@@ -1,0 +1,94 @@
+"""Shared base for matrix-RS erasure-code plugins (isa / jerasure / tpu).
+
+Wires a ``MatrixRSCodec`` (host oracle) and optionally the TPU device backend
+(ceph_tpu.ops.gf_matmul) into the ErasureCode ABI.  The execution backend is
+selected by the profile key ``backend=host|tpu|auto`` (auto = TPU when a
+device is usable, else host).  Both backends are byte-identical by
+construction and by test.
+"""
+from __future__ import annotations
+
+from typing import Dict, Set
+
+import numpy as np
+
+from .base import ErasureCode
+from .rs_codec import MatrixRSCodec
+
+
+class ErasureCodeMatrixRS(ErasureCode):
+    """A systematic matrix code with k data + m coding chunks."""
+
+    def __init__(self):
+        super().__init__()
+        self.k = 0
+        self.m = 0
+        self.codec: MatrixRSCodec | None = None
+        self.backend_name = "host"
+        self._device = None  # lazy DeviceRSBackend
+
+    # -- sizing -------------------------------------------------------------
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_alignment(self) -> int:
+        return 32
+
+    def get_chunk_size(self, object_size: int) -> int:
+        # isa-style: ceil(object_size / k) rounded up to alignment
+        # (reference ErasureCodeIsa.cc:65-78)
+        alignment = self.get_alignment()
+        chunk_size = (object_size + self.k - 1) // self.k
+        modulo = chunk_size % alignment
+        if modulo:
+            chunk_size += alignment - modulo
+        return chunk_size
+
+    # -- backend ------------------------------------------------------------
+    def _init_backend(self, profile) -> None:
+        self.backend_name = profile.get("backend", "auto")
+        if self.backend_name not in ("host", "tpu", "auto"):
+            raise ValueError(f"backend={self.backend_name} not in host|tpu|auto")
+
+    def device(self):
+        if self._device is None:
+            from ..ops.gf_matmul import DeviceRSBackend
+            self._device = DeviceRSBackend(self.codec.matrix)
+        return self._device
+
+    def _use_device(self) -> bool:
+        if self.backend_name == "host":
+            return False
+        if self.backend_name == "tpu":
+            return True
+        from ..ops.gf_matmul import device_available
+        return device_available()
+
+    # -- encode/decode ------------------------------------------------------
+    def encode_chunks(self, want_to_encode: Set[int],
+                      encoded: Dict[int, np.ndarray]) -> None:
+        # buffers are keyed by *physical* index (chunk_index); the codec works
+        # in logical rows.  mapping= profiles permute the two.
+        data = np.stack([encoded[self.chunk_index(i)] for i in range(self.k)])
+        if self._use_device():
+            coding = self.device().encode(data[None])[0]
+        else:
+            coding = self.codec.encode(data)
+        for i in range(self.m):
+            # fill in place so callers holding references see the parity
+            encoded[self.chunk_index(self.k + i)][...] = coding[i]
+
+    def decode_chunks(self, want_to_read: Set[int],
+                      chunks: Dict[int, np.ndarray],
+                      decoded: Dict[int, np.ndarray]) -> None:
+        n = self.k + self.m
+        phys_to_logical = {self.chunk_index(i): i for i in range(n)}
+        logical_chunks = {phys_to_logical[p]: buf for p, buf in chunks.items()}
+        want = sorted(phys_to_logical[p] for p in range(n)
+                      if p in want_to_read or p not in chunks)
+        out = self.codec.decode(logical_chunks, want)
+        for i, buf in out.items():
+            decoded[self.chunk_index(i)][...] = buf

@@ -1,0 +1,117 @@
+"""ErasureCodePluginRegistry — plugin factory registry.
+
+Mirrors the reference's dlopen-based registry semantics
+(src/erasure-code/ErasureCodePlugin.cc:126-184): plugins are registered by
+name into a lock-guarded singleton, version-checked, and instantiated per
+profile.  Here a "plugin" is a Python factory; third-party plugins can
+register via ``ErasureCodePluginRegistry.add``.  Preloading
+(osd_erasure_code_plugins; reference global/global_init.cc:482) maps to
+``preload()``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict
+
+from .interface import ErasureCodeInterface, ErasureCodeProfile
+
+# version handshake analog of __erasure_code_version (ErasureCodePlugin.h:24-27)
+PLUGIN_VERSION = "ceph_tpu-ec-1"
+
+
+class ErasureCodePlugin:
+    """Factory wrapper; subclass or pass a callable returning a codec."""
+
+    version = PLUGIN_VERSION
+
+    def __init__(self, factory: Callable[[], ErasureCodeInterface]):
+        self._factory = factory
+
+    def make(self, profile: ErasureCodeProfile) -> ErasureCodeInterface:
+        codec = self._factory()
+        codec.init(dict(profile))
+        return codec
+
+
+class ErasureCodePluginRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plugins: Dict[str, ErasureCodePlugin] = {}
+        self._load_errors: Dict[str, Exception] = {}
+        self.disable_dlclose = True  # parity flag; meaningless here
+
+    def add(self, name: str, plugin: ErasureCodePlugin) -> None:
+        with self._lock:
+            if name in self._plugins:
+                raise KeyError(f"plugin {name} already registered")
+            if plugin.version != PLUGIN_VERSION:
+                raise RuntimeError(
+                    f"plugin {name} version {plugin.version} does not match "
+                    f"expected {PLUGIN_VERSION}")
+            self._plugins[name] = plugin
+
+    def get(self, name: str) -> ErasureCodePlugin:
+        with self._lock:
+            self._load_builtin(name)
+            if name not in self._plugins:
+                if name in self._load_errors:
+                    raise ImportError(
+                        f"erasure-code plugin {name!r} failed to load: "
+                        f"{self._load_errors[name]}")
+                raise KeyError(f"unknown erasure-code plugin {name!r}")
+            return self._plugins[name]
+
+    def factory(self, name: str, profile: ErasureCodeProfile) -> ErasureCodeInterface:
+        return self.get(name).make(profile)
+
+    def preload(self, names) -> None:
+        for n in names:
+            self.get(n)
+
+    def names(self):
+        for n in ("jerasure", "isa", "tpu", "lrc", "shec", "example_xor"):
+            self._load_builtin(n)
+        return sorted(self._plugins)
+
+    # lazy built-in registration (avoids import cycles; analog of the
+    # libec_<name>.so lookup path)
+    def _load_builtin(self, name: str) -> None:
+        if name in self._plugins:
+            return
+        try:
+            self._load_builtin_unchecked(name)
+        except ImportError as e:
+            self._load_errors[name] = e
+
+    def _load_builtin_unchecked(self, name: str) -> None:
+        factory = None
+        if name == "jerasure":
+            from .jerasure import ErasureCodeJerasure
+            factory = ErasureCodeJerasure
+        elif name == "isa":
+            from .isa import ErasureCodeIsa
+            factory = ErasureCodeIsa
+        elif name == "tpu":
+            from .tpu_plugin import ErasureCodeTpu
+            factory = ErasureCodeTpu
+        elif name == "lrc":
+            from .lrc import ErasureCodeLrc
+            factory = ErasureCodeLrc
+        elif name == "shec":
+            from .shec import ErasureCodeShec
+            factory = ErasureCodeShec
+        elif name == "example_xor":
+            from .example_xor import ErasureCodeExampleXor
+            factory = ErasureCodeExampleXor
+        if factory is not None:
+            self._plugins[name] = ErasureCodePlugin(factory)
+
+
+instance = ErasureCodePluginRegistry()
+
+
+def create_erasure_code(profile: ErasureCodeProfile) -> ErasureCodeInterface:
+    """mon-style entry point (reference mon/OSDMonitor.cc:5335
+    get_erasure_code): profile['plugin'] selects the codec."""
+    plugin = profile.get("plugin", "jerasure")
+    return instance.factory(plugin, profile)

@@ -1,0 +1,72 @@
+"""'tpu' plugin — ErasureCodeTpu: the flagship device codec.
+
+The north-star component: an ErasureCodeInterface-compatible codec whose
+encode()/decode() batch stripe chunks into HBM and run the GF(2^8) matrix
+multiply as MXU matmuls (ceph_tpu.ops.gf_matmul), replacing the reference's
+isa-l/jerasure SIMD paths while staying byte-identical to them.
+
+Profile: k, m, technique=reed_sol_van|cauchy (isa-l matrix semantics, so
+chunks match the reference isa plugin bit-for-bit).  Beyond the reference
+ABI it adds the batched-stripe entry points ``encode_batch`` /
+``decode_batch`` used by ECUtil striping and the benchmark CLI — one device
+call for S stripes is where the >=10x throughput target comes from (the
+reference encodes stripe-by-stripe on the CPU, osd/ECUtil.cc:120-159).
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+from .isa import ErasureCodeIsa
+
+
+class ErasureCodeTpu(ErasureCodeIsa):
+    def init(self, profile) -> None:
+        profile = dict(profile)
+        profile.setdefault("backend", "tpu")
+        super().init(profile)
+
+    # ---- batched device API ----------------------------------------------
+    def encode_batch(self, data: np.ndarray) -> np.ndarray:
+        """(S, k, C) uint8 -> (S, m, C) coding chunks in one device call."""
+        return self.device().encode(data)
+
+    def encode_batch_device(self, data):
+        """jnp in/out; composes under jit / Mesh shardings."""
+        return self.device().encode_device(data)
+
+    def decode_batch(self, chunks: Dict[int, np.ndarray],
+                     want: Sequence[int]) -> Dict[int, np.ndarray]:
+        """Reconstruct chunk ids in *want* for a whole batch.
+
+        chunks maps chunk id -> (S, C) arrays; all stripes share the same
+        erasure signature (the recovery case: one failed shard across many
+        stripes).
+        """
+        if len(chunks) < self.k:
+            raise IOError(
+                f"need at least k={self.k} chunks, have {len(chunks)}")
+        srcs = sorted(chunks)[:self.k]
+        survivors = np.stack([chunks[i] for i in srcs], axis=1)  # (S, k, C)
+        want_data = [i for i in want if i < self.k and i not in chunks]
+        want_coding = [i for i in want if i >= self.k and i not in chunks]
+        out: Dict[int, np.ndarray] = {i: chunks[i] for i in want if i in chunks}
+        dev = self.device()
+        # only actually-missing data rows go through the device matvec
+        need = sorted(set(want_data) |
+                      ({i for i in range(self.k) if i not in chunks}
+                       if want_coding else set()))
+        if need:
+            rec = dev.decode_data(survivors, srcs, need)
+            by_id = {i: rec[:, idx] for idx, i in enumerate(need)}
+            for i in want_data:
+                out[i] = by_id[i]
+            if want_coding:
+                data_full = np.stack(
+                    [chunks[i] if i in chunks else by_id[i]
+                     for i in range(self.k)], axis=1)
+                coding = dev.encode(data_full)
+                for i in want_coding:
+                    out[i] = coding[:, i - self.k]
+        return out

@@ -1,0 +1,13 @@
+"""Test environment: force JAX onto a virtual 8-device CPU mesh.
+
+Real-TPU runs happen via bench.py / the driver; tests must be hermetic and
+exercise the multi-chip sharding path, so we ask XLA for 8 host devices.
+Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

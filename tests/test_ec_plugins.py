@@ -1,0 +1,147 @@
+"""Erasure-code plugin tests: registry, padding, exhaustive erasure sweeps.
+
+Models the reference test strategy (SURVEY.md §4): per-plugin encode/decode
+checks across all failure combinations (mirroring TestErasureCodeIsa's
+exhaustive (k,m) sweeps) plus registry behavior tests.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import plugin_registry, create_erasure_code
+
+
+def roundtrip_sweep(codec, payload: bytes, max_erasures=None):
+    k = codec.get_data_chunk_count()
+    n = codec.get_chunk_count()
+    m = n - k
+    want_all = set(range(n))
+    encoded = codec.encode(want_all, payload)
+    assert set(encoded) == want_all
+    blocksize = codec.get_chunk_size(len(payload))
+    for c in encoded.values():
+        assert len(c) == blocksize
+    # reconstructed payload round-trips (with zero padding)
+    out = codec.decode_concat(encoded)
+    assert out[:len(payload)] == payload
+    assert all(b == 0 for b in out[len(payload):])
+
+    erasure_budget = m if max_erasures is None else max_erasures
+    for e in range(1, erasure_budget + 1):
+        for gone in itertools.combinations(range(n), e):
+            avail = {i: encoded[i] for i in want_all - set(gone)}
+            mind = codec.minimum_to_decode(set(gone), set(avail))
+            assert len(mind) <= k
+            decoded = codec.decode(set(gone), avail)
+            for i in gone:
+                np.testing.assert_array_equal(
+                    decoded[i], encoded[i],
+                    err_msg=f"chunk {i} mismatch after erasing {gone}")
+    return encoded
+
+
+@pytest.mark.parametrize("plugin,profile", [
+    ("isa", {"k": "4", "m": "2", "backend": "host"}),
+    ("isa", {"k": "8", "m": "4", "backend": "host"}),
+    ("isa", {"k": "4", "m": "2", "technique": "cauchy", "backend": "host"}),
+    ("jerasure", {"k": "4", "m": "2", "backend": "host"}),
+    ("jerasure", {"k": "7", "m": "3", "backend": "host"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "reed_sol_r6_op",
+                  "backend": "host"}),
+    ("jerasure", {"k": "4", "m": "3", "technique": "cauchy_orig",
+                  "backend": "host"}),
+    ("example_xor", {"k": "3", "backend": "host"}),
+])
+def test_roundtrip_exhaustive(plugin, profile):
+    codec = plugin_registry.factory(plugin, profile)
+    rng = np.random.default_rng(42)
+    payload = rng.integers(0, 256, size=4096 + 17, dtype=np.uint8).tobytes()
+    roundtrip_sweep(codec, payload)
+
+
+def test_registry_names_and_create():
+    names = plugin_registry.names()
+    for expected in ("isa", "jerasure", "tpu", "example_xor"):
+        assert expected in names
+    codec = create_erasure_code({"plugin": "isa", "k": "4", "m": "2",
+                                 "backend": "host"})
+    assert codec.get_chunk_count() == 6
+
+
+def test_registry_unknown_plugin():
+    with pytest.raises(KeyError):
+        plugin_registry.factory("nope", {})
+
+
+def test_isa_defaults_and_clamps():
+    codec = plugin_registry.factory("isa", {"backend": "host"})
+    assert codec.get_data_chunk_count() == 7  # reference DEFAULT_K
+    assert codec.get_coding_chunk_count() == 3
+    # MDS clamps (ErasureCodeIsa.cc:330-361)
+    codec = plugin_registry.factory(
+        "isa", {"k": "40", "m": "6", "backend": "host"})
+    assert codec.get_data_chunk_count() == 21  # 40->32, then m=4 forces 21
+    assert codec.get_coding_chunk_count() == 4
+
+
+def test_minimum_to_decode_semantics():
+    codec = plugin_registry.factory("isa", {"k": "4", "m": "2",
+                                            "backend": "host"})
+    # want fully available -> want itself
+    assert set(codec.minimum_to_decode({1, 2}, {0, 1, 2, 3, 4, 5})) == {1, 2}
+    # missing chunk -> first k available in ascending order
+    assert set(codec.minimum_to_decode({0}, {1, 2, 3, 4, 5})) == {1, 2, 3, 4}
+    with pytest.raises(IOError):
+        codec.minimum_to_decode({0}, {1, 2, 3})
+    # sub-chunk lists are (0, 1) for MDS codes
+    assert codec.minimum_to_decode({1}, {0, 1, 2, 3, 4, 5}) == {1: [(0, 1)]}
+
+
+def test_chunk_size_semantics():
+    isa = plugin_registry.factory("isa", {"k": "4", "m": "2",
+                                          "backend": "host"})
+    # ceil(len/k) rounded to 32
+    assert isa.get_chunk_size(4096) == 1024
+    assert isa.get_chunk_size(4097) == 1056  # 1025 -> pad to 32
+    jer = plugin_registry.factory("jerasure", {"k": "4", "m": "2",
+                                               "backend": "host"})
+    # object padded to k*w*4 = 128, divided by k
+    assert jer.get_chunk_size(4096) == 1024
+    assert jer.get_chunk_size(4097) == (4096 + 128) // 4
+
+
+def test_isa_m1_parity_is_xor():
+    codec = plugin_registry.factory("isa", {"k": "4", "m": "1",
+                                            "backend": "host"})
+    rng = np.random.default_rng(7)
+    payload = rng.integers(0, 256, size=1024, dtype=np.uint8).tobytes()
+    enc = codec.encode(set(range(5)), payload)
+    xor = np.zeros_like(enc[0])
+    for i in range(4):
+        xor ^= enc[i]
+    np.testing.assert_array_equal(enc[4], xor)
+
+
+def test_padding_small_objects():
+    codec = plugin_registry.factory("isa", {"k": "4", "m": "2",
+                                            "backend": "host"})
+    payload = b"tiny"
+    enc = codec.encode(set(range(6)), payload)
+    assert codec.decode_concat(enc)[:4] == payload
+
+
+def test_mapping_profile_roundtrip():
+    # mapping= permutes logical->physical chunk placement (ErasureCode.cc:258)
+    codec = plugin_registry.factory(
+        "isa", {"k": "3", "m": "1", "mapping": "ABCD", "backend": "host"})
+    rng = np.random.default_rng(11)
+    payload = rng.integers(0, 256, size=1024, dtype=np.uint8).tobytes()
+    roundtrip_sweep(codec, payload)
+
+
+def test_decode_no_chunks_raises_ioerror():
+    codec = plugin_registry.factory("isa", {"k": "4", "m": "2",
+                                            "backend": "host"})
+    with pytest.raises(IOError):
+        codec.decode({0}, {})

@@ -1,0 +1,141 @@
+"""GF(2^8) field + matrix generator tests."""
+import numpy as np
+import pytest
+
+from ceph_tpu.gf import (
+    GF_POLY, gf_exp, gf_log, gf_mul, gf_inv, gf_div, gf_pow, MUL_TABLE,
+    gf_mult_bitmatrix, expand_to_bitmatrix,
+    gf_gen_rs_matrix, gf_gen_cauchy1_matrix, jerasure_reed_sol_van_matrix,
+    gf_invert_matrix, gf_matmul,
+)
+
+
+def slow_mul(a, b):
+    """Carry-less multiply + reduction — independent of the tables."""
+    p = 0
+    for i in range(8):
+        if b & (1 << i):
+            p ^= a << i
+    for i in range(15, 7, -1):
+        if p & (1 << i):
+            p ^= GF_POLY << (i - 8)
+    return p
+
+
+def test_tables_against_carryless_mult():
+    rng = np.random.default_rng(0)
+    for _ in range(2000):
+        a, b = int(rng.integers(256)), int(rng.integers(256))
+        assert gf_mul(a, b) == slow_mul(a, b)
+        assert MUL_TABLE[a, b] == slow_mul(a, b)
+
+
+def test_field_axioms():
+    for a in range(1, 256):
+        assert gf_mul(a, gf_inv(a)) == 1
+        assert gf_div(a, a) == 1
+        assert gf_mul(a, 1) == a
+    # distributivity spot checks
+    rng = np.random.default_rng(1)
+    for _ in range(500):
+        a, b, c = (int(x) for x in rng.integers(256, size=3))
+        assert gf_mul(a, b ^ c) == gf_mul(a, b) ^ gf_mul(a, c)
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert gf_exp[gf_log[a]] == a
+
+
+def test_bitmatrix_mult():
+    rng = np.random.default_rng(2)
+    for _ in range(200):
+        c, x = int(rng.integers(256)), int(rng.integers(256))
+        m = gf_mult_bitmatrix(c)
+        xb = np.array([(x >> i) & 1 for i in range(8)], dtype=np.uint8)
+        pb = (m @ xb) % 2
+        p = sum(int(pb[i]) << i for i in range(8))
+        assert p == gf_mul(c, x)
+
+
+def _is_mds(matrix, k, m):
+    """Every k x k submatrix from any k of the k+m rows must be invertible."""
+    import itertools
+    for rows in itertools.combinations(range(k + m), k):
+        try:
+            gf_invert_matrix(matrix[list(rows), :])
+        except np.linalg.LinAlgError:
+            return False
+    return True
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 4), (21, 4), (6, 3)])
+def test_isa_vandermonde_mds_within_limits(k, m):
+    # reference guarantees MDS only within k<=21..32, m<=4 (ErasureCodeIsa.cc:330)
+    mat = gf_gen_rs_matrix(k + m, k)
+    assert (mat[:k] == np.eye(k, dtype=np.uint8)).all()
+    assert (mat[k] == 1).all()  # first coding row is XOR (region_xor fast path)
+    assert _is_mds(mat, k, m)
+
+
+@pytest.mark.parametrize("k,m", [(2, 2), (4, 3), (8, 4), (10, 4)])
+def test_cauchy_mds(k, m):
+    mat = gf_gen_cauchy1_matrix(k + m, k)
+    assert (mat[:k] == np.eye(k, dtype=np.uint8)).all()
+    assert _is_mds(mat, k, m)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (7, 3), (8, 4), (9, 6)])
+def test_jerasure_reed_sol_van_mds(k, m):
+    mat = jerasure_reed_sol_van_matrix(k, m)
+    assert mat.shape == (m, k)
+    full = np.vstack([np.eye(k, dtype=np.uint8), mat])
+    assert _is_mds(full, k, m)
+
+
+def test_jerasure_reed_sol_van_deterministic():
+    # construction is deterministic and systematic; jerasure's own binary
+    # output is unverifiable here (empty submodule in the reference tree),
+    # so we pin our own construction to catch regressions
+    a = jerasure_reed_sol_van_matrix(4, 2)
+    b = jerasure_reed_sol_van_matrix(4, 2)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 4)
+
+
+def test_matrix_inverse():
+    rng = np.random.default_rng(3)
+    for _ in range(50):
+        k = int(rng.integers(2, 12))
+        while True:
+            a = rng.integers(0, 256, size=(k, k)).astype(np.uint8)
+            try:
+                inv = gf_invert_matrix(a)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        prod = gf_matmul(a, inv)
+        assert (prod == np.eye(k, dtype=np.uint8)).all()
+
+
+def test_expand_to_bitmatrix_matches_scalar():
+    rng = np.random.default_rng(4)
+    k, m = 4, 2
+    coding = rng.integers(0, 256, size=(m, k)).astype(np.uint8)
+    big = expand_to_bitmatrix(coding)
+    data = rng.integers(0, 256, size=k).astype(np.uint8)
+    bits = np.concatenate(
+        [[(int(d) >> i) & 1 for i in range(8)] for d in data]).astype(np.uint8)
+    out_bits = (bits @ big) % 2
+    for r in range(m):
+        byte = sum(int(out_bits[r * 8 + i]) << i for i in range(8))
+        ref = 0
+        for c in range(k):
+            ref ^= gf_mul(int(coding[r, c]), int(data[c]))
+        assert byte == ref
+
+
+def test_gf_pow():
+    assert gf_pow(2, 0) == 1
+    assert gf_pow(2, 1) == 2
+    assert gf_pow(2, 8) == GF_POLY ^ 0x100  # 2^8 reduces by the polynomial
