@@ -1,0 +1,8 @@
+from .constants import *  # noqa: F401,F403
+from .types import (  # noqa: F401
+    Bucket, UniformBucket, ListBucket, TreeBucket, StrawBucket, Straw2Bucket,
+    Rule, RuleStep, CrushMap, ChooseArg, WeightSet,
+)
+from .mapper import crush_do_rule, crush_find_rule  # noqa: F401
+from .wrapper import CrushWrapper  # noqa: F401
+from . import builder  # noqa: F401

@@ -1,0 +1,228 @@
+"""CrushWrapper — the named, user-facing façade over the raw map.
+
+Semantics follow the reference C++ façade (src/crush/CrushWrapper.{h,cc}):
+type/bucket/rule name registries, hierarchy construction, add_simple_rule
+("firstn"/"indep" step templates incl. the indep SET_CHOOSELEAF_TRIES=5 /
+SET_CHOOSE_TRIES=100 preamble, CrushWrapper.cc add_simple_rule_at), tunable
+profiles, per-map choose_args, and the batch do_rule entry used by OSDMap.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .constants import (
+    CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSELEAF_INDEP,
+    CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSE_INDEP, CRUSH_RULE_EMIT,
+    CRUSH_RULE_SET_CHOOSELEAF_TRIES, CRUSH_RULE_SET_CHOOSE_TRIES,
+    CRUSH_RULE_TAKE, PG_POOL_TYPE_REPLICATED,
+)
+from . import builder
+from .mapper import crush_do_rule, crush_find_rule
+from .types import Bucket, ChooseArg, CrushMap, Rule, RuleStep
+
+
+class CrushWrapper:
+    def __init__(self):
+        self.crush = CrushMap()
+        self.type_map: Dict[int, str] = {0: "osd"}
+        self.name_map: Dict[int, str] = {}       # item id -> name
+        self.rule_name_map: Dict[int, str] = {}
+        self.class_map: Dict[int, str] = {}      # class id -> name
+        self.item_class: Dict[int, int] = {}     # device id -> class id
+        # root bucket id -> class id -> shadow bucket id
+        self.class_bucket: Dict[int, Dict[int, int]] = {}
+
+    # ---- names ------------------------------------------------------------
+    def set_type_name(self, t: int, name: str) -> None:
+        self.type_map[t] = name
+
+    def get_type_id(self, name: str) -> int:
+        for t, n in self.type_map.items():
+            if n == name:
+                return t
+        return -1
+
+    def get_type_name(self, t: int) -> str:
+        return self.type_map.get(t, f"type{t}")
+
+    def set_item_name(self, item: int, name: str) -> None:
+        self.name_map[item] = name
+
+    def get_item_name(self, item: int) -> str:
+        return self.name_map.get(
+            item, f"osd.{item}" if item >= 0 else f"bucket{item}")
+
+    def name_exists(self, name: str) -> bool:
+        return name in self.name_map.values()
+
+    def get_item_id(self, name: str) -> int:
+        for i, n in self.name_map.items():
+            if n == name:
+                return i
+        raise KeyError(name)
+
+    def rule_exists(self, name_or_no) -> bool:
+        if isinstance(name_or_no, str):
+            return name_or_no in self.rule_name_map.values()
+        return (0 <= name_or_no < self.crush.max_rules
+                and self.crush.rules[name_or_no] is not None)
+
+    def get_rule_id(self, name: str) -> int:
+        for i, n in self.rule_name_map.items():
+            if n == name:
+                return i
+        return -1
+
+    def ruleset_exists(self, ruleset: int) -> bool:
+        return any(r is not None and r.ruleset == ruleset
+                   for r in self.crush.rules)
+
+    # ---- device classes ---------------------------------------------------
+    def get_or_create_class_id(self, name: str) -> int:
+        for c, n in self.class_map.items():
+            if n == name:
+                return c
+        c = max(self.class_map, default=-1) + 1
+        self.class_map[c] = name
+        return c
+
+    def class_exists(self, name: str) -> bool:
+        return name in self.class_map.values()
+
+    def set_item_class(self, item: int, cls: str) -> int:
+        c = self.get_or_create_class_id(cls)
+        self.item_class[item] = c
+        return c
+
+    # ---- construction -----------------------------------------------------
+    def add_bucket(self, alg: int, type: int, name: str,
+                   items: Sequence[int] = (), weights: Sequence[int] = (),
+                   id: int = 0) -> int:
+        b = builder.make_bucket(alg, type, items, weights, id,
+                                self.crush.straw_calc_version)
+        bid = self.crush.add_bucket(b, None if id == 0 else id)
+        self.set_item_name(bid, name)
+        return bid
+
+    def get_bucket(self, id: int) -> Bucket:
+        b = self.crush.bucket(id)
+        if b is None:
+            raise KeyError(f"no bucket {id}")
+        return b
+
+    def rebuild_bucket(self, id: int, items: Sequence[int],
+                       weights: Sequence[int]) -> None:
+        """Replace a bucket's items/weights in place (reweight/add/remove)."""
+        old = self.get_bucket(id)
+        b = builder.make_bucket(old.alg, old.type, items, weights, id,
+                                self.crush.straw_calc_version)
+        self.crush.buckets[-1 - id] = b
+
+    def get_max_devices(self) -> int:
+        return self.crush.max_devices
+
+    def set_max_devices(self, n: int) -> None:
+        self.crush.max_devices = n
+
+    # ---- rules ------------------------------------------------------------
+    def add_rule(self, rule: Rule, name: str, ruleno: int = -1) -> int:
+        rno = self.crush.add_rule(rule, ruleno)
+        self.rule_name_map[rno] = name
+        return rno
+
+    def add_simple_rule(self, name: str, root_name: str,
+                        failure_domain_name: str = "",
+                        device_class: str = "",
+                        mode: str = "firstn",
+                        rule_type: int = PG_POOL_TYPE_REPLICATED,
+                        ruleno: int = -1) -> int:
+        if self.rule_exists(name):
+            return -17  # EEXIST
+        if not self.name_exists(root_name):
+            return -2   # ENOENT
+        root = self.get_item_id(root_name)
+        ftype = 0
+        if failure_domain_name:
+            ftype = self.get_type_id(failure_domain_name)
+            if ftype < 0:
+                return -22  # EINVAL
+        if device_class:
+            if not self.class_exists(device_class):
+                return -22
+            c = self.get_or_create_class_id(device_class)
+            shadow = self.class_bucket.get(root, {}).get(c)
+            if shadow is None:
+                return -22
+            root = shadow
+        if mode not in ("firstn", "indep"):
+            return -22
+        if ruleno < 0:
+            ruleno = next(
+                (i for i in range(self.crush.max_rules + 1)
+                 if not self.rule_exists(i) and not self.ruleset_exists(i)))
+        steps: List[RuleStep] = []
+        if mode == "indep":
+            steps.append(RuleStep(CRUSH_RULE_SET_CHOOSELEAF_TRIES, 5, 0))
+            steps.append(RuleStep(CRUSH_RULE_SET_CHOOSE_TRIES, 100, 0))
+        steps.append(RuleStep(CRUSH_RULE_TAKE, root, 0))
+        if ftype:
+            steps.append(RuleStep(
+                CRUSH_RULE_CHOOSELEAF_FIRSTN if mode == "firstn"
+                else CRUSH_RULE_CHOOSELEAF_INDEP, 0, ftype))
+        else:
+            steps.append(RuleStep(
+                CRUSH_RULE_CHOOSE_FIRSTN if mode == "firstn"
+                else CRUSH_RULE_CHOOSE_INDEP, 0, 0))
+        steps.append(RuleStep(CRUSH_RULE_EMIT, 0, 0))
+        rule = Rule(steps=steps, ruleset=ruleno, type=rule_type,
+                    min_size=1 if mode == "firstn" else 3,
+                    max_size=10 if mode == "firstn" else 20)
+        return self.add_rule(rule, name, ruleno)
+
+    def set_rule_mask_max_size(self, ruleno: int, max_size: int) -> None:
+        self.crush.rules[ruleno].max_size = max_size
+
+    def find_rule(self, ruleset: int, type: int, size: int) -> int:
+        return crush_find_rule(self.crush, ruleset, type, size)
+
+    # ---- tunables ---------------------------------------------------------
+    def set_tunables_profile(self, profile: str) -> None:
+        self.crush.set_tunables_profile(profile)
+
+    # ---- choose args ------------------------------------------------------
+    def choose_args_create(self, key: int = 0) -> List[ChooseArg]:
+        args = [ChooseArg() for _ in range(self.crush.max_buckets)]
+        self.crush.choose_args[key] = args
+        return args
+
+    def choose_args_get(self, key: int = 0) -> Optional[List[ChooseArg]]:
+        return self.crush.choose_args.get(key)
+
+    # ---- mapping ----------------------------------------------------------
+    def do_rule(self, ruleno: int, x: int, maxout: int,
+                weight: Sequence[int],
+                choose_args_index: Optional[int] = None) -> List[int]:
+        ca = None
+        if choose_args_index is not None:
+            ca = self.crush.choose_args.get(choose_args_index)
+        return crush_do_rule(self.crush, ruleno, x, maxout, weight, ca)
+
+    # ---- introspection ----------------------------------------------------
+    def get_children(self, id: int) -> List[int]:
+        b = self.crush.bucket(id)
+        return list(b.items) if b else []
+
+    def get_full_location(self, item: int) -> Dict[str, str]:
+        """Walk up the tree: type name -> bucket name for each ancestor."""
+        loc = {}
+        cur = item
+        found = True
+        while found:
+            found = False
+            for b in self.crush.buckets:
+                if b is not None and cur in b.items:
+                    loc[self.get_type_name(b.type)] = self.get_item_name(b.id)
+                    cur = b.id
+                    found = True
+                    break
+        return loc

@@ -26,6 +26,25 @@ from ..gf.matrices import gf_invert_matrix, gf_matmul
 DECODE_CACHE_ENTRIES = 2516
 
 
+def plan_decode(k: int, available: Sequence[int], want: Sequence[int]):
+    """Shared reconstruction plan used by both host and device executors.
+
+    Returns (srcs, want_data, want_coding, missing_data):
+    - srcs: the k survivor chunk ids to invert against
+    - want_data / want_coding: requested-and-missing chunk ids by kind
+    - missing_data: data rows the matvec must recover (includes data rows
+      needed solely to re-encode missing coding chunks)
+    """
+    have = set(available)
+    srcs = sorted(have)[:k]
+    want_data = [i for i in want if i < k and i not in have]
+    want_coding = [i for i in want if i >= k and i not in have]
+    missing_data = sorted(
+        set(want_data) |
+        ({i for i in range(k) if i not in have} if want_coding else set()))
+    return srcs, want_data, want_coding, missing_data
+
+
 def gf_matvec_bytes(matrix_rows: np.ndarray, data: np.ndarray) -> np.ndarray:
     """rows (r, k) x data (k, C) -> (r, C) over GF(2^8), via 64KiB mul table."""
     r, k = matrix_rows.shape
@@ -94,15 +113,11 @@ class MatrixRSCodec:
         inv, srcs = self.decode_matrix_for(list(chunks))
         src_stack = np.stack([chunks[i] for i in srcs])
         out: Dict[int, np.ndarray] = {}
-        want_data = [i for i in want if i < self.k and i not in chunks]
-        want_coding = [i for i in want if i >= self.k and i not in chunks]
+        _, want_data, want_coding, missing_data = plan_decode(
+            self.k, chunks, want)
         if want_data or want_coding:
             # only the data rows actually missing need the matvec; surviving
             # data rows come straight from chunks
-            missing_data = sorted(
-                set(want_data) |
-                ({i for i in range(self.k) if i not in chunks}
-                 if want_coding else set()))
             rec = gf_matvec_bytes(inv[missing_data, :], src_stack)
             data_by_id = dict(zip(missing_data, rec))
             for i in want_data:

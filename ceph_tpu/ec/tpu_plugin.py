@@ -47,26 +47,24 @@ class ErasureCodeTpu(ErasureCodeIsa):
         if len(chunks) < self.k:
             raise IOError(
                 f"need at least k={self.k} chunks, have {len(chunks)}")
-        srcs = sorted(chunks)[:self.k]
+        from .rs_codec import plan_decode
+        srcs, want_data, want_coding, missing_data = plan_decode(
+            self.k, chunks, want)
         survivors = np.stack([chunks[i] for i in srcs], axis=1)  # (S, k, C)
-        want_data = [i for i in want if i < self.k and i not in chunks]
-        want_coding = [i for i in want if i >= self.k and i not in chunks]
         out: Dict[int, np.ndarray] = {i: chunks[i] for i in want if i in chunks}
         dev = self.device()
-        # only actually-missing data rows go through the device matvec
-        need = sorted(set(want_data) |
-                      ({i for i in range(self.k) if i not in chunks}
-                       if want_coding else set()))
-        if need:
-            rec = dev.decode_data(survivors, srcs, need)
-            by_id = {i: rec[:, idx] for idx, i in enumerate(need)}
+        by_id: Dict[int, np.ndarray] = {}
+        if missing_data:
+            # only actually-missing data rows go through the device matvec
+            rec = dev.decode_data(survivors, srcs, missing_data)
+            by_id = {i: rec[:, idx] for idx, i in enumerate(missing_data)}
             for i in want_data:
                 out[i] = by_id[i]
-            if want_coding:
-                data_full = np.stack(
-                    [chunks[i] if i in chunks else by_id[i]
-                     for i in range(self.k)], axis=1)
-                coding = dev.encode(data_full)
-                for i in want_coding:
-                    out[i] = coding[:, i - self.k]
+        if want_coding:
+            data_full = np.stack(
+                [chunks[i] if i in chunks else by_id[i]
+                 for i in range(self.k)], axis=1)
+            coding = dev.encode(data_full)
+            for i in want_coding:
+                out[i] = coding[:, i - self.k]
         return out

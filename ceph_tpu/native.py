@@ -1,0 +1,199 @@
+"""ctypes bindings to the native C++ runtime (native/libceph_tpu_native.so).
+
+The native library supplies:
+- an independent CRUSH map evaluator (cross-validates the Python mapper and
+  serves as the threaded CPU batch baseline, the ParallelPGMapper analog);
+- GF(2^8) region encode (the isa-l ec_encode_data-class CPU path used as
+  the benchmark baseline);
+- crc32c for chunk HashInfo.
+
+Builds on demand with the repo's Makefile (g++ -O3 -march=native).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .crush.constants import (
+    CRUSH_BUCKET_LIST, CRUSH_BUCKET_STRAW, CRUSH_BUCKET_STRAW2,
+    CRUSH_BUCKET_TREE, CRUSH_BUCKET_UNIFORM,
+)
+from .crush.types import CrushMap
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_ROOT, "native")
+_SO = os.path.join(_NATIVE_DIR, "libceph_tpu_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build_native() -> str:
+    subprocess.run(["make", "-s", "-C", _NATIVE_DIR], check=True)
+    return _SO
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) or (
+                os.path.getmtime(_SO) < max(
+                    os.path.getmtime(os.path.join(_NATIVE_DIR, f))
+                    for f in ("crush_mapper.cpp", "gf_rs.cpp"))):
+            build_native()
+        lib = ctypes.CDLL(_SO)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        # argtypes are mandatory: passing python ints for int64_t params
+        # without them leaves the upper register bits undefined (SysV ABI)
+        lib.crush_set_ln_tables.argtypes = [i64p, i64p]
+        lib.crush_do_rule_c.restype = ctypes.c_int
+        lib.crush_do_rule_c.argtypes = [
+            i64p, ctypes.c_int64, ctypes.c_int, ctypes.c_int64, i64p,
+            ctypes.c_int, u32p, ctypes.c_int64]
+        lib.crush_do_rule_batch.restype = ctypes.c_int
+        lib.crush_do_rule_batch.argtypes = [
+            i64p, ctypes.c_int64, ctypes.c_int, i64p, ctypes.c_int64, i64p,
+            ctypes.c_int, i32p, u32p, ctypes.c_int64]
+        lib.gf_rs_encode.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, u8p, u8p, ctypes.c_int64]
+        lib.gf_region_xor.argtypes = [u8p, u8p, u8p, ctypes.c_int64]
+        lib.ceph_crc32c.restype = ctypes.c_uint32
+        lib.ceph_crc32c.argtypes = [ctypes.c_uint32, u8p, ctypes.c_int64]
+        lib.gf_mul_c.restype = ctypes.c_uint8
+        lib.gf_mul_c.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
+        # inject the ln tables once
+        from .crush.ln import RH_LH_NP, LL_NP
+        rh = RH_LH_NP.astype(np.int64)
+        llt = LL_NP.astype(np.int64)
+        lib.crush_set_ln_tables(
+            rh.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            llt.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        get_lib()
+        return True
+    except Exception:
+        return False
+
+
+# ---- crush ----------------------------------------------------------------
+
+def serialize_map(m: CrushMap) -> np.ndarray:
+    """Flatten a CrushMap into the int64 blob the native parser reads."""
+    out: List[int] = [
+        m.max_devices, m.choose_local_tries, m.choose_local_fallback_tries,
+        m.choose_total_tries, m.chooseleaf_descend_once,
+        m.chooseleaf_vary_r, m.chooseleaf_stable,
+        m.max_buckets, m.max_rules,
+    ]
+    for b in m.buckets:
+        if b is None:
+            out.append(0)
+            continue
+        out += [1, b.id, b.alg, b.type, b.size]
+        out += list(b.items)
+        if b.alg == CRUSH_BUCKET_UNIFORM:
+            out.append(b.item_weight)
+        elif b.alg == CRUSH_BUCKET_LIST:
+            out += list(b.item_weights) + list(b.sum_weights)
+        elif b.alg == CRUSH_BUCKET_TREE:
+            out.append(b.num_nodes)
+            out += list(b.node_weights)
+        elif b.alg == CRUSH_BUCKET_STRAW:
+            out += list(b.item_weights) + list(b.straws)
+        elif b.alg == CRUSH_BUCKET_STRAW2:
+            out += list(b.item_weights)
+        else:
+            raise ValueError(f"bucket alg {b.alg}")
+    for r in m.rules:
+        if r is None:
+            out.append(0)
+            continue
+        out += [1, r.ruleset, r.type, r.min_size, r.max_size, len(r.steps)]
+        for s in r.steps:
+            out += [s.op, s.arg1, s.arg2]
+    return np.array(out, dtype=np.int64)
+
+
+class NativeCrushMapper:
+    """Batch CRUSH evaluation through the C++ engine."""
+
+    def __init__(self, m: CrushMap):
+        self.lib = get_lib()
+        self.blob = serialize_map(m)
+
+    def do_rule(self, ruleno: int, x: int, result_max: int,
+                weight: Sequence[int]) -> List[int]:
+        res = np.zeros(result_max, dtype=np.int64)
+        w = np.asarray(weight, dtype=np.uint32)
+        n = self.lib.crush_do_rule_c(
+            self.blob.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(self.blob), ruleno, x,
+            res.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), result_max,
+            w.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), len(w))
+        if n < 0:
+            raise RuntimeError("native map parse failed")
+        return res[:n].tolist()
+
+    def do_rule_batch(self, ruleno: int, xs: Sequence[int], result_max: int,
+                      weight: Sequence[int]):
+        """Returns (out (nx, result_max) int64 NONE-padded, lens (nx,))."""
+        xs = np.asarray(xs, dtype=np.int64)
+        out = np.zeros((len(xs), result_max), dtype=np.int64)
+        lens = np.zeros(len(xs), dtype=np.int32)
+        w = np.asarray(weight, dtype=np.uint32)
+        rc = self.lib.crush_do_rule_batch(
+            self.blob.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(self.blob), ruleno,
+            xs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(xs),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), result_max,
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            w.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), len(w))
+        if rc < 0:
+            raise RuntimeError("native map parse failed")
+        return out, lens
+
+
+# ---- gf -------------------------------------------------------------------
+
+def native_rs_encode(matrix_rows: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """rows (r, k) x data (k, n) -> (r, n) over GF(2^8), C++ path."""
+    lib = get_lib()
+    r, k = matrix_rows.shape
+    kk, n = data.shape
+    assert k == kk
+    mat = np.ascontiguousarray(matrix_rows, dtype=np.uint8)
+    dat = np.ascontiguousarray(data, dtype=np.uint8)
+    out = np.zeros((r, n), dtype=np.uint8)
+    lib.gf_rs_encode(
+        mat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), r, k,
+        dat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(n))
+    return out
+
+
+def crc32c(data: bytes, crc: int = 0xFFFFFFFF) -> int:
+    """Ceph-convention crc32c: raw castagnoli update, no pre/post inversion
+    (reference include/crc32c.h); Ceph callers seed with -1."""
+    lib = get_lib()
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(
+        data, np.ndarray) else data
+    return int(lib.ceph_crc32c(
+        ctypes.c_uint32(crc),
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(len(buf))))

@@ -80,3 +80,16 @@ def test_tpu_plugin_batch_roundtrip():
     out = tpu.decode_batch(chunks, [1, 9])
     np.testing.assert_array_equal(out[1], data[:, 1])
     np.testing.assert_array_equal(out[9], coding[:, 1])
+
+
+def test_tpu_plugin_batch_coding_only_recovery():
+    # all data chunks survive; only a coding shard is lost (the most common
+    # repair) — regression for the skipped-reencode bug
+    tpu = plugin_registry.factory("tpu", {"k": "3", "m": "2"})
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, size=(4, 3, 64), dtype=np.uint8)
+    coding = tpu.encode_batch(data)
+    chunks = {i: data[:, i] for i in range(3)}
+    chunks[4] = coding[:, 1]
+    out = tpu.decode_batch(chunks, [3])
+    np.testing.assert_array_equal(out[3], coding[:, 0])
