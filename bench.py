@@ -1,0 +1,77 @@
+"""Headline benchmark: EC encode throughput, k=8 m=4, 1 MiB objects.
+
+Mirrors the reference harness semantics (`ceph_erasure_code_benchmark -p isa
+-P k=8 -P m=4 -S 1048576 -w encode`, src/test/erasure-code/
+ceph_erasure_code_benchmark.cc:150-189): GiB/s of object data erasure-coded.
+The device path batches S objects' stripes into one (S, k, C) device call
+(the whole point — the reference encodes object-by-object on the CPU).
+
+Baseline = the native C++ 4-bit split-table region coder
+(native/gf_rs.cpp, the isa-l ec_encode_data-class host path) measured on
+this machine.  Prints ONE json line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+K, M = 8, 4
+OBJECT_SIZE = 1 << 20           # 1 MiB per object
+CHUNK = OBJECT_SIZE // K        # 128 KiB
+BATCH = 64                      # objects per device call
+TARGET_SECONDS = 3.0
+
+
+def measure_host(matrix: np.ndarray, data2d: np.ndarray) -> float:
+    """GiB/s of the native C++ path on one (k, C) object."""
+    from ceph_tpu.native import native_rs_encode, native_available
+    if not native_available():
+        return 0.0
+    rows = matrix[K:]
+    native_rs_encode(rows, data2d)  # warm tables
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < TARGET_SECONDS / 2:
+        native_rs_encode(rows, data2d)
+        n += 1
+    dt = time.perf_counter() - t0
+    return n * OBJECT_SIZE / dt / (1 << 30)
+
+
+def measure_device(matrix: np.ndarray, batch: np.ndarray) -> float:
+    """GiB/s of the jitted device path on (S, k, C) batches."""
+    import jax
+    import jax.numpy as jnp
+    from ceph_tpu.ops.gf_matmul import gf_bit_matmul
+    from ceph_tpu.gf.tables import expand_to_bitmatrix
+
+    bits = jnp.asarray(expand_to_bitmatrix(matrix[K:]).astype(np.int8))
+    dev = jax.device_put(jnp.asarray(batch))
+    gf_bit_matmul(dev, bits).block_until_ready()  # compile + warm
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < TARGET_SECONDS:
+        gf_bit_matmul(dev, bits).block_until_ready()
+        n += 1
+    dt = time.perf_counter() - t0
+    return n * BATCH * OBJECT_SIZE / dt / (1 << 30)
+
+
+def main() -> None:
+    from ceph_tpu.gf.matrices import gf_gen_rs_matrix
+    rng = np.random.default_rng(1234)
+    matrix = gf_gen_rs_matrix(K + M, K)
+    batch = rng.integers(0, 256, size=(BATCH, K, CHUNK), dtype=np.uint8)
+
+    host_gibs = measure_host(matrix, batch[0])
+    dev_gibs = measure_device(matrix, batch)
+    print(json.dumps({
+        "metric": "ec_encode_k8m4_1MiB_throughput",
+        "value": round(dev_gibs, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(dev_gibs / host_gibs, 2) if host_gibs else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Device-mesh parallelism for the TPU-native data path.
+
+Ceph's distribution axes (SURVEY.md §2.10) map onto a 2-D
+``jax.sharding.Mesh``:
+
+- ``stripe`` — the data-parallel axis: batches of EC stripes (and batches
+  of PGs in the placement kernel) shard across devices, the analog of
+  object→PG sharding / ParallelPGMapper's thread fan-out
+  (reference src/osd/OSDMapMapping.h:17).
+- ``shard`` — the model-parallel axis: the k+m output-chunk dimension of the
+  GF coding matmul shards its columns across devices, the analog of one
+  stripe's chunks fanning out to k+m OSDs (src/osd/ECBackend.cc:1942).
+
+Collectives ride ICI: encode needs none (the contraction dim is replicated);
+cluster-wide reductions (chunk checksums, placement histograms) are psums.
+"""
+from .mesh import make_mesh, mesh_shape_for
+from .ec import ShardedRS
+from .step import pipeline_step, example_pipeline_args
+
+__all__ = [
+    "make_mesh", "mesh_shape_for", "ShardedRS",
+    "pipeline_step", "example_pipeline_args",
+]

@@ -6,8 +6,21 @@ Must run before jax is imported anywhere.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Override, don't setdefault: the driver environment pre-sets JAX_PLATFORMS
+# to the real-chip tunnel, but unit tests need the virtual 8-CPU mesh.
+# Set CEPH_TPU_TEST_REAL=1 to run the suite against the real device instead.
+# Always expose 8 virtual host devices: even in real-device mode the
+# mesh-sized tests fall back to the host platform (make_mesh).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+if not os.environ.get("CEPH_TPU_TEST_REAL"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # The axon PJRT plugin (sitecustomize) already imported jax and forced
+    # jax_platforms="axon,cpu"; the config value wins over the env var, so
+    # force it back.  Backends are created lazily, so as long as no test
+    # module touched a device yet this reliably lands on the virtual mesh.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
