@@ -1,0 +1,3 @@
+from .rados import RadosClient
+
+__all__ = ["RadosClient"]

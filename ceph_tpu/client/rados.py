@@ -1,0 +1,105 @@
+"""RadosClient — librados-lite over an Objecter-style op state machine.
+
+Mirrors the client stack's shape (src/librados/IoCtxImpl.cc:642,692 →
+osdc/Objecter.cc op_submit/_calc_target): every op computes its target PG
+from the client's OSDMap copy (object_locator_to_pg → raw_pg_to_pg →
+acting primary), sends an MOSDOp to that OSD, and resends after a map
+refresh when the target was wrong or silent — the Objecter's
+recalc-on-every-epoch behavior.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional
+
+from ..msg import (
+    CEPH_OSD_OP_DELETE, CEPH_OSD_OP_READ, CEPH_OSD_OP_STAT,
+    CEPH_OSD_OP_WRITE, Dispatcher, MOSDMap, MOSDOp, MOSDOpReply, Message,
+    Network,
+)
+from ..msg.messages import new_trace_id
+from ..osdmap import OSDMap, ceph_stable_mod, pg_t
+
+MAX_ATTEMPTS = 8
+
+
+class RadosClient(Dispatcher):
+    def __init__(self, network: Network, mon, name: str = "client.0"):
+        self.network = network
+        self.mon = mon
+        self.name = name
+        self.messenger = network.create_messenger(name)
+        self.messenger.add_dispatcher_head(self)
+        self.osdmap = OSDMap()
+        self._tid = 0
+        self._replies: Dict[int, MOSDOpReply] = {}
+        mon.subscribe(name)
+        mon.send_full_map(name)
+        network.pump()
+
+    # ---- dispatch ---------------------------------------------------------
+    def ms_fast_dispatch(self, msg: Message) -> None:
+        if isinstance(msg, MOSDMap):
+            for inc in msg.incrementals:
+                if inc.epoch == self.osdmap.epoch + 1:
+                    self.osdmap.apply_incremental(inc)
+        elif isinstance(msg, MOSDOpReply):
+            self._replies[msg.tid] = msg
+
+    # ---- Objecter-lite ----------------------------------------------------
+    def _calc_target(self, pool_id: int, oid: str):
+        pool = self.osdmap.get_pg_pool(pool_id)
+        raw = self.osdmap.map_to_pg(pool_id, oid)
+        ps = ceph_stable_mod(raw.ps, pool.pg_num, pool.pg_num_mask)
+        pg = pg_t(pool_id, ps)
+        *_, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+        return (pool_id, ps), primary
+
+    def _submit(self, pool_id: int, oid: str, op: str, data: bytes = b""
+                ) -> MOSDOpReply:
+        for attempt in range(MAX_ATTEMPTS):
+            pgid, primary = self._calc_target(pool_id, oid)
+            self._tid += 1
+            tid = self._tid
+            if primary >= 0:
+                msg = MOSDOp(tid=tid, pool=pool_id, oid=oid, pgid=pgid,
+                             op=op, data=data, epoch=self.osdmap.epoch,
+                             trace_id=new_trace_id())
+                self.messenger.send_message(msg, f"osd.{primary}")
+                self.network.pump()
+            reply = self._replies.pop(tid, None)
+            if reply is not None and reply.result != -11:
+                return reply
+            # wrong/silent primary: refresh the map and retry
+            self.mon.send_full_map(self.name)
+            self.network.pump()
+        return reply if reply is not None else MOSDOpReply(tid=tid,
+                                                           result=-110)
+
+    def lookup_pool(self, name: str) -> int:
+        pid = self.osdmap.lookup_pg_pool_name(name)
+        if pid < 0:
+            raise KeyError(f"no pool {name!r}")
+        return pid
+
+    # ---- public API (librados verbs) --------------------------------------
+    def write_full(self, pool: str, oid: str, data: bytes) -> int:
+        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_WRITE,
+                         bytes(data))
+        return r.result
+
+    def read(self, pool: str, oid: str) -> bytes:
+        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_READ)
+        if r.result < 0:
+            raise IOError(f"read {oid}: {r.result}")
+        return r.data
+
+    def stat(self, pool: str, oid: str) -> int:
+        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_STAT)
+        if r.result < 0:
+            raise IOError(f"stat {oid}: {r.result}")
+        return struct.unpack("<Q", r.data)[0]
+
+    def remove(self, pool: str, oid: str) -> int:
+        return self._submit(self.lookup_pool(pool), oid,
+                            CEPH_OSD_OP_DELETE).result

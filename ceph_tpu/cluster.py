@@ -1,0 +1,130 @@
+"""vstart-lite: a single-process mini cluster.
+
+The reference's qa tiers spin real daemons on localhost (src/vstart.sh,
+qa/standalone/ceph-helpers.sh); the TPU-native equivalent is one process
+wiring mon + N OSDs + clients over the deterministic messenger fabric, with
+the Thrasher controls (qa/tasks/ceph_manager.py:195 kill_osd, :373
+revive_osd, :360 blackhole) as first-class methods.  All EC compute inside
+the OSDs runs through the device codec.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .client import RadosClient
+from .mon import Monitor
+from .msg import Network
+from .osd.osd import OSD
+
+
+class MiniCluster:
+    def __init__(self, n_osds: int = 6, osds_per_host: int = 1):
+        self.network = Network()
+        self.mon = Monitor(self.network)
+        self.mon.bootstrap(n_osds, osds_per_host)
+        self.osds: Dict[int, OSD] = {}
+        for i in range(n_osds):
+            osd = OSD(self.network, i)
+            self.osds[i] = osd
+            self.mon.subscribe(osd.name)
+        self.clock = 0.0
+
+    # ---- pools ------------------------------------------------------------
+    def create_ec_pool(self, name: str, k: int = 4, m: int = 2,
+                       pg_num: int = 32, plugin: str = "tpu",
+                       extra_profile: Optional[Dict[str, str]] = None,
+                       failure_domain: str = "host") -> int:
+        profile = {"plugin": plugin, "k": str(k), "m": str(m),
+                   "crush-failure-domain": failure_domain}
+        if extra_profile:
+            profile.update(extra_profile)
+        pname = f"{name}_profile"
+        self.mon.create_ec_profile(pname, profile)
+        pid = self.mon.create_ec_pool(name, pname, pg_num)
+        self.publish()
+        return pid
+
+    def create_replicated_pool(self, name: str, size: int = 3,
+                               pg_num: int = 32) -> int:
+        pid = self.mon.create_replicated_pool(name, size, pg_num)
+        self.publish()
+        return pid
+
+    # ---- control ----------------------------------------------------------
+    def publish(self) -> None:
+        self.mon.publish()
+        self.network.pump()
+        self.run_recovery()
+
+    def client(self, name: str = "client.0") -> RadosClient:
+        return RadosClient(self.network, self.mon, name)
+
+    def tick(self, dt: float = 1.0, rounds: int = 1) -> None:
+        """Advance time: heartbeats fire, failures get detected."""
+        for _ in range(rounds):
+            self.clock += dt
+            for i, osd in self.osds.items():
+                if osd.name not in self.network.down:
+                    osd.tick(self.clock)
+            self.network.pump()
+        self.run_recovery()
+
+    def run_recovery(self, max_rounds: int = 4) -> int:
+        total = 0
+        for _ in range(max_rounds):
+            pushed = 0
+            for osd in self.osds.values():
+                if osd.name not in self.network.down:
+                    pushed += osd.run_recovery()
+            self.network.pump()
+            total += pushed
+            if not pushed:
+                break
+        return total
+
+    # ---- thrasher API ------------------------------------------------------
+    def kill_osd(self, osd_id: int) -> None:
+        """Hard-kill: the daemon stops answering anything
+        (ceph_manager.py:195)."""
+        self.network.set_down(f"osd.{osd_id}", True)
+
+    def revive_osd(self, osd_id: int) -> None:
+        """Bring the daemon back and let it catch up on maps
+        (ceph_manager.py:373)."""
+        self.network.set_down(f"osd.{osd_id}", False)
+        osd = self.osds[osd_id]
+        self.mon.mark_osd_up(osd_id)
+        self.mon.send_full_map(osd.name)
+        self.network.pump()
+        self.run_recovery()
+
+    def blackhole_osd(self, osd_id: int, on: bool = True) -> None:
+        """Drop all traffic to the osd without killing it
+        (ceph_manager.py:360)."""
+        for name in list(self.network.endpoints):
+            self.network.blackhole(name, f"osd.{osd_id}", on)
+
+    def mark_osd_down(self, osd_id: int) -> None:
+        self.mon.mark_osd_down(osd_id)
+        self.network.pump()
+        self.run_recovery()
+
+    def mark_osd_out(self, osd_id: int) -> None:
+        self.mon.mark_osd_out(osd_id)
+        self.network.pump()
+        self.run_recovery()
+
+    # ---- introspection -----------------------------------------------------
+    def pg_states(self) -> Dict[str, str]:
+        out = {}
+        for osd in self.osds.values():
+            for pgid, pg in osd.pgs.items():
+                if pg.is_primary():
+                    out[f"{pgid[0]}.{pgid[1]:x}"] = pg.state
+        return out
+
+    def health(self) -> str:
+        n_down = sum(1 for o in range(self.mon.osdmap.max_osd)
+                     if not self.mon.osdmap.is_up(o))
+        return "HEALTH_OK" if n_down == 0 else \
+            f"HEALTH_WARN {n_down} osds down"

@@ -74,15 +74,12 @@ class ErasureCode(ErasureCodeInterface):
     def parse_mapping(self, profile: ErasureCodeProfile) -> None:
         m = profile.get("mapping")
         if m:
-            # mapping string like "DD_D...": position of each non-'_' char is
-            # the physical index of successive logical chunks
-            mapping = []
-            position = 0
-            for c in m:
-                if c != "_":
-                    mapping.append(position)
-                position += 1
-            self.chunk_mapping = mapping
+            # mapping string like "DD_D...": logical data chunks land on the
+            # 'D' positions, logical coding chunks on the remaining positions
+            # in order (reference ErasureCode.cc to_mapping)
+            data_pos = [i for i, c in enumerate(m) if c == "D"]
+            other_pos = [i for i, c in enumerate(m) if c != "D"]
+            self.chunk_mapping = data_pos + other_pos
 
     def chunk_index(self, i: int) -> int:
         return self.chunk_mapping[i] if len(self.chunk_mapping) > i else i
@@ -92,9 +89,11 @@ class ErasureCode(ErasureCodeInterface):
 
     # ---- crush rule -------------------------------------------------------
     def create_rule(self, name: str, crush) -> int:
+        from ..crush.constants import PG_POOL_TYPE_ERASURE
         ruleid = crush.add_simple_rule(
             name, self.rule_root, self.rule_failure_domain,
-            self.rule_device_class, "indep")
+            self.rule_device_class, "indep",
+            rule_type=PG_POOL_TYPE_ERASURE)
         if ruleid >= 0:
             crush.set_rule_mask_max_size(ruleid, self.get_chunk_count())
         return ruleid
@@ -169,9 +168,11 @@ class ErasureCode(ErasureCodeInterface):
             return {i: chunks[i] for i in want_to_read}
         k = self.get_data_chunk_count()
         m = self.get_coding_chunk_count()
-        if len(chunks) < k:
-            raise IOError(
-                f"not enough chunks to decode: have {len(chunks)}, need {k}")
+        if not chunks:
+            raise IOError("no chunks to decode from")
+        # insufficiency is the codec's call: layered codes (lrc) can repair
+        # from fewer than k global chunks (reference ErasureCode.cc:199-232
+        # delegates to decode_chunks)
         blocksize = len(next(iter(chunks.values())))
         decoded: Dict[int, np.ndarray] = {}
         for i in range(k + m):

@@ -1,0 +1,185 @@
+"""Monitor-lite — the cluster control plane.
+
+Single-instance stand-in for the reference's paxos-replicated OSDMonitor
+(src/mon/OSDMonitor.cc): it owns the authoritative OSDMap, stages changes
+in an Incremental, and publishes epochs to every subscriber (MOSDMap).
+Pool/EC-profile management mirrors the mon flow: a profile is stored in
+the map, the plugin is instantiated to validate it and to create the crush
+rule (OSDMonitor.cc:5335 get_erasure_code, :5298 crush_rule_create_erasure),
+and the pool's stripe_width comes from the plugin's chunk math.  Failure
+reports mark OSDs down and publish a new epoch.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+from ..crush.constants import CRUSH_BUCKET_STRAW2
+from ..ec import create_erasure_code
+from ..msg import Dispatcher, MOSDFailure, MOSDMap, Message, Network
+from ..osdmap import (
+    CEPH_OSD_IN, Incremental, OSDMap, TYPE_ERASURE, TYPE_REPLICATED,
+    pg_pool_t,
+)
+
+DEFAULT_STRIPE_UNIT = 4096  # osd_pool_erasure_code_stripe_unit
+
+
+class Monitor(Dispatcher):
+    def __init__(self, network: Network, name: str = "mon"):
+        self.network = network
+        self.name = name
+        self.messenger = network.create_messenger(name)
+        self.messenger.add_dispatcher_head(self)
+        self.osdmap = OSDMap()
+        self.osdmap.epoch = 0
+        self.incrementals: List[Incremental] = []
+        self.subscribers: List[str] = []
+        self._topology_dirty = False  # crush/pools changed since last epoch
+
+    # ---- cluster bootstrap -------------------------------------------------
+    def bootstrap(self, n_osds: int, osds_per_host: int = 1) -> None:
+        """Build the initial map: straw2 host tree, all osds up+in."""
+        m = self.osdmap
+        m.set_max_osd(n_osds)
+        cw = m.crush
+        cw.set_type_name(1, "host")
+        cw.set_type_name(10, "root")
+        hosts = []
+        n_hosts = (n_osds + osds_per_host - 1) // osds_per_host
+        for h in range(n_hosts):
+            osds = list(range(h * osds_per_host,
+                              min((h + 1) * osds_per_host, n_osds)))
+            hid = cw.add_bucket(CRUSH_BUCKET_STRAW2, 1, f"host{h}", osds,
+                                [0x10000] * len(osds), id=-(h + 2))
+            hosts.append((hid, len(osds)))
+        cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default",
+                      [h for h, _ in hosts],
+                      [0x10000 * n for _, n in hosts], id=-1)
+        for i in range(n_osds):
+            m.set_osd(i, up=True, weight=CEPH_OSD_IN)
+            cw.set_item_name(i, f"osd.{i}")
+        self._topology_dirty = True
+
+    def subscribe(self, name: str) -> None:
+        if name not in self.subscribers:
+            self.subscribers.append(name)
+
+    # ---- pools -------------------------------------------------------------
+    def create_replicated_pool(self, name: str, size: int = 3,
+                               pg_num: int = 32) -> int:
+        rno = self.osdmap.crush.get_rule_id("replicated_rule")
+        if rno < 0:
+            rno = self.osdmap.crush.add_simple_rule(
+                "replicated_rule", "default", "host", mode="firstn")
+        pool = pg_pool_t(type=TYPE_REPLICATED, size=size,
+                         min_size=max(1, size - 1), crush_rule=rno,
+                         pg_num=pg_num, pgp_num=pg_num)
+        self._topology_dirty = True
+        return self.osdmap.add_pool(name, pool)
+
+    def create_ec_profile(self, name: str, profile: Dict[str, str]) -> None:
+        # instantiating validates the profile (OSDMonitor get_erasure_code)
+        create_erasure_code(dict(profile))
+        self.osdmap.erasure_code_profiles[name] = dict(profile)
+
+    def create_ec_pool(self, name: str, profile_name: str,
+                       pg_num: int = 32) -> int:
+        profile = self.osdmap.erasure_code_profiles[profile_name]
+        ec = create_erasure_code(dict(profile))
+        rule_name = f"{name}_rule"
+        rno = ec.create_rule(rule_name, self.osdmap.crush)
+        if rno < 0:
+            raise RuntimeError(f"create_rule failed: {rno}")
+        k = ec.get_data_chunk_count()
+        stripe_unit = int(profile.get("stripe_unit", DEFAULT_STRIPE_UNIT))
+        pool = pg_pool_t(type=TYPE_ERASURE, size=ec.get_chunk_count(),
+                         min_size=k + 1, crush_rule=rno,
+                         pg_num=pg_num, pgp_num=pg_num,
+                         erasure_code_profile=profile_name,
+                         stripe_width=k * stripe_unit)
+        self._topology_dirty = True
+        return self.osdmap.add_pool(name, pool)
+
+    # ---- epoch publication -------------------------------------------------
+    def _snapshot_inc(self) -> Incremental:
+        """Full-state Incremental (crush/pools/osd states deep-copied so
+        later mon mutations can't leak into published epochs)."""
+        m = self.osdmap
+        inc = Incremental()
+        inc.crush = copy.deepcopy(m.crush)
+        inc.new_pools = copy.deepcopy(m.pools)
+        inc.new_pool_names = dict(m.pool_name)
+        inc.new_max_osd = m.max_osd
+        for o in range(m.max_osd):
+            inc.new_up[o] = m.is_up(o)
+            inc.new_weight[o] = m.osd_weight[o]
+        inc.new_erasure_code_profiles = copy.deepcopy(
+            m.erasure_code_profiles)
+        return inc
+
+    def publish(self, inc: Optional[Incremental] = None) -> None:
+        """Commit a new epoch and broadcast it (mon → MOSDMap).
+
+        Topology changes (crush/pools) publish as a full-state snapshot
+        Incremental; osd up/weight deltas publish as true diffs which the
+        mon also applies to its own map.
+        """
+        epoch = self.osdmap.epoch + 1
+        if self._topology_dirty:
+            delta = inc
+            inc = self._snapshot_inc()
+            if delta is not None:
+                inc.new_up.update(delta.new_up)
+                inc.new_weight.update(delta.new_weight)
+            self._topology_dirty = False
+            if delta is not None:
+                delta.epoch = epoch
+                self.osdmap.apply_incremental(delta)
+            else:
+                # mon map already holds the state; just bump the epoch
+                self.osdmap.epoch = epoch
+        else:
+            if inc is None:
+                inc = Incremental()
+            inc.epoch = epoch
+            self.osdmap.apply_incremental(inc)
+        inc.epoch = epoch
+        self.incrementals.append(inc)
+        for sub in self.subscribers:
+            self.messenger.send_message(
+                MOSDMap(first=inc.epoch, last=inc.epoch,
+                        incrementals=[inc]), sub)
+
+    def send_full_map(self, dst: str) -> None:
+        self.messenger.send_message(
+            MOSDMap(first=1, last=self.osdmap.epoch,
+                    incrementals=list(self.incrementals)), dst)
+
+    # ---- osd state changes -------------------------------------------------
+    def mark_osd_down(self, osd: int) -> None:
+        inc = Incremental()
+        inc.new_up[osd] = False
+        self.publish(inc)
+
+    def mark_osd_up(self, osd: int) -> None:
+        inc = Incremental()
+        inc.new_up[osd] = True
+        self.publish(inc)
+
+    def mark_osd_out(self, osd: int) -> None:
+        inc = Incremental()
+        inc.new_weight[osd] = 0
+        self.publish(inc)
+
+    def mark_osd_in(self, osd: int) -> None:
+        inc = Incremental()
+        inc.new_weight[osd] = CEPH_OSD_IN
+        self.publish(inc)
+
+    # ---- dispatch ----------------------------------------------------------
+    def ms_fast_dispatch(self, msg: Message) -> None:
+        if isinstance(msg, MOSDFailure):
+            # reference waits for enough reporters; one suffices here
+            if self.osdmap.is_up(msg.target_osd):
+                self.mark_osd_down(msg.target_osd)

@@ -1,0 +1,15 @@
+from .messages import (
+    MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
+    MOSDECSubOpWriteReply, MOSDMap, MOSDOp, MOSDOpReply, MOSDPing, Message,
+    MOSDFailure, CEPH_OSD_OP_READ, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_DELETE,
+    CEPH_OSD_OP_STAT,
+)
+from .messenger import Connection, Dispatcher, Messenger, Network
+
+__all__ = [
+    "MOSDECSubOpRead", "MOSDECSubOpReadReply", "MOSDECSubOpWrite",
+    "MOSDECSubOpWriteReply", "MOSDMap", "MOSDOp", "MOSDOpReply", "MOSDPing",
+    "Message", "MOSDFailure", "Connection", "Dispatcher", "Messenger",
+    "Network", "CEPH_OSD_OP_READ", "CEPH_OSD_OP_WRITE", "CEPH_OSD_OP_DELETE",
+    "CEPH_OSD_OP_STAT",
+]

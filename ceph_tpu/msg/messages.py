@@ -1,0 +1,131 @@
+"""Message types for the mini-cluster fabric.
+
+Named after the reference wire messages (src/messages/M*.h) so the data
+path reads the same: client ops (MOSDOp/MOSDOpReply), EC shard sub-ops
+(MOSDECSubOpWrite/..., src/osd/ECMsgTypes.h payloads), heartbeats
+(MOSDPing), failure reports, and map publication (MOSDMap).  Every message
+carries the op's trace id end to end (the ZTracer::Trace slot on
+msg/Message.h:254).
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+_trace_counter = itertools.count(1)
+
+
+def new_trace_id() -> int:
+    return next(_trace_counter)
+
+
+@dataclass
+class Message:
+    src: str = ""
+    trace_id: int = 0
+
+    def name(self) -> str:
+        return type(self).__name__
+
+
+# client op codes (subset of the do_osd_ops interpreter's)
+CEPH_OSD_OP_READ = "read"
+CEPH_OSD_OP_WRITE = "write"          # write-full for the EC pool path
+CEPH_OSD_OP_DELETE = "delete"
+CEPH_OSD_OP_STAT = "stat"
+
+
+@dataclass
+class MOSDOp(Message):
+    """Client -> primary OSD op (src/messages/MOSDOp.h)."""
+    tid: int = 0
+    pool: int = 0
+    oid: str = ""
+    pgid: Tuple[int, int] = (0, 0)      # (pool, ps)
+    op: str = CEPH_OSD_OP_READ
+    offset: int = 0
+    length: int = 0
+    data: bytes = b""
+    epoch: int = 0
+
+
+@dataclass
+class MOSDOpReply(Message):
+    tid: int = 0
+    result: int = 0
+    data: bytes = b""
+    epoch: int = 0
+
+
+@dataclass
+class MOSDECSubOpWrite(Message):
+    """Primary -> shard EC write (src/messages/MOSDECSubOpWrite.h,
+    payload ECSubWrite in osd/ECMsgTypes.h)."""
+    tid: int = 0
+    pgid: Tuple[int, int] = (0, 0)
+    shard: int = 0
+    oid: str = ""
+    chunk: bytes = b""
+    offset: int = 0
+    hash_epoch: int = 0
+    at_version: int = 0
+    trim_to: int = 0
+
+
+@dataclass
+class MOSDECSubOpWriteReply(Message):
+    tid: int = 0
+    pgid: Tuple[int, int] = (0, 0)
+    shard: int = 0
+    committed: bool = True
+
+
+@dataclass
+class MOSDECSubOpRead(Message):
+    """Primary -> shard EC read (ECSubRead payload)."""
+    tid: int = 0
+    pgid: Tuple[int, int] = (0, 0)
+    shard: int = 0
+    oid: str = ""
+    offset: int = 0
+    length: int = 0
+    subchunks: List[Tuple[int, int]] = field(default_factory=list)
+
+
+@dataclass
+class MOSDECSubOpReadReply(Message):
+    tid: int = 0
+    pgid: Tuple[int, int] = (0, 0)
+    shard: int = 0
+    oid: str = ""
+    data: bytes = b""
+    result: int = 0
+    attrs: Dict[str, bytes] = field(default_factory=dict)
+
+
+@dataclass
+class MOSDPing(Message):
+    """OSD<->OSD heartbeat (src/messages/MOSDPing.h)."""
+    PING = "ping"
+    PING_REPLY = "ping_reply"
+    op: str = PING
+    stamp: float = 0.0
+    epoch: int = 0
+
+
+@dataclass
+class MOSDFailure(Message):
+    """OSD -> mon failure report (src/messages/MOSDFailure.h)."""
+    target_osd: int = -1
+    failed_since: float = 0.0
+    epoch: int = 0
+
+
+@dataclass
+class MOSDMap(Message):
+    """Mon -> everyone map publication (src/messages/MOSDMap.h); carries
+    incrementals from ``first`` to ``last``."""
+    first: int = 0
+    last: int = 0
+    incrementals: List[Any] = field(default_factory=list)

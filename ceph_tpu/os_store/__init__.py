@@ -1,0 +1,3 @@
+from .memstore import MemStore, Transaction, hobject_t
+
+__all__ = ["MemStore", "Transaction", "hobject_t"]

@@ -1,0 +1,225 @@
+"""MemStore — transactional in-memory ObjectStore.
+
+Mirrors the reference's test backend (src/os/memstore/MemStore.{h,cc}) and
+the ObjectStore transaction model (src/os/ObjectStore.h): collections (one
+per PG shard) hold objects with byte data, xattrs and omap; mutations are
+queued as Transactions whose ops apply atomically and in order.  BlueStore's
+block/WAL machinery is host-I/O out of scope for a TPU build (SURVEY.md
+§2.9) — this is the durability stand-in that keeps the OSD data path
+honest: every shard write and recovery push lands here through the same
+Transaction ABI the reference uses.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True, order=True)
+class hobject_t:
+    """Object identity inside a collection (simplified hobject)."""
+    oid: str
+    shard: int = -1  # EC shard id, -1 = whole/replicated
+
+    def __str__(self):
+        return f"{self.oid}" if self.shard < 0 else f"{self.oid}({self.shard})"
+
+
+class _Object:
+    __slots__ = ("data", "attrs", "omap")
+
+    def __init__(self):
+        self.data = bytearray()
+        self.attrs: Dict[str, bytes] = {}
+        self.omap: Dict[str, bytes] = {}
+
+
+# transaction op codes (subset of ObjectStore::Transaction ops)
+OP_TOUCH = "touch"
+OP_WRITE = "write"
+OP_ZERO = "zero"
+OP_TRUNCATE = "truncate"
+OP_REMOVE = "remove"
+OP_SETATTR = "setattr"
+OP_RMATTR = "rmattr"
+OP_OMAP_SETKEYS = "omap_setkeys"
+OP_OMAP_RMKEYS = "omap_rmkeys"
+OP_MKCOLL = "mkcoll"
+OP_RMCOLL = "rmcoll"
+
+
+class Transaction:
+    """Ordered batch of mutations applied atomically
+    (os/ObjectStore.h Transaction)."""
+
+    def __init__(self):
+        self.ops: List[Tuple] = []
+
+    def touch(self, cid: str, oid: hobject_t):
+        self.ops.append((OP_TOUCH, cid, oid))
+
+    def write(self, cid: str, oid: hobject_t, offset: int, data):
+        self.ops.append((OP_WRITE, cid, oid, offset, bytes(data)))
+
+    def zero(self, cid: str, oid: hobject_t, offset: int, length: int):
+        self.ops.append((OP_ZERO, cid, oid, offset, length))
+
+    def truncate(self, cid: str, oid: hobject_t, size: int):
+        self.ops.append((OP_TRUNCATE, cid, oid, size))
+
+    def remove(self, cid: str, oid: hobject_t):
+        self.ops.append((OP_REMOVE, cid, oid))
+
+    def setattr(self, cid: str, oid: hobject_t, name: str, value: bytes):
+        self.ops.append((OP_SETATTR, cid, oid, name, bytes(value)))
+
+    def rmattr(self, cid: str, oid: hobject_t, name: str):
+        self.ops.append((OP_RMATTR, cid, oid, name))
+
+    def omap_setkeys(self, cid: str, oid: hobject_t,
+                     keys: Dict[str, bytes]):
+        self.ops.append((OP_OMAP_SETKEYS, cid, oid, dict(keys)))
+
+    def omap_rmkeys(self, cid: str, oid: hobject_t, keys: List[str]):
+        self.ops.append((OP_OMAP_RMKEYS, cid, oid, list(keys)))
+
+    def create_collection(self, cid: str):
+        self.ops.append((OP_MKCOLL, cid))
+
+    def remove_collection(self, cid: str):
+        self.ops.append((OP_RMCOLL, cid))
+
+    def append(self, other: "Transaction"):
+        self.ops.extend(other.ops)
+
+    def empty(self) -> bool:
+        return not self.ops
+
+
+class MemStore:
+    def __init__(self):
+        self.colls: Dict[str, Dict[hobject_t, _Object]] = {}
+        self.committed_txns = 0
+
+    # ---- lifecycle --------------------------------------------------------
+    def mount(self) -> None:
+        pass
+
+    def umount(self) -> None:
+        pass
+
+    # ---- transactions -----------------------------------------------------
+    def queue_transaction(self, t: Transaction) -> None:
+        """Apply atomically; invalid ops raise before any mutation."""
+        staged = {cid: {o: self._clone(obj) for o, obj in coll.items()}
+                  for cid, coll in self.colls.items()}
+        try:
+            self._apply(staged, t)
+        except Exception:
+            raise
+        self.colls = staged
+        self.committed_txns += 1
+
+    @staticmethod
+    def _clone(obj: _Object) -> _Object:
+        c = _Object()
+        c.data = bytearray(obj.data)
+        c.attrs = dict(obj.attrs)
+        c.omap = dict(obj.omap)
+        return c
+
+    def _apply(self, colls, t: Transaction) -> None:
+        def coll(cid):
+            if cid not in colls:
+                raise KeyError(f"no collection {cid}")
+            return colls[cid]
+
+        def obj(cid, oid, create=False):
+            c = coll(cid)
+            if oid not in c:
+                if not create:
+                    raise KeyError(f"no object {oid} in {cid}")
+                c[oid] = _Object()
+            return c[oid]
+
+        for op in t.ops:
+            code = op[0]
+            if code == OP_MKCOLL:
+                colls.setdefault(op[1], {})
+            elif code == OP_RMCOLL:
+                colls.pop(op[1], None)
+            elif code == OP_TOUCH:
+                obj(op[1], op[2], create=True)
+            elif code == OP_WRITE:
+                _, cid, oid, offset, data = op
+                o = obj(cid, oid, create=True)
+                end = offset + len(data)
+                if len(o.data) < end:
+                    o.data.extend(b"\0" * (end - len(o.data)))
+                o.data[offset:end] = data
+            elif code == OP_ZERO:
+                _, cid, oid, offset, length = op
+                o = obj(cid, oid, create=True)
+                end = offset + length
+                if len(o.data) < end:
+                    o.data.extend(b"\0" * (end - len(o.data)))
+                o.data[offset:end] = b"\0" * length
+            elif code == OP_TRUNCATE:
+                _, cid, oid, size = op
+                o = obj(cid, oid, create=True)
+                if len(o.data) > size:
+                    del o.data[size:]
+                else:
+                    o.data.extend(b"\0" * (size - len(o.data)))
+            elif code == OP_REMOVE:
+                coll(op[1]).pop(op[2], None)
+            elif code == OP_SETATTR:
+                _, cid, oid, name, value = op
+                obj(cid, oid, create=True).attrs[name] = value
+            elif code == OP_RMATTR:
+                _, cid, oid, name = op
+                obj(cid, oid).attrs.pop(name, None)
+            elif code == OP_OMAP_SETKEYS:
+                _, cid, oid, keys = op
+                obj(cid, oid, create=True).omap.update(keys)
+            elif code == OP_OMAP_RMKEYS:
+                _, cid, oid, keys = op
+                o = obj(cid, oid)
+                for k in keys:
+                    o.omap.pop(k, None)
+            else:
+                raise ValueError(f"unknown op {code}")
+
+    # ---- reads ------------------------------------------------------------
+    def collection_exists(self, cid: str) -> bool:
+        return cid in self.colls
+
+    def list_collections(self) -> List[str]:
+        return sorted(self.colls)
+
+    def exists(self, cid: str, oid: hobject_t) -> bool:
+        return oid in self.colls.get(cid, {})
+
+    def read(self, cid: str, oid: hobject_t, offset: int = 0,
+             length: int = 0) -> bytes:
+        o = self.colls[cid][oid]
+        if length == 0:
+            length = len(o.data) - offset
+        return bytes(o.data[offset:offset + length])
+
+    def stat(self, cid: str, oid: hobject_t) -> int:
+        return len(self.colls[cid][oid].data)
+
+    def getattr(self, cid: str, oid: hobject_t, name: str) -> bytes:
+        return self.colls[cid][oid].attrs[name]
+
+    def getattrs(self, cid: str, oid: hobject_t) -> Dict[str, bytes]:
+        return dict(self.colls[cid][oid].attrs)
+
+    def omap_get(self, cid: str, oid: hobject_t) -> Dict[str, bytes]:
+        return dict(self.colls[cid][oid].omap)
+
+    def list_objects(self, cid: str) -> List[hobject_t]:
+        return sorted(self.colls.get(cid, {}))

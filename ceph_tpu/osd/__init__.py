@@ -1,0 +1,5 @@
+from .ecutil import HashInfo, stripe_info_t, encode as ecutil_encode, \
+    decode as ecutil_decode, decode_concat as ecutil_decode_concat
+
+__all__ = ["HashInfo", "stripe_info_t", "ecutil_encode", "ecutil_decode",
+           "ecutil_decode_concat"]

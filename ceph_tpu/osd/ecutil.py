@@ -1,0 +1,203 @@
+"""ECUtil — stripe bookkeeping between the object store and the EC codec.
+
+The reference loops stripes one at a time through the plugin
+(src/osd/ECUtil.cc:120-159 encode, :9-45 decode) because its codecs are
+CPU-SIMD calls.  Here the whole multi-stripe payload is reshaped into one
+(S, k, C) uint8 tensor and handed to the codec's batched device entry
+points when it has them (ErasureCodeTpu.encode_batch), falling back to the
+reference's per-stripe loop for host-only codecs — results are identical
+either way, per-shard buffers are the stripe-concatenated chunks.
+
+HashInfo mirrors osd/ECUtil.cc:161-207: cumulative per-shard crc32c seeded
+with -1, appended as shards grow.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from ..utils.crc32c import crc32c
+
+CHUNK_ALIGNMENT = 64
+CHUNK_INFO = 8
+CHUNK_PADDING = 8
+CHUNK_OVERHEAD = 16
+
+
+class stripe_info_t:
+    """(stripe_size=k, stripe_width=k*chunk_size) (ECUtil.h:31-76)."""
+
+    def __init__(self, stripe_size: int, stripe_width: int):
+        assert stripe_width % stripe_size == 0
+        self.stripe_width = stripe_width
+        self.chunk_size = stripe_width // stripe_size
+
+    def logical_offset_is_stripe_aligned(self, logical: int) -> bool:
+        return logical % self.stripe_width == 0
+
+    def get_stripe_width(self) -> int:
+        return self.stripe_width
+
+    def get_chunk_size(self) -> int:
+        return self.chunk_size
+
+    def logical_to_prev_chunk_offset(self, offset: int) -> int:
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def logical_to_next_chunk_offset(self, offset: int) -> int:
+        return ((offset + self.stripe_width - 1)
+                // self.stripe_width) * self.chunk_size
+
+    def logical_to_prev_stripe_offset(self, offset: int) -> int:
+        return offset - (offset % self.stripe_width)
+
+    def logical_to_next_stripe_offset(self, offset: int) -> int:
+        rem = offset % self.stripe_width
+        return offset if not rem else offset - rem + self.stripe_width
+
+    def aligned_logical_offset_to_chunk_offset(self, offset: int) -> int:
+        assert offset % self.stripe_width == 0
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def aligned_chunk_offset_to_logical_offset(self, offset: int) -> int:
+        assert offset % self.chunk_size == 0
+        return (offset // self.chunk_size) * self.stripe_width
+
+    def offset_len_to_stripe_bounds(self, offset: int, length: int):
+        start = self.logical_to_prev_stripe_offset(offset)
+        end = self.logical_to_next_stripe_offset(offset + length)
+        return start, end - start
+
+
+def encode(sinfo: stripe_info_t, ec_impl, data,
+           want: Set[int]) -> Dict[int, np.ndarray]:
+    """Erasure-code a stripe-aligned payload; returns shard id -> buffer.
+
+    Batched: all S stripes go through the codec in one call when it
+    provides encode_batch (the device path); otherwise the reference's
+    stripe loop runs (identical output).
+    """
+    buf = np.frombuffer(bytes(data), dtype=np.uint8) \
+        if not isinstance(data, np.ndarray) else data
+    logical_size = len(buf)
+    assert logical_size % sinfo.get_stripe_width() == 0
+    if logical_size == 0:
+        return {}
+    S = logical_size // sinfo.get_stripe_width()
+    k = ec_impl.get_data_chunk_count()
+    n = ec_impl.get_chunk_count()
+    C = sinfo.get_chunk_size()
+
+    if hasattr(ec_impl, "encode_batch") and not ec_impl.get_chunk_mapping():
+        stripes = buf.reshape(S, k, C)
+        coding = ec_impl.encode_batch(stripes)        # (S, m, C)
+        out: Dict[int, np.ndarray] = {}
+        for i in want:
+            if i < k:
+                out[i] = np.ascontiguousarray(stripes[:, i, :]).reshape(-1)
+            else:
+                out[i] = np.ascontiguousarray(
+                    coding[:, i - k, :]).reshape(-1)
+        return out
+
+    out_parts: Dict[int, List[np.ndarray]] = {i: [] for i in want}
+    w = sinfo.get_stripe_width()
+    for s in range(S):
+        encoded = ec_impl.encode(want, buf[s * w:(s + 1) * w])
+        for i, chunk in encoded.items():
+            assert len(chunk) == C
+            out_parts[i].append(chunk)
+    return {i: np.concatenate(parts) for i, parts in out_parts.items()}
+
+
+def decode_concat(sinfo: stripe_info_t, ec_impl,
+                  to_decode: Dict[int, np.ndarray]) -> np.ndarray:
+    """Rebuild the full logical payload from whole-object shards
+    (ECUtil.cc:9-45)."""
+    assert to_decode
+    total = len(next(iter(to_decode.values())))
+    C = sinfo.get_chunk_size()
+    assert total % C == 0
+    for b in to_decode.values():
+        assert len(b) == total
+    if total == 0:
+        return np.zeros(0, dtype=np.uint8)
+    S = total // C
+    k = ec_impl.get_data_chunk_count()
+    chunks2d = {i: np.asarray(b, dtype=np.uint8).reshape(S, C)
+                for i, b in to_decode.items()}
+    want = list(range(k))
+    if hasattr(ec_impl, "decode_batch"):
+        got = ec_impl.decode_batch(chunks2d, want)
+        data = np.stack([got[i] for i in range(k)], axis=1)  # (S, k, C)
+        return data.reshape(-1)
+    outs = []
+    for s in range(S):
+        chunks = {i: b[s] for i, b in chunks2d.items()}
+        outs.append(np.frombuffer(
+            ec_impl.decode_concat(chunks), dtype=np.uint8))
+    return np.concatenate(outs)
+
+
+def decode(sinfo: stripe_info_t, ec_impl,
+           to_decode: Dict[int, np.ndarray],
+           need: Sequence[int]) -> Dict[int, np.ndarray]:
+    """Reconstruct specific shards across all stripes (ECUtil.cc:47-118),
+    e.g. recovery of a failed OSD's chunk for a whole object."""
+    assert to_decode
+    total = len(next(iter(to_decode.values())))
+    C = sinfo.get_chunk_size()
+    if total == 0:
+        return {i: np.zeros(0, dtype=np.uint8) for i in need}
+    S = total // C
+    chunks2d = {i: np.asarray(b, dtype=np.uint8).reshape(S, C)
+                for i, b in to_decode.items()}
+    if hasattr(ec_impl, "decode_batch"):
+        got = ec_impl.decode_batch(chunks2d, list(need))
+        return {i: np.ascontiguousarray(got[i]).reshape(-1) for i in need}
+    out_parts: Dict[int, List[np.ndarray]] = {i: [] for i in need}
+    for s in range(S):
+        chunks = {i: b[s] for i, b in chunks2d.items()}
+        decoded = ec_impl.decode(set(need), chunks)
+        for i in need:
+            out_parts[i].append(decoded[i])
+    return {i: np.concatenate(parts) for i, parts in out_parts.items()}
+
+
+class HashInfo:
+    """Cumulative per-shard crc32c (ECUtil.cc:161-207)."""
+
+    def __init__(self, num_chunks: int = 0):
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes = [0xFFFFFFFF] * num_chunks
+        self.projected_total_chunk_size = 0
+
+    def has_chunk_hash(self) -> bool:
+        return bool(self.cumulative_shard_hashes)
+
+    def append(self, old_size: int,
+               to_append: Dict[int, np.ndarray]) -> None:
+        assert old_size == self.total_chunk_size
+        size = len(next(iter(to_append.values())))
+        if self.has_chunk_hash():
+            assert len(to_append) == len(self.cumulative_shard_hashes)
+            for i, buf in to_append.items():
+                assert len(buf) == size
+                self.cumulative_shard_hashes[i] = crc32c(
+                    buf, self.cumulative_shard_hashes[i])
+        self.total_chunk_size += size
+
+    def get_chunk_hash(self, shard: int) -> int:
+        return self.cumulative_shard_hashes[shard]
+
+    def get_total_chunk_size(self) -> int:
+        return self.total_chunk_size
+
+    def dump(self) -> dict:
+        return {
+            "total_chunk_size": self.total_chunk_size,
+            "cumulative_shard_hashes": [
+                {"shard": i, "hash": h}
+                for i, h in enumerate(self.cumulative_shard_hashes)],
+        }

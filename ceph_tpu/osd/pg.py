@@ -1,0 +1,213 @@
+"""PG — placement group with a peering-lite state machine.
+
+The reference drives each PG through a boost::statechart RecoveryMachine
+(src/osd/PG.h:1879: Initial/Peering/Active/...); here the same lifecycle is
+a small explicit state machine: on every map epoch the PG recomputes
+up/acting (AdvMap), re-peers when membership changed, and schedules
+shard recovery for acting members that lack data (the ECBackend recovery
+flow, src/osd/ECBackend.cc:535-743).  Ops only execute in the Active state
+on the primary (PrimaryLogPG::do_op gating).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..crush.constants import CRUSH_ITEM_NONE
+from ..msg import (
+    CEPH_OSD_OP_DELETE, CEPH_OSD_OP_READ, CEPH_OSD_OP_STAT,
+    CEPH_OSD_OP_WRITE, MOSDOp, MOSDOpReply, Message,
+)
+from ..os_store import Transaction, hobject_t
+from .ec_backend import ECBackend, SIZE_ATTR
+
+STATE_INITIAL = "initial"
+STATE_PEERING = "peering"
+STATE_ACTIVE = "active"
+STATE_ACTIVE_RECOVERING = "active+recovering"
+
+
+class ReplicatedBackend:
+    """Full-copy backend for replicated pools (osd/ReplicatedBackend —
+    replication is host-side fan-out, not device compute)."""
+
+    def __init__(self, pg):
+        self.pg = pg
+
+    def cid(self) -> str:
+        return f"{self.pg.pgid[0]}.{self.pg.pgid[1]}"
+
+    def write(self, oid: str, data: bytes) -> None:
+        from ..msg.messages import MOSDECSubOpWrite
+        for osd in self.pg.acting:
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            msg = MOSDECSubOpWrite(tid=0, pgid=self.pg.pgid, shard=-1,
+                                   oid=oid, chunk=data,
+                                   at_version=len(data))
+            self.pg.send_to_osd(osd, msg)
+
+    def apply_write(self, msg, store) -> None:
+        cid = self.cid()
+        t = Transaction()
+        if not store.collection_exists(cid):
+            t.create_collection(cid)
+        ho = hobject_t(msg.oid)
+        t.truncate(cid, ho, 0)
+        t.write(cid, ho, 0, msg.chunk)
+        t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", msg.at_version))
+        store.queue_transaction(t)
+
+    def read(self, oid: str) -> Optional[bytes]:
+        store = self.pg.osd.store
+        cid = self.cid()
+        ho = hobject_t(oid)
+        if not store.collection_exists(cid) or not store.exists(cid, ho):
+            return None
+        return store.read(cid, ho)
+
+
+class PG:
+    def __init__(self, osd, pgid: Tuple[int, int], pool):
+        self.osd = osd
+        self.pgid = pgid
+        self.pool = pool
+        self.up: List[int] = []
+        self.acting: List[int] = []
+        self.up_primary = -1
+        self.acting_primary = -1
+        self.state = STATE_INITIAL
+        self.last_epoch_started = 0
+        self.backend: Optional[ECBackend] = None
+        self.rep_backend: Optional[ReplicatedBackend] = None
+        if pool.is_erasure():
+            ec_impl = osd.get_ec_impl(pool)
+            self.backend = ECBackend(self, ec_impl, pool.stripe_width)
+        else:
+            self.rep_backend = ReplicatedBackend(self)
+
+    # ---- topology ---------------------------------------------------------
+    def is_primary(self) -> bool:
+        return self.acting_primary == self.osd.osd_id
+
+    def my_shard(self) -> int:
+        for i, o in enumerate(self.acting):
+            if o == self.osd.osd_id:
+                return i
+        return -1
+
+    def acting_shards(self) -> Dict[int, int]:
+        """shard index -> osd id, skipping NONE holes."""
+        return {i: o for i, o in enumerate(self.acting)
+                if o != CRUSH_ITEM_NONE}
+
+    def send_to_osd(self, osd_id: int, msg: Message) -> None:
+        self.osd.messenger.send_message(msg, f"osd.{osd_id}")
+
+    # ---- peering-lite (AdvMap/ActMap events) ------------------------------
+    def advance_map(self, osdmap) -> None:
+        from ..osdmap import pg_t
+        up, upp, acting, actp = osdmap.pg_to_up_acting_osds(
+            pg_t(self.pgid[0], self.pgid[1]))
+        changed = (acting != self.acting or actp != self.acting_primary)
+        self.up, self.up_primary = up, upp
+        self.acting, self.acting_primary = acting, actp
+        if changed or self.state == STATE_INITIAL:
+            self.state = STATE_PEERING
+            # peering-lite: membership is authoritative from the map; data
+            # completeness is restored by recovery below
+            self.last_epoch_started = osdmap.epoch
+            if self.is_primary():
+                self.state = STATE_ACTIVE
+                self.osd.request_recovery(self)
+            else:
+                self.state = STATE_ACTIVE
+
+    # ---- op execution (PrimaryLogPG::do_op analog) ------------------------
+    def do_op(self, msg: MOSDOp) -> None:
+        if not self.is_primary() or self.state not in (
+                STATE_ACTIVE, STATE_ACTIVE_RECOVERING):
+            self.osd.reply_to(msg, MOSDOpReply(
+                tid=msg.tid, result=-11,  # EAGAIN: wrong primary / not ready
+                epoch=self.osd.osdmap.epoch))
+            return
+        if msg.op == CEPH_OSD_OP_WRITE:
+            self._do_write(msg)
+        elif msg.op == CEPH_OSD_OP_READ:
+            self._do_read(msg)
+        elif msg.op == CEPH_OSD_OP_STAT:
+            self._do_stat(msg)
+        elif msg.op == CEPH_OSD_OP_DELETE:
+            self._do_delete(msg)
+        else:
+            self.osd.reply_to(msg, MOSDOpReply(tid=msg.tid, result=-95))
+
+    def _do_write(self, msg: MOSDOp) -> None:
+        if self.backend is not None:
+            src = msg.src
+
+            def on_commit(result: int) -> None:
+                self.osd.messenger.send_message(
+                    MOSDOpReply(tid=msg.tid, result=result,
+                                epoch=self.osd.osdmap.epoch), src)
+
+            self.backend.submit_transaction(msg.oid, msg.data, on_commit)
+        else:
+            self.rep_backend.write(msg.oid, msg.data)
+            self.osd.reply_to(msg, MOSDOpReply(
+                tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))
+
+    def _do_read(self, msg: MOSDOp) -> None:
+        if self.backend is not None:
+            src = msg.src
+
+            def on_complete(result: int, data: bytes) -> None:
+                self.osd.messenger.send_message(
+                    MOSDOpReply(tid=msg.tid, result=result, data=data,
+                                epoch=self.osd.osdmap.epoch), src)
+
+            self.backend.objects_read_and_reconstruct(msg.oid, on_complete)
+        else:
+            data = self.rep_backend.read(msg.oid)
+            if data is None:
+                self.osd.reply_to(msg, MOSDOpReply(tid=msg.tid, result=-2))
+            else:
+                self.osd.reply_to(msg, MOSDOpReply(
+                    tid=msg.tid, result=0, data=data,
+                    epoch=self.osd.osdmap.epoch))
+
+    def _do_stat(self, msg: MOSDOp) -> None:
+        store = self.osd.store
+        if self.backend is not None:
+            shard = self.my_shard()
+            cid = self.backend.shard_cid(shard)
+            ho = hobject_t(msg.oid, shard)
+        else:
+            cid = self.rep_backend.cid()
+            ho = hobject_t(msg.oid)
+        if not store.collection_exists(cid) or not store.exists(cid, ho):
+            self.osd.reply_to(msg, MOSDOpReply(tid=msg.tid, result=-2))
+            return
+        size = struct.unpack("<Q", store.getattr(cid, ho, SIZE_ATTR))[0]
+        self.osd.reply_to(msg, MOSDOpReply(
+            tid=msg.tid, result=0, data=struct.pack("<Q", size),
+            epoch=self.osd.osdmap.epoch))
+
+    def _do_delete(self, msg: MOSDOp) -> None:
+        from ..msg.messages import MOSDECSubOpWrite
+        if self.backend is not None:
+            for shard, osd in self.acting_shards().items():
+                m = MOSDECSubOpWrite(tid=-msg.tid, pgid=self.pgid,
+                                     shard=shard, oid=msg.oid, chunk=b"",
+                                     at_version=-1)
+                self.send_to_osd(osd, m)
+        else:
+            for osd in self.acting:
+                if osd == CRUSH_ITEM_NONE:
+                    continue
+                m = MOSDECSubOpWrite(tid=-msg.tid, pgid=self.pgid,
+                                     shard=-1, oid=msg.oid, chunk=b"",
+                                     at_version=-1)
+                self.send_to_osd(osd, m)
+        self.osd.reply_to(msg, MOSDOpReply(
+            tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))

@@ -9,6 +9,7 @@ host-inverted survivor matrix.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Sequence, Tuple
 
 import numpy as np
@@ -38,14 +39,25 @@ class ShardedRS:
         self.data_sharding = NamedSharding(mesh, P(STRIPE_AXIS, None, None))
         # bit matrix (k*8, m*8): shard output columns over the shard axis
         self.mat_sharding = NamedSharding(mesh, P(None, SHARD_AXIS))
-        self.out_sharding = NamedSharding(mesh, P(STRIPE_AXIS, None, None))
+        # output (S, m, C): keep the chunk dim on the shard axis when it
+        # divides evenly — the matmul's column sharding then lands in place
+        # with zero collectives; otherwise replicate (forces a gather)
+        shard_size = mesh.shape[SHARD_AXIS]
+        out_chunk_axis = SHARD_AXIS if self.m % shard_size == 0 else None
+        self.out_sharding = NamedSharding(
+            mesh, P(STRIPE_AXIS, out_chunk_axis, None))
         self._enc_bits = jax.device_put(
             self.backend._enc_bits, self.mat_sharding)
-        # one wrapper serves encode and decode: jit caches per shape
         self._matmul_jit = jax.jit(
             gf_bit_matmul, out_shardings=self.out_sharding)
-        # sharded decode bit-matrices keyed like the backend's host cache
-        self._dev_decode_bits: dict = {}
+        # decode output width is len(want_rows), not m: replicate it
+        self._decode_jit = jax.jit(
+            gf_bit_matmul,
+            out_shardings=NamedSharding(mesh, P(STRIPE_AXIS, None, None)))
+        # sharded decode bit-matrices: bounded LRU mirroring the backend's
+        # host-side cache so device memory cannot grow without bound
+        self._dev_decode_bits: OrderedDict = OrderedDict()
+        self._dev_decode_cap = 2516
 
     # -- encode -------------------------------------------------------------
     def encode_device(self, data: jnp.ndarray) -> jnp.ndarray:
@@ -63,14 +75,17 @@ class ShardedRS:
         key = (tuple(srcs), tuple(want_rows))
         hit = self._dev_decode_bits.get(key)
         if hit is not None:
+            self._dev_decode_bits.move_to_end(key)
             return hit
         bits = self.backend._decode_bits_for(*key)
         out = jax.device_put(bits, NamedSharding(self.mesh, P(None, None)))
         self._dev_decode_bits[key] = out
+        if len(self._dev_decode_bits) > self._dev_decode_cap:
+            self._dev_decode_bits.popitem(last=False)
         return out
 
     def decode_data(self, survivors: np.ndarray, srcs: Sequence[int],
                     want_rows: Sequence[int]) -> np.ndarray:
         bits = self.decode_bits(tuple(srcs), tuple(want_rows))
         sv = jax.device_put(jnp.asarray(survivors), self.data_sharding)
-        return np.asarray(self._matmul_jit(sv, bits))
+        return np.asarray(self._decode_jit(sv, bits))

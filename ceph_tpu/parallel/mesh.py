@@ -36,11 +36,21 @@ def make_mesh(n_devices: Optional[int] = None,
         if n_devices is not None and len(devices) < n_devices:
             # single real chip but a bigger mesh requested: the virtual host
             # platform carries --xla_force_host_platform_device_count devices
-            devices = jax.devices("cpu")
+            cpus = jax.devices("cpu")
+            if len(cpus) < n_devices:
+                try:
+                    # works when the cpu backend is not initialized yet
+                    jax.config.update("jax_num_cpu_devices", n_devices)
+                    cpus = jax.devices("cpu")
+                except Exception:
+                    pass
+            devices = cpus
     if n_devices is not None:
         if len(devices) < n_devices:
             raise ValueError(
-                f"need {n_devices} devices, have {len(devices)}")
+                f"need {n_devices} devices, have {len(devices)}; for a "
+                "virtual mesh start the process with XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={n_devices}")
         devices = devices[:n_devices]
     dp, tp = mesh_shape_for(len(devices), max_shard)
     arr = np.array(devices).reshape(dp, tp)

@@ -1,0 +1,9 @@
+from .str_hash import (
+    CEPH_STR_HASH_LINUX, CEPH_STR_HASH_RJENKINS, ceph_str_hash,
+    ceph_str_hash_linux, ceph_str_hash_rjenkins,
+)
+
+__all__ = [
+    "CEPH_STR_HASH_LINUX", "CEPH_STR_HASH_RJENKINS", "ceph_str_hash",
+    "ceph_str_hash_linux", "ceph_str_hash_rjenkins",
+]

@@ -1,0 +1,130 @@
+"""Candidate-table fast mapper: exact parity with the host interpreter.
+
+The fast path materializes a bounded number of retries on the device and
+hands unresolved lanes to the host, so its *combined* output must equal
+crush_do_rule bit for bit on every x — including heavily reweighted maps
+that force many retries.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import CRUSH_ITEM_NONE
+from ceph_tpu.crush.types import Rule, RuleStep
+from ceph_tpu.crush.constants import (
+    CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSE_INDEP, CRUSH_RULE_EMIT,
+    CRUSH_RULE_TAKE, PG_POOL_TYPE_ERASURE,
+)
+from ceph_tpu.ops.crush_fast import UnsupportedRule, compile_fast_rule
+
+from test_crush_device import build_map
+
+N_X = 600
+
+
+def assert_fast_parity(cw, rno, result_max, weight, n_x=N_X):
+    fr = compile_fast_rule(cw.crush, rno, result_max)
+    res, cnt = fr.map_batch(np.arange(n_x, dtype=np.uint32), weight)
+    for x in range(n_x):
+        expect = cw.do_rule(rno, x, result_max, weight)
+        got = list(res[x, :cnt[x]])
+        assert got == expect, (x, got, expect, fr.residual_fraction)
+    return fr
+
+
+def test_fast_chooseleaf_firstn():
+    cw, n = build_map(n_hosts=8, osds_per_host=4, uneven=True)
+    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    fr = assert_fast_parity(cw, rno, 3, [0x10000] * n)
+    assert fr.residual_fraction < 0.05
+
+
+def test_fast_firstn_heavy_reweight_forces_residuals():
+    cw, n = build_map(n_hosts=5, osds_per_host=3)
+    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    rng = np.random.default_rng(0)
+    weight = [int(w) for w in rng.choice([0, 0x2000, 0x8000, 0x10000],
+                                         size=n)]
+    assert_fast_parity(cw, rno, 3, weight)
+
+
+def test_fast_choose_firstn_flat():
+    cw, n = build_map(n_hosts=4, osds_per_host=6)
+    steps = [RuleStep(CRUSH_RULE_TAKE, -1, 0),
+             RuleStep(CRUSH_RULE_CHOOSE_FIRSTN, 0, 0),
+             RuleStep(CRUSH_RULE_EMIT, 0, 0)]
+    rno = cw.add_rule(Rule(steps=steps, ruleset=1, type=1,
+                           min_size=1, max_size=10), "flat")
+    weight = [0x10000] * n
+    weight[2] = 0
+    weight[9] = 0x5000
+    assert_fast_parity(cw, rno, 3, weight)
+
+
+def test_fast_chooseleaf_indep():
+    cw, n = build_map(n_hosts=9, osds_per_host=3, uneven=True)
+    rno = cw.add_simple_rule("ec", "default", "host", mode="indep",
+                             rule_type=PG_POOL_TYPE_ERASURE)
+    cw.set_rule_mask_max_size(rno, 8)
+    assert_fast_parity(cw, rno, 6, [0x10000] * n)
+
+
+def test_fast_indep_with_down_outs():
+    cw, n = build_map(n_hosts=6, osds_per_host=2)
+    rno = cw.add_simple_rule("ec", "default", "host", mode="indep",
+                             rule_type=PG_POOL_TYPE_ERASURE)
+    weight = [0x10000] * n
+    weight[0] = weight[3] = weight[8] = 0
+    assert_fast_parity(cw, rno, 5, weight)
+
+
+def test_fast_choose_indep_flat():
+    cw, n = build_map(n_hosts=3, osds_per_host=5)
+    steps = [RuleStep(CRUSH_RULE_TAKE, -1, 0),
+             RuleStep(CRUSH_RULE_CHOOSE_INDEP, 0, 0),
+             RuleStep(CRUSH_RULE_EMIT, 0, 0)]
+    rno = cw.add_rule(Rule(steps=steps, ruleset=1, type=3,
+                           min_size=1, max_size=20), "flatec")
+    weight = [0x10000] * n
+    weight[4] = 0
+    assert_fast_parity(cw, rno, 4, weight)
+
+
+def test_fast_three_level_hierarchy():
+    from ceph_tpu.crush import CrushWrapper, CRUSH_BUCKET_STRAW2
+    cw = CrushWrapper()
+    cw.set_type_name(1, "host")
+    cw.set_type_name(2, "rack")
+    cw.set_type_name(10, "root")
+    osd = 0
+    rack_ids = []
+    bid = -2
+    for rk in range(3):
+        host_ids = []
+        for h in range(3):
+            osds = list(range(osd, osd + 3))
+            osd += 3
+            hid = cw.add_bucket(CRUSH_BUCKET_STRAW2, 1,
+                                f"host{rk}-{h}", osds, [0x10000] * 3, id=bid)
+            bid -= 1
+            host_ids.append(hid)
+        rid = cw.add_bucket(CRUSH_BUCKET_STRAW2, 2, f"rack{rk}", host_ids,
+                            [0x30000] * 3, id=bid)
+        bid -= 1
+        rack_ids.append(rid)
+    cw.set_max_devices(osd)
+    cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default", rack_ids,
+                  [0x90000] * 3, id=-1)
+    rno = cw.add_simple_rule("data", "default", "rack", mode="firstn")
+    assert_fast_parity(cw, rno, 3, [0x10000] * osd, n_x=300)
+
+
+def test_fast_rejects_chained_rules():
+    cw, n = build_map()
+    steps = [RuleStep(CRUSH_RULE_TAKE, -1, 0),
+             RuleStep(CRUSH_RULE_CHOOSE_FIRSTN, 2, 1),
+             RuleStep(CRUSH_RULE_CHOOSE_FIRSTN, 2, 0),
+             RuleStep(CRUSH_RULE_EMIT, 0, 0)]
+    rno = cw.add_rule(Rule(steps=steps, ruleset=1, type=1,
+                           min_size=1, max_size=10), "chain")
+    with pytest.raises(UnsupportedRule):
+        compile_fast_rule(cw.crush, rno, 4)

@@ -132,9 +132,11 @@ def test_padding_small_objects():
 
 
 def test_mapping_profile_roundtrip():
-    # mapping= permutes logical->physical chunk placement (ErasureCode.cc:258)
+    # mapping= permutes logical->physical chunk placement: data chunks land
+    # on 'D' positions, coding on the rest (ErasureCode.cc to_mapping)
     codec = plugin_registry.factory(
-        "isa", {"k": "3", "m": "1", "mapping": "ABCD", "backend": "host"})
+        "isa", {"k": "3", "m": "1", "mapping": "D_DD", "backend": "host"})
+    assert list(codec.get_chunk_mapping()) == [0, 2, 3, 1]
     rng = np.random.default_rng(11)
     payload = rng.integers(0, 256, size=1024, dtype=np.uint8).tobytes()
     roundtrip_sweep(codec, payload)
