@@ -57,6 +57,45 @@ def measure_device(matrix: np.ndarray, batch: np.ndarray) -> float:
     return n * BATCH * OBJECT_SIZE / dt / (1 << 30)
 
 
+def measure_crush_remap(n_osds=1000, n_pgs=100_000):
+    """Seconds to map all PGs of a 1000-OSD map (the <50 ms north star);
+    device fast path vs the native C++ host evaluator."""
+    from ceph_tpu.crush import CrushWrapper, CRUSH_BUCKET_STRAW2
+    from ceph_tpu.ops.crush_fast import compile_fast_rule
+    per_host = 20
+    cw = CrushWrapper()
+    cw.set_type_name(1, "host")
+    cw.set_type_name(10, "root")
+    hosts = []
+    for h in range(n_osds // per_host):
+        osds = list(range(h * per_host, (h + 1) * per_host))
+        hosts.append(cw.add_bucket(CRUSH_BUCKET_STRAW2, 1, f"host{h}",
+                                   osds, [0x10000] * per_host, id=-(h + 2)))
+    cw.set_max_devices(n_osds)
+    cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default", hosts,
+                  [0x10000 * per_host] * len(hosts), id=-1)
+    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    xs = np.arange(n_pgs, dtype=np.uint32)
+    w = np.full(n_osds, 0x10000, dtype=np.uint32)
+    fr = compile_fast_rule(cw.crush, rno, 3)
+    fr.map_batch(xs, w)  # compile + warm
+    t0 = time.perf_counter()
+    fr.map_batch(xs, w)
+    dev_s = time.perf_counter() - t0
+    host_s = None
+    try:
+        from ceph_tpu.native import NativeCrushMapper, native_available
+        if native_available():
+            nm = NativeCrushMapper(cw.crush)
+            sample = 2000
+            t0 = time.perf_counter()
+            nm.do_rule_batch(rno, xs[:sample].tolist(), 3, w.tolist())
+            host_s = (time.perf_counter() - t0) * (n_pgs / sample)
+    except Exception:
+        pass
+    return dev_s, host_s
+
+
 def main() -> None:
     from ceph_tpu.gf.matrices import gf_gen_rs_matrix
     rng = np.random.default_rng(1234)
@@ -65,12 +104,21 @@ def main() -> None:
 
     host_gibs = measure_host(matrix, batch[0])
     dev_gibs = measure_device(matrix, batch)
-    print(json.dumps({
+    result = {
         "metric": "ec_encode_k8m4_1MiB_throughput",
         "value": round(dev_gibs, 3),
         "unit": "GiB/s",
         "vs_baseline": round(dev_gibs / host_gibs, 2) if host_gibs else None,
-    }))
+    }
+    try:
+        crush_dev_s, crush_host_s = measure_crush_remap()
+        result["crush_remap_100k_pgs_ms"] = round(crush_dev_s * 1000, 1)
+        if crush_host_s:
+            result["crush_remap_vs_native_host"] = round(
+                crush_host_s / crush_dev_s, 2)
+    except Exception:
+        pass
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -12,6 +12,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from .client import RadosClient
+from .common import AdminSocket, PerfCountersCollection
+from .common.config import g_conf
 from .mon import Monitor
 from .msg import Network
 from .osd.osd import OSD
@@ -23,11 +25,40 @@ class MiniCluster:
         self.mon = Monitor(self.network)
         self.mon.bootstrap(n_osds, osds_per_host)
         self.osds: Dict[int, OSD] = {}
+        self.perf_collection = PerfCountersCollection()
         for i in range(n_osds):
             osd = OSD(self.network, i)
             self.osds[i] = osd
             self.mon.subscribe(osd.name)
+            self.perf_collection.add(osd.perf_counters)
         self.clock = 0.0
+        self.admin_socket = AdminSocket()
+        self._register_admin_commands()
+
+    def _register_admin_commands(self) -> None:
+        asok = self.admin_socket
+        asok.register("perf dump",
+                      lambda c, a: self.perf_collection.dump(
+                          a.get("logger", ""), a.get("counter", "")),
+                      "dump perfcounters")
+        asok.register("config show", lambda c, a: g_conf.show_config(),
+                      "show config values")
+        asok.register("status",
+                      lambda c, a: {"health": self.health(),
+                                    "epoch": self.mon.osdmap.epoch,
+                                    "num_osds": len(self.osds),
+                                    "pg_states": self.pg_states()},
+                      "cluster status")
+        asok.register(
+            "dump_historic_ops",
+            lambda c, a: {o.name: o.op_tracker.dump_historic_ops()
+                          for o in self.osds.values()},
+            "recent completed ops with event timelines")
+        asok.register(
+            "dump_ops_in_flight",
+            lambda c, a: {o.name: o.op_tracker.dump_ops_in_flight()
+                          for o in self.osds.values()},
+            "in-flight ops")
 
     # ---- pools ------------------------------------------------------------
     def create_ec_pool(self, name: str, k: int = 4, m: int = 2,

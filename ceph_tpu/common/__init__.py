@@ -1,0 +1,13 @@
+from .config import Option, ConfigProxy, OPT_INT, OPT_STR, OPT_FLOAT, \
+    OPT_BOOL, OPT_DOUBLE
+from .perf_counters import (
+    PerfCounters, PerfCountersBuilder, PerfCountersCollection,
+)
+from .admin_socket import AdminSocket
+from .tracked_op import OpTracker, TrackedOp
+
+__all__ = [
+    "Option", "ConfigProxy", "OPT_INT", "OPT_STR", "OPT_FLOAT", "OPT_BOOL",
+    "OPT_DOUBLE", "PerfCounters", "PerfCountersBuilder",
+    "PerfCountersCollection", "AdminSocket", "OpTracker", "TrackedOp",
+]

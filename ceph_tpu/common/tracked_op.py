@@ -1,0 +1,93 @@
+"""OpTracker — in-flight op tracking and historic-op tracing.
+
+Mirrors the reference's op latency surface (src/common/TrackedOp.cc +
+the blkin trace slot on every Message, msg/Message.h:254): each op carries
+one trace id end to end, records named events with timestamps, and
+completed ops land in a bounded history ring dumped via the admin socket
+(`dump_historic_ops`, `dump_ops_in_flight`).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+
+class TrackedOp:
+    def __init__(self, tracker: "OpTracker", trace_id: int,
+                 description: str):
+        self.tracker = tracker
+        self.trace_id = trace_id
+        self.description = description
+        self.initiated_at = tracker.now()
+        self.events: List[Tuple[float, str]] = []
+        self.completed_at: Optional[float] = None
+
+    def mark_event(self, event: str) -> None:
+        self.events.append((self.tracker.now(), event))
+
+    def finish(self) -> None:
+        self.completed_at = self.tracker.now()
+        self.tracker._complete(self)
+
+    @property
+    def duration(self) -> float:
+        end = self.completed_at if self.completed_at is not None \
+            else self.tracker.now()
+        return end - self.initiated_at
+
+    def dump(self) -> dict:
+        return {
+            "description": self.description,
+            "trace_id": self.trace_id,
+            "initiated_at": self.initiated_at,
+            "age": self.duration,
+            "type_data": {
+                "events": [{"time": t, "event": e}
+                           for t, e in self.events],
+            },
+        }
+
+
+class OpTracker:
+    def __init__(self, history_size: int = 20,
+                 history_duration: float = 600.0,
+                 clock=time.monotonic):
+        self.history_size = history_size
+        self.history_duration = history_duration
+        self.now = clock
+        self._inflight: Dict[int, TrackedOp] = {}
+        self._history: Deque[TrackedOp] = deque(maxlen=history_size)
+        self._slow: Deque[TrackedOp] = deque(maxlen=history_size)
+        self._lock = threading.Lock()
+        self.complaint_time = 30.0
+
+    def create_request(self, trace_id: int, description: str) -> TrackedOp:
+        op = TrackedOp(self, trace_id, description)
+        with self._lock:
+            self._inflight[trace_id] = op
+        op.mark_event("initiated")
+        return op
+
+    def _complete(self, op: TrackedOp) -> None:
+        with self._lock:
+            self._inflight.pop(op.trace_id, None)
+            self._history.append(op)
+            if op.duration > self.complaint_time:
+                self._slow.append(op)
+
+    def dump_ops_in_flight(self) -> dict:
+        with self._lock:
+            ops = [o.dump() for o in self._inflight.values()]
+        return {"ops": ops, "num_ops": len(ops)}
+
+    def dump_historic_ops(self) -> dict:
+        with self._lock:
+            ops = [o.dump() for o in self._history]
+        return {"size": self.history_size,
+                "duration": self.history_duration, "ops": ops}
+
+    def dump_historic_slow_ops(self) -> dict:
+        with self._lock:
+            return {"ops": [o.dump() for o in self._slow]}

@@ -1,0 +1,306 @@
+"""CrushCompiler — crushmap text format ⇄ CrushWrapper.
+
+Implements the reference's textual map grammar (src/crush/CrushCompiler.cc,
+grammar in src/crush/grammar.h): tunable lines, device lines (with device
+class), type lines, bucket blocks (id/alg/hash/item weight), and rule
+blocks (ruleset/type/min_size/max_size/step...).  compile() parses text
+into a CrushWrapper; decompile() emits text that re-compiles to the same
+map — the crushtool -c/-d round-trip contract.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, TextIO
+
+from .constants import (
+    CRUSH_BUCKET_LIST, CRUSH_BUCKET_STRAW, CRUSH_BUCKET_STRAW2,
+    CRUSH_BUCKET_TREE, CRUSH_BUCKET_UNIFORM,
+    CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSELEAF_INDEP,
+    CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSE_INDEP, CRUSH_RULE_EMIT,
+    CRUSH_RULE_SET_CHOOSELEAF_STABLE, CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+    CRUSH_RULE_SET_CHOOSELEAF_VARY_R,
+    CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+    CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES, CRUSH_RULE_SET_CHOOSE_TRIES,
+    CRUSH_RULE_TAKE, PG_POOL_TYPE_ERASURE, PG_POOL_TYPE_REPLICATED,
+)
+from .types import Rule, RuleStep
+from .wrapper import CrushWrapper
+
+ALG_NAMES = {
+    CRUSH_BUCKET_UNIFORM: "uniform",
+    CRUSH_BUCKET_LIST: "list",
+    CRUSH_BUCKET_TREE: "tree",
+    CRUSH_BUCKET_STRAW: "straw",
+    CRUSH_BUCKET_STRAW2: "straw2",
+}
+ALG_IDS = {v: k for k, v in ALG_NAMES.items()}
+
+RULE_TYPE_NAMES = {PG_POOL_TYPE_REPLICATED: "replicated",
+                   PG_POOL_TYPE_ERASURE: "erasure"}
+RULE_TYPE_IDS = {v: k for k, v in RULE_TYPE_NAMES.items()}
+
+STEP_SET_OPS = {
+    "set_choose_tries": CRUSH_RULE_SET_CHOOSE_TRIES,
+    "set_chooseleaf_tries": CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+    "set_choose_local_tries": CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES,
+    "set_choose_local_fallback_tries":
+        CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+    "set_chooseleaf_vary_r": CRUSH_RULE_SET_CHOOSELEAF_VARY_R,
+    "set_chooseleaf_stable": CRUSH_RULE_SET_CHOOSELEAF_STABLE,
+}
+STEP_SET_NAMES = {v: k for k, v in STEP_SET_OPS.items()}
+
+TUNABLES = ("choose_local_tries", "choose_local_fallback_tries",
+            "choose_total_tries", "chooseleaf_descend_once",
+            "chooseleaf_vary_r", "chooseleaf_stable", "straw_calc_version",
+            "allowed_bucket_algs")
+
+
+class CrushCompiler:
+    def __init__(self, crush: Optional[CrushWrapper] = None):
+        self.crush = crush or CrushWrapper()
+
+    # ---- decompile ---------------------------------------------------------
+    def decompile(self) -> str:
+        cw = self.crush
+        m = cw.crush
+        out: List[str] = ["# begin crush map"]
+        for t in TUNABLES:
+            out.append(f"tunable {t} {getattr(m, t)}")
+        out.append("")
+        out.append("# devices")
+        for d in range(m.max_devices):
+            name = cw.name_map.get(d, f"osd.{d}")
+            cls = cw.item_class.get(d)
+            suffix = f" class {cw.class_map[cls]}" if cls is not None else ""
+            out.append(f"device {d} {name}{suffix}")
+        out.append("")
+        out.append("# types")
+        for t in sorted(cw.type_map):
+            out.append(f"type {t} {cw.type_map[t]}")
+        out.append("")
+        out.append("# buckets")
+        # emit leaves-first so items are defined before use
+        emitted = set()
+
+        def emit_bucket(bid: int):
+            if bid in emitted:
+                return
+            b = m.bucket(bid)
+            if b is None:
+                return
+            for it in b.items:
+                if it < 0:
+                    emit_bucket(it)
+            emitted.add(bid)
+            tname = cw.type_map.get(b.type, f"type{b.type}")
+            name = cw.name_map.get(bid, f"bucket{bid}")
+            out.append(f"{tname} {name} {{")
+            out.append(f"\tid {bid}")
+            out.append(f"\talg {ALG_NAMES.get(b.alg, b.alg)}")
+            out.append("\thash 0\t# rjenkins1")
+            ws = getattr(b, "item_weights", None)
+            for i, it in enumerate(b.items):
+                iname = cw.name_map.get(
+                    it, f"osd.{it}" if it >= 0 else f"bucket{it}")
+                if ws is not None and i < len(ws):
+                    out.append(f"\titem {iname} weight "
+                               f"{ws[i] / 0x10000:.5f}")
+                else:
+                    out.append(f"\titem {iname}")
+            out.append("}")
+
+        for b in m.buckets:
+            if b is not None:
+                emit_bucket(b.id)
+        out.append("")
+        out.append("# rules")
+        for rno, rule in enumerate(m.rules):
+            if rule is None:
+                continue
+            rname = cw.rule_name_map.get(rno, f"rule-{rno}")
+            out.append(f"rule {rname} {{")
+            out.append(f"\truleset {rule.ruleset}")
+            out.append(f"\ttype "
+                       f"{RULE_TYPE_NAMES.get(rule.type, rule.type)}")
+            out.append(f"\tmin_size {rule.min_size}")
+            out.append(f"\tmax_size {rule.max_size}")
+            for step in rule.steps:
+                out.append("\t" + self._step_text(step))
+            out.append("}")
+        out.append("")
+        out.append("# end crush map")
+        return "\n".join(out) + "\n"
+
+    def _step_text(self, step: RuleStep) -> str:
+        cw = self.crush
+        op = step.op
+        if op == CRUSH_RULE_TAKE:
+            return f"step take {cw.name_map.get(step.arg1, step.arg1)}"
+        if op == CRUSH_RULE_EMIT:
+            return "step emit"
+        if op in STEP_SET_NAMES:
+            return f"step {STEP_SET_NAMES[op]} {step.arg1}"
+        mode = {
+            CRUSH_RULE_CHOOSE_FIRSTN: "choose firstn",
+            CRUSH_RULE_CHOOSE_INDEP: "choose indep",
+            CRUSH_RULE_CHOOSELEAF_FIRSTN: "chooseleaf firstn",
+            CRUSH_RULE_CHOOSELEAF_INDEP: "chooseleaf indep",
+        }.get(op)
+        if mode is None:
+            return f"step op{op} {step.arg1} {step.arg2}"
+        tname = cw.type_map.get(step.arg2, f"type{step.arg2}")
+        return f"step {mode} {step.arg1} type {tname}"
+
+    # ---- compile -----------------------------------------------------------
+    def compile(self, text: str) -> CrushWrapper:
+        cw = CrushWrapper()
+        cw.type_map = {}
+        lines = []
+        for raw in text.splitlines():
+            line = raw.split("#", 1)[0].strip()
+            if line:
+                lines.append(line)
+        i = 0
+        pending_buckets: List[dict] = []
+        rule_starts: List[int] = []
+        max_dev = 0
+        while i < len(lines):
+            line = lines[i]
+            toks = line.split()
+            if toks[0] == "tunable":
+                setattr(cw.crush, toks[1], int(toks[2]))
+                i += 1
+            elif toks[0] == "device":
+                dev = int(toks[1])
+                cw.set_item_name(dev, toks[2])
+                max_dev = max(max_dev, dev + 1)
+                if len(toks) >= 5 and toks[3] == "class":
+                    cw.set_item_class(dev, toks[4])
+                i += 1
+            elif toks[0] == "type":
+                cw.set_type_name(int(toks[1]), toks[2])
+                i += 1
+            elif toks[0] == "rule":
+                # rules reference bucket names: parse after buckets build
+                rule_starts.append(i)
+                while i < len(lines) and lines[i] != "}":
+                    i += 1
+                i += 1
+            elif len(toks) == 3 and toks[2] == "{":
+                i = self._parse_bucket(cw, lines, i, pending_buckets)
+            else:
+                raise ValueError(f"cannot parse line: {line!r}")
+        cw.set_max_devices(max_dev)
+        self._build_buckets(cw, pending_buckets)
+        for start in rule_starts:
+            self._parse_rule(cw, lines, start)
+        self.crush = cw
+        return cw
+
+    def _parse_bucket(self, cw: CrushWrapper, lines: List[str], i: int,
+                      pending: List[dict]) -> int:
+        toks = lines[i].split()
+        btype, name = toks[0], toks[1]
+        spec = {"type": btype, "name": name, "id": None,
+                "alg": "straw2", "items": []}
+        i += 1
+        while i < len(lines) and lines[i] != "}":
+            t = lines[i].split()
+            if t[0] == "id":
+                spec["id"] = int(t[1])
+            elif t[0] == "alg":
+                spec["alg"] = t[1]
+            elif t[0] == "hash":
+                pass
+            elif t[0] == "item":
+                w = 0x10000
+                if "weight" in t:
+                    w = int(round(float(t[t.index("weight") + 1]) * 0x10000))
+                spec["items"].append((t[1], w))
+            else:
+                raise ValueError(f"bucket {name}: bad line {lines[i]!r}")
+            i += 1
+        pending.append(spec)
+        return i + 1
+
+    def _build_buckets(self, cw: CrushWrapper, pending: List[dict]) -> None:
+        # leaves first: a bucket can be built once all its items exist
+        remaining = list(pending)
+        while remaining:
+            progressed = False
+            for spec in list(remaining):
+                try:
+                    items = [cw.get_item_id(n) if not n.startswith("osd.")
+                             else int(n[4:]) for n, _ in spec["items"]]
+                except KeyError:
+                    continue
+                weights = [w for _, w in spec["items"]]
+                tid = cw.get_type_id(spec["type"])
+                if tid < 0:
+                    raise ValueError(f"unknown type {spec['type']!r}")
+                cw.add_bucket(ALG_IDS[spec["alg"]], tid, spec["name"],
+                              items, weights,
+                              id=spec["id"] if spec["id"] is not None else 0)
+                remaining.remove(spec)
+                progressed = True
+            if not progressed:
+                names = [s["name"] for s in remaining]
+                raise ValueError(f"unresolvable bucket items in {names}")
+
+    def _parse_rule(self, cw: CrushWrapper, lines: List[str],
+                    i: int) -> int:
+        toks = lines[i].split()
+        name = toks[1]
+        ruleset = -1
+        rtype = PG_POOL_TYPE_REPLICATED
+        min_size, max_size = 1, 10
+        steps: List[RuleStep] = []
+        i += 1
+        while i < len(lines) and lines[i] != "}":
+            t = lines[i].split()
+            if t[0] == "ruleset" or t[0] == "id":
+                ruleset = int(t[1])
+            elif t[0] == "type":
+                rtype = RULE_TYPE_IDS.get(t[1], int(t[1])
+                                          if t[1].isdigit() else 1)
+            elif t[0] == "min_size":
+                min_size = int(t[1])
+            elif t[0] == "max_size":
+                max_size = int(t[1])
+            elif t[0] == "step":
+                steps.append(self._parse_step(cw, t[1:]))
+            else:
+                raise ValueError(f"rule {name}: bad line {lines[i]!r}")
+            i += 1
+        rule = Rule(steps=steps, ruleset=ruleset, type=rtype,
+                    min_size=min_size, max_size=max_size)
+        rno = cw.add_rule(rule, name,
+                          ruleno=ruleset if ruleset >= 0 else -1)
+        rule.ruleset = rno if ruleset < 0 else ruleset
+        return i + 1
+
+    def _parse_step(self, cw: CrushWrapper, t: List[str]) -> RuleStep:
+        if t[0] == "take":
+            item = int(t[1][4:]) if t[1].startswith("osd.") \
+                else cw.get_item_id(t[1])
+            return RuleStep(CRUSH_RULE_TAKE, item, 0)
+        if t[0] == "emit":
+            return RuleStep(CRUSH_RULE_EMIT, 0, 0)
+        if t[0] in STEP_SET_OPS:
+            return RuleStep(STEP_SET_OPS[t[0]], int(t[1]), 0)
+        if t[0] in ("choose", "chooseleaf"):
+            mode = t[1]  # firstn | indep
+            n = int(t[2])
+            assert t[3] == "type"
+            tid = cw.get_type_id(t[4])
+            if tid < 0:
+                raise ValueError(f"unknown type {t[4]!r}")
+            op = {
+                ("choose", "firstn"): CRUSH_RULE_CHOOSE_FIRSTN,
+                ("choose", "indep"): CRUSH_RULE_CHOOSE_INDEP,
+                ("chooseleaf", "firstn"): CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                ("chooseleaf", "indep"): CRUSH_RULE_CHOOSELEAF_INDEP,
+            }[(t[0], mode)]
+            return RuleStep(op, n, tid)
+        raise ValueError(f"unknown step {' '.join(t)!r}")

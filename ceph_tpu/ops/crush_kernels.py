@@ -188,6 +188,12 @@ class CompiledCrushMap:
         self.sizes = jnp.asarray(sizes)
         self.types = jnp.asarray(types)
         self.weights = jnp.asarray(weights)
+        # f32 reciprocals for the fast-path draw (crush_fast.py); 0 marks
+        # zero-weight lanes
+        with np.errstate(divide="ignore"):
+            inv = np.where(weights > 0, 1.0 / weights.astype(np.float64),
+                           0.0)
+        self.inv_weights = jnp.asarray(inv.astype(np.float32))
         self.lane = jnp.arange(S, dtype=jnp.int32)
 
 

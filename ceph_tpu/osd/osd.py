@@ -12,6 +12,7 @@ from __future__ import annotations
 import struct
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..common import OpTracker, PerfCountersBuilder
 from ..crush.constants import CRUSH_ITEM_NONE
 from ..msg import (
     Dispatcher, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
@@ -25,6 +26,30 @@ from .pg import PG
 
 HEARTBEAT_GRACE = 20.0     # osd_heartbeat_grace default (options.cc:2461)
 HEARTBEAT_INTERVAL = 6.0   # osd_heartbeat_interval (options.cc:2456)
+
+# perf counter indices (l_osd_* analog, osd/OSD.cc:3099)
+L_OSD_FIRST = 1000
+L_OSD_OP_W = 1001
+L_OSD_OP_R = 1002
+L_OSD_SUBOP_W = 1003
+L_OSD_SUBOP_R = 1004
+L_OSD_RECOVERY_PUSH = 1005
+L_OSD_MAP = 1006
+L_OSD_OP_LAT = 1007
+L_OSD_LAST = 1008
+
+
+def _build_osd_perf(name: str):
+    b = PerfCountersBuilder(name, L_OSD_FIRST, L_OSD_LAST)
+    b.add_u64_counter(L_OSD_OP_W, "op_w", "client writes")
+    b.add_u64_counter(L_OSD_OP_R, "op_r", "client reads")
+    b.add_u64_counter(L_OSD_SUBOP_W, "subop_w", "shard writes")
+    b.add_u64_counter(L_OSD_SUBOP_R, "subop_r", "shard reads")
+    b.add_u64_counter(L_OSD_RECOVERY_PUSH, "recovery_push",
+                      "recovered shard pushes")
+    b.add_u64_counter(L_OSD_MAP, "maps", "osdmap epochs consumed")
+    b.add_time_avg(L_OSD_OP_LAT, "op_latency", "client op latency")
+    return b.create_perf_counters()
 
 
 class OSD(Dispatcher):
@@ -43,9 +68,16 @@ class OSD(Dispatcher):
         self.last_ping_reply: Dict[int, float] = {}
         self.reported_failures: Set[int] = set()
         self.now = 0.0
-        self.perf = {"op_w": 0, "op_r": 0, "subop_w": 0, "subop_r": 0,
-                     "recovery_push": 0, "maps": 0}
+        self.perf_counters = _build_osd_perf(self.name)
+        self.op_tracker = OpTracker()
+        self._tracked: Dict[Tuple[str, int], object] = {}
         self._recovery_queue: List[PG] = []
+
+    # legacy-style dict view used by tests / admin socket
+    @property
+    def perf(self) -> Dict[str, int]:
+        d = self.perf_counters.dump()
+        return {k: v for k, v in d.items() if isinstance(v, int)}
 
     # ---- EC profile plumbing ----------------------------------------------
     def get_ec_impl(self, pool):
@@ -89,7 +121,7 @@ class OSD(Dispatcher):
 
     # ---- map handling (OSD::handle_osd_map) --------------------------------
     def _handle_osd_map(self, msg: MOSDMap) -> None:
-        self.perf["maps"] += 1
+        self.perf_counters.inc(L_OSD_MAP)
         for inc in msg.incrementals:
             if inc.epoch == self.osdmap.epoch + 1:
                 self.osdmap.apply_incremental(inc)
@@ -111,17 +143,32 @@ class OSD(Dispatcher):
 
     # ---- client ops -------------------------------------------------------
     def _handle_op(self, msg: MOSDOp) -> None:
-        self.perf["op_w" if msg.op == "write" else "op_r"] += 1
+        self.perf_counters.inc(
+            L_OSD_OP_W if msg.op == "write" else L_OSD_OP_R)
+        op = self.op_tracker.create_request(
+            msg.trace_id, f"osd_op({msg.op} {msg.pool}/{msg.oid})")
+        op.mark_event("queued_for_pg")
+        self._tracked[(msg.src, msg.tid)] = op
         pg = self.pgs.get(msg.pgid)
         if pg is None:
-            self.reply_to(msg, MOSDOpReply(tid=msg.tid, result=-11,
-                                           epoch=self.osdmap.epoch))
+            self.send_op_reply(msg.src, MOSDOpReply(
+                tid=msg.tid, result=-11, epoch=self.osdmap.epoch))
             return
+        op.mark_event("reached_pg")
         pg.do_op(msg)
+
+    def send_op_reply(self, dst: str, reply: MOSDOpReply) -> None:
+        """All client replies funnel here so op tracking/latency see them."""
+        op = self._tracked.pop((dst, reply.tid), None)
+        if op is not None:
+            op.mark_event("commit_sent" if reply.result == 0 else "error")
+            op.finish()
+            self.perf_counters.tinc(L_OSD_OP_LAT, op.duration)
+        self.messenger.send_message(reply, dst)
 
     # ---- shard sub-ops ----------------------------------------------------
     def _handle_sub_write(self, msg: MOSDECSubOpWrite) -> None:
-        self.perf["subop_w"] += 1
+        self.perf_counters.inc(L_OSD_SUBOP_W)
         if msg.at_version < 0:  # delete marker
             self._apply_delete(msg)
             return
@@ -148,7 +195,7 @@ class OSD(Dispatcher):
             self.store.queue_transaction(t)
 
     def _handle_sub_read(self, msg: MOSDECSubOpRead) -> None:
-        self.perf["subop_r"] += 1
+        self.perf_counters.inc(L_OSD_SUBOP_R)
         pg = self.pgs.get(msg.pgid)
         if pg is not None and pg.backend is not None:
             reply = pg.backend.handle_sub_read(msg, self.store)
@@ -261,7 +308,7 @@ class OSD(Dispatcher):
                     tid=be.next_tid(), pgid=pg.pgid, shard=shard, oid=oid,
                     chunk=rec[shard], at_version=logical)
                 pg.send_to_osd(osd, push)
-                self.perf["recovery_push"] += 1
+                self.perf_counters.inc(L_OSD_RECOVERY_PUSH)
                 pushed += 1
         return pushed
 
@@ -283,7 +330,7 @@ class OSD(Dispatcher):
                                         oid=ho.oid, chunk=data,
                                         at_version=size)
                 pg.send_to_osd(osd, push)
-                self.perf["recovery_push"] += 1
+                self.perf_counters.inc(L_OSD_RECOVERY_PUSH)
                 pushed += 1
         return pushed
 

@@ -127,7 +127,7 @@ class PG:
     def do_op(self, msg: MOSDOp) -> None:
         if not self.is_primary() or self.state not in (
                 STATE_ACTIVE, STATE_ACTIVE_RECOVERING):
-            self.osd.reply_to(msg, MOSDOpReply(
+            self.osd.send_op_reply(msg.src, MOSDOpReply(
                 tid=msg.tid, result=-11,  # EAGAIN: wrong primary / not ready
                 epoch=self.osd.osdmap.epoch))
             return
@@ -140,21 +140,22 @@ class PG:
         elif msg.op == CEPH_OSD_OP_DELETE:
             self._do_delete(msg)
         else:
-            self.osd.reply_to(msg, MOSDOpReply(tid=msg.tid, result=-95))
+            self.osd.send_op_reply(msg.src,
+                                   MOSDOpReply(tid=msg.tid, result=-95))
 
     def _do_write(self, msg: MOSDOp) -> None:
         if self.backend is not None:
             src = msg.src
 
             def on_commit(result: int) -> None:
-                self.osd.messenger.send_message(
-                    MOSDOpReply(tid=msg.tid, result=result,
-                                epoch=self.osd.osdmap.epoch), src)
+                self.osd.send_op_reply(src, MOSDOpReply(
+                    tid=msg.tid, result=result,
+                    epoch=self.osd.osdmap.epoch))
 
             self.backend.submit_transaction(msg.oid, msg.data, on_commit)
         else:
             self.rep_backend.write(msg.oid, msg.data)
-            self.osd.reply_to(msg, MOSDOpReply(
+            self.osd.send_op_reply(msg.src, MOSDOpReply(
                 tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))
 
     def _do_read(self, msg: MOSDOp) -> None:
@@ -162,17 +163,18 @@ class PG:
             src = msg.src
 
             def on_complete(result: int, data: bytes) -> None:
-                self.osd.messenger.send_message(
-                    MOSDOpReply(tid=msg.tid, result=result, data=data,
-                                epoch=self.osd.osdmap.epoch), src)
+                self.osd.send_op_reply(src, MOSDOpReply(
+                    tid=msg.tid, result=result, data=data,
+                    epoch=self.osd.osdmap.epoch))
 
             self.backend.objects_read_and_reconstruct(msg.oid, on_complete)
         else:
             data = self.rep_backend.read(msg.oid)
             if data is None:
-                self.osd.reply_to(msg, MOSDOpReply(tid=msg.tid, result=-2))
+                self.osd.send_op_reply(msg.src,
+                                       MOSDOpReply(tid=msg.tid, result=-2))
             else:
-                self.osd.reply_to(msg, MOSDOpReply(
+                self.osd.send_op_reply(msg.src, MOSDOpReply(
                     tid=msg.tid, result=0, data=data,
                     epoch=self.osd.osdmap.epoch))
 
@@ -186,10 +188,11 @@ class PG:
             cid = self.rep_backend.cid()
             ho = hobject_t(msg.oid)
         if not store.collection_exists(cid) or not store.exists(cid, ho):
-            self.osd.reply_to(msg, MOSDOpReply(tid=msg.tid, result=-2))
+            self.osd.send_op_reply(msg.src,
+                                   MOSDOpReply(tid=msg.tid, result=-2))
             return
         size = struct.unpack("<Q", store.getattr(cid, ho, SIZE_ATTR))[0]
-        self.osd.reply_to(msg, MOSDOpReply(
+        self.osd.send_op_reply(msg.src, MOSDOpReply(
             tid=msg.tid, result=0, data=struct.pack("<Q", size),
             epoch=self.osd.osdmap.epoch))
 
@@ -209,5 +212,5 @@ class PG:
                                      shard=-1, oid=msg.oid, chunk=b"",
                                      at_version=-1)
                 self.send_to_osd(osd, m)
-        self.osd.reply_to(msg, MOSDOpReply(
+        self.osd.send_op_reply(msg.src, MOSDOpReply(
             tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))

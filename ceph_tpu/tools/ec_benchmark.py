@@ -1,0 +1,97 @@
+"""ceph_erasure_code_benchmark equivalent.
+
+CLI mirrors the reference harness (src/test/erasure-code/
+ceph_erasure_code_benchmark.cc): `-p <plugin> -P k=K -P m=M -S <size>
+-i <iterations> -w encode|decode [-e erasures] [--erasures-generation
+exhaustive]`, printing `<seconds>\t<KiB>` like :187.  Extra knob
+`--batch S` exercises the batched device path (S objects per device call)
+— the TPU-native mode the reference cannot express.
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import sys
+import time
+
+import numpy as np
+
+from ..ec import create_erasure_code
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="ec_benchmark")
+    p.add_argument("-p", "--plugin", default="jerasure")
+    p.add_argument("-P", "--parameter", action="append", default=[],
+                   help="profile parameter k=v")
+    p.add_argument("-S", "--size", type=int, default=1 << 20)
+    p.add_argument("-i", "--iterations", type=int, default=1)
+    p.add_argument("-w", "--workload", choices=("encode", "decode"),
+                   default="encode")
+    p.add_argument("-e", "--erasures", type=int, default=1)
+    p.add_argument("--erasures-generation", default="random",
+                   choices=("random", "exhaustive"))
+    p.add_argument("--batch", type=int, default=0,
+                   help="objects per batched device call (tpu plugin)")
+    p.add_argument("--erased", type=int, action="append", default=[])
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    profile = {"plugin": args.plugin}
+    for kv in args.parameter:
+        k, _, v = kv.partition("=")
+        profile[k] = v
+    codec = create_erasure_code(profile)
+    k = codec.get_data_chunk_count()
+    n = codec.get_chunk_count()
+    size = args.size
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=size, dtype=np.uint8)
+
+    if args.workload == "encode":
+        if args.batch and hasattr(codec, "encode_batch"):
+            C = codec.get_chunk_size(size)
+            stripes = np.ascontiguousarray(
+                np.resize(data, (args.batch, k, C)))
+            codec.encode_batch(stripes)  # warm + compile
+            t0 = time.perf_counter()
+            for _ in range(args.iterations):
+                codec.encode_batch(stripes)
+            dt = time.perf_counter() - t0
+            kib = args.iterations * args.batch * size // 1024
+        else:
+            t0 = time.perf_counter()
+            for _ in range(args.iterations):
+                codec.encode(set(range(n)), data)
+            dt = time.perf_counter() - t0
+            kib = args.iterations * size // 1024
+        print(f"{dt:.6f}\t{kib}")
+        return 0
+
+    # decode workload
+    enc = codec.encode(set(range(n)), data)
+    if args.erasures_generation == "exhaustive":
+        patterns = list(itertools.combinations(range(n), args.erasures))
+    elif args.erased:
+        patterns = [tuple(args.erased)]
+    else:
+        patterns = [tuple(sorted(rng.choice(n, size=args.erasures,
+                                            replace=False).tolist()))
+                    for _ in range(args.iterations)]
+    want = set(range(k))
+    t0 = time.perf_counter()
+    done = 0
+    for i in range(args.iterations):
+        lost = patterns[i % len(patterns)]
+        have = {j: enc[j] for j in range(n) if j not in lost}
+        codec.decode(want, have)
+        done += 1
+    dt = time.perf_counter() - t0
+    print(f"{dt:.6f}\t{done * size // 1024}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""osdmaptool — inspect and exercise OSDMaps.
+
+CLI surface mirrors the reference tool (src/tools/osdmaptool.cc):
+--createsimple N builds a map, --test-map-pgs maps every PG of every pool
+(the full-cluster remap benchmark harness, backed by OSDMapMapping's device
+batch path), --test-map-object maps one object, --upmap runs the balancer
+(calc_pg_upmaps), --mark-up-in resets osd states.  Maps are python pickles.
+"""
+from __future__ import annotations
+
+import argparse
+import pickle
+import sys
+import time
+
+import numpy as np
+
+from ..crush.constants import CRUSH_BUCKET_STRAW2, CRUSH_ITEM_NONE
+from ..osdmap import (
+    CEPH_OSD_IN, Incremental, OSDMap, OSDMapMapping, TYPE_REPLICATED,
+    pg_pool_t, pg_t,
+)
+from ..osdmap.balancer import calc_pg_upmaps
+
+
+def createsimple(n_osds: int, pg_num: int = 128,
+                 osds_per_host: int = 4) -> OSDMap:
+    m = OSDMap()
+    m.set_max_osd(n_osds)
+    cw = m.crush
+    cw.set_type_name(1, "host")
+    cw.set_type_name(10, "root")
+    hosts = []
+    n_hosts = (n_osds + osds_per_host - 1) // osds_per_host
+    for h in range(n_hosts):
+        osds = list(range(h * osds_per_host,
+                          min((h + 1) * osds_per_host, n_osds)))
+        hid = cw.add_bucket(CRUSH_BUCKET_STRAW2, 1, f"host{h}", osds,
+                            [0x10000] * len(osds), id=-(h + 2))
+        hosts.append((hid, len(osds)))
+    cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default",
+                  [h for h, _ in hosts],
+                  [0x10000 * n for _, n in hosts], id=-1)
+    for i in range(n_osds):
+        m.set_osd(i, up=True, weight=CEPH_OSD_IN)
+        cw.set_item_name(i, f"osd.{i}")
+    rno = cw.add_simple_rule("replicated_rule", "default", "host",
+                             mode="firstn")
+    m.add_pool("rbd", pg_pool_t(type=TYPE_REPLICATED, size=3,
+                                crush_rule=rno, pg_num=pg_num,
+                                pgp_num=pg_num))
+    m.epoch = 1
+    return m
+
+
+def test_map_pgs(m: OSDMap, use_device: bool, out) -> None:
+    mapping = OSDMapMapping(use_device=use_device)
+    t0 = time.perf_counter()
+    mapping.update(m)
+    dt = time.perf_counter() - t0
+    count = np.zeros(m.max_osd, dtype=np.int64)
+    primaries = np.zeros(m.max_osd, dtype=np.int64)
+    total = 0
+    size_total = 0
+    for pid, pm in mapping.pools.items():
+        for ps in range(pm.acting.shape[0]):
+            row = pm.acting[ps]
+            total += 1
+            for o in row:
+                if o != CRUSH_ITEM_NONE:
+                    count[o] += 1
+                    size_total += 1
+            p = pm.acting_primary[ps]
+            if p >= 0:
+                primaries[p] += 1
+    used = count[count > 0]
+    print(f"pool {sorted(mapping.pools)} pg_num "
+          f"{[m.pools[p].pg_num for p in sorted(mapping.pools)]}",
+          file=out)
+    print(f"#osd\tcount\tfirst\tprimary\tc wt\twt", file=out)
+    for o in range(m.max_osd):
+        print(f"osd.{o}\t{count[o]}\t{primaries[o]}\t{primaries[o]}"
+              f"\t{m.crush.crush.max_devices and 1.0}\t"
+              f"{m.osd_weight[o] / 0x10000:.4g}", file=out)
+    avg = size_total / max(1, len(used))
+    print(f" avg {avg:.4g} stddev {used.std():.4g} "
+          f"(expected {np.sqrt(avg):.4g})", file=out)
+    print(f" min osd.{int(count.argmin())} {int(count.min())}", file=out)
+    print(f" max osd.{int(count.argmax())} {int(count.max())}", file=out)
+    print(f"size {size_total // max(1, total)}\t{total}", file=out)
+    backends = ",".join(sorted(set(mapping.last_backend.values())))
+    print(f"mapped {total} pgs in {dt * 1000:.1f} ms "
+          f"(backend: {backends})", file=out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="osdmaptool")
+    p.add_argument("mapfn", nargs="?", help="osdmap file")
+    p.add_argument("--createsimple", type=int, metavar="N_OSDS")
+    p.add_argument("--pg-num", type=int, default=128)
+    p.add_argument("--test-map-pgs", action="store_true")
+    p.add_argument("--test-map-object", metavar="OBJ")
+    p.add_argument("--pool", type=int, default=-1)
+    p.add_argument("--upmap", metavar="OUTFILE",
+                   help="calculate pg upmaps and write the changes")
+    p.add_argument("--upmap-max", type=int, default=100)
+    p.add_argument("--upmap-deviation", type=float, default=0.01)
+    p.add_argument("--mark-up-in", action="store_true")
+    p.add_argument("--host-mapper", action="store_true")
+    p.add_argument("--print", dest="do_print", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.createsimple:
+        m = createsimple(args.createsimple, args.pg_num)
+        if args.mapfn:
+            with open(args.mapfn, "wb") as f:
+                pickle.dump(m, f)
+        print(f"osdmaptool: osdmap file '{args.mapfn}'")
+        print(f"osdmaptool: writing epoch {m.epoch} to {args.mapfn}")
+        return 0
+
+    if not args.mapfn:
+        p.print_help()
+        return 1
+    with open(args.mapfn, "rb") as f:
+        m = pickle.load(f)
+
+    if args.mark_up_in:
+        for o in range(m.max_osd):
+            m.set_osd(o, up=True, weight=CEPH_OSD_IN)
+
+    if args.do_print:
+        print(f"epoch {m.epoch}")
+        print(f"max_osd {m.max_osd}")
+        for pid in sorted(m.pools):
+            pool = m.pools[pid]
+            print(f"pool {pid} '{m.pool_name[pid]}' type {pool.type} "
+                  f"size {pool.size} pg_num {pool.pg_num} "
+                  f"crush_rule {pool.crush_rule}")
+
+    if args.test_map_object:
+        pid = args.pool if args.pool >= 0 else sorted(m.pools)[0]
+        pg = m.map_to_pg(pid, args.test_map_object)
+        pool = m.pools[pid]
+        from ..osdmap import ceph_stable_mod
+        ps = ceph_stable_mod(pg.ps, pool.pg_num, pool.pg_num_mask)
+        up, upp, acting, actp = m.pg_to_up_acting_osds(pg_t(pid, ps))
+        print(f" object '{args.test_map_object}' -> {pid}.{ps:x} -> "
+              f"up {up} acting {acting}")
+        return 0
+
+    if args.test_map_pgs:
+        test_map_pgs(m, not args.host_mapper, sys.stdout)
+        return 0
+
+    if args.upmap:
+        inc = Incremental(epoch=m.epoch + 1)
+        pools = [args.pool] if args.pool >= 0 else None
+        n = calc_pg_upmaps(m, args.upmap_deviation, args.upmap_max,
+                           pools, inc)
+        with open(args.upmap, "w") as f:
+            for pg, items in sorted(inc.new_pg_upmap_items.items(),
+                                    key=lambda kv: str(kv[0])):
+                pairs = " ".join(f"{a} {b}" for a, b in items)
+                f.write(f"ceph osd pg-upmap-items {pg} {pairs}\n")
+        print(f"wrote {n} upmap item changes to {args.upmap}")
+        return 0
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

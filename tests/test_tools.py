@@ -1,0 +1,210 @@
+"""Tools: crushtool compile/decompile/test, osdmaptool, ec_benchmark,
+balancer.
+
+Mirrors the reference's tool-level checks: text map round-trips
+(crushtool -c / -d), --test distribution sweeps, osdmaptool
+--test-map-pgs / --upmap, and the benchmark CLI's output contract.
+"""
+import io
+import pickle
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.compiler import CrushCompiler
+from ceph_tpu.crush.tester import CrushTester
+from ceph_tpu.tools import crushtool, ec_benchmark, osdmaptool
+
+MAP_TEXT = """
+# begin crush map
+tunable choose_local_tries 0
+tunable choose_local_fallback_tries 0
+tunable choose_total_tries 50
+tunable chooseleaf_descend_once 1
+tunable chooseleaf_vary_r 1
+tunable chooseleaf_stable 1
+
+# devices
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2
+device 3 osd.3
+device 4 osd.4
+device 5 osd.5
+
+# types
+type 0 osd
+type 1 host
+type 10 root
+
+# buckets
+host host0 {
+\tid -2
+\talg straw2
+\thash 0\t# rjenkins1
+\titem osd.0 weight 1.00000
+\titem osd.1 weight 1.00000
+}
+host host1 {
+\tid -3
+\talg straw2
+\thash 0
+\titem osd.2 weight 1.00000
+\titem osd.3 weight 1.00000
+}
+host host2 {
+\tid -4
+\talg straw2
+\thash 0
+\titem osd.4 weight 1.00000
+\titem osd.5 weight 1.00000
+}
+root default {
+\tid -1
+\talg straw2
+\thash 0
+\titem host0 weight 2.00000
+\titem host1 weight 2.00000
+\titem host2 weight 2.00000
+}
+
+# rules
+rule replicated_rule {
+\truleset 0
+\ttype replicated
+\tmin_size 1
+\tmax_size 10
+\tstep take default
+\tstep chooseleaf firstn 0 type host
+\tstep emit
+}
+# end crush map
+"""
+
+
+def test_compile_text_map():
+    cw = CrushCompiler().compile(MAP_TEXT)
+    assert cw.get_max_devices() == 6
+    assert cw.get_item_id("host1") == -3
+    rno = cw.get_rule_id("replicated_rule")
+    assert rno >= 0
+    out = cw.do_rule(rno, 7, 3, [0x10000] * 6)
+    assert len(out) == 3
+    assert len({o // 2 for o in out}) == 3  # one per host
+
+
+def test_decompile_recompile_same_mappings():
+    cw = CrushCompiler().compile(MAP_TEXT)
+    text = CrushCompiler(cw).decompile()
+    cw2 = CrushCompiler().compile(text)
+    rno = cw.get_rule_id("replicated_rule")
+    rno2 = cw2.get_rule_id("replicated_rule")
+    w = [0x10000] * 6
+    for x in range(200):
+        assert cw.do_rule(rno, x, 3, w) == cw2.do_rule(rno2, x, 3, w)
+
+
+def test_crush_tester_statistics():
+    cw = CrushCompiler().compile(MAP_TEXT)
+    buf = io.StringIO()
+    t = CrushTester(cw, out=buf)
+    t.set_num_rep(3)
+    t.set_min_x(0)
+    t.set_max_x(199)
+    t.set_output_statistics(True)
+    t.use_device = False
+    assert t.test() == 0
+    s = buf.getvalue()
+    assert "rule 0" in s
+    assert "result size == 3:\t200/200" in s
+    assert t.bad_mappings == 0
+
+
+def test_crush_tester_weights_zero_device():
+    cw = CrushCompiler().compile(MAP_TEXT)
+    buf = io.StringIO()
+    t = CrushTester(cw, out=buf)
+    t.set_num_rep(3)
+    t.set_max_x(99)
+    t.set_device_weight(0, 0.0)
+    t.use_device = False
+    t.set_output_mappings(True)
+    t.test()
+    assert " 0," not in buf.getvalue().replace("[0,", "[X,")
+
+
+def test_crushtool_cli_roundtrip(tmp_path):
+    src = tmp_path / "map.txt"
+    src.write_text(MAP_TEXT)
+    binf = tmp_path / "map.bin"
+    assert crushtool.main(["-c", str(src), "-o", str(binf)]) == 0
+    outf = tmp_path / "out.txt"
+    assert crushtool.main(["-d", str(binf), "-o", str(outf)]) == 0
+    assert "rule replicated_rule" in outf.read_text()
+    # --test runs clean on the host mapper
+    assert crushtool.main(["-i", str(binf), "--test", "--num-rep", "3",
+                           "--max-x", "63", "--show-statistics",
+                           "--host-mapper"]) == 0
+
+
+def test_osdmaptool_createsimple_and_test_map_pgs(tmp_path, capsys):
+    mf = tmp_path / "om"
+    assert osdmaptool.main(["--createsimple", "12", str(mf),
+                            "--pg-num", "64"]) == 0
+    assert osdmaptool.main([str(mf), "--test-map-pgs",
+                            "--host-mapper"]) == 0
+    out = capsys.readouterr().out
+    assert "mapped 64 pgs" in out
+    assert osdmaptool.main([str(mf), "--test-map-object", "foo"]) == 0
+    out = capsys.readouterr().out
+    assert "object 'foo'" in out
+
+
+def test_osdmaptool_upmap_balances(tmp_path, capsys):
+    mf = tmp_path / "om"
+    osdmaptool.main(["--createsimple", "16", str(mf), "--pg-num", "128"])
+    upf = tmp_path / "upmaps.sh"
+    assert osdmaptool.main([str(mf), "--upmap", str(upf),
+                            "--upmap-max", "32"]) == 0
+    out = capsys.readouterr().out
+    assert "upmap item changes" in out
+    text = upf.read_text()
+    # each line is a pg-upmap-items command
+    for line in text.splitlines():
+        assert line.startswith("ceph osd pg-upmap-items ")
+
+
+def test_balancer_reduces_spread():
+    m = osdmaptool.createsimple(16, pg_num=256)
+
+    def spread():
+        from ceph_tpu.osdmap import pg_t
+        count = np.zeros(m.max_osd)
+        for ps in range(256):
+            up, _ = m.pg_to_raw_up(pg_t(0, ps))
+            for o in up:
+                count[o] += 1
+        return count.max() - count.min()
+
+    before = spread()
+    from ceph_tpu.osdmap.balancer import calc_pg_upmaps
+    n = calc_pg_upmaps(m, max_iterations=64)
+    assert n > 0
+    after = spread()
+    assert after < before
+
+
+def test_ec_benchmark_encode_and_decode(capsys):
+    assert ec_benchmark.main(["-p", "isa", "-P", "k=4", "-P", "m=2",
+                              "-P", "backend=host", "-S", "65536",
+                              "-i", "3", "-w", "encode"]) == 0
+    out = capsys.readouterr().out.strip()
+    secs, kib = out.split("\t")
+    assert float(secs) > 0
+    assert int(kib) == 3 * 64
+    assert ec_benchmark.main(["-p", "isa", "-P", "k=4", "-P", "m=2",
+                              "-P", "backend=host", "-S", "16384",
+                              "-i", "5", "-w", "decode", "-e", "2"]) == 0
+    out = capsys.readouterr().out.strip()
+    secs, kib = out.split("\t")
+    assert int(kib) == 5 * 16
