@@ -21,9 +21,12 @@ mapper): straw2 buckets only (the modern default since hammer) and
 bobtail+ tunables (choose_local_tries == choose_local_fallback_tries == 0).
 Rules may chain TAKE / CHOOSE / CHOOSELEAF / SET_* / EMIT steps arbitrarily.
 
-64-bit note: the straw2 divide is exact s64 math, so importing this module
-enables jax x64 mode.  All ceph_tpu device code uses explicit dtypes and is
-unaffected by the changed defaults.
+64-bit note: the straw2 divide is exact u64 math, which requires jax x64
+mode *during tracing*.  Rather than flipping the global ``jax_enable_x64``
+flag at import (a surprising process-wide side effect), the public entry
+point (``DeviceCrushMapper.map_batch``) scopes it with the
+``jax.enable_x64`` context manager; module-level constants stay numpy so
+nothing 64-bit is materialized outside that scope.
 """
 from __future__ import annotations
 
@@ -32,9 +35,6 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 import jax
-
-jax.config.update("jax_enable_x64", True)
-
 import jax.numpy as jnp
 from jax import lax
 
@@ -51,12 +51,12 @@ from ..crush.ln import LL_NP, RH_LH_NP
 from ..crush.types import CrushMap
 
 MAX_DESCENT = 12  # > CRUSH_MAX_DEPTH (crush.h:26)
-_U64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
-_LN_BIAS = jnp.uint64(0x1000000000000)  # 2^48 (mapper.c:342)
+_U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
+_LN_BIAS = np.uint64(0x1000000000000)  # 2^48 (mapper.c:342)
 
-_SEED = jnp.uint32(1315423911)
-_PAD1 = jnp.uint32(231232)
-_PAD2 = jnp.uint32(1232)
+_SEED = np.uint32(1315423911)
+_PAD1 = np.uint32(231232)
+_PAD2 = np.uint32(1232)
 
 
 # ---- rjenkins in uint32 lanes (crush/hash.c) ------------------------------
@@ -102,12 +102,17 @@ def hash32_3(a, b, c):
 
 # ---- crush_ln LUT evaluation (mapper.c:243-290) ---------------------------
 
-_RH_LH = jnp.asarray(RH_LH_NP)   # uint64, indexed by index1-256
-_LL = jnp.asarray(LL_NP)         # uint64, 256 entries
+def _ln_tables():
+    """u64 log LUTs as jnp constants, created at use site so the uint64
+    conversion happens inside the caller's enable_x64 scope.  Deliberately
+    uncached: under a jit trace the result is a tracer that must not leak
+    into module state; XLA folds the constants per compiled program."""
+    return jnp.asarray(RH_LH_NP), jnp.asarray(LL_NP)
 
 
 def crush_ln_dev(u):
     """2^44*log2(u+1) fixed point; u: uint32 in [0, 0xffff]."""
+    _RH_LH, _LL = _ln_tables()
     x = (u + jnp.uint32(1)).astype(jnp.uint32)
     blen = jnp.uint32(32) - lax.clz(x & jnp.uint32(0x1FFFF))
     need = (x & jnp.uint32(0x18000)) == 0
@@ -221,6 +226,12 @@ def _descend(C: CompiledCrushMap, item, x, r, position, target_type):
     Mirrors the itemtype-mismatch descent in both choosers (mapper.c:498-520,
     :691-713): r is constant during the walk.  Returns (item, status) with
     status _DEAD for a wrong-type dead end and _EMPTY for an empty bucket.
+
+    Do-while semantics: the reference always draws one item from the
+    starting bucket before any type test (crush_bucket_choose precedes the
+    itemtype check, mapper.c:487-498), so a choose step whose target type
+    equals the take bucket's own type still descends one level rather than
+    returning the take bucket itself.
     """
     def itype(it):
         return jnp.where(it >= 0, 0, C.types[jnp.maximum(-1 - it, 0)])
@@ -240,8 +251,8 @@ def _descend(C: CompiledCrushMap, item, x, r, position, target_type):
         status2 = jnp.where(dead, _DEAD, jnp.where(empty, _EMPTY, status))
         return it2, status2, depth + 1
 
-    it, status, depth = lax.while_loop(
-        cond, body, (item, jnp.int32(_OK), jnp.int32(0)))
+    first = body((item, jnp.int32(_OK), jnp.int32(0)))
+    it, status, depth = lax.while_loop(cond, body, first)
     status = jnp.where((status == _OK) & (itype(it) != target_type),
                        _DEAD, status)
     return it, status
@@ -521,9 +532,10 @@ class DeviceCrushMapper:
 
     def map_batch(self, xs: np.ndarray, weight: np.ndarray):
         """Map all xs; returns (results [X, result_max] int32, counts [X])."""
-        xs = jnp.asarray(np.asarray(xs, dtype=np.uint32))
-        w = jnp.asarray(np.asarray(weight, dtype=np.uint32))
-        res, cnt = self._fn(xs, w)
+        with jax.enable_x64(True):
+            xs = jnp.asarray(np.asarray(xs, dtype=np.uint32))
+            w = jnp.asarray(np.asarray(weight, dtype=np.uint32))
+            res, cnt = self._fn(xs, w)
         return res, cnt
 
 

@@ -179,7 +179,7 @@ class OSDMap:
         pg = pool.raw_pg_to_pg(raw_pg)
         p = self.pg_upmap.get(pg)
         if p is not None:
-            if any(o != CRUSH_ITEM_NONE and o < self.max_osd
+            if any(o != CRUSH_ITEM_NONE and 0 <= o < self.max_osd
                    and self.osd_weight[o] == 0 for o in p):
                 # an explicit target is marked out: ignore the whole
                 # override, including any pg_upmap_items (OSDMap.cc:1971)
@@ -196,7 +196,7 @@ class OSDMap:
                         break
                     if (o == frm and pos < 0
                             and not (to != CRUSH_ITEM_NONE
-                                     and to < self.max_osd
+                                     and 0 <= to < self.max_osd
                                      and self.osd_weight[to] == 0)):
                         pos = i
                 if not exists and pos >= 0:

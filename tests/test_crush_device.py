@@ -186,3 +186,16 @@ def test_rejects_legacy_tunables():
     cw.set_tunables_profile("argonaut")
     with pytest.raises(ValueError):
         compile_map(cw.crush)
+
+
+def test_choose_take_buckets_own_type():
+    """A choose step targeting the take bucket's own type must still draw
+    from the bucket (do-while semantics, mapper.c:487-498), not return the
+    take bucket itself."""
+    cw, n = build_map(n_hosts=4, osds_per_host=3)
+    steps = [RuleStep(CRUSH_RULE_TAKE, -1, 0),
+             RuleStep(CRUSH_RULE_CHOOSE_FIRSTN, 2, 10),  # type 10 == root
+             RuleStep(CRUSH_RULE_EMIT, 0, 0)]
+    rno = cw.add_rule(Rule(steps=steps, ruleset=1, type=1,
+                           min_size=1, max_size=10), "degenerate")
+    assert_parity(cw, rno, 2, [0x10000] * n, n_x=64)
