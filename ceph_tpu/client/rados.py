@@ -13,9 +13,9 @@ import struct
 from typing import Dict, Optional
 
 from ..msg import (
-    CEPH_OSD_OP_DELETE, CEPH_OSD_OP_READ, CEPH_OSD_OP_STAT,
-    CEPH_OSD_OP_WRITE, Dispatcher, MOSDMap, MOSDOp, MOSDOpReply, Message,
-    Network,
+    CEPH_OSD_OP_APPEND, CEPH_OSD_OP_DELETE, CEPH_OSD_OP_READ,
+    CEPH_OSD_OP_STAT, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_WRITEFULL,
+    Dispatcher, MOSDMap, MOSDOp, MOSDOpReply, Message, Network,
 )
 from ..msg.messages import new_trace_id
 from ..osdmap import OSDMap, ceph_stable_mod, pg_t
@@ -55,15 +55,16 @@ class RadosClient(Dispatcher):
         *_, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
         return (pool_id, ps), primary
 
-    def _submit(self, pool_id: int, oid: str, op: str, data: bytes = b""
-                ) -> MOSDOpReply:
+    def _submit(self, pool_id: int, oid: str, op: str, data: bytes = b"",
+                offset: int = 0, length: int = 0) -> MOSDOpReply:
         for attempt in range(MAX_ATTEMPTS):
             pgid, primary = self._calc_target(pool_id, oid)
             self._tid += 1
             tid = self._tid
             if primary >= 0:
                 msg = MOSDOp(tid=tid, pool=pool_id, oid=oid, pgid=pgid,
-                             op=op, data=data, epoch=self.osdmap.epoch,
+                             op=op, data=data, offset=offset,
+                             length=length, epoch=self.osdmap.epoch,
                              trace_id=new_trace_id())
                 self.messenger.send_message(msg, f"osd.{primary}")
                 self.network.pump()
@@ -84,12 +85,26 @@ class RadosClient(Dispatcher):
 
     # ---- public API (librados verbs) --------------------------------------
     def write_full(self, pool: str, oid: str, data: bytes) -> int:
+        r = self._submit(self.lookup_pool(pool), oid,
+                         CEPH_OSD_OP_WRITEFULL, bytes(data))
+        return r.result
+
+    def write(self, pool: str, oid: str, data: bytes, offset: int) -> int:
+        """Offset write (librados rados_write): rmw on EC pools."""
         r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_WRITE,
+                         bytes(data), offset=offset)
+        return r.result
+
+    def append(self, pool: str, oid: str, data: bytes) -> int:
+        """Append at the current object size (rados_append)."""
+        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_APPEND,
                          bytes(data))
         return r.result
 
-    def read(self, pool: str, oid: str) -> bytes:
-        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_READ)
+    def read(self, pool: str, oid: str, offset: int = 0,
+             length: int = 0) -> bytes:
+        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_READ,
+                         offset=offset, length=length)
         if r.result < 0:
             raise IOError(f"read {oid}: {r.result}")
         return r.data

@@ -1,8 +1,8 @@
 from .messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDMap, MOSDOp, MOSDOpReply, MOSDPing, Message,
-    MOSDFailure, CEPH_OSD_OP_READ, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_DELETE,
-    CEPH_OSD_OP_STAT,
+    MOSDFailure, CEPH_OSD_OP_READ, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_WRITEFULL,
+    CEPH_OSD_OP_APPEND, CEPH_OSD_OP_DELETE, CEPH_OSD_OP_STAT,
 )
 from .messenger import Connection, Dispatcher, Messenger, Network
 
@@ -10,6 +10,7 @@ __all__ = [
     "MOSDECSubOpRead", "MOSDECSubOpReadReply", "MOSDECSubOpWrite",
     "MOSDECSubOpWriteReply", "MOSDMap", "MOSDOp", "MOSDOpReply", "MOSDPing",
     "Message", "MOSDFailure", "Connection", "Dispatcher", "Messenger",
-    "Network", "CEPH_OSD_OP_READ", "CEPH_OSD_OP_WRITE", "CEPH_OSD_OP_DELETE",
+    "Network", "CEPH_OSD_OP_READ", "CEPH_OSD_OP_WRITE",
+    "CEPH_OSD_OP_WRITEFULL", "CEPH_OSD_OP_APPEND", "CEPH_OSD_OP_DELETE",
     "CEPH_OSD_OP_STAT",
 ]

@@ -29,9 +29,12 @@ class Message:
         return type(self).__name__
 
 
-# client op codes (subset of the do_osd_ops interpreter's)
-CEPH_OSD_OP_READ = "read"
-CEPH_OSD_OP_WRITE = "write"          # write-full for the EC pool path
+# client op codes (subset of the do_osd_ops interpreter's,
+# src/osd/PrimaryLogPG.cc do_osd_ops: CEPH_OSD_OP_{READ,WRITE,WRITEFULL,...})
+CEPH_OSD_OP_READ = "read"            # ranged read (offset/length)
+CEPH_OSD_OP_WRITE = "write"          # offset write (rmw on EC pools)
+CEPH_OSD_OP_WRITEFULL = "writefull"  # whole-object replace
+CEPH_OSD_OP_APPEND = "append"        # write at current object size
 CEPH_OSD_OP_DELETE = "delete"
 CEPH_OSD_OP_STAT = "stat"
 
@@ -67,7 +70,8 @@ class MOSDECSubOpWrite(Message):
     shard: int = 0
     oid: str = ""
     chunk: bytes = b""
-    offset: int = 0
+    offset: int = 0          # chunk-granularity offset into the shard
+    partial: bool = False    # False = whole-shard replace; True = rmw splice
     hash_epoch: int = 0
     at_version: int = 0
     trim_to: int = 0
@@ -88,8 +92,9 @@ class MOSDECSubOpRead(Message):
     pgid: Tuple[int, int] = (0, 0)
     shard: int = 0
     oid: str = ""
-    offset: int = 0
-    length: int = 0
+    offset: int = 0          # chunk-granularity offset into the shard
+    length: int = 0          # 0 = to end of shard
+    attrs_only: bool = False  # stat/size probe: no payload wanted
     subchunks: List[Tuple[int, int]] = field(default_factory=list)
 
 

@@ -2,13 +2,22 @@
 
 Mirrors the reference pipeline shapes (src/osd/ECBackend.{h,cc}):
 
-- writes: submit_transaction → encode all stripes in ONE batched device
-  call (ECUtil/encode over (S, k, C), replacing the per-stripe CPU loop at
-  ECUtil.cc:136-148) → MOSDECSubOpWrite to every shard → all_commit ack
-  (ECBackend.cc:1459,1793-2101).
+- full writes: submit_transaction → encode all stripes in ONE batched
+  device call (ECUtil/encode over (S, k, C), replacing the per-stripe CPU
+  loop at ECUtil.cc:136-148) → MOSDECSubOpWrite to every shard →
+  all_commit ack (ECBackend.cc:1459,1793-2101).
+- partial writes (rmw): submit_write runs the read-modify-write pipeline
+  (start_rmw → try_state_to_reads → try_reads_to_commit,
+  ECBackend.cc:1793,1819,1894): read the affected stripe range from the
+  cheapest shard set (reconstructing if degraded), splice the new bytes,
+  re-encode the whole affected range in one batched device call, and fan
+  chunk-granularity deltas to every shard.  Per-object ops are pipelined
+  through an ExtentCache (ExtentCache.h:23) so queued overlapping writes
+  read projected extents instead of re-fetching shards.
 - reads: objects_read_and_reconstruct consults the plugin's
   minimum_to_decode, fans MOSDECSubOpRead to the cheapest shard set, and
   reconstructs via the batched decode (ECBackend.cc:1580-1669,986,1141).
+  Ranged reads fetch only the covering chunk range.
 - recovery: RecoveryOp reads k available shards, decodes the missing
   shard's chunks, and pushes them to the replacement OSD
   (ECBackend.cc:535-743).
@@ -21,8 +30,9 @@ ECBackend.cc:1022-1066).
 from __future__ import annotations
 
 import struct
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -39,24 +49,109 @@ SIZE_ATTR = "_size"          # logical object size (un-padded)
 HINFO_ATTR = "hinfo_key"     # reference's hinfo xattr name
 
 
+class ExtentCache:
+    """Projected in-flight object extents (src/osd/ExtentCache.h:23).
+
+    While a per-object write pipeline is non-empty, the logical bytes each
+    op produced are cached here so the next queued op's rmw pre-read hits
+    memory instead of re-fetching shards.  Extents are stripe-range bytes
+    (already padded); the map is trimmed when the object's pipeline drains.
+    """
+
+    def __init__(self):
+        self._extents: Dict[str, List[Tuple[int, bytearray]]] = {}
+        self._sizes: Dict[str, int] = {}
+
+    def projected_size(self, oid: str) -> Optional[int]:
+        return self._sizes.get(oid)
+
+    def write(self, oid: str, offset: int, data: bytes,
+              new_size: int) -> None:
+        """Merge [offset, offset+len) into the extent list (sorted,
+        non-overlapping, coalesced)."""
+        runs = self._extents.setdefault(oid, [])
+        new = (offset, bytearray(data))
+        merged: List[Tuple[int, bytearray]] = []
+        for off, buf in runs:
+            if off + len(buf) < new[0] or new[0] + len(new[1]) < off:
+                merged.append((off, buf))
+                continue
+            # overlap/adjacent: splice the older run around the new bytes
+            lo = min(off, new[0])
+            hi = max(off + len(buf), new[0] + len(new[1]))
+            combined = bytearray(hi - lo)
+            combined[off - lo:off - lo + len(buf)] = buf
+            combined[new[0] - lo:new[0] - lo + len(new[1])] = new[1]
+            new = (lo, combined)
+        merged.append(new)
+        merged.sort(key=lambda r: r[0])
+        self._extents[oid] = merged
+        self._sizes[oid] = new_size
+
+    def read(self, oid: str, offset: int, length: int) -> Optional[bytes]:
+        """The cached bytes for [offset, offset+length) iff fully covered."""
+        for off, buf in self._extents.get(oid, []):
+            if off <= offset and offset + length <= off + len(buf):
+                return bytes(buf[offset - off:offset - off + length])
+        return None
+
+    def replace(self, oid: str, data: bytes, size: int) -> None:
+        """Whole-object overwrite: drop stale extents, cache the new body."""
+        self._extents[oid] = [(0, bytearray(data))]
+        self._sizes[oid] = size
+
+    def clear(self, oid: str) -> None:
+        self._extents.pop(oid, None)
+        self._sizes.pop(oid, None)
+
+
 @dataclass
 class InflightWrite:
     tid: int
     oid: str
     client_reply: Callable[[int], None]
     pending_shards: Set[int] = field(default_factory=set)
+    on_all_commit: Optional[Callable[[], None]] = None
 
 
 @dataclass
 class InflightRead:
+    """One fan-out read round over a chunk range.
+
+    ``on_done(result, data, size)``: data = decoded logical bytes for the
+    stripe range covering [chunk_off, chunk_off+chunk_len) (padded), size =
+    the object's logical size from shard attrs (-1 if unknown).
+    """
     tid: int
     oid: str
-    want: List[int]
-    on_complete: Callable[[int, bytes], None]
-    length: int = 0
+    on_done: Callable[[int, bytes, int], None]
+    chunk_off: int = 0
+    chunk_len: int = 0            # 0 = to end of shard
+    attrs_only: bool = False
+    size: int = -1
     chunks: Dict[int, bytes] = field(default_factory=dict)
     pending: Set[int] = field(default_factory=set)
     failed: Set[int] = field(default_factory=set)
+    seen: int = 0                 # shards that answered at all
+
+
+@dataclass
+class RMWOp:
+    """One queued partial write (start_rmw state, ECBackend.h:467)."""
+    tid: int
+    oid: str
+    data: bytes
+    offset: Optional[int]         # None = append at current size
+    on_commit: Callable[[int], None]
+    old_size: int = -1
+
+
+@dataclass
+class FullWriteOp:
+    tid: int
+    oid: str
+    data: bytes
+    on_commit: Callable[[int], None]
 
 
 class ECBackend:
@@ -71,6 +166,8 @@ class ECBackend:
         self.n = ec_impl.get_chunk_count()
         self.inflight_writes: Dict[int, InflightWrite] = {}
         self.inflight_reads: Dict[int, InflightRead] = {}
+        self.extent_cache = ExtentCache()
+        self._oid_queues: Dict[str, Deque] = {}
         self._tid = 0
 
     # ---- helpers ----------------------------------------------------------
@@ -89,40 +186,199 @@ class ECBackend:
         rem = len(data) % w
         return data if not rem else data + b"\0" * (w - rem)
 
+    # ---- per-object write pipeline ----------------------------------------
+    def _enqueue(self, oid: str, op) -> None:
+        q = self._oid_queues.setdefault(oid, deque())
+        q.append(op)
+        if len(q) == 1:
+            self._start_op(op)
+
+    def _op_done(self, oid: str) -> None:
+        q = self._oid_queues.get(oid)
+        if not q:
+            return
+        q.popleft()
+        if q:
+            self._start_op(q[0])
+        else:
+            del self._oid_queues[oid]
+            self.extent_cache.clear(oid)
+
+    def _start_op(self, op) -> None:
+        if isinstance(op, FullWriteOp):
+            self._start_full_write(op)
+        else:
+            self._start_rmw(op)
+
     # ---- write path (primary) --------------------------------------------
     def submit_transaction(self, oid: str, data: bytes,
                            on_commit: Callable[[int], None]) -> int:
         """Full-object EC write: one batched encode, fan out shards."""
         tid = self.next_tid()
-        padded = self._pad(data)
+        self._enqueue(oid, FullWriteOp(tid=tid, oid=oid, data=bytes(data),
+                                       on_commit=on_commit))
+        return tid
+
+    def submit_write(self, oid: str, data: bytes, offset: Optional[int],
+                     on_commit: Callable[[int], None]) -> int:
+        """Partial write (offset) or append (offset=None): rmw pipeline."""
+        tid = self.next_tid()
+        self._enqueue(oid, RMWOp(tid=tid, oid=oid, data=bytes(data),
+                                 offset=offset, on_commit=on_commit))
+        return tid
+
+    def _start_full_write(self, op: FullWriteOp) -> None:
+        padded = self._pad(op.data)
         shards = ec_encode(self.sinfo, self.ec_impl, padded,
                            set(range(self.n)))
-        op = InflightWrite(tid=tid, oid=oid, client_reply=on_commit)
+
+        def all_commit() -> None:
+            self.extent_cache.replace(op.oid, padded, len(op.data))
+            op.on_commit(0)
+            self._op_done(op.oid)
+
+        self._fan_out_shards(op.tid, op.oid, shards, chunk_off=0,
+                             partial=False, new_size=len(op.data),
+                             on_all_commit=all_commit,
+                             client_reply=op.on_commit)
+
+    # ---- rmw pipeline (start_rmw, ECBackend.cc:1793) -----------------------
+    def _start_rmw(self, op: RMWOp) -> None:
+        # 1. learn the object's current (projected) size
+        projected = self.extent_cache.projected_size(op.oid)
+        if projected is not None:
+            self._rmw_have_size(op, projected)
+            return
+        local = self._local_size(op.oid)
+        if local is not None:
+            self._rmw_have_size(op, local)
+            return
+        # degraded primary without its own shard: probe attrs over the wire
+        self._start_read(op.oid, 0, 0, True,
+                         lambda res, _d, size: self._rmw_have_size(
+                             op, max(size, 0) if res in (0, -2) else res,
+                             err=res not in (0, -2)))
+
+    def _local_size(self, oid: str) -> Optional[int]:
+        """Size from the primary's own shard; None = ask over the wire
+        (a fresh primary may not hold its shard yet)."""
+        my_shard = self.pg.my_shard()
+        if my_shard < 0:
+            return None
+        store = self.pg.osd.store
+        cid = self.shard_cid(my_shard)
+        ho = hobject_t(oid, my_shard)
+        if not store.collection_exists(cid) or not store.exists(cid, ho):
+            return None
+        try:
+            return struct.unpack("<Q", store.getattr(cid, ho, SIZE_ATTR))[0]
+        except KeyError:
+            return store.stat(cid, ho) * self.k
+
+    def _rmw_have_size(self, op: RMWOp, old_size: int,
+                       err: bool = False) -> None:
+        if err:
+            op.on_commit(old_size)  # old_size carries the errno here
+            self._op_done(op.oid)
+            return
+        op.old_size = old_size
+        offset = old_size if op.offset is None else op.offset
+        op.offset = offset
+        w = self.sinfo.get_stripe_width()
+        a0 = self.sinfo.logical_to_prev_stripe_offset(offset)
+        a1 = self.sinfo.logical_to_next_stripe_offset(offset + len(op.data))
+        old_aligned = self.sinfo.logical_to_next_stripe_offset(old_size)
+        read_end = min(a1, old_aligned)
+        if read_end <= a0:
+            self._rmw_have_old(op, a0, a1, b"")
+            return
+        cached = self.extent_cache.read(op.oid, a0, read_end - a0)
+        if cached is not None:
+            self._rmw_have_old(op, a0, a1, cached)
+            return
+        c0 = self.sinfo.aligned_logical_offset_to_chunk_offset(a0)
+        c1 = self.sinfo.aligned_logical_offset_to_chunk_offset(read_end)
+        self._start_read(
+            op.oid, c0, c1 - c0, False,
+            lambda res, data, _size: (
+                self._rmw_have_old(op, a0, a1, data) if res == 0 or
+                (res == -2 and old_size == 0)
+                else (op.on_commit(res), self._op_done(op.oid))))
+
+    def _rmw_have_old(self, op: RMWOp, a0: int, a1: int,
+                      old_bytes: bytes) -> None:
+        """Splice + re-encode the affected range in one device call, then
+        fan chunk deltas (try_reads_to_commit, ECBackend.cc:1894)."""
+        buf = bytearray(a1 - a0)
+        buf[:len(old_bytes)] = old_bytes
+        rel = op.offset - a0
+        buf[rel:rel + len(op.data)] = op.data
+        shards = ec_encode(self.sinfo, self.ec_impl, bytes(buf),
+                           set(range(self.n)))
+        new_size = max(op.old_size, op.offset + len(op.data))
+        c0 = self.sinfo.aligned_logical_offset_to_chunk_offset(a0)
+
+        def all_commit() -> None:
+            self.extent_cache.write(op.oid, a0, bytes(buf), new_size)
+            op.on_commit(0)
+            self._op_done(op.oid)
+
+        self._fan_out_shards(op.tid, op.oid, shards, chunk_off=c0,
+                             partial=True, new_size=new_size,
+                             on_all_commit=all_commit,
+                             client_reply=op.on_commit)
+
+    def _fan_out_shards(self, tid: int, oid: str,
+                        shards: Dict[int, np.ndarray], chunk_off: int,
+                        partial: bool, new_size: int,
+                        on_all_commit: Callable[[], None],
+                        client_reply: Callable[[int], None]) -> None:
+        wr = InflightWrite(tid=tid, oid=oid, client_reply=client_reply,
+                           on_all_commit=on_all_commit)
         acting = self.pg.acting_shards()
         for shard, osd in acting.items():
             chunk = shards[shard].tobytes() if shard in shards else b""
             msg = MOSDECSubOpWrite(
                 tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
-                chunk=chunk, offset=0, at_version=len(data))
-            op.pending_shards.add(shard)
+                chunk=chunk, offset=chunk_off, partial=partial,
+                at_version=new_size)
+            wr.pending_shards.add(shard)
             self.pg.send_to_osd(osd, msg)
-        self.inflight_writes[tid] = op
-        return tid
+        self.inflight_writes[tid] = wr
 
     def handle_sub_write(self, msg: MOSDECSubOpWrite, store: MemStore
                          ) -> MOSDECSubOpWriteReply:
         """Shard-side apply (ECBackend.cc:921-983): one transaction with
-        chunk data, size attr, and the updated HashInfo."""
+        chunk data, size attr, and the updated HashInfo.
+
+        Full writes replace the shard; partial (rmw) writes splice the
+        chunk range and recompute the shard crc over the spliced body —
+        the reference similarly rewrites hinfo on overwrite
+        (ECTransaction.cc generate_transactions hinfo updates).
+        """
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}s{msg.shard}"
         t = Transaction()
         if not store.collection_exists(cid):
             t.create_collection(cid)
         ho = hobject_t(msg.oid, msg.shard)
-        t.truncate(cid, ho, 0)
-        t.write(cid, ho, msg.offset, msg.chunk)
+        if not msg.partial:
+            t.truncate(cid, ho, 0)
+            t.write(cid, ho, 0, msg.chunk)
+            body = msg.chunk
+        else:
+            existing = store.read(cid, ho) \
+                if store.collection_exists(cid) and store.exists(cid, ho) \
+                else b""
+            spliced = bytearray(max(len(existing),
+                                    msg.offset + len(msg.chunk)))
+            spliced[:len(existing)] = existing
+            spliced[msg.offset:msg.offset + len(msg.chunk)] = msg.chunk
+            t.truncate(cid, ho, 0)
+            t.write(cid, ho, 0, bytes(spliced))
+            body = bytes(spliced)
         t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", msg.at_version))
         hi = HashInfo(1)
-        hi.append(0, {0: np.frombuffer(msg.chunk, dtype=np.uint8)})
+        hi.append(0, {0: np.frombuffer(body, dtype=np.uint8)})
         t.setattr(cid, ho, HINFO_ATTR,
                   struct.pack("<QI", hi.total_chunk_size,
                               hi.get_chunk_hash(0)))
@@ -131,36 +387,83 @@ class ECBackend:
                                      shard=msg.shard, committed=True)
 
     def handle_sub_write_reply(self, msg: MOSDECSubOpWriteReply) -> None:
-        op = self.inflight_writes.get(msg.tid)
-        if op is None:
+        wr = self.inflight_writes.get(msg.tid)
+        if wr is None:
             return
-        op.pending_shards.discard(msg.shard)
-        if not op.pending_shards:
+        wr.pending_shards.discard(msg.shard)
+        if not wr.pending_shards:
             del self.inflight_writes[msg.tid]
-            op.client_reply(0)
+            if wr.on_all_commit is not None:
+                wr.on_all_commit()
+            else:
+                wr.client_reply(0)
 
     # ---- read path (primary) ---------------------------------------------
     def objects_read_and_reconstruct(
-            self, oid: str, on_complete: Callable[[int, bytes], None]
-    ) -> int:
-        """Route the cheapest shard set through minimum_to_decode and fan
-        out reads (ECBackend.cc:1580-1669)."""
+            self, oid: str, on_complete: Callable[[int, bytes], None],
+            offset: int = 0, length: int = 0) -> int:
+        """Client-facing (ranged) read: decode the covering chunk range,
+        slice, trim to logical size (ECBackend.cc:1580-1669)."""
+        if length == 0:
+            c0, c1 = 0, 0
+        else:
+            a0 = self.sinfo.logical_to_prev_stripe_offset(offset)
+            a1 = self.sinfo.logical_to_next_stripe_offset(offset + length)
+            c0 = self.sinfo.aligned_logical_offset_to_chunk_offset(a0)
+            c1 = self.sinfo.aligned_logical_offset_to_chunk_offset(a1)
+
+        def done(result: int, data: bytes, size: int) -> None:
+            if result != 0:
+                on_complete(result, b"")
+                return
+            if length == 0:
+                body = data[:size] if size >= 0 else data
+                on_complete(0, body[offset:])
+                return
+            a0 = self.sinfo.logical_to_prev_stripe_offset(offset)
+            end = min(offset + length, size) if size >= 0 \
+                else offset + length
+            if end <= offset:
+                on_complete(0, b"")
+                return
+            on_complete(0, data[offset - a0:end - a0])
+
+        return self._start_read(oid, c0, max(0, c1 - c0), False, done)
+
+    def _start_read(self, oid: str, chunk_off: int, chunk_len: int,
+                    attrs_only: bool,
+                    on_done: Callable[[int, bytes, int], None]) -> int:
+        """Fan MOSDECSubOpRead for a chunk range to the cheapest shard set."""
         tid = self.next_tid()
         acting = self.pg.acting_shards()
         avail = set(acting)
+        rd = InflightRead(tid=tid, oid=oid, on_done=on_done,
+                          chunk_off=chunk_off, chunk_len=chunk_len,
+                          attrs_only=attrs_only)
+        if attrs_only:
+            # any single shard knows the size attr
+            if not acting:
+                on_done(-5, b"", -1)
+                return tid
+            shard = min(acting)
+            rd.pending.add(shard)
+            self.inflight_reads[tid] = rd
+            self.pg.send_to_osd(acting[shard], MOSDECSubOpRead(
+                tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
+                attrs_only=True))
+            return tid
         # want the *physical* positions of the data chunks (chunk_mapping
         # remaps logical->physical for lrc/shec layouts)
         want = {self.ec_impl.chunk_index(i) for i in range(self.k)}
         try:
             minimum = self.ec_impl.minimum_to_decode(want, avail)
         except IOError:
-            on_complete(-5, b"")  # EIO: not enough shards
+            on_done(-5, b"", -1)  # EIO: not enough shards
             return tid
-        rd = InflightRead(tid=tid, oid=oid, want=sorted(want),
-                          on_complete=on_complete)
         for shard in minimum:
             msg = MOSDECSubOpRead(tid=tid, pgid=self.pg.pgid, shard=shard,
-                                  oid=oid, offset=0, length=0,
+                                  oid=oid, offset=chunk_off,
+                                  length=chunk_len,
                                   subchunks=list(minimum[shard]))
             rd.pending.add(shard)
             self.pg.send_to_osd(acting[shard], msg)
@@ -169,7 +472,11 @@ class ECBackend:
 
     def handle_sub_read(self, msg: MOSDECSubOpRead, store: MemStore
                         ) -> MOSDECSubOpReadReply:
-        """Shard-side read + crc check (ECBackend.cc:986-1066)."""
+        """Shard-side read + crc check (ECBackend.cc:986-1066).
+
+        The crc always covers the whole stored shard (hinfo is cumulative,
+        ECUtil.cc:161-207), so ranged reads verify the full body before
+        slicing out [offset, offset+length)."""
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}s{msg.shard}"
         ho = hobject_t(msg.oid, msg.shard)
         if not store.collection_exists(cid) or not store.exists(cid, ho):
@@ -186,6 +493,11 @@ class ECBackend:
                 return MOSDECSubOpReadReply(tid=msg.tid, pgid=msg.pgid,
                                             shard=msg.shard, oid=msg.oid,
                                             result=-5)
+        if msg.attrs_only:
+            data = b""
+        elif msg.offset or msg.length:
+            end = msg.offset + msg.length if msg.length else len(data)
+            data = data[msg.offset:end]
         return MOSDECSubOpReadReply(tid=msg.tid, pgid=msg.pgid,
                                     shard=msg.shard, oid=msg.oid,
                                     data=data, attrs=attrs, result=0)
@@ -197,36 +509,53 @@ class ECBackend:
         if rd is None:
             return
         rd.pending.discard(msg.shard)
+        rd.seen += 1
         if msg.result == 0:
             rd.chunks[msg.shard] = msg.data
             sz = msg.attrs.get(SIZE_ATTR)
             if sz is not None:
-                rd.length = struct.unpack("<Q", sz)[0]
+                rd.size = struct.unpack("<Q", sz)[0]
         else:
             rd.failed.add(msg.shard)
-            # retry with reconstruction from any other shards
+            # retry with reconstruction from any other shards (same range)
             acting = self.pg.acting_shards()
             others = (set(acting) - set(rd.chunks) - rd.failed
                       - rd.pending)
             for shard in others:
                 m2 = MOSDECSubOpRead(tid=rd.tid, pgid=self.pg.pgid,
-                                     shard=shard, oid=rd.oid)
+                                     shard=shard, oid=rd.oid,
+                                     offset=rd.chunk_off,
+                                     length=rd.chunk_len,
+                                     attrs_only=rd.attrs_only)
                 rd.pending.add(shard)
                 self.pg.send_to_osd(acting[shard], m2)
         if rd.pending:
             return
         del self.inflight_reads[msg.tid]
+        if rd.attrs_only:
+            if rd.size >= 0:
+                rd.on_done(0, b"", rd.size)
+            elif rd.failed and not rd.chunks:
+                # every shard answered ENOENT/error; distinguish pure ENOENT
+                rd.on_done(-2, b"", 0)
+            else:
+                rd.on_done(-5, b"", -1)
+            return
+        if not rd.chunks and rd.failed:
+            # all shards report no object
+            rd.on_done(-2, b"", 0)
+            return
         if len(rd.chunks) < self.k:
-            rd.on_complete(-5, b"")
+            rd.on_done(-5, b"", rd.size)
             return
         arrays = {i: np.frombuffer(b, dtype=np.uint8)
                   for i, b in rd.chunks.items()}
         try:
             data = ec_decode_concat(self.sinfo, self.ec_impl, arrays)
         except IOError:
-            rd.on_complete(-5, b"")
+            rd.on_done(-5, b"", rd.size)
             return
-        rd.on_complete(0, data.tobytes()[:rd.length])
+        rd.on_done(0, data.tobytes(), rd.size)
 
     # ---- recovery (ECBackend.cc:535-743) ----------------------------------
     def recover_object(self, oid: str, missing_shards: Set[int],

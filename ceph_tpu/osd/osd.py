@@ -144,7 +144,8 @@ class OSD(Dispatcher):
     # ---- client ops -------------------------------------------------------
     def _handle_op(self, msg: MOSDOp) -> None:
         self.perf_counters.inc(
-            L_OSD_OP_W if msg.op == "write" else L_OSD_OP_R)
+            L_OSD_OP_W if msg.op in ("write", "writefull", "append",
+                                     "delete") else L_OSD_OP_R)
         op = self.op_tracker.create_request(
             msg.trace_id, f"osd_op({msg.op} {msg.pool}/{msg.oid})")
         op.mark_event("queued_for_pg")

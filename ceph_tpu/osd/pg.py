@@ -15,8 +15,9 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..crush.constants import CRUSH_ITEM_NONE
 from ..msg import (
-    CEPH_OSD_OP_DELETE, CEPH_OSD_OP_READ, CEPH_OSD_OP_STAT,
-    CEPH_OSD_OP_WRITE, MOSDOp, MOSDOpReply, Message,
+    CEPH_OSD_OP_APPEND, CEPH_OSD_OP_DELETE, CEPH_OSD_OP_READ,
+    CEPH_OSD_OP_STAT, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_WRITEFULL,
+    MOSDOp, MOSDOpReply, Message,
 )
 from ..os_store import Transaction, hobject_t
 from .ec_backend import ECBackend, SIZE_ATTR
@@ -37,14 +38,27 @@ class ReplicatedBackend:
     def cid(self) -> str:
         return f"{self.pg.pgid[0]}.{self.pg.pgid[1]}"
 
-    def write(self, oid: str, data: bytes) -> None:
+    def write(self, oid: str, data: bytes, offset: Optional[int] = None,
+              full: bool = False) -> None:
+        """full=True replaces the object; otherwise an offset write
+        (offset=None appends at the current size, read from the primary's
+        own full copy)."""
         from ..msg.messages import MOSDECSubOpWrite
+        if full:
+            off, partial = 0, False
+            new_size = len(data)
+        else:
+            old = self.read(oid)
+            old_size = len(old) if old is not None else 0
+            off = old_size if offset is None else offset
+            partial = True
+            new_size = max(old_size, off + len(data))
         for osd in self.pg.acting:
             if osd == CRUSH_ITEM_NONE:
                 continue
             msg = MOSDECSubOpWrite(tid=0, pgid=self.pg.pgid, shard=-1,
-                                   oid=oid, chunk=data,
-                                   at_version=len(data))
+                                   oid=oid, chunk=data, offset=off,
+                                   partial=partial, at_version=new_size)
             self.pg.send_to_osd(osd, msg)
 
     def apply_write(self, msg, store) -> None:
@@ -53,8 +67,9 @@ class ReplicatedBackend:
         if not store.collection_exists(cid):
             t.create_collection(cid)
         ho = hobject_t(msg.oid)
-        t.truncate(cid, ho, 0)
-        t.write(cid, ho, 0, msg.chunk)
+        if not msg.partial:
+            t.truncate(cid, ho, 0)
+        t.write(cid, ho, msg.offset, msg.chunk)
         t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", msg.at_version))
         store.queue_transaction(t)
 
@@ -131,8 +146,10 @@ class PG:
                 tid=msg.tid, result=-11,  # EAGAIN: wrong primary / not ready
                 epoch=self.osd.osdmap.epoch))
             return
-        if msg.op == CEPH_OSD_OP_WRITE:
+        if msg.op == CEPH_OSD_OP_WRITEFULL:
             self._do_write(msg)
+        elif msg.op in (CEPH_OSD_OP_WRITE, CEPH_OSD_OP_APPEND):
+            self._do_partial_write(msg)
         elif msg.op == CEPH_OSD_OP_READ:
             self._do_read(msg)
         elif msg.op == CEPH_OSD_OP_STAT:
@@ -154,7 +171,25 @@ class PG:
 
             self.backend.submit_transaction(msg.oid, msg.data, on_commit)
         else:
-            self.rep_backend.write(msg.oid, msg.data)
+            self.rep_backend.write(msg.oid, msg.data, full=True)
+            self.osd.send_op_reply(msg.src, MOSDOpReply(
+                tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))
+
+    def _do_partial_write(self, msg: MOSDOp) -> None:
+        """Offset write / append: rmw on EC pools, splice on replicated
+        (PrimaryLogPG do_osd_ops CEPH_OSD_OP_WRITE/APPEND)."""
+        offset = None if msg.op == CEPH_OSD_OP_APPEND else msg.offset
+        if self.backend is not None:
+            src = msg.src
+
+            def on_commit(result: int) -> None:
+                self.osd.send_op_reply(src, MOSDOpReply(
+                    tid=msg.tid, result=result,
+                    epoch=self.osd.osdmap.epoch))
+
+            self.backend.submit_write(msg.oid, msg.data, offset, on_commit)
+        else:
+            self.rep_backend.write(msg.oid, msg.data, offset=offset)
             self.osd.send_op_reply(msg.src, MOSDOpReply(
                 tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))
 
@@ -167,13 +202,18 @@ class PG:
                     tid=msg.tid, result=result, data=data,
                     epoch=self.osd.osdmap.epoch))
 
-            self.backend.objects_read_and_reconstruct(msg.oid, on_complete)
+            self.backend.objects_read_and_reconstruct(
+                msg.oid, on_complete, offset=msg.offset, length=msg.length)
         else:
             data = self.rep_backend.read(msg.oid)
             if data is None:
                 self.osd.send_op_reply(msg.src,
                                        MOSDOpReply(tid=msg.tid, result=-2))
             else:
+                if msg.length:
+                    data = data[msg.offset:msg.offset + msg.length]
+                elif msg.offset:
+                    data = data[msg.offset:]
                 self.osd.send_op_reply(msg.src, MOSDOpReply(
                     tid=msg.tid, result=0, data=data,
                     epoch=self.osd.osdmap.epoch))
