@@ -7,17 +7,34 @@ the TPU-native formulation instead:
 
 1. *Candidate tables* (the FLOPs): for every x and every retry index r the
    rule could consume, evaluate the full descent (root → failure domain →
-   leaf) as pure batched tensor ops — rjenkins hashes, crush_ln LUT gathers
-   and the fixed-point divide over (X, R, fanout) lanes, argmin-reduced.
-   No loops, no lane divergence; this is where the device wins.
+   leaf) as pure batched tensor ops — rjenkins hashes plus one straw2 draw
+   per level — with ALL retry lanes flattened into one (X*R) batch so the
+   whole phase is a single fused walk.  Two draw implementations:
+
+   - **exact-i32 quotient tables** (the common case): when a bucket's item
+     weights are uniform (w identical, ≥ 0x10000) the reference draw
+     ``div64_s64(crush_ln(u) - 2^48, w)`` is a pure function of u, so a
+     per-w 64K i32 table of ``floor(G(u)/w) - 2^31`` reproduces the s64
+     ordering *and* its truncation ties exactly (argmin, first index wins
+     — mapper.c:322-367's strict-greater update).  Integer-exact: no
+     risk analysis, no residuals.
+   - **f32 + risk flags** (fallback): non-uniform weights, per-position
+     weight sets (choose_args), or pathological w < 0x10000 use
+     ``argmin(f32(G) * f32(1/w))`` with a conservative float-error guard;
+     ambiguous lanes are flagged for exact replay.
+
 2. *Resolution* (cheap): replay the exact firstn/indep retry semantics
    (mapper.c:443-636, :638-790) as a statically unrolled sequence of masked
    vector ops over the precomputed candidates — collision tests, weight
-   rejection, slot fills.  A bounded number of retries is materialized;
-   any lane that would need more is flagged.
-3. *Residuals* (exactness escape hatch): flagged lanes — typically well
-   under 1% — are recomputed with the bit-exact host interpreter, so the
-   combined result equals crush_do_rule on every input.
+   rejection, slot fills.  Candidates depend only on the *topology* (bucket
+   ids/weights), not on the per-epoch osd reweight vector, so they are
+   cached on device across map_batch calls: an epoch change (osd out/down,
+   reweight) re-runs only this phase.
+
+3. *Residuals* (exactness escape hatch): flagged lanes — zero on
+   integer-table maps, well under 1% otherwise — are recomputed with the
+   bit-exact native C++ batch evaluator (Python interpreter fallback), so
+   the combined result equals crush_do_rule on every input.
 
 Scope: straw2 maps, layered hierarchies (every descent path from the take
 root crosses the same bucket types at the same depths), jewel-style
@@ -27,6 +44,7 @@ loop kernel or the host.
 """
 from __future__ import annotations
 
+import hashlib
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -55,51 +73,45 @@ class UnsupportedRule(ValueError):
 
 
 def _build_g_table() -> np.ndarray:
-    """G[u] = 2^48 - crush_ln(u) for every 16-bit u, as float32.
+    """G[u] = 2^48 - crush_ln(u) for every 16-bit u (exact int64).
 
-    The straw2 draw argmax over -(G/w) (mapper.c:322-367) becomes a single
-    table gather plus a reciprocal multiply — no 64-bit math on device.
+    The straw2 draw argmax over draw = -floor(G/w) (mapper.c:322-367)
+    becomes a table gather plus a compare.
     """
     us = np.arange(0x10000, dtype=np.uint32)
     g = (np.uint64(1) << np.uint64(48)) - crush_ln_np(us)
-    return g.astype(np.float64).astype(np.float32)
+    return g.astype(np.int64)
 
 
-_G_F32 = jnp.asarray(_build_g_table())
+_G_EXACT = _build_g_table()
+_G_F32 = jnp.asarray(_G_EXACT.astype(np.float64).astype(np.float32))
 
 # conservative relative error of q = f32(G) * f32(1/w): G rounding (2^-24)
-# + inv rounding (2^-24) + product rounding (2^-24), padded
-_REL_ERR = np.float32(2 ** -20)
+# + inv rounding (2^-24) + product rounding (2^-24) -> |q-Q|/Q <= ~3*2^-24
+# per candidate; the two-candidate gap test sums both sides' bounds, so
+# (q1+q2)*2^-22 covers (q1*err + q2*err) with >2x margin.
+_REL_ERR = np.float32(2 ** -22)
 # floor(q) ties break by index in the reference; candidates within +-TIE
 # of each other could tie after truncation
 _TIE_PAD = np.float32(2.0)
 
+# minimum uniform weight eligible for the exact quotient-table path:
+# floor(G_max / w) must fit the biased-i32 encoding (G_max = 2^48)
+_QTABLE_MIN_W = 0x10000
+_QBIAS = np.int64(1) << np.int64(31)
 
-def _straw2_batch(C: CompiledCrushMap, bidx, x, r: int, position: int):
-    """Straw2 winners for a batch of buckets: bidx (X,), x (X,) -> (X,).
 
-    f32 fast evaluation of argmin(G(u)/w) with an exactness guard: lanes
-    whose top-two draws are within the float error bound (or the integer
-    floor-tie window) get risky=True and are re-evaluated on the host by
-    the caller.  Everything here is u32 hashing, one 64K-entry gather and
-    f32 multiplies — TPU-friendly lanes, no u64.
+def _quotient_table(w: int) -> np.ndarray:
+    """i32 table T[u] = floor(G(u)/w) - 2^31, order- and tie-exact.
+
+    Valid for w >= 0x10000: quotients fit 32 unsigned bits except the
+    unique u=0 entry (G=2^48, q=2^48/w may hit exactly 2^32), which is
+    clamped by 1 — safe because the runner-up G is 2^48 - 2^44, far more
+    than w below the clamp boundary for every w <= 2^31.
     """
-    ids = C.hash_ids[bidx]           # (X, S)
-    invw = C.inv_weights[min(position, C.npos - 1)][bidx]  # (X, S) f32
-    u = hash32_3(x[:, None], ids, jnp.uint32(r)) & jnp.uint32(0xFFFF)
-    g = _G_F32[u.astype(jnp.int32)]
-    valid = (C.lane[None, :] < C.sizes[bidx][:, None]) & (invw > 0)
-    q = jnp.where(valid, g * invw, jnp.float32(np.inf))
-    win = jnp.argmin(q, axis=1)
-    q1 = jnp.min(q, axis=1)
-    q2 = jnp.min(jnp.where(jax.nn.one_hot(win, q.shape[1], dtype=bool),
-                           jnp.float32(np.inf), q), axis=1)
-    finite1 = jnp.isfinite(q1)
-    finite2 = jnp.isfinite(q2)
-    risky = finite1 & finite2 & \
-        ((q2 - q1) <= (q1 + q2) * _REL_ERR + _TIE_PAD)
-    items = jnp.take_along_axis(C.items[bidx], win[:, None], axis=1)[:, 0]
-    return items, risky
+    q = _G_EXACT // np.int64(w)
+    q = np.minimum(q, (np.int64(1) << np.int64(32)) - 1)
+    return (q - _QBIAS).astype(np.int32)
 
 
 def _is_out_batch(dev_weight, items, x):
@@ -141,6 +153,19 @@ def _layer_path(m: CrushMap, root: int, target_type: int) -> int:
         frontier = next_frontier
         if depth > 10:
             raise UnsupportedRule("hierarchy too deep")
+
+
+def _level_frontiers(m: CrushMap, root: int, n_levels: int) -> List[List[int]]:
+    """Bucket-id frontier feeding each of the n_levels draws under root."""
+    out = []
+    frontier = [root]
+    for _ in range(n_levels):
+        out.append(list(frontier))
+        nxt: List[int] = []
+        for b in frontier:
+            nxt.extend(i for i in m.bucket(b).items if i < 0)
+        frontier = nxt
+    return out
 
 
 class FastRule:
@@ -248,61 +273,192 @@ class FastRule:
                         raise UnsupportedRule("uneven leaf depth")
         self.C = C
         self.result_max = result_max
-        self._jit = jax.jit(self._device_map)
+        self._build_quotient_tables()
+        self._cand_key: Optional[bytes] = None
+        self._cand = None
+        self._cand_jit = jax.jit(self._candidates)
+        self._resolve_jit = jax.jit(self._resolve)
 
-    # ---- device pass ------------------------------------------------------
-    def _descend(self, x, start_bidx, r: int, position: int, depth: int):
-        """Fixed-depth descent: (X,) bucket idx -> (X,) item at the target
-        layer, plus the accumulated exactness-risk flag.  r is constant
-        through the walk (mapper.c:498-520)."""
+    # ---- exact integer draw tables ----------------------------------------
+    def _build_quotient_tables(self) -> None:
+        """Per-level eligibility + shared per-w i32 quotient tables.
+
+        A level draws with exact integer tables iff every bucket its
+        frontier can present has uniform item weights >= _QTABLE_MIN_W and
+        no per-position weight set overrides them.
+        """
+        m = self.C.map
+        n_main = self.depth
+        n_leaf_lvls = self.leaf_depth if self.leaf_depth else (
+            1 if (self.leafy and self.target_type != 0) else 0)
+        frontiers = _level_frontiers(m, self.take, n_main)
+        if n_leaf_lvls:
+            # leaf levels start below every failure-domain bucket
+            fd_buckets = _level_frontiers(m, self.take, n_main + 1)[n_main]
+            # merge frontiers across all failure-domain roots per level
+            merged: List[List[int]] = [[] for _ in range(n_leaf_lvls)]
+            for fd in fd_buckets:
+                for li, lvl in enumerate(
+                        _level_frontiers(m, fd, n_leaf_lvls)):
+                    merged[li].extend(lvl)
+            frontiers = frontiers + merged
+        self.total_levels = len(frontiers)
+
+        w_to_idx = {}
+        tables: List[np.ndarray] = []
+        nb = self.C.nbuckets
+        bucket_qidx = np.zeros(nb, dtype=np.int32)
+        lvl_int: List[bool] = []
+        # any choose_args disables the integer path: weight_set entries
+        # override item_weights even with a single position (npos==1),
+        # and the quotient tables are built from raw topology weights
+        use_pos_weights = self.C.npos > 1 or self.choose_args is not None
+        for lvl in frontiers:
+            ok = not use_pos_weights
+            for bid in lvl:
+                b = m.bucket(bid)
+                ws = list(b.item_weights)
+                if not ws or min(ws) != max(ws) or ws[0] < _QTABLE_MIN_W:
+                    ok = False
+                    break
+            if ok:
+                for bid in lvl:
+                    b = m.bucket(bid)
+                    w = int(b.item_weights[0])
+                    if w not in w_to_idx:
+                        w_to_idx[w] = len(tables)
+                        tables.append(_quotient_table(w))
+                    bucket_qidx[-1 - bid] = w_to_idx[w]
+            lvl_int.append(ok)
+        self._lvl_int = lvl_int
+        if tables:
+            self._qtables = jnp.asarray(np.stack(tables))
+            self._bucket_qidx = jnp.asarray(bucket_qidx)
+        else:
+            self._qtables = None
+            self._bucket_qidx = None
+
+    # ---- device draws ------------------------------------------------------
+    def _straw2_int(self, bidx, x, r):
+        """Exact integer straw2 via the quotient table: argmin with
+        first-index tie-break == the reference's strict-greater update."""
+        C = self.C
+        ids = C.hash_ids[bidx]                   # (N, S)
+        u = hash32_3(x[:, None], ids, r[:, None]) & jnp.uint32(0xFFFF)
+        q = self._qtables[self._bucket_qidx[bidx][:, None],
+                          u.astype(jnp.int32)]  # (N, S)
+        valid = C.lane[None, :] < C.sizes[bidx][:, None]
+        q = jnp.where(valid, q, jnp.int32(0x7FFFFFFF))
+        win = jnp.argmin(q, axis=1)
+        items = jnp.take_along_axis(C.items[bidx], win[:, None], axis=1)[:, 0]
+        return items, jnp.zeros(x.shape, dtype=bool)
+
+    def _straw2_f32(self, bidx, x, r, pos):
+        """f32 draw with exactness guard: lanes whose top-two draws are
+        within the float error bound (or the integer floor-tie window) get
+        risky=True and are re-evaluated exactly by the caller."""
+        C = self.C
+        ids = C.hash_ids[bidx]                   # (N, S)
+        invw = C.inv_weights[jnp.minimum(pos, C.npos - 1), bidx]  # (N, S)
+        u = hash32_3(x[:, None], ids, r[:, None]) & jnp.uint32(0xFFFF)
+        g = _G_F32[u.astype(jnp.int32)]
+        valid = (C.lane[None, :] < C.sizes[bidx][:, None]) & (invw > 0)
+        q = jnp.where(valid, g * invw, jnp.float32(np.inf))
+        win = jnp.argmin(q, axis=1)
+        q1 = jnp.min(q, axis=1)
+        q2 = jnp.min(jnp.where(jax.nn.one_hot(win, q.shape[1], dtype=bool),
+                               jnp.float32(np.inf), q), axis=1)
+        finite1 = jnp.isfinite(q1)
+        finite2 = jnp.isfinite(q2)
+        risky = finite1 & finite2 & \
+            ((q2 - q1) <= (q1 + q2) * _REL_ERR + _TIE_PAD)
+        items = jnp.take_along_axis(C.items[bidx], win[:, None], axis=1)[:, 0]
+        return items, risky
+
+    def _descend(self, x, start_bidx, r, pos, base_level: int, depth: int):
+        """Fixed-depth descent for a flat batch of lanes: (N,) bucket idx
+        -> (N,) item at the target layer, plus the accumulated
+        exactness-risk flag.  r is constant through the walk
+        (mapper.c:498-520); each level statically picks the integer or f32
+        draw."""
         item = None
         bidx = start_bidx
         risky = jnp.zeros(x.shape, dtype=bool)
-        for _ in range(depth):
-            item, rk = _straw2_batch(self.C, bidx, x, r, position)
+        for d in range(depth):
+            if self._lvl_int[base_level + d]:
+                item, rk = self._straw2_int(bidx, x, r)
+            else:
+                item, rk = self._straw2_f32(bidx, x, r, pos)
             risky = risky | rk
             bidx = jnp.maximum(-1 - item, 0)
         return item, risky
 
-    def _leaf_of(self, x, host_item, r: int, rep_static: int):
-        """One leaf attempt below a chosen failure-domain bucket."""
-        if self.leaf_depth == 0 and self.target_type == 0:
-            return host_item, jnp.zeros(x.shape, dtype=bool)
-        bidx = jnp.maximum(-1 - host_item, 0)
-        depth = self.leaf_depth if self.leaf_depth else 1
-        pos = rep_static if not self.firstn else 0
-        return self._descend(x, bidx, r, pos, depth)
+    # ---- candidate phase (topology-only; cached across epochs) -------------
+    def _candidates(self, xs):
+        """One flattened descent over all (x, retry) lanes.
 
-    def _device_map(self, xs, dev_weight):
+        Returns cand (R, X) failure-domain items, leaf (R, L, X) leaf
+        items (all-NONE when not leafy), risky (X,)."""
         x = xs.astype(jnp.uint32)
-        root_idx = jnp.full((xs.shape[0],), -1 - self.take, dtype=jnp.int32)
+        X = xs.shape[0]
         if self.firstn:
-            return self._resolve_firstn(x, root_idx, dev_weight)
-        return self._resolve_indep(x, root_idx, dev_weight)
+            R = self.numrep + self.n_rounds - 1
+        else:
+            R = self.numrep * self.n_rounds
+        r_col = jnp.arange(R, dtype=jnp.uint32)
+        xf = jnp.broadcast_to(x[None, :], (R, X)).reshape(-1)
+        rf = jnp.broadcast_to(r_col[:, None], (R, X)).reshape(-1)
+        root = jnp.full((R * X,), -1 - self.take, dtype=jnp.int32)
+        pos0 = jnp.zeros((R * X,), dtype=jnp.int32)
+        item, risky_f = self._descend(xf, root, rf, pos0, 0, self.depth)
+        risky = jnp.any(risky_f.reshape(R, X), axis=0)
+        cand = item.reshape(R, X)
+        L = self.n_leaf
+        if not self.leafy:
+            leaf = jnp.full((R, 1, X), NONE, dtype=jnp.int32)
+            return cand, leaf, risky
+        if self.leaf_depth == 0 and self.target_type == 0:
+            # chooseleaf over devices: every leaf attempt is the item itself
+            leaf = jnp.broadcast_to(cand[:, None, :], (R, L, X))
+            return cand, leaf, risky
+        # leaf attempts: one flattened batch over (R, L, X)
+        if self.firstn:
+            sub_r = (rf >> jnp.uint32(self.vary_r - 1)) if self.vary_r \
+                else jnp.zeros_like(rf)
+            lpos = jnp.zeros((R * X,), dtype=jnp.int32)
+        else:
+            rep = rf % jnp.uint32(self.numrep)
+            sub_r = rep + rf  # + numrep*ft2 added per attempt below
+            lpos = rep.astype(jnp.int32)
+        bidx = jnp.maximum(-1 - item, 0)
+        depth = self.leaf_depth if self.leaf_depth else 1
+        xl = jnp.broadcast_to(xf[None, :], (L, R * X)).reshape(-1)
+        bl = jnp.broadcast_to(bidx[None, :], (L, R * X)).reshape(-1)
+        pl = jnp.broadcast_to(lpos[None, :], (L, R * X)).reshape(-1)
+        ft2 = jnp.arange(L, dtype=jnp.uint32)
+        if self.firstn:
+            rl = (sub_r[None, :] + ft2[:, None]).reshape(-1)
+        else:
+            rl = (sub_r[None, :] +
+                  jnp.uint32(self.numrep) * ft2[:, None]).reshape(-1)
+        lv, lrisky = self._descend(xl, bl, rl, pl, self.depth, depth)
+        risky = risky | jnp.any(lrisky.reshape(L, R, X), axis=(0, 1))
+        leaf = jnp.transpose(lv.reshape(L, R, X), (1, 0, 2))  # (R, L, X)
+        return cand, leaf, risky
 
-    def _resolve_firstn(self, x, root_idx, dev_weight):
+    # ---- resolution phase (per weight vector; cheap) -----------------------
+    def _resolve(self, cand, leaf, risky, x, dev_weight):
+        if self.firstn:
+            return self._resolve_firstn(cand, leaf, risky, x, dev_weight)
+        return self._resolve_indep(cand, leaf, risky, x, dev_weight)
+
+    def _resolve_firstn(self, cand, leaf, risky, x, dev_weight):
         """firstn: slot j retries r = j + ftotal (mapper.c:493-495); leafy
         failures consume an outer retry (descend_once semantics)."""
-        X = x.shape[0]
-        numrep, R = self.numrep, self.numrep + self.n_rounds - 1
-        # candidate tables: descent + single leaf attempt per r.  any
-        # float-ambiguous draw anywhere in a lane's tables flags the lane
-        # for exact host recomputation (conservative, ~1e-6 of lanes)
-        residual = jnp.zeros((X,), dtype=bool)
-        cand = []
-        leaf = []
-        for r in range(R):
-            item, rk = self._descend(x, root_idx, r, 0, self.depth)
-            residual = residual | rk
-            cand.append(item)
-            if self.leafy:
-                sub_r = (r >> (self.vary_r - 1)) if self.vary_r else 0
-                lf = []
-                for ft2 in range(self.n_leaf):
-                    lv, lrk = self._leaf_of(x, item, sub_r + ft2, 0)
-                    residual = residual | lrk
-                    lf.append(lv)
-                leaf.append(lf)
+        R, X = cand.shape
+        numrep = self.numrep
+        x = x.astype(jnp.uint32)
+        residual = risky
         outs = jnp.full((X, numrep), NONE, dtype=jnp.int32)
         leaves = jnp.full((X, numrep), NONE, dtype=jnp.int32)
         for j in range(numrep):
@@ -317,7 +473,7 @@ class FastRule:
                     lsel = jnp.full((X,), NONE, dtype=jnp.int32)
                     lres = jnp.zeros((X,), dtype=bool)
                     for ft2 in range(self.n_leaf):
-                        lf = leaf[r][ft2]
+                        lf = leaf[r, ft2]
                         lcoll = jnp.any(leaves == lf[:, None], axis=1)
                         lrej = _is_out_batch(dev_weight, lf, x)
                         good = ~lok & ~lcoll & ~lrej
@@ -348,29 +504,27 @@ class FastRule:
         sel = leaves if self.leafy else outs
         return sel, residual
 
-    def _resolve_indep(self, x, root_idx, dev_weight):
+    def _resolve_indep(self, cand, leaf, risky, x, dev_weight):
         """indep rounds: r = rep + numrep*ftotal; UNDEF slots retry,
         dead ends become NONE (mapper.c:638-790)."""
-        X = x.shape[0]
+        R, X = cand.shape
         numrep = self.numrep
+        x = x.astype(jnp.uint32)
         UNDEF = jnp.int32(0x7FFFFFFE)  # CRUSH_ITEM_UNDEF; never a real item
         outs = jnp.full((X, numrep), UNDEF, dtype=jnp.int32)
         leaves = jnp.full((X, numrep), UNDEF, dtype=jnp.int32)
-        residual = jnp.zeros((X,), dtype=bool)
+        residual = risky
         for ftotal in range(self.n_rounds):
             for rep in range(numrep):
                 r = rep + numrep * ftotal
-                item, rk = self._descend(x, root_idx, r, 0, self.depth)
-                residual = residual | rk
+                item = cand[r]
                 unfilled = outs[:, rep] == UNDEF
                 coll = jnp.any(outs == item[:, None], axis=1)
                 if self.leafy:
                     lok = jnp.zeros((X,), dtype=bool)
                     lsel = jnp.full((X,), NONE, dtype=jnp.int32)
                     for ft2 in range(self.n_leaf):
-                        r2 = rep + r + numrep * ft2
-                        lf, lrk = self._leaf_of(x, item, r2, rep)
-                        residual = residual | lrk
+                        lf = leaf[r, ft2]
                         lrej = _is_out_batch(dev_weight, lf, x)
                         good = ~lok & ~lrej
                         lsel = jnp.where(good, lf, lsel)
@@ -398,12 +552,41 @@ class FastRule:
         return sel, residual
 
     # ---- public -----------------------------------------------------------
+    def prepare_candidates(self, xs: np.ndarray) -> None:
+        """Compute (or reuse) the device candidate tables for this xs
+        batch.  Topology-only: reused across weight vectors/epochs."""
+        xs = np.asarray(xs, dtype=np.uint32)
+        key = hashlib.sha1(xs.tobytes()).digest()
+        if self._cand_key != key:
+            xd = jnp.asarray(xs)
+            self._cand = jax.block_until_ready(self._cand_jit(xd))
+            self._cand_x = xd
+            self._cand_key = key
+
+    def resolve_device(self, weight) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Device-resident resolution against the cached candidates:
+        (sel, residual) device arrays.  The per-epoch remap call —
+        requires prepare_candidates/map_batch to have run for the batch.
+        Not exact on its own: residual lanes still need host replay."""
+        if self._cand is None:
+            raise RuntimeError("no candidate tables; call "
+                               "prepare_candidates(xs) first")
+        wd = weight if isinstance(weight, jnp.ndarray) \
+            else jnp.asarray(np.asarray(weight, dtype=np.uint32))
+        return self._resolve_jit(*self._cand, self._cand_x, wd)
+
     def map_batch(self, xs: np.ndarray, weight: np.ndarray
                   ) -> Tuple[np.ndarray, np.ndarray]:
-        """Map every x; exact.  Returns (results [X, numrep], counts [X])."""
+        """Map every x; exact.  Returns (results [X, numrep], counts [X]).
+
+        Candidates are cached on device keyed by the xs batch: calling
+        again with the same xs (the whole-map remap on every epoch) only
+        re-runs the cheap resolution phase with the new weight vector.
+        """
         xs = np.asarray(xs, dtype=np.uint32)
         w32 = np.asarray(weight, dtype=np.uint32)
-        sel, residual = self._jit(jnp.asarray(xs), jnp.asarray(w32))
+        self.prepare_candidates(xs)
+        sel, residual = self.resolve_device(w32)
         sel = np.asarray(sel)
         residual = np.asarray(residual)
         out = np.full((xs.shape[0], self.result_max), NONE, dtype=np.int32)
@@ -421,22 +604,55 @@ class FastRule:
             n = min(sel.shape[1], self.result_max)
             out[:, :n] = sel[:, :n]
             counts[:] = n
-        # exactness escape hatch: recompute flagged lanes on the host
+        # exactness escape hatch: recompute flagged lanes exactly.  The
+        # C++ batch evaluator replays them ~100x faster than the Python
+        # interpreter (OSDMapMapping.h:17's ParallelPGMapper role); fall
+        # back to Python when the native lib is absent or the rule uses
+        # choose_args (not in the native blob format).
         self._residual_frac = float(residual.mean())
         if residual.any():
-            m = self.C.map
-            wl = [int(v) for v in weight]
-            for i in np.nonzero(residual)[0]:
-                res = crush_do_rule(m, self.ruleno, int(xs[i]),
-                                    self.result_max, wl, self.choose_args)
-                out[i, :] = NONE
-                out[i, :len(res)] = res
-                counts[i] = len(res)
+            idxs = np.nonzero(residual)[0]
+            done = False
+            if self.choose_args is None:
+                try:
+                    nm = self._native_mapper()
+                    rout, rlens = nm.do_rule_batch(
+                        self.ruleno, xs[idxs].astype(np.int64),
+                        self.result_max, w32)
+                    out[idxs] = np.where(
+                        np.arange(self.result_max)[None, :] < rlens[:, None],
+                        rout.astype(np.int32), NONE)
+                    counts[idxs] = rlens
+                    done = True
+                except Exception:
+                    pass
+            if not done:
+                m = self.C.map
+                wl = [int(v) for v in weight]
+                for i in idxs:
+                    res = crush_do_rule(m, self.ruleno, int(xs[i]),
+                                        self.result_max, wl,
+                                        self.choose_args)
+                    out[i, :] = NONE
+                    out[i, :len(res)] = res
+                    counts[i] = len(res)
         return out, counts
+
+    def _native_mapper(self):
+        nm = getattr(self, "_nm", None)
+        if nm is None:
+            from ..native import NativeCrushMapper
+            nm = self._nm = NativeCrushMapper(self.C.map)
+        return nm
 
     @property
     def residual_fraction(self) -> float:
         return getattr(self, "_residual_frac", 0.0)
+
+    @property
+    def integer_exact_levels(self) -> List[bool]:
+        """Per-level flag: True = draws use the exact i32 quotient table."""
+        return list(self._lvl_int)
 
 
 def compile_fast_rule(m: CrushMap, ruleno: int, result_max: int,

@@ -133,6 +133,7 @@ class InflightRead:
     pending: Set[int] = field(default_factory=set)
     failed: Set[int] = field(default_factory=set)
     seen: int = 0                 # shards that answered at all
+    saw_eio: bool = False         # any non-ENOENT shard failure (crc etc.)
 
 
 @dataclass
@@ -517,6 +518,8 @@ class ECBackend:
                 rd.size = struct.unpack("<Q", sz)[0]
         else:
             rd.failed.add(msg.shard)
+            if msg.result != -2:
+                rd.saw_eio = True
             # retry with reconstruction from any other shards (same range)
             acting = self.pg.acting_shards()
             others = (set(acting) - set(rd.chunks) - rd.failed
@@ -535,14 +538,16 @@ class ECBackend:
         if rd.attrs_only:
             if rd.size >= 0:
                 rd.on_done(0, b"", rd.size)
-            elif rd.failed and not rd.chunks:
-                # every shard answered ENOENT/error; distinguish pure ENOENT
+            elif rd.failed and not rd.chunks and not rd.saw_eio:
+                # every shard answered a clean ENOENT: object absent
                 rd.on_done(-2, b"", 0)
             else:
+                # crc/EIO failures must surface as EIO, never ENOENT —
+                # a corrupt object is not an absent one
                 rd.on_done(-5, b"", -1)
             return
-        if not rd.chunks and rd.failed:
-            # all shards report no object
+        if not rd.chunks and rd.failed and not rd.saw_eio:
+            # all shards report a clean no-such-object
             rd.on_done(-2, b"", 0)
             return
         if len(rd.chunks) < self.k:

@@ -118,6 +118,110 @@ def test_fast_three_level_hierarchy():
     assert_fast_parity(cw, rno, 3, [0x10000] * osd, n_x=300)
 
 
+def test_fast_pathological_weight_dynamic_range():
+    """Adversarial f32-guard stress (VERDICT weak #5): bucket item weights
+    spanning the full 16.16 range (0x1 .. 0x7fffffff) make G*invw spacing
+    collapse, so near-ties must be *flagged* (then replayed exactly), never
+    silently mis-ordered.  Parity against the exact interpreter is the
+    whole assertion."""
+    from ceph_tpu.crush import CrushWrapper, CRUSH_BUCKET_STRAW2
+    rng = np.random.default_rng(42)
+    extremes = [0x1, 0x2, 0x7fffffff, 0x7ffffffe, 0x10000, 0x10001,
+                0xffff, 0x40000000, 0x3, 0x20000000]
+    cw = CrushWrapper()
+    cw.set_type_name(1, "host")
+    cw.set_type_name(10, "root")
+    hosts = []
+    osd = 0
+    for h in range(6):
+        osds = list(range(osd, osd + 4))
+        osd += 4
+        ws = [int(extremes[(h * 4 + i) % len(extremes)]) for i in range(4)]
+        hosts.append(cw.add_bucket(CRUSH_BUCKET_STRAW2, 1, f"h{h}",
+                                   osds, ws, id=-(h + 2)))
+    cw.set_max_devices(osd)
+    # host weights also pathological
+    hws = [0x1, 0x7fffffff, 0x10000, 0x2, 0x40000000, 0x7ffffffe]
+    cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default", hosts, hws, id=-1)
+    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    assert_fast_parity(cw, rno, 3, [0x10000] * osd, n_x=400)
+
+
+def test_fast_near_tie_storm_huge_weights():
+    """Near-maximal, slightly distinct bucket item weights force the f32
+    path (non-uniform) in the coarse-quotient regime: floor(G/w) has only
+    ~2^17 distinct values, so draws tie constantly and the reference
+    breaks them by item index.  TIE_PAD must flag every such lane for
+    exact replay — parity is the assertion."""
+    from ceph_tpu.crush import CrushWrapper, CRUSH_BUCKET_STRAW2
+    cw = CrushWrapper()
+    cw.set_type_name(1, "host")
+    cw.set_type_name(10, "root")
+    hosts, osd = [], 0
+    for h in range(12):
+        osds = list(range(osd, osd + 2))
+        osd += 2
+        ws = [0x7fffffff - h, 0x7ffffffe - h]   # huge, non-uniform
+        hosts.append(cw.add_bucket(CRUSH_BUCKET_STRAW2, 1, f"h{h}",
+                                   osds, ws, id=-(h + 2)))
+    cw.set_max_devices(osd)
+    cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default", hosts,
+                  [0x7fffffff - h for h in range(12)], id=-1)
+    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    fr = compile_fast_rule(cw.crush, rno, 3)
+    assert not any(fr.integer_exact_levels), \
+        "non-uniform weights must use the f32 path"
+    weight = [0x10000] * osd
+    res, cnt = fr.map_batch(np.arange(500, dtype=np.uint32), weight)
+    assert fr.residual_fraction > 0  # ties were actually flagged
+    for x in range(500):
+        expect = cw.do_rule(rno, x, 3, weight)
+        assert list(res[x, :cnt[x]]) == expect, x
+
+
+def test_fast_choose_args_disable_integer_path():
+    """choose_args weight-set overrides must disable the quotient-table
+    draw even with a single position (npos==1) — the tables are built
+    from raw item weights and would silently diverge."""
+    from ceph_tpu.crush.types import ChooseArg, WeightSet
+    cw, n = build_map(n_hosts=6, osds_per_host=3)
+    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    nb = len(cw.crush.buckets)
+    args = [None] * nb
+    # override one host bucket's weights with a single-position set
+    for bi, b in enumerate(cw.crush.buckets):
+        if b is not None and b.type == 1:
+            args[bi] = ChooseArg(
+                ids=None,
+                weight_set=[WeightSet(weights=[0x8000] * b.size)])
+            break
+    fr = compile_fast_rule(cw.crush, rno, 3, choose_args=args)
+    assert not any(fr.integer_exact_levels)
+    weight = [0x10000] * n
+    res, cnt = fr.map_batch(np.arange(400, dtype=np.uint32), weight)
+    from ceph_tpu.crush.mapper import crush_do_rule
+    for x in range(400):
+        expect = crush_do_rule(cw.crush, rno, x, 3, weight, args)
+        assert list(res[x, :cnt[x]]) == expect, x
+
+
+def test_fast_residuals_route_through_native():
+    """The exactness escape hatch should use the C++ batch evaluator when
+    available (the serial-Python tail was the <50 ms risk, VERDICT #6)."""
+    from ceph_tpu.native import native_available
+    if not native_available():
+        pytest.skip("native lib unavailable")
+    cw, n = build_map(n_hosts=5, osds_per_host=3)
+    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    rng = np.random.default_rng(1)
+    weight = [int(w) for w in rng.choice([0, 0x2000, 0x10000], size=n)]
+    fr = assert_fast_parity(cw, rno, 3, weight)
+    # heavy reweighting forces unresolved lanes -> the native mapper
+    # object must have been instantiated (and parity held above)
+    if fr.residual_fraction > 0:
+        assert getattr(fr, "_nm", None) is not None
+
+
 def test_fast_rejects_chained_rules():
     cw, n = build_map()
     steps = [RuleStep(CRUSH_RULE_TAKE, -1, 0),
