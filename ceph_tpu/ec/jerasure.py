@@ -1,4 +1,4 @@
-"""'jerasure' plugin: RS/Cauchy matrix techniques with jerasure semantics.
+"""'jerasure' plugin: RS/Cauchy matrix + bitmatrix-schedule techniques.
 
 Mirrors the reference jerasure plugin's technique set
 (src/erasure-code/jerasure/ErasureCodeJerasure.h:82-258; defaults k=7 m=3
@@ -8,13 +8,17 @@ w=8 at :90-92):
   (reed_sol_vandermonde_coding_matrix; ErasureCodeJerasure.cc:155).
 - reed_sol_r6_op: RAID6 optimization — coding rows [1,1,..] and [1,2,4,..]
   (m is forced to 2).
-- cauchy_orig: original Cauchy matrix, row i col j = 1/(i ^ (m+j)).
-- cauchy_good / liberation / blaum_roth / liber8tion: bitmatrix+schedule
-  codes; scheduled-XOR execution is not yet implemented in this round and
-  raises NotImplementedError at init.
+- cauchy_orig / cauchy_good: Cauchy coefficient matrices (original /
+  density-improved) executed as bitmatrix packet codes
+  (ErasureCodeJerasure.cc:259-269 jerasure_schedule_encode role).
+- liberation / blaum_roth / liber8tion: minimal-density RAID-6 bitmatrix
+  codes (m=2), same packet execution (ErasureCodeJerasure.cc:340-348).
 
-Only w=8 is supported on the device path (the reference default); other w
-values raise.
+The bitmatrix family runs through gf/bitmatrix.BitmatrixPacketCodec: XOR
+of byte packets with 0/1 coefficients is GF(2^8)-linear, so the device
+path is the same MXU bit-matmul the RS codes use, over virtual packet
+chunks.  reed_sol_* supports w=8 on the byte path (w=16/32 raise — the
+word-interleaved layouts are not implemented).
 """
 from __future__ import annotations
 
@@ -22,15 +26,23 @@ import numpy as np
 
 from ..gf.tables import gf_inv, gf_pow
 from ..gf.matrices import jerasure_reed_sol_van_matrix
+from ..gf.bitmatrix import (
+    BitmatrixPacketCodec, blaum_roth_bitmatrix, cauchy_good_matrix,
+    cauchy_original_matrix, liber8tion_bitmatrix, liberation_bitmatrix,
+    matrix_to_bitmatrix, _is_prime,
+)
 from .matrix_plugin import ErasureCodeMatrixRS
 from .rs_codec import MatrixRSCodec
 
 DEFAULT_K = 7
 DEFAULT_M = 3
 DEFAULT_W = 8
+DEFAULT_PACKETSIZE = 2048  # ErasureCodeJerasure.h:141 DEFAULT_PACKETSIZE
 
 TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good",
               "liberation", "blaum_roth", "liber8tion")
+BITMATRIX_TECHNIQUES = ("cauchy_orig", "cauchy_good", "liberation",
+                        "blaum_roth", "liber8tion")
 
 
 def reed_sol_r6_matrix(k: int) -> np.ndarray:
@@ -40,15 +52,6 @@ def reed_sol_r6_matrix(k: int) -> np.ndarray:
     for j in range(k):
         m[1, j] = gf_pow(2, j)
     return m
-
-
-def cauchy_orig_matrix(k: int, m: int) -> np.ndarray:
-    """jerasure cauchy_original_coding_matrix: row i col j = 1/(i ^ (m+j))."""
-    a = np.zeros((m, k), dtype=np.uint8)
-    for i in range(m):
-        for j in range(k):
-            a[i, j] = gf_inv(i ^ (m + j))
-    return a
 
 
 def _systematic(coding: np.ndarray) -> np.ndarray:
@@ -67,40 +70,108 @@ class ErasureCodeJerasure(ErasureCodeMatrixRS):
         self.packetsize = 0
         self.per_chunk_alignment = False
 
+    @property
+    def is_bitmatrix(self) -> bool:
+        return self.technique in BITMATRIX_TECHNIQUES
+
     def init(self, profile) -> None:
         super().init(profile)
         self.parse_mapping(profile)
         self.technique = profile.get("technique", self.technique)
         if self.technique not in TECHNIQUES:
             raise ValueError(f"technique={self.technique} not in {TECHNIQUES}")
-        self.k = self.to_int("k", profile, DEFAULT_K)
-        self.m = self.to_int("m", profile, DEFAULT_M)
-        self.w = self.to_int("w", profile, DEFAULT_W)
-        self.packetsize = self.to_int("packetsize", profile, 0)
+        # per-technique defaults (ErasureCodeJerasure.h constructors)
+        def_k, def_m, def_w = DEFAULT_K, DEFAULT_M, DEFAULT_W
+        if self.technique == "liberation":
+            def_k, def_m, def_w = 2, 2, 7
+        elif self.technique in ("blaum_roth", "liber8tion"):
+            def_k, def_m, def_w = 2, 2, 8 if self.technique == "liber8tion" \
+                else 6
+        self.k = self.to_int("k", profile, def_k)
+        self.m = self.to_int("m", profile, def_m)
+        self.w = self.to_int("w", profile, def_w)
+        self.packetsize = self.to_int("packetsize", profile,
+                                      DEFAULT_PACKETSIZE
+                                      if self.is_bitmatrix else 0)
         self.per_chunk_alignment = self.to_bool(
             "jerasure-per-chunk-alignment", profile, False)
         self.sanity_check_k(self.k)
-        if self.w != 8:
-            raise ValueError(f"w={self.w}: only w=8 is supported "
-                             "(device GF(2^8) kernels)")
         self._init_backend(profile)
         if self.technique == "reed_sol_van":
+            if self.w != 8:
+                raise ValueError(
+                    f"w={self.w}: reed_sol_van supports w=8 on the byte "
+                    "path (w=16/32 word layouts not implemented)")
             coding = jerasure_reed_sol_van_matrix(self.k, self.m)
+            self.codec = MatrixRSCodec(_systematic(coding))
         elif self.technique == "reed_sol_r6_op":
+            if self.w != 8:
+                raise ValueError("reed_sol_r6_op supports w=8 only")
             self.m = 2
             coding = reed_sol_r6_matrix(self.k)
-        elif self.technique == "cauchy_orig":
-            coding = cauchy_orig_matrix(self.k, self.m)
+            self.codec = MatrixRSCodec(_systematic(coding))
         else:
-            raise NotImplementedError(
-                f"technique={self.technique}: bitmatrix/scheduled codes "
-                "planned for a later round")
-        self.codec = MatrixRSCodec(_systematic(coding))
+            self._init_bitmatrix()
         self._profile.update({"k": str(self.k), "m": str(self.m),
                               "w": str(self.w),
                               "technique": self.technique})
+        if self.is_bitmatrix:
+            self._profile["packetsize"] = str(self.packetsize)
+
+    def _init_bitmatrix(self) -> None:
+        if self.packetsize <= 0:
+            raise ValueError(
+                f"technique={self.technique} requires packetsize > 0")
+        if self.packetsize % 4:
+            # ErasureCodeJerasure.cc:390-397 check_packetsize
+            raise ValueError("packetsize must be a multiple of 4")
+        if self.technique == "cauchy_orig":
+            bm = matrix_to_bitmatrix(
+                cauchy_original_matrix(self.k, self.m, self.w), self.w)
+        elif self.technique == "cauchy_good":
+            bm = matrix_to_bitmatrix(
+                cauchy_good_matrix(self.k, self.m, self.w), self.w)
+        elif self.technique == "liberation":
+            self.m = 2
+            if self.k > self.w or not _is_prime(self.w):
+                raise ValueError(
+                    f"liberation needs prime w >= k (k={self.k} w={self.w})")
+            bm = liberation_bitmatrix(self.k, self.w)
+        elif self.technique == "blaum_roth":
+            self.m = 2
+            if self.k > self.w or not _is_prime(self.w + 1):
+                raise ValueError(
+                    f"blaum_roth needs w+1 prime, w >= k "
+                    f"(k={self.k} w={self.w})")
+            bm = blaum_roth_bitmatrix(self.k, self.w)
+        else:  # liber8tion
+            self.m = 2
+            self.w = 8
+            if self.k > 8:
+                raise ValueError("liber8tion needs k <= 8")
+            bm = liber8tion_bitmatrix(self.k)
+        self.codec = BitmatrixPacketCodec(bm, self.k, self.m, self.w,
+                                          self.packetsize)
+
+    def _device_encode(self, data: np.ndarray) -> np.ndarray:
+        if not self.is_bitmatrix:
+            return super()._device_encode(data)
+        dv = self.codec.to_virtual(data)
+        cv = self.device().encode(dv[None])[0]
+        return self.codec.from_virtual(cv, self.m)
 
     def get_alignment(self) -> int:
+        if self.is_bitmatrix:
+            # ErasureCodeJerasureCauchy::get_alignment
+            # (ErasureCodeJerasure.cc:272-283): per-chunk = w*packetsize;
+            # whole-object = k*w*packetsize*sizeof(int), widened to the
+            # vector word size when misaligned
+            if self.per_chunk_alignment:
+                return self.w * self.packetsize
+            alignment = self.k * self.w * self.packetsize * 4
+            if (self.w * self.packetsize * 4) % 16:
+                alignment = self.k * self.w * self.packetsize * 16
+            return alignment
         # reference ErasureCodeJerasureReedSolomonVandermonde::get_alignment:
         # k*w*sizeof(int) when not per-chunk (w=8 => 32k), else
         # w*LARGEST_VECTOR_WORDSIZE (=16) per chunk

@@ -67,6 +67,11 @@ class ErasureCodeMatrixRS(ErasureCode):
         from ..ops.gf_matmul import device_available
         return device_available()
 
+    def _device_encode(self, data: np.ndarray) -> np.ndarray:
+        """(k, C) -> (m, C) on the device backend; codecs with a virtual
+        layout (bitmatrix packet codes) override."""
+        return self.device().encode(data[None])[0]
+
     # -- encode/decode ------------------------------------------------------
     def encode_chunks(self, want_to_encode: Set[int],
                       encoded: Dict[int, np.ndarray]) -> None:
@@ -74,7 +79,7 @@ class ErasureCodeMatrixRS(ErasureCode):
         # in logical rows.  mapping= profiles permute the two.
         data = np.stack([encoded[self.chunk_index(i)] for i in range(self.k)])
         if self._use_device():
-            coding = self.device().encode(data[None])[0]
+            coding = self._device_encode(data)
         else:
             coding = self.codec.encode(data)
         for i in range(self.m):

@@ -50,7 +50,17 @@ def roundtrip_sweep(codec, payload: bytes, max_erasures=None):
     ("jerasure", {"k": "4", "m": "2", "technique": "reed_sol_r6_op",
                   "backend": "host"}),
     ("jerasure", {"k": "4", "m": "3", "technique": "cauchy_orig",
-                  "backend": "host"}),
+                  "packetsize": "8", "backend": "host"}),
+    ("jerasure", {"k": "4", "m": "3", "technique": "cauchy_good",
+                  "packetsize": "8", "backend": "host"}),
+    ("jerasure", {"k": "5", "m": "2", "technique": "cauchy_good", "w": "4",
+                  "packetsize": "4", "backend": "host"}),
+    ("jerasure", {"k": "5", "technique": "liberation", "w": "7",
+                  "packetsize": "8", "backend": "host"}),
+    ("jerasure", {"k": "4", "technique": "blaum_roth", "w": "6",
+                  "packetsize": "8", "backend": "host"}),
+    ("jerasure", {"k": "6", "technique": "liber8tion",
+                  "packetsize": "8", "backend": "host"}),
     ("example_xor", {"k": "3", "backend": "host"}),
 ])
 def test_roundtrip_exhaustive(plugin, profile):
