@@ -17,8 +17,9 @@ w=8 at :90-92):
 The bitmatrix family runs through gf/bitmatrix.BitmatrixPacketCodec: XOR
 of byte packets with 0/1 coefficients is GF(2^8)-linear, so the device
 path is the same MXU bit-matmul the RS codes use, over virtual packet
-chunks.  reed_sol_* supports w=8 on the byte path (w=16/32 raise — the
-word-interleaved layouts are not implemented).
+chunks.  reed_sol_* supports w=8 (byte path), and w=16/32 through the
+LE-word codec (gf/word_codec.py host split tables; companion-bitmatrix
+MXU matmul on device).
 """
 from __future__ import annotations
 
@@ -26,6 +27,7 @@ import numpy as np
 
 from ..gf.tables import gf_inv, gf_pow
 from ..gf.matrices import jerasure_reed_sol_van_matrix
+from ..gf.word_codec import reed_sol_r6_matrix_w, reed_sol_van_matrix_w
 from ..gf.bitmatrix import (
     BitmatrixPacketCodec, blaum_roth_bitmatrix, cauchy_good_matrix,
     cauchy_original_matrix, liber8tion_bitmatrix, liberation_bitmatrix,
@@ -98,18 +100,23 @@ class ErasureCodeJerasure(ErasureCodeMatrixRS):
         self.sanity_check_k(self.k)
         self._init_backend(profile)
         if self.technique == "reed_sol_van":
-            if self.w != 8:
-                raise ValueError(
-                    f"w={self.w}: reed_sol_van supports w=8 on the byte "
-                    "path (w=16/32 word layouts not implemented)")
-            coding = jerasure_reed_sol_van_matrix(self.k, self.m)
-            self.codec = MatrixRSCodec(_systematic(coding))
+            if self.w == 8:
+                coding = jerasure_reed_sol_van_matrix(self.k, self.m)
+                self.codec = MatrixRSCodec(_systematic(coding))
+            elif self.w in (16, 32):
+                self._init_word_codec(
+                    reed_sol_van_matrix_w(self.k, self.m, self.w))
+            else:
+                raise ValueError(f"reed_sol_van: w={self.w} not in 8|16|32")
         elif self.technique == "reed_sol_r6_op":
-            if self.w != 8:
-                raise ValueError("reed_sol_r6_op supports w=8 only")
             self.m = 2
-            coding = reed_sol_r6_matrix(self.k)
-            self.codec = MatrixRSCodec(_systematic(coding))
+            if self.w == 8:
+                coding = reed_sol_r6_matrix(self.k)
+                self.codec = MatrixRSCodec(_systematic(coding))
+            elif self.w in (16, 32):
+                self._init_word_codec(reed_sol_r6_matrix_w(self.k, self.w))
+            else:
+                raise ValueError(f"reed_sol_r6_op: w={self.w} not in 8|16|32")
         else:
             self._init_bitmatrix()
         self._profile.update({"k": str(self.k), "m": str(self.m),
@@ -153,7 +160,30 @@ class ErasureCodeJerasure(ErasureCodeMatrixRS):
         self.codec = BitmatrixPacketCodec(bm, self.k, self.m, self.w,
                                           self.packetsize)
 
+    def _init_word_codec(self, coding: np.ndarray) -> None:
+        """w=16/32: LE-word layout codec (jerasure_matrix_encode role)."""
+        from ..gf.word_codec import WordMatrixCodec
+        full = np.zeros((self.k + self.m, self.k), dtype=np.int64)
+        full[:self.k] = np.eye(self.k, dtype=np.int64)
+        full[self.k:] = coding
+        self.codec = WordMatrixCodec(full, self.w)
+
+    @property
+    def is_word_code(self) -> bool:
+        from ..gf.word_codec import WordMatrixCodec
+        return isinstance(self.codec, WordMatrixCodec)
+
+    def device(self):
+        if self.is_word_code:
+            if self._device is None:
+                from ..ops.gf_matmul import DeviceWordRSBackend
+                self._device = DeviceWordRSBackend(self.codec.matrix, self.w)
+            return self._device
+        return super().device()
+
     def _device_encode(self, data: np.ndarray) -> np.ndarray:
+        if self.is_word_code:
+            return self.device().encode(data[None])[0]
         if not self.is_bitmatrix:
             return super()._device_encode(data)
         dv = self.codec.to_virtual(data)

@@ -80,6 +80,66 @@ def gf_bit_matmul(data: jnp.ndarray, bitmat: jnp.ndarray) -> jnp.ndarray:
     return jnp.transpose(out, (0, 2, 1))         # (S, r, C)
 
 
+@functools.partial(jax.jit, static_argnames=("w",))
+def gfw_bit_matmul(data: jnp.ndarray, bitmat: jnp.ndarray,
+                   w: int) -> jnp.ndarray:
+    """GF(2^w) word-layout coding as the same MXU 0/1 matmul.
+
+    data (S, k, C) uint8 viewed as little-endian w-bit words, bitmat
+    (k*w, r*w) int8 companion expansion -> (S, r, C) uint8.  Each word
+    unpacks to its w bits (LE byte order makes word bit b*8+i = bit i of
+    byte b), the contraction runs over k*w bit lanes, and the parity low
+    bit packs back into words.  w=8 degenerates to gf_bit_matmul.
+    """
+    s, k, c = data.shape
+    ws = w // 8
+    W = c // ws
+    d = jnp.transpose(data.reshape(s, k, W, ws), (0, 2, 1, 3))  # (S,W,k,ws)
+    bits = _unpack_bits(d.reshape(s, W, k * ws)).reshape(
+        s, W, k, w).reshape(s, W, k * w).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        bits, bitmat,
+        dimension_numbers=(((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)            # (S, W, r*w)
+    parity = (acc & 1).astype(jnp.uint8)
+    out = _pack_bits(parity)                         # (S, W, r*ws)
+    r = bitmat.shape[1] // w
+    out = jnp.transpose(out.reshape(s, W, r, ws), (0, 2, 1, 3))
+    return out.reshape(s, r, c)
+
+
+def expand_to_bitmatrix_w(coding: np.ndarray, w: int) -> np.ndarray:
+    """(m, k) GF(2^w) coefficients -> (k*w, m*w) 0/1 matrix in the
+    d @ B convention gfw_bit_matmul consumes (gf/tables.py
+    expand_to_bitmatrix generalized via the companion representation)."""
+    from ..gf.bitmatrix import element_bitmatrix
+    mm, kk = coding.shape
+    out = np.zeros((kk * w, mm * w), dtype=np.uint8)
+    for r in range(mm):
+        for c in range(kk):
+            bm = element_bitmatrix(int(coding[r, c]), w)
+            out[c * w:(c + 1) * w, r * w:(r + 1) * w] = bm.T
+    return out
+
+
+class DeviceWordRSBackend:
+    """Device executor for a (k+m, k) GF(2^w) word-layout code."""
+
+    def __init__(self, encode_matrix: np.ndarray, w: int):
+        rows, k = encode_matrix.shape
+        self.k = k
+        self.m = rows - k
+        self.w = w
+        self.matrix = encode_matrix.astype(np.int64)
+        bits = expand_to_bitmatrix_w(self.matrix[k:], w)
+        self._enc_bits = jnp.asarray(bits.astype(np.int8))
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """(S, k, C) uint8 -> (S, m, C) coding chunks."""
+        return np.asarray(gfw_bit_matmul(jnp.asarray(data),
+                                         self._enc_bits, self.w))
+
+
 class DeviceRSBackend:
     """Device-side executor for one (k+m, k) systematic code."""
 

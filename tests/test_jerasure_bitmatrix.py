@@ -165,10 +165,59 @@ def test_device_host_parity_bitmatrix(tech, prof):
         np.testing.assert_array_equal(eh[i], ed[i], err_msg=f"chunk {i}")
 
 
-def test_reed_sol_w16_rejected():
+@pytest.mark.parametrize("w,tech,k,m", [
+    (16, "reed_sol_van", 4, 2), (32, "reed_sol_van", 5, 3),
+    (16, "reed_sol_r6_op", 4, 2), (32, "reed_sol_r6_op", 6, 2),
+])
+def test_reed_sol_word_widths(w, tech, k, m):
+    """w=16/32 LE-word layout: exhaustive erasure roundtrip + the word
+    semantics (coding word = XOR gfw_mul(coeff, data word))."""
+    from ceph_tpu.gf.bitmatrix import gfw_mul
+    prof = {"plugin": "jerasure", "technique": tech, "k": str(k),
+            "m": str(m), "w": str(w), "backend": "host"}
+    c = create_erasure_code(prof)
+    n = c.get_chunk_count()
+    k, m = c.get_data_chunk_count(), n - c.get_data_chunk_count()
+    rng = np.random.default_rng(w + k)
+    payload = rng.integers(0, 256, 3333, dtype=np.uint8).tobytes()
+    enc = c.encode(set(range(n)), payload)
+    assert c.decode_concat(enc)[:len(payload)] == payload
+    for e in range(1, m + 1):
+        for gone in itertools.combinations(range(n), e):
+            avail = {i: enc[i] for i in range(n) if i not in gone}
+            dec = c.decode(set(gone), avail)
+            for i in gone:
+                np.testing.assert_array_equal(dec[i], enc[i],
+                                              err_msg=(w, tech, gone))
+    # word-level oracle on the first words
+    dt = np.dtype("<u2") if w == 16 else np.dtype("<u4")
+    words = [np.frombuffer(bytes(enc[j]), dtype=dt) for j in range(n)]
+    mat = c.codec.matrix
+    for i in range(m):
+        acc = 0
+        for j in range(k):
+            acc ^= gfw_mul(int(mat[k + i, j]), int(words[j][0]), w)
+        assert acc == int(words[k + i][0]), (w, tech, i)
+
+
+def test_reed_sol_word_device_parity():
+    """The companion-bitmatrix MXU path equals the split-table host path."""
+    prof = {"plugin": "jerasure", "technique": "reed_sol_van", "k": "4",
+            "m": "2", "w": "16"}
+    rng = np.random.default_rng(11)
+    payload = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    host = create_erasure_code(dict(prof, backend="host"))
+    dev = create_erasure_code(dict(prof, backend="tpu"))
+    eh = host.encode(set(range(6)), payload)
+    ed = dev.encode(set(range(6)), payload)
+    for i in range(6):
+        np.testing.assert_array_equal(eh[i], ed[i], err_msg=f"chunk {i}")
+
+
+def test_reed_sol_w9_rejected():
     with pytest.raises(ValueError):
         create_erasure_code({"plugin": "jerasure", "k": "4", "m": "2",
-                             "w": "16"})
+                             "w": "9"})
 
 
 def test_mini_cluster_with_bitmatrix_pool():
