@@ -42,6 +42,7 @@ class ErasureCode(ErasureCodeInterface):
         self.rule_root = DEFAULT_RULE_ROOT
         self.rule_failure_domain = DEFAULT_RULE_FAILURE_DOMAIN
         self.rule_device_class = ""
+        self.backend_name = "host"
 
     # ---- profile handling -------------------------------------------------
     def init(self, profile: ErasureCodeProfile) -> None:
@@ -50,6 +51,21 @@ class ErasureCode(ErasureCodeInterface):
             "crush-failure-domain", DEFAULT_RULE_FAILURE_DOMAIN)
         self.rule_device_class = profile.get("crush-device-class", "")
         self._profile = dict(profile)
+
+    # ---- execution backend selection (host | tpu | auto) -------------------
+    def _init_backend(self, profile: ErasureCodeProfile) -> None:
+        self.backend_name = profile.get("backend", "auto")
+        if self.backend_name not in ("host", "tpu", "auto"):
+            raise ValueError(
+                f"backend={self.backend_name} not in host|tpu|auto")
+
+    def _use_device(self) -> bool:
+        if self.backend_name == "host":
+            return False
+        if self.backend_name == "tpu":
+            return True
+        from ..ops.gf_matmul import device_available
+        return device_available()
 
     def get_profile(self) -> ErasureCodeProfile:
         return self._profile

@@ -181,6 +181,19 @@ class ErasureCodeJerasure(ErasureCodeMatrixRS):
             return self._device
         return super().device()
 
+    def _stripe_block(self) -> int:
+        if self.is_bitmatrix:
+            return self.w * self.packetsize
+        if self.is_word_code:
+            return self.w // 8
+        return 1
+
+    @property
+    def _device_decode_supported(self) -> bool:
+        # bitmatrix/word layouts decode through the host codec (their
+        # device backends consume virtual/word layouts, not whole chunks)
+        return not (self.is_bitmatrix or self.is_word_code)
+
     def _device_encode(self, data: np.ndarray) -> np.ndarray:
         if self.is_word_code:
             return self.device().encode(data[None])[0]
@@ -189,6 +202,24 @@ class ErasureCodeJerasure(ErasureCodeMatrixRS):
         dv = self.codec.to_virtual(data)
         cv = self.device().encode(dv[None])[0]
         return self.codec.from_virtual(cv, self.m)
+
+    def _device_encode_batch(self, data: np.ndarray) -> np.ndarray:
+        if self.is_word_code:
+            return self.device().encode(data)
+        if not self.is_bitmatrix:
+            return super()._device_encode_batch(data)
+        # batch virtual reshape: (S, k, C) -> (S, k*w, C/w)
+        s, k, c = data.shape
+        w, ps = self.w, self.packetsize
+        nb = c // (w * ps)
+        dv = np.ascontiguousarray(
+            data.reshape(s, k, nb, w, ps).transpose(0, 1, 3, 2, 4)
+        ).reshape(s, k * w, nb * ps)
+        cv = self.device().encode(dv)                # (S, m*w, C/w)
+        m = self.m
+        return np.ascontiguousarray(
+            cv.reshape(s, m, w, nb, ps).transpose(0, 1, 3, 2, 4)
+        ).reshape(s, m, c)
 
     def get_alignment(self) -> int:
         if self.is_bitmatrix:

@@ -62,6 +62,7 @@ class ErasureCodeLrc(ErasureCode):
     # ---- profile parsing --------------------------------------------------
     def init(self, profile: ErasureCodeProfile) -> None:
         profile = dict(profile)
+        self._backend_name = profile.get("backend", "")
         self._parse_kml(profile)
         self._parse_rule(profile)
         layers_str = profile.get("layers")
@@ -182,6 +183,10 @@ class ErasureCodeLrc(ErasureCode):
             layer.profile.setdefault("m", str(len(layer.coding)))
             layer.profile.setdefault("plugin", "jerasure")
             layer.profile.setdefault("technique", "reed_sol_van")
+            # the parent's backend choice flows into every layer so the
+            # whole layered code runs on the device path (VERDICT #7)
+            if self._backend_name:
+                layer.profile.setdefault("backend", self._backend_name)
             layer.erasure_code = registry.factory(
                 layer.profile["plugin"], layer.profile)
 
@@ -313,6 +318,73 @@ class ErasureCodeLrc(ErasureCode):
             layer.erasure_code.encode_chunks(layer_want, layer_encoded)
             for j, c in enumerate(layer.chunks):
                 encoded[c] = layer_encoded[j]
+
+    def encode_batch_full(self, stripes: np.ndarray) -> np.ndarray:
+        """(S, k, C) logical data stripes -> (S, n, C) ALL chunks in
+        physical position order, every layer's coding computed in one
+        batched (device) call per layer (the ECUtil batch entry for
+        mapped codes)."""
+        s, k, c = stripes.shape
+        assert k == self.data_chunk_count_
+        n = self.chunk_count_
+        buf = np.zeros((s, n, c), dtype=np.uint8)
+        for i in range(k):
+            buf[:, self.chunk_index(i), :] = stripes[:, i, :]
+        for layer in self.layers:
+            delegate = layer.erasure_code
+            data = np.ascontiguousarray(buf[:, layer.data, :])
+            if hasattr(delegate, "encode_batch"):
+                coding = delegate.encode_batch(data)
+            else:  # pragma: no cover - all shipped delegates batch
+                coding = np.stack([
+                    np.stack([v for _, v in sorted(delegate.encode(
+                        set(range(len(layer.chunks))),
+                        data[si].reshape(-1).tobytes()).items())])
+                    [len(layer.data):]
+                    for si in range(s)])
+            for idx, pos in enumerate(layer.coding):
+                buf[:, pos, :] = coding[:, idx, :]
+        return buf
+
+    def decode_batch(self, chunks, want) -> Dict[int, np.ndarray]:
+        """Batched layer-walking recovery (chunks: physical id -> (S, C));
+        each layer repairs what it can through its delegate's batched
+        decode and feeds recovered chunks upward — the decode_chunks walk
+        (ErasureCodeLrc.cc:783-869) vectorized over stripes."""
+        n = self.get_chunk_count()
+        full: Dict[int, Optional[np.ndarray]] = {
+            i: chunks.get(i) for i in range(n)}
+        erasures = {i for i in range(n) if full[i] is None}
+        want_missing = erasures & set(want)
+        if not want_missing:
+            return {i: full[i] for i in want}
+        for layer in reversed(self.layers):
+            layer_erasures = layer.chunks_as_set & erasures
+            if not layer_erasures:
+                continue
+            if len(layer_erasures) > \
+                    layer.erasure_code.get_coding_chunk_count():
+                continue
+            delegate = layer.erasure_code
+            layer_chunks = {j: full[cpos]
+                            for j, cpos in enumerate(layer.chunks)
+                            if cpos not in erasures}
+            want_js = [j for j, cpos in enumerate(layer.chunks)
+                       if cpos in erasures]
+            try:
+                got = delegate.decode_batch(layer_chunks, want_js)
+            except IOError:
+                continue
+            for j, cpos in enumerate(layer.chunks):
+                if cpos in erasures and j in got:
+                    full[cpos] = got[j]
+                    erasures.discard(cpos)
+            want_missing = erasures & set(want)
+            if not want_missing:
+                break
+        if want_missing:
+            raise IOError(f"unable to read {sorted(want_missing)}")
+        return {i: full[i] for i in want}
 
     def decode_chunks(self, want_to_read: Set[int], chunks,
                       decoded) -> None:

@@ -19,6 +19,10 @@ from .rs_codec import MatrixRSCodec
 class ErasureCodeMatrixRS(ErasureCode):
     """A systematic matrix code with k data + m coding chunks."""
 
+    # False when the device backend's data layout differs from whole
+    # chunks (bitmatrix packet codes): decode then uses the host path
+    _device_decode_supported = True
+
     def __init__(self):
         super().__init__()
         self.k = 0
@@ -47,30 +51,105 @@ class ErasureCodeMatrixRS(ErasureCode):
             chunk_size += alignment - modulo
         return chunk_size
 
-    # -- backend ------------------------------------------------------------
-    def _init_backend(self, profile) -> None:
-        self.backend_name = profile.get("backend", "auto")
-        if self.backend_name not in ("host", "tpu", "auto"):
-            raise ValueError(f"backend={self.backend_name} not in host|tpu|auto")
-
+    # -- backend (selection inherited from ErasureCode) ----------------------
     def device(self):
         if self._device is None:
             from ..ops.gf_matmul import DeviceRSBackend
             self._device = DeviceRSBackend(self.codec.matrix)
         return self._device
 
-    def _use_device(self) -> bool:
-        if self.backend_name == "host":
-            return False
-        if self.backend_name == "tpu":
-            return True
-        from ..ops.gf_matmul import device_available
-        return device_available()
-
     def _device_encode(self, data: np.ndarray) -> np.ndarray:
         """(k, C) -> (m, C) on the device backend; codecs with a virtual
         layout (bitmatrix packet codes) override."""
         return self.device().encode(data[None])[0]
+
+    def _device_encode_batch(self, data: np.ndarray) -> np.ndarray:
+        """(S, k, C) -> (S, m, C) on the device backend."""
+        return self.device().encode(data)
+
+    def _stripe_block(self) -> int:
+        """Per-stripe chunk-size granularity required for batch flattening
+        (1 = pointwise byte codes; jerasure overrides for packet/word
+        layouts whose blocks must not span stripe boundaries)."""
+        return 1
+
+    # -- batched stripe API (ECUtil striping, osd/ECUtil.cc:120-159) --------
+    def encode_batch(self, data: np.ndarray) -> np.ndarray:
+        """(S, k, C) uint8 -> (S, m, C) coding chunks; ONE device call for
+        all S stripes (the whole point vs the reference's stripe loop).
+        Host fallback flattens stripes into the byte axis — valid because
+        each stripe's C is a whole number of code blocks."""
+        s, k, c = data.shape
+        if c % self._stripe_block():
+            # flattening would let code blocks span stripe boundaries and
+            # S*C could mask the misalignment — reject it loudly (ECUtil's
+            # get_chunk_size always produces aligned stripes)
+            raise ValueError(
+                f"stripe chunk size {c} is not a multiple of the code "
+                f"block ({self._stripe_block()} bytes)")
+        if self._use_device():
+            return self._device_encode_batch(np.ascontiguousarray(data))
+        flat = np.ascontiguousarray(data.transpose(1, 0, 2)).reshape(
+            k, s * c)
+        coding = self.codec.encode(flat)
+        return np.ascontiguousarray(
+            coding.reshape(self.m, s, c).transpose(1, 0, 2))
+
+    def decode_batch(self, chunks: Dict[int, np.ndarray],
+                     want) -> Dict[int, np.ndarray]:
+        """Reconstruct chunk ids in *want* for a whole batch.
+
+        chunks maps chunk id -> (S, C); all stripes share one erasure
+        signature (the recovery shape: one failed shard, many stripes).
+        """
+        if len(chunks) < self.k:
+            raise IOError(
+                f"need at least k={self.k} chunks, have {len(chunks)}")
+        from .rs_codec import plan_decode
+        # callers key by physical chunk id; the codec works in logical rows
+        n = self.k + self.m
+        p2l = {self.chunk_index(i): i for i in range(n)}
+        l2p = {l: p for p, l in p2l.items()}
+        chunks = {p2l[p]: b for p, b in chunks.items()}
+        want_phys = list(want)
+        want = [p2l[p] for p in want_phys]
+        srcs, want_data, want_coding, missing_data = plan_decode(
+            self.k, chunks, want)
+        out: Dict[int, np.ndarray] = {i: chunks[i] for i in want
+                                      if i in chunks}
+        if self._use_device() and self._device_decode_supported and \
+                hasattr(self.device(), "decode_data"):
+            dev = self.device()
+            by_id: Dict[int, np.ndarray] = {}
+            if missing_data:
+                survivors = np.stack([chunks[i] for i in srcs], axis=1)
+                rec = dev.decode_data(survivors, srcs, missing_data)
+                by_id = {i: rec[:, idx]
+                         for idx, i in enumerate(missing_data)}
+                for i in want_data:
+                    out[i] = by_id[i]
+            if want_coding:
+                data_full = np.stack(
+                    [chunks[i] if i in chunks else by_id[i]
+                     for i in range(self.k)], axis=1)
+                coding = dev.encode(data_full)
+                for i in want_coding:
+                    out[i] = coding[:, i - self.k]
+            return {l2p[i]: b for i, b in out.items()}
+        # host: flatten stripes into the byte axis (blocks never span
+        # stripes because each stripe's C is a whole number of blocks)
+        some = next(iter(chunks.values()))
+        s, c = some.shape
+        if c % self._stripe_block():
+            raise ValueError(
+                f"stripe chunk size {c} is not a multiple of the code "
+                f"block ({self._stripe_block()} bytes)")
+        flat = {i: np.ascontiguousarray(b).reshape(s * c)
+                for i, b in chunks.items()}
+        dec = self.codec.decode(flat, list(want))
+        for i in want:
+            out[i] = np.ascontiguousarray(dec[i]).reshape(s, c)
+        return {l2p[i]: b for i, b in out.items()}
 
     # -- encode/decode ------------------------------------------------------
     def encode_chunks(self, want_to_encode: Set[int],

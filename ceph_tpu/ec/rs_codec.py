@@ -65,21 +65,33 @@ def gf_matvec_bytes(matrix_rows: np.ndarray, data: np.ndarray) -> np.ndarray:
 
 
 class MatrixRSCodec:
-    """Systematic (k+m, k) matrix code executor with signature-cached decode."""
+    """Systematic (k+m, k) matrix code executor with signature-cached
+    decode.  Subclasses for other fields/layouts (gf/word_codec.py
+    GF(2^w) words) override the ``_matvec``/``_invert`` primitives and
+    inherit the encode/decode scaffolding unchanged."""
+
+    _matrix_dtype = np.uint8
 
     def __init__(self, encode_matrix: np.ndarray):
         rows, k = encode_matrix.shape
         self.k = k
         self.m = rows - k
-        self.matrix = encode_matrix.astype(np.uint8)
+        self.matrix = encode_matrix.astype(self._matrix_dtype)
         self.coding_rows = self.matrix[k:, :]
         self._decode_cache: "OrderedDict[Tuple[int, ...], np.ndarray]" = OrderedDict()
         self._lock = threading.Lock()
 
+    # -- field/layout primitives (override points) ---------------------------
+    def _matvec(self, rows: np.ndarray, data: np.ndarray) -> np.ndarray:
+        return gf_matvec_bytes(rows, data)
+
+    def _invert(self, sub: np.ndarray) -> np.ndarray:
+        return gf_invert_matrix(sub)
+
     # -- encode -------------------------------------------------------------
     def encode(self, data: np.ndarray) -> np.ndarray:
         """data (k, C) uint8 -> coding (m, C) uint8."""
-        return gf_matvec_bytes(self.coding_rows, data)
+        return self._matvec(self.coding_rows, data)
 
     # -- decode -------------------------------------------------------------
     def decode_matrix_for(self, available: Sequence[int]) -> Tuple[np.ndarray, List[int]]:
@@ -96,7 +108,7 @@ class MatrixRSCodec:
                 self._decode_cache.move_to_end(key)
                 return hit, list(key)
         sub = self.matrix[list(srcs), :]
-        inv = gf_invert_matrix(sub)
+        inv = self._invert(sub)
         with self._lock:
             self._decode_cache[key] = inv
             if len(self._decode_cache) > DECODE_CACHE_ENTRIES:
@@ -118,7 +130,7 @@ class MatrixRSCodec:
         if want_data or want_coding:
             # only the data rows actually missing need the matvec; surviving
             # data rows come straight from chunks
-            rec = gf_matvec_bytes(inv[missing_data, :], src_stack)
+            rec = self._matvec(inv[missing_data, :], src_stack)
             data_by_id = dict(zip(missing_data, rec))
             for i in want_data:
                 out[i] = data_by_id[i]
@@ -127,7 +139,7 @@ class MatrixRSCodec:
                     chunks[i] if i in chunks else data_by_id[i]
                     for i in range(self.k)])
                 rows = self.matrix[want_coding, :]
-                cod = gf_matvec_bytes(rows, data_full)
+                cod = self._matvec(rows, data_full)
                 for idx, i in enumerate(want_coding):
                     out[i] = cod[idx]
         for i in want:

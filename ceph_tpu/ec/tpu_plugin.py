@@ -22,49 +22,15 @@ from .isa import ErasureCodeIsa
 
 
 class ErasureCodeTpu(ErasureCodeIsa):
+    """isa-matrix semantics with the device backend on by default; the
+    batched stripe entry points (encode_batch/decode_batch) are inherited
+    from ErasureCodeMatrixRS and dispatch to the MXU bit-matmul."""
+
     def init(self, profile) -> None:
         profile = dict(profile)
         profile.setdefault("backend", "tpu")
         super().init(profile)
 
-    # ---- batched device API ----------------------------------------------
-    def encode_batch(self, data: np.ndarray) -> np.ndarray:
-        """(S, k, C) uint8 -> (S, m, C) coding chunks in one device call."""
-        return self.device().encode(data)
-
     def encode_batch_device(self, data):
         """jnp in/out; composes under jit / Mesh shardings."""
         return self.device().encode_device(data)
-
-    def decode_batch(self, chunks: Dict[int, np.ndarray],
-                     want: Sequence[int]) -> Dict[int, np.ndarray]:
-        """Reconstruct chunk ids in *want* for a whole batch.
-
-        chunks maps chunk id -> (S, C) arrays; all stripes share the same
-        erasure signature (the recovery case: one failed shard across many
-        stripes).
-        """
-        if len(chunks) < self.k:
-            raise IOError(
-                f"need at least k={self.k} chunks, have {len(chunks)}")
-        from .rs_codec import plan_decode
-        srcs, want_data, want_coding, missing_data = plan_decode(
-            self.k, chunks, want)
-        survivors = np.stack([chunks[i] for i in srcs], axis=1)  # (S, k, C)
-        out: Dict[int, np.ndarray] = {i: chunks[i] for i in want if i in chunks}
-        dev = self.device()
-        by_id: Dict[int, np.ndarray] = {}
-        if missing_data:
-            # only actually-missing data rows go through the device matvec
-            rec = dev.decode_data(survivors, srcs, missing_data)
-            by_id = {i: rec[:, idx] for idx, i in enumerate(missing_data)}
-            for i in want_data:
-                out[i] = by_id[i]
-        if want_coding:
-            data_full = np.stack(
-                [chunks[i] if i in chunks else by_id[i]
-                 for i in range(self.k)], axis=1)
-            coding = dev.encode(data_full)
-            for i in want_coding:
-                out[i] = coding[:, i - self.k]
-        return out

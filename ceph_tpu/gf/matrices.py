@@ -55,52 +55,16 @@ def gf_gen_cauchy1_matrix(rows: int, k: int) -> np.ndarray:
     return a
 
 
-def _extended_vandermonde(rows: int, cols: int) -> np.ndarray:
-    """jerasure's extended Vandermonde matrix: row 0 = e_0, last row =
-    e_{cols-1}, middle rows i hold powers i^j (GF multiply chain)."""
-    v = np.zeros((rows, cols), dtype=np.uint8)
-    v[0, 0] = 1
-    if rows == 1:
-        return v
-    v[rows - 1, cols - 1] = 1
-    for i in range(1, rows - 1):
-        acc = 1
-        for j in range(cols):
-            v[i, j] = acc
-            acc = gf_mul(acc, i)
-    return v
-
-
 def jerasure_reed_sol_van_matrix(k: int, m: int) -> np.ndarray:
     """m x k coding matrix matching jerasure reed_sol_van (w=8).
 
-    Builds the (k+m) x k extended Vandermonde matrix, then performs the same
-    column-elimination sequence jerasure uses to force the top k x k block to
-    identity; the bottom m rows are the coding matrix.
+    The construction (extended Vandermonde + jerasure's column-elimination
+    systematization) is shared with the w=16/32 paths; this is the w=8
+    instance (gfw_mul(a, b, 8) == gf_mul(a, b): same 0x11D polynomial,
+    verified in tests/test_jerasure_bitmatrix.py).
     """
-    rows, cols = k + m, k
-    dist = _extended_vandermonde(rows, cols)
-    for i in range(1, cols):
-        # pivot search in column i at/below row i
-        j = i
-        while j < rows and dist[j, i] == 0:
-            j += 1
-        if j >= rows:
-            raise ValueError("singular extended Vandermonde matrix")
-        if j > i:
-            dist[[i, j], :] = dist[[j, i], :]
-        # scale column i so dist[i, i] == 1
-        if dist[i, i] != 1:
-            inv = gf_div(1, int(dist[i, i]))
-            for r in range(rows):
-                dist[r, i] = gf_mul(inv, int(dist[r, i]))
-        # eliminate the rest of row i by column ops
-        for jj in range(cols):
-            t = int(dist[i, jj])
-            if jj != i and t != 0:
-                for r in range(rows):
-                    dist[r, jj] ^= gf_mul(t, int(dist[r, i]))
-    return dist[k:, :].copy()
+    from .word_codec import reed_sol_van_matrix_w
+    return reed_sol_van_matrix_w(k, m, 8).astype(np.uint8)
 
 
 def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:

@@ -20,12 +20,11 @@ into its w bits.
 """
 from __future__ import annotations
 
-import threading
-from collections import OrderedDict
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict
 
 import numpy as np
 
+from ..ec.rs_codec import MatrixRSCodec
 from .bitmatrix import gfw_div, gfw_inv, gfw_mul
 
 _WORD_DTYPE = {16: np.dtype("<u2"), 32: np.dtype("<u4")}
@@ -130,24 +129,21 @@ class _SplitMul:
         return acc
 
 
-class WordMatrixCodec:
+class WordMatrixCodec(MatrixRSCodec):
     """Systematic (k+m, k) GF(2^w) code executor over LE word chunks.
 
-    Mirrors the MatrixRSCodec surface (matrix/encode/decode) so the
-    plugin layer treats both identically."""
+    Inherits MatrixRSCodec's encode/decode scaffolding (signature-cached
+    inversion, plan_decode routing) and swaps the two field primitives:
+    the matvec runs split-table word multiplies, the inversion runs
+    GF(2^w) Gauss-Jordan."""
+
+    _matrix_dtype = np.int64
 
     def __init__(self, encode_matrix: np.ndarray, w: int):
-        rows, k = encode_matrix.shape
         assert w in _WORD_DTYPE
         self.w = w
-        self.k = k
-        self.m = rows - k
-        self.matrix = encode_matrix.astype(np.int64)
-        self.coding_rows = self.matrix[k:, :]
+        super().__init__(encode_matrix)
         self._mul_cache: Dict[int, _SplitMul] = {}
-        self._decode_cache: "OrderedDict[Tuple[int, ...], np.ndarray]" = \
-            OrderedDict()
-        self._lock = threading.Lock()
 
     def _mul(self, coeff: int) -> _SplitMul:
         sm = self._mul_cache.get(coeff)
@@ -176,52 +172,5 @@ class WordMatrixCodec:
             out[i] = acc
         return out.view(np.uint8).reshape(r, C)
 
-    # -- MatrixRSCodec surface ----------------------------------------------
-    def encode(self, data: np.ndarray) -> np.ndarray:
-        return self._matvec(self.coding_rows, data)
-
-    def decode_matrix_for(self, available: Sequence[int]
-                          ) -> Tuple[np.ndarray, List[int]]:
-        srcs = sorted(available)[:self.k]
-        key = tuple(srcs)
-        with self._lock:
-            hit = self._decode_cache.get(key)
-            if hit is not None:
-                self._decode_cache.move_to_end(key)
-                return hit, list(key)
-        sub = self.matrix[list(srcs), :]
-        inv = gfw_invert_matrix(sub, self.w)
-        with self._lock:
-            self._decode_cache[key] = inv
-            from ..ec.rs_codec import DECODE_CACHE_ENTRIES
-            if len(self._decode_cache) > DECODE_CACHE_ENTRIES:
-                self._decode_cache.popitem(last=False)
-        return inv, list(srcs)
-
-    def decode(self, chunks: Dict[int, np.ndarray],
-               want: Sequence[int]) -> Dict[int, np.ndarray]:
-        from ..ec.rs_codec import plan_decode
-        if len(chunks) < self.k:
-            raise IOError(
-                f"need at least k={self.k} chunks, have {len(chunks)}")
-        inv, srcs = self.decode_matrix_for(list(chunks))
-        src_stack = np.stack([chunks[i] for i in srcs])
-        out: Dict[int, np.ndarray] = {}
-        _, want_data, want_coding, missing_data = plan_decode(
-            self.k, chunks, want)
-        if want_data or want_coding:
-            rec = self._matvec(inv[missing_data, :], src_stack)
-            data_by_id = dict(zip(missing_data, rec))
-            for i in want_data:
-                out[i] = data_by_id[i]
-            if want_coding:
-                data_full = np.stack([
-                    chunks[i] if i in chunks else data_by_id[i]
-                    for i in range(self.k)])
-                cod = self._matvec(self.matrix[want_coding, :], data_full)
-                for idx, i in enumerate(want_coding):
-                    out[i] = cod[idx]
-        for i in want:
-            if i in chunks:
-                out[i] = chunks[i]
-        return out
+    def _invert(self, sub: np.ndarray) -> np.ndarray:
+        return gfw_invert_matrix(sub, self.w)

@@ -89,6 +89,13 @@ def encode(sinfo: stripe_info_t, ec_impl, data,
     n = ec_impl.get_chunk_count()
     C = sinfo.get_chunk_size()
 
+    if hasattr(ec_impl, "encode_batch_full"):
+        # mapped layered codes (lrc): one batched call yields every
+        # physical chunk directly
+        stripes = buf.reshape(S, k, C)
+        allc = ec_impl.encode_batch_full(stripes)     # (S, n, C)
+        return {i: np.ascontiguousarray(allc[:, i, :]).reshape(-1)
+                for i in want}
     if hasattr(ec_impl, "encode_batch") and not ec_impl.get_chunk_mapping():
         stripes = buf.reshape(S, k, C)
         coding = ec_impl.encode_batch(stripes)        # (S, m, C)
@@ -127,10 +134,13 @@ def decode_concat(sinfo: stripe_info_t, ec_impl,
     k = ec_impl.get_data_chunk_count()
     chunks2d = {i: np.asarray(b, dtype=np.uint8).reshape(S, C)
                 for i, b in to_decode.items()}
-    want = list(range(k))
     if hasattr(ec_impl, "decode_batch"):
-        got = ec_impl.decode_batch(chunks2d, want)
-        data = np.stack([got[i] for i in range(k)], axis=1)  # (S, k, C)
+        # decode_batch is keyed by *physical* chunk ids; logical data row
+        # i lives at chunk_index(i) for mapped codes (lrc)
+        want_phys = [ec_impl.chunk_index(i) for i in range(k)]
+        got = ec_impl.decode_batch(chunks2d, want_phys)
+        data = np.stack([got[want_phys[i]] for i in range(k)],
+                        axis=1)  # (S, k, C)
         return data.reshape(-1)
     outs = []
     for s in range(S):

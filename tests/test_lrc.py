@@ -125,3 +125,46 @@ def test_create_rule_indep_steps():
     assert rno >= 0
     out = cw.do_rule(rno, 42, 8, [0x10000] * 18)
     assert len(out) == 8
+
+
+def test_device_backend_byte_identical():
+    """VERDICT #7: the layered code wired through the device backend —
+    every layer's encode/decode runs the MXU bit-matmul path — must be
+    byte-identical to the host path, including the batched ECUtil entry
+    points (encode_batch_full / decode_batch)."""
+    import numpy as np
+    host = plugin_registry.factory("lrc", {
+        "plugin": "lrc", "k": "4", "m": "2", "l": "3", "backend": "host"})
+    dev = plugin_registry.factory("lrc", {
+        "plugin": "lrc", "k": "4", "m": "2", "l": "3", "backend": "tpu"})
+    # every layer delegate inherited the backend
+    assert all(l.erasure_code.backend_name == "tpu" for l in dev.layers)
+    data = payload(20000, seed=77)
+    n = host.get_chunk_count()
+    eh = host.encode(set(range(n)), data)
+    ed = dev.encode(set(range(n)), data)
+    for i in range(n):
+        np.testing.assert_array_equal(eh[i], ed[i], err_msg=f"chunk {i}")
+    # erasure decode parity (local + global repair)
+    for gone in ([0], [1, 4], [2, 6]):
+        have = {i: ed[i] for i in range(n) if i not in gone}
+        dh = host.decode(set(gone), {i: eh[i] for i in have})
+        dd = dev.decode(set(gone), have)
+        for i in gone:
+            np.testing.assert_array_equal(dh[i], dd[i])
+    # batched paths through ECUtil striping
+    from ceph_tpu.osd.ecutil import stripe_info_t, encode as ec_encode, \
+        decode_concat as ec_decode_concat
+    k = host.get_data_chunk_count()
+    w = host.get_chunk_size(1) * k
+    sinfo = stripe_info_t(k, w)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    buf = np.concatenate([buf, np.zeros((-len(buf)) % w, np.uint8)])
+    sh = ec_encode(sinfo, host, buf, set(range(n)))
+    sd = ec_encode(sinfo, dev, buf, set(range(n)))
+    for i in range(n):
+        np.testing.assert_array_equal(sh[i], sd[i], err_msg=f"shard {i}")
+    # degraded batched read (decode_batch path)
+    avail = {i: sd[i] for i in range(n) if i not in (0, 5)}
+    out = ec_decode_concat(sinfo, dev, avail)
+    np.testing.assert_array_equal(out, buf)
