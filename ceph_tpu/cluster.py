@@ -20,20 +20,60 @@ from .osd.osd import OSD
 
 
 class MiniCluster:
-    def __init__(self, n_osds: int = 6, osds_per_host: int = 1):
+    def __init__(self, n_osds: int = 6, osds_per_host: int = 1,
+                 _stores: Optional[Dict[int, object]] = None,
+                 _bootstrap: bool = True):
         self.network = Network()
         self.mon = Monitor(self.network)
-        self.mon.bootstrap(n_osds, osds_per_host)
+        if _bootstrap:
+            self.mon.bootstrap(n_osds, osds_per_host)
         self.osds: Dict[int, OSD] = {}
         self.perf_collection = PerfCountersCollection()
         for i in range(n_osds):
-            osd = OSD(self.network, i)
+            store = _stores.get(i) if _stores else None
+            osd = OSD(self.network, i, store=store)
             self.osds[i] = osd
             self.mon.subscribe(osd.name)
             self.perf_collection.add(osd.perf_counters)
         self.clock = 0.0
         self.admin_socket = AdminSocket()
         self._register_admin_commands()
+
+    # ---- checkpoint / resume (OSD.cc:2469+ init/resume model) --------------
+    def checkpoint(self, directory: str) -> None:
+        """Persist the whole cluster: mon store + every OSD's object
+        store.  Resume with ``MiniCluster.restore``."""
+        import os
+        os.makedirs(directory, exist_ok=True)
+        self.mon.save(os.path.join(directory, "mon.json"))
+        meta = {"n_osds": len(self.osds)}
+        for i, osd in self.osds.items():
+            osd.store.save(os.path.join(directory, f"osd.{i}.store"))
+        import json
+        with open(os.path.join(directory, "cluster.json"), "w") as f:
+            json.dump(meta, f)
+
+    @classmethod
+    def restore(cls, directory: str) -> "MiniCluster":
+        """Cold-start from a checkpoint: mount every store, load the mon
+        map history, replay to the current epoch, re-peer; objects come
+        back byte-exact."""
+        import json
+        import os
+        from .os_store import MemStore
+        with open(os.path.join(directory, "cluster.json")) as f:
+            meta = json.load(f)
+        n = meta["n_osds"]
+        stores = {i: MemStore.load(os.path.join(directory, f"osd.{i}.store"))
+                  for i in range(n)}
+        c = cls(n_osds=n, _stores=stores, _bootstrap=False)
+        c.mon.load(os.path.join(directory, "mon.json"))
+        # boot: every osd catches up on the full map history and re-peers
+        for osd in c.osds.values():
+            c.mon.send_full_map(osd.name)
+        c.network.pump()
+        c.run_recovery()
+        return c
 
     def _register_admin_commands(self) -> None:
         asok = self.admin_socket
@@ -112,6 +152,21 @@ class MiniCluster:
             if not pushed:
                 break
         return total
+
+    def restart_osd(self, osd_id: int) -> None:
+        """Simulate a daemon restart: a fresh OSD process mounts the same
+        object store — in-memory state (pg logs, inflight ops) must come
+        back from disk (OSD::init, OSD.cc:2469+)."""
+        old = self.osds[osd_id]
+        self.network.set_down(old.name, False)
+        osd = OSD(self.network, osd_id, store=old.store)
+        self.osds[osd_id] = osd
+        self.perf_collection.add(osd.perf_counters)  # replaces by name
+        if not self.mon.osdmap.is_up(osd_id):
+            self.mon.mark_osd_up(osd_id)
+        self.mon.send_full_map(osd.name)
+        self.network.pump()
+        self.run_recovery()
 
     # ---- thrasher API ------------------------------------------------------
     def kill_osd(self, osd_id: int) -> None:

@@ -36,6 +36,9 @@ class Monitor(Dispatcher):
         self.incrementals: List[Incremental] = []
         self.subscribers: List[str] = []
         self._topology_dirty = False  # crush/pools changed since last epoch
+        # failure reports per target (mon_osd_min_down_reporters=2 —
+        # a single partitioned reporter can't take the cluster down)
+        self._failure_reports: Dict[int, set] = {}
 
     # ---- cluster bootstrap -------------------------------------------------
     def bootstrap(self, n_osds: int, osds_per_host: int = 1) -> None:
@@ -160,11 +163,17 @@ class Monitor(Dispatcher):
     def mark_osd_down(self, osd: int) -> None:
         inc = Incremental()
         inc.new_up[osd] = False
+        # a down osd's past failure reports no longer count
+        reporter = f"osd.{osd}"
+        for reps in self._failure_reports.values():
+            reps.discard(reporter)
         self.publish(inc)
 
     def mark_osd_up(self, osd: int) -> None:
         inc = Incremental()
         inc.new_up[osd] = True
+        # recovery voids any partial reports against this osd
+        self._failure_reports.pop(osd, None)
         self.publish(inc)
 
     def mark_osd_out(self, osd: int) -> None:
@@ -177,9 +186,49 @@ class Monitor(Dispatcher):
         inc.new_weight[osd] = CEPH_OSD_IN
         self.publish(inc)
 
+    # ---- durability (mon store, src/mon/MonitorDBStore.h role) -------------
+    def save(self, path: str) -> None:
+        """Persist the authoritative map + full epoch history to a JSON
+        file (the mon store: resume = load + replay)."""
+        import json
+        import os as _os
+        from ..osdmap.encoding import incremental_to_dict, osdmap_to_dict
+        state = {
+            "osdmap": osdmap_to_dict(self.osdmap),
+            "incrementals": [incremental_to_dict(i)
+                             for i in self.incrementals],
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        _os.replace(tmp, path)
+
+    def load(self, path: str) -> None:
+        import json
+        from ..osdmap.encoding import incremental_from_dict, \
+            osdmap_from_dict
+        with open(path) as f:
+            state = json.load(f)
+        self.osdmap = osdmap_from_dict(state["osdmap"])
+        self.incrementals = [incremental_from_dict(i)
+                             for i in state["incrementals"]]
+        self._topology_dirty = False
+
     # ---- dispatch ----------------------------------------------------------
+    def min_down_reporters(self) -> int:
+        n_up = sum(1 for o in range(self.osdmap.max_osd)
+                   if self.osdmap.is_up(o))
+        return 2 if n_up > 2 else 1
+
     def ms_fast_dispatch(self, msg: Message) -> None:
         if isinstance(msg, MOSDFailure):
-            # reference waits for enough reporters; one suffices here
-            if self.osdmap.is_up(msg.target_osd):
+            # OSDMonitor::check_failure quorum: distinct reporters must
+            # agree before the mark (mon_osd_min_down_reporters)
+            if not self.osdmap.is_up(msg.target_osd):
+                return
+            reporters = self._failure_reports.setdefault(
+                msg.target_osd, set())
+            reporters.add(msg.src)
+            if len(reporters) >= self.min_down_reporters():
+                del self._failure_reports[msg.target_osd]
                 self.mark_osd_down(msg.target_osd)

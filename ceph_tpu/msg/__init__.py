@@ -1,6 +1,7 @@
 from .messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
-    MOSDECSubOpWriteReply, MOSDMap, MOSDOp, MOSDOpReply, MOSDPing, Message,
+    MOSDECSubOpWriteReply, MOSDMap, MOSDOp, MOSDOpReply, MOSDPGInfo,
+    MOSDPGQuery, MOSDPGScan, MOSDPGScanReply, MOSDPing, Message,
     MOSDFailure, CEPH_OSD_OP_READ, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_WRITEFULL,
     CEPH_OSD_OP_APPEND, CEPH_OSD_OP_DELETE, CEPH_OSD_OP_STAT,
 )
@@ -8,9 +9,10 @@ from .messenger import Connection, Dispatcher, Messenger, Network
 
 __all__ = [
     "MOSDECSubOpRead", "MOSDECSubOpReadReply", "MOSDECSubOpWrite",
-    "MOSDECSubOpWriteReply", "MOSDMap", "MOSDOp", "MOSDOpReply", "MOSDPing",
-    "Message", "MOSDFailure", "Connection", "Dispatcher", "Messenger",
-    "Network", "CEPH_OSD_OP_READ", "CEPH_OSD_OP_WRITE",
+    "MOSDECSubOpWriteReply", "MOSDMap", "MOSDOp", "MOSDOpReply",
+    "MOSDPGInfo", "MOSDPGQuery", "MOSDPGScan", "MOSDPGScanReply",
+    "MOSDPing", "Message", "MOSDFailure", "Connection", "Dispatcher",
+    "Messenger", "Network", "CEPH_OSD_OP_READ", "CEPH_OSD_OP_WRITE",
     "CEPH_OSD_OP_WRITEFULL", "CEPH_OSD_OP_APPEND", "CEPH_OSD_OP_DELETE",
     "CEPH_OSD_OP_STAT",
 ]

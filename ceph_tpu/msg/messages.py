@@ -73,8 +73,10 @@ class MOSDECSubOpWrite(Message):
     offset: int = 0          # chunk-granularity offset into the shard
     partial: bool = False    # False = whole-shard replace; True = rmw splice
     hash_epoch: int = 0
-    at_version: int = 0
-    trim_to: int = 0
+    at_version: int = 0      # logical object size after the write
+    version: int = 0         # pg_log version of this mutation (0 = none)
+    is_push: bool = False    # recovery push: stamp the version attr but
+    trim_to: int = 0         # do not re-append the (already merged) log
 
 
 @dataclass
@@ -107,6 +109,50 @@ class MOSDECSubOpReadReply(Message):
     data: bytes = b""
     result: int = 0
     attrs: Dict[str, bytes] = field(default_factory=dict)
+
+
+@dataclass
+class MOSDPGQuery(Message):
+    """Primary -> acting shard: report your PG state (peering GetInfo,
+    src/messages/MOSDPGQuery.h).  log_since >= 0 additionally requests the
+    log suffix past that version (the GetLog step folded in)."""
+    pgid: Tuple[int, int] = (0, 0)
+    shard: int = -1
+    epoch: int = 0
+    log_since: int = -1
+
+
+@dataclass
+class MOSDPGInfo(Message):
+    """Shard -> primary peering reply (MOSDPGInfo/MOSDPGLog roles):
+    last_update/log_tail, the replica's own missing set (objects whose
+    log entry was merged but whose data never arrived — pg_missing_t),
+    and an optional serialized log suffix."""
+    pgid: Tuple[int, int] = (0, 0)
+    shard: int = -1
+    epoch: int = 0
+    last_update: int = 0
+    log_tail: int = 0
+    log_entries: List[bytes] = field(default_factory=list)
+    missing_oids: List[Tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class MOSDPGScan(Message):
+    """Primary -> shard: list your objects (backfill scan,
+    src/messages/MOSDPGScan.h)."""
+    pgid: Tuple[int, int] = (0, 0)
+    shard: int = -1
+    epoch: int = 0
+
+
+@dataclass
+class MOSDPGScanReply(Message):
+    pgid: Tuple[int, int] = (0, 0)
+    shard: int = -1
+    epoch: int = 0
+    objects: List[Tuple[str, int]] = field(default_factory=list)
+    # (oid, version) per object on the shard
 
 
 @dataclass

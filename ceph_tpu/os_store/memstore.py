@@ -11,6 +11,7 @@ Transaction ABI the reference uses.
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -98,17 +99,104 @@ class Transaction:
         return not self.ops
 
 
+_MAGIC = b"CTPUSTOR"
+_VERSION = 1
+
+
 class MemStore:
     def __init__(self):
         self.colls: Dict[str, Dict[hobject_t, _Object]] = {}
         self.committed_txns = 0
 
-    # ---- lifecycle --------------------------------------------------------
+    # ---- lifecycle / durability -------------------------------------------
     def mount(self) -> None:
         pass
 
     def umount(self) -> None:
         pass
+
+    def save(self, path: str) -> None:
+        """Persist every collection to *path* (length-prefixed binary; the
+        BlueStore-durability stand-in: checkpoint = this file, resume =
+        ``MemStore.load``)."""
+        import struct as _s
+
+        def pstr(b: bytes) -> bytes:
+            return _s.pack("<I", len(b)) + b
+
+        out = [_MAGIC, _s.pack("<IQ", _VERSION, self.committed_txns),
+               _s.pack("<I", len(self.colls))]
+        for cid in sorted(self.colls):
+            coll = self.colls[cid]
+            out.append(pstr(cid.encode()))
+            out.append(_s.pack("<I", len(coll)))
+            for ho in sorted(coll):
+                o = coll[ho]
+                out.append(pstr(ho.oid.encode()))
+                out.append(_s.pack("<i", ho.shard))
+                out.append(pstr(bytes(o.data)))
+                out.append(_s.pack("<I", len(o.attrs)))
+                for k in sorted(o.attrs):
+                    out.append(pstr(k.encode()))
+                    out.append(pstr(o.attrs[k]))
+                out.append(_s.pack("<I", len(o.omap)))
+                for k in sorted(o.omap):
+                    out.append(pstr(k.encode()))
+                    out.append(pstr(o.omap[k]))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(b"".join(out))
+        os.replace(tmp, path)  # atomic like a journal commit
+
+    @classmethod
+    def load(cls, path: str) -> "MemStore":
+        import struct as _s
+        with open(path, "rb") as f:
+            buf = f.read()
+        if buf[:8] != _MAGIC:
+            raise ValueError(f"{path}: not a ceph_tpu store file")
+        pos = 8
+        version, txns = _s.unpack_from("<IQ", buf, pos)
+        pos += 12
+        if version != _VERSION:
+            raise ValueError(f"{path}: store version {version}")
+
+        def rstr() -> bytes:
+            nonlocal pos
+            (n,) = _s.unpack_from("<I", buf, pos)
+            pos += 4
+            b = buf[pos:pos + n]
+            pos += n
+            return b
+
+        store = cls()
+        store.committed_txns = txns
+        (ncolls,) = _s.unpack_from("<I", buf, pos)
+        pos += 4
+        for _ in range(ncolls):
+            cid = rstr().decode()
+            (nobjs,) = _s.unpack_from("<I", buf, pos)
+            pos += 4
+            coll: Dict[hobject_t, _Object] = {}
+            for _o in range(nobjs):
+                oid = rstr().decode()
+                (shard,) = _s.unpack_from("<i", buf, pos)
+                pos += 4
+                obj = _Object()
+                obj.data = bytearray(rstr())
+                (nattrs,) = _s.unpack_from("<I", buf, pos)
+                pos += 4
+                for _a in range(nattrs):
+                    k = rstr().decode()
+                    obj.attrs[k] = rstr()
+                (nomap,) = _s.unpack_from("<I", buf, pos)
+                pos += 4
+                for _m in range(nomap):
+                    k = rstr().decode()
+                    obj.omap[k] = rstr()
+                coll[hobject_t(oid, shard)] = obj
+            store.colls[cid] = coll
+        return store
 
     # ---- transactions -----------------------------------------------------
     def queue_transaction(self, t: Transaction) -> None:

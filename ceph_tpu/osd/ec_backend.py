@@ -134,6 +134,7 @@ class InflightRead:
     failed: Set[int] = field(default_factory=set)
     seen: int = 0                 # shards that answered at all
     saw_eio: bool = False         # any non-ENOENT shard failure (crc etc.)
+    raw: bool = False             # recovery mode: deliver raw shard chunks
 
 
 @dataclass
@@ -175,6 +176,15 @@ class ECBackend:
     def next_tid(self) -> int:
         self._tid += 1
         return self._tid
+
+    def on_change(self) -> None:
+        """Interval change (new acting set): drop all in-flight state —
+        the reference's ECBackend::on_change; clients resend through the
+        Objecter, so unanswered ops are safe to forget."""
+        self.inflight_writes.clear()
+        self.inflight_reads.clear()
+        self._oid_queues.clear()
+        self.extent_cache = ExtentCache()
 
     def shard_cid(self, shard: int) -> str:
         return f"{self.pg.pgid[0]}.{self.pg.pgid[1]}s{shard}"
@@ -241,7 +251,8 @@ class ECBackend:
         self._fan_out_shards(op.tid, op.oid, shards, chunk_off=0,
                              partial=False, new_size=len(op.data),
                              on_all_commit=all_commit,
-                             client_reply=op.on_commit)
+                             client_reply=op.on_commit,
+                             version=self.pg.next_version())
 
     # ---- rmw pipeline (start_rmw, ECBackend.cc:1793) -----------------------
     def _start_rmw(self, op: RMWOp) -> None:
@@ -327,13 +338,15 @@ class ECBackend:
         self._fan_out_shards(op.tid, op.oid, shards, chunk_off=c0,
                              partial=True, new_size=new_size,
                              on_all_commit=all_commit,
-                             client_reply=op.on_commit)
+                             client_reply=op.on_commit,
+                             version=self.pg.next_version())
 
     def _fan_out_shards(self, tid: int, oid: str,
                         shards: Dict[int, np.ndarray], chunk_off: int,
                         partial: bool, new_size: int,
                         on_all_commit: Callable[[], None],
-                        client_reply: Callable[[int], None]) -> None:
+                        client_reply: Callable[[int], None],
+                        version: int = 0) -> None:
         wr = InflightWrite(tid=tid, oid=oid, client_reply=client_reply,
                            on_all_commit=on_all_commit)
         acting = self.pg.acting_shards()
@@ -342,15 +355,51 @@ class ECBackend:
             msg = MOSDECSubOpWrite(
                 tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
                 chunk=chunk, offset=chunk_off, partial=partial,
-                at_version=new_size)
+                at_version=new_size, version=version)
             wr.pending_shards.add(shard)
             self.pg.send_to_osd(osd, msg)
         self.inflight_writes[tid] = wr
 
-    def handle_sub_write(self, msg: MOSDECSubOpWrite, store: MemStore
-                         ) -> MOSDECSubOpWriteReply:
+    def push_chunks(self, oid: str, shard_data: Dict[int, bytes],
+                    size: int, on_done: Callable[[], None],
+                    version: int = 0) -> int:
+        """Recovery push: whole-shard writes to specific shards only
+        (RecoveryOp pushes, ECBackend.cc:535-743).  is_push: the
+        replica's log already carries the entries (activation), but the
+        object's version attr must be stamped so staleness checks see
+        current data."""
+        tid = self.next_tid()
+        wr = InflightWrite(tid=tid, oid=oid, client_reply=lambda _r: None,
+                           on_all_commit=on_done)
+        acting = self.pg.acting_shards()
+        for shard, chunk in shard_data.items():
+            if shard not in acting:
+                continue
+            msg = MOSDECSubOpWrite(
+                tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
+                chunk=chunk, offset=0, partial=False, at_version=size,
+                version=version, is_push=True)
+            wr.pending_shards.add(shard)
+            self.pg.send_to_osd(acting[shard], msg)
+        if not wr.pending_shards:
+            on_done()
+            return tid
+        self.inflight_writes[tid] = wr
+        return tid
+
+    def read_chunks(self, oid: str,
+                    on_done: Callable[[int, Dict[int, bytes], int], None]
+                    ) -> int:
+        """Recovery read: raw chunks from the cheapest healthy shard set
+        (no decode) — on_done(result, {shard: bytes}, logical_size)."""
+        return self._start_read(oid, 0, 0, False, on_done, raw=True)
+
+    def handle_sub_write(self, msg: MOSDECSubOpWrite, store: MemStore,
+                         pg=None) -> MOSDECSubOpWriteReply:
         """Shard-side apply (ECBackend.cc:921-983): one transaction with
-        chunk data, size attr, and the updated HashInfo.
+        chunk data, size attr, the updated HashInfo, and — for versioned
+        client writes — the pg_log entry (the reference appends the log
+        entry in the same transaction as the data).
 
         Full writes replace the shard; partial (rmw) writes splice the
         chunk range and recompute the shard crc over the spliced body —
@@ -383,7 +432,16 @@ class ECBackend:
         t.setattr(cid, ho, HINFO_ATTR,
                   struct.pack("<QI", hi.total_chunk_size,
                               hi.get_chunk_hash(0)))
+        if msg.version:
+            from .pg_log import VERSION_ATTR
+            t.setattr(cid, ho, VERSION_ATTR,
+                      struct.pack("<Q", msg.version))
+        if pg is not None and msg.version and not msg.is_push:
+            from .pg_log import LogEntry, OP_MODIFY
+            pg.append_log(LogEntry(msg.version, msg.oid, OP_MODIFY), t)
         store.queue_transaction(t)
+        if pg is not None and not msg.partial:
+            pg.data_received(msg.oid)
         return MOSDECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
                                      shard=msg.shard, committed=True)
 
@@ -433,20 +491,23 @@ class ECBackend:
 
     def _start_read(self, oid: str, chunk_off: int, chunk_len: int,
                     attrs_only: bool,
-                    on_done: Callable[[int, bytes, int], None]) -> int:
-        """Fan MOSDECSubOpRead for a chunk range to the cheapest shard set."""
+                    on_done: Callable[[int, bytes, int], None],
+                    raw: bool = False) -> int:
+        """Fan MOSDECSubOpRead for a chunk range to the cheapest shard
+        set.  Shards the primary knows are missing this object are
+        excluded up front (degraded-read gating)."""
         tid = self.next_tid()
         acting = self.pg.acting_shards()
-        avail = set(acting)
+        avail = set(acting) - self.pg.missing_shards_for(oid)
         rd = InflightRead(tid=tid, oid=oid, on_done=on_done,
                           chunk_off=chunk_off, chunk_len=chunk_len,
-                          attrs_only=attrs_only)
+                          attrs_only=attrs_only, raw=raw)
         if attrs_only:
-            # any single shard knows the size attr
-            if not acting:
+            # any single healthy shard knows the size attr
+            if not avail:
                 on_done(-5, b"", -1)
                 return tid
-            shard = min(acting)
+            shard = min(avail)
             rd.pending.add(shard)
             self.inflight_reads[tid] = rd
             self.pg.send_to_osd(acting[shard], MOSDECSubOpRead(
@@ -520,10 +581,10 @@ class ECBackend:
             rd.failed.add(msg.shard)
             if msg.result != -2:
                 rd.saw_eio = True
-            # retry with reconstruction from any other shards (same range)
+            # retry with reconstruction from any other healthy shards
             acting = self.pg.acting_shards()
             others = (set(acting) - set(rd.chunks) - rd.failed
-                      - rd.pending)
+                      - rd.pending - self.pg.missing_shards_for(rd.oid))
             for shard in others:
                 m2 = MOSDECSubOpRead(tid=rd.tid, pgid=self.pg.pgid,
                                      shard=shard, oid=rd.oid,
@@ -548,10 +609,14 @@ class ECBackend:
             return
         if not rd.chunks and rd.failed and not rd.saw_eio:
             # all shards report a clean no-such-object
-            rd.on_done(-2, b"", 0)
+            rd.on_done(-2, b"", 0) if not rd.raw else \
+                rd.on_done(-2, {}, 0)
             return
         if len(rd.chunks) < self.k:
-            rd.on_done(-5, b"", rd.size)
+            rd.on_done(-5, b"" if not rd.raw else {}, rd.size)
+            return
+        if rd.raw:
+            rd.on_done(0, dict(rd.chunks), rd.size)
             return
         arrays = {i: np.frombuffer(b, dtype=np.uint8)
                   for i, b in rd.chunks.items()}

@@ -4,25 +4,33 @@ Mirrors the reference OSD's control surface (src/osd/OSD.{h,cc}): messages
 enter via ms_fast_dispatch (OSD.cc:6594) and route to PGs; MOSDMap applies
 incrementals and advances every PG (handle_osd_map → consume_map); OSD↔OSD
 heartbeats detect silent peers and report them to the mon
-(OSD::heartbeat, OSD.cc:4888; failure reports :7787); recovery pulls
-surviving shards and pushes reconstructed chunks to replacement shards.
+(OSD::heartbeat, OSD.cc:4888; failure reports :7787).
+
+Recovery runs entirely over the message fabric (no peer-heap shortcuts):
+the primary's per-PG missing sets come from pg_log deltas computed during
+peering (PGLog role) or backfill scans; each missing object is recovered
+by reading k healthy chunks (MOSDECSubOpRead), decoding the lost shards'
+chunks on the codec, and pushing them (MOSDECSubOpWrite) — the
+continue_recovery_op flow, ECBackend.cc:535-743.
 """
 from __future__ import annotations
 
 import struct
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..common import OpTracker, PerfCountersBuilder
 from ..crush.constants import CRUSH_ITEM_NONE
 from ..msg import (
     Dispatcher, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDFailure, MOSDMap, MOSDOp, MOSDOpReply,
-    MOSDPing, Message, Network,
+    MOSDPGInfo, MOSDPGQuery, MOSDPGScan, MOSDPGScanReply, MOSDPing,
+    Message, Network,
 )
 from ..os_store import MemStore, Transaction, hobject_t
 from ..osdmap import OSDMap, pg_t
 from .ec_backend import HINFO_ATTR, SIZE_ATTR
 from .pg import PG
+from .pg_log import LogEntry, OP_DELETE
 
 HEARTBEAT_GRACE = 20.0     # osd_heartbeat_grace default (options.cc:2461)
 HEARTBEAT_INTERVAL = 6.0   # osd_heartbeat_interval (options.cc:2456)
@@ -54,14 +62,14 @@ def _build_osd_perf(name: str):
 
 class OSD(Dispatcher):
     def __init__(self, network: Network, osd_id: int,
-                 mon_name: str = "mon"):
+                 mon_name: str = "mon", store: Optional[MemStore] = None):
         self.osd_id = osd_id
         self.name = f"osd.{osd_id}"
         self.network = network
         self.mon_name = mon_name
         self.messenger = network.create_messenger(self.name)
         self.messenger.add_dispatcher_head(self)
-        self.store = MemStore()
+        self.store = store if store is not None else MemStore()
         self.osdmap = OSDMap()
         self.pgs: Dict[Tuple[int, int], PG] = {}
         self._ec_impls: Dict[str, object] = {}
@@ -72,6 +80,8 @@ class OSD(Dispatcher):
         self.op_tracker = OpTracker()
         self._tracked: Dict[Tuple[str, int], object] = {}
         self._recovery_queue: List[PG] = []
+        self._rep_pulls: Dict[int, Callable] = {}
+        self._pull_tid = 0
 
     # legacy-style dict view used by tests / admin socket
     @property
@@ -107,12 +117,28 @@ class OSD(Dispatcher):
         elif isinstance(msg, MOSDECSubOpRead):
             self._handle_sub_read(msg)
         elif isinstance(msg, MOSDECSubOpReadReply):
+            if msg.tid in self._rep_pulls:
+                self._rep_pulls.pop(msg.tid)(msg)
+                return
             pg = self.pgs.get(msg.pgid)
             if pg is not None and pg.backend is not None:
-                if msg.tid in getattr(self, "_recovery_reads", {}):
-                    self._handle_recovery_read_reply(msg)
-                else:
-                    pg.backend.handle_sub_read_reply(msg)
+                pg.backend.handle_sub_read_reply(msg)
+        elif isinstance(msg, MOSDPGQuery):
+            pg = self.pgs.get(msg.pgid)
+            if pg is not None:
+                pg.handle_pg_query(msg)
+        elif isinstance(msg, MOSDPGInfo):
+            pg = self.pgs.get(msg.pgid)
+            if pg is not None:
+                pg.handle_pg_info(msg)
+        elif isinstance(msg, MOSDPGScan):
+            pg = self.pgs.get(msg.pgid)
+            if pg is not None:
+                pg.handle_pg_scan(msg)
+        elif isinstance(msg, MOSDPGScanReply):
+            pg = self.pgs.get(msg.pgid)
+            if pg is not None:
+                pg.handle_pg_scan_reply(msg)
         elif isinstance(msg, MOSDPing):
             self._handle_ping(msg)
 
@@ -121,11 +147,16 @@ class OSD(Dispatcher):
 
     # ---- map handling (OSD::handle_osd_map) --------------------------------
     def _handle_osd_map(self, msg: MOSDMap) -> None:
+        """Apply and consume epoch by epoch: an interval change inside a
+        batch of incrementals (e.g. this osd flapped and the net acting
+        set looks unchanged) must still trigger re-peering — the
+        reference's same_interval_since check walks every epoch too
+        (PG::start_peering_interval)."""
         self.perf_counters.inc(L_OSD_MAP)
         for inc in msg.incrementals:
             if inc.epoch == self.osdmap.epoch + 1:
                 self.osdmap.apply_incremental(inc)
-        self._consume_map()
+                self._consume_map()
 
     def _consume_map(self) -> None:
         # instantiate PGs this osd serves; advance all
@@ -180,7 +211,7 @@ class OSD(Dispatcher):
                 pg.rep_backend.apply_write(msg, self.store)
             return
         if pg is not None and pg.backend is not None:
-            reply = pg.backend.handle_sub_write(msg, self.store)
+            reply = pg.backend.handle_sub_write(msg, self.store, pg=pg)
             self.reply_to(msg, reply)
 
     def _apply_delete(self, msg: MOSDECSubOpWrite) -> None:
@@ -190,21 +221,42 @@ class OSD(Dispatcher):
         else:
             cid = f"{msg.pgid[0]}.{msg.pgid[1]}s{msg.shard}"
             ho = hobject_t(msg.oid, msg.shard)
+        pg = self.pgs.get(msg.pgid)
+        t = Transaction()
         if self.store.collection_exists(cid):
-            t = Transaction()
             t.remove(cid, ho)
+        if pg is not None and msg.version:
+            pg.append_log(LogEntry(msg.version, msg.oid, OP_DELETE), t)
+        if not t.empty():
             self.store.queue_transaction(t)
+        if pg is not None:
+            pg.data_received(msg.oid)  # debt settled: object is gone
 
     def _handle_sub_read(self, msg: MOSDECSubOpRead) -> None:
         self.perf_counters.inc(L_OSD_SUBOP_R)
         pg = self.pgs.get(msg.pgid)
-        if pg is not None and pg.backend is not None:
-            reply = pg.backend.handle_sub_read(msg, self.store)
-            self.reply_to(msg, reply)
-        else:
+        if pg is None:
             self.reply_to(msg, MOSDECSubOpReadReply(
                 tid=msg.tid, pgid=msg.pgid, shard=msg.shard, oid=msg.oid,
                 result=-11))
+            return
+        if msg.shard < 0:
+            # replicated full-object read (recovery pulls)
+            data = pg.rep_backend.read(msg.oid) \
+                if pg.rep_backend is not None else None
+            if data is None:
+                self.reply_to(msg, MOSDECSubOpReadReply(
+                    tid=msg.tid, pgid=msg.pgid, shard=-1, oid=msg.oid,
+                    result=-2))
+            else:
+                self.reply_to(msg, MOSDECSubOpReadReply(
+                    tid=msg.tid, pgid=msg.pgid, shard=-1, oid=msg.oid,
+                    data=data, result=0,
+                    attrs={SIZE_ATTR: struct.pack("<Q", len(data))}))
+            return
+        if pg.backend is not None:
+            reply = pg.backend.handle_sub_read(msg, self.store)
+            self.reply_to(msg, reply)
 
     # ---- heartbeats / failure detection -----------------------------------
     def tick(self, now: float) -> None:
@@ -236,118 +288,136 @@ class OSD(Dispatcher):
             self.last_ping_reply[peer] = self.now
             self.reported_failures.discard(peer)
 
-    # ---- recovery ---------------------------------------------------------
+    # ---- recovery (message-driven; ECBackend.cc:535-743) -------------------
     def request_recovery(self, pg: PG) -> None:
         if pg not in self._recovery_queue:
             self._recovery_queue.append(pg)
 
     def run_recovery(self) -> int:
-        """Drive queued PG recovery; returns number of pushed shards.
-
-        The primary lists objects on its own shard (it is always a data
-        holder after peering), reads k source chunks for any object a
-        replacement shard lacks, decodes that shard's chunk and pushes it
-        (continue_recovery_op semantics, ECBackend.cc:535-743).
-        """
-        pushed = 0
+        """Drive queued PG recovery; returns recoveries initiated.  All
+        data movement is messages; completions chain through the fabric."""
+        started = 0
         queue, self._recovery_queue = self._recovery_queue, []
         for pg in queue:
-            if pg.backend is None:
-                pushed += self._recover_replicated(pg)
-                continue
-            pushed += self._recover_ec(pg)
-        return pushed
+            started += self._continue_pg_recovery(pg)
+        return started
 
-    def _recover_ec(self, pg: PG) -> int:
+    def _continue_pg_recovery(self, pg: PG) -> int:
+        if not pg.is_primary():
+            return 0
+        started = 0
+        # own shard first: the primary's store must become authoritative
+        # before backfill diffs use it
+        my = pg.my_shard()
+        shards = sorted(pg.missing, key=lambda s: (s != my, s))
+        for shard in shards:
+            for oid in list(pg.missing.get(shard, {})):
+                if oid not in pg._recovering:
+                    self.recover_oid(pg, oid)
+                    started += 1
+        return started
+
+    def recover_oid(self, pg: PG, oid: str) -> None:
+        """Recover one object on every shard missing it."""
+        if oid in pg._recovering:
+            return
+        targets = {s: pg.missing[s][oid]
+                   for s in pg.missing if oid in pg.missing[s]}
+        if not targets:
+            pg.recovery_done_for(oid)
+            return
+        pg._recovering.add(oid)
+        if all(op == OP_DELETE for (_v, op) in targets.values()):
+            for s, (v, _op) in targets.items():
+                osd = pg.acting_shards().get(s)
+                if osd is not None:
+                    pg.send_to_osd(osd, MOSDECSubOpWrite(
+                        tid=0, pgid=pg.pgid,
+                        shard=s if pg.backend is not None else -1,
+                        oid=oid, chunk=b"", at_version=-1, version=v))
+                pg.missing[s].pop(oid, None)
+            pg.recovery_done_for(oid)
+            return
+        if pg.backend is not None:
+            self._recover_ec_oid(pg, oid, targets)
+        else:
+            self._recover_rep_oid(pg, oid, targets)
+
+    def _recover_ec_oid(self, pg: PG, oid: str,
+                        targets: Dict[int, Tuple[int, str]]) -> None:
         be = pg.backend
-        my_shard = pg.my_shard()
-        if my_shard < 0:
-            return 0
-        my_cid = be.shard_cid(my_shard)
-        if not self.store.collection_exists(my_cid):
-            # new primary without data: pull the object list lazily from
-            # another shard via recovery reads below (object registry =
-            # union of shard listings; empty until peers push)
-            return 0
-        pushed = 0
-        objects = [ho.oid for ho in self.store.list_objects(my_cid)]
+        needed = sorted(s for s, (_v, op) in targets.items()
+                        if op != OP_DELETE)
+
+        def on_chunks(result: int, chunks: Dict[int, bytes],
+                      size: int) -> None:
+            if result != 0:
+                # sources unavailable right now; retry on the next kick
+                pg._recovering.discard(oid)
+                self.request_recovery(pg)
+                return
+            rec = be.recover_object(oid, set(needed), chunks, size)
+            version = max(v for (v, _op) in targets.values())
+
+            def pushed() -> None:
+                for s in needed:
+                    pg.missing.get(s, {}).pop(oid, None)
+                self.perf_counters.inc(L_OSD_RECOVERY_PUSH, len(needed))
+                pg.recovery_done_for(oid)
+
+            be.push_chunks(oid, {s: rec[s] for s in needed}, size, pushed,
+                           version=version)
+
+        be.read_chunks(oid, on_chunks)
+
+    def _recover_rep_oid(self, pg: PG, oid: str,
+                         targets: Dict[int, Tuple[int, str]]) -> None:
+        data = pg.rep_backend.read(oid)
+        if data is not None:
+            self._push_rep(pg, oid, data, targets)
+            return
+        # primary lacks its own copy: pull from a peer that has it
+        srcs = [s for s, osd in pg.acting_shards().items()
+                if s not in targets and osd != self.osd_id]
+        if not srcs:
+            pg._recovering.discard(oid)
+            return
+        self._pull_tid += 1
+        tid = self._pull_tid
+
+        def on_pull(msg: MOSDECSubOpReadReply) -> None:
+            if msg.result != 0:
+                pg._recovering.discard(oid)
+                self.request_recovery(pg)
+                return
+            # apply locally, then fan to the other missing shards
+            my = pg.my_shard()
+            v = targets.get(my, (0, ""))[0]
+            wr = MOSDECSubOpWrite(tid=0, pgid=pg.pgid, shard=-1, oid=oid,
+                                  chunk=msg.data, offset=0, partial=False,
+                                  at_version=len(msg.data), version=v,
+                                  is_push=True)
+            pg.rep_backend.apply_write(wr, self.store)
+            pg.missing.get(my, {}).pop(oid, None)
+            rest = {s: t for s, t in targets.items() if s != my}
+            self._push_rep(pg, oid, msg.data, rest)
+
+        self._rep_pulls[tid] = on_pull
+        pg.send_to_osd(pg.acting_shards()[srcs[0]], MOSDECSubOpRead(
+            tid=tid, pgid=pg.pgid, shard=-1, oid=oid))
+
+    def _push_rep(self, pg: PG, oid: str, data: bytes,
+                  targets: Dict[int, Tuple[int, str]]) -> None:
         acting = pg.acting_shards()
-        for oid in objects:
-            missing: Dict[int, int] = {}
-            for shard, osd in acting.items():
-                holder = self._peer_osd(osd)
-                cid = be.shard_cid(shard)
-                ho = hobject_t(oid, shard)
-                if holder is None:
-                    continue
-                if not holder.store.collection_exists(cid) or \
-                        not holder.store.exists(cid, ho):
-                    missing[shard] = osd
-            if not missing:
+        for s, (v, _op) in targets.items():
+            osd = acting.get(s)
+            if osd is None or osd == self.osd_id:
                 continue
-            sources: Dict[int, bytes] = {}
-            logical = 0
-            for shard, osd in acting.items():
-                if shard in missing or len(sources) >= be.k:
-                    continue
-                holder = self._peer_osd(osd)
-                if holder is None:
-                    continue
-                cid = be.shard_cid(shard)
-                ho = hobject_t(oid, shard)
-                try:
-                    sources[shard] = holder.store.read(cid, ho)
-                    logical = struct.unpack(
-                        "<Q", holder.store.getattr(cid, ho, SIZE_ATTR))[0]
-                except KeyError:
-                    continue
-            if len(sources) < be.k:
-                continue
-            rec = be.recover_object(oid, set(missing), sources, logical)
-            for shard, osd in missing.items():
-                push = MOSDECSubOpWrite(
-                    tid=be.next_tid(), pgid=pg.pgid, shard=shard, oid=oid,
-                    chunk=rec[shard], at_version=logical)
-                pg.send_to_osd(osd, push)
-                self.perf_counters.inc(L_OSD_RECOVERY_PUSH)
-                pushed += 1
-        return pushed
-
-    def _recover_replicated(self, pg: PG) -> int:
-        cid = pg.rep_backend.cid()
-        if not self.store.collection_exists(cid):
-            return 0
-        pushed = 0
-        acting = [o for o in pg.acting if o != CRUSH_ITEM_NONE]
-        for ho in self.store.list_objects(cid):
-            data = self.store.read(cid, ho)
-            size = struct.unpack(
-                "<Q", self.store.getattr(cid, ho, SIZE_ATTR))[0]
-            for osd in acting:
-                holder = self._peer_osd(osd)
-                if holder is None or holder.store.exists(cid, ho):
-                    continue
-                push = MOSDECSubOpWrite(tid=0, pgid=pg.pgid, shard=-1,
-                                        oid=ho.oid, chunk=data,
-                                        at_version=size)
-                pg.send_to_osd(osd, push)
-                self.perf_counters.inc(L_OSD_RECOVERY_PUSH)
-                pushed += 1
-        return pushed
-
-    def _peer_osd(self, osd_id: int) -> Optional["OSD"]:
-        """Peer store visibility for recovery planning.
-
-        The reference primary learns peer completeness from pg_log/backfill
-        scans over the wire; the single-process equivalent inspects the
-        peer's store directly for the *plan*, while all data movement still
-        flows through messages.
-        """
-        ep = self.network.endpoints.get(f"osd.{osd_id}")
-        if ep is None or f"osd.{osd_id}" in self.network.down:
-            return None
-        d = ep.dispatcher
-        return d if isinstance(d, OSD) else None
-
-    def _handle_recovery_read_reply(self, msg) -> None:
-        pass
+            pg.send_to_osd(osd, MOSDECSubOpWrite(
+                tid=0, pgid=pg.pgid, shard=-1, oid=oid, chunk=data,
+                offset=0, partial=False, at_version=len(data),
+                version=v, is_push=True))
+            self.perf_counters.inc(L_OSD_RECOVERY_PUSH)
+        for s in list(targets):
+            pg.missing.get(s, {}).pop(oid, None)
+        pg.recovery_done_for(oid)

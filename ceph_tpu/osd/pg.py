@@ -1,12 +1,26 @@
-"""PG — placement group with a peering-lite state machine.
+"""PG — placement group with log-based peering and recovery.
 
 The reference drives each PG through a boost::statechart RecoveryMachine
-(src/osd/PG.h:1879: Initial/Peering/Active/...); here the same lifecycle is
-a small explicit state machine: on every map epoch the PG recomputes
-up/acting (AdvMap), re-peers when membership changed, and schedules
-shard recovery for acting members that lack data (the ECBackend recovery
-flow, src/osd/ECBackend.cc:535-743).  Ops only execute in the Active state
-on the primary (PrimaryLogPG::do_op gating).
+(src/osd/PG.h:1879: Initial/Peering(GetInfo/GetLog/GetMissing)/Active
+(Activating/Recovering/Backfilling)); here the same lifecycle is an
+explicit state machine driven entirely by messages over the fabric:
+
+- AdvMap: on every epoch the PG recomputes up/acting; a changed acting set
+  puts the primary into PEERING and fans MOSDPGQuery to every acting
+  shard (GetInfo).
+- GetLog: if a peer reports a newer last_update, the primary fetches the
+  authoritative log suffix and merges it (PGLog.merge_authoritative).
+- GetMissing: each peer's missing set is computed from the log suffix
+  past its reported last_update (log-bounded delta recovery, PGLog.h
+  role); peers beyond the log tail go through backfill (MOSDPGScan
+  listing diff).
+- Activation: the primary ships each peer the log suffix it lacks
+  (MOSDPGInfo activate=True) and goes ACTIVE; ops flow while recovery
+  pushes reconstructed chunks in the background (ECBackend.cc:535-743).
+
+Client ops on degraded objects are gated: reads exclude shards missing
+the object; rmw writes recover the object first (PrimaryLogPG's
+wait_for_missing_object semantics).
 """
 from __future__ import annotations
 
@@ -17,10 +31,12 @@ from ..crush.constants import CRUSH_ITEM_NONE
 from ..msg import (
     CEPH_OSD_OP_APPEND, CEPH_OSD_OP_DELETE, CEPH_OSD_OP_READ,
     CEPH_OSD_OP_STAT, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_WRITEFULL,
-    MOSDOp, MOSDOpReply, Message,
+    MOSDOp, MOSDOpReply, MOSDPGInfo, MOSDPGQuery, MOSDPGScan,
+    MOSDPGScanReply, Message,
 )
 from ..os_store import Transaction, hobject_t
 from .ec_backend import ECBackend, SIZE_ATTR
+from .pg_log import LogEntry, OP_DELETE, OP_MODIFY, PGLog, PG_META_OID
 
 STATE_INITIAL = "initial"
 STATE_PEERING = "peering"
@@ -39,10 +55,7 @@ class ReplicatedBackend:
         return f"{self.pg.pgid[0]}.{self.pg.pgid[1]}"
 
     def write(self, oid: str, data: bytes, offset: Optional[int] = None,
-              full: bool = False) -> None:
-        """full=True replaces the object; otherwise an offset write
-        (offset=None appends at the current size, read from the primary's
-        own full copy)."""
+              full: bool = False, version: int = 0) -> None:
         from ..msg.messages import MOSDECSubOpWrite
         if full:
             off, partial = 0, False
@@ -58,7 +71,8 @@ class ReplicatedBackend:
                 continue
             msg = MOSDECSubOpWrite(tid=0, pgid=self.pg.pgid, shard=-1,
                                    oid=oid, chunk=data, offset=off,
-                                   partial=partial, at_version=new_size)
+                                   partial=partial, at_version=new_size,
+                                   version=version)
             self.pg.send_to_osd(osd, msg)
 
     def apply_write(self, msg, store) -> None:
@@ -71,7 +85,17 @@ class ReplicatedBackend:
             t.truncate(cid, ho, 0)
         t.write(cid, ho, msg.offset, msg.chunk)
         t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", msg.at_version))
+        if msg.version:
+            from .pg_log import VERSION_ATTR
+            t.setattr(cid, ho, VERSION_ATTR, struct.pack("<Q", msg.version))
+            if not msg.is_push:
+                self.pg.append_log(
+                    LogEntry(msg.version, msg.oid, OP_MODIFY), t)
         store.queue_transaction(t)
+        if not msg.partial:
+            self.pg.data_received(msg.oid)
+        if not msg.partial:
+            self.pg.data_received(msg.oid)
 
     def read(self, oid: str) -> Optional[bytes]:
         store = self.pg.osd.store
@@ -100,8 +124,33 @@ class PG:
             self.backend = ECBackend(self, ec_impl, pool.stripe_width)
         else:
             self.rep_backend = ReplicatedBackend(self)
+        # log + versions (one per PG replica; persists in the meta coll)
+        self.pg_log = PGLog()
+        self.pg_log.load(osd.store, self.meta_cid())
+        self._version_alloc = self.pg_log.head
+        # replica-side: objects whose log entries arrived (activation)
+        # but whose data has not (pg_missing_t role) — rebuilt from
+        # log-vs-store on mount so restarts don't forget
+        self.local_missing: Dict[str, Tuple[int, str]] = {}
+        self._rebuild_local_missing()
+        # primary-side peering/recovery state
+        self.peer_last_update: Dict[int, int] = {}
+        self.missing: Dict[int, Dict[str, Tuple[int, str]]] = {}
+        self._peer_pending: Set[int] = set()
+        self._peer_infos: Dict[int, MOSDPGInfo] = {}
+        self._getlog_pending: Optional[int] = None
+        self._backfill_pending: Set[int] = set()
+        self._self_backfill_from: Optional[int] = None
+        self._recovering: Set[str] = set()
+        self._waiting_for_recovery: Dict[str, List[Callable[[], None]]] = {}
 
-    # ---- topology ---------------------------------------------------------
+    # ---- identity ---------------------------------------------------------
+    def meta_cid(self) -> str:
+        """Per-PG-replica meta collection (log + superblock attrs); named
+        independently of the acting shard position, which changes on
+        remap."""
+        return f"{self.pgid[0]}.{self.pgid[1]}_meta"
+
     def is_primary(self) -> bool:
         return self.acting_primary == self.osd.osd_id
 
@@ -119,7 +168,71 @@ class PG:
     def send_to_osd(self, osd_id: int, msg: Message) -> None:
         self.osd.messenger.send_message(msg, f"osd.{osd_id}")
 
-    # ---- peering-lite (AdvMap/ActMap events) ------------------------------
+    def next_version(self) -> int:
+        self._version_alloc = max(self._version_alloc,
+                                  self.pg_log.head) + 1
+        return self._version_alloc
+
+    def append_log(self, entry: LogEntry, t: Transaction) -> None:
+        """Stage a log append into *t* (the data-write transaction)."""
+        cid = self.meta_cid()
+        if not self.osd.store.collection_exists(cid):
+            pre = Transaction()
+            pre.create_collection(cid)
+            t.ops[0:0] = pre.ops
+        if entry.version > self.pg_log.head:
+            self.pg_log.append(entry, t, cid)
+
+    def _rebuild_local_missing(self) -> None:
+        """Mount-time: any logged modify whose object is absent — or
+        present at an older version — is data this replica never
+        received."""
+        latest: Dict[str, Tuple[int, str]] = {}
+        for e in self.pg_log.entries:
+            latest[e.oid] = (e.version, e.op)
+        for oid, (v, op) in latest.items():
+            if op == OP_DELETE:
+                continue
+            if not self._have_version(oid, v):
+                self.local_missing[oid] = (v, op)
+
+    def _object_version(self, oid: str) -> int:
+        """Stored pg_log version of this replica's copy (-1 = absent,
+        0 = pre-log object)."""
+        from .pg_log import VERSION_ATTR
+        store = self.osd.store
+        if self.backend is not None:
+            prefix = f"{self.pgid[0]}.{self.pgid[1]}s"
+            cids = [cid for cid in store.list_collections()
+                    if cid.startswith(prefix)]
+        else:
+            cids = [f"{self.pgid[0]}.{self.pgid[1]}"]
+        best = -1
+        for cid in cids:
+            if not store.collection_exists(cid):
+                continue
+            for ho in store.list_objects(cid):
+                if ho.oid != oid:
+                    continue
+                try:
+                    v = struct.unpack(
+                        "<Q", store.getattr(cid, ho, VERSION_ATTR))[0]
+                except KeyError:
+                    v = 0
+                best = max(best, v)
+        return best
+
+    def _have_version(self, oid: str, version: int) -> bool:
+        return self._object_version(oid) >= version
+
+    def _have_object(self, oid: str) -> bool:
+        return self._object_version(oid) >= 0
+
+    def data_received(self, oid: str) -> None:
+        """A full copy/chunk of *oid* landed on this replica."""
+        self.local_missing.pop(oid, None)
+
+    # ---- peering (GetInfo / GetLog / GetMissing / Activate) ----------------
     def advance_map(self, osdmap) -> None:
         from ..osdmap import pg_t
         up, upp, acting, actp = osdmap.pg_to_up_acting_osds(
@@ -127,16 +240,310 @@ class PG:
         changed = (acting != self.acting or actp != self.acting_primary)
         self.up, self.up_primary = up, upp
         self.acting, self.acting_primary = acting, actp
-        if changed or self.state == STATE_INITIAL:
-            self.state = STATE_PEERING
-            # peering-lite: membership is authoritative from the map; data
-            # completeness is restored by recovery below
-            self.last_epoch_started = osdmap.epoch
-            if self.is_primary():
-                self.state = STATE_ACTIVE
-                self.osd.request_recovery(self)
+        if not (changed or self.state == STATE_INITIAL):
+            return
+        self.last_epoch_started = osdmap.epoch
+        if not self.is_primary():
+            # replicas serve sub-ops; the primary drives consistency
+            self.state = STATE_ACTIVE
+            return
+        self.start_peering(osdmap.epoch)
+
+    def start_peering(self, epoch: int) -> None:
+        self.state = STATE_PEERING
+        self.peering_epoch = epoch
+        self._peer_infos.clear()
+        self._getlog_pending = None
+        self._backfill_pending.clear()
+        self._self_backfill_from = None
+        self.missing = {}
+        self._recovering.clear()
+        self._waiting_for_recovery.clear()
+        if self.backend is not None:
+            self.backend.on_change()
+        self._peer_pending = set(self.acting_shards())
+        for shard, osd in self.acting_shards().items():
+            self.send_to_osd(osd, MOSDPGQuery(
+                pgid=self.pgid, shard=shard, epoch=epoch))
+
+    def handle_pg_query(self, msg: MOSDPGQuery) -> None:
+        """Any replica (incl. the primary itself): report state; attach
+        the log suffix when asked (GetLog)."""
+        entries: List[bytes] = []
+        if msg.log_since >= 0:
+            suffix = self.pg_log.entries_after(msg.log_since)
+            if suffix:
+                entries = [e.encode() for e in suffix]
+        self.osd.messenger.send_message(MOSDPGInfo(
+            pgid=self.pgid, shard=msg.shard, epoch=msg.epoch,
+            last_update=self.pg_log.head, log_tail=self.pg_log.tail,
+            log_entries=entries,
+            missing_oids=[(o, v) for o, (v, _op)
+                          in self.local_missing.items()]), msg.src)
+
+    def handle_pg_info(self, msg: MOSDPGInfo) -> None:
+        if not self.is_primary():
+            self._apply_activation(msg)
+            return
+        if msg.epoch != getattr(self, "peering_epoch", msg.epoch):
+            return  # reply from a superseded peering round
+        if self._getlog_pending is not None and \
+                msg.shard == self._getlog_pending:
+            if msg.log_entries:
+                self._merge_auth_log(msg)
             else:
-                self.state = STATE_ACTIVE
+                # authority's log is trimmed past our head: our log can't
+                # catch up — adopt the authoritative head and backfill
+                # ourselves from the authority's listing
+                self._adopt_head_and_self_backfill(msg)
+            return
+        if self.state != STATE_PEERING:
+            return
+        self._peer_infos[msg.shard] = msg
+        self._peer_pending.discard(msg.shard)
+        if not self._peer_pending:
+            self._peering_all_infos()
+
+    def _peering_all_infos(self) -> None:
+        infos = self._peer_infos
+        auth_shard, auth_lu = None, self.pg_log.head
+        for shard, info in infos.items():
+            if info.last_update > auth_lu:
+                auth_shard, auth_lu = shard, info.last_update
+        if auth_shard is not None:
+            # GetLog: pull the authoritative suffix before activating
+            self._getlog_pending = auth_shard
+            osd = self.acting_shards()[auth_shard]
+            self.send_to_osd(osd, MOSDPGQuery(
+                pgid=self.pgid, shard=auth_shard,
+                epoch=self.last_epoch_started,
+                log_since=self.pg_log.head))
+            return
+        self._activate()
+
+    def _merge_auth_log(self, msg: MOSDPGInfo) -> None:
+        entries = [LogEntry.decode(b) for b in msg.log_entries]
+        my_old_head = self.pg_log.head
+        t = Transaction()
+        cid = self.meta_cid()
+        if not self.osd.store.collection_exists(cid):
+            t.create_collection(cid)
+        self.pg_log.merge_authoritative(entries, t, cid)
+        self.osd.store.queue_transaction(t)
+        self._version_alloc = max(self._version_alloc, self.pg_log.head)
+        # everything merged is missing on our own shard
+        mine = self.missing.setdefault(self.my_shard(), {})
+        for e in entries:
+            if e.version > my_old_head:
+                mine[e.oid] = (e.version, e.op)
+                if e.op != OP_DELETE:
+                    self.local_missing[e.oid] = (e.version, e.op)
+        self._getlog_pending = None
+        self._activate()
+
+    def _adopt_head_and_self_backfill(self, msg: MOSDPGInfo) -> None:
+        """Primary beyond the authority's log tail: no entry replay is
+        possible.  Adopt the authoritative head (so versions stay
+        monotonic) and diff our store against the authority's listing."""
+        import struct as _s
+        from .pg_log import LAST_UPDATE_ATTR, LOG_TAIL_ATTR, PG_META_OID
+        self.pg_log.head = max(self.pg_log.head, msg.last_update)
+        self.pg_log.tail = self.pg_log.head
+        self.pg_log.entries = []
+        t = Transaction()
+        cid = self.meta_cid()
+        if not self.osd.store.collection_exists(cid):
+            t.create_collection(cid)
+        meta = hobject_t(PG_META_OID)
+        t.touch(cid, meta)
+        t.setattr(cid, meta, LAST_UPDATE_ATTR,
+                  _s.pack("<Q", self.pg_log.head))
+        t.setattr(cid, meta, LOG_TAIL_ATTR, _s.pack("<Q", self.pg_log.tail))
+        self.osd.store.queue_transaction(t)
+        self._version_alloc = max(self._version_alloc, self.pg_log.head)
+        auth = self._getlog_pending
+        self._getlog_pending = None
+        self._self_backfill_from = auth
+        self.send_to_osd(self.acting_shards()[auth], MOSDPGScan(
+            pgid=self.pgid, shard=auth, epoch=self.peering_epoch))
+        self._activate()
+
+    def _activate(self) -> None:
+        """GetMissing + Activate: compute per-shard deltas from the
+        (now authoritative) log plus each replica's own reported missing
+        set; ship peers the suffix they lack."""
+        my_shard = self.my_shard()
+        for oid, (v, op) in self.local_missing.items():
+            self.missing.setdefault(my_shard, {}).setdefault(oid, (v, op))
+        for shard, info in self._peer_infos.items():
+            self.peer_last_update[shard] = info.last_update
+            if shard == my_shard:
+                continue
+            delta = self.pg_log.missing_after(info.last_update)
+            if delta is None:
+                # peer is beyond the log tail: backfill via listing diff
+                self._backfill_pending.add(shard)
+                self.send_to_osd(self.acting_shards()[shard], MOSDPGScan(
+                    pgid=self.pgid, shard=shard,
+                    epoch=self.peering_epoch))
+            elif delta:
+                self.missing[shard] = dict(delta)
+            # plus whatever the replica itself knows it never received
+            for oid, v in info.missing_oids:
+                self.missing.setdefault(shard, {}).setdefault(
+                    oid, (v, OP_MODIFY))
+            # activation: ship the log suffix the peer lacks
+            suffix = self.pg_log.entries_after(info.last_update) or []
+            self.send_to_osd(self.acting_shards()[shard], MOSDPGInfo(
+                pgid=self.pgid, shard=shard,
+                epoch=self.peering_epoch,
+                last_update=self.pg_log.head,
+                log_tail=self.pg_log.tail,
+                log_entries=[e.encode() for e in suffix]))
+        self.state = STATE_ACTIVE_RECOVERING if self._has_missing() \
+            else STATE_ACTIVE
+        if self.state == STATE_ACTIVE_RECOVERING or self._backfill_pending:
+            self.osd.request_recovery(self)
+
+    def _apply_activation(self, msg: MOSDPGInfo) -> None:
+        """Replica side: adopt the authoritative log suffix.  Modify
+        entries whose data has not arrived are recorded in local_missing
+        (the head advances, the data debt does not vanish — pg_missing_t);
+        delete entries apply immediately (reference merge_log)."""
+        entries = [LogEntry.decode(b) for b in msg.log_entries]
+        if not entries:
+            return
+        my_old_head = self.pg_log.head
+        t = Transaction()
+        cid = self.meta_cid()
+        if not self.osd.store.collection_exists(cid):
+            t.create_collection(cid)
+        self.pg_log.merge_authoritative(entries, t, cid)
+        latest: Dict[str, Tuple[int, str]] = {}
+        for e in entries:
+            if e.version > my_old_head:
+                latest[e.oid] = (e.version, e.op)
+        for oid, (v, op) in latest.items():
+            if op == OP_DELETE:
+                self.local_missing.pop(oid, None)
+                self._stage_local_delete(oid, t)
+            elif not self._have_version(oid, v):
+                # absent OR present at an older version: data debt
+                self.local_missing[oid] = (v, op)
+        self.osd.store.queue_transaction(t)
+
+    def _stage_local_delete(self, oid: str, t: Transaction) -> None:
+        store = self.osd.store
+        if self.backend is not None:
+            prefix = f"{self.pgid[0]}.{self.pgid[1]}s"
+            for cid in store.list_collections():
+                if cid.startswith(prefix):
+                    for ho in store.list_objects(cid):
+                        if ho.oid == oid:
+                            t.remove(cid, ho)
+        else:
+            cid = f"{self.pgid[0]}.{self.pgid[1]}"
+            if store.collection_exists(cid) and \
+                    store.exists(cid, hobject_t(oid)):
+                t.remove(cid, hobject_t(oid))
+
+    def handle_pg_scan(self, msg: MOSDPGScan) -> None:
+        """Backfill scan: list (oid, version) on this replica's shard."""
+        store = self.osd.store
+        objects: List[Tuple[str, int]] = []
+        cid = self._data_cid()
+        if cid and store.collection_exists(cid):
+            for ho in store.list_objects(cid):
+                if ho.oid == PG_META_OID:
+                    continue
+                objects.append((ho.oid, 0))
+        self.osd.messenger.send_message(MOSDPGScanReply(
+            pgid=self.pgid, shard=msg.shard, epoch=msg.epoch,
+            objects=objects), msg.src)
+
+    def _data_cid(self) -> Optional[str]:
+        if self.backend is not None:
+            s = self.my_shard()
+            return self.backend.shard_cid(s) if s >= 0 else None
+        return self.rep_backend.cid()
+
+    def handle_pg_scan_reply(self, msg: MOSDPGScanReply) -> None:
+        if not self.is_primary():
+            return
+        if msg.epoch != getattr(self, "peering_epoch", msg.epoch):
+            return  # stale round
+        if msg.shard == self._self_backfill_from:
+            # our own backfill: whatever the authority lists and we lack
+            # is missing on us; our extras were deleted while we were out
+            self._self_backfill_from = None
+            my = self.my_shard()
+            auth_objects = {o for o, _v in msg.objects}
+            for oid in auth_objects:
+                if not self._have_object(oid):
+                    self.local_missing[oid] = (self.pg_log.head, OP_MODIFY)
+                    self.missing.setdefault(my, {}).setdefault(
+                        oid, (self.pg_log.head, OP_MODIFY))
+            mine = self._authoritative_objects()
+            t = Transaction()
+            for oid in set(mine) - auth_objects:
+                self._stage_local_delete(oid, t)
+            if not t.empty():
+                self.osd.store.queue_transaction(t)
+            if self._has_missing():
+                self.state = STATE_ACTIVE_RECOVERING
+                self.osd.request_recovery(self)
+            return
+        self._backfill_pending.discard(msg.shard)
+        peer_objects = {o for o, _v in msg.objects}
+        auth = self._authoritative_objects()
+        delta: Dict[str, Tuple[int, str]] = {}
+        for oid, version in auth.items():
+            if oid not in peer_objects:
+                delta[oid] = (version, OP_MODIFY)
+        for oid in peer_objects - set(auth):
+            delta[oid] = (self.pg_log.head, OP_DELETE)
+        if delta:
+            self.missing.setdefault(msg.shard, {}).update(delta)
+            self.state = STATE_ACTIVE_RECOVERING
+            self.osd.request_recovery(self)
+        elif not self._has_missing() and not self._backfill_pending:
+            self.state = STATE_ACTIVE
+
+    def _authoritative_objects(self) -> Dict[str, int]:
+        """oid -> version for every live object (primary's own store is
+        authoritative once self-recovery has drained)."""
+        store = self.osd.store
+        out: Dict[str, int] = {}
+        cid = self._data_cid()
+        if cid and store.collection_exists(cid):
+            for ho in store.list_objects(cid):
+                if ho.oid != PG_META_OID:
+                    out[ho.oid] = 0
+        # objects newer than the store view (log wins)
+        for e in self.pg_log.entries:
+            if e.op == OP_DELETE:
+                out.pop(e.oid, None)
+            else:
+                out[e.oid] = max(out.get(e.oid, 0), e.version)
+        return out
+
+    # ---- degraded-object tracking -----------------------------------------
+    def _has_missing(self) -> bool:
+        return any(self.missing.values())
+
+    def missing_shards_for(self, oid: str) -> Set[int]:
+        return {s for s, mm in self.missing.items() if oid in mm}
+
+    def clear_missing_for(self, oid: str) -> None:
+        """A full-object write/delete rewrote every acting shard."""
+        for mm in self.missing.values():
+            mm.pop(oid, None)
+        self._maybe_clean()
+
+    def _maybe_clean(self) -> None:
+        if self.state == STATE_ACTIVE_RECOVERING and \
+                not self._has_missing() and not self._backfill_pending:
+            self.state = STATE_ACTIVE
 
     # ---- op execution (PrimaryLogPG::do_op analog) ------------------------
     def do_op(self, msg: MOSDOp) -> None:
@@ -163,21 +570,26 @@ class PG:
     def _do_write(self, msg: MOSDOp) -> None:
         if self.backend is not None:
             src = msg.src
+            oid = msg.oid
 
             def on_commit(result: int) -> None:
+                if result == 0:
+                    self.clear_missing_for(oid)
                 self.osd.send_op_reply(src, MOSDOpReply(
                     tid=msg.tid, result=result,
                     epoch=self.osd.osdmap.epoch))
 
             self.backend.submit_transaction(msg.oid, msg.data, on_commit)
         else:
-            self.rep_backend.write(msg.oid, msg.data, full=True)
+            self.rep_backend.write(msg.oid, msg.data, full=True,
+                                   version=self.next_version())
             self.osd.send_op_reply(msg.src, MOSDOpReply(
                 tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))
 
     def _do_partial_write(self, msg: MOSDOp) -> None:
         """Offset write / append: rmw on EC pools, splice on replicated
-        (PrimaryLogPG do_osd_ops CEPH_OSD_OP_WRITE/APPEND)."""
+        (PrimaryLogPG do_osd_ops CEPH_OSD_OP_WRITE/APPEND).  Degraded
+        objects are recovered before the rmw touches shard state."""
         offset = None if msg.op == CEPH_OSD_OP_APPEND else msg.offset
         if self.backend is not None:
             src = msg.src
@@ -187,11 +599,39 @@ class PG:
                     tid=msg.tid, result=result,
                     epoch=self.osd.osdmap.epoch))
 
-            self.backend.submit_write(msg.oid, msg.data, offset, on_commit)
+            def submit() -> None:
+                self.backend.submit_write(msg.oid, msg.data, offset,
+                                          on_commit)
+
+            if self.missing_shards_for(msg.oid):
+                self.wait_for_recovery(msg.oid, submit)
+            else:
+                submit()
         else:
-            self.rep_backend.write(msg.oid, msg.data, offset=offset)
-            self.osd.send_op_reply(msg.src, MOSDOpReply(
-                tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))
+            def rep_submit() -> None:
+                self.rep_backend.write(msg.oid, msg.data, offset=offset,
+                                       version=self.next_version())
+                self.osd.send_op_reply(msg.src, MOSDOpReply(
+                    tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))
+
+            if msg.oid in self.local_missing:
+                # our own copy is stale/absent: the splice offset would
+                # be wrong — recover first (wait_for_missing_object)
+                self.wait_for_recovery(msg.oid, rep_submit)
+            else:
+                rep_submit()
+
+    def wait_for_recovery(self, oid: str, then: Callable[[], None]) -> None:
+        """Queue *then* until the object is fully recovered
+        (wait_for_missing_object semantics)."""
+        self._waiting_for_recovery.setdefault(oid, []).append(then)
+        self.osd.recover_oid(self, oid)
+
+    def recovery_done_for(self, oid: str) -> None:
+        self._recovering.discard(oid)
+        self._maybe_clean()
+        for cb in self._waiting_for_recovery.pop(oid, []):
+            cb()
 
     def _do_read(self, msg: MOSDOp) -> None:
         if self.backend is not None:
@@ -205,18 +645,26 @@ class PG:
             self.backend.objects_read_and_reconstruct(
                 msg.oid, on_complete, offset=msg.offset, length=msg.length)
         else:
-            data = self.rep_backend.read(msg.oid)
-            if data is None:
-                self.osd.send_op_reply(msg.src,
-                                       MOSDOpReply(tid=msg.tid, result=-2))
+            def rep_read() -> None:
+                data = self.rep_backend.read(msg.oid)
+                if data is None:
+                    self.osd.send_op_reply(
+                        msg.src, MOSDOpReply(tid=msg.tid, result=-2))
+                else:
+                    body = data
+                    if msg.length:
+                        body = data[msg.offset:msg.offset + msg.length]
+                    elif msg.offset:
+                        body = data[msg.offset:]
+                    self.osd.send_op_reply(msg.src, MOSDOpReply(
+                        tid=msg.tid, result=0, data=body,
+                        epoch=self.osd.osdmap.epoch))
+
+            if msg.oid in self.local_missing:
+                # serving the stale local copy would return old bytes
+                self.wait_for_recovery(msg.oid, rep_read)
             else:
-                if msg.length:
-                    data = data[msg.offset:msg.offset + msg.length]
-                elif msg.offset:
-                    data = data[msg.offset:]
-                self.osd.send_op_reply(msg.src, MOSDOpReply(
-                    tid=msg.tid, result=0, data=data,
-                    epoch=self.osd.osdmap.epoch))
+                rep_read()
 
     def _do_stat(self, msg: MOSDOp) -> None:
         store = self.osd.store
@@ -238,19 +686,21 @@ class PG:
 
     def _do_delete(self, msg: MOSDOp) -> None:
         from ..msg.messages import MOSDECSubOpWrite
+        version = self.next_version()
         if self.backend is not None:
             for shard, osd in self.acting_shards().items():
                 m = MOSDECSubOpWrite(tid=-msg.tid, pgid=self.pgid,
                                      shard=shard, oid=msg.oid, chunk=b"",
-                                     at_version=-1)
+                                     at_version=-1, version=version)
                 self.send_to_osd(osd, m)
+            self.clear_missing_for(msg.oid)
         else:
             for osd in self.acting:
                 if osd == CRUSH_ITEM_NONE:
                     continue
                 m = MOSDECSubOpWrite(tid=-msg.tid, pgid=self.pgid,
                                      shard=-1, oid=msg.oid, chunk=b"",
-                                     at_version=-1)
+                                     at_version=-1, version=version)
                 self.send_to_osd(osd, m)
         self.osd.send_op_reply(msg.src, MOSDOpReply(
             tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))

@@ -1,0 +1,130 @@
+"""PGLog — per-PG operation log enabling log-bounded (delta) recovery.
+
+Mirrors the reference's src/osd/PGLog.{h,cc} role: every mutation appends
+a (version, oid, op) entry on every shard in the same transaction as the
+data write; after a flap, the primary computes each peer's missing set by
+replaying only the log suffix past the peer's last_update instead of
+rescanning stores.  A peer whose last_update fell behind the log tail is
+beyond log-bounded repair and goes through backfill (full listing diff),
+like the reference's backfill path.
+
+Entries persist in the shard store: a per-PG meta object holds the log in
+omap (key = zero-padded version) and last_update/tail as attrs, so a
+restarted OSD resumes from its on-disk state (OSD.cc:2469+ resume model).
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..os_store import MemStore, Transaction, hobject_t
+
+OP_MODIFY = "m"
+OP_DELETE = "d"
+
+PG_META_OID = "_pgmeta"          # per-shard-collection meta object
+LAST_UPDATE_ATTR = "_last_update"
+LOG_TAIL_ATTR = "_log_tail"
+VERSION_ATTR = "_version"        # per-object: pg_log version of its data
+
+DEFAULT_LOG_ENTRIES = 500        # osd_min_pg_log_entries-style bound
+
+
+@dataclass(frozen=True)
+class LogEntry:
+    version: int
+    oid: str
+    op: str        # OP_MODIFY | OP_DELETE
+
+    def encode(self) -> bytes:
+        o = self.oid.encode()
+        return struct.pack("<QB", self.version,
+                           1 if self.op == OP_DELETE else 0) + o
+
+    @classmethod
+    def decode(cls, b: bytes) -> "LogEntry":
+        version, d = struct.unpack_from("<QB", b)
+        return cls(version=version, oid=b[9:].decode(),
+                   op=OP_DELETE if d else OP_MODIFY)
+
+
+class PGLog:
+    """In-memory log mirror with store-backed persistence."""
+
+    def __init__(self, max_entries: int = DEFAULT_LOG_ENTRIES):
+        self.entries: List[LogEntry] = []
+        self.tail = 0          # every version <= tail has been trimmed
+        self.head = 0          # last_update
+        self.max_entries = max_entries
+
+    # ---- mutation ----------------------------------------------------------
+    def append(self, entry: LogEntry, t: Transaction, cid: str) -> None:
+        """Record the entry and stage its persistence into *t* (same
+        transaction as the data mutation, the reference's atomicity)."""
+        assert entry.version > self.head, (entry.version, self.head)
+        self.entries.append(entry)
+        self.head = entry.version
+        meta = hobject_t(PG_META_OID)
+        t.touch(cid, meta)
+        t.omap_setkeys(cid, meta, {self._key(entry.version): entry.encode()})
+        t.setattr(cid, meta, LAST_UPDATE_ATTR, struct.pack("<Q", self.head))
+        if len(self.entries) > self.max_entries:
+            self._trim(t, cid)
+
+    def _trim(self, t: Transaction, cid: str) -> None:
+        drop = self.entries[:-self.max_entries]
+        self.entries = self.entries[-self.max_entries:]
+        self.tail = self.entries[0].version - 1 if self.entries else self.head
+        meta = hobject_t(PG_META_OID)
+        t.omap_rmkeys(cid, meta, [self._key(e.version) for e in drop])
+        t.setattr(cid, meta, LOG_TAIL_ATTR, struct.pack("<Q", self.tail))
+
+    @staticmethod
+    def _key(version: int) -> str:
+        return f"{version:020d}"
+
+    # ---- queries -----------------------------------------------------------
+    def entries_after(self, version: int) -> Optional[List[LogEntry]]:
+        """Log suffix past *version*, or None when the log was trimmed
+        beyond it (-> backfill)."""
+        if version < self.tail:
+            return None
+        return [e for e in self.entries if e.version > version]
+
+    def missing_after(self, version: int
+                      ) -> Optional[Dict[str, Tuple[int, str]]]:
+        """oid -> (latest version, op) for everything changed past
+        *version*; None = out of log bounds."""
+        suffix = self.entries_after(version)
+        if suffix is None:
+            return None
+        out: Dict[str, Tuple[int, str]] = {}
+        for e in suffix:
+            out[e.oid] = (e.version, e.op)
+        return out
+
+    def merge_authoritative(self, entries: List[LogEntry], t: Transaction,
+                            cid: str) -> None:
+        """Adopt an authoritative log suffix (primary catching up to a
+        peer that saw newer writes — the GetLog step)."""
+        for e in entries:
+            if e.version > self.head:
+                self.append(e, t, cid)
+
+    # ---- persistence -------------------------------------------------------
+    def load(self, store: MemStore, cid: str) -> None:
+        meta = hobject_t(PG_META_OID)
+        if not store.collection_exists(cid) or not store.exists(cid, meta):
+            return
+        attrs = store.getattrs(cid, meta)
+        if LAST_UPDATE_ATTR in attrs:
+            self.head = struct.unpack("<Q", attrs[LAST_UPDATE_ATTR])[0]
+        if LOG_TAIL_ATTR in attrs:
+            self.tail = struct.unpack("<Q", attrs[LOG_TAIL_ATTR])[0]
+        omap = store.omap_get(cid, meta)
+        self.entries = sorted(
+            (LogEntry.decode(v) for v in omap.values()),
+            key=lambda e: e.version)
+        if self.entries:
+            self.head = max(self.head, self.entries[-1].version)

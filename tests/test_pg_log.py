@@ -1,0 +1,303 @@
+"""PGLog + peering: log-bounded delta recovery, all over the messenger.
+
+Models the reference behaviors: PGLog.{h,cc} delta recovery after a flap
+(only objects changed while the peer was away move), backfill when a peer
+falls beyond the log tail, GetLog when the primary is behind, and the
+qa-thrasher blackhole scenarios (qa/tasks/ceph_manager.py:360) — recovery
+must converge with a blackholed source because every byte moves through
+the fault-injectable fabric (no peer-heap shortcuts).
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.osd.pg_log import LogEntry, OP_DELETE, OP_MODIFY, PGLog
+from ceph_tpu.os_store import MemStore, Transaction
+
+
+def payload(n=20000, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+# ---- unit: the log itself --------------------------------------------------
+
+def test_log_append_trim_and_persistence():
+    store = MemStore()
+    t = Transaction()
+    t.create_collection("meta")
+    store.queue_transaction(t)
+    log = PGLog(max_entries=5)
+    for v in range(1, 12):
+        t = Transaction()
+        log.append(LogEntry(v, f"o{v % 3}", OP_MODIFY), t, "meta")
+        store.queue_transaction(t)
+    assert log.head == 11
+    assert len(log.entries) == 5
+    assert log.tail == 6
+    # reload from the store: identical state
+    log2 = PGLog(max_entries=5)
+    log2.load(store, "meta")
+    assert log2.head == 11 and log2.tail == 6
+    assert [e.version for e in log2.entries] == [7, 8, 9, 10, 11]
+    # bounded query semantics
+    assert log2.entries_after(3) is None          # beyond tail: backfill
+    assert [e.version for e in log2.entries_after(8)] == [9, 10, 11]
+    miss = log2.missing_after(8)
+    assert set(miss) <= {"o0", "o1", "o2"}
+
+
+def test_log_missing_dedups_to_latest():
+    log = PGLog()
+    t = Transaction()
+    t.create_collection("m")
+    for v, oid, op in [(1, "a", OP_MODIFY), (2, "b", OP_MODIFY),
+                       (3, "a", OP_MODIFY), (4, "b", OP_DELETE)]:
+        log.append(LogEntry(v, oid, op), t, "m")
+    miss = log.missing_after(0)
+    assert miss["a"] == (3, OP_MODIFY)
+    assert miss["b"] == (4, OP_DELETE)
+
+
+# ---- integration: flap -> delta recovery -----------------------------------
+
+def _holders(c, oid):
+    return {o.osd_id for o in c.osds.values()
+            if o.name not in c.network.down
+            and any(ho.oid == oid for cid in o.store.list_collections()
+                    for ho in o.store.list_objects(cid))}
+
+
+def test_flap_recovers_only_the_delta():
+    """An osd that flaps (down while writes continue, then back) must
+    receive exactly the objects written in its absence — log-bounded
+    recovery, not a full-PG rescan (PGLog.h role)."""
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("p", k=3, m=2, pg_num=1, plugin="tpu")
+    cl = c.client("client.f")
+    for i in range(6):
+        assert cl.write_full("p", f"pre{i}", payload(seed=i)) == 0
+    holders = _holders(c, "pre0")
+    _, primary = cl._calc_target(cl.lookup_pool("p"), "pre0")
+    victim = next(o for o in holders if o != primary)
+    before = sum(o.perf["recovery_push"] for o in c.osds.values())
+    c.kill_osd(victim)
+    c.mark_osd_down(victim)
+    # two new objects + one overwrite while the victim is away
+    assert cl.write_full("p", "new1", payload(seed=10)) == 0
+    assert cl.write_full("p", "new2", payload(seed=11)) == 0
+    assert cl.write_full("p", "pre3", payload(seed=12)) == 0
+    c.revive_osd(victim)
+    c.run_recovery()
+    after = sum(o.perf["recovery_push"] for o in c.osds.values())
+    # exactly the 3 changed objects moved (one shard each), not all 8
+    assert after - before == 3, (before, after)
+    # and the data is consistent
+    for i in range(6):
+        expect = payload(seed=12) if i == 3 else payload(seed=i)
+        assert cl.read("p", f"pre{i}") == expect
+    assert cl.read("p", "new1") == payload(seed=10)
+
+
+def test_flap_delete_propagates_via_log():
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("p", k=3, m=2, pg_num=1, plugin="tpu")
+    cl = c.client("client.d")
+    assert cl.write_full("p", "victim_obj", payload(seed=1)) == 0
+    holders = _holders(c, "victim_obj")
+    _, primary = cl._calc_target(cl.lookup_pool("p"), "victim_obj")
+    victim = next(o for o in holders if o != primary)
+    c.kill_osd(victim)
+    c.mark_osd_down(victim)
+    assert cl.remove("p", "victim_obj") == 0
+    c.network.pump()
+    c.revive_osd(victim)
+    c.run_recovery()
+    c.network.pump()
+    # the revived osd must have applied the delete from the log
+    leftovers = [1 for cid in c.osds[victim].store.list_collections()
+                 for ho in c.osds[victim].store.list_objects(cid)
+                 if ho.oid == "victim_obj"]
+    assert not leftovers
+
+
+def test_backfill_when_log_trimmed():
+    """A peer so far behind that the log was trimmed past it gets a
+    backfill (scan diff) instead of silent data loss."""
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("p", k=3, m=2, pg_num=1, plugin="tpu")
+    # shrink the log so it trims quickly
+    for o in c.osds.values():
+        for pg in o.pgs.values():
+            pg.pg_log.max_entries = 10
+    cl = c.client("client.b")
+    assert cl.write_full("p", "old", payload(seed=1)) == 0
+    holders = _holders(c, "old")
+    _, primary = cl._calc_target(cl.lookup_pool("p"), "old")
+    victim = next(o for o in holders if o != primary)
+    c.kill_osd(victim)
+    c.mark_osd_down(victim)
+    for i in range(15):  # push the log tail past the victim's head
+        assert cl.write_full("p", f"n{i}", payload(200, seed=i)) == 0
+        for o in c.osds.values():
+            for pg in o.pgs.values():
+                pg.pg_log.max_entries = 10
+    c.revive_osd(victim)
+    c.run_recovery()
+    c.network.pump()
+    c.run_recovery()
+    for i in range(15):
+        assert cl.read("p", f"n{i}") == payload(200, seed=i)
+    assert cl.read("p", "old") == payload(seed=1)
+    # victim really caught up: kill another holder and read degraded
+    holders2 = _holders(c, "n3")
+    _, primary2 = cl._calc_target(cl.lookup_pool("p"), "n3")
+    other = next(o for o in holders2 if o not in (victim, primary2))
+    c.kill_osd(other)
+    c.mark_osd_down(other)
+    assert cl.read("p", "n3") == payload(200, seed=3)
+
+
+def test_blackholed_recovery_source_converges():
+    """Blackhole a shard holder: heartbeat quorum marks it down, peering
+    recomputes, and recovery converges from the remaining shards — every
+    recovery byte travels the fabric, so the fault injection actually
+    bites (VERDICT #8)."""
+    c = MiniCluster(n_osds=7)
+    c.create_ec_pool("p", k=3, m=2, pg_num=4, plugin="tpu")
+    cl = c.client("client.bh")
+    data = {f"o{i}": payload(seed=20 + i) for i in range(5)}
+    for oid, d in data.items():
+        assert cl.write_full("p", oid, d) == 0
+    holders = _holders(c, "o0")
+    _, primary = cl._calc_target(cl.lookup_pool("p"), "o0")
+    source = next(o for o in holders if o != primary)
+    c.blackhole_osd(source)
+    # heartbeats: multiple peers report; the single partitioned osd's
+    # own reports must NOT take healthy peers down (min reporters)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    assert not c.mon.osdmap.is_up(source)
+    up = [o for o in range(7) if c.mon.osdmap.is_up(o)]
+    assert len(up) == 6, "healthy osds must stay up"
+    c.mon.mark_osd_out(source)
+    c.network.pump()
+    c.run_recovery()
+    c.network.pump()
+    c.run_recovery()
+    for oid, d in data.items():
+        assert cl.read("p", oid) == d
+    # redundancy restored on the remaining osds (the blackholed osd still
+    # holds its stale copy — it was partitioned, not wiped)
+    for oid in data:
+        assert len(_holders(c, oid) - {source}) == 5
+
+
+def test_new_primary_catches_up_via_getlog():
+    """If the acting primary's shard is stale (it was down while writes
+    landed), it must pull the authoritative log and recover itself before
+    serving (the GetLog/GetMissing steps)."""
+    c = MiniCluster(n_osds=5)
+    c.create_ec_pool("p", k=2, m=2, pg_num=1, plugin="tpu")
+    cl = c.client("client.g")
+    assert cl.write_full("p", "x", payload(seed=5)) == 0
+    pool_id = cl.lookup_pool("p")
+    _, primary = cl._calc_target(pool_id, "x")
+    c.kill_osd(primary)
+    c.mark_osd_down(primary)
+    assert cl.write_full("p", "x", payload(seed=6)) == 0
+    assert cl.write_full("p", "y", payload(seed=7)) == 0
+    c.revive_osd(primary)
+    c.run_recovery()
+    c.network.pump()
+    c.run_recovery()
+    # whoever is primary now, reads must see the newest data
+    assert cl.read("p", "x") == payload(seed=6)
+    assert cl.read("p", "y") == payload(seed=7)
+
+
+def test_primary_beyond_log_tail_self_backfills():
+    """A returning primary whose head predates the authority's log tail
+    cannot replay entries — it must adopt the authoritative head and
+    backfill itself from a listing diff instead of looping in GetLog."""
+    c = MiniCluster(n_osds=5)
+    c.create_ec_pool("p", k=2, m=2, pg_num=1, plugin="tpu")
+    for o in c.osds.values():
+        for pg in o.pgs.values():
+            pg.pg_log.max_entries = 8
+    cl = c.client("client.sb")
+    assert cl.write_full("p", "keep", payload(seed=1)) == 0
+    pool_id = cl.lookup_pool("p")
+    _, primary = cl._calc_target(pool_id, "keep")
+    c.kill_osd(primary)
+    c.mark_osd_down(primary)
+    for i in range(12):  # trim the log well past the dead primary's head
+        assert cl.write_full("p", f"n{i}", payload(300, seed=i)) == 0
+        for o in c.osds.values():
+            for pg in o.pgs.values():
+                pg.pg_log.max_entries = 8
+    assert cl.remove("p", "keep") == 0  # delete must propagate via diff
+    c.network.pump()
+    c.revive_osd(primary)
+    c.run_recovery()
+    c.network.pump()
+    c.run_recovery()
+    for i in range(12):
+        assert cl.read("p", f"n{i}") == payload(300, seed=i)
+    with pytest.raises(IOError):
+        cl.read("p", "keep")
+    # the returned osd's stale copy of the deleted object is gone
+    leftovers = [1 for cid in c.osds[primary].store.list_collections()
+                 for ho in c.osds[primary].store.list_objects(cid)
+                 if ho.oid == "keep"]
+    assert not leftovers
+
+
+def test_activation_missing_survives_promotion():
+    """A replica whose log head advanced via activation but whose data
+    never arrived must carry that debt (local_missing) into the next
+    peering round — even if it becomes the primary."""
+    c = MiniCluster(n_osds=5)
+    c.create_ec_pool("p", k=2, m=2, pg_num=1, plugin="tpu")
+    cl = c.client("client.pm")
+    assert cl.write_full("p", "a", payload(seed=1)) == 0
+    pool_id = cl.lookup_pool("p")
+    pgid, primary = cl._calc_target(pool_id, "a")
+    acting = c.osds[primary].pgs[pgid].acting
+    behind = next(o for o in acting if o != primary)
+    c.kill_osd(behind)
+    c.mark_osd_down(behind)
+    assert cl.write_full("p", "a", payload(seed=2)) == 0
+    assert cl.write_full("p", "b", payload(seed=3)) == 0
+    # bring it back WITHOUT driving recovery: activation merges the log
+    c.network.set_down(f"osd.{behind}", False)
+    c.mon.mark_osd_up(behind)
+    c.mon.send_full_map(f"osd.{behind}")
+    c.network.pump()
+    pg_b = c.osds[behind].pgs[pgid]
+    assert "a" in pg_b.local_missing or "b" in pg_b.local_missing
+    # force a new interval immediately (old primary dies before pushes)
+    c.kill_osd(primary)
+    c.mark_osd_down(primary)
+    c.run_recovery()
+    c.network.pump()
+    c.run_recovery()
+    assert cl.read("p", "a") == payload(seed=2)
+    assert cl.read("p", "b") == payload(seed=3)
+
+
+def test_stale_failure_reports_expire_on_recovery():
+    """One old report plus one new report from different eras must not
+    reach the down-mark quorum (reports void on mark_osd_up)."""
+    from ceph_tpu.msg import MOSDFailure
+    c = MiniCluster(n_osds=5)
+    mon = c.mon
+    # one report arrives; target then proves healthy (marked up)
+    mon.ms_fast_dispatch(MOSDFailure(src="osd.2", target_osd=1, epoch=1))
+    assert mon.osdmap.is_up(1)
+    mon.mark_osd_down(1)
+    mon.mark_osd_up(1)   # recovery clears the partial report set
+    mon.ms_fast_dispatch(MOSDFailure(src="osd.3", target_osd=1, epoch=2))
+    assert mon.osdmap.is_up(1), "stale+fresh reports must not sum"
+    mon.ms_fast_dispatch(MOSDFailure(src="osd.4", target_osd=1, epoch=2))
+    assert not mon.osdmap.is_up(1)  # two contemporaneous reporters do
