@@ -140,6 +140,20 @@ class MiniCluster:
             self.network.pump()
         self.run_recovery()
 
+    def scrub(self) -> None:
+        """Background consistency pass over every PG (qa deep-scrub
+        role): primaries collect shard scrub maps, inconsistencies become
+        missing entries, recovery repairs them by decode — no client
+        reads involved."""
+        for osd in self.osds.values():
+            if osd.name in self.network.down:
+                continue
+            for pg in osd.pgs.values():
+                if pg.is_primary():
+                    pg.start_scrub()
+        self.network.pump()
+        self.run_recovery()
+
     def run_recovery(self, max_rounds: int = 4) -> int:
         total = 0
         for _ in range(max_rounds):

@@ -156,6 +156,27 @@ class MOSDPGScanReply(Message):
 
 
 @dataclass
+class MOSDRepScrub(Message):
+    """Primary -> shard: build and return a scrub map of your chunks
+    (src/messages/MOSDRepScrub.h role)."""
+    pgid: Tuple[int, int] = (0, 0)
+    shard: int = -1
+    epoch: int = 0
+
+
+@dataclass
+class MOSDRepScrubMap(Message):
+    """Shard -> primary scrub results (ScrubMap role): per object the
+    stored size, whether the shard's HashInfo crc verified, and the data
+    digest (crc32c) for cross-replica comparison."""
+    pgid: Tuple[int, int] = (0, 0)
+    shard: int = -1
+    epoch: int = 0
+    objects: List[Tuple[str, int, bool, int]] = field(default_factory=list)
+    # (oid, size, crc_ok, digest)
+
+
+@dataclass
 class MOSDPing(Message):
     """OSD<->OSD heartbeat (src/messages/MOSDPing.h)."""
     PING = "ping"

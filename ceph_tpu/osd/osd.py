@@ -24,7 +24,7 @@ from ..msg import (
     Dispatcher, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDFailure, MOSDMap, MOSDOp, MOSDOpReply,
     MOSDPGInfo, MOSDPGQuery, MOSDPGScan, MOSDPGScanReply, MOSDPing,
-    Message, Network,
+    MOSDRepScrub, MOSDRepScrubMap, Message, Network,
 )
 from ..os_store import MemStore, Transaction, hobject_t
 from ..osdmap import OSDMap, pg_t
@@ -139,6 +139,14 @@ class OSD(Dispatcher):
             pg = self.pgs.get(msg.pgid)
             if pg is not None:
                 pg.handle_pg_scan_reply(msg)
+        elif isinstance(msg, MOSDRepScrub):
+            pg = self.pgs.get(msg.pgid)
+            if pg is not None:
+                pg.handle_rep_scrub(msg)
+        elif isinstance(msg, MOSDRepScrubMap):
+            pg = self.pgs.get(msg.pgid)
+            if pg is not None:
+                pg.handle_rep_scrub_map(msg)
         elif isinstance(msg, MOSDPing):
             self._handle_ping(msg)
 
@@ -373,10 +381,14 @@ class OSD(Dispatcher):
     def _recover_rep_oid(self, pg: PG, oid: str,
                          targets: Dict[int, Tuple[int, str]]) -> None:
         data = pg.rep_backend.read(oid)
-        if data is not None:
+        my = pg.my_shard()
+        if data is not None and my not in targets:
+            # our copy is current (we are not in the missing set)
             self._push_rep(pg, oid, data, targets)
             return
-        # primary lacks its own copy: pull from a peer that has it
+        # primary lacks its own copy — or holds a STALE one (it is in
+        # targets): pushing local bytes would resurrect pre-flap data,
+        # so pull the authoritative copy from a healthy peer first
         srcs = [s for s, osd in pg.acting_shards().items()
                 if s not in targets and osd != self.osd_id]
         if not srcs:

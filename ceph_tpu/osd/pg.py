@@ -32,7 +32,7 @@ from ..msg import (
     CEPH_OSD_OP_APPEND, CEPH_OSD_OP_DELETE, CEPH_OSD_OP_READ,
     CEPH_OSD_OP_STAT, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_WRITEFULL,
     MOSDOp, MOSDOpReply, MOSDPGInfo, MOSDPGQuery, MOSDPGScan,
-    MOSDPGScanReply, Message,
+    MOSDPGScanReply, MOSDRepScrub, MOSDRepScrubMap, Message,
 )
 from ..os_store import Transaction, hobject_t
 from .ec_backend import ECBackend, SIZE_ATTR
@@ -92,8 +92,6 @@ class ReplicatedBackend:
                 self.pg.append_log(
                     LogEntry(msg.version, msg.oid, OP_MODIFY), t)
         store.queue_transaction(t)
-        if not msg.partial:
-            self.pg.data_received(msg.oid)
         if not msg.partial:
             self.pg.data_received(msg.oid)
 
@@ -190,15 +188,19 @@ class PG:
         latest: Dict[str, Tuple[int, str]] = {}
         for e in self.pg_log.entries:
             latest[e.oid] = (e.version, e.op)
+        if not latest:
+            return
+        snap = self._object_versions_snapshot()
         for oid, (v, op) in latest.items():
             if op == OP_DELETE:
                 continue
-            if not self._have_version(oid, v):
+            if snap.get(oid, -1) < v:
                 self.local_missing[oid] = (v, op)
 
-    def _object_version(self, oid: str) -> int:
-        """Stored pg_log version of this replica's copy (-1 = absent,
-        0 = pre-log object)."""
+    def _object_versions_snapshot(self) -> Dict[str, int]:
+        """One pass over this replica's collections: oid -> stored
+        version (0 = pre-log object).  Batch form of _object_version so
+        mount/activation stay linear, not quadratic."""
         from .pg_log import VERSION_ATTR
         store = self.osd.store
         if self.backend is not None:
@@ -207,20 +209,25 @@ class PG:
                     if cid.startswith(prefix)]
         else:
             cids = [f"{self.pgid[0]}.{self.pgid[1]}"]
-        best = -1
+        out: Dict[str, int] = {}
         for cid in cids:
             if not store.collection_exists(cid):
                 continue
             for ho in store.list_objects(cid):
-                if ho.oid != oid:
+                if ho.oid == PG_META_OID:
                     continue
                 try:
                     v = struct.unpack(
                         "<Q", store.getattr(cid, ho, VERSION_ATTR))[0]
                 except KeyError:
                     v = 0
-                best = max(best, v)
-        return best
+                out[ho.oid] = max(out.get(ho.oid, -1), v)
+        return out
+
+    def _object_version(self, oid: str) -> int:
+        """Stored pg_log version of this replica's copy (-1 = absent,
+        0 = pre-log object)."""
+        return self._object_versions_snapshot().get(oid, -1)
 
     def _have_version(self, oid: str, version: int) -> bool:
         return self._object_version(oid) >= version
@@ -423,11 +430,12 @@ class PG:
         for e in entries:
             if e.version > my_old_head:
                 latest[e.oid] = (e.version, e.op)
+        snap = self._object_versions_snapshot() if latest else {}
         for oid, (v, op) in latest.items():
             if op == OP_DELETE:
                 self.local_missing.pop(oid, None)
                 self._stage_local_delete(oid, t)
-            elif not self._have_version(oid, v):
+            elif snap.get(oid, -1) < v:
                 # absent OR present at an older version: data debt
                 self.local_missing[oid] = (v, op)
         self.osd.store.queue_transaction(t)
@@ -448,7 +456,9 @@ class PG:
                 t.remove(cid, hobject_t(oid))
 
     def handle_pg_scan(self, msg: MOSDPGScan) -> None:
-        """Backfill scan: list (oid, version) on this replica's shard."""
+        """Backfill scan: list (oid, version) on this replica's shard —
+        the version attr lets the primary spot present-but-stale copies."""
+        from .pg_log import VERSION_ATTR
         store = self.osd.store
         objects: List[Tuple[str, int]] = []
         cid = self._data_cid()
@@ -456,7 +466,12 @@ class PG:
             for ho in store.list_objects(cid):
                 if ho.oid == PG_META_OID:
                     continue
-                objects.append((ho.oid, 0))
+                try:
+                    v = struct.unpack(
+                        "<Q", store.getattr(cid, ho, VERSION_ATTR))[0]
+                except KeyError:
+                    v = 0
+                objects.append((ho.oid, v))
         self.osd.messenger.send_message(MOSDPGScanReply(
             pgid=self.pgid, shard=msg.shard, epoch=msg.epoch,
             objects=objects), msg.src)
@@ -473,19 +488,21 @@ class PG:
         if msg.epoch != getattr(self, "peering_epoch", msg.epoch):
             return  # stale round
         if msg.shard == self._self_backfill_from:
-            # our own backfill: whatever the authority lists and we lack
-            # is missing on us; our extras were deleted while we were out
+            # our own backfill: whatever the authority lists at a newer
+            # version than our copy is missing on us; our extras were
+            # deleted while we were out
             self._self_backfill_from = None
             my = self.my_shard()
-            auth_objects = {o for o, _v in msg.objects}
-            for oid in auth_objects:
-                if not self._have_object(oid):
-                    self.local_missing[oid] = (self.pg_log.head, OP_MODIFY)
+            auth_objects = {o: v for o, v in msg.objects}
+            for oid, v in auth_objects.items():
+                if not self._have_version(oid, v):
+                    vv = max(v, 1)
+                    self.local_missing[oid] = (vv, OP_MODIFY)
                     self.missing.setdefault(my, {}).setdefault(
-                        oid, (self.pg_log.head, OP_MODIFY))
+                        oid, (vv, OP_MODIFY))
             mine = self._authoritative_objects()
             t = Transaction()
-            for oid in set(mine) - auth_objects:
+            for oid in set(mine) - set(auth_objects):
                 self._stage_local_delete(oid, t)
             if not t.empty():
                 self.osd.store.queue_transaction(t)
@@ -494,13 +511,14 @@ class PG:
                 self.osd.request_recovery(self)
             return
         self._backfill_pending.discard(msg.shard)
-        peer_objects = {o for o, _v in msg.objects}
+        peer_objects = {o: v for o, v in msg.objects}
         auth = self._authoritative_objects()
         delta: Dict[str, Tuple[int, str]] = {}
         for oid, version in auth.items():
-            if oid not in peer_objects:
-                delta[oid] = (version, OP_MODIFY)
-        for oid in peer_objects - set(auth):
+            # absent OR present at an older version than the authority
+            if peer_objects.get(oid, -1) < version:
+                delta[oid] = (max(version, 1), OP_MODIFY)
+        for oid in set(peer_objects) - set(auth):
             delta[oid] = (self.pg_log.head, OP_DELETE)
         if delta:
             self.missing.setdefault(msg.shard, {}).update(delta)
@@ -512,13 +530,20 @@ class PG:
     def _authoritative_objects(self) -> Dict[str, int]:
         """oid -> version for every live object (primary's own store is
         authoritative once self-recovery has drained)."""
+        from .pg_log import VERSION_ATTR
         store = self.osd.store
         out: Dict[str, int] = {}
         cid = self._data_cid()
         if cid and store.collection_exists(cid):
             for ho in store.list_objects(cid):
-                if ho.oid != PG_META_OID:
-                    out[ho.oid] = 0
+                if ho.oid == PG_META_OID:
+                    continue
+                try:
+                    v = struct.unpack(
+                        "<Q", store.getattr(cid, ho, VERSION_ATTR))[0]
+                except KeyError:
+                    v = 0
+                out[ho.oid] = v
         # objects newer than the store view (log wins)
         for e in self.pg_log.entries:
             if e.op == OP_DELETE:
@@ -526,6 +551,95 @@ class PG:
             else:
                 out[e.oid] = max(out.get(e.oid, 0), e.version)
         return out
+
+    # ---- scrub (PG.cc scrub path + ECUtil HashInfo, scrub-lite) ------------
+    def start_scrub(self) -> None:
+        """Primary: collect scrub maps from every acting shard; compare
+        when all arrive.  Background consistency checking — no client
+        read involved (ScrubStore/PG scrub role)."""
+        if not self.is_primary() or self.state not in (
+                STATE_ACTIVE, STATE_ACTIVE_RECOVERING):
+            return
+        self._scrub_maps: Dict[int, MOSDRepScrubMap] = {}
+        self._scrub_pending = set(self.acting_shards())
+        for shard, osd in self.acting_shards().items():
+            self.send_to_osd(osd, MOSDRepScrub(
+                pgid=self.pgid, shard=shard,
+                epoch=self.last_epoch_started))
+
+    def handle_rep_scrub(self, msg: MOSDRepScrub) -> None:
+        """Replica: verify every stored chunk against its HashInfo crc
+        (handle_sub_read's check, proactively) and report digests."""
+        from ..utils.crc32c import crc32c
+        store = self.osd.store
+        objects: List[Tuple[str, int, bool, int]] = []
+        if self.backend is not None:
+            s = self.my_shard()
+            cids = [self.backend.shard_cid(s)] if s >= 0 else []
+        else:
+            cids = [f"{self.pgid[0]}.{self.pgid[1]}"]
+        for cid in cids:
+            if not store.collection_exists(cid):
+                continue
+            for ho in store.list_objects(cid):
+                if ho.oid == PG_META_OID:
+                    continue
+                data = store.read(cid, ho)
+                digest = crc32c(data)
+                ok = True
+                if self.backend is not None:
+                    from .ec_backend import HINFO_ATTR
+                    hv = store.getattrs(cid, ho).get(HINFO_ATTR)
+                    if hv is not None:
+                        total, expect = struct.unpack("<QI", hv)
+                        ok = not (total == len(data) and digest != expect)
+                objects.append((ho.oid, len(data), ok, digest))
+        self.osd.messenger.send_message(MOSDRepScrubMap(
+            pgid=self.pgid, shard=msg.shard, epoch=msg.epoch,
+            objects=objects), msg.src)
+
+    def handle_rep_scrub_map(self, msg: MOSDRepScrubMap) -> None:
+        if not self.is_primary() or \
+                not hasattr(self, "_scrub_pending"):
+            return
+        self._scrub_maps[msg.shard] = msg
+        self._scrub_pending.discard(msg.shard)
+        if self._scrub_pending:
+            return
+        self._scrub_compare()
+
+    def _scrub_compare(self) -> None:
+        """Compare shard scrub maps; inconsistent/absent copies become
+        missing entries and the recovery machinery repairs them by
+        decode/push (repair = recovery, like the reference)."""
+        maps = self._scrub_maps
+        del self._scrub_maps, self._scrub_pending
+        my_shard = self.my_shard()
+        auth = self._authoritative_objects()
+        by_shard: Dict[int, Dict[str, Tuple[int, bool, int]]] = {
+            s: {o: (sz, ok, dg) for o, sz, ok, dg in m.objects}
+            for s, m in maps.items()}
+        # replicated auth digest: the primary's own copy
+        my_map = by_shard.get(my_shard, {})
+        found = False
+        for oid, version in auth.items():
+            for shard in self.acting_shards():
+                ent = by_shard.get(shard, {}).get(oid)
+                bad = ent is None or not ent[1]
+                if self.rep_backend is not None and ent is not None:
+                    mine = my_map.get(oid)
+                    if mine is not None and ent[2] != mine[2]:
+                        bad = True
+                if bad:
+                    v = version or self.pg_log.head
+                    self.missing.setdefault(shard, {})[oid] = \
+                        (v, OP_MODIFY)
+                    if shard == my_shard:
+                        self.local_missing[oid] = (v, OP_MODIFY)
+                    found = True
+        if found:
+            self.state = STATE_ACTIVE_RECOVERING
+            self.osd.request_recovery(self)
 
     # ---- degraded-object tracking -----------------------------------------
     def _has_missing(self) -> bool:
@@ -702,5 +816,6 @@ class PG:
                                      shard=-1, oid=msg.oid, chunk=b"",
                                      at_version=-1, version=version)
                 self.send_to_osd(osd, m)
+            self.clear_missing_for(msg.oid)
         self.osd.send_op_reply(msg.src, MOSDOpReply(
             tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))

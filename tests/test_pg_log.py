@@ -301,3 +301,31 @@ def test_stale_failure_reports_expire_on_recovery():
     assert mon.osdmap.is_up(1), "stale+fresh reports must not sum"
     mon.ms_fast_dispatch(MOSDFailure(src="osd.4", target_osd=1, epoch=2))
     assert not mon.osdmap.is_up(1)  # two contemporaneous reporters do
+
+
+def test_replicated_stale_primary_pulls_not_pushes():
+    """A returning replicated primary holding a STALE copy must pull the
+    authoritative bytes, never push its old data over newer writes."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("r", size=3, pg_num=1)
+    cl = c.client("client.sp")
+    assert cl.write_full("r", "x", payload(seed=1)) == 0
+    pool_id = cl.lookup_pool("r")
+    pgid, primary = cl._calc_target(pool_id, "x")
+    c.kill_osd(primary)
+    c.mark_osd_down(primary)
+    assert cl.write_full("r", "x", payload(seed=2)) == 0  # newer write
+    c.revive_osd(primary)
+    c.run_recovery()
+    c.network.pump()
+    c.run_recovery()
+    # the newer bytes won everywhere, including on the returned primary
+    assert cl.read("r", "x") == payload(seed=2)
+    for osd in c.osds.values():
+        for cid in osd.store.list_collections():
+            if "_meta" in cid:
+                continue
+            for ho in osd.store.list_objects(cid):
+                if ho.oid == "x":
+                    assert bytes(osd.store.read(cid, ho)) == \
+                        payload(seed=2), f"osd.{osd.osd_id} stale"
