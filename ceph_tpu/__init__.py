@@ -1,6 +1,6 @@
 """ceph_tpu — a TPU-native framework providing Ceph's OSD-side compute capabilities.
 
-Built from scratch in JAX/XLA/Pallas (device path) + numpy/C++ (host oracles),
+Built from scratch in JAX/XLA (device path) + numpy/C++ (host oracles),
 re-designed TPU-first rather than ported.  Reference for semantics (not code):
 gencer/ceph v12.1.2, mounted read-only at /root/reference.
 
@@ -9,13 +9,13 @@ Subpackages
 - ``ceph_tpu.gf``      GF(2^8) arithmetic, RS matrix generation (host math core)
 - ``ceph_tpu.ec``      ErasureCodeInterface-compatible plugin stack (jerasure/isa
                        semantics, LRC, SHEC, XOR) with host and TPU backends
-- ``ceph_tpu.ops``     JAX/Pallas device kernels (GF(2^8) bit-matmul, batched
-                       stripes, straw2 draw)
+- ``ceph_tpu.ops``     device kernels (GF(2^8) MXU bit-matmul incl. a Pallas
+                       variant, batched stripes, straw2 draw)
 - ``ceph_tpu.crush``   CRUSH: data model, builder, exact host mapper, compiler,
                        tester, and the vmapped device mapper
 - ``ceph_tpu.osd``     OSDMap/epochs, batch PG mapping, ECUtil striping,
                        ECBackend-style rmw + recovery, memstore
-- ``ceph_tpu.msg``     asyncio messenger (cluster control plane shim)
+- ``ceph_tpu.msg``     messenger fabric: in-process + TCP transports, wire codec
 - ``ceph_tpu.cluster`` vstart-lite single-process mini-cluster
 - ``ceph_tpu.parallel``device mesh / sharding helpers (dp over stripes, tp over
                        shards, multi-host ready)

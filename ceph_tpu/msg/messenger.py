@@ -112,10 +112,21 @@ class Network:
                     continue
                 ep = self.endpoints.get(dst)
                 if ep is None or ep.dispatcher is None:
-                    self.dropped += 1
+                    # non-local destination: transports (msg/tcp.py) route
+                    # it onward; the base fabric drops it
+                    if self._route_remote(src, dst, msg):
+                        self.delivered += 1
+                    else:
+                        self.dropped += 1
                     continue
                 self.delivered += 1
                 ep.dispatcher.ms_fast_dispatch(msg)
         finally:
             self.pumping = False
         return n
+
+    def _route_remote(self, src: str, dst: str, msg: Message) -> bool:
+        """Hook for cross-process transports; False = undeliverable.
+        Runs AFTER the down/blackhole/drop filters, so fault injection
+        applies identically to local and remote peers."""
+        return False

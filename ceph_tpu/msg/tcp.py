@@ -1,0 +1,158 @@
+"""TCP messenger — the cross-process transport (AsyncMessenger role).
+
+The reference runs epoll-driven AsyncMessengers speaking a framed wire
+protocol between daemon processes (src/msg/async/AsyncMessenger.h:74);
+this is the equivalent thin shim: a ``TcpNetwork`` extends the in-process
+fabric so entities living in *other* processes are reachable through
+length-prefixed wire frames (msg/wire.py) over plain sockets.
+
+Topology is static like a mon map: every process knows the
+entity -> (host, port) directory.  Local sends short-circuit through the
+in-process queue; remote sends frame and ship.  ``pump()`` drains both
+the local queue and any readable sockets until traffic quiesces, so the
+callers' deterministic pump loops keep working across processes.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from typing import Dict, Optional, Tuple
+
+from .messenger import Network
+from .messages import Message
+from .wire import decode_message, encode_message
+
+_HDR = struct.Struct("<I H")   # frame length, dst-name length
+
+
+class TcpNetwork(Network):
+    """One per process: hosts local entities, routes to remote ones."""
+
+    def __init__(self, listen_addr: Tuple[str, int],
+                 directory: Dict[str, Tuple[str, int]]):
+        super().__init__()
+        self.directory = dict(directory)
+        self.listen_addr = listen_addr
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(listen_addr)
+        self._listener.listen(16)
+        self._listener.setblocking(False)
+        self._conns: Dict[Tuple[str, int], socket.socket] = {}
+        self._accepted: list = []
+        self._rxbuf: Dict[socket.socket, bytearray] = {}
+
+    # ---- sending -----------------------------------------------------------
+    # Network.send enqueues everything; pump() applies the fault-injection
+    # filters and calls _route_remote for non-local destinations, so
+    # down/blackhole/drop semantics are identical across the boundary.
+    def _route_remote(self, src: str, dst: str, msg: Message) -> bool:
+        addr = self.directory.get(dst)
+        if addr is None or tuple(addr) == tuple(self.listen_addr):
+            return False  # unknown, or points back here with no endpoint
+        payload = encode_message(msg)
+        dname = dst.encode()
+        frame = _HDR.pack(len(payload), len(dname)) + dname + payload
+        addr = tuple(addr)
+        try:
+            self._peer(addr).sendall(frame)
+            return True
+        except OSError:
+            s = self._conns.pop(addr, None)
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            return False
+
+    def _peer(self, addr: Tuple[str, int]) -> socket.socket:
+        s = self._conns.get(addr)
+        if s is None:
+            s = socket.create_connection(addr, timeout=5.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns[addr] = s
+        return s
+
+    # ---- receiving ---------------------------------------------------------
+    def _poll_sockets(self, wait: float) -> int:
+        import select
+        socks = [self._listener] + self._accepted
+        try:
+            readable, _, _ = select.select(socks, [], [], wait)
+        except OSError:
+            return 0
+        n = 0
+        for s in readable:
+            if s is self._listener:
+                try:
+                    conn, _peer = self._listener.accept()
+                    conn.setblocking(False)
+                    self._accepted.append(conn)
+                    self._rxbuf[conn] = bytearray()
+                except OSError:
+                    pass
+                continue
+            try:
+                data = s.recv(1 << 20)
+            except OSError:
+                data = b""
+            if not data:
+                self._accepted.remove(s)
+                self._rxbuf.pop(s, None)
+                continue
+            buf = self._rxbuf[s]
+            buf.extend(data)
+            n += self._drain_frames(buf)
+        return n
+
+    def _drain_frames(self, buf: bytearray) -> int:
+        n = 0
+        while len(buf) >= _HDR.size:
+            plen, dlen = _HDR.unpack_from(buf, 0)
+            total = _HDR.size + dlen + plen
+            if len(buf) < total:
+                break
+            dst = bytes(buf[_HDR.size:_HDR.size + dlen]).decode()
+            payload = bytes(buf[_HDR.size + dlen:total])
+            del buf[:total]
+            try:
+                msg = decode_message(payload)
+            except (ValueError, KeyError, UnicodeDecodeError):
+                # corrupt/unknown frame: count it dropped, keep pumping
+                self.dropped += 1
+                continue
+            # enqueue like a local delivery (fault injection still applies)
+            self.queue.append((msg.src, dst, msg))
+            n += 1
+        return n
+
+    # ---- pumping -----------------------------------------------------------
+    def pump(self, max_msgs: int = 100000, quiesce: float = 0.05,
+             deadline: float = 5.0) -> int:
+        """Drain local queue + sockets until no traffic arrives for
+        *quiesce* seconds (bounded by *deadline*)."""
+        total = 0
+        t_end = time.monotonic() + deadline
+        idle_since = None
+        while time.monotonic() < t_end:
+            moved = super().pump(max_msgs)
+            moved += self._poll_sockets(0.005)
+            total += moved
+            if moved:
+                idle_since = None
+                continue
+            if idle_since is None:
+                idle_since = time.monotonic()
+            elif time.monotonic() - idle_since >= quiesce:
+                break
+        return total
+
+    def close(self) -> None:
+        for s in [self._listener, *self._accepted,
+                  *self._conns.values()]:
+            try:
+                s.close()
+            except OSError:
+                pass

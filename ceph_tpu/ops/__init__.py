@@ -1,1 +1,1 @@
-"""Device kernels (JAX/XLA/Pallas) for the compute hot paths."""
+"""Device kernels (JAX/XLA; optional Pallas variants) for the compute hot paths."""

@@ -93,3 +93,29 @@ def test_tpu_plugin_batch_coding_only_recovery():
     chunks[4] = coding[:, 1]
     out = tpu.decode_batch(chunks, [3])
     np.testing.assert_array_equal(out[3], coding[:, 0])
+
+
+def test_pallas_kernel_parity_with_xla_path():
+    """ops/gf_pallas.py (fused unpack->MXU->pack in VMEM) must be
+    byte-identical to the XLA dot_general path.  The A/B on hardware
+    measured the XLA path ~3x faster (2754 vs 920 GiB/s at k=8,m=4,
+    1 MiB chunks), so XLA remains the default executor; the kernel is
+    kept as the measured alternative."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ceph_tpu.ops.gf_matmul import gf_bit_matmul
+    from ceph_tpu.ops.gf_pallas import gf_bit_matmul_pallas, \
+        pallas_supported
+    from ceph_tpu.gf.matrices import gf_gen_rs_matrix
+    from ceph_tpu.gf.tables import expand_to_bitmatrix
+
+    rng = np.random.default_rng(9)
+    for (s, k, m, c) in [(4, 8, 4, 512), (1, 4, 2, 128), (3, 6, 3, 1152)]:
+        assert pallas_supported(c)
+        data = jnp.asarray(rng.integers(0, 256, (s, k, c), dtype=np.uint8))
+        mat = gf_gen_rs_matrix(k + m, k)
+        bits = jnp.asarray(expand_to_bitmatrix(mat[k:]).astype(np.int8))
+        a = np.asarray(gf_bit_matmul(data, bits))
+        b = np.asarray(gf_bit_matmul_pallas(data, bits))
+        np.testing.assert_array_equal(a, b, err_msg=str((s, k, m, c)))
+    assert not pallas_supported(96)  # below the minimum tile
