@@ -156,6 +156,11 @@ class CrushCompiler:
     def compile(self, text: str) -> CrushWrapper:
         cw = CrushWrapper()
         cw.type_map = {}
+        # "always start with legacy tunables, so that the compiled result
+        # of a given crushmap is fixed" (CrushCompiler.cc:1205-1207) —
+        # including straw_calc_version=0; tunable lines in the text
+        # override from there
+        cw.crush.set_tunables_profile("legacy")
         lines = []
         for raw in text.splitlines():
             line = raw.split("#", 1)[0].strip()
