@@ -21,23 +21,52 @@ from .osd.osd import OSD
 
 class MiniCluster:
     def __init__(self, n_osds: int = 6, osds_per_host: int = 1,
+                 n_mons: int = 1,
                  _stores: Optional[Dict[int, object]] = None,
                  _bootstrap: bool = True):
         self.network = Network()
-        self.mon = Monitor(self.network)
+        if n_mons == 1:
+            self.mons = [Monitor(self.network)]
+        else:
+            names = [f"mon.{r}" for r in range(n_mons)]
+            self.mons = [
+                Monitor(self.network, name=names[r], rank=r,
+                        peers=[n for n in names if n != names[r]])
+                for r in range(n_mons)]
         if _bootstrap:
-            self.mon.bootstrap(n_osds, osds_per_host)
+            self.mons[0].bootstrap(n_osds, osds_per_host)
+        if n_mons > 1:
+            # initial election: rank 0 wins; recovery syncs the quorum
+            self.mons[0].start_election()
+            self.network.pump()
         self.osds: Dict[int, OSD] = {}
         self.perf_collection = PerfCountersCollection()
+        mon_names = [m.name for m in self.mons]
         for i in range(n_osds):
             store = _stores.get(i) if _stores else None
-            osd = OSD(self.network, i, store=store)
+            osd = OSD(self.network, i, store=store,
+                      mon_name=mon_names[0], mon_names=mon_names)
             self.osds[i] = osd
-            self.mon.subscribe(osd.name)
+            for m in self.mons:
+                m.subscribe(osd.name)
             self.perf_collection.add(osd.perf_counters)
         self.clock = 0.0
         self.admin_socket = AdminSocket()
         self._register_admin_commands()
+
+    @property
+    def mon(self) -> Monitor:
+        """The current live leader — the mon everything talks to;
+        single-mon clusters return the only monitor.  During a failover
+        window (no live leader yet) this returns a live mon for reads;
+        mutations on it raise until a quorum re-forms (Monitor.publish
+        guards), matching the reference's commands-stall-without-quorum
+        behavior."""
+        live = [m for m in self.mons if m.name not in self.network.down]
+        for m in live:
+            if m.is_leader():
+                return m
+        return live[0] if live else self.mons[0]
 
     # ---- checkpoint / resume (OSD.cc:2469+ init/resume model) --------------
     def checkpoint(self, directory: str) -> None:
@@ -131,14 +160,28 @@ class MiniCluster:
         return RadosClient(self.network, self.mon, name)
 
     def tick(self, dt: float = 1.0, rounds: int = 1) -> None:
-        """Advance time: heartbeats fire, failures get detected."""
+        """Advance time: heartbeats fire, failures get detected, mon
+        elections resolve."""
         for _ in range(rounds):
             self.clock += dt
+            for m in self.mons:
+                if m.name not in self.network.down:
+                    m.tick(self.clock)
             for i, osd in self.osds.items():
                 if osd.name not in self.network.down:
                     osd.tick(self.clock)
             self.network.pump()
         self.run_recovery()
+
+    # ---- mon thrashing ------------------------------------------------------
+    def kill_mon(self, rank: int) -> None:
+        self.network.set_down(self.mons[rank].name, True)
+
+    def revive_mon(self, rank: int) -> None:
+        mon = self.mons[rank]
+        self.network.set_down(mon.name, False)
+        mon.start_election()  # rejoin: triggers re-election + catch-up
+        self.network.pump()
 
     def scrub(self) -> None:
         """Background consistency pass over every PG (qa deep-scrub
@@ -173,7 +216,8 @@ class MiniCluster:
         back from disk (OSD::init, OSD.cc:2469+)."""
         old = self.osds[osd_id]
         self.network.set_down(old.name, False)
-        osd = OSD(self.network, osd_id, store=old.store)
+        osd = OSD(self.network, osd_id, store=old.store,
+                  mon_name=old.mon_name, mon_names=old.mon_names)
         self.osds[osd_id] = osd
         self.perf_collection.add(osd.perf_counters)  # replaces by name
         if not self.mon.osdmap.is_up(osd_id):

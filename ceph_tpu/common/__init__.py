@@ -5,9 +5,11 @@ from .perf_counters import (
 )
 from .admin_socket import AdminSocket
 from .tracked_op import OpTracker, TrackedOp
+from .lockdep import DebugLock, LockOrderError, lockdep_enable, lockdep_reset
 
 __all__ = [
     "Option", "ConfigProxy", "OPT_INT", "OPT_STR", "OPT_FLOAT", "OPT_BOOL",
     "OPT_DOUBLE", "PerfCounters", "PerfCountersBuilder",
     "PerfCountersCollection", "AdminSocket", "OpTracker", "TrackedOp",
+    "DebugLock", "LockOrderError", "lockdep_enable", "lockdep_reset",
 ]

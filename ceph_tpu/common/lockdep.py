@@ -1,0 +1,114 @@
+"""lockdep — runtime lock-ordering checker (src/common/lockdep.cc role).
+
+The reference registers every named mutex and records, per acquisition,
+which locks the thread already holds; observing A-before-B and later
+B-before-A is a potential deadlock and aborts with both backtraces.
+This is the same design over ``threading``: ``DebugLock`` wraps a lock
+with a name, a global order graph accumulates (holder -> acquired)
+edges, and an inversion raises ``LockOrderError`` with the two orders'
+stacks.  Enabled via ``lockdep_enable()`` (tests / vstart-style debug
+runs — the reference gates it behind the ``lockdep`` option too,
+src/vstart.sh); disabled it costs one attribute check per acquire.
+"""
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+_enabled = False
+_registry_lock = threading.Lock()
+# (before, after) -> formatted stack that first established the order
+_orders: Dict[Tuple[str, str], str] = {}
+_held = threading.local()
+
+
+class LockOrderError(RuntimeError):
+    pass
+
+
+def lockdep_enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def lockdep_reset() -> None:
+    with _registry_lock:
+        _orders.clear()
+
+
+def _held_stack() -> List[str]:
+    st = getattr(_held, "stack", None)
+    if st is None:
+        st = _held.stack = []
+    return st
+
+
+def _reaches(src: str, dst: str) -> Optional[str]:
+    """First recorded stack on a path src ->* dst in the order graph
+    (the reference lockdep's recursive ``does_follow`` check)."""
+    seen = {src}
+    frontier = [src]
+    while frontier:
+        cur = frontier.pop()
+        for (a, b), stack in _orders.items():
+            if a != cur or b in seen:
+                continue
+            if b == dst:
+                return stack
+            seen.add(b)
+            frontier.append(b)
+    return None
+
+
+def _will_lock(name: str) -> None:
+    held = _held_stack()
+    if not held:
+        return
+    stack = "".join(traceback.format_stack(limit=8)[:-2])
+    with _registry_lock:
+        for h in held:
+            if h == name:
+                raise LockOrderError(f"recursive acquire of {name!r}")
+            # transitive check: any existing name ->* h path plus the
+            # new h -> name edge closes a cycle
+            prior = _reaches(name, h)
+            if prior is not None:
+                raise LockOrderError(
+                    f"lock order inversion: acquiring {name!r} while "
+                    f"holding {h!r}, but an order {name!r} ->* {h!r} "
+                    f"was established here:\n{prior}")
+            _orders.setdefault((h, name), stack)
+
+
+class DebugLock:
+    """Named lock participating in ordering checks when lockdep is on."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _enabled:
+            _will_lock(self.name)
+        got = self._lock.acquire(blocking, timeout)
+        if got and _enabled:
+            _held_stack().append(self.name)
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        if _enabled:
+            st = _held_stack()
+            if self.name in st:
+                st.remove(self.name)
+
+    def __enter__(self) -> "DebugLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()

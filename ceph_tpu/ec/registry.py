@@ -10,7 +10,6 @@ register via ``ErasureCodePluginRegistry.add``.  Preloading
 """
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict
 
 from .interface import ErasureCodeInterface, ErasureCodeProfile
@@ -35,7 +34,8 @@ class ErasureCodePlugin:
 
 class ErasureCodePluginRegistry:
     def __init__(self):
-        self._lock = threading.Lock()
+        from ..common.lockdep import DebugLock
+        self._lock = DebugLock("ec_registry::plugins")
         self._plugins: Dict[str, ErasureCodePlugin] = {}
         self._load_errors: Dict[str, Exception] = {}
         self.disable_dlclose = True  # parity flag; meaningless here

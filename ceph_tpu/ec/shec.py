@@ -15,11 +15,11 @@ search in prepare mode and returns exactly the chunks that system reads.
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..common.lockdep import DebugLock
 from ..gf.matrices import gf_invert_matrix, jerasure_reed_sol_van_matrix
 from ..gf.tables import gf_mul_scalar
 from .base import ErasureCode, SIMD_ALIGN
@@ -98,7 +98,7 @@ class ErasureCodeShec(ErasureCode):
 
     _table_cache: Dict[Tuple, np.ndarray] = {}
     _decode_cache: Dict[Tuple, Tuple] = {}
-    _cache_lock = threading.Lock()
+    _cache_lock = DebugLock("shec::table_cache")
 
     def __init__(self):
         super().__init__()

@@ -1,34 +1,49 @@
-"""Monitor-lite — the cluster control plane.
+"""Monitor — the cluster control plane, electable and replicated.
 
-Single-instance stand-in for the reference's paxos-replicated OSDMonitor
-(src/mon/OSDMonitor.cc): it owns the authoritative OSDMap, stages changes
-in an Incremental, and publishes epochs to every subscriber (MOSDMap).
+Stand-in for the reference's paxos-replicated OSDMonitor
+(src/mon/OSDMonitor.cc): the leader owns the authoritative OSDMap, stages
+changes in an Incremental, replicates committed epochs to its quorum
+(MMonPaxos begin/accept/commit — src/mon/Paxos.cc phases, leader-driven
+and simplified), and publishes to every subscriber (MOSDMap).  Leadership
+comes from an election among reachable monitors — lowest rank wins
+(src/mon/Elector.cc) — driven by keepalive pings; a dead leader is
+detected by grace timeout and a surviving quorum re-elects and continues
+from its last committed epoch (the collect/last recovery phase syncs
+whoever is behind).  A single monitor (the default) is its own quorum
+and behaves exactly like the round-1 monitor-lite.
+
 Pool/EC-profile management mirrors the mon flow: a profile is stored in
 the map, the plugin is instantiated to validate it and to create the crush
 rule (OSDMonitor.cc:5335 get_erasure_code, :5298 crush_rule_create_erasure),
 and the pool's stripe_width comes from the plugin's chunk math.  Failure
-reports mark OSDs down and publish a new epoch.
+reports (quorum of 2 reporters) mark OSDs down and publish a new epoch;
+peons forward reports to the leader.
 """
 from __future__ import annotations
 
 import copy
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from ..crush.constants import CRUSH_BUCKET_STRAW2
 from ..ec import create_erasure_code
 from ..msg import Dispatcher, MOSDFailure, MOSDMap, Message, Network
+from ..msg.messages import MMonElection, MMonPaxos, MMonPing
 from ..osdmap import (
     CEPH_OSD_IN, Incremental, OSDMap, TYPE_ERASURE, TYPE_REPLICATED,
     pg_pool_t,
 )
 
 DEFAULT_STRIPE_UNIT = 4096  # osd_pool_erasure_code_stripe_unit
+MON_PING_GRACE = 15.0       # leader silent this long -> re-elect
 
 
 class Monitor(Dispatcher):
-    def __init__(self, network: Network, name: str = "mon"):
+    def __init__(self, network: Network, name: str = "mon",
+                 rank: int = 0, peers: Optional[List[str]] = None):
         self.network = network
         self.name = name
+        self.rank = rank
+        self.peers = list(peers or [])       # other mon names
         self.messenger = network.create_messenger(name)
         self.messenger.add_dispatcher_head(self)
         self.osdmap = OSDMap()
@@ -39,6 +54,200 @@ class Monitor(Dispatcher):
         # failure reports per target (mon_osd_min_down_reporters=2 —
         # a single partitioned reporter can't take the cluster down)
         self._failure_reports: Dict[int, set] = {}
+        # ---- election / quorum state (Elector.cc role) --------------------
+        self.election_epoch = 0
+        self.leader_rank = 0 if not self.peers else -1
+        self.quorum: Set[int] = {rank} if not self.peers else set()
+        self._election_acks: Set[int] = set()
+        self._peer_ranks: Dict[str, int] = {}
+        self._last_peer_seen: Dict[int, float] = {}
+        self.now = 0.0
+
+    # ---- roles -------------------------------------------------------------
+    def is_leader(self) -> bool:
+        return self.leader_rank == self.rank
+
+    def is_peon(self) -> bool:
+        return self.leader_rank >= 0 and not self.is_leader()
+
+    def n_mons(self) -> int:
+        return len(self.peers) + 1
+
+    def _majority(self) -> int:
+        return self.n_mons() // 2 + 1
+
+    def _peer_name(self, rank: int) -> Optional[str]:
+        for name, r in self._peer_ranks.items():
+            if r == rank:
+                return name
+        # fall back to the conventional naming
+        cand = f"mon.{rank}"
+        return cand if cand in self.peers else None
+
+    # ---- election (Elector.cc: lowest reachable rank wins) ----------------
+    def start_election(self) -> None:
+        if not self.peers:
+            self.leader_rank = self.rank
+            self.quorum = {self.rank}
+            return
+        self.election_epoch += 1
+        if self.election_epoch % 2 == 0:
+            self.election_epoch += 1      # odd = electing
+        self.leader_rank = -1
+        self._election_acks = {self.rank}
+        for p in self.peers:
+            self.messenger.send_message(MMonElection(
+                op=MMonElection.OP_PROPOSE, epoch=self.election_epoch,
+                rank=self.rank), p)
+
+    def _handle_election(self, msg: MMonElection) -> None:
+        self._peer_ranks[msg.src] = msg.rank
+        if msg.op == MMonElection.OP_PROPOSE:
+            if msg.epoch > self.election_epoch:
+                self.election_epoch = msg.epoch
+            if msg.rank < self.rank:
+                # defer to the lower rank
+                self.leader_rank = -1
+                self.messenger.send_message(MMonElection(
+                    op=MMonElection.OP_ACK, epoch=msg.epoch,
+                    rank=self.rank), msg.src)
+            else:
+                # we outrank them: counter-propose
+                self.start_election()
+        elif msg.op == MMonElection.OP_ACK:
+            if self.is_leader() and msg.epoch == self.election_epoch - 1:
+                # straggler ack for the election we just won: widen the
+                # quorum and bring the peer in (Elector expand behavior)
+                if msg.rank not in self.quorum:
+                    self.quorum.add(msg.rank)
+                    self.messenger.send_message(MMonElection(
+                        op=MMonElection.OP_VICTORY,
+                        epoch=self.election_epoch, rank=self.rank,
+                        quorum=sorted(self.quorum)), msg.src)
+                    self.messenger.send_message(MMonPaxos(
+                        op=MMonPaxos.OP_COLLECT, rank=self.rank,
+                        pn=self.election_epoch,
+                        last_committed=self.osdmap.epoch), msg.src)
+                return
+            if msg.epoch != self.election_epoch or self.leader_rank >= 0:
+                return
+            self._election_acks.add(msg.rank)
+            if len(self._election_acks) >= self._majority():
+                self._declare_victory()
+        elif msg.op == MMonElection.OP_VICTORY:
+            self.election_epoch = msg.epoch
+            self.leader_rank = msg.rank
+            self.quorum = set(msg.quorum)
+            self._last_peer_seen[msg.rank] = self.now
+
+    def _declare_victory(self) -> None:
+        self.election_epoch += 1          # even = decided
+        self.leader_rank = self.rank
+        self.quorum = set(self._election_acks)
+        for p in self.peers:
+            self.messenger.send_message(MMonElection(
+                op=MMonElection.OP_VICTORY, epoch=self.election_epoch,
+                rank=self.rank, quorum=sorted(self.quorum)), p)
+        # recovery: learn whatever the quorum committed that we missed
+        for r in self.quorum - {self.rank}:
+            name = self._peer_name(r)
+            if name:
+                self.messenger.send_message(MMonPaxos(
+                    op=MMonPaxos.OP_COLLECT, rank=self.rank,
+                    pn=self.election_epoch,
+                    last_committed=self.osdmap.epoch), name)
+
+    # ---- paxos-lite replication (Paxos.cc, leader-driven) -----------------
+    def _handle_paxos(self, msg: MMonPaxos) -> None:
+        from ..osdmap.encoding import incremental_from_dict, \
+            incremental_to_dict
+        if msg.op == MMonPaxos.OP_COLLECT:
+            # new leader asks what we committed past its epoch
+            deltas = [incremental_to_dict(i) for i in self.incrementals
+                      if i.epoch > msg.last_committed]
+            self.messenger.send_message(MMonPaxos(
+                op=MMonPaxos.OP_LAST, rank=self.rank,
+                pn=msg.pn, last_committed=self.osdmap.epoch,
+                values=deltas), msg.src)
+        elif msg.op == MMonPaxos.OP_LAST:
+            for d in msg.values:
+                inc = incremental_from_dict(d)
+                if inc.epoch == self.osdmap.epoch + 1:
+                    self.osdmap.apply_incremental(inc)
+                    self.incrementals.append(inc)
+            # push our surplus back so the peon catches up
+            if msg.last_committed < self.osdmap.epoch:
+                name = self._peer_name(msg.rank) or msg.src
+                deltas = [incremental_to_dict(i) for i in self.incrementals
+                          if i.epoch > msg.last_committed]
+                self.messenger.send_message(MMonPaxos(
+                    op=MMonPaxos.OP_BEGIN, rank=self.rank,
+                    pn=self.election_epoch,
+                    last_committed=self.osdmap.epoch,
+                    values=deltas), name)
+        elif msg.op == MMonPaxos.OP_BEGIN:
+            # peon: apply+persist the proposed epochs, then accept
+            for d in msg.values:
+                inc = incremental_from_dict(d)
+                if inc.epoch == self.osdmap.epoch + 1:
+                    self.osdmap.apply_incremental(inc)
+                    self.incrementals.append(inc)
+            self.messenger.send_message(MMonPaxos(
+                op=MMonPaxos.OP_ACCEPT, rank=self.rank, pn=msg.pn,
+                last_committed=self.osdmap.epoch), msg.src)
+        elif msg.op == MMonPaxos.OP_ACCEPT:
+            pass  # leader bookkeeping only; commit is implicit at accept
+        elif msg.op == MMonPaxos.OP_COMMIT:
+            pass
+
+    def _replicate(self, inc: Incremental) -> None:
+        """Leader: ship the committed epoch to the peon quorum."""
+        if not self.is_leader() or not self.peers:
+            return
+        from ..osdmap.encoding import incremental_to_dict
+        d = incremental_to_dict(inc)
+        for r in self.quorum - {self.rank}:
+            name = self._peer_name(r)
+            if name:
+                self.messenger.send_message(MMonPaxos(
+                    op=MMonPaxos.OP_BEGIN, rank=self.rank,
+                    pn=self.election_epoch,
+                    last_committed=self.osdmap.epoch, values=[d]), name)
+
+    # ---- liveness (elector keepalives) ------------------------------------
+    def tick(self, now: float) -> None:
+        self.now = now
+        if not self.peers:
+            return
+        for p in self.peers:
+            self.messenger.send_message(MMonPing(
+                op=MMonPing.PING, rank=self.rank, stamp=now), p)
+        if self.leader_rank >= 0 and not self.is_leader():
+            last = self._last_peer_seen.get(self.leader_rank, now)
+            self._last_peer_seen.setdefault(self.leader_rank, now)
+            if now - last > MON_PING_GRACE:
+                self.start_election()
+        elif self.is_leader() and len(self.quorum) > 1:
+            # a leader losing quorum peons must re-elect (lease timeout,
+            # Paxos::lease_timeout): a stale quorum would let a minority
+            # keep committing
+            for r in self.quorum - {self.rank}:
+                last = self._last_peer_seen.get(r, now)
+                self._last_peer_seen.setdefault(r, now)
+                if now - last > MON_PING_GRACE:
+                    self.start_election()
+                    break
+        elif self.leader_rank < 0:
+            # election stalled (e.g. proposed to dead peers): retry
+            self.start_election()
+
+    def _handle_mon_ping(self, msg: MMonPing) -> None:
+        self._peer_ranks[msg.src] = msg.rank
+        if msg.op == MMonPing.PING:
+            self.messenger.send_message(MMonPing(
+                op=MMonPing.REPLY, rank=self.rank, stamp=msg.stamp),
+                msg.src)
+        self._last_peer_seen[msg.rank] = self.now
 
     # ---- cluster bootstrap -------------------------------------------------
     def bootstrap(self, n_osds: int, osds_per_host: int = 1) -> None:
@@ -127,7 +336,16 @@ class Monitor(Dispatcher):
         Topology changes (crush/pools) publish as a full-state snapshot
         Incremental; osd up/weight deltas publish as true diffs which the
         mon also applies to its own map.
+
+        Multi-mon: only the quorum leader may commit — a partitioned
+        minority mutating its private map would diverge from the quorum
+        (real paxos makes this impossible; we make it loud).
         """
+        if self.peers and (not self.is_leader()
+                           or len(self.quorum) < self._majority()):
+            raise RuntimeError(
+                f"{self.name}: not the quorum leader "
+                f"(leader_rank={self.leader_rank}, quorum={self.quorum})")
         epoch = self.osdmap.epoch + 1
         if self._topology_dirty:
             delta = inc
@@ -149,6 +367,7 @@ class Monitor(Dispatcher):
             self.osdmap.apply_incremental(inc)
         inc.epoch = epoch
         self.incrementals.append(inc)
+        self._replicate(inc)
         for sub in self.subscribers:
             self.messenger.send_message(
                 MOSDMap(first=inc.epoch, last=inc.epoch,
@@ -221,7 +440,26 @@ class Monitor(Dispatcher):
         return 2 if n_up > 2 else 1
 
     def ms_fast_dispatch(self, msg: Message) -> None:
-        if isinstance(msg, MOSDFailure):
+        if isinstance(msg, MMonElection):
+            self._handle_election(msg)
+        elif isinstance(msg, MMonPaxos):
+            self._handle_paxos(msg)
+        elif isinstance(msg, MMonPing):
+            self._handle_mon_ping(msg)
+        elif isinstance(msg, MOSDFailure):
+            if not self.is_leader():
+                # peons forward to the leader (Monitor::forward_request);
+                # a mon mid-election drops the report — OSDs re-send
+                # every tick, so the eventual leader still hears it
+                if self.is_peon():
+                    name = self._peer_name(self.leader_rank)
+                    if name:
+                        fwd = MOSDFailure(target_osd=msg.target_osd,
+                                          failed_since=msg.failed_since,
+                                          epoch=msg.epoch)
+                        fwd.src = msg.src  # preserve reporter identity
+                        self.network.queue.append((msg.src, name, fwd))
+                return
             # OSDMonitor::check_failure quorum: distinct reporters must
             # agree before the mark (mon_osd_min_down_reporters)
             if not self.osdmap.is_up(msg.target_osd):

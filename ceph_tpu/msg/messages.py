@@ -195,6 +195,46 @@ class MOSDFailure(Message):
 
 
 @dataclass
+class MMonElection(Message):
+    """Mon <-> mon election (src/mon/Elector.cc / MMonElection.h roles):
+    propose/ack/victory; lowest reachable rank wins."""
+    OP_PROPOSE = "propose"
+    OP_ACK = "ack"
+    OP_VICTORY = "victory"
+    op: str = OP_PROPOSE
+    epoch: int = 0              # election epoch (odd = electing, even = won)
+    rank: int = -1
+    quorum: List[int] = field(default_factory=list)
+
+
+@dataclass
+class MMonPaxos(Message):
+    """Mon <-> mon map replication (src/mon/Paxos.cc phases, simplified
+    to the leader-driven begin/accept/commit + collect recovery)."""
+    OP_COLLECT = "collect"
+    OP_LAST = "last"
+    OP_BEGIN = "begin"
+    OP_ACCEPT = "accept"
+    OP_COMMIT = "commit"
+    op: str = OP_COLLECT
+    rank: int = -1
+    pn: int = 0                 # proposal number (election epoch based)
+    last_committed: int = 0
+    values: List[Any] = field(default_factory=list)
+    # values = incremental dicts (osdmap/encoding) being replicated
+
+
+@dataclass
+class MMonPing(Message):
+    """Mon <-> mon liveness (the elector's keepalives)."""
+    PING = "ping"
+    REPLY = "reply"
+    op: str = PING
+    rank: int = -1
+    stamp: float = 0.0
+
+
+@dataclass
 class MOSDMap(Message):
     """Mon -> everyone map publication (src/messages/MOSDMap.h); carries
     incrementals from ``first`` to ``last``."""

@@ -24,7 +24,6 @@ across devices (see ceph_tpu.parallel).
 from __future__ import annotations
 
 import functools
-import threading
 from collections import OrderedDict
 from typing import Dict, Sequence, Tuple
 
@@ -33,6 +32,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..common.lockdep import DebugLock
 from ..gf.tables import expand_to_bitmatrix
 from ..gf.matrices import gf_invert_matrix
 
@@ -153,7 +153,7 @@ class DeviceRSBackend:
         # bounded like the host codec's signature cache (mirrors
         # ErasureCodeIsaTableCache's 2516-entry LRU)
         self._decode_bits_cache: "OrderedDict[tuple, jnp.ndarray]" = OrderedDict()
-        self._cache_lock = threading.Lock()
+        self._cache_lock = DebugLock("gf_matmul::decode_bits_cache")
 
     # -- encode -------------------------------------------------------------
     def encode(self, data: np.ndarray) -> np.ndarray:

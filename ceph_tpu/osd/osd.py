@@ -62,11 +62,15 @@ def _build_osd_perf(name: str):
 
 class OSD(Dispatcher):
     def __init__(self, network: Network, osd_id: int,
-                 mon_name: str = "mon", store: Optional[MemStore] = None):
+                 mon_name: str = "mon", store: Optional[MemStore] = None,
+                 mon_names: Optional[List[str]] = None):
         self.osd_id = osd_id
         self.name = f"osd.{osd_id}"
         self.network = network
         self.mon_name = mon_name
+        # failure reports go to every monitor (peons forward to the
+        # leader), so a dead leader doesn't blind failure detection
+        self.mon_names = list(mon_names) if mon_names else [mon_name]
         self.messenger = network.create_messenger(self.name)
         self.messenger.add_dispatcher_head(self)
         self.store = store if store is not None else MemStore()
@@ -163,7 +167,17 @@ class OSD(Dispatcher):
         self.perf_counters.inc(L_OSD_MAP)
         for inc in msg.incrementals:
             if inc.epoch == self.osdmap.epoch + 1:
+                was_up = {o for o in range(self.osdmap.max_osd)
+                          if self.osdmap.is_up(o)}
                 self.osdmap.apply_incremental(inc)
+                # a peer newly marked up gets a fresh heartbeat grace and
+                # its standing failure report is withdrawn (the
+                # reference's send_still_alive cancellation role) —
+                # otherwise stale ping state re-reports it instantly
+                for o in range(self.osdmap.max_osd):
+                    if self.osdmap.is_up(o) and o not in was_up:
+                        self.last_ping_reply[o] = self.now
+                        self.reported_failures.discard(o)
                 self._consume_map()
 
     def _consume_map(self) -> None:
@@ -279,12 +293,16 @@ class OSD(Dispatcher):
         for peer in peers:
             last = self.last_ping_reply.get(peer, now)
             self.last_ping_reply.setdefault(peer, now)
-            if now - last > HEARTBEAT_GRACE and \
-                    peer not in self.reported_failures:
+            if now - last > HEARTBEAT_GRACE:
+                # keep re-sending while the peer stays silent: the mon
+                # leadership may change mid-outage and a one-shot report
+                # to a dead leader would blind failure detection (the
+                # reference OSD also re-reports until the mark)
                 self.reported_failures.add(peer)
-                self.messenger.send_message(
-                    MOSDFailure(target_osd=peer, failed_since=last,
-                                epoch=self.osdmap.epoch), self.mon_name)
+                for mon in self.mon_names:
+                    self.messenger.send_message(
+                        MOSDFailure(target_osd=peer, failed_since=last,
+                                    epoch=self.osdmap.epoch), mon)
 
     def _handle_ping(self, msg: MOSDPing) -> None:
         if msg.op == MOSDPing.PING:

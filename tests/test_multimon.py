@@ -1,0 +1,136 @@
+"""Multi-monitor control plane: election, replication, leader failover.
+
+Models the reference's mon quorum (src/mon/Elector.cc lowest-rank-wins
+elections, src/mon/Paxos.cc leader-driven replication): three monitors
+replicate every committed epoch; killing the leader elects a successor
+that continues publishing from the last committed state, and a revived
+monitor catches up through the collect/last recovery phase.
+"""
+import numpy as np
+
+from ceph_tpu.cluster import MiniCluster
+
+
+def payload(n=15000, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def test_three_mons_elect_and_replicate():
+    c = MiniCluster(n_osds=5, n_mons=3)
+    assert c.mon.name == "mon.0"          # lowest rank leads
+    assert c.mon.quorum == {0, 1, 2}
+    c.create_ec_pool("p", k=3, m=2, pg_num=8, plugin="tpu")
+    # every committed epoch is replicated to the peons
+    for m in c.mons:
+        assert m.osdmap.epoch == c.mons[0].osdmap.epoch
+        assert len(m.incrementals) == len(c.mons[0].incrementals)
+    cl = c.client("client.m")
+    data = payload(seed=1)
+    assert cl.write_full("p", "o", data) == 0
+    assert cl.read("p", "o") == data
+
+
+def test_leader_failover_continues_service():
+    c = MiniCluster(n_osds=5, n_mons=3)
+    c.create_ec_pool("p", k=3, m=2, pg_num=8, plugin="tpu")
+    cl = c.client("client.f")
+    assert cl.write_full("p", "pre", payload(seed=2)) == 0
+    epoch_before = c.mon.osdmap.epoch
+    c.kill_mon(0)
+    # keepalive grace expires -> survivors elect mon.1
+    for _ in range(6):
+        c.tick(dt=6.0)
+    leader = c.mon
+    assert leader.name == "mon.1"
+    assert leader.is_leader()
+    assert 0 not in leader.quorum
+    # the new leader continues from the committed history
+    assert leader.osdmap.epoch >= epoch_before
+    # and the control plane still works: osd failure -> mark down -> remap
+    holders = {o.osd_id for o in c.osds.values()
+               if any(ho.oid == "pre"
+                      for cid in o.store.list_collections()
+                      for ho in o.store.list_objects(cid))}
+    pool_id = cl.lookup_pool("p")
+    _, primary = cl._calc_target(pool_id, "pre")
+    victim = next(o for o in holders if o != primary)
+    c.kill_osd(victim)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    assert not leader.osdmap.is_up(victim)
+    assert cl.read("p", "pre") == payload(seed=2)
+
+
+def test_revived_mon_catches_up():
+    c = MiniCluster(n_osds=5, n_mons=3)
+    c.create_ec_pool("p", k=3, m=2, pg_num=8, plugin="tpu")
+    c.kill_mon(2)
+    # epochs committed while mon.2 is away
+    c.mon.mark_osd_out(4)
+    c.network.pump()
+    c.mon.mark_osd_in(4)
+    c.network.pump()
+    target = c.mon.osdmap.epoch
+    c.revive_mon(2)
+    for _ in range(3):
+        c.tick(dt=6.0)
+    mon2 = next(m for m in c.mons if m.name == "mon.2")
+    assert mon2.osdmap.epoch == target
+    assert len(mon2.incrementals) == len(c.mon.incrementals)
+
+
+def test_minority_cannot_elect():
+    """A single partitioned mon must not declare itself leader (no
+    split-brain: victory needs a majority of the mon map)."""
+    c = MiniCluster(n_osds=3, n_mons=3)
+    c.kill_mon(1)
+    c.kill_mon(2)
+    mon0 = c.mons[0]
+    mon0.start_election()
+    c.network.pump()
+    for _ in range(4):
+        c.tick(dt=6.0)
+    # mon.0 alone is 1 of 3: not a majority
+    assert not mon0.is_leader() or len(mon0.quorum) >= 2, \
+        (mon0.leader_rank, mon0.quorum)
+    assert mon0.leader_rank == -1
+
+
+def test_osd_failure_detected_across_leader_outage():
+    """An OSD that dies just before the mon leader dies must still get
+    marked down by the successor: OSDs re-send failure reports every
+    tick, and mid-election mons drop rather than act on them."""
+    c = MiniCluster(n_osds=5, n_mons=3)
+    c.create_ec_pool("p", k=3, m=2, pg_num=8, plugin="tpu")
+    cl = c.client("client.o")
+    assert cl.write_full("p", "o", payload(seed=9)) == 0
+    holders = {o.osd_id for o in c.osds.values()
+               if any(ho.oid == "o" for cid in o.store.list_collections()
+                      for ho in o.store.list_objects(cid))}
+    pool_id = cl.lookup_pool("p")
+    _, primary = cl._calc_target(pool_id, "o")
+    victim = next(o for o in holders if o != primary)
+    c.kill_osd(victim)
+    c.kill_mon(0)   # leader dies in the same window
+    for _ in range(10):
+        c.tick(dt=6.0)
+    leader = c.mon
+    assert leader.is_leader() and leader.name != "mon.0"
+    assert not leader.osdmap.is_up(victim), "successor must mark it down"
+    assert cl.read("p", "o") == payload(seed=9)
+    # quorum histories stayed convergent
+    live = [m for m in c.mons if m.name != "mon.0"]
+    assert live[0].osdmap.epoch == live[1].osdmap.epoch
+    assert len(live[0].incrementals) == len(live[1].incrementals)
+
+
+def test_mutation_without_quorum_raises():
+    c = MiniCluster(n_osds=3, n_mons=3)
+    c.kill_mon(1)
+    c.kill_mon(2)
+    for _ in range(5):
+        c.tick(dt=6.0)
+    import pytest
+    with pytest.raises(RuntimeError, match="quorum"):
+        c.mons[0].mark_osd_out(1)
