@@ -203,15 +203,21 @@ def main() -> None:
     except Exception as e:
         errors.append(f"device bench failed: {e!r}")
 
-    try:
-        wall_ms, dev_ms, host_ms, resid = measure_crush_remap()
-        result["crush_remap_100k_pgs_ms"] = round(dev_ms, 1)
-        result["crush_remap_wall_ms"] = round(wall_ms, 1)
-        result["crush_residual_fraction"] = resid
-        if host_ms:
-            result["crush_remap_vs_native_host"] = round(host_ms / dev_ms, 2)
-    except Exception as e:
-        errors.append(f"crush bench failed: {e!r}")
+    # the tunnel can drop a long-running remote compile mid-flight;
+    # retry the whole section once before recording the failure
+    for attempt in range(2):
+        try:
+            wall_ms, dev_ms, host_ms, resid = measure_crush_remap()
+            result["crush_remap_100k_pgs_ms"] = round(dev_ms, 1)
+            result["crush_remap_wall_ms"] = round(wall_ms, 1)
+            result["crush_residual_fraction"] = resid
+            if host_ms:
+                result["crush_remap_vs_native_host"] = round(
+                    host_ms / dev_ms, 2)
+            break
+        except Exception as e:
+            if attempt == 1:
+                errors.append(f"crush bench failed: {e!r}")
 
     if errors:
         result["error"] = "; ".join(errors)

@@ -39,6 +39,12 @@ class MiniCluster:
             # initial election: rank 0 wins; recovery syncs the quorum
             self.mons[0].start_election()
             self.network.pump()
+            if _bootstrap:
+                # commit the bootstrap topology as epoch 1 so it is
+                # replicated — a leader failover before the first pool
+                # creation must not lose the cluster topology
+                self.mons[0].publish()
+                self.network.pump()
         self.osds: Dict[int, OSD] = {}
         self.perf_collection = PerfCountersCollection()
         mon_names = [m.name for m in self.mons]
@@ -50,7 +56,16 @@ class MiniCluster:
             for m in self.mons:
                 m.subscribe(osd.name)
             self.perf_collection.add(osd.perf_counters)
+        if n_mons > 1 and _bootstrap:
+            # osds subscribed after the bootstrap epoch: catch them up
+            for osd in self.osds.values():
+                self.mons[0].send_full_map(osd.name)
+            self.network.pump()
         self.clock = 0.0
+        from .mgr import Manager
+        # the mgr always talks to the CURRENT leader (failover-safe)
+        self.mgr = Manager(self.network, lambda: self.mon,
+                           all_mons=self.mons)
         self.admin_socket = AdminSocket()
         self._register_admin_commands()
 
@@ -75,7 +90,7 @@ class MiniCluster:
         import os
         os.makedirs(directory, exist_ok=True)
         self.mon.save(os.path.join(directory, "mon.json"))
-        meta = {"n_osds": len(self.osds)}
+        meta = {"n_osds": len(self.osds), "n_mons": len(self.mons)}
         for i, osd in self.osds.items():
             osd.store.save(os.path.join(directory, f"osd.{i}.store"))
         import json
@@ -93,13 +108,19 @@ class MiniCluster:
         with open(os.path.join(directory, "cluster.json")) as f:
             meta = json.load(f)
         n = meta["n_osds"]
+        n_mons = meta.get("n_mons", 1)
         stores = {i: MemStore.load(os.path.join(directory, f"osd.{i}.store"))
                   for i in range(n)}
-        c = cls(n_osds=n, _stores=stores, _bootstrap=False)
-        c.mon.load(os.path.join(directory, "mon.json"))
+        c = cls(n_osds=n, n_mons=n_mons, _stores=stores, _bootstrap=False)
+        c.mons[0].load(os.path.join(directory, "mon.json"))
+        if n_mons > 1:
+            # re-elect so the collect/last recovery replays the loaded
+            # history onto the (empty) peons
+            c.mons[0].start_election()
+            c.network.pump()
         # boot: every osd catches up on the full map history and re-peers
         for osd in c.osds.values():
-            c.mon.send_full_map(osd.name)
+            c.mons[0].send_full_map(osd.name)
         c.network.pump()
         c.run_recovery()
         return c
@@ -128,6 +149,17 @@ class MiniCluster:
             lambda c, a: {o.name: o.op_tracker.dump_ops_in_flight()
                           for o in self.osds.values()},
             "in-flight ops")
+        asok.register("mgr status", lambda c, a: self.mgr.status(),
+                      "manager module status")
+        asok.register(
+            "balancer optimize",
+            lambda c, a: {"changes": self.mgr.balancer_optimize()},
+            "run one upmap balancer pass")
+        asok.register(
+            "prometheus metrics",
+            lambda c, a: self.mgr.prometheus_metrics(
+                self.perf_collection),
+            "prometheus text exposition")
 
     # ---- pools ------------------------------------------------------------
     def create_ec_pool(self, name: str, k: int = 4, m: int = 2,

@@ -1,0 +1,3 @@
+from .mgr import Manager
+
+__all__ = ["Manager"]

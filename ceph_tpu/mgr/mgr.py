@@ -1,0 +1,127 @@
+"""ceph-mgr-lite — the manager daemon's module host.
+
+The reference mgr (src/mgr/, ~9k LoC) subscribes to cluster maps and
+hosts python modules (pybind/mgr/: balancer, prometheus, status...).
+This is the same shape over the mini-cluster fabric:
+
+- ``balancer``: periodically runs calc_pg_upmaps (the device-batched
+  upmap optimizer, osdmap/balancer.py — OSDMap::calc_pg_upmaps role) and
+  proposes the resulting pg_upmap_items to the monitor as an
+  Incremental, exactly how pybind/mgr/balancer/module.py feeds the mon.
+- ``prometheus``: renders cluster gauges + every registered perf counter
+  in the Prometheus text exposition format
+  (pybind/mgr/prometheus/module.py role).
+- ``status``: health / pg / pool summaries for the admin socket.
+
+The mgr is a map subscriber like any daemon: it keeps its own OSDMap
+copy current from MOSDMap broadcasts.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..msg import Dispatcher, MOSDMap, Message, Network
+from ..osdmap import Incremental, OSDMap
+from ..osdmap.balancer import calc_pg_upmaps
+
+
+class Manager(Dispatcher):
+    def __init__(self, network: Network, mon, name: str = "mgr",
+                 all_mons=None):
+        """*mon* is either a Monitor or a zero-arg resolver returning the
+        current leader (failover-safe); *all_mons* subscribes the mgr on
+        every monitor so map updates keep flowing after an election."""
+        self.network = network
+        self._mon = mon
+        self.name = name
+        self.messenger = network.create_messenger(name)
+        self.messenger.add_dispatcher_head(self)
+        self.osdmap = OSDMap()
+        self.modules = ["balancer", "prometheus", "status"]
+        self.balancer_active = False     # 'ceph balancer on' equivalent
+        self.last_optimize_result = 0
+        for m in (all_mons if all_mons is not None else [self.mon]):
+            m.subscribe(name)
+        self.mon.send_full_map(name)
+        network.pump()
+
+    @property
+    def mon(self):
+        return self._mon() if callable(self._mon) else self._mon
+
+    # ---- dispatch ----------------------------------------------------------
+    def ms_fast_dispatch(self, msg: Message) -> None:
+        if isinstance(msg, MOSDMap):
+            for inc in msg.incrementals:
+                if inc.epoch == self.osdmap.epoch + 1:
+                    self.osdmap.apply_incremental(inc)
+
+    # ---- balancer module ---------------------------------------------------
+    def balancer_optimize(self, max_deviation: float = 0.01,
+                          max_iterations: int = 10) -> int:
+        """One optimization pass: compute pg_upmap_items on our map copy
+        and propose them to the mon (balancer/module.py:optimize ->
+        OSDMonitor upmap commands).  Returns the number of changes."""
+        import copy
+        inc = Incremental()
+        work = copy.deepcopy(self.osdmap)
+        n = calc_pg_upmaps(work, max_deviation=max_deviation,
+                           max_iterations=max_iterations, inc=inc)
+        self.last_optimize_result = n
+        if n:
+            self.mon.publish(inc)
+            self.network.pump()
+        return n
+
+    def tick(self) -> None:
+        """Periodic module work (the mgr's serve loops)."""
+        if self.balancer_active:
+            self.balancer_optimize()
+
+    # ---- status module -----------------------------------------------------
+    def status(self) -> Dict:
+        m = self.osdmap
+        n_up = sum(1 for o in range(m.max_osd) if m.is_up(o))
+        n_in = sum(1 for o in range(m.max_osd)
+                   if m.exists(o) and m.osd_weight[o] > 0)
+        return {
+            "epoch": m.epoch,
+            "num_osds": m.max_osd,
+            "num_up_osds": n_up,
+            "num_in_osds": n_in,
+            "num_pools": len(m.pools),
+            "num_pgs": sum(p.pg_num for p in m.pools.values()),
+            "num_pg_upmap_items": len(m.pg_upmap_items),
+            "balancer_active": self.balancer_active,
+            "last_optimize_result": self.last_optimize_result,
+        }
+
+    # ---- prometheus module -------------------------------------------------
+    def prometheus_metrics(self, perf_collection=None) -> str:
+        """Prometheus text exposition of cluster gauges + perf counters
+        (pybind/mgr/prometheus/module.py role)."""
+        s = self.status()
+        lines: List[str] = []
+
+        def gauge(name: str, value, help_: str, labels: str = "") -> None:
+            lines.append(f"# HELP ceph_{name} {help_}")
+            lines.append(f"# TYPE ceph_{name} gauge")
+            lines.append(f"ceph_{name}{labels} {value}")
+
+        gauge("osdmap_epoch", s["epoch"], "Current osdmap epoch")
+        gauge("osd_up", s["num_up_osds"], "OSDs up")
+        gauge("osd_in", s["num_in_osds"], "OSDs in")
+        gauge("pools", s["num_pools"], "Pools")
+        gauge("pgs", s["num_pgs"], "Placement groups")
+        if perf_collection is not None:
+            dump = perf_collection.dump()
+            for logger, counters in sorted(dump.items()):
+                if not isinstance(counters, dict):
+                    continue
+                for cname, val in sorted(counters.items()):
+                    if not isinstance(val, (int, float)):
+                        continue
+                    metric = f"{logger}_{cname}".replace(".", "_")
+                    lines.append(
+                        f"ceph_daemon_{metric} {val}")
+        return "\n".join(lines) + "\n"

@@ -454,11 +454,11 @@ class Monitor(Dispatcher):
                 if self.is_peon():
                     name = self._peer_name(self.leader_rank)
                     if name:
-                        fwd = MOSDFailure(target_osd=msg.target_osd,
-                                          failed_since=msg.failed_since,
-                                          epoch=msg.epoch)
-                        fwd.src = msg.src  # preserve reporter identity
-                        self.network.queue.append((msg.src, name, fwd))
+                        self.messenger.send_message(MOSDFailure(
+                            target_osd=msg.target_osd,
+                            failed_since=msg.failed_since,
+                            epoch=msg.epoch,
+                            reporter=msg.reporter or msg.src), name)
                 return
             # OSDMonitor::check_failure quorum: distinct reporters must
             # agree before the mark (mon_osd_min_down_reporters)
@@ -466,7 +466,7 @@ class Monitor(Dispatcher):
                 return
             reporters = self._failure_reports.setdefault(
                 msg.target_osd, set())
-            reporters.add(msg.src)
+            reporters.add(msg.reporter or msg.src)
             if len(reporters) >= self.min_down_reporters():
                 del self._failure_reports[msg.target_osd]
                 self.mark_osd_down(msg.target_osd)

@@ -188,10 +188,14 @@ class MOSDPing(Message):
 
 @dataclass
 class MOSDFailure(Message):
-    """OSD -> mon failure report (src/messages/MOSDFailure.h)."""
+    """OSD -> mon failure report (src/messages/MOSDFailure.h).
+
+    ``reporter`` survives peon->leader forwarding (src is stomped by
+    every send), keeping the reporter-quorum count honest."""
     target_osd: int = -1
     failed_since: float = 0.0
     epoch: int = 0
+    reporter: str = ""
 
 
 @dataclass

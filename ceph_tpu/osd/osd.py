@@ -78,7 +78,6 @@ class OSD(Dispatcher):
         self.pgs: Dict[Tuple[int, int], PG] = {}
         self._ec_impls: Dict[str, object] = {}
         self.last_ping_reply: Dict[int, float] = {}
-        self.reported_failures: Set[int] = set()
         self.now = 0.0
         self.perf_counters = _build_osd_perf(self.name)
         self.op_tracker = OpTracker()
@@ -177,7 +176,6 @@ class OSD(Dispatcher):
                 for o in range(self.osdmap.max_osd):
                     if self.osdmap.is_up(o) and o not in was_up:
                         self.last_ping_reply[o] = self.now
-                        self.reported_failures.discard(o)
                 self._consume_map()
 
     def _consume_map(self) -> None:
@@ -298,11 +296,11 @@ class OSD(Dispatcher):
                 # leadership may change mid-outage and a one-shot report
                 # to a dead leader would blind failure detection (the
                 # reference OSD also re-reports until the mark)
-                self.reported_failures.add(peer)
                 for mon in self.mon_names:
                     self.messenger.send_message(
                         MOSDFailure(target_osd=peer, failed_since=last,
-                                    epoch=self.osdmap.epoch), mon)
+                                    epoch=self.osdmap.epoch,
+                                    reporter=self.name), mon)
 
     def _handle_ping(self, msg: MOSDPing) -> None:
         if msg.op == MOSDPing.PING:
@@ -312,7 +310,6 @@ class OSD(Dispatcher):
         else:
             peer = int(msg.src.split(".")[1])
             self.last_ping_reply[peer] = self.now
-            self.reported_failures.discard(peer)
 
     # ---- recovery (message-driven; ECBackend.cc:535-743) -------------------
     def request_recovery(self, pg: PG) -> None:

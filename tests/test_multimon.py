@@ -134,3 +134,60 @@ def test_mutation_without_quorum_raises():
     import pytest
     with pytest.raises(RuntimeError, match="quorum"):
         c.mons[0].mark_osd_out(1)
+
+
+def test_pool_creation_after_failover():
+    """The bootstrap topology is committed as an epoch, so a successor
+    leader can create pools (the topology survives mon.0's death even if
+    nothing else was ever published)."""
+    c = MiniCluster(n_osds=5, n_mons=3)
+    c.kill_mon(0)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    leader = c.mon
+    assert leader.name == "mon.1"
+    assert leader.osdmap.max_osd == 5, "bootstrap topology must replicate"
+    c.create_ec_pool("late", k=3, m=2, pg_num=8, plugin="tpu")
+    cl = c.client("client.l")
+    assert cl.write_full("late", "o", payload(seed=4)) == 0
+    assert cl.read("late", "o") == payload(seed=4)
+
+
+def test_mgr_follows_leader_failover():
+    """The mgr resolves the CURRENT leader: balancer commits after a
+    failover reach the live quorum, not the dead mon."""
+    c = MiniCluster(n_osds=8, n_mons=3)
+    c.create_replicated_pool("r", size=3, pg_num=64)
+    c.kill_mon(0)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    assert c.mon.name == "mon.1"
+    changes = c.mgr.balancer_optimize()
+    if changes:
+        # the commit landed on the live quorum (not the dead mon.0) and
+        # both survivors agree
+        live = [m for m in c.mons if m.name != "mon.0"]
+        assert live[0].osdmap.pg_upmap_items
+        assert live[0].osdmap.epoch == live[1].osdmap.epoch
+        assert len(live[0].osdmap.pg_upmap_items) == \
+            len(live[1].osdmap.pg_upmap_items)
+    assert c.mgr.osdmap.epoch == c.mon.osdmap.epoch
+
+
+def test_multimon_checkpoint_restore(tmp_path):
+    c = MiniCluster(n_osds=5, n_mons=3)
+    c.create_ec_pool("p", k=3, m=2, pg_num=8, plugin="tpu")
+    cl = c.client("client.c")
+    assert cl.write_full("p", "o", payload(seed=5)) == 0
+    c.checkpoint(str(tmp_path / "ck"))
+    r = MiniCluster.restore(str(tmp_path / "ck"))
+    assert len(r.mons) == 3
+    for m in r.mons:
+        assert m.osdmap.epoch == r.mons[0].osdmap.epoch
+    cl2 = r.client("client.c2")
+    assert cl2.read("p", "o") == payload(seed=5)
+    r.kill_mon(0)
+    for _ in range(6):
+        r.tick(dt=6.0)
+    assert r.mon.name == "mon.1"
+    assert cl2.read("p", "o") == payload(seed=5)
