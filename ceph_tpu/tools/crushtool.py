@@ -1,21 +1,22 @@
 """crushtool — compile/decompile/test crush maps.
 
 CLI surface mirrors the reference tool (src/tools/crushtool.cc): -c compile
-text → map (pickled), -d decompile, -i map --test with
+text → binary map, -d decompile, -i map --test with
 --num-rep/--min-x/--max-x/--show-statistics/--show-mappings/
---show-bad-mappings/--weight, and --build for quick hierarchies.  The
---test engine is CrushTester (crush/CrushTester.cc:472), running the
-device mapper when eligible.
+--show-bad-mappings/--weight/--set-*-tunables, and --build for quick
+hierarchies.  The --test engine is CrushTester (crush/CrushTester.cc:472),
+running the device mapper when eligible.
 
-Maps are stored as python pickles of CrushWrapper (the reference's binary
-encoding is a C++ serialization detail, not part of the compute contract).
+Maps are stored in the reference's binary crushmap format
+(crush/binfmt.py ≙ CrushWrapper::encode/decode), so this tool reads maps
+produced by the reference crushtool and vice versa.
 """
 from __future__ import annotations
 
 import argparse
-import pickle
 import sys
 
+from ..crush.binfmt import decode_crushmap, encode_crushmap
 from ..crush.compiler import CrushCompiler
 from ..crush.tester import CrushTester
 from ..crush.wrapper import CrushWrapper
@@ -23,12 +24,12 @@ from ..crush.wrapper import CrushWrapper
 
 def load_map(path: str) -> CrushWrapper:
     with open(path, "rb") as f:
-        return pickle.load(f)
+        return decode_crushmap(f.read())
 
 
 def save_map(cw: CrushWrapper, path: str) -> None:
     with open(path, "wb") as f:
-        pickle.dump(cw, f)
+        f.write(encode_crushmap(cw))
 
 
 def main(argv=None) -> int:
@@ -52,14 +53,38 @@ def main(argv=None) -> int:
     p.add_argument("--show-utilization", action="store_true")
     p.add_argument("--weight", nargs=2, action="append", default=[],
                    metavar=("DEVNO", "WEIGHT"))
+    # runtime tunable overrides (reference --set-* flags)
+    p.add_argument("--set-choose-local-tries", type=int, default=None)
+    p.add_argument("--set-choose-local-fallback-tries", type=int,
+                   default=None)
+    p.add_argument("--set-choose-total-tries", type=int, default=None)
+    p.add_argument("--set-chooseleaf-descend-once", type=int, default=None)
+    p.add_argument("--set-chooseleaf-vary-r", type=int, default=None)
+    p.add_argument("--set-chooseleaf-stable", type=int, default=None)
+    p.add_argument("--set-straw-calc-version", type=int, default=None)
     p.add_argument("--host-mapper", action="store_true",
                    help="force the host interpreter (no device batch)")
     args = p.parse_args(argv)
+
+    def apply_tunable_flags(m) -> None:
+        for attr, val in [
+                ("choose_local_tries", args.set_choose_local_tries),
+                ("choose_local_fallback_tries",
+                 args.set_choose_local_fallback_tries),
+                ("choose_total_tries", args.set_choose_total_tries),
+                ("chooseleaf_descend_once",
+                 args.set_chooseleaf_descend_once),
+                ("chooseleaf_vary_r", args.set_chooseleaf_vary_r),
+                ("chooseleaf_stable", args.set_chooseleaf_stable),
+                ("straw_calc_version", args.set_straw_calc_version)]:
+            if val is not None:
+                setattr(m, attr, val)
 
     if args.srcfn:
         with open(args.srcfn) as f:
             text = f.read()
         cw = CrushCompiler().compile(text)
+        apply_tunable_flags(cw.crush)  # reference applies --set-* at -c too
         out = args.outfn or "crushmap"
         save_map(cw, out)
         return 0
@@ -83,6 +108,7 @@ def main(argv=None) -> int:
             print("--test requires -i <map>", file=sys.stderr)
             return 1
         cw = load_map(args.infn)
+        apply_tunable_flags(cw.crush)
         t = CrushTester(cw)
         if args.num_rep >= 0:
             t.set_num_rep(args.num_rep)

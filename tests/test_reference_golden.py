@@ -130,3 +130,89 @@ def test_bad_mappings_match_reference(t_name, map_name):
             assert got == expect, (rule, x, numrep, got, expect)
             checked += 1
     assert checked >= 2, checked
+
+
+_SET_FLAG = re.compile(r"--set-([a-z-]+) (\d+)")
+_FLAG_ATTR = {
+    "choose-local-tries": "choose_local_tries",
+    "choose-local-fallback-tries": "choose_local_fallback_tries",
+    "choose-total-tries": "choose_total_tries",
+    "chooseleaf-descend-once": "chooseleaf_descend_once",
+    "chooseleaf-vary-r": "chooseleaf_vary_r",
+    "chooseleaf-stable": "chooseleaf_stable",
+    "straw-calc-version": "straw_calc_version",
+}
+
+
+def _run_binary_fixture(t_name: str, map_name: str, stride: int = 1):
+    """Replay a cram fixture that evaluates a BINARY reference crushmap:
+    decode it with our codec, apply the command's --set-* tunables and
+    --weight vector, and compare every recorded mapping."""
+    from ceph_tpu.crush.binfmt import decode_crushmap
+    t_path = os.path.join(REF_CLI, t_name)
+    total = 0
+    m = w = None
+    nr_min = 1
+    seen: dict = {}
+    with open(t_path) as f:
+        for raw in f:
+            line = raw.strip()
+            if line.startswith("$ crushtool") and "--test" in line:
+                mm = re.search(r'-i "\$TESTDIR/([^"]+)"', line)
+                assert mm and mm.group(1) == map_name, line
+                with open(os.path.join(REF_CLI, map_name), "rb") as bf:
+                    m = decode_crushmap(bf.read()).crush
+                for flag, val in _SET_FLAG.findall(line):
+                    setattr(m, _FLAG_ATTR[flag], int(val))
+                w = _weights_vector(_WEIGHT.findall(line), m.max_devices)
+                continue
+            hdr = _RULE_HDR.match(line)
+            if hdr:
+                nr_min = int(hdr.group(4))
+                seen = {}
+                continue
+            mm = _MAPPING.match(line)
+            if mm and m is not None:
+                rule, x = int(mm.group(1)), int(mm.group(2))
+                # numrep = header minimum + how many sweeps of this x we
+                # have already passed (results can be SHORTER than
+                # numrep, so len(result) is not a substitute)
+                numrep = nr_min + seen.get((rule, x), 0)
+                seen[(rule, x)] = seen.get((rule, x), 0) + 1
+                if x % stride:
+                    continue
+                expect = [int(v) for v in mm.group(3).split(",")] \
+                    if mm.group(3) else []
+                got = crush_do_rule(m, rule, x, numrep, w)
+                assert got == expect, (t_name, rule, x, numrep, got,
+                                       expect)
+                total += 1
+    return total
+
+
+# stride subsamples the recorded x values to bound suite runtime (the
+# heavy maps cost ~10-45 ms per exact host evaluation); every file still
+# contributes hundreds of cross-checked mappings per run
+@pytest.mark.parametrize("t_name,map_name,stride", [
+    ("test-map-legacy-tunables.t", "test-map-a.crushmap", 16),
+    ("test-map-bobtail-tunables.t", "test-map-a.crushmap", 16),
+    ("test-map-firefly-tunables.t", "test-map-vary-r.crushmap", 16),
+    ("test-map-hammer-tunables.t",
+     "test-map-hammer-tunables.crushmap", 16),
+    ("test-map-jewel-tunables.t", "test-map-jewel-tunables.crushmap", 16),
+    ("test-map-indep.t", "test-map-indep.crushmap", 16),
+    ("test-map-tries-vs-retries.t",
+     "test-map-tries-vs-retries.crushmap", 16),
+    ("test-map-vary-r-0.t", "test-map-vary-r.crushmap", 16),
+    ("test-map-vary-r-1.t", "test-map-vary-r.crushmap", 16),
+    ("test-map-vary-r-2.t", "test-map-vary-r.crushmap", 16),
+    ("test-map-vary-r-3.t", "test-map-vary-r.crushmap", 16),
+    ("test-map-vary-r-4.t", "test-map-vary-r.crushmap", 16),
+])
+def test_binary_fixture_mappings_match_reference(t_name, map_name, stride):
+    """Binary maps produced by the reference crushtool, decoded by our
+    codec, must map identically across every tunables profile the
+    reference recorded (legacy/bobtail/firefly/hammer/jewel, indep,
+    tries-vs-retries, vary-r 0..4)."""
+    total = _run_binary_fixture(t_name, map_name, stride)
+    assert total > 100, total
