@@ -232,3 +232,50 @@ def test_fast_rejects_chained_rules():
                            min_size=1, max_size=10), "chain")
     with pytest.raises(UnsupportedRule):
         compile_fast_rule(cw.crush, rno, 4)
+
+
+def test_fast_delta_epochs_stay_exact():
+    """The per-epoch delta fetch must equal a from-scratch exact map for
+    every epoch: weights flap up/down, residual lanes appear/disappear,
+    and a tiny delta_cap forces the overflow -> full-fetch path too."""
+    cw, n = build_map(n_hosts=6, osds_per_host=4, uneven=True)
+    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    fr = compile_fast_rule(cw.crush, rno, 3)
+    fr.delta_cap = 8  # force overflow on big epochs
+    xs = np.arange(N_X, dtype=np.uint32)
+    rng = np.random.default_rng(42)
+    weight = np.full(n, 0x10000, dtype=np.uint32)
+    for epoch in range(8):
+        if epoch:
+            if epoch % 3 == 0:
+                # big epoch: heavy random reweight (overflows the cap)
+                weight = rng.choice(
+                    [0, 0x2000, 0x8000, 0x10000], size=n).astype(np.uint32)
+            else:
+                # small epoch: one osd flaps
+                weight = weight.copy()
+                weight[(5 * epoch) % n] ^= 0x10000
+        res, cnt = fr.map_batch(xs, weight)
+        wl = [int(w) for w in weight]
+        for x in range(0, N_X, 7):
+            expect = cw.do_rule(rno, int(x), 3, wl)
+            got = list(res[x, :cnt[x]])
+            assert got == expect, (epoch, x, got, expect)
+
+
+def test_fast_delta_indep_epochs_stay_exact():
+    cw, n = build_map(n_hosts=7, osds_per_host=3)
+    rno = cw.add_simple_rule("data", "default", "host", mode="indep")
+    fr = compile_fast_rule(cw.crush, rno, 3)
+    xs = np.arange(300, dtype=np.uint32)
+    weight = np.full(n, 0x10000, dtype=np.uint32)
+    for epoch in range(4):
+        if epoch:
+            weight = weight.copy()
+            weight[(3 * epoch + 1) % n] ^= 0x10000
+        res, cnt = fr.map_batch(xs, weight)
+        wl = [int(w) for w in weight]
+        for x in range(0, 300, 11):
+            expect = cw.do_rule(rno, int(x), 3, wl)
+            got = [int(v) for v in res[x, :cnt[x]]]
+            assert got == expect, (epoch, x, got, expect)
