@@ -89,7 +89,15 @@ def measure_device(matrix: np.ndarray, batch: np.ndarray) -> float:
     # the chip's int8-MXU compute floor — a cache, not the hardware.)
     @jax.jit
     def step(d, b, salt):
-        return gf_bit_matmul(d ^ salt.astype(jnp.uint8), b)
+        # xor the full 32-bit salt across the payload (bitcast to u32
+        # lanes) so the input genuinely never repeats within a run — a
+        # uint8 salt would cycle every 256 iterations
+        s_, k_, c_ = d.shape
+        d32 = jax.lax.bitcast_convert_type(
+            d.reshape(s_, k_, c_ // 4, 4), jnp.uint32)
+        d8 = jax.lax.bitcast_convert_type(
+            d32 ^ salt, jnp.uint8).reshape(s_, k_, c_)
+        return gf_bit_matmul(d8, b)
 
     step(dev, bits, jnp.uint32(0)).block_until_ready()  # compile + warm
     n, t0 = 0, time.perf_counter()
@@ -154,17 +162,23 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10):
     t0 = time.perf_counter()
     np.asarray(tiny)
     rtt_ms = (time.perf_counter() - t0) * 1000
-    # sustained device resolve time (back-to-back dispatches, one sync)
+    # sustained device resolve time: back-to-back dispatches drained by
+    # fetching one element of the LAST output.  PJRT executes in
+    # submission order, so that fetch completing means every dispatch
+    # completed — block_until_ready alone is not trustworthy over a
+    # tunnelled transport (it can acknowledge before remote completion).
+    # The fetch round trip itself is subtracted via the measured rtt.
     wds = []
     for e in range(epochs):
         w2 = w.copy()
-        w2[(11 * e + 5) % n_osds] = 0
+        w2[(13 * e + 29) % n_osds] = 0
         wds.append(jnp.asarray(w2))
-    jax.block_until_ready(fr.resolve_device(wds[0]))
+    np.asarray(fr.resolve_device(wds[0])[0][0, 0])   # warm + drain
     t0 = time.perf_counter()
     outs = [fr.resolve_device(wd) for wd in wds]
-    jax.block_until_ready(outs)
-    dev_ms = (time.perf_counter() - t0) / len(wds) * 1000
+    np.asarray(outs[-1][0][0, 0])
+    total = (time.perf_counter() - t0) * 1000
+    dev_ms = max(total - rtt_ms, 0.0) / len(wds)
     host_ms = None
     try:
         from ceph_tpu.native import NativeCrushMapper, native_available

@@ -1,3 +1,3 @@
-from .rados import RadosClient
+from .rados import ObjectOperation, RadosClient
 
-__all__ = ["RadosClient"]
+__all__ = ["ObjectOperation", "RadosClient"]

@@ -10,17 +10,119 @@ recalc-on-every-epoch behavior.
 from __future__ import annotations
 
 import struct
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ..msg import (
     CEPH_OSD_OP_APPEND, CEPH_OSD_OP_DELETE, CEPH_OSD_OP_READ,
     CEPH_OSD_OP_STAT, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_WRITEFULL,
     Dispatcher, MOSDMap, MOSDOp, MOSDOpReply, Message, Network,
 )
-from ..msg.messages import new_trace_id
+from ..msg.messages import (
+    CEPH_OSD_CMPXATTR_OP_EQ, CEPH_OSD_OP_CMPXATTR, CEPH_OSD_OP_CREATE,
+    CEPH_OSD_OP_FLAG_EXCL, CEPH_OSD_OP_GETXATTR, CEPH_OSD_OP_GETXATTRS,
+    CEPH_OSD_OP_OMAPGETVALS, CEPH_OSD_OP_OMAPRMKEYS,
+    CEPH_OSD_OP_OMAPSETKEYS, CEPH_OSD_OP_RMXATTR, CEPH_OSD_OP_SETXATTR,
+    CEPH_OSD_OP_TRUNCATE, CEPH_OSD_OP_ZERO, OSDOp, new_trace_id,
+)
+from ..msg.kv import pack_kv as _pack_kv, pack_keys as _pack_keys, \
+    unpack_kv as _unpack_kv
 from ..osdmap import OSDMap, ceph_stable_mod, pg_t
 
 MAX_ATTEMPTS = 8
+
+
+
+
+class ObjectOperation:
+    """Builder for an atomic multi-op vector (librados
+    ObjectWriteOperation/ObjectReadOperation, executed by the OSD's
+    do_osd_ops interpreter in order, all-or-nothing)."""
+
+    def __init__(self):
+        self.ops: list = []
+
+    # -- data ops --
+    def create(self, exclusive: bool = True) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_CREATE,
+                              flags=CEPH_OSD_OP_FLAG_EXCL
+                              if exclusive else 0))
+        return self
+
+    def write(self, data: bytes, offset: int) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_WRITE, data=bytes(data),
+                              offset=offset))
+        return self
+
+    def write_full(self, data: bytes) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_WRITEFULL, data=bytes(data)))
+        return self
+
+    def append(self, data: bytes) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_APPEND, data=bytes(data)))
+        return self
+
+    def truncate(self, size: int) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_TRUNCATE, offset=size))
+        return self
+
+    def zero(self, offset: int, length: int) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_ZERO, offset=offset,
+                              length=length))
+        return self
+
+    def remove(self) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_DELETE))
+        return self
+
+    def read(self, offset: int = 0, length: int = 0) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_READ, offset=offset,
+                              length=length))
+        return self
+
+    def stat(self) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_STAT))
+        return self
+
+    # -- xattrs --
+    def set_xattr(self, name: str, value: bytes) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_SETXATTR, name=name,
+                              data=bytes(value)))
+        return self
+
+    def get_xattr(self, name: str) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_GETXATTR, name=name))
+        return self
+
+    def get_xattrs(self) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_GETXATTRS))
+        return self
+
+    def rm_xattr(self, name: str) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_RMXATTR, name=name))
+        return self
+
+    def cmp_xattr(self, name: str, value: bytes,
+                  comparison: int = CEPH_OSD_CMPXATTR_OP_EQ
+                  ) -> "ObjectOperation":
+        """Guard: abort the whole vector with ECANCELED on mismatch."""
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_CMPXATTR, name=name,
+                              data=bytes(value), flags=comparison))
+        return self
+
+    # -- omap (replicated pools only) --
+    def omap_set(self, kv) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_OMAPSETKEYS,
+                              data=_pack_kv(kv)))
+        return self
+
+    def omap_rm_keys(self, keys) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_OMAPRMKEYS,
+                              data=_pack_keys(keys)))
+        return self
+
+    def omap_get(self) -> "ObjectOperation":
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_OMAPGETVALS))
+        return self
 
 
 class RadosClient(Dispatcher):
@@ -55,8 +157,9 @@ class RadosClient(Dispatcher):
         *_, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
         return (pool_id, ps), primary
 
-    def _submit(self, pool_id: int, oid: str, op: str, data: bytes = b"",
-                offset: int = 0, length: int = 0) -> MOSDOpReply:
+    def _submit(self, pool_id: int, oid: str, op: str = "",
+                data: bytes = b"", offset: int = 0, length: int = 0,
+                ops: Optional[list] = None) -> MOSDOpReply:
         for attempt in range(MAX_ATTEMPTS):
             pgid, primary = self._calc_target(pool_id, oid)
             self._tid += 1
@@ -65,6 +168,7 @@ class RadosClient(Dispatcher):
                 msg = MOSDOp(tid=tid, pool=pool_id, oid=oid, pgid=pgid,
                              op=op, data=data, offset=offset,
                              length=length, epoch=self.osdmap.epoch,
+                             ops=list(ops) if ops else [],
                              trace_id=new_trace_id())
                 self.messenger.send_message(msg, f"osd.{primary}")
                 self.network.pump()
@@ -76,6 +180,13 @@ class RadosClient(Dispatcher):
             self.network.pump()
         return reply if reply is not None else MOSDOpReply(tid=tid,
                                                            result=-110)
+
+    def operate(self, pool: str, oid: str, op: ObjectOperation
+                ) -> Tuple[int, list]:
+        """Execute an atomic multi-op vector; returns (result,
+        [(per-op result, per-op data), ...]) — rados_*_op_operate."""
+        r = self._submit(self.lookup_pool(pool), oid, ops=op.ops)
+        return r.result, list(r.op_results)
 
     def lookup_pool(self, name: str) -> int:
         pid = self.osdmap.lookup_pg_pool_name(name)
@@ -118,3 +229,56 @@ class RadosClient(Dispatcher):
     def remove(self, pool: str, oid: str) -> int:
         return self._submit(self.lookup_pool(pool), oid,
                             CEPH_OSD_OP_DELETE).result
+
+    # -- xattr / omap / extent convenience verbs (librados rados_*) ----------
+    def setxattr(self, pool: str, oid: str, name: str,
+                 value: bytes) -> int:
+        r, _ = self.operate(pool, oid,
+                            ObjectOperation().set_xattr(name, value))
+        return r
+
+    def getxattr(self, pool: str, oid: str, name: str) -> bytes:
+        r, res = self.operate(pool, oid,
+                              ObjectOperation().get_xattr(name))
+        if r < 0:
+            raise IOError(f"getxattr {oid}.{name}: {r}")
+        return res[0][1]
+
+    def getxattrs(self, pool: str, oid: str) -> Dict[str, bytes]:
+        r, res = self.operate(pool, oid, ObjectOperation().get_xattrs())
+        if r < 0:
+            raise IOError(f"getxattrs {oid}: {r}")
+        return _unpack_kv(res[0][1])
+
+    def rmxattr(self, pool: str, oid: str, name: str) -> int:
+        r, _ = self.operate(pool, oid, ObjectOperation().rm_xattr(name))
+        return r
+
+    def truncate(self, pool: str, oid: str, size: int) -> int:
+        r, _ = self.operate(pool, oid, ObjectOperation().truncate(size))
+        return r
+
+    def zero(self, pool: str, oid: str, offset: int, length: int) -> int:
+        r, _ = self.operate(pool, oid,
+                            ObjectOperation().zero(offset, length))
+        return r
+
+    def create(self, pool: str, oid: str, exclusive: bool = True) -> int:
+        r, _ = self.operate(pool, oid,
+                            ObjectOperation().create(exclusive))
+        return r
+
+    def omap_set(self, pool: str, oid: str, kv: Dict[str, bytes]) -> int:
+        r, _ = self.operate(pool, oid, ObjectOperation().omap_set(kv))
+        return r
+
+    def omap_get(self, pool: str, oid: str) -> Dict[str, bytes]:
+        r, res = self.operate(pool, oid, ObjectOperation().omap_get())
+        if r < 0:
+            raise IOError(f"omap_get {oid}: {r}")
+        return _unpack_kv(res[0][1])
+
+    def omap_rm_keys(self, pool: str, oid: str, keys) -> int:
+        r, _ = self.operate(pool, oid,
+                            ObjectOperation().omap_rm_keys(keys))
+        return r

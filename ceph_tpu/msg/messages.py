@@ -29,7 +29,7 @@ class Message:
         return type(self).__name__
 
 
-# client op codes (subset of the do_osd_ops interpreter's,
+# client op codes (the do_osd_ops interpreter's vocabulary,
 # src/osd/PrimaryLogPG.cc do_osd_ops: CEPH_OSD_OP_{READ,WRITE,WRITEFULL,...})
 CEPH_OSD_OP_READ = "read"            # ranged read (offset/length)
 CEPH_OSD_OP_WRITE = "write"          # offset write (rmw on EC pools)
@@ -37,11 +37,50 @@ CEPH_OSD_OP_WRITEFULL = "writefull"  # whole-object replace
 CEPH_OSD_OP_APPEND = "append"        # write at current object size
 CEPH_OSD_OP_DELETE = "delete"
 CEPH_OSD_OP_STAT = "stat"
+CEPH_OSD_OP_CREATE = "create"        # create; flags=EXCL -> EEXIST if present
+CEPH_OSD_OP_TRUNCATE = "truncate"    # resize (shrink or zero-extend)
+CEPH_OSD_OP_ZERO = "zero"            # zero an extent (never extends)
+CEPH_OSD_OP_SETXATTR = "setxattr"
+CEPH_OSD_OP_GETXATTR = "getxattr"
+CEPH_OSD_OP_GETXATTRS = "getxattrs"
+CEPH_OSD_OP_RMXATTR = "rmxattr"
+CEPH_OSD_OP_CMPXATTR = "cmpxattr"    # guard; flags = comparison operator
+CEPH_OSD_OP_OMAPSETKEYS = "omap_setkeys"   # replicated pools only
+CEPH_OSD_OP_OMAPRMKEYS = "omap_rmkeys"
+CEPH_OSD_OP_OMAPGETVALS = "omap_getvals"
+
+# cmpxattr comparison operators (include/rados.h CEPH_OSD_CMPXATTR_OP_*)
+CEPH_OSD_CMPXATTR_OP_EQ = 1
+CEPH_OSD_CMPXATTR_OP_NE = 2
+CEPH_OSD_CMPXATTR_OP_GT = 3
+CEPH_OSD_CMPXATTR_OP_GTE = 4
+CEPH_OSD_CMPXATTR_OP_LT = 5
+CEPH_OSD_CMPXATTR_OP_LTE = 6
+
+# create flags
+CEPH_OSD_OP_FLAG_EXCL = 1
+
+
+@dataclass
+class OSDOp:
+    """One op of an MOSDOp vector (the OSDOp struct in osd_types.h:
+    opcode + extent + payload + xattr name, executed in order by the
+    do_osd_ops interpreter)."""
+    op: str = CEPH_OSD_OP_READ
+    offset: int = 0
+    length: int = 0
+    data: bytes = b""
+    name: str = ""           # xattr name
+    flags: int = 0           # cmpxattr operator / create EXCL
 
 
 @dataclass
 class MOSDOp(Message):
-    """Client -> primary OSD op (src/messages/MOSDOp.h)."""
+    """Client -> primary OSD op (src/messages/MOSDOp.h).
+
+    Carries either one legacy single op (``op``/``offset``/``length``/
+    ``data``) or a multi-op vector (``ops``, like the reference's
+    vector<OSDOp>) executed atomically in order."""
     tid: int = 0
     pool: int = 0
     oid: str = ""
@@ -51,6 +90,7 @@ class MOSDOp(Message):
     length: int = 0
     data: bytes = b""
     epoch: int = 0
+    ops: List["OSDOp"] = field(default_factory=list)
 
 
 @dataclass
@@ -59,6 +99,9 @@ class MOSDOpReply(Message):
     result: int = 0
     data: bytes = b""
     epoch: int = 0
+    # per-op (result, data) for vector ops, parallel to MOSDOp.ops up to
+    # the first failing op (the reference returns per-op rval/outdata)
+    op_results: List[Tuple[int, bytes]] = field(default_factory=list)
 
 
 @dataclass
@@ -77,6 +120,11 @@ class MOSDECSubOpWrite(Message):
     version: int = 0         # pg_log version of this mutation (0 = none)
     is_push: bool = False    # recovery push: stamp the version attr but
     trim_to: int = 0         # do not re-append the (already merged) log
+    # user xattr / omap payload (attrs ride every shard like the
+    # reference's ECSubWrite transactions; omap is replicated-only)
+    xattrs: Optional[Dict[str, bytes]] = None   # full replacement set
+    omap: Optional[Dict[str, bytes]] = None     # full replacement (rep only)
+    attr_only: bool = False  # metadata-only mutation: leave the body alone
 
 
 @dataclass

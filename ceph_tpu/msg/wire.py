@@ -110,6 +110,8 @@ def encode_message(msg: M.Message) -> bytes:
         from ..osdmap.encoding import incremental_to_dict
         fields["incrementals"] = [incremental_to_dict(i)
                                   for i in msg.incrementals]
+    if isinstance(msg, M.MOSDOp) and msg.ops:
+        fields["ops"] = [dict(vars(o)) for o in msg.ops]
     out: list = []
     name = type(msg).__name__.encode()
     out.append(struct.pack("<H", len(name)))
@@ -129,6 +131,8 @@ def decode_message(buf: bytes) -> M.Message:
         from ..osdmap.encoding import incremental_from_dict
         fields["incrementals"] = [incremental_from_dict(d)
                                   for d in fields["incrementals"]]
+    if cls is M.MOSDOp and fields.get("ops"):
+        fields["ops"] = [M.OSDOp(**d) for d in fields["ops"]]
     msg = cls()
     for k, v in fields.items():
         setattr(msg, k, v)

@@ -47,6 +47,14 @@ from .ecutil import HashInfo, decode as ec_decode, \
 
 SIZE_ATTR = "_size"          # logical object size (un-padded)
 HINFO_ATTR = "hinfo_key"     # reference's hinfo xattr name
+USER_ATTR_PREFIX = "_u_"     # user xattr namespace in shard/replica attrs
+
+
+def user_attrs_of(attrs: Dict[str, bytes]) -> Dict[str, bytes]:
+    """The user-visible xattrs hiding in a shard's attr dict."""
+    n = len(USER_ATTR_PREFIX)
+    return {k[n:]: v for k, v in attrs.items()
+            if k.startswith(USER_ATTR_PREFIX)}
 
 
 class ExtentCache:
@@ -118,13 +126,15 @@ class InflightWrite:
 class InflightRead:
     """One fan-out read round over a chunk range.
 
-    ``on_done(result, data, size)``: data = decoded logical bytes for the
-    stripe range covering [chunk_off, chunk_off+chunk_len) (padded), size =
-    the object's logical size from shard attrs (-1 if unknown).
+    ``on_done(result, data, size, attrs)``: data = decoded logical bytes
+    for the stripe range covering [chunk_off, chunk_off+chunk_len)
+    (padded), size = the object's logical size from shard attrs (-1 if
+    unknown), attrs = the object's user xattrs (replicated on every
+    shard, so any healthy reply carries them).
     """
     tid: int
     oid: str
-    on_done: Callable[[int, bytes, int], None]
+    on_done: Callable[[int, bytes, int, Dict[str, bytes]], None]
     chunk_off: int = 0
     chunk_len: int = 0            # 0 = to end of shard
     attrs_only: bool = False
@@ -135,6 +145,7 @@ class InflightRead:
     seen: int = 0                 # shards that answered at all
     saw_eio: bool = False         # any non-ENOENT shard failure (crc etc.)
     raw: bool = False             # recovery mode: deliver raw shard chunks
+    user_attrs: Dict[str, bytes] = field(default_factory=dict)
 
 
 @dataclass
@@ -154,6 +165,24 @@ class FullWriteOp:
     oid: str
     data: bytes
     on_commit: Callable[[int], None]
+    xattrs: Optional[Dict[str, bytes]] = None   # full user-attr replacement
+
+
+@dataclass
+class VectorOp:
+    """A queued atomic multi-op vector (the interpreter's rmw unit).
+
+    ``run(result, body, size, attrs)`` executes the ops against the
+    fetched state and returns a commit spec — None (read-only/aborted;
+    reply already sent), ("write", body, attrs, on_commit, omap),
+    ("attrs", attrs, on_commit, omap) or ("delete", fan_fn, on_commit).
+    Riding the per-oid queue serializes whole vectors against each
+    other and the single-op write pipelines (start_rmw's guarantee).
+    """
+    tid: int
+    oid: str
+    run: Callable
+    meta_only: bool = False   # no body op: fetch attrs from one shard
 
 
 class ECBackend:
@@ -218,17 +247,91 @@ class ECBackend:
     def _start_op(self, op) -> None:
         if isinstance(op, FullWriteOp):
             self._start_full_write(op)
+        elif isinstance(op, VectorOp):
+            self._start_vector(op)
         else:
             self._start_rmw(op)
 
     # ---- write path (primary) --------------------------------------------
     def submit_transaction(self, oid: str, data: bytes,
-                           on_commit: Callable[[int], None]) -> int:
-        """Full-object EC write: one batched encode, fan out shards."""
+                           on_commit: Callable[[int], None],
+                           xattrs: Optional[Dict[str, bytes]] = None) -> int:
+        """Full-object EC write: one batched encode, fan out shards.
+
+        ``xattrs``: full replacement set of user xattrs riding the same
+        shard transactions (ECTransaction attr updates); None leaves the
+        shards' existing user attrs alone."""
         tid = self.next_tid()
         self._enqueue(oid, FullWriteOp(tid=tid, oid=oid, data=bytes(data),
-                                       on_commit=on_commit))
+                                       on_commit=on_commit, xattrs=xattrs))
         return tid
+
+    def submit_vector(self, oid: str, run: Callable,
+                      meta_only: bool = False) -> int:
+        """Queue an atomic multi-op vector behind this object's other
+        writes (see VectorOp)."""
+        tid = self.next_tid()
+        self._enqueue(oid, VectorOp(tid=tid, oid=oid, run=run,
+                                    meta_only=meta_only))
+        return tid
+
+    def _start_vector(self, op: VectorOp) -> None:
+        """Head-of-queue vector execution: fetch state (attrs-only probe
+        for pure-metadata vectors; whole-object decode otherwise), run
+        the interpreter, start the committed mutation — exactly one
+        _op_done fires when the commit (or the read-only reply) lands."""
+
+        def have_state(res: int, body: bytes, size: int,
+                       attrs: Dict[str, bytes]) -> None:
+            spec = op.run(res, body, size, attrs)
+            if spec is None:
+                self._op_done(op.oid)
+                return
+            kind = spec[0]
+            if kind == "write":
+                _, body2, attrs2, on_commit, _omap = spec
+                # _start_full_write's all_commit pops the queue head —
+                # which is this VectorOp
+                self._start_full_write(FullWriteOp(
+                    tid=op.tid, oid=op.oid, data=bytes(body2),
+                    on_commit=on_commit, xattrs=attrs2))
+            elif kind == "attrs":
+                _, attrs2, on_commit, _omap = spec
+                self._fan_attrs(op.tid, op.oid, attrs2,
+                                lambda r: (on_commit(r),
+                                           self._op_done(op.oid)))
+            else:  # ("delete", fan_fn, on_commit)
+                _, fan_fn, on_commit = spec
+                self.extent_cache.clear(op.oid)
+                fan_fn()
+                on_commit(0)
+                self._op_done(op.oid)
+
+        if op.meta_only:
+            self._start_read(
+                op.oid, 0, 0, True,
+                lambda res, _d, size, attrs: have_state(res, b"", size,
+                                                        attrs))
+        else:
+            self.object_state(op.oid, have_state)
+
+    def _fan_attrs(self, tid: int, oid: str, xattrs: Dict[str, bytes],
+                   on_commit: Callable[[int], None]) -> None:
+        """Metadata-only mutation: replace the user xattrs on every
+        shard without touching the body (a versioned, logged write).
+        Only called at the head of the per-oid queue."""
+        wr = InflightWrite(tid=tid, oid=oid, client_reply=on_commit,
+                           on_all_commit=lambda: on_commit(0))
+        acting = self.pg.acting_shards()
+        version = self.pg.next_version()
+        for shard, osd in acting.items():
+            msg = MOSDECSubOpWrite(
+                tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
+                chunk=b"", attr_only=True, xattrs=dict(xattrs),
+                version=version)
+            wr.pending_shards.add(shard)
+            self.pg.send_to_osd(osd, msg)
+        self.inflight_writes[tid] = wr
 
     def submit_write(self, oid: str, data: bytes, offset: Optional[int],
                      on_commit: Callable[[int], None]) -> int:
@@ -252,7 +355,8 @@ class ECBackend:
                              partial=False, new_size=len(op.data),
                              on_all_commit=all_commit,
                              client_reply=op.on_commit,
-                             version=self.pg.next_version())
+                             version=self.pg.next_version(),
+                             xattrs=op.xattrs)
 
     # ---- rmw pipeline (start_rmw, ECBackend.cc:1793) -----------------------
     def _start_rmw(self, op: RMWOp) -> None:
@@ -267,7 +371,7 @@ class ECBackend:
             return
         # degraded primary without its own shard: probe attrs over the wire
         self._start_read(op.oid, 0, 0, True,
-                         lambda res, _d, size: self._rmw_have_size(
+                         lambda res, _d, size, _a: self._rmw_have_size(
                              op, max(size, 0) if res in (0, -2) else res,
                              err=res not in (0, -2)))
 
@@ -312,7 +416,7 @@ class ECBackend:
         c1 = self.sinfo.aligned_logical_offset_to_chunk_offset(read_end)
         self._start_read(
             op.oid, c0, c1 - c0, False,
-            lambda res, data, _size: (
+            lambda res, data, _size, _a: (
                 self._rmw_have_old(op, a0, a1, data) if res == 0 or
                 (res == -2 and old_size == 0)
                 else (op.on_commit(res), self._op_done(op.oid))))
@@ -346,7 +450,8 @@ class ECBackend:
                         partial: bool, new_size: int,
                         on_all_commit: Callable[[], None],
                         client_reply: Callable[[int], None],
-                        version: int = 0) -> None:
+                        version: int = 0,
+                        xattrs: Optional[Dict[str, bytes]] = None) -> None:
         wr = InflightWrite(tid=tid, oid=oid, client_reply=client_reply,
                            on_all_commit=on_all_commit)
         acting = self.pg.acting_shards()
@@ -355,19 +460,21 @@ class ECBackend:
             msg = MOSDECSubOpWrite(
                 tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
                 chunk=chunk, offset=chunk_off, partial=partial,
-                at_version=new_size, version=version)
+                at_version=new_size, version=version, xattrs=xattrs)
             wr.pending_shards.add(shard)
             self.pg.send_to_osd(osd, msg)
         self.inflight_writes[tid] = wr
 
     def push_chunks(self, oid: str, shard_data: Dict[int, bytes],
                     size: int, on_done: Callable[[], None],
-                    version: int = 0) -> int:
+                    version: int = 0,
+                    xattrs: Optional[Dict[str, bytes]] = None) -> int:
         """Recovery push: whole-shard writes to specific shards only
         (RecoveryOp pushes, ECBackend.cc:535-743).  is_push: the
         replica's log already carries the entries (activation), but the
         object's version attr must be stamped so staleness checks see
-        current data."""
+        current data.  ``xattrs`` restores the object's user attrs on
+        the rebuilt shard (the reference pushes attrs with the chunks)."""
         tid = self.next_tid()
         wr = InflightWrite(tid=tid, oid=oid, client_reply=lambda _r: None,
                            on_all_commit=on_done)
@@ -378,7 +485,7 @@ class ECBackend:
             msg = MOSDECSubOpWrite(
                 tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
                 chunk=chunk, offset=0, partial=False, at_version=size,
-                version=version, is_push=True)
+                version=version, is_push=True, xattrs=xattrs)
             wr.pending_shards.add(shard)
             self.pg.send_to_osd(acting[shard], msg)
         if not wr.pending_shards:
@@ -388,10 +495,12 @@ class ECBackend:
         return tid
 
     def read_chunks(self, oid: str,
-                    on_done: Callable[[int, Dict[int, bytes], int], None]
-                    ) -> int:
+                    on_done: Callable[
+                        [int, Dict[int, bytes], int, Dict[str, bytes]],
+                        None]) -> int:
         """Recovery read: raw chunks from the cheapest healthy shard set
-        (no decode) — on_done(result, {shard: bytes}, logical_size)."""
+        (no decode) — on_done(result, {shard: bytes}, logical_size,
+        user_attrs)."""
         return self._start_read(oid, 0, 0, False, on_done, raw=True)
 
     def handle_sub_write(self, msg: MOSDECSubOpWrite, store: MemStore,
@@ -411,6 +520,25 @@ class ECBackend:
         if not store.collection_exists(cid):
             t.create_collection(cid)
         ho = hobject_t(msg.oid, msg.shard)
+        if msg.attr_only:
+            # metadata-only mutation: replace user attrs, stamp version,
+            # log — leave body/size/hinfo untouched.  A touch that
+            # CREATES the object must stamp a zero size so reads/stat
+            # see a consistent (empty) object, not a corrupt one.
+            t.touch(cid, ho)
+            if not (store.collection_exists(cid) and store.exists(cid, ho)):
+                t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", 0))
+            self._apply_user_attrs(t, store, cid, ho, msg.xattrs)
+            if msg.version:
+                from .pg_log import VERSION_ATTR
+                t.setattr(cid, ho, VERSION_ATTR,
+                          struct.pack("<Q", msg.version))
+            if pg is not None and msg.version and not msg.is_push:
+                from .pg_log import LogEntry, OP_MODIFY
+                pg.append_log(LogEntry(msg.version, msg.oid, OP_MODIFY), t)
+            store.queue_transaction(t)
+            return MOSDECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
+                                         shard=msg.shard, committed=True)
         if not msg.partial:
             t.truncate(cid, ho, 0)
             t.write(cid, ho, 0, msg.chunk)
@@ -427,6 +555,7 @@ class ECBackend:
             t.write(cid, ho, 0, bytes(spliced))
             body = bytes(spliced)
         t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", msg.at_version))
+        self._apply_user_attrs(t, store, cid, ho, msg.xattrs)
         hi = HashInfo(1)
         hi.append(0, {0: np.frombuffer(body, dtype=np.uint8)})
         t.setattr(cid, ho, HINFO_ATTR,
@@ -444,6 +573,22 @@ class ECBackend:
             pg.data_received(msg.oid)
         return MOSDECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
                                      shard=msg.shard, committed=True)
+
+    @staticmethod
+    def _apply_user_attrs(t: Transaction, store: MemStore, cid: str, ho,
+                          xattrs: Optional[Dict[str, bytes]]) -> None:
+        """Full-replacement user-attr application: drop every existing
+        ``_u_*`` attr, set the new set.  None = leave attrs alone."""
+        if xattrs is None:
+            return
+        existing = {}
+        if store.collection_exists(cid) and store.exists(cid, ho):
+            existing = store.getattrs(cid, ho)
+        for k in existing:
+            if k.startswith(USER_ATTR_PREFIX):
+                t.rmattr(cid, ho, k)
+        for name, value in xattrs.items():
+            t.setattr(cid, ho, USER_ATTR_PREFIX + name, bytes(value))
 
     def handle_sub_write_reply(self, msg: MOSDECSubOpWriteReply) -> None:
         wr = self.inflight_writes.get(msg.tid)
@@ -471,7 +616,7 @@ class ECBackend:
             c0 = self.sinfo.aligned_logical_offset_to_chunk_offset(a0)
             c1 = self.sinfo.aligned_logical_offset_to_chunk_offset(a1)
 
-        def done(result: int, data: bytes, size: int) -> None:
+        def done(result: int, data: bytes, size: int, _attrs) -> None:
             if result != 0:
                 on_complete(result, b"")
                 return
@@ -489,6 +634,22 @@ class ECBackend:
 
         return self._start_read(oid, c0, max(0, c1 - c0), False, done)
 
+    def object_state(self, oid: str,
+                     on_done: Callable[
+                         [int, bytes, int, Dict[str, bytes]], None]) -> int:
+        """Whole-object fetch for the op interpreter: on_done(result,
+        logical_bytes, size, user_attrs); result -2 = object absent."""
+
+        def done(result: int, data: bytes, size: int,
+                 attrs: Dict[str, bytes]) -> None:
+            if result != 0:
+                on_done(result, b"", 0, {})
+                return
+            body = data[:size] if size >= 0 else data
+            on_done(0, body, max(size, 0), attrs)
+
+        return self._start_read(oid, 0, 0, False, done)
+
     def _start_read(self, oid: str, chunk_off: int, chunk_len: int,
                     attrs_only: bool,
                     on_done: Callable[[int, bytes, int], None],
@@ -505,7 +666,7 @@ class ECBackend:
         if attrs_only:
             # any single healthy shard knows the size attr
             if not avail:
-                on_done(-5, b"", -1)
+                on_done(-5, b"", -1, {})
                 return tid
             shard = min(avail)
             rd.pending.add(shard)
@@ -520,7 +681,7 @@ class ECBackend:
         try:
             minimum = self.ec_impl.minimum_to_decode(want, avail)
         except IOError:
-            on_done(-5, b"", -1)  # EIO: not enough shards
+            on_done(-5, b"", -1, {})  # EIO: not enough shards
             return tid
         for shard in minimum:
             msg = MOSDECSubOpRead(tid=tid, pgid=self.pg.pgid, shard=shard,
@@ -577,6 +738,8 @@ class ECBackend:
             sz = msg.attrs.get(SIZE_ATTR)
             if sz is not None:
                 rd.size = struct.unpack("<Q", sz)[0]
+            if not rd.user_attrs:
+                rd.user_attrs = user_attrs_of(msg.attrs)
         else:
             rd.failed.add(msg.shard)
             if msg.result != -2:
@@ -598,34 +761,35 @@ class ECBackend:
         del self.inflight_reads[msg.tid]
         if rd.attrs_only:
             if rd.size >= 0:
-                rd.on_done(0, b"", rd.size)
+                rd.on_done(0, b"", rd.size, rd.user_attrs)
             elif rd.failed and not rd.chunks and not rd.saw_eio:
                 # every shard answered a clean ENOENT: object absent
-                rd.on_done(-2, b"", 0)
+                rd.on_done(-2, b"", 0, {})
             else:
                 # crc/EIO failures must surface as EIO, never ENOENT —
                 # a corrupt object is not an absent one
-                rd.on_done(-5, b"", -1)
+                rd.on_done(-5, b"", -1, {})
             return
         if not rd.chunks and rd.failed and not rd.saw_eio:
             # all shards report a clean no-such-object
-            rd.on_done(-2, b"", 0) if not rd.raw else \
-                rd.on_done(-2, {}, 0)
+            rd.on_done(-2, b"", 0, {}) if not rd.raw else \
+                rd.on_done(-2, {}, 0, {})
             return
         if len(rd.chunks) < self.k:
-            rd.on_done(-5, b"" if not rd.raw else {}, rd.size)
+            rd.on_done(-5, b"" if not rd.raw else {}, rd.size,
+                       rd.user_attrs)
             return
         if rd.raw:
-            rd.on_done(0, dict(rd.chunks), rd.size)
+            rd.on_done(0, dict(rd.chunks), rd.size, rd.user_attrs)
             return
         arrays = {i: np.frombuffer(b, dtype=np.uint8)
                   for i, b in rd.chunks.items()}
         try:
             data = ec_decode_concat(self.sinfo, self.ec_impl, arrays)
         except IOError:
-            rd.on_done(-5, b"", rd.size)
+            rd.on_done(-5, b"", rd.size, rd.user_attrs)
             return
-        rd.on_done(0, data.tobytes(), rd.size)
+        rd.on_done(0, data.tobytes(), rd.size, rd.user_attrs)
 
     # ---- recovery (ECBackend.cc:535-743) ----------------------------------
     def recover_object(self, oid: str, missing_shards: Set[int],

@@ -47,6 +47,16 @@ L_OSD_OP_LAT = 1007
 L_OSD_LAST = 1008
 
 
+def _unpack_pull_meta(attrs: Dict[str, bytes]):
+    """Split a replicated pull reply's attr dict into (user_xattrs, omap)."""
+    from ..msg.kv import unpack_kv
+    from .ec_backend import user_attrs_of
+    uattrs = user_attrs_of(attrs)
+    omap_blob = attrs.get("_omap_kv")
+    omap = unpack_kv(omap_blob) if omap_blob else {}
+    return uattrs, omap
+
+
 def _build_osd_perf(name: str):
     b = PerfCountersBuilder(name, L_OSD_FIRST, L_OSD_LAST)
     b.add_u64_counter(L_OSD_OP_W, "op_w", "client writes")
@@ -262,17 +272,28 @@ class OSD(Dispatcher):
             return
         if msg.shard < 0:
             # replicated full-object read (recovery pulls)
-            data = pg.rep_backend.read(msg.oid) \
-                if pg.rep_backend is not None else None
-            if data is None:
+            if pg.rep_backend is not None:
+                exists, data, uattrs, omap = \
+                    pg.rep_backend.object_state(msg.oid)
+            else:
+                exists = False
+            if not exists:
                 self.reply_to(msg, MOSDECSubOpReadReply(
                     tid=msg.tid, pgid=msg.pgid, shard=-1, oid=msg.oid,
                     result=-2))
             else:
+                from .ec_backend import USER_ATTR_PREFIX
+                attrs = {SIZE_ATTR: struct.pack("<Q", len(data))}
+                for k, v in uattrs.items():
+                    attrs[USER_ATTR_PREFIX + k] = v
+                # omap rides the attr dict under a reserved key (the
+                # reference pushes omap in its own push payload section)
+                if omap:
+                    from ..msg.kv import pack_kv
+                    attrs["_omap_kv"] = pack_kv(omap)
                 self.reply_to(msg, MOSDECSubOpReadReply(
                     tid=msg.tid, pgid=msg.pgid, shard=-1, oid=msg.oid,
-                    data=data, result=0,
-                    attrs={SIZE_ATTR: struct.pack("<Q", len(data))}))
+                    data=data, result=0, attrs=attrs))
             return
         if pg.backend is not None:
             reply = pg.backend.handle_sub_read(msg, self.store)
@@ -373,7 +394,7 @@ class OSD(Dispatcher):
                         if op != OP_DELETE)
 
         def on_chunks(result: int, chunks: Dict[int, bytes],
-                      size: int) -> None:
+                      size: int, attrs: Dict[str, bytes]) -> None:
             if result != 0:
                 # sources unavailable right now; retry on the next kick
                 pg._recovering.discard(oid)
@@ -389,7 +410,7 @@ class OSD(Dispatcher):
                 pg.recovery_done_for(oid)
 
             be.push_chunks(oid, {s: rec[s] for s in needed}, size, pushed,
-                           version=version)
+                           version=version, xattrs=attrs)
 
         be.read_chunks(oid, on_chunks)
 
@@ -420,21 +441,28 @@ class OSD(Dispatcher):
             # apply locally, then fan to the other missing shards
             my = pg.my_shard()
             v = targets.get(my, (0, ""))[0]
+            uattrs, omap = _unpack_pull_meta(msg.attrs)
             wr = MOSDECSubOpWrite(tid=0, pgid=pg.pgid, shard=-1, oid=oid,
                                   chunk=msg.data, offset=0, partial=False,
                                   at_version=len(msg.data), version=v,
-                                  is_push=True)
+                                  is_push=True, xattrs=uattrs, omap=omap)
             pg.rep_backend.apply_write(wr, self.store)
             pg.missing.get(my, {}).pop(oid, None)
             rest = {s: t for s, t in targets.items() if s != my}
-            self._push_rep(pg, oid, msg.data, rest)
+            self._push_rep(pg, oid, msg.data, rest,
+                           xattrs=uattrs, omap=omap)
 
         self._rep_pulls[tid] = on_pull
         pg.send_to_osd(pg.acting_shards()[srcs[0]], MOSDECSubOpRead(
             tid=tid, pgid=pg.pgid, shard=-1, oid=oid))
 
     def _push_rep(self, pg: PG, oid: str, data: bytes,
-                  targets: Dict[int, Tuple[int, str]]) -> None:
+                  targets: Dict[int, Tuple[int, str]],
+                  xattrs: Optional[Dict[str, bytes]] = None,
+                  omap: Optional[Dict[str, bytes]] = None) -> None:
+        if xattrs is None and pg.rep_backend is not None:
+            # pushing our own authoritative copy: include its metadata
+            _ex, _d, xattrs, omap = pg.rep_backend.object_state(oid)
         acting = pg.acting_shards()
         for s, (v, _op) in targets.items():
             osd = acting.get(s)
@@ -443,7 +471,7 @@ class OSD(Dispatcher):
             pg.send_to_osd(osd, MOSDECSubOpWrite(
                 tid=0, pgid=pg.pgid, shard=-1, oid=oid, chunk=data,
                 offset=0, partial=False, at_version=len(data),
-                version=v, is_push=True))
+                version=v, is_push=True, xattrs=xattrs, omap=omap))
             self.perf_counters.inc(L_OSD_RECOVERY_PUSH)
         for s in list(targets):
             pg.missing.get(s, {}).pop(oid, None)

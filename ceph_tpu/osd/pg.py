@@ -34,6 +34,16 @@ from ..msg import (
     MOSDOp, MOSDOpReply, MOSDPGInfo, MOSDPGQuery, MOSDPGScan,
     MOSDPGScanReply, MOSDRepScrub, MOSDRepScrubMap, Message,
 )
+from ..msg.messages import (
+    CEPH_OSD_CMPXATTR_OP_EQ, CEPH_OSD_CMPXATTR_OP_GT,
+    CEPH_OSD_CMPXATTR_OP_GTE, CEPH_OSD_CMPXATTR_OP_LT,
+    CEPH_OSD_CMPXATTR_OP_LTE, CEPH_OSD_CMPXATTR_OP_NE,
+    CEPH_OSD_OP_CMPXATTR, CEPH_OSD_OP_CREATE, CEPH_OSD_OP_FLAG_EXCL,
+    CEPH_OSD_OP_GETXATTR, CEPH_OSD_OP_GETXATTRS, CEPH_OSD_OP_OMAPGETVALS,
+    CEPH_OSD_OP_OMAPRMKEYS, CEPH_OSD_OP_OMAPSETKEYS, CEPH_OSD_OP_RMXATTR,
+    CEPH_OSD_OP_SETXATTR, CEPH_OSD_OP_TRUNCATE, CEPH_OSD_OP_ZERO, OSDOp,
+)
+from ..msg.kv import pack_kv, unpack_keys, unpack_kv
 from ..os_store import Transaction, hobject_t
 from .ec_backend import ECBackend, SIZE_ATTR
 from .pg_log import LogEntry, OP_DELETE, OP_MODIFY, PGLog, PG_META_OID
@@ -55,9 +65,14 @@ class ReplicatedBackend:
         return f"{self.pg.pgid[0]}.{self.pg.pgid[1]}"
 
     def write(self, oid: str, data: bytes, offset: Optional[int] = None,
-              full: bool = False, version: int = 0) -> None:
+              full: bool = False, version: int = 0,
+              xattrs: Optional[Dict[str, bytes]] = None,
+              omap: Optional[Dict[str, bytes]] = None,
+              attr_only: bool = False) -> None:
         from ..msg.messages import MOSDECSubOpWrite
-        if full:
+        if attr_only:
+            off, partial, new_size = 0, True, 0
+        elif full:
             off, partial = 0, False
             new_size = len(data)
         else:
@@ -72,19 +87,36 @@ class ReplicatedBackend:
             msg = MOSDECSubOpWrite(tid=0, pgid=self.pg.pgid, shard=-1,
                                    oid=oid, chunk=data, offset=off,
                                    partial=partial, at_version=new_size,
-                                   version=version)
+                                   version=version, xattrs=xattrs,
+                                   omap=omap, attr_only=attr_only)
             self.pg.send_to_osd(osd, msg)
 
     def apply_write(self, msg, store) -> None:
+        from .ec_backend import ECBackend, USER_ATTR_PREFIX
         cid = self.cid()
         t = Transaction()
         if not store.collection_exists(cid):
             t.create_collection(cid)
         ho = hobject_t(msg.oid)
-        if not msg.partial:
-            t.truncate(cid, ho, 0)
-        t.write(cid, ho, msg.offset, msg.chunk)
-        t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", msg.at_version))
+        if msg.attr_only:
+            t.touch(cid, ho)
+            if not (store.collection_exists(cid)
+                    and store.exists(cid, ho)):
+                t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", 0))
+        else:
+            if not msg.partial:
+                t.truncate(cid, ho, 0)
+            t.write(cid, ho, msg.offset, msg.chunk)
+            t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", msg.at_version))
+        ECBackend._apply_user_attrs(t, store, cid, ho, msg.xattrs)
+        if msg.omap is not None:
+            existing = store.omap_get(cid, ho) \
+                if store.collection_exists(cid) and store.exists(cid, ho) \
+                else {}
+            if existing:
+                t.omap_rmkeys(cid, ho, list(existing))
+            if msg.omap:
+                t.omap_setkeys(cid, ho, msg.omap)
         if msg.version:
             from .pg_log import VERSION_ATTR
             t.setattr(cid, ho, VERSION_ATTR, struct.pack("<Q", msg.version))
@@ -102,6 +134,18 @@ class ReplicatedBackend:
         if not store.collection_exists(cid) or not store.exists(cid, ho):
             return None
         return store.read(cid, ho)
+
+    def object_state(self, oid: str):
+        """(exists, data, user_attrs, omap) from the local replica."""
+        from .ec_backend import user_attrs_of
+        store = self.pg.osd.store
+        cid = self.cid()
+        ho = hobject_t(oid)
+        if not store.collection_exists(cid) or not store.exists(cid, ho):
+            return False, b"", {}, {}
+        return (True, store.read(cid, ho),
+                user_attrs_of(store.getattrs(cid, ho)),
+                dict(store.omap_get(cid, ho)))
 
 
 class PG:
@@ -667,7 +711,9 @@ class PG:
                 tid=msg.tid, result=-11,  # EAGAIN: wrong primary / not ready
                 epoch=self.osd.osdmap.epoch))
             return
-        if msg.op == CEPH_OSD_OP_WRITEFULL:
+        if msg.ops:
+            self._do_op_vector(msg)
+        elif msg.op == CEPH_OSD_OP_WRITEFULL:
             self._do_write(msg)
         elif msg.op in (CEPH_OSD_OP_WRITE, CEPH_OSD_OP_APPEND):
             self._do_partial_write(msg)
@@ -680,6 +726,257 @@ class PG:
         else:
             self.osd.send_op_reply(msg.src,
                                    MOSDOpReply(tid=msg.tid, result=-95))
+
+    # ---- multi-op vector interpreter (do_osd_ops) --------------------------
+
+    # ops whose execution needs the object's current bytes; vectors with
+    # none of these run off a one-shard attrs-only probe on EC pools
+    _BODY_OPS = frozenset([
+        CEPH_OSD_OP_READ, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_APPEND,
+        CEPH_OSD_OP_TRUNCATE, CEPH_OSD_OP_ZERO, CEPH_OSD_OP_STAT,
+        CEPH_OSD_OP_WRITEFULL,
+    ])
+
+    def _do_op_vector(self, msg: MOSDOp) -> None:
+        """Atomic multi-op execution (PrimaryLogPG::do_osd_ops,
+        PrimaryLogPG.cc:7796 via prepare_transaction): fetch the object's
+        state once, run every op of the vector in order against it, and
+        commit all mutations as ONE backend transaction — which on EC
+        pools means one batched device encode for the whole vector.  The
+        first failing op aborts the vector with nothing committed (the
+        reference aborts the ctx on the first negative rval).  Vectors
+        ride the backend's per-object queue, so concurrent vectors and
+        single-op writes on one object serialize (start_rmw's
+        guarantee)."""
+        oid = msg.oid
+
+        def start() -> None:
+            if self.backend is not None:
+                meta_only = all(o.op not in self._BODY_OPS
+                                for o in msg.ops)
+                self.backend.submit_vector(
+                    oid,
+                    lambda res, body, _size, attrs:
+                    self._run_op_vector(msg, res, body, attrs, {}),
+                    meta_only=meta_only)
+            else:
+                exists, data, attrs, omap = \
+                    self.rep_backend.object_state(oid)
+                spec = self._run_op_vector(
+                    msg, 0 if exists else -2, data, attrs, omap)
+                self._commit_rep_vector(msg.oid, spec)
+
+        degraded = (self.missing_shards_for(oid) if self.backend is not None
+                    else (oid in self.local_missing))
+        if degraded:
+            self.wait_for_recovery(oid, start)
+        else:
+            start()
+
+    def _run_op_vector(self, msg: MOSDOp, res: int, data: bytes,
+                       attrs: Dict[str, bytes], omap: Dict[str, bytes]):
+        """Execute the ops; send the reply for no-commit outcomes; return
+        the commit spec (see ec_backend.VectorOp) otherwise."""
+        if res not in (0, -2):
+            self.osd.send_op_reply(msg.src, MOSDOpReply(
+                tid=msg.tid, result=res, epoch=self.osd.osdmap.epoch))
+            return None
+        st = {"exists": res == 0, "body": bytearray(data),
+              "attrs": dict(attrs), "omap": dict(omap)}
+        existed = st["exists"]
+        mutated = meta_mutated = False
+        results: List[Tuple[int, bytes]] = []
+        error = 0
+        for op in msg.ops:
+            r, out = self._exec_one_op(op, st)
+            mutated |= st.pop("_mutated", False)
+            meta_mutated |= st.pop("_meta", False)
+            results.append((r, out))
+            if r < 0:
+                error = r
+                break
+        reply = MOSDOpReply(tid=msg.tid, result=error,
+                            epoch=self.osd.osdmap.epoch,
+                            op_results=results)
+        if error or not (mutated or meta_mutated):
+            # read-only vector or aborted mutation: nothing to commit
+            if results and not error:
+                reply.data = next((d for r, d in reversed(results) if d),
+                                  b"")
+            self.osd.send_op_reply(msg.src, reply)
+            return None
+        src = msg.src
+
+        def on_commit(result: int) -> None:
+            reply.result = result
+            self.osd.send_op_reply(src, reply)
+
+        if not st["exists"]:
+            # the vector's NET effect is removal (a later create/write
+            # in the same vector would have set exists back — the final
+            # state decides, like the reference's ctx->delta_stats)
+            if not existed:
+                # never existed and still doesn't: nothing to fan
+                self.osd.send_op_reply(src, reply)
+                return None
+            self.clear_missing_for(msg.oid)
+            return ("delete", lambda: self._fan_delete(msg.oid), on_commit)
+        if mutated:
+            def committed(result: int) -> None:
+                if result == 0:
+                    self.clear_missing_for(msg.oid)
+                on_commit(result)
+            return ("write", bytes(st["body"]), dict(st["attrs"]),
+                    committed, dict(st["omap"]))
+        return ("attrs", dict(st["attrs"]), on_commit, dict(st["omap"]))
+
+    def _commit_rep_vector(self, oid: str, spec) -> None:
+        """Apply a commit spec synchronously on the replicated backend
+        (the in-process fabric serializes rep ops; no queue needed)."""
+        if spec is None:
+            return
+        kind = spec[0]
+        if kind == "delete":
+            _, fan_fn, on_commit = spec
+            fan_fn()
+            on_commit(0)
+            return
+        if kind == "write":
+            _, body, attrs, on_commit, omap = spec
+            self.rep_backend.write(oid, body, full=True,
+                                   version=self.next_version(),
+                                   xattrs=attrs, omap=omap)
+            on_commit(0)
+            return
+        _, attrs, on_commit, omap = spec
+        self.rep_backend.write(oid, b"", version=self.next_version(),
+                               xattrs=attrs, omap=omap, attr_only=True)
+        on_commit(0)
+
+    def _exec_one_op(self, op: OSDOp, st: Dict) -> Tuple[int, bytes]:
+        """Run one op against the in-memory object state; mutations are
+        recorded in st via _mutated/_meta/_deleted flags."""
+        exists, body = st["exists"], st["body"]
+        attrs, omap = st["attrs"], st["omap"]
+        o = op.op
+        if o == CEPH_OSD_OP_CREATE:
+            if exists and (op.flags & CEPH_OSD_OP_FLAG_EXCL):
+                return -17, b""                     # EEXIST
+            if not exists:
+                st["exists"], st["_mutated"] = True, True
+            return 0, b""
+        if o == CEPH_OSD_OP_WRITEFULL:
+            st["body"] = bytearray(op.data)
+            st["exists"], st["_mutated"] = True, True
+            return 0, b""
+        if o == CEPH_OSD_OP_WRITE:
+            end = op.offset + len(op.data)
+            if end > len(body):
+                body.extend(b"\0" * (end - len(body)))
+            body[op.offset:end] = op.data
+            st["exists"], st["_mutated"] = True, True
+            return 0, b""
+        if o == CEPH_OSD_OP_APPEND:
+            body.extend(op.data)
+            st["exists"], st["_mutated"] = True, True
+            return 0, b""
+        if o == CEPH_OSD_OP_TRUNCATE:
+            if not exists:
+                return -2, b""                      # ENOENT
+            if op.offset <= len(body):
+                del body[op.offset:]
+            else:
+                body.extend(b"\0" * (op.offset - len(body)))
+            st["_mutated"] = True
+            return 0, b""
+        if o == CEPH_OSD_OP_ZERO:
+            if not exists:
+                return -2, b""
+            end = min(op.offset + op.length, len(body))
+            if end > op.offset:
+                body[op.offset:end] = b"\0" * (end - op.offset)
+                st["_mutated"] = True
+            return 0, b""
+        if o == CEPH_OSD_OP_DELETE:
+            if not exists:
+                return -2, b""
+            st["exists"], st["_mutated"] = False, True
+            st["body"] = bytearray()
+            attrs.clear()
+            omap.clear()
+            return 0, b""
+        if o == CEPH_OSD_OP_READ:
+            if not exists:
+                return -2, b""
+            end = op.offset + op.length if op.length else len(body)
+            return 0, bytes(body[op.offset:end])
+        if o == CEPH_OSD_OP_STAT:
+            if not exists:
+                return -2, b""
+            return 0, struct.pack("<Q", len(body))
+        if o == CEPH_OSD_OP_SETXATTR:
+            attrs[op.name] = bytes(op.data)
+            st["exists"], st["_meta"] = True, True
+            return 0, b""
+        if o == CEPH_OSD_OP_RMXATTR:
+            if op.name not in attrs:
+                return -61, b""                     # ENODATA
+            del attrs[op.name]
+            st["_meta"] = True
+            return 0, b""
+        if o == CEPH_OSD_OP_GETXATTR:
+            v = attrs.get(op.name)
+            if v is None:
+                return -61, b""
+            return 0, v
+        if o == CEPH_OSD_OP_GETXATTRS:
+            return 0, pack_kv({k: attrs[k] for k in sorted(attrs)})
+        if o == CEPH_OSD_OP_CMPXATTR:
+            v = attrs.get(op.name)
+            if v is None:
+                return -61, b""
+            cmp = (v > op.data) - (v < op.data)
+            ok = {CEPH_OSD_CMPXATTR_OP_EQ: cmp == 0,
+                  CEPH_OSD_CMPXATTR_OP_NE: cmp != 0,
+                  CEPH_OSD_CMPXATTR_OP_GT: cmp > 0,
+                  CEPH_OSD_CMPXATTR_OP_GTE: cmp >= 0,
+                  CEPH_OSD_CMPXATTR_OP_LT: cmp < 0,
+                  CEPH_OSD_CMPXATTR_OP_LTE: cmp <= 0}.get(op.flags)
+            if ok is None:
+                return -22, b""                     # EINVAL
+            return (1, b"") if ok else (-125, b"")  # ECANCELED on mismatch
+        if o in (CEPH_OSD_OP_OMAPSETKEYS, CEPH_OSD_OP_OMAPRMKEYS,
+                 CEPH_OSD_OP_OMAPGETVALS):
+            if self.backend is not None:
+                return -95, b""   # EOPNOTSUPP: no omap on EC pools
+            if o == CEPH_OSD_OP_OMAPSETKEYS:
+                omap.update(unpack_kv(op.data))
+                st["exists"], st["_meta"] = True, True
+                return 0, b""
+            if o == CEPH_OSD_OP_OMAPRMKEYS:
+                for k in unpack_keys(op.data):
+                    omap.pop(k, None)
+                st["_meta"] = True
+                return 0, b""
+            return 0, pack_kv({k: omap[k] for k in sorted(omap)})
+        return -95, b""                             # EOPNOTSUPP
+
+    def _fan_delete(self, oid: str) -> None:
+        """Fan a versioned delete to every acting shard/replica."""
+        from ..msg.messages import MOSDECSubOpWrite
+        version = self.next_version()
+        if self.backend is not None:
+            for shard, osd in self.acting_shards().items():
+                self.send_to_osd(osd, MOSDECSubOpWrite(
+                    tid=0, pgid=self.pgid, shard=shard, oid=oid,
+                    chunk=b"", at_version=-1, version=version))
+        else:
+            for osd in self.acting:
+                if osd == CRUSH_ITEM_NONE:
+                    continue
+                self.send_to_osd(osd, MOSDECSubOpWrite(
+                    tid=0, pgid=self.pgid, shard=-1, oid=oid,
+                    chunk=b"", at_version=-1, version=version))
 
     def _do_write(self, msg: MOSDOp) -> None:
         if self.backend is not None:
@@ -793,29 +1090,16 @@ class PG:
             self.osd.send_op_reply(msg.src,
                                    MOSDOpReply(tid=msg.tid, result=-2))
             return
-        size = struct.unpack("<Q", store.getattr(cid, ho, SIZE_ATTR))[0]
+        try:
+            size = struct.unpack("<Q", store.getattr(cid, ho, SIZE_ATTR))[0]
+        except KeyError:
+            size = store.stat(cid, ho)
         self.osd.send_op_reply(msg.src, MOSDOpReply(
             tid=msg.tid, result=0, data=struct.pack("<Q", size),
             epoch=self.osd.osdmap.epoch))
 
     def _do_delete(self, msg: MOSDOp) -> None:
-        from ..msg.messages import MOSDECSubOpWrite
-        version = self.next_version()
-        if self.backend is not None:
-            for shard, osd in self.acting_shards().items():
-                m = MOSDECSubOpWrite(tid=-msg.tid, pgid=self.pgid,
-                                     shard=shard, oid=msg.oid, chunk=b"",
-                                     at_version=-1, version=version)
-                self.send_to_osd(osd, m)
-            self.clear_missing_for(msg.oid)
-        else:
-            for osd in self.acting:
-                if osd == CRUSH_ITEM_NONE:
-                    continue
-                m = MOSDECSubOpWrite(tid=-msg.tid, pgid=self.pgid,
-                                     shard=-1, oid=msg.oid, chunk=b"",
-                                     at_version=-1, version=version)
-                self.send_to_osd(osd, m)
-            self.clear_missing_for(msg.oid)
+        self._fan_delete(msg.oid)
+        self.clear_missing_for(msg.oid)
         self.osd.send_op_reply(msg.src, MOSDOpReply(
             tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))

@@ -1,0 +1,240 @@
+"""Multi-op vector interpreter (do_osd_ops, PrimaryLogPG.cc:7796).
+
+Atomic op vectors over both backends: guards abort everything, xattrs
+ride shard transactions and survive recovery, omap works on replicated
+pools and is rejected on EC pools — mirroring the reference's
+TestRados-style op coverage.
+"""
+import struct
+
+import pytest
+
+from ceph_tpu.client import ObjectOperation
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.msg.messages import (
+    CEPH_OSD_CMPXATTR_OP_GT, CEPH_OSD_CMPXATTR_OP_NE,
+)
+
+
+@pytest.fixture(scope="module")
+def ec_cluster():
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("vec", k=2, m=1, plugin="isa", pg_num=8)
+    return c, c.client("client.vec")
+
+
+@pytest.fixture(scope="module")
+def rep_cluster():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("rvec", size=3, pg_num=8)
+    return c, c.client("client.rvec")
+
+
+# ---- atomic write vectors -------------------------------------------------
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_write_and_xattr_vector_is_atomic(fixture, request):
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    op = (ObjectOperation().create(exclusive=True)
+          .write_full(b"payload-one").set_xattr("tag", b"v1"))
+    r, res = cl.operate(pool, "obj-a", op)
+    assert r == 0 and all(rr == 0 for rr, _ in res)
+    assert cl.read(pool, "obj-a") == b"payload-one"
+    assert cl.getxattr(pool, "obj-a", "tag") == b"v1"
+    # exclusive create on an existing object: whole vector aborts,
+    # nothing committed
+    op = (ObjectOperation().create(exclusive=True)
+          .write_full(b"CLOBBER").set_xattr("tag", b"v2"))
+    r, res = cl.operate(pool, "obj-a", op)
+    assert r == -17                       # EEXIST
+    assert cl.read(pool, "obj-a") == b"payload-one"
+    assert cl.getxattr(pool, "obj-a", "tag") == b"v1"
+
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_cmpxattr_guard(fixture, request):
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    cl.write_full(pool, "guarded", b"before")
+    cl.setxattr(pool, "guarded", "ver", b"7")
+    # matching guard: the write goes through
+    op = (ObjectOperation().cmp_xattr("ver", b"7")
+          .write_full(b"after").set_xattr("ver", b"8"))
+    r, _ = cl.operate(pool, "guarded", op)
+    assert r == 0
+    assert cl.read(pool, "guarded") == b"after"
+    # failing guard: ECANCELED, nothing changed
+    op = (ObjectOperation().cmp_xattr("ver", b"7")
+          .write_full(b"NOPE"))
+    r, _ = cl.operate(pool, "guarded", op)
+    assert r == -125
+    assert cl.read(pool, "guarded") == b"after"
+    # other comparison operators
+    r, _ = cl.operate(pool, "guarded", ObjectOperation().cmp_xattr(
+        "ver", b"7", CEPH_OSD_CMPXATTR_OP_GT))
+    assert r == 0                         # "8" > "7"
+    r, _ = cl.operate(pool, "guarded", ObjectOperation().cmp_xattr(
+        "ver", b"8", CEPH_OSD_CMPXATTR_OP_NE))
+    assert r == -125
+
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_truncate_zero_read_vector(fixture, request):
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    cl.write_full(pool, "tz", bytes(range(100)) * 10)   # 1000 bytes
+    assert cl.truncate(pool, "tz", 500) == 0
+    assert cl.stat(pool, "tz") == 500
+    assert cl.zero(pool, "tz", 100, 50) == 0
+    body = cl.read(pool, "tz")
+    assert len(body) == 500
+    assert body[100:150] == b"\0" * 50
+    assert body[:100] == (bytes(range(100)) * 10)[:100]
+    # zero never extends (reference ZERO semantics)
+    assert cl.zero(pool, "tz", 490, 100) == 0
+    assert cl.stat(pool, "tz") == 500
+    # truncate up zero-extends
+    assert cl.truncate(pool, "tz", 600) == 0
+    assert cl.read(pool, "tz")[500:] == b"\0" * 100
+    # read + stat vector in one round trip
+    r, res = cl.operate(pool, "tz", ObjectOperation().stat().read(0, 10))
+    assert r == 0
+    assert struct.unpack("<Q", res[0][1])[0] == 600
+    assert res[1][1] == bytes(range(10))
+
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_xattr_lifecycle(fixture, request):
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    cl.write_full(pool, "xa", b"body")
+    cl.setxattr(pool, "xa", "a", b"1")
+    cl.setxattr(pool, "xa", "b", b"2")
+    assert cl.getxattrs(pool, "xa") == {"a": b"1", "b": b"2"}
+    assert cl.rmxattr(pool, "xa", "a") == 0
+    assert cl.getxattrs(pool, "xa") == {"b": b"2"}
+    assert cl.rmxattr(pool, "xa", "a") == -61      # ENODATA
+    with pytest.raises(IOError):
+        cl.getxattr(pool, "xa", "a")
+    # metadata-only mutation must not disturb the body
+    assert cl.read(pool, "xa") == b"body"
+
+
+def test_omap_on_replicated(rep_cluster):
+    c, cl = rep_cluster
+    cl.write_full("rvec", "om", b"x")
+    assert cl.omap_set("rvec", "om", {"k1": b"v1", "k2": b"v2"}) == 0
+    assert cl.omap_get("rvec", "om") == {"k1": b"v1", "k2": b"v2"}
+    assert cl.omap_rm_keys("rvec", "om", ["k1"]) == 0
+    assert cl.omap_get("rvec", "om") == {"k2": b"v2"}
+
+
+def test_omap_rejected_on_ec(ec_cluster):
+    c, cl = ec_cluster
+    cl.write_full("vec", "om-ec", b"x")
+    r, _ = cl.operate("vec", "om-ec",
+                      ObjectOperation().omap_set({"k": b"v"}))
+    assert r == -95                       # EOPNOTSUPP
+
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_delete_in_vector(fixture, request):
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    cl.write_full(pool, "gone", b"short-lived")
+    r, _ = cl.operate(pool, "gone", ObjectOperation().remove())
+    assert r == 0
+    with pytest.raises(IOError):
+        cl.read(pool, "gone")
+
+
+# ---- xattrs survive failure + recovery ------------------------------------
+
+def test_xattrs_survive_osd_kill_and_recovery_ec():
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("surv", k=2, m=1, plugin="isa", pg_num=8)
+    cl = c.client("client.surv")
+    cl.write_full("surv", "keep", b"important-bytes")
+    cl.setxattr("surv", "keep", "owner", b"alice")
+    _pg, victim = cl._calc_target(cl.lookup_pool("surv"), "keep")
+    c.kill_osd(victim)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    c.run_recovery()
+    c.network.pump()
+    assert cl.read("surv", "keep") == b"important-bytes"
+    assert cl.getxattr("surv", "keep", "owner") == b"alice"
+    # revive and let it re-peer: attrs still intact afterwards
+    c.revive_osd(victim)
+    for _ in range(4):
+        c.tick(dt=6.0)
+    c.run_recovery()
+    c.network.pump()
+    assert cl.getxattr("surv", "keep", "owner") == b"alice"
+
+
+def test_xattrs_omap_survive_recovery_replicated():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("rsurv", size=3, pg_num=8)
+    cl = c.client("client.rsurv")
+    cl.write_full("rsurv", "keep", b"rep-bytes")
+    cl.setxattr("rsurv", "keep", "owner", b"bob")
+    cl.omap_set("rsurv", "keep", {"idx": b"42"})
+    _pg, victim = cl._calc_target(cl.lookup_pool("rsurv"), "keep")
+    c.kill_osd(victim)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    c.run_recovery()
+    c.network.pump()
+    assert cl.read("rsurv", "keep") == b"rep-bytes"
+    assert cl.getxattr("rsurv", "keep", "owner") == b"bob"
+    assert cl.omap_get("rsurv", "keep") == {"idx": b"42"}
+
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_remove_then_recreate_in_one_vector(fixture, request):
+    """The vector's FINAL state decides delete-vs-write: a vector that
+    deletes and then recreates must leave the recreated object."""
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    cl.write_full(pool, "phoenix", b"old-body")
+    cl.setxattr(pool, "phoenix", "gen", b"1")
+    r, _ = cl.operate(pool, "phoenix", ObjectOperation()
+                      .remove().write_full(b"new-body"))
+    assert r == 0
+    assert cl.read(pool, "phoenix") == b"new-body"
+    # delete dropped the old attrs; the recreate carried none
+    assert cl.getxattrs(pool, "phoenix") == {}
+
+
+def test_concurrent_ec_vectors_serialize(ec_cluster):
+    """Two vectors on one EC object submitted before any pump must not
+    interleave their read-modify-write phases (the per-oid queue)."""
+    from ceph_tpu.msg.messages import (
+        CEPH_OSD_OP_APPEND, MOSDOp, OSDOp,
+    )
+    c, cl = ec_cluster
+    cl.write_full("vec", "race", b"")
+    pid = cl.lookup_pool("vec")
+    pgid, primary = cl._calc_target(pid, "race")
+    for i, payload in enumerate([b"AA", b"BB"]):
+        cl._tid += 1
+        m = MOSDOp(tid=cl._tid, pool=pid, oid="race", pgid=pgid,
+                   ops=[OSDOp(op=CEPH_OSD_OP_APPEND, data=payload)],
+                   epoch=cl.osdmap.epoch)
+        cl.messenger.send_message(m, f"osd.{primary}")
+    c.network.pump()
+    assert cl.read("vec", "race") == b"AABB"
+
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_setxattr_creates_consistent_empty_object(fixture, request):
+    """A metadata-only vector on a nonexistent object creates an empty
+    object whose size/read/stat remain consistent (SIZE_ATTR stamped)."""
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    assert cl.setxattr(pool, "ghost", "tag", b"boo") == 0
+    assert cl.getxattr(pool, "ghost", "tag") == b"boo"
+    assert cl.stat(pool, "ghost") == 0
+    assert cl.read(pool, "ghost") == b""
