@@ -160,6 +160,35 @@ class MiniCluster:
             lambda c, a: self.mgr.prometheus_metrics(
                 self.perf_collection),
             "prometheus text exposition")
+        from .common import g_kernel_timer, get_log, \
+            register_config_observers
+        register_config_observers(g_conf)
+        asok.register(
+            "log dump",
+            lambda c, a: {"lines": get_log().dump_recent(
+                int(a.get("n", 0) or 0), a.get("subsys", ""))},
+            "dump the recent in-memory log ring")
+        asok.register(
+            "log set",
+            lambda c, a: (g_conf.set_val(f"debug_{a['subsys']}",
+                                         a["level"]),
+                          {"ok": True})[1],
+            "set debug_<subsys> level (log/gather)")
+        asok.register(
+            "kernel timings",
+            lambda c, a: g_kernel_timer.dump(),
+            "cumulative per-kernel device dispatch timings")
+        asok.register(
+            "kernel tracing",
+            lambda c, a: (g_kernel_timer.enable(
+                str(a.get("on", "1")).lower() in ("1", "true", "on")),
+                {"enabled": g_kernel_timer.enabled})[1],
+            "enable/disable per-kernel timing (adds a sync per call)")
+        asok.register(
+            "arch probe",
+            lambda c, a: __import__("ceph_tpu.arch", fromlist=["probe"])
+            .probe(),
+            "accelerator/host feature probe")
 
     # ---- pools ------------------------------------------------------------
     def create_ec_pool(self, name: str, k: int = 4, m: int = 2,

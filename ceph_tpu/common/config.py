@@ -56,6 +56,11 @@ class Option:
         return str(v)
 
 
+def _subsys_defaults():
+    from .dout import SUBSYS_DEFAULTS
+    return sorted(SUBSYS_DEFAULTS.items())
+
+
 def build_options() -> List[Option]:
     """The option table (subset of src/common/options.cc this build uses)."""
     return [
@@ -86,6 +91,14 @@ def build_options() -> List[Option]:
                          "residual fallback"),
         Option("ec_device_batch", OPT_INT).set_default(64)
         .set_description("stripes per batched device encode call"),
+        Option("tracing_kernels", OPT_BOOL).set_default(False)
+        .set_description("time every device kernel dispatch (adds a "
+                         "sync per call; diagnosis only)"),
+        # debug_<subsys> levels, "log" or "log/gather" — one schema entry
+        # per dout subsystem (single source of truth: SUBSYS_DEFAULTS)
+        *[Option(f"debug_{s}", OPT_STR).set_default(f"{lg}/{gt}")
+          .set_description(f"{s} debug level (log/gather)")
+          for s, (lg, gt) in _subsys_defaults()],
     ]
 
 

@@ -1,0 +1,115 @@
+"""Per-kernel device timing + profiler hooks — the tracing subsystem.
+
+The reference instruments its hot paths with LTTng tracepoints
+(src/tracing/*.tp, emitted from e.g. OSD.cc:6606) and threads one
+ZTracer trace id through every op (msg/Message.h:254).  The TPU-native
+equivalents here:
+
+- ``KernelTimer``: named cumulative timing of device dispatches.  Off
+  by default (timing forces a ``block_until_ready`` sync per call,
+  which kills dispatch pipelining); flip on via config
+  ``tracing_kernels`` or ``KernelTimer.enable()`` when diagnosing.
+  Dumped over the admin socket ("kernel timings") next to perf
+  counters — the "perf dump" of the device side.
+- ``annotate(name)``: a jax.profiler.TraceAnnotation passthrough so
+  framework phases show up named in a jax profiler trace (the
+  tracepoint provider analog); harmless no-op when the profiler is
+  inactive or jax is absent.
+- trace ids: already carried end-to-end by every message
+  (msg/messages.py new_trace_id), surfaced in OpTracker events.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Optional
+
+
+class KernelTimer:
+    """Cumulative wall timing per named kernel."""
+
+    def __init__(self):
+        self.enabled = False
+        self.stats: Dict[str, Dict[str, float]] = {}
+
+    def enable(self, on: bool = True) -> None:
+        self.enabled = on
+
+    @contextlib.contextmanager
+    def time(self, name: str):
+        """Time a host-side block (callers drain device values inside)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._record(name, time.perf_counter() - t0)
+
+    def timed(self, name: str, fn, *args, **kw):
+        """Call fn and drain its output: the one-shot instrumented
+        dispatch used by the device backends when tracing is on."""
+        if not self.enabled:
+            return fn(*args, **kw)
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        try:
+            import jax
+            jax.block_until_ready(out)
+        except Exception:
+            pass
+        self._record(name, time.perf_counter() - t0)
+        return out
+
+    def _record(self, name: str, dt: float) -> None:
+        s = self.stats.setdefault(
+            name, {"calls": 0, "total_s": 0.0, "max_s": 0.0})
+        s["calls"] += 1
+        s["total_s"] += dt
+        s["max_s"] = max(s["max_s"], dt)
+
+    def dump(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for name, s in sorted(self.stats.items()):
+            d = dict(s)
+            if s["calls"]:
+                d["avg_ms"] = round(s["total_s"] / s["calls"] * 1e3, 3)
+            out[name] = d
+        return out
+
+    def reset(self) -> None:
+        self.stats.clear()
+
+
+g_kernel_timer = KernelTimer()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in a jax profiler trace (TraceAnnotation passthrough)."""
+    try:
+        import jax.profiler
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except Exception:
+        yield
+
+
+def start_profiler_trace(log_dir: str) -> bool:
+    """Begin a jax profiler trace (view with tensorboard/xprof)."""
+    try:
+        import jax.profiler
+        jax.profiler.start_trace(log_dir)
+        return True
+    except Exception:
+        return False
+
+
+def stop_profiler_trace() -> bool:
+    try:
+        import jax.profiler
+        jax.profiler.stop_trace()
+        return True
+    except Exception:
+        return False

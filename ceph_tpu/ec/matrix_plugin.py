@@ -87,13 +87,20 @@ class ErasureCodeMatrixRS(ErasureCode):
             raise ValueError(
                 f"stripe chunk size {c} is not a multiple of the code "
                 f"block ({self._stripe_block()} bytes)")
+        from ..common.kernel_trace import g_kernel_timer
         if self._use_device():
-            return self._device_encode_batch(np.ascontiguousarray(data))
-        flat = np.ascontiguousarray(data.transpose(1, 0, 2)).reshape(
-            k, s * c)
-        coding = self.codec.encode(flat)
-        return np.ascontiguousarray(
-            coding.reshape(self.m, s, c).transpose(1, 0, 2))
+            return g_kernel_timer.timed(
+                "ec_encode_batch", self._device_encode_batch,
+                np.ascontiguousarray(data))
+
+        def host():
+            flat = np.ascontiguousarray(data.transpose(1, 0, 2)).reshape(
+                k, s * c)
+            coding = self.codec.encode(flat)
+            return np.ascontiguousarray(
+                coding.reshape(self.m, s, c).transpose(1, 0, 2))
+
+        return g_kernel_timer.timed("ec_encode_batch_host", host)
 
     def decode_batch(self, chunks: Dict[int, np.ndarray],
                      want) -> Dict[int, np.ndarray]:

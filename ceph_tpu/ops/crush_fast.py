@@ -685,7 +685,10 @@ class FastRule:
         R = self.result_max
         X = xs.shape[0]
         wd = jnp.asarray(w32)
-        packed = self._packed_jit(*self._cand, self._cand_x, wd)
+        from ..common.kernel_trace import g_kernel_timer
+        packed = g_kernel_timer.timed(
+            "crush_resolve", self._packed_jit, *self._cand,
+            self._cand_x, wd)
         cap = min(self.delta_cap, X)
         if self._prev_packed is not None and self._host_out is not None:
             # per-epoch fast path: fetch only the rows that changed since

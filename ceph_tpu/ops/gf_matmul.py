@@ -158,7 +158,10 @@ class DeviceRSBackend:
     # -- encode -------------------------------------------------------------
     def encode(self, data: np.ndarray) -> np.ndarray:
         """(S, k, C) uint8 -> (S, m, C) coding chunks (numpy round-trip)."""
-        return np.asarray(self.encode_device(jnp.asarray(data)))
+        from ..common.kernel_trace import g_kernel_timer
+        return g_kernel_timer.timed(
+            "gf_encode", lambda:
+            np.asarray(self.encode_device(jnp.asarray(data))))
 
     def encode_device(self, data: jnp.ndarray) -> jnp.ndarray:
         """Device-resident variant; composes under jit/shard_map."""

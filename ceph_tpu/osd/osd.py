@@ -18,7 +18,7 @@ from __future__ import annotations
 import struct
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
-from ..common import OpTracker, PerfCountersBuilder
+from ..common import Dout, OpTracker, PerfCountersBuilder
 from ..crush.constants import CRUSH_ITEM_NONE
 from ..msg import (
     Dispatcher, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
@@ -90,6 +90,7 @@ class OSD(Dispatcher):
         self.last_ping_reply: Dict[int, float] = {}
         self.now = 0.0
         self.perf_counters = _build_osd_perf(self.name)
+        self.dout = Dout("osd", self.name)
         self.op_tracker = OpTracker()
         self._tracked: Dict[Tuple[str, int], object] = {}
         self._recovery_queue: List[PG] = []
@@ -174,6 +175,9 @@ class OSD(Dispatcher):
         reference's same_interval_since check walks every epoch too
         (PG::start_peering_interval)."""
         self.perf_counters.inc(L_OSD_MAP)
+        self.dout(7, f"handle_osd_map epochs "
+                  f"[{msg.incrementals[0].epoch if msg.incrementals else 0}"
+                  f"..{msg.incrementals[-1].epoch if msg.incrementals else 0}]")
         for inc in msg.incrementals:
             if inc.epoch == self.osdmap.epoch + 1:
                 was_up = {o for o in range(self.osdmap.max_osd)
@@ -313,6 +317,8 @@ class OSD(Dispatcher):
             last = self.last_ping_reply.get(peer, now)
             self.last_ping_reply.setdefault(peer, now)
             if now - last > HEARTBEAT_GRACE:
+                self.dout(1, f"heartbeat: no reply from osd.{peer} "
+                          f"since {last:.1f}, reporting failure")
                 # keep re-sending while the peer stays silent: the mon
                 # leadership may change mid-outage and a one-shot report
                 # to a dead leader would blind failure detection (the
@@ -371,6 +377,8 @@ class OSD(Dispatcher):
             pg.recovery_done_for(oid)
             return
         pg._recovering.add(oid)
+        self.dout(5, f"recover_oid {oid} pg {pg.pgid} "
+                  f"targets {sorted(targets)}", )
         if all(op == OP_DELETE for (_v, op) in targets.values()):
             for s, (v, _op) in targets.items():
                 osd = pg.acting_shards().get(s)

@@ -44,6 +44,7 @@ from ..msg.messages import (
     CEPH_OSD_OP_SETXATTR, CEPH_OSD_OP_TRUNCATE, CEPH_OSD_OP_ZERO, OSDOp,
 )
 from ..msg.kv import pack_kv, unpack_keys, unpack_kv
+from ..common.dout import dlog
 from ..os_store import Transaction, hobject_t
 from .ec_backend import ECBackend, SIZE_ATTR
 from .pg_log import LogEntry, OP_DELETE, OP_MODIFY, PGLog, PG_META_OID
@@ -302,6 +303,8 @@ class PG:
 
     def start_peering(self, epoch: int) -> None:
         self.state = STATE_PEERING
+        dlog("pg", 5, f"pg {self.pgid} -> peering, acting {self.acting}",
+             f"osd.{self.osd.osd_id}")
         self.peering_epoch = epoch
         self._peer_infos.clear()
         self._getlog_pending = None
@@ -925,11 +928,15 @@ class PG:
             st["_meta"] = True
             return 0, b""
         if o == CEPH_OSD_OP_GETXATTR:
+            if not exists:
+                return -2, b""                      # ENOENT
             v = attrs.get(op.name)
             if v is None:
                 return -61, b""
             return 0, v
         if o == CEPH_OSD_OP_GETXATTRS:
+            if not exists:
+                return -2, b""
             return 0, pack_kv({k: attrs[k] for k in sorted(attrs)})
         if o == CEPH_OSD_OP_CMPXATTR:
             v = attrs.get(op.name)
@@ -958,6 +965,8 @@ class PG:
                     omap.pop(k, None)
                 st["_meta"] = True
                 return 0, b""
+            if not exists:
+                return -2, b""
             return 0, pack_kv({k: omap[k] for k in sorted(omap)})
         return -95, b""                             # EOPNOTSUPP
 

@@ -1,0 +1,131 @@
+"""Leveled logging (dout/Log), kernel tracing, and arch probing."""
+import pytest
+
+from ceph_tpu.arch import probe
+from ceph_tpu.common import g_kernel_timer, get_log
+from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.common.dout import Dout, dlog, register_config_observers
+
+
+@pytest.fixture(autouse=True)
+def clean_log():
+    get_log().clear()
+    g_kernel_timer.reset()
+    g_kernel_timer.enable(False)
+    yield
+    get_log().clear()
+
+
+def test_gather_vs_log_levels():
+    log = get_log()
+    log.parse_level("osd", "1/5")
+    dlog("osd", 1, "visible")
+    dlog("osd", 5, "gathered-only")
+    dlog("osd", 6, "dropped")
+    lines = log.dump_recent()
+    assert any("visible" in ln for ln in lines)
+    assert any("gathered-only" in ln for ln in lines)
+    assert not any("dropped" in ln for ln in lines)
+
+
+def test_ring_is_bounded():
+    log = get_log()
+    log.parse_level("osd", "0/5")
+    for i in range(11000):
+        dlog("osd", 1, f"e{i}")
+    assert len(log.recent) == 10000
+    # oldest entries evicted, newest retained
+    assert log.dump_recent(1)[0].endswith("e10999")
+
+
+def test_subsys_filter_and_who_prefix():
+    log = get_log()
+    d = Dout("pg", "osd.3")
+    d(1, "peering started")
+    dlog("mon", 1, "epoch 5")
+    pg_lines = log.dump_recent(0, "pg")
+    assert len(pg_lines) == 1 and "osd.3" in pg_lines[0]
+
+
+def test_config_observer_updates_levels():
+    cfg = ConfigProxy()
+    register_config_observers(cfg)
+    log = get_log()
+    cfg.set_val("debug_crush", "10/20")
+    assert log.levels["crush"] == (10, 20)
+    dlog("crush", 15, "deep detail")
+    assert any("deep detail" in ln for ln in log.dump_recent())
+
+
+def test_kernel_timer_disabled_is_passthrough():
+    calls = []
+    out = g_kernel_timer.timed("k", lambda: calls.append(1) or 42)
+    assert out == 42 and g_kernel_timer.dump() == {}
+
+
+def test_kernel_timer_records_when_enabled():
+    g_kernel_timer.enable()
+    g_kernel_timer.timed("k", lambda: 1)
+    g_kernel_timer.timed("k", lambda: 2)
+    d = g_kernel_timer.dump()
+    assert d["k"]["calls"] == 2 and d["k"]["total_s"] >= 0
+    assert "avg_ms" in d["k"]
+
+
+def test_kernel_timer_hooks_in_device_backend():
+    import numpy as np
+    from ceph_tpu.gf.matrices import gf_gen_rs_matrix
+    from ceph_tpu.ops.gf_matmul import DeviceRSBackend
+    g_kernel_timer.enable()
+    be = DeviceRSBackend(gf_gen_rs_matrix(6, 4))
+    data = np.zeros((2, 4, 64), dtype=np.uint8)
+    be.encode(data)
+    assert g_kernel_timer.dump()["gf_encode"]["calls"] == 1
+
+
+def test_arch_probe_shape():
+    p = probe()
+    assert p["platform"] in ("cpu", "tpu", "gpu", "none")
+    assert isinstance(p["n_devices"], int) and p["n_devices"] >= 1
+    assert p["x64"] is True          # CPU mesh in tests supports x64
+    assert isinstance(p["native"], bool)
+    # cached second call returns the same dict
+    assert probe() is p
+
+
+def test_cluster_admin_log_and_trace_commands():
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=3)
+    c.create_ec_pool("lp", k=2, m=1, plugin="isa", pg_num=4)
+    cl = c.client("client.l")
+    cl.write_full("lp", "o1", b"x" * 1000)
+    out = c.admin_socket.execute("log dump", {"subsys": "osd"})
+    assert isinstance(out["lines"], list)
+    c.admin_socket.execute("log set", {"subsys": "osd", "level": "20/20"})
+    assert get_log().levels["osd"] == (20, 20)
+    c.admin_socket.execute("kernel tracing", {"on": "1"})
+    cl.write_full("lp", "o2", b"y" * 1000)
+    kt = c.admin_socket.execute("kernel timings")
+    encodes = sum(v.get("calls", 0) for n, v in kt.items()
+                  if n.startswith("ec_encode_batch"))
+    assert encodes >= 1
+    ap = c.admin_socket.execute("arch probe")
+    assert ap["platform"] == "cpu"
+
+
+def test_osd_map_events_logged():
+    from ceph_tpu.cluster import MiniCluster
+    get_log().parse_level("osd", "1/10")
+    c = MiniCluster(n_osds=3)
+    c.create_ec_pool("lg", k=2, m=1, plugin="isa", pg_num=4)
+    lines = get_log().dump_recent(0, "osd")
+    assert any("handle_osd_map" in ln for ln in lines)
+
+
+def test_tracing_kernels_config_option_enables_timer():
+    cfg = ConfigProxy()
+    register_config_observers(cfg)
+    cfg.set_val("tracing_kernels", "true")
+    assert g_kernel_timer.enabled
+    cfg.set_val("tracing_kernels", "false")
+    assert not g_kernel_timer.enabled

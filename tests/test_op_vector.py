@@ -238,3 +238,14 @@ def test_setxattr_creates_consistent_empty_object(fixture, request):
     assert cl.getxattr(pool, "ghost", "tag") == b"boo"
     assert cl.stat(pool, "ghost") == 0
     assert cl.read(pool, "ghost") == b""
+
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_metadata_reads_on_absent_object_return_enoent(fixture, request):
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    with pytest.raises(IOError):
+        cl.getxattrs(pool, "never-created")
+    if fixture == "rep_cluster":
+        with pytest.raises(IOError):
+            cl.omap_get(pool, "never-created")
