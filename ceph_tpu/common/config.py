@@ -91,6 +91,11 @@ def build_options() -> List[Option]:
                          "residual fallback"),
         Option("ec_device_batch", OPT_INT).set_default(64)
         .set_description("stripes per batched device encode call"),
+        Option("osd_scrub_min_interval", OPT_FLOAT).set_default(86400.0)
+        .set_description("seconds between periodic background scrubs "
+                         "of a PG (reference osd_scrub_min_interval)"),
+        Option("osd_scrub_auto", OPT_BOOL).set_default(True)
+        .set_description("schedule background scrubs from the OSD tick"),
         Option("tracing_kernels", OPT_BOOL).set_default(False)
         .set_description("time every device kernel dispatch (adds a "
                          "sync per call; diagnosis only)"),

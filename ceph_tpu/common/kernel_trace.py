@@ -87,13 +87,20 @@ g_kernel_timer = KernelTimer()
 
 @contextlib.contextmanager
 def annotate(name: str):
-    """Named region in a jax profiler trace (TraceAnnotation passthrough)."""
+    """Named region in a jax profiler trace (TraceAnnotation
+    passthrough).  Only the profiler plumbing is guarded — exceptions
+    from the annotated body always propagate unchanged."""
+    cm = None
     try:
         import jax.profiler
-        with jax.profiler.TraceAnnotation(name):
-            yield
+        cm = jax.profiler.TraceAnnotation(name)
     except Exception:
+        cm = None
+    if cm is None:
         yield
+    else:
+        with cm:
+            yield
 
 
 def start_profiler_trace(log_dir: str) -> bool:

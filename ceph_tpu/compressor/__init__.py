@@ -1,0 +1,6 @@
+from .registry import (
+    Compressor, CompressorRegistry, create_compressor, g_compressor_registry,
+)
+
+__all__ = ["Compressor", "CompressorRegistry", "create_compressor",
+           "g_compressor_registry"]

@@ -23,15 +23,31 @@ from .messenger import Network
 from .messages import Message
 from .wire import decode_message, encode_message
 
-_HDR = struct.Struct("<I H")   # frame length, dst-name length
+_HDR = struct.Struct("<I H B")   # frame length, dst-name length, comp algo
+
+# frame compression algorithm ids (Compressor::COMP_ALG_* role); the
+# receiver decodes by the frame's id, so peers may use different configs
+_COMP_IDS = {"none": 0, "zlib": 1, "snappy": 2, "zstd": 3, "lz4": 4}
+_COMP_NAMES = {v: k for k, v in _COMP_IDS.items()}
 
 
 class TcpNetwork(Network):
-    """One per process: hosts local entities, routes to remote ones."""
+    """One per process: hosts local entities, routes to remote ones.
+
+    ``compression`` compresses outbound frame payloads at least
+    ``compress_min`` bytes long (ms_compress role; BlueStore-style
+    plugin via ceph_tpu.compressor)."""
 
     def __init__(self, listen_addr: Tuple[str, int],
-                 directory: Dict[str, Tuple[str, int]]):
+                 directory: Dict[str, Tuple[str, int]],
+                 compression: str = "none", compress_min: int = 1024):
         super().__init__()
+        from ..compressor import create_compressor
+        self.compression = compression
+        self.compress_min = compress_min
+        self._comp = create_compressor(compression)
+        self._comp_id = _COMP_IDS[compression]
+        self._decomps = {0: create_compressor("none")}
         self.directory = dict(directory)
         self.listen_addr = listen_addr
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -52,8 +68,17 @@ class TcpNetwork(Network):
         if addr is None or tuple(addr) == tuple(self.listen_addr):
             return False  # unknown, or points back here with no endpoint
         payload = encode_message(msg)
+        comp_id = 0
+        if self._comp_id and len(payload) >= self.compress_min:
+            compressed = self._comp.compress(payload)
+            # keep the raw buffer when compression doesn't help
+            # (incompressible EC shard data expands under zlib)
+            if len(compressed) < len(payload):
+                payload = compressed
+                comp_id = self._comp_id
         dname = dst.encode()
-        frame = _HDR.pack(len(payload), len(dname)) + dname + payload
+        frame = _HDR.pack(len(payload), len(dname), comp_id) \
+            + dname + payload
         addr = tuple(addr)
         try:
             self._peer(addr).sendall(frame)
@@ -110,7 +135,7 @@ class TcpNetwork(Network):
     def _drain_frames(self, buf: bytearray) -> int:
         n = 0
         while len(buf) >= _HDR.size:
-            plen, dlen = _HDR.unpack_from(buf, 0)
+            plen, dlen, comp_id = _HDR.unpack_from(buf, 0)
             total = _HDR.size + dlen + plen
             if len(buf) < total:
                 break
@@ -118,8 +143,28 @@ class TcpNetwork(Network):
             payload = bytes(buf[_HDR.size + dlen:total])
             del buf[:total]
             try:
+                if comp_id:
+                    dec = self._decomps.get(comp_id)
+                    if dec is None:
+                        from ..common.dout import dlog
+                        from ..compressor import create_compressor
+                        try:
+                            dec = create_compressor(
+                                _COMP_NAMES.get(comp_id, f"#{comp_id}"))
+                        except KeyError:
+                            # peer uses a codec this environment lacks:
+                            # dropping silently would hang its ops with
+                            # zero diagnostics — log loudly every time
+                            dlog("msg", 0,
+                                 f"dropping frame for {dst}: peer codec "
+                                 f"id {comp_id} unavailable here")
+                            self.dropped += 1
+                            continue
+                        self._decomps[comp_id] = dec
+                    payload = dec.decompress(payload)
                 msg = decode_message(payload)
-            except (ValueError, KeyError, UnicodeDecodeError):
+            except Exception:   # corrupt frame or codec error (zlib.error
+                                # etc. — each codec raises its own type)
                 # corrupt/unknown frame: count it dropped, keep pumping
                 self.dropped += 1
                 continue

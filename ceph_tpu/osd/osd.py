@@ -313,6 +313,7 @@ class OSD(Dispatcher):
             self.messenger.send_message(
                 MOSDPing(op=MOSDPing.PING, stamp=now,
                          epoch=self.osdmap.epoch), f"osd.{peer}")
+        self.maybe_schedule_scrubs()
         for peer in peers:
             last = self.last_ping_reply.get(peer, now)
             self.last_ping_reply.setdefault(peer, now)
@@ -328,6 +329,24 @@ class OSD(Dispatcher):
                         MOSDFailure(target_osd=peer, failed_since=last,
                                     epoch=self.osdmap.epoch,
                                     reporter=self.name), mon)
+
+    def maybe_schedule_scrubs(self) -> None:
+        """Periodic background scrub scheduling (the OSD's scrub
+        scheduler role, OSD.cc sched_scrub): each primary PG scrubs
+        every osd_scrub_min_interval seconds, staggered per PG so a
+        whole cluster never scrubs at one instant (the reference
+        randomizes with osd_scrub_interval_randomize_ratio)."""
+        from ..common.config import g_conf
+        if not g_conf.get_val("osd_scrub_auto"):
+            return
+        interval = float(g_conf.get_val("osd_scrub_min_interval"))
+        for pg in self.pgs.values():
+            if not pg.is_primary():
+                continue
+            stagger = (hash(pg.pgid) % 997) / 997.0 * interval * 0.1
+            if self.now - pg.last_scrub_stamp >= interval + stagger:
+                self.dout(5, f"sched_scrub pg {pg.pgid}")
+                pg.start_scrub()
 
     def _handle_ping(self, msg: MOSDPing) -> None:
         if msg.op == MOSDPing.PING:

@@ -160,6 +160,7 @@ class PG:
         self.acting_primary = -1
         self.state = STATE_INITIAL
         self.last_epoch_started = 0
+        self.last_scrub_stamp = 0.0
         self.backend: Optional[ECBackend] = None
         self.rep_backend: Optional[ReplicatedBackend] = None
         if pool.is_erasure():
@@ -607,6 +608,9 @@ class PG:
         if not self.is_primary() or self.state not in (
                 STATE_ACTIVE, STATE_ACTIVE_RECOVERING):
             return
+        self.last_scrub_stamp = self.osd.now
+        dlog("scrub", 5, f"pg {self.pgid} scrub start",
+             f"osd.{self.osd.osd_id}")
         self._scrub_maps: Dict[int, MOSDRepScrubMap] = {}
         self._scrub_pending = set(self.acting_shards())
         for shard, osd in self.acting_shards().items():

@@ -1,0 +1,184 @@
+"""objectstore-tool — offline object-store surgery on saved stores.
+
+The ceph-objectstore-tool analog (src/tools/ceph_objectstore_tool.cc):
+operate on an unmounted OSD store (here: a ``MemStore.save`` file, the
+checkpoint format MiniCluster.checkpoint writes) without a running
+cluster.  Supported ops mirror the reference's most-used surface:
+
+  --op list                      list (collection, object) pairs
+  --op info                      store summary (collections/objects/txns)
+  --op get-bytes  --cid C --oid O [--shard S] [--out FILE]
+  --op list-attrs --cid C --oid O [--shard S]
+  --op get-omap   --cid C --oid O [--shard S]
+  --op remove     --cid C --oid O [--shard S]   (rewrites the store)
+  --op export     --cid C --out FILE            (one collection, portable)
+  --op import     --in FILE                     (merge an exported coll)
+
+Exit status 0 on success, 1 on usage/lookup errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import struct
+import sys
+
+from ..os_store import MemStore, Transaction, hobject_t
+
+_EXPORT_MAGIC = b"CTOSEXP1"
+
+
+def _find(store: MemStore, cid: str, oid: str, shard: int):
+    ho = hobject_t(oid, shard)
+    if not store.collection_exists(cid) or not store.exists(cid, ho):
+        return None
+    return ho
+
+
+def _op_list(store: MemStore, out) -> int:
+    for cid in sorted(store.list_collections()):
+        for ho in sorted(store.list_objects(cid)):
+            print(json.dumps({"cid": cid, "oid": ho.oid,
+                              "shard": ho.shard,
+                              "size": store.stat(cid, ho)}), file=out)
+    return 0
+
+
+def _op_info(store: MemStore, out) -> int:
+    n_obj = sum(len(store.list_objects(c))
+                for c in store.list_collections())
+    print(json.dumps({"collections": len(store.list_collections()),
+                      "objects": n_obj,
+                      "committed_txns": store.committed_txns}), file=out)
+    return 0
+
+
+def _op_export(store: MemStore, cid: str, path: str) -> int:
+    if not store.collection_exists(cid):
+        print(f"no collection {cid!r}", file=sys.stderr)
+        return 1
+    sub = MemStore()
+    t = Transaction()
+    t.create_collection(cid)
+    sub.queue_transaction(t)
+    for ho in store.list_objects(cid):
+        t = Transaction()
+        t.touch(cid, ho)
+        t.write(cid, ho, 0, store.read(cid, ho))
+        for k, v in store.getattrs(cid, ho).items():
+            t.setattr(cid, ho, k, v)
+        om = store.omap_get(cid, ho)
+        if om:
+            t.omap_setkeys(cid, ho, om)
+        sub.queue_transaction(t)
+    sub_path = path + ".body"
+    sub.save(sub_path)
+    with open(sub_path, "rb") as f:
+        body = f.read()
+    import os
+    os.remove(sub_path)
+    with open(path, "wb") as f:
+        f.write(_EXPORT_MAGIC + struct.pack("<I", len(body)) + body)
+    return 0
+
+
+def _op_import(store: MemStore, store_path: str, path: str) -> int:
+    with open(path, "rb") as f:
+        blob = f.read()
+    if blob[:8] != _EXPORT_MAGIC:
+        print(f"{path}: not an objectstore export", file=sys.stderr)
+        return 1
+    import os
+    body_path = path + ".body"
+    try:
+        (n,) = struct.unpack_from("<I", blob, 8)
+        if len(blob) < 12 + n:
+            raise ValueError("truncated export body")
+        with open(body_path, "wb") as f:
+            f.write(blob[12:12 + n])
+        sub = MemStore.load(body_path)
+    except (struct.error, ValueError) as e:
+        print(f"{path}: corrupt export ({e})", file=sys.stderr)
+        return 1
+    finally:
+        if os.path.exists(body_path):
+            os.remove(body_path)
+    for cid in sub.list_collections():
+        t = Transaction()
+        if not store.collection_exists(cid):
+            t.create_collection(cid)
+        for ho in sub.list_objects(cid):
+            t.touch(cid, ho)
+            t.truncate(cid, ho, 0)
+            t.write(cid, ho, 0, sub.read(cid, ho))
+            for k, v in sub.getattrs(cid, ho).items():
+                t.setattr(cid, ho, k, v)
+            om = sub.omap_get(cid, ho)
+            if om:
+                t.omap_setkeys(cid, ho, om)
+        store.queue_transaction(t)
+    store.save(store_path)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="objectstore-tool")
+    p.add_argument("--data-path", required=True,
+                   help="MemStore.save file (osd.N.store)")
+    p.add_argument("--op", required=True,
+                   choices=["list", "info", "get-bytes", "list-attrs",
+                            "get-omap", "remove", "export", "import"])
+    p.add_argument("--cid")
+    p.add_argument("--oid")
+    p.add_argument("--shard", type=int, default=-1)
+    p.add_argument("--out", help="output file (get-bytes/export)")
+    p.add_argument("--in", dest="infile", help="input file (import)")
+    a = p.parse_args(argv)
+
+    store = MemStore.load(a.data_path)
+    if a.op == "list":
+        return _op_list(store, sys.stdout)
+    if a.op == "info":
+        return _op_info(store, sys.stdout)
+    if a.op == "export":
+        if not a.cid or not a.out:
+            p.error("export needs --cid and --out")
+        return _op_export(store, a.cid, a.out)
+    if a.op == "import":
+        if not a.infile:
+            p.error("import needs --in")
+        return _op_import(store, a.data_path, a.infile)
+
+    if not a.cid or not a.oid:
+        p.error(f"{a.op} needs --cid and --oid")
+    ho = _find(store, a.cid, a.oid, a.shard)
+    if ho is None:
+        print(f"object {a.oid!r} (shard {a.shard}) not in {a.cid!r}",
+              file=sys.stderr)
+        return 1
+    if a.op == "get-bytes":
+        data = store.read(a.cid, ho)
+        if a.out:
+            with open(a.out, "wb") as f:
+                f.write(data)
+        else:
+            sys.stdout.buffer.write(data)
+        return 0
+    if a.op == "list-attrs":
+        attrs = store.getattrs(a.cid, ho)
+        print(json.dumps({k: v.hex() for k, v in sorted(attrs.items())}))
+        return 0
+    if a.op == "get-omap":
+        om = store.omap_get(a.cid, ho)
+        print(json.dumps({k: v.hex() for k, v in sorted(om.items())}))
+        return 0
+    # remove
+    t = Transaction()
+    t.remove(a.cid, ho)
+    store.queue_transaction(t)
+    store.save(a.data_path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
