@@ -159,7 +159,8 @@ class RadosClient(Dispatcher):
 
     def _submit(self, pool_id: int, oid: str, op: str = "",
                 data: bytes = b"", offset: int = 0, length: int = 0,
-                ops: Optional[list] = None) -> MOSDOpReply:
+                ops: Optional[list] = None,
+                snapid: int = 0) -> MOSDOpReply:
         for attempt in range(MAX_ATTEMPTS):
             pgid, primary = self._calc_target(pool_id, oid)
             self._tid += 1
@@ -169,6 +170,7 @@ class RadosClient(Dispatcher):
                              op=op, data=data, offset=offset,
                              length=length, epoch=self.osdmap.epoch,
                              ops=list(ops) if ops else [],
+                             snapid=snapid,
                              trace_id=new_trace_id())
                 self.messenger.send_message(msg, f"osd.{primary}")
                 self.network.pump()
@@ -181,11 +183,15 @@ class RadosClient(Dispatcher):
         return reply if reply is not None else MOSDOpReply(tid=tid,
                                                            result=-110)
 
-    def operate(self, pool: str, oid: str, op: ObjectOperation
-                ) -> Tuple[int, list]:
+    def operate(self, pool: str, oid: str, op: ObjectOperation,
+                snap=None) -> Tuple[int, list]:
         """Execute an atomic multi-op vector; returns (result,
-        [(per-op result, per-op data), ...]) — rados_*_op_operate."""
-        r = self._submit(self.lookup_pool(pool), oid, ops=op.ops)
+        [(per-op result, per-op data), ...]) — rados_*_op_operate.
+        With ``snap`` the vector runs read-only against that pool
+        snapshot's view."""
+        snapid = self._resolve_snapid(pool, snap) if snap else 0
+        r = self._submit(self.lookup_pool(pool), oid, ops=op.ops,
+                         snapid=snapid)
         return r.result, list(r.op_results)
 
     def lookup_pool(self, name: str) -> int:
@@ -213,12 +219,70 @@ class RadosClient(Dispatcher):
         return r.result
 
     def read(self, pool: str, oid: str, offset: int = 0,
-             length: int = 0) -> bytes:
+             length: int = 0, snap=None) -> bytes:
+        """Read the head, or — with ``snap`` (name or id) — the object's
+        state as of that pool snapshot (rados snap read)."""
+        snapid = self._resolve_snapid(pool, snap) if snap else 0
         r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_READ,
-                         offset=offset, length=length)
+                         offset=offset, length=length, snapid=snapid)
         if r.result < 0:
             raise IOError(f"read {oid}: {r.result}")
         return r.data
+
+    # ---- pool snapshots (rados_ioctx_snap_*) -------------------------------
+    def _resolve_snapid(self, pool: str, snap) -> int:
+        if isinstance(snap, int):
+            return snap
+        p = self.osdmap.get_pg_pool(self.lookup_pool(pool))
+        for sid, name in p.snaps.items():
+            if name == snap:
+                return sid
+        raise KeyError(f"no snap {snap!r} on pool {pool!r}")
+
+    def snap_create(self, pool: str, name: str) -> int:
+        sid = self.mon.pool_snap_create(pool, name)
+        self.mon.publish()
+        self.network.pump()
+        return sid
+
+    def snap_remove(self, pool: str, name: str) -> int:
+        sid = self.mon.pool_snap_rm(pool, name)
+        self.mon.publish()
+        self.network.pump()
+        return sid
+
+    def snap_list(self, pool: str) -> Dict[int, str]:
+        p = self.osdmap.get_pg_pool(self.lookup_pool(pool))
+        return dict(p.snaps)
+
+    def rollback(self, pool: str, oid: str, snap) -> int:
+        """Restore the head — data AND xattrs — to its state at the
+        snap (rados_ioctx_snap_rollback; composed client-side from
+        snap-view reads + one atomic head vector)."""
+        pid = self.lookup_pool(pool)
+        snapid = self._resolve_snapid(pool, snap)
+        r = self._submit(pid, oid, CEPH_OSD_OP_READ, snapid=snapid)
+        if r.result == -2:
+            # object did not exist at the snap: remove the head
+            return self.remove(pool, oid)
+        if r.result < 0:
+            # transient failure (EIO/degraded): never touch the head
+            raise IOError(f"rollback read {oid}@{snap}: {r.result}")
+        rs, res = self.operate(pool, oid,
+                               ObjectOperation().get_xattrs(), snap=snap)
+        snap_attrs = _unpack_kv(res[0][1]) if rs == 0 else {}
+        try:
+            head_attrs = self.getxattrs(pool, oid)
+        except IOError:
+            head_attrs = {}
+        op = ObjectOperation().write_full(r.data)
+        for k in head_attrs:
+            if k not in snap_attrs:
+                op.rm_xattr(k)
+        for k, v in snap_attrs.items():
+            op.set_xattr(k, v)
+        r2, _ = self.operate(pool, oid, op)
+        return r2
 
     def stat(self, pool: str, oid: str) -> int:
         r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_STAT)

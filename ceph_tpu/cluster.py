@@ -205,6 +205,16 @@ class MiniCluster:
         self.publish()
         return pid
 
+    def pool_snap_create(self, pool: str, snap: str) -> int:
+        sid = self.mon.pool_snap_create(pool, snap)
+        self.publish()
+        return sid
+
+    def pool_snap_rm(self, pool: str, snap: str) -> int:
+        sid = self.mon.pool_snap_rm(pool, snap)
+        self.publish()
+        return sid
+
     def create_replicated_pool(self, name: str, size: int = 3,
                                pg_num: int = 32) -> int:
         pid = self.mon.create_replicated_pool(name, size, pg_num)

@@ -27,7 +27,7 @@ from typing import Dict, List, Optional, Set
 from ..crush.constants import CRUSH_BUCKET_STRAW2
 from ..ec import create_erasure_code
 from ..msg import Dispatcher, MOSDFailure, MOSDMap, Message, Network
-from ..msg.messages import MMonElection, MMonPaxos, MMonPing
+from ..msg.messages import MMonElection, MMonPaxos, MMonPing, MOSDPGTemp
 from ..osdmap import (
     CEPH_OSD_IN, Incremental, OSDMap, TYPE_ERASURE, TYPE_REPLICATED,
     pg_pool_t,
@@ -313,6 +313,35 @@ class Monitor(Dispatcher):
         self._topology_dirty = True
         return self.osdmap.add_pool(name, pool)
 
+    # ---- pool snapshots (OSDMonitor pool mksnap/rmsnap) --------------------
+    def pool_snap_create(self, pool_name: str, snap_name: str) -> int:
+        """Allocate the next snap id on the pool; publish via the next
+        epoch (pg_pool_t::add_snap role)."""
+        pid = self.osdmap.lookup_pg_pool_name(pool_name)
+        if pid < 0:
+            raise KeyError(f"no pool {pool_name!r}")
+        pool = self.osdmap.pools[pid]
+        if snap_name in pool.snaps.values():
+            raise ValueError(f"snap {snap_name!r} exists")
+        sid = pool.snap_seq + 1
+        pool.snaps[sid] = snap_name
+        pool.snap_seq = sid
+        self._topology_dirty = True
+        return sid
+
+    def pool_snap_rm(self, pool_name: str, snap_name: str) -> int:
+        pid = self.osdmap.lookup_pg_pool_name(pool_name)
+        if pid < 0:
+            raise KeyError(f"no pool {pool_name!r}")
+        pool = self.osdmap.pools[pid]
+        for sid, n in list(pool.snaps.items()):
+            if n == snap_name:
+                del pool.snaps[sid]
+                pool.removed_snaps.append(sid)
+                self._topology_dirty = True
+                return sid
+        raise KeyError(f"no snap {snap_name!r} on {pool_name!r}")
+
     # ---- epoch publication -------------------------------------------------
     def _snapshot_inc(self) -> Incremental:
         """Full-state Incremental (crush/pools/osd states deep-copied so
@@ -400,6 +429,19 @@ class Monitor(Dispatcher):
         inc.new_weight[osd] = 0
         self.publish(inc)
 
+    def handle_pg_temp(self, msg: MOSDPGTemp) -> None:
+        """OSDMonitor pg_temp handling: pin/clear the PG's acting set
+        (OSDMonitor::preprocess_pgtemp role)."""
+        from ..osdmap import pg_t as _pg_t
+        pg = _pg_t(msg.pgid[0], msg.pgid[1])
+        want = [int(o) for o in msg.temp]
+        cur = self.osdmap.pg_temp.get(pg, [])
+        if want == list(cur):
+            return
+        inc = Incremental()
+        inc.new_pg_temp[pg] = want      # [] clears the pin
+        self.publish(inc)
+
     def mark_osd_in(self, osd: int) -> None:
         inc = Incremental()
         inc.new_weight[osd] = CEPH_OSD_IN
@@ -446,6 +488,15 @@ class Monitor(Dispatcher):
             self._handle_paxos(msg)
         elif isinstance(msg, MMonPing):
             self._handle_mon_ping(msg)
+        elif isinstance(msg, MOSDPGTemp):
+            if self.is_leader() or not self.peers:
+                self.handle_pg_temp(msg)
+            elif self.is_peon():
+                name = self._peer_name(self.leader_rank)
+                if name:
+                    self.messenger.send_message(MOSDPGTemp(
+                        pgid=msg.pgid, epoch=msg.epoch,
+                        temp=list(msg.temp)), name)
         elif isinstance(msg, MOSDFailure):
             if not self.is_leader():
                 # peons forward to the leader (Monitor::forward_request);

@@ -91,6 +91,7 @@ class MOSDOp(Message):
     data: bytes = b""
     epoch: int = 0
     ops: List["OSDOp"] = field(default_factory=list)
+    snapid: int = 0          # read at this pool snap (0 = head)
 
 
 @dataclass
@@ -125,6 +126,10 @@ class MOSDECSubOpWrite(Message):
     xattrs: Optional[Dict[str, bytes]] = None   # full replacement set
     omap: Optional[Dict[str, bytes]] = None     # full replacement (rep only)
     attr_only: bool = False  # metadata-only mutation: leave the body alone
+    # snapshot bookkeeping riding the same shard transaction: update the
+    # PG meta snapset for (head_oid, packed_entries); b"" removes it
+    snapset_update: Optional[Tuple[str, bytes]] = None
+    snapset_only: bool = False  # pure meta message: touch no object
 
 
 @dataclass
@@ -183,6 +188,13 @@ class MOSDPGInfo(Message):
     log_tail: int = 0
     log_entries: List[bytes] = field(default_factory=list)
     missing_oids: List[Tuple[str, int]] = field(default_factory=list)
+    # per-head snapset blobs: clone bookkeeping must survive primary
+    # failover/backfill, so it rides peering like the log does
+    snapsets: List[Tuple[str, bytes]] = field(default_factory=list)
+    # which EC shard collections this OSD actually HOLDS data for —
+    # acting positions can shuffle on remap, and the pg_log alone can't
+    # tell a data-bearing replica from a freshly assigned one
+    held_shards: List[int] = field(default_factory=list)
 
 
 @dataclass
@@ -201,6 +213,17 @@ class MOSDPGScanReply(Message):
     epoch: int = 0
     objects: List[Tuple[str, int]] = field(default_factory=list)
     # (oid, version) per object on the shard
+
+
+@dataclass
+class MOSDPGTemp(Message):
+    """Primary -> mon: pin this PG's acting set to *temp* until the
+    data realigns (OSD::send_pg_temp / MOSDPGTemp.h; empty temp clears
+    the pin).  The choose_acting answer when CRUSH shuffles surviving
+    shards to new positions."""
+    pgid: Tuple[int, int] = (0, 0)
+    epoch: int = 0
+    temp: List[int] = field(default_factory=list)
 
 
 @dataclass

@@ -166,6 +166,7 @@ class FullWriteOp:
     data: bytes
     on_commit: Callable[[int], None]
     xattrs: Optional[Dict[str, bytes]] = None   # full user-attr replacement
+    snapset_update: Optional[Tuple[str, bytes]] = None
 
 
 @dataclass
@@ -255,7 +256,9 @@ class ECBackend:
     # ---- write path (primary) --------------------------------------------
     def submit_transaction(self, oid: str, data: bytes,
                            on_commit: Callable[[int], None],
-                           xattrs: Optional[Dict[str, bytes]] = None) -> int:
+                           xattrs: Optional[Dict[str, bytes]] = None,
+                           snapset_update: Optional[Tuple[str, bytes]]
+                           = None) -> int:
         """Full-object EC write: one batched encode, fan out shards.
 
         ``xattrs``: full replacement set of user xattrs riding the same
@@ -263,7 +266,8 @@ class ECBackend:
         shards' existing user attrs alone."""
         tid = self.next_tid()
         self._enqueue(oid, FullWriteOp(tid=tid, oid=oid, data=bytes(data),
-                                       on_commit=on_commit, xattrs=xattrs))
+                                       on_commit=on_commit, xattrs=xattrs,
+                                       snapset_update=snapset_update))
         return tid
 
     def submit_vector(self, oid: str, run: Callable,
@@ -356,7 +360,8 @@ class ECBackend:
                              on_all_commit=all_commit,
                              client_reply=op.on_commit,
                              version=self.pg.next_version(),
-                             xattrs=op.xattrs)
+                             xattrs=op.xattrs,
+                             snapset_update=op.snapset_update)
 
     # ---- rmw pipeline (start_rmw, ECBackend.cc:1793) -----------------------
     def _start_rmw(self, op: RMWOp) -> None:
@@ -451,7 +456,9 @@ class ECBackend:
                         on_all_commit: Callable[[], None],
                         client_reply: Callable[[int], None],
                         version: int = 0,
-                        xattrs: Optional[Dict[str, bytes]] = None) -> None:
+                        xattrs: Optional[Dict[str, bytes]] = None,
+                        snapset_update: Optional[Tuple[str, bytes]]
+                        = None) -> None:
         wr = InflightWrite(tid=tid, oid=oid, client_reply=client_reply,
                            on_all_commit=on_all_commit)
         acting = self.pg.acting_shards()
@@ -460,7 +467,8 @@ class ECBackend:
             msg = MOSDECSubOpWrite(
                 tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
                 chunk=chunk, offset=chunk_off, partial=partial,
-                at_version=new_size, version=version, xattrs=xattrs)
+                at_version=new_size, version=version, xattrs=xattrs,
+                snapset_update=snapset_update)
             wr.pending_shards.add(shard)
             self.pg.send_to_osd(osd, msg)
         self.inflight_writes[tid] = wr
@@ -568,6 +576,8 @@ class ECBackend:
         if pg is not None and msg.version and not msg.is_push:
             from .pg_log import LogEntry, OP_MODIFY
             pg.append_log(LogEntry(msg.version, msg.oid, OP_MODIFY), t)
+        if pg is not None and msg.snapset_update is not None:
+            pg.apply_snapset_update(tuple(msg.snapset_update), t)
         store.queue_transaction(t)
         if pg is not None and not msg.partial:
             pg.data_received(msg.oid)

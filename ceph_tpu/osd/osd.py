@@ -235,6 +235,13 @@ class OSD(Dispatcher):
     # ---- shard sub-ops ----------------------------------------------------
     def _handle_sub_write(self, msg: MOSDECSubOpWrite) -> None:
         self.perf_counters.inc(L_OSD_SUBOP_W)
+        if msg.snapset_only:
+            pg = self.pgs.get(msg.pgid)
+            if pg is not None and msg.snapset_update is not None:
+                t = Transaction()
+                pg.apply_snapset_update(tuple(msg.snapset_update), t)
+                self.store.queue_transaction(t)
+            return
         if msg.at_version < 0:  # delete marker
             self._apply_delete(msg)
             return

@@ -47,7 +47,11 @@ from ..msg.kv import pack_kv, unpack_keys, unpack_kv
 from ..common.dout import dlog
 from ..os_store import Transaction, hobject_t
 from .ec_backend import ECBackend, SIZE_ATTR
-from .pg_log import LogEntry, OP_DELETE, OP_MODIFY, PGLog, PG_META_OID
+from .pg_log import (
+    LogEntry, OP_DELETE, OP_MODIFY, PGLog, PG_META_OID, SNAP_CLONE,
+    SNAP_TRIMMED, SNAP_WHITEOUT, encode_snapset, load_snapsets,
+    stage_snapset,
+)
 
 STATE_INITIAL = "initial"
 STATE_PEERING = "peering"
@@ -69,7 +73,8 @@ class ReplicatedBackend:
               full: bool = False, version: int = 0,
               xattrs: Optional[Dict[str, bytes]] = None,
               omap: Optional[Dict[str, bytes]] = None,
-              attr_only: bool = False) -> None:
+              attr_only: bool = False,
+              snapset_update: Optional[Tuple[str, bytes]] = None) -> None:
         from ..msg.messages import MOSDECSubOpWrite
         if attr_only:
             off, partial, new_size = 0, True, 0
@@ -89,7 +94,8 @@ class ReplicatedBackend:
                                    oid=oid, chunk=data, offset=off,
                                    partial=partial, at_version=new_size,
                                    version=version, xattrs=xattrs,
-                                   omap=omap, attr_only=attr_only)
+                                   omap=omap, attr_only=attr_only,
+                                   snapset_update=snapset_update)
             self.pg.send_to_osd(osd, msg)
 
     def apply_write(self, msg, store) -> None:
@@ -124,6 +130,8 @@ class ReplicatedBackend:
             if not msg.is_push:
                 self.pg.append_log(
                     LogEntry(msg.version, msg.oid, OP_MODIFY), t)
+        if msg.snapset_update is not None:
+            self.pg.apply_snapset_update(tuple(msg.snapset_update), t)
         store.queue_transaction(t)
         if not msg.partial:
             self.pg.data_received(msg.oid)
@@ -176,6 +184,10 @@ class PG:
         # but whose data has not (pg_missing_t role) — rebuilt from
         # log-vs-store on mount so restarts don't forget
         self.local_missing: Dict[str, Tuple[int, str]] = {}
+        # per-head snapset (clone bookkeeping) mirrored from the meta
+        # object on this shard — every replica has it (SnapSet role)
+        self.snapsets: Dict[str, List[Tuple[int, int]]] = \
+            load_snapsets(osd.store, self.meta_cid())
         self._rebuild_local_missing()
         # primary-side peering/recovery state
         self.peer_last_update: Dict[int, int] = {}
@@ -288,11 +300,22 @@ class PG:
     # ---- peering (GetInfo / GetLog / GetMissing / Activate) ----------------
     def advance_map(self, osdmap) -> None:
         from ..osdmap import pg_t
+        newpool = osdmap.get_pg_pool(self.pgid[0])
+        snaps_changed = False
+        if newpool is not None:
+            snaps_changed = (newpool.snap_seq != self.pool.snap_seq or
+                             newpool.removed_snaps !=
+                             self.pool.removed_snaps)
+            self.pool = newpool
         up, upp, acting, actp = osdmap.pg_to_up_acting_osds(
             pg_t(self.pgid[0], self.pgid[1]))
         changed = (acting != self.acting or actp != self.acting_primary)
         self.up, self.up_primary = up, upp
         self.acting, self.acting_primary = acting, actp
+        if snaps_changed:
+            # AFTER the acting update: trim must fan from the new
+            # epoch's primary to the new acting set
+            self._maybe_trim_snaps()
         if not (changed or self.state == STATE_INITIAL):
             return
         self.last_epoch_started = osdmap.epoch
@@ -334,7 +357,66 @@ class PG:
             last_update=self.pg_log.head, log_tail=self.pg_log.tail,
             log_entries=entries,
             missing_oids=[(o, v) for o, (v, _op)
-                          in self.local_missing.items()]), msg.src)
+                          in self.local_missing.items()],
+            snapsets=self._encoded_snapsets(),
+            held_shards=self.held_shards()), msg.src)
+
+    def held_shards(self) -> List[int]:
+        """EC shard positions whose collection holds data on THIS osd
+        (spg_t identity stand-in: the data, not the log, names the
+        shard)."""
+        if self.backend is None:
+            return []
+        store = self.osd.store
+        out = []
+        for shard in range(self.pool.size):
+            cid = f"{self.pgid[0]}.{self.pgid[1]}s{shard}"
+            if store.collection_exists(cid) and store.list_objects(cid):
+                out.append(shard)
+        return out
+
+    def _choose_acting(self) -> bool:
+        """EC choose_acting (PG::choose_acting + queue_want_pg_temp):
+        when CRUSH's remap put surviving shard data at the wrong
+        positions, ask the mon to pin pg_temp so every data-bearing OSD
+        serves the shard it actually holds; freed positions go to the
+        remaining acting members, which then backfill.  Returns True if
+        a pin was requested (activation waits for the new epoch)."""
+        if self.backend is None:
+            return False
+        holders: Dict[int, int] = {}
+        for slot, info in self._peer_infos.items():
+            osd = self.acting_shards().get(slot)
+            if osd is None:
+                continue
+            for h in info.held_shards:
+                holders.setdefault(h, osd)
+        acting_osds = [o for o in self.acting if o != CRUSH_ITEM_NONE]
+        misplaced = any(self.acting[s] != o for s, o in holders.items()
+                        if s < len(self.acting) and o in acting_osds)
+        if not misplaced:
+            return False
+        used: Set[int] = set()
+        temp: List[int] = [CRUSH_ITEM_NONE] * len(self.acting)
+        for s, o in holders.items():
+            if s < len(temp) and o in acting_osds and o not in used:
+                temp[s] = o
+                used.add(o)
+        spare = [o for o in acting_osds if o not in used]
+        for s in range(len(temp)):
+            if temp[s] == CRUSH_ITEM_NONE and spare:
+                temp[s] = spare.pop(0)
+        if temp == self.acting:
+            return False
+        dlog("pg", 3, f"pg {self.pgid} choose_acting: data holders "
+             f"{holders} vs acting {self.acting} -> pg_temp {temp}",
+             f"osd.{self.osd.osd_id}")
+        from ..msg.messages import MOSDPGTemp
+        for mon in self.osd.mon_names:
+            self.osd.messenger.send_message(MOSDPGTemp(
+                pgid=self.pgid, epoch=self.last_epoch_started,
+                temp=list(temp)), mon)
+        return True
 
     def handle_pg_info(self, msg: MOSDPGInfo) -> None:
         if not self.is_primary():
@@ -360,6 +442,10 @@ class PG:
             self._peering_all_infos()
 
     def _peering_all_infos(self) -> None:
+        if self._choose_acting():
+            # a pg_temp pin is on its way; the next epoch re-peers with
+            # the data-aligned acting set
+            return
         infos = self._peer_infos
         auth_shard, auth_lu = None, self.pg_log.head
         for shard, info in infos.items():
@@ -428,11 +514,24 @@ class PG:
         (now authoritative) log plus each replica's own reported missing
         set; ship peers the suffix they lack."""
         my_shard = self.my_shard()
+        for info in self._peer_infos.values():
+            self.merge_snapsets(info.snapsets)
         for oid, (v, op) in self.local_missing.items():
             self.missing.setdefault(my_shard, {}).setdefault(oid, (v, op))
         for shard, info in self._peer_infos.items():
             self.peer_last_update[shard] = info.last_update
             if shard == my_shard:
+                continue
+            if self.backend is not None and \
+                    shard not in info.held_shards and \
+                    self.pg_log.head > 0:
+                # the osd's log may be current (it held ANOTHER shard of
+                # this pg before the remap) but it has no data for THIS
+                # position: only a listing diff finds the debt
+                self._backfill_pending.add(shard)
+                self.send_to_osd(self.acting_shards()[shard], MOSDPGScan(
+                    pgid=self.pgid, shard=shard,
+                    epoch=self.peering_epoch))
                 continue
             delta = self.pg_log.missing_after(info.last_update)
             if delta is None:
@@ -454,7 +553,8 @@ class PG:
                 epoch=self.peering_epoch,
                 last_update=self.pg_log.head,
                 log_tail=self.pg_log.tail,
-                log_entries=[e.encode() for e in suffix]))
+                log_entries=[e.encode() for e in suffix],
+                snapsets=self._encoded_snapsets()))
         self.state = STATE_ACTIVE_RECOVERING if self._has_missing() \
             else STATE_ACTIVE
         if self.state == STATE_ACTIVE_RECOVERING or self._backfill_pending:
@@ -465,6 +565,7 @@ class PG:
         entries whose data has not arrived are recorded in local_missing
         (the head advances, the data debt does not vanish — pg_missing_t);
         delete entries apply immediately (reference merge_log)."""
+        self.merge_snapsets(msg.snapsets)
         entries = [LogEntry.decode(b) for b in msg.log_entries]
         if not entries:
             return
@@ -721,18 +822,202 @@ class PG:
         if msg.ops:
             self._do_op_vector(msg)
         elif msg.op == CEPH_OSD_OP_WRITEFULL:
-            self._do_write(msg)
+            self.with_clone(msg.oid, lambda: self._do_write(msg))
         elif msg.op in (CEPH_OSD_OP_WRITE, CEPH_OSD_OP_APPEND):
-            self._do_partial_write(msg)
+            self.with_clone(msg.oid,
+                            lambda: self._do_partial_write(msg))
         elif msg.op == CEPH_OSD_OP_READ:
             self._do_read(msg)
         elif msg.op == CEPH_OSD_OP_STAT:
             self._do_stat(msg)
         elif msg.op == CEPH_OSD_OP_DELETE:
-            self._do_delete(msg)
+            self.with_clone(msg.oid, lambda: self._do_delete(msg))
         else:
             self.osd.send_op_reply(msg.src,
                                    MOSDOpReply(tid=msg.tid, result=-95))
+
+    # ---- snapshots (PrimaryLogPG snapset/clone model, pool snaps) ----------
+    #
+    # Pool snaps only (rados mksnap).  On the first write after the
+    # pool's snap_seq advances, the primary clones the head's current
+    # state into an ordinary PG object named _clone_oid(oid, seq) (so
+    # recovery/scrub/backfill/durability cover clones for free) — or
+    # records a whiteout when the head did not exist.  The per-head
+    # snapset (sorted [(seq, kind)]) rides the shard write transactions
+    # into every replica's PG meta object.  A read at snap s resolves to
+    # the earliest entry with seq >= s (whiteout -> ENOENT; none -> head).
+
+    @staticmethod
+    def _clone_oid(oid: str, seq: int) -> str:
+        return f"{oid}\x00snap\x00{seq}"
+
+    @staticmethod
+    def is_clone_oid(oid: str) -> bool:
+        return "\x00snap\x00" in oid
+
+    def _snapset_max(self, oid: str) -> int:
+        ents = self.snapsets.get(oid)
+        return ents[-1][0] if ents else 0
+
+    def _clone_needed(self, oid: str) -> bool:
+        seq = self.pool.snap_seq
+        if seq == 0 or self.is_clone_oid(oid):
+            return False
+        m = self._snapset_max(oid)
+        if m >= seq:
+            return False
+        # a clone is only worth taking if a LIVE snap falls in the
+        # window it would cover — after every snap is removed, writes
+        # must not keep manufacturing instant garbage
+        return any(m < sid <= seq for sid in self.pool.snaps)
+
+    def with_clone(self, oid: str, proceed: Callable[[], None]) -> None:
+        """Run *proceed* after ensuring the pre-write state is cloned
+        (make_writeable's clone step, PrimaryLogPG.cc)."""
+        if not self._clone_needed(oid):
+            proceed()
+            return
+        if self.backend is not None:
+            self.backend.object_state(
+                oid, lambda res, data, _size, attrs:
+                self._clone_have_state(oid, res, data, attrs, proceed))
+        else:
+            exists, data, attrs, _omap = self.rep_backend.object_state(oid)
+            self._clone_have_state(oid, 0 if exists else -2, data, attrs,
+                                   proceed)
+
+    def _clone_have_state(self, oid: str, res: int, data: bytes,
+                          attrs: Dict[str, bytes],
+                          proceed: Callable[[], None]) -> None:
+        if res not in (0, -2):
+            # can't read the head (EIO): write anyway, skip the clone —
+            # losing a snapshot beats failing every write
+            dlog("pg", 1, f"snap clone of {oid} failed: {res}",
+                 f"osd.{self.osd.osd_id}")
+            proceed()
+            return
+        seq = self.pool.snap_seq
+        if self._snapset_max(oid) >= seq:   # raced with ourselves
+            proceed()
+            return
+        entries = list(self.snapsets.get(oid, []))
+        kind = SNAP_CLONE if res == 0 else SNAP_WHITEOUT
+        entries.append((seq, kind))
+        blob = encode_snapset(entries)
+        self.snapsets[oid] = entries
+        dlog("pg", 5, f"cloning {oid} @ seq {seq} "
+             f"({'clone' if kind else 'whiteout'})",
+             f"osd.{self.osd.osd_id}")
+        if kind == SNAP_CLONE:
+            cl = self._clone_oid(oid, seq)
+            if self.backend is not None:
+                self.backend.submit_transaction(
+                    cl, data, lambda _r: None, xattrs=attrs,
+                    snapset_update=(oid, blob))
+            else:
+                self.rep_backend.write(cl, data, full=True,
+                                       version=self.next_version(),
+                                       xattrs=attrs,
+                                       snapset_update=(oid, blob))
+        else:
+            self._fan_snapset(oid, blob)
+        proceed()
+
+    def _fan_snapset(self, oid: str, blob: bytes) -> None:
+        """Pure snapset-metadata fan-out (no object touched)."""
+        from ..msg.messages import MOSDECSubOpWrite
+        for shard, osd in self.acting_shards().items():
+            self.send_to_osd(osd, MOSDECSubOpWrite(
+                tid=0, pgid=self.pgid,
+                shard=shard if self.backend is not None else -1,
+                oid=oid, snapset_only=True, snapset_update=(oid, blob)))
+
+    def _encoded_snapsets(self) -> List[Tuple[str, bytes]]:
+        return [(oid, encode_snapset(ents))
+                for oid, ents in self.snapsets.items()]
+
+    def merge_snapsets(self, pairs: List[Tuple[str, bytes]]) -> None:
+        """Adopt peer snapsets that are ahead of ours (higher max clone
+        seq wins — seqs only grow, so the longer history is newer)."""
+        from .pg_log import decode_snapset
+        if not pairs:
+            return
+        t = Transaction()
+        changed = False
+        for oid, blob in pairs:
+            ents = decode_snapset(blob)
+            if not ents:
+                continue
+            mine = self.snapsets.get(oid, [])
+            if not mine or ents[-1][0] > mine[-1][0]:
+                if not self.osd.store.collection_exists(self.meta_cid()):
+                    t.create_collection(self.meta_cid())
+                stage_snapset(t, self.meta_cid(), oid, blob)
+                self.snapsets[oid] = ents
+                changed = True
+        if changed:
+            self.osd.store.queue_transaction(t)
+
+    def apply_snapset_update(self, upd: Tuple[str, bytes],
+                             t: Transaction) -> None:
+        """Shard-side: stage the snapset into the meta object and
+        mirror it in memory (every replica tracks snapsets)."""
+        from .pg_log import decode_snapset
+        oid, blob = upd
+        if not self.osd.store.collection_exists(self.meta_cid()):
+            t.create_collection(self.meta_cid())
+        stage_snapset(t, self.meta_cid(), oid, blob)
+        if blob:
+            self.snapsets[oid] = decode_snapset(blob)
+        else:
+            self.snapsets.pop(oid, None)
+
+    def resolve_snap(self, oid: str, snapid: int):
+        """-> (target_oid | None for ENOENT).  Earliest snapset entry
+        with seq >= snapid wins; none means the head is unchanged since
+        the snap and serves it."""
+        for seq, kind in self.snapsets.get(oid, []):
+            if seq >= snapid:
+                if kind == SNAP_TRIMMED:
+                    continue        # the covering state is gone
+                if kind == SNAP_WHITEOUT:
+                    return None
+                return self._clone_oid(oid, seq)
+        return oid
+
+    def _maybe_trim_snaps(self) -> None:
+        """Drop clones covering only removed snaps (snap trimmer role).
+        Entry (S, kind) covers pool snaps s with prev_S < s <= S; when no
+        live snap falls in that window the clone is garbage."""
+        if not self.is_primary():
+            return
+        live = set(self.pool.snaps)
+        for oid, entries in list(self.snapsets.items()):
+            keep = []
+            prev = 0
+            changed = False
+            trimmed_max = 0
+            for seq, kind in entries:
+                if kind == SNAP_TRIMMED:
+                    trimmed_max = max(trimmed_max, seq)
+                    changed = True      # re-emitted (possibly merged) below
+                elif any(prev < sid <= seq for sid in live):
+                    keep.append((seq, kind))
+                else:
+                    changed = True
+                    trimmed_max = max(trimmed_max, seq)
+                    if kind == SNAP_CLONE:
+                        dlog("pg", 5, f"trimming clone {oid}@{seq}",
+                             f"osd.{self.osd.osd_id}")
+                        self._fan_delete(self._clone_oid(oid, seq))
+                prev = seq
+            if changed:
+                # one tombstone at the max trimmed seq keeps a stale
+                # rejoining peer from resurrecting the dead entries
+                if trimmed_max:
+                    keep = sorted(keep + [(trimmed_max, SNAP_TRIMMED)])
+                self.snapsets[oid] = keep
+                self._fan_snapset(oid, encode_snapset(keep))
 
     # ---- multi-op vector interpreter (do_osd_ops) --------------------------
 
@@ -742,6 +1027,11 @@ class PG:
         CEPH_OSD_OP_READ, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_APPEND,
         CEPH_OSD_OP_TRUNCATE, CEPH_OSD_OP_ZERO, CEPH_OSD_OP_STAT,
         CEPH_OSD_OP_WRITEFULL,
+    ])
+
+    _READONLY_OPS = frozenset([
+        CEPH_OSD_OP_READ, CEPH_OSD_OP_STAT, CEPH_OSD_OP_GETXATTR,
+        CEPH_OSD_OP_GETXATTRS, CEPH_OSD_OP_OMAPGETVALS,
     ])
 
     def _do_op_vector(self, msg: MOSDOp) -> None:
@@ -756,6 +1046,20 @@ class PG:
         single-op writes on one object serialize (start_rmw's
         guarantee)."""
         oid = msg.oid
+        if msg.snapid:
+            # snap-targeted vectors are read-only views of the clone
+            if any(o.op not in self._READONLY_OPS for o in msg.ops):
+                self.osd.send_op_reply(msg.src, MOSDOpReply(
+                    tid=msg.tid, result=-30,     # EROFS
+                    epoch=self.osd.osdmap.epoch))
+                return
+            target = self.resolve_snap(oid, msg.snapid)
+            if target is None:
+                self.osd.send_op_reply(msg.src, MOSDOpReply(
+                    tid=msg.tid, result=-2,
+                    epoch=self.osd.osdmap.epoch))
+                return
+            oid = target
 
         def start() -> None:
             if self.backend is not None:
@@ -773,12 +1077,24 @@ class PG:
                     msg, 0 if exists else -2, data, attrs, omap)
                 self._commit_rep_vector(msg.oid, spec)
 
+        def gated() -> None:
+            mutates = any(o.op not in (CEPH_OSD_OP_READ, CEPH_OSD_OP_STAT,
+                                       CEPH_OSD_OP_GETXATTR,
+                                       CEPH_OSD_OP_GETXATTRS,
+                                       CEPH_OSD_OP_OMAPGETVALS,
+                                       CEPH_OSD_OP_CMPXATTR)
+                          for o in msg.ops)
+            if mutates:
+                self.with_clone(oid, start)
+            else:
+                start()
+
         degraded = (self.missing_shards_for(oid) if self.backend is not None
                     else (oid in self.local_missing))
         if degraded:
-            self.wait_for_recovery(oid, start)
+            self.wait_for_recovery(oid, gated)
         else:
-            start()
+            gated()
 
     def _run_op_vector(self, msg: MOSDOp, res: int, data: bytes,
                        attrs: Dict[str, bytes], omap: Dict[str, bytes]):
@@ -1058,6 +1374,17 @@ class PG:
             cb()
 
     def _do_read(self, msg: MOSDOp) -> None:
+        if msg.snapid:
+            target = self.resolve_snap(msg.oid, msg.snapid)
+            if target is None:
+                self.osd.send_op_reply(msg.src, MOSDOpReply(
+                    tid=msg.tid, result=-2,
+                    epoch=self.osd.osdmap.epoch))
+                return
+            if target != msg.oid:
+                import copy as _copy
+                msg = _copy.copy(msg)
+                msg.oid = target
         if self.backend is not None:
             src = msg.src
 

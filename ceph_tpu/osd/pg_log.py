@@ -24,6 +24,13 @@ OP_MODIFY = "m"
 OP_DELETE = "d"
 
 PG_META_OID = "_pgmeta"          # per-shard-collection meta object
+SNAPSET_KEY_PREFIX = "ss\x00"    # meta omap namespace for per-oid snapsets
+
+# snapset entry kinds (SnapSet clone bookkeeping, osd_types.h SnapSet)
+SNAP_CLONE = 1       # a clone object exists for this seq
+SNAP_WHITEOUT = 0    # object did not exist when this seq was crossed
+SNAP_TRIMMED = 2     # tombstone: entries up to this seq were trimmed —
+                     # keeps stale peers from resurrecting dead clones
 LAST_UPDATE_ATTR = "_last_update"
 LOG_TAIL_ATTR = "_log_tail"
 VERSION_ATTR = "_version"        # per-object: pg_log version of its data
@@ -124,7 +131,46 @@ class PGLog:
             self.tail = struct.unpack("<Q", attrs[LOG_TAIL_ATTR])[0]
         omap = store.omap_get(cid, meta)
         self.entries = sorted(
-            (LogEntry.decode(v) for v in omap.values()),
+            (LogEntry.decode(v) for k, v in omap.items()
+             if not k.startswith(SNAPSET_KEY_PREFIX)),
             key=lambda e: e.version)
         if self.entries:
             self.head = max(self.head, self.entries[-1].version)
+
+
+# ---- snapsets (per-head clone bookkeeping in the same meta object) ---------
+
+def encode_snapset(entries: List[Tuple[int, int]]) -> bytes:
+    """[(seq, kind)] sorted ascending -> packed bytes."""
+    return b"".join(struct.pack("<QB", s, k) for s, k in entries)
+
+
+def decode_snapset(blob: bytes) -> List[Tuple[int, int]]:
+    out = []
+    for off in range(0, len(blob), 9):
+        s, k = struct.unpack_from("<QB", blob, off)
+        out.append((s, k))
+    return out
+
+
+def stage_snapset(t: Transaction, cid: str, oid: str, blob: bytes) -> None:
+    """Stage a snapset write/removal into the meta object (same
+    transaction as the data mutation it accompanies)."""
+    meta = hobject_t(PG_META_OID)
+    t.touch(cid, meta)
+    key = SNAPSET_KEY_PREFIX + oid
+    if blob:
+        t.omap_setkeys(cid, meta, {key: blob})
+    else:
+        t.omap_rmkeys(cid, meta, [key])
+
+
+def load_snapsets(store: MemStore, cid: str) -> Dict[str, List[Tuple[int, int]]]:
+    meta = hobject_t(PG_META_OID)
+    if not store.collection_exists(cid) or not store.exists(cid, meta):
+        return {}
+    out = {}
+    for k, v in store.omap_get(cid, meta).items():
+        if k.startswith(SNAPSET_KEY_PREFIX):
+            out[k[len(SNAPSET_KEY_PREFIX):]] = decode_snapset(v)
+    return out

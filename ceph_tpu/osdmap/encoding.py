@@ -149,11 +149,19 @@ _POOL_FIELDS = ("type", "size", "min_size", "crush_rule", "object_hash",
 
 
 def pool_to_dict(p: pg_pool_t) -> Dict[str, Any]:
-    return {k: getattr(p, k) for k in _POOL_FIELDS}
+    d = {k: getattr(p, k) for k in _POOL_FIELDS}
+    d["snap_seq"] = p.snap_seq
+    d["snaps"] = {str(k): v for k, v in p.snaps.items()}
+    d["removed_snaps"] = list(p.removed_snaps)
+    return d
 
 
 def pool_from_dict(d: Dict[str, Any]) -> pg_pool_t:
-    return pg_pool_t(**{k: d[k] for k in _POOL_FIELDS})
+    p = pg_pool_t(**{k: d[k] for k in _POOL_FIELDS})
+    p.snap_seq = int(d.get("snap_seq", 0))
+    p.snaps = {int(k): v for k, v in d.get("snaps", {}).items()}
+    p.removed_snaps = [int(x) for x in d.get("removed_snaps", [])]
+    return p
 
 
 def _pgid_key(pg: pg_t) -> str:

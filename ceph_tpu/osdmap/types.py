@@ -53,6 +53,11 @@ class pg_pool_t:
     last_change: int = 0
     erasure_code_profile: str = ""
     stripe_width: int = 0
+    # pool snapshots (pg_pool_t snaps/snap_seq, osd_types.h): snap id ->
+    # name; removed ids accumulate so PGs can trim clones
+    snap_seq: int = 0
+    snaps: Dict[int, str] = field(default_factory=dict)
+    removed_snaps: List[int] = field(default_factory=list)
     pg_num_mask: int = field(default=0, repr=False)
     pgp_num_mask: int = field(default=0, repr=False)
 

@@ -1,0 +1,219 @@
+"""Pool snapshots: clone-on-first-write, read-at-snap, rollback, trim.
+
+PrimaryLogPG's snapset/clone model (make_writeable's clone step) scoped
+to pool snaps: a write after mksnap clones the pre-write state into an
+ordinary PG object; reads at a snap resolve through the snapset.
+"""
+import pytest
+
+from ceph_tpu.client import ObjectOperation
+from ceph_tpu.cluster import MiniCluster
+
+
+def make(fixture):
+    if fixture == "ec":
+        c = MiniCluster(n_osds=6)
+        c.create_ec_pool("sp", k=2, m=1, plugin="isa", pg_num=8)
+    else:
+        c = MiniCluster(n_osds=4)
+        c.create_replicated_pool("sp", size=3, pg_num=8)
+    return c, c.client("client.s")
+
+
+@pytest.mark.parametrize("fixture", ["ec", "rep"])
+def test_snap_read_and_head(fixture):
+    c, cl = make(fixture)
+    cl.write_full("sp", "o", b"version-one")
+    cl.snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"version-two-longer")
+    assert cl.read("sp", "o") == b"version-two-longer"
+    assert cl.read("sp", "o", snap="s1") == b"version-one"
+    # a second write after the same snap must NOT re-clone
+    cl.write_full("sp", "o", b"version-three")
+    assert cl.read("sp", "o", snap="s1") == b"version-one"
+    assert cl.read("sp", "o") == b"version-three"
+
+
+@pytest.mark.parametrize("fixture", ["ec", "rep"])
+def test_multiple_snaps_layered(fixture):
+    c, cl = make(fixture)
+    cl.write_full("sp", "o", b"v1")
+    cl.snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"v2")
+    cl.snap_create("sp", "s2")
+    cl.write_full("sp", "o", b"v3")
+    assert cl.read("sp", "o", snap="s1") == b"v1"
+    assert cl.read("sp", "o", snap="s2") == b"v2"
+    assert cl.read("sp", "o") == b"v3"
+    # unmodified-since-snap object serves its head at the snap
+    cl.write_full("sp", "calm", b"steady")
+    cl.snap_create("sp", "s3")
+    assert cl.read("sp", "calm", snap="s3") == b"steady"
+
+
+@pytest.mark.parametrize("fixture", ["ec", "rep"])
+def test_object_created_after_snap_is_absent_at_snap(fixture):
+    c, cl = make(fixture)
+    cl.snap_create("sp", "early")
+    cl.write_full("sp", "late", b"newcomer")
+    with pytest.raises(IOError):
+        cl.read("sp", "late", snap="early")
+    assert cl.read("sp", "late") == b"newcomer"
+
+
+@pytest.mark.parametrize("fixture", ["ec", "rep"])
+def test_delete_after_snap_preserves_snap_view(fixture):
+    c, cl = make(fixture)
+    cl.write_full("sp", "doomed", b"precious")
+    cl.snap_create("sp", "keep")
+    assert cl.remove("sp", "doomed") == 0
+    with pytest.raises(IOError):
+        cl.read("sp", "doomed")
+    assert cl.read("sp", "doomed", snap="keep") == b"precious"
+
+
+@pytest.mark.parametrize("fixture", ["ec", "rep"])
+def test_partial_write_and_vector_trigger_clone(fixture):
+    c, cl = make(fixture)
+    cl.write_full("sp", "o", b"A" * 100)
+    cl.snap_create("sp", "s1")
+    # rmw offset write must clone first
+    cl.write("sp", "o", b"BBB", offset=10)
+    assert cl.read("sp", "o", snap="s1") == b"A" * 100
+    assert cl.read("sp", "o")[10:13] == b"BBB"
+    cl.snap_create("sp", "s2")
+    # vector write must clone too
+    r, _ = cl.operate("sp", "o", ObjectOperation()
+                      .write_full(b"C" * 50).set_xattr("t", b"1"))
+    assert r == 0
+    at_s2 = cl.read("sp", "o", snap="s2")
+    assert at_s2[10:13] == b"BBB" and len(at_s2) == 100
+    assert cl.read("sp", "o") == b"C" * 50
+
+
+@pytest.mark.parametrize("fixture", ["ec", "rep"])
+def test_rollback(fixture):
+    c, cl = make(fixture)
+    cl.write_full("sp", "o", b"golden")
+    cl.snap_create("sp", "g")
+    cl.write_full("sp", "o", b"corrupted")
+    assert cl.rollback("sp", "o", "g") == 0
+    assert cl.read("sp", "o") == b"golden"
+
+
+@pytest.mark.parametrize("fixture", ["ec", "rep"])
+def test_snap_rm_trims_clones(fixture):
+    c, cl = make(fixture)
+    cl.write_full("sp", "o", b"v1")
+    cl.snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"v2")
+    assert cl.read("sp", "o", snap="s1") == b"v1"
+
+    def clone_count():
+        n = 0
+        for osd in c.osds.values():
+            for cid in osd.store.list_collections():
+                for ho in osd.store.list_objects(cid):
+                    if "\x00snap\x00" in ho.oid:
+                        n += 1
+        return n
+
+    assert clone_count() > 0
+    cl.snap_remove("sp", "s1")
+    c.network.pump()
+    assert clone_count() == 0
+    assert cl.read("sp", "o") == b"v2"
+
+
+def test_snapshots_survive_checkpoint_restore(tmp_path):
+    c, cl = make("ec")
+    cl.write_full("sp", "o", b"old-state")
+    cl.snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"new-state")
+    c.checkpoint(str(tmp_path / "ckpt"))
+    c2 = MiniCluster.restore(str(tmp_path / "ckpt"))
+    cl2 = c2.client("client.r")
+    assert cl2.read("sp", "o") == b"new-state"
+    assert cl2.read("sp", "o", snap="s1") == b"old-state"
+
+
+def test_snapshots_survive_failure_and_recovery():
+    c, cl = make("ec")
+    cl.write_full("sp", "o", b"pre-snap")
+    cl.snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"post-snap")
+    _pg, victim = cl._calc_target(cl.lookup_pool("sp"), "o")
+    c.kill_osd(victim)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    c.mark_osd_out(victim)
+    c.run_recovery()
+    c.network.pump()
+    c.run_recovery()
+    c.network.pump()
+    assert cl.read("sp", "o") == b"post-snap"
+    assert cl.read("sp", "o", snap="s1") == b"pre-snap"
+
+
+@pytest.mark.parametrize("fixture", ["ec", "rep"])
+def test_rollback_restores_xattrs_and_guards_errors(fixture):
+    c, cl = make(fixture)
+    cl.write_full("sp", "o", b"golden")
+    cl.setxattr("sp", "o", "tag", b"v1")
+    cl.snap_create("sp", "g")
+    cl.write_full("sp", "o", b"corrupted")
+    cl.setxattr("sp", "o", "tag", b"v2")
+    cl.setxattr("sp", "o", "extra", b"junk")
+    assert cl.rollback("sp", "o", "g") == 0
+    assert cl.read("sp", "o") == b"golden"
+    assert cl.getxattrs("sp", "o") == {"tag": b"v1"}
+    # snap-targeted vectors are read-only
+    from ceph_tpu.client import ObjectOperation
+    r, _ = cl.operate("sp", "o", ObjectOperation().write_full(b"x"),
+                      snap="g")
+    assert r == -30                       # EROFS
+
+
+@pytest.mark.parametrize("fixture", ["ec", "rep"])
+def test_no_clone_after_all_snaps_removed(fixture):
+    c, cl = make(fixture)
+    cl.write_full("sp", "o", b"v1")
+    cl.snap_create("sp", "s1")
+    cl.snap_remove("sp", "s1")
+    c.network.pump()
+    cl.write_full("sp", "o", b"v2")       # must NOT clone
+    clones = sum(1 for o in c.osds.values()
+                 for cid in o.store.list_collections()
+                 for ho in o.store.list_objects(cid)
+                 if "\x00snap\x00" in ho.oid)
+    assert clones == 0
+
+
+def test_stale_peer_cannot_resurrect_trimmed_snapset():
+    c, cl = make("ec")
+    cl.write_full("sp", "o", b"v1")
+    cl.snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"v2")
+    # take one replica down, trim while it is away
+    pid = cl.lookup_pool("sp")
+    pgid, primary = cl._calc_target(pid, "o")
+    away = next(o for o in c.osds if o != primary
+                and c.osds[o].pgs.get(pgid) is not None)
+    c.kill_osd(away)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    cl.snap_remove("sp", "s1")
+    c.network.pump()
+    # rejoin: peering must NOT re-adopt the dead snapset
+    c.revive_osd(away)
+    for _ in range(4):
+        c.tick(dt=6.0)
+    c.run_recovery()
+    c.network.pump()
+    for o in c.osds.values():
+        pg = o.pgs.get(pgid)
+        if pg is not None and pg.is_primary():
+            ents = pg.snapsets.get("o", [])
+            from ceph_tpu.osd.pg_log import SNAP_CLONE
+            assert not any(k == SNAP_CLONE for _s, k in ents), ents
+    assert cl.read("sp", "o") == b"v2"
