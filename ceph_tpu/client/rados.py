@@ -135,18 +135,34 @@ class RadosClient(Dispatcher):
         self.osdmap = OSDMap()
         self._tid = 0
         self._replies: Dict[int, MOSDOpReply] = {}
+        self._watches: Dict[int, object] = {}   # cookie -> callback
+        self._next_cookie = 1
         mon.subscribe(name)
         mon.send_full_map(name)
         network.pump()
 
     # ---- dispatch ---------------------------------------------------------
     def ms_fast_dispatch(self, msg: Message) -> None:
+        from ..msg.messages import MWatchNotify
         if isinstance(msg, MOSDMap):
             for inc in msg.incrementals:
                 if inc.epoch == self.osdmap.epoch + 1:
                     self.osdmap.apply_incremental(inc)
         elif isinstance(msg, MOSDOpReply):
             self._replies[msg.tid] = msg
+        elif isinstance(msg, MWatchNotify) and \
+                msg.op == MWatchNotify.NOTIFY:
+            cb = self._watches.get(msg.cookie)
+            reply = b""
+            if cb is not None:
+                try:
+                    reply = cb(msg.notify_id, msg.payload) or b""
+                except Exception:
+                    reply = b""
+            self.messenger.send_message(MWatchNotify(
+                op=MWatchNotify.ACK, pgid=msg.pgid, oid=msg.oid,
+                cookie=msg.cookie, notify_id=msg.notify_id,
+                payload=bytes(reply)), msg.src)
 
     # ---- Objecter-lite ----------------------------------------------------
     def _calc_target(self, pool_id: int, oid: str):
@@ -346,3 +362,36 @@ class RadosClient(Dispatcher):
         r, _ = self.operate(pool, oid,
                             ObjectOperation().omap_rm_keys(keys))
         return r
+
+    # ---- watch / notify (rados_watch / rados_notify) -----------------------
+    def watch(self, pool: str, oid: str, callback) -> int:
+        """Register *callback(notify_id, payload) -> reply_bytes* for
+        notifies on the object; returns the watch cookie."""
+        from ..msg.messages import CEPH_OSD_OP_WATCH
+        cookie = self._next_cookie
+        self._next_cookie += 1
+        self._watches[cookie] = callback
+        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_WATCH,
+                         offset=cookie)
+        if r.result < 0:
+            del self._watches[cookie]
+            raise IOError(f"watch {oid}: {r.result}")
+        return cookie
+
+    def unwatch(self, pool: str, oid: str, cookie: int) -> int:
+        from ..msg.messages import CEPH_OSD_OP_UNWATCH
+        self._watches.pop(cookie, None)
+        return self._submit(self.lookup_pool(pool), oid,
+                            CEPH_OSD_OP_UNWATCH, offset=cookie).result
+
+    def notify(self, pool: str, oid: str, payload: bytes = b"",
+               timeout: int = 30) -> Dict[str, bytes]:
+        """Broadcast to the object's watchers; returns
+        {"client:cookie": reply_payload} once every live watcher acked
+        (rados_notify2 semantics)."""
+        from ..msg.messages import CEPH_OSD_OP_NOTIFY
+        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_NOTIFY,
+                         data=bytes(payload), length=timeout)
+        if r.result < 0:
+            raise IOError(f"notify {oid}: {r.result}")
+        return _unpack_kv(r.data)

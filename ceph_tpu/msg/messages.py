@@ -48,6 +48,9 @@ CEPH_OSD_OP_CMPXATTR = "cmpxattr"    # guard; flags = comparison operator
 CEPH_OSD_OP_OMAPSETKEYS = "omap_setkeys"   # replicated pools only
 CEPH_OSD_OP_OMAPRMKEYS = "omap_rmkeys"
 CEPH_OSD_OP_OMAPGETVALS = "omap_getvals"
+CEPH_OSD_OP_WATCH = "watch"          # register interest (cookie in offset)
+CEPH_OSD_OP_UNWATCH = "unwatch"
+CEPH_OSD_OP_NOTIFY = "notify"        # broadcast to watchers, await acks
 
 # cmpxattr comparison operators (include/rados.h CEPH_OSD_CMPXATTR_OP_*)
 CEPH_OSD_CMPXATTR_OP_EQ = 1
@@ -213,6 +216,22 @@ class MOSDPGScanReply(Message):
     epoch: int = 0
     objects: List[Tuple[str, int]] = field(default_factory=list)
     # (oid, version) per object on the shard
+
+
+@dataclass
+class MWatchNotify(Message):
+    """Watch/notify events (src/messages/MWatchNotify.h): the primary
+    fans NOTIFY to every watcher's client; watchers reply NOTIFY_ACK;
+    the primary completes the notifier once every live watcher acked
+    (Watch.cc / PrimaryLogPG::do_osd_op_effects roles)."""
+    NOTIFY = "notify"
+    ACK = "notify_ack"
+    op: str = NOTIFY
+    pgid: Tuple[int, int] = (0, 0)
+    oid: str = ""
+    cookie: int = 0
+    notify_id: int = 0
+    payload: bytes = b""
 
 
 @dataclass

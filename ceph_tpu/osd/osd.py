@@ -163,6 +163,13 @@ class OSD(Dispatcher):
                 pg.handle_rep_scrub_map(msg)
         elif isinstance(msg, MOSDPing):
             self._handle_ping(msg)
+        else:
+            from ..msg.messages import MWatchNotify
+            if isinstance(msg, MWatchNotify) and \
+                    msg.op == MWatchNotify.ACK:
+                pg = self.pgs.get(msg.pgid)
+                if pg is not None:
+                    pg.handle_notify_ack(msg)
 
     def reply_to(self, msg: Message, reply: Message) -> None:
         self.messenger.send_message(reply, msg.src)
@@ -321,6 +328,11 @@ class OSD(Dispatcher):
                 MOSDPing(op=MOSDPing.PING, stamp=now,
                          epoch=self.osdmap.epoch), f"osd.{peer}")
         self.maybe_schedule_scrubs()
+        for pg in self.pgs.values():
+            if pg._notifies:
+                pg.sweep_notifies()
+            pg.retry_pending_pg_temp()
+            pg.maybe_realign()
         for peer in peers:
             last = self.last_ping_reply.get(peer, now)
             self.last_ping_reply.setdefault(peer, now)

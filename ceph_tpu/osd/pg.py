@@ -188,6 +188,12 @@ class PG:
         # object on this shard — every replica has it (SnapSet role)
         self.snapsets: Dict[str, List[Tuple[int, int]]] = \
             load_snapsets(osd.store, self.meta_cid())
+        # watch/notify: primary-side in-memory state (Watch.cc role;
+        # watchers re-register after a primary change, like clients do
+        # on watch timeout in the reference)
+        self.watchers: Dict[str, Dict[Tuple[str, int], float]] = {}
+        self._notifies: Dict[int, Dict] = {}
+        self._notify_seq = 0
         self._rebuild_local_missing()
         # primary-side peering/recovery state
         self.peer_last_update: Dict[int, int] = {}
@@ -403,6 +409,9 @@ class PG:
                 temp[s] = o
                 used.add(o)
         spare = [o for o in acting_osds if o not in used]
+        spare += [o for o in self.up
+                  if o != CRUSH_ITEM_NONE and o not in used
+                  and o not in spare and o not in acting_osds]
         for s in range(len(temp)):
             if temp[s] == CRUSH_ITEM_NONE and spare:
                 temp[s] = spare.pop(0)
@@ -411,12 +420,88 @@ class PG:
         dlog("pg", 3, f"pg {self.pgid} choose_acting: data holders "
              f"{holders} vs acting {self.acting} -> pg_temp {temp}",
              f"osd.{self.osd.osd_id}")
+        self._request_pg_temp(temp)
+        return True
+
+    def _request_pg_temp(self, temp: List[int]) -> None:
+        """Send (and keep re-sending from the tick until an epoch
+        carrying it arrives — the request can be dropped or hit a mon
+        mid-election) the pg_temp pin/clear."""
         from ..msg.messages import MOSDPGTemp
+        self._pending_pg_temp = list(temp)
         for mon in self.osd.mon_names:
             self.osd.messenger.send_message(MOSDPGTemp(
                 pgid=self.pgid, epoch=self.last_epoch_started,
                 temp=list(temp)), mon)
-        return True
+
+    def retry_pending_pg_temp(self) -> None:
+        want = getattr(self, "_pending_pg_temp", None)
+        if want is None:
+            return
+        from ..osdmap import pg_t
+        cur = self.osd.osdmap.pg_temp.get(
+            pg_t(self.pgid[0], self.pgid[1]), [])
+        if list(cur) == want or (not want and not cur):
+            self._pending_pg_temp = None
+            return
+        self._request_pg_temp(want)
+
+    def maybe_realign(self) -> None:
+        """Clean + pinned: move each shard to its CRUSH-up position
+        (decode + push to the up member), then clear the pin — the
+        reference's backfill-to-up that lets pg_temp be temporary."""
+        if self.backend is None or not self.is_primary():
+            return
+        if self.state != STATE_ACTIVE or self._has_missing() \
+                or self._backfill_pending:
+            return
+        from ..osdmap import pg_t
+        if pg_t(self.pgid[0], self.pgid[1]) not in self.osd.osdmap.pg_temp:
+            return
+        if getattr(self, "_realigning", False):
+            return
+        moves = [s for s in range(len(self.up))
+                 if s < len(self.acting)
+                 and self.up[s] != CRUSH_ITEM_NONE
+                 and self.up[s] != self.acting[s]]
+        objects = sorted(self._authoritative_objects())
+        if not moves or not objects:
+            self._request_pg_temp([])
+            return
+        self._realigning = True
+        dlog("pg", 3, f"pg {self.pgid} realign to up {self.up} "
+             f"(moves {moves}, {len(objects)} objects)",
+             f"osd.{self.osd.osd_id}")
+        state = {"left": len(objects), "failed": False}
+
+        def done_obj(ok: bool) -> None:
+            state["left"] -= 1
+            state["failed"] |= not ok
+            if state["left"] == 0:
+                self._realigning = False
+                if not state["failed"]:
+                    self._request_pg_temp([])   # next epoch: acting = up
+
+        from ..msg.messages import MOSDECSubOpWrite
+        be = self.backend
+
+        def start_obj(oid: str) -> None:
+            def on_chunks(res, chunks, size, attrs):
+                if res != 0:
+                    done_obj(False)
+                    return
+                rec = be.recover_object(oid, set(moves), chunks, size)
+                for s_ in moves:
+                    self.send_to_osd(self.up[s_], MOSDECSubOpWrite(
+                        tid=0, pgid=self.pgid, shard=s_, oid=oid,
+                        chunk=rec[s_], offset=0, partial=False,
+                        at_version=size, is_push=True,
+                        xattrs=attrs))
+                done_obj(True)
+            be.read_chunks(oid, on_chunks)
+
+        for oid in objects:
+            start_obj(oid)
 
     def handle_pg_info(self, msg: MOSDPGInfo) -> None:
         if not self.is_primary():
@@ -819,7 +904,16 @@ class PG:
                 tid=msg.tid, result=-11,  # EAGAIN: wrong primary / not ready
                 epoch=self.osd.osdmap.epoch))
             return
-        if msg.ops:
+        from ..msg.messages import (
+            CEPH_OSD_OP_NOTIFY, CEPH_OSD_OP_UNWATCH, CEPH_OSD_OP_WATCH,
+        )
+        if msg.op == CEPH_OSD_OP_WATCH and not msg.ops:
+            self._do_watch(msg)
+        elif msg.op == CEPH_OSD_OP_UNWATCH and not msg.ops:
+            self._do_unwatch(msg)
+        elif msg.op == CEPH_OSD_OP_NOTIFY and not msg.ops:
+            self._do_notify(msg)
+        elif msg.ops:
             self._do_op_vector(msg)
         elif msg.op == CEPH_OSD_OP_WRITEFULL:
             self.with_clone(msg.oid, lambda: self._do_write(msg))
@@ -835,6 +929,77 @@ class PG:
         else:
             self.osd.send_op_reply(msg.src,
                                    MOSDOpReply(tid=msg.tid, result=-95))
+
+    # ---- watch / notify (Watch.cc + do_osd_op_effects, scoped) -------------
+    def _do_watch(self, msg: MOSDOp) -> None:
+        """Register (client, cookie) as a watcher of the object; the
+        cookie rides msg.offset (librados rados_watch)."""
+        self.watchers.setdefault(msg.oid, {})[(msg.src, msg.offset)] = \
+            self.osd.now
+        dlog("osd", 10, f"watch {msg.oid} by {msg.src} "
+             f"cookie {msg.offset}", f"osd.{self.osd.osd_id}")
+        self.osd.send_op_reply(msg.src, MOSDOpReply(
+            tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))
+
+    def _do_unwatch(self, msg: MOSDOp) -> None:
+        ws = self.watchers.get(msg.oid, {})
+        ws.pop((msg.src, msg.offset), None)
+        self.osd.send_op_reply(msg.src, MOSDOpReply(
+            tid=msg.tid, result=0, epoch=self.osd.osdmap.epoch))
+
+    def _do_notify(self, msg: MOSDOp) -> None:
+        """Broadcast to every live watcher; complete the notifier when
+        all acks arrive (or the timeout sweep gives up on the dead)."""
+        from ..msg.messages import MWatchNotify
+        self._notify_seq += 1
+        nid = self._notify_seq
+        live = {}
+        down = self.osd.network.down
+        for (client, cookie), since in self.watchers.get(msg.oid,
+                                                         {}).items():
+            if client not in down and client != msg.src:
+                live[(client, cookie)] = since
+            elif client == msg.src:
+                # the notifier's own watch acks implicitly (librados
+                # does not deliver a notify to its own handle)
+                pass
+        st = {"src": msg.src, "tid": msg.tid, "oid": msg.oid,
+              "pending": set(live), "replies": {},
+              "deadline": self.osd.now + (msg.length or 30)}
+        if not live:
+            self._notify_complete(nid, st)
+            return
+        self._notifies[nid] = st
+        for (client, cookie) in live:
+            self.osd.messenger.send_message(MWatchNotify(
+                op=MWatchNotify.NOTIFY, pgid=self.pgid, oid=msg.oid,
+                cookie=cookie, notify_id=nid, payload=msg.data), client)
+
+    def handle_notify_ack(self, msg) -> None:
+        st = self._notifies.get(msg.notify_id)
+        if st is None:
+            return
+        st["pending"].discard((msg.src, msg.cookie))
+        st["replies"][f"{msg.src}:{msg.cookie}"] = msg.payload
+        if not st["pending"]:
+            self._notify_complete(msg.notify_id, st)
+
+    def _notify_complete(self, nid: int, st: Dict,
+                         result: int = 0) -> None:
+        self._notifies.pop(nid, None)
+        self.osd.send_op_reply(st["src"], MOSDOpReply(
+            tid=st["tid"], result=result, data=pack_kv(st["replies"]),
+            epoch=self.osd.osdmap.epoch))
+
+    def sweep_notifies(self) -> None:
+        """Tick-driven timeout: notifies whose remaining watchers went
+        silent complete with ETIMEDOUT + the partial replies (the
+        reference reports the timed-out watchers, never fake success)."""
+        for nid, st in list(self._notifies.items()):
+            if self.osd.now >= st["deadline"]:
+                dlog("osd", 5, f"notify {nid} timed out waiting for "
+                     f"{st['pending']}", f"osd.{self.osd.osd_id}")
+                self._notify_complete(nid, st, result=-110)
 
     # ---- snapshots (PrimaryLogPG snapset/clone model, pool snaps) ----------
     #
@@ -880,14 +1045,15 @@ class PG:
         if self.backend is not None:
             self.backend.object_state(
                 oid, lambda res, data, _size, attrs:
-                self._clone_have_state(oid, res, data, attrs, proceed))
+                self._clone_have_state(oid, res, data, attrs, {}, proceed))
         else:
-            exists, data, attrs, _omap = self.rep_backend.object_state(oid)
+            exists, data, attrs, omap = self.rep_backend.object_state(oid)
             self._clone_have_state(oid, 0 if exists else -2, data, attrs,
-                                   proceed)
+                                   omap, proceed)
 
     def _clone_have_state(self, oid: str, res: int, data: bytes,
                           attrs: Dict[str, bytes],
+                          omap: Dict[str, bytes],
                           proceed: Callable[[], None]) -> None:
         if res not in (0, -2):
             # can't read the head (EIO): write anyway, skip the clone —
@@ -917,7 +1083,7 @@ class PG:
             else:
                 self.rep_backend.write(cl, data, full=True,
                                        version=self.next_version(),
-                                       xattrs=attrs,
+                                       xattrs=attrs, omap=omap,
                                        snapset_update=(oid, blob))
         else:
             self._fan_snapset(oid, blob)
@@ -944,12 +1110,19 @@ class PG:
             return
         t = Transaction()
         changed = False
+
+        def rank(entries):
+            # trimmed beats clone/whiteout at the same seq, so a trim
+            # tombstone always propagates over the entries it killed
+            return (entries[-1][0],
+                    1 if entries[-1][1] == SNAP_TRIMMED else 0)
+
         for oid, blob in pairs:
             ents = decode_snapset(blob)
             if not ents:
                 continue
             mine = self.snapsets.get(oid, [])
-            if not mine or ents[-1][0] > mine[-1][0]:
+            if not mine or rank(ents) > rank(mine):
                 if not self.osd.store.collection_exists(self.meta_cid()):
                     t.create_collection(self.meta_cid())
                 stage_snapset(t, self.meta_cid(), oid, blob)
@@ -1032,6 +1205,7 @@ class PG:
     _READONLY_OPS = frozenset([
         CEPH_OSD_OP_READ, CEPH_OSD_OP_STAT, CEPH_OSD_OP_GETXATTR,
         CEPH_OSD_OP_GETXATTRS, CEPH_OSD_OP_OMAPGETVALS,
+        CEPH_OSD_OP_CMPXATTR,
     ])
 
     def _do_op_vector(self, msg: MOSDOp) -> None:
@@ -1078,11 +1252,7 @@ class PG:
                 self._commit_rep_vector(msg.oid, spec)
 
         def gated() -> None:
-            mutates = any(o.op not in (CEPH_OSD_OP_READ, CEPH_OSD_OP_STAT,
-                                       CEPH_OSD_OP_GETXATTR,
-                                       CEPH_OSD_OP_GETXATTRS,
-                                       CEPH_OSD_OP_OMAPGETVALS,
-                                       CEPH_OSD_OP_CMPXATTR)
+            mutates = any(o.op not in self._READONLY_OPS
                           for o in msg.ops)
             if mutates:
                 self.with_clone(oid, start)

@@ -17,8 +17,9 @@ cluster-wide reductions (chunk checksums, placement histograms) are psums.
 from .mesh import make_mesh, mesh_shape_for
 from .ec import ShardedRS
 from .step import pipeline_step, example_pipeline_args
+from .crush import ShardedFastRule, sharded_fast_rule
 
 __all__ = [
     "make_mesh", "mesh_shape_for", "ShardedRS",
-    "pipeline_step", "example_pipeline_args",
+    "pipeline_step", "ShardedFastRule", "sharded_fast_rule", "example_pipeline_args",
 ]

@@ -1,0 +1,97 @@
+"""Multi-chip CRUSH: the whole-cluster remap sharded over a device mesh.
+
+The single-chip fast path (ops/crush_fast.py) resolves every PG in one
+kernel call.  At larger scale (millions of PGs, whole-map remaps every
+epoch) the PG axis shards across chips exactly like stripes do for EC:
+candidate tables are computed and cached per device slice, each epoch's
+resolve runs fully parallel with NO cross-chip traffic — placement is
+embarrassingly parallel per PG, the ideal ICI workload is the one that
+never uses ICI — and only the compacted (X, result_max+1) output
+gathers back.  This is OSDMapMapping's ParallelPGMapper
+(osd/OSDMapMapping.h:17) with chips in place of CPU worker threads.
+
+GSPMD does the partitioning: the xs / weight inputs carry NamedShardings
+and XLA propagates them through the candidate and resolve kernels, so
+the very same jitted programs serve one chip or a whole mesh.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..crush.types import CrushMap
+from ..ops.crush_fast import FastRule, compile_fast_rule
+from .mesh import SHARD_AXIS, STRIPE_AXIS
+
+
+class ShardedFastRule:
+    """A FastRule whose PG axis is sharded over every device of *mesh*."""
+
+    def __init__(self, m: CrushMap, ruleno: int, result_max: int,
+                 mesh: Mesh, **kw):
+        self.fr: FastRule = compile_fast_rule(m, ruleno, result_max, **kw)
+        self.mesh = mesh
+        self.n_devices = int(np.prod(mesh.devices.shape))
+        self._xs_sharding = NamedSharding(mesh, P((STRIPE_AXIS, SHARD_AXIS)))
+        self._rep_sharding = NamedSharding(mesh, P())
+        self._cand = None
+        self._cand_x = None
+        self._cand_key: Optional[bytes] = None
+
+    @property
+    def result_max(self) -> int:
+        return self.fr.result_max
+
+    @property
+    def residual_fraction(self) -> float:
+        return self.fr.residual_fraction
+
+    def prepare_candidates(self, xs_padded: np.ndarray) -> None:
+        key = hashlib.sha1(xs_padded.tobytes()).digest()
+        if self._cand_key == key:
+            return
+        xd = jax.device_put(xs_padded, self._xs_sharding)
+        self._cand = jax.block_until_ready(self.fr._cand_jit(xd))
+        self._cand_x = xd
+        self._cand_key = key
+
+    def resolve_device(self, weight) -> jnp.ndarray:
+        """Sharded packed resolve (see FastRule._resolve_packed); the
+        per-epoch device call — weights replicate, PGs stay put."""
+        if self._cand is None:
+            raise RuntimeError("no candidate tables; call "
+                               "prepare_candidates(xs) first")
+        wd = jax.device_put(np.asarray(weight, dtype=np.uint32),
+                            self._rep_sharding)
+        return self.fr._packed_jit(*self._cand, self._cand_x, wd)
+
+    def map_batch(self, xs: np.ndarray, weight: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact whole-map mapping, PGs sharded across the mesh."""
+        xs = np.asarray(xs, dtype=np.uint32)
+        X = xs.shape[0]
+        pad = (-X) % self.n_devices
+        xs_p = np.concatenate([xs, np.repeat(xs[:1], pad)]) if pad else xs
+        self.prepare_candidates(xs_p)
+        R = self.fr.result_max
+        packed = self.resolve_device(weight)
+        full = np.asarray(packed)[:X]
+        out = full[:, :R].copy()
+        counts = (full[:, R] & 0xFFFF).astype(np.int32)
+        residual = (full[:, R] >> 16) != 0
+        self.fr._residual_frac = float(residual.mean())
+        self.fr._replay_exact(np.nonzero(residual)[0], xs,
+                              np.asarray(weight, dtype=np.uint32),
+                              out, counts)
+        return out, counts
+
+
+def sharded_fast_rule(m: CrushMap, ruleno: int, result_max: int,
+                      mesh: Mesh, **kw) -> ShardedFastRule:
+    return ShardedFastRule(m, ruleno, result_max, mesh, **kw)

@@ -73,3 +73,33 @@ def test_ec_position_shuffle_recovers_via_pg_temp():
     # overwrite still works under the pinned acting set
     cl.write_full("pt", oid, b"fresh")
     assert cl.read("pt", oid) == b"fresh"
+
+
+def test_pg_temp_clears_after_realign_to_up():
+    """Once the PG is clean under a pin, the primary pushes each shard
+    to its CRUSH-up position and clears pg_temp — the pin is temporary,
+    so a later failure of a pinned member cannot strand the PG."""
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("pt", k=2, m=1, plugin="isa", pg_num=8)
+    cl = c.client("client.t")
+    oid, victim = _find_shuffling_object(c, cl, cl.lookup_pool("pt"))
+    payload = bytes(range(256)) * 16
+    cl.write_full("pt", oid, payload)
+    c.kill_osd(victim)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    c.mark_osd_out(victim)
+    for _ in range(6):
+        c.run_recovery()
+        c.network.pump()
+    assert cl.read("pt", oid) == payload
+    assert c.mon.osdmap.pg_temp
+    # ticks drive realign-to-up; the pin must clear and data stay intact
+    for _ in range(12):
+        c.tick(dt=6.0)
+        c.run_recovery()
+        c.network.pump()
+    assert not c.mon.osdmap.pg_temp, c.mon.osdmap.pg_temp
+    assert cl.read("pt", oid) == payload
+    cl.write_full("pt", oid, b"after-clear")
+    assert cl.read("pt", oid) == b"after-clear"

@@ -217,3 +217,18 @@ def test_stale_peer_cannot_resurrect_trimmed_snapset():
             from ceph_tpu.osd.pg_log import SNAP_CLONE
             assert not any(k == SNAP_CLONE for _s, k in ents), ents
     assert cl.read("sp", "o") == b"v2"
+
+
+def test_clone_preserves_omap_on_replicated():
+    c, cl = make("rep")
+    cl.write_full("sp", "o", b"body")
+    cl.omap_set("sp", "o", {"k": b"v-snap"})
+    cl.snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"body2")
+    cl.omap_set("sp", "o", {"k": b"v-head"})
+    r, res = cl.operate("sp", "o", ObjectOperation().omap_get(),
+                        snap="s1")
+    assert r == 0
+    from ceph_tpu.msg.kv import unpack_kv
+    assert unpack_kv(res[0][1]) == {"k": b"v-snap"}
+    assert cl.omap_get("sp", "o") == {"k": b"v-head"}
