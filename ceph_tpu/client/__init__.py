@@ -1,3 +1,4 @@
 from .rados import ObjectOperation, RadosClient
+from .striper import RadosStriper
 
-__all__ = ["ObjectOperation", "RadosClient"]
+__all__ = ["ObjectOperation", "RadosClient", "RadosStriper"]

@@ -31,6 +31,15 @@ from ..osdmap import OSDMap, ceph_stable_mod, pg_t
 MAX_ATTEMPTS = 8
 
 
+class NotifyTimeout(IOError):
+    """notify() timed out on silent watchers; .replies carries the
+    acks that DID arrive (rados_notify2: error + reply buffer)."""
+
+    def __init__(self, msg: str, replies):
+        super().__init__(msg)
+        self.replies = replies
+
+
 
 
 class ObjectOperation:
@@ -135,8 +144,11 @@ class RadosClient(Dispatcher):
         self.osdmap = OSDMap()
         self._tid = 0
         self._replies: Dict[int, MOSDOpReply] = {}
-        self._watches: Dict[int, object] = {}   # cookie -> callback
+        # cookie -> (callback, pool_id, oid, last_known_primary)
+        self._watches: Dict[int, list] = {}
         self._next_cookie = 1
+        self._linger_tids: Dict[int, int] = {}   # in-flight re-register
+        self._linger_retries: Dict[int, int] = {}
         mon.subscribe(name)
         mon.send_full_map(name)
         network.pump()
@@ -145,18 +157,34 @@ class RadosClient(Dispatcher):
     def ms_fast_dispatch(self, msg: Message) -> None:
         from ..msg.messages import MWatchNotify
         if isinstance(msg, MOSDMap):
+            applied = False
             for inc in msg.incrementals:
                 if inc.epoch == self.osdmap.epoch + 1:
                     self.osdmap.apply_incremental(inc)
+                    applied = True
+            if applied:
+                self._reregister_watches()
         elif isinstance(msg, MOSDOpReply):
+            cookie = self._linger_tids.pop(msg.tid, None)
+            if cookie is not None:
+                if msg.result == -11 and cookie in self._watches and \
+                        self._linger_retries.get(cookie, 0) < 50:
+                    # target PG still peering: keep lingering (the
+                    # Objecter retries linger ops until they land)
+                    self._linger_retries[cookie] = \
+                        self._linger_retries.get(cookie, 0) + 1
+                    self._send_watch_register(cookie)
+                else:
+                    self._linger_retries.pop(cookie, None)
+                return
             self._replies[msg.tid] = msg
         elif isinstance(msg, MWatchNotify) and \
                 msg.op == MWatchNotify.NOTIFY:
-            cb = self._watches.get(msg.cookie)
+            w = self._watches.get(msg.cookie)
             reply = b""
-            if cb is not None:
+            if w is not None:
                 try:
-                    reply = cb(msg.notify_id, msg.payload) or b""
+                    reply = w[0](msg.notify_id, msg.payload) or b""
                 except Exception:
                     reply = b""
             self.messenger.send_message(MWatchNotify(
@@ -364,15 +392,47 @@ class RadosClient(Dispatcher):
         return r
 
     # ---- watch / notify (rados_watch / rados_notify) -----------------------
+    def _reregister_watches(self) -> None:
+        """After a map change, re-send watch registrations whose PG
+        primary moved — the new primary's watcher table starts empty
+        (the linger-op resend in Objecter::_linger_submit)."""
+        for cookie, w in self._watches.items():
+            _cb, pool_id, oid, last_primary = w
+            _pgid, primary = self._calc_target(pool_id, oid)
+            if primary != last_primary and primary >= 0:
+                w[3] = primary
+                self._linger_retries[cookie] = 0
+                self._send_watch_register(cookie)
+
+    def _send_watch_register(self, cookie: int) -> None:
+        from ..msg.messages import CEPH_OSD_OP_WATCH
+        w = self._watches.get(cookie)
+        if w is None:
+            return
+        _cb, pool_id, oid, _lp = w
+        pgid, primary = self._calc_target(pool_id, oid)
+        if primary < 0:
+            return
+        w[3] = primary
+        self._tid += 1
+        self._linger_tids[self._tid] = cookie
+        self.messenger.send_message(MOSDOp(
+            tid=self._tid, pool=pool_id, oid=oid, pgid=pgid,
+            op=CEPH_OSD_OP_WATCH, offset=cookie,
+            epoch=self.osdmap.epoch,
+            trace_id=new_trace_id()), f"osd.{primary}")
+
     def watch(self, pool: str, oid: str, callback) -> int:
         """Register *callback(notify_id, payload) -> reply_bytes* for
-        notifies on the object; returns the watch cookie."""
+        notifies on the object; returns the watch cookie.  Watches
+        re-register automatically when the PG's primary moves."""
         from ..msg.messages import CEPH_OSD_OP_WATCH
         cookie = self._next_cookie
         self._next_cookie += 1
-        self._watches[cookie] = callback
-        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_WATCH,
-                         offset=cookie)
+        pool_id = self.lookup_pool(pool)
+        _pgid, primary = self._calc_target(pool_id, oid)
+        self._watches[cookie] = [callback, pool_id, oid, primary]
+        r = self._submit(pool_id, oid, CEPH_OSD_OP_WATCH, offset=cookie)
         if r.result < 0:
             del self._watches[cookie]
             raise IOError(f"watch {oid}: {r.result}")
@@ -392,6 +452,9 @@ class RadosClient(Dispatcher):
         from ..msg.messages import CEPH_OSD_OP_NOTIFY
         r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_NOTIFY,
                          data=bytes(payload), length=timeout)
+        if r.result == -110:
+            raise NotifyTimeout(f"notify {oid} timed out",
+                                _unpack_kv(r.data))
         if r.result < 0:
             raise IOError(f"notify {oid}: {r.result}")
         return _unpack_kv(r.data)

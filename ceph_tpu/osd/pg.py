@@ -438,6 +438,11 @@ class PG:
         want = getattr(self, "_pending_pg_temp", None)
         if want is None:
             return
+        if not self.is_primary():
+            # demoted: a pin chosen under our old map must not override
+            # the new primary's placement
+            self._pending_pg_temp = None
+            return
         from ..osdmap import pg_t
         cur = self.osd.osdmap.pg_temp.get(
             pg_t(self.pgid[0], self.pgid[1]), [])
@@ -460,6 +465,10 @@ class PG:
             return
         if getattr(self, "_realigning", False):
             return
+        # quiesce: no in-flight writes may interleave with the shard
+        # copies (clients see EAGAIN while realigning and resend)
+        if self.backend._oid_queues or self.backend.inflight_writes:
+            return
         moves = [s for s in range(len(self.up))
                  if s < len(self.acting)
                  and self.up[s] != CRUSH_ITEM_NONE
@@ -469,6 +478,7 @@ class PG:
             self._request_pg_temp([])
             return
         self._realigning = True
+        start_head = self.pg_log.head
         dlog("pg", 3, f"pg {self.pgid} realign to up {self.up} "
              f"(moves {moves}, {len(objects)} objects)",
              f"osd.{self.osd.osd_id}")
@@ -479,7 +489,10 @@ class PG:
             state["failed"] |= not ok
             if state["left"] == 0:
                 self._realigning = False
-                if not state["failed"]:
+                if not state["failed"] and \
+                        self.pg_log.head == start_head:
+                    # nothing wrote while the copies were in flight:
+                    # the pushed shards are current -> drop the pin
                     self._request_pg_temp([])   # next epoch: acting = up
 
         from ..msg.messages import MOSDECSubOpWrite
@@ -898,6 +911,12 @@ class PG:
 
     # ---- op execution (PrimaryLogPG::do_op analog) ------------------------
     def do_op(self, msg: MOSDOp) -> None:
+        if getattr(self, "_realigning", False):
+            # shard copies are in flight; EAGAIN makes the client
+            # resend after the realign epoch lands
+            self.osd.send_op_reply(msg.src, MOSDOpReply(
+                tid=msg.tid, result=-11, epoch=self.osd.osdmap.epoch))
+            return
         if not self.is_primary() or self.state not in (
                 STATE_ACTIVE, STATE_ACTIVE_RECOVERING):
             self.osd.send_op_reply(msg.src, MOSDOpReply(

@@ -78,3 +78,22 @@ def test_watch_on_ec_pool(cluster):
     replies = b.notify("wnec", "obj", b"ec-notify")
     assert got == [b"ec-notify"]
     assert list(replies.values()) == [b"ok"]
+
+
+def test_watch_survives_primary_failover(cluster):
+    """Watches re-register with the new primary after a map change
+    (the client-side linger resend)."""
+    c = cluster
+    a = c.client("client.wa")
+    b = c.client("client.wb")
+    a.write_full("wn", "cfg", b"x")
+    heard = []
+    a.watch("wn", "cfg", lambda nid, p: (heard.append(p), b"ok")[1])
+    _pg, primary = a._calc_target(a.lookup_pool("wn"), "cfg")
+    c.kill_osd(primary)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    c.network.pump()
+    replies = b.notify("wn", "cfg", b"after-failover")
+    assert heard == [b"after-failover"]
+    assert list(replies.values()) == [b"ok"]
