@@ -31,6 +31,14 @@ from ..osdmap import OSDMap, ceph_stable_mod, pg_t
 MAX_ATTEMPTS = 8
 
 
+def _ioerror(api: str, oid: str, result: int) -> IOError:
+    """IOError with the errno attached so callers can branch on the
+    CODE (ENOENT vs transient) instead of parsing the message."""
+    e = IOError(f"{api} {oid}: {result}")
+    e.errno = -result        # positive errno convention
+    return e
+
+
 class NotifyTimeout(IOError):
     """notify() timed out on silent watchers; .replies carries the
     acks that DID arrive (rados_notify2: error + reply buffer)."""
@@ -270,7 +278,7 @@ class RadosClient(Dispatcher):
         r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_READ,
                          offset=offset, length=length, snapid=snapid)
         if r.result < 0:
-            raise IOError(f"read {oid}: {r.result}")
+            raise _ioerror("read", oid, r.result)
         return r.data
 
     # ---- pool snapshots (rados_ioctx_snap_*) -------------------------------
@@ -331,7 +339,7 @@ class RadosClient(Dispatcher):
     def stat(self, pool: str, oid: str) -> int:
         r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_STAT)
         if r.result < 0:
-            raise IOError(f"stat {oid}: {r.result}")
+            raise _ioerror("stat", oid, r.result)
         return struct.unpack("<Q", r.data)[0]
 
     def remove(self, pool: str, oid: str) -> int:
@@ -349,13 +357,13 @@ class RadosClient(Dispatcher):
         r, res = self.operate(pool, oid,
                               ObjectOperation().get_xattr(name))
         if r < 0:
-            raise IOError(f"getxattr {oid}.{name}: {r}")
+            raise _ioerror(f"getxattr .{name}", oid, r)
         return res[0][1]
 
     def getxattrs(self, pool: str, oid: str) -> Dict[str, bytes]:
         r, res = self.operate(pool, oid, ObjectOperation().get_xattrs())
         if r < 0:
-            raise IOError(f"getxattrs {oid}: {r}")
+            raise _ioerror("getxattrs", oid, r)
         return _unpack_kv(res[0][1])
 
     def rmxattr(self, pool: str, oid: str, name: str) -> int:
@@ -383,7 +391,7 @@ class RadosClient(Dispatcher):
     def omap_get(self, pool: str, oid: str) -> Dict[str, bytes]:
         r, res = self.operate(pool, oid, ObjectOperation().omap_get())
         if r < 0:
-            raise IOError(f"omap_get {oid}: {r}")
+            raise _ioerror("omap_get", oid, r)
         return _unpack_kv(res[0][1])
 
     def omap_rm_keys(self, pool: str, oid: str, keys) -> int:

@@ -28,6 +28,12 @@ from typing import List, Tuple
 
 from .rados import ObjectOperation, RadosClient
 
+_ABSENT = (2, 61)     # ENOENT / ENODATA: genuinely missing, not transient
+
+
+def _absent(e: IOError) -> bool:
+    return getattr(e, "errno", None) in _ABSENT
+
 SIZE_XATTR = "striper.size"          # reference XATTR_SIZE
 
 
@@ -79,7 +85,9 @@ class RadosStriper:
         first = self._obj_name(soid, 0)
         try:
             cur = self.stat(soid)
-        except IOError:
+        except IOError as e:
+            if not _absent(e):
+                raise            # transient: never shrink the size
             cur = -1
         if new_end > cur:
             op = (ObjectOperation().create(exclusive=False)
@@ -109,7 +117,9 @@ class RadosStriper:
     def append(self, soid: str, data: bytes) -> int:
         try:
             size = self.stat(soid)
-        except IOError:
+        except IOError as e:
+            if not _absent(e):
+                raise            # transient: appending at 0 would clobber
             size = 0
         return self.write(soid, data, size)
 
@@ -125,7 +135,9 @@ class RadosStriper:
                 piece = self.client.read(
                     self.pool, self._obj_name(soid, objectno),
                     offset=obj_off, length=run)
-            except IOError:
+            except IOError as e:
+                if not _absent(e):
+                    raise        # transient/EIO must surface, not zero-fill
                 piece = b""                   # sparse hole reads zeros
             out[lpos - offset:lpos - offset + len(piece)] = piece
         return bytes(out)
@@ -159,13 +171,14 @@ class RadosStriper:
             for objectno in self._all_objectnos(old):
                 kept = self._kept_in_object(objectno, size)
                 name = self._obj_name(soid, objectno)
-                try:
-                    if kept == 0 and objectno != 0:
-                        self.client.remove(self.pool, name)
-                    else:
-                        self.client.truncate(self.pool, name, kept)
-                except IOError:
-                    pass                    # sparse hole: nothing stored
+                if kept == 0 and objectno != 0:
+                    r = self.client.remove(self.pool, name)
+                    if r not in (0, -2):
+                        return r      # keep the old size on failure
+                else:
+                    r = self.client.truncate(self.pool, name, kept)
+                    if r not in (0, -2):
+                        return r
         first = self._obj_name(soid, 0)
         op = (ObjectOperation().create(exclusive=False)
               .set_xattr(SIZE_XATTR, struct.pack("<Q", size)))

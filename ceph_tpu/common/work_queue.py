@@ -1,0 +1,143 @@
+"""Sharded op queue + mClock QoS scheduling — the OSD's op intake.
+
+Two reference mechanisms reproduced with honest semantics:
+
+- ``ShardedOpWQ`` (common/WorkQueue.h:618, osd/OSD.cc:2008): client ops
+  land in one of N shards keyed by PG id, so one PG's ops stay strictly
+  FIFO while different PGs interleave fairly.  The reference drains
+  shards with a thread pool; this single-threaded runtime drains them
+  explicitly (``drain``), preserving the ordering/fairness contract the
+  threads would give.
+- ``MClockQueue`` (osd/mClockOpClassQueue.h over src/dmclock): QoS
+  arbitration between op classes (client / recovery / scrub / snaptrim)
+  by (reservation, weight, limit) tags.  Classes below their
+  reservation are served first (most-behind first); the rest share by
+  weight (lowest virtual finish tag wins); classes at their limit wait.
+
+The scheduler decides ORDER whenever more ops are queued than drained
+in one step — exactly the burst case QoS exists for.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+# op classes (mClockOpClassQueue's osd_op_queue_mclock_* option groups)
+CLASS_CLIENT = "client"
+CLASS_RECOVERY = "recovery"
+CLASS_SCRUB = "scrub"
+CLASS_SNAPTRIM = "snaptrim"
+
+# (reservation, weight, limit) per class, in ops per virtual second;
+# defaults shaped like the reference's mclock option defaults: clients
+# get most of the weight, background work is reservation-guaranteed but
+# limited so it cannot starve clients
+DEFAULT_TAGS: Dict[str, Tuple[float, float, float]] = {
+    CLASS_CLIENT: (100.0, 500.0, 0.0),      # limit 0 = unlimited
+    CLASS_RECOVERY: (50.0, 100.0, 200.0),
+    CLASS_SCRUB: (10.0, 50.0, 100.0),
+    CLASS_SNAPTRIM: (10.0, 50.0, 100.0),
+}
+
+
+class MClockQueue:
+    """dmclock-lite over a virtual clock that advances one unit per
+    dequeue (deterministic; no wall time in the decision path)."""
+
+    def __init__(self, tags: Optional[Dict[str, Tuple[float, float,
+                                                      float]]] = None):
+        self.tags = dict(tags or DEFAULT_TAGS)
+        self._queues: Dict[str, Deque] = {}
+        # per-class progress tags (dmclock's r/w tag pairs)
+        self._r_tags: Dict[str, float] = {}
+        self._w_tags: Dict[str, float] = {}
+        self._now = 0.0
+        self._size = 0
+
+    def enqueue(self, op_class: str, item) -> None:
+        if op_class not in self.tags:
+            op_class = CLASS_CLIENT
+        self._queues.setdefault(op_class, deque()).append(item)
+        self._size += 1
+
+    def __len__(self) -> int:
+        return self._size
+
+    def dequeue(self):
+        """Pop the QoS-chosen item; None when empty."""
+        self._now += 1.0
+        candidates = [c for c, q in self._queues.items() if q]
+        if not candidates:
+            return None
+        # phase 1: reservations — the class most behind its guaranteed
+        # rate goes first (dmclock's reservation tag comparison)
+        best, best_deficit = None, 0.0
+        for c in candidates:
+            res = self.tags[c][0]
+            if res <= 0:
+                continue
+            expect = self._now * res / 1000.0
+            deficit = expect - self._r_tags.get(c, 0.0)
+            if deficit > best_deficit:
+                best, best_deficit = c, deficit
+        if best is None:
+            # phase 2: weight sharing — lowest virtual finish tag wins,
+            # classes at their limit stand aside (unless all are)
+            def finish_tag(c):
+                return self._w_tags.get(c, 0.0) / max(self.tags[c][1],
+                                                      1e-9)
+            under = [c for c in candidates if not self._at_limit(c)]
+            pool = under or candidates
+            best = min(pool, key=finish_tag)
+        item = self._queues[best].popleft()
+        self._size -= 1
+        self._r_tags[best] = self._r_tags.get(best, 0.0) + 1.0
+        self._w_tags[best] = self._w_tags.get(best, 0.0) + 1.0
+        return item
+
+    def _at_limit(self, c: str) -> bool:
+        lim = self.tags[c][2]
+        if lim <= 0:
+            return False
+        return self._w_tags.get(c, 0.0) >= self._now * lim / 1000.0
+
+
+class ShardedOpWQ:
+    """PG-sharded front queues feeding per-shard mClock arbiters."""
+
+    def __init__(self, n_shards: int = 5,
+                 tags: Optional[Dict] = None):
+        self.n_shards = n_shards
+        self.shards: List[MClockQueue] = [MClockQueue(tags)
+                                          for _ in range(n_shards)]
+        # one PG's ops must stay FIFO: the shard index is a pure
+        # function of the pgid (OSD.cc shard = pgid.hash % num_shards)
+        self._rr = 0
+
+    def shard_of(self, pgid: Tuple[int, int]) -> int:
+        return hash(pgid) % self.n_shards
+
+    def enqueue(self, pgid: Tuple[int, int], op_class: str, item) -> None:
+        self.shards[self.shard_of(pgid)].enqueue(op_class, item)
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.shards)
+
+    def drain(self, handler: Callable, max_ops: int = 0) -> int:
+        """Round-robin the shards, QoS-dequeue within each; returns the
+        number of ops handled."""
+        done = 0
+        idle_rounds = 0
+        while idle_rounds < self.n_shards:
+            if max_ops and done >= max_ops:
+                break
+            shard = self.shards[self._rr]
+            self._rr = (self._rr + 1) % self.n_shards
+            item = shard.dequeue()
+            if item is None:
+                idle_rounds += 1
+                continue
+            idle_rounds = 0
+            handler(item)
+            done += 1
+        return done

@@ -19,6 +19,7 @@ import struct
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..common import Dout, OpTracker, PerfCountersBuilder
+from ..common.work_queue import CLASS_CLIENT, CLASS_SCRUB, ShardedOpWQ
 from ..crush.constants import CRUSH_ITEM_NONE
 from ..msg import (
     Dispatcher, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
@@ -94,6 +95,7 @@ class OSD(Dispatcher):
         self.op_tracker = OpTracker()
         self._tracked: Dict[Tuple[str, int], object] = {}
         self._recovery_queue: List[PG] = []
+        self.op_wq = ShardedOpWQ()
         self._rep_pulls: Dict[int, Callable] = {}
         self._pull_tid = 0
 
@@ -215,6 +217,9 @@ class OSD(Dispatcher):
 
     # ---- client ops -------------------------------------------------------
     def _handle_op(self, msg: MOSDOp) -> None:
+        """Client op intake: lands in the sharded op queue (one PG's
+        ops stay FIFO in their shard, OSD.cc ShardedOpWQ) and drains
+        through the mClock arbiter — under bursts, QoS decides order."""
         self.perf_counters.inc(
             L_OSD_OP_W if msg.op in ("write", "writefull", "append",
                                      "delete") else L_OSD_OP_R)
@@ -222,13 +227,27 @@ class OSD(Dispatcher):
             msg.trace_id, f"osd_op({msg.op} {msg.pool}/{msg.oid})")
         op.mark_event("queued_for_pg")
         self._tracked[(msg.src, msg.tid)] = op
-        pg = self.pgs.get(msg.pgid)
-        if pg is None:
-            self.send_op_reply(msg.src, MOSDOpReply(
-                tid=msg.tid, result=-11, epoch=self.osdmap.epoch))
-            return
-        op.mark_event("reached_pg")
-        pg.do_op(msg)
+        self.op_wq.enqueue(msg.pgid, CLASS_CLIENT, ("op", msg))
+        self.drain_ops()
+
+    def drain_ops(self, max_ops: int = 0) -> int:
+        return self.op_wq.drain(self._wq_handle, max_ops)
+
+    def _wq_handle(self, item) -> None:
+        kind = item[0]
+        if kind == "op":
+            msg = item[1]
+            pg = self.pgs.get(msg.pgid)
+            if pg is None:
+                self.send_op_reply(msg.src, MOSDOpReply(
+                    tid=msg.tid, result=-11, epoch=self.osdmap.epoch))
+                return
+            tracked = self._tracked.get((msg.src, msg.tid))
+            if tracked is not None:
+                tracked.mark_event("reached_pg")
+            pg.do_op(msg)
+        elif kind == "scrub":
+            item[1].start_scrub()
 
     def send_op_reply(self, dst: str, reply: MOSDOpReply) -> None:
         """All client replies funnel here so op tracking/latency see them."""
@@ -365,7 +384,10 @@ class OSD(Dispatcher):
             stagger = (hash(pg.pgid) % 997) / 997.0 * interval * 0.1
             if self.now - pg.last_scrub_stamp >= interval + stagger:
                 self.dout(5, f"sched_scrub pg {pg.pgid}")
-                pg.start_scrub()
+                # start_scrub stamps on an ACTUAL start; a PG that is
+                # peering right now simply retries next tick
+                self.op_wq.enqueue(pg.pgid, CLASS_SCRUB, ("scrub", pg))
+        self.drain_ops()
 
     def _handle_ping(self, msg: MOSDPing) -> None:
         if msg.op == MOSDPing.PING:

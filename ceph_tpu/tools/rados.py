@@ -68,6 +68,9 @@ def main(argv=None) -> int:
                 return 1
             mutated = True
         elif v == "get":
+            if len(rest) < 2:
+                print("get needs POOL OID [FILE]", file=sys.stderr)
+                return 1
             pool, oid = rest[0], rest[1]
             data = cl.read(pool, oid)
             if len(rest) > 2:
