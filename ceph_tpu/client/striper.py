@@ -26,9 +26,12 @@ from __future__ import annotations
 import struct
 from typing import List, Tuple
 
+import errno as _errno
+
 from .rados import ObjectOperation, RadosClient
 
-_ABSENT = (2, 61)     # ENOENT / ENODATA: genuinely missing, not transient
+# genuinely missing (vs transient): object or size attr absent
+_ABSENT = (_errno.ENOENT, _errno.ENODATA)
 
 
 def _absent(e: IOError) -> bool:
@@ -167,23 +170,28 @@ class RadosStriper:
 
     def truncate(self, soid: str, size: int) -> int:
         old = self.stat(soid)
+        # shrink the recorded size FIRST: if a later backing trim fails,
+        # bytes are orphaned (harmless) instead of the size claiming
+        # destroyed data that reads would silently zero-fill
+        first = self._obj_name(soid, 0)
+        op = (ObjectOperation().create(exclusive=False)
+              .set_xattr(SIZE_XATTR, struct.pack("<Q", size)))
+        r, _ = self.client.operate(self.pool, first, op)
+        if r < 0:
+            return r
         if size < old:
             for objectno in self._all_objectnos(old):
                 kept = self._kept_in_object(objectno, size)
                 name = self._obj_name(soid, objectno)
                 if kept == 0 and objectno != 0:
-                    r = self.client.remove(self.pool, name)
-                    if r not in (0, -2):
-                        return r      # keep the old size on failure
+                    r2 = self.client.remove(self.pool, name)
+                    if r2 not in (0, -2):
+                        return r2     # size already safe; bytes orphan
                 else:
-                    r = self.client.truncate(self.pool, name, kept)
-                    if r not in (0, -2):
-                        return r
-        first = self._obj_name(soid, 0)
-        op = (ObjectOperation().create(exclusive=False)
-              .set_xattr(SIZE_XATTR, struct.pack("<Q", size)))
-        r, _ = self.client.operate(self.pool, first, op)
-        return r
+                    r2 = self.client.truncate(self.pool, name, kept)
+                    if r2 not in (0, -2):
+                        return r2
+        return 0
 
     def remove(self, soid: str, _ignore_missing: bool = False) -> int:
         try:

@@ -57,7 +57,24 @@ class MClockQueue:
     def enqueue(self, op_class: str, item) -> None:
         if op_class not in self.tags:
             op_class = CLASS_CLIENT
-        self._queues.setdefault(op_class, deque()).append(item)
+        q = self._queues.setdefault(op_class, deque())
+        if not q:
+            # idle -> active: clamp the class's tags to the present so a
+            # long-idle class cannot cash in an unbounded reservation
+            # deficit or dodge its limit (dmclock's tag re-clamping)
+            res = self.tags[op_class][0]
+            if res > 0:
+                self._r_tags[op_class] = max(
+                    self._r_tags.get(op_class, 0.0),
+                    self._now * res / 1000.0)
+            active = [c for c, aq in self._queues.items() if aq]
+            if active:
+                floor = min(self._w_tags.get(c, 0.0) /
+                            max(self.tags[c][1], 1e-9) for c in active)
+                self._w_tags[op_class] = max(
+                    self._w_tags.get(op_class, 0.0),
+                    floor * max(self.tags[op_class][1], 1e-9))
+        q.append(item)
         self._size += 1
 
     def __len__(self) -> int:

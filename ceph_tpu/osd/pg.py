@@ -926,6 +926,24 @@ class PG:
         from ..msg.messages import (
             CEPH_OSD_OP_NOTIFY, CEPH_OSD_OP_UNWATCH, CEPH_OSD_OP_WATCH,
         )
+        # min_size gate (PG::get_min_peer_features / is_degraded_below):
+        # mutations need at least min_size live acting members, or a
+        # single further failure could lose acked data — clients retry
+        # until recovery/remap restores enough copies
+        is_write = (any(o.op not in self._READONLY_OPS for o in msg.ops)
+                    if msg.ops else
+                    msg.op in (CEPH_OSD_OP_WRITE, CEPH_OSD_OP_WRITEFULL,
+                               CEPH_OSD_OP_APPEND, CEPH_OSD_OP_DELETE))
+        if is_write:
+            alive = sum(1 for o in self.acting if o != CRUSH_ITEM_NONE)
+            if alive < self.pool.min_size:
+                dlog("pg", 5, f"pg {self.pgid} write blocked: "
+                     f"{alive} acting < min_size {self.pool.min_size}",
+                     f"osd.{self.osd.osd_id}")
+                self.osd.send_op_reply(msg.src, MOSDOpReply(
+                    tid=msg.tid, result=-11,
+                    epoch=self.osd.osdmap.epoch))
+                return
         if msg.op == CEPH_OSD_OP_WATCH and not msg.ops:
             self._do_watch(msg)
         elif msg.op == CEPH_OSD_OP_UNWATCH and not msg.ops:

@@ -1,0 +1,134 @@
+"""ceph — cluster status CLI against a checkpointed mini cluster.
+
+The python `ceph` tool analog (src/ceph.in + mon command surface,
+mon/MonCommands.h): status/health/df plus the osd and pg inspection
+verbs, driven from a checkpoint directory like tools/rados.py.
+
+  status | health | df
+  osd tree           (CrushTreeDumper-style hierarchy with weights)
+  osd df             (per-osd object/byte usage from the stores)
+  pg stat            (per-state PG counts)
+  pg dump            (one line per PG: state, up/acting sets)
+
+Read-only: never writes the checkpoint back.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _osd_tree(c) -> None:
+    cw = c.mon.osdmap.crush
+    m = cw.crush
+
+    def walk(item: int, depth: int) -> None:
+        indent = "  " * depth
+        if item >= 0:
+            w = c.mon.osdmap.osd_weight[item] / 0x10000 \
+                if item < len(c.mon.osdmap.osd_weight) else 0
+            up = "up" if c.mon.osdmap.is_up(item) else "down"
+            print(f"{indent}osd.{item}\tweight {w:.3f}\t{up}")
+            return
+        b = m.bucket(item)
+        if b is None:
+            return
+        name = cw.get_item_name(item) or str(item)
+        tname = cw.get_type_name(b.type) or str(b.type)
+        print(f"{indent}{tname} {name}")
+        for child in b.items:
+            walk(child, depth + 1)
+
+    roots = set(b.id for b in m.buckets if b is not None)
+    children = set()
+    for b in m.buckets:
+        if b is None:
+            continue
+        children.update(i for i in b.items if i < 0)
+    for r in sorted(roots - children, reverse=True):
+        walk(r, 0)
+
+
+def _osd_df(c) -> None:
+    print("ID\tOBJECTS\tBYTES\tSTATUS")
+    for i, osd in sorted(c.osds.items()):
+        n_obj = 0
+        n_bytes = 0
+        for cid in osd.store.list_collections():
+            for ho in osd.store.list_objects(cid):
+                n_obj += 1
+                n_bytes += osd.store.stat(cid, ho)
+        status = "up" if c.mon.osdmap.is_up(i) else "down"
+        if i < len(c.mon.osdmap.osd_weight) and \
+                c.mon.osdmap.osd_weight[i] == 0:
+            status += "+out"
+        print(f"{i}\t{n_obj}\t{n_bytes}\t{status}")
+
+
+def _pg_lines(c):
+    seen = set()
+    for osd in c.osds.values():
+        for pgid, pg in osd.pgs.items():
+            if pgid in seen or not pg.is_primary():
+                continue
+            seen.add(pgid)
+            yield pgid, pg
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ceph")
+    p.add_argument("--cluster", required=True,
+                   help="checkpoint directory (MiniCluster.checkpoint)")
+    p.add_argument("verb", choices=["status", "health", "df", "osd", "pg"])
+    p.add_argument("args", nargs="*")
+    a = p.parse_args(argv)
+
+    from ..cluster import MiniCluster
+    c = MiniCluster.restore(a.cluster)
+    v, rest = a.verb, a.args
+    if v == "status":
+        print(json.dumps({
+            "health": c.health(),
+            "epoch": c.mon.osdmap.epoch,
+            "num_osds": len(c.osds),
+            "num_up": sum(1 for i in c.osds
+                          if c.mon.osdmap.is_up(i)),
+            "pools": len(c.mon.osdmap.pools),
+            "pg_states": c.pg_states(),
+        }, indent=2))
+    elif v == "health":
+        print(c.health())
+    elif v == "df":
+        for pid, name in sorted(c.mon.osdmap.pool_name.items()):
+            pool = c.mon.osdmap.pools[pid]
+            kind = "erasure" if pool.is_erasure() else "replicated"
+            print(f"{name}\t{kind}\tpg_num={pool.pg_num}")
+    elif v == "osd":
+        sub = rest[0] if rest else "tree"
+        if sub == "tree":
+            _osd_tree(c)
+        elif sub == "df":
+            _osd_df(c)
+        else:
+            print(f"unknown: osd {sub}", file=sys.stderr)
+            return 1
+    elif v == "pg":
+        sub = rest[0] if rest else "stat"
+        if sub == "stat":
+            counts = {}
+            for _pgid, pg in _pg_lines(c):
+                counts[pg.state] = counts.get(pg.state, 0) + 1
+            print(json.dumps(counts))
+        elif sub == "dump":
+            for pgid, pg in sorted(_pg_lines(c)):
+                print(f"{pgid[0]}.{pgid[1]}\t{pg.state}"
+                      f"\tup={pg.up}\tacting={pg.acting}")
+        else:
+            print(f"unknown: pg {sub}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

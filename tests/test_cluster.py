@@ -174,3 +174,40 @@ def test_lrc_pool_end_to_end():
     data = payload(seed=9)
     assert client.write_full("lrcpool", "objl", data) == 0
     assert client.read("lrcpool", "objl") == data
+
+
+def test_writes_blocked_below_min_size():
+    """Writes to a PG with fewer than min_size live acting members are
+    refused (EAGAIN -> client retry -> -110), while reads still serve
+    degraded; recovery of the acting set unblocks writes."""
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=3)          # exactly k+m: no spare to remap to
+    c.create_ec_pool("ms", k=2, m=1, plugin="isa", pg_num=4)
+    cl = c.client("client.ms")
+    data = payload(seed=11)
+    cl.write_full("ms", "obj", data)
+    victim = None
+    for osd in c.osds.values():
+        for cid in osd.store.list_collections():
+            for ho in osd.store.list_objects(cid):
+                if ho.oid == "obj" and victim is None:
+                    victim = osd.osd_id
+    c.kill_osd(victim)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    c.mark_osd_out(victim)
+    c.network.pump()
+    # only 2 live osds remain for a min_size=3 pool: writes refuse
+    assert cl.write_full("ms", "obj", b"nope") in (-11, -110)
+    # degraded reads still reconstruct
+    assert cl.read("ms", "obj") == data
+    # revive AND mark back in: acting refills, writes flow again
+    c.revive_osd(victim)
+    for _ in range(4):
+        c.tick(dt=6.0)
+    c.mon.mark_osd_in(victim)
+    c.network.pump()
+    c.run_recovery()
+    c.network.pump()
+    assert cl.write_full("ms", "obj", b"back") == 0
+    assert cl.read("ms", "obj") == b"back"

@@ -135,3 +135,33 @@ def test_rados_cli_roundtrip(tmp_path, capsys):
     assert rc == 0
     rc, out = run("ls", "rp")
     assert "up" not in out.splitlines()
+
+
+def test_ceph_cli_status_surfaces(tmp_path, capsys):
+    from ceph_tpu.tools import ceph_cli
+    c = MiniCluster(n_osds=4)
+    c.create_ec_pool("cp", k=2, m=1, plugin="isa", pg_num=4)
+    cl = c.client("client.c")
+    cl.write_full("cp", "o", b"bytes" * 100)
+    ckpt = str(tmp_path / "ck")
+    c.checkpoint(ckpt)
+
+    def run(*argv):
+        rc = ceph_cli.main(["--cluster", ckpt, *argv])
+        return rc, capsys.readouterr().out
+
+    rc, out = run("status")
+    st = json.loads(out)
+    assert rc == 0 and st["num_osds"] == 4 and st["pools"] == 1
+    rc, out = run("health")
+    assert rc == 0 and out.strip()
+    rc, out = run("osd", "tree")
+    assert rc == 0 and "osd.0" in out and "root" in out
+    rc, out = run("osd", "df")
+    assert rc == 0 and out.count("\n") >= 5
+    rc, out = run("pg", "stat")
+    assert rc == 0 and sum(json.loads(out).values()) == 4
+    rc, out = run("pg", "dump")
+    assert rc == 0 and "acting=" in out
+    rc, out = run("df")
+    assert "cp" in out

@@ -67,3 +67,23 @@ def test_osd_ops_flow_through_the_queue():
         assert cl.read("wq", f"o{i}") == bytes([i]) * 100
     # the queue is empty after the pump settles
     assert all(len(o.op_wq) == 0 for o in c.osds.values())
+
+
+def test_idle_class_cannot_cash_unbounded_deficit():
+    """A class idle for thousands of vticks must not monopolize the
+    queue when it wakes (dmclock tag clamping on idle->active)."""
+    q = MClockQueue({CLASS_CLIENT: (0.0, 400.0, 0.0),
+                     CLASS_SCRUB: (100.0, 1.0, 0.0)})
+    # run the clock forward with client-only traffic
+    for i in range(5000):
+        q.enqueue(CLASS_CLIENT, ("c", i))
+    for _ in range(5000):
+        q.dequeue()
+    # scrub wakes after a long idle next to a fresh client burst
+    for i in range(200):
+        q.enqueue(CLASS_CLIENT, ("c2", i))
+    for i in range(200):
+        q.enqueue(CLASS_SCRUB, ("s", i))
+    first_50 = [q.dequeue()[0] for _ in range(50)]
+    # without clamping, scrub's phantom deficit serves ~all of these
+    assert first_50.count("s") <= 25, first_50.count("s")
