@@ -38,6 +38,7 @@ def _absent(e: IOError) -> bool:
     return getattr(e, "errno", None) in _ABSENT
 
 SIZE_XATTR = "striper.size"          # reference XATTR_SIZE
+TRIM_XATTR = "striper.trim_upto"     # pending-shrink high-water mark
 
 
 class RadosStriper:
@@ -169,29 +170,45 @@ class RadosStriper:
         return range((last_set + 1) * self.sc)
 
     def truncate(self, soid: str, size: int) -> int:
+        """Retry-safe two-phase shrink: (1) record the new size AND a
+        trim high-water mark covering any previously failed shrink, so
+        reads never claim destroyed bytes; (2) trim the backing
+        objects over the whole marked span; (3) clear the mark.  A
+        failure between phases leaves orphan bytes that the NEXT
+        truncate/grow call re-trims (the mark survives)."""
         old = self.stat(soid)
-        # shrink the recorded size FIRST: if a later backing trim fails,
-        # bytes are orphaned (harmless) instead of the size claiming
-        # destroyed data that reads would silently zero-fill
         first = self._obj_name(soid, 0)
+        try:
+            prev_mark = struct.unpack(
+                "<Q", self.client.getxattr(self.pool, first,
+                                           TRIM_XATTR))[0]
+        except IOError as e:
+            if not _absent(e):
+                raise
+            prev_mark = 0
+        span = max(old, prev_mark)
         op = (ObjectOperation().create(exclusive=False)
-              .set_xattr(SIZE_XATTR, struct.pack("<Q", size)))
+              .set_xattr(SIZE_XATTR, struct.pack("<Q", size))
+              .set_xattr(TRIM_XATTR, struct.pack("<Q", span)))
         r, _ = self.client.operate(self.pool, first, op)
         if r < 0:
             return r
-        if size < old:
-            for objectno in self._all_objectnos(old):
+        if size < span:
+            for objectno in self._all_objectnos(span):
                 kept = self._kept_in_object(objectno, size)
                 name = self._obj_name(soid, objectno)
                 if kept == 0 and objectno != 0:
                     r2 = self.client.remove(self.pool, name)
                     if r2 not in (0, -2):
-                        return r2     # size already safe; bytes orphan
+                        return r2     # mark persists; retry re-trims
                 else:
                     r2 = self.client.truncate(self.pool, name, kept)
                     if r2 not in (0, -2):
                         return r2
-        return 0
+        r, _ = self.client.operate(self.pool, first, ObjectOperation()
+                                   .set_xattr(TRIM_XATTR,
+                                              struct.pack("<Q", size)))
+        return r
 
     def remove(self, soid: str, _ignore_missing: bool = False) -> int:
         try:

@@ -194,14 +194,16 @@ class MiniCluster:
     def create_ec_pool(self, name: str, k: int = 4, m: int = 2,
                        pg_num: int = 32, plugin: str = "tpu",
                        extra_profile: Optional[Dict[str, str]] = None,
-                       failure_domain: str = "host") -> int:
+                       failure_domain: str = "host",
+                       ec_overwrites: bool = True) -> int:
         profile = {"plugin": plugin, "k": str(k), "m": str(m),
                    "crush-failure-domain": failure_domain}
         if extra_profile:
             profile.update(extra_profile)
         pname = f"{name}_profile"
         self.mon.create_ec_profile(pname, profile)
-        pid = self.mon.create_ec_pool(name, pname, pg_num)
+        pid = self.mon.create_ec_pool(name, pname, pg_num,
+                                      ec_overwrites=ec_overwrites)
         self.publish()
         return pid
 
@@ -331,15 +333,39 @@ class MiniCluster:
 
     # ---- introspection -----------------------------------------------------
     def pg_states(self) -> Dict[str, str]:
-        out = {}
+        return {f"{pgid[0]}.{pgid[1]:x}": pg.state
+                for pgid, pg in self.primary_pgs()}
+
+    def primary_pgs(self):
+        """(pgid, pg) for each PG's live primary — THE pg scan used by
+        pg_states/health/CLIs so their accounting cannot drift."""
+        seen = set()
         for osd in self.osds.values():
+            if osd.name in self.network.down:
+                continue
             for pgid, pg in osd.pgs.items():
-                if pg.is_primary():
-                    out[f"{pgid[0]}.{pgid[1]:x}"] = pg.state
-        return out
+                if pgid in seen or not pg.is_primary():
+                    continue
+                seen.add(pgid)
+                yield pgid, pg
 
     def health(self) -> str:
+        """HEALTH_OK / HEALTH_WARN with reasons (mon health checks):
+        down osds, degraded/peering pgs, pinned pg_temp remaps."""
+        reasons = []
         n_down = sum(1 for o in range(self.mon.osdmap.max_osd)
                      if not self.mon.osdmap.is_up(o))
-        return "HEALTH_OK" if n_down == 0 else \
-            f"HEALTH_WARN {n_down} osds down"
+        if n_down:
+            reasons.append(f"{n_down} osds down")
+        states = {}
+        for _pgid, pg in self.primary_pgs():
+            states[pg.state] = states.get(pg.state, 0) + 1
+        bad = {st: n for st, n in states.items() if st != "active"}
+        if bad:
+            reasons.append("pgs " + ", ".join(
+                f"{n} {st}" for st, n in sorted(bad.items())))
+        if self.mon.osdmap.pg_temp:
+            reasons.append(
+                f"{len(self.mon.osdmap.pg_temp)} pgs remapped (pg_temp)")
+        return "HEALTH_OK" if not reasons else \
+            "HEALTH_WARN " + "; ".join(reasons)

@@ -296,7 +296,8 @@ class Monitor(Dispatcher):
         self.osdmap.erasure_code_profiles[name] = dict(profile)
 
     def create_ec_pool(self, name: str, profile_name: str,
-                       pg_num: int = 32) -> int:
+                       pg_num: int = 32,
+                       ec_overwrites: bool = True) -> int:
         profile = self.osdmap.erasure_code_profiles[profile_name]
         ec = create_erasure_code(dict(profile))
         rule_name = f"{name}_rule"
@@ -305,11 +306,14 @@ class Monitor(Dispatcher):
             raise RuntimeError(f"create_rule failed: {rno}")
         k = ec.get_data_chunk_count()
         stripe_unit = int(profile.get("stripe_unit", DEFAULT_STRIPE_UNIT))
+        from ..osdmap.types import FLAG_EC_OVERWRITES, FLAG_HASHPSPOOL
+        flags = FLAG_HASHPSPOOL | (FLAG_EC_OVERWRITES if ec_overwrites
+                                   else 0)
         pool = pg_pool_t(type=TYPE_ERASURE, size=ec.get_chunk_count(),
                          min_size=k + 1, crush_rule=rno,
                          pg_num=pg_num, pgp_num=pg_num,
                          erasure_code_profile=profile_name,
-                         stripe_width=k * stripe_unit)
+                         stripe_width=k * stripe_unit, flags=flags)
         self._topology_dirty = True
         return self.osdmap.add_pool(name, pool)
 

@@ -946,11 +946,23 @@ class PG:
                 return
         if msg.op == CEPH_OSD_OP_WATCH and not msg.ops:
             self._do_watch(msg)
+            return
         elif msg.op == CEPH_OSD_OP_UNWATCH and not msg.ops:
             self._do_unwatch(msg)
+            return
         elif msg.op == CEPH_OSD_OP_NOTIFY and not msg.ops:
             self._do_notify(msg)
-        elif msg.ops:
+            return
+        # FLAG_EC_OVERWRITES gate — BEFORE any clone/side effect, and
+        # covering both message shapes (a partial update is a partial
+        # update whether it rides a single op or a vector)
+        if self.backend is not None and \
+                not self.pool.allows_ecoverwrites() and \
+                self._is_partial_update(msg):
+            self.osd.send_op_reply(msg.src, MOSDOpReply(
+                tid=msg.tid, result=-95, epoch=self.osd.osdmap.epoch))
+            return
+        if msg.ops:
             self._do_op_vector(msg)
         elif msg.op == CEPH_OSD_OP_WRITEFULL:
             self.with_clone(msg.oid, lambda: self._do_write(msg))
@@ -1513,6 +1525,16 @@ class PG:
                 self.send_to_osd(osd, MOSDECSubOpWrite(
                     tid=0, pgid=self.pgid, shard=-1, oid=oid,
                     chunk=b"", at_version=-1, version=version))
+
+    _PARTIAL_OPS = frozenset([
+        CEPH_OSD_OP_WRITE, CEPH_OSD_OP_APPEND, CEPH_OSD_OP_TRUNCATE,
+        CEPH_OSD_OP_ZERO,
+    ])
+
+    def _is_partial_update(self, msg: MOSDOp) -> bool:
+        if msg.ops:
+            return any(o.op in self._PARTIAL_OPS for o in msg.ops)
+        return msg.op in (CEPH_OSD_OP_WRITE, CEPH_OSD_OP_APPEND)
 
     def _do_write(self, msg: MOSDOp) -> None:
         if self.backend is not None:

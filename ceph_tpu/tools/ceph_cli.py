@@ -67,13 +67,7 @@ def _osd_df(c) -> None:
 
 
 def _pg_lines(c):
-    seen = set()
-    for osd in c.osds.values():
-        for pgid, pg in osd.pgs.items():
-            if pgid in seen or not pg.is_primary():
-                continue
-            seen.add(pgid)
-            yield pgid, pg
+    return c.primary_pgs()
 
 
 def main(argv=None) -> int:

@@ -186,3 +186,45 @@ def test_replicated_partial_write_and_append():
     final[1234:1234 + len(patch)] = patch
     final += extra
     assert client.read("rp", "ro") == bytes(final)
+
+
+def test_partial_writes_require_ec_overwrites_flag():
+    """Without FLAG_EC_OVERWRITES, offset writes/appends on an EC pool
+    are rejected with EOPNOTSUPP; full-object writes still work
+    (the reference gates rmw behind the pool flag)."""
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=4)
+    c.create_ec_pool("noow", k=2, m=1, plugin="isa", pg_num=4,
+                     ec_overwrites=False)
+    cl = c.client("client.no")
+    assert cl.write_full("noow", "o", b"full-ok") == 0
+    assert cl.write("noow", "o", b"xx", offset=2) == -95
+    assert cl.append("noow", "o", b"yy") == -95
+    assert cl.read("noow", "o") == b"full-ok"
+
+
+def test_overwrites_gate_covers_vectors_and_skips_clones():
+    from ceph_tpu.client import ObjectOperation
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=4)
+    c.create_ec_pool("gv", k=2, m=1, plugin="isa", pg_num=4,
+                     ec_overwrites=False)
+    cl = c.client("client.gv")
+    cl.write_full("gv", "o", b"base")
+    # vector-shaped partial updates are rejected identically
+    for op in (ObjectOperation().write(b"x", 1),
+               ObjectOperation().append(b"x"),
+               ObjectOperation().truncate(2),
+               ObjectOperation().zero(0, 2)):
+        r, _ = cl.operate("gv", "o", op)
+        assert r == -95, r
+    # a rejected partial write must not leave a snapshot clone behind
+    cl.snap_create("gv", "s1")
+    assert cl.write("gv", "o", b"x", offset=1) == -95
+    clones = sum(1 for o in c.osds.values()
+                 for cid in o.store.list_collections()
+                 for ho in o.store.list_objects(cid)
+                 if "\x00snap\x00" in ho.oid)
+    assert clones == 0
+    # write_full still allowed (it replaces, not overwrites)
+    assert cl.write_full("gv", "o", b"replaced") == 0
