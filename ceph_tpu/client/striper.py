@@ -187,15 +187,20 @@ class RadosStriper:
                 raise
             prev_mark = 0
         span = max(old, prev_mark)
+        # bytes above min(size, old) were either destroyed by THIS call
+        # or by a previously failed shrink (the mark) — both must trim,
+        # even when the new size grows past the old one (those bytes
+        # must read as zeros, not resurrect)
+        keep_to = min(size, old)
         op = (ObjectOperation().create(exclusive=False)
               .set_xattr(SIZE_XATTR, struct.pack("<Q", size))
               .set_xattr(TRIM_XATTR, struct.pack("<Q", span)))
         r, _ = self.client.operate(self.pool, first, op)
         if r < 0:
             return r
-        if size < span:
+        if keep_to < span:
             for objectno in self._all_objectnos(span):
-                kept = self._kept_in_object(objectno, size)
+                kept = self._kept_in_object(objectno, keep_to)
                 name = self._obj_name(soid, objectno)
                 if kept == 0 and objectno != 0:
                     r2 = self.client.remove(self.pool, name)
@@ -207,7 +212,7 @@ class RadosStriper:
                         return r2
         r, _ = self.client.operate(self.pool, first, ObjectOperation()
                                    .set_xattr(TRIM_XATTR,
-                                              struct.pack("<Q", size)))
+                                              struct.pack("<Q", keep_to)))
         return r
 
     def remove(self, soid: str, _ignore_missing: bool = False) -> int:

@@ -95,6 +95,138 @@ class CrushWrapper:
         return c
 
     # ---- construction -----------------------------------------------------
+    # ---- crush locations (crush/CrushLocation.cc + CrushWrapper
+    # insert_item/create_or_move_item, CrushWrapper.cc) -------------------
+    @staticmethod
+    def parse_loc(spec) -> list:
+        """"root=default host=h1" or dict -> [(type_name, name), ...]
+        (the osd_crush_location config format)."""
+        if isinstance(spec, dict):
+            return list(spec.items())
+        out = []
+        for tok in str(spec).split():
+            t, _, n = tok.partition("=")
+            if not n:
+                raise ValueError(f"bad crush location token {tok!r}")
+            out.append((t, n))
+        return out
+
+    def _loc_chain(self, loc) -> int:
+        """Ensure the bucket chain described by *loc* exists (creating
+        straw2 buckets as needed, highest type first); returns the
+        LEAF-most bucket id items should land in."""
+        from .constants import CRUSH_BUCKET_STRAW2
+        pairs = self.parse_loc(loc)
+        typed = []
+        for tname, name in pairs:
+            t = self.get_type_id(tname)
+            if t <= 0:
+                raise ValueError(f"unknown crush type {tname!r}")
+            typed.append((t, tname, name))
+        typed.sort(reverse=True)           # root first
+        parent = None
+        for t, _tname, name in typed:
+            if self.name_exists(name):
+                bid = self.get_item_id(name)
+                if bid >= 0:
+                    raise ValueError(f"{name!r} names a device")
+                # an existing but PARENTLESS bucket attaches under the
+                # chain (insert_item's behavior); one already homed
+                # elsewhere stays put — re-homing is move_bucket's job
+                if parent is not None and self._parent_of(bid) is None:
+                    self._bucket_link(parent, bid,
+                                      self.crush.bucket(bid).weight)
+            else:
+                bid = self.add_bucket(CRUSH_BUCKET_STRAW2, t, name,
+                                      [], [])
+                if parent is not None:
+                    self._bucket_link(parent, bid, 0)
+            parent = bid
+        if parent is None:
+            raise ValueError("empty crush location")
+        return parent
+
+    def _parent_of(self, item: int):
+        for b in self.crush.buckets:
+            if b is not None and item in b.items:
+                return b
+        return None
+
+    def _bucket_link(self, parent_id: int, item: int, weight: int) -> None:
+        b = self.crush.bucket(parent_id)
+        b.items.append(item)
+        b.item_weights.append(weight)
+        self._propagate(parent_id, weight)
+
+    def _bucket_unlink(self, item: int) -> int:
+        """Detach *item* from its parent; returns its weight there."""
+        p = self._parent_of(item)
+        if p is None:
+            return 0
+        idx = p.items.index(item)
+        w = p.item_weights.pop(idx)
+        p.items.pop(idx)
+        self._propagate(p.id, -w)
+        return w
+
+    def _propagate(self, bucket_id: int, delta: int) -> None:
+        """Apply a weight delta to a bucket and every ancestor."""
+        b = self.crush.bucket(bucket_id)
+        b.weight += delta
+        p = self._parent_of(bucket_id)
+        if p is not None:
+            idx = p.items.index(bucket_id)
+            p.item_weights[idx] += delta
+            self._propagate(p.id, delta)
+
+    def create_or_move_item(self, item: int, weight: int, name: str,
+                            loc) -> None:
+        """Place a DEVICE at the crush location, creating intermediate
+        buckets and unlinking any previous position — the OSD-boot
+        'ceph osd crush create-or-move' semantics
+        (CrushWrapper::create_or_move_item)."""
+        if item < 0:
+            raise ValueError("devices only; use move_bucket for buckets")
+        leaf = self._loc_chain(loc)
+        self._bucket_unlink(item)
+        self._bucket_link(leaf, item, weight)
+        self.set_item_name(item, name)
+        if item >= self.crush.max_devices:
+            self.crush.max_devices = item + 1
+
+    def move_bucket(self, name: str, loc) -> None:
+        """Re-home an existing bucket under a new location chain
+        (CrushWrapper::move_bucket)."""
+        if not self.name_exists(name):
+            raise ValueError(f"no bucket named {name!r}")
+        bid = self.get_item_id(name)
+        if bid >= 0:
+            raise ValueError(f"{name!r} names a device, not a bucket")
+        leaf = self._loc_chain(loc)
+        # cycle guard (the reference returns -EINVAL): the destination
+        # must not be the bucket itself or anything inside its subtree
+        probe = leaf
+        while probe is not None:
+            if probe == bid:
+                raise ValueError(
+                    f"cannot move {name!r} under its own subtree")
+            parent = self._parent_of(probe)
+            probe = parent.id if parent is not None else None
+        w = self.crush.bucket(bid).weight
+        self._bucket_unlink(bid)
+        self._bucket_link(leaf, bid, w)
+
+    def get_loc(self, item: int) -> list:
+        """[(type_name, bucket_name), ...] from the item up to its root
+        (CrushLocation lookup)."""
+        out = []
+        p = self._parent_of(item)
+        while p is not None:
+            out.append((self.get_type_name(p.type),
+                        self.get_item_name(p.id)))
+            p = self._parent_of(p.id)
+        return out
+
     def add_bucket(self, alg: int, type: int, name: str,
                    items: Sequence[int] = (), weights: Sequence[int] = (),
                    id: int = 0) -> int:

@@ -1,0 +1,94 @@
+"""CrushLocation: create-or-move placement by location string.
+
+crush/CrushLocation.cc + CrushWrapper::create_or_move_item/move_bucket:
+OSDs place themselves by 'root=... host=...' strings at boot; moving an
+item re-homes it and reweights every ancestor.
+"""
+import pytest
+
+from ceph_tpu.crush import CrushWrapper
+
+
+@pytest.fixture()
+def cw():
+    w = CrushWrapper()
+    w.set_type_name(1, "host")
+    w.set_type_name(2, "rack")
+    w.set_type_name(10, "root")
+    return w
+
+
+def test_create_or_move_builds_chain_and_maps(cw):
+    for osd in range(6):
+        cw.create_or_move_item(
+            osd, 0x10000, f"osd.{osd}",
+            f"root=default rack=r{osd % 2} host=h{osd % 3}")
+    cw.set_max_devices(6)
+    root = cw.get_item_id("default")
+    assert cw.crush.bucket(root).weight == 6 * 0x10000
+    # hierarchy: root -> 2 racks -> hosts -> osds
+    racks = cw.crush.bucket(root).items
+    assert len(racks) == 2
+    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    res = cw.do_rule(rno, 1234, 3, [0x10000] * 6)
+    assert len(res) == 3 and len(set(res)) == 3
+
+
+def test_move_rehomes_and_reweights(cw):
+    for osd in range(4):
+        cw.create_or_move_item(osd, 0x10000, f"osd.{osd}",
+                               "root=default host=h0")
+    # move osd.3 to a new host: weights follow
+    cw.create_or_move_item(3, 0x10000, "osd.3", "root=default host=h1")
+    h0 = cw.crush.bucket(cw.get_item_id("h0"))
+    h1 = cw.crush.bucket(cw.get_item_id("h1"))
+    assert h0.weight == 3 * 0x10000 and 3 not in h0.items
+    assert h1.weight == 1 * 0x10000 and 3 in h1.items
+    root = cw.crush.bucket(cw.get_item_id("default"))
+    assert root.weight == 4 * 0x10000
+    # get_loc reports the position bottom-up
+    loc = cw.get_loc(3)
+    assert loc[0] == ("host", "h1") and loc[-1] == ("root", "default")
+
+
+def test_move_bucket(cw):
+    for osd in range(2):
+        cw.create_or_move_item(osd, 0x10000, f"osd.{osd}",
+                               "root=default rack=r0 host=h0")
+    cw.create_or_move_item(2, 0x10000, "osd.2",
+                           "root=default rack=r1 host=h9")
+    # re-home host h0 (2 osds) under rack r1
+    cw.move_bucket("h0", "root=default rack=r1")
+    r0 = cw.crush.bucket(cw.get_item_id("r0"))
+    r1 = cw.crush.bucket(cw.get_item_id("r1"))
+    assert r0.weight == 0 and r1.weight == 3 * 0x10000
+    assert cw.get_item_id("h0") in r1.items
+
+
+def test_bad_locations_rejected(cw):
+    with pytest.raises(ValueError):
+        cw.create_or_move_item(0, 0x10000, "osd.0", "root=default nope")
+    with pytest.raises(ValueError):
+        cw.create_or_move_item(0, 0x10000, "osd.0", "widget=default")
+    with pytest.raises(ValueError):
+        cw.move_bucket("missing-bucket", "root=default")
+
+
+def test_move_into_own_subtree_rejected(cw):
+    cw.create_or_move_item(0, 0x10000, "osd.0",
+                           "root=default rack=r0 host=h0")
+    with pytest.raises(ValueError):
+        cw.move_bucket("r0", "rack=r0")
+    with pytest.raises(ValueError):
+        cw.move_bucket("r0", "root=default rack=r0 host=h0")
+
+
+def test_parentless_bucket_attaches_to_chain(cw):
+    from ceph_tpu.crush import CRUSH_BUCKET_STRAW2
+    # a bucket created standalone (no parent) joins the chain on use
+    cw.add_bucket(CRUSH_BUCKET_STRAW2, 1, "h-solo", [], [])
+    cw.create_or_move_item(0, 0x10000, "osd.0",
+                           "root=default host=h-solo")
+    root = cw.crush.bucket(cw.get_item_id("default"))
+    assert cw.get_item_id("h-solo") in root.items
+    assert root.weight == 0x10000

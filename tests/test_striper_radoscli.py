@@ -165,3 +165,22 @@ def test_ceph_cli_status_surfaces(tmp_path, capsys):
     assert rc == 0 and "acting=" in out
     rc, out = run("df")
     assert "cp" in out
+
+
+def test_truncate_grow_after_failed_shrink_reads_zeros(env):
+    """Even if a shrink's backing trim were lost, a later grow must not
+    resurrect destroyed bytes: the trim mark forces a re-trim bounded
+    by min(new size, old size)."""
+    import struct as _s
+    from ceph_tpu.client.striper import SIZE_XATTR, TRIM_XATTR
+    c, cl = env
+    s = striper(cl)
+    s.write_full("gz", b"D" * 1000)
+    # simulate a shrink whose backing trim never happened: size says 0,
+    # mark says 1000, data still on the shelves
+    first = "gz." + "0" * 16
+    cl.setxattr("st", first, SIZE_XATTR, _s.pack("<Q", 0))
+    cl.setxattr("st", first, TRIM_XATTR, _s.pack("<Q", 1000))
+    # grow: the destroyed bytes must come back as zeros, not "D"
+    assert s.truncate("gz", 600) == 0
+    assert s.read("gz") == b"\0" * 600
