@@ -185,6 +185,11 @@ class MiniCluster:
                 {"enabled": g_kernel_timer.enabled})[1],
             "enable/disable per-kernel timing (adds a sync per call)")
         asok.register(
+            "dump_op_pq_state",
+            lambda c, a: {o.name: o.op_wq.dump()
+                          for o in self.osds.values()},
+            "per-shard op queue sizes and mclock tags")
+        asok.register(
             "arch probe",
             lambda c, a: __import__("ceph_tpu.arch", fromlist=["probe"])
             .probe(),

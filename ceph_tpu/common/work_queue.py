@@ -112,6 +112,14 @@ class MClockQueue:
         self._w_tags[best] = self._w_tags.get(best, 0.0) + 1.0
         return item
 
+    def dump(self) -> Dict:
+        return {
+            "queued": {c: len(q) for c, q in self._queues.items() if q},
+            "vclock": self._now,
+            "r_tags": dict(self._r_tags),
+            "w_tags": dict(self._w_tags),
+        }
+
     def _at_limit(self, c: str) -> bool:
         lim = self.tags[c][2]
         if lim <= 0:
@@ -139,6 +147,11 @@ class ShardedOpWQ:
 
     def __len__(self) -> int:
         return sum(len(s) for s in self.shards)
+
+    def dump(self) -> Dict:
+        """Introspection for the admin socket (dump_op_pq_state role)."""
+        return {f"shard_{i}": sh.dump()
+                for i, sh in enumerate(self.shards)}
 
     def drain(self, handler: Callable, max_ops: int = 0) -> int:
         """Round-robin the shards, QoS-dequeue within each; returns the

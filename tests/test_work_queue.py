@@ -87,3 +87,19 @@ def test_idle_class_cannot_cash_unbounded_deficit():
     first_50 = [q.dequeue()[0] for _ in range(50)]
     # without clamping, scrub's phantom deficit serves ~all of these
     assert first_50.count("s") <= 25, first_50.count("s")
+
+
+def test_op_pq_state_admin_command():
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=3)
+    c.create_replicated_pool("pq", size=3, pg_num=4)
+    cl = c.client("client.pq")
+    cl.write_full("pq", "o", b"x")
+    out = c.admin_socket.execute("dump_op_pq_state")
+    assert "osd.0" in out
+    shard0 = out["osd.0"]["shard_0"]
+    assert "vclock" in shard0 and "queued" in shard0
+    # the dump must reflect REAL activity: the write above flowed
+    # through some shard's arbiter, advancing its virtual clock
+    assert any(sh["vclock"] > 0
+               for osd in out.values() for sh in osd.values())
