@@ -31,30 +31,68 @@ OBJECT_SIZE = 1 << 20           # 1 MiB per object
 CHUNK = OBJECT_SIZE // K        # 128 KiB
 BATCH = 64                      # objects per device call
 TARGET_SECONDS = 3.0
-PROBE_TIMEOUT = float(os.environ.get("CEPH_TPU_BENCH_PROBE_TIMEOUT", "180"))
+PROBE_TIMEOUT = float(os.environ.get("CEPH_TPU_BENCH_PROBE_TIMEOUT", "150"))
+# Total wall budget for accelerator probing.  The tunnel flaps: a dead
+# probe at minute 0 says nothing about minute 5 (round 2 lost its driver
+# bench to exactly that).  Keep retrying inside this window before
+# accepting the CPU fallback.
+PROBE_WINDOW = float(os.environ.get("CEPH_TPU_BENCH_PROBE_WINDOW", "600"))
+PROBE_RETRY_DELAY = 20.0
 
 
-def probe_accelerator() -> str | None:
-    """Return the accelerator platform name, or None if unusable.
-
-    Runs ``jax.devices()`` in a child process so a hung tunnel cannot hang
-    the bench itself; a generous timeout covers the tunnel's slow handshake.
-    """
+def _probe_once(timeout: float) -> tuple[str | None, bool]:
+    """One probe attempt: jax.devices() in a child process so a hung
+    tunnel cannot hang the bench itself.  Returns (platform | None,
+    permanent): permanent means retrying cannot help (jax missing)."""
     code = ("import jax; d = jax.devices(); "
             "print('PLATFORM:' + d[0].platform)")
     try:
         p = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True,
-                           timeout=PROBE_TIMEOUT)
+                           timeout=timeout)
     except Exception:
-        return None
+        return None, False          # hang/timeout: the flaky-tunnel case
     if p.returncode != 0:
-        return None
+        permanent = ("ModuleNotFoundError" in p.stderr
+                     or "ImportError" in p.stderr)
+        return None, permanent
     for line in p.stdout.splitlines():
         if line.startswith("PLATFORM:"):
             plat = line.split(":", 1)[1].strip()
-            return plat if plat != "cpu" else None
-    return None
+            # "cpu" can mean a flapping tunnel plugin that failed to
+            # register and fell back — worth retrying, not permanent
+            return (plat if plat != "cpu" else None), False
+    return None, False
+
+
+def probe_accelerator() -> str | None:
+    """Return the accelerator platform name, or None if unusable.
+
+    Retries failed probes in a bounded loop across PROBE_WINDOW seconds
+    rather than falling back to CPU on the first dead-tunnel handshake;
+    progress goes to stderr so the one stdout line stays pure JSON.
+    """
+    deadline = time.monotonic() + PROBE_WINDOW
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        plat, permanent = _probe_once(min(PROBE_TIMEOUT,
+                                          max(remaining, 30.0)))
+        if plat is not None:
+            if attempt > 1:
+                print(f"[bench] accelerator up on probe #{attempt}",
+                      file=sys.stderr)
+            return plat
+        remaining = deadline - time.monotonic()
+        if permanent or remaining <= PROBE_RETRY_DELAY:
+            print(f"[bench] accelerator unreachable after {attempt} "
+                  f"probes{' (permanent)' if permanent else ''}; "
+                  "cpu fallback", file=sys.stderr)
+            return None
+        print(f"[bench] probe #{attempt} failed; retrying "
+              f"({remaining:.0f}s left in window)", file=sys.stderr)
+        time.sleep(PROBE_RETRY_DELAY)
 
 
 def measure_host(matrix: np.ndarray, data2d: np.ndarray) -> float:
@@ -278,38 +316,45 @@ def main() -> None:
     except Exception as e:
         errors.append(f"host bench failed: {e!r}")
 
-    try:
+    def retry_section(label: str, fn) -> None:
+        # the tunnel can drop a long-running remote compile mid-flight;
+        # re-run the section once (after a settle delay) before
+        # recording the failure
+        for attempt in range(2):
+            try:
+                fn()
+                return
+            except Exception as e:
+                if attempt == 1:
+                    errors.append(f"{label} failed: {e!r}")
+                else:
+                    time.sleep(10.0)
+
+    def encode_section() -> None:
         dev_gibs = measure_device(matrix, batch)
         result["value"] = round(dev_gibs, 3)
         if host_gibs:
             result["vs_baseline"] = round(dev_gibs / host_gibs, 2)
-    except Exception as e:
-        errors.append(f"device bench failed: {e!r}")
 
-    try:
-        result["ec_decode_e2_gibs"] = round(measure_decode(matrix, batch),
-                                            3)
-    except Exception as e:
-        errors.append(f"decode bench failed: {e!r}")
+    def decode_section() -> None:
+        result["ec_decode_e2_gibs"] = round(
+            measure_decode(matrix, batch), 3)
 
-    # the tunnel can drop a long-running remote compile mid-flight;
-    # retry the whole section once before recording the failure
-    for attempt in range(2):
-        try:
-            n_pgs = 100_000 if platform else 10_000
-            wall_ms, dev_ms, host_ms, resid, rtt_ms = measure_crush_remap(
-                n_pgs=n_pgs, epochs=10 if platform else 2)
-            result[f"crush_remap_{n_pgs // 1000}k_pgs_ms"] = round(dev_ms, 1)
-            result["crush_remap_wall_ms"] = round(wall_ms, 1)
-            result["transport_rtt_ms"] = round(rtt_ms, 1)
-            result["crush_residual_fraction"] = resid
-            if host_ms:
-                result["crush_remap_vs_native_host"] = round(
-                    host_ms / dev_ms, 2)
-            break
-        except Exception as e:
-            if attempt == 1:
-                errors.append(f"crush bench failed: {e!r}")
+    def crush_section() -> None:
+        n_pgs = 100_000 if platform else 10_000
+        wall_ms, dev_ms, host_ms, resid, rtt_ms = measure_crush_remap(
+            n_pgs=n_pgs, epochs=10 if platform else 2)
+        result[f"crush_remap_{n_pgs // 1000}k_pgs_ms"] = round(dev_ms, 1)
+        result["crush_remap_wall_ms"] = round(wall_ms, 1)
+        result["transport_rtt_ms"] = round(rtt_ms, 1)
+        result["crush_residual_fraction"] = resid
+        if host_ms:
+            result["crush_remap_vs_native_host"] = round(
+                host_ms / dev_ms, 2)
+
+    retry_section("device bench", encode_section)
+    retry_section("decode bench", decode_section)
+    retry_section("crush bench", crush_section)
 
     if errors:
         result["error"] = "; ".join(errors)

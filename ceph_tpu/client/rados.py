@@ -18,7 +18,8 @@ from ..msg import (
     Dispatcher, MOSDMap, MOSDOp, MOSDOpReply, Message, Network,
 )
 from ..msg.messages import (
-    CEPH_OSD_CMPXATTR_OP_EQ, CEPH_OSD_OP_CMPXATTR, CEPH_OSD_OP_CREATE,
+    CEPH_OSD_CMPXATTR_OP_EQ, CEPH_OSD_OP_ASSERT_VER,
+    CEPH_OSD_OP_CMPXATTR, CEPH_OSD_OP_CREATE,
     CEPH_OSD_OP_FLAG_EXCL, CEPH_OSD_OP_GETXATTR, CEPH_OSD_OP_GETXATTRS,
     CEPH_OSD_OP_OMAPGETVALS, CEPH_OSD_OP_OMAPRMKEYS,
     CEPH_OSD_OP_OMAPSETKEYS, CEPH_OSD_OP_RMXATTR, CEPH_OSD_OP_SETXATTR,
@@ -116,6 +117,12 @@ class ObjectOperation:
 
     def rm_xattr(self, name: str) -> "ObjectOperation":
         self.ops.append(OSDOp(op=CEPH_OSD_OP_RMXATTR, name=name))
+        return self
+
+    def assert_version(self, version: int) -> "ObjectOperation":
+        """Abort the vector with -ERANGE unless the object's version
+        still equals *version* (rados assert_version guard)."""
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_ASSERT_VER, offset=version))
         return self
 
     def cmp_xattr(self, name: str, value: bytes,
@@ -310,37 +317,74 @@ class RadosClient(Dispatcher):
     def rollback(self, pool: str, oid: str, snap) -> int:
         """Restore the head — data AND xattrs — to its state at the
         snap (rados_ioctx_snap_rollback; composed client-side from
-        snap-view reads + one atomic head vector)."""
+        snap-view reads + one atomic head vector).  The final vector is
+        guarded with assert_version on the head version observed before
+        the reads, so a write landing mid-compose aborts the vector
+        (-ERANGE) and the rollback recomposes instead of silently
+        overwriting it."""
         pid = self.lookup_pool(pool)
         snapid = self._resolve_snapid(pool, snap)
-        r = self._submit(pid, oid, CEPH_OSD_OP_READ, snapid=snapid)
-        if r.result == -2:
-            # object did not exist at the snap: remove the head
-            return self.remove(pool, oid)
-        if r.result < 0:
-            # transient failure (EIO/degraded): never touch the head
-            raise IOError(f"rollback read {oid}@{snap}: {r.result}")
-        rs, res = self.operate(pool, oid,
-                               ObjectOperation().get_xattrs(), snap=snap)
-        snap_attrs = _unpack_kv(res[0][1]) if rs == 0 else {}
-        try:
-            head_attrs = self.getxattrs(pool, oid)
-        except IOError:
-            head_attrs = {}
-        op = ObjectOperation().write_full(r.data)
-        for k in head_attrs:
-            if k not in snap_attrs:
-                op.rm_xattr(k)
-        for k, v in snap_attrs.items():
-            op.set_xattr(k, v)
-        r2, _ = self.operate(pool, oid, op)
-        return r2
+        for _ in range(MAX_ATTEMPTS):
+            rv = self._submit(pid, oid, CEPH_OSD_OP_STAT)
+            if rv.result == -2:
+                head_ver = 0
+            elif rv.result < 0:
+                raise IOError(f"rollback stat {oid}: {rv.result}")
+            else:
+                head_ver = rv.version
+            r = self._submit(pid, oid, CEPH_OSD_OP_READ, snapid=snapid)
+            if r.result == -2:
+                # object did not exist at the snap: remove the head
+                r2, _ = self.operate(pool, oid, ObjectOperation()
+                                     .assert_version(head_ver).remove())
+                if r2 == -34:
+                    continue        # head moved under us: recompose
+                return 0 if r2 == -2 else r2    # no head either: no-op
+            if r.result < 0:
+                # transient failure (EIO/degraded): never touch the head
+                raise IOError(f"rollback read {oid}@{snap}: {r.result}")
+            rs, res = self.operate(pool, oid,
+                                   ObjectOperation().get_xattrs(),
+                                   snap=snap)
+            if rs < 0 and rs != -2:
+                # transient xattr-read failure would silently strip the
+                # snap-time xattrs while the data restore succeeds —
+                # same contract as the data read: never touch the head
+                raise IOError(f"rollback xattrs {oid}@{snap}: {rs}")
+            snap_attrs = _unpack_kv(res[0][1]) if rs == 0 else {}
+            try:
+                head_attrs = self.getxattrs(pool, oid)
+            except IOError as e:
+                if e.errno != 2:            # ENOENT = no head attrs
+                    raise
+                head_attrs = {}
+            op = ObjectOperation().assert_version(head_ver) \
+                                  .write_full(r.data)
+            for k in head_attrs:
+                if k not in snap_attrs:
+                    op.rm_xattr(k)
+            for k, v in snap_attrs.items():
+                op.set_xattr(k, v)
+            r2, _ = self.operate(pool, oid, op)
+            if r2 != -34:
+                return r2
+        return -34
 
-    def stat(self, pool: str, oid: str) -> int:
-        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_STAT)
+    def stat(self, pool: str, oid: str, snap=None) -> int:
+        snapid = self._resolve_snapid(pool, snap) if snap else 0
+        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_STAT,
+                         snapid=snapid)
         if r.result < 0:
             raise _ioerror("stat", oid, r.result)
         return struct.unpack("<Q", r.data)[0]
+
+    def get_version(self, pool: str, oid: str) -> int:
+        """Current object version (the stat reply's user_version) —
+        pairs with ObjectOperation.assert_version guards."""
+        r = self._submit(self.lookup_pool(pool), oid, CEPH_OSD_OP_STAT)
+        if r.result < 0:
+            raise _ioerror("stat", oid, r.result)
+        return r.version
 
     def remove(self, pool: str, oid: str) -> int:
         return self._submit(self.lookup_pool(pool), oid,

@@ -220,6 +220,18 @@ class RadosStriper:
             size = self.stat(soid)
         except IOError:
             return 0 if _ignore_missing else -2
-        for objectno in self._all_objectnos(size):
+        # a shrink that died mid-trim leaves backing objects in
+        # (size, mark]; deleting only up to size would orphan them and a
+        # recreated striped object could resurrect their bytes as data
+        try:
+            mark = struct.unpack(
+                "<Q", self.client.getxattr(self.pool,
+                                           self._obj_name(soid, 0),
+                                           TRIM_XATTR))[0]
+        except IOError as e:
+            if not _absent(e):
+                raise
+            mark = 0
+        for objectno in self._all_objectnos(max(size, mark)):
             self.client.remove(self.pool, self._obj_name(soid, objectno))
         return 0

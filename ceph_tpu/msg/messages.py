@@ -48,6 +48,10 @@ CEPH_OSD_OP_CMPXATTR = "cmpxattr"    # guard; flags = comparison operator
 CEPH_OSD_OP_OMAPSETKEYS = "omap_setkeys"   # replicated pools only
 CEPH_OSD_OP_OMAPRMKEYS = "omap_rmkeys"
 CEPH_OSD_OP_OMAPGETVALS = "omap_getvals"
+CEPH_OSD_OP_ASSERT_VER = "assert_ver"  # guard: object version == offset
+                                     # (mismatch -> -ERANGE, like
+                                     # PrimaryLogPG.cc do_osd_ops
+                                     # CEPH_OSD_OP_ASSERT_VER)
 CEPH_OSD_OP_WATCH = "watch"          # register interest (cookie in offset)
 CEPH_OSD_OP_UNWATCH = "unwatch"
 CEPH_OSD_OP_NOTIFY = "notify"        # broadcast to watchers, await acks
@@ -106,6 +110,9 @@ class MOSDOpReply(Message):
     # per-op (result, data) for vector ops, parallel to MOSDOp.ops up to
     # the first failing op (the reference returns per-op rval/outdata)
     op_results: List[Tuple[int, bytes]] = field(default_factory=list)
+    # object version at reply time (the reference's reply user_version);
+    # stamped on stat replies so clients can build assert_ver guards
+    version: int = 0
 
 
 @dataclass

@@ -163,10 +163,20 @@ class TcpNetwork(Network):
                         self._decomps[comp_id] = dec
                     payload = dec.decompress(payload)
                 msg = decode_message(payload)
-            except Exception:   # corrupt frame or codec error (zlib.error
-                                # etc. — each codec raises its own type)
-                # corrupt/unknown frame: count it dropped, keep pumping
+            except Exception as e:  # corrupt frame or codec error
+                                # (zlib.error etc. — each codec raises
+                                # its own type)
+                # count it dropped and keep pumping, but make sustained
+                # failure streams (e.g. a peer speaking an older frame
+                # layout) discoverable: log the first drop and then
+                # every 100th
                 self.dropped += 1
+                if self.dropped == 1 or self.dropped % 100 == 0:
+                    from ..common.dout import dlog
+                    dlog("msg", 0,
+                         f"dropped undecodable frame for {dst} "
+                         f"({self.dropped} total; possible peer wire-"
+                         f"format mismatch): {e!r}")
                 continue
             # enqueue like a local delivery (fault injection still applies)
             self.queue.append((msg.src, dst, msg))

@@ -24,6 +24,7 @@ wait_for_missing_object semantics).
 """
 from __future__ import annotations
 
+import copy
 import struct
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -38,6 +39,7 @@ from ..msg.messages import (
     CEPH_OSD_CMPXATTR_OP_EQ, CEPH_OSD_CMPXATTR_OP_GT,
     CEPH_OSD_CMPXATTR_OP_GTE, CEPH_OSD_CMPXATTR_OP_LT,
     CEPH_OSD_CMPXATTR_OP_LTE, CEPH_OSD_CMPXATTR_OP_NE,
+    CEPH_OSD_OP_ASSERT_VER,
     CEPH_OSD_OP_CMPXATTR, CEPH_OSD_OP_CREATE, CEPH_OSD_OP_FLAG_EXCL,
     CEPH_OSD_OP_GETXATTR, CEPH_OSD_OP_GETXATTRS, CEPH_OSD_OP_OMAPGETVALS,
     CEPH_OSD_OP_OMAPRMKEYS, CEPH_OSD_OP_OMAPSETKEYS, CEPH_OSD_OP_RMXATTR,
@@ -49,8 +51,8 @@ from ..os_store import Transaction, hobject_t
 from .ec_backend import ECBackend, SIZE_ATTR
 from .pg_log import (
     LogEntry, OP_DELETE, OP_MODIFY, PGLog, PG_META_OID, SNAP_CLONE,
-    SNAP_TRIMMED, SNAP_WHITEOUT, encode_snapset, load_snapsets,
-    stage_snapset,
+    SNAP_TRIMMED, SNAP_WHITEOUT, VERSION_ATTR, encode_snapset,
+    load_snapsets, stage_snapset,
 )
 
 STATE_INITIAL = "initial"
@@ -1162,9 +1164,14 @@ class PG:
 
         def rank(entries):
             # trimmed beats clone/whiteout at the same seq, so a trim
-            # tombstone always propagates over the entries it killed
+            # tombstone always propagates over the entries it killed;
+            # ties on max seq break on the highest trimmed seq anywhere
+            # in the history (a tombstone below a surviving live clone
+            # must still dominate the pre-trim history it replaced)
             return (entries[-1][0],
-                    1 if entries[-1][1] == SNAP_TRIMMED else 0)
+                    1 if entries[-1][1] == SNAP_TRIMMED else 0,
+                    max((s for s, k in entries if k == SNAP_TRIMMED),
+                        default=0))
 
         for oid, blob in pairs:
             ents = decode_snapset(blob)
@@ -1254,8 +1261,29 @@ class PG:
     _READONLY_OPS = frozenset([
         CEPH_OSD_OP_READ, CEPH_OSD_OP_STAT, CEPH_OSD_OP_GETXATTR,
         CEPH_OSD_OP_GETXATTRS, CEPH_OSD_OP_OMAPGETVALS,
-        CEPH_OSD_OP_CMPXATTR,
+        CEPH_OSD_OP_CMPXATTR, CEPH_OSD_OP_ASSERT_VER,
     ])
+
+    def _stored_user_version(self, oid: str) -> int:
+        """Current pg_log version stamped on the object's VERSION_ATTR
+        (0 when absent) — the reply user_version analog that assert_ver
+        guards compare against.  Distinct from _object_version, the
+        recovery-path helper whose absent sentinel is -1."""
+        store = self.osd.store
+        if self.backend is not None:
+            shard = self.my_shard()
+            cid = self.backend.shard_cid(shard)
+            ho = hobject_t(oid, shard)
+        else:
+            cid = self.rep_backend.cid()
+            ho = hobject_t(oid)
+        if not store.collection_exists(cid) or not store.exists(cid, ho):
+            return 0
+        try:
+            return struct.unpack("<Q",
+                                 store.getattr(cid, ho, VERSION_ATTR))[0]
+        except KeyError:
+            return 0
 
     def _do_op_vector(self, msg: MOSDOp) -> None:
         """Atomic multi-op execution (PrimaryLogPG::do_osd_ops,
@@ -1325,6 +1353,8 @@ class PG:
             return None
         st = {"exists": res == 0, "body": bytearray(data),
               "attrs": dict(attrs), "omap": dict(omap)}
+        if any(o.op == CEPH_OSD_OP_ASSERT_VER for o in msg.ops):
+            st["cur_version"] = self._stored_user_version(msg.oid)
         existed = st["exists"]
         mutated = meta_mutated = False
         results: List[Tuple[int, bytes]] = []
@@ -1491,6 +1521,11 @@ class PG:
             if ok is None:
                 return -22, b""                     # EINVAL
             return (1, b"") if ok else (-125, b"")  # ECANCELED on mismatch
+        if o == CEPH_OSD_OP_ASSERT_VER:
+            # expected version rides op.offset; mismatch aborts the
+            # vector with ERANGE (PrimaryLogPG.cc do_osd_ops)
+            return (0, b"") if op.offset == st["cur_version"] \
+                else (-34, b"")
         if o in (CEPH_OSD_OP_OMAPSETKEYS, CEPH_OSD_OP_OMAPRMKEYS,
                  CEPH_OSD_OP_OMAPGETVALS):
             if self.backend is not None:
@@ -1602,18 +1637,27 @@ class PG:
         for cb in self._waiting_for_recovery.pop(oid, []):
             cb()
 
+    def _snap_redirect(self, msg: MOSDOp) -> Optional[MOSDOp]:
+        """Resolve msg.snapid to the object serving that snap view;
+        returns the (possibly cloned-and-redirected) msg, or None after
+        replying ENOENT for whiteouts/absent-at-snap."""
+        if not msg.snapid:
+            return msg
+        target = self.resolve_snap(msg.oid, msg.snapid)
+        if target is None:
+            self.osd.send_op_reply(msg.src, MOSDOpReply(
+                tid=msg.tid, result=-2,
+                epoch=self.osd.osdmap.epoch))
+            return None
+        if target != msg.oid:
+            msg = copy.copy(msg)
+            msg.oid = target
+        return msg
+
     def _do_read(self, msg: MOSDOp) -> None:
-        if msg.snapid:
-            target = self.resolve_snap(msg.oid, msg.snapid)
-            if target is None:
-                self.osd.send_op_reply(msg.src, MOSDOpReply(
-                    tid=msg.tid, result=-2,
-                    epoch=self.osd.osdmap.epoch))
-                return
-            if target != msg.oid:
-                import copy as _copy
-                msg = _copy.copy(msg)
-                msg.oid = target
+        msg = self._snap_redirect(msg)
+        if msg is None:
+            return
         if self.backend is not None:
             src = msg.src
 
@@ -1647,6 +1691,9 @@ class PG:
                 rep_read()
 
     def _do_stat(self, msg: MOSDOp) -> None:
+        msg = self._snap_redirect(msg)
+        if msg is None:
+            return
         store = self.osd.store
         if self.backend is not None:
             shard = self.my_shard()
@@ -1665,7 +1712,8 @@ class PG:
             size = store.stat(cid, ho)
         self.osd.send_op_reply(msg.src, MOSDOpReply(
             tid=msg.tid, result=0, data=struct.pack("<Q", size),
-            epoch=self.osd.osdmap.epoch))
+            epoch=self.osd.osdmap.epoch,
+            version=self._stored_user_version(msg.oid)))
 
     def _do_delete(self, msg: MOSDOp) -> None:
         self._fan_delete(msg.oid)

@@ -249,3 +249,45 @@ def test_metadata_reads_on_absent_object_return_enoent(fixture, request):
     if fixture == "rep_cluster":
         with pytest.raises(IOError):
             cl.omap_get(pool, "never-created")
+
+
+# ---- assert_ver guard (PrimaryLogPG.cc do_osd_ops CEPH_OSD_OP_ASSERT_VER)
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_assert_version_guard(fixture, request):
+    """assert_version passes at the observed version, aborts the whole
+    vector with -ERANGE once an intervening write bumps it."""
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    cl.write_full(pool, "av", b"one")
+    v = cl.get_version(pool, "av")
+    assert v > 0
+    r, _ = cl.operate(pool, "av", ObjectOperation()
+                      .assert_version(v).write_full(b"two"))
+    assert r == 0
+    assert cl.read(pool, "av") == b"two"
+    # the guarded write bumped the version: the old guard must now fail
+    # and the payload must NOT land
+    r, _ = cl.operate(pool, "av", ObjectOperation()
+                      .assert_version(v).write_full(b"stale"))
+    assert r == -34
+    assert cl.read(pool, "av") == b"two"
+
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_stat_at_snap_resolves_clone(fixture, request):
+    """Snap-targeted stat sizes the clone, not the head (_do_stat now
+    resolves snapid like _do_read)."""
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    cl.write_full(pool, "ss", b"short")
+    cl.snap_create(pool, "ssnap")
+    cl.write_full(pool, "ss", b"a-much-longer-head-payload")
+    assert cl.stat(pool, "ss") == 26
+    assert cl.stat(pool, "ss", snap="ssnap") == 5
+    # object born after the snap is absent at the snap
+    cl.write_full(pool, "ss2", b"late")
+    cl.snap_create(pool, "ssnap2")
+    cl.write_full(pool, "ss3", b"later")
+    with pytest.raises(IOError):
+        cl.stat(pool, "ss3", snap="ssnap2")

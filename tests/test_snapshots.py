@@ -232,3 +232,42 @@ def test_clone_preserves_omap_on_replicated():
     from ceph_tpu.msg.kv import unpack_kv
     assert unpack_kv(res[0][1]) == {"k": b"v-snap"}
     assert cl.omap_get("sp", "o") == {"k": b"v-head"}
+
+
+def test_stale_peer_tombstone_below_live_clone():
+    """A trim tombstone sitting BELOW a surviving live clone must still
+    dominate a stale peer's pre-trim history of the same max seq
+    (merge_snapsets rank tiebreak): the rejoined peer may never
+    re-reference the trimmed clone."""
+    from ceph_tpu.osd.pg_log import SNAP_CLONE, SNAP_TRIMMED
+    c, cl = make("ec")
+    cl.write_full("sp", "o", b"v1")
+    cl.snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"v2")
+    cl.snap_create("sp", "s2")
+    cl.write_full("sp", "o", b"v3")       # snapset: clone@s1, clone@s2
+    pid = cl.lookup_pool("sp")
+    pgid, primary = cl._calc_target(pid, "o")
+    away = next(o for o in c.osds if o != primary
+                and c.osds[o].pgs.get(pgid) is not None)
+    c.kill_osd(away)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    # trim only the LOWER snap: tombstone@s1 below the live clone@s2
+    cl.snap_remove("sp", "s1")
+    c.network.pump()
+    c.revive_osd(away)
+    for _ in range(4):
+        c.tick(dt=6.0)
+    c.run_recovery()
+    c.network.pump()
+    for o in c.osds.values():
+        pg = o.pgs.get(pgid)
+        if pg is not None:
+            ents = pg.snapsets.get("o", [])
+            kinds = [k for _s, k in ents]
+            assert kinds.count(SNAP_CLONE) <= 1, ents
+            if pg.is_primary():
+                assert SNAP_TRIMMED in kinds, ents
+    assert cl.read("sp", "o", snap="s2") == b"v2"
+    assert cl.read("sp", "o") == b"v3"

@@ -184,3 +184,27 @@ def test_truncate_grow_after_failed_shrink_reads_zeros(env):
     # grow: the destroyed bytes must come back as zeros, not "D"
     assert s.truncate("gz", 600) == 0
     assert s.read("gz") == b"\0" * 600
+
+
+def test_remove_after_failed_shrink_deletes_orphans(env):
+    """remove() honors the trim high-water mark: backing objects in
+    (size, mark] left by a shrink that died mid-trim are deleted too,
+    so a recreated striped object cannot resurrect their bytes."""
+    import struct as _s
+    from ceph_tpu.client.striper import SIZE_XATTR, TRIM_XATTR
+    c, cl = env
+    s = striper(cl)
+    s.write_full("orph", b"D" * 2000)
+    first = "orph." + "0" * 16
+    # simulate a shrink to 100 whose backing trims never ran
+    cl.setxattr("st", first, SIZE_XATTR, _s.pack("<Q", 100))
+    cl.setxattr("st", first, TRIM_XATTR, _s.pack("<Q", 2000))
+    assert s.remove("orph") == 0
+    # every backing object across the full 2000-byte span must be gone
+    for objectno in range(4):       # 2000 B / 512 B object_size
+        with pytest.raises(IOError):
+            cl.stat("st", f"orph.{objectno:016x}")
+    # recreate small, grow into the old span: holes must read as zeros
+    assert s.write_full("orph", b"x" * 10) == 0
+    assert s.truncate("orph", 1500) == 0
+    assert s.read("orph") == b"x" * 10 + b"\0" * 1490
