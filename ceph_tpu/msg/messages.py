@@ -183,6 +183,9 @@ class MOSDPGQuery(Message):
     shard: int = -1
     epoch: int = 0
     log_since: int = -1
+    # >= 0: before replying, rewind your divergent log entries past this
+    # version and roll the touched objects back (rewind_divergent_log)
+    rewind_to: int = -1
 
 
 @dataclass

@@ -57,6 +57,26 @@ def user_attrs_of(attrs: Dict[str, bytes]) -> Dict[str, bytes]:
             if k.startswith(USER_ATTR_PREFIX)}
 
 
+def stash_pre_write_state(t: Transaction, store: MemStore, pg, oid: str,
+                          cid: str, ho, version: int) -> None:
+    """Stash the object's pre-write state (body + every attr) into the
+    PG meta omap in the same transaction as the write, so peering can
+    roll this write back if it proves divergent — the role of the
+    reference's append-only writes + rollback info in the PG log
+    (ECTransaction.h rollback extents, ecbackend.rst:1-27)."""
+    from .pg_log import encode_rollback, stage_rollback
+    exists = store.collection_exists(cid) and store.exists(cid, ho)
+    data = store.read(cid, ho) if exists else b""
+    attrs = dict(store.getattrs(cid, ho)) if exists else {}
+    mcid = pg.meta_cid()
+    if not store.collection_exists(mcid):
+        pre = Transaction()
+        pre.create_collection(mcid)
+        t.ops[0:0] = pre.ops
+    stage_rollback(t, mcid, oid,
+                   encode_rollback(version, exists, data, attrs))
+
+
 class ExtentCache:
     """Projected in-flight object extents (src/osd/ExtentCache.h:23).
 
@@ -528,6 +548,9 @@ class ECBackend:
         if not store.collection_exists(cid):
             t.create_collection(cid)
         ho = hobject_t(msg.oid, msg.shard)
+        if pg is not None and msg.version and not msg.is_push:
+            stash_pre_write_state(t, store, pg, msg.oid, cid, ho,
+                                  msg.version)
         if msg.attr_only:
             # metadata-only mutation: replace user attrs, stamp version,
             # log — leave body/size/hinfo untouched.  A touch that

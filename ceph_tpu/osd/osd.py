@@ -290,6 +290,12 @@ class OSD(Dispatcher):
             ho = hobject_t(msg.oid, msg.shard)
         pg = self.pgs.get(msg.pgid)
         t = Transaction()
+        if pg is not None and pg.backend is not None and msg.version:
+            # EC shards stash the pre-delete state like writes do, so a
+            # delete that reached too few shards can be rolled back
+            from .ec_backend import stash_pre_write_state
+            stash_pre_write_state(t, self.store, pg, msg.oid, cid, ho,
+                                  msg.version)
         if self.store.collection_exists(cid):
             t.remove(cid, ho)
         if pg is not None and msg.version:

@@ -203,6 +203,7 @@ class PG:
         self._peer_pending: Set[int] = set()
         self._peer_infos: Dict[int, MOSDPGInfo] = {}
         self._getlog_pending: Optional[int] = None
+        self._rewind_requested = False
         self._backfill_pending: Set[int] = set()
         self._self_backfill_from: Optional[int] = None
         self._recovering: Set[str] = set()
@@ -340,6 +341,7 @@ class PG:
         self.peering_epoch = epoch
         self._peer_infos.clear()
         self._getlog_pending = None
+        self._rewind_requested = False
         self._backfill_pending.clear()
         self._self_backfill_from = None
         self.missing = {}
@@ -355,6 +357,11 @@ class PG:
     def handle_pg_query(self, msg: MOSDPGQuery) -> None:
         """Any replica (incl. the primary itself): report state; attach
         the log suffix when asked (GetLog)."""
+        if msg.rewind_to >= 0 and msg.shard >= 0 and \
+                msg.epoch >= self.last_epoch_started:
+            # the epoch gate drops destructive rewinds from a superseded
+            # primary (handle_pg_info filters its replies the same way)
+            self._rewind_divergent(msg.rewind_to, msg.shard)
         entries: List[bytes] = []
         if msg.log_since >= 0:
             suffix = self.pg_log.entries_after(msg.log_since)
@@ -541,10 +548,137 @@ class PG:
         if not self._peer_pending:
             self._peering_all_infos()
 
+    def _rewind_divergent(self, to: int, shard: int) -> None:
+        """Rewind this replica's log past *to* and roll every touched
+        object back to its stashed pre-write state (the
+        rewind_divergent_log + rollback step of src/osd/PGLog.cc
+        merge_log, using the append-only/rollback design of
+        doc/dev/osd_internals/erasure_coding/ecbackend.rst:1-27).
+        *shard* is the acting position the requesting primary holds us
+        at.  Objects whose stash can't reach *to* are only destroyed if
+        their on-disk version actually sits past the horizon; otherwise
+        the (valid, old) local chunk is kept and at most re-reported
+        missing so recovery can top it up."""
+        if self.backend is None or self.pg_log.head <= to:
+            return
+        from .pg_log import clear_rollback, load_rollback
+        store = self.osd.store
+        t = Transaction()
+        cid = self.meta_cid()
+        if not store.collection_exists(cid):
+            t.create_collection(cid)
+        dropped = self.pg_log.rewind_to(to, t, cid)
+        dlog("pg", 3,
+             f"pg {self.pgid} rewinding {len(dropped)} divergent "
+             f"entries to v{to}", f"osd.{self.osd.osd_id}")
+        scid = self.backend.shard_cid(shard)
+        handled: Set[str] = set()
+        for e in sorted(dropped, key=lambda e: e.version, reverse=True):
+            if e.oid in handled:
+                continue
+            handled.add(e.oid)
+            ho = hobject_t(e.oid, shard)
+            have = (store.collection_exists(scid)
+                    and store.exists(scid, ho))
+            cur_v = 0
+            if have:
+                try:
+                    cur_v = struct.unpack(
+                        "<Q", store.getattr(scid, ho, VERSION_ATTR))[0]
+                except KeyError:
+                    pass
+            stash = load_rollback(store, cid, e.oid)
+            restorable = (stash is not None and stash[0] == e.version)
+            if restorable and stash[1]:
+                # the stash's own version must sit at/below the horizon,
+                # else it is the residue of an EARLIER divergent write
+                # and restoring it would still leave torn state
+                pv = stash[3].get(VERSION_ATTR)
+                if pv is not None and \
+                        struct.unpack("<Q", pv)[0] > to:
+                    restorable = False
+            if restorable:
+                _v, prev_exists, data, attrs = stash
+                if prev_exists:
+                    if not store.collection_exists(scid):
+                        t.create_collection(scid)
+                    t.touch(scid, ho)
+                    t.truncate(scid, ho, 0)
+                    if data:
+                        t.write(scid, ho, 0, data)
+                    cur = store.getattrs(scid, ho) if have else {}
+                    for k in cur:
+                        if k not in attrs:
+                            t.rmattr(scid, ho, k)
+                    for k, v in attrs.items():
+                        t.setattr(scid, ho, k, v)
+                elif have:
+                    t.remove(scid, ho)
+                clear_rollback(t, cid, e.oid)
+                self.local_missing.pop(e.oid, None)
+            elif have and cur_v <= to:
+                # the divergent entry was merged into our log without
+                # its data ever landing here (activation): the local
+                # chunk predates the horizon and stays valid — keep it
+                if stash is not None:
+                    clear_rollback(t, cid, e.oid)
+                if cur_v < to:
+                    self.local_missing[e.oid] = (to, OP_MODIFY)
+                else:
+                    self.local_missing.pop(e.oid, None)
+            else:
+                # torn local write with no usable stash: drop the copy
+                # and report it missing so recovery rebuilds by decode
+                dlog("pg", 1,
+                     f"pg {self.pgid} no rollback stash for {e.oid}"
+                     f"@v{e.version}; marking missing",
+                     f"osd.{self.osd.osd_id}")
+                if have:
+                    t.remove(scid, ho)
+                if stash is not None:
+                    clear_rollback(t, cid, e.oid)
+                self.local_missing[e.oid] = (to, OP_MODIFY)
+        store.queue_transaction(t)
+
+    def _maybe_rewind_divergent(self) -> bool:
+        """EC interrupted-write consistency: a log entry is recoverable
+        only if at least k shards hold its data, so the roll-forward
+        horizon is the k-th highest last_update among data-bearing
+        acting shards.  Entries past the horizon were partial fan-outs
+        the client never saw acked — tell every shard carrying them to
+        roll back before the logs merge.  Returns True when rewind
+        queries went out (peering resumes on their fresh infos)."""
+        if self.backend is None or self._rewind_requested:
+            return False
+        lus = sorted((info.last_update
+                      for shard, info in self._peer_infos.items()
+                      if shard in info.held_shards),
+                     reverse=True)
+        k = self.backend.k
+        if len(lus) < k:
+            # fewer than k data-bearing shards: nothing is decodable
+            # at ANY version — rolling back could only destroy state
+            return False
+        horizon = lus[k - 1]
+        divergent = [shard for shard, info in self._peer_infos.items()
+                     if info.last_update > horizon]
+        if not divergent:
+            return False
+        self._rewind_requested = True
+        for shard in divergent:
+            self._peer_pending.add(shard)
+            self.send_to_osd(self.acting_shards()[shard], MOSDPGQuery(
+                pgid=self.pgid, shard=shard, epoch=self.peering_epoch,
+                rewind_to=horizon))
+        return True
+
     def _peering_all_infos(self) -> None:
         if self._choose_acting():
             # a pg_temp pin is on its way; the next epoch re-peers with
             # the data-aligned acting set
+            return
+        if self._maybe_rewind_divergent():
+            # divergent shards report fresh infos after rewinding
             return
         infos = self._peer_infos
         auth_shard, auth_lu = None, self.pg_log.head

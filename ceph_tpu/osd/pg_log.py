@@ -85,6 +85,15 @@ class PGLog:
         self.tail = self.entries[0].version - 1 if self.entries else self.head
         meta = hobject_t(PG_META_OID)
         t.omap_rmkeys(cid, meta, [self._key(e.version) for e in drop])
+        # rollback stashes are only consumable while their entry can
+        # still be divergent-rewound, i.e. while the oid has an in-log
+        # entry; once its last entry trims, drop the stash (the
+        # reference similarly trims rollback info past can_rollback_to)
+        live = {e.oid for e in self.entries}
+        dead = sorted({ROLLBACK_KEY_PREFIX + e.oid for e in drop
+                       if e.oid not in live})
+        if dead:
+            t.omap_rmkeys(cid, meta, dead)
         t.setattr(cid, meta, LOG_TAIL_ATTR, struct.pack("<Q", self.tail))
 
     @staticmethod
@@ -119,6 +128,23 @@ class PGLog:
             if e.version > self.head:
                 self.append(e, t, cid)
 
+    def rewind_to(self, version: int, t: Transaction,
+                  cid: str) -> List[LogEntry]:
+        """Drop every entry past *version* and move the head back
+        (rewind_divergent_log, src/osd/PGLog.cc): the divergent suffix
+        is returned (ascending) so the caller can roll the touched
+        objects back.  Persistence rides *t* like append's."""
+        dropped = [e for e in self.entries if e.version > version]
+        if not dropped:
+            return []
+        self.entries = [e for e in self.entries if e.version <= version]
+        self.head = max(version, self.tail)
+        meta = hobject_t(PG_META_OID)
+        t.touch(cid, meta)
+        t.omap_rmkeys(cid, meta, [self._key(e.version) for e in dropped])
+        t.setattr(cid, meta, LAST_UPDATE_ATTR, struct.pack("<Q", self.head))
+        return dropped
+
     # ---- persistence -------------------------------------------------------
     def load(self, store: MemStore, cid: str) -> None:
         meta = hobject_t(PG_META_OID)
@@ -132,10 +158,78 @@ class PGLog:
         omap = store.omap_get(cid, meta)
         self.entries = sorted(
             (LogEntry.decode(v) for k, v in omap.items()
-             if not k.startswith(SNAPSET_KEY_PREFIX)),
+             if k.isdigit()),       # skip snapset/rollback namespaces
             key=lambda e: e.version)
         if self.entries:
             self.head = max(self.head, self.entries[-1].version)
+
+
+# ---- rollback stashes (EC interrupted-write consistency) -------------------
+#
+# The reference makes EC writes atomic-per-stripe by writing append-only
+# and recording roll-back info in the PG log (ECTransaction.h rollback
+# extents; doc/dev/osd_internals/erasure_coding/ecbackend.rst:1-27).  The
+# equivalent here: every versioned shard apply stashes the object's
+# pre-write state (body + attrs) in the meta object's omap first, in the
+# SAME transaction, so peering can restore it if the write proves
+# divergent (reached fewer than k shards before the primary died).  One
+# stash per object — writes on one object serialize through the backend's
+# per-object queue, so at most one write per object is ever in flight.
+
+ROLLBACK_KEY_PREFIX = "rb\x00"   # meta omap namespace for the stashes
+
+
+def encode_rollback(replaced_version: int, prev_exists: bool,
+                    prev_data: bytes,
+                    prev_attrs: Dict[str, bytes]) -> bytes:
+    parts = [struct.pack("<QBI", replaced_version,
+                         1 if prev_exists else 0, len(prev_data)),
+             prev_data, struct.pack("<I", len(prev_attrs))]
+    for k, v in prev_attrs.items():
+        kb = k.encode()
+        parts.append(struct.pack("<II", len(kb), len(v)))
+        parts.append(kb)
+        parts.append(v)
+    return b"".join(parts)
+
+
+def decode_rollback(blob: bytes
+                    ) -> Tuple[int, bool, bytes, Dict[str, bytes]]:
+    version, exists, dlen = struct.unpack_from("<QBI", blob)
+    off = 13
+    data = blob[off:off + dlen]
+    off += dlen
+    (n,) = struct.unpack_from("<I", blob, off)
+    off += 4
+    attrs: Dict[str, bytes] = {}
+    for _ in range(n):
+        klen, vlen = struct.unpack_from("<II", blob, off)
+        off += 8
+        attrs[blob[off:off + klen].decode()] = blob[off + klen:
+                                                    off + klen + vlen]
+        off += klen + vlen
+    return version, bool(exists), data, attrs
+
+
+def stage_rollback(t: Transaction, cid: str, oid: str,
+                   blob: bytes) -> None:
+    meta = hobject_t(PG_META_OID)
+    t.touch(cid, meta)
+    t.omap_setkeys(cid, meta, {ROLLBACK_KEY_PREFIX + oid: blob})
+
+
+def clear_rollback(t: Transaction, cid: str, oid: str) -> None:
+    meta = hobject_t(PG_META_OID)
+    t.omap_rmkeys(cid, meta, [ROLLBACK_KEY_PREFIX + oid])
+
+
+def load_rollback(store: MemStore, cid: str, oid: str
+                  ) -> Optional[Tuple[int, bool, bytes, Dict[str, bytes]]]:
+    meta = hobject_t(PG_META_OID)
+    if not store.collection_exists(cid) or not store.exists(cid, meta):
+        return None
+    blob = store.omap_get(cid, meta).get(ROLLBACK_KEY_PREFIX + oid)
+    return decode_rollback(blob) if blob else None
 
 
 # ---- snapsets (per-head clone bookkeeping in the same meta object) ---------

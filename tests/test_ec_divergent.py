@@ -1,0 +1,185 @@
+"""EC interrupted-write consistency (divergent-log rewind + rollback).
+
+The reference makes EC writes atomic-per-stripe with append-only writes
+plus roll-back info in the PG log (ECTransaction.h rollback extents;
+doc/dev/osd_internals/erasure_coding/ecbackend.rst:1-27) and rewinds
+divergent entries at peering (src/osd/PGLog.cc rewind_divergent_log /
+merge_log).  These tests kill the primary between the MOSDECSubOpWrite
+fan-out and all_commit and prove the stripe converges: every surviving
+shard lands on ONE version and reads return either the old or the new
+payload, never a torn mix.
+"""
+import struct
+
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.os_store import hobject_t
+from ceph_tpu.osd.pg_log import VERSION_ATTR
+
+OLD = b"A" * 4096
+NEW = b"B" * 4096
+
+
+def make_cluster():
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("dp", k=2, m=1, plugin="isa", pg_num=1)
+    return c, c.client("client.d")
+
+
+def pg_of(c, cl, oid):
+    pid = cl.lookup_pool("dp")
+    pgid, primary = cl._calc_target(pid, oid)
+    return pgid, primary, c.osds[primary].pgs[pgid]
+
+
+def shard_versions(c, pgid, oid):
+    """shard position -> stored VERSION_ATTR across every live osd."""
+    out = {}
+    for osd in c.osds.values():
+        pg = osd.pgs.get(pgid)
+        if pg is None or pg.backend is None:
+            continue
+        shard = pg.my_shard()
+        if shard < 0:
+            continue
+        cid = pg.backend.shard_cid(shard)
+        ho = hobject_t(oid, shard)
+        store = osd.store
+        if store.collection_exists(cid) and store.exists(cid, ho):
+            try:
+                v = struct.unpack(
+                    "<Q", store.getattr(cid, ho, VERSION_ATTR))[0]
+            except KeyError:
+                v = 0
+            out[shard] = v
+    return out
+
+
+def settle(c, ticks=6):
+    for _ in range(ticks):
+        c.tick(dt=6.0)
+    c.run_recovery()
+    c.network.pump()
+
+
+def test_partial_fanout_rolls_back_to_old_data():
+    """Write reaches fewer than k shards before the primary dies: the
+    divergent entry must be rolled back and reads must return the OLD
+    payload — the new one is undecodable and was never acked."""
+    c, cl = make_cluster()
+    assert cl.write_full("dp", "o", OLD) == 0
+    pgid, primary, pg = pg_of(c, cl, "o")
+    others = [o for o in pg.acting if o != primary]
+    # the fan-out to every non-primary shard goes dark: only the
+    # primary's own shard applies the new version
+    for o in others:
+        c.network.blackhole(f"osd.{primary}", f"osd.{o}")
+    r = cl.write_full("dp", "o", NEW)
+    assert r != 0            # all_commit never fired: no ack
+    vs = shard_versions(c, pgid, "o")
+    assert len(set(vs.values())) == 2, vs     # genuinely torn right now
+    for o in others:
+        c.network.blackhole(f"osd.{primary}", f"osd.{o}", on=False)
+    c.kill_osd(primary)
+    settle(c)
+    assert cl.read("dp", "o") == OLD
+    # the divergent shard rejoins: peering must rewind it via its
+    # rollback stash, converging every shard on the old version
+    c.revive_osd(primary)
+    settle(c)
+    settle(c)
+    vs = shard_versions(c, pgid, "o")
+    assert len(set(vs.values())) == 1, vs
+    assert cl.read("dp", "o") == OLD
+    # the pool keeps working at full health: a new write commits
+    assert cl.write_full("dp", "o", b"C" * 1024) == 0
+    assert cl.read("dp", "o") == b"C" * 1024
+
+
+def test_full_fanout_unacked_rolls_forward_to_new_data():
+    """Every shard applied the write but the primary died before acking:
+    >= k shards hold the new version, so peering rolls FORWARD and reads
+    return the NEW payload."""
+    c, cl = make_cluster()
+    assert cl.write_full("dp", "o", OLD) == 0
+    pgid, primary, pg = pg_of(c, cl, "o")
+    others = [o for o in pg.acting if o != primary]
+    # fan-out delivers everywhere; the commit REPLIES go dark, so
+    # all_commit never fires on the primary and the client sees no ack
+    for o in others:
+        c.network.blackhole(f"osd.{o}", f"osd.{primary}")
+    r = cl.write_full("dp", "o", NEW)
+    assert r != 0
+    vs = shard_versions(c, pgid, "o")
+    assert len(set(vs.values())) == 1, vs     # all applied the write
+    for o in others:
+        c.network.blackhole(f"osd.{o}", f"osd.{primary}", on=False)
+    c.kill_osd(primary)
+    settle(c)
+    assert cl.read("dp", "o") == NEW
+    c.revive_osd(primary)
+    settle(c)
+    settle(c)
+    vs = shard_versions(c, pgid, "o")
+    assert len(set(vs.values())) == 1, vs
+    assert cl.read("dp", "o") == NEW
+
+
+def test_divergent_delete_rolls_back():
+    """A delete that reached only a minority of shards is rolled back:
+    the object survives with its pre-delete payload and attrs."""
+    c, cl = make_cluster()
+    assert cl.write_full("dp", "o", OLD) == 0
+    assert cl.setxattr("dp", "o", "tag", b"keep") == 0
+    pgid, primary, pg = pg_of(c, cl, "o")
+    others = [o for o in pg.acting if o != primary]
+    for o in others:
+        c.network.blackhole(f"osd.{primary}", f"osd.{o}")
+    cl.remove("dp", "o")     # applies only on the primary's shard
+    for o in others:
+        c.network.blackhole(f"osd.{primary}", f"osd.{o}", on=False)
+    c.kill_osd(primary)
+    settle(c)
+    assert cl.read("dp", "o") == OLD
+    c.revive_osd(primary)
+    settle(c)
+    settle(c)
+    vs = shard_versions(c, pgid, "o")
+    assert len(set(vs.values())) == 1, vs
+    assert cl.read("dp", "o") == OLD
+    assert cl.getxattr("dp", "o", "tag") == b"keep"
+
+
+def test_thrash_partial_fanouts_never_torn():
+    """Thrasher-style loop: repeated partial fan-outs + primary kills.
+    Invariant after every convergence: the read returns a payload some
+    client write actually produced — never a torn mix."""
+    c, cl = make_cluster()
+    payloads = [bytes([0x41 + i]) * 2048 for i in range(4)]
+    assert cl.write_full("dp", "t", payloads[0]) == 0
+    legal = {payloads[0]}
+    for i in range(1, 4):
+        pgid, primary, pg = pg_of(c, cl, "t")
+        others = [o for o in pg.acting if o != primary]
+        dark = others[: i % 2 + 1]       # vary how far the fan-out got
+        for o in dark:
+            c.network.blackhole(f"osd.{primary}", f"osd.{o}")
+        r = cl.write_full("dp", "t", payloads[i])
+        legal.add(payloads[i])
+        for o in dark:
+            c.network.blackhole(f"osd.{primary}", f"osd.{o}", on=False)
+        c.kill_osd(primary)
+        settle(c)
+        data = cl.read("dp", "t")
+        assert data in legal, f"torn read on round {i}"
+        c.revive_osd(primary)
+        settle(c)
+        settle(c)
+        data2 = cl.read("dp", "t")
+        assert data2 in legal, f"torn read after rejoin on round {i}"
+        vs = shard_versions(c, pgid, "t")
+        assert len(set(vs.values())) == 1, vs
+        # re-establish a known committed baseline for the next round
+        assert cl.write_full("dp", "t", payloads[i]) == 0
+        legal = {payloads[i]}

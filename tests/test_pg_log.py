@@ -329,3 +329,58 @@ def test_replicated_stale_primary_pulls_not_pushes():
                 if ho.oid == "x":
                     assert bytes(osd.store.read(cid, ho)) == \
                         payload(seed=2), f"osd.{osd.osd_id} stale"
+
+
+def test_rewind_to_drops_suffix_and_persists():
+    store = MemStore()
+    t = Transaction()
+    t.create_collection("meta")
+    store.queue_transaction(t)
+    log = PGLog(max_entries=50)
+    for v in range(1, 9):
+        t = Transaction()
+        log.append(LogEntry(v, f"o{v}", OP_MODIFY), t, "meta")
+        store.queue_transaction(t)
+    t = Transaction()
+    dropped = log.rewind_to(5, t, "meta")
+    store.queue_transaction(t)
+    assert [e.version for e in dropped] == [6, 7, 8]
+    assert log.head == 5
+    assert [e.version for e in log.entries] == [1, 2, 3, 4, 5]
+    # persisted: a reload sees the rewound state, appends resume at 6
+    log2 = PGLog(max_entries=50)
+    log2.load(store, "meta")
+    assert log2.head == 5
+    assert [e.version for e in log2.entries] == [1, 2, 3, 4, 5]
+    t = Transaction()
+    log2.append(LogEntry(6, "new", OP_MODIFY), t, "meta")
+    store.queue_transaction(t)
+    assert log2.head == 6
+
+
+def test_trim_clears_dead_rollback_stashes():
+    """A stash is consumable only while its oid still has an in-log
+    entry; trimming the oid's last entry must drop the stash, while an
+    oid that keeps a live entry keeps its stash."""
+    from ceph_tpu.osd.pg_log import (
+        ROLLBACK_KEY_PREFIX, encode_rollback, load_rollback,
+        stage_rollback,
+    )
+    store = MemStore()
+    t = Transaction()
+    t.create_collection("meta")
+    store.queue_transaction(t)
+    log = PGLog(max_entries=3)
+    # o1 written at v1 only (will trim); o2 at v2 AND v5 (stays live)
+    seq = [(1, "o1"), (2, "o2"), (3, "o3"), (4, "o4"), (5, "o2")]
+    for v, oid in seq:
+        t = Transaction()
+        stage_rollback(t, "meta", oid,
+                       encode_rollback(v, True, b"prev", {}))
+        log.append(LogEntry(v, oid, OP_MODIFY), t, "meta")
+        store.queue_transaction(t)
+    # max_entries=3: entries 1-2 trimmed; o1 has no live entry -> stash
+    # gone; o2's latest entry (v5) is live -> stash kept
+    assert load_rollback(store, "meta", "o1") is None
+    kept = load_rollback(store, "meta", "o2")
+    assert kept is not None and kept[0] == 5
