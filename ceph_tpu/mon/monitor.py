@@ -62,6 +62,17 @@ class Monitor(Dispatcher):
         self._peer_ranks: Dict[str, int] = {}
         self._last_peer_seen: Dict[int, float] = {}
         self.now = 0.0
+        # ---- paxos state (Paxos.cc begin/accept/commit) -------------------
+        # leader: the value currently awaiting an accept quorum, plus
+        # proposals queued behind it (Paxos allows one in flight)
+        self._inflight: Optional[Dict] = None
+        self._pending_proposals: List[Dict] = []
+        # any replica: a value staged at BEGIN but not yet known
+        # committed: (pn, epoch, value_dict, locally_prematerialized)
+        self._uncommitted: Optional[tuple] = None
+        # leader recovery (collect/last): acks seen + best uncommitted
+        self._collect_acks: Set[int] = set()
+        self._collect_uncommitted: Optional[tuple] = None
 
     # ---- roles -------------------------------------------------------------
     def is_leader(self) -> bool:
@@ -90,6 +101,7 @@ class Monitor(Dispatcher):
             self.leader_rank = self.rank
             self.quorum = {self.rank}
             return
+        self._demote_inflight()
         self.election_epoch += 1
         if self.election_epoch % 2 == 0:
             self.election_epoch += 1      # odd = electing
@@ -135,6 +147,8 @@ class Monitor(Dispatcher):
             if len(self._election_acks) >= self._majority():
                 self._declare_victory()
         elif msg.op == MMonElection.OP_VICTORY:
+            if msg.rank != self.rank:
+                self._demote_inflight()
             self.election_epoch = msg.epoch
             self.leader_rank = msg.rank
             self.quorum = set(msg.quorum)
@@ -148,7 +162,13 @@ class Monitor(Dispatcher):
             self.messenger.send_message(MMonElection(
                 op=MMonElection.OP_VICTORY, epoch=self.election_epoch,
                 rank=self.rank, quorum=sorted(self.quorum)), p)
-        # recovery: learn whatever the quorum committed that we missed
+        # recovery (collect/last): learn whatever the quorum committed
+        # that we missed, and surface any staged-but-uncommitted value —
+        # starting with our own — so a possibly-majority-accepted
+        # proposal gets finished (Paxos.cc leader recovery)
+        self._collect_acks = {self.rank}
+        self._collect_uncommitted = self._uncommitted
+        self._uncommitted = None
         for r in self.quorum - {self.rank}:
             name = self._peer_name(r)
             if name:
@@ -157,55 +177,173 @@ class Monitor(Dispatcher):
                     pn=self.election_epoch,
                     last_committed=self.osdmap.epoch), name)
 
-    # ---- paxos-lite replication (Paxos.cc, leader-driven) -----------------
+    # ---- paxos replication (Paxos.cc begin/accept/commit) -----------------
+    #
+    # A value is committed only after a majority ACCEPTs it: the leader
+    # stages it in _inflight and ships OP_BEGIN; peons STAGE it (no map
+    # mutation) and ACCEPT; the leader applies + broadcasts OP_COMMIT
+    # once accepts (incl. its own) reach a majority.  A leader
+    # partitioned mid-BEGIN therefore never exposes the value anywhere;
+    # a value a majority staged survives leader death via the
+    # collect/LAST recovery re-proposal.
+
+    def _demote_inflight(self) -> None:
+        """Leadership lost (or contested): our in-flight proposal is no
+        longer ours to commit — keep it staged like a peon would, so
+        collect recovery can surface it."""
+        fl = self._inflight
+        if fl is not None:
+            self._inflight = None
+            self._uncommitted = (fl["pn"], fl["epoch"], fl["value"],
+                                 fl["topology"])
+        self._pending_proposals.clear()
+
+    def _discard_uncommitted(self) -> None:
+        """Drop the staged value; if it was our own topology proposal
+        the working map was mutated in place before the commit — rebuild
+        it from the committed history so the ghost state vanishes."""
+        u = self._uncommitted
+        self._uncommitted = None
+        if u is not None and u[3]:
+            self._rebuild_from_incrementals()
+
+    def _rebuild_from_incrementals(self) -> None:
+        m = OSDMap()
+        m.epoch = 0
+        for inc in self.incrementals:
+            m.apply_incremental(inc)
+        self.osdmap = m
+        self._topology_dirty = False
+
+    def _apply_committed_values(self, values: List) -> None:
+        from ..osdmap.encoding import incremental_from_dict
+        for d in values:
+            inc = incremental_from_dict(d)
+            if inc.epoch != self.osdmap.epoch + 1:
+                continue
+            if self._uncommitted is not None and \
+                    inc.epoch >= self._uncommitted[1]:
+                # the round our staged value hoped to win is decided
+                self._discard_uncommitted()
+            self.osdmap.apply_incremental(inc)
+            self.incrementals.append(inc)
+
     def _handle_paxos(self, msg: MMonPaxos) -> None:
         from ..osdmap.encoding import incremental_from_dict, \
             incremental_to_dict
         if msg.op == MMonPaxos.OP_COLLECT:
-            # new leader asks what we committed past its epoch
+            # new leader asks what we committed past its epoch — a
+            # higher proposal number also supersedes our own leadership
+            if msg.pn >= self.election_epoch:
+                self._demote_inflight()
             deltas = [incremental_to_dict(i) for i in self.incrementals
                       if i.epoch > msg.last_committed]
+            u = self._uncommitted
             self.messenger.send_message(MMonPaxos(
                 op=MMonPaxos.OP_LAST, rank=self.rank,
                 pn=msg.pn, last_committed=self.osdmap.epoch,
-                values=deltas), msg.src)
+                values=deltas,
+                uncommitted_pn=u[0] if u else -1,
+                uncommitted_value=list(u[1:3]) if u else None), msg.src)
         elif msg.op == MMonPaxos.OP_LAST:
-            for d in msg.values:
-                inc = incremental_from_dict(d)
-                if inc.epoch == self.osdmap.epoch + 1:
-                    self.osdmap.apply_incremental(inc)
-                    self.incrementals.append(inc)
-            # push our surplus back so the peon catches up
-            if msg.last_committed < self.osdmap.epoch:
-                name = self._peer_name(msg.rank) or msg.src
-                deltas = [incremental_to_dict(i) for i in self.incrementals
-                          if i.epoch > msg.last_committed]
-                self.messenger.send_message(MMonPaxos(
-                    op=MMonPaxos.OP_BEGIN, rank=self.rank,
-                    pn=self.election_epoch,
-                    last_committed=self.osdmap.epoch,
-                    values=deltas), name)
+            if not self.is_leader():
+                return
+            self._apply_committed_values(msg.values)
+            self._collect_acks.add(msg.rank)
+            if msg.uncommitted_value is not None:
+                best = self._collect_uncommitted
+                if best is None or msg.uncommitted_pn > best[0]:
+                    if best is not None and best[3]:
+                        # our own superseded topology proposal: purge
+                        # its in-place map mutations before replacing
+                        self._rebuild_from_incrementals()
+                    ep, val = msg.uncommitted_value
+                    self._collect_uncommitted = (msg.uncommitted_pn,
+                                                 ep, val, False)
+            # push our surplus back so the peon catches up (these are
+            # committed epochs: OP_COMMIT, not a new proposal)
+            self._send_commit_surplus(msg.last_committed,
+                                      self._peer_name(msg.rank)
+                                      or msg.src)
+            if len(self._collect_acks) >= self._majority():
+                self._finish_collect()
         elif msg.op == MMonPaxos.OP_BEGIN:
-            # peon: apply+persist the proposed epochs, then accept
-            for d in msg.values:
+            # peon: STAGE the proposed value and accept — commitment is
+            # the leader's call once a majority accepted.  A stale
+            # proposal number (superseded leader) gets no promise.
+            if msg.pn < self.election_epoch:
+                return
+            if msg.values:
+                d = msg.values[-1]
                 inc = incremental_from_dict(d)
-                if inc.epoch == self.osdmap.epoch + 1:
-                    self.osdmap.apply_incremental(inc)
-                    self.incrementals.append(inc)
+                if inc.epoch > self.osdmap.epoch:
+                    if self._uncommitted is not None and \
+                            self._uncommitted[0] <= msg.pn:
+                        self._discard_uncommitted()
+                    self._uncommitted = (msg.pn, inc.epoch, d, False)
             self.messenger.send_message(MMonPaxos(
                 op=MMonPaxos.OP_ACCEPT, rank=self.rank, pn=msg.pn,
                 last_committed=self.osdmap.epoch), msg.src)
         elif msg.op == MMonPaxos.OP_ACCEPT:
-            pass  # leader bookkeeping only; commit is implicit at accept
+            fl = self._inflight
+            if self.is_leader() and fl is not None and msg.pn == fl["pn"]:
+                fl["accepts"].add(msg.rank)
+                # a lagging accepter also gets the committed surplus
+                self._send_commit_surplus(msg.last_committed, msg.src)
+                self._maybe_commit()
         elif msg.op == MMonPaxos.OP_COMMIT:
-            pass
+            self._apply_committed_values(msg.values)
 
-    def _replicate(self, inc: Incremental) -> None:
-        """Leader: ship the committed epoch to the peon quorum."""
-        if not self.is_leader() or not self.peers:
+    def _send_commit_surplus(self, peer_committed: int,
+                             dst: Optional[str]) -> None:
+        """Catch a lagging peer up with committed epochs (OP_COMMIT —
+        these are decided values, not a proposal)."""
+        if dst is None or peer_committed >= self.osdmap.epoch:
             return
         from ..osdmap.encoding import incremental_to_dict
-        d = incremental_to_dict(inc)
+        deltas = [incremental_to_dict(i) for i in self.incrementals
+                  if i.epoch > peer_committed]
+        self.messenger.send_message(MMonPaxos(
+            op=MMonPaxos.OP_COMMIT, rank=self.rank,
+            pn=self.election_epoch,
+            last_committed=self.osdmap.epoch, values=deltas), dst)
+
+    def _finish_collect(self) -> None:
+        """A majority answered the collect: finish any surfaced
+        uncommitted value whose round is still undecided by re-proposing
+        it under our proposal number (Paxos.cc begin after collect)."""
+        cu = self._collect_uncommitted
+        self._collect_uncommitted = None
+        if cu is None:
+            return
+        if cu[3]:
+            # our own demoted topology proposal mutated the working map
+            # in place; revert to the committed history first — if the
+            # value still wins, the commit below re-applies it cleanly
+            self._rebuild_from_incrementals()
+        if cu[1] == self.osdmap.epoch + 1:
+            from ..osdmap.encoding import incremental_from_dict
+            inc = incremental_from_dict(cu[2])
+            self._propose(inc, topology=False)
+
+    # ---- proposal machinery (leader) --------------------------------------
+    def _propose(self, inc: Incremental, topology: bool) -> None:
+        self._pending_proposals.append({"inc": inc,
+                                        "topology": topology})
+        self._try_begin()
+
+    def _try_begin(self) -> None:
+        from ..osdmap.encoding import incremental_to_dict
+        if self._inflight is not None or not self._pending_proposals:
+            return
+        p = self._pending_proposals.pop(0)
+        epoch = self.osdmap.epoch + 1
+        p["inc"].epoch = epoch
+        d = incremental_to_dict(p["inc"])
+        self._inflight = {"pn": self.election_epoch, "epoch": epoch,
+                          "inc": p["inc"], "value": d,
+                          "topology": p["topology"],
+                          "accepts": {self.rank}}
         for r in self.quorum - {self.rank}:
             name = self._peer_name(r)
             if name:
@@ -213,6 +351,45 @@ class Monitor(Dispatcher):
                     op=MMonPaxos.OP_BEGIN, rank=self.rank,
                     pn=self.election_epoch,
                     last_committed=self.osdmap.epoch, values=[d]), name)
+        self._maybe_commit()   # a self-quorum commits immediately
+
+    def _maybe_commit(self) -> None:
+        from ..osdmap.encoding import incremental_to_dict
+        fl = self._inflight
+        if fl is None or len(fl["accepts"]) < self._majority():
+            return
+        self._inflight = None
+        inc = fl["inc"]
+        if fl["topology"]:
+            # the working map already holds the topology state (mutated
+            # in place by create_*): commitment = the epoch bump, plus
+            # any up/weight delta that was folded into the snapshot
+            # (applied field-wise — apply_incremental would alias the
+            # snapshot's crush/pool objects into the working map)
+            from ..osdmap.osdmap import CEPH_OSD_EXISTS, CEPH_OSD_UP
+            self.osdmap.epoch = fl["epoch"]
+            for osd, up in inc.new_up.items():
+                st = self.osdmap.osd_state[osd] | CEPH_OSD_EXISTS
+                self.osdmap.osd_state[osd] = \
+                    (st | CEPH_OSD_UP) if up else (st & ~CEPH_OSD_UP)
+            for osd, w in inc.new_weight.items():
+                self.osdmap.osd_state[osd] |= CEPH_OSD_EXISTS
+                self.osdmap.osd_weight[osd] = w
+        else:
+            self.osdmap.apply_incremental(inc)
+        self.incrementals.append(inc)
+        for r in self.quorum - {self.rank}:
+            name = self._peer_name(r)
+            if name:
+                self.messenger.send_message(MMonPaxos(
+                    op=MMonPaxos.OP_COMMIT, rank=self.rank,
+                    pn=fl["pn"], last_committed=self.osdmap.epoch,
+                    values=[fl["value"]]), name)
+        for sub in self.subscribers:
+            self.messenger.send_message(
+                MOSDMap(first=inc.epoch, last=inc.epoch,
+                        incrementals=[inc]), sub)
+        self._try_begin()
 
     # ---- liveness (elector keepalives) ------------------------------------
     def tick(self, now: float) -> None:
@@ -379,7 +556,6 @@ class Monitor(Dispatcher):
             raise RuntimeError(
                 f"{self.name}: not the quorum leader "
                 f"(leader_rank={self.leader_rank}, quorum={self.quorum})")
-        epoch = self.osdmap.epoch + 1
         if self._topology_dirty:
             delta = inc
             inc = self._snapshot_inc()
@@ -387,24 +563,14 @@ class Monitor(Dispatcher):
                 inc.new_up.update(delta.new_up)
                 inc.new_weight.update(delta.new_weight)
             self._topology_dirty = False
-            if delta is not None:
-                delta.epoch = epoch
-                self.osdmap.apply_incremental(delta)
-            else:
-                # mon map already holds the state; just bump the epoch
-                self.osdmap.epoch = epoch
+            topology = True
         else:
-            if inc is None:
-                inc = Incremental()
-            inc.epoch = epoch
-            self.osdmap.apply_incremental(inc)
-        inc.epoch = epoch
-        self.incrementals.append(inc)
-        self._replicate(inc)
-        for sub in self.subscribers:
-            self.messenger.send_message(
-                MOSDMap(first=inc.epoch, last=inc.epoch,
-                        incrementals=[inc]), sub)
+            inc = inc if inc is not None else Incremental()
+            topology = False
+        # commitment is deferred to the accept quorum: a single mon (its
+        # own majority) commits inline, a multi-mon cluster commits when
+        # the peon ACCEPTs drain (the next network pump)
+        self._propose(inc, topology)
 
     def send_full_map(self, dst: str) -> None:
         self.messenger.send_message(

@@ -326,6 +326,11 @@ class MMonPaxos(Message):
     last_committed: int = 0
     values: List[Any] = field(default_factory=list)
     # values = incremental dicts (osdmap/encoding) being replicated
+    # LAST replies also surface any staged-but-uncommitted value so a
+    # new leader can finish a possibly-majority-accepted proposal
+    # (Paxos.cc handle_last uncommitted_v/uncommitted_pn)
+    uncommitted_pn: int = -1
+    uncommitted_value: Optional[Any] = None
 
 
 @dataclass

@@ -68,11 +68,7 @@ def stash_pre_write_state(t: Transaction, store: MemStore, pg, oid: str,
     exists = store.collection_exists(cid) and store.exists(cid, ho)
     data = store.read(cid, ho) if exists else b""
     attrs = dict(store.getattrs(cid, ho)) if exists else {}
-    mcid = pg.meta_cid()
-    if not store.collection_exists(mcid):
-        pre = Transaction()
-        pre.create_collection(mcid)
-        t.ops[0:0] = pre.ops
+    mcid = pg.ensure_meta_collection(t)
     stage_rollback(t, mcid, oid,
                    encode_rollback(version, exists, data, attrs))
 

@@ -238,13 +238,20 @@ class PG:
                                   self.pg_log.head) + 1
         return self._version_alloc
 
-    def append_log(self, entry: LogEntry, t: Transaction) -> None:
-        """Stage a log append into *t* (the data-write transaction)."""
+    def ensure_meta_collection(self, t: Transaction) -> str:
+        """Make sure *t* creates the meta collection if absent (spliced
+        at the front so later ops in *t* can target it); returns its
+        cid."""
         cid = self.meta_cid()
         if not self.osd.store.collection_exists(cid):
             pre = Transaction()
             pre.create_collection(cid)
-            t.ops[0:0] = pre.ops
+            t.ops[0:0] = pre.ops      # mkcoll is idempotent in the store
+        return cid
+
+    def append_log(self, entry: LogEntry, t: Transaction) -> None:
+        """Stage a log append into *t* (the data-write transaction)."""
+        cid = self.ensure_meta_collection(t)
         if entry.version > self.pg_log.head:
             self.pg_log.append(entry, t, cid)
 

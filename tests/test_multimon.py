@@ -191,3 +191,119 @@ def test_multimon_checkpoint_restore(tmp_path):
         r.tick(dt=6.0)
     assert r.mon.name == "mon.1"
     assert cl2.read("p", "o") == payload(seed=5)
+
+
+# ---- real paxos commit semantics (Paxos.cc begin/accept/commit) -----------
+
+def test_partitioned_leader_value_never_observable():
+    """The leader is partitioned so its BEGIN reaches no peon (a
+    minority: itself).  The value must never be committed or observable
+    on ANY mon — commit requires an accept quorum, not just BEGIN."""
+    c = MiniCluster(n_osds=5, n_mons=3)
+    c.create_ec_pool("p", k=3, m=2, pg_num=8, plugin="tpu")
+    base_epoch = c.mons[0].osdmap.epoch
+    base_weight = c.mons[0].osdmap.osd_weight[4]
+    # cut the leader's OUTBOUND links: its BEGIN reaches nobody, while
+    # it still hears the peons' pings (believes the quorum is fine)
+    c.network.blackhole("mon.0", "mon.1")
+    c.network.blackhole("mon.0", "mon.2")
+    c.mons[0].mark_osd_out(4)          # stages + begins, cannot commit
+    c.network.pump()
+    # never committed anywhere — including the proposing leader itself
+    for m in c.mons:
+        assert m.osdmap.epoch == base_epoch, m.name
+        assert m.osdmap.osd_weight[4] == base_weight, m.name
+        assert len(m.incrementals) == base_epoch
+    # survivors elect mon.1 (the old leader's pings are also dark)
+    for _ in range(8):
+        c.tick(dt=6.0)
+    leader = c.mon
+    assert leader.name == "mon.1" and leader.is_leader()
+    # the uncommitted value did not leak into the new quorum's history
+    assert leader.osdmap.osd_weight[4] == base_weight
+    for m in (c.mons[1], c.mons[2]):
+        for inc in m.incrementals:
+            assert inc.new_weight.get(4) != 0
+    # the new quorum keeps committing
+    leader.mark_osd_out(3)
+    c.network.pump()
+    assert c.mons[1].osdmap.osd_weight[3] == 0
+    assert c.mons[2].osdmap.osd_weight[3] == 0
+    # partition heals: the old leader discards its uncommitted value
+    # and converges on the quorum's history
+    c.network.blackhole("mon.0", "mon.1", on=False)
+    c.network.blackhole("mon.0", "mon.2", on=False)
+    c.mons[0].start_election()
+    c.network.pump()
+    for _ in range(4):
+        c.tick(dt=6.0)
+    assert c.mons[0].osdmap.epoch == c.mons[1].osdmap.epoch
+    assert c.mons[0].osdmap.osd_weight[4] == base_weight
+    assert c.mons[0].osdmap.osd_weight[3] == 0
+    assert c.mons[0]._uncommitted is None
+
+
+def test_majority_accepted_value_survives_leader_death():
+    """A value the peons staged (BEGIN delivered, majority accept) but
+    whose commit the dying leader never sent must be finished by the
+    next leader through collect/LAST re-proposal — paxos' completion
+    guarantee."""
+    c = MiniCluster(n_osds=5, n_mons=3)
+    c.create_ec_pool("p", k=3, m=2, pg_num=8, plugin="tpu")
+    # the peons' ACCEPTs never reach the leader: BEGIN lands (staged on
+    # a majority) but the leader cannot learn it and cannot commit
+    c.network.blackhole("mon.1", "mon.0")
+    c.network.blackhole("mon.2", "mon.0")
+    c.mons[0].mark_osd_out(4)
+    c.network.pump()
+    assert c.mons[0].osdmap.osd_weight[4] != 0   # leader: uncommitted
+    assert c.mons[1]._uncommitted is not None    # peons: staged
+    assert c.mons[2]._uncommitted is not None
+    c.kill_mon(0)
+    for _ in range(8):
+        c.tick(dt=6.0)
+    leader = c.mon
+    assert leader.name == "mon.1" and leader.is_leader()
+    c.network.pump()
+    # the staged value was re-proposed and committed by the new leader
+    assert c.mons[1].osdmap.osd_weight[4] == 0
+    assert c.mons[2].osdmap.osd_weight[4] == 0
+    assert c.mons[1]._uncommitted is None
+    assert c.mons[2]._uncommitted is None
+
+
+def test_healed_leader_discards_ghost_topology():
+    """An ex-leader whose TOPOLOGY proposal (in-place map mutation) died
+    uncommitted must purge the ghost state when it re-wins the election
+    after healing — the next snapshot commit must not resurrect it."""
+    c = MiniCluster(n_osds=5, n_mons=3)
+    c.create_ec_pool("p", k=3, m=2, pg_num=8, plugin="tpu")
+    pid = c.mons[0].osdmap.lookup_pg_pool_name("p")
+    c.network.blackhole("mon.0", "mon.1")
+    c.network.blackhole("mon.0", "mon.2")
+    # topology proposal: mutates mon.0's working map in place
+    c.mons[0].pool_snap_create("p", "ghost")
+    c.mons[0].publish()
+    c.network.pump()
+    # survivors elect mon.1 and commit an epoch of their own
+    for _ in range(8):
+        c.tick(dt=6.0)
+    assert c.mon.name == "mon.1"
+    c.mon.mark_osd_out(4)
+    c.network.pump()
+    # heal: mon.0 (lowest rank) re-wins; its ghost snap must vanish
+    c.network.blackhole("mon.0", "mon.1", on=False)
+    c.network.blackhole("mon.0", "mon.2", on=False)
+    c.mons[0].start_election()
+    c.network.pump()
+    for _ in range(4):
+        c.tick(dt=6.0)
+    assert c.mons[0].is_leader()
+    assert c.mons[0].osdmap.pools[pid].snaps == {}, "ghost snap survived"
+    assert c.mons[0].osdmap.epoch == c.mons[1].osdmap.epoch
+    # the next topology commit must not resurrect it anywhere
+    c.mons[0].pool_snap_create("p", "real")
+    c.mons[0].publish()
+    c.network.pump()
+    for m in c.mons:
+        assert list(m.osdmap.pools[pid].snaps.values()) == ["real"], m.name
