@@ -72,6 +72,7 @@ class Monitor(Dispatcher):
         self._uncommitted: Optional[tuple] = None
         # leader recovery (collect/last): acks seen + best uncommitted
         self._collect_acks: Set[int] = set()
+        self._collect_pn = -1
         self._collect_uncommitted: Optional[tuple] = None
 
     # ---- roles -------------------------------------------------------------
@@ -167,6 +168,7 @@ class Monitor(Dispatcher):
         # starting with our own — so a possibly-majority-accepted
         # proposal gets finished (Paxos.cc leader recovery)
         self._collect_acks = {self.rank}
+        self._collect_pn = self.election_epoch
         self._collect_uncommitted = self._uncommitted
         self._uncommitted = None
         for r in self.quorum - {self.rank}:
@@ -190,13 +192,26 @@ class Monitor(Dispatcher):
     def _demote_inflight(self) -> None:
         """Leadership lost (or contested): our in-flight proposal is no
         longer ours to commit — keep it staged like a peon would, so
-        collect recovery can surface it."""
+        collect recovery can surface it.  Queued proposals are simply
+        dropped; any topology state they materialized in the working map
+        must be purged with them (their value can never commit)."""
         fl = self._inflight
         if fl is not None:
             self._inflight = None
             self._uncommitted = (fl["pn"], fl["epoch"], fl["value"],
                                  fl["topology"])
+        pending_topology = any(p["topology"]
+                               for p in self._pending_proposals)
         self._pending_proposals.clear()
+        if pending_topology:
+            self._rebuild_from_incrementals()
+            if self._uncommitted is not None:
+                # the rebuild also reverted the demoted value's own
+                # in-place state; its VALUE is a full snapshot dict, so
+                # a later re-proposal re-applies it cleanly — the map is
+                # no longer dirty with it
+                u = self._uncommitted
+                self._uncommitted = (u[0], u[1], u[2], False)
 
     def _discard_uncommitted(self) -> None:
         """Drop the staged value; if it was our own topology proposal
@@ -249,6 +264,13 @@ class Monitor(Dispatcher):
             if not self.is_leader():
                 return
             self._apply_committed_values(msg.values)
+            # push our surplus back so the peon catches up (these are
+            # committed epochs: OP_COMMIT, not a new proposal)
+            self._send_commit_surplus(msg.last_committed,
+                                      self._peer_name(msg.rank)
+                                      or msg.src)
+            if msg.pn != getattr(self, "_collect_pn", -1):
+                return      # straggler from a superseded collect round
             self._collect_acks.add(msg.rank)
             if msg.uncommitted_value is not None:
                 best = self._collect_uncommitted
@@ -260,11 +282,6 @@ class Monitor(Dispatcher):
                     ep, val = msg.uncommitted_value
                     self._collect_uncommitted = (msg.uncommitted_pn,
                                                  ep, val, False)
-            # push our surplus back so the peon catches up (these are
-            # committed epochs: OP_COMMIT, not a new proposal)
-            self._send_commit_surplus(msg.last_committed,
-                                      self._peer_name(msg.rank)
-                                      or msg.src)
             if len(self._collect_acks) >= self._majority():
                 self._finish_collect()
         elif msg.op == MMonPaxos.OP_BEGIN:
@@ -367,14 +384,29 @@ class Monitor(Dispatcher):
             # (applied field-wise — apply_incremental would alias the
             # snapshot's crush/pool objects into the working map)
             from ..osdmap.osdmap import CEPH_OSD_EXISTS, CEPH_OSD_UP
-            self.osdmap.epoch = fl["epoch"]
+            m = self.osdmap
+            m.epoch = fl["epoch"]
             for osd, up in inc.new_up.items():
-                st = self.osdmap.osd_state[osd] | CEPH_OSD_EXISTS
-                self.osdmap.osd_state[osd] = \
+                st = m.osd_state[osd] | CEPH_OSD_EXISTS
+                m.osd_state[osd] = \
                     (st | CEPH_OSD_UP) if up else (st & ~CEPH_OSD_UP)
             for osd, w in inc.new_weight.items():
-                self.osdmap.osd_state[osd] |= CEPH_OSD_EXISTS
-                self.osdmap.osd_weight[osd] = w
+                m.osd_state[osd] |= CEPH_OSD_EXISTS
+                m.osd_weight[osd] = w
+            for osd, a in inc.new_primary_affinity.items():
+                m.set_primary_affinity(osd, a)
+            for pg, osds in inc.new_pg_temp.items():
+                if osds:
+                    m.pg_temp[pg] = list(osds)
+                else:
+                    m.pg_temp.pop(pg, None)
+            for pg, p in inc.new_primary_temp.items():
+                if p >= 0:
+                    m.primary_temp[pg] = p
+                else:
+                    m.primary_temp.pop(pg, None)
+            m.pg_upmap.update(inc.new_pg_upmap)
+            m.pg_upmap_items.update(inc.new_pg_upmap_items)
         else:
             self.osdmap.apply_incremental(inc)
         self.incrementals.append(inc)
@@ -559,9 +591,21 @@ class Monitor(Dispatcher):
         if self._topology_dirty:
             delta = inc
             inc = self._snapshot_inc()
-            if delta is not None:
-                inc.new_up.update(delta.new_up)
-                inc.new_weight.update(delta.new_weight)
+            # the snapshot reads the WORKING map, which does not yet
+            # reflect deferred (in-flight/queued) delta proposals that
+            # will commit before this epoch — fold their overrides in,
+            # or the snapshot would silently revert them at commit
+            deferred = ([self._inflight["inc"]] if self._inflight
+                        else []) + \
+                [p["inc"] for p in self._pending_proposals]
+            for src in deferred + ([delta] if delta is not None else []):
+                inc.new_up.update(src.new_up)
+                inc.new_weight.update(src.new_weight)
+                inc.new_primary_affinity.update(src.new_primary_affinity)
+                inc.new_pg_temp.update(src.new_pg_temp)
+                inc.new_primary_temp.update(src.new_primary_temp)
+                inc.new_pg_upmap.update(src.new_pg_upmap)
+                inc.new_pg_upmap_items.update(src.new_pg_upmap_items)
             self._topology_dirty = False
             topology = True
         else:

@@ -123,8 +123,13 @@ def _is_out_batch(dev_weight, items, x):
 def _layer_path(m: CrushMap, root: int, target_type: int) -> int:
     """Verify the hierarchy under *root* is layered toward *target_type*;
     returns the number of choose levels needed to reach it."""
+    return _layer_path_frontier(m, [root], target_type)
+
+
+def _layer_path_frontier(m: CrushMap, roots: List[int],
+                         target_type: int) -> int:
     depth = 0
-    frontier = [root]
+    frontier = list(roots)
     while True:
         child_types = set()
         for b in frontier:
@@ -155,16 +160,21 @@ def _layer_path(m: CrushMap, root: int, target_type: int) -> int:
             raise UnsupportedRule("hierarchy too deep")
 
 
+def _advance(m: CrushMap, frontier: List[int]) -> List[int]:
+    """One level down: the sub-buckets the frontier's draws can land in."""
+    nxt: List[int] = []
+    for b in frontier:
+        nxt.extend(i for i in m.bucket(b).items if i < 0)
+    return nxt
+
+
 def _level_frontiers(m: CrushMap, root: int, n_levels: int) -> List[List[int]]:
     """Bucket-id frontier feeding each of the n_levels draws under root."""
     out = []
     frontier = [root]
     for _ in range(n_levels):
         out.append(list(frontier))
-        nxt: List[int] = []
-        for b in frontier:
-            nxt.extend(i for i in m.bucket(b).items if i < 0)
-        frontier = nxt
+        frontier = _advance(m, frontier)
     return out
 
 
@@ -186,7 +196,7 @@ class FastRule:
         vary_r = m.chooseleaf_vary_r
         stable = m.chooseleaf_stable
         take = None
-        choose = None
+        chooses: List = []
         for step in rule.steps:
             if step.op == CRUSH_RULE_SET_CHOOSE_TRIES:
                 if step.arg1 > 0:
@@ -212,15 +222,34 @@ class FastRule:
                              CRUSH_RULE_CHOOSELEAF_FIRSTN,
                              CRUSH_RULE_CHOOSE_INDEP,
                              CRUSH_RULE_CHOOSELEAF_INDEP):
-                if choose is not None:
-                    raise UnsupportedRule("chained choose steps")
-                choose = step
+                chooses.append(step)
             elif step.op == CRUSH_RULE_EMIT:
                 pass
             else:
                 raise UnsupportedRule(f"op {step.op}")
-        if take is None or choose is None or take >= 0:
+        if take is None or not chooses or take >= 0:
             raise UnsupportedRule("rule shape")
+        # chained choose steps (set-choose.t shapes): every step but the
+        # last selects buckets — resolvable from topology alone, so the
+        # whole chain lives in the cached candidate phase; only the last
+        # step (devices / chooseleaf) depends on the weight vector
+        self.mid_stages: List[dict] = []
+        for step in chooses[:-1]:
+            if step.op in (CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                           CRUSH_RULE_CHOOSELEAF_INDEP):
+                raise UnsupportedRule("chooseleaf before the last step")
+            if step.arg2 == 0:
+                raise UnsupportedRule("device choose before the last step")
+            n = step.arg1
+            if n <= 0:
+                n += result_max
+            if n <= 0:
+                raise UnsupportedRule("numrep")
+            self.mid_stages.append({
+                "firstn": step.op == CRUSH_RULE_CHOOSE_FIRSTN,
+                "numrep": n, "type": step.arg2,
+            })
+        choose = chooses[-1]
         self.firstn = choose.op in (CRUSH_RULE_CHOOSE_FIRSTN,
                                     CRUSH_RULE_CHOOSELEAF_FIRSTN)
         self.leafy = choose.op in (CRUSH_RULE_CHOOSELEAF_FIRSTN,
@@ -254,7 +283,29 @@ class FastRule:
         self.recurse_tries = recurse
         self.n_rounds = min(tries_cap + 1, choose_tries)
         self.n_leaf = min(leaf_tries_cap + 1, recurse)
-        self.depth = _layer_path(m, take, self.target_type)
+        # per-stage descent depths along the (validated layered) tree;
+        # self.depth stays the TOTAL main depth so the per-level
+        # quotient-table eligibility below is unchanged
+        frontier = [take]
+        base = 0
+        self.parents = 1          # lanes per x feeding the last stage
+        for st in self.mid_stages:
+            if st["firstn"] and C.npos > 1:
+                raise UnsupportedRule("firstn with per-position "
+                                      "weight sets")
+            d = _layer_path_frontier(m, frontier, st["type"])
+            st["depth"] = d
+            st["base_level"] = base
+            st["tries"] = choose_tries
+            st["n_rounds"] = min(tries_cap + 1, choose_tries)
+            base += d
+            for _ in range(d):
+                frontier = _advance(m, frontier)
+            self.parents *= st["numrep"]
+        self.base_level = base
+        self.depth = base + _layer_path_frontier(m, frontier,
+                                                 self.target_type)
+        self.last_depth = self.depth - self.base_level
         self.leaf_depth = 0
         if self.leafy and self.target_type != 0:
             # depth below a failure-domain bucket, validated layered
@@ -401,64 +452,153 @@ class FastRule:
             bidx = jnp.maximum(-1 - item, 0)
         return item, risky
 
+    # ---- intermediate (bucket-choosing) stages ----------------------------
+    def _mid_candidates(self, st: dict, xl, roots, valid):
+        """Candidates + collision-only resolution for one intermediate
+        choose step over N parent lanes: returns sel (N, numrep) items
+        (NONE-filled for invalid/failed), risky (N,)."""
+        N = xl.shape[0]
+        n = st["numrep"]
+        rounds = st["n_rounds"]
+        if st["firstn"]:
+            R = n + rounds - 1
+        else:
+            R = n * rounds
+        r_col = jnp.arange(R, dtype=jnp.uint32)
+        xf = jnp.broadcast_to(xl[None, :], (R, N)).reshape(-1)
+        rf = jnp.broadcast_to(r_col[:, None], (R, N)).reshape(-1)
+        bf = jnp.broadcast_to(roots[None, :], (R, N)).reshape(-1)
+        pos0 = jnp.zeros((R * N,), dtype=jnp.int32)
+        item, risky_f = self._descend(xf, bf, rf, pos0,
+                                      st["base_level"], st["depth"])
+        cand = item.reshape(R, N)
+        risky = jnp.any(risky_f.reshape(R, N), axis=0)
+        outs = jnp.full((N, n), NONE, dtype=jnp.int32)
+        if st["firstn"]:
+            for j in range(n):
+                done = jnp.zeros((N,), dtype=bool)
+                for ftotal in range(rounds):
+                    item = cand[j + ftotal]
+                    coll = jnp.any(outs == item[:, None], axis=1)
+                    take = ~coll & ~done
+                    outs = outs.at[:, j].set(
+                        jnp.where(take, item, outs[:, j]))
+                    done = done | ~coll
+                if rounds < st["tries"]:
+                    risky = risky | ~done
+            # firstn feeds the next step COMPACTLY (wsize entries)
+            order = jnp.argsort((outs == NONE).astype(jnp.int32),
+                                axis=1, stable=True)
+            outs = jnp.take_along_axis(outs, order, axis=1)
+        else:
+            UNDEF = jnp.int32(0x7FFFFFFE)
+            outs = jnp.full((N, n), UNDEF, dtype=jnp.int32)
+            for ftotal in range(rounds):
+                for rep in range(n):
+                    item = cand[rep + n * ftotal]
+                    unfilled = outs[:, rep] == UNDEF
+                    coll = jnp.any(outs == item[:, None], axis=1)
+                    take = unfilled & ~coll
+                    outs = outs.at[:, rep].set(
+                        jnp.where(take, item, outs[:, rep]))
+            if rounds < st["tries"]:
+                risky = risky | jnp.any(outs == UNDEF, axis=1)
+            outs = jnp.where(outs == UNDEF, NONE, outs)
+        outs = jnp.where(valid[:, None], outs, NONE)
+        return outs, risky
+
     # ---- candidate phase (topology-only; cached across epochs) -------------
     def _candidates(self, xs):
-        """One flattened descent over all (x, retry) lanes.
+        """One flattened descent over all (x, parent, retry) lanes.
 
-        Returns cand (R, X) failure-domain items, leaf (R, L, X) leaf
-        items (all-NONE when not leafy), risky (X,)."""
+        Returns cand (R, N) failure-domain items, leaf (R, L, N) leaf
+        items (all-NONE when not leafy), risky (X,), valid (N,), and the
+        per-lane x vector (N,), where N = X * parents (the intermediate
+        stages' fan-out; 1 for single-choose rules)."""
         x = xs.astype(jnp.uint32)
         X = xs.shape[0]
+        xl = x
+        roots = jnp.full((X,), -1 - self.take, dtype=jnp.int32)
+        valid = jnp.ones((X,), dtype=bool)
+        risky_lanes = jnp.zeros((X,), dtype=bool)
+        for st in self.mid_stages:
+            sel, rk = self._mid_candidates(st, xl, roots, valid)
+            risky_lanes = risky_lanes | rk
+            n = st["numrep"]
+            # expand lanes: each parent slot becomes a lane
+            risky_lanes = jnp.repeat(risky_lanes, n)
+            xl = jnp.repeat(xl, n)
+            valid = (jnp.repeat(valid, n)) & (sel.reshape(-1) != NONE)
+            roots = jnp.maximum(-1 - sel.reshape(-1), 0)
+        N = X * self.parents
         if self.firstn:
             R = self.numrep + self.n_rounds - 1
         else:
             R = self.numrep * self.n_rounds
         r_col = jnp.arange(R, dtype=jnp.uint32)
-        xf = jnp.broadcast_to(x[None, :], (R, X)).reshape(-1)
-        rf = jnp.broadcast_to(r_col[:, None], (R, X)).reshape(-1)
-        root = jnp.full((R * X,), -1 - self.take, dtype=jnp.int32)
-        pos0 = jnp.zeros((R * X,), dtype=jnp.int32)
-        item, risky_f = self._descend(xf, root, rf, pos0, 0, self.depth)
-        risky = jnp.any(risky_f.reshape(R, X), axis=0)
-        cand = item.reshape(R, X)
+        xf = jnp.broadcast_to(xl[None, :], (R, N)).reshape(-1)
+        rf = jnp.broadcast_to(r_col[:, None], (R, N)).reshape(-1)
+        root = jnp.broadcast_to(roots[None, :], (R, N)).reshape(-1)
+        pos0 = jnp.zeros((R * N,), dtype=jnp.int32)
+        item, risky_f = self._descend(xf, root, rf, pos0,
+                                      self.base_level, self.last_depth)
+        risky_lanes = risky_lanes | jnp.any(risky_f.reshape(R, N), axis=0)
+        cand = item.reshape(R, N)
+
+        def finish(leaf, risky_lanes):
+            risky = jnp.any(risky_lanes.reshape(-1, self.parents), axis=1)
+            return cand, leaf, risky, valid, xl
+
         L = self.n_leaf
         if not self.leafy:
-            leaf = jnp.full((R, 1, X), NONE, dtype=jnp.int32)
-            return cand, leaf, risky
+            return finish(jnp.full((R, 1, N), NONE, dtype=jnp.int32),
+                          risky_lanes)
         if self.leaf_depth == 0 and self.target_type == 0:
             # chooseleaf over devices: every leaf attempt is the item itself
-            leaf = jnp.broadcast_to(cand[:, None, :], (R, L, X))
-            return cand, leaf, risky
-        # leaf attempts: one flattened batch over (R, L, X)
+            return finish(jnp.broadcast_to(cand[:, None, :], (R, L, N)),
+                          risky_lanes)
+        # leaf attempts: one flattened batch over (R, L, N)
         if self.firstn:
             sub_r = (rf >> jnp.uint32(self.vary_r - 1)) if self.vary_r \
                 else jnp.zeros_like(rf)
-            lpos = jnp.zeros((R * X,), dtype=jnp.int32)
+            lpos = jnp.zeros((R * N,), dtype=jnp.int32)
         else:
             rep = rf % jnp.uint32(self.numrep)
             sub_r = rep + rf  # + numrep*ft2 added per attempt below
             lpos = rep.astype(jnp.int32)
         bidx = jnp.maximum(-1 - item, 0)
         depth = self.leaf_depth if self.leaf_depth else 1
-        xl = jnp.broadcast_to(xf[None, :], (L, R * X)).reshape(-1)
-        bl = jnp.broadcast_to(bidx[None, :], (L, R * X)).reshape(-1)
-        pl = jnp.broadcast_to(lpos[None, :], (L, R * X)).reshape(-1)
+        xleaf = jnp.broadcast_to(xf[None, :], (L, R * N)).reshape(-1)
+        bl = jnp.broadcast_to(bidx[None, :], (L, R * N)).reshape(-1)
+        pl = jnp.broadcast_to(lpos[None, :], (L, R * N)).reshape(-1)
         ft2 = jnp.arange(L, dtype=jnp.uint32)
         if self.firstn:
             rl = (sub_r[None, :] + ft2[:, None]).reshape(-1)
         else:
             rl = (sub_r[None, :] +
                   jnp.uint32(self.numrep) * ft2[:, None]).reshape(-1)
-        lv, lrisky = self._descend(xl, bl, rl, pl, self.depth, depth)
-        risky = risky | jnp.any(lrisky.reshape(L, R, X), axis=(0, 1))
-        leaf = jnp.transpose(lv.reshape(L, R, X), (1, 0, 2))  # (R, L, X)
-        return cand, leaf, risky
+        lv, lrisky = self._descend(xleaf, bl, rl, pl, self.depth, depth)
+        risky_lanes = risky_lanes | jnp.any(lrisky.reshape(L, R, N),
+                                            axis=(0, 1))
+        leaf = jnp.transpose(lv.reshape(L, R, N), (1, 0, 2))  # (R, L, N)
+        return finish(leaf, risky_lanes)
 
     # ---- resolution phase (per weight vector; cheap) -----------------------
-    def _resolve(self, cand, leaf, risky, x, dev_weight):
+    def _resolve(self, cand, leaf, risky, valid, xl, x, dev_weight):
+        """Per-lane resolution: sel (N, numrep) plus residual (X,) —
+        a lane's unresolved state rolls up to its x, which replays on
+        the host whole."""
+        risky_lanes = jnp.repeat(risky, self.parents)
         if self.firstn:
-            return self._resolve_firstn(cand, leaf, risky, x, dev_weight)
-        return self._resolve_indep(cand, leaf, risky, x, dev_weight)
+            sel, lres = self._resolve_firstn(cand, leaf, risky_lanes,
+                                             xl, dev_weight)
+        else:
+            sel, lres = self._resolve_indep(cand, leaf, risky_lanes,
+                                            xl, dev_weight)
+        sel = jnp.where(valid[:, None], sel, NONE)
+        lres = lres & valid
+        residual = risky | jnp.any(lres.reshape(-1, self.parents), axis=1)
+        return sel, residual
 
     def _resolve_firstn(self, cand, leaf, risky, x, dev_weight):
         """firstn: slot j retries r = j + ftotal (mapper.c:493-495); leafy
@@ -560,35 +700,52 @@ class FastRule:
         return sel, residual
 
     # ---- device-side compaction + delta fetch ------------------------------
-    def _resolve_packed(self, cand, leaf, risky, x, dev_weight):
+    def _resolve_packed(self, cand, leaf, risky, valid, xl, x, dev_weight):
         """Resolve, compact and pack ON DEVICE: one (X, result_max+1) i32.
 
         Columns [0, result_max) are the compacted result slots (EMIT
         semantics: firstn drops NONE gaps in slot order, indep keeps
-        holes); the last column is ``count | residual << 16``.  A single
-        small array means the per-epoch host fetch is one transfer — the
+        holes within a parent's block but drops absent parents' blocks);
+        the last column is ``count | residual << 16``.  A single small
+        array means the per-epoch host fetch is one transfer — the
         tunnel/PCIe round trip, not the resolve, is the remap wall floor.
         """
-        sel, residual = self._resolve(cand, leaf, risky, x, dev_weight)
-        X = sel.shape[0]
+        sel, residual = self._resolve(cand, leaf, risky, valid, xl, x,
+                                      dev_weight)
+        P = self.parents
+        X = sel.shape[0] // P
         R = self.result_max
+        nr = self.numrep
         if self.firstn:
-            # stable partition: non-NONE first, slot order preserved
-            order = jnp.argsort((sel == NONE).astype(jnp.int32), axis=1,
+            # per-parent picks concatenate compactly in the reference
+            # (outpos appends): a stable global compaction of the
+            # (P*numrep)-wide row is the same sequence
+            wide = sel.reshape(X, P * nr)
+            order = jnp.argsort((wide == NONE).astype(jnp.int32), axis=1,
                                 stable=True)
-            compact = jnp.take_along_axis(sel, order, axis=1)
+            compact = jnp.take_along_axis(wide, order, axis=1)
             if compact.shape[1] < R:
-                compact = jnp.pad(compact, ((0, 0), (0, R - compact.shape[1])),
+                compact = jnp.pad(compact,
+                                  ((0, 0), (0, R - compact.shape[1])),
                                   constant_values=NONE)
             out = compact[:, :R]
-            counts = jnp.minimum(jnp.sum(sel != NONE, axis=1), R)
+            counts = jnp.minimum(jnp.sum(wide != NONE, axis=1), R)
         else:
-            n = min(sel.shape[1], R)
-            out = sel[:, :n]
-            if n < R:
-                out = jnp.pad(out, ((0, 0), (0, R - n)),
-                              constant_values=NONE)
-            counts = jnp.full((X,), n, dtype=jnp.int32)
+            # indep keeps holes, but a parent that was never chosen
+            # contributes NOTHING (crush_do_rule skips absent buckets):
+            # drop absent parents' blocks, keep block order stable
+            sel3 = sel.reshape(X, P, nr)
+            vp = valid.reshape(X, P)
+            order = jnp.argsort((~vp).astype(jnp.int32), axis=1,
+                                stable=True)
+            sel3 = jnp.take_along_axis(sel3, order[:, :, None], axis=1)
+            wide = sel3.reshape(X, P * nr)
+            if wide.shape[1] < R:
+                wide = jnp.pad(wide, ((0, 0), (0, R - wide.shape[1])),
+                               constant_values=NONE)
+            out = wide[:, :R]
+            counts = jnp.minimum(
+                jnp.sum(vp, axis=1, dtype=jnp.int32) * nr, R)
         tail = counts.astype(jnp.int32) | (residual.astype(jnp.int32) << 16)
         return jnp.concatenate([out, tail[:, None]], axis=1)
 

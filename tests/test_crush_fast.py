@@ -222,16 +222,83 @@ def test_fast_residuals_route_through_native():
         assert getattr(fr, "_nm", None) is not None
 
 
-def test_fast_rejects_chained_rules():
-    cw, n = build_map()
+def chained_rule(cw, mode, n1=2, n2=2, mid_type=1, leaf=False):
+    from ceph_tpu.crush.constants import (
+        CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSELEAF_INDEP,
+    )
+    first = mode == "firstn"
+    op1 = CRUSH_RULE_CHOOSE_FIRSTN if first else CRUSH_RULE_CHOOSE_INDEP
+    if leaf:
+        op2 = CRUSH_RULE_CHOOSELEAF_FIRSTN if first \
+            else CRUSH_RULE_CHOOSELEAF_INDEP
+        t2 = 1
+    else:
+        op2 = op1
+        t2 = 0
     steps = [RuleStep(CRUSH_RULE_TAKE, -1, 0),
-             RuleStep(CRUSH_RULE_CHOOSE_FIRSTN, 2, 1),
-             RuleStep(CRUSH_RULE_CHOOSE_FIRSTN, 2, 0),
+             RuleStep(op1, n1, mid_type),
+             RuleStep(op2, n2, t2),
              RuleStep(CRUSH_RULE_EMIT, 0, 0)]
-    rno = cw.add_rule(Rule(steps=steps, ruleset=1, type=1,
-                           min_size=1, max_size=10), "chain")
-    with pytest.raises(UnsupportedRule):
-        compile_fast_rule(cw.crush, rno, 4)
+    return cw.add_rule(Rule(steps=steps, ruleset=1, type=1,
+                            min_size=1, max_size=10), f"chain-{mode}")
+
+
+@pytest.mark.parametrize("mode", ["firstn", "indep"])
+def test_fast_chained_choose(mode):
+    """take root; choose <mode> 2 type host; choose <mode> 2 type 0;
+    emit — the set-choose.t chained shape, exact vs the interpreter
+    under healthy, non-uniform, and zeroed weight vectors."""
+    cw, n = build_map(n_hosts=6, osds_per_host=4, uneven=True)
+    rno = chained_rule(cw, mode)
+    rng = np.random.default_rng(3)
+    for weight in ([0x10000] * n,
+                   [int(w) for w in rng.choice(
+                       [0, 0x4000, 0x8000, 0x10000], size=n)]):
+        assert_fast_parity(cw, rno, 4, weight)
+
+
+def test_fast_chained_chooseleaf_three_levels():
+    """3-level hierarchy: choose firstn 2 type rack; chooseleaf firstn 2
+    type host; emit."""
+    from ceph_tpu.crush import CrushWrapper, CRUSH_BUCKET_STRAW2
+    cw = CrushWrapper()
+    cw.set_type_name(1, "host")
+    cw.set_type_name(2, "rack")
+    cw.set_type_name(10, "root")
+    rng = np.random.default_rng(11)
+    osd = 0
+    racks = []
+    bid = -2
+    for r in range(3):
+        hosts = []
+        for h in range(3):
+            osds = list(range(osd, osd + 3))
+            osd += 3
+            ws = [int(rng.integers(1, 4)) * 0x10000 for _ in osds]
+            hosts.append(cw.add_bucket(CRUSH_BUCKET_STRAW2, 1,
+                                       f"h{r}{h}", osds, ws, id=bid))
+            bid -= 1
+        rws = [0x30000] * len(hosts)
+        racks.append(cw.add_bucket(CRUSH_BUCKET_STRAW2, 2, f"rack{r}",
+                                   hosts, rws, id=bid))
+        bid -= 1
+    cw.set_max_devices(osd)
+    cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default", racks,
+                  [0x90000] * len(racks), id=-1)
+    rno = chained_rule(cw, "firstn", n1=2, n2=2, mid_type=2, leaf=True)
+    weight = [0x10000] * osd
+    weight[4] = 0
+    weight[11] = 0x6000
+    assert_fast_parity(cw, rno, 4, weight)
+
+
+def test_fast_chained_numrep_zero_expands():
+    """arg1=0 on the first step means result_max parents."""
+    cw, n = build_map(n_hosts=5, osds_per_host=3)
+    rno = chained_rule(cw, "firstn", n1=0, n2=1)
+    weight = [0x10000] * n
+    weight[1] = 0
+    assert_fast_parity(cw, rno, 3, weight)
 
 
 def test_fast_delta_epochs_stay_exact():

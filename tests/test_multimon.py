@@ -307,3 +307,56 @@ def test_healed_leader_discards_ghost_topology():
     c.network.pump()
     for m in c.mons:
         assert list(m.osdmap.pools[pid].snaps.values()) == ["real"], m.name
+
+
+def test_topology_snapshot_folds_deferred_deltas():
+    """A topology publish issued while a delta proposal is still in
+    flight must not snapshot the pre-delta working map and silently
+    revert the delta at commit."""
+    c = MiniCluster(n_osds=5, n_mons=3)
+    c.create_ec_pool("p", k=3, m=2, pg_num=8, plugin="tpu")
+    leader = c.mons[0]
+    # no pump between the two: the mark is still in flight (deferred)
+    leader.mark_osd_out(4)
+    leader.pool_snap_create("p", "s1")
+    leader.publish()
+    c.network.pump()
+    pid = leader.osdmap.lookup_pg_pool_name("p")
+    for m in c.mons:
+        assert m.osdmap.osd_weight[4] == 0, m.name
+        assert list(m.osdmap.pools[pid].snaps.values()) == ["s1"], m.name
+
+
+def test_demoted_queued_topology_proposal_leaves_no_ghost():
+    """A QUEUED (behind an in-flight delta) topology proposal dropped at
+    demotion must purge its in-place working-map state."""
+    c = MiniCluster(n_osds=5, n_mons=3)
+    c.create_ec_pool("p", k=3, m=2, pg_num=8, plugin="tpu")
+    pid = c.mons[0].osdmap.lookup_pg_pool_name("p")
+    c.network.blackhole("mon.0", "mon.1")
+    c.network.blackhole("mon.0", "mon.2")
+    # delta goes inflight (never accepted); topology queues behind it
+    c.mons[0].mark_osd_out(4)
+    c.mons[0].pool_snap_create("p", "ghost")
+    c.mons[0].publish()
+    c.network.pump()
+    for _ in range(8):
+        c.tick(dt=6.0)
+    assert c.mon.name == "mon.1"
+    c.mon.mark_osd_out(3)
+    c.network.pump()
+    c.network.blackhole("mon.0", "mon.1", on=False)
+    c.network.blackhole("mon.0", "mon.2", on=False)
+    c.mons[0].start_election()
+    c.network.pump()
+    for _ in range(4):
+        c.tick(dt=6.0)
+    assert c.mons[0].is_leader()
+    # neither the ghost snap nor the never-accepted mark survives
+    assert c.mons[0].osdmap.pools[pid].snaps == {}
+    c.mons[0].pool_snap_create("p", "real")
+    c.mons[0].publish()
+    c.network.pump()
+    for m in c.mons:
+        assert list(m.osdmap.pools[pid].snaps.values()) == ["real"], m.name
+        assert m.osdmap.osd_weight[3] == 0, m.name
