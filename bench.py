@@ -187,7 +187,8 @@ def measure_decode(matrix: np.ndarray, batch: np.ndarray,
     return n * BATCH * OBJECT_SIZE / dt / (1 << 30)
 
 
-def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10):
+def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
+                        uniform=True):
     """The <50 ms north star: remap ALL PGs after an epoch change.
 
     The workload is OSDMapMapping's per-epoch job (OSDMapMapping.h:17): the
@@ -208,10 +209,18 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10):
     cw.set_type_name(1, "host")
     cw.set_type_name(10, "root")
     hosts = []
+    rng_w = np.random.default_rng(7)
     for h in range(n_osds // per_host):
         osds = list(range(h * per_host, (h + 1) * per_host))
+        if uniform:
+            ws = [0x10000] * per_host
+        else:
+            # heterogeneous drives: the f32+risk draw path with exact
+            # residual replay (crush_fast.py), not the quotient tables
+            ws = [int(v) * 0x8000
+                  for v in rng_w.integers(1, 5, size=per_host)]
         hosts.append(cw.add_bucket(CRUSH_BUCKET_STRAW2, 1, f"host{h}",
-                                   osds, [0x10000] * per_host, id=-(h + 2)))
+                                   osds, ws, id=-(h + 2)))
     cw.set_max_devices(n_osds)
     cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default", hosts,
                   [0x10000 * per_host] * len(hosts), id=-1)
@@ -352,9 +361,20 @@ def main() -> None:
             result["crush_remap_vs_native_host"] = round(
                 host_ms / dev_ms, 2)
 
+    def crush_nonuniform_section() -> None:
+        # the <50 ms target on a 2-level map with NON-uniform weights:
+        # exercises the f32 draw + exact-residual-replay path
+        n_pgs = 100_000 if platform else 10_000
+        wall_ms, dev_ms, _host, resid, _rtt = measure_crush_remap(
+            n_pgs=n_pgs, epochs=10 if platform else 2, uniform=False)
+        result["crush_remap_nonuniform_ms"] = round(dev_ms, 1)
+        result["crush_remap_nonuniform_wall_ms"] = round(wall_ms, 1)
+        result["crush_nonuniform_residual_fraction"] = resid
+
     retry_section("device bench", encode_section)
     retry_section("decode bench", decode_section)
     retry_section("crush bench", crush_section)
+    retry_section("crush nonuniform bench", crush_nonuniform_section)
 
     if errors:
         result["error"] = "; ".join(errors)

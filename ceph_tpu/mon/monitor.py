@@ -405,6 +405,10 @@ class Monitor(Dispatcher):
                     m.primary_temp[pg] = p
                 else:
                     m.primary_temp.pop(pg, None)
+            for pg in inc.old_pg_upmap:
+                m.pg_upmap.pop(pg, None)
+            for pg in inc.old_pg_upmap_items:
+                m.pg_upmap_items.pop(pg, None)
             m.pg_upmap.update(inc.new_pg_upmap)
             m.pg_upmap_items.update(inc.new_pg_upmap_items)
         else:
@@ -606,6 +610,8 @@ class Monitor(Dispatcher):
                 inc.new_primary_temp.update(src.new_primary_temp)
                 inc.new_pg_upmap.update(src.new_pg_upmap)
                 inc.new_pg_upmap_items.update(src.new_pg_upmap_items)
+                inc.old_pg_upmap.extend(src.old_pg_upmap)
+                inc.old_pg_upmap_items.extend(src.old_pg_upmap_items)
             self._topology_dirty = False
             topology = True
         else:

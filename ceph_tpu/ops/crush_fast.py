@@ -234,6 +234,10 @@ class FastRule:
         # whole chain lives in the cached candidate phase; only the last
         # step (devices / chooseleaf) depends on the weight vector
         self.mid_stages: List[dict] = []
+        if len(chooses) > 2:
+            # a third step's slot room depends on the second's dynamic
+            # truncation — not modeled; host fallback
+            raise UnsupportedRule("more than two choose steps")
         for step in chooses[:-1]:
             if step.op in (CRUSH_RULE_CHOOSELEAF_FIRSTN,
                            CRUSH_RULE_CHOOSELEAF_INDEP):
@@ -247,7 +251,10 @@ class FastRule:
                 raise UnsupportedRule("numrep")
             self.mid_stages.append({
                 "firstn": step.op == CRUSH_RULE_CHOOSE_FIRSTN,
-                "numrep": n, "type": step.arg2,
+                # numrep keeps the step's r spacing; the step can only
+                # FILL min(numrep, result_max) slots (out_size room)
+                "numrep": n, "slots": min(n, result_max),
+                "type": step.arg2,
             })
         choose = chooses[-1]
         self.firstn = choose.op in (CRUSH_RULE_CHOOSE_FIRSTN,
@@ -301,7 +308,7 @@ class FastRule:
             base += d
             for _ in range(d):
                 frontier = _advance(m, frontier)
-            self.parents *= st["numrep"]
+            self.parents *= st["slots"]
         self.base_level = base
         self.depth = base + _layer_path_frontier(m, frontier,
                                                  self.target_type)
@@ -459,6 +466,7 @@ class FastRule:
         (NONE-filled for invalid/failed), risky (N,)."""
         N = xl.shape[0]
         n = st["numrep"]
+        slots = st["slots"]
         rounds = st["n_rounds"]
         if st["firstn"]:
             R = n + rounds - 1
@@ -473,8 +481,11 @@ class FastRule:
                                       st["base_level"], st["depth"])
         cand = item.reshape(R, N)
         risky = jnp.any(risky_f.reshape(R, N), axis=0)
-        outs = jnp.full((N, n), NONE, dtype=jnp.int32)
         if st["firstn"]:
+            # all numrep ATTEMPTS run (slot = attempt; the reference's
+            # outpos append == stable compaction); the room truncation
+            # to `slots` happens at fan-out below
+            outs = jnp.full((N, n), NONE, dtype=jnp.int32)
             for j in range(n):
                 done = jnp.zeros((N,), dtype=bool)
                 for ftotal in range(rounds):
@@ -489,12 +500,12 @@ class FastRule:
             # firstn feeds the next step COMPACTLY (wsize entries)
             order = jnp.argsort((outs == NONE).astype(jnp.int32),
                                 axis=1, stable=True)
-            outs = jnp.take_along_axis(outs, order, axis=1)
+            outs = jnp.take_along_axis(outs, order, axis=1)[:, :slots]
         else:
             UNDEF = jnp.int32(0x7FFFFFFE)
-            outs = jnp.full((N, n), UNDEF, dtype=jnp.int32)
+            outs = jnp.full((N, slots), UNDEF, dtype=jnp.int32)
             for ftotal in range(rounds):
-                for rep in range(n):
+                for rep in range(slots):
                     item = cand[rep + n * ftotal]
                     unfilled = outs[:, rep] == UNDEF
                     coll = jnp.any(outs == item[:, None], axis=1)
@@ -524,7 +535,7 @@ class FastRule:
         for st in self.mid_stages:
             sel, rk = self._mid_candidates(st, xl, roots, valid)
             risky_lanes = risky_lanes | rk
-            n = st["numrep"]
+            n = st["slots"]
             # expand lanes: each parent slot becomes a lane
             risky_lanes = jnp.repeat(risky_lanes, n)
             xl = jnp.repeat(xl, n)
@@ -593,8 +604,17 @@ class FastRule:
             sel, lres = self._resolve_firstn(cand, leaf, risky_lanes,
                                              xl, dev_weight)
         else:
+            # per-parent slot room (crush_do_rule: out_size =
+            # min(numrep, result_max - osize), osize advancing only
+            # over present parents): slots past the room are never
+            # filled by the reference, so retries must not see them
+            # as collision targets
+            vp = valid.reshape(-1, self.parents).astype(jnp.int32)
+            vbefore = jnp.cumsum(vp, axis=1) - vp
+            room = jnp.clip(self.result_max - vbefore * self.numrep,
+                            0, self.numrep).reshape(-1)
             sel, lres = self._resolve_indep(cand, leaf, risky_lanes,
-                                            xl, dev_weight)
+                                            xl, dev_weight, room)
         sel = jnp.where(valid[:, None], sel, NONE)
         lres = lres & valid
         residual = risky | jnp.any(lres.reshape(-1, self.parents), axis=1)
@@ -652,9 +672,12 @@ class FastRule:
         sel = leaves if self.leafy else outs
         return sel, residual
 
-    def _resolve_indep(self, cand, leaf, risky, x, dev_weight):
+    def _resolve_indep(self, cand, leaf, risky, x, dev_weight,
+                       room=None):
         """indep rounds: r = rep + numrep*ftotal; UNDEF slots retry,
-        dead ends become NONE (mapper.c:638-790)."""
+        dead ends become NONE (mapper.c:638-790).  *room* (per-lane)
+        caps how many slots this parent may fill when the result is
+        narrower than parents*numrep."""
         R, X = cand.shape
         numrep = self.numrep
         x = x.astype(jnp.uint32)
@@ -667,6 +690,8 @@ class FastRule:
                 r = rep + numrep * ftotal
                 item = cand[r]
                 unfilled = outs[:, rep] == UNDEF
+                if room is not None:
+                    unfilled = unfilled & (jnp.int32(rep) < room)
                 coll = jnp.any(outs == item[:, None], axis=1)
                 if self.leafy:
                     lok = jnp.zeros((X,), dtype=bool)
@@ -691,7 +716,10 @@ class FastRule:
                     jnp.where(take, item, outs[:, rep]))
                 leaves = leaves.at[:, rep].set(
                     jnp.where(take, lsel, leaves[:, rep]))
-        unfinished = jnp.any(outs == UNDEF, axis=1)
+        undef = outs == UNDEF
+        if room is not None:
+            undef = undef & (jnp.arange(numrep)[None, :] < room[:, None])
+        unfinished = jnp.any(undef, axis=1)
         if self.n_rounds < self.tries:
             residual = residual | unfinished
         outs = jnp.where(outs == UNDEF, NONE, outs)

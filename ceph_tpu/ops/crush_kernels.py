@@ -140,7 +140,12 @@ class CompiledCrushMap:
     """
 
     def __init__(self, m: CrushMap,
-                 choose_args: Optional[Sequence] = None):
+                 choose_args: Optional[Sequence] = None,
+                 allow_legacy: bool = False):
+        """``allow_legacy`` additionally admits straw(v1) buckets and
+        pre-bobtail local-tries tunables — consumed only by the legacy
+        fast path (ops/crush_legacy.py), which models those semantics;
+        the plain loop kernel does not."""
         nb = len(m.buckets)
         S = max((b.size for b in m.buckets if b is not None), default=1)
         S = max(S, 1)
@@ -154,11 +159,19 @@ class CompiledCrushMap:
                 if arg is not None and arg.weight_set:
                     npos = max(npos, len(arg.weight_set))
         weights = np.zeros((npos, nb, S), dtype=np.uint32)
+        algs = np.zeros(nb, dtype=np.int32)
+        straws = np.zeros((nb, S), dtype=np.uint32)
         for bi, b in enumerate(m.buckets):
             if b is None:
                 continue
             if b.size and b.alg != CRUSH_BUCKET_STRAW2:
-                raise ValueError("device mapper supports straw2 buckets only")
+                from ..crush.constants import CRUSH_BUCKET_STRAW
+                if not (allow_legacy and b.alg == CRUSH_BUCKET_STRAW):
+                    raise ValueError(
+                        "device mapper supports straw2 buckets only")
+                straws[bi, :b.size] = np.asarray(b.straws,
+                                                 dtype=np.uint32)
+            algs[bi] = b.alg
             sizes[bi] = b.size
             types[bi] = b.type
             items[bi, :b.size] = b.items
@@ -181,13 +194,18 @@ class CompiledCrushMap:
                             ws.weights, dtype=np.uint32)
                 if arg.ids:
                     hash_ids[bi, :b.size] = arg.ids
-        if m.choose_local_tries or m.choose_local_fallback_tries:
+        if not allow_legacy and (m.choose_local_tries
+                                 or m.choose_local_fallback_tries):
             raise ValueError("device mapper requires bobtail+ tunables "
                              "(choose_local_*_tries == 0)")
         self.map = m
         self.nbuckets = nb
         self.max_size = S
         self.npos = npos
+        self.algs = np.asarray(algs)
+        # straw(v1) scalers only matter to the legacy path; don't pay a
+        # device transfer of zeros on every production compile
+        self.straws = jnp.asarray(straws) if allow_legacy else None
         self.items = jnp.asarray(items)
         self.hash_ids = jnp.asarray(hash_ids)
         self.sizes = jnp.asarray(sizes)

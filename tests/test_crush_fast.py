@@ -346,3 +346,17 @@ def test_fast_delta_indep_epochs_stay_exact():
             expect = cw.do_rule(rno, int(x), 3, wl)
             got = [int(v) for v in res[x, :cnt[x]]]
             assert got == expect, (epoch, x, got, expect)
+
+
+def test_fast_chained_indep_room_truncation():
+    """result_max not a multiple of the last step's numrep: the
+    reference truncates the straddling parent's block (out_size =
+    result_max - osize), so retries must never collide with slots the
+    reference never fills."""
+    cw, n = build_map(n_hosts=4, osds_per_host=3)
+    rno = chained_rule(cw, "indep", n1=2, n2=2)
+    rng = np.random.default_rng(9)
+    for trial in range(3):
+        weight = [int(w) for w in rng.choice(
+            [0, 0x6000, 0x10000], size=n, p=[0.25, 0.25, 0.5])]
+        assert_fast_parity(cw, rno, 3, weight, n_x=1024)
