@@ -1,0 +1,25 @@
+"""MonClient — the client's mon stub for cross-process clusters.
+
+RadosClient drives its monitor through two calls (subscribe +
+send_full_map).  In-process clusters hand it the Monitor object; across
+process boundaries this stub speaks MMonSubscribe over the wire instead
+(src/mon/MonClient.h role: the client-side session with the mon).
+"""
+from __future__ import annotations
+
+from ..msg.messages import MMonSubscribe
+
+
+class MonClient:
+    def __init__(self, network, mon_name: str = "mon"):
+        self.network = network
+        self.mon_name = mon_name
+
+    def subscribe(self, name: str) -> None:
+        """Subscribe and fetch are ONE wire operation here: the mon
+        answers every MMonSubscribe with the full history."""
+        self.network.send(name, self.mon_name, MMonSubscribe())
+
+    # RadosClient calls both on its monitor handle; over the wire they
+    # are the same request
+    send_full_map = subscribe

@@ -27,7 +27,10 @@ from typing import Dict, List, Optional, Set
 from ..crush.constants import CRUSH_BUCKET_STRAW2
 from ..ec import create_erasure_code
 from ..msg import Dispatcher, MOSDFailure, MOSDMap, Message, Network
-from ..msg.messages import MMonElection, MMonPaxos, MMonPing, MOSDPGTemp
+from ..msg.messages import (
+    MMonElection, MMonPaxos, MMonPing, MMonSubscribe, MOSDBoot,
+    MOSDPGTemp,
+)
 from ..osdmap import (
     CEPH_OSD_IN, Incremental, OSDMap, TYPE_ERASURE, TYPE_REPLICATED,
     pg_pool_t,
@@ -54,6 +57,11 @@ class Monitor(Dispatcher):
         # failure reports per target (mon_osd_min_down_reporters=2 —
         # a single partitioned reporter can't take the cluster down)
         self._failure_reports: Dict[int, set] = {}
+        # down->out auto-eviction (mon_osd_down_out_interval, 600 s
+        # default): a dead osd is marked out so CRUSH re-places its
+        # data; a mere flap that reboots in time keeps its weight
+        self.down_out_interval = 600.0
+        self._down_stamps: Dict[int, float] = {}
         # ---- election / quorum state (Elector.cc role) --------------------
         self.election_epoch = 0
         self.leader_rank = 0 if not self.peers else -1
@@ -171,6 +179,12 @@ class Monitor(Dispatcher):
         self._collect_pn = self.election_epoch
         self._collect_uncommitted = self._uncommitted
         self._uncommitted = None
+        # down->out bookkeeping is leader-local: rebuild it from the
+        # map so eviction survives leadership changes (the reference
+        # reconstructs down_pending_out the same way)
+        for o in range(self.osdmap.max_osd):
+            if not self.osdmap.is_up(o) and self.osdmap.osd_weight[o]:
+                self._down_stamps.setdefault(o, self.now)
         for r in self.quorum - {self.rank}:
             name = self._peer_name(r)
             if name:
@@ -430,6 +444,15 @@ class Monitor(Dispatcher):
     # ---- liveness (elector keepalives) ------------------------------------
     def tick(self, now: float) -> None:
         self.now = now
+        if self.is_leader() or not self.peers:
+            # down->out eviction (OSDMonitor::tick down_pending_out)
+            for osd, t0 in list(self._down_stamps.items()):
+                if self.osdmap.is_up(osd) or \
+                        self.osdmap.osd_weight[osd] == 0:
+                    del self._down_stamps[osd]   # revived, or already out
+                elif now - t0 >= self.down_out_interval:
+                    del self._down_stamps[osd]
+                    self.mark_osd_out(osd)
         if not self.peers:
             return
         for p in self.peers:
@@ -635,6 +658,7 @@ class Monitor(Dispatcher):
         reporter = f"osd.{osd}"
         for reps in self._failure_reports.values():
             reps.discard(reporter)
+        self._down_stamps.setdefault(osd, self.now)
         self.publish(inc)
 
     def mark_osd_up(self, osd: int) -> None:
@@ -642,6 +666,7 @@ class Monitor(Dispatcher):
         inc.new_up[osd] = True
         # recovery voids any partial reports against this osd
         self._failure_reports.pop(osd, None)
+        self._down_stamps.pop(osd, None)
         self.publish(inc)
 
     def mark_osd_out(self, osd: int) -> None:
@@ -702,7 +727,12 @@ class Monitor(Dispatcher):
         return 2 if n_up > 2 else 1
 
     def ms_fast_dispatch(self, msg: Message) -> None:
-        if isinstance(msg, MMonElection):
+        if isinstance(msg, MMonSubscribe):
+            # cross-process clients/daemons subscribe over the wire
+            # (the in-process ones call subscribe() directly)
+            self.subscribe(msg.src)
+            self.send_full_map(msg.src)
+        elif isinstance(msg, MMonElection):
             self._handle_election(msg)
         elif isinstance(msg, MMonPaxos):
             self._handle_paxos(msg)
@@ -717,6 +747,18 @@ class Monitor(Dispatcher):
                     self.messenger.send_message(MOSDPGTemp(
                         pgid=msg.pgid, epoch=msg.epoch,
                         temp=list(msg.temp)), name)
+        elif isinstance(msg, MOSDBoot):
+            # a live osd the map calls down asks back in
+            # (OSDMonitor::preprocess_boot/prepare_boot)
+            if self.is_leader() or not self.peers:
+                if 0 <= msg.osd < self.osdmap.max_osd and \
+                        not self.osdmap.is_up(msg.osd):
+                    self.mark_osd_up(msg.osd)
+            elif self.is_peon():
+                name = self._peer_name(self.leader_rank)
+                if name:
+                    self.messenger.send_message(MOSDBoot(
+                        osd=msg.osd, epoch=msg.epoch), name)
         elif isinstance(msg, MOSDFailure):
             if not self.is_leader():
                 # peons forward to the leader (Monitor::forward_request);

@@ -204,6 +204,12 @@ class MOSDPGInfo(Message):
     # per-head snapset blobs: clone bookkeeping must survive primary
     # failover/backfill, so it rides peering like the log does
     snapsets: List[Tuple[str, bytes]] = field(default_factory=list)
+    # backfill completion (last_backfill == MAX role): the target holds
+    # every object the primary knew, so it adopts the primary's log
+    # WHOLESALE (entries + head + tail) — without this a pushed-only
+    # shard keeps last_update 0 and every later peering re-treats it as
+    # missing everything
+    adopt_log: bool = False
     # which EC shard collections this OSD actually HOLDS data for —
     # acting positions can shuffle on remap, and the pg_log alone can't
     # tell a data-bearing replica from a freshly assigned one
@@ -331,6 +337,21 @@ class MMonPaxos(Message):
     # (Paxos.cc handle_last uncommitted_v/uncommitted_pn)
     uncommitted_pn: int = -1
     uncommitted_value: Optional[Any] = None
+
+
+@dataclass
+class MOSDBoot(Message):
+    """OSD -> mon: I am alive, mark me up (src/messages/MOSDBoot.h;
+    sent at init and when a live osd sees itself marked down)."""
+    osd: int = -1
+    epoch: int = 0
+
+
+@dataclass
+class MMonSubscribe(Message):
+    """Client/daemon -> mon: subscribe to map updates and get the full
+    history now (src/messages/MMonSubscribe.h, 'osdmap' what)."""
+    what: str = "osdmap"
 
 
 @dataclass

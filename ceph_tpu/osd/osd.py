@@ -35,6 +35,9 @@ from .pg_log import LogEntry, OP_DELETE
 
 HEARTBEAT_GRACE = 20.0     # osd_heartbeat_grace default (options.cc:2461)
 HEARTBEAT_INTERVAL = 6.0   # osd_heartbeat_interval (options.cc:2456)
+RECOVERY_RETRY = 10.0      # re-kick a recovery whose reply chain went
+                           # silent (a push can race a peer's map epoch
+                           # and be dropped pg-less on arrival)
 
 # perf counter indices (l_osd_* analog, osd/OSD.cc:3099)
 L_OSD_FIRST = 1000
@@ -199,6 +202,20 @@ class OSD(Dispatcher):
                 for o in range(self.osdmap.max_osd):
                     if self.osdmap.is_up(o) and o not in was_up:
                         self.last_ping_reply[o] = self.now
+                if self.osd_id < self.osdmap.max_osd and \
+                        not self.osdmap.is_up(self.osd_id):
+                    # the map says we are down but we are demonstrably
+                    # alive: ask to be marked back up, once per epoch
+                    # (OSD::_committed_osd_maps "marked down" reboot +
+                    # MOSDBoot to the mon)
+                    if getattr(self, "_boot_sent_epoch", -1) != \
+                            self.osdmap.epoch:
+                        self._boot_sent_epoch = self.osdmap.epoch
+                        from ..msg.messages import MOSDBoot
+                        for mon in self.mon_names:
+                            self.messenger.send_message(
+                                MOSDBoot(osd=self.osd_id,
+                                         epoch=self.osdmap.epoch), mon)
                 self._consume_map()
 
     def _consume_map(self) -> None:
@@ -358,6 +375,17 @@ class OSD(Dispatcher):
                 pg.sweep_notifies()
             pg.retry_pending_pg_temp()
             pg.maybe_realign()
+            # stuck recoveries (reply chain lost to a map race or a
+            # mid-flight death): forget and re-drive them
+            stale = [oid for oid, t0 in pg._recovering_since.items()
+                     if now - t0 > RECOVERY_RETRY]
+            for oid in stale:
+                pg._recovering_since.pop(oid, None)
+                if oid in pg._recovering:
+                    self.dout(3, f"recovery of {oid} pg {pg.pgid} "
+                              "stalled; re-kicking")
+                    pg._recovering.discard(oid)
+                    self.request_recovery(pg)
         for peer in peers:
             last = self.last_ping_reply.get(peer, now)
             self.last_ping_reply.setdefault(peer, now)
@@ -443,6 +471,7 @@ class OSD(Dispatcher):
             pg.recovery_done_for(oid)
             return
         pg._recovering.add(oid)
+        pg._recovering_since[oid] = self.now
         self.dout(5, f"recover_oid {oid} pg {pg.pgid} "
                   f"targets {sorted(targets)}", )
         if all(op == OP_DELETE for (_v, op) in targets.values()):
@@ -478,11 +507,17 @@ class OSD(Dispatcher):
             version = max(v for (v, _op) in targets.values())
 
             def pushed() -> None:
+                self.dout(5, f"recovery push of {oid} acked by "
+                          f"{sorted(needed)}")
                 for s in needed:
                     pg.missing.get(s, {}).pop(oid, None)
+                    if not pg.missing.get(s):
+                        pg.send_backfill_complete(s)
                 self.perf_counters.inc(L_OSD_RECOVERY_PUSH, len(needed))
                 pg.recovery_done_for(oid)
 
+            self.dout(5, f"recovery pushing {oid} -> shards "
+                      f"{sorted(needed)} acting {pg.acting}")
             be.push_chunks(oid, {s: rec[s] for s in needed}, size, pushed,
                            version=version, xattrs=attrs)
 
@@ -549,4 +584,9 @@ class OSD(Dispatcher):
             self.perf_counters.inc(L_OSD_RECOVERY_PUSH)
         for s in list(targets):
             pg.missing.get(s, {}).pop(oid, None)
+        # NOTE: no send_backfill_complete here — rep pushes are
+        # fire-and-forget (no ack path), so adopting the log now could
+        # mask a lost push as a complete replica.  A log-less rep
+        # target is merely re-pushed on the next peering round (any
+        # single copy serves reads, unlike EC's k-source requirement).
         pg.recovery_done_for(oid)

@@ -207,6 +207,7 @@ class PG:
         self._backfill_pending: Set[int] = set()
         self._self_backfill_from: Optional[int] = None
         self._recovering: Set[str] = set()
+        self._recovering_since: Dict[str, float] = {}
         self._waiting_for_recovery: Dict[str, List[Callable[[], None]]] = {}
 
     # ---- identity ---------------------------------------------------------
@@ -353,6 +354,7 @@ class PG:
         self._self_backfill_from = None
         self.missing = {}
         self._recovering.clear()
+        self._recovering_since.clear()
         self._waiting_for_recovery.clear()
         if self.backend is not None:
             self.backend.on_change()
@@ -657,9 +659,14 @@ class PG:
         queries went out (peering resumes on their fresh infos)."""
         if self.backend is None or self._rewind_requested:
             return False
+        # only LOG-bearing data shards vote: a backfilled/pushed shard
+        # holds chunks but no history (last_update 0, like a reference
+        # backfill target) — counting it would drag the horizon to 0
+        # and destroy healthy peers' state
         lus = sorted((info.last_update
                       for shard, info in self._peer_infos.items()
-                      if shard in info.held_shards),
+                      if shard in info.held_shards
+                      and info.last_update > 0),
                      reverse=True)
         k = self.backend.k
         if len(lus) < k:
@@ -801,11 +808,56 @@ class PG:
         if self.state == STATE_ACTIVE_RECOVERING or self._backfill_pending:
             self.osd.request_recovery(self)
 
+    def send_backfill_complete(self, shard: int) -> None:
+        """Primary: this shard now holds every object we tracked —
+        ship our log wholesale so its info stops reading as
+        missing-everything (the reference's last_backfill == MAX info
+        update at backfill completion)."""
+        osd = self.acting_shards().get(shard)
+        if osd is None or osd == self.osd.osd_id:
+            return
+        self.send_to_osd(osd, MOSDPGInfo(
+            pgid=self.pgid, shard=shard,
+            epoch=self.last_epoch_started,
+            last_update=self.pg_log.head, log_tail=self.pg_log.tail,
+            log_entries=[e.encode() for e in self.pg_log.entries],
+            snapsets=self._encoded_snapsets(), adopt_log=True))
+
+    def _adopt_full_log(self, msg: MOSDPGInfo) -> None:
+        """Backfill target: adopt the primary's log window (entries +
+        head + tail) — our data is complete, our history was not."""
+        from .pg_log import LAST_UPDATE_ATTR, LOG_TAIL_ATTR
+        self.merge_snapsets(msg.snapsets)
+        t = Transaction()
+        cid = self.ensure_meta_collection(t)
+        meta = hobject_t(PG_META_OID)
+        t.touch(cid, meta)
+        entries = sorted((LogEntry.decode(b) for b in msg.log_entries),
+                         key=lambda e: e.version)
+        for e in entries:
+            t.omap_setkeys(cid, meta,
+                           {PGLog._key(e.version): e.encode()})
+        t.setattr(cid, meta, LAST_UPDATE_ATTR,
+                  struct.pack("<Q", msg.last_update))
+        t.setattr(cid, meta, LOG_TAIL_ATTR,
+                  struct.pack("<Q", msg.log_tail))
+        self.osd.store.queue_transaction(t)
+        self.pg_log.entries = entries
+        self.pg_log.head = max(self.pg_log.head, msg.last_update)
+        self.pg_log.tail = max(self.pg_log.tail, msg.log_tail)
+        self._version_alloc = max(self._version_alloc, self.pg_log.head)
+        dlog("pg", 4, f"pg {self.pgid} adopted log to "
+             f"v{self.pg_log.head} (backfill complete)",
+             f"osd.{self.osd.osd_id}")
+
     def _apply_activation(self, msg: MOSDPGInfo) -> None:
         """Replica side: adopt the authoritative log suffix.  Modify
         entries whose data has not arrived are recorded in local_missing
         (the head advances, the data debt does not vanish — pg_missing_t);
         delete entries apply immediately (reference merge_log)."""
+        if msg.adopt_log:
+            self._adopt_full_log(msg)
+            return
         self.merge_snapsets(msg.snapsets)
         entries = [LogEntry.decode(b) for b in msg.log_entries]
         if not entries:
@@ -1774,6 +1826,7 @@ class PG:
 
     def recovery_done_for(self, oid: str) -> None:
         self._recovering.discard(oid)
+        self._recovering_since.pop(oid, None)
         self._maybe_clean()
         for cb in self._waiting_for_recovery.pop(oid, []):
             cb()

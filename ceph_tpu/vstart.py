@@ -1,0 +1,270 @@
+"""vstart-lite: a REAL multi-process cluster on localhost TCP sockets.
+
+The reference's integration tier runs mon/mgr/osd daemons as separate
+processes on localhost ports (src/vstart.sh;
+qa/standalone/ceph-helpers.sh run_mon/run_osd) and thrashes them with
+kill -9 (qa/tasks/ceph_manager.py:195 kill_osd).  This module is that
+tier for ceph_tpu: ``python -m ceph_tpu.vstart mon|osd ...`` daemon
+entrypoints over the TCP messenger (msg/tcp.py), plus a
+``ProcessCluster`` harness that spawns one mon process and N OSD
+processes, hands out wire-connected clients, and SIGKILLs daemons.
+
+Every byte — client ops, EC sub-writes, peering queries, heartbeats,
+failure reports, map publications — crosses real process boundaries
+through the framed wire codec; nothing shortcuts through shared memory.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pin_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+# ---- daemon mains ----------------------------------------------------------
+
+def mon_main(args) -> None:
+    """Monitor daemon: bootstrap the map, create the requested pool,
+    serve subscriptions/failure reports forever."""
+    _pin_cpu()
+    from .mon import Monitor
+    from .msg.tcp import TcpNetwork
+
+    directory = json.loads(args.directory)
+    net = TcpNetwork(("127.0.0.1", args.port),
+                     {k: tuple(v) for k, v in directory.items()})
+    mon = Monitor(net, name="mon")
+    if args.down_out_interval:
+        mon.down_out_interval = args.down_out_interval
+    mon.bootstrap(args.n_osds, osds_per_host=1)
+    for i in range(args.n_osds):
+        mon.subscribe(f"osd.{i}")
+    if args.pool:
+        spec = json.loads(args.pool)
+        if spec.get("type") == "replicated":
+            mon.create_replicated_pool(spec["name"], size=spec["size"],
+                                       pg_num=spec["pg_num"])
+        else:
+            mon.create_ec_profile("vprof", spec["profile"])
+            mon.create_ec_pool(spec["name"], "vprof",
+                               pg_num=spec["pg_num"])
+    mon.publish()
+    net.pump()
+    for i in range(args.n_osds):
+        mon.send_full_map(f"osd.{i}")
+    print("READY", flush=True)
+    while True:
+        net.pump(quiesce=0.02, deadline=0.5)
+        mon.tick(time.monotonic())
+
+
+def osd_main(args) -> None:
+    """OSD daemon: dispatch loop + heartbeat ticks + recovery rounds."""
+    _pin_cpu()
+    from .msg.tcp import TcpNetwork
+    from .osd import osd as osd_mod
+
+    if args.heartbeat_grace:
+        osd_mod.HEARTBEAT_GRACE = args.heartbeat_grace
+    if args.debug:
+        from .common.config import g_conf
+        from .common.dout import _log
+        for s in ("osd", "pg", "recovery"):
+            g_conf.set_val(f"debug_{s}", f"{args.debug}/{args.debug}")
+        _log.stderr_level = args.debug
+    directory = json.loads(args.directory)
+    net = TcpNetwork(("127.0.0.1", args.port),
+                     {k: tuple(v) for k, v in directory.items()})
+    daemon = osd_mod.OSD(net, args.id, mon_name="mon")
+    # boot subscription: the mon's startup map pushes predate this
+    # process's listener, so ask for the full history explicitly
+    # (MonClient::sub_want("osdmap") at OSD::init)
+    from .msg.messages import MMonSubscribe
+    net.send(daemon.name, "mon", MMonSubscribe())
+    print("READY", flush=True)
+    interval = args.heartbeat_interval or osd_mod.HEARTBEAT_INTERVAL
+    # warm-up: the first tick waits one full interval so sibling
+    # daemons still booting don't read as silent peers
+    last_tick = time.monotonic()
+    while True:
+        net.pump(quiesce=0.02, deadline=0.5)
+        now = time.monotonic()
+        if now - last_tick >= interval:
+            daemon.tick(now)
+            last_tick = now
+        daemon.run_recovery()
+
+
+# ---- harness ---------------------------------------------------------------
+
+def _free_ports(n: int) -> List[int]:
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class ProcessCluster:
+    """Spawn mon + N OSDs as real processes; clients live in the
+    calling process and speak TCP like everyone else."""
+
+    def __init__(self, n_osds: int = 6, pool: Optional[dict] = None,
+                 heartbeat_interval: float = 1.0,
+                 heartbeat_grace: float = 4.0,
+                 down_out_interval: float = 5.0,
+                 client_names: Tuple[str, ...] = ("client.x",)):
+        self.n_osds = n_osds
+        ports = _free_ports(n_osds + 2)
+        self.mon_port = ports[0]
+        self.client_port = ports[1]
+        self.osd_ports = ports[2:]
+        directory: Dict[str, Tuple[str, int]] = {
+            "mon": ("127.0.0.1", self.mon_port)}
+        for name in client_names:
+            directory[name] = ("127.0.0.1", self.client_port)
+        for i in range(n_osds):
+            directory[f"osd.{i}"] = ("127.0.0.1", self.osd_ports[i])
+        self.directory = directory
+        dir_json = json.dumps({k: list(v) for k, v in directory.items()})
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self.network = None
+        try:
+            self._spawn(n_osds, dir_json, env, pool, heartbeat_interval,
+                        heartbeat_grace, down_out_interval)
+        except Exception:
+            self.close()
+            raise
+
+    def _spawn(self, n_osds, dir_json, env, pool, heartbeat_interval,
+               heartbeat_grace, down_out_interval) -> None:
+        self.procs["mon"] = subprocess.Popen(
+            [sys.executable, "-m", "ceph_tpu.vstart", "mon",
+             "--port", str(self.mon_port), "--n-osds", str(n_osds),
+             "--directory", dir_json,
+             "--down-out-interval", str(down_out_interval),
+             "--pool", json.dumps(pool) if pool else ""],
+            stdout=subprocess.PIPE, text=True, cwd=REPO, env=env)
+        self._await_ready("mon")
+        # spawn every osd CONCURRENTLY: a sequential boot staggers the
+        # daemons' first heartbeats past the grace window and the
+        # cluster marks itself down before it finishes starting
+        for i in range(n_osds):
+            self.procs[f"osd.{i}"] = subprocess.Popen(
+                [sys.executable, "-m", "ceph_tpu.vstart", "osd",
+                 "--id", str(i), "--port", str(self.osd_ports[i]),
+                 "--directory", dir_json,
+                 "--heartbeat-interval", str(heartbeat_interval),
+                 "--heartbeat-grace", str(heartbeat_grace)],
+                stdout=subprocess.PIPE, text=True, cwd=REPO, env=env)
+        for i in range(n_osds):
+            self._await_ready(f"osd.{i}")
+        from .msg.tcp import TcpNetwork
+        self.network = TcpNetwork(("127.0.0.1", self.client_port),
+                                  self.directory)
+
+    def _await_ready(self, name: str, timeout: float = 120.0) -> None:
+        import select
+        proc = self.procs[name]
+        r, _, _ = select.select([proc.stdout], [], [], timeout)
+        if not r:
+            raise RuntimeError(f"{name} did not report READY in "
+                               f"{timeout}s")
+        line = proc.stdout.readline()
+        if line.strip() != "READY":
+            raise RuntimeError(f"{name} failed to start: {line!r}")
+
+    def client(self, name: str = "client.x"):
+        from .client.mon_client import MonClient
+        from .client.rados import RadosClient
+        return RadosClient(self.network, MonClient(self.network), name)
+
+    def wait_healthy(self, cl, timeout: float = 60.0) -> None:
+        """Block until the map shows every osd up (daemons can still be
+        booting/re-booting when the first client appears)."""
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            self.network.pump(quiesce=0.05, deadline=0.3)
+            cl.mon.send_full_map(cl.name)
+            self.network.pump(quiesce=0.05, deadline=0.3)
+            m = cl.osdmap
+            if m.max_osd == self.n_osds and \
+                    all(m.is_up(o) for o in range(self.n_osds)):
+                return
+            time.sleep(0.2)
+        raise RuntimeError("cluster never became healthy")
+
+    def kill_osd(self, osd_id: int) -> None:
+        """kill -9 the daemon process (ceph_manager.py:195)."""
+        p = self.procs[f"osd.{osd_id}"]
+        p.send_signal(signal.SIGKILL)
+        p.wait()
+
+    def pump_for(self, seconds: float) -> None:
+        """Keep the client-side socket drained while the daemons work."""
+        end = time.monotonic() + seconds
+        while time.monotonic() < end:
+            self.network.pump(quiesce=0.05, deadline=0.3)
+
+    def close(self) -> None:
+        for p in self.procs.values():
+            try:
+                p.kill()
+            except OSError:
+                pass
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                pass
+        if self.network is not None:
+            self.network.close()
+
+
+def main(argv=None) -> None:
+    import argparse
+    ap = argparse.ArgumentParser(prog="ceph_tpu.vstart")
+    sub = ap.add_subparsers(dest="role", required=True)
+    pm = sub.add_parser("mon")
+    pm.add_argument("--port", type=int, required=True)
+    pm.add_argument("--n-osds", type=int, required=True)
+    pm.add_argument("--directory", required=True)
+    pm.add_argument("--pool", default="")
+    pm.add_argument("--down-out-interval", type=float, default=0.0)
+    po = sub.add_parser("osd")
+    po.add_argument("--id", type=int, required=True)
+    po.add_argument("--port", type=int, required=True)
+    po.add_argument("--directory", required=True)
+    po.add_argument("--heartbeat-interval", type=float, default=0.0)
+    po.add_argument("--heartbeat-grace", type=float, default=0.0)
+    po.add_argument("--debug", type=int,
+                    default=int(os.environ.get("VSTART_DEBUG", "0")))
+    args = ap.parse_args(argv)
+    if args.role == "mon":
+        mon_main(args)
+    else:
+        osd_main(args)
+
+
+if __name__ == "__main__":
+    main()
