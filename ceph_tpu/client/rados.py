@@ -210,6 +210,12 @@ class RadosClient(Dispatcher):
     # ---- Objecter-lite ----------------------------------------------------
     def _calc_target(self, pool_id: int, oid: str):
         pool = self.osdmap.get_pg_pool(pool_id)
+        if pool is not None and pool.read_tier >= 0:
+            # cache tier overlay: ops retarget to the cache pool
+            # (Objecter op_target read_tier/write_tier resolution)
+            tier = self.osdmap.get_pg_pool(pool.read_tier)
+            if tier is not None:
+                pool_id, pool = pool.read_tier, tier
         raw = self.osdmap.map_to_pg(pool_id, oid)
         ps = ceph_stable_mod(raw.ps, pool.pg_num, pool.pg_num_mask)
         pg = pg_t(pool_id, ps)
@@ -225,7 +231,7 @@ class RadosClient(Dispatcher):
             self._tid += 1
             tid = self._tid
             if primary >= 0:
-                msg = MOSDOp(tid=tid, pool=pool_id, oid=oid, pgid=pgid,
+                msg = MOSDOp(tid=tid, pool=pgid[0], oid=oid, pgid=pgid,
                              op=op, data=data, offset=offset,
                              length=length, epoch=self.osdmap.epoch,
                              ops=list(ops) if ops else [],

@@ -553,6 +553,47 @@ class Monitor(Dispatcher):
         self._topology_dirty = True
         return self.osdmap.add_pool(name, pool)
 
+    # ---- cache tiering (OSDMonitor "osd tier add/cache-mode") --------------
+    def add_cache_tier(self, base_name: str, cache_name: str,
+                       mode: str = "writeback",
+                       hit_set_period: float = 60.0,
+                       hit_set_count: int = 4,
+                       target_max_objects: int = 0) -> None:
+        """Overlay *cache_name* (replicated) on *base_name*: clients
+        redirect to the cache; the cache PGs promote/flush/evict
+        (OSDMonitor::prepare_command 'osd tier add' + 'cache-mode' +
+        'set-overlay')."""
+        base_id = self.osdmap.lookup_pg_pool_name(base_name)
+        cache_id = self.osdmap.lookup_pg_pool_name(cache_name)
+        if base_id < 0 or cache_id < 0:
+            raise KeyError("unknown pool")
+        cache = self.osdmap.pools[cache_id]
+        if cache.type != TYPE_REPLICATED:
+            raise ValueError("cache tier pool must be replicated")
+        if mode != "writeback":
+            raise ValueError("only writeback cache-mode is implemented")
+        cache.tier_of = base_id
+        cache.cache_mode = mode
+        cache.hit_set_period = hit_set_period
+        cache.hit_set_count = hit_set_count
+        cache.target_max_objects = target_max_objects
+        base = self.osdmap.pools[base_id]
+        base.read_tier = cache_id
+        base.write_tier = cache_id
+        self._topology_dirty = True
+
+    def remove_cache_tier(self, base_name: str) -> None:
+        base_id = self.osdmap.lookup_pg_pool_name(base_name)
+        base = self.osdmap.pools[base_id]
+        if base.read_tier >= 0:
+            cache = self.osdmap.pools.get(base.read_tier)
+            if cache is not None:
+                cache.tier_of = -1
+                cache.cache_mode = ""
+        base.read_tier = -1
+        base.write_tier = -1
+        self._topology_dirty = True
+
     # ---- pool snapshots (OSDMonitor pool mksnap/rmsnap) --------------------
     def pool_snap_create(self, pool_name: str, snap_name: str) -> int:
         """Allocate the next snap id on the pool; publish via the next

@@ -101,6 +101,10 @@ class OSD(Dispatcher):
         self.op_wq = ShardedOpWQ()
         self._rep_pulls: Dict[int, Callable] = {}
         self._pull_tid = 0
+        # tier ops this OSD issued as a client of the base pool
+        # (promote reads / flush writes): tid -> reply callback
+        self._tier_ops: Dict[int, Callable] = {}
+        self._tier_tid = 1 << 40     # clear of client tid spaces
 
     # legacy-style dict view used by tests / admin socket
     @property
@@ -125,6 +129,11 @@ class OSD(Dispatcher):
     def ms_fast_dispatch(self, msg: Message) -> None:
         if isinstance(msg, MOSDMap):
             self._handle_osd_map(msg)
+        elif isinstance(msg, MOSDOpReply):
+            # replies to this OSD's own tier ops (promote/flush)
+            ent = self._tier_ops.pop(msg.tid, None)
+            if ent is not None:
+                ent[0](msg)
         elif isinstance(msg, MOSDOp):
             self._handle_op(msg)
         elif isinstance(msg, MOSDECSubOpWrite):
@@ -375,6 +384,14 @@ class OSD(Dispatcher):
                 pg.sweep_notifies()
             pg.retry_pending_pg_temp()
             pg.maybe_realign()
+            if pg.tier is not None and pg.is_primary():
+                pg.tier.agent_work(now)
+        # tier ops whose reply never came (base primary died, message
+        # lost): fail them so promotes/flushes unwind and retry
+        for tid, (cb, t0) in list(self._tier_ops.items()):
+            if now - t0 > RECOVERY_RETRY:
+                del self._tier_ops[tid]
+                cb(MOSDOpReply(tid=tid, result=-110))
             # stuck recoveries (reply chain lost to a map race or a
             # mid-flight death): forget and re-drive them
             stale = [oid for oid, t0 in pg._recovering_since.items()
@@ -431,6 +448,36 @@ class OSD(Dispatcher):
         else:
             peer = int(msg.src.split(".")[1])
             self.last_ping_reply[peer] = self.now
+
+    # ---- tier client (Objecter-lite for promote/flush) ---------------------
+    def tier_submit(self, pool_id: int, oid: str, ops,
+                    on_reply: Callable) -> None:
+        """Send an op vector to *pool_id*'s primary on this OSD's own
+        behalf (the cache PG acting as a client of its base pool —
+        PrimaryLogPG's copy-from/flush ops role).  An unreachable or
+        unanswering target fails the op via the tick timeout sweep so
+        callers never park forever."""
+        from ..osdmap.types import ceph_stable_mod
+        pool = self.osdmap.get_pg_pool(pool_id)
+        primary = -1
+        ps = 0
+        if pool is not None:
+            raw = self.osdmap.map_to_pg(pool_id, oid)
+            ps = ceph_stable_mod(raw.ps, pool.pg_num, pool.pg_num_mask)
+            *_, _acting, primary = self.osdmap.pg_to_up_acting_osds(
+                pg_t(pool_id, ps))
+        if pool is None or primary < 0:
+            # fail asynchronously so callers' state machines unwind the
+            # same way they do for a timeout
+            on_reply(MOSDOpReply(tid=0, result=-110))
+            return
+        self._tier_tid += 1
+        tid = self._tier_tid
+        self._tier_ops[tid] = (on_reply, self.now)
+        self.messenger.send_message(
+            MOSDOp(tid=tid, pool=pool_id, oid=oid, pgid=(pool_id, ps),
+                   epoch=self.osdmap.epoch, ops=list(ops)),
+            f"osd.{primary}")
 
     # ---- recovery (message-driven; ECBackend.cc:535-743) -------------------
     def request_recovery(self, pg: PG) -> None:

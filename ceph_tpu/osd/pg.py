@@ -178,6 +178,12 @@ class PG:
             self.backend = ECBackend(self, ec_impl, pool.stripe_width)
         else:
             self.rep_backend = ReplicatedBackend(self)
+        # cache-tier machinery (replicated cache pools only)
+        self.tier = None
+        if pool.tier_of >= 0 and pool.cache_mode and \
+                self.rep_backend is not None:
+            from .tier import TierState
+            self.tier = TierState(self)
         # log + versions (one per PG replica; persists in the meta coll)
         self.pg_log = PGLog()
         self.pg_log.load(osd.store, self.meta_cid())
@@ -324,6 +330,19 @@ class PG:
                              newpool.removed_snaps !=
                              self.pool.removed_snaps)
             self.pool = newpool
+            if self.tier is None and newpool.tier_of >= 0 and \
+                    newpool.cache_mode and self.rep_backend is not None:
+                from .tier import TierState
+                self.tier = TierState(self)
+            elif self.tier is not None and newpool.tier_of < 0:
+                # overlay removed: stop intercepting, drain every
+                # dirty object down, then drop the state (the agent
+                # clears self.tier once nothing is owed; replicas owe
+                # nothing and drop immediately)
+                if self.tier.dirty or self.tier._flushing:
+                    self.tier.shutting_down = True
+                else:
+                    self.tier = None
         up, upp, acting, actp = osdmap.pg_to_up_acting_osds(
             pg_t(self.pgid[0], self.pgid[1]))
         changed = (acting != self.acting or actp != self.acting_primary)
@@ -1157,6 +1176,9 @@ class PG:
             self.osd.send_op_reply(msg.src, MOSDOpReply(
                 tid=msg.tid, result=-95, epoch=self.osd.osdmap.epoch))
             return
+        if self.tier is not None and not self.tier.shutting_down and \
+                self.tier.intercept(msg):
+            return      # parked behind a promote; re-dispatched after
         if msg.ops:
             self._do_op_vector(msg)
         elif msg.op == CEPH_OSD_OP_WRITEFULL:

@@ -146,10 +146,16 @@ def crush_from_dict(d: Dict[str, Any]) -> CrushWrapper:
 _POOL_FIELDS = ("type", "size", "min_size", "crush_rule", "object_hash",
                 "pg_num", "pgp_num", "flags", "last_change",
                 "erasure_code_profile", "stripe_width")
+# tiering fields ride with defaults so old checkpoints keep decoding
+_POOL_TIER_FIELDS = ("tier_of", "read_tier", "write_tier", "cache_mode",
+                     "hit_set_period", "hit_set_count",
+                     "target_max_objects")
 
 
 def pool_to_dict(p: pg_pool_t) -> Dict[str, Any]:
     d = {k: getattr(p, k) for k in _POOL_FIELDS}
+    for k in _POOL_TIER_FIELDS:
+        d[k] = getattr(p, k)
     d["snap_seq"] = p.snap_seq
     d["snaps"] = {str(k): v for k, v in p.snaps.items()}
     d["removed_snaps"] = list(p.removed_snaps)
@@ -159,6 +165,9 @@ def pool_to_dict(p: pg_pool_t) -> Dict[str, Any]:
 
 def pool_from_dict(d: Dict[str, Any]) -> pg_pool_t:
     p = pg_pool_t(**{k: d[k] for k in _POOL_FIELDS})
+    for k in _POOL_TIER_FIELDS:
+        if k in d:
+            setattr(p, k, d[k])
     p.snap_seq = int(d.get("snap_seq", 0))
     p.snaps = {int(k): v for k, v in d.get("snaps", {}).items()}
     p.removed_snaps = [int(x) for x in d.get("removed_snaps", [])]

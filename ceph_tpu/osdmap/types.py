@@ -58,6 +58,16 @@ class pg_pool_t:
     snap_seq: int = 0
     snaps: Dict[int, str] = field(default_factory=dict)
     removed_snaps: List[int] = field(default_factory=list)
+    # cache tiering (pg_pool_t tier fields, osd_types.h): a BASE pool
+    # gains read_tier/write_tier redirects; the CACHE pool records
+    # tier_of + agent/hit-set knobs (HitSet.h; OSDMonitor "osd tier")
+    tier_of: int = -1            # base pool id (set on the cache pool)
+    read_tier: int = -1          # cache pool id (set on the base pool)
+    write_tier: int = -1
+    cache_mode: str = ""         # "writeback" (the implemented mode)
+    hit_set_period: float = 60.0
+    hit_set_count: int = 4
+    target_max_objects: int = 0  # 0 = no eviction pressure
     pg_num_mask: int = field(default=0, repr=False)
     pgp_num_mask: int = field(default=0, repr=False)
 

@@ -1,0 +1,228 @@
+"""Cache tiering: writeback overlay with HitSet-driven flush/evict.
+
+Mirrors the reference flow (PrimaryLogPG.cc hit_set_setup /
+promote_object / agent_work; HitSet.h bloom sets; Objecter
+read_tier/write_tier retargeting): clients talk to the base pool name,
+land on the cache pool, misses promote from the base, writes dirty the
+cache, the agent flushes cold dirty objects down and evicts cold clean
+ones — and reads are served by the tier.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.client import ObjectOperation
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.osdmap import pg_t
+
+
+def make():
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("base", k=2, m=1, plugin="isa", pg_num=4)
+    # one cache PG makes the eviction-pressure math deterministic
+    c.create_replicated_pool("hot", size=3, pg_num=1)
+    c.mon.add_cache_tier("base", "hot", hit_set_period=30.0,
+                         hit_set_count=2, target_max_objects=2)
+    c.publish()
+    return c, c.client("client.t")
+
+
+def cache_pgs(c):
+    pid = c.mon.osdmap.lookup_pg_pool_name("hot")
+    for osd in c.osds.values():
+        for pgid, pg in osd.pgs.items():
+            if pgid[0] == pid and pg.is_primary() and pg.tier:
+                yield pg
+
+
+def base_holds(c, oid):
+    pid = c.mon.osdmap.lookup_pg_pool_name("base")
+    for osd in c.osds.values():
+        for cid in osd.store.list_collections():
+            if cid.startswith(f"{pid}."):
+                if any(ho.oid == oid
+                       for ho in osd.store.list_objects(cid)):
+                    return True
+    return False
+
+
+def cache_holds(c, oid):
+    pid = c.mon.osdmap.lookup_pg_pool_name("hot")
+    for osd in c.osds.values():
+        for cid in osd.store.list_collections():
+            if cid.startswith(f"{pid}.") and not cid.endswith("_meta"):
+                if any(ho.oid == oid
+                       for ho in osd.store.list_objects(cid)):
+                    return True
+    return False
+
+
+def agent(c, now):
+    for pg in list(cache_pgs(c)):
+        pg.tier.agent_work(now)
+    c.network.pump()
+
+
+def test_writes_land_in_tier_and_flush_cold(c=None):
+    c, cl = make()
+    data = b"tiered!" * 1000
+    assert cl.write_full("base", "obj", data) == 0
+    # the write landed in the CACHE pool, dirty; base has nothing yet
+    assert cache_holds(c, "obj")
+    assert not base_holds(c, "obj")
+    assert cl.read("base", "obj") == data
+    # stays hot across agent passes inside the hit-set window
+    agent(c, now=10.0)
+    assert not base_holds(c, "obj")
+    # goes cold: two rotations push it out of every hit set -> flush
+    agent(c, now=50.0)
+    agent(c, now=100.0)
+    agent(c, now=150.0)
+    assert base_holds(c, "obj"), "cold dirty object never flushed"
+    assert cl.read("base", "obj") == data
+
+
+def test_promote_on_miss_serves_from_tier():
+    c, cl = make()
+    data = b"promote-me" * 500
+    assert cl.write_full("base", "obj", data) == 0
+    assert cl.setxattr("base", "obj", "tag", b"kept") == 0
+    # flush + evict it out of the cache entirely
+    for now in (50.0, 100.0, 150.0, 200.0):
+        agent(c, now)
+    # force eviction: it is clean + cold and the pool is over target
+    for i in range(3):
+        cl.write_full("base", f"filler{i}", b"x" * 100)
+    for now in (250.0, 300.0, 350.0):
+        agent(c, now)
+    assert base_holds(c, "obj")
+    assert not cache_holds(c, "obj"), "cold clean object never evicted"
+    # a read MISSES the cache -> promote from base -> served by tier
+    assert cl.read("base", "obj") == data
+    assert cache_holds(c, "obj"), "miss did not promote"
+    assert cl.getxattr("base", "obj", "tag") == b"kept"
+    # prove subsequent reads hit the TIER: destroy every base copy;
+    # the promoted cache copy still serves
+    pid = c.mon.osdmap.lookup_pg_pool_name("base")
+    from ceph_tpu.os_store import Transaction
+    for osd in c.osds.values():
+        for cid in list(osd.store.list_collections()):
+            if cid.startswith(f"{pid}."):
+                for ho in list(osd.store.list_objects(cid)):
+                    if ho.oid == "obj":
+                        t = Transaction()
+                        t.remove(cid, ho)
+                        osd.store.queue_transaction(t)
+    assert not base_holds(c, "obj")
+    assert cl.read("base", "obj") == data, "read did not hit the tier"
+
+
+def test_delete_writes_through_and_does_not_resurrect():
+    c, cl = make()
+    assert cl.write_full("base", "obj", b"gone-soon") == 0
+    for now in (50.0, 100.0, 150.0):
+        agent(c, now)
+    assert base_holds(c, "obj")
+    assert cl.remove("base", "obj") == 0
+    c.network.pump()
+    assert not base_holds(c, "obj"), "delete did not write through"
+    with pytest.raises(IOError):
+        cl.read("base", "obj")
+
+
+def test_dirty_markers_survive_restart():
+    c, cl = make()
+    assert cl.write_full("base", "obj", b"durable-dirt") == 0
+    pg = next(p for p in cache_pgs(c)
+              if "obj" in p.tier.dirty or True)
+    dirty_holders = [p for p in cache_pgs(c) if "obj" in p.tier.dirty]
+    assert dirty_holders, "write did not dirty the cache copy"
+    osd_id = dirty_holders[0].osd.osd_id
+    c.restart_osd(osd_id)
+    c.network.pump()
+    held = [p for p in cache_pgs(c) if "obj" in p.tier.dirty]
+    assert held, "dirty marker lost across restart"
+    # and the flush still happens after the restart
+    for now in (50.0, 100.0, 150.0):
+        agent(c, now)
+    assert base_holds(c, "obj")
+
+
+def test_miss_on_absent_object_returns_enoent_not_hang():
+    """A read through the tier for an object that exists NOWHERE must
+    answer ENOENT, not promote-loop forever."""
+    c, cl = make()
+    with pytest.raises(IOError):
+        cl.read("base", "never-written")
+    # and a creating partial write works (promote finds nothing, the
+    # op then creates the cache object)
+    assert cl.write("base", "fresh", b"abc", 0) == 0
+    assert cl.read("base", "fresh") == b"abc"
+
+
+def test_write_during_flush_is_not_lost():
+    """A write landing while its object's flush is in flight must stay
+    dirty and reach the base on the next agent pass."""
+    c, cl = make()
+    assert cl.write_full("base", "obj", b"old-bytes") == 0
+    pg = next(p for p in cache_pgs(c) if "obj" in p.tier.dirty)
+    # start the flush but DON'T pump: the WRITEFULL to the base and its
+    # reply are still in the network queue
+    pg.tier.hit_sets.rotate(50.0)
+    pg.tier.hit_sets.rotate(100.0)
+    pg.tier._flush("obj")
+    assert "obj" in pg.tier._flushing
+    # overlapping client write (re-dirties the object mid-flush)
+    assert cl.write_full("base", "obj", b"NEW-bytes") == 0
+    c.network.pump()            # flush reply arrives, must NOT clear
+    assert "obj" in pg.tier.dirty, "mid-flush write lost its marker"
+    for now in (150.0, 200.0, 250.0):
+        agent(c, now)
+    assert cl.read("base", "obj") == b"NEW-bytes"
+    # the BASE copy also converged on the new bytes
+    c.mon.remove_cache_tier("base")
+    c.publish()
+    for _ in range(6):
+        c.tick(dt=6.0)
+    assert cl.read("base", "obj") == b"NEW-bytes"
+
+
+def test_xattrs_promote_and_flush_through_tier():
+    c, cl = make()
+    assert cl.write_full("base", "obj", b"body") == 0
+    assert cl.setxattr("base", "obj", "k", b"v1") == 0
+    # flush, then evict so the next xattr read is a miss
+    for now in (50.0, 100.0, 150.0):
+        agent(c, now)
+    for i in range(3):
+        cl.write_full("base", f"fill{i}", b"x")
+    for now in (200.0, 250.0, 300.0):
+        agent(c, now)
+    assert not cache_holds(c, "obj")
+    # xattr read through the tier promotes (was ENOENT before)
+    assert cl.getxattr("base", "obj", "k") == b"v1"
+    assert cache_holds(c, "obj")
+    # xattr write dirties the cache copy so it re-flushes
+    assert cl.setxattr("base", "obj", "k", b"v2") == 0
+    assert any("obj" in p.tier.dirty for p in cache_pgs(c))
+
+
+def test_remove_cache_tier_drains_dirty_objects():
+    """Tearing the overlay down must not strand acked writes in the
+    cache pool: PGs drain their dirty objects to the base first."""
+    c, cl = make()
+    assert cl.write_full("base", "obj", b"must-survive") == 0
+    assert not base_holds(c, "obj")
+    c.mon.remove_cache_tier("base")
+    c.publish()
+    # agent ticks drain the dirty set regardless of temperature
+    for _ in range(6):
+        c.tick(dt=6.0)
+    c.network.pump()
+    assert base_holds(c, "obj"), "acked write stranded in the cache"
+    assert cl.read("base", "obj") == b"must-survive"
+    # the tier state dropped itself once drained
+    pid = c.mon.osdmap.lookup_pg_pool_name("hot")
+    for osd in c.osds.values():
+        for pgid, pg in osd.pgs.items():
+            if pgid[0] == pid:
+                assert pg.tier is None or pg.tier.dirty

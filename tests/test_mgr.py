@@ -17,6 +17,9 @@ def test_mgr_tracks_maps_and_reports_status():
     assert s["num_pools"] == 1
     assert s["num_pgs"] == 16
     assert s["num_up_osds"] == 6
+    # kill the daemon first: a LIVE osd administratively marked down
+    # boots itself right back in (MOSDBoot), as the reference does
+    c.kill_osd(3)
     c.mark_osd_down(3)
     s = c.mgr.status()
     assert s["num_up_osds"] == 5
