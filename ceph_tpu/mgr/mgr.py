@@ -73,6 +73,22 @@ class Manager(Dispatcher):
             self.network.pump()
         return n
 
+    def balancer_optimize_crush_compat(self, pool_id: int,
+                                       max_iterations: int = 30
+                                       ) -> "tuple[float, float]":
+        """crush-compat mode (balancer/module.py do_crush_compat):
+        optimize a per-position weight_set on the MON's map — the
+        choose_args ride the crush map, so the change publishes as a
+        topology epoch, no upmap entries involved."""
+        from ..osdmap.balancer import calc_weight_set
+        before, after = calc_weight_set(self.mon.osdmap, pool_id,
+                                        max_iterations=max_iterations)
+        if after < before:
+            self.mon._topology_dirty = True
+            self.mon.publish()
+            self.network.pump()
+        return before, after
+
     def tick(self) -> None:
         """Periodic module work (the mgr's serve loops)."""
         if self.balancer_active:

@@ -386,12 +386,6 @@ class OSD(Dispatcher):
             pg.maybe_realign()
             if pg.tier is not None and pg.is_primary():
                 pg.tier.agent_work(now)
-        # tier ops whose reply never came (base primary died, message
-        # lost): fail them so promotes/flushes unwind and retry
-        for tid, (cb, t0) in list(self._tier_ops.items()):
-            if now - t0 > RECOVERY_RETRY:
-                del self._tier_ops[tid]
-                cb(MOSDOpReply(tid=tid, result=-110))
             # stuck recoveries (reply chain lost to a map race or a
             # mid-flight death): forget and re-drive them
             stale = [oid for oid, t0 in pg._recovering_since.items()
@@ -403,6 +397,12 @@ class OSD(Dispatcher):
                               "stalled; re-kicking")
                     pg._recovering.discard(oid)
                     self.request_recovery(pg)
+        # tier ops whose reply never came (base primary died, message
+        # lost): fail them so promotes/flushes unwind and retry
+        for tid, (cb, t0) in list(self._tier_ops.items()):
+            if now - t0 > RECOVERY_RETRY:
+                del self._tier_ops[tid]
+                cb(MOSDOpReply(tid=tid, result=-110))
         for peer in peers:
             last = self.last_ping_reply.get(peer, now)
             self.last_ping_reply.setdefault(peer, now)
@@ -467,9 +467,12 @@ class OSD(Dispatcher):
             *_, _acting, primary = self.osdmap.pg_to_up_acting_osds(
                 pg_t(pool_id, ps))
         if pool is None or primary < 0:
-            # fail asynchronously so callers' state machines unwind the
-            # same way they do for a timeout
-            on_reply(MOSDOpReply(tid=0, result=-110))
+            # park the failure for the next tick sweep: failing INLINE
+            # would recurse promote -> tier_submit -> promote with no
+            # base case while the target stays unreachable
+            self._tier_tid += 1
+            self._tier_ops[self._tier_tid] = (
+                on_reply, self.now - RECOVERY_RETRY - 1.0)
             return
         self._tier_tid += 1
         tid = self._tier_tid

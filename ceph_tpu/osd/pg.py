@@ -1176,8 +1176,7 @@ class PG:
             self.osd.send_op_reply(msg.src, MOSDOpReply(
                 tid=msg.tid, result=-95, epoch=self.osd.osdmap.epoch))
             return
-        if self.tier is not None and not self.tier.shutting_down and \
-                self.tier.intercept(msg):
+        if self.tier is not None and self.tier.intercept(msg):
             return      # parked behind a promote; re-dispatched after
         if msg.ops:
             self._do_op_vector(msg)

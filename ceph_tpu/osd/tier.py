@@ -138,6 +138,11 @@ class TierState:
             self._mark_dirty(oid, False)
         elif mutates:
             self._mark_dirty(oid, True)
+        if self.shutting_down:
+            # draining: keep tracking mutations from stale-map clients
+            # (or the drain would strand their acked writes), but no
+            # new promotes — the op executes directly
+            return False
         if oid in self._promoting:
             self._promoting[oid].append(lambda: pg.do_op(msg))
             return True

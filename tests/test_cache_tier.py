@@ -132,8 +132,6 @@ def test_delete_writes_through_and_does_not_resurrect():
 def test_dirty_markers_survive_restart():
     c, cl = make()
     assert cl.write_full("base", "obj", b"durable-dirt") == 0
-    pg = next(p for p in cache_pgs(c)
-              if "obj" in p.tier.dirty or True)
     dirty_holders = [p for p in cache_pgs(c) if "obj" in p.tier.dirty]
     assert dirty_holders, "write did not dirty the cache copy"
     osd_id = dirty_holders[0].osd.osd_id

@@ -1,0 +1,126 @@
+"""crush-compat balancer mode: per-position weight_set optimization.
+
+The reference balancer's second mode (pybind/mgr/balancer/module.py
+do_crush_compat) flattens PG distribution by optimizing the crush map's
+choose_args weight_set (crush.h:273) instead of emitting pg_upmap
+entries — for clients too old to decode upmaps.  These tests require:
+stddev improves on a skewed map with ZERO upmap entries, and the device
+mappers evaluate the optimized weight_set bit-exactly.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import CrushWrapper, CRUSH_BUCKET_STRAW2
+from ceph_tpu.osdmap import OSDMap, pg_t
+from ceph_tpu.osdmap.balancer import calc_weight_set
+from ceph_tpu.osdmap.types import pg_pool_t, TYPE_REPLICATED
+
+
+def skewed_map(n_hosts=6, per_host=4, pg_num=256):
+    m = OSDMap()
+    cw = m.crush
+    cw.set_type_name(1, "host")
+    cw.set_type_name(10, "root")
+    rng = np.random.default_rng(17)
+    hosts, osd = [], 0
+    for h in range(n_hosts):
+        osds = list(range(osd, osd + per_host))
+        osd += per_host
+        # skew: identical CLAIMED weights but real clusters never land
+        # perfectly — compat mode corrects the hash noise
+        ws = [0x10000] * per_host
+        hosts.append(cw.add_bucket(CRUSH_BUCKET_STRAW2, 1, f"h{h}",
+                                   osds, ws, id=-(h + 2)))
+    m.set_max_osd(osd)
+    cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default", hosts,
+                  [0x10000 * per_host] * n_hosts, id=-1)
+    for i in range(osd):
+        m.set_osd(i, up=True)
+    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    pool = pg_pool_t(type=TYPE_REPLICATED, size=3, min_size=2,
+                     crush_rule=rno, pg_num=pg_num, pgp_num=pg_num)
+    pid = m.add_pool("p", pool)
+    m.epoch = 1
+    return m, pid, rno
+
+
+def per_osd_stddev(m, pid):
+    pool = m.pools[pid]
+    counts = {}
+    for ps in range(pool.pg_num):
+        up, _ = m.pg_to_raw_up(pg_t(pid, ps))
+        for o in up:
+            if o != 0x7FFFFFFF:
+                counts[o] = counts.get(o, 0) + 1
+    vals = [counts.get(o, 0) for o in range(m.max_osd)]
+    return float(np.std(vals))
+
+
+def test_weight_set_flattens_distribution_without_upmaps():
+    m, pid, _ = skewed_map()
+    before = per_osd_stddev(m, pid)
+    b2, after = calc_weight_set(m, pid)
+    assert b2 == pytest.approx(before)
+    assert after < before, (before, after)
+    assert per_osd_stddev(m, pid) == pytest.approx(after)
+    # the whole point of compat mode: zero upmap entries
+    assert not m.pg_upmap and not m.pg_upmap_items
+    # the optimized args are per-position (one weight list per replica
+    # slot, crush_choose_arg's weight_set shape)
+    args = m.crush.crush.choose_args[pid]
+    ws = next(a.weight_set for a in args if a.weight_set)
+    assert len(ws) == m.pools[pid].size
+
+
+def test_device_mappers_evaluate_weight_set_bit_exactly():
+    """The optimized choose_args must map identically on the device
+    (loop kernel) and the host interpreter."""
+    from ceph_tpu.ops.crush_kernels import DeviceCrushMapper, compile_map
+    m, pid, rno = skewed_map(n_hosts=5, per_host=3, pg_num=128)
+    calc_weight_set(m, pid, max_iterations=10)
+    args = m.crush.crush.choose_args[pid]
+    cw = m.crush
+    comp = compile_map(cw.crush, args)
+    dev = DeviceCrushMapper(comp, rno, 3)
+    xs = np.arange(400, dtype=np.uint32)
+    weight = [0x10000] * m.max_osd
+    res, cnt = dev.map_batch(xs, weight)
+    for x in range(400):
+        expect = cw.do_rule(rno, int(x), 3, weight,
+                            choose_args_index=pid)
+        assert list(res[x, :cnt[x]]) == expect, x
+
+
+def test_batch_mapping_uses_weight_set():
+    """OSDMapMapping's whole-map batch path must agree with the scalar
+    pipeline once choose_args are installed."""
+    from ceph_tpu.osdmap.mapping import OSDMapMapping
+    m, pid, _ = skewed_map(n_hosts=4, per_host=3, pg_num=64)
+    calc_weight_set(m, pid, max_iterations=8)
+    mapping = OSDMapMapping()
+    mapping.update(m)
+    for ps in range(64):
+        up, upp, acting, actp = m.pg_to_up_acting_osds(pg_t(pid, ps))
+        bup, bprim = mapping.get(pg_t(pid, ps))[:2], None
+        got_up, got_upp, got_acting, got_actp = mapping.get(pg_t(pid, ps))
+        assert got_up == up and got_acting == acting
+        assert got_upp == upp and got_actp == actp
+
+
+def test_mgr_crush_compat_mode_publishes():
+    """End-to-end through the mgr: the optimized weight_set rides a
+    topology epoch to every subscriber; no upmaps appear."""
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=9, osds_per_host=3)
+    c.create_replicated_pool("p", size=3, pg_num=128)
+    pid = c.mon.osdmap.lookup_pg_pool_name("p")
+    before, after = c.mgr.balancer_optimize_crush_compat(pid)
+    assert after <= before
+    assert not c.mon.osdmap.pg_upmap_items
+    if after < before:
+        # published: OSDs' maps carry the same choose_args
+        osd = next(iter(c.osds.values()))
+        assert pid in osd.osdmap.crush.crush.choose_args
+    cl = c.client("client.b")
+    assert cl.write_full("p", "o", b"balanced") == 0
+    assert cl.read("p", "o") == b"balanced"
