@@ -293,6 +293,7 @@ class MiniCluster:
         object store — in-memory state (pg logs, inflight ops) must come
         back from disk (OSD::init, OSD.cc:2469+)."""
         old = self.osds[osd_id]
+        old.shutdown()
         self.network.set_down(old.name, False)
         osd = OSD(self.network, osd_id, store=old.store,
                   mon_name=old.mon_name, mon_names=old.mon_names)

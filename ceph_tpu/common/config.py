@@ -96,6 +96,10 @@ def build_options() -> List[Option]:
                          "of a PG (reference osd_scrub_min_interval)"),
         Option("osd_scrub_auto", OPT_BOOL).set_default(True)
         .set_description("schedule background scrubs from the OSD tick"),
+        Option("osd_op_num_threads", OPT_INT).set_default(0)
+        .set_description("worker threads draining the sharded op queue "
+                         "(reference osd_op_num_threads_per_shard x "
+                         "shards; 0 = drain synchronously)"),
         Option("tracing_kernels", OPT_BOOL).set_default(False)
         .set_description("time every device kernel dispatch (adds a "
                          "sync per call; diagnosis only)"),

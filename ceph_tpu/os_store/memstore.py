@@ -105,8 +105,10 @@ _VERSION = 1
 
 class MemStore:
     def __init__(self):
+        import threading
         self.colls: Dict[str, Dict[hobject_t, _Object]] = {}
         self.committed_txns = 0
+        self._write_lock = threading.RLock()
 
     # ---- lifecycle / durability -------------------------------------------
     def mount(self) -> None:
@@ -200,15 +202,27 @@ class MemStore:
 
     # ---- transactions -----------------------------------------------------
     def queue_transaction(self, t: Transaction) -> None:
-        """Apply atomically; invalid ops raise before any mutation."""
-        staged = {cid: {o: self._clone(obj) for o, obj in coll.items()}
-                  for cid, coll in self.colls.items()}
-        try:
+        """Apply atomically; invalid ops raise before any mutation.
+
+        Thread-safe for writers (the threaded op queue commits from
+        worker threads; the reference ObjectStore is too): the whole
+        stage-and-swap runs under a mutex, while readers see either the
+        old or the new dict via the atomic reference swap."""
+        with self._write_lock:
+            # stage (deep-clone) only the collections this transaction
+            # touches; untouched ones share by reference — the swap
+            # below is still one atomic rebind for readers, and the
+            # critical section stops scaling with the WHOLE store
+            touched = {op[1] for op in t.ops if len(op) > 1}
+            staged = dict(self.colls)
+            for cid in touched:
+                coll = self.colls.get(cid)
+                if coll is not None:
+                    staged[cid] = {o: self._clone(obj)
+                                   for o, obj in coll.items()}
             self._apply(staged, t)
-        except Exception:
-            raise
-        self.colls = staged
-        self.committed_txns += 1
+            self.colls = staged
+            self.committed_txns += 1
 
     @staticmethod
     def _clone(obj: _Object) -> _Object:

@@ -99,12 +99,35 @@ class OSD(Dispatcher):
         self._tracked: Dict[Tuple[str, int], object] = {}
         self._recovery_queue: List[PG] = []
         self.op_wq = ShardedOpWQ()
+        # threaded drain (osd_op_tp, OSD.cc:2008): workers take the
+        # target PG's lock around each op, like dequeue_op does — real
+        # concurrency across shards, lockdep live on the hot path
+        from ..common.config import g_conf
+        self.op_tp = None
+        n_threads = int(g_conf.get_val("osd_op_num_threads") or 0)
+        if n_threads > 0:
+            from ..common.work_queue import ShardedThreadPool
+            self.op_tp = ShardedThreadPool(self.op_wq,
+                                           self._wq_handle_locked,
+                                           n_threads)
         self._rep_pulls: Dict[int, Callable] = {}
         self._pull_tid = 0
         # tier ops this OSD issued as a client of the base pool
-        # (promote reads / flush writes): tid -> reply callback
+        # (promote reads / flush writes): tid -> reply callback.
+        # Allocated/consumed from worker threads holding only a PG
+        # lock, so OSD-level state needs its own mutex
+        import threading
         self._tier_ops: Dict[int, Callable] = {}
         self._tier_tid = 1 << 40     # clear of client tid spaces
+        self._tier_lock = threading.Lock()
+
+    def shutdown(self) -> None:
+        """Stop background machinery (the threaded op pool's workers
+        would otherwise outlive a restarted/replaced daemon and keep
+        polling — or executing stale ops against — its old store)."""
+        if self.op_tp is not None:
+            self.op_tp.stop()
+            self.op_tp = None
 
     # legacy-style dict view used by tests / admin socket
     @property
@@ -131,7 +154,8 @@ class OSD(Dispatcher):
             self._handle_osd_map(msg)
         elif isinstance(msg, MOSDOpReply):
             # replies to this OSD's own tier ops (promote/flush)
-            ent = self._tier_ops.pop(msg.tid, None)
+            with self._tier_lock:
+                ent = self._tier_ops.pop(msg.tid, None)
             if ent is not None:
                 ent[0](msg)
         elif isinstance(msg, MOSDOp):
@@ -257,7 +281,26 @@ class OSD(Dispatcher):
         self.drain_ops()
 
     def drain_ops(self, max_ops: int = 0) -> int:
+        if self.op_tp is not None:
+            # workers drain concurrently; block until handled so the
+            # in-process fabric's pump loops keep their semantics
+            self.op_tp.flush()
+            return 0
         return self.op_wq.drain(self._wq_handle, max_ops)
+
+    def _wq_handle_locked(self, item) -> None:
+        """Thread-pool handler: serialize per PG via its DebugLock (the
+        reference's pg->lock() in dequeue_op, OSD.cc:9262)."""
+        kind = item[0]
+        if kind == "op":
+            pg = self.pgs.get(item[1].pgid)
+        else:
+            pg = item[1]
+        if pg is not None:
+            with pg.op_lock:
+                self._wq_handle(item)
+        else:
+            self._wq_handle(item)
 
     def _wq_handle(self, item) -> None:
         kind = item[0]
@@ -399,10 +442,13 @@ class OSD(Dispatcher):
                     self.request_recovery(pg)
         # tier ops whose reply never came (base primary died, message
         # lost): fail them so promotes/flushes unwind and retry
-        for tid, (cb, t0) in list(self._tier_ops.items()):
-            if now - t0 > RECOVERY_RETRY:
+        with self._tier_lock:
+            expired = [(tid, ent) for tid, ent in self._tier_ops.items()
+                       if now - ent[1] > RECOVERY_RETRY]
+            for tid, _ent in expired:
                 del self._tier_ops[tid]
-                cb(MOSDOpReply(tid=tid, result=-110))
+        for tid, (cb, _t0) in expired:
+            cb(MOSDOpReply(tid=tid, result=-110))
         for peer in peers:
             last = self.last_ping_reply.get(peer, now)
             self.last_ping_reply.setdefault(peer, now)
@@ -470,13 +516,15 @@ class OSD(Dispatcher):
             # park the failure for the next tick sweep: failing INLINE
             # would recurse promote -> tier_submit -> promote with no
             # base case while the target stays unreachable
-            self._tier_tid += 1
-            self._tier_ops[self._tier_tid] = (
-                on_reply, self.now - RECOVERY_RETRY - 1.0)
+            with self._tier_lock:
+                self._tier_tid += 1
+                self._tier_ops[self._tier_tid] = (
+                    on_reply, self.now - RECOVERY_RETRY - 1.0)
             return
-        self._tier_tid += 1
-        tid = self._tier_tid
-        self._tier_ops[tid] = (on_reply, self.now)
+        with self._tier_lock:
+            self._tier_tid += 1
+            tid = self._tier_tid
+            self._tier_ops[tid] = (on_reply, self.now)
         self.messenger.send_message(
             MOSDOp(tid=tid, pool=pool_id, oid=oid, pgid=(pool_id, ps),
                    epoch=self.osdmap.epoch, ops=list(ops)),

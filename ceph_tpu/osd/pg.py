@@ -178,6 +178,10 @@ class PG:
             self.backend = ECBackend(self, ec_impl, pool.stripe_width)
         else:
             self.rep_backend = ReplicatedBackend(self)
+        # per-PG op lock (PG::lock; taken by threaded dequeue_op and
+        # visible to lockdep)
+        from ..common.lockdep import DebugLock
+        self.op_lock = DebugLock(f"pg-{pgid[0]}.{pgid[1]}")
         # cache-tier machinery (replicated cache pools only)
         self.tier = None
         if pool.tier_of >= 0 and pool.cache_mode and \

@@ -138,11 +138,12 @@ class TierState:
             self._mark_dirty(oid, False)
         elif mutates:
             self._mark_dirty(oid, True)
-        if self.shutting_down:
-            # draining: keep tracking mutations from stale-map clients
-            # (or the drain would strand their acked writes), but no
-            # new promotes — the op executes directly
-            return False
+        # NOTE: intercept stays FULLY active while a removed tier
+        # drains (shutting_down): skipping the promote for a needs-body
+        # mutation would execute it against a missing cache copy and
+        # the drain would then flush that partial body over the intact
+        # base object — the promote path is still safe (the base pool
+        # is still there to read from).
         if oid in self._promoting:
             self._promoting[oid].append(lambda: pg.do_op(msg))
             return True

@@ -1,0 +1,137 @@
+"""ShardedThreadPool: real worker threads draining the sharded op queue.
+
+The reference drains its sharded op queue with a ShardedThreadPool
+(common/WorkQueue.h:618; OSD.cc:2008 osd_op_tp) and serializes per-PG
+via pg->lock() in dequeue_op.  These tests require: genuine concurrency
+(two workers demonstrably inside handlers at once), per-shard FIFO
+survival, a deliberate lock-order inversion DETECTED by lockdep under
+real threads, and a MiniCluster running green with threads on.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.common.lockdep import (
+    DebugLock, LockOrderError, lockdep_enable, lockdep_reset,
+)
+from ceph_tpu.common.work_queue import (
+    CLASS_CLIENT, ShardedOpWQ, ShardedThreadPool,
+)
+
+
+def test_pool_runs_handlers_concurrently_and_keeps_shard_fifo():
+    wq = ShardedOpWQ(n_shards=4)
+    seen = {}
+    peak = [0]
+    active = [0]
+    gate = threading.Lock()
+
+    def handler(item):
+        pgid, seq = item
+        with gate:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        time.sleep(0.002)       # give workers a window to overlap
+        with gate:
+            seen.setdefault(pgid, []).append(seq)
+            active[0] -= 1
+
+    pool = ShardedThreadPool(wq, handler, n_threads=3)
+    try:
+        pgids = [(0, i) for i in range(8)]
+        for seq in range(30):
+            for pgid in pgids:
+                wq.enqueue(pgid, CLASS_CLIENT, (pgid, seq))
+        pool.flush()
+    finally:
+        pool.stop()
+    # every op handled, per-PG order preserved (same shard => FIFO)
+    for pgid in pgids:
+        assert seen[pgid] == list(range(30)), pgid
+    assert peak[0] >= 2, "workers never actually overlapped"
+
+
+def test_lockdep_catches_inversion_under_real_threads():
+    """Two workers take (A then B) and (B then A): lockdep must flag
+    the cycle from a real thread, not a simulated drain."""
+    lockdep_reset()
+    lockdep_enable(True)
+    try:
+        A, B = DebugLock("inv-A"), DebugLock("inv-B")
+        wq = ShardedOpWQ(n_shards=2)
+        sync = threading.Barrier(2, timeout=5.0)
+        errors = []
+
+        def handler(item):
+            first, second = item
+            try:
+                with first:
+                    sync.wait()     # both workers hold their first lock
+                    time.sleep(0.01)
+                    with second:
+                        pass
+            except LockOrderError as e:
+                errors.append(e)
+            except threading.BrokenBarrierError:
+                pass
+
+        pool = ShardedThreadPool(wq, handler, n_threads=2)
+        try:
+            wq.enqueue((0, 0), CLASS_CLIENT, (A, B))   # shard 0
+            wq.enqueue((0, 1), CLASS_CLIENT, (B, A))   # shard 1
+            deadline = time.monotonic() + 10
+            while not errors and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            pool.stop()
+        assert errors, "lock-order inversion went undetected"
+        assert "inv-" in str(errors[0])
+    finally:
+        lockdep_enable(False)
+        lockdep_reset()
+
+
+@pytest.fixture
+def threaded_conf():
+    g_conf.set_val("osd_op_num_threads", 3)
+    lockdep_reset()
+    lockdep_enable(True)
+    yield
+    lockdep_enable(False)
+    lockdep_reset()
+    g_conf.set_val("osd_op_num_threads", 0)
+
+
+def test_cluster_green_with_threads_on(threaded_conf):
+    """EC write/read/degraded-read/recovery with every OSD draining its
+    op queue from a real thread pool, lockdep armed."""
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=6)
+    assert all(o.op_tp is not None for o in c.osds.values())
+    c.create_ec_pool("p", k=2, m=1, plugin="isa", pg_num=8)
+    cl = c.client("client.th")
+    rng = np.random.default_rng(8)
+    blobs = {}
+    for i in range(12):
+        data = rng.integers(0, 256, 4000 + i * 37,
+                            dtype=np.uint8).tobytes()
+        blobs[f"o{i}"] = data
+        assert cl.write_full("p", f"o{i}", data) == 0
+    for oid, data in blobs.items():
+        assert cl.read("p", oid) == data
+    # kill + detect + recover, all with threaded drains
+    pgid, primary = cl._calc_target(cl.lookup_pool("p"), "o0")
+    victim = next(o for o in range(6) if o != primary)
+    c.kill_osd(victim)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    c.run_recovery()
+    c.network.pump()
+    for oid, data in blobs.items():
+        assert cl.read("p", oid) == data
+    assert cl.write_full("p", "after", b"threads-on") == 0
+    assert cl.read("p", "after") == b"threads-on"
+    c.scrub()
