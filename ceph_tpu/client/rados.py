@@ -19,7 +19,8 @@ from ..msg import (
 )
 from ..msg.messages import (
     CEPH_OSD_CMPXATTR_OP_EQ, CEPH_OSD_OP_ASSERT_VER,
-    CEPH_OSD_OP_CMPXATTR, CEPH_OSD_OP_CREATE,
+    CEPH_OSD_OP_CALL, CEPH_OSD_OP_CMPXATTR, CEPH_OSD_OP_COPY_FROM,
+    CEPH_OSD_OP_CREATE,
     CEPH_OSD_OP_FLAG_EXCL, CEPH_OSD_OP_GETXATTR, CEPH_OSD_OP_GETXATTRS,
     CEPH_OSD_OP_OMAPGETVALS, CEPH_OSD_OP_OMAPRMKEYS,
     CEPH_OSD_OP_OMAPSETKEYS, CEPH_OSD_OP_RMXATTR, CEPH_OSD_OP_SETXATTR,
@@ -123,6 +124,23 @@ class ObjectOperation:
         """Abort the vector with -ERANGE unless the object's version
         still equals *version* (rados assert_version guard)."""
         self.ops.append(OSDOp(op=CEPH_OSD_OP_ASSERT_VER, offset=version))
+        return self
+
+    def call(self, cls: str, method: str,
+             inp: bytes = b"") -> "ObjectOperation":
+        """Invoke an object-class method on the OSD
+        (ObjectOperation::exec / rados_exec; src/cls)."""
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_CALL,
+                              name=f"{cls}.{method}", data=bytes(inp)))
+        return self
+
+    def copy_from(self, src_oid: str,
+                  src_pool: int = -1) -> "ObjectOperation":
+        """Replace this object with a server-side copy of *src_oid*
+        (ObjectWriteOperation::copy_from; -1 = same pool — pool ids
+        start at 0, so 0 is a real pool)."""
+        self.ops.append(OSDOp(op=CEPH_OSD_OP_COPY_FROM, name=src_oid,
+                              offset=src_pool))
         return self
 
     def cmp_xattr(self, name: str, value: bytes,
@@ -383,6 +401,25 @@ class RadosClient(Dispatcher):
         if r.result < 0:
             raise _ioerror("stat", oid, r.result)
         return struct.unpack("<Q", r.data)[0]
+
+    def exec(self, pool: str, oid: str, cls: str, method: str,
+             inp: bytes = b"") -> "tuple[int, bytes]":
+        """Run an object-class method (rados_exec): returns
+        (method ret, output bytes)."""
+        r, res = self.operate(pool, oid,
+                              ObjectOperation().call(cls, method, inp))
+        if r < 0:
+            return r, b""
+        return res[0][0], res[0][1]
+
+    def copy(self, pool: str, dst: str, src: str,
+             src_pool: Optional[str] = None) -> int:
+        """Server-side copy (rados_copy role): dst <= src."""
+        spid = self.lookup_pool(src_pool) if src_pool \
+            else self.lookup_pool(pool)
+        r, _ = self.operate(pool, dst,
+                            ObjectOperation().copy_from(src, spid))
+        return r
 
     def get_version(self, pool: str, oid: str) -> int:
         """Current object version (the stat reply's user_version) —

@@ -48,6 +48,11 @@ CEPH_OSD_OP_CMPXATTR = "cmpxattr"    # guard; flags = comparison operator
 CEPH_OSD_OP_OMAPSETKEYS = "omap_setkeys"   # replicated pools only
 CEPH_OSD_OP_OMAPRMKEYS = "omap_rmkeys"
 CEPH_OSD_OP_OMAPGETVALS = "omap_getvals"
+CEPH_OSD_OP_CALL = "call"            # object-class method (src/cls);
+                                     # name = "cls.method", data = input
+CEPH_OSD_OP_COPY_FROM = "copy_from"  # copy another object into this one
+                                     # (PrimaryLogPG do_copy_from);
+                                     # name = src oid, offset = src pool
 CEPH_OSD_OP_ASSERT_VER = "assert_ver"  # guard: object version == offset
                                      # (mismatch -> -ERANGE, like
                                      # PrimaryLogPG.cc do_osd_ops

@@ -39,8 +39,9 @@ from ..msg.messages import (
     CEPH_OSD_CMPXATTR_OP_EQ, CEPH_OSD_CMPXATTR_OP_GT,
     CEPH_OSD_CMPXATTR_OP_GTE, CEPH_OSD_CMPXATTR_OP_LT,
     CEPH_OSD_CMPXATTR_OP_LTE, CEPH_OSD_CMPXATTR_OP_NE,
-    CEPH_OSD_OP_ASSERT_VER,
-    CEPH_OSD_OP_CMPXATTR, CEPH_OSD_OP_CREATE, CEPH_OSD_OP_FLAG_EXCL,
+    CEPH_OSD_OP_ASSERT_VER, CEPH_OSD_OP_CALL,
+    CEPH_OSD_OP_CMPXATTR, CEPH_OSD_OP_COPY_FROM, CEPH_OSD_OP_CREATE,
+    CEPH_OSD_OP_FLAG_EXCL,
     CEPH_OSD_OP_GETXATTR, CEPH_OSD_OP_GETXATTRS, CEPH_OSD_OP_OMAPGETVALS,
     CEPH_OSD_OP_OMAPRMKEYS, CEPH_OSD_OP_OMAPSETKEYS, CEPH_OSD_OP_RMXATTR,
     CEPH_OSD_OP_SETXATTR, CEPH_OSD_OP_TRUNCATE, CEPH_OSD_OP_ZERO, OSDOp,
@@ -1148,7 +1149,7 @@ class PG:
         # mutations need at least min_size live acting members, or a
         # single further failure could lose acked data — clients retry
         # until recovery/remap restores enough copies
-        is_write = (any(o.op not in self._READONLY_OPS for o in msg.ops)
+        is_write = (any(self._op_mutates(o) for o in msg.ops)
                     if msg.ops else
                     msg.op in (CEPH_OSD_OP_WRITE, CEPH_OSD_OP_WRITEFULL,
                                CEPH_OSD_OP_APPEND, CEPH_OSD_OP_DELETE))
@@ -1182,6 +1183,18 @@ class PG:
             return
         if self.tier is not None and self.tier.intercept(msg):
             return      # parked behind a promote; re-dispatched after
+        if msg.ops and any(o.op == CEPH_OSD_OP_COPY_FROM
+                           for o in msg.ops):
+            # async source fetch: cannot run inside the synchronous
+            # vector interpreter (PrimaryLogPG starts a CopyOp the
+            # same way, do_copy_from)
+            if len(msg.ops) != 1:
+                self.osd.send_op_reply(msg.src, MOSDOpReply(
+                    tid=msg.tid, result=-95,
+                    epoch=self.osd.osdmap.epoch))
+                return
+            self.with_clone(msg.oid, lambda: self._do_copy_from(msg))
+            return
         if msg.ops:
             self._do_op_vector(msg)
         elif msg.op == CEPH_OSD_OP_WRITEFULL:
@@ -1474,6 +1487,7 @@ class PG:
         CEPH_OSD_OP_READ, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_APPEND,
         CEPH_OSD_OP_TRUNCATE, CEPH_OSD_OP_ZERO, CEPH_OSD_OP_STAT,
         CEPH_OSD_OP_WRITEFULL,
+        CEPH_OSD_OP_CALL,       # class methods may read/write the body
     ])
 
     _READONLY_OPS = frozenset([
@@ -1481,6 +1495,19 @@ class PG:
         CEPH_OSD_OP_GETXATTRS, CEPH_OSD_OP_OMAPGETVALS,
         CEPH_OSD_OP_CMPXATTR, CEPH_OSD_OP_ASSERT_VER,
     ])
+
+    def _op_mutates(self, o: OSDOp) -> bool:
+        """Write-ness of one vector op; class calls consult their
+        registered RD/WR flags (the reference's cls method flags) so a
+        pure-read exec is not gated or cloned like a write."""
+        if o.op in self._READONLY_OPS:
+            return False
+        if o.op == CEPH_OSD_OP_CALL:
+            from .cls import CLS_METHOD_WR, lookup
+            cls_name, _, method = o.name.partition(".")
+            ent = lookup(cls_name, method)
+            return bool(ent and (ent[1] & CLS_METHOD_WR))
+        return True
 
     def _stored_user_version(self, oid: str) -> int:
         """Current pg_log version stamped on the object's VERSION_ATTR
@@ -1517,7 +1544,7 @@ class PG:
         oid = msg.oid
         if msg.snapid:
             # snap-targeted vectors are read-only views of the clone
-            if any(o.op not in self._READONLY_OPS for o in msg.ops):
+            if any(self._op_mutates(o) for o in msg.ops):
                 self.osd.send_op_reply(msg.src, MOSDOpReply(
                     tid=msg.tid, result=-30,     # EROFS
                     epoch=self.osd.osdmap.epoch))
@@ -1547,8 +1574,7 @@ class PG:
                 self._commit_rep_vector(msg.oid, spec)
 
         def gated() -> None:
-            mutates = any(o.op not in self._READONLY_OPS
-                          for o in msg.ops)
+            mutates = any(self._op_mutates(o) for o in msg.ops)
             if mutates:
                 self.with_clone(oid, start)
             else:
@@ -1744,6 +1770,23 @@ class PG:
             # vector with ERANGE (PrimaryLogPG.cc do_osd_ops)
             return (0, b"") if op.offset == st["cur_version"] \
                 else (-34, b"")
+        if o == CEPH_OSD_OP_CALL:
+            # object-class method (do_osd_ops CEPH_OSD_OP_CALL ->
+            # ClassHandler): runs against the staged state so its
+            # mutations commit with the rest of the vector
+            from .cls import ClsContext, ClsError, lookup
+            cls_name, _, method = op.name.partition(".")
+            ent = lookup(cls_name, method)
+            if ent is None:
+                return -95, b""             # EOPNOTSUPP: no such method
+            fn, _flags = ent
+            try:
+                ret, out = fn(ClsContext(st), bytes(op.data))
+            except ClsError as e:
+                return e.ret, b""
+            except Exception:
+                return -22, b""
+            return ret, out
         if o in (CEPH_OSD_OP_OMAPSETKEYS, CEPH_OSD_OP_OMAPRMKEYS,
                  CEPH_OSD_OP_OMAPGETVALS):
             if self.backend is not None:
@@ -1933,6 +1976,64 @@ class PG:
             tid=msg.tid, result=0, data=struct.pack("<Q", size),
             epoch=self.osd.osdmap.epoch,
             version=self._stored_user_version(msg.oid)))
+
+    def _do_copy_from(self, msg: MOSDOp) -> None:
+        """Server-side object copy (PrimaryLogPG do_copy_from /
+        process_copy_chunk): the primary fetches the SOURCE — possibly
+        from another pool — through its own client path, then commits
+        the bytes + user attrs locally as one full write."""
+        from ..msg.messages import (
+            CEPH_OSD_OP_GETXATTRS as _GX, CEPH_OSD_OP_OMAPGETVALS as _OG,
+            CEPH_OSD_OP_READ as _RD,
+        )
+        op = msg.ops[0]
+        src_oid = op.name
+        # pool ids start at 0: -1 is the same-pool sentinel
+        src_pool = op.offset if op.offset >= 0 else msg.pool
+        src = msg.src
+        # omap rides along only when the SOURCE pool can hold it (an
+        # OMAPGETVALS in the fetch vector would abort on an EC source)
+        spool = self.osd.osdmap.get_pg_pool(src_pool)
+        fetch = [OSDOp(op=_RD), OSDOp(op=_GX)]
+        src_has_omap = spool is not None and not spool.is_erasure()
+        if src_has_omap:
+            fetch.append(OSDOp(op=_OG))
+
+        def on_fetch(reply) -> None:
+            if reply.result != 0 or not reply.op_results:
+                self.osd.send_op_reply(src, MOSDOpReply(
+                    tid=msg.tid, result=reply.result or -5,
+                    epoch=self.osd.osdmap.epoch))
+                return
+            data = reply.op_results[0][1]
+            attrs = {}
+            if len(reply.op_results) > 1 and reply.op_results[1][0] >= 0:
+                attrs = unpack_kv(reply.op_results[1][1])
+            omap = {}
+            if src_has_omap and len(reply.op_results) > 2 and \
+                    reply.op_results[2][0] >= 0:
+                omap = unpack_kv(reply.op_results[2][1])
+
+            def on_commit(result: int) -> None:
+                if result == 0:
+                    self.clear_missing_for(msg.oid)
+                self.osd.send_op_reply(src, MOSDOpReply(
+                    tid=msg.tid, result=result,
+                    epoch=self.osd.osdmap.epoch))
+
+            if self.backend is not None:
+                # EC destinations cannot hold omap; body + attrs copy
+                self.backend.submit_transaction(msg.oid, data, on_commit,
+                                                xattrs=attrs)
+            else:
+                # full replacement INCLUDING omap (reference copy-from
+                # replaces the whole object; {} clears stale dst keys)
+                self.rep_backend.write(msg.oid, data, full=True,
+                                       version=self.next_version(),
+                                       xattrs=attrs, omap=omap)
+                on_commit(0)
+
+        self.osd.tier_submit(src_pool, src_oid, fetch, on_fetch)
 
     def _do_delete(self, msg: MOSDOp) -> None:
         self._fan_delete(msg.oid)

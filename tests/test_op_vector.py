@@ -291,3 +291,63 @@ def test_stat_at_snap_resolves_clone(fixture, request):
     cl.write_full(pool, "ss3", b"later")
     with pytest.raises(IOError):
         cl.stat(pool, "ss3", snap="ssnap2")
+
+
+# ---- object classes (src/cls; do_osd_ops CEPH_OSD_OP_CALL) ----------------
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_cls_hello_and_numops(fixture, request):
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    ret, out = cl.exec(pool, "greet", "hello", "say_hello", b"tpu")
+    assert ret == 0 and out == b"Hello, tpu!"
+    # WR method: mutation commits like any write
+    ret, _ = cl.exec(pool, "greet", "hello", "record_hello", b"disk")
+    assert ret == 0
+    assert cl.read(pool, "greet") == b"Hello, disk!"
+    assert cl.getxattr(pool, "greet", "hello") == b"1"
+    # numops arithmetic on the stored value (cls_numops.cc)
+    assert cl.exec(pool, "n", "numops", "add", b"10")[0] == 0
+    assert cl.exec(pool, "n", "numops", "add", b"5")[0] == 0
+    assert cl.exec(pool, "n", "numops", "mul", b"3")[0] == 0
+    assert cl.read(pool, "n") == b"45"
+    # unknown method -> EOPNOTSUPP, nothing committed
+    ret, _ = cl.exec(pool, "n", "nope", "nada")
+    assert ret == -95
+    # a failing call aborts the whole vector atomically
+    r, _ = cl.operate(pool, "n", ObjectOperation()
+                      .call("numops", "add", b"not-a-number")
+                      .set_xattr("t", b"x"))
+    assert r == -22
+    with pytest.raises(IOError):
+        cl.getxattr(pool, "n", "t")
+
+
+@pytest.mark.parametrize("fixture", ["ec_cluster", "rep_cluster"])
+def test_copy_from_same_and_cross_pool(fixture, request):
+    c, cl = request.getfixturevalue(fixture)
+    pool = "vec" if fixture == "ec_cluster" else "rvec"
+    payload = bytes(range(256)) * 30
+    assert cl.write_full(pool, "src", payload) == 0
+    assert cl.setxattr(pool, "src", "tag", b"copied") == 0
+    assert cl.copy(pool, "dst", "src") == 0
+    assert cl.read(pool, "dst") == payload
+    assert cl.getxattr(pool, "dst", "tag") == b"copied"
+    # REAL cross-pool copy, with the source in pool id 0 (the falsy-id
+    # regression: 0 must not read as "same pool")
+    assert cl.lookup_pool(pool) == 0
+    c.create_replicated_pool(f"x{pool}", size=3, pg_num=4)
+    cl.mon.send_full_map(cl.name)
+    c.network.pump()
+    assert cl.copy(f"x{pool}", "xdst", "src", src_pool=pool) == 0
+    assert cl.read(f"x{pool}", "xdst") == payload
+    assert cl.getxattr(f"x{pool}", "xdst", "tag") == b"copied"
+    # and rep -> original direction (omap rides along to rep dsts)
+    assert cl.write_full(f"x{pool}", "rsrc", b"with-omap") == 0
+    cl.omap_set(f"x{pool}", "rsrc", {"k": b"v"})
+    assert cl.copy(f"x{pool}", "rdst", "rsrc") == 0
+    assert cl.omap_get(f"x{pool}", "rdst") == {"k": b"v"}
+    # missing source -> ENOENT, destination untouched
+    assert cl.copy(pool, "dst3", "no-such-src") == -2
+    with pytest.raises(IOError):
+        cl.read(pool, "dst3")
