@@ -1,0 +1,57 @@
+"""Keyring: entity name -> base64 secret (src/auth/KeyRing.cc role).
+
+File format mirrors the reference's keyring ini shape::
+
+    [osd.0]
+        key = <base64>
+
+The mon process loads the full keyring (it is the KDC); every other
+daemon/client needs only its own entry.
+"""
+from __future__ import annotations
+
+import base64
+from typing import Dict, Optional
+
+from .crypto import make_secret
+
+
+class Keyring:
+    def __init__(self) -> None:
+        self.keys: Dict[str, bytes] = {}
+
+    def create(self, entity: str) -> bytes:
+        """Generate-or-get a secret for *entity* (ceph auth get-or-create)."""
+        if entity not in self.keys:
+            self.keys[entity] = make_secret()
+        return self.keys[entity]
+
+    def get(self, entity: str) -> Optional[bytes]:
+        return self.keys.get(entity)
+
+    # ---- file io -----------------------------------------------------------
+    def save(self, path: str) -> None:
+        lines = []
+        for entity in sorted(self.keys):
+            lines.append(f"[{entity}]")
+            key64 = base64.b64encode(self.keys[entity]).decode()
+            lines.append(f"\tkey = {key64}")
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Keyring":
+        kr = cls()
+        entity = None
+        with open(path) as f:
+            for raw in f:
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if line.startswith("[") and line.endswith("]"):
+                    entity = line[1:-1]
+                elif "=" in line and entity is not None:
+                    k, v = (s.strip() for s in line.split("=", 1))
+                    if k == "key":
+                        kr.keys[entity] = base64.b64decode(v)
+        return kr

@@ -14,6 +14,7 @@ callers' deterministic pump loops keep working across processes.
 """
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import time
@@ -21,9 +22,65 @@ from typing import Dict, Optional, Tuple
 
 from .messenger import Network
 from .messages import Message
-from .wire import decode_message, encode_message
+from .wire import decode_blob, decode_message, encode_blob, encode_message
 
 _HDR = struct.Struct("<I H B")   # frame length, dst-name length, comp algo
+
+# auth control frames reuse the MSG header with a dst-name-length
+# sentinel no real name can reach; the comp byte carries the opcode
+_AUTH_DLEN = 0xFFFF
+_A_KDC_HELLO, _A_KDC_CHALLENGE, _A_KDC_PROVE, _A_KDC_REPLY = 1, 2, 3, 4
+_A_AUTHORIZER, _A_AUTH_REPLY = 5, 6
+_A_AUTH_HELLO, _A_AUTH_CHALLENGE = 7, 8
+_SIG_LEN = 8                     # per-frame HMAC trailer when authed
+
+
+class _AuthFailed(Exception):
+    pass
+
+
+class TcpAuth:
+    """Per-process auth state for a TcpNetwork (cephx on the wire).
+
+    ``entity`` is the process's principal; its secret comes from the
+    keyring file.  The mon process passes ``kdc=True`` and the FULL
+    keyring — it hosts the CephxServer and answers KDC frames on its
+    unauthenticated inbound sockets (the cephx bootstrap path).
+    Daemons/clients hold only their own entry.
+
+    Caveat vs the reference: a process authenticates as ONE principal,
+    so inbound src names are enforced at service granularity
+    (client.* may not claim osd.*), not per-entity.
+    """
+
+    def __init__(self, entity: str, keyring_path: str, kdc: bool = False):
+        from ..auth import (CephxClient, CephxServer,
+                            CephxServiceVerifier, Keyring, entity_service)
+        keyring = Keyring.load(keyring_path)
+        secret = keyring.get(entity)
+        if secret is None:
+            raise ValueError(f"keyring has no key for {entity!r}")
+        self.entity = entity
+        self.service = entity_service(entity)
+        self.client = CephxClient(entity, secret)
+        self.server: Optional[CephxServer] = None
+        self.verifier: Optional[CephxServiceVerifier] = None
+        if kdc:
+            self.server = CephxServer(keyring)
+            # the mon authenticates itself against its own KDC in-memory
+            ch = self.server.get_challenge(entity)
+            cch, proof = self.client.make_proof(ch)
+            self.client.handle_reply(
+                self.server.authenticate(entity, ch, cch, proof))
+            self.ensure_verifier()
+
+    def ensure_verifier(self) -> None:
+        """Build the service verifier once rotating keys are known."""
+        if self.verifier is None and \
+                self.service in self.client.rotating:
+            from ..auth import CephxServiceVerifier
+            self.verifier = CephxServiceVerifier(
+                self.service, self.client.rotating[self.service])
 
 # frame compression algorithm ids (Compressor::COMP_ALG_* role); the
 # receiver decodes by the frame's id, so peers may use different configs
@@ -40,9 +97,15 @@ class TcpNetwork(Network):
 
     def __init__(self, listen_addr: Tuple[str, int],
                  directory: Dict[str, Tuple[str, int]],
-                 compression: str = "none", compress_min: int = 1024):
+                 compression: str = "none", compress_min: int = 1024,
+                 auth: Optional[TcpAuth] = None):
         super().__init__()
         from ..compressor import create_compressor
+        self.auth = auth
+        # outbound socket -> session key; inbound socket -> state dict
+        self._out_sk: Dict[socket.socket, bytes] = {}
+        self._in_auth: Dict[socket.socket, Dict] = {}
+        self.auth_rejects = 0
         self.compression = compression
         self.compress_min = compress_min
         self._comp = create_compressor(compression)
@@ -81,24 +144,145 @@ class TcpNetwork(Network):
             + dname + payload
         addr = tuple(addr)
         try:
-            self._peer(addr).sendall(frame)
+            s = self._peer(addr, dst)
+            if self.auth is not None:
+                from ..auth import hmac_tag
+                frame += hmac_tag(self._out_sk[s], frame, _SIG_LEN)
+            s.sendall(frame)
             return True
-        except OSError:
+        except Exception:
+            # OSError / _AuthFailed / malformed peer handshake bytes
+            # (struct.error, bad TLV): drop the connection, never die
             s = self._conns.pop(addr, None)
             if s is not None:
+                self._out_sk.pop(s, None)
                 try:
                     s.close()
                 except OSError:
                     pass
             return False
 
-    def _peer(self, addr: Tuple[str, int]) -> socket.socket:
+    def _peer(self, addr: Tuple[str, int],
+              dst: str = "") -> socket.socket:
         s = self._conns.get(addr)
         if s is None:
             s = socket.create_connection(addr, timeout=5.0)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                if self.auth is not None:
+                    self._auth_outbound(s, addr, dst)
+            except Exception:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                raise
             self._conns[addr] = s
         return s
+
+    # ---- auth handshakes ---------------------------------------------------
+    def _send_auth_frame(self, s: socket.socket, op: int,
+                         body: Dict) -> None:
+        payload = encode_blob(body)
+        s.sendall(_HDR.pack(len(payload), _AUTH_DLEN, op) + payload)
+
+    def _read_auth_frame(self, s: socket.socket) -> Tuple[int, Dict]:
+        """Read one auth frame, serving OUR inbound sockets while
+        waiting — two daemons handshaking with each other concurrently
+        would otherwise deadlock until both time out."""
+        buf = b""
+        deadline = time.monotonic() + 5.0
+        s.settimeout(0.05)
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    chunk = s.recv(1 << 16)
+                    if not chunk:
+                        raise _AuthFailed("peer closed during auth")
+                    buf += chunk
+                except socket.timeout:
+                    self._poll_sockets(0.0)
+                    continue
+                if len(buf) < _HDR.size:
+                    continue
+                plen, dlen, op = _HDR.unpack_from(buf, 0)
+                if dlen != _AUTH_DLEN:
+                    raise _AuthFailed("expected auth frame")
+                if len(buf) >= _HDR.size + plen:
+                    return op, decode_blob(buf[_HDR.size:_HDR.size + plen])
+            raise _AuthFailed("auth handshake timed out")
+        finally:
+            try:
+                s.settimeout(5.0)
+            except OSError:
+                pass
+
+    def _auth_outbound(self, s: socket.socket, addr: Tuple[str, int],
+                       dst: str) -> None:
+        """Authenticate a fresh outbound connection: bootstrap with the
+        KDC if needed, then present an authorizer for dst's service."""
+        from ..auth import AuthError, entity_service
+        a = self.auth
+        mon_addr = tuple(self.directory.get("mon", ("", 0)))
+        if not a.client.authenticated():
+            if addr != mon_addr:
+                # need tickets first; fetch them over a mon connection
+                self._peer(mon_addr, "mon")
+            else:
+                self._kdc_exchange(s)
+        service = entity_service(dst) if dst else "mon"
+        # fetch the connection-bound server challenge first, so a
+        # recorded authorizer can't re-authenticate a new connection
+        self._send_auth_frame(s, _A_AUTH_HELLO, {})
+        op, body = self._read_auth_frame(s)
+        if op != _A_AUTH_CHALLENGE or "challenge" not in body:
+            raise _AuthFailed(body.get("error", "no authorizer challenge"))
+        try:
+            auth_msg, sk, nonce = a.client.build_authorizer(
+                service, body["challenge"])
+        except AuthError as e:
+            raise _AuthFailed(str(e))
+        self._send_auth_frame(s, _A_AUTHORIZER, auth_msg)
+        op, reply = self._read_auth_frame(s)
+        if op != _A_AUTH_REPLY or not reply.get("ok") or \
+                not a.client.check_authorizer_reply(
+                    sk, nonce, reply.get("reply", b"")):
+            from ..common.dout import dlog
+            dlog("msg", 0, f"authorizer for {dst!r} rejected: "
+                 f"{reply.get('error', 'bad reply proof')}")
+            raise _AuthFailed("authorizer rejected")
+        self._out_sk[s] = sk
+
+    def _kdc_exchange(self, s: socket.socket) -> None:
+        """cephx bootstrap on an un-authed mon connection."""
+        a = self.auth
+        self._send_auth_frame(s, _A_KDC_HELLO, {"entity": a.entity})
+        op, body = self._read_auth_frame(s)
+        if op != _A_KDC_CHALLENGE or "challenge" not in body:
+            raise _AuthFailed(body.get("error", "no KDC challenge"))
+        cch, proof = a.client.make_proof(body["challenge"])
+        self._send_auth_frame(s, _A_KDC_PROVE, {
+            "entity": a.entity, "server_challenge": body["challenge"],
+            "client_challenge": cch, "proof": proof})
+        op, body = self._read_auth_frame(s)
+        if op != _A_KDC_REPLY or not body.get("ok"):
+            from ..common.dout import dlog
+            dlog("msg", 0, "KDC rejected "
+                 f"{a.entity!r}: {body.get('error', '?')}")
+            raise _AuthFailed("KDC rejected credentials")
+        a.client.handle_reply(body["blob"])
+        a.ensure_verifier()
+
+    def authenticate(self) -> bool:
+        """Force the KDC exchange now (daemon boot path), so inbound
+        authorizers can be verified before any outbound traffic."""
+        if self.auth is None or self.auth.client.authenticated():
+            return True
+        try:
+            self._peer(tuple(self.directory["mon"]), "mon")
+            return True
+        except (_AuthFailed, OSError, KeyError):
+            return False
 
     # ---- receiving ---------------------------------------------------------
     def _poll_sockets(self, wait: float) -> int:
@@ -126,22 +310,142 @@ class TcpNetwork(Network):
             if not data:
                 self._accepted.remove(s)
                 self._rxbuf.pop(s, None)
+                self._in_auth.pop(s, None)
                 continue
             buf = self._rxbuf[s]
             buf.extend(data)
-            n += self._drain_frames(buf)
+            n += self._drain_frames(s, buf)
         return n
 
-    def _drain_frames(self, buf: bytearray) -> int:
+    def _handle_auth_frame(self, s: socket.socket, op: int,
+                           payload: bytes) -> None:
+        """Inbound auth control frame on an accepted socket."""
+        from ..auth import AuthError
+        a = self.auth
+        state = self._in_auth.setdefault(s, {"authed": False})
+        try:
+            body = decode_blob(payload)
+            if op == _A_KDC_HELLO:
+                if a is None or a.server is None:
+                    self._send_auth_frame(s, _A_KDC_CHALLENGE,
+                                          {"error": "not a KDC"})
+                    return
+                try:
+                    ch = a.server.get_challenge(body["entity"])
+                except AuthError as e:
+                    self.auth_rejects += 1
+                    self._send_auth_frame(s, _A_KDC_CHALLENGE,
+                                          {"error": str(e)})
+                    return
+                self._send_auth_frame(s, _A_KDC_CHALLENGE,
+                                      {"challenge": ch})
+            elif op == _A_KDC_PROVE:
+                if a is None or a.server is None:
+                    self._send_auth_frame(s, _A_KDC_REPLY,
+                                          {"ok": False,
+                                           "error": "not a KDC"})
+                    return
+                try:
+                    blob = a.server.authenticate(
+                        body["entity"], body.get("server_challenge", b""),
+                        body["client_challenge"], body["proof"])
+                    self._send_auth_frame(s, _A_KDC_REPLY,
+                                          {"ok": True, "blob": blob})
+                except AuthError as e:
+                    self.auth_rejects += 1
+                    self._send_auth_frame(s, _A_KDC_REPLY,
+                                          {"ok": False, "error": str(e)})
+            elif op == _A_AUTH_HELLO:
+                ch = os.urandom(16)
+                state["challenge"] = ch
+                self._send_auth_frame(s, _A_AUTH_CHALLENGE,
+                                      {"challenge": ch})
+            elif op == _A_AUTHORIZER:
+                if a is None:
+                    self._send_auth_frame(
+                        s, _A_AUTH_REPLY,
+                        {"ok": False, "error": "auth disabled here"})
+                    return
+                a.ensure_verifier()
+                if a.verifier is None:
+                    self._send_auth_frame(
+                        s, _A_AUTH_REPLY,
+                        {"ok": False, "error": "no rotating keys yet"})
+                    return
+                ch = state.pop("challenge", None)
+                if ch is None:
+                    self.auth_rejects += 1
+                    self._send_auth_frame(
+                        s, _A_AUTH_REPLY,
+                        {"ok": False, "error": "no challenge issued on "
+                         "this connection"})
+                    return
+                try:
+                    entity, sk, reply = \
+                        a.verifier.verify_authorizer(body, ch)
+                except AuthError as e:
+                    self.auth_rejects += 1
+                    self._send_auth_frame(s, _A_AUTH_REPLY,
+                                          {"ok": False, "error": str(e)})
+                    return
+                state.update(authed=True, sk=sk, entity=entity)
+                self._send_auth_frame(s, _A_AUTH_REPLY,
+                                      {"ok": True, "reply": reply})
+        except Exception as e:
+            # malformed payloads (struct.error, UnicodeDecodeError, bad
+            # TLV...) come straight off the network: drop, never die
+            self.auth_rejects += 1
+            from ..common.dout import dlog
+            dlog("msg", 0, f"auth frame error: {e!r}")
+
+    def _drain_frames(self, s: socket.socket, buf: bytearray) -> int:
         n = 0
+        trailer = _SIG_LEN if self.auth is not None else 0
         while len(buf) >= _HDR.size:
             plen, dlen, comp_id = _HDR.unpack_from(buf, 0)
-            total = _HDR.size + dlen + plen
+            if dlen == _AUTH_DLEN:
+                total = _HDR.size + plen
+                if len(buf) < total:
+                    break
+                payload = bytes(buf[_HDR.size:total])
+                del buf[:total]
+                self._handle_auth_frame(s, comp_id, payload)
+                continue
+            total = _HDR.size + dlen + plen + trailer
             if len(buf) < total:
                 break
-            dst = bytes(buf[_HDR.size:_HDR.size + dlen]).decode()
-            payload = bytes(buf[_HDR.size + dlen:total])
+            payload = bytes(buf[_HDR.size + dlen:total - trailer])
+            frame_bytes = bytes(buf[:total - trailer])
+            dst_raw = bytes(buf[_HDR.size:_HDR.size + dlen])
+            sig = bytes(buf[total - trailer:total])
             del buf[:total]
+            # auth gate FIRST: nothing from an unauthenticated or
+            # forged frame (including its dst name) gets interpreted
+            if trailer:
+                state = self._in_auth.get(s)
+                if state is None or not state.get("authed"):
+                    self.auth_rejects += 1
+                    self.dropped += 1
+                    from ..common.dout import dlog
+                    dlog("msg", 0, "dropping frame: "
+                         "connection not authenticated")
+                    continue
+                from ..auth import hmac_tag
+                if sig != hmac_tag(state["sk"], frame_bytes, _SIG_LEN):
+                    self.auth_rejects += 1
+                    self.dropped += 1
+                    from ..common.dout import dlog
+                    dlog("msg", 0, "dropping frame: "
+                         "bad frame signature")
+                    continue
+            try:
+                dst = dst_raw.decode()
+            except UnicodeDecodeError as e:
+                self.dropped += 1
+                from ..common.dout import dlog
+                dlog("msg", 0, f"dropped frame with undecodable dst "
+                     f"name: {e!r}")
+                continue
             try:
                 if comp_id:
                     dec = self._decomps.get(comp_id)
@@ -178,6 +482,22 @@ class TcpNetwork(Network):
                          f"({self.dropped} total; possible peer wire-"
                          f"format mismatch): {e!r}")
                 continue
+            if trailer:
+                # the signature binds the frame to the connection's
+                # authenticated principal; spoofed src names (a client
+                # key claiming to be an osd/mon) get dropped here
+                from ..auth import entity_service
+                state = self._in_auth.get(s) or {}
+                if entity_service(msg.src) != \
+                        entity_service(state.get("entity", "")):
+                    self.auth_rejects += 1
+                    self.dropped += 1
+                    from ..common.dout import dlog
+                    dlog("msg", 0,
+                         f"dropping frame: src {msg.src!r} outside "
+                         f"authenticated service of "
+                         f"{state.get('entity')!r}")
+                    continue
             # enqueue like a local delivery (fault injection still applies)
             self.queue.append((msg.src, dst, msg))
             n += 1

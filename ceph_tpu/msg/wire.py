@@ -103,6 +103,18 @@ def _dec_value(buf: bytes, pos: int):
     raise ValueError(f"bad wire tag {tag!r} at {pos - 1}")
 
 
+def encode_blob(value: Any) -> bytes:
+    """Plain value -> TLV bytes (auth tickets, small control blobs)."""
+    out: list = []
+    _enc_value(value, out)
+    return b"".join(out)
+
+
+def decode_blob(buf: bytes) -> Any:
+    value, _pos = _dec_value(buf, 0)
+    return value
+
+
 def encode_message(msg: M.Message) -> bytes:
     """Message -> framed bytes (class name + field dict)."""
     fields: Dict[str, Any] = dict(vars(msg))

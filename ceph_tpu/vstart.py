@@ -44,8 +44,13 @@ def mon_main(args) -> None:
     from .msg.tcp import TcpNetwork
 
     directory = json.loads(args.directory)
+    auth = None
+    if args.keyring:
+        from .msg.tcp import TcpAuth
+        auth = TcpAuth("mon", args.keyring, kdc=True)
     net = TcpNetwork(("127.0.0.1", args.port),
-                     {k: tuple(v) for k, v in directory.items()})
+                     {k: tuple(v) for k, v in directory.items()},
+                     auth=auth)
     mon = Monitor(net, name="mon")
     if args.down_out_interval:
         mon.down_out_interval = args.down_out_interval
@@ -86,8 +91,20 @@ def osd_main(args) -> None:
             g_conf.set_val(f"debug_{s}", f"{args.debug}/{args.debug}")
         _log.stderr_level = args.debug
     directory = json.loads(args.directory)
+    auth = None
+    if args.keyring:
+        from .msg.tcp import TcpAuth
+        auth = TcpAuth(f"osd.{args.id}", args.keyring)
     net = TcpNetwork(("127.0.0.1", args.port),
-                     {k: tuple(v) for k, v in directory.items()})
+                     {k: tuple(v) for k, v in directory.items()},
+                     auth=auth)
+    if auth is not None:
+        # fetch tickets + rotating keys BEFORE serving, so inbound
+        # authorizers (peer OSDs, the mon) can be verified from boot
+        for _ in range(50):
+            if net.authenticate():
+                break
+            time.sleep(0.2)
     daemon = osd_mod.OSD(net, args.id, mon_name="mon")
     # boot subscription: the mon's startup map pushes predate this
     # process's listener, so ask for the full history explicitly
@@ -131,8 +148,24 @@ class ProcessCluster:
                  heartbeat_interval: float = 1.0,
                  heartbeat_grace: float = 4.0,
                  down_out_interval: float = 5.0,
-                 client_names: Tuple[str, ...] = ("client.x",)):
+                 client_names: Tuple[str, ...] = ("client.x",),
+                 auth: bool = False):
         self.n_osds = n_osds
+        self.keyring_path: Optional[str] = None
+        self._tmpdir: Optional[str] = None
+        if auth:
+            import tempfile
+            from .auth import Keyring
+            self._tmpdir = tempfile.mkdtemp(prefix="ceph_tpu_auth_")
+            kr = Keyring()
+            kr.create("mon")
+            for i in range(n_osds):
+                kr.create(f"osd.{i}")
+            for name in client_names:
+                kr.create(name)
+            self.keyring_path = os.path.join(self._tmpdir, "keyring")
+            kr.save(self.keyring_path)
+        self.client_names = client_names
         ports = _free_ports(n_osds + 2)
         self.mon_port = ports[0]
         self.client_port = ports[1]
@@ -158,12 +191,15 @@ class ProcessCluster:
 
     def _spawn(self, n_osds, dir_json, env, pool, heartbeat_interval,
                heartbeat_grace, down_out_interval) -> None:
+        keyring_args = (["--keyring", self.keyring_path]
+                        if self.keyring_path else [])
         self.procs["mon"] = subprocess.Popen(
             [sys.executable, "-m", "ceph_tpu.vstart", "mon",
              "--port", str(self.mon_port), "--n-osds", str(n_osds),
              "--directory", dir_json,
              "--down-out-interval", str(down_out_interval),
-             "--pool", json.dumps(pool) if pool else ""],
+             "--pool", json.dumps(pool) if pool else "",
+             *keyring_args],
             stdout=subprocess.PIPE, text=True, cwd=REPO, env=env)
         self._await_ready("mon")
         # spawn every osd CONCURRENTLY: a sequential boot staggers the
@@ -175,13 +211,18 @@ class ProcessCluster:
                  "--id", str(i), "--port", str(self.osd_ports[i]),
                  "--directory", dir_json,
                  "--heartbeat-interval", str(heartbeat_interval),
-                 "--heartbeat-grace", str(heartbeat_grace)],
+                 "--heartbeat-grace", str(heartbeat_grace),
+                 *keyring_args],
                 stdout=subprocess.PIPE, text=True, cwd=REPO, env=env)
         for i in range(n_osds):
             self._await_ready(f"osd.{i}")
         from .msg.tcp import TcpNetwork
+        cl_auth = None
+        if self.keyring_path:
+            from .msg.tcp import TcpAuth
+            cl_auth = TcpAuth(self.client_names[0], self.keyring_path)
         self.network = TcpNetwork(("127.0.0.1", self.client_port),
-                                  self.directory)
+                                  self.directory, auth=cl_auth)
 
     def _await_ready(self, name: str, timeout: float = 120.0) -> None:
         import select
@@ -239,6 +280,9 @@ class ProcessCluster:
                 pass
         if self.network is not None:
             self.network.close()
+        if self._tmpdir:
+            import shutil
+            shutil.rmtree(self._tmpdir, ignore_errors=True)
 
 
 def main(argv=None) -> None:
@@ -251,12 +295,14 @@ def main(argv=None) -> None:
     pm.add_argument("--directory", required=True)
     pm.add_argument("--pool", default="")
     pm.add_argument("--down-out-interval", type=float, default=0.0)
+    pm.add_argument("--keyring", default="")
     po = sub.add_parser("osd")
     po.add_argument("--id", type=int, required=True)
     po.add_argument("--port", type=int, required=True)
     po.add_argument("--directory", required=True)
     po.add_argument("--heartbeat-interval", type=float, default=0.0)
     po.add_argument("--heartbeat-grace", type=float, default=0.0)
+    po.add_argument("--keyring", default="")
     po.add_argument("--debug", type=int,
                     default=int(os.environ.get("VSTART_DEBUG", "0")))
     args = ap.parse_args(argv)

@@ -1,0 +1,366 @@
+"""Auth subsystem tests (src/auth/ cephx role).
+
+Protocol level: challenge/response against the KDC, ticket issuance,
+authorizer verification from rotating secrets, expiry/rotation, and
+tamper-evidence of every blob.  Transport level: two TcpNetworks in one
+process handshake and exchange signed frames; wrong keys, unknown
+entities, spoofed src names, and bit-flipped frames are all rejected.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.auth import (
+    AuthError, CephxClient, CephxServer, CephxServiceVerifier, Keyring,
+    decrypt, encrypt, hmac_tag,
+)
+from ceph_tpu.msg.messages import MMonPing
+from ceph_tpu.msg.messenger import Dispatcher
+from ceph_tpu.msg.tcp import TcpAuth, TcpNetwork
+
+
+# ---- crypto ----------------------------------------------------------------
+
+def test_encrypt_decrypt_roundtrip():
+    key = os.urandom(16)
+    for n in (0, 1, 31, 32, 33, 4096):
+        pt = os.urandom(n)
+        assert decrypt(key, encrypt(key, pt)) == pt
+
+
+def test_decrypt_rejects_tamper_and_wrong_key():
+    key = os.urandom(16)
+    blob = bytearray(encrypt(key, b"secret payload"))
+    for pos in (0, len(blob) // 2, len(blob) - 1):
+        t = bytearray(blob)
+        t[pos] ^= 0x01
+        with pytest.raises(AuthError):
+            decrypt(key, bytes(t))
+    with pytest.raises(AuthError):
+        decrypt(os.urandom(16), bytes(blob))
+
+
+def test_keyring_file_roundtrip(tmp_path):
+    kr = Keyring()
+    s1 = kr.create("mon")
+    s2 = kr.create("osd.0")
+    assert kr.create("mon") == s1          # get-or-create is stable
+    path = str(tmp_path / "keyring")
+    kr.save(path)
+    back = Keyring.load(path)
+    assert back.get("mon") == s1 and back.get("osd.0") == s2
+    assert back.get("osd.99") is None
+
+
+# ---- KDC protocol ----------------------------------------------------------
+
+def _kdc_pair(entities=("mon", "osd.0", "client.x")):
+    kr = Keyring()
+    for e in entities:
+        kr.create(e)
+    return kr, CephxServer(kr)
+
+
+def _login(server: CephxServer, entity: str, secret: bytes) -> CephxClient:
+    client = CephxClient(entity, secret)
+    ch = server.get_challenge(entity)
+    cch, proof = client.make_proof(ch)
+    client.handle_reply(server.authenticate(entity, ch, cch, proof))
+    return client
+
+
+def test_kdc_exchange_issues_tickets_and_rotating_keys():
+    kr, server = _kdc_pair()
+    osd = _login(server, "osd.0", kr.get("osd.0"))
+    assert osd.authenticated()
+    for svc in ("mon", "osd", "mgr", "client"):
+        assert svc in osd.tickets
+    # daemon got its own service's rotating secrets, nothing else's
+    assert "osd" in osd.rotating and "mon" not in osd.rotating
+    cl = _login(server, "client.x", kr.get("client.x"))
+    assert "client" in cl.rotating and "osd" not in cl.rotating
+
+
+def test_kdc_rejects_wrong_secret_unknown_entity_stale_challenge():
+    kr, server = _kdc_pair()
+    bad = CephxClient("osd.0", os.urandom(16))
+    ch = server.get_challenge("osd.0")
+    cch, proof = bad.make_proof(ch)
+    with pytest.raises(AuthError):
+        server.authenticate("osd.0", ch, cch, proof)
+    # challenge is consumed by the failed attempt (no retry oracle)
+    good = CephxClient("osd.0", kr.get("osd.0"))
+    cch, proof = good.make_proof(ch)
+    with pytest.raises(AuthError):
+        server.authenticate("osd.0", ch, cch, proof)
+    with pytest.raises(AuthError):
+        server.authenticate("osd.99", server.get_challenge("osd.99"),
+                            b"x" * 16, b"y" * 16)
+    # a challenge issued to one entity cannot prove another
+    kr.create("client.evil")
+    ch2 = server.get_challenge("client.evil")
+    victim = CephxClient("osd.0", kr.get("osd.0"))
+    cch, proof = victim.make_proof(ch2)
+    with pytest.raises(AuthError):
+        server.authenticate("osd.0", ch2, cch, proof)
+
+
+def test_authorizer_verify_and_mutual_proof():
+    kr, server = _kdc_pair()
+    cl = _login(server, "client.x", kr.get("client.x"))
+    osd = _login(server, "osd.0", kr.get("osd.0"))
+    verifier = CephxServiceVerifier("osd", osd.rotating["osd"])
+    auth, sk, nonce = cl.build_authorizer("osd")
+    entity, vsk, reply = verifier.verify_authorizer(auth)
+    assert entity == "client.x" and vsk == sk
+    assert cl.check_authorizer_reply(sk, nonce, reply)
+    # a reply proof computed under the wrong key fails the mutual check
+    assert not cl.check_authorizer_reply(sk, nonce,
+                                         hmac_tag(os.urandom(16),
+                                                  struct.pack("<Q",
+                                                              nonce + 1)))
+
+
+def test_authorizer_rejects_tampered_ticket_wrong_service_bad_proof():
+    kr, server = _kdc_pair()
+    cl = _login(server, "client.x", kr.get("client.x"))
+    osd = _login(server, "osd.0", kr.get("osd.0"))
+    verifier = CephxServiceVerifier("osd", osd.rotating["osd"])
+    auth, _sk, _nonce = cl.build_authorizer("osd")
+    t = dict(auth)
+    tb = bytearray(t["ticket"])
+    tb[len(tb) // 2] ^= 1
+    t["ticket"] = bytes(tb)
+    with pytest.raises(AuthError):
+        verifier.verify_authorizer(t)
+    mon_auth, _, _ = cl.build_authorizer("mon")
+    with pytest.raises(AuthError):          # mon ticket shown to an osd
+        verifier.verify_authorizer(mon_auth)
+    t2 = dict(auth)
+    t2["proof"] = os.urandom(16)
+    with pytest.raises(AuthError):
+        verifier.verify_authorizer(t2)
+
+
+def test_authorizer_replay_needs_fresh_challenge():
+    """A recorded authorizer cannot re-authenticate a new connection:
+    the proof binds the connection's server challenge
+    (CVE-2018-1128-class replay, closed the same way)."""
+    kr, server = _kdc_pair()
+    cl = _login(server, "client.x", kr.get("client.x"))
+    osd = _login(server, "osd.0", kr.get("osd.0"))
+    verifier = CephxServiceVerifier("osd", osd.rotating["osd"])
+    ch1 = os.urandom(16)
+    auth, _, _ = cl.build_authorizer("osd", ch1)
+    verifier.verify_authorizer(auth, ch1)        # live connection: ok
+    with pytest.raises(AuthError):               # replay, new challenge
+        verifier.verify_authorizer(auth, os.urandom(16))
+    with pytest.raises(AuthError):               # replay, no challenge
+        verifier.verify_authorizer(auth)
+
+
+def test_kdc_challenge_table_bounded():
+    """HELLO floods can't grow the KDC's challenge table: unknown
+    entities are rejected outright and expired entries are swept."""
+    kr, server = _kdc_pair()
+    with pytest.raises(AuthError):
+        server.get_challenge("osd.999")
+    t0 = time.time()
+    for _ in range(50):
+        server.get_challenge("osd.0", now=t0)
+    assert len(server._challenges) == 50
+    # all expired by the next issue -> swept down to the new one
+    server.get_challenge("osd.0", now=t0 + 61.0)
+    assert len(server._challenges) == 1
+
+
+def test_ticket_expiry_and_rotation():
+    kr, _ = _kdc_pair()
+    server = CephxServer(kr, ticket_ttl=10.0)
+    cl = _login(server, "client.x", kr.get("client.x"))
+    osd = _login(server, "osd.0", kr.get("osd.0"))
+    verifier = CephxServiceVerifier("osd", osd.rotating["osd"])
+    auth, _, _ = cl.build_authorizer("osd")
+    verifier.verify_authorizer(auth, now=time.time())
+    with pytest.raises(AuthError):          # past the ttl
+        verifier.verify_authorizer(auth, now=time.time() + 11.0)
+    # rotation: new tickets use the new secret id; a verifier that
+    # never learned it rejects, one that refreshed accepts
+    server.rotate()
+    cl2 = _login(server, "client.x", kr.get("client.x"))
+    auth2, _, _ = cl2.build_authorizer("osd")
+    with pytest.raises(AuthError):
+        verifier.verify_authorizer(auth2)
+    verifier.update_rotating(
+        {sid: (sec, exp) for sid, (sec, exp)
+         in server.rotating["osd"].items()})
+    verifier.verify_authorizer(auth2)
+
+
+# ---- transport integration -------------------------------------------------
+
+class _Sink(Dispatcher):
+    def __init__(self):
+        self.got = []
+
+    def ms_fast_dispatch(self, msg):
+        self.got.append(msg)
+
+
+def _free_port():
+    import socket as sk
+    s = sk.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture
+def auth_pair(tmp_path):
+    """mon-net (KDC) + osd-net on localhost TCP with auth enabled."""
+    kr = Keyring()
+    for e in ("mon", "osd.0", "client.x"):
+        kr.create(e)
+    path = str(tmp_path / "keyring")
+    kr.save(path)
+    pm, po = _free_port(), _free_port()
+    directory = {"mon": ("127.0.0.1", pm), "osd.0": ("127.0.0.1", po)}
+    mon_net = TcpNetwork(("127.0.0.1", pm), directory,
+                         auth=TcpAuth("mon", path, kdc=True))
+    osd_net = TcpNetwork(("127.0.0.1", po), directory,
+                         auth=TcpAuth("osd.0", path))
+    nets = [mon_net, osd_net]
+    try:
+        yield kr, path, directory, mon_net, osd_net, nets
+    finally:
+        for n in nets:
+            n.close()
+
+
+def _pump_until(nets, pred, seconds=10.0):
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        for n in nets:
+            n.pump(quiesce=0.01, deadline=0.2)
+        if pred():
+            return True
+    return pred()
+
+
+def _serve(nets, stop):
+    """Pump *nets* from a thread so blocking handshakes can complete."""
+    while not stop.is_set():
+        for n in nets:
+            n.pump(quiesce=0.01, deadline=0.1)
+
+
+def test_tcp_auth_handshake_and_signed_delivery(auth_pair):
+    kr, path, directory, mon_net, osd_net, nets = auth_pair
+    mon_sink, osd_sink = _Sink(), _Sink()
+    mon_net.create_messenger("mon").add_dispatcher_head(mon_sink)
+    osd_net.create_messenger("osd.0").add_dispatcher_head(osd_sink)
+    stop = threading.Event()
+    t = threading.Thread(target=_serve, args=([mon_net], stop))
+    t.start()
+    try:
+        # osd -> mon: triggers KDC bootstrap + authorizer on connect
+        osd_net.send("osd.0", "mon", MMonPing(rank=0))
+        assert _pump_until([osd_net], lambda: len(mon_sink.got) == 1)
+    finally:
+        stop.set()
+        t.join()
+    assert osd_net.auth.client.authenticated()
+    # mon -> osd: replies flow over mon's own authed connection
+    stop = threading.Event()
+    t = threading.Thread(target=_serve, args=([osd_net], stop))
+    t.start()
+    try:
+        mon_net.send("mon", "osd.0", MMonPing(rank=1))
+        assert _pump_until([mon_net], lambda: len(osd_sink.got) == 1)
+    finally:
+        stop.set()
+        t.join()
+    assert mon_net.auth_rejects == 0 and osd_net.auth_rejects == 0
+
+
+def test_tcp_auth_rejects_wrong_key_and_unkeyed_entity(auth_pair,
+                                                       tmp_path):
+    kr, path, directory, mon_net, osd_net, nets = auth_pair
+    mon_sink = _Sink()
+    mon_net.create_messenger("mon").add_dispatcher_head(mon_sink)
+    # an intruder with a self-invented key for a real entity name
+    bad_kr = Keyring()
+    bad_kr.create("osd.0")
+    bad_path = str(tmp_path / "bad_keyring")
+    bad_kr.save(bad_path)
+    ip = _free_port()
+    intruder = TcpNetwork(("127.0.0.1", ip),
+                          {**directory, "osd.0": ("127.0.0.1", ip)},
+                          auth=TcpAuth("osd.0", bad_path))
+    stop = threading.Event()
+    t = threading.Thread(target=_serve, args=([mon_net], stop))
+    t.start()
+    try:
+        intruder.send("osd.0", "mon", MMonPing(rank=0))
+        _pump_until([intruder], lambda: False, seconds=2.0)
+    finally:
+        stop.set()
+        t.join()
+        intruder.close()
+    assert mon_sink.got == []
+    assert not intruder.auth.client.authenticated()
+
+
+def test_tcp_auth_drops_unsigned_and_spoofed_frames(auth_pair):
+    """A raw socket shoving unauthenticated or forged frames at an
+    auth-enabled listener gets every frame dropped."""
+    import socket as sk
+    kr, path, directory, mon_net, osd_net, nets = auth_pair
+    mon_sink = _Sink()
+    mon_net.create_messenger("mon").add_dispatcher_head(mon_sink)
+    from ceph_tpu.msg.wire import encode_message
+    payload = encode_message(MMonPing(rank=0))
+    dname = b"mon"
+    frame = struct.pack("<I H B", len(payload), len(dname), 0) \
+        + dname + payload
+    raw = sk.create_connection(tuple(directory["mon"]), timeout=5.0)
+    # no handshake at all; with and without a junk signature trailer
+    raw.sendall(frame + os.urandom(8))
+    raw.sendall(frame)
+    _pump_until([mon_net], lambda: mon_net.auth_rejects > 0,
+                seconds=5.0)
+    raw.close()
+    assert mon_sink.got == []
+    assert mon_net.auth_rejects > 0
+
+
+def test_tcp_auth_src_service_enforcement(auth_pair):
+    """client.x's key cannot put osd-sourced frames on the wire: the
+    signature binds frames to the authenticated principal's service."""
+    kr, path, directory, mon_net, osd_net, nets = auth_pair
+    mon_sink = _Sink()
+    mon_net.create_messenger("mon").add_dispatcher_head(mon_sink)
+    cp = _free_port()
+    cl_net = TcpNetwork(("127.0.0.1", cp),
+                        {**directory, "client.x": ("127.0.0.1", cp)},
+                        auth=TcpAuth("client.x", path))
+    stop = threading.Event()
+    t = threading.Thread(target=_serve, args=([mon_net], stop))
+    t.start()
+    try:
+        cl_net.send("osd.0", "mon", MMonPing(rank=0))   # spoofed src
+        cl_net.send("client.x", "mon", MMonPing(rank=7))
+        _pump_until([cl_net],
+                    lambda: len(mon_sink.got) >= 1, seconds=5.0)
+    finally:
+        stop.set()
+        t.join()
+        cl_net.close()
+    assert [m.rank for m in mon_sink.got] == [7]
+    assert mon_net.auth_rejects >= 1
