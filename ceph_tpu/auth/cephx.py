@@ -38,6 +38,8 @@ from .keyring import Keyring
 TICKET_TTL = 3600.0          # auth_service_ticket_ttl
 ROTATION_PERIOD = 3600.0     # auth_rotating_secrets period
 CHALLENGE_TTL = 60.0
+MAX_CHALLENGES = 1024        # un-authed HELLO floods evict the oldest
+RENEW_MARGIN = 60.0          # re-run the KDC exchange this early
 # "client" is a ticket-bearing service here (unlike the reference)
 # because replies flow over daemon->client connections in this
 # transport, so clients must verify inbound connecting daemons too.
@@ -101,6 +103,11 @@ class CephxServer:
         for stale in [c for c, (_, exp) in self._challenges.items()
                       if exp < now]:
             del self._challenges[stale]
+        # hard cap: a flood inside the TTL evicts its own oldest
+        # entries instead of growing mon memory (legit exchanges
+        # complete in milliseconds and are unaffected)
+        while len(self._challenges) >= MAX_CHALLENGES:
+            del self._challenges[next(iter(self._challenges))]
         ch = os.urandom(16)
         self._challenges[ch] = (entity, now + CHALLENGE_TTL)
         return ch
@@ -134,8 +141,11 @@ class CephxServer:
                 "session_key": session_key,
                 "expires": now + self.ticket_ttl,
             }))
+            # "expires" rides in the clear too so the CLIENT knows
+            # when to renew (the authoritative copy stays encrypted)
             tickets[svc] = {"session_key": session_key,
-                            "secret_id": sid, "ticket": ticket}
+                            "secret_id": sid, "ticket": ticket,
+                            "expires": now + self.ticket_ttl}
         reply: Dict = {"tickets": tickets}
         svc = entity_service(entity)
         if svc in SERVICES:   # daemons get their service's rotating keys
@@ -171,6 +181,15 @@ class CephxClient:
 
     def authenticated(self) -> bool:
         return bool(self.tickets)
+
+    def needs_renewal(self, now: Optional[float] = None) -> bool:
+        """True when any held ticket is at/near expiry — time to re-run
+        the KDC exchange (RotatingKeyRing renewal role)."""
+        if not self.tickets:
+            return True
+        now = time.time() if now is None else now
+        return any(t.get("expires", 0.0) <= now + RENEW_MARGIN
+                   for t in self.tickets.values())
 
     # ---- service connections ----------------------------------------------
     def build_authorizer(self, service: str,

@@ -18,6 +18,7 @@ import os
 import socket
 import struct
 import time
+from collections import deque
 from typing import Dict, Optional, Tuple
 
 from .messenger import Network
@@ -33,6 +34,13 @@ _A_KDC_HELLO, _A_KDC_CHALLENGE, _A_KDC_PROVE, _A_KDC_REPLY = 1, 2, 3, 4
 _A_AUTHORIZER, _A_AUTH_REPLY = 5, 6
 _A_AUTH_HELLO, _A_AUTH_CHALLENGE = 7, 8
 _SIG_LEN = 8                     # per-frame HMAC trailer when authed
+
+# lossless-session frames (Messenger Policy lossless_peer role):
+# 0xFFFD = seq-wrapped message, 0xFFFC = ack, 0xFFFB = session hello
+_SEQ_DLEN, _ACK_DLEN, _SESS_DLEN = 0xFFFD, 0xFFFC, 0xFFFB
+_S_HELLO, _S_HELLO_ACK = 1, 2
+_DAEMON_SERVICES = ("mon", "osd", "mgr")
+MAX_UNACKED = 10000              # per-peer resend-queue bound
 
 
 class _AuthFailed(Exception):
@@ -75,12 +83,17 @@ class TcpAuth:
             self.ensure_verifier()
 
     def ensure_verifier(self) -> None:
-        """Build the service verifier once rotating keys are known."""
-        if self.verifier is None and \
-                self.service in self.client.rotating:
-            from ..auth import CephxServiceVerifier
+        """Build (or refresh) the service verifier from the latest
+        rotating keys the KDC handed us."""
+        if self.service not in self.client.rotating:
+            return
+        from ..auth import CephxServiceVerifier
+        if self.verifier is None:
             self.verifier = CephxServiceVerifier(
                 self.service, self.client.rotating[self.service])
+        else:
+            self.verifier.update_rotating(
+                self.client.rotating[self.service])
 
 # frame compression algorithm ids (Compressor::COMP_ALG_* role); the
 # receiver decodes by the frame's id, so peers may use different configs
@@ -98,7 +111,8 @@ class TcpNetwork(Network):
     def __init__(self, listen_addr: Tuple[str, int],
                  directory: Dict[str, Tuple[str, int]],
                  compression: str = "none", compress_min: int = 1024,
-                 auth: Optional[TcpAuth] = None):
+                 auth: Optional[TcpAuth] = None,
+                 entity: Optional[str] = None):
         super().__init__()
         from ..compressor import create_compressor
         self.auth = auth
@@ -106,6 +120,26 @@ class TcpNetwork(Network):
         self._out_sk: Dict[socket.socket, bytes] = {}
         self._in_auth: Dict[socket.socket, Dict] = {}
         self.auth_rejects = 0
+        # ---- lossless-peer session state (msg/Messenger.h Policy) ----------
+        # the process principal decides the policy: daemon<->daemon
+        # links are lossless (seq + ack + reconnect-resend), anything
+        # involving a client stays lossy (drop on broken socket)
+        self.local_entity = entity or (auth.entity if auth else None)
+        # dst -> {next_seq, unacked deque[(seq, frame)], sock, retry_at,
+        #         backoff}
+        self._sess_tx: Dict[str, Dict] = {}
+        # peer entity -> highest seq delivered (survives reconnects)
+        self._sess_rx: Dict[str, int] = {}
+        # inbound socket -> peer entity (from session hello)
+        self._sess_peer: Dict[socket.socket, str] = {}
+        # outbound socket -> dst name (for routing acks back to tx state)
+        self._sock_dst: Dict[socket.socket, str] = {}
+        # sockets mid-handshake: _poll_sockets must not read them
+        self._handshaking: set = set()
+        # outbound socket -> rx buffer (ack frames from the peer)
+        self._obuf: Dict[socket.socket, bytearray] = {}
+        self.dup_dropped = 0
+        self.resent = 0
         self.compression = compression
         self.compress_min = compress_min
         self._comp = create_compressor(compression)
@@ -126,10 +160,7 @@ class TcpNetwork(Network):
     # Network.send enqueues everything; pump() applies the fault-injection
     # filters and calls _route_remote for non-local destinations, so
     # down/blackhole/drop semantics are identical across the boundary.
-    def _route_remote(self, src: str, dst: str, msg: Message) -> bool:
-        addr = self.directory.get(dst)
-        if addr is None or tuple(addr) == tuple(self.listen_addr):
-            return False  # unknown, or points back here with no endpoint
+    def _encode_payload(self, msg: Message) -> Tuple[bytes, int]:
         payload = encode_message(msg)
         comp_id = 0
         if self._comp_id and len(payload) >= self.compress_min:
@@ -139,28 +170,128 @@ class TcpNetwork(Network):
             if len(compressed) < len(payload):
                 payload = compressed
                 comp_id = self._comp_id
+        return payload, comp_id
+
+    def _lossless(self, dst: str) -> bool:
+        if self.local_entity is None:
+            return False
+        from ..auth import entity_service
+        return entity_service(self.local_entity) in _DAEMON_SERVICES \
+            and entity_service(dst) in _DAEMON_SERVICES
+
+    def _route_remote(self, src: str, dst: str, msg: Message) -> bool:
+        addr = self.directory.get(dst)
+        if addr is None or tuple(addr) == tuple(self.listen_addr):
+            return False  # unknown, or points back here with no endpoint
+        payload, comp_id = self._encode_payload(msg)
         dname = dst.encode()
+        if self._lossless(dst):
+            wrapped = struct.pack("<Q H", 0, len(dname)) + dname + payload
+            return self._send_lossless(dst, tuple(addr), comp_id, wrapped)
         frame = _HDR.pack(len(payload), len(dname), comp_id) \
             + dname + payload
         addr = tuple(addr)
         try:
             s = self._peer(addr, dst)
-            if self.auth is not None:
-                from ..auth import hmac_tag
-                frame += hmac_tag(self._out_sk[s], frame, _SIG_LEN)
-            s.sendall(frame)
+            self._transmit(s, frame)
             return True
         except Exception:
             # OSError / _AuthFailed / malformed peer handshake bytes
             # (struct.error, bad TLV): drop the connection, never die
-            s = self._conns.pop(addr, None)
-            if s is not None:
-                self._out_sk.pop(s, None)
-                try:
-                    s.close()
-                except OSError:
-                    pass
+            self._drop_conn(addr)
             return False
+
+    def _transmit(self, s: socket.socket, frame: bytes) -> None:
+        if self.auth is not None:
+            from ..auth import hmac_tag
+            frame += hmac_tag(self._out_sk[s], frame, _SIG_LEN)
+        s.sendall(frame)
+
+    def _drop_conn(self, addr: Tuple[str, int]) -> None:
+        s = self._conns.pop(addr, None)
+        if s is not None:
+            self._out_sk.pop(s, None)
+            self._sock_dst.pop(s, None)
+            self._obuf.pop(s, None)
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # ---- lossless-peer sessions (reconnect + resend, exactly-once) ---------
+    def _send_lossless(self, dst: str, addr: Tuple[str, int],
+                       comp_id: int, wrapped: bytes) -> bool:
+        """Queue a seq-wrapped frame for *dst* and try to ship it; a
+        broken socket keeps the frame queued for reconnect-resend
+        instead of dropping it (Policy lossless_peer)."""
+        tx = self._sess_tx.setdefault(
+            dst, {"next_seq": 1, "unacked": deque(),
+                  "retry_at": 0.0, "backoff": 0.25})
+        if len(tx["unacked"]) >= MAX_UNACKED:
+            from ..common.dout import dlog
+            dlog("msg", 0, f"lossless queue to {dst} overflowed "
+                 f"({MAX_UNACKED}); dropping message")
+            return False
+        seq = tx["next_seq"]
+        tx["next_seq"] = seq + 1
+        # stamp the real seq into the wrapper built by the caller
+        wrapped = struct.pack("<Q", seq) + wrapped[8:]
+        frame = _HDR.pack(len(wrapped), _SEQ_DLEN, comp_id) + wrapped
+        tx["unacked"].append((seq, frame))
+        self._flush_dst(dst, addr)
+        return True
+
+    def _flush_dst(self, dst: str, addr: Tuple[str, int]) -> None:
+        """(Re)connect to *dst* if needed and push every unacked frame
+        the current socket hasn't carried yet."""
+        tx = self._sess_tx[dst]
+        now = time.monotonic()
+        if self._conns.get(addr) is None and now < tx["retry_at"]:
+            return                       # in reconnect backoff
+        try:
+            s = self._peer(addr, dst)
+            if tx.get("sock") is not s:
+                # fresh socket: session hello tells the peer who we
+                # are and returns its delivered high-water mark
+                acked = self._session_hello(s, dst)
+                while tx["unacked"] and tx["unacked"][0][0] <= acked:
+                    tx["unacked"].popleft()
+                for _seq, frame in list(tx["unacked"]):
+                    self._transmit(s, frame)
+                    self.resent += 1
+                tx["sock"] = s
+                self._sock_dst[s] = dst
+            else:
+                _seq, frame = tx["unacked"][-1]
+                self._transmit(s, frame)
+            tx["backoff"] = 0.25
+        except Exception:
+            self._drop_conn(addr)
+            tx["sock"] = None
+            tx["retry_at"] = now + tx["backoff"]
+            tx["backoff"] = min(tx["backoff"] * 2, 5.0)
+
+    def _session_hello(self, s: socket.socket, dst: str) -> int:
+        """-> peer's last delivered seq from us (for resend trimming)."""
+        body = encode_blob({"entity": self.local_entity})
+        s.sendall(_HDR.pack(len(body), _SESS_DLEN, _S_HELLO) + body)
+        op, reply = self._read_ctrl_frame(s, _SESS_DLEN)
+        if op != _S_HELLO_ACK or "last_seq" not in reply:
+            raise _AuthFailed(reply.get("error", "bad session hello ack"))
+        return int(reply["last_seq"])
+
+    def _flush_lossless(self) -> None:
+        """Retry peers with backlogged unacked frames (called from
+        pump); reconnection does the resend."""
+        for dst, tx in list(self._sess_tx.items()):
+            if not tx["unacked"]:
+                continue
+            addr = self.directory.get(dst)
+            if addr is None:
+                continue
+            addr = tuple(addr)
+            if self._conns.get(addr) is None:
+                self._flush_dst(dst, addr)
 
     def _peer(self, addr: Tuple[str, int],
               dst: str = "") -> socket.socket:
@@ -187,18 +318,24 @@ class TcpNetwork(Network):
         s.sendall(_HDR.pack(len(payload), _AUTH_DLEN, op) + payload)
 
     def _read_auth_frame(self, s: socket.socket) -> Tuple[int, Dict]:
-        """Read one auth frame, serving OUR inbound sockets while
-        waiting — two daemons handshaking with each other concurrently
-        would otherwise deadlock until both time out."""
+        return self._read_ctrl_frame(s, _AUTH_DLEN)
+
+    def _read_ctrl_frame(self, s: socket.socket,
+                         want_dlen: int) -> Tuple[int, Dict]:
+        """Read one control frame (auth or session handshake), serving
+        OUR inbound sockets while waiting — two daemons handshaking
+        with each other concurrently would otherwise deadlock until
+        both time out."""
         buf = b""
         deadline = time.monotonic() + 5.0
         s.settimeout(0.05)
+        self._handshaking.add(s)
         try:
             while time.monotonic() < deadline:
                 try:
                     chunk = s.recv(1 << 16)
                     if not chunk:
-                        raise _AuthFailed("peer closed during auth")
+                        raise _AuthFailed("peer closed during handshake")
                     buf += chunk
                 except socket.timeout:
                     self._poll_sockets(0.0)
@@ -206,12 +343,13 @@ class TcpNetwork(Network):
                 if len(buf) < _HDR.size:
                     continue
                 plen, dlen, op = _HDR.unpack_from(buf, 0)
-                if dlen != _AUTH_DLEN:
-                    raise _AuthFailed("expected auth frame")
+                if dlen != want_dlen:
+                    raise _AuthFailed("unexpected frame during handshake")
                 if len(buf) >= _HDR.size + plen:
                     return op, decode_blob(buf[_HDR.size:_HDR.size + plen])
-            raise _AuthFailed("auth handshake timed out")
+            raise _AuthFailed("handshake timed out")
         finally:
+            self._handshaking.discard(s)
             try:
                 s.settimeout(5.0)
             except OSError:
@@ -224,9 +362,13 @@ class TcpNetwork(Network):
         from ..auth import AuthError, entity_service
         a = self.auth
         mon_addr = tuple(self.directory.get("mon", ("", 0)))
-        if not a.client.authenticated():
+        if a.client.needs_renewal():
+            # missing OR near-expiry tickets: (re)run the KDC exchange
+            # — on this socket if it goes to the mon, else over a fresh
+            # mon connection (expired tickets would otherwise lock the
+            # daemon out of every reconnect forever)
             if addr != mon_addr:
-                # need tickets first; fetch them over a mon connection
+                self._drop_conn(mon_addr)
                 self._peer(mon_addr, "mon")
             else:
                 self._kdc_exchange(s)
@@ -287,7 +429,9 @@ class TcpNetwork(Network):
     # ---- receiving ---------------------------------------------------------
     def _poll_sockets(self, wait: float) -> int:
         import select
-        socks = [self._listener] + self._accepted
+        outbound = [s for s in self._conns.values()
+                    if s not in self._handshaking]
+        socks = [self._listener] + self._accepted + outbound
         try:
             readable, _, _ = select.select(socks, [], [], wait)
         except OSError:
@@ -303,19 +447,53 @@ class TcpNetwork(Network):
                 except OSError:
                     pass
                 continue
+            if s in self._handshaking:
+                continue          # the blocking exchange owns this fd
+            is_outbound = s not in self._rxbuf and s in outbound
             try:
                 data = s.recv(1 << 20)
-            except OSError:
+            except (OSError, socket.timeout):
                 data = b""
             if not data:
+                if is_outbound:
+                    # peer closed our outbound connection: drop it so
+                    # the next send (or lossless flush) reconnects
+                    for addr, c in list(self._conns.items()):
+                        if c is s:
+                            self._drop_conn(addr)
+                    continue
                 self._accepted.remove(s)
                 self._rxbuf.pop(s, None)
                 self._in_auth.pop(s, None)
+                self._sess_peer.pop(s, None)
+                continue
+            if is_outbound:
+                buf = self._obuf.setdefault(s, bytearray())
+                buf.extend(data)
+                self._drain_outbound(s, buf)
                 continue
             buf = self._rxbuf[s]
             buf.extend(data)
             n += self._drain_frames(s, buf)
         return n
+
+    def _drain_outbound(self, s: socket.socket, buf: bytearray) -> None:
+        """Outbound sockets only carry session ACK frames inbound."""
+        while len(buf) >= _HDR.size:
+            plen, dlen, _op = _HDR.unpack_from(buf, 0)
+            total = _HDR.size + plen
+            if len(buf) < total:
+                break
+            payload = bytes(buf[_HDR.size:total])
+            del buf[:total]
+            if dlen != _ACK_DLEN or plen != 8:
+                continue          # stray frame: ignore
+            (acked,) = struct.unpack("<Q", payload)
+            dst = self._sock_dst.get(s)
+            tx = self._sess_tx.get(dst) if dst else None
+            if tx is not None:
+                while tx["unacked"] and tx["unacked"][0][0] <= acked:
+                    tx["unacked"].popleft()
 
     def _handle_auth_frame(self, s: socket.socket, op: int,
                            payload: bytes) -> None:
@@ -398,25 +576,64 @@ class TcpNetwork(Network):
             from ..common.dout import dlog
             dlog("msg", 0, f"auth frame error: {e!r}")
 
+    def _handle_session_frame(self, s: socket.socket, op: int,
+                              payload: bytes) -> None:
+        """Session hello on an accepted socket: bind the peer entity
+        (for seq bookkeeping) and return its delivered high-water mark
+        so a reconnecting sender can trim its resend queue."""
+        try:
+            if op != _S_HELLO:
+                return
+            body = decode_blob(payload)
+            entity = body.get("entity")
+            err = None
+            if not isinstance(entity, str) or not entity:
+                err = "session hello without entity"
+            elif self.auth is not None:
+                st = self._in_auth.get(s)
+                if st is None or not st.get("authed") or \
+                        st.get("entity") != entity:
+                    err = "session hello does not match " \
+                          "authenticated principal"
+            if err:
+                self.auth_rejects += 1
+                out = encode_blob({"error": err})
+            else:
+                self._sess_peer[s] = entity
+                out = encode_blob(
+                    {"last_seq": self._sess_rx.get(entity, 0)})
+            s.sendall(_HDR.pack(len(out), _SESS_DLEN, _S_HELLO_ACK)
+                      + out)
+        except Exception as e:
+            from ..common.dout import dlog
+            dlog("msg", 0, f"session frame error: {e!r}")
+
     def _drain_frames(self, s: socket.socket, buf: bytearray) -> int:
         n = 0
         trailer = _SIG_LEN if self.auth is not None else 0
+        ack_entity = None
         while len(buf) >= _HDR.size:
             plen, dlen, comp_id = _HDR.unpack_from(buf, 0)
-            if dlen == _AUTH_DLEN:
+            if dlen in (_AUTH_DLEN, _SESS_DLEN, _ACK_DLEN):
+                # control frames: no dst name, no signature trailer
                 total = _HDR.size + plen
                 if len(buf) < total:
                     break
                 payload = bytes(buf[_HDR.size:total])
                 del buf[:total]
-                self._handle_auth_frame(s, comp_id, payload)
+                if dlen == _AUTH_DLEN:
+                    self._handle_auth_frame(s, comp_id, payload)
+                elif dlen == _SESS_DLEN:
+                    self._handle_session_frame(s, comp_id, payload)
+                # _ACK_DLEN rides outbound sockets; ignore here
                 continue
-            total = _HDR.size + dlen + plen + trailer
+            seq_wrapped = dlen == _SEQ_DLEN
+            body_len = plen if seq_wrapped else dlen + plen
+            total = _HDR.size + body_len + trailer
             if len(buf) < total:
                 break
-            payload = bytes(buf[_HDR.size + dlen:total - trailer])
             frame_bytes = bytes(buf[:total - trailer])
-            dst_raw = bytes(buf[_HDR.size:_HDR.size + dlen])
+            body = frame_bytes[_HDR.size:]
             sig = bytes(buf[total - trailer:total])
             del buf[:total]
             # auth gate FIRST: nothing from an unauthenticated or
@@ -438,6 +655,17 @@ class TcpNetwork(Network):
                     dlog("msg", 0, "dropping frame: "
                          "bad frame signature")
                     continue
+            seq = 0
+            if seq_wrapped:
+                if len(body) < 10:
+                    self.dropped += 1
+                    continue
+                seq, ndlen = struct.unpack_from("<Q H", body, 0)
+                dst_raw = body[10:10 + ndlen]
+                payload = body[10 + ndlen:]
+            else:
+                dst_raw = body[:dlen]
+                payload = body[dlen:]
             try:
                 dst = dst_raw.decode()
             except UnicodeDecodeError as e:
@@ -446,6 +674,23 @@ class TcpNetwork(Network):
                 dlog("msg", 0, f"dropped frame with undecodable dst "
                      f"name: {e!r}")
                 continue
+            if seq_wrapped:
+                # seq bookkeeping BEFORE decode: an undecodable payload
+                # (codec mismatch, corrupt TLV) must still advance the
+                # ack high-water mark — resending it forever would
+                # wedge the session head-of-line; the loss is counted
+                # and logged below instead of silently un-acked
+                ent = self._sess_peer.get(s)
+                if ent is None:
+                    # no session hello on this connection yet
+                    self.dropped += 1
+                    continue
+                if seq <= self._sess_rx.get(ent, 0):
+                    self.dup_dropped += 1      # reconnect resend overlap
+                    ack_entity = ent
+                    continue
+                self._sess_rx[ent] = seq
+                ack_entity = ent
             try:
                 if comp_id:
                     dec = self._decomps.get(comp_id)
@@ -482,13 +727,19 @@ class TcpNetwork(Network):
                          f"({self.dropped} total; possible peer wire-"
                          f"format mismatch): {e!r}")
                 continue
+            if not isinstance(getattr(msg, "src", None), str):
+                # src drives hashed routing/filter lookups everywhere;
+                # a non-string here is a malformed/hostile frame
+                self.dropped += 1
+                continue
             if trailer:
                 # the signature binds the frame to the connection's
                 # authenticated principal; spoofed src names (a client
                 # key claiming to be an osd/mon) get dropped here
                 from ..auth import entity_service
                 state = self._in_auth.get(s) or {}
-                if entity_service(msg.src) != \
+                src = msg.src if isinstance(msg.src, str) else ""
+                if entity_service(src) != \
                         entity_service(state.get("entity", "")):
                     self.auth_rejects += 1
                     self.dropped += 1
@@ -501,6 +752,12 @@ class TcpNetwork(Network):
             # enqueue like a local delivery (fault injection still applies)
             self.queue.append((msg.src, dst, msg))
             n += 1
+        if ack_entity is not None:
+            try:
+                s.sendall(_HDR.pack(8, _ACK_DLEN, 0)
+                          + struct.pack("<Q", self._sess_rx[ack_entity]))
+            except OSError:
+                pass
         return n
 
     # ---- pumping -----------------------------------------------------------
@@ -512,6 +769,7 @@ class TcpNetwork(Network):
         t_end = time.monotonic() + deadline
         idle_since = None
         while time.monotonic() < t_end:
+            self._flush_lossless()
             moved = super().pump(max_msgs)
             moved += self._poll_sockets(0.005)
             total += moved

@@ -50,7 +50,7 @@ def mon_main(args) -> None:
         auth = TcpAuth("mon", args.keyring, kdc=True)
     net = TcpNetwork(("127.0.0.1", args.port),
                      {k: tuple(v) for k, v in directory.items()},
-                     auth=auth)
+                     auth=auth, entity="mon")
     mon = Monitor(net, name="mon")
     if args.down_out_interval:
         mon.down_out_interval = args.down_out_interval
@@ -97,7 +97,7 @@ def osd_main(args) -> None:
         auth = TcpAuth(f"osd.{args.id}", args.keyring)
     net = TcpNetwork(("127.0.0.1", args.port),
                      {k: tuple(v) for k, v in directory.items()},
-                     auth=auth)
+                     auth=auth, entity=f"osd.{args.id}")
     if auth is not None:
         # fetch tickets + rotating keys BEFORE serving, so inbound
         # authorizers (peer OSDs, the mon) can be verified from boot

@@ -179,6 +179,19 @@ def test_kdc_challenge_table_bounded():
     assert len(server._challenges) == 1
 
 
+def test_client_knows_when_to_renew():
+    """Tickets carry a client-readable expiry; needs_renewal() trips
+    RENEW_MARGIN early so reconnects re-run the KDC exchange instead
+    of retrying an expired ticket forever."""
+    kr, _ = _kdc_pair()
+    server = CephxServer(kr, ticket_ttl=120.0)
+    cl = _login(server, "osd.0", kr.get("osd.0"))
+    now = time.time()
+    assert not cl.needs_renewal(now=now)
+    assert cl.needs_renewal(now=now + 61.0)     # inside the margin
+    assert CephxClient("osd.1", os.urandom(16)).needs_renewal()
+
+
 def test_ticket_expiry_and_rotation():
     kr, _ = _kdc_pair()
     server = CephxServer(kr, ticket_ttl=10.0)
