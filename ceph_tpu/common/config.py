@@ -100,6 +100,10 @@ def build_options() -> List[Option]:
         .set_description("worker threads draining the sharded op queue "
                          "(reference osd_op_num_threads_per_shard x "
                          "shards; 0 = drain synchronously)"),
+        Option("osd_op_queue_mclock_wall", OPT_BOOL).set_default(False)
+        .set_description("enforce mclock reservation/limit in ops per "
+                         "REAL second (src/dmclock role) instead of "
+                         "the deterministic virtual clock"),
         Option("tracing_kernels", OPT_BOOL).set_default(False)
         .set_description("time every device kernel dispatch (adds a "
                          "sync per call; diagnosis only)"),

@@ -127,17 +127,184 @@ class MClockQueue:
         return self._w_tags.get(c, 0.0) >= self._now * lim / 1000.0
 
 
+class WallMClockQueue:
+    """dmclock against WALL time — a real rate enforcer, not just an
+    ordering arbiter (src/dmclock dmc::PriorityQueue semantics).
+
+    Tags are per-class times in seconds: the reservation tag is when
+    the class's next guaranteed-credit falls due (1/res apart), the
+    limit tag is when it is next allowed a weight-phase dequeue (1/lim
+    apart).  ``dequeue(now)``:
+
+    - reservation phase: any class whose reservation tag <= now is owed
+      service; most-overdue first.  Floors are therefore honored in
+      real ops/sec, and an idle class cannot hoard credit (tags clamp
+      to now on idle->active, dmclock's tag re-clamping).
+    - weight phase: among classes under their limit (limit tag <= now),
+      lowest virtual finish tag wins; serving pushes the limit tag
+      forward by 1/lim, so a class can NEVER exceed limit ops/sec over
+      any window, even on an otherwise idle OSD.
+    - neither ready: returns (None, next_due) so the caller can sleep
+      until credit accrues instead of spinning.
+
+    (res, weight, limit) keep the DEFAULT_TAGS shapes but are read as
+    ops per REAL second here.
+    """
+
+    def __init__(self, tags: Optional[Dict[str, Tuple[float, float,
+                                                      float]]] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        import time as _time
+        self.tags = dict(tags or DEFAULT_TAGS)
+        self.clock = clock or _time.monotonic
+        self._queues: Dict[str, Deque] = {}
+        self._r_next: Dict[str, float] = {}   # next reservation due
+        self._l_next: Dict[str, float] = {}   # next limit-allowed slot
+        self._w_tags: Dict[str, float] = {}   # virtual weight finish
+        self._w_floor = 0.0                   # last served finish tag
+        self._size = 0
+
+    def enqueue(self, op_class: str, item) -> None:
+        if op_class not in self.tags:
+            op_class = CLASS_CLIENT
+        q = self._queues.setdefault(op_class, deque())
+        if not q:
+            now = self.clock()
+            # idle -> active: no hoarded reservation credit, no limit
+            # debt from the idle past
+            self._r_next[op_class] = max(
+                self._r_next.get(op_class, 0.0), now)
+            self._l_next[op_class] = max(
+                self._l_next.get(op_class, 0.0), now)
+            # clamp the weight tag to the virtual present: a fresh
+            # class entering an EMPTY queue starts at the last served
+            # finish tag (not 0, which would starve any class with
+            # history), and a returning class starts no better than
+            # the most-behind active class
+            active = [c for c, aq in self._queues.items() if aq]
+            floor = min((self._w_tags.get(c, 0.0) for c in active),
+                        default=self._w_floor)
+            self._w_tags[op_class] = max(
+                self._w_tags.get(op_class, 0.0), floor)
+        q.append(item)
+        self._size += 1
+
+    def __len__(self) -> int:
+        return self._size
+
+    def dequeue(self, now: Optional[float] = None):
+        """-> (item, 0.0) or (None, next_due_time); next_due is 0.0
+        when the queue is empty."""
+        now = self.clock() if now is None else now
+        candidates = [c for c, q in self._queues.items() if q]
+        if not candidates:
+            return None, 0.0
+        # ---- reservation phase (floors) --------------------------------
+        best, best_overdue = None, 0.0
+        for c in candidates:
+            res = self.tags[c][0]
+            if res <= 0:
+                continue
+            overdue = now - self._r_next.get(c, 0.0)
+            if overdue >= 0 and (best is None or overdue > best_overdue):
+                best, best_overdue = c, overdue
+        if best is not None:
+            return self._serve(best, now, reserved=True), 0.0
+        # ---- weight phase (shares under ceilings) ----------------------
+        under = [c for c in candidates
+                 if self.tags[c][2] <= 0
+                 or self._l_next.get(c, 0.0) <= now]
+        if under:
+            best = min(under, key=lambda c: self._w_tags.get(c, 0.0))
+            return self._serve(best, now, reserved=False), 0.0
+        # everyone is rate-blocked: report when the earliest credit
+        # (reservation or limit slot) falls due
+        nxt = min(min((self._r_next.get(c, now) for c in candidates
+                       if self.tags[c][0] > 0), default=float("inf")),
+                  min(self._l_next.get(c, now) for c in candidates))
+        return None, nxt
+
+    def _serve(self, c: str, now: float, reserved: bool):
+        item = self._queues[c].popleft()
+        self._size -= 1
+        res, weight, lim = self.tags[c]
+        if res > 0:
+            # served work counts toward the floor whatever phase it
+            # used (dmclock advances the reservation tag on any serve)
+            self._r_next[c] = max(self._r_next.get(c, 0.0), now) \
+                + (1.0 / res)
+        if lim > 0:
+            self._l_next[c] = max(self._l_next.get(c, 0.0), now) \
+                + (1.0 / lim)
+        self._w_tags[c] = self._w_tags.get(c, 0.0) \
+            + 1.0 / max(weight, 1e-9)
+        self._w_floor = self._w_tags[c]
+        return item
+
+    def has_ready(self, now: Optional[float] = None) -> bool:
+        """True when some queued op is dispatchable right now (not
+        rate-blocked) — the drain/flush boundary must not wait out the
+        rate limiter itself."""
+        now = self.clock() if now is None else now
+        for c, q in self._queues.items():
+            if not q:
+                continue
+            res, _w, lim = self.tags[c]
+            if res > 0 and self._r_next.get(c, 0.0) <= now:
+                return True
+            if lim <= 0 or self._l_next.get(c, 0.0) <= now:
+                return True
+        return False
+
+    def dump(self) -> Dict:
+        return {
+            "queued": {c: len(q) for c, q in self._queues.items() if q},
+            "mode": "wall",
+            "r_next": dict(self._r_next),
+            "l_next": dict(self._l_next),
+            "w_tags": dict(self._w_tags),
+        }
+
+
 class ShardedOpWQ:
     """PG-sharded front queues feeding per-shard mClock arbiters."""
 
     def __init__(self, n_shards: int = 5,
-                 tags: Optional[Dict] = None):
+                 tags: Optional[Dict] = None, wall: bool = False):
         self.n_shards = n_shards
-        self.shards: List[MClockQueue] = [MClockQueue(tags)
-                                          for _ in range(n_shards)]
+        self.wall = wall
+        cls = WallMClockQueue if wall else MClockQueue
+        self.shards: List = [cls(tags) for _ in range(n_shards)]
         # one PG's ops must stay FIFO: the shard index is a pure
         # function of the pgid (OSD.cc shard = pgid.hash % num_shards)
         self._rr = 0
+
+    def _deq(self, shard):
+        """Uniform dequeue across clock modes; wall mode records when
+        the next rate credit falls due so drainers can sleep exactly
+        that long instead of a fixed poll interval."""
+        if not self.wall:
+            return shard.dequeue()
+        item, nxt = shard.dequeue()
+        if item is None and nxt:
+            cur = getattr(self, "next_due", 0.0)
+            self.next_due = nxt if not cur else min(cur, nxt)
+        return item
+
+    def take_next_due(self) -> float:
+        """Earliest rate-credit time seen since the last call (0 =
+        none); wall mode only."""
+        nd = getattr(self, "next_due", 0.0)
+        self.next_due = 0.0
+        return nd
+
+    def ready(self) -> bool:
+        """Is there work dispatchable NOW?  In wall mode rate-blocked
+        ops don't count: flush()/drain boundaries must not block on the
+        rate limiter's schedule."""
+        if not self.wall:
+            return len(self) > 0
+        return any(sh.has_ready() for sh in self.shards)
 
     def shard_of(self, pgid: Tuple[int, int]) -> int:
         return hash(pgid) % self.n_shards
@@ -171,7 +338,7 @@ class ShardedOpWQ:
                 break
             shard = self.shards[self._rr]
             self._rr = (self._rr + 1) % self.n_shards
-            item = shard.dequeue()
+            item = self._deq(shard)
             if item is None:
                 idle_rounds += 1
                 continue
@@ -225,13 +392,21 @@ class ShardedThreadPool:
                     if self._stopping:
                         return
                     for s in shards:
-                        item = self.wq.shards[s].dequeue()
+                        item = self.wq._deq(self.wq.shards[s])
                         if item is not None:
                             break
                     if item is not None:
                         self._active += 1
                         break
-                    self._cv.wait(timeout=0.05)
+                    timeout = 0.05
+                    if self.wq.wall:
+                        nd = self.wq.take_next_due()
+                        if nd:
+                            import time as _time
+                            timeout = max(0.001,
+                                          min(0.05,
+                                              nd - _time.monotonic()))
+                    self._cv.wait(timeout=timeout)
             try:
                 self.handler(item)
             except Exception:
@@ -259,11 +434,11 @@ class ShardedThreadPool:
         end = _time.monotonic() + timeout
         with self._cv:
             self._cv.notify_all()
-            while (len(self.wq) or self._active) and \
+            while (self.wq.ready() or self._active) and \
                     _time.monotonic() < end:
                 self._cv.wait(timeout=0.05)
                 self._cv.notify_all()
-        if len(self.wq) or self._active:
+        if self.wq.ready() or self._active:
             raise TimeoutError("op thread pool failed to drain")
 
     def stop(self) -> None:

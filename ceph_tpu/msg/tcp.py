@@ -290,7 +290,11 @@ class TcpNetwork(Network):
             if addr is None:
                 continue
             addr = tuple(addr)
-            if self._conns.get(addr) is None:
+            # resend when disconnected OR when the live socket never
+            # ran this session's hello/resend (e.g. a fresh connection
+            # made for ticket renewal replaced the session socket)
+            if self._conns.get(addr) is not tx.get("sock") or \
+                    tx.get("sock") is None:
                 self._flush_dst(dst, addr)
 
     def _peer(self, addr: Tuple[str, int],
@@ -738,8 +742,7 @@ class TcpNetwork(Network):
                 # key claiming to be an osd/mon) get dropped here
                 from ..auth import entity_service
                 state = self._in_auth.get(s) or {}
-                src = msg.src if isinstance(msg.src, str) else ""
-                if entity_service(src) != \
+                if entity_service(msg.src) != \
                         entity_service(state.get("entity", "")):
                     self.auth_rejects += 1
                     self.dropped += 1

@@ -98,11 +98,12 @@ class OSD(Dispatcher):
         self.op_tracker = OpTracker()
         self._tracked: Dict[Tuple[str, int], object] = {}
         self._recovery_queue: List[PG] = []
-        self.op_wq = ShardedOpWQ()
+        from ..common.config import g_conf
+        self.op_wq = ShardedOpWQ(
+            wall=bool(g_conf.get_val("osd_op_queue_mclock_wall")))
         # threaded drain (osd_op_tp, OSD.cc:2008): workers take the
         # target PG's lock around each op, like dequeue_op does — real
         # concurrency across shards, lockdep live on the hot path
-        from ..common.config import g_conf
         self.op_tp = None
         n_threads = int(g_conf.get_val("osd_op_num_threads") or 0)
         if n_threads > 0:

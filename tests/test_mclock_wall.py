@@ -1,0 +1,213 @@
+"""Wall-clock dmClock: real IOPS floors and ceilings.
+
+The reference's mclock scheduler enforces (reservation, weight, limit)
+against wall time via src/dmclock — a limit is a hard ops-per-real-
+second ceiling and a reservation is a floor the class achieves under
+load.  The deterministic virtual-clock arbiter (MClockQueue) decides
+only ORDER; WallMClockQueue is the rate enforcer.  Deterministic tests
+drive it with a fake clock; one timing test proves enforcement under
+the real thread pool.
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.common.work_queue import (
+    CLASS_CLIENT, CLASS_RECOVERY, CLASS_SCRUB, ShardedOpWQ,
+    ShardedThreadPool, WallMClockQueue,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_limit_is_a_hard_ceiling_over_any_window():
+    """limit=100/s: no window of 1 fake second may serve more than
+    ~101 ops (the t=0 op plus 100 credits), however hungry the
+    drainer."""
+    clk = FakeClock()
+    q = WallMClockQueue(tags={CLASS_SCRUB: (0.0, 1.0, 100.0)},
+                        clock=clk)
+    for i in range(500):
+        q.enqueue(CLASS_SCRUB, i)
+    served = []
+    # greedy drain loop: take everything the scheduler allows, advance
+    # time only when told to wait
+    while q and clk.t <= 1.0:
+        item, nxt = q.dequeue()
+        if item is not None:
+            served.append((clk.t, item))
+        else:
+            assert nxt > clk.t
+            clk.t = nxt
+    assert len(served) <= 101
+    assert len(served) >= 95            # and the credits ARE usable
+
+
+def test_reservation_floor_under_competing_load():
+    """client has 1000x recovery's weight, but recovery's 50/s floor
+    must still be met in real time."""
+    clk = FakeClock()
+    q = WallMClockQueue(tags={
+        CLASS_CLIENT: (0.0, 1000.0, 0.0),
+        CLASS_RECOVERY: (50.0, 1.0, 0.0),
+    }, clock=clk)
+    for i in range(2000):
+        q.enqueue(CLASS_CLIENT, ("c", i))
+        q.enqueue(CLASS_RECOVERY, ("r", i))
+    # a drainer with 1000 ops/s of capacity (1 ms per dequeue)
+    got = {"c": 0, "r": 0}
+    while clk.t < 1.0:
+        item, _nxt = q.dequeue()
+        if item is not None:
+            got[item[0]] += 1
+        clk.t += 0.001
+    # recovery achieves its floor (50/s) but little more (weight 1 vs
+    # 1000 hands the rest to clients)
+    assert got["r"] >= 45
+    assert got["r"] <= 80
+    assert got["c"] >= 850
+
+
+def test_idle_class_cannot_hoard_reservation_credit():
+    """A class idle for 10 fake seconds must NOT burst 10s x res ops
+    when it wakes (dmclock tag re-clamping)."""
+    clk = FakeClock()
+    q = WallMClockQueue(tags={
+        CLASS_CLIENT: (0.0, 100.0, 0.0),
+        CLASS_RECOVERY: (100.0, 1.0, 0.0),
+    }, clock=clk)
+    q.enqueue(CLASS_CLIENT, "warm")
+    q.dequeue()
+    clk.t = 10.0                         # recovery idle this whole time
+    for i in range(2000):
+        q.enqueue(CLASS_CLIENT, ("c", i))
+        q.enqueue(CLASS_RECOVERY, ("r", i))
+    got = {"c": 0, "r": 0}
+    t_end = clk.t + 0.5
+    while clk.t < t_end:
+        item, _ = q.dequeue()
+        if item is not None:
+            got[item[0]] += 1
+        clk.t += 0.001
+    # 0.5 s at res=100/s -> ~50 reserved ops, NOT 1000+ banked ones
+    assert got["r"] <= 70
+    assert got["r"] >= 40
+
+
+def test_no_starvation_after_idle_period():
+    """A class with heavy past work must compete fairly when it
+    reactivates against a class that was idle through that work: the
+    weight clamp pins newcomers to the last served finish tag."""
+    clk = FakeClock()
+    q = WallMClockQueue(tags={
+        CLASS_CLIENT: (0.0, 1.0, 0.0),
+        CLASS_SCRUB: (0.0, 1.0, 0.0),
+    }, clock=clk)
+    for i in range(10000):                   # client works alone
+        q.enqueue(CLASS_CLIENT, ("c", i))
+    while len(q):
+        q.dequeue()
+        clk.t += 0.0001
+    # full idle, then both classes return with equal weight
+    got = {"c": 0, "s": 0}
+    for i in range(1000):
+        q.enqueue(CLASS_SCRUB, ("s", i))
+        q.enqueue(CLASS_CLIENT, ("c", i))
+    for _ in range(1000):
+        item, _ = q.dequeue()
+        if item is not None:
+            got[item[0]] += 1
+        clk.t += 0.001
+    assert abs(got["c"] - got["s"]) <= 2, got
+
+
+def test_flush_does_not_wait_out_the_rate_limiter():
+    """flush() blocks for dispatchable work only: a big rate-blocked
+    backlog must not stall (or TimeoutError) the flush boundary the
+    op-dispatch path runs on."""
+    wq = ShardedOpWQ(n_shards=1, wall=True, tags={
+        CLASS_CLIENT: (0.0, 100.0, 0.0),
+        CLASS_SCRUB: (0.0, 1.0, 10.0),       # 10/s ceiling
+    })
+    pool = ShardedThreadPool(wq, lambda it: None, n_threads=2)
+    try:
+        for i in range(600):                 # a minute of backlog
+            wq.enqueue((1, 0), CLASS_SCRUB, i)
+        t0 = time.monotonic()
+        pool.flush(timeout=30.0)             # must NOT take ~60s
+        assert time.monotonic() - t0 < 5.0
+        assert len(wq) > 500                 # backlog still queued
+    finally:
+        pool.stop()
+
+
+def test_wall_limit_enforced_under_real_thread_pool():
+    """The threaded drain obeys the ceiling in actual wall time: 60
+    limited ops at 100/s must take >= ~0.5 s; unlimited client ops
+    drain orders of magnitude faster."""
+    wq = ShardedOpWQ(n_shards=1, wall=True, tags={
+        CLASS_CLIENT: (0.0, 100.0, 0.0),
+        CLASS_SCRUB: (0.0, 1.0, 100.0),
+    })
+    stamps = []
+    pool = ShardedThreadPool(wq, lambda it: stamps.append(
+        (time.monotonic(), it)), n_threads=2)
+    try:
+        t0 = time.monotonic()
+        for i in range(60):
+            wq.enqueue((1, 0), CLASS_SCRUB, ("s", i))
+        pool.kick()
+        # flush() deliberately does NOT wait out the rate limiter
+        # (rate-blocked ops are not "ready"), so wait for delivery
+        end = time.monotonic() + 30.0
+        while len(stamps) < 60 and time.monotonic() < end:
+            time.sleep(0.01)
+        elapsed = time.monotonic() - t0
+        assert len(stamps) == 60
+        # 59 credit intervals at 10 ms each, minus scheduling slop
+        assert elapsed >= 0.45, f"ceiling not enforced: {elapsed:.3f}s"
+        # sanity: unlimited class is not throttled by the machinery,
+        # and flush() blocks for ready work exactly as before
+        stamps.clear()
+        t0 = time.monotonic()
+        for i in range(200):
+            wq.enqueue((1, 0), CLASS_CLIENT, ("c", i))
+        pool.kick()
+        pool.flush(timeout=30.0)
+        assert time.monotonic() - t0 < 2.0
+        assert len(stamps) == 200
+    finally:
+        pool.stop()
+
+
+@pytest.fixture
+def wall_conf():
+    g_conf.set_val("osd_op_queue_mclock_wall", True)
+    g_conf.set_val("osd_op_num_threads", 2)
+    yield
+    g_conf.set_val("osd_op_num_threads", 0)
+    g_conf.set_val("osd_op_queue_mclock_wall", False)
+
+
+def test_cluster_runs_with_wall_mclock(wall_conf):
+    """End-to-end: a cluster whose OSDs enforce wall-clock QoS still
+    serves EC writes/reads correctly."""
+    import numpy as np
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=5)
+    c.create_ec_pool("p", k=3, m=2, pg_num=8)
+    assert all(o.op_wq.wall for o in c.osds.values())
+    cl = c.client()
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, 20000, dtype=np.uint8).tobytes()
+    assert cl.write_full("p", "obj", data) == 0
+    assert cl.read("p", "obj") == data
