@@ -54,6 +54,9 @@ class Monitor(Dispatcher):
         self.incrementals: List[Incremental] = []
         self.subscribers: List[str] = []
         self._topology_dirty = False  # crush/pools changed since last epoch
+        # pg_temp pins primed by placement changes, folded into the
+        # next topology publish (snapshot incs don't carry pg_temp)
+        self._primed_pg_temp: Dict = {}
         # failure reports per target (mon_osd_min_down_reporters=2 —
         # a single partitioned reporter can't take the cluster down)
         self._failure_reports: Dict[int, set] = {}
@@ -526,6 +529,55 @@ class Monitor(Dispatcher):
         self._topology_dirty = True
         return self.osdmap.add_pool(name, pool)
 
+    def set_pool_pg_num(self, name: str, pg_num: int) -> None:
+        """Grow a pool's pg_num (PG splitting; OSDMonitor 'osd pool set
+        pg_num').  pgp_num is left alone so children colocate with
+        their parents (placement uses pgp_num) — raise pgp_num
+        afterwards to actually spread them, like the reference."""
+        pid = self.osdmap.lookup_pg_pool_name(name)
+        if pid < 0:
+            raise KeyError(f"no pool named {name!r}")
+        pool = self.osdmap.pools[pid]
+        if pg_num < pool.pg_num:
+            raise ValueError("pg_num can only grow (no PG merging)")
+        pool.set_pg_num(pg_num)
+        self._topology_dirty = True
+
+    def set_pool_pgp_num(self, name: str, pgp_num: int) -> None:
+        """Spread split children to their own CRUSH positions
+        (OSDMonitor 'osd pool set pgp_num'); bounded by pg_num.
+
+        Placement changes are PRIMED (OSDMonitor::maybe_prime_pg_temp):
+        every PG whose acting set would move to different OSDs gets
+        pg_temp pinned to its OLD acting, so the data-bearing members
+        keep serving while the realign machinery copies shards to the
+        new CRUSH positions and then drops the pin — without this, a
+        PG remapped to entirely fresh OSDs has no acting member holding
+        its data and reads go EIO forever."""
+        from ..crush.constants import CRUSH_ITEM_NONE
+        from ..osdmap import pg_t as _pg_t
+        pid = self.osdmap.lookup_pg_pool_name(name)
+        if pid < 0:
+            raise KeyError(f"no pool named {name!r}")
+        pool = self.osdmap.pools[pid]
+        if pgp_num > pool.pg_num:
+            raise ValueError("pgp_num cannot exceed pg_num")
+        old_acting = {}
+        for ps in range(pool.pg_num):
+            pg = _pg_t(pid, ps)
+            if pg not in self.osdmap.pg_temp:   # existing pins win
+                old_acting[ps] = list(
+                    self.osdmap.pg_to_up_acting_osds(pg)[2])
+        pool.set_pgp_num(pgp_num)
+        for ps, olda in old_acting.items():
+            pg = _pg_t(pid, ps)
+            newa = list(self.osdmap.pg_to_up_acting_osds(pg)[2])
+            if newa != olda and \
+                    any(o != CRUSH_ITEM_NONE for o in olda):
+                self.osdmap.pg_temp[pg] = [int(o) for o in olda]
+                self._primed_pg_temp[pg] = [int(o) for o in olda]
+        self._topology_dirty = True
+
     def create_ec_profile(self, name: str, profile: Dict[str, str]) -> None:
         # instantiating validates the profile (OSDMonitor get_erasure_code)
         create_erasure_code(dict(profile))
@@ -676,6 +728,9 @@ class Monitor(Dispatcher):
                 inc.new_pg_upmap_items.update(src.new_pg_upmap_items)
                 inc.old_pg_upmap.extend(src.old_pg_upmap)
                 inc.old_pg_upmap_items.extend(src.old_pg_upmap_items)
+            if self._primed_pg_temp:
+                inc.new_pg_temp.update(self._primed_pg_temp)
+                self._primed_pg_temp = {}
             self._topology_dirty = False
             topology = True
         else:

@@ -492,17 +492,21 @@ class ECBackend:
     def push_chunks(self, oid: str, shard_data: Dict[int, bytes],
                     size: int, on_done: Callable[[], None],
                     version: int = 0,
-                    xattrs: Optional[Dict[str, bytes]] = None) -> int:
+                    xattrs: Optional[Dict[str, bytes]] = None,
+                    targets: Optional[Dict[int, int]] = None) -> int:
         """Recovery push: whole-shard writes to specific shards only
         (RecoveryOp pushes, ECBackend.cc:535-743).  is_push: the
         replica's log already carries the entries (activation), but the
         object's version attr must be stamped so staleness checks see
         current data.  ``xattrs`` restores the object's user attrs on
-        the rebuilt shard (the reference pushes attrs with the chunks)."""
+        the rebuilt shard (the reference pushes attrs with the chunks).
+        ``targets`` overrides the shard->osd destinations (realign
+        pushes go to UP members that are not acting yet)."""
         tid = self.next_tid()
         wr = InflightWrite(tid=tid, oid=oid, client_reply=lambda _r: None,
                            on_all_commit=on_done)
-        acting = self.pg.acting_shards()
+        acting = targets if targets is not None \
+            else self.pg.acting_shards()
         for shard, chunk in shard_data.items():
             if shard not in acting:
                 continue

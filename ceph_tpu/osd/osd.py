@@ -112,7 +112,11 @@ class OSD(Dispatcher):
                                            self._wq_handle_locked,
                                            n_threads)
         self._rep_pulls: Dict[int, Callable] = {}
-        self._pull_tid = 0
+        # OSD-level tids (_rep_pulls, recovery probes, realign pushes)
+        # live in a range disjoint from every per-PG backend counter
+        # (which starts at 1): a probe reply must never be claimable
+        # by — or hijack — a PG's own inflight read with the same tid
+        self._pull_tid = 1 << 32
         # tier ops this OSD issued as a client of the base pool
         # (promote reads / flush writes): tid -> reply callback.
         # Allocated/consumed from worker threads holding only a PG
@@ -167,6 +171,10 @@ class OSD(Dispatcher):
             pg = self.pgs.get(msg.pgid)
             if pg is not None and pg.backend is not None:
                 pg.backend.handle_sub_write_reply(msg)
+            elif pg is not None:
+                ack = getattr(pg, "_rep_realign_ack", None)
+                if ack is not None:
+                    ack(msg.tid)
         elif isinstance(msg, MOSDECSubOpRead):
             self._handle_sub_read(msg)
         elif isinstance(msg, MOSDECSubOpReadReply):
@@ -252,19 +260,42 @@ class OSD(Dispatcher):
                                          epoch=self.osdmap.epoch), mon)
                 self._consume_map()
 
+    def next_pull_tid(self) -> int:
+        """OSD-level tid (disjoint from per-PG backend counters)."""
+        self._pull_tid += 1
+        return self._pull_tid
+
+    def get_or_create_pg(self, pg_id: Tuple[int, int]) -> PG:
+        if pg_id not in self.pgs:
+            self.pgs[pg_id] = PG(self, pg_id,
+                                 self.osdmap.pools[pg_id[0]])
+        return self.pgs[pg_id]
+
     def _consume_map(self) -> None:
-        # instantiate PGs this osd serves; advance all
+        # instantiate PGs this osd serves
         for pool_id, pool in self.osdmap.pools.items():
             for ps in range(pool.pg_num):
                 pg_id = (pool_id, ps)
                 up, upp, acting, actp = self.osdmap.pg_to_up_acting_osds(
                     pg_t(pool_id, ps))
-                member = self.osd_id in [o for o in acting
+                # up-but-not-acting members (pg_temp pinned elsewhere)
+                # must exist too: they receive the realign/backfill
+                # pushes that let the pin clear
+                member = self.osd_id in [o for o in list(acting) +
+                                         list(up)
                                          if o != CRUSH_ITEM_NONE]
-                if member and pg_id not in self.pgs:
-                    self.pgs[pg_id] = PG(self, pg_id, pool)
-                if pg_id in self.pgs:
-                    self.pgs[pg_id].advance_map(self.osdmap)
+                if member:
+                    self.get_or_create_pg(pg_id)
+        # pg_num grew past a local PG's recorded layout: split its
+        # local objects into the children (OSD::split_pgs) before any
+        # PG advances into the new epoch
+        for pg_id, pg in list(self.pgs.items()):
+            pool = self.osdmap.pools.get(pg_id[0])
+            if pool is not None and pg.known_pg_num < pool.pg_num:
+                pg.split_children()
+        # advance all (children included)
+        for pg_id in list(self.pgs):
+            self.pgs[pg_id].advance_map(self.osdmap)
 
     # ---- client ops -------------------------------------------------------
     def _handle_op(self, msg: MOSDOp) -> None:
@@ -346,6 +377,11 @@ class OSD(Dispatcher):
             # replicated full-copy write
             if pg is not None and pg.rep_backend is not None:
                 pg.rep_backend.apply_write(msg, self.store)
+                if msg.is_push and msg.tid:
+                    # realign pushes are acked so the sender clears
+                    # the pg_temp pin only once the copy is durable
+                    self.messenger.send_message(MOSDECSubOpWriteReply(
+                        tid=msg.tid, pgid=msg.pgid, shard=-1), msg.src)
             return
         if pg is not None and pg.backend is not None:
             reply = pg.backend.handle_sub_write(msg, self.store, pg=pg)
@@ -423,6 +459,11 @@ class OSD(Dispatcher):
                 MOSDPing(op=MOSDPing.PING, stamp=now,
                          epoch=self.osdmap.epoch), f"osd.{peer}")
         self.maybe_schedule_scrubs()
+        if self.op_tp is None and self.op_wq.wall and len(self.op_wq):
+            # synchronous wall-clock mode: rate-blocked ops queued with
+            # no worker threads must be re-driven from the tick, or a
+            # pause in client traffic strands them forever
+            self.drain_ops()
         for pg in self.pgs.values():
             if pg._notifies:
                 pg.sweep_notifies()
@@ -594,6 +635,57 @@ class OSD(Dispatcher):
         be = pg.backend
         needed = sorted(s for s, (_v, op) in targets.items()
                         if op != OP_DELETE)
+        # probe phase: a "missing" peer may already hold the object at
+        # the target version — the primary's log-delta cannot see data
+        # that landed ahead of the log entries (realign pushes,
+        # interrupted prior recoveries).  A version-matching reply
+        # settles the debt without moving bytes; mismatches fall
+        # through to the decode+push path.
+        from .pg_log import VERSION_ATTR
+        acting = pg.acting_shards()
+        probes = [s for s in needed
+                  if s in acting and self.osdmap.is_up(acting[s])]
+        state = {"left": len(probes)}
+
+        def after_probes() -> None:
+            remaining = sorted(s for s in needed
+                               if oid in pg.missing.get(s, {}))
+            if not remaining:
+                for s in needed:
+                    if not pg.missing.get(s):
+                        pg.send_backfill_complete(s)
+                pg.recovery_done_for(oid)
+                pg._maybe_clean()
+                return
+            self._recover_ec_oid_push(pg, oid, targets, remaining)
+
+        if not probes:
+            self._recover_ec_oid_push(pg, oid, targets, needed)
+            return
+        for s in probes:
+            v_expect = targets[s][0]
+            self._pull_tid += 1
+            tid = self._pull_tid
+
+            def on_probe(reply, s=s, v_expect=v_expect) -> None:
+                vb = reply.attrs.get(VERSION_ATTR) \
+                    if reply.result == 0 and reply.oid == oid \
+                    and reply.shard == s else None
+                if vb is not None and \
+                        struct.unpack("<Q", vb)[0] >= v_expect:
+                    pg.missing.get(s, {}).pop(oid, None)
+                state["left"] -= 1
+                if state["left"] == 0:
+                    after_probes()
+            self._rep_pulls[tid] = on_probe
+            pg.send_to_osd(acting[s], MOSDECSubOpRead(
+                tid=tid, pgid=pg.pgid, shard=s, oid=oid,
+                attrs_only=True))
+
+    def _recover_ec_oid_push(self, pg: PG, oid: str,
+                             targets: Dict[int, Tuple[int, str]],
+                             needed) -> None:
+        be = pg.backend
 
         def on_chunks(result: int, chunks: Dict[int, bytes],
                       size: int, attrs: Dict[str, bytes]) -> None:

@@ -56,6 +56,8 @@ from .pg_log import (
     load_snapsets, stage_snapset,
 )
 
+PG_NUM_ATTR = "_pg_num"          # pg_num this PG's store layout reflects
+
 STATE_INITIAL = "initial"
 STATE_PEERING = "peering"
 STATE_ACTIVE = "active"
@@ -192,6 +194,15 @@ class PG:
         # log + versions (one per PG replica; persists in the meta coll)
         self.pg_log = PGLog()
         self.pg_log.load(osd.store, self.meta_cid())
+        # pg_num this replica's layout reflects: from disk if recorded
+        # (restart case — may lag the map, triggering a catch-up
+        # split), else the pool's current value, persisted now so a
+        # restart straddling a future split epoch can't miss it
+        stored = self.stored_pg_num()
+        if stored:
+            self.known_pg_num = stored
+        else:
+            self.record_pg_num(pool.pg_num)
         self._version_alloc = self.pg_log.head
         # replica-side: objects whose log entries arrived (activation)
         # but whose data has not (pg_missing_t role) — rebuilt from
@@ -220,6 +231,176 @@ class PG:
         self._recovering: Set[str] = set()
         self._recovering_since: Dict[str, float] = {}
         self._waiting_for_recovery: Dict[str, List[Callable[[], None]]] = {}
+
+    # ---- pg splitting (OSD::split_pgs / PG::split_into) -------------------
+    def stored_pg_num(self) -> int:
+        """pg_num this replica's on-disk layout reflects (0 = never
+        recorded); lets a restarted OSD catch up on splits it missed."""
+        store = self.osd.store
+        cid = self.meta_cid()
+        meta = hobject_t(PG_META_OID)
+        if store.collection_exists(cid) and store.exists(cid, meta):
+            b = store.getattrs(cid, meta).get(PG_NUM_ATTR)
+            if b:
+                return struct.unpack("<I", b)[0]
+        return 0
+
+    def record_pg_num(self, n: int,
+                      t: Optional[Transaction] = None) -> None:
+        self.known_pg_num = n
+        own = t is None
+        if own:
+            t = Transaction()
+        cid = self.ensure_meta_collection(t)
+        meta = hobject_t(PG_META_OID)
+        t.touch(cid, meta)
+        t.setattr(cid, meta, PG_NUM_ATTR, struct.pack("<I", n))
+        if own:
+            self.osd.store.queue_transaction(t)
+
+    @staticmethod
+    def _head_of(oid: str) -> str:
+        """Snap clones hash (and therefore split) with their head."""
+        return oid.split("\x00snap\x00", 1)[0]
+
+    def split_children(self) -> None:
+        """Split this PG's local shard data into its children after a
+        pg_num increase (ceph_stable_mod keeps parent ps stable, so
+        only objects whose hash lands in a child ps move).  Runs
+        identically on every replica; with pgp_num unchanged the
+        children map to the SAME acting set as the parent
+        (raw_pg_to_pps uses pgp_num), so the split is purely local —
+        a later pgp_num increase migrates children through the normal
+        peering/backfill machinery.  Mirrors OSD::split_pgs +
+        PG::split_into + PGLog::split_into.
+        """
+        pool_id, ps = self.pgid
+        pool = self.osd.osdmap.pools.get(pool_id)
+        if pool is None or pool.pg_num <= self.known_pg_num:
+            return
+        store = self.osd.store
+        new_num, new_mask = pool.pg_num, pool.pg_num_mask
+        from ..osdmap import ceph_stable_mod
+
+        def target_ps(oid: str) -> int:
+            return ceph_stable_mod(pool.hash_key(self._head_of(oid)),
+                                   new_num, new_mask)
+
+        # data collections: replicated "{pool}.{ps}", EC shards
+        # "{pool}.{ps}s{shard}" — children keep the shard suffix
+        suffixes: List[str] = []
+        base = f"{pool_id}.{ps}"
+        if self.backend is not None:
+            prefix = base + "s"
+            suffixes = [cid[len(base):] for cid in
+                        store.list_collections()
+                        if cid.startswith(prefix)]
+        elif store.collection_exists(base):
+            suffixes = [""]
+        t_parent = Transaction()
+        child_ts: Dict[int, Transaction] = {}
+        moved_oids: Dict[int, set] = {}
+
+        def child_t(cps: int) -> Transaction:
+            if cps not in child_ts:
+                child_ts[cps] = Transaction()
+                moved_oids[cps] = set()
+            return child_ts[cps]
+
+        for sfx in suffixes:
+            pcid = base + sfx
+            if not store.collection_exists(pcid):
+                continue
+            for ho in store.list_objects(pcid):
+                if ho.oid == PG_META_OID:
+                    continue
+                tps = target_ps(ho.oid)
+                if tps == ps:
+                    continue
+                tc = child_t(tps)
+                ccid = f"{pool_id}.{tps}{sfx}"
+                if not store.collection_exists(ccid):
+                    tc.create_collection(ccid)   # MKCOLL is idempotent
+                data = store.read(pcid, ho)
+                tc.touch(ccid, ho)
+                if data:
+                    tc.write(ccid, ho, 0, data)
+                for name, val in store.getattrs(pcid, ho).items():
+                    tc.setattr(ccid, ho, name, val)
+                omap = store.omap_get(pcid, ho)
+                if omap:
+                    tc.omap_setkeys(ccid, ho, dict(omap))
+                t_parent.remove(pcid, ho)
+                moved_oids[tps].add(ho.oid)
+        # meta: pg_log entries, snapsets, rollback stashes — split by
+        # oid ownership under the NEW pg_num (log entries can name
+        # deleted objects, so ownership comes from the hash, not the
+        # moved set)
+        pcid_meta = self.ensure_meta_collection(t_parent)
+        meta = hobject_t(PG_META_OID)
+        meta_omap = store.omap_get(pcid_meta, meta) \
+            if store.collection_exists(pcid_meta) and \
+            store.exists(pcid_meta, meta) else {}
+        children: List["PG"] = []
+        all_child_oids: Dict[int, set] = {}
+        for e in self.pg_log.entries:
+            tps = target_ps(e.oid)
+            if tps != ps:
+                all_child_oids.setdefault(tps, set()).add(e.oid)
+        for oid in list(self.snapsets):
+            tps = target_ps(oid)
+            if tps != ps:
+                all_child_oids.setdefault(tps, set()).add(oid)
+        for tps in set(all_child_oids) | set(moved_oids):
+            child = self.osd.get_or_create_pg((pool_id, tps))
+            children.append(child)
+            tc = child_t(tps)
+            ccid_meta = child.ensure_meta_collection(tc)
+            oids = all_child_oids.get(tps, set()) | moved_oids[tps]
+            self.pg_log.split_into(child.pg_log, oids, t_parent,
+                                   pcid_meta, tc, ccid_meta)
+            # snapset + rollback omap keys follow their oid
+            from .pg_log import ROLLBACK_KEY_PREFIX, SNAPSET_KEY_PREFIX
+            move_keys = {}
+            for k, v in meta_omap.items():
+                for pfx in (SNAPSET_KEY_PREFIX, ROLLBACK_KEY_PREFIX):
+                    if k.startswith(pfx) and \
+                            target_ps(k[len(pfx):]) == tps:
+                        move_keys[k] = v
+            if move_keys:
+                tc.touch(ccid_meta, meta)
+                tc.omap_setkeys(ccid_meta, meta, move_keys)
+                t_parent.omap_rmkeys(pcid_meta, meta,
+                                     list(move_keys))
+            # in-memory state follows
+            for oid in list(self.snapsets):
+                if target_ps(oid) == tps:
+                    child.snapsets[oid] = self.snapsets.pop(oid)
+            for oid in list(self.local_missing):
+                if target_ps(oid) == tps:
+                    child.local_missing[oid] = \
+                        self.local_missing.pop(oid)
+            for oid in list(self.watchers):
+                if target_ps(oid) == tps:
+                    child.watchers[oid] = self.watchers.pop(oid)
+            child._version_alloc = max(child._version_alloc,
+                                       child.pg_log.head)
+            child.record_pg_num(new_num, tc)
+            child.state = STATE_INITIAL
+        self.record_pg_num(new_num, t_parent)
+        self._version_alloc = max(self._version_alloc,
+                                  self.pg_log.head)
+        # children first: if we crash between transactions, objects
+        # exist in both collections and the recorded parent pg_num
+        # triggers a re-split that converges (moves are idempotent)
+        for tps, tc in child_ts.items():
+            store.queue_transaction(tc)
+        store.queue_transaction(t_parent)
+        self.state = STATE_INITIAL
+        dlog("pg", 3,
+             f"pg {self.pgid} split into "
+             f"{sorted(c.pgid for c in children)} at pg_num {new_num}",
+             f"osd.{self.osd.osd_id}")
 
     # ---- identity ---------------------------------------------------------
     def meta_cid(self) -> str:
@@ -432,24 +613,40 @@ class PG:
         a pin was requested (activation waits for the new epoch)."""
         if self.backend is None:
             return False
-        holders: Dict[int, int] = {}
-        for slot, info in self._peer_infos.items():
+        # shard -> ALL acting osds holding a copy (stale realign/split
+        # leftovers mean several members can hold the same shard; a
+        # first-writer-wins map here oscillated pg_temp forever)
+        holders: Dict[int, Set[int]] = {}
+        for slot, info in sorted(self._peer_infos.items()):
             osd = self.acting_shards().get(slot)
             if osd is None:
                 continue
             for h in info.held_shards:
-                holders.setdefault(h, osd)
+                holders.setdefault(h, set()).add(osd)
         acting_osds = [o for o in self.acting if o != CRUSH_ITEM_NONE]
-        misplaced = any(self.acting[s] != o for s, o in holders.items()
-                        if s < len(self.acting) and o in acting_osds)
-        if not misplaced:
-            return False
+
+        def placed(assignment: List[int]) -> int:
+            return sum(1 for s, o in enumerate(assignment)
+                       if o != CRUSH_ITEM_NONE
+                       and o in holders.get(s, ()))
+
+        current_good = placed(self.acting)
+        # deterministic proposal: keep correctly-placed members, then
+        # give each uncovered slot the lowest-id unused holder
         used: Set[int] = set()
         temp: List[int] = [CRUSH_ITEM_NONE] * len(self.acting)
-        for s, o in holders.items():
-            if s < len(temp) and o in acting_osds and o not in used:
+        for s, o in enumerate(self.acting):
+            if o != CRUSH_ITEM_NONE and o in holders.get(s, ()):
                 temp[s] = o
                 used.add(o)
+        for s in range(len(temp)):
+            if temp[s] != CRUSH_ITEM_NONE:
+                continue
+            cands = sorted(o for o in holders.get(s, ())
+                           if o in acting_osds and o not in used)
+            if cands:
+                temp[s] = cands[0]
+                used.add(cands[0])
         spare = [o for o in acting_osds if o not in used]
         spare += [o for o in self.up
                   if o != CRUSH_ITEM_NONE and o not in used
@@ -457,7 +654,10 @@ class PG:
         for s in range(len(temp)):
             if temp[s] == CRUSH_ITEM_NONE and spare:
                 temp[s] = spare.pop(0)
-        if temp == self.acting:
+        # pin only when the permutation STRICTLY beats the current
+        # placement — equal-coverage alternatives would flip-flop, and
+        # slots no permutation can cover belong to recovery/backfill
+        if temp == self.acting or placed(temp) <= current_good:
             return False
         dlog("pg", 3, f"pg {self.pgid} choose_acting: data holders "
              f"{holders} vs acting {self.acting} -> pg_temp {temp}",
@@ -497,7 +697,7 @@ class PG:
         """Clean + pinned: move each shard to its CRUSH-up position
         (decode + push to the up member), then clear the pin — the
         reference's backfill-to-up that lets pg_temp be temporary."""
-        if self.backend is None or not self.is_primary():
+        if not self.is_primary():
             return
         if self.state != STATE_ACTIVE or self._has_missing() \
                 or self._backfill_pending:
@@ -506,6 +706,15 @@ class PG:
         if pg_t(self.pgid[0], self.pgid[1]) not in self.osd.osdmap.pg_temp:
             return
         if getattr(self, "_realigning", False):
+            # an ack/reply chain lost mid-flight must not wedge the
+            # pin forever: reset after a grace and retry
+            if self.osd.now - getattr(self, "_realign_started",
+                                      self.osd.now) > 15.0:
+                self._realigning = False
+                self._rep_realign_ack = None
+            return
+        if self.backend is None:
+            self._realign_replicated()
             return
         # quiesce: no in-flight writes may interleave with the shard
         # copies (clients see EAGAIN while realigning and resend)
@@ -520,6 +729,7 @@ class PG:
             self._request_pg_temp([])
             return
         self._realigning = True
+        self._realign_started = self.osd.now
         start_head = self.pg_log.head
         dlog("pg", 3, f"pg {self.pgid} realign to up {self.up} "
              f"(moves {moves}, {len(objects)} objects)",
@@ -546,17 +756,100 @@ class PG:
                     done_obj(False)
                     return
                 rec = be.recover_object(oid, set(moves), chunks, size)
-                for s_ in moves:
-                    self.send_to_osd(self.up[s_], MOSDECSubOpWrite(
-                        tid=0, pgid=self.pgid, shard=s_, oid=oid,
-                        chunk=rec[s_], offset=0, partial=False,
-                        at_version=size, is_push=True,
-                        xattrs=attrs))
-                done_obj(True)
+                # stamp the object's version on the pushed shards —
+                # receivers compare store VERSION_ATTR against their
+                # log to build local_missing, and a mismatch leaves
+                # the object "missing" forever on the new members
+                ver = 0
+                mine = self.my_shard()
+                if mine >= 0:
+                    scid = be.shard_cid(mine)
+                    sho = be.shard_oid(oid, mine)
+                    store = self.osd.store
+                    if store.collection_exists(scid) and \
+                            store.exists(scid, sho):
+                        vb = store.getattrs(scid, sho).get(VERSION_ATTR)
+                        if vb:
+                            ver = struct.unpack("<Q", vb)[0]
+                # acked pushes: done_obj only fires once every target
+                # APPLIED its shard — clearing the pin earlier lets the
+                # next peering round see the new members as missing and
+                # wedge recovery on a stale missing-map
+                be.push_chunks(
+                    oid, {s_: rec[s_] for s_ in moves}, size,
+                    lambda: done_obj(True), version=ver, xattrs=attrs,
+                    targets={s_: self.up[s_] for s_ in moves})
             be.read_chunks(oid, on_chunks)
 
         for oid in objects:
             start_obj(oid)
+
+    def _realign_replicated(self) -> None:
+        """Full-copy analog of the EC realign for replicated pools:
+        push every object (data + user attrs + omap + snapset +
+        version) to the up members that are not yet acting, then clear
+        the pin (backfill-to-up).  Needed when a placement change
+        (pgp_num growth, crush edit) moves a PG to OSDs that never
+        held its data — the mon primes pg_temp to the old acting and
+        this migrates the copies before the flip.
+
+        Same invariants as the EC realign: concurrent client writes
+        are excluded (op_lock — tick runs without it), every push is
+        ACKED before the pin clears, and a log-head change while the
+        copies were in flight aborts the clear so the next tick
+        re-runs with current data."""
+        to_add = [o for o in self.up
+                  if o != CRUSH_ITEM_NONE and o not in self.acting]
+        store = self.osd.store
+        be = self.rep_backend
+        cid = be.cid()
+        oids = [ho.oid for ho in store.list_objects(cid)] \
+            if store.collection_exists(cid) else []
+        if not to_add or not oids:
+            self._request_pg_temp([])
+            return
+        if not self.op_lock.acquire(blocking=False):
+            return                       # a write holds the PG; retry
+        try:
+            self._realigning = True
+            self._realign_started = self.osd.now
+            start_head = self.pg_log.head
+            pending: Set[int] = set()
+            state = {"armed": False}
+
+            def on_ack(tid: int) -> None:
+                pending.discard(tid)
+                if state["armed"] and not pending:
+                    self._realigning = False
+                    self._rep_realign_ack = None
+                    if self.pg_log.head == start_head:
+                        self._request_pg_temp([])
+            self._rep_realign_ack = on_ack
+            from ..msg.messages import MOSDECSubOpWrite
+            for oid in sorted(oids):
+                exists, data, uattrs, omap = be.object_state(oid)
+                ho = hobject_t(oid)
+                vb = store.getattrs(cid, ho).get(VERSION_ATTR)
+                ver = struct.unpack("<Q", vb)[0] if vb else 0
+                ss = self.snapsets.get(oid)
+                ssu = (oid, encode_snapset(ss)) if ss else None
+                for tgt in to_add:
+                    tid = self.osd.next_pull_tid()
+                    pending.add(tid)
+                    self.send_to_osd(tgt, MOSDECSubOpWrite(
+                        tid=tid, pgid=self.pgid, shard=-1, oid=oid,
+                        chunk=data, offset=0, partial=False,
+                        at_version=len(data), version=ver,
+                        is_push=True, xattrs=uattrs or None,
+                        omap=omap or None, snapset_update=ssu))
+            dlog("pg", 3, f"pg {self.pgid} replicated realign: pushed "
+                 f"{len(oids)} objects to {to_add}",
+                 f"osd.{self.osd.osd_id}")
+            state["armed"] = True
+            if not pending:              # acks raced the sends
+                on_ack(-1)
+        finally:
+            self.op_lock.release()
 
     def handle_pg_info(self, msg: MOSDPGInfo) -> None:
         if not self.is_primary():
@@ -1142,6 +1435,19 @@ class PG:
                 tid=msg.tid, result=-11,  # EAGAIN: wrong primary / not ready
                 epoch=self.osd.osdmap.epoch))
             return
+        cur_pool = self.osd.osdmap.pools.get(self.pgid[0])
+        if cur_pool is not None:
+            actual = cur_pool.raw_pg_to_pg(
+                self.osd.osdmap.map_to_pg(self.pgid[0], msg.oid))
+            if actual.ps != self.pgid[1]:
+                # misdirected: the client targeted us from a pre-split
+                # map (PrimaryLogPG::do_op "wrong node" handling) —
+                # EAGAIN makes it refresh the map and resend to the
+                # child PG
+                self.osd.send_op_reply(msg.src, MOSDOpReply(
+                    tid=msg.tid, result=-11,
+                    epoch=self.osd.osdmap.epoch))
+                return
         from ..msg.messages import (
             CEPH_OSD_OP_NOTIFY, CEPH_OSD_OP_UNWATCH, CEPH_OSD_OP_WATCH,
         )

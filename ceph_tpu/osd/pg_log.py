@@ -145,6 +145,35 @@ class PGLog:
         t.setattr(cid, meta, LAST_UPDATE_ATTR, struct.pack("<Q", self.head))
         return dropped
 
+    def split_into(self, child: "PGLog", child_oids,
+                   t_parent: Transaction, parent_cid: str,
+                   t_child: Transaction, child_cid: str) -> None:
+        """Move entries for *child_oids* out of this log into *child*
+        (PGLog::split_into role).  Both logs keep the parent's
+        head/tail so peering version comparisons stay consistent
+        across the identically-split replicas; persistence rides the
+        two transactions."""
+        meta = hobject_t(PG_META_OID)
+        child_entries = [e for e in self.entries if e.oid in child_oids]
+        self.entries = [e for e in self.entries
+                        if e.oid not in child_oids]
+        child.head = self.head
+        child.tail = self.tail
+        child.entries = child_entries
+        t_parent.touch(parent_cid, meta)
+        t_parent.omap_rmkeys(parent_cid, meta,
+                             [self._key(e.version)
+                              for e in child_entries])
+        t_child.touch(child_cid, meta)
+        t_child.omap_setkeys(child_cid, meta,
+                             {self._key(e.version): e.encode()
+                              for e in child_entries})
+        for t, cid in ((t_parent, parent_cid), (t_child, child_cid)):
+            t.setattr(cid, meta, LAST_UPDATE_ATTR,
+                      struct.pack("<Q", self.head))
+            t.setattr(cid, meta, LOG_TAIL_ATTR,
+                      struct.pack("<Q", self.tail))
+
     # ---- persistence -------------------------------------------------------
     def load(self, store: MemStore, cid: str) -> None:
         meta = hobject_t(PG_META_OID)

@@ -198,6 +198,46 @@ def wall_conf():
     g_conf.set_val("osd_op_queue_mclock_wall", False)
 
 
+@pytest.fixture
+def wall_sync_conf():
+    g_conf.set_val("osd_op_queue_mclock_wall", True)
+    yield
+    g_conf.set_val("osd_op_queue_mclock_wall", False)
+
+
+def test_wall_mode_without_threads_drains_from_tick(wall_sync_conf):
+    """The shipped-default combination (wall clock on, no worker
+    threads): rate-blocked ops left behind by the synchronous drain
+    are re-driven from the OSD tick, not stranded until the next
+    client op arrives."""
+    import numpy as np
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.common.work_queue import CLASS_SCRUB
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", size=3, pg_num=4)
+    cl = c.client()
+    data = np.random.default_rng(2).integers(
+        0, 256, 8000, dtype=np.uint8).tobytes()
+    assert cl.write_full("p", "obj", data) == 0
+    assert cl.read("p", "obj") == data
+    # strand rate-blocked ops with NO further client traffic
+    osd = next(iter(c.osds.values()))
+    handled = []
+    orig = osd._wq_handle
+    osd._wq_handle = lambda item: (
+        handled.append(item) if item[0] == "noop"
+        else orig(item))
+    for sh in osd.op_wq.shards:
+        sh.tags[CLASS_SCRUB] = (0.0, 1.0, 50.0)
+    for i in range(10):
+        osd.op_wq.shards[0].enqueue(CLASS_SCRUB, ("noop", i))
+    deadline = time.time() + 10.0
+    while len(handled) < 10 and time.time() < deadline:
+        c.tick(dt=0.05)
+        time.sleep(0.02)
+    assert len(handled) == 10, f"tick never drained: {len(handled)}"
+
+
 def test_cluster_runs_with_wall_mclock(wall_conf):
     """End-to-end: a cluster whose OSDs enforce wall-clock QoS still
     serves EC writes/reads correctly."""

@@ -1,0 +1,242 @@
+"""PG splitting: pg_num growth, local splits, pgp_num migration.
+
+The reference splits PGs when pg_num grows (OSD::split_pgs,
+PG::split_into, PGLog::split_into): ceph_stable_mod keeps a parent's ps
+stable while objects whose hash lands in a child ps move to it, and —
+with pgp_num unchanged — children colocate with their parents (pps uses
+pgp_num), so the split is purely local.  Raising pgp_num afterwards
+migrates children through ordinary peering/backfill.  These tests
+verify object placement matches the map after splits, data survives
+end-to-end (replicated + EC + snapshots), writes work post-split, a
+restarted OSD catches up on a split it slept through, and pgp_num
+migration converges.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.osdmap import pg_t
+
+NONE = 0x7FFFFFFF
+
+
+def _settle(c, rounds=8):
+    for _ in range(rounds):
+        c.network.pump()
+        c.run_recovery()
+
+
+def _split(c, pool_name, pg_num):
+    c.mon.set_pool_pg_num(pool_name, pg_num)
+    c.publish()
+    _settle(c)
+
+
+def _objects(rng, n, tag):
+    return {f"{tag}{i}": rng.integers(0, 256, 2000 + 37 * i,
+                                      dtype=np.uint8).tobytes()
+            for i in range(n)}
+
+
+def test_replicated_split_moves_objects_and_keeps_data():
+    c = MiniCluster(n_osds=6)
+    c.create_replicated_pool("p", size=3, pg_num=8)
+    cl = c.client()
+    rng = np.random.default_rng(5)
+    blobs = _objects(rng, 40, "o")
+    for oid, data in blobs.items():
+        assert cl.write_full("p", oid, data) == 0
+    pid = c.mon.osdmap.lookup_pg_pool_name("p")
+    _split(c, "p", 16)
+    pool = c.mon.osdmap.pools[pid]
+    assert pool.pg_num == 16 and pool.pgp_num == 8
+    # every object readable, and stored under its NEW pg on every OSD
+    moved = 0
+    for oid, data in blobs.items():
+        assert cl.read("p", oid) == data
+        ps = pool.raw_pg_to_pg(c.mon.osdmap.map_to_pg(pid, oid)).ps
+        if ps >= 8:
+            moved += 1
+        for osd in c.osds.values():
+            for cps in range(16):
+                cid = f"{pid}.{cps}"
+                if not osd.store.collection_exists(cid):
+                    continue
+                held = [h.oid for h in osd.store.list_objects(cid)
+                        if h.oid == oid]
+                if held:
+                    assert cps == ps, \
+                        f"{oid} in pg {cps}, belongs in {ps}"
+    assert moved > 0, "hash never landed in a child (bad test seed)"
+    # post-split writes and overwrites land in the children
+    blobs2 = _objects(rng, 20, "n")
+    for oid, data in blobs2.items():
+        assert cl.write_full("p", oid, data) == 0
+        assert cl.read("p", oid) == data
+    some = next(iter(blobs))
+    assert cl.write_full("p", some, b"rewritten") == 0
+    assert cl.read("p", some) == b"rewritten"
+
+
+def test_ec_split_shards_and_recovery():
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("e", k=2, m=1, plugin="isa", pg_num=4,
+                     failure_domain="osd")
+    cl = c.client()
+    rng = np.random.default_rng(9)
+    blobs = _objects(rng, 30, "x")
+    for oid, data in blobs.items():
+        assert cl.write_full("e", oid, data) == 0
+    _split(c, "e", 8)
+    for oid, data in blobs.items():
+        assert cl.read("e", oid) == data
+    # degraded read + recovery still work on split children
+    pid = c.mon.osdmap.lookup_pg_pool_name("e")
+    pool = c.mon.osdmap.pools[pid]
+    oid = next(o for o in blobs
+               if pool.raw_pg_to_pg(
+                   c.mon.osdmap.map_to_pg(pid, o)).ps >= 4)
+    pg = pool.raw_pg_to_pg(c.mon.osdmap.map_to_pg(pid, oid))
+    *_, acting, primary = c.mon.osdmap.pg_to_up_acting_osds(pg)
+    victim = next(o for o in acting if o != primary and o != NONE)
+    c.kill_osd(victim)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    assert cl.read("e", oid) == blobs[oid]      # degraded read
+    c.mark_osd_out(victim)                      # re-place + backfill
+    _settle(c, rounds=12)
+    data2 = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+    assert cl.write_full("e", oid, data2) == 0
+    assert cl.read("e", oid) == data2
+
+
+def test_split_preserves_snapshots_and_clones():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", size=3, pg_num=4)
+    cl = c.client()
+    rng = np.random.default_rng(3)
+    blobs = _objects(rng, 16, "s")
+    for oid, data in blobs.items():
+        assert cl.write_full("p", oid, data) == 0
+    c.pool_snap_create("p", "snap1")
+    new = {oid: rng.integers(0, 256, 1500, dtype=np.uint8).tobytes()
+           for oid in blobs}
+    for oid, data in new.items():
+        assert cl.write_full("p", oid, data) == 0
+    _split(c, "p", 8)
+    for oid in blobs:
+        assert cl.read("p", oid) == new[oid]
+        assert cl.read("p", oid, snap="snap1") == blobs[oid], \
+            f"snap read of {oid} lost across split"
+
+
+def test_restarted_osd_catches_up_on_missed_split():
+    """An OSD down across the split epoch must split its local layout
+    on restart (the persisted per-PG pg_num attr)."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", size=3, pg_num=4)
+    cl = c.client()
+    rng = np.random.default_rng(8)
+    blobs = _objects(rng, 24, "r")
+    for oid, data in blobs.items():
+        assert cl.write_full("p", oid, data) == 0
+    victim = 0
+    c.kill_osd(victim)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    _split(c, "p", 8)
+    for oid, data in blobs.items():       # degraded, split reads fine
+        assert cl.read("p", oid) == data
+    c.restart_osd(victim)
+    _settle(c, rounds=12)
+    pid = c.mon.osdmap.lookup_pg_pool_name("p")
+    pool = c.mon.osdmap.pools[pid]
+    osd = c.osds[victim]
+    # the restarted OSD's layout reflects the new pg_num: no object
+    # sits in a parent collection that belongs to a child
+    for oid in blobs:
+        ps = pool.raw_pg_to_pg(c.mon.osdmap.map_to_pg(pid, oid)).ps
+        for cps in range(8):
+            cid = f"{pid}.{cps}"
+            if osd.store.collection_exists(cid) and any(
+                    h.oid == oid for h in osd.store.list_objects(cid)):
+                assert cps == ps, \
+                    f"osd.{victim}: {oid} in {cps}, belongs in {ps}"
+    for oid, data in blobs.items():
+        assert cl.read("p", oid) == data
+
+
+def test_pgp_num_increase_migrates_children():
+    """Phase 2: raising pgp_num gives children their own CRUSH
+    placement; the realignment machinery moves the data and reads keep
+    working from the new acting sets."""
+    c = MiniCluster(n_osds=6)
+    c.create_replicated_pool("p", size=3, pg_num=8)
+    cl = c.client()
+    rng = np.random.default_rng(4)
+    blobs = _objects(rng, 30, "m")
+    for oid, data in blobs.items():
+        assert cl.write_full("p", oid, data) == 0
+    _split(c, "p", 16)
+    pid = c.mon.osdmap.lookup_pg_pool_name("p")
+    before = {ps: c.mon.osdmap.pg_to_up_acting_osds(pg_t(pid, ps))[2]
+              for ps in range(16)}
+    c.mon.set_pool_pgp_num("p", 16)
+    c.publish()
+    for _ in range(10):
+        c.tick(dt=1.0)
+        _settle(c, rounds=4)
+    after = {ps: c.mon.osdmap.pg_to_up_acting_osds(pg_t(pid, ps))[2]
+             for ps in range(16)}
+    assert any(before[ps] != after[ps] for ps in range(8, 16)), \
+        "pgp_num increase moved no child placements"
+    for oid, data in blobs.items():
+        assert cl.read("p", oid) == data
+    for oid in list(blobs)[:8]:
+        assert cl.write_full("p", oid, b"post-migrate") == 0
+        assert cl.read("p", oid) == b"post-migrate"
+
+
+def test_ec_pgp_migration_to_disjoint_acting_converges():
+    """The hard case: pgp_num growth can hand an EC child PG an acting
+    set sharing NO member with the data holders.  The mon's pg_temp
+    priming keeps the old members serving, realign pushes each shard
+    (with its version) to the new up members and waits for acks, and
+    the recovery probe clears debts the log-delta can't see — without
+    any one of those, this wedges with reads returning EIO forever."""
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("e", k=2, m=1, plugin="isa", pg_num=4,
+                     failure_domain="osd")
+    cl = c.client()
+    rng = np.random.default_rng(42)
+    blobs = {f"o{i}": rng.integers(0, 256, 4096,
+                                   dtype=np.uint8).tobytes()
+             for i in range(10)}
+    for oid, d in blobs.items():
+        assert cl.write_full("e", oid, d) == 0
+    _split(c, "e", 16)
+    c.mon.set_pool_pgp_num("e", 16)
+    c.publish()
+    for _ in range(12):
+        c.tick(dt=1.0)
+        _settle(c, rounds=3)
+    assert not c.mon.osdmap.pg_temp, \
+        f"pins never cleared: {dict(c.mon.osdmap.pg_temp)}"
+    for oid, d in blobs.items():
+        assert cl.read("e", oid) == d
+    for oid in list(blobs)[:4]:
+        assert cl.write_full("e", oid, b"after-migration") == 0
+        assert cl.read("e", oid) == b"after-migration"
+
+
+def test_mon_guards():
+    c = MiniCluster(n_osds=3)
+    c.create_replicated_pool("p", size=2, pg_num=8)
+    with pytest.raises(ValueError):
+        c.mon.set_pool_pg_num("p", 4)          # no merging
+    with pytest.raises(ValueError):
+        c.mon.set_pool_pgp_num("p", 16)        # pgp > pg
+    with pytest.raises(KeyError):
+        c.mon.set_pool_pg_num("nope", 16)
