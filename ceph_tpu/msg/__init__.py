@@ -1,7 +1,8 @@
 from .messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDMap, MOSDOp, MOSDOpReply, MOSDPGInfo,
-    MOSDPGQuery, MOSDPGScan, MOSDPGScanReply, MOSDPing, MOSDRepScrub,
+    MOSDPGNotify, MOSDPGQuery, MOSDPGRemove, MOSDPGScan,
+    MOSDPGScanReply, MOSDPing, MOSDRepScrub,
     MOSDRepScrubMap, Message,
     MOSDFailure, CEPH_OSD_OP_READ, CEPH_OSD_OP_WRITE, CEPH_OSD_OP_WRITEFULL,
     CEPH_OSD_OP_APPEND, CEPH_OSD_OP_DELETE, CEPH_OSD_OP_STAT,
@@ -11,7 +12,8 @@ from .messenger import Connection, Dispatcher, Messenger, Network
 __all__ = [
     "MOSDECSubOpRead", "MOSDECSubOpReadReply", "MOSDECSubOpWrite",
     "MOSDECSubOpWriteReply", "MOSDMap", "MOSDOp", "MOSDOpReply",
-    "MOSDPGInfo", "MOSDPGQuery", "MOSDPGScan", "MOSDPGScanReply",
+    "MOSDPGInfo", "MOSDPGNotify", "MOSDPGQuery", "MOSDPGRemove",
+    "MOSDPGScan", "MOSDPGScanReply",
     "MOSDPing", "MOSDRepScrub", "MOSDRepScrubMap",
     "Message", "MOSDFailure", "Connection", "Dispatcher",
     "Messenger", "Network", "CEPH_OSD_OP_READ", "CEPH_OSD_OP_WRITE",

@@ -222,6 +222,28 @@ class MOSDPGInfo(Message):
 
 
 @dataclass
+class MOSDPGNotify(Message):
+    """Stray -> primary: I hold data for a PG I no longer serve
+    (MOSDPGNotify stray-notify role).  The primary answers with
+    MOSDPGRemove once the PG is clean everywhere it IS served."""
+    pgid: Tuple[int, int] = (0, 0)
+    epoch: int = 0
+    from_osd: int = -1
+    held_shards: List[int] = field(default_factory=list)
+    # the stray's pg_log head: a primary must never authorize deleting
+    # a copy NEWER than what it can serve itself
+    last_update: int = 0
+
+
+@dataclass
+class MOSDPGRemove(Message):
+    """Primary -> stray: your copy is no longer needed; delete it
+    (src/messages/MOSDPGRemove.h; OSD::_remove_pg role)."""
+    pgid: Tuple[int, int] = (0, 0)
+    epoch: int = 0
+
+
+@dataclass
 class MOSDPGScan(Message):
     """Primary -> shard: list your objects (backfill scan,
     src/messages/MOSDPGScan.h)."""

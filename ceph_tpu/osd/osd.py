@@ -24,7 +24,8 @@ from ..crush.constants import CRUSH_ITEM_NONE
 from ..msg import (
     Dispatcher, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDFailure, MOSDMap, MOSDOp, MOSDOpReply,
-    MOSDPGInfo, MOSDPGQuery, MOSDPGScan, MOSDPGScanReply, MOSDPing,
+    MOSDPGInfo, MOSDPGNotify, MOSDPGQuery, MOSDPGRemove, MOSDPGScan,
+    MOSDPGScanReply, MOSDPing,
     MOSDRepScrub, MOSDRepScrubMap, Message, Network,
 )
 from ..os_store import MemStore, Transaction, hobject_t
@@ -117,6 +118,7 @@ class OSD(Dispatcher):
         # (which starts at 1): a probe reply must never be claimable
         # by — or hijack — a PG's own inflight read with the same tid
         self._pull_tid = 1 << 32
+        self._rep_pull_stamps: Dict[int, float] = {}
         # tier ops this OSD issued as a client of the base pool
         # (promote reads / flush writes): tid -> reply callback.
         # Allocated/consumed from worker threads holding only a PG
@@ -179,11 +181,16 @@ class OSD(Dispatcher):
             self._handle_sub_read(msg)
         elif isinstance(msg, MOSDECSubOpReadReply):
             if msg.tid in self._rep_pulls:
+                self._rep_pull_stamps.pop(msg.tid, None)
                 self._rep_pulls.pop(msg.tid)(msg)
                 return
             pg = self.pgs.get(msg.pgid)
             if pg is not None and pg.backend is not None:
                 pg.backend.handle_sub_read_reply(msg)
+        elif isinstance(msg, MOSDPGNotify):
+            self._handle_pg_notify(msg)
+        elif isinstance(msg, MOSDPGRemove):
+            self._handle_pg_remove(msg)
         elif isinstance(msg, MOSDPGQuery):
             pg = self.pgs.get(msg.pgid)
             if pg is not None:
@@ -260,6 +267,127 @@ class OSD(Dispatcher):
                                          epoch=self.osdmap.epoch), mon)
                 self._consume_map()
 
+    # ---- stray PG removal (PG RecoveryState::Stray + OSD::_remove_pg) -----
+    def _local_pg_collections(self) -> Dict[Tuple[int, int], List[str]]:
+        """(pool, ps) -> local collection names, parsed from the store
+        (strays can exist with no PG object after a restart)."""
+        out: Dict[Tuple[int, int], List[str]] = {}
+        for cid in self.store.list_collections():
+            body = cid[:-5] if cid.endswith("_meta") else cid
+            if "s" in body.split(".")[-1]:
+                body = body[:body.rindex("s")]
+            try:
+                pool_s, ps_s = body.split(".")
+                key = (int(pool_s), int(ps_s))
+            except ValueError:
+                continue
+            out.setdefault(key, []).append(cid)
+        return out
+
+    def _report_strays(self) -> None:
+        """Notify the current primary about PGs we hold data for but
+        no longer serve; it answers MOSDPGRemove once the PG is clean
+        (the reference's stray-notify / purged_strays flow)."""
+        interval = 5.0
+        # gate the whole scan: listing every collection and running a
+        # CRUSH mapping per held PG is too much work for every tick
+        if self.now - getattr(self, "_stray_scan_at", -1e9) < interval:
+            return
+        self._stray_scan_at = self.now
+        sent = getattr(self, "_stray_notified", None)
+        if sent is None:
+            sent = self._stray_notified = {}
+        for pg_id, cids in self._local_pg_collections().items():
+            pool = self.osdmap.pools.get(pg_id[0])
+            if pool is None or pg_id[1] >= pool.pg_num:
+                continue          # pool gone / unknown: stay conservative
+            up, _upp, acting, actp = self.osdmap.pg_to_up_acting_osds(
+                pg_t(*pg_id))
+            members = {o for o in list(up) + list(acting)
+                       if o != CRUSH_ITEM_NONE}
+            if self.osd_id in members or actp < 0 or \
+                    actp == self.osd_id:
+                sent.pop(pg_id, None)
+                continue
+            if self.now - sent.get(pg_id, -1e9) < interval:
+                continue
+            sent[pg_id] = self.now
+            held = sorted({int(cid[cid.rindex("s") + 1:])
+                           for cid in cids
+                           if not cid.endswith("_meta")
+                           and "s" in cid.split(".")[-1]})
+            from .pg_log import LAST_UPDATE_ATTR, PG_META_OID
+            lu = 0
+            mcid = f"{pg_id[0]}.{pg_id[1]}_meta"
+            meta = hobject_t(PG_META_OID)
+            if self.store.collection_exists(mcid) and \
+                    self.store.exists(mcid, meta):
+                b = self.store.getattrs(mcid, meta).get(LAST_UPDATE_ATTR)
+                if b:
+                    lu = struct.unpack("<Q", b)[0]
+            self.messenger.send_message(MOSDPGNotify(
+                pgid=pg_id, epoch=self.osdmap.epoch,
+                from_osd=self.osd_id, held_shards=held,
+                last_update=lu),
+                f"osd.{actp}")
+
+    def _handle_pg_notify(self, msg: MOSDPGNotify) -> None:
+        """Primary: a stray holds our data; authorize removal only when
+        this PG is clean and unpinned — while degraded, the stray may
+        yet become a recovery source via choose_acting."""
+        pg = self.pgs.get(msg.pgid)
+        if pg is None or not pg.is_primary():
+            return
+        from .pg import STATE_ACTIVE
+        if pg.state != STATE_ACTIVE or pg._has_missing() or \
+                pg._backfill_pending or \
+                getattr(pg, "_realigning", False):
+            return
+        if pg_t(*msg.pgid) in self.osdmap.pg_temp:
+            return
+        members = {o for o in list(pg.up) + list(pg.acting)
+                   if o != CRUSH_ITEM_NONE}
+        if msg.from_osd in members:
+            return
+        high = pg.data_high_water()
+        if msg.last_update > high:
+            # the stray holds writes we cannot serve: deleting it would
+            # destroy the only newer copy — leave it until this PG
+            # catches up (or an operator intervenes)
+            self.dout(1, f"pg {tuple(msg.pgid)}: stray osd."
+                      f"{msg.from_osd} is NEWER than us "
+                      f"({msg.last_update} > {high}); "
+                      "refusing removal")
+            return
+        self.messenger.send_message(MOSDPGRemove(
+            pgid=msg.pgid, epoch=self.osdmap.epoch),
+            f"osd.{msg.from_osd}")
+
+    def _handle_pg_remove(self, msg: MOSDPGRemove) -> None:
+        """Stray: delete the local PG copy — re-checked against OUR
+        current map (a newer epoch may have made us a member again)."""
+        if msg.epoch > self.osdmap.epoch:
+            return                # catch up first; primary will re-ack
+        pg_id = tuple(msg.pgid)
+        pool = self.osdmap.pools.get(pg_id[0])
+        if pool is None or pg_id[1] >= pool.pg_num:
+            return
+        up, _upp, acting, _actp = self.osdmap.pg_to_up_acting_osds(
+            pg_t(*pg_id))
+        if self.osd_id in {o for o in list(up) + list(acting)
+                           if o != CRUSH_ITEM_NONE}:
+            return
+        cids = self._local_pg_collections().get(pg_id, [])
+        t = Transaction()
+        for cid in cids:
+            t.remove_collection(cid)
+        if not t.empty():
+            self.store.queue_transaction(t)
+        self.pgs.pop(pg_id, None)
+        getattr(self, "_stray_notified", {}).pop(pg_id, None)
+        self.dout(3, f"removed stray pg {pg_id} "
+                  f"({len(cids)} collections)")
+
     def next_pull_tid(self) -> int:
         """OSD-level tid (disjoint from per-PG backend counters)."""
         self._pull_tid += 1
@@ -286,13 +414,22 @@ class OSD(Dispatcher):
                                          if o != CRUSH_ITEM_NONE]
                 if member:
                     self.get_or_create_pg(pg_id)
-        # pg_num grew past a local PG's recorded layout: split its
-        # local objects into the children (OSD::split_pgs) before any
-        # PG advances into the new epoch
-        for pg_id, pg in list(self.pgs.items()):
+        # pg_num grew past a local layout's record: split before any PG
+        # advances (OSD::split_pgs) — including layouts held WITHOUT
+        # membership: an OSD down through the split epoch can be
+        # remapped off the parent yet still serve a child, and its
+        # stranded objects must reach the child collections (stray
+        # removal would otherwise delete them with the parent)
+        from .pg import stored_pg_num_of
+        for pg_id in set(self._local_pg_collections()) | set(self.pgs):
             pool = self.osdmap.pools.get(pg_id[0])
-            if pool is not None and pg.known_pg_num < pool.pg_num:
-                pg.split_children()
+            if pool is None or pg_id[1] >= pool.pg_num:
+                continue
+            pg = self.pgs.get(pg_id)
+            known = pg.known_pg_num if pg is not None else \
+                (stored_pg_num_of(self.store, pg_id) or pool.pg_num)
+            if known < pool.pg_num:
+                self.get_or_create_pg(pg_id).split_children()
         # advance all (children included)
         for pg_id in list(self.pgs):
             self.pgs[pg_id].advance_map(self.osdmap)
@@ -459,6 +596,12 @@ class OSD(Dispatcher):
                 MOSDPing(op=MOSDPing.PING, stamp=now,
                          epoch=self.osdmap.epoch), f"osd.{peer}")
         self.maybe_schedule_scrubs()
+        self._report_strays()
+        # sweep probe callbacks whose replies died with their peer
+        for tid in [t for t, t0 in self._rep_pull_stamps.items()
+                    if now - t0 > 60.0]:
+            self._rep_pull_stamps.pop(tid, None)
+            self._rep_pulls.pop(tid, None)
         if self.op_tp is None and self.op_wq.wall and len(self.op_wq):
             # synchronous wall-clock mode: rate-blocked ops queued with
             # no worker threads must be re-driven from the tick, or a
@@ -632,7 +775,6 @@ class OSD(Dispatcher):
 
     def _recover_ec_oid(self, pg: PG, oid: str,
                         targets: Dict[int, Tuple[int, str]]) -> None:
-        be = pg.backend
         needed = sorted(s for s, (_v, op) in targets.items()
                         if op != OP_DELETE)
         # probe phase: a "missing" peer may already hold the object at
@@ -646,6 +788,13 @@ class OSD(Dispatcher):
         probes = [s for s in needed
                   if s in acting and self.osdmap.is_up(acting[s])]
         state = {"left": len(probes)}
+        # generation guard: replies from a SUPERSEDED probe round (the
+        # recovery was re-kicked after RECOVERY_RETRY) must not run
+        # after_probes a second time concurrently with the new round
+        generation = pg._recovering_since.get(oid)
+
+        def current() -> bool:
+            return pg._recovering_since.get(oid) == generation
 
         def after_probes() -> None:
             remaining = sorted(s for s in needed
@@ -664,10 +813,11 @@ class OSD(Dispatcher):
             return
         for s in probes:
             v_expect = targets[s][0]
-            self._pull_tid += 1
-            tid = self._pull_tid
+            tid = self.next_pull_tid()
 
             def on_probe(reply, s=s, v_expect=v_expect) -> None:
+                if not current():
+                    return              # superseded round's late reply
                 vb = reply.attrs.get(VERSION_ATTR) \
                     if reply.result == 0 and reply.oid == oid \
                     and reply.shard == s else None
@@ -678,6 +828,7 @@ class OSD(Dispatcher):
                 if state["left"] == 0:
                     after_probes()
             self._rep_pulls[tid] = on_probe
+            self._rep_pull_stamps[tid] = self.now
             pg.send_to_osd(acting[s], MOSDECSubOpRead(
                 tid=tid, pgid=pg.pgid, shard=s, oid=oid,
                 attrs_only=True))

@@ -58,6 +58,18 @@ from .pg_log import (
 
 PG_NUM_ATTR = "_pg_num"          # pg_num this PG's store layout reflects
 
+
+def stored_pg_num_of(store, pg_id: Tuple[int, int]) -> int:
+    """Read a PG layout's recorded pg_num straight from the store (0 =
+    never recorded) — usable before any PG object exists."""
+    cid = f"{pg_id[0]}.{pg_id[1]}_meta"
+    meta = hobject_t(PG_META_OID)
+    if store.collection_exists(cid) and store.exists(cid, meta):
+        b = store.getattrs(cid, meta).get(PG_NUM_ATTR)
+        if b:
+            return struct.unpack("<I", b)[0]
+    return 0
+
 STATE_INITIAL = "initial"
 STATE_PEERING = "peering"
 STATE_ACTIVE = "active"
@@ -236,14 +248,7 @@ class PG:
     def stored_pg_num(self) -> int:
         """pg_num this replica's on-disk layout reflects (0 = never
         recorded); lets a restarted OSD catch up on splits it missed."""
-        store = self.osd.store
-        cid = self.meta_cid()
-        meta = hobject_t(PG_META_OID)
-        if store.collection_exists(cid) and store.exists(cid, meta):
-            b = store.getattrs(cid, meta).get(PG_NUM_ATTR)
-            if b:
-                return struct.unpack("<I", b)[0]
-        return 0
+        return stored_pg_num_of(self.osd.store, self.pgid)
 
     def record_pg_num(self, n: int,
                       t: Optional[Transaction] = None) -> None:
@@ -278,6 +283,17 @@ class PG:
         pool = self.osd.osdmap.pools.get(pool_id)
         if pool is None or pool.pg_num <= self.known_pg_num:
             return
+        # serialize against in-flight client writes: worker threads run
+        # do_op under this lock, and a write landing between our read
+        # and the parent-side delete would be lost
+        self.op_lock.acquire()
+        try:
+            self._split_children_locked(pool)
+        finally:
+            self.op_lock.release()
+
+    def _split_children_locked(self, pool) -> None:
+        pool_id, ps = self.pgid
         store = self.osd.store
         new_num, new_mask = pool.pg_num, pool.pg_num_mask
         from ..osdmap import ceph_stable_mod
@@ -401,6 +417,27 @@ class PG:
              f"pg {self.pgid} split into "
              f"{sorted(c.pgid for c in children)} at pg_num {new_num}",
              f"osd.{self.osd.osd_id}")
+
+    def data_high_water(self) -> int:
+        """Highest object version this replica can actually SERVE —
+        max of the log head and stored VERSION_ATTRs (pushed data can
+        be newer than the local log after a realign/backfill)."""
+        store = self.osd.store
+        hi = self.pg_log.head
+        if self.backend is not None:
+            prefix = f"{self.pgid[0]}.{self.pgid[1]}s"
+            cids = [c for c in store.list_collections()
+                    if c.startswith(prefix)]
+        else:
+            cids = [f"{self.pgid[0]}.{self.pgid[1]}"]
+        for cid in cids:
+            if not store.collection_exists(cid):
+                continue
+            for ho in store.list_objects(cid):
+                vb = store.getattrs(cid, ho).get(VERSION_ATTR)
+                if vb:
+                    hi = max(hi, struct.unpack("<Q", vb)[0])
+        return hi
 
     # ---- identity ---------------------------------------------------------
     def meta_cid(self) -> str:

@@ -1,0 +1,168 @@
+"""Stray PG removal: old copies are purged once the PG is clean.
+
+The reference keeps a migrated-away PG's data as a "stray" until the
+primary confirms the PG is clean, then authorizes deletion
+(PG RecoveryState::Stray notifies, src/messages/MOSDPGRemove.h,
+OSD::_remove_pg).  Here strays self-report from the store (so copies
+with no live PG object — restarts — are found too), a clean unpinned
+primary acks with MOSDPGRemove, and the stray re-checks its own map
+before deleting.  Stale copies otherwise accumulate forever and
+confuse choose_acting's holder bookkeeping.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.osdmap import pg_t
+
+NONE = 0x7FFFFFFF
+
+
+def _settle(c, rounds=6):
+    for _ in range(rounds):
+        c.network.pump()
+        c.run_recovery()
+
+
+def _stray_collections(c, pid):
+    """[(osd, cid)] for data held by non-members, across the cluster."""
+    out = []
+    pool = c.mon.osdmap.pools[pid]
+    for i, osd in c.osds.items():
+        for pg_id, cids in osd._local_pg_collections().items():
+            if pg_id[0] != pid or pg_id[1] >= pool.pg_num:
+                continue
+            up, _u, acting, _a = \
+                c.mon.osdmap.pg_to_up_acting_osds(pg_t(*pg_id))
+            members = {o for o in list(up) + list(acting) if o != NONE}
+            if i not in members:
+                out.extend((i, cid) for cid in cids)
+    return out
+
+
+def test_migration_strays_get_removed():
+    """After a pgp_num migration, the old holders' copies disappear
+    once every PG is clean — and the data stays fully readable."""
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("e", k=2, m=1, plugin="isa", pg_num=8,
+                     failure_domain="osd")
+    cl = c.client()
+    rng = np.random.default_rng(1)
+    blobs = {f"o{i}": rng.integers(0, 256, 4096,
+                                   dtype=np.uint8).tobytes()
+             for i in range(12)}
+    for oid, d in blobs.items():
+        assert cl.write_full("e", oid, d) == 0
+    pid = c.mon.osdmap.lookup_pg_pool_name("e")
+    c.mon.set_pool_pg_num("e", 16)
+    c.publish()
+    _settle(c)
+    c.mon.set_pool_pgp_num("e", 16)
+    c.publish()
+    for _ in range(12):
+        c.tick(dt=1.0)
+        _settle(c, rounds=3)
+    assert not c.mon.osdmap.pg_temp
+    # several tick rounds: notify -> remove ack -> deletion
+    for _ in range(8):
+        c.tick(dt=6.0)
+        _settle(c, rounds=3)
+    strays = _stray_collections(c, pid)
+    assert strays == [], f"stray copies survived: {strays}"
+    for oid, d in blobs.items():
+        assert cl.read("e", oid) == d
+
+
+def test_degraded_pg_keeps_its_strays():
+    """While a PG is degraded its strays must NOT be purged — they can
+    become recovery sources (choose_acting can pin back to them)."""
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("e", k=2, m=1, plugin="isa", pg_num=8,
+                     failure_domain="osd")
+    cl = c.client()
+    rng = np.random.default_rng(2)
+    for i in range(12):
+        assert cl.write_full(
+            "e", f"o{i}",
+            rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()) == 0
+    pid = c.mon.osdmap.lookup_pg_pool_name("e")
+    c.mon.set_pool_pg_num("e", 16)
+    c.publish()
+    _settle(c)
+    c.mon.set_pool_pgp_num("e", 16)
+    c.publish()
+    # migrate, but then kill an OSD so some PGs go degraded BEFORE the
+    # strays are acked away
+    for _ in range(4):
+        c.tick(dt=1.0)
+        _settle(c, rounds=2)
+    victim = 0
+    c.kill_osd(victim)
+    for _ in range(6):
+        c.tick(dt=6.0)
+        _settle(c, rounds=2)
+    rng = np.random.default_rng(2)
+    for i in range(12):
+        expect = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+        assert cl.read("e", f"o{i}") == expect
+    # and assert the GATE itself: a primary that is recovering (or
+    # pinned, or whose data lags the stray) must not ack a removal
+    from ceph_tpu.msg.messages import MOSDPGNotify, MOSDPGRemove
+    from ceph_tpu.osd.pg import STATE_ACTIVE_RECOVERING
+    live = next(o for o in c.osds.values())
+    pg = next(p for p in live.pgs.values() if p.is_primary())
+    saved_state = pg.state
+    pg.state = STATE_ACTIVE_RECOVERING
+    before = len(c.network.queue)
+    live._handle_pg_notify(MOSDPGNotify(
+        pgid=pg.pgid, epoch=live.osdmap.epoch, from_osd=99,
+        held_shards=[0], last_update=0))
+    removes = [m for _s, _d, m in list(c.network.queue)[before:]
+               if isinstance(m, MOSDPGRemove)]
+    assert removes == [], "recovering primary acked a stray removal"
+    pg.state = saved_state
+    # a stray NEWER than the primary's data is refused even when clean
+    if pg.state == "active":
+        before = len(c.network.queue)
+        live._handle_pg_notify(MOSDPGNotify(
+            pgid=pg.pgid, epoch=live.osdmap.epoch, from_osd=99,
+            held_shards=[0],
+            last_update=pg.data_high_water() + 1000))
+        removes = [m for _s, _d, m in list(c.network.queue)[before:]
+                   if isinstance(m, MOSDPGRemove)]
+        assert removes == [], "primary acked removal of a NEWER stray"
+
+
+def test_restarted_stray_is_found_from_the_store():
+    """A stray with no live PG object (OSD restarted after the remap)
+    is discovered by scanning the store and still gets purged."""
+    c = MiniCluster(n_osds=6)
+    c.create_replicated_pool("p", size=3, pg_num=8)
+    cl = c.client()
+    rng = np.random.default_rng(3)
+    blobs = {f"r{i}": rng.integers(0, 256, 3000,
+                                   dtype=np.uint8).tobytes()
+             for i in range(10)}
+    for oid, d in blobs.items():
+        assert cl.write_full("p", oid, d) == 0
+    pid = c.mon.osdmap.lookup_pg_pool_name("p")
+    c.mon.set_pool_pg_num("p", 16)
+    c.publish()
+    _settle(c)
+    c.mon.set_pool_pgp_num("p", 16)
+    c.publish()
+    for _ in range(10):
+        c.tick(dt=1.0)
+        _settle(c, rounds=3)
+    # restart every OSD: stray PG objects are gone, collections remain
+    for i in list(c.osds):
+        c.restart_osd(i)
+    _settle(c, rounds=6)
+    for _ in range(8):
+        c.tick(dt=6.0)
+        _settle(c, rounds=3)
+    strays = _stray_collections(c, pid)
+    assert strays == [], f"stray copies survived restart: {strays}"
+    for oid, d in blobs.items():
+        assert cl.read("p", oid) == d
