@@ -455,7 +455,13 @@ class Monitor(Dispatcher):
                     del self._down_stamps[osd]   # revived, or already out
                 elif now - t0 >= self.down_out_interval:
                     del self._down_stamps[osd]
-                    self.mark_osd_out(osd)
+                    # remember the pre-out weight IN THE MAP so a later
+                    # boot restores it on any leader, across failovers
+                    # (osd_xinfo_t::old_weight, OSDMonitor::tick)
+                    inc = Incremental()
+                    inc.new_old_weight[osd] = self.osdmap.osd_weight[osd]
+                    inc.new_weight[osd] = 0
+                    self.publish(inc)
         if not self.peers:
             return
         for p in self.peers:
@@ -688,6 +694,7 @@ class Monitor(Dispatcher):
         for o in range(m.max_osd):
             inc.new_up[o] = m.is_up(o)
             inc.new_weight[o] = m.osd_weight[o]
+            inc.new_old_weight[o] = m.osd_old_weight.get(o, 0)
         inc.new_erasure_code_profiles = copy.deepcopy(
             m.erasure_code_profiles)
         return inc
@@ -721,6 +728,7 @@ class Monitor(Dispatcher):
             for src in deferred + ([delta] if delta is not None else []):
                 inc.new_up.update(src.new_up)
                 inc.new_weight.update(src.new_weight)
+                inc.new_old_weight.update(src.new_old_weight)
                 inc.new_primary_affinity.update(src.new_primary_affinity)
                 inc.new_pg_temp.update(src.new_pg_temp)
                 inc.new_primary_temp.update(src.new_primary_temp)
@@ -760,6 +768,14 @@ class Monitor(Dispatcher):
     def mark_osd_up(self, osd: int) -> None:
         inc = Incremental()
         inc.new_up[osd] = True
+        # a boot reverses an AUTOMATIC out (operator outs stay out):
+        # mon_osd_auto_mark_auto_out_in, OSDMonitor::prepare_boot.
+        # The memo rides the replicated map, so any leader can restore
+        old_w = self.osdmap.osd_old_weight.get(osd)
+        if old_w:
+            if self.osdmap.osd_weight[osd] == 0:
+                inc.new_weight[osd] = old_w
+            inc.new_old_weight[osd] = 0
         # recovery voids any partial reports against this osd
         self._failure_reports.pop(osd, None)
         self._down_stamps.pop(osd, None)

@@ -130,6 +130,20 @@ class TcpNetwork(Network):
         self._sess_tx: Dict[str, Dict] = {}
         # peer entity -> highest seq delivered (survives reconnects)
         self._sess_rx: Dict[str, int] = {}
+        # this process's session incarnation.  A rebooted daemon restarts
+        # its send seqs at 1; without an incarnation check the old
+        # session's high-water mark at the receiver silently swallows
+        # every post-reboot frame as a duplicate, AND the stale hello
+        # ack makes the newcomer trim its queue as already-delivered.
+        # The reference detects this as a peer reset in the connect
+        # handshake (msg/simple/Pipe.cc "existing connection reset",
+        # addr nonce + connect_seq) and zeroes in_seq the same way.
+        import os as _os
+        # 63 bits: the wire TLV int is signed 64-bit
+        self._sess_nonce = (int.from_bytes(_os.urandom(8), "little")
+                            >> 1) | 1
+        # peer entity -> the incarnation its _sess_rx entry belongs to
+        self._sess_rx_nonce: Dict[str, int] = {}
         # inbound socket -> peer entity (from session hello)
         self._sess_peer: Dict[socket.socket, str] = {}
         # outbound socket -> dst name (for routing acks back to tx state)
@@ -273,7 +287,8 @@ class TcpNetwork(Network):
 
     def _session_hello(self, s: socket.socket, dst: str) -> int:
         """-> peer's last delivered seq from us (for resend trimming)."""
-        body = encode_blob({"entity": self.local_entity})
+        body = encode_blob({"entity": self.local_entity,
+                            "nonce": self._sess_nonce})
         s.sendall(_HDR.pack(len(body), _SESS_DLEN, _S_HELLO) + body)
         op, reply = self._read_ctrl_frame(s, _SESS_DLEN)
         if op != _S_HELLO_ACK or "last_seq" not in reply:
@@ -604,6 +619,12 @@ class TcpNetwork(Network):
                 out = encode_blob({"error": err})
             else:
                 self._sess_peer[s] = entity
+                nonce = int(body.get("nonce", 0))
+                if self._sess_rx_nonce.get(entity) != nonce:
+                    # new incarnation of this peer: its seq space
+                    # restarted, so the old high-water mark is void
+                    self._sess_rx_nonce[entity] = nonce
+                    self._sess_rx[entity] = 0
                 out = encode_blob(
                     {"last_seq": self._sess_rx.get(entity, 0)})
             s.sendall(_HDR.pack(len(out), _SESS_DLEN, _S_HELLO_ACK)

@@ -1,3 +1,5 @@
 from .memstore import MemStore, Transaction, hobject_t
+from .walstore import WALStore, mount_store
 
-__all__ = ["MemStore", "Transaction", "hobject_t"]
+__all__ = ["MemStore", "Transaction", "hobject_t", "WALStore",
+           "mount_store"]

@@ -316,20 +316,47 @@ class OSD(Dispatcher):
                            for cid in cids
                            if not cid.endswith("_meta")
                            and "s" in cid.split(".")[-1]})
-            from .pg_log import LAST_UPDATE_ATTR, PG_META_OID
-            lu = 0
-            mcid = f"{pg_id[0]}.{pg_id[1]}_meta"
-            meta = hobject_t(PG_META_OID)
-            if self.store.collection_exists(mcid) and \
-                    self.store.exists(mcid, meta):
-                b = self.store.getattrs(mcid, meta).get(LAST_UPDATE_ATTR)
-                if b:
-                    lu = struct.unpack("<Q", b)[0]
+            lu = self._stray_high_water(pg_id, cids)
             self.messenger.send_message(MOSDPGNotify(
                 pgid=pg_id, epoch=self.osdmap.epoch,
                 from_osd=self.osd_id, held_shards=held,
                 last_update=lu),
                 f"osd.{actp}")
+
+    def _stray_high_water(self, pg_id: Tuple[int, int],
+                          cids: List[str]) -> int:
+        """Highest version this stray can actually serve: log head attr
+        plus stored VERSION_ATTRs.  Pushed objects can be newer than the
+        stray's own log (realign/backfill), and the primary's
+        keep-or-delete decision compares against what the stray can
+        serve — under-reporting could authorize deleting the only newer
+        copy (mirror of PG.data_high_water, with the same
+        committed_txns-keyed cache: this rescans every notify retry)."""
+        cache = getattr(self, "_stray_hw_cache", None)
+        if cache is None:
+            cache = self._stray_hw_cache = {}
+        key = self.store.committed_txns
+        hit = cache.get(pg_id)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        from .pg_log import LAST_UPDATE_ATTR, PG_META_OID, VERSION_ATTR
+        lu = 0
+        mcid = f"{pg_id[0]}.{pg_id[1]}_meta"
+        meta = hobject_t(PG_META_OID)
+        if self.store.collection_exists(mcid) and \
+                self.store.exists(mcid, meta):
+            b = self.store.getattrs(mcid, meta).get(LAST_UPDATE_ATTR)
+            if b:
+                lu = struct.unpack("<Q", b)[0]
+        for cid in cids:
+            if cid.endswith("_meta"):
+                continue
+            for ho in self.store.list_objects(cid):
+                vb = self.store.getattrs(cid, ho).get(VERSION_ATTR)
+                if vb:
+                    lu = max(lu, struct.unpack("<Q", vb)[0])
+        cache[pg_id] = (key, lu)
+        return lu
 
     def _handle_pg_notify(self, msg: MOSDPGNotify) -> None:
         """Primary: a stray holds our data; authorize removal only when
@@ -597,6 +624,17 @@ class OSD(Dispatcher):
                          epoch=self.osdmap.epoch), f"osd.{peer}")
         self.maybe_schedule_scrubs()
         self._report_strays()
+        # map says down but we are alive: keep asking back in every tick
+        # (the reference's OSD::start_boot retries; a single send can be
+        # lost while connections re-establish after a daemon reboot)
+        if 0 <= self.osd_id < self.osdmap.max_osd and \
+                self.osdmap.epoch > 0 and \
+                not self.osdmap.is_up(self.osd_id):
+            from ..msg.messages import MOSDBoot
+            for mon in self.mon_names:
+                self.messenger.send_message(
+                    MOSDBoot(osd=self.osd_id, epoch=self.osdmap.epoch),
+                    mon)
         # sweep probe callbacks whose replies died with their peer
         for tid in [t for t, t0 in self._rep_pull_stamps.items()
                     if now - t0 > 60.0]:
@@ -904,6 +942,9 @@ class OSD(Dispatcher):
                            xattrs=uattrs, omap=omap)
 
         self._rep_pulls[tid] = on_pull
+        # stamped like the probe path: the sweep in tick() reaps this
+        # closure if the source dies before replying
+        self._rep_pull_stamps[tid] = self.now
         pg.send_to_osd(pg.acting_shards()[srcs[0]], MOSDECSubOpRead(
             tid=tid, pgid=pg.pgid, shard=-1, oid=oid))
 

@@ -421,8 +421,16 @@ class PG:
     def data_high_water(self) -> int:
         """Highest object version this replica can actually SERVE —
         max of the log head and stored VERSION_ATTRs (pushed data can
-        be newer than the local log after a realign/backfill)."""
+        be newer than the local log after a realign/backfill).
+
+        Cached against the store's commit counter: a refused stray
+        notify retries every few seconds forever, and an O(objects)
+        attr walk per retry on an idle cluster is pure waste."""
         store = self.osd.store
+        cache = getattr(self, "_dhw_cache", None)
+        key = (store.committed_txns, self.pg_log.head)
+        if cache is not None and cache[0] == key:
+            return cache[1]
         hi = self.pg_log.head
         if self.backend is not None:
             prefix = f"{self.pgid[0]}.{self.pgid[1]}s"
@@ -437,6 +445,7 @@ class PG:
                 vb = store.getattrs(cid, ho).get(VERSION_ATTR)
                 if vb:
                     hi = max(hi, struct.unpack("<Q", vb)[0])
+        self._dhw_cache = (key, hi)
         return hi
 
     # ---- identity ---------------------------------------------------------

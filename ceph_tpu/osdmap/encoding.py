@@ -205,6 +205,8 @@ def osdmap_to_dict(m) -> Dict[str, Any]:
         "pg_temp": {_pgid_key(k): list(v) for k, v in m.pg_temp.items()},
         "primary_temp": {_pgid_key(k): v
                          for k, v in m.primary_temp.items()},
+        "osd_old_weight": {str(k): v
+                           for k, v in m.osd_old_weight.items()},
         "erasure_code_profiles": {k: dict(v) for k, v in
                                   m.erasure_code_profiles.items()},
         "crush": crush_to_dict(m.crush),
@@ -231,6 +233,8 @@ def osdmap_from_dict(d: Dict[str, Any]):
                  for k, v in d["pg_temp"].items()}
     m.primary_temp = {_pgid_from_key(k): v
                       for k, v in d["primary_temp"].items()}
+    m.osd_old_weight = {int(k): v for k, v in
+                        d.get("osd_old_weight", {}).items()}
     m.erasure_code_profiles = {k: dict(v) for k, v in
                                d["erasure_code_profiles"].items()}
     m.crush = crush_from_dict(d["crush"])
@@ -248,6 +252,8 @@ def incremental_to_dict(inc) -> Dict[str, Any]:
         "old_pools": list(inc.old_pools),
         "new_up": {str(k): v for k, v in inc.new_up.items()},
         "new_weight": {str(k): v for k, v in inc.new_weight.items()},
+        "new_old_weight": {str(k): v
+                           for k, v in inc.new_old_weight.items()},
         "new_primary_affinity": {str(k): v for k, v in
                                  inc.new_primary_affinity.items()},
         "new_pg_upmap": {_pgid_key(k): list(v)
@@ -279,6 +285,8 @@ def incremental_from_dict(d: Dict[str, Any]):
     inc.old_pools = list(d["old_pools"])
     inc.new_up = {int(k): v for k, v in d["new_up"].items()}
     inc.new_weight = {int(k): v for k, v in d["new_weight"].items()}
+    inc.new_old_weight = {int(k): v for k, v in
+                          d.get("new_old_weight", {}).items()}
     inc.new_primary_affinity = {int(k): v for k, v in
                                 d["new_primary_affinity"].items()}
     inc.new_pg_upmap = {_pgid_from_key(k): list(v)

@@ -42,6 +42,9 @@ class Incremental:
     old_pools: List[int] = field(default_factory=list)
     new_up: Dict[int, bool] = field(default_factory=dict)       # osd -> up?
     new_weight: Dict[int, int] = field(default_factory=dict)
+    # weight to restore if the osd boots after an AUTO out (replicated
+    # like osd_xinfo_t::old_weight, osd/OSDMap.h; 0 = clear the memo)
+    new_old_weight: Dict[int, int] = field(default_factory=dict)
     new_primary_affinity: Dict[int, int] = field(default_factory=dict)
     new_pg_upmap: Dict[pg_t, List[int]] = field(default_factory=dict)
     old_pg_upmap: List[pg_t] = field(default_factory=list)
@@ -69,6 +72,9 @@ class OSDMap:
         self.pg_upmap_items: Dict[pg_t, List[Tuple[int, int]]] = {}
         self.pg_temp: Dict[pg_t, List[int]] = {}
         self.primary_temp: Dict[pg_t, int] = {}
+        # osd -> weight before an automatic out (osd_xinfo_t::old_weight):
+        # lives in the map so every mon agrees across failovers
+        self.osd_old_weight: Dict[int, int] = {}
         self.erasure_code_profiles: Dict[str, Dict[str, str]] = {}
         self.crush = CrushWrapper()
 
@@ -326,6 +332,11 @@ class OSDMap:
                 self.set_max_osd(osd + 1)
             self.osd_state[osd] |= CEPH_OSD_EXISTS
             self.osd_weight[osd] = w
+        for osd, w in inc.new_old_weight.items():
+            if w:
+                self.osd_old_weight[osd] = w
+            else:
+                self.osd_old_weight.pop(osd, None)
         for osd, a in inc.new_primary_affinity.items():
             self.set_primary_affinity(osd, a)
         for pg in inc.old_pg_upmap:

@@ -105,7 +105,14 @@ def osd_main(args) -> None:
             if net.authenticate():
                 break
             time.sleep(0.2)
-    daemon = osd_mod.OSD(net, args.id, mon_name="mon")
+    store = None
+    if args.data_dir:
+        # durable boot (OSD::init, osd/OSD.cc:2469): mount the WAL
+        # store — a rebooted daemon replays its journal and resumes
+        # with its PG logs/data intact, so recovery is log-based
+        from .os_store.walstore import mount_store
+        store = mount_store(args.data_dir)
+    daemon = osd_mod.OSD(net, args.id, mon_name="mon", store=store)
     # boot subscription: the mon's startup map pushes predate this
     # process's listener, so ask for the full history explicitly
     # (MonClient::sub_want("osdmap") at OSD::init)
@@ -149,8 +156,12 @@ class ProcessCluster:
                  heartbeat_grace: float = 4.0,
                  down_out_interval: float = 5.0,
                  client_names: Tuple[str, ...] = ("client.x",),
-                 auth: bool = False):
+                 auth: bool = False,
+                 data_root: Optional[str] = None):
         self.n_osds = n_osds
+        self.data_root = data_root
+        if data_root:
+            os.makedirs(data_root, exist_ok=True)
         self.keyring_path: Optional[str] = None
         self._tmpdir: Optional[str] = None
         if auth:
@@ -205,15 +216,12 @@ class ProcessCluster:
         # spawn every osd CONCURRENTLY: a sequential boot staggers the
         # daemons' first heartbeats past the grace window and the
         # cluster marks itself down before it finishes starting
+        self._osd_args = {"dir_json": dir_json, "env": env,
+                          "heartbeat_interval": heartbeat_interval,
+                          "heartbeat_grace": heartbeat_grace,
+                          "keyring_args": keyring_args}
         for i in range(n_osds):
-            self.procs[f"osd.{i}"] = subprocess.Popen(
-                [sys.executable, "-m", "ceph_tpu.vstart", "osd",
-                 "--id", str(i), "--port", str(self.osd_ports[i]),
-                 "--directory", dir_json,
-                 "--heartbeat-interval", str(heartbeat_interval),
-                 "--heartbeat-grace", str(heartbeat_grace),
-                 *keyring_args],
-                stdout=subprocess.PIPE, text=True, cwd=REPO, env=env)
+            self._spawn_osd(i)
         for i in range(n_osds):
             self._await_ready(f"osd.{i}")
         from .msg.tcp import TcpNetwork
@@ -255,11 +263,36 @@ class ProcessCluster:
             time.sleep(0.2)
         raise RuntimeError("cluster never became healthy")
 
+    def _spawn_osd(self, i: int) -> None:
+        a = self._osd_args
+        data_args = ([]
+                     if not self.data_root else
+                     ["--data-dir",
+                      os.path.join(self.data_root, f"osd.{i}")])
+        self.procs[f"osd.{i}"] = subprocess.Popen(
+            [sys.executable, "-m", "ceph_tpu.vstart", "osd",
+             "--id", str(i), "--port", str(self.osd_ports[i]),
+             "--directory", a["dir_json"],
+             "--heartbeat-interval", str(a["heartbeat_interval"]),
+             "--heartbeat-grace", str(a["heartbeat_grace"]),
+             *a["keyring_args"], *data_args],
+            stdout=subprocess.PIPE, text=True, cwd=REPO, env=a["env"])
+
     def kill_osd(self, osd_id: int) -> None:
         """kill -9 the daemon process (ceph_manager.py:195)."""
         p = self.procs[f"osd.{osd_id}"]
         p.send_signal(signal.SIGKILL)
         p.wait()
+
+    def restart_osd(self, osd_id: int) -> None:
+        """Boot a fresh daemon process on the same port + data dir
+        (ceph_manager.py:373 revive_osd): with a data_root, the new
+        process remounts its WALStore and rejoins with its history."""
+        old = self.procs.get(f"osd.{osd_id}")
+        if old is not None and old.poll() is None:
+            raise RuntimeError(f"osd.{osd_id} is still running")
+        self._spawn_osd(osd_id)
+        self._await_ready(f"osd.{osd_id}")
 
     def pump_for(self, seconds: float) -> None:
         """Keep the client-side socket drained while the daemons work."""
@@ -303,6 +336,7 @@ def main(argv=None) -> None:
     po.add_argument("--heartbeat-interval", type=float, default=0.0)
     po.add_argument("--heartbeat-grace", type=float, default=0.0)
     po.add_argument("--keyring", default="")
+    po.add_argument("--data-dir", default="")
     po.add_argument("--debug", type=int,
                     default=int(os.environ.get("VSTART_DEBUG", "0")))
     args = ap.parse_args(argv)

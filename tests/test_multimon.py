@@ -360,3 +360,38 @@ def test_demoted_queued_topology_proposal_leaves_no_ghost():
     for m in c.mons:
         assert list(m.osdmap.pools[pid].snaps.values()) == ["real"], m.name
         assert m.osdmap.osd_weight[3] == 0, m.name
+
+
+def test_auto_out_weight_restored_across_leader_failover():
+    """The pre-out weight memo rides the replicated map
+    (osd_xinfo_t::old_weight, src/osd/OSDMap.h), so an osd that was
+    AUTOMATICALLY marked out recovers its weight when it boots even if
+    a different mon leads by then (OSDMonitor::prepare_boot +
+    mon_osd_auto_mark_auto_out_in)."""
+    c = MiniCluster(n_osds=5, n_mons=3)
+    c.create_ec_pool("p", k=3, m=2, pg_num=8, plugin="tpu")
+    for m in c.mons:
+        m.down_out_interval = 10.0
+    victim = 4
+    w_before = c.mon.osdmap.osd_weight[victim]
+    assert w_before > 0
+    c.kill_osd(victim)
+    for _ in range(8):                    # detect + down->out eviction
+        c.tick(dt=6.0)
+    assert not c.mon.osdmap.is_up(victim)
+    assert c.mon.osdmap.osd_weight[victim] == 0
+    # the memo is in every mon's replicated map, not leader RAM
+    for m in c.mons:
+        assert m.osdmap.osd_old_weight.get(victim) == w_before
+    c.kill_mon(0)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    assert c.mon.name == "mon.1" and c.mon.is_leader()
+    c.revive_osd(victim)
+    for _ in range(4):
+        c.tick(dt=6.0)
+    m = c.mon.osdmap
+    assert m.is_up(victim)
+    assert m.osd_weight[victim] == w_before, \
+        "auto-out weight memo lost across leader failover"
+    assert victim not in m.osd_old_weight  # memo consumed

@@ -182,3 +182,41 @@ def test_lossless_with_auth_signing(tmp_path):
             n.close()
     assert [m.rank for m in sink.got] == list(range(30))
     assert b.auth_rejects == 0
+
+
+def test_rebooted_peer_seq_space_resets():
+    """A daemon that dies and reboots restarts its send seqs at 1; the
+    receiver must treat the new incarnation as a fresh session instead
+    of swallowing every frame as a reconnect duplicate (the reference's
+    peer-reset detection: msg/simple/Pipe.cc "existing connection
+    reset" zeroes in_seq via the addr nonce + connect_seq exchange)."""
+    pa, pb = _free_port(), _free_port()
+    directory = {"osd.0": ("127.0.0.1", pa), "mon": ("127.0.0.1", pb)}
+    mon_net = TcpNetwork(("127.0.0.1", pb), directory, entity="mon")
+    sink = _Sink()
+    mon_net.create_messenger("mon").add_dispatcher_head(sink)
+    srv = _Server(mon_net)
+    a = TcpNetwork(("127.0.0.1", pa), directory, entity="osd.0")
+    try:
+        for i in range(5):
+            a.send("osd.0", "mon", MMonPing(rank=i))
+        a.pump(quiesce=0.02, deadline=2.0)
+        deadline = time.monotonic() + 5
+        while len(sink.got) < 5 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(sink.got) == 5
+        # daemon reboot: same entity + port, fresh process state
+        a.close()
+        a = TcpNetwork(("127.0.0.1", pa), directory, entity="osd.0")
+        for i in range(5, 9):
+            a.send("osd.0", "mon", MMonPing(rank=i))
+        a.pump(quiesce=0.02, deadline=2.0)
+        deadline = time.monotonic() + 5
+        while len(sink.got) < 9 and time.monotonic() < deadline:
+            a.pump(quiesce=0.02, deadline=0.2)
+        assert [m.rank for m in sink.got] == list(range(9)), \
+            "post-reboot frames were dropped as stale-session duplicates"
+    finally:
+        srv.close()
+        a.close()
+        mon_net.close()

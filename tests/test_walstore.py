@@ -1,0 +1,189 @@
+"""WALStore: journal-then-apply durability (FileJournal replay semantics,
+src/os/filestore/FileJournal.{h,cc}; BlueStore fsck role)."""
+import os
+import struct
+
+import pytest
+
+from ceph_tpu.os_store import Transaction, hobject_t
+from ceph_tpu.os_store.walstore import (WALStore, mount_store, encode_txn,
+                                        decode_txn, _HDR, _REC_MAGIC)
+
+
+def _txn(i: int) -> Transaction:
+    t = Transaction()
+    cid = "0.0s0"
+    oid = hobject_t(f"obj{i}", 0)
+    t.create_collection(cid)
+    t.write(cid, oid, 0, bytes([i % 256]) * 64)
+    t.setattr(cid, oid, "v", struct.pack("<Q", i))
+    t.omap_setkeys(cid, oid, {f"k{i}": b"val"})
+    return t
+
+
+def test_txn_codec_roundtrip():
+    t = Transaction()
+    t.create_collection("1.2s3")
+    o = hobject_t("x", 3)
+    t.touch("1.2s3", o)
+    t.write("1.2s3", o, 7, b"hello")
+    t.zero("1.2s3", o, 2, 3)
+    t.truncate("1.2s3", o, 9)
+    t.setattr("1.2s3", o, "a", b"\x00\xff")
+    t.rmattr("1.2s3", o, "a")
+    t.omap_setkeys("1.2s3", o, {"k1": b"v1", "k2": b""})
+    t.omap_rmkeys("1.2s3", o, ["k1"])
+    t.remove("1.2s3", o)
+    t.remove_collection("1.2s3")
+    assert decode_txn(encode_txn(t)).ops == t.ops
+
+
+def test_mount_replay_roundtrip(tmp_path):
+    d = str(tmp_path / "osd0")
+    s = mount_store(d)
+    for i in range(10):
+        s.queue_transaction(_txn(i))
+    # NO umount: simulates kill -9 (the OS keeps the flushed WAL)
+    s._wal_f.close()
+    s2 = mount_store(d)
+    assert s2.committed_txns == 10
+    for i in range(10):
+        assert s2.read("0.0s0", hobject_t(f"obj{i}", 0))[:1] == \
+            bytes([i % 256])
+        assert struct.unpack(
+            "<Q", s2.getattr("0.0s0", hobject_t(f"obj{i}", 0), "v"))[0] == i
+    assert s2.omap_get("0.0s0", hobject_t("obj3", 0)) == {"k3": b"val"}
+
+
+def test_clean_umount_checkpoints(tmp_path):
+    d = str(tmp_path / "osd0")
+    s = mount_store(d)
+    for i in range(5):
+        s.queue_transaction(_txn(i))
+    s.umount()
+    assert os.path.getsize(os.path.join(d, "wal.bin")) == 0
+    s2 = mount_store(d)
+    assert s2.committed_txns == 5
+    assert s2.exists("0.0s0", hobject_t("obj4", 0))
+
+
+def test_torn_tail_replays_prefix(tmp_path):
+    """A partially-written last record (crash mid-append) must not poison
+    the intact prefix — replay stops at the tear."""
+    d = str(tmp_path / "osd0")
+    s = mount_store(d)
+    for i in range(6):
+        s.queue_transaction(_txn(i))
+    s._wal_f.close()
+    wal = os.path.join(d, "wal.bin")
+    with open(wal, "r+b") as f:
+        f.truncate(os.path.getsize(wal) - 11)     # tear the last record
+    s2 = mount_store(d)
+    assert s2.committed_txns == 5                  # txns 1..5 survive
+    assert s2.exists("0.0s0", hobject_t("obj4", 0))
+    assert not s2.exists("0.0s0", hobject_t("obj5", 0))
+
+
+def test_corrupt_record_stops_replay_and_fsck_reports(tmp_path):
+    d = str(tmp_path / "osd0")
+    s = mount_store(d)
+    for i in range(4):
+        s.queue_transaction(_txn(i))
+    s._wal_f.close()
+    wal = os.path.join(d, "wal.bin")
+    buf = bytearray(open(wal, "rb").read())
+    # flip one payload byte in the SECOND record
+    magic, seq, ln, crc = _HDR.unpack_from(buf, 0)
+    assert magic == _REC_MAGIC and seq == 1
+    second = _HDR.size + ln
+    buf[second + _HDR.size + 5] ^= 0xFF
+    open(wal, "wb").write(bytes(buf))
+    rep = WALStore(d).fsck()                       # offline, pre-recovery
+    assert rep["wal_torn_tail"]                    # crc break = frontier
+    assert rep["wal_records"] == 1
+    s2 = mount_store(d)
+    assert s2.committed_txns == 1                  # only record 1 applies
+    # recovery cut the log at the frontier: a re-check is clean
+    rep2 = s2.fsck()
+    assert not rep2["wal_torn_tail"] and rep2["wal_records"] == 1
+
+
+def test_checkpoint_roll_and_recovery(tmp_path):
+    """Exceeding wal_max_bytes checkpoints + truncates; old WAL records
+    whose seq is under the fence are skipped on the next mount."""
+    d = str(tmp_path / "osd0")
+    s = WALStore(d, wal_max_bytes=2048)
+    s.mount()
+    for i in range(40):
+        s.queue_transaction(_txn(i))
+    assert os.path.exists(os.path.join(d, "checkpoint.bin"))
+    assert s._wal_size < 2048 + 1024               # rolled recently
+    s._wal_f.close()
+    s2 = mount_store(d)
+    assert s2.committed_txns == 40
+    assert s2.exists("0.0s0", hobject_t("obj39", 0))
+    rep = s2.fsck()
+    assert rep["ok"] and not rep["wal_torn_tail"]
+    assert rep["checkpoint"]["seq"] >= 1
+
+
+def test_fsck_clean_store(tmp_path):
+    d = str(tmp_path / "osd0")
+    s = mount_store(d)
+    s.queue_transaction(_txn(0))
+    s.umount()
+    rep = WALStore(d).fsck()
+    assert rep["ok"]
+    assert rep["checkpoint"]["objects"] == 1
+    assert rep["wal_records"] == 0
+
+
+def test_unmounted_degrades_to_memstore(tmp_path):
+    s = WALStore(str(tmp_path / "x"))
+    s.queue_transaction(_txn(0))                   # no mount(): no files
+    assert s.exists("0.0s0", hobject_t("obj0", 0))
+    assert not os.path.exists(str(tmp_path / "x" / "wal.bin"))
+
+
+def test_append_after_torn_tail_survives_second_crash(tmp_path):
+    """Recovery must CUT the log at the torn frontier before appending:
+    post-recovery commits written after torn garbage would be stranded
+    behind bytes the next replay refuses to cross."""
+    d = str(tmp_path / "osd0")
+    s = mount_store(d)
+    for i in range(6):
+        s.queue_transaction(_txn(i))
+    s._wal_f.close()
+    wal = os.path.join(d, "wal.bin")
+    with open(wal, "r+b") as f:
+        f.truncate(os.path.getsize(wal) - 7)       # tear record 6
+    s2 = mount_store(d)                            # recovers 1..5
+    assert s2.committed_txns == 5
+    s2.queue_transaction(_txn(100))                # post-recovery commits
+    s2.queue_transaction(_txn(101))
+    s2._wal_f.close()                              # second kill -9
+    s3 = mount_store(d)
+    assert s3.committed_txns == 7
+    assert s3.exists("0.0s0", hobject_t("obj101", 0)), \
+        "post-recovery write stranded behind torn garbage"
+
+
+def test_failed_apply_rewinds_journal(tmp_path):
+    """A transaction that fails validation must not leave a poison WAL
+    record (its seq would collide with the next good commit and break
+    the next mount)."""
+    d = str(tmp_path / "osd0")
+    s = mount_store(d)
+    s.queue_transaction(_txn(0))
+    bad = Transaction()
+    bad.rmattr("no_such_coll", hobject_t("x"), "a")   # raises pre-apply
+    with pytest.raises(KeyError):
+        s.queue_transaction(bad)
+    assert s.committed_txns == 1
+    s.queue_transaction(_txn(1))                   # reuses the seq slot
+    s._wal_f.close()
+    s2 = mount_store(d)                            # must not raise
+    assert s2.committed_txns == 2
+    assert s2.exists("0.0s0", hobject_t("obj1", 0))
+    rep = s2.fsck()
+    assert rep["ok"] and rep["wal_records"] == 2
