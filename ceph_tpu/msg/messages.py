@@ -209,6 +209,10 @@ class MOSDPGInfo(Message):
     # per-head snapset blobs: clone bookkeeping must survive primary
     # failover/backfill, so it rides peering like the log does
     snapsets: List[Tuple[str, bytes]] = field(default_factory=list)
+    # snaps this replica knows were fully trimmed
+    # (pg_info_t.purged_snaps role) — unioned at peering so a primary
+    # that died mid-trim is finished by its successor, never redone
+    purged_snaps: List[int] = field(default_factory=list)
     # backfill completion (last_backfill == MAX role): the target holds
     # every object the primary knew, so it adopts the primary's log
     # WHOLESALE (entries + head + tail) — without this a pushed-only

@@ -224,6 +224,14 @@ class PG:
         # object on this shard — every replica has it (SnapSet role)
         self.snapsets: Dict[str, List[Tuple[int, int]]] = \
             load_snapsets(osd.store, self.meta_cid())
+        # snap -> heads index (SnapMapper role) + what was already
+        # trimmed (pg_info_t.purged_snaps role, persisted so a primary
+        # dying mid-trim is finished by its successor)
+        from .snap_mapper import SnapMapper, load_purged
+        self.purged_snaps: Set[int] = load_purged(osd.store,
+                                                  self.meta_cid())
+        self.snap_mapper = SnapMapper()
+        self.snap_mapper.rebuild(self.snapsets, self._interesting_snaps())
         # watch/notify: primary-side in-memory state (Watch.cc role;
         # watchers re-register after a primary change, like clients do
         # on watch timeout in the reference)
@@ -388,10 +396,19 @@ class PG:
                 tc.omap_setkeys(ccid_meta, meta, move_keys)
                 t_parent.omap_rmkeys(pcid_meta, meta,
                                      list(move_keys))
+            # the child inherits the parent's trim history FIRST (its
+            # objects were governed by it until this instant) so the
+            # index entries built below exclude already-purged snaps
+            child._adopt_purged(sorted(self.purged_snaps))
             # in-memory state follows
+            child_interesting = child._interesting_snaps()
             for oid in list(self.snapsets):
                 if target_ps(oid) == tps:
                     child.snapsets[oid] = self.snapsets.pop(oid)
+                    self.snap_mapper.update_oid(
+                        oid, [], ())
+                    child.snap_mapper.update_oid(
+                        oid, child.snapsets[oid], child_interesting)
             for oid in list(self.local_missing):
                 if target_ps(oid) == tps:
                     child.local_missing[oid] = \
@@ -580,9 +597,14 @@ class PG:
         changed = (acting != self.acting or actp != self.acting_primary)
         self.up, self.up_primary = up, upp
         self.acting, self.acting_primary = acting, actp
-        if snaps_changed:
+        if snaps_changed and not changed:
             # AFTER the acting update: trim must fan from the new
-            # epoch's primary to the new acting set
+            # epoch's primary to the new acting set.  If the acting set
+            # itself changed in this epoch, defer to the peering we are
+            # about to start — _activate re-drives the trim once peer
+            # snapsets/purged knowledge has been merged (a freshly
+            # promoted primary trimming now could record purged off
+            # near-empty knowledge)
             self._maybe_trim_snaps()
         if not (changed or self.state == STATE_INITIAL):
             return
@@ -634,6 +656,7 @@ class PG:
             missing_oids=[(o, v) for o, (v, _op)
                           in self.local_missing.items()],
             snapsets=self._encoded_snapsets(),
+            purged_snaps=sorted(self.purged_snaps),
             held_shards=self.held_shards()), msg.src)
 
     def held_shards(self) -> List[int]:
@@ -1127,6 +1150,7 @@ class PG:
         my_shard = self.my_shard()
         for info in self._peer_infos.values():
             self.merge_snapsets(info.snapsets)
+            self._adopt_purged(info.purged_snaps)
         for oid, (v, op) in self.local_missing.items():
             self.missing.setdefault(my_shard, {}).setdefault(oid, (v, op))
         for shard, info in self._peer_infos.items():
@@ -1165,11 +1189,16 @@ class PG:
                 last_update=self.pg_log.head,
                 log_tail=self.pg_log.tail,
                 log_entries=[e.encode() for e in suffix],
-                snapsets=self._encoded_snapsets()))
+                snapsets=self._encoded_snapsets(),
+                purged_snaps=sorted(self.purged_snaps)))
         self.state = STATE_ACTIVE_RECOVERING if self._has_missing() \
             else STATE_ACTIVE
         if self.state == STATE_ACTIVE_RECOVERING or self._backfill_pending:
             self.osd.request_recovery(self)
+        # a predecessor may have died between the snap-removal epoch and
+        # its trim pass: removed_snaps - (unioned) purged_snaps is the
+        # outstanding debt, and we are now the one who owes it
+        self._maybe_trim_snaps()
 
     def send_backfill_complete(self, shard: int) -> None:
         """Primary: this shard now holds every object we tracked —
@@ -1184,7 +1213,8 @@ class PG:
             epoch=self.last_epoch_started,
             last_update=self.pg_log.head, log_tail=self.pg_log.tail,
             log_entries=[e.encode() for e in self.pg_log.entries],
-            snapsets=self._encoded_snapsets(), adopt_log=True))
+            snapsets=self._encoded_snapsets(),
+            purged_snaps=sorted(self.purged_snaps), adopt_log=True))
 
     def _adopt_full_log(self, msg: MOSDPGInfo) -> None:
         """Backfill target: adopt the primary's log window (entries +
@@ -1218,6 +1248,7 @@ class PG:
         entries whose data has not arrived are recorded in local_missing
         (the head advances, the data debt does not vanish — pg_missing_t);
         delete entries apply immediately (reference merge_log)."""
+        self._adopt_purged(msg.purged_snaps)
         if msg.adopt_log:
             self._adopt_full_log(msg)
             return
@@ -1646,6 +1677,29 @@ class PG:
     # into every replica's PG meta object.  A read at snap s resolves to
     # the earliest entry with seq >= s (whiteout -> ENOENT; none -> head).
 
+    def _adopt_purged(self, snaps: List[int]) -> None:
+        """Union a peer's purged_snaps into ours (peering exchange —
+        trim-is-done knowledge must survive any single death)."""
+        extra = set(snaps) - self.purged_snaps
+        if not extra:
+            return
+        from .snap_mapper import stage_purged
+        self.purged_snaps |= extra
+        t = Transaction()
+        self.ensure_meta_collection(t)
+        stage_purged(t, self.meta_cid(), self.purged_snaps)
+        self.osd.store.queue_transaction(t)
+
+    def _interesting_snaps(self) -> Set[int]:
+        """Snap ids the SnapMapper indexes: live plus removed ones —
+        deliberately NOT minus purged_snaps.  The index must stay a
+        truthful "who still references this snap" so the trimmer can
+        detect a purged marker whose trim never actually landed (a
+        primary killed between staging purged and the fan-out being
+        delivered) and redo it; purged_snaps is a fast-path hint, not
+        ground truth."""
+        return set(self.pool.snaps) | set(self.pool.removed_snaps)
+
     @staticmethod
     def _clone_oid(oid: str, seq: int) -> str:
         return f"{oid}\x00snap\x00{seq}"
@@ -1705,6 +1759,8 @@ class PG:
         entries.append((seq, kind))
         blob = encode_snapset(entries)
         self.snapsets[oid] = entries
+        self.snap_mapper.update_oid(oid, entries,
+                                    self._interesting_snaps())
         dlog("pg", 5, f"cloning {oid} @ seq {seq} "
              f"({'clone' if kind else 'whiteout'})",
              f"osd.{self.osd.osd_id}")
@@ -1744,6 +1800,7 @@ class PG:
             return
         t = Transaction()
         changed = False
+        interesting = self._interesting_snaps()
 
         def rank(entries):
             # trimmed beats clone/whiteout at the same seq, so a trim
@@ -1766,6 +1823,7 @@ class PG:
                     t.create_collection(self.meta_cid())
                 stage_snapset(t, self.meta_cid(), oid, blob)
                 self.snapsets[oid] = ents
+                self.snap_mapper.update_oid(oid, ents, interesting)
                 changed = True
         if changed:
             self.osd.store.queue_transaction(t)
@@ -1783,6 +1841,8 @@ class PG:
             self.snapsets[oid] = decode_snapset(blob)
         else:
             self.snapsets.pop(oid, None)
+        self.snap_mapper.update_oid(oid, self.snapsets.get(oid, []),
+                                    self._interesting_snaps())
 
     def resolve_snap(self, oid: str, snapid: int):
         """-> (target_oid | None for ENOENT).  Earliest snapset entry
@@ -1800,11 +1860,39 @@ class PG:
     def _maybe_trim_snaps(self) -> None:
         """Drop clones covering only removed snaps (snap trimmer role).
         Entry (S, kind) covers pool snaps s with prev_S < s <= S; when no
-        live snap falls in that window the clone is garbage."""
+        live snap falls in that window the clone is garbage.
+
+        The candidates come from the SnapMapper index (snap -> heads),
+        not a scan of every snapset, and the snaps to handle come from
+        ``removed_snaps - purged_snaps`` rather than "did this epoch
+        change them" — so a primary that died before trimming is
+        finished by its successor at the next activation (the
+        reference's purged_snaps catch-up, src/osd/PrimaryLogPG.cc
+        AwaitAsyncWork + pg_info_t.purged_snaps)."""
         if not self.is_primary():
             return
+        if self.state not in (STATE_ACTIVE, STATE_ACTIVE_RECOVERING):
+            # mid-peering our snapsets/purged knowledge is incomplete —
+            # recording purged now would mark debt paid that was never
+            # collected; _activate re-calls us once the merge is done
+            return
+        # unpurged removed snaps, PLUS purged ones the index says are
+        # still referenced — a purged marker can outlive a crash that
+        # swallowed the trim's fan-out, and only the index knows
+        to_purge = {s for s in self.pool.removed_snaps
+                    if s not in self.purged_snaps
+                    or self.snap_mapper.lookup(s)}
+        if not to_purge:
+            return
+        candidates: Set[str] = set()
+        for sid in to_purge:
+            candidates |= self.snap_mapper.lookup(sid)
         live = set(self.pool.snaps)
-        for oid, entries in list(self.snapsets.items()):
+        interesting = self._interesting_snaps()
+        for oid in sorted(candidates):
+            entries = self.snapsets.get(oid)
+            if not entries:
+                continue
             keep = []
             prev = 0
             changed = False
@@ -1829,7 +1917,10 @@ class PG:
                 if trimmed_max:
                     keep = sorted(keep + [(trimmed_max, SNAP_TRIMMED)])
                 self.snapsets[oid] = keep
+                self.snap_mapper.update_oid(oid, keep, interesting)
                 self._fan_snapset(oid, encode_snapset(keep))
+        # record completion so no successor (or later epoch) redoes it
+        self._adopt_purged(sorted(to_purge))
 
     # ---- multi-op vector interpreter (do_osd_ops) --------------------------
 

@@ -1,0 +1,253 @@
+"""SnapMapper index + purged_snaps trim catch-up.
+
+The reference pairs a snap->object omap index (src/osd/SnapMapper.cc,
+get_next_objects_to_trim) with pg_info_t.purged_snaps so the trimmer
+touches only the objects that matter and a primary dying mid-trim is
+finished by its successor.  These tests cover the framework's analogs:
+the derived SnapMapper index, its maintenance across clone/trim/split,
+and the failover catch-up.
+"""
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.osd.snap_mapper import (SnapMapper, decode_purged,
+                                      encode_purged)
+from ceph_tpu.osd.pg_log import SNAP_CLONE, SNAP_TRIMMED, SNAP_WHITEOUT
+
+
+# ---- unit: the index itself -------------------------------------------------
+
+def test_covered_snaps_windows():
+    # entry (seq, kind) covers (prev_seq, seq]
+    entries = [(5, SNAP_CLONE), (9, SNAP_WHITEOUT)]
+    assert SnapMapper.covered_snaps(entries, [3, 5, 7, 9, 11]) == {3, 5, 7, 9}
+    # tombstones cover nothing
+    assert SnapMapper.covered_snaps(
+        [(5, SNAP_TRIMMED), (9, SNAP_CLONE)], [3, 7]) == {7}
+    assert SnapMapper.covered_snaps([], [1, 2]) == set()
+
+
+def test_update_oid_and_lookup():
+    m = SnapMapper()
+    m.update_oid("a", [(5, SNAP_CLONE)], [3, 5])
+    m.update_oid("b", [(5, SNAP_CLONE)], [5])
+    assert m.lookup(3) == {"a"}
+    assert m.lookup(5) == {"a", "b"}
+    # trim a: memberships drop out
+    m.update_oid("a", [(5, SNAP_TRIMMED)], [3, 5])
+    assert m.lookup(3) == set()
+    assert m.lookup(5) == {"b"}
+    # delete b entirely
+    m.update_oid("b", [], [5])
+    assert m.lookup(5) == set()
+    assert m.by_snap == {} and m.by_oid == {}
+
+
+def test_rebuild_matches_incremental():
+    m1, m2 = SnapMapper(), SnapMapper()
+    sets = {"x": [(4, SNAP_CLONE), (8, SNAP_CLONE)],
+            "y": [(6, SNAP_WHITEOUT)],
+            "z": [(8, SNAP_TRIMMED)]}
+    interesting = [2, 4, 6, 8]
+    for oid, ents in sets.items():
+        m1.update_oid(oid, ents, interesting)
+    m2.rebuild(sets, interesting)
+    assert m1.by_snap == m2.by_snap
+    assert m1.by_oid == m2.by_oid
+
+
+def test_purged_codec_roundtrip():
+    assert decode_purged(encode_purged({7, 3, 99})) == {3, 7, 99}
+    assert decode_purged(b"") == set()
+
+
+# ---- integration ------------------------------------------------------------
+
+def _clone_count(c):
+    n = 0
+    for osd in c.osds.values():
+        for cid in osd.store.list_collections():
+            for ho in osd.store.list_objects(cid):
+                if "\x00snap\x00" in ho.oid:
+                    n += 1
+    return n
+
+
+def _pgs_of(c, pool, oid):
+    cl = c.client("client.probe")
+    pid = cl.lookup_pool(pool)
+    pgid, _primary = cl._calc_target(pid, oid)
+    return [osd.pgs[pgid] for osd in c.osds.values()
+            if pgid in osd.pgs]
+
+
+def test_mapper_indexes_only_touched_heads():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("sp", size=3, pg_num=8)
+    cl = c.client("client.s")
+    for i in range(6):
+        cl.write_full("sp", f"o{i}", b"base")
+    sid = c.pool_snap_create("sp", "s1")
+    cl.write_full("sp", "o2", b"changed")      # only o2 clones
+    hit = set()
+    for osd in c.osds.values():
+        for pg in osd.pgs.values():
+            hit |= pg.snap_mapper.lookup(sid)
+    assert hit == {"o2"}
+
+
+def test_trim_updates_index_and_purged():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("sp", size=3, pg_num=8)
+    cl = c.client("client.s")
+    cl.write_full("sp", "o", b"v1")
+    sid = c.pool_snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"v2")
+    assert _clone_count(c) > 0
+    c.pool_snap_rm("sp", "s1")
+    c.network.pump()
+    assert _clone_count(c) == 0
+    pgs = _pgs_of(c, "sp", "o")
+    prim = next(p for p in pgs if p.is_primary())
+    assert sid in prim.purged_snaps
+    assert prim.snap_mapper.lookup(sid) == set()
+
+
+def test_partial_trim_keeps_truthful_index():
+    """A clone window covering a live AND a removed snap keeps its
+    entry at trim; the index keeps truthfully reporting that the clone
+    still covers the removed snap (purged_snaps is a hint, the index is
+    ground truth) and stays exactly what rebuild() would produce."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("sp", size=3, pg_num=8)
+    cl = c.client("client.s")
+    cl.write_full("sp", "o", b"v1")
+    s1 = c.pool_snap_create("sp", "s1")
+    s2 = c.pool_snap_create("sp", "s2")
+    cl.write_full("sp", "o", b"v2")      # one clone covers {s1, s2}
+    c.pool_snap_rm("sp", "s1")
+    c.network.pump()
+    # clone survives (s2 still live in its window) ...
+    assert _clone_count(c) > 0
+    assert cl.read("sp", "o", snap="s2") == b"v1"
+    for pg in _pgs_of(c, "sp", "o"):
+        if not pg.is_primary():
+            continue
+        assert s1 in pg.purged_snaps
+        fresh = SnapMapper()
+        fresh.rebuild(pg.snapsets, pg._interesting_snaps())
+        assert pg.snap_mapper.by_snap == fresh.by_snap
+        assert pg.snap_mapper.by_oid == fresh.by_oid
+        # truth: the surviving clone still covers s1
+        assert pg.snap_mapper.lookup(s1) == {"o"}
+    # removing s2 releases the clone (and the s1 membership with it)
+    c.pool_snap_rm("sp", "s2")
+    c.network.pump()
+    assert _clone_count(c) == 0
+
+
+def test_stale_purged_marker_is_redone():
+    """A purged marker without the trim work behind it (primary killed
+    between staging purged and the fan-out landing) must not suppress
+    the trim: the index still shows references, so it reruns."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("sp", size=3, pg_num=8)
+    cl = c.client("client.s")
+    cl.write_full("sp", "o", b"v1")
+    sid = c.pool_snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"v2")
+    assert _clone_count(c) > 0
+    # forge the crash artifact: purged says done, nothing was done
+    for pg in _pgs_of(c, "sp", "o"):
+        if pg.is_primary():
+            pg._adopt_purged([sid])
+    c.pool_snap_rm("sp", "s1")
+    c.network.pump()
+    assert _clone_count(c) == 0
+    assert cl.read("sp", "o") == b"v2"
+
+
+def test_trim_survives_primary_failover():
+    """Primary never sees the snap removal; its successor owes (and
+    pays) the trim at activation — the purged_snaps catch-up."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("sp", size=3, pg_num=8)
+    cl = c.client("client.s")
+    cl.write_full("sp", "o", b"v1")
+    sid = c.pool_snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"v2")
+    assert _clone_count(c) > 0
+    pid = cl.lookup_pool("sp")
+    _pgid, primary = cl._calc_target(pid, "o")
+    # the primary dies BEFORE the removal epoch reaches it
+    c.kill_osd(primary)
+    c.pool_snap_rm("sp", "s1")
+    c.mark_osd_down(primary)
+    c.mark_osd_out(primary)
+    c.tick(rounds=3)
+    # survivors: clones trimmed by the successor primary
+    live_clones = 0
+    for oid_, osd in c.osds.items():
+        if oid_ == primary:
+            continue
+        for cid in osd.store.list_collections():
+            for ho in osd.store.list_objects(cid):
+                if "\x00snap\x00" in ho.oid:
+                    live_clones += 1
+    assert live_clones == 0
+    pgs = [p for p in _pgs_of(c, "sp", "o")
+           if p.osd.osd_id != primary]
+    assert any(sid in p.purged_snaps for p in pgs)
+    # the old primary comes back: log replay + snapset/purged adoption
+    # deletes its stale clone instead of resurrecting it
+    c.revive_osd(primary)
+    c.tick(rounds=3)
+    assert _clone_count(c) == 0
+    assert cl.read("sp", "o") == b"v2"
+
+
+def test_purged_snaps_survive_checkpoint_restore(tmp_path):
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("sp", size=3, pg_num=8)
+    cl = c.client("client.s")
+    cl.write_full("sp", "o", b"v1")
+    sid = c.pool_snap_create("sp", "s1")
+    cl.write_full("sp", "o", b"v2")
+    c.pool_snap_rm("sp", "s1")
+    c.network.pump()
+    c.checkpoint(str(tmp_path / "ck"))
+    c2 = MiniCluster.restore(str(tmp_path / "ck"))
+    pgs = _pgs_of(c2, "sp", "o")
+    assert pgs and all(sid in p.purged_snaps for p in pgs
+                       if p.is_primary())
+    # and the trim does not rerun / the index stays empty for it
+    assert all(p.snap_mapper.lookup(sid) == set() for p in pgs)
+
+
+def test_mapper_follows_pg_split():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("sp", size=3, pg_num=4)
+    cl = c.client("client.s")
+    for i in range(8):
+        cl.write_full("sp", f"o{i}", b"base")
+    sid = c.pool_snap_create("sp", "s1")
+    for i in range(8):
+        cl.write_full("sp", f"o{i}", b"changed")   # all clone
+    c.mon.set_pool_pg_num("sp", 8)
+    c.publish()
+    c.tick(rounds=3)
+    # every head is indexed exactly where its snapset now lives
+    for osd in c.osds.values():
+        for pg in osd.pgs.values():
+            for oid in pg.snap_mapper.lookup(sid):
+                assert oid in pg.snapsets
+    hit = set()
+    for osd in c.osds.values():
+        for pg in osd.pgs.values():
+            hit |= pg.snap_mapper.lookup(sid)
+    assert hit == {f"o{i}" for i in range(8)}
+    # trimming after the split cleans everything
+    c.pool_snap_rm("sp", "s1")
+    c.network.pump()
+    c.tick(rounds=2)
+    assert _clone_count(c) == 0
