@@ -261,17 +261,18 @@ class MiniCluster:
         mon.start_election()  # rejoin: triggers re-election + catch-up
         self.network.pump()
 
-    def scrub(self) -> None:
+    def scrub(self, deep: bool = True) -> None:
         """Background consistency pass over every PG (qa deep-scrub
         role): primaries collect shard scrub maps, inconsistencies become
         missing entries, recovery repairs them by decode — no client
-        reads involved."""
+        reads involved.  deep=False runs the metadata-only shallow
+        variant (sizes + attr/omap digests, no data reads)."""
         for osd in self.osds.values():
             if osd.name in self.network.down:
                 continue
             for pg in osd.pgs.values():
                 if pg.is_primary():
-                    pg.start_scrub()
+                    pg.start_scrub(deep=deep)
         self.network.pump()
         self.run_recovery()
 

@@ -96,6 +96,11 @@ def build_options() -> List[Option]:
                          "of a PG (reference osd_scrub_min_interval)"),
         Option("osd_scrub_auto", OPT_BOOL).set_default(True)
         .set_description("schedule background scrubs from the OSD tick"),
+        Option("osd_deep_scrub_interval", OPT_FLOAT).set_default(604800.0)
+        .set_description("seconds between deep (data-checksumming) "
+                         "scrubs of a PG; shallow scrubs in between "
+                         "compare metadata only (reference "
+                         "osd_deep_scrub_interval)"),
         Option("osd_op_num_threads", OPT_INT).set_default(0)
         .set_description("worker threads draining the sharded op queue "
                          "(reference osd_op_num_threads_per_shard x "

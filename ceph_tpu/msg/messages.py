@@ -295,22 +295,30 @@ class MOSDPGTemp(Message):
 @dataclass
 class MOSDRepScrub(Message):
     """Primary -> shard: build and return a scrub map of your chunks
-    (src/messages/MOSDRepScrub.h role)."""
+    (src/messages/MOSDRepScrub.h role).  ``deep`` mirrors the
+    reference's shallow/deep split (PG::Scrubber::deep): shallow
+    compares metadata only (size/attrs/omap digests, no data read);
+    deep additionally reads every object and checksums the bytes."""
     pgid: Tuple[int, int] = (0, 0)
     shard: int = -1
     epoch: int = 0
+    deep: bool = False
 
 
 @dataclass
 class MOSDRepScrubMap(Message):
     """Shard -> primary scrub results (ScrubMap role): per object the
-    stored size, whether the shard's HashInfo crc verified, and the data
-    digest (crc32c) for cross-replica comparison."""
+    stored size, whether the shard's local integrity check passed
+    (HashInfo crc on deep, HashInfo-total-vs-size on shallow), the data
+    digest (crc32c; -1 on shallow scrubs, which never read data), and
+    the attr/omap digests for cross-replica metadata comparison."""
     pgid: Tuple[int, int] = (0, 0)
     shard: int = -1
     epoch: int = 0
-    objects: List[Tuple[str, int, bool, int]] = field(default_factory=list)
-    # (oid, size, crc_ok, digest)
+    objects: List[Tuple[str, int, bool, int, int, int]] = \
+        field(default_factory=list)
+    # (oid, size, local_ok, data_digest, attrs_digest, omap_digest)
+    deep: bool = False
 
 
 @dataclass

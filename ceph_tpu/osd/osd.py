@@ -512,7 +512,7 @@ class OSD(Dispatcher):
                 tracked.mark_event("reached_pg")
             pg.do_op(msg)
         elif kind == "scrub":
-            item[1].start_scrub()
+            item[1].start_scrub(deep=item[2] if len(item) > 2 else False)
 
     def send_op_reply(self, dst: str, reply: MOSDOpReply) -> None:
         """All client replies funnel here so op tracking/latency see them."""
@@ -698,15 +698,27 @@ class OSD(Dispatcher):
         if not g_conf.get_val("osd_scrub_auto"):
             return
         interval = float(g_conf.get_val("osd_scrub_min_interval"))
+        deep_interval = float(g_conf.get_val("osd_deep_scrub_interval"))
         for pg in self.pgs.values():
             if not pg.is_primary():
                 continue
-            stagger = (hash(pg.pgid) % 997) / 997.0 * interval * 0.1
-            if self.now - pg.last_scrub_stamp >= interval + stagger:
-                self.dout(5, f"sched_scrub pg {pg.pgid}")
+            frac = (hash(pg.pgid) % 997) / 997.0
+            stagger = frac * interval * 0.1
+            # a due shallow scrub is upgraded to deep when the (longer)
+            # deep interval has also lapsed — the reference's
+            # sched_scrub deep-upgrade decision.  The deep stagger
+            # scales with ITS interval: data-reading scrubs are the
+            # ones that must not all fire in one tick
+            deep = (self.now - pg.last_deep_scrub_stamp
+                    >= deep_interval + frac * deep_interval * 0.1)
+            if deep or self.now - pg.last_scrub_stamp >= \
+                    interval + stagger:
+                self.dout(5, f"sched_scrub pg {pg.pgid}"
+                             f"{' (deep)' if deep else ''}")
                 # start_scrub stamps on an ACTUAL start; a PG that is
                 # peering right now simply retries next tick
-                self.op_wq.enqueue(pg.pgid, CLASS_SCRUB, ("scrub", pg))
+                self.op_wq.enqueue(pg.pgid, CLASS_SCRUB,
+                                   ("scrub", pg, deep))
         self.drain_ops()
 
     def _handle_ping(self, msg: MOSDPing) -> None:

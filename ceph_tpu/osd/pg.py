@@ -186,6 +186,7 @@ class PG:
         self.state = STATE_INITIAL
         self.last_epoch_started = 0
         self.last_scrub_stamp = 0.0
+        self.last_deep_scrub_stamp = 0.0
         self.backend: Optional[ECBackend] = None
         self.rep_backend: Optional[ReplicatedBackend] = None
         if pool.is_erasure():
@@ -1389,29 +1390,43 @@ class PG:
         return out
 
     # ---- scrub (PG.cc scrub path + ECUtil HashInfo, scrub-lite) ------------
-    def start_scrub(self) -> None:
+    def start_scrub(self, deep: bool = False) -> bool:
         """Primary: collect scrub maps from every acting shard; compare
         when all arrive.  Background consistency checking — no client
-        read involved (ScrubStore/PG scrub role)."""
+        read involved (ScrubStore/PG scrub role).  Shallow scrubs
+        compare metadata only (sizes + attr/omap digests, no object
+        data is read); deep scrubs additionally checksum every byte —
+        the reference's scrub vs deep-scrub split (PG::Scrubber::deep,
+        src/osd/PG.cc chunky_scrub).  Returns whether a scrub round
+        actually started (a peering/non-primary PG declines)."""
         if not self.is_primary() or self.state not in (
                 STATE_ACTIVE, STATE_ACTIVE_RECOVERING):
-            return
+            return False
         self.last_scrub_stamp = self.osd.now
-        dlog("scrub", 5, f"pg {self.pgid} scrub start",
+        if deep:
+            self.last_deep_scrub_stamp = self.osd.now
+        dlog("scrub", 5,
+             f"pg {self.pgid} {'deep-' if deep else ''}scrub start",
              f"osd.{self.osd.osd_id}")
         self._scrub_maps: Dict[int, MOSDRepScrubMap] = {}
         self._scrub_pending = set(self.acting_shards())
+        self._scrub_deep = deep
         for shard, osd in self.acting_shards().items():
             self.send_to_osd(osd, MOSDRepScrub(
                 pgid=self.pgid, shard=shard,
-                epoch=self.last_epoch_started))
+                epoch=self.last_epoch_started, deep=deep))
+        return True
 
     def handle_rep_scrub(self, msg: MOSDRepScrub) -> None:
-        """Replica: verify every stored chunk against its HashInfo crc
-        (handle_sub_read's check, proactively) and report digests."""
+        """Replica: build this shard's scrub map.  Always: stored size
+        plus attr/omap digests (metadata is cheap).  Deep only: read
+        the data and checksum it, verifying against HashInfo
+        (handle_sub_read's check, proactively).  Shallow still catches
+        a shard whose stored size disagrees with its HashInfo total."""
         from ..utils.crc32c import crc32c
+        from .ec_backend import HINFO_ATTR
         store = self.osd.store
-        objects: List[Tuple[str, int, bool, int]] = []
+        objects: List[Tuple[str, int, bool, int, int, int]] = []
         if self.backend is not None:
             s = self.my_shard()
             cids = [self.backend.shard_cid(s)] if s >= 0 else []
@@ -1423,23 +1438,56 @@ class PG:
             for ho in store.list_objects(cid):
                 if ho.oid == PG_META_OID:
                     continue
-                data = store.read(cid, ho)
-                digest = crc32c(data)
-                ok = True
-                if self.backend is not None:
-                    from .ec_backend import HINFO_ATTR
-                    hv = store.getattrs(cid, ho).get(HINFO_ATTR)
+                attrs = store.getattrs(cid, ho)
+
+                def kv_blob(items):
+                    # length-prefixed framing: values are struct-packed
+                    # binary (NULs are the norm), so separator framing
+                    # would let different k/v sets hash identically
+                    return b"".join(
+                        struct.pack("<I", len(k)) + k.encode()
+                        + struct.pack("<I", len(v)) + v
+                        for k, v in items)
+
+                # per-shard hinfo differs by construction; everything
+                # else must agree across copies/shards
+                attrs_dg = crc32c(kv_blob(
+                    (k, v) for k, v in sorted(attrs.items())
+                    if k != HINFO_ATTR))
+                omap_dg = crc32c(kv_blob(
+                    sorted(store.omap_get(cid, ho).items())))
+                hv = attrs.get(HINFO_ATTR) \
+                    if self.backend is not None else None
+                if msg.deep:
+                    data = store.read(cid, ho)
+                    size = len(data)
+                    digest = crc32c(data)
+                    ok = True
                     if hv is not None:
                         total, expect = struct.unpack("<QI", hv)
-                        ok = not (total == len(data) and digest != expect)
-                objects.append((ho.oid, len(data), ok, digest))
+                        ok = not (total == size and digest != expect)
+                else:
+                    size = store.stat(cid, ho)
+                    digest = -1
+                    ok = True
+                    if hv is not None:
+                        total, _expect = struct.unpack("<QI", hv)
+                        ok = (total == size)
+                objects.append((ho.oid, size, ok, digest,
+                                attrs_dg, omap_dg))
         self.osd.messenger.send_message(MOSDRepScrubMap(
             pgid=self.pgid, shard=msg.shard, epoch=msg.epoch,
-            objects=objects), msg.src)
+            objects=objects, deep=msg.deep), msg.src)
 
     def handle_rep_scrub_map(self, msg: MOSDRepScrubMap) -> None:
         if not self.is_primary() or \
                 not hasattr(self, "_scrub_pending"):
+            return
+        if msg.deep != getattr(self, "_scrub_deep", False) or \
+                msg.epoch != self.last_epoch_started:
+            # stale reply from a superseded scrub round (e.g. a shallow
+            # map resent over a healed link after a deep round started):
+            # its digests don't mean what this round's comparison needs
             return
         self._scrub_maps[msg.shard] = msg
         self._scrub_pending.discard(msg.shard)
@@ -1450,24 +1498,38 @@ class PG:
     def _scrub_compare(self) -> None:
         """Compare shard scrub maps; inconsistent/absent copies become
         missing entries and the recovery machinery repairs them by
-        decode/push (repair = recovery, like the reference)."""
+        decode/push (repair = recovery, like the reference).
+
+        What compares depends on depth: metadata (replicated size,
+        attr/omap digests) on every scrub; data digests only when the
+        maps were built deep (shallow maps carry no data digest)."""
         maps = self._scrub_maps
+        deep = getattr(self, "_scrub_deep", False)
         del self._scrub_maps, self._scrub_pending
         my_shard = self.my_shard()
         auth = self._authoritative_objects()
-        by_shard: Dict[int, Dict[str, Tuple[int, bool, int]]] = {
-            s: {o: (sz, ok, dg) for o, sz, ok, dg in m.objects}
+        by_shard: Dict[int, Dict[str, Tuple[int, bool, int, int, int]]] = {
+            s: {o: (sz, ok, dg, adg, odg)
+                for o, sz, ok, dg, adg, odg in m.objects}
             for s, m in maps.items()}
-        # replicated auth digest: the primary's own copy
+        # authoritative copy for cross-shard comparison: the primary's
         my_map = by_shard.get(my_shard, {})
         found = False
         for oid, version in auth.items():
             for shard in self.acting_shards():
                 ent = by_shard.get(shard, {}).get(oid)
                 bad = ent is None or not ent[1]
-                if self.rep_backend is not None and ent is not None:
-                    mine = my_map.get(oid)
-                    if mine is not None and ent[2] != mine[2]:
+                mine = my_map.get(oid)
+                if ent is not None and mine is not None:
+                    # user attrs replicate to every shard/copy; omap
+                    # and sizes are per-copy on replicated pools only
+                    # (EC shards hold different-length chunk bytes
+                    # whose digests legitimately differ)
+                    if ent[3] != mine[3]:
+                        bad = True
+                    if self.rep_backend is not None and (
+                            ent[0] != mine[0] or ent[4] != mine[4]
+                            or (deep and ent[2] != mine[2])):
                         bad = True
                 if bad:
                     v = version or self.pg_log.head

@@ -117,7 +117,29 @@ def main(argv=None) -> int:
         elif sub == "dump":
             for pgid, pg in sorted(_pg_lines(c)):
                 print(f"{pgid[0]}.{pgid[1]}\t{pg.state}"
-                      f"\tup={pg.up}\tacting={pg.acting}")
+                      f"\tup={pg.up}\tacting={pg.acting}"
+                      f"\tlast_scrub={pg.last_scrub_stamp:.0f}"
+                      f"\tlast_deep_scrub={pg.last_deep_scrub_stamp:.0f}")
+        elif sub in ("scrub", "deep-scrub"):
+            # ceph pg scrub/deep-scrub <pool.ps> (MonCommands.h role);
+            # the restored cluster is ephemeral, so this reports what
+            # the pass found rather than mutating daemon state
+            want = rest[1] if len(rest) > 1 else None
+            ran, matched = 0, 0
+            for pgid, pg in _pg_lines(c):
+                if want and f"{pgid[0]}.{pgid[1]}" != want:
+                    continue
+                matched += 1
+                if pg.start_scrub(deep=(sub == "deep-scrub")):
+                    ran += 1
+            if want and not matched:
+                print(f"pg {want} does not exist", file=sys.stderr)
+                return 1
+            c.network.pump()
+            print(json.dumps({"scrubbed": ran,
+                              "declined": matched - ran, "deep":
+                              sub == "deep-scrub",
+                              "pg_states": c.pg_states()}))
         else:
             print(f"unknown: pg {sub}", file=sys.stderr)
             return 1

@@ -162,7 +162,14 @@ def test_ceph_cli_status_surfaces(tmp_path, capsys):
     rc, out = run("pg", "stat")
     assert rc == 0 and sum(json.loads(out).values()) == 4
     rc, out = run("pg", "dump")
-    assert rc == 0 and "acting=" in out
+    assert rc == 0 and "acting=" in out and "last_deep_scrub=" in out
+    one_pgid = out.split()[0]
+    rc, out = run("pg", "scrub")
+    st = json.loads(out)
+    assert rc == 0 and st["scrubbed"] == 4 and st["deep"] is False
+    rc, out = run("pg", "deep-scrub", one_pgid)
+    st = json.loads(out)
+    assert rc == 0 and st["scrubbed"] == 1 and st["deep"] is True
     rc, out = run("df")
     assert "cp" in out
 

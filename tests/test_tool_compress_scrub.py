@@ -180,7 +180,11 @@ def test_periodic_scrub_detects_bitrot():
                     corrupted = True
     assert corrupted
     old = g_conf.get_val("osd_scrub_min_interval")
+    old_deep = g_conf.get_val("osd_deep_scrub_interval")
     g_conf.set_val("osd_scrub_min_interval", 10.0)
+    # same-size bitrot is only visible to data-checksumming scrubs, so
+    # the deep interval must lapse within the ticks below
+    g_conf.set_val("osd_deep_scrub_interval", 10.0)
     try:
         for _ in range(8):
             c.tick(dt=6.0)
@@ -190,6 +194,7 @@ def test_periodic_scrub_detects_bitrot():
         c.network.pump()
     finally:
         g_conf.set_val("osd_scrub_min_interval", old)
+        g_conf.set_val("osd_deep_scrub_interval", old_deep)
     # every stored copy of the shard is consistent again
     assert cl.read("ss", "victim") == data
     for osd in c.osds.values():
