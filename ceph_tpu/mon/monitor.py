@@ -22,13 +22,13 @@ peons forward reports to the leader.
 from __future__ import annotations
 
 import copy
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..crush.constants import CRUSH_BUCKET_STRAW2
 from ..ec import create_erasure_code
 from ..msg import Dispatcher, MOSDFailure, MOSDMap, Message, Network
 from ..msg.messages import (
-    MMonElection, MMonPaxos, MMonPing, MMonSubscribe, MOSDBoot,
+    MLog, MMonElection, MMonPaxos, MMonPing, MMonSubscribe, MOSDBoot,
     MOSDPGTemp,
 )
 from ..osdmap import (
@@ -85,6 +85,18 @@ class Monitor(Dispatcher):
         self._collect_acks: Set[int] = set()
         self._collect_pn = -1
         self._collect_uncommitted: Optional[tuple] = None
+        # ---- paxos services sharing the one consensus ---------------------
+        # cluster log (LogMonitor role): committed entries, newest last;
+        # bounded like the reference's in-memory summary
+        self.cluster_log: List[Tuple[float, str, str, str]] = []
+        self.cluster_log_max = 10000
+        # replicated key-value store (ConfigKeyService role)
+        self.config_kv: Dict[str, str] = {}
+        # leader: log entries awaiting the next committed epoch, plus
+        # recently seen daemon-entry identities (the broadcast fan-in
+        # dedup — cleared wholesale when it grows, a cheap rolling set)
+        self._pending_log: List[Tuple[float, str, str, str]] = []
+        self._recent_log_keys: Set[Tuple[float, str, str, str]] = set()
 
     # ---- roles -------------------------------------------------------------
     def is_leader(self) -> bool:
@@ -242,10 +254,27 @@ class Monitor(Dispatcher):
     def _rebuild_from_incrementals(self) -> None:
         m = OSDMap()
         m.epoch = 0
+        self.cluster_log = []
+        self.config_kv = {}
         for inc in self.incrementals:
             m.apply_incremental(inc)
+            self._apply_service(inc)
         self.osdmap = m
         self._topology_dirty = False
+
+    def _apply_service(self, inc: Incremental) -> None:
+        """Fold a committed epoch's service payloads into the local
+        LogMonitor/ConfigKeyService state (every mon, every commit path
+        — the services are exactly as replicated as the map)."""
+        if inc.service_log:
+            self.cluster_log.extend(inc.service_log)
+            if len(self.cluster_log) > self.cluster_log_max:
+                del self.cluster_log[:-self.cluster_log_max]
+        for k, v in inc.service_config_kv.items():
+            if v is None:
+                self.config_kv.pop(k, None)
+            else:
+                self.config_kv[k] = v
 
     def _apply_committed_values(self, values: List) -> None:
         from ..osdmap.encoding import incremental_from_dict
@@ -259,6 +288,7 @@ class Monitor(Dispatcher):
                 self._discard_uncommitted()
             self.osdmap.apply_incremental(inc)
             self.incrementals.append(inc)
+            self._apply_service(inc)
 
     def _handle_paxos(self, msg: MMonPaxos) -> None:
         from ..osdmap.encoding import incremental_from_dict, \
@@ -373,6 +403,12 @@ class Monitor(Dispatcher):
         p = self._pending_proposals.pop(0)
         epoch = self.osdmap.epoch + 1
         p["inc"].epoch = epoch
+        if self._pending_log:
+            # queued clog entries ride whatever epoch commits next
+            # (LogMonitor batching onto the shared paxos round)
+            p["inc"].service_log = list(p["inc"].service_log) + \
+                self._pending_log
+            self._pending_log = []
         d = incremental_to_dict(p["inc"])
         self._inflight = {"pn": self.election_epoch, "epoch": epoch,
                           "inc": p["inc"], "value": d,
@@ -431,6 +467,7 @@ class Monitor(Dispatcher):
         else:
             self.osdmap.apply_incremental(inc)
         self.incrementals.append(inc)
+        self._apply_service(inc)
         for r in self.quorum - {self.rank}:
             name = self._peer_name(r)
             if name:
@@ -461,7 +498,12 @@ class Monitor(Dispatcher):
                     inc = Incremental()
                     inc.new_old_weight[osd] = self.osdmap.osd_weight[osd]
                     inc.new_weight[osd] = 0
+                    self.log_entry("mon", "WRN",
+                                   f"osd.{osd} marked out after "
+                                   f"{self.down_out_interval:.0f}s down")
                     self.publish(inc)
+            # clog entries with no epoch to ride commit on their own
+            self.flush_log()
         if not self.peers:
             return
         for p in self.peers:
@@ -522,6 +564,45 @@ class Monitor(Dispatcher):
         if name not in self.subscribers:
             self.subscribers.append(name)
 
+    # ---- cluster log (LogMonitor, src/mon/LogMonitor.cc) -------------------
+    def log_entry(self, who: str, level: str, message: str) -> None:
+        """Queue a cluster-log entry; it commits with the next epoch
+        (immediately if the log is the only pending state — see tick)."""
+        self._pending_log.append((self.now, who, level, message))
+
+    def flush_log(self) -> None:
+        """Commit queued log entries on their own no-op epoch."""
+        if self._pending_log and (not self.peers or
+                                  (self.is_leader() and
+                                   len(self.quorum) >= self._majority())):
+            self.publish(Incremental())
+
+    def log_last(self, n: int = 20, level: Optional[str] = None
+                 ) -> List[Tuple[float, str, str, str]]:
+        ents = self.cluster_log
+        if level is not None:
+            ents = [e for e in ents if e[2] == level]
+        return ents[-n:]
+
+    # ---- config-key store (ConfigKeyService, mon/ConfigKeyService.cc) ------
+    def config_key_set(self, key: str, value: str) -> None:
+        """Replicate a key-value pair through paxos (ceph config-key
+        set).  Leader-only, like every other mutation."""
+        inc = Incremental()
+        inc.service_config_kv[key] = value
+        self.publish(inc)
+
+    def config_key_rm(self, key: str) -> None:
+        inc = Incremental()
+        inc.service_config_kv[key] = None
+        self.publish(inc)
+
+    def config_key_get(self, key: str) -> Optional[str]:
+        return self.config_kv.get(key)
+
+    def config_key_dump(self) -> Dict[str, str]:
+        return dict(self.config_kv)
+
     # ---- pools -------------------------------------------------------------
     def create_replicated_pool(self, name: str, size: int = 3,
                                pg_num: int = 32) -> int:
@@ -533,6 +614,8 @@ class Monitor(Dispatcher):
                          min_size=max(1, size - 1), crush_rule=rno,
                          pg_num=pg_num, pgp_num=pg_num)
         self._topology_dirty = True
+        self.log_entry("mon", "INF",
+                       f"pool '{name}' created (replicated size={size})")
         return self.osdmap.add_pool(name, pool)
 
     def set_pool_pg_num(self, name: str, pg_num: int) -> None:
@@ -609,6 +692,9 @@ class Monitor(Dispatcher):
                          erasure_code_profile=profile_name,
                          stripe_width=k * stripe_unit, flags=flags)
         self._topology_dirty = True
+        self.log_entry("mon", "INF",
+                       f"pool '{name}' created (erasure "
+                       f"profile={profile_name})")
         return self.osdmap.add_pool(name, pool)
 
     # ---- cache tiering (OSDMonitor "osd tier add/cache-mode") --------------
@@ -739,6 +825,13 @@ class Monitor(Dispatcher):
             if self._primed_pg_temp:
                 inc.new_pg_temp.update(self._primed_pg_temp)
                 self._primed_pg_temp = {}
+            if delta is not None:
+                # service payloads fold from the DIRECT delta only:
+                # deferred proposals commit on their own, and unlike
+                # the idempotent map-field folding above, log entries
+                # and kv mutations must apply exactly once
+                inc.service_log.extend(delta.service_log)
+                inc.service_config_kv.update(delta.service_config_kv)
             self._topology_dirty = False
             topology = True
         else:
@@ -763,6 +856,7 @@ class Monitor(Dispatcher):
         for reps in self._failure_reports.values():
             reps.discard(reporter)
         self._down_stamps.setdefault(osd, self.now)
+        self.log_entry("mon", "WRN", f"osd.{osd} marked down")
         self.publish(inc)
 
     def mark_osd_up(self, osd: int) -> None:
@@ -779,6 +873,7 @@ class Monitor(Dispatcher):
         # recovery voids any partial reports against this osd
         self._failure_reports.pop(osd, None)
         self._down_stamps.pop(osd, None)
+        self.log_entry("mon", "INF", f"osd.{osd} boot")
         self.publish(inc)
 
     def mark_osd_out(self, osd: int) -> None:
@@ -830,6 +925,10 @@ class Monitor(Dispatcher):
         self.osdmap = osdmap_from_dict(state["osdmap"])
         self.incrementals = [incremental_from_dict(i)
                              for i in state["incrementals"]]
+        self.cluster_log = []
+        self.config_kv = {}
+        for inc in self.incrementals:
+            self._apply_service(inc)
         self._topology_dirty = False
 
     # ---- dispatch ----------------------------------------------------------
@@ -871,6 +970,27 @@ class Monitor(Dispatcher):
                 if name:
                     self.messenger.send_message(MOSDBoot(
                         osd=msg.osd, epoch=msg.epoch), name)
+        elif isinstance(msg, MLog):
+            # daemons' clog entries: the leader queues (committed with
+            # the next epoch / tick flush); peons forward.  Daemons
+            # broadcast to every mon so the entry survives any single
+            # mon death — the leader therefore sees the same entry
+            # several times (direct + forwarded) and dedups by its
+            # (stamp, who, level, message) identity
+            if self.is_leader() or not self.peers:
+                ent = (msg.stamp or self.now, msg.who or msg.src,
+                       msg.level, msg.message)
+                if ent not in self._recent_log_keys:
+                    self._recent_log_keys.add(ent)
+                    if len(self._recent_log_keys) > 512:
+                        self._recent_log_keys.clear()
+                    self._pending_log.append(ent)
+            elif self.is_peon():
+                name = self._peer_name(self.leader_rank)
+                if name:
+                    self.messenger.send_message(MLog(
+                        who=msg.who or msg.src, level=msg.level,
+                        message=msg.message, stamp=msg.stamp), name)
         elif isinstance(msg, MOSDFailure):
             if not self.is_leader():
                 # peons forward to the leader (Monitor::forward_request);

@@ -394,6 +394,17 @@ class MMonSubscribe(Message):
 
 
 @dataclass
+class MLog(Message):
+    """Daemon -> mon cluster-log entry (src/messages/MLog.h role):
+    queued by the leader and paxos-committed with the next epoch, so
+    `ceph log last` reads one replicated, failover-proof history."""
+    who: str = ""
+    level: str = "INF"          # DBG/INF/WRN/ERR (clog levels)
+    message: str = ""
+    stamp: float = 0.0
+
+
+@dataclass
 class MMonPing(Message):
     """Mon <-> mon liveness (the elector's keepalives)."""
     PING = "ping"

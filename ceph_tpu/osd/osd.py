@@ -688,6 +688,18 @@ class OSD(Dispatcher):
                                     epoch=self.osdmap.epoch,
                                     reporter=self.name), mon)
 
+    def clog(self, level: str, message: str) -> None:
+        """Send a cluster-log entry to the mons (clog->error()/info()
+        role).  Every mon gets a copy, like the failure-report loop
+        above — a single-target send dies with that mon.  Peons forward
+        to the leader, which dedups identical (stamp, who, message)
+        arrivals so the fan-out still commits exactly once."""
+        from ..msg.messages import MLog
+        for mon in self.mon_names:
+            self.messenger.send_message(MLog(
+                who=self.name, level=level, message=message,
+                stamp=self.now), mon)
+
     def maybe_schedule_scrubs(self) -> None:
         """Periodic background scrub scheduling (the OSD's scrub
         scheduler role, OSD.cc sched_scrub): each primary PG scrubs

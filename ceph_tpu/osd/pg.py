@@ -1439,23 +1439,16 @@ class PG:
                 if ho.oid == PG_META_OID:
                     continue
                 attrs = store.getattrs(cid, ho)
-
-                def kv_blob(items):
-                    # length-prefixed framing: values are struct-packed
-                    # binary (NULs are the norm), so separator framing
-                    # would let different k/v sets hash identically
-                    return b"".join(
-                        struct.pack("<I", len(k)) + k.encode()
-                        + struct.pack("<I", len(v)) + v
-                        for k, v in items)
-
-                # per-shard hinfo differs by construction; everything
-                # else must agree across copies/shards
-                attrs_dg = crc32c(kv_blob(
+                # pack_kv's length-prefixed framing (values are
+                # struct-packed binary, so separator framing would let
+                # different k/v sets hash identically); per-shard hinfo
+                # differs by construction, everything else must agree
+                # across copies/shards
+                attrs_dg = crc32c(pack_kv(dict(
                     (k, v) for k, v in sorted(attrs.items())
-                    if k != HINFO_ATTR))
-                omap_dg = crc32c(kv_blob(
-                    sorted(store.omap_get(cid, ho).items())))
+                    if k != HINFO_ATTR)))
+                omap_dg = crc32c(pack_kv(dict(
+                    sorted(store.omap_get(cid, ho).items()))))
                 hv = attrs.get(HINFO_ATTR) \
                     if self.backend is not None else None
                 if msg.deep:
@@ -1514,7 +1507,7 @@ class PG:
             for s, m in maps.items()}
         # authoritative copy for cross-shard comparison: the primary's
         my_map = by_shard.get(my_shard, {})
-        found = False
+        found = 0
         for oid, version in auth.items():
             for shard in self.acting_shards():
                 ent = by_shard.get(shard, {}).get(oid)
@@ -1537,8 +1530,15 @@ class PG:
                         (v, OP_MODIFY)
                     if shard == my_shard:
                         self.local_missing[oid] = (v, OP_MODIFY)
-                    found = True
+                    found += 1       # this scrub's findings only —
+                    # pre-existing missing entries are recovery debt,
+                    # not scrub results
         if found:
+            noun = "copy" if found == 1 else "copies"
+            self.osd.clog(
+                "ERR", f"pg {self.pgid[0]}.{self.pgid[1]} "
+                f"{'deep-' if deep else ''}scrub: {found} inconsistent "
+                f"object {noun}, repairing")
             self.state = STATE_ACTIVE_RECOVERING
             self.osd.request_recovery(self)
 

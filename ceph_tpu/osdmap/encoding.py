@@ -270,6 +270,8 @@ def incremental_to_dict(inc) -> Dict[str, Any]:
         "new_erasure_code_profiles": {
             k: dict(v) for k, v in inc.new_erasure_code_profiles.items()},
         "crush": None if inc.crush is None else crush_to_dict(inc.crush),
+        "service_log": [list(e) for e in inc.service_log],
+        "service_config_kv": dict(inc.service_config_kv),
     }
 
 
@@ -305,4 +307,6 @@ def incremental_from_dict(d: Dict[str, Any]):
         k: dict(v) for k, v in d["new_erasure_code_profiles"].items()}
     inc.crush = None if d["crush"] is None \
         else crush_from_dict(d["crush"])
+    inc.service_log = [tuple(e) for e in d.get("service_log", [])]
+    inc.service_config_kv = dict(d.get("service_config_kv", {}))
     return inc

@@ -56,6 +56,15 @@ class Incremental:
     new_erasure_code_profiles: Dict[str, Dict[str, str]] = \
         field(default_factory=dict)
     crush: Optional[CrushWrapper] = None
+    # mon-service payloads committed with the epoch (PaxosService
+    # siblings sharing the one Paxos, src/mon/PaxosService.h): cluster
+    # log entries (LogMonitor) and config-key mutations
+    # (ConfigKeyService; value None = delete).  OSDMap consumers ignore
+    # them — they are replicated mon state riding the same consensus.
+    service_log: List[Tuple[float, str, str, str]] = \
+        field(default_factory=list)          # (stamp, who, level, text)
+    service_config_kv: Dict[str, Optional[str]] = \
+        field(default_factory=dict)
 
 
 class OSDMap:

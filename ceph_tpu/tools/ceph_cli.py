@@ -74,7 +74,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ceph")
     p.add_argument("--cluster", required=True,
                    help="checkpoint directory (MiniCluster.checkpoint)")
-    p.add_argument("verb", choices=["status", "health", "df", "osd", "pg"])
+    p.add_argument("verb", choices=["status", "health", "df", "osd",
+                                    "pg", "log", "config-key"])
     p.add_argument("args", nargs="*")
     a = p.parse_args(argv)
 
@@ -142,6 +143,37 @@ def main(argv=None) -> int:
                               "pg_states": c.pg_states()}))
         else:
             print(f"unknown: pg {sub}", file=sys.stderr)
+            return 1
+    elif v == "log":
+        # ceph log last [n] (LogMonitor history)
+        sub = rest[0] if rest else "last"
+        if sub != "last":
+            print(f"unknown: log {sub}", file=sys.stderr)
+            return 1
+        try:
+            n = int(rest[1]) if len(rest) > 1 else 20
+        except ValueError:
+            print(f"log last: not a count: {rest[1]!r}", file=sys.stderr)
+            return 1
+        for stamp, who, level, text in c.mon.log_last(n):
+            print(f"{stamp:.1f} {who} {level}: {text}")
+    elif v == "config-key":
+        sub = rest[0] if rest else "dump"
+        if sub == "dump":
+            print(json.dumps(c.mon.config_key_dump(), indent=2,
+                             sort_keys=True))
+        elif sub == "get" and len(rest) > 1:
+            val = c.mon.config_key_get(rest[1])
+            if val is None:
+                print(f"no such key {rest[1]!r}", file=sys.stderr)
+                return 1
+            print(val)
+        elif sub == "exists" and len(rest) > 1:
+            ok = c.mon.config_key_get(rest[1]) is not None
+            print(json.dumps({"exists": ok}))
+            return 0 if ok else 1
+        else:
+            print(f"unknown: config-key {sub}", file=sys.stderr)
             return 1
     return 0
 
