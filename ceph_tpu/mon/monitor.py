@@ -978,12 +978,16 @@ class Monitor(Dispatcher):
             # several times (direct + forwarded) and dedups by its
             # (stamp, who, level, message) identity
             if self.is_leader() or not self.peers:
-                ent = (msg.stamp or self.now, msg.who or msg.src,
+                stamp = msg.stamp if msg.stamp >= 0 else self.now
+                ent = (stamp, msg.who or msg.src,
                        msg.level, msg.message)
                 if ent not in self._recent_log_keys:
-                    self._recent_log_keys.add(ent)
                     if len(self._recent_log_keys) > 512:
+                        # rolling reset — but keep the entry being
+                        # admitted, or its own in-flight forwarded
+                        # duplicates would slip past the dedup
                         self._recent_log_keys.clear()
+                    self._recent_log_keys.add(ent)
                     self._pending_log.append(ent)
             elif self.is_peon():
                 name = self._peer_name(self.leader_rank)

@@ -315,9 +315,11 @@ class MOSDRepScrubMap(Message):
     pgid: Tuple[int, int] = (0, 0)
     shard: int = -1
     epoch: int = 0
-    objects: List[Tuple[str, int, bool, int, int, int]] = \
+    objects: List[Tuple[str, int, bool, int, int, int, bool]] = \
         field(default_factory=list)
-    # (oid, size, local_ok, data_digest, attrs_digest, omap_digest)
+    # (oid, size, local_ok, data_digest, attrs_digest, omap_digest,
+    #  digest_validated) — the last flag marks copies whose bytes
+    #  provably match a write-time recorded digest (hinfo / data_digest)
     deep: bool = False
 
 
@@ -401,7 +403,8 @@ class MLog(Message):
     who: str = ""
     level: str = "INF"          # DBG/INF/WRN/ERR (clog levels)
     message: str = ""
-    stamp: float = 0.0
+    stamp: float = -1.0         # sender clock; -1 = unset (0.0 is a
+    # legitimate time-zero stamp and must survive the fan-in dedup)
 
 
 @dataclass

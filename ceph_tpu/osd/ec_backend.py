@@ -46,6 +46,11 @@ from .ecutil import HashInfo, decode as ec_decode, \
     decode_concat as ec_decode_concat, encode as ec_encode, stripe_info_t
 
 SIZE_ATTR = "_size"          # logical object size (un-padded)
+DIGEST_ATTR = "_data_digest"  # crc32c recorded at full-object write
+# (object_info_t::data_digest role, src/osd/osd_types.h): lets scrub
+# tell WHICH copy rotted instead of just that copies differ; partial
+# overwrites invalidate it (rmattr), exactly like the reference
+# clears FLAG_DATA_DIGEST on unaligned writes
 HINFO_ATTR = "hinfo_key"     # reference's hinfo xattr name
 USER_ATTR_PREFIX = "_u_"     # user xattr namespace in shard/replica attrs
 

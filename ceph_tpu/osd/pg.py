@@ -128,9 +128,19 @@ class ReplicatedBackend:
                     and store.exists(cid, ho)):
                 t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", 0))
         else:
+            from .ec_backend import DIGEST_ATTR
             if not msg.partial:
                 t.truncate(cid, ho, 0)
             t.write(cid, ho, msg.offset, msg.chunk)
+            if not msg.partial:
+                from ..utils.crc32c import crc32c
+                t.setattr(cid, ho, DIGEST_ATTR,
+                          struct.pack("<I", crc32c(bytes(msg.chunk))))
+            else:
+                # unaligned overwrite: the whole-object digest no
+                # longer describes the bytes — invalidate, don't lie
+                # (after t.write, so the object exists to rmattr on)
+                t.rmattr(cid, ho, DIGEST_ATTR)
             t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", msg.at_version))
         ECBackend._apply_user_attrs(t, store, cid, ho, msg.xattrs)
         if msg.omap is not None:
@@ -1424,9 +1434,9 @@ class PG:
         (handle_sub_read's check, proactively).  Shallow still catches
         a shard whose stored size disagrees with its HashInfo total."""
         from ..utils.crc32c import crc32c
-        from .ec_backend import HINFO_ATTR
+        from .ec_backend import DIGEST_ATTR, HINFO_ATTR
         store = self.osd.store
-        objects: List[Tuple[str, int, bool, int, int, int]] = []
+        objects: List[tuple] = []
         if self.backend is not None:
             s = self.my_shard()
             cids = [self.backend.shard_cid(s)] if s >= 0 else []
@@ -1441,16 +1451,19 @@ class PG:
                 attrs = store.getattrs(cid, ho)
                 # pack_kv's length-prefixed framing (values are
                 # struct-packed binary, so separator framing would let
-                # different k/v sets hash identically); per-shard hinfo
-                # differs by construction, everything else must agree
-                # across copies/shards
+                # different k/v sets hash identically).  Integrity
+                # metadata is excluded: per-shard hinfo differs by
+                # construction, and the recorded data digest can
+                # legitimately exist on a recovery-pushed copy while
+                # its peers (post-partial-write) have none
                 attrs_dg = crc32c(pack_kv(dict(
                     (k, v) for k, v in sorted(attrs.items())
-                    if k != HINFO_ATTR)))
+                    if k not in (HINFO_ATTR, DIGEST_ATTR))))
                 omap_dg = crc32c(pack_kv(dict(
                     sorted(store.omap_get(cid, ho).items()))))
                 hv = attrs.get(HINFO_ATTR) \
                     if self.backend is not None else None
+                validated = False
                 if msg.deep:
                     data = store.read(cid, ho)
                     size = len(data)
@@ -1459,6 +1472,17 @@ class PG:
                     if hv is not None:
                         total, expect = struct.unpack("<QI", hv)
                         ok = not (total == size and digest != expect)
+                        validated = ok and total == size
+                    elif self.backend is None:
+                        # replicated: verify against the write-time
+                        # recorded digest (object_info data_digest) —
+                        # a self-inconsistent copy is known-bad on its
+                        # own and gets no vote in _scrub_compare, even
+                        # if identical rot hit a majority of copies
+                        rec = attrs.get(DIGEST_ATTR)
+                        if rec is not None and len(rec) == 4:
+                            ok = struct.unpack("<I", rec)[0] == digest
+                            validated = ok
                 else:
                     size = store.stat(cid, ho)
                     digest = -1
@@ -1467,7 +1491,7 @@ class PG:
                         total, _expect = struct.unpack("<QI", hv)
                         ok = (total == size)
                 objects.append((ho.oid, size, ok, digest,
-                                attrs_dg, omap_dg))
+                                attrs_dg, omap_dg, validated))
         self.osd.messenger.send_message(MOSDRepScrubMap(
             pgid=self.pgid, shard=msg.shard, epoch=msg.epoch,
             objects=objects, deep=msg.deep), msg.src)
@@ -1501,29 +1525,80 @@ class PG:
         del self._scrub_maps, self._scrub_pending
         my_shard = self.my_shard()
         auth = self._authoritative_objects()
-        by_shard: Dict[int, Dict[str, Tuple[int, bool, int, int, int]]] = {
-            s: {o: (sz, ok, dg, adg, odg)
-                for o, sz, ok, dg, adg, odg in m.objects}
+        by_shard: Dict[int, Dict[str, tuple]] = {
+            s: {o: (sz, ok, dg, adg, odg, val)
+                for o, sz, ok, dg, adg, odg, val in m.objects}
             for s, m in maps.items()}
-        # authoritative copy for cross-shard comparison: the primary's
-        my_map = by_shard.get(my_shard, {})
+        from collections import Counter
         found = 0
+        shard_order = sorted(self.acting_shards(),
+                             key=lambda s: (s != my_shard, s))
+
+        def data_identity(e):
+            return (e[0], e[2] if deep else None)
+
+        def meta_identity(e):
+            return (e[3], e[4])
+
         for oid, version in auth.items():
+            ents = {s: by_shard.get(s, {}).get(oid)
+                    for s in self.acting_shards()}
+            # Authority selection (be_select_auth_object role), split
+            # by what the write-time digest actually protects:
+            #
+            # DATA (size + data digest), precedence order: (1) majority
+            # among DIGEST-VALIDATED copies — their bytes provably
+            # match their recorded digest, so even identical rot on a
+            # majority can't outvote them; (2) no validated copy
+            # (partial-write history wiped the digests): the primary's
+            # self-consistent copy — plain majority there would let
+            # identical rot on two replicas overwrite a healthy
+            # primary; (3) majority among self-consistent copies
+            # (primary absent/bad).  Ties break toward the primary
+            # (my_shard votes first in shard_order).
+            #
+            # METADATA (attr/omap digests): no recorded digest guards
+            # it, so data-validation must not lend false authority —
+            # the primary's self-consistent copy rules (the pre-digest
+            # semantics), majority only when the primary can't vote.
+            mine = ents.get(my_shard)
+            if self.rep_backend is not None:
+                val = [data_identity(ents[s]) for s in shard_order
+                       if ents[s] is not None and ents[s][1]
+                       and ents[s][5]]
+                if val:
+                    data_win = Counter(val).most_common(1)[0][0]
+                elif mine is not None and mine[1]:
+                    data_win = data_identity(mine)
+                else:
+                    votes = [data_identity(ents[s]) for s in shard_order
+                             if ents[s] is not None and ents[s][1]]
+                    data_win = Counter(votes).most_common(1)[0][0] \
+                        if votes else None
+            else:
+                data_win = None     # EC chunks differ by construction
+            if mine is not None and mine[1]:
+                meta_win = meta_identity(mine) \
+                    if self.rep_backend is not None else mine[3]
+            else:
+                if self.rep_backend is not None:
+                    mvotes = [meta_identity(ents[s]) for s in shard_order
+                              if ents[s] is not None and ents[s][1]]
+                else:
+                    mvotes = [ents[s][3] for s in shard_order
+                              if ents[s] is not None and ents[s][1]]
+                meta_win = Counter(mvotes).most_common(1)[0][0] \
+                    if mvotes else None
             for shard in self.acting_shards():
-                ent = by_shard.get(shard, {}).get(oid)
+                ent = ents[shard]
                 bad = ent is None or not ent[1]
-                mine = my_map.get(oid)
-                if ent is not None and mine is not None:
-                    # user attrs replicate to every shard/copy; omap
-                    # and sizes are per-copy on replicated pools only
-                    # (EC shards hold different-length chunk bytes
-                    # whose digests legitimately differ)
-                    if ent[3] != mine[3]:
-                        bad = True
-                    if self.rep_backend is not None and (
-                            ent[0] != mine[0] or ent[4] != mine[4]
-                            or (deep and ent[2] != mine[2])):
-                        bad = True
+                if not bad and data_win is not None:
+                    bad = data_identity(ent) != data_win
+                if not bad and meta_win is not None:
+                    if self.rep_backend is not None:
+                        bad = meta_identity(ent) != meta_win
+                    else:
+                        bad = ent[3] != meta_win
                 if bad:
                     v = version or self.pg_log.head
                     self.missing.setdefault(shard, {})[oid] = \

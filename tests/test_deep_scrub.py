@@ -130,6 +130,193 @@ def test_shallow_scrub_catches_ec_size_vs_hinfo():
     raise AssertionError("no EC shard found")
 
 
+def test_corrupt_primary_loses_majority_vote():
+    """A corrupt PRIMARY copy must not become the scrub authority and
+    'repair' healthy replicas from bad data: the authoritative value is
+    the majority among self-consistent copies (be_select_auth_object),
+    so the primary repairs itself from the survivors."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", size=3, pg_num=4)
+    cl = c.client("client.s")
+    data = payload(seed=9)
+    assert cl.write_full("p", "obj", data) == 0
+    cl2 = c.client("client.probe")
+    _pg, primary = cl2._calc_target(cl2.lookup_pool("p"), "obj")
+    posd = c.osds[primary]
+    hit = None
+    for cid in posd.store.list_collections():
+        if "_meta" in cid:
+            continue
+        for ho in posd.store.list_objects(cid):
+            if ho.oid == "obj" and hit is None:
+                posd.store.colls[cid][ho].data[7] ^= 0x3C
+                hit = (cid, ho)
+    assert hit is not None
+    c.scrub(deep=True)
+    c.tick()
+    cid, ho = hit
+    assert bytes(posd.store.colls[cid][ho].data) == data, \
+        "primary must be repaired from the majority, not vice versa"
+    assert cl.read("p", "obj") == data
+    # and the healthy replicas were left alone / stayed correct
+    for osd in c.osds.values():
+        for c2 in osd.store.list_collections():
+            if "_meta" in c2:
+                continue
+            for h2 in osd.store.list_objects(c2):
+                if h2.oid == "obj":
+                    assert bytes(osd.store.colls[c2][h2].data) == data
+
+
+def test_identical_rot_on_majority_of_copies_still_repaired():
+    """Even when the SAME corruption hits a majority of replicas,
+    the write-time recorded digest (object_info data_digest role)
+    identifies each rotted copy as self-inconsistent — voting alone
+    would elect the corruption."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", size=3, pg_num=4)
+    cl = c.client("client.s")
+    data = payload(seed=11)
+    assert cl.write_full("p", "obj", data) == 0
+    cl2 = c.client("client.probe")
+    _pg, primary = cl2._calc_target(cl2.lookup_pool("p"), "obj")
+    # identical byte-flip on every NON-primary copy (2 of 3)
+    n = 0
+    for osd in c.osds.values():
+        if osd.osd_id == primary:
+            continue
+        for cid in osd.store.list_collections():
+            if "_meta" in cid:
+                continue
+            for ho in osd.store.list_objects(cid):
+                if ho.oid == "obj":
+                    osd.store.colls[cid][ho].data[3] ^= 0xFF
+                    n += 1
+    assert n == 2
+    c.scrub(deep=True)
+    for osd in c.osds.values():
+        for cid in osd.store.list_collections():
+            if "_meta" in cid:
+                continue
+            for ho in osd.store.list_objects(cid):
+                if ho.oid == "obj":
+                    assert bytes(osd.store.colls[cid][ho].data) == data
+    assert cl.read("p", "obj") == data
+
+
+def test_identical_attr_rot_on_majority_cannot_outvote_primary():
+    """Data digests validate bytes, not metadata — identical attr rot
+    on two (data-validated) replicas must not outvote the healthy
+    primary's metadata."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", size=3, pg_num=4)
+    cl = c.client("client.s")
+    assert cl.write_full("p", "obj", b"solid" * 200) == 0
+    assert cl.setxattr("p", "obj", "owner", b"alice") == 0
+    cl2 = c.client("client.probe")
+    _pg, primary = cl2._calc_target(cl2.lookup_pool("p"), "obj")
+    from ceph_tpu.osd.ec_backend import USER_ATTR_PREFIX
+    n = 0
+    for osd in c.osds.values():
+        if osd.osd_id == primary:
+            continue
+        for cid in osd.store.list_collections():
+            if "_meta" in cid:
+                continue
+            for ho in osd.store.list_objects(cid):
+                if ho.oid == "obj":
+                    osd.store.colls[cid][ho].attrs[
+                        USER_ATTR_PREFIX + "owner"] = b"mallory"
+                    n += 1
+    assert n == 2
+    c.scrub(deep=True)
+    assert cl.getxattr("p", "obj", "owner") == b"alice"
+    for osd in c.osds.values():
+        for cid in osd.store.list_collections():
+            if "_meta" in cid:
+                continue
+            for ho in osd.store.list_objects(cid):
+                if ho.oid == "obj":
+                    assert osd.store.colls[cid][ho].attrs[
+                        USER_ATTR_PREFIX + "owner"] == b"alice"
+
+
+def test_digestless_object_keeps_primary_authority():
+    """After a partial overwrite wipes the recorded digests, identical
+    rot on a majority of replicas must NOT outvote the healthy primary
+    (the pre-digest semantics are the fallback, not plain majority)."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", size=3, pg_num=4)
+    cl = c.client("client.s")
+    data = payload(seed=13)
+    assert cl.write_full("p", "obj", data) == 0
+    assert cl.write("p", "obj", b"QQ", offset=100) == 0   # digest wiped
+    expect = bytearray(data)
+    expect[100:102] = b"QQ"
+    expect = bytes(expect)
+    cl2 = c.client("client.probe")
+    _pg, primary = cl2._calc_target(cl2.lookup_pool("p"), "obj")
+    n = 0
+    for osd in c.osds.values():
+        if osd.osd_id == primary:
+            continue
+        for cid in osd.store.list_collections():
+            if "_meta" in cid:
+                continue
+            for ho in osd.store.list_objects(cid):
+                if ho.oid == "obj":
+                    osd.store.colls[cid][ho].data[3] ^= 0xFF
+                    n += 1
+    assert n == 2
+    c.scrub(deep=True)
+    for osd in c.osds.values():
+        for cid in osd.store.list_collections():
+            if "_meta" in cid:
+                continue
+            for ho in osd.store.list_objects(cid):
+                if ho.oid == "obj":
+                    assert bytes(osd.store.colls[cid][ho].data) == expect
+    assert cl.read("p", "obj") == expect
+
+
+def test_repaired_copy_does_not_rescrub_forever():
+    """A recovery push mints a recorded digest the other copies lack;
+    that must not read as an attr inconsistency, or every scrub would
+    re-'repair' a correct copy forever."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", size=3, pg_num=4)
+    cl = c.client("client.s")
+    data = payload(seed=17)
+    assert cl.write_full("p", "obj", data) == 0
+    assert cl.write("p", "obj", b"ZZ", offset=50) == 0    # digests wiped
+    expect = bytearray(data)
+    expect[50:52] = b"ZZ"
+    expect = bytes(expect)
+    cl2 = c.client("client.probe")
+    _pg, primary = cl2._calc_target(cl2.lookup_pool("p"), "obj")
+    hit = 0
+    for osd in c.osds.values():
+        if osd.osd_id == primary:
+            continue
+        for cid in osd.store.list_collections():
+            if "_meta" in cid:
+                continue
+            for ho in osd.store.list_objects(cid):
+                if ho.oid == "obj" and hit == 0:
+                    osd.store.colls[cid][ho].data[3] ^= 0x55
+                    hit += 1
+    assert hit == 1
+    c.scrub(deep=True)          # finds + repairs (push mints a digest)
+    c.tick()
+    errs_after_repair = len(c.mon.log_last(100, level="ERR"))
+    for _ in range(3):          # further scrubs must stay quiet
+        c.scrub(deep=True)
+        c.tick()
+    assert len(c.mon.log_last(100, level="ERR")) == errs_after_repair, \
+        c.mon.log_last(5, level="ERR")
+    assert cl.read("p", "obj") == expect
+
+
 def test_scheduler_upgrades_to_deep_on_interval():
     c = MiniCluster(n_osds=4)
     c.create_replicated_pool("p", size=3, pg_num=4)
