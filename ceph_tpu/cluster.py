@@ -160,6 +160,10 @@ class MiniCluster:
             lambda c, a: self.mgr.prometheus_metrics(
                 self.perf_collection),
             "prometheus text exposition")
+        asok.register(
+            "pg_autoscale status",
+            lambda c, a: self.mgr.pg_autoscale(apply=False),
+            "per-pool pg_num recommendations (dry run)")
         from .common import g_kernel_timer, get_log, \
             register_config_observers
         register_config_observers(g_conf)

@@ -37,9 +37,15 @@ class Manager(Dispatcher):
         self.messenger = network.create_messenger(name)
         self.messenger.add_dispatcher_head(self)
         self.osdmap = OSDMap()
-        self.modules = ["balancer", "prometheus", "status"]
+        self.modules = ["balancer", "prometheus", "status",
+                        "pg_autoscaler"]
         self.balancer_active = False     # 'ceph balancer on' equivalent
         self.last_optimize_result = 0
+        # per-PG usage from primaries' MPGStats reports (newest epoch
+        # wins — only the current primary reports a PG, so no double
+        # counting):  (pool, ps) -> (epoch, objects, bytes)
+        self.pg_stats: Dict[tuple, tuple] = {}
+        self.autoscaler_active = False
         for m in (all_mons if all_mons is not None else [self.mon]):
             m.subscribe(name)
         self.mon.send_full_map(name)
@@ -51,10 +57,20 @@ class Manager(Dispatcher):
 
     # ---- dispatch ----------------------------------------------------------
     def ms_fast_dispatch(self, msg: Message) -> None:
+        from ..msg.messages import MPGStats
         if isinstance(msg, MOSDMap):
             for inc in msg.incrementals:
                 if inc.epoch == self.osdmap.epoch + 1:
                     self.osdmap.apply_incremental(inc)
+        elif isinstance(msg, MPGStats):
+            for pool, ps, n_obj, n_bytes in msg.pg_stats:
+                cur = self.pg_stats.get((pool, ps))
+                if cur is not None and cur[0] > msg.epoch:
+                    # a map-lagged ex-primary (blackholed from the
+                    # mons but not from us) must not clobber the
+                    # current primary's numbers
+                    continue
+                self.pg_stats[(pool, ps)] = (msg.epoch, n_obj, n_bytes)
 
     # ---- balancer module ---------------------------------------------------
     def balancer_optimize(self, max_deviation: float = 0.01,
@@ -93,13 +109,94 @@ class Manager(Dispatcher):
         """Periodic module work (the mgr's serve loops)."""
         if self.balancer_active:
             self.balancer_optimize()
+        if self.autoscaler_active:
+            self.pg_autoscale(apply=True)
+
+    # ---- pg_autoscaler module ----------------------------------------------
+    def pool_stats(self) -> Dict[int, Dict[str, int]]:
+        """Per-pool usage aggregated from primaries' MPGStats reports
+        (the mgr's PGMap-digest role).  Stale entries for PGs a pool no
+        longer has (pre-split parents never re-report) are skipped."""
+        out: Dict[int, Dict[str, int]] = {}
+        stale = []
+        for (pool, ps), (_e, n_obj, n_bytes) in self.pg_stats.items():
+            p = self.osdmap.pools.get(pool)
+            if p is None or ps >= p.pg_num:
+                stale.append((pool, ps))   # deleted pool / split parent
+                continue
+            d = out.setdefault(pool, {"objects": 0, "bytes": 0,
+                                      "pgs_reporting": 0})
+            d["objects"] += n_obj
+            d["bytes"] += n_bytes
+            d["pgs_reporting"] += 1
+        for key in stale:
+            del self.pg_stats[key]
+        return out
+
+    def pg_autoscale(self, target_pgs_per_osd: int = 100,
+                     threshold: float = 3.0,
+                     apply: bool = False) -> List[Dict]:
+        """Recommend (and optionally apply) per-pool pg_num targets
+        (pybind/mgr/pg_autoscaler/module.py): each pool's share of the
+        cluster's used bytes earns it a share of the PG budget
+        (target_pgs_per_osd x in-OSDs), divided by its replication
+        cost, rounded to a power of two.  A change is recommended only
+        when the pool is off by *threshold* in either direction; only
+        growth can be applied (splitting exists, merging does not — a
+        shrink recommendation is report-only, like the reference's
+        warn mode)."""
+        m = self.osdmap
+        n_in = self.num_in_osds()
+        stats = self.pool_stats()
+        total_bytes = sum(d["bytes"] for d in stats.values())
+        budget = target_pgs_per_osd * max(n_in, 1)
+        out: List[Dict] = []
+        for pid, pool in sorted(m.pools.items()):
+            used = stats.get(pid, {}).get("bytes", 0)
+            if total_bytes <= 0:
+                # empty cluster: spread the budget evenly
+                ratio = 1.0 / max(len(m.pools), 1)
+            else:
+                ratio = used / total_bytes
+            raw = ratio * budget / max(pool.size, 1)
+            target = 1
+            while target * 2 <= max(raw, 1):
+                target *= 2
+            target = max(target, 4)      # pg_num_min floor
+            action = "ok"
+            if target >= pool.pg_num * threshold:
+                action = "grow"
+            elif target * threshold <= pool.pg_num:
+                action = "shrink (report-only)"
+            ent = {"pool_id": pid,
+                   "pool": m.pool_name.get(pid, str(pid)),
+                   "bytes": used, "ratio": round(ratio, 4),
+                   "pg_num": pool.pg_num, "target": target,
+                   "action": action}
+            if apply and action == "grow":
+                # grow like an operator would: pg_num first (children
+                # split in place), then pgp_num (children spread to
+                # their own CRUSH positions, pg_temp-primed)
+                name = m.pool_name[pid]
+                self.mon.set_pool_pg_num(name, target)
+                self.mon.publish()
+                self.mon.set_pool_pgp_num(name, target)
+                self.mon.publish()
+                self.network.pump()
+                ent["applied"] = True
+            out.append(ent)
+        return out
+
+    def num_in_osds(self) -> int:
+        m = self.osdmap
+        return sum(1 for o in range(m.max_osd)
+                   if m.exists(o) and m.osd_weight[o] > 0)
 
     # ---- status module -----------------------------------------------------
     def status(self) -> Dict:
         m = self.osdmap
         n_up = sum(1 for o in range(m.max_osd) if m.is_up(o))
-        n_in = sum(1 for o in range(m.max_osd)
-                   if m.exists(o) and m.osd_weight[o] > 0)
+        n_in = self.num_in_osds()
         return {
             "epoch": m.epoch,
             "num_osds": m.max_osd,

@@ -396,6 +396,18 @@ class MMonSubscribe(Message):
 
 
 @dataclass
+class MPGStats(Message):
+    """OSD -> mgr per-PG usage stats (src/messages/MPGStats.h role):
+    each primary reports its PGs' object counts and logical bytes, the
+    mgr aggregates per pool — the usage feed for pg_autoscaler and
+    `ceph df`-style accounting."""
+    osd: int = -1
+    epoch: int = 0
+    # [(pool, ps, num_objects, num_bytes)]
+    pg_stats: List[Tuple[int, int, int, int]] = field(default_factory=list)
+
+
+@dataclass
 class MLog(Message):
     """Daemon -> mon cluster-log entry (src/messages/MLog.h role):
     queued by the leader and paxos-committed with the next epoch, so

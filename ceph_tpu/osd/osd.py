@@ -624,6 +624,7 @@ class OSD(Dispatcher):
                          epoch=self.osdmap.epoch), f"osd.{peer}")
         self.maybe_schedule_scrubs()
         self._report_strays()
+        self.report_pg_stats()
         # map says down but we are alive: keep asking back in every tick
         # (the reference's OSD::start_boot retries; a single send can be
         # lost while connections re-establish after a daemon reboot)
@@ -687,6 +688,48 @@ class OSD(Dispatcher):
                         MOSDFailure(target_osd=peer, failed_since=last,
                                     epoch=self.osdmap.epoch,
                                     reporter=self.name), mon)
+
+    def report_pg_stats(self, mgr_name: str = "mgr",
+                        every: int = 5) -> None:
+        """Primary PGs report object counts + logical bytes to the mgr
+        (MPGStats / MgrClient role); the network drops the send when no
+        mgr exists.  Logical size comes from SIZE_ATTR (un-padded), so
+        replicated and EC pools account the same bytes.  The store scan
+        is O(objects), so it runs every ``every``-th tick (the
+        reference's mgr_stats_period), starting with the first."""
+        self._stats_tick = getattr(self, "_stats_tick", -1) + 1
+        if every > 1 and self._stats_tick % every:
+            return
+        from ..msg.messages import MPGStats
+        from .ec_backend import SIZE_ATTR
+        from .pg_log import PG_META_OID
+        stats = []
+        for pgid, pg in self.pgs.items():
+            if not pg.is_primary():
+                continue
+            if pg.backend is not None:
+                shard = pg.my_shard()
+                cids = [pg.backend.shard_cid(shard)] if shard >= 0 else []
+            else:
+                cids = [pg.rep_backend.cid()]
+            n_obj = n_bytes = 0
+            for cid in cids:
+                if not self.store.collection_exists(cid):
+                    continue
+                for ho in self.store.list_objects(cid):
+                    if ho.oid == PG_META_OID:
+                        continue
+                    n_obj += 1
+                    sz = self.store.getattrs(cid, ho).get(SIZE_ATTR)
+                    if sz is not None:
+                        n_bytes += struct.unpack("<Q", sz)[0]
+                    else:
+                        n_bytes += self.store.stat(cid, ho)
+            stats.append((pgid[0], pgid[1], n_obj, n_bytes))
+        if stats:
+            self.messenger.send_message(MPGStats(
+                osd=self.osd_id, epoch=self.osdmap.epoch,
+                pg_stats=stats), mgr_name)
 
     def clog(self, level: str, message: str) -> None:
         """Send a cluster-log entry to the mons (clog->error()/info()

@@ -1,0 +1,113 @@
+"""pg_autoscaler + MPGStats: usage-driven PG budgeting.
+
+The reference's OSDs report per-PG stats to the mgr (MPGStats /
+MgrClient), whose pg_autoscaler module (pybind/mgr/pg_autoscaler/)
+computes each pool's share of the PG budget from its share of used
+bytes and grows pg_num toward a power-of-two target.  Shrinking is
+report-only here (splitting exists, merging does not), matching the
+module's warn mode.
+"""
+import numpy as np
+
+from ceph_tpu.cluster import MiniCluster
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def test_mpgstats_aggregate_to_pool_usage():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("busy", size=3, pg_num=4)
+    c.create_replicated_pool("idle", size=3, pg_num=4)
+    cl = c.client("client.s")
+    for i in range(8):
+        cl.write_full("busy", f"o{i}", payload(10000, seed=i))
+    cl.write_full("idle", "only", payload(100, seed=99))
+    c.tick()                      # primaries report MPGStats
+    stats = c.mgr.pool_stats()
+    busy = cl.lookup_pool("busy")
+    idle = cl.lookup_pool("idle")
+    assert stats[busy]["objects"] == 8
+    assert stats[busy]["bytes"] == 8 * 10000
+    assert stats[idle]["objects"] == 1
+    assert stats[idle]["bytes"] == 100
+
+
+def test_autoscaler_grows_hot_pool_and_data_survives():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("hot", size=2, pg_num=4)
+    c.create_replicated_pool("cold", size=2, pg_num=4)
+    cl = c.client("client.s")
+    blobs = {}
+    for i in range(24):
+        blobs[f"h{i}"] = payload(20000, seed=i)
+        cl.write_full("hot", f"h{i}", blobs[f"h{i}"])
+    cl.write_full("cold", "c0", payload(50, seed=77))
+    c.tick()
+    recs = c.mgr.pg_autoscale(target_pgs_per_osd=64, apply=False)
+    hot = next(r for r in recs if r["pool"] == "hot")
+    cold = next(r for r in recs if r["pool"] == "cold")
+    assert hot["action"] == "grow" and hot["target"] > hot["pg_num"]
+    # a power-of-two target
+    assert hot["target"] & (hot["target"] - 1) == 0
+    assert "grow" not in cold["action"]
+    # apply: splitting machinery runs, all data stays readable
+    recs = c.mgr.pg_autoscale(target_pgs_per_osd=64, apply=True)
+    c.tick(rounds=3)
+    hot_pool = c.mon.osdmap.pools[cl.lookup_pool("hot")]
+    assert hot_pool.pg_num == hot["target"]
+    assert hot_pool.pgp_num == hot["target"]
+    for oid, data in blobs.items():
+        assert cl.read("hot", oid) == data
+    assert cl.read("cold", "c0") == payload(50, seed=77)
+
+
+def test_autoscaler_shrink_is_report_only():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("over", size=3, pg_num=64)
+    c.create_replicated_pool("rest", size=3, pg_num=4)
+    cl = c.client("client.s")
+    cl.write_full("over", "tiny", payload(10))
+    for i in range(10):
+        cl.write_full("rest", f"r{i}", payload(20000, seed=i))
+    c.tick()
+    recs = c.mgr.pg_autoscale(target_pgs_per_osd=16, apply=True)
+    over = next(r for r in recs if r["pool"] == "over")
+    assert "shrink" in over["action"]
+    assert "applied" not in over
+    assert c.mon.osdmap.pools[cl.lookup_pool("over")].pg_num == 64
+
+
+def test_autoscaler_admin_socket_dry_run():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", size=3, pg_num=4)
+    cl = c.client("client.s")
+    cl.write_full("p", "o", payload(1000))
+    c.tick()
+    out = c.admin_socket.execute("pg_autoscale status")
+    assert isinstance(out, list) and out[0]["pool"] == "p"
+    # dry run: nothing changed
+    assert c.mon.osdmap.pools[cl.lookup_pool("p")].pg_num == 4
+
+
+def test_stale_parent_stats_dropped_after_split():
+    """A pre-split parent's report for ps >= pg_num children doesn't
+    linger; the pool aggregate converges to the real contents."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", size=2, pg_num=2)
+    cl = c.client("client.s")
+    blobs = {f"o{i}": payload(5000, seed=i) for i in range(8)}
+    for oid, b in blobs.items():
+        cl.write_full("p", oid, b)
+    c.tick()
+    before = c.mgr.pool_stats()[cl.lookup_pool("p")]
+    c.mon.set_pool_pg_num("p", 8)
+    c.publish()
+    c.tick(rounds=2)
+    after = c.mgr.pool_stats()[cl.lookup_pool("p")]
+    assert after["objects"] == before["objects"] == 8
+    assert after["bytes"] == before["bytes"]
+    for oid, b in blobs.items():
+        assert cl.read("p", oid) == b
