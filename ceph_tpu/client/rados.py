@@ -182,6 +182,10 @@ class RadosClient(Dispatcher):
         self._next_cookie = 1
         self._linger_tids: Dict[int, int] = {}   # in-flight re-register
         self._linger_retries: Dict[int, int] = {}
+        # pool id -> (snapc_seq, [snap ids, newest first]): the write
+        # SnapContext for selfmanaged-snap pools (librados
+        # selfmanaged_snap_set_write_ctx; rides every mutating MOSDOp)
+        self._write_snapc: Dict[int, Tuple[int, list]] = {}
         mon.subscribe(name)
         mon.send_full_map(name)
         network.pump()
@@ -249,11 +253,13 @@ class RadosClient(Dispatcher):
             self._tid += 1
             tid = self._tid
             if primary >= 0:
+                sc_seq, sc_snaps = self._write_snapc.get(pool_id, (0, []))
                 msg = MOSDOp(tid=tid, pool=pgid[0], oid=oid, pgid=pgid,
                              op=op, data=data, offset=offset,
                              length=length, epoch=self.osdmap.epoch,
                              ops=list(ops) if ops else [],
                              snapid=snapid,
+                             snapc_seq=sc_seq, snapc_snaps=list(sc_snaps),
                              trace_id=new_trace_id())
                 self.messenger.send_message(msg, f"osd.{primary}")
                 self.network.pump()
@@ -337,6 +343,34 @@ class RadosClient(Dispatcher):
     def snap_list(self, pool: str) -> Dict[int, str]:
         p = self.osdmap.get_pg_pool(self.lookup_pool(pool))
         return dict(p.snaps)
+
+    # ---- selfmanaged snaps (librados rados_ioctx_selfmanaged_snap_*):
+    # the mon only allocates/retires ids; snapshot membership lives in
+    # the write SnapContext this client attaches to mutations ----------
+    def selfmanaged_snap_create(self, pool: str) -> int:
+        sid = self.mon.selfmanaged_snap_create(pool)
+        self.mon.publish()
+        self.network.pump()
+        return sid
+
+    def selfmanaged_snap_remove(self, pool: str, snapid: int) -> None:
+        self.mon.selfmanaged_snap_remove(pool, snapid)
+        self.mon.publish()
+        self.network.pump()
+        pid = self.lookup_pool(pool)
+        seq, snaps = self._write_snapc.get(pid, (0, []))
+        if snapid in snaps:
+            self.set_write_ctx(pool, seq,
+                               [s for s in snaps if s != snapid])
+
+    def set_write_ctx(self, pool: str, seq: int, snaps) -> None:
+        """Set the SnapContext attached to this pool's writes: ``seq``
+        the newest snap id, ``snaps`` every live snap (any order; sent
+        newest-first like the reference sorts it)."""
+        snaps = sorted(snaps, reverse=True)
+        if snaps and (seq < snaps[0] or len(set(snaps)) != len(snaps)):
+            raise ValueError("invalid snap context")
+        self._write_snapc[self.lookup_pool(pool)] = (seq, snaps)
 
     def rollback(self, pool: str, oid: str, snap) -> int:
         """Restore the head — data AND xattrs — to its state at the

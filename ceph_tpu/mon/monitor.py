@@ -746,6 +746,9 @@ class Monitor(Dispatcher):
         if pid < 0:
             raise KeyError(f"no pool {pool_name!r}")
         pool = self.osdmap.pools[pid]
+        if pool.selfmanaged:
+            raise ValueError(
+                f"pool {pool_name!r} is in selfmanaged snap mode")
         if snap_name in pool.snaps.values():
             raise ValueError(f"snap {snap_name!r} exists")
         sid = pool.snap_seq + 1
@@ -766,6 +769,43 @@ class Monitor(Dispatcher):
                 self._topology_dirty = True
                 return sid
         raise KeyError(f"no snap {snap_name!r} on {pool_name!r}")
+
+    # ---- self-managed snaps (OSDMonitor "osd pool mksnap" unmanaged twin:
+    # librados selfmanaged_snap_create/remove -> mon snapid allocation;
+    # pg_pool_t::add_unmanaged_snap, src/osd/osd_types.cc) ------------------
+    def selfmanaged_snap_create(self, pool_name: str) -> int:
+        """Allocate the next snap id; the snapshot itself lives only in
+        the client's SnapContext.  Commits the pool to selfmanaged mode."""
+        pid = self.osdmap.lookup_pg_pool_name(pool_name)
+        if pid < 0:
+            raise KeyError(f"no pool {pool_name!r}")
+        pool = self.osdmap.pools[pid]
+        if pool.snaps:
+            raise ValueError(
+                f"pool {pool_name!r} already has pool snapshots")
+        pool.selfmanaged = True
+        sid = pool.snap_seq + 1
+        pool.snap_seq = sid
+        self._topology_dirty = True
+        return sid
+
+    def selfmanaged_snap_remove(self, pool_name: str, snapid: int) -> None:
+        """Mark an allocated id removed so PGs trim its clones."""
+        pid = self.osdmap.lookup_pg_pool_name(pool_name)
+        if pid < 0:
+            raise KeyError(f"no pool {pool_name!r}")
+        pool = self.osdmap.pools[pid]
+        if not pool.selfmanaged:
+            # retiring a live pool-mode snapshot id here would corrupt
+            # it (the reference returns EINVAL unless the pool is in
+            # unmanaged snaps mode, pg_pool_t::remove_unmanaged_snap)
+            raise ValueError(
+                f"pool {pool_name!r} is not in selfmanaged snap mode")
+        if not (0 < snapid <= pool.snap_seq):
+            raise KeyError(f"snap id {snapid} never allocated")
+        if snapid not in pool.removed_snaps:
+            pool.removed_snaps.append(snapid)
+            self._topology_dirty = True
 
     # ---- epoch publication -------------------------------------------------
     def _snapshot_inc(self) -> Incremental:

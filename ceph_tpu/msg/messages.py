@@ -104,6 +104,10 @@ class MOSDOp(Message):
     epoch: int = 0
     ops: List["OSDOp"] = field(default_factory=list)
     snapid: int = 0          # read at this pool snap (0 = head)
+    # client-supplied write SnapContext for selfmanaged-snap pools
+    # (MOSDOp snapc, src/messages/MOSDOp.h; empty = use the pool snapc)
+    snapc_seq: int = 0
+    snapc_snaps: List[int] = field(default_factory=list)
 
 
 @dataclass

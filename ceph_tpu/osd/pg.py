@@ -1713,21 +1713,25 @@ class PG:
                     tid=msg.tid, result=-95,
                     epoch=self.osd.osdmap.epoch))
                 return
-            self.with_clone(msg.oid, lambda: self._do_copy_from(msg))
+            self.with_clone(msg.oid, lambda: self._do_copy_from(msg),
+                            snapc=self._msg_snapc(msg))
             return
         if msg.ops:
             self._do_op_vector(msg)
         elif msg.op == CEPH_OSD_OP_WRITEFULL:
-            self.with_clone(msg.oid, lambda: self._do_write(msg))
+            self.with_clone(msg.oid, lambda: self._do_write(msg),
+                            snapc=self._msg_snapc(msg))
         elif msg.op in (CEPH_OSD_OP_WRITE, CEPH_OSD_OP_APPEND):
             self.with_clone(msg.oid,
-                            lambda: self._do_partial_write(msg))
+                            lambda: self._do_partial_write(msg),
+                            snapc=self._msg_snapc(msg))
         elif msg.op == CEPH_OSD_OP_READ:
             self._do_read(msg)
         elif msg.op == CEPH_OSD_OP_STAT:
             self._do_stat(msg)
         elif msg.op == CEPH_OSD_OP_DELETE:
-            self.with_clone(msg.oid, lambda: self._do_delete(msg))
+            self.with_clone(msg.oid, lambda: self._do_delete(msg),
+                            snapc=self._msg_snapc(msg))
         else:
             self.osd.send_op_reply(msg.src,
                                    MOSDOpReply(tid=msg.tid, result=-95))
@@ -1835,7 +1839,7 @@ class PG:
         primary killed between staging purged and the fan-out being
         delivered) and redo it; purged_snaps is a fast-path hint, not
         ground truth."""
-        return set(self.pool.snaps) | set(self.pool.removed_snaps)
+        return self.pool.live_snaps() | set(self.pool.removed_snaps)
 
     @staticmethod
     def _clone_oid(oid: str, seq: int) -> str:
@@ -1849,8 +1853,18 @@ class PG:
         ents = self.snapsets.get(oid)
         return ents[-1][0] if ents else 0
 
-    def _clone_needed(self, oid: str) -> bool:
-        seq = self.pool.snap_seq
+    def _msg_snapc(self, msg) -> Optional[Tuple[int, Tuple[int, ...]]]:
+        """Client-supplied write SnapContext (selfmanaged-snap pools);
+        None means clone against the pool snapc as before."""
+        if getattr(msg, "snapc_seq", 0) > 0:
+            return (msg.snapc_seq, tuple(msg.snapc_snaps))
+        return None
+
+    def _clone_needed(self, oid: str, snapc=None) -> bool:
+        if snapc is None:
+            seq, snaps = self.pool.snap_seq, self.pool.snaps
+        else:
+            seq, snaps = snapc
         if seq == 0 or self.is_clone_oid(oid):
             return False
         m = self._snapset_max(oid)
@@ -1858,28 +1872,33 @@ class PG:
             return False
         # a clone is only worth taking if a LIVE snap falls in the
         # window it would cover — after every snap is removed, writes
-        # must not keep manufacturing instant garbage
-        return any(m < sid <= seq for sid in self.pool.snaps)
+        # must not keep manufacturing instant garbage.  A client snapc
+        # may lag the mon's removals, so filter those out too.
+        removed = set(self.pool.removed_snaps)
+        return any(m < sid <= seq and sid not in removed for sid in snaps)
 
-    def with_clone(self, oid: str, proceed: Callable[[], None]) -> None:
+    def with_clone(self, oid: str, proceed: Callable[[], None],
+                   snapc=None) -> None:
         """Run *proceed* after ensuring the pre-write state is cloned
         (make_writeable's clone step, PrimaryLogPG.cc)."""
-        if not self._clone_needed(oid):
+        if not self._clone_needed(oid, snapc):
             proceed()
             return
         if self.backend is not None:
             self.backend.object_state(
                 oid, lambda res, data, _size, attrs:
-                self._clone_have_state(oid, res, data, attrs, {}, proceed))
+                self._clone_have_state(oid, res, data, attrs, {}, proceed,
+                                       snapc))
         else:
             exists, data, attrs, omap = self.rep_backend.object_state(oid)
             self._clone_have_state(oid, 0 if exists else -2, data, attrs,
-                                   omap, proceed)
+                                   omap, proceed, snapc)
 
     def _clone_have_state(self, oid: str, res: int, data: bytes,
                           attrs: Dict[str, bytes],
                           omap: Dict[str, bytes],
-                          proceed: Callable[[], None]) -> None:
+                          proceed: Callable[[], None],
+                          snapc=None) -> None:
         if res not in (0, -2):
             # can't read the head (EIO): write anyway, skip the clone —
             # losing a snapshot beats failing every write
@@ -1887,7 +1906,7 @@ class PG:
                  f"osd.{self.osd.osd_id}")
             proceed()
             return
-        seq = self.pool.snap_seq
+        seq = snapc[0] if snapc is not None else self.pool.snap_seq
         if self._snapset_max(oid) >= seq:   # raced with ourselves
             proceed()
             return
@@ -2024,7 +2043,7 @@ class PG:
         candidates: Set[str] = set()
         for sid in to_purge:
             candidates |= self.snap_mapper.lookup(sid)
-        live = set(self.pool.snaps)
+        live = self.pool.live_snaps()
         interesting = self._interesting_snaps()
         for oid in sorted(candidates):
             entries = self.snapsets.get(oid)
@@ -2156,7 +2175,8 @@ class PG:
         def gated() -> None:
             mutates = any(self._op_mutates(o) for o in msg.ops)
             if mutates:
-                self.with_clone(oid, start)
+                self.with_clone(oid, start,
+                                snapc=self._msg_snapc(msg))
             else:
                 start()
 

@@ -159,6 +159,8 @@ def pool_to_dict(p: pg_pool_t) -> Dict[str, Any]:
     d["snap_seq"] = p.snap_seq
     d["snaps"] = {str(k): v for k, v in p.snaps.items()}
     d["removed_snaps"] = list(p.removed_snaps)
+    if p.selfmanaged:
+        d["selfmanaged"] = True
     d["flags_versioned"] = True   # marks flags as post-ec_overwrites-gate
     return d
 
@@ -171,6 +173,7 @@ def pool_from_dict(d: Dict[str, Any]) -> pg_pool_t:
     p.snap_seq = int(d.get("snap_seq", 0))
     p.snaps = {int(k): v for k, v in d.get("snaps", {}).items()}
     p.removed_snaps = [int(x) for x in d.get("removed_snaps", [])]
+    p.selfmanaged = bool(d.get("selfmanaged", False))
     if p.is_erasure() and not d.get("flags_versioned"):
         # checkpoints written before the overwrites gate existed always
         # allowed rmw; restoring them must not break their workloads

@@ -58,6 +58,27 @@ class pg_pool_t:
     snap_seq: int = 0
     snaps: Dict[int, str] = field(default_factory=dict)
     removed_snaps: List[int] = field(default_factory=list)
+    # self-managed (unmanaged) snap mode: ids are allocated by the mon but
+    # snapshots exist only in client-supplied SnapContexts (librbd-style;
+    # pg_pool_t::is_unmanaged_snaps_mode, osd_types.h).  A pool commits to
+    # one mode on first use; mixing is refused like the reference does.
+    selfmanaged: bool = False
+
+    def live_snaps(self) -> set:
+        """Snap ids that may still be referenced — the trim liveness
+        set.  Pool mode: the named snaps.  Selfmanaged mode: every
+        allocated-and-not-removed id (any client's snapc may cite it,
+        so only removal makes a clone garbage); cached because clone
+        writes consult this per mutation and snap_seq only grows."""
+        if not self.selfmanaged:
+            return set(self.snaps)
+        key = (self.snap_seq, len(self.removed_snaps))
+        cached = getattr(self, "_live_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        live = set(range(1, self.snap_seq + 1)) - set(self.removed_snaps)
+        object.__setattr__(self, "_live_cache", (key, live))
+        return live
     # cache tiering (pg_pool_t tier fields, osd_types.h): a BASE pool
     # gains read_tier/write_tier redirects; the CACHE pool records
     # tier_of + agent/hit-set knobs (HitSet.h; OSDMonitor "osd tier")
