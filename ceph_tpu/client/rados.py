@@ -370,7 +370,13 @@ class RadosClient(Dispatcher):
         snaps = sorted(snaps, reverse=True)
         if snaps and (seq < snaps[0] or len(set(snaps)) != len(snaps)):
             raise ValueError("invalid snap context")
-        self._write_snapc[self.lookup_pool(pool)] = (seq, snaps)
+        pid = self.lookup_pool(pool)
+        if seq > 0 and not self.osdmap.get_pg_pool(pid).selfmanaged:
+            # a snapc on a pool-snapshot pool would shadow the pool
+            # snapc and corrupt its snapshots (reference: EINVAL)
+            raise ValueError(
+                f"pool {pool!r} is not in selfmanaged snap mode")
+        self._write_snapc[pid] = (seq, snaps)
 
     def rollback(self, pool: str, oid: str, snap) -> int:
         """Restore the head — data AND xattrs — to its state at the

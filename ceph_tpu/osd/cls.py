@@ -64,12 +64,26 @@ class ClsContext:
         self._st["exists"] = True
         self._st["_meta"] = True
 
+    def _check_omap(self) -> None:
+        if not self._st.get("omap_ok", True):
+            raise ClsError(-95)      # EOPNOTSUPP: no omap on EC pools
+
     def omap_get(self) -> Dict[str, bytes]:
+        self._check_omap()
         return dict(self._st["omap"])
 
     def omap_set(self, kv: Dict[str, bytes]) -> None:
-        self._st["omap"].update(kv)
+        self._check_omap()
+        self._st["omap"].update(
+            {k: v if isinstance(v, bytes) else str(v).encode()
+             for k, v in kv.items()})
         self._st["exists"] = True
+        self._st["_meta"] = True
+
+    def omap_rm_keys(self, keys) -> None:
+        self._check_omap()
+        for k in keys:
+            self._st["omap"].pop(k, None)
         self._st["_meta"] = True
 
 

@@ -1692,6 +1692,14 @@ class PG:
         elif msg.op == CEPH_OSD_OP_NOTIFY and not msg.ops:
             self._do_notify(msg)
             return
+        # a client SnapContext is only meaningful on selfmanaged-snap
+        # pools; honoring one on a pool-snapshot pool would replace the
+        # pool snapc and corrupt its snapshots (the reference rejects
+        # this with EINVAL, PrimaryLogPG do_op snapc checks)
+        if getattr(msg, "snapc_seq", 0) > 0 and not self.pool.selfmanaged:
+            self.osd.send_op_reply(msg.src, MOSDOpReply(
+                tid=msg.tid, result=-22, epoch=self.osd.osdmap.epoch))
+            return
         # FLAG_EC_OVERWRITES gate — BEFORE any clone/side effect, and
         # covering both message shapes (a partial update is a partial
         # update whether it rides a single op or a vector)
@@ -2196,7 +2204,11 @@ class PG:
                 tid=msg.tid, result=res, epoch=self.osd.osdmap.epoch))
             return None
         st = {"exists": res == 0, "body": bytearray(data),
-              "attrs": dict(attrs), "omap": dict(omap)}
+              "attrs": dict(attrs), "omap": dict(omap),
+              # EC stores have no omap; class methods touching it must
+              # fail loudly (EOPNOTSUPP) instead of staging silently
+              # dropped keys (reference: cls_cxx_map_* on EC pools)
+              "omap_ok": self.backend is None}
         if any(o.op == CEPH_OSD_OP_ASSERT_VER for o in msg.ops):
             st["cur_version"] = self._stored_user_version(msg.oid)
         existed = st["exists"]

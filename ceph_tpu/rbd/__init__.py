@@ -1,0 +1,10 @@
+"""librbd-lite: rados block images (src/librbd + src/cls/rbd).
+
+Importing the package registers the ``rbd`` object class so any OSD in
+the process can execute header methods, mirroring how the reference
+loads libcls_rbd.so into every OSD.
+"""
+from . import cls_rbd  # noqa: F401  (registers the cls methods)
+from .image import Image, RBD, RBDError
+
+__all__ = ["Image", "RBD", "RBDError"]

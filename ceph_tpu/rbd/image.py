@@ -1,0 +1,509 @@
+"""librbd-lite: block images striped over rados objects.
+
+The reference's librbd (src/librbd, 57k LoC) maps a virtual block device
+onto 2^order-byte rados objects ``rbd_data.<id>.<objno:%016x>``, with
+image metadata in a header object mutated only through cls_rbd methods
+and per-pool indexes (``rbd_directory``, ``rbd_children``).  This module
+reimplements that core on the framework's own primitives:
+
+- striping: image offset -> (objno, in-object offset); absent objects
+  read as zeros (sparse), like ImageCtx::prune_parent_extents + ObjectMap
+  absence semantics.
+- snapshots: mon-allocated selfmanaged snap ids recorded on the header
+  (cls snapshot_add); every data mutation rides the image's SnapContext
+  so the OSD clones pre-write state (librbd ImageCtx::snapc).
+- clones: child images carry a (pool, image_id, snapid, overlap) parent
+  link; reads fall through to the parent below the overlap and writes
+  copy-up the parent object first (AbstractObjectWriteRequest copyup).
+- flatten/resize/rollback mirror Operations.cc semantics at lite scale.
+
+Scope-outs vs the reference: exclusive locking, the image journal +
+mirroring, object-map/fast-diff feature bits, and the qemu block driver
+surface.
+"""
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from ..client.rados import ObjectOperation, RadosClient
+from .cls_rbd import (
+    RBD_CHILDREN, RBD_DATA_PREFIX, RBD_DIRECTORY, RBD_HEADER_PREFIX,
+)
+
+
+class RBDError(IOError):
+    def __init__(self, api: str, result: int):
+        super().__init__(f"rbd {api}: error {result}")
+        self.result = result
+
+
+def _j(obj) -> bytes:
+    return json.dumps(obj, sort_keys=True).encode()
+
+
+def _absent(e: IOError) -> bool:
+    return getattr(e, "errno", None) == 2
+
+
+class RBD:
+    """Pool-level image admin (librbd::RBD): create/clone/list/remove."""
+
+    def __init__(self, client: RadosClient):
+        self.client = client
+
+    def _exec(self, pool: str, oid: str, method: str, payload=None
+              ) -> bytes:
+        ret, out = self.client.exec(pool, oid, "rbd", method,
+                                    _j(payload or {}))
+        if ret < 0:
+            raise RBDError(method, ret)
+        return out
+
+    def create(self, pool: str, name: str, size: int,
+               order: int = 22, data_pool: str = None) -> str:
+        """Create an image; returns its id (librbd::RBD::create).
+
+        ``data_pool`` puts the data objects in a separate — typically
+        erasure-coded — pool while the header/directory stay in the
+        omap-capable base pool (librbd RBD_FEATURE_DATA_POOL; EC pools
+        cannot hold omap, in the reference or here)."""
+        if size < 0 or not (12 <= order <= 26):
+            raise RBDError("create", -22)
+        iid = uuid.uuid4().hex[:12]
+        self._exec(pool, RBD_DIRECTORY, "dir_add_image",
+                   {"name": name, "id": iid})
+        try:
+            self._exec(pool, RBD_HEADER_PREFIX + iid, "create",
+                       {"size": size, "order": order,
+                        "object_prefix": RBD_DATA_PREFIX + iid,
+                        "data_pool": data_pool})
+        except RBDError:
+            self._exec(pool, RBD_DIRECTORY, "dir_remove_image",
+                       {"name": name, "id": iid})
+            raise
+        return iid
+
+    def list(self, pool: str) -> List[str]:
+        try:
+            return json.loads(self._exec(pool, RBD_DIRECTORY, "dir_list"))
+        except RBDError as e:
+            if e.result == -2:
+                return []
+            raise
+
+    def rename(self, pool: str, src: str, dst: str) -> None:
+        iid = self._exec(pool, RBD_DIRECTORY, "dir_get_id",
+                         {"name": src}).decode()
+        self._exec(pool, RBD_DIRECTORY, "dir_rename_image",
+                   {"src": src, "dst": dst, "id": iid})
+
+    def remove(self, pool: str, name: str) -> None:
+        """Remove an image: refused while it has snapshots or clone
+        children (librbd returns -ENOTEMPTY / -EBUSY)."""
+        img = Image(self.client, pool, name)
+        if img.snap_list():
+            raise RBDError("remove", -39)             # ENOTEMPTY
+        if img.parent():
+            pool_p, pid, psnap, _ = img.parent()
+            self._exec(pool_p, RBD_CHILDREN, "remove_child",
+                       {"pool": pool_p, "image_id": pid, "snapid": psnap,
+                        "child_id": img.id})
+        # a stale pool-wide write ctx from another image must not
+        # manufacture whiteout clones for these deletes
+        self.client.set_write_ctx(img.data_pool, 0, [])
+        for objno in range(img._objects_in(img.size())):
+            self.client.remove(img.data_pool, img._obj(objno))
+        self.client.remove(pool, RBD_HEADER_PREFIX + img.id)
+        self._exec(pool, RBD_DIRECTORY, "dir_remove_image",
+                   {"name": name, "id": img.id})
+
+    def clone(self, parent_pool: str, parent_name: str, snap_name: str,
+              child_pool: str, child_name: str,
+              data_pool: str = None) -> str:
+        """COW child of a protected parent snapshot (librbd clone v1
+        semantics: protect -> clone -> children index)."""
+        parent = Image(self.client, parent_pool, parent_name)
+        sid, info = parent._snap_by_name(snap_name)
+        if not info["protected"]:
+            raise RBDError("clone", -22)
+        iid = self.create(child_pool, child_name, info["size"],
+                          parent.order_log2, data_pool)
+        self._exec(child_pool, RBD_HEADER_PREFIX + iid, "set_parent",
+                   {"pool": parent_pool, "image_id": parent.id,
+                    "snapid": sid, "overlap": info["size"]})
+        self._exec(parent_pool, RBD_CHILDREN, "add_child",
+                   {"pool": parent_pool, "image_id": parent.id,
+                    "snapid": sid, "child_id": iid})
+        return iid
+
+
+class Image:
+    """An open image (librbd::Image): data I/O + snapshot/clone ops.
+
+    ``snapshot=`` opens a read-only view at that snap, like
+    rbd_open_read_only with a snap set on the ioctx.
+    """
+
+    def __init__(self, client: RadosClient, pool: str, name: str,
+                 snapshot: Optional[str] = None):
+        self.client = client
+        self.pool = pool
+        self.name = name
+        ret, out = client.exec(pool, RBD_DIRECTORY, "rbd", "dir_get_id",
+                               _j({"name": name}))
+        if ret < 0:
+            raise RBDError("open", ret)
+        self._load_header(out.decode())
+        if snapshot is not None:
+            sid, _ = self._snap_by_name(snapshot)
+            self.read_snap = sid
+
+    def _load_header(self, iid: str) -> None:
+        """Load the immutable image shape + parent link once at open
+        (ImageCtx caches parent_md the same way; librbd invalidates via
+        header watch/notify, which this lite layer scopes out — reopen
+        after another handle's flatten to observe it)."""
+        self.id = iid
+        self._header = RBD_HEADER_PREFIX + iid
+        meta = self._call("get_image")
+        self.order_log2 = meta["order"]
+        self.object_size = 1 << meta["order"]
+        self.object_prefix = meta["object_prefix"]
+        self.data_pool = meta.get("data_pool") or self.pool
+        self.read_snap: Optional[int] = None
+        self._parent_link = self._fetch_parent()
+        self._parent_handle: Optional["Image"] = None
+
+    # ---- header helpers ---------------------------------------------------
+    def _call(self, method: str, payload=None, parse: bool = True):
+        ret, out = self.client.exec(self.pool, self._header, "rbd",
+                                    method, _j(payload or {}))
+        if ret < 0:
+            raise RBDError(method, ret)
+        return json.loads(out) if (parse and out) else out
+
+    def _snapcontext(self) -> Tuple[int, Dict[int, Dict]]:
+        sc = self._call("get_snapcontext")
+        return sc["seq"], {int(k): v for k, v in sc["snaps"].items()}
+
+    def _snap_by_name(self, name: str) -> Tuple[int, Dict]:
+        for sid, info in sorted(self._snapcontext()[1].items()):
+            if info["name"] == name:
+                return sid, info
+        raise RBDError("snap lookup", -2)
+
+    def _apply_write_ctx(self) -> None:
+        """Install this image's SnapContext on the pool before a data
+        mutation (ImageCtx::snapc -> ioctx write ctx)."""
+        seq, snaps = self._snapcontext()
+        self.client.set_write_ctx(self.data_pool, seq, list(snaps))
+
+    def parent(self) -> Optional[Tuple[str, str, int, int]]:
+        return self._parent_link
+
+    def _fetch_parent(self) -> Optional[Tuple[str, str, int, int]]:
+        try:
+            p = json.loads(self._call("get_parent", parse=False))
+        except RBDError as e:
+            if e.result == -2:
+                return None
+            raise
+        return p["pool"], p["image_id"], p["snapid"], p["overlap"]
+
+    def _parent_image(self) -> "Image":
+        if self._parent_handle is None:
+            ppool, pid = self._parent_link[0], self._parent_link[1]
+            self._parent_handle = Image._open_by_id(self.client, ppool,
+                                                    pid)
+        return self._parent_handle
+
+    # ---- geometry ---------------------------------------------------------
+    def size(self) -> int:
+        if self.read_snap is not None:
+            return self._snapcontext()[1][self.read_snap]["size"]
+        return self._call("get_image")["size"]
+
+    def _obj(self, objno: int) -> str:
+        return f"{self.object_prefix}.{objno:016x}"
+
+    def _objects_in(self, nbytes: int) -> int:
+        return (nbytes + self.object_size - 1) // self.object_size
+
+    def _extents(self, offset: int, length: int
+                 ) -> List[Tuple[int, int, int]]:
+        """(objno, in-object offset, length) covering [offset, +length)
+        (Striper::file_to_extents for the rbd flat layout)."""
+        out = []
+        while length > 0:
+            objno, off = divmod(offset, self.object_size)
+            take = min(length, self.object_size - off)
+            out.append((objno, off, take))
+            offset += take
+            length -= take
+        return out
+
+    # ---- data path --------------------------------------------------------
+    def _read_object(self, objno: int, off: int, ln: int,
+                     snapid: Optional[int]) -> bytes:
+        try:
+            data = self.client.read(self.data_pool, self._obj(objno),
+                                    offset=off, length=ln, snap=snapid)
+        except IOError as e:
+            if not _absent(e):
+                raise
+            data = b""
+        return data.ljust(ln, b"\x00")
+
+    def _parent_read(self, objno: int, off: int, ln: int) -> bytes:
+        """Fall through to the parent below the overlap (ImageCtx::
+        aio_read parent path)."""
+        p = self.parent()
+        if p is None:
+            return b"\x00" * ln
+        ppool, pid, psnap, overlap = p
+        pos = objno * self.object_size + off
+        if pos >= overlap:
+            return b"\x00" * ln
+        take = min(ln, overlap - pos)
+        data = self._parent_image()._read_at(pos, take, psnap)
+        return data.ljust(ln, b"\x00")
+
+    @classmethod
+    def _open_by_id(cls, client: RadosClient, pool: str,
+                    iid: str) -> "Image":
+        img = object.__new__(cls)
+        img.client, img.pool, img.name = client, pool, f"#{iid}"
+        img._load_header(iid)
+        return img
+
+    def _read_at(self, offset: int, length: int,
+                 snapid: Optional[int]) -> bytes:
+        chunks = []
+        has_parent = self.parent() is not None
+        for objno, off, ln in self._extents(offset, length):
+            data = self._read_object(objno, off, ln, snapid)
+            if has_parent and not data.strip(b"\x00"):
+                # object may be wholly absent: only then fall through
+                try:
+                    self.client.stat(self.data_pool, self._obj(objno),
+                                     snap=snapid)
+                except IOError as e:
+                    if _absent(e):
+                        data = self._parent_read(objno, off, ln)
+                    else:
+                        raise
+            chunks.append(data)
+        return b"".join(chunks)
+
+    def read(self, offset: int, length: int) -> bytes:
+        end = self.size()
+        if offset >= end:
+            return b""
+        length = min(length, end - offset)
+        return self._read_at(offset, length, self.read_snap)
+
+    def write(self, offset: int, data: bytes) -> int:
+        """Write-through with copy-up for clones; grows never — writes
+        past the end are clipped like librbd returns -EINVAL."""
+        if self.read_snap is not None:
+            raise RBDError("write", -30)              # EROFS
+        end = self.size()
+        if offset + len(data) > end:
+            raise RBDError("write", -22)
+        self._apply_write_ctx()
+        pos = 0
+        has_parent = self.parent() is not None
+        for objno, off, ln in self._extents(offset, len(data)):
+            piece = data[pos:pos + ln]
+            pos += ln
+            oid = self._obj(objno)
+            if has_parent and self._needs_copyup(objno):
+                op = self._copyup_op(objno).write(piece, off)
+                r, _ = self.client.operate(self.data_pool, oid, op)
+            else:
+                r = self.client.write(self.data_pool, oid, piece, off)
+            if r < 0:
+                raise RBDError("write", r)
+        return len(data)
+
+    def _needs_copyup(self, objno: int) -> bool:
+        p = self.parent()
+        if p is None or objno * self.object_size >= p[3]:
+            return False
+        try:
+            self.client.stat(self.data_pool, self._obj(objno))
+            return False
+        except IOError as e:
+            if _absent(e):
+                return True
+            raise
+
+    def _copyup_data(self, objno: int) -> bytes:
+        """The parent's bytes for this child object, clipped to the
+        overlap (CopyupRequest)."""
+        ln = min(self.object_size,
+                 self.parent()[3] - objno * self.object_size)
+        return self._parent_read(objno, 0, ln).rstrip(b"\x00")
+
+    def _copyup_op(self, objno: int) -> ObjectOperation:
+        """Vector prefix materializing the parent bytes in the child
+        object, to be extended with the triggering mutation so both
+        commit atomically (CopyupRequest + chained write)."""
+        cdata = self._copyup_data(objno)
+        op = ObjectOperation()
+        if cdata:
+            op.write(cdata, 0)
+        else:
+            op.create(exclusive=False)
+        return op
+
+    def discard(self, offset: int, length: int) -> None:
+        """Punch a hole (rbd_discard): whole objects are removed, edges
+        are zeroed.  Inside a clone's parent overlap a hole must STAY a
+        hole — removing the child object (or zeroing an absent one)
+        would re-expose parent bytes on the next read, so there the
+        discard materializes an explicit zero state instead (librbd
+        turns such discards into truncate/zero whiteouts)."""
+        if self.read_snap is not None:
+            raise RBDError("discard", -30)
+        self._apply_write_ctx()
+        p = self.parent()
+        overlap = p[3] if p else 0
+        for objno, off, ln in self._extents(offset, length):
+            oid = self._obj(objno)
+            in_overlap = objno * self.object_size < overlap
+            if off == 0 and ln == self.object_size:
+                if in_overlap:
+                    op = ObjectOperation().create(exclusive=False)
+                    r, _ = self.client.operate(self.data_pool, oid,
+                                               op.truncate(0))
+                else:
+                    r = self.client.remove(self.data_pool, oid)
+            elif in_overlap and self._needs_copyup(objno):
+                op = self._copyup_op(objno).zero(off, ln)
+                r, _ = self.client.operate(self.data_pool, oid, op)
+            else:
+                r = self.client.zero(self.data_pool, oid, off, ln)
+            if r < 0 and r != -2:
+                raise RBDError("discard", r)
+
+    def resize(self, new_size: int) -> None:
+        """Grow adjusts metadata only (sparse); shrink removes/truncates
+        objects beyond the new end (Operations::resize)."""
+        old = self.size()
+        if self.read_snap is not None:
+            raise RBDError("resize", -30)
+        if new_size < old:
+            self._apply_write_ctx()
+            keep_objs = self._objects_in(new_size)
+            for objno in range(keep_objs, self._objects_in(old)):
+                r = self.client.remove(self.data_pool, self._obj(objno))
+                if r < 0 and r != -2:
+                    raise RBDError("resize", r)
+            tail = new_size - (keep_objs - 1) * self.object_size
+            if keep_objs and tail < self.object_size:
+                r = self.client.truncate(self.data_pool,
+                                         self._obj(keep_objs - 1), tail)
+                if r < 0 and r != -2:
+                    raise RBDError("resize", r)
+            if self.parent() is not None:
+                self._call("set_parent_overlap", {"overlap": new_size},
+                           parse=False)
+                self._parent_link = self._fetch_parent()
+        self._call("set_size", {"size": new_size}, parse=False)
+
+    # ---- snapshots --------------------------------------------------------
+    def snap_create(self, name: str) -> int:
+        sid = self.client.selfmanaged_snap_create(self.data_pool)
+        self._call("snapshot_add",
+                   {"snapid": sid, "name": name, "size": self.size()},
+                   parse=False)
+        return sid
+
+    def snap_remove(self, name: str) -> None:
+        sid, info = self._snap_by_name(name)
+        self._call("snapshot_remove", {"snapid": sid}, parse=False)
+        self.client.selfmanaged_snap_remove(self.data_pool, sid)
+
+    def snap_list(self) -> Dict[str, Dict]:
+        return {info["name"]: dict(info, id=sid)
+                for sid, info in self._snapcontext()[1].items()}
+
+    def snap_protect(self, name: str) -> None:
+        sid, _ = self._snap_by_name(name)
+        self._call("snapshot_protect", {"snapid": sid}, parse=False)
+
+    def snap_unprotect(self, name: str) -> None:
+        sid, _ = self._snap_by_name(name)
+        kids = json.loads(self.client.exec(
+            self.pool, RBD_CHILDREN, "rbd", "get_children",
+            _j({"pool": self.pool, "image_id": self.id,
+                "snapid": sid}))[1] or b"[]")
+        if kids:
+            raise RBDError("snap unprotect", -16)     # EBUSY
+        self._call("snapshot_unprotect", {"snapid": sid}, parse=False)
+
+    def snap_rollback(self, name: str) -> None:
+        """Restore the head to the snapshot's content (Operations::
+        snap_rollback): resize to the snap size, then per-object restore
+        reads at the snap and rewrites the head under the current ctx."""
+        sid, info = self._snap_by_name(name)
+        self.resize(info["size"])
+        self._apply_write_ctx()
+        for objno in range(self._objects_in(info["size"])):
+            oid = self._obj(objno)
+            try:
+                snap_data = self.client.read(self.data_pool, oid,
+                                             snap=sid)
+                at_snap = True
+            except IOError as e:
+                if not _absent(e):
+                    raise
+                at_snap = False
+            if at_snap:
+                r = self.client.write_full(self.data_pool, oid,
+                                           snap_data)
+                if r < 0:
+                    raise RBDError("snap rollback", r)
+            else:
+                r = self.client.remove(self.data_pool, oid)
+                if r < 0 and r != -2:
+                    raise RBDError("snap rollback", r)
+
+    # ---- clone management -------------------------------------------------
+    def flatten(self) -> None:
+        """Copy every parent-backed object into the child, then sever
+        the parent link (Operations::flatten)."""
+        p = self.parent()
+        if p is None:
+            raise RBDError("flatten", -22)
+        ppool, pid, psnap, overlap = p
+        self._apply_write_ctx()
+        for objno in range(self._objects_in(min(overlap, self.size()))):
+            if self._needs_copyup(objno):
+                data = self._copyup_data(objno)
+                if data:
+                    r = self.client.write_full(
+                        self.data_pool, self._obj(objno), data)
+                    if r < 0:
+                        raise RBDError("flatten", r)
+        self._call("remove_parent", parse=False)
+        self._parent_link = None
+        self._parent_handle = None
+        ret, _ = self.client.exec(
+            ppool, RBD_CHILDREN, "rbd", "remove_child",
+            _j({"pool": ppool, "image_id": pid, "snapid": psnap,
+                "child_id": self.id}))
+        if ret < 0 and ret != -2:
+            raise RBDError("flatten", ret)
+
+    def stat(self) -> Dict:
+        meta = self._call("get_image")
+        return {"size": self.size(), "order": meta["order"],
+                "data_pool": self.data_pool,
+                "object_prefix": meta["object_prefix"],
+                "num_objs": self._objects_in(meta["size"]),
+                "parent": self.parent(),
+                "snaps": sorted(self.snap_list())}

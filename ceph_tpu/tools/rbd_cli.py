@@ -1,0 +1,108 @@
+"""rbd CLI (src/tools/rbd in the reference): image admin over a
+MiniCluster checkpoint or live in-process cluster.
+
+Subcommands mirror the reference verbs used in its qa suites
+(qa/workunits/rbd/): create/ls/info/resize/rm/snap/clone/flatten plus
+import/export for moving data in and out of images.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..rbd import RBD, Image
+
+
+def run(cluster, client, argv) -> int:
+    """Drive rbd verbs against an existing cluster+client (the testable
+    entry; ``main`` wraps it with checkpoint loading)."""
+    ap = argparse.ArgumentParser(prog="rbd")
+    ap.add_argument("-p", "--pool", default="rbd")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("create")
+    s.add_argument("image")
+    s.add_argument("--size", type=int, required=True)
+    s.add_argument("--order", type=int, default=22)
+    s.add_argument("--data-pool", default=None)
+    sub.add_parser("ls")
+    s = sub.add_parser("info")
+    s.add_argument("image")
+    s = sub.add_parser("resize")
+    s.add_argument("image")
+    s.add_argument("--size", type=int, required=True)
+    s = sub.add_parser("rm")
+    s.add_argument("image")
+    s = sub.add_parser("snap")
+    s.add_argument("verb", choices=["create", "rm", "ls", "protect",
+                                    "unprotect", "rollback"])
+    s.add_argument("spec", help="image[@snap]")
+    s = sub.add_parser("clone")
+    s.add_argument("parent_spec", help="image@snap")
+    s.add_argument("child")
+    s = sub.add_parser("flatten")
+    s.add_argument("image")
+    s = sub.add_parser("export")
+    s.add_argument("image")
+    s.add_argument("path")
+    s = sub.add_parser("import")
+    s.add_argument("path")
+    s.add_argument("image")
+    s.add_argument("--order", type=int, default=22)
+    args = ap.parse_args(argv)
+
+    rbd = RBD(client)
+    pool = args.pool
+    if args.cmd == "create":
+        rbd.create(pool, args.image, args.size, args.order,
+                   data_pool=args.data_pool)
+    elif args.cmd == "ls":
+        print("\n".join(rbd.list(pool)))
+    elif args.cmd == "info":
+        print(json.dumps(Image(client, pool, args.image).stat(),
+                         indent=2, sort_keys=True))
+    elif args.cmd == "resize":
+        Image(client, pool, args.image).resize(args.size)
+    elif args.cmd == "rm":
+        rbd.remove(pool, args.image)
+    elif args.cmd == "snap":
+        if args.verb == "ls":
+            img = Image(client, pool, args.spec)
+            print(json.dumps(img.snap_list(), indent=2, sort_keys=True))
+        else:
+            name, snap = args.spec.split("@", 1)
+            img = Image(client, pool, name)
+            getattr(img, {"create": "snap_create", "rm": "snap_remove",
+                          "protect": "snap_protect",
+                          "unprotect": "snap_unprotect",
+                          "rollback": "snap_rollback"}[args.verb])(snap)
+    elif args.cmd == "clone":
+        pname, snap = args.parent_spec.split("@", 1)
+        rbd.clone(pool, pname, snap, pool, args.child)
+    elif args.cmd == "flatten":
+        Image(client, pool, args.image).flatten()
+    elif args.cmd == "export":
+        img = Image(client, pool, args.image)
+        with open(args.path, "wb") as f:
+            f.write(img.read(0, img.size()))
+    elif args.cmd == "import":
+        with open(args.path, "rb") as f:
+            data = f.read()
+        rbd.create(pool, args.image, len(data), args.order)
+        Image(client, pool, args.image).write(0, data)
+    return 0
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin shell wrapper
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ap = argparse.ArgumentParser(prog="rbd", add_help=False)
+    ap.add_argument("--checkpoint", required=True)
+    ns, rest = ap.parse_known_args(argv)
+    from ..cluster import MiniCluster
+    c = MiniCluster.restore(ns.checkpoint)
+    return run(c, c.client("client.rbd-cli"), rest)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

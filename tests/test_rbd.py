@@ -1,0 +1,245 @@
+"""librbd-lite: image I/O, snapshots, clones, flatten, CLI.
+
+Mirrors the reference's librbd unit surface (src/test/librbd) at lite
+scale: striping correctness incl. sparse reads, snapshot read/rollback
+via selfmanaged snapcs, COW clone copyup + parent fall-through, flatten
+severing the parent link, and directory/children index consistency via
+the server-side cls_rbd methods.
+"""
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.rbd import Image, RBD, RBDError
+
+ORDER = 12                      # 4 KiB objects keep the tests tiny
+OBJ = 1 << ORDER
+
+
+@pytest.fixture()
+def env():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("rbd", size=3, pg_num=8)
+    cl = c.client("client.rbd")
+    return c, cl, RBD(cl)
+
+
+def test_create_list_rename_remove(env):
+    c, cl, rbd = env
+    rbd.create("rbd", "a", 10 * OBJ, ORDER)
+    rbd.create("rbd", "b", 4 * OBJ, ORDER)
+    assert rbd.list("rbd") == ["a", "b"]
+    with pytest.raises(RBDError):
+        rbd.create("rbd", "a", OBJ, ORDER)      # name collision
+    rbd.rename("rbd", "b", "c")
+    assert rbd.list("rbd") == ["a", "c"]
+    rbd.remove("rbd", "c")
+    assert rbd.list("rbd") == ["a"]
+
+
+def test_io_striping_and_sparse(env):
+    c, cl, rbd = env
+    rbd.create("rbd", "img", 8 * OBJ, ORDER)
+    img = Image(cl, "rbd", "img")
+    # a write spanning three objects
+    payload = bytes(range(256)) * ((2 * OBJ + 512) // 256)
+    img.write(OBJ // 2, payload)
+    assert img.read(OBJ // 2, len(payload)) == payload
+    # sparse regions read as zeros, including whole absent objects
+    assert img.read(6 * OBJ, 100) == b"\x00" * 100
+    assert img.read(0, 16) == b"\x00" * 16
+    # reads clip at the image end
+    assert len(img.read(8 * OBJ - 10, 1000)) == 10
+    with pytest.raises(RBDError):
+        img.write(8 * OBJ - 1, b"xx")           # past the end
+
+
+def test_discard_and_resize(env):
+    c, cl, rbd = env
+    rbd.create("rbd", "img", 4 * OBJ, ORDER)
+    img = Image(cl, "rbd", "img")
+    img.write(0, b"A" * (4 * OBJ))
+    img.discard(OBJ, OBJ)                       # whole object
+    img.discard(10, 20)                         # sub-object hole
+    assert img.read(OBJ, OBJ) == b"\x00" * OBJ
+    assert img.read(10, 20) == b"\x00" * 20
+    assert img.read(30, 10) == b"A" * 10
+    img.resize(2 * OBJ + 100)
+    assert img.size() == 2 * OBJ + 100
+    assert img.read(2 * OBJ, 200) == b"A" * 100
+    img.resize(4 * OBJ)                         # grow back: sparse zeros
+    assert img.read(2 * OBJ + 100, 100) == b"\x00" * 100
+    assert img.read(3 * OBJ, OBJ) == b"\x00" * OBJ
+
+
+def test_snapshots_read_and_rollback(env):
+    c, cl, rbd = env
+    rbd.create("rbd", "img", 4 * OBJ, ORDER)
+    img = Image(cl, "rbd", "img")
+    img.write(0, b"one" * 100)
+    img.snap_create("s1")
+    img.write(0, b"two" * 200)
+    img.write(2 * OBJ, b"later-object")
+    assert Image(cl, "rbd", "img", snapshot="s1").read(0, 300) == \
+        b"one" * 100
+    # object created after the snap reads as zeros at the snap
+    assert Image(cl, "rbd", "img", snapshot="s1").read(
+        2 * OBJ, 12) == b"\x00" * 12
+    assert img.read(0, 600) == b"two" * 200
+    img.snap_rollback("s1")
+    assert img.read(0, 300) == b"one" * 100
+    assert img.read(300, 300) == b"\x00" * 300  # post-snap bytes gone
+    assert img.read(2 * OBJ, 12) == b"\x00" * 12
+    # snapshots pin removal until deleted
+    with pytest.raises(RBDError):
+        rbd.remove("rbd", "img")
+    img.snap_remove("s1")
+    rbd.remove("rbd", "img")
+    assert rbd.list("rbd") == []
+
+
+def test_snapshot_size_view(env):
+    c, cl, rbd = env
+    rbd.create("rbd", "img", 4 * OBJ, ORDER)
+    img = Image(cl, "rbd", "img")
+    img.write(0, b"x" * OBJ)
+    img.snap_create("small")
+    img.resize(8 * OBJ)
+    img.write(5 * OBJ, b"grown")
+    snap = Image(cl, "rbd", "img", snapshot="small")
+    assert snap.size() == 4 * OBJ
+    assert snap.read(0, OBJ) == b"x" * OBJ
+    assert snap.read(5 * OBJ, 5) == b""         # beyond snap size
+    with pytest.raises(RBDError):
+        snap.write(0, b"nope")                  # read-only view
+
+
+def test_clone_copyup_flatten(env):
+    c, cl, rbd = env
+    rbd.create("rbd", "parent", 4 * OBJ, ORDER)
+    parent = Image(cl, "rbd", "parent")
+    parent.write(0, b"P" * OBJ)
+    parent.write(2 * OBJ, b"Q" * 100)
+    parent.snap_create("base")
+    with pytest.raises(RBDError):
+        rbd.clone("rbd", "parent", "base", "rbd", "child")  # unprotected
+    parent.snap_protect("base")
+    rbd.clone("rbd", "parent", "base", "rbd", "child")
+    child = Image(cl, "rbd", "child")
+    # reads fall through to the parent snap
+    assert child.read(0, OBJ) == b"P" * OBJ
+    assert child.read(2 * OBJ, 100) == b"Q" * 100
+    # parent head changes must NOT leak into the child
+    parent.write(0, b"Z" * OBJ)
+    assert child.read(0, OBJ) == b"P" * OBJ
+    # copyup: a partial child write preserves surrounding parent bytes
+    child.write(10, b"child-bytes")
+    assert child.read(0, 10) == b"P" * 10
+    assert child.read(10, 11) == b"child-bytes"
+    assert child.read(21, OBJ - 21) == b"P" * (OBJ - 21)
+    # snap protection is pinned by the child
+    with pytest.raises(RBDError):
+        parent.snap_unprotect("base")
+    child.flatten()
+    assert child.parent() is None
+    assert child.read(2 * OBJ, 100) == b"Q" * 100
+    parent.snap_unprotect("base")
+    parent.snap_remove("base")
+    # the flattened child stands alone even after the parent dies
+    rbd.remove("rbd", "parent")
+    assert child.read(0, 10) == b"P" * 10
+    assert child.read(10, 11) == b"child-bytes"
+
+
+def test_clone_discard_stays_hole(env):
+    """A discard inside the parent overlap must not re-expose parent
+    bytes (librbd whiteout semantics for clone discards)."""
+    c, cl, rbd = env
+    rbd.create("rbd", "parent", 4 * OBJ, ORDER)
+    parent = Image(cl, "rbd", "parent")
+    parent.write(0, b"P" * (2 * OBJ))
+    parent.snap_create("base")
+    parent.snap_protect("base")
+    rbd.clone("rbd", "parent", "base", "rbd", "child")
+    child = Image(cl, "rbd", "child")
+    # whole-object discard on an untouched (parent-backed) object
+    child.discard(0, OBJ)
+    assert child.read(0, OBJ) == b"\x00" * OBJ
+    # sub-object discard on an absent child object: copyup + zero
+    child.discard(OBJ + 100, 50)
+    assert child.read(OBJ + 100, 50) == b"\x00" * 50
+    assert child.read(OBJ, 100) == b"P" * 100          # rest preserved
+    assert child.read(OBJ + 150, 100) == b"P" * 100
+    # discard after copyup behaves the same
+    child.write(10, b"x")
+    child.discard(0, OBJ)
+    assert child.read(0, OBJ) == b"\x00" * OBJ
+    # beyond the overlap whole-object discard still removes outright
+    child.write(3 * OBJ, b"tail")
+    child.discard(3 * OBJ, OBJ)
+    assert child.read(3 * OBJ, 4) == b"\x00" * 4
+
+
+def test_snapc_rejected_on_pool_snap_pool(env):
+    """A client snapc on a pool-snapshot pool is refused (EINVAL) both
+    client-side and by the OSD."""
+    import pytest as _pytest
+    c, cl, rbd = env
+    cl.write_full("rbd", "o", b"v1")
+    cl.snap_create("rbd", "ps1")
+    with _pytest.raises(ValueError):
+        cl.set_write_ctx("rbd", 1, [1])
+    # force it past the client guard: the OSD still rejects
+    cl._write_snapc[cl.lookup_pool("rbd")] = (1, [])
+    assert cl.write_full("rbd", "o", b"v2") == -22
+    cl._write_snapc.clear()
+    assert cl.read("rbd", "o", snap="ps1") == b"v1"
+
+
+def test_ec_data_pool(env):
+    """Image data on an EC pool, metadata in the replicated pool — the
+    librbd data-pool feature (EC pools cannot hold omap, so headers
+    must stay in an omap-capable pool, here as in the reference)."""
+    c, cl, rbd = env
+    c.create_ec_pool("ecdata", k=2, m=1, plugin="isa", pg_num=8)
+    rbd.create("rbd", "vm", 8 * OBJ, ORDER, data_pool="ecdata")
+    img = Image(cl, "rbd", "vm")
+    assert img.data_pool == "ecdata"
+    img.write(0, b"ec-backed-bytes" * 100)
+    assert img.read(0, 15) == b"ec-backed-bytes"
+    # the data objects really are in the EC pool
+    assert cl.read("ecdata", img._obj(0), length=15) == b"ec-backed-bytes"
+    with pytest.raises(IOError):
+        cl.read("rbd", img._obj(0))
+    # snapshots allocate ids on the DATA pool and clone there
+    img.snap_create("s1")
+    img.write(0, b"overwritten-now")
+    assert Image(cl, "rbd", "vm", snapshot="s1").read(0, 15) == \
+        b"ec-backed-bytes"
+    img.snap_remove("s1")
+    rbd.remove("rbd", "vm")
+    assert rbd.list("rbd") == []
+    # cls omap methods on the EC pool itself fail loudly (EOPNOTSUPP)
+    ret, _ = cl.exec("ecdata", "rbd_directory", "rbd", "dir_add_image",
+                     b'{"name": "x", "id": "y"}')
+    assert ret == -95
+
+
+def test_rbd_cli(env, tmp_path, capsys):
+    c, cl, rbd = env
+    from ceph_tpu.tools import rbd_cli
+    run = lambda *a: rbd_cli.run(c, cl, ["-p", "rbd", *a])
+    run("create", "disk", "--size", str(4 * OBJ), "--order", str(ORDER))
+    img = Image(cl, "rbd", "disk")
+    img.write(0, b"cli-payload")
+    run("snap", "create", "disk@s1")
+    img.write(0, b"overwritten")
+    run("snap", "rollback", "disk@s1")
+    assert img.read(0, 11) == b"cli-payload"
+    run("export", "disk", str(tmp_path / "out.bin"))
+    data = (tmp_path / "out.bin").read_bytes()
+    assert data[:11] == b"cli-payload" and len(data) == 4 * OBJ
+    run("import", str(tmp_path / "out.bin"), "disk2")
+    assert Image(cl, "rbd", "disk2").read(0, 11) == b"cli-payload"
+    run("ls")
+    out = capsys.readouterr().out
+    assert "disk" in out and "disk2" in out
