@@ -1,0 +1,308 @@
+"""cephfs-lite client: POSIX-shaped file operations over two pools.
+
+The reference cephfs (src/client, 24k LoC + src/mds, 77k) resolves
+paths dentry-by-dentry against MDS caches and stripes file data into a
+data pool via the file layout (osdc/Striper).  This client keeps that
+exact storage shape — metadata-pool dir objects with dentry omaps
+(cls_fs), ``%llx.%08llx`` data objects — and performs each metadata
+mutation as one atomic server-side class method, so concurrency is
+serialized by the directory object's PG instead of MDS locks.
+
+Scope-outs vs the reference (see cls_fs for the rationale): client
+capabilities/leases and delegations, the MDS journal + standby-replay,
+multi-MDS subtree partitioning, hard links (remote dentries), and
+cephfs snapshots.  Cross-directory rename is dst-link-then-src-unlink —
+two PG-atomic steps, briefly observable as a double link, never a loss
+(the reference orders the same two events through its journal).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..client.rados import RadosClient
+from .cls_fs import ROOT_INO, INOTABLE_OID, dir_oid, file_oid
+
+
+class FsError(IOError):
+    def __init__(self, api: str, result: int):
+        super().__init__(f"cephfs {api}: error {result}")
+        self.result = result
+
+
+def _j(obj) -> bytes:
+    return json.dumps(obj, sort_keys=True).encode()
+
+
+def _absent(e: IOError) -> bool:
+    return getattr(e, "errno", None) == 2
+
+
+DEFAULT_ORDER = 22                # 4 MiB objects (file_layout_t default)
+
+
+class CephFS:
+    """A mounted filesystem (libcephfs ceph_mount shape)."""
+
+    def __init__(self, client: RadosClient, metadata_pool: str,
+                 data_pool: str):
+        self.client = client
+        self.mdpool = metadata_pool
+        self.dpool = data_pool
+
+    # ---- cls plumbing -----------------------------------------------------
+    def _call(self, oid: str, method: str, payload=None) -> bytes:
+        ret, out = self.client.exec(self.mdpool, oid, "fs", method,
+                                    _j(payload or {}))
+        if ret < 0:
+            raise FsError(method, ret)
+        return out
+
+    # ---- lifecycle --------------------------------------------------------
+    def mkfs(self) -> None:
+        """Initialize inotable + root directory object (ceph fs new)."""
+        self._call(INOTABLE_OID, "mkfs")
+        # the root dir object springs into existence on first dentry;
+        # create it eagerly so readdir("/") works on an empty fs
+        self.client.create(self.mdpool, dir_oid(ROOT_INO),
+                           exclusive=False)
+
+    def _alloc_ino(self) -> int:
+        return json.loads(self._call(INOTABLE_OID, "alloc_ino"))["ino"]
+
+    # ---- path resolution --------------------------------------------------
+    @staticmethod
+    def _split(path: str) -> List[str]:
+        parts = [p for p in path.split("/") if p]
+        if any(p in (".", "..") for p in parts):
+            raise FsError("path", -22)
+        return parts
+
+    def _resolve(self, path: str) -> Dict:
+        """Path -> inode dict; root is synthetic (the reference pins the
+        root CInode in the MDS cache the same way)."""
+        inode = {"ino": ROOT_INO, "type": "dir", "size": 0, "mtime": 0}
+        for name in self._split(path):
+            if inode["type"] != "dir":
+                raise FsError("resolve", -20)         # ENOTDIR
+            inode = self._lookup(inode["ino"], name)
+        return inode
+
+    def _resolve_parent(self, path: str) -> Tuple[int, str]:
+        parts = self._split(path)
+        if not parts:
+            raise FsError("resolve", -22)
+        parent = "/".join(parts[:-1])
+        return self._resolve(parent)["ino"], parts[-1]
+
+    def _lookup(self, dir_ino: int, name: str) -> Dict:
+        return json.loads(self._call(dir_oid(dir_ino), "lookup",
+                                     {"name": name}))
+
+    # ---- directories ------------------------------------------------------
+    def mkdir(self, path: str) -> int:
+        dino, name = self._resolve_parent(path)
+        ino = self._alloc_ino()
+        self._call(dir_oid(dino), "link", {"name": name, "inode": {
+            "ino": ino, "type": "dir", "size": 0,
+            "mtime": time.time()}})
+        self.client.create(self.mdpool, dir_oid(ino), exclusive=False)
+        return ino
+
+    def listdir(self, path: str) -> Dict[str, Dict]:
+        inode = self._resolve(path)
+        if inode["type"] != "dir":
+            raise FsError("listdir", -20)
+        return json.loads(self._call(dir_oid(inode["ino"]), "readdir"))
+
+    def rmdir(self, path: str) -> None:
+        dino, name = self._resolve_parent(path)
+        target = self._lookup(dino, name)
+        if target["type"] != "dir":
+            raise FsError("rmdir", -20)
+        # seal the child atomically (empty-check + refuse-new-links in
+        # one PG-serialized call) BEFORE touching the parent dentry, so
+        # a racing create either beats the seal (rmdir fails ENOTEMPTY)
+        # or loses to it (create fails ENOENT) — never gets orphaned
+        self._call(dir_oid(target["ino"]), "dir_mark_dead")
+        self._call(dir_oid(dino), "unlink", {"name": name})
+        self.client.remove(self.mdpool, dir_oid(target["ino"]))
+
+    # ---- files ------------------------------------------------------------
+    def create(self, path: str, order: int = DEFAULT_ORDER) -> int:
+        dino, name = self._resolve_parent(path)
+        ino = self._alloc_ino()
+        self._call(dir_oid(dino), "link", {"name": name, "inode": {
+            "ino": ino, "type": "file", "size": 0, "order": order,
+            "mtime": time.time()}})
+        return ino
+
+    def symlink(self, path: str, target: str) -> int:
+        dino, name = self._resolve_parent(path)
+        ino = self._alloc_ino()
+        self._call(dir_oid(dino), "link", {"name": name, "inode": {
+            "ino": ino, "type": "symlink", "size": len(target),
+            "target": target, "mtime": time.time()}})
+        return ino
+
+    def readlink(self, path: str) -> str:
+        inode = self._resolve(path)
+        if inode["type"] != "symlink":
+            raise FsError("readlink", -22)
+        return inode["target"]
+
+    def stat(self, path: str) -> Dict:
+        return self._resolve(path)
+
+    def _file_inode(self, path: str,
+                    depth: int = 0) -> Tuple[int, str, Dict]:
+        if depth > 10:
+            raise FsError("open", -40)                # ELOOP
+        dino, name = self._resolve_parent(path)
+        inode = self._lookup(dino, name)
+        if inode["type"] == "symlink":
+            target = inode["target"]
+            if not target.startswith("/"):
+                # relative targets resolve against the link's parent
+                # directory, like symlink(2)
+                parent = "/".join(self._split(path)[:-1])
+                target = (f"/{parent}/{target}" if parent
+                          else f"/{target}")
+            return self._file_inode(target, depth + 1)
+        if inode["type"] != "file":
+            raise FsError("open", -21)                # EISDIR
+        return dino, name, inode
+
+    def _update(self, dino: int, name: str, **attrs) -> Dict:
+        return json.loads(self._call(dir_oid(dino), "update_inode",
+                                     {"name": name, "attrs": attrs}))
+
+    def write(self, path: str, data: bytes, offset: int = 0) -> int:
+        dino, name, inode = self._file_inode(path)
+        osize = 1 << inode.get("order", DEFAULT_ORDER)
+        pos = 0
+        while pos < len(data):
+            objno, ooff = divmod(offset + pos, osize)
+            take = min(len(data) - pos, osize - ooff)
+            r = self.client.write(self.dpool,
+                                  file_oid(inode["ino"], objno),
+                                  data[pos:pos + take], ooff)
+            if r < 0:
+                raise FsError("write", r)
+            pos += take
+        # the size maxes server-side (cls update_inode max_attrs), so
+        # two concurrent writers can never shrink each other's growth
+        self._call(dir_oid(dino), "update_inode",
+                   {"name": name, "attrs": {"mtime": time.time()},
+                    "max_attrs": {"size": offset + len(data)}})
+        return len(data)
+
+    def read(self, path: str, offset: int = 0,
+             length: Optional[int] = None) -> bytes:
+        _, _, inode = self._file_inode(path)
+        size = inode["size"]
+        if offset >= size:
+            return b""
+        length = size - offset if length is None else \
+            min(length, size - offset)
+        osize = 1 << inode.get("order", DEFAULT_ORDER)
+        chunks = []
+        remaining, pos = length, offset
+        while remaining > 0:
+            objno, ooff = divmod(pos, osize)
+            take = min(remaining, osize - ooff)
+            try:
+                data = self.client.read(self.dpool,
+                                        file_oid(inode["ino"], objno),
+                                        offset=ooff, length=take)
+            except IOError as e:
+                if not _absent(e):
+                    raise
+                data = b""
+            chunks.append(data.ljust(take, b"\x00"))   # sparse holes
+            pos += take
+            remaining -= take
+        return b"".join(chunks)
+
+    def truncate(self, path: str, size: int) -> None:
+        dino, name, inode = self._file_inode(path)
+        osize = 1 << inode.get("order", DEFAULT_ORDER)
+        old = inode["size"]
+        if size < old:
+            keep = (size + osize - 1) // osize
+            for objno in range(keep, (old + osize - 1) // osize):
+                self.client.remove(self.dpool,
+                                   file_oid(inode["ino"], objno))
+            tail = size - (keep - 1) * osize
+            if keep and tail < osize:
+                self.client.truncate(self.dpool,
+                                     file_oid(inode["ino"], keep - 1),
+                                     tail)
+        self._update(dino, name, size=size, mtime=time.time())
+
+    def unlink(self, path: str) -> None:
+        dino, name = self._resolve_parent(path)
+        gone = json.loads(self._call(dir_oid(dino), "unlink",
+                                     {"name": name, "deny_dir": True}))
+        self._purge_file(gone)
+
+    def _purge_file(self, inode: Dict) -> None:
+        """Delete the data objects of an unlinked file (the reference
+        delegates this to the MDS PurgeQueue)."""
+        if not inode or inode.get("type") != "file":
+            return
+        osize = 1 << inode.get("order", DEFAULT_ORDER)
+        for objno in range((inode["size"] + osize - 1) // osize):
+            self.client.remove(self.dpool,
+                               file_oid(inode["ino"], objno))
+
+    def rename(self, src: str, dst: str) -> None:
+        """rename(2): atomic within one directory (single cls call);
+        across directories it is dst-link + src-unlink — two atomic
+        steps with a transient double-link window, never a loss."""
+        sdino, sname = self._resolve_parent(src)
+        ddino, dname = self._resolve_parent(dst)
+        if sdino == ddino:
+            displaced = json.loads(self._call(
+                dir_oid(sdino), "rename_local",
+                {"src": sname, "dst": dname, "replace": True}))
+            self._purge_file(displaced)
+            return
+        inode = self._lookup(sdino, sname)
+        try:
+            self._call(dir_oid(ddino), "link",
+                       {"name": dname, "inode": inode})
+        except FsError as e:
+            if e.result != -17:
+                raise
+            # deny_dir makes replacing a directory fail EISDIR at the
+            # dentry itself — a subtree can never be silently destroyed
+            displaced = json.loads(self._call(
+                dir_oid(ddino), "unlink",
+                {"name": dname, "deny_dir": True}))
+            self._purge_file(displaced)
+            self._call(dir_oid(ddino), "link",
+                       {"name": dname, "inode": inode})
+        self._call(dir_oid(sdino), "unlink", {"name": sname})
+
+    def exists(self, path: str) -> bool:
+        try:
+            self._resolve(path)
+            return True
+        except FsError as e:
+            if e.result in (-2, -20):
+                return False
+            raise
+
+    # ---- recursive conveniences (libcephfs ceph_walk-ish helpers) ---------
+    def walk(self, path: str = "/"):
+        """Yield (dirpath, dirnames, filenames) like os.walk."""
+        entries = self.listdir(path)
+        dirs = sorted(n for n, i in entries.items() if i["type"] == "dir")
+        files = sorted(n for n, i in entries.items()
+                       if i["type"] != "dir")
+        yield path, dirs, files
+        for d in dirs:
+            sub = path.rstrip("/") + "/" + d
+            yield from self.walk(sub)

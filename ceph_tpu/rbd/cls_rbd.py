@@ -106,7 +106,7 @@ def _snapshot_add(ctx: ClsContext, inp: bytes):
         return -2, b""
     snapid, name = int(req["snapid"]), str(req["name"])
     if snapid <= int(om["snap_seq"]):
-        return -106, b""                              # ESTALE
+        return -116, b""                              # ESTALE
     for k, v in om.items():
         if k.startswith("snapshot_") and json.loads(v)["name"] == name:
             return -17, b""                           # EEXIST

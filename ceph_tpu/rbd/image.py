@@ -322,6 +322,9 @@ class Image:
             if has_parent and self._needs_copyup(objno):
                 op = self._copyup_op(objno).write(piece, off)
                 r, _ = self.client.operate(self.data_pool, oid, op)
+                if r == -17:    # lost the copyup race: object exists now
+                    r = self.client.write(self.data_pool, oid, piece,
+                                          off)
             else:
                 r = self.client.write(self.data_pool, oid, piece, off)
             if r < 0:
@@ -350,13 +353,16 @@ class Image:
     def _copyup_op(self, objno: int) -> ObjectOperation:
         """Vector prefix materializing the parent bytes in the child
         object, to be extended with the triggering mutation so both
-        commit atomically (CopyupRequest + chained write)."""
+        commit atomically.  The exclusive create guards the
+        stat-then-copyup window: if another client copied up (and
+        possibly wrote) since our stat, the vector aborts -EEXIST and
+        the caller retries as a plain mutation instead of smearing
+        parent bytes over committed data (the reference's guarded
+        CopyupRequest)."""
         cdata = self._copyup_data(objno)
-        op = ObjectOperation()
+        op = ObjectOperation().create(exclusive=True)
         if cdata:
             op.write(cdata, 0)
-        else:
-            op.create(exclusive=False)
         return op
 
     def discard(self, offset: int, length: int) -> None:
@@ -384,6 +390,8 @@ class Image:
             elif in_overlap and self._needs_copyup(objno):
                 op = self._copyup_op(objno).zero(off, ln)
                 r, _ = self.client.operate(self.data_pool, oid, op)
+                if r == -17:
+                    r = self.client.zero(self.data_pool, oid, off, ln)
             else:
                 r = self.client.zero(self.data_pool, oid, off, ln)
             if r < 0 and r != -2:

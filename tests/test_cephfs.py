@@ -1,0 +1,224 @@
+"""cephfs-lite: tree ops, file I/O, rename semantics, purge, EC data.
+
+Mirrors the reference's libcephfs/client test surface at lite scale
+(src/test/libcephfs): path resolution, mkdir/rmdir guards, striped
+sparse file I/O, truncate, unlink purging data objects, rename within
+and across directories, symlinks, and the reference-identical object
+naming so the layout is inspectable with rados tools.
+"""
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.cephfs import CephFS, FsError, ROOT_INO, dir_oid, file_oid
+
+ORDER = 12
+OBJ = 1 << ORDER
+
+
+@pytest.fixture()
+def fs():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("fsmeta", size=3, pg_num=8)
+    c.create_replicated_pool("fsdata", size=3, pg_num=8)
+    cl = c.client("client.fs")
+    f = CephFS(cl, "fsmeta", "fsdata")
+    f.mkfs()
+    return c, cl, f
+
+
+def test_tree_and_listing(fs):
+    c, cl, f = fs
+    f.mkdir("/a")
+    f.mkdir("/a/b")
+    f.create("/a/b/file", ORDER)
+    f.mkdir("/c")
+    assert sorted(f.listdir("/")) == ["a", "c"]
+    assert sorted(f.listdir("/a")) == ["b"]
+    assert f.stat("/a")["type"] == "dir"
+    assert f.stat("/a/b/file")["type"] == "file"
+    assert f.exists("/a/b/file") and not f.exists("/a/nope")
+    with pytest.raises(FsError):
+        f.mkdir("/a")                        # EEXIST via cls link
+    with pytest.raises(FsError):
+        f.listdir("/a/b/file")               # ENOTDIR
+    walked = list(f.walk("/"))
+    assert walked[0] == ("/", ["a", "c"], [])
+    assert ("/a/b", [], ["file"]) in walked
+
+
+def test_file_io_striping_sparse(fs):
+    c, cl, f = fs
+    f.create("/data", ORDER)
+    payload = bytes(range(256)) * ((2 * OBJ + 700) // 256)
+    f.write("/data", payload, offset=OBJ // 2)
+    assert f.stat("/data")["size"] == OBJ // 2 + len(payload)
+    assert f.read("/data", OBJ // 2, len(payload)) == payload
+    # the hole before the write reads as zeros
+    assert f.read("/data", 0, 100) == b"\x00" * 100
+    # reference-identical data object naming in the data pool
+    ino = f.stat("/data")["ino"]
+    assert cl.read("fsdata", file_oid(ino, 1))    # object 1 exists
+    # read past EOF clips
+    size = f.stat("/data")["size"]
+    assert f.read("/data", size - 5) == payload[-5:]
+    assert f.read("/data", size + 10) == b""
+
+
+def test_truncate_and_unlink_purge(fs):
+    c, cl, f = fs
+    f.create("/f", ORDER)
+    f.write("/f", b"Z" * (3 * OBJ))
+    f.truncate("/f", OBJ + 10)
+    assert f.stat("/f")["size"] == OBJ + 10
+    assert f.read("/f") == b"Z" * (OBJ + 10)
+    f.write("/f", b"Z" * (3 * OBJ))           # regrow
+    ino = f.stat("/f")["ino"]
+    f.unlink("/f")
+    assert not f.exists("/f")
+    # purge removed the data objects (PurgeQueue role)
+    for objno in range(3):
+        with pytest.raises(IOError):
+            cl.read("fsdata", file_oid(ino, objno))
+
+
+def test_rmdir_guards(fs):
+    c, cl, f = fs
+    f.mkdir("/d")
+    f.create("/d/x", ORDER)
+    with pytest.raises(FsError):
+        f.rmdir("/d")                        # ENOTEMPTY
+    f.unlink("/d/x")
+    f.rmdir("/d")
+    assert not f.exists("/d")
+    with pytest.raises(FsError):
+        f.rmdir("/nope")
+
+
+def test_rename_same_and_cross_dir(fs):
+    c, cl, f = fs
+    f.mkdir("/a")
+    f.mkdir("/b")
+    f.create("/a/src", ORDER)
+    f.write("/a/src", b"payload")
+    f.rename("/a/src", "/a/dst")             # same dir: one cls call
+    assert not f.exists("/a/src")
+    assert f.read("/a/dst") == b"payload"
+    f.rename("/a/dst", "/b/moved")           # cross dir
+    assert not f.exists("/a/dst")
+    assert f.read("/b/moved") == b"payload"
+    # rename over an existing file replaces it and purges the old data
+    f.create("/b/victim", ORDER)
+    f.write("/b/victim", b"to-be-replaced" * 400)
+    victim_ino = f.stat("/b/victim")["ino"]
+    f.rename("/b/moved", "/b/victim")
+    assert f.read("/b/victim") == b"payload"
+    with pytest.raises(IOError):
+        cl.read("fsdata", file_oid(victim_ino, 0))
+
+
+def test_unlink_and_rename_refuse_directories(fs):
+    """unlink(2)/rename(2) must never silently destroy a subtree: the
+    guards live server-side in the dentry's cls methods."""
+    c, cl, f = fs
+    f.mkdir("/d")
+    f.create("/d/child", ORDER)
+    with pytest.raises(FsError) as ei:
+        f.unlink("/d")
+    assert ei.value.result == -21                    # EISDIR
+    assert f.exists("/d/child")
+    f.create("/plain", ORDER)
+    with pytest.raises(FsError) as ei:
+        f.rename("/plain", "/d")                     # same-dir replace
+    assert ei.value.result == -21
+    f.mkdir("/other")
+    f.mkdir("/other/dir2")
+    with pytest.raises(FsError) as ei:
+        f.rename("/plain", "/other/dir2")            # cross-dir replace
+    assert ei.value.result == -21
+    assert f.exists("/d/child") and f.stat("/other/dir2")["type"] == "dir"
+
+
+def test_concurrent_size_growth_never_shrinks(fs):
+    """Two clients with stale size views: the server-side size max
+    keeps the larger committed size (no client RMW window)."""
+    c, cl, f = fs
+    cl2 = c.client("client.fs2")
+    f2 = CephFS(cl2, "fsmeta", "fsdata")
+    f.create("/grow", ORDER)
+    f.write("/grow", b"A" * 4096)        # size 4096
+    f2.write("/grow", b"B" * 100)        # stale writer, smaller extent
+    assert f.stat("/grow")["size"] == 4096
+    data = f.read("/grow")
+    assert data[:100] == b"B" * 100 and data[100:] == b"A" * 3996
+
+
+def test_relative_symlink(fs):
+    c, cl, f = fs
+    f.mkdir("/sd")
+    f.create("/sd/t", ORDER)
+    f.write("/sd/t", b"relative-ok")
+    f.symlink("/sd/l", "t")              # relative target
+    assert f.read("/sd/l") == b"relative-ok"
+    # symlink loops fail ELOOP instead of recursing forever
+    f.symlink("/loop1", "/loop2")
+    f.symlink("/loop2", "/loop1")
+    with pytest.raises(FsError) as ei:
+        f.read("/loop1")
+    assert ei.value.result == -40
+
+
+def test_symlink(fs):
+    c, cl, f = fs
+    f.mkdir("/real")
+    f.create("/real/target", ORDER)
+    f.write("/real/target", b"through-the-link")
+    f.symlink("/lnk", "/real/target")
+    assert f.readlink("/lnk") == "/real/target"
+    assert f.read("/lnk") == b"through-the-link"
+    f.write("/lnk", b"WRITTEN", offset=0)
+    assert f.read("/real/target")[:7] == b"WRITTEN"
+
+
+def test_concurrent_create_one_winner(fs):
+    """Two clients racing to create the same name: the dir object's PG
+    orders the cls link calls — exactly one wins (the MDS-lock role)."""
+    c, cl, f = fs
+    cl2 = c.client("client.fs2")
+    f2 = CephFS(cl2, "fsmeta", "fsdata")
+    f.create("/winner", ORDER)
+    with pytest.raises(FsError):
+        f2.create("/winner", ORDER)
+    # and the loser's error is EEXIST specifically
+    try:
+        f2.mkdir("/winner")
+    except FsError as e:
+        assert e.result == -17
+
+
+def test_checkpoint_restore(fs, tmp_path):
+    c, cl, f = fs
+    f.mkdir("/keep")
+    f.create("/keep/file", ORDER)
+    f.write("/keep/file", b"persistent-bytes")
+    c.checkpoint(str(tmp_path / "ckpt"))
+    c2 = MiniCluster.restore(str(tmp_path / "ckpt"))
+    f2 = CephFS(c2.client("client.r"), "fsmeta", "fsdata")
+    assert f2.read("/keep/file") == b"persistent-bytes"
+    assert sorted(f2.listdir("/")) == ["keep"]
+    # ino allocation continues past the restored watermark
+    f2.create("/keep/new", ORDER)
+    inos = {f2.stat(p)["ino"] for p in ("/keep/file", "/keep/new")}
+    assert len(inos) == 2
+
+
+def test_ec_data_pool(fs):
+    """File data on an EC pool, metadata replicated — the cephfs
+    add_data_pool layout (EC pools hold file data, never dir omaps)."""
+    c, cl, f = fs
+    c.create_ec_pool("fsec", k=2, m=1, plugin="isa", pg_num=8)
+    fec = CephFS(cl, "fsmeta", "fsec")
+    fec.create("/ecfile", ORDER)
+    fec.write("/ecfile", b"ec-file-data" * 50)
+    assert fec.read("/ecfile") == b"ec-file-data" * 50
+    ino = fec.stat("/ecfile")["ino"]
+    assert cl.read("fsec", file_oid(ino, 0), length=12) == b"ec-file-data"

@@ -179,6 +179,28 @@ def test_clone_discard_stays_hole(env):
     assert child.read(3 * OBJ, 4) == b"\x00" * 4
 
 
+def test_copyup_race_does_not_smear_parent_bytes(env):
+    """Two clients racing the first write to a clone object: the loser
+    of the copyup race must NOT re-write parent bytes over the winner's
+    committed data (exclusive-create guard on the copyup vector)."""
+    c, cl, rbd = env
+    rbd.create("rbd", "parent", 2 * OBJ, ORDER)
+    parent = Image(cl, "rbd", "parent")
+    parent.write(0, b"P" * OBJ)
+    parent.snap_create("base")
+    parent.snap_protect("base")
+    rbd.clone("rbd", "parent", "base", "rbd", "child")
+    a = Image(cl, "rbd", "child")
+    b = Image(c.client("client.rbd2"), "rbd", "child")
+    a.write(0, b"AAAA")                  # wins the copyup
+    # force b into the stale stat-then-copyup window
+    b._needs_copyup = lambda objno: True
+    b.write(100, b"BBBB")
+    assert a.read(0, 4) == b"AAAA"       # not smeared back to parent
+    assert a.read(100, 4) == b"BBBB"
+    assert a.read(4, 8) == b"P" * 8
+
+
 def test_snapc_rejected_on_pool_snap_pool(env):
     """A client snapc on a pool-snapshot pool is refused (EINVAL) both
     client-side and by the OSD."""
