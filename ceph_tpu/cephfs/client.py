@@ -11,7 +11,9 @@ serialized by the directory object's PG instead of MDS locks.
 Scope-outs vs the reference (see cls_fs for the rationale): client
 capabilities/leases and delegations, the MDS journal + standby-replay,
 multi-MDS subtree partitioning, hard links (remote dentries), and
-cephfs snapshots.  Cross-directory rename is dst-link-then-src-unlink —
+cephfs snapshots.  stat() is lstat-shaped (final-component symlinks
+are not followed); intermediate symlinks resolve like the kernel
+client's path walk.  Cross-directory rename is dst-link-then-src-unlink —
 two PG-atomic steps, briefly observable as a double link, never a loss
 (the reference orders the same two events through its journal).
 """
@@ -79,14 +81,31 @@ class CephFS:
             raise FsError("path", -22)
         return parts
 
-    def _resolve(self, path: str) -> Dict:
+    def _resolve(self, path: str, depth: int = 0,
+                 follow_final: bool = False) -> Dict:
         """Path -> inode dict; root is synthetic (the reference pins the
-        root CInode in the MDS cache the same way)."""
+        root CInode in the MDS cache the same way).  Symlinks in
+        intermediate components are always followed; the final
+        component follows only with ``follow_final`` (stat keeps
+        lstat-like semantics)."""
+        if depth > 10:
+            raise FsError("resolve", -40)             # ELOOP
+        parts = self._split(path)
         inode = {"ino": ROOT_INO, "type": "dir", "size": 0, "mtime": 0}
-        for name in self._split(path):
+        for i, name in enumerate(parts):
             if inode["type"] != "dir":
                 raise FsError("resolve", -20)         # ENOTDIR
             inode = self._lookup(inode["ino"], name)
+            last = i == len(parts) - 1
+            if inode["type"] == "symlink" and (not last or follow_final):
+                target = inode["target"]
+                if not target.startswith("/"):
+                    base = "/".join(parts[:i])
+                    target = (f"/{base}/{target}" if base
+                              else f"/{target}")
+                rest = "/".join(parts[i + 1:])
+                full = f"{target}/{rest}" if rest else target
+                return self._resolve(full, depth + 1, follow_final)
         return inode
 
     def _resolve_parent(self, path: str) -> Tuple[int, str]:
@@ -94,7 +113,8 @@ class CephFS:
         if not parts:
             raise FsError("resolve", -22)
         parent = "/".join(parts[:-1])
-        return self._resolve(parent)["ino"], parts[-1]
+        return (self._resolve(parent, follow_final=True)["ino"],
+                parts[-1])
 
     def _lookup(self, dir_ino: int, name: str) -> Dict:
         return json.loads(self._call(dir_oid(dir_ino), "lookup",
@@ -111,7 +131,7 @@ class CephFS:
         return ino
 
     def listdir(self, path: str) -> Dict[str, Dict]:
-        inode = self._resolve(path)
+        inode = self._resolve(path, follow_final=True)
         if inode["type"] != "dir":
             raise FsError("listdir", -20)
         return json.loads(self._call(dir_oid(inode["ino"]), "readdir"))
@@ -261,6 +281,14 @@ class CephFS:
         """rename(2): atomic within one directory (single cls call);
         across directories it is dst-link + src-unlink — two atomic
         steps with a transient double-link window, never a loss."""
+        sparts, dparts = self._split(src), self._split(dst)
+        if sparts == dparts:
+            self._resolve(src)               # still ENOENT if absent
+            return                           # rename(p, p): no-op
+        if dparts[:len(sparts)] == sparts:
+            # moving a directory into its own subtree would detach the
+            # whole subtree forever (POSIX: EINVAL)
+            raise FsError("rename", -22)
         sdino, sname = self._resolve_parent(src)
         ddino, dname = self._resolve_parent(dst)
         if sdino == ddino:

@@ -175,6 +175,8 @@ def _rename_local(ctx: ClsContext, inp: bytes):
     om = ctx.omap_get()
     if src not in om:
         return -2, b""
+    if src == dst:
+        return 0, b"null"     # rename(p, p) is a no-op, rename(2)
     if dst in om and not req.get("replace"):
         return -17, b""
     if dst in om and json.loads(om[dst]).get("type") == "dir":

@@ -491,12 +491,14 @@ class Image:
         self._apply_write_ctx()
         for objno in range(self._objects_in(min(overlap, self.size()))):
             if self._needs_copyup(objno):
-                data = self._copyup_data(objno)
-                if data:
-                    r = self.client.write_full(
-                        self.data_pool, self._obj(objno), data)
-                    if r < 0:
-                        raise RBDError("flatten", r)
+                # same exclusive-create guard as write(): losing the
+                # copyup race to a concurrent writer must skip, not
+                # smear parent bytes over committed data
+                r, _ = self.client.operate(
+                    self.data_pool, self._obj(objno),
+                    self._copyup_op(objno))
+                if r < 0 and r != -17:
+                    raise RBDError("flatten", r)
         self._call("remove_parent", parse=False)
         self._parent_link = None
         self._parent_handle = None

@@ -1,0 +1,12 @@
+"""rgw-lite: S3-shaped object gateway (src/rgw + src/cls/rgw at lite
+scale).
+
+Importing registers the ``rgw`` object class (two-phase bucket-index
+methods); ``gateway.RGWLite`` is the RGWRados-role core and
+``http.S3Frontend``/``http.serve`` the path-style S3 REST frontend.
+"""
+from . import cls_rgw  # noqa: F401  (registers the cls methods)
+from .gateway import RGWError, RGWLite
+from .http import S3Frontend, serve
+
+__all__ = ["RGWError", "RGWLite", "S3Frontend", "serve"]

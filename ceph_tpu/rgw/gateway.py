@@ -1,0 +1,318 @@
+"""rgw-lite: S3-shaped object gateway over rados (src/rgw, 122k LoC in
+the reference, at lite scale).
+
+Storage layout mirrors the reference's: user and bucket-entrypoint
+records in a metadata pool (``user.<uid>``, ``bucket.<name>``), one
+index object per bucket (``.dir.<bucket_id>``) mutated through the
+two-phase cls_rgw protocol, and object payloads chunked into the data
+pool under ``<bucket_id>_<name>[.chunk.N]`` with a manifest in the
+index entry (RGWObjManifest role).  Multipart uploads stage parts
+under a ``_multipart_`` namespace and stitch a manifest at complete,
+like RGWMultipart*.
+
+Scope-outs vs the reference: versioning, lifecycle, ACL grammars
+beyond owner checks, swift API, and the civetweb frontend (the
+``http`` module provides a threaded stdlib server speaking the S3
+path-style subset with AWS v2-style HMAC auth instead).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import secrets
+import time
+from typing import Dict, List, Optional
+
+from ..client.rados import RadosClient
+from . import cls_rgw  # noqa: F401
+
+CHUNK = 4 << 20                   # rgw_max_chunk_size default (4 MiB)
+
+
+class RGWError(IOError):
+    def __init__(self, api: str, result: int, reason: str = ""):
+        super().__init__(f"rgw {api}: {result} {reason}".rstrip())
+        self.result = result
+
+
+def _j(obj) -> bytes:
+    return json.dumps(obj, sort_keys=True).encode()
+
+
+def _absent(e: IOError) -> bool:
+    return getattr(e, "errno", None) == 2
+
+
+class RGWLite:
+    """The gateway core (RGWRados role): all state in rados."""
+
+    def __init__(self, client: RadosClient, meta_pool: str,
+                 data_pool: str):
+        self.client = client
+        self.mpool = meta_pool
+        self.dpool = data_pool
+
+    # ---- cls / meta helpers ------------------------------------------------
+    def _exec(self, pool: str, oid: str, method: str, payload=None
+              ) -> bytes:
+        ret, out = self.client.exec(pool, oid, "rgw", method,
+                                    _j(payload or {}))
+        if ret < 0:
+            raise RGWError(method, ret)
+        return out
+
+    def _meta_get(self, oid: str) -> Optional[Dict]:
+        try:
+            return json.loads(self.client.read(self.mpool, oid))
+        except IOError as e:
+            if _absent(e):
+                return None
+            raise
+
+    # ---- users (RGWUser / radosgw-admin user create) -----------------------
+    def create_user(self, uid: str, display_name: str = "") -> Dict:
+        if self._meta_get(f"user.{uid}") is not None:
+            raise RGWError("create_user", -17)
+        user = {"uid": uid, "display_name": display_name or uid,
+                "access_key": secrets.token_hex(10),
+                "secret_key": secrets.token_hex(20),
+                "buckets": []}
+        self.client.write_full(self.mpool, f"user.{uid}", _j(user))
+        self._meta_index(f"user.{uid}", True)
+        return user
+
+    def get_user(self, uid: str) -> Dict:
+        u = self._meta_get(f"user.{uid}")
+        if u is None:
+            raise RGWError("get_user", -2)
+        return u
+
+    def user_by_access_key(self, access_key: str) -> Optional[Dict]:
+        # lite linear scan (the reference keeps a key->uid index object)
+        for oid in self._meta_list("user."):
+            u = self._meta_get(oid)
+            if u and u["access_key"] == access_key:
+                return u
+        return None
+
+    def _meta_list(self, prefix: str) -> List[str]:
+        try:
+            om = self.client.omap_get(self.mpool, "rgw_meta_index")
+        except IOError as e:
+            if not _absent(e):
+                raise
+            om = {}
+        return sorted(k for k in om if k.startswith(prefix))
+
+    def _meta_index(self, key: str, add: bool) -> None:
+        if add:
+            self.client.omap_set(self.mpool, "rgw_meta_index",
+                                 {key: b"1"})
+        else:
+            self.client.omap_rm_keys(self.mpool, "rgw_meta_index",
+                                     [key])
+
+    # ---- buckets -----------------------------------------------------------
+    def _index_oid(self, bucket_id: str) -> str:
+        return f".dir.{bucket_id}"
+
+    def create_bucket(self, uid: str, name: str) -> Dict:
+        user = self.get_user(uid)
+        if self._meta_get(f"bucket.{name}") is not None:
+            raise RGWError("create_bucket", -17, "BucketAlreadyExists")
+        bid = secrets.token_hex(8)
+        bucket = {"name": name, "id": bid, "owner": uid,
+                  "created": time.time()}
+        self.client.write_full(self.mpool, f"bucket.{name}", _j(bucket))
+        self.client.create(self.mpool, self._index_oid(bid),
+                           exclusive=False)
+        user["buckets"] = sorted(set(user["buckets"]) | {name})
+        self.client.write_full(self.mpool, f"user.{uid}", _j(user))
+        return bucket
+
+    def get_bucket(self, name: str) -> Dict:
+        b = self._meta_get(f"bucket.{name}")
+        if b is None:
+            raise RGWError("get_bucket", -2, "NoSuchBucket")
+        return b
+
+    def delete_bucket(self, name: str) -> None:
+        b = self.get_bucket(name)
+        stats = json.loads(self._exec(self.mpool,
+                                      self._index_oid(b["id"]),
+                                      "bucket_stats"))
+        if stats["num_objects"]:
+            raise RGWError("delete_bucket", -39, "BucketNotEmpty")
+        self.client.remove(self.mpool, self._index_oid(b["id"]))
+        self.client.remove(self.mpool, f"bucket.{name}")
+        owner = self._meta_get(f"user.{b['owner']}")
+        if owner:
+            owner["buckets"] = [x for x in owner["buckets"] if x != name]
+            self.client.write_full(self.mpool, f"user.{b['owner']}",
+                                   _j(owner))
+
+    def list_buckets(self, uid: str) -> List[str]:
+        return list(self.get_user(uid)["buckets"])
+
+    # ---- objects -----------------------------------------------------------
+    def _data_oid(self, bucket_id: str, name: str) -> str:
+        return f"{bucket_id}_{name}"
+
+    def _write_chunked(self, base_oid: str, data: bytes) -> List[str]:
+        """Payload -> head object + .chunk.N tail objects (manifest)."""
+        oids = []
+        for i in range(0, max(len(data), 1), CHUNK):
+            oid = base_oid if i == 0 else \
+                f"{base_oid}.chunk.{i // CHUNK}"
+            r = self.client.write_full(self.dpool, oid,
+                                       data[i:i + CHUNK])
+            if r < 0:
+                raise RGWError("put_object", r)
+            oids.append(oid)
+        return oids
+
+    def put_object(self, bucket: str, name: str, data: bytes,
+                   content_type: str = "binary/octet-stream") -> Dict:
+        """Two-phase put: index prepare -> data chunks -> index
+        complete.  A crash mid-way leaves a pending marker and garbage
+        chunks, but never a listing entry for unreadable data."""
+        b = self.get_bucket(bucket)
+        idx = self._index_oid(b["id"])
+        tag = secrets.token_hex(8)
+        self._exec(self.mpool, idx, "bucket_prepare_op",
+                   {"tag": tag, "name": name, "op": "put"})
+        try:
+            chunks = self._write_chunked(self._data_oid(b["id"], name),
+                                         data)
+        except Exception:
+            self._exec(self.mpool, idx, "bucket_cancel_op", {"tag": tag})
+            raise
+        meta = {"size": len(data),
+                "etag": hashlib.md5(data).hexdigest(),
+                "mtime": time.time(), "content_type": content_type,
+                "chunks": len(chunks)}
+        self._exec(self.mpool, idx, "bucket_complete_op",
+                   {"tag": tag, "name": name, "op": "put", "meta": meta})
+        return meta
+
+    def get_object(self, bucket: str, name: str) -> bytes:
+        b = self.get_bucket(bucket)
+        meta = self.head_object(bucket, name)
+        base = self._data_oid(b["id"], name)
+        parts = []
+        for i in range(meta["chunks"]):
+            oid = base if i == 0 else f"{base}.chunk.{i}"
+            parts.append(self.client.read(self.dpool, oid))
+        return b"".join(parts)
+
+    def head_object(self, bucket: str, name: str) -> Dict:
+        b = self.get_bucket(bucket)
+        try:
+            return json.loads(self._exec(
+                self.mpool, self._index_oid(b["id"]),
+                "bucket_get_entry", {"name": name}))
+        except RGWError as e:
+            if e.result == -2:
+                raise RGWError("head_object", -2, "NoSuchKey")
+            raise
+
+    def delete_object(self, bucket: str, name: str) -> None:
+        b = self.get_bucket(bucket)
+        meta = self.head_object(bucket, name)
+        idx = self._index_oid(b["id"])
+        tag = secrets.token_hex(8)
+        self._exec(self.mpool, idx, "bucket_prepare_op",
+                   {"tag": tag, "name": name, "op": "del"})
+        base = self._data_oid(b["id"], name)
+        for i in range(meta["chunks"]):
+            oid = base if i == 0 else f"{base}.chunk.{i}"
+            self.client.remove(self.dpool, oid)
+        self._exec(self.mpool, idx, "bucket_complete_op",
+                   {"tag": tag, "name": name, "op": "del"})
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     delimiter: str = "", marker: str = "",
+                     max_keys: int = 1000) -> Dict:
+        """S3 ListObjects semantics incl. delimiter rollup into
+        CommonPrefixes (RGWRados::cls_bucket_list + RGWListBucket)."""
+        b = self.get_bucket(bucket)
+        raw = json.loads(self._exec(
+            self.mpool, self._index_oid(b["id"]), "bucket_list",
+            {"prefix": prefix, "marker": marker,
+             "max_keys": max_keys if not delimiter else 100000}))
+        if not delimiter:
+            return {"contents": raw["entries"], "common_prefixes": [],
+                    "truncated": raw["truncated"]}
+        contents, prefixes, seen = [], [], set()
+        for e in raw["entries"]:
+            rest = e["name"][len(prefix):]
+            if delimiter in rest:
+                cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+                if cp not in seen:
+                    seen.add(cp)
+                    prefixes.append(cp)
+            else:
+                contents.append(e)
+            if len(contents) + len(prefixes) >= max_keys:
+                break
+        return {"contents": contents, "common_prefixes": prefixes,
+                "truncated": raw["truncated"]}
+
+    # ---- multipart (RGWMultipart*) -----------------------------------------
+    def initiate_multipart(self, bucket: str, name: str) -> str:
+        b = self.get_bucket(bucket)
+        upload_id = secrets.token_hex(8)
+        self.client.write_full(
+            self.mpool, f"multipart.{b['id']}.{name}.{upload_id}",
+            _j({"parts": {}}))
+        return upload_id
+
+    def _mp_meta_oid(self, bid: str, name: str, upload_id: str) -> str:
+        return f"multipart.{bid}.{name}.{upload_id}"
+
+    def upload_part(self, bucket: str, name: str, upload_id: str,
+                    part_num: int, data: bytes) -> str:
+        b = self.get_bucket(bucket)
+        moid = self._mp_meta_oid(b["id"], name, upload_id)
+        mp = self._meta_get(moid)
+        if mp is None:
+            raise RGWError("upload_part", -2, "NoSuchUpload")
+        poid = f"{b['id']}__multipart_{name}.{upload_id}.{part_num}"
+        r = self.client.write_full(self.dpool, poid, data)
+        if r < 0:
+            raise RGWError("upload_part", r)
+        etag = hashlib.md5(data).hexdigest()
+        mp["parts"][str(part_num)] = {"size": len(data), "etag": etag}
+        self.client.write_full(self.mpool, moid, _j(mp))
+        return etag
+
+    def complete_multipart(self, bucket: str, name: str,
+                           upload_id: str) -> Dict:
+        """Stitch the parts into the final object (copy-concatenate —
+        the reference links manifests instead; lite keeps one chunk
+        layout for get_object)."""
+        b = self.get_bucket(bucket)
+        moid = self._mp_meta_oid(b["id"], name, upload_id)
+        mp = self._meta_get(moid)
+        if mp is None:
+            raise RGWError("complete_multipart", -2, "NoSuchUpload")
+        data = b""
+        for pn in sorted(mp["parts"], key=int):
+            poid = f"{b['id']}__multipart_{name}.{upload_id}.{pn}"
+            data += self.client.read(self.dpool, poid)
+        meta = self.put_object(bucket, name, data)
+        self.abort_multipart(bucket, name, upload_id)
+        return meta
+
+    def abort_multipart(self, bucket: str, name: str,
+                        upload_id: str) -> None:
+        b = self.get_bucket(bucket)
+        moid = self._mp_meta_oid(b["id"], name, upload_id)
+        mp = self._meta_get(moid)
+        if mp is None:
+            return
+        for pn in mp["parts"]:
+            self.client.remove(
+                self.dpool,
+                f"{b['id']}__multipart_{name}.{upload_id}.{pn}")
+        self.client.remove(self.mpool, moid)

@@ -1,0 +1,200 @@
+"""S3 path-style HTTP frontend for rgw-lite (the civetweb/beast
+frontend role, src/rgw/rgw_main.cc + rgw_rest_s3.cc at lite scale).
+
+Speaks the S3 subset the gateway implements over path-style URLs
+(``/bucket``, ``/bucket/key``): bucket PUT/GET/DELETE, object
+PUT/GET/HEAD/DELETE, ListObjectsV1 query args (prefix/marker/
+delimiter/max-keys) with XML responses, and AWS signature v2-style
+auth: ``Authorization: AWS <access_key>:<sig>`` where sig =
+base64(HMAC-SHA1(secret, method\\n\\n\\ndate\\npath)) — the reference's
+v2 string-to-sign with the optional header sections empty.
+
+``handle()`` is a pure request->response function (testable without
+sockets); ``serve()`` wraps it in a threaded stdlib HTTPServer.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+from typing import Dict, Optional, Tuple
+from xml.sax.saxutils import escape
+
+from .gateway import RGWError, RGWLite
+
+
+def _sign_v2(secret: str, method: str, date: str, path: str) -> str:
+    sts = f"{method}\n\n\n{date}\n{path}"
+    mac = hmac.new(secret.encode(), sts.encode(), hashlib.sha1)
+    return base64.b64encode(mac.digest()).decode()
+
+
+def _err(status: int, code: str, message: str = "") -> Tuple[int, Dict,
+                                                             bytes]:
+    body = (f'<?xml version="1.0"?><Error><Code>{escape(code)}</Code>'
+            f"<Message>{escape(message or code)}</Message></Error>")
+    return status, {"Content-Type": "application/xml"}, body.encode()
+
+
+_ERRNO_TO_S3 = {
+    -2: (404, "NoSuchKey"),
+    -13: (403, "AccessDenied"),
+    -17: (409, "BucketAlreadyExists"),
+    -39: (409, "BucketNotEmpty"),
+}
+
+
+class S3Frontend:
+    def __init__(self, rgw: RGWLite):
+        self.rgw = rgw
+
+    # ---- auth --------------------------------------------------------------
+    def _authenticate(self, method: str, path: str,
+                      headers: Dict[str, str]) -> Optional[Dict]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("AWS ") or ":" not in auth[4:]:
+            return None
+        access_key, sig = auth[4:].split(":", 1)
+        user = self.rgw.user_by_access_key(access_key)
+        if user is None:
+            return None
+        want = _sign_v2(user["secret_key"], method,
+                        headers.get("Date", ""), path)
+        return user if hmac.compare_digest(want, sig) else None
+
+    # ---- request router ----------------------------------------------------
+    def handle(self, method: str, path: str,
+               headers: Optional[Dict[str, str]] = None,
+               body: bytes = b"",
+               query: Optional[Dict[str, str]] = None
+               ) -> Tuple[int, Dict[str, str], bytes]:
+        headers = headers or {}
+        query = query or {}
+        user = self._authenticate(method, path.split("?")[0], headers)
+        if user is None:
+            return _err(403, "AccessDenied", "bad or missing signature")
+        parts = path.split("?")[0].strip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        try:
+            if not bucket:
+                return self._list_buckets(user)
+            if not key:
+                return self._bucket_op(method, user, bucket, query)
+            return self._object_op(method, user, bucket, key, body)
+        except RGWError as e:
+            status, code = _ERRNO_TO_S3.get(e.result,
+                                            (500, "InternalError"))
+            return _err(status, code, str(e))
+
+    def _owner_check(self, user: Dict, bucket: str) -> None:
+        if self.rgw.get_bucket(bucket)["owner"] != user["uid"]:
+            raise RGWError("acl", -13, "AccessDenied")
+
+    def _list_buckets(self, user):
+        names = "".join(f"<Bucket><Name>{escape(n)}</Name></Bucket>"
+                        for n in self.rgw.list_buckets(user["uid"]))
+        xml = (f'<?xml version="1.0"?><ListAllMyBucketsResult>'
+               f"<Buckets>{names}</Buckets></ListAllMyBucketsResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    def _bucket_op(self, method, user, bucket, query):
+        if method == "PUT":
+            self.rgw.create_bucket(user["uid"], bucket)
+            return 200, {}, b""
+        if method == "DELETE":
+            self._owner_check(user, bucket)
+            self.rgw.delete_bucket(bucket)
+            return 204, {}, b""
+        if method == "GET":
+            res = self.rgw.list_objects(
+                bucket, prefix=query.get("prefix", ""),
+                delimiter=query.get("delimiter", ""),
+                marker=query.get("marker", ""),
+                max_keys=int(query.get("max-keys", "1000")))
+            items = "".join(
+                f"<Contents><Key>{escape(e['name'])}</Key>"
+                f"<Size>{e['size']}</Size>"
+                f'<ETag>"{e["etag"]}"</ETag></Contents>'
+                for e in res["contents"])
+            cps = "".join(
+                f"<CommonPrefixes><Prefix>{escape(p)}</Prefix>"
+                f"</CommonPrefixes>"
+                for p in res["common_prefixes"])
+            xml = (f'<?xml version="1.0"?><ListBucketResult>'
+                   f"<Name>{escape(bucket)}</Name>"
+                   f"<IsTruncated>{str(res['truncated']).lower()}"
+                   f"</IsTruncated>{items}{cps}</ListBucketResult>")
+            return 200, {"Content-Type": "application/xml"}, xml.encode()
+        return _err(405, "MethodNotAllowed")
+
+    def _object_op(self, method, user, bucket, key, body):
+        if method == "PUT":
+            self._owner_check(user, bucket)
+            meta = self.rgw.put_object(bucket, key, body)
+            return 200, {"ETag": f'"{meta["etag"]}"'}, b""
+        if method == "GET":
+            data = self.rgw.get_object(bucket, key)
+            meta = self.rgw.head_object(bucket, key)
+            return 200, {"Content-Type": meta["content_type"],
+                         "ETag": f'"{meta["etag"]}"'}, data
+        if method == "HEAD":
+            meta = self.rgw.head_object(bucket, key)
+            return 200, {"Content-Length": str(meta["size"]),
+                         "ETag": f'"{meta["etag"]}"'}, b""
+        if method == "DELETE":
+            self._owner_check(user, bucket)
+            self.rgw.delete_object(bucket, key)
+            return 204, {}, b""
+        return _err(405, "MethodNotAllowed")
+
+
+def serve(frontend: S3Frontend, port: int = 0):
+    """Threaded stdlib HTTP server; returns (server, port).  Call
+    ``server.shutdown()`` when done."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.parse import parse_qsl, urlparse
+
+    # the in-process rados client/fabric is not thread-safe; requests
+    # from concurrent connections serialize here (the reference runs a
+    # real thread pool over a thread-safe RGWRados)
+    lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        def _run(self, method):
+            u = urlparse(self.path)
+            ln = int(self.headers.get("Content-Length", "0") or 0)
+            body = self.rfile.read(ln) if ln else b""
+            with lock:
+                status, hdrs, out = frontend.handle(
+                    method, u.path, dict(self.headers), body,
+                    dict(parse_qsl(u.query)))
+            self.send_response(status)
+            for k, v in hdrs.items():
+                self.send_header(k, v)
+            if "Content-Length" not in hdrs:
+                self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            if method != "HEAD":
+                self.wfile.write(out)
+
+        def do_GET(self):
+            self._run("GET")
+
+        def do_PUT(self):
+            self._run("PUT")
+
+        def do_DELETE(self):
+            self._run("DELETE")
+
+        def do_HEAD(self):
+            self._run("HEAD")
+
+        def log_message(self, *a):      # keep test output clean
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1]

@@ -167,6 +167,47 @@ def test_relative_symlink(fs):
     assert ei.value.result == -40
 
 
+def test_rename_identity_and_cycle_guards(fs):
+    """rename(p, p) is a no-op; moving a dir into its own subtree is
+    EINVAL — both would otherwise detach data forever."""
+    c, cl, f = fs
+    f.create("/x", ORDER)
+    f.write("/x", b"survives")
+    f.rename("/x", "/x")
+    assert f.read("/x") == b"survives"
+    f.mkdir("/d")
+    f.mkdir("/d/sub")
+    f.create("/d/sub/keep", ORDER)
+    with pytest.raises(FsError) as ei:
+        f.rename("/d", "/d/sub/trap")
+    assert ei.value.result == -22
+    assert f.exists("/d/sub/keep")
+    with pytest.raises(FsError):
+        f.rename("/missing", "/missing")     # still ENOENT
+
+
+def test_intermediate_symlink_resolution(fs):
+    """Paths THROUGH a directory symlink resolve like the kernel
+    client's walk; final-component stat stays lstat-shaped."""
+    c, cl, f = fs
+    f.mkdir("/real")
+    f.create("/real/t", ORDER)
+    f.write("/real/t", b"via-dir-link")
+    f.symlink("/ld", "/real")
+    assert f.read("/ld/t") == b"via-dir-link"
+    assert sorted(f.listdir("/ld")) == ["t"]
+    f.write("/ld/t", b"written-thru")
+    assert f.read("/real/t") == b"written-thru"
+    f.create("/ld/new", ORDER)               # create through the link
+    assert f.exists("/real/new")
+    assert f.stat("/ld")["type"] == "symlink"  # lstat semantics
+    # relative dir symlink in the middle of a path
+    f.mkdir("/real/deep")
+    f.create("/real/deep/f", ORDER)
+    f.symlink("/real/shortcut", "deep")
+    assert f.exists("/real/shortcut/f")
+
+
 def test_symlink(fs):
     c, cl, f = fs
     f.mkdir("/real")
