@@ -285,12 +285,16 @@ class CephFS:
         if sparts == dparts:
             self._resolve(src)               # still ENOENT if absent
             return                           # rename(p, p): no-op
-        if dparts[:len(sparts)] == sparts:
-            # moving a directory into its own subtree would detach the
-            # whole subtree forever (POSIX: EINVAL)
-            raise FsError("rename", -22)
         sdino, sname = self._resolve_parent(src)
         ddino, dname = self._resolve_parent(dst)
+        moving = self._lookup(sdino, sname)
+        if moving["type"] == "dir" and \
+                self._subtree_contains(moving["ino"], ddino):
+            # moving a directory into its own subtree would detach the
+            # whole subtree forever (POSIX: EINVAL).  Checked on
+            # RESOLVED inodes, not path strings, so a symlink into the
+            # source subtree cannot smuggle the cycle past the guard.
+            raise FsError("rename", -22)
         if sdino == ddino:
             displaced = json.loads(self._call(
                 dir_oid(sdino), "rename_local",
@@ -313,6 +317,21 @@ class CephFS:
             self._call(dir_oid(ddino), "link",
                        {"name": dname, "inode": inode})
         self._call(dir_oid(sdino), "unlink", {"name": sname})
+
+    def _subtree_contains(self, root_ino: int, needle_ino: int,
+                          depth: int = 0) -> bool:
+        """Is ``needle_ino`` the root or any descendant directory of
+        ``root_ino``?  (The MDS answers this from its cache; here it is
+        a readdir walk over the moved subtree.)"""
+        if root_ino == needle_ino:
+            return True
+        if depth > 64:
+            return True          # fail closed on absurd nesting
+        entries = json.loads(self._call(dir_oid(root_ino), "readdir"))
+        return any(info["type"] == "dir" and
+                   self._subtree_contains(info["ino"], needle_ino,
+                                          depth + 1)
+                   for info in entries.values())
 
     def exists(self, path: str) -> bool:
         try:

@@ -1,0 +1,7 @@
+"""journal-lite: ordered append/replay log over rados (src/journal +
+src/cls/journal at lite scale — the engine under rbd mirroring).
+"""
+from . import cls_journal  # noqa: F401  (registers the cls methods)
+from .journaler import Journaler, JournalError
+
+__all__ = ["Journaler", "JournalError"]

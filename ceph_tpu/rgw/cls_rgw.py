@@ -71,10 +71,10 @@ def _list(ctx: ClsContext, inp: bytes):
     prefix = req.get("prefix", "")
     marker = req.get("marker", "")
     maxk = int(req.get("max_keys", 1000))
-    names = sorted(k[len("entry_"):] for k in ctx.omap_get()
+    om = ctx.omap_get()
+    names = sorted(k[len("entry_"):] for k in om
                    if k.startswith("entry_"))
     out, truncated = [], False
-    om = ctx.omap_get()
     for n in names:
         if n <= marker or not n.startswith(prefix):
             continue

@@ -156,14 +156,17 @@ class RGWLite:
 
     # ---- objects -----------------------------------------------------------
     def _data_oid(self, bucket_id: str, name: str) -> str:
-        return f"{bucket_id}_{name}"
+        # distinct o_/c_/mp_ namespaces: a key can never collide with
+        # another key's chunk or multipart staging objects (the
+        # reference's __shadow_ namespace escaping, rgw_obj::set_ns)
+        return f"{bucket_id}_o_{name}"
 
     def _write_chunked(self, base_oid: str, data: bytes) -> List[str]:
         """Payload -> head object + .chunk.N tail objects (manifest)."""
         oids = []
         for i in range(0, max(len(data), 1), CHUNK):
             oid = base_oid if i == 0 else \
-                f"{base_oid}.chunk.{i // CHUNK}"
+                base_oid.replace("_o_", "_c_", 1) + f".{i // CHUNK}"
             r = self.client.write_full(self.dpool, oid,
                                        data[i:i + CHUNK])
             if r < 0:
@@ -178,6 +181,10 @@ class RGWLite:
         chunks, but never a listing entry for unreadable data."""
         b = self.get_bucket(bucket)
         idx = self._index_oid(b["id"])
+        try:
+            old_chunks = self.head_object(bucket, name)["chunks"]
+        except RGWError:
+            old_chunks = 0
         tag = secrets.token_hex(8)
         self._exec(self.mpool, idx, "bucket_prepare_op",
                    {"tag": tag, "name": name, "op": "put"})
@@ -193,17 +200,26 @@ class RGWLite:
                 "chunks": len(chunks)}
         self._exec(self.mpool, idx, "bucket_complete_op",
                    {"tag": tag, "name": name, "op": "put", "meta": meta})
+        # a shrinking overwrite strands the old version's tail chunks;
+        # collect them now (the reference defers this to its GC)
+        for oid in self._chunk_oids(b["id"], name,
+                                    old_chunks)[len(chunks):]:
+            self.client.remove(self.dpool, oid)
         return meta
 
     def get_object(self, bucket: str, name: str) -> bytes:
         b = self.get_bucket(bucket)
         meta = self.head_object(bucket, name)
-        base = self._data_oid(b["id"], name)
         parts = []
-        for i in range(meta["chunks"]):
-            oid = base if i == 0 else f"{base}.chunk.{i}"
+        for oid in self._chunk_oids(b["id"], name, meta["chunks"]):
             parts.append(self.client.read(self.dpool, oid))
         return b"".join(parts)
+
+    def _chunk_oids(self, bid: str, name: str, count: int):
+        base = self._data_oid(bid, name)
+        return [base if i == 0 else
+                base.replace("_o_", "_c_", 1) + f".{i}"
+                for i in range(count)]
 
     def head_object(self, bucket: str, name: str) -> Dict:
         b = self.get_bucket(bucket)
@@ -217,18 +233,19 @@ class RGWLite:
             raise
 
     def delete_object(self, bucket: str, name: str) -> None:
+        """Index first, data second: a crash mid-delete leaves orphan
+        chunks (GC debt) but never a listing entry pointing at deleted
+        data — the same invariant direction as put."""
         b = self.get_bucket(bucket)
         meta = self.head_object(bucket, name)
         idx = self._index_oid(b["id"])
         tag = secrets.token_hex(8)
         self._exec(self.mpool, idx, "bucket_prepare_op",
                    {"tag": tag, "name": name, "op": "del"})
-        base = self._data_oid(b["id"], name)
-        for i in range(meta["chunks"]):
-            oid = base if i == 0 else f"{base}.chunk.{i}"
-            self.client.remove(self.dpool, oid)
         self._exec(self.mpool, idx, "bucket_complete_op",
                    {"tag": tag, "name": name, "op": "del"})
+        for oid in self._chunk_oids(b["id"], name, meta["chunks"]):
+            self.client.remove(self.dpool, oid)
 
     def list_objects(self, bucket: str, prefix: str = "",
                      delimiter: str = "", marker: str = "",
@@ -244,7 +261,8 @@ class RGWLite:
             return {"contents": raw["entries"], "common_prefixes": [],
                     "truncated": raw["truncated"]}
         contents, prefixes, seen = [], [], set()
-        for e in raw["entries"]:
+        truncated = raw["truncated"]
+        for i, e in enumerate(raw["entries"]):
             rest = e["name"][len(prefix):]
             if delimiter in rest:
                 cp = prefix + rest.split(delimiter, 1)[0] + delimiter
@@ -254,9 +272,11 @@ class RGWLite:
             else:
                 contents.append(e)
             if len(contents) + len(prefixes) >= max_keys:
+                # anything left past the cut means this page is partial
+                truncated = truncated or i + 1 < len(raw["entries"])
                 break
         return {"contents": contents, "common_prefixes": prefixes,
-                "truncated": raw["truncated"]}
+                "truncated": truncated}
 
     # ---- multipart (RGWMultipart*) -----------------------------------------
     def initiate_multipart(self, bucket: str, name: str) -> str:
@@ -277,7 +297,7 @@ class RGWLite:
         mp = self._meta_get(moid)
         if mp is None:
             raise RGWError("upload_part", -2, "NoSuchUpload")
-        poid = f"{b['id']}__multipart_{name}.{upload_id}.{part_num}"
+        poid = f"{b['id']}_mp_{name}.{upload_id}.{part_num}"
         r = self.client.write_full(self.dpool, poid, data)
         if r < 0:
             raise RGWError("upload_part", r)
@@ -298,7 +318,7 @@ class RGWLite:
             raise RGWError("complete_multipart", -2, "NoSuchUpload")
         data = b""
         for pn in sorted(mp["parts"], key=int):
-            poid = f"{b['id']}__multipart_{name}.{upload_id}.{pn}"
+            poid = f"{b['id']}_mp_{name}.{upload_id}.{pn}"
             data += self.client.read(self.dpool, poid)
         meta = self.put_object(bucket, name, data)
         self.abort_multipart(bucket, name, upload_id)
@@ -314,5 +334,5 @@ class RGWLite:
         for pn in mp["parts"]:
             self.client.remove(
                 self.dpool,
-                f"{b['id']}__multipart_{name}.{upload_id}.{pn}")
+                f"{b['id']}_mp_{name}.{upload_id}.{pn}")
         self.client.remove(self.mpool, moid)

@@ -86,6 +86,10 @@ class S3Frontend:
             status, code = _ERRNO_TO_S3.get(e.result,
                                             (500, "InternalError"))
             return _err(status, code, str(e))
+        except ValueError as e:
+            return _err(400, "InvalidArgument", str(e))
+        except Exception as e:      # a handler thread must always reply
+            return _err(500, "InternalError", repr(e))
 
     def _owner_check(self, user: Dict, bucket: str) -> None:
         if self.rgw.get_bucket(bucket)["owner"] != user["uid"]:
@@ -107,6 +111,7 @@ class S3Frontend:
             self.rgw.delete_bucket(bucket)
             return 204, {}, b""
         if method == "GET":
+            self._owner_check(user, bucket)
             res = self.rgw.list_objects(
                 bucket, prefix=query.get("prefix", ""),
                 delimiter=query.get("delimiter", ""),
@@ -134,11 +139,13 @@ class S3Frontend:
             meta = self.rgw.put_object(bucket, key, body)
             return 200, {"ETag": f'"{meta["etag"]}"'}, b""
         if method == "GET":
+            self._owner_check(user, bucket)
             data = self.rgw.get_object(bucket, key)
             meta = self.rgw.head_object(bucket, key)
             return 200, {"Content-Type": meta["content_type"],
                          "ETag": f'"{meta["etag"]}"'}, data
         if method == "HEAD":
+            self._owner_check(user, bucket)
             meta = self.rgw.head_object(bucket, key)
             return 200, {"Content-Length": str(meta["size"]),
                          "ETag": f'"{meta["etag"]}"'}, b""

@@ -184,6 +184,13 @@ def test_rename_identity_and_cycle_guards(fs):
     assert f.exists("/d/sub/keep")
     with pytest.raises(FsError):
         f.rename("/missing", "/missing")     # still ENOENT
+    # a symlink into the source subtree cannot smuggle the cycle past
+    # the guard (inode-resolved ancestry, not path strings)
+    f.symlink("/s", "/d")
+    with pytest.raises(FsError) as ei:
+        f.rename("/d", "/s/trap")
+    assert ei.value.result == -22
+    assert f.exists("/d/sub/keep")
 
 
 def test_intermediate_symlink_resolution(fs):

@@ -125,6 +125,73 @@ def test_two_phase_index_crash_safety(rgw):
     assert ei.value.result == -125
 
 
+def test_key_chunk_namespace_no_collision(rgw):
+    """A key named like another key's chunk object must not collide
+    (distinct o_/c_/mp_ data-oid namespaces)."""
+    c, cl, g, user = rgw
+    g.create_bucket("alice", "b")
+    import ceph_tpu.rgw.gateway as gw
+    old = gw.CHUNK
+    gw.CHUNK = 4096
+    try:
+        big = bytes(range(256)) * 32             # 8 KiB -> 2 chunks
+        g.put_object("b", "a", big)
+        g.put_object("b", "a.chunk.1", b"innocent")  # old collision name
+        g.put_object("b", "a.1", b"also-fine")
+        assert g.get_object("b", "a") == big     # chunks intact
+        assert g.get_object("b", "a.chunk.1") == b"innocent"
+        g.delete_object("b", "a.chunk.1")
+        assert g.get_object("b", "a") == big     # still intact
+        # shrinking overwrite collects the stranded tail chunks
+        b = g.get_bucket("b")
+        tail = g._chunk_oids(b["id"], "a", 2)[1]
+        cl.read("rgwdata", tail)                 # exists before
+        g.put_object("b", "a", b"tiny")
+        with pytest.raises(IOError):
+            cl.read("rgwdata", tail)             # collected after
+    finally:
+        gw.CHUNK = old
+
+
+def test_reads_require_ownership(rgw):
+    """GET/HEAD/listing are owner-gated too, not just mutations."""
+    c, cl, g, user = rgw
+    g.create_bucket("alice", "secret")
+    g.put_object("secret", "doc", b"private")
+    mallory = g.create_user("mallory")
+    fe = S3Frontend(g)
+
+    def req(method, path, u):
+        from ceph_tpu.rgw.http import _sign_v2 as sv
+        sig = sv(u["secret_key"], method, "d", path.split("?")[0])
+        return fe.handle(method, path, {
+            "Date": "d", "Authorization": f"AWS {u['access_key']}:{sig}"})
+
+    assert req("GET", "/secret/doc", mallory)[0] == 403
+    assert req("HEAD", "/secret/doc", mallory)[0] == 403
+    assert req("GET", "/secret", mallory)[0] == 403
+    assert req("GET", "/secret/doc", user)[0] == 200
+    # malformed query args return an S3 error, not a dropped socket
+    st, _, out = fe.handle("GET", "/secret?max-keys=abc", {
+        "Date": "d", "Authorization": "AWS %s:%s" % (
+            user["access_key"],
+            __import__("ceph_tpu.rgw.http", fromlist=["_sign_v2"]
+                       )._sign_v2(user["secret_key"], "GET", "d",
+                                  "/secret"))}, b"",
+        {"max-keys": "abc"})
+    assert st == 400 and b"InvalidArgument" in out
+
+
+def test_delimiter_truncation_honest(rgw):
+    c, cl, g, user = rgw
+    g.create_bucket("alice", "b")
+    for k in ["a/1", "b/2", "top"]:
+        g.put_object("b", k, b"x")
+    res = g.list_objects("b", delimiter="/", max_keys=1)
+    assert res["truncated"] is True              # more rollups remain
+    assert res["common_prefixes"] == ["a/"]
+
+
 def test_multipart(rgw):
     c, cl, g, user = rgw
     g.create_bucket("alice", "b")
