@@ -253,6 +253,7 @@ class MiniCluster:
                 if osd.name not in self.network.down:
                     osd.tick(self.clock)
             self.network.pump()
+            self.mgr.tick()
         self.run_recovery()
 
     # ---- mon thrashing ------------------------------------------------------

@@ -109,6 +109,17 @@ def build_options() -> List[Option]:
         .set_description("enforce mclock reservation/limit in ops per "
                          "REAL second (src/dmclock role) instead of "
                          "the deterministic virtual clock"),
+        Option("osd_capacity_bytes", OPT_INT).set_default(0)
+        .set_description("logical capacity per OSD for full-ratio "
+                         "accounting (osd_stat_t kb role); 0 = "
+                         "unlimited, never full"),
+        Option("mon_osd_full_ratio", OPT_FLOAT).set_default(0.95)
+        .set_description("OSD fill ratio at which the cluster FULL "
+                         "flag blocks writes (common/options.cc "
+                         "mon_osd_full_ratio)"),
+        Option("mon_osd_nearfull_ratio", OPT_FLOAT).set_default(0.85)
+        .set_description("OSD fill ratio raising the NEARFULL health "
+                         "warning (mon_osd_nearfull_ratio)"),
         Option("tracing_kernels", OPT_BOOL).set_default(False)
         .set_description("time every device kernel dispatch (adds a "
                          "sync per call; diagnosis only)"),

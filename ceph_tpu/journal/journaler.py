@@ -55,6 +55,7 @@ class Journaler:
         self.order = 0
         self.splay = 0
         self._next_tid = 0
+        self._pushed_active_set = -1
 
     # ---- metadata ----------------------------------------------------------
     def _exec(self, method: str, payload=None) -> bytes:
@@ -109,7 +110,11 @@ class Journaler:
             raise JournalError("append", r)
         self._next_tid = tid + 1
         active_set = tid // self._entries_per_set()
-        self._exec("set_active_set", {"set": active_set})
+        if active_set > self._pushed_active_set:
+            # the watermark only moves once per object set; skipping
+            # the no-op exec halves the append hot path's op count
+            self._exec("set_active_set", {"set": active_set})
+            self._pushed_active_set = active_set
         return tid
 
     # ---- replay ------------------------------------------------------------

@@ -45,7 +45,10 @@ class Manager(Dispatcher):
         # wins — only the current primary reports a PG, so no double
         # counting):  (pool, ps) -> (epoch, objects, bytes)
         self.pg_stats: Dict[tuple, tuple] = {}
+        # osd -> (store_bytes, store_capacity) from MPGStats osd_stat
+        self.osd_stats: Dict[int, tuple] = {}
         self.autoscaler_active = False
+        self.health_checks: Dict[str, str] = {}
         for m in (all_mons if all_mons is not None else [self.mon]):
             m.subscribe(name)
         self.mon.send_full_map(name)
@@ -71,6 +74,9 @@ class Manager(Dispatcher):
                     # current primary's numbers
                     continue
                 self.pg_stats[(pool, ps)] = (msg.epoch, n_obj, n_bytes)
+            if msg.osd >= 0:
+                self.osd_stats[msg.osd] = (msg.store_bytes,
+                                           msg.store_capacity)
 
     # ---- balancer module ---------------------------------------------------
     def balancer_optimize(self, max_deviation: float = 0.01,
@@ -111,6 +117,80 @@ class Manager(Dispatcher):
             self.balancer_optimize()
         if self.autoscaler_active:
             self.pg_autoscale(apply=True)
+        self.check_quotas_and_fullness()
+
+    # ---- quota / full-ratio enforcement (the mon's PGMap-driven
+    # OSDMonitor::tick role, fed from mgr-side usage digests) --------------
+    def check_quotas_and_fullness(self) -> None:
+        from ..common.config import g_conf
+        from ..osdmap.osdmap import CEPH_OSDMAP_FULL, CEPH_OSDMAP_NEARFULL
+        from ..osdmap.types import FLAG_FULL, FLAG_FULL_QUOTA
+        mon = self.mon
+        if mon is None or not mon.is_leader():
+            # only the quorum leader's working map may be staged on —
+            # flags pushed at a peon would sit diverged until IT was
+            # elected, resurrecting stale state; skip and re-derive
+            # from fresh usage next tick instead
+            return
+        dirty = False
+        # pool quotas -> FLAG_FULL_QUOTA|FLAG_FULL (OSDMonitor
+        # check_pool_quota semantics: exceed -> full, clear -> unfull)
+        usage = self.pool_stats()
+        for pid, pool in self.osdmap.pools.items():
+            st = usage.get(pid, {"objects": 0, "bytes": 0})
+            over = ((pool.quota_max_objects and
+                     st["objects"] >= pool.quota_max_objects) or
+                    (pool.quota_max_bytes and
+                     st["bytes"] >= pool.quota_max_bytes))
+            if over:
+                dirty |= mon.set_pool_flags(
+                    pid, set_mask=FLAG_FULL | FLAG_FULL_QUOTA)
+            elif pool.has_flag(FLAG_FULL_QUOTA):
+                # only clear FULL we set ourselves (quota-driven)
+                dirty |= mon.set_pool_flags(
+                    pid, clear_mask=FLAG_FULL | FLAG_FULL_QUOTA)
+        # osd fill ratios -> cluster FULL/NEARFULL flags + health
+        full_r = float(g_conf.get_val("mon_osd_full_ratio") or 0.95)
+        near_r = float(g_conf.get_val("mon_osd_nearfull_ratio") or 0.85)
+        full_osds, near_osds = [], []
+        for osd, (used, cap) in self.osd_stats.items():
+            if not cap:
+                continue
+            if not self.osdmap.exists(osd) or not self.osdmap.is_up(osd):
+                # a dead/removed OSD's last report must not pin the
+                # cluster full forever; its data is re-placed anyway
+                continue
+            ratio = used / cap
+            if ratio >= full_r:
+                full_osds.append(osd)
+            elif ratio >= near_r:
+                near_osds.append(osd)
+        if full_osds:
+            dirty |= mon.set_cluster_flags(set_mask=CEPH_OSDMAP_FULL |
+                                           CEPH_OSDMAP_NEARFULL)
+            self.health_checks["OSD_FULL"] = (
+                f"osd(s) {sorted(full_osds)} are full; writes blocked")
+        else:
+            dirty |= mon.set_cluster_flags(clear_mask=CEPH_OSDMAP_FULL)
+            self.health_checks.pop("OSD_FULL", None)
+            if near_osds:
+                dirty |= mon.set_cluster_flags(
+                    set_mask=CEPH_OSDMAP_NEARFULL)
+                self.health_checks["OSD_NEARFULL"] = (
+                    f"osd(s) {sorted(near_osds)} are near full")
+            else:
+                dirty |= mon.set_cluster_flags(
+                    clear_mask=CEPH_OSDMAP_NEARFULL)
+                self.health_checks.pop("OSD_NEARFULL", None)
+        if dirty:
+            try:
+                mon.publish()
+            except RuntimeError:
+                # mid-election / not the leader: flags are staged on
+                # this mon's working map; the next elected leader's
+                # publish (or our next tick) lands them
+                return
+            self.network.pump()
 
     # ---- pg_autoscaler module ----------------------------------------------
     def pool_stats(self) -> Dict[int, Dict[str, int]]:
@@ -207,6 +287,8 @@ class Manager(Dispatcher):
             "num_pg_upmap_items": len(m.pg_upmap_items),
             "balancer_active": self.balancer_active,
             "last_optimize_result": self.last_optimize_result,
+            "osdmap_flags": m.flags,
+            "health_checks": dict(self.health_checks),
         }
 
     # ---- prometheus module -------------------------------------------------

@@ -807,12 +807,68 @@ class Monitor(Dispatcher):
             pool.removed_snaps.append(snapid)
             self._topology_dirty = True
 
+    # ---- pool quotas + full flags (OSDMonitor "osd pool set-quota",
+    # "osd set full"; flag values from osd_types.h:1148-1158) --------------
+    def set_pool_quota(self, pool_name: str, max_objects: int = 0,
+                       max_bytes: int = 0) -> None:
+        pid = self.osdmap.lookup_pg_pool_name(pool_name)
+        if pid < 0:
+            raise KeyError(f"no pool {pool_name!r}")
+        pool = self.osdmap.pools[pid]
+        pool.quota_max_objects = int(max_objects)
+        pool.quota_max_bytes = int(max_bytes)
+        self._topology_dirty = True
+
+    def set_pool_flags(self, pool_id: int, set_mask: int = 0,
+                       clear_mask: int = 0) -> bool:
+        """Set/clear pg_pool_t flags (the mgr drives FULL_QUOTA from
+        usage); returns whether anything changed."""
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            return False
+        new = (pool.flags | set_mask) & ~clear_mask
+        if new == pool.flags:
+            return False
+        pool.flags = new
+        self._topology_dirty = True
+        return True
+
+    def set_cluster_flags(self, set_mask: int = 0,
+                          clear_mask: int = 0) -> bool:
+        """Cluster-wide CEPH_OSDMAP_* flags (full/nearfull/pausewr)."""
+        new = (self.osdmap.flags | set_mask) & ~clear_mask
+        if new == self.osdmap.flags:
+            return False
+        self.osdmap.flags = new
+        self._topology_dirty = True
+        return True
+
+    def _maybe_remove_pg_upmaps(self) -> None:
+        """Drop upmap entries that reference deleted pools or
+        nonexistent OSDs (OSDMonitor::maybe_remove_pg_upmaps) — stale
+        entries would silently distort placement forever."""
+        m = self.osdmap
+
+        def stale(pg, osds) -> bool:
+            if pg.pool not in m.pools or pg.ps >= m.pools[pg.pool].pg_num:
+                return True
+            return any(o >= m.max_osd or not m.exists(o) for o in osds)
+
+        for pg in [pg for pg, v in m.pg_upmap.items() if stale(pg, v)]:
+            del m.pg_upmap[pg]
+            self._topology_dirty = True
+        for pg in [pg for pg, v in m.pg_upmap_items.items()
+                   if stale(pg, [o for pair in v for o in pair])]:
+            del m.pg_upmap_items[pg]
+            self._topology_dirty = True
+
     # ---- epoch publication -------------------------------------------------
     def _snapshot_inc(self) -> Incremental:
         """Full-state Incremental (crush/pools/osd states deep-copied so
         later mon mutations can't leak into published epochs)."""
         m = self.osdmap
         inc = Incremental()
+        inc.new_flags = m.flags
         inc.crush = copy.deepcopy(m.crush)
         inc.new_pools = copy.deepcopy(m.pools)
         inc.new_pool_names = dict(m.pool_name)
@@ -842,6 +898,7 @@ class Monitor(Dispatcher):
                 f"{self.name}: not the quorum leader "
                 f"(leader_rank={self.leader_rank}, quorum={self.quorum})")
         if self._topology_dirty:
+            self._maybe_remove_pg_upmaps()
             delta = inc
             inc = self._snapshot_inc()
             # the snapshot reads the WORKING map, which does not yet

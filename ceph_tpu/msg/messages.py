@@ -409,6 +409,10 @@ class MPGStats(Message):
     epoch: int = 0
     # [(pool, ps, num_objects, num_bytes)]
     pg_stats: List[Tuple[int, int, int, int]] = field(default_factory=list)
+    # osd_stat_t role: logical bytes stored on this OSD and its
+    # configured capacity (0 = unlimited) for full-ratio accounting
+    store_bytes: int = 0
+    store_capacity: int = 0
 
 
 @dataclass

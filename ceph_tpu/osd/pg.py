@@ -1683,6 +1683,25 @@ class PG:
                     tid=msg.tid, result=-11,
                     epoch=self.osd.osdmap.epoch))
                 return
+            # full gate (PrimaryLogPG.cc:7832-7842 check_full /
+            # osd_is_full): a FULL pool or cluster refuses mutations —
+            # EDQUOT when quota-driven, ENOSPC otherwise.  Deletes pass
+            # so users can free space (the reference's may-free-space
+            # carve-out).
+            deletes_only = (
+                all(o.op == CEPH_OSD_OP_DELETE for o in msg.ops)
+                if msg.ops else msg.op == CEPH_OSD_OP_DELETE)
+            if not deletes_only:
+                from ..osdmap.osdmap import CEPH_OSDMAP_FULL
+                from ..osdmap.types import FLAG_FULL, FLAG_FULL_QUOTA
+                if self.pool.has_flag(FLAG_FULL) or \
+                        (self.osd.osdmap.flags & CEPH_OSDMAP_FULL):
+                    res = -122 if self.pool.has_flag(FLAG_FULL_QUOTA) \
+                        else -28
+                    self.osd.send_op_reply(msg.src, MOSDOpReply(
+                        tid=msg.tid, result=res,
+                        epoch=self.osd.osdmap.epoch))
+                    return
         if msg.op == CEPH_OSD_OP_WATCH and not msg.ops:
             self._do_watch(msg)
             return

@@ -161,6 +161,9 @@ def pool_to_dict(p: pg_pool_t) -> Dict[str, Any]:
     d["removed_snaps"] = list(p.removed_snaps)
     if p.selfmanaged:
         d["selfmanaged"] = True
+    if p.quota_max_objects or p.quota_max_bytes:
+        d["quota_max_objects"] = p.quota_max_objects
+        d["quota_max_bytes"] = p.quota_max_bytes
     d["flags_versioned"] = True   # marks flags as post-ec_overwrites-gate
     return d
 
@@ -174,6 +177,8 @@ def pool_from_dict(d: Dict[str, Any]) -> pg_pool_t:
     p.snaps = {int(k): v for k, v in d.get("snaps", {}).items()}
     p.removed_snaps = [int(x) for x in d.get("removed_snaps", [])]
     p.selfmanaged = bool(d.get("selfmanaged", False))
+    p.quota_max_objects = int(d.get("quota_max_objects", 0))
+    p.quota_max_bytes = int(d.get("quota_max_bytes", 0))
     if p.is_erasure() and not d.get("flags_versioned"):
         # checkpoints written before the overwrites gate existed always
         # allowed rmw; restoring them must not break their workloads
@@ -194,6 +199,7 @@ def _pgid_from_key(s: str) -> pg_t:
 def osdmap_to_dict(m) -> Dict[str, Any]:
     return {
         "epoch": m.epoch,
+        "flags": m.flags,
         "max_osd": m.max_osd,
         "osd_state": list(m.osd_state),
         "osd_weight": list(m.osd_weight),
@@ -220,6 +226,7 @@ def osdmap_from_dict(d: Dict[str, Any]):
     from .osdmap import OSDMap
     m = OSDMap()
     m.epoch = d["epoch"]
+    m.flags = int(d.get("flags", 0))
     m.max_osd = d["max_osd"]
     m.osd_state = list(d["osd_state"])
     m.osd_weight = list(d["osd_weight"])

@@ -37,6 +37,7 @@ class Incremental:
     """Delta between epoch-1 and epoch (OSDMap.h:393-395 analog)."""
     epoch: int = 0
     new_max_osd: int = -1
+    new_flags: int = -1        # cluster CEPH_OSDMAP_* flags; -1 = keep
     new_pools: Dict[int, pg_pool_t] = field(default_factory=dict)
     new_pool_names: Dict[int, str] = field(default_factory=dict)
     old_pools: List[int] = field(default_factory=list)
@@ -67,9 +68,16 @@ class Incremental:
         field(default_factory=dict)
 
 
+# cluster-wide osdmap flags (include/rados.h:139-142)
+CEPH_OSDMAP_NEARFULL = 1 << 0
+CEPH_OSDMAP_FULL = 1 << 1
+CEPH_OSDMAP_PAUSEWR = 1 << 3
+
+
 class OSDMap:
     def __init__(self):
         self.epoch = 0
+        self.flags = 0
         self.max_osd = 0
         self.osd_state: List[int] = []
         self.osd_weight: List[int] = []
@@ -322,6 +330,8 @@ class OSDMap:
     def apply_incremental(self, inc: Incremental) -> None:
         assert inc.epoch == self.epoch + 1, (inc.epoch, self.epoch)
         self.epoch = inc.epoch
+        if inc.new_flags >= 0:
+            self.flags = inc.new_flags
         if inc.new_max_osd >= 0:
             self.set_max_osd(inc.new_max_osd)
         for pid in inc.old_pools:

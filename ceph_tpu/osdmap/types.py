@@ -21,6 +21,9 @@ TYPE_REPLICATED = PG_POOL_TYPE_REPLICATED
 TYPE_ERASURE = PG_POOL_TYPE_ERASURE
 
 FLAG_HASHPSPOOL = 1 << 0
+FLAG_FULL = 1 << 1            # pool is full (osd_types.h:1148)
+FLAG_FULL_QUOTA = 1 << 10     # full because quota exceeded (:1157)
+FLAG_NEARFULL = 1 << 11       # pool is nearfull (:1158)
 FLAG_EC_OVERWRITES = 1 << 17
 
 
@@ -63,6 +66,10 @@ class pg_pool_t:
     # pg_pool_t::is_unmanaged_snaps_mode, osd_types.h).  A pool commits to
     # one mode on first use; mixing is refused like the reference does.
     selfmanaged: bool = False
+    # pool quotas (pg_pool_t quota_max_*, "osd pool set-quota"): 0 =
+    # unlimited; the mgr sets FLAG_FULL_QUOTA|FLAG_FULL when exceeded
+    quota_max_objects: int = 0
+    quota_max_bytes: int = 0
 
     def live_snaps(self) -> set:
         """Snap ids that may still be referenced — the trim liveness

@@ -1,0 +1,118 @@
+"""Pool quotas, cluster full/nearfull gating, stale-upmap cleanup.
+
+Reference semantics: writes to a pool flagged FULL return EDQUOT when
+quota-driven and ENOSPC otherwise (PrimaryLogPG.cc:7832-7842); deletes
+pass so space can be freed; the mon drops upmap entries referencing
+dead pools/OSDs (OSDMonitor::maybe_remove_pg_upmaps).
+"""
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.osdmap.osdmap import CEPH_OSDMAP_FULL, CEPH_OSDMAP_NEARFULL
+from ceph_tpu.osdmap.types import FLAG_FULL, FLAG_FULL_QUOTA, pg_t
+
+
+def settle(c, n=3):
+    for _ in range(n):
+        c.tick(dt=1.0)
+
+
+def test_pool_quota_objects_edquot():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("q", size=2, pg_num=8)
+    cl = c.client("client.q")
+    c.mon.set_pool_quota("q", max_objects=3)
+    c.publish()
+    for i in range(3):
+        assert cl.write_full("q", f"o{i}", b"x" * 10) == 0
+    settle(c, 6)        # stats report (every 5th tick) + mgr reaction
+    pid = c.mon.osdmap.lookup_pg_pool_name("q")
+    assert c.mon.osdmap.pools[pid].has_flag(FLAG_FULL_QUOTA)
+    assert cl.write_full("q", "o3", b"x") == -122        # EDQUOT
+    # deletes pass (free space) and the quota clears after usage drops
+    assert cl.remove("q", "o0") == 0
+    assert cl.remove("q", "o1") == 0
+    settle(c, 6)
+    assert not c.mon.osdmap.pools[pid].has_flag(FLAG_FULL_QUOTA)
+    assert cl.write_full("q", "o4", b"x") == 0
+
+
+def test_pool_quota_bytes():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("q", size=2, pg_num=8)
+    cl = c.client("client.q")
+    c.mon.set_pool_quota("q", max_bytes=1000)
+    c.publish()
+    assert cl.write_full("q", "big", b"z" * 1200) == 0
+    settle(c, 6)
+    assert cl.write_full("q", "more", b"y") == -122
+    # reads still work on a quota-full pool
+    assert cl.read("q", "big")[:1] == b"z"
+
+
+def test_cluster_full_ratio_blocks_writes():
+    old = g_conf.get_val("osd_capacity_bytes")
+    g_conf.set_val("osd_capacity_bytes", 10_000)
+    try:
+        c = MiniCluster(n_osds=3)
+        c.create_replicated_pool("d", size=2, pg_num=8)
+        cl = c.client("client.f")
+        assert cl.write_full("d", "small", b"a" * 100) == 0
+        settle(c, 6)
+        assert not (c.mon.osdmap.flags & CEPH_OSDMAP_FULL)
+        # push one OSD past 95% of its 10k capacity
+        cl.write_full("d", "huge", b"b" * 20_000)
+        settle(c, 6)
+        assert c.mon.osdmap.flags & CEPH_OSDMAP_FULL
+        assert "OSD_FULL" in c.mgr.status()["health_checks"]
+        assert cl.write_full("d", "nope", b"c") == -28   # ENOSPC
+        assert cl.read("d", "small") == b"a" * 100       # reads fine
+        # deleting the hog clears the flag and unblocks writes
+        assert cl.remove("d", "huge") == 0
+        settle(c, 8)
+        assert not (c.mon.osdmap.flags & CEPH_OSDMAP_FULL)
+        assert cl.write_full("d", "ok-again", b"d") == 0
+    finally:
+        g_conf.set_val("osd_capacity_bytes", old)
+
+
+def test_nearfull_health_warning():
+    old = g_conf.get_val("osd_capacity_bytes")
+    g_conf.set_val("osd_capacity_bytes", 10_000)
+    try:
+        c = MiniCluster(n_osds=3)
+        c.create_replicated_pool("d", size=2, pg_num=8)
+        cl = c.client("client.n")
+        cl.write_full("d", "mid", b"m" * 9_000)          # ~90%: nearfull
+        settle(c, 6)
+        assert c.mon.osdmap.flags & CEPH_OSDMAP_NEARFULL
+        assert not (c.mon.osdmap.flags & CEPH_OSDMAP_FULL)
+        assert "OSD_NEARFULL" in c.mgr.status()["health_checks"]
+        assert cl.write_full("d", "still-ok", b"x") == 0  # warn, not block
+    finally:
+        g_conf.set_val("osd_capacity_bytes", old)
+
+
+def test_stale_upmaps_removed():
+    c = MiniCluster(n_osds=5)
+    c.create_replicated_pool("u", size=2, pg_num=8)
+    pid = c.mon.osdmap.lookup_pg_pool_name("u")
+    # a valid upmap entry survives publishes
+    c.mon.osdmap.pg_upmap_items[pg_t(pid, 1)] = [(0, 3)]
+    c.mon._topology_dirty = True
+    c.publish()
+    assert pg_t(pid, 1) in c.mon.osdmap.pg_upmap_items
+    # an entry citing a nonexistent OSD is dropped at the next publish
+    c.mon.osdmap.pg_upmap_items[pg_t(pid, 2)] = [(0, 97)]
+    c.mon.osdmap.pg_upmap[pg_t(pid, 3)] = [98, 99]
+    c.mon._topology_dirty = True
+    c.publish()
+    assert pg_t(pid, 2) not in c.mon.osdmap.pg_upmap_items
+    assert pg_t(pid, 3) not in c.mon.osdmap.pg_upmap
+    assert pg_t(pid, 1) in c.mon.osdmap.pg_upmap_items
+    # entries for a deleted pool's pgs go too
+    c.mon.osdmap.pg_upmap_items[pg_t(pid + 77, 0)] = [(0, 1)]
+    c.mon._topology_dirty = True
+    c.publish()
+    assert pg_t(pid + 77, 0) not in c.mon.osdmap.pg_upmap_items
