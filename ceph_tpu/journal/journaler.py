@@ -70,8 +70,12 @@ class Journaler:
                               "splay_width": splay_width})
         self.open()
 
+    def get_metadata(self) -> dict:
+        """Decoded journal metadata (shape, watermarks, clients)."""
+        return json.loads(self._exec("get_metadata"))
+
     def open(self) -> dict:
-        md = json.loads(self._exec("get_metadata"))
+        md = self.get_metadata()
         self.order = md["order"]
         self.splay = md["splay_width"]
         self._next_tid = self._scan_next_tid(md)
@@ -148,7 +152,7 @@ class Journaler:
         after ``after_tid`` (JournalPlayer's committed-position replay).
         Stops at the first gap — entries past a torn/missing tid are
         not safe to apply in order."""
-        md = json.loads(self._exec("get_metadata"))
+        md = self.get_metadata()
         entries = {}
         for oset in range(md["minimum_set"], md["active_set"] + 1):
             for s in range(self.splay):
@@ -175,7 +179,7 @@ class Journaler:
     def committed_tid(self) -> int:
         """min over registered clients (nothing may be trimmed past the
         slowest consumer)."""
-        md = json.loads(self._exec("get_metadata"))
+        md = self.get_metadata()
         if not md["clients"]:
             return -1
         return min(c["commit_tid"] for c in md["clients"].values())
@@ -183,7 +187,7 @@ class Journaler:
     def trim(self) -> int:
         """Delete object sets wholly below every client's commit
         position; returns the new minimum set."""
-        md = json.loads(self._exec("get_metadata"))
+        md = self.get_metadata()
         safe_tid = self.committed_tid()
         eps = self._entries_per_set()
         # a set is trimmable when its LAST tid is committed
@@ -197,7 +201,7 @@ class Journaler:
         return new_min
 
     def remove(self) -> None:
-        md = json.loads(self._exec("get_metadata"))
+        md = self.get_metadata()
         for oset in range(md["minimum_set"], md["active_set"] + 1):
             for s in range(self.splay):
                 self.client.remove(self.pool,

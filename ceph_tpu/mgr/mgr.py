@@ -165,6 +165,8 @@ class Manager(Dispatcher):
                 full_osds.append(osd)
             elif ratio >= near_r:
                 near_osds.append(osd)
+        # both health entries are recomputed every pass so neither can
+        # go stale while the other branch is active
         if full_osds:
             dirty |= mon.set_cluster_flags(set_mask=CEPH_OSDMAP_FULL |
                                            CEPH_OSDMAP_NEARFULL)
@@ -173,15 +175,17 @@ class Manager(Dispatcher):
         else:
             dirty |= mon.set_cluster_flags(clear_mask=CEPH_OSDMAP_FULL)
             self.health_checks.pop("OSD_FULL", None)
-            if near_osds:
+        if near_osds:
+            if not full_osds:
                 dirty |= mon.set_cluster_flags(
                     set_mask=CEPH_OSDMAP_NEARFULL)
-                self.health_checks["OSD_NEARFULL"] = (
-                    f"osd(s) {sorted(near_osds)} are near full")
-            else:
+            self.health_checks["OSD_NEARFULL"] = (
+                f"osd(s) {sorted(near_osds)} are near full")
+        else:
+            self.health_checks.pop("OSD_NEARFULL", None)
+            if not full_osds:
                 dirty |= mon.set_cluster_flags(
                     clear_mask=CEPH_OSDMAP_NEARFULL)
-                self.health_checks.pop("OSD_NEARFULL", None)
         if dirty:
             try:
                 mon.publish()

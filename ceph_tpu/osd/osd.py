@@ -726,19 +726,19 @@ class OSD(Dispatcher):
                     else:
                         n_bytes += self.store.stat(cid, ho)
             stats.append((pgid[0], pgid[1], n_obj, n_bytes))
-        if stats:
-            # osd_stat_t role: total logical bytes on this OSD (every
-            # PG's share, primary or not would double-count across the
-            # cluster, so the mgr divides by replication when it needs
-            # raw usage; for full-ratio purposes the primary-logical
-            # total against the configured capacity is the signal)
-            from ..common.config import g_conf
-            capacity = int(g_conf.get_val("osd_capacity_bytes") or 0)
-            total = sum(b for (_p, _s, _o, b) in stats)
-            self.messenger.send_message(MPGStats(
-                osd=self.osd_id, epoch=self.osdmap.epoch,
-                pg_stats=stats, store_bytes=total,
-                store_capacity=capacity), mgr_name)
+        # osd_stat_t role: total logical bytes on this OSD's primary
+        # PGs against the configured capacity.  Sent even when the
+        # stats list is empty — an OSD whose primaries all moved away
+        # must not leave its last (possibly full) usage pinned at the
+        # mgr.  Replica-only bytes are invisible to this logical
+        # accounting — a known lite-ism.
+        from ..common.config import g_conf
+        capacity = int(g_conf.get_val("osd_capacity_bytes") or 0)
+        total = sum(b for (_p, _s, _o, b) in stats)
+        self.messenger.send_message(MPGStats(
+            osd=self.osd_id, epoch=self.osdmap.epoch,
+            pg_stats=stats, store_bytes=total,
+            store_capacity=capacity), mgr_name)
 
     def clog(self, level: str, message: str) -> None:
         """Send a cluster-log entry to the mons (clog->error()/info()

@@ -5,6 +5,8 @@ the process can execute header methods, mirroring how the reference
 loads libcls_rbd.so into every OSD.
 """
 from . import cls_rbd  # noqa: F401  (registers the cls methods)
-from .image import Image, RBD, RBDError
+from .image import Image, RBD, RBDError, apply_image_event
+from .mirror import ImageMirror
 
-__all__ = ["Image", "RBD", "RBDError"]
+__all__ = ["Image", "ImageMirror", "RBD", "RBDError",
+           "apply_image_event"]

@@ -63,6 +63,8 @@ def _create(ctx: ClsContext, inp: bytes):
         # image data lives in a separate (typically EC) pool while the
         # header stays omap-capable (librbd RBD_FEATURE_DATA_POOL)
         kv["data_pool"] = str(req["data_pool"])
+    if req.get("journaling"):
+        kv["journaling"] = "1"     # RBD_FEATURE_JOURNALING
     ctx.omap_set(kv)
     return 0, b""
 
@@ -81,6 +83,8 @@ def _get_image(ctx: ClsContext, inp: bytes):
     }
     if "data_pool" in om:
         out["data_pool"] = om["data_pool"].decode()
+    if "journaling" in om:
+        out["journaling"] = True
     return 0, _j(out)
 
 

@@ -62,13 +62,16 @@ class RBD:
         return out
 
     def create(self, pool: str, name: str, size: int,
-               order: int = 22, data_pool: str = None) -> str:
+               order: int = 22, data_pool: str = None,
+               journaling: bool = False) -> str:
         """Create an image; returns its id (librbd::RBD::create).
 
         ``data_pool`` puts the data objects in a separate — typically
         erasure-coded — pool while the header/directory stay in the
         omap-capable base pool (librbd RBD_FEATURE_DATA_POOL; EC pools
-        cannot hold omap, in the reference or here)."""
+        cannot hold omap, in the reference or here).  ``journaling``
+        enables the write-ahead image journal (RBD_FEATURE_JOURNALING)
+        that rbd-mirror replays."""
         if size < 0 or not (12 <= order <= 26):
             raise RBDError("create", -22)
         iid = uuid.uuid4().hex[:12]
@@ -78,11 +81,17 @@ class RBD:
             self._exec(pool, RBD_HEADER_PREFIX + iid, "create",
                        {"size": size, "order": order,
                         "object_prefix": RBD_DATA_PREFIX + iid,
-                        "data_pool": data_pool})
+                        "data_pool": data_pool,
+                        "journaling": journaling})
         except RBDError:
             self._exec(pool, RBD_DIRECTORY, "dir_remove_image",
                        {"name": name, "id": iid})
             raise
+        if journaling:
+            from ..journal import Journaler
+            jr = Journaler(self.client, pool, iid)
+            jr.create(order=order, splay_width=4)
+            jr.register_client("local")     # the primary's own replay
         return iid
 
     def list(self, pool: str) -> List[str]:
@@ -172,6 +181,8 @@ class Image:
         self.object_size = 1 << meta["order"]
         self.object_prefix = meta["object_prefix"]
         self.data_pool = meta.get("data_pool") or self.pool
+        self.journaling = bool(meta.get("journaling"))
+        self._journal = None
         self.read_snap: Optional[int] = None
         self._parent_link = self._fetch_parent()
         self._parent_handle: Optional["Image"] = None
@@ -199,6 +210,48 @@ class Image:
         mutation (ImageCtx::snapc -> ioctx write ctx)."""
         seq, snaps = self._snapcontext()
         self.client.set_write_ctx(self.data_pool, seq, list(snaps))
+
+    def journal(self):
+        """The image's write-ahead journal (librbd::Journal), lazily
+        opened; None when the feature is off."""
+        if not self.journaling:
+            return None
+        if self._journal is None:
+            from ..journal import Journaler
+            self._journal = Journaler(self.client, self.pool, self.id)
+            self._journal.open()
+        return self._journal
+
+    def _journal_event(self, event: Dict) -> None:
+        """Append one mutation event BEFORE applying it (write-ahead,
+        librbd::Journal::append_io_event): a crash between append and
+        apply is healed by replay_local(), and rbd-mirror replays the
+        same stream remotely."""
+        jr = self.journal()
+        if jr is not None:
+            jr.append(_j(event))
+
+    def _journal_commit_applied(self) -> None:
+        jr = self.journal()
+        if jr is not None:
+            jr.commit("local", jr._next_tid - 1)
+
+    def replay_local(self) -> int:
+        """Re-apply journal events past the local commit position (the
+        primary's crash-recovery replay, librbd::Journal::replay).
+        Events are idempotent (absolute offsets/extents), so re-applying
+        an already-applied tail is safe.  Returns events replayed."""
+        jr = self.journal()
+        if jr is None:
+            return 0
+        md = jr.get_metadata()
+        pos = md["clients"].get("local", {}).get("commit_tid", -1)
+        n = 0
+        for tid, payload in jr.replay(after_tid=pos):
+            apply_image_event(self, json.loads(payload))
+            jr.commit("local", tid)
+            n += 1
+        return n
 
     def parent(self) -> Optional[Tuple[str, str, int, int]]:
         return self._parent_link
@@ -312,6 +365,11 @@ class Image:
         end = self.size()
         if offset + len(data) > end:
             raise RBDError("write", -22)
+        if self.journaling:
+            import base64
+            self._journal_event({
+                "op": "write", "offset": offset,
+                "data": base64.b64encode(data).decode()})
         self._apply_write_ctx()
         pos = 0
         has_parent = self.parent() is not None
@@ -329,6 +387,7 @@ class Image:
                 r = self.client.write(self.data_pool, oid, piece, off)
             if r < 0:
                 raise RBDError("write", r)
+        self._journal_commit_applied()
         return len(data)
 
     def _needs_copyup(self, objno: int) -> bool:
@@ -374,6 +433,9 @@ class Image:
         turns such discards into truncate/zero whiteouts)."""
         if self.read_snap is not None:
             raise RBDError("discard", -30)
+        if self.journaling:
+            self._journal_event({"op": "discard", "offset": offset,
+                                 "length": length})
         self._apply_write_ctx()
         p = self.parent()
         overlap = p[3] if p else 0
@@ -396,6 +458,7 @@ class Image:
                 r = self.client.zero(self.data_pool, oid, off, ln)
             if r < 0 and r != -2:
                 raise RBDError("discard", r)
+        self._journal_commit_applied()
 
     def resize(self, new_size: int) -> None:
         """Grow adjusts metadata only (sparse); shrink removes/truncates
@@ -403,6 +466,8 @@ class Image:
         old = self.size()
         if self.read_snap is not None:
             raise RBDError("resize", -30)
+        if self.journaling:
+            self._journal_event({"op": "resize", "size": new_size})
         if new_size < old:
             self._apply_write_ctx()
             keep_objs = self._objects_in(new_size)
@@ -421,19 +486,26 @@ class Image:
                            parse=False)
                 self._parent_link = self._fetch_parent()
         self._call("set_size", {"size": new_size}, parse=False)
+        self._journal_commit_applied()
 
     # ---- snapshots --------------------------------------------------------
     def snap_create(self, name: str) -> int:
+        if self.journaling:
+            self._journal_event({"op": "snap_create", "name": name})
         sid = self.client.selfmanaged_snap_create(self.data_pool)
         self._call("snapshot_add",
                    {"snapid": sid, "name": name, "size": self.size()},
                    parse=False)
+        self._journal_commit_applied()
         return sid
 
     def snap_remove(self, name: str) -> None:
         sid, info = self._snap_by_name(name)
+        if self.journaling:
+            self._journal_event({"op": "snap_remove", "name": name})
         self._call("snapshot_remove", {"snapid": sid}, parse=False)
         self.client.selfmanaged_snap_remove(self.data_pool, sid)
+        self._journal_commit_applied()
 
     def snap_list(self) -> Dict[str, Dict]:
         return {info["name"]: dict(info, id=sid)
@@ -517,3 +589,33 @@ class Image:
                 "num_objs": self._objects_in(meta["size"]),
                 "parent": self.parent(),
                 "snaps": sorted(self.snap_list())}
+
+
+def apply_image_event(img: "Image", event: Dict) -> None:
+    """Apply one journal event to an image (the librbd journal Replay
+    handler's op table).  Events carry absolute extents, so re-applying
+    is idempotent; journaling is suppressed on the target handle to
+    avoid re-journaling replayed ops."""
+    import base64
+    was = img.journaling
+    img.journaling = False          # never re-journal a replay
+    try:
+        op = event["op"]
+        if op == "write":
+            data = base64.b64decode(event["data"])
+            end = event["offset"] + len(data)
+            if end > img.size():
+                img.resize(end)
+            img.write(event["offset"], data)
+        elif op == "discard":
+            img.discard(event["offset"], event["length"])
+        elif op == "resize":
+            img.resize(event["size"])
+        elif op == "snap_create":
+            if event["name"] not in img.snap_list():
+                img.snap_create(event["name"])
+        elif op == "snap_remove":
+            if event["name"] in img.snap_list():
+                img.snap_remove(event["name"])
+    finally:
+        img.journaling = was
