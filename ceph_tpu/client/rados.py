@@ -283,6 +283,50 @@ class RadosClient(Dispatcher):
                          snapid=snapid)
         return r.result, list(r.op_results)
 
+    def _submit_to_pg(self, pgid, op: str, data: bytes = b"",
+                      length: int = 0) -> MOSDOpReply:
+        """Send a PG-targeted op (no object) to the PG's primary with
+        the same refresh-and-resend loop as _submit."""
+        for attempt in range(MAX_ATTEMPTS):
+            *_, acting, primary = self.osdmap.pg_to_up_acting_osds(
+                pg_t(*pgid))
+            self._tid += 1
+            tid = self._tid
+            if primary >= 0:
+                self.messenger.send_message(MOSDOp(
+                    tid=tid, pool=pgid[0], pgid=tuple(pgid), op=op,
+                    data=data, length=length, epoch=self.osdmap.epoch,
+                    trace_id=new_trace_id()), f"osd.{primary}")
+                self.network.pump()
+            reply = self._replies.pop(tid, None)
+            if reply is not None and reply.result >= 0:
+                return reply
+            self.mon.send_full_map(self.name)
+            self.network.pump()
+        return reply if reply is not None else \
+            MOSDOpReply(tid=tid, result=-110)
+
+    def list_objects(self, pool: str, page: int = 512):
+        """Iterate every head object in the pool (rados_nobjects_list):
+        a PGLS op per PG with cursor pagination, like the Objecter's
+        pg-targeted listing ops (PrimaryLogPG do_pg_op PGNLS)."""
+        from ..msg.messages import CEPH_OSD_OP_PGLS
+        pid = self.lookup_pool(pool)
+        p = self.osdmap.get_pg_pool(pid)
+        for ps in range(p.pg_num):
+            cursor = b""
+            while True:
+                reply = self._submit_to_pg((pid, ps), CEPH_OSD_OP_PGLS,
+                                           data=cursor, length=page)
+                if reply.result < 0:
+                    raise _ioerror("pgls", f"{pid}.{ps}", reply.result)
+                names = (reply.data.decode().split("\n")
+                         if reply.data else [])
+                yield from names
+                if reply.result != 1:       # no more pages in this PG
+                    break
+                cursor = names[-1].encode()
+
     def lookup_pool(self, name: str) -> int:
         pid = self.osdmap.lookup_pg_pool_name(name)
         if pid < 0:

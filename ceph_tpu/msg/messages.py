@@ -60,6 +60,9 @@ CEPH_OSD_OP_ASSERT_VER = "assert_ver"  # guard: object version == offset
 CEPH_OSD_OP_WATCH = "watch"          # register interest (cookie in offset)
 CEPH_OSD_OP_UNWATCH = "unwatch"
 CEPH_OSD_OP_NOTIFY = "notify"        # broadcast to watchers, await acks
+CEPH_OSD_OP_PGLS = "pgls"            # list this PG's head objects
+                                     # (CEPH_OSD_OP_PGNLS; data = cursor,
+                                     # length = max entries)
 
 # cmpxattr comparison operators (include/rados.h CEPH_OSD_CMPXATTR_OP_*)
 CEPH_OSD_CMPXATTR_OP_EQ = 1

@@ -707,11 +707,7 @@ class OSD(Dispatcher):
         for pgid, pg in self.pgs.items():
             if not pg.is_primary():
                 continue
-            if pg.backend is not None:
-                shard = pg.my_shard()
-                cids = [pg.backend.shard_cid(shard)] if shard >= 0 else []
-            else:
-                cids = [pg.rep_backend.cid()]
+            cids = pg.data_cids()
             n_obj = n_bytes = 0
             for cid in cids:
                 if not self.store.collection_exists(cid):

@@ -1649,6 +1649,11 @@ class PG:
                 tid=msg.tid, result=-11,  # EAGAIN: wrong primary / not ready
                 epoch=self.osd.osdmap.epoch))
             return
+        from ..msg.messages import CEPH_OSD_OP_PGLS as _PGLS
+        if msg.op == _PGLS and not msg.ops:
+            # pg-targeted op: no object to misdirect-check
+            self._do_pgls(msg)
+            return
         cur_pool = self.osd.osdmap.pools.get(self.pgid[0])
         if cur_pool is not None:
             actual = cur_pool.raw_pg_to_pg(
@@ -2546,6 +2551,40 @@ class PG:
             msg = copy.copy(msg)
             msg.oid = target
         return msg
+
+    def data_cids(self) -> List[str]:
+        """The store collections holding this PG's objects on THIS OSD
+        (one shard cid on EC pools, the replica cid otherwise) — shared
+        by listing and stats reporting."""
+        if self.backend is not None:
+            shard = self.my_shard()
+            return [self.backend.shard_cid(shard)] if shard >= 0 else []
+        return [self.rep_backend.cid()]
+
+    def _do_pgls(self, msg: MOSDOp) -> None:
+        """List this PG's head objects (PrimaryLogPG do_pg_op
+        CEPH_OSD_OP_PGNLS): cursor = last name already returned
+        (msg.data), page size = msg.length (0 = everything).  Clones
+        and PG-internal metadata never appear; the reply data is the
+        newline-joined page and result carries 1 when more remain."""
+        store = self.osd.store
+        names = set()
+        for cid in self.data_cids():
+            if not store.collection_exists(cid):
+                continue
+            for ho in store.list_objects(cid):
+                if ho.oid == PG_META_OID or self.is_clone_oid(ho.oid):
+                    continue
+                names.add(ho.oid)
+        cursor = msg.data.decode() if msg.data else ""
+        page = sorted(n for n in names if n > cursor)
+        more = 0
+        if msg.length and len(page) > msg.length:
+            page = page[:msg.length]
+            more = 1
+        self.osd.send_op_reply(msg.src, MOSDOpReply(
+            tid=msg.tid, result=more, epoch=self.osd.osdmap.epoch,
+            data="\n".join(page).encode()))
 
     def _do_read(self, msg: MOSDOp) -> None:
         msg = self._snap_redirect(msg)

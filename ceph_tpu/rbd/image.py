@@ -17,9 +17,9 @@ reimplements that core on the framework's own primitives:
   copy-up the parent object first (AbstractObjectWriteRequest copyup).
 - flatten/resize/rollback mirror Operations.cc semantics at lite scale.
 
-Scope-outs vs the reference: exclusive locking, the image journal +
-mirroring, object-map/fast-diff feature bits, and the qemu block driver
-surface.
+The write-ahead image journal + mirroring live in ``mirror.py`` /
+``ceph_tpu.journal``.  Scope-outs vs the reference: exclusive locking,
+object-map/fast-diff feature bits, and the qemu block driver surface.
 """
 from __future__ import annotations
 
@@ -89,9 +89,18 @@ class RBD:
             raise
         if journaling:
             from ..journal import Journaler
-            jr = Journaler(self.client, pool, iid)
-            jr.create(order=order, splay_width=4)
-            jr.register_client("local")     # the primary's own replay
+            try:
+                jr = Journaler(self.client, pool, iid)
+                jr.create(order=order, splay_width=4)
+                jr.register_client("local")  # the primary's own replay
+            except Exception as e:
+                # roll the half-created image back out — a registered
+                # image whose journal never materialized would fail
+                # every mutation with no visible defect in list()
+                self.client.remove(pool, RBD_HEADER_PREFIX + iid)
+                self._exec(pool, RBD_DIRECTORY, "dir_remove_image",
+                           {"name": name, "id": iid})
+                raise RBDError("create journal", -5) from e
         return iid
 
     def list(self, pool: str) -> List[str]:
@@ -229,12 +238,28 @@ class Image:
         same stream remotely."""
         jr = self.journal()
         if jr is not None:
-            jr.append(_j(event))
+            if getattr(self, "_applied_tid", None) is not None:
+                # a previous append never reached commit (its apply
+                # failed mid-op): heal it first, or committing this
+                # op's later tid would bury the unapplied event forever
+                # (commit is monotonic) while the mirror still replays
+                # it — local/remote divergence
+                self.replay_local()
+            self._applied_tid = jr.append(_j(event))
 
     def _journal_commit_applied(self) -> None:
+        """Commit exactly the tid of the event this op appended — NOT
+        the journal head: if an earlier op failed between append and
+        apply, advancing past its tid would stop replay_local() from
+        ever healing it (commit never regresses, so re-committing an
+        older tid after such a failure is a no-op, which is correct:
+        the unapplied event stays below the next replay window only if
+        we never skip it)."""
         jr = self.journal()
-        if jr is not None:
-            jr.commit("local", jr._next_tid - 1)
+        tid = getattr(self, "_applied_tid", None)
+        if jr is not None and tid is not None:
+            jr.commit("local", tid)
+            self._applied_tid = None
 
     def replay_local(self) -> int:
         """Re-apply journal events past the local commit position (the
@@ -251,6 +276,7 @@ class Image:
             apply_image_event(self, json.loads(payload))
             jr.commit("local", tid)
             n += 1
+        self._applied_tid = None     # nothing outstanding after a heal
         return n
 
     def parent(self) -> Optional[Tuple[str, str, int, int]]:

@@ -44,19 +44,9 @@ def main(argv=None) -> int:
                       f"\tsnaps={len(pool.snaps)}")
         elif v == "ls":
             (pool,) = rest
-            oids = set()
-            pid = cl.lookup_pool(pool)
-            prefix = f"{pid}."
-            for osd in c.osds.values():
-                for cid in osd.store.list_collections():
-                    if not cid.startswith(prefix) or \
-                            cid.endswith("_meta"):
-                        continue
-                    for ho in osd.store.list_objects(cid):
-                        if "\x00" not in ho.oid and \
-                                not ho.oid.startswith("_"):
-                            oids.add(ho.oid)
-            for o in sorted(oids):
+            # real client listing (rados_nobjects_list -> PGLS ops per
+            # PG), not a store scan — exactly what the reference CLI does
+            for o in sorted(cl.list_objects(pool)):
                 print(o)
         elif v == "put":
             pool, oid, path = rest

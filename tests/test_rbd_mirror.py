@@ -114,6 +114,29 @@ def test_local_crash_replay(pair):
     assert reopened.replay_local() == 0                   # idempotent
 
 
+def test_failed_apply_healed_before_next_event(pair):
+    """An event journaled but never applied (apply failed mid-op) must
+    be healed before a LATER op commits a higher tid — commit is
+    monotonic, so skipping it would diverge from the mirror forever."""
+    a, b, ca, cb = pair
+    src = Image(ca, "rbd", "img")
+    src.write(0, b"base")
+    import base64
+    # simulate the crash window: append without applying
+    src._journal_event({"op": "write", "offset": 200,
+                        "data": base64.b64encode(b"ORPHAN").decode()})
+    # the next op on the same handle heals the orphan first
+    src.write(300, b"later")
+    assert src.read(200, 6) == b"ORPHAN"
+    assert src.read(300, 5) == b"later"
+    # and the mirror sees both, in order
+    m = ImageMirror(ca, "rbd", "img", cb, "rbd")
+    m.run_once()
+    dst = Image(cb, "rbd", "img")
+    assert dst.read(200, 6) == b"ORPHAN"
+    assert dst.read(300, 5) == b"later"
+
+
 def test_mirror_requires_journaling(pair):
     a, b, ca, cb = pair
     RBD(ca).create("rbd", "plain", OBJ, ORDER)
