@@ -299,7 +299,7 @@ class RadosClient(Dispatcher):
                     trace_id=new_trace_id()), f"osd.{primary}")
                 self.network.pump()
             reply = self._replies.pop(tid, None)
-            if reply is not None and reply.result >= 0:
+            if reply is not None and reply.result != -11:
                 return reply
             self.mon.send_full_map(self.name)
             self.network.pump()
@@ -320,8 +320,8 @@ class RadosClient(Dispatcher):
                                            data=cursor, length=page)
                 if reply.result < 0:
                     raise _ioerror("pgls", f"{pid}.{ps}", reply.result)
-                names = (reply.data.decode().split("\n")
-                         if reply.data else [])
+                import json as _json
+                names = _json.loads(reply.data) if reply.data else []
                 yield from names
                 if reply.result != 1:       # no more pages in this PG
                     break

@@ -379,5 +379,12 @@ class MiniCluster:
         if self.mon.osdmap.pg_temp:
             reasons.append(
                 f"{len(self.mon.osdmap.pg_temp)} pgs remapped (pg_temp)")
+        from .osdmap.osdmap import CEPH_OSDMAP_FULL, CEPH_OSDMAP_NEARFULL
+        if self.mon.osdmap.flags & CEPH_OSDMAP_FULL:
+            reasons.append("cluster is FULL; writes blocked")
+        elif self.mon.osdmap.flags & CEPH_OSDMAP_NEARFULL:
+            reasons.append("cluster is nearfull")
+        for check, msg in sorted(self.mgr.health_checks.items()):
+            reasons.append(f"{check}: {msg}")
         return "HEALTH_OK" if not reasons else \
             "HEALTH_WARN " + "; ".join(reasons)

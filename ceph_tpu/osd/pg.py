@@ -2565,26 +2565,43 @@ class PG:
         """List this PG's head objects (PrimaryLogPG do_pg_op
         CEPH_OSD_OP_PGNLS): cursor = last name already returned
         (msg.data), page size = msg.length (0 = everything).  Clones
-        and PG-internal metadata never appear; the reply data is the
-        newline-joined page and result carries 1 when more remain."""
+        and PG-internal metadata never appear; objects the primary
+        knows about but has not recovered yet DO (the reference merges
+        the missing set the same way, so a listing taken mid-recovery
+        is complete).  The page ships as JSON (names may contain any
+        byte); result carries 1 when more remain."""
+        import heapq
+        import json as _json
         store = self.osd.store
+        cursor = msg.data.decode() if msg.data else ""
         names = set()
         for cid in self.data_cids():
             if not store.collection_exists(cid):
                 continue
             for ho in store.list_objects(cid):
-                if ho.oid == PG_META_OID or self.is_clone_oid(ho.oid):
+                if ho.oid == PG_META_OID or self.is_clone_oid(ho.oid) \
+                        or ho.oid <= cursor:
                     continue
                 names.add(ho.oid)
-        cursor = msg.data.decode() if msg.data else ""
-        page = sorted(n for n in names if n > cursor)
-        more = 0
-        if msg.length and len(page) > msg.length:
+        # merge known-but-unrecovered objects (do_pgnls missing merge)
+        if self.backend is not None:
+            for per_shard in self.missing.values():
+                for oid in per_shard:
+                    if not self.is_clone_oid(oid) and oid > cursor:
+                        names.add(oid)
+        else:
+            for oid in self.local_missing:
+                if not self.is_clone_oid(oid) and oid > cursor:
+                    names.add(oid)
+        if msg.length:
+            page = heapq.nsmallest(msg.length + 1, names)
+            more = 1 if len(page) > msg.length else 0
             page = page[:msg.length]
-            more = 1
+        else:
+            page, more = sorted(names), 0
         self.osd.send_op_reply(msg.src, MOSDOpReply(
             tid=msg.tid, result=more, epoch=self.osd.osdmap.epoch,
-            data="\n".join(page).encode()))
+            data=_json.dumps(page).encode()))
 
     def _do_read(self, msg: MOSDOp) -> None:
         msg = self._snap_redirect(msg)

@@ -97,6 +97,10 @@ class RBD:
                 # roll the half-created image back out — a registered
                 # image whose journal never materialized would fail
                 # every mutation with no visible defect in list()
+                try:
+                    jr.remove()           # any journal objects written
+                except Exception:
+                    pass
                 self.client.remove(pool, RBD_HEADER_PREFIX + iid)
                 self._exec(pool, RBD_DIRECTORY, "dir_remove_image",
                            {"name": name, "id": iid})
@@ -133,6 +137,14 @@ class RBD:
         self.client.set_write_ctx(img.data_pool, 0, [])
         for objno in range(img._objects_in(img.size())):
             self.client.remove(img.data_pool, img._obj(objno))
+        if img.journaling:
+            from ..journal import Journaler
+            jr = Journaler(self.client, pool, img.id)
+            try:
+                jr.open()
+                jr.remove()
+            except Exception:
+                pass                  # journal already gone: fine
         self.client.remove(pool, RBD_HEADER_PREFIX + img.id)
         self._exec(pool, RBD_DIRECTORY, "dir_remove_image",
                    {"name": name, "id": img.id})

@@ -87,6 +87,15 @@ class RGWLite:
             raise RGWError("get_user", -2)
         return u
 
+    def delete_user(self, uid: str) -> None:
+        """Remove a user (radosgw-admin user rm): refused while the
+        user still owns buckets."""
+        u = self.get_user(uid)
+        if u["buckets"]:
+            raise RGWError("delete_user", -39, "user owns buckets")
+        self.client.remove(self.mpool, f"user.{uid}")
+        self._meta_index(f"user.{uid}", False)
+
     def user_by_access_key(self, access_key: str) -> Optional[Dict]:
         # lite linear scan (the reference keeps a key->uid index object)
         for oid in self._meta_list("user."):

@@ -26,6 +26,7 @@ def run(cluster, client, argv) -> int:
     s.add_argument("--size", type=int, required=True)
     s.add_argument("--order", type=int, default=22)
     s.add_argument("--data-pool", default=None)
+    s.add_argument("--journaling", action="store_true")
     sub.add_parser("ls")
     s = sub.add_parser("info")
     s.add_argument("image")
@@ -56,7 +57,8 @@ def run(cluster, client, argv) -> int:
     pool = args.pool
     if args.cmd == "create":
         rbd.create(pool, args.image, args.size, args.order,
-                   data_pool=args.data_pool)
+                   data_pool=args.data_pool,
+                   journaling=args.journaling)
     elif args.cmd == "ls":
         print("\n".join(rbd.list(pool)))
     elif args.cmd == "info":

@@ -1,0 +1,83 @@
+"""radosgw-admin CLI (src/rgw/rgw_admin.cc in the reference): user and
+bucket administration against a MiniCluster checkpoint.
+
+Verbs mirror the reference's common surface: user create/info/rm/list,
+bucket list/stats/rm, and object listing within a bucket.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..rgw import RGWError, RGWLite
+
+
+def run(cluster, client, argv, meta_pool: str = "rgwmeta",
+        data_pool: str = "rgwdata") -> int:
+    ap = argparse.ArgumentParser(prog="radosgw-admin")
+    ap.add_argument("--meta-pool", default=meta_pool)
+    ap.add_argument("--data-pool", default=data_pool)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("user")
+    s.add_argument("verb", choices=["create", "info", "rm", "list"])
+    s.add_argument("--uid", default=None)
+    s.add_argument("--display-name", default="")
+    s = sub.add_parser("bucket")
+    s.add_argument("verb", choices=["list", "stats", "rm"])
+    s.add_argument("--bucket", default=None)
+    s.add_argument("--uid", default=None)
+    args = ap.parse_args(argv)
+
+    g = RGWLite(client, args.meta_pool, args.data_pool)
+    out = sys.stdout
+    if args.cmd == "user":
+        if args.verb == "create":
+            u = g.create_user(args.uid, args.display_name)
+            json.dump(u, out, indent=2, sort_keys=True)
+            print(file=out)
+        elif args.verb == "info":
+            json.dump(g.get_user(args.uid), out, indent=2,
+                      sort_keys=True)
+            print(file=out)
+        elif args.verb == "rm":
+            try:
+                g.delete_user(args.uid)
+            except RGWError as e:
+                print(f"user rm failed: {e}", file=sys.stderr)
+                return 1
+        elif args.verb == "list":
+            for oid in g._meta_list("user."):
+                print(oid[len("user."):], file=out)
+    elif args.cmd == "bucket":
+        if args.verb == "list":
+            if args.uid:
+                for b in g.list_buckets(args.uid):
+                    print(b, file=out)
+            elif args.bucket:
+                for e in g.list_objects(args.bucket)["contents"]:
+                    print(e["name"], file=out)
+        elif args.verb == "stats":
+            b = g.get_bucket(args.bucket)
+            stats = json.loads(g._exec(
+                args.meta_pool, g._index_oid(b["id"]), "bucket_stats"))
+            json.dump({**b, **stats}, out, indent=2, sort_keys=True)
+            print(file=out)
+        elif args.verb == "rm":
+            g.delete_bucket(args.bucket)
+    return 0
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin shell wrapper
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ap = argparse.ArgumentParser(prog="radosgw-admin", add_help=False)
+    ap.add_argument("--checkpoint", required=True)
+    ns, rest = ap.parse_known_args(argv)
+    from ..cluster import MiniCluster
+    c = MiniCluster.restore(ns.checkpoint)
+    return run(c, c.client("client.rgw-admin"), rest)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Admin CLIs for the client layer: radosgw-admin and cephfs shells.
+
+Mirrors the reference's admin-tool surface (src/rgw/rgw_admin.cc,
+cephfs-shell): user/bucket administration and fs manipulation drive the
+same library paths the gateways use.
+"""
+import json
+
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.tools import cephfs_cli, rgw_admin
+
+
+@pytest.fixture()
+def env():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("rgwmeta", size=3, pg_num=8)
+    c.create_replicated_pool("rgwdata", size=3, pg_num=8)
+    c.create_replicated_pool("fsmeta", size=3, pg_num=8)
+    c.create_replicated_pool("fsdata", size=3, pg_num=8)
+    return c, c.client("client.cli")
+
+
+def test_rgw_admin_flow(env, capsys):
+    c, cl = env
+    run = lambda *a: rgw_admin.run(c, cl, list(a))
+    assert run("user", "create", "--uid", "bob",
+               "--display-name", "Bob") == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["uid"] == "bob" and out["access_key"]
+    run("user", "info", "--uid", "bob")
+    assert json.loads(capsys.readouterr().out)["display_name"] == "Bob"
+    run("user", "list")
+    assert "bob" in capsys.readouterr().out.split()
+
+    from ceph_tpu.rgw import RGWLite
+    g = RGWLite(cl, "rgwmeta", "rgwdata")
+    g.create_bucket("bob", "pics")
+    g.put_object("pics", "a.jpg", b"jpeg")
+    run("bucket", "list", "--uid", "bob")
+    assert "pics" in capsys.readouterr().out.split()
+    run("bucket", "list", "--bucket", "pics")
+    assert "a.jpg" in capsys.readouterr().out.split()
+    run("bucket", "stats", "--bucket", "pics")
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["num_objects"] == 1 and stats["size_bytes"] == 4
+    # user rm refused while owning buckets
+    assert run("user", "rm", "--uid", "bob") == 1
+    g.delete_object("pics", "a.jpg")
+    run("bucket", "rm", "--bucket", "pics")
+    assert run("user", "rm", "--uid", "bob") == 0
+    run("user", "list")
+    assert "bob" not in capsys.readouterr().out.split()
+
+
+def test_cephfs_cli_flow(env, tmp_path, capsys):
+    c, cl = env
+    run = lambda *a: cephfs_cli.run(c, cl, list(a))
+    run("mkfs")
+    run("mkdir", "/docs")
+    src = tmp_path / "in.txt"
+    src.write_bytes(b"file-body")
+    run("put", str(src), "/docs/readme")
+    run("cat", "/docs/readme")
+    assert capsys.readouterr().out == "file-body"
+    run("ln", "/docs/readme", "/latest")
+    run("cat", "/latest")
+    assert capsys.readouterr().out == "file-body"
+    run("ls", "/")
+    out = capsys.readouterr().out
+    assert "docs" in out
+    assert any(line.startswith("l") and "latest" in line
+               for line in out.splitlines())
+    run("mv", "/docs/readme", "/docs/manual")
+    dst = tmp_path / "out.txt"
+    run("get", "/docs/manual", str(dst))
+    assert dst.read_bytes() == b"file-body"
+    run("tree", "/")
+    tree = capsys.readouterr().out
+    assert "/docs" in tree and "manual" in tree
+    run("stat", "/docs/manual")
+    assert json.loads(capsys.readouterr().out)["type"] == "file"
+    run("rm", "/docs/manual")
+    run("rmdir", "/docs")
+    run("ls", "/")
+    assert "docs" not in capsys.readouterr().out
