@@ -388,6 +388,51 @@ class RadosClient(Dispatcher):
         p = self.osdmap.get_pg_pool(self.lookup_pool(pool))
         return dict(p.snaps)
 
+    # ---- advisory locks (rados_lock_exclusive/shared -> cls_lock,
+    # src/cls/lock/cls_lock_client.cc) ---------------------------------
+    def _lock_exec(self, pool: str, oid: str, method: str,
+                   payload: dict) -> int:
+        import json as _json
+        ret, _ = self.exec(pool, oid, "lock", method,
+                           _json.dumps(payload).encode())
+        return ret
+
+    def lock_exclusive(self, pool: str, oid: str, name: str,
+                       cookie: str = "", description: str = "",
+                       duration: float = 0) -> int:
+        from ..osd.cls_lock import LOCK_EXCLUSIVE
+        return self._lock_exec(pool, oid, "lock", {
+            "name": name, "type": LOCK_EXCLUSIVE, "cookie": cookie,
+            "description": description, "duration": duration})
+
+    def lock_shared(self, pool: str, oid: str, name: str,
+                    cookie: str = "", tag: str = "",
+                    description: str = "", duration: float = 0) -> int:
+        from ..osd.cls_lock import LOCK_SHARED
+        return self._lock_exec(pool, oid, "lock", {
+            "name": name, "type": LOCK_SHARED, "cookie": cookie,
+            "tag": tag, "description": description,
+            "duration": duration})
+
+    def unlock(self, pool: str, oid: str, name: str,
+               cookie: str = "") -> int:
+        return self._lock_exec(pool, oid, "unlock",
+                               {"name": name, "cookie": cookie})
+
+    def break_lock(self, pool: str, oid: str, name: str, entity: str,
+                   cookie: str = "") -> int:
+        return self._lock_exec(pool, oid, "break_lock",
+                               {"name": name, "entity": entity,
+                                "cookie": cookie})
+
+    def list_lockers(self, pool: str, oid: str, name: str) -> dict:
+        import json as _json
+        ret, out = self.exec(pool, oid, "lock", "get_info",
+                             _json.dumps({"name": name}).encode())
+        if ret < 0:
+            raise _ioerror("list_lockers", oid, ret)
+        return _json.loads(out)
+
     # ---- selfmanaged snaps (librados rados_ioctx_selfmanaged_snap_*):
     # the mon only allocates/retires ids; snapshot membership lives in
     # the write SnapContext this client attaches to mutations ----------

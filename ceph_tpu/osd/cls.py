@@ -45,6 +45,16 @@ class ClsContext:
     def exists(self) -> bool:
         return self._st["exists"]
 
+    @property
+    def now(self) -> float:
+        """OSD wall time (for cls_lock expirations)."""
+        return self._st.get("now", 0.0)
+
+    @property
+    def entity(self) -> str:
+        """The calling client's entity name (cls_cxx_get_origin)."""
+        return self._st.get("entity", "")
+
     def read(self) -> bytes:
         return bytes(self._st["body"])
 
@@ -63,6 +73,13 @@ class ClsContext:
         self._st["attrs"][name] = bytes(value)
         self._st["exists"] = True
         self._st["_meta"] = True
+
+    def rmxattr(self, name: str) -> None:
+        self._st["attrs"].pop(name, None)
+        self._st["_meta"] = True
+
+    def attr_names(self):
+        return sorted(self._st["attrs"])
 
     def _check_omap(self) -> None:
         if not self._st.get("omap_ok", True):
@@ -146,3 +163,7 @@ def _numops_mul(ctx: ClsContext, inp: bytes):
     enc = ("%d" % out if out == int(out) else repr(out)).encode()
     ctx.write_full(enc)
     return 0, b""
+
+
+# generic lock class registers with the same registry (src/cls/lock)
+from . import cls_lock  # noqa: E402,F401

@@ -2232,7 +2232,10 @@ class PG:
               # EC stores have no omap; class methods touching it must
               # fail loudly (EOPNOTSUPP) instead of staging silently
               # dropped keys (reference: cls_cxx_map_* on EC pools)
-              "omap_ok": self.backend is None}
+              "omap_ok": self.backend is None,
+              # cls_lock needs wall time (expirations) and the caller
+              # identity (cls_cxx_get_origin / ceph_cls_current_*)
+              "now": self.osd.now, "entity": msg.src}
         if any(o.op == CEPH_OSD_OP_ASSERT_VER for o in msg.ops):
             st["cur_version"] = self._stored_user_version(msg.oid)
         existed = st["exists"]

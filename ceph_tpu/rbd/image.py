@@ -619,6 +619,30 @@ class Image:
         if ret < 0 and ret != -2:
             raise RBDError("flatten", ret)
 
+    # ---- advisory image locks (rbd lock add/ls/rm -> cls_lock on the
+    # header object, librbd list_lockers/lock_exclusive) ---------------
+    RBD_LOCK_NAME = "rbd_lock"
+
+    def lock_exclusive(self, cookie: str = "") -> int:
+        return self.client.lock_exclusive(self.pool, self._header,
+                                          self.RBD_LOCK_NAME, cookie)
+
+    def lock_shared(self, cookie: str = "", tag: str = "") -> int:
+        return self.client.lock_shared(self.pool, self._header,
+                                       self.RBD_LOCK_NAME, cookie, tag)
+
+    def unlock(self, cookie: str = "") -> int:
+        return self.client.unlock(self.pool, self._header,
+                                  self.RBD_LOCK_NAME, cookie)
+
+    def break_lock(self, entity: str, cookie: str = "") -> int:
+        return self.client.break_lock(self.pool, self._header,
+                                      self.RBD_LOCK_NAME, entity, cookie)
+
+    def list_lockers(self) -> List[Dict]:
+        return self.client.list_lockers(self.pool, self._header,
+                                        self.RBD_LOCK_NAME)["lockers"]
+
     def stat(self) -> Dict:
         meta = self._call("get_image")
         return {"size": self.size(), "order": meta["order"],

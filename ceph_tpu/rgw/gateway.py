@@ -96,6 +96,16 @@ class RGWLite:
         self.client.remove(self.mpool, f"user.{uid}")
         self._meta_index(f"user.{uid}", False)
 
+    def list_users(self) -> List[str]:
+        return [oid[len("user."):] for oid in self._meta_list("user.")]
+
+    def bucket_stats(self, bucket: str) -> Dict:
+        """Bucket entry + index stats (radosgw-admin bucket stats)."""
+        b = self.get_bucket(bucket)
+        stats = json.loads(self._exec(
+            self.mpool, self._index_oid(b["id"]), "bucket_stats"))
+        return {**b, **stats}
+
     def user_by_access_key(self, access_key: str) -> Optional[Dict]:
         # lite linear scan (the reference keeps a key->uid index object)
         for oid in self._meta_list("user."):

@@ -44,6 +44,11 @@ def run(cluster, client, argv) -> int:
     s.add_argument("child")
     s = sub.add_parser("flatten")
     s.add_argument("image")
+    s = sub.add_parser("lock")
+    s.add_argument("verb", choices=["add", "ls", "rm"])
+    s.add_argument("image")
+    s.add_argument("--cookie", default="")
+    s.add_argument("--locker", default="")
     s = sub.add_parser("export")
     s.add_argument("image")
     s.add_argument("path")
@@ -84,6 +89,22 @@ def run(cluster, client, argv) -> int:
         rbd.clone(pool, pname, snap, pool, args.child)
     elif args.cmd == "flatten":
         Image(client, pool, args.image).flatten()
+    elif args.cmd == "lock":
+        img = Image(client, pool, args.image)
+        if args.verb == "add":
+            r = img.lock_exclusive(args.cookie)
+            if r < 0:
+                print(f"lock failed: {r}", file=sys.stderr)
+                return 1
+        elif args.verb == "ls":
+            print(json.dumps(img.list_lockers(), indent=2,
+                             sort_keys=True))
+        elif args.verb == "rm":
+            r = (img.break_lock(args.locker, args.cookie)
+                 if args.locker else img.unlock(args.cookie))
+            if r < 0:
+                print(f"unlock failed: {r}", file=sys.stderr)
+                return 1
     elif args.cmd == "export":
         img = Image(client, pool, args.image)
         with open(args.path, "wb") as f:

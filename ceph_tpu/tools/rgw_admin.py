@@ -32,6 +32,14 @@ def run(cluster, client, argv, meta_pool: str = "rgwmeta",
 
     g = RGWLite(client, args.meta_pool, args.data_pool)
     out = sys.stdout
+    try:
+        return _dispatch(g, client, args, out)
+    except RGWError as e:
+        print(f"{args.cmd} {args.verb} failed: {e}", file=sys.stderr)
+        return 1
+
+
+def _dispatch(g, client, args, out) -> int:
     if args.cmd == "user":
         if args.verb == "create":
             u = g.create_user(args.uid, args.display_name)
@@ -42,14 +50,10 @@ def run(cluster, client, argv, meta_pool: str = "rgwmeta",
                       sort_keys=True)
             print(file=out)
         elif args.verb == "rm":
-            try:
-                g.delete_user(args.uid)
-            except RGWError as e:
-                print(f"user rm failed: {e}", file=sys.stderr)
-                return 1
+            g.delete_user(args.uid)
         elif args.verb == "list":
-            for oid in g._meta_list("user."):
-                print(oid[len("user."):], file=out)
+            for uid in g.list_users():
+                print(uid, file=out)
     elif args.cmd == "bucket":
         if args.verb == "list":
             if args.uid:
@@ -59,10 +63,8 @@ def run(cluster, client, argv, meta_pool: str = "rgwmeta",
                 for e in g.list_objects(args.bucket)["contents"]:
                     print(e["name"], file=out)
         elif args.verb == "stats":
-            b = g.get_bucket(args.bucket)
-            stats = json.loads(g._exec(
-                args.meta_pool, g._index_oid(b["id"]), "bucket_stats"))
-            json.dump({**b, **stats}, out, indent=2, sort_keys=True)
+            json.dump(g.bucket_stats(args.bucket), out, indent=2,
+                      sort_keys=True)
             print(file=out)
         elif args.verb == "rm":
             g.delete_bucket(args.bucket)

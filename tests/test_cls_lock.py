@@ -1,0 +1,133 @@
+"""cls_lock: advisory object locks (src/cls/lock semantics).
+
+Exclusive contention, shared coexistence under one tag, renewal,
+expiration via the OSD clock, break_lock, assert_locked fencing inside
+write vectors, and EC-pool locks (xattr state needs no omap).
+"""
+import json
+
+import pytest
+
+from ceph_tpu.client import ObjectOperation
+from ceph_tpu.cluster import MiniCluster
+
+
+@pytest.fixture()
+def env():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", size=3, pg_num=8)
+    return c, c.client("client.a"), c.client("client.b")
+
+
+def test_exclusive_contention_and_unlock(env):
+    c, a, b = env
+    assert a.lock_exclusive("p", "o", "lk", cookie="c1") == 0
+    assert b.lock_exclusive("p", "o", "lk", cookie="c2") == -16  # EBUSY
+    assert b.lock_shared("p", "o", "lk", cookie="c2") == -16
+    # renewal by the same (entity, cookie) succeeds
+    assert a.lock_exclusive("p", "o", "lk", cookie="c1") == 0
+    info = a.list_lockers("p", "o", "lk")
+    assert len(info["lockers"]) == 1
+    assert info["lockers"][0]["entity"] == "client.a"
+    # only the holder can unlock
+    assert b.unlock("p", "o", "lk", cookie="c2") == -2
+    assert a.unlock("p", "o", "lk", cookie="c1") == 0
+    assert b.lock_exclusive("p", "o", "lk", cookie="c2") == 0
+
+
+def test_shared_tag_semantics(env):
+    c, a, b = env
+    assert a.lock_shared("p", "o", "lk", cookie="c1", tag="T") == 0
+    assert b.lock_shared("p", "o", "lk", cookie="c2", tag="T") == 0
+    assert len(a.list_lockers("p", "o", "lk")["lockers"]) == 2
+    # a different tag or an exclusive request conflicts
+    c2 = c.client("client.x")
+    assert c2.lock_shared("p", "o", "lk", cookie="c3", tag="OTHER") == -16
+    assert c2.lock_exclusive("p", "o", "lk", cookie="c3") == -16
+    a.unlock("p", "o", "lk", cookie="c1")
+    b.unlock("p", "o", "lk", cookie="c2")
+    assert c2.lock_exclusive("p", "o", "lk", cookie="c3") == 0
+
+
+def test_sole_holder_redefines_type(env):
+    """A sole holder downgrading exclusive->shared resets the stored
+    type/tag so new shared lockers can join (cls_lock.cc re-set)."""
+    c, a, b = env
+    assert a.lock_exclusive("p", "o", "lk", cookie="c1") == 0
+    assert a.lock_shared("p", "o", "lk", cookie="c1", tag="T") == 0
+    assert b.lock_shared("p", "o", "lk", cookie="c2", tag="T") == 0
+    assert len(a.list_lockers("p", "o", "lk")["lockers"]) == 2
+    # upgrade back requires being sole holder again
+    assert a.lock_exclusive("p", "o", "lk", cookie="c1") == -16
+    b.unlock("p", "o", "lk", cookie="c2")
+    assert a.lock_exclusive("p", "o", "lk", cookie="c1") == 0
+
+
+def test_expiration_and_break(env):
+    c, a, b = env
+    assert a.lock_exclusive("p", "o", "lk", cookie="c1",
+                            duration=5.0) == 0
+    assert b.lock_exclusive("p", "o", "lk", cookie="c2") == -16
+    c.tick(dt=3.0)
+    assert b.lock_exclusive("p", "o", "lk", cookie="c2") == -16
+    c.tick(dt=3.0)          # past the 5 s duration: lock expired
+    assert b.lock_exclusive("p", "o", "lk", cookie="c2") == 0
+    # operator break of a live lock
+    assert a.break_lock("p", "o", "lk", entity="client.b",
+                        cookie="c2") == 0
+    assert a.lock_exclusive("p", "o", "lk", cookie="c1") == 0
+    a.unlock("p", "o", "lk", cookie="c1")
+
+
+def test_assert_locked_fences_writes(env):
+    """The librbd exclusive-lock fencing pattern: writes guarded by
+    assert_locked abort EBUSY unless the caller holds the lock."""
+    c, a, b = env
+    a.write_full("p", "img", b"initial")
+    assert a.lock_exclusive("p", "img", "rbd_lock", cookie="c1") == 0
+
+    def guarded_write(cl, cookie, payload):
+        op = ObjectOperation()
+        op.call("lock", "assert_locked", json.dumps(
+            {"name": "rbd_lock", "cookie": cookie}).encode())
+        op.write_full(payload)
+        r, _ = cl.operate("p", "img", op)
+        return r
+
+    assert guarded_write(a, "c1", b"by-holder") == 0
+    assert a.read("p", "img") == b"by-holder"
+    assert guarded_write(b, "c2", b"by-intruder") == -16
+    assert a.read("p", "img") == b"by-holder"     # write fenced off
+
+
+def test_rbd_image_locks(env, capsys):
+    """rbd lock add/ls/rm on the header object (librbd list_lockers)."""
+    c, a, b = env
+    from ceph_tpu.rbd import Image, RBD
+    from ceph_tpu.tools import rbd_cli
+    c.create_replicated_pool("rbd", size=3, pg_num=8)
+    RBD(a).create("rbd", "vm", 1 << 14, order=12)
+    img_a = Image(a, "rbd", "vm")
+    img_b = Image(b, "rbd", "vm")
+    assert img_a.lock_exclusive("qemu-1") == 0
+    assert img_b.lock_exclusive("qemu-2") == -16
+    lockers = img_b.list_lockers()
+    assert lockers[0]["entity"] == "client.a"
+    assert rbd_cli.run(c, b, ["-p", "rbd", "lock", "ls", "vm"]) == 0
+    assert "client.a" in capsys.readouterr().out
+    # operator break via the CLI, then the other client can lock
+    assert rbd_cli.run(c, b, ["-p", "rbd", "lock", "rm", "vm",
+                              "--locker", "client.a",
+                              "--cookie", "qemu-1"]) == 0
+    assert img_b.lock_exclusive("qemu-2") == 0
+
+
+def test_locks_on_ec_pool(env):
+    c, a, b = env
+    c.create_ec_pool("e", k=2, m=1, plugin="isa", pg_num=8)
+    a.write_full("e", "o", b"ec-data")
+    assert a.lock_exclusive("e", "o", "lk", cookie="c1") == 0
+    assert b.lock_exclusive("e", "o", "lk", cookie="c2") == -16
+    info = b.list_lockers("e", "o", "lk")
+    assert info["lockers"][0]["entity"] == "client.a"
+    assert a.unlock("e", "o", "lk", cookie="c1") == 0
