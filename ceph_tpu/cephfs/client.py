@@ -10,8 +10,9 @@ serialized by the directory object's PG instead of MDS locks.
 
 Scope-outs vs the reference (see cls_fs for the rationale): client
 capabilities/leases and delegations, the MDS journal + standby-replay,
-multi-MDS subtree partitioning, hard links (remote dentries), and
-cephfs snapshots.  stat() is lstat-shaped (final-component symlinks
+multi-MDS subtree partitioning and cephfs snapshots.  Hard links use
+remote dentries with a back-pointer list on the primary (promotion on
+primary unlink replaces the MDS stray-directory migration).  stat() is lstat-shaped (final-component symlinks
 are not followed); intermediate symlinks resolve like the kernel
 client's path walk.  Cross-directory rename is dst-link-then-src-unlink —
 two PG-atomic steps, briefly observable as a double link, never a loss
@@ -96,6 +97,10 @@ class CephFS:
             if inode["type"] != "dir":
                 raise FsError("resolve", -20)         # ENOTDIR
             inode = self._lookup(inode["ino"], name)
+            if inode.get("type") == "remote":
+                # hard link: a remote dentry IS the file (POSIX link
+                # identity), unlike a symlink — always dereference
+                _, _, inode = self._primary_of(0, "", inode)
             last = i == len(parts) - 1
             if inode["type"] == "symlink" and (not last or follow_final):
                 target = inode["target"]
@@ -119,6 +124,39 @@ class CephFS:
     def _lookup(self, dir_ino: int, name: str) -> Dict:
         return json.loads(self._call(dir_oid(dir_ino), "lookup",
                                      {"name": name}))
+
+    def _primary_of(self, dino: int, name: str, inode: Dict):
+        """Resolve a remote dentry to (primary_dir, primary_name,
+        primary_inode); identity for everything else (CDentry remote ->
+        primary resolution in the MDS cache)."""
+        if inode.get("type") != "remote":
+            return dino, name, inode
+        pd, pn = inode["primary"]
+        return pd, pn, self._lookup(pd, pn)
+
+    # ---- hard links (CDentry remote dentries; inode embedded in the
+    # primary, back-pointer list to every remote) ----------------------
+    def hardlink(self, existing: str, newpath: str) -> None:
+        """link(2): a new name for an existing FILE.  The new dentry is
+        a remote referencing the primary; the primary records it in its
+        back-pointer list FIRST, so a crash between the two steps
+        leaves a recorded-but-absent link (pruned on promotion) rather
+        than an untracked dangling remote."""
+        ed, en = self._resolve_parent(existing)
+        pd, pn, pinode = self._primary_of(ed, en, self._lookup(ed, en))
+        if pinode["type"] == "dir":
+            raise FsError("link", -1)            # EPERM, like the MDS
+        if pinode["type"] != "file":
+            raise FsError("link", -22)
+        nd, nn = self._resolve_parent(newpath)
+        self._update_links(pd, pn, add_links=[[nd, nn]])
+        try:
+            self._call(dir_oid(nd), "link", {"name": nn, "inode": {
+                "type": "remote", "ino": pinode["ino"],
+                "primary": [pd, pn]}})
+        except FsError:
+            self._update_links(pd, pn, remove_links=[[nd, nn]])
+            raise
 
     # ---- directories ------------------------------------------------------
     def mkdir(self, path: str) -> int:
@@ -173,7 +211,11 @@ class CephFS:
         return inode["target"]
 
     def stat(self, path: str) -> Dict:
-        return self._resolve(path)
+        inode = self._resolve(path)
+        if inode.get("type") == "file":
+            inode = dict(inode,
+                         nlink=1 + len(inode.get("links", [])))
+        return inode
 
     def _file_inode(self, path: str,
                     depth: int = 0) -> Tuple[int, str, Dict]:
@@ -181,6 +223,8 @@ class CephFS:
             raise FsError("open", -40)                # ELOOP
         dino, name = self._resolve_parent(path)
         inode = self._lookup(dino, name)
+        if inode.get("type") == "remote":
+            dino, name, inode = self._primary_of(dino, name, inode)
         if inode["type"] == "symlink":
             target = inode["target"]
             if not target.startswith("/"):
@@ -193,6 +237,12 @@ class CephFS:
         if inode["type"] != "file":
             raise FsError("open", -21)                # EISDIR
         return dino, name, inode
+
+    def _update_links(self, dino: int, name: str, **kind) -> Dict:
+        """Server-side back-pointer mutation (add_links/remove_links/
+        replace_link) — atomic on the dentry, no client RMW window."""
+        return json.loads(self._call(dir_oid(dino), "update_inode",
+                                     {"name": name, **kind}))
 
     def _update(self, dino: int, name: str, **attrs) -> Dict:
         return json.loads(self._call(dir_oid(dino), "update_inode",
@@ -265,6 +315,48 @@ class CephFS:
         dino, name = self._resolve_parent(path)
         gone = json.loads(self._call(dir_oid(dino), "unlink",
                                      {"name": name, "deny_dir": True}))
+        self._unlinked_cleanup(gone, dino, name)
+
+    def _unlinked_cleanup(self, gone: Dict, dino: int,
+                          name: str) -> None:
+        """After a dentry disappears: a remote detaches from its
+        primary's back-pointer list; a primary with surviving remotes
+        promotes one of them to hold the inode (the MDS migrates such
+        inodes through the stray directory — here the promotion is
+        direct); a sole primary purges its data."""
+        if not gone:
+            return
+        if gone.get("type") == "remote":
+            pd, pn = gone["primary"]
+            try:
+                self._update_links(pd, pn,
+                                   remove_links=[[dino, name]])
+            except FsError:
+                pass                 # primary already gone
+            return
+        if gone.get("type") != "file":
+            return
+        # validate EVERY back-pointer up front (recorded-but-absent
+        # entries from the documented crash window are pruned here)
+        valid = []
+        for ld, ln in gone.get("links", []):
+            try:
+                r = self._lookup(ld, ln)
+            except FsError:
+                continue
+            if r.get("type") == "remote" and r.get("ino") == gone["ino"]:
+                valid.append([ld, ln])
+        if valid:
+            (ld, ln), rest = valid[0], valid[1:]
+            promoted = dict(gone, links=rest)
+            self._call(dir_oid(ld), "set_dentry",
+                       {"name": ln, "inode": promoted})
+            for od, on in rest:      # repoint surviving remotes
+                try:
+                    self._update(od, on, primary=[ld, ln])
+                except FsError:
+                    pass
+            return
         self._purge_file(gone)
 
     def _purge_file(self, inode: Dict) -> None:
@@ -288,6 +380,17 @@ class CephFS:
         sdino, sname = self._resolve_parent(src)
         ddino, dname = self._resolve_parent(dst)
         moving = self._lookup(sdino, sname)
+        try:
+            existing_dst = self._lookup(ddino, dname)
+        except FsError:
+            existing_dst = None
+        if existing_dst is not None and \
+                existing_dst.get("ino") == moving.get("ino") and \
+                moving.get("type") in ("file", "remote"):
+            # rename between two names of the same file is a POSIX
+            # no-op (both dentries survive) — proceeding would displace
+            # the primary and purge the data
+            return
         if moving["type"] == "dir" and \
                 self._subtree_contains(moving["ino"], ddino):
             # moving a directory into its own subtree would detach the
@@ -299,9 +402,11 @@ class CephFS:
             displaced = json.loads(self._call(
                 dir_oid(sdino), "rename_local",
                 {"src": sname, "dst": dname, "replace": True}))
-            self._purge_file(displaced)
+            self._unlinked_cleanup(displaced, sdino, dname)
+            self._fix_link_pointers(moving, [sdino, sname],
+                                    [sdino, dname])
             return
-        inode = self._lookup(sdino, sname)
+        inode = moving
         try:
             self._call(dir_oid(ddino), "link",
                        {"name": dname, "inode": inode})
@@ -313,10 +418,28 @@ class CephFS:
             displaced = json.loads(self._call(
                 dir_oid(ddino), "unlink",
                 {"name": dname, "deny_dir": True}))
-            self._purge_file(displaced)
+            self._unlinked_cleanup(displaced, ddino, dname)
             self._call(dir_oid(ddino), "link",
                        {"name": dname, "inode": inode})
         self._call(dir_oid(sdino), "unlink", {"name": sname})
+        self._fix_link_pointers(inode, [sdino, sname], [ddino, dname])
+
+    def _fix_link_pointers(self, moved: Dict, old_loc, new_loc) -> None:
+        """A moved remote must update its primary's back-pointer; a
+        moved primary must repoint every remote at its new location."""
+        if moved.get("type") == "remote":
+            pd, pn = moved["primary"]
+            try:
+                self._update_links(pd, pn,
+                                   replace_link=[old_loc, new_loc])
+            except FsError:
+                pass
+        elif moved.get("type") == "file":
+            for od, on in moved.get("links", []):
+                try:
+                    self._update(od, on, primary=new_loc)
+                except FsError:
+                    pass
 
     def _subtree_contains(self, root_ino: int, needle_ino: int,
                           depth: int = 0) -> bool:

@@ -161,8 +161,36 @@ def _update_inode(ctx: ClsContext, inp: bytes):
     # window can shrink a committed size
     for k, v in req.get("max_attrs", {}).items():
         inode[k] = max(inode.get(k, 0), v)
+    # back-pointer list mutations merge HERE for the same reason: two
+    # concurrent hardlink()s must both land their entries
+    if req.get("add_links") or req.get("remove_links") \
+            or req.get("replace_link"):
+        links = list(inode.get("links", []))
+        for l in req.get("add_links", []):
+            if l not in links:
+                links.append(l)
+        links = [l for l in links
+                 if l not in req.get("remove_links", [])]
+        rep = req.get("replace_link")
+        if rep:
+            links = [rep[1] if l == rep[0] else l for l in links]
+        inode["links"] = links
     ctx.omap_set({key: _j(inode)})
     return 0, _j(inode)
+
+
+@register_cls_method("fs", "set_dentry", CLS_METHOD_WR)
+def _set_dentry(ctx: ClsContext, inp: bytes):
+    """Atomically overwrite (or install) a dentry's value — the
+    hard-link promotion/repoint primitive: replacing a remote dentry
+    with an embedded inode must never pass through a missing-dentry
+    window the way unlink+link would."""
+    req = _parse(inp)
+    om = ctx.omap_get()
+    if "_dead" in om:
+        return -2, b""
+    ctx.omap_set({f"dn_{req['name']}": _j(req["inode"])})
+    return 0, b""
 
 
 @register_cls_method("fs", "rename_local", CLS_METHOD_WR)

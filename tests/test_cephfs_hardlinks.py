@@ -1,0 +1,130 @@
+"""cephfs hard links: remote dentries, nlink, promotion on unlink.
+
+Reference semantics (CDentry remote dentries + stray-directory inode
+migration): every name is the same file; data survives until the LAST
+name goes; renames keep the primary/remote pointers consistent.
+"""
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.cephfs import CephFS, FsError, file_oid
+
+ORDER = 12
+
+
+@pytest.fixture()
+def fs():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("fsmeta", size=3, pg_num=8)
+    c.create_replicated_pool("fsdata", size=3, pg_num=8)
+    cl = c.client("client.fs")
+    f = CephFS(cl, "fsmeta", "fsdata")
+    f.mkfs()
+    return c, cl, f
+
+
+def test_link_identity_and_nlink(fs):
+    c, cl, f = fs
+    f.create("/a", ORDER)
+    f.write("/a", b"shared-bytes")
+    f.mkdir("/d")
+    f.hardlink("/a", "/d/b")
+    assert f.read("/d/b") == b"shared-bytes"
+    assert f.stat("/a")["nlink"] == 2
+    assert f.stat("/d/b")["nlink"] == 2
+    assert f.stat("/d/b")["ino"] == f.stat("/a")["ino"]
+    # writes through either name are visible through both
+    f.write("/d/b", b"NEW", offset=0)
+    assert f.read("/a")[:3] == b"NEW"
+    f.write("/a", b"!", offset=3)
+    assert f.read("/d/b")[:4] == b"NEW!"
+    # size growth through the remote name lands on the shared inode
+    f.write("/d/b", b"Z" * 50, offset=100)
+    assert f.stat("/a")["size"] == 150
+    # hard links to directories are refused
+    with pytest.raises(FsError) as ei:
+        f.hardlink("/d", "/dlink")
+    assert ei.value.result == -1
+
+
+def test_unlink_order_data_survives_until_last(fs):
+    c, cl, f = fs
+    f.create("/orig", ORDER)
+    f.write("/orig", b"payload")
+    f.hardlink("/orig", "/l1")
+    f.hardlink("/l1", "/l2")           # linking via a remote works
+    assert f.stat("/orig")["nlink"] == 3
+    ino = f.stat("/orig")["ino"]
+    # drop a remote: others unaffected
+    f.unlink("/l1")
+    assert f.stat("/orig")["nlink"] == 2
+    assert f.read("/l2") == b"payload"
+    # drop the PRIMARY: a remote is promoted, data survives
+    f.unlink("/orig")
+    assert f.read("/l2") == b"payload"
+    assert f.stat("/l2")["nlink"] == 1
+    assert not f.exists("/orig")
+    # last name purges the data objects
+    f.unlink("/l2")
+    with pytest.raises(IOError):
+        cl.read("fsdata", file_oid(ino, 0))
+
+
+def test_rename_keeps_pointers(fs):
+    c, cl, f = fs
+    f.mkdir("/x")
+    f.create("/file", ORDER)
+    f.write("/file", b"pointer-check")
+    f.hardlink("/file", "/x/link")
+    # move the REMOTE cross-dir: identity intact
+    f.rename("/x/link", "/moved-link")
+    assert f.read("/moved-link") == b"pointer-check"
+    # then unlink the primary: the moved remote is still found/promoted
+    f.unlink("/file")
+    assert f.read("/moved-link") == b"pointer-check"
+    # move the (now-)PRIMARY cross-dir after making another link
+    f.hardlink("/moved-link", "/x/again")
+    f.rename("/moved-link", "/x/primary-moved")
+    assert f.read("/x/again") == b"pointer-check"
+    f.unlink("/x/primary-moved")       # promotion chases moved pointers
+    assert f.read("/x/again") == b"pointer-check"
+    f.unlink("/x/again")
+
+
+def test_rename_between_same_file_names_is_noop(fs):
+    """rename between two names of the same file is a POSIX no-op in
+    BOTH directions — it must never displace the primary or purge."""
+    c, cl, f = fs
+    f.create("/a", ORDER)
+    f.write("/a", b"precious")
+    f.hardlink("/a", "/b")
+    f.rename("/b", "/a")             # remote onto its primary
+    assert f.read("/a") == b"precious"
+    assert f.read("/b") == b"precious"
+    assert f.stat("/a")["nlink"] == 2
+    f.rename("/a", "/b")             # primary onto its remote
+    assert f.read("/a") == b"precious"
+    assert f.read("/b") == b"precious"
+    assert f.stat("/b")["nlink"] == 2
+    # cross-dir variant
+    f.mkdir("/d")
+    f.hardlink("/a", "/d/c")
+    f.rename("/d/c", "/a")
+    assert f.read("/d/c") == b"precious"
+    assert f.stat("/a")["nlink"] == 3
+
+
+def test_promotion_prunes_stale_backpointers(fs):
+    """A recorded-but-absent link (the documented crash window) is
+    pruned during promotion instead of wedging the unlink."""
+    c, cl, f = fs
+    f.create("/p", ORDER)
+    f.write("/p", b"x")
+    f.hardlink("/p", "/live")
+    # manufacture a stale back-pointer (the crash between record+link)
+    dino, name = f._resolve_parent("/p")
+    inode = f._lookup(dino, name)
+    f._update(dino, name, links=inode["links"] + [[999, "ghost"]])
+    f.unlink("/p")                     # must promote /live, prune ghost
+    assert f.read("/live") == b"x"
+    assert f.stat("/live")["nlink"] == 1
