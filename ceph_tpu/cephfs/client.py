@@ -149,13 +149,19 @@ class CephFS:
         if pinode["type"] != "file":
             raise FsError("link", -22)
         nd, nn = self._resolve_parent(newpath)
-        self._update_links(pd, pn, add_links=[[nd, nn]])
+        # only roll back an entry THIS call added — a repeated
+        # hardlink to the same name must not strip the original
+        # back-pointer on its EEXIST failure
+        added = [nd, nn] not in pinode.get("links", [])
+        if added:
+            self._update_links(pd, pn, add_links=[[nd, nn]])
         try:
             self._call(dir_oid(nd), "link", {"name": nn, "inode": {
                 "type": "remote", "ino": pinode["ino"],
                 "primary": [pd, pn]}})
         except FsError:
-            self._update_links(pd, pn, remove_links=[[nd, nn]])
+            if added:
+                self._update_links(pd, pn, remove_links=[[nd, nn]])
             raise
 
     # ---- directories ------------------------------------------------------
@@ -346,11 +352,19 @@ class CephFS:
                 continue
             if r.get("type") == "remote" and r.get("ino") == gone["ino"]:
                 valid.append([ld, ln])
-        if valid:
+        while valid:
             (ld, ln), rest = valid[0], valid[1:]
             promoted = dict(gone, links=rest)
-            self._call(dir_oid(ld), "set_dentry",
-                       {"name": ln, "inode": promoted})
+            try:
+                # guarded: only replaces the dentry if it is STILL the
+                # remote we validated — a concurrent unlink of that
+                # name must not be resurrected by our promotion
+                self._call(dir_oid(ld), "set_dentry",
+                           {"name": ln, "inode": promoted,
+                            "expect_remote_ino": gone["ino"]})
+            except FsError:
+                valid = rest         # candidate vanished: try the next
+                continue
             for od, on in rest:      # repoint surviving remotes
                 try:
                     self._update(od, on, primary=[ld, ln])
@@ -421,8 +435,12 @@ class CephFS:
             self._unlinked_cleanup(displaced, ddino, dname)
             self._call(dir_oid(ddino), "link",
                        {"name": dname, "inode": inode})
-        self._call(dir_oid(sdino), "unlink", {"name": sname})
+        # pointers first, THEN the src unlink: a crash in between
+        # leaves a stale duplicate NAME at src (harmless, cleaned by a
+        # later unlink) instead of dangling remotes whose primary is
+        # gone — names are never lost
         self._fix_link_pointers(inode, [sdino, sname], [ddino, dname])
+        self._call(dir_oid(sdino), "unlink", {"name": sname})
 
     def _fix_link_pointers(self, moved: Dict, old_loc, new_loc) -> None:
         """A moved remote must update its primary's back-pointer; a

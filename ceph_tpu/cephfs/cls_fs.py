@@ -189,7 +189,16 @@ def _set_dentry(ctx: ClsContext, inp: bytes):
     om = ctx.omap_get()
     if "_dead" in om:
         return -2, b""
-    ctx.omap_set({f"dn_{req['name']}": _j(req["inode"])})
+    key = f"dn_{req['name']}"
+    if "expect_remote_ino" in req:
+        cur = om.get(key)
+        if cur is None:
+            return -2, b""
+        parsed = json.loads(cur)
+        if parsed.get("type") != "remote" or \
+                parsed.get("ino") != req["expect_remote_ino"]:
+            return -125, b""                          # ECANCELED
+    ctx.omap_set({key: _j(req["inode"])})
     return 0, b""
 
 

@@ -186,13 +186,17 @@ class RadosClient(Dispatcher):
         # SnapContext for selfmanaged-snap pools (librados
         # selfmanaged_snap_set_write_ctx; rides every mutating MOSDOp)
         self._write_snapc: Dict[int, Tuple[int, list]] = {}
+        self._mon_acks: Dict[int, object] = {}
         mon.subscribe(name)
         mon.send_full_map(name)
         network.pump()
 
     # ---- dispatch ---------------------------------------------------------
     def ms_fast_dispatch(self, msg: Message) -> None:
-        from ..msg.messages import MWatchNotify
+        from ..msg.messages import MMonCommandAck, MWatchNotify
+        if isinstance(msg, MMonCommandAck):
+            self._mon_acks[msg.tid] = msg
+            return
         if isinstance(msg, MOSDMap):
             applied = False
             for inc in msg.incrementals:
@@ -362,6 +366,33 @@ class RadosClient(Dispatcher):
             raise _ioerror("read", oid, r.result)
         return r.data
 
+    def mon_command(self, cmd: str, **args):
+        """Run a mon administrative command by name (librados
+        mon_command / 'ceph tell mon').  In-process Monitors execute
+        directly; over TCP this sends MMonCommand and waits for the
+        ack.  Both paths take the Monitor method's own kwargs and
+        return its return value."""
+        if hasattr(self.mon, cmd):
+            value = getattr(self.mon, cmd)(**args)
+            self.mon.publish()
+            self.network.pump()
+            return value
+        from ..msg.messages import MMonCommand
+        self._tid += 1
+        tid = self._tid
+        mon_name = getattr(self.mon, "mon_name", "mon")
+        for attempt in range(MAX_ATTEMPTS):
+            self.messenger.send_message(MMonCommand(
+                tid=tid, cmd=cmd, args=dict(args)), mon_name)
+            self.network.pump()
+            ack = self._mon_acks.pop(tid, None)
+            if ack is not None:
+                if ack.result < 0:
+                    raise ValueError(ack.data.get("error",
+                                                  f"mon {ack.result}"))
+                return ack.data.get("value")
+        raise _ioerror("mon_command", cmd, -110)
+
     # ---- pool snapshots (rados_ioctx_snap_*) -------------------------------
     def _resolve_snapid(self, pool: str, snap) -> int:
         if isinstance(snap, int):
@@ -373,16 +404,12 @@ class RadosClient(Dispatcher):
         raise KeyError(f"no snap {snap!r} on pool {pool!r}")
 
     def snap_create(self, pool: str, name: str) -> int:
-        sid = self.mon.pool_snap_create(pool, name)
-        self.mon.publish()
-        self.network.pump()
-        return sid
+        return self.mon_command("pool_snap_create", pool_name=pool,
+                                snap_name=name)
 
     def snap_remove(self, pool: str, name: str) -> int:
-        sid = self.mon.pool_snap_rm(pool, name)
-        self.mon.publish()
-        self.network.pump()
-        return sid
+        return self.mon_command("pool_snap_rm", pool_name=pool,
+                                snap_name=name)
 
     def snap_list(self, pool: str) -> Dict[int, str]:
         p = self.osdmap.get_pg_pool(self.lookup_pool(pool))
@@ -437,15 +464,12 @@ class RadosClient(Dispatcher):
     # the mon only allocates/retires ids; snapshot membership lives in
     # the write SnapContext this client attaches to mutations ----------
     def selfmanaged_snap_create(self, pool: str) -> int:
-        sid = self.mon.selfmanaged_snap_create(pool)
-        self.mon.publish()
-        self.network.pump()
-        return sid
+        return self.mon_command("selfmanaged_snap_create",
+                                pool_name=pool)
 
     def selfmanaged_snap_remove(self, pool: str, snapid: int) -> None:
-        self.mon.selfmanaged_snap_remove(pool, snapid)
-        self.mon.publish()
-        self.network.pump()
+        self.mon_command("selfmanaged_snap_remove", pool_name=pool,
+                         snapid=snapid)
         pid = self.lookup_pool(pool)
         seq, snaps = self._write_snapc.get(pid, (0, []))
         if snapid in snaps:

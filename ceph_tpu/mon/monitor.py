@@ -862,6 +862,44 @@ class Monitor(Dispatcher):
             del m.pg_upmap_items[pg]
             self._topology_dirty = True
 
+    # ---- wire commands (MMonCommand -> handle_command, the
+    # 'ceph tell mon' / librados mon_command surface) ----------------------
+    def _handle_command(self, msg) -> None:
+        from ..msg.messages import MMonCommandAck
+        # ack cache: a lossy client link may replay the same command
+        # tid after a dropped ack — non-idempotent commands (snap id
+        # allocation!) must not run twice (the reference's mon session
+        # dedups by (client, tid) the same way)
+        cache = getattr(self, "_cmd_ack_cache", None)
+        if cache is None:
+            cache = self._cmd_ack_cache = {}
+        key = (msg.src, msg.tid)
+        if key in cache:
+            self.messenger.send_message(cache[key], msg.src)
+            return
+        allowed = {"pool_snap_create", "pool_snap_rm",
+                   "selfmanaged_snap_create", "selfmanaged_snap_remove",
+                   "set_pool_quota", "create_replicated_pool",
+                   "create_ec_profile", "create_ec_pool"}
+        if msg.cmd not in allowed:
+            self.messenger.send_message(MMonCommandAck(
+                tid=msg.tid, result=-22,
+                data={"error": f"unknown command {msg.cmd!r}"}),
+                msg.src)
+            return
+        try:
+            value = getattr(self, msg.cmd)(**msg.args)
+            self.publish()
+            ack = MMonCommandAck(tid=msg.tid, result=0,
+                                 data={"value": value})
+        except (KeyError, ValueError, TypeError) as e:
+            ack = MMonCommandAck(tid=msg.tid, result=-22,
+                                 data={"error": str(e)})
+        if len(cache) > 1024:
+            cache.clear()
+        cache[key] = ack
+        self.messenger.send_message(ack, msg.src)
+
     # ---- epoch publication -------------------------------------------------
     def _snapshot_inc(self) -> Incremental:
         """Full-state Incremental (crush/pools/osd states deep-copied so
@@ -1035,11 +1073,14 @@ class Monitor(Dispatcher):
         return 2 if n_up > 2 else 1
 
     def ms_fast_dispatch(self, msg: Message) -> None:
+        from ..msg.messages import MMonCommand
         if isinstance(msg, MMonSubscribe):
             # cross-process clients/daemons subscribe over the wire
             # (the in-process ones call subscribe() directly)
             self.subscribe(msg.src)
             self.send_full_map(msg.src)
+        elif isinstance(msg, MMonCommand):
+            self._handle_command(msg)
         elif isinstance(msg, MMonElection):
             self._handle_election(msg)
         elif isinstance(msg, MMonPaxos):

@@ -403,6 +403,25 @@ class MMonSubscribe(Message):
 
 
 @dataclass
+class MMonCommand(Message):
+    """Client -> mon administrative command (src/messages/
+    MMonCommand.h; the 'ceph tell mon' / librados mon_command path).
+    ``cmd`` names a registered mon command, ``args`` its parameters."""
+    tid: int = 0
+    cmd: str = ""
+    args: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class MMonCommandAck(Message):
+    """Mon -> client command completion (MMonCommandAck.h): result
+    errno + a JSON-ish payload dict."""
+    tid: int = 0
+    result: int = 0
+    data: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
 class MPGStats(Message):
     """OSD -> mgr per-PG usage stats (src/messages/MPGStats.h role):
     each primary reports its PGs' object counts and logical bytes, the

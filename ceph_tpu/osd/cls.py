@@ -167,3 +167,15 @@ def _numops_mul(ctx: ClsContext, inp: bytes):
 
 # generic lock class registers with the same registry (src/cls/lock)
 from . import cls_lock  # noqa: E402,F401
+
+
+def load_builtin_classes() -> None:
+    """Import every in-tree object class (osd_class_load_list='*'):
+    the reference OSD dlopens all cls plugins at start, so a client's
+    call works whether or not ITS process imported the owning package
+    — essential for cross-process clusters, where the OSD daemon never
+    imports ceph_tpu.rbd/cephfs/rgw on its own."""
+    import importlib
+    for mod in ("ceph_tpu.rbd.cls_rbd", "ceph_tpu.cephfs.cls_fs",
+                "ceph_tpu.rgw.cls_rgw", "ceph_tpu.journal.cls_journal"):
+        importlib.import_module(mod)

@@ -89,6 +89,9 @@ class OSD(Dispatcher):
         self.messenger = network.create_messenger(self.name)
         self.messenger.add_dispatcher_head(self)
         self.store = store if store is not None else MemStore()
+        from .cls import load_builtin_classes
+        load_builtin_classes()      # osd_class_load_list='*'
+
         self.osdmap = OSDMap()
         self.pgs: Dict[Tuple[int, int], PG] = {}
         self._ec_impls: Dict[str, object] = {}

@@ -30,8 +30,9 @@ def run(cluster, client, argv, meta_pool: str = "fsmeta",
     elif v == "ls":
         (path,) = rest or ["/"]
         for name, ino in sorted(fs.listdir(path).items()):
-            kind = {"dir": "d", "symlink": "l"}.get(ino["type"], "-")
-            print(f"{kind} {ino['size']:>10} {name}")
+            kind = {"dir": "d", "symlink": "l",
+                    "remote": "h"}.get(ino.get("type"), "-")
+            print(f"{kind} {ino.get('size', 0):>10} {name}")
     elif v == "mkdir":
         (path,) = rest
         fs.mkdir(path)

@@ -91,6 +91,26 @@ def test_rename_keeps_pointers(fs):
     f.unlink("/x/again")
 
 
+def test_repeated_hardlink_eexist_keeps_backpointer(fs):
+    """A second hardlink to the same name fails EEXIST without
+    stripping the original back-pointer (rollback only removes what
+    the failing call itself added)."""
+    c, cl, f = fs
+    f.create("/a", ORDER)
+    f.write("/a", b"keep-me")
+    f.hardlink("/a", "/b")
+    with pytest.raises(FsError) as ei:
+        f.hardlink("/a", "/b")
+    assert ei.value.result == -17
+    assert f.stat("/a")["nlink"] == 2
+    f.unlink("/a")                     # promotion must still find /b
+    assert f.read("/b") == b"keep-me"
+    # CLI ls renders the hard-link dentry without crashing
+    from ceph_tpu.tools import cephfs_cli
+    f.hardlink("/b", "/c")
+    assert cephfs_cli.run(c, cl, ["ls", "/"]) == 0
+
+
 def test_rename_between_same_file_names_is_noop(fs):
     """rename between two names of the same file is a POSIX no-op in
     BOTH directions — it must never displace the primary or purge."""
