@@ -362,7 +362,12 @@ class CephFS:
                 self._call(dir_oid(ld), "set_dentry",
                            {"name": ln, "inode": promoted,
                             "expect_remote_ino": gone["ino"]})
-            except FsError:
+            except FsError as e:
+                if e.result not in (-2, -125):
+                    # ambiguous (timeout): the promotion may have
+                    # applied — promoting another candidate or purging
+                    # could double-promote or delete live data
+                    raise
                 valid = rest         # candidate vanished: try the next
                 continue
             for od, on in rest:      # repoint surviving remotes
@@ -494,3 +499,83 @@ class CephFS:
         for d in dirs:
             sub = path.rstrip("/") + "/" + d
             yield from self.walk(sub)
+
+    # ---- fsck (cephfs-data-scan / scrub_path role) ------------------------
+    def fsck(self, repair: bool = False) -> Dict:
+        """Consistency scan over the whole tree: dangling remotes
+        (primary gone), stale back-pointers (remote gone), and orphan
+        data objects in the data pool (no referencing inode).  With
+        ``repair`` the findings are fixed: dangling remotes unlinked,
+        stale back-pointers pruned, orphan objects deleted — the
+        cephfs-data-scan + 'ceph tell mds scrub_path repair' roles.
+        Returns {dangling_remotes, stale_backpointers, orphan_objects}
+        as lists of what was found."""
+        report = {"dangling_remotes": [], "stale_backpointers": [],
+                  "orphan_objects": []}
+        live_inos = set()
+        # pass 1: walk every directory object via readdir
+        stack = [(ROOT_INO, "/")]
+        seen_dirs = set()
+        while stack:
+            dino, dpath = stack.pop()
+            if dino in seen_dirs:
+                continue
+            seen_dirs.add(dino)
+            try:
+                entries = json.loads(self._call(dir_oid(dino),
+                                                "readdir"))
+            except FsError as e:
+                if e.result != -2:
+                    # transient failure (e.g. PG down): aborting beats
+                    # mistaking a whole reachable subtree for garbage
+                    raise
+                continue
+            for name, inode in entries.items():
+                path = dpath.rstrip("/") + "/" + name
+                t = inode.get("type")
+                if t == "dir":
+                    stack.append((inode["ino"], path))
+                elif t == "file":
+                    live_inos.add(inode["ino"])
+                    for ld, ln in list(inode.get("links", [])):
+                        try:
+                            r = self._lookup(ld, ln)
+                            ok = (r.get("type") == "remote"
+                                  and r.get("ino") == inode["ino"])
+                        except FsError as e:
+                            if e.result != -2:
+                                raise
+                            ok = False
+                        if not ok:
+                            report["stale_backpointers"].append(
+                                [path, [ld, ln]])
+                            if repair:
+                                self._update_links(
+                                    dino, name,
+                                    remove_links=[[ld, ln]])
+                elif t == "remote":
+                    live_inos.add(inode.get("ino", -1))
+                    try:
+                        pd, pn = inode["primary"]
+                        pr = self._lookup(pd, pn)
+                        ok = pr.get("ino") == inode["ino"]
+                    except FsError as e:
+                        if e.result != -2:
+                            raise
+                        ok = False
+                    if not ok:
+                        report["dangling_remotes"].append(path)
+                        if repair:
+                            self._call(dir_oid(dino), "unlink",
+                                       {"name": name})
+        # pass 2: orphan data objects (ino not referenced anywhere)
+        for oid in self.client.list_objects(self.dpool):
+            try:
+                ino = int(oid.split(".")[0], 16)
+            except ValueError:
+                continue             # not a cephfs data object
+            if ino not in live_inos:
+                report["orphan_objects"].append(oid)
+                if repair:
+                    self.client.remove(self.dpool, oid)
+        return report

@@ -175,7 +175,12 @@ class RadosClient(Dispatcher):
         self.messenger = network.create_messenger(name)
         self.messenger.add_dispatcher_head(self)
         self.osdmap = OSDMap()
-        self._tid = 0
+        # per-instance random base (the reference scopes tids to the
+        # mon session/connection): a restarted client with the same
+        # entity name must not replay-match another instance's cached
+        # command acks
+        import secrets as _secrets
+        self._tid = _secrets.randbits(44) << 16
         self._replies: Dict[int, MOSDOpReply] = {}
         # cookie -> (callback, pool_id, oid, last_known_primary)
         self._watches: Dict[int, list] = {}

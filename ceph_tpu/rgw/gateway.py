@@ -355,3 +355,70 @@ class RGWLite:
                 self.dpool,
                 f"{b['id']}_mp_{name}.{upload_id}.{pn}")
         self.client.remove(self.mpool, moid)
+
+
+    # ---- garbage collection (RGWGC role, src/rgw/rgw_gc.cc) ----------------
+    def gc(self, repair: bool = False) -> Dict:
+        """Scan for debt the two-phase protocol can leave behind: data
+        objects not referenced by any committed index entry or active
+        multipart upload (crashed puts, interrupted deletes), and
+        uncommitted pending index markers.  With ``repair``, orphans
+        are deleted and pending markers cancelled — the rgw gc +
+        radosgw-admin gc process role.  Run it quiesced: a put in
+        flight legitimately holds a pending marker and unreferenced
+        chunks."""
+        report = {"orphan_objects": [], "stale_pending": []}
+        meta_oids = list(self.client.list_objects(self.mpool))
+        bucket_names = [o[len("bucket."):] for o in meta_oids
+                        if o.startswith("bucket.")]
+        referenced = set()
+        known_bids = set()
+        pending: list = []
+        for name in bucket_names:
+            try:
+                b = self.get_bucket(name)
+            except RGWError:
+                continue
+            known_bids.add(b["id"])
+            marker = ""
+            while True:              # paginate: never misread a huge
+                listing = self.list_objects(name, marker=marker,
+                                            max_keys=10000)
+                for e in listing["contents"]:
+                    referenced.update(self._chunk_oids(
+                        b["id"], e["name"], e.get("chunks", 1)))
+                if not listing["truncated"] or not listing["contents"]:
+                    break
+                marker = listing["contents"][-1]["name"]
+            idx = self._index_oid(b["id"])
+            try:
+                om = self.client.omap_get(self.mpool, idx)
+            except IOError:
+                om = {}
+            for k in om:
+                if k.startswith("pending_"):
+                    pending.append((name, idx, k[len("pending_"):]))
+        for moid in meta_oids:
+            if not moid.startswith("multipart."):
+                continue
+            mp = self._meta_get(moid)
+            if not mp:
+                continue
+            _, bid, rest = moid.split(".", 2)
+            name, upload_id = rest.rsplit(".", 1)
+            for pn in mp.get("parts", {}):
+                referenced.add(f"{bid}_mp_{name}.{upload_id}.{pn}")
+        for oid in self.client.list_objects(self.dpool):
+            bid = oid.split("_", 1)[0]
+            if bid not in known_bids:
+                continue             # not an rgw data object
+            if oid not in referenced:
+                report["orphan_objects"].append(oid)
+                if repair:
+                    self.client.remove(self.dpool, oid)
+        for name, idx, tag in pending:
+            report["stale_pending"].append([name, tag])
+            if repair:
+                self._exec(self.mpool, idx, "bucket_cancel_op",
+                           {"tag": tag})
+        return report

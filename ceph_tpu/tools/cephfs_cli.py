@@ -20,7 +20,8 @@ def run(cluster, client, argv, meta_pool: str = "fsmeta",
     ap.add_argument("--data-pool", default=data_pool)
     ap.add_argument("verb", choices=[
         "mkfs", "ls", "mkdir", "put", "get", "cat", "rm", "rmdir",
-        "mv", "ln", "stat", "tree"])
+        "mv", "ln", "stat", "tree", "fsck"])
+    ap.add_argument("--repair", action="store_true")
     ap.add_argument("args", nargs="*")
     a = ap.parse_args(argv)
     fs = CephFS(client, a.meta_pool, a.data_pool)
@@ -66,6 +67,10 @@ def run(cluster, client, argv, meta_pool: str = "fsmeta",
     elif v == "stat":
         (path,) = rest
         json.dump(fs.stat(path), sys.stdout, indent=2, sort_keys=True)
+        print()
+    elif v == "fsck":
+        json.dump(fs.fsck(repair=a.repair), sys.stdout, indent=2,
+                  sort_keys=True)
         print()
     elif v == "tree":
         (path,) = rest or ["/"]

@@ -28,6 +28,8 @@ def run(cluster, client, argv, meta_pool: str = "rgwmeta",
     s.add_argument("verb", choices=["list", "stats", "rm"])
     s.add_argument("--bucket", default=None)
     s.add_argument("--uid", default=None)
+    s = sub.add_parser("gc")
+    s.add_argument("verb", choices=["list", "process"])
     args = ap.parse_args(argv)
 
     g = RGWLite(client, args.meta_pool, args.data_pool)
@@ -54,6 +56,10 @@ def _dispatch(g, client, args, out) -> int:
         elif args.verb == "list":
             for uid in g.list_users():
                 print(uid, file=out)
+    elif args.cmd == "gc":
+        report = g.gc(repair=(args.verb == "process"))
+        json.dump(report, out, indent=2, sort_keys=True)
+        print(file=out)
     elif args.cmd == "bucket":
         if args.verb == "list":
             if args.uid:
