@@ -508,10 +508,15 @@ class CephFS:
         ``repair`` the findings are fixed: dangling remotes unlinked,
         stale back-pointers pruned, orphan objects deleted — the
         cephfs-data-scan + 'ceph tell mds scrub_path repair' roles.
-        Returns {dangling_remotes, stale_backpointers, orphan_objects}
-        as lists of what was found."""
+        Run it quiesced: a file created between the tree walk and the
+        data-pool sweep would be misread as orphaned, exactly like
+        rgw gc's in-flight-put hazard.  Returns {dangling_remotes,
+        stale_backpointers, orphan_objects, missing_dirs}; when any
+        directory OBJECT is missing (a lost metadata PG) the orphan
+        purge is withheld even under repair — those files' data is
+        what a data-scan recovery would rebuild from, never garbage."""
         report = {"dangling_remotes": [], "stale_backpointers": [],
-                  "orphan_objects": []}
+                  "orphan_objects": [], "missing_dirs": []}
         live_inos = set()
         # pass 1: walk every directory object via readdir
         stack = [(ROOT_INO, "/")]
@@ -529,6 +534,8 @@ class CephFS:
                     # transient failure (e.g. PG down): aborting beats
                     # mistaking a whole reachable subtree for garbage
                     raise
+                if dino != ROOT_INO:
+                    report["missing_dirs"].append(dpath)
                 continue
             for name, inode in entries.items():
                 path = dpath.rstrip("/") + "/" + name
@@ -568,7 +575,12 @@ class CephFS:
                         if repair:
                             self._call(dir_oid(dino), "unlink",
                                        {"name": name})
-        # pass 2: orphan data objects (ino not referenced anywhere)
+        # pass 2: orphan data objects (ino not referenced anywhere).
+        # A missing dir object means an unknown set of inos was
+        # unreachable in pass 1 — deleting "orphans" then would purge
+        # the very data a recovery would rebuild from, so repair is
+        # withheld for this pass.
+        purge_ok = repair and not report["missing_dirs"]
         for oid in self.client.list_objects(self.dpool):
             try:
                 ino = int(oid.split(".")[0], 16)
@@ -576,6 +588,6 @@ class CephFS:
                 continue             # not a cephfs data object
             if ino not in live_inos:
                 report["orphan_objects"].append(oid)
-                if repair:
+                if purge_ok:
                     self.client.remove(self.dpool, oid)
         return report

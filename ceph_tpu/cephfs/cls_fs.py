@@ -122,6 +122,10 @@ def _lookup(ctx: ClsContext, inp: bytes):
 
 @register_cls_method("fs", "readdir")
 def _readdir(ctx: ClsContext, inp: bytes):
+    if not ctx.exists:
+        # a LOST dir object must read as ENOENT, not as an empty
+        # directory — fsck distinguishes "empty" from "unknowable"
+        return -2, b""
     out = {k[3:]: json.loads(v) for k, v in ctx.omap_get().items()
            if k.startswith("dn_")}
     return 0, _j(out)

@@ -408,14 +408,20 @@ class RGWLite:
             name, upload_id = rest.rsplit(".", 1)
             for pn in mp.get("parts", {}):
                 referenced.add(f"{bid}_mp_{name}.{upload_id}.{pn}")
+        import re
+        rgw_oid = re.compile(r"^[0-9a-f]{16}_(o|c|mp)_")
         for oid in self.client.list_objects(self.dpool):
-            bid = oid.split("_", 1)[0]
-            if bid not in known_bids:
+            if not rgw_oid.match(oid):
                 continue             # not an rgw data object
-            if oid not in referenced:
-                report["orphan_objects"].append(oid)
-                if repair:
-                    self.client.remove(self.dpool, oid)
+            bid = oid.split("_", 1)[0]
+            # chunks of DELETED buckets (crashed put, then bucket rm)
+            # are orphans too — bid membership only tells us whether an
+            # index might still reference them
+            if bid in known_bids and oid in referenced:
+                continue
+            report["orphan_objects"].append(oid)
+            if repair:
+                self.client.remove(self.dpool, oid)
         for name, idx, tag in pending:
             report["stale_pending"].append([name, tag])
             if repair:

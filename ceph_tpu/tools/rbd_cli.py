@@ -56,6 +56,18 @@ def run(cluster, client, argv) -> int:
     s.add_argument("path")
     s.add_argument("image")
     s.add_argument("--order", type=int, default=22)
+    s = sub.add_parser("export-diff")
+    s.add_argument("image")
+    s.add_argument("path")
+    s.add_argument("--from-snap", default=None)
+    s.add_argument("--snap", default=None)
+    s = sub.add_parser("import-diff")
+    s.add_argument("path")
+    s.add_argument("image")
+    s = sub.add_parser("cp")
+    s.add_argument("src")
+    s.add_argument("dst")
+    s.add_argument("--snap", default=None)
     args = ap.parse_args(argv)
 
     rbd = RBD(client)
@@ -109,6 +121,16 @@ def run(cluster, client, argv) -> int:
         img = Image(client, pool, args.image)
         with open(args.path, "wb") as f:
             f.write(img.read(0, img.size()))
+    elif args.cmd == "export-diff":
+        img = Image(client, pool, args.image)
+        with open(args.path, "wb") as fh:
+            fh.write(img.export_diff(from_snap=args.from_snap,
+                                     to_snap=args.snap))
+    elif args.cmd == "import-diff":
+        with open(args.path, "rb") as fh:
+            Image(client, pool, args.image).import_diff(fh.read())
+    elif args.cmd == "cp":
+        rbd.copy(pool, args.src, pool, args.dst, src_snap=args.snap)
     elif args.cmd == "import":
         with open(args.path, "rb") as f:
             data = f.read()

@@ -35,7 +35,7 @@ def test_fsck_clean_tree(env):
     f.symlink("/lnk", "/d/file")
     report = f.fsck()
     assert report == {"dangling_remotes": [], "stale_backpointers": [],
-                      "orphan_objects": []}
+                      "orphan_objects": [], "missing_dirs": []}
 
 
 def test_fsck_finds_and_repairs(env):
@@ -61,7 +61,7 @@ def test_fsck_finds_and_repairs(env):
     assert file_oid(0xdead, 0) in report["orphan_objects"]
     # repaired: second pass is clean and the healthy file survived
     assert f.fsck() == {"dangling_remotes": [], "stale_backpointers": [],
-                        "orphan_objects": []}
+                        "orphan_objects": [], "missing_dirs": []}
     assert f.read("/h") == b"k"
     assert not f.exists("/dangling")
     with pytest.raises(IOError):
@@ -95,6 +95,45 @@ def test_rgw_gc(env):
     g.upload_part("b", "inflight", mpid, 2, b"-two")
     g.complete_multipart("b", "inflight", mpid)
     assert g.get_object("b", "inflight") == b"part-two"
+
+
+def test_gc_collects_deleted_bucket_debris(env):
+    """Crashed put, then bucket rm: the stranded chunks' bucket id no
+    longer exists, but gc still reclaims them (bid-pattern match, not
+    known-bucket membership)."""
+    c, cl = env
+    g = RGWLite(cl, "rgwmeta", "rgwdata")
+    g.create_user("u")
+    g.create_bucket("u", "doomed")
+    bid = g.get_bucket("doomed")["id"]
+    g._exec("rgwmeta", g._index_oid(bid), "bucket_prepare_op",
+            {"tag": "t", "name": "ghost", "op": "put"})
+    g._write_chunked(g._data_oid(bid, "ghost"), b"stranded")
+    g.delete_bucket("doomed")          # num_objects==0: delete passes
+    report = g.gc(repair=True)
+    assert g._data_oid(bid, "ghost") in report["orphan_objects"]
+    with pytest.raises(IOError):
+        cl.read("rgwdata", g._data_oid(bid, "ghost"))
+
+
+def test_fsck_withholds_purge_on_missing_dir(env):
+    """A lost directory OBJECT makes its subtree's inos unknowable;
+    fsck must report the orphan candidates but NOT delete them — that
+    data is what a recovery would rebuild from."""
+    c, cl = env
+    f = CephFS(cl, "fsmeta", "fsdata")
+    f.mkfs()
+    f.mkdir("/broken")
+    f.create("/broken/file", ORDER)
+    f.write("/broken/file", b"survivor")
+    ino = f.stat("/broken/file")["ino"]
+    dino = f.stat("/broken")["ino"]
+    cl.remove("fsmeta", dir_oid(dino))     # lose the dir object
+    report = f.fsck(repair=True)
+    assert "/broken" in report["missing_dirs"]
+    assert file_oid(ino, 0) in report["orphan_objects"]
+    # withheld: the data object survives despite repair=True
+    assert cl.read("fsdata", file_oid(ino, 0)).startswith(b"survivor")
 
 
 def test_cli_verbs(env, capsys):

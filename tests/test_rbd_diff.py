@@ -1,0 +1,101 @@
+"""rbd export-diff / import-diff / cp: the incremental-backup flow.
+
+Reference workflow (rbd export-diff --from-snap A @B | rbd import-diff
+on the backup cluster): a full export at the first snapshot, then
+incremental diffs replayed in order, reproduce the source bit-for-bit
+— including shrinks and punched holes.
+"""
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.rbd import Image, RBD
+
+ORDER = 12
+OBJ = 1 << ORDER
+
+
+@pytest.fixture()
+def env():
+    a = MiniCluster(n_osds=4)
+    a.create_replicated_pool("rbd", size=3, pg_num=8)
+    b = MiniCluster(n_osds=3)
+    b.create_replicated_pool("rbd", size=2, pg_num=8)
+    return a.client("client.a"), b.client("client.b")
+
+
+def test_incremental_backup_roundtrip(env):
+    ca, cb = env
+    RBD(ca).create("rbd", "img", 6 * OBJ, ORDER)
+    src = Image(ca, "rbd", "img")
+    src.write(0, b"base" * 500)
+    src.write(3 * OBJ, b"far")
+    src.snap_create("s1")
+    # full export at s1 -> seed the backup image
+    full = src.export_diff(to_snap="s1")
+    RBD(cb).create("rbd", "img", 6 * OBJ, ORDER)
+    dst = Image(cb, "rbd", "img")
+    dst.import_diff(full)
+    assert dst.read(0, 2000) == src.read(0, 2000)
+    assert dst.read(3 * OBJ, 3) == b"far"
+    # mutate: overwrite, punch a hole, shrink, then snap again
+    src.write(OBJ, b"second-gen" * 100)
+    src.discard(3 * OBJ, OBJ)
+    src.resize(5 * OBJ)
+    src.snap_create("s2")
+    inc = src.export_diff(from_snap="s1", to_snap="s2")
+    dst.import_diff(inc)
+    assert dst.size() == 5 * OBJ
+    s2 = Image(ca, "rbd", "img", snapshot="s2")
+    for off, ln in [(0, 2000), (OBJ, 1000), (3 * OBJ, OBJ),
+                    (4 * OBJ, OBJ)]:
+        assert dst.read(off, ln) == s2.read(off, ln)
+    # the incremental is much smaller than a full export
+    assert len(inc) < len(src.export_diff(to_snap="s2"))
+
+
+def test_diff_head_and_identity(env):
+    ca, _ = env
+    RBD(ca).create("rbd", "i", 4 * OBJ, ORDER)
+    img = Image(ca, "rbd", "i")
+    img.write(100, b"payload")
+    img.snap_create("s")
+    # no changes since the snap: diff carries only the size record
+    import json
+    assert json.loads(img.export_diff(from_snap="s")) == [["s", 4 * OBJ]]
+    img.write(200, b"x")
+    recs = json.loads(img.export_diff(from_snap="s"))
+    assert any(r[0] == "w" for r in recs)
+
+
+def test_cp(env):
+    ca, _ = env
+    RBD(ca).create("rbd", "src", 4 * OBJ, ORDER)
+    img = Image(ca, "rbd", "src")
+    img.write(0, b"copy-me" * 100)
+    img.snap_create("point")
+    img.write(0, b"after!!" * 100)
+    rbd = RBD(ca)
+    rbd.copy("rbd", "src", "rbd", "dup")
+    rbd.copy("rbd", "src", "rbd", "dup-at-snap", src_snap="point")
+    assert Image(ca, "rbd", "dup").read(0, 7) == b"after!!"
+    assert Image(ca, "rbd", "dup-at-snap").read(0, 7) == b"copy-me"
+    # copies are independent of the source
+    img.write(0, b"mutated")
+    assert Image(ca, "rbd", "dup").read(0, 7) == b"after!!"
+
+
+def test_cli_roundtrip(env, tmp_path):
+    ca, cb = env
+    from ceph_tpu.tools import rbd_cli
+    run_a = lambda *x: rbd_cli.run(None, ca, ["-p", "rbd", *x])
+    run_b = lambda *x: rbd_cli.run(None, cb, ["-p", "rbd", *x])
+    run_a("create", "d", "--size", str(2 * OBJ), "--order", str(ORDER))
+    Image(ca, "rbd", "d").write(0, b"cli-diff")
+    run_a("snap", "create", "d@s1")
+    p = str(tmp_path / "d.diff")
+    run_a("export-diff", "d", p, "--snap", "s1")
+    run_b("create", "d", "--size", str(2 * OBJ), "--order", str(ORDER))
+    run_b("import-diff", p, "d")
+    assert Image(cb, "rbd", "d").read(0, 8) == b"cli-diff"
+    run_a("cp", "d", "d2")
+    assert Image(ca, "rbd", "d2").read(0, 8) == b"cli-diff"

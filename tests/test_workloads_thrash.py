@@ -1,0 +1,77 @@
+"""Combined client workloads under OSD thrashing (qa/workunits +
+qa/tasks Thrasher role): rbd, cephfs and rgw all running against one
+cluster while OSDs are killed, revived, and marked out — every layer
+must stay consistent through re-peer and recovery.
+"""
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.cephfs import CephFS
+from ceph_tpu.rbd import Image, RBD
+from ceph_tpu.rgw import RGWLite
+
+ORDER = 12
+OBJ = 1 << ORDER
+
+
+def settle(c, rounds=8, dt=6.0):
+    for _ in range(rounds):
+        c.tick(dt=dt)
+
+
+def test_three_workloads_survive_thrashing():
+    c = MiniCluster(n_osds=6)
+    for p in ("rbd", "fsmeta", "fsdata", "rgwmeta"):
+        c.create_replicated_pool(p, size=3, pg_num=8)
+    c.create_ec_pool("rgwdata", k=2, m=1, plugin="isa", pg_num=8)
+    cl = c.client("client.w")
+
+    rbd = RBD(cl)
+    rbd.create("rbd", "vm", 4 * OBJ, ORDER, journaling=False)
+    img = Image(cl, "rbd", "vm")
+    fs = CephFS(cl, "fsmeta", "fsdata")
+    fs.mkfs()
+    fs.mkdir("/logs")
+    g = RGWLite(cl, "rgwmeta", "rgwdata")
+    g.create_user("app")
+    g.create_bucket("app", "events")
+
+    expectations = {}
+    victim_cycle = [0, 3, 1]
+    for gen, victim in enumerate(victim_cycle):
+        payload = bytes([65 + gen]) * 512
+        img.write(gen * OBJ, payload)
+        fs.create(f"/logs/gen{gen}", ORDER)
+        fs.write(f"/logs/gen{gen}", payload)
+        g.put_object("events", f"e{gen}", payload)
+        expectations[gen] = payload
+
+        c.kill_osd(victim)
+        settle(c)
+        c.mark_osd_out(victim)
+        settle(c, rounds=5, dt=2.0)
+
+        # everything written so far reads back while degraded
+        for g2, data in expectations.items():
+            assert img.read(g2 * OBJ, 512) == data
+            assert fs.read(f"/logs/gen{g2}") == data
+            assert g.get_object("events", f"e{g2}") == data
+
+        c.revive_osd(victim)
+        c.mon.mark_osd_in(victim)
+        c.publish()
+        settle(c, rounds=5, dt=2.0)
+
+    # final sweep after all thrashing: listings + consistency tools
+    assert sorted(fs.listdir("/logs")) == ["gen0", "gen1", "gen2"]
+    assert [e["name"] for e in
+            g.list_objects("events")["contents"]] == ["e0", "e1", "e2"]
+    assert fs.fsck() == {"dangling_remotes": [], "stale_backpointers": [],
+                         "orphan_objects": [], "missing_dirs": []}
+    assert g.gc() == {"orphan_objects": [], "stale_pending": []}
+    assert c.health().startswith("HEALTH")
+    # scrub finds nothing to repair
+    c.scrub()
+    settle(c, rounds=3, dt=2.0)
+    for g2, data in expectations.items():
+        assert img.read(g2 * OBJ, 512) == data
