@@ -534,8 +534,7 @@ class CephFS:
                     # transient failure (e.g. PG down): aborting beats
                     # mistaking a whole reachable subtree for garbage
                     raise
-                if dino != ROOT_INO:
-                    report["missing_dirs"].append(dpath)
+                report["missing_dirs"].append(dpath)
                 continue
             for name, inode in entries.items():
                 path = dpath.rstrip("/") + "/" + name
@@ -550,6 +549,13 @@ class CephFS:
                             ok = (r.get("type") == "remote"
                                   and r.get("ino") == inode["ino"])
                         except FsError as e:
+                            if e.result == -116:
+                                # the remote's DIR is lost: unknowable,
+                                # never repaired away (recovery may
+                                # rebuild it)
+                                report["missing_dirs"].append(
+                                    f"dir#{ld}")
+                                continue
                             if e.result != -2:
                                 raise
                             ok = False
@@ -567,6 +573,12 @@ class CephFS:
                         pr = self._lookup(pd, pn)
                         ok = pr.get("ino") == inode["ino"]
                     except FsError as e:
+                        if e.result == -116:
+                            # primary's DIR is lost: this remote is
+                            # the surviving namespace reference a
+                            # recovery would reattach — keep it
+                            report["missing_dirs"].append(f"dir#{pd}")
+                            continue
                         if e.result != -2:
                             raise
                         ok = False

@@ -113,6 +113,11 @@ def _unlink(ctx: ClsContext, inp: bytes):
 
 @register_cls_method("fs", "lookup")
 def _lookup(ctx: ClsContext, inp: bytes):
+    if not ctx.exists:
+        # the directory OBJECT itself is gone (lost metadata PG) —
+        # report ESTALE, not "no such dentry": callers like fsck must
+        # distinguish a deleted name from an unknowable directory
+        return -116, b""
     req = _parse(inp)
     v = ctx.omap_get().get(f"dn_{req['name']}")
     if v is None:

@@ -410,13 +410,19 @@ class RGWLite:
                 referenced.add(f"{bid}_mp_{name}.{upload_id}.{pn}")
         import re
         rgw_oid = re.compile(r"^[0-9a-f]{16}_(o|c|mp)_")
+        # a bucket whose INDEX object exists but whose bucket.<name>
+        # meta was unreadable this pass is unknowable — its data must
+        # never be purged (the index may reference it); only a bucket
+        # with NO index object left (delete_bucket removed it) has
+        # truly deleted debris
+        index_bids = {o[len(".dir."):] for o in meta_oids
+                      if o.startswith(".dir.")}
         for oid in self.client.list_objects(self.dpool):
             if not rgw_oid.match(oid):
                 continue             # not an rgw data object
             bid = oid.split("_", 1)[0]
-            # chunks of DELETED buckets (crashed put, then bucket rm)
-            # are orphans too — bid membership only tells us whether an
-            # index might still reference them
+            if bid in index_bids and bid not in known_bids:
+                continue             # index alive, meta unreadable
             if bid in known_bids and oid in referenced:
                 continue
             report["orphan_objects"].append(oid)

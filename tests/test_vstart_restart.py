@@ -66,8 +66,18 @@ def test_kill9_restart_recovers_from_disk(cluster):
     assert _retry_write(cl, "p", "obj1", data1) == 0
     assert cl.read("p", "obj1") == data1
 
-    acting, primary = _acting(cl, "obj1")
-    assert len(acting) == 3
+    # under heavy host load a daemon's heartbeats can momentarily lapse
+    # past the grace (MOSDBoot re-ups it); wait for the full acting set
+    # instead of sampling one instant
+    deadline = time.monotonic() + 60
+    while True:
+        acting, primary = _acting(cl, "obj1")
+        if len(acting) == 3:
+            break
+        assert time.monotonic() < deadline, f"acting stuck at {acting}"
+        time.sleep(1.0)
+        cl.mon.send_full_map(cl.name)
+        cl.network.pump()
     victim = next(o for o in acting if o != primary)
     c.kill_osd(victim)
     assert _wait_state(c, cl, victim, up=False), "victim never marked down"
