@@ -6,7 +6,7 @@ loads libcls_rbd.so into every OSD.
 """
 from . import cls_rbd  # noqa: F401  (registers the cls methods)
 from .image import Image, RBD, RBDError, apply_image_event
-from .mirror import ImageMirror
+from .mirror import ImageMirror, PoolMirror
 
-__all__ = ["Image", "ImageMirror", "RBD", "RBDError",
+__all__ = ["Image", "ImageMirror", "PoolMirror", "RBD", "RBDError",
            "apply_image_event"]

@@ -63,3 +63,47 @@ class ImageMirror:
     def trim_source(self) -> int:
         """Reclaim source journal sets every consumer has passed."""
         return self.journal.trim()
+
+
+class PoolMirror:
+    """Pool-mode mirroring (rbd mirror pool enable + the rbd-mirror
+    daemon's pool watcher): every JOURNALED image in the source pool
+    gets an ImageMirror to the destination; images that appear later
+    are picked up on the next run.  Non-journaled images are skipped,
+    like the reference skips images without the journaling feature."""
+
+    def __init__(self, src_client, src_pool: str, dst_client,
+                 dst_pool: str, dst_data_pool: str = None):
+        self.src_client = src_client
+        self.src_pool = src_pool
+        self.dst_client = dst_client
+        self.dst_pool = dst_pool
+        self.dst_data_pool = dst_data_pool
+        self.mirrors: dict = {}
+
+    def run_once(self) -> dict:
+        """Scan the pool, attach new journaled images, replay every
+        mirror; returns {image: events_applied}."""
+        applied = {}
+        for name in RBD(self.src_client).list(self.src_pool):
+            m = self.mirrors.get(name)
+            if m is None:
+                try:
+                    m = ImageMirror(self.src_client, self.src_pool,
+                                    name, self.dst_client,
+                                    self.dst_pool, self.dst_data_pool)
+                except RBDError as e:
+                    if e.result == -22:      # journaling off: skip
+                        continue
+                    raise
+                self.mirrors[name] = m
+            applied[name] = m.run_once()
+        # forget images that vanished from the source
+        for name in list(self.mirrors):
+            if name not in applied:
+                del self.mirrors[name]
+        return applied
+
+    def trim_sources(self) -> None:
+        for m in self.mirrors.values():
+            m.trim_source()

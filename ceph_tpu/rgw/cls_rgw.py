@@ -67,6 +67,10 @@ def _cancel(ctx: ClsContext, inp: bytes):
 def _list(ctx: ClsContext, inp: bytes):
     """Listing with prefix/marker/max_keys, server-side like
     cls_rgw_bucket_list so huge buckets never ship their whole omap."""
+    if not ctx.exists:
+        # a LOST index object must answer ESTALE, never "empty
+        # bucket" — gc would purge a live bucket's data otherwise
+        return -116, b""
     req = _parse(inp)
     prefix = req.get("prefix", "")
     marker = req.get("marker", "")
@@ -96,6 +100,8 @@ def _get_entry(ctx: ClsContext, inp: bytes):
 
 @register_cls_method("rgw", "bucket_stats")
 def _stats(ctx: ClsContext, inp: bytes):
+    if not ctx.exists:
+        return -116, b""      # lost index: unknowable, not empty
     om = ctx.omap_get()
     entries = [json.loads(v) for k, v in om.items()
                if k.startswith("entry_")]

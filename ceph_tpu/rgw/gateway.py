@@ -277,25 +277,38 @@ class RGWLite:
             {"prefix": prefix, "marker": marker,
              "max_keys": max_keys if not delimiter else 100000}))
         if not delimiter:
+            nm = (raw["entries"][-1]["name"] if raw["entries"] else "")
             return {"contents": raw["entries"], "common_prefixes": [],
-                    "truncated": raw["truncated"]}
-        contents, prefixes, seen = [], [], set()
-        truncated = raw["truncated"]
-        for i, e in enumerate(raw["entries"]):
+                    "truncated": raw["truncated"], "next_marker": nm}
+        # delimiter rollup with GROUP-atomic pagination: a common
+        # prefix is never split across pages (the whole contiguous key
+        # group is consumed before the cap applies), so resuming from
+        # next_marker never re-emits a prefix
+        contents, prefixes = [], []
+        entries = raw["entries"]
+        next_marker = ""
+        i = 0
+        truncated = False
+        while i < len(entries):
+            if len(contents) + len(prefixes) >= max_keys:
+                truncated = True
+                break
+            e = entries[i]
             rest = e["name"][len(prefix):]
             if delimiter in rest:
                 cp = prefix + rest.split(delimiter, 1)[0] + delimiter
-                if cp not in seen:
-                    seen.add(cp)
-                    prefixes.append(cp)
+                prefixes.append(cp)
+                while i < len(entries) and \
+                        entries[i]["name"].startswith(cp):
+                    next_marker = entries[i]["name"]
+                    i += 1
             else:
                 contents.append(e)
-            if len(contents) + len(prefixes) >= max_keys:
-                # anything left past the cut means this page is partial
-                truncated = truncated or i + 1 < len(raw["entries"])
-                break
+                next_marker = e["name"]
+                i += 1
+        truncated = truncated or raw["truncated"]
         return {"contents": contents, "common_prefixes": prefixes,
-                "truncated": truncated}
+                "truncated": truncated, "next_marker": next_marker}
 
     # ---- multipart (RGWMultipart*) -----------------------------------------
     def initiate_multipart(self, bucket: str, name: str) -> str:
@@ -374,22 +387,30 @@ class RGWLite:
         referenced = set()
         known_bids = set()
         pending: list = []
+        protected_bids = set()
         for name in bucket_names:
             try:
                 b = self.get_bucket(name)
             except RGWError:
                 continue
             known_bids.add(b["id"])
-            marker = ""
-            while True:              # paginate: never misread a huge
-                listing = self.list_objects(name, marker=marker,
-                                            max_keys=10000)
-                for e in listing["contents"]:
-                    referenced.update(self._chunk_oids(
-                        b["id"], e["name"], e.get("chunks", 1)))
-                if not listing["truncated"] or not listing["contents"]:
-                    break
-                marker = listing["contents"][-1]["name"]
+            try:
+                marker = ""
+                while True:          # paginate: never misread a huge
+                    listing = self.list_objects(name, marker=marker,
+                                                max_keys=10000)
+                    for e in listing["contents"]:
+                        referenced.update(self._chunk_oids(
+                            b["id"], e["name"], e.get("chunks", 1)))
+                    if not listing["truncated"] or \
+                            not listing["contents"]:
+                        break
+                    marker = listing["contents"][-1]["name"]
+            except RGWError:
+                # index unreadable/lost (ESTALE): this bucket's
+                # references are unknowable — its data must never be
+                # classified as orphaned
+                protected_bids.add(b["id"])
             idx = self._index_oid(b["id"])
             try:
                 om = self.client.omap_get(self.mpool, idx)
@@ -421,6 +442,8 @@ class RGWLite:
             if not rgw_oid.match(oid):
                 continue             # not an rgw data object
             bid = oid.split("_", 1)[0]
+            if bid in protected_bids:
+                continue             # meta alive, index unreadable
             if bid in index_bids and bid not in known_bids:
                 continue             # index alive, meta unreadable
             if bid in known_bids and oid in referenced:

@@ -112,10 +112,14 @@ class S3Frontend:
             return 204, {}, b""
         if method == "GET":
             self._owner_check(user, bucket)
+            v2 = query.get("list-type") == "2"
+            marker = (query.get("continuation-token")
+                      or query.get("start-after", "")) if v2 \
+                else query.get("marker", "")
             res = self.rgw.list_objects(
                 bucket, prefix=query.get("prefix", ""),
                 delimiter=query.get("delimiter", ""),
-                marker=query.get("marker", ""),
+                marker=marker,
                 max_keys=int(query.get("max-keys", "1000")))
             items = "".join(
                 f"<Contents><Key>{escape(e['name'])}</Key>"
@@ -126,10 +130,19 @@ class S3Frontend:
                 f"<CommonPrefixes><Prefix>{escape(p)}</Prefix>"
                 f"</CommonPrefixes>"
                 for p in res["common_prefixes"])
+            extra = ""
+            if v2:
+                count = len(res["contents"]) + len(res["common_prefixes"])
+                extra = f"<KeyCount>{count}</KeyCount>"
+                if res["truncated"] and res.get("next_marker"):
+                    tok = escape(res["next_marker"])
+                    extra += (f"<NextContinuationToken>{tok}"
+                              f"</NextContinuationToken>")
             xml = (f'<?xml version="1.0"?><ListBucketResult>'
                    f"<Name>{escape(bucket)}</Name>"
                    f"<IsTruncated>{str(res['truncated']).lower()}"
-                   f"</IsTruncated>{items}{cps}</ListBucketResult>")
+                   f"</IsTruncated>{extra}{items}{cps}"
+                   f"</ListBucketResult>")
             return 200, {"Content-Type": "application/xml"}, xml.encode()
         return _err(405, "MethodNotAllowed")
 

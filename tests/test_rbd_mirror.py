@@ -142,3 +142,27 @@ def test_mirror_requires_journaling(pair):
     RBD(ca).create("rbd", "plain", OBJ, ORDER)
     with pytest.raises(RBDError):
         ImageMirror(ca, "rbd", "plain", cb, "rbd")
+
+
+def test_pool_mirror(pair):
+    """Pool-mode mirroring: every journaled image replicates; plain
+    images are skipped; images created later are picked up."""
+    from ceph_tpu.rbd import PoolMirror
+    a, b, ca, cb = pair
+    RBD(ca).create("rbd", "second", 4 * OBJ, ORDER, journaling=True)
+    RBD(ca).create("rbd", "plain", OBJ, ORDER)        # not journaled
+    Image(ca, "rbd", "img").write(0, b"img-bytes")
+    Image(ca, "rbd", "second").write(0, b"second-bytes")
+    pm = PoolMirror(ca, "rbd", cb, "rbd")
+    applied = pm.run_once()
+    assert applied == {"img": 1, "second": 1}
+    assert Image(cb, "rbd", "img").read(0, 9) == b"img-bytes"
+    assert Image(cb, "rbd", "second").read(0, 12) == b"second-bytes"
+    assert "plain" not in RBD(cb).list("rbd")
+    # a later image joins on the next scan
+    RBD(ca).create("rbd", "late", OBJ, ORDER, journaling=True)
+    Image(ca, "rbd", "late").write(0, b"late-bytes")
+    applied = pm.run_once()
+    assert applied["late"] == 1
+    assert Image(cb, "rbd", "late").read(0, 10) == b"late-bytes"
+    pm.trim_sources()
