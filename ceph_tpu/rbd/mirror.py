@@ -87,6 +87,17 @@ class PoolMirror:
         applied = {}
         for name in RBD(self.src_client).list(self.src_pool):
             m = self.mirrors.get(name)
+            if m is not None:
+                try:
+                    cur_id = Image(self.src_client, self.src_pool,
+                                   name).id
+                except RBDError:
+                    cur_id = None
+                if cur_id != m.src.id:
+                    # deleted-and-recreated under the same name: the
+                    # cached mirror replays a dead journal forever
+                    del self.mirrors[name]
+                    m = None
             if m is None:
                 try:
                     m = ImageMirror(self.src_client, self.src_pool,

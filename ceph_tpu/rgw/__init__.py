@@ -7,6 +7,7 @@ methods); ``gateway.RGWLite`` is the RGWRados-role core and
 """
 from . import cls_rgw  # noqa: F401  (registers the cls methods)
 from .gateway import RGWError, RGWLite
-from .http import S3Frontend, serve
+from .http import S3Frontend, SwiftFrontend, serve
 
-__all__ = ["RGWError", "RGWLite", "S3Frontend", "serve"]
+__all__ = ["RGWError", "RGWLite", "S3Frontend", "SwiftFrontend",
+           "serve"]

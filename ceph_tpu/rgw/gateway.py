@@ -297,11 +297,20 @@ class RGWLite:
             rest = e["name"][len(prefix):]
             if delimiter in rest:
                 cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+                marker_before_group = next_marker
                 prefixes.append(cp)
                 while i < len(entries) and \
                         entries[i]["name"].startswith(cp):
                     next_marker = entries[i]["name"]
                     i += 1
+                if i == len(entries) and raw["truncated"]:
+                    # the group may continue past the raw fetch cap:
+                    # withdraw it from this page and resume BEFORE it,
+                    # so no prefix is ever emitted twice
+                    prefixes.pop()
+                    next_marker = marker_before_group or marker
+                    truncated = True
+                    break
             else:
                 contents.append(e)
                 next_marker = e["name"]

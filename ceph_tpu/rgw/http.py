@@ -218,3 +218,148 @@ def serve(frontend: S3Frontend, port: int = 0):
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]
+
+
+class SwiftFrontend:
+    """Swift-dialect REST frontend (rgw_rest_swift.cc role): the same
+    RGWLite core behind OpenStack-Swift paths.
+
+    - ``GET /auth/v1.0`` with ``X-Auth-User: <uid>:swift`` and
+      ``X-Auth-Key: <secret_key>`` answers ``X-Auth-Token`` (a
+      stateless HMAC over the uid, so any frontend instance validates
+      it) and ``X-Storage-Url`` (``/v1/AUTH_<uid>``).
+    - ``/v1/AUTH_<uid>/<container>[/<object>]``: container PUT/GET
+      (plain-text or ``format=json`` listings)/DELETE, object
+      PUT/GET/HEAD/DELETE.  Swift names buckets "containers" and
+      accounts map to rgw users (RGWSwift).
+    """
+
+    def __init__(self, rgw: RGWLite):
+        self.rgw = rgw
+
+    def _token_for(self, user: Dict) -> str:
+        mac = hmac.new(user["secret_key"].encode(),
+                       f"swift:{user['uid']}".encode(), hashlib.sha1)
+        return f"AUTH_tk{mac.hexdigest()}"
+
+    def _user_for_token(self, uid: str, token: str) -> Optional[Dict]:
+        try:
+            user = self.rgw.get_user(uid)
+        except RGWError:
+            return None
+        if hmac.compare_digest(self._token_for(user), token or ""):
+            return user
+        return None
+
+    def handle(self, method: str, path: str,
+               headers: Optional[Dict[str, str]] = None,
+               body: bytes = b"",
+               query: Optional[Dict[str, str]] = None
+               ) -> Tuple[int, Dict[str, str], bytes]:
+        headers = headers or {}
+        query = query or {}
+        if path.startswith("/auth/v1.0"):
+            xuser = headers.get("X-Auth-User", "")
+            uid = xuser.split(":", 1)[0]
+            try:
+                user = self.rgw.get_user(uid)
+            except RGWError:
+                return 401, {}, b"invalid user"
+            if headers.get("X-Auth-Key") != user["secret_key"]:
+                return 401, {}, b"invalid key"
+            return 204, {"X-Auth-Token": self._token_for(user),
+                         "X-Storage-Url": f"/v1/AUTH_{uid}"}, b""
+        if not path.startswith("/v1/AUTH_"):
+            return 404, {}, b"not found"
+        parts = path[len("/v1/AUTH_"):].split("/", 2)
+        uid = parts[0]
+        user = self._user_for_token(uid, headers.get("X-Auth-Token"))
+        if user is None:
+            return 401, {}, b"bad token"
+        container = parts[1] if len(parts) > 1 and parts[1] else ""
+        obj = parts[2] if len(parts) > 2 else ""
+        try:
+            if not container:
+                if method == "GET":      # account listing
+                    names = self.rgw.list_buckets(uid)
+                    if not names:
+                        return 204, {}, b""
+                    return (200, {"Content-Type": "text/plain"},
+                            ("\n".join(names) + "\n").encode())
+                return 405, {}, b""
+            if not obj:
+                return self._container_op(method, user, container,
+                                          query)
+            return self._object_op(method, user, container, obj, body)
+        except RGWError as e:
+            status = {-2: 404, -17: 202, -39: 409,
+                      -13: 403}.get(e.result, 500)
+            return status, {}, str(e).encode()
+        except ValueError as e:
+            return 412, {}, str(e).encode()   # Swift's bad-param code
+        except Exception as e:    # a handler thread must always reply
+            return 500, {}, repr(e).encode()
+
+    def _check_owner(self, user: Dict, container: str) -> None:
+        if self.rgw.get_bucket(container)["owner"] != user["uid"]:
+            raise RGWError("acl", -13, "forbidden")
+
+    def _container_op(self, method, user, container, query):
+        import json as _json
+        if method == "PUT":
+            try:
+                self.rgw.create_bucket(user["uid"], container)
+            except RGWError as e:
+                if e.result != -17:
+                    raise
+                return 202, {}, b""      # existed: Swift says Accepted
+            return 201, {}, b""
+        if method == "DELETE":
+            self._check_owner(user, container)
+            self.rgw.delete_bucket(container)
+            return 204, {}, b""
+        if method in ("GET", "HEAD"):
+            self._check_owner(user, container)
+            res = self.rgw.list_objects(
+                container, prefix=query.get("prefix", ""),
+                delimiter=query.get("delimiter", ""),
+                marker=query.get("marker", ""),
+                max_keys=int(query.get("limit", "10000")))
+            if method == "HEAD":
+                stats = self.rgw.bucket_stats(container)
+                return 204, {"X-Container-Object-Count":
+                             str(stats["num_objects"])}, b""
+            if query.get("format") == "json":
+                out = _json.dumps(
+                    [{"name": e["name"], "bytes": e["size"],
+                      "hash": e["etag"]} for e in res["contents"]] +
+                    [{"subdir": p} for p in res["common_prefixes"]])
+                return 200, {"Content-Type": "application/json"}, \
+                    out.encode()
+            names = [e["name"] for e in res["contents"]] + \
+                res["common_prefixes"]
+            return 200, {"Content-Type": "text/plain"}, \
+                ("\n".join(names) + ("\n" if names else "")).encode()
+        return 405, {}, b""
+
+    def _object_op(self, method, user, container, obj, body):
+        if method == "PUT":
+            self._check_owner(user, container)
+            meta = self.rgw.put_object(container, obj, body)
+            return 201, {"Etag": meta["etag"]}, b""
+        if method == "GET":
+            self._check_owner(user, container)
+            data = self.rgw.get_object(container, obj)
+            meta = self.rgw.head_object(container, obj)
+            return 200, {"Content-Type": meta["content_type"],
+                         "Etag": meta["etag"]}, data
+        if method == "HEAD":
+            self._check_owner(user, container)
+            meta = self.rgw.head_object(container, obj)
+            return 200, {"Content-Length": str(meta["size"]),
+                         "Etag": meta["etag"]}, b""
+        if method == "DELETE":
+            self._check_owner(user, container)
+            self.rgw.delete_object(container, obj)
+            return 204, {}, b""
+        return 405, {}, b""

@@ -166,3 +166,21 @@ def test_pool_mirror(pair):
     assert applied["late"] == 1
     assert Image(cb, "rbd", "late").read(0, 10) == b"late-bytes"
     pm.trim_sources()
+
+
+def test_pool_mirror_recreated_image(pair):
+    """Delete + recreate under the same name between scans: the pool
+    mirror rebinds to the NEW image id instead of replaying the dead
+    journal forever."""
+    from ceph_tpu.rbd import PoolMirror
+    a, b, ca, cb = pair
+    Image(ca, "rbd", "img").write(0, b"old-gen")
+    pm = PoolMirror(ca, "rbd", cb, "rbd")
+    pm.run_once()
+    RBD(ca).remove("rbd", "img")
+    RBD(cb).remove("rbd", "img")       # fresh slate on the target too
+    RBD(ca).create("rbd", "img", 4 * OBJ, ORDER, journaling=True)
+    Image(ca, "rbd", "img").write(0, b"new-gen!")
+    applied = pm.run_once()
+    assert applied["img"] == 1
+    assert Image(cb, "rbd", "img").read(0, 8) == b"new-gen!"

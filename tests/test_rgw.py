@@ -338,3 +338,61 @@ def test_gc_protects_bucket_with_lost_index(rgw):
     with pytest.raises(RGWError) as ei:
         g.list_objects("b")
     assert ei.value.result == -116
+
+
+def test_swift_api(rgw):
+    """The Swift dialect over the same gateway core: auth token,
+    container + object CRUD, listings (plain + json + delimiter)."""
+    import http.client
+    from ceph_tpu.rgw import SwiftFrontend
+
+    c, cl, g, user = rgw
+    fe = SwiftFrontend(g)
+    # auth handshake
+    st, hdrs, _ = fe.handle("GET", "/auth/v1.0", {
+        "X-Auth-User": "alice:swift",
+        "X-Auth-Key": user["secret_key"]})
+    assert st == 204
+    token = hdrs["X-Auth-Token"]
+    url = hdrs["X-Storage-Url"]
+    assert url == "/v1/AUTH_alice"
+    assert fe.handle("GET", "/auth/v1.0", {
+        "X-Auth-User": "alice:swift", "X-Auth-Key": "wrong"})[0] == 401
+    auth = {"X-Auth-Token": token}
+    # containers + objects
+    assert fe.handle("PUT", f"{url}/photos", auth)[0] == 201
+    assert fe.handle("PUT", f"{url}/photos", auth)[0] == 202  # existed
+    st, hdrs, _ = fe.handle("PUT", f"{url}/photos/a/cat.jpg", auth,
+                            b"meow")
+    assert st == 201
+    fe.handle("PUT", f"{url}/photos/dog.jpg", auth, b"woof")
+    st, _, out = fe.handle("GET", f"{url}/photos/a/cat.jpg", auth)
+    assert st == 200 and out == b"meow"
+    st, hdrs, _ = fe.handle("HEAD", f"{url}/photos/dog.jpg", auth)
+    assert st == 200 and hdrs["Content-Length"] == "4"
+    # listings
+    st, _, out = fe.handle("GET", f"{url}/photos", auth)
+    assert out == b"a/cat.jpg\ndog.jpg\n"
+    st, _, out = fe.handle("GET", f"{url}/photos", auth, b"",
+                           {"delimiter": "/"})
+    assert out == b"dog.jpg\na/\n"
+    st, _, out = fe.handle("GET", f"{url}/photos", auth, b"",
+                           {"format": "json"})
+    listing = json.loads(out)
+    assert {e.get("name") for e in listing} == {"a/cat.jpg", "dog.jpg"}
+    # account listing + auth boundaries
+    st, _, out = fe.handle("GET", url, auth)
+    assert st == 200 and b"photos" in out
+    mallory = g.create_user("mallory")
+    st, h2, _ = fe.handle("GET", "/auth/v1.0", {
+        "X-Auth-User": "mallory:swift",
+        "X-Auth-Key": mallory["secret_key"]})
+    assert fe.handle("GET", f"{url}/photos/dog.jpg",
+                     {"X-Auth-Token": h2["X-Auth-Token"]})[0] == 401
+    mauth = {"X-Auth-Token": h2["X-Auth-Token"]}
+    assert fe.handle("GET", f"/v1/AUTH_mallory/../photos",
+                     mauth)[0] in (401, 404)
+    # cleanup path
+    fe.handle("DELETE", f"{url}/photos/a/cat.jpg", auth)
+    fe.handle("DELETE", f"{url}/photos/dog.jpg", auth)
+    assert fe.handle("DELETE", f"{url}/photos", auth)[0] == 204
