@@ -8,6 +8,6 @@ class methods.
 """
 from . import cls_fs  # noqa: F401  (registers the cls methods)
 from .client import CephFS, FsError
-from .cls_fs import ROOT_INO, dir_oid, file_oid
+from .cls_fs import FS_SNAPS_OID, ROOT_INO, dir_oid, file_oid
 
 __all__ = ["CephFS", "FsError", "ROOT_INO", "dir_oid", "file_oid"]

@@ -10,7 +10,9 @@ serialized by the directory object's PG instead of MDS locks.
 
 Scope-outs vs the reference (see cls_fs for the rationale): client
 capabilities/leases and delegations, the MDS journal + standby-replay,
-multi-MDS subtree partitioning and cephfs snapshots.  Hard links use
+and multi-MDS subtree partitioning.  Snapshots exist at whole-fs scope
+(the SnapRealm hierarchy collapsed to one domain; see snap_create).
+Hard links use
 remote dentries with a back-pointer list on the primary (promotion on
 primary unlink replaces the MDS stray-directory migration).  stat() is lstat-shaped (final-component symlinks
 are not followed); intermediate symlinks resolve like the kernel
@@ -53,14 +55,32 @@ class CephFS:
         self.client = client
         self.mdpool = metadata_pool
         self.dpool = data_pool
+        # set on snapshot VIEWS (CephFS.snapshot()): reads resolve
+        # against these snap ids; mutations are refused EROFS
+        self._md_snap = None
+        self._data_snap = None
+        try:
+            self._install_snapc()
+        except KeyError:
+            pass                     # pool not created yet (pre-mkfs)
+        except FsError as e:
+            if e.result != -2:
+                # a transient failure must be LOUD: mounting with no
+                # snap context would silently overwrite snapshots
+                raise
 
     # ---- cls plumbing -----------------------------------------------------
     def _call(self, oid: str, method: str, payload=None) -> bytes:
         ret, out = self.client.exec(self.mdpool, oid, "fs", method,
-                                    _j(payload or {}))
+                                    _j(payload or {}),
+                                    snap=self._md_snap)
         if ret < 0:
             raise FsError(method, ret)
         return out
+
+    def _rw(self) -> None:
+        if self._md_snap is not None:
+            raise FsError("readonly snapshot view", -30)   # EROFS
 
     # ---- lifecycle --------------------------------------------------------
     def mkfs(self) -> None:
@@ -137,6 +157,7 @@ class CephFS:
     # ---- hard links (CDentry remote dentries; inode embedded in the
     # primary, back-pointer list to every remote) ----------------------
     def hardlink(self, existing: str, newpath: str) -> None:
+        self._rw()
         """link(2): a new name for an existing FILE.  The new dentry is
         a remote referencing the primary; the primary records it in its
         back-pointer list FIRST, so a crash between the two steps
@@ -166,6 +187,7 @@ class CephFS:
 
     # ---- directories ------------------------------------------------------
     def mkdir(self, path: str) -> int:
+        self._rw()
         dino, name = self._resolve_parent(path)
         ino = self._alloc_ino()
         self._call(dir_oid(dino), "link", {"name": name, "inode": {
@@ -181,6 +203,7 @@ class CephFS:
         return json.loads(self._call(dir_oid(inode["ino"]), "readdir"))
 
     def rmdir(self, path: str) -> None:
+        self._rw()
         dino, name = self._resolve_parent(path)
         target = self._lookup(dino, name)
         if target["type"] != "dir":
@@ -195,6 +218,7 @@ class CephFS:
 
     # ---- files ------------------------------------------------------------
     def create(self, path: str, order: int = DEFAULT_ORDER) -> int:
+        self._rw()
         dino, name = self._resolve_parent(path)
         ino = self._alloc_ino()
         self._call(dir_oid(dino), "link", {"name": name, "inode": {
@@ -203,6 +227,7 @@ class CephFS:
         return ino
 
     def symlink(self, path: str, target: str) -> int:
+        self._rw()
         dino, name = self._resolve_parent(path)
         ino = self._alloc_ino()
         self._call(dir_oid(dino), "link", {"name": name, "inode": {
@@ -255,6 +280,7 @@ class CephFS:
                                      {"name": name, "attrs": attrs}))
 
     def write(self, path: str, data: bytes, offset: int = 0) -> int:
+        self._rw()
         dino, name, inode = self._file_inode(path)
         osize = 1 << inode.get("order", DEFAULT_ORDER)
         pos = 0
@@ -291,7 +317,8 @@ class CephFS:
             try:
                 data = self.client.read(self.dpool,
                                         file_oid(inode["ino"], objno),
-                                        offset=ooff, length=take)
+                                        offset=ooff, length=take,
+                                        snap=self._data_snap)
             except IOError as e:
                 if not _absent(e):
                     raise
@@ -302,6 +329,7 @@ class CephFS:
         return b"".join(chunks)
 
     def truncate(self, path: str, size: int) -> None:
+        self._rw()
         dino, name, inode = self._file_inode(path)
         osize = 1 << inode.get("order", DEFAULT_ORDER)
         old = inode["size"]
@@ -318,6 +346,7 @@ class CephFS:
         self._update(dino, name, size=size, mtime=time.time())
 
     def unlink(self, path: str) -> None:
+        self._rw()
         dino, name = self._resolve_parent(path)
         gone = json.loads(self._call(dir_oid(dino), "unlink",
                                      {"name": name, "deny_dir": True}))
@@ -389,6 +418,7 @@ class CephFS:
                                file_oid(inode["ino"], objno))
 
     def rename(self, src: str, dst: str) -> None:
+        self._rw()
         """rename(2): atomic within one directory (single cls call);
         across directories it is dst-link + src-unlink — two atomic
         steps with a transient double-link window, never a loss."""
@@ -499,6 +529,80 @@ class CephFS:
         for d in dirs:
             sub = path.rstrip("/") + "/" + d
             yield from self.walk(sub)
+
+    # ---- filesystem snapshots (the .snap surface, whole-fs scope;
+    # the reference's SnapServer table + SnapRealm propagation is
+    # collapsed to one snapshot domain) --------------------------------
+    def _snap_table(self) -> Dict:
+        import json as _json
+        from .cls_fs import FS_SNAPS_OID
+        try:
+            return _json.loads(self._call(FS_SNAPS_OID, "snap_ls"))
+        except FsError as e:
+            if e.result == -2:
+                return {}
+            raise
+
+    def _install_snapc(self) -> None:
+        """Install the fs snapshot context on BOTH pools' write paths
+        (the client-side SnapContext a cephfs client gets from its MDS
+        caps).  Another client's newer snapshot is picked up on its
+        next refresh — mount time, snap ops, or refresh_snaps()."""
+        table = self._snap_table()
+        md = sorted(e["md"] for e in table.values())
+        dt = sorted(e["data"] for e in table.values())
+        self.client.set_write_ctx(self.mdpool, md[-1] if md else 0, md)
+        self.client.set_write_ctx(self.dpool, dt[-1] if dt else 0, dt)
+
+    refresh_snaps = _install_snapc
+
+    def snap_create(self, name: str) -> None:
+        """Snapshot the whole filesystem under ``name`` (mkdir .snap/
+        name): one selfmanaged snap id per pool, registered atomically
+        in the snapshot table, then installed in the write ctx so every
+        later mutation clones pre-write state."""
+        self._rw()
+        import time as _time
+        from .cls_fs import FS_SNAPS_OID
+        md_sid = self.client.selfmanaged_snap_create(self.mdpool)
+        data_sid = self.client.selfmanaged_snap_create(self.dpool)
+        try:
+            self._call(FS_SNAPS_OID, "snap_add",
+                       {"name": name, "md_sid": md_sid,
+                        "data_sid": data_sid, "stamp": _time.time()})
+        except FsError:
+            self.client.selfmanaged_snap_remove(self.mdpool, md_sid)
+            self.client.selfmanaged_snap_remove(self.dpool, data_sid)
+            raise
+        self._install_snapc()
+
+    def snap_remove(self, name: str) -> None:
+        self._rw()
+        import json as _json
+        from .cls_fs import FS_SNAPS_OID
+        gone = _json.loads(self._call(FS_SNAPS_OID, "snap_rm",
+                                      {"name": name}))
+        self.client.selfmanaged_snap_remove(self.mdpool, gone["md"])
+        self.client.selfmanaged_snap_remove(self.dpool, gone["data"])
+        self._install_snapc()
+
+    def snap_list(self) -> Dict[str, Dict]:
+        return self._snap_table()
+
+    def snapshot(self, name: str) -> "CephFS":
+        """A read-only view of the filesystem as of ``name`` (cd
+        .snap/name): same API, reads resolve against the snapshot's
+        clones, mutations fail EROFS."""
+        table = self._snap_table()
+        if name not in table:
+            raise FsError("snapshot", -2)
+        view = CephFS.__new__(CephFS)
+        view.client = self.client
+        view.mdpool = self.mdpool
+        view.dpool = self.dpool
+        view._md_snap = table[name]["md"]
+        view._data_snap = table[name]["data"]
+        return view
 
     # ---- fsck (cephfs-data-scan / scrub_path role) ------------------------
     def fsck(self, repair: bool = False) -> Dict:

@@ -188,6 +188,41 @@ def _update_inode(ctx: ClsContext, inp: bytes):
     return 0, _j(inode)
 
 
+FS_SNAPS_OID = "fs_snaps"         # snapshot table (SnapServer role)
+
+
+@register_cls_method("fs", "snap_add", CLS_METHOD_WR)
+def _snap_add(ctx: ClsContext, inp: bytes):
+    """Register a filesystem snapshot name -> (md_sid, data_sid)
+    atomically (-EEXIST on collision) — the SnapServer's table."""
+    req = _parse(inp)
+    key = f"snap_{req['name']}"
+    if key in ctx.omap_get():
+        return -17, b""
+    ctx.omap_set({key: _j({"md": int(req["md_sid"]),
+                           "data": int(req["data_sid"]),
+                           "stamp": float(req.get("stamp", 0))})})
+    return 0, b""
+
+
+@register_cls_method("fs", "snap_rm", CLS_METHOD_WR)
+def _snap_rm(ctx: ClsContext, inp: bytes):
+    req = _parse(inp)
+    key = f"snap_{req['name']}"
+    om = ctx.omap_get()
+    if key not in om:
+        return -2, b""
+    ctx.omap_rm_keys([key])
+    return 0, bytes(om[key])
+
+
+@register_cls_method("fs", "snap_ls")
+def _snap_ls(ctx: ClsContext, inp: bytes):
+    return 0, _j({k[len("snap_"):]: json.loads(v)
+                  for k, v in ctx.omap_get().items()
+                  if k.startswith("snap_")})
+
+
 @register_cls_method("fs", "set_dentry", CLS_METHOD_WR)
 def _set_dentry(ctx: ClsContext, inp: bytes):
     """Atomically overwrite (or install) a dentry's value — the

@@ -561,11 +561,14 @@ class RadosClient(Dispatcher):
         return struct.unpack("<Q", r.data)[0]
 
     def exec(self, pool: str, oid: str, cls: str, method: str,
-             inp: bytes = b"") -> "tuple[int, bytes]":
+             inp: bytes = b"", snap=None) -> "tuple[int, bytes]":
         """Run an object-class method (rados_exec): returns
-        (method ret, output bytes)."""
+        (method ret, output bytes).  With ``snap`` a READ-ONLY method
+        runs against the object's state at that snapshot (the vector
+        interpreter resolves the clone like any snap read)."""
         r, res = self.operate(pool, oid,
-                              ObjectOperation().call(cls, method, inp))
+                              ObjectOperation().call(cls, method, inp),
+                              snap=snap)
         if r < 0:
             return r, b""
         return res[0][0], res[0][1]

@@ -84,20 +84,30 @@ class PoolMirror:
     def run_once(self) -> dict:
         """Scan the pool, attach new journaled images, replay every
         mirror; returns {image: events_applied}."""
+        import json as _json
+        from .cls_rbd import RBD_DIRECTORY
         applied = {}
         for name in RBD(self.src_client).list(self.src_pool):
             m = self.mirrors.get(name)
             if m is not None:
-                try:
-                    cur_id = Image(self.src_client, self.src_pool,
-                                   name).id
-                except RBDError:
-                    cur_id = None
+                ret, out = self.src_client.exec(
+                    self.src_pool, RBD_DIRECTORY, "rbd", "dir_get_id",
+                    _json.dumps({"name": name}).encode())
+                cur_id = out.decode() if ret == 0 else None
                 if cur_id != m.src.id:
                     # deleted-and-recreated under the same name: the
-                    # cached mirror replays a dead journal forever
+                    # cached mirror replays a dead journal forever, and
+                    # the old-generation DESTINATION must go too or
+                    # replaying the new stream onto it leaves offsets
+                    # the new generation never wrote reading old bytes
                     del self.mirrors[name]
-                    m = None
+                    try:
+                        RBD(self.dst_client).remove(self.dst_pool,
+                                                    name)
+                    except RBDError as e:
+                        if e.result != -2:
+                            raise    # dst has snapshots/children:
+                    m = None         # operator must resolve first
             if m is None:
                 try:
                     m = ImageMirror(self.src_client, self.src_pool,

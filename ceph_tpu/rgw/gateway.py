@@ -306,9 +306,14 @@ class RGWLite:
                 if i == len(entries) and raw["truncated"]:
                     # the group may continue past the raw fetch cap:
                     # withdraw it from this page and resume BEFORE it,
-                    # so no prefix is ever emitted twice
-                    prefixes.pop()
-                    next_marker = marker_before_group or marker
+                    # so no prefix is ever emitted twice — unless the
+                    # page would then be EMPTY (one group larger than
+                    # the raw cap): emit it and advance past what we
+                    # consumed, accepting one possible duplicate over a
+                    # livelocked pagination
+                    if contents or len(prefixes) > 1:
+                        prefixes.pop()
+                        next_marker = marker_before_group or marker
                     truncated = True
                     break
             else:

@@ -265,7 +265,9 @@ class SwiftFrontend:
                 user = self.rgw.get_user(uid)
             except RGWError:
                 return 401, {}, b"invalid user"
-            if headers.get("X-Auth-Key") != user["secret_key"]:
+            if not hmac.compare_digest(
+                    headers.get("X-Auth-Key", ""),
+                    user["secret_key"]):
                 return 401, {}, b"invalid key"
             return 204, {"X-Auth-Token": self._token_for(user),
                          "X-Storage-Url": f"/v1/AUTH_{uid}"}, b""
@@ -318,17 +320,18 @@ class SwiftFrontend:
             self._check_owner(user, container)
             self.rgw.delete_bucket(container)
             return 204, {}, b""
-        if method in ("GET", "HEAD"):
+        if method == "HEAD":
+            self._check_owner(user, container)
+            stats = self.rgw.bucket_stats(container)
+            return 204, {"X-Container-Object-Count":
+                         str(stats["num_objects"])}, b""
+        if method == "GET":
             self._check_owner(user, container)
             res = self.rgw.list_objects(
                 container, prefix=query.get("prefix", ""),
                 delimiter=query.get("delimiter", ""),
                 marker=query.get("marker", ""),
                 max_keys=int(query.get("limit", "10000")))
-            if method == "HEAD":
-                stats = self.rgw.bucket_stats(container)
-                return 204, {"X-Container-Object-Count":
-                             str(stats["num_objects"])}, b""
             if query.get("format") == "json":
                 out = _json.dumps(
                     [{"name": e["name"], "bytes": e["size"],

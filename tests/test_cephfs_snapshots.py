@@ -1,0 +1,132 @@
+"""cephfs filesystem snapshots: point-in-time read-only views.
+
+The .snap surface at whole-fs scope: snap_create captures metadata AND
+data (one selfmanaged snap id per pool, clone-on-write after), views
+serve the tree exactly as it was — dentries, file bytes, symlinks,
+hard links — while the head keeps evolving; removal retires both snap
+ids for trimming.
+"""
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.cephfs import CephFS, FsError
+
+ORDER = 12
+
+
+@pytest.fixture()
+def fs():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("fsmeta", size=3, pg_num=8)
+    c.create_replicated_pool("fsdata", size=3, pg_num=8)
+    f = CephFS(c.client("client.fs"), "fsmeta", "fsdata")
+    f.mkfs()
+    return c, f
+
+
+def test_snapshot_view_is_point_in_time(fs):
+    c, f = fs
+    f.mkdir("/proj")
+    f.create("/proj/code", ORDER)
+    f.write("/proj/code", b"v1-source")
+    f.symlink("/latest", "/proj/code")
+    f.snap_create("rel1")
+    # mutate everything after the snapshot
+    f.write("/proj/code", b"v2-rewritten")
+    f.create("/proj/new", ORDER)
+    f.mkdir("/docs")
+    f.unlink("/latest")
+    v = f.snapshot("rel1")
+    assert sorted(v.listdir("/")) == ["latest", "proj"]
+    assert sorted(v.listdir("/proj")) == ["code"]
+    assert v.read("/proj/code") == b"v1-source"
+    assert v.read("/latest") == b"v1-source"      # symlink at snap
+    assert v.stat("/proj/code")["size"] == 9
+    # head unaffected
+    assert f.read("/proj/code") == b"v2-rewritten"
+    assert sorted(f.listdir("/")) == ["docs", "proj"]
+    # views are read-only
+    with pytest.raises(FsError) as ei:
+        v.write("/proj/code", b"nope")
+    assert ei.value.result == -30
+    with pytest.raises(FsError):
+        v.mkdir("/x")
+    with pytest.raises(FsError):
+        v.unlink("/proj/code")
+
+
+def test_layered_snapshots_and_removal(fs):
+    c, f = fs
+    f.create("/f", ORDER)
+    f.write("/f", b"gen1")
+    f.snap_create("s1")
+    f.write("/f", b"gen2!")
+    f.snap_create("s2")
+    f.write("/f", b"gen3!!")
+    assert f.snapshot("s1").read("/f") == b"gen1"
+    assert f.snapshot("s2").read("/f") == b"gen2!"
+    assert f.read("/f") == b"gen3!!"
+    assert sorted(f.snap_list()) == ["s1", "s2"]
+    with pytest.raises(FsError):
+        f.snap_create("s1")                        # EEXIST
+    f.snap_remove("s1")
+    assert sorted(f.snap_list()) == ["s2"]
+    with pytest.raises(FsError):
+        f.snapshot("s1")
+    c.tick(40)                                     # trim s1's clones
+    assert f.snapshot("s2").read("/f") == b"gen2!"
+    assert f.read("/f") == b"gen3!!"
+
+
+def test_snapshot_sees_deleted_files(fs):
+    """Files deleted after the snapshot remain readable in the view —
+    the defining recovery use-case."""
+    c, f = fs
+    f.mkdir("/data")
+    f.create("/data/precious", ORDER)
+    f.write("/data/precious", b"do-not-lose" * 100)
+    f.snap_create("backup")
+    f.unlink("/data/precious")
+    f.rmdir("/data")
+    assert not f.exists("/data")
+    v = f.snapshot("backup")
+    assert v.read("/data/precious") == b"do-not-lose" * 100
+    # restore from the snapshot view onto the head
+    f.mkdir("/data")
+    f.create("/data/precious", ORDER)
+    f.write("/data/precious", v.read("/data/precious"))
+    assert f.read("/data/precious") == b"do-not-lose" * 100
+
+
+def test_hardlinks_in_snapshot(fs):
+    c, f = fs
+    f.create("/a", ORDER)
+    f.write("/a", b"linked-at-snap")
+    f.hardlink("/a", "/b")
+    f.snap_create("s")
+    f.unlink("/a")                                 # promotes /b on head
+    v = f.snapshot("s")
+    assert v.read("/a") == b"linked-at-snap"
+    assert v.read("/b") == b"linked-at-snap"
+    assert v.stat("/a")["nlink"] == 2
+    assert f.stat("/b")["nlink"] == 1              # head promoted
+
+
+def test_snapshot_survives_failure_and_checkpoint(fs, tmp_path):
+    c, f = fs
+    f.create("/x", ORDER)
+    f.write("/x", b"pre-snap")
+    f.snap_create("s")
+    f.write("/x", b"post-snap")
+    c.kill_osd(0)
+    for _ in range(6):
+        c.tick(dt=6.0)
+    assert f.snapshot("s").read("/x") == b"pre-snap"
+    c.checkpoint(str(tmp_path / "ck"))
+    c2 = MiniCluster.restore(str(tmp_path / "ck"))
+    f2 = CephFS(c2.client("client.r"), "fsmeta", "fsdata")
+    assert f2.snapshot("s").read("/x") == b"pre-snap"
+    assert f2.read("/x") == b"post-snap"
+    # the restored client's write ctx still protects the snapshot
+    f2.write("/x", b"post-restore")
+    assert f2.snapshot("s").read("/x") == b"pre-snap"

@@ -178,7 +178,9 @@ def test_pool_mirror_recreated_image(pair):
     pm = PoolMirror(ca, "rbd", cb, "rbd")
     pm.run_once()
     RBD(ca).remove("rbd", "img")
-    RBD(cb).remove("rbd", "img")       # fresh slate on the target too
+    # the stale DESTINATION is dropped automatically on rebind (old
+    # bytes must not shine through offsets the new generation never
+    # wrote)
     RBD(ca).create("rbd", "img", 4 * OBJ, ORDER, journaling=True)
     Image(ca, "rbd", "img").write(0, b"new-gen!")
     applied = pm.run_once()
