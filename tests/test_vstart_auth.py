@@ -67,4 +67,13 @@ def test_auth_cluster_rejects_unauthenticated_injection(cluster):
     raw.close()
     cl = c.client()
     c.wait_healthy(cl)          # cluster unbothered, client still keyed
-    assert cl.read("p", "obj") is not None
+    # self-sufficient: write-then-read here (xdist may run this test
+    # before the module's write test, on a different worker)
+    r = -1
+    for attempt in range(30):
+        r = cl.write_full("p", "inj-probe", b"still-keyed")
+        if r == 0:
+            break
+        time.sleep(0.5)
+    assert r == 0, f"probe write never landed: {r}"
+    assert cl.read("p", "inj-probe") == b"still-keyed"

@@ -18,16 +18,19 @@ def test_rbd_image_over_process_cluster():
         heartbeat_interval=1.0, heartbeat_grace=4.0)
     try:
         cl = c.client("client.x")
+        c.wait_healthy(cl)       # map delivery + peering (loaded host)
         from ceph_tpu.rbd import Image, RBD
         rbd = RBD(cl)
-        # retry the first cls call: daemons may still be applying maps
+        # short retry only for daemons still loading object classes
         last = None
-        for attempt in range(20):
+        for attempt in range(30):
             try:
                 rbd.create("rbd", "disk", 1 << 14, order=12)
                 break
             except Exception as e:
                 last = e
+                cl.mon.send_full_map(cl.name)
+                cl.network.pump(deadline=0.3)
                 time.sleep(0.5)
         else:
             raise last
