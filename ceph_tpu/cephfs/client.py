@@ -191,8 +191,8 @@ class CephFS:
         dino, name = self._resolve_parent(path)
         ino = self._alloc_ino()
         self._call(dir_oid(dino), "link", {"name": name, "inode": {
-            "ino": ino, "type": "dir", "size": 0,
-            "mtime": time.time()}})
+            "ino": ino, "type": "dir", "size": 0, "mode": 0o755,
+            "uid": 0, "gid": 0, "mtime": time.time()}})
         self.client.create(self.mdpool, dir_oid(ino), exclusive=False)
         return ino
 
@@ -223,6 +223,7 @@ class CephFS:
         ino = self._alloc_ino()
         self._call(dir_oid(dino), "link", {"name": name, "inode": {
             "ino": ino, "type": "file", "size": 0, "order": order,
+            "mode": 0o644, "uid": 0, "gid": 0,
             "mtime": time.time()}})
         return ino
 
@@ -241,6 +242,39 @@ class CephFS:
             raise FsError("readlink", -22)
         return inode["target"]
 
+    def setattr(self, path: str, mode: Optional[int] = None,
+                uid: Optional[int] = None, gid: Optional[int] = None,
+                mtime: Optional[float] = None) -> Dict:
+        """chmod/chown/utimens in one verb (the MDS setattr flow):
+        attribute merges happen server-side on the dentry, so two
+        concurrent setattrs never lose each other's fields."""
+        self._rw()
+        if not self._split(path):
+            # the root inode is synthetic (no dentry to store attrs
+            # on); a clear error beats EINVAL from path resolution
+            raise FsError("setattr on the filesystem root is not "
+                          "supported (synthetic root inode)", -95)
+        # follows final symlinks like chmod(2)/chown(2)
+        dino, name, inode = self._resolve_dentry(path)
+        attrs = {}
+        if mode is not None:
+            attrs["mode"] = mode & 0o7777
+        if uid is not None:
+            attrs["uid"] = uid
+        if gid is not None:
+            attrs["gid"] = gid
+        if mtime is not None:
+            attrs["mtime"] = mtime
+        if not attrs:
+            return inode            # no-op: skip the mutating RPC
+        return self._update(dino, name, **attrs)
+
+    def chmod(self, path: str, mode: int) -> None:
+        self.setattr(path, mode=mode)
+
+    def chown(self, path: str, uid: int, gid: int) -> None:
+        self.setattr(path, uid=uid, gid=gid)
+
     def stat(self, path: str) -> Dict:
         inode = self._resolve(path)
         if inode.get("type") == "file":
@@ -248,10 +282,14 @@ class CephFS:
                          nlink=1 + len(inode.get("links", [])))
         return inode
 
-    def _file_inode(self, path: str,
-                    depth: int = 0) -> Tuple[int, str, Dict]:
+    def _resolve_dentry(self, path: str,
+                        depth: int = 0) -> Tuple[int, str, Dict]:
+        """-> (dir_ino, name, inode) of the PRIMARY dentry serving
+        ``path``, following final-component symlinks (like open(2)/
+        chmod(2)) and dereferencing remote hard-link dentries — the
+        shared resolution under _file_inode and setattr."""
         if depth > 10:
-            raise FsError("open", -40)                # ELOOP
+            raise FsError("resolve", -40)             # ELOOP
         dino, name = self._resolve_parent(path)
         inode = self._lookup(dino, name)
         if inode.get("type") == "remote":
@@ -264,7 +302,11 @@ class CephFS:
                 parent = "/".join(self._split(path)[:-1])
                 target = (f"/{parent}/{target}" if parent
                           else f"/{target}")
-            return self._file_inode(target, depth + 1)
+            return self._resolve_dentry(target, depth + 1)
+        return dino, name, inode
+
+    def _file_inode(self, path: str) -> Tuple[int, str, Dict]:
+        dino, name, inode = self._resolve_dentry(path)
         if inode["type"] != "file":
             raise FsError("open", -21)                # EISDIR
         return dino, name, inode

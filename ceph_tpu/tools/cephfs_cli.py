@@ -20,7 +20,7 @@ def run(cluster, client, argv, meta_pool: str = "fsmeta",
     ap.add_argument("--data-pool", default=data_pool)
     ap.add_argument("verb", choices=[
         "mkfs", "ls", "mkdir", "put", "get", "cat", "rm", "rmdir",
-        "mv", "ln", "stat", "tree", "fsck"])
+        "mv", "ln", "stat", "tree", "fsck", "chmod", "chown"])
     ap.add_argument("--repair", action="store_true")
     ap.add_argument("args", nargs="*")
     a = ap.parse_args(argv)
@@ -68,6 +68,13 @@ def run(cluster, client, argv, meta_pool: str = "fsmeta",
         (path,) = rest
         json.dump(fs.stat(path), sys.stdout, indent=2, sort_keys=True)
         print()
+    elif v == "chmod":
+        mode, path = rest
+        fs.chmod(path, int(mode, 8))
+    elif v == "chown":
+        owner, path = rest
+        uid, gid = owner.split(":")
+        fs.chown(path, int(uid), int(gid))
     elif v == "fsck":
         json.dump(fs.fsck(repair=a.repair), sys.stdout, indent=2,
                   sort_keys=True)

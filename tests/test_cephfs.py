@@ -270,3 +270,42 @@ def test_ec_data_pool(fs):
     assert fec.read("/ecfile") == b"ec-file-data" * 50
     ino = fec.stat("/ecfile")["ino"]
     assert cl.read("fsec", file_oid(ino, 0), length=12) == b"ec-file-data"
+
+
+def test_setattr_chmod_chown(fs):
+    """Mode/ownership attributes with server-side merge (the MDS
+    setattr flow); hard links share them through the primary."""
+    c, cl, f = fs
+    f.create("/f", ORDER)
+    st = f.stat("/f")
+    assert st["mode"] == 0o644 and st["uid"] == 0
+    f.chmod("/f", 0o600)
+    f.chown("/f", 1000, 100)
+    st = f.stat("/f")
+    assert (st["mode"], st["uid"], st["gid"]) == (0o600, 1000, 100)
+    f.mkdir("/d")
+    assert f.stat("/d")["mode"] == 0o755
+    # attrs travel with hard links (one inode)
+    f.hardlink("/f", "/link")
+    f.chmod("/link", 0o400)
+    assert f.stat("/f")["mode"] == 0o400
+    # setattr merges: concurrent-style partial updates keep other fields
+    f.setattr("/f", mtime=12345.0)
+    st = f.stat("/f")
+    assert st["mode"] == 0o400 and st["mtime"] == 12345.0
+    # CLI verbs
+    from ceph_tpu.tools import cephfs_cli
+    assert cephfs_cli.run(c, cl, ["chmod", "755", "/f"]) == 0
+    assert f.stat("/f")["mode"] == 0o755
+    assert cephfs_cli.run(c, cl, ["chown", "5:6", "/f"]) == 0
+    assert f.stat("/f")["uid"] == 5
+    # chmod THROUGH a symlink affects the target (chmod(2) follows)
+    f.symlink("/sym", "/f")
+    f.chmod("/sym", 0o640)
+    assert f.stat("/f")["mode"] == 0o640
+    assert f.stat("/sym")["type"] == "symlink"   # link untouched
+    # root setattr refused with a clear error, no-op setattr is free
+    with pytest.raises(FsError) as ei:
+        f.chmod("/", 0o700)
+    assert ei.value.result == -95
+    assert f.setattr("/f")["mode"] == 0o640
