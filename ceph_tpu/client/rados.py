@@ -241,7 +241,11 @@ class RadosClient(Dispatcher):
     # ---- Objecter-lite ----------------------------------------------------
     def _calc_target(self, pool_id: int, oid: str):
         pool = self.osdmap.get_pg_pool(pool_id)
-        if pool is not None and pool.read_tier >= 0:
+        if pool is None:
+            # the pool vanished between resolution and submit (pool
+            # deletion): surface librados's clean ENOENT, not KeyError
+            raise _ioerror("op", f"pool {pool_id}", -2)
+        if pool.read_tier >= 0:
             # cache tier overlay: ops retarget to the cache pool
             # (Objecter op_target read_tier/write_tier resolution)
             tier = self.osdmap.get_pg_pool(pool.read_tier)
@@ -297,6 +301,8 @@ class RadosClient(Dispatcher):
         """Send a PG-targeted op (no object) to the PG's primary with
         the same refresh-and-resend loop as _submit."""
         for attempt in range(MAX_ATTEMPTS):
+            if self.osdmap.get_pg_pool(pgid[0]) is None:
+                raise _ioerror("op", f"pool {pgid[0]}", -2)
             *_, acting, primary = self.osdmap.pg_to_up_acting_osds(
                 pg_t(*pgid))
             self._tid += 1

@@ -232,6 +232,11 @@ class MiniCluster:
         self.publish()
         return pid
 
+    def delete_pool(self, name: str) -> int:
+        pid = self.mon.delete_pool(name)
+        self.publish()
+        return pid
+
     # ---- control ----------------------------------------------------------
     def publish(self) -> None:
         self.mon.publish()

@@ -807,6 +807,27 @@ class Monitor(Dispatcher):
             pool.removed_snaps.append(snapid)
             self._topology_dirty = True
 
+    # ---- pool deletion (OSDMonitor "osd pool delete") ---------------------
+    def delete_pool(self, pool_name: str) -> int:
+        """Remove a pool from the map; OSDs purge its PGs and data on
+        consuming the epoch (OSD 'PG removed' / PG::on_removal).  A
+        pool participating in a cache tier must be detached first,
+        like the reference refuses (EBUSY)."""
+        pid = self.osdmap.lookup_pg_pool_name(pool_name)
+        if pid < 0:
+            raise KeyError(f"no pool {pool_name!r}")
+        pool = self.osdmap.pools[pid]
+        if pool.tier_of >= 0 or pool.read_tier >= 0:
+            raise ValueError(
+                f"pool {pool_name!r} is part of a cache tier")
+        del self.osdmap.pools[pid]
+        del self.osdmap.pool_name[pid]
+        if not hasattr(self, "_pending_pool_deletes"):
+            self._pending_pool_deletes = []
+        self._pending_pool_deletes.append(pid)
+        self._topology_dirty = True
+        return pid
+
     # ---- pool quotas + full flags (OSDMonitor "osd pool set-quota",
     # "osd set full"; flag values from osd_types.h:1148-1158) --------------
     def set_pool_quota(self, pool_name: str, max_objects: int = 0,
@@ -861,6 +882,10 @@ class Monitor(Dispatcher):
                    if stale(pg, [o for pair in v for o in pair])]:
             del m.pg_upmap_items[pg]
             self._topology_dirty = True
+        for store in (m.pg_temp, m.primary_temp):
+            for pg in [pg for pg in store if pg.pool not in m.pools]:
+                del store[pg]
+                self._topology_dirty = True
 
     # ---- wire commands (MMonCommand -> handle_command, the
     # 'ceph tell mon' / librados mon_command surface) ----------------------
@@ -880,7 +905,8 @@ class Monitor(Dispatcher):
         allowed = {"pool_snap_create", "pool_snap_rm",
                    "selfmanaged_snap_create", "selfmanaged_snap_remove",
                    "set_pool_quota", "create_replicated_pool",
-                   "create_ec_profile", "create_ec_pool"}
+                   "create_ec_profile", "create_ec_pool",
+                   "delete_pool"}
         if msg.cmd not in allowed:
             self.messenger.send_message(MMonCommandAck(
                 tid=msg.tid, result=-22,
@@ -907,6 +933,16 @@ class Monitor(Dispatcher):
         m = self.osdmap
         inc = Incremental()
         inc.new_flags = m.flags
+        # full-state incs only REPLACE listed pools on consumers;
+        # deletions must travel explicitly.  Filter against the WORKING
+        # map: a paxos demotion can rebuild it from committed history
+        # and resurrect a pool whose delete never got quorum — shipping
+        # that stale pid would purge a live pool's data on every OSD
+        # (pids are never reused, so absence == genuinely deleted)
+        inc.old_pools = [pid for pid in
+                         getattr(self, "_pending_pool_deletes", [])
+                         if pid not in m.pools]
+        self._pending_pool_deletes = []
         inc.crush = copy.deepcopy(m.crush)
         inc.new_pools = copy.deepcopy(m.pools)
         inc.new_pool_names = dict(m.pool_name)

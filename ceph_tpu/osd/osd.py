@@ -247,6 +247,8 @@ class OSD(Dispatcher):
                 was_up = {o for o in range(self.osdmap.max_osd)
                           if self.osdmap.is_up(o)}
                 self.osdmap.apply_incremental(inc)
+                if inc.old_pools:
+                    self._purge_deleted_pools(inc.old_pools)
                 # a peer newly marked up gets a fresh heartbeat grace and
                 # its standing failure report is withdrawn (the
                 # reference's send_still_alive cancellation role) —
@@ -407,16 +409,8 @@ class OSD(Dispatcher):
         if self.osd_id in {o for o in list(up) + list(acting)
                            if o != CRUSH_ITEM_NONE}:
             return
-        cids = self._local_pg_collections().get(pg_id, [])
-        t = Transaction()
-        for cid in cids:
-            t.remove_collection(cid)
-        if not t.empty():
-            self.store.queue_transaction(t)
-        self.pgs.pop(pg_id, None)
-        getattr(self, "_stray_notified", {}).pop(pg_id, None)
-        self.dout(3, f"removed stray pg {pg_id} "
-                  f"({len(cids)} collections)")
+        n = self._remove_pg_local(pg_id)
+        self.dout(3, f"removed stray pg {pg_id} ({n} collections)")
 
     def next_pull_tid(self) -> int:
         """OSD-level tid (disjoint from per-PG backend counters)."""
@@ -428,6 +422,34 @@ class OSD(Dispatcher):
             self.pgs[pg_id] = PG(self, pg_id,
                                  self.osdmap.pools[pg_id[0]])
         return self.pgs[pg_id]
+
+    def _remove_pg_local(self, pg_id) -> int:
+        """Drop one local PG: collections, in-memory object, stray
+        bookkeeping (the shared tail of stray removal and pool
+        deletion).  Returns collections removed."""
+        cids = self._local_pg_collections().get(pg_id, [])
+        t = Transaction()
+        for cid in cids:
+            t.remove_collection(cid)
+        if not t.empty():
+            self.store.queue_transaction(t)
+        self.pgs.pop(pg_id, None)
+        getattr(self, "_stray_notified", {}).pop(pg_id, None)
+        return len(cids)
+
+    def _purge_deleted_pools(self, pool_ids) -> None:
+        """Drop PGs + store collections of explicitly deleted pools
+        (PG::on_removal on the pool-deletion epoch).  Driven ONLY by
+        incrementals' old_pools — absence from the map is not evidence
+        of deletion (a booting OSD briefly holds an empty map while
+        its store is full of live data)."""
+        gone = set(pool_ids)
+        if not gone:
+            return
+        doomed_ids = set(p for p in self.pgs if p[0] in gone) | \
+            set(p for p in self._local_pg_collections() if p[0] in gone)
+        for pg_id in doomed_ids:
+            self._remove_pg_local(pg_id)
 
     def _consume_map(self) -> None:
         # instantiate PGs this osd serves

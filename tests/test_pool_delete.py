@@ -1,0 +1,87 @@
+"""Pool deletion: OSDs purge the deleted pool's PGs and data.
+
+Reference flow ('osd pool delete' -> OSDMonitor, OSDs remove PGs via
+PG::on_removal on consuming the epoch): data objects and collections
+disappear from every store, stale pg_temp/upmap entries are cleaned,
+cache-tier participants are refused, and the name is reusable.
+"""
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+
+
+def test_delete_pool_purges_everything():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("keep", size=2, pg_num=8)
+    c.create_ec_pool("doomed", k=2, m=1, plugin="isa", pg_num=8)
+    cl = c.client("client.d")
+    for i in range(12):
+        cl.write_full("doomed", f"o{i}", b"x" * 500)
+        cl.write_full("keep", f"k{i}", b"y" * 100)
+    # collections for the doomed pool exist before
+    doomed_pid = c.mon.osdmap.lookup_pg_pool_name("doomed")
+    pre = sum(1 for osd in c.osds.values()
+              for cid in osd.store.list_collections()
+              if cid.startswith(f"{doomed_pid}."))
+    assert pre > 0
+    c.delete_pool("doomed")
+    c.tick(3)
+    # every doomed collection purged from every store; keep intact
+    for osd in c.osds.values():
+        for cid in osd.store.list_collections():
+            assert not cid.startswith(f"{doomed_pid}.")
+    assert cl.read("keep", "k3") == b"y" * 100
+    # client ops on the dead pool fail at pool lookup after refresh
+    with pytest.raises(KeyError):
+        cl.lookup_pool("doomed")
+    # a client holding the resolved pool id gets a clean ENOENT, not a
+    # KeyError out of target calculation
+    with pytest.raises(IOError) as ei:
+        cl._submit(doomed_pid, "o1", "read")
+    assert getattr(ei.value, "errno", None) == 2
+
+    # the name is immediately reusable with fresh PGs
+    c.create_replicated_pool("doomed", size=2, pg_num=8)
+    assert cl.write_full("doomed", "fresh", b"new") == 0
+    assert cl.read("doomed", "fresh") == b"new"
+
+
+def test_delete_pool_guards_and_cleanup():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("base", size=2, pg_num=8)
+    c.create_replicated_pool("cache", size=2, pg_num=8)
+    c.mon.add_cache_tier("base", "cache")
+    c.publish()
+    with pytest.raises(ValueError):
+        c.delete_pool("cache")           # tier participant
+    with pytest.raises(ValueError):
+        c.delete_pool("base")
+    with pytest.raises(KeyError):
+        c.delete_pool("nope")
+    # stale placement state of a deleted pool is swept from the map
+    c.create_replicated_pool("tmp", size=2, pg_num=8)
+    from ceph_tpu.osdmap.types import pg_t
+    pid = c.mon.osdmap.lookup_pg_pool_name("tmp")
+    c.mon.osdmap.pg_temp[pg_t(pid, 0)] = [0, 1]
+    c.mon.osdmap.pg_upmap_items[pg_t(pid, 1)] = [(0, 1)]
+    c.delete_pool("tmp")
+    assert not any(pg.pool == pid for pg in c.mon.osdmap.pg_temp)
+    assert not any(pg.pool == pid for pg in c.mon.osdmap.pg_upmap_items)
+
+
+def test_delete_pool_survives_restart():
+    import tempfile
+    c = MiniCluster(n_osds=3)
+    c.create_replicated_pool("gone", size=2, pg_num=8)
+    cl = c.client("client.r")
+    cl.write_full("gone", "o", b"bye")
+    c.delete_pool("gone")
+    c.tick(3)
+    d = tempfile.mkdtemp()
+    c.checkpoint(d)
+    c2 = MiniCluster.restore(d)
+    pid_absent = c2.mon.osdmap.lookup_pg_pool_name("gone")
+    assert pid_absent < 0
+    for osd in c2.osds.values():
+        assert not any("gone" in cid for cid in
+                       osd.store.list_collections())
