@@ -52,15 +52,10 @@ def _wait_down(c, cl, osd_id, timeout=45.0):
 def test_process_cluster_write_kill_recover(cluster):
     c = cluster
     cl = c.client()
-    # under heavy host load the mon's subscription answer can lag past
-    # client construction: re-request until the first map lands
-    deadline = time.monotonic() + 60
-    while cl.osdmap.epoch == 0:
-        assert time.monotonic() < deadline, "no map from the mon process"
-        cl.mon.send_full_map(cl.name)
-        cl.network.pump(deadline=0.3)
-        time.sleep(0.5)
+    # wait_healthy re-requests the map until every osd shows up, which
+    # subsumes waiting for the FIRST map under heavy host load
     c.wait_healthy(cl)
+    assert cl.osdmap.epoch > 0
     rng = np.random.default_rng(4)
     data = rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
     # daemons may still be chewing their map backlog: the reference
