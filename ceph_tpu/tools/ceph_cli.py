@@ -7,8 +7,13 @@ verbs, driven from a checkpoint directory like tools/rados.py.
   status | health | df
   osd tree           (CrushTreeDumper-style hierarchy with weights)
   osd df             (per-osd object/byte usage from the stores)
+  osd pool ls [detail]  (pool names / pg_pool_t summary with flags,
+                         quotas, snaps mode, tiering)
   pg stat            (per-state PG counts)
   pg dump            (one line per PG: state, up/acting sets)
+  pg scrub|deep-scrub [pool.ps]  (offline consistency pass report)
+  log last [n]       (recent cluster-log entries)
+  config-key get|ls  (replicated config-key store)
 
 Read-only: never writes the checkpoint back.
 """
@@ -105,6 +110,54 @@ def main(argv=None) -> int:
             _osd_tree(c)
         elif sub == "df":
             _osd_df(c)
+        elif sub == "pool" and rest[1:2] == ["ls"]:
+            # ceph osd pool ls [detail] (MonCommands.h)
+            if rest[2:] not in ([], ["detail"]):
+                print(f"unknown: osd pool ls {' '.join(rest[2:])}",
+                      file=sys.stderr)
+                return 1
+            detail = rest[2:3] == ["detail"]
+            from ..osdmap.types import (
+                FLAG_EC_OVERWRITES, FLAG_FULL, FLAG_FULL_QUOTA,
+                FLAG_NEARFULL,
+            )
+            for pid, name in sorted(c.mon.osdmap.pool_name.items()):
+                if not detail:
+                    print(name)
+                    continue
+                pool = c.mon.osdmap.pools[pid]
+                kind = "erasure" if pool.is_erasure() else "replicated"
+                flags = [fname for bit, fname in [
+                    (FLAG_FULL, "full"),
+                    (FLAG_FULL_QUOTA, "full_quota"),
+                    (FLAG_NEARFULL, "nearfull"),
+                    (FLAG_EC_OVERWRITES, "ec_overwrites"),
+                ] if pool.has_flag(bit)]
+                parts = [f"pool {pid} '{name}' {kind}",
+                         f"size {pool.size}",
+                         f"min_size {pool.min_size}",
+                         f"crush_rule {pool.crush_rule}",
+                         f"pg_num {pool.pg_num}",
+                         f"pgp_num {pool.pgp_num}"]
+                if pool.erasure_code_profile:
+                    parts.append(
+                        f"profile {pool.erasure_code_profile}")
+                if flags:
+                    parts.append("flags " + "+".join(flags))
+                if pool.quota_max_objects:
+                    parts.append(
+                        f"max_objects {pool.quota_max_objects}")
+                if pool.quota_max_bytes:
+                    parts.append(f"max_bytes {pool.quota_max_bytes}")
+                if pool.selfmanaged:
+                    parts.append("selfmanaged_snaps")
+                elif pool.snaps:
+                    parts.append(f"snaps {len(pool.snaps)}")
+                if pool.read_tier >= 0:
+                    parts.append(f"read_tier {pool.read_tier}")
+                if pool.tier_of >= 0:
+                    parts.append(f"tier_of {pool.tier_of}")
+                print(" ".join(parts))
         else:
             print(f"unknown: osd {sub}", file=sys.stderr)
             return 1

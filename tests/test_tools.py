@@ -208,3 +208,28 @@ def test_ec_benchmark_encode_and_decode(capsys):
     out = capsys.readouterr().out.strip()
     secs, kib = out.split("\t")
     assert int(kib) == 5 * 16
+
+
+def test_ceph_osd_pool_ls_detail(tmp_path, capsys):
+    """ceph osd pool ls [detail]: names, then the pg_pool_t summary
+    line with flags/quotas/tiering (MonCommands.h 'osd pool ls')."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.tools import ceph_cli
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("plain", size=2, pg_num=8)
+    c.create_ec_pool("ecp", k=2, m=1, plugin="isa", pg_num=8)
+    c.mon.set_pool_quota("plain", max_objects=10)
+    cl = c.client("client.t")
+    cl.selfmanaged_snap_create("ecp")
+    c.publish()
+    ck = str(tmp_path / "ck")
+    c.checkpoint(ck)
+    assert ceph_cli.main(["--cluster", ck, "osd", "pool", "ls"]) == 0
+    out = capsys.readouterr().out.split()
+    assert "plain" in out and "ecp" in out
+    assert ceph_cli.main(["--cluster", ck, "osd", "pool", "ls",
+                          "detail"]) == 0
+    out = capsys.readouterr().out
+    assert "'plain' replicated" in out and "max_objects 10" in out
+    assert "'ecp' erasure" in out and "selfmanaged_snaps" in out
+    assert "ec_overwrites" in out
