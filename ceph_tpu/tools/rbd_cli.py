@@ -30,6 +30,8 @@ def run(cluster, client, argv) -> int:
     sub.add_parser("ls")
     s = sub.add_parser("info")
     s.add_argument("image")
+    s = sub.add_parser("du")
+    s.add_argument("spec", help="image[@snap]")
     s = sub.add_parser("resize")
     s.add_argument("image")
     s.add_argument("--size", type=int, required=True)
@@ -78,6 +80,10 @@ def run(cluster, client, argv) -> int:
                    journaling=args.journaling)
     elif args.cmd == "ls":
         print("\n".join(rbd.list(pool)))
+    elif args.cmd == "du":
+        name, _, snap = args.spec.partition("@")
+        img = Image(client, pool, name, snapshot=snap or None)
+        print(json.dumps(img.du(), sort_keys=True))
     elif args.cmd == "info":
         print(json.dumps(Image(client, pool, args.image).stat(),
                          indent=2, sort_keys=True))

@@ -265,3 +265,28 @@ def test_rbd_cli(env, tmp_path, capsys):
     run("ls")
     out = capsys.readouterr().out
     assert "disk" in out and "disk2" in out
+
+
+def test_du(env, capsys):
+    """rbd du: sparse images cost only their written objects; snapshots
+    report their own point-in-time usage."""
+    c, cl, rbd = env
+    rbd.create("rbd", "sparse", 16 * OBJ, ORDER)
+    img = Image(cl, "rbd", "sparse")
+    assert img.du() == {"provisioned": 16 * OBJ, "used": 0}
+    img.write(0, b"x" * 100)
+    img.write(10 * OBJ, b"y" * OBJ)
+    du = img.du()
+    assert du["provisioned"] == 16 * OBJ
+    assert du["used"] == 100 + OBJ
+    img.snap_create("s")
+    img.write(0, b"z" * OBJ)             # grow object 0 post-snap
+    assert img.du()["used"] == 2 * OBJ
+    snap_du = Image(cl, "rbd", "sparse", snapshot="s").du()
+    assert snap_du["used"] == 100 + OBJ  # point-in-time usage
+    from ceph_tpu.tools import rbd_cli
+    import json as _json
+    assert rbd_cli.run(c, cl, ["-p", "rbd", "du", "sparse"]) == 0
+    assert _json.loads(capsys.readouterr().out)["used"] == 2 * OBJ
+    assert rbd_cli.run(c, cl, ["-p", "rbd", "du", "sparse@s"]) == 0
+    assert _json.loads(capsys.readouterr().out)["used"] == 100 + OBJ
