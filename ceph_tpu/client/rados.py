@@ -398,6 +398,8 @@ class RadosClient(Dispatcher):
             self.network.pump()
             ack = self._mon_acks.pop(tid, None)
             if ack is not None:
+                if ack.result == -11:
+                    continue    # EAGAIN: mon electing / leadership moved
                 if ack.result < 0:
                     raise ValueError(ack.data.get("error",
                                                   f"mon {ack.result}"))

@@ -890,41 +890,94 @@ class Monitor(Dispatcher):
     # ---- wire commands (MMonCommand -> handle_command, the
     # 'ceph tell mon' / librados mon_command surface) ----------------------
     def _handle_command(self, msg) -> None:
-        from ..msg.messages import MMonCommandAck
+        from ..msg.messages import MMonCommand, MMonCommandAck
         # ack cache: a lossy client link may replay the same command
         # tid after a dropped ack — non-idempotent commands (snap id
         # allocation!) must not run twice (the reference's mon session
-        # dedups by (client, tid) the same way)
+        # dedups by (client, tid) the same way).  Keyed by the ORIGIN
+        # client, which for a peon-relayed command is reply_to, so a
+        # replay arriving by a different route still dedups.
+        from collections import OrderedDict
         cache = getattr(self, "_cmd_ack_cache", None)
         if cache is None:
-            cache = self._cmd_ack_cache = {}
-        key = (msg.src, msg.tid)
-        if key in cache:
-            self.messenger.send_message(cache[key], msg.src)
+            cache = self._cmd_ack_cache = OrderedDict()
+        origin = msg.reply_to or msg.src
+        key = (origin, msg.tid)
+        hit = cache.get(key)
+        if hit is not None:
+            cache.move_to_end(key)
+            self.messenger.send_message(MMonCommandAck(
+                tid=hit.tid, result=hit.result, data=hit.data,
+                reply_to=msg.reply_to), msg.src)
             return
+
+        def reply(result: int, data: dict, cacheable: bool) -> None:
+            ack = MMonCommandAck(tid=msg.tid, result=result, data=data,
+                                 reply_to=msg.reply_to)
+            if cacheable:
+                # bounded LRU: evict the coldest single entries instead
+                # of a wholesale clear (which would discard live acks
+                # and let a delayed replay re-run a non-idempotent
+                # command).  LRU also ages out an entry whose (client,
+                # tid) could collide after a client restart resets tids.
+                cache[key] = ack
+                cache.move_to_end(key)
+                while len(cache) > 1024:
+                    cache.popitem(last=False)
+            self.messenger.send_message(ack, msg.src)
+
+        # peons never mutate: relay to the leader (Monitor::
+        # forward_request_leader, src/mon/Monitor.cc) and let the ack
+        # route back through us.  A mutation here would diverge this
+        # mon's working map from quorum AND publish() would refuse.
+        if self.peers and not self.is_leader():
+            leader = (self._peer_name(self.leader_rank)
+                      if self.leader_rank >= 0 else None)
+            if leader is None or msg.reply_to:
+                # electing, or a stale forward that landed on a non-
+                # leader: transient — tell the client to retry (-EAGAIN,
+                # never cached so the retry re-resolves the leader)
+                reply(-11, {"error": "mon not quorum leader"},
+                      cacheable=False)
+                return
+            self.messenger.send_message(MMonCommand(
+                tid=msg.tid, cmd=msg.cmd, args=dict(msg.args),
+                reply_to=origin), leader)
+            return
+
         allowed = {"pool_snap_create", "pool_snap_rm",
                    "selfmanaged_snap_create", "selfmanaged_snap_remove",
                    "set_pool_quota", "create_replicated_pool",
                    "create_ec_profile", "create_ec_pool",
                    "delete_pool"}
         if msg.cmd not in allowed:
-            self.messenger.send_message(MMonCommandAck(
-                tid=msg.tid, result=-22,
-                data={"error": f"unknown command {msg.cmd!r}"}),
-                msg.src)
+            reply(-22, {"error": f"unknown command {msg.cmd!r}"},
+                  cacheable=True)
             return
         try:
             value = getattr(self, msg.cmd)(**msg.args)
             self.publish()
-            ack = MMonCommandAck(tid=msg.tid, result=0,
-                                 data={"value": value})
         except (KeyError, ValueError, TypeError) as e:
-            ack = MMonCommandAck(tid=msg.tid, result=-22,
-                                 data={"error": str(e)})
-        if len(cache) > 1024:
-            cache.clear()
-        cache[key] = ack
-        self.messenger.send_message(ack, msg.src)
+            reply(-22, {"error": str(e)}, cacheable=True)
+            return
+        except RuntimeError as e:
+            # lost leadership between the check above and publish():
+            # the local mutation will be rebuilt from committed history
+            # on the next election; the client must retry at the new
+            # leader.  Not cached — the retry must re-execute there.
+            reply(-11, {"error": f"leadership lost: {e}"},
+                  cacheable=False)
+            return
+        reply(0, {"value": value}, cacheable=True)
+
+    def _relay_command_ack(self, msg) -> None:
+        """Ack for a command this peon forwarded to the leader: route it
+        to the waiting client (Monitor::route_message role)."""
+        from ..msg.messages import MMonCommandAck
+        if msg.reply_to:
+            self.messenger.send_message(MMonCommandAck(
+                tid=msg.tid, result=msg.result, data=msg.data),
+                msg.reply_to)
 
     # ---- epoch publication -------------------------------------------------
     def _snapshot_inc(self) -> Incremental:
@@ -1109,7 +1162,7 @@ class Monitor(Dispatcher):
         return 2 if n_up > 2 else 1
 
     def ms_fast_dispatch(self, msg: Message) -> None:
-        from ..msg.messages import MMonCommand
+        from ..msg.messages import MMonCommand, MMonCommandAck
         if isinstance(msg, MMonSubscribe):
             # cross-process clients/daemons subscribe over the wire
             # (the in-process ones call subscribe() directly)
@@ -1117,6 +1170,8 @@ class Monitor(Dispatcher):
             self.send_full_map(msg.src)
         elif isinstance(msg, MMonCommand):
             self._handle_command(msg)
+        elif isinstance(msg, MMonCommandAck):
+            self._relay_command_ack(msg)
         elif isinstance(msg, MMonElection):
             self._handle_election(msg)
         elif isinstance(msg, MMonPaxos):

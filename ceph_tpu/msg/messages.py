@@ -410,15 +410,21 @@ class MMonCommand(Message):
     tid: int = 0
     cmd: str = ""
     args: Dict[str, Any] = field(default_factory=dict)
+    # set when a peon relays the command to the leader (MForward role,
+    # src/messages/MForward.h): the original client the ack must reach
+    reply_to: str = ""
 
 
 @dataclass
 class MMonCommandAck(Message):
     """Mon -> client command completion (MMonCommandAck.h): result
-    errno + a JSON-ish payload dict."""
+    errno + a JSON-ish payload dict.  ``reply_to`` mirrors the request's
+    relay field: a peon receiving an ack with it set forwards the ack to
+    that client (the route_message leg of MForward)."""
     tid: int = 0
     result: int = 0
     data: Dict[str, Any] = field(default_factory=dict)
+    reply_to: str = ""
 
 
 @dataclass

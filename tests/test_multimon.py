@@ -395,3 +395,44 @@ def test_auto_out_weight_restored_across_leader_failover():
     assert m.osd_weight[victim] == w_before, \
         "auto-out weight memo lost across leader failover"
     assert victim not in m.osd_old_weight  # memo consumed
+
+
+def test_wire_command_peon_relay_and_dedup():
+    """A MMonCommand landing on a PEON must not mutate that mon: it is
+    relayed to the leader (Monitor::forward_request_leader role), the
+    ack routes back through the peon, and replays — by either route —
+    are answered from the (origin, tid) ack cache instead of
+    re-executing a non-idempotent command (snap id allocation)."""
+    from ceph_tpu.msg.messages import MMonCommand
+    c = MiniCluster(n_osds=3, n_mons=3)
+    c.create_ec_pool("p", k=2, m=1, pg_num=8, plugin="jerasure")
+    cl = c.client("client.w")
+
+    def send(mon, tid):
+        c.network.send("client.w", mon.name, MMonCommand(
+            tid=tid, cmd="selfmanaged_snap_create",
+            args={"pool_name": "p"}))
+        c.network.pump()
+        return cl._mon_acks.pop(tid)
+
+    peon = c.mons[1]
+    assert not peon.is_leader()
+    ack1 = send(peon, 901)
+    assert ack1.result == 0
+    snapid = ack1.data["value"]
+    assert snapid > 0
+    # replay via the peon: dedup -> the SAME snap id, no re-allocation
+    ack2 = send(peon, 901)
+    assert ack2.result == 0 and ack2.data["value"] == snapid
+    # replay direct to the leader: the cache keys by ORIGIN, so a
+    # different route still dedups
+    ack3 = send(c.mons[0], 901)
+    assert ack3.result == 0 and ack3.data["value"] == snapid
+    # a fresh tid is a fresh command: allocates the next id
+    ack4 = send(peon, 902)
+    assert ack4.result == 0 and ack4.data["value"] != snapid
+    # the committed allocation replicated to every mon; no peon
+    # diverged by executing locally
+    for m in c.mons:
+        pool = m.osdmap.pools[m.osdmap.lookup_pg_pool_name("p")]
+        assert pool.snap_seq >= ack4.data["value"]
