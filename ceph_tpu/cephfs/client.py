@@ -190,10 +190,19 @@ class CephFS:
         self._rw()
         dino, name = self._resolve_parent(path)
         ino = self._alloc_ino()
-        self._call(dir_oid(dino), "link", {"name": name, "inode": {
-            "ino": ino, "type": "dir", "size": 0, "mode": 0o755,
-            "uid": 0, "gid": 0, "mtime": time.time()}})
+        # object BEFORE dentry: cls_fs refuses WR calls on a missing
+        # dir object (missing == rmdir'd — the anti-resurrection
+        # guard), so the object must exist from the instant the dentry
+        # makes it reachable.  A crash here leaves an unreachable
+        # object (fsck-collectable), never a broken directory.
         self.client.create(self.mdpool, dir_oid(ino), exclusive=False)
+        try:
+            self._call(dir_oid(dino), "link", {"name": name, "inode": {
+                "ino": ino, "type": "dir", "size": 0, "mode": 0o755,
+                "uid": 0, "gid": 0, "mtime": time.time()}})
+        except FsError:
+            self.client.remove(self.mdpool, dir_oid(ino))
+            raise
         return ino
 
     def listdir(self, path: str) -> Dict[str, Dict]:

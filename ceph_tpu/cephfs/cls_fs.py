@@ -83,6 +83,14 @@ def _link(ctx: ClsContext, inp: bytes):
     replaces the MDS's dentry lock.  A directory marked dead by
     dir_mark_dead refuses new dentries (-ENOENT) so rmdir cannot race
     a create."""
+    if not ctx.exists:
+        # directory objects are created eagerly, so a missing object
+        # means rmdir already deleted it (after sealing).  A WR cls
+        # method would implicitly recreate the object here — a
+        # resurrected directory holding an orphaned dentry that no
+        # root walk (fsck) can ever reach.  The seal must keep holding
+        # after the object is gone.
+        return -2, b""
     req = _parse(inp)
     name = str(req["name"])
     key = f"dn_{name}"
@@ -100,6 +108,8 @@ def _unlink(ctx: ClsContext, inp: bytes):
     """Remove a dentry.  With ``deny_dir`` a directory dentry is
     refused (-EISDIR) — the unlink(2) contract, enforced where the
     dentry actually lives so no client-side stat can go stale."""
+    if not ctx.exists:
+        return -2, b""          # deleted dir: don't resurrect (see link)
     req = _parse(inp)
     key = f"dn_{req['name']}"
     om = ctx.omap_get()
@@ -148,6 +158,8 @@ def _dir_mark_dead(ctx: ClsContext, inp: bytes):
     succeeds, link() refuses new dentries, so the rmdir sequence
     (seal child -> unlink parent dentry -> delete object) cannot lose a
     concurrently created entry (the MDS holds a dirlock for this)."""
+    if not ctx.exists:
+        return -2, b""          # deleted dir: don't resurrect (see link)
     if any(k.startswith("dn_") for k in ctx.omap_get()):
         return -39, b""                               # ENOTEMPTY
     ctx.omap_set({"_dead": "1"})
@@ -229,6 +241,8 @@ def _set_dentry(ctx: ClsContext, inp: bytes):
     hard-link promotion/repoint primitive: replacing a remote dentry
     with an embedded inode must never pass through a missing-dentry
     window the way unlink+link would."""
+    if not ctx.exists:
+        return -2, b""          # deleted dir: don't resurrect (see link)
     req = _parse(inp)
     om = ctx.omap_get()
     if "_dead" in om:

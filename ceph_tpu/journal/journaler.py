@@ -79,6 +79,11 @@ class Journaler:
         self.order = md["order"]
         self.splay = md["splay_width"]
         self._next_tid = self._scan_next_tid(md)
+        # never try to move the stored watermark backwards: after a
+        # crash in the write-ahead window the metadata set can be one
+        # AHEAD of where the next append lands (empty set), and
+        # set_active_set refuses regressions
+        self._pushed_active_set = md["active_set"]
         return md
 
     def register_client(self, client_id: str, data: str = "") -> None:
@@ -106,6 +111,17 @@ class Journaler:
         """Frame + append one entry; returns its tid.  The frame crc
         covers header+payload so a torn tail write is detectable."""
         tid = self._next_tid
+        active_set = tid // self._entries_per_set()
+        if active_set > self._pushed_active_set:
+            # write-AHEAD the watermark (once per object set): if the
+            # frame landed first and we crashed before the bump, the
+            # entry would be invisible to both replay() and the next-tid
+            # scan (both bounded by metadata active_set) — the reused
+            # tid could then be applied locally yet never replayed to a
+            # mirror.  Bumping first merely costs replay a scan over an
+            # empty set on the crash path.
+            self._exec("set_active_set", {"set": active_set})
+            self._pushed_active_set = active_set
         hdr = _HDR.pack(PREAMBLE, tid, len(payload))
         frame = hdr + payload + struct.pack("<I", crc32c(hdr + payload))
         r = self.client.append(self.pool, self._data_oid(self._objno(tid)),
@@ -113,12 +129,6 @@ class Journaler:
         if r < 0:
             raise JournalError("append", r)
         self._next_tid = tid + 1
-        active_set = tid // self._entries_per_set()
-        if active_set > self._pushed_active_set:
-            # the watermark only moves once per object set; skipping
-            # the no-op exec halves the append hot path's op count
-            self._exec("set_active_set", {"set": active_set})
-            self._pushed_active_set = active_set
         return tid
 
     # ---- replay ------------------------------------------------------------
@@ -165,8 +175,15 @@ class Journaler:
             tid += 1
 
     def _scan_next_tid(self, md: dict) -> int:
+        """Highest tid on disk + 1, walking DOWN from active_set until
+        a set with entries appears (tids grow with set number, so the
+        first non-empty set holds the maximum).  active_set itself can
+        be empty — the watermark is written ahead of the first frame —
+        and with trimming lagging there can be several live sets, so
+        stopping after active_set+minimum_set alone would resurrect a
+        stale tid from the bottom of the window."""
         last = -1
-        for oset in (md["active_set"], md["minimum_set"]):
+        for oset in range(md["active_set"], md["minimum_set"] - 1, -1):
             for s in range(self.splay):
                 for tid, _ in self._read_object_entries(
                         oset * self.splay + s):

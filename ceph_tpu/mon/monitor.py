@@ -956,15 +956,20 @@ class Monitor(Dispatcher):
             return
         try:
             value = getattr(self, msg.cmd)(**msg.args)
-            self.publish()
-        except (KeyError, ValueError, TypeError) as e:
+        except (KeyError, ValueError, TypeError, RuntimeError) as e:
+            # the command's own failure is permanent: cache it so a
+            # replay gets the same answer instead of re-executing
             reply(-22, {"error": str(e)}, cacheable=True)
             return
+        try:
+            self.publish()
         except RuntimeError as e:
             # lost leadership between the check above and publish():
             # the local mutation will be rebuilt from committed history
             # on the next election; the client must retry at the new
             # leader.  Not cached — the retry must re-execute there.
+            # (Scoped to publish() alone: a RuntimeError raised by the
+            # command itself is a real error, not a leadership signal.)
             reply(-11, {"error": f"leadership lost: {e}"},
                   cacheable=False)
             return

@@ -583,29 +583,44 @@ class Image:
     def snap_rollback(self, name: str) -> None:
         """Restore the head to the snapshot's content (Operations::
         snap_rollback): resize to the snap size, then per-object restore
-        reads at the snap and rewrites the head under the current ctx."""
+        reads at the snap and rewrites the head under the current ctx.
+
+        Journaled as ONE semantic op event (the reference records an
+        OpEvent, librbd/journal/Types.h SnapRollbackEvent) with the
+        inner resize/write journaling suppressed: a mirror replays
+        "roll back to snap X" against its own replicated snapshot, so
+        primary and secondary converge even though the per-object
+        restore I/O never crosses the journal."""
         sid, info = self._snap_by_name(name)
-        self.resize(info["size"])
-        self._apply_write_ctx()
-        for objno in range(self._objects_in(info["size"])):
-            oid = self._obj(objno)
-            try:
-                snap_data = self.client.read(self.data_pool, oid,
-                                             snap=sid)
-                at_snap = True
-            except IOError as e:
-                if not _absent(e):
-                    raise
-                at_snap = False
-            if at_snap:
-                r = self.client.write_full(self.data_pool, oid,
-                                           snap_data)
-                if r < 0:
-                    raise RBDError("snap rollback", r)
-            else:
-                r = self.client.remove(self.data_pool, oid)
-                if r < 0 and r != -2:
-                    raise RBDError("snap rollback", r)
+        if self.journaling:
+            self._journal_event({"op": "snap_rollback", "name": name})
+        was = self.journaling
+        self.journaling = False
+        try:
+            self.resize(info["size"])
+            self._apply_write_ctx()
+            for objno in range(self._objects_in(info["size"])):
+                oid = self._obj(objno)
+                try:
+                    snap_data = self.client.read(self.data_pool, oid,
+                                                 snap=sid)
+                    at_snap = True
+                except IOError as e:
+                    if not _absent(e):
+                        raise
+                    at_snap = False
+                if at_snap:
+                    r = self.client.write_full(self.data_pool, oid,
+                                               snap_data)
+                    if r < 0:
+                        raise RBDError("snap rollback", r)
+                else:
+                    r = self.client.remove(self.data_pool, oid)
+                    if r < 0 and r != -2:
+                        raise RBDError("snap rollback", r)
+        finally:
+            self.journaling = was
+        self._journal_commit_applied()
 
     # ---- clone management -------------------------------------------------
     def flatten(self) -> None:
@@ -760,5 +775,10 @@ def apply_image_event(img: "Image", event: Dict) -> None:
         elif op == "snap_remove":
             if event["name"] in img.snap_list():
                 img.snap_remove(event["name"])
+        elif op == "snap_rollback":
+            # the snap replicated earlier in the same stream (its
+            # snap_create event precedes this one), so rolling back by
+            # name reproduces the primary's semantic rollback exactly
+            img.snap_rollback(event["name"])
     finally:
         img.journaling = was

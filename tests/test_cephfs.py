@@ -309,3 +309,27 @@ def test_setattr_chmod_chown(fs):
         f.chmod("/", 0o700)
     assert ei.value.result == -95
     assert f.setattr("/f")["mode"] == 0o640
+
+
+def test_rmdir_seal_survives_object_deletion(fs):
+    """After rmdir deletes the sealed dir object, a racing create that
+    already resolved the child ino calls 'link' on the now-missing
+    object.  WR cls methods implicitly recreate objects, so without the
+    ctx.exists guard this resurrects the directory with an orphaned
+    dentry fsck's root walk can never reach — the seal must keep
+    holding after deletion."""
+    c, cl, f = fs
+    f.mkdir("/d")
+    ino = f._resolve("/d")["ino"]
+    f.rmdir("/d")
+    # the racing create's link: must fail ENOENT, not recreate
+    with pytest.raises(FsError) as ei:
+        f._call(dir_oid(ino), "link", {"name": "orphan", "inode": {
+            "ino": 999, "type": "file", "size": 0, "order": 22,
+            "mode": 0o644, "uid": 0, "gid": 0, "mtime": 0.0}})
+    assert ei.value.result == -2
+    # the object stayed deleted (no resurrection), and the tree is clean
+    with pytest.raises(IOError):
+        cl.stat("fsmeta", dir_oid(ino))
+    report = f.fsck()
+    assert not any(report.values()), report

@@ -98,3 +98,62 @@ def test_journal_lifecycle_errors(jr):
     j.remove()
     with pytest.raises(JournalError):
         j.open()
+
+
+def test_active_set_write_ahead_of_first_frame(jr):
+    """The watermark bumps BEFORE the first frame of a new object set
+    lands: a crash between the two leaves only an empty set to scan —
+    never an applied-but-invisible entry whose tid gets silently
+    reused (which a mirror would then never see)."""
+    c, cl, j = jr
+    per_set = j._entries_per_set()
+    for i in range(per_set):                    # fill set 0 exactly
+        j.append(b"x%d" % i)
+    # simulate the crash: the metadata bump succeeds, the data append
+    # never happens
+    real_append = cl.append
+    def boom(pool, oid, data):
+        if oid.startswith("journal_data."):
+            raise IOError("crash before data append")
+        return real_append(pool, oid, data)
+    cl.append = boom
+    with pytest.raises(IOError):
+        j.append(b"first-of-set-1")
+    cl.append = real_append
+    assert j.get_metadata()["active_set"] == 1  # write-ahead held
+    # crash recovery: a fresh journaler re-derives the same next tid
+    j2 = Journaler(cl, "jp", "img1", entries_per_object=4)
+    md = j2.open()
+    assert j2._next_tid == per_set
+    t = j2.append(b"retry")
+    assert t == per_set
+    assert [p for tid, p in j2.replay() if tid == t] == [b"retry"]
+
+
+def test_crash_into_empty_set_with_lagging_trim(jr):
+    """The reviewer's corner: several live sets (trim lagging), crash
+    in the write-ahead window so active_set points at an EMPTY set two
+    past minimum_set.  Recovery must walk down to the first non-empty
+    set (not just peek at active_set and minimum_set), and the
+    recovered journaler must keep appending without trying to regress
+    the stored watermark."""
+    c, cl, j = jr
+    per_set = j._entries_per_set()
+    for i in range(2 * per_set):                # sets 0 and 1 full
+        j.append(b"e%d" % i)
+    real_append = cl.append
+    def boom(pool, oid, data):
+        if oid.startswith("journal_data."):
+            raise IOError("crash before data append")
+        return real_append(pool, oid, data)
+    cl.append = boom
+    with pytest.raises(IOError):
+        j.append(b"first-of-set-2")             # bumped watermark only
+    cl.append = real_append
+    assert j.get_metadata()["active_set"] == 2
+    j2 = Journaler(cl, "jp", "img1", entries_per_object=4)
+    j2.open()
+    assert j2._next_tid == 2 * per_set          # no tid reuse
+    t = j2.append(b"recovered")                 # must not raise -22
+    assert t == 2 * per_set
+    assert [t2 for t2, _ in j2.replay()][-1] == t

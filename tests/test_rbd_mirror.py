@@ -186,3 +186,31 @@ def test_pool_mirror_recreated_image(pair):
     applied = pm.run_once()
     assert applied["img"] == 1
     assert Image(cb, "rbd", "img").read(0, 8) == b"new-gen!"
+
+
+def test_mirror_replicates_snap_rollback(pair):
+    """snap_rollback is journaled as ONE op event (SnapRollbackEvent
+    role): the mirror replays the semantic rollback against its own
+    replicated snapshot, so a rolled-back primary and its secondary
+    converge instead of silently diverging (the inner restore I/O
+    never crosses the journal)."""
+    a, b, ca, cb = pair
+    src = Image(ca, "rbd", "img")
+    src.write(0, b"keep-me")
+    src.snap_create("s1")
+    src.write(0, b"OVERWRITTEN")
+    src.write(3 * OBJ, b"late-object")
+    m = ImageMirror(ca, "rbd", "img", cb, "rbd")
+    m.run_once()
+    src.snap_rollback("s1")
+    assert src.read(0, 7) == b"keep-me"
+    n = m.run_once()
+    assert n >= 1                       # the rollback event replicated
+    dst = Image(cb, "rbd", "img")
+    assert dst.read(0, 7) == b"keep-me"
+    assert dst.read(0, 11) == src.read(0, 11)
+    assert dst.size() == src.size()
+    # post-rollback mutations keep flowing
+    src.write(1, b"after")
+    m.run_once()
+    assert Image(cb, "rbd", "img").read(0, 8) == src.read(0, 8)
