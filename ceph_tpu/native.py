@@ -92,8 +92,12 @@ def native_available() -> bool:
 
 # ---- crush ----------------------------------------------------------------
 
-def serialize_map(m: CrushMap) -> np.ndarray:
-    """Flatten a CrushMap into the int64 blob the native parser reads."""
+def serialize_map(m: CrushMap, choose_args=None) -> np.ndarray:
+    """Flatten a CrushMap into the int64 blob the native parser reads.
+
+    ``choose_args`` (crush.h crush_choose_arg: per-bucket id overrides
+    for hashing plus per-position weight_set replacements) serialize as
+    a trailing section; absent section == no overrides."""
     out: List[int] = [
         m.max_devices, m.choose_local_tries, m.choose_local_fallback_tries,
         m.choose_total_tries, m.chooseleaf_descend_once,
@@ -126,15 +130,48 @@ def serialize_map(m: CrushMap) -> np.ndarray:
         out += [1, r.ruleset, r.type, r.min_size, r.max_size, len(r.steps)]
         for s in r.steps:
             out += [s.op, s.arg1, s.arg2]
+    entries = []
+    if choose_args is not None:
+        for bno, arg in enumerate(choose_args):
+            if arg is None or (not arg.ids and not arg.weight_set):
+                continue
+            b = m.buckets[bno] if bno < len(m.buckets) else None
+            if b is None:
+                continue
+            # the C++ parser advances by b.size per row — a mismatched
+            # arg (e.g. from an externally decoded binary map) must
+            # fail LOUDLY here, not parse misaligned and silently
+            # return wrong placements
+            if arg.ids and len(arg.ids) != b.size:
+                raise ValueError(
+                    f"choose_args ids len {len(arg.ids)} != bucket "
+                    f"size {b.size} (bucket index {bno})")
+            for ws in arg.weight_set or []:
+                if len(ws.weights) != b.size:
+                    raise ValueError(
+                        f"choose_args weight_set row len "
+                        f"{len(ws.weights)} != bucket size {b.size} "
+                        f"(bucket index {bno})")
+            ent = [bno, 1 if arg.ids else 0, b.size]
+            if arg.ids:
+                ent += list(arg.ids)
+            npos = len(arg.weight_set) if arg.weight_set else 0
+            ent.append(npos)
+            for ws in arg.weight_set or []:
+                ent += list(ws.weights)
+            entries.append(ent)
+    out.append(len(entries))
+    for ent in entries:
+        out += ent
     return np.array(out, dtype=np.int64)
 
 
 class NativeCrushMapper:
     """Batch CRUSH evaluation through the C++ engine."""
 
-    def __init__(self, m: CrushMap):
+    def __init__(self, m: CrushMap, choose_args=None):
         self.lib = get_lib()
-        self.blob = serialize_map(m)
+        self.blob = serialize_map(m, choose_args)
 
     def do_rule(self, ruleno: int, x: int, result_max: int,
                 weight: Sequence[int]) -> List[int]:

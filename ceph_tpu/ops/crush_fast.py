@@ -273,8 +273,16 @@ class FastRule:
                 # rep' for the leaf draw depends on the dynamic success
                 # count without the stable tunable (mapper.c:545)
                 raise UnsupportedRule("firstn chooseleaf needs stable=1")
-            if C.npos > 1:
-                raise UnsupportedRule("firstn with per-position weight sets")
+        # firstn indexes weight sets by the DYNAMIC success count
+        # (mapper.c:513 passes outpos as the choose_args position, and
+        # outpos only advances on success) — so with per-position
+        # weight sets the candidates must be materialized for every
+        # position the walk could be at; resolution gathers the lane's
+        # actual outpos.  indep passes the invocation's constant
+        # starting outpos (0 from crush_do_rule) for main draws and
+        # rep for leaf draws (mapper.c:723,777), which the pos vector
+        # already threads — no extra axis needed.
+        self.posP = min(C.npos, self.numrep) if self.firstn else 1
         if self.leafy:
             if leaf_tries:
                 recurse = leaf_tries
@@ -297,9 +305,10 @@ class FastRule:
         base = 0
         self.parents = 1          # lanes per x feeding the last stage
         for st in self.mid_stages:
-            if st["firstn"] and C.npos > 1:
-                raise UnsupportedRule("firstn with per-position "
-                                      "weight sets")
+            # same dynamic-position treatment per stage (each choose
+            # step invocation restarts outpos at 0, crush_do_rule
+            # passes j=0 per parent)
+            st["posP"] = min(C.npos, st["numrep"]) if st["firstn"] else 1
             d = _layer_path_frontier(m, frontier, st["type"])
             st["depth"] = d
             st["base_level"] = base
@@ -468,28 +477,51 @@ class FastRule:
         n = st["numrep"]
         slots = st["slots"]
         rounds = st["n_rounds"]
+        P = st.get("posP", 1)
         if st["firstn"]:
             R = n + rounds - 1
         else:
             R = n * rounds
         r_col = jnp.arange(R, dtype=jnp.uint32)
-        xf = jnp.broadcast_to(xl[None, :], (R, N)).reshape(-1)
-        rf = jnp.broadcast_to(r_col[:, None], (R, N)).reshape(-1)
-        bf = jnp.broadcast_to(roots[None, :], (R, N)).reshape(-1)
-        pos0 = jnp.zeros((R * N,), dtype=jnp.int32)
-        item, risky_f = self._descend(xf, bf, rf, pos0,
-                                      st["base_level"], st["depth"])
-        cand = item.reshape(R, N)
-        risky = jnp.any(risky_f.reshape(R, N), axis=0)
+        if P > 1:
+            # per-position candidates: the draw at retry r depends on
+            # which weight_set position (the dynamic outpos) it runs at
+            xf = jnp.broadcast_to(xl[None, None, :], (R, P, N)).reshape(-1)
+            rf = jnp.broadcast_to(r_col[:, None, None],
+                                  (R, P, N)).reshape(-1)
+            bf = jnp.broadcast_to(roots[None, None, :],
+                                  (R, P, N)).reshape(-1)
+            pf = jnp.broadcast_to(
+                jnp.arange(P, dtype=jnp.int32)[None, :, None],
+                (R, P, N)).reshape(-1)
+            item, risky_f = self._descend(xf, bf, rf, pf,
+                                          st["base_level"], st["depth"])
+            cand = item.reshape(R, P, N)
+            risky = jnp.any(risky_f.reshape(R, P, N), axis=(0, 1))
+        else:
+            xf = jnp.broadcast_to(xl[None, :], (R, N)).reshape(-1)
+            rf = jnp.broadcast_to(r_col[:, None], (R, N)).reshape(-1)
+            bf = jnp.broadcast_to(roots[None, :], (R, N)).reshape(-1)
+            pos0 = jnp.zeros((R * N,), dtype=jnp.int32)
+            item, risky_f = self._descend(xf, bf, rf, pos0,
+                                          st["base_level"], st["depth"])
+            cand = item.reshape(R, N)
+            risky = jnp.any(risky_f.reshape(R, N), axis=0)
+        lanes = jnp.arange(N)
         if st["firstn"]:
             # all numrep ATTEMPTS run (slot = attempt; the reference's
             # outpos append == stable compaction); the room truncation
             # to `slots` happens at fan-out below
             outs = jnp.full((N, n), NONE, dtype=jnp.int32)
             for j in range(n):
+                if P > 1:
+                    # outpos == successes so far == filled slots < j
+                    pos = jnp.minimum(jnp.sum(outs != NONE, axis=1),
+                                      P - 1)
                 done = jnp.zeros((N,), dtype=bool)
                 for ftotal in range(rounds):
-                    item = cand[j + ftotal]
+                    c_r = cand[j + ftotal]
+                    item = c_r[pos, lanes] if P > 1 else c_r
                     coll = jnp.any(outs == item[:, None], axis=1)
                     take = ~coll & ~done
                     outs = outs.at[:, j].set(
@@ -542,46 +574,75 @@ class FastRule:
             valid = (jnp.repeat(valid, n)) & (sel.reshape(-1) != NONE)
             roots = jnp.maximum(-1 - sel.reshape(-1), 0)
         N = X * self.parents
+        P = self.posP
         if self.firstn:
             R = self.numrep + self.n_rounds - 1
         else:
             R = self.numrep * self.n_rounds
         r_col = jnp.arange(R, dtype=jnp.uint32)
-        xf = jnp.broadcast_to(xl[None, :], (R, N)).reshape(-1)
-        rf = jnp.broadcast_to(r_col[:, None], (R, N)).reshape(-1)
-        root = jnp.broadcast_to(roots[None, :], (R, N)).reshape(-1)
-        pos0 = jnp.zeros((R * N,), dtype=jnp.int32)
-        item, risky_f = self._descend(xf, root, rf, pos0,
+        if P > 1:
+            # firstn + per-position weight sets: the draw at retry r
+            # depends on the dynamic outpos (see __init__) — flatten a
+            # position axis into the descent; resolution gathers the
+            # lane's actual position.  cand (R, P, N), leaf (R, L, P, N).
+            xf = jnp.broadcast_to(xl[None, None, :], (R, P, N)).reshape(-1)
+            rf = jnp.broadcast_to(r_col[:, None, None],
+                                  (R, P, N)).reshape(-1)
+            root = jnp.broadcast_to(roots[None, None, :],
+                                    (R, P, N)).reshape(-1)
+            pf = jnp.broadcast_to(
+                jnp.arange(P, dtype=jnp.int32)[None, :, None],
+                (R, P, N)).reshape(-1)
+        else:
+            xf = jnp.broadcast_to(xl[None, :], (R, N)).reshape(-1)
+            rf = jnp.broadcast_to(r_col[:, None], (R, N)).reshape(-1)
+            root = jnp.broadcast_to(roots[None, :], (R, N)).reshape(-1)
+            pf = jnp.zeros((R * N,), dtype=jnp.int32)
+        item, risky_f = self._descend(xf, root, rf, pf,
                                       self.base_level, self.last_depth)
-        risky_lanes = risky_lanes | jnp.any(risky_f.reshape(R, N), axis=0)
-        cand = item.reshape(R, N)
+        if P > 1:
+            risky_lanes = risky_lanes | jnp.any(
+                risky_f.reshape(R, P, N), axis=(0, 1))
+            cand = item.reshape(R, P, N)
+        else:
+            risky_lanes = risky_lanes | jnp.any(risky_f.reshape(R, N),
+                                                axis=0)
+            cand = item.reshape(R, N)
 
         def finish(leaf, risky_lanes):
             risky = jnp.any(risky_lanes.reshape(-1, self.parents), axis=1)
             return cand, leaf, risky, valid, xl
 
         L = self.n_leaf
+        lshape = (R, L, P, N) if P > 1 else (R, L, N)
         if not self.leafy:
-            return finish(jnp.full((R, 1, N), NONE, dtype=jnp.int32),
+            return finish(jnp.full(lshape, NONE, dtype=jnp.int32),
                           risky_lanes)
         if self.leaf_depth == 0 and self.target_type == 0:
             # chooseleaf over devices: every leaf attempt is the item itself
-            return finish(jnp.broadcast_to(cand[:, None, :], (R, L, N)),
+            if P > 1:
+                return finish(jnp.broadcast_to(
+                    cand[:, None, :, :], lshape), risky_lanes)
+            return finish(jnp.broadcast_to(cand[:, None, :], lshape),
                           risky_lanes)
-        # leaf attempts: one flattened batch over (R, L, N)
+        # leaf attempts: one flattened batch over lshape
+        M = R * P * N if P > 1 else R * N
         if self.firstn:
             sub_r = (rf >> jnp.uint32(self.vary_r - 1)) if self.vary_r \
                 else jnp.zeros_like(rf)
-            lpos = jnp.zeros((R * N,), dtype=jnp.int32)
+            # leaf draw position = the parent step's outpos
+            # (mapper.c:561-562: the recursion inherits outpos, and the
+            # leaf bucket_choose passes it) — the materialized p axis
+            lpos = pf
         else:
             rep = rf % jnp.uint32(self.numrep)
             sub_r = rep + rf  # + numrep*ft2 added per attempt below
             lpos = rep.astype(jnp.int32)
         bidx = jnp.maximum(-1 - item, 0)
         depth = self.leaf_depth if self.leaf_depth else 1
-        xleaf = jnp.broadcast_to(xf[None, :], (L, R * N)).reshape(-1)
-        bl = jnp.broadcast_to(bidx[None, :], (L, R * N)).reshape(-1)
-        pl = jnp.broadcast_to(lpos[None, :], (L, R * N)).reshape(-1)
+        xleaf = jnp.broadcast_to(xf[None, :], (L, M)).reshape(-1)
+        bl = jnp.broadcast_to(bidx[None, :], (L, M)).reshape(-1)
+        pl = jnp.broadcast_to(lpos[None, :], (L, M)).reshape(-1)
         ft2 = jnp.arange(L, dtype=jnp.uint32)
         if self.firstn:
             rl = (sub_r[None, :] + ft2[:, None]).reshape(-1)
@@ -589,9 +650,14 @@ class FastRule:
             rl = (sub_r[None, :] +
                   jnp.uint32(self.numrep) * ft2[:, None]).reshape(-1)
         lv, lrisky = self._descend(xleaf, bl, rl, pl, self.depth, depth)
-        risky_lanes = risky_lanes | jnp.any(lrisky.reshape(L, R, N),
-                                            axis=(0, 1))
-        leaf = jnp.transpose(lv.reshape(L, R, N), (1, 0, 2))  # (R, L, N)
+        if P > 1:
+            risky_lanes = risky_lanes | jnp.any(
+                lrisky.reshape(L, R, P, N), axis=(0, 1, 2))
+            leaf = jnp.transpose(lv.reshape(L, R, P, N), (1, 0, 2, 3))
+        else:
+            risky_lanes = risky_lanes | jnp.any(lrisky.reshape(L, R, N),
+                                                axis=(0, 1))
+            leaf = jnp.transpose(lv.reshape(L, R, N), (1, 0, 2))
         return finish(leaf, risky_lanes)
 
     # ---- resolution phase (per weight vector; cheap) -----------------------
@@ -622,18 +688,31 @@ class FastRule:
 
     def _resolve_firstn(self, cand, leaf, risky, x, dev_weight):
         """firstn: slot j retries r = j + ftotal (mapper.c:493-495); leafy
-        failures consume an outer retry (descend_once semantics)."""
-        R, X = cand.shape
+        failures consume an outer retry (descend_once semantics).
+
+        With per-position weight sets (posP > 1) the candidate arrays
+        carry a position axis and each lane gathers at its dynamic
+        outpos — the success count so far (mapper.c:513/620-621:
+        position == outpos, advancing only on success)."""
+        P = self.posP
+        if P > 1:
+            R = cand.shape[0]
+            X = cand.shape[2]
+        else:
+            R, X = cand.shape
+        lanes = jnp.arange(X)
         numrep = self.numrep
         x = x.astype(jnp.uint32)
         residual = risky
         outs = jnp.full((X, numrep), NONE, dtype=jnp.int32)
         leaves = jnp.full((X, numrep), NONE, dtype=jnp.int32)
         for j in range(numrep):
+            if P > 1:
+                pos = jnp.minimum(jnp.sum(outs != NONE, axis=1), P - 1)
             done = jnp.zeros((X,), dtype=bool)
             for ftotal in range(self.n_rounds):
                 r = j + ftotal
-                item = cand[r]
+                item = cand[r][pos, lanes] if P > 1 else cand[r]
                 coll = jnp.any(outs == item[:, None], axis=1)
                 if self.leafy:
                     # first acceptable leaf attempt, if any
@@ -641,7 +720,8 @@ class FastRule:
                     lsel = jnp.full((X,), NONE, dtype=jnp.int32)
                     lres = jnp.zeros((X,), dtype=bool)
                     for ft2 in range(self.n_leaf):
-                        lf = leaf[r, ft2]
+                        lf = leaf[r, ft2][pos, lanes] if P > 1 \
+                            else leaf[r, ft2]
                         lcoll = jnp.any(leaves == lf[:, None], axis=1)
                         lrej = _is_out_batch(dev_weight, lf, x)
                         good = ~lok & ~lcoll & ~lrej
@@ -807,19 +887,18 @@ class FastRule:
         if len(idxs) == 0:
             return
         w32 = np.asarray(weight, dtype=np.uint32)
-        if self.choose_args is None:
-            try:
-                nm = self._native_mapper()
-                rout, rlens = nm.do_rule_batch(
-                    self.ruleno, xs[idxs].astype(np.int64),
-                    self.result_max, w32)
-                out[idxs] = np.where(
-                    np.arange(self.result_max)[None, :] < rlens[:, None],
-                    rout.astype(np.int32), NONE)
-                counts[idxs] = rlens
-                return
-            except Exception:
-                pass
+        try:
+            nm = self._native_mapper()
+            rout, rlens = nm.do_rule_batch(
+                self.ruleno, xs[idxs].astype(np.int64),
+                self.result_max, w32)
+            out[idxs] = np.where(
+                np.arange(self.result_max)[None, :] < rlens[:, None],
+                rout.astype(np.int32), NONE)
+            counts[idxs] = rlens
+            return
+        except Exception:
+            pass
         m = self.C.map
         wl = [int(v) for v in w32]
         for i in idxs:
@@ -903,9 +982,9 @@ class FastRule:
         residual = (full[:, R] >> 16) != 0
         # exactness escape hatch: recompute flagged lanes exactly.  The
         # C++ batch evaluator replays them ~100x faster than the Python
-        # interpreter (OSDMapMapping.h:17's ParallelPGMapper role); fall
-        # back to Python when the native lib is absent or the rule uses
-        # choose_args (not in the native blob format).
+        # interpreter (OSDMapMapping.h:17's ParallelPGMapper role),
+        # choose_args included (serialized into the blob); Python only
+        # when the native lib is absent.
         self._residual_frac = float(residual.mean())
         self._replay_exact(np.nonzero(residual)[0], xs, w32, out, counts)
         self._prev_packed = packed
@@ -917,7 +996,8 @@ class FastRule:
         nm = getattr(self, "_nm", None)
         if nm is None:
             from ..native import NativeCrushMapper
-            nm = self._nm = NativeCrushMapper(self.C.map)
+            nm = self._nm = NativeCrushMapper(self.C.map,
+                                              self.choose_args)
         return nm
 
     @property

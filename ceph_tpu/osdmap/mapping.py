@@ -135,11 +135,11 @@ class OSDMapMapping:
                 return self._trim(res, cnt, pool, size)
             except (ValueError, ImportError):
                 pass
-        if self.use_native and choose_args is None:
+        if self.use_native:
             try:
                 from ..native import NativeCrushMapper, native_available
                 if native_available():
-                    nm = NativeCrushMapper(osdmap.crush.crush)
+                    nm = NativeCrushMapper(osdmap.crush.crush, choose_args)
                     res, cnt = nm.do_rule_batch(ruleno, pps.tolist(), size,
                                                 weight)
                     self.last_backend[pool_id] = "native"

@@ -93,6 +93,11 @@ struct FlatBucket {
   int64_t item_weight = 0;               // uniform
   int32_t num_nodes = 0;
   bool present = false;
+  // choose_args overrides (crush.h crush_choose_arg): straw2 hashing
+  // ids and per-position weight replacements
+  const int64_t* arg_ids = nullptr;
+  const int64_t* arg_weights = nullptr;  // (arg_npos, size) row-major
+  int32_t arg_npos = 0;
 };
 
 struct FlatRule {
@@ -176,6 +181,34 @@ bool parse_map(const int64_t* p, int64_t n, FlatMap* m) {
     fr.len = (int32_t)p[i++];
     fr.steps = &p[i]; i += 3 * fr.len;
   }
+  // trailing choose_args section (older blobs simply end here).
+  // Every advance is bounds-checked BEFORE the dereference — a
+  // truncated blob must fail the parse, not read past the buffer.
+  if (i < n) {
+    int64_t nca = p[i++];
+    for (int64_t e = 0; e < nca; e++) {
+      if (i + 3 > n) return false;
+      int64_t bno = p[i++];
+      int64_t has_ids = p[i++];
+      int64_t size = p[i++];
+      if (bno < 0 || bno >= nb || size < 0 ||
+          size != m->buckets[bno].size)
+        return false;
+      FlatBucket& fb = m->buckets[bno];
+      if (has_ids) {
+        if (i + size > n) return false;
+        fb.arg_ids = &p[i]; i += size;
+      }
+      if (i + 1 > n) return false;
+      int64_t npos = p[i++];
+      if (npos < 0 || npos > (n - i) / (size ? size : 1)) return false;
+      fb.arg_npos = (int32_t)npos;
+      if (npos) {
+        if (i + npos * size > n) return false;
+        fb.arg_weights = &p[i]; i += npos * size;
+      }
+    }
+  }
   return i <= n;
 }
 
@@ -236,14 +269,24 @@ int64_t straw_choose(const FlatBucket* b, int64_t x, int64_t r) {
   return b->items[high];
 }
 
-int64_t straw2_choose(const FlatBucket* b, int64_t x, int64_t r) {
+int64_t straw2_choose(const FlatBucket* b, int64_t x, int64_t r,
+                      int position) {
+  // choose_args override the weights (clamped position, mapper.c:
+  // get_choose_arg_weights) and the ids hashed (get_choose_arg_ids);
+  // only straw2 consumes them (crush_bucket_choose)
+  const int64_t* weights = b->weights;
+  if (b->arg_npos > 0) {
+    int pos = position >= b->arg_npos ? b->arg_npos - 1 : position;
+    weights = b->arg_weights + (int64_t)pos * b->size;
+  }
+  const int64_t* ids = b->arg_ids ? b->arg_ids : b->items;
   int high = 0;
   int64_t high_draw = 0;
   for (int i = 0; i < b->size; i++) {
-    int64_t w = b->weights[i];
+    int64_t w = weights[i];
     int64_t draw;
     if (w) {
-      uint32_t u = hash3((uint32_t)x, (uint32_t)b->items[i],
+      uint32_t u = hash3((uint32_t)x, (uint32_t)ids[i],
                          (uint32_t)r) & 0xffff;
       int64_t ln = crush_ln_fp(u) - 0x1000000000000ll;
       draw = ln / w;  // C++ division truncates toward zero, as required
@@ -259,13 +302,13 @@ int64_t straw2_choose(const FlatBucket* b, int64_t x, int64_t r) {
 }
 
 int64_t bucket_choose(const FlatMap& m, const FlatBucket* b, int64_t x,
-                      int64_t r) {
+                      int64_t r, int position) {
   switch (b->alg) {
     case UNIFORM: return perm_choose(b, x, r);
     case LIST:    return list_choose(b, x, r);
     case TREE:    return tree_choose(b, x, r);
     case STRAW:   return straw_choose(b, x, r);
-    case STRAW2:  return straw2_choose(b, x, r);
+    case STRAW2:  return straw2_choose(b, x, r, position);
   }
   return b->items[0];
 }
@@ -314,7 +357,9 @@ int choose_firstn(const FlatMap& m, const FlatBucket* bucket,
               flocal > (unsigned)local_fallback_retries)
             item = perm_choose(in, x, r);
           else
-            item = bucket_choose(m, in, x, r);
+            // position = outpos, the dynamic success count
+            // (mapper.c:513)
+            item = bucket_choose(m, in, x, r, outpos);
           if (item >= m.max_devices) {
             skip_rep = true;
             break;
@@ -396,7 +441,9 @@ void choose_indep(const FlatMap& m, const FlatBucket* bucket,
         else
           r += numrep * ftotal;
         if (in->size == 0) break;
-        int64_t item = bucket_choose(m, in, x, r);
+        // position = the invocation's constant starting outpos
+        // (mapper.c:723) — 0 from do_rule, rep inside leaf recursion
+        int64_t item = bucket_choose(m, in, x, r, outpos);
         if (item >= m.max_devices) {
           out[rep] = ITEM_NONE;
           if (out2) out2[rep] = ITEM_NONE;

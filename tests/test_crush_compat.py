@@ -124,3 +124,64 @@ def test_mgr_crush_compat_mode_publishes():
     cl = c.client("client.b")
     assert cl.write_full("p", "o", b"balanced") == 0
     assert cl.read("p", "o") == b"balanced"
+
+
+def test_fast_path_firstn_weight_set_bit_exact():
+    """The candidate-table fast path evaluates firstn rules under
+    per-position weight sets bit-exactly: positions index by the
+    DYNAMIC outpos (mapper.c:513), materialized as a candidate axis
+    and gathered by each lane's success count during resolution."""
+    from ceph_tpu.ops.crush_fast import compile_fast_rule
+    m, pid, rno = skewed_map(n_hosts=5, per_host=3, pg_num=128)
+    calc_weight_set(m, pid, max_iterations=10)
+    args = m.crush.crush.choose_args[pid]
+    assert max(len(a.weight_set) for a in args if a.weight_set) > 1
+    cw = m.crush
+    fr = compile_fast_rule(cw.crush, rno, 3, choose_args=args)
+    assert fr.posP > 1 and fr.firstn
+    xs = np.arange(400, dtype=np.uint32)
+    rng = np.random.default_rng(3)
+    for w in ([0x10000] * m.max_osd,
+              [0x10000] * (m.max_osd - 2) + [0, 0x8000],
+              list(rng.integers(0, 5, m.max_osd) * 0x4000)):
+        res, cnt = fr.map_batch(xs, np.asarray(w, np.uint32))
+        for x in range(len(xs)):
+            expect = cw.do_rule(rno, int(x), 3, list(w),
+                                choose_args_index=pid)
+            assert list(res[x, :cnt[x]]) == expect, (x, w[:4])
+
+
+def test_native_mapper_choose_args_bit_exact():
+    """The C++ batch evaluator consumes choose_args from the blob
+    (ids overrides + per-position weight_set) and matches the host
+    interpreter exactly — so the residual-replay and middle fallback
+    tiers never degrade to the scalar Python loop."""
+    from ceph_tpu.native import NativeCrushMapper, native_available
+    if not native_available():
+        pytest.skip("native lib unavailable")
+    m, pid, rno = skewed_map(n_hosts=5, per_host=3, pg_num=64)
+    calc_weight_set(m, pid, max_iterations=8)
+    args = m.crush.crush.choose_args[pid]
+    cw = m.crush
+    nm = NativeCrushMapper(cw.crush, args)
+    w = [0x10000] * (m.max_osd - 1) + [0]
+    out, lens = nm.do_rule_batch(rno, list(range(300)), 3, w)
+    for x in range(300):
+        expect = cw.do_rule(rno, x, 3, list(w), choose_args_index=pid)
+        assert list(out[x][:lens[x]]) == expect, x
+
+
+def test_batch_mapping_stays_on_device_with_weight_set():
+    """The VERDICT done-criterion: a compat-balanced firstn pool keeps
+    the DEVICE batch mapper (no silent per-PG Python fallback)."""
+    from ceph_tpu.osdmap.mapping import OSDMapMapping
+    m, pid, _ = skewed_map(n_hosts=4, per_host=3, pg_num=64)
+    calc_weight_set(m, pid, max_iterations=8)
+    assert pid in m.crush.crush.choose_args
+    mapping = OSDMapMapping()
+    mapping.update(m)
+    assert mapping.last_backend[pid] == "device"
+    for ps in range(0, 64, 7):
+        up, upp, acting, actp = m.pg_to_up_acting_osds(pg_t(pid, ps))
+        got_up, got_upp, got_acting, got_actp = mapping.get(pg_t(pid, ps))
+        assert got_up == up and got_acting == acting
