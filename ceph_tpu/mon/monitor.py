@@ -142,8 +142,14 @@ class Monitor(Dispatcher):
             if msg.epoch > self.election_epoch:
                 self.election_epoch = msg.epoch
             if msg.rank < self.rank:
-                # defer to the lower rank
+                # defer to the lower rank — and HOLD OFF our own
+                # tick-driven retry while their round runs (Elector.cc
+                # defer(): re-proposing the instant after acking storms
+                # the election with ever-higher epochs; real processes
+                # on a loaded host can storm for dozens of rounds)
                 self.leader_rank = -1
+                self._election_defer_until = self.now + \
+                    max(MON_PING_GRACE / 2.0, 1.0)
                 self.messenger.send_message(MMonElection(
                     op=MMonElection.OP_ACK, epoch=msg.epoch,
                     rank=self.rank), msg.src)
@@ -171,6 +177,19 @@ class Monitor(Dispatcher):
             if len(self._election_acks) >= self._majority():
                 self._declare_victory()
         elif msg.op == MMonElection.OP_VICTORY:
+            if msg.rank > self.rank:
+                # lowest-rank-wins: a HIGHER rank declaring victory
+                # while we are alive means our own proposal raced its
+                # round (our acks were dropped once we "had a leader").
+                # Serving under it would deadlock — we'd never propose
+                # again (the leader looks alive) and it would keep a
+                # quorum excluding us.  Counter-propose instead; the
+                # new round converges on us (Elector.cc classic mode:
+                # the leader is the lowest live rank).
+                if msg.epoch > self.election_epoch:
+                    self.election_epoch = msg.epoch
+                self.start_election()
+                return
             if msg.rank != self.rank:
                 self._demote_inflight()
             self.election_epoch = msg.epoch
@@ -525,8 +544,11 @@ class Monitor(Dispatcher):
                     self.start_election()
                     break
         elif self.leader_rank < 0:
-            # election stalled (e.g. proposed to dead peers): retry
-            self.start_election()
+            # election stalled (e.g. proposed to dead peers): retry —
+            # but not while we just deferred to a lower rank whose
+            # round is still in flight
+            if now >= getattr(self, "_election_defer_until", 0.0):
+                self.start_election()
 
     def _handle_mon_ping(self, msg: MMonPing) -> None:
         self._peer_ranks[msg.src] = msg.rank
@@ -535,6 +557,17 @@ class Monitor(Dispatcher):
                 op=MMonPing.REPLY, rank=self.rank, stamp=msg.stamp),
                 msg.src)
         self._last_peer_seen[msg.rank] = self.now
+        # a LIVE mon pinging us while outside our quorum must be
+        # brought back in (its election ack straggled past the window):
+        # without this it never sees another BEGIN/COMMIT and its
+        # committed history freezes (Monitor.cc quorum expand on
+        # probe).  Damped: one rejoin election per grace period.
+        if self.is_leader() and len(self.quorum) < self.n_mons() and \
+                msg.rank not in self.quorum:
+            last = getattr(self, "_last_rejoin_election", -1e9)
+            if self.now - last > MON_PING_GRACE:
+                self._last_rejoin_election = self.now
+                self.start_election()
 
     # ---- cluster bootstrap -------------------------------------------------
     def bootstrap(self, n_osds: int, osds_per_host: int = 1) -> None:

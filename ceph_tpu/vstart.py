@@ -38,42 +38,87 @@ def _pin_cpu() -> None:
 
 def mon_main(args) -> None:
     """Monitor daemon: bootstrap the map, create the requested pool,
-    serve subscriptions/failure reports forever."""
+    serve subscriptions/failure reports forever.
+
+    Multi-mon (--peers): rank 0 bootstraps, wins the initial election
+    (lowest rank, Elector.cc), commits the initial epochs through paxos
+    and only then reports READY; peons serve elections/replication from
+    boot.  Any mon may later lead — all of them register the osd
+    subscriptions so a post-failover leader publishes to everyone."""
     _pin_cpu()
     from .mon import Monitor
+    from .mon import monitor as monitor_mod
     from .msg.tcp import TcpNetwork
 
     directory = json.loads(args.directory)
     auth = None
     if args.keyring:
         from .msg.tcp import TcpAuth
-        auth = TcpAuth("mon", args.keyring, kdc=True)
+        auth = TcpAuth(args.name, args.keyring, kdc=True)
     net = TcpNetwork(("127.0.0.1", args.port),
                      {k: tuple(v) for k, v in directory.items()},
-                     auth=auth, entity="mon")
-    mon = Monitor(net, name="mon")
+                     auth=auth, entity=args.name)
+    peers = [p for p in args.peers.split(",") if p]
+    if args.mon_grace:
+        monitor_mod.MON_PING_GRACE = args.mon_grace
+    mon = Monitor(net, name=args.name, rank=args.rank, peers=peers)
     if args.down_out_interval:
         mon.down_out_interval = args.down_out_interval
-    mon.bootstrap(args.n_osds, osds_per_host=1)
     for i in range(args.n_osds):
         mon.subscribe(f"osd.{i}")
-    if args.pool:
-        spec = json.loads(args.pool)
-        if spec.get("type") == "replicated":
-            mon.create_replicated_pool(spec["name"], size=spec["size"],
-                                       pg_num=spec["pg_num"])
-        else:
-            mon.create_ec_profile("vprof", spec["profile"])
-            mon.create_ec_pool(spec["name"], "vprof",
-                               pg_num=spec["pg_num"])
-    mon.publish()
-    net.pump()
-    for i in range(args.n_osds):
-        mon.send_full_map(f"osd.{i}")
+    if args.rank == 0:
+        mon.bootstrap(args.n_osds, osds_per_host=1)
+        if peers:
+            # win the initial election and seat the full quorum before
+            # committing anything (peons were spawned first)
+            mon.start_election()
+            deadline = time.monotonic() + 60.0
+            while not (mon.is_leader()
+                       and len(mon.quorum) == len(peers) + 1):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"initial mon quorum never formed: "
+                        f"ee={mon.election_epoch} lr={mon.leader_rank} "
+                        f"q={sorted(mon.quorum)}")
+                net.pump(quiesce=0.02, deadline=0.2)
+                mon.tick(time.monotonic())
+        if args.pool:
+            spec = json.loads(args.pool)
+            if spec.get("type") == "replicated":
+                mon.create_replicated_pool(spec["name"], size=spec["size"],
+                                           pg_num=spec["pg_num"])
+            else:
+                mon.create_ec_profile("vprof", spec["profile"])
+                mon.create_ec_pool(spec["name"], "vprof",
+                                   pg_num=spec["pg_num"])
+        mon.publish()
+        net.pump()
+        if peers:
+            # drain the paxos pipeline: READY must mean the initial
+            # epochs are COMMITTED quorum-wide, not merely proposed
+            deadline = time.monotonic() + 60.0
+            while mon._inflight is not None or mon._pending_proposals:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("initial epochs never committed")
+                net.pump(quiesce=0.02, deadline=0.2)
+                mon.tick(time.monotonic())
+        for i in range(args.n_osds):
+            mon.send_full_map(f"osd.{i}")
     print("READY", flush=True)
+    trace = os.environ.get("VSTART_MON_TRACE")
+    last_trace = 0.0
     while True:
         net.pump(quiesce=0.02, deadline=0.5)
         mon.tick(time.monotonic())
+        if trace and time.monotonic() - last_trace > 1.0:
+            last_trace = time.monotonic()
+            print(f"TRACE {mon.name} ee={mon.election_epoch} "
+                  f"lr={mon.leader_rank} q={sorted(mon.quorum)} "
+                  f"ep={mon.osdmap.epoch} ninc={len(mon.incrementals)} "
+                  f"unc={mon._uncommitted is not None} "
+                  f"infl={mon._inflight is not None} "
+                  f"pend={len(mon._pending_proposals)}",
+                  file=sys.stderr, flush=True)
 
 
 def osd_main(args) -> None:
@@ -112,12 +157,16 @@ def osd_main(args) -> None:
         # with its PG logs/data intact, so recovery is log-based
         from .os_store.walstore import mount_store
         store = mount_store(args.data_dir)
-    daemon = osd_mod.OSD(net, args.id, mon_name="mon", store=store)
+    mon_names = [m for m in (args.mon_names or "mon").split(",") if m]
+    daemon = osd_mod.OSD(net, args.id, mon_name=mon_names[0],
+                         store=store, mon_names=mon_names)
     # boot subscription: the mon's startup map pushes predate this
     # process's listener, so ask for the full history explicitly
-    # (MonClient::sub_want("osdmap") at OSD::init)
+    # (MonClient::sub_want("osdmap") at OSD::init) — from EVERY mon,
+    # so a post-failover leader keeps publishing to us
     from .msg.messages import MMonSubscribe
-    net.send(daemon.name, "mon", MMonSubscribe())
+    for m in mon_names:
+        net.send(daemon.name, m, MMonSubscribe())
     print("READY", flush=True)
     interval = args.heartbeat_interval or osd_mod.HEARTBEAT_INTERVAL
     # warm-up: the first tick waits one full interval so sibling
@@ -157,8 +206,15 @@ class ProcessCluster:
                  down_out_interval: float = 5.0,
                  client_names: Tuple[str, ...] = ("client.x",),
                  auth: bool = False,
-                 data_root: Optional[str] = None):
+                 data_root: Optional[str] = None,
+                 n_mons: int = 1,
+                 mon_grace: float = 4.0):
         self.n_osds = n_osds
+        self.n_mons = n_mons
+        self.mon_grace = mon_grace
+        # single-mon clusters keep the historical name "mon"
+        self.mon_names = (["mon"] if n_mons == 1
+                          else [f"mon.{r}" for r in range(n_mons)])
         self.data_root = data_root
         if data_root:
             os.makedirs(data_root, exist_ok=True)
@@ -169,7 +225,8 @@ class ProcessCluster:
             from .auth import Keyring
             self._tmpdir = tempfile.mkdtemp(prefix="ceph_tpu_auth_")
             kr = Keyring()
-            kr.create("mon")
+            for m in self.mon_names:
+                kr.create(m)
             for i in range(n_osds):
                 kr.create(f"osd.{i}")
             for name in client_names:
@@ -177,12 +234,14 @@ class ProcessCluster:
             self.keyring_path = os.path.join(self._tmpdir, "keyring")
             kr.save(self.keyring_path)
         self.client_names = client_names
-        ports = _free_ports(n_osds + 2)
-        self.mon_port = ports[0]
-        self.client_port = ports[1]
-        self.osd_ports = ports[2:]
-        directory: Dict[str, Tuple[str, int]] = {
-            "mon": ("127.0.0.1", self.mon_port)}
+        ports = _free_ports(n_osds + n_mons + 1)
+        self.mon_ports = ports[:n_mons]
+        self.mon_port = self.mon_ports[0]
+        self.client_port = ports[n_mons]
+        self.osd_ports = ports[n_mons + 1:]
+        directory: Dict[str, Tuple[str, int]] = {}
+        for r, m in enumerate(self.mon_names):
+            directory[m] = ("127.0.0.1", self.mon_ports[r])
         for name in client_names:
             directory[name] = ("127.0.0.1", self.client_port)
         for i in range(n_osds):
@@ -204,15 +263,34 @@ class ProcessCluster:
                heartbeat_grace, down_out_interval) -> None:
         keyring_args = (["--keyring", self.keyring_path]
                         if self.keyring_path else [])
-        self.procs["mon"] = subprocess.Popen(
-            [sys.executable, "-m", "ceph_tpu.vstart", "mon",
-             "--port", str(self.mon_port), "--n-osds", str(n_osds),
-             "--directory", dir_json,
-             "--down-out-interval", str(down_out_interval),
-             "--pool", json.dumps(pool) if pool else "",
-             *keyring_args],
-            stdout=subprocess.PIPE, text=True, cwd=REPO, env=env)
-        self._await_ready("mon")
+        peers_of = {m: ",".join(n for n in self.mon_names if n != m)
+                    for m in self.mon_names}
+
+        def spawn_mon(rank: int, with_pool: bool) -> None:
+            name = self.mon_names[rank]
+            self.procs[name] = subprocess.Popen(
+                [sys.executable, "-m", "ceph_tpu.vstart", "mon",
+                 "--port", str(self.mon_ports[rank]),
+                 "--n-osds", str(n_osds),
+                 "--directory", dir_json,
+                 "--name", name, "--rank", str(rank),
+                 "--peers", peers_of[name],
+                 "--mon-grace", str(self.mon_grace),
+                 "--down-out-interval", str(down_out_interval),
+                 "--pool", json.dumps(pool) if (pool and with_pool)
+                 else "",
+                 *keyring_args],
+                stdout=subprocess.PIPE, text=True, cwd=REPO, env=env)
+
+        # peons first (they serve the election rank 0 must win); rank 0
+        # reports READY only after the initial epochs are committed
+        # quorum-wide
+        for r in range(1, self.n_mons):
+            spawn_mon(r, with_pool=False)
+        for r in range(1, self.n_mons):
+            self._await_ready(self.mon_names[r])
+        spawn_mon(0, with_pool=True)
+        self._await_ready(self.mon_names[0])
         # spawn every osd CONCURRENTLY: a sequential boot staggers the
         # daemons' first heartbeats past the grace window and the
         # cluster marks itself down before it finishes starting
@@ -243,10 +321,22 @@ class ProcessCluster:
         if line.strip() != "READY":
             raise RuntimeError(f"{name} failed to start: {line!r}")
 
-    def client(self, name: str = "client.x"):
+    def client(self, name: str = "client.x",
+               mon_name: Optional[str] = None):
+        """Wire client; ``mon_name`` picks which mon it is bound to
+        (subscriptions + wire commands — commands relay to the leader
+        from any mon, so binding to a peon is fine)."""
         from .client.mon_client import MonClient
         from .client.rados import RadosClient
-        return RadosClient(self.network, MonClient(self.network), name)
+        return RadosClient(
+            self.network,
+            MonClient(self.network, mon_name or self.mon_names[0]), name)
+
+    def kill_mon(self, rank: int) -> None:
+        """kill -9 a monitor daemon (the leader-failure drill)."""
+        p = self.procs[self.mon_names[rank]]
+        p.send_signal(signal.SIGKILL)
+        p.wait()
 
     def wait_healthy(self, cl, timeout: float = 60.0) -> None:
         """Block until the map shows every osd up (daemons can still be
@@ -273,6 +363,7 @@ class ProcessCluster:
             [sys.executable, "-m", "ceph_tpu.vstart", "osd",
              "--id", str(i), "--port", str(self.osd_ports[i]),
              "--directory", a["dir_json"],
+             "--mon-names", ",".join(self.mon_names),
              "--heartbeat-interval", str(a["heartbeat_interval"]),
              "--heartbeat-grace", str(a["heartbeat_grace"]),
              *a["keyring_args"], *data_args],
@@ -326,6 +417,10 @@ def main(argv=None) -> None:
     pm.add_argument("--port", type=int, required=True)
     pm.add_argument("--n-osds", type=int, required=True)
     pm.add_argument("--directory", required=True)
+    pm.add_argument("--name", default="mon")
+    pm.add_argument("--rank", type=int, default=0)
+    pm.add_argument("--peers", default="")
+    pm.add_argument("--mon-grace", type=float, default=0.0)
     pm.add_argument("--pool", default="")
     pm.add_argument("--down-out-interval", type=float, default=0.0)
     pm.add_argument("--keyring", default="")
@@ -333,6 +428,7 @@ def main(argv=None) -> None:
     po.add_argument("--id", type=int, required=True)
     po.add_argument("--port", type=int, required=True)
     po.add_argument("--directory", required=True)
+    po.add_argument("--mon-names", default="mon")
     po.add_argument("--heartbeat-interval", type=float, default=0.0)
     po.add_argument("--heartbeat-grace", type=float, default=0.0)
     po.add_argument("--keyring", default="")

@@ -1,0 +1,124 @@
+"""Three-monitor ProcessCluster: leader SIGKILL over real sockets.
+
+The reference's vstart runs three mons and mon thrashing kills the
+leader mid-flight (qa/tasks/mon_thrash.py); the survivors must elect,
+recover possibly-committed values through the collect/LAST phase
+(src/mon/Paxos.cc), and keep serving — with nothing unquorate ever
+observable.  This is the in-process `tests/test_multimon.py` partition
+scenario run across real process boundaries: every election, BEGIN,
+ACCEPT, and command relay crosses a TCP socket.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.vstart import ProcessCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = ProcessCluster(
+        n_osds=3, n_mons=3, mon_grace=3.0,
+        pool={"name": "p", "type": "replicated", "size": 3, "pg_num": 4},
+        client_names=("client.x", "client.y"),
+        heartbeat_interval=1.0, heartbeat_grace=4.0)
+    yield c
+    c.close()
+
+
+def _snap_create_retrying(c, cl, timeout=45.0):
+    """selfmanaged_snap_create through the wire-command path, retried
+    across election windows; returns the acked snap id."""
+    end = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < end:
+        try:
+            return cl.selfmanaged_snap_create("p")
+        except (IOError, ValueError) as e:
+            last = e
+            c.pump_for(0.5)
+    raise AssertionError(f"snap create never succeeded: {last!r}")
+
+
+def _refresh_map(c, cl, tries=3):
+    for _ in range(tries):
+        cl.mon.send_full_map(cl.name)
+        c.pump_for(0.3)
+
+
+def test_three_mons_leader_sigkill_recovers(cluster):
+    c = cluster
+    # the client is BOUND TO A PEON (mon.1): its commands cross the
+    # peon->leader relay, and its map feed survives the leader's death
+    cl = c.client("client.x", mon_name="mon.1")
+    c.wait_healthy(cl)
+
+    data = np.random.default_rng(9).integers(
+        0, 256, 20000, dtype=np.uint8).tobytes()
+    end = time.monotonic() + 30.0
+    while True:                    # daemons may still be applying maps
+        try:
+            assert cl.write_full("p", "obj", data) == 0
+            break
+        except IOError:
+            if time.monotonic() > end:
+                raise
+            c.pump_for(1.0)
+    assert cl.read("p", "obj") == data
+
+    # committed allocations under the original leader (relayed mon.1 ->
+    # mon.0): these are full-quorum commits the recovery must preserve
+    pre_ids = [_snap_create_retrying(c, cl) for _ in range(3)]
+    assert pre_ids == sorted(pre_ids) and len(set(pre_ids)) == 3
+
+    # kill the leader MID-PROPOSAL: fire a relayed command and SIGKILL
+    # mon.0 immediately, so a BEGIN can be in flight when it dies
+    from ceph_tpu.msg.messages import MMonCommand
+    c.network.send("client.x", "mon.1", MMonCommand(
+        tid=990001, cmd="selfmanaged_snap_create",
+        args={"pool_name": "p"}))
+    c.kill_mon(0)
+
+    # survivors elect (mon.1, the lowest surviving rank) and service
+    # resumes; the first post-failover allocation must be STRICTLY
+    # ABOVE every pre-kill ack — if collect/LAST recovery had lost a
+    # committed value, the fresh leader would re-issue an old id
+    post_id = _snap_create_retrying(c, cl, timeout=60.0)
+    assert post_id > max(pre_ids), (pre_ids, post_id)
+
+    # both survivors converge on one committed state: subscribe a
+    # client to each and compare the replicated map
+    cl2 = c.client("client.y", mon_name="mon.2")
+    deadline = time.monotonic() + 30.0
+    while True:
+        _refresh_map(c, cl)
+        _refresh_map(c, cl2)
+        p1 = cl.osdmap.pools.get(cl.lookup_pool("p"))
+        p2 = cl2.osdmap.pools.get(cl2.lookup_pool("p"))
+        if (p1 is not None and p2 is not None
+                and cl.osdmap.epoch == cl2.osdmap.epoch
+                and p1.snap_seq == p2.snap_seq
+                and p1.snap_seq >= post_id):
+            break
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"survivors diverged: epochs {cl.osdmap.epoch}/"
+                f"{cl2.osdmap.epoch}, snap_seq "
+                f"{getattr(p1, 'snap_seq', None)}/"
+                f"{getattr(p2, 'snap_seq', None)}, want >= {post_id}")
+        c.pump_for(1.0)
+
+    # data written under the old quorum still serves under the new one
+    assert cl.read("p", "obj") == data
+    # and the cluster keeps accepting writes
+    end = time.monotonic() + 30.0
+    while True:
+        try:
+            assert cl.write_full("p", "obj2", data[:5000]) == 0
+            break
+        except IOError:
+            if time.monotonic() > end:
+                raise
+            c.pump_for(1.0)
+    assert cl.read("p", "obj2") == data[:5000]
