@@ -61,88 +61,22 @@ def calc_pg_upmaps(osdmap: OSDMap, max_deviation: float = 0.01,
                    inc: Optional[Incremental] = None) -> int:
     """Compute pg_upmap_items flattening the distribution.
 
-    Stores results into *inc* (and the map's pg_upmap_items for chained
-    evaluation); returns the number of changes, like the reference.
+    Delegates to the decision-identical port of the reference's
+    OSDMap::calc_pg_upmaps (osdmap/upmap.py, pinned byte-for-byte to
+    the recorded osdmaptool cram outputs by
+    tests/test_osdmaptool_golden.py).  Results land in *inc* (and the
+    map's pg_upmap_items for chained evaluation); returns the number
+    of changes, like the reference.
     """
-    pools = pools if pools is not None else sorted(osdmap.pools)
-    changes = 0
-
-    for _ in range(max_iterations):
-        # current distribution over raw-up mappings
-        pgs_by_osd: Dict[int, List[pg_t]] = {}
-        total_copies = 0
-        dom_cache: Dict[Tuple[int, int], int] = {}
-        pg_map: Dict[pg_t, List[int]] = {}
-        for pid in pools:
-            pool = osdmap.pools[pid]
-            for ps in range(pool.pg_num):
-                pg = pg_t(pid, ps)
-                up, _ = osdmap.pg_to_raw_up(pg)
-                up = [o for o in up if o != CRUSH_ITEM_NONE]
-                pg_map[pg] = up
-                for o in up:
-                    pgs_by_osd.setdefault(o, []).append(pg)
-                    total_copies += 1
-        weights = {o: osdmap.osd_weight[o]
-                   for o in range(osdmap.max_osd)
-                   if osdmap.exists(o) and osdmap.osd_weight[o] > 0}
-        if not weights or not total_copies:
-            return changes
-        wsum = sum(weights.values())
-        target = {o: total_copies * w / wsum for o, w in weights.items()}
-        deviation = {o: len(pgs_by_osd.get(o, [])) - target[o]
-                     for o in weights}
-        over = max(deviation, key=lambda o: deviation[o])
-        under = sorted((o for o in weights if deviation[o] < 0),
-                       key=lambda o: deviation[o])
-        if deviation[over] <= max(1.0, max_deviation * total_copies /
-                                  max(1, len(weights))):
-            break
-        moved = False
-        for pg in sorted(pgs_by_osd.get(over, []), key=str):
-            pool = osdmap.pools[pg.pool]
-            ruleno = osdmap.crush.find_rule(pool.crush_rule, pool.type,
-                                            pool.size)
-            dtype = _failure_domain_type(osdmap, ruleno)
-            cur = pg_map[pg]
-            used_domains = set()
-            for o in cur:
-                if o == over:
-                    continue
-                key = (o, dtype)
-                if key not in dom_cache:
-                    dom_cache[key] = _domain_of(osdmap, o, dtype)
-                used_domains.add(dom_cache[key])
-            for cand in under:
-                if cand in cur:
-                    continue
-                key = (cand, dtype)
-                if key not in dom_cache:
-                    dom_cache[key] = _domain_of(osdmap, cand, dtype)
-                if dom_cache[key] in used_domains:
-                    continue
-                # validate by applying the remap for real
-                items = osdmap.pg_upmap_items.get(pg, [])
-                trial = [it for it in items if it[0] != over] \
-                    + [(over, cand)]
-                osdmap.pg_upmap_items[pg] = trial
-                new_up, _ = osdmap.pg_to_raw_up(pg)
-                if over in new_up or cand not in new_up:
-                    if items:
-                        osdmap.pg_upmap_items[pg] = items
-                    else:
-                        del osdmap.pg_upmap_items[pg]
-                    continue
-                if inc is not None:
-                    inc.new_pg_upmap_items[pg] = trial
-                changes += 1
-                moved = True
-                break
-            if moved:
-                break
-        if not moved:
-            break
-    return changes
+    from .upmap import PendingInc
+    from .upmap import calc_pg_upmaps as _exact
+    pi = PendingInc()
+    n = _exact(osdmap, max_deviation, max_iterations,
+               set(pools) if pools else None, pi)
+    if inc is not None:
+        inc.new_pg_upmap_items.update(pi.new_pg_upmap_items)
+        inc.old_pg_upmap_items.extend(sorted(pi.old_pg_upmap_items))
+    return n
 
 
 # ---- crush-compat mode (per-position weight_set optimization) --------------

@@ -62,6 +62,12 @@ def main(argv=None) -> int:
     p.add_argument("--set-chooseleaf-vary-r", type=int, default=None)
     p.add_argument("--set-chooseleaf-stable", type=int, default=None)
     p.add_argument("--set-straw-calc-version", type=int, default=None)
+    p.add_argument("--build", action="store_true",
+                   help="build a layered map: --num_osds N "
+                        "(name alg size)...")
+    p.add_argument("--num_osds", type=int, default=0)
+    p.add_argument("layers", nargs="*",
+                   help="--build layer triples: name alg size")
     p.add_argument("--host-mapper", action="store_true",
                    help="force the host interpreter (no device batch)")
     args = p.parse_args(argv)
@@ -79,6 +85,65 @@ def main(argv=None) -> int:
                 ("straw_calc_version", args.set_straw_calc_version)]:
             if val is not None:
                 setattr(m, attr, val)
+
+    if args.build:
+        # crushtool --build --num_osds N name alg size ...
+        # (src/tools/crushtool.cc): stack layers bottom-up, each layer
+        # packing the previous one's items into buckets of `size`
+        # (0 = everything into one bucket), named name<i> (bare name
+        # for size 0); then build_simple_crush_rules over the top root.
+        from ..crush.constants import (
+            CRUSH_BUCKET_LIST, CRUSH_BUCKET_STRAW, CRUSH_BUCKET_STRAW2,
+            CRUSH_BUCKET_TREE, CRUSH_BUCKET_UNIFORM)
+        algs = {"uniform": CRUSH_BUCKET_UNIFORM,
+                "list": CRUSH_BUCKET_LIST, "tree": CRUSH_BUCKET_TREE,
+                "straw": CRUSH_BUCKET_STRAW,
+                "straw2": CRUSH_BUCKET_STRAW2}
+        if len(args.layers) % 3 or not args.layers:
+            print("--build needs (name alg size) triples",
+                  file=sys.stderr)
+            return 1
+        for li in range(0, len(args.layers), 3):
+            lname, lalg, lsize = args.layers[li:li + 3]
+            if lalg not in algs:
+                print(f"unknown bucket type '{lalg}'", file=sys.stderr)
+                return 1
+            if not lsize.lstrip("-").isdigit() or int(lsize) < 0:
+                print(f"invalid layer size '{lsize}'", file=sys.stderr)
+                return 1
+        cw = CrushWrapper()
+        cw.set_tunables_profile("jewel")
+        cw.set_type_name(0, "osd")
+        cw.set_max_devices(args.num_osds)
+        lower = [(i, 0x10000) for i in range(args.num_osds)]
+        for i in range(args.num_osds):
+            cw.set_item_name(i, f"osd.{i}")
+        t = 0
+        lname = "osd"
+        for li in range(0, len(args.layers), 3):
+            lname, lalg, lsize = args.layers[li:li + 3]
+            t += 1
+            size = int(lsize)
+            cw.set_type_name(t, lname)
+            pos, idx = 0, 0
+            cur = []
+            while pos < len(lower):
+                chunk = lower[pos:pos + size] if size else lower[pos:]
+                pos += len(chunk)
+                bid = cw.add_bucket(
+                    algs[lalg], t,
+                    f"{lname}{idx}" if size else lname,
+                    [c for c, _ in chunk], [w for _, w in chunk])
+                cur.append((bid, sum(w for _, w in chunk)))
+                idx += 1
+            lower = cur
+        root = lname if int(args.layers[-1]) == 0 else f"{lname}0"
+        cw.add_simple_rule("replicated_rule", root_name=root,
+                           failure_domain_name=cw.get_type_name(1),
+                           mode="firstn", ruleno=0)
+        out = args.outfn or "crushmap"
+        save_map(cw, out)
+        return 0
 
     if args.srcfn:
         with open(args.srcfn) as f:

@@ -53,43 +53,97 @@ def createsimple(n_osds: int, pg_num: int = 128,
     return m
 
 
-def test_map_pgs(m: OSDMap, use_device: bool, out) -> None:
+def _crush_item_weights(m: OSDMap) -> dict:
+    """osd -> crush item weight, one pass over every bucket."""
+    out: dict = {}
+    for b in m.crush.crush.buckets:
+        if b is None:
+            continue
+        for i, it in enumerate(b.items):
+            if it >= 0:
+                out[it] = b.item_weights[i]
+    return out
+
+
+def test_map_pgs(m: OSDMap, use_device: bool, out,
+                 test_random: bool = False, only_pool: int = -1) -> None:
+    """--test-map-pgs in the reference's output format
+    (src/tools/osdmaptool.cc): per-pool pg_num lines, the per-IN-osd
+    count table, ' in/avg/min/max' stats, and the size histogram —
+    plus one trailing 'mapped ...' line naming the batch backend."""
     mapping = OSDMapMapping(use_device=use_device)
     t0 = time.perf_counter()
-    mapping.update(m)
+    if not test_random:
+        mapping.update(m)
     dt = time.perf_counter() - t0
     count = np.zeros(m.max_osd, dtype=np.int64)
+    first = np.zeros(m.max_osd, dtype=np.int64)
     primaries = np.zeros(m.max_osd, dtype=np.int64)
-    total = 0
-    size_total = 0
-    for pid, pm in mapping.pools.items():
-        for ps in range(pm.acting.shape[0]):
-            row = pm.acting[ps]
-            total += 1
+    sizes = np.zeros(30, dtype=np.int64)
+    total_pgs = 0
+    rng = np.random.default_rng()
+    for pid in sorted(m.pools):
+        if only_pool >= 0 and pid != only_pool:
+            continue
+        pool = m.pools[pid]
+        print(f"pool {pid} pg_num {pool.pg_num}", file=out)
+        for ps in range(pool.pg_num):
+            total_pgs += 1
+            if test_random:
+                row = rng.integers(0, m.max_osd, size=pool.size)
+                prim = int(row[0])
+            else:
+                pm = mapping.pools[pid]
+                row = [o for o in pm.acting[ps] if o != CRUSH_ITEM_NONE]
+                prim = int(pm.acting_primary[ps])
+            sizes[len(row)] += 1
             for o in row:
-                if o != CRUSH_ITEM_NONE:
-                    count[o] += 1
-                    size_total += 1
-            p = pm.acting_primary[ps]
-            if p >= 0:
-                primaries[p] += 1
-    used = count[count > 0]
-    print(f"pool {sorted(mapping.pools)} pg_num "
-          f"{[m.pools[p].pg_num for p in sorted(mapping.pools)]}",
-          file=out)
-    print(f"#osd\tcount\tfirst\tprimary\tc wt\twt", file=out)
+                count[o] += 1
+            if len(row):
+                first[row[0]] += 1
+            if prim >= 0:
+                primaries[prim] += 1
+    n_in = 0
+    total = 0
+    min_osd = max_osd = -1
+    crush_w = _crush_item_weights(m)
+    print("#osd\tcount\tfirst\tprimary\tc wt\twt", file=out)
     for o in range(m.max_osd):
-        print(f"osd.{o}\t{count[o]}\t{primaries[o]}\t{primaries[o]}"
-              f"\t{m.crush.crush.max_devices and 1.0}\t"
-              f"{m.osd_weight[o] / 0x10000:.4g}", file=out)
-    avg = size_total / max(1, len(used))
-    print(f" avg {avg:.4g} stddev {used.std():.4g} "
-          f"(expected {np.sqrt(avg):.4g})", file=out)
-    print(f" min osd.{int(count.argmin())} {int(count.min())}", file=out)
-    print(f" max osd.{int(count.argmax())} {int(count.max())}", file=out)
-    print(f"size {size_total // max(1, total)}\t{total}", file=out)
-    backends = ",".join(sorted(set(mapping.last_backend.values())))
-    print(f"mapped {total} pgs in {dt * 1000:.1f} ms "
+        if m.osd_weight[o] == 0:
+            continue
+        cw = crush_w.get(o, 0)
+        if cw <= 0:
+            continue
+        n_in += 1
+        print(f"osd.{o}\t{count[o]}\t{first[o]}\t{primaries[o]}"
+              f"\t{cw / 0x10000:g}\t{m.osd_weight[o] / 0x10000:g}",
+              file=out)
+        total += count[o]
+        if count[o] and (min_osd < 0 or count[o] < count[min_osd]):
+            min_osd = o
+        if count[o] and (max_osd < 0 or count[o] > count[max_osd]):
+            max_osd = o
+    avg = total // n_in if n_in else 0
+    dev = 0.0
+    for o in range(m.max_osd):
+        if m.osd_weight[o] == 0 or crush_w.get(o, 0) <= 0:
+            continue
+        dev += float(avg - count[o]) ** 2
+    dev = (dev / n_in) ** 0.5 if n_in else 0.0
+    edev = ((total / n_in) * (1.0 - 1.0 / n_in)) ** 0.5 if n_in else 0.0
+    print(f" in {n_in}", file=out)
+    print(f" avg {avg} stddev {dev:g} ({dev / avg if avg else 0:g}x) "
+          f"(expected {edev:g} {edev / avg if avg else 0:g}x))",
+          file=out)
+    if min_osd >= 0:
+        print(f" min osd.{min_osd} {count[min_osd]}", file=out)
+    if max_osd >= 0:
+        print(f" max osd.{max_osd} {count[max_osd]}", file=out)
+    for i in range(4):
+        print(f"size {i}\t{sizes[i]}", file=out)
+    backends = ",".join(sorted(set(mapping.last_backend.values()))) \
+        if not test_random else "random"
+    print(f"mapped {total_pgs} pgs in {dt * 1000:.1f} ms "
           f"(backend: {backends})", file=out)
 
 
@@ -97,8 +151,16 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="osdmaptool")
     p.add_argument("mapfn", nargs="?", help="osdmap file")
     p.add_argument("--createsimple", type=int, metavar="N_OSDS")
+    p.add_argument("--create-from-conf", action="store_true")
+    p.add_argument("-c", "--conf", metavar="CONFFILE")
+    p.add_argument("--with-default-pool", action="store_true")
+    p.add_argument("--pg_bits", type=int, default=None)
+    p.add_argument("--pgp_bits", type=int, default=None)
+    p.add_argument("--mark-out", type=int, default=-1, metavar="OSD")
     p.add_argument("--pg-num", type=int, default=128)
     p.add_argument("--test-map-pgs", action="store_true")
+    p.add_argument("--test-random", action="store_true")
+    p.add_argument("--import-crush", metavar="CRUSHFILE")
     p.add_argument("--test-map-object", metavar="OBJ")
     p.add_argument("--pool", type=int, default=-1)
     p.add_argument("--upmap", metavar="OUTFILE",
@@ -110,24 +172,65 @@ def main(argv=None) -> int:
     p.add_argument("--print", dest="do_print", action="store_true")
     args = p.parse_args(argv)
 
+    pg_bits = 6 if args.pg_bits is None else args.pg_bits
+    pgp_bits = pg_bits if args.pgp_bits is None else args.pgp_bits
+
+    if (args.createsimple or args.create_from_conf) and not args.mapfn:
+        p.print_help()
+        return 1
+    if args.create_from_conf and not args.conf:
+        print("--create-from-conf requires -c <conffile>",
+              file=sys.stderr)
+        return 1
+
     if args.createsimple:
-        m = createsimple(args.createsimple, args.pg_num)
+        if args.pg_bits is not None or args.with_default_pool:
+            # the reference shape: pool 1 'rbd', pg_num = N << pg_bits,
+            # osds NOT yet up/in (--mark-up-in does that)
+            from ..osdmap.simple_build import build_simple
+            m = build_simple(args.createsimple,
+                             with_default_pool=args.with_default_pool,
+                             pg_bits=pg_bits, pgp_bits=pgp_bits)
+        else:
+            m = createsimple(args.createsimple, args.pg_num)
+        print(f"osdmaptool: osdmap file '{args.mapfn}'")
         if args.mapfn:
             with open(args.mapfn, "wb") as f:
                 pickle.dump(m, f)
+        print(f"osdmaptool: writing epoch {m.epoch} to {args.mapfn}")
+        return 0
+
+    if args.create_from_conf:
+        # the reference's --create-from-conf (build_simple_with_pool
+        # over the conf's [osd.N] host/rack locations)
+        from ..osdmap.simple_build import build_from_conf
+        with open(args.conf) as f:
+            conf_text = f.read()
+        m = build_from_conf(conf_text,
+                            with_default_pool=args.with_default_pool,
+                            pg_bits=pg_bits, pgp_bits=pgp_bits)
         print(f"osdmaptool: osdmap file '{args.mapfn}'")
+        with open(args.mapfn, "wb") as f:
+            pickle.dump(m, f)
         print(f"osdmaptool: writing epoch {m.epoch} to {args.mapfn}")
         return 0
 
     if not args.mapfn:
         p.print_help()
         return 1
+    print(f"osdmaptool: osdmap file '{args.mapfn}'")
     with open(args.mapfn, "rb") as f:
         m = pickle.load(f)
 
     if args.mark_up_in:
-        for o in range(m.max_osd):
-            m.set_osd(o, up=True, weight=CEPH_OSD_IN)
+        print("marking all OSDs up and in")
+        from ..osdmap.simple_build import mark_up_in
+        mark_up_in(m)
+
+    if args.mark_out >= 0 and args.mark_out < m.max_osd:
+        print(f"marking OSD@{args.mark_out} as out")
+        from ..osdmap.simple_build import mark_out as _mark_out
+        _mark_out(m, args.mark_out)
 
     if args.do_print:
         print(f"epoch {m.epoch}")
@@ -149,21 +252,41 @@ def main(argv=None) -> int:
               f"up {up} acting {acting}")
         return 0
 
+    if args.import_crush:
+        from .crushtool import load_map
+        m.crush = load_map(args.import_crush)
+        with open(args.mapfn, "wb") as f:
+            pickle.dump(m, f)
+        return 0
+
     if args.test_map_pgs:
-        test_map_pgs(m, not args.host_mapper, sys.stdout)
+        if args.pool >= 0 and args.pool not in m.pools:
+            print(f"There is no pool {args.pool}", file=sys.stderr)
+            return 1
+        test_map_pgs(m, not args.host_mapper, sys.stdout,
+                     test_random=args.test_random, only_pool=args.pool)
         return 0
 
     if args.upmap:
-        inc = Incremental(epoch=m.epoch + 1)
-        pools = [args.pool] if args.pool >= 0 else None
-        n = calc_pg_upmaps(m, args.upmap_deviation, args.upmap_max,
-                           pools, inc)
+        # decision-identical with the reference's calc_pg_upmaps
+        # (osdmap/upmap.py); the stdout/file formats mirror
+        # src/tools/osdmaptool.cc print_inc_upmaps
+        from ..osdmap.upmap import PendingInc
+        from ..osdmap.upmap import calc_pg_upmaps as exact_upmaps
+        print(f"writing upmap command output to: {args.upmap}")
+        print("checking for upmap cleanups")
+        print(f"upmap, max-count {args.upmap_max}, "
+              f"max deviation {args.upmap_deviation:g}")
+        inc = PendingInc()
+        pools = {args.pool} if args.pool >= 0 else None
+        exact_upmaps(m, args.upmap_deviation, args.upmap_max, pools, inc)
         with open(args.upmap, "w") as f:
-            for pg, items in sorted(inc.new_pg_upmap_items.items(),
-                                    key=lambda kv: str(kv[0])):
-                pairs = " ".join(f"{a} {b}" for a, b in items)
+            for pg in sorted(inc.old_pg_upmap_items):
+                f.write(f"ceph osd rm-pg-upmap-items {pg}\n")
+            for pg in sorted(inc.new_pg_upmap_items):
+                pairs = " ".join(f"{a} {b}"
+                                 for a, b in inc.new_pg_upmap_items[pg])
                 f.write(f"ceph osd pg-upmap-items {pg} {pairs}\n")
-        print(f"wrote {n} upmap item changes to {args.upmap}")
         return 0
 
     return 0

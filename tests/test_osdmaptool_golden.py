@@ -1,0 +1,91 @@
+"""osdmaptool golden parity: replay the reference's recorded cram
+outputs byte-for-byte.
+
+Like tests/test_reference_golden.py does for crushtool, these tests
+parse the reference's cram files (src/test/cli/osdmaptool/*.t — the
+EXPECTED outputs its own binary produced) and replay the same command
+sequences through ceph_tpu's osdmaptool/crushtool, pinning
+``calc_pg_upmaps`` to the reference algorithm's actual decisions (not
+a stddev proxy) and the simple-map builders to its construction.
+"""
+import os
+import re
+
+import pytest
+
+from ceph_tpu.tools import crushtool, osdmaptool
+
+TDIR = "/root/reference/src/test/cli/osdmaptool"
+CONF = os.path.join(TDIR, "ceph.conf.withracks")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(TDIR), reason="reference cram files unavailable")
+
+
+def expected_upmap_lines(tname: str):
+    """The `cat c` block from a cram file: the recorded upmap commands."""
+    text = open(os.path.join(TDIR, tname)).read()
+    m = re.search(r"\$ cat c\n((?:  ceph osd [^\n]+\n)+)", text)
+    assert m, f"no recorded upmap block in {tname}"
+    return [ln[2:] for ln in m.group(1).splitlines()]
+
+
+def run_upmap(tmp_path, mark_out=None):
+    om = str(tmp_path / "om")
+    c = str(tmp_path / "c")
+    assert osdmaptool.main(["--create-from-conf", om, "-c", CONF,
+                            "--with-default-pool"]) == 0
+    argv = [om, "--mark-up-in"]
+    if mark_out is not None:
+        argv += ["--mark-out", str(mark_out)]
+    argv += ["--upmap-max", "11", "--upmap", c]
+    assert osdmaptool.main(argv) == 0
+    return open(c).read().splitlines()
+
+
+def test_upmap_t_byte_exact(tmp_path):
+    """upmap.t: 239-osd two-rack map, `--upmap-max 11 --upmap c` —
+    the 11 recorded pg-upmap-items commands, byte-for-byte."""
+    assert run_upmap(tmp_path) == expected_upmap_lines("upmap.t")
+
+
+def test_upmap_out_t_byte_exact(tmp_path):
+    """upmap-out.t: same with osd.147 marked out."""
+    assert run_upmap(tmp_path, mark_out=147) == \
+        expected_upmap_lines("upmap-out.t")
+
+
+def test_map_pgs_t_replay(tmp_path, capsys):
+    """test-map-pgs.t: createsimple 500 osds @ pg_bits 4, import a
+    crushtool --build straw map, and replay the cram's grep asserts:
+    pool pg_num, the complete size histogram, and crush-vs-random
+    stats differing."""
+    om = str(tmp_path / "osdmap")
+    cm = str(tmp_path / "crushmap")
+    assert osdmaptool.main(["--pg_bits", "4", "--createsimple", "500",
+                            om, "--with-default-pool"]) == 0
+    assert crushtool.main(["--outfn", cm, "--build", "--num_osds",
+                           "500", "node", "straw", "10",
+                           "rack", "straw", "10",
+                           "root", "straw", "0"]) == 0
+    assert osdmaptool.main([om, "--import-crush", cm]) == 0
+    capsys.readouterr()
+
+    assert osdmaptool.main([om, "--mark-up-in", "--test-map-pgs"]) == 0
+    out = capsys.readouterr().out
+    assert "pool 1 pg_num 8000" in out            # 500 << 4
+    assert re.search(r"size 3\t8000\b", out)      # every pg mapped full
+    stats_crush = [ln for ln in out.splitlines()
+                   if ln.startswith(" avg ")]
+    assert stats_crush
+
+    assert osdmaptool.main([om, "--mark-up-in", "--test-random",
+                            "--test-map-pgs"]) == 0
+    out = capsys.readouterr().out
+    assert "pool 1 pg_num 8000" in out
+    assert re.search(r"size 3\t8000\b", out)
+    stats_random = [ln for ln in out.splitlines()
+                    if ln.startswith(" avg ")]
+    # "it is almost impossible to get the same stats with random and
+    # crush; if they are, something went wrong somewhere" (the cram)
+    assert stats_crush != stats_random

@@ -167,7 +167,7 @@ def test_osdmaptool_upmap_balances(tmp_path, capsys):
     assert osdmaptool.main([str(mf), "--upmap", str(upf),
                             "--upmap-max", "32"]) == 0
     out = capsys.readouterr().out
-    assert "upmap item changes" in out
+    assert "upmap, max-count 32" in out
     text = upf.read_text()
     # each line is a pg-upmap-items command
     for line in text.splitlines():
