@@ -1,0 +1,417 @@
+"""RemoteCephFS — the MDS-mediated cephfs client (libcephfs + Client.cc
+shape at lite scale).
+
+Metadata operations cross the wire to the MDS (MClientRequest /
+MClientReply, mds/server.py); FILE DATA goes straight to the OSDs with
+the layout and SnapContext the MDS handed out at open — the cephfs
+split exactly (src/client/Client.cc: metadata via MDS sessions, data
+via the Objecter).
+
+Capabilities: ``open(path, "w")`` asks for CEPH_CAP_FILE_BUFFER; while
+held, FileHandle.write() buffers locally (write-back).  When another
+client's open conflicts, the MDS revokes (MClientCaps) — the dispatcher
+flushes the buffer to the data pool and acks with the wrstat payload,
+exactly the Locker round the reference drives.  Snapshot reads resolve
+directly against immutable clones (like data reads, they never need the
+MDS's serialization)."""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..client.rados import RadosClient
+from ..msg.messages import (
+    CEPH_CAP_FILE_BUFFER, CEPH_CAP_FILE_CACHE, MClientCaps,
+    MClientReply, MClientRequest, Message,
+)
+from .client import CephFS, FsError, _absent
+from .cls_fs import ROOT_INO, dir_oid, file_oid
+
+# the wait must outlast the MDS session_timeout (20 s): a request
+# parked behind a DEAD cap holder only unblocks once the MDS evicts
+# the holder.  In-process (drive set) iterations are fast; across
+# processes each late iteration sleeps 0.25 s -> ~30 s worst case.
+MAX_ATTEMPTS = 120
+DEFAULT_ORDER = 22
+
+
+class FileHandle:
+    """An open file under caps: write-back buffer when BUFFER is held
+    (ObjectCacher role, one-file scale)."""
+
+    def __init__(self, fs: "RemoteCephFS", path: str, inode: Dict,
+                 caps: int, snapc: Tuple[int, List[int]]):
+        self.fs = fs
+        self.path = path
+        self.inode = inode
+        self.caps = caps
+        self.snapc = snapc
+        self.buffer: List[Tuple[int, bytes]] = []
+        self.size = inode["size"]
+
+    # -- io ------------------------------------------------------------
+    def write(self, data: bytes, offset: Optional[int] = None) -> int:
+        off = self.size if offset is None else offset
+        if self.caps & CEPH_CAP_FILE_BUFFER:
+            self.buffer.append((off, bytes(data)))
+            self.size = max(self.size, off + len(data))
+            return len(data)
+        self.fs._write_through(self.path, self.inode, data, off,
+                               self.snapc)
+        self.size = max(self.size, off + len(data))
+        return len(data)
+
+    def read(self, offset: int = 0,
+             length: Optional[int] = None) -> bytes:
+        base = self.fs._read_data(self.inode, offset, length, self.size)
+        if not self.buffer:
+            return base
+        # overlay buffered extents (our own dirty data is visible to us)
+        end = offset + len(base)
+        buf = bytearray(base)
+        for boff, bdata in self.buffer:
+            lo = max(offset, boff)
+            hi = min(end, boff + len(bdata))
+            if lo < hi:
+                buf[lo - offset:hi - offset] = \
+                    bdata[lo - boff:hi - boff]
+        return bytes(buf)
+
+    def flush(self) -> None:
+        """Write back buffered extents + wrstat SYNCHRONOUSLY (the
+        voluntary fsync path; revoke-driven flushes instead ride the
+        MClientCaps round in RemoteCephFS.process)."""
+        if self.buffer:
+            for off, data in self.buffer:
+                self.fs._write_data(self.inode, data, off, self.snapc)
+            self.buffer = []
+        self.fs._request("wrstat", path=self.path, size=self.size,
+                         mtime=time.time())
+
+    def close(self) -> None:
+        self.flush()
+        self.fs._request("release", ino=self.inode["ino"])
+        self.fs._handles.pop(self.inode["ino"], None)
+
+
+class RemoteCephFS:
+    """Client-side mount over an MDS session."""
+
+    def __init__(self, client: RadosClient, mds_name: str = "mds.0",
+                 metadata_pool: str = "fsmeta",
+                 data_pool: str = "fsdata", drive=None):
+        self.client = client
+        self.mds = mds_name
+        self.mdpool = metadata_pool
+        self.dpool = data_pool
+        self._tid = 0
+        self._replies: Dict[int, MClientReply] = {}
+        self._handles: Dict[int, FileHandle] = {}
+        # revokes arrive inside a network pump, where the flush's own
+        # rados round trips cannot run (nested pumps no-op); they are
+        # queued and drained by process() — from our request loops, or
+        # the in-process scheduler
+        self._pending_revokes: List[MClientCaps] = []
+        # cooperative scheduler hook: in-process harnesses pass a
+        # callable that runs the MDS (and peers) so a blocked request
+        # can make progress; separate-process setups leave it None
+        self._drive = drive
+        # interpose on the rados client's dispatcher slot: MDS traffic
+        # is consumed here, everything else forwards to the client
+        # (the messenger holds ONE dispatcher, not a chain)
+        self._inner = client
+        client.messenger.add_dispatcher_head(self)
+
+    # ---- wire --------------------------------------------------------------
+    def ms_fast_dispatch(self, msg: Message) -> None:
+        if isinstance(msg, MClientReply):
+            self._replies[msg.tid] = msg
+            return
+        if isinstance(msg, MClientCaps):
+            if msg.op == MClientCaps.OP_REVOKE:
+                self._pending_revokes.append(msg)
+            return
+        self._inner.ms_fast_dispatch(msg)
+
+    def ms_dispatch(self, msg: Message) -> None:  # pragma: no cover
+        self.ms_fast_dispatch(msg)
+
+    def process(self) -> None:
+        """Service pending cap revokes: write back buffered data, then
+        ack with the wrstat payload (the Locker flush round)."""
+        while self._pending_revokes:
+            msg = self._pending_revokes.pop(0)
+            fh = self._handles.pop(msg.ino, None)
+            if fh is not None:
+                if fh.buffer:
+                    for off, data in fh.buffer:
+                        self._write_data(fh.inode, data, off, fh.snapc)
+                    fh.buffer = []
+                fh.caps = 0
+                self._send_flush(fh)
+            else:
+                self.client.messenger.send_message(MClientCaps(
+                    op=MClientCaps.OP_FLUSH, ino=msg.ino,
+                    seq=msg.seq), self.mds)
+
+    def _send_flush(self, fh: FileHandle) -> None:
+        self.client.messenger.send_message(MClientCaps(
+            op=MClientCaps.OP_FLUSH, ino=fh.inode["ino"],
+            data={"path": fh.path, "size": fh.size,
+                  "mtime": time.time()}), self.mds)
+
+    def _request(self, op: str, **args):
+        self.process()          # our own pending flushes go first
+        self._tid += 1
+        tid = self._tid
+        self.client.messenger.send_message(MClientRequest(
+            tid=tid, op=op, args=args), self.mds)
+        import time as _time
+        for attempt in range(MAX_ATTEMPTS):
+            self.client.network.pump()
+            self.process()
+            if self._drive is not None:
+                self._drive()
+                self.client.network.pump()
+            rep = self._replies.pop(tid, None)
+            if rep is not None:
+                if rep.result < 0:
+                    raise FsError(op, rep.result)
+                return rep.data
+            if self._drive is None and attempt > 2:
+                _time.sleep(0.25)   # cross-process: let the mds run
+        raise FsError(op, -110)                       # ETIMEDOUT
+
+    # ---- metadata surface (all via the MDS) --------------------------------
+    def mkdir(self, path: str) -> int:
+        return self._request("mkdir", path=path)["ino"]
+
+    def create(self, path: str, order: int = DEFAULT_ORDER) -> int:
+        return self._request("create", path=path, order=order)["ino"]
+
+    def symlink(self, path: str, target: str) -> int:
+        return self._request("symlink", path=path, target=target)["ino"]
+
+    def readlink(self, path: str) -> str:
+        return self._request("readlink", path=path)["target"]
+
+    def hardlink(self, existing: str, newpath: str) -> None:
+        self._request("hardlink", existing=existing, newpath=newpath)
+
+    def unlink(self, path: str) -> None:
+        self._request("unlink", path=path)
+
+    def rmdir(self, path: str) -> None:
+        self._request("rmdir", path=path)
+
+    def rename(self, src: str, dst: str) -> None:
+        self._request("rename", src=src, dst=dst)
+
+    def setattr(self, path: str, **attrs) -> None:
+        self._request("setattr", path=path, **attrs)
+
+    def chmod(self, path: str, mode: int) -> None:
+        self.setattr(path, mode=mode)
+
+    def stat(self, path: str) -> Dict:
+        return self._request("stat", path=path)["inode"]
+
+    def listdir(self, path: str) -> Dict[str, Dict]:
+        return self._request("listdir", path=path)["entries"]
+
+    def exists(self, path: str) -> bool:
+        return self._request("exists", path=path)["exists"]
+
+    def truncate(self, path: str, size: int) -> None:
+        self._request("truncate", path=path, size=size)
+
+    # ---- caps + file io ----------------------------------------------------
+    def open(self, path: str, mode: str = "r") -> FileHandle:
+        """'r' wants CACHE, 'w' wants BUFFER (+creates).  The MDS
+        serializes conflicting opens by revoking first — this call
+        blocks (retrying) until the caps are granted."""
+        want = CEPH_CAP_FILE_BUFFER if "w" in mode else \
+            CEPH_CAP_FILE_CACHE
+        out = self._request("open", path=path, want=want,
+                            create="w" in mode)
+        fh = FileHandle(self, path, out["inode"], out["caps"],
+                        (out["snapc_seq"], out["snapc_snaps"]))
+        self._handles[out["inode"]["ino"]] = fh
+        return fh
+
+    def write(self, path: str, data: bytes, offset: int = 0) -> int:
+        """Write-through convenience: open-for-write (serializing with
+        any buffered writer elsewhere), write the data objects, then
+        wrstat through the MDS."""
+        fh = self.open(path, "w")
+        try:
+            self._write_data(fh.inode, data, offset, fh.snapc)
+            fh.size = max(fh.size, offset + len(data))
+            fh.close()
+        finally:
+            self._handles.pop(fh.inode["ino"], None)
+        return len(data)
+
+    def read(self, path: str, offset: int = 0,
+             length: Optional[int] = None) -> bytes:
+        """Read-through: opening for read first forces any conflicting
+        buffered writer to flush (the caps round)."""
+        fh = self.open(path, "r")
+        try:
+            inode = self._request("stat", path=path)["inode"]
+            return self._read_data(inode, offset, length,
+                                   inode["size"])
+        finally:
+            self._request("release", ino=fh.inode["ino"])
+            self._handles.pop(fh.inode["ino"], None)
+
+    # ---- data plumbing (direct to OSDs) ------------------------------------
+    def _write_data(self, inode: Dict, data: bytes, offset: int,
+                    snapc: Tuple[int, List[int]]) -> None:
+        """Object writes with the file's realm SnapContext installed
+        (per-file snapc is what makes per-directory snapshots work)."""
+        seq, snaps = snapc
+        self.client.set_write_ctx(self.dpool, seq, snaps)
+        try:
+            osize = 1 << inode.get("order", DEFAULT_ORDER)
+            pos = 0
+            while pos < len(data):
+                objno, ooff = divmod(offset + pos, osize)
+                take = min(len(data) - pos, osize - ooff)
+                r = self.client.write(self.dpool,
+                                      file_oid(inode["ino"], objno),
+                                      data[pos:pos + take], ooff)
+                if r < 0:
+                    raise FsError("write", r)
+                pos += take
+        finally:
+            self.client.set_write_ctx(self.dpool, 0, [])
+
+    def _write_through(self, path: str, inode: Dict, data: bytes,
+                       offset: int,
+                       snapc: Tuple[int, List[int]]) -> None:
+        self._write_data(inode, data, offset, snapc)
+        self._request("wrstat", path=path, size=offset + len(data),
+                      mtime=time.time())
+
+    def _read_data(self, inode: Dict, offset: int,
+                   length: Optional[int], logical_size: int,
+                   snap: Optional[int] = None) -> bytes:
+        if offset >= logical_size:
+            return b""
+        length = logical_size - offset if length is None else \
+            min(length, logical_size - offset)
+        osize = 1 << inode.get("order", DEFAULT_ORDER)
+        chunks = []
+        remaining, pos = length, offset
+        while remaining > 0:
+            objno, ooff = divmod(pos, osize)
+            take = min(remaining, osize - ooff)
+            try:
+                data = self.client.read(self.dpool,
+                                        file_oid(inode["ino"], objno),
+                                        offset=ooff, length=take,
+                                        snap=snap)
+            except IOError as e:
+                if not _absent(e):
+                    raise
+                data = b""
+            chunks.append(data.ljust(take, b"\x00"))
+            pos += take
+            remaining -= take
+        return b"".join(chunks)
+
+    # ---- per-directory snapshots (SnapRealm surface) -----------------------
+    def snap_create(self, path: str, name: str) -> Dict:
+        """mkdir <path>/.snap/<name>: snapshot ONLY that subtree."""
+        return self._request("snap_create", path=path, name=name,
+                             stamp=time.time())
+
+    def snap_remove(self, path: str, name: str) -> Dict:
+        return self._request("snap_remove", path=path, name=name)
+
+    def snap_list(self, path: str) -> Dict[str, Dict]:
+        return self._request("lssnap", path=path)["snaps"]
+
+    def snapshot(self, path: str, name: str) -> "SubtreeSnapView":
+        out = self._request("lssnap", path=path)
+        snaps = out["snaps"]
+        if name not in snaps:
+            raise FsError("snapshot", -2)
+        return SubtreeSnapView(self.client, self.mdpool, self.dpool,
+                               out["ino"], snaps[name]["md"],
+                               snaps[name]["data"])
+
+
+class SubtreeSnapView:
+    """Read-only view of one realm's subtree as of a snapshot (cd
+    <dir>/.snap/<name>): metadata resolves at the md snap, file data
+    at the data snap — all against immutable clones, no MDS needed."""
+
+    def __init__(self, client: RadosClient, mdpool: str, dpool: str,
+                 root_ino: int, md_snap: int, data_snap: int):
+        self._fs = CephFS.__new__(CephFS)
+        self._fs.client = client
+        self._fs.mdpool = mdpool
+        self._fs.dpool = dpool
+        self._fs._md_snap = md_snap
+        self._fs._data_snap = data_snap
+        self.root_ino = root_ino
+
+    def _resolve(self, path: str) -> Dict:
+        inode = {"ino": self.root_ino, "type": "dir", "size": 0}
+        for name in CephFS._split(path):
+            if inode["type"] != "dir":
+                raise FsError("resolve", -20)
+            inode = self._fs._lookup(inode["ino"], name)
+            if inode.get("type") == "remote":
+                _, _, inode = self._fs._primary_of(0, "", inode)
+        return inode
+
+    def listdir(self, path: str = "/") -> Dict[str, Dict]:
+        inode = self._resolve(path)
+        if inode["type"] != "dir":
+            raise FsError("listdir", -20)
+        return json.loads(self._fs._call(dir_oid(inode["ino"]),
+                                         "readdir"))
+
+    def stat(self, path: str) -> Dict:
+        return self._resolve(path)
+
+    def exists(self, path: str) -> bool:
+        try:
+            self._resolve(path)
+            return True
+        except FsError:
+            return False
+
+    def read(self, path: str, offset: int = 0,
+             length: Optional[int] = None) -> bytes:
+        inode = self._resolve(path)
+        if inode["type"] != "file":
+            raise FsError("read", -21)
+        size = inode["size"]
+        if offset >= size:
+            return b""
+        length = size - offset if length is None else \
+            min(length, size - offset)
+        osize = 1 << inode.get("order", DEFAULT_ORDER)
+        chunks = []
+        remaining, pos = length, offset
+        while remaining > 0:
+            objno, ooff = divmod(pos, osize)
+            take = min(remaining, osize - ooff)
+            try:
+                data = self._fs.client.read(
+                    self._fs.dpool, file_oid(inode["ino"], objno),
+                    offset=ooff, length=take,
+                    snap=self._fs._data_snap)
+            except IOError as e:
+                if not _absent(e):
+                    raise
+                data = b""
+            chunks.append(data.ljust(take, b"\x00"))
+            pos += take
+            remaining -= take
+        return b"".join(chunks)

@@ -1,0 +1,479 @@
+"""MDS-lite — the metadata server daemon for cephfs.
+
+The reference's cephfs is MDS-mediated (src/mds/MDSDaemon.cc): clients
+hold CAPABILITIES issued by the Locker (src/mds/Locker.cc) that gate
+caching and write-back, every metadata mutation funnels through the MDS
+(which persists dentries to the metadata pool and write-ahead-logs each
+op in the MDS journal, src/mds/MDLog.cc), and snapshots attach to any
+directory via the SnapRealm hierarchy (src/mds/SnapRealm.h).  This is
+that architecture at lite scale:
+
+- ONE metadata authority: the daemon owns a CephFS backend (the
+  cls_fs-based engine) as the sole metadata-pool writer; clients speak
+  MClientRequest/MClientReply and never touch metadata objects.
+- Locker-lite capabilities: CEPH_CAP_FILE_BUFFER (exclusive write-back)
+  conflicts with everything; CEPH_CAP_FILE_CACHE (shared read-cache)
+  conflicts with BUFFER.  Conflicting opens trigger a revoke round —
+  the holder flushes its buffered data to the DATA pool directly, then
+  sends MClientCaps(flush) carrying the wrstat payload; the blocked
+  request resumes once every revoke is acked (Locker::issue_caps +
+  file_update_finish shape).  Holders that never ack are evicted after
+  ``session_timeout`` (Session::is_stale eviction).
+- MDS journal: every mutating op is appended to a Journaler ("mdlog")
+  in the metadata pool BEFORE it is applied; a restarted daemon
+  replays uncommitted events idempotently (MDLog replay).  This also
+  makes cross-directory rename crash-safe: the two dentry updates are
+  one journaled event, and only the (single-writer) MDS applies them,
+  so no client can observe the intermediate state through the MDS.
+- SnapRealm-lite: `snap_create(path, name)` records (md_sid, data_sid)
+  in the realm table of that DIRECTORY; a file's write SnapContext is
+  the union of the data snaps on its ancestor realm chain, handed to
+  clients at open.  Files outside the subtree keep writing with a
+  snapc that excludes the new snap, so no clone of them is preserved —
+  per-directory snapshots fall out of per-file snap contexts.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..cephfs.client import CephFS, FsError
+from ..cephfs.cls_fs import ROOT_INO, dir_oid, file_oid
+from ..client.rados import RadosClient
+from ..msg.messages import (
+    CEPH_CAP_FILE_BUFFER, CEPH_CAP_FILE_CACHE, MClientCaps,
+    MClientReply, MClientRequest, Message,
+)
+
+MDLOG_ID = "mdlog"
+REALM_PREFIX = "fs_realm."
+
+
+def realm_oid(ino: int) -> str:
+    return f"{REALM_PREFIX}{ino:x}"
+
+
+def _j(obj) -> bytes:
+    return json.dumps(obj, sort_keys=True).encode()
+
+
+# ops that mutate metadata and therefore ride the MDS journal
+_JOURNALED = {"mkdir", "create", "symlink", "hardlink", "unlink",
+              "rmdir", "rename", "setattr", "wrstat", "truncate",
+              "snap_create", "snap_remove"}
+# ops answered read-only
+_READONLY = {"stat", "listdir", "readlink", "resolve", "exists",
+             "lssnap", "open", "release", "walk_snapc"}
+
+
+class MDSDaemon:
+    """The mds-lite daemon: metadata authority + Locker + MDLog +
+    SnapRealms.  Event-driven like Monitor/OSD: register on a network,
+    pump delivers requests, ``tick(now)`` drives session timeouts."""
+
+    def __init__(self, network, rados: RadosClient, name: str = "mds.0",
+                 metadata_pool: str = "fsmeta", data_pool: str = "fsdata",
+                 mkfs: bool = False, session_timeout: float = 20.0):
+        from ..journal import Journaler
+        self.network = network
+        self.name = name
+        self.messenger = network.create_messenger(name)
+        self.messenger.add_dispatcher_head(self)
+        self.rados = rados
+        # daemon mode shares ONE entity name between the MDS service
+        # and its rados client (vstart "mds.0"): we hold the dispatcher
+        # slot, so everything that isn't MDS traffic (MOSDOpReply, map
+        # pushes, command acks) must fall through to the rados client
+        self._fallthrough = rados if getattr(rados, "name", None) == \
+            name else None
+        self.mdpool = metadata_pool
+        self.dpool = data_pool
+        self.fs = CephFS(rados, metadata_pool, data_pool)
+        self.session_timeout = session_timeout
+        self.journal = Journaler(rados, metadata_pool, MDLOG_ID,
+                                 entries_per_object=128)
+        from ..journal import JournalError
+        if mkfs:
+            self.fs.mkfs()
+            try:
+                self.journal.create(order=20, splay_width=2)
+            except JournalError as e:
+                if e.result != -17:
+                    raise
+                self.journal.open()   # a retried boot already made it
+            try:
+                self.journal.register_client("mds")
+            except JournalError as e:
+                if e.result != -17:
+                    raise
+        else:
+            self.journal.open()
+        # caps: ino -> {client_name: capbits}
+        self.caps: Dict[int, Dict[str, int]] = {}
+        self.cap_seq = 0
+        # outstanding revokes: ino -> {client: (seq, issued_at)};
+        # issued_at is None until the first tick() supplies a clock
+        # (deadlines from a zero clock would expire instantly)
+        self.revoking: Dict[int, Dict[str, Tuple[int,
+                                                 Optional[float]]]] = {}
+        # requests parked until an ino's revokes drain
+        self.waiting: Dict[int, List[MClientRequest]] = {}
+        self.now = 0.0
+        # dispatch only ENQUEUES: handlers do blocking rados IO, which
+        # must not run nested inside a network pump (the daemon loop —
+        # or an in-process driver — calls process())
+        self._inbox: List[Message] = []
+        self._replay()
+
+    # ---- journal (MDLog) ---------------------------------------------------
+    def _replay(self) -> None:
+        """Re-apply uncommitted journal events (MDLog replay after a
+        crash).  Events are idempotent: already-applied mutations
+        answer EEXIST/ENOENT and are treated as done."""
+        committed = -1
+        md = self.journal.get_metadata()
+        cl = md.get("clients", {}).get("mds")
+        if cl is None:
+            self.journal.register_client("mds")
+        else:
+            committed = cl["commit_tid"]
+        last = committed
+        for tid, payload in self.journal.replay(after_tid=committed):
+            ev = json.loads(payload)
+            try:
+                self._apply(ev["op"], ev["args"])
+            except FsError as e:
+                if e.result not in (-17, -2, -39):
+                    raise
+            last = tid
+        if last > committed:
+            self.journal.commit("mds", last)
+
+    def _journal_and_apply(self, op: str, args: Dict):
+        tid = self.journal.append(_j({"op": op, "args": args}))
+        out = self._apply(op, args)
+        self.journal.commit("mds", tid)
+        return out
+
+    # ---- dispatch ----------------------------------------------------------
+    def ms_fast_dispatch(self, msg: Message) -> None:
+        if isinstance(msg, (MClientRequest, MClientCaps)):
+            self._inbox.append(msg)
+        elif self._fallthrough is not None:
+            self._fallthrough.ms_fast_dispatch(msg)
+
+    def ms_dispatch(self, msg: Message) -> None:  # pragma: no cover
+        self.ms_fast_dispatch(msg)
+
+    def process(self) -> int:
+        """Drain queued client traffic (the dispatch/workqueue split:
+        handlers run OUTSIDE the network pump so their own rados round
+        trips can pump freely).  Returns messages handled."""
+        n = 0
+        while self._inbox:
+            msg = self._inbox.pop(0)
+            n += 1
+            if isinstance(msg, MClientRequest):
+                self._handle_request(msg)
+            else:
+                self._handle_caps(msg)
+        return n
+
+    def tick(self, now: float) -> None:
+        """Evict sessions that never acked a revoke (stale session
+        eviction): their caps are dropped so the fs cannot wedge on a
+        dead client; their buffered data is lost, like the reference
+        evicting a stale session."""
+        self.now = now
+        for ino, m in list(self.revoking.items()):
+            for client, (seq, issued) in list(m.items()):
+                if issued is None:
+                    # revoke predates our first clock reading: the
+                    # grace period starts NOW
+                    m[client] = (seq, now)
+                elif now - issued > self.session_timeout:
+                    del m[client]
+                    self.caps.get(ino, {}).pop(client, None)
+            if not m:
+                del self.revoking[ino]
+                self._kick(ino)
+
+    # ---- capabilities (Locker-lite) ---------------------------------------
+    def _issue(self, client: str, ino: int, want: int,
+               msg: MClientRequest) -> Optional[int]:
+        """Grant *want* caps to *client*, revoking conflicts first.
+        Returns the granted bits, or None if the request must wait for
+        a revoke round (it has been parked)."""
+        holders = self.caps.setdefault(ino, {})
+        conflicts = []
+        for other, bits in holders.items():
+            if other == client:
+                continue
+            if want & CEPH_CAP_FILE_BUFFER:
+                conflicts.append(other)          # BUFFER is exclusive
+            elif bits & CEPH_CAP_FILE_BUFFER:
+                conflicts.append(other)          # CACHE vs their BUFFER
+        pending = self.revoking.setdefault(ino, {})
+        newly = [c for c in conflicts if c not in pending]
+        for other in newly:
+            self.cap_seq += 1
+            pending[other] = (self.cap_seq,
+                              self.now if self.now else None)
+            self.messenger.send_message(MClientCaps(
+                op=MClientCaps.OP_REVOKE, ino=ino,
+                caps=holders[other], seq=self.cap_seq), other)
+        if pending:
+            self.waiting.setdefault(ino, []).append(msg)
+            return None
+        if not self.revoking.get(ino):
+            self.revoking.pop(ino, None)
+        holders[client] = holders.get(client, 0) | want
+        return holders[client]
+
+    def _handle_caps(self, msg: MClientCaps) -> None:
+        if msg.op != MClientCaps.OP_FLUSH:
+            return
+        ino = msg.ino
+        # only a CURRENT cap holder (or a revoke still outstanding)
+        # may flush: an evicted client's delayed flush must not roll
+        # metadata back under the new holder's feet
+        if msg.src not in self.caps.get(ino, {}) and \
+                msg.src not in self.revoking.get(ino, {}):
+            return
+        # the flush carries the holder's write-back results (wrstat):
+        # journal + apply them before anyone else touches the file
+        if msg.data.get("path") is not None and "size" in msg.data:
+            try:
+                self._journal_and_apply("wrstat", {
+                    "path": msg.data["path"],
+                    "size": msg.data["size"],
+                    "mtime": msg.data.get("mtime", time.time())})
+            except FsError:
+                pass             # file unlinked while caps were out
+        m = self.revoking.get(ino)
+        if m is not None:
+            m.pop(msg.src, None)
+            if not m:
+                del self.revoking[ino]
+        self.caps.get(ino, {}).pop(msg.src, None)
+        self._kick(ino)
+
+    def _kick(self, ino: int) -> None:
+        for req in self.waiting.pop(ino, []):
+            self._handle_request(req)
+
+    # ---- request handling --------------------------------------------------
+    def _reply(self, msg: MClientRequest, result: int,
+               data: Optional[Dict] = None) -> None:
+        self.messenger.send_message(MClientReply(
+            tid=msg.tid, result=result, data=data or {}), msg.src)
+
+    def _handle_request(self, msg: MClientRequest) -> None:
+        op, args = msg.op, dict(msg.args)
+        try:
+            if op == "open":
+                out = self._op_open(msg, args)
+                if out is None:
+                    return               # parked on a revoke round
+            elif op == "release":
+                ino = int(args["ino"])
+                self.caps.get(ino, {}).pop(msg.src, None)
+                out = {}
+            elif op in _JOURNALED:
+                out = self._journal_and_apply(op, args)
+            elif op in _READONLY:
+                out = self._apply(op, args)
+            else:
+                self._reply(msg, -22, {"error": f"unknown op {op!r}"})
+                return
+        except FsError as e:
+            self._reply(msg, e.result, {"error": str(e)})
+            return
+        except (KeyError, ValueError, TypeError) as e:
+            self._reply(msg, -22, {"error": repr(e)})
+            return
+        self._reply(msg, 0, out)
+
+    def _op_open(self, msg: MClientRequest,
+                 args: Dict) -> Optional[Dict]:
+        """Resolve + cap issue: the client gets the inode, its data
+        SnapContext (realm chain), and the granted caps."""
+        path = args["path"]
+        want = int(args.get("want", CEPH_CAP_FILE_CACHE))
+        create = bool(args.get("create"))
+        try:
+            dino, name, inode = self.fs._resolve_dentry(path)
+        except FsError as e:
+            if e.result != -2 or not create:
+                raise
+            self._journal_and_apply("create", {"path": path})
+            dino, name, inode = self.fs._resolve_dentry(path)
+        if inode["type"] == "dir":
+            raise FsError("open", -21)           # EISDIR
+        granted = self._issue(msg.src, inode["ino"], want, msg)
+        if granted is None:
+            return None
+        seq, snaps = self._file_snapc(path)
+        return {"inode": inode, "caps": granted,
+                "snapc_seq": seq, "snapc_snaps": snaps,
+                "path": path}
+
+    # ---- snap realms -------------------------------------------------------
+    def _realm_snaps(self, ino: int) -> Dict[str, Dict]:
+        try:
+            return json.loads(self.fs._call(realm_oid(ino), "snap_ls"))
+        except FsError as e:
+            if e.result in (-2, -116):
+                return {}
+            raise
+
+    def _ancestor_inos(self, path: str) -> List[int]:
+        """Realm chain: every directory ino from root down to the
+        file's parent (SnapRealm parent links)."""
+        out = [ROOT_INO]
+        cur = ROOT_INO
+        parts = self.fs._split(path)
+        for part in parts[:-1]:
+            inode = self.fs._lookup(cur, part)
+            if inode["type"] != "dir":
+                break
+            cur = inode["ino"]
+            out.append(cur)
+        return out
+
+    def _file_snapc(self, path: str) -> Tuple[int, List[int]]:
+        """Write SnapContext for the file at *path*: union of data
+        snaps over the ancestor realm chain (newest first, like the
+        reference's SnapContext)."""
+        snaps: Set[int] = set()
+        for ino in self._ancestor_inos(path):
+            for e in self._realm_snaps(ino).values():
+                snaps.add(int(e["data"]))
+        ordered = sorted(snaps, reverse=True)
+        return (ordered[0] if ordered else 0), ordered
+
+    def _op_snap_create(self, args: Dict) -> Dict:
+        """Per-directory snapshot (mkdir .snap/<name>): ids recorded in
+        the DIRECTORY's realm, so only its subtree is covered."""
+        path = args["path"]
+        name = args["name"]
+        inode = self.fs._resolve(path, follow_final=True)
+        if inode["type"] != "dir":
+            raise FsError("snap_create", -20)
+        md_sid = self.rados.selfmanaged_snap_create(self.mdpool)
+        data_sid = self.rados.selfmanaged_snap_create(self.dpool)
+        try:
+            self.fs._call(realm_oid(inode["ino"]), "snap_add",
+                          {"name": name, "md_sid": md_sid,
+                           "data_sid": data_sid,
+                           "stamp": args.get("stamp", 0.0)})
+        except FsError:
+            self.rados.selfmanaged_snap_remove(self.mdpool, md_sid)
+            self.rados.selfmanaged_snap_remove(self.dpool, data_sid)
+            raise
+        self._install_md_snapc()
+        return {"ino": inode["ino"], "md": md_sid, "data": data_sid}
+
+    def _op_snap_remove(self, args: Dict) -> Dict:
+        inode = self.fs._resolve(args["path"], follow_final=True)
+        gone = json.loads(self.fs._call(
+            realm_oid(inode["ino"]), "snap_rm", {"name": args["name"]}))
+        self.rados.selfmanaged_snap_remove(self.mdpool, gone["md"])
+        self.rados.selfmanaged_snap_remove(self.dpool, gone["data"])
+        self._install_md_snapc()
+        return gone
+
+    def _all_realm_md_snaps(self) -> List[int]:
+        """Union of metadata snap ids over every realm.  The MDS
+        writes metadata with ALL realms' md snaps in context — cloning
+        a dentry object outside a snapshotted subtree is invisible to
+        every view (views resolve only under their realm root), while
+        per-FILE data snapc stays strictly per-realm-chain."""
+        snaps: Set[int] = set()
+        stack = ["/"]
+        inos = [ROOT_INO]
+        while stack:
+            path = stack.pop()
+            for name, inode in self.fs.listdir(path).items():
+                if inode.get("type") == "dir":
+                    inos.append(inode["ino"])
+                    stack.append(path.rstrip("/") + "/" + name)
+        for ino in inos:
+            for e in self._realm_snaps(ino).values():
+                snaps.add(int(e["md"]))
+        return sorted(snaps)
+
+    def _install_md_snapc(self) -> None:
+        md = self._all_realm_md_snaps()
+        self.rados.set_write_ctx(self.mdpool, md[-1] if md else 0, md)
+
+    def _op_walk_snapc(self, args: Dict) -> Dict:
+        seq, snaps = self._file_snapc(args["path"])
+        return {"snapc_seq": seq, "snapc_snaps": snaps}
+
+    def _op_lssnap(self, args: Dict) -> Dict:
+        inode = self.fs._resolve(args["path"], follow_final=True)
+        return {"snaps": self._realm_snaps(inode["ino"]),
+                "ino": inode["ino"]}
+
+    # ---- op table ----------------------------------------------------------
+    def _apply(self, op: str, args: Dict):
+        fs = self.fs
+        if op == "mkdir":
+            return {"ino": fs.mkdir(args["path"])}
+        if op == "create":
+            return {"ino": fs.create(args["path"],
+                                     order=int(args.get("order", 22)))}
+        if op == "symlink":
+            return {"ino": fs.symlink(args["path"], args["target"])}
+        if op == "hardlink":
+            fs.hardlink(args["existing"], args["newpath"])
+            return {}
+        if op == "unlink":
+            fs.unlink(args["path"])
+            return {}
+        if op == "rmdir":
+            fs.rmdir(args["path"])
+            return {}
+        if op == "rename":
+            fs.rename(args["src"], args["dst"])
+            return {}
+        if op == "setattr":
+            fs.setattr(args["path"],
+                       mode=args.get("mode"), uid=args.get("uid"),
+                       gid=args.get("gid"), mtime=args.get("mtime"))
+            return {}
+        if op == "truncate":
+            fs.truncate(args["path"], int(args["size"]))
+            return {}
+        if op == "wrstat":
+            # size/mtime write-back from a cap flush
+            # (Locker::file_update_finish role)
+            dino, name, inode = fs._resolve_dentry(args["path"])
+            attrs = {"size": int(args["size"])}
+            if args.get("mtime") is not None:
+                attrs["mtime"] = float(args["mtime"])
+            tgt_dino, tgt_name, _ = fs._primary_of(dino, name, inode)
+            fs._update(tgt_dino, tgt_name, **attrs)
+            return {}
+        if op == "snap_create":
+            return self._op_snap_create(args)
+        if op == "snap_remove":
+            return self._op_snap_remove(args)
+        if op == "lssnap":
+            return self._op_lssnap(args)
+        if op == "walk_snapc":
+            return self._op_walk_snapc(args)
+        if op == "stat":
+            return {"inode": fs.stat(args["path"])}
+        if op == "resolve":
+            return {"inode": fs._resolve(args["path"],
+                                         follow_final=True)}
+        if op == "exists":
+            return {"exists": fs.exists(args["path"])}
+        if op == "listdir":
+            return {"entries": fs.listdir(args["path"])}
+        if op == "readlink":
+            return {"target": fs.readlink(args["path"])}
+        raise FsError(op, -22)

@@ -472,3 +472,46 @@ class MOSDMap(Message):
     first: int = 0
     last: int = 0
     incrementals: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class MClientRequest(Message):
+    """Client -> MDS metadata operation (src/messages/MClientRequest.h
+    role): ``op`` names an MDS handler (mkdir/create/open/...), ``args``
+    its parameters.  Every metadata mutation crosses the MDS — clients
+    never write the metadata pool directly in MDS mode."""
+    tid: int = 0
+    op: str = ""
+    args: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class MClientReply(Message):
+    """MDS -> client completion (MClientReply.h): errno-style result
+    plus a JSON-ish payload (inode attrs, cap grant, snap context)."""
+    tid: int = 0
+    result: int = 0
+    data: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class MClientCaps(Message):
+    """MDS <-> client capability traffic (MClientCaps.h role).
+
+    op: 'revoke' (MDS asks the holder to drop ``caps``; the holder
+    flushes buffered data first), 'flush' (client -> MDS: buffered
+    state written back, carries the wrstat payload), 'grant' (MDS ->
+    client: caps now held).  ``seq`` orders revoke/flush rounds."""
+    OP_REVOKE = "revoke"
+    OP_FLUSH = "flush"
+    OP_GRANT = "grant"
+    op: str = ""
+    ino: int = 0
+    caps: int = 0
+    seq: int = 0
+    data: Dict[str, Any] = field(default_factory=dict)
+
+
+# cephfs capability bits (a lite slice of CEPH_CAP_*)
+CEPH_CAP_FILE_CACHE = 1     # may cache reads
+CEPH_CAP_FILE_BUFFER = 2    # may buffer writes (write-back)

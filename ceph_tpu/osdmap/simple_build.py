@@ -62,7 +62,6 @@ def insert_item(cw: CrushWrapper, item: int, weight: int, name: str,
     if not cw.name_exists(name):
         cw.set_item_name(item, name)
     cur = item
-    placed_under: Optional[int] = None
     for t, tname in CRUSH_TYPES:
         if t == 0:
             continue
@@ -80,11 +79,9 @@ def insert_item(cw: CrushWrapper, item: int, weight: int, name: str,
         if b is None or b.type != t:
             raise ValueError(f"bucket {bname!r} type mismatch")
         cw._bucket_link(bid, cur, 0)
-        placed_under = bid
         break
     else:
         raise ValueError(f"nowhere to add item {item} in {loc}")
-    del placed_under
     # adjust_item_weightf_in_loc: set the device's weight where it
     # lives and propagate the delta to every ancestor
     p = cw._parent_of(item)

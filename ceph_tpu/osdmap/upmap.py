@@ -139,7 +139,10 @@ def calc_pg_upmaps(m: OSDMap, max_deviation_ratio: float, max: int,
 
             ruleno = m.crush.find_rule(pool.crush_rule, pool.type,
                                        pool.size)
-            pmap = get_rule_weight_osd_map(m.crush, ruleno)
+            # no matching rule -> empty weight map (the reference's
+            # unsigned-index ENOENT), while total_pgs still counted
+            pmap = get_rule_weight_osd_map(m.crush, ruleno) \
+                if ruleno >= 0 else {}
             for osd in sorted(pmap):
                 # get_weightf: 16.16 in/out weight as C float
                 wf = F(F(m.osd_weight[osd]) / F(0x10000)) \

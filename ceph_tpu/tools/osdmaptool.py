@@ -20,7 +20,6 @@ from ..osdmap import (
     CEPH_OSD_IN, Incremental, OSDMap, OSDMapMapping, TYPE_REPLICATED,
     pg_pool_t, pg_t,
 )
-from ..osdmap.balancer import calc_pg_upmaps
 
 
 def createsimple(n_osds: int, pg_num: int = 128,

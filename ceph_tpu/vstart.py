@@ -181,6 +181,83 @@ def osd_main(args) -> None:
         daemon.run_recovery()
 
 
+def mds_main(args) -> None:
+    """MDS daemon: metadata authority over the wire (mds/server.py).
+    Creates the fs pools through mon wire commands on first boot; a
+    rebooted daemon finds them and REPLAYS its journal."""
+    _pin_cpu()
+    from .client.mon_client import MonClient
+    from .client.rados import RadosClient
+    from .msg.tcp import TcpNetwork
+
+    directory = json.loads(args.directory)
+    auth = None
+    if args.keyring:
+        from .msg.tcp import TcpAuth
+        auth = TcpAuth(args.name, args.keyring)
+    net = TcpNetwork(("127.0.0.1", args.port),
+                     {k: tuple(v) for k, v in directory.items()},
+                     auth=auth, entity=args.name)
+    mon_names = [m for m in (args.mon_names or "mon").split(",") if m]
+    rados = RadosClient(net, MonClient(net, mon_names[0]), args.name)
+    # wait for a map with every osd up before touching pools
+    deadline = time.monotonic() + 120.0
+    while True:
+        net.pump(quiesce=0.05, deadline=0.3)
+        rados.mon.send_full_map(args.name)
+        net.pump(quiesce=0.05, deadline=0.3)
+        m = rados.osdmap
+        if m.max_osd >= args.n_osds and \
+                all(m.is_up(o) for o in range(args.n_osds)):
+            break
+        if time.monotonic() > deadline:
+            raise RuntimeError("mds never saw a healthy map")
+        time.sleep(0.2)
+    for pool in (args.metadata_pool, args.data_pool):
+        try:
+            rados.mon_command("create_replicated_pool", name=pool,
+                              size=min(3, args.n_osds), pg_num=8)
+        except (ValueError, IOError):
+            pass                    # exists (reboot) — reuse it
+    from .cephfs.cls_fs import ROOT_INO, dir_oid
+    from .mds import MDSDaemon
+    # the fresh pools' PGs keep settling for a while after creation:
+    # wait until the metadata pool actually ANSWERS (ENOENT = servable
+    # but empty -> first boot; success = existing fs -> replay boot)
+    fresh = None
+    deadline = time.monotonic() + 120.0
+    while fresh is None:
+        try:
+            rados.stat(args.metadata_pool, dir_oid(ROOT_INO))
+            fresh = False
+        except IOError as e:
+            if getattr(e, "errno", None) == 2:
+                fresh = True        # pool serves, no fs yet
+            elif time.monotonic() > deadline:
+                raise RuntimeError("fs pools never became servable")
+            else:
+                net.pump(quiesce=0.05, deadline=0.3)
+                time.sleep(0.3)
+    mds = None
+    while mds is None:
+        try:
+            mds = MDSDaemon(net, rados, args.name,
+                            metadata_pool=args.metadata_pool,
+                            data_pool=args.data_pool, mkfs=fresh)
+        except IOError:
+            # some PG of the fresh pools still settling; mkfs/journal
+            # creation is idempotent, so just try again
+            if time.monotonic() > deadline:
+                raise
+            net.pump(quiesce=0.05, deadline=0.3)
+            time.sleep(0.5)
+    print("READY", flush=True)
+    while True:
+        net.pump(quiesce=0.02, deadline=0.3)
+        mds.process()
+        mds.tick(time.monotonic())
+
+
 # ---- harness ---------------------------------------------------------------
 
 def _free_ports(n: int) -> List[int]:
@@ -208,9 +285,11 @@ class ProcessCluster:
                  auth: bool = False,
                  data_root: Optional[str] = None,
                  n_mons: int = 1,
-                 mon_grace: float = 4.0):
+                 mon_grace: float = 4.0,
+                 n_mds: int = 0):
         self.n_osds = n_osds
         self.n_mons = n_mons
+        self.n_mds = n_mds
         self.mon_grace = mon_grace
         # single-mon clusters keep the historical name "mon"
         self.mon_names = (["mon"] if n_mons == 1
@@ -229,16 +308,19 @@ class ProcessCluster:
                 kr.create(m)
             for i in range(n_osds):
                 kr.create(f"osd.{i}")
+            for i in range(n_mds):
+                kr.create(f"mds.{i}")
             for name in client_names:
                 kr.create(name)
             self.keyring_path = os.path.join(self._tmpdir, "keyring")
             kr.save(self.keyring_path)
         self.client_names = client_names
-        ports = _free_ports(n_osds + n_mons + 1)
+        ports = _free_ports(n_osds + n_mons + n_mds + 1)
         self.mon_ports = ports[:n_mons]
         self.mon_port = self.mon_ports[0]
         self.client_port = ports[n_mons]
-        self.osd_ports = ports[n_mons + 1:]
+        self.osd_ports = ports[n_mons + 1:n_mons + 1 + n_osds]
+        self.mds_ports = ports[n_mons + 1 + n_osds:]
         directory: Dict[str, Tuple[str, int]] = {}
         for r, m in enumerate(self.mon_names):
             directory[m] = ("127.0.0.1", self.mon_ports[r])
@@ -246,6 +328,8 @@ class ProcessCluster:
             directory[name] = ("127.0.0.1", self.client_port)
         for i in range(n_osds):
             directory[f"osd.{i}"] = ("127.0.0.1", self.osd_ports[i])
+        for i in range(n_mds):
+            directory[f"mds.{i}"] = ("127.0.0.1", self.mds_ports[i])
         self.directory = directory
         dir_json = json.dumps({k: list(v) for k, v in directory.items()})
         env = dict(os.environ)
@@ -302,6 +386,12 @@ class ProcessCluster:
             self._spawn_osd(i)
         for i in range(n_osds):
             self._await_ready(f"osd.{i}")
+        for i in range(self.n_mds):
+            self._spawn_mds(i)
+        for i in range(self.n_mds):
+            # the mds waits for a healthy map + creates/opens the fs
+            # pools before READY, which can take a while
+            self._await_ready(f"mds.{i}", timeout=240.0)
         from .msg.tcp import TcpNetwork
         cl_auth = None
         if self.keyring_path:
@@ -309,6 +399,32 @@ class ProcessCluster:
             cl_auth = TcpAuth(self.client_names[0], self.keyring_path)
         self.network = TcpNetwork(("127.0.0.1", self.client_port),
                                   self.directory, auth=cl_auth)
+
+    def _spawn_mds(self, i: int) -> None:
+        a = self._osd_args
+        self.procs[f"mds.{i}"] = subprocess.Popen(
+            [sys.executable, "-m", "ceph_tpu.vstart", "mds",
+             "--name", f"mds.{i}", "--port", str(self.mds_ports[i]),
+             "--directory", a["dir_json"],
+             "--mon-names", ",".join(self.mon_names),
+             "--n-osds", str(self.n_osds),
+             *a["keyring_args"]],
+            stdout=subprocess.PIPE, text=True, cwd=REPO, env=a["env"])
+
+    def kill_mds(self, i: int = 0) -> None:
+        """kill -9 the mds daemon (the MDS failover drill)."""
+        p = self.procs[f"mds.{i}"]
+        p.send_signal(signal.SIGKILL)
+        p.wait()
+
+    def restart_mds(self, i: int = 0) -> None:
+        """Fresh mds process on the same port: it finds the existing
+        pools and REPLAYS the MDS journal."""
+        old = self.procs.get(f"mds.{i}")
+        if old is not None and old.poll() is None:
+            raise RuntimeError(f"mds.{i} is still running")
+        self._spawn_mds(i)
+        self._await_ready(f"mds.{i}", timeout=240.0)
 
     def _await_ready(self, name: str, timeout: float = 120.0) -> None:
         import select
@@ -435,9 +551,20 @@ def main(argv=None) -> None:
     po.add_argument("--data-dir", default="")
     po.add_argument("--debug", type=int,
                     default=int(os.environ.get("VSTART_DEBUG", "0")))
+    pd = sub.add_parser("mds")
+    pd.add_argument("--name", default="mds.0")
+    pd.add_argument("--port", type=int, required=True)
+    pd.add_argument("--directory", required=True)
+    pd.add_argument("--mon-names", default="mon")
+    pd.add_argument("--n-osds", type=int, required=True)
+    pd.add_argument("--metadata-pool", default="fsmeta")
+    pd.add_argument("--data-pool", default="fsdata")
+    pd.add_argument("--keyring", default="")
     args = ap.parse_args(argv)
     if args.role == "mon":
         mon_main(args)
+    elif args.role == "mds":
+        mds_main(args)
     else:
         osd_main(args)
 
