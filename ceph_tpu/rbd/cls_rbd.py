@@ -65,6 +65,10 @@ def _create(ctx: ClsContext, inp: bytes):
         kv["data_pool"] = str(req["data_pool"])
     if req.get("journaling"):
         kv["journaling"] = "1"     # RBD_FEATURE_JOURNALING
+    if req.get("exclusive_lock"):
+        kv["exclusive_lock"] = "1"  # RBD_FEATURE_EXCLUSIVE_LOCK
+    if req.get("object_map"):
+        kv["object_map"] = "1"     # RBD_FEATURE_OBJECT_MAP (fast-diff)
     ctx.omap_set(kv)
     return 0, b""
 
@@ -85,6 +89,10 @@ def _get_image(ctx: ClsContext, inp: bytes):
         out["data_pool"] = om["data_pool"].decode()
     if "journaling" in om:
         out["journaling"] = True
+    if "exclusive_lock" in om:
+        out["exclusive_lock"] = True
+    if "object_map" in om:
+        out["object_map"] = True
     return 0, _j(out)
 
 

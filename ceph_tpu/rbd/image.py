@@ -18,8 +18,11 @@ reimplements that core on the framework's own primitives:
 - flatten/resize/rollback mirror Operations.cc semantics at lite scale.
 
 The write-ahead image journal + mirroring live in ``mirror.py`` /
-``ceph_tpu.journal``.  Scope-outs vs the reference: exclusive locking,
-object-map/fast-diff feature bits, and the qemu block driver surface.
+``ceph_tpu.journal``.  The exclusive lock (auto-acquire on first write,
+cooperative surrender over the header watch, dead-owner break —
+librbd::ExclusiveLock) and the object map / fast-diff existence bitmap
+(librbd::ObjectMap) are implemented on cls_lock + watch/notify.
+Scope-outs vs the reference: the qemu block driver surface.
 """
 from __future__ import annotations
 
@@ -27,7 +30,8 @@ import json
 import uuid
 from typing import Dict, List, Optional, Tuple
 
-from ..client.rados import ObjectOperation, RadosClient
+from ..client.rados import NotifyTimeout, ObjectOperation, \
+    RadosClient
 from .cls_rbd import (
     RBD_CHILDREN, RBD_DATA_PREFIX, RBD_DIRECTORY, RBD_HEADER_PREFIX,
 )
@@ -63,7 +67,9 @@ class RBD:
 
     def create(self, pool: str, name: str, size: int,
                order: int = 22, data_pool: str = None,
-               journaling: bool = False) -> str:
+               journaling: bool = False,
+               exclusive_lock: bool = False,
+               object_map: bool = False) -> str:
         """Create an image; returns its id (librbd::RBD::create).
 
         ``data_pool`` puts the data objects in a separate — typically
@@ -82,7 +88,13 @@ class RBD:
                        {"size": size, "order": order,
                         "object_prefix": RBD_DATA_PREFIX + iid,
                         "data_pool": data_pool,
-                        "journaling": journaling})
+                        "journaling": journaling,
+                        # journaling REQUIRES the exclusive lock in the
+                        # reference (mutations must be single-writer or
+                        # the journal interleaves) — imply it
+                        "exclusive_lock": exclusive_lock or journaling
+                        or object_map,
+                        "object_map": object_map})
         except RBDError:
             self._exec(pool, RBD_DIRECTORY, "dir_remove_image",
                        {"name": name, "id": iid})
@@ -186,6 +198,45 @@ class RBD:
         return iid
 
 
+# open handles per (client, header): same-client lock transitions are
+# coordinated HERE — the OSD excludes the notifier's own watches from a
+# notify fan-out, so a sibling handle can never be reached that way
+_LOCAL_HANDLES: Dict[Tuple[int, str], object] = {}
+
+
+def _register_handle(img: "Image") -> None:
+    import weakref
+    key = (id(img.client), img._header)
+    ws = _LOCAL_HANDLES.get(key)
+    if ws is None:
+        ws = _LOCAL_HANDLES[key] = weakref.WeakSet()
+    ws.add(img)
+
+
+def _mutating(fn):
+    """Every mutating entry point runs under the exclusive lock when
+    the feature is on (librbd::ExclusiveLock auto-acquire on first
+    write), and is marked in-op so a concurrent surrender request is
+    answered 'busy' instead of letting the lock break mid-mutation."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        self._op_depth += 1
+        self._in_op = True
+        try:
+            if self._op_depth == 1:
+                self._ensure_exclusive_lock()
+            return fn(self, *a, **kw)
+        finally:
+            # unwound on EVERY exit (a failed lock acquire included):
+            # leaked depth would skip future acquisitions and answer
+            # every surrender request 'busy' forever
+            self._op_depth -= 1
+            self._in_op = self._op_depth > 0
+    return wrapper
+
+
 class Image:
     """An open image (librbd::Image): data I/O + snapshot/clone ops.
 
@@ -220,10 +271,23 @@ class Image:
         self.object_prefix = meta["object_prefix"]
         self.data_pool = meta.get("data_pool") or self.pool
         self.journaling = bool(meta.get("journaling"))
+        self.exclusive_lock_feature = bool(meta.get("exclusive_lock"))
+        self.object_map_feature = bool(meta.get("object_map"))
         self._journal = None
         self.read_snap: Optional[int] = None
         self._parent_link = self._fetch_parent()
         self._parent_handle: Optional["Image"] = None
+        # exclusive-lock state (librbd::ExclusiveLock): acquired lazily
+        # on the first mutation, surrendered cooperatively on another
+        # handle's request (the header watch round)
+        self._lock_cookie = f"auto {uuid.uuid4().hex[:8]}"
+        self._lock_owned = False
+        self._lock_surrendered = False
+        self._watch_cookie: Optional[int] = None
+        self._in_op = False
+        self._op_depth = 0
+        self._omap_cache: Optional[bytearray] = None
+        _register_handle(self)
 
     # ---- header helpers ---------------------------------------------------
     def _call(self, method: str, payload=None, parse: bool = True):
@@ -412,6 +476,7 @@ class Image:
         length = min(length, end - offset)
         return self._read_at(offset, length, self.read_snap)
 
+    @_mutating
     def write(self, offset: int, data: bytes) -> int:
         """Write-through with copy-up for clones; grows never — writes
         past the end are clipped like librbd returns -EINVAL."""
@@ -425,6 +490,8 @@ class Image:
             self._journal_event({
                 "op": "write", "offset": offset,
                 "data": base64.b64encode(data).decode()})
+        self._om_mark([objno for objno, _, _ in
+                       self._extents(offset, len(data))], self.OM_EXISTS)
         self._apply_write_ctx()
         pos = 0
         has_parent = self.parent() is not None
@@ -479,6 +546,7 @@ class Image:
             op.write(cdata, 0)
         return op
 
+    @_mutating
     def discard(self, offset: int, length: int) -> None:
         """Punch a hole (rbd_discard): whole objects are removed, edges
         are zeroed.  Inside a clone's parent overlap a hole must STAY a
@@ -502,19 +570,29 @@ class Image:
                     op = ObjectOperation().create(exclusive=False)
                     r, _ = self.client.operate(self.data_pool, oid,
                                                op.truncate(0))
+                    self._om_mark([objno], self.OM_EXISTS)
                 else:
                     r = self.client.remove(self.data_pool, oid)
+                    if r in (0, -2):
+                        self._om_mark([objno], self.OM_NONE)
             elif in_overlap and self._needs_copyup(objno):
+                self._om_mark([objno], self.OM_EXISTS)
                 op = self._copyup_op(objno).zero(off, ln)
                 r, _ = self.client.operate(self.data_pool, oid, op)
                 if r == -17:
                     r = self.client.zero(self.data_pool, oid, off, ln)
             else:
                 r = self.client.zero(self.data_pool, oid, off, ln)
+                if r == 0:
+                    # zeroing an EXISTING object changed its bytes:
+                    # fast-diff must see it dirty (CLEAN would make
+                    # export-diff skip the punched hole)
+                    self._om_mark([objno], self.OM_EXISTS)
             if r < 0 and r != -2:
                 raise RBDError("discard", r)
         self._journal_commit_applied()
 
+    @_mutating
     def resize(self, new_size: int) -> None:
         """Grow adjusts metadata only (sparse); shrink removes/truncates
         objects beyond the new end (Operations::resize)."""
@@ -541,9 +619,25 @@ class Image:
                            parse=False)
                 self._parent_link = self._fetch_parent()
         self._call("set_size", {"size": new_size}, parse=False)
+        if self.object_map_feature:
+            # shrink truncates the bitmap; grow extends with NONE
+            m = self._om_load()
+            n = self._objects_in(new_size)
+            if len(m) > n:
+                m = m[:n]
+                # a partially-truncated tail object CHANGED: dirty it
+                # or export-diff would skip it as CLEAN
+                if n and new_size % self.object_size and \
+                        m[n - 1] != self.OM_NONE:
+                    m[n - 1] = self.OM_EXISTS
+                self._om_save(m)
+            elif len(m) < n:
+                m.extend(b"\x00" * (n - len(m)))
+                self._om_save(m)
         self._journal_commit_applied()
 
     # ---- snapshots --------------------------------------------------------
+    @_mutating
     def snap_create(self, name: str) -> int:
         if self.journaling:
             self._journal_event({"op": "snap_create", "name": name})
@@ -551,15 +645,37 @@ class Image:
         self._call("snapshot_add",
                    {"snapid": sid, "name": name, "size": self.size()},
                    parse=False)
+        if self.object_map_feature:
+            # freeze the bitmap as the snap's map, then mark every
+            # existing head object CLEAN: fast-diff reads 'dirty since
+            # the latest snap' straight off the head map
+            m = self._om_load()
+            self._om_save(bytearray(m), snapid=sid)
+            self._om_save(bytearray(
+                self.OM_CLEAN if b != self.OM_NONE else self.OM_NONE
+                for b in m))
         self._journal_commit_applied()
         return sid
 
+    @_mutating
     def snap_remove(self, name: str) -> None:
         sid, info = self._snap_by_name(name)
         if self.journaling:
             self._journal_event({"op": "snap_remove", "name": name})
+        was_latest = sid == max(self._snapcontext()[1], default=sid)
         self._call("snapshot_remove", {"snapid": sid}, parse=False)
         self.client.selfmanaged_snap_remove(self.data_pool, sid)
+        if self.object_map_feature:
+            self.client.remove(self.pool, self._om_oid(sid))
+            if was_latest:
+                # CLEAN meant 'unchanged since sid'; with sid gone the
+                # reference point is an OLDER snap we did not track
+                # against — over-claim dirtiness (safe) rather than
+                # let export-diff skip changed objects
+                m = self._om_load()
+                self._om_save(bytearray(
+                    self.OM_EXISTS if b != self.OM_NONE else
+                    self.OM_NONE for b in m))
         self._journal_commit_applied()
 
     def snap_list(self) -> Dict[str, Dict]:
@@ -580,6 +696,7 @@ class Image:
             raise RBDError("snap unprotect", -16)     # EBUSY
         self._call("snapshot_unprotect", {"snapid": sid}, parse=False)
 
+    @_mutating
     def snap_rollback(self, name: str) -> None:
         """Restore the head to the snapshot's content (Operations::
         snap_rollback): resize to the snap size, then per-object restore
@@ -620,9 +737,11 @@ class Image:
                         raise RBDError("snap rollback", r)
         finally:
             self.journaling = was
+        self.rebuild_object_map()
         self._journal_commit_applied()
 
     # ---- clone management -------------------------------------------------
+    @_mutating
     def flatten(self) -> None:
         """Copy every parent-backed object into the child, then sever
         the parent link (Operations::flatten)."""
@@ -641,6 +760,7 @@ class Image:
                     self._copyup_op(objno))
                 if r < 0 and r != -17:
                     raise RBDError("flatten", r)
+                self._om_mark([objno], self.OM_EXISTS)
         self._call("remove_parent", parse=False)
         self._parent_link = None
         self._parent_handle = None
@@ -666,9 +786,23 @@ class Image:
         src_to = (Image(self.client, self.pool, self.name,
                         snapshot=to_snap) if to_snap else self)
         records: List = [("s", src_to.size())]
+        # fast-diff (librbd::ObjectMap): when diffing HEAD against the
+        # LATEST snapshot, the head bitmap already says which objects
+        # changed since it — CLEAN objects are skipped unread
+        skip_clean = None
+        if (self.object_map_feature and from_snap and to_snap is None
+                and self.read_snap is None):
+            snaps = self._snapcontext()[1]
+            latest = max(snaps) if snaps else None
+            if latest is not None and \
+                    snaps[latest]["name"] == from_snap:
+                skip_clean = self._om_load()
         # extents beyond the target size need no records: import_diff's
         # leading resize truncates them
         for objno in range(self._objects_in(src_to.size())):
+            if skip_clean is not None and objno < len(skip_clean) \
+                    and skip_clean[objno] == self.OM_CLEAN:
+                continue
             off = objno * self.object_size
             ln = min(self.object_size, src_to.size() - off)
             new = src_to.read(off, ln) if ln > 0 else b""
@@ -722,14 +856,188 @@ class Image:
         return self.client.list_lockers(self.pool, self._header,
                                         self.RBD_LOCK_NAME)["lockers"]
 
+    # ---- exclusive lock (librbd::ExclusiveLock) -----------------------
+    def _watch_cb(self, _notify_id, payload) -> bytes:
+        """Header watch callback — runs INSIDE a network pump, so it
+        must not issue rados ops.  A lock request is answered by
+        surrendering the lock state locally and letting the REQUESTER
+        break the now-promised lock (the cooperative transition of
+        ExclusiveLock::handle_peer_notification); 'busy' defers while
+        a mutation is mid-flight."""
+        try:
+            req = json.loads(payload)
+        except Exception:
+            return b""
+        if req.get("op") == "request_lock":
+            if self._in_op:
+                return b"busy"
+            if self._lock_owned:
+                self._lock_owned = False
+                self._lock_surrendered = True
+                # the next owner will mutate the object map: our
+                # cached copy is stale the moment we surrender
+                self._omap_cache = None
+            return b"released"
+        return b""
+
+    def _ensure_exclusive_lock(self) -> None:
+        """Auto-acquire on first mutation (ExclusiveLock.cc): try the
+        cls lock; if another handle owns it, request a cooperative
+        surrender over the header watch, breaking the lock once the
+        owner promised (acked 'released') or proved dead (silent past
+        the notify timeout)."""
+        if not self.exclusive_lock_feature or self.read_snap is not None:
+            return
+        if self._lock_owned:
+            return
+        for attempt in range(30):
+            r = self.lock_exclusive(self._lock_cookie)
+            if r == -16:
+                # a sibling handle on THIS client?  notify cannot reach
+                # it (the OSD excludes the notifier's own watches), so
+                # run the surrender round locally
+                handled = False
+                for lk in self.list_lockers():
+                    if lk["entity"] != self.client.name:
+                        continue
+                    handled = True
+                    import weakref
+                    peers = _LOCAL_HANDLES.get(
+                        (id(self.client), self._header),
+                        weakref.WeakSet())
+                    owner = next((img for img in peers
+                                  if img is not self and
+                                  img._lock_cookie == lk["cookie"]), None)
+                    if owner is None or owner._watch_cb(
+                            0, _j({"op": "request_lock"})) == b"released":
+                        self.break_lock(lk["entity"], lk["cookie"])
+                    # else: mid-op -> retry the round
+                if handled:
+                    continue
+            if r == 0:
+                self._lock_owned = True
+                self._lock_surrendered = False
+                if self._watch_cookie is None:
+                    self._watch_cookie = self.client.watch(
+                        self.pool, self._header, self._watch_cb)
+                # another owner may have advanced the journal and the
+                # object map while we were away: drop cached state so
+                # the next use re-reads (a stale journal position
+                # would reuse tids — the corruption this lock exists
+                # to prevent)
+                self._journal = None
+                self._omap_cache = None
+                return
+            try:
+                replies = self.client.notify(
+                    self.pool, self._header,
+                    _j({"op": "request_lock"}), timeout=5)
+                # an EMPTY reply set means nobody is watching the
+                # header: the owner's client is gone (the OSD pruned
+                # its dead watch) — safe to break
+                promised = (not replies) or any(
+                    v == b"released" for v in replies.values())
+            except NotifyTimeout as e:
+                # the owner's client is dead (its watch never acked):
+                # safe to break (ExclusiveLock's blacklist-and-break,
+                # minus the blacklist)
+                promised = True
+                del e
+            if promised:
+                for lk in self.list_lockers():
+                    self.break_lock(lk["entity"], lk["cookie"])
+            # else: owner answered 'busy' mid-op — retry the round
+        raise RBDError("exclusive lock", -110)
+
+    # ---- object map (librbd::ObjectMap; fast-diff substrate) ----------
+    OM_NONE = 0          # OBJECT_NONEXISTENT
+    OM_EXISTS = 1        # OBJECT_EXISTS (dirty since the last snap)
+    OM_CLEAN = 3         # OBJECT_EXISTS_CLEAN (unchanged since it)
+
+    def _om_oid(self, snapid: Optional[int] = None) -> str:
+        base = f"rbd_object_map.{self.id}"
+        return f"{base}.{snapid}" if snapid is not None else base
+
+    def _om_load(self, snapid: Optional[int] = None) -> bytearray:
+        if snapid is None and self._omap_cache is not None:
+            return self._omap_cache
+        try:
+            data = self.client.read(self.pool, self._om_oid(snapid))
+        except IOError as e:
+            if not _absent(e):
+                raise
+            data = b""
+        m = bytearray(data)
+        if snapid is None:
+            n = self._objects_in(self.size())
+            if len(m) < n:
+                m.extend(b"\x00" * (n - len(m)))
+            self._omap_cache = m
+        return m
+
+    def _om_save(self, m: bytearray,
+                 snapid: Optional[int] = None) -> None:
+        self.client.write_full(self.pool, self._om_oid(snapid),
+                               bytes(m))
+        if snapid is None:
+            self._omap_cache = m
+
+    def _om_mark(self, objnos, state: int) -> None:
+        """Update-before-write discipline: existence flips are
+        persisted BEFORE the data mutation they describe, so a crash
+        can only ever leave the map OVER-claiming (safe: fast-diff
+        then includes an unchanged object, never misses a changed
+        one)."""
+        if not self.object_map_feature or self.read_snap is not None:
+            return
+        m = self._om_load()
+        changed = False
+        for o in objnos:
+            if o >= len(m):
+                m.extend(b"\x00" * (o + 1 - len(m)))
+            if m[o] != state:
+                m[o] = state
+                changed = True
+        if changed:
+            self._om_save(m)
+
+    def rebuild_object_map(self) -> None:
+        """rbd object-map rebuild: re-derive the bitmap from reality."""
+        if not self.object_map_feature:
+            return
+        n = self._objects_in(self.size())
+        m = bytearray(n)
+        for objno in range(n):
+            try:
+                self.client.stat(self.data_pool, self._obj(objno))
+                m[objno] = self.OM_EXISTS
+            except IOError as e:
+                if not _absent(e):
+                    raise
+        self._om_save(m)
+
+    def object_map(self, snap_name: Optional[str] = None) -> bytes:
+        """The existence bitmap (one byte per object)."""
+        sid = self._snap_by_name(snap_name)[0] if snap_name else None
+        return bytes(self._om_load(sid))
+
     def du(self) -> Dict:
-        """Provisioned vs used bytes (rbd du), at OBJECT granularity
-        like the reference's fast-diff accounting: each existing data
-        object contributes its logical size, wholly absent objects
-        cost nothing (in-object holes still count)."""
+        """Provisioned vs used bytes (rbd du).  With the object-map
+        feature this is O(map): existing objects contribute their full
+        object span (the reference's fast-diff accounting); without
+        it, each object is stat'ed."""
         provisioned = self.size()
+        nobj = self._objects_in(provisioned)
+        if self.object_map_feature:
+            m = self._om_load(self.read_snap)
+            used = 0
+            for objno in range(min(nobj, len(m))):
+                if m[objno] != self.OM_NONE:
+                    used += min(self.object_size,
+                                provisioned - objno * self.object_size)
+            return {"provisioned": provisioned, "used": used}
         used = 0
-        for objno in range(self._objects_in(provisioned)):
+        for objno in range(nobj):
             try:
                 used += self.client.stat(self.data_pool,
                                          self._obj(objno),
