@@ -869,7 +869,10 @@ class Image:
         except Exception:
             return b""
         if req.get("op") == "request_lock":
-            if self._in_op:
+            if self._in_op and self._lock_owned:
+                # only the OWNER mid-mutation defers; a fellow WAITER
+                # being in-op must not veto breaking a dead owner's
+                # lock (two waiters would deadlock each other)
                 return b"busy"
             if self._lock_owned:
                 self._lock_owned = False
@@ -948,6 +951,31 @@ class Image:
                     self.break_lock(lk["entity"], lk["cookie"])
             # else: owner answered 'busy' mid-op — retry the round
         raise RBDError("exclusive lock", -110)
+
+    def close(self) -> None:
+        """Release the exclusive lock and the header watch (the
+        ImageCtx close path).  Handles that acquired the lock pin
+        themselves through the client's watch table until closed —
+        long-lived clients should close handles they drop."""
+        if self._lock_owned:
+            try:
+                self.unlock(self._lock_cookie)
+            except Exception:
+                pass
+            self._lock_owned = False
+        if self._watch_cookie is not None:
+            try:
+                self.client.unwatch(self.pool, self._header,
+                                    self._watch_cookie)
+            except Exception:
+                pass
+            self._watch_cookie = None
+
+    def __enter__(self) -> "Image":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # ---- object map (librbd::ObjectMap; fast-diff substrate) ----------
     OM_NONE = 0          # OBJECT_NONEXISTENT

@@ -30,6 +30,9 @@ def run(cluster, client, argv, meta_pool: str = "rgwmeta",
     s.add_argument("--uid", default=None)
     s = sub.add_parser("gc")
     s.add_argument("verb", choices=["list", "process"])
+    s = sub.add_parser("lc")
+    s.add_argument("verb", choices=["list", "process"])
+    s.add_argument("--bucket", default=None)
     args = ap.parse_args(argv)
 
     g = RGWLite(client, args.meta_pool, args.data_pool)
@@ -59,6 +62,13 @@ def _dispatch(g, client, args, out) -> int:
     elif args.cmd == "gc":
         report = g.gc(repair=(args.verb == "process"))
         json.dump(report, out, indent=2, sort_keys=True)
+        print(file=out)
+    elif args.cmd == "lc":
+        if args.verb == "list":
+            json.dump(g.get_bucket_lifecycle(args.bucket), out,
+                      indent=2, sort_keys=True)
+        else:
+            json.dump(g.lc_process(), out, indent=2, sort_keys=True)
         print(file=out)
     elif args.cmd == "bucket":
         if args.verb == "list":

@@ -1,0 +1,178 @@
+"""rgw versioning + lifecycle + ACLs (rgw_rados versioned ops,
+rgw_lc.cc, rgw_acl_s3.cc at lite scale)."""
+import time
+
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.rgw import RGWLite
+from ceph_tpu.rgw.gateway import RGWError
+
+
+@pytest.fixture()
+def rgw():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("rgw.meta", size=3, pg_num=8)
+    c.create_replicated_pool("rgw.data", size=3, pg_num=8)
+    g = RGWLite(c.client("client.rgw"), "rgw.meta", "rgw.data")
+    g.create_user("alice")
+    g.create_user("bob")
+    g.create_bucket("alice", "b")
+    return c, g
+
+
+def test_versioning_suite(rgw):
+    """The S3 versioning behavior matrix: PUTx2 / GET?versionId /
+    DELETE marker / restore."""
+    c, g = rgw
+    g.put_bucket_versioning("b", "enabled")
+    assert g.get_bucket_versioning("b") == "enabled"
+    v1 = g.put_object("b", "k", b"version-one")
+    v2 = g.put_object("b", "k", b"version-two")
+    assert v1["vid"] != v2["vid"]
+    # current GET = newest; explicit versionId reaches both
+    assert g.get_object("b", "k") == b"version-two"
+    assert g.get_object("b", "k", version_id=v1["vid"]) == b"version-one"
+    assert g.get_object("b", "k", version_id=v2["vid"]) == b"version-two"
+    vers = [v for v in g.list_object_versions("b") if v["key"] == "k"]
+    assert [v["version_id"] for v in vers] == [v2["vid"], v1["vid"]]
+    assert vers[0]["is_latest"] and not vers[1]["is_latest"]
+    # DELETE without versionId pushes a marker: key vanishes from GET
+    # and ListObjects, data stays
+    d = g.delete_object("b", "k")
+    assert d["delete_marker"]
+    with pytest.raises(RGWError):
+        g.get_object("b", "k")
+    assert all(e["name"] != "k"
+               for e in g.list_objects("b")["contents"])
+    assert g.get_object("b", "k", version_id=v1["vid"]) == b"version-one"
+    # deleting the MARKER restores the previous current (undelete)
+    g.delete_object("b", "k", version_id=d["version_id"])
+    assert g.get_object("b", "k") == b"version-two"
+    # permanently deleting the newest exposes its predecessor
+    g.delete_object("b", "k", version_id=v2["vid"])
+    assert g.get_object("b", "k") == b"version-one"
+
+
+def test_preversioning_objects_become_null_version(rgw):
+    c, g = rgw
+    g.put_object("b", "old", b"before-versioning")
+    g.put_bucket_versioning("b", "enabled")
+    v2 = g.put_object("b", "old", b"after-versioning")
+    assert g.get_object("b", "old") == b"after-versioning"
+    assert g.get_object("b", "old",
+                        version_id="null") == b"before-versioning"
+    vers = [v for v in g.list_object_versions("b")
+            if v["key"] == "old"]
+    assert [v["version_id"] for v in vers] == [v2["vid"], "null"]
+
+
+def test_suspended_versioning_overwrites_null(rgw):
+    c, g = rgw
+    g.put_bucket_versioning("b", "enabled")
+    v1 = g.put_object("b", "k", b"kept")
+    g.put_bucket_versioning("b", "suspended")
+    g.put_object("b", "k", b"null-1")
+    g.put_object("b", "k", b"null-2")        # overwrites the null slot
+    vers = [v for v in g.list_object_versions("b") if v["key"] == "k"]
+    assert [v["version_id"] for v in vers] == ["null", v1["vid"]]
+    assert g.get_object("b", "k") == b"null-2"
+    assert g.get_object("b", "k", version_id=v1["vid"]) == b"kept"
+
+
+def test_lifecycle_expiration(rgw):
+    c, g = rgw
+    now = time.time()
+    g.put_object("b", "logs/old", b"ancient")
+    g.put_object("b", "logs/new", b"fresh")
+    g.put_object("b", "keep/x", b"outside prefix")
+    g.put_bucket_lifecycle("b", [{"id": "r1", "prefix": "logs/",
+                                  "status": "Enabled",
+                                  "expiration_days": 7}])
+    # nothing is old enough yet
+    rep = g.lc_process(now=now + 86400)
+    assert rep["b"]["expired"] == 0
+    # 8 "days" later the old prefix objects expire; others survive
+    rep = g.lc_process(now=now + 8 * 86400)
+    assert rep["b"]["expired"] == 2
+    with pytest.raises(RGWError):
+        g.get_object("b", "logs/old")
+    assert g.get_object("b", "keep/x") == b"outside prefix"
+
+
+def test_lifecycle_noncurrent_expiration_versioned(rgw):
+    c, g = rgw
+    now = time.time()
+    g.put_bucket_versioning("b", "enabled")
+    v1 = g.put_object("b", "k", b"v1")
+    v2 = g.put_object("b", "k", b"v2")
+    g.put_bucket_lifecycle("b", [{"id": "nc", "prefix": "",
+                                  "status": "Enabled",
+                                  "noncurrent_days": 3}])
+    rep = g.lc_process(now=now + 4 * 86400)
+    assert rep["b"]["noncurrent_removed"] == 1
+    vers = [v for v in g.list_object_versions("b") if v["key"] == "k"]
+    assert [v["version_id"] for v in vers] == [v2["vid"]]
+    assert g.get_object("b", "k") == b"v2"
+
+
+def test_acl_cross_user_matrix(rgw):
+    """Owner / grantee / everyone / authenticated across read+write."""
+    c, g = rgw
+    g.put_object("b", "o", b"secret", actor="alice")
+    # default private: bob denied read and write
+    with pytest.raises(RGWError):
+        g.get_object("b", "o", actor="bob")
+    with pytest.raises(RGWError):
+        g.put_object("b", "x", b"nope", actor="bob")
+    # owner always passes
+    assert g.get_object("b", "o", actor="alice") == b"secret"
+    # explicit READ grant to bob on the OBJECT
+    g.put_object_acl("b", "o", grants=[{"grantee": "bob",
+                                        "permission": "READ"}],
+                     actor="alice")
+    assert g.get_object("b", "o", actor="bob") == b"secret"
+    with pytest.raises(RGWError):          # read grant is not write
+        g.put_object("b", "o", b"clobber", actor="bob")
+    # canned public-read on the bucket: anonymous read works,
+    # anonymous write still denied
+    g.put_bucket_acl("b", canned="public-read", actor="alice")
+    assert g.list_objects("b", actor="bob")["contents"]
+    with pytest.raises(RGWError):
+        g.put_object("b", "y", b"nope", actor="bob")
+    # public-read-write opens puts to authenticated non-owners
+    g.put_bucket_acl("b", canned="public-read-write", actor="alice")
+    assert g.put_object("b", "y", b"ok", actor="bob")["size"] == 2
+    # only the owner may change ACLs
+    with pytest.raises(RGWError):
+        g.put_bucket_acl("b", canned="private", actor="bob")
+    # acl read surface
+    acl = g.get_bucket_acl("b", actor="alice")
+    assert acl["owner"] == "alice"
+    assert {"grantee": "*", "permission": "WRITE"} in acl["grants"]
+
+
+def test_gc_accounts_for_all_versions(rgw):
+    c, g = rgw
+    g.put_bucket_versioning("b", "enabled")
+    g.put_object("b", "k", b"v1" * 100)
+    g.put_object("b", "k", b"v2" * 100)
+    rep = g.gc(repair=False)
+    assert rep["orphan_objects"] == []     # every version referenced
+
+
+def test_gc_never_reaps_versions_behind_delete_marker(rgw):
+    """Keys hidden by a delete marker still own live noncurrent data;
+    gc must walk the RAW index (not the marker-filtered listing) or a
+    repair pass permanently destroys restorable versions."""
+    c, g = rgw
+    g.put_bucket_versioning("b", "enabled")
+    v1 = g.put_object("b", "k", b"restorable-data")
+    d = g.delete_object("b", "k")           # marker hides the key
+    rep = g.gc(repair=True)
+    assert rep["orphan_objects"] == []
+    # restore by removing the marker: the data must still be there
+    g.delete_object("b", "k", version_id=d["version_id"])
+    assert g.get_object("b", "k") == b"restorable-data"
+    assert g.get_object("b", "k", version_id=v1["vid"]) == \
+        b"restorable-data"
