@@ -56,7 +56,7 @@ def test_three_mons_leader_sigkill_recovers(cluster):
 
     data = np.random.default_rng(9).integers(
         0, 256, 20000, dtype=np.uint8).tobytes()
-    end = time.monotonic() + 30.0
+    end = time.monotonic() + 90.0
     while True:                    # daemons may still be applying maps
         try:
             assert cl.write_full("p", "obj", data) == 0
@@ -90,7 +90,7 @@ def test_three_mons_leader_sigkill_recovers(cluster):
     # both survivors converge on one committed state: subscribe a
     # client to each and compare the replicated map
     cl2 = c.client("client.y", mon_name="mon.2")
-    deadline = time.monotonic() + 30.0
+    deadline = time.monotonic() + 90.0
     while True:
         _refresh_map(c, cl)
         _refresh_map(c, cl2)
@@ -111,8 +111,9 @@ def test_three_mons_leader_sigkill_recovers(cluster):
 
     # data written under the old quorum still serves under the new one
     assert cl.read("p", "obj") == data
-    # and the cluster keeps accepting writes
-    end = time.monotonic() + 30.0
+    # and the cluster keeps accepting writes (generous window: under a
+    # loaded host the re-peering after mon failover can take a while)
+    end = time.monotonic() + 90.0
     while True:
         try:
             assert cl.write_full("p", "obj2", data[:5000]) == 0
