@@ -98,11 +98,13 @@ class FileHandle:
 class RemoteCephFS:
     """Client-side mount over an MDS session."""
 
-    def __init__(self, client: RadosClient, mds_name: str = "mds.0",
+    def __init__(self, client: RadosClient,
+                 mds_name: Optional[str] = "mds.0",
                  metadata_pool: str = "fsmeta",
                  data_pool: str = "fsdata", drive=None):
         self.client = client
-        self.mds = mds_name
+        self._auto = mds_name is None
+        self.mds = mds_name or ""
         self.mdpool = metadata_pool
         self.dpool = data_pool
         self._tid = 0
@@ -161,12 +163,36 @@ class RemoteCephFS:
             data={"path": fh.path, "size": fh.size,
                   "mtime": time.time()}), self.mds)
 
-    def _request(self, op: str, **args):
+    def _resolve_mds(self, timeout: float = 60.0) -> str:
+        """The ACTIVE mds from the mon's replicated fsmap ('ceph mds
+        stat'): how a client finds — and, after a failover, re-finds —
+        its metadata server."""
+        import time as _time
+        end = _time.monotonic() + timeout
+        while _time.monotonic() < end:
+            try:
+                st = self.client.mon_command("fs_status")
+                if st and st.get("active"):
+                    return st["active"][0]
+            except (IOError, ValueError):
+                pass
+            self.client.network.pump()
+            _time.sleep(0.3)
+        raise FsError("resolve_mds", -110)
+
+    def _request(self, op: str, _refind: bool = True,
+                 _reqid: str = "", **args):
+        if self._auto and not self.mds:
+            self.mds = self._resolve_mds()
         self.process()          # our own pending flushes go first
         self._tid += 1
         tid = self._tid
+        # the reqid survives a failover retry with its ORIGINAL tid, so
+        # a promoted standby that replayed the dead active's journal
+        # can recognize an already-applied mutation
+        reqid = _reqid or f"{self.client.name}#{tid}"
         self.client.messenger.send_message(MClientRequest(
-            tid=tid, op=op, args=args), self.mds)
+            tid=tid, op=op, args=args, reqid=reqid), self.mds)
         import time as _time
         for attempt in range(MAX_ATTEMPTS):
             self.client.network.pump()
@@ -181,6 +207,14 @@ class RemoteCephFS:
                 return rep.data
             if self._drive is None and attempt > 2:
                 _time.sleep(0.25)   # cross-process: let the mds run
+        if self._auto and _refind:
+            # the active may have failed over: re-resolve and retry
+            # once against the new incumbent, carrying the SAME reqid
+            # so an op the dead active already journaled is not
+            # re-executed
+            self.mds = self._resolve_mds()
+            return self._request(op, _refind=False, _reqid=reqid,
+                                 **args)
         raise FsError(op, -110)                       # ETIMEDOUT
 
     # ---- metadata surface (all via the MDS) --------------------------------

@@ -78,6 +78,15 @@ class MDSDaemon:
         from ..journal import Journaler
         self.network = network
         self.name = name
+        # ALL dispatch-visible state must exist before the messenger
+        # registration: construction below does rados IO whose pumps
+        # can deliver client requests to ms_fast_dispatch mid-__init__
+        self._inbox: List[Message] = []
+        self.caps = {}
+        self.cap_seq = 0
+        self.revoking = {}
+        self.waiting = {}
+        self.now = 0.0
         self.messenger = network.create_messenger(name)
         self.messenger.add_dispatcher_head(self)
         self.rados = rados
@@ -109,21 +118,18 @@ class MDSDaemon:
                     raise
         else:
             self.journal.open()
-        # caps: ino -> {client_name: capbits}
-        self.caps: Dict[int, Dict[str, int]] = {}
-        self.cap_seq = 0
-        # outstanding revokes: ino -> {client: (seq, issued_at)};
-        # issued_at is None until the first tick() supplies a clock
-        # (deadlines from a zero clock would expire instantly)
-        self.revoking: Dict[int, Dict[str, Tuple[int,
-                                                 Optional[float]]]] = {}
-        # requests parked until an ino's revokes drain
-        self.waiting: Dict[int, List[MClientRequest]] = {}
-        self.now = 0.0
-        # dispatch only ENQUEUES: handlers do blocking rados IO, which
-        # must not run nested inside a network pump (the daemon loop —
-        # or an in-process driver — calls process())
-        self._inbox: List[Message] = []
+        # caps: ino -> {client: capbits}; revokes: ino -> {client:
+        # (seq, issued_at)} with issued_at None until the first tick
+        # supplies a clock; _inbox: dispatch only ENQUEUES (handlers do
+        # blocking rados IO which cannot run nested inside a pump) —
+        # all initialized above, before the messenger registration.
+        #
+        # completed request ids: mutating ops journal their reqid, so
+        # a PROMOTED standby that replayed the journal can answer a
+        # client's failover retry instead of re-executing it (the
+        # reference persists completed_requests in the session map)
+        from collections import OrderedDict
+        self._completed: "OrderedDict[str, bool]" = OrderedDict()
         self._replay()
 
     # ---- journal (MDLog) ---------------------------------------------------
@@ -139,21 +145,39 @@ class MDSDaemon:
         else:
             committed = cl["commit_tid"]
         last = committed
-        for tid, payload in self.journal.replay(after_tid=committed):
+        # scan the WHOLE retained journal: reqids must be remembered
+        # even for committed events (a failover retry can reference an
+        # op the dead active both journaled AND committed), but only
+        # events past the commit point are re-APPLIED
+        for tid, payload in self.journal.replay(after_tid=-1):
             ev = json.loads(payload)
-            try:
-                self._apply(ev["op"], ev["args"])
-            except FsError as e:
-                if e.result not in (-17, -2, -39):
-                    raise
-            last = tid
+            if tid > committed:
+                try:
+                    self._apply(ev["op"], ev["args"])
+                except FsError as e:
+                    if e.result not in (-17, -2, -39):
+                        raise
+                last = tid
+            if ev.get("reqid"):
+                self._remember(ev["reqid"])
         if last > committed:
             self.journal.commit("mds", last)
 
-    def _journal_and_apply(self, op: str, args: Dict):
-        tid = self.journal.append(_j({"op": op, "args": args}))
+    def _remember(self, reqid: str) -> None:
+        self._completed[reqid] = True
+        while len(self._completed) > 4096:
+            self._completed.popitem(last=False)
+
+    def _journal_and_apply(self, op: str, args: Dict,
+                           reqid: str = ""):
+        ev = {"op": op, "args": args}
+        if reqid:
+            ev["reqid"] = reqid
+        tid = self.journal.append(_j(ev))
         out = self._apply(op, args)
         self.journal.commit("mds", tid)
+        if reqid:
+            self._remember(reqid)
         return out
 
     # ---- dispatch ----------------------------------------------------------
@@ -179,6 +203,17 @@ class MDSDaemon:
             else:
                 self._handle_caps(msg)
         return n
+
+    def beacon(self, mons, state: str = "active") -> None:
+        """MMDSBeacon to every mon (MDSDaemon::beacon_send): liveness
+        for the MDSMonitor's fsmap — a silent active gets failed over
+        to a standby."""
+        from ..msg.messages import MMDSBeacon
+        self._beacon_seq = getattr(self, "_beacon_seq", 0) + 1
+        for m in mons:
+            self.messenger.send_message(MMDSBeacon(
+                name=self.name, state=state,
+                seq=self._beacon_seq), m)
 
     def tick(self, now: float) -> None:
         """Evict sessions that never acked a revoke (stale session
@@ -281,7 +316,15 @@ class MDSDaemon:
                 self.caps.get(ino, {}).pop(msg.src, None)
                 out = {}
             elif op in _JOURNALED:
-                out = self._journal_and_apply(op, args)
+                reqid = getattr(msg, "reqid", "")
+                if reqid and reqid in self._completed:
+                    # a failover retry of an op the dead active already
+                    # journaled (and we replayed): answer from effect,
+                    # never re-execute (mkdir would EEXIST, rename
+                    # would ENOENT, snap ids would double-allocate)
+                    out = self._replayed_reply(op, args)
+                else:
+                    out = self._journal_and_apply(op, args, reqid)
             elif op in _READONLY:
                 out = self._apply(op, args)
             else:
@@ -295,6 +338,18 @@ class MDSDaemon:
             return
         self._reply(msg, 0, out)
 
+    def _replayed_reply(self, op: str, args: Dict) -> Dict:
+        """Reconstruct the reply for an already-applied duplicate:
+        ino-returning ops re-resolve; the rest have no payload."""
+        if op in ("mkdir", "create", "symlink"):
+            try:
+                return {"ino": self.fs._resolve(
+                    args["path"], follow_final=False)["ino"],
+                    "replayed": True}
+            except FsError:
+                return {"replayed": True}
+        return {"replayed": True}
+
     def _op_open(self, msg: MClientRequest,
                  args: Dict) -> Optional[Dict]:
         """Resolve + cap issue: the client gets the inode, its data
@@ -307,7 +362,8 @@ class MDSDaemon:
         except FsError as e:
             if e.result != -2 or not create:
                 raise
-            self._journal_and_apply("create", {"path": path})
+            self._journal_and_apply("create", {"path": path},
+                                    getattr(msg, "reqid", ""))
             dino, name, inode = self.fs._resolve_dentry(path)
         if inode["type"] == "dir":
             raise FsError("open", -21)           # EISDIR

@@ -28,8 +28,8 @@ from ..crush.constants import CRUSH_BUCKET_STRAW2
 from ..ec import create_erasure_code
 from ..msg import Dispatcher, MOSDFailure, MOSDMap, Message, Network
 from ..msg.messages import (
-    MLog, MMonElection, MMonPaxos, MMonPing, MMonSubscribe, MOSDBoot,
-    MOSDPGTemp,
+    MLog, MMDSBeacon, MMonElection, MMonPaxos, MMonPing, MMonSubscribe,
+    MOSDBoot, MOSDPGTemp,
 )
 from ..osdmap import (
     CEPH_OSD_IN, Incremental, OSDMap, TYPE_ERASURE, TYPE_REPLICATED,
@@ -38,6 +38,7 @@ from ..osdmap import (
 
 DEFAULT_STRIPE_UNIT = 4096  # osd_pool_erasure_code_stripe_unit
 MON_PING_GRACE = 15.0       # leader silent this long -> re-elect
+MDS_BEACON_GRACE = 15.0     # active mds silent this long -> failover
 
 
 class Monitor(Dispatcher):
@@ -523,6 +524,7 @@ class Monitor(Dispatcher):
                     self.publish(inc)
             # clog entries with no epoch to ride commit on their own
             self.flush_log()
+            self._check_mds_failover(now)
         if not self.peers:
             return
         for p in self.peers:
@@ -635,6 +637,97 @@ class Monitor(Dispatcher):
 
     def config_key_dump(self) -> Dict[str, str]:
         return dict(self.config_kv)
+
+    # ---- fsmap (MDSMonitor role, src/mon/MDSMonitor.cc at lite scale) ------
+    #
+    # The map of MDS daemons and their states rides the replicated
+    # config-key store (one paxos service reused, like LogMonitor): the
+    # FIRST daemon to beacon becomes active, later ones stand by, and
+    # an active whose beacons go stale is failed over to the
+    # longest-waiting live standby.  Beacon liveness itself is
+    # leader-local RAM — a new leader re-learns it from the next
+    # beacons, restarting the grace window.
+    def _fsmap(self) -> Dict:
+        import json as _json
+        raw = self.config_key_get("fsmap")
+        return _json.loads(raw) if raw else {"mds": {}}
+
+    def _save_fsmap(self, fsmap: Dict) -> None:
+        import json as _json
+        self.config_key_set("fsmap", _json.dumps(fsmap,
+                                                 sort_keys=True))
+
+    def fs_status(self) -> Dict:
+        """Read-only fsmap view ('ceph mds stat' / 'ceph fs status'):
+        answerable by any mon — the fsmap is paxos-replicated."""
+        fsmap = self._fsmap()
+        active = sorted(n for n, e in fsmap["mds"].items()
+                        if e["state"] == "active")
+        standby = sorted(n for n, e in fsmap["mds"].items()
+                         if e["state"] == "standby")
+        return {"mds": fsmap["mds"], "active": active,
+                "standby": standby}
+
+    def _handle_mds_beacon(self, msg: MMDSBeacon) -> None:
+        if self.peers and not self.is_leader():
+            name = self._peer_name(self.leader_rank) \
+                if self.leader_rank >= 0 else None
+            if name:
+                self.messenger.send_message(MMDSBeacon(
+                    name=msg.name, state=msg.state, seq=msg.seq), name)
+            return
+        if not hasattr(self, "_mds_last_beacon"):
+            self._mds_last_beacon = {}
+        self._mds_last_beacon[msg.name] = self.now
+        fsmap = self._fsmap()
+        cur = fsmap["mds"].get(msg.name)
+        if cur is None or cur["state"] == "failed":
+            # new daemon — or a FAILED one beaconing again (restarted
+            # after the grace window): it rejoins, taking the active
+            # seat if nobody holds it (MDSMonitor re-admitting a
+            # formerly-laggy daemon)
+            has_active = any(e["state"] == "active"
+                             for e in fsmap["mds"].values())
+            fsmap["mds"][msg.name] = {
+                "state": "standby" if has_active else "active"}
+            self.log_entry("mon", "INF",
+                           f"mds {msg.name} joined as "
+                           f"{fsmap['mds'][msg.name]['state']}")
+            self._save_fsmap(fsmap)
+
+    def _check_mds_failover(self, now: float) -> None:
+        """Leader tick: fail a silent active and promote a LIVE
+        standby (MDSMonitor::tick beacon grace)."""
+        beacons = getattr(self, "_mds_last_beacon", None)
+        if not beacons:
+            return
+        fsmap = self._fsmap()
+        changed = False
+        for name, e in sorted(fsmap["mds"].items()):
+            if e["state"] != "active":
+                continue
+            last = beacons.get(name, now)
+            beacons.setdefault(name, now)
+            if now - last <= MDS_BEACON_GRACE:
+                continue
+            # the active is gone: pick the standby we heard from most
+            # recently within the grace window
+            live = [(beacons.get(n, -1e18), n)
+                    for n, se in sorted(fsmap["mds"].items())
+                    if se["state"] == "standby"
+                    and now - beacons.get(n, -1e18) <= MDS_BEACON_GRACE]
+            fsmap["mds"][name] = {"state": "failed"}
+            changed = True
+            if live:
+                _t, pick = max(live)
+                fsmap["mds"][pick] = {"state": "active"}
+                self.log_entry("mon", "WRN",
+                               f"mds {name} failed; promoting {pick}")
+            else:
+                self.log_entry("mon", "WRN",
+                               f"mds {name} failed; no standby")
+        if changed:
+            self._save_fsmap(fsmap)
 
     # ---- pools -------------------------------------------------------------
     def create_replicated_pool(self, name: str, size: int = 3,
@@ -959,6 +1052,14 @@ class Monitor(Dispatcher):
                     cache.popitem(last=False)
             self.messenger.send_message(ack, msg.src)
 
+        # read-only commands: no mutation, no publish, answerable on
+        # ANY mon from replicated state — handled before the leader
+        # relay so a client bound to a peon gets its answer even
+        # mid-election
+        if msg.cmd == "fs_status":
+            reply(0, {"value": self.fs_status()}, cacheable=False)
+            return
+
         # peons never mutate: relay to the leader (Monitor::
         # forward_request_leader, src/mon/Monitor.cc) and let the ack
         # route back through us.  A mutation here would diverge this
@@ -1216,6 +1317,8 @@ class Monitor(Dispatcher):
             self._handle_paxos(msg)
         elif isinstance(msg, MMonPing):
             self._handle_mon_ping(msg)
+        elif isinstance(msg, MMDSBeacon):
+            self._handle_mds_beacon(msg)
         elif isinstance(msg, MOSDPGTemp):
             if self.is_leader() or not self.peers:
                 self.handle_pg_temp(msg)

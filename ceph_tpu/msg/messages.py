@@ -483,6 +483,9 @@ class MClientRequest(Message):
     tid: int = 0
     op: str = ""
     args: Dict[str, Any] = field(default_factory=dict)
+    # stable across failover retries: the promoted MDS dedups mutating
+    # ops it already replayed from the journal by this id
+    reqid: str = ""
 
 
 @dataclass
@@ -515,3 +518,14 @@ class MClientCaps(Message):
 # cephfs capability bits (a lite slice of CEPH_CAP_*)
 CEPH_CAP_FILE_CACHE = 1     # may cache reads
 CEPH_CAP_FILE_BUFFER = 2    # may buffer writes (write-back)
+
+
+@dataclass
+class MMDSBeacon(Message):
+    """MDS -> mon liveness + state beacon (src/messages/MMDSBeacon.h
+    role): the MDSMonitor builds the fsmap from these — first beacon
+    becomes active, later ones standby, and a stale active is failed
+    over to a live standby."""
+    name: str = ""
+    state: str = "standby"      # what the daemon believes it is
+    seq: int = 0

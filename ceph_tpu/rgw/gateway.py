@@ -408,9 +408,11 @@ class RGWLite:
                 for i in range(count)]
 
     def head_object(self, bucket: str, name: str,
-                    version_id: Optional[str] = None) -> Dict:
+                    version_id: Optional[str] = None,
+                    actor: Optional[str] = None) -> Dict:
         b = self.get_bucket(bucket)
         cur = self._raw_entry(b, name)
+        self._check_object_access(b, cur, actor, "READ")
         if version_id is not None:
             vrec = next((v for v in
                          self._version_stack(b, name, cur)

@@ -111,7 +111,8 @@ class S3Frontend:
             self.rgw.delete_bucket(bucket)
             return 204, {}, b""
         if method == "GET":
-            self._owner_check(user, bucket)
+            # ACL-gated (bucket READ), not owner-gated: public-read
+            # buckets list for any authenticated caller
             v2 = query.get("list-type") == "2"
             marker = (query.get("continuation-token")
                       or query.get("start-after", "")) if v2 \
@@ -120,7 +121,8 @@ class S3Frontend:
                 bucket, prefix=query.get("prefix", ""),
                 delimiter=query.get("delimiter", ""),
                 marker=marker,
-                max_keys=int(query.get("max-keys", "1000")))
+                max_keys=int(query.get("max-keys", "1000")),
+                actor=user["uid"])
             items = "".join(
                 f"<Contents><Key>{escape(e['name'])}</Key>"
                 f"<Size>{e['size']}</Size>"
@@ -147,24 +149,24 @@ class S3Frontend:
         return _err(405, "MethodNotAllowed")
 
     def _object_op(self, method, user, bucket, key, body):
+        # policy decisions live in the gateway's ACL engine (canned
+        # ACLs + grants, rgw_acl_s3.cc role): the frontend just
+        # supplies the authenticated actor
+        actor = user["uid"]
         if method == "PUT":
-            self._owner_check(user, bucket)
-            meta = self.rgw.put_object(bucket, key, body)
+            meta = self.rgw.put_object(bucket, key, body, actor=actor)
             return 200, {"ETag": f'"{meta["etag"]}"'}, b""
         if method == "GET":
-            self._owner_check(user, bucket)
-            data = self.rgw.get_object(bucket, key)
+            data = self.rgw.get_object(bucket, key, actor=actor)
             meta = self.rgw.head_object(bucket, key)
             return 200, {"Content-Type": meta["content_type"],
                          "ETag": f'"{meta["etag"]}"'}, data
         if method == "HEAD":
-            self._owner_check(user, bucket)
-            meta = self.rgw.head_object(bucket, key)
+            meta = self.rgw.head_object(bucket, key, actor=actor)
             return 200, {"Content-Length": str(meta["size"]),
                          "ETag": f'"{meta["etag"]}"'}, b""
         if method == "DELETE":
-            self._owner_check(user, bucket)
-            self.rgw.delete_object(bucket, key)
+            self.rgw.delete_object(bucket, key, actor=actor)
             return 204, {}, b""
         return _err(405, "MethodNotAllowed")
 

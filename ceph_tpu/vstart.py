@@ -61,6 +61,8 @@ def mon_main(args) -> None:
     peers = [p for p in args.peers.split(",") if p]
     if args.mon_grace:
         monitor_mod.MON_PING_GRACE = args.mon_grace
+    if args.mds_grace:
+        monitor_mod.MDS_BEACON_GRACE = args.mds_grace
     mon = Monitor(net, name=args.name, rank=args.rank, peers=peers)
     if args.down_out_interval:
         mon.down_out_interval = args.down_out_interval
@@ -222,8 +224,52 @@ def mds_main(args) -> None:
     from .cephfs.cls_fs import ROOT_INO, dir_oid
     from .mds import MDSDaemon
     # the fresh pools' PGs keep settling for a while after creation:
-    # wait until the metadata pool actually ANSWERS (ENOENT = servable
-    # but empty -> first boot; success = existing fs -> replay boot)
+    # wait until the metadata pool actually ANSWERS (ENOENT or data —
+    # either means servable).  Freshness is decided AFTER promotion:
+    # another mds may create the fs while we stand by.
+    deadline = time.monotonic() + 120.0
+    while True:
+        try:
+            rados.stat(args.metadata_pool, dir_oid(ROOT_INO))
+            break
+        except IOError as e:
+            if getattr(e, "errno", None) == 2:
+                break               # pool serves, no fs yet
+            if time.monotonic() > deadline:
+                raise RuntimeError("fs pools never became servable")
+            net.pump(quiesce=0.05, deadline=0.3)
+            time.sleep(0.3)
+    # ---- fsmap membership: beacon as standby until the MDSMonitor
+    # names us active (first joiner activates immediately; later ones
+    # stand by and take over on the active's beacon-grace failover) ----
+    from .msg.messages import MMDSBeacon
+
+    def beacon(state: str) -> None:
+        for m in mon_names:
+            net.send(args.name, m, MMDSBeacon(name=args.name,
+                                              state=state))
+
+    def fs_active() -> str:
+        try:
+            st = rados.mon_command("fs_status")
+            return st["active"][0] if st and st["active"] else ""
+        except (IOError, ValueError):
+            return ""
+
+    beacon("standby")
+    print("READY", flush=True)
+    last_beacon = 0.0
+    while fs_active() != args.name:
+        net.pump(quiesce=0.05, deadline=0.3)
+        if time.monotonic() - last_beacon > 1.0:
+            beacon("standby")
+            last_beacon = time.monotonic()
+        time.sleep(0.2)
+
+    # promoted (or first): initialize and serve.  Probe freshness NOW —
+    # if another mds was active before us, IT created the fs and we
+    # must open + REPLAY, not mkfs.  Transient errors retry (a stale
+    # False would journal.open() a journal that never existed).
     fresh = None
     deadline = time.monotonic() + 120.0
     while fresh is None:
@@ -232,9 +278,9 @@ def mds_main(args) -> None:
             fresh = False
         except IOError as e:
             if getattr(e, "errno", None) == 2:
-                fresh = True        # pool serves, no fs yet
+                fresh = True
             elif time.monotonic() > deadline:
-                raise RuntimeError("fs pools never became servable")
+                raise
             else:
                 net.pump(quiesce=0.05, deadline=0.3)
                 time.sleep(0.3)
@@ -251,11 +297,27 @@ def mds_main(args) -> None:
                 raise
             net.pump(quiesce=0.05, deadline=0.3)
             time.sleep(0.5)
-    print("READY", flush=True)
+    last_beacon = 0.0
+    last_fence_check = time.monotonic()
     while True:
         net.pump(quiesce=0.02, deadline=0.3)
         mds.process()
-        mds.tick(time.monotonic())
+        now = time.monotonic()
+        if now - last_beacon > 1.0:
+            mds.beacon(mon_names)
+            last_beacon = now
+        if now - last_fence_check > 2.0:
+            last_fence_check = now
+            active = fs_active()
+            if active and active != args.name:
+                # FENCED: the mon failed us over (we stalled past the
+                # beacon grace but did not die).  Two writers on one
+                # MDS journal would corrupt it — suicide and let the
+                # harness restart us as a standby (MDSDaemon::respawn)
+                print(f"fenced: {active} is active now; exiting",
+                      file=sys.stderr, flush=True)
+                os._exit(0)
+        mds.tick(now)
 
 
 # ---- harness ---------------------------------------------------------------
@@ -286,10 +348,12 @@ class ProcessCluster:
                  data_root: Optional[str] = None,
                  n_mons: int = 1,
                  mon_grace: float = 4.0,
-                 n_mds: int = 0):
+                 n_mds: int = 0,
+                 mds_grace: float = 5.0):
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.n_mds = n_mds
+        self.mds_grace = mds_grace
         self.mon_grace = mon_grace
         # single-mon clusters keep the historical name "mon"
         self.mon_names = (["mon"] if n_mons == 1
@@ -360,6 +424,7 @@ class ProcessCluster:
                  "--name", name, "--rank", str(rank),
                  "--peers", peers_of[name],
                  "--mon-grace", str(self.mon_grace),
+                 "--mds-grace", str(self.mds_grace),
                  "--down-out-interval", str(down_out_interval),
                  "--pool", json.dumps(pool) if (pool and with_pool)
                  else "",
@@ -537,6 +602,7 @@ def main(argv=None) -> None:
     pm.add_argument("--rank", type=int, default=0)
     pm.add_argument("--peers", default="")
     pm.add_argument("--mon-grace", type=float, default=0.0)
+    pm.add_argument("--mds-grace", type=float, default=0.0)
     pm.add_argument("--pool", default="")
     pm.add_argument("--down-out-interval", type=float, default=0.0)
     pm.add_argument("--keyring", default="")

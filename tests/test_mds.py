@@ -162,3 +162,22 @@ def test_snapshot_remove_and_readonly(world):
     fa.snap_remove("/a", "s")
     with pytest.raises(FsError):
         fa.snapshot("/a", "s")
+
+
+def test_failover_retry_dedup(world):
+    """A mutating op retried with its original reqid — the failover
+    retry shape — is answered from effect, not re-executed; a PROMOTED
+    incarnation that replayed the journal dedups it too."""
+    c, mds, fa, fb = world
+    out1 = fa._request("mkdir", path="/dup", _reqid="client.a#7")
+    out2 = fa._request("mkdir", path="/dup", _reqid="client.a#7")
+    assert out2.get("replayed") and out2["ino"] == out1["ino"]
+    # without the reqid it is a genuine duplicate -> EEXIST
+    with pytest.raises(FsError):
+        fa._request("mkdir", path="/dup")
+    # a fresh incarnation rebuilt the completed set from the journal
+    mds2 = MDSDaemon(c.network, c.client("client.mdsB"), "mds.0")
+    f2 = RemoteCephFS(c.client("client.a4"))
+    f2._drive = lambda: mds2.process()
+    out3 = f2._request("mkdir", path="/dup", _reqid="client.a#7")
+    assert out3.get("replayed") and out3["ino"] == out1["ino"]

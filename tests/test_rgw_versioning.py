@@ -176,3 +176,50 @@ def test_gc_never_reaps_versions_behind_delete_marker(rgw):
     assert g.get_object("b", "k") == b"restorable-data"
     assert g.get_object("b", "k", version_id=v1["vid"]) == \
         b"restorable-data"
+
+
+def test_http_frontend_enforces_acls(rgw):
+    """Cross-user access over the HTTP surface: the frontend passes
+    the authenticated actor into the gateway's ACL engine instead of
+    the old owner-only check."""
+    import http.client
+
+    from ceph_tpu.rgw import S3Frontend, serve
+    from ceph_tpu.rgw.http import _sign_v2
+
+    c, g = rgw
+    alice = g.get_user("alice")
+    bob = g.get_user("bob")
+    fe = S3Frontend(g)
+    srv, port = serve(fe)
+    try:
+        def req(method, path, body=b"", sign_as=alice):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            date = "now"
+            sig = _sign_v2(sign_as["secret_key"], method, date,
+                           path.split("?")[0])
+            conn.request(method, path, body, {
+                "Date": date,
+                "Authorization": f"AWS {sign_as['access_key']}:{sig}"})
+            r = conn.getresponse()
+            out = r.read()
+            conn.close()
+            return r.status, out
+
+        assert req("PUT", "/b/doc", b"private bytes")[0] == 200
+        # bob: denied read/list/write on the private bucket
+        assert req("GET", "/b/doc", sign_as=bob)[0] == 403
+        assert req("GET", "/b", sign_as=bob)[0] == 403
+        assert req("PUT", "/b/intruder", b"x", sign_as=bob)[0] == 403
+        assert req("DELETE", "/b/doc", sign_as=bob)[0] == 403
+        # a READ grant opens GET but not PUT/DELETE
+        g.put_bucket_acl("b", canned="public-read", actor="alice")
+        st, out = req("GET", "/b/doc", sign_as=bob)
+        assert (st, out) == (200, b"private bytes")
+        assert req("GET", "/b", sign_as=bob)[0] == 200
+        assert req("PUT", "/b/intruder", b"x", sign_as=bob)[0] == 403
+        # owner still writes
+        assert req("PUT", "/b/doc2", b"ok")[0] == 200
+    finally:
+        srv.shutdown()

@@ -57,3 +57,51 @@ def test_mds_sigkill_replay_recovers(cluster):
     fs2.create("/post/new")
     fs2.write("/post/new", b"after restart", 0)
     assert fs2.read("/post/new") == b"after restart"
+
+
+@pytest.fixture(scope="module")
+def ha_cluster():
+    c = ProcessCluster(n_osds=3, n_mds=2, mds_grace=4.0,
+                       client_names=("client.x", "client.y"),
+                       heartbeat_interval=1.0, heartbeat_grace=4.0)
+    yield c
+    c.close()
+
+
+def test_mds_standby_takeover(ha_cluster):
+    """MDS HA (MDSMonitor + standby daemons): two mds processes beacon
+    to the mon; the first is active, the second stands by.  SIGKILL
+    the active: the mon's beacon grace fails it over, the standby
+    opens the fs, REPLAYS the MDS journal, and the client re-resolves
+    the active from the replicated fsmap and keeps working."""
+    c = ha_cluster
+    cl = c.client("client.x")
+    c.wait_healthy(cl)
+    fs = RemoteCephFS(cl, mds_name=None)      # resolve via the fsmap
+    _retrying(lambda: fs.mkdir("/ha"))
+    fs.create("/ha/f")
+    fs.write("/ha/f", b"pre-failover", 0)
+    st = cl.mon_command("fs_status")
+    first_active = st["active"][0]
+    assert st["standby"], st                  # a standby is seated
+    # kill the ACTIVE mds daemon
+    active_idx = int(first_active.split(".")[1])
+    c.kill_mds(active_idx)
+    # the client's next ops ride the failover: re-resolve + retry
+    end = time.monotonic() + 90.0
+    while True:
+        try:
+            assert fs.read("/ha/f") == b"pre-failover"
+            break
+        except IOError:
+            if time.monotonic() > end:
+                raise
+            time.sleep(1.0)
+    st = cl.mon_command("fs_status")
+    assert st["active"] and st["active"][0] != first_active
+    # and the promoted daemon serves mutations
+    fs.write("/ha/f", b"post-failover", 0)
+    fs.mkdir("/ha/sub")
+    fs2 = RemoteCephFS(c.client("client.y"), mds_name=None)
+    assert fs2.read("/ha/f") == b"post-failover"
+    assert fs2.exists("/ha/sub")
