@@ -103,11 +103,17 @@ class RemoteCephFS:
                  metadata_pool: str = "fsmeta",
                  data_pool: str = "fsdata", drive=None):
         self.client = client
-        self._auto = mds_name is None
+        # any falsy mds_name means "resolve the active from the fsmap"
+        self._auto = not mds_name
         self.mds = mds_name or ""
         self.mdpool = metadata_pool
         self.dpool = data_pool
-        self._tid = 0
+        # random tid base: reqids must be unique ACROSS MOUNTS of the
+        # same client name, or a remount's early tids would collide
+        # with a previous incarnation's completed reqids in the MDS
+        # journal and be silently skipped as failover duplicates
+        import secrets as _secrets
+        self._tid = _secrets.randbits(40) << 8
         self._replies: Dict[int, MClientReply] = {}
         self._handles: Dict[int, FileHandle] = {}
         # revokes arrive inside a network pump, where the flush's own
@@ -151,6 +157,15 @@ class RemoteCephFS:
                         self._write_data(fh.inode, data, off, fh.snapc)
                     fh.buffer = []
                 fh.caps = 0
+                # durability first: the wrstat as a REQUEST reaches
+                # whoever is active (it re-resolves across a failover);
+                # the MClientCaps ack below just clears the revoking
+                # entry on the (possibly dead) sender
+                try:
+                    self._request("wrstat", path=fh.path, size=fh.size,
+                                  mtime=time.time())
+                except FsError:
+                    pass
                 self._send_flush(fh)
             else:
                 self.client.messenger.send_message(MClientCaps(

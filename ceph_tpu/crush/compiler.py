@@ -128,6 +128,30 @@ class CrushCompiler:
             for step in rule.steps:
                 out.append("\t" + self._step_text(step))
             out.append("}")
+        if m.choose_args:
+            out.append("")
+            out.append("# choose_args")
+            for key in sorted(m.choose_args):
+                out.append(f"choose_args {key} {{")
+                args = m.choose_args[key]
+                for bi, arg in enumerate(args):
+                    if arg is None or (not arg.ids
+                                       and not arg.weight_set):
+                        continue
+                    out.append("  {")
+                    out.append(f"    bucket_id {-1 - bi}")
+                    if arg.weight_set:
+                        out.append("    weight_set [")
+                        for ws in arg.weight_set:
+                            row = " ".join(f"{w / 0x10000:.3f}"
+                                           for w in ws.weights)
+                            out.append(f"      [ {row} ]")
+                        out.append("    ]")
+                    if arg.ids:
+                        row = " ".join(str(x) for x in arg.ids)
+                        out.append(f"    ids [ {row} ]")
+                    out.append("  }")
+                out.append("}")
         out.append("")
         out.append("# end crush map")
         return "\n".join(out) + "\n"
@@ -168,6 +192,7 @@ class CrushCompiler:
                 lines.append(line)
         i = 0
         pending_buckets: List[dict] = []
+        self._pending_choose_args: List = []
         rule_starts: List[int] = []
         max_dev = 0
         while i < len(lines):
@@ -192,6 +217,8 @@ class CrushCompiler:
                 while i < len(lines) and lines[i] != "}":
                     i += 1
                 i += 1
+            elif toks[0] == "choose_args":
+                i = self._parse_choose_args(cw, lines, i)
             elif len(toks) == 3 and toks[2] == "{":
                 i = self._parse_bucket(cw, lines, i, pending_buckets)
             else:
@@ -200,8 +227,68 @@ class CrushCompiler:
         self._build_buckets(cw, pending_buckets)
         for start in rule_starts:
             self._parse_rule(cw, lines, start)
+        self._install_choose_args(cw)
         self.crush = cw
         return cw
+
+    def _parse_choose_args(self, cw: CrushWrapper, lines: List[str],
+                           i: int) -> int:
+        """choose_args <key> { { bucket_id N [weight_set [...]]
+        [ids [...]] } ... }  (CrushCompiler.cc parse_choose_args)."""
+        from .types import ChooseArg, WeightSet
+        key = int(lines[i].split()[1])
+        i += 1
+        entries = []
+        while i < len(lines) and lines[i].split()[0] != "}":
+            t = lines[i].split()
+            if t[0] != "{":
+                raise ValueError(f"choose_args: bad line {lines[i]!r}")
+            i += 1
+            arg = ChooseArg(ids=None, weight_set=None)
+            bucket_id = None
+            while i < len(lines) and lines[i].split()[0] != "}":
+                t = lines[i].split()
+                if t[0] == "bucket_id":
+                    bucket_id = int(t[1])
+                elif t[0] == "ids":
+                    arg.ids = [int(x) for x in t[2:-1]]
+                elif t[0] == "weight_set":
+                    i += 1
+                    arg.weight_set = []
+                    while i < len(lines) and \
+                            lines[i].split()[0] == "[":
+                        ws = lines[i].split()[1:-1]
+                        arg.weight_set.append(WeightSet(
+                            weights=[int(round(float(x) * 0x10000))
+                                     for x in ws]))
+                        i += 1
+                    if i >= len(lines) or lines[i].split()[0] != "]":
+                        raise ValueError("choose_args: unterminated "
+                                         "weight_set")
+                else:
+                    raise ValueError(
+                        f"choose_args: bad line {lines[i]!r}")
+                i += 1
+            if bucket_id is None or bucket_id >= 0:
+                raise ValueError("choose_args: entry needs a negative "
+                                 "bucket_id")
+            entries.append((bucket_id, arg))
+            i += 1
+        # arg maps are positional over max_buckets: installed after the
+        # buckets exist (compile() defers to _install_choose_args)
+        self._pending_choose_args.append((key, entries))
+        return i + 1
+
+    def _install_choose_args(self, cw: CrushWrapper) -> None:
+        for key, entries in self._pending_choose_args:
+            args = [None] * len(cw.crush.buckets)
+            for bucket_id, arg in entries:
+                bi = -1 - bucket_id
+                if bi >= len(args):
+                    raise ValueError(
+                        f"choose_args: no bucket {bucket_id}")
+                args[bi] = arg
+            cw.crush.choose_args[key] = args
 
     def _parse_bucket(self, cw: CrushWrapper, lines: List[str], i: int,
                       pending: List[dict]) -> int:

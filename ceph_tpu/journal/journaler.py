@@ -174,6 +174,18 @@ class Journaler:
             yield tid, entries[tid]
             tid += 1
 
+    def scan_entries(self):
+        """Every intact retained entry, ascending tid, WITHOUT the
+        replay gap rule: for membership scans (e.g. dedup-id recovery)
+        where ordering safety doesn't apply."""
+        md = self.get_metadata()
+        out = []
+        for oset in range(md["minimum_set"], md["active_set"] + 1):
+            for s in range(self.splay):
+                out.extend(self._read_object_entries(
+                    oset * self.splay + s))
+        return sorted(out)
+
     def _scan_next_tid(self, md: dict) -> int:
         """Highest tid on disk + 1, walking DOWN from active_set until
         a set with entries appears (tids grow with set number, so the

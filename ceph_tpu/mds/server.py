@@ -144,22 +144,29 @@ class MDSDaemon:
             self.journal.register_client("mds")
         else:
             committed = cl["commit_tid"]
+        # reqids must be remembered for EVERY retained event, even
+        # committed ones (a failover retry can reference an op the dead
+        # active journaled AND committed).  This scan tolerates gaps
+        # (trimmed sets, torn old frames) — ordering doesn't matter
+        # for a membership set.
+        for _tid, payload in self.journal.scan_entries():
+            try:
+                rid = json.loads(payload).get("reqid")
+            except ValueError:
+                continue
+            if rid:
+                self._remember(rid)
+        # the APPLY pass keeps the strict gap rule FROM THE COMMIT
+        # POINT (events past a gap are not safe to apply in order)
         last = committed
-        # scan the WHOLE retained journal: reqids must be remembered
-        # even for committed events (a failover retry can reference an
-        # op the dead active both journaled AND committed), but only
-        # events past the commit point are re-APPLIED
-        for tid, payload in self.journal.replay(after_tid=-1):
+        for tid, payload in self.journal.replay(after_tid=committed):
             ev = json.loads(payload)
-            if tid > committed:
-                try:
-                    self._apply(ev["op"], ev["args"])
-                except FsError as e:
-                    if e.result not in (-17, -2, -39):
-                        raise
-                last = tid
-            if ev.get("reqid"):
-                self._remember(ev["reqid"])
+            try:
+                self._apply(ev["op"], ev["args"])
+            except FsError as e:
+                if e.result not in (-17, -2, -39):
+                    raise
+            last = tid
         if last > committed:
             self.journal.commit("mds", last)
 
@@ -348,6 +355,18 @@ class MDSDaemon:
                     "replayed": True}
             except FsError:
                 return {"replayed": True}
+        if op == "snap_create":
+            # the snapshot exists: hand back its recorded ids
+            try:
+                inode = self.fs._resolve(args["path"],
+                                         follow_final=True)
+                e = self._realm_snaps(inode["ino"]).get(args["name"])
+                if e is not None:
+                    return {"ino": inode["ino"], "md": e["md"],
+                            "data": e["data"], "replayed": True}
+            except FsError:
+                pass
+            return {"replayed": True}
         return {"replayed": True}
 
     def _op_open(self, msg: MClientRequest,
