@@ -152,20 +152,23 @@ class RemoteCephFS:
             msg = self._pending_revokes.pop(0)
             fh = self._handles.pop(msg.ino, None)
             if fh is not None:
+                had_buffer = bool(fh.buffer)
                 if fh.buffer:
                     for off, data in fh.buffer:
                         self._write_data(fh.inode, data, off, fh.snapc)
                     fh.buffer = []
                 fh.caps = 0
-                # durability first: the wrstat as a REQUEST reaches
-                # whoever is active (it re-resolves across a failover);
-                # the MClientCaps ack below just clears the revoking
-                # entry on the (possibly dead) sender
-                try:
-                    self._request("wrstat", path=fh.path, size=fh.size,
-                                  mtime=time.time())
-                except FsError:
-                    pass
+                if had_buffer:
+                    # durability first: the wrstat as a REQUEST reaches
+                    # whoever is active (it re-resolves across a
+                    # failover); clean read handles skip it — nothing
+                    # to write back, and a stale size must not be
+                    # journaled
+                    try:
+                        self._request("wrstat", path=fh.path,
+                                      size=fh.size, mtime=time.time())
+                    except FsError:
+                        pass
                 self._send_flush(fh)
             else:
                 self.client.messenger.send_message(MClientCaps(

@@ -143,6 +143,9 @@ class CrushCompiler:
                     if arg.weight_set:
                         out.append("    weight_set [")
                         for ws in arg.weight_set:
+                            # .3f matches the reference's
+                            # print_fixedpoint exactly (and shares its
+                            # round-trip granularity limit)
                             row = " ".join(f"{w / 0x10000:.3f}"
                                            for w in ws.weights)
                             out.append(f"      [ {row} ]")

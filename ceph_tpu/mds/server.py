@@ -144,29 +144,31 @@ class MDSDaemon:
             self.journal.register_client("mds")
         else:
             committed = cl["commit_tid"]
-        # reqids must be remembered for EVERY retained event, even
-        # committed ones (a failover retry can reference an op the dead
-        # active journaled AND committed).  This scan tolerates gaps
-        # (trimmed sets, torn old frames) — ordering doesn't matter
-        # for a membership set.
-        for _tid, payload in self.journal.scan_entries():
+        # ONE read of the retained journal serves both passes.
+        # reqids are remembered for EVERY event, even committed ones
+        # (a failover retry can reference an op the dead active
+        # journaled AND committed) and tolerate gaps; the APPLY pass
+        # keeps the strict gap rule FROM THE COMMIT POINT (events past
+        # a gap are not safe to apply in order).
+        entries = dict(self.journal.scan_entries())
+        for payload in entries.values():
             try:
                 rid = json.loads(payload).get("reqid")
             except ValueError:
                 continue
             if rid:
                 self._remember(rid)
-        # the APPLY pass keeps the strict gap rule FROM THE COMMIT
-        # POINT (events past a gap are not safe to apply in order)
         last = committed
-        for tid, payload in self.journal.replay(after_tid=committed):
-            ev = json.loads(payload)
+        tid = committed + 1
+        while tid in entries:
+            ev = json.loads(entries[tid])
             try:
                 self._apply(ev["op"], ev["args"])
             except FsError as e:
                 if e.result not in (-17, -2, -39):
                     raise
             last = tid
+            tid += 1
         if last > committed:
             self.journal.commit("mds", last)
 
@@ -322,6 +324,10 @@ class MDSDaemon:
                 ino = int(args["ino"])
                 self.caps.get(ino, {}).pop(msg.src, None)
                 out = {}
+            elif op == "wrstat" and not self._wrstat_allowed(msg,
+                                                             args):
+                self._reply(msg, -13, {"error": "stale cap flush"})
+                return
             elif op in _JOURNALED:
                 reqid = getattr(msg, "reqid", "")
                 if reqid and reqid in self._completed:
@@ -344,6 +350,18 @@ class MDSDaemon:
             self._reply(msg, -22, {"error": repr(e)})
             return
         self._reply(msg, 0, out)
+
+    def _wrstat_allowed(self, msg, args: Dict) -> bool:
+        """The MClientCaps flush path refuses stale writers (evicted
+        sessions); the REQUEST-shaped wrstat must enforce the same:
+        if anyone currently holds caps on the ino, only a holder may
+        write back size/mtime."""
+        try:
+            _d, _n, inode = self.fs._resolve_dentry(args["path"])
+        except FsError:
+            return True          # path-level errors surface in _apply
+        holders = self.caps.get(inode["ino"])
+        return not holders or msg.src in holders
 
     def _replayed_reply(self, op: str, args: Dict) -> Dict:
         """Reconstruct the reply for an already-applied duplicate:

@@ -600,29 +600,41 @@ class FastRule:
             pf = jnp.zeros((R * N,), dtype=jnp.int32)
         item, risky_f = self._descend(xf, root, rf, pf,
                                       self.base_level, self.last_depth)
+        rk_main = None
         if P > 1:
-            risky_lanes = risky_lanes | jnp.any(
-                risky_f.reshape(R, P, N), axis=(0, 1))
+            # per-draw risk, NOT folded: resolution flags a lane only
+            # when a draw it actually EXAMINES (at its dynamic
+            # position) was risky — flagging any-position risk would
+            # replay ~P times more lanes than necessary
+            rk_main = risky_f.reshape(R, P, N)
             cand = item.reshape(R, P, N)
         else:
             risky_lanes = risky_lanes | jnp.any(risky_f.reshape(R, N),
                                                 axis=0)
             cand = item.reshape(R, N)
 
-        def finish(leaf, risky_lanes):
+        def finish(leaf, risky_lanes, rk_leaf=None):
+            if P > 1:
+                # lane-level mid-stage risk + the per-draw tensors
+                return (cand, leaf,
+                        (risky_lanes, rk_main, rk_leaf), valid, xl)
             risky = jnp.any(risky_lanes.reshape(-1, self.parents), axis=1)
             return cand, leaf, risky, valid, xl
 
         L = self.n_leaf
         lshape = (R, L, P, N) if P > 1 else (R, L, N)
+        zero_lrisk = (jnp.zeros(lshape, dtype=bool) if P > 1 else None)
         if not self.leafy:
             return finish(jnp.full(lshape, NONE, dtype=jnp.int32),
-                          risky_lanes)
+                          risky_lanes, zero_lrisk)
         if self.leaf_depth == 0 and self.target_type == 0:
             # chooseleaf over devices: every leaf attempt is the item itself
             if P > 1:
-                return finish(jnp.broadcast_to(
-                    cand[:, None, :, :], lshape), risky_lanes)
+                # the "leaf draw" IS the main draw: its risk too
+                return finish(
+                    jnp.broadcast_to(cand[:, None, :, :], lshape),
+                    risky_lanes,
+                    jnp.broadcast_to(rk_main[:, None, :, :], lshape))
             return finish(jnp.broadcast_to(cand[:, None, :], lshape),
                           risky_lanes)
         # leaf attempts: one flattened batch over lshape
@@ -651,13 +663,13 @@ class FastRule:
                   jnp.uint32(self.numrep) * ft2[:, None]).reshape(-1)
         lv, lrisky = self._descend(xleaf, bl, rl, pl, self.depth, depth)
         if P > 1:
-            risky_lanes = risky_lanes | jnp.any(
-                lrisky.reshape(L, R, P, N), axis=(0, 1, 2))
             leaf = jnp.transpose(lv.reshape(L, R, P, N), (1, 0, 2, 3))
-        else:
-            risky_lanes = risky_lanes | jnp.any(lrisky.reshape(L, R, N),
-                                                axis=(0, 1))
-            leaf = jnp.transpose(lv.reshape(L, R, N), (1, 0, 2))
+            rk_leaf = jnp.transpose(lrisky.reshape(L, R, P, N),
+                                    (1, 0, 2, 3))
+            return finish(leaf, risky_lanes, rk_leaf)
+        risky_lanes = risky_lanes | jnp.any(lrisky.reshape(L, R, N),
+                                            axis=(0, 1))
+        leaf = jnp.transpose(lv.reshape(L, R, N), (1, 0, 2))
         return finish(leaf, risky_lanes)
 
     # ---- resolution phase (per weight vector; cheap) -----------------------
@@ -665,10 +677,15 @@ class FastRule:
         """Per-lane resolution: sel (N, numrep) plus residual (X,) —
         a lane's unresolved state rolls up to its x, which replays on
         the host whole."""
-        risky_lanes = jnp.repeat(risky, self.parents)
+        rk_main = rk_leaf = None
+        if self.posP > 1:
+            risky_lanes, rk_main, rk_leaf = risky
+        else:
+            risky_lanes = jnp.repeat(risky, self.parents)
         if self.firstn:
             sel, lres = self._resolve_firstn(cand, leaf, risky_lanes,
-                                             xl, dev_weight)
+                                             xl, dev_weight,
+                                             rk_main, rk_leaf)
         else:
             # per-parent slot room (crush_do_rule: out_size =
             # min(numrep, result_max - osize), osize advancing only
@@ -683,10 +700,19 @@ class FastRule:
                                             xl, dev_weight, room)
         sel = jnp.where(valid[:, None], sel, NONE)
         lres = lres & valid
-        residual = risky | jnp.any(lres.reshape(-1, self.parents), axis=1)
+        if self.posP > 1:
+            # mid-stage risk must survive even on INVALID lanes (their
+            # NONE may itself be the wrong answer), so OR the unmasked
+            # lane-level risk back in before the per-x rollup
+            residual = jnp.any(
+                (lres | risky_lanes).reshape(-1, self.parents), axis=1)
+        else:
+            residual = risky | jnp.any(
+                lres.reshape(-1, self.parents), axis=1)
         return sel, residual
 
-    def _resolve_firstn(self, cand, leaf, risky, x, dev_weight):
+    def _resolve_firstn(self, cand, leaf, risky, x, dev_weight,
+                        rk_main=None, rk_leaf=None):
         """firstn: slot j retries r = j + ftotal (mapper.c:493-495); leafy
         failures consume an outer retry (descend_once semantics).
 
@@ -713,6 +739,7 @@ class FastRule:
             for ftotal in range(self.n_rounds):
                 r = j + ftotal
                 item = cand[r][pos, lanes] if P > 1 else cand[r]
+                rdraw = rk_main[r][pos, lanes] if P > 1 else None
                 coll = jnp.any(outs == item[:, None], axis=1)
                 if self.leafy:
                     # first acceptable leaf attempt, if any
@@ -722,6 +749,8 @@ class FastRule:
                     for ft2 in range(self.n_leaf):
                         lf = leaf[r, ft2][pos, lanes] if P > 1 \
                             else leaf[r, ft2]
+                        if P > 1:
+                            rdraw = rdraw | rk_leaf[r, ft2][pos, lanes]
                         lcoll = jnp.any(leaves == lf[:, None], axis=1)
                         lrej = _is_out_batch(dev_weight, lf, x)
                         good = ~lok & ~lcoll & ~lrej
@@ -739,6 +768,10 @@ class FastRule:
                     ok = ~coll & ~rej
                     lsel = item
                     maybe_more = jnp.zeros((X,), dtype=bool)
+                if rdraw is not None:
+                    # a risky draw EXAMINED at this lane's position
+                    # taints everything from here on
+                    residual = residual | (rdraw & ~done)
                 take = ok & ~done & ~residual
                 outs = outs.at[:, j].set(jnp.where(take, item, outs[:, j]))
                 leaves = leaves.at[:, j].set(
