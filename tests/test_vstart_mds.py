@@ -23,7 +23,7 @@ def cluster():
     c.close()
 
 
-def _retrying(fn, timeout=45.0):
+def _retrying(fn, timeout=150.0):
     end = time.monotonic() + timeout
     while True:
         try:
@@ -88,7 +88,8 @@ def test_mds_standby_takeover(ha_cluster):
     active_idx = int(first_active.split(".")[1])
     c.kill_mds(active_idx)
     # the client's next ops ride the failover: re-resolve + retry
-    end = time.monotonic() + 90.0
+    # (generous: under an 8-worker xdist load the daemons starve)
+    end = time.monotonic() + 150.0
     while True:
         try:
             assert fs.read("/ha/f") == b"pre-failover"
