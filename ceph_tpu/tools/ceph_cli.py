@@ -80,7 +80,8 @@ def main(argv=None) -> int:
     p.add_argument("--cluster", required=True,
                    help="checkpoint directory (MiniCluster.checkpoint)")
     p.add_argument("verb", choices=["status", "health", "df", "osd",
-                                    "pg", "log", "config-key"])
+                                    "pg", "log", "config-key", "fs",
+                                    "mds"])
     p.add_argument("args", nargs="*")
     a = p.parse_args(argv)
 
@@ -99,6 +100,15 @@ def main(argv=None) -> int:
         }, indent=2))
     elif v == "health":
         print(c.health())
+    elif v in ("fs", "mds"):
+        # ceph fs status / ceph mds stat (MDSMonitor fsmap surface)
+        st = c.mon.fs_status()
+        if v == "mds" or rest[:1] == ["stat"]:
+            act = ",".join(st["active"]) or "-"
+            sby = len(st["standby"])
+            print(f"{act} up:active, {sby} up:standby")
+        else:
+            print(json.dumps(st, indent=2, sort_keys=True))
     elif v == "df":
         for pid, name in sorted(c.mon.osdmap.pool_name.items()):
             pool = c.mon.osdmap.pools[pid]

@@ -233,3 +233,27 @@ def test_ceph_osd_pool_ls_detail(tmp_path, capsys):
     assert "'plain' replicated" in out and "max_objects 10" in out
     assert "'ecp' erasure" in out and "selfmanaged_snaps" in out
     assert "ec_overwrites" in out
+
+
+def test_ceph_fs_status_and_mds_stat(tmp_path, capsys):
+    """ceph fs status / ceph mds stat surface the MDSMonitor fsmap."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.msg.messages import MMDSBeacon
+    from ceph_tpu.tools import ceph_cli
+    c = MiniCluster(n_osds=3)
+    # two daemons beacon in: first active, second standby
+    c.network.send("mds.0", c.mon.name, MMDSBeacon(name="mds.0"))
+    c.network.pump()
+    c.network.send("mds.1", c.mon.name, MMDSBeacon(name="mds.1"))
+    c.network.pump()
+    ck = str(tmp_path / "ck")
+    c.checkpoint(ck)
+    assert ceph_cli.main(["--cluster", ck, "mds", "stat"]) == 0
+    out = capsys.readouterr().out
+    assert "mds.0 up:active" in out and "1 up:standby" in out
+    assert ceph_cli.main(["--cluster", ck, "fs", "status"]) == 0
+    st = capsys.readouterr().out
+    import json as _json
+    parsed = _json.loads(st)
+    assert parsed["active"] == ["mds.0"]
+    assert parsed["standby"] == ["mds.1"]

@@ -75,3 +75,65 @@ def test_three_workloads_survive_thrashing():
     settle(c, rounds=3, dt=2.0)
     for g2, data in expectations.items():
         assert img.read(g2 * OBJ, 512) == data
+
+
+def test_mds_and_versioned_rgw_survive_thrashing():
+    """The round-4 tiers under the same thrasher: MDS-mediated cephfs
+    (caps + journal) and a VERSIONED rgw bucket keep full histories
+    through OSD kill/out/revive cycles, with an MDS crash-replay in
+    the middle."""
+    from ceph_tpu.cephfs.mds_client import RemoteCephFS
+    from ceph_tpu.mds import MDSDaemon
+    c = MiniCluster(n_osds=6)
+    for p in ("fsmeta", "fsdata", "rgwmeta"):
+        c.create_replicated_pool(p, size=3, pg_num=8)
+    c.create_ec_pool("rgwdata", k=2, m=1, plugin="isa", pg_num=8)
+
+    mds = MDSDaemon(c.network, c.client("client.mds"), "mds.0",
+                    mkfs=True)
+    fs = RemoteCephFS(c.client("client.f"))
+    fs._drive = lambda: mds.process()
+    g = RGWLite(c.client("client.g"), "rgwmeta", "rgwdata")
+    g.create_user("app")
+    g.create_bucket("app", "b")
+    g.put_bucket_versioning("b", "enabled")
+    fs.mkdir("/d")
+
+    history = []
+    for gen, victim in enumerate([2, 5]):
+        payload = bytes([97 + gen]) * 256
+        fs.create(f"/d/f{gen}")
+        fs.write(f"/d/f{gen}", payload, 0)
+        v = g.put_object("b", "doc", payload)     # new VERSION each gen
+        history.append((v["vid"], payload))
+
+        c.kill_osd(victim)
+        settle(c)
+        c.mark_osd_out(victim)
+        settle(c, rounds=5, dt=2.0)
+
+        # degraded reads: every fs file and every rgw VERSION
+        for g2 in range(gen + 1):
+            assert fs.read(f"/d/f{g2}") == bytes([97 + g2]) * 256
+        for vid, data in history:
+            assert g.get_object("b", "doc", version_id=vid) == data
+
+        if gen == 0:
+            # crash the MDS mid-run: a fresh incarnation replays and
+            # the same namespace serves on
+            mds = MDSDaemon(c.network, c.client("client.mds2"),
+                            "mds.0")
+            fs._drive = lambda: mds.process()
+            assert fs.read("/d/f0") == bytes([97]) * 256
+
+        c.revive_osd(victim)
+        c.mon.mark_osd_in(victim)
+        c.publish()
+        settle(c, rounds=5, dt=2.0)
+
+    assert sorted(fs.listdir("/d")) == ["f0", "f1"]
+    vers = [v for v in g.list_object_versions("b") if v["key"] == "doc"]
+    assert len(vers) == 2 and vers[0]["is_latest"]
+    assert not any(mds.fs.fsck().values())
+    assert g.gc() == {"orphan_objects": [], "stale_pending": []}
+    assert c.health().startswith("HEALTH")
