@@ -62,25 +62,51 @@ class CrushCompiler:
 
     # ---- decompile ---------------------------------------------------------
     def decompile(self) -> str:
+        """Reference-exact text form (CrushCompiler::decompile): the
+        tunable lines appear only when they differ from LEGACY
+        defaults, weights print at the reference's 3-decimal
+        fixedpoint, buckets carry the advisory comments, rules use the
+        'id N' header — recorded reference decompiles (multitype.after,
+        add-item.t) and ours compare byte-for-byte."""
         cw = self.crush
         m = cw.crush
         out: List[str] = ["# begin crush map"]
+        legacy = {"choose_local_tries": 2,
+                  "choose_local_fallback_tries": 5,
+                  "choose_total_tries": 19,
+                  "chooseleaf_descend_once": 0,
+                  "chooseleaf_vary_r": 0,
+                  "chooseleaf_stable": 0,
+                  "straw_calc_version": 0,
+                  # CRUSH_LEGACY_ALLOWED_BUCKET_ALGS (crush.h:198)
+                  "allowed_bucket_algs": (1 << 1) | (1 << 2) | (1 << 4)}
         for t in TUNABLES:
-            out.append(f"tunable {t} {getattr(m, t)}")
+            v = getattr(m, t, legacy[t])
+            if v != legacy[t]:
+                out.append(f"tunable {t} {v}")
         out.append("")
         out.append("# devices")
         for d in range(m.max_devices):
-            name = cw.name_map.get(d, f"osd.{d}")
+            name = cw.name_map.get(d)
+            if name is None:
+                continue
             cls = cw.item_class.get(d)
-            suffix = f" class {cw.class_map[cls]}" if cls is not None else ""
+            suffix = f" class {cw.class_map[cls]}" \
+                if cls is not None else ""
             out.append(f"device {d} {name}{suffix}")
         out.append("")
         out.append("# types")
+        if cw.type_map and 0 not in cw.type_map:
+            out.append("type 0 osd")
         for t in sorted(cw.type_map):
             out.append(f"type {t} {cw.type_map[t]}")
         out.append("")
         out.append("# buckets")
-        # emit leaves-first so items are defined before use
+
+        def item_name(it: int) -> str:
+            return cw.name_map.get(
+                it, f"device{it}" if it >= 0 else f"bucket{-1 - it}")
+
         emitted = set()
 
         def emit_bucket(bid: int):
@@ -89,25 +115,52 @@ class CrushCompiler:
             b = m.bucket(bid)
             if b is None:
                 return
+            emitted.add(bid)
             for it in b.items:
                 if it < 0:
                     emit_bucket(it)
-            emitted.add(bid)
             tname = cw.type_map.get(b.type, f"type{b.type}")
-            name = cw.name_map.get(bid, f"bucket{bid}")
-            out.append(f"{tname} {name} {{")
-            out.append(f"\tid {bid}")
-            out.append(f"\talg {ALG_NAMES.get(b.alg, b.alg)}")
+            out.append(f"{tname} {item_name(bid)} {{")
+            out.append(f"\tid {bid}\t\t# do not change unnecessarily")
+            for cls, cid in sorted(
+                    cw.class_bucket.get(bid, {}).items()):
+                out.append(f"\tid {cid} class {cw.class_map[cls]}"
+                           f"\t\t# do not change unnecessarily")
+            out.append(f"\t# weight {b.weight / 0x10000:.3f}")
+            alg = ALG_NAMES.get(b.alg, str(b.alg))
+            note = ""
+            dopos = False
+            from .constants import (
+                CRUSH_BUCKET_LIST, CRUSH_BUCKET_TREE,
+                CRUSH_BUCKET_UNIFORM)
+            if b.alg == CRUSH_BUCKET_UNIFORM:
+                note = ("\t# do not change bucket size "
+                        f"({b.size}) unnecessarily")
+                dopos = True
+            elif b.alg == CRUSH_BUCKET_LIST:
+                note = ("\t# add new items at the end; "
+                        "do not change order unnecessarily")
+            elif b.alg == CRUSH_BUCKET_TREE:
+                note = ("\t# do not change pos for existing "
+                        "items unnecessarily")
+                dopos = True
+            out.append(f"\talg {alg}{note}")
             out.append("\thash 0\t# rjenkins1")
             ws = getattr(b, "item_weights", None)
             for i, it in enumerate(b.items):
-                iname = cw.name_map.get(
-                    it, f"osd.{it}" if it >= 0 else f"bucket{it}")
-                if ws is not None and i < len(ws):
-                    out.append(f"\titem {iname} weight "
-                               f"{ws[i] / 0x10000:.5f}")
+                if b.alg == CRUSH_BUCKET_UNIFORM:
+                    w = b.item_weight
+                elif b.alg == CRUSH_BUCKET_TREE:
+                    # tree stores weights at the leaf NODES
+                    # (crush_calc_tree_node)
+                    w = b.node_weights[((i + 1) << 1) - 1]
+                elif ws is not None and i < len(ws):
+                    w = ws[i]
                 else:
-                    out.append(f"\titem {iname}")
+                    w = 0
+                pos = f" pos {i}" if dopos else ""
+                out.append(f"\titem {item_name(it)} weight "
+                           f"{w / 0x10000:.3f}{pos}")
             out.append("}")
 
         for b in m.buckets:
@@ -120,7 +173,11 @@ class CrushCompiler:
                 continue
             rname = cw.rule_name_map.get(rno, f"rule-{rno}")
             out.append(f"rule {rname} {{")
-            out.append(f"\truleset {rule.ruleset}")
+            out.append(f"\tid {rno}")
+            if rule.ruleset != rno:
+                out.append(f"\t# WARNING: ruleset {rule.ruleset} != "
+                           f"id {rno}; this will not recompile to the "
+                           f"same map")
             out.append(f"\ttype "
                        f"{RULE_TYPE_NAMES.get(rule.type, rule.type)}")
             out.append(f"\tmin_size {rule.min_size}")

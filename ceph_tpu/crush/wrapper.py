@@ -153,10 +153,19 @@ class CrushWrapper:
         return None
 
     def _bucket_link(self, parent_id: int, item: int, weight: int) -> None:
+        """Append an item and REBUILD the bucket: every alg's derived
+        structure (list sums, straw scalers, tree nodes) must track the
+        membership change or the binary codec writes inconsistent
+        arrays (caught by add-item.t on a straw-v1 map)."""
         b = self.crush.bucket(parent_id)
-        b.items.append(item)
-        b.item_weights.append(weight)
-        self._propagate(parent_id, weight)
+        before = b.weight
+        ws = self._bucket_weights(b)
+        self.rebuild_bucket(parent_id, list(b.items) + [item],
+                            ws + [weight])
+        # uniform parents derive their weight from item_weight*size,
+        # not the requested weight: ripple what actually changed
+        self._propagate_above(
+            parent_id, self.crush.bucket(parent_id).weight - before)
 
     def _bucket_unlink(self, item: int) -> int:
         """Detach *item* from its parent; returns its weight there."""
@@ -164,20 +173,28 @@ class CrushWrapper:
         if p is None:
             return 0
         idx = p.items.index(item)
-        w = p.item_weights.pop(idx)
-        p.items.pop(idx)
-        self._propagate(p.id, -w)
+        before = p.weight
+        ws = self._bucket_weights(p)
+        w = ws[idx]
+        items = list(p.items)
+        del items[idx]
+        del ws[idx]
+        self.rebuild_bucket(p.id, items, ws)
+        self._propagate_above(p.id,
+                              self.crush.bucket(p.id).weight - before)
         return w
 
-    def _propagate(self, bucket_id: int, delta: int) -> None:
-        """Apply a weight delta to a bucket and every ancestor."""
-        b = self.crush.bucket(bucket_id)
-        b.weight += delta
+    def _propagate_above(self, bucket_id: int, delta: int) -> None:
+        """Apply a weight delta to every ANCESTOR of bucket_id (its own
+        weight was already re-derived by rebuild_bucket)."""
         p = self._parent_of(bucket_id)
-        if p is not None:
-            idx = p.items.index(bucket_id)
-            p.item_weights[idx] += delta
-            self._propagate(p.id, delta)
+        if p is None or not delta:
+            return
+        idx = p.items.index(bucket_id)
+        ws = self._bucket_weights(p)
+        ws[idx] += delta
+        self.rebuild_bucket(p.id, list(p.items), ws)
+        self._propagate_above(p.id, delta)
 
     def create_or_move_item(self, item: int, weight: int, name: str,
                             loc) -> None:
@@ -215,6 +232,84 @@ class CrushWrapper:
         w = self.crush.bucket(bid).weight
         self._bucket_unlink(bid)
         self._bucket_link(leaf, bid, w)
+
+    def get_default_bucket_alg(self) -> int:
+        """Preference order over allowed_bucket_algs
+        (CrushWrapper::get_default_bucket_alg)."""
+        from .constants import (
+            CRUSH_BUCKET_LIST, CRUSH_BUCKET_STRAW, CRUSH_BUCKET_STRAW2,
+            CRUSH_BUCKET_TREE, CRUSH_BUCKET_UNIFORM)
+        allowed = getattr(self.crush, "allowed_bucket_algs", 0)
+        for alg in (CRUSH_BUCKET_STRAW2, CRUSH_BUCKET_STRAW,
+                    CRUSH_BUCKET_TREE, CRUSH_BUCKET_LIST,
+                    CRUSH_BUCKET_UNIFORM):
+            if allowed & (1 << alg):
+                return alg
+        return CRUSH_BUCKET_STRAW2
+
+    def _bucket_item_weight(self, b, idx: int) -> int:
+        from .constants import CRUSH_BUCKET_TREE, CRUSH_BUCKET_UNIFORM
+        if b.alg == CRUSH_BUCKET_UNIFORM:
+            return b.item_weight
+        if b.alg == CRUSH_BUCKET_TREE:
+            return b.node_weights[((idx + 1) << 1) - 1]
+        return b.item_weights[idx]
+
+    def _bucket_weights(self, b) -> list:
+        return [self._bucket_item_weight(b, i)
+                for i in range(len(b.items))]
+
+    def _set_item_weight_in(self, bid: int, item: int,
+                            weight: int) -> int:
+        """Set *item*'s weight inside bucket *bid*, REBUILDING the
+        bucket so every alg's derived structure (list sums, straw
+        scalers, tree nodes) stays consistent; returns the bucket's
+        weight delta.  Uniform buckets reweight EVERY item (the
+        reference's crush_adjust_uniform_bucket_item_weight returns
+        diff * size)."""
+        from .constants import CRUSH_BUCKET_UNIFORM
+        b = self.crush.bucket(bid)
+        idx = b.items.index(item)
+        if b.alg == CRUSH_BUCKET_UNIFORM:
+            old_w = b.item_weight
+            self.rebuild_bucket(bid, list(b.items),
+                                [weight] * len(b.items))
+            return (weight - old_w) * len(b.items)
+        ws = self._bucket_weights(b)
+        delta = weight - ws[idx]
+        ws[idx] = weight
+        self.rebuild_bucket(bid, list(b.items), ws)
+        return delta
+
+    def adjust_item_weight(self, item: int, weight: int) -> int:
+        """Adjust *item*'s weight wherever it lives and propagate the
+        change up every ancestor chain (CrushWrapper::
+        adjust_item_weight): ancestors are REBUILT too, so straw
+        scalers and tree nodes re-derive.  Returns buckets changed."""
+        changed = 0
+        for b in list(self.crush.buckets):
+            if b is None or item not in b.items:
+                continue
+            delta = self._set_item_weight_in(b.id, item, weight)
+            changed += 1
+            # ripple the new total up the chain
+            cur = b.id
+            while delta:
+                parent = self._parent_of(cur)
+                if parent is None:
+                    break
+                new_w = self.crush.bucket(cur).weight
+                self._set_item_weight_in(parent.id, cur, new_w)
+                cur = parent.id
+                changed += 1
+        return changed
+
+    def remove_item(self, item: int) -> None:
+        """Detach a device from every bucket (+ ancestor reweight) and
+        drop its name (CrushWrapper::remove_item)."""
+        while self._parent_of(item) is not None:
+            self._bucket_unlink(item)
+        self.name_map.pop(item, None)
 
     def get_loc(self, item: int) -> list:
         """[(type_name, bucket_name), ...] from the item up to its root

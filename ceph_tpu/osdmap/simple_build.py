@@ -58,11 +58,16 @@ def parse_conf_sections(text: str) -> Dict[str, Dict[str, str]]:
 
 def insert_item(cw: CrushWrapper, item: int, weight: int, name: str,
                 loc: Dict[str, str]) -> None:
-    """CrushWrapper::insert_item at 16.16 fixed weight."""
+    """CrushWrapper::insert_item at 16.16 fixed weight.  Walks the
+    map's OWN type table ascending (the reference iterates type_map);
+    missing ancestors are created with the map's default bucket alg
+    (straw2 under jewel+, straw on legacy maps)."""
+    alg = cw.get_default_bucket_alg()
     if not cw.name_exists(name):
         cw.set_item_name(item, name)
     cur = item
-    for t, tname in CRUSH_TYPES:
+    for t in sorted(cw.type_map):
+        tname = cw.type_map[t]
         if t == 0:
             continue
         bname = loc.get(tname)
@@ -70,7 +75,7 @@ def insert_item(cw: CrushWrapper, item: int, weight: int, name: str,
             continue
         if not cw.name_exists(bname):
             # create the ancestor CONTAINING the cursor, weight 0
-            newid = cw.add_bucket(CRUSH_BUCKET_STRAW2, t, bname,
+            newid = cw.add_bucket(alg, t, bname,
                                   [cur], [0])
             cur = newid
             continue
@@ -83,12 +88,11 @@ def insert_item(cw: CrushWrapper, item: int, weight: int, name: str,
     else:
         raise ValueError(f"nowhere to add item {item} in {loc}")
     # adjust_item_weightf_in_loc: set the device's weight where it
-    # lives and propagate the delta to every ancestor
+    # lives (REBUILDING the bucket's derived arrays) and ripple the
+    # actual delta to every ancestor
     p = cw._parent_of(item)
-    idx = p.items.index(item)
-    delta = weight - p.item_weights[idx]
-    p.item_weights[idx] = weight
-    cw._propagate(p.id, delta)
+    delta = cw._set_item_weight_in(p.id, item, weight)
+    cw._propagate_above(p.id, delta)
     if item >= cw.crush.max_devices:
         cw.crush.max_devices = item + 1
 

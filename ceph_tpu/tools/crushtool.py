@@ -62,6 +62,13 @@ def main(argv=None) -> int:
     p.add_argument("--set-chooseleaf-vary-r", type=int, default=None)
     p.add_argument("--set-chooseleaf-stable", type=int, default=None)
     p.add_argument("--set-straw-calc-version", type=int, default=None)
+    p.add_argument("--add-item", nargs=3, metavar=("ID", "W", "NAME"))
+    p.add_argument("--loc", nargs=2, action="append", default=[],
+                   metavar=("TYPE", "NAME"))
+    p.add_argument("--reweight-item", nargs=2, metavar=("NAME", "W"))
+    p.add_argument("--remove-item", metavar="NAME")
+    p.add_argument("--create-simple-rule", nargs=4,
+                   metavar=("NAME", "ROOT", "TYPE", "MODE"))
     p.add_argument("--build", action="store_true",
                    help="build a layered map: --num_osds N "
                         "(name alg size)...")
@@ -166,6 +173,39 @@ def main(argv=None) -> int:
                 f.write(text)
         else:
             sys.stdout.write(text)
+        return 0
+
+    if args.add_item or args.reweight_item or args.remove_item \
+            or args.create_simple_rule:
+        # map-editing verbs (crushtool.cc --add-item/--reweight-item/
+        # --remove-item/--create-simple-rule)
+        if not args.infn:
+            print("map edits require -i <map>", file=sys.stderr)
+            return 1
+        cw = load_map(args.infn)
+        if args.add_item:
+            from ..osdmap.simple_build import insert_item
+            dev, w, name = args.add_item
+            loc = {t: n for t, n in args.loc}
+            insert_item(cw, int(dev),
+                        int(round(float(w) * 0x10000)), name, loc)
+        if args.reweight_item:
+            name, w = args.reweight_item
+            cw.adjust_item_weight(cw.get_item_id(name),
+                                  int(round(float(w) * 0x10000)))
+        if args.remove_item:
+            cw.remove_item(cw.get_item_id(args.remove_item))
+        if args.create_simple_rule:
+            rname, root, ftype, mode = args.create_simple_rule
+            cw.add_simple_rule(rname, root_name=root,
+                               failure_domain_name=ftype, mode=mode)
+        if not args.outfn:
+            # the reference never writes edits in place
+            # (crushtool.cc: "use -o <file> to write it out")
+            print("edited map not written; use -o <file> to write "
+                  "it out", file=sys.stderr)
+            return 0
+        save_map(cw, args.outfn)
         return 0
 
     if args.test:

@@ -120,3 +120,64 @@ def test_crushtool_choose_args_roundtrip():
     # and the binary codec round-trips the args structurally
     cw3 = decode_crushmap(bin_a)
     assert cw3.crush.choose_args[6][2].ids == [-20, -30, -25]
+
+
+def test_crushtool_reweight_t_byte_exact(tmp_path):
+    """reweight.t: compile multitype.before (uniform/list/tree/straw
+    buckets), apply the four recorded --reweight-item ops, decompile —
+    the output must equal multitype.after byte-for-byte (the cram's
+    `diff final multitype.after`)."""
+    d = "/root/reference/src/test/cli/crushtool"
+    mt = str(tmp_path / "mt")
+    assert crushtool.main(["-c", f"{d}/multitype.before",
+                           "-o", mt]) == 0
+    for name, w in [("osd0", "2.0"), ("osd3", "2.0"),
+                    ("osd6", "2.0"), ("osd7", ".5")]:
+        assert crushtool.main(["-i", mt, "--reweight-item", name, w,
+                               "-o", mt]) == 0
+    final = str(tmp_path / "final")
+    assert crushtool.main(["-d", mt, "-o", final]) == 0
+    assert open(final).read() == open(f"{d}/multitype.after").read()
+
+
+def _cram_expected_decompile(tname: str) -> str:
+    """The recorded `crushtool -d` output block from a cram file,
+    unescaped (cram's '\\t...(esc)' notation)."""
+    lines = open("/root/reference/src/test/cli/crushtool/"
+                 + tname).read().splitlines()
+    start = next(i for i, ln in enumerate(lines)
+                 if ln.strip().startswith("$ crushtool -d"))
+    out = []
+    for ln in lines[start + 1:]:
+        if ln.startswith("  $ ") or not ln.startswith("  "):
+            break
+        body = ln[2:]
+        if body.endswith(" (esc)"):
+            body = body[:-6].replace("\\t", "\t")
+        out.append(body)
+    return "\n".join(out) + "\n"
+
+
+def test_crushtool_add_item_t_byte_exact(tmp_path):
+    """add-item.t: start from the reference's binary simple.template,
+    --add-item two devices with --loc chains, --create-simple-rule,
+    decompile — byte-for-byte against the cram's recorded output."""
+    d = "/root/reference/src/test/cli/crushtool"
+    one = str(tmp_path / "one")
+    two = str(tmp_path / "two")
+    assert crushtool.main(["-i", f"{d}/simple.template",
+                           "--add-item", "0", "1.0", "device0",
+                           "--loc", "host", "host0",
+                           "--loc", "cluster", "cluster0",
+                           "-o", one]) == 0
+    assert crushtool.main(["-i", one,
+                           "--add-item", "1", "1.0", "device1",
+                           "--loc", "host", "host0",
+                           "--loc", "cluster", "cluster0",
+                           "-o", two]) == 0
+    assert crushtool.main(["-i", two, "--create-simple-rule",
+                           "simple-rule", "cluster0", "host", "firstn",
+                           "-o", two]) == 0
+    out = str(tmp_path / "out")
+    assert crushtool.main(["-d", two, "-o", out]) == 0
+    assert open(out).read() == _cram_expected_decompile("add-item.t")
