@@ -27,7 +27,7 @@ def cluster():
     c.close()
 
 
-def _snap_create_retrying(c, cl, timeout=45.0):
+def _snap_create_retrying(c, cl, timeout=120.0):
     """selfmanaged_snap_create through the wire-command path, retried
     across election windows; returns the acked snap id."""
     end = time.monotonic() + timeout
@@ -84,7 +84,7 @@ def test_three_mons_leader_sigkill_recovers(cluster):
     # resumes; the first post-failover allocation must be STRICTLY
     # ABOVE every pre-kill ack — if collect/LAST recovery had lost a
     # committed value, the fresh leader would re-issue an old id
-    post_id = _snap_create_retrying(c, cl, timeout=60.0)
+    post_id = _snap_create_retrying(c, cl, timeout=150.0)
     assert post_id > max(pre_ids), (pre_ids, post_id)
 
     # both survivors converge on one committed state: subscribe a
