@@ -404,6 +404,7 @@ class CrushCompiler:
                     i: int) -> int:
         toks = lines[i].split()
         name = toks[1]
+        self._rule_name = name
         ruleset = -1
         rtype = PG_POOL_TYPE_REPLICATED
         min_size, max_size = 1, 10
@@ -434,8 +435,17 @@ class CrushCompiler:
 
     def _parse_step(self, cw: CrushWrapper, t: List[str]) -> RuleStep:
         if t[0] == "take":
-            item = int(t[1][4:]) if t[1].startswith("osd.") \
-                else cw.get_item_id(t[1])
+            if t[1].startswith("osd."):
+                item = int(t[1][4:])
+            else:
+                try:
+                    item = cw.get_item_id(t[1])
+                except KeyError:
+                    # the reference's diagnostic, verbatim
+                    # (CrushCompiler::parse_step_take)
+                    raise ValueError(
+                        f"in rule '{self._rule_name}' item "
+                        f"'{t[1]}' not defined") from None
             return RuleStep(CRUSH_RULE_TAKE, item, 0)
         if t[0] == "emit":
             return RuleStep(CRUSH_RULE_EMIT, 0, 0)

@@ -181,3 +181,29 @@ def test_crushtool_add_item_t_byte_exact(tmp_path):
     out = str(tmp_path / "out")
     assert crushtool.main(["-d", two, "-o", out]) == 0
     assert open(out).read() == _cram_expected_decompile("add-item.t")
+
+
+def test_crushtool_compile_decompile_recompile_t(tmp_path):
+    """compile-decompile-recompile.t: need_tree_order.crush is itself
+    a recorded decompile — our decompile must reproduce it (comments
+    and all) and the binary encoding must be deterministic; a rule
+    taking an undefined bucket fails with the reference's diagnostic."""
+    from ceph_tpu.crush.compiler import CrushCompiler
+    d = "/root/reference/src/test/cli/crushtool"
+    src = open(f"{d}/need_tree_order.crush").read()
+    nto = str(tmp_path / "nto.compiled")
+    conf = str(tmp_path / "nto.conf")
+    reco = str(tmp_path / "nto.recompiled")
+    srcf = str(tmp_path / "need_tree_order.crush")
+    open(srcf, "w").write(src)
+    assert crushtool.main(["-c", srcf, "-o", nto]) == 0
+    assert crushtool.main(["-d", nto, "-o", conf]) == 0
+    assert crushtool.main(["-c", conf, "-o", reco]) == 0
+    assert open(conf).read() == src                     # cmp 1
+    assert open(nto, "rb").read() == open(reco, "rb").read()  # cmp 2
+    # missing-bucket.crushmap.txt: the recorded diagnostic
+    with pytest.raises(ValueError) as ei:
+        CrushCompiler().compile(
+            open(f"{d}/missing-bucket.crushmap.txt").read())
+    assert str(ei.value) == "in rule 'rule-bad' item 'root-404' " \
+        "not defined"
