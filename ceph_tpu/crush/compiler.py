@@ -116,6 +116,8 @@ class CrushCompiler:
             if b is None:
                 return
             emitted.add(bid)
+            if "~" in cw.name_map.get(bid, ""):
+                return              # shadow trees are implementation
             for it in b.items:
                 if it < 0:
                     emit_bucket(it)
@@ -220,6 +222,10 @@ class CrushCompiler:
         cw = self.crush
         op = step.op
         if op == CRUSH_RULE_TAKE:
+            orig, c = cw.split_id_class(step.arg1)
+            if c is not None:
+                return (f"step take {cw.name_map.get(orig, orig)} "
+                        f"class {cw.class_map[c]}")
             return f"step take {cw.name_map.get(step.arg1, step.arg1)}"
         if op == CRUSH_RULE_EMIT:
             return "step emit"
@@ -285,6 +291,17 @@ class CrushCompiler:
                 raise ValueError(f"cannot parse line: {line!r}")
         cw.set_max_devices(max_dev)
         self._build_buckets(cw, pending_buckets)
+        if cw.item_class:
+            # shadow class trees exist from the moment the map is
+            # complete (finalize/rebuild_roots_with_classes): rules
+            # may 'take X class Y' and the binary carries the shadows.
+            # Decompiled maps pin their shadow ids in 'id N class C'
+            # lines; honor them so the round-trip keeps ids stable.
+            pins = {}
+            for spec in pending_buckets:
+                for cname, sid in spec.get("class_ids", {}).items():
+                    pins[(spec["name"], cname)] = sid
+            cw.rebuild_roots_with_classes(pins)
         for start in rule_starts:
             self._parse_rule(cw, lines, start)
         self._install_choose_args(cw)
@@ -354,13 +371,21 @@ class CrushCompiler:
                       pending: List[dict]) -> int:
         toks = lines[i].split()
         btype, name = toks[0], toks[1]
+        if "~" in name:
+            # the reference grammar rejects '~' in names — it marks
+            # shadow (per-class) buckets, which are never declared
+            raise ValueError(f"invalid crush name '{name}'")
         spec = {"type": btype, "name": name, "id": None,
                 "alg": "straw2", "items": []}
         i += 1
         while i < len(lines) and lines[i] != "}":
             t = lines[i].split()
             if t[0] == "id":
-                spec["id"] = int(t[1])
+                if len(t) >= 4 and t[2] == "class":
+                    # a decompiled shadow-id pin: 'id -4 class ssd'
+                    spec.setdefault("class_ids", {})[t[3]] = int(t[1])
+                else:
+                    spec["id"] = int(t[1])
             elif t[0] == "alg":
                 spec["alg"] = t[1]
             elif t[0] == "hash":
@@ -446,6 +471,19 @@ class CrushCompiler:
                     raise ValueError(
                         f"in rule '{self._rule_name}' item "
                         f"'{t[1]}' not defined") from None
+            if len(t) >= 4 and t[2] == "class":
+                cls = t[3]
+                if not cw.class_exists(cls):
+                    raise ValueError(
+                        f"in rule '{self._rule_name}' class "
+                        f"'{cls}' not defined")
+                c = cw.get_or_create_class_id(cls)
+                shadow = cw.class_bucket.get(item, {}).get(c)
+                if shadow is None:
+                    raise ValueError(
+                        f"in rule '{self._rule_name}' no class "
+                        f"'{cls}' tree under '{t[1]}'")
+                item = shadow
             return RuleStep(CRUSH_RULE_TAKE, item, 0)
         if t[0] == "emit":
             return RuleStep(CRUSH_RULE_EMIT, 0, 0)

@@ -210,6 +210,8 @@ class CrushWrapper:
         self.set_item_name(item, name)
         if item >= self.crush.max_devices:
             self.crush.max_devices = item + 1
+        if self.item_class:
+            self.rebuild_roots_with_classes()
 
     def move_bucket(self, name: str, loc) -> None:
         """Re-home an existing bucket under a new location chain
@@ -232,6 +234,8 @@ class CrushWrapper:
         w = self.crush.bucket(bid).weight
         self._bucket_unlink(bid)
         self._bucket_link(leaf, bid, w)
+        if self.item_class:
+            self.rebuild_roots_with_classes()
 
     def get_default_bucket_alg(self) -> int:
         """Preference order over allowed_bucket_algs
@@ -310,6 +314,76 @@ class CrushWrapper:
         while self._parent_of(item) is not None:
             self._bucket_unlink(item)
         self.name_map.pop(item, None)
+        if self.item_class:
+            self.item_class.pop(item, None)
+            self.rebuild_roots_with_classes()
+
+    def rebuild_roots_with_classes(self, pins=None) -> None:
+        """(Re)build the per-class SHADOW trees (CrushWrapper::
+        rebuild_roots_with_classes): for every non-shadow root and
+        every device class, clone the tree keeping only that class's
+        devices.  Shadow buckets are named '<orig>~<class>' (invalid
+        crush names, so decompile hides them as 'id N class C'
+        comments) and recorded in class_bucket[orig][class] — the take
+        target for class-scoped rules."""
+        # destroy existing shadows first (idempotent rebuild)
+        for b in list(self.crush.buckets):
+            if b is None:
+                continue
+            if "~" in self.name_map.get(b.id, ""):
+                self.crush.buckets[-1 - b.id] = None
+                self.name_map.pop(b.id, None)
+        self.class_bucket = {}
+        if not self.item_class or not self.class_map:
+            return
+        roots = sorted(
+            b.id for b in self.crush.buckets if b is not None
+            and self._parent_of(b.id) is None)
+        self._shadow_pins = pins or {}
+        for r in roots:                      # set<int> ascending
+            for c in sorted(self.class_map):  # class id ascending
+                self._device_class_clone(r, c)
+        self._shadow_pins = {}
+
+    def _device_class_clone(self, oid: int, c: int) -> int:
+        """DFS child-first clone (CrushWrapper::device_class_clone):
+        devices of other classes are dropped; child BUCKET clones are
+        kept even when empty; ids take the lowest free slots in
+        creation order (which the recorded goldens pin)."""
+        name = f"{self.name_map[oid]}~{self.class_map[c]}"
+        if self.name_exists(name):
+            return self.get_item_id(name)
+        b = self.crush.bucket(oid)
+        items: list = []
+        weights: list = []
+        for i, it in enumerate(b.items):
+            w = self._bucket_item_weight(b, i)
+            if it >= 0:
+                if self.item_class.get(it) == c:
+                    items.append(it)
+                    weights.append(w)
+            else:
+                cid = self._device_class_clone(it, c)
+                items.append(cid)
+                weights.append(self.crush.bucket(cid).weight)
+        # a decompiled text map pins shadow ids in its 'id N class C'
+        # lines: honor them so class-bearing maps round-trip with
+        # stable ids (the reference parses those lines the same way)
+        pin = getattr(self, "_shadow_pins", {}).get(
+            (self.name_map[oid], self.class_map[c]), 0)
+        nid = self.add_bucket(b.alg, b.type, name, items, weights,
+                              id=pin)
+        self.class_bucket.setdefault(oid, {})[c] = nid
+        return nid
+
+    def split_id_class(self, bid: int):
+        """Shadow id -> (original id, class id); (bid, None) when not
+        a shadow (CrushWrapper::split_id_class)."""
+        for orig, per_class in self.class_bucket.items():
+            for c, shadow in per_class.items():
+                if shadow == bid:
+                    return orig, c
+        return bid, None
 
     def get_loc(self, item: int) -> list:
         """[(type_name, bucket_name), ...] from the item up to its root

@@ -69,6 +69,9 @@ def main(argv=None) -> int:
     p.add_argument("--remove-item", metavar="NAME")
     p.add_argument("--create-simple-rule", nargs=4,
                    metavar=("NAME", "ROOT", "TYPE", "MODE"))
+    p.add_argument("--create-replicated-rule", nargs=3,
+                   metavar=("NAME", "ROOT", "TYPE"))
+    p.add_argument("--device-class", default="")
     p.add_argument("--build", action="store_true",
                    help="build a layered map: --num_osds N "
                         "(name alg size)...")
@@ -152,6 +155,60 @@ def main(argv=None) -> int:
         save_map(cw, out)
         return 0
 
+    if args.add_item or args.reweight_item or args.remove_item \
+            or args.create_simple_rule or args.create_replicated_rule:
+        # map-editing verbs (crushtool.cc --add-item/--reweight-item/
+        # --remove-item/--create-simple-rule)
+        if args.srcfn and args.infn:
+            print("give either -c <text> or -i <map>, not both",
+                  file=sys.stderr)
+            return 1
+        if args.srcfn:
+            # the reference accepts -c source + edit verbs in one run
+            with open(args.srcfn) as f:
+                cw = CrushCompiler().compile(f.read())
+            apply_tunable_flags(cw.crush)
+        elif args.infn:
+            cw = load_map(args.infn)
+        else:
+            print("map edits require -i <map> or -c <text>",
+                  file=sys.stderr)
+            return 1
+        if args.add_item:
+            from ..osdmap.simple_build import insert_item
+            dev, w, name = args.add_item
+            loc = {t: n for t, n in args.loc}
+            insert_item(cw, int(dev),
+                        int(round(float(w) * 0x10000)), name, loc)
+        if args.reweight_item:
+            name, w = args.reweight_item
+            cw.adjust_item_weight(cw.get_item_id(name),
+                                  int(round(float(w) * 0x10000)))
+        if args.remove_item:
+            cw.remove_item(cw.get_item_id(args.remove_item))
+        if args.create_simple_rule:
+            rname, root, ftype, mode = args.create_simple_rule
+            cw.add_simple_rule(rname, root_name=root,
+                               failure_domain_name=ftype, mode=mode)
+        if args.create_replicated_rule:
+            rname, root, ftype = args.create_replicated_rule
+            r = cw.add_simple_rule(rname, root_name=root,
+                                   failure_domain_name=ftype,
+                                   device_class=args.device_class,
+                                   mode="firstn")
+            if r < 0:
+                print(f"create-replicated-rule failed: {r}",
+                      file=sys.stderr)
+                return 1
+        if not args.outfn:
+            # the reference never writes edits in place
+            # (crushtool.cc: "use -o <file> to write it out")
+            print("edited map not written; use -o <file> to write "
+                  "it out", file=sys.stderr)
+            return 0
+        save_map(cw, args.outfn)
+        return 0
+
     if args.srcfn:
         with open(args.srcfn) as f:
             text = f.read()
@@ -173,39 +230,6 @@ def main(argv=None) -> int:
                 f.write(text)
         else:
             sys.stdout.write(text)
-        return 0
-
-    if args.add_item or args.reweight_item or args.remove_item \
-            or args.create_simple_rule:
-        # map-editing verbs (crushtool.cc --add-item/--reweight-item/
-        # --remove-item/--create-simple-rule)
-        if not args.infn:
-            print("map edits require -i <map>", file=sys.stderr)
-            return 1
-        cw = load_map(args.infn)
-        if args.add_item:
-            from ..osdmap.simple_build import insert_item
-            dev, w, name = args.add_item
-            loc = {t: n for t, n in args.loc}
-            insert_item(cw, int(dev),
-                        int(round(float(w) * 0x10000)), name, loc)
-        if args.reweight_item:
-            name, w = args.reweight_item
-            cw.adjust_item_weight(cw.get_item_id(name),
-                                  int(round(float(w) * 0x10000)))
-        if args.remove_item:
-            cw.remove_item(cw.get_item_id(args.remove_item))
-        if args.create_simple_rule:
-            rname, root, ftype, mode = args.create_simple_rule
-            cw.add_simple_rule(rname, root_name=root,
-                               failure_domain_name=ftype, mode=mode)
-        if not args.outfn:
-            # the reference never writes edits in place
-            # (crushtool.cc: "use -o <file> to write it out")
-            print("edited map not written; use -o <file> to write "
-                  "it out", file=sys.stderr)
-            return 0
-        save_map(cw, args.outfn)
         return 0
 
     if args.test:

@@ -140,13 +140,14 @@ def test_crushtool_reweight_t_byte_exact(tmp_path):
     assert open(final).read() == open(f"{d}/multitype.after").read()
 
 
-def _cram_expected_decompile(tname: str) -> str:
+def _cram_expected_decompile(tname: str, nth: int = 0) -> str:
     """The recorded `crushtool -d` output block from a cram file,
     unescaped (cram's '\\t...(esc)' notation)."""
     lines = open("/root/reference/src/test/cli/crushtool/"
                  + tname).read().splitlines()
-    start = next(i for i, ln in enumerate(lines)
-                 if ln.strip().startswith("$ crushtool -d"))
+    starts = [i for i, ln in enumerate(lines)
+              if ln.strip().startswith("$ crushtool -d")]
+    start = starts[nth]
     out = []
     for ln in lines[start + 1:]:
         if ln.startswith("  $ ") or not ln.startswith("  "):
@@ -207,3 +208,42 @@ def test_crushtool_compile_decompile_recompile_t(tmp_path):
             open(f"{d}/missing-bucket.crushmap.txt").read())
     assert str(ei.value) == "in rule 'rule-bad' item 'root-404' " \
         "not defined"
+
+
+def test_crushtool_rules_t_byte_exact(tmp_path):
+    """rules.t: device classes build SHADOW trees with the recorded id
+    allocation (-4..-9), --create-replicated-rule with and without
+    --device-class, and both recorded decompiles match byte-for-byte
+    (class id comments, 'step take default class ssd')."""
+    d = "/root/reference/src/test/cli/crushtool"
+    one = str(tmp_path / "one")
+    assert crushtool.main(["-c", f"{d}/rules.txt",
+                           "--create-replicated-rule", "foo",
+                           "default", "host", "-o", one]) == 0
+    out = str(tmp_path / "out")
+    assert crushtool.main(["-d", one, "-o", out]) == 0
+    assert open(out).read() == _cram_expected_decompile("rules.t", 0)
+    two = str(tmp_path / "two")
+    assert crushtool.main(["-c", f"{d}/rules.txt",
+                           "--create-replicated-rule", "foo-ssd",
+                           "default", "host",
+                           "--device-class", "ssd", "-o", two]) == 0
+    assert crushtool.main(["-d", two, "-o", out]) == 0
+    assert open(out).read() == _cram_expected_decompile("rules.t", 1)
+
+
+def test_class_map_roundtrip_pins_shadow_ids(tmp_path):
+    """A decompiled class-bearing map recompiles to the IDENTICAL
+    binary: the 'id N class C' lines pin the shadow-tree ids, so
+    editing a decompiled map cannot scramble class_bucket references."""
+    d = "/root/reference/src/test/cli/crushtool"
+    one = str(tmp_path / "one")
+    txt = str(tmp_path / "txt")
+    two = str(tmp_path / "two")
+    assert crushtool.main(["-c", f"{d}/rules.txt",
+                           "--create-replicated-rule", "foo-ssd",
+                           "default", "host", "--device-class", "ssd",
+                           "-o", one]) == 0
+    assert crushtool.main(["-d", one, "-o", txt]) == 0
+    assert crushtool.main(["-c", txt, "-o", two]) == 0
+    assert open(one, "rb").read() == open(two, "rb").read()
