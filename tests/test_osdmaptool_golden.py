@@ -247,3 +247,21 @@ def test_class_map_roundtrip_pins_shadow_ids(tmp_path):
     assert crushtool.main(["-d", one, "-o", txt]) == 0
     assert crushtool.main(["-c", txt, "-o", two]) == 0
     assert open(one, "rb").read() == open(two, "rb").read()
+
+
+def test_crushtool_device_class_t_byte_exact(tmp_path):
+    """device-class.t: a class-bearing map (shadow trees, class-scoped
+    takes) compiles, decompiles back to the IDENTICAL text (the cram's
+    `cmp device-class.crush device-class.conf`), and recompiles to the
+    identical binary."""
+    d = "/root/reference/src/test/cli/crushtool"
+    c = str(tmp_path / "c")
+    conf = str(tmp_path / "conf")
+    r = str(tmp_path / "r")
+    assert crushtool.main(["-c", f"{d}/device-class.crush",
+                           "-o", c]) == 0
+    assert crushtool.main(["-d", c, "-o", conf]) == 0
+    assert crushtool.main(["-c", conf, "-o", r]) == 0
+    assert open(conf).read() == \
+        open(f"{d}/device-class.crush").read()
+    assert open(c, "rb").read() == open(r, "rb").read()
