@@ -78,6 +78,8 @@ def main(argv=None) -> int:
     p.add_argument("--num_osds", type=int, default=0)
     p.add_argument("layers", nargs="*",
                    help="--build layer triples: name alg size")
+    p.add_argument("--dump", action="store_true",
+                   help="dump the map as reference-format JSON")
     p.add_argument("--host-mapper", action="store_true",
                    help="force the host interpreter (no device batch)")
     args = p.parse_args(argv)
@@ -216,6 +218,9 @@ def main(argv=None) -> int:
         apply_tunable_flags(cw.crush)  # reference applies --set-* at -c too
         out = args.outfn or "crushmap"
         save_map(cw, out)
+        if args.dump:
+            from ..crush.dumpfmt import dump_json
+            sys.stdout.write(dump_json(cw))
         return 0
 
     if args.decompile is not None:
@@ -230,6 +235,16 @@ def main(argv=None) -> int:
                 f.write(text)
         else:
             sys.stdout.write(text)
+        return 0
+
+    if args.dump:
+        if not args.infn:
+            print("--dump requires -i <map>", file=sys.stderr)
+            return 1
+        from ..crush.dumpfmt import dump_json
+        cw = load_map(args.infn)
+        apply_tunable_flags(cw.crush)   # the reference mutates first
+        sys.stdout.write(dump_json(cw))
         return 0
 
     if args.test:

@@ -265,3 +265,28 @@ def test_crushtool_device_class_t_byte_exact(tmp_path):
     assert open(conf).read() == \
         open(f"{d}/device-class.crush").read()
     assert open(c, "rb").read() == open(r, "rb").read()
+
+
+def test_crushtool_dump_json_byte_exact(tmp_path, capsys):
+    """choose-args.t's --dump block: the JSON map dump (devices/types/
+    buckets/rules/tunables with profile+min-version detection/
+    choose_args with %f weights) matches the recorded output
+    byte-for-byte."""
+    d = "/root/reference/src/test/cli/crushtool"
+    c = str(tmp_path / "c")
+    conf = str(tmp_path / "conf")
+    assert crushtool.main(["-c", f"{d}/choose-args.crush",
+                           "-o", c]) == 0
+    assert crushtool.main(["-d", c, "-o", conf]) == 0
+    capsys.readouterr()
+    assert crushtool.main(["-c", conf, "-o", "/dev/null",
+                           "--dump"]) == 0
+    got = capsys.readouterr().out
+    lines = open(f"{d}/choose-args.t").read().splitlines()
+    start = next(i for i, ln in enumerate(lines) if "--dump" in ln)
+    exp = []
+    for ln in lines[start + 1:]:
+        if ln.startswith("  $ ") or not ln.startswith("  "):
+            break
+        exp.append(ln[2:])
+    assert got == "\n".join(exp) + "\n"
