@@ -66,6 +66,7 @@ def insert_item(cw: CrushWrapper, item: int, weight: int, name: str,
     if not cw.name_exists(name):
         cw.set_item_name(item, name)
     cur = item
+    device_parent = None
     for t in sorted(cw.type_map):
         tname = cw.type_map[t]
         if t == 0:
@@ -77,6 +78,8 @@ def insert_item(cw: CrushWrapper, item: int, weight: int, name: str,
             # create the ancestor CONTAINING the cursor, weight 0
             newid = cw.add_bucket(alg, t, bname,
                                   [cur], [0])
+            if cur == item:
+                device_parent = newid
             cur = newid
             continue
         bid = cw.get_item_id(bname)
@@ -84,15 +87,17 @@ def insert_item(cw: CrushWrapper, item: int, weight: int, name: str,
         if b is None or b.type != t:
             raise ValueError(f"bucket {bname!r} type mismatch")
         cw._bucket_link(bid, cur, 0)
+        if cur == item:
+            device_parent = bid
         break
     else:
         raise ValueError(f"nowhere to add item {item} in {loc}")
-    # adjust_item_weightf_in_loc: set the device's weight where it
-    # lives (REBUILDING the bucket's derived arrays) and ripple the
-    # actual delta to every ancestor
-    p = cw._parent_of(item)
-    delta = cw._set_item_weight_in(p.id, item, weight)
-    cw._propagate_above(p.id, delta)
+    # adjust_item_weightf_in_loc: set the device's weight in THE
+    # LOCATION JUST PLACED (a device may live in several locations —
+    # the first parent found is not necessarily this one), rebuilding
+    # derived arrays and rippling the actual delta upward
+    delta = cw._set_item_weight_in(device_parent, item, weight)
+    cw._propagate_above(device_parent, delta)
     if item >= cw.crush.max_devices:
         cw.crush.max_devices = item + 1
 

@@ -65,6 +65,8 @@ def main(argv=None) -> int:
     p.add_argument("--add-item", nargs=3, metavar=("ID", "W", "NAME"))
     p.add_argument("--loc", nargs=2, action="append", default=[],
                    metavar=("TYPE", "NAME"))
+    p.add_argument("--update-item", nargs=3,
+                   metavar=("ID", "W", "NAME"))
     p.add_argument("--reweight-item", nargs=2, metavar=("NAME", "W"))
     p.add_argument("--remove-item", metavar="NAME")
     p.add_argument("--create-simple-rule", nargs=4,
@@ -157,8 +159,9 @@ def main(argv=None) -> int:
         save_map(cw, out)
         return 0
 
-    if args.add_item or args.reweight_item or args.remove_item \
-            or args.create_simple_rule or args.create_replicated_rule:
+    if args.add_item or args.update_item or args.reweight_item \
+            or args.remove_item or args.create_simple_rule \
+            or args.create_replicated_rule:
         # map-editing verbs (crushtool.cc --add-item/--reweight-item/
         # --remove-item/--create-simple-rule)
         if args.srcfn and args.infn:
@@ -182,6 +185,32 @@ def main(argv=None) -> int:
             loc = {t: n for t, n in args.loc}
             insert_item(cw, int(dev),
                         int(round(float(w) * 0x10000)), name, loc)
+        if args.update_item:
+            # CrushWrapper::update_item: adjust IN THE GIVEN LOCATION
+            # only when the item already lives there; insert otherwise
+            from ..osdmap.simple_build import insert_item
+            dev, w, name = args.update_item
+            dev = int(dev)
+            w16 = int(round(float(w) * 0x10000))
+            loc = {t: n for t, n in args.loc}
+            placed = False
+            for t in sorted(cw.type_map):
+                bname = loc.get(cw.type_map[t])
+                if t == 0 or bname is None:
+                    continue
+                if not cw.name_exists(bname):
+                    break
+                bid = cw.get_item_id(bname)
+                if dev in cw.crush.bucket(bid).items:
+                    delta = cw._set_item_weight_in(bid, dev, w16)
+                    cw._propagate_above(bid, delta)
+                    cw.set_item_name(dev, name)
+                    if cw.item_class:
+                        cw.rebuild_roots_with_classes()
+                    placed = True
+                break
+            if not placed:
+                insert_item(cw, dev, w16, name, loc)
         if args.reweight_item:
             name, w = args.reweight_item
             cw.adjust_item_weight(cw.get_item_id(name),

@@ -290,3 +290,53 @@ def test_crushtool_dump_json_byte_exact(tmp_path, capsys):
             break
         exp.append(ln[2:])
     assert got == "\n".join(exp) + "\n"
+
+
+def test_crushtool_add_item_in_tree_t_byte_exact(tmp_path):
+    """add-item-in-tree.t: eight sequential --add-item ops into a
+    TREE-bucket template; the final decompile matches the recorded
+    tree.template.final byte-for-byte (tree node arrays re-derive
+    correctly through every membership change)."""
+    d = "/root/reference/src/test/cli/crushtool"
+    cur = f"{d}/tree.template"
+    for i in range(8):
+        nxt = str(tmp_path / f"m{i}")
+        assert crushtool.main(
+            ["-i", cur, "--add-item", str(i), "1.0", f"device{i}",
+             "--loc", "host", "host0", "--loc", "cluster", "cluster0",
+             "-o", nxt]) == 0
+        cur = nxt
+    final = str(tmp_path / "final")
+    assert crushtool.main(["-d", cur, "-o", final]) == 0
+    assert open(final).read() == \
+        open(f"{d}/tree.template.final").read()
+
+
+def test_crushtool_adjust_item_weight_t_byte_exact(tmp_path):
+    """adjust-item-weight.t: a device living in TWO hosts keeps
+    per-location weights — adding it to a second host sets the weight
+    THERE, and --update-item adjusts only the named location; both
+    recorded decompiles match byte-for-byte."""
+    d = "/root/reference/src/test/cli/crushtool"
+    one = str(tmp_path / "one")
+    two = str(tmp_path / "two")
+    three = str(tmp_path / "three")
+    final = str(tmp_path / "final")
+    assert crushtool.main(
+        ["-i", f"{d}/simple.template", "--add-item", "0", "1.0",
+         "device0", "--loc", "host", "host0",
+         "--loc", "cluster", "cluster0", "-o", one]) == 0
+    assert crushtool.main(
+        ["-i", one, "--add-item", "0", "2.0", "device0",
+         "--loc", "host", "fake", "--loc", "cluster", "cluster0",
+         "-o", two]) == 0
+    assert crushtool.main(["-d", two, "-o", final]) == 0
+    assert open(final).read() == \
+        open(f"{d}/simple.template.adj.two").read()
+    assert crushtool.main(
+        ["-i", two, "--update-item", "0", "3.0", "device0",
+         "--loc", "host", "host0", "--loc", "cluster", "cluster0",
+         "-o", three]) == 0
+    assert crushtool.main(["-d", three, "-o", final]) == 0
+    assert open(final).read() == \
+        open(f"{d}/simple.template.adj.three").read()
