@@ -453,8 +453,12 @@ class CrushCompiler:
             i += 1
         rule = Rule(steps=steps, ruleset=ruleset, type=rtype,
                     min_size=min_size, max_size=max_size)
-        rno = cw.add_rule(rule, name,
-                          ruleno=ruleset if ruleset >= 0 else -1)
+        try:
+            rno = cw.add_rule(rule, name,
+                              ruleno=ruleset if ruleset >= 0 else -1)
+        except ValueError:
+            # the reference's parse_rule diagnostic
+            raise ValueError(f"rule {ruleset} already exists") from None
         rule.ruleset = rno if ruleset < 0 else ruleset
         return i + 1
 

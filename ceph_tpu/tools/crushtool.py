@@ -32,6 +32,70 @@ def save_map(cw: CrushWrapper, path: str) -> None:
         f.write(encode_crushmap(cw))
 
 
+def _check_name_maps(cw, max_id: int) -> bool:
+    """CrushTester::check_name_maps: walk the tree from the roots (the
+    'ceph osd tree' walk) and verify every bucket has a name, every
+    node's type has a name, and no device id reaches max_id; also
+    probe the stray-device path with item 0."""
+    def fail(msg, item):
+        print(f"{msg}: item#{item}")
+        return False
+
+    def visit(item):
+        if item < 0:
+            b = cw.crush.bucket(item)
+            if item not in cw.name_map:
+                return fail("unknown item name", item)
+            t = b.type
+        else:
+            if max_id > 0 and item >= max_id:
+                return fail("item id too large", item)
+            t = 0
+        if t not in cw.type_map:
+            return fail("unknown type name", item)
+        if item < 0:
+            for it in cw.crush.bucket(item).items:
+                if not visit(it):
+                    return False
+        return True
+
+    roots = [b.id for b in cw.crush.buckets if b is not None
+             and cw._parent_of(b.id) is None]
+    for r in sorted(roots):
+        if not visit(r):
+            return False
+    # straying osd probe (id 0 need not be in the map)
+    if 0 not in cw.type_map:
+        return fail("unknown type name", 0)
+    if max_id > 0 and 0 >= max_id:
+        return fail("item id too large", 0)
+    return True
+
+
+def _check_overlapped_rules(cw) -> None:
+    """CrushTester::check_overlapped_rules: rules sharing a (ruleset,
+    type) whose [min_size, max_size] ranges overlap print per merged
+    sub-interval, names sorted (the boost interval_map shape)."""
+    groups: dict = {}
+    for rno, r in enumerate(cw.crush.rules):
+        if r is None:
+            continue
+        name = cw.rule_name_map.get(rno, f"rule{rno}")
+        groups.setdefault((r.ruleset, r.type), []).append(
+            (r.min_size, r.max_size, name))
+    for (ruleset, _t), rules in sorted(groups.items()):
+        points = sorted({p for lo, hi, _ in rules
+                         for p in (lo, hi + 1)})
+        prev = None
+        for a, b in zip(points, points[1:]):
+            names = sorted({n for lo, hi, n in rules
+                            if lo <= a and a <= hi})
+            if len(names) > 1 and names != prev:
+                print(f"overlapped rules in ruleset {ruleset}: "
+                      + ", ".join(names))
+            prev = names if len(names) > 1 else None
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="crushtool")
     p.add_argument("-i", "--infn", help="input map file")
@@ -80,6 +144,8 @@ def main(argv=None) -> int:
     p.add_argument("--num_osds", type=int, default=0)
     p.add_argument("layers", nargs="*",
                    help="--build layer triples: name alg size")
+    p.add_argument("--check", nargs="?", const=-1, type=int,
+                   default=None, metavar="MAX_ID")
     p.add_argument("--dump", action="store_true",
                    help="dump the map as reference-format JSON")
     p.add_argument("--host-mapper", action="store_true",
@@ -243,7 +309,11 @@ def main(argv=None) -> int:
     if args.srcfn:
         with open(args.srcfn) as f:
             text = f.read()
-        cw = CrushCompiler().compile(text)
+        try:
+            cw = CrushCompiler().compile(text)
+        except ValueError as e:
+            print(e)
+            return 1
         apply_tunable_flags(cw.crush)  # reference applies --set-* at -c too
         out = args.outfn or "crushmap"
         save_map(cw, out)
@@ -257,13 +327,27 @@ def main(argv=None) -> int:
         if not path:
             print("decompile requires a map file", file=sys.stderr)
             return 1
-        cw = load_map(path)
+        try:
+            cw = load_map(path)
+        except Exception:
+            print(f"crushtool: unable to decode {path}")
+            return 1
         text = CrushCompiler(cw).decompile()
         if args.outfn:
             with open(args.outfn, "w") as f:
                 f.write(text)
         else:
             sys.stdout.write(text)
+        return 0
+
+    if args.check is not None:
+        if not args.infn:
+            print("--check requires -i <map>", file=sys.stderr)
+            return 1
+        cw = load_map(args.infn)
+        _check_overlapped_rules(cw)
+        if args.check >= 0 and not _check_name_maps(cw, args.check):
+            return 1
         return 0
 
     if args.dump:

@@ -340,3 +340,54 @@ def test_crushtool_adjust_item_weight_t_byte_exact(tmp_path):
     assert crushtool.main(["-d", three, "-o", final]) == 0
     assert open(final).read() == \
         open(f"{d}/simple.template.adj.three").read()
+
+
+def test_crushtool_check_t_behaviors(tmp_path, capsys):
+    """The --check cram family: check-names.empty.t (the stray-osd
+    type probe on an empty map), check-names.max-id.t (device ids vs
+    the bound), and check-overlapped-rules.t (per-sub-interval
+    overlap reporting + the duplicate-rule compile diagnostic) — all
+    recorded outputs verbatim."""
+    d = "/root/reference/src/test/cli/crushtool"
+    e = str(tmp_path / "e")
+    assert crushtool.main(["-c", f"{d}/check-names.empty.crushmap.txt",
+                           "-o", e]) == 0
+    capsys.readouterr()
+    assert crushtool.main(["-i", e, "--check", "0"]) == 1
+    assert capsys.readouterr().out == "unknown type name: item#0\n"
+
+    cur = f"{d}/simple.template"
+    for i in range(3):
+        nxt = str(tmp_path / f"m{i}")
+        assert crushtool.main(
+            ["-i", cur, "--add-item", str(i), "1.0", f"device{i}",
+             "--loc", "host", "host0", "--loc", "cluster", "cluster0",
+             "-o", nxt]) == 0
+        cur = nxt
+    capsys.readouterr()
+    assert crushtool.main(["-i", str(tmp_path / "m1"),
+                           "--check", "2"]) == 0
+    assert crushtool.main(["-i", str(tmp_path / "m2"),
+                           "--check", "2"]) == 1
+    assert capsys.readouterr().out == "item id too large: item#2\n"
+    assert crushtool.main(["-i", str(tmp_path / "m2"),
+                           "--check"]) == 0
+    capsys.readouterr()
+
+    assert crushtool.main(
+        ["-i", f"{d}/check-overlapped-rules.crushmap", "--check"]) == 0
+    assert capsys.readouterr().out == (
+        "overlapped rules in ruleset 0: rule-r0, rule-r1, rule-r2\n"
+        "overlapped rules in ruleset 0: rule-r0, rule-r2, rule-r3\n"
+        "overlapped rules in ruleset 0: rule-r0, rule-r3\n")
+    assert crushtool.main(
+        ["-c", f"{d}/check-overlapped-rules.crushmap.txt",
+         "-o", str(tmp_path / "x")]) == 1
+    assert capsys.readouterr().out == "rule 0 already exists\n"
+
+
+def test_crushtool_decode_failure_message(capsys):
+    """crushtool -d on a non-crushmap prints the recorded diagnostic."""
+    assert crushtool.main(["-d", "/etc/hosts"]) == 1
+    assert capsys.readouterr().out == \
+        "crushtool: unable to decode /etc/hosts\n"
