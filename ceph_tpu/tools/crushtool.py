@@ -144,6 +144,8 @@ def main(argv=None) -> int:
     p.add_argument("--num_osds", type=int, default=0)
     p.add_argument("layers", nargs="*",
                    help="--build layer triples: name alg size")
+    p.add_argument("--show-location", type=int, default=None,
+                   metavar="ID")
     p.add_argument("--check", nargs="?", const=-1, type=int,
                    default=None, metavar="MAX_ID")
     p.add_argument("--dump", action="store_true",
@@ -338,6 +340,16 @@ def main(argv=None) -> int:
                 f.write(text)
         else:
             sys.stdout.write(text)
+        return 0
+
+    if args.show_location is not None:
+        if not args.infn:
+            print("--show-location requires -i <map>", file=sys.stderr)
+            return 1
+        cw = load_map(args.infn)
+        loc = cw.get_full_location(args.show_location)
+        for k in sorted(loc):        # std::map: alphabetical by type
+            print(f"{k}\t{loc[k]}")
         return 0
 
     if args.check is not None:

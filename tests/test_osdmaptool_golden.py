@@ -391,3 +391,23 @@ def test_crushtool_decode_failure_message(capsys):
     assert crushtool.main(["-d", "/etc/hosts"]) == 1
     assert capsys.readouterr().out == \
         "crushtool: unable to decode /etc/hosts\n"
+
+
+def test_crushtool_show_location_t_byte_exact(capsys):
+    """location.t: --show-location walks the ancestor chain of a
+    device in the reference's big recorded binary map, printing
+    type\\tname alphabetically (the std::map order); devices outside
+    the map print nothing."""
+    d = "/root/reference/src/test/cli/crushtool"
+    m = f"{d}/test-map-big-1.crushmap"
+    cases = {
+        44: "",
+        16: "",
+        167: ("host\tp05151113587529\nrack\tRJ45\n"
+              "room\t0513-R-0050\nroot\tdefault\n"),
+        258: "host\tlxfssi44a06\nrack\tSI44\nroot\tcastor\n",
+    }
+    for dev, want in cases.items():
+        assert crushtool.main(["-i", m, "--show-location",
+                               str(dev)]) == 0
+        assert capsys.readouterr().out == want, dev
