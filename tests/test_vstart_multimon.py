@@ -19,7 +19,10 @@ from ceph_tpu.vstart import ProcessCluster
 @pytest.fixture(scope="module")
 def cluster():
     c = ProcessCluster(
-        n_osds=3, n_mons=3, mon_grace=3.0,
+        # mon_grace sized for LOADED hosts: a 3 s grace causes
+        # spurious re-elections under an 8-worker suite, stalling
+        # the relayed commands past any reasonable window
+        n_osds=3, n_mons=3, mon_grace=8.0,
         pool={"name": "p", "type": "replicated", "size": 3, "pg_num": 4},
         client_names=("client.x", "client.y"),
         heartbeat_interval=1.0, heartbeat_grace=4.0)
