@@ -160,8 +160,13 @@ class RGWLite:
             raise RGWError("get_bucket", -2, "NoSuchBucket")
         return b
 
-    def delete_bucket(self, name: str) -> None:
+    def delete_bucket(self, name: str,
+                      actor: Optional[str] = None) -> None:
+        """RGWDeleteBucket::verify_permission checks bucket policy
+        (rgw_op.cc:2828-2832), not raw ownership — a FULL_CONTROL/
+        WRITE grantee may delete; actor None = admin bypass."""
         b = self.get_bucket(name)
+        self._check_bucket_access(b, actor, "WRITE")
         stats = json.loads(self._exec(self.mpool,
                                       self._index_oid(b["id"]),
                                       "bucket_stats"))
@@ -320,15 +325,21 @@ class RGWLite:
         if not stack:
             return {}
         cur = stack[0]
-        return {"size": 0 if cur.get("delete_marker")
-                else cur.get("size", 0),
-                "etag": cur.get("etag", ""),
-                "mtime": cur.get("mtime", 0.0),
-                "content_type": cur.get("content_type",
-                                        "binary/octet-stream"),
-                "chunks": 0 if cur.get("delete_marker")
-                else cur.get("chunks", 0),
-                "delete_marker": bool(cur.get("delete_marker"))}
+        out = {"size": 0 if cur.get("delete_marker")
+               else cur.get("size", 0),
+               "etag": cur.get("etag", ""),
+               "mtime": cur.get("mtime", 0.0),
+               "content_type": cur.get("content_type",
+                                       "binary/octet-stream"),
+               "chunks": 0 if cur.get("delete_marker")
+               else cur.get("chunks", 0),
+               "delete_marker": bool(cur.get("delete_marker"))}
+        # the uploader owns the object (RGWRados sets the attr owner
+        # to the writing user): surface the current version's owner
+        # at entry level so _check_object_access sees it
+        if "owner" in cur:
+            out["owner"] = cur["owner"]
+        return out
 
     def put_bucket_versioning(self, bucket: str, status: str,
                               actor: Optional[str] = None) -> None:
@@ -341,8 +352,14 @@ class RGWLite:
         b["versioning"] = status
         self.client.write_full(self.mpool, f"bucket.{bucket}", _j(b))
 
-    def get_bucket_versioning(self, bucket: str) -> Optional[str]:
-        return self.get_bucket(bucket).get("versioning")
+    def get_bucket_versioning(self, bucket: str,
+                              actor: Optional[str] = None
+                              ) -> Optional[str]:
+        # s3GetBucketVersioning maps to READ_ACP in the reference's
+        # op_to_perm (rgw_iam_policy.h:102), not plain READ
+        b = self.get_bucket(bucket)
+        self._check_bucket_access(b, actor, "READ_ACP")
+        return b.get("versioning")
 
     def list_object_versions(self, bucket: str, prefix: str = "",
                              actor: Optional[str] = None
@@ -570,20 +587,65 @@ class RGWLite:
                 "truncated": truncated, "next_marker": next_marker}
 
     # ---- multipart (RGWMultipart*) -----------------------------------------
-    def initiate_multipart(self, bucket: str, name: str) -> str:
+    def initiate_multipart(self, bucket: str, name: str,
+                           actor: Optional[str] = None) -> str:
+        """RGWInitMultipart needs s3PutObject on the bucket
+        (rgw_op.cc:5155-5160) — WRITE here."""
         b = self.get_bucket(bucket)
+        self._check_bucket_access(b, actor, "WRITE")
         upload_id = secrets.token_hex(8)
+        meta = {"parts": {}, "key": name}
+        if actor is not None:
+            meta["owner"] = actor
         self.client.write_full(
             self.mpool, f"multipart.{b['id']}.{name}.{upload_id}",
-            _j({"parts": {}}))
+            _j(meta))
         return upload_id
+
+    def list_multipart_uploads(self, bucket: str,
+                               actor: Optional[str] = None
+                               ) -> List[Dict]:
+        """In-progress uploads for a bucket (RGWListBucketMultiparts
+        role), sorted by (key, upload_id)."""
+        b = self.get_bucket(bucket)
+        self._check_bucket_access(b, actor, "READ")
+        prefix = f"multipart.{b['id']}."
+        out = []
+        moids = [o for o in self.client.list_objects(self.mpool)
+                 if o.startswith(prefix)]
+        for moid in moids:
+            rest = moid[len(prefix):]
+            if "." not in rest:
+                continue
+            name, upload_id = rest.rsplit(".", 1)
+            mp = self._meta_get(moid) or {}
+            out.append({"key": name, "upload_id": upload_id,
+                        "owner": mp.get("owner")})
+        return sorted(out, key=lambda u: (u["key"], u["upload_id"]))
+
+    def list_parts(self, bucket: str, name: str, upload_id: str,
+                   actor: Optional[str] = None) -> List[Dict]:
+        """Parts uploaded so far (RGWListMultipart role,
+        rgw_op.cc:5641-5644), ascending part number."""
+        b = self.get_bucket(bucket)
+        self._check_bucket_access(b, actor, "READ")
+        mp = self._meta_get(self._mp_meta_oid(b["id"], name,
+                                              upload_id))
+        if mp is None:
+            raise RGWError("list_parts", -2, "NoSuchUpload")
+        return [{"part_number": int(pn), "size": p["size"],
+                 "etag": p["etag"]}
+                for pn, p in sorted(mp["parts"].items(),
+                                    key=lambda kv: int(kv[0]))]
 
     def _mp_meta_oid(self, bid: str, name: str, upload_id: str) -> str:
         return f"multipart.{bid}.{name}.{upload_id}"
 
     def upload_part(self, bucket: str, name: str, upload_id: str,
-                    part_num: int, data: bytes) -> str:
+                    part_num: int, data: bytes,
+                    actor: Optional[str] = None) -> str:
         b = self.get_bucket(bucket)
+        self._check_bucket_access(b, actor, "WRITE")
         moid = self._mp_meta_oid(b["id"], name, upload_id)
         mp = self._meta_get(moid)
         if mp is None:
@@ -598,26 +660,56 @@ class RGWLite:
         return etag
 
     def complete_multipart(self, bucket: str, name: str,
-                           upload_id: str) -> Dict:
+                           upload_id: str,
+                           parts: Optional[List[Dict]] = None,
+                           actor: Optional[str] = None) -> Dict:
         """Stitch the parts into the final object (copy-concatenate —
         the reference links manifests instead; lite keeps one chunk
-        layout for get_object)."""
+        layout for get_object).
+
+        ``parts`` (the client's CompleteMultipartUpload manifest,
+        [{'part_number', 'etag'}]) is validated against what was
+        uploaded the way RGWCompleteMultipart::execute checks each
+        listed part's etag (rgw_op.cc InvalidPart path); None keeps
+        the legacy use-everything behavior."""
         b = self.get_bucket(bucket)
+        self._check_bucket_access(b, actor, "WRITE")
         moid = self._mp_meta_oid(b["id"], name, upload_id)
         mp = self._meta_get(moid)
         if mp is None:
             raise RGWError("complete_multipart", -2, "NoSuchUpload")
+        if parts is not None:
+            if not parts:
+                raise RGWError("complete_multipart", -22,
+                               "MalformedXML")
+            nums = [p["part_number"] for p in parts]
+            # strictly ascending: duplicates are invalid too
+            if any(x >= y for x, y in zip(nums, nums[1:])):
+                raise RGWError("complete_multipart", -22,
+                               "InvalidPartOrder")
+            for p in parts:
+                have = mp["parts"].get(str(p["part_number"]))
+                if have is None or (p.get("etag") and
+                                    p["etag"].strip('"') !=
+                                    have["etag"]):
+                    raise RGWError("complete_multipart", -22,
+                                   "InvalidPart")
+            use = [str(p["part_number"]) for p in parts]
+        else:
+            use = sorted(mp["parts"], key=int)
         data = b""
-        for pn in sorted(mp["parts"], key=int):
+        for pn in use:
             poid = f"{b['id']}_mp_{name}.{upload_id}.{pn}"
             data += self.client.read(self.dpool, poid)
-        meta = self.put_object(bucket, name, data)
+        meta = self.put_object(bucket, name, data, actor=actor)
         self.abort_multipart(bucket, name, upload_id)
         return meta
 
     def abort_multipart(self, bucket: str, name: str,
-                        upload_id: str) -> None:
+                        upload_id: str,
+                        actor: Optional[str] = None) -> None:
         b = self.get_bucket(bucket)
+        self._check_bucket_access(b, actor, "WRITE")
         moid = self._mp_meta_oid(b["id"], name, upload_id)
         mp = self._meta_get(moid)
         if mp is None:
@@ -739,11 +831,17 @@ class RGWLite:
         b["lifecycle"] = list(rules)
         self.client.write_full(self.mpool, f"bucket.{bucket}", _j(b))
 
-    def get_bucket_lifecycle(self, bucket: str) -> List[Dict]:
-        return list(self.get_bucket(bucket).get("lifecycle") or [])
-
-    def delete_bucket_lifecycle(self, bucket: str) -> None:
+    def get_bucket_lifecycle(self, bucket: str,
+                             actor: Optional[str] = None
+                             ) -> List[Dict]:
         b = self.get_bucket(bucket)
+        self._check_bucket_access(b, actor, "READ_ACP")
+        return list(b.get("lifecycle") or [])
+
+    def delete_bucket_lifecycle(self, bucket: str,
+                                actor: Optional[str] = None) -> None:
+        b = self.get_bucket(bucket)
+        self._check_bucket_access(b, actor, "WRITE_ACP")
         b.pop("lifecycle", None)
         self.client.write_full(self.mpool, f"bucket.{bucket}", _j(b))
 

@@ -3,9 +3,15 @@ frontend role, src/rgw/rgw_main.cc + rgw_rest_s3.cc at lite scale).
 
 Speaks the S3 subset the gateway implements over path-style URLs
 (``/bucket``, ``/bucket/key``): bucket PUT/GET/DELETE, object
-PUT/GET/HEAD/DELETE, ListObjectsV1 query args (prefix/marker/
-delimiter/max-keys) with XML responses, and AWS signature v2-style
-auth: ``Authorization: AWS <access_key>:<sig>`` where sig =
+PUT/GET/HEAD/DELETE/POST, ListObjectsV1/V2, and the subresources the
+reference routes in rgw_rest_s3.cc: ``?versioning`` (GET/PUT,
+rgw_rest_s3.cc:868-960), ``?versions`` (ListObjectVersions),
+``versionId=`` on object GET/HEAD/DELETE, ``?acl`` (GET/PUT bucket +
+object policy XML, rgw_rest_s3.cc:2176-2209 / rgw_acl_s3.cc
+grammar), ``?lifecycle`` (GET/PUT/DELETE), and multipart
+(``?uploads`` POST/GET, ``uploadId=`` PUT/POST/GET/DELETE,
+rgw_rest_s3.cc:2628).  Auth is AWS signature v2-style:
+``Authorization: AWS <access_key>:<sig>`` where sig =
 base64(HMAC-SHA1(secret, method\\n\\n\\ndate\\npath)) — the reference's
 v2 string-to-sign with the optional header sections empty.
 
@@ -17,9 +23,13 @@ from __future__ import annotations
 import base64
 import hashlib
 import hmac
-from typing import Dict, Optional, Tuple
+import re
+import time as _time
+import xml.etree.ElementTree as ET
+from typing import Dict, List, Optional, Tuple
 from xml.sax.saxutils import escape
 
+from . import acl_xml
 from .gateway import RGWError, RGWLite
 
 
@@ -40,8 +50,38 @@ _ERRNO_TO_S3 = {
     -2: (404, "NoSuchKey"),
     -13: (403, "AccessDenied"),
     -17: (409, "BucketAlreadyExists"),
+    -22: (400, "InvalidArgument"),
     -39: (409, "BucketNotEmpty"),
 }
+
+# gateway reasons that ARE S3 error codes ride through verbatim (the
+# reference maps op_ret -> rgw_http_errors the same way)
+_CODE_RE = re.compile(r"^[A-Z][A-Za-z]+$")
+
+
+def _rgw_err(e: RGWError) -> Tuple[int, Dict, bytes]:
+    status, code = _ERRNO_TO_S3.get(e.result, (500, "InternalError"))
+    # RGWError's str is "rgw <api>: <result> <reason>"; when the
+    # reason IS an S3 code (NoSuchUpload, InvalidPart, ...) it rides
+    # through verbatim like the reference's rgw_http_errors mapping
+    reason = str(e).rsplit(" ", 1)[-1]
+    if _CODE_RE.match(reason):
+        code = reason
+    return _err(status, code, str(e))
+
+
+def _iso8601(ts: float) -> str:
+    return _time.strftime("%Y-%m-%dT%H:%M:%S.000Z", _time.gmtime(ts))
+
+
+# namespace-insensitive XML helpers shared with the ACL grammar
+_xml_local = acl_xml._local
+_xml_find = acl_xml._find
+
+
+def _xml_text(el, name, default: str = "") -> str:
+    child = _xml_find(el, name)
+    return (child.text or "").strip() if child is not None else default
 
 
 class S3Frontend:
@@ -80,20 +120,53 @@ class S3Frontend:
             if not bucket:
                 return self._list_buckets(user)
             if not key:
-                return self._bucket_op(method, user, bucket, query)
-            return self._object_op(method, user, bucket, key, body)
+                return self._bucket_op(method, user, bucket, query,
+                                       body, headers)
+            return self._object_op(method, user, bucket, key, body,
+                                   query, headers)
         except RGWError as e:
-            status, code = _ERRNO_TO_S3.get(e.result,
-                                            (500, "InternalError"))
-            return _err(status, code, str(e))
+            return _rgw_err(e)
         except ValueError as e:
-            return _err(400, "InvalidArgument", str(e))
+            msg = str(e)
+            code = msg.split(":", 1)[0] if _CODE_RE.match(
+                msg.split(":", 1)[0]) else "InvalidArgument"
+            return _err(400, code, msg)
         except Exception as e:      # a handler thread must always reply
             return _err(500, "InternalError", repr(e))
 
-    def _owner_check(self, user: Dict, bucket: str) -> None:
-        if self.rgw.get_bucket(bucket)["owner"] != user["uid"]:
-            raise RGWError("acl", -13, "AccessDenied")
+    # ---- display names for ACL XML -----------------------------------------
+    def _display_names(self, *uids: Optional[str]) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for uid in uids:
+            if not uid or uid in ("*", "auth") or uid in out:
+                continue
+            try:
+                dn = self.rgw.get_user(uid).get("display_name")
+            except RGWError:
+                continue
+            if dn:
+                out[uid] = dn
+        return out
+
+    def _acl_response(self, policy: Dict) -> Tuple[int, Dict, bytes]:
+        uids = [policy.get("owner")] + \
+            [g["grantee"] for g in policy.get("grants", [])]
+        xml = acl_xml.policy_to_xml(policy.get("owner"),
+                                    policy.get("grants", []),
+                                    self._display_names(*uids))
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    @staticmethod
+    def _acl_request(headers: Dict[str, str], body: bytes
+                     ) -> Tuple[Optional[str], Optional[List[Dict]]]:
+        """PUT ?acl input: an XML policy body, else the x-amz-acl
+        canned header (the reference accepts both; body wins)."""
+        if body.strip():
+            _owner, grants = acl_xml.policy_from_xml(body)
+            return None, grants
+        canned = headers.get("x-amz-acl") or \
+            headers.get("X-Amz-Acl") or "private"
+        return canned, None
 
     def _list_buckets(self, user):
         names = "".join(f"<Bucket><Name>{escape(n)}</Name></Bucket>"
@@ -102,13 +175,26 @@ class S3Frontend:
                f"<Buckets>{names}</Buckets></ListAllMyBucketsResult>")
         return 200, {"Content-Type": "application/xml"}, xml.encode()
 
-    def _bucket_op(self, method, user, bucket, query):
+    def _bucket_op(self, method, user, bucket, query, body, headers):
+        actor = user["uid"]
+        if "versioning" in query:
+            return self._versioning_op(method, actor, bucket, body)
+        if "versions" in query:
+            return self._list_versions(method, actor, bucket, query)
+        if "acl" in query:
+            return self._bucket_acl_op(method, actor, bucket, body,
+                                       headers)
+        if "lifecycle" in query:
+            return self._lifecycle_op(method, actor, bucket, body)
+        if "uploads" in query and method == "GET":
+            return self._list_uploads(actor, bucket)
         if method == "PUT":
             self.rgw.create_bucket(user["uid"], bucket)
             return 200, {}, b""
         if method == "DELETE":
-            self._owner_check(user, bucket)
-            self.rgw.delete_bucket(bucket)
+            # policy-gated like every other op (RGWDeleteBucket goes
+            # through verify_bucket_permission, not a raw owner check)
+            self.rgw.delete_bucket(bucket, actor=actor)
             return 204, {}, b""
         if method == "GET":
             # ACL-gated (bucket READ), not owner-gated: public-read
@@ -148,25 +234,285 @@ class S3Frontend:
             return 200, {"Content-Type": "application/xml"}, xml.encode()
         return _err(405, "MethodNotAllowed")
 
-    def _object_op(self, method, user, bucket, key, body):
+    # ---- ?versioning (rgw_rest_s3.cc:868-960) ------------------------------
+    def _versioning_op(self, method, actor, bucket, body):
+        if method == "GET":
+            status = self.rgw.get_bucket_versioning(bucket,
+                                                    actor=actor)
+            inner = "" if status is None else \
+                f"<Status>{status.capitalize()}</Status>"
+            xml = (f'<?xml version="1.0"?>'
+                   f'<VersioningConfiguration xmlns="{acl_xml.XMLNS}">'
+                   f"{inner}</VersioningConfiguration>")
+            return 200, {"Content-Type": "application/xml"}, \
+                xml.encode()
+        if method == "PUT":
+            try:
+                root = ET.fromstring(body)
+            except ET.ParseError:
+                return _err(400, "MalformedXML")
+            if _xml_local(root.tag) != "VersioningConfiguration":
+                return _err(400, "MalformedXML")
+            status = _xml_text(root, "Status")
+            if not status:      # VersioningNotChanged
+                return 200, {}, b""
+            if status.lower() not in ("enabled", "suspended"):
+                return _err(400, "MalformedXML",
+                            f"bad Status {status!r}")
+            self.rgw.put_bucket_versioning(bucket, status.lower(),
+                                           actor=actor)
+            return 200, {}, b""
+        return _err(405, "MethodNotAllowed")
+
+    # ---- ?versions (ListObjectVersions) ------------------------------------
+    def _list_versions(self, method, actor, bucket, query):
+        if method != "GET":
+            return _err(405, "MethodNotAllowed")
+        vers = self.rgw.list_object_versions(
+            bucket, prefix=query.get("prefix", ""), actor=actor)
+        items = []
+        for v in vers:
+            tag = "DeleteMarker" if v["delete_marker"] else "Version"
+            fields = (f"<Key>{escape(v['key'])}</Key>"
+                      f"<VersionId>{escape(v['version_id'])}"
+                      f"</VersionId>"
+                      f"<IsLatest>{str(v['is_latest']).lower()}"
+                      f"</IsLatest>"
+                      f"<LastModified>{_iso8601(v['mtime'])}"
+                      f"</LastModified>")
+            if not v["delete_marker"]:
+                fields += (f'<ETag>"{v["etag"]}"</ETag>'
+                           f"<Size>{v['size']}</Size>")
+            items.append(f"<{tag}>{fields}</{tag}>")
+        xml = (f'<?xml version="1.0"?>'
+               f'<ListVersionsResult xmlns="{acl_xml.XMLNS}">'
+               f"<Name>{escape(bucket)}</Name>"
+               f"<Prefix>{escape(query.get('prefix', ''))}</Prefix>"
+               f"{''.join(items)}</ListVersionsResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    # ---- ?acl (bucket) -----------------------------------------------------
+    def _bucket_acl_op(self, method, actor, bucket, body, headers):
+        if method == "GET":
+            return self._acl_response(
+                self.rgw.get_bucket_acl(bucket, actor=actor))
+        if method == "PUT":
+            canned, grants = self._acl_request(headers, body)
+            self.rgw.put_bucket_acl(bucket, canned=canned,
+                                    grants=grants, actor=actor)
+            return 200, {}, b""
+        return _err(405, "MethodNotAllowed")
+
+    # ---- ?lifecycle --------------------------------------------------------
+    def _lifecycle_op(self, method, actor, bucket, body):
+        if method == "GET":
+            rules = self.rgw.get_bucket_lifecycle(bucket, actor=actor)
+            if not rules:
+                return _err(404, "NoSuchLifecycleConfiguration")
+            items = []
+            for r in rules:
+                inner = ""
+                if r.get("id"):
+                    inner += f"<ID>{escape(r['id'])}</ID>"
+                inner += (f"<Prefix>{escape(r.get('prefix', ''))}"
+                          f"</Prefix>"
+                          f"<Status>{r.get('status', 'Enabled')}"
+                          f"</Status>")
+                if r.get("expiration_days"):
+                    inner += (f"<Expiration><Days>"
+                              f"{r['expiration_days']}</Days>"
+                              f"</Expiration>")
+                if r.get("noncurrent_days"):
+                    inner += (f"<NoncurrentVersionExpiration>"
+                              f"<NoncurrentDays>"
+                              f"{r['noncurrent_days']}"
+                              f"</NoncurrentDays>"
+                              f"</NoncurrentVersionExpiration>")
+                items.append(f"<Rule>{inner}</Rule>")
+            xml = (f'<?xml version="1.0"?>'
+                   f'<LifecycleConfiguration xmlns="{acl_xml.XMLNS}">'
+                   f"{''.join(items)}</LifecycleConfiguration>")
+            return 200, {"Content-Type": "application/xml"}, \
+                xml.encode()
+        if method == "PUT":
+            try:
+                root = ET.fromstring(body)
+            except ET.ParseError:
+                return _err(400, "MalformedXML")
+            rules = []
+            for rule in root:
+                if _xml_local(rule.tag) != "Rule":
+                    continue
+                r: Dict = {"id": _xml_text(rule, "ID"),
+                           "prefix": _xml_text(rule, "Prefix"),
+                           "status": _xml_text(rule, "Status",
+                                               "Enabled")}
+                exp = _xml_find(rule, "Expiration")
+                if exp is not None:
+                    r["expiration_days"] = int(_xml_text(exp, "Days",
+                                                         "0"))
+                non = _xml_find(rule, "NoncurrentVersionExpiration")
+                if non is not None:
+                    r["noncurrent_days"] = int(
+                        _xml_text(non, "NoncurrentDays", "0"))
+                rules.append(r)
+            if not rules:
+                return _err(400, "MalformedXML", "no Rule")
+            self.rgw.put_bucket_lifecycle(bucket, rules, actor=actor)
+            return 200, {}, b""
+        if method == "DELETE":
+            self.rgw.delete_bucket_lifecycle(bucket, actor=actor)
+            return 204, {}, b""
+        return _err(405, "MethodNotAllowed")
+
+    # ---- ?uploads listing --------------------------------------------------
+    def _list_uploads(self, actor, bucket):
+        ups = self.rgw.list_multipart_uploads(bucket, actor=actor)
+        items = "".join(
+            f"<Upload><Key>{escape(u['key'])}</Key>"
+            f"<UploadId>{escape(u['upload_id'])}</UploadId></Upload>"
+            for u in ups)
+        xml = (f'<?xml version="1.0"?>'
+               f'<ListMultipartUploadsResult xmlns="{acl_xml.XMLNS}">'
+               f"<Bucket>{escape(bucket)}</Bucket>{items}"
+               f"</ListMultipartUploadsResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    # ---- objects -----------------------------------------------------------
+    def _object_op(self, method, user, bucket, key, body, query,
+                   headers):
         # policy decisions live in the gateway's ACL engine (canned
         # ACLs + grants, rgw_acl_s3.cc role): the frontend just
         # supplies the authenticated actor
         actor = user["uid"]
+        if "acl" in query:
+            return self._object_acl_op(method, actor, bucket, key,
+                                       body, headers)
+        if "uploads" in query and method == "POST":
+            upload_id = self.rgw.initiate_multipart(bucket, key,
+                                                    actor=actor)
+            xml = (f'<?xml version="1.0"?>'
+                   f'<InitiateMultipartUploadResult '
+                   f'xmlns="{acl_xml.XMLNS}">'
+                   f"<Bucket>{escape(bucket)}</Bucket>"
+                   f"<Key>{escape(key)}</Key>"
+                   f"<UploadId>{upload_id}</UploadId>"
+                   f"</InitiateMultipartUploadResult>")
+            return 200, {"Content-Type": "application/xml"}, \
+                xml.encode()
+        if "uploadId" in query:
+            return self._multipart_op(method, actor, bucket, key,
+                                      body, query)
+        vid = query.get("versionId")
         if method == "PUT":
             meta = self.rgw.put_object(bucket, key, body, actor=actor)
-            return 200, {"ETag": f'"{meta["etag"]}"'}, b""
+            hdrs = {"ETag": f'"{meta["etag"]}"'}
+            if meta.get("vid"):
+                hdrs["x-amz-version-id"] = meta["vid"]
+            canned = headers.get("x-amz-acl") or \
+                headers.get("X-Amz-Acl")
+            if canned:
+                # object-level canned ACL on upload; the actor just
+                # became the owner, so this cannot be denied
+                self.rgw.put_object_acl(bucket, key, canned=canned,
+                                        actor=actor)
+            return 200, hdrs, b""
         if method == "GET":
-            data = self.rgw.get_object(bucket, key, actor=actor)
-            meta = self.rgw.head_object(bucket, key)
-            return 200, {"Content-Type": meta["content_type"],
-                         "ETag": f'"{meta["etag"]}"'}, data
+            data = self.rgw.get_object(bucket, key, version_id=vid,
+                                       actor=actor)
+            meta = self.rgw.head_object(bucket, key, version_id=vid)
+            hdrs = {"Content-Type": meta["content_type"],
+                    "ETag": f'"{meta["etag"]}"'}
+            if meta.get("vid"):
+                hdrs["x-amz-version-id"] = meta["vid"]
+            return 200, hdrs, data
         if method == "HEAD":
-            meta = self.rgw.head_object(bucket, key, actor=actor)
-            return 200, {"Content-Length": str(meta["size"]),
-                         "ETag": f'"{meta["etag"]}"'}, b""
+            meta = self.rgw.head_object(bucket, key, version_id=vid,
+                                        actor=actor)
+            if meta.get("delete_marker"):
+                return _err(405, "MethodNotAllowed",
+                            "delete marker")   # S3's 405 on marker HEAD
+            hdrs = {"Content-Length": str(meta["size"]),
+                    "ETag": f'"{meta["etag"]}"'}
+            if meta.get("vid"):
+                hdrs["x-amz-version-id"] = meta["vid"]
+            return 200, hdrs, b""
         if method == "DELETE":
-            self.rgw.delete_object(bucket, key, actor=actor)
+            res = self.rgw.delete_object(bucket, key, version_id=vid,
+                                         actor=actor)
+            hdrs = {}
+            if res.get("version_id"):
+                hdrs["x-amz-version-id"] = res["version_id"]
+            if res.get("delete_marker"):
+                hdrs["x-amz-delete-marker"] = "true"
+            return 204, hdrs, b""
+        return _err(405, "MethodNotAllowed")
+
+    def _object_acl_op(self, method, actor, bucket, key, body,
+                       headers):
+        if method == "GET":
+            return self._acl_response(
+                self.rgw.get_object_acl(bucket, key, actor=actor))
+        if method == "PUT":
+            canned, grants = self._acl_request(headers, body)
+            self.rgw.put_object_acl(bucket, key, canned=canned,
+                                    grants=grants, actor=actor)
+            return 200, {}, b""
+        return _err(405, "MethodNotAllowed")
+
+    def _multipart_op(self, method, actor, bucket, key, body, query):
+        upload_id = query["uploadId"]
+        if method == "PUT" and "partNumber" in query:
+            etag = self.rgw.upload_part(bucket, key, upload_id,
+                                        int(query["partNumber"]),
+                                        body, actor=actor)
+            return 200, {"ETag": f'"{etag}"'}, b""
+        if method == "POST":
+            try:
+                root = ET.fromstring(body)
+            except ET.ParseError:
+                return _err(400, "MalformedXML")
+            parts = []
+            for p in root:
+                if _xml_local(p.tag) != "Part":
+                    continue
+                parts.append({
+                    "part_number": int(_xml_text(p, "PartNumber",
+                                                 "0")),
+                    "etag": _xml_text(p, "ETag").strip('"')})
+            meta = self.rgw.complete_multipart(bucket, key, upload_id,
+                                               parts=parts,
+                                               actor=actor)
+            xml = (f'<?xml version="1.0"?>'
+                   f'<CompleteMultipartUploadResult '
+                   f'xmlns="{acl_xml.XMLNS}">'
+                   f"<Location>/{escape(bucket)}/{escape(key)}"
+                   f"</Location>"
+                   f"<Bucket>{escape(bucket)}</Bucket>"
+                   f"<Key>{escape(key)}</Key>"
+                   f'<ETag>"{meta["etag"]}"</ETag>'
+                   f"</CompleteMultipartUploadResult>")
+            return 200, {"Content-Type": "application/xml"}, \
+                xml.encode()
+        if method == "GET":
+            parts = self.rgw.list_parts(bucket, key, upload_id,
+                                        actor=actor)
+            items = "".join(
+                f"<Part><PartNumber>{p['part_number']}</PartNumber>"
+                f'<ETag>"{p["etag"]}"</ETag>'
+                f"<Size>{p['size']}</Size></Part>"
+                for p in parts)
+            xml = (f'<?xml version="1.0"?>'
+                   f'<ListPartsResult xmlns="{acl_xml.XMLNS}">'
+                   f"<Bucket>{escape(bucket)}</Bucket>"
+                   f"<Key>{escape(key)}</Key>"
+                   f"<UploadId>{upload_id}</UploadId>{items}"
+                   f"</ListPartsResult>")
+            return 200, {"Content-Type": "application/xml"}, \
+                xml.encode()
+        if method == "DELETE":
+            self.rgw.abort_multipart(bucket, key, upload_id,
+                                     actor=actor)
             return 204, {}, b""
         return _err(405, "MethodNotAllowed")
 
@@ -189,9 +535,11 @@ def serve(frontend: S3Frontend, port: int = 0):
             ln = int(self.headers.get("Content-Length", "0") or 0)
             body = self.rfile.read(ln) if ln else b""
             with lock:
+                # keep_blank_values: bare subresource markers
+                # (?versioning, ?uploads, ?acl ...) must survive
                 status, hdrs, out = frontend.handle(
                     method, u.path, dict(self.headers), body,
-                    dict(parse_qsl(u.query)))
+                    dict(parse_qsl(u.query, keep_blank_values=True)))
             self.send_response(status)
             for k, v in hdrs.items():
                 self.send_header(k, v)
@@ -206,6 +554,9 @@ def serve(frontend: S3Frontend, port: int = 0):
 
         def do_PUT(self):
             self._run("PUT")
+
+        def do_POST(self):
+            self._run("POST")
 
         def do_DELETE(self):
             self._run("DELETE")
