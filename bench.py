@@ -328,8 +328,9 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
         if uniform:
             ws = [0x10000] * per_host
         else:
-            # heterogeneous drives: the f32+risk draw path with exact
-            # residual replay (crush_fast.py), not the quotient tables
+            # heterogeneous drives: the exact64 draw path (u64 table
+            # divide, zero residuals; f32+replay when a backend can't
+            # lower u64), not the quotient tables
             ws = [int(v) * 0x8000
                   for v in rng_w.integers(1, 5, size=per_host)]
         hosts.append(cw.add_bucket(CRUSH_BUCKET_STRAW2, 1, f"host{h}",
@@ -467,26 +468,35 @@ def main() -> None:
             measure_decode(matrix, batch), 3)
 
     def crush_section() -> None:
+        # STABLE metric keys across rounds/platforms: the workload
+        # size lives in crush_remap_pgs, never in the key name, so
+        # r(N) and r(N+1) JSON lines stay field-compatible even when
+        # a CPU fallback shrinks the workload
         n_pgs = 100_000 if platform else 10_000
         wall_ms, dev_ms, host_ms, resid, rtt_ms = measure_crush_remap(
             n_pgs=n_pgs, epochs=10 if platform else 2)
+        RESULT["crush_remap_pgs"] = n_pgs
         # microseconds, unrounded enough that "fast" and "didn't run"
         # can never be confused (a 0.0 ms report reads as broken)
-        RESULT[f"crush_remap_{n_pgs // 1000}k_pgs_us"] = round(
-            dev_ms * 1000.0, 2)
+        RESULT["crush_remap_us"] = round(dev_ms * 1000.0, 2)
         RESULT["crush_remap_wall_ms"] = round(wall_ms, 2)
         RESULT["transport_rtt_ms"] = round(rtt_ms, 2)
         RESULT["crush_residual_fraction"] = resid
+        if host_ms:
+            # absolute native-host number too, so vs_native is
+            # interpretable from this line alone
+            RESULT["crush_remap_native_host_ms"] = round(host_ms, 2)
         if host_ms and dev_ms > 0:
             RESULT["crush_remap_vs_native_host"] = round(
                 host_ms / dev_ms, 2)
 
     def crush_nonuniform_section() -> None:
         # the <50 ms target on a 2-level map with NON-uniform weights:
-        # exercises the f32 draw + exact-residual-replay path
+        # exercises the exact64 draw (f32 + residual replay fallback)
         n_pgs = 100_000 if platform else 10_000
         wall_ms, dev_ms, _host, resid, _rtt = measure_crush_remap(
             n_pgs=n_pgs, epochs=10 if platform else 2, uniform=False)
+        RESULT["crush_remap_nonuniform_pgs"] = n_pgs
         RESULT["crush_remap_nonuniform_us"] = round(dev_ms * 1000.0, 2)
         RESULT["crush_remap_nonuniform_wall_ms"] = round(wall_ms, 2)
         RESULT["crush_nonuniform_residual_fraction"] = resid

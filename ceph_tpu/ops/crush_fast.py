@@ -45,6 +45,7 @@ loop kernel or the host.
 from __future__ import annotations
 
 import hashlib
+import os
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -184,7 +185,7 @@ class FastRule:
 
     def __init__(self, C: CompiledCrushMap, ruleno: int, result_max: int,
                  tries_cap: int = 4, leaf_tries_cap: int = 4,
-                 choose_args=None):
+                 choose_args=None, exact64: Optional[bool] = None):
         m = C.map
         self.ruleno = ruleno
         self.choose_args = choose_args
@@ -341,6 +342,17 @@ class FastRule:
         self.C = C
         self.result_max = result_max
         self._build_quotient_tables()
+        # non-quotient-table levels (non-uniform weights, choose_args,
+        # small w) draw EXACTLY with the u64 table-gather + divide —
+        # the same div64_s64 the loop kernel runs on device — instead
+        # of the f32 approximation, killing the residual-replay tail.
+        # One-time cost in the cached candidate phase; the per-epoch
+        # resolve stays 32-bit.  Opt out (or auto-fallback when a
+        # backend can't lower u64 divide) -> f32 + risk flags.
+        if exact64 is None:
+            exact64 = os.environ.get("CEPH_TPU_CRUSH_EXACT64",
+                                     "1") != "0"
+        self._exact64 = exact64 and not all(self._lvl_int)
         self._cand_key: Optional[bytes] = None
         self._cand = None
         self._cand_jit = jax.jit(self._candidates)
@@ -428,6 +440,28 @@ class FastRule:
         items = jnp.take_along_axis(C.items[bidx], win[:, None], axis=1)[:, 0]
         return items, jnp.zeros(x.shape, dtype=bool)
 
+    def _straw2_exact64(self, bidx, x, r, pos):
+        """Bit-exact straw2 for arbitrary (incl. per-position) weights:
+        q = (2^48 - crush_ln(u)) // w in integer 64-bit, argmin with
+        first-index tie-break == mapper.c:322-367's strict-greater
+        update over div64_s64 draws.  Requires an enable_x64 trace
+        scope (prepare_candidates provides it)."""
+        C = self.C
+        ids = C.hash_ids[bidx]                   # (N, S)
+        w = C.weights[jnp.minimum(pos, C.npos - 1), bidx]  # (N, S) u32
+        u = hash32_3(x[:, None], ids, r[:, None]) & jnp.uint32(0xFFFF)
+        # constant converted at use site so the int64 table survives
+        # only inside the x64 trace (crush_kernels.py's convention)
+        g = jnp.asarray(_G_EXACT)[u.astype(jnp.int32)]
+        valid = (C.lane[None, :] < C.sizes[bidx][:, None]) & (w > 0)
+        q = jnp.where(valid,
+                      g // jnp.maximum(w, 1).astype(jnp.int64),
+                      jnp.int64(1) << jnp.int64(62))
+        win = jnp.argmin(q, axis=1)
+        items = jnp.take_along_axis(C.items[bidx], win[:, None],
+                                    axis=1)[:, 0]
+        return items, jnp.zeros(x.shape, dtype=bool)
+
     def _straw2_f32(self, bidx, x, r, pos):
         """f32 draw with exactness guard: lanes whose top-two draws are
         within the float error bound (or the integer floor-tie window) get
@@ -462,6 +496,8 @@ class FastRule:
         for d in range(depth):
             if self._lvl_int[base_level + d]:
                 item, rk = self._straw2_int(bidx, x, r)
+            elif self._exact64:
+                item, rk = self._straw2_exact64(bidx, x, r, pos)
             else:
                 item, rk = self._straw2_f32(bidx, x, r, pos)
             risky = risky | rk
@@ -949,12 +985,42 @@ class FastRule:
         key = hashlib.sha1(xs.tobytes()).digest()
         if self._cand_key != key:
             xd = jnp.asarray(xs)
-            self._cand = jax.block_until_ready(self._cand_jit(xd))
+            self._cand = jax.block_until_ready(
+                self._run_candidates(xd))
             self._cand_x = xd
             self._cand_key = key
             self._prev_packed = None
             self._host_out = None
             self._host_counts = None
+
+    def _run_candidates(self, xd):
+        """The candidate trace; exact64 draws need an x64 scope.  A
+        backend that cannot lower the u64 divide drops to the f32 +
+        risk-flag draw (correctness preserved via residual replay)."""
+        if not self._exact64:
+            return self._cand_jit(xd)
+        try:
+            with jax.enable_x64(True):
+                return self._cand_jit(xd)
+        except Exception as e:
+            # only an UNIMPLEMENTED-class lowering failure means the
+            # backend can't do u64 divide; transient transport errors
+            # must propagate or they'd silently downgrade exactness
+            msg = str(e)
+            if not any(s in msg for s in ("UNIMPLEMENTED",
+                                          "Unimplemented",
+                                          "not supported",
+                                          "Unsupported")):
+                raise
+            from ..common.dout import dlog
+            dlog("crush", 0,
+                 "exact64 draw unavailable on this backend "
+                 f"({type(e).__name__}); falling back to f32 + "
+                 "residual replay")
+            self._exact64_fallback = msg[:200]
+            self._exact64 = False
+            self._cand_jit = jax.jit(self._candidates)  # fresh trace
+            return self._cand_jit(xd)
 
     def resolve_device(self, weight) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Device-resident resolution against the cached candidates:

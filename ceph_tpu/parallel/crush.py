@@ -57,7 +57,11 @@ class ShardedFastRule:
         if self._cand_key == key:
             return
         xd = jax.device_put(xs_padded, self._xs_sharding)
-        self._cand = jax.block_until_ready(self.fr._cand_jit(xd))
+        # _run_candidates, NOT _cand_jit: the exact64 draw needs its
+        # enable_x64 trace scope — a direct _cand_jit call would
+        # silently truncate the u64 tables to 32 bits
+        self._cand = jax.block_until_ready(
+            self.fr._run_candidates(xd))
         self._cand_x = xd
         self._cand_key = key
 

@@ -148,11 +148,15 @@ def test_fast_pathological_weight_dynamic_range():
 
 
 def test_fast_near_tie_storm_huge_weights():
-    """Near-maximal, slightly distinct bucket item weights force the f32
-    path (non-uniform) in the coarse-quotient regime: floor(G/w) has only
+    """Near-maximal, slightly distinct bucket item weights force the
+    non-uniform path in the coarse-quotient regime: floor(G/w) has only
     ~2^17 distinct values, so draws tie constantly and the reference
-    breaks them by item index.  TIE_PAD must flag every such lane for
-    exact replay — parity is the assertion."""
+    breaks them by item index.
+
+    The default exact64 draw must get every tie right on device with
+    ZERO residual replays (first-index argmin == strict-greater
+    update); the f32 fallback must flag every such lane via TIE_PAD
+    for exact replay.  Parity is the assertion for both."""
     from ceph_tpu.crush import CrushWrapper, CRUSH_BUCKET_STRAW2
     cw = CrushWrapper()
     cw.set_type_name(1, "host")
@@ -168,15 +172,22 @@ def test_fast_near_tie_storm_huge_weights():
     cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default", hosts,
                   [0x7fffffff - h for h in range(12)], id=-1)
     rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    weight = [0x10000] * osd
+    expect = [cw.do_rule(rno, x, 3, weight) for x in range(500)]
+    # exact64 (default): device-exact, no replays even in a tie storm
     fr = compile_fast_rule(cw.crush, rno, 3)
     assert not any(fr.integer_exact_levels), \
-        "non-uniform weights must use the f32 path"
-    weight = [0x10000] * osd
+        "non-uniform weights must not take the quotient-table path"
     res, cnt = fr.map_batch(np.arange(500, dtype=np.uint32), weight)
-    assert fr.residual_fraction > 0  # ties were actually flagged
+    assert fr.residual_fraction == 0.0
     for x in range(500):
-        expect = cw.do_rule(rno, x, 3, weight)
-        assert list(res[x, :cnt[x]]) == expect, x
+        assert list(res[x, :cnt[x]]) == expect[x], x
+    # f32 fallback: ties flagged for replay, combined result exact
+    fr32 = compile_fast_rule(cw.crush, rno, 3, exact64=False)
+    res, cnt = fr32.map_batch(np.arange(500, dtype=np.uint32), weight)
+    assert fr32.residual_fraction > 0  # ties were actually flagged
+    for x in range(500):
+        assert list(res[x, :cnt[x]]) == expect[x], x
 
 
 def test_fast_choose_args_disable_integer_path():

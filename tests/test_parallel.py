@@ -118,3 +118,38 @@ def test_sharded_crush_resolve_matches_host_oracle():
     # the resolve output is actually sharded across devices
     packed = sf.resolve_device(w)
     assert len(packed.sharding.device_set) == 8
+
+
+def test_sharded_crush_nonuniform_exact64_parity():
+    """Regression: the sharded candidate build must go through
+    FastRule._run_candidates so the exact64 draw traces under x64 —
+    a direct _cand_jit call silently truncates the u64 tables to 32
+    bits and produces wrong placements with risky=False."""
+    import numpy as np
+    from ceph_tpu.crush import CrushWrapper, CRUSH_BUCKET_STRAW2
+    from ceph_tpu.parallel import make_mesh
+    from ceph_tpu.parallel.crush import ShardedFastRule
+
+    rng = np.random.default_rng(3)
+    cw = CrushWrapper()
+    cw.set_type_name(1, "host")
+    cw.set_type_name(10, "root")
+    hosts, osd = [], 0
+    for h in range(8):
+        osds = list(range(osd, osd + 4))
+        osd += 4
+        ws = [int(w) for w in rng.integers(0x9000, 0x22000, 4)]
+        hosts.append(cw.add_bucket(CRUSH_BUCKET_STRAW2, 1, f"h{h}",
+                                   osds, ws, id=-(h + 2)))
+    cw.set_max_devices(osd)
+    cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default", hosts,
+                  [0x30000] * 8, id=-1)
+    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
+    sf = ShardedFastRule(cw.crush, rno, 3, make_mesh(8))
+    assert sf.fr._exact64        # non-uniform weights: exact64 is on
+    xs = np.arange(640, dtype=np.uint32)
+    w = [0x10000] * osd
+    res, cnt = sf.map_batch(xs, np.asarray(w, np.uint32))
+    for x in range(640):
+        expect = cw.do_rule(rno, int(x), 3, list(w))
+        assert [int(v) for v in res[x, :cnt[x]]] == expect, x
