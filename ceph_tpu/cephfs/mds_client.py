@@ -41,12 +41,14 @@ class FileHandle:
     (ObjectCacher role, one-file scale)."""
 
     def __init__(self, fs: "RemoteCephFS", path: str, inode: Dict,
-                 caps: int, snapc: Tuple[int, List[int]]):
+                 caps: int, snapc: Tuple[int, List[int]],
+                 mds: str = ""):
         self.fs = fs
         self.path = path
         self.inode = inode
         self.caps = caps
         self.snapc = snapc
+        self.mds = mds           # the rank daemon that issued the caps
         self.buffer: List[Tuple[int, bytes]] = []
         self.size = inode["size"]
 
@@ -91,7 +93,10 @@ class FileHandle:
 
     def close(self) -> None:
         self.flush()
-        self.fs._request("release", ino=self.inode["ino"])
+        # release is ino-addressed (no path to route by): it must go
+        # to the RANK that issued the caps, not the default target
+        self.fs._request("release", ino=self.inode["ino"],
+                         _target=self.mds)
         self.fs._handles.pop(self.inode["ino"], None)
 
 
@@ -116,6 +121,11 @@ class RemoteCephFS:
         self._tid = _secrets.randbits(40) << 8
         self._replies: Dict[int, MClientReply] = {}
         self._handles: Dict[int, FileHandle] = {}
+        # multi-active routing: rank -> daemon name (from the fsmap
+        # or forward replies) and learned per-directory auth hints —
+        # misses self-correct via MDS_FORWARD replies
+        self._ranks: Dict[int, str] = {}
+        self._auth_hint: Dict[str, str] = {}
         # revokes arrive inside a network pump, where the flush's own
         # rados round trips cannot run (nested pumps no-op); they are
         # queued and drained by process() — from our request loops, or
@@ -147,9 +157,12 @@ class RemoteCephFS:
 
     def process(self) -> None:
         """Service pending cap revokes: write back buffered data, then
-        ack with the wrstat payload (the Locker flush round)."""
+        ack with the wrstat payload (the Locker flush round).  The
+        flush answers the RANK that sent the revoke (msg.src), which
+        under multi-active need not be our default target."""
         while self._pending_revokes:
             msg = self._pending_revokes.pop(0)
+            revoker = getattr(msg, "src", "") or self.mds
             fh = self._handles.pop(msg.ino, None)
             if fh is not None:
                 had_buffer = bool(fh.buffer)
@@ -169,17 +182,17 @@ class RemoteCephFS:
                                       size=fh.size, mtime=time.time())
                     except FsError:
                         pass
-                self._send_flush(fh)
+                self._send_flush(fh, to=revoker)
             else:
                 self.client.messenger.send_message(MClientCaps(
                     op=MClientCaps.OP_FLUSH, ino=msg.ino,
-                    seq=msg.seq), self.mds)
+                    seq=msg.seq), revoker)
 
-    def _send_flush(self, fh: FileHandle) -> None:
+    def _send_flush(self, fh: FileHandle, to: str = "") -> None:
         self.client.messenger.send_message(MClientCaps(
             op=MClientCaps.OP_FLUSH, ino=fh.inode["ino"],
             data={"path": fh.path, "size": fh.size,
-                  "mtime": time.time()}), self.mds)
+                  "mtime": time.time()}), to or fh.mds or self.mds)
 
     def _resolve_mds(self, timeout: float = 60.0) -> str:
         """The ACTIVE mds from the mon's replicated fsmap ('ceph mds
@@ -198,11 +211,41 @@ class RemoteCephFS:
             _time.sleep(0.3)
         raise FsError("resolve_mds", -110)
 
+    def _hint_key(self, op: str, args: Dict) -> Optional[str]:
+        path = args.get("src" if op == "rename" else
+                        "existing" if op == "hardlink" else "path")
+        if not isinstance(path, str):
+            return None
+        parts = [p for p in path.split("/") if p]
+        return "/" + "/".join(parts[:-1]) if len(parts) > 1 else "/"
+
+    def _resolve_rank(self, rank: int, timeout: float = 60.0) -> str:
+        """rank -> daemon name from the fsmap (waits through a
+        failover window)."""
+        import time as _time
+        end = _time.monotonic() + timeout
+        while _time.monotonic() < end:
+            try:
+                st = self.client.mon_command("fs_status")
+                name = (st or {}).get("ranks", {}).get(str(rank))
+                if name:
+                    return name
+            except (IOError, ValueError):
+                pass
+            self.client.network.pump()
+            _time.sleep(0.3)
+        raise FsError("resolve_rank", -110)
+
     def _request(self, op: str, _refind: bool = True,
-                 _reqid: str = "", **args):
+                 _reqid: str = "", _target: str = "",
+                 _hops: int = 0, **args):
         if self._auto and not self.mds:
             self.mds = self._resolve_mds()
         self.process()          # our own pending flushes go first
+        hint_key = self._hint_key(op, args)
+        target = _target or \
+            (self._auth_hint.get(hint_key, self.mds)
+             if hint_key is not None else self.mds)
         self._tid += 1
         tid = self._tid
         # the reqid survives a failover retry with its ORIGINAL tid, so
@@ -210,7 +253,7 @@ class RemoteCephFS:
         # can recognize an already-applied mutation
         reqid = _reqid or f"{self.client.name}#{tid}"
         self.client.messenger.send_message(MClientRequest(
-            tid=tid, op=op, args=args, reqid=reqid), self.mds)
+            tid=tid, op=op, args=args, reqid=reqid), target)
         import time as _time
         for attempt in range(MAX_ATTEMPTS):
             self.client.network.pump()
@@ -220,16 +263,37 @@ class RemoteCephFS:
                 self.client.network.pump()
             rep = self._replies.pop(tid, None)
             if rep is not None:
+                from ..mds.server import MDS_FORWARD
+                if rep.result == MDS_FORWARD:
+                    # not that rank's subtree: chase the auth rank
+                    # with the SAME reqid (lite MClientRequestForward)
+                    if _hops >= 4:
+                        raise FsError(op, -40)       # ELOOP
+                    rank = int(rep.data.get("forward_rank", 0))
+                    self._ranks.update(
+                        {rank: rep.data["mds"]}
+                        if rep.data.get("mds") else {})
+                    nxt = self._ranks.get(rank) or \
+                        self._resolve_rank(rank)
+                    if hint_key is not None:
+                        self._auth_hint[hint_key] = nxt
+                    return self._request(op, _refind=_refind,
+                                         _reqid=reqid, _target=nxt,
+                                         _hops=_hops + 1, **args)
                 if rep.result < 0:
                     raise FsError(op, rep.result)
+                self._last_mds = target
                 return rep.data
             if self._drive is None and attempt > 2:
                 _time.sleep(0.25)   # cross-process: let the mds run
         if self._auto and _refind:
-            # the active may have failed over: re-resolve and retry
+            # the target may have failed over: re-resolve and retry
             # once against the new incumbent, carrying the SAME reqid
             # so an op the dead active already journaled is not
-            # re-executed
+            # re-executed.  Learned hints are dropped — the fsmap may
+            # have reshuffled every rank.
+            self._auth_hint.clear()
+            self._ranks.clear()
             self.mds = self._resolve_mds()
             return self._request(op, _refind=False, _reqid=reqid,
                                  **args)
@@ -278,6 +342,13 @@ class RemoteCephFS:
     def truncate(self, path: str, size: int) -> None:
         self._request("truncate", path=path, size=size)
 
+    def set_dir_pin(self, path: str, rank: int) -> Dict:
+        """Pin *path*'s subtree to an MDS rank (setfattr -n
+        ceph.dir.pin): the journaled subtree handoff.  Served by the
+        CURRENT auth rank, which drains caps under the subtree before
+        the pin commits."""
+        return self._request("set_dir_pin", path=path, rank=rank)
+
     # ---- caps + file io ----------------------------------------------------
     def open(self, path: str, mode: str = "r") -> FileHandle:
         """'r' wants CACHE, 'w' wants BUFFER (+creates).  The MDS
@@ -288,7 +359,8 @@ class RemoteCephFS:
         out = self._request("open", path=path, want=want,
                             create="w" in mode)
         fh = FileHandle(self, path, out["inode"], out["caps"],
-                        (out["snapc_seq"], out["snapc_snaps"]))
+                        (out["snapc_seq"], out["snapc_snaps"]),
+                        mds=getattr(self, "_last_mds", "") or self.mds)
         self._handles[out["inode"]["ino"]] = fh
         return fh
 
@@ -315,7 +387,8 @@ class RemoteCephFS:
             return self._read_data(inode, offset, length,
                                    inode["size"])
         finally:
-            self._request("release", ino=fh.inode["ino"])
+            self._request("release", ino=fh.inode["ino"],
+                          _target=fh.mds)
             self._handles.pop(fh.inode["ino"], None)
 
     # ---- data plumbing (direct to OSDs) ------------------------------------

@@ -61,10 +61,20 @@ def _j(obj) -> bytes:
 # ops that mutate metadata and therefore ride the MDS journal
 _JOURNALED = {"mkdir", "create", "symlink", "hardlink", "unlink",
               "rmdir", "rename", "setattr", "wrstat", "truncate",
-              "snap_create", "snap_remove"}
+              "snap_create", "snap_remove", "set_dir_pin"}
 # ops answered read-only
 _READONLY = {"stat", "listdir", "readlink", "resolve", "exists",
              "lssnap", "open", "release", "walk_snapc"}
+
+# the request key that names the op's PRIMARY path — the one whose
+# subtree authority decides which rank serves it (Server::
+# dispatch_client_request routing by dentry auth)
+_PATH_KEY = {"rename": "src", "hardlink": "existing"}
+
+# MClientReply.result for "not my subtree — retry at rank N" (the
+# lite form of MClientRequestForward); data carries forward_rank and,
+# when known, the serving daemon's name
+MDS_FORWARD = -2001
 
 
 class MDSDaemon:
@@ -74,7 +84,8 @@ class MDSDaemon:
 
     def __init__(self, network, rados: RadosClient, name: str = "mds.0",
                  metadata_pool: str = "fsmeta", data_pool: str = "fsdata",
-                 mkfs: bool = False, session_timeout: float = 20.0):
+                 mkfs: bool = False, session_timeout: float = 20.0,
+                 rank: int = 0):
         from ..journal import Journaler
         self.network = network
         self.name = name
@@ -100,24 +111,50 @@ class MDSDaemon:
         self.dpool = data_pool
         self.fs = CephFS(rados, metadata_pool, data_pool)
         self.session_timeout = session_timeout
-        self.journal = Journaler(rados, metadata_pool, MDLOG_ID,
+        # subtree authority: this daemon serves RANK ``rank`` of the
+        # fs (mds_rank_t); ranks partition the namespace by directory
+        # pins (ceph.dir.pin vxattr / MDSMonitor fsmap ranks).  Each
+        # rank journals to its OWN mdlog (the reference's per-rank
+        # 0x200+rank journal inos); rank 0 keeps the legacy name so
+        # single-active clusters are unchanged on disk.
+        self.rank = rank
+        self.mds_map: Dict[int, str] = {rank: name}
+        self._cap_paths: Dict[int, str] = {}
+        jname = MDLOG_ID if rank == 0 else f"{MDLOG_ID}.{rank}"
+        self.journal = Journaler(rados, metadata_pool, jname,
                                  entries_per_object=128)
         from ..journal import JournalError
-        if mkfs:
-            self.fs.mkfs()
+        if mkfs and rank == 0:
+            try:
+                self.fs.mkfs()
+            except FsError as e:
+                # a RETRIED boot (journal/PG settling killed the
+                # previous attempt after mkfs landed) must not wedge
+                # on its own half-finished init
+                if e.result != -17:
+                    raise
+        # open-or-create: a freshly promoted rank (or first boot)
+        # creates its journal; a rebooted one opens and replays
+        try:
+            self.journal.open()
+        except JournalError as e:
+            # only a first boot (mkfs) or a freshly promoted rank > 0
+            # may create its journal; a plain rank-0 reboot with a
+            # MISSING journal is a misconfiguration (wrong pool, lost
+            # data) that must fail loudly, never silently skip replay
+            if e.result != -2 or not (mkfs or rank > 0):
+                raise
             try:
                 self.journal.create(order=20, splay_width=2)
-            except JournalError as e:
-                if e.result != -17:
+            except JournalError as e2:
+                if e2.result != -17:
                     raise
-                self.journal.open()   # a retried boot already made it
-            try:
-                self.journal.register_client("mds")
-            except JournalError as e:
-                if e.result != -17:
-                    raise
-        else:
-            self.journal.open()
+                self.journal.open()   # a racing boot already made it
+        try:
+            self.journal.register_client("mds")
+        except JournalError as e:
+            if e.result != -17:
+                raise
         # caps: ino -> {client: capbits}; revokes: ino -> {client:
         # (seq, issued_at)} with issued_at None until the first tick
         # supplies a clock; _inbox: dispatch only ENQUEUES (handlers do
@@ -213,6 +250,98 @@ class MDSDaemon:
                 self._handle_caps(msg)
         return n
 
+    # ---- subtree authority (multi-active ranks) ----------------------------
+    def set_mds_map(self, ranks: Dict[int, str]) -> None:
+        """Current rank->daemon map from the fsmap ('ceph fs status'):
+        pins to ranks outside this map are ignored, exactly like the
+        reference ignoring export_pin targets beyond max_mds."""
+        self.mds_map = {int(r): n for r, n in ranks.items()}
+        if self.rank not in self.mds_map:
+            self.mds_map[self.rank] = self.name
+
+    def _auth_rank(self, path: str) -> int:
+        """The rank authoritative for *path*: the deepest ancestor
+        directory pin along the (existing) path, rank 0 otherwise —
+        static export pins as the lite MDBalancer (CInode::
+        get_export_pin / Migrator policy at lite scale)."""
+        auth = 0
+        cur = ROOT_INO
+        try:
+            parts = self.fs._split(path)
+        except Exception:
+            return auth
+        for part in parts:
+            try:
+                inode = self.fs._lookup(cur, part)
+            except FsError:
+                break
+            pin = inode.get("pin")
+            if inode.get("type") != "dir":
+                break
+            if pin is not None and int(pin) in self.mds_map:
+                auth = int(pin)
+            cur = inode["ino"]
+        return auth
+
+    def _route(self, op: str, args: Dict) -> Optional[int]:
+        """None = ours; else the rank to forward to.  Single-rank maps
+        short-circuit (no lookups on the hot path)."""
+        if len(self.mds_map) <= 1:
+            return None
+        path = args.get(_PATH_KEY.get(op, "path"))
+        if not isinstance(path, str):
+            return None              # ino-addressed (release): local
+        auth = self._auth_rank(path)
+        return None if auth == self.rank else auth
+
+    def _subtree_cap_inos(self, path: str) -> List[int]:
+        """Inos with outstanding caps under *path* (handoff drain)."""
+        prefix = "/" + "/".join(self.fs._split(path))
+        out = []
+        for ino, p in self._cap_paths.items():
+            if not self.caps.get(ino):
+                continue
+            q = "/" + "/".join(self.fs._split(p))
+            if q == prefix or q.startswith(prefix + "/"):
+                out.append(ino)
+        return out
+
+    def _op_set_dir_pin(self, msg: MClientRequest,
+                        args: Dict) -> Optional[Dict]:
+        """Repin a subtree to another rank — the journaled handoff
+        (Migrator::export_dir at lite scale).  Outstanding caps under
+        the subtree are revoked and flushed FIRST, so the new
+        authority never sees a writer it doesn't know about; the pin
+        itself is one journaled event."""
+        rank = int(args["rank"])
+        inode = self.fs._resolve(args["path"], follow_final=True)
+        if inode["type"] != "dir":
+            raise FsError("set_dir_pin", -20)        # ENOTDIR
+        held = self._subtree_cap_inos(args["path"])
+        parked_on = None
+        for ino in held:
+            holders = self.caps.get(ino, {})
+            pending = self.revoking.setdefault(ino, {})
+            for other in [c for c in holders if c not in pending]:
+                self.cap_seq += 1
+                pending[other] = (self.cap_seq,
+                                  self.now if self.now else None)
+                self.messenger.send_message(MClientCaps(
+                    op=MClientCaps.OP_REVOKE, ino=ino,
+                    caps=holders[other], seq=self.cap_seq), other)
+            if pending and parked_on is None:
+                parked_on = ino
+            elif not pending:
+                self.revoking.pop(ino, None)
+        if parked_on is not None:
+            # re-dispatched by _kick once this ino drains; the re-run
+            # re-checks the remaining holders
+            self.waiting.setdefault(parked_on, []).append(msg)
+            return None
+        return self._journal_and_apply(
+            "set_dir_pin", {"path": args["path"], "rank": rank},
+            getattr(msg, "reqid", ""))
+
     def beacon(self, mons, state: str = "active") -> None:
         """MMDSBeacon to every mon (MDSDaemon::beacon_send): liveness
         for the MDSMonitor's fsmap — a silent active gets failed over
@@ -239,6 +368,8 @@ class MDSDaemon:
                 elif now - issued > self.session_timeout:
                     del m[client]
                     self.caps.get(ino, {}).pop(client, None)
+                    if not self.caps.get(ino):
+                        self._cap_paths.pop(ino, None)
             if not m:
                 del self.revoking[ino]
                 self._kick(ino)
@@ -286,11 +417,15 @@ class MDSDaemon:
                 msg.src not in self.revoking.get(ino, {}):
             return
         # the flush carries the holder's write-back results (wrstat):
-        # journal + apply them before anyone else touches the file
-        if msg.data.get("path") is not None and "size" in msg.data:
+        # journal + apply them before anyone else touches the file.
+        # The ino's CURRENT path (our cap bookkeeping, kept fresh
+        # across renames) outranks the client's open-time path — the
+        # reference's cap flushes are ino-addressed for this reason.
+        path = self._cap_paths.get(ino) or msg.data.get("path")
+        if path is not None and "size" in msg.data:
             try:
                 self._journal_and_apply("wrstat", {
-                    "path": msg.data["path"],
+                    "path": path,
                     "size": msg.data["size"],
                     "mtime": msg.data.get("mtime", time.time())})
             except FsError:
@@ -301,6 +436,8 @@ class MDSDaemon:
             if not m:
                 del self.revoking[ino]
         self.caps.get(ino, {}).pop(msg.src, None)
+        if not self.caps.get(ino):
+            self._cap_paths.pop(ino, None)
         self._kick(ino)
 
     def _kick(self, ino: int) -> None:
@@ -316,28 +453,46 @@ class MDSDaemon:
     def _handle_request(self, msg: MClientRequest) -> None:
         op, args = msg.op, dict(msg.args)
         try:
-            if op == "open":
+            reqid = getattr(msg, "reqid", "")
+            if op in _JOURNALED and reqid \
+                    and reqid in self._completed:
+                # a failover retry of an op WE already journaled (or
+                # replayed): answer from effect, never re-execute
+                # (mkdir would EEXIST, rename would ENOENT, snap ids
+                # would double-allocate).  Checked BEFORE routing —
+                # the subtree may have been repinned since the
+                # original ran, and forwarding the retry would
+                # re-execute it on the new auth rank.
+                self._reply(msg, 0, self._replayed_reply(op, args))
+                return
+            fwd = self._route(op, args)
+            if fwd is not None:
+                # not our subtree: point the client at the auth rank
+                # (MClientRequestForward at lite scale)
+                self._reply(msg, MDS_FORWARD, {
+                    "forward_rank": fwd,
+                    "mds": self.mds_map.get(fwd, "")})
+                return
+            if op == "set_dir_pin":
+                out = self._op_set_dir_pin(msg, args)
+                if out is None:
+                    return           # parked on the cap drain
+            elif op == "open":
                 out = self._op_open(msg, args)
                 if out is None:
                     return               # parked on a revoke round
             elif op == "release":
                 ino = int(args["ino"])
                 self.caps.get(ino, {}).pop(msg.src, None)
+                if not self.caps.get(ino):
+                    self._cap_paths.pop(ino, None)
                 out = {}
             elif op == "wrstat" and not self._wrstat_allowed(msg,
                                                              args):
                 self._reply(msg, -13, {"error": "stale cap flush"})
                 return
             elif op in _JOURNALED:
-                reqid = getattr(msg, "reqid", "")
-                if reqid and reqid in self._completed:
-                    # a failover retry of an op the dead active already
-                    # journaled (and we replayed): answer from effect,
-                    # never re-execute (mkdir would EEXIST, rename
-                    # would ENOENT, snap ids would double-allocate)
-                    out = self._replayed_reply(op, args)
-                else:
-                    out = self._journal_and_apply(op, args, reqid)
+                out = self._journal_and_apply(op, args, reqid)
             elif op in _READONLY:
                 out = self._apply(op, args)
             else:
@@ -407,6 +562,7 @@ class MDSDaemon:
         granted = self._issue(msg.src, inode["ino"], want, msg)
         if granted is None:
             return None
+        self._cap_paths[inode["ino"]] = path
         seq, snaps = self._file_snapc(path)
         return {"inode": inode, "caps": granted,
                 "snapc_seq": seq, "snapc_snaps": snaps,
@@ -531,6 +687,17 @@ class MDSDaemon:
             return {}
         if op == "rename":
             fs.rename(args["src"], args["dst"])
+            # cap bookkeeping follows the namespace: open handles on
+            # renamed files must still be found by a later subtree
+            # cap drain (set_dir_pin under the NEW path)
+            src = "/" + "/".join(fs._split(args["src"]))
+            dst = "/" + "/".join(fs._split(args["dst"]))
+            for ino, p in list(self._cap_paths.items()):
+                q = "/" + "/".join(fs._split(p))
+                if q == src:
+                    self._cap_paths[ino] = dst
+                elif q.startswith(src + "/"):
+                    self._cap_paths[ino] = dst + q[len(src):]
             return {}
         if op == "setattr":
             fs.setattr(args["path"],
@@ -550,6 +717,12 @@ class MDSDaemon:
             tgt_dino, tgt_name, _ = fs._primary_of(dino, name, inode)
             fs._update(tgt_dino, tgt_name, **attrs)
             return {}
+        if op == "set_dir_pin":
+            # the handoff record: one atomic attr merge on the dir's
+            # dentry; authority flips for the whole subtree
+            dino, name, inode = fs._resolve_dentry(args["path"])
+            fs._update(dino, name, pin=int(args["rank"]))
+            return {"ino": inode["ino"], "rank": int(args["rank"])}
         if op == "snap_create":
             return self._op_snap_create(args)
         if op == "snap_remove":

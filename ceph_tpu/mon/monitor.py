@@ -650,23 +650,82 @@ class Monitor(Dispatcher):
     def _fsmap(self) -> Dict:
         import json as _json
         raw = self.config_key_get("fsmap")
-        return _json.loads(raw) if raw else {"mds": {}}
+        fsmap = _json.loads(raw) if raw else {"mds": {}}
+        fsmap.setdefault("max_mds", 1)
+        # rank back-fill for maps persisted before multi-active: a
+        # rankless active is rank 0
+        for e in fsmap["mds"].values():
+            if e.get("state") == "active":
+                e.setdefault("rank", 0)
+        return fsmap
 
     def _save_fsmap(self, fsmap: Dict) -> None:
         import json as _json
         self.config_key_set("fsmap", _json.dumps(fsmap,
                                                  sort_keys=True))
 
+    @staticmethod
+    def _fsmap_ranks(fsmap: Dict) -> Dict[int, str]:
+        """rank -> holder name, actives only."""
+        return {int(e["rank"]): n for n, e in fsmap["mds"].items()
+                if e.get("state") == "active"
+                and e.get("rank") is not None}
+
     def fs_status(self) -> Dict:
         """Read-only fsmap view ('ceph mds stat' / 'ceph fs status'):
-        answerable by any mon — the fsmap is paxos-replicated."""
+        answerable by any mon — the fsmap is paxos-replicated.
+        ``active`` is ordered by RANK (active[0] == rank 0, which is
+        what pre-multi-active clients expect)."""
         fsmap = self._fsmap()
-        active = sorted(n for n, e in fsmap["mds"].items()
-                        if e["state"] == "active")
+        ranks = self._fsmap_ranks(fsmap)
+        active = [ranks[r] for r in sorted(ranks)]
         standby = sorted(n for n, e in fsmap["mds"].items()
                          if e["state"] == "standby")
         return {"mds": fsmap["mds"], "active": active,
-                "standby": standby}
+                "standby": standby, "max_mds": fsmap["max_mds"],
+                "ranks": {str(r): n for r, n in sorted(ranks.items())}}
+
+    def fs_set_max_mds(self, n: int) -> Dict:
+        """'ceph fs set <fs> max_mds <n>' (MDSMonitor::filesystem_set):
+        grow the active-rank count; live standbys are promoted into
+        the new ranks immediately.  Shrinking deactivates the excess
+        ranks (their daemons see the fsmap and respawn as standby)."""
+        n = int(n)
+        if n < 1:
+            raise ValueError("max_mds must be >= 1")
+        fsmap = self._fsmap()
+        fsmap["max_mds"] = n
+        for name, e in sorted(fsmap["mds"].items()):
+            if e.get("state") == "active" and e.get("rank", 0) >= n:
+                fsmap["mds"][name] = {"state": "standby",
+                                      "rank": None}
+                self.log_entry("mon", "INF",
+                               f"mds {name} deactivated "
+                               f"(max_mds={n})")
+        self._fill_ranks(fsmap)
+        self._save_fsmap(fsmap)
+        return {"max_mds": n}
+
+    def _fill_ranks(self, fsmap: Dict) -> None:
+        """Promote LIVE standbys into unheld ranks < max_mds
+        (MDSMonitor::maybe_promote_standby)."""
+        beacons = getattr(self, "_mds_last_beacon", {})
+        held = set(self._fsmap_ranks(fsmap))
+        for rank in range(fsmap["max_mds"]):
+            if rank in held:
+                continue
+            live = sorted(
+                (n for n, e in fsmap["mds"].items()
+                 if e["state"] == "standby"
+                 and self.now - beacons.get(n, -1e18)
+                 <= MDS_BEACON_GRACE))
+            if not live:
+                continue
+            pick = live[0]
+            fsmap["mds"][pick] = {"state": "active", "rank": rank}
+            held.add(rank)
+            self.log_entry("mon", "INF",
+                           f"mds {pick} is now active rank {rank}")
 
     def _handle_mds_beacon(self, msg: MMDSBeacon) -> None:
         if self.peers and not self.is_leader():
@@ -683,21 +742,23 @@ class Monitor(Dispatcher):
         cur = fsmap["mds"].get(msg.name)
         if cur is None or cur["state"] == "failed":
             # new daemon — or a FAILED one beaconing again (restarted
-            # after the grace window): it rejoins, taking the active
-            # seat if nobody holds it (MDSMonitor re-admitting a
-            # formerly-laggy daemon)
-            has_active = any(e["state"] == "active"
-                             for e in fsmap["mds"].values())
-            fsmap["mds"][msg.name] = {
-                "state": "standby" if has_active else "active"}
+            # after the grace window): it rejoins as standby and takes
+            # any unheld rank (MDSMonitor re-admitting a formerly-
+            # laggy daemon)
+            fsmap["mds"][msg.name] = {"state": "standby",
+                                      "rank": None}
+            self._fill_ranks(fsmap)
+            st = fsmap["mds"][msg.name]
+            joined = f"active rank {st['rank']}" \
+                if st["state"] == "active" else "standby"
             self.log_entry("mon", "INF",
-                           f"mds {msg.name} joined as "
-                           f"{fsmap['mds'][msg.name]['state']}")
+                           f"mds {msg.name} joined as {joined}")
             self._save_fsmap(fsmap)
 
     def _check_mds_failover(self, now: float) -> None:
         """Leader tick: fail a silent active and promote a LIVE
-        standby (MDSMonitor::tick beacon grace)."""
+        standby into ITS rank (MDSMonitor::tick beacon grace).
+        Failover is per-rank: other actives are untouched."""
         beacons = getattr(self, "_mds_last_beacon", None)
         if not beacons:
             return
@@ -710,23 +771,13 @@ class Monitor(Dispatcher):
             beacons.setdefault(name, now)
             if now - last <= MDS_BEACON_GRACE:
                 continue
-            # the active is gone: pick the standby we heard from most
-            # recently within the grace window
-            live = [(beacons.get(n, -1e18), n)
-                    for n, se in sorted(fsmap["mds"].items())
-                    if se["state"] == "standby"
-                    and now - beacons.get(n, -1e18) <= MDS_BEACON_GRACE]
-            fsmap["mds"][name] = {"state": "failed"}
+            rank = e.get("rank", 0)
+            fsmap["mds"][name] = {"state": "failed", "rank": None}
             changed = True
-            if live:
-                _t, pick = max(live)
-                fsmap["mds"][pick] = {"state": "active"}
-                self.log_entry("mon", "WRN",
-                               f"mds {name} failed; promoting {pick}")
-            else:
-                self.log_entry("mon", "WRN",
-                               f"mds {name} failed; no standby")
+            self.log_entry("mon", "WRN",
+                           f"mds {name} (rank {rank}) failed")
         if changed:
+            self._fill_ranks(fsmap)
             self._save_fsmap(fsmap)
 
     # ---- pools -------------------------------------------------------------
@@ -1083,7 +1134,7 @@ class Monitor(Dispatcher):
                    "selfmanaged_snap_create", "selfmanaged_snap_remove",
                    "set_pool_quota", "create_replicated_pool",
                    "create_ec_profile", "create_ec_pool",
-                   "delete_pool"}
+                   "delete_pool", "fs_set_max_mds"}
         if msg.cmd not in allowed:
             reply(-22, {"error": f"unknown command {msg.cmd!r}"},
                   cacheable=True)

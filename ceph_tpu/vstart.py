@@ -249,17 +249,30 @@ def mds_main(args) -> None:
             net.send(args.name, m, MMDSBeacon(name=args.name,
                                               state=state))
 
-    def fs_active() -> str:
+    def fs_state():
+        """(my_rank or None, rank->name) from the replicated fsmap."""
         try:
             st = rados.mon_command("fs_status")
-            return st["active"][0] if st and st["active"] else ""
         except (IOError, ValueError):
-            return ""
+            return None, {}
+        if not st:
+            return None, {}
+        ranks = {int(r): n for r, n in
+                 (st.get("ranks") or {}).items()}
+        e = (st.get("mds") or {}).get(args.name)
+        if e and e.get("state") == "active" \
+                and e.get("rank") is not None:
+            return int(e["rank"]), ranks
+        return None, ranks
 
     beacon("standby")
     print("READY", flush=True)
     last_beacon = 0.0
-    while fs_active() != args.name:
+    my_rank = None
+    while my_rank is None:
+        my_rank, _ranks = fs_state()
+        if my_rank is not None:
+            break
         net.pump(quiesce=0.05, deadline=0.3)
         if time.monotonic() - last_beacon > 1.0:
             beacon("standby")
@@ -270,6 +283,8 @@ def mds_main(args) -> None:
     # if another mds was active before us, IT created the fs and we
     # must open + REPLAY, not mkfs.  Transient errors retry (a stale
     # False would journal.open() a journal that never existed).
+    # Rank 0 is the fs creator; a promoted rank > 0 WAITS for the fs
+    # (rank 0's mkfs) and never creates it.
     fresh = None
     deadline = time.monotonic() + 120.0
     while fresh is None:
@@ -278,7 +293,13 @@ def mds_main(args) -> None:
             fresh = False
         except IOError as e:
             if getattr(e, "errno", None) == 2:
-                fresh = True
+                if my_rank == 0:
+                    fresh = True
+                elif time.monotonic() > deadline:
+                    raise RuntimeError("rank 0 never created the fs")
+                else:
+                    net.pump(quiesce=0.05, deadline=0.3)
+                    time.sleep(0.3)
             elif time.monotonic() > deadline:
                 raise
             else:
@@ -289,7 +310,8 @@ def mds_main(args) -> None:
         try:
             mds = MDSDaemon(net, rados, args.name,
                             metadata_pool=args.metadata_pool,
-                            data_pool=args.data_pool, mkfs=fresh)
+                            data_pool=args.data_pool, mkfs=fresh,
+                            rank=my_rank)
         except IOError:
             # some PG of the fresh pools still settling; mkfs/journal
             # creation is idempotent, so just try again
@@ -308,13 +330,19 @@ def mds_main(args) -> None:
             last_beacon = now
         if now - last_fence_check > 2.0:
             last_fence_check = now
-            active = fs_active()
-            if active and active != args.name:
-                # FENCED: the mon failed us over (we stalled past the
-                # beacon grace but did not die).  Two writers on one
-                # MDS journal would corrupt it — suicide and let the
-                # harness restart us as a standby (MDSDaemon::respawn)
-                print(f"fenced: {active} is active now; exiting",
+            rank_now, ranks = fs_state()
+            if ranks:
+                mds.set_mds_map(ranks)
+            # FENCED whenever a REAL fsmap read no longer shows us
+            # holding our rank — reassigned (beacon-grace failover),
+            # demoted (max_mds shrink), or dropped.  Two writers on
+            # one rank journal would corrupt it — suicide and let the
+            # harness restart us as a standby (MDSDaemon::respawn).
+            # An empty ranks dict is a transient mon read failure,
+            # never a fence signal.
+            if ranks and ranks.get(my_rank) != args.name:
+                print(f"fenced: rank {my_rank} is now "
+                      f"{ranks.get(my_rank) or 'unheld'}; exiting",
                       file=sys.stderr, flush=True)
                 os._exit(0)
         mds.tick(now)

@@ -1,0 +1,260 @@
+"""Multi-active MDS: subtree authority by rank, export pins, the
+journaled handoff, forward-based client routing, per-rank failover.
+
+The reference runs multiple active ranks with subtree authority
+partitioning (src/mds/Migrator.cc export/import, MDBalancer.cc;
+export pins via the ceph.dir.pin vxattr, CInode::get_export_pin) and
+the MDSMonitor's per-rank fsmap (src/mon/MDSMonitor.cc).  Lite form:
+static pins partition the namespace; the pin write is the journaled
+handoff; MClientReply(MDS_FORWARD) routes clients to the auth rank.
+"""
+import json
+
+import pytest
+
+from ceph_tpu.cephfs import FsError
+from ceph_tpu.cephfs.mds_client import RemoteCephFS
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.mds import MDSDaemon
+from ceph_tpu.mds.server import MDS_FORWARD
+from ceph_tpu.msg.messages import CEPH_CAP_FILE_BUFFER, MMDSBeacon
+
+
+@pytest.fixture()
+def world():
+    """Two actives (rank 0 + rank 1) and two clients on one fabric."""
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("fsmeta", size=3, pg_num=8)
+    c.create_replicated_pool("fsdata", size=3, pg_num=8)
+    a = MDSDaemon(c.network, c.client("client.mdsa"), "mds.a",
+                  mkfs=True, rank=0)
+    b = MDSDaemon(c.network, c.client("client.mdsb"), "mds.b",
+                  rank=1)
+    ranks = {0: "mds.a", 1: "mds.b"}
+    a.set_mds_map(ranks)
+    b.set_mds_map(ranks)
+    fa = RemoteCephFS(c.client("client.a"), mds_name="mds.a")
+    fb = RemoteCephFS(c.client("client.b"), mds_name="mds.a")
+    fa._drive = lambda: (a.process(), b.process(), fb.process())
+    fb._drive = lambda: (a.process(), b.process(), fa.process())
+    return c, a, b, fa, fb
+
+
+def test_two_actives_serve_disjoint_subtrees(world):
+    """The done-criterion: both ranks serve concurrently, each
+    authoritative for its own subtree; requests sent to the wrong
+    rank are forwarded, and each rank journals ONLY its own ops."""
+    c, a, b, fa, fb = world
+    fa.mkdir("/teamA")
+    fa.mkdir("/teamB")
+    fa.set_dir_pin("/teamB", 1)
+    j_a = a.journal._next_tid
+    j_b = b.journal._next_tid
+    # client A works under /teamA (rank 0), client B under /teamB
+    # (rank 1) — concurrently interleaved
+    fa.create("/teamA/x")
+    fb.create("/teamB/y")
+    fa.write("/teamA/x", b"rank-zero", 0)
+    fb.write("/teamB/y", b"rank-one", 0)
+    assert fa.read("/teamB/y") == b"rank-one"     # cross-visibility
+    assert fb.read("/teamA/x") == b"rank-zero"
+    # rank 1 journaled the /teamB mutations; rank 0 never saw them
+    assert b.journal._next_tid > j_b
+    assert ({json.loads(e)["args"].get("path", "")
+             for e in dict(a.journal.scan_entries()).values()
+             if json.loads(e).get("op") == "create"} &
+            {"/teamB/y"}) == set()
+    # the client LEARNED the auth and now goes direct (hint cached)
+    assert fb._auth_hint.get("/teamB") == "mds.b"
+    # direct-to-wrong-rank gets a forward, not an error: a fresh
+    # client aimed at rank 1 still reaches rank 0's subtree
+    fc = RemoteCephFS(c.client("client.c"), mds_name="mds.b")
+    fc._drive = lambda: (a.process(), b.process())
+    assert fc.stat("/teamA/x")["size"] == 9
+    assert fc._auth_hint.get("/teamA") == "mds.a"
+
+
+def test_forward_reply_shape(world):
+    """The wire shape: ops for a pinned subtree answered MDS_FORWARD
+    with the rank and (when known) the daemon name."""
+    c, a, b, fa, fb = world
+    fa.mkdir("/pinned")
+    fa.set_dir_pin("/pinned", 1)
+    from ceph_tpu.msg.messages import MClientRequest
+
+    class Probe:
+        def __init__(self):
+            self.replies = []
+
+        def ms_fast_dispatch(self, msg):
+            self.replies.append(msg)
+
+    probe = Probe()
+    mess = c.network.create_messenger("client.probe")
+    mess.add_dispatcher_head(probe)
+    mess.send_message(MClientRequest(
+        tid=1, op="mkdir", args={"path": "/pinned/sub"},
+        reqid="probe#1"), "mds.a")
+    c.network.pump()
+    a.process()
+    c.network.pump()
+    assert len(probe.replies) == 1
+    rep = probe.replies[0]
+    assert rep.result == MDS_FORWARD
+    assert rep.data == {"forward_rank": 1, "mds": "mds.b"}
+
+
+def test_pin_to_absent_rank_is_ignored(world):
+    """A pin naming a rank outside the mds_map is inherited over —
+    the reference ignores export_pins beyond max_mds the same way."""
+    c, a, b, fa, fb = world
+    fa.mkdir("/d")
+    fa.set_dir_pin("/d", 7)           # no rank 7 anywhere
+    fa.create("/d/f")                 # rank 0 serves it, no forward
+    assert fa._auth_hint.get("/d") is None
+    assert fa.exists("/d/f")
+
+
+def test_subtree_handoff_drains_caps(world):
+    """Repinning a subtree with a buffered writer drains the caps
+    FIRST: the writer's data is flushed durable before authority
+    moves, so the new rank never sees an unknown writer."""
+    c, a, b, fa, fb = world
+    fa.mkdir("/mig")
+    fh = fb.open("/mig/f", "w")
+    assert fh.caps & CEPH_CAP_FILE_BUFFER
+    fh.write(b"buffered-under-rank0", 0)
+    assert a.fs.stat("/mig/f")["size"] == 0    # still only in buffer
+    fa.set_dir_pin("/mig", 1)                  # the journaled handoff
+    # the drain flushed B's buffer before the pin committed
+    assert a.fs.stat("/mig/f")["size"] == 20
+    assert fh.caps == 0
+    # authority actually moved: rank 1 journals the next mutation
+    j_b = b.journal._next_tid
+    fb.create("/mig/g")
+    assert b.journal._next_tid > j_b
+    assert fa.read("/mig/f") == b"buffered-under-rank0"
+
+
+def test_release_reaches_issuing_rank(world):
+    """close() must release caps at the RANK that issued them — an
+    ino-addressed release to the default rank would leave the real
+    issuer recording a phantom holder forever."""
+    c, a, b, fa, fb = world
+    fa.mkdir("/pin1")
+    fa.set_dir_pin("/pin1", 1)
+    fh = fa.open("/pin1/f", "w")
+    ino = fh.inode["ino"]
+    assert fa.caps_held(b, ino) if hasattr(fa, "caps_held") else \
+        b.caps.get(ino)                       # rank 1 issued the caps
+    fh.close()
+    assert not b.caps.get(ino)                # and rank 1 released
+    # a later repin must not park on a phantom holder
+    fa.set_dir_pin("/pin1", 0)
+    assert fa.exists("/pin1/f")
+
+
+def test_drain_finds_renamed_open_handle(world):
+    """A file renamed into a subtree while its handle is open must
+    still be drained by set_dir_pin (cap bookkeeping follows the
+    namespace, not the open-time path)."""
+    c, a, b, fa, fb = world
+    fa.mkdir("/stay")
+    fa.mkdir("/move")
+    fh = fb.open("/stay/f", "w")
+    fh.write(b"renamed-while-open", 0)
+    fa.rename("/stay/f", "/move/f")
+    assert a.fs.stat("/move/f")["size"] == 0      # still buffered
+    fa.set_dir_pin("/move", 1)                    # must drain fh
+    assert a.fs.stat("/move/f")["size"] == 18
+    assert fa.read("/move/f") == b"renamed-while-open"
+
+
+def test_cross_subtree_rename_crash_safe(world):
+    """Rename from rank 0's subtree into rank 1's: executed by the
+    SOURCE auth as ONE journaled event — a crash between journal and
+    apply replays it; a third incarnation changes nothing."""
+    c, a, b, fa, fb = world
+    fa.mkdir("/src")
+    fa.mkdir("/dst")
+    fa.set_dir_pin("/dst", 1)
+    fa.create("/src/f")
+    fa.write("/src/f", b"crossing", 0)
+    # live path first: the rename is served by /src's auth (rank 0)
+    fa.rename("/src/f", "/dst/f")
+    assert fa.read("/dst/f") == b"crossing"
+    assert not fa.exists("/src/f")
+    # crash window: journaled on rank 0, never applied
+    a.journal.append(json.dumps(
+        {"op": "rename",
+         "args": {"src": "/dst/f", "dst": "/src/f2"}}).encode())
+    a2 = MDSDaemon(c.network, c.client("client.mdsa2"), "mds.a",
+                   rank=0)
+    a2.set_mds_map({0: "mds.a", 1: "mds.b"})
+    f2 = RemoteCephFS(c.client("client.a2"), mds_name="mds.a")
+    f2._drive = lambda: (a2.process(), b.process())
+    assert f2.exists("/src/f2") and not f2.exists("/dst/f")
+    assert f2.read("/src/f2") == b"crossing"
+    # idempotent on a third incarnation
+    a3 = MDSDaemon(c.network, c.client("client.mdsa3"), "mds.a",
+                   rank=0)
+    assert a3.fs.exists("/src/f2") and not a3.fs.exists("/dst/f")
+    assert not any(a3.fs.fsck().values())
+
+
+def test_per_rank_journals_are_separate(world):
+    c, a, b, fa, fb = world
+    assert a.journal.meta_oid != b.journal.meta_oid
+
+
+def _beacon(c, name):
+    c.network.send(name, c.mon.name, MMDSBeacon(name=name))
+    c.network.pump()
+
+
+def test_fsmap_ranks_and_per_rank_failover():
+    """MDSMonitor-lite with max_mds=2: two actives hold ranks 0/1, a
+    silent rank fails over to the standby WITHOUT touching the other
+    rank, and 'ceph fs status' shows the rank table."""
+    c = MiniCluster(n_osds=3)
+    c.mon.fs_set_max_mds(2)
+    _beacon(c, "mds.x")
+    _beacon(c, "mds.y")
+    _beacon(c, "mds.z")
+    st = c.mon.fs_status()
+    assert st["max_mds"] == 2
+    assert st["ranks"] == {"0": "mds.x", "1": "mds.y"}
+    assert st["active"] == ["mds.x", "mds.y"]
+    assert st["standby"] == ["mds.z"]
+    # rank 1 goes silent: beacons keep coming from x and z only
+    from ceph_tpu.mon import monitor as monitor_mod
+    for _ in range(6):
+        c.tick(dt=monitor_mod.MDS_BEACON_GRACE / 3)
+        _beacon(c, "mds.x")
+        _beacon(c, "mds.z")
+    st = c.mon.fs_status()
+    assert st["ranks"]["0"] == "mds.x"        # rank 0 untouched
+    assert st["ranks"]["1"] == "mds.z"        # standby took rank 1
+    assert st["mds"]["mds.y"]["state"] == "failed"
+    # the deposed daemon beacons again: rejoins as standby
+    _beacon(c, "mds.y")
+    st = c.mon.fs_status()
+    assert st["mds"]["mds.y"]["state"] == "standby"
+
+
+def test_fs_set_max_mds_grow_and_shrink():
+    c = MiniCluster(n_osds=3)
+    _beacon(c, "mds.x")
+    _beacon(c, "mds.y")
+    st = c.mon.fs_status()
+    assert st["ranks"] == {"0": "mds.x"}      # max_mds=1 default
+    assert st["standby"] == ["mds.y"]
+    # grow: the live standby is promoted into rank 1 immediately
+    c.mon.fs_set_max_mds(2)
+    st = c.mon.fs_status()
+    assert st["ranks"] == {"0": "mds.x", "1": "mds.y"}
+    # shrink: rank 1 is deactivated back to standby
+    c.mon.fs_set_max_mds(1)
+    st = c.mon.fs_status()
+    assert st["ranks"] == {"0": "mds.x"}
+    assert st["mds"]["mds.y"]["state"] == "standby"

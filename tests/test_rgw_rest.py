@@ -230,8 +230,7 @@ def test_rest_object_acl(rest):
     assert st == 200
     st, _, body = b.req("GET", "/b/o")
     assert (st, body) == (200, b"data")
-    root = b.xml("GET", "/b/o", query={"acl": ""}) if False else \
-        a.xml("GET", "/b/o", query={"acl": ""})
+    root = a.xml("GET", "/b/o", query={"acl": ""})
     assert _text(_find(root, "Owner"), "ID") == "alice"
     # canned ACL directly on upload
     st, _, _ = a.req("PUT", "/b/o2", body=b"x",

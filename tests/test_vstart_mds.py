@@ -106,3 +106,74 @@ def test_mds_standby_takeover(ha_cluster):
     fs2 = RemoteCephFS(c.client("client.y"), mds_name=None)
     assert fs2.read("/ha/f") == b"post-failover"
     assert fs2.exists("/ha/sub")
+
+
+@pytest.fixture(scope="module")
+def multi_cluster():
+    c = ProcessCluster(n_osds=3, n_mds=3, mds_grace=4.0,
+                       client_names=("client.x", "client.y"),
+                       heartbeat_interval=1.0, heartbeat_grace=4.0)
+    yield c
+    c.close()
+
+
+def _wait_status(cl, pred, timeout=150.0):
+    """Event wait on the replicated fsmap (poll the map state, not
+    wall time)."""
+    end = time.monotonic() + timeout
+    while True:
+        try:
+            st = cl.mon_command("fs_status")
+            if st and pred(st):
+                return st
+        except (IOError, ValueError):
+            pass
+        if time.monotonic() > end:
+            raise AssertionError(f"fsmap never satisfied: {st}")
+        time.sleep(0.5)
+
+
+def test_multi_active_subtrees_and_per_rank_failover(multi_cluster):
+    """Two active ranks over real processes: disjoint pinned subtrees
+    served concurrently; SIGKILL of rank 1 recovers ONLY rank 1 (the
+    standby replays mdlog.1 and takes the rank; rank 0's incumbency
+    is untouched); clients re-route via forwards + the fsmap."""
+    c = multi_cluster
+    cl = c.client("client.x")
+    c.wait_healthy(cl)
+    # grow to two ranks: a standby is promoted into rank 1
+    _retrying(lambda: cl.mon_command("fs_set_max_mds", n=2))
+    st = _wait_status(cl, lambda s: len(s.get("ranks", {})) == 2)
+    rank0_before = st["ranks"]["0"]
+    rank1_before = st["ranks"]["1"]
+    assert st["standby"]                      # one standby remains
+    fs = RemoteCephFS(cl, mds_name=None)
+    _retrying(lambda: fs.mkdir("/zero"))
+    fs.mkdir("/one")
+    fs.set_dir_pin("/one", 1)
+    fs.create("/zero/f")
+    fs.write("/zero/f", b"rank-zero-data", 0)
+    fs.create("/one/f")                       # forwarded to rank 1
+    fs.write("/one/f", b"rank-one-data", 0)
+    assert fs.read("/one/f") == b"rank-one-data"
+    # SIGKILL the rank-1 daemon only
+    c.kill_mds(int(rank1_before.split(".")[1]))
+    st = _wait_status(cl, lambda s:
+                      s.get("ranks", {}).get("1") not in
+                      (None, rank1_before))
+    assert st["ranks"]["0"] == rank0_before   # rank 0 untouched
+    # the promoted daemon replayed mdlog.1: /one is intact and serves
+    fs2 = RemoteCephFS(c.client("client.y"), mds_name=None)
+    end = time.monotonic() + 150.0
+    while True:
+        try:
+            assert fs2.read("/one/f") == b"rank-one-data"
+            break
+        except IOError:
+            if time.monotonic() > end:
+                raise
+            time.sleep(1.0)
+    fs2.write("/one/f", b"post-failover!", 0)
+    assert fs2.read("/one/f") == b"post-failover!"
+    # rank 0's subtree never blinked
+    assert fs2.read("/zero/f") == b"rank-zero-data"
