@@ -19,6 +19,9 @@ from .crypto import make_secret
 class Keyring:
     def __init__(self) -> None:
         self.keys: Dict[str, bytes] = {}
+        # entity -> {subsystem: capability string}; written as the
+        # reference's `caps <subsys> = "<grant>"` keyring lines
+        self.caps: Dict[str, Dict[str, str]] = {}
 
     def create(self, entity: str) -> bytes:
         """Generate-or-get a secret for *entity* (ceph auth get-or-create)."""
@@ -29,15 +32,27 @@ class Keyring:
     def get(self, entity: str) -> Optional[bytes]:
         return self.keys.get(entity)
 
+    def set_caps(self, entity: str, caps: Dict[str, str]) -> None:
+        """Replace the entity's full cap set (KeyRing::set_caps — the
+        reference's --cap replaces all previous caps, cap-overwrite.t)."""
+        self.caps[entity] = dict(caps)
+
     # ---- file io -----------------------------------------------------------
-    def save(self, path: str) -> None:
-        lines = []
+    def lines(self) -> list:
+        out = []
         for entity in sorted(self.keys):
-            lines.append(f"[{entity}]")
+            out.append(f"[{entity}]")
             key64 = base64.b64encode(self.keys[entity]).decode()
-            lines.append(f"\tkey = {key64}")
+            out.append(f"\tkey = {key64}")
+            for subsys in sorted(self.caps.get(entity, {})):
+                out.append(f'\tcaps {subsys} = '
+                           f'"{self.caps[entity][subsys]}"')
+        return out
+
+    def save(self, path: str) -> None:
+        lines = self.lines()
         with open(path, "w") as f:
-            f.write("\n".join(lines) + "\n")
+            f.write("\n".join(lines) + ("\n" if lines else ""))
 
     @classmethod
     def load(cls, path: str) -> "Keyring":
@@ -54,4 +69,8 @@ class Keyring:
                     k, v = (s.strip() for s in line.split("=", 1))
                     if k == "key":
                         kr.keys[entity] = base64.b64decode(v)
+                    elif k.startswith("caps "):
+                        subsys = k[len("caps "):].strip()
+                        kr.caps.setdefault(entity, {})[subsys] = \
+                            v.strip().strip('"')
         return kr

@@ -238,7 +238,8 @@ class RemoteCephFS:
 
     def _request(self, op: str, _refind: bool = True,
                  _reqid: str = "", _target: str = "",
-                 _hops: int = 0, **args):
+                 _hops: int = 0, _rank: Optional[int] = None,
+                 **args):
         if self._auto and not self.mds:
             self.mds = self._resolve_mds()
         self.process()          # our own pending flushes go first
@@ -246,6 +247,13 @@ class RemoteCephFS:
         target = _target or \
             (self._auth_hint.get(hint_key, self.mds)
              if hint_key is not None else self.mds)
+        if _rank is None:
+            # remember which RANK we are talking to: a failover retry
+            # must go back to the same rank (whose new holder replayed
+            # that rank's journal and can dedup our reqid), not to
+            # whatever rank the path's auth is after a repin
+            _rank = next((r for r, n in self._ranks.items()
+                          if n == target), 0)
         self._tid += 1
         tid = self._tid
         # the reqid survives a failover retry with its ORIGINAL tid, so
@@ -279,7 +287,8 @@ class RemoteCephFS:
                         self._auth_hint[hint_key] = nxt
                     return self._request(op, _refind=_refind,
                                          _reqid=reqid, _target=nxt,
-                                         _hops=_hops + 1, **args)
+                                         _hops=_hops + 1, _rank=rank,
+                                         **args)
                 if rep.result < 0:
                     raise FsError(op, rep.result)
                 self._last_mds = target
@@ -288,15 +297,21 @@ class RemoteCephFS:
                 _time.sleep(0.25)   # cross-process: let the mds run
         if self._auto and _refind:
             # the target may have failed over: re-resolve and retry
-            # once against the new incumbent, carrying the SAME reqid
-            # so an op the dead active already journaled is not
-            # re-executed.  Learned hints are dropped — the fsmap may
-            # have reshuffled every rank.
+            # once, carrying the SAME reqid TO THE SAME RANK — its
+            # new holder replayed that rank's journal, so an op the
+            # dead incumbent already journaled is answered from
+            # effect, not re-executed (even if the subtree was
+            # repinned in between).  Learned hints are dropped — the
+            # fsmap may have reshuffled every rank.
             self._auth_hint.clear()
             self._ranks.clear()
             self.mds = self._resolve_mds()
+            try:
+                nxt = self._resolve_rank(_rank) if _rank else ""
+            except FsError:
+                nxt = ""
             return self._request(op, _refind=False, _reqid=reqid,
-                                 **args)
+                                 _target=nxt, _rank=_rank, **args)
         raise FsError(op, -110)                       # ETIMEDOUT
 
     # ---- metadata surface (all via the MDS) --------------------------------

@@ -317,7 +317,16 @@ class MDSDaemon:
         inode = self.fs._resolve(args["path"], follow_final=True)
         if inode["type"] != "dir":
             raise FsError("set_dir_pin", -20)        # ENOTDIR
-        held = self._subtree_cap_inos(args["path"])
+        if self._drain_caps(msg, self._subtree_cap_inos(args["path"])):
+            return None
+        return self._journal_and_apply(
+            "set_dir_pin", {"path": args["path"], "rank": rank},
+            getattr(msg, "reqid", ""))
+
+    def _drain_caps(self, msg: MClientRequest, held: List[int]) -> bool:
+        """Start revoke rounds on every held ino; True = *msg* parked
+        (re-dispatched by _kick once the first ino drains; the re-run
+        re-checks the remaining holders)."""
         parked_on = None
         for ino in held:
             holders = self.caps.get(ino, {})
@@ -334,13 +343,9 @@ class MDSDaemon:
             elif not pending:
                 self.revoking.pop(ino, None)
         if parked_on is not None:
-            # re-dispatched by _kick once this ino drains; the re-run
-            # re-checks the remaining holders
             self.waiting.setdefault(parked_on, []).append(msg)
-            return None
-        return self._journal_and_apply(
-            "set_dir_pin", {"path": args["path"], "rank": rank},
-            getattr(msg, "reqid", ""))
+            return True
+        return False
 
     def beacon(self, mons, state: str = "active") -> None:
         """MMDSBeacon to every mon (MDSDaemon::beacon_send): liveness
@@ -437,6 +442,7 @@ class MDSDaemon:
                 del self.revoking[ino]
         self.caps.get(ino, {}).pop(msg.src, None)
         if not self.caps.get(ino):
+            self.caps.pop(ino, None)
             self._cap_paths.pop(ino, None)
         self._kick(ino)
 
@@ -492,6 +498,15 @@ class MDSDaemon:
                 self._reply(msg, -13, {"error": "stale cap flush"})
                 return
             elif op in _JOURNALED:
+                if op == "rename" and len(self.mds_map) > 1 and \
+                        self._auth_rank(args["dst"]) != self.rank:
+                    # a rename OUT of our authority moves any open
+                    # handle beyond our cap bookkeeping's reach — the
+                    # destination auth could never drain it.  Revoke
+                    # + flush first, like the set_dir_pin handoff.
+                    if self._drain_caps(msg, self._subtree_cap_inos(
+                            args["src"])):
+                        return       # parked on the drain
                 out = self._journal_and_apply(op, args, reqid)
             elif op in _READONLY:
                 out = self._apply(op, args)

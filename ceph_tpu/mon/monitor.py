@@ -43,11 +43,29 @@ MDS_BEACON_GRACE = 15.0     # active mds silent this long -> failover
 
 class Monitor(Dispatcher):
     def __init__(self, network: Network, name: str = "mon",
-                 rank: int = 0, peers: Optional[List[str]] = None):
+                 rank: int = 0, peers: Optional[List[str]] = None,
+                 monmap=None):
         self.network = network
         self.name = name
         self.rank = rank
         self.peers = list(peers or [])       # other mon names
+        # the epoched mon roster as a first-class map (MonMap.h):
+        # built from the quorum membership when not handed one (in-
+        # process fabrics have no addresses; ranks synthesize stable
+        # loopback ports)
+        if monmap is None:
+            import uuid as _uuid
+            from .monmap import MonMap
+            roster = sorted({name, *self.peers})
+            # deterministic fsid from the roster: every mon of one
+            # cluster derives the SAME cluster identity
+            monmap = MonMap(fsid=str(_uuid.uuid5(
+                _uuid.NAMESPACE_URL,
+                "ceph-tpu://" + ",".join(roster))))
+            monmap.epoch = 1
+            for i, n in enumerate(roster):
+                monmap.add(n, f"127.0.0.1:{6789 + i}/0")
+        self.monmap = monmap
         self.messenger = network.create_messenger(name)
         self.messenger.add_dispatcher_head(self)
         self.osdmap = OSDMap()
@@ -740,6 +758,18 @@ class Monitor(Dispatcher):
         self._mds_last_beacon[msg.name] = self.now
         fsmap = self._fsmap()
         cur = fsmap["mds"].get(msg.name)
+        if cur is not None and cur["state"] == "standby":
+            # a known standby beaconing while ranks sit unheld (e.g.
+            # it was momentarily stale when fs_set_max_mds ran): seat
+            # it now — without this, nothing would ever re-run the
+            # promotion for an idle-but-healthy standby
+            held = self._fsmap_ranks(fsmap)
+            if len(held) < fsmap["max_mds"]:
+                self._fill_ranks(fsmap)
+                if fsmap["mds"][msg.name]["state"] != "standby" or \
+                        self._fsmap_ranks(fsmap) != held:
+                    self._save_fsmap(fsmap)
+            return
         if cur is None or cur["state"] == "failed":
             # new daemon — or a FAILED one beaconing again (restarted
             # after the grace window): it rejoins as standby and takes
@@ -1324,6 +1354,7 @@ class Monitor(Dispatcher):
             "osdmap": osdmap_to_dict(self.osdmap),
             "incrementals": [incremental_to_dict(i)
                              for i in self.incrementals],
+            "monmap": self.monmap.to_bytes().decode("latin1"),
         }
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -1339,6 +1370,10 @@ class Monitor(Dispatcher):
         self.osdmap = osdmap_from_dict(state["osdmap"])
         self.incrementals = [incremental_from_dict(i)
                              for i in state["incrementals"]]
+        if "monmap" in state:
+            from .monmap import MonMap
+            self.monmap = MonMap.from_bytes(
+                state["monmap"].encode("latin1"))
         self.cluster_log = []
         self.config_kv = {}
         for inc in self.incrementals:

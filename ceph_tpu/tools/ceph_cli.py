@@ -81,7 +81,7 @@ def main(argv=None) -> int:
                    help="checkpoint directory (MiniCluster.checkpoint)")
     p.add_argument("verb", choices=["status", "health", "df", "osd",
                                     "pg", "log", "config-key", "fs",
-                                    "mds"])
+                                    "mds", "mon"])
     p.add_argument("args", nargs="*")
     a = p.parse_args(argv)
 
@@ -109,6 +109,14 @@ def main(argv=None) -> int:
             print(f"{act} up:active, {sby} up:standby")
         else:
             print(json.dumps(st, indent=2, sort_keys=True))
+    elif v == "mon":
+        # ceph mon dump: the epoched MonMap (MonMap::print)
+        if rest[:1] in ([], ["dump"]):
+            for line in c.mon.monmap.print_lines():
+                print(line)
+        else:
+            print(f"unknown: mon {' '.join(rest)}", file=sys.stderr)
+            return 1
     elif v == "df":
         for pid, name in sorted(c.mon.osdmap.pool_name.items()):
             pool = c.mon.osdmap.pools[pid]

@@ -63,7 +63,23 @@ def mon_main(args) -> None:
         monitor_mod.MON_PING_GRACE = args.mon_grace
     if args.mds_grace:
         monitor_mod.MDS_BEACON_GRACE = args.mds_grace
-    mon = Monitor(net, name=args.name, rank=args.rank, peers=peers)
+    # real addresses -> a real MonMap (the roster as a first-class
+    # epoched map, not just config; mon/MonMap.h role)
+    import uuid as _uuid
+
+    from .mon.monmap import MonMap
+    roster = sorted({args.name, *peers})
+    addrs = {n: directory.get(n, ("127.0.0.1", 0)) for n in roster}
+    # deterministic over the roster+addresses: every mon process of
+    # this cluster computes the SAME fsid
+    monmap = MonMap(fsid=str(_uuid.uuid5(
+        _uuid.NAMESPACE_URL, "ceph-tpu://" + ",".join(
+            f"{n}={h}:{p}" for n, (h, p) in sorted(addrs.items())))))
+    monmap.epoch = 1
+    for n, (host, port) in addrs.items():
+        monmap.add(n, f"{host}:{port}/0")
+    mon = Monitor(net, name=args.name, rank=args.rank, peers=peers,
+                  monmap=monmap)
     if args.down_out_interval:
         mon.down_out_interval = args.down_out_interval
     for i in range(args.n_osds):
@@ -312,6 +328,12 @@ def mds_main(args) -> None:
                             metadata_pool=args.metadata_pool,
                             data_pool=args.data_pool, mkfs=fresh,
                             rank=my_rank)
+            # seed the rank map NOW — serving with a single-entry map
+            # until the first fence-check tick would short-circuit
+            # routing and journal other ranks' subtrees
+            _r, ranks0 = fs_state()
+            if ranks0:
+                mds.set_mds_map(ranks0)
         except IOError:
             # some PG of the fresh pools still settling; mkfs/journal
             # creation is idempotent, so just try again

@@ -1,0 +1,150 @@
+"""A minimal cram(1) interpreter for replaying the reference's
+recorded CLI transcripts (src/test/cli/*/*.t) byte-exact.
+
+Cram format: 2-space-indented ``$ cmd`` lines (with ``> ``
+continuations) followed by 2-space-indented expected output; a
+trailing ``[N]`` line pins the exit status.  Expected lines may end
+with `` (re)`` (regex fullmatch) or `` (esc)`` (escaped literals).
+All commands of one file share a single bash session (env vars and
+``$(...)`` captures persist), exactly like cram runs them; our CLIs
+are exposed as PATH shims.
+"""
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+SALT = "===CRAM-73a1==="
+
+TOOLS = {
+    "monmaptool": "ceph_tpu.tools.monmaptool",
+    "ceph-authtool": "ceph_tpu.tools.authtool",
+    "crushtool": "ceph_tpu.tools.crushtool",
+    "osdmaptool": "ceph_tpu.tools.osdmaptool",
+}
+
+
+class Command:
+    def __init__(self, text: str):
+        self.text = text
+        self.expected: List[str] = []
+        self.exit_code = 0
+
+
+def parse(path: str) -> List[Command]:
+    cmds: List[Command] = []
+    cur: Optional[Command] = None
+    for raw in open(path).read().splitlines():
+        if raw.startswith("  $ "):
+            cur = Command(raw[4:])
+            cmds.append(cur)
+        elif raw.startswith("  > ") and cur is not None:
+            cur.text += "\n" + raw[4:]
+        elif raw.startswith("  ") and cur is not None:
+            line = raw[2:]
+            m = re.fullmatch(r"\[(\d+)\]", line)
+            if m:
+                # an exit-status line always terminates the block
+                cur.exit_code = int(m.group(1))
+                cur = None
+            else:
+                cur.expected.append(line)
+        else:
+            cur = None          # comment / blank: block over
+    return cmds
+
+
+def _escape(s: str) -> str:
+    return s.encode("unicode_escape").decode("ascii")
+
+
+def _line_matches(expected: str, actual: str) -> bool:
+    if expected == actual:
+        return True
+    if expected.endswith(" (esc)"):
+        want = bytes(expected[:-len(" (esc)")],
+                     "latin1").decode("unicode_escape")
+        return want == actual
+    if expected.endswith(" (re)"):
+        pat = expected[:-len(" (re)")]
+        try:
+            if re.fullmatch(pat, actual):
+                return True
+            # cram matches escaped output forms too ("\tkey = ... (esc)")
+            return re.fullmatch(pat, _escape(actual) + " (esc)") \
+                is not None
+        except re.error:
+            return False
+    return False
+
+
+def run(path: str, tmpdir: str,
+        env_extra: Optional[Dict[str, str]] = None
+        ) -> List[Tuple[Command, int, List[str], str]]:
+    """Replay a .t file; returns a list of mismatches
+    (command, actual_exit, actual_lines, why)."""
+    shimdir = os.path.join(tmpdir, "_shims")
+    os.makedirs(shimdir, exist_ok=True)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for tool, mod in TOOLS.items():
+        shim = os.path.join(shimdir, tool)
+        with open(shim, "w") as f:
+            f.write(f"""#!/bin/bash
+exec {sys.executable} -m {mod} "$@"
+""")
+        os.chmod(shim, 0o755)
+    cmds = parse(path)
+    script = ["set +e", "exec 2>&1", f"cd {tmpdir}",
+              f'export PATH="{shimdir}:$PATH"',
+              f'export PYTHONPATH="{repo}"',
+              "export JAX_PLATFORMS=cpu"]
+    for i, c in enumerate(cmds):
+        script.append(c.text)
+        script.append(f'echo "{SALT} {i} $?"')
+    proc = subprocess.run(["bash", "-c", "\n".join(script)],
+                          capture_output=True, text=True,
+                          env={**os.environ, **(env_extra or {})},
+                          timeout=1200)
+    out = proc.stdout
+    blocks: Dict[int, Tuple[List[str], int]] = {}
+    curlines: List[str] = []
+    for line in out.splitlines():
+        m = re.fullmatch(rf"{re.escape(SALT)} (\d+) (\d+)", line)
+        if m:
+            blocks[int(m.group(1))] = (curlines, int(m.group(2)))
+            curlines = []
+        else:
+            curlines.append(line)
+    failures = []
+    for i, c in enumerate(cmds):
+        actual, rc = blocks.get(i, ([], -1))
+        if rc != c.exit_code:
+            failures.append((c, rc, actual,
+                             f"exit {rc} != {c.exit_code}"))
+            continue
+        if len(actual) != len(c.expected):
+            failures.append((c, rc, actual,
+                             f"{len(actual)} lines != "
+                             f"{len(c.expected)}"))
+            continue
+        for want, got in zip(c.expected, actual):
+            if not _line_matches(want, got):
+                failures.append((c, rc, actual,
+                                 f"line {got!r} !~ {want!r}"))
+                break
+    return failures
+
+
+def assert_cram(path: str, tmpdir: str) -> None:
+    failures = run(path, str(tmpdir))
+    if failures:
+        msgs = []
+        for c, rc, actual, why in failures[:5]:
+            msgs.append(f"$ {c.text}\n  {why}\n  actual: "
+                        + "\n          ".join(actual[:12]))
+        raise AssertionError(
+            f"{os.path.basename(path)}: {len(failures)} command(s) "
+            f"diverged\n" + "\n".join(msgs))

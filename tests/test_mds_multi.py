@@ -170,6 +170,24 @@ def test_drain_finds_renamed_open_handle(world):
     assert fa.read("/move/f") == b"renamed-while-open"
 
 
+def test_rename_out_of_authority_drains_caps(world):
+    """A rename whose DESTINATION is another rank's subtree drains the
+    open handle first — otherwise the moved file's caps would be
+    stranded where no future subtree drain could find them."""
+    c, a, b, fa, fb = world
+    fa.mkdir("/ours")
+    fa.mkdir("/theirs")
+    fa.set_dir_pin("/theirs", 1)
+    fh = fb.open("/ours/f", "w")
+    fh.write(b"crossing-over", 0)
+    assert a.fs.stat("/ours/f")["size"] == 0      # buffered only
+    fa.rename("/ours/f", "/theirs/f")             # rank 0 executes
+    # the drain flushed before the rename moved it out of rank 0
+    assert fh.caps == 0
+    assert fa.read("/theirs/f") == b"crossing-over"
+    assert not a.caps                              # nothing stranded
+
+
 def test_cross_subtree_rename_crash_safe(world):
     """Rename from rank 0's subtree into rank 1's: executed by the
     SOURCE auth as ONE journaled event — a crash between journal and

@@ -1,0 +1,36 @@
+"""monmaptool + ceph-authtool cram parity: replay the reference's
+ENTIRE recorded CLI transcripts (src/test/cli/monmaptool/*.t,
+src/test/cli/ceph-authtool/*.t) through the mini-cram interpreter —
+every command line, output byte, and exit code.
+
+manpage.t (needs the groff-built man page) is the only exclusion.
+"""
+import os
+
+import pytest
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from cram import assert_cram  # noqa: E402
+
+MONDIR = "/root/reference/src/test/cli/monmaptool"
+AUTHDIR = "/root/reference/src/test/cli/ceph-authtool"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(MONDIR), reason="reference cram files unavailable")
+
+MON_TS = sorted(t for t in os.listdir(MONDIR) if t.endswith(".t"))
+# manpage.t greps the installed troff page — packaging, not behavior
+AUTH_TS = sorted(t for t in os.listdir(AUTHDIR)
+                 if t.endswith(".t") and t != "manpage.t")
+
+
+@pytest.mark.parametrize("tname", MON_TS)
+def test_monmaptool_cram(tname, tmp_path):
+    assert_cram(os.path.join(MONDIR, tname), str(tmp_path))
+
+
+@pytest.mark.parametrize("tname", AUTH_TS)
+def test_authtool_cram(tname, tmp_path):
+    assert_cram(os.path.join(AUTHDIR, tname), str(tmp_path))

@@ -235,6 +235,24 @@ def test_ceph_osd_pool_ls_detail(tmp_path, capsys):
     assert "ec_overwrites" in out
 
 
+def test_ceph_mon_dump_prints_monmap(tmp_path, capsys):
+    """ceph mon dump: the mon's roster is a first-class epoched
+    MonMap (mon/MonMap.h role) with address-ordered ranks."""
+    import re as _re
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.tools import ceph_cli
+    c = MiniCluster(n_osds=3, n_mons=3)
+    ck = str(tmp_path / "ck")
+    c.checkpoint(ck)
+    assert ceph_cli.main(["--cluster", ck, "mon", "dump"]) == 0
+    out = capsys.readouterr().out.splitlines()
+    assert out[0] == "epoch 1"
+    assert _re.fullmatch(r"fsid [0-9a-f-]{36}", out[1])
+    ranked = [ln for ln in out if _re.match(r"\d+: ", ln)]
+    assert len(ranked) == 3
+    assert all("mon." in ln for ln in ranked)
+
+
 def test_ceph_fs_status_and_mds_stat(tmp_path, capsys):
     """ceph fs status / ceph mds stat surface the MDSMonitor fsmap."""
     from ceph_tpu.cluster import MiniCluster
