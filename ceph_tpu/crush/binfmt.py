@@ -126,6 +126,9 @@ def decode_crushmap(data: bytes) -> CrushWrapper:
     max_buckets = r.s32()
     max_rules = r.u32()
     m.max_devices = r.s32()
+    # re-encode exactly what was stored (round-trip byte identity),
+    # whatever slot-count policy the producer used
+    m.exact_bucket_slots = True
     # "legacy tunables, unless we decode something newer"
     m.set_tunables_profile("legacy")
 
@@ -238,11 +241,25 @@ def encode_crushmap(cw: CrushWrapper) -> bytes:
     m = cw.crush
     w = _Writer()
     w.u32(CRUSH_MAGIC)
-    w.s32(len(m.buckets))
+    # max_buckets carries the builder's allocation high-water: the
+    # bucket array starts at 8 slots and doubles (builder.c
+    # crush_add_bucket:150-156), so a reference-built 3-bucket map
+    # stores 5 empty slots.  Stored maps already carry this padding
+    # (decode preserves the None slots); maps built in-memory pad
+    # here so our encodings are byte-identical to the reference's.
+    slots = len(m.buckets)
+    if slots and not getattr(m, "exact_bucket_slots", False):
+        # decoded maps re-encode their stored slot count verbatim
+        # (exact_bucket_slots); only in-memory-built maps pad here
+        policy = 8
+        while policy < slots:
+            policy *= 2
+        slots = max(slots, policy)
+    w.s32(slots)
     w.u32(len(m.rules))
     w.s32(m.max_devices)
 
-    for b in m.buckets:
+    for b in list(m.buckets) + [None] * (slots - len(m.buckets)):
         if b is None:
             w.u32(0)
             continue

@@ -287,26 +287,24 @@ class CrushWrapper:
 
     def adjust_item_weight(self, item: int, weight: int) -> int:
         """Adjust *item*'s weight wherever it lives and propagate the
-        change up every ancestor chain (CrushWrapper::
-        adjust_item_weight): ancestors are REBUILT too, so straw
+        change up EVERY ancestor chain — recursively over all buckets
+        containing each changed bucket, so multi-root maps (an item
+        linked under several trees) update every copy
+        (CrushWrapper::adjust_item_weight's recursion,
+        CrushWrapper.cc).  Ancestors are REBUILT too, so straw
         scalers and tree nodes re-derive.  Returns buckets changed."""
         changed = 0
         for b in list(self.crush.buckets):
             if b is None or item not in b.items:
                 continue
-            delta = self._set_item_weight_in(b.id, item, weight)
+            self._set_item_weight_in(b.id, item, weight)
             changed += 1
-            # ripple the new total up the chain
-            cur = b.id
-            while delta:
-                parent = self._parent_of(cur)
-                if parent is None:
-                    break
-                new_w = self.crush.bucket(cur).weight
-                self._set_item_weight_in(parent.id, cur, new_w)
-                cur = parent.id
-                changed += 1
-        return changed
+            # the recursion's count is NOT accumulated (reference
+            # counts direct containments only) and an unlinked item
+            # is -ENOENT, not a silent no-op
+            self.adjust_item_weight(b.id,
+                                    self.crush.bucket(b.id).weight)
+        return changed if changed else -2
 
     def remove_item(self, item: int) -> None:
         """Detach a device from every bucket (+ ancestor reweight) and

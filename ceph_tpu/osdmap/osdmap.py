@@ -78,6 +78,13 @@ class OSDMap:
     def __init__(self):
         self.epoch = 0
         self.flags = 0
+        # map identity/stamps (OSDMap::print header fields); tools
+        # built maps (osdmaptool --createsimple) keep the zero fsid
+        # like the reference's zeroed uuid_d
+        self.fsid = "00000000-0000-0000-0000-000000000000"
+        self.created = 0.0
+        self.modified = 0.0
+        self.crush_version = 1
         self.max_osd = 0
         self.osd_state: List[int] = []
         self.osd_weight: List[int] = []

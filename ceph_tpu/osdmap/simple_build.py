@@ -110,7 +110,7 @@ def _add_default_pool(m: OSDMap, pg_bits: int, pgp_bits: int,
     pool = pg_pool_t(type=TYPE_REPLICATED, size=3, min_size=2,
                      crush_rule=rule, pg_num=poolbase << pg_bits,
                      pgp_num=poolbase << pgp_bits,
-                     flags=FLAG_HASHPSPOOL)
+                     flags=FLAG_HASHPSPOOL, application="rbd")
     m.add_pool("rbd", pool, pool_id=1)
 
 
@@ -162,15 +162,21 @@ def build_from_conf(conf_text: str, with_default_pool: bool = True,
     if with_default_pool:
         _add_default_pool(m, pg_bits, pgp_bits, rule)
     m.epoch = 1
+    import time as _time
+    m.created = m.modified = _time.time()
     return m
 
 
 def build_simple(n_osds: int, with_default_pool: bool = True,
                  pg_bits: int = 6, pgp_bits: int = 6) -> OSDMap:
-    """OSDMap::build_simple_with_pool(nosd=N): one host per osd under
-    the default root (build_simple_crush_map)."""
+    """OSDMap::build_simple_with_pool(nosd=N): every osd at the fixed
+    localhost/localrack location under the default root
+    (build_simple_crush_map, OSDMap.cc:3556-3580 — localhost id -2,
+    localrack -3, pinned by create-print.t's recorded decompile)."""
+    import time as _time
     m = OSDMap()
     m.set_max_osd(n_osds)
+    m.created = m.modified = _time.time()
     cw = m.crush
     for t, name in CRUSH_TYPES:
         cw.set_type_name(t, name)
@@ -179,7 +185,8 @@ def build_simple(n_osds: int, with_default_pool: bool = True,
     assert root == -1
     for o in range(n_osds):
         insert_item(cw, o, 0x10000, f"osd.{o}",
-                    {"host": f"host{o}", "root": "default"})
+                    {"host": "localhost", "rack": "localrack",
+                     "root": "default"})
     rule = _finish_crush(cw)
     if with_default_pool:
         _add_default_pool(m, pg_bits, pgp_bits, rule)

@@ -56,6 +56,9 @@ class pg_pool_t:
     last_change: int = 0
     erasure_code_profile: str = ""
     stripe_width: int = 0
+    # enabled application (pg_pool_t application_metadata keys; the
+    # default pool carries "rbd")
+    application: str = ""
     # pool snapshots (pg_pool_t snaps/snap_seq, osd_types.h): snap id ->
     # name; removed ids accumulate so PGs can trim clones
     snap_seq: int = 0

@@ -180,4 +180,8 @@ def _parse_and_run(argv) -> int:
 
 
 if __name__ == "__main__":
+    # die silently on a closed pipe (`tool ... | head`), like the
+    # C++ tools' default SIGPIPE disposition
+    import signal
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
     sys.exit(main())

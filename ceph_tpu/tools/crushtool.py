@@ -281,8 +281,17 @@ def main(argv=None) -> int:
                 insert_item(cw, dev, w16, name, loc)
         if args.reweight_item:
             name, w = args.reweight_item
-            cw.adjust_item_weight(cw.get_item_id(name),
-                                  int(round(float(w) * 0x10000)))
+            print(f"crushtool reweighting item {name} to "
+                  f"{float(w):g}")
+            if not cw.name_exists(name):
+                print(f" name {name} dne", file=sys.stderr)
+                return 1
+            r = cw.adjust_item_weight(cw.get_item_id(name),
+                                      int(round(float(w) * 0x10000)))
+            if r < 0:        # named but linked into no bucket
+                print("crushtool (2) No such file or directory",
+                      file=sys.stderr)
+                return 1
         if args.remove_item:
             cw.remove_item(cw.get_item_id(args.remove_item))
         if args.create_simple_rule:
@@ -401,4 +410,8 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
+    # die silently on a closed pipe (`tool ... | head`), like the
+    # C++ tools' default SIGPIPE disposition
+    import signal
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
     sys.exit(main())

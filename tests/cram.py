@@ -37,14 +37,22 @@ class Command:
 def parse(path: str) -> List[Command]:
     cmds: List[Command] = []
     cur: Optional[Command] = None
-    for raw in open(path).read().splitlines():
-        if raw.startswith("  $ "):
-            cur = Command(raw[4:])
+    text = open(path).read()
+    # two dialects in the reference tree: standard cram (2-space
+    # indent) and the column-0 form some crushtool files use
+    indent = "  " if re.search(r"^  \$ ", text, re.M) else ""
+    n = len(indent)
+    for raw in text.splitlines():
+        if raw.startswith(indent + "$ "):
+            cur = Command(raw[n + 2:])
             cmds.append(cur)
-        elif raw.startswith("  > ") and cur is not None:
-            cur.text += "\n" + raw[4:]
-        elif raw.startswith("  ") and cur is not None:
-            line = raw[2:]
+        elif raw.startswith(indent + "> ") and cur is not None:
+            cur.text += "\n" + raw[n + 2:]
+        elif not indent and (not raw or raw.startswith("#")):
+            cur = None          # column-0 dialect: comment/blank ends
+        elif raw.startswith(indent) and cur is not None and \
+                (indent or raw):
+            line = raw[n:]
             m = re.fullmatch(r"\[(\d+)\]", line)
             if m:
                 # an exit-status line always terminates the block
@@ -100,7 +108,9 @@ exec {sys.executable} -m {mod} "$@"
     script = ["set +e", "exec 2>&1", f"cd {tmpdir}",
               f'export PATH="{shimdir}:$PATH"',
               f'export PYTHONPATH="{repo}"',
-              "export JAX_PLATFORMS=cpu"]
+              "export JAX_PLATFORMS=cpu",
+              # cram exports the .t file's directory as TESTDIR
+              f'export TESTDIR="{os.path.dirname(os.path.abspath(path))}"']
     for i, c in enumerate(cmds):
         script.append(c.text)
         script.append(f'echo "{SALT} {i} $?"')

@@ -16,6 +16,7 @@ from cram import assert_cram  # noqa: E402
 
 MONDIR = "/root/reference/src/test/cli/monmaptool"
 AUTHDIR = "/root/reference/src/test/cli/ceph-authtool"
+OSDDIR = "/root/reference/src/test/cli/osdmaptool"
 
 pytestmark = pytest.mark.skipif(
     not os.path.isdir(MONDIR), reason="reference cram files unavailable")
@@ -24,6 +25,12 @@ MON_TS = sorted(t for t in os.listdir(MONDIR) if t.endswith(".t"))
 # manpage.t greps the installed troff page — packaging, not behavior
 AUTH_TS = sorted(t for t in os.listdir(AUTHDIR)
                  if t.endswith(".t") and t != "manpage.t")
+# upmap.t / upmap-out.t / test-map-pgs.t are replayed (in richer
+# assertion form) by test_osdmaptool_golden.py already
+OSD_TS = sorted(t for t in os.listdir(OSDDIR)
+                if t.endswith(".t")
+                and t not in ("upmap.t", "upmap-out.t",
+                              "test-map-pgs.t"))
 
 
 @pytest.mark.parametrize("tname", MON_TS)
@@ -34,3 +41,11 @@ def test_monmaptool_cram(tname, tmp_path):
 @pytest.mark.parametrize("tname", AUTH_TS)
 def test_authtool_cram(tname, tmp_path):
     assert_cram(os.path.join(AUTHDIR, tname), str(tmp_path))
+
+
+@pytest.mark.parametrize("tname", OSD_TS)
+def test_osdmaptool_cram(tname, tmp_path):
+    """The whole-file replays of the osdmaptool cram suite (tree,
+    create-print, create-racks, clobber, pool, crush, error paths,
+    help) — every command, output byte, and exit code."""
+    assert_cram(os.path.join(OSDDIR, tname), str(tmp_path))

@@ -155,7 +155,10 @@ def test_osdmaptool_createsimple_and_test_map_pgs(tmp_path, capsys):
                             "--host-mapper"]) == 0
     out = capsys.readouterr().out
     assert "mapped 64 pgs" in out
-    assert osdmaptool.main([str(mf), "--test-map-object", "foo"]) == 0
+    # the legacy builder's pool id is 0; the tool assumes pool 1 when
+    # --pool is omitted (osdmaptool.cc), so name it explicitly
+    assert osdmaptool.main([str(mf), "--test-map-object", "foo",
+                            "--pool", "0"]) == 0
     out = capsys.readouterr().out
     assert "object 'foo'" in out
 
@@ -175,7 +178,7 @@ def test_osdmaptool_upmap_balances(tmp_path, capsys):
 
 
 def test_balancer_reduces_spread():
-    m = osdmaptool.createsimple(16, pg_num=256)
+    m = osdmaptool.createsimple_legacy(16, pg_num=256)
 
     def spread():
         from ceph_tpu.osdmap import pg_t
