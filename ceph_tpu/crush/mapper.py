@@ -246,6 +246,11 @@ def _choose_firstn(map: CrushMap, bucket: Bucket, weight, x: int,
             out[outpos] = item
             outpos += 1
             count -= 1
+            # choose-tries profile (mapper.c:624: userspace-only
+            # histogram behind crush.start_choose_profile)
+            prof = getattr(map, "choose_tries", None)
+            if prof is not None and ftotal <= map.choose_total_tries:
+                prof[ftotal] += 1
         rep += 1
     return outpos
 
@@ -325,6 +330,11 @@ def _choose_indep(map: CrushMap, bucket: Bucket, weight, x: int,
             out[rep] = CRUSH_ITEM_NONE
         if out2 is not None and out2[rep] == CRUSH_ITEM_UNDEF:
             out2[rep] = CRUSH_ITEM_NONE
+    # choose-tries profile: indep records once per invocation with
+    # the loop-exit ftotal (mapper.c:809)
+    prof = getattr(map, "choose_tries", None)
+    if prof is not None and ftotal <= map.choose_total_tries:
+        prof[ftotal] += 1
 
 
 # ---- do_rule --------------------------------------------------------------

@@ -306,6 +306,20 @@ class CrushWrapper:
                                     self.crush.bucket(b.id).weight)
         return changed if changed else -2
 
+    def reweight(self) -> None:
+        """Recalculate every bucket weight bottom-up from the leaf
+        item weights (CrushWrapper::reweight -> crush_reweight_bucket
+        recursion), rebuilding straw scalers along the way."""
+        def rw(bid: int) -> int:
+            b = self.crush.bucket(bid)
+            ws = [rw(it) if it < 0 else b.item_weights[i]
+                  for i, it in enumerate(b.items)]
+            self.rebuild_bucket(bid, list(b.items), ws)
+            return self.crush.bucket(bid).weight
+        for b in list(self.crush.buckets):
+            if b is not None and self._parent_of(b.id) is None:
+                rw(b.id)
+
     def remove_item(self, item: int) -> None:
         """Detach a device from every bucket (+ ancestor reweight) and
         drop its name (CrushWrapper::remove_item)."""
@@ -428,6 +442,15 @@ class CrushWrapper:
         rno = self.crush.add_rule(rule, ruleno)
         self.rule_name_map[rno] = name
         return rno
+
+    def remove_rule(self, ruleno: int) -> int:
+        """CrushWrapper::remove_rule: drop the rule slot + its name."""
+        if ruleno < 0 or ruleno >= len(self.crush.rules) \
+                or self.crush.rules[ruleno] is None:
+            return -2
+        self.crush.rules[ruleno] = None
+        self.rule_name_map.pop(ruleno, None)
+        return 0
 
     def add_simple_rule(self, name: str, root_name: str,
                         failure_domain_name: str = "",

@@ -56,6 +56,16 @@ def parse_conf_sections(text: str) -> Dict[str, Dict[str, str]]:
     return sections
 
 
+def _subtree_contains(cw: CrushWrapper, root: int, item: int) -> bool:
+    if root == item:
+        return True
+    if root >= 0:
+        return False
+    b = cw.crush.bucket(root)
+    return b is not None and any(_subtree_contains(cw, it, item)
+                                 for it in b.items)
+
+
 def insert_item(cw: CrushWrapper, item: int, weight: int, name: str,
                 loc: Dict[str, str]) -> None:
     """CrushWrapper::insert_item at 16.16 fixed weight.  Walks the
@@ -86,6 +96,11 @@ def insert_item(cw: CrushWrapper, item: int, weight: int, name: str,
         b = cw.crush.bucket(bid)
         if b is None or b.type != t:
             raise ValueError(f"bucket {bname!r} type mismatch")
+        if _subtree_contains(cw, bid, cur):
+            # CrushWrapper.cc:901-905: re-inserting an item already
+            # beneath the target location is -EINVAL, not a dup link
+            raise ValueError(
+                f"insert_item item {cur} already exists beneath {bid}")
         cw._bucket_link(bid, cur, 0)
         if cur == item:
             device_parent = bid

@@ -104,6 +104,23 @@ def run(path: str, tmpdir: str,
 exec {sys.executable} -m {mod} "$@"
 """)
         os.chmod(shim, 0o755)
+    import shutil
+    if shutil.which("jq") is None:
+        # choose-args.t validates --dump JSON through `jq .key`; the
+        # image has no jq, so provide the one filter shape it uses
+        jq = os.path.join(shimdir, "jq")
+        with open(jq, "w") as f:
+            f.write(f"""#!{sys.executable}
+import json, sys
+filt = sys.argv[1]
+doc = json.load(sys.stdin)
+for part in filt.lstrip(".").split("."):
+    if not part:
+        continue
+    doc = doc.get(part) if isinstance(doc, dict) else None
+print(json.dumps(doc, indent=2) if doc is not None else "null")
+""")
+        os.chmod(jq, 0o755)
     cmds = parse(path)
     script = ["set +e", "exec 2>&1", f"cd {tmpdir}",
               f'export PATH="{shimdir}:$PATH"',
@@ -117,7 +134,7 @@ exec {sys.executable} -m {mod} "$@"
     proc = subprocess.run(["bash", "-c", "\n".join(script)],
                           capture_output=True, text=True,
                           env={**os.environ, **(env_extra or {})},
-                          timeout=1200)
+                          timeout=2400)
     out = proc.stdout
     blocks: Dict[int, Tuple[List[str], int]] = {}
     curlines: List[str] = []
