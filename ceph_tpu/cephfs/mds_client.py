@@ -42,7 +42,7 @@ class FileHandle:
 
     def __init__(self, fs: "RemoteCephFS", path: str, inode: Dict,
                  caps: int, snapc: Tuple[int, List[int]],
-                 mds: str = ""):
+                 mds: str = "", quotas: Optional[List[Dict]] = None):
         self.fs = fs
         self.path = path
         self.inode = inode
@@ -51,10 +51,34 @@ class FileHandle:
         self.mds = mds           # the rank daemon that issued the caps
         self.buffer: List[Tuple[int, bytes]] = []
         self.size = inode["size"]
+        # the quota realm chain from the open reply (the client-side
+        # cache the reference keeps as in->quota/rstat): byte quotas
+        # are enforced HERE, on the data path, before bytes move
+        self.quotas = list(quotas or [])
+        self._max_end = self.size
+
+    def _check_byte_quota(self, end: int) -> None:
+        """EDQUOT when this write's growth would push any ancestor
+        realm past max_bytes (Client.cc:9137-9141
+        is_quota_bytes_exceeded with the cached realm usage)."""
+        growth = end - self._max_end
+        if growth <= 0:
+            return
+        for q in self.quotas:
+            if q.get("max_bytes") and \
+                    q["used_bytes"] + growth > q["max_bytes"]:
+                raise FsError("write", -122)         # EDQUOT
 
     # -- io ------------------------------------------------------------
     def write(self, data: bytes, offset: Optional[int] = None) -> int:
         off = self.size if offset is None else offset
+        end = off + len(data)
+        self._check_byte_quota(end)
+        if end > self._max_end:
+            for q in self.quotas:
+                q["used_bytes"] = q.get("used_bytes", 0) + \
+                    (end - self._max_end)
+            self._max_end = end
         if self.caps & CEPH_CAP_FILE_BUFFER:
             self.buffer.append((off, bytes(data)))
             self.size = max(self.size, off + len(data))
@@ -318,7 +342,9 @@ class RemoteCephFS:
     def mkdir(self, path: str) -> int:
         return self._request("mkdir", path=path)["ino"]
 
-    def create(self, path: str, order: int = DEFAULT_ORDER) -> int:
+    def create(self, path: str, order: Optional[int] = None) -> int:
+        # order None lets the MDS apply the inherited dir layout
+        # (an explicit order overrides it, like a file vxattr would)
         return self._request("create", path=path, order=order)["ino"]
 
     def symlink(self, path: str, target: str) -> int:
@@ -357,6 +383,35 @@ class RemoteCephFS:
     def truncate(self, path: str, size: int) -> None:
         self._request("truncate", path=path, size=size)
 
+    def set_quota(self, path: str, max_bytes: int = 0,
+                  max_files: int = 0) -> Dict:
+        """setfattr ceph.quota.max_bytes/max_files on a directory
+        (0 clears); enforced against the ancestor realm chain."""
+        return self._request("set_quota", path=path,
+                             max_bytes=max_bytes,
+                             max_files=max_files)
+
+    def get_quota(self, path: str) -> List[Dict]:
+        """The quota realm chain covering *path*, with usage."""
+        return self._request("get_quota", path=path)["quotas"]
+
+    def set_layout(self, path: str, order: Optional[int] = None,
+                   pool: Optional[str] = None) -> Dict:
+        """setfattr ceph.dir.layout.* / ceph.file.layout.*: object
+        size (order) and data pool.  Dir layouts are inherited by new
+        files; a file's layout is only settable while empty."""
+        return self._request("set_layout", path=path, order=order,
+                             pool=pool)
+
+    def get_layout(self, path: str) -> Dict:
+        """The effective layout of a file or dir (getfattr
+        ceph.file.layout)."""
+        inode = self._request("stat", path=path)["inode"]
+        if inode.get("type") == "dir":
+            return dict(inode.get("layout") or {})
+        return {"order": inode.get("order", DEFAULT_ORDER),
+                "pool": inode.get("pool")}
+
     def set_dir_pin(self, path: str, rank: int) -> Dict:
         """Pin *path*'s subtree to an MDS rank (setfattr -n
         ceph.dir.pin): the journaled subtree handoff.  Served by the
@@ -375,7 +430,8 @@ class RemoteCephFS:
                             create="w" in mode)
         fh = FileHandle(self, path, out["inode"], out["caps"],
                         (out["snapc_seq"], out["snapc_snaps"]),
-                        mds=getattr(self, "_last_mds", "") or self.mds)
+                        mds=getattr(self, "_last_mds", "") or self.mds,
+                        quotas=out.get("quotas"))
         self._handles[out["inode"]["ino"]] = fh
         return fh
 
@@ -385,9 +441,18 @@ class RemoteCephFS:
         wrstat through the MDS."""
         fh = self.open(path, "w")
         try:
+            fh._check_byte_quota(offset + len(data))
             self._write_data(fh.inode, data, offset, fh.snapc)
             fh.size = max(fh.size, offset + len(data))
             fh.close()
+        except BaseException:
+            # EDQUOT (or any data-path error) must not strand the
+            # caps the open just took
+            try:
+                fh.close()
+            except Exception:
+                pass
+            raise
         finally:
             self._handles.pop(fh.inode["ino"], None)
         return len(data)
@@ -410,23 +475,26 @@ class RemoteCephFS:
     def _write_data(self, inode: Dict, data: bytes, offset: int,
                     snapc: Tuple[int, List[int]]) -> None:
         """Object writes with the file's realm SnapContext installed
-        (per-file snapc is what makes per-directory snapshots work)."""
+        (per-file snapc is what makes per-directory snapshots work).
+        The file's LAYOUT pool (ceph.file.layout.pool, fixed at
+        create) overrides the mount's default data pool."""
+        pool = inode.get("pool") or self.dpool
         seq, snaps = snapc
-        self.client.set_write_ctx(self.dpool, seq, snaps)
+        self.client.set_write_ctx(pool, seq, snaps)
         try:
             osize = 1 << inode.get("order", DEFAULT_ORDER)
             pos = 0
             while pos < len(data):
                 objno, ooff = divmod(offset + pos, osize)
                 take = min(len(data) - pos, osize - ooff)
-                r = self.client.write(self.dpool,
+                r = self.client.write(pool,
                                       file_oid(inode["ino"], objno),
                                       data[pos:pos + take], ooff)
                 if r < 0:
                     raise FsError("write", r)
                 pos += take
         finally:
-            self.client.set_write_ctx(self.dpool, 0, [])
+            self.client.set_write_ctx(pool, 0, [])
 
     def _write_through(self, path: str, inode: Dict, data: bytes,
                        offset: int,
@@ -440,6 +508,7 @@ class RemoteCephFS:
                    snap: Optional[int] = None) -> bytes:
         if offset >= logical_size:
             return b""
+        pool = inode.get("pool") or self.dpool
         length = logical_size - offset if length is None else \
             min(length, logical_size - offset)
         osize = 1 << inode.get("order", DEFAULT_ORDER)
@@ -449,7 +518,7 @@ class RemoteCephFS:
             objno, ooff = divmod(pos, osize)
             take = min(remaining, osize - ooff)
             try:
-                data = self.client.read(self.dpool,
+                data = self.client.read(pool,
                                         file_oid(inode["ino"], objno),
                                         offset=ooff, length=take,
                                         snap=snap)

@@ -11,9 +11,23 @@ from ..msg.messages import MMonSubscribe
 
 
 class MonClient:
-    def __init__(self, network, mon_name: str = "mon"):
+    def __init__(self, network, mon_name: str = "mon",
+                 mon_names=None):
         self.network = network
         self.mon_name = mon_name
+        # the full roster for hunting (MonClient::_reopen_session /
+        # hunt): when the bound mon goes silent, rotate to the next
+        self.mon_names = list(mon_names or [mon_name])
+
+    def hunt(self) -> str:
+        """Rotate to the next monitor in the roster (the reference's
+        hunting when the current mon connection goes dead)."""
+        if len(self.mon_names) > 1:
+            i = self.mon_names.index(self.mon_name) \
+                if self.mon_name in self.mon_names else -1
+            self.mon_name = self.mon_names[(i + 1)
+                                           % len(self.mon_names)]
+        return self.mon_name
 
     def subscribe(self, name: str) -> None:
         """Subscribe and fetch are ONE wire operation here: the mon

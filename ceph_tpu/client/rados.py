@@ -391,12 +391,18 @@ class RadosClient(Dispatcher):
         from ..msg.messages import MMonCommand
         self._tid += 1
         tid = self._tid
-        mon_name = getattr(self.mon, "mon_name", "mon")
         for attempt in range(MAX_ATTEMPTS):
+            # re-read each attempt: a silent mon triggers hunting
+            mon_name = getattr(self.mon, "mon_name", "mon")
             self.messenger.send_message(MMonCommand(
                 tid=tid, cmd=cmd, args=dict(args)), mon_name)
             self.network.pump()
             ack = self._mon_acks.pop(tid, None)
+            if ack is None and attempt and attempt % 3 == 0 \
+                    and hasattr(self.mon, "hunt"):
+                # only a SILENT mon triggers hunting; an answering
+                # one (even with EAGAIN mid-election) keeps the bind
+                self.mon.hunt()
             if ack is not None:
                 if ack.result == -11:
                     continue    # EAGAIN: mon electing / leadership moved

@@ -61,10 +61,15 @@ def _j(obj) -> bytes:
 # ops that mutate metadata and therefore ride the MDS journal
 _JOURNALED = {"mkdir", "create", "symlink", "hardlink", "unlink",
               "rmdir", "rename", "setattr", "wrstat", "truncate",
-              "snap_create", "snap_remove", "set_dir_pin"}
+              "snap_create", "snap_remove", "set_dir_pin",
+              "set_quota", "set_layout"}
 # ops answered read-only
 _READONLY = {"stat", "listdir", "readlink", "resolve", "exists",
-             "lssnap", "open", "release", "walk_snapc"}
+             "lssnap", "open", "release", "walk_snapc", "get_quota"}
+
+# ops that add a dentry — gated by ancestor max_files quotas
+# (Client.cc:11502/11636 is_quota_files_exceeded; authority-side here)
+_CREATES_DENTRY = {"mkdir", "create", "symlink", "hardlink"}
 
 # the request key that names the op's PRIMARY path — the one whose
 # subtree authority decides which rank serves it (Server::
@@ -498,6 +503,26 @@ class MDSDaemon:
                 self._reply(msg, -13, {"error": "stale cap flush"})
                 return
             elif op in _JOURNALED:
+                if op in _CREATES_DENTRY:
+                    self._check_files_quota(
+                        args["newpath"] if op == "hardlink"
+                        else args["path"])
+                if op == "truncate":
+                    # setattr-size growth rides byte quotas
+                    # (Client.cc:6660-6664)
+                    inode = self.fs._resolve(args["path"],
+                                             follow_final=True)
+                    growth = int(args["size"]) - \
+                        int(inode.get("size", 0))
+                    if growth > 0:
+                        for q in self._quota_chain(args["path"]):
+                            if q["max_bytes"] and \
+                                    q["used_bytes"] + growth > \
+                                    q["max_bytes"]:
+                                raise FsError("quota", -122)
+                if op == "rename":
+                    self._check_rename_quota(args["src"],
+                                             args["dst"])
                 if op == "rename" and len(self.mds_map) > 1 and \
                         self._auth_rank(args["dst"]) != self.rank:
                     # a rename OUT of our authority moves any open
@@ -569,6 +594,9 @@ class MDSDaemon:
         except FsError as e:
             if e.result != -2 or not create:
                 raise
+            # O_CREAT is a dentry creation like any other: same
+            # max_files gate as the create op
+            self._check_files_quota(path)
             self._journal_and_apply("create", {"path": path},
                                     getattr(msg, "reqid", ""))
             dino, name, inode = self.fs._resolve_dentry(path)
@@ -581,7 +609,140 @@ class MDSDaemon:
         seq, snaps = self._file_snapc(path)
         return {"inode": inode, "caps": granted,
                 "snapc_seq": seq, "snapc_snaps": snaps,
-                "path": path}
+                "path": path,
+                # the quota realm chain, cached client-side for the
+                # data path's byte-quota checks (Client.cc's in->quota)
+                "quotas": self._quota_chain(path)}
+
+    # ---- quotas + layouts (Client.cc quota realms / file layouts) ----------
+    def _ancestor_dirs(self, path: str):
+        """(path, inode) for every EXISTING directory from root down
+        to *path*'s deepest dir component — the quota realm chain."""
+        out = []
+        cur_ino = ROOT_INO
+        cur_path = ""
+        parts = self.fs._split(path)
+        for part in parts:
+            try:
+                inode = self.fs._lookup(cur_ino, part)
+            except FsError:
+                break
+            if inode.get("type") != "dir":
+                break
+            cur_ino = inode["ino"]
+            cur_path = cur_path + "/" + part
+            out.append((cur_path, inode))
+        return out
+
+    def _subtree_usage(self, path: str):
+        """(bytes, files) under a directory: the rstat role
+        (rbytes / rfiles+rsubdirs) computed on demand at lite scale."""
+        used_bytes = 0
+        used_files = 0
+        stack = [path]
+        while stack:
+            p = stack.pop()
+            for name, inode in self.fs.listdir(p).items():
+                used_files += 1
+                child = p.rstrip("/") + "/" + name
+                if inode.get("type") == "dir":
+                    stack.append(child)
+                else:
+                    used_bytes += int(inode.get("size", 0))
+        return used_bytes, used_files
+
+    def _quota_chain(self, path: str):
+        """Every quota-bearing ancestor with its limits and current
+        usage, outermost first (the realm chain a client enforces
+        writes against, Client.cc:4627)."""
+        out = []
+        for p, inode in self._ancestor_dirs(path):
+            mb = int(inode.get("quota_max_bytes", 0) or 0)
+            mf = int(inode.get("quota_max_files", 0) or 0)
+            if not (mb or mf):
+                continue
+            ub, uf = self._subtree_usage(p)
+            out.append({"path": p, "max_bytes": mb, "max_files": mf,
+                        "used_bytes": ub, "used_files": uf})
+        return out
+
+    def _check_files_quota(self, path: str) -> None:
+        """EDQUOT when adding one dentry at *path* would exceed any
+        ancestor max_files (the chain walk stops at the deepest
+        existing directory, so the not-yet-created leaf is fine)."""
+        for q in self._quota_chain(path):
+            if q["max_files"] and q["used_files"] + 1 > \
+                    q["max_files"]:
+                raise FsError("quota", -122)         # EDQUOT
+
+    def _check_rename_quota(self, src: str, dst: str) -> None:
+        """A rename INTO a quota realm absorbs the moved subtree's
+        dentries and bytes (Server.cc's rename quota gate): realms
+        covering dst but NOT src must fit the increment."""
+        src_realms = {q["path"] for q in self._quota_chain(src)}
+        dst_chain = [q for q in self._quota_chain(dst)
+                     if q["path"] not in src_realms]
+        if not dst_chain:
+            return
+        inode = self.fs._resolve(src, follow_final=False)
+        if inode.get("type") == "dir":
+            sp = "/" + "/".join(self.fs._split(src))
+            add_bytes, add_files = self._subtree_usage(sp)
+            add_files += 1                       # the moved dir itself
+        else:
+            add_bytes, add_files = int(inode.get("size", 0)), 1
+        for q in dst_chain:
+            if q["max_files"] and \
+                    q["used_files"] + add_files > q["max_files"]:
+                raise FsError("quota", -122)
+            if q["max_bytes"] and add_bytes and \
+                    q["used_bytes"] + add_bytes > q["max_bytes"]:
+                raise FsError("quota", -122)
+
+    def _inherited_layout(self, path: str):
+        """Nearest ancestor dir layout (ceph.dir.layout inheritance:
+        fixed into the file inode at create, Client.cc:11645)."""
+        layout = None
+        for _p, inode in self._ancestor_dirs(path):
+            if inode.get("layout"):
+                layout = inode["layout"]
+        return layout
+
+    def _op_set_quota(self, args: Dict) -> Dict:
+        dino, name, inode = self.fs._resolve_dentry(args["path"])
+        if inode["type"] != "dir":
+            raise FsError("set_quota", -20)          # ENOTDIR
+        self.fs._update(dino, name,
+                        quota_max_bytes=int(args.get("max_bytes", 0)),
+                        quota_max_files=int(args.get("max_files", 0)))
+        return {"ino": inode["ino"]}
+
+    def _op_set_layout(self, args: Dict) -> Dict:
+        """ceph.dir.layout / ceph.file.layout vxattrs: {order, pool}.
+        Fields MERGE into an existing layout (setfattr of one
+        ceph.dir.layout.* field keeps the others).  A FILE's layout
+        is only settable while it is empty (the reference's
+        layout-after-data EINVAL)."""
+        dino, name, inode = self.fs._resolve_dentry(args["path"])
+        layout = dict(inode.get("layout") or {})
+        if args.get("order") is not None:
+            layout["order"] = int(args["order"])
+        if args.get("pool"):
+            layout["pool"] = args["pool"]
+        if inode["type"] == "dir":
+            self.fs._update(dino, name, layout=layout)
+        elif inode["type"] == "file":
+            if int(inode.get("size", 0)):
+                raise FsError("set_layout", -22)     # EINVAL
+            attrs = {}
+            if "order" in layout:
+                attrs["order"] = layout["order"]
+            if "pool" in layout:
+                attrs["pool"] = layout["pool"]
+            self.fs._update(dino, name, **attrs)
+        else:
+            raise FsError("set_layout", -22)
+        return {"ino": inode["ino"]}
 
     # ---- snap realms -------------------------------------------------------
     def _realm_snaps(self, ino: int) -> Dict[str, Dict]:
@@ -687,8 +848,16 @@ class MDSDaemon:
         if op == "mkdir":
             return {"ino": fs.mkdir(args["path"])}
         if op == "create":
-            return {"ino": fs.create(args["path"],
-                                     order=int(args.get("order", 22)))}
+            # layout inheritance: the nearest ancestor dir layout is
+            # FIXED into the file inode at create (Client.cc:11645)
+            layout = self._inherited_layout(args["path"]) or {}
+            order = int(args.get("order") or
+                        layout.get("order") or 22)
+            ino = fs.create(args["path"], order=order)
+            if layout.get("pool"):
+                dino, name, _ = fs._resolve_dentry(args["path"])
+                fs._update(dino, name, pool=layout["pool"])
+            return {"ino": ino}
         if op == "symlink":
             return {"ino": fs.symlink(args["path"], args["target"])}
         if op == "hardlink":
@@ -732,6 +901,12 @@ class MDSDaemon:
             tgt_dino, tgt_name, _ = fs._primary_of(dino, name, inode)
             fs._update(tgt_dino, tgt_name, **attrs)
             return {}
+        if op == "set_quota":
+            return self._op_set_quota(args)
+        if op == "set_layout":
+            return self._op_set_layout(args)
+        if op == "get_quota":
+            return {"quotas": self._quota_chain(args["path"])}
         if op == "set_dir_pin":
             # the handoff record: one atomic attr merge on the dir's
             # dentry; authority flips for the whole subtree

@@ -84,7 +84,7 @@ def mon_main(args) -> None:
         mon.down_out_interval = args.down_out_interval
     for i in range(args.n_osds):
         mon.subscribe(f"osd.{i}")
-    if args.rank == 0:
+    if args.rank == 0 and not args.rejoin:
         mon.bootstrap(args.n_osds, osds_per_host=1)
         if peers:
             # win the initial election and seat the full quorum before
@@ -122,6 +122,12 @@ def mon_main(args) -> None:
                 mon.tick(time.monotonic())
         for i in range(args.n_osds):
             mon.send_full_map(f"osd.{i}")
+    if args.rejoin:
+        # a RESTARTED mon (mon_thrash revival): boot empty and force
+        # an election — the collect/LAST recovery teaches whichever
+        # side is behind (an empty rank-0 leader pulls the peers'
+        # full committed history via OP_LAST deltas)
+        mon.start_election()
     print("READY", flush=True)
     trace = os.environ.get("VSTART_MON_TRACE")
     last_trace = 0.0
@@ -217,7 +223,12 @@ def mds_main(args) -> None:
                      {k: tuple(v) for k, v in directory.items()},
                      auth=auth, entity=args.name)
     mon_names = [m for m in (args.mon_names or "mon").split(",") if m]
-    rados = RadosClient(net, MonClient(net, mon_names[0]), args.name)
+    # the FULL roster: an mds must keep reading the fsmap (its
+    # promotion/fencing signal) across mon failures, hunting like the
+    # reference MonClient
+    rados = RadosClient(net, MonClient(net, mon_names[0],
+                                       mon_names=mon_names),
+                        args.name)
     # wait for a map with every osd up before touching pools
     deadline = time.monotonic() + 120.0
     while True:
@@ -463,32 +474,21 @@ class ProcessCluster:
                         if self.keyring_path else [])
         peers_of = {m: ",".join(n for n in self.mon_names if n != m)
                     for m in self.mon_names}
+        self._mon_args = {"dir_json": dir_json, "env": env,
+                          "pool": pool, "n_osds": n_osds,
+                          "down_out_interval": down_out_interval,
+                          "keyring_args": keyring_args,
+                          "peers_of": peers_of}
 
-        def spawn_mon(rank: int, with_pool: bool) -> None:
-            name = self.mon_names[rank]
-            self.procs[name] = subprocess.Popen(
-                [sys.executable, "-m", "ceph_tpu.vstart", "mon",
-                 "--port", str(self.mon_ports[rank]),
-                 "--n-osds", str(n_osds),
-                 "--directory", dir_json,
-                 "--name", name, "--rank", str(rank),
-                 "--peers", peers_of[name],
-                 "--mon-grace", str(self.mon_grace),
-                 "--mds-grace", str(self.mds_grace),
-                 "--down-out-interval", str(down_out_interval),
-                 "--pool", json.dumps(pool) if (pool and with_pool)
-                 else "",
-                 *keyring_args],
-                stdout=subprocess.PIPE, text=True, cwd=REPO, env=env)
 
         # peons first (they serve the election rank 0 must win); rank 0
         # reports READY only after the initial epochs are committed
         # quorum-wide
         for r in range(1, self.n_mons):
-            spawn_mon(r, with_pool=False)
+            self._spawn_mon(r, with_pool=False)
         for r in range(1, self.n_mons):
             self._await_ready(self.mon_names[r])
-        spawn_mon(0, with_pool=True)
+        self._spawn_mon(0, with_pool=True)
         self._await_ready(self.mon_names[0])
         # spawn every osd CONCURRENTLY: a sequential boot staggers the
         # daemons' first heartbeats past the grace window and the
@@ -562,6 +562,39 @@ class ProcessCluster:
         return RadosClient(
             self.network,
             MonClient(self.network, mon_name or self.mon_names[0]), name)
+
+    def _spawn_mon(self, rank: int, with_pool: bool,
+                   rejoin: bool = False) -> None:
+        a = self._mon_args
+        name = self.mon_names[rank]
+        pool = a["pool"]
+        self.procs[name] = subprocess.Popen(
+            [sys.executable, "-m", "ceph_tpu.vstart", "mon",
+             "--port", str(self.mon_ports[rank]),
+             "--n-osds", str(a["n_osds"]),
+             "--directory", a["dir_json"],
+             "--name", name, "--rank", str(rank),
+             "--peers", a["peers_of"][name],
+             "--mon-grace", str(self.mon_grace),
+             "--mds-grace", str(self.mds_grace),
+             "--down-out-interval", str(a["down_out_interval"]),
+             "--pool", json.dumps(pool) if (pool and with_pool)
+             else "",
+             *(["--rejoin"] if rejoin else []),
+             *a["keyring_args"]],
+            stdout=subprocess.PIPE, text=True, cwd=REPO,
+            env=a["env"])
+
+    def restart_mon(self, rank: int) -> None:
+        """Fresh mon process on the same port: boots EMPTY, rejoins
+        the quorum, and is taught the committed history through the
+        collect/LAST recovery (mon_thrash's revive step)."""
+        old = self.procs.get(self.mon_names[rank])
+        if old is not None and old.poll() is None:
+            old.kill()
+            old.wait()
+        self._spawn_mon(rank, with_pool=False, rejoin=True)
+        self._await_ready(self.mon_names[rank], timeout=120.0)
 
     def kill_mon(self, rank: int) -> None:
         """kill -9 a monitor daemon (the leader-failure drill)."""
@@ -654,6 +687,7 @@ def main(argv=None) -> None:
     pm.add_argument("--mon-grace", type=float, default=0.0)
     pm.add_argument("--mds-grace", type=float, default=0.0)
     pm.add_argument("--pool", default="")
+    pm.add_argument("--rejoin", action="store_true")
     pm.add_argument("--down-out-interval", type=float, default=0.0)
     pm.add_argument("--keyring", default="")
     po = sub.add_parser("osd")

@@ -1,0 +1,181 @@
+"""CephFS directory quotas + file layouts.
+
+The reference enforces dir quotas at the client against the ancestor
+quota-realm chain (src/client/Client.cc:4627 handle_quota,
+:9137/:11502 is_quota_{bytes,files}_exceeded -> EDQUOT) and fixes a
+file's layout (ceph.file.layout.* vxattrs, Client.cc:11645) from the
+nearest ancestor dir layout at create.  Lite split: file-count
+quotas gate dentry creation at the metadata authority, byte quotas
+gate the client's data path using the realm chain cached at open.
+"""
+import pytest
+
+from ceph_tpu.cephfs import FsError
+from ceph_tpu.cephfs.cls_fs import file_oid
+from ceph_tpu.cephfs.mds_client import RemoteCephFS
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.mds import MDSDaemon
+
+EDQUOT = -122
+
+
+@pytest.fixture()
+def world():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("fsmeta", size=3, pg_num=8)
+    c.create_replicated_pool("fsdata", size=3, pg_num=8)
+    c.create_replicated_pool("fastpool", size=3, pg_num=8)
+    mds = MDSDaemon(c.network, c.client("client.mds"), "mds.0",
+                    mkfs=True)
+    fs = RemoteCephFS(c.client("client.a"))
+    fs._drive = lambda: mds.process()
+    return c, mds, fs
+
+
+def test_max_files_quota_edquot(world):
+    c, mds, fs = world
+    fs.mkdir("/proj")
+    fs.set_quota("/proj", max_files=3)
+    fs.create("/proj/a")
+    fs.mkdir("/proj/sub")                 # dirs count too (rsubdirs)
+    fs.create("/proj/sub/b")              # 3rd dentry in the realm
+    with pytest.raises(FsError) as e:
+        fs.create("/proj/c")
+    assert e.value.result == EDQUOT
+    with pytest.raises(FsError) as e:
+        fs.mkdir("/proj/sub/d")           # nested path, same realm
+    assert e.value.result == EDQUOT
+    # outside the realm is unaffected
+    fs.create("/free")
+    # deleting frees the slot
+    fs.unlink("/proj/a")
+    fs.create("/proj/c")
+    # hardlinks consume a dentry too
+    with pytest.raises(FsError) as e:
+        fs.hardlink("/proj/c", "/proj/link")
+    assert e.value.result == EDQUOT
+
+
+def test_max_bytes_quota_on_data_path(world):
+    c, mds, fs = world
+    fs.mkdir("/cap")
+    fs.set_quota("/cap", max_bytes=100)
+    fh = fs.open("/cap/f", "w")
+    fh.write(b"x" * 60, 0)                # under quota, buffered
+    with pytest.raises(FsError) as e:
+        fh.write(b"y" * 60, 60)           # 120 > 100
+    assert e.value.result == EDQUOT
+    fh.close()
+    # write-through path enforces too
+    with pytest.raises(FsError) as e:
+        fs.write("/cap/g", b"z" * 200, 0)
+    assert e.value.result == EDQUOT
+    # and the failed write-through did not leak caps: a fresh open
+    # of the same file proceeds without a revoke stall
+    fs.write("/cap/g", b"ok", 0)
+    assert fs.read("/cap/g") == b"ok"
+    # truncate growth through the MDS is gated as well
+    with pytest.raises(FsError) as e:
+        fs.truncate("/cap/f", 500)
+    assert e.value.result == EDQUOT
+
+
+def test_ancestor_chain_outer_quota_wins(world):
+    c, mds, fs = world
+    fs.mkdir("/outer")
+    fs.mkdir("/outer/inner")
+    fs.set_quota("/outer", max_bytes=50)
+    fs.set_quota("/outer/inner", max_bytes=1000)   # laxer inside
+    with pytest.raises(FsError) as e:
+        fs.write("/outer/inner/f", b"b" * 200, 0)
+    assert e.value.result == EDQUOT
+
+
+def test_quota_survives_mds_failover(world):
+    """Quotas are journaled metadata: a replacement MDS incarnation
+    keeps enforcing them (the VERDICT's failover criterion)."""
+    c, mds, fs = world
+    fs.mkdir("/q")
+    fs.set_quota("/q", max_files=1)
+    fs.create("/q/only")
+    mds2 = MDSDaemon(c.network, c.client("client.mds2"), "mds.0")
+    fs2 = RemoteCephFS(c.client("client.b"))
+    fs2._drive = lambda: mds2.process()
+    with pytest.raises(FsError) as e:
+        fs2.create("/q/two")
+    assert e.value.result == EDQUOT
+    assert fs2.get_quota("/q")[0]["max_files"] == 1
+    # clearing re-opens the gate
+    fs2.set_quota("/q", max_files=0)
+    fs2.create("/q/two")
+
+
+def test_open_create_and_rename_ride_quota(world):
+    """The two creation paths the review flagged: O_CREAT via
+    open('w') and rename-into-realm both hit the max_files gate."""
+    c, mds, fs = world
+    fs.mkdir("/q")
+    fs.set_quota("/q", max_files=1)
+    fs.create("/q/only")
+    with pytest.raises(FsError) as e:
+        fs.open("/q/second", "w")             # O_CREAT path
+    assert e.value.result == EDQUOT
+    fs.create("/outside")
+    with pytest.raises(FsError) as e:
+        fs.rename("/outside", "/q/in")        # absorb-into-realm
+    assert e.value.result == EDQUOT
+    # byte-quota absorbs a moved subtree too
+    fs.mkdir("/b")
+    fs.set_quota("/b", max_bytes=50)
+    fs.mkdir("/big")
+    fs.write("/big/payload", b"m" * 200, 0)
+    with pytest.raises(FsError) as e:
+        fs.rename("/big", "/b/big")
+    assert e.value.result == EDQUOT
+    # a rename WITHIN one realm is not double-counted
+    fs.write("/b/f", b"n" * 40, 0)
+    fs.rename("/b/f", "/b/g")
+
+
+def test_dir_layout_fields_merge(world):
+    c, mds, fs = world
+    fs.mkdir("/m")
+    fs.set_layout("/m", order=16)
+    fs.set_layout("/m", pool="fastpool")      # must keep order=16
+    assert fs.get_layout("/m") == {"order": 16, "pool": "fastpool"}
+
+
+def test_layout_inheritance_and_pool_placement(world):
+    """ceph.dir.layout fixes new files' object size AND data pool;
+    bytes actually land in the layout pool."""
+    c, mds, fs = world
+    fs.mkdir("/fast")
+    fs.set_layout("/fast", order=12, pool="fastpool")
+    assert fs.get_layout("/fast") == {"order": 12, "pool": "fastpool"}
+    ino = fs.create("/fast/f")
+    assert fs.get_layout("/fast/f") == {"order": 12,
+                                        "pool": "fastpool"}
+    payload = bytes(range(256)) * 24          # 6 KiB -> 2 objs @4KiB
+    fs.write("/fast/f", payload, 0)
+    assert fs.read("/fast/f") == payload
+    cl = c.client("client.check")
+    # the objects live in fastpool (order 12 -> 4 KiB stripes), and
+    # NOT in the default data pool
+    assert len(cl.read("fastpool", file_oid(ino, 0))) == 4096
+    assert len(cl.read("fastpool", file_oid(ino, 1))) == 2048
+    with pytest.raises(IOError):
+        cl.read("fsdata", file_oid(ino, 0))
+    # files created elsewhere keep the default layout
+    fs.create("/plain")
+    assert fs.get_layout("/plain")["pool"] is None
+
+
+def test_file_layout_only_while_empty(world):
+    c, mds, fs = world
+    fs.create("/empty")
+    fs.set_layout("/empty", order=13)          # empty: allowed
+    assert fs.get_layout("/empty")["order"] == 13
+    fs.write("/data", b"bytes", 0)
+    with pytest.raises(FsError) as e:
+        fs.set_layout("/data", order=13)       # has data: EINVAL
+    assert e.value.result == -22
