@@ -380,7 +380,12 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
     outs = [fr.resolve_device(wd) for wd in wds]
     np.asarray(outs[-1][0][0, 0])
     total = (time.perf_counter() - t0) * 1000
+    # subtracting the fetch rtt can hit zero when the resolves are
+    # faster than one round trip; fall back to the un-subtracted upper
+    # bound so the metric never reads as "didn't run"
     dev_ms = max(total - rtt_ms, 0.0) / len(wds)
+    if dev_ms == 0.0:
+        dev_ms = total / len(wds)
     host_ms = None
     try:
         from ceph_tpu.native import NativeCrushMapper, native_available
@@ -422,6 +427,15 @@ def main() -> None:
         import jax
         if platform is None:
             jax.config.update("jax_platforms", "cpu")
+        # Persistent compilation cache: the tunnelled XLA compiles are
+        # the dominant cost (a cold crush section pays ~7 min compiling
+        # its four kernels); with the on-disk cache warm, a full run
+        # fits easily inside the driver's 480 s budget.
+        cache_dir = os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception as e:  # pragma: no cover - catastrophic env breakage
         _ERRORS.append(f"jax import failed: {e!r}")
 
@@ -504,16 +518,20 @@ def main() -> None:
     def parity_section() -> None:
         RESULT["decode_parity"] = parity_check(matrix)
 
-    # Ordered so a budget kill costs the least: the two done-criterion
-    # numbers first (headline encode, then the 100k-PG remap), then the
-    # extras, and the fetch-heavy parity receipt dead last.  min_needed
-    # gates reflect that every section pays a fresh tunnelled XLA
-    # compile (minutes, not seconds): better an honest skip at rc=0 than
-    # a watchdog hard-kill mid-compile.
+    # Ordered so a budget kill costs the least AND so the dispatch-
+    # timing sections run before anything does a large device->host
+    # fetch: the crush sections' 100k-row map_batch fetches flip the
+    # tunnelled transport into sync-dispatch mode (~80 ms/dispatch),
+    # which poisoned a decode bench run after them (measured 0.76 GiB/s
+    # vs 313-627 clean).  So: encode, decode (both pure dispatch), then
+    # the remap north star, then extras, then the fetch-heavy parity
+    # receipt dead last.  min_needed gates reflect that a cold-cache
+    # section pays a tunnelled XLA compile (minutes); with the
+    # persistent cache warm they're seconds.
     run_section("device bench", encode_section, 45.0)
+    run_section("decode bench", decode_section, 45.0)
     run_section("crush bench", crush_section, 110.0)
     run_section("crush nonuniform bench", crush_nonuniform_section, 80.0)
-    run_section("decode bench", decode_section, 60.0)
     run_section("decode parity", parity_section, 45.0)
 
 

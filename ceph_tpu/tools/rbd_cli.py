@@ -152,7 +152,25 @@ def main(argv=None) -> int:  # pragma: no cover - thin shell wrapper
     ns, rest = ap.parse_known_args(argv)
     from ..cluster import MiniCluster
     c = MiniCluster.restore(ns.checkpoint)
-    return run(c, c.client("client.rbd-cli"), rest)
+    rc = run(c, c.client("client.rbd-cli"), rest)
+    # match rados.py: persist mutations back into the checkpoint,
+    # but don't rewrite it for read-only verbs
+    toks: list[str] = []
+    skip = False
+    for t in rest:
+        if skip:
+            skip = False
+        elif t in ("-p", "--pool"):
+            skip = True                # option value, not a verb
+        elif not t.startswith("-"):
+            toks.append(t)
+    readonly = (not toks or toks[0] in ("ls", "info", "du", "export",
+                                        "export-diff")
+                or (toks[0] in ("snap", "lock") and len(toks) > 1
+                    and toks[1] == "ls"))
+    if rc == 0 and not readonly:
+        c.checkpoint(ns.checkpoint)
+    return rc
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -89,12 +89,29 @@ def _dispatch(g, client, args, out) -> int:
 
 def main(argv=None) -> int:  # pragma: no cover - thin shell wrapper
     argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or "--help" in argv or "-h" in argv:
+        # the reference prints its full usage and exits 1
+        # (src/rgw/rgw_admin.cc usage(); src/test/cli/radosgw-admin/
+        # help.t pins the bytes)
+        from .rgw_admin_usage import USAGE
+        sys.stdout.write(USAGE)
+        return 1
     ap = argparse.ArgumentParser(prog="radosgw-admin", add_help=False)
     ap.add_argument("--checkpoint", required=True)
     ns, rest = ap.parse_known_args(argv)
     from ..cluster import MiniCluster
     c = MiniCluster.restore(ns.checkpoint)
-    return run(c, c.client("client.rgw-admin"), rest)
+    rc = run(c, c.client("client.rgw-admin"), rest)
+    # rados.py's CLI contract: persist mutations back; reads don't
+    # rewrite the checkpoint
+    toks = [t for t in rest if not t.startswith("-")]
+    mutating = (len(toks) >= 2 and
+                (toks[0], toks[1]) in {("user", "create"), ("user", "rm"),
+                                       ("bucket", "rm"), ("gc", "process"),
+                                       ("lc", "process")})
+    if rc == 0 and mutating:
+        c.checkpoint(ns.checkpoint)
+    return rc
 
 
 if __name__ == "__main__":  # pragma: no cover

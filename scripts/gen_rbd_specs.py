@@ -1,0 +1,205 @@
+"""Derive the rbd command-spec table from the reference's recorded
+help transcript (src/test/cli/rbd/help.t) and emit
+ceph_tpu/tools/rbd_specs.py.
+
+The transcript IS the contract: usage lines give option order,
+required-ness and positional arity; the detailed sections give short
+names, arg-ness and description text (kept with the reference's own
+line breaks so re-rendering through rbd_optfmt reproduces the bytes).
+Run: python scripts/gen_rbd_specs.py [--check]
+"""
+from __future__ import annotations
+
+import re
+import sys
+import os
+
+REF = "/root/reference/src/test/cli/rbd/help.t"
+OUT = os.path.join(os.path.dirname(__file__), "..",
+                   "ceph_tpu", "tools", "rbd_specs.py")
+
+
+def load_blocks():
+    lines = [l[2:] if l.startswith("  ") else l
+             for l in open(REF).read().splitlines()]
+    # global help section: between "$ rbd --help" and the loop command
+    gstart = next(i for i, l in enumerate(lines)
+                  if l.startswith("usage: rbd <command>"))
+    gend = next(i for i, l in enumerate(lines)
+                if l.startswith("$ rbd help | grep"))
+    global_help = lines[gstart:gend]
+    blocks, cur = {}, None
+    for l in lines[gend + 1:]:
+        if l.startswith("rbd help ") and not l.startswith("rbd help |"):
+            cur = l[len("rbd help "):]
+            blocks[cur] = []
+        elif cur is not None:
+            blocks[cur].append(l)
+    return global_help, blocks
+
+
+def parse_command_list(global_help):
+    """name -> (alias tuple or None, wrapped description)."""
+    out = {}
+    in_list = False
+    cur = None
+    for l in global_help:
+        if l.startswith("Positional arguments:"):
+            in_list = True
+            continue
+        if l.startswith("Optional arguments:"):
+            break
+        if not in_list or l.strip() in ("", "<command>"):
+            continue
+        m = re.match(r"^    ([a-z][a-z-]*(?: [a-z-]+)*)"
+                     r"(?: \(([^)]+)\))?(?:\s+(.*))?$", l)
+        if m and not l.startswith("      "):
+            name = m.group(1)
+            alias = tuple(m.group(2).split()) if m.group(2) else None
+            out[name] = alias
+            cur = name
+    return out
+
+
+def parse_usage(block):
+    """-> (spec_words, ordered option tokens w/ required flag,
+    positionals w/ variadic flag)."""
+    usage_lines = []
+    i = 0
+    while i < len(block) and (i == 0 or block[i].startswith(" ")):
+        usage_lines.append(block[i])
+        i += 1
+    flat = ""
+    for l in usage_lines:
+        flat += l.strip() + " "
+    m = re.match(r"usage: rbd ((?:[a-z0-9-]+ )+)", flat)
+    words = []
+    rest = flat[len("usage: rbd "):]
+    toks = rest.split()
+    spec = []
+    j = 0
+    while j < len(toks) and re.fullmatch(r"[a-z0-9-]+", toks[j]):
+        spec.append(toks[j])
+        j += 1
+    opts = []       # (long, required)
+    poss = []       # (name, variadic)
+    rest2 = " ".join(toks[j:])
+    for tok in re.finditer(
+            r"\[--([a-z0-9_-]+)(?: <[^>]+>)?\]"
+            r"|--([a-z0-9_-]+) <[^>]+>"
+            r"|\[<([a-z0-9-]+)> \.\.\.\]"
+            r"|<([a-z0-9-]+)>", rest2):
+        if tok.group(1):
+            opts.append((tok.group(1), False))
+        elif tok.group(2):
+            opts.append((tok.group(2), True))
+        elif tok.group(3):
+            poss[-1] = (poss[-1][0], True)
+        else:
+            poss.append((tok.group(4), False))
+    return spec, opts, poss, i
+
+
+def parse_detailed(block, start):
+    """-> description, {pos name: desc}, {long: (short, has_arg, desc)},
+    extra_help."""
+    i = start
+    while i < len(block) and block[i] == "":
+        i += 1
+    desc = block[i] if i < len(block) else ""
+    i += 1
+    pos_desc, opt_desc = {}, {}
+    extra = []
+    section = None
+    entries = []    # (kind, key, short, has_arg, desclines)
+    cur = None
+    while i < len(block):
+        l = block[i]
+        if l == "Positional arguments":
+            section = "pos"
+            cur = None
+        elif l == "Optional arguments":
+            section = "opt"
+            cur = None
+        elif section and l.startswith("  ") and not l.startswith("   "):
+            if section == "pos":
+                m = re.match(r"^  <([a-z0-9-]+)>\s*(.*)$", l)
+                cur = ["pos", m.group(1), None, False,
+                       [m.group(2)] if m.group(2) else []]
+            else:
+                m = re.match(r"^  (?:-(\w) \[ )?--([a-z0-9_-]+)(?: \])?"
+                             r"( arg)?\s*(.*)$", l)
+                cur = ["opt", m.group(2), m.group(1),
+                       bool(m.group(3)), [m.group(4)] if m.group(4) else []]
+            entries.append(cur)
+        elif section and l.startswith("   ") and cur is not None:
+            cur[4].append(l.strip())
+        elif section == "opt" and l == "":
+            # blank after the optional block: anything further is the
+            # action's extra help (e.g. the Image Features legend)
+            if i + 1 < len(block) and block[i + 1] != "":
+                extra = [x for x in block[i + 1:]]
+                while extra and extra[-1] == "":
+                    extra.pop()
+            break
+        i += 1
+    for kind, key, short, has_arg, dl in entries:
+        text = "\n".join(dl)
+        if kind == "pos":
+            pos_desc[key] = text
+        else:
+            opt_desc[key] = (short, has_arg, text)
+    return desc, pos_desc, opt_desc, "\n".join(extra)
+
+
+def main():
+    global_help, blocks = load_blocks()
+    aliases = parse_command_list(global_help)
+    specs = []
+    for name, block in blocks.items():
+        spec, opts, poss, di = parse_usage(block)
+        desc, pos_desc, opt_desc, extra = parse_detailed(block, di)
+        entry = {
+            "spec": tuple(spec),
+            "alias": aliases.get(name),
+            "desc": desc,
+            "positionals": [
+                (pname, pos_desc.get(pname, ""), var)
+                for pname, var in poss],
+            "options": [
+                (opt_desc[long][0], long, opt_desc[long][1], req,
+                 opt_desc[long][2])
+                for long, req in opts],
+            "help": extra,
+        }
+        specs.append(entry)
+    with open(OUT, "w") as f:
+        f.write('"""rbd command-spec table (generated by '
+                'scripts/gen_rbd_specs.py\nfrom the reference\'s '
+                'recorded help transcript '
+                'src/test/cli/rbd/help.t --\nthe transcript is the '
+                'contract; regenerate rather than hand-edit).\n\n'
+                'Entry: spec words, alias words or None, one-line '
+                'description,\npositionals [(name, desc, variadic)], '
+                'options [(short, long,\nhas_arg, required, desc)], '
+                'extra help text.\n"""\n\n')
+        f.write("SPECS = [\n")
+        for e in specs:
+            f.write("    {\n")
+            for k in ("spec", "alias", "desc"):
+                f.write(f"        {k!r}: {e[k]!r},\n")
+            f.write("        'positionals': [\n")
+            for p in e["positionals"]:
+                f.write(f"            {p!r},\n")
+            f.write("        ],\n        'options': [\n")
+            for o in e["options"]:
+                f.write(f"            {o!r},\n")
+            f.write("        ],\n")
+            f.write(f"        'help': {e['help']!r},\n")
+            f.write("    },\n")
+        f.write("]\n")
+    print(f"wrote {len(specs)} specs to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

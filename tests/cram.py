@@ -24,6 +24,8 @@ TOOLS = {
     "ceph-authtool": "ceph_tpu.tools.authtool",
     "crushtool": "ceph_tpu.tools.crushtool",
     "osdmaptool": "ceph_tpu.tools.osdmaptool",
+    "rbd": "ceph_tpu.tools.rbd_shell",
+    "radosgw-admin": "ceph_tpu.tools.rgw_admin",
 }
 
 

@@ -1,0 +1,31 @@
+"""rbd CLI cram parity: replay the reference's recorded rbd shell
+transcripts (src/test/cli/rbd/*.t) byte-exact through the mini-cram
+interpreter.
+
+These pin the whole argv surface the reference's Shell
+(src/tools/rbd/Shell.cc) exposes without a cluster: the full help
+corpus (80 commands through OptionPrinter/IndentStream formatting),
+boost::program_options-stage errors (too many arguments, invalid
+option values), and the execute-stage validation messages from
+src/tools/rbd/Utils.cc (image/snap/path/lock/meta presence checks).
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from cram import assert_cram  # noqa: E402
+
+REF = "/root/reference/src/test/cli/rbd"
+
+ALL = ["help.t", "not-enough-args.t", "too-many-args.t",
+       "invalid-snap-usage.t"]
+
+
+@pytest.mark.parametrize("name", ALL)
+def test_rbd_cram(name, tmp_path):
+    path = os.path.join(REF, name)
+    if not os.path.exists(path):
+        pytest.skip("reference cram corpus not present")
+    assert_cram(path, str(tmp_path))

@@ -1,0 +1,21 @@
+"""radosgw-admin cram parity: the reference's recorded help
+transcript (src/test/cli/radosgw-admin/help.t) replayed byte-exact —
+the full usage surface of src/rgw/rgw_admin.cc including its exit-1
+contract."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from cram import assert_cram  # noqa: E402
+
+REF = "/root/reference/src/test/cli/radosgw-admin"
+
+
+@pytest.mark.parametrize("name", ["help.t"])
+def test_rgw_admin_cram(name, tmp_path):
+    path = os.path.join(REF, name)
+    if not os.path.exists(path):
+        pytest.skip("reference cram corpus not present")
+    assert_cram(path, str(tmp_path))
