@@ -123,6 +123,28 @@ def build_options() -> List[Option]:
         Option("tracing_kernels", OPT_BOOL).set_default(False)
         .set_description("time every device kernel dispatch (adds a "
                          "sync per call; diagnosis only)"),
+        # daemon-identity path options (reference options.cc defaults,
+        # with the same $cluster/$name metavariables -- ceph-conf
+        # expands them per name; pinned by src/test/cli/ceph-conf)
+        Option("log_file", OPT_STR, LEVEL_BASIC)
+        .set_default("/var/log/ceph/$cluster-$name.log")
+        .set_description("path to log file"),
+        Option("admin_socket", OPT_STR)
+        .set_default("/var/run/ceph/$cluster-$name.asok")
+        .set_description("path for the runtime control socket"),
+        Option("mon_debug_dump_location", OPT_STR)
+        .set_default("/var/log/ceph/$cluster-$name.tdump")
+        .set_description("file to dump paxos transactions to"),
+        Option("fsid", OPT_STR, LEVEL_BASIC).set_default("")
+        .set_description("cluster fsid (uuid)"),
+        Option("mon_host", OPT_STR, LEVEL_BASIC).set_default("")
+        .set_description("list of hosts or addresses for monitors"),
+        Option("public_network", OPT_STR).set_default("")
+        .set_description("network(s) for public traffic"),
+        Option("pid_file", OPT_STR).set_default("")
+        .set_description("path to write the daemon's pid to"),
+        Option("host", OPT_STR, LEVEL_BASIC).set_default("")
+        .set_description("local hostname"),
         # debug_<subsys> levels, "log" or "log/gather" — one schema entry
         # per dout subsystem (single source of truth: SUBSYS_DEFAULTS)
         *[Option(f"debug_{s}", OPT_STR).set_default(f"{lg}/{gt}")

@@ -26,6 +26,8 @@ TOOLS = {
     "osdmaptool": "ceph_tpu.tools.osdmaptool",
     "rbd": "ceph_tpu.tools.rbd_shell",
     "radosgw-admin": "ceph_tpu.tools.rgw_admin",
+    "ceph-conf": "ceph_tpu.tools.ceph_conf",
+    "ceph-kvstore-tool": "ceph_tpu.tools.kvstore_tool",
 }
 
 
