@@ -232,7 +232,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             need()
         elif a.startswith("-"):
             # registered-option override, e.g. CEPH_ARGS="--fsid ..."
-            overrides[_norm_key(a.lstrip("-"))] = need()
+            # (na already has any "=value" split off)
+            overrides[_norm_key(na.lstrip("-"))] = need()
         else:
             lookup_key = a
         i += 1

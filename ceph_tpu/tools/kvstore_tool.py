@@ -122,11 +122,36 @@ class DirStore:
                 os.unlink(os.path.join(pdir, k))
             os.rmdir(pdir)
 
+    def exists_any(self, prefix: str) -> bool:
+        pdir = self._pdir(prefix)
+        return os.path.isdir(pdir) and bool(os.listdir(pdir))
 
-def _pair_crc(prefix: str, key: str, value: bytes) -> int:
-    crc = crc32c(prefix.encode(), 0)
-    crc = crc32c(key.encode(), crc)
-    return crc32c(value, crc)
+    def size(self) -> int:
+        """Whole-store byte size (StoreTool::get_size role) via stat,
+        without reading any values."""
+        total = 0
+        for pesc in os.listdir(self.path):
+            pdir = os.path.join(self.path, pesc)
+            if not os.path.isdir(pdir):
+                continue
+            for kesc in os.listdir(pdir):
+                total += os.stat(os.path.join(pdir, kesc)).st_size
+        return total
+
+
+def _pair_crc(prefix: str, key: str, value: bytes,
+              seed: int = 0) -> int:
+    """crc32c over prefix+key+value concatenated with no separators
+    (StoreTool::traverse builds one bufferlist of the three)."""
+    return crc32c(prefix.encode() + key.encode() + value, seed)
+
+
+def _si_t(n: int) -> str:
+    """byte count with binary-SI suffix (include/types.h si_t)."""
+    for mag, suffix in ((40, "T"), (30, "G"), (20, "M"), (10, "k")):
+        if n >= 1 << mag:
+            return f"{n >> mag}{suffix}"
+    return str(n)
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -165,7 +190,7 @@ def main(argv: Optional[list] = None) -> int:
         if key:
             found = st.get(prefix, key) is not None
         else:
-            found = any(True for _ in st.iterate(prefix))
+            found = st.exists_any(prefix)
         print(f"({url_escape(prefix)}, {url_escape(key)}) "
               + ("exists" if found else "does not exist"))
         return 0 if found else 1
@@ -211,18 +236,23 @@ def main(argv: Optional[list] = None) -> int:
               f"{_pair_crc(prefix, key, v)}")
         return 0
     if cmd == "get-size":
-        if len(rest) >= 2:
-            v = st.get(url_unescape(rest[0]), url_unescape(rest[1]))
-            if v is None:
-                print(f"({url_escape(rest[0])}, {url_escape(rest[1])}) "
-                      "does not exist")
-                return 1
-            print(f"estimated store size: {len(v)}")
+        # reference shape (ceph_kvstore_tool.cc:446-467): the whole-
+        # store estimate always prints first; a lone extra arg is a
+        # usage error; prefix+key adds the pair's size line
+        print(f"estimated store size: {st.size()}")
+        if not rest:
             return 0
-        total = 0
-        for p, k, v in st.iterate(""):
-            total += len(v)
-        print(f"estimated store size: {total}")
+        if len(rest) < 2:
+            sys.stderr.write(USAGE)
+            return 1
+        prefix, key = url_unescape(rest[0]), url_unescape(rest[1])
+        v = st.get(prefix, key)
+        if v is None:
+            sys.stderr.write(f"({url_escape(prefix)},"
+                             f"{url_escape(key)}) does not exist\n")
+            return 1
+        print(f"({url_escape(prefix)},{url_escape(key)}) size "
+              f"{_si_t(len(v))}")
         return 0
     if cmd == "set":
         if len(rest) < 2:
@@ -270,10 +300,18 @@ def main(argv: Optional[list] = None) -> int:
         print(f"  copied {n} keys")
         return 0
     if cmd == "store-crc":
+        # traverse with the dump written to <path> (the reference's
+        # ofstream(argv[4])), crc chained with no separators from -1
+        if not rest:
+            sys.stderr.write(USAGE)
+            return 1
         crc = 0xFFFFFFFF
-        for p, k, v in st.iterate(""):
-            crc = crc32c((f"{p}\0{k}\0").encode() + v, crc)
-        print(f"store at '{path}' crc {crc}")
+        with open(rest[0], "w") as dump:
+            for p, k, v in st.iterate(""):
+                dump.write(f"{url_escape(p)}\t{url_escape(k)}\t"
+                           f"{_pair_crc(p, k, v)}\n")
+                crc = _pair_crc(p, k, v, crc)
+        print(f"store at '{rest[0]}' crc {crc}")
         return 0
     if cmd in ("compact", "compact-prefix", "compact-range"):
         return 0        # directory store has nothing to compact

@@ -11,7 +11,7 @@ import pytest
 
 from ceph_tpu.cluster import MiniCluster
 from ceph_tpu.rgw import RGWLite, S3Frontend, serve
-from ceph_tpu.rgw.http import _sign_v2
+from ceph_tpu.rgw.http import sign_v2
 
 
 def _local(tag):
@@ -51,11 +51,11 @@ class S3Rest:
 
     def req(self, method, path, body=b"", query=None, headers=None):
         hdrs = dict(headers or {})
-        sig = _sign_v2(self.user["secret_key"], method, self.DATE,
-                       path)
+        hdrs["Date"] = self.DATE
+        sig = sign_v2(self.user["secret_key"], method, path, hdrs,
+                      query or {})
         hdrs["Authorization"] = \
             f"AWS {self.user['access_key']}:{sig}"
-        hdrs["Date"] = self.DATE
         return self.fe.handle(method, path, hdrs, body, query or {})
 
     def xml(self, method, path, **kw):
@@ -397,11 +397,14 @@ def test_rest_cross_user_matrix_over_sockets(rest):
             conn = http.client.HTTPConnection("127.0.0.1", port,
                                               timeout=10)
             hdrs = dict(headers or {})
-            sig = _sign_v2(client.user["secret_key"], method,
-                           client.DATE, path.split("?")[0])
+            hdrs["Date"] = client.DATE
+            qs = path.split("?", 1)[1] if "?" in path else ""
+            query = dict(kv.partition("=")[::2] for kv in qs.split("&")
+                         if kv)
+            sig = sign_v2(client.user["secret_key"], method,
+                          path.split("?")[0], hdrs, query)
             hdrs["Authorization"] = \
                 f"AWS {client.user['access_key']}:{sig}"
-            hdrs["Date"] = client.DATE
             conn.request(method, path, body=body, headers=hdrs)
             r = conn.getresponse()
             data = r.read()

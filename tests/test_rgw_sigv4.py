@@ -1,0 +1,127 @@
+"""AWS signature v4 at the rgw HTTP boundary (rgw_auth_s3.cc's
+AWS4-HMAC-SHA256 header flavor): canonical request over signed
+headers + credential-scope key chain, payload-hash verification, and
+the same ACL enforcement as v2-signed requests.
+"""
+import hashlib
+
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.rgw import S3Frontend
+from ceph_tpu.rgw.gateway import RGWLite
+from ceph_tpu.rgw.http import sign_v4
+
+
+@pytest.fixture()
+def fe():
+    c = MiniCluster(n_osds=3)
+    c.create_replicated_pool("rgw.meta", size=3, pg_num=8)
+    c.create_replicated_pool("rgw.data", size=3, pg_num=8)
+    g = RGWLite(c.client("client.rgw"), "rgw.meta", "rgw.data")
+    alice = g.create_user("alice", "Alice")
+    bob = g.create_user("bob", "Bob")
+    return S3Frontend(g), alice, bob
+
+
+def v4req(fe, user, method, path, body=b"", query=None, headers=None,
+          unsigned=False, tamper_body=None):
+    hdrs = dict(headers or {})
+    hdrs.setdefault("Host", "s3.local")
+    hdrs["Authorization"] = sign_v4(
+        user["access_key"], user["secret_key"], method, path,
+        hdrs, query or {}, body, unsigned_payload=unsigned)
+    sent = tamper_body if tamper_body is not None else body
+    return fe.handle(method, path, hdrs, sent, query or {})
+
+
+def test_v4_round_trip(fe):
+    front, alice, _ = fe
+    assert v4req(front, alice, "PUT", "/b")[0] == 200
+    assert v4req(front, alice, "PUT", "/b/k", b"payload")[0] == 200
+    st, _, body = v4req(front, alice, "GET", "/b/k")
+    assert (st, body) == (200, b"payload")
+    # subresource + query participate in the canonical request
+    st, _, body = v4req(front, alice, "GET", "/b",
+                        query={"versioning": ""})
+    assert st == 200 and b"VersioningConfiguration" in body
+
+
+def test_v4_unsigned_payload(fe):
+    front, alice, _ = fe
+    assert v4req(front, alice, "PUT", "/b")[0] == 200
+    assert v4req(front, alice, "PUT", "/b/u", b"data",
+                 unsigned=True)[0] == 200
+
+
+def test_v4_rejects_tampering(fe):
+    front, alice, bob = fe
+    assert v4req(front, alice, "PUT", "/b")[0] == 200
+    # body swapped after signing: payload hash mismatch
+    st, _, _ = v4req(front, alice, "PUT", "/b/k", b"good",
+                     tamper_body=b"evil")
+    assert st == 403
+    # signature from the wrong secret
+    fake = dict(alice)
+    fake["secret_key"] = "not-the-secret"
+    assert v4req(front, fake, "GET", "/b")[0] == 403
+    # malformed credential scope
+    st, _, _ = front.handle("GET", "/b", {
+        "Host": "s3.local",
+        "x-amz-date": "20260101T000000Z",
+        "Authorization": "AWS4-HMAC-SHA256 Credential=zzz, "
+                         "SignedHeaders=host, Signature=00"}, b"", {})
+    assert st == 403
+
+
+def test_v4_acl_enforced_same_as_v2(fe):
+    front, alice, bob = fe
+    assert v4req(front, alice, "PUT", "/priv")[0] == 200
+    assert v4req(front, alice, "PUT", "/priv/doc", b"x")[0] == 200
+    assert v4req(front, bob, "GET", "/priv/doc")[0] == 403
+    # public-read opens GET for bob's correctly-signed v4 request
+    acl = (b'<AccessControlPolicy><Owner><ID>alice</ID></Owner>'
+           b'<AccessControlList><Grant><Grantee '
+           b'xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance" '
+           b'xsi:type="Group"><URI>http://acs.amazonaws.com/groups/'
+           b'global/AllUsers</URI></Grantee>'
+           b'<Permission>READ</Permission></Grant>'
+           b'<Grant><Grantee xsi:type="CanonicalUser" '
+           b'xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance">'
+           b'<ID>alice</ID></Grantee>'
+           b'<Permission>FULL_CONTROL</Permission></Grant>'
+           b'</AccessControlList></AccessControlPolicy>')
+    st, _, out = v4req(front, alice, "PUT", "/priv",
+                       body=acl, query={"acl": ""})
+    assert st == 200, out
+    assert v4req(front, bob, "GET", "/priv/doc")[0] == 200
+    assert v4req(front, bob, "PUT", "/priv/doc", b"y")[0] == 403
+
+
+def test_v4_streaming_payload_refused(fe):
+    """Chunked uploads would need per-chunk verification (the
+    reference's AWSv4ComplMulti); accepting them unverified would be
+    an integrity hole, so the frontend refuses the marker."""
+    front, alice, _ = fe
+    assert v4req(front, alice, "PUT", "/b")[0] == 200
+    hdrs = {"Host": "s3.local",
+            "x-amz-content-sha256": "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"}
+    hdrs["Authorization"] = sign_v4(
+        alice["access_key"], alice["secret_key"], "PUT", "/b/s",
+        hdrs, {}, b"")
+    st, _, _ = front.handle("PUT", "/b/s", hdrs, b"tampered", {})
+    assert st == 403
+
+
+def test_v4_content_sha256_mismatch_header(fe):
+    front, alice, _ = fe
+    assert v4req(front, alice, "PUT", "/b")[0] == 200
+    # a signed-but-wrong x-amz-content-sha256 fails even though the
+    # signature over it is internally consistent
+    hdrs = {"Host": "s3.local",
+            "x-amz-content-sha256": hashlib.sha256(b"other").hexdigest()}
+    hdrs["Authorization"] = sign_v4(
+        alice["access_key"], alice["secret_key"], "PUT", "/b/k",
+        hdrs, {}, b"other")
+    st, _, _ = front.handle("PUT", "/b/k", hdrs, b"real", {})
+    assert st == 403
