@@ -1,0 +1,16 @@
+#!/bin/bash
+# TPU-tunnel liveness probe loop: one line per attempt in PROBE_r05.log
+# (timestamp, outcome) — the auditable record of accelerator
+# availability during the round.
+LOG=/root/repo/PROBE_r05.log
+while true; do
+  ts=$(date -u +"%Y-%m-%dT%H:%M:%SZ")
+  out=$(timeout 60 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+  rc=$?
+  if [ "$rc" = 0 ] && [ -n "$out" ] && [ "$out" != "cpu" ]; then
+    echo "$ts LIVE $out" >> "$LOG"
+  else
+    echo "$ts DEAD rc=$rc" >> "$LOG"
+  fi
+  sleep 240
+done
