@@ -341,11 +341,26 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
     rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
     xs = np.arange(n_pgs, dtype=np.uint32)
     w = np.full(n_osds, 0x10000, dtype=np.uint32)
+
+    dbg = os.environ.get("CEPH_TPU_BENCH_DEBUG")
+    tmark = time.monotonic()
+
+    def mark(label: str) -> None:
+        nonlocal tmark
+        if dbg:
+            now = time.monotonic()
+            print(f"[crush-bench] {label}: {now - tmark:.1f}s",
+                  file=sys.stderr)
+            tmark = now
+
     fr = compile_fast_rule(cw.crush, rno, 3)
+    mark("compile_fast_rule (host tables)")
     fr.map_batch(xs, w)  # compile + candidate tables + warm (full fetch)
+    mark("map_batch warm #1 (cand+resolve compiles)")
     wwarm = w.copy()
     wwarm[1] = 0
     fr.map_batch(xs, wwarm)  # warm the delta-path trace/compile too
+    mark("map_batch warm #2 (delta compile)")
     # per-epoch wall time: one osd out per epoch.  map_batch's delta path
     # fetches only changed rows, so the wall is one resolve + one small
     # device->host transfer (OSDMapMapping's per-epoch job).
@@ -357,6 +372,7 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
         fr.map_batch(xs, w2)
         walls.append(time.perf_counter() - t0)
     wall_ms = sorted(walls)[len(walls) // 2] * 1000
+    mark("per-epoch wall loop")
     # device->host round-trip floor of this transport (tunnelled PJRT
     # pays ~100 ms here; local PCIe pays ~0) so wall_ms is interpretable
     tiny = jnp.zeros((8,), jnp.int32) + jnp.int32(1)
@@ -376,10 +392,12 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
         w2[(13 * e + 29) % n_osds] = 0
         wds.append(jnp.asarray(w2))
     np.asarray(fr.resolve_device(wds[0])[0][0, 0])   # warm + drain
+    mark("resolve_device warm")
     t0 = time.perf_counter()
     outs = [fr.resolve_device(wd) for wd in wds]
     np.asarray(outs[-1][0][0, 0])
     total = (time.perf_counter() - t0) * 1000
+    mark("sustained resolve loop")
     # subtracting the fetch rtt can hit zero when the resolves are
     # faster than one round trip; fall back to the un-subtracted upper
     # bound so the metric never reads as "didn't run"

@@ -322,7 +322,22 @@ def mds_main(args) -> None:
             if getattr(e, "errno", None) == 2:
                 if my_rank == 0:
                     fresh = True
-                elif time.monotonic() > deadline:
+                    continue
+                # a promoted rank > 0 must outwait a SLOW rank 0, not
+                # just a dead one: while the fsmap still shows a
+                # rank-0 incumbent its mkfs is in progress somewhere,
+                # so the deadline keeps sliding (loaded-host runs
+                # exceeded a fixed 120 s before rank 0 finished).
+                # Keep beaconing meanwhile — a silent promoted rank
+                # would be grace-failed by the mon while it waits.
+                if time.monotonic() - last_beacon > 1.0:
+                    beacon("active")
+                    last_beacon = time.monotonic()
+                _r, ranks = fs_state()
+                if 0 in ranks:
+                    deadline = max(deadline,
+                                   time.monotonic() + 120.0)
+                if time.monotonic() > deadline:
                     raise RuntimeError("rank 0 never created the fs")
                 else:
                     net.pump(quiesce=0.05, deadline=0.3)
