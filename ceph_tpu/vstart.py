@@ -314,7 +314,20 @@ def mds_main(args) -> None:
     # (rank 0's mkfs) and never creates it.
     fresh = None
     deadline = time.monotonic() + 120.0
+    last_slide = 0.0
+
+    def keepalive() -> None:
+        # EVERY promoted daemon (rank 0 doing mkfs included) must
+        # keep beaconing while it initializes — a silent active is
+        # grace-failed by the mon and its rank reseated under it,
+        # which on a slow host means dual mkfs writers
+        nonlocal last_beacon
+        if time.monotonic() - last_beacon > 1.0:
+            beacon("active")
+            last_beacon = time.monotonic()
+
     while fresh is None:
+        keepalive()
         try:
             rados.stat(args.metadata_pool, dir_oid(ROOT_INO))
             fresh = False
@@ -328,15 +341,13 @@ def mds_main(args) -> None:
                 # rank-0 incumbent its mkfs is in progress somewhere,
                 # so the deadline keeps sliding (loaded-host runs
                 # exceeded a fixed 120 s before rank 0 finished).
-                # Keep beaconing meanwhile — a silent promoted rank
-                # would be grace-failed by the mon while it waits.
-                if time.monotonic() - last_beacon > 1.0:
-                    beacon("active")
-                    last_beacon = time.monotonic()
-                _r, ranks = fs_state()
-                if 0 in ranks:
-                    deadline = max(deadline,
-                                   time.monotonic() + 120.0)
+                # The status poll rides the same 1 s cadence as the
+                # beacons — the slide needs no finer granularity.
+                if time.monotonic() - last_slide > 1.0:
+                    last_slide = time.monotonic()
+                    _r, ranks = fs_state()
+                    if 0 in ranks:
+                        deadline = max(deadline, last_slide + 120.0)
                 if time.monotonic() > deadline:
                     raise RuntimeError("rank 0 never created the fs")
                 else:
@@ -349,6 +360,7 @@ def mds_main(args) -> None:
                 time.sleep(0.3)
     mds = None
     while mds is None:
+        keepalive()
         try:
             mds = MDSDaemon(net, rados, args.name,
                             metadata_pool=args.metadata_pool,
