@@ -201,6 +201,10 @@ def _registry() -> Dict[str, TypeHandler]:
         mm = MonMap(fsid="00000000-1111-2222-3333-444444444444")
         mm.add("a", "127.0.0.1:6789")
         mm.add("b", "127.0.0.1:6790")
+        # pin wall-clock fields so the archived corpus regenerates
+        # byte-identically (a real diff must mean a codec change)
+        mm.created = 1750000000.0
+        mm.last_changed = 1750000000.0
         return [mm]
 
     reg["MonMap"] = TypeHandler(

@@ -1,0 +1,42 @@
+"""Regenerate the archived encoding corpus
+(tests/corpus/encodings/*.bin) from the dencoder registry's generated
+test instances — the ceph-object-corpus role: blobs written by one
+version of the framework must keep decoding in every later version
+(tests/test_encoding_corpus.py enforces it).
+
+Run ONLY when an encoding change is intentional; the diff of the
+regenerated blobs is the reviewable record of what changed.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ceph_tpu.tools.dencoder import _registry  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "tests", "corpus",
+                   "encodings")
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    # drop stale blobs first: a rename/removal must not leave orphans
+    # that fail the corpus test after a documented regeneration
+    for old in os.listdir(OUT):
+        if old.endswith(".bin"):
+            os.unlink(os.path.join(OUT, old))
+    reg = _registry()
+    n = 0
+    for name, h in reg.items():
+        for i, t in enumerate(h.tests(), 1):
+            safe = name.replace(":", "_")
+            with open(os.path.join(OUT, f"{safe}.{i}.bin"), "wb") as f:
+                f.write(h.encode(t))
+            n += 1
+    print(f"archived {n} blobs for {len(reg)} types")
+
+
+if __name__ == "__main__":
+    main()

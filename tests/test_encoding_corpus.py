@@ -1,0 +1,43 @@
+"""Archived-encoding non-regression (the ceph-object-corpus +
+test/encoding/readable.sh role): blobs under tests/corpus/encodings/
+were written by an earlier state of the framework; the CURRENT code
+must still decode every one, and re-encode it byte-identical.
+
+An intentional encoding change regenerates the corpus
+(scripts/gen_encoding_corpus.py) so the blob diff is reviewed with
+the code change; an accidental one fails here first.
+"""
+import glob
+import os
+
+import pytest
+
+from ceph_tpu.tools.dencoder import _registry
+
+DIR = os.path.join(os.path.dirname(__file__), "corpus", "encodings")
+BLOBS = sorted(glob.glob(os.path.join(DIR, "*.bin")))
+REG = _registry()
+
+
+def _type_for(path):
+    stem = os.path.basename(path).rsplit(".", 2)[0]
+    # ':' is not filename-safe; the generator maps it to '_'
+    for name in REG:
+        if name.replace(":", "_") == stem:
+            return name
+    return None
+
+
+def test_corpus_present():
+    assert len(BLOBS) >= 60, "encoding corpus missing or truncated"
+
+
+@pytest.mark.parametrize("path", BLOBS,
+                         ids=[os.path.basename(p) for p in BLOBS])
+def test_archived_blob_still_decodes(path):
+    name = _type_for(path)
+    assert name is not None, f"no registered type for {path}"
+    h = REG[name]
+    blob = open(path, "rb").read()
+    obj = h.decode(blob)                 # the decode guarantee
+    assert h.encode(obj) == blob         # and stable re-encode
