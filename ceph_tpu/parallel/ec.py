@@ -89,3 +89,66 @@ class ShardedRS:
         bits = self.decode_bits(tuple(srcs), tuple(want_rows))
         sv = jax.device_put(jnp.asarray(survivors), self.data_sharding)
         return np.asarray(self._decode_jit(sv, bits))
+
+    # -- contraction-sharded decode -----------------------------------------
+    def decode_data_survivor_sharded(self, survivors: np.ndarray,
+                                     srcs: Sequence[int],
+                                     want_rows: Sequence[int]
+                                     ) -> np.ndarray:
+        """Decode with the SURVIVORS sharded across the ``shard`` axis.
+
+        The degraded-read case where no single chip holds all k
+        survivor shards (each device fetched its own subset from its
+        OSDs — the sequence/context-parallel layout of this
+        framework).  GF(2) makes the contraction reduction a psum:
+        every device computes the int32 bit-accumulator over its local
+        k-slice, one ``lax.psum`` rides the ICI mesh, and only THEN is
+        accumulator parity taken — XOR-allreduce expressed as the
+        compiler-native collective (the NCCL-allreduce role in the
+        reference's recovery fan-in, osd/ECBackend.cc:1141-1281, where
+        shard reads converge on the primary).
+        """
+        nshard = self.mesh.shape[SHARD_AXIS]
+        k = survivors.shape[1]
+        if k % nshard:
+            raise ValueError(f"k={k} not divisible by shard axis "
+                             f"size {nshard}")
+        bits = self.decode_bits(tuple(srcs), tuple(want_rows))
+        sv = jax.device_put(
+            jnp.asarray(survivors),
+            NamedSharding(self.mesh, P(STRIPE_AXIS, SHARD_AXIS, None)))
+        bd = jax.device_put(
+            bits, NamedSharding(self.mesh, P(SHARD_AXIS, None)))
+        return np.asarray(self._collective_decode_jit()(sv, bd))
+
+    def _collective_decode_jit(self):
+        """The shard_map-wrapped kernel, built once per instance so
+        repeat degraded reads hit jit's cache instead of retracing."""
+        fn = getattr(self, "_collective_fn", None)
+        if fn is not None:
+            return fn
+        try:
+            from jax import shard_map            # jax >= 0.8
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+        from ..ops.gf_matmul import _pack_bits, _unpack_bits
+
+        def local_partial(sv_local, bits_local):
+            # sv_local (S/dp, k/tp, C); bits_local (k*8/tp, r*8)
+            d = jnp.transpose(sv_local, (0, 2, 1))
+            planes = _unpack_bits(d).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                planes, bits_local,
+                dimension_numbers=(((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            acc = jax.lax.psum(acc, SHARD_AXIS)
+            parity = (acc & 1).astype(jnp.uint8)
+            return jnp.transpose(_pack_bits(parity), (0, 2, 1))
+
+        fn = jax.jit(shard_map(
+            local_partial, mesh=self.mesh,
+            in_specs=(P(STRIPE_AXIS, SHARD_AXIS, None),
+                      P(SHARD_AXIS, None)),
+            out_specs=P(STRIPE_AXIS, None, None)))
+        self._collective_fn = fn
+        return fn

@@ -366,12 +366,6 @@ def mds_main(args) -> None:
                             metadata_pool=args.metadata_pool,
                             data_pool=args.data_pool, mkfs=fresh,
                             rank=my_rank)
-            # seed the rank map NOW — serving with a single-entry map
-            # until the first fence-check tick would short-circuit
-            # routing and journal other ranks' subtrees
-            _r, ranks0 = fs_state()
-            if ranks0:
-                mds.set_mds_map(ranks0)
         except IOError:
             # some PG of the fresh pools still settling; mkfs/journal
             # creation is idempotent, so just try again
@@ -379,6 +373,26 @@ def mds_main(args) -> None:
                 raise
             net.pump(quiesce=0.05, deadline=0.3)
             time.sleep(0.5)
+    # seed the rank map BEFORE serving — with an empty map a freshly
+    # promoted rank treats other ranks' subtrees as its own and
+    # answers ENOENT where it must FORWARD, so a transient fs_status
+    # failure here cannot be shrugged off; a SEPARATE loop from the
+    # construction retry so an IOError mid-seed cannot skip it
+    seeded = False
+    while not seeded:
+        keepalive()
+        try:
+            _r, ranks0 = fs_state()
+            if ranks0:
+                mds.set_mds_map(ranks0)
+                seeded = True
+                continue
+        except IOError:
+            pass
+        if time.monotonic() > deadline:
+            raise RuntimeError("fsmap never readable before serving")
+        net.pump(quiesce=0.05, deadline=0.3)
+        time.sleep(0.3)
     last_beacon = 0.0
     last_fence_check = time.monotonic()
     while True:
