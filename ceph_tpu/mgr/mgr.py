@@ -41,6 +41,9 @@ class Manager(Dispatcher):
                         "pg_autoscaler"]
         self.balancer_active = False     # 'ceph balancer on' equivalent
         self.last_optimize_result = 0
+        # every optimize pass appended here (the restful module's
+        # /request history role): (mode, changes_proposed)
+        self.proposal_log: List[Dict] = []
         # per-PG usage from primaries' MPGStats reports (newest epoch
         # wins — only the current primary reports a PG, so no double
         # counting):  (pool, ps) -> (epoch, objects, bytes)
@@ -90,6 +93,8 @@ class Manager(Dispatcher):
         n = calc_pg_upmaps(work, max_deviation=max_deviation,
                            max_iterations=max_iterations, inc=inc)
         self.last_optimize_result = n
+        self.proposal_log.append({"mode": "upmap", "changes": n,
+                                  "epoch": self.osdmap.epoch})
         if n:
             self.mon.publish(inc)
             self.network.pump()

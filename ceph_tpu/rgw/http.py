@@ -704,58 +704,9 @@ class S3Frontend:
 
 def serve(frontend: S3Frontend, port: int = 0):
     """Threaded stdlib HTTP server; returns (server, port).  Call
-    ``server.shutdown()`` when done."""
-    import threading
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-    from urllib.parse import parse_qsl, urlparse
-
-    # the in-process rados client/fabric is not thread-safe; requests
-    # from concurrent connections serialize here (the reference runs a
-    # real thread pool over a thread-safe RGWRados)
-    lock = threading.Lock()
-
-    class Handler(BaseHTTPRequestHandler):
-        def _run(self, method):
-            u = urlparse(self.path)
-            ln = int(self.headers.get("Content-Length", "0") or 0)
-            body = self.rfile.read(ln) if ln else b""
-            with lock:
-                # keep_blank_values: bare subresource markers
-                # (?versioning, ?uploads, ?acl ...) must survive
-                status, hdrs, out = frontend.handle(
-                    method, u.path, dict(self.headers), body,
-                    dict(parse_qsl(u.query, keep_blank_values=True)))
-            self.send_response(status)
-            for k, v in hdrs.items():
-                self.send_header(k, v)
-            if "Content-Length" not in hdrs:
-                self.send_header("Content-Length", str(len(out)))
-            self.end_headers()
-            if method != "HEAD":
-                self.wfile.write(out)
-
-        def do_GET(self):
-            self._run("GET")
-
-        def do_PUT(self):
-            self._run("PUT")
-
-        def do_POST(self):
-            self._run("POST")
-
-        def do_DELETE(self):
-            self._run("DELETE")
-
-        def do_HEAD(self):
-            self._run("HEAD")
-
-        def log_message(self, *a):      # keep test output clean
-            pass
-
-    srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    t = threading.Thread(target=srv.serve_forever, daemon=True)
-    t.start()
-    return srv, srv.server_address[1]
+    ``server.shutdown()`` + ``server.server_close()`` when done."""
+    from ..common.http_serve import serve_frontend
+    return serve_frontend(frontend.handle, port)
 
 
 class SwiftFrontend:

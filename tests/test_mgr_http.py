@@ -1,0 +1,99 @@
+"""mgr HTTP frontends: the prometheus /metrics endpoint and the
+restful-module JSON read surface, both through handle() and over a
+real socket."""
+import http.client
+import json
+
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.mgr.http import MgrHttp, serve
+
+
+@pytest.fixture()
+def fe():
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("data", pg_num=8)
+    c.create_ec_pool("ec", k=2, m=1, pg_num=8)
+    return c, MgrHttp(c.mgr, cluster=c,
+                      perf_collection=c.perf_collection)
+
+
+def test_routes(fe):
+    c, f = fe
+    st, hdrs, body = f.handle("GET", "/metrics")
+    assert st == 200 and b"ceph_osdmap_epoch" in body \
+        and b"ceph_osd_up 4" in body
+
+    st, _, body = f.handle("GET", "/health")
+    doc = json.loads(body)
+    assert doc["health"].startswith("HEALTH")
+
+    st, _, body = f.handle("GET", "/osd")
+    osds = json.loads(body)
+    assert len(osds) == 4 and all(o["up"] == 1 for o in osds)
+    st, _, body = f.handle("GET", "/osd/2")
+    assert json.loads(body)["osd"] == 2
+    assert f.handle("GET", "/osd/99")[0] == 404
+    assert f.handle("GET", "/osd/abc")[0] == 400
+
+    st, _, body = f.handle("GET", "/pool")
+    pools = json.loads(body)
+    names = {p["pool_name"]: p for p in pools}
+    assert names["data"]["type"] == "replicated"
+    assert names["ec"]["type"] == "erasure"
+    pid = names["ec"]["pool"]
+    st, _, body = f.handle("GET", f"/pool/{pid}")
+    assert json.loads(body)["pool_name"] == "ec"
+
+    st, _, body = f.handle("GET", "/pg")
+    doc = json.loads(body)
+    assert doc["num_pgs"] == 16 and doc["pg_states"]
+
+    st, _, body = f.handle("GET", "/crush/rule")
+    rules = json.loads(body)
+    assert any(r["rule_name"] for r in rules)
+
+    st, _, body = f.handle("GET", "/mon")
+    assert json.loads(body)[0]["name"]
+
+    # perf counters flow through /metrics via the collection
+    c.client("client.t").write_full("data", "o", b"x" * 64)
+    _, _, body = f.handle("GET", "/metrics")
+    assert b"ceph_daemon_" in body
+
+    # the balancer history surfaces on /request
+    c.mgr.balancer_optimize()
+    st, _, body = f.handle("GET", "/request")
+    log = json.loads(body)
+    assert st == 200 and log and log[-1]["mode"] == "upmap"
+
+    assert f.handle("GET", "/nope")[0] == 404
+    assert f.handle("GET", "/osd/2/garbage")[0] == 404
+    assert f.handle("GET", "/mon/extra")[0] == 404
+    assert f.handle("POST", "/osd")[0] == 405
+
+
+def test_osd_state_reflected(fe):
+    c, f = fe
+    c.mark_osd_out(1)
+    doc = json.loads(f.handle("GET", "/osd/1")[2])
+    assert doc["in"] == 0 and doc["up"] == 1
+
+
+def test_over_socket(fe):
+    c, f = fe
+    srv, port = serve(f)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=20)
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        assert r.status == 200 and b"ceph_pools" in r.read()
+        conn.request("GET", "/pool")
+        r = conn.getresponse()
+        assert r.status == 200 and len(json.loads(r.read())) == 2
+        conn.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
