@@ -1349,13 +1349,8 @@ class Monitor(Dispatcher):
         file (the mon store: resume = load + replay)."""
         import json
         import os as _os
-        from ..osdmap.encoding import incremental_to_dict, osdmap_to_dict
-        state = {
-            "osdmap": osdmap_to_dict(self.osdmap),
-            "incrementals": [incremental_to_dict(i)
-                             for i in self.incrementals],
-            "monmap": self.monmap.to_bytes().decode("latin1"),
-        }
+        state = mon_store_state(self.osdmap, self.incrementals,
+                                self.monmap)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(state, f)
@@ -1475,3 +1470,15 @@ class Monitor(Dispatcher):
             if len(reporters) >= self.min_down_reporters():
                 del self._failure_reports[msg.target_osd]
                 self.mark_osd_down(msg.target_osd)
+
+
+def mon_store_state(osdmap, incrementals, monmap) -> dict:
+    """The mon store's on-disk shape — ONE writer definition shared by
+    Monitor.save and the DR rebuild (tools/rebuild_mondb.py), so the
+    two can never drift; Monitor.load is the reader."""
+    from ..osdmap.encoding import incremental_to_dict, osdmap_to_dict
+    return {
+        "osdmap": osdmap_to_dict(osdmap),
+        "incrementals": [incremental_to_dict(i) for i in incrementals],
+        "monmap": monmap.to_bytes().decode("latin1"),
+    }

@@ -246,6 +246,7 @@ class OSD(Dispatcher):
             if inc.epoch == self.osdmap.epoch + 1:
                 was_up = {o for o in range(self.osdmap.max_osd)
                           if self.osdmap.is_up(o)}
+                self._persist_incremental(inc)
                 self.osdmap.apply_incremental(inc)
                 if inc.old_pools:
                     self._purge_deleted_pools(inc.old_pools)
@@ -271,6 +272,22 @@ class OSD(Dispatcher):
                                 MOSDBoot(osd=self.osd_id,
                                          epoch=self.osdmap.epoch), mon)
                 self._consume_map()
+
+    def _persist_incremental(self, inc) -> None:
+        """Store every applied map epoch in the meta collection
+        (OSD::handle_osd_map writing inc_osdmap.<e> into coll::meta):
+        the on-disk history that lets rebuild-mondb reconstruct a
+        LOST mon store from surviving OSDs."""
+        from ..msg.wire import encode_blob
+        from ..osdmap.encoding import incremental_to_dict
+        t = Transaction()
+        cid = "meta"
+        if not self.store.collection_exists(cid):
+            t.create_collection(cid)
+        oid = hobject_t(f"inc_osdmap.{inc.epoch}")
+        t.touch(cid, oid)
+        t.write(cid, oid, 0, encode_blob(incremental_to_dict(inc)))
+        self.store.queue_transaction(t)
 
     # ---- stray PG removal (PG RecoveryState::Stray + OSD::_remove_pg) -----
     def _local_pg_collections(self) -> Dict[Tuple[int, int], List[str]]:

@@ -61,6 +61,8 @@ def _osd_df(c) -> None:
         n_obj = 0
         n_bytes = 0
         for cid in osd.store.list_collections():
+            if cid == "meta":
+                continue      # map history, not client data
             for ho in osd.store.list_objects(cid):
                 n_obj += 1
                 n_bytes += osd.store.stat(cid, ho)
