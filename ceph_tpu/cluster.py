@@ -133,6 +133,34 @@ class MiniCluster:
                       "dump perfcounters")
         asok.register("config show", lambda c, a: g_conf.show_config(),
                       "show config values")
+
+        def _config_set(c, a):
+            # runtime reconfiguration with observer notification — the
+            # `ceph daemon X config set` / `ceph tell ... injectargs`
+            # role (md_config_t::set_val + apply_changes)
+            name = a.get("name", "")
+            if name not in g_conf.schema:
+                raise ValueError(f"unrecognized config option "
+                                 f"'{name}'")
+            try:
+                g_conf.set_val(name, a.get("value", ""))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"invalid value '{a.get('value', '')}' for "
+                    f"option '{name}'")
+            return {name: g_conf.get_val(name), "success": True}
+
+        def _config_get(c, a):
+            name = a.get("name", "")
+            if name not in g_conf.schema:
+                raise ValueError(f"unrecognized config option "
+                                 f"'{name}'")
+            return {name: g_conf.get_val(name)}
+
+        asok.register("config set", _config_set,
+                      "set a config option at runtime")
+        asok.register("config get", _config_get,
+                      "get one config value")
         asok.register("status",
                       lambda c, a: {"health": self.health(),
                                     "epoch": self.mon.osdmap.epoch,

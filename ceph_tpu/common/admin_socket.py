@@ -51,7 +51,10 @@ class AdminSocket:
                      args: Optional[Dict[str, str]] = None) -> str:
         try:
             return json.dumps(self.execute(command, args), default=str)
-        except KeyError as e:
+        except (KeyError, ValueError) as e:
+            # hooks signal bad arguments with ValueError (e.g. config
+            # set on an unknown option); socket clients must still get
+            # a JSON reply, not a dropped connection
             return json.dumps({"error": str(e)})
 
     # ---- optional real unix socket ----------------------------------------

@@ -83,9 +83,12 @@ def main(argv=None) -> int:
                    help="checkpoint directory (MiniCluster.checkpoint)")
     p.add_argument("verb", choices=["status", "health", "df", "osd",
                                     "pg", "log", "config-key", "fs",
-                                    "mds", "mon"])
+                                    "mds", "mon", "tell", "daemon"])
     p.add_argument("args", nargs="*")
-    a = p.parse_args(argv)
+    # parse_known_args: `tell X injectargs --debug_osd 9` carries
+    # dashed tokens that are arguments to injectargs, not to ceph
+    a, extra = p.parse_known_args(argv)
+    a.args = list(a.args) + list(extra)
 
     from ..cluster import MiniCluster
     c = MiniCluster.restore(a.cluster)
@@ -230,6 +233,69 @@ def main(argv=None) -> int:
             return 1
         for stamp, who, level, text in c.mon.log_last(n):
             print(f"{stamp:.1f} {who} {level}: {text}")
+    elif v in ("tell", "daemon"):
+        # `ceph tell <who> injectargs --opt val ...` and
+        # `ceph daemon <who> <asok command> [k=v ...]` — runtime
+        # reconfiguration/introspection over the admin socket.  Like
+        # the reference, injectargs is NOT durable: it mutates the
+        # running process only (checkpoints don't carry it).
+        if len(rest) < 2:
+            print(f"usage: ceph {v} <who> <command> [args...]",
+                  file=sys.stderr)
+            return 1
+        who, cmd, cargs = rest[0], rest[1], rest[2:]
+        if cmd == "injectargs":
+            if len(cargs) == 1 and " " in cargs[0]:
+                # the reference's quoted form:
+                # ceph tell osd.0 injectargs '--debug-osd 20'
+                cargs = cargs[0].split()
+            changed = {}
+            i = 0
+            while i < len(cargs):
+                tok = cargs[i]
+                if not tok.startswith("--"):
+                    print(f"injectargs: expected --option, got "
+                          f"'{tok}'", file=sys.stderr)
+                    return 1
+                name, eq, val = tok[2:].partition("=")
+                name = name.replace("-", "_")
+                if not eq:
+                    if i + 1 >= len(cargs):
+                        print(f"injectargs: missing value for "
+                              f"--{name}", file=sys.stderr)
+                        return 1
+                    i += 1
+                    val = cargs[i]
+                # ONE set path: the asok 'config set' hook owns
+                # validation + observer notification
+                try:
+                    out = c.admin_socket.execute(
+                        "config set", {"name": name, "value": val})
+                except ValueError as e:
+                    print(f"injectargs: {e}", file=sys.stderr)
+                    return 1
+                changed[name] = out[name]
+                i += 1
+            print(json.dumps(changed, sort_keys=True))
+        else:
+            # multi-word asok commands may arrive as separate shell
+            # words (`daemon mon.a config show`): everything up to the
+            # first k=v token is the command
+            words = [cmd]
+            kv = {}
+            for t in cargs:
+                if "=" in t:
+                    k, _, vv = t.partition("=")
+                    kv[k] = vv
+                else:
+                    words.append(t)
+            try:
+                out = c.admin_socket.execute(" ".join(words), kv)
+            except (KeyError, ValueError) as e:
+                print(f"admin socket: {e}", file=sys.stderr)
+                return 1
+            print(json.dumps(out, indent=2, sort_keys=True,
+                             default=repr))
     elif v == "config-key":
         sub = rest[0] if rest else "dump"
         if sub == "dump":

@@ -1,0 +1,89 @@
+"""Runtime reconfiguration: `ceph tell ... injectargs`, `ceph daemon
+<who> <asok cmd>`, and the admin socket's config set/get — the
+md_config_t::set_val + observer-notification flow (`ceph daemon X
+config set` role)."""
+import json
+
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.tools.ceph_cli import main
+
+
+@pytest.fixture()
+def env(tmp_path):
+    c = MiniCluster(n_osds=3)
+    c.create_replicated_pool("p", pg_num=8)
+    d = str(tmp_path / "ck")
+    c.checkpoint(d)
+    saved = dict(g_conf.values)
+    saved_obs = {k: list(v) for k, v in g_conf.observers.items()}
+    yield c, d
+    g_conf.values = saved              # module-global: restore
+    g_conf.observers = saved_obs       # incl. any observers we added
+
+
+def test_asok_config_set_get_and_observer(env):
+    c, d = env
+    fired = []
+    g_conf.add_observer("osd_heartbeat_grace",
+                        lambda n, v: fired.append((n, v)))
+    out = c.admin_socket.execute("config set",
+                                 {"name": "osd_heartbeat_grace",
+                                  "value": "42.5"})
+    assert out["success"] and out["osd_heartbeat_grace"] == 42.5
+    assert fired == [("osd_heartbeat_grace", 42.5)]
+    got = c.admin_socket.execute("config get",
+                                 {"name": "osd_heartbeat_grace"})
+    assert got["osd_heartbeat_grace"] == 42.5
+    with pytest.raises(ValueError):
+        c.admin_socket.execute("config set", {"name": "nope",
+                                              "value": "1"})
+
+
+def test_cli_tell_injectargs(env, capsys):
+    _, d = env
+    rc = main(["--cluster", d, "tell", "osd.0", "injectargs",
+               "--osd-heartbeat-grace", "33", "--debug_osd=9/9"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["osd_heartbeat_grace"] == 33.0
+    assert doc["debug_osd"] == "9/9"
+
+    # the reference's single-quoted-string form
+    rc = main(["--cluster", d, "tell", "osd.0", "injectargs",
+               "--osd-heartbeat-grace 21"])
+    assert rc == 0
+    assert json.loads(
+        capsys.readouterr().out)["osd_heartbeat_grace"] == 21.0
+
+    # error contracts: unknown option, missing value, bad token
+    assert main(["--cluster", d, "tell", "osd.0", "injectargs",
+                 "--no-such-option", "1"]) == 1
+    assert main(["--cluster", d, "tell", "osd.0", "injectargs",
+                 "--osd-heartbeat-grace"]) == 1
+    assert main(["--cluster", d, "tell", "osd.0", "injectargs",
+                 "oops"]) == 1
+    assert main(["--cluster", d, "tell", "osd.0"]) == 1
+
+
+def test_cli_daemon_asok_commands(env, capsys):
+    _, d = env
+    # both shell forms: quoted single token and separate words
+    for form in (["config show"], ["config", "show"]):
+        rc = main(["--cluster", d, "daemon", "mon.a", *form])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert "osd_heartbeat_grace" in doc
+    rc = main(["--cluster", d, "daemon", "osd.0",
+               "config", "get", "name=osd_heartbeat_grace"])
+    assert rc == 0
+    assert main(["--cluster", d, "daemon", "osd.0",
+                 "no-such-cmd"]) == 1
+    # bad value surfaces as an error, not a traceback
+    assert main(["--cluster", d, "tell", "osd.0", "injectargs",
+                 "--osd-heartbeat-grace", "notanum"]) == 1
+    # unknown option via config get is an explicit refusal
+    assert main(["--cluster", d, "daemon", "osd.0",
+                 "config", "get", "name=nope"]) == 1
