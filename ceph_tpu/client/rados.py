@@ -198,8 +198,10 @@ class RadosClient(Dispatcher):
 
     # ---- dispatch ---------------------------------------------------------
     def ms_fast_dispatch(self, msg: Message) -> None:
-        from ..msg.messages import MMonCommandAck, MWatchNotify
-        if isinstance(msg, MMonCommandAck):
+        from ..msg.messages import MCommandReply, MMonCommandAck, \
+            MWatchNotify
+        if isinstance(msg, (MMonCommandAck, MCommandReply)):
+            # _mon_acks doubles as the reply slot for daemon commands
             self._mon_acks[msg.tid] = msg
             return
         if isinstance(msg, MOSDMap):
@@ -411,6 +413,26 @@ class RadosClient(Dispatcher):
                                                   f"mon {ack.result}"))
                 return ack.data.get("value")
         raise _ioerror("mon_command", cmd, -110)
+
+    def osd_command(self, osd_id: int, cmd: str, **args):
+        """Run a command on a LIVE osd daemon over the wire
+        ('ceph tell osd.N', MCommand.h): injectargs / config show /
+        config get / perf dump / dump_ops_in_flight."""
+        from ..msg.messages import MCommand
+        self._tid += 1
+        tid = self._tid
+        target = f"osd.{osd_id}"
+        for _attempt in range(MAX_ATTEMPTS):
+            self.messenger.send_message(
+                MCommand(tid=tid, cmd=cmd, args=dict(args)), target)
+            self.network.pump()
+            rep = self._mon_acks.pop(tid, None)
+            if rep is not None:
+                if rep.result < 0:
+                    raise ValueError(rep.data.get(
+                        "error", f"osd {rep.result}"))
+                return rep.data
+        raise _ioerror("osd_command", cmd, -110)
 
     # ---- pool snapshots (rados_ioctx_snap_*) -------------------------------
     def _resolve_snapid(self, pool: str, snap) -> int:

@@ -137,29 +137,19 @@ class MiniCluster:
         def _config_set(c, a):
             # runtime reconfiguration with observer notification — the
             # `ceph daemon X config set` / `ceph tell ... injectargs`
-            # role (md_config_t::set_val + apply_changes)
-            name = a.get("name", "")
-            if name not in g_conf.schema:
-                raise ValueError(f"unrecognized config option "
-                                 f"'{name}'")
-            try:
-                g_conf.set_val(name, a.get("value", ""))
-            except (TypeError, ValueError):
-                raise ValueError(
-                    f"invalid value '{a.get('value', '')}' for "
-                    f"option '{name}'")
-            return {name: g_conf.get_val(name), "success": True}
-
-        def _config_get(c, a):
-            name = a.get("name", "")
-            if name not in g_conf.schema:
-                raise ValueError(f"unrecognized config option "
-                                 f"'{name}'")
-            return {name: g_conf.get_val(name)}
+            # role (md_config_t::set_val + apply_changes); validation
+            # lives in ConfigProxy.set_checked, shared with the OSD's
+            # wire MCommand handler
+            out = g_conf.set_checked(a.get("name", ""),
+                                     a.get("value", ""))
+            out["success"] = True
+            return out
 
         asok.register("config set", _config_set,
                       "set a config option at runtime")
-        asok.register("config get", _config_get,
+        asok.register("config get",
+                      lambda c, a: g_conf.get_checked(
+                          a.get("name", "")),
                       "get one config value")
         asok.register("status",
                       lambda c, a: {"health": self.health(),

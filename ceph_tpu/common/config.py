@@ -175,6 +175,25 @@ class ConfigProxy:
     def rm_val(self, name: str) -> None:
         self.values.pop(name, None)
 
+    # the validated set/get path shared by every admin surface (asok
+    # 'config set', 'ceph tell ... injectargs', MCommand handlers):
+    # one place owns the schema check, cast-error wording, and
+    # observer notification
+    def set_checked(self, name: str, value) -> Dict[str, Any]:
+        if name not in self.schema:
+            raise ValueError(f"unrecognized config option '{name}'")
+        try:
+            self.set_val(name, value)
+        except (TypeError, ValueError):
+            raise ValueError(f"invalid value '{value}' for option "
+                             f"'{name}'")
+        return {name: self.get_val(name)}
+
+    def get_checked(self, name: str) -> Dict[str, Any]:
+        if name not in self.schema:
+            raise ValueError(f"unrecognized config option '{name}'")
+        return {name: self.get_val(name)}
+
     def add_observer(self, name: str,
                      cb: Callable[[str, Any], None]) -> None:
         self.observers.setdefault(name, []).append(cb)

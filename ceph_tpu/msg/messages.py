@@ -529,3 +529,21 @@ class MMDSBeacon(Message):
     name: str = ""
     state: str = "standby"      # what the daemon believes it is
     seq: int = 0
+
+
+@dataclass
+class MCommand(Message):
+    """Client -> any daemon administrative command
+    (src/messages/MCommand.h; the 'ceph tell osd.N' path): runtime
+    introspection/reconfiguration of a LIVE daemon over the wire."""
+    tid: int = 0
+    cmd: str = ""
+    args: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class MCommandReply(Message):
+    """Daemon -> client command completion (MCommandReply.h)."""
+    tid: int = 0
+    result: int = 0
+    data: Dict[str, Any] = field(default_factory=dict)

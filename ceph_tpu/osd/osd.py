@@ -221,15 +221,57 @@ class OSD(Dispatcher):
         elif isinstance(msg, MOSDPing):
             self._handle_ping(msg)
         else:
-            from ..msg.messages import MWatchNotify
+            from ..msg.messages import MCommand, MWatchNotify
             if isinstance(msg, MWatchNotify) and \
                     msg.op == MWatchNotify.ACK:
                 pg = self.pgs.get(msg.pgid)
                 if pg is not None:
                     pg.handle_notify_ack(msg)
+            elif isinstance(msg, MCommand):
+                self._handle_command(msg)
 
     def reply_to(self, msg: Message, reply: Message) -> None:
         self.messenger.send_message(reply, msg.src)
+
+    # ---- daemon commands ('ceph tell osd.N', MCommand.h) ------------------
+    def _handle_command(self, msg) -> None:
+        """Runtime introspection/reconfiguration of THIS live daemon
+        over the wire: injectargs (config mutation with observer
+        notification), config show/get, perf dump."""
+        from ..common.config import g_conf
+        from ..msg.messages import MCommandReply
+        result, data = 0, {}
+        try:
+            if msg.cmd == "injectargs":
+                opts = dict(msg.args.get("opts", {}))
+                # validate EVERY name AND value before mutating
+                # anything: an error reply must mean nothing changed
+                for name, val in opts.items():
+                    if name not in g_conf.schema:
+                        raise ValueError(
+                            f"unrecognized config option '{name}'")
+                    try:
+                        g_conf.schema[name].cast(val)
+                    except (TypeError, ValueError):
+                        raise ValueError(f"invalid value '{val}' for "
+                                         f"option '{name}'")
+                for name, val in opts.items():
+                    data.update(g_conf.set_checked(name, val))
+            elif msg.cmd == "config show":
+                data = g_conf.show_config()
+            elif msg.cmd == "config get":
+                data = g_conf.get_checked(msg.args.get("name", ""))
+            elif msg.cmd == "perf dump":
+                data = self.perf_counters.dump()
+            elif msg.cmd == "dump_ops_in_flight":
+                data = self.op_tracker.dump_ops_in_flight()
+            else:
+                result, data = -22, {"error":
+                                     f"unknown command '{msg.cmd}'"}
+        except (TypeError, ValueError) as e:
+            result, data = -22, {"error": str(e)}
+        self.reply_to(msg, MCommandReply(tid=msg.tid, result=result,
+                                         data=data))
 
     # ---- map handling (OSD::handle_osd_map) --------------------------------
     def _handle_osd_map(self, msg: MOSDMap) -> None:

@@ -68,6 +68,28 @@ def test_cli_tell_injectargs(env, capsys):
     assert main(["--cluster", d, "tell", "osd.0"]) == 1
 
 
+def test_osd_command_wire(env):
+    """'ceph tell osd.N' over the message fabric: MCommand to a LIVE
+    daemon, MCommandReply back (config mutation fires observers in
+    the daemon's process; here in-process, over TCP in
+    test_vstart_process.py)."""
+    c, _ = env
+    cl = c.client("client.t")
+    out = cl.osd_command(0, "config get", name="osd_heartbeat_grace")
+    assert out["osd_heartbeat_grace"] == 20.0
+    out = cl.osd_command(0, "injectargs",
+                         opts={"osd_heartbeat_grace": "31"})
+    assert out["osd_heartbeat_grace"] == 31.0
+    out = cl.osd_command(0, "perf dump")
+    assert isinstance(out, dict) and out
+    out = cl.osd_command(0, "dump_ops_in_flight")
+    assert "ops" in out
+    with pytest.raises(ValueError):
+        cl.osd_command(0, "no-such-command")
+    with pytest.raises(ValueError):
+        cl.osd_command(0, "injectargs", opts={"nope": "1"})
+
+
 def test_cli_daemon_asok_commands(env, capsys):
     _, d = env
     # both shell forms: quoted single token and separate words

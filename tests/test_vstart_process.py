@@ -49,6 +49,36 @@ def _wait_down(c, cl, osd_id, timeout=45.0):
     return False
 
 
+def test_tell_osd_over_sockets(cluster):
+    """'ceph tell osd.N' against a REAL daemon process: MCommand and
+    its reply cross TCP; injectargs mutates the remote daemon's
+    config registry and a follow-up config get reads it back."""
+    c = cluster
+    cl = c.client()
+    c.wait_healthy(cl)
+    out = None
+    for _ in range(30):
+        try:
+            out = cl.osd_command(0, "config get",
+                                 name="osd_heartbeat_grace")
+            break
+        except IOError:
+            time.sleep(0.5)
+    assert out is not None
+    out = cl.osd_command(0, "injectargs",
+                         opts={"osd_heartbeat_grace": "44"})
+    assert out["osd_heartbeat_grace"] == 44.0
+    got = cl.osd_command(0, "config get",
+                         name="osd_heartbeat_grace")
+    assert got["osd_heartbeat_grace"] == 44.0
+    # other daemons are untouched: per-process registries
+    other = cl.osd_command(1, "config get",
+                           name="osd_heartbeat_grace")
+    assert other["osd_heartbeat_grace"] != 44.0
+    perf = cl.osd_command(0, "perf dump")
+    assert isinstance(perf, dict) and perf
+
+
 def test_process_cluster_write_kill_recover(cluster):
     c = cluster
     cl = c.client()
