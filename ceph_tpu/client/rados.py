@@ -414,14 +414,10 @@ class RadosClient(Dispatcher):
                 return ack.data.get("value")
         raise _ioerror("mon_command", cmd, -110)
 
-    def osd_command(self, osd_id: int, cmd: str, **args):
-        """Run a command on a LIVE osd daemon over the wire
-        ('ceph tell osd.N', MCommand.h): injectargs / config show /
-        config get / perf dump / dump_ops_in_flight."""
+    def _daemon_command(self, target: str, cmd: str, args: dict):
         from ..msg.messages import MCommand
         self._tid += 1
         tid = self._tid
-        target = f"osd.{osd_id}"
         for _attempt in range(MAX_ATTEMPTS):
             self.messenger.send_message(
                 MCommand(tid=tid, cmd=cmd, args=dict(args)), target)
@@ -430,9 +426,21 @@ class RadosClient(Dispatcher):
             if rep is not None:
                 if rep.result < 0:
                     raise ValueError(rep.data.get(
-                        "error", f"osd {rep.result}"))
+                        "error", f"{target} {rep.result}"))
                 return rep.data
-        raise _ioerror("osd_command", cmd, -110)
+        raise _ioerror("daemon_command", cmd, -110)
+
+    def osd_command(self, osd_id: int, cmd: str, **args):
+        """Run a command on a LIVE osd daemon over the wire
+        ('ceph tell osd.N', MCommand.h): injectargs / config show /
+        config get / perf dump / dump_ops_in_flight."""
+        return self._daemon_command(f"osd.{osd_id}", cmd, args)
+
+    def mds_command(self, mds_name: str, cmd: str, **args):
+        """'ceph tell mds.<name>': the same wire command pair against
+        a live metadata server (injectargs / config show / config get
+        / session ls / status)."""
+        return self._daemon_command(mds_name, cmd, args)
 
     # ---- pool snapshots (rados_ioctx_snap_*) -------------------------------
     def _resolve_snapid(self, pool: str, snap) -> int:

@@ -194,6 +194,36 @@ class ConfigProxy:
             raise ValueError(f"unrecognized config option '{name}'")
         return {name: self.get_val(name)}
 
+    def handle_config_command(self, cmd: str,
+                              args: Dict[str, Any]
+                              ) -> Optional[Dict[str, Any]]:
+        """The config subset of the daemon-command vocabulary
+        ('ceph tell <daemon> ...'), shared by every MCommand handler:
+        returns the reply data, or None when *cmd* is not a config
+        command (the daemon adds its own).  injectargs validates
+        EVERY name and value before applying anything — an error must
+        mean nothing changed."""
+        if cmd == "injectargs":
+            opts = dict(args.get("opts", {}))
+            for name, val in opts.items():
+                if name not in self.schema:
+                    raise ValueError(
+                        f"unrecognized config option '{name}'")
+                try:
+                    self.schema[name].cast(val)
+                except (TypeError, ValueError):
+                    raise ValueError(f"invalid value '{val}' for "
+                                     f"option '{name}'")
+            out: Dict[str, Any] = {}
+            for name, val in opts.items():
+                out.update(self.set_checked(name, val))
+            return out
+        if cmd == "config show":
+            return self.show_config()
+        if cmd == "config get":
+            return self.get_checked(args.get("name", ""))
+        return None
+
     def add_observer(self, name: str,
                      cb: Callable[[str, Any], None]) -> None:
         self.observers.setdefault(name, []).append(cb)

@@ -43,7 +43,7 @@ from ..cephfs.cls_fs import ROOT_INO, dir_oid, file_oid
 from ..client.rados import RadosClient
 from ..msg.messages import (
     CEPH_CAP_FILE_BUFFER, CEPH_CAP_FILE_CACHE, MClientCaps,
-    MClientReply, MClientRequest, Message,
+    MClientReply, MClientRequest, MCommand, MCommandReply, Message,
 )
 
 MDLOG_ID = "mdlog"
@@ -233,7 +233,12 @@ class MDSDaemon:
 
     # ---- dispatch ----------------------------------------------------------
     def ms_fast_dispatch(self, msg: Message) -> None:
-        if isinstance(msg, (MClientRequest, MClientCaps)):
+        if isinstance(msg, MCommand):
+            # SYNCHRONOUS, unlike client traffic: the command handler
+            # does no rados IO, so it is safe inside the pump — and a
+            # blocked 'ceph tell' client could never drive process()
+            self._handle_command(msg)
+        elif isinstance(msg, (MClientRequest, MClientCaps)):
             self._inbox.append(msg)
         elif self._fallthrough is not None:
             self._fallthrough.ms_fast_dispatch(msg)
@@ -254,6 +259,34 @@ class MDSDaemon:
             else:
                 self._handle_caps(msg)
         return n
+
+    def _handle_command(self, msg) -> None:
+        """'ceph tell mds.<name>' (MCommand.h): runtime config and
+        introspection on a live metadata server.  The config
+        vocabulary (incl. atomic injectargs) is
+        ConfigProxy.handle_config_command, shared with the OSD."""
+        from ..common.config import g_conf
+        result, data = 0, {}
+        try:
+            handled = g_conf.handle_config_command(msg.cmd, msg.args)
+            if handled is not None:
+                data = handled
+            elif msg.cmd == "session ls":
+                clients = sorted({c for holders in self.caps.values()
+                                  for c in holders})
+                data = {"sessions": clients}
+            elif msg.cmd == "status":
+                data = {"name": self.name, "rank": self.rank,
+                        "mds_map": {str(r): n for r, n
+                                    in self.mds_map.items()}}
+            else:
+                result, data = -22, {"error":
+                                     f"unknown command '{msg.cmd}'"}
+        except (TypeError, ValueError) as e:
+            result, data = -22, {"error": str(e)}
+        self.messenger.send_message(
+            MCommandReply(tid=msg.tid, result=result, data=data),
+            msg.src)
 
     # ---- subtree authority (multi-active ranks) ----------------------------
     def set_mds_map(self, ranks: Dict[int, str]) -> None:

@@ -242,25 +242,9 @@ class OSD(Dispatcher):
         from ..msg.messages import MCommandReply
         result, data = 0, {}
         try:
-            if msg.cmd == "injectargs":
-                opts = dict(msg.args.get("opts", {}))
-                # validate EVERY name AND value before mutating
-                # anything: an error reply must mean nothing changed
-                for name, val in opts.items():
-                    if name not in g_conf.schema:
-                        raise ValueError(
-                            f"unrecognized config option '{name}'")
-                    try:
-                        g_conf.schema[name].cast(val)
-                    except (TypeError, ValueError):
-                        raise ValueError(f"invalid value '{val}' for "
-                                         f"option '{name}'")
-                for name, val in opts.items():
-                    data.update(g_conf.set_checked(name, val))
-            elif msg.cmd == "config show":
-                data = g_conf.show_config()
-            elif msg.cmd == "config get":
-                data = g_conf.get_checked(msg.args.get("name", ""))
+            handled = g_conf.handle_config_command(msg.cmd, msg.args)
+            if handled is not None:
+                data = handled
             elif msg.cmd == "perf dump":
                 data = self.perf_counters.dump()
             elif msg.cmd == "dump_ops_in_flight":

@@ -181,3 +181,42 @@ def test_failover_retry_dedup(world):
     f2._drive = lambda: mds2.process()
     out3 = f2._request("mkdir", path="/dup", _reqid="client.a#7")
     assert out3.get("replayed") and out3["ino"] == out1["ino"]
+
+
+def test_tell_mds_commands(world):
+    """'ceph tell mds.<name>' through the PUBLIC mds_command client
+    API: status, session ls, config get, and an atomic injectargs
+    against a live metadata server (MCommand executes synchronously
+    in dispatch, so a blocked teller needs no one driving
+    process())."""
+    from ceph_tpu.common.config import g_conf
+
+    c, mds, fa, fb = world
+    fa.create("/tellfile")
+    fh = fa.open("/tellfile", "w")  # holds caps -> a live session
+    cl = c.client("client.teller")
+
+    st = cl.mds_command(mds.name, "status")
+    assert st["name"] == mds.name and st["rank"] == 0
+    sessions = cl.mds_command(mds.name, "session ls")["sessions"]
+    assert "client.a" in sessions
+    fh.close()
+    before = g_conf.get_val("osd_heartbeat_grace")
+    try:
+        out = cl.mds_command(mds.name, "injectargs",
+                             opts={"osd_heartbeat_grace": "27"})
+        assert out["osd_heartbeat_grace"] == 27.0
+        assert cl.mds_command(mds.name, "config get",
+                              name="osd_heartbeat_grace")[
+            "osd_heartbeat_grace"] == 27.0
+        # atomic: one bad name means nothing applies
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            cl.mds_command(mds.name, "injectargs",
+                           opts={"osd_heartbeat_grace": "99",
+                                 "nope": "1"})
+        assert g_conf.get_val("osd_heartbeat_grace") == 27.0
+        with _pytest.raises(ValueError):
+            cl.mds_command(mds.name, "no-such-command")
+    finally:
+        g_conf.set_val("osd_heartbeat_grace", before)
