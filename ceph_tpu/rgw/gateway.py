@@ -82,7 +82,7 @@ class RGWLite:
                 "access_key": secrets.token_hex(10),
                 "secret_key": secrets.token_hex(20),
                 "buckets": []}
-        self.client.write_full(self.mpool, f"user.{uid}", _j(user))
+        self._save_user(user)
         self._meta_index(f"user.{uid}", True)
         return user
 
@@ -104,6 +104,183 @@ class RGWLite:
     def list_users(self) -> List[str]:
         return [oid[len("user."):] for oid in self._meta_list("user.")]
 
+    def _save_user(self, user: Dict) -> None:
+        self.client.write_full(self.mpool, f"user.{user['uid']}",
+                               _j(user))
+
+    def _save_bucket(self, bucket: Dict) -> None:
+        self.client.write_full(self.mpool,
+                               f"bucket.{bucket['name']}", _j(bucket))
+
+    def modify_user(self, uid: str, display_name: Optional[str] = None,
+                    suspended: Optional[bool] = None,
+                    max_buckets: Optional[int] = None) -> Dict:
+        """radosgw-admin user modify / suspend / enable: a suspended
+        user's requests are refused at the frontends (the reference's
+        RGW_USER_SUSPENDED check)."""
+        u = self.get_user(uid)
+        if display_name is not None:
+            u["display_name"] = display_name
+        if suspended is not None:
+            u["suspended"] = bool(suspended)
+        if max_buckets is not None:
+            u["max_buckets"] = int(max_buckets)
+        self._save_user(u)
+        return u
+
+    def user_add_key(self, uid: str) -> Dict:
+        """radosgw-admin key create: an ADDITIONAL key pair; every
+        key authenticates the user (RGWUserInfo access_keys map)."""
+        u = self.get_user(uid)
+        key = {"access_key": secrets.token_hex(10),
+               "secret_key": secrets.token_hex(20)}
+        u.setdefault("keys", []).append(key)
+        self._save_user(u)
+        return key
+
+    def user_rm_key(self, uid: str, access_key: str) -> None:
+        """radosgw-admin key rm (the primary key is not removable
+        here — the reference also refuses removing the last key)."""
+        u = self.get_user(uid)
+        keys = u.get("keys", [])
+        kept = [k for k in keys if k["access_key"] != access_key]
+        if len(kept) == len(keys):
+            raise RGWError("user_rm_key", -2, "no such key")
+        u["keys"] = kept
+        self._save_user(u)
+
+    def user_caps(self, uid: str, add: Optional[str] = None,
+                  rm: Optional[str] = None) -> Dict[str, str]:
+        """radosgw-admin caps add/rm: admin capability strings like
+        'users=read,write' (RGWUserCaps grammar)."""
+        u = self.get_user(uid)
+        caps = dict(u.get("caps", {}))
+        for spec, is_add in ((add, True), (rm, False)):
+            if not spec:
+                continue
+            for part in spec.split(";"):
+                kind, _, perms = part.strip().partition("=")
+                if not kind:
+                    continue
+                if is_add:
+                    caps[kind] = perms or "read"
+                elif not perms:
+                    caps.pop(kind, None)       # rm the whole kind
+                else:
+                    # subtract only the listed perms
+                    # (RGWUserCaps::remove)
+                    have = [p for p in caps.get(kind, "").split(",")
+                            if p]
+                    left = [p for p in have
+                            if p not in perms.split(",")]
+                    if left:
+                        caps[kind] = ",".join(left)
+                    else:
+                        caps.pop(kind, None)
+        u["caps"] = caps
+        self._save_user(u)
+        return caps
+
+    def set_user_quota(self, uid: str,
+                       max_size: Optional[int] = None,
+                       max_objects: Optional[int] = None,
+                       enabled: Optional[bool] = None) -> Dict:
+        """radosgw-admin quota set/enable/disable --quota-scope=user:
+        checked on every put against the user's aggregate usage."""
+        u = self.get_user(uid)
+        q = dict(u.get("quota", {}))
+        if max_size is not None:
+            q["max_size"] = int(max_size)
+        if max_objects is not None:
+            q["max_objects"] = int(max_objects)
+        if enabled is not None:
+            q["enabled"] = bool(enabled)
+        u["quota"] = q
+        self._save_user(u)
+        return q
+
+    def user_stats(self, uid: str) -> Dict:
+        """radosgw-admin user stats: aggregate usage across every
+        owned bucket (the quota subsystem's accounting)."""
+        u = self.get_user(uid)
+        size = objects = 0
+        for b in u.get("buckets", []):
+            try:
+                st = self.bucket_stats(b)
+            except RGWError:
+                continue
+            size += int(st.get("size_bytes", 0))
+            objects += int(st.get("num_objects", 0))
+        return {"uid": uid, "size": size, "num_objects": objects}
+
+    def _check_user_quota(self, uid: Optional[str],
+                          incoming: int) -> None:
+        if not uid:
+            return
+        try:
+            u = self.get_user(uid)
+        except RGWError:
+            return
+        q = u.get("quota", {})
+        if not q.get("enabled"):
+            return
+        # aggregate walk with early exit (the reference amortizes this
+        # with RGWQuotaCache; at lite scale the walk stops as soon as
+        # either limit is provably exceeded)
+        max_size = q.get("max_size", 0)
+        max_objects = q.get("max_objects", 0)
+        size, objects = incoming, 1
+        for b in u.get("buckets", []):
+            try:
+                st = self.bucket_stats(b)
+            except RGWError:
+                continue
+            size += int(st.get("size_bytes", 0))
+            objects += int(st.get("num_objects", 0))
+            if (max_size > 0 and size > max_size) or \
+                    (max_objects > 0 and objects > max_objects):
+                raise RGWError("put_object", -122, "QuotaExceeded")
+        if (max_size > 0 and size > max_size) or \
+                (max_objects > 0 and objects > max_objects):
+            raise RGWError("put_object", -122, "QuotaExceeded")
+
+    def link_bucket(self, bucket: str, uid: str) -> None:
+        """radosgw-admin bucket link: move ownership to *uid*."""
+        b = self.get_bucket(bucket)
+        new_owner = self.get_user(uid)
+        old = b.get("owner")
+        if old == uid:
+            return
+        mb = int(new_owner.get("max_buckets", 0) or 0)
+        if mb > 0 and len(new_owner.get("buckets", [])) >= mb:
+            raise RGWError("link_bucket", -24, "TooManyBuckets")
+        if old:
+            try:
+                ou = self.get_user(old)
+                ou["buckets"] = [x for x in ou["buckets"]
+                                 if x != bucket]
+                self._save_user(ou)
+            except RGWError:
+                pass
+        b["owner"] = uid
+        self._save_bucket(b)
+        if bucket not in new_owner["buckets"]:
+            new_owner["buckets"].append(bucket)
+            self._save_user(new_owner)
+
+    def unlink_bucket(self, bucket: str, uid: str) -> None:
+        """radosgw-admin bucket unlink: detach from the user (the
+        bucket keeps existing, ownerless)."""
+        b = self.get_bucket(bucket)
+        if b.get("owner") != uid:
+            raise RGWError("unlink_bucket", -22,
+                           "bucket not linked to that user")
+        u = self.get_user(uid)
+        u["buckets"] = [x for x in u["buckets"] if x != bucket]
+        self._save_user(u)
+        b["owner"] = ""
+        self._save_bucket(b)
+
     def bucket_stats(self, bucket: str) -> Dict:
         """Bucket entry + index stats (radosgw-admin bucket stats)."""
         b = self.get_bucket(bucket)
@@ -115,9 +292,22 @@ class RGWLite:
         # lite linear scan (the reference keeps a key->uid index object)
         for oid in self._meta_list("user."):
             u = self._meta_get(oid)
-            if u and u["access_key"] == access_key:
+            if u is None:
+                continue
+            if u["access_key"] == access_key or any(
+                    k["access_key"] == access_key
+                    for k in u.get("keys", [])):
                 return u
         return None
+
+    def secret_for_key(self, user: Dict, access_key: str) -> str:
+        """The secret matching *access_key* (primary or additional)."""
+        if user["access_key"] == access_key:
+            return user["secret_key"]
+        for k in user.get("keys", []):
+            if k["access_key"] == access_key:
+                return k["secret_key"]
+        raise RGWError("secret_for_key", -2, "no such key")
 
     def _meta_list(self, prefix: str) -> List[str]:
         try:
@@ -142,16 +332,19 @@ class RGWLite:
 
     def create_bucket(self, uid: str, name: str) -> Dict:
         user = self.get_user(uid)
+        mb = int(user.get("max_buckets", 0) or 0)
+        if mb > 0 and len(user.get("buckets", [])) >= mb:
+            raise RGWError("create_bucket", -24, "TooManyBuckets")
         if self._meta_get(f"bucket.{name}") is not None:
             raise RGWError("create_bucket", -17, "BucketAlreadyExists")
         bid = secrets.token_hex(8)
         bucket = {"name": name, "id": bid, "owner": uid,
                   "created": time.time()}
-        self.client.write_full(self.mpool, f"bucket.{name}", _j(bucket))
+        self._save_bucket(bucket)
         self.client.create(self.mpool, self._index_oid(bid),
                            exclusive=False)
         user["buckets"] = sorted(set(user["buckets"]) | {name})
-        self.client.write_full(self.mpool, f"user.{uid}", _j(user))
+        self._save_user(user)
         return bucket
 
     def get_bucket(self, name: str) -> Dict:
@@ -177,8 +370,7 @@ class RGWLite:
         owner = self._meta_get(f"user.{b['owner']}")
         if owner:
             owner["buckets"] = [x for x in owner["buckets"] if x != name]
-            self.client.write_full(self.mpool, f"user.{b['owner']}",
-                                   _j(owner))
+            self._save_user(owner)
 
     def list_buckets(self, uid: str) -> List[str]:
         return list(self.get_user(uid)["buckets"])
@@ -215,6 +407,8 @@ class RGWLite:
         RGWRados versioned object ops."""
         b = self.get_bucket(bucket)
         self._check_bucket_access(b, actor, "WRITE")
+        # storage quota charges the bucket OWNER (RGWQuotaHandler)
+        self._check_user_quota(b.get("owner"), len(data))
         vstate = b.get("versioning")
         idx = self._index_oid(b["id"])
         cur = None
@@ -350,7 +544,7 @@ class RGWLite:
         b = self.get_bucket(bucket)
         self._check_bucket_access(b, actor, "WRITE_ACP")
         b["versioning"] = status
-        self.client.write_full(self.mpool, f"bucket.{bucket}", _j(b))
+        self._save_bucket(b)
 
     def get_bucket_versioning(self, bucket: str,
                               actor: Optional[str] = None
@@ -646,6 +840,10 @@ class RGWLite:
                     actor: Optional[str] = None) -> str:
         b = self.get_bucket(bucket)
         self._check_bucket_access(b, actor, "WRITE")
+        # staged parts count against the owner's quota too — without
+        # this a quota-limited user could park unbounded data in
+        # _multipart_ staging
+        self._check_user_quota(b.get("owner"), len(data))
         moid = self._mp_meta_oid(b["id"], name, upload_id)
         mp = self._meta_get(moid)
         if mp is None:
@@ -784,7 +982,7 @@ class RGWLite:
         b = self.get_bucket(bucket)
         self._check_bucket_access(b, actor, "WRITE_ACP")
         b["acl"] = {"grants": self._resolve_grants(canned, grants)}
-        self.client.write_full(self.mpool, f"bucket.{bucket}", _j(b))
+        self._save_bucket(b)
 
     def get_bucket_acl(self, bucket: str,
                        actor: Optional[str] = None) -> Dict:
@@ -829,7 +1027,7 @@ class RGWLite:
                     or r.get("noncurrent_days")):
                 raise RGWError("lifecycle", -22, "MissingAction")
         b["lifecycle"] = list(rules)
-        self.client.write_full(self.mpool, f"bucket.{bucket}", _j(b))
+        self._save_bucket(b)
 
     def get_bucket_lifecycle(self, bucket: str,
                              actor: Optional[str] = None
@@ -843,7 +1041,7 @@ class RGWLite:
         b = self.get_bucket(bucket)
         self._check_bucket_access(b, actor, "WRITE_ACP")
         b.pop("lifecycle", None)
-        self.client.write_full(self.mpool, f"bucket.{bucket}", _j(b))
+        self._save_bucket(b)
 
     def lc_process(self, now: Optional[float] = None) -> Dict:
         """One lifecycle pass over every bucket (radosgw-admin lc

@@ -241,8 +241,8 @@ class S3Frontend:
         user = self.rgw.user_by_access_key(access_key)
         if user is None:
             return None
-        want = sign_v2(user["secret_key"], method, path, headers,
-                       query)
+        secret = self.rgw.secret_for_key(user, access_key)
+        want = sign_v2(secret, method, path, headers, query)
         return user if hmac.compare_digest(want, sig) else None
 
     def _authenticate_v4(self, method: str, path: str,
@@ -267,6 +267,7 @@ class S3Frontend:
         user = self.rgw.user_by_access_key(access_key)
         if user is None:
             return None
+        secret = self.rgw.secret_for_key(user, access_key)
         h = {k.lower(): v for k, v in headers.items()}
         amz_date = h.get("x-amz-date", "")
         if not amz_date.startswith(bits[1]):
@@ -283,7 +284,7 @@ class S3Frontend:
                 return None            # body does not match its hash
         creq = v4_canonical_request(method, path, query, headers,
                                     signed, payload_hash)
-        want = v4_signature(user["secret_key"], amz_date, scope, creq)
+        want = v4_signature(secret, amz_date, scope, creq)
         return user if hmac.compare_digest(want, sig) else None
 
     # ---- request router ----------------------------------------------------
@@ -298,6 +299,9 @@ class S3Frontend:
                                   query, body)
         if user is None:
             return _err(403, "AccessDenied", "bad or missing signature")
+        if user.get("suspended"):
+            # the reference's RGW_USER_SUSPENDED refusal
+            return _err(403, "UserSuspended", "account suspended")
         parts = path.split("?")[0].strip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
@@ -767,6 +771,10 @@ class SwiftFrontend:
         user = self._user_for_token(uid, headers.get("X-Auth-Token"))
         if user is None:
             return 401, {}, b"bad token"
+        if user.get("suspended"):
+            # same refusal as the S3 frontend (RGW_USER_SUSPENDED):
+            # suspension covers EVERY frontend
+            return 403, {}, b"account suspended"
         container = parts[1] if len(parts) > 1 and parts[1] else ""
         obj = parts[2] if len(parts) > 2 else ""
         try:

@@ -1,0 +1,185 @@
+"""radosgw-admin's user-administration surface (rgw_admin.cc verbs):
+suspend/enable with frontend refusal, additional keys authenticating
+at both signature flavors, admin caps, user quotas enforced on put,
+bucket link/unlink ownership moves, and user stats accounting."""
+import io
+import json
+from contextlib import redirect_stdout
+
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.rgw import S3Frontend
+from ceph_tpu.rgw.gateway import RGWError, RGWLite
+from ceph_tpu.rgw.http import sign_v2, sign_v4
+from ceph_tpu.tools.rgw_admin import run
+
+
+@pytest.fixture()
+def env():
+    c = MiniCluster(n_osds=3)
+    c.create_replicated_pool("m", size=3, pg_num=8)
+    c.create_replicated_pool("d", size=3, pg_num=8)
+    cl = c.client("client.rgw")
+    g = RGWLite(cl, "m", "d")
+    alice = g.create_user("alice", "Alice")
+    return c, cl, g, alice
+
+
+def _admin(cl, *argv):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = run(None, cl, list(argv), meta_pool="m", data_pool="d")
+    return rc, buf.getvalue()
+
+
+def _v2req(fe, user, method, path, body=b"", secret=None, key=None):
+    hdrs = {"Date": "now"}
+    ak = key or user["access_key"]
+    sk = secret or user["secret_key"]
+    hdrs["Authorization"] = \
+        f"AWS {ak}:{sign_v2(sk, method, path, hdrs, {})}"
+    return fe.handle(method, path, hdrs, body, {})
+
+
+def test_suspend_enable_refuses_requests(env):
+    c, cl, g, alice = env
+    fe = S3Frontend(g)
+    assert _v2req(fe, alice, "PUT", "/b")[0] == 200
+    rc, _ = _admin(cl, "user", "suspend", "--uid", "alice")
+    assert rc == 0
+    st, _, body = _v2req(fe, alice, "GET", "/b")
+    assert st == 403 and b"UserSuspended" in body
+    rc, _ = _admin(cl, "user", "enable", "--uid", "alice")
+    assert rc == 0
+    assert _v2req(fe, alice, "GET", "/b")[0] == 200
+
+
+def test_additional_keys_authenticate(env):
+    c, cl, g, alice = env
+    fe = S3Frontend(g)
+    assert _v2req(fe, alice, "PUT", "/b")[0] == 200
+    rc, out = _admin(cl, "key", "create", "--uid", "alice")
+    assert rc == 0
+    key = json.loads(out)
+    # the NEW key signs v2...
+    st, _, _ = _v2req(fe, alice, "GET", "/b",
+                      secret=key["secret_key"],
+                      key=key["access_key"])
+    assert st == 200
+    # ...and v4
+    hdrs = {"Host": "s3.local"}
+    hdrs["Authorization"] = sign_v4(key["access_key"],
+                                    key["secret_key"], "GET", "/b",
+                                    hdrs, {}, b"")
+    assert fe.handle("GET", "/b", hdrs, b"", {})[0] == 200
+    # key rm revokes it
+    rc, _ = _admin(cl, "key", "rm", "--uid", "alice",
+                   "--access-key", key["access_key"])
+    assert rc == 0
+    st, _, _ = _v2req(fe, alice, "GET", "/b",
+                      secret=key["secret_key"],
+                      key=key["access_key"])
+    assert st == 403
+
+
+def test_caps_add_rm(env):
+    c, cl, g, alice = env
+    rc, out = _admin(cl, "caps", "add", "--uid", "alice",
+                     "--caps", "users=read,write;buckets=read")
+    assert rc == 0
+    caps = json.loads(out)
+    assert caps == {"users": "read,write", "buckets": "read"}
+    rc, out = _admin(cl, "caps", "rm", "--uid", "alice",
+                     "--caps", "users=")
+    assert rc == 0 and json.loads(out) == {"buckets": "read"}
+
+
+def test_user_quota_enforced_on_put(env):
+    c, cl, g, alice = env
+    g.create_bucket("alice", "qb")
+    g.put_object("qb", "one", b"x" * 1000, actor="alice")
+    rc, _ = _admin(cl, "quota", "set", "--uid", "alice",
+                   "--max-size", "1500", "--quota-scope", "user")
+    assert rc == 0
+    rc, _ = _admin(cl, "quota", "enable", "--uid", "alice")
+    assert rc == 0
+    with pytest.raises(RGWError) as ei:
+        g.put_object("qb", "two", b"y" * 1000, actor="alice")
+    assert "QuotaExceeded" in str(ei.value)
+    # small writes under the limit still land
+    g.put_object("qb", "small", b"z" * 100, actor="alice")
+    rc, _ = _admin(cl, "quota", "disable", "--uid", "alice")
+    assert rc == 0
+    g.put_object("qb", "two", b"y" * 1000, actor="alice")
+    # stats reflect the aggregate
+    rc, out = _admin(cl, "user", "stats", "--uid", "alice")
+    assert rc == 0 and json.loads(out)["size"] >= 2100
+
+
+def test_suspension_covers_swift_frontend(env):
+    c, cl, g, alice = env
+    from ceph_tpu.rgw.http import SwiftFrontend
+    sw = SwiftFrontend(g)
+    st, hdrs, _ = sw.handle("GET", "/auth/v1.0", {
+        "X-Auth-User": "alice:swift",
+        "X-Auth-Key": alice["secret_key"]}, b"", {})
+    assert st == 204
+    token = hdrs["X-Auth-Token"]
+    g.create_bucket("alice", "swb")
+    ok = sw.handle("GET", "/v1/AUTH_alice/swb",
+                   {"X-Auth-Token": token}, b"", {})
+    assert ok[0] in (200, 204)
+    g.modify_user("alice", suspended=True)
+    st, _, body = sw.handle("GET", "/v1/AUTH_alice/swb",
+                            {"X-Auth-Token": token}, b"", {})
+    assert st == 403 and b"suspended" in body
+
+
+def test_max_buckets_enforced(env):
+    c, cl, g, alice = env
+    g.modify_user("alice", max_buckets=2)
+    g.create_bucket("alice", "b1")
+    g.create_bucket("alice", "b2")
+    with pytest.raises(RGWError):
+        g.create_bucket("alice", "b3")
+    # linking counts against the cap too
+    bob = g.create_user("bob")
+    g.create_bucket("bob", "bb")
+    with pytest.raises(RGWError):
+        g.link_bucket("bb", "alice")
+
+
+def test_quota_covers_multipart_staging(env):
+    c, cl, g, alice = env
+    g.create_bucket("alice", "mp")
+    g.set_user_quota("alice", max_size=1000, enabled=True)
+    up = g.initiate_multipart("mp", "big", actor="alice")
+    with pytest.raises(RGWError) as ei:
+        g.upload_part("mp", "big", up, 1, b"x" * 2000, actor="alice")
+    assert "QuotaExceeded" in str(ei.value)
+
+
+def test_caps_rm_subtracts_perms(env):
+    c, cl, g, alice = env
+    g.user_caps("alice", add="users=read,write")
+    assert g.user_caps("alice", rm="users=write") == \
+        {"users": "read"}
+    assert g.user_caps("alice", rm="users=read") == {}
+
+
+def test_bucket_link_unlink(env):
+    c, cl, g, alice = env
+    bob = g.create_user("bob", "Bob")
+    g.create_bucket("alice", "shared")
+    rc, _ = _admin(cl, "bucket", "link", "--bucket", "shared",
+                   "--uid", "bob")
+    assert rc == 0
+    assert g.get_bucket("shared")["owner"] == "bob"
+    assert "shared" in g.get_user("bob")["buckets"]
+    assert "shared" not in g.get_user("alice")["buckets"]
+    rc, _ = _admin(cl, "bucket", "unlink", "--bucket", "shared",
+                   "--uid", "bob")
+    assert rc == 0
+    assert g.get_bucket("shared")["owner"] == ""
+    assert "shared" not in g.get_user("bob")["buckets"]
