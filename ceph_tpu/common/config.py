@@ -224,6 +224,23 @@ class ConfigProxy:
             return self.get_checked(args.get("name", ""))
         return None
 
+    def run_daemon_command(self, cmd: str, args: Dict[str, Any],
+                           extras: Dict[str, Callable[[], Any]]
+                           ) -> "tuple[int, Dict[str, Any]]":
+        """The full MCommand handler body shared by every daemon:
+        config vocabulary first, then the daemon's *extras* (zero-arg
+        callables by command name), with the reference's -EINVAL
+        error shape.  Returns (result, data)."""
+        try:
+            handled = self.handle_config_command(cmd, args)
+            if handled is not None:
+                return 0, handled
+            if cmd in extras:
+                return 0, extras[cmd]()
+            return -22, {"error": f"unknown command '{cmd}'"}
+        except (TypeError, ValueError) as e:
+            return -22, {"error": str(e)}
+
     def add_observer(self, name: str,
                      cb: Callable[[str, Any], None]) -> None:
         self.observers.setdefault(name, []).append(cb)

@@ -266,24 +266,14 @@ class MDSDaemon:
         vocabulary (incl. atomic injectargs) is
         ConfigProxy.handle_config_command, shared with the OSD."""
         from ..common.config import g_conf
-        result, data = 0, {}
-        try:
-            handled = g_conf.handle_config_command(msg.cmd, msg.args)
-            if handled is not None:
-                data = handled
-            elif msg.cmd == "session ls":
-                clients = sorted({c for holders in self.caps.values()
-                                  for c in holders})
-                data = {"sessions": clients}
-            elif msg.cmd == "status":
-                data = {"name": self.name, "rank": self.rank,
-                        "mds_map": {str(r): n for r, n
-                                    in self.mds_map.items()}}
-            else:
-                result, data = -22, {"error":
-                                     f"unknown command '{msg.cmd}'"}
-        except (TypeError, ValueError) as e:
-            result, data = -22, {"error": str(e)}
+        result, data = g_conf.run_daemon_command(msg.cmd, msg.args, {
+            "session ls": lambda: {"sessions": sorted(
+                {c for holders in self.caps.values()
+                 for c in holders})},
+            "status": lambda: {"name": self.name, "rank": self.rank,
+                               "mds_map": {str(r): n for r, n
+                                           in self.mds_map.items()}},
+        })
         self.messenger.send_message(
             MCommandReply(tid=msg.tid, result=result, data=data),
             msg.src)

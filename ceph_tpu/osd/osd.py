@@ -240,20 +240,10 @@ class OSD(Dispatcher):
         notification), config show/get, perf dump."""
         from ..common.config import g_conf
         from ..msg.messages import MCommandReply
-        result, data = 0, {}
-        try:
-            handled = g_conf.handle_config_command(msg.cmd, msg.args)
-            if handled is not None:
-                data = handled
-            elif msg.cmd == "perf dump":
-                data = self.perf_counters.dump()
-            elif msg.cmd == "dump_ops_in_flight":
-                data = self.op_tracker.dump_ops_in_flight()
-            else:
-                result, data = -22, {"error":
-                                     f"unknown command '{msg.cmd}'"}
-        except (TypeError, ValueError) as e:
-            result, data = -22, {"error": str(e)}
+        result, data = g_conf.run_daemon_command(msg.cmd, msg.args, {
+            "perf dump": self.perf_counters.dump,
+            "dump_ops_in_flight": self.op_tracker.dump_ops_in_flight,
+        })
         self.reply_to(msg, MCommandReply(tid=msg.tid, result=result,
                                          data=data))
 

@@ -236,18 +236,8 @@ def validate(action: Action, vm: Dict[str, str],
         if name in _PRESENCE:
             out["image"] = _image_check(val(idx), vm, _PRESENCE[name])
         elif name == "dest-image-spec":
-            spec = val(idx)
-            prefix = "destination "
-            pool, image, snap = _parse_spec(spec)
-            if not image:
-                image = vm.get("dest", "")
-            if spec and "@" in spec:
-                raise ValidationError(
-                    f"{prefix}snapname specified for a command that "
-                    "doesn't use it")
-            if not image:
-                raise ValidationError(
-                    f"{prefix}image name was not specified")
+            pool, image, snap = _image_check(val(idx), vm, "none",
+                                             dest=True)
             out["dest"] = (pool or vm.get("dest-pool", ""), image, snap)
         elif name == "dest-snap-spec":
             spec = val(idx)
