@@ -21,6 +21,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.gf_matmul import gf_bit_matmul, DeviceRSBackend
 from .mesh import STRIPE_AXIS, SHARD_AXIS
 
+try:
+    from jax import shard_map                    # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
 
 class ShardedRS:
     """Mesh-wide executor for one (k+m, k) systematic code.
@@ -121,16 +126,46 @@ class ShardedRS:
             bits, NamedSharding(self.mesh, P(SHARD_AXIS, None)))
         return np.asarray(self._collective_decode_jit()(sv, bd))
 
+    # -- layout conversion (all-to-all) -------------------------------------
+    def reshard_stripes_to_chunks(self, chunks: jnp.ndarray
+                                  ) -> jnp.ndarray:
+        """(S, k+m, C) stripe-sharded -> chunk-sharded, on-mesh.
+
+        Encode produces stripe-parallel output (each device holds ALL
+        chunks of ITS stripes); distribution to OSD shards wants
+        chunk-parallel layout (each device holds ONE chunk slice of
+        ALL stripes — the k+m fan-out, ECBackend.cc:1942+).  The
+        switch is a single ``lax.all_to_all`` over the stripe axis —
+        the storage analog of the sequence<->head resharding in
+        all-to-all context parallelism, riding ICI instead of a
+        device->host->device bounce."""
+        nstripe = self.mesh.shape[STRIPE_AXIS]
+        s, r, _c = chunks.shape
+        if r % nstripe or s % nstripe:
+            raise ValueError(f"shape ({s}, {r}, ...) not divisible "
+                             f"by stripe axis size {nstripe}")
+        fn = getattr(self, "_reshard_fn", None)
+        if fn is None:
+            def swap(local):
+                # local (S/n, r, C) -> all_to_all splits r, concats S
+                return jax.lax.all_to_all(local, STRIPE_AXIS,
+                                          split_axis=1, concat_axis=0,
+                                          tiled=True)
+
+            fn = self._reshard_fn = jax.jit(shard_map(
+                swap, mesh=self.mesh,
+                in_specs=P(STRIPE_AXIS, None, None),
+                out_specs=P(None, STRIPE_AXIS, None)))
+        src = jax.device_put(chunks, NamedSharding(
+            self.mesh, P(STRIPE_AXIS, None, None)))
+        return fn(src)
+
     def _collective_decode_jit(self):
         """The shard_map-wrapped kernel, built once per instance so
         repeat degraded reads hit jit's cache instead of retracing."""
         fn = getattr(self, "_collective_fn", None)
         if fn is not None:
             return fn
-        try:
-            from jax import shard_map            # jax >= 0.8
-        except ImportError:  # pragma: no cover - older jax
-            from jax.experimental.shard_map import shard_map
         from ..ops.gf_matmul import _pack_bits, _unpack_bits
 
         def local_partial(sv_local, bits_local):

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from ceph_tpu.gf.matrices import gf_gen_rs_matrix
 from ceph_tpu.ec.rs_codec import MatrixRSCodec
@@ -77,6 +78,30 @@ def test_survivor_sharded_decode_xor_allreduce():
     sv5 = np.zeros((8, 5, 64), np.uint8)
     with pytest.raises(ValueError):
         bad.decode_data_survivor_sharded(sv5, [0, 1, 2, 3, 4], [5])
+
+
+def test_reshard_stripes_to_chunks_all_to_all():
+    """The encode->distribution layout switch rides one all_to_all
+    over the stripe axis (sequence<->head resharding analog): values
+    are IDENTICAL, only the sharding moves."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ceph_tpu.parallel.mesh import STRIPE_AXIS
+
+    k, m, s, c = 8, 4, 16, 256
+    mat = gf_gen_rs_matrix(k + m, k)
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=(s, k, c), dtype=np.uint8)
+    sharded = ShardedRS(mat, make_mesh(8))     # stripe axis size 4
+    coding = sharded.encode(data)
+    allc = np.concatenate([data, coding], axis=1)
+    out = sharded.reshard_stripes_to_chunks(jnp.asarray(allc))
+    assert np.array_equal(np.asarray(out), allc)
+    # the output really is chunk-sharded over the stripe axis
+    want = NamedSharding(sharded.mesh, P(None, STRIPE_AXIS, None))
+    assert out.sharding.is_equivalent_to(want, ndim=3)
+    with pytest.raises(ValueError):
+        sharded.reshard_stripes_to_chunks(
+            jnp.zeros((8, 5, 64), jnp.uint8))   # 5 % 4 != 0
 
 
 def test_pipeline_step_8dev():
