@@ -21,6 +21,7 @@ Semantics replicated from the reference:
 from __future__ import annotations
 
 import configparser
+import json
 import os
 import re
 import sys
@@ -177,6 +178,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     action: Optional[Tuple[str, str]] = None
     overrides: Dict[str, str] = {}
     lookup_key: Optional[str] = None
+    dump_format = "plain"
 
     def norm_flag(a: str) -> str:
         return a.replace("_", "-")
@@ -229,7 +231,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif na in ("-r", "--resolve-search"):
             action = ("resolve-search", "")
         elif na == "--format":
-            need()
+            # validated only when a dump actually runs (the reference
+            # checks via Formatter::create inside dump_all)
+            dump_format = need()
         elif a.startswith("-"):
             # registered-option override, e.g. CEPH_ARGS="--fsid ..."
             # (na already has any "=value" split off)
@@ -319,9 +323,50 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(exp.expand(raw, [(key, raw)]))
         return 0
     if kind == "dump":
+        known = ("", "plain", "json", "json-pretty", "xml",
+                 "xml-pretty", "table", "table-kv", "html",
+                 "html-pretty")
+        if dump_format not in known:
+            # Formatter::create's refusal shape: stderr + usage
+            sys.stderr.write(f"format '{dump_format}' not "
+                             "recognized.\n")
+            sys.stderr.write(USAGE)
+            return 1
+        vals = {}
         for key in sorted(g.schema):
             raw = str(resolved(key) or "")
-            print(f"{key} = {exp.expand(raw, [(key, raw)])}")
+            vals[key] = exp.expand(raw, [(key, raw)])
+        # _show_config emits the identity keys first
+        doc = {"name": name, "cluster": cluster, **vals}
+        if dump_format == "json":
+            print(json.dumps(doc, separators=(",", ":")))
+        elif dump_format == "json-pretty":
+            print(json.dumps(doc, indent=4))
+        elif dump_format in ("xml", "xml-pretty"):
+            from xml.sax.saxutils import escape as _esc
+            nl = "\n" if dump_format == "xml-pretty" else ""
+            pad = "    " if dump_format == "xml-pretty" else ""
+            out = ["<config>" + nl]
+            for k, v in doc.items():
+                out.append(f"{pad}<{k}>{_esc(v)}</{k}>{nl}")
+            out.append("</config>")
+            print("".join(out))
+        elif dump_format in ("table", "table-kv"):
+            sep = ": " if dump_format == "table-kv" else "  "
+            width = max(len(k) for k in doc)
+            for k, v in doc.items():
+                left = k if dump_format == "table-kv" \
+                    else k.ljust(width)
+                print(f"{left}{sep}{v}")
+        elif dump_format in ("html", "html-pretty"):
+            from xml.sax.saxutils import escape as _esc
+            nl = "\n" if dump_format == "html-pretty" else ""
+            items = "".join(f"<li>{_esc(k)}: {_esc(v)}</li>{nl}"
+                            for k, v in doc.items())
+            print(f"<ul>{nl}{items}</ul>")
+        else:
+            for key, v in vals.items():
+                print(f"{key} = {v}")
         return 0
     if kind == "list-sections":
         for sec in conf.names():

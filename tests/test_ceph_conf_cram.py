@@ -80,3 +80,42 @@ def test_kvstore_tool_round_trip(tmp_path):
     assert run("set", "pre/fix", "k y%", "in", str(blob))[0] == 0
     rc, out = run("get", "pre/fix", "k y%")
     assert rc == 0 and "pre%2ffix" in out
+
+
+def test_dump_formats(tmp_path):
+    """--format plain|json|json-pretty on -D (the help's FLAGS
+    contract), beyond what the cram corpus pins."""
+    import io
+    import json as _json
+    from contextlib import redirect_stdout
+
+    from ceph_tpu.tools.ceph_conf import main
+
+    def run(*args):
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = main(list(args))
+        return rc, buf.getvalue()
+
+    rc, out = run("-n", "osd.0", "-D", "-c", "/dev/null")
+    assert rc == 0 and "log_file = /var/log/ceph/ceph-osd.0.log" in out
+    rc, out = run("-n", "osd.0", "-D", "--format", "json",
+                  "-c", "/dev/null")
+    assert rc == 0
+    doc = _json.loads(out)
+    assert doc["log_file"] == "/var/log/ceph/ceph-osd.0.log"
+    rc, out = run("-n", "osd.0", "-D", "--format", "json-pretty",
+                  "-c", "/dev/null")
+    assert rc == 0 and _json.loads(out)["admin_socket"].endswith(
+        "ceph-osd.0.asok")
+    # identity keys lead the structured dumps (_show_config order)
+    assert list(_json.loads(out))[:2] == ["name", "cluster"]
+    rc, out = run("-n", "osd.0", "-D", "--format", "xml",
+                  "-c", "/dev/null")
+    assert rc == 0 and out.startswith("<config>") \
+        and "<name>osd.0</name>" in out
+    rc, out = run("-D", "--format", "table-kv", "-c", "/dev/null")
+    assert rc == 0 and "fsid: " in out
+    # unknown formats: Formatter::create's refusal, only at dump time
+    assert run("-D", "--format", "yaml")[0] == 1
+    assert run("-L", "--format", "yaml", "-c", "/dev/null")[0] == 0
