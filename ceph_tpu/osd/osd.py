@@ -309,15 +309,11 @@ class OSD(Dispatcher):
     def _local_pg_collections(self) -> Dict[Tuple[int, int], List[str]]:
         """(pool, ps) -> local collection names, parsed from the store
         (strays can exist with no PG object after a restart)."""
+        from ..os_store import parse_pg_from_cid
         out: Dict[Tuple[int, int], List[str]] = {}
         for cid in self.store.list_collections():
-            body = cid[:-5] if cid.endswith("_meta") else cid
-            if "s" in body.split(".")[-1]:
-                body = body[:body.rindex("s")]
-            try:
-                pool_s, ps_s = body.split(".")
-                key = (int(pool_s), int(ps_s))
-            except ValueError:
+            key = parse_pg_from_cid(cid)
+            if key is None:
                 continue
             out.setdefault(key, []).append(cid)
         return out

@@ -13,6 +13,11 @@ cluster.  Supported ops mirror the reference's most-used surface:
   --op remove     --cid C --oid O [--shard S]   (rewrites the store)
   --op export     --cid C --out FILE            (one collection, portable)
   --op import     --in FILE                     (merge an exported coll)
+  --op list-pgs                                 distinct pg ids on the store
+  --op get-attr   --cid C --oid O --key K       (hex to stdout)
+  --op set-bytes  --cid C --oid O --in FILE     (replace payload)
+  --op set-attr/rm-attr --key K [--value HEX]
+  --op set-omap/rm-omap --key K [--value HEX]
 
 Exit status 0 on success, 1 on usage/lookup errors.
 """
@@ -127,17 +132,34 @@ def main(argv=None) -> int:
                    help="MemStore.save file (osd.N.store)")
     p.add_argument("--op", required=True,
                    choices=["list", "info", "get-bytes", "list-attrs",
-                            "get-omap", "remove", "export", "import"])
+                            "get-omap", "remove", "export", "import",
+                            "set-bytes", "get-attr", "set-attr",
+                            "rm-attr", "set-omap", "rm-omap",
+                            "list-pgs"])
     p.add_argument("--cid")
     p.add_argument("--oid")
     p.add_argument("--shard", type=int, default=-1)
+    p.add_argument("--key", help="attr/omap key (get/set/rm-attr, "
+                                 "set/rm-omap)")
+    p.add_argument("--value", help="hex value (set-attr/set-omap)")
     p.add_argument("--out", help="output file (get-bytes/export)")
-    p.add_argument("--in", dest="infile", help="input file (import)")
+    p.add_argument("--in", dest="infile",
+                   help="input file (import/set-bytes)")
     a = p.parse_args(argv)
 
     store = MemStore.load(a.data_path)
     if a.op == "list":
         return _op_list(store, sys.stdout)
+    if a.op == "list-pgs":
+        # distinct pg ids parsed from collection names, rendered like
+        # pg_t ("pool.ps" with HEX ps, matching ceph pg dump)
+        from ..os_store import parse_pg_from_cid
+        pgs = {p for p in map(parse_pg_from_cid,
+                              store.list_collections())
+               if p is not None}
+        for pool, ps in sorted(pgs):
+            print(f"{pool}.{ps:x}")
+        return 0
     if a.op == "info":
         return _op_info(store, sys.stdout)
     if a.op == "export":
@@ -172,9 +194,69 @@ def main(argv=None) -> int:
         om = store.omap_get(a.cid, ho)
         print(json.dumps({k: v.hex() for k, v in sorted(om.items())}))
         return 0
-    # remove
+    if a.op == "get-attr":
+        if not a.key:
+            p.error("get-attr needs --key")
+        attrs = store.getattrs(a.cid, ho)
+        if a.key not in attrs:
+            print(f"no attr {a.key!r}", file=sys.stderr)
+            return 1
+        sys.stdout.write(attrs[a.key].hex() + "\n")
+        return 0
+    # write-side surgery: every mutation goes through a transaction
+    # and rewrites the store file (the offline-store contract); the
+    # else branch is `remove`, so an op missing from this chain can
+    # never silently fall through to a delete
+
+    def hexval():
+        if not a.key or a.value is None:
+            p.error(f"{a.op} needs --key and --value (hex)")
+        try:
+            return bytes.fromhex(a.value)
+        except ValueError:
+            print(f"--value {a.value!r} is not hex", file=sys.stderr)
+            return None
+
     t = Transaction()
-    t.remove(a.cid, ho)
+    if a.op == "set-bytes":
+        if not a.infile:
+            p.error("set-bytes needs --in")
+        try:
+            with open(a.infile, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            print(f"cannot read {a.infile}: {e.strerror}",
+                  file=sys.stderr)
+            return 1
+        t.truncate(a.cid, ho, 0)
+        t.write(a.cid, ho, 0, data)
+    elif a.op == "set-attr":
+        v = hexval()
+        if v is None:
+            return 1
+        t.setattr(a.cid, ho, a.key, v)
+    elif a.op == "rm-attr":
+        if not a.key:
+            p.error("rm-attr needs --key")
+        if a.key not in store.getattrs(a.cid, ho):
+            print(f"no attr {a.key!r}", file=sys.stderr)
+            return 1
+        t.rmattr(a.cid, ho, a.key)
+    elif a.op == "set-omap":
+        v = hexval()
+        if v is None:
+            return 1
+        t.omap_setkeys(a.cid, ho, {a.key: v})
+    elif a.op == "rm-omap":
+        if not a.key:
+            p.error("rm-omap needs --key")
+        if a.key not in store.omap_get(a.cid, ho):
+            print(f"no omap key {a.key!r}", file=sys.stderr)
+            return 1
+        t.omap_rmkeys(a.cid, ho, [a.key])
+    else:
+        assert a.op == "remove", a.op
+        t.remove(a.cid, ho)
     store.queue_transaction(t)
     store.save(a.data_path)
     return 0
