@@ -408,6 +408,71 @@ def execute_action(action: Action, vm: Dict[str, str],
         argv = ["-p", pool, "lock", "rm", name,
                 "--cookie", parsed.get("lock-id", ""),  # type: ignore
                 "--locker", parsed.get("locker", "")]  # type: ignore
+    elif spec == "bench":
+        # rbd bench (tools/rbd/action/Bench.cc): drive IO at the image
+        # through the librbd-lite API and report the reference's
+        # SEC/OPS/OPS/SEC table + elapsed summary
+        if checkpoint is None:
+            print("rbd: error opening cluster (no --checkpoint)",
+                  file=sys.stderr)
+            return 1
+        from ..cluster import MiniCluster
+        c = MiniCluster.restore(checkpoint)
+        from ..rbd import Image
+        import time as _time
+        io_type = vm.get("io-type", "")
+        if io_type not in ("read", "write", "readwrite", "rw"):
+            print("rbd: --io-type must be read, write, or "
+                  "readwrite(rw)", file=sys.stderr)
+            return EINVAL
+        io_size = n(vm.get("io-size", "4K"))
+        io_total = n(vm.get("io-total", "1G")) if "io-total" in vm \
+            else (1 << 20)              # liliputian default for tests
+        pattern = vm.get("io-pattern", "seq")
+        if pattern not in ("seq", "rand"):
+            print(f"rbd: --io-pattern must be rand or seq",
+                  file=sys.stderr)
+            return EINVAL
+        img = Image(c.client("client.rbd-bench"), pool, name)
+        size = img.size()
+        if io_size <= 0 or io_size > size:
+            print(f"rbd: --io-size must be > 0 and fit the image "
+                  f"({size} bytes)", file=sys.stderr)
+            return EINVAL
+        ops_total = max(1, io_total // io_size)
+        payload = b"\xbe" * io_size
+        rng_seed = 0x5eed
+        t0 = _time.perf_counter()
+        last_tick, ops_done = t0, 0
+        print("  SEC       OPS   OPS/SEC   BYTES/SEC")
+        for i in range(ops_total):
+            if pattern == "rand":
+                rng_seed = (rng_seed * 1103515245 + 12345) & 0x7FFFFFFF
+                off = (rng_seed * io_size) % max(size - io_size, 1)
+                off -= off % io_size
+            else:
+                off = (i * io_size) % max(size - io_size + 1, 1)
+            write_this = io_type in ("write",) or \
+                (io_type in ("readwrite", "rw") and i % 2 == 0)
+            if write_this:
+                img.write(off, payload)
+            else:
+                img.read(off, io_size)
+            ops_done += 1
+            now = _time.perf_counter()
+            if now - last_tick >= 1.0:
+                dt = now - t0
+                print(f"{int(dt):5d}  {ops_done:8d}  "
+                      f"{ops_done / dt:8.2f}  "
+                      f"{ops_done * io_size / dt:.2f}")
+                last_tick = now
+        dt = max(_time.perf_counter() - t0, 1e-9)
+        print(f"elapsed: {int(dt):5d}  ops: {ops_total:8d}  "
+              f"ops/sec: {ops_total / dt:8.2f}  "
+              f"bytes/sec: {ops_total * io_size / dt:.2f}")
+        if io_type != "read":
+            c.checkpoint(checkpoint)    # bench writes persist
+        return 0
     elif spec == "rename":
         from ..cluster import MiniCluster
         if checkpoint is None:
