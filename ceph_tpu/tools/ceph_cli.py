@@ -193,10 +193,46 @@ def main(argv=None) -> int:
             print(json.dumps(counts))
         elif sub == "dump":
             for pgid, pg in sorted(_pg_lines(c)):
-                print(f"{pgid[0]}.{pgid[1]}\t{pg.state}"
+                print(f"{pgid[0]}.{pgid[1]:x}\t{pg.state}"
                       f"\tup={pg.up}\tacting={pg.acting}"
                       f"\tlast_scrub={pg.last_scrub_stamp:.0f}"
                       f"\tlast_deep_scrub={pg.last_deep_scrub_stamp:.0f}")
+        elif sub == "query" or (len(rest) > 1 and rest[1] == "query"):
+            # ceph pg <pgid> query (PG::Query role): one pg's peering
+            # and log state as json.  pgids are the canonical pg_t
+            # rendering (HEX ps) only — accepting decimal too would
+            # make ids like 0.10 ambiguous
+            if len(rest) < 2:
+                print("usage: ceph pg <pgid> query", file=sys.stderr)
+                return 1
+            want = rest[1] if sub == "query" else rest[0]
+            from ..os_store import parse_pg_from_cid
+            for pgid, pg in _pg_lines(c):
+                if f"{pgid[0]}.{pgid[1]:x}" == want:
+                    n_obj = 0
+                    for cid in pg.osd.store.list_collections():
+                        if parse_pg_from_cid(cid) == pgid \
+                                and not cid.endswith("_meta"):
+                            n_obj += len(
+                                pg.osd.store.list_objects(cid))
+                    print(json.dumps({
+                        "pgid": f"{pgid[0]}.{pgid[1]:x}",
+                        "state": pg.state,
+                        "up": list(pg.up),
+                        "acting": list(pg.acting),
+                        "acting_primary": pg.acting_primary,
+                        "last_update": pg.pg_log.head,
+                        "log_tail": pg.pg_log.tail,
+                        "log_entries": len(pg.pg_log.entries),
+                        "objects_on_primary": n_obj,
+                        "last_scrub_stamp": pg.last_scrub_stamp,
+                        "last_deep_scrub_stamp":
+                            pg.last_deep_scrub_stamp,
+                    }, indent=2, sort_keys=True))
+                    break
+            else:
+                print(f"pg {want} does not exist", file=sys.stderr)
+                return 1
         elif sub in ("scrub", "deep-scrub"):
             # ceph pg scrub/deep-scrub <pool.ps> (MonCommands.h role);
             # the restored cluster is ephemeral, so this reports what
@@ -204,7 +240,8 @@ def main(argv=None) -> int:
             want = rest[1] if len(rest) > 1 else None
             ran, matched = 0, 0
             for pgid, pg in _pg_lines(c):
-                if want and f"{pgid[0]}.{pgid[1]}" != want:
+                # canonical pg_t rendering only (hex ps)
+                if want and want != f"{pgid[0]}.{pgid[1]:x}":
                     continue
                 matched += 1
                 if pg.start_scrub(deep=(sub == "deep-scrub")):

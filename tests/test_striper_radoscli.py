@@ -215,3 +215,48 @@ def test_remove_after_failed_shrink_deletes_orphans(env):
     assert s.write_full("orph", b"x" * 10) == 0
     assert s.truncate("orph", 1500) == 0
     assert s.read("orph") == b"x" * 10 + b"\0" * 1490
+
+
+def test_pg_query(tmp_path):
+    """ceph pg <pgid> query: one pg's peering/log state as json, with
+    the canonical hex pgid rendering (pg_t)."""
+    import io
+    import json
+    from contextlib import redirect_stdout, redirect_stderr
+
+    from ceph_tpu.tools import ceph_cli
+
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("qp", pg_num=16)
+    c.client("client.q").write_full("qp", "obj", b"querydata")
+    ckpt = str(tmp_path / "ck")
+    c.checkpoint(ckpt)
+
+    def run(*args):
+        out = io.StringIO()
+        with redirect_stdout(out), redirect_stderr(out):
+            rc = ceph_cli.main(["--cluster", ckpt, *args])
+        return rc, out.getvalue()
+
+    rc, out = run("pg", "dump")
+    assert rc == 0
+    pgid = out.split()[0]
+    total = 0
+    for line in out.splitlines():
+        pid = line.split("\t")[0]
+        for args in (("pg", "query", pid), ("pg", pid, "query")):
+            rc, qout = run(*args)
+            assert rc == 0, (args, qout)
+            doc = json.loads(qout)
+            assert doc["pgid"] == pid and doc["state"]
+            assert "last_update" in doc and "log_entries" in doc
+            assert doc["acting"] and \
+                doc["acting_primary"] in doc["acting"]
+        total += doc["objects_on_primary"]
+    # per-pg object counts sum to the ONE object written (prefix
+    # over-matching 0.1 vs 0.10 would overcount)
+    assert total == 1, total
+    rc, out = run("pg", "query", "9.ff")
+    assert rc == 1 and "does not exist" in out
+    rc, out = run("pg", "query")
+    assert rc == 1 and "usage" in out
