@@ -11,11 +11,17 @@ verbs, driven from a checkpoint directory like tools/rados.py.
                          quotas, snaps mode, tiering)
   pg stat            (per-state PG counts)
   pg dump            (one line per PG: state, up/acting sets)
+  pg <pgid> query    (one pg's peering/log state as json)
   pg scrub|deep-scrub [pool.ps]  (offline consistency pass report)
   log last [n]       (recent cluster-log entries)
   config-key get|ls  (replicated config-key store)
+  osd pool create|set|rm         (pool admin; persists to the
+                                  checkpoint, rm needs the
+                                  double-name + flag confirmation)
+  tell <who> injectargs|...      (runtime config, admin socket)
 
-Read-only: never writes the checkpoint back.
+Inspection verbs never write the checkpoint back; the pool-admin
+verbs (and tell-driven writes inside bench-style flows) do.
 """
 from __future__ import annotations
 
@@ -133,6 +139,122 @@ def main(argv=None) -> int:
             _osd_tree(c)
         elif sub == "df":
             _osd_df(c)
+        elif sub == "pool" and rest[1:2] == ["create"]:
+            # ceph osd pool create <name> <pg_num>
+            #   [replicated | erasure [profile]]   (MonCommands.h)
+            if len(rest) < 4 or not rest[3].isdigit() \
+                    or int(rest[3]) < 1:
+                print("usage: ceph osd pool create <name> <pg_num> "
+                      "[replicated|erasure [profile]]  (pg_num >= 1)",
+                      file=sys.stderr)
+                return 1
+            name, pg_num = rest[2], int(rest[3])
+            if name in c.mon.osdmap.pool_name.values():
+                # the reference treats re-creation as success
+                print(f"pool '{name}' already exists")
+                return 0
+            kind = rest[4] if len(rest) > 4 else "replicated"
+            try:
+                if kind == "replicated":
+                    c.create_replicated_pool(name, pg_num=pg_num)
+                elif kind == "erasure":
+                    profile = rest[5] if len(rest) > 5 else None
+                    if profile:
+                        if profile not in \
+                                c.mon.osdmap.erasure_code_profiles:
+                            print(f"unknown ec profile '{profile}'",
+                                  file=sys.stderr)
+                            return 1
+                        # the mon's own path honors EVERY profile key
+                        # (failure domain, stripe_unit, technique...)
+                        c.mon.create_ec_pool(name, profile,
+                                             pg_num=pg_num)
+                        c.mon.publish()
+                        c.network.pump()
+                        c.run_recovery()
+                    else:
+                        c.create_ec_pool(name, pg_num=pg_num)
+                else:
+                    print(f"unknown pool type '{kind}'",
+                          file=sys.stderr)
+                    return 1
+            except (ValueError, KeyError, RuntimeError) as e:
+                print(f"pool create failed: {e}", file=sys.stderr)
+                return 1
+            c.checkpoint(a.cluster)
+            print(f"pool '{name}' created")
+        elif sub == "pool" and rest[1:2] == ["rm"]:
+            # the reference's double-name + flag confirmation
+            if len(rest) < 4 or rest[2] != rest[3] or \
+                    "--yes-i-really-really-mean-it" not in rest:
+                print("Error EPERM: WARNING: this will *PERMANENTLY "
+                      "DESTROY* all data stored in pool. If you are "
+                      "ABSOLUTELY CERTAIN that is what you want, pass "
+                      "the pool name *twice*, followed by "
+                      "--yes-i-really-really-mean-it.",
+                      file=sys.stderr)
+                return 1
+            try:
+                c.delete_pool(rest[2])
+            except (KeyError, ValueError) as e:
+                print(f"pool rm failed: {e}", file=sys.stderr)
+                return 1
+            c.checkpoint(a.cluster)
+            print(f"pool '{rest[2]}' removed")
+        elif sub == "pool" and rest[1:2] == ["set"]:
+            # ceph osd pool set <name> <var> <val>
+            if len(rest) < 5:
+                print("usage: ceph osd pool set <name> <var> <val>",
+                      file=sys.stderr)
+                return 1
+            name, var, val = rest[2], rest[3], rest[4]
+            try:
+                if var in ("pg_num", "pgp_num", "quota_max_objects",
+                           "quota_max_bytes"):
+                    if var == "pg_num":
+                        c.mon.set_pool_pg_num(name, int(val))
+                    elif var == "pgp_num":
+                        c.mon.set_pool_pgp_num(name, int(val))
+                    elif var == "quota_max_objects":
+                        c.mon.set_pool_quota(name,
+                                             max_objects=int(val))
+                    else:
+                        c.mon.set_pool_quota(name, max_bytes=int(val))
+                    # the setters stage into the working map; COMMIT
+                    # an epoch so OSDs (and restores, which rebuild
+                    # from incrementals) actually see it
+                    c.mon.publish()
+                elif var in ("size", "min_size"):
+                    pid = c.mon.osdmap.lookup_pg_pool_name(name)
+                    if pid < 0:
+                        raise KeyError(name)
+                    from ..osdmap import Incremental
+                    inc = Incremental()
+                    import copy
+                    pool = copy.deepcopy(c.mon.osdmap.pools[pid])
+                    v = int(val)
+                    new_size = v if var == "size" else pool.size
+                    new_min = v if var == "min_size" else pool.min_size
+                    if new_size < 1 or new_min < 1 or \
+                            new_min > new_size:
+                        raise ValueError(
+                            f"size {new_size} / min_size {new_min} "
+                            "out of range")
+                    setattr(pool, var, v)
+                    inc.new_pools[pid] = pool
+                    inc.new_pool_names[pid] = name
+                    c.mon.publish(inc)
+                else:
+                    print(f"unknown variable '{var}'",
+                          file=sys.stderr)
+                    return 1
+            except (KeyError, ValueError) as e:
+                print(f"pool set failed: {e!r}", file=sys.stderr)
+                return 1
+            c.network.pump()
+            c.run_recovery()
+            c.checkpoint(a.cluster)
+            print(f"set pool '{name}' {var} to {val}")
         elif sub == "pool" and rest[1:2] == ["ls"]:
             # ceph osd pool ls [detail] (MonCommands.h)
             if rest[2:] not in ([], ["detail"]):

@@ -260,3 +260,68 @@ def test_pg_query(tmp_path):
     assert rc == 1 and "does not exist" in out
     rc, out = run("pg", "query")
     assert rc == 1 and "usage" in out
+
+
+def test_pool_admin_verbs(tmp_path):
+    """ceph osd pool create/set/rm (MonCommands.h): mutations persist
+    to the checkpoint; rm requires the reference's double-name +
+    --yes-i-really-really-mean-it confirmation."""
+    import io
+    from contextlib import redirect_stdout, redirect_stderr
+
+    from ceph_tpu.tools import ceph_cli
+
+    c = MiniCluster(n_osds=6)
+    ckpt = str(tmp_path / "ck")
+    c.checkpoint(ckpt)
+
+    def run(*args):
+        out = io.StringIO()
+        with redirect_stdout(out), redirect_stderr(out):
+            rc = ceph_cli.main(["--cluster", ckpt, *args])
+        return rc, out.getvalue()
+
+    assert run("osd", "pool", "create", "rp", "16")[0] == 0
+    assert run("osd", "pool", "create", "ep", "8", "erasure")[0] == 0
+    assert run("osd", "pool", "set", "rp", "size", "2")[0] == 0
+    assert run("osd", "pool", "set", "rp", "quota_max_bytes",
+               "1048576")[0] == 0
+    rc, out = run("osd", "pool", "ls", "detail")
+    assert rc == 0 and "size 2" in out and "max_bytes 1048576" in out
+    # rm refuses casual deletion
+    rc, out = run("osd", "pool", "rm", "rp")
+    assert rc == 1 and "PERMANENTLY" in out
+    rc, out = run("osd", "pool", "rm", "rp", "nope",
+                  "--yes-i-really-really-mean-it")
+    assert rc == 1
+    assert run("osd", "pool", "rm", "rp", "rp",
+               "--yes-i-really-really-mean-it")[0] == 0
+    rc, out = run("osd", "pool", "ls")
+    assert "rp" not in out and "ep" in out
+    # usage errors
+    assert run("osd", "pool", "create", "x")[0] == 1
+    assert run("osd", "pool", "create", "x", "0")[0] == 1
+    assert run("osd", "pool", "create", "x", "8", "wat")[0] == 1
+    assert run("osd", "pool", "set", "ep", "nope", "1")[0] == 1
+    # duplicate create = success without a shadow pool (reference)
+    rc, out = run("osd", "pool", "create", "ep", "8")
+    assert rc == 0 and "already exists" in out
+    # rm of a missing pool errors cleanly
+    rc, out = run("osd", "pool", "rm", "gone", "gone",
+                  "--yes-i-really-really-mean-it")
+    assert rc == 1 and "failed" in out
+    # invalid size combinations are refused
+    assert run("osd", "pool", "set", "ep", "min_size", "99")[0] == 1
+    assert run("osd", "pool", "set", "ep", "size", "0")[0] == 1
+    # pg_num growth COMMITS an epoch: a restored cluster's osds
+    # instantiate the split pgs and serve objects hashed into them
+    assert run("osd", "pool", "set", "ep", "pg_num", "16")[0] == 0
+    assert run("osd", "pool", "set", "ep", "pgp_num", "16")[0] == 0
+    # the mutations persisted: the restored cluster serves the EC pool
+    c2 = MiniCluster.restore(ckpt)
+    assert c2.mon.osdmap.pools[
+        c2.mon.osdmap.lookup_pg_pool_name("ep")].pg_num == 16
+    cl = c2.client("client.v")
+    for i in range(8):          # span the split pg range
+        assert cl.write_full("ep", f"o{i}", b"x%d" % i) == 0
+        assert bytes(cl.read("ep", f"o{i}")) == b"x%d" % i
