@@ -1323,6 +1323,12 @@ class Monitor(Dispatcher):
     def mark_osd_out(self, osd: int) -> None:
         inc = Incremental()
         inc.new_weight[osd] = 0
+        cur = self.osdmap.osd_weight[osd] \
+            if osd < len(self.osdmap.osd_weight) else 0
+        if 0 < cur < CEPH_OSD_IN:
+            # memo a reweight override so a later 'in' restores it
+            # (osd_xinfo_t::old_weight, OSDMonitor operator out/in)
+            inc.new_old_weight[osd] = cur
         self.publish(inc)
 
     def handle_pg_temp(self, msg: MOSDPGTemp) -> None:
@@ -1340,7 +1346,10 @@ class Monitor(Dispatcher):
 
     def mark_osd_in(self, osd: int) -> None:
         inc = Incremental()
-        inc.new_weight[osd] = CEPH_OSD_IN
+        old = self.osdmap.osd_old_weight.get(osd, 0)
+        inc.new_weight[osd] = old if old > 0 else CEPH_OSD_IN
+        if old:
+            inc.new_old_weight[osd] = 0      # memo consumed
         self.publish(inc)
 
     # ---- durability (mon store, src/mon/MonitorDBStore.h role) -------------

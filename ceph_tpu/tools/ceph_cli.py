@@ -139,6 +139,54 @@ def main(argv=None) -> int:
             _osd_tree(c)
         elif sub == "df":
             _osd_df(c)
+        elif sub in ("out", "in", "reweight"):
+            # ceph osd out/in/reweight <id> [w] (MonCommands.h): mark
+            # an osd out/in or set its override weight; commits an
+            # epoch and persists like the pool-admin verbs
+            ident = rest[1] if len(rest) > 1 else ""
+            if ident.startswith("osd."):
+                ident = ident[len("osd."):]
+            if not ident.isdigit():
+                print(f"usage: ceph osd {sub} <id>"
+                      + (" <weight 0..1>" if sub == "reweight"
+                         else ""), file=sys.stderr)
+                return 1
+            oid_ = int(ident)
+            if not c.mon.osdmap.exists(oid_):
+                print(f"osd.{oid_} does not exist", file=sys.stderr)
+                return 1
+            already = (sub == "out" and not c.mon.osdmap.is_in(oid_)) \
+                or (sub == "in" and c.mon.osdmap.is_in(oid_))
+            if already:
+                # no epoch churn for a no-op, like the reference mon
+                print(f"osd.{oid_} is already {sub}")
+                return 0
+            if sub == "out":
+                c.mark_osd_out(oid_)     # the cluster helper bundles
+                                         # publish + pump + recovery
+            elif sub == "in":
+                c.mon.mark_osd_in(oid_)
+            else:
+                try:
+                    w = float(rest[2])
+                except (IndexError, ValueError):
+                    print("usage: ceph osd reweight <id> "
+                          "<weight 0..1>", file=sys.stderr)
+                    return 1
+                if not 0.0 <= w <= 1.0:
+                    print("weight must be in [0, 1]",
+                          file=sys.stderr)
+                    return 1
+                from ..osdmap import Incremental
+                inc = Incremental()
+                inc.new_weight[oid_] = int(w * 0x10000)
+                c.mon.publish(inc)
+            if sub != "out":             # out's helper already settled
+                c.network.pump()
+                c.run_recovery()
+            c.checkpoint(a.cluster)
+            print(f"osd.{oid_} {sub} done "
+                  f"(epoch {c.mon.osdmap.epoch})")
         elif sub == "pool" and rest[1:2] == ["create"]:
             # ceph osd pool create <name> <pg_num>
             #   [replicated | erasure [profile]]   (MonCommands.h)

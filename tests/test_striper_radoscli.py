@@ -325,3 +325,49 @@ def test_pool_admin_verbs(tmp_path):
     for i in range(8):          # span the split pg range
         assert cl.write_full("ep", f"o{i}", b"x%d" % i) == 0
         assert bytes(cl.read("ep", f"o{i}")) == b"x%d" % i
+
+
+def test_osd_admin_verbs(tmp_path):
+    """ceph osd out/in/reweight: epoch-committing osd state admin
+    that a restored cluster observes."""
+    import io
+    from contextlib import redirect_stdout, redirect_stderr
+
+    from ceph_tpu.tools import ceph_cli
+
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("p", pg_num=8)
+    ckpt = str(tmp_path / "ck")
+    c.checkpoint(ckpt)
+
+    def run(*args):
+        out = io.StringIO()
+        with redirect_stdout(out), redirect_stderr(out):
+            rc = ceph_cli.main(["--cluster", ckpt, *args])
+        return rc, out.getvalue()
+
+    assert run("osd", "out", "2")[0] == 0
+    c2 = MiniCluster.restore(ckpt)
+    assert not c2.mon.osdmap.is_in(2)
+    # a repeat out is a NO-OP (no epoch churn)
+    e0 = c2.mon.osdmap.epoch
+    rc, out = run("osd", "out", "2")
+    assert rc == 0 and "already" in out
+    assert MiniCluster.restore(ckpt).mon.osdmap.epoch == e0
+    assert run("osd", "in", "osd.2")[0] == 0
+    c2 = MiniCluster.restore(ckpt)
+    assert c2.mon.osdmap.is_in(2)
+    assert run("osd", "reweight", "1", "0.5")[0] == 0
+    c2 = MiniCluster.restore(ckpt)
+    assert c2.mon.osdmap.osd_weight[1] == 0x8000
+    # out then in RESTORES the reweight override (old_weight memo)
+    assert run("osd", "out", "1")[0] == 0
+    assert run("osd", "in", "1")[0] == 0
+    c2 = MiniCluster.restore(ckpt)
+    assert c2.mon.osdmap.osd_weight[1] == 0x8000
+    # error contracts
+    assert run("osd", "out", "99")[0] == 1
+    assert run("osd", "out", "dso.2")[0] == 1
+    assert run("osd", "reweight", "1", "7")[0] == 1
+    assert run("osd", "reweight", "1")[0] == 1
+    assert run("osd", "out")[0] == 1
