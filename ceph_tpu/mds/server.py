@@ -192,18 +192,43 @@ class MDSDaemon:
         # journaled AND committed) and tolerate gaps; the APPLY pass
         # keeps the strict gap rule FROM THE COMMIT POINT (events past
         # a gap are not safe to apply in order).
-        entries = dict(self.journal.scan_entries())
-        for payload in entries.values():
+        raw = self.journal.scan_entries()
+        # annul accounting: an op whose apply FAILED left its frame in
+        # the journal plus an __annul__ record; a reqid counts as
+        # applied only if it has more op frames than annuls (so a
+        # failed attempt can never replay into a phantom success)
+        frames: Dict[str, int] = {}
+        annuls: Dict[str, int] = {}
+        annulled_tids: Dict[int, int] = {}
+        docs = []
+        for tid_, payload in raw:
             try:
-                rid = json.loads(payload).get("reqid")
+                doc = json.loads(payload)
             except ValueError:
                 continue
-            if rid:
+            docs.append((tid_, doc))
+            rid = doc.get("reqid")
+            if doc.get("op") == "__annul__":
+                if rid:
+                    annuls[rid] = annuls.get(rid, 0) + 1
+                ft = int(doc.get("for", -1))
+                annulled_tids[ft] = annulled_tids.get(ft, 0) + 1
+            elif rid:
+                frames[rid] = frames.get(rid, 0) + 1
+        for rid, n in frames.items():
+            if n > annuls.get(rid, 0):
                 self._remember(rid)
+        entries = {t: d for t, d in docs if d.get("op") != "__annul__"}
         last = committed
         tid = committed + 1
         while tid in entries:
-            ev = json.loads(entries[tid])
+            ev = entries[tid]
+            if annulled_tids.get(tid, 0) >= 1:
+                # the failed attempt's frame: its effects never
+                # happened; replaying could apply them now
+                last = tid
+                tid += 1
+                continue
             try:
                 self._apply(ev["op"], ev["args"])
             except FsError as e:
@@ -213,11 +238,42 @@ class MDSDaemon:
             tid += 1
         if last > committed:
             self.journal.commit("mds", last)
+        # frames appended after THIS scan belong to racing writers;
+        # the duplicate fence only needs to look there
+        self._boot_next_tid = getattr(self.journal, "_next_tid", 0)
 
     def _remember(self, reqid: str) -> None:
         self._completed[reqid] = True
         while len(self._completed) > 4096:
             self._completed.popitem(last=False)
+
+    def _applied_elsewhere(self, reqid: str) -> bool:
+        """Duplicate-apply fence: does *reqid* have an APPLIED journal
+        frame besides the one the current invocation just wrote?
+        Counts op frames minus __annul__ records minus our own
+        attempt; scans fresh reads bounded to frames appended after
+        our startup scan (only racing writers can live there — older
+        applied frames already populated the memo at boot)."""
+        boot = getattr(self, "_boot_next_tid", 0)
+        needle = reqid.encode()
+        frames = annuls = 0
+        try:
+            for tid_, payload in self.journal.scan_entries():
+                if tid_ < boot or needle not in payload:
+                    continue
+                try:
+                    doc = json.loads(payload)
+                except ValueError:
+                    continue
+                if doc.get("reqid") != reqid:
+                    continue
+                if doc.get("op") == "__annul__":
+                    annuls += 1
+                else:
+                    frames += 1
+        except IOError:
+            return False
+        return frames - annuls - 1 > 0    # minus our own attempt
 
     def _journal_and_apply(self, op: str, args: Dict,
                            reqid: str = ""):
@@ -225,7 +281,25 @@ class MDSDaemon:
         if reqid:
             ev["reqid"] = reqid
         tid = self.journal.append(_j(ev))
-        out = self._apply(op, args)
+        try:
+            out = self._apply(op, args)
+        except FsError as e:
+            # duplicate-apply fence: a deposed incumbent can land a
+            # mutation in OUR journal after our startup scan (the
+            # dual-writer window before it fences).  An already-
+            # exists-class failure is a duplicate iff the reqid has an
+            # APPLIED frame besides ours (frames minus annuls minus
+            # our own attempt) — answer from effect like the memo path
+            if e.result in (-17, -2, -39) and reqid and \
+                    self._applied_elsewhere(reqid):
+                self.journal.commit("mds", tid)
+                self._remember(reqid)
+                return self._replayed_reply(op, args)
+            # record the failure so no later consumer (startup memo,
+            # replay, fence) mistakes this attempt's frame for effect
+            self.journal.append(_j({"op": "__annul__", "for": tid,
+                                    "reqid": reqid}))
+            raise
         self.journal.commit("mds", tid)
         if reqid:
             self._remember(reqid)

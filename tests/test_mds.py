@@ -220,3 +220,57 @@ def test_tell_mds_commands(world):
             cl.mds_command(mds.name, "no-such-command")
     finally:
         g_conf.set_val("osd_heartbeat_grace", before)
+
+
+def test_dual_writer_duplicate_fence(world):
+    """The deposed-incumbent race: daemon A lands a mutation in the
+    shared journal AFTER daemon B's startup scan; B answering a
+    client retry must detect the duplicate by re-scanning the journal
+    and reply from effect — never EEXIST (the under-load
+    multi-active flake's root cause)."""
+    c, mds, fa, fb = world
+    # B's incarnation scans the journal NOW (no /race entry yet)
+    mdsB = MDSDaemon(c.network, c.client("client.mdsFence"), "mds.0")
+    # A (the soon-deposed incumbent) steals the entity name back —
+    # the real race's shape: the old holder still serving while B
+    # already finished its startup scan
+    mds.messenger = c.network.create_messenger("mds.0")
+    mds.messenger.add_dispatcher_head(mds)
+    out1 = fa._request("mkdir", path="/race", _reqid="client.a#99")
+    # failover completes: B owns the name from here on
+    mdsB.messenger = c.network.create_messenger("mds.0")
+    mdsB.messenger.add_dispatcher_head(mdsB)
+    # the client's failover retry lands on B, whose memo predates A's
+    # append: the journal re-scan fence must answer from effect
+    f2 = RemoteCephFS(c.client("client.a9"))
+    f2._drive = lambda: mdsB.process()
+    out2 = f2._request("mkdir", path="/race", _reqid="client.a#99")
+    assert out2.get("replayed") and out2["ino"] == out1["ino"]
+    # a DIFFERENT reqid is a genuine conflict: still EEXIST
+    with pytest.raises(FsError) as ei:
+        f2._request("mkdir", path="/race", _reqid="client.a#100")
+    assert ei.value.result == -17
+
+
+def test_failed_attempt_retry_stays_failed(world):
+    """A genuinely-failing op retried with its original reqid must
+    KEEP failing: the failed attempt's journal frame carries an
+    __annul__ record, so neither the duplicate fence nor a restarted
+    daemon's memo can mistake it for applied effect."""
+    c, mds, fa, fb = world
+    fa._request("mkdir", path="/owned")        # someone else's dir
+    with pytest.raises(FsError) as e1:
+        fa._request("mkdir", path="/owned", _reqid="client.a#501")
+    assert e1.value.result == -17
+    # the failover-retry shape: same reqid again -> STILL -17
+    with pytest.raises(FsError) as e2:
+        fa._request("mkdir", path="/owned", _reqid="client.a#501")
+    assert e2.value.result == -17
+    # a restarted incarnation must not remember the failed reqid as
+    # applied either
+    mds2 = MDSDaemon(c.network, c.client("client.mdsAnnul"), "mds.0")
+    f2 = RemoteCephFS(c.client("client.a11"))
+    f2._drive = lambda: mds2.process()
+    with pytest.raises(FsError) as e3:
+        f2._request("mkdir", path="/owned", _reqid="client.a#501")
+    assert e3.value.result == -17
