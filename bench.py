@@ -1,31 +1,35 @@
-"""Headline benchmark: EC encode throughput, k=8 m=4, 1 MiB objects.
+"""Headline benchmark driver: EC encode/decode + CRUSH remap, k=8 m=4.
 
-Mirrors the reference harness semantics (`ceph_erasure_code_benchmark -p isa
--P k=8 -P m=4 -S 1048576 -w encode`, src/test/erasure-code/
-ceph_erasure_code_benchmark.cc:150-189): GiB/s of object data erasure-coded.
-The device path batches S objects' stripes into one (S, k, C) device call
-(the whole point — the reference encodes object-by-object on the CPU).
+Thin survivability shell over the ``ceph_tpu.bench`` subsystem, which
+owns ALL measurement mechanics: completion-fenced timers (the clock
+stops only after a device→host drain of the last output — dispatch
+acknowledgements are not completions over a tunnelled transport),
+warmup/repeat statistics (median/IQR/min), a roofline validator that
+stamps ``suspect: true`` on any reading implying more than the chip's
+physical peak, and the versioned metric schema.  See
+docs/BENCHMARKING.md for the methodology.
 
-Baseline = the native C++ 4-bit split-table region coder
-(native/gf_rs.cpp, the isa-l ec_encode_data-class host path) measured on
-this machine.
-
-Survivability contract (the driver kills this process with an external
-timeout; three rounds of TPU evidence were lost to that):
-  - ONE overall wall-clock budget (CEPH_TPU_BENCH_BUDGET, default 480 s)
-    covers probing AND measuring; sections are skipped when the budget is
-    nearly exhausted instead of overrunning.
-  - The JSON result line is (re-)printed after EVERY completed section —
-    a kill at any moment leaves a parseable last line on stdout with
-    whatever was measured so far.
+What stays HERE is the survivability contract (the driver kills this
+process with an external timeout; three rounds of TPU evidence were
+lost to that):
+  - ONE overall wall-clock budget (CEPH_TPU_BENCH_BUDGET, default
+    480 s) covers probing AND measuring; sections are skipped when the
+    budget is nearly exhausted instead of overrunning.
+  - The JSON result line is (re-)printed after EVERY completed section
+    with ``"partial": true``; only the final complete emit flips it to
+    false — a kill at any moment leaves a parseable last line on stdout
+    that is distinguishable from a finished run.
   - A dedicated sigwait() watcher thread dumps the partial line on
     SIGTERM/SIGINT even while the main thread is blocked inside a
-    tunnelled remote compile (Python-level signal handlers only run on
-    the main thread between bytecodes, so a plain handler would never
-    fire there); a deadline watchdog thread covers budget overrun.
-  - The TPU tunnel (axon PJRT) can be dead or hang on backend init, so the
-    device backend is probed in a subprocess with a timeout before this
-    process ever imports jax; probe retries are bounded by the budget.
+    tunnelled remote compile; a deadline watchdog covers budget
+    overrun.
+  - The TPU tunnel (axon PJRT) can be dead or hang on backend init, so
+    the device backend is probed in a subprocess with a timeout before
+    this process ever imports jax.
+
+Legacy flat keys (value, ec_decode_e2_gibs, crush_remap_*) are kept so
+the BENCH_r*.json trajectory stays field-compatible; the new
+schema-versioned records ride alongside under ``"metrics"``.
 """
 from __future__ import annotations
 
@@ -63,23 +67,34 @@ RESULT: dict = {
     "value": 0.0,
     "unit": "GiB/s",
     "vs_baseline": None,
+    "partial": True,
+    "metrics": [],
 }
 _ERRORS: list[str] = []
 _SKIPPED: list[str] = []
 
 
-def _emit() -> None:
+def _emit(final: bool = False) -> None:
     """(Re-)print the result line with everything measured so far.
+
+    ``partial`` stays true on every milestone re-print and on watcher/
+    watchdog dumps; only the one complete end-of-run emit flips it to
+    false, so a kill mid-run is distinguishable from a finished line
+    even though both re-print identical measurement keys.
 
     Serializes a snapshot: this runs from the watcher/watchdog threads
     while the main thread may be inserting keys, and json.dumps over a
-    mutating dict raises mid-dump."""
+    mutating container raises mid-dump."""
+    if final:
+        RESULT["partial"] = False
     if _ERRORS:
         RESULT["error"] = "; ".join(list(_ERRORS))
     if _SKIPPED:
         RESULT["skipped_sections"] = ",".join(list(_SKIPPED))
     RESULT["elapsed_s"] = round(time.monotonic() - _T0, 1)
-    sys.stdout.write(json.dumps(dict(RESULT)) + "\n")
+    snap = dict(RESULT)
+    snap["metrics"] = list(RESULT["metrics"])
+    sys.stdout.write(json.dumps(snap) + "\n")
     sys.stdout.flush()
 
 
@@ -179,269 +194,6 @@ def probe_accelerator() -> str | None:
         time.sleep(PROBE_RETRY_DELAY)
 
 
-def measure_host(matrix: np.ndarray, data2d: np.ndarray) -> float:
-    """GiB/s of the native C++ path on one (k, C) object."""
-    from ceph_tpu.native import native_rs_encode, native_available
-    if not native_available():
-        return 0.0
-    rows = matrix[K:]
-    native_rs_encode(rows, data2d)  # warm tables
-    n, t0 = 0, time.perf_counter()
-    while time.perf_counter() - t0 < TARGET_SECONDS / 2:
-        native_rs_encode(rows, data2d)
-        n += 1
-    dt = time.perf_counter() - t0
-    return n * OBJECT_SIZE / dt / (1 << 30)
-
-
-def _salted_matmul_step():
-    """One shared jitted (payload ^ salt) @ bits step.
-
-    Salting with a never-repeating per-iteration scalar means no layer
-    (XLA or a tunnelled PJRT shim) can serve a repeat dispatch from
-    cache: every iteration is a genuinely new execution.  (Without this,
-    repeat dispatches of identical inputs measured 3-10x above the
-    chip's int8-MXU compute floor — a cache, not the hardware.)  The
-    full 32-bit salt is xored across u32 lanes so the input never
-    repeats within a run — a uint8 salt would cycle every 256 iters.
-    """
-    import jax
-    import jax.numpy as jnp
-    from ceph_tpu.ops.gf_matmul import gf_bit_matmul
-
-    @jax.jit
-    def step(d, b, salt):
-        s_, k_, c_ = d.shape
-        d32 = jax.lax.bitcast_convert_type(
-            d.reshape(s_, k_, c_ // 4, 4), jnp.uint32)
-        d8 = jax.lax.bitcast_convert_type(
-            d32 ^ salt, jnp.uint8).reshape(s_, k_, c_)
-        return gf_bit_matmul(d8, b)
-
-    return step
-
-
-_STEP = None
-
-
-def _step_fn():
-    global _STEP
-    if _STEP is None:
-        _STEP = _salted_matmul_step()
-    return _STEP
-
-
-def measure_device(matrix: np.ndarray, batch: np.ndarray) -> float:
-    """GiB/s of the jitted device path on (S, k, C) batches."""
-    import jax
-    import jax.numpy as jnp
-    from ceph_tpu.gf.tables import expand_to_bitmatrix
-
-    bits = jnp.asarray(expand_to_bitmatrix(matrix[K:]).astype(np.int8))
-    dev = jax.device_put(jnp.asarray(batch))
-    step = _step_fn()
-    step(dev, bits, jnp.uint32(0)).block_until_ready()  # compile + warm
-    n, t0 = 0, time.perf_counter()
-    while time.perf_counter() - t0 < TARGET_SECONDS:
-        step(dev, bits, jnp.uint32(n + 1)).block_until_ready()
-        n += 1
-    dt = time.perf_counter() - t0
-    return n * BATCH * OBJECT_SIZE / dt / (1 << 30)
-
-
-def measure_decode(matrix: np.ndarray, batch: np.ndarray,
-                   erasures: int = 2) -> float:
-    """GiB/s of the device decode path with *erasures* data shards lost
-    (the reference's ``-w decode -e 2``): reconstruct the missing data
-    chunks from k survivors via the signature-cached inverted bitmatrix
-    (ErasureCodeIsa decode + table cache role).
-
-    The survivor payload here is random: the GF matmul's timing is
-    data-independent, and a large device->host fetch mid-run flips this
-    tunnelled transport into a sync-dispatch mode that poisons every
-    later measurement in the process (measured: 137 us -> 81 ms per
-    dispatch after one 16 MB fetch).  Correctness on REAL coded data is
-    verified separately by parity_check(), which runs LAST for exactly
-    that reason."""
-    import jax
-    import jax.numpy as jnp
-    from ceph_tpu.ops.gf_matmul import DeviceRSBackend
-
-    be = DeviceRSBackend(matrix)
-    lost = tuple(range(erasures))                   # data shards 0..e-1
-    srcs = tuple(range(erasures, K)) + tuple(K + i for i in range(erasures))
-    bits = be._decode_bits_for(srcs, lost)
-    dev = jax.device_put(jnp.asarray(batch))        # (S, k, C) survivors
-    step = _step_fn()
-    step(dev, bits, jnp.uint32(0)).block_until_ready()
-    n, t0 = 0, time.perf_counter()
-    while time.perf_counter() - t0 < TARGET_SECONDS:
-        step(dev, bits, jnp.uint32(n + 1)).block_until_ready()
-        n += 1
-    dt = time.perf_counter() - t0
-    return n * BATCH * OBJECT_SIZE / dt / (1 << 30)
-
-
-def parity_check(matrix: np.ndarray) -> bool:
-    """Encode REAL data on device, erase two data shards, decode on
-    device, fetch, byte-compare against the original.  This is the
-    on-hardware correctness receipt for the decode throughput number;
-    it involves device->host fetches, so it must be the LAST section
-    (sync-dispatch poisoning no longer matters)."""
-    from ceph_tpu.ops.gf_matmul import DeviceRSBackend
-    rng = np.random.default_rng(20260731)
-    data = rng.integers(0, 256, size=(2, K, 4096), dtype=np.uint8)
-    be = DeviceRSBackend(matrix)
-    coding = be.encode(data)                         # (2, m, C) fetched
-    lost = (0, 1)
-    srcs = tuple(range(2, K)) + (K, K + 1)
-    survivors = np.concatenate([data[:, 2:, :], coding[:, :2, :]], axis=1)
-    got = be.decode_data(survivors, srcs, lost)      # (2, 2, C)
-    return bool(np.array_equal(got, data[:, :2, :]))
-
-
-def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
-                        uniform=True, partial=None, infix=""):
-    """The <50 ms north star: remap ALL PGs after an epoch change.
-
-    The workload is OSDMapMapping's per-epoch job (OSDMapMapping.h:17): the
-    crush topology is unchanged (candidate tables cached on device), one
-    osd flips out per epoch (new weight vector), and the resolution kernel
-    re-derives every PG's mapping.  Reported:
-      - wall: full map_batch (device resolve + transfer + host compaction
-        + exact residual replay) per epoch, median over ``epochs``;
-      - device: sustained resolve-kernel time amortized over back-to-back
-        dispatches (what a pipelined consumer pays per epoch).
-    """
-    import jax
-    import jax.numpy as jnp
-    from ceph_tpu.crush import CrushWrapper, CRUSH_BUCKET_STRAW2
-    from ceph_tpu.ops.crush_fast import compile_fast_rule
-    per_host = 20
-    cw = CrushWrapper()
-    cw.set_type_name(1, "host")
-    cw.set_type_name(10, "root")
-    hosts = []
-    rng_w = np.random.default_rng(7)
-    for h in range(n_osds // per_host):
-        osds = list(range(h * per_host, (h + 1) * per_host))
-        if uniform:
-            ws = [0x10000] * per_host
-        else:
-            # heterogeneous drives: the exact64 draw path (u64 table
-            # divide, zero residuals; f32+replay when a backend can't
-            # lower u64), not the quotient tables
-            ws = [int(v) * 0x8000
-                  for v in rng_w.integers(1, 5, size=per_host)]
-        hosts.append(cw.add_bucket(CRUSH_BUCKET_STRAW2, 1, f"host{h}",
-                                   osds, ws, id=-(h + 2)))
-    cw.set_max_devices(n_osds)
-    cw.add_bucket(CRUSH_BUCKET_STRAW2, 10, "default", hosts,
-                  [0x10000 * per_host] * len(hosts), id=-1)
-    rno = cw.add_simple_rule("data", "default", "host", mode="firstn")
-    xs = np.arange(n_pgs, dtype=np.uint32)
-    w = np.full(n_osds, 0x10000, dtype=np.uint32)
-
-    dbg = os.environ.get("CEPH_TPU_BENCH_DEBUG")
-    tmark = time.monotonic()
-
-    def mark(label: str) -> None:
-        nonlocal tmark
-        if dbg:
-            now = time.monotonic()
-            print(f"[crush-bench] {label}: {now - tmark:.1f}s",
-                  file=sys.stderr)
-            tmark = now
-
-    def report(**kv) -> None:
-        # milestone callback: the caller re-emits the JSON line, so a
-        # watchdog kill later in the section cannot erase what this
-        # section already measured (the remap north star must survive
-        # a budget overrun in a LATER phase).  *infix* keeps the
-        # uniform and nonuniform sections' keys distinct.
-        if partial is not None:
-            partial({k.replace("@", infix): v for k, v in kv.items()})
-
-    # the native-host baseline first: pure C++, no tunnel exposure —
-    # worst case the device phases die and the line still carries it
-    host_ms = None
-    try:
-        from ceph_tpu.native import NativeCrushMapper, native_available
-        if native_available():
-            nm = NativeCrushMapper(cw.crush)
-            w0 = [0x10000] * n_osds
-            sample = 2000
-            t0 = time.perf_counter()
-            nm.do_rule_batch(rno, list(range(sample)), 3, w0)
-            host_ms = (time.perf_counter() - t0) \
-                * (n_pgs / sample) * 1000
-            if uniform:
-                report(crush_remap_native_host_ms=round(host_ms, 2))
-    except Exception:
-        pass
-    mark("native host baseline")
-
-    fr = compile_fast_rule(cw.crush, rno, 3)
-    mark("compile_fast_rule (host tables)")
-    fr.map_batch(xs, w)  # compile + candidate tables + warm (full fetch)
-    mark("map_batch warm #1 (cand+resolve compiles)")
-    wwarm = w.copy()
-    wwarm[1] = 0
-    fr.map_batch(xs, wwarm)  # warm the delta-path trace/compile too
-    mark("map_batch warm #2 (delta compile)")
-    # per-epoch wall time: one osd out per epoch.  map_batch's delta path
-    # fetches only changed rows, so the wall is one resolve + one small
-    # device->host transfer (OSDMapMapping's per-epoch job).
-    walls = []
-    for e in range(epochs):
-        w2 = w.copy()
-        w2[(7 * e + 3) % n_osds] = 0
-        t0 = time.perf_counter()
-        fr.map_batch(xs, w2)
-        walls.append(time.perf_counter() - t0)
-    wall_ms = sorted(walls)[len(walls) // 2] * 1000
-    report(**{"crush_remap@_pgs": n_pgs,
-              "crush_remap@_wall_ms": round(wall_ms, 2),
-              "crush@_residual_fraction": fr.residual_fraction})
-    mark("per-epoch wall loop")
-    # device->host round-trip floor of this transport (tunnelled PJRT
-    # pays ~100 ms here; local PCIe pays ~0) so wall_ms is interpretable
-    tiny = jnp.zeros((8,), jnp.int32) + jnp.int32(1)
-    jax.block_until_ready(tiny)
-    t0 = time.perf_counter()
-    np.asarray(tiny)
-    rtt_ms = (time.perf_counter() - t0) * 1000
-    # sustained device resolve time: back-to-back dispatches drained by
-    # fetching one element of the LAST output.  PJRT executes in
-    # submission order, so that fetch completing means every dispatch
-    # completed — block_until_ready alone is not trustworthy over a
-    # tunnelled transport (it can acknowledge before remote completion).
-    # The fetch round trip itself is subtracted via the measured rtt.
-    wds = []
-    for e in range(epochs):
-        w2 = w.copy()
-        w2[(13 * e + 29) % n_osds] = 0
-        wds.append(jnp.asarray(w2))
-    np.asarray(fr.resolve_device(wds[0])[0][0, 0])   # warm + drain
-    mark("resolve_device warm")
-    t0 = time.perf_counter()
-    outs = [fr.resolve_device(wd) for wd in wds]
-    np.asarray(outs[-1][0][0, 0])
-    total = (time.perf_counter() - t0) * 1000
-    mark("sustained resolve loop")
-    # subtracting the fetch rtt can hit zero when the resolves are
-    # faster than one round trip; fall back to the un-subtracted upper
-    # bound so the metric never reads as "didn't run"
-    dev_ms = max(total - rtt_ms, 0.0) / len(wds)
-    if dev_ms == 0.0:
-        dev_ms = total / len(wds)
-    kv = {"crush_remap@_us": round(dev_ms * 1000.0, 2)}
-    if uniform:
-        kv["transport_rtt_ms"] = round(rtt_ms, 2)
-    report(**kv)
-    return wall_ms, dev_ms, host_ms, fr.residual_fraction, rtt_ms
-
-
 def main() -> None:
     signal.pthread_sigmask(signal.SIG_BLOCK,
                            {signal.SIGTERM, signal.SIGINT})
@@ -481,6 +233,7 @@ def main() -> None:
     except Exception as e:  # pragma: no cover - catastrophic env breakage
         _ERRORS.append(f"jax import failed: {e!r}")
 
+    from ceph_tpu.bench import workloads
     from ceph_tpu.gf.matrices import gf_gen_rs_matrix
     rng = np.random.default_rng(1234)
     matrix = gf_gen_rs_matrix(K + M, K)
@@ -488,8 +241,12 @@ def main() -> None:
 
     host_gibs = 0.0
     try:
-        host_gibs = measure_host(matrix, batch[0])
-        RESULT["host_native_gibs"] = round(host_gibs, 3)
+        hm = workloads.measure_host_native(
+            matrix, batch[0], target_seconds=TARGET_SECONDS / 2)
+        if hm is not None:
+            host_gibs = hm["value"]
+            RESULT["host_native_gibs"] = round(host_gibs, 3)
+            RESULT["metrics"].append(hm)
     except Exception as e:
         _ERRORS.append(f"host bench failed: {e!r}")
     _emit()
@@ -514,14 +271,23 @@ def main() -> None:
         _emit()
 
     def encode_section() -> None:
-        dev_gibs = measure_device(matrix, batch)
-        RESULT["value"] = round(dev_gibs, 3)
+        m = workloads.measure_encode(
+            matrix, batch, target_seconds=TARGET_SECONDS,
+            repeats=3 if platform else 2)
+        RESULT["metrics"].append(m)
+        # headline value = the FENCED median; the roofline verdict and
+        # implied TOPS ride inside the metric record
+        RESULT["value"] = m["value"]
+        RESULT["encode_suspect"] = m["suspect"]
         if host_gibs:
-            RESULT["vs_baseline"] = round(dev_gibs / host_gibs, 2)
+            RESULT["vs_baseline"] = round(m["value"] / host_gibs, 2)
 
     def decode_section() -> None:
-        RESULT["ec_decode_e2_gibs"] = round(
-            measure_decode(matrix, batch), 3)
+        m = workloads.measure_decode(
+            matrix, batch, target_seconds=TARGET_SECONDS,
+            repeats=3 if platform else 2)
+        RESULT["metrics"].append(m)
+        RESULT["ec_decode_e2_gibs"] = m["value"]
 
     def _partial(kv: dict) -> None:
         # milestone flush: remap numbers hit the JSON line the moment
@@ -535,47 +301,38 @@ def main() -> None:
                 host / (us / 1000.0), 2)
         _emit()
 
-    def crush_section() -> None:
-        # STABLE metric keys across rounds/platforms: the workload
-        # size lives in crush_remap_pgs, never in the key name, so
-        # r(N) and r(N+1) JSON lines stay field-compatible even when
-        # a CPU fallback shrinks the workload.  The partial path is
-        # the ONE writer of the remap keys (milestone flushes; see
-        # _partial) — microseconds so "fast" and "didn't run" can
-        # never be confused.
-        n_pgs = 100_000 if platform else 10_000
-        measure_crush_remap(n_pgs=n_pgs,
-                            epochs=10 if platform else 2,
-                            partial=_partial)
-
-    def crush_nonuniform_section() -> None:
-        # the <50 ms target on a 2-level map with NON-uniform weights:
-        # exercises the exact64 draw; same milestone flushing with
-        # the _nonuniform key infix
-        n_pgs = 100_000 if platform else 10_000
-        measure_crush_remap(n_pgs=n_pgs,
-                            epochs=10 if platform else 2,
-                            uniform=False, partial=_partial,
-                            infix="_nonuniform")
+    def crush_section(uniform: bool = True, infix: str = "") -> None:
+        # STABLE metric keys across rounds/platforms: the workload size
+        # lives in crush_remap_pgs, never in the key name, so r(N) and
+        # r(N+1) JSON lines stay field-compatible even when a CPU
+        # fallback shrinks the workload.
+        *_ignored, ms = workloads.measure_crush_remap(
+            n_pgs=100_000 if platform else 10_000,
+            epochs=10 if platform else 2,
+            uniform=uniform, partial=_partial, infix=infix,
+            debug=bool(os.environ.get("CEPH_TPU_BENCH_DEBUG")))
+        RESULT["metrics"].extend(ms)
 
     def parity_section() -> None:
-        RESULT["decode_parity"] = parity_check(matrix)
+        RESULT["decode_parity"] = workloads.parity_check(matrix)
 
     # Ordered so a budget kill costs the least AND so the dispatch-
     # timing sections run before anything does a large device->host
     # fetch: the crush sections' 100k-row map_batch fetches flip the
     # tunnelled transport into sync-dispatch mode (~80 ms/dispatch),
     # which poisoned a decode bench run after them (measured 0.76 GiB/s
-    # vs 313-627 clean).  So: encode, decode (both pure dispatch), then
-    # the remap north star, then extras, then the fetch-heavy parity
-    # receipt dead last.  min_needed gates reflect that a cold-cache
-    # section pays a tunnelled XLA compile (minutes); with the
-    # persistent cache warm they're seconds.
+    # vs 313-627 clean).  So: encode, decode (both drain via one-element
+    # fetches only), then the remap north star, then extras, then the
+    # fetch-heavy parity receipt dead last.  min_needed gates reflect
+    # that a cold-cache section pays a tunnelled XLA compile (minutes);
+    # with the persistent cache warm they're seconds.
     run_section("device bench", encode_section, 45.0)
     run_section("decode bench", decode_section, 45.0)
-    run_section("crush bench", crush_section, 110.0)
-    run_section("crush nonuniform bench", crush_nonuniform_section, 80.0)
+    run_section("crush bench", lambda: crush_section(True), 110.0)
+    run_section("crush nonuniform bench",
+                lambda: crush_section(False, "_nonuniform"), 80.0)
     run_section("decode parity", parity_section, 45.0)
+    _emit(final=True)
 
 
 if __name__ == "__main__":
@@ -589,6 +346,7 @@ if __name__ == "__main__":
             print(json.dumps({
                 "metric": "ec_encode_k8m4_1MiB_throughput",
                 "value": 0.0, "unit": "GiB/s", "vs_baseline": None,
+                "partial": True,
                 "error": f"bench crashed: {e!r}",
             }))
         raise SystemExit(1)

@@ -29,6 +29,19 @@ from typing import Any, Dict
 _cache: Dict[str, Any] = {}
 
 
+def enable_x64():
+    """The x64-trace context manager, wherever this jax release keeps
+    it: top-level ``jax.enable_x64`` on newer releases,
+    ``jax.experimental.enable_x64`` on 0.4.x.  Every exact-s64/u64
+    kernel trace goes through here so one jax upgrade can't silently
+    break the integer-exact paths."""
+    import jax
+    fn = getattr(jax, "enable_x64", None)
+    if fn is None:
+        from jax.experimental import enable_x64 as fn
+    return fn(True)
+
+
 def probe(refresh: bool = False) -> Dict[str, Any]:
     global _cache
     if _cache and not refresh:
@@ -54,7 +67,7 @@ def probe(refresh: bool = False) -> Dict[str, Any]:
     try:
         import jax.numpy as jnp
         import numpy as np
-        with jax.enable_x64(True):
+        with enable_x64():
             v = jax.jit(lambda a: a * a)(
                 jnp.asarray(np.int64(3_000_000_019)))
             out["x64"] = int(v) == 3_000_000_019 ** 2

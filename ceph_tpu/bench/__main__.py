@@ -1,0 +1,134 @@
+"""``python -m ceph_tpu.bench`` — run the fenced harness standalone.
+
+Modes:
+  --smoke       CPU, tiny shapes, seconds-fast: proves the harness
+                itself (fence, stats, roofline, schema, gate) end to
+                end.  Wired into the test suite so every PR regression-
+                tests the measurement machinery.  The CRUSH remap
+                workload is excluded here — its XLA compiles alone blow
+                a seconds-scale budget on CPU; the survivability driver
+                (repo-root bench.py) owns it.
+  (default)     full fenced EC encode/decode + parity on whatever
+                backend jax selects.
+
+  --gate off|warn|fail   compare fenced metrics against the archived
+                BENCH_r*.json trajectory (regress.py); "fail" exits 2
+                on a regression beyond --tolerance.
+
+Output: ONE JSON line on stdout carrying schema-valid metrics; human
+progress goes to stderr.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m ceph_tpu.bench")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU, tiny shapes, seconds-fast harness check")
+    ap.add_argument("--gate", choices=("off", "warn", "fail"),
+                    default="warn")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="relative regression tolerance (default 0.30)")
+    ap.add_argument("--repeats", type=int, default=None)
+    ap.add_argument("--root", default=None,
+                    help="repo root holding BENCH_r*.json (default: "
+                         "two levels above this package)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        # must land before any jax import in this process
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    t0 = time.monotonic()
+    import numpy as np
+    import jax
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+
+    from . import regress
+    from .workloads import (bench_perf_counters, measure_decode,
+                            measure_encode, measure_host_native,
+                            parity_check)
+    from ..gf.matrices import gf_gen_rs_matrix
+
+    K, M = 8, 4
+    if args.smoke:
+        batch_s, chunk = 2, 8192
+        target_s, repeats, warmup = 0.3, (args.repeats or 2), 1
+    else:
+        batch_s, chunk = 64, 1 << 17
+        target_s, repeats, warmup = 3.0, (args.repeats or 3), 1
+
+    rng = np.random.default_rng(1234)
+    matrix = gf_gen_rs_matrix(K + M, K)
+    batch = rng.integers(0, 256, size=(batch_s, K, chunk),
+                         dtype=np.uint8)
+
+    result = {
+        "schema_version": 1,
+        "mode": "smoke" if args.smoke else "full",
+        "platform": jax.devices()[0].platform,
+        "metrics": [],
+    }
+
+    def progress(msg: str) -> None:
+        print(f"[bench] {msg}", file=sys.stderr)
+
+    progress(f"platform={result['platform']} "
+             f"batch=({batch_s},{K},{chunk})")
+    rc = 0
+    try:
+        m = measure_encode(matrix, batch, target_seconds=target_s,
+                           repeats=repeats, warmup=warmup)
+        result["metrics"].append(m)
+        progress(f"encode {m['value']} GiB/s fenced "
+                 f"(roofline: {m['roofline']['verdict']})")
+        m = measure_decode(matrix, batch, target_seconds=target_s,
+                           repeats=repeats, warmup=warmup)
+        result["metrics"].append(m)
+        progress(f"decode {m['value']} GiB/s fenced "
+                 f"(roofline: {m['roofline']['verdict']})")
+        host = measure_host_native(matrix, batch[0],
+                                   target_seconds=0.3 if args.smoke
+                                   else 1.5)
+        if host is not None:
+            result["metrics"].append(host)
+        result["decode_parity"] = parity_check(matrix)
+        if not result["decode_parity"]:
+            rc = 1
+    except Exception as e:
+        result["error"] = repr(e)
+        rc = 1
+
+    if args.gate != "off":
+        root = args.root or os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        traj = regress.load_trajectory(root)
+        gate = regress.compare_against_trajectory(
+            result["metrics"], traj, result["platform"],
+            tolerance=(args.tolerance
+                       if args.tolerance is not None
+                       else regress.DEFAULT_TOLERANCE))
+        result["gate"] = gate
+        for r in gate["regressions"]:
+            progress(f"REGRESSION {r['name']}: {r['value']} vs "
+                     f"r{r['baseline_round']} baseline {r['baseline']} "
+                     f"({r['change']:+.0%})")
+        if gate["regressions"] and args.gate == "fail":
+            rc = max(rc, 2)
+
+    result["perf"] = bench_perf_counters().dump()
+    result["elapsed_s"] = round(time.monotonic() - t0, 1)
+    sys.stdout.write(json.dumps(result) + "\n")
+    sys.stdout.flush()
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

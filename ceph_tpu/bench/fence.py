@@ -1,0 +1,138 @@
+"""Completion-fenced timing: the clock stops only after outputs exist
+on the host.
+
+Why ``block_until_ready`` is not enough here: over a tunnelled PJRT
+transport the ready acknowledgement can arrive before remote execution
+completes, so a loop that fences each step with ``block_until_ready``
+still measures dispatch rate, not compute (bench.py's crush section
+documented this in round 4; round 5's verdict proved the encode numbers
+it produced were physically impossible).  The only fence this transport
+honors is a device→host readback: PJRT executes in submission order, so
+fetching one element of the LAST output means every dispatch before it
+completed on the device.
+
+Accounting contract: the fenced elapsed time INCLUDES one transport
+round trip (the drain fetch).  That RTT is measured separately and
+reported alongside — never silently subtracted — so a reader can bound
+the pure-compute time as ``elapsed - rtt <= compute <= elapsed`` and
+the number stays honest on both a 70 ms tunnel and a microsecond PCIe
+link.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+class FencedTiming:
+    """One fenced measurement: N steps dispatched back-to-back, drained,
+    timed as a unit."""
+
+    __slots__ = ("elapsed_s", "n_steps", "rtt_s", "fenced")
+
+    def __init__(self, elapsed_s: float, n_steps: int, rtt_s: float):
+        self.elapsed_s = elapsed_s
+        self.n_steps = n_steps
+        self.rtt_s = rtt_s
+        self.fenced = True
+
+    @property
+    def per_step_s(self) -> float:
+        return self.elapsed_s / max(self.n_steps, 1)
+
+    def throughput(self, bytes_per_step: int) -> float:
+        """GiB/s of payload through the timed region (fence included)."""
+        return self.n_steps * bytes_per_step / self.elapsed_s / (1 << 30)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"elapsed_s": self.elapsed_s, "n_steps": self.n_steps,
+                "rtt_s": self.rtt_s, "fenced": True}
+
+
+def drain(out: Any) -> None:
+    """Materialize *out* on the host — the completion fence.
+
+    Order matters: ``block_until_ready`` first (cheap, and on a local
+    backend it is the whole fence), then a one-element host fetch, which
+    is the only signal a tunnelled transport cannot fake.  Works on any
+    object exposing the jax Array protocol or plain ``__array__`` —
+    including test doubles that delay materialization.
+    """
+    bur = getattr(out, "block_until_ready", None)
+    if bur is not None:
+        bur()
+    # One-ELEMENT readback, not the full array: a large device→host
+    # fetch over the tunnelled transport flips it into sync-dispatch
+    # mode and poisons every later measurement in the process (measured
+    # 137 us -> 81 ms per dispatch after one 16 MB fetch).  The slice
+    # dispatch is submitted after the timed work, so its completion
+    # implies everything before it completed.
+    try:
+        one = out.ravel()[:1]
+    except Exception:
+        one = out
+    arr = np.asarray(one)
+    if arr.size:
+        arr.ravel()[:1].copy()
+
+
+def measure_rtt(make_tiny: Optional[Callable[[], Any]] = None,
+                repeats: int = 3) -> float:
+    """Median device→host round trip (seconds) for a tiny transfer.
+
+    This is the fence's own cost: ~100 ms over the axon tunnel, ~0 on
+    locally attached hardware.  Reported next to every fenced elapsed
+    time so the reading is interpretable on both.
+    """
+    if make_tiny is None:
+        import jax
+        import jax.numpy as jnp
+
+        def make_tiny():
+            t = jnp.zeros((8,), jnp.int32) + jnp.int32(1)
+            jax.block_until_ready(t)
+            return t
+
+    samples = []
+    for _ in range(max(repeats, 1)):
+        tiny = make_tiny()
+        t0 = time.perf_counter()
+        np.asarray(tiny)
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def fenced_time(step: Callable[[int], Any], n_steps: int,
+                rtt_s: Optional[float] = None,
+                kernel_name: Optional[str] = None) -> FencedTiming:
+    """Dispatch ``step(i)`` for i in [0, n_steps) back-to-back, fence on
+    the LAST output, and time the whole region.
+
+    ``step`` must return the dispatch's output (device array or pytree
+    leaf).  Only the LAST output is retained: a submitted PJRT dispatch
+    executes whether or not its output handle is kept (dropping the
+    handle frees the buffer after execution, it does not cancel it), so
+    retention would buy nothing — and holding all N outputs at the
+    calibrated step count can pin gigabytes of HBM and OOM a real-chip
+    run.  The caller salts the step input by ``i`` so no transport/XLA
+    layer can serve a repeat from cache.
+    """
+    if n_steps < 1:
+        raise ValueError("n_steps must be >= 1")
+    if rtt_s is None:
+        rtt_s = measure_rtt()
+    last: Any = None
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        last = step(i)
+    drain(last)
+    elapsed = time.perf_counter() - t0
+    timing = FencedTiming(elapsed, n_steps, rtt_s)
+    if kernel_name:
+        from ..common.kernel_trace import g_kernel_timer
+        if g_kernel_timer.enabled:
+            g_kernel_timer._record(kernel_name, elapsed)
+    return timing

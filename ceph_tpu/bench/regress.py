@@ -1,0 +1,130 @@
+"""Perf-regression gate over the per-round bench trajectory.
+
+The driver archives one ``BENCH_r<N>.json`` per round: ``{"n": round,
+"rc": ..., "parsed": <last JSON line bench.py printed>}``.  Since this
+subsystem landed, that line embeds a ``metrics`` list of schema records
+(schema.py).  The comparator walks the trajectory newest-first, finds
+the most recent round with a comparable reading (same metric name, same
+platform, fenced, not suspect), and flags a regression when the current
+reading moved beyond tolerance in the bad direction — lower for
+throughputs, higher for times.
+
+Legacy rounds (r01-r05) predate the schema and carry only flat unfenced
+keys; they are never used as a gate baseline (an unfenced dispatch-rate
+number would make every honest fenced number look like a regression).
+
+Configurable fail/warn: CI runs ``python -m ceph_tpu.bench --smoke
+--gate warn`` (a shared-tunnel wobble should not break the build);
+``--gate fail`` exits non-zero for release gating.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+DEFAULT_TOLERANCE = 0.30   # shared-tunnel runs wobble ~25% run-to-run
+
+# units where a larger value is better; any other unit is lower-better
+_HIGHER_BETTER_UNITS = {"GiB/s", "MiB/s", "ops/s"}
+
+
+def load_trajectory(root: str) -> List[Dict[str, Any]]:
+    """All parseable BENCH_r*.json records under *root*, oldest first.
+
+    Each item: {"round": N, "path": ..., "parsed": <dict or None>}.
+    Unreadable or rc-failed rounds still appear (with parsed=None) so
+    the gate can report how far back the baseline is.
+    """
+    out: List[Dict[str, Any]] = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        rec: Dict[str, Any] = {"round": int(m.group(1)), "path": path,
+                               "parsed": None}
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            parsed = data.get("parsed")
+            if isinstance(parsed, dict):
+                rec["parsed"] = parsed
+        except Exception:
+            pass
+        out.append(rec)
+    out.sort(key=lambda r: r["round"])
+    return out
+
+
+def _fenced_metrics(parsed: Optional[Dict[str, Any]]
+                    ) -> Dict[str, Dict[str, Any]]:
+    """name -> schema metric for every gate-eligible reading in one
+    round's parsed line: fenced, not suspect, schema-carrying."""
+    if not parsed:
+        return {}
+    out: Dict[str, Dict[str, Any]] = {}
+    for m in parsed.get("metrics", []) or []:
+        if not isinstance(m, dict) or not m.get("fenced"):
+            continue
+        if m.get("suspect"):
+            continue            # a broken-fence reading gates nothing
+        name = m.get("name")
+        if isinstance(name, str) and name:
+            out[name] = m
+    return out
+
+
+def compare_against_trajectory(
+        current: List[Dict[str, Any]], trajectory: List[Dict[str, Any]],
+        platform: str, tolerance: float = DEFAULT_TOLERANCE
+) -> Dict[str, Any]:
+    """Gate the *current* schema metrics against the newest comparable
+    round per metric.
+
+    Returns {"regressions": [...], "improvements": [...], "compared": N,
+    "no_baseline": [names...]}.  A regression entry carries the metric
+    name, both values, the baseline round, and the relative change.
+    Caller decides warn-vs-fail.
+    """
+    regressions: List[Dict[str, Any]] = []
+    improvements: List[Dict[str, Any]] = []
+    no_baseline: List[str] = []
+    compared = 0
+    for cur in current:
+        if not cur.get("fenced") or cur.get("suspect"):
+            continue
+        name = cur["name"]
+        baseline = None
+        baseline_round = None
+        for rec in reversed(trajectory):
+            parsed = rec["parsed"]
+            if not parsed or parsed.get("platform") != platform:
+                continue
+            prev = _fenced_metrics(parsed).get(name)
+            if prev is not None:
+                baseline, baseline_round = prev, rec["round"]
+                break
+        if baseline is None:
+            no_baseline.append(name)
+            continue
+        compared += 1
+        cur_v, prev_v = float(cur["value"]), float(baseline["value"])
+        higher_better = cur["unit"] in _HIGHER_BETTER_UNITS
+        if prev_v <= 0:
+            continue
+        change = (cur_v - prev_v) / prev_v
+        bad = (change < -tolerance) if higher_better \
+            else (change > tolerance)
+        entry = {"name": name, "unit": cur["unit"], "value": cur_v,
+                 "baseline": prev_v, "baseline_round": baseline_round,
+                 "change": round(change, 4)}
+        if bad:
+            regressions.append(entry)
+        elif (change > tolerance) if higher_better \
+                else (change < -tolerance):
+            improvements.append(entry)
+    return {"regressions": regressions, "improvements": improvements,
+            "compared": compared, "no_baseline": no_baseline,
+            "tolerance": tolerance, "platform": platform}

@@ -338,17 +338,45 @@ class RemoteCephFS:
                                  _target=nxt, _rank=_rank, **args)
         raise FsError(op, -110)                       # ETIMEDOUT
 
+    def _ino_of(self, op: str, rep: Dict, path: str) -> int:
+        """Extract the ino from an ino-returning op's reply.
+
+        A dedup'd duplicate answered "from effect" can arrive as
+        {"replayed": true} WITHOUT an ino when the server's re-resolve
+        raced a subtree repin (mds/server.py _replayed_reply).  The
+        effect exists — recover the id with a read op: stat follows
+        the final component, which is identity for the dir/file the
+        mutation created (symlinks use the nofollow flavor so the
+        link's own ino comes back, not its target's).  A retried
+        mutation could NOT recover (its fresh reqid misses the dedup
+        memo and the server answers EEXIST forever); a stat that races
+        the repin raises a retryable FsError and callers' retry loops
+        converge."""
+        ino = rep.get("ino")
+        if ino is not None:
+            return ino
+        if rep.get("replayed"):
+            nofollow = op == "symlink"
+            return self._request("stat", path=path,
+                                 nofollow=nofollow)["inode"]["ino"]
+        raise FsError(f"{op} (replayed, ino unresolved)", -11)
+
     # ---- metadata surface (all via the MDS) --------------------------------
     def mkdir(self, path: str) -> int:
-        return self._request("mkdir", path=path)["ino"]
+        return self._ino_of("mkdir", self._request("mkdir", path=path),
+                            path)
 
     def create(self, path: str, order: Optional[int] = None) -> int:
         # order None lets the MDS apply the inherited dir layout
         # (an explicit order overrides it, like a file vxattr would)
-        return self._request("create", path=path, order=order)["ino"]
+        return self._ino_of("create",
+                            self._request("create", path=path,
+                                          order=order), path)
 
     def symlink(self, path: str, target: str) -> int:
-        return self._request("symlink", path=path, target=target)["ino"]
+        return self._ino_of("symlink",
+                            self._request("symlink", path=path,
+                                          target=target), path)
 
     def readlink(self, path: str) -> str:
         return self._request("readlink", path=path)["target"]

@@ -1019,6 +1019,11 @@ class MDSDaemon:
         if op == "walk_snapc":
             return self._op_walk_snapc(args)
         if op == "stat":
+            if args.get("nofollow"):
+                # lstat flavor: the client's replayed-symlink ino
+                # recovery must see the link itself, not its target
+                return {"inode": fs._resolve(args["path"],
+                                             follow_final=False)}
             return {"inode": fs.stat(args["path"])}
         if op == "resolve":
             return {"inode": fs._resolve(args["path"],

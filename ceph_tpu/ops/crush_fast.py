@@ -61,6 +61,7 @@ from ..crush.constants import (
     CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES, CRUSH_RULE_SET_CHOOSE_TRIES,
     CRUSH_RULE_TAKE,
 )
+from ..arch import enable_x64
 from ..crush.ln import crush_ln_np
 from ..crush.mapper import crush_do_rule
 from ..crush.types import CrushMap
@@ -1000,7 +1001,7 @@ class FastRule:
         if not self._exact64:
             return self._cand_jit(xd)
         try:
-            with jax.enable_x64(True):
+            with enable_x64():
                 return self._cand_jit(xd)
         except Exception as e:
             # only an UNIMPLEMENTED-class lowering failure means the

@@ -24,3 +24,12 @@ if not os.environ.get("CEPH_TPU_TEST_REAL"):
     # module touched a device yet this reliably lands on the virtual mesh.
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # Persistent compilation cache, shared with bench.py: XLA compiles
+    # dominate the crush device/fast suites on a 1-core box (the exact64
+    # kernels alone cost minutes cold); with the on-disk cache warm the
+    # tier-1 suite fits its wall budget with room to spare.
+    cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

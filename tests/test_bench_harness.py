@@ -1,0 +1,333 @@
+"""The measurement harness measures the measurer.
+
+Round 5's verdict: the published 807 GiB/s encode number was physically
+impossible because the timing loop mistook dispatch acknowledgements
+for completions.  These tests pin the properties that make that class
+of bug structurally impossible again:
+
+- the fenced timer cannot stop before outputs materialize on the host
+  (proved with a delayed-materialization array double that acknowledges
+  ``block_until_ready`` instantly — exactly the tunnelled-PJRT failure
+  mode);
+- any reading whose implied op rate exceeds the chip's physical peak is
+  stamped ``suspect: true``;
+- the schema refuses an exact-0.0 timing (round 5's
+  ``nonuniform_us: 0.0``: "fast" must never read as "didn't run");
+- the regression gate flags fenced metrics that move beyond tolerance
+  against the archived trajectory, and never gates on unfenced or
+  suspect baselines;
+- ``python -m ceph_tpu.bench --smoke`` — the CI tier — exits 0 on CPU
+  in seconds with schema-valid fenced metrics.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.bench import fence, regress, roofline, schema, stats
+
+
+# ---- fence -----------------------------------------------------------------
+
+class DelayedArray:
+    """Array double mimicking a tunnelled PJRT handle: the ready
+    acknowledgement returns instantly, but the value only exists after
+    ``delay`` more seconds of remote execution — observable solely via
+    host readback."""
+
+    def __init__(self, delay_s, t_dispatch):
+        self._ready_at = t_dispatch + delay_s
+        self._payload = np.arange(8, dtype=np.int32)
+
+    def block_until_ready(self):
+        return self            # lies, like the transport does
+
+    def __array__(self, dtype=None, copy=None):
+        now = time.perf_counter()
+        if now < self._ready_at:
+            time.sleep(self._ready_at - now)
+        return self._payload
+
+
+def test_fenced_timer_waits_for_materialization():
+    """The clock must not stop until the last output's bytes exist on
+    the host, even when block_until_ready acknowledges instantly."""
+    DELAY = 0.15
+
+    def step(i):
+        return DelayedArray(DELAY, time.perf_counter())
+
+    timing = fence.fenced_time(step, n_steps=3, rtt_s=0.0)
+    # dispatches are instant; an unfenced timer would read ~0 here.
+    assert timing.elapsed_s >= DELAY * 0.95
+    assert timing.fenced is True
+    assert timing.n_steps == 3
+
+
+def test_drain_touches_host_bytes():
+    done = {"materialized": False}
+
+    class Probe:
+        def block_until_ready(self):
+            return self
+
+        def __array__(self, dtype=None, copy=None):
+            done["materialized"] = True
+            return np.zeros(4, dtype=np.int32)
+
+    fence.drain(Probe())
+    assert done["materialized"]
+
+
+def test_fenced_time_on_real_backend():
+    """End-to-end on the CPU backend: jit dispatch, drain, sane fields."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x, s: x * s)
+    x = jnp.arange(1024, dtype=jnp.int32)
+    timing = fence.fenced_time(lambda i: f(x, jnp.int32(i + 1)), 4)
+    assert timing.elapsed_s > 0.0
+    assert timing.rtt_s >= 0.0
+    d = timing.to_dict()
+    assert d["fenced"] is True and d["n_steps"] == 4
+
+
+def test_measure_rtt_custom_maker():
+    rtt = fence.measure_rtt(lambda: np.ones(8, dtype=np.int32), repeats=3)
+    assert 0.0 <= rtt < 1.0
+
+
+# ---- roofline --------------------------------------------------------------
+
+def test_roofline_flags_above_peak_reading():
+    """807 GiB/s on a v5e implies ~444 int8 TOPS > 394 peak — the exact
+    round-5 bogus headline must come back stamped suspect."""
+    v = roofline.validate_reading(807.0, roofline.EC_ENCODE_K8M4,
+                                  "tpu", "TPU v5 lite")
+    assert v["suspect"] is True
+    assert v["verdict"] == "suspect"
+    assert v["implied_tops"] > v["peak_tops"]
+
+
+def test_roofline_passes_physical_reading():
+    v = roofline.validate_reading(300.0, roofline.EC_ENCODE_K8M4,
+                                  "tpu", "TPU v5 lite")
+    assert v["suspect"] is False
+    assert v["verdict"] == "ok"
+    assert 0.0 < v["mfu"] < 1.0
+
+
+def test_roofline_memory_axis_trips_too():
+    # 500 GiB/s of object data = 750 GiB/s of HBM traffic on the encode
+    # model — fine for v5e compute but well past a 600 GiB/s host
+    v = roofline.validate_reading(500.0, roofline.EC_ENCODE_K8M4, "cpu")
+    assert v["suspect"] is True
+
+
+def test_roofline_unknown_backend_never_ok():
+    v = roofline.validate_reading(100.0, roofline.EC_ENCODE_K8M4,
+                                  "rocm", "gfx90a")
+    assert v["verdict"] == "unknown"
+    assert v["suspect"] is False and v["peak_tops"] is None
+
+
+def test_chip_spec_lookup():
+    assert roofline.chip_spec("tpu", "TPU v5 lite")["int8_tops"] == 394.0
+    assert roofline.chip_spec("tpu", "TPU v4")["int8_tops"] == 275.0
+    assert roofline.chip_spec("cpu")["int8_tops"] == 2.0
+    # unknown TPU generation: most permissive known peak, never None
+    assert roofline.chip_spec("tpu", "")["int8_tops"] >= 394.0
+
+
+# ---- stats -----------------------------------------------------------------
+
+def test_summarize_median_iqr():
+    st = stats.summarize([1.0, 2.0, 3.0, 4.0, 5.0])
+    assert st["median"] == 3.0
+    assert st["iqr"] == 2.0
+    assert st["min"] == 1.0 and st["max"] == 5.0 and st["n"] == 5
+
+
+def test_repeat_measure_discards_warmup():
+    vals = iter([100.0, 1.0, 2.0, 3.0])   # first sample is compile cost
+    st = stats.repeat_measure(lambda: next(vals), repeats=3, warmup=1)
+    assert st["median"] == 2.0            # 100.0 excluded
+    assert st["warmup_samples"] == [100.0]
+    assert st["samples"] == [1.0, 2.0, 3.0]
+
+
+# ---- schema ----------------------------------------------------------------
+
+def test_make_metric_roundtrip():
+    m = schema.make_metric(
+        "x_gibs", 12.5, "GiB/s", fenced=True, rtt_s=0.07,
+        stats=stats.summarize([12.0, 12.5, 13.0]),
+        roofline=roofline.validate_reading(
+            12.5, roofline.EC_ENCODE_K8M4, "cpu"))
+    schema.validate_metric(m)
+    assert m["fenced"] is True and m["rtt_ms"] == 70.0
+    assert m["stats"]["n"] == 3
+    assert m["suspect"] is m["roofline"]["suspect"]
+
+
+def test_schema_rejects_exact_zero_timing():
+    """A 0.0 reading in a time/throughput unit means 'didn't run' — the
+    round-5 nonuniform_us:0.0 line must be unpublishable."""
+    with pytest.raises(schema.SchemaError, match="0.0"):
+        schema.make_metric("crush_remap_device", 0.0, "us", fenced=True)
+
+
+def test_schema_rejects_missing_fence_field():
+    with pytest.raises(schema.SchemaError):
+        schema.validate_metric({"schema_version": 1, "name": "x",
+                                "value": 1.0, "unit": "GiB/s"})
+
+
+def test_schema_suspect_must_mirror_roofline():
+    m = schema.make_metric(
+        "x", 807.0, "GiB/s", fenced=True,
+        roofline=roofline.validate_reading(
+            807.0, roofline.EC_ENCODE_K8M4, "tpu", "TPU v5 lite"))
+    assert m["suspect"] is True
+    m["suspect"] = False       # tamper
+    with pytest.raises(schema.SchemaError):
+        schema.validate_metric(m)
+
+
+# ---- regression gate -------------------------------------------------------
+
+def _write_round(tmp_path, n, platform, metrics):
+    rec = {"n": n, "rc": 0,
+           "parsed": {"platform": platform, "metrics": metrics}}
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(rec))
+
+
+def _metric(name, value, unit="GiB/s", fenced=True, suspect=False):
+    m = schema.make_metric(name, value, unit, fenced=fenced)
+    if suspect:   # hand-build: make_metric would need a roofline dict
+        m["suspect"] = True
+    return m
+
+
+def test_gate_flags_throughput_regression(tmp_path):
+    _write_round(tmp_path, 6, "cpu", [_metric("enc", 10.0)])
+    traj = regress.load_trajectory(str(tmp_path))
+    out = regress.compare_against_trajectory(
+        [_metric("enc", 5.0)], traj, "cpu", tolerance=0.3)
+    assert len(out["regressions"]) == 1
+    assert out["regressions"][0]["baseline_round"] == 6
+    assert out["regressions"][0]["change"] == -0.5
+
+
+def test_gate_time_metrics_are_lower_better(tmp_path):
+    _write_round(tmp_path, 6, "cpu", [_metric("remap", 10.0, unit="ms")])
+    traj = regress.load_trajectory(str(tmp_path))
+    out = regress.compare_against_trajectory(
+        [_metric("remap", 20.0, unit="ms")], traj, "cpu")
+    assert len(out["regressions"]) == 1
+    out = regress.compare_against_trajectory(
+        [_metric("remap", 5.0, unit="ms")], traj, "cpu")
+    assert not out["regressions"] and len(out["improvements"]) == 1
+
+
+def test_gate_within_tolerance_passes(tmp_path):
+    _write_round(tmp_path, 6, "cpu", [_metric("enc", 10.0)])
+    traj = regress.load_trajectory(str(tmp_path))
+    out = regress.compare_against_trajectory(
+        [_metric("enc", 8.0)], traj, "cpu", tolerance=0.3)
+    assert not out["regressions"] and out["compared"] == 1
+
+
+def test_gate_ignores_unfenced_and_suspect_baselines(tmp_path):
+    # legacy-style round: flat keys only, no schema metrics
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps(
+        {"n": 5, "parsed": {"platform": "cpu", "value": 999.0}}))
+    # a suspect reading must never become the gate baseline either
+    _write_round(tmp_path, 6, "cpu",
+                 [_metric("enc", 999.0, suspect=True)])
+    traj = regress.load_trajectory(str(tmp_path))
+    out = regress.compare_against_trajectory(
+        [_metric("enc", 5.0)], traj, "cpu")
+    assert out["compared"] == 0
+    assert out["no_baseline"] == ["enc"]
+
+
+def test_gate_platform_mismatch_is_no_baseline(tmp_path):
+    _write_round(tmp_path, 6, "tpu", [_metric("enc", 500.0)])
+    traj = regress.load_trajectory(str(tmp_path))
+    out = regress.compare_against_trajectory(
+        [_metric("enc", 0.01)], traj, "cpu")
+    assert out["compared"] == 0 and not out["regressions"]
+
+
+def test_load_trajectory_orders_and_survives_junk(tmp_path):
+    (tmp_path / "BENCH_r02.json").write_text("not json {")
+    _write_round(tmp_path, 10, "cpu", [])
+    _write_round(tmp_path, 3, "cpu", [])
+    traj = regress.load_trajectory(str(tmp_path))
+    assert [r["round"] for r in traj] == [2, 3, 10]
+    assert traj[0]["parsed"] is None
+
+
+# ---- the CI smoke tier -----------------------------------------------------
+
+def test_smoke_mode_end_to_end():
+    """`python -m ceph_tpu.bench --smoke` is the per-PR harness check:
+    exit 0 on CPU, one schema-valid JSON line, fenced metrics with
+    stats and a roofline verdict, in well under 30 s of measured time."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.bench", "--smoke"],
+        capture_output=True, text=True, timeout=180, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert p.returncode == 0, p.stderr[-2000:]
+    line = [ln for ln in p.stdout.splitlines() if ln.strip()][-1]
+    out = json.loads(line)
+    assert out["mode"] == "smoke" and out["platform"] == "cpu"
+    assert out["elapsed_s"] < 30.0
+    assert out["decode_parity"] is True
+    names = set()
+    for m in out["metrics"]:
+        schema.validate_metric(m)
+        names.add(m["name"])
+        assert m["fenced"] is True
+        assert {"median", "iqr", "min"} <= set(m["stats"])
+        assert m["roofline"]["verdict"] in ("ok", "suspect", "unknown")
+    assert {"ec_encode_k8m4_fenced", "ec_decode_k8m4_e2_fenced"} <= names
+    # the gate ran (warn mode) and the observability counters moved
+    assert "gate" in out
+    assert out["perf"]["dispatches"] > 0
+    assert out["perf"]["fences"] > 0
+
+
+def test_workload_metrics_in_process():
+    """measure_encode/decode produce schema-valid fenced metrics on the
+    test backend (tiny shapes — this is a harness test, not a perf
+    run), and the shared kernel timer sees the fenced regions when
+    tracing is enabled."""
+    from ceph_tpu.bench import workloads
+    from ceph_tpu.common.kernel_trace import g_kernel_timer
+    from ceph_tpu.gf.matrices import gf_gen_rs_matrix
+
+    rng = np.random.default_rng(7)
+    matrix = gf_gen_rs_matrix(12, 8)
+    batch = rng.integers(0, 256, size=(2, 8, 4096), dtype=np.uint8)
+    g_kernel_timer.enable(True)
+    try:
+        m = workloads.measure_encode(matrix, batch, target_seconds=0.2,
+                                     repeats=2, warmup=1)
+        schema.validate_metric(m)
+        assert m["fenced"] is True and m["value"] > 0
+        m2 = workloads.measure_decode(matrix, batch, target_seconds=0.2,
+                                      repeats=2, warmup=1)
+        schema.validate_metric(m2)
+        assert "bench_encode_fenced" in g_kernel_timer.dump()
+    finally:
+        g_kernel_timer.enable(False)
+        g_kernel_timer.reset()
+    assert workloads.parity_check(matrix) is True

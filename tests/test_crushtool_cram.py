@@ -39,11 +39,14 @@ EXCLUDED = {"output-csv.t"}
 # mappers by tests/test_reference_golden.py; the whole-file replays
 # were verified green this round and stay runnable via
 # CEPH_TPU_CRAM_FULL=1.
-HEAVY = {t for t in os.listdir(CDIR)
+# listdir must not run at import when the reference tree is absent —
+# the skipif mark only guards test execution, not module collection
+_TS = os.listdir(CDIR) if os.path.isdir(CDIR) else []
+HEAVY = {t for t in _TS
          if t.startswith("test-map-")} | {"straw2.t", "set-choose.t"}
 FULL = os.environ.get("CEPH_TPU_CRAM_FULL") == "1"
 
-ALL_TS = sorted(t for t in os.listdir(CDIR)
+ALL_TS = sorted(t for t in _TS
                 if t.endswith(".t") and t not in EXCLUDED
                 and (FULL or t not in HEAVY))
 

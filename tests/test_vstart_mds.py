@@ -34,6 +34,9 @@ def _retrying(fn, timeout=150.0):
             time.sleep(0.5)
 
 
+@pytest.mark.slow   # ~50 s of real-process spin-up/kill/replay; the same
+# contract is exercised in tier-1 by test_multi_active_subtrees_and_per_rank
+# _failover (kill -9 + journal replay of rank 1) and in-process test_mds.py
 def test_mds_sigkill_replay_recovers(cluster):
     c = cluster
     cl = c.client("client.x")
@@ -68,6 +71,8 @@ def ha_cluster():
     c.close()
 
 
+@pytest.mark.slow   # ~45 s; standby promotion + replay is also covered by
+# the multi-active per-rank failover test that stays in tier-1
 def test_mds_standby_takeover(ha_cluster):
     """MDS HA (MDSMonitor + standby daemons): two mds processes beacon
     to the mon; the first is active, the second stands by.  SIGKILL
