@@ -17,6 +17,8 @@ Subpackages
                        ECBackend-style rmw + recovery, memstore
 - ``ceph_tpu.msg``     messenger fabric: in-process + TCP transports, wire codec
 - ``ceph_tpu.cluster`` vstart-lite single-process mini-cluster
+- ``ceph_tpu.trace``   observability: cross-daemon spans, perf histograms,
+                       slow-op flight recorder
 - ``ceph_tpu.parallel``device mesh / sharding helpers (dp over stripes, tp over
                        shards, multi-host ready)
 - ``ceph_tpu.tools``   crushtool / osdmaptool / ec benchmark CLI equivalents

@@ -124,6 +124,10 @@ def main(argv=None) -> int:
             rc = max(rc, 2)
 
     result["perf"] = bench_perf_counters().dump()
+    # histogram metric lines: the same perf-histogram surface the admin
+    # socket's `perf histogram dump` serves, scoped to this bench run
+    from ..trace import g_perf_histograms
+    result["perf_histograms"] = g_perf_histograms.dump("bench")
     result["elapsed_s"] = round(time.monotonic() - t0, 1)
     sys.stdout.write(json.dumps(result) + "\n")
     sys.stdout.flush()

@@ -124,13 +124,26 @@ def fenced_time(step: Callable[[int], Any], n_steps: int,
         raise ValueError("n_steps must be >= 1")
     if rtt_s is None:
         rtt_s = measure_rtt()
+    from ..trace import g_perf_histograms, g_tracer, latency_axes
+    span = g_tracer.begin(
+        f"bench_fence:{kernel_name or 'fenced'}") if g_tracer.enabled \
+        else None
     last: Any = None
     t0 = time.perf_counter()
-    for i in range(n_steps):
-        last = step(i)
-    drain(last)
+    with g_tracer.activate(span):
+        for i in range(n_steps):
+            last = step(i)
+        drain_span = g_tracer.begin("drain") if span is not None else None
+        drain(last)
+        g_tracer.finish(drain_span)
     elapsed = time.perf_counter() - t0
+    g_tracer.finish(span)
     timing = FencedTiming(elapsed, n_steps, rtt_s)
+    # per-step latency lands in the always-on bench histogram so
+    # `python -m ceph_tpu.bench` metric lines carry the distribution
+    g_perf_histograms.get("bench", "fenced_step_latency_histogram",
+                          latency_axes).inc(
+        elapsed / n_steps * 1e6)
     if kernel_name:
         from ..common.kernel_trace import g_kernel_timer
         if g_kernel_timer.enabled:

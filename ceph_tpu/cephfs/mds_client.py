@@ -263,6 +263,7 @@ class RemoteCephFS:
     def _request(self, op: str, _refind: bool = True,
                  _reqid: str = "", _target: str = "",
                  _hops: int = 0, _rank: Optional[int] = None,
+                 _trace: Optional[tuple] = None,
                  **args):
         if self._auto and not self.mds:
             self.mds = self._resolve_mds()
@@ -284,8 +285,30 @@ class RemoteCephFS:
         # a promoted standby that replayed the dead active's journal
         # can recognize an already-applied mutation
         reqid = _reqid or f"{self.client.name}#{tid}"
+        from ..msg.messages import new_trace_id
+        from ..trace import g_tracer
+        # ONE trace per logical request: forward/failover retries reuse
+        # the root's (trace_id, span_id) so the hops stitch into one
+        # tree, mirroring rados.py's retry contract
+        span = None
+        if _trace is None:
+            span = g_tracer.begin(f"fs_request:{op}",
+                                  daemon=self.client.name,
+                                  trace_id=new_trace_id())
+            _trace = (span.trace_id, span.span_id) \
+                if span is not None else (0, 0)
         self.client.messenger.send_message(MClientRequest(
-            tid=tid, op=op, args=args, reqid=reqid), target)
+            tid=tid, op=op, args=args, reqid=reqid,
+            trace_id=_trace[0], parent_span_id=_trace[1]), target)
+        try:
+            return self._await_reply(op, args, tid, reqid, target,
+                                     hint_key, _refind, _hops, _rank,
+                                     _trace)
+        finally:
+            g_tracer.finish(span)
+
+    def _await_reply(self, op, args, tid, reqid, target, hint_key,
+                     _refind, _hops, _rank, _trace):
         import time as _time
         for attempt in range(MAX_ATTEMPTS):
             self.client.network.pump()
@@ -312,7 +335,7 @@ class RemoteCephFS:
                     return self._request(op, _refind=_refind,
                                          _reqid=reqid, _target=nxt,
                                          _hops=_hops + 1, _rank=rank,
-                                         **args)
+                                         _trace=_trace, **args)
                 if rep.result < 0:
                     raise FsError(op, rep.result)
                 self._last_mds = target
@@ -335,7 +358,8 @@ class RemoteCephFS:
             except FsError:
                 nxt = ""
             return self._request(op, _refind=False, _reqid=reqid,
-                                 _target=nxt, _rank=_rank, **args)
+                                 _target=nxt, _rank=_rank,
+                                 _trace=_trace, **args)
         raise FsError(op, -110)                       # ETIMEDOUT
 
     def _ino_of(self, op: str, rep: Dict, path: str) -> int:

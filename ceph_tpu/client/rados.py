@@ -263,6 +263,25 @@ class RadosClient(Dispatcher):
                 data: bytes = b"", offset: int = 0, length: int = 0,
                 ops: Optional[list] = None,
                 snapid: int = 0) -> MOSDOpReply:
+        # ONE trace id for the logical op: resend attempts are the same
+        # op (the reference's ZTracer trace survives Objecter retries),
+        # and the client's root span parents every daemon-side child
+        from ..trace import g_tracer
+        trace_id = new_trace_id()
+        span = g_tracer.begin(f"client_op:{op or 'vector'}:{oid}",
+                              daemon=self.name, trace_id=trace_id)
+        try:
+            with g_tracer.activate(span):
+                return self._submit_attempts(
+                    pool_id, oid, op, data, offset, length, ops, snapid,
+                    trace_id, span.span_id if span is not None else 0)
+        finally:
+            g_tracer.finish(span)
+
+    def _submit_attempts(self, pool_id: int, oid: str, op: str,
+                         data: bytes, offset: int, length: int,
+                         ops: Optional[list], snapid: int,
+                         trace_id: int, span_id: int) -> MOSDOpReply:
         for attempt in range(MAX_ATTEMPTS):
             pgid, primary = self._calc_target(pool_id, oid)
             self._tid += 1
@@ -275,7 +294,8 @@ class RadosClient(Dispatcher):
                              ops=list(ops) if ops else [],
                              snapid=snapid,
                              snapc_seq=sc_seq, snapc_snaps=list(sc_snaps),
-                             trace_id=new_trace_id())
+                             trace_id=trace_id,
+                             parent_span_id=span_id)
                 self.messenger.send_message(msg, f"osd.{primary}")
                 self.network.pump()
             reply = self._replies.pop(tid, None)

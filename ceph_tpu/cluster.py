@@ -163,6 +163,11 @@ class MiniCluster:
                           for o in self.osds.values()},
             "recent completed ops with event timelines")
         asok.register(
+            "dump_historic_slow_ops",
+            lambda c, a: {o.name: o.op_tracker.dump_historic_slow_ops()
+                          for o in self.osds.values()},
+            "ops over complaint_time, with flight-recorded span trees")
+        asok.register(
             "dump_ops_in_flight",
             lambda c, a: {o.name: o.op_tracker.dump_ops_in_flight()
                           for o in self.osds.values()},
@@ -173,11 +178,35 @@ class MiniCluster:
             "balancer optimize",
             lambda c, a: {"changes": self.mgr.balancer_optimize()},
             "run one upmap balancer pass")
+        from .common import g_kernel_timer
+        from .trace import g_flight_recorder, g_perf_histograms, g_tracer
         asok.register(
             "prometheus metrics",
             lambda c, a: self.mgr.prometheus_metrics(
-                self.perf_collection),
+                self.perf_collection,
+                histograms=g_perf_histograms,
+                kernel_timer=g_kernel_timer,
+                slow_ops={o.name: o.op_tracker.num_slow_ops
+                          for o in self.osds.values()}),
             "prometheus text exposition")
+        asok.register(
+            "perf histogram dump",
+            lambda c, a: g_perf_histograms.dump(
+                a.get("logger", ""), a.get("name", "")),
+            "dump 1D/2D perf histograms (axes + count grids)")
+        asok.register(
+            "dump_tracing",
+            lambda c, a: {"enabled": g_tracer.enabled,
+                          "spans": g_tracer.collector.dump(
+                              a.get("daemon", "")),
+                          "flight_recorder": g_flight_recorder.dump()},
+            "recent spans per daemon + slow-op flight recorder")
+        asok.register(
+            "span tracing",
+            lambda c, a: (g_tracer.enable(
+                str(a.get("on", "1")).lower() in ("1", "true", "on")),
+                {"enabled": g_tracer.enabled})[1],
+            "enable/disable span tracing (host-side; zero device syncs)")
         asok.register(
             "pg_autoscale status",
             lambda c, a: self.mgr.pg_autoscale(apply=False),

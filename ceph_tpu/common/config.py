@@ -123,6 +123,14 @@ def build_options() -> List[Option]:
         Option("tracing_kernels", OPT_BOOL).set_default(False)
         .set_description("time every device kernel dispatch (adds a "
                          "sync per call; diagnosis only)"),
+        Option("tracing_spans", OPT_BOOL).set_default(False)
+        .set_description("collect parent/child op spans end to end "
+                         "(trace/ package; host-side only, adds zero "
+                         "device syncs — safe to leave on)"),
+        Option("op_complaint_time", OPT_FLOAT).set_default(30.0)
+        .set_description("ops slower than this land in the slow-op "
+                         "history + flight recorder (reference "
+                         "osd_op_complaint_time, options.cc)"),
         # daemon-identity path options (reference options.cc defaults,
         # with the same $cluster/$name metavariables -- ceph-conf
         # expands them per name; pinned by src/test/cli/ceph-conf)

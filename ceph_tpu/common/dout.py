@@ -156,3 +156,6 @@ def register_config_observers(config) -> None:
     from .kernel_trace import g_kernel_timer
     config.add_observer("tracing_kernels",
                         lambda _n, v: g_kernel_timer.enable(bool(v)))
+    from ..trace import g_tracer
+    config.add_observer("tracing_spans",
+                        lambda _n, v: g_tracer.enable(bool(v)))

@@ -43,13 +43,23 @@ class PerfCounters:
 
     # ---- updates ----------------------------------------------------------
     def inc(self, idx: int, amount: int = 1) -> None:
+        """Bump a counter; ``count`` (the avgcount denominator) only
+        moves for LONGRUNAVG counters, matching the reference's inc()
+        (perf_counters.cc) — plain u64 counters must keep count == 0 so
+        any future average over them isn't skewed by an inc-only,
+        dec-never denominator."""
         c = self._by_idx[idx]
         with self._lock:
             c.value += amount
-            c.count += 1
+            if c.type & PERFCOUNTER_LONGRUNAVG:
+                c.count += 1
 
     def dec(self, idx: int, amount: int = 1) -> None:
+        """Reference semantics: dec() asserts on LONGRUNAVG counters
+        and never touches avgcount — symmetric with inc() above."""
         c = self._by_idx[idx]
+        assert not (c.type & PERFCOUNTER_LONGRUNAVG), \
+            "dec() on a LONGRUNAVG counter (perf_counters.cc asserts)"
         with self._lock:
             c.value -= amount
 

@@ -23,6 +23,11 @@ class TrackedOp:
         self.initiated_at = tracker.now()
         self.events: List[Tuple[float, str]] = []
         self.completed_at: Optional[float] = None
+        # observability hooks: the daemon's span for this op (set by the
+        # dispatch path when the tracer is on) and the flight-recorder
+        # entry pinning its span tree once the op proves slow
+        self.span = None
+        self.flight = None
 
     def mark_event(self, event: str) -> None:
         self.events.append((self.tracker.now(), event))
@@ -61,7 +66,22 @@ class OpTracker:
         self._history: Deque[TrackedOp] = deque(maxlen=history_size)
         self._slow: Deque[TrackedOp] = deque(maxlen=history_size)
         self._lock = threading.Lock()
-        self.complaint_time = 30.0
+        self._complaint_override: Optional[float] = None
+
+    @property
+    def complaint_time(self) -> float:
+        """Live view of op_complaint_time: a runtime `config set` (or
+        injectargs) takes effect on the next completion — no observer
+        plumbing per tracker instance needed.  Direct assignment (tests,
+        embedders) pins an explicit override."""
+        if self._complaint_override is not None:
+            return self._complaint_override
+        from .config import g_conf
+        return float(g_conf.get_val("op_complaint_time"))
+
+    @complaint_time.setter
+    def complaint_time(self, v: float) -> None:
+        self._complaint_override = float(v)
 
     def create_request(self, trace_id: int, description: str) -> TrackedOp:
         op = TrackedOp(self, trace_id, description)
@@ -74,8 +94,21 @@ class OpTracker:
         with self._lock:
             self._inflight.pop(op.trace_id, None)
             self._history.append(op)
-            if op.duration > self.complaint_time:
+            slow = op.duration > self.complaint_time
+            if slow:
                 self._slow.append(op)
+        if slow:
+            # flight-record the span tree NOW: ring eviction in the
+            # collector must not be able to dismember a slow op's trace
+            # before anyone dumps it.  Span objects are pinned by
+            # reference, so spans still open here (the client's root)
+            # close in place before a later dump reads them.
+            from ..trace import g_flight_recorder, g_tracer
+            if g_tracer.enabled and op.trace_id:
+                spans = g_tracer.collector.spans_for_trace(op.trace_id)
+                if spans:
+                    op.flight = g_flight_recorder.record(
+                        op.trace_id, op.description, op.duration, spans)
 
     def dump_ops_in_flight(self) -> dict:
         with self._lock:
@@ -89,5 +122,19 @@ class OpTracker:
                 "duration": self.history_duration, "ops": ops}
 
     def dump_historic_slow_ops(self) -> dict:
+        """Slow ops with their flight-recorded span trees (the
+        reference's dump_historic_slow_ops, grown the ZTracer view)."""
         with self._lock:
-            return {"ops": [o.dump() for o in self._slow]}
+            ops = list(self._slow)
+        out = []
+        for o in ops:
+            d = o.dump()
+            if o.flight is not None:
+                d["span_tree"] = o.flight.tree()
+            out.append(d)
+        return {"ops": out}
+
+    @property
+    def num_slow_ops(self) -> int:
+        with self._lock:
+            return len(self._slow)

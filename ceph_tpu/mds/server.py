@@ -92,8 +92,14 @@ class MDSDaemon:
                  mkfs: bool = False, session_timeout: float = 20.0,
                  rank: int = 0):
         from ..journal import Journaler
+        from ..trace import g_perf_histograms, latency_axes
         self.network = network
         self.name = name
+        # per-daemon request-latency histogram, resolved once (same
+        # pattern as OSD.hist_op_w — keeps the registry lock off the
+        # per-request hot path)
+        self._hist_req = g_perf_histograms.get(
+            name, "req_latency_histogram", latency_axes)
         # ALL dispatch-visible state must exist before the messenger
         # registration: construction below does rados IO whose pumps
         # can deliver client requests to ms_fast_dispatch mid-__init__
@@ -559,6 +565,23 @@ class MDSDaemon:
             tid=msg.tid, result=result, data=data or {}), msg.src)
 
     def _handle_request(self, msg: MClientRequest) -> None:
+        """Instrumented intake: every request lands one sample in the
+        per-daemon request-latency histogram, and (tracer on) runs
+        under a span parented by the client's (Server.cc
+        handle_client_request's mds_server perf counters + blkin
+        trace role)."""
+        from ..trace import g_tracer
+        t0 = time.perf_counter()
+        if g_tracer.enabled:
+            with g_tracer.span(f"mds_req:{msg.op}", daemon=self.name,
+                               trace_id=msg.trace_id,
+                               parent_id=msg.parent_span_id):
+                self._do_handle_request(msg)
+        else:
+            self._do_handle_request(msg)
+        self._hist_req.inc((time.perf_counter() - t0) * 1e6)
+
+    def _do_handle_request(self, msg: MClientRequest) -> None:
         op, args = msg.op, dict(msg.args)
         try:
             reqid = getattr(msg, "reqid", "")

@@ -50,7 +50,14 @@ class MgrHttp:
                 return None
 
         if parts == ["metrics"]:
-            text = self.mgr.prometheus_metrics(self.perf_collection)
+            from ..common import g_kernel_timer
+            from ..trace import g_perf_histograms
+            slow = {o.name: o.op_tracker.num_slow_ops
+                    for o in self.cluster.osds.values()} \
+                if self.cluster is not None else None
+            text = self.mgr.prometheus_metrics(
+                self.perf_collection, histograms=g_perf_histograms,
+                kernel_timer=g_kernel_timer, slow_ops=slow)
             return 200, {"Content-Type":
                          "text/plain; version=0.0.4"}, text.encode()
         if not parts or parts == ["health"]:

@@ -301,9 +301,21 @@ class Manager(Dispatcher):
         }
 
     # ---- prometheus module -------------------------------------------------
-    def prometheus_metrics(self, perf_collection=None) -> str:
+    @staticmethod
+    def _prom_name(raw: str) -> str:
+        """Sanitize to the exposition-format name charset."""
+        import re
+        return re.sub(r"[^a-zA-Z0-9_:]", "_", raw)
+
+    def prometheus_metrics(self, perf_collection=None, histograms=None,
+                           kernel_timer=None, slow_ops=None) -> str:
         """Prometheus text exposition of cluster gauges + perf counters
-        (pybind/mgr/prometheus/module.py role)."""
+        (pybind/mgr/prometheus/module.py role), grown the observability
+        surfaces: ``histograms`` (a PerfHistogramCollection) renders as
+        real ``# TYPE ... histogram`` families with cumulative
+        ``_bucket{le=...}`` series over the latency axis (usec buckets
+        exported as seconds), ``kernel_timer`` as dispatch-total
+        counters, and ``slow_ops`` ({daemon: count}) as gauges."""
         s = self.status()
         lines: List[str] = []
 
@@ -325,7 +337,62 @@ class Manager(Dispatcher):
                 for cname, val in sorted(counters.items()):
                     if not isinstance(val, (int, float)):
                         continue
-                    metric = f"{logger}_{cname}".replace(".", "_")
+                    metric = self._prom_name(f"{logger}_{cname}")
                     lines.append(
                         f"ceph_daemon_{metric} {val}")
+        if histograms is not None:
+            lines.extend(self._render_histograms(histograms))
+        if kernel_timer is not None:
+            stats = kernel_timer.dump()
+            if stats:
+                lines.append("# HELP ceph_kernel_dispatch_seconds_total "
+                             "cumulative device dispatch wall time")
+                lines.append(
+                    "# TYPE ceph_kernel_dispatch_seconds_total counter")
+                for kname, st in sorted(stats.items()):
+                    lines.append(
+                        f'ceph_kernel_dispatch_seconds_total'
+                        f'{{kernel="{self._prom_name(kname)}"}} '
+                        f'{st["total_s"]}')
+                lines.append("# HELP ceph_kernel_dispatch_calls_total "
+                             "device dispatches timed")
+                lines.append(
+                    "# TYPE ceph_kernel_dispatch_calls_total counter")
+                for kname, st in sorted(stats.items()):
+                    lines.append(
+                        f'ceph_kernel_dispatch_calls_total'
+                        f'{{kernel="{self._prom_name(kname)}"}} '
+                        f'{st["calls"]}')
+        if slow_ops is not None:
+            lines.append("# HELP ceph_daemon_slow_ops ops slower than "
+                         "complaint_time in the flight recorder")
+            lines.append("# TYPE ceph_daemon_slow_ops gauge")
+            for daemon, n in sorted(slow_ops.items()):
+                lines.append(f'ceph_daemon_slow_ops'
+                             f'{{daemon="{self._prom_name(daemon)}"}} {n}')
         return "\n".join(lines) + "\n"
+
+    def _render_histograms(self, histograms) -> List[str]:
+        """One Prometheus histogram family per histogram NAME, a series
+        per daemon (label), buckets cumulative over the latency axis."""
+        by_name: Dict[str, List] = {}
+        for (logger, hname), hist in histograms.items():
+            by_name.setdefault(hname, []).append((logger, hist))
+        out: List[str] = []
+        for hname in sorted(by_name):
+            base = self._prom_name(f"ceph_{hname}")
+            out.append(f"# HELP {base} latency distribution "
+                       f"(axis buckets exported as seconds)")
+            out.append(f"# TYPE {base} histogram")
+            for logger, hist in sorted(by_name[hname]):
+                label = self._prom_name(logger)
+                for edge, cum in hist.cumulative_axis0():
+                    le = "+Inf" if edge == float("inf") \
+                        else repr(edge / 1e6)
+                    out.append(f'{base}_bucket{{daemon="{label}",'
+                               f'le="{le}"}} {cum}')
+                out.append(f'{base}_sum{{daemon="{label}"}} '
+                           f'{hist.axis0_sum / 1e6}')
+                out.append(f'{base}_count{{daemon="{label}"}} '
+                           f'{hist.total_count}')
+        return out

@@ -24,6 +24,11 @@ def new_trace_id() -> int:
 class Message:
     src: str = ""
     trace_id: int = 0
+    # the sender's active span (trace/span.py): receivers open child
+    # spans under it, giving cross-daemon span trees — the blkin
+    # parent-handle half of the Message.h:254 trace slot.  0 = no
+    # parent (tracing off or a root message).
+    parent_span_id: int = 0
 
     def name(self) -> str:
         return type(self).__name__

@@ -118,6 +118,12 @@ def decode_blob(buf: bytes) -> Any:
 def encode_message(msg: M.Message) -> bytes:
     """Message -> framed bytes (class name + field dict)."""
     fields: Dict[str, Any] = dict(vars(msg))
+    if not fields.get("parent_span_id"):
+        # optional tracing header: only on the wire when set, so frames
+        # with tracing off — and the archived encoding corpus — stay
+        # byte-identical to the pre-tracing format (decode fills the
+        # dataclass default 0)
+        fields.pop("parent_span_id", None)
     if isinstance(msg, M.MOSDMap):
         from ..osdmap.encoding import incremental_to_dict
         fields["incrementals"] = [incremental_to_dict(i)

@@ -30,6 +30,7 @@ ECBackend.cc:1022-1066).
 from __future__ import annotations
 
 import struct
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
@@ -40,6 +41,7 @@ from ..msg import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply,
 )
+from ..trace import g_perf_histograms, g_tracer, latency_in_bytes_axes
 from ..os_store import MemStore, Transaction, hobject_t
 from ..utils.crc32c import crc32c
 from .ecutil import HashInfo, decode as ec_decode, \
@@ -178,6 +180,11 @@ class RMWOp:
     offset: Optional[int]         # None = append at current size
     on_commit: Callable[[int], None]
     old_size: int = -1
+    # the submitting op's span, captured at ENQUEUE time: a queued op
+    # starts from _op_done (the sub-write-reply dispatch context, no
+    # span active), so reading the thread-current span at start time
+    # would trace contended ops — the slow ones — as orphans
+    parent_span: object = None
 
 
 @dataclass
@@ -188,6 +195,7 @@ class FullWriteOp:
     on_commit: Callable[[int], None]
     xattrs: Optional[Dict[str, bytes]] = None   # full user-attr replacement
     snapset_update: Optional[Tuple[str, bytes]] = None
+    parent_span: object = None    # see RMWOp.parent_span
 
 
 @dataclass
@@ -205,6 +213,7 @@ class VectorOp:
     oid: str
     run: Callable
     meta_only: bool = False   # no body op: fetch attrs from one shard
+    parent_span: object = None    # see RMWOp.parent_span
 
 
 class ECBackend:
@@ -222,6 +231,15 @@ class ECBackend:
         self.extent_cache = ExtentCache()
         self._oid_queues: Dict[str, Deque] = {}
         self._tid = 0
+        # batched-codec latency x bytes distributions, per daemon
+        # (dumped under `perf histogram dump` next to the op hists)
+        name = pg.osd.name
+        self.hist_encode = g_perf_histograms.get(
+            name, "ec_encode_latency_in_bytes_histogram",
+            latency_in_bytes_axes)
+        self.hist_decode = g_perf_histograms.get(
+            name, "ec_decode_latency_in_bytes_histogram",
+            latency_in_bytes_axes)
 
     # ---- helpers ----------------------------------------------------------
     def next_tid(self) -> int:
@@ -248,6 +266,38 @@ class ECBackend:
         rem = len(data) % w
         return data if not rem else data + b"\0" * (w - rem)
 
+    # ---- instrumented codec entry points ----------------------------------
+    def _encode(self, data: bytes) -> Dict[int, np.ndarray]:
+        """The one batched-encode funnel: span (tracer on) + latency x
+        bytes histogram (always).  Host-side wall clock only — the
+        encode itself already materializes chunks for the wire, so no
+        extra device sync is introduced."""
+        t0 = time.perf_counter()
+        if g_tracer.enabled:
+            with g_tracer.span("ec_encode") as sp:
+                if sp is not None:      # enable() can race the check
+                    sp.tags["bytes"] = len(data)
+                shards = ec_encode(self.sinfo, self.ec_impl, data,
+                                   set(range(self.n)))
+        else:
+            shards = ec_encode(self.sinfo, self.ec_impl, data,
+                               set(range(self.n)))
+        self.hist_encode.inc((time.perf_counter() - t0) * 1e6, len(data))
+        return shards
+
+    def _decode_timed(self, nbytes: int, fn, *args):
+        """Shared decode instrumentation (concat + shard-recovery)."""
+        t0 = time.perf_counter()
+        if g_tracer.enabled:
+            with g_tracer.span("ec_decode") as sp:
+                if sp is not None:      # enable() can race the check
+                    sp.tags["bytes"] = nbytes
+                out = fn(*args)
+        else:
+            out = fn(*args)
+        self.hist_decode.inc((time.perf_counter() - t0) * 1e6, nbytes)
+        return out
+
     # ---- per-object write pipeline ----------------------------------------
     def _enqueue(self, oid: str, op) -> None:
         q = self._oid_queues.setdefault(oid, deque())
@@ -267,12 +317,16 @@ class ECBackend:
             self.extent_cache.clear(oid)
 
     def _start_op(self, op) -> None:
-        if isinstance(op, FullWriteOp):
-            self._start_full_write(op)
-        elif isinstance(op, VectorOp):
-            self._start_vector(op)
-        else:
-            self._start_rmw(op)
+        # re-enter the submitting op's span context: head-of-queue ops
+        # start inline under it anyway, but a QUEUED op starts from
+        # _op_done where no (or an unrelated) span is current
+        with g_tracer.activate(op.parent_span):
+            if isinstance(op, FullWriteOp):
+                self._start_full_write(op)
+            elif isinstance(op, VectorOp):
+                self._start_vector(op)
+            else:
+                self._start_rmw(op)
 
     # ---- write path (primary) --------------------------------------------
     def submit_transaction(self, oid: str, data: bytes,
@@ -288,7 +342,8 @@ class ECBackend:
         tid = self.next_tid()
         self._enqueue(oid, FullWriteOp(tid=tid, oid=oid, data=bytes(data),
                                        on_commit=on_commit, xattrs=xattrs,
-                                       snapset_update=snapset_update))
+                                       snapset_update=snapset_update,
+                                       parent_span=g_tracer.current()))
         return tid
 
     def submit_vector(self, oid: str, run: Callable,
@@ -297,7 +352,8 @@ class ECBackend:
         writes (see VectorOp)."""
         tid = self.next_tid()
         self._enqueue(oid, VectorOp(tid=tid, oid=oid, run=run,
-                                    meta_only=meta_only))
+                                    meta_only=meta_only,
+                                    parent_span=g_tracer.current()))
         return tid
 
     def _start_vector(self, op: VectorOp) -> None:
@@ -319,7 +375,8 @@ class ECBackend:
                 # which is this VectorOp
                 self._start_full_write(FullWriteOp(
                     tid=op.tid, oid=op.oid, data=bytes(body2),
-                    on_commit=on_commit, xattrs=attrs2))
+                    on_commit=on_commit, xattrs=attrs2,
+                    parent_span=op.parent_span))
             elif kind == "attrs":
                 _, attrs2, on_commit, _omap = spec
                 self._fan_attrs(op.tid, op.oid, attrs2,
@@ -363,26 +420,29 @@ class ECBackend:
         """Partial write (offset) or append (offset=None): rmw pipeline."""
         tid = self.next_tid()
         self._enqueue(oid, RMWOp(tid=tid, oid=oid, data=bytes(data),
-                                 offset=offset, on_commit=on_commit))
+                                 offset=offset, on_commit=on_commit,
+                                 parent_span=g_tracer.current()))
         return tid
 
     def _start_full_write(self, op: FullWriteOp) -> None:
-        padded = self._pad(op.data)
-        shards = ec_encode(self.sinfo, self.ec_impl, padded,
-                           set(range(self.n)))
+        # reached both from _start_op and from a VectorOp's read
+        # callback, so re-anchor the span context here
+        with g_tracer.activate(op.parent_span):
+            padded = self._pad(op.data)
+            shards = self._encode(padded)
 
-        def all_commit() -> None:
-            self.extent_cache.replace(op.oid, padded, len(op.data))
-            op.on_commit(0)
-            self._op_done(op.oid)
+            def all_commit() -> None:
+                self.extent_cache.replace(op.oid, padded, len(op.data))
+                op.on_commit(0)
+                self._op_done(op.oid)
 
-        self._fan_out_shards(op.tid, op.oid, shards, chunk_off=0,
-                             partial=False, new_size=len(op.data),
-                             on_all_commit=all_commit,
-                             client_reply=op.on_commit,
-                             version=self.pg.next_version(),
-                             xattrs=op.xattrs,
-                             snapset_update=op.snapset_update)
+            self._fan_out_shards(op.tid, op.oid, shards, chunk_off=0,
+                                 partial=False, new_size=len(op.data),
+                                 on_all_commit=all_commit,
+                                 client_reply=op.on_commit,
+                                 version=self.pg.next_version(),
+                                 xattrs=op.xattrs,
+                                 snapset_update=op.snapset_update)
 
     # ---- rmw pipeline (start_rmw, ECBackend.cc:1793) -----------------------
     def _start_rmw(self, op: RMWOp) -> None:
@@ -450,26 +510,27 @@ class ECBackend:
     def _rmw_have_old(self, op: RMWOp, a0: int, a1: int,
                       old_bytes: bytes) -> None:
         """Splice + re-encode the affected range in one device call, then
-        fan chunk deltas (try_reads_to_commit, ECBackend.cc:1894)."""
-        buf = bytearray(a1 - a0)
-        buf[:len(old_bytes)] = old_bytes
-        rel = op.offset - a0
-        buf[rel:rel + len(op.data)] = op.data
-        shards = ec_encode(self.sinfo, self.ec_impl, bytes(buf),
-                           set(range(self.n)))
-        new_size = max(op.old_size, op.offset + len(op.data))
-        c0 = self.sinfo.aligned_logical_offset_to_chunk_offset(a0)
+        fan chunk deltas (try_reads_to_commit, ECBackend.cc:1894).
+        Runs from a read-reply callback — re-anchor the span context."""
+        with g_tracer.activate(op.parent_span):
+            buf = bytearray(a1 - a0)
+            buf[:len(old_bytes)] = old_bytes
+            rel = op.offset - a0
+            buf[rel:rel + len(op.data)] = op.data
+            shards = self._encode(bytes(buf))
+            new_size = max(op.old_size, op.offset + len(op.data))
+            c0 = self.sinfo.aligned_logical_offset_to_chunk_offset(a0)
 
-        def all_commit() -> None:
-            self.extent_cache.write(op.oid, a0, bytes(buf), new_size)
-            op.on_commit(0)
-            self._op_done(op.oid)
+            def all_commit() -> None:
+                self.extent_cache.write(op.oid, a0, bytes(buf), new_size)
+                op.on_commit(0)
+                self._op_done(op.oid)
 
-        self._fan_out_shards(op.tid, op.oid, shards, chunk_off=c0,
-                             partial=True, new_size=new_size,
-                             on_all_commit=all_commit,
-                             client_reply=op.on_commit,
-                             version=self.pg.next_version())
+            self._fan_out_shards(op.tid, op.oid, shards, chunk_off=c0,
+                                 partial=True, new_size=new_size,
+                                 on_all_commit=all_commit,
+                                 client_reply=op.on_commit,
+                                 version=self.pg.next_version())
 
     def _fan_out_shards(self, tid: int, oid: str,
                         shards: Dict[int, np.ndarray], chunk_off: int,
@@ -483,13 +544,18 @@ class ECBackend:
         wr = InflightWrite(tid=tid, oid=oid, client_reply=client_reply,
                            on_all_commit=on_all_commit)
         acting = self.pg.acting_shards()
+        # propagate the op's trace so shard OSDs open child spans
+        # (the Message.h:254 slot riding every sub-op)
+        cur_trace = g_tracer.current_trace_id() if g_tracer.enabled else 0
+        cur_span = g_tracer.current_span_id() if g_tracer.enabled else 0
         for shard, osd in acting.items():
             chunk = shards[shard].tobytes() if shard in shards else b""
             msg = MOSDECSubOpWrite(
                 tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
                 chunk=chunk, offset=chunk_off, partial=partial,
                 at_version=new_size, version=version, xattrs=xattrs,
-                snapset_update=snapset_update)
+                snapset_update=snapset_update,
+                trace_id=cur_trace, parent_span_id=cur_span)
             wr.pending_shards.add(shard)
             self.pg.send_to_osd(osd, msg)
         self.inflight_writes[tid] = wr
@@ -701,6 +767,8 @@ class ECBackend:
         rd = InflightRead(tid=tid, oid=oid, on_done=on_done,
                           chunk_off=chunk_off, chunk_len=chunk_len,
                           attrs_only=attrs_only, raw=raw)
+        cur_trace = g_tracer.current_trace_id() if g_tracer.enabled else 0
+        cur_span = g_tracer.current_span_id() if g_tracer.enabled else 0
         if attrs_only:
             # any single healthy shard knows the size attr
             if not avail:
@@ -711,7 +779,8 @@ class ECBackend:
             self.inflight_reads[tid] = rd
             self.pg.send_to_osd(acting[shard], MOSDECSubOpRead(
                 tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
-                attrs_only=True))
+                attrs_only=True, trace_id=cur_trace,
+                parent_span_id=cur_span))
             return tid
         # want the *physical* positions of the data chunks (chunk_mapping
         # remaps logical->physical for lrc/shec layouts)
@@ -725,7 +794,9 @@ class ECBackend:
             msg = MOSDECSubOpRead(tid=tid, pgid=self.pg.pgid, shard=shard,
                                   oid=oid, offset=chunk_off,
                                   length=chunk_len,
-                                  subchunks=list(minimum[shard]))
+                                  subchunks=list(minimum[shard]),
+                                  trace_id=cur_trace,
+                                  parent_span_id=cur_span)
             rd.pending.add(shard)
             self.pg.send_to_osd(acting[shard], msg)
         self.inflight_reads[tid] = rd
@@ -823,7 +894,9 @@ class ECBackend:
         arrays = {i: np.frombuffer(b, dtype=np.uint8)
                   for i, b in rd.chunks.items()}
         try:
-            data = ec_decode_concat(self.sinfo, self.ec_impl, arrays)
+            data = self._decode_timed(
+                sum(len(b) for b in rd.chunks.values()),
+                ec_decode_concat, self.sinfo, self.ec_impl, arrays)
         except IOError:
             rd.on_done(-5, b"", rd.size, rd.user_attrs)
             return
@@ -836,6 +909,8 @@ class ECBackend:
         """Decode the missing shards' chunks from k sources."""
         arrays = {i: np.frombuffer(b, dtype=np.uint8)
                   for i, b in source_chunks.items()}
-        rec = ec_decode(self.sinfo, self.ec_impl, arrays,
-                        sorted(missing_shards))
+        rec = self._decode_timed(
+            sum(len(b) for b in source_chunks.values()),
+            ec_decode, self.sinfo, self.ec_impl, arrays,
+            sorted(missing_shards))
         return {i: rec[i].tobytes() for i in rec}

@@ -20,6 +20,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..common import Dout, OpTracker, PerfCountersBuilder
 from ..common.work_queue import CLASS_CLIENT, CLASS_SCRUB, ShardedOpWQ
+from ..trace import g_perf_histograms, g_tracer, latency_in_bytes_axes
 from ..crush.constants import CRUSH_ITEM_NONE
 from ..msg import (
     Dispatcher, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
@@ -98,6 +99,15 @@ class OSD(Dispatcher):
         self.last_ping_reply: Dict[int, float] = {}
         self.now = 0.0
         self.perf_counters = _build_osd_perf(self.name)
+        # 2D latency x bytes distributions (the reference's
+        # op_w_latency_in_bytes_histogram surface, perf_histogram.h):
+        # always-on host-side math, dumped via `perf histogram dump`
+        self.hist_op_w = g_perf_histograms.get(
+            self.name, "op_w_latency_in_bytes_histogram",
+            latency_in_bytes_axes)
+        self.hist_op_r = g_perf_histograms.get(
+            self.name, "op_r_latency_in_bytes_histogram",
+            latency_in_bytes_axes)
         self.dout = Dout("osd", self.name)
         self.op_tracker = OpTracker()
         self._tracked: Dict[Tuple[str, int], object] = {}
@@ -520,12 +530,23 @@ class OSD(Dispatcher):
         """Client op intake: lands in the sharded op queue (one PG's
         ops stay FIFO in their shard, OSD.cc ShardedOpWQ) and drains
         through the mClock arbiter — under bursts, QoS decides order."""
-        self.perf_counters.inc(
-            L_OSD_OP_W if msg.op in ("write", "writefull", "append",
-                                     "delete") else L_OSD_OP_R)
+        is_write = msg.op in ("write", "writefull", "append", "delete") \
+            or any(o.op in ("write", "writefull", "append", "delete")
+                   for o in msg.ops)
+        self.perf_counters.inc(L_OSD_OP_W if is_write else L_OSD_OP_R)
         op = self.op_tracker.create_request(
             msg.trace_id, f"osd_op({msg.op} {msg.pool}/{msg.oid})")
         op.mark_event("queued_for_pg")
+        # latency x bytes accounting resolved at reply time
+        op.is_write = is_write
+        op.num_bytes = len(msg.data) + sum(len(o.data) for o in msg.ops)
+        if g_tracer.enabled:
+            # child of the client's root span; activated around do_op so
+            # EC encode / kernel spans attach below it
+            op.span = g_tracer.begin(
+                f"osd_op:{msg.op or 'vector'}:{msg.oid}",
+                daemon=self.name, trace_id=msg.trace_id,
+                parent_id=msg.parent_span_id)
         self._tracked[(msg.src, msg.tid)] = op
         self.op_wq.enqueue(msg.pgid, CLASS_CLIENT, ("op", msg))
         self.drain_ops()
@@ -564,7 +585,11 @@ class OSD(Dispatcher):
             tracked = self._tracked.get((msg.src, msg.tid))
             if tracked is not None:
                 tracked.mark_event("reached_pg")
-            pg.do_op(msg)
+            if tracked is not None and tracked.span is not None:
+                with g_tracer.activate(tracked.span):
+                    pg.do_op(msg)
+            else:
+                pg.do_op(msg)
         elif kind == "scrub":
             item[1].start_scrub(deep=item[2] if len(item) > 2 else False)
 
@@ -573,13 +598,36 @@ class OSD(Dispatcher):
         op = self._tracked.pop((dst, reply.tid), None)
         if op is not None:
             op.mark_event("commit_sent" if reply.result == 0 else "error")
+            if op.span is not None:
+                g_tracer.finish(op.span)
             op.finish()
             self.perf_counters.tinc(L_OSD_OP_LAT, op.duration)
+            if getattr(op, "is_write", False):
+                # write axis: payload bytes captured at intake
+                self.hist_op_w.inc(op.duration * 1e6,
+                                   getattr(op, "num_bytes", 0))
+            else:
+                # read axis: OUT bytes (reads carry no payload in; the
+                # reference's op_r histogram also sizes by outdata).
+                # Vector replies duplicate the last per-op payload into
+                # reply.data, so count op_results OR data, never both
+                out_bytes = sum(len(d) for _r, d in reply.op_results) \
+                    if reply.op_results else len(reply.data)
+                self.hist_op_r.inc(op.duration * 1e6, out_bytes)
         self.messenger.send_message(reply, dst)
 
     # ---- shard sub-ops ----------------------------------------------------
     def _handle_sub_write(self, msg: MOSDECSubOpWrite) -> None:
         self.perf_counters.inc(L_OSD_SUBOP_W)
+        if g_tracer.enabled and msg.parent_span_id:
+            with g_tracer.span(f"sub_write:s{msg.shard}",
+                               daemon=self.name, trace_id=msg.trace_id,
+                               parent_id=msg.parent_span_id):
+                self._do_handle_sub_write(msg)
+        else:
+            self._do_handle_sub_write(msg)
+
+    def _do_handle_sub_write(self, msg: MOSDECSubOpWrite) -> None:
         if msg.snapset_only:
             pg = self.pgs.get(msg.pgid)
             if pg is not None and msg.snapset_update is not None:
@@ -631,6 +679,15 @@ class OSD(Dispatcher):
 
     def _handle_sub_read(self, msg: MOSDECSubOpRead) -> None:
         self.perf_counters.inc(L_OSD_SUBOP_R)
+        if g_tracer.enabled and msg.parent_span_id:
+            with g_tracer.span(f"sub_read:s{msg.shard}",
+                               daemon=self.name, trace_id=msg.trace_id,
+                               parent_id=msg.parent_span_id):
+                self._do_handle_sub_read(msg)
+        else:
+            self._do_handle_sub_read(msg)
+
+    def _do_handle_sub_read(self, msg: MOSDECSubOpRead) -> None:
         pg = self.pgs.get(msg.pgid)
         if pg is None:
             self.reply_to(msg, MOSDECSubOpReadReply(

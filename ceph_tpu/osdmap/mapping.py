@@ -253,16 +253,26 @@ class OSDMapMapping:
 
     # ---- public -----------------------------------------------------------
     def update(self, osdmap: OSDMap) -> None:
-        self.pools.clear()
-        crush_fp = self._crush_fingerprint(osdmap) if self.use_device \
-            else None
-        for pool_id, pool in osdmap.pools.items():
-            ps = np.arange(pool.pg_num, dtype=np.uint32)
-            pps = pool_pps(pool, pool_id, ps)
-            raw = self._raw_batch(osdmap, pool_id, pool, pps, crush_fp)
-            self.pools[pool_id] = self._postprocess(
-                osdmap, pool_id, pool, raw, pps)
-        self.epoch = osdmap.epoch
+        """Recompute all pools; latency lands in the per-epoch batched
+        mapping histogram (the whole-map remap is the device-batched
+        hot path the balancer and every epoch apply lean on)."""
+        import time
+        from ..trace import g_perf_histograms, g_tracer, latency_axes
+        t0 = time.perf_counter()
+        with g_tracer.span("crush_map_update"):
+            self.pools.clear()
+            crush_fp = self._crush_fingerprint(osdmap) if self.use_device \
+                else None
+            for pool_id, pool in osdmap.pools.items():
+                ps = np.arange(pool.pg_num, dtype=np.uint32)
+                pps = pool_pps(pool, pool_id, ps)
+                raw = self._raw_batch(osdmap, pool_id, pool, pps, crush_fp)
+                self.pools[pool_id] = self._postprocess(
+                    osdmap, pool_id, pool, raw, pps)
+            self.epoch = osdmap.epoch
+        g_perf_histograms.get(
+            "osdmap", "crush_map_latency_histogram", latency_axes).inc(
+            (time.perf_counter() - t0) * 1e6)
 
     def get(self, pg: pg_t) -> Tuple[List[int], int, List[int], int]:
         pm = self.pools[pg.pool]

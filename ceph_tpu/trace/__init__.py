@@ -1,0 +1,28 @@
+"""Unified observability layer: spans, perf histograms, flight recorder.
+
+The runtime-side counterpart of the bench subsystem's measurement rigor
+(PR 1): cross-daemon span trees keyed by the trace id every message
+already carries (msg/Message.h:254's ZTracer slot), reference-shaped 2D
+PerfHistograms (src/common/perf_histogram.h), and a slow-op flight
+recorder feeding ``dump_historic_slow_ops``.  Export rides the admin
+socket, the mgr's Prometheus renderer, and ``python -m ceph_tpu.bench``.
+
+Everything here is sync-free by construction: spans and histogram
+increments never touch the device, so the default-off tracer adds zero
+``block_until_ready``/drain calls to any hot path.
+"""
+from .span import Span, SpanCollector, Tracer, build_tree, g_tracer
+from .histogram import (
+    PerfHistogram, PerfHistogramAxis, PerfHistogramCollection,
+    SCALE_LINEAR, SCALE_LOG2, g_perf_histograms, latency_axes,
+    latency_in_bytes_axes,
+)
+from .flight import FlightEntry, FlightRecorder, g_flight_recorder
+
+__all__ = [
+    "Span", "SpanCollector", "Tracer", "build_tree", "g_tracer",
+    "PerfHistogram", "PerfHistogramAxis", "PerfHistogramCollection",
+    "SCALE_LINEAR", "SCALE_LOG2", "g_perf_histograms", "latency_axes",
+    "latency_in_bytes_axes",
+    "FlightEntry", "FlightRecorder", "g_flight_recorder",
+]

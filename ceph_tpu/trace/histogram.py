@@ -1,0 +1,204 @@
+"""PerfHistogram — log2-bucketed 1D/2D distributions.
+
+The reference's 2D latency×bytes surface (src/common/perf_histogram.h,
+dumped as ``op_w_latency_in_bytes_histogram`` under ``perf histogram
+dump``): each axis declares (name, min, quant_size, buckets,
+scale_type), a sample lands in one cell, and the dump carries the axis
+configs next to the full count grid so a consumer can reconstruct
+bucket bounds without out-of-band knowledge.
+
+Bucketing matches the reference's get_bucket_for_axis: values below
+``min`` land in bucket 0; otherwise ``d = (value - min) // quant_size``
+and log2 axes place d in bucket ``min(1 + bit_length(d), buckets-1)``
+(linear: ``min(1 + d, buckets-1)``).  The last bucket is the overflow.
+
+Histograms are always-on like perf counters: incrementing is host-side
+integer math under a lock — no device syncs, no allocation per sample —
+so the write path keeps them hot in production.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+SCALE_LINEAR = "linear"
+SCALE_LOG2 = "log2"
+
+
+class PerfHistogramAxis:
+    __slots__ = ("name", "min", "quant_size", "buckets", "scale_type")
+
+    def __init__(self, name: str, min: int = 0, quant_size: int = 1,
+                 buckets: int = 32, scale_type: str = SCALE_LOG2):
+        assert buckets >= 2, "need at least an underflow + one bucket"
+        assert quant_size >= 1
+        self.name = name
+        self.min = min
+        self.quant_size = quant_size
+        self.buckets = buckets
+        self.scale_type = scale_type
+
+    def bucket_for(self, value: float) -> int:
+        v = int(value)
+        if v < self.min:
+            return 0
+        d = (v - self.min) // self.quant_size
+        if self.scale_type == SCALE_LINEAR:
+            return min(1 + d, self.buckets - 1)
+        return min(1 + int(d).bit_length(), self.buckets - 1)
+
+    def upper_edges(self) -> List[float]:
+        """Exclusive upper bound of every bucket, in the axis's raw
+        unit; the last bucket's bound is +inf (overflow)."""
+        edges: List[float] = [float(self.min)]          # bucket 0: < min
+        for b in range(1, self.buckets - 1):
+            if self.scale_type == SCALE_LINEAR:
+                edges.append(float(self.min + b * self.quant_size))
+            else:
+                edges.append(float(self.min
+                                   + self.quant_size * (1 << (b - 1))))
+        edges.append(float("inf"))
+        return edges
+
+    def dump_config(self) -> dict:
+        return {"name": self.name, "min": self.min,
+                "quant_size": self.quant_size, "buckets": self.buckets,
+                "scale_type": self.scale_type}
+
+
+class PerfHistogram:
+    """N-dimensional counts grid (1D and 2D used here), thread-safe."""
+
+    def __init__(self, axes: List[PerfHistogramAxis]):
+        assert axes, "at least one axis"
+        self.axes = list(axes)
+        n = 1
+        for ax in self.axes:
+            n *= ax.buckets
+        self._counts = [0] * n
+        self._lock = threading.Lock()
+        # axis-0 raw-value accounting for _sum/_count exposition
+        self.total_count = 0
+        self.axis0_sum = 0.0
+
+    def inc(self, *values: float) -> None:
+        assert len(values) == len(self.axes)
+        idx = 0
+        for ax, v in zip(self.axes, values):
+            idx = idx * ax.buckets + ax.bucket_for(v)
+        with self._lock:
+            self._counts[idx] += 1
+            self.total_count += 1
+            self.axis0_sum += float(values[0])
+
+    # ---- views ------------------------------------------------------------
+    def _grid(self) -> list:
+        """Counts as nested lists matching the axis order."""
+        with self._lock:
+            flat = list(self._counts)
+        shape = [ax.buckets for ax in self.axes]
+
+        def nest(offset: int, dims: List[int]):
+            if len(dims) == 1:
+                return flat[offset:offset + dims[0]]
+            stride = 1
+            for d in dims[1:]:
+                stride *= d
+            return [nest(offset + i * stride, dims[1:])
+                    for i in range(dims[0])]
+
+        return nest(0, shape)
+
+    def marginal_axis0(self) -> List[int]:
+        """Per-bucket counts over axis 0, summed across all other axes."""
+        with self._lock:
+            flat = list(self._counts)
+        b0 = self.axes[0].buckets
+        stride = len(flat) // b0
+        return [sum(flat[i * stride:(i + 1) * stride]) for i in range(b0)]
+
+    def cumulative_axis0(self) -> List[Tuple[float, int]]:
+        """(upper_edge, cumulative_count) per axis-0 bucket — the
+        Prometheus ``le`` series shape (monotone by construction)."""
+        counts = self.marginal_axis0()
+        edges = self.axes[0].upper_edges()
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        for edge, cnt in zip(edges, counts):
+            cum += cnt
+            out.append((edge, cum))
+        return out
+
+    def dump(self) -> dict:
+        """The reference's dump shape: axis configs + full count grid."""
+        return {"axes": [ax.dump_config() for ax in self.axes],
+                "values": self._grid(),
+                "count": self.total_count,
+                "axis0_sum": self.axis0_sum}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * len(self._counts)
+            self.total_count = 0
+            self.axis0_sum = 0.0
+
+
+class PerfHistogramCollection:
+    """(logger, histogram-name) registry dumped by ``perf histogram
+    dump`` and scraped by the mgr's Prometheus renderer."""
+
+    def __init__(self):
+        self._hists: Dict[Tuple[str, str], PerfHistogram] = {}
+        self._lock = threading.Lock()
+
+    def get(self, logger: str, name: str,
+            axes_factory=None) -> PerfHistogram:
+        """Fetch-or-create; *axes_factory* is a zero-arg callable
+        returning the axis list (only invoked on first creation, so a
+        restarted daemon reattaches to its existing histogram)."""
+        key = (logger, name)
+        with self._lock:
+            hist = self._hists.get(key)
+            if hist is None:
+                if axes_factory is None:
+                    raise KeyError(f"histogram {key!r} not registered")
+                hist = self._hists[key] = PerfHistogram(axes_factory())
+            return hist
+
+    def items(self) -> List[Tuple[Tuple[str, str], PerfHistogram]]:
+        with self._lock:
+            return sorted(self._hists.items())
+
+    def dump(self, logger: str = "", name: str = ""
+             ) -> Dict[str, Dict[str, dict]]:
+        out: Dict[str, Dict[str, dict]] = {}
+        for (lg, nm), hist in self.items():
+            if (logger and lg != logger) or (name and nm != name):
+                continue
+            out.setdefault(lg, {})[nm] = hist.dump()
+        return out
+
+    def reset(self) -> None:
+        for _key, hist in self.items():
+            hist.reset()
+
+
+g_perf_histograms = PerfHistogramCollection()
+
+
+# ---- standard axis shapes (the reference's l_osd histogram configs) ------
+def latency_in_bytes_axes() -> List[PerfHistogramAxis]:
+    """2D latency(usec, log2) x request-size(bytes, log2) — the
+    ``op_w_latency_in_bytes_histogram`` shape (OSD.cc histogram setup:
+    latency quant 100 usec, size quant 512 B, 32 log2 buckets each)."""
+    return [PerfHistogramAxis("latency_usec", min=0, quant_size=100,
+                              buckets=32, scale_type=SCALE_LOG2),
+            PerfHistogramAxis("request_size_bytes", min=0, quant_size=512,
+                              buckets=32, scale_type=SCALE_LOG2)]
+
+
+def latency_axes() -> List[PerfHistogramAxis]:
+    """1D latency(usec, log2) — request-handling paths with no natural
+    byte axis (MDS requests, CRUSH batch mapping)."""
+    return [PerfHistogramAxis("latency_usec", min=0, quant_size=100,
+                              buckets=32, scale_type=SCALE_LOG2)]

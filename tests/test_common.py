@@ -58,6 +58,31 @@ def test_perf_counters_dump():
     assert coll.dump(counter="ops")["test"] == {"ops": 6}
 
 
+def test_perf_counters_u64_avgcount_semantics():
+    """inc()/dec() on a plain u64 must not move the avgcount
+    denominator (the reference only bumps avgcount on LONGRUNAVG
+    counters) — an inc-only count would skew any average built over
+    the counter later."""
+    b = PerfCountersBuilder("avg", 0, 10)
+    b.add_u64_counter(1, "plain")
+    b.add_u64(2, "gauge_like")
+    b.add_time_avg(3, "lat")
+    pc = b.create_perf_counters()
+    pc.inc(1, 3)
+    pc.dec(1, 1)
+    pc.inc(2, 7)
+    pc.dec(2, 2)
+    assert pc.get(1) == 2
+    assert pc.get(2) == 5
+    assert pc._by_idx[1].count == 0
+    assert pc._by_idx[2].count == 0
+    # LONGRUNAVG counters DO advance avgcount via inc, and refuse dec
+    pc.inc(3)
+    assert pc._by_idx[3].count == 1
+    with pytest.raises(AssertionError):
+        pc.dec(3)
+
+
 def test_admin_socket_dispatch():
     asok = AdminSocket()
     asok.register("perf dump", lambda c, a: {"x": 1})

@@ -1,0 +1,293 @@
+"""Observability layer: spans, perf histograms, flight recorder.
+
+Tier-1 smoke coverage for the trace/ package (the runtime-side
+counterpart of the bench subsystem's rigor): the zero-sync contract of
+the default-off path, the cross-daemon span tree a slow op preserves
+(client -> OSD -> EC encode -> device drain), and the admin-socket
+export surfaces (`perf histogram dump`, `dump_tracing`,
+`dump_historic_slow_ops`).
+"""
+import pytest
+
+from ceph_tpu.common import g_kernel_timer
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.trace import (
+    PerfHistogram, PerfHistogramAxis, SCALE_LINEAR, build_tree,
+    g_flight_recorder, g_perf_histograms, g_tracer, latency_in_bytes_axes,
+)
+
+
+@pytest.fixture
+def clean_tracing():
+    """Every test leaves the process-global observability state as it
+    found it (tracer off, kernel timer off, default complaint time)."""
+    yield
+    g_tracer.enable(False)
+    g_tracer.collector.clear()
+    g_kernel_timer.enable(False)
+    g_kernel_timer.reset()
+    g_flight_recorder.clear()
+    g_conf.rm_val("op_complaint_time")
+    g_conf.rm_val("tracing_spans")
+
+
+# ---- span primitives -------------------------------------------------------
+def test_spans_disabled_are_free(clean_tracing):
+    assert g_tracer.begin("x") is None
+    with g_tracer.span("y") as sp:
+        assert sp is None
+    assert g_tracer.collector.dump() == {}
+
+
+def test_span_parent_inheritance_and_tree(clean_tracing):
+    g_tracer.enable()
+    with g_tracer.span("root", daemon="a", trace_id=7) as root:
+        with g_tracer.span("child") as child:
+            # parent + trace inherit from the activated span
+            assert child.parent_span_id == root.span_id
+            assert child.trace_id == 7
+        # explicit parent id (the cross-daemon message header) wins
+        remote = g_tracer.begin("remote", daemon="b", trace_id=7,
+                                parent_id=root.span_id)
+        g_tracer.finish(remote)
+    tree = g_tracer.collector.tree(7)
+    assert len(tree) == 1 and tree[0]["name"] == "root"
+    names = sorted(c["name"] for c in tree[0]["children"])
+    assert names == ["child", "remote"]
+    assert tree[0]["end"] is not None
+
+
+def test_span_ring_bounded_and_flight_recorder_pins(clean_tracing):
+    g_tracer.enable()
+    g_tracer.collector.ring_size = 2048
+    keep = g_tracer.begin("pinned", daemon="ringtest", trace_id=99)
+    g_tracer.finish(keep)
+    entry = g_flight_recorder.record(
+        99, "slow op", 1.0, g_tracer.collector.spans_for_trace(99))
+    # overflow the daemon's ring: the collector forgets, the pin holds
+    for i in range(3000):
+        g_tracer.finish(g_tracer.begin(f"junk{i}", daemon="ringtest",
+                                       trace_id=1))
+    assert g_tracer.collector.spans_for_trace(99) == []
+    tree = entry.tree()
+    assert len(tree) == 1 and tree[0]["name"] == "pinned"
+    assert g_flight_recorder.dump()["slow_ops"][-1]["trace_id"] == 99
+
+
+def test_build_tree_orphan_parents_become_roots(clean_tracing):
+    g_tracer.enable()
+    sp = g_tracer.begin("orphan", daemon="d", trace_id=5,
+                        parent_id=123456789)
+    g_tracer.finish(sp)
+    tree = build_tree(g_tracer.collector.spans_for_trace(5))
+    assert [t["name"] for t in tree] == ["orphan"]
+
+
+# ---- histogram primitives --------------------------------------------------
+def test_histogram_log2_bucketing_matches_reference():
+    ax = PerfHistogramAxis("lat", min=100, quant_size=10, buckets=8)
+    # below min -> underflow bucket 0
+    assert ax.bucket_for(99) == 0
+    # d = 0 -> bucket 1; d = 1 -> bucket 2; d in [2,4) -> 3 ...
+    assert ax.bucket_for(100) == 1
+    assert ax.bucket_for(110) == 2
+    assert ax.bucket_for(120) == 3
+    assert ax.bucket_for(140) == 4
+    # overflow clamps to the last bucket
+    assert ax.bucket_for(10**9) == 7
+    lin = PerfHistogramAxis("x", min=0, quant_size=2, buckets=4,
+                            scale_type=SCALE_LINEAR)
+    assert [lin.bucket_for(v) for v in (0, 2, 4, 100)] == [1, 2, 3, 3]
+
+
+def test_histogram_2d_dump_shape_and_cumulative():
+    hist = PerfHistogram(latency_in_bytes_axes())
+    hist.inc(250, 4096)       # 250 usec, 4 KiB
+    hist.inc(50, 100)
+    hist.inc(10**9, 2**40)    # overflow both axes
+    d = hist.dump()
+    assert [a["name"] for a in d["axes"]] == ["latency_usec",
+                                              "request_size_bytes"]
+    assert d["axes"][0]["scale_type"] == "log2"
+    assert len(d["values"]) == 32 and len(d["values"][0]) == 32
+    assert sum(map(sum, d["values"])) == 3 == d["count"]
+    cum = hist.cumulative_axis0()
+    counts = [c for _e, c in cum]
+    assert counts == sorted(counts)          # monotone by construction
+    assert counts[-1] == 3
+    assert cum[-1][0] == float("inf")
+
+
+def test_histogram_collection_get_or_create():
+    h1 = g_perf_histograms.get("unit.test", "h", latency_in_bytes_axes)
+    h2 = g_perf_histograms.get("unit.test", "h")
+    assert h1 is h2
+    with pytest.raises(KeyError):
+        g_perf_histograms.get("unit.test", "missing")
+
+
+# ---- cluster wiring --------------------------------------------------------
+def _boot_traced_cluster():
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("trace", k=3, m=2, pg_num=8)
+    return c
+
+
+def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
+                                                     monkeypatch):
+    """Acceptance gate: the default-off tracing path must add no
+    block_until_ready/drain to the OSD write path — counted via a
+    monkeypatched fence, with spans both off AND on (spans are
+    host-side only; only tracing_kernels may ever add a sync)."""
+    import jax
+    c = _boot_traced_cluster()
+    cl = c.client()
+    cl.write_full("trace", "warm", b"w" * 20000)      # compile warmup
+    calls = {"n": 0}
+    real = jax.block_until_ready
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "block_until_ready", counting)
+    assert cl.write_full("trace", "o_off", b"x" * 20000) == 0
+    assert calls["n"] == 0, "write path synced with tracing disabled"
+    g_tracer.enable()                                 # spans only
+    assert cl.write_full("trace", "o_on", b"y" * 20000) == 0
+    assert calls["n"] == 0, "span tracing added a device sync"
+
+
+def test_slow_op_span_tree_and_histogram_dump(clean_tracing):
+    """Tier-1 smoke: boot the mini-cluster, one write through the traced
+    path, assert a complete span tree (client -> OSD -> EC encode ->
+    device drain, monotone timestamps) in dump_historic_slow_ops and a
+    non-empty `perf histogram dump` via the admin socket."""
+    g_conf.set_val("op_complaint_time", -1.0)   # every op is "slow"
+    g_tracer.enable()
+    g_kernel_timer.enable()                     # drain child spans exist
+    c = _boot_traced_cluster()
+    cl = c.client()
+    assert cl.write_full("trace", "obj", b"z" * 20000) == 0
+
+    hd = c.admin_socket.execute("perf histogram dump")
+    w = [d["op_w_latency_in_bytes_histogram"] for d in hd.values()
+         if d.get("op_w_latency_in_bytes_histogram", {}).get("count")]
+    assert w, "no OSD recorded an op_w histogram sample"
+    enc = [d["ec_encode_latency_in_bytes_histogram"] for d in hd.values()
+           if d.get("ec_encode_latency_in_bytes_histogram",
+                    {}).get("count")]
+    assert enc, "no OSD recorded an ec_encode histogram sample"
+
+    slow = c.admin_socket.execute("dump_historic_slow_ops")
+    trees = [op["span_tree"] for d in slow.values() for op in d["ops"]
+             if "span_tree" in op
+             and op["description"].startswith("osd_op(writefull")]
+    assert trees, "slow write op carried no span tree"
+    roots = trees[0]
+    assert len(roots) == 1 and roots[0]["name"].startswith("client_op:")
+
+    def find(node, pred, path):
+        if pred(node):
+            return path + [node]
+        for ch in node["children"]:
+            hit = find(ch, pred, path + [node])
+            if hit:
+                return hit
+        return None
+
+    chain = find(roots[0],
+                 lambda n: n["name"] == "device_drain", [])
+    assert chain is not None, "no device_drain span under the op"
+    names = [n["name"] for n in chain]
+    assert any(n.startswith("osd_op:") for n in names)
+    assert "ec_encode" in names
+    assert any(n.startswith("kernel:") for n in names)
+    # monotone: every child starts at/after its parent, all spans closed
+    for parent, child in zip(chain, chain[1:]):
+        assert child["start"] >= parent["start"]
+        assert parent["end"] is not None and child["end"] is not None
+        assert child["end"] <= parent["end"] + 1e-6
+
+    # dump_tracing surfaces the same spans per daemon + flight entries
+    dt = c.admin_socket.execute("dump_tracing")
+    assert dt["enabled"] and "client.0" in dt["spans"]
+    assert dt["flight_recorder"]["slow_ops"]
+
+
+def test_queued_ec_write_keeps_trace_context(clean_tracing):
+    """A write queued behind another on the same oid starts from the
+    sub-write-reply dispatch context; its encode/fan-out must still
+    trace under the SUBMITTING op's span (captured at enqueue), not
+    whatever span is current at dequeue."""
+    g_tracer.enable()
+    c = _boot_traced_cluster()
+    cl = c.client()
+    cl.write_full("trace", "qq", b"a" * 8000)
+    pid = cl.lookup_pool("trace")
+    pgid, primary = cl._calc_target(pid, "qq")
+    be = c.osds[primary].pgs[pgid].backend
+    root = g_tracer.begin("test_root", daemon="test", trace_id=424242)
+    with g_tracer.activate(root):
+        # first starts inline; second queues until the first's shard
+        # acks arrive (nothing pumps inside submit_transaction)
+        be.submit_transaction("qq", b"b" * 8000, lambda _r: None)
+        be.submit_transaction("qq", b"c" * 8000, lambda _r: None)
+    g_tracer.finish(root)
+    c.network.pump()
+    spans = g_tracer.collector.spans_for_trace(424242)
+    encodes = [s for s in spans if s.name == "ec_encode"]
+    assert len(encodes) == 2, \
+        "queued write's ec_encode span lost the submitting trace"
+    assert all(s.parent_span_id == root.span_id for s in encodes)
+    # the queued op's sub-writes carried the trace cross-daemon too
+    assert sum(1 for s in spans if s.name.startswith("sub_write")) >= 10
+
+
+def test_op_complaint_time_live_config(clean_tracing):
+    """Runtime `config set op_complaint_time` must take effect on
+    already-constructed OpTrackers (no restart)."""
+    from ceph_tpu.common import OpTracker
+    t = OpTracker()
+    assert t.complaint_time == 30.0
+    g_conf.set_val("op_complaint_time", 1.5)
+    assert t.complaint_time == 1.5
+    t.complaint_time = 99.0          # explicit override pins
+    g_conf.set_val("op_complaint_time", 2.0)
+    assert t.complaint_time == 99.0
+
+
+def test_tracing_admin_toggle_and_config_observer(clean_tracing):
+    c = _boot_traced_cluster()
+    out = c.admin_socket.execute("span tracing", {"on": "1"})
+    assert out["enabled"] and g_tracer.enabled
+    out = c.admin_socket.execute("span tracing", {"on": "0"})
+    assert not out["enabled"] and not g_tracer.enabled
+    # config observer path ('ceph tell ... injectargs tracing_spans')
+    c.admin_socket.execute("config set", {"name": "tracing_spans",
+                                          "value": "true"})
+    assert g_tracer.enabled
+    c.admin_socket.execute("config set", {"name": "tracing_spans",
+                                          "value": "false"})
+    assert not g_tracer.enabled
+
+
+def test_kernel_timer_record_thread_safe():
+    """Satellite: concurrent _record calls must not lose samples."""
+    import threading
+    from ceph_tpu.common.kernel_trace import KernelTimer
+    kt = KernelTimer()
+    kt.enable()
+    N, THREADS = 500, 8
+
+    def worker():
+        for _ in range(N):
+            kt._record("hot", 0.001)
+
+    ts = [threading.Thread(target=worker) for _ in range(THREADS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert kt.dump()["hot"]["calls"] == N * THREADS
