@@ -53,8 +53,8 @@ def main(argv=None) -> int:
 
     from . import regress
     from .workloads import (bench_perf_counters, measure_decode,
-                            measure_encode, measure_host_native,
-                            parity_check)
+                            measure_dispatch_coalesce, measure_encode,
+                            measure_host_native, parity_check)
     from ..gf.matrices import gf_gen_rs_matrix
 
     K, M = 8, 4
@@ -94,6 +94,14 @@ def main(argv=None) -> int:
         result["metrics"].append(m)
         progress(f"decode {m['value']} GiB/s fenced "
                  f"(roofline: {m['roofline']['verdict']})")
+        mc, ms = measure_dispatch_coalesce(
+            n_requests=8 if args.smoke else 32,
+            target_seconds=0.3 if args.smoke else 2.0,
+            repeats=repeats, warmup=warmup)
+        result["metrics"] += [mc, ms]
+        progress(f"dispatch_coalesce {mc['value']} GiB/s coalesced vs "
+                 f"{ms['value']} serial (x{mc['speedup']}, "
+                 f"occupancy {mc['batch_occupancy']})")
         host = measure_host_native(matrix, batch[0],
                                    target_seconds=0.3 if args.smoke
                                    else 1.5)

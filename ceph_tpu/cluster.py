@@ -240,6 +240,17 @@ class MiniCluster:
             lambda c, a: {o.name: o.op_wq.dump()
                           for o in self.osds.values()},
             "per-shard op queue sizes and mclock tags")
+        from .dispatch import dispatch_perf_counters, g_dispatcher
+        self.perf_collection.add(dispatch_perf_counters())
+        asok.register(
+            "dispatch dump",
+            lambda c, a: g_dispatcher.dump(),
+            "EC dispatch scheduler state: options, per-signature "
+            "queues, counters, batch-occupancy histogram")
+        asok.register(
+            "dispatch flush",
+            lambda c, a: {"flushed": g_dispatcher.flush()},
+            "flush every pending EC dispatch queue now")
         asok.register(
             "arch probe",
             lambda c, a: __import__("ceph_tpu.arch", fromlist=["probe"])

@@ -91,6 +91,19 @@ def build_options() -> List[Option]:
                          "residual fallback"),
         Option("ec_device_batch", OPT_INT).set_default(64)
         .set_description("stripes per batched device encode call"),
+        Option("ec_dispatch_batch_max", OPT_INT).set_default(64)
+        .set_description("EC dispatch scheduler: requests per codec "
+                         "signature that trigger an immediate coalesced "
+                         "flush (ceph_tpu/dispatch)"),
+        Option("ec_dispatch_batch_window_us", OPT_INT).set_default(0)
+        .set_description("EC dispatch scheduler: collection window in "
+                         "microseconds before a queued request's batch "
+                         "flushes; 0 = exact passthrough to the "
+                         "uncoalesced per-op device call"),
+        Option("ec_dispatch_queue_max", OPT_INT).set_default(1024)
+        .set_description("EC dispatch scheduler: total pending requests "
+                         "across all queues before a forced "
+                         "backpressure flush"),
         Option("osd_scrub_min_interval", OPT_FLOAT).set_default(86400.0)
         .set_description("seconds between periodic background scrubs "
                          "of a PG (reference osd_scrub_min_interval)"),

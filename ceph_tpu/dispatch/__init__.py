@@ -1,0 +1,22 @@
+"""Cross-PG dynamic-batching device scheduler for EC codec work.
+
+The serving-stack pattern (dynamic batching) landed behind Ceph's
+plugin boundary: concurrent encode/decode/reconstruct requests from
+every PG on an OSD coalesce into one padded batched device call per
+flush.  See docs/DISPATCH.md for the queueing model, bucketing rules,
+tuning knobs, and the window=0 exact-passthrough contract.
+"""
+from .batch import Request, run_group, run_one
+from .future import DispatchFuture
+from .scheduler import (DeviceDispatcher, dispatch_perf_counters,
+                        g_dispatcher)
+from .signature import (KIND_DECODE, KIND_DECODE_CONCAT, KIND_ENCODE,
+                        batchable, bucket_chunk_size, codec_signature)
+
+__all__ = [
+    "Request", "run_group", "run_one",
+    "DispatchFuture",
+    "DeviceDispatcher", "dispatch_perf_counters", "g_dispatcher",
+    "KIND_DECODE", "KIND_DECODE_CONCAT", "KIND_ENCODE",
+    "batchable", "bucket_chunk_size", "codec_signature",
+]

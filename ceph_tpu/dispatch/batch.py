@@ -1,0 +1,184 @@
+"""Batch assembly: many queued codec requests -> ONE padded device call.
+
+The same shape the paper exploits *within* one op (all stripes of one
+object in a single MXU call) applied one level up: all stripes of all
+queued ops of one codec signature.  Assembly is pure numpy reshaping;
+the single device call goes through the codec's own batched entry
+points (``encode_batch`` / ``decode_batch``), so the kernel-timer and
+backend-selection behavior of the uncoalesced path is preserved.
+
+Correctness contract (property-tested): for every request in a group,
+slicing its rows/columns back out of the coalesced result is
+byte-identical to running the request alone.  This holds because (a)
+stripes are independent — concatenating along S changes nothing, and
+(b) the zero-pad from C to the bucket width is whole code blocks, and
+blocks are columnwise independent (signature.batchable enforces it).
+
+Requests are executed via the exact ecutil entry points when alone
+(``run_one`` IS the passthrough path — not a reimplementation of it),
+so window=0 behavior is today's behavior by construction.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .signature import (KIND_DECODE, KIND_DECODE_CONCAT, KIND_ENCODE,
+                        next_pow2)
+
+
+class Request:
+    """One queued codec work item (encode / decode / reconstruct)."""
+
+    __slots__ = ("kind", "sinfo", "ec_impl", "payload", "chunks", "need",
+                 "want", "future", "parent_span", "trace_id", "nbytes",
+                 "n_stripes", "chunk_size", "enq_t", "batchable", "key")
+
+    def __init__(self, kind: str, sinfo, ec_impl, *, payload=None,
+                 chunks=None, need=None, want=None):
+        self.kind = kind
+        self.sinfo = sinfo
+        self.ec_impl = ec_impl
+        self.payload = payload            # np.uint8 1D (encode)
+        self.chunks = chunks              # {chunk id: np.uint8 1D}
+        self.need = tuple(need) if need is not None else ()
+        self.want = set(want) if want is not None else set()
+        self.future = None                # bound by the scheduler
+        self.parent_span = None
+        self.trace_id = 0
+        self.chunk_size = sinfo.get_chunk_size()
+        if kind == KIND_ENCODE:
+            self.nbytes = len(payload)
+            self.n_stripes = (len(payload)
+                              // max(sinfo.get_stripe_width(), 1))
+        else:
+            total = len(next(iter(chunks.values()))) if chunks else 0
+            self.nbytes = sum(len(b) for b in chunks.values())
+            self.n_stripes = total // max(self.chunk_size, 1)
+        self.enq_t = 0.0
+        self.batchable = False
+        self.key = None
+
+
+def _ecutil():
+    # deferred: osd.ecutil is dependency-free, but importing it through
+    # the osd package at module-load time would cycle with ec_backend
+    from ..osd import ecutil
+    return ecutil
+
+
+def run_one(req: Request):
+    """Exact per-request execution — the window=0 passthrough path and
+    the fallback when a batched call throws.  Calls the SAME ecutil
+    entry points ec_backend always called, so outputs are identical to
+    the pre-dispatcher code by construction."""
+    eu = _ecutil()
+    if req.kind == KIND_ENCODE:
+        return eu.encode(req.sinfo, req.ec_impl, req.payload, req.want)
+    arrays = {i: np.asarray(b, dtype=np.uint8)
+              for i, b in req.chunks.items()}
+    if req.kind == KIND_DECODE_CONCAT:
+        return eu.decode_concat(req.sinfo, req.ec_impl, arrays)
+    return eu.decode(req.sinfo, req.ec_impl, arrays, list(req.need))
+
+
+def _pad_cols(a: np.ndarray, cb: int) -> np.ndarray:
+    """Zero-pad the last (byte-column) axis to the bucket width."""
+    c = a.shape[-1]
+    if c == cb:
+        return a
+    width = [(0, 0)] * (a.ndim - 1) + [(0, cb - c)]
+    return np.pad(a, width)
+
+
+def _pad_stripes(big: np.ndarray, use_device: bool) -> np.ndarray:
+    """Pad the stripe axis to a power of two on the device path so the
+    jit cache sees O(log S) batch shapes, not one per occupancy mix.
+    Zero stripes encode/decode independently and are sliced off."""
+    s = big.shape[0]
+    if not use_device:
+        return big
+    st = next_pow2(s)
+    if st == s:
+        return big
+    width = [(0, st - s)] + [(0, 0)] * (big.ndim - 1)
+    return np.pad(big, width)
+
+
+def run_group(reqs: List[Request], bucket_c: int) -> List:
+    """One coalesced device call for a signature/bucket group; returns
+    per-request results aligned with *reqs*.  Any failure propagates to
+    the caller, which re-runs each request alone so one bad request
+    cannot poison its batchmates."""
+    if len(reqs) == 1:
+        return [run_one(reqs[0])]
+    leader = reqs[0].ec_impl
+    kind = reqs[0].kind
+    use_device = bool(getattr(leader, "_use_device", lambda: False)())
+    if kind == KIND_ENCODE:
+        return _run_group_encode(reqs, bucket_c, leader, use_device)
+    return _run_group_decode(reqs, bucket_c, leader, use_device, kind)
+
+
+def _run_group_encode(reqs, bucket_c, leader, use_device):
+    # requests may carry different pool chunk sizes within one bucket:
+    # each is zero-padded to the bucket width and sliced back to its own
+    # width (columnwise independence makes the pad invisible)
+    k = leader.get_data_chunk_count()
+    stacks, offsets, s0 = [], [], 0
+    for r in reqs:
+        stripes = np.frombuffer(bytes(r.payload), dtype=np.uint8) \
+            if not isinstance(r.payload, np.ndarray) else r.payload
+        stripes = stripes.reshape(r.n_stripes, k, r.chunk_size)
+        stacks.append(_pad_cols(stripes, bucket_c))
+        offsets.append((s0, stripes))
+        s0 += r.n_stripes
+    big = _pad_stripes(np.ascontiguousarray(np.concatenate(stacks)),
+                       use_device)
+    coding = leader.encode_batch(big)          # (S_total[, pad], m, Cb)
+    coding = np.asarray(coding)
+    out: List[Dict[int, np.ndarray]] = []
+    for r, (off, stripes) in zip(reqs, offsets):
+        res: Dict[int, np.ndarray] = {}
+        for i in r.want:
+            if i < k:
+                res[i] = np.ascontiguousarray(
+                    stripes[:, i, :]).reshape(-1)
+            else:
+                res[i] = np.ascontiguousarray(
+                    coding[off:off + r.n_stripes, i - k,
+                           :r.chunk_size]).reshape(-1)
+        out.append(res)
+    return out
+
+
+def _run_group_decode(reqs, bucket_c, leader, use_device, kind):
+    k = leader.get_data_chunk_count()
+    ids = sorted(reqs[0].chunks)
+    stacked: Dict[int, np.ndarray] = {}
+    for cid in ids:
+        parts = [_pad_cols(np.asarray(r.chunks[cid], dtype=np.uint8)
+                           .reshape(r.n_stripes, r.chunk_size), bucket_c)
+                 for r in reqs]
+        stacked[cid] = _pad_stripes(
+            np.ascontiguousarray(np.concatenate(parts)), use_device)
+    if kind == KIND_DECODE_CONCAT:
+        want_phys = [leader.chunk_index(i) for i in range(k)]
+    else:
+        want_phys = list(reqs[0].need)
+    got = leader.decode_batch(stacked, want_phys)
+    got = {i: np.asarray(b) for i, b in got.items()}
+    out: List = []
+    s0 = 0
+    for r in reqs:
+        s1, c = s0 + r.n_stripes, r.chunk_size
+        if kind == KIND_DECODE_CONCAT:
+            data = np.stack([got[want_phys[i]][s0:s1, :c]
+                             for i in range(k)], axis=1)   # (S, k, C)
+            out.append(np.ascontiguousarray(data).reshape(-1))
+        else:
+            out.append({i: np.ascontiguousarray(
+                got[i][s0:s1, :c]).reshape(-1) for i in r.need})
+        s0 = s1
+    return out

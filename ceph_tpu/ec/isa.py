@@ -24,6 +24,11 @@ DEFAULT_M = 3
 
 
 class ErasureCodeIsa(ErasureCodeMatrixRS):
+    # isa-matrix semantics: the tpu plugin inherits this family, so isa
+    # and tpu requests of equal (technique, k, m) coalesce into one
+    # dispatch batch (they are byte-identical by construction + test)
+    signature_family = "isa-matrix"
+
     def __init__(self):
         super().__init__()
         self.technique = "reed_sol_van"

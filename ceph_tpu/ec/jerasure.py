@@ -65,6 +65,10 @@ def _systematic(coding: np.ndarray) -> np.ndarray:
 
 
 class ErasureCodeJerasure(ErasureCodeMatrixRS):
+    # jerasure matrices differ from isa's for the same (technique, k,
+    # m), so the family keeps its requests in their own dispatch groups
+    signature_family = "jerasure"
+
     def __init__(self, technique: str = "reed_sol_van"):
         super().__init__()
         self.technique = technique

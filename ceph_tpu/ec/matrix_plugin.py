@@ -23,6 +23,26 @@ class ErasureCodeMatrixRS(ErasureCode):
     # chunks (bitmatrix packet codes): decode then uses the host path
     _device_decode_supported = True
 
+    # matrix codes are stripe- and block-independent, so the dispatch
+    # scheduler (ceph_tpu/dispatch) may coalesce signature-equal
+    # requests into one padded device call
+    dispatch_batchable = True
+    # codecs with byte-identical matrix semantics share a family so
+    # their requests group cross-plugin (tpu == isa by construction);
+    # None = the concrete class name
+    signature_family: "str | None" = None
+
+    def codec_signature(self):
+        """The dispatcher's grouping key: everything the coding matrix
+        is derived from.  Two impls with equal signatures encode and
+        decode byte-identically, so their requests may share a call."""
+        return (self.signature_family or type(self).__name__,
+                self.k, self.m,
+                getattr(self, "technique", ""),
+                getattr(self, "w", 0),
+                getattr(self, "packetsize", 0),
+                tuple(self.chunk_mapping))
+
     def __init__(self):
         super().__init__()
         self.k = 0

@@ -24,7 +24,15 @@ from .isa import ErasureCodeIsa
 class ErasureCodeTpu(ErasureCodeIsa):
     """isa-matrix semantics with the device backend on by default; the
     batched stripe entry points (encode_batch/decode_batch) are inherited
-    from ErasureCodeMatrixRS and dispatch to the MXU bit-matmul."""
+    from ErasureCodeMatrixRS and dispatch to the MXU bit-matmul.
+
+    This codec is the dispatch scheduler's primary target
+    (ceph_tpu/dispatch): ``signature_family = "isa-matrix"`` (inherited)
+    lets concurrent requests against tpu AND host-isa instances of the
+    same (technique, k, m) coalesce into ONE padded device call, and the
+    pointwise byte layout (``_stripe_block() == 1``) makes the
+    scheduler's power-of-two chunk-size padding output-preserving.
+    """
 
     def init(self, profile) -> None:
         profile = dict(profile)
@@ -34,3 +42,11 @@ class ErasureCodeTpu(ErasureCodeIsa):
     def encode_batch_device(self, data):
         """jnp in/out; composes under jit / Mesh shardings."""
         return self.device().encode_device(data)
+
+    def decode_batch_device(self, survivors, srcs, want_rows):
+        """Batched reconstruction on the device backend: *survivors*
+        (S, len(srcs), C) stacked in ``srcs`` order, returns
+        (S, len(want_rows), C) — the recovery-path twin of
+        ``encode_batch_device`` for mesh/bench drivers."""
+        return self.device().decode_data(survivors, tuple(srcs),
+                                         tuple(want_rows))

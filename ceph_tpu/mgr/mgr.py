@@ -381,18 +381,25 @@ class Manager(Dispatcher):
         out: List[str] = []
         for hname in sorted(by_name):
             base = self._prom_name(f"ceph_{hname}")
-            out.append(f"# HELP {base} latency distribution "
-                       f"(axis buckets exported as seconds)")
+            # axis-0 unit drives the exported scale: usec axes render
+            # as seconds (Prometheus convention); dimensionless axes
+            # (e.g. the dispatcher's batch occupancy) render raw
+            ax0 = by_name[hname][0][1].axes[0]
+            usec = ax0.name.endswith("_usec")
+            scale = 1e6 if usec else 1.0
+            unit = "seconds" if usec else ax0.name
+            out.append(f"# HELP {base} {ax0.name} distribution "
+                       f"(axis buckets exported as {unit})")
             out.append(f"# TYPE {base} histogram")
             for logger, hist in sorted(by_name[hname]):
                 label = self._prom_name(logger)
                 for edge, cum in hist.cumulative_axis0():
                     le = "+Inf" if edge == float("inf") \
-                        else repr(edge / 1e6)
+                        else repr(edge / scale)
                     out.append(f'{base}_bucket{{daemon="{label}",'
                                f'le="{le}"}} {cum}')
                 out.append(f'{base}_sum{{daemon="{label}"}} '
-                           f'{hist.axis0_sum / 1e6}')
+                           f'{hist.axis0_sum / scale}')
                 out.append(f'{base}_count{{daemon="{label}"}} '
                            f'{hist.total_count}')
         return out

@@ -37,6 +37,7 @@ from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..dispatch import g_dispatcher
 from ..msg import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply,
@@ -44,8 +45,7 @@ from ..msg import (
 from ..trace import g_perf_histograms, g_tracer, latency_in_bytes_axes
 from ..os_store import MemStore, Transaction, hobject_t
 from ..utils.crc32c import crc32c
-from .ecutil import HashInfo, decode as ec_decode, \
-    decode_concat as ec_decode_concat, encode as ec_encode, stripe_info_t
+from .ecutil import HashInfo, stripe_info_t
 
 SIZE_ATTR = "_size"          # logical object size (un-padded)
 DIGEST_ATTR = "_data_digest"  # crc32c recorded at full-object write
@@ -271,17 +271,21 @@ class ECBackend:
         """The one batched-encode funnel: span (tracer on) + latency x
         bytes histogram (always).  Host-side wall clock only — the
         encode itself already materializes chunks for the wire, so no
-        extra device sync is introduced."""
+        extra device sync is introduced.  Execution goes through the
+        dynamic-batching device scheduler (ceph_tpu/dispatch), which is
+        an exact passthrough at the default window=0 and coalesces
+        signature-equal requests from other PGs otherwise."""
         t0 = time.perf_counter()
+        want = set(range(self.n))
         if g_tracer.enabled:
             with g_tracer.span("ec_encode") as sp:
                 if sp is not None:      # enable() can race the check
                     sp.tags["bytes"] = len(data)
-                shards = ec_encode(self.sinfo, self.ec_impl, data,
-                                   set(range(self.n)))
+                shards = g_dispatcher.encode(self.sinfo, self.ec_impl,
+                                             data, want)
         else:
-            shards = ec_encode(self.sinfo, self.ec_impl, data,
-                               set(range(self.n)))
+            shards = g_dispatcher.encode(self.sinfo, self.ec_impl, data,
+                                         want)
         self.hist_encode.inc((time.perf_counter() - t0) * 1e6, len(data))
         return shards
 
@@ -896,7 +900,8 @@ class ECBackend:
         try:
             data = self._decode_timed(
                 sum(len(b) for b in rd.chunks.values()),
-                ec_decode_concat, self.sinfo, self.ec_impl, arrays)
+                g_dispatcher.decode_concat, self.sinfo, self.ec_impl,
+                arrays)
         except IOError:
             rd.on_done(-5, b"", rd.size, rd.user_attrs)
             return
@@ -911,6 +916,6 @@ class ECBackend:
                   for i, b in source_chunks.items()}
         rec = self._decode_timed(
             sum(len(b) for b in source_chunks.values()),
-            ec_decode, self.sinfo, self.ec_impl, arrays,
+            g_dispatcher.decode, self.sinfo, self.ec_impl, arrays,
             sorted(missing_shards))
         return {i: rec[i].tobytes() for i in rec}

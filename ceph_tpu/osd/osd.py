@@ -727,6 +727,10 @@ class OSD(Dispatcher):
     def tick(self, now: float) -> None:
         """Heartbeat tick: ping peers, report silent ones to the mon."""
         self.now = now
+        # flush EC dispatch batches whose collection window expired
+        # (async submitters without a result() demand rely on this)
+        from ..dispatch import g_dispatcher
+        g_dispatcher.poll()
         peers = [o for o in range(self.osdmap.max_osd)
                  if o != self.osd_id and self.osdmap.is_up(o)]
         for peer in peers:

@@ -15,11 +15,12 @@ Collectives ride ICI: encode needs none (the contraction dim is replicated);
 cluster-wide reductions (chunk checksums, placement histograms) are psums.
 """
 from .mesh import make_mesh, mesh_shape_for
-from .ec import ShardedRS
+from .ec import ShardedRS, drain_sharded, mesh_roofline
 from .step import pipeline_step, example_pipeline_args
 from .crush import ShardedFastRule, sharded_fast_rule
 
 __all__ = [
-    "make_mesh", "mesh_shape_for", "ShardedRS",
+    "make_mesh", "mesh_shape_for", "ShardedRS", "drain_sharded",
+    "mesh_roofline",
     "pipeline_step", "ShardedFastRule", "sharded_fast_rule", "example_pipeline_args",
 ]

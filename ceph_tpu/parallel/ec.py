@@ -27,6 +27,56 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 
+def drain_sharded(out) -> int:
+    """Completion fence for a MESH-SHARDED output: fetch one element
+    from EVERY addressable shard of *out*; returns the number of shards
+    drained.
+
+    The single-device drain (bench/fence.py) fetches one element of the
+    last output — enough there because PJRT executes per device in
+    submission order.  A sharded output extends that contract per
+    device: device d's dispatches are only proven complete by a
+    readback from a buffer ON d, so the mesh fence touches each shard
+    once (one element each, never a full fetch — a large device->host
+    transfer flips a tunnelled transport into sync-dispatch mode and
+    poisons later measurements).  Unsharded / host values fall back to
+    the single drain.
+    """
+    bur = getattr(out, "block_until_ready", None)
+    if bur is not None:
+        bur()
+    shards = getattr(out, "addressable_shards", None)
+    if not shards:
+        from ..bench.fence import drain
+        drain(out)
+        return 1
+    n = 0
+    for sh in shards:
+        piece = sh.data
+        try:
+            one = piece.ravel()[:1]
+        except Exception:
+            one = piece
+        np.asarray(one)   # THE fence: the device->host readback
+        n += 1
+    return n
+
+
+def mesh_roofline(gibs: float, workload, mesh: Mesh,
+                  platform: str = "", device_kind: str = ""):
+    """Roofline verdict for a mesh-wide throughput reading: the chip
+    peaks scale by mesh size (N devices = N chips of headroom), so a
+    sharded reading is flagged suspect only above the MESH's physics,
+    not a single chip's."""
+    from ..bench.roofline import validate_reading
+    dev = np.asarray(mesh.devices).ravel()[0]
+    return validate_reading(
+        gibs, workload,
+        platform or getattr(dev, "platform", "unknown"),
+        device_kind or getattr(dev, "device_kind", ""),
+        n_devices=mesh.size)
+
+
 class ShardedRS:
     """Mesh-wide executor for one (k+m, k) systematic code.
 
@@ -63,6 +113,19 @@ class ShardedRS:
         # host-side cache so device memory cannot grow without bound
         self._dev_decode_bits: OrderedDict = OrderedDict()
         self._dev_decode_cap = 2516
+
+    # -- completion fence (the multichip ROADMAP item) -----------------------
+    def drain(self, out) -> int:
+        """Prove *out* complete on EVERY device of the mesh (one-element
+        fetch per shard); returns the shard count drained.  Fenced
+        mesh measurements must stop the clock here, not at
+        block_until_ready (see drain_sharded)."""
+        return drain_sharded(out)
+
+    def roofline(self, gibs: float, workload):
+        """Physics verdict for a mesh-wide reading, peaks scaled by
+        this mesh's device count."""
+        return mesh_roofline(gibs, workload, self.mesh)
 
     # -- encode -------------------------------------------------------------
     def encode_device(self, data: jnp.ndarray) -> jnp.ndarray:

@@ -33,6 +33,10 @@ def parse_args(argv=None):
                    choices=("random", "exhaustive"))
     p.add_argument("--batch", type=int, default=0,
                    help="objects per batched device call (tpu plugin)")
+    p.add_argument("--dispatch", type=int, default=0,
+                   help="concurrent objects coalesced per flush through "
+                        "the dynamic-batching dispatch scheduler "
+                        "(docs/DISPATCH.md); 0 = off")
     p.add_argument("--erased", type=int, action="append", default=[])
     return p.parse_args(argv)
 
@@ -49,6 +53,54 @@ def main(argv=None) -> int:
     size = args.size
     rng = np.random.default_rng(0)
     data = rng.integers(0, 256, size=size, dtype=np.uint8)
+
+    if args.dispatch and args.workload != "encode":
+        print("--dispatch only measures the encode workload; refusing "
+              "to print an uncoalesced decode as --dispatch output",
+              file=sys.stderr)
+        return 1
+
+    if args.workload == "encode" and args.dispatch:
+        # N concurrent submissions per iteration, coalesced into one
+        # padded device call by the scheduler (the cross-PG shape the
+        # OSD sees under load; --batch is the within-one-op shape)
+        from ..common.config import g_conf
+        from ..dispatch import KIND_ENCODE, batchable, g_dispatcher
+        from ..osd.ecutil import stripe_info_t
+        C = codec.get_chunk_size(size)
+        if not batchable(codec, C, KIND_ENCODE):
+            print(f"plugin {args.plugin!r} is not dispatch-batchable "
+                  f"(no coalescing would happen); refusing to print a "
+                  f"serial measurement as --dispatch output",
+                  file=sys.stderr)
+            return 1
+        sinfo = stripe_info_t(k, k * C)
+        padded = np.resize(data, k * C)
+        want = set(range(n))
+        saved = {nm: g_conf.values.get(nm) for nm in
+                 ("ec_dispatch_batch_max", "ec_dispatch_batch_window_us")}
+        g_conf.set_val("ec_dispatch_batch_max", args.dispatch)
+        g_conf.set_val("ec_dispatch_batch_window_us", 10**7)
+        try:
+            for f in [g_dispatcher.submit_encode(sinfo, codec, padded,
+                                                 want)
+                      for _ in range(args.dispatch)]:
+                f.result()            # warm + compile
+            t0 = time.perf_counter()
+            for _ in range(args.iterations):
+                futs = [g_dispatcher.submit_encode(sinfo, codec, padded,
+                                                   want)
+                        for _ in range(args.dispatch)]
+                for f in futs:
+                    f.result()
+            dt = time.perf_counter() - t0
+        finally:
+            for nm, v in saved.items():
+                g_conf.rm_val(nm) if v is None else g_conf.set_val(nm, v)
+            g_dispatcher.flush()
+        kib = args.iterations * args.dispatch * size // 1024
+        print(f"{dt:.6f}\t{kib}")
+        return 0
 
     if args.workload == "encode":
         if args.batch and hasattr(codec, "encode_batch"):

@@ -202,3 +202,13 @@ def latency_axes() -> List[PerfHistogramAxis]:
     byte axis (MDS requests, CRUSH batch mapping)."""
     return [PerfHistogramAxis("latency_usec", min=0, quant_size=100,
                               buckets=32, scale_type=SCALE_LOG2)]
+
+
+def occupancy_axes() -> List[PerfHistogramAxis]:
+    """1D batch occupancy (requests per coalesced device flush) —
+    linear unit buckets.  Occupancies 0..64 are individually visible
+    (value v lands in bucket 1+v, the last bucket is overflow), so a
+    FULL default-sized batch (ec_dispatch_batch_max = 64) has its own
+    bucket instead of vanishing into +Inf."""
+    return [PerfHistogramAxis("batch_occupancy", min=0, quant_size=1,
+                              buckets=67, scale_type=SCALE_LINEAR)]

@@ -298,7 +298,14 @@ def test_smoke_mode_end_to_end():
         assert m["fenced"] is True
         assert {"median", "iqr", "min"} <= set(m["stats"])
         assert m["roofline"]["verdict"] in ("ok", "suspect", "unknown")
-    assert {"ec_encode_k8m4_fenced", "ec_decode_k8m4_e2_fenced"} <= names
+    assert {"ec_encode_k8m4_fenced", "ec_decode_k8m4_e2_fenced",
+            "ec_dispatch_coalesce_fenced",
+            "ec_dispatch_serial_fenced"} <= names
+    # the coalesce metric carries its serial twin and speedup
+    mc = next(m for m in out["metrics"]
+              if m["name"] == "ec_dispatch_coalesce_fenced")
+    assert mc["serial_gibs"] > 0 and mc["speedup"] > 0
+    assert mc["batch_occupancy"] == mc["n_requests"] == 8
     # the gate ran (warn mode) and the observability counters moved
     assert "gate" in out
     assert out["perf"]["dispatches"] > 0
@@ -331,3 +338,25 @@ def test_workload_metrics_in_process():
         g_kernel_timer.enable(False)
         g_kernel_timer.reset()
     assert workloads.parity_check(matrix) is True
+
+
+def test_dispatch_coalesce_workload_in_process():
+    """measure_dispatch_coalesce leaves the dispatcher drained and the
+    config untouched, and both metric records validate."""
+    from ceph_tpu.bench import workloads
+    from ceph_tpu.common.config import g_conf
+    from ceph_tpu.dispatch import g_dispatcher
+
+    before = {n: g_conf.values.get(n) for n in
+              ("ec_dispatch_batch_max", "ec_dispatch_batch_window_us")}
+    mc, ms = workloads.measure_dispatch_coalesce(
+        n_requests=4, object_bytes=16384, target_seconds=0.1,
+        repeats=2, warmup=1)
+    for m in (mc, ms):
+        schema.validate_metric(m)
+        assert m["fenced"] is True and m["value"] > 0
+    assert mc["batch_occupancy"] == 4
+    assert mc["speedup"] > 0 and mc["serial_gibs"] > 0
+    assert g_dispatcher.dump()["pending"] == 0
+    after = {n: g_conf.values.get(n) for n in before}
+    assert after == before, "workload leaked dispatch config"

@@ -29,6 +29,8 @@ def clean_tracing():
     g_flight_recorder.clear()
     g_conf.rm_val("op_complaint_time")
     g_conf.rm_val("tracing_spans")
+    g_conf.rm_val("ec_dispatch_batch_window_us")
+    g_conf.rm_val("ec_dispatch_batch_max")
 
 
 # ---- span primitives -------------------------------------------------------
@@ -157,6 +159,13 @@ def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
     g_tracer.enable()                                 # spans only
     assert cl.write_full("trace", "o_on", b"y" * 20000) == 0
     assert calls["n"] == 0, "span tracing added a device sync"
+    # dispatch-PR extension: the batched path (non-zero collection
+    # window) must stay sync-free too, tracing on or off
+    g_tracer.enable(False)
+    g_conf.set_val("ec_dispatch_batch_window_us", 100_000)
+    g_conf.set_val("ec_dispatch_batch_max", 8)
+    assert cl.write_full("trace", "o_batched", b"z" * 20000) == 0
+    assert calls["n"] == 0, "batched dispatch added a device sync"
 
 
 def test_slow_op_span_tree_and_histogram_dump(clean_tracing):

@@ -120,6 +120,35 @@ def test_histogram_families_cumulative_and_consistent(exposition):
             assert sm >= 0.0
 
 
+def test_dispatch_occupancy_family_and_counters(exposition):
+    """Dispatch-PR golden coverage: the batch-occupancy histogram
+    renders as a real histogram family (monotone cumulative buckets,
+    +Inf == _count — enforced for every family by the generic test
+    above) with RAW occupancy bucket edges (not usec-scaled), and the
+    dispatch perf counters render as daemon series."""
+    types, samples = _parse(exposition)
+    fam = "ceph_dispatch_batch_occupancy_histogram"
+    assert types.get(fam) == "histogram", \
+        "batch-occupancy histogram family missing"
+    buckets = [(_le_of(labels), v) for n, labels, v in samples
+               if n == f"{fam}_bucket"]
+    assert buckets, "no occupancy buckets rendered"
+    # occupancy axis is dimensionless: unit-quant linear edges survive
+    # un-scaled (1.0, 2.0, ... not 1e-06); the fixture's writes all ran
+    # at occupancy 1, so the le="1.0" bucket is still 0 and le="2.0"
+    # carries them
+    les = sorted(le for le, _v in buckets if le != math.inf)
+    assert les[0] == 0.0 and 2.0 in les, f"unexpected edges {les[:4]}"
+    counts = {n for n, _l, _v in samples}
+    assert f"{fam}_count" in counts and f"{fam}_sum" in counts
+    # dispatch counters on the daemon surface
+    sub = [v for n, _l, v in samples
+           if n == "ceph_daemon_dispatch_submitted"]
+    assert sub and sub[0] > 0, "dispatch_submitted counter missing"
+    assert any(n == "ceph_daemon_dispatch_passthrough"
+               for n, _l, _v in samples)
+
+
 def test_op_histograms_carry_the_writes(exposition):
     """The two writes + one read issued by the fixture are visible in
     some OSD's latency histograms (non-zero _count)."""

@@ -213,6 +213,23 @@ def test_ec_benchmark_encode_and_decode(capsys):
     assert int(kib) == 5 * 16
 
 
+def test_ec_benchmark_dispatch_mode(capsys):
+    """--dispatch N coalesces N concurrent encodes per iteration
+    through the dynamic-batching scheduler and leaves it drained."""
+    from ceph_tpu.common.config import g_conf
+    from ceph_tpu.dispatch import g_dispatcher
+    assert ec_benchmark.main(["-p", "isa", "-P", "k=4", "-P", "m=2",
+                              "-P", "backend=host", "-S", "16384",
+                              "-i", "2", "-w", "encode",
+                              "--dispatch", "4"]) == 0
+    out = capsys.readouterr().out.strip()
+    secs, kib = out.split("\t")
+    assert float(secs) > 0
+    assert int(kib) == 2 * 4 * 16
+    assert g_dispatcher.dump()["pending"] == 0
+    assert g_conf.values.get("ec_dispatch_batch_window_us") is None
+
+
 def test_ceph_osd_pool_ls_detail(tmp_path, capsys):
     """ceph osd pool ls [detail]: names, then the pg_pool_t summary
     line with flags/quotas/tiering (MonCommands.h 'osd pool ls')."""
