@@ -180,15 +180,19 @@ class MiniCluster:
             "run one upmap balancer pass")
         from .common import g_kernel_timer
         from .trace import g_flight_recorder, g_perf_histograms, g_tracer
-        asok.register(
-            "prometheus metrics",
-            lambda c, a: self.mgr.prometheus_metrics(
+        def _prometheus(c, a):
+            from .fault import g_breakers as _breakers
+            self.mgr.check_degraded_codecs()   # fresh breaker -> check
+            return self.mgr.prometheus_metrics(
                 self.perf_collection,
                 histograms=g_perf_histograms,
                 kernel_timer=g_kernel_timer,
                 slow_ops={o.name: o.op_tracker.num_slow_ops
-                          for o in self.osds.values()}),
-            "prometheus text exposition")
+                          for o in self.osds.values()},
+                breakers=_breakers)
+
+        asok.register("prometheus metrics", _prometheus,
+                      "prometheus text exposition")
         asok.register(
             "perf histogram dump",
             lambda c, a: g_perf_histograms.dump(
@@ -251,6 +255,51 @@ class MiniCluster:
             "dispatch flush",
             lambda c, a: {"flushed": g_dispatcher.flush()},
             "flush every pending EC dispatch queue now")
+        from .fault import fault_perf_counters, g_breakers, g_faults
+        self.perf_collection.add(fault_perf_counters())
+
+        def _fault_inject(c, a):
+            # arm a site: fault inject name=<site> mode=prob|nth|once|
+            # always [p=] [n=] [seed=] [count=] [error=device|timeout]
+            # [match=]; validation errors surface as JSON like
+            # every other asok hook
+            casts = (("mode", str), ("p", float), ("n", int),
+                     ("seed", int), ("count", int), ("error", str),
+                     ("match", str))
+            unknown = set(a) - {"name"} - {k for k, _ in casts}
+            if unknown:
+                # a typo'd trigger key must not silently arm a very
+                # different fault (mdoe=prob -> mode=always)
+                raise ValueError(
+                    f"unknown argument(s) {sorted(unknown)}; expected "
+                    f"name, mode, p, n, seed, count, error, match")
+            kw = {}
+            for key, cast in casts:
+                if key in a:
+                    try:
+                        kw[key] = cast(a[key])
+                    except (TypeError, ValueError):
+                        raise ValueError(
+                            f"invalid value '{a[key]}' for '{key}'")
+            spec = g_faults.inject(a.get("name", ""), **kw)
+            return {"site": spec.site, "armed": spec.dump()}
+
+        asok.register(
+            "fault inject", _fault_inject,
+            "arm a fault-injection site (mode=prob|nth|once|always, "
+            "p=, n=, seed=, count=, error=, match=)")
+        asok.register(
+            "fault list",
+            lambda c, a: g_faults.dump(),
+            "fault-injection site catalog + armed triggers")
+        asok.register(
+            "fault clear",
+            lambda c, a: {"cleared": g_faults.clear(a.get("name", ""))},
+            "disarm one site (name=) or every armed site")
+        asok.register(
+            "breaker dump",
+            lambda c, a: g_breakers.dump(),
+            "per-codec-signature circuit breaker states")
         asok.register(
             "arch probe",
             lambda c, a: __import__("ceph_tpu.arch", fromlist=["probe"])
@@ -426,7 +475,11 @@ class MiniCluster:
 
     def health(self) -> str:
         """HEALTH_OK / HEALTH_WARN with reasons (mon health checks):
-        down osds, degraded/peering pgs, pinned pg_temp remaps."""
+        down osds, degraded/peering pgs, pinned pg_temp remaps,
+        degraded codec signatures (TPU_CODEC_DEGRADED)."""
+        # refresh breaker-derived checks so health() is current even
+        # between mgr ticks (tests and CLIs call it directly)
+        self.mgr.check_degraded_codecs()
         reasons = []
         n_down = sum(1 for o in range(self.mon.osdmap.max_osd)
                      if not self.mon.osdmap.is_up(o))

@@ -104,6 +104,25 @@ def build_options() -> List[Option]:
         .set_description("EC dispatch scheduler: total pending requests "
                          "across all queues before a forced "
                          "backpressure flush"),
+        Option("ec_device_retry_max", OPT_INT).set_default(2)
+        .set_description("retries (after the first attempt) for a "
+                         "transient device codec-call failure before "
+                         "the call degrades to the CPU matrix path "
+                         "(ceph_tpu/fault guard)"),
+        Option("ec_device_retry_backoff_us", OPT_INT).set_default(200)
+        .set_description("base backoff between device-call retries, "
+                         "doubled per attempt (exponential)"),
+        Option("ec_device_watchdog_ms", OPT_FLOAT).set_default(0.0)
+        .set_description("per-call watchdog deadline for device codec "
+                         "calls; a call exceeding it counts as a "
+                         "failure (result discarded).  0 = disabled"),
+        Option("ec_breaker_threshold", OPT_INT).set_default(3)
+        .set_description("consecutive device-call failures that trip a "
+                         "codec signature's circuit breaker onto the "
+                         "CPU path (TPU_CODEC_DEGRADED)"),
+        Option("ec_breaker_cooldown_s", OPT_FLOAT).set_default(30.0)
+        .set_description("seconds an open breaker refuses the device "
+                         "before half-open probing it to auto-restore"),
         Option("osd_scrub_min_interval", OPT_FLOAT).set_default(86400.0)
         .set_description("seconds between periodic background scrubs "
                          "of a PG (reference osd_scrub_min_interval)"),

@@ -39,6 +39,7 @@ import numpy as np
 
 from ..common.config import g_conf
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder
+from ..fault import g_faults
 from ..trace import g_perf_histograms, g_tracer, occupancy_axes
 from .batch import Request, run_group, run_one
 from .future import DispatchFuture
@@ -59,6 +60,8 @@ l_dispatch_backpressure = 91008   # forced flushes from a full queue
 l_dispatch_stripes = 91009        # stripes through the dispatcher
 l_dispatch_bytes = 91010          # payload bytes through the dispatcher
 l_dispatch_flush_time = 91011     # time inside flush execution
+l_dispatch_fallback_reqs = 91012  # requests re-run alone after a
+                                  # batched call fell back
 DISPATCH_LAST = 91020
 
 _dispatch_pc: Optional[PerfCounters] = None
@@ -98,6 +101,10 @@ def dispatch_perf_counters() -> PerfCounters:
                               "stripes through the dispatcher")
             b.add_u64_counter(l_dispatch_bytes, "bytes",
                               "payload bytes through the dispatcher")
+            b.add_u64_counter(l_dispatch_fallback_reqs,
+                              "dispatch_fallback",
+                              "requests re-executed alone after their "
+                              "batched call fell back")
             b.add_time_avg(l_dispatch_flush_time, "flush",
                            "time inside flush execution")
             _dispatch_pc = b.create_perf_counters()
@@ -325,13 +332,25 @@ class DeviceDispatcher:
         outcomes: List = []
         with g_tracer.activate(span):
             try:
+                if g_faults.site_armed("dispatch.batch"):
+                    g_faults.check("dispatch.batch",
+                                   ctx=str(reqs[0].key or reqs[0].kind))
                 outcomes = [(True, res)
                             for res in run_group(reqs, bucket_c)]
-            except Exception:
+            except Exception as batch_err:   # noqa: BLE001 — isolated
                 # fail-fast isolation: re-run each request alone so one
                 # bad request cannot poison its batchmates
                 pc.inc(l_dispatch_fallbacks)
+                if span is not None:
+                    span.event("batch_fallback", error=repr(batch_err))
                 for r in reqs:
+                    pc.inc(l_dispatch_fallback_reqs)
+                    if r.parent_span is not None:
+                        # surface the degradation on the SUBMITTER's op
+                        # span, where slow-op forensics will look
+                        r.parent_span.event("dispatch_fallback",
+                                            kind=r.kind,
+                                            error=repr(batch_err))
                     try:
                         outcomes.append((True, run_one(r)))
                     except Exception as e:   # noqa: BLE001 — per-req

@@ -5,6 +5,14 @@ Wires a ``MatrixRSCodec`` (host oracle) and optionally the TPU device backend
 selected by the profile key ``backend=host|tpu|auto`` (auto = TPU when a
 device is usable, else host).  Both backends are byte-identical by
 construction and by test.
+
+Robustness (docs/ROBUSTNESS.md): every device-path call runs through
+the fault guard — injection site, bounded retry with backoff, watchdog
+deadline, circuit-breaker accounting — and degrades to the
+byte-identical host matrix path on ``DeviceUnavailable``, so a device
+failure costs throughput, never a client op.  A tripped breaker makes
+``_use_device`` route the whole signature to the host path until a
+half-open probe restores it.
 """
 from __future__ import annotations
 
@@ -12,6 +20,9 @@ from typing import Dict, Set
 
 import numpy as np
 
+from ..fault import (DeviceUnavailable, fault_perf_counters, g_breakers,
+                     l_fault_cpu_fallbacks, run_device_call)
+from ..trace import g_tracer
 from .base import ErasureCode
 from .rs_codec import MatrixRSCodec
 
@@ -72,6 +83,19 @@ class ErasureCodeMatrixRS(ErasureCode):
         return chunk_size
 
     # -- backend (selection inherited from ErasureCode) ----------------------
+    def _use_device(self) -> bool:
+        """Backend selection gated by the signature's circuit breaker:
+        an open breaker routes every call to the host matrix path
+        (byte-identical by construction) until the half-open probe
+        window lets a device call through to test recovery."""
+        if not super()._use_device():
+            return False
+        return g_breakers.allow_device(self.codec_signature())
+
+    def _note_cpu_fallback(self, site: str) -> None:
+        fault_perf_counters().inc(l_fault_cpu_fallbacks)
+        g_tracer.event("cpu_fallback", site=site)
+
     def device(self):
         if self._device is None:
             from ..ops.gf_matmul import DeviceRSBackend
@@ -109,9 +133,15 @@ class ErasureCodeMatrixRS(ErasureCode):
                 f"block ({self._stripe_block()} bytes)")
         from ..common.kernel_trace import g_kernel_timer
         if self._use_device():
-            return g_kernel_timer.timed(
-                "ec_encode_batch", self._device_encode_batch,
-                np.ascontiguousarray(data))
+            data_c = np.ascontiguousarray(data)
+            try:
+                return run_device_call(
+                    self.codec_signature(), "device.encode_batch",
+                    lambda: g_kernel_timer.timed(
+                        "ec_encode_batch", self._device_encode_batch,
+                        data_c))
+            except DeviceUnavailable:
+                self._note_cpu_fallback("device.encode_batch")
 
         def host():
             flat = np.ascontiguousarray(data.transpose(1, 0, 2)).reshape(
@@ -142,10 +172,10 @@ class ErasureCodeMatrixRS(ErasureCode):
         want = [p2l[p] for p in want_phys]
         srcs, want_data, want_coding, missing_data = plan_decode(
             self.k, chunks, want)
-        out: Dict[int, np.ndarray] = {i: chunks[i] for i in want
-                                      if i in chunks}
-        if self._use_device() and self._device_decode_supported and \
-                hasattr(self.device(), "decode_data"):
+
+        def device_path() -> Dict[int, np.ndarray]:
+            out: Dict[int, np.ndarray] = {i: chunks[i] for i in want
+                                          if i in chunks}
             dev = self.device()
             by_id: Dict[int, np.ndarray] = {}
             if missing_data:
@@ -163,8 +193,18 @@ class ErasureCodeMatrixRS(ErasureCode):
                 for i in want_coding:
                     out[i] = coding[:, i - self.k]
             return {l2p[i]: b for i, b in out.items()}
+
+        if self._use_device() and self._device_decode_supported and \
+                hasattr(self.device(), "decode_data"):
+            try:
+                return run_device_call(self.codec_signature(),
+                                       "device.decode_batch",
+                                       device_path)
+            except DeviceUnavailable:
+                self._note_cpu_fallback("device.decode_batch")
         # host: flatten stripes into the byte axis (blocks never span
         # stripes because each stripe's C is a whole number of blocks)
+        out = {i: chunks[i] for i in want if i in chunks}
         some = next(iter(chunks.values()))
         s, c = some.shape
         if c % self._stripe_block():
@@ -185,7 +225,13 @@ class ErasureCodeMatrixRS(ErasureCode):
         # in logical rows.  mapping= profiles permute the two.
         data = np.stack([encoded[self.chunk_index(i)] for i in range(self.k)])
         if self._use_device():
-            coding = self._device_encode(data)
+            try:
+                coding = run_device_call(
+                    self.codec_signature(), "device.encode_chunks",
+                    lambda: self._device_encode(data))
+            except DeviceUnavailable:
+                self._note_cpu_fallback("device.encode_chunks")
+                coding = self.codec.encode(data)
         else:
             coding = self.codec.encode(data)
         for i in range(self.m):

@@ -18,6 +18,7 @@ from typing import Dict, Sequence
 
 import numpy as np
 
+from ..fault import run_device_call
 from .isa import ErasureCodeIsa
 
 
@@ -40,13 +41,20 @@ class ErasureCodeTpu(ErasureCodeIsa):
         super().init(profile)
 
     def encode_batch_device(self, data):
-        """jnp in/out; composes under jit / Mesh shardings."""
-        return self.device().encode_device(data)
+        """jnp in/out; composes under jit / Mesh shardings.  Guarded
+        (retry/backoff/watchdog + breaker accounting) but with no host
+        fallback — callers want device-resident arrays, so exhaustion
+        raises DeviceUnavailable for the driver to handle."""
+        return run_device_call(
+            self.codec_signature(), "tpu.encode_batch_device",
+            lambda: self.device().encode_device(data))
 
     def decode_batch_device(self, survivors, srcs, want_rows):
         """Batched reconstruction on the device backend: *survivors*
         (S, len(srcs), C) stacked in ``srcs`` order, returns
         (S, len(want_rows), C) — the recovery-path twin of
         ``encode_batch_device`` for mesh/bench drivers."""
-        return self.device().decode_data(survivors, tuple(srcs),
-                                         tuple(want_rows))
+        return run_device_call(
+            self.codec_signature(), "tpu.decode_batch_device",
+            lambda: self.device().decode_data(survivors, tuple(srcs),
+                                              tuple(want_rows)))

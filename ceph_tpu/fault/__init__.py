@@ -1,0 +1,33 @@
+"""Device-path fault injection and graceful degradation.
+
+Three layers (docs/ROBUSTNESS.md):
+
+- ``registry``: config-driven fault-injection sites (probabilistic /
+  every-Nth / one-shot triggers, deterministic seeding) with the admin
+  socket's ``fault inject|list|clear`` control surface — the device
+  path's answer to Ceph's ``ms inject socket failures`` /
+  ``bluestore_debug_inject_read_err``.
+- ``guard``: bounded retry + exponential backoff + per-call watchdog
+  deadline around every device codec call.
+- ``breaker``: per-codec-signature circuit breakers that trip persistent
+  failures onto the byte-identical CPU matrix path, surface
+  ``TPU_CODEC_DEGRADED`` on health/Prometheus, and half-open-probe the
+  device to auto-restore.
+"""
+from .breaker import BreakerBoard, g_breakers
+from .guard import DeviceUnavailable, DeviceWatchdogTimeout, \
+    run_device_call
+from .registry import (FaultRegistry, FaultSpec, InjectedDeviceError,
+                       InjectedFault, InjectedTimeout, SITE_CATALOG,
+                       fault_perf_counters, g_faults, l_fault_cpu_fallbacks,
+                       l_fault_eio_injected, l_fault_eio_reconstructs,
+                       l_fault_msg_drops)
+
+__all__ = [
+    "BreakerBoard", "g_breakers",
+    "DeviceUnavailable", "DeviceWatchdogTimeout", "run_device_call",
+    "FaultRegistry", "FaultSpec", "InjectedDeviceError", "InjectedFault",
+    "InjectedTimeout", "SITE_CATALOG", "fault_perf_counters", "g_faults",
+    "l_fault_cpu_fallbacks", "l_fault_eio_injected",
+    "l_fault_eio_reconstructs", "l_fault_msg_drops",
+]

@@ -1,0 +1,200 @@
+"""Per-codec-signature circuit breakers — degrade to the CPU twin.
+
+The one luxury this reproduction has over generic serving stacks: every
+device codec has a byte-identical CPU reference path (the isa/jerasure
+matrix semantics the device path was built to match, proven by the
+parity suites).  So "degraded" here costs throughput, never
+correctness — in the spirit of straggler-tolerant coded computation
+(arxiv 1804.10331), where work lost to a slow/broken worker is served
+from redundancy instead of failing the request.
+
+State machine, keyed by the dispatch scheduler's codec signature
+(family, k, m, technique, w, packetsize, mapping):
+
+- CLOSED: device allowed.  ``ec_breaker_threshold`` CONSECUTIVE
+  failures trip the breaker.
+- OPEN: device refused — ``ErasureCodeMatrixRS._use_device`` routes
+  every call to the host matrix path.  After ``ec_breaker_cooldown_s``
+  the breaker is HALF-OPEN.
+- HALF-OPEN (derived: open + cooldown elapsed): device allowed again,
+  so the next call is a live probe.  Success restores CLOSED
+  (``breaker_restores``); failure re-arms the cooldown.
+
+Health: any open breaker surfaces as the ``TPU_CODEC_DEGRADED``
+warning through the mgr's health checks (mon cluster log on
+transitions) and as a gauge on the Prometheus surface.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Tuple
+
+from ..common.config import g_conf
+from ..trace import g_tracer
+from .registry import (fault_perf_counters, l_fault_breaker_restores,
+                       l_fault_breaker_trips, l_fault_degraded)
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half-open"
+
+
+class _Breaker:
+    __slots__ = ("sig", "consecutive_failures", "open_since", "is_open",
+                 "trips", "restores", "last_error")
+
+    def __init__(self, sig: Tuple):
+        self.sig = sig
+        self.consecutive_failures = 0
+        self.is_open = False
+        self.open_since = 0.0
+        self.trips = 0
+        self.restores = 0
+        self.last_error = ""
+
+    def state(self, now: float, cooldown: float) -> str:
+        if not self.is_open:
+            return STATE_CLOSED
+        if now - self.open_since >= cooldown:
+            return STATE_HALF_OPEN
+        return STATE_OPEN
+
+    def dump(self, now: float, cooldown: float) -> dict:
+        return {"signature": [str(x) for x in self.sig],
+                "state": self.state(now, cooldown),
+                "consecutive_failures": self.consecutive_failures,
+                "trips": self.trips,
+                "restores": self.restores,
+                "open_for_s": round(now - self.open_since, 3)
+                if self.is_open else 0.0,
+                "last_error": self.last_error}
+
+
+class BreakerBoard:
+    """Process-wide breaker registry (one accelerator per process, so
+    one board covers every daemon — like g_dispatcher)."""
+
+    def __init__(self):
+        self._breakers: Dict[Tuple, _Breaker] = {}
+        self._lock = threading.Lock()
+
+    # ---- options (read live so `config set` applies) ----------------------
+    @staticmethod
+    def _opts() -> Tuple[int, float]:
+        return (int(g_conf.get_val("ec_breaker_threshold")),
+                float(g_conf.get_val("ec_breaker_cooldown_s")))
+
+    # ---- hot path ---------------------------------------------------------
+    def allow_device(self, sig: Tuple) -> bool:
+        """May this signature's next call use the device?  CLOSED and
+        HALF-OPEN say yes (the half-open call IS the probe); OPEN
+        within its cooldown says no.  The steady-state path (no entry,
+        or a long-healed one) is a lock-free dict read — a racing
+        trip/restore just moves one call to the other backend, which
+        is always correct."""
+        br = self._breakers.get(sig) if self._breakers else None
+        if br is None or not br.is_open:
+            return True
+        with self._lock:
+            br = self._breakers.get(sig)
+            if br is None or not br.is_open:
+                return True
+            _thr, cooldown = self._opts()
+            return br.state(time.monotonic(), cooldown) \
+                == STATE_HALF_OPEN
+
+    def record_success(self, sig: Tuple) -> None:
+        """A device call for *sig* completed: reset the failure run;
+        restore an open breaker (the half-open probe succeeded).
+        Healthy entries (closed, no failure run) return without the
+        lock so a long-ago transient doesn't tax every later call."""
+        br = self._breakers.get(sig) if self._breakers else None
+        if br is None or (not br.is_open
+                          and br.consecutive_failures == 0):
+            return
+        restored = False
+        with self._lock:
+            br = self._breakers.get(sig)
+            if br is None:
+                return
+            br.consecutive_failures = 0
+            if br.is_open:
+                br.is_open = False
+                br.restores += 1
+                restored = True
+        if restored:
+            pc = fault_perf_counters()
+            pc.inc(l_fault_breaker_restores)
+            pc.set(l_fault_degraded, self._n_open())
+            g_tracer.event("breaker_restore", signature=str(sig))
+
+    def record_failure(self, sig: Tuple, error: str = "") -> bool:
+        """A device attempt for *sig* failed; returns True when further
+        retries are pointless — this failure TRIPPED the breaker, or it
+        was a failed HALF-OPEN probe against an already-open one (the
+        device is still dead; re-arm the cooldown and let the CPU path
+        serve)."""
+        threshold, _cooldown = self._opts()
+        tripped = False
+        probe_failed = False
+        with self._lock:
+            br = self._breakers.get(sig)
+            if br is None:
+                br = self._breakers[sig] = _Breaker(sig)
+            br.consecutive_failures += 1
+            br.last_error = error
+            if br.is_open:
+                # a failed half-open probe: re-arm the cooldown
+                br.open_since = time.monotonic()
+                probe_failed = True
+            elif br.consecutive_failures >= threshold:
+                br.is_open = True
+                br.open_since = time.monotonic()
+                br.trips += 1
+                tripped = True
+        if tripped:
+            pc = fault_perf_counters()
+            pc.inc(l_fault_breaker_trips)
+            pc.set(l_fault_degraded, self._n_open())
+            g_tracer.event("breaker_trip", signature=str(sig),
+                           error=error)
+        return tripped or probe_failed
+
+    def _n_open(self) -> int:
+        with self._lock:
+            return sum(1 for br in self._breakers.values()
+                       if br.is_open)
+
+    # ---- introspection ----------------------------------------------------
+    def degraded(self) -> List[dict]:
+        """Breakers currently refusing (or probing) the device — the
+        TPU_CODEC_DEGRADED health payload."""
+        if not self._breakers:
+            return []
+        now = time.monotonic()
+        _thr, cooldown = self._opts()
+        with self._lock:
+            return [br.dump(now, cooldown)
+                    for br in self._breakers.values() if br.is_open]
+
+    def dump(self) -> dict:
+        now = time.monotonic()
+        threshold, cooldown = self._opts()
+        with self._lock:
+            entries = [br.dump(now, cooldown)
+                       for br in self._breakers.values()]
+        return {"options": {"ec_breaker_threshold": threshold,
+                            "ec_breaker_cooldown_s": cooldown},
+                "breakers": entries}
+
+    def reset(self) -> None:
+        """Forget every breaker (tests; `fault clear` leaves breakers
+        alone — degradation outlives the injection that caused it)."""
+        with self._lock:
+            self._breakers.clear()
+        fault_perf_counters().set(l_fault_degraded, 0)
+
+
+# process-wide board, like g_dispatcher
+g_breakers = BreakerBoard()

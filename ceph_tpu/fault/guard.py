@@ -1,0 +1,104 @@
+"""DeviceGuard — the one funnel every device codec call goes through.
+
+Wraps a device-path callable with the three robustness mechanisms, in
+order:
+
+1. fault-injection site check (``g_faults``) — so chaos tests exercise
+   exactly the path production errors take;
+2. bounded retry with exponential backoff for transient errors, plus a
+   per-call watchdog deadline (``ec_device_watchdog_ms``) that converts
+   an overlong call into a failure instead of letting one wedged
+   dispatch stall the op pipeline forever;
+3. per-signature circuit-breaker accounting (``g_breakers``) — N
+   consecutive failures trip the signature to the CPU matrix path.
+
+Retryable = RuntimeError lineage: the injected device/timeout kinds and
+jaxlib's XlaRuntimeError both subclass it, while semantic errors
+(IOError "not enough chunks", ValueError misalignment) do NOT and
+propagate to the caller unchanged on the first throw.
+
+After the retry budget (or an early breaker trip) the guard raises
+``DeviceUnavailable``; ``ErasureCodeMatrixRS`` catches exactly that and
+serves the call from the byte-identical host matrix path, so a client
+op never fails because the device did.
+
+Cost contract: with nothing armed and no watchdog the per-call overhead
+is one try/except frame and two clock reads — no locks, no device
+syncs.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Tuple
+
+from ..common.config import g_conf
+from ..trace import g_tracer
+from .breaker import g_breakers
+from .registry import (InjectedTimeout, fault_perf_counters, g_faults,
+                       l_fault_device_errors, l_fault_device_retries,
+                       l_fault_watchdog_timeouts)
+
+
+class DeviceUnavailable(RuntimeError):
+    """The device path is (transiently or persistently) failing for
+    this call; the caller should serve it from the CPU twin."""
+
+    def __init__(self, site: str, cause: BaseException):
+        super().__init__(f"device path unavailable at {site}: {cause!r}")
+        self.site = site
+        self.cause = cause
+
+
+class DeviceWatchdogTimeout(InjectedTimeout):
+    """A device call exceeded the per-call watchdog deadline.  The
+    result (if any) is discarded and the attempt counts as a failure;
+    in-process we cannot abort the call, but we CAN refuse to trust a
+    device that wedges and route around it."""
+
+
+def _opts() -> Tuple[int, float, float]:
+    return (max(int(g_conf.get_val("ec_device_retry_max")), 0),
+            int(g_conf.get_val("ec_device_retry_backoff_us")) / 1e6,
+            float(g_conf.get_val("ec_device_watchdog_ms")) / 1e3)
+
+
+def run_device_call(sig: Tuple, site: str, fn: Callable):
+    """Execute *fn* (a zero-arg device-path closure) under the
+    site/retry/watchdog/breaker policy for codec signature *sig*.
+
+    Raises DeviceUnavailable after the retry budget, or immediately
+    once a failure trips the breaker (further retries are pointless —
+    the CPU path will serve this and every following call)."""
+    retries, backoff, watchdog = _opts()
+    pc = fault_perf_counters()
+    last: BaseException = None
+    for attempt in range(retries + 1):
+        t0 = time.perf_counter()
+        try:
+            if g_faults.site_armed(site):
+                g_faults.check(site, ctx=str(sig))
+            out = fn()
+            if watchdog > 0 and time.perf_counter() - t0 > watchdog:
+                raise DeviceWatchdogTimeout(site, "watchdog deadline")
+        except RuntimeError as e:       # XlaRuntimeError + injected kinds
+            last = e
+            pc.inc(l_fault_device_errors)
+            if isinstance(e, DeviceWatchdogTimeout):
+                pc.inc(l_fault_watchdog_timeouts)
+            # True = retries are pointless: this failure tripped the
+            # breaker, or it was a failed half-open probe against an
+            # already-open one — either way the CPU path serves now
+            give_up = g_breakers.record_failure(sig, error=repr(e))
+            if give_up or attempt >= retries:
+                g_tracer.event("device_error", site=site,
+                               attempt=attempt, error=repr(e))
+                raise DeviceUnavailable(site, e) from e
+            pc.inc(l_fault_device_retries)
+            g_tracer.event("device_retry", site=site, attempt=attempt,
+                           error=repr(e))
+            if backoff > 0:
+                time.sleep(backoff * (2 ** attempt))
+            continue
+        g_breakers.record_success(sig)
+        return out
+    raise DeviceUnavailable(site, last)    # unreachable; loop covers it

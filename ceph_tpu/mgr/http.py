@@ -51,13 +51,16 @@ class MgrHttp:
 
         if parts == ["metrics"]:
             from ..common import g_kernel_timer
+            from ..fault import g_breakers
             from ..trace import g_perf_histograms
             slow = {o.name: o.op_tracker.num_slow_ops
                     for o in self.cluster.osds.values()} \
                 if self.cluster is not None else None
+            self.mgr.check_degraded_codecs()
             text = self.mgr.prometheus_metrics(
                 self.perf_collection, histograms=g_perf_histograms,
-                kernel_timer=g_kernel_timer, slow_ops=slow)
+                kernel_timer=g_kernel_timer, slow_ops=slow,
+                breakers=g_breakers)
             return 200, {"Content-Type":
                          "text/plain; version=0.0.4"}, text.encode()
         if not parts or parts == ["health"]:

@@ -123,6 +123,41 @@ class Manager(Dispatcher):
         if self.autoscaler_active:
             self.pg_autoscale(apply=True)
         self.check_quotas_and_fullness()
+        self.check_degraded_codecs()
+
+    # ---- codec degradation (circuit-breaker board -> health) ---------------
+    def check_degraded_codecs(self) -> None:
+        """TPU_CODEC_DEGRADED: raised while any codec signature's
+        circuit breaker is tripped to the CPU matrix path
+        (ceph_tpu/fault), cleared when every breaker restores via its
+        half-open probe.  Transitions land in the mon cluster log, the
+        check itself rides health/`ceph -s` like OSD_FULL."""
+        from ..fault import g_breakers
+        deg = g_breakers.degraded()
+        had = "TPU_CODEC_DEGRADED" in self.health_checks
+        if deg:
+            sigs = ", ".join(
+                "/".join(d["signature"][:4]) for d in deg)
+            self.health_checks["TPU_CODEC_DEGRADED"] = (
+                f"{len(deg)} codec signature(s) serving from the CPU "
+                f"matrix path: {sigs}")
+            if not had:
+                self._cluster_log("WRN",
+                                  f"Health check failed: "
+                                  f"TPU_CODEC_DEGRADED ({sigs})")
+        elif had:
+            self.health_checks.pop("TPU_CODEC_DEGRADED", None)
+            self._cluster_log("INF",
+                              "Health check cleared: TPU_CODEC_DEGRADED "
+                              "(device path restored)")
+
+    def _cluster_log(self, level: str, message: str) -> None:
+        """Best-effort mon cluster-log entry (clog->warn role); a
+        mid-election mon must not fail the health pass itself."""
+        try:
+            self.mon.log_entry(self.name, level, message)
+        except (RuntimeError, AttributeError, IndexError):
+            pass
 
     # ---- quota / full-ratio enforcement (the mon's PGMap-driven
     # OSDMonitor::tick role, fed from mgr-side usage digests) --------------
@@ -308,7 +343,8 @@ class Manager(Dispatcher):
         return re.sub(r"[^a-zA-Z0-9_:]", "_", raw)
 
     def prometheus_metrics(self, perf_collection=None, histograms=None,
-                           kernel_timer=None, slow_ops=None) -> str:
+                           kernel_timer=None, slow_ops=None,
+                           breakers=None) -> str:
         """Prometheus text exposition of cluster gauges + perf counters
         (pybind/mgr/prometheus/module.py role), grown the observability
         surfaces: ``histograms`` (a PerfHistogramCollection) renders as
@@ -329,6 +365,25 @@ class Manager(Dispatcher):
         gauge("osd_in", s["num_in_osds"], "OSDs in")
         gauge("pools", s["num_pools"], "Pools")
         gauge("pgs", s["num_pgs"], "Placement groups")
+        if self.health_checks:
+            lines.append("# HELP ceph_health_check active cluster "
+                         "health checks (1 = raised)")
+            lines.append("# TYPE ceph_health_check gauge")
+            for check in sorted(self.health_checks):
+                lines.append(f'ceph_health_check'
+                             f'{{check="{self._prom_name(check)}"}} 1')
+        if breakers is not None:
+            deg = breakers.degraded()
+            gauge("tpu_codec_degraded", len(deg),
+                  "codec signatures tripped to the CPU matrix path")
+            if deg:
+                lines.append("# HELP ceph_tpu_codec_breaker_open per-"
+                             "signature breaker state (1 = open)")
+                lines.append("# TYPE ceph_tpu_codec_breaker_open gauge")
+                for d in deg:
+                    sig = self._prom_name("_".join(d["signature"][:4]))
+                    lines.append(f'ceph_tpu_codec_breaker_open'
+                                 f'{{signature="{sig}"}} 1')
         if perf_collection is not None:
             dump = perf_collection.dump()
             for logger, counters in sorted(dump.items()):

@@ -617,6 +617,16 @@ class Monitor(Dispatcher):
         if name not in self.subscribers:
             self.subscribers.append(name)
 
+    def quorum_status(self) -> dict:
+        """This mon's view of the election ('ceph quorum_status',
+        mon/MonCommands.h): rank, election epoch (odd = electing, even
+        = decided), leader rank (-1 mid-election) and quorum set."""
+        return {"rank": self.rank,
+                "election_epoch": self.election_epoch,
+                "leader_rank": self.leader_rank,
+                "is_leader": self.is_leader(),
+                "quorum": sorted(self.quorum)}
+
     # ---- cluster log (LogMonitor, src/mon/LogMonitor.cc) -------------------
     def log_entry(self, who: str, level: str, message: str) -> None:
         """Queue a cluster-log entry; it commits with the next epoch
@@ -1139,6 +1149,13 @@ class Monitor(Dispatcher):
         # mid-election
         if msg.cmd == "fs_status":
             reply(0, {"value": self.fs_status()}, cacheable=False)
+            return
+        if msg.cmd == "quorum_status":
+            # election/quorum introspection ('ceph quorum_status'):
+            # answerable mid-election on any mon, never relayed — the
+            # vstart tests poll it to wait for a NEW leader after a
+            # SIGKILL instead of guessing with fixed pump counts
+            reply(0, {"value": self.quorum_status()}, cacheable=False)
             return
 
         # peons never mutate: relay to the leader (Monitor::

@@ -17,6 +17,20 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from .messages import Message
 
 
+_g_faults = None
+
+
+def _faults():
+    # deferred: the fault registry is dependency-light, but importing it
+    # at module load would couple msg/ to common/ for every consumer of
+    # the wire types; the first check pays the import once
+    global _g_faults
+    if _g_faults is None:
+        from ..fault import g_faults
+        _g_faults = g_faults
+    return _g_faults
+
+
 class Dispatcher:
     """Receiver interface (msg/Dispatcher.h)."""
 
@@ -108,6 +122,17 @@ class Network:
                     self.dropped += 1
                     continue
                 if self.drop_hook and self.drop_hook(src, dst, msg):
+                    self.dropped += 1
+                    continue
+                if _faults().site_armed("msg.drop") and \
+                        _faults().should_fire(
+                            "msg.drop",
+                            ctx=f"{type(msg).__name__} {src}>{dst}"):
+                    # the `ms inject socket failures` analog: the armed
+                    # trigger (prob/nth/once, match=-scoped) decides
+                    from ..fault import (fault_perf_counters,
+                                         l_fault_msg_drops)
+                    fault_perf_counters().inc(l_fault_msg_drops)
                     self.dropped += 1
                     continue
                 ep = self.endpoints.get(dst)

@@ -38,6 +38,8 @@ from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from ..dispatch import g_dispatcher
+from ..fault import (fault_perf_counters, g_faults, l_fault_eio_injected,
+                     l_fault_eio_reconstructs)
 from ..msg import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply,
@@ -815,6 +817,17 @@ class ECBackend:
         slicing out [offset, offset+length)."""
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}s{msg.shard}"
         ho = hobject_t(msg.oid, msg.shard)
+        if g_faults.site_armed("osd.shard_read_eio") and \
+                g_faults.should_fire(
+                    "osd.shard_read_eio",
+                    ctx=f"{cid}:{msg.oid}:shard{msg.shard}"):
+            # injected media error (bluestore_debug_inject_read_err
+            # role): fail THIS shard's read; the primary's reply
+            # handler reconstructs from the surviving shards
+            fault_perf_counters().inc(l_fault_eio_injected)
+            return MOSDECSubOpReadReply(tid=msg.tid, pgid=msg.pgid,
+                                        shard=msg.shard, oid=msg.oid,
+                                        result=-5)
         if not store.collection_exists(cid) or not store.exists(cid, ho):
             return MOSDECSubOpReadReply(tid=msg.tid, pgid=msg.pgid,
                                         shard=msg.shard, oid=msg.oid,
@@ -857,6 +870,8 @@ class ECBackend:
             rd.failed.add(msg.shard)
             if msg.result != -2:
                 rd.saw_eio = True
+                g_tracer.event("shard_read_eio", shard=msg.shard,
+                               oid=rd.oid, result=msg.result)
             # retry with reconstruction from any other healthy shards
             acting = self.pg.acting_shards()
             others = (set(acting) - set(rd.chunks) - rd.failed
@@ -892,6 +907,11 @@ class ECBackend:
             rd.on_done(-5, b"" if not rd.raw else {}, rd.size,
                        rd.user_attrs)
             return
+        if rd.saw_eio:
+            # the op was served despite >=1 failed shard: EC
+            # reconstruction from survivors did its job (the graceful-
+            # degradation contract for injected/real media errors)
+            fault_perf_counters().inc(l_fault_eio_reconstructs)
         if rd.raw:
             rd.on_done(0, dict(rd.chunks), rd.size, rd.user_attrs)
             return

@@ -54,6 +54,13 @@ class Span:
     def duration(self) -> Optional[float]:
         return None if self.end is None else self.end - self.start
 
+    def event(self, name: str, **tags) -> None:
+        """Append a timestamped point event (the OpenTracing log slot:
+        retries, breaker trips, fallbacks).  Rides the tags dict so the
+        dump shape is unchanged for consumers that ignore events."""
+        self.tags.setdefault("events", []).append(
+            {"event": name, "t": time.monotonic(), **tags})
+
     def dump(self) -> dict:
         return {
             "span_id": self.span_id,
@@ -188,6 +195,16 @@ class Tracer:
 
     def current(self) -> Optional[Span]:
         return _current.get()
+
+    def event(self, name: str, **tags) -> None:
+        """Record a point event on the thread's current span; a no-op
+        when disabled or no span is active (host-side only — the
+        degradation machinery calls this from hot paths)."""
+        if not self.enabled:
+            return
+        cur = _current.get()
+        if cur is not None:
+            cur.event(name, **tags)
 
     def current_span_id(self) -> int:
         cur = _current.get()

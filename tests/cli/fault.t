@@ -1,0 +1,45 @@
+Fault-injection admin CLI (`ceph daemon <who> fault inject|list|clear`),
+in the style of the reference's recorded src/test/cli transcripts: the
+site catalog, arming a trigger, the unknown-site refusal, and clearing.
+
+  $ python -c "from ceph_tpu.cluster import MiniCluster; MiniCluster(n_osds=2).checkpoint('ck')"
+
+  $ ceph --cluster ck daemon osd.0 fault list
+  {
+    "armed": {},
+    "sites": {
+      "device.decode_batch": "batched EC decode/reconstruct device call (matrix_plugin.decode_batch)",
+      "device.encode_batch": "batched EC encode device call (matrix_plugin.encode_batch)",
+      "device.encode_chunks": "per-stripe encode device call (matrix_plugin.encode_chunks)",
+      "dispatch.batch": "coalesced flush execution (scheduler._execute run_group) \u2014 exercises the per-request fallback isolation",
+      "msg.drop": "drop a fabric message (ms inject socket failures role); context is '<MsgType> <src>><dst>' for match= scoping",
+      "osd.shard_read_eio": "shard-side EC read returns EIO (bluestore_debug_inject_read_err role) \u2014 the primary must reconstruct from surviving shards",
+      "tpu.decode_batch_device": "device-resident decode entry point (tpu_plugin, mesh/bench)",
+      "tpu.encode_batch_device": "device-resident encode entry point (tpu_plugin, mesh/bench)"
+    }
+  }
+
+  $ ceph --cluster ck daemon osd.0 fault inject name=osd.shard_read_eio mode=nth n=3
+  {
+    "armed": {
+      "checks": 0,
+      "count": 0,
+      "error": "device",
+      "fires": 0,
+      "match": "",
+      "mode": "nth",
+      "n": 3,
+      "p": 1.0,
+      "seed": null
+    },
+    "site": "osd.shard_read_eio"
+  }
+
+  $ ceph --cluster ck daemon osd.0 fault inject name=bogus.site
+  admin socket: unknown fault site 'bogus.site' (see 'fault list')
+  [1]
+
+  $ ceph --cluster ck daemon osd.0 fault clear
+  {
+    "cleared": 0
+  }

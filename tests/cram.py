@@ -28,6 +28,7 @@ TOOLS = {
     "radosgw-admin": "ceph_tpu.tools.rgw_admin",
     "ceph-conf": "ceph_tpu.tools.ceph_conf",
     "ceph-kvstore-tool": "ceph_tpu.tools.kvstore_tool",
+    "ceph": "ceph_tpu.tools.ceph_cli",
 }
 
 

@@ -5,11 +5,16 @@ cephfs-shell): user/bucket administration and fs manipulation drive the
 same library paths the gateways use.
 """
 import json
+import os
+import sys
 
 import pytest
 
 from ceph_tpu.cluster import MiniCluster
 from ceph_tpu.tools import cephfs_cli, rgw_admin
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from cram import assert_cram  # noqa: E402
 
 
 @pytest.fixture()
@@ -20,6 +25,16 @@ def env():
     c.create_replicated_pool("fsmeta", size=3, pg_num=8)
     c.create_replicated_pool("fsdata", size=3, pg_num=8)
     return c, c.client("client.cli")
+
+
+def test_fault_cli_cram(tmp_path):
+    """`ceph daemon <who> fault inject|list|clear` replayed from a
+    recorded transcript (tests/cli/fault.t), byte-exact like the
+    reference's src/test/cli corpora: the injection-site catalog, an
+    armed trigger's dump, the unknown-site refusal and the clear."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cli", "fault.t")
+    assert_cram(path, str(tmp_path))
 
 
 def test_rgw_admin_flow(env, capsys):

@@ -1,0 +1,168 @@
+"""Chaos: the mini-cluster under SIMULTANEOUS fault injection.
+
+The robustness PR's end-to-end acceptance gate: with message drops
+(`msg.drop`), transient device errors (`device.encode_batch` /
+`device.decode_batch`) and shard-read EIO (`osd.shard_read_eio`) all
+armed at once — plus an OSD kill/revive cycle — a mixed
+write/overwrite/partial-write/read/recovery workload completes every
+client op and the final object contents are byte-identical to an
+uninjected run.
+
+Determinism notes baked into the parameters:
+
+- ``msg.drop`` is scoped to ``match="MOSDOp "`` (client REQUESTS): a
+  dropped request was never executed, so the Objecter's refresh-and-
+  resend loop replays it exactly once.  Reply drops would double-apply
+  non-idempotent ops; request drops cannot.
+- ``osd.shard_read_eio`` uses ``nth n=4``: any one read fans to at most
+  5 shard reads (k=3 + m=2 retries), and 5 consecutive checks contain
+  at most 2 multiples of 4 — never more than m failures per read, so
+  reconstruction always has k survivors by construction, not luck.
+- everything probabilistic is seeded, so a pass is reproducible.
+
+The <10 s smoke runs in tier-1 (`-m chaos` selects it); the full soak
+(twin-cluster byte comparison down to the stored shard bodies) is also
+marked `slow`.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.fault import fault_perf_counters, g_breakers, g_faults
+from ceph_tpu.fault.registry import (l_fault_eio_reconstructs,
+                                     l_fault_injected, l_fault_msg_drops)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def clean_faults():
+    yield
+    g_faults.clear()
+    g_breakers.reset()
+    for name in ("ec_device_retry_max", "ec_device_retry_backoff_us",
+                 "ec_breaker_threshold", "ec_breaker_cooldown_s"):
+        g_conf.rm_val(name)
+
+
+def _boot(n_osds=6, k=3, m=2):
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=n_osds)
+    c.create_ec_pool("chaos", k=k, m=m, pg_num=8)
+    return c, c.client("client.chaos")
+
+
+def _arm_chaos(seed: int) -> None:
+    g_conf.set_val("ec_device_retry_backoff_us", 0)
+    g_faults.inject("msg.drop", mode="prob", p=0.2, seed=seed,
+                    match="MOSDOp ")
+    g_faults.inject("device.encode_batch", mode="nth", n=3)
+    g_faults.inject("device.decode_batch", mode="nth", n=3)
+    g_faults.inject("osd.shard_read_eio", mode="nth", n=4)
+
+
+def _workload(c, cl, expected, rng, gens, kill_cycle=(1,)):
+    """Mixed write/overwrite/partial-write/read/recovery generations;
+    records every object's expected logical bytes in *expected*."""
+    for gen in range(gens):
+        # fresh full-object writes
+        for i in range(3):
+            oid = f"g{gen}o{i}"
+            body = bytes(rng.integers(0, 256, 6000 + 700 * i,
+                                      dtype=np.uint8))
+            assert cl.write_full("chaos", oid, body) == 0, (gen, i)
+            expected[oid] = body
+        # whole-object overwrite of an older object
+        oid = f"g{gen}o0"
+        body = bytes(rng.integers(0, 256, 5000, dtype=np.uint8))
+        assert cl.write_full("chaos", oid, body) == 0
+        expected[oid] = body
+        # partial write (the rmw pipeline: pre-read, splice, re-encode)
+        oid = f"g{gen}o1"
+        patch = bytes(rng.integers(0, 256, 1500, dtype=np.uint8))
+        off = 800
+        assert cl.write("chaos", oid, patch, off) == 0
+        old = bytearray(expected[oid])
+        old[off:off + len(patch)] = patch
+        expected[oid] = bytes(old)
+        # reads while injection is live (EIO recovery + decode path)
+        for oid, body in list(expected.items())[-4:]:
+            assert cl.read("chaos", oid) == body, oid
+        # recovery leg: kill an OSD, read degraded, revive, recover
+        if gen in kill_cycle:
+            victim = 1 + (gen % 3)
+            c.kill_osd(victim)
+            for _ in range(6):
+                c.tick(dt=5.0)
+            for oid, body in list(expected.items())[:2]:
+                assert cl.read("chaos", oid) == body, f"degraded {oid}"
+            c.revive_osd(victim)
+            for _ in range(3):
+                c.tick(dt=2.0)
+            c.run_recovery()
+
+
+def test_chaos_smoke(clean_faults):
+    """Tier-1: drops + device errors + read EIO at once, one kill/
+    revive cycle, every op completes, every object reads back exactly."""
+    c, cl = _boot()
+    pc = fault_perf_counters()
+    before = {"inj": pc.get(l_fault_injected),
+              "drop": pc.get(l_fault_msg_drops),
+              "rec": pc.get(l_fault_eio_reconstructs)}
+    expected = {}
+    _arm_chaos(seed=1234)
+    rng = np.random.default_rng(99)
+    _workload(c, cl, expected, rng, gens=2, kill_cycle=(1,))
+    g_faults.clear()
+    # final sweep with injection disarmed: contents are byte-identical
+    # to what an uninjected run would hold (the payloads themselves)
+    for oid, body in expected.items():
+        assert cl.read("chaos", oid) == body, oid
+    # the chaos was real: every armed class actually fired
+    assert pc.get(l_fault_injected) > before["inj"]
+    assert pc.get(l_fault_msg_drops) > before["drop"]
+    assert pc.get(l_fault_eio_reconstructs) > before["rec"]
+    assert c.health().startswith("HEALTH")
+
+
+@pytest.mark.slow
+def test_chaos_soak_byte_identical_to_uninjected_twin(clean_faults):
+    """The full soak: the SAME workload sequence runs on an injected
+    cluster and an uninjected twin; every client op completes on both,
+    final object contents match object-for-object, and the EC pool's
+    stored shard BODIES are byte-identical across the two clusters
+    (CPU-degraded encodes, retried dispatches and reconstruct-served
+    reads must leave no trace in the bytes)."""
+    results = {}
+    for label, inject in (("twin", False), ("injected", True)):
+        c, cl = _boot()
+        expected = {}
+        if inject:
+            _arm_chaos(seed=4321)
+            # push the breaker through a trip + half-open restore
+            # mid-run: device failures must only ever cost throughput
+            g_conf.set_val("ec_breaker_threshold", 2)
+            g_conf.set_val("ec_breaker_cooldown_s", 0.05)
+        rng = np.random.default_rng(7)
+        _workload(c, cl, expected, rng, gens=4, kill_cycle=(1, 3))
+        g_faults.clear()
+        for oid, body in expected.items():
+            assert cl.read("chaos", oid) == body, (label, oid)
+        # collect the EC pool's stored shard bodies
+        pool_id = cl.lookup_pool("chaos")
+        shards = {}
+        for i, osd in c.osds.items():
+            for cid in osd.store.list_collections():
+                if not cid.startswith(f"{pool_id}.") or "_meta" in cid:
+                    continue
+                for ho in osd.store.list_objects(cid):
+                    shards[(i, cid, str(ho))] = osd.store.read(cid, ho)
+        results[label] = (expected, shards)
+        g_breakers.reset()
+    exp_twin, shards_twin = results["twin"]
+    exp_inj, shards_inj = results["injected"]
+    assert exp_twin == exp_inj
+    assert set(shards_twin) == set(shards_inj)
+    diff = [k for k in shards_twin if shards_twin[k] != shards_inj[k]]
+    assert not diff, f"shard bodies diverged: {diff[:5]}"

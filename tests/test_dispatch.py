@@ -292,6 +292,52 @@ def test_batch_dispatch_span_children(dispatch_conf):
     assert all(s["trace_id"] == 777 for s in kids)
 
 
+def test_mid_batch_fallback_counter_and_span_event(dispatch_conf):
+    """Robustness-PR satellite: the mid-batch per-request fallback is
+    no longer silent — each re-run request bumps the
+    `dispatch_fallback` counter AND lands a `dispatch_fallback` event
+    on the submitting op's span."""
+    from ceph_tpu.dispatch.scheduler import (l_dispatch_fallback_reqs,
+                                             l_dispatch_fallbacks)
+    from ceph_tpu.fault import g_faults
+    g_conf.set_val("ec_dispatch_batch_window_us", 10_000_000)
+    g_tracer.enable()
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    sinfo = stripe_info_t(4, 4 * 512)
+    rng = np.random.default_rng(21)
+    payloads = [rng.integers(0, 256, size=4 * 512, dtype=np.uint8)
+                for _ in range(3)]
+    pc = dispatch_perf_counters()
+    before_req = pc.get(l_dispatch_fallback_reqs)
+    before_batch = pc.get(l_dispatch_fallbacks)
+    # one-shot batched-call failure: the flush falls back per-request,
+    # every request still resolves byte-identically
+    g_faults.inject("dispatch.batch", mode="once")
+    try:
+        with g_tracer.span("op_root", daemon="test",
+                           trace_id=888) as root:
+            futs = [g_dispatcher.submit_encode(sinfo, impl, p,
+                                               set(range(6)))
+                    for p in payloads]
+            for f, p in zip(futs, payloads):
+                _same("encode", f.result(),
+                      eu_encode(sinfo, impl, p, set(range(6))))
+    finally:
+        g_faults.clear()
+    assert pc.get(l_dispatch_fallbacks) == before_batch + 1
+    assert pc.get(l_dispatch_fallback_reqs) == before_req + 3
+    events = [e for e in root.tags.get("events", [])
+              if e["event"] == "dispatch_fallback"]
+    assert len(events) == 3, \
+        "each re-run request must stamp the submitter's span"
+    assert all(e["kind"] == "encode" for e in events)
+    # the batch span itself carries the fallback marker too
+    spans = g_tracer.collector.dump("dispatch")["dispatch"]
+    batch = [s for s in spans if s["name"] == "batch_dispatch"][-1]
+    assert any(e["event"] == "batch_fallback"
+               for e in batch["tags"].get("events", []))
+
+
 def test_raising_done_callback_does_not_poison_batch(dispatch_conf):
     """concurrent.futures semantics: a consumer callback that raises is
     the consumer's bug — it must not be mistaken for a device failure

@@ -166,6 +166,18 @@ def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
     g_conf.set_val("ec_dispatch_batch_max", 8)
     assert cl.write_full("trace", "o_batched", b"z" * 20000) == 0
     assert calls["n"] == 0, "batched dispatch added a device sync"
+    # robustness-PR extension: the fault guard + breaker board wrap
+    # every device call unconditionally — with NO site armed they must
+    # add zero syncs and leave no degradation trace behind
+    from ceph_tpu.fault import fault_perf_counters, g_breakers, g_faults
+    assert g_faults.dump()["armed"] == {}
+    errors_before = fault_perf_counters().dump()["device_errors"]
+    g_conf.rm_val("ec_dispatch_batch_window_us")
+    assert cl.write_full("trace", "o_guarded", b"g" * 20000) == 0
+    assert calls["n"] == 0, "fault guard added a device sync"
+    assert fault_perf_counters().dump()["device_errors"] \
+        == errors_before, "unarmed guard recorded a device failure"
+    assert g_breakers.degraded() == []
 
 
 def test_slow_op_span_tree_and_histogram_dump(clean_tracing):

@@ -50,6 +50,49 @@ def _refresh_map(c, cl, tries=3):
         c.pump_for(0.3)
 
 
+def _read_retrying(c, cl, oid, timeout=90.0):
+    """Read retried across the post-failover re-peering window: OSDs
+    answer EAGAIN (-11) while they catch up on the new quorum's maps,
+    and under suite load that window can outlast the Objecter's own
+    8-attempt loop.  Only transient codes retry — anything else (wrong
+    bytes, ENOENT) is a real failure and raises immediately."""
+    end = time.monotonic() + timeout
+    while True:
+        try:
+            return cl.read("p", oid)
+        except IOError as e:
+            if getattr(e, "errno", None) not in (11, 110) or \
+                    time.monotonic() > end:
+                raise
+            c.pump_for(1.0)
+
+
+def _wait_new_leader(c, cl, dead_rank, timeout=150.0):
+    """Poll `quorum_status` (read-only, answerable on any mon even
+    mid-election) until a DECIDED election has seated a leader other
+    than *dead_rank* with a surviving-majority quorum.  Replaces
+    guessing with pump counts: under a loaded host the re-election can
+    take arbitrarily long, and asserting before it completes is the
+    known flake."""
+    end = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < end:
+        try:
+            st = cl.mon_command("quorum_status")
+        except (IOError, ValueError) as e:   # silent/hunting window
+            last = e
+            c.pump_for(0.5)
+            continue
+        last = st
+        if (st["leader_rank"] >= 0 and st["leader_rank"] != dead_rank
+                and st["election_epoch"] % 2 == 0
+                and dead_rank not in st["quorum"]
+                and len(st["quorum"]) >= 2):
+            return st
+        c.pump_for(0.5)
+    raise AssertionError(f"no post-kill leader/quorum formed: {last!r}")
+
+
 def test_three_mons_leader_sigkill_recovers(cluster):
     c = cluster
     # the client is BOUND TO A PEON (mon.1): its commands cross the
@@ -73,7 +116,7 @@ def test_three_mons_leader_sigkill_recovers(cluster):
         if time.monotonic() > end:
             raise AssertionError(f"first write never landed: {r}")
         c.pump_for(1.0)
-    assert cl.read("p", "obj") == data
+    assert _read_retrying(c, cl, "obj") == data
 
     # committed allocations under the original leader (relayed mon.1 ->
     # mon.0): these are full-quorum commits the recovery must preserve
@@ -88,10 +131,16 @@ def test_three_mons_leader_sigkill_recovers(cluster):
         args={"pool_name": "p"}))
     c.kill_mon(0)
 
-    # survivors elect (mon.1, the lowest surviving rank) and service
-    # resumes; the first post-failover allocation must be STRICTLY
-    # ABOVE every pre-kill ack — if collect/LAST recovery had lost a
-    # committed value, the fresh leader would re-issue an old id
+    # wait for the surviving majority to finish electing a NEW leader
+    # (mon.1, the lowest surviving rank) before asserting anything —
+    # on a loaded host the election itself can outlast any fixed pump
+    # budget, which was this test's flake
+    st = _wait_new_leader(c, cl, dead_rank=0)
+    assert st["leader_rank"] == 1, st
+
+    # service resumes; the first post-failover allocation must be
+    # STRICTLY ABOVE every pre-kill ack — if collect/LAST recovery had
+    # lost a committed value, the fresh leader would re-issue an old id
     post_id = _snap_create_retrying(c, cl, timeout=150.0)
     assert post_id > max(pre_ids), (pre_ids, post_id)
 
@@ -118,7 +167,8 @@ def test_three_mons_leader_sigkill_recovers(cluster):
         c.pump_for(1.0)
 
     # data written under the old quorum still serves under the new one
-    assert cl.read("p", "obj") == data
+    # (retried: OSDs may still be re-peering under the fresh maps)
+    assert _read_retrying(c, cl, "obj") == data
     # and the cluster keeps accepting writes (generous window: under a
     # loaded host the re-peering after mon failover can take a while)
     end = time.monotonic() + 90.0
@@ -132,4 +182,4 @@ def test_three_mons_leader_sigkill_recovers(cluster):
         if time.monotonic() > end:
             raise AssertionError(f"post-failover write failed: {r}")
         c.pump_for(1.0)
-    assert cl.read("p", "obj2") == data[:5000]
+    assert _read_retrying(c, cl, "obj2") == data[:5000]
