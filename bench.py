@@ -313,6 +313,20 @@ def main() -> None:
             debug=bool(os.environ.get("CEPH_TPU_BENCH_DEBUG")))
         RESULT["metrics"].extend(ms)
 
+    def pipeline_section() -> None:
+        # depth-8 async write pipeline vs depth-1 synchronous submit
+        # from ONE thread — the dispatch-amortization headline; host-
+        # materialized completions, so safe before the fetch-heavy
+        # parity receipt but after the pure one-element-drain sections
+        mp, mp1 = workloads.measure_ec_pipeline(
+            n_requests=32 if platform else 16,
+            target_seconds=TARGET_SECONDS / 2,
+            repeats=3 if platform else 2)
+        RESULT["metrics"].extend([mp, mp1])
+        RESULT["ec_pipeline_gibs"] = mp["value"]
+        RESULT["ec_pipeline_speedup"] = mp["speedup"]
+        RESULT["ec_pipeline_occupancy"] = mp["mean_batch_occupancy"]
+
     def parity_section() -> None:
         RESULT["decode_parity"] = workloads.parity_check(matrix)
 
@@ -331,6 +345,7 @@ def main() -> None:
     run_section("crush bench", lambda: crush_section(True), 110.0)
     run_section("crush nonuniform bench",
                 lambda: crush_section(False, "_nonuniform"), 80.0)
+    run_section("ec pipeline bench", pipeline_section, 45.0)
     run_section("decode parity", parity_section, 45.0)
     _emit(final=True)
 

@@ -53,7 +53,8 @@ def main(argv=None) -> int:
 
     from . import regress
     from .workloads import (bench_perf_counters, measure_decode,
-                            measure_dispatch_coalesce, measure_encode,
+                            measure_dispatch_coalesce,
+                            measure_ec_pipeline, measure_encode,
                             measure_host_native, parity_check)
     from ..gf.matrices import gf_gen_rs_matrix
 
@@ -102,6 +103,15 @@ def main(argv=None) -> int:
         progress(f"dispatch_coalesce {mc['value']} GiB/s coalesced vs "
                  f"{ms['value']} serial (x{mc['speedup']}, "
                  f"occupancy {mc['batch_occupancy']})")
+        mp, mp1 = measure_ec_pipeline(
+            n_requests=16 if args.smoke else 64,
+            target_seconds=0.3 if args.smoke else 2.0,
+            repeats=repeats, warmup=warmup)
+        result["metrics"] += [mp, mp1]
+        progress(f"ec_pipeline {mp['value']} GiB/s depth-8 vs "
+                 f"{mp1['value']} depth-1 (x{mp['speedup']}, occupancy "
+                 f"{mp['mean_batch_occupancy']}, identical "
+                 f"{mp['identical']})")
         host = measure_host_native(matrix, batch[0],
                                    target_seconds=0.3 if args.smoke
                                    else 1.5)

@@ -357,6 +357,159 @@ def measure_dispatch_coalesce(*, n_requests: int = 8,
     return mets[0], mets[1]
 
 
+def measure_ec_pipeline(*, n_requests: int = 64,
+                        object_bytes: int = 65536, depth: int = 8,
+                        target_seconds: float = 0.6,
+                        repeats: int = 3, warmup: int = 1,
+                        rtt_s: Optional[float] = None
+                        ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """N sequential 64 KiB k=8,m=4 encodes from ONE submitter thread:
+    pipeline depth 8 (non-blocking dispatch futures with continuation
+    completion, window drained by a forced flush at the depth boundary
+    — the ec_backend backpressure rule) vs depth 1 (the synchronous
+    submit → result() per op the write path used before the async
+    pipeline).  The depth-1 leg models exactly why a lone OSD op
+    thread never filled a batch: each op demands its result inline, so
+    every encode pays a full dispatch.
+
+    Fencing: completion is a continuation observing the fully
+    host-materialized chunk buffers, so the clock stops only after the
+    device output crossed back to the host (the drain contract, as in
+    measure_dispatch_coalesce); the RTT is measured and reported,
+    never subtracted.  Inputs are salted per pass.  The occupancy the
+    pipeline actually achieved is read back from the dispatcher's
+    batch-occupancy histogram and reported as
+    ``mean_batch_occupancy``; byte-identity of the pipelined outputs
+    against the depth-1 path is checked every run (``identical``).
+    """
+    from ..common.config import g_conf
+    from ..dispatch import g_dispatcher
+    from ..ec.tpu_plugin import ErasureCodeTpu
+    from ..osd.ecutil import stripe_info_t
+    from ..trace import g_perf_histograms, occupancy_axes
+
+    impl = ErasureCodeTpu()
+    impl.init({"k": str(K), "m": str(M), "technique": "reed_sol_van"})
+    assert object_bytes % K == 0
+    sinfo = stripe_info_t(K, object_bytes)
+    want = set(range(K + M))
+    rng = np.random.default_rng(20260804)
+    base = rng.integers(0, 256, size=(n_requests, object_bytes),
+                        dtype=np.uint8)
+    if rtt_s is None:
+        rtt_s = measure_rtt()
+    saved = {name: g_conf.values.get(name) for name in
+             ("ec_dispatch_batch_max", "ec_dispatch_batch_window_us")}
+    pc = bench_perf_counters()
+    occ_hist = g_perf_histograms.get(
+        "dispatch", "dispatch_batch_occupancy_histogram",
+        occupancy_axes)
+
+    def one_pass(d: int, collect: Optional[list] = None) -> None:
+        payloads = np.bitwise_xor(base, np.uint8(_next_salt() & 0xFF))
+        if d <= 1:
+            for i in range(n_requests):
+                out = g_dispatcher.encode(sinfo, impl, payloads[i],
+                                          want)
+                if collect is not None:
+                    collect.append(out)
+            pc.inc(l_bench_dispatches, n_requests)
+        else:
+            done = [None] * n_requests
+            inflight = [0]
+            for i in range(n_requests):
+                if inflight[0] >= d:
+                    # the per-PG window is full: backpressure drains it
+                    # by executing the batch inline (never by waiting)
+                    g_dispatcher.flush()
+                fut = g_dispatcher.submit_encode(sinfo, impl,
+                                                 payloads[i], want)
+                inflight[0] += 1
+
+                def on_ready(f, i=i):
+                    inflight[0] -= 1
+                    done[i] = f.result()    # resolved: host buffers
+
+                fut.add_done_callback(on_ready)
+            g_dispatcher.flush()            # completion fence
+            assert all(r is not None for r in done)
+            if collect is not None:
+                collect.extend(done)
+            pc.inc(l_bench_dispatches, (n_requests + d - 1) // d)
+        pc.inc(l_bench_bytes, n_requests * object_bytes)
+
+    def make_sampler(d: int, rounds: int):
+        def sample() -> float:
+            g_conf.set_val("ec_dispatch_batch_max", max(d, 1))
+            g_conf.set_val("ec_dispatch_batch_window_us", 10**7)
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                one_pass(d)
+            dt = time.perf_counter() - t0
+            pc.tinc(l_bench_fence_time, dt)
+            return rounds * n_requests * object_bytes / dt / (1 << 30)
+
+        return sample
+
+    try:
+        # byte-identity receipt: the same salted payloads through both
+        # depths must produce identical chunk buffers
+        salt_before = _SALT[0]
+        g_conf.set_val("ec_dispatch_batch_max", depth)
+        g_conf.set_val("ec_dispatch_batch_window_us", 10**7)
+        piped: list = []
+        one_pass(depth, collect=piped)
+        _SALT[0] = salt_before          # replay the same inputs
+        serial: list = []
+        one_pass(1, collect=serial)
+        identical = all(
+            sorted(a) == sorted(b)
+            and all(np.asarray(a[i]).tobytes()
+                    == np.asarray(b[i]).tobytes() for i in a)
+            for a, b in zip(piped, serial))
+        results = {}
+        occupancy = None
+        for d in (1, depth):
+            make_sampler(d, 1)()        # warm compiles
+            t0 = time.perf_counter()
+            make_sampler(d, 1)()
+            per_pass = max(time.perf_counter() - t0, 1e-6)
+            rounds = max(1, min(
+                int(max(target_seconds / max(repeats, 1),
+                        4.0 * rtt_s) / per_pass), 256))
+            if d == depth:
+                occ0 = (occ_hist.axis0_sum, occ_hist.total_count)
+            results[d] = repeat_measure(make_sampler(d, rounds),
+                                        repeats=repeats, warmup=warmup)
+            if d == depth:
+                ds = occ_hist.axis0_sum - occ0[0]
+                dn = occ_hist.total_count - occ0[1]
+                occupancy = round(ds / dn, 2) if dn else 0.0
+    finally:
+        for name, v in saved.items():
+            g_conf.rm_val(name) if v is None else g_conf.set_val(name, v)
+        g_dispatcher.flush()
+    platform, kind, ndev = _device_info()
+    mets = []
+    for d, name in ((depth, "ec_pipeline_fenced"),
+                    (1, "ec_pipeline_depth1_fenced")):
+        st = results[d]
+        rl = validate_reading(st["median"], EC_ENCODE_K8M4, platform,
+                              kind, ndev)
+        extra = {"n_requests": n_requests, "object_bytes": object_bytes,
+                 "pipeline_depth": d, "platform": platform}
+        if d == depth:
+            extra["depth1_gibs"] = round(results[1]["median"], 4)
+            extra["speedup"] = round(
+                st["median"] / max(results[1]["median"], 1e-9), 3)
+            extra["mean_batch_occupancy"] = occupancy
+            extra["identical"] = bool(identical)
+        mets.append(make_metric(name, st["median"], "GiB/s",
+                                fenced=True, rtt_s=rtt_s, stats=st,
+                                roofline=rl, extra=extra))
+    return mets[0], mets[1]
+
+
 def parity_check(matrix: np.ndarray) -> bool:
     """Encode REAL data on device, erase two data shards, decode on
     device, fetch, byte-compare against the original — the on-hardware

@@ -68,6 +68,36 @@ class MiniCluster:
                            all_mons=self.mons)
         self.admin_socket = AdminSocket()
         self._register_admin_commands()
+        # deterministic-fabric idle kick: once the message queue drains,
+        # (1) flush encodes the async EC write pipeline parked in the
+        # dispatch scheduler's collection window — their continuations
+        # fan out the sub-op writes pump() then delivers — and (2)
+        # resend unacked sub-writes (quiescence proves the message or
+        # its ack was dropped).  Both are bounded, so pump terminates.
+        self.network.add_idle_hook(self._idle_kick)
+
+    def _idle_kick(self) -> bool:
+        from .dispatch import g_dispatcher
+        did = bool(g_dispatcher.pending_count() and g_dispatcher.flush())
+        # threaded op queues defer pipeline continuations back through
+        # the sharded wq — flush the pools so their fan-out reaches the
+        # wire before pump decides the fabric is quiescent
+        for osd in self.osds.values():
+            if osd.name in self.network.down:
+                continue
+            if osd.op_tp is not None and len(osd.op_wq):
+                osd.drain_ops()
+                did = True
+        if did:
+            return True     # let pump drain the fan-out first
+        for osd in self.osds.values():
+            if osd.name in self.network.down:
+                continue
+            for pg in osd.pgs.values():
+                be = pg.backend
+                if be is not None and be.inflight_writes:
+                    did = bool(be.sweep_inflight(idle=True)) or did
+        return did
 
     @property
     def mon(self) -> Monitor:
@@ -246,6 +276,8 @@ class MiniCluster:
             "per-shard op queue sizes and mclock tags")
         from .dispatch import dispatch_perf_counters, g_dispatcher
         self.perf_collection.add(dispatch_perf_counters())
+        from .osd.ec_backend import pipeline_perf_counters
+        self.perf_collection.add(pipeline_perf_counters())
         asok.register(
             "dispatch dump",
             lambda c, a: g_dispatcher.dump(),

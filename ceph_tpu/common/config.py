@@ -104,6 +104,23 @@ def build_options() -> List[Option]:
         .set_description("EC dispatch scheduler: total pending requests "
                          "across all queues before a forced "
                          "backpressure flush"),
+        Option("ec_pipeline_depth", OPT_INT).set_default(1)
+        .set_description("EC write pipeline: encodes a single PG may "
+                         "keep in flight in the dispatch scheduler "
+                         "before backpressure force-flushes "
+                         "(osd/ec_backend).  1 = today's synchronous "
+                         "submit->encode->fan-out per op; >1 converts "
+                         "the write path to non-blocking dispatch "
+                         "futures with continuation fan-out"),
+        Option("ec_subwrite_retry_timeout", OPT_FLOAT).set_default(3.0)
+        .set_description("seconds before an unacked EC sub-op write is "
+                         "resent to its shard (messenger-level drops "
+                         "no longer wedge the per-oid write pipeline); "
+                         "0 disables the resend timer"),
+        Option("ec_subwrite_retry_max", OPT_INT).set_default(6)
+        .set_description("resend attempts per in-flight EC sub-op "
+                         "write before giving up (peering's on_change "
+                         "then owns the cleanup, as before the timer)"),
         Option("ec_device_retry_max", OPT_INT).set_default(2)
         .set_description("retries (after the first attempt) for a "
                          "transient device codec-call failure before "

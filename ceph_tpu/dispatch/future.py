@@ -10,8 +10,24 @@ flush containing the request lands.  Two consumption styles:
   owning queue to flush, so correctness NEVER depends on a timer or on
   other traffic arriving.  Coalescing happens when it can (concurrent
   submitters, batch_max triggers), never at the price of a stall.
-- ``add_done_callback()``: async consumers (bench drivers, future
-  pipelined write paths) get called on the flusher's thread.
+- ``add_done_callback()``: async consumers (bench drivers, the EC
+  write pipeline's continuation fan-out) get called on the flusher's
+  thread.  Callback execution context: whichever thread resolves the
+  future runs the callbacks inline — the submitter itself when the
+  request executed inline or a backpressure ``force()``/``flush()``
+  ran there, the OSD tick thread when the collection window expired,
+  or another submitter whose demand flushed the shared queue.
+  Consumers that touch shared state must therefore take their own
+  locks (ec_backend's pipeline window does) and re-anchor their trace
+  context (``g_tracer.activate``) — the thread-current span at
+  callback time belongs to whoever flushed, not to the submitter.
+- ``force()``: flush-on-demand WITHOUT blocking — runs the owning
+  queue's flush inline (resolving this future and its batchmates via
+  their callbacks) but never waits on another thread.  The write
+  pipeline's backpressure forces its oldest pending future (falling
+  back to the scheduler-wide ``flush()`` for mixed-signature
+  windows): a full window empties by running the work, not by
+  parking the submitter.
 
 Error isolation contract: a future carries ITS request's exception
 only.  One malformed or undecodable request in a batch must resolve
@@ -85,6 +101,15 @@ class DispatchFuture:
     # ---- consumer side -----------------------------------------------------
     def done(self) -> bool:
         return self._event.is_set()
+
+    def force(self) -> None:
+        """Flush-on-demand: execute the owning queue's flush inline if
+        this request is still queued.  Unlike ``result()`` this never
+        waits — when the request is already executing on another
+        thread the call returns immediately and completion arrives via
+        ``add_done_callback``."""
+        if not self._event.is_set() and self._flush_fn is not None:
+            self._flush_fn()
 
     def result(self, timeout: Optional[float] = None) -> Any:
         """The request's own outcome; forces a flush when still queued."""

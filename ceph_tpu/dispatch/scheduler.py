@@ -293,6 +293,12 @@ class DeviceDispatcher:
             self._execute(q.reqs, q.bucket_c)
         return n
 
+    def pending_count(self) -> int:
+        """Requests currently queued (cheap probe for idle kickers:
+        the mini-cluster fabric flushes on quiescence so pipelined
+        submitters never depend on a wall-clock window)."""
+        return self._pending
+
     def flush(self) -> int:
         """Flush everything pending regardless of deadline; returns the
         number of requests executed."""

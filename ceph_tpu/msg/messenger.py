@@ -83,6 +83,13 @@ class Network:
         self.delivered = 0
         self.dropped = 0
         self.pumping = False
+        # idle kickers: called when the queue drains; a hook returning
+        # True did deferred work (flushed a dispatch batch, resent a
+        # lost sub-write) and pump loops to deliver what it enqueued.
+        # This is how "drain to quiescence" stays true once the EC
+        # write path is continuation-driven: an encode parked in the
+        # dispatch scheduler's collection window is not quiescent.
+        self.idle_hooks: List[Callable[[], bool]] = []
 
     def create_messenger(self, name: str) -> Messenger:
         m = Messenger(self, name)
@@ -107,14 +114,27 @@ class Network:
         msg.src = src
         self.queue.append((src, dst, msg))
 
+    def add_idle_hook(self, hook: Callable[[], bool]) -> None:
+        self.idle_hooks.append(hook)
+
     def pump(self, max_msgs: int = 100000) -> int:
-        """Deliver queued messages until quiescent; returns count."""
+        """Deliver queued messages until quiescent — including deferred
+        work the idle hooks surface (pipelined dispatch flushes,
+        sub-write resends); returns the delivery count."""
         if self.pumping:
             return 0  # re-entrant sends drain in the outer pump
         self.pumping = True
         n = 0
         try:
-            while self.queue and n < max_msgs:
+            while n < max_msgs:
+                if not self.queue:
+                    # quiescent: give the idle kickers one round; any
+                    # that did work may have enqueued messages (hook
+                    # bounds — resend caps, finite dispatch queues —
+                    # guarantee this terminates)
+                    if not any([h() for h in self.idle_hooks]):
+                        break
+                    continue
                 src, dst, msg = self.queue.popleft()
                 n += 1
                 if (src in self.down or dst in self.down

@@ -26,10 +26,31 @@ Chunk placement is positional: acting[i] holds shard i (chunk_mapping
 applies inside the codec).  HashInfo crc32c guards every shard read
 (ECUtil.cc:161-207; checked like handle_sub_read's crc path,
 ECBackend.cc:1022-1066).
+
+Async write pipeline (``ec_pipeline_depth`` > 1): the encode no longer
+blocks the op thread on ``future.result()`` — submit enqueues the
+encode into the dispatch scheduler and registers a continuation
+(``add_done_callback``) that fans out the per-shard sub-op writes when
+the batched device call completes, so a SINGLE submitter can keep up
+to ``ec_pipeline_depth`` encodes in flight per PG and the scheduler
+sees real batches (docs/DISPATCH.md "Async write pipeline").  Per-oid
+ordering is untouched (the per-object queue still admits one op at a
+time), depth 1 (the default) is exactly the old synchronous path, and
+a full window backpressures by force-flushing the scheduler inline —
+never by parking the submitter on a cross-thread wait.
+
+Sub-op write retry: every in-flight write remembers its per-shard
+messages; the OSD tick (and the deterministic fabric's idle kick)
+resends unacked sub-writes after ``ec_subwrite_retry_timeout``, so a
+messenger-level drop no longer wedges the per-oid pipeline until
+peering.  Shard-side replay is idempotent — ``handle_sub_write``
+short-circuits when the stored object version already covers the
+message's version and just re-acks.
 """
 from __future__ import annotations
 
 import struct
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -37,6 +58,8 @@ from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..common.config import g_conf
+from ..common.perf_counters import PerfCounters, PerfCountersBuilder
 from ..dispatch import g_dispatcher
 from ..fault import (fault_perf_counters, g_faults, l_fault_eio_injected,
                      l_fault_eio_reconstructs)
@@ -44,7 +67,8 @@ from ..msg import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply,
 )
-from ..trace import g_perf_histograms, g_tracer, latency_in_bytes_axes
+from ..trace import (g_perf_histograms, g_tracer, latency_in_bytes_axes,
+                     pipeline_axes)
 from ..os_store import MemStore, Transaction, hobject_t
 from ..utils.crc32c import crc32c
 from .ecutil import HashInfo, stripe_info_t
@@ -57,6 +81,50 @@ DIGEST_ATTR = "_data_digest"  # crc32c recorded at full-object write
 # clears FLAG_DATA_DIGEST on unaligned writes
 HINFO_ATTR = "hinfo_key"     # reference's hinfo xattr name
 USER_ATTR_PREFIX = "_u_"     # user xattr namespace in shard/replica attrs
+
+# ---- pipeline perf counters (perf dump / Prometheus) -----------------------
+PIPELINE_FIRST = 93000
+l_pipeline_inflight = 93001       # gauge: encodes in flight (all PGs)
+l_pipeline_submitted = 93002      # ops submitted through the async path
+l_pipeline_backpressure = 93003   # full-window force-flushes
+l_pipeline_stale_drops = 93004    # continuations dropped by an interval
+                                  # change (peering raced the encode)
+l_pipeline_errors = 93005         # ops whose encode future carried an
+                                  # exception (client answered EIO)
+l_pipeline_subwrite_resends = 93006  # unacked sub-op writes resent
+PIPELINE_LAST = 93010
+
+_pipeline_pc: Optional[PerfCounters] = None
+_pipeline_pc_lock = threading.Lock()
+
+
+def pipeline_perf_counters() -> PerfCounters:
+    """The EC write pipeline's counter logger (perf dump/Prometheus)."""
+    global _pipeline_pc
+    if _pipeline_pc is not None:
+        return _pipeline_pc
+    with _pipeline_pc_lock:
+        if _pipeline_pc is None:
+            b = PerfCountersBuilder("pipeline", PIPELINE_FIRST,
+                                    PIPELINE_LAST)
+            b.add_u64(l_pipeline_inflight, "pipeline_inflight",
+                      "EC write encodes currently in flight in the "
+                      "dispatch scheduler (all PGs)")
+            b.add_u64_counter(l_pipeline_submitted, "submitted",
+                              "EC writes submitted through the async "
+                              "pipeline")
+            b.add_u64_counter(l_pipeline_backpressure, "backpressure",
+                              "full-window force-flushes")
+            b.add_u64_counter(l_pipeline_stale_drops, "stale_drops",
+                              "continuations dropped by an interval "
+                              "change")
+            b.add_u64_counter(l_pipeline_errors, "encode_errors",
+                              "encode futures resolved with an error")
+            b.add_u64_counter(l_pipeline_subwrite_resends,
+                              "subwrite_resends",
+                              "unacked EC sub-op writes resent")
+            _pipeline_pc = b.create_perf_counters()
+    return _pipeline_pc
 
 
 def user_attrs_of(attrs: Dict[str, bytes]) -> Dict[str, bytes]:
@@ -145,6 +213,13 @@ class InflightWrite:
     client_reply: Callable[[int], None]
     pending_shards: Set[int] = field(default_factory=set)
     on_all_commit: Optional[Callable[[], None]] = None
+    # sub-write retry state: the exact message sent to each shard (the
+    # in-process fabric passes objects by reference, so resending the
+    # same object is byte-identical), the destination osd, the cluster
+    # clock at the last send, and how many resend rounds have run
+    sent_msgs: Dict[int, Tuple[int, object]] = field(default_factory=dict)
+    last_send: float = 0.0
+    resends: int = 0
 
 
 @dataclass
@@ -233,6 +308,16 @@ class ECBackend:
         self.extent_cache = ExtentCache()
         self._oid_queues: Dict[str, Deque] = {}
         self._tid = 0
+        # async write pipeline (ec_pipeline_depth > 1): encodes this PG
+        # currently has in flight in the dispatch scheduler, an RLock
+        # because continuations run on whichever thread flushed (the
+        # submitter itself under backpressure), and a generation stamp
+        # so a continuation resolving AFTER an interval change drops
+        # its fan-out instead of writing into a dead acting set
+        self.pipeline_inflight = 0
+        self._pipeline_futs: Deque = deque()   # oldest-first pending
+        self._pipeline_lock = threading.RLock()
+        self._interval_gen = 0
         # batched-codec latency x bytes distributions, per daemon
         # (dumped under `perf histogram dump` next to the op hists)
         name = pg.osd.name
@@ -241,6 +326,17 @@ class ECBackend:
             latency_in_bytes_axes)
         self.hist_decode = g_perf_histograms.get(
             name, "ec_decode_latency_in_bytes_histogram",
+            latency_in_bytes_axes)
+        # write-pipeline occupancy at encode-submit time (linear,
+        # dimensionless — the mgr renderer exports raw bucket edges
+        # like the dispatcher's occupancy family)
+        self.hist_pipeline = g_perf_histograms.get(
+            name, "pipeline_inflight_histogram", pipeline_axes)
+        # pipelined submit->resolve latency (queue wait INCLUDED) —
+        # kept apart from hist_encode, whose samples are pure codec
+        # calls the slow-op forensics compare against
+        self.hist_encode_pipelined = g_perf_histograms.get(
+            name, "ec_encode_pipelined_latency_in_bytes_histogram",
             latency_in_bytes_axes)
 
     # ---- helpers ----------------------------------------------------------
@@ -251,11 +347,16 @@ class ECBackend:
     def on_change(self) -> None:
         """Interval change (new acting set): drop all in-flight state —
         the reference's ECBackend::on_change; clients resend through the
-        Objecter, so unanswered ops are safe to forget."""
+        Objecter, so unanswered ops are safe to forget.  Pipelined
+        encodes still queued in the dispatcher are NOT cancelled (their
+        device work may be batched with live PGs'); bumping the
+        generation makes their continuations complete as no-ops."""
         self.inflight_writes.clear()
         self.inflight_reads.clear()
         self._oid_queues.clear()
         self.extent_cache = ExtentCache()
+        with self._pipeline_lock:
+            self._interval_gen += 1
 
     def shard_cid(self, shard: int) -> str:
         return f"{self.pg.pgid[0]}.{self.pg.pgid[1]}s{shard}"
@@ -303,6 +404,127 @@ class ECBackend:
             out = fn(*args)
         self.hist_decode.inc((time.perf_counter() - t0) * 1e6, nbytes)
         return out
+
+    def _encode_pipelined(self, data: bytes, parent_span,
+                          then: Callable[[Optional[Dict[int, np.ndarray]],
+                                          Optional[BaseException]],
+                                         None]) -> None:
+        """The write path's encode in continuation-passing style:
+        ``then(shards, None)`` on success, ``then(None, exc)`` on a
+        (semantic) encode failure.
+
+        Depth <= 1 (the default) is the old synchronous call by
+        construction — same funnel, inline continuation.  Depth > 1
+        submits the encode as a dispatch future and returns
+        immediately; the continuation runs on whichever thread flushes
+        the batch (window expiry from the OSD tick, batch_max, another
+        submitter's demand, or this PG's own backpressure flush), with
+        the submitting op's span re-anchored so the sub_write fan-out
+        and the batch_dispatch children stay on the op's trace."""
+        depth = int(g_conf.get_val("ec_pipeline_depth"))
+        if depth <= 1:
+            # today's synchronous path by construction: any encode
+            # exception propagates to the submitter exactly as before
+            then(self._encode(data), None)
+            return
+        pc = pipeline_perf_counters()
+        # window reservation is atomic with the full-check (a plain
+        # check-then-increment would let N concurrent op threads
+        # overshoot the depth by N-1).  Backpressure drains the window
+        # by EXECUTING pending work inline — force() flushes only the
+        # OLDEST request's own queue (its signature-mates, i.e. this
+        # PG's backlog) so other PGs' collection windows keep
+        # accumulating; a mixed-signature window falls back to the
+        # scheduler-wide flush.  A submitter whose window stays full
+        # after two rounds (PG-mates mid-execution on ANOTHER thread)
+        # proceeds rather than spinning: the overshoot is transient
+        # and bounded by the op-thread count.
+        rounds = 0
+        while True:
+            with self._pipeline_lock:
+                if self.pipeline_inflight < depth or rounds >= 2:
+                    self.pipeline_inflight += 1
+                    inflight = self.pipeline_inflight
+                    break
+                oldest = self._pipeline_futs[0] \
+                    if self._pipeline_futs else None
+            pc.inc(l_pipeline_backpressure)
+            if oldest is not None:
+                oldest.force()
+            else:
+                g_dispatcher.flush()
+            rounds += 1
+        gen = self._interval_gen
+        nbytes = len(data)
+        t0 = time.perf_counter()
+        sp = g_tracer.begin("ec_encode") if g_tracer.enabled else None
+        if sp is not None:
+            sp.tags["bytes"] = nbytes
+            sp.tags["pipelined"] = True
+        # the gauge counts encodes in flight across ALL PGs, so it must
+        # inc/dec — a set() of this PG's count would clobber others'
+        pc.inc(l_pipeline_inflight)
+        self.hist_pipeline.inc(inflight)
+        pc.inc(l_pipeline_submitted)
+        want = set(range(self.n))
+        # activate the encode span around the submit so the scheduler
+        # captures it as the request's parent — batch_dispatch children
+        # then hang off the submitting op exactly like the sync path
+        with g_tracer.activate(sp):
+            fut = g_dispatcher.submit_encode(self.sinfo, self.ec_impl,
+                                             data, want)
+        with self._pipeline_lock:
+            self._pipeline_futs.append(fut)
+
+        def deliver(f) -> None:
+            """The PG-state half of the continuation (fan-out, version
+            allocation, per-oid queue advance).  Must run under the
+            same exclusion as op execution — inline in synchronous
+            mode, via the sharded op queue (whose workers take
+            pg.op_lock) when an op thread-pool is active."""
+            if gen != self._interval_gen:
+                # peering raced the encode: the acting set this op was
+                # aimed at is gone; the client resends via the Objecter
+                pc.inc(l_pipeline_stale_drops)
+                return
+            err = f.exception()      # resolved — never blocks here
+            if err is not None:
+                pc.inc(l_pipeline_errors)
+            with g_tracer.activate(parent_span):
+                if err is not None:
+                    then(None, err)
+                else:
+                    then(f.result(), None)
+
+        def on_ready(f) -> None:
+            with self._pipeline_lock:
+                self.pipeline_inflight -= 1
+                try:
+                    self._pipeline_futs.remove(f)
+                except ValueError:
+                    pass
+            pc.dec(l_pipeline_inflight)
+            g_tracer.finish(sp)
+            # submit->resolve wall time INCLUDES the collection-window
+            # queue wait, so it must not pollute the sync path's pure
+            # codec-latency family — pipelined ops get their own
+            self.hist_encode_pipelined.inc(
+                (time.perf_counter() - t0) * 1e6, nbytes)
+            osd = self.pg.osd
+            if getattr(osd, "op_tp", None) is not None:
+                # threaded op queue: the flusher thread may hold (or
+                # race) another PG's op_lock — taking this PG's lock
+                # inline could deadlock AB-BA, and mutating unlocked
+                # would race the workers.  Re-enter through the op
+                # queue instead; a worker delivers under pg.op_lock.
+                from ..common.work_queue import CLASS_CLIENT
+                osd.op_wq.enqueue(self.pg.pgid, CLASS_CLIENT,
+                                  ("pipeline", self.pg,
+                                   lambda: deliver(f)))
+            else:
+                deliver(f)
+
+        fut.add_done_callback(on_ready)
 
     # ---- per-object write pipeline ----------------------------------------
     def _enqueue(self, oid: str, op) -> None:
@@ -418,7 +640,9 @@ class ECBackend:
                 chunk=b"", attr_only=True, xattrs=dict(xattrs),
                 version=version)
             wr.pending_shards.add(shard)
+            wr.sent_msgs[shard] = (osd, msg)
             self.pg.send_to_osd(osd, msg)
+        wr.last_send = self.pg.osd.now
         self.inflight_writes[tid] = wr
 
     def submit_write(self, oid: str, data: bytes, offset: Optional[int],
@@ -435,20 +659,33 @@ class ECBackend:
         # callback, so re-anchor the span context here
         with g_tracer.activate(op.parent_span):
             padded = self._pad(op.data)
-            shards = self._encode(padded)
 
-            def all_commit() -> None:
-                self.extent_cache.replace(op.oid, padded, len(op.data))
-                op.on_commit(0)
-                self._op_done(op.oid)
+            def have_shards(shards, err) -> None:
+                if err is not None:
+                    # the encode future carried an error (semantic —
+                    # device failures already degraded to the CPU twin
+                    # inside the guard): the client op must still
+                    # complete, as EIO
+                    op.on_commit(-5)
+                    self._op_done(op.oid)
+                    return
 
-            self._fan_out_shards(op.tid, op.oid, shards, chunk_off=0,
-                                 partial=False, new_size=len(op.data),
-                                 on_all_commit=all_commit,
-                                 client_reply=op.on_commit,
-                                 version=self.pg.next_version(),
-                                 xattrs=op.xattrs,
-                                 snapset_update=op.snapset_update)
+                def all_commit() -> None:
+                    self.extent_cache.replace(op.oid, padded,
+                                              len(op.data))
+                    op.on_commit(0)
+                    self._op_done(op.oid)
+
+                self._fan_out_shards(op.tid, op.oid, shards, chunk_off=0,
+                                     partial=False,
+                                     new_size=len(op.data),
+                                     on_all_commit=all_commit,
+                                     client_reply=op.on_commit,
+                                     version=self.pg.next_version(),
+                                     xattrs=op.xattrs,
+                                     snapset_update=op.snapset_update)
+
+            self._encode_pipelined(padded, op.parent_span, have_shards)
 
     # ---- rmw pipeline (start_rmw, ECBackend.cc:1793) -----------------------
     def _start_rmw(self, op: RMWOp) -> None:
@@ -523,20 +760,30 @@ class ECBackend:
             buf[:len(old_bytes)] = old_bytes
             rel = op.offset - a0
             buf[rel:rel + len(op.data)] = op.data
-            shards = self._encode(bytes(buf))
             new_size = max(op.old_size, op.offset + len(op.data))
             c0 = self.sinfo.aligned_logical_offset_to_chunk_offset(a0)
 
-            def all_commit() -> None:
-                self.extent_cache.write(op.oid, a0, bytes(buf), new_size)
-                op.on_commit(0)
-                self._op_done(op.oid)
+            def have_shards(shards, err) -> None:
+                if err is not None:
+                    op.on_commit(-5)
+                    self._op_done(op.oid)
+                    return
 
-            self._fan_out_shards(op.tid, op.oid, shards, chunk_off=c0,
-                                 partial=True, new_size=new_size,
-                                 on_all_commit=all_commit,
-                                 client_reply=op.on_commit,
-                                 version=self.pg.next_version())
+                def all_commit() -> None:
+                    self.extent_cache.write(op.oid, a0, bytes(buf),
+                                            new_size)
+                    op.on_commit(0)
+                    self._op_done(op.oid)
+
+                self._fan_out_shards(op.tid, op.oid, shards,
+                                     chunk_off=c0,
+                                     partial=True, new_size=new_size,
+                                     on_all_commit=all_commit,
+                                     client_reply=op.on_commit,
+                                     version=self.pg.next_version())
+
+            self._encode_pipelined(bytes(buf), op.parent_span,
+                                   have_shards)
 
     def _fan_out_shards(self, tid: int, oid: str,
                         shards: Dict[int, np.ndarray], chunk_off: int,
@@ -563,7 +810,9 @@ class ECBackend:
                 snapset_update=snapset_update,
                 trace_id=cur_trace, parent_span_id=cur_span)
             wr.pending_shards.add(shard)
+            wr.sent_msgs[shard] = (osd, msg)
             self.pg.send_to_osd(osd, msg)
+        wr.last_send = self.pg.osd.now
         self.inflight_writes[tid] = wr
 
     def push_chunks(self, oid: str, shard_data: Dict[int, bytes],
@@ -592,10 +841,12 @@ class ECBackend:
                 chunk=chunk, offset=0, partial=False, at_version=size,
                 version=version, is_push=True, xattrs=xattrs)
             wr.pending_shards.add(shard)
+            wr.sent_msgs[shard] = (acting[shard], msg)
             self.pg.send_to_osd(acting[shard], msg)
         if not wr.pending_shards:
             on_done()
             return tid
+        wr.last_send = self.pg.osd.now
         self.inflight_writes[tid] = wr
         return tid
 
@@ -621,10 +872,25 @@ class ECBackend:
         (ECTransaction.cc generate_transactions hinfo updates).
         """
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}s{msg.shard}"
+        ho = hobject_t(msg.oid, msg.shard)
+        if msg.version and not msg.is_push and \
+                store.collection_exists(cid) and store.exists(cid, ho):
+            # resend dedup: the stored version already covers this
+            # message — the original apply succeeded and only the ack
+            # was lost.  Re-applying would overwrite the rollback stash
+            # with POST-write state and duplicate the log entry, so
+            # just re-ack (the reference dedups via the pg log's
+            # already-applied check in do_request)
+            from .pg_log import VERSION_ATTR
+            vb = store.getattrs(cid, ho).get(VERSION_ATTR)
+            if vb is not None and \
+                    struct.unpack("<Q", vb)[0] >= msg.version:
+                return MOSDECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
+                                             shard=msg.shard,
+                                             committed=True)
         t = Transaction()
         if not store.collection_exists(cid):
             t.create_collection(cid)
-        ho = hobject_t(msg.oid, msg.shard)
         if pg is not None and msg.version and not msg.is_push:
             stash_pre_write_state(t, store, pg, msg.oid, cid, ho,
                                   msg.version)
@@ -705,12 +971,60 @@ class ECBackend:
         if wr is None:
             return
         wr.pending_shards.discard(msg.shard)
+        wr.sent_msgs.pop(msg.shard, None)
         if not wr.pending_shards:
             del self.inflight_writes[msg.tid]
             if wr.on_all_commit is not None:
                 wr.on_all_commit()
             else:
                 wr.client_reply(0)
+
+    def sweep_inflight(self, now: Optional[float] = None,
+                       idle: bool = False) -> int:
+        """Resend unacked sub-op writes (the reference's messenger
+        retries at the Connection layer; this fabric needs an explicit
+        timer).  Two drivers: the OSD tick (``now`` = cluster clock,
+        resend after ``ec_subwrite_retry_timeout``) and the
+        deterministic fabric's idle kick (``idle=True`` — quiescence
+        means the message or its ack is provably lost, resend now).
+        Bounded by ``ec_subwrite_retry_max`` per write so a down shard
+        cannot spin the fabric; past the cap the write waits for
+        peering's on_change, exactly as before the timer existed.
+        Returns the number of messages resent."""
+        timeout = float(g_conf.get_val("ec_subwrite_retry_timeout"))
+        if timeout <= 0:
+            return 0
+        max_resend = int(g_conf.get_val("ec_subwrite_retry_max"))
+        pc = pipeline_perf_counters()
+        sent = 0
+        for wr in list(self.inflight_writes.values()):
+            if not wr.pending_shards or wr.resends >= max_resend:
+                continue
+            if idle:
+                # the idle kick re-fires every time the fabric drains,
+                # so an unreachable (down/blackholed) target would burn
+                # the whole budget inside ONE pump and leave nothing
+                # for the paced tick retries after the outage heals —
+                # cap idle-driven rounds at two (enough for a dropped
+                # send AND a dropped resend)
+                if wr.resends >= min(2, max_resend):
+                    continue
+            elif now is None or now - wr.last_send < timeout:
+                continue
+            wr.resends += 1
+            wr.last_send = self.pg.osd.now if now is None else now
+            for shard in sorted(wr.pending_shards):
+                ent = wr.sent_msgs.get(shard)
+                if ent is None:
+                    continue
+                osd, msg = ent
+                pc.inc(l_pipeline_subwrite_resends)
+                g_tracer.event("subwrite_resend", shard=shard,
+                               oid=wr.oid, tid=wr.tid,
+                               attempt=wr.resends)
+                self.pg.send_to_osd(osd, msg)
+                sent += 1
+        return sent
 
     # ---- read path (primary) ---------------------------------------------
     def objects_read_and_reconstruct(

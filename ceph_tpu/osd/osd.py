@@ -592,6 +592,10 @@ class OSD(Dispatcher):
                 pg.do_op(msg)
         elif kind == "scrub":
             item[1].start_scrub(deep=item[2] if len(item) > 2 else False)
+        elif kind == "pipeline":
+            # deferred EC write-pipeline continuation (fan-out under
+            # the PG lock — _wq_handle_locked took it via item[1])
+            item[2]()
 
     def send_op_reply(self, dst: str, reply: MOSDOpReply) -> None:
         """All client replies funnel here so op tracking/latency see them."""
@@ -765,6 +769,12 @@ class OSD(Dispatcher):
             if pg._notifies:
                 pg.sweep_notifies()
             pg.retry_pending_pg_temp()
+            pg.retry_peering()
+            if pg.backend is not None and pg.backend.inflight_writes:
+                # in-flight sweep: resend unacked EC sub-op writes so a
+                # messenger-level drop cannot wedge the per-oid write
+                # pipeline until peering (docs/ROBUSTNESS.md)
+                pg.backend.sweep_inflight(now)
             pg.maybe_realign()
             if pg.tier is not None and pg.is_primary():
                 pg.tier.agent_work(now)
@@ -904,6 +914,17 @@ class OSD(Dispatcher):
         else:
             peer = int(msg.src.split(".")[1])
             self.last_ping_reply[peer] = self.now
+        if msg.epoch > self.osdmap.epoch:
+            # a peer runs a newer map than ours — our MOSDMap delivery
+            # was lost (droppable fabric): re-subscribe for the full
+            # history (OSD::osdmap_subscribe on a detected gap).
+            # Rate-limited by time, not epoch, so a lost subscribe or
+            # reply just retries on the next heartbeat round.
+            if self.now - getattr(self, "_map_catchup_at", -1e9) > 2.0:
+                self._map_catchup_at = self.now
+                from ..msg.messages import MMonSubscribe
+                for mon in self.mon_names:
+                    self.messenger.send_message(MMonSubscribe(), mon)
 
     # ---- tier client (Objecter-lite for promote/flush) ---------------------
     def tier_submit(self, pool_id: int, oid: str, ops,

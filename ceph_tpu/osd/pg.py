@@ -257,6 +257,11 @@ class PG:
         self._peer_infos: Dict[int, MOSDPGInfo] = {}
         self._getlog_pending: Optional[int] = None
         self._rewind_requested = False
+        self._rewind_horizon: Optional[int] = None
+        # peering-round query retry state (retry_peering): the exact
+        # queries sent this round, and the last (re)send stamp
+        self._peering_queries: Dict[int, MOSDPGQuery] = {}
+        self._peering_sent_at = -1e9
         self._backfill_pending: Set[int] = set()
         self._self_backfill_from: Optional[int] = None
         self._recovering: Set[str] = set()
@@ -643,9 +648,46 @@ class PG:
         if self.backend is not None:
             self.backend.on_change()
         self._peer_pending = set(self.acting_shards())
+        self._peering_queries = {}
+        self._peering_sent_at = getattr(self.osd, "now", 0.0)
+        self._rewind_horizon = None
         for shard, osd in self.acting_shards().items():
-            self.send_to_osd(osd, MOSDPGQuery(
+            self._send_peering_query(shard, MOSDPGQuery(
                 pgid=self.pgid, shard=shard, epoch=epoch))
+
+    def _send_peering_query(self, shard: int, msg: MOSDPGQuery) -> None:
+        """Send one peering-round query, remembering it so the tick can
+        resend the EXACT message (rewind_to/log_since included) while
+        the shard stays pending — peering rides the same droppable
+        fabric as data, and a lost query must not wedge the round."""
+        self._peering_queries[shard] = msg
+        osd = self.acting_shards().get(shard)
+        if osd is not None:
+            self.send_to_osd(osd, msg)
+
+    def retry_peering(self) -> None:
+        """Tick-driven resend of this peering round's outstanding
+        queries (rate-limited).  Replies are idempotent: a replica
+        re-answers info, an already-rewound shard's rewind is a no-op
+        (pg_log.head <= to), a duplicate GetLog reply is dropped by
+        the _getlog_pending check in handle_pg_info, and a late
+        pre-rewind duplicate is rejected by the horizon gate there."""
+        if not self.is_primary() or self.state != STATE_PEERING:
+            return
+        pending = set(self._peer_pending) \
+            | ({self._getlog_pending}
+               if self._getlog_pending is not None else set())
+        if not pending:
+            return
+        now = self.osd.now
+        if now - self._peering_sent_at < 2.0:
+            return
+        self._peering_sent_at = now
+        acting = self.acting_shards()
+        for shard in sorted(pending):
+            msg = self._peering_queries.get(shard)
+            if msg is not None and shard in acting:
+                self.send_to_osd(acting[shard], msg)
 
     def handle_pg_query(self, msg: MOSDPGQuery) -> None:
         """Any replica (incl. the primary itself): report state; attach
@@ -797,8 +839,10 @@ class PG:
             self._realign_replicated()
             return
         # quiesce: no in-flight writes may interleave with the shard
-        # copies (clients see EAGAIN while realigning and resend)
-        if self.backend._oid_queues or self.backend.inflight_writes:
+        # copies (clients see EAGAIN while realigning and resend) —
+        # including pipelined encodes still queued in the dispatcher
+        if self.backend._oid_queues or self.backend.inflight_writes \
+                or self.backend.pipeline_inflight:
             return
         moves = [s for s in range(len(self.up))
                  if s < len(self.acting)
@@ -949,6 +993,21 @@ class PG:
             return
         if self.state != STATE_PEERING:
             return
+        if msg.shard not in self._peer_pending:
+            # duplicate info (the tick's query resend raced the
+            # original reply): refresh the record but never re-enter
+            # _peering_all_infos — the round already advanced past
+            # this shard (a GetLog may be outstanding)
+            self._peer_infos[msg.shard] = msg
+            return
+        if self._rewind_horizon is not None and \
+                msg.last_update > self._rewind_horizon:
+            # the shard is being asked to rewind to the horizon, so the
+            # reply that settles it must show last_update <= horizon; a
+            # head beyond it is a late duplicate of the PRE-rewind info
+            # (the retry resend raced the original reply) — consuming
+            # it would activate on entries the shard just rolled back
+            return
         self._peer_infos[msg.shard] = msg
         self._peer_pending.discard(msg.shard)
         if not self._peer_pending:
@@ -1076,9 +1135,10 @@ class PG:
         if not divergent:
             return False
         self._rewind_requested = True
+        self._rewind_horizon = horizon
         for shard in divergent:
             self._peer_pending.add(shard)
-            self.send_to_osd(self.acting_shards()[shard], MOSDPGQuery(
+            self._send_peering_query(shard, MOSDPGQuery(
                 pgid=self.pgid, shard=shard, epoch=self.peering_epoch,
                 rewind_to=horizon))
         return True
@@ -1099,8 +1159,7 @@ class PG:
         if auth_shard is not None:
             # GetLog: pull the authoritative suffix before activating
             self._getlog_pending = auth_shard
-            osd = self.acting_shards()[auth_shard]
-            self.send_to_osd(osd, MOSDPGQuery(
+            self._send_peering_query(auth_shard, MOSDPGQuery(
                 pgid=self.pgid, shard=auth_shard,
                 epoch=self.last_epoch_started,
                 log_since=self.pg_log.head))

@@ -15,7 +15,7 @@ from .span import Span, SpanCollector, Tracer, build_tree, g_tracer
 from .histogram import (
     PerfHistogram, PerfHistogramAxis, PerfHistogramCollection,
     SCALE_LINEAR, SCALE_LOG2, g_perf_histograms, latency_axes,
-    latency_in_bytes_axes, occupancy_axes,
+    latency_in_bytes_axes, occupancy_axes, pipeline_axes,
 )
 from .flight import FlightEntry, FlightRecorder, g_flight_recorder
 
@@ -23,6 +23,6 @@ __all__ = [
     "Span", "SpanCollector", "Tracer", "build_tree", "g_tracer",
     "PerfHistogram", "PerfHistogramAxis", "PerfHistogramCollection",
     "SCALE_LINEAR", "SCALE_LOG2", "g_perf_histograms", "latency_axes",
-    "latency_in_bytes_axes", "occupancy_axes",
+    "latency_in_bytes_axes", "occupancy_axes", "pipeline_axes",
     "FlightEntry", "FlightRecorder", "g_flight_recorder",
 ]

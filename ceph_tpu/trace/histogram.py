@@ -212,3 +212,13 @@ def occupancy_axes() -> List[PerfHistogramAxis]:
     bucket instead of vanishing into +Inf."""
     return [PerfHistogramAxis("batch_occupancy", min=0, quant_size=1,
                               buckets=67, scale_type=SCALE_LINEAR)]
+
+
+def pipeline_axes() -> List[PerfHistogramAxis]:
+    """1D EC write-pipeline occupancy (ops in flight in the per-PG
+    window at encode-submit time) — linear unit buckets, dimensionless
+    like occupancy_axes (the mgr renderer exports raw bucket edges).
+    Depths 0..32 are individually visible; deeper windows overflow into
+    the last bucket."""
+    return [PerfHistogramAxis("pipeline_inflight", min=0, quant_size=1,
+                              buckets=35, scale_type=SCALE_LINEAR)]

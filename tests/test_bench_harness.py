@@ -300,12 +300,22 @@ def test_smoke_mode_end_to_end():
         assert m["roofline"]["verdict"] in ("ok", "suspect", "unknown")
     assert {"ec_encode_k8m4_fenced", "ec_decode_k8m4_e2_fenced",
             "ec_dispatch_coalesce_fenced",
-            "ec_dispatch_serial_fenced"} <= names
+            "ec_dispatch_serial_fenced",
+            "ec_pipeline_fenced", "ec_pipeline_depth1_fenced"} <= names
     # the coalesce metric carries its serial twin and speedup
     mc = next(m for m in out["metrics"]
               if m["name"] == "ec_dispatch_coalesce_fenced")
     assert mc["serial_gibs"] > 0 and mc["speedup"] > 0
     assert mc["batch_occupancy"] == mc["n_requests"] == 8
+    # pipeline acceptance: a SINGLE submitter at depth 8 must fill real
+    # batches (mean dispatch occupancy >= 4) and stay byte-identical to
+    # the depth-1 passthrough
+    mp = next(m for m in out["metrics"]
+              if m["name"] == "ec_pipeline_fenced")
+    assert mp["pipeline_depth"] == 8
+    assert mp["mean_batch_occupancy"] >= 4, mp
+    assert mp["identical"] is True
+    assert mp["depth1_gibs"] > 0 and mp["speedup"] > 0
     # the gate ran (warn mode) and the observability counters moved
     assert "gate" in out
     assert out["perf"]["dispatches"] > 0

@@ -10,10 +10,16 @@ uninjected run.
 
 Determinism notes baked into the parameters:
 
-- ``msg.drop`` is scoped to ``match="MOSDOp "`` (client REQUESTS): a
-  dropped request was never executed, so the Objecter's refresh-and-
-  resend loop replays it exactly once.  Reply drops would double-apply
-  non-idempotent ops; request drops cannot.
+- the SMOKE drops ALL traffic (``msg.drop`` unscoped): client requests
+  replay via the Objecter's refresh-and-resend loop, EC sub-op writes
+  via the ec_backend resend timer (shard-side replay is version-deduped
+  so a lost ACK cannot double-apply), peering queries via the tick
+  retry, and lost MOSDMap deliveries via the heartbeat-epoch
+  resubscribe.  A deterministic scoped drop of one MOSDECSubOpWrite
+  runs first, as the drop→resend receipt.
+- the twin-cluster SOAK keeps ``match="MOSDOp "`` (client REQUESTS): a
+  dropped request was never executed, so the replay count — and with
+  it the twin comparison of stored shard bodies — stays exact.
 - ``osd.shard_read_eio`` uses ``nth n=4``: any one read fans to at most
   5 shard reads (k=3 + m=2 retries), and 5 consecutive checks contain
   at most 2 multiples of 4 — never more than m failures per read, so
@@ -52,10 +58,11 @@ def _boot(n_osds=6, k=3, m=2):
     return c, c.client("client.chaos")
 
 
-def _arm_chaos(seed: int) -> None:
+def _arm_chaos(seed: int, drop_match: str = "MOSDOp ",
+               drop_p: float = 0.2) -> None:
     g_conf.set_val("ec_device_retry_backoff_us", 0)
-    g_faults.inject("msg.drop", mode="prob", p=0.2, seed=seed,
-                    match="MOSDOp ")
+    g_faults.inject("msg.drop", mode="prob", p=drop_p, seed=seed,
+                    match=drop_match)
     g_faults.inject("device.encode_batch", mode="nth", n=3)
     g_faults.inject("device.decode_batch", mode="nth", n=3)
     g_faults.inject("osd.shard_read_eio", mode="nth", n=4)
@@ -103,15 +110,30 @@ def _workload(c, cl, expected, rng, gens, kill_cycle=(1,)):
 
 
 def test_chaos_smoke(clean_faults):
-    """Tier-1: drops + device errors + read EIO at once, one kill/
-    revive cycle, every op completes, every object reads back exactly."""
+    """Tier-1: UNSCOPED message drops (sub-op writes included) + device
+    errors + read EIO at once, one kill/revive cycle, every op
+    completes, every object reads back exactly."""
+    from ceph_tpu.osd.ec_backend import (l_pipeline_subwrite_resends,
+                                         pipeline_perf_counters)
     c, cl = _boot()
     pc = fault_perf_counters()
+    ppc = pipeline_perf_counters()
     before = {"inj": pc.get(l_fault_injected),
               "drop": pc.get(l_fault_msg_drops),
-              "rec": pc.get(l_fault_eio_reconstructs)}
+              "rec": pc.get(l_fault_eio_reconstructs),
+              "resend": ppc.get(l_pipeline_subwrite_resends)}
     expected = {}
-    _arm_chaos(seed=1234)
+    # deterministic drop→resend receipt: lose exactly one EC sub-op
+    # write; before the resend timer this wedged the per-oid pipeline
+    # until peering — now the op must complete on the retry
+    g_faults.inject("msg.drop", mode="once", match="MOSDECSubOpWrite ")
+    assert cl.write_full("chaos", "receipt", b"r" * 4000) == 0
+    assert cl.read("chaos", "receipt") == b"r" * 4000
+    assert ppc.get(l_pipeline_subwrite_resends) > before["resend"], \
+        "dropped sub-write was not resent"
+    expected["receipt"] = b"r" * 4000
+    g_faults.clear("msg.drop")
+    _arm_chaos(seed=1234, drop_match="", drop_p=0.04)  # ALL traffic
     rng = np.random.default_rng(99)
     _workload(c, cl, expected, rng, gens=2, kill_cycle=(1,))
     g_faults.clear()

@@ -178,6 +178,16 @@ def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
     assert fault_perf_counters().dump()["device_errors"] \
         == errors_before, "unarmed guard recorded a device failure"
     assert g_breakers.degraded() == []
+    # async-pipeline extension: the continuation-driven write path
+    # (ec_pipeline_depth > 1, encode resolved via add_done_callback)
+    # must add zero device syncs too, tracing off
+    g_conf.set_val("ec_pipeline_depth", 8)
+    g_conf.set_val("ec_dispatch_batch_window_us", 100_000)
+    try:
+        assert cl.write_full("trace", "o_piped", b"p" * 20000) == 0
+        assert calls["n"] == 0, "async pipeline added a device sync"
+    finally:
+        g_conf.rm_val("ec_pipeline_depth")
 
 
 def test_slow_op_span_tree_and_histogram_dump(clean_tracing):

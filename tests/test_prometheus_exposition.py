@@ -63,12 +63,22 @@ def _le_of(labels: str):
 @pytest.fixture(scope="module")
 def exposition():
     from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.common.config import g_conf
     c = MiniCluster(n_osds=6)
     c.create_ec_pool("prom", k=3, m=2, pg_num=8)
     cl = c.client("client.prom")
     assert cl.write_full("prom", "o1", b"p" * 20000) == 0
     assert cl.write_full("prom", "o2", b"q" * 4000) == 0
     assert cl.read("prom", "o1")[:1] == b"p"
+    # one write through the async pipeline so its histogram/counters
+    # carry samples on the exposition surface
+    g_conf.set_val("ec_pipeline_depth", 4)
+    g_conf.set_val("ec_dispatch_batch_window_us", 100_000)
+    try:
+        assert cl.write_full("prom", "o3", b"r" * 8000) == 0
+    finally:
+        g_conf.rm_val("ec_pipeline_depth")
+        g_conf.rm_val("ec_dispatch_batch_window_us")
     return c.admin_socket.execute("prometheus metrics")
 
 
@@ -147,6 +157,32 @@ def test_dispatch_occupancy_family_and_counters(exposition):
     assert sub and sub[0] > 0, "dispatch_submitted counter missing"
     assert any(n == "ceph_daemon_dispatch_passthrough"
                for n, _l, _v in samples)
+
+
+def test_pipeline_family_and_counters(exposition):
+    """Async-pipeline golden coverage: the per-PG pipeline-occupancy
+    histogram renders as a real histogram family with RAW (unscaled)
+    linear bucket edges — the dimensionless-axis renderer path the
+    dispatch occupancy family established — and the pipeline perf
+    counters (inflight gauge included) render as daemon series."""
+    types, samples = _parse(exposition)
+    fam = "ceph_pipeline_inflight_histogram"
+    assert types.get(fam) == "histogram", \
+        "pipeline-occupancy histogram family missing"
+    buckets = [(_le_of(labels), v) for n, labels, v in samples
+               if n == f"{fam}_bucket"]
+    assert buckets, "no pipeline buckets rendered"
+    les = sorted(le for le, _v in buckets if le != math.inf)
+    assert les[0] == 0.0 and 2.0 in les, f"unexpected edges {les[:4]}"
+    # the fixture's pipelined write landed a sample somewhere
+    counts = [v for n, _l, v in samples if n == f"{fam}_count"]
+    assert sum(counts) >= 1, "pipelined write left no histogram sample"
+    # pipeline counters on the daemon surface, gauge included
+    sub = [v for n, _l, v in samples
+           if n == "ceph_daemon_pipeline_submitted"]
+    assert sub and sub[0] >= 1, "pipeline_submitted counter missing"
+    assert any(n == "ceph_daemon_pipeline_pipeline_inflight"
+               for n, _l, _v in samples), "pipeline_inflight gauge missing"
 
 
 def test_op_histograms_carry_the_writes(exposition):
