@@ -55,7 +55,8 @@ def main(argv=None) -> int:
     from .workloads import (bench_perf_counters, measure_decode,
                             measure_dispatch_coalesce,
                             measure_ec_pipeline, measure_encode,
-                            measure_host_native, parity_check)
+                            measure_host_native, measure_traffic,
+                            parity_check)
     from ..gf.matrices import gf_gen_rs_matrix
 
     K, M = 8, 4
@@ -112,6 +113,19 @@ def main(argv=None) -> int:
                  f"{mp1['value']} depth-1 (x{mp['speedup']}, occupancy "
                  f"{mp['mean_batch_occupancy']}, identical "
                  f"{mp['identical']})")
+        # traffic harness (ceph_tpu/load): ≥8 concurrent synthetic
+        # clients over the real client stack; the smoke shape is <10 s
+        # on CPU, the full mode drives a deeper closed loop
+        mt = measure_traffic(
+            n_clients=8,
+            ops_per_client=32 if args.smoke else 256,
+            name="traffic_harness_smoke" if args.smoke
+            else "traffic_harness")
+        result["metrics"].append(mt)
+        progress(f"traffic {mt['value']} ops/s over "
+                 f"{mt['n_clients']} clients ({mt['total_ops']} ops, "
+                 f"byte_exact {mt['byte_exact']}, agg p99 "
+                 f"{mt['aggregate'].get('p99')}us)")
         host = measure_host_native(matrix, batch[0],
                                    target_seconds=0.3 if args.smoke
                                    else 1.5)

@@ -282,7 +282,11 @@ class RadosClient(Dispatcher):
                          data: bytes, offset: int, length: int,
                          ops: Optional[list], snapid: int,
                          trace_id: int, span_id: int) -> MOSDOpReply:
-        for attempt in range(MAX_ATTEMPTS):
+        import time as _time
+        reply = None
+        tid = self._tid
+        attempt = throttle_waits = 0
+        while attempt < MAX_ATTEMPTS:
             pgid, primary = self._calc_target(pool_id, oid)
             self._tid += 1
             tid = self._tid
@@ -299,8 +303,26 @@ class RadosClient(Dispatcher):
                 self.messenger.send_message(msg, f"osd.{primary}")
                 self.network.pump()
             reply = self._replies.pop(tid, None)
+            if reply is not None and reply.result == -11 and \
+                    getattr(reply, "retry_after", 0.0) > 0:
+                # admission-control throttle (docs/QOS.md): the op was
+                # SHED at intake, not misrouted — back off and resend
+                # without burning a map-refresh attempt.  Bounded so a
+                # permanently saturated OSD still surfaces EAGAIN; the
+                # pump between resends is what drains the queue on the
+                # deterministic fabric.
+                throttle_waits += 1
+                if throttle_waits <= 256:
+                    if not self.network.pump():
+                        # nothing moved (remote daemons still working):
+                        # honor the hint briefly before resending — on
+                        # the in-process fabric the pump IS the drain,
+                        # so a wall-sleep there is pure dead time
+                        _time.sleep(min(reply.retry_after, 0.02))
+                    continue
             if reply is not None and reply.result != -11:
                 return reply
+            attempt += 1
             # wrong/silent primary: refresh the map and retry
             self.mon.send_full_map(self.name)
             self.network.pump()

@@ -81,13 +81,22 @@ class MiniCluster:
         did = bool(g_dispatcher.pending_count() and g_dispatcher.flush())
         # threaded op queues defer pipeline continuations back through
         # the sharded wq — flush the pools so their fan-out reaches the
-        # wire before pump decides the fabric is quiescent
+        # wire before pump decides the fabric is quiescent.  With
+        # osd_op_queue_batch_intake, synchronous OSDs also leave intake
+        # bursts queued until quiescence: drain them here so the mClock
+        # tiers arbitrate the whole pump's burst at once (docs/QOS.md)
         for osd in self.osds.values():
             if osd.name in self.network.down:
                 continue
-            if osd.op_tp is not None and len(osd.op_wq):
-                osd.drain_ops()
-                did = True
+            if len(osd.op_wq):
+                if osd.op_tp is not None:
+                    osd.drain_ops()
+                    did = True
+                else:
+                    # wall-mode rate-blocked ops stay queued (the tick
+                    # re-drives them); a zero-handled drain must not
+                    # report progress or pump would spin
+                    did = bool(osd.drain_ops()) or did
         if did:
             return True     # let pump drain the fan-out first
         for osd in self.osds.values():
@@ -278,6 +287,8 @@ class MiniCluster:
         self.perf_collection.add(dispatch_perf_counters())
         from .osd.ec_backend import pipeline_perf_counters
         self.perf_collection.add(pipeline_perf_counters())
+        from .common.work_queue import qos_perf_counters
+        self.perf_collection.add(qos_perf_counters())
         asok.register(
             "dispatch dump",
             lambda c, a: g_dispatcher.dump(),

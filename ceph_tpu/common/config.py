@@ -158,6 +158,45 @@ def build_options() -> List[Option]:
         .set_description("enforce mclock reservation/limit in ops per "
                          "REAL second (src/dmclock role) instead of "
                          "the deterministic virtual clock"),
+        Option("osd_mclock_client_reservation", OPT_FLOAT)
+        .set_default(0.0)
+        .set_description("per-client dmClock reservation inside the "
+                         "client op class, in ops per 1000 client-tier "
+                         "dequeues (docs/QOS.md); 0 = no floor"),
+        Option("osd_mclock_client_weight", OPT_FLOAT).set_default(1.0)
+        .set_description("per-client dmClock weight inside the client "
+                         "op class: backlogged clients share dequeues "
+                         "proportionally to their weights"),
+        Option("osd_mclock_client_limit", OPT_FLOAT).set_default(0.0)
+        .set_description("per-client dmClock limit inside the client "
+                         "op class, in ops per 1000 client-tier "
+                         "dequeues; 0 = uncapped"),
+        Option("osd_mclock_client_overrides", OPT_STR).set_default("")
+        .set_description("per-entity (res, weight, limit) overrides: "
+                         "'entity:res:weight:limit[,entity:...]' — "
+                         "entities not listed use the "
+                         "osd_mclock_client_* defaults"),
+        Option("osd_op_queue_admission_max", OPT_INT).set_default(0)
+        .set_description("op-queue depth at which client-op intake "
+                         "sheds load: new client ops are answered "
+                         "EAGAIN with a retry_after hint instead of "
+                         "growing the queue (docs/QOS.md admission "
+                         "control); 0 = disabled"),
+        Option("osd_op_queue_throttle_window", OPT_FLOAT)
+        .set_default(0.0)
+        .set_description("seconds a shed client stays throttled after "
+                         "tripping admission control (on top of the "
+                         "depth hysteresis: a throttled client is "
+                         "re-admitted only once the queue drains below "
+                         "half of osd_op_queue_admission_max); also "
+                         "the retry_after hint floor sent to clients"),
+        Option("osd_op_queue_batch_intake", OPT_BOOL).set_default(False)
+        .set_description("do not drain the sharded op queue inline at "
+                         "every intake: ops accumulate across one fabric "
+                         "pump and drain at quiescence, so bursts see "
+                         "real mClock arbitration (the traffic "
+                         "harness's intake mode; default preserves "
+                         "the synchronous drain)"),
         Option("osd_capacity_bytes", OPT_INT).set_default(0)
         .set_description("logical capacity per OSD for full-ratio "
                          "accounting (osd_stat_t kb role); 0 = "

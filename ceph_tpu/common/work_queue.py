@@ -16,9 +16,22 @@ Two reference mechanisms reproduced with honest semantics:
 
 The scheduler decides ORDER whenever more ops are queued than drained
 in one step — exactly the burst case QoS exists for.
+
+Per-client dmClock (docs/QOS.md): inside each class queue, ops that
+carry a client entity are arbitrated by a second dmClock tier keyed by
+that entity — (reservation, weight, limit) per client, defaults from
+the ``osd_mclock_client_*`` options, overrides from
+``osd_mclock_client_overrides``.  The class tier stays the OUTER
+arbiter (recovery/scrub arbitration is unchanged); the client tier
+only decides WHICH client's op goes when the class tier picks that
+class.  The client tier always runs the deterministic virtual clock
+(one tick per pop): its reservation/limit are shares of the class's
+dequeues (ops per 1000 client-tier pops), not wall rates — wall-rate
+enforcement stays a class-tier (WallMClockQueue) property.
 """
 from __future__ import annotations
 
+import threading
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
@@ -40,6 +53,247 @@ DEFAULT_TAGS: Dict[str, Tuple[float, float, float]] = {
 }
 
 
+# ---- qos perf counters (perf dump / Prometheus) ----------------------------
+QOS_FIRST = 95000
+l_qos_dequeue_client = 95001     # client-class ops dequeued
+l_qos_dequeue_recovery = 95002
+l_qos_dequeue_scrub = 95003
+l_qos_dequeue_snaptrim = 95004
+l_qos_admission_rejections = 95005  # ops refused at intake (EAGAIN)
+l_qos_throttle_events = 95006    # clients newly entering throttle
+l_qos_queue_depth = 95007        # gauge: op-queue depth at last intake
+QOS_LAST = 95010
+
+_CLASS_DEQ_IDX = {
+    CLASS_CLIENT: l_qos_dequeue_client,
+    CLASS_RECOVERY: l_qos_dequeue_recovery,
+    CLASS_SCRUB: l_qos_dequeue_scrub,
+    CLASS_SNAPTRIM: l_qos_dequeue_snaptrim,
+}
+
+_qos_pc = None
+_qos_pc_lock = threading.Lock()
+
+
+def qos_perf_counters():
+    """The op-queue QoS counter logger (perf dump / Prometheus)."""
+    global _qos_pc
+    if _qos_pc is not None:
+        return _qos_pc
+    with _qos_pc_lock:
+        if _qos_pc is None:
+            from .perf_counters import PerfCountersBuilder
+            b = PerfCountersBuilder("qos", QOS_FIRST, QOS_LAST)
+            b.add_u64_counter(l_qos_dequeue_client, "dequeues_client",
+                              "client-class ops dequeued")
+            b.add_u64_counter(l_qos_dequeue_recovery, "dequeues_recovery",
+                              "recovery-class ops dequeued")
+            b.add_u64_counter(l_qos_dequeue_scrub, "dequeues_scrub",
+                              "scrub-class ops dequeued")
+            b.add_u64_counter(l_qos_dequeue_snaptrim, "dequeues_snaptrim",
+                              "snaptrim-class ops dequeued")
+            b.add_u64_counter(l_qos_admission_rejections,
+                              "admission_rejections",
+                              "client ops shed at intake "
+                              "(osd_op_queue_admission_max)")
+            b.add_u64_counter(l_qos_throttle_events, "throttle_events",
+                              "clients newly entering the admission "
+                              "throttle window")
+            b.add_u64(l_qos_queue_depth, "queue_depth",
+                      "op-queue depth observed at the last intake")
+            _qos_pc = b.create_perf_counters()
+    return _qos_pc
+
+
+def _note_class_dequeue(op_class: str) -> None:
+    idx = _CLASS_DEQ_IDX.get(op_class)
+    if idx is not None:
+        qos_perf_counters().inc(idx)
+
+
+class ClientDmClock:
+    """The per-client dmClock lane INSIDE one op class's queue.
+
+    Deque-compatible container (``push``/``pop``/``__len__``) so the
+    class-tier arbiters need not know clients exist: when the class
+    tier picks this class, ``pop`` runs a second (reservation, weight,
+    limit) arbitration across the client entities queued here.  Ops
+    enqueued with no client share the ``""`` lane (pure FIFO among
+    themselves — exactly the pre-client behavior).
+
+    Virtual clock: one tick per pop, so reservation/limit read as ops
+    per 1000 client-tier dequeues — deterministic, like MClockQueue.
+    Per-client tags resolve override -> ``osd_mclock_client_*``
+    defaults; ``osd_mclock_client_overrides`` is parsed lazily
+    ("entity:res:weight:limit[,entity:...]") and re-parsed whenever the
+    option string changes, so injectargs takes effect immediately.
+    """
+
+    __slots__ = ("_queues", "_r_tags", "_w_tags", "_now", "_size",
+                 "_w_floor", "_dequeues", "_override_src", "_overrides",
+                 "_local_tags", "_defaults", "_resolved")
+
+    def __init__(self):
+        self._queues: Dict[str, Deque] = {}
+        self._r_tags: Dict[str, float] = {}
+        self._w_tags: Dict[str, float] = {}
+        self._now = 0.0
+        self._size = 0
+        self._w_floor = 0.0          # last served normalized finish tag
+        self._dequeues: Dict[str, int] = {}
+        self._override_src: Optional[str] = None
+        self._overrides: Dict[str, Tuple[float, float, float]] = {}
+        self._local_tags: Dict[str, Tuple[float, float, float]] = {}
+        self._defaults: Optional[Tuple[float, float, float]] = None
+        self._resolved: Dict[str, Tuple[float, float, float]] = {}
+
+    # ---- tags --------------------------------------------------------------
+    def set_client_tags(self, client: str, res: float, weight: float,
+                        limit: float) -> None:
+        self._local_tags[client] = (float(res), float(weight),
+                                    float(limit))
+
+    def _refresh_tag_sources(self) -> None:
+        """Re-read the osd_mclock_client_* options ONCE per arbitration
+        (pop / idle->active push), not once per candidate: any change
+        to the overrides string or the three defaults drops the
+        per-client resolved cache, so injectargs stays live while a
+        steady-state pop costs one dict lookup per candidate."""
+        from .config import g_conf
+        src = str(g_conf.get_val("osd_mclock_client_overrides") or "")
+        defaults = (
+            float(g_conf.get_val("osd_mclock_client_reservation")),
+            float(g_conf.get_val("osd_mclock_client_weight")),
+            float(g_conf.get_val("osd_mclock_client_limit")))
+        if src == self._override_src and defaults == self._defaults:
+            return
+        self._override_src = src
+        self._defaults = defaults
+        self._resolved = {}
+        self._overrides = {}
+        for part in src.replace(";", ",").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.rsplit(":", 3)
+            if len(bits) != 4:
+                continue     # malformed entry: fall to defaults
+            try:
+                self._overrides[bits[0]] = (float(bits[1]),
+                                            float(bits[2]),
+                                            float(bits[3]))
+            except ValueError:
+                continue
+
+    def _tags_for(self, client: str) -> Tuple[float, float, float]:
+        t = self._local_tags.get(client)
+        if t is not None:
+            return t
+        t = self._resolved.get(client)
+        if t is None:
+            if self._defaults is None:
+                self._refresh_tag_sources()
+            t = self._resolved[client] = self._overrides.get(
+                client, self._defaults)
+        return t
+
+    # ---- deque-compatible container API ------------------------------------
+    def __len__(self) -> int:
+        return self._size
+
+    def push(self, client: str, item) -> None:
+        q = self._queues.get(client)
+        if q is None:
+            q = self._queues[client] = deque()
+        if not q:
+            # idle -> active: clamp tags to the present (dmclock tag
+            # re-clamping) — no hoarded reservation credit, and the
+            # weight tag starts at the most-behind ACTIVE client's
+            # normalized finish (or the last served finish when alone),
+            # so neither newcomers nor returners starve anyone
+            self._refresh_tag_sources()
+            res, weight, _lim = self._tags_for(client)
+            if res > 0:
+                self._r_tags[client] = max(
+                    self._r_tags.get(client, 0.0),
+                    self._now * res / 1000.0)
+            active = [c for c, aq in self._queues.items() if aq]
+            floor = min(
+                (self._w_tags.get(c, 0.0)
+                 / max(self._tags_for(c)[1], 1e-9) for c in active),
+                default=self._w_floor)
+            self._w_tags[client] = max(
+                self._w_tags.get(client, 0.0),
+                floor * max(weight, 1e-9))
+        q.append(item)
+        self._size += 1
+
+    def pop(self):
+        """QoS-chosen item; None when empty."""
+        candidates = [c for c, q in self._queues.items() if q]
+        if not candidates:
+            return None
+        self._now += 1.0
+        # one option-change check per pop; per-candidate resolution is
+        # then a cached dict lookup (nothing can change mid-decision)
+        self._refresh_tag_sources()
+        tags = {c: self._tags_for(c) for c in candidates}
+        if len(candidates) == 1:
+            best = candidates[0]
+        else:
+            # phase 1: reservations — most-behind-its-floor first
+            best, best_deficit = None, 0.0
+            for c in candidates:
+                res = tags[c][0]
+                if res <= 0:
+                    continue
+                deficit = self._now * res / 1000.0 \
+                    - self._r_tags.get(c, 0.0)
+                if deficit > best_deficit:
+                    best, best_deficit = c, deficit
+            if best is None:
+                # phase 2: weight shares — lowest normalized finish tag
+                # wins; clients at their limit stand aside unless all
+                # are (work-conserving)
+                def finish(c):
+                    return self._w_tags.get(c, 0.0) \
+                        / max(tags[c][1], 1e-9)
+                under = [c for c in candidates
+                         if not self._at_limit(c, tags[c][2])]
+                best = min(under or candidates, key=finish)
+        item = self._queues[best].popleft()
+        self._size -= 1
+        self._r_tags[best] = self._r_tags.get(best, 0.0) + 1.0
+        self._w_tags[best] = self._w_tags.get(best, 0.0) + 1.0
+        self._w_floor = max(
+            self._w_floor,
+            self._w_tags[best] / max(tags[best][1], 1e-9))
+        self._dequeues[best] = self._dequeues.get(best, 0) + 1
+        if not self._queues[best] and len(self._queues) > 64:
+            # bound per-client memory under churn ("millions of
+            # users"): evict the drained lane AND its tag/accounting
+            # state — a returning client is re-clamped by push() like
+            # any newcomer, so dropped history is safe by construction
+            del self._queues[best]
+            self._r_tags.pop(best, None)
+            self._w_tags.pop(best, None)
+            self._dequeues.pop(best, None)
+            self._resolved.pop(best, None)
+        return item
+
+    def _at_limit(self, c: str, lim: float) -> bool:
+        if lim <= 0:
+            return False
+        return self._w_tags.get(c, 0.0) >= self._now * lim / 1000.0
+
+    def dump(self) -> Dict:
+        return {
+            "queued": {c: len(q) for c, q in self._queues.items() if q},
+            "dequeues": dict(self._dequeues),
+            "w_tags": {c: round(v, 3) for c, v in self._w_tags.items()},
+        }
+
+
 class MClockQueue:
     """dmclock-lite over a virtual clock that advances one unit per
     dequeue (deterministic; no wall time in the decision path)."""
@@ -47,17 +301,19 @@ class MClockQueue:
     def __init__(self, tags: Optional[Dict[str, Tuple[float, float,
                                                       float]]] = None):
         self.tags = dict(tags or DEFAULT_TAGS)
-        self._queues: Dict[str, Deque] = {}
+        self._queues: Dict[str, ClientDmClock] = {}
         # per-class progress tags (dmclock's r/w tag pairs)
         self._r_tags: Dict[str, float] = {}
         self._w_tags: Dict[str, float] = {}
         self._now = 0.0
         self._size = 0
 
-    def enqueue(self, op_class: str, item) -> None:
+    def enqueue(self, op_class: str, item, client: str = "") -> None:
         if op_class not in self.tags:
             op_class = CLASS_CLIENT
-        q = self._queues.setdefault(op_class, deque())
+        q = self._queues.get(op_class)
+        if q is None:
+            q = self._queues[op_class] = ClientDmClock()
         if not q:
             # idle -> active: clamp the class's tags to the present so a
             # long-idle class cannot cash in an unbounded reservation
@@ -74,7 +330,7 @@ class MClockQueue:
                 self._w_tags[op_class] = max(
                     self._w_tags.get(op_class, 0.0),
                     floor * max(self.tags[op_class][1], 1e-9))
-        q.append(item)
+        q.push(client, item)
         self._size += 1
 
     def __len__(self) -> int:
@@ -106,10 +362,11 @@ class MClockQueue:
             under = [c for c in candidates if not self._at_limit(c)]
             pool = under or candidates
             best = min(pool, key=finish_tag)
-        item = self._queues[best].popleft()
+        item = self._queues[best].pop()
         self._size -= 1
         self._r_tags[best] = self._r_tags.get(best, 0.0) + 1.0
         self._w_tags[best] = self._w_tags.get(best, 0.0) + 1.0
+        _note_class_dequeue(best)
         return item
 
     def dump(self) -> Dict:
@@ -118,6 +375,10 @@ class MClockQueue:
             "vclock": self._now,
             "r_tags": dict(self._r_tags),
             "w_tags": dict(self._w_tags),
+            # client-tier accounting survives a drained queue: the
+            # dequeue history is exactly what an operator inspects
+            # AFTER a burst
+            "clients": {c: q.dump() for c, q in self._queues.items()},
         }
 
     def _at_limit(self, c: str) -> bool:
@@ -157,17 +418,19 @@ class WallMClockQueue:
         import time as _time
         self.tags = dict(tags or DEFAULT_TAGS)
         self.clock = clock or _time.monotonic
-        self._queues: Dict[str, Deque] = {}
+        self._queues: Dict[str, ClientDmClock] = {}
         self._r_next: Dict[str, float] = {}   # next reservation due
         self._l_next: Dict[str, float] = {}   # next limit-allowed slot
         self._w_tags: Dict[str, float] = {}   # virtual weight finish
         self._w_floor = 0.0                   # last served finish tag
         self._size = 0
 
-    def enqueue(self, op_class: str, item) -> None:
+    def enqueue(self, op_class: str, item, client: str = "") -> None:
         if op_class not in self.tags:
             op_class = CLASS_CLIENT
-        q = self._queues.setdefault(op_class, deque())
+        q = self._queues.get(op_class)
+        if q is None:
+            q = self._queues[op_class] = ClientDmClock()
         if not q:
             now = self.clock()
             # idle -> active: no hoarded reservation credit, no limit
@@ -186,7 +449,7 @@ class WallMClockQueue:
                         default=self._w_floor)
             self._w_tags[op_class] = max(
                 self._w_tags.get(op_class, 0.0), floor)
-        q.append(item)
+        q.push(client, item)
         self._size += 1
 
     def __len__(self) -> int:
@@ -225,8 +488,9 @@ class WallMClockQueue:
         return None, nxt
 
     def _serve(self, c: str, now: float, reserved: bool):
-        item = self._queues[c].popleft()
+        item = self._queues[c].pop()
         self._size -= 1
+        _note_class_dequeue(c)
         res, weight, lim = self.tags[c]
         if res > 0:
             # served work counts toward the floor whatever phase it
@@ -263,6 +527,7 @@ class WallMClockQueue:
             "r_next": dict(self._r_next),
             "l_next": dict(self._l_next),
             "w_tags": dict(self._w_tags),
+            "clients": {c: q.dump() for c, q in self._queues.items()},
         }
 
 
@@ -309,16 +574,19 @@ class ShardedOpWQ:
     def shard_of(self, pgid: Tuple[int, int]) -> int:
         return hash(pgid) % self.n_shards
 
-    def enqueue(self, pgid: Tuple[int, int], op_class: str, item) -> None:
+    def enqueue(self, pgid: Tuple[int, int], op_class: str, item,
+                client: str = "") -> None:
         pool = getattr(self, "_pool", None)
         if pool is not None:
             # threaded mode: the per-shard queues are shared with the
             # workers; serialize on the pool's condition lock and wake
             with pool._cv:
-                self.shards[self.shard_of(pgid)].enqueue(op_class, item)
+                self.shards[self.shard_of(pgid)].enqueue(op_class, item,
+                                                         client)
                 pool._cv.notify_all()
         else:
-            self.shards[self.shard_of(pgid)].enqueue(op_class, item)
+            self.shards[self.shard_of(pgid)].enqueue(op_class, item,
+                                                     client)
 
     def __len__(self) -> int:
         return sum(len(s) for s in self.shards)

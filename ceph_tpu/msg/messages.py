@@ -130,6 +130,12 @@ class MOSDOpReply(Message):
     # object version at reply time (the reference's reply user_version);
     # stamped on stat replies so clients can build assert_ver guards
     version: int = 0
+    # admission-control throttle hint (docs/QOS.md): result=-11 with
+    # retry_after > 0 means "op was SHED at intake, back off this many
+    # seconds and resend" — distinct from the peering EAGAIN, which the
+    # Objecter answers with a map refresh.  Omitted from the wire when
+    # 0.0 so the archived encoding corpus stays byte-identical.
+    retry_after: float = 0.0
 
 
 @dataclass

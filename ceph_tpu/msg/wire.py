@@ -124,6 +124,11 @@ def encode_message(msg: M.Message) -> bytes:
         # byte-identical to the pre-tracing format (decode fills the
         # dataclass default 0)
         fields.pop("parent_span_id", None)
+    if not fields.get("retry_after"):
+        # optional QoS throttle hint (MOSDOpReply): same
+        # omitted-when-default contract as parent_span_id — unthrottled
+        # replies and the archived corpus encode byte-identically
+        fields.pop("retry_after", None)
     if isinstance(msg, M.MOSDMap):
         from ..osdmap.encoding import incremental_to_dict
         fields["incrementals"] = [incremental_to_dict(i)

@@ -141,7 +141,14 @@ def stash_pre_write_state(t: Transaction, store: MemStore, pg, oid: str,
     roll this write back if it proves divergent — the role of the
     reference's append-only writes + rollback info in the PG log
     (ECTransaction.h rollback extents, ecbackend.rst:1-27)."""
-    from .pg_log import encode_rollback, stage_rollback
+    from .pg_log import encode_rollback, load_rollback, stage_rollback
+    prior = load_rollback(store, pg.meta_cid(), oid)
+    if prior is not None and prior[0] >= version:
+        # first-writer-wins per version: a replayed fan-out (resend whose
+        # log entry was dropped as stale, so the log dedup can't see it)
+        # would re-stash POST-apply state here and peering's rollback
+        # would then restore the wrong bytes — keep the original stash
+        return
     exists = store.collection_exists(cid) and store.exists(cid, ho)
     data = store.read(cid, ho) if exists else b""
     attrs = dict(store.getattrs(cid, ho)) if exists else {}

@@ -16,11 +16,16 @@ continue_recovery_op flow, ECBackend.cc:535-743.
 from __future__ import annotations
 
 import struct
+import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..common import Dout, OpTracker, PerfCountersBuilder
-from ..common.work_queue import CLASS_CLIENT, CLASS_SCRUB, ShardedOpWQ
-from ..trace import g_perf_histograms, g_tracer, latency_in_bytes_axes
+from ..common.work_queue import (
+    CLASS_CLIENT, CLASS_SCRUB, ShardedOpWQ, l_qos_admission_rejections,
+    l_qos_queue_depth, l_qos_throttle_events, qos_perf_counters,
+)
+from ..trace import (g_perf_histograms, g_tracer, latency_axes,
+                     latency_in_bytes_axes)
 from ..crush.constants import CRUSH_ITEM_NONE
 from ..msg import (
     Dispatcher, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
@@ -125,6 +130,16 @@ class OSD(Dispatcher):
             self.op_tp = ShardedThreadPool(self.op_wq,
                                            self._wq_handle_locked,
                                            n_threads)
+        # admission-control throttle windows: client entity ->
+        # monotonic expiry.  A client stays listed (and keeps getting
+        # EAGAIN+retry_after) until the queue drains below half of
+        # osd_op_queue_admission_max AND its window lapsed (docs/QOS.md)
+        self._throttled_clients: Dict[str, float] = {}
+        # entities granted their own wait-time histogram lane; past the
+        # cap newcomers share one overflow lane (bounds the process-
+        # global registry under client churn, like ClientDmClock's
+        # 64-lane eviction one layer below)
+        self._client_hist_lanes: Set[str] = set()
         self._rep_pulls: Dict[int, Callable] = {}
         # OSD-level tids (_rep_pulls, recovery probes, realign pushes)
         # live in a range disjoint from every per-PG backend counter
@@ -526,10 +541,65 @@ class OSD(Dispatcher):
             self.pgs[pg_id].advance_map(self.osdmap)
 
     # ---- client ops -------------------------------------------------------
+    def _admit_op(self, msg: MOSDOp) -> bool:
+        """Overload admission control (docs/QOS.md): once the op-queue
+        depth crosses ``osd_op_queue_admission_max``, new CLIENT ops
+        are shed with an EAGAIN + retry_after reply instead of growing
+        the queue unboundedly.  A shed client stays throttled — a
+        depth-hysteresis window (plus an optional wall-clock window) —
+        until the queue drains below half the cap, so one abusive
+        client's replays cannot re-fill the queue the instant a slot
+        opens.  Internal clients (tier ops from other OSDs, daemons)
+        are exempt: an EAGAIN loop inside the cluster would be a
+        livelock, not backpressure."""
+        from ..common.config import g_conf
+        admission_max = int(
+            g_conf.get_val("osd_op_queue_admission_max") or 0)
+        if admission_max <= 0 or not msg.src.startswith("client"):
+            return True
+        qos = qos_perf_counters()
+        depth = len(self.op_wq)
+        qos.set(l_qos_queue_depth, depth)
+        low_water = max(1, admission_max // 2)
+        if len(self._throttled_clients) > 64 and depth < low_water:
+            # opportunistic prune under entity churn — same condition
+            # as the per-client clear below, applied to clients that
+            # never came back (their windows would otherwise pin map
+            # entries forever)
+            now = time.monotonic()
+            self._throttled_clients = {
+                c: u for c, u in self._throttled_clients.items()
+                if u > now}
+        until = self._throttled_clients.get(msg.src)
+        shed = depth >= admission_max or (
+            until is not None and
+            (depth >= low_water or time.monotonic() < until))
+        if not shed:
+            if until is not None:
+                del self._throttled_clients[msg.src]
+            return True
+        window = float(g_conf.get_val("osd_op_queue_throttle_window"))
+        if until is None:
+            # first shed for this client: open its throttle window
+            # (never re-extended on replays, or a retrying client
+            # could be starved forever in wall mode)
+            qos.inc(l_qos_throttle_events)
+            self._throttled_clients[msg.src] = \
+                time.monotonic() + window
+        qos.inc(l_qos_admission_rejections)
+        self.messenger.send_message(MOSDOpReply(
+            tid=msg.tid, result=-11, epoch=self.osdmap.epoch,
+            retry_after=max(window, 1e-3)), msg.src)
+        return False
+
     def _handle_op(self, msg: MOSDOp) -> None:
         """Client op intake: lands in the sharded op queue (one PG's
         ops stay FIFO in their shard, OSD.cc ShardedOpWQ) and drains
-        through the mClock arbiter — under bursts, QoS decides order."""
+        through the mClock arbiter — under bursts, QoS decides order.
+        The client-tier dmClock lane is keyed by the sending entity
+        (msg.src), so one abusive client cannot starve the rest."""
+        if not self._admit_op(msg):
+            return
         is_write = msg.op in ("write", "writefull", "append", "delete") \
             or any(o.op in ("write", "writefull", "append", "delete")
                    for o in msg.ops)
@@ -540,6 +610,7 @@ class OSD(Dispatcher):
         # latency x bytes accounting resolved at reply time
         op.is_write = is_write
         op.num_bytes = len(msg.data) + sum(len(o.data) for o in msg.ops)
+        op.queued_at = time.perf_counter()
         if g_tracer.enabled:
             # child of the client's root span; activated around do_op so
             # EC encode / kernel spans attach below it
@@ -548,7 +619,18 @@ class OSD(Dispatcher):
                 daemon=self.name, trace_id=msg.trace_id,
                 parent_id=msg.parent_span_id)
         self._tracked[(msg.src, msg.tid)] = op
-        self.op_wq.enqueue(msg.pgid, CLASS_CLIENT, ("op", msg))
+        self.op_wq.enqueue(msg.pgid, CLASS_CLIENT, ("op", msg),
+                           client=msg.src)
+        from ..common.config import g_conf
+        if bool(g_conf.get_val("osd_op_queue_batch_intake")):
+            # burst intake (the traffic harness's mode): leave the op
+            # queued so one fabric pump's worth of concurrent client
+            # traffic accumulates and the mClock tiers arbitrate a REAL
+            # burst; workers (threaded) or the cluster idle kick
+            # (synchronous) drain at quiescence
+            if self.op_tp is not None:
+                self.op_tp.kick()
+            return
         self.drain_ops()
 
     def drain_ops(self, max_ops: int = 0) -> int:
@@ -585,6 +667,16 @@ class OSD(Dispatcher):
             tracked = self._tracked.get((msg.src, msg.tid))
             if tracked is not None:
                 tracked.mark_event("reached_pg")
+                t0 = getattr(tracked, "queued_at", None)
+                if t0 is not None and msg.src:
+                    # per-client queue-wait distribution (intake ->
+                    # dequeue): the dmClock tier's effect made visible
+                    # per entity on perf dump + mgr Prometheus
+                    g_perf_histograms.get(
+                        self._client_hist_lane(msg.src),
+                        "client_queue_wait_latency_histogram",
+                        latency_axes).inc(
+                            (time.perf_counter() - t0) * 1e6)
             if tracked is not None and tracked.span is not None:
                 with g_tracer.activate(tracked.span):
                     pg.do_op(msg)
@@ -596,6 +688,14 @@ class OSD(Dispatcher):
             # deferred EC write-pipeline continuation (fan-out under
             # the PG lock — _wq_handle_locked took it via item[1])
             item[2]()
+
+    def _client_hist_lane(self, src: str) -> str:
+        if src in self._client_hist_lanes:
+            return src
+        if len(self._client_hist_lanes) >= 64:
+            return "client.other"
+        self._client_hist_lanes.add(src)
+        return src
 
     def send_op_reply(self, dst: str, reply: MOSDOpReply) -> None:
         """All client replies funnel here so op tracking/latency see them."""
@@ -638,6 +738,13 @@ class OSD(Dispatcher):
                 t = Transaction()
                 pg.apply_snapset_update(tuple(msg.snapset_update), t)
                 self.store.queue_transaction(t)
+                if msg.tid:
+                    # acked fan-out (docs/ROBUSTNESS.md "unacked
+                    # write-path classes"): a replayed snapset update
+                    # is a full-blob replacement, so re-applying is
+                    # idempotent — ack unconditionally
+                    self.reply_to(msg, MOSDECSubOpWriteReply(
+                        tid=msg.tid, pgid=msg.pgid, shard=msg.shard))
             return
         if msg.at_version < 0:  # delete marker
             self._apply_delete(msg)
@@ -665,6 +772,22 @@ class OSD(Dispatcher):
             cid = f"{msg.pgid[0]}.{msg.pgid[1]}s{msg.shard}"
             ho = hobject_t(msg.oid, msg.shard)
         pg = self.pgs.get(msg.pgid)
+        if msg.tid and pg is not None and msg.version:
+            # resend dedup (tid-carrying client-delete fan-outs only —
+            # recovery delete fans keep tid 0 and may legitimately
+            # arrive with the log entry already merged): our log holds
+            # this delete, so the original apply landed and only the
+            # ack was lost.  Re-applying would overwrite the rollback
+            # stash with post-delete state; just re-ack.  Versions
+            # append monotonically, so scan from the tail and stop at
+            # the first older entry — first arrivals pay O(1).
+            for e in reversed(pg.pg_log.entries):
+                if e.version < msg.version:
+                    break
+                if e.version == msg.version and e.oid == msg.oid:
+                    self.reply_to(msg, MOSDECSubOpWriteReply(
+                        tid=msg.tid, pgid=msg.pgid, shard=msg.shard))
+                    return
         t = Transaction()
         if pg is not None and pg.backend is not None and msg.version:
             # EC shards stash the pre-delete state like writes do, so a
@@ -680,6 +803,9 @@ class OSD(Dispatcher):
             self.store.queue_transaction(t)
         if pg is not None:
             pg.data_received(msg.oid)  # debt settled: object is gone
+        if msg.tid:
+            self.reply_to(msg, MOSDECSubOpWriteReply(
+                tid=msg.tid, pgid=msg.pgid, shard=msg.shard))
 
     def _handle_sub_read(self, msg: MOSDECSubOpRead) -> None:
         self.perf_counters.inc(L_OSD_SUBOP_R)

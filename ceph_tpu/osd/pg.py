@@ -2027,13 +2027,42 @@ class PG:
         proceed()
 
     def _fan_snapset(self, oid: str, blob: bytes) -> None:
-        """Pure snapset-metadata fan-out (no object touched)."""
+        """Pure snapset-metadata fan-out (no object touched).  On EC
+        pools the fan is acked and retried like sub-op writes (an
+        InflightWrite swept by the OSD tick / idle kick); replicated
+        pools keep the rep backend's fire-and-forget shape."""
         from ..msg.messages import MOSDECSubOpWrite
+        if self.backend is not None:
+            self._fan_acked(
+                oid, lambda shard, tid: MOSDECSubOpWrite(
+                    tid=tid, pgid=self.pgid, shard=shard, oid=oid,
+                    snapset_only=True, snapset_update=(oid, blob)))
+            return
         for shard, osd in self.acting_shards().items():
             self.send_to_osd(osd, MOSDECSubOpWrite(
-                tid=0, pgid=self.pgid,
-                shard=shard if self.backend is not None else -1,
+                tid=0, pgid=self.pgid, shard=-1,
                 oid=oid, snapset_only=True, snapset_update=(oid, blob)))
+
+    def _fan_acked(self, oid: str, make_msg) -> int:
+        """Fan ``make_msg(shard, tid)`` to every acting shard through
+        the EC backend's InflightWrite machinery: acked per shard,
+        unacked sends resent by sweep_inflight (tick + idle kick) —
+        the retry contract sub-op writes already have
+        (docs/ROBUSTNESS.md).  Returns the fan's tid."""
+        from .ec_backend import InflightWrite
+        be = self.backend
+        tid = be.next_tid()
+        wr = InflightWrite(tid=tid, oid=oid,
+                           client_reply=lambda _r: None)
+        for shard, osd in self.acting_shards().items():
+            msg = make_msg(shard, tid)
+            wr.pending_shards.add(shard)
+            wr.sent_msgs[shard] = (osd, msg)
+            self.send_to_osd(osd, msg)
+        if wr.pending_shards:
+            wr.last_send = self.osd.now
+            be.inflight_writes[tid] = wr
+        return tid
 
     def _encoded_snapsets(self) -> List[Tuple[str, bytes]]:
         return [(oid, encode_snapset(ents))
@@ -2504,13 +2533,18 @@ class PG:
         return -95, b""                             # EOPNOTSUPP
 
     def _fan_delete(self, oid: str) -> None:
-        """Fan a versioned delete to every acting shard/replica."""
+        """Fan a versioned delete to every acting shard/replica.  EC
+        deletes are acked + retried like sub-op writes (tid assigned,
+        resent from the OSD tick/idle kick, shard replay deduped
+        against the pg log) — the last unacked write-path class
+        (docs/ROBUSTNESS.md); replicated deletes stay fire-and-forget
+        like every other rep-backend fan."""
         from ..msg.messages import MOSDECSubOpWrite
         version = self.next_version()
         if self.backend is not None:
-            for shard, osd in self.acting_shards().items():
-                self.send_to_osd(osd, MOSDECSubOpWrite(
-                    tid=0, pgid=self.pgid, shard=shard, oid=oid,
+            self._fan_acked(
+                oid, lambda shard, tid: MOSDECSubOpWrite(
+                    tid=tid, pgid=self.pgid, shard=shard, oid=oid,
                     chunk=b"", at_version=-1, version=version))
         else:
             for osd in self.acting:

@@ -301,7 +301,8 @@ def test_smoke_mode_end_to_end():
     assert {"ec_encode_k8m4_fenced", "ec_decode_k8m4_e2_fenced",
             "ec_dispatch_coalesce_fenced",
             "ec_dispatch_serial_fenced",
-            "ec_pipeline_fenced", "ec_pipeline_depth1_fenced"} <= names
+            "ec_pipeline_fenced", "ec_pipeline_depth1_fenced",
+            "traffic_harness_smoke"} <= names
     # the coalesce metric carries its serial twin and speedup
     mc = next(m for m in out["metrics"]
               if m["name"] == "ec_dispatch_coalesce_fenced")
@@ -316,6 +317,19 @@ def test_smoke_mode_end_to_end():
     assert mp["mean_batch_occupancy"] >= 4, mp
     assert mp["identical"] is True
     assert mp["depth1_gibs"] > 0 and mp["speedup"] > 0
+    # traffic-harness acceptance (docs/QOS.md): >= 8 concurrent
+    # synthetic clients, every op byte-exact, per-client p99 non-empty
+    # in the bench JSON
+    mt = next(m for m in out["metrics"]
+              if m["name"] == "traffic_harness_smoke")
+    assert mt["n_clients"] >= 8
+    assert mt["byte_exact"] is True and not mt["errors"]
+    assert mt["completed"] == mt["total_ops"] \
+        == mt["n_clients"] * 32
+    assert len(mt["per_client"]) == mt["n_clients"]
+    for cname, st in mt["per_client"].items():
+        assert st["p99"] > 0.0, (cname, st)
+    assert mt["aggregate"]["p99"] > 0.0
     # the gate ran (warn mode) and the observability counters moved
     assert "gate" in out
     assert out["perf"]["dispatches"] > 0
@@ -348,6 +362,27 @@ def test_workload_metrics_in_process():
         g_kernel_timer.enable(False)
         g_kernel_timer.reset()
     assert workloads.parity_check(matrix) is True
+
+
+def test_traffic_workload_in_process():
+    """measure_traffic produces a schema-valid metric off a tiny run
+    (harness shape test — throughput itself is measured by --smoke)
+    and restores the admission config it set."""
+    from ceph_tpu.bench import workloads
+    from ceph_tpu.common.config import g_conf
+
+    before = g_conf.values.get("osd_op_queue_admission_max")
+    m = workloads.measure_traffic(n_clients=4, ops_per_client=8,
+                                  n_osds=3, pg_num=4,
+                                  admission_max=64, seed=3,
+                                  name="traffic_tiny")
+    schema.validate_metric(m)
+    assert m["fenced"] is True and m["value"] > 0
+    assert m["byte_exact"] is True
+    assert m["completed"] == m["total_ops"] == 4 * 8
+    assert len(m["per_client"]) == 4
+    assert g_conf.values.get("osd_op_queue_admission_max") == before, \
+        "workload leaked admission config"
 
 
 def test_dispatch_coalesce_workload_in_process():

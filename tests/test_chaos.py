@@ -68,9 +68,28 @@ def _arm_chaos(seed: int, drop_match: str = "MOSDOp ",
     g_faults.inject("osd.shard_read_eio", mode="nth", n=4)
 
 
-def _workload(c, cl, expected, rng, gens, kill_cycle=(1,)):
-    """Mixed write/overwrite/partial-write/read/recovery generations;
-    records every object's expected logical bytes in *expected*."""
+def _read_healing(c, cl, oid, tries=8):
+    """Degraded read across a re-peering window: peering-query resends
+    are TICK-driven (PG.retry_peering), so a read that lands while a
+    dropped query is still outstanding sees EAGAIN — tick and retry
+    like a live client would, bounded so a real wedge still fails."""
+    for _ in range(tries):
+        try:
+            return cl.read("chaos", oid)
+        except IOError as e:
+            if e.errno != 11:           # only EAGAIN is the heal case
+                raise
+            c.tick(dt=5.0)
+    return cl.read("chaos", oid)
+
+
+def _workload(c, cl, expected, rng, gens, kill_cycle=(1,),
+              deleted=None):
+    """Mixed write/overwrite/partial-write/delete/read/recovery
+    generations; records every object's expected logical bytes in
+    *expected* and removed oids in *deleted* (when given — deletes are
+    exercised under whatever drop scope is armed; the EC delete fan is
+    acked + resent like sub-op writes, docs/ROBUSTNESS.md)."""
     for gen in range(gens):
         # fresh full-object writes
         for i in range(3):
@@ -92,6 +111,17 @@ def _workload(c, cl, expected, rng, gens, kill_cycle=(1,)):
         old = bytearray(expected[oid])
         old[off:off + len(patch)] = patch
         expected[oid] = bytes(old)
+        # delete an older object with the chaos still armed: the
+        # versioned delete fan must converge (ack + retry) and reads
+        # must see a clean ENOENT, not a half-deleted object
+        if deleted is not None and gen > 0:
+            doid = f"g{gen - 1}o2"
+            if doid in expected:
+                assert cl.remove("chaos", doid) == 0, doid
+                expected.pop(doid)
+                deleted.add(doid)
+                with pytest.raises(IOError):
+                    cl.read("chaos", doid)
         # reads while injection is live (EIO recovery + decode path)
         for oid, body in list(expected.items())[-4:]:
             assert cl.read("chaos", oid) == body, oid
@@ -102,7 +132,8 @@ def _workload(c, cl, expected, rng, gens, kill_cycle=(1,)):
             for _ in range(6):
                 c.tick(dt=5.0)
             for oid, body in list(expected.items())[:2]:
-                assert cl.read("chaos", oid) == body, f"degraded {oid}"
+                assert _read_healing(c, cl, oid) == body, \
+                    f"degraded {oid}"
             c.revive_osd(victim)
             for _ in range(3):
                 c.tick(dt=2.0)
@@ -133,19 +164,71 @@ def test_chaos_smoke(clean_faults):
         "dropped sub-write was not resent"
     expected["receipt"] = b"r" * 4000
     g_faults.clear("msg.drop")
+    # drop→resend receipt for the DELETE fan too (the last unacked
+    # write-path class): lose exactly one sub-delete; the inflight
+    # sweep must resend it and the object must be gone everywhere
+    resend0 = ppc.get(l_pipeline_subwrite_resends)
+    g_faults.inject("msg.drop", mode="once", match="MOSDECSubOpWrite ")
+    assert cl.remove("chaos", "receipt") == 0
+    assert ppc.get(l_pipeline_subwrite_resends) > resend0, \
+        "dropped sub-delete was not resent"
+    with pytest.raises(IOError):
+        cl.read("chaos", "receipt")
+    expected.pop("receipt")
+    g_faults.clear("msg.drop")
     _arm_chaos(seed=1234, drop_match="", drop_p=0.04)  # ALL traffic
     rng = np.random.default_rng(99)
-    _workload(c, cl, expected, rng, gens=2, kill_cycle=(1,))
+    deleted = set()
+    _workload(c, cl, expected, rng, gens=2, kill_cycle=(1,),
+              deleted=deleted)
     g_faults.clear()
     # final sweep with injection disarmed: contents are byte-identical
-    # to what an uninjected run would hold (the payloads themselves)
+    # to what an uninjected run would hold (the payloads themselves),
+    # and deleted objects stay deleted on every shard
     for oid, body in expected.items():
         assert cl.read("chaos", oid) == body, oid
+    assert deleted, "workload exercised no deletes"
+    for oid in deleted:
+        with pytest.raises(IOError):
+            cl.read("chaos", oid)
     # the chaos was real: every armed class actually fired
     assert pc.get(l_fault_injected) > before["inj"]
     assert pc.get(l_fault_msg_drops) > before["drop"]
     assert pc.get(l_fault_eio_reconstructs) > before["rec"]
     assert c.health().startswith("HEALTH")
+
+
+def test_chaos_saturation_abusive_client(clean_faults):
+    """QoS saturation scenario (docs/QOS.md): ONE abusive client at
+    10x the arrival rate of 7 well-behaved clients against a small
+    admission cap.  All well-behaved ops complete byte-exact with
+    bounded completion latency (deterministic round metric — no wall
+    time in any decision path), while the abusive client is throttled
+    and the admission counter fires."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.load import TrafficSpec, run_traffic
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("load", size=3, pg_num=8)
+    g_conf.set_val("osd_op_queue_admission_max", 16)
+    try:
+        res = run_traffic(c, TrafficSpec(
+            n_clients=8, ops_per_client=40, read_fraction=0.4,
+            mode="open", rate=3.0, rate_multipliers=(10.0,),
+            seed=424242))
+    finally:
+        g_conf.rm_val("osd_op_queue_admission_max")
+    assert res.byte_exact, res.errors[:5]
+    assert res.admission_rejections > 0, "admission never fired"
+    assert res.max_intake_depth <= 16
+    abusive = res.per_client["client.load.0"]
+    assert abusive["throttled"] > 0, abusive
+    for name, st in sorted(res.per_client.items()):
+        if name == "client.load.0":
+            continue
+        assert st["completed"] == 40, (name, st)
+        # bounded p99: a well-behaved client's worst op finishes
+        # within a handful of rounds of its issue, saturation or not
+        assert st["round_latency_max"] <= 6, (name, st)
 
 
 @pytest.mark.slow

@@ -183,3 +183,37 @@ def test_thrash_partial_fanouts_never_torn():
         # re-establish a known committed baseline for the next round
         assert cl.write_full("dp", "t", payloads[i]) == 0
         legal = {payloads[i]}
+
+
+def test_delete_replay_does_not_clobber_rollback_stash():
+    """A resent delete whose log entry was dropped as stale (the shard's
+    head had already advanced past it, so the log-based replay dedup
+    can never see it) must not re-stash: the second apply would capture
+    POST-delete state and peering's rollback would then restore
+    'absent' instead of the pre-delete body."""
+    from ceph_tpu.msg.messages import MOSDECSubOpWrite
+    from ceph_tpu.osd.pg_log import load_rollback
+
+    c, cl = make_cluster()
+    assert cl.write_full("dp", "a", OLD) == 0
+    assert cl.write_full("dp", "b", NEW) == 0
+    pgid, primary, _pg = pg_of(c, cl, "a")
+    # pick a non-primary shard holder and replay a delete there whose
+    # version sits at the shard's head (so append_log drops the entry)
+    osd = next(o for o in c.osds.values()
+               if o.osd_id != primary and pgid in o.pgs
+               and o.pgs[pgid].my_shard() >= 0)
+    pg = osd.pgs[pgid]
+    shard = pg.my_shard()
+    head = pg.pg_log.head
+    msg = MOSDECSubOpWrite(tid=991, pgid=pgid, shard=shard, oid="a",
+                           chunk=b"", at_version=-1, version=head)
+    msg.src = f"osd.{primary}"
+    osd._apply_delete(msg)
+    stash = load_rollback(osd.store, pg.meta_cid(), "a")
+    assert stash is not None and stash[0] == head and stash[1], \
+        "first apply must stash the pre-delete (existing) state"
+    osd._apply_delete(msg)  # replay: ack was lost, fan resends
+    stash = load_rollback(osd.store, pg.meta_cid(), "a")
+    assert stash is not None and stash[0] == head and stash[1], \
+        "replay clobbered the rollback stash with post-delete state"

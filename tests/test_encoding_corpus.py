@@ -41,3 +41,31 @@ def test_archived_blob_still_decodes(path):
     blob = open(path, "rb").read()
     obj = h.decode(blob)                 # the decode guarantee
     assert h.encode(obj) == blob         # and stable re-encode
+
+
+def test_qos_throttle_hint_omitted_when_default():
+    """Wire-format guard for the QoS throttle field (docs/QOS.md): an
+    UNTHROTTLED MOSDOpReply (retry_after=0.0, the dataclass default)
+    must encode byte-identical to the pre-QoS format — the archived
+    corpus above stays pinned precisely because the field is dropped
+    from the wire when default.  A throttled reply round-trips the
+    hint; the archived blobs decode with the default filled in."""
+    from ceph_tpu.msg import messages as M
+    from ceph_tpu.msg import wire
+
+    plain = wire.encode_message(M.MOSDOpReply(tid=9, result=0, epoch=4))
+    assert b"retry_after" not in plain, \
+        "default retry_after leaked onto the wire"
+    explicit_default = wire.encode_message(
+        M.MOSDOpReply(tid=9, result=0, epoch=4, retry_after=0.0))
+    assert explicit_default == plain
+    throttled = wire.encode_message(
+        M.MOSDOpReply(tid=9, result=-11, epoch=4, retry_after=0.25))
+    assert wire.decode_message(throttled).retry_after == 0.25
+    # the archived MOSDOpReply blobs predate the field: decode fills
+    # the default, and (per the parametrized test above) re-encode is
+    # byte-identical
+    for path in BLOBS:
+        if os.path.basename(path).startswith("MOSDOpReply."):
+            obj = REG[_type_for(path)].decode(open(path, "rb").read())
+            assert getattr(obj, "retry_after", 0.0) == 0.0

@@ -185,6 +185,31 @@ def test_pipeline_family_and_counters(exposition):
                for n, _l, _v in samples), "pipeline_inflight gauge missing"
 
 
+def test_qos_families_and_counters(exposition):
+    """QoS-PR golden coverage: the per-client queue-wait histogram
+    renders as a real histogram family keyed by the CLIENT entity in
+    the daemon label (cumulative/monotone buckets enforced by the
+    generic test above), and the qos perf counters (per-class
+    dequeues, admission/throttle accounting, queue-depth gauge) render
+    as daemon series with the fixture's ops accounted."""
+    types, samples = _parse(exposition)
+    fam = "ceph_client_queue_wait_latency_histogram"
+    assert types.get(fam) == "histogram", \
+        "per-client queue-wait histogram family missing"
+    counts = [v for n, labels, v in samples
+              if n == f"{fam}_count" and 'daemon="client_prom"' in labels]
+    # the fixture issued 4 ops as client.prom: each intake->dequeue
+    # wait lands in THAT entity's histogram
+    assert counts and counts[0] >= 4, counts
+    deq = [v for n, _l, v in samples
+           if n == "ceph_daemon_qos_dequeues_client"]
+    assert deq and deq[0] >= 4, "qos dequeue accounting missing"
+    for name in ("ceph_daemon_qos_admission_rejections",
+                 "ceph_daemon_qos_throttle_events",
+                 "ceph_daemon_qos_queue_depth"):
+        assert any(n == name for n, _l, _v in samples), f"{name} missing"
+
+
 def test_op_histograms_carry_the_writes(exposition):
     """The two writes + one read issued by the fixture are visible in
     some OSD's latency histograms (non-zero _count)."""

@@ -93,6 +93,7 @@ def _wait_new_leader(c, cl, dead_rank, timeout=150.0):
     raise AssertionError(f"no post-kill leader/quorum formed: {last!r}")
 
 
+@pytest.mark.loadflaky
 def test_three_mons_leader_sigkill_recovers(cluster):
     c = cluster
     # the client is BOUND TO A PEON (mon.1): its commands cross the

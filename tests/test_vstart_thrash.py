@@ -61,6 +61,7 @@ def mon_cluster():
     c.close()
 
 
+@pytest.mark.loadflaky
 def test_mon_thrash_kill_revive_rotation(mon_cluster):
     """Three rounds: SIGKILL a different mon each time (leader
     included), writes continuing, then REVIVE it and event-wait for
