@@ -149,17 +149,24 @@ def main(argv=None) -> int:
                        else regress.DEFAULT_TOLERANCE))
         result["gate"] = gate
         for r in gate["regressions"]:
+            pct = f"{r['change']:+.0%}" if r.get("change") is not None \
+                else "new-copies"
             progress(f"REGRESSION {r['name']}: {r['value']} vs "
                      f"r{r['baseline_round']} baseline {r['baseline']} "
-                     f"({r['change']:+.0%})")
+                     f"({pct})")
         if gate["regressions"] and args.gate == "fail":
             rc = max(rc, 2)
 
     result["perf"] = bench_perf_counters().dump()
     # histogram metric lines: the same perf-histogram surface the admin
     # socket's `perf histogram dump` serves, scoped to this bench run
-    from ..trace import g_perf_histograms
+    from ..trace import g_devprof, g_perf_histograms
     result["perf_histograms"] = g_perf_histograms.dump("bench")
+    # the run's device-flow ledger (same shape as `prof dump`): which
+    # call-sites moved how many bytes across the host<->device boundary
+    prof = g_devprof.dump()
+    result["devprof"] = {"sites": prof["sites"],
+                         "totals": prof["totals"]}
     result["elapsed_s"] = round(time.monotonic() - t0, 1)
     sys.stdout.write(json.dumps(result) + "\n")
     sys.stdout.flush()

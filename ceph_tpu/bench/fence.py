@@ -76,6 +76,10 @@ def drain(out: Any) -> None:
     arr = np.asarray(one)
     if arr.size:
         arr.ravel()[:1].copy()
+    # the fence IS a device->host readback: account it like any other
+    # transfer so the devflow ledger never hides the drain's own copy
+    from ..trace.devprof import g_devprof
+    g_devprof.account_d2h("bench.drain", arr.nbytes)
 
 
 def measure_rtt(make_tiny: Optional[Callable[[], Any]] = None,

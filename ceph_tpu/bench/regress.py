@@ -30,6 +30,25 @@ DEFAULT_TOLERANCE = 0.30   # shared-tunnel runs wobble ~25% run-to-run
 # units where a larger value is better; any other unit is lower-better
 _HIGHER_BETTER_UNITS = {"GiB/s", "MiB/s", "ops/s"}
 
+# the copy-budget gate (devprof PR): every fenced workload's devflow
+# block carries these per-op flow figures; both are lower-better and
+# gated alongside the workload's primary value, so a zero-copy refactor
+# must move a number CI watches — and a copy regression fails the gate
+# like a latency regression.  Unlike wall times these are deterministic
+# counts, so the gate uses a tighter tolerance than the timing wobble.
+#
+# Floors: a device-resident workload's only accounted flow is the
+# fence drain — copies_per_op ~ 1/n_steps where n_steps is calibrated
+# from a timed probe, so the figure jitters with the same run-to-run
+# wobble the timing tolerance exists for.  Values below the floors are
+# sub-op-level noise, not a per-op copy chain: both sides under floor
+# gates nothing, and "zero-copy baseline" means "under floor", so a
+# regression fires only when a real per-op copy appears.
+_DEVFLOW_GATED = (("copies_per_op", "copies/op"),
+                  ("bytes_per_op", "B/op"))
+DEVFLOW_TOLERANCE = 0.10
+DEVFLOW_FLOORS = {"copies_per_op": 0.25, "bytes_per_op": 512.0}
+
 
 def load_trajectory(root: str) -> List[Dict[str, Any]]:
     """All parseable BENCH_r*.json records under *root*, oldest first.
@@ -91,7 +110,8 @@ def compare_against_trajectory(
     regressions: List[Dict[str, Any]] = []
     improvements: List[Dict[str, Any]] = []
     no_baseline: List[str] = []
-    compared = 0
+    compared = 0           # metrics with a value baseline
+    devflow_compared = 0   # devflow keys with a gated baseline
     for cur in current:
         if not cur.get("fenced") or cur.get("suspect"):
             continue
@@ -125,6 +145,41 @@ def compare_against_trajectory(
         elif (change > tolerance) if higher_better \
                 else (change < -tolerance):
             improvements.append(entry)
+        # ---- copy-budget gate: the workload's devflow block ------------
+        flow_cur = cur.get("devflow")
+        flow_prev = baseline.get("devflow")
+        if not isinstance(flow_cur, dict) or \
+                not isinstance(flow_prev, dict):
+            continue
+        for key, unit in _DEVFLOW_GATED:
+            cv = float(flow_cur.get(key, 0.0) or 0.0)
+            bv = float(flow_prev.get(key, 0.0) or 0.0)
+            floor = DEVFLOW_FLOORS[key]
+            if bv < floor:
+                # an (effectively) zero-copy baseline is sacred: a
+                # real per-op copy chain appearing is a regression;
+                # sub-floor drift (drain-fence noise) gates nothing
+                if cv >= floor:
+                    devflow_compared += 1
+                    regressions.append({
+                        "name": f"{name}.{key}", "unit": unit,
+                        "value": cv, "baseline": bv,
+                        "baseline_round": baseline_round,
+                        "change": None})
+                continue
+            devflow_compared += 1
+            fchange = (cv - bv) / bv
+            fentry = {"name": f"{name}.{key}", "unit": unit,
+                      "value": cv, "baseline": bv,
+                      "baseline_round": baseline_round,
+                      "change": round(fchange, 4)}
+            if cv < floor:
+                improvements.append(fentry)      # dropped under floor
+            elif fchange > DEVFLOW_TOLERANCE:
+                regressions.append(fentry)
+            elif fchange < -DEVFLOW_TOLERANCE:
+                improvements.append(fentry)
     return {"regressions": regressions, "improvements": improvements,
-            "compared": compared, "no_baseline": no_baseline,
+            "compared": compared, "devflow_compared": devflow_compared,
+            "no_baseline": no_baseline,
             "tolerance": tolerance, "platform": platform}

@@ -27,6 +27,7 @@ from .roofline import EC_DECODE_K8M4, EC_ENCODE_K8M4, validate_reading
 from .schema import make_metric
 from .stats import repeat_measure
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder
+from ..trace.devprof import devflow_delta, g_devprof
 
 K, M = 8, 4
 
@@ -135,6 +136,16 @@ def _fenced_throughput(step: Callable[[int], Any], n_steps: int,
     return timing.throughput(bytes_per_step), timing.to_dict()
 
 
+def _devflow_since(before: Dict[str, int], n_ops: int) -> Dict[str, Any]:
+    """The ``devflow`` block every fenced workload carries: device-flow
+    deltas over the measured region, normalized per op.
+    ``copies_per_op`` / ``bytes_per_op`` are GATED metrics
+    (regress.py's copy-budget gate), so a zero-copy refactor must move
+    a number CI watches — and a copy regression fails the gate like a
+    latency regression."""
+    return devflow_delta(before, g_devprof.snapshot(), n_ops)
+
+
 def _device_info() -> Tuple[str, str, int]:
     try:
         import jax
@@ -169,17 +180,19 @@ def _measure_fenced_gf(bits, batch: np.ndarray, *, metric_name: str,
         * int(batch.shape[2])
     n_steps = _calibrate_steps(step, target_seconds / max(repeats, 1),
                                rtt_s)
+    flow0 = g_devprof.snapshot()
     st = repeat_measure(
         lambda: _fenced_throughput(step, n_steps, bytes_per_step, rtt_s,
                                    kernel_name)[0],
         repeats=repeats, warmup=warmup)
+    devflow = _devflow_since(flow0, n_steps * (repeats + warmup))
     platform, kind, ndev = _device_info()
     rl = validate_reading(st["median"], workload, platform, kind, ndev)
     return make_metric(
         metric_name, st["median"], "GiB/s", fenced=True,
         rtt_s=rtt_s, stats=st, roofline=rl,
         extra={"n_steps": n_steps, "bytes_per_step": bytes_per_step,
-               "platform": platform})
+               "platform": platform, "devflow": devflow})
 
 
 def measure_encode(matrix: np.ndarray, batch: np.ndarray, *,
@@ -240,13 +253,19 @@ def measure_host_native(matrix: np.ndarray, data2d: np.ndarray,
             native_rs_encode(rows, data2d)
             n += 1
         dt = time.perf_counter() - t0
+        one_sample.n_ops += n
         return n * object_size / dt / (1 << 30)
 
+    one_sample.n_ops = 0
+    flow0 = g_devprof.snapshot()
     st = repeat_measure(one_sample, repeats=3, warmup=0)
+    # the native path never crosses the device boundary — its devflow
+    # block is the zero-copy baseline the device paths are judged by
+    devflow = _devflow_since(flow0, max(one_sample.n_ops, 1))
     rl = validate_reading(st["median"], EC_ENCODE_K8M4, "cpu", "", 1)
     return make_metric("ec_encode_host_native", st["median"], "GiB/s",
                        fenced=True, rtt_s=0.0, stats=st, roofline=rl,
-                       extra={"platform": "cpu"})
+                       extra={"platform": "cpu", "devflow": devflow})
 
 
 def measure_dispatch_coalesce(*, n_requests: int = 8,
@@ -319,6 +338,7 @@ def measure_dispatch_coalesce(*, n_requests: int = 8,
 
     try:
         results = {}
+        flows = {}
         for mode in ("serial", "coalesced"):
             coalesced = mode == "coalesced"
             # warm compiles, then calibrate rounds per sample so the
@@ -330,9 +350,12 @@ def measure_dispatch_coalesce(*, n_requests: int = 8,
             rounds = max(1, min(
                 int(max(target_seconds / max(repeats, 1),
                         4.0 * rtt_s) / per_pass), 256))
+            flow0 = g_devprof.snapshot()
             results[mode] = repeat_measure(
                 make_sampler(coalesced, rounds),
                 repeats=repeats, warmup=warmup)
+            flows[mode] = _devflow_since(
+                flow0, rounds * n_requests * (repeats + warmup))
     finally:
         for name, v in saved.items():
             g_conf.rm_val(name) if v is None else g_conf.set_val(name, v)
@@ -345,7 +368,7 @@ def measure_dispatch_coalesce(*, n_requests: int = 8,
         rl = validate_reading(st["median"], EC_ENCODE_K8M4, platform,
                               kind, ndev)
         extra = {"n_requests": n_requests, "object_bytes": object_bytes,
-                 "platform": platform}
+                 "platform": platform, "devflow": flows[mode]}
         if mode == "coalesced":
             extra["serial_gibs"] = round(results["serial"]["median"], 4)
             extra["speedup"] = round(
@@ -468,6 +491,7 @@ def measure_ec_pipeline(*, n_requests: int = 64,
                     == np.asarray(b[i]).tobytes() for i in a)
             for a, b in zip(piped, serial))
         results = {}
+        flows = {}
         occupancy = None
         for d in (1, depth):
             make_sampler(d, 1)()        # warm compiles
@@ -479,8 +503,11 @@ def measure_ec_pipeline(*, n_requests: int = 64,
                         4.0 * rtt_s) / per_pass), 256))
             if d == depth:
                 occ0 = (occ_hist.axis0_sum, occ_hist.total_count)
+            flow0 = g_devprof.snapshot()
             results[d] = repeat_measure(make_sampler(d, rounds),
                                         repeats=repeats, warmup=warmup)
+            flows[d] = _devflow_since(
+                flow0, rounds * n_requests * (repeats + warmup))
             if d == depth:
                 ds = occ_hist.axis0_sum - occ0[0]
                 dn = occ_hist.total_count - occ0[1]
@@ -497,7 +524,8 @@ def measure_ec_pipeline(*, n_requests: int = 64,
         rl = validate_reading(st["median"], EC_ENCODE_K8M4, platform,
                               kind, ndev)
         extra = {"n_requests": n_requests, "object_bytes": object_bytes,
-                 "pipeline_depth": d, "platform": platform}
+                 "pipeline_depth": d, "platform": platform,
+                 "devflow": flows[d]}
         if d == depth:
             extra["depth1_gibs"] = round(results[1]["median"], 4)
             extra["speedup"] = round(
@@ -539,6 +567,7 @@ def measure_traffic(*, n_clients: int = 8, ops_per_client: int = 32,
     saved = g_conf.values.get("osd_op_queue_admission_max")
     if admission_max:
         g_conf.set_val("osd_op_queue_admission_max", admission_max)
+    flow0 = g_devprof.snapshot()
     try:
         res = run_traffic(cluster, TrafficSpec(
             pool="load", n_clients=n_clients,
@@ -560,6 +589,7 @@ def measure_traffic(*, n_clients: int = 8, ops_per_client: int = 32,
         stats={"n": 1, "median": v, "iqr": 0.0, "min": v, "max": v},
         roofline={"verdict": "unknown", "suspect": False},
         extra={"n_clients": n_clients, "total_ops": res.total_ops,
+               "devflow": _devflow_since(flow0, max(res.completed, 1)),
                "completed": res.completed,
                "byte_exact": bool(res.byte_exact),
                "rounds": res.rounds,
@@ -695,6 +725,7 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
     # per-epoch wall time: one osd out per epoch.  map_batch's delta
     # path fetches only changed rows, so the wall is one resolve + one
     # small device->host transfer (OSDMapMapping's per-epoch job).
+    flow_wall0 = g_devprof.snapshot()
     walls = []
     for e in range(epochs):
         w2 = w.copy()
@@ -705,6 +736,7 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
     from .stats import summarize
     wall_st = summarize(walls)
     wall_ms = wall_st["median"]
+    devflow_wall = _devflow_since(flow_wall0, epochs)
     report(**{"crush_remap@_pgs": n_pgs,
               "crush_remap@_wall_ms": round(wall_ms, 2),
               "crush@_residual_fraction": fr.residual_fraction})
@@ -726,10 +758,12 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
     np.asarray(fr.resolve_device(wds[0])[0][0, 0])   # warm + drain
     mark("resolve_device warm")
     pc = bench_perf_counters()
+    flow_dev0 = g_devprof.snapshot()
     t0 = time.perf_counter()
     outs = [fr.resolve_device(wd) for wd in wds]
     np.asarray(outs[-1][0][0, 0])
     total = (time.perf_counter() - t0) * 1000
+    devflow_dev = _devflow_since(flow_dev0, epochs)
     pc.inc(l_bench_dispatches, len(wds))
     pc.inc(l_bench_fences)
     pc.tinc(l_bench_fence_time, total / 1000.0)
@@ -757,11 +791,13 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
             stats={"n": len(wds), "median": dev_ms, "iqr": 0.0,
                    "min": dev_ms, "max": dev_ms_raw},
             extra={"pgs": n_pgs, "n_osds": n_osds,
-                   "raw_ms": round(dev_ms_raw, 4)}))
+                   "raw_ms": round(dev_ms_raw, 4),
+                   "devflow": devflow_dev}))
         metrics.append(make_metric(
             f"crush_remap{name_sfx}_wall", wall_ms, "ms", fenced=True,
             rtt_s=rtt_s, stats=wall_st,
-            extra={"pgs": n_pgs, "n_osds": n_osds}))
+            extra={"pgs": n_pgs, "n_osds": n_osds,
+                   "devflow": devflow_wall}))
     except Exception as e:
         # schema refused the reading (e.g. exact 0.0) — the flat keys
         # above still carry the raw evidence; note the refusal

@@ -218,10 +218,16 @@ class MiniCluster:
             lambda c, a: {"changes": self.mgr.balancer_optimize()},
             "run one upmap balancer pass")
         from .common import g_kernel_timer
-        from .trace import g_flight_recorder, g_perf_histograms, g_tracer
+        from .trace import (devprof_perf_counters, g_devprof,
+                            g_flight_recorder, g_perf_histograms,
+                            g_tracer)
         def _prometheus(c, a):
             from .fault import g_breakers as _breakers
             self.mgr.check_degraded_codecs()   # fresh breaker -> check
+            # refresh the devprof device-memory high-water gauge so
+            # the scrape carries a current sample (scrape-time only —
+            # never on the op path)
+            g_devprof.sample_device_mem()
             return self.mgr.prometheus_metrics(
                 self.perf_collection,
                 histograms=g_perf_histograms,
@@ -298,6 +304,18 @@ class MiniCluster:
             "dispatch flush",
             lambda c, a: {"flushed": g_dispatcher.flush()},
             "flush every pending EC dispatch queue now")
+        self.perf_collection.add(devprof_perf_counters())
+        asok.register(
+            "prof dump",
+            lambda c, a: g_devprof.dump(),
+            "device-flow profiler: per-call-site host<->device "
+            "transfers, compiles, host staging copies, device-memory "
+            "high-water")
+        asok.register(
+            "prof reset",
+            lambda c, a: (g_devprof.reset(), {"reset": True})[1],
+            "zero the device-flow profiler's sites, counters and "
+            "transfer-size histogram")
         from .fault import fault_perf_counters, g_breakers, g_faults
         self.perf_collection.add(fault_perf_counters())
 

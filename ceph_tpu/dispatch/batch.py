@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..trace.devprof import g_devprof
 from .signature import (KIND_DECODE, KIND_DECODE_CONCAT, KIND_ENCODE,
                         next_pow2)
 
@@ -84,12 +85,16 @@ def run_one(req: Request):
 
 
 def _pad_cols(a: np.ndarray, cb: int) -> np.ndarray:
-    """Zero-pad the last (byte-column) axis to the bucket width."""
+    """Zero-pad the last (byte-column) axis to the bucket width.  A
+    real pad is a whole-buffer host copy — accounted on the device-flow
+    profiler so the copy ledger shows what bucket padding costs."""
     c = a.shape[-1]
     if c == cb:
         return a
     width = [(0, 0)] * (a.ndim - 1) + [(0, cb - c)]
-    return np.pad(a, width)
+    out = np.pad(a, width)
+    g_devprof.account_host_copy("dispatch.pad_cols", out.nbytes)
+    return out
 
 
 def _pad_stripes(big: np.ndarray, use_device: bool) -> np.ndarray:
@@ -103,7 +108,9 @@ def _pad_stripes(big: np.ndarray, use_device: bool) -> np.ndarray:
     if st == s:
         return big
     width = [(0, st - s)] + [(0, 0)] * (big.ndim - 1)
-    return np.pad(big, width)
+    out = np.pad(big, width)
+    g_devprof.account_host_copy("dispatch.pad_stripes", out.nbytes)
+    return out
 
 
 def run_group(reqs: List[Request], bucket_c: int) -> List:
@@ -134,8 +141,9 @@ def _run_group_encode(reqs, bucket_c, leader, use_device):
         stacks.append(_pad_cols(stripes, bucket_c))
         offsets.append((s0, stripes))
         s0 += r.n_stripes
-    big = _pad_stripes(np.ascontiguousarray(np.concatenate(stacks)),
-                       use_device)
+    stacked = np.ascontiguousarray(np.concatenate(stacks))
+    g_devprof.account_host_copy("dispatch.stack", stacked.nbytes)
+    big = _pad_stripes(stacked, use_device)
     coding = leader.encode_batch(big)          # (S_total[, pad], m, Cb)
     coding = np.asarray(coding)
     out: List[Dict[int, np.ndarray]] = []
@@ -161,8 +169,9 @@ def _run_group_decode(reqs, bucket_c, leader, use_device, kind):
         parts = [_pad_cols(np.asarray(r.chunks[cid], dtype=np.uint8)
                            .reshape(r.n_stripes, r.chunk_size), bucket_c)
                  for r in reqs]
-        stacked[cid] = _pad_stripes(
-            np.ascontiguousarray(np.concatenate(parts)), use_device)
+        joined = np.ascontiguousarray(np.concatenate(parts))
+        g_devprof.account_host_copy("dispatch.stack", joined.nbytes)
+        stacked[cid] = _pad_stripes(joined, use_device)
     if kind == KIND_DECODE_CONCAT:
         want_phys = [leader.chunk_index(i) for i in range(k)]
     else:

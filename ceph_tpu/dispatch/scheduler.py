@@ -40,7 +40,7 @@ import numpy as np
 from ..common.config import g_conf
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder
 from ..fault import g_faults
-from ..trace import g_perf_histograms, g_tracer, occupancy_axes
+from ..trace import g_devprof, g_perf_histograms, g_tracer, occupancy_axes
 from .batch import Request, run_group, run_one
 from .future import DispatchFuture
 from .signature import (KIND_DECODE, KIND_DECODE_CONCAT, KIND_ENCODE,
@@ -336,7 +336,7 @@ class DeviceDispatcher:
                     ch.tags["bytes"] = r.nbytes
                 children.append(ch)
         outcomes: List = []
-        with g_tracer.activate(span):
+        with g_tracer.activate(span), g_devprof.stage("dispatch.batch"):
             try:
                 if g_faults.site_armed("dispatch.batch"):
                     g_faults.check("dispatch.batch",

@@ -65,6 +65,7 @@ from ..arch import enable_x64
 from ..crush.ln import crush_ln_np
 from ..crush.mapper import crush_do_rule
 from ..crush.types import CrushMap
+from ..trace.devprof import g_devprof
 from .crush_kernels import CompiledCrushMap, compile_map, hash32_2, hash32_3
 
 NONE = CRUSH_ITEM_NONE
@@ -985,9 +986,12 @@ class FastRule:
         xs = np.asarray(xs, dtype=np.uint32)
         key = hashlib.sha1(xs.tobytes()).digest()
         if self._cand_key != key:
-            xd = jnp.asarray(xs)
-            self._cand = jax.block_until_ready(
-                self._run_candidates(xd))
+            g_devprof.install_compile_listener()
+            g_devprof.account_h2d("crush.candidates", xs.nbytes)
+            with g_devprof.stage("crush.candidates"):
+                xd = jnp.asarray(xs)
+                self._cand = jax.block_until_ready(
+                    self._run_candidates(xd))
             self._cand_x = xd
             self._cand_key = key
             self._prev_packed = None
@@ -1031,9 +1035,14 @@ class FastRule:
         if self._cand is None:
             raise RuntimeError("no candidate tables; call "
                                "prepare_candidates(xs) first")
-        wd = weight if isinstance(weight, jnp.ndarray) \
-            else jnp.asarray(np.asarray(weight, dtype=np.uint32))
-        return self._resolve_jit(*self._cand, self._cand_x, wd)
+        if isinstance(weight, jnp.ndarray):
+            wd = weight
+        else:
+            w32 = np.asarray(weight, dtype=np.uint32)
+            g_devprof.account_h2d("crush.resolve", w32.nbytes)
+            wd = jnp.asarray(w32)
+        with g_devprof.stage("crush.resolve"):
+            return self._resolve_jit(*self._cand, self._cand_x, wd)
 
     def map_batch(self, xs: np.ndarray, weight: np.ndarray
                   ) -> Tuple[np.ndarray, np.ndarray]:
@@ -1048,18 +1057,23 @@ class FastRule:
         self.prepare_candidates(xs)
         R = self.result_max
         X = xs.shape[0]
+        g_devprof.account_h2d("crush.map_batch", w32.nbytes)
         wd = jnp.asarray(w32)
         from ..common.kernel_trace import g_kernel_timer
-        packed = g_kernel_timer.timed(
-            "crush_resolve", self._packed_jit, *self._cand,
-            self._cand_x, wd)
+        with g_devprof.stage("crush.map_batch"):
+            packed = g_kernel_timer.timed(
+                "crush_resolve", self._packed_jit, *self._cand,
+                self._cand_x, wd)
         cap = min(self.delta_cap, X)
         if self._prev_packed is not None and self._host_out is not None:
             # per-epoch fast path: fetch only the rows that changed since
             # the previous weight vector (plus residual guesses, which
             # must be re-verified) and patch the host mirror in place.
-            flat = np.asarray(self._delta_jit(packed, self._prev_packed,
-                                              cap))
+            with g_devprof.stage("crush.map_batch"):
+                flat = np.asarray(self._delta_jit(packed,
+                                                  self._prev_packed,
+                                                  cap))
+            g_devprof.account_d2h("crush.map_batch", flat.nbytes)
             n_changed = int(flat[0])
             self._residual_frac = int(flat[1]) / X
             if n_changed <= cap:
@@ -1077,6 +1091,7 @@ class FastRule:
             # sustained churny workloads stop overflowing)
             self.delta_cap = min(2 * self.delta_cap, max(X, 1))
         full = np.asarray(packed)
+        g_devprof.account_d2h("crush.map_batch", full.nbytes)
         out = full[:, :R].copy()
         counts = (full[:, R] & 0xFFFF).astype(np.int32)
         residual = (full[:, R] >> 16) != 0

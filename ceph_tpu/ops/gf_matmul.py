@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from ..common.lockdep import DebugLock
 from ..gf.tables import expand_to_bitmatrix
 from ..gf.matrices import gf_invert_matrix
+from ..trace.devprof import g_devprof
 
 
 @functools.lru_cache(maxsize=1)
@@ -136,8 +137,13 @@ class DeviceWordRSBackend:
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         """(S, k, C) uint8 -> (S, m, C) coding chunks."""
-        return np.asarray(gfw_bit_matmul(jnp.asarray(data),
-                                         self._enc_bits, self.w))
+        g_devprof.install_compile_listener()
+        g_devprof.account_h2d("gf_matmul.encode_w", data.nbytes)
+        with g_devprof.stage("gf_matmul.encode_w"):
+            out = np.asarray(gfw_bit_matmul(jnp.asarray(data),
+                                            self._enc_bits, self.w))
+        g_devprof.account_d2h("gf_matmul.encode_w", out.nbytes)
+        return out
 
 
 class DeviceRSBackend:
@@ -157,11 +163,22 @@ class DeviceRSBackend:
 
     # -- encode -------------------------------------------------------------
     def encode(self, data: np.ndarray) -> np.ndarray:
-        """(S, k, C) uint8 -> (S, m, C) coding chunks (numpy round-trip)."""
+        """(S, k, C) uint8 -> (S, m, C) coding chunks (numpy round-trip).
+
+        THE host↔device boundary of the EC write path: the whole
+        batch crosses up, the coding chunks cross back.  Both legs are
+        accounted per call-site by the device-flow profiler (counter
+        bumps only — no sync is added; the ``jnp.asarray`` /
+        ``np.asarray`` pair was always the copy)."""
         from ..common.kernel_trace import g_kernel_timer
-        return g_kernel_timer.timed(
-            "gf_encode", lambda:
-            np.asarray(self.encode_device(jnp.asarray(data))))
+        g_devprof.install_compile_listener()
+        g_devprof.account_h2d("gf_matmul.encode", data.nbytes)
+        with g_devprof.stage("gf_matmul.encode"):
+            out = g_kernel_timer.timed(
+                "gf_encode", lambda:
+                np.asarray(self.encode_device(jnp.asarray(data))))
+        g_devprof.account_d2h("gf_matmul.encode", out.nbytes)
+        return out
 
     def encode_device(self, data: jnp.ndarray) -> jnp.ndarray:
         """Device-resident variant; composes under jit/shard_map."""
@@ -179,7 +196,9 @@ class DeviceRSBackend:
         sub = self.matrix[list(srcs), :]
         inv = gf_invert_matrix(sub)              # data = inv @ survivors
         rows = inv[list(want_rows), :]
-        bits = jnp.asarray(expand_to_bitmatrix(rows).astype(np.int8))
+        bits_np = expand_to_bitmatrix(rows).astype(np.int8)
+        g_devprof.account_h2d("gf_matmul.decode_bits", bits_np.nbytes)
+        bits = jnp.asarray(bits_np)
         with self._cache_lock:
             self._decode_bits_cache[key] = bits
             from ..ec.rs_codec import DECODE_CACHE_ENTRIES
@@ -192,4 +211,9 @@ class DeviceRSBackend:
         """survivors (S, k, C) stacked in ``srcs`` order -> the requested
         data rows (S, len(want_rows), C)."""
         bits = self._decode_bits_for(tuple(srcs), tuple(want_rows))
-        return np.asarray(gf_bit_matmul(jnp.asarray(survivors), bits))
+        g_devprof.install_compile_listener()
+        g_devprof.account_h2d("gf_matmul.decode", survivors.nbytes)
+        with g_devprof.stage("gf_matmul.decode"):
+            out = np.asarray(gf_bit_matmul(jnp.asarray(survivors), bits))
+        g_devprof.account_d2h("gf_matmul.decode", out.nbytes)
+        return out

@@ -67,8 +67,8 @@ from ..msg import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply,
 )
-from ..trace import (g_perf_histograms, g_tracer, latency_in_bytes_axes,
-                     pipeline_axes)
+from ..trace import (g_devprof, g_perf_histograms, g_tracer,
+                     latency_in_bytes_axes, pipeline_axes)
 from ..os_store import MemStore, Transaction, hobject_t
 from ..utils.crc32c import crc32c
 from .ecutil import HashInfo, stripe_info_t
@@ -374,7 +374,13 @@ class ECBackend:
     def _pad(self, data: bytes) -> bytes:
         w = self.sinfo.get_stripe_width()
         rem = len(data) % w
-        return data if not rem else data + b"\0" * (w - rem)
+        if not rem:
+            return data
+        # stripe-align pad: the first host-side copy of the write
+        # path's ledger (bufferlist bytes -> padded stripe buffer)
+        out = data + b"\0" * (w - rem)
+        g_devprof.account_host_copy("ec.pad_stripe_align", len(out))
+        return out
 
     # ---- instrumented codec entry points ----------------------------------
     def _encode(self, data: bytes) -> Dict[int, np.ndarray]:
@@ -808,8 +814,10 @@ class ECBackend:
         # (the Message.h:254 slot riding every sub-op)
         cur_trace = g_tracer.current_trace_id() if g_tracer.enabled else 0
         cur_span = g_tracer.current_span_id() if g_tracer.enabled else 0
+        msg_bytes = 0
         for shard, osd in acting.items():
             chunk = shards[shard].tobytes() if shard in shards else b""
+            msg_bytes += len(chunk)
             msg = MOSDECSubOpWrite(
                 tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
                 chunk=chunk, offset=chunk_off, partial=partial,
@@ -819,6 +827,10 @@ class ECBackend:
             wr.pending_shards.add(shard)
             wr.sent_msgs[shard] = (osd, msg)
             self.pg.send_to_osd(osd, msg)
+        if msg_bytes:
+            # last stage of the write path's copy ledger: shard chunk
+            # buffers materialized into per-shard sub-op messages
+            g_devprof.account_host_copy("ec.subop_messages", msg_bytes)
         wr.last_send = self.pg.osd.now
         self.inflight_writes[tid] = wr
 

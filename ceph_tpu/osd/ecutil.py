@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Sequence, Set
 
 import numpy as np
 
+from ..trace.devprof import g_devprof
 from ..utils.crc32c import crc32c
 
 CHUNK_ALIGNMENT = 64
@@ -94,8 +95,11 @@ def encode(sinfo: stripe_info_t, ec_impl, data,
         # physical chunk directly
         stripes = buf.reshape(S, k, C)
         allc = ec_impl.encode_batch_full(stripes)     # (S, n, C)
-        return {i: np.ascontiguousarray(allc[:, i, :]).reshape(-1)
-                for i in want}
+        out = {i: np.ascontiguousarray(allc[:, i, :]).reshape(-1)
+               for i in want}
+        g_devprof.account_host_copy(
+            "ecutil.shard_slice", sum(b.nbytes for b in out.values()))
+        return out
     if hasattr(ec_impl, "encode_batch") and not ec_impl.get_chunk_mapping():
         stripes = buf.reshape(S, k, C)
         coding = ec_impl.encode_batch(stripes)        # (S, m, C)
@@ -106,6 +110,10 @@ def encode(sinfo: stripe_info_t, ec_impl, data,
             else:
                 out[i] = np.ascontiguousarray(
                     coding[:, i - k, :]).reshape(-1)
+        # per-shard slice-out of the batched result: one ledger stage
+        # for the whole fan (S*C bytes per wanted shard)
+        g_devprof.account_host_copy(
+            "ecutil.shard_slice", sum(b.nbytes for b in out.values()))
         return out
 
     out_parts: Dict[int, List[np.ndarray]] = {i: [] for i in want}
@@ -115,7 +123,10 @@ def encode(sinfo: stripe_info_t, ec_impl, data,
         for i, chunk in encoded.items():
             assert len(chunk) == C
             out_parts[i].append(chunk)
-    return {i: np.concatenate(parts) for i, parts in out_parts.items()}
+    out = {i: np.concatenate(parts) for i, parts in out_parts.items()}
+    g_devprof.account_host_copy(
+        "ecutil.shard_slice", sum(b.nbytes for b in out.values()))
+    return out
 
 
 def decode_concat(sinfo: stripe_info_t, ec_impl,

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.gf_matmul import gf_bit_matmul, DeviceRSBackend
+from ..trace.devprof import g_devprof
 from .mesh import STRIPE_AXIS, SHARD_AXIS
 
 try:
@@ -135,7 +136,12 @@ class ShardedRS:
         return self._matmul_jit(data, self._enc_bits)
 
     def encode(self, data: np.ndarray) -> np.ndarray:
-        return np.asarray(self.encode_device(jnp.asarray(data)))
+        g_devprof.install_compile_listener()
+        g_devprof.account_h2d("parallel.encode", data.nbytes)
+        with g_devprof.stage("parallel.encode"):
+            out = np.asarray(self.encode_device(jnp.asarray(data)))
+        g_devprof.account_d2h("parallel.encode", out.nbytes)
+        return out
 
     # -- decode -------------------------------------------------------------
     def decode_bits(self, srcs: Tuple[int, ...],
@@ -145,6 +151,9 @@ class ShardedRS:
         if hit is not None:
             self._dev_decode_bits.move_to_end(key)
             return hit
+        # no devprof h2d here: _decode_bits_for already accounted the
+        # real host->device crossing; this device_put is a device-to-
+        # device reshard onto the mesh, not a boundary copy
         bits = self.backend._decode_bits_for(*key)
         out = jax.device_put(bits, NamedSharding(self.mesh, P(None, None)))
         self._dev_decode_bits[key] = out
@@ -155,8 +164,14 @@ class ShardedRS:
     def decode_data(self, survivors: np.ndarray, srcs: Sequence[int],
                     want_rows: Sequence[int]) -> np.ndarray:
         bits = self.decode_bits(tuple(srcs), tuple(want_rows))
-        sv = jax.device_put(jnp.asarray(survivors), self.data_sharding)
-        return np.asarray(self._decode_jit(sv, bits))
+        g_devprof.install_compile_listener()
+        g_devprof.account_h2d("parallel.decode", survivors.nbytes)
+        with g_devprof.stage("parallel.decode"):
+            sv = jax.device_put(jnp.asarray(survivors),
+                                self.data_sharding)
+            out = np.asarray(self._decode_jit(sv, bits))
+        g_devprof.account_d2h("parallel.decode", out.nbytes)
+        return out
 
     # -- contraction-sharded decode -----------------------------------------
     def decode_data_survivor_sharded(self, survivors: np.ndarray,
@@ -182,12 +197,19 @@ class ShardedRS:
             raise ValueError(f"k={k} not divisible by shard axis "
                              f"size {nshard}")
         bits = self.decode_bits(tuple(srcs), tuple(want_rows))
-        sv = jax.device_put(
-            jnp.asarray(survivors),
-            NamedSharding(self.mesh, P(STRIPE_AXIS, SHARD_AXIS, None)))
-        bd = jax.device_put(
-            bits, NamedSharding(self.mesh, P(SHARD_AXIS, None)))
-        return np.asarray(self._collective_decode_jit()(sv, bd))
+        g_devprof.install_compile_listener()
+        g_devprof.account_h2d("parallel.decode_sharded",
+                              survivors.nbytes)
+        with g_devprof.stage("parallel.decode_sharded"):
+            sv = jax.device_put(
+                jnp.asarray(survivors),
+                NamedSharding(self.mesh,
+                              P(STRIPE_AXIS, SHARD_AXIS, None)))
+            bd = jax.device_put(
+                bits, NamedSharding(self.mesh, P(SHARD_AXIS, None)))
+            out = np.asarray(self._collective_decode_jit()(sv, bd))
+        g_devprof.account_d2h("parallel.decode_sharded", out.nbytes)
+        return out
 
     # -- layout conversion (all-to-all) -------------------------------------
     def reshard_stripes_to_chunks(self, chunks: jnp.ndarray

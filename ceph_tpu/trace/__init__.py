@@ -18,6 +18,9 @@ from .histogram import (
     latency_in_bytes_axes, occupancy_axes, pipeline_axes,
 )
 from .flight import FlightEntry, FlightRecorder, g_flight_recorder
+from .devprof import (DevFlowProfiler, devflow_delta,
+                      devprof_perf_counters, g_devprof,
+                      transfer_size_axes)
 
 __all__ = [
     "Span", "SpanCollector", "Tracer", "build_tree", "g_tracer",
@@ -25,4 +28,6 @@ __all__ = [
     "SCALE_LINEAR", "SCALE_LOG2", "g_perf_histograms", "latency_axes",
     "latency_in_bytes_axes", "occupancy_axes", "pipeline_axes",
     "FlightEntry", "FlightRecorder", "g_flight_recorder",
+    "DevFlowProfiler", "devflow_delta", "devprof_perf_counters",
+    "g_devprof", "transfer_size_axes",
 ]

@@ -1,0 +1,53 @@
+Device-flow profiler admin CLI (`ceph daemon <who> prof dump|reset`),
+in the style of the reference's recorded src/test/cli transcripts: the
+zeroed profile of a freshly restored cluster, an EC write's per-site
+ledger, and the reset.
+
+  $ python -c "from ceph_tpu.cluster import MiniCluster; MiniCluster(n_osds=2).checkpoint('ck')"
+
+  $ ceph --cluster ck daemon osd.0 prof dump
+  {
+    "counters": {
+      "compiles": 0,
+      "d2h_bytes": 0,
+      "d2h_transfers": 0,
+      "device_mem_highwater_bytes": 0,
+      "h2d_bytes": 0,
+      "h2d_transfers": 0,
+      "host_copies": 0,
+      "host_copy_bytes": 0
+    },
+    "device_mem": {
+      "bytes_in_use": \d+, (re)
+      "highwater_bytes": \d+, (re)
+      "peak_bytes_in_use": \d+, (re)
+      "source": "live_arrays"
+    },
+    "sites": {},
+    "totals": {
+      "compiles": 0,
+      "d2h_bytes": 0,
+      "d2h_count": 0,
+      "h2d_bytes": 0,
+      "h2d_count": 0,
+      "host_copies": 0,
+      "host_copy_bytes": 0,
+      "transfers": 0
+    },
+    "transfer_size_histogram": {
+      "count": 0,
+      "sum_bytes": 0.0
+    }
+  }
+
+  $ ceph --cluster ck daemon osd.0 prof reset
+  {
+    "reset": true
+  }
+
+(The populated per-site table of a live EC write — stripe pad, device
+round trip, shard slice-out, sub-op message build — is asserted
+in-process by tests/test_devprof.py; booting an EC cluster inside a
+cram subprocess would re-compile the encode kernel outside the shared
+XLA cache and burn tier-1 wall budget for coverage that already
+exists.)

@@ -37,6 +37,17 @@ def test_fault_cli_cram(tmp_path):
     assert_cram(path, str(tmp_path))
 
 
+def test_prof_cli_cram(tmp_path):
+    """`ceph daemon <who> prof dump|reset` replayed from a recorded
+    transcript (tests/cli/prof.t): the zeroed device-flow profile of a
+    restored cluster and the reset — through the same `ceph` shim as
+    fault.t (the populated ledger is covered in-process by
+    tests/test_devprof.py)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cli", "prof.t")
+    assert_cram(path, str(tmp_path))
+
+
 def test_rgw_admin_flow(env, capsys):
     c, cl = env
     run = lambda *a: rgw_admin.run(c, cl, list(a))

@@ -330,6 +330,25 @@ def test_smoke_mode_end_to_end():
     for cname, st in mt["per_client"].items():
         assert st["p99"] > 0.0, (cname, st)
     assert mt["aggregate"]["p99"] > 0.0
+    # devprof acceptance: EVERY fenced workload emits a devflow block
+    # with the gated per-op figures, and the dispatch/pipeline pairs
+    # show coalescing as FEWER copies per op (the copy-budget story)
+    for m in out["metrics"]:
+        flow = m.get("devflow")
+        assert isinstance(flow, dict), f"{m['name']}: no devflow block"
+        assert {"h2d_bytes", "d2h_bytes", "transfers", "compiles",
+                "copies_per_op", "bytes_per_op"} <= set(flow), m["name"]
+        assert flow["copies_per_op"] >= 0
+    flows = {m["name"]: m["devflow"] for m in out["metrics"]}
+    assert flows["ec_dispatch_serial_fenced"]["copies_per_op"] > \
+        flows["ec_dispatch_coalesce_fenced"]["copies_per_op"], \
+        "coalescing did not reduce copies per op"
+    assert flows["ec_pipeline_depth1_fenced"]["copies_per_op"] > \
+        flows["ec_pipeline_fenced"]["copies_per_op"]
+    assert flows["ec_dispatch_coalesce_fenced"]["h2d_bytes"] > 0
+    # the run JSON also ships the per-site ledger (prof dump shape)
+    assert flows and out["devprof"]["totals"]["transfers"] > 0
+    assert "gf_matmul.encode" in out["devprof"]["sites"]
     # the gate ran (warn mode) and the observability counters moved
     assert "gate" in out
     assert out["perf"]["dispatches"] > 0

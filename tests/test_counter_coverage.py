@@ -1,0 +1,95 @@
+"""Counter-coverage lint: no registered metric may silently skip the
+Prometheus exporter.
+
+Satellite of the devprof PR.  Twice now a counter family was added to
+`perf dump` and only later discovered missing from the mgr exposition
+(the PR 3 dimensionless-axis fix, the PR 6 qos wiring).  This lint
+closes the loop structurally: it walks every ``PerfCounters`` logger
+registered in the cluster's collection AND every ``PerfHistogram`` in
+the process registry, and asserts each family appears in the rendered
+exposition — so a new counter that skips the exporter fails tier-1,
+not a dashboard review.
+"""
+import re
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster_and_text():
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("lint", k=3, m=2, pg_num=8)
+    cl = c.client("client.lint")
+    assert cl.write_full("lint", "o", b"c" * 16000) == 0
+    assert cl.read("lint", "o")[:1] == b"c"
+    return c, c.admin_socket.execute("prometheus metrics")
+
+
+def _prom_name(raw: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", raw)
+
+
+def test_every_perf_counter_is_exported(cluster_and_text):
+    """Every numeric counter of every registered logger renders as a
+    ``ceph_daemon_<logger>_<counter>`` sample."""
+    c, text = cluster_and_text
+    sample_names = {line.split("{")[0].split(" ")[0]
+                    for line in text.splitlines()
+                    if line and not line.startswith("#")}
+    missing = []
+    dump = c.perf_collection.dump()
+    assert dump, "empty perf collection"
+    for logger, counters in sorted(dump.items()):
+        if not isinstance(counters, dict):
+            continue
+        for cname, val in sorted(counters.items()):
+            if not isinstance(val, (int, float)):
+                # time-avg counters dump as {sum, avgcount}: the
+                # renderer skips them by design (no scalar sample)
+                continue
+            want = f"ceph_daemon_{_prom_name(f'{logger}_{cname}')}"
+            if want not in sample_names:
+                missing.append(want)
+    assert not missing, \
+        f"{len(missing)} registered counters missing from the " \
+        f"exposition: {missing[:10]}"
+
+
+def test_every_histogram_family_is_exported(cluster_and_text):
+    """Every registered PerfHistogram NAME renders as a ``# TYPE ...
+    histogram`` family with _bucket/_sum/_count series."""
+    from ceph_tpu.trace import g_perf_histograms
+    _c, text = cluster_and_text
+    types = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _h, _t, name, typ = line.split(None, 3)
+            types[name] = typ
+    sample_names = {line.split("{")[0].split(" ")[0]
+                    for line in text.splitlines()
+                    if line and not line.startswith("#")}
+    names = {hname for (_logger, hname), _h in g_perf_histograms.items()}
+    assert names, "no histograms registered"
+    missing = []
+    for hname in sorted(names):
+        fam = f"ceph_{_prom_name(hname)}"
+        if types.get(fam) != "histogram":
+            missing.append(f"{fam} (no TYPE histogram)")
+            continue
+        for sfx in ("_bucket", "_sum", "_count"):
+            if f"{fam}{sfx}" not in sample_names:
+                missing.append(f"{fam}{sfx}")
+    assert not missing, \
+        f"histogram families missing from the exposition: {missing[:10]}"
+
+
+def test_known_new_families_covered_by_the_lint(cluster_and_text):
+    """Canary: the lint actually sees this PR's additions (devprof) —
+    if someone unregisters the logger the lint must not silently pass
+    on an empty set."""
+    c, _text = cluster_and_text
+    assert "devprof" in c.perf_collection.dump()
+    from ceph_tpu.trace import g_perf_histograms
+    assert any(lg == "devprof" for (lg, _n), _h
+               in g_perf_histograms.items())

@@ -1,0 +1,327 @@
+"""Device-flow profiler: host↔device transfer, compile, and memory
+accounting (ceph_tpu/trace/devprof.py).
+
+Acceptance gates of the devprof PR:
+
+- a traced EC write in the mini-cluster yields a COMPLETE copy ledger:
+  the op's span tree carries ≥1 h2d and ≥1 d2h stage with non-zero
+  bytes, plus the host staging stages (pad/stack → device → host →
+  sub-op messages);
+- ``prof dump`` and the Prometheus exposition agree on transfer
+  totals;
+- fresh XLA compiles are detected via jit cache-miss observation and
+  attributed to the active call-site stage;
+- ``devflow_delta`` produces the bench block (copies_per_op /
+  bytes_per_op) and regress.py gates it (the copy-budget gate).
+"""
+import re
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.trace import devflow_delta, g_devprof, g_tracer
+from ceph_tpu.trace.devprof import (DevFlowProfiler,
+                                    devprof_perf_counters,
+                                    l_devprof_compiles,
+                                    l_devprof_d2h_bytes,
+                                    l_devprof_h2d_bytes)
+
+
+@pytest.fixture
+def clean_devprof():
+    yield
+    g_tracer.enable(False)
+    g_tracer.collector.clear()
+    g_devprof.reset()
+
+
+# ---- unit: accounting primitives -------------------------------------------
+def test_site_accounting_and_totals(clean_devprof):
+    p = DevFlowProfiler()
+    p.account_h2d("unit.a", 1000)
+    p.account_h2d("unit.a", 24)
+    p.account_d2h("unit.a", 512)
+    p.account_host_copy("unit.b", 4096)
+    t = p.totals()
+    assert t["h2d_bytes"] == 1024 and t["h2d_count"] == 2
+    assert t["d2h_bytes"] == 512 and t["d2h_count"] == 1
+    assert t["transfers"] == 3
+    assert t["host_copies"] == 1 and t["host_copy_bytes"] == 4096
+    d = p.dump()
+    assert d["sites"]["unit.a"]["h2d_bytes"] == 1024
+    assert d["sites"]["unit.b"]["host_copies"] == 1
+
+
+def test_ledger_attaches_to_active_span(clean_devprof):
+    g_tracer.enable()
+    with g_tracer.span("op", daemon="t", trace_id=77) as sp:
+        g_devprof.account_h2d("unit.site", 100)
+        g_devprof.account_d2h("unit.site", 64)
+        g_devprof.account_host_copy("unit.pad", 32)
+    led = sp.tags["copy_ledger"]
+    assert ({e["dir"] for e in led} == {"h2d", "d2h", "host"}
+            and all(e["bytes"] > 0 for e in led))
+
+
+def test_ledger_free_when_tracing_disabled(clean_devprof):
+    """Default-off tracing: accounting still counts (always-on, like
+    perf counters) but allocates no ledger anywhere."""
+    before = g_devprof.totals()["transfers"]
+    g_devprof.account_h2d("unit.off", 10)
+    assert g_devprof.totals()["transfers"] == before + 1
+    assert g_tracer.collector.dump() == {}
+
+
+def test_devflow_delta_block():
+    before = {"h2d_bytes": 100, "d2h_bytes": 50, "h2d_count": 1,
+              "d2h_count": 1, "host_copies": 0, "host_copy_bytes": 0,
+              "compiles": 0}
+    after = {"h2d_bytes": 1124, "d2h_bytes": 562, "h2d_count": 5,
+             "d2h_count": 3, "host_copies": 2, "host_copy_bytes": 99,
+             "compiles": 1}
+    block = devflow_delta(before, after, n_ops=4)
+    assert block["h2d_bytes"] == 1024 and block["d2h_bytes"] == 512
+    assert block["transfers"] == 6 and block["compiles"] == 1
+    # copies = transfers + host staging copies, per op
+    assert block["copies_per_op"] == pytest.approx(8 / 4)
+    assert block["bytes_per_op"] == pytest.approx(1536 / 4)
+
+
+def test_compile_detection_attributes_to_stage(clean_devprof):
+    """A fresh jit compile (cache miss) bumps the compile counter under
+    the active stage; a cache HIT adds nothing."""
+    import jax
+    import jax.numpy as jnp
+    g_devprof.install_compile_listener()
+    pc = devprof_perf_counters()
+
+    # a never-before-seen jaxpr: closure over a fresh python constant
+    # makes the trace unique to this test run
+    salt = np.random.default_rng().integers(1 << 30)
+
+    def fresh(x):
+        return x * jnp.int32(int(salt) % 7 + 2) + jnp.int32(int(salt) % 5)
+
+    jitted = jax.jit(fresh)
+    before = pc.get(l_devprof_compiles)
+    with g_devprof.stage("unit.compile_probe"):
+        jax.block_until_ready(jitted(jnp.arange(4, dtype=jnp.int32)))
+    after_first = pc.get(l_devprof_compiles)
+    assert after_first > before, "fresh jit compile not detected"
+    assert g_devprof.dump()["sites"].get(
+        "unit.compile_probe", {}).get("compiles", 0) >= 1
+    # same shape again: cache hit, no compile event
+    with g_devprof.stage("unit.compile_probe"):
+        jax.block_until_ready(jitted(jnp.arange(4, dtype=jnp.int32)))
+    assert pc.get(l_devprof_compiles) == after_first, \
+        "jit cache hit was miscounted as a compile"
+
+
+def test_device_mem_sample_never_raises(clean_devprof):
+    out = g_devprof.sample_device_mem()
+    assert out["source"] in ("memory_stats", "live_arrays", "none")
+    assert out["peak_bytes_in_use"] >= 0
+
+
+def test_reset_zeroes_everything(clean_devprof):
+    g_devprof.account_h2d("unit.r", 10)
+    g_devprof.account_host_copy("unit.r", 10)
+    g_devprof.reset()
+    d = g_devprof.dump()
+    assert d["sites"] == {}
+    t = d["totals"]
+    assert all(v == 0 for v in t.values())
+    assert d["counters"]["h2d_bytes"] == 0
+
+
+# ---- cluster acceptance -----------------------------------------------------
+@pytest.fixture(scope="module")
+def prof_cluster():
+    """One shared mini-cluster for the acceptance tests (the profiler
+    is process-global; each test works off counter deltas / its own
+    trace id, so sharing the boot costs tier-1 nothing in isolation)."""
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("prof", k=3, m=2, pg_num=8)
+    return c
+
+
+def test_traced_ec_write_yields_complete_copy_ledger(prof_cluster,
+                                                     clean_devprof):
+    """Acceptance: one traced EC write shows its full copy ledger on
+    the op's span tree — ≥1 h2d and ≥1 d2h stage with non-zero bytes,
+    plus the host staging stages (stripe pad, shard slice-out, sub-op
+    message build)."""
+    c = prof_cluster
+    cl = c.client()
+    g_tracer.enable()
+    assert cl.write_full("prof", "obj", b"L" * 20000) == 0
+
+    # collect every ledger entry on the write's trace
+    spans = [s for ring in g_tracer.collector._rings.values()
+             for s in ring]
+    trace_ids = {s.trace_id for s in spans
+                 if s.name.startswith("osd_op:writefull")}
+    assert trace_ids, "no traced write op span"
+    tid = trace_ids.pop()
+    ledger = [e for s in spans if s.trace_id == tid
+              for e in s.tags.get("copy_ledger", [])]
+    dirs = {e["dir"] for e in ledger}
+    assert "h2d" in dirs and "d2h" in dirs, ledger
+    assert all(e["bytes"] > 0 for e in ledger)
+    stages = {e["stage"] for e in ledger}
+    # the write path's staging stages are all visible
+    assert "gf_matmul.encode" in stages
+    assert "ec.subop_messages" in stages
+    assert "ecutil.shard_slice" in stages
+
+
+def test_prof_dump_and_prometheus_agree(prof_cluster, clean_devprof):
+    """Acceptance: the admin socket's `prof dump` totals equal the
+    Prometheus exposition's ceph_daemon_devprof_* samples (one source
+    of truth, two surfaces)."""
+    c = prof_cluster
+    cl = c.client()
+    assert cl.write_full("prof", "agree", b"A" * 16000) == 0
+    dump = c.admin_socket.execute("prof dump")
+    totals = dump["totals"]
+    assert totals["h2d_bytes"] > 0 and totals["d2h_bytes"] > 0
+
+    text = c.admin_socket.execute("prometheus metrics")
+
+    def prom(name):
+        m = re.search(rf"^ceph_daemon_devprof_{name} (\d+(?:\.\d+)?)$",
+                      text, re.M)
+        assert m, f"ceph_daemon_devprof_{name} missing from exposition"
+        return float(m.group(1))
+
+    # the exposition is rendered AFTER the dump: totals can only grow,
+    # and nothing in between touches the device — they must agree
+    assert prom("h2d_bytes") == totals["h2d_bytes"]
+    assert prom("d2h_bytes") == totals["d2h_bytes"]
+    assert prom("h2d_transfers") == totals["h2d_count"]
+    assert prom("d2h_transfers") == totals["d2h_count"]
+    assert prom("compiles") == totals["compiles"]
+    # high-water gauge present (sampled at scrape)
+    assert prom("device_mem_highwater_bytes") >= 0
+
+
+def test_prof_dump_counts_batched_writes_too(prof_cluster,
+                                             clean_devprof):
+    """The dispatcher's coalesced path accounts through the same
+    funnels: a batched write adds pad/stack host copies and one
+    h2d/d2h pair for the whole batch."""
+    c = prof_cluster
+    cl = c.client()
+    cl.write_full("prof", "warm", b"w" * 8000)
+    g_conf.set_val("ec_dispatch_batch_window_us", 100_000)
+    g_conf.set_val("ec_dispatch_batch_max", 8)
+    try:
+        t0 = g_devprof.totals()
+        assert cl.write_full("prof", "batched", b"B" * 16000) == 0
+        t1 = g_devprof.totals()
+    finally:
+        g_conf.rm_val("ec_dispatch_batch_window_us")
+        g_conf.rm_val("ec_dispatch_batch_max")
+    assert t1["h2d_count"] > t0["h2d_count"]
+    assert t1["d2h_count"] > t0["d2h_count"]
+    assert t1["h2d_bytes"] - t0["h2d_bytes"] >= 16000
+
+
+def test_transfer_size_histogram_lands_samples(clean_devprof):
+    """Every transfer lands in the devprof log2 size histogram (the
+    `perf histogram dump` / Prometheus family)."""
+    from ceph_tpu.trace import g_perf_histograms
+    hist = g_perf_histograms.get("devprof",
+                                 "devprof_transfer_size_histogram")
+    n0 = hist.total_count
+    g_devprof.account_h2d("unit.hist", 4096)
+    g_devprof.account_d2h("unit.hist", 100)
+    assert hist.total_count == n0 + 2
+    # host staging copies are NOT transfers: histogram untouched
+    g_devprof.account_host_copy("unit.hist", 8192)
+    assert hist.total_count == n0 + 2
+
+
+# ---- copy-budget gate -------------------------------------------------------
+def _metric(name, value, devflow, unit="GiB/s"):
+    return {"schema_version": 1, "name": name, "value": value,
+            "unit": unit, "fenced": True, "devflow": devflow}
+
+
+def _flow(copies, bpo):
+    return {"h2d_bytes": 0, "d2h_bytes": 0, "transfers": 0,
+            "compiles": 0, "host_copies": 0,
+            "copies_per_op": copies, "bytes_per_op": bpo}
+
+
+def test_copy_budget_gate_flags_copy_regression(tmp_path):
+    """regress.py: copies_per_op / bytes_per_op are gated metrics —
+    more copies than baseline beyond tolerance is a REGRESSION even
+    when throughput is unchanged."""
+    import json
+    from ceph_tpu.bench import regress
+    base = _metric("wl", 1.0, _flow(2.0, 1000.0))
+    with open(tmp_path / "BENCH_r90.json", "w") as f:
+        json.dump({"n": 90, "rc": 0,
+                   "parsed": {"platform": "cpu", "metrics": [base]}}, f)
+    traj = regress.load_trajectory(str(tmp_path))
+    # same throughput, 2x the copies: the copy budget trips
+    cur = [_metric("wl", 1.0, _flow(4.0, 1000.0))]
+    gate = regress.compare_against_trajectory(cur, traj, "cpu")
+    names = [r["name"] for r in gate["regressions"]]
+    assert "wl.copies_per_op" in names
+    assert "wl.bytes_per_op" not in names
+    # fewer copies: an improvement, not a regression
+    cur = [_metric("wl", 1.0, _flow(1.0, 400.0))]
+    gate = regress.compare_against_trajectory(cur, traj, "cpu")
+    assert not gate["regressions"]
+    imp = [r["name"] for r in gate["improvements"]]
+    assert "wl.copies_per_op" in imp and "wl.bytes_per_op" in imp
+
+
+def test_copy_budget_gate_zero_copy_baseline_is_sacred(tmp_path):
+    """A workload whose baseline moved (effectively) ZERO bytes must
+    stay zero-copy: a real per-op copy chain appearing regresses
+    regardless of relative tolerance — but sub-floor drift (the fence
+    drain's 1/n_steps noise on device-resident workloads, whose step
+    count is calibration-dependent) gates nothing."""
+    import json
+    from ceph_tpu.bench import regress
+    base = _metric("zc", 1.0, _flow(0.0, 0.0))
+    with open(tmp_path / "BENCH_r91.json", "w") as f:
+        json.dump({"n": 91, "rc": 0,
+                   "parsed": {"platform": "cpu", "metrics": [base]}}, f)
+    traj = regress.load_trajectory(str(tmp_path))
+    cur = [_metric("zc", 1.0, _flow(0.5, 2048.0))]
+    gate = regress.compare_against_trajectory(cur, traj, "cpu")
+    assert {"zc.copies_per_op", "zc.bytes_per_op"} <= \
+        {r["name"] for r in gate["regressions"]}
+    # sub-floor drift (drain-fence noise): clean — this is what keeps
+    # measure_encode/measure_decode (device-resident, copies_per_op
+    # ~ 1/n_steps with run-calibrated n_steps) from flapping the gate
+    cur = [_metric("zc", 1.0, _flow(0.1, 100.0))]
+    gate = regress.compare_against_trajectory(cur, traj, "cpu")
+    assert not gate["regressions"]
+    # still exactly zero-copy: clean
+    cur = [_metric("zc", 1.0, _flow(0.0, 0.0))]
+    gate = regress.compare_against_trajectory(cur, traj, "cpu")
+    assert not gate["regressions"]
+
+
+def test_legacy_rounds_without_devflow_gate_nothing(tmp_path):
+    """Archived rounds predating the devprof PR carry no devflow —
+    the copy gate must skip them silently, not crash or fabricate a
+    zero baseline."""
+    import json
+    from ceph_tpu.bench import regress
+    base = {"schema_version": 1, "name": "wl", "value": 1.0,
+            "unit": "GiB/s", "fenced": True}     # no devflow key
+    with open(tmp_path / "BENCH_r92.json", "w") as f:
+        json.dump({"n": 92, "rc": 0,
+                   "parsed": {"platform": "cpu", "metrics": [base]}}, f)
+    traj = regress.load_trajectory(str(tmp_path))
+    cur = [_metric("wl", 1.0, _flow(3.0, 999.0))]
+    gate = regress.compare_against_trajectory(cur, traj, "cpu")
+    assert not gate["regressions"]

@@ -188,6 +188,22 @@ def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
         assert calls["n"] == 0, "async pipeline added a device sync"
     finally:
         g_conf.rm_val("ec_pipeline_depth")
+    # devprof extension: the device-flow profiler is ALWAYS on (counter
+    # bumps per boundary crossing) — it must have accounted the writes
+    # above while this counting fence saw zero added syncs, and a
+    # `prof dump` (device-mem sample included) must not sync either
+    from ceph_tpu.trace import g_devprof
+    g_conf.rm_val("ec_dispatch_batch_window_us")
+    t0 = g_devprof.totals()
+    assert cl.write_full("trace", "o_profiled", b"d" * 20000) == 0
+    t1 = g_devprof.totals()
+    assert t1["h2d_count"] > t0["h2d_count"], \
+        "profiler missed the write's h2d transfer"
+    assert t1["d2h_count"] > t0["d2h_count"], \
+        "profiler missed the write's d2h transfer"
+    assert calls["n"] == 0, "device-flow profiling added a device sync"
+    g_devprof.sample_device_mem()
+    assert calls["n"] == 0, "device-mem sampling added a device sync"
 
 
 def test_slow_op_span_tree_and_histogram_dump(clean_tracing):

@@ -210,6 +210,40 @@ def test_qos_families_and_counters(exposition):
         assert any(n == name for n, _l, _v in samples), f"{name} missing"
 
 
+def test_devprof_families_and_counters(exposition):
+    """Devprof-PR golden coverage: the transfer-size histogram renders
+    as a real histogram family with RAW log2 byte edges (dimensionless
+    axis — the un-scaled renderer path), and the devprof counters
+    (h2d/d2h bytes+transfers, compiles, device-mem high-water gauge)
+    render as daemon series with the fixture's EC writes accounted."""
+    types, samples = _parse(exposition)
+    fam = "ceph_devprof_transfer_size_histogram"
+    assert types.get(fam) == "histogram", \
+        "devprof transfer-size histogram family missing"
+    buckets = [(_le_of(labels), v) for n, labels, v in samples
+               if n == f"{fam}_bucket"]
+    assert buckets, "no transfer-size buckets rendered"
+    # byte axis is dimensionless: log2 edges survive un-scaled
+    # (512.0, 1024.0, ... not usec-to-seconds 0.000512)
+    les = sorted(le for le, _v in buckets if le != math.inf)
+    assert les[0] == 0.0 and 512.0 in les and 1024.0 in les, les[:6]
+    # the generic histogram test above already enforced cumulative
+    # monotonicity and +Inf == _count; here: the EC writes landed
+    counts = [v for n, _l, v in samples if n == f"{fam}_count"]
+    assert sum(counts) >= 2, "EC writes left no transfer samples"
+    # counter families on the daemon surface, all non-trivial
+    vals = {n: v for n, _l, v in samples}
+    for name in ("ceph_daemon_devprof_h2d_bytes",
+                 "ceph_daemon_devprof_h2d_transfers",
+                 "ceph_daemon_devprof_d2h_bytes",
+                 "ceph_daemon_devprof_d2h_transfers"):
+        assert vals.get(name, 0) > 0, f"{name} missing or zero"
+    for name in ("ceph_daemon_devprof_compiles",
+                 "ceph_daemon_devprof_host_copies",
+                 "ceph_daemon_devprof_device_mem_highwater_bytes"):
+        assert name in vals, f"{name} missing"
+
+
 def test_op_histograms_carry_the_writes(exposition):
     """The two writes + one read issued by the fixture are visible in
     some OSD's latency histograms (non-zero _count)."""
