@@ -160,8 +160,12 @@ def main(argv=None) -> int:
     result["perf"] = bench_perf_counters().dump()
     # histogram metric lines: the same perf-histogram surface the admin
     # socket's `perf histogram dump` serves, scoped to this bench run
-    from ..trace import g_devprof, g_perf_histograms
+    from ..trace import g_devprof, g_oplat, g_perf_histograms
     result["perf_histograms"] = g_perf_histograms.dump("bench")
+    # the run's stage-latency ledger (same shape as `latency dump`):
+    # where the microseconds went, per daemon per stage — the
+    # run-level companion of every workload's stage_breakdown block
+    result["oplat"] = g_oplat.dump()
     # the run's device-flow ledger (same shape as `prof dump`): which
     # call-sites moved how many bytes across the host<->device boundary
     prof = g_devprof.dump()

@@ -137,11 +137,20 @@ def fenced_time(step: Callable[[int], Any], n_steps: int,
     with g_tracer.activate(span):
         for i in range(n_steps):
             last = step(i)
+        t_issued = time.perf_counter()
         drain_span = g_tracer.begin("drain") if span is not None else None
         drain(last)
         g_tracer.finish(drain_span)
     elapsed = time.perf_counter() - t0
     g_tracer.finish(span)
+    # stage-latency ledger (trace/oplat.py): a fenced region decomposes
+    # into the back-to-back dispatch loop (device_call) and the drain
+    # fetch that completes it (d2h) — the two stamps sum to the fenced
+    # elapsed exactly, so every fenced workload's stage_breakdown
+    # reconciles with its wall by construction
+    from ..trace.oplat import g_oplat
+    g_oplat.record("bench", "device_call", (t_issued - t0) * 1e6)
+    g_oplat.record("bench", "d2h", (t0 + elapsed - t_issued) * 1e6)
     timing = FencedTiming(elapsed, n_steps, rtt_s)
     # per-step latency lands in the always-on bench histogram so
     # `python -m ceph_tpu.bench` metric lines carry the distribution

@@ -49,6 +49,20 @@ _DEVFLOW_GATED = (("copies_per_op", "copies/op"),
 DEVFLOW_TOLERANCE = 0.10
 DEVFLOW_FLOORS = {"copies_per_op": 0.25, "bytes_per_op": 512.0}
 
+# the stage-budget gate (oplat PR): every fenced workload's
+# stage_breakdown carries per-stage usec_per_op figures; each is
+# lower-better and gated alongside the workload's primary value, so
+# the mesh-sharded dispatch and zero-copy refactors must move a
+# CI-watched stage number instead of a prose claim.  Stage times are
+# wall-clock (not deterministic counts like the copy budget), so the
+# tolerance is looser than both the timing and copy gates; the per-op
+# floor keeps microsecond-scale stages — scheduling jitter, not a
+# budget — from gating anything.  A stage CROSSING the floor from a
+# sub-floor baseline is a regression (a new time sink appeared), the
+# mirror of the copy gate's zero-copy-baseline rule.
+STAGE_TOLERANCE = 0.50
+STAGE_FLOOR_USEC_PER_OP = 50.0
+
 
 def load_trajectory(root: str) -> List[Dict[str, Any]]:
     """All parseable BENCH_r*.json records under *root*, oldest first.
@@ -95,6 +109,38 @@ def _fenced_metrics(parsed: Optional[Dict[str, Any]]
     return out
 
 
+def _gate_lower_better(name: str, unit: str, cv: float, bv: float,
+                       floor: float, tolerance: float,
+                       baseline_round, regressions: List,
+                       improvements: List) -> bool:
+    """The one lower-better floor/tolerance rule both per-op gates
+    (copy budget, stage budget) apply, so the semantics cannot drift:
+    a sub-floor baseline is sacred — crossing the floor is a
+    regression with no ratio to report, sub-floor drift gates nothing;
+    over the floor, movement beyond *tolerance* classifies as
+    regression/improvement, and dropping under the floor is always an
+    improvement.  Returns True when the pair was actually compared."""
+    if bv < floor:
+        if cv >= floor:
+            regressions.append({
+                "name": name, "unit": unit, "value": cv,
+                "baseline": bv, "baseline_round": baseline_round,
+                "change": None})
+            return True
+        return False
+    change = (cv - bv) / bv
+    entry = {"name": name, "unit": unit, "value": cv, "baseline": bv,
+             "baseline_round": baseline_round,
+             "change": round(change, 4)}
+    if cv < floor:
+        improvements.append(entry)          # dropped under floor
+    elif change > tolerance:
+        regressions.append(entry)
+    elif change < -tolerance:
+        improvements.append(entry)
+    return True
+
+
 def compare_against_trajectory(
         current: List[Dict[str, Any]], trajectory: List[Dict[str, Any]],
         platform: str, tolerance: float = DEFAULT_TOLERANCE
@@ -112,6 +158,7 @@ def compare_against_trajectory(
     no_baseline: List[str] = []
     compared = 0           # metrics with a value baseline
     devflow_compared = 0   # devflow keys with a gated baseline
+    stage_compared = 0     # stage usec/op figures with a gated baseline
     for cur in current:
         if not cur.get("fenced") or cur.get("suspect"):
             continue
@@ -148,38 +195,30 @@ def compare_against_trajectory(
         # ---- copy-budget gate: the workload's devflow block ------------
         flow_cur = cur.get("devflow")
         flow_prev = baseline.get("devflow")
-        if not isinstance(flow_cur, dict) or \
-                not isinstance(flow_prev, dict):
-            continue
-        for key, unit in _DEVFLOW_GATED:
-            cv = float(flow_cur.get(key, 0.0) or 0.0)
-            bv = float(flow_prev.get(key, 0.0) or 0.0)
-            floor = DEVFLOW_FLOORS[key]
-            if bv < floor:
-                # an (effectively) zero-copy baseline is sacred: a
-                # real per-op copy chain appearing is a regression;
-                # sub-floor drift (drain-fence noise) gates nothing
-                if cv >= floor:
-                    devflow_compared += 1
-                    regressions.append({
-                        "name": f"{name}.{key}", "unit": unit,
-                        "value": cv, "baseline": bv,
-                        "baseline_round": baseline_round,
-                        "change": None})
-                continue
-            devflow_compared += 1
-            fchange = (cv - bv) / bv
-            fentry = {"name": f"{name}.{key}", "unit": unit,
-                      "value": cv, "baseline": bv,
-                      "baseline_round": baseline_round,
-                      "change": round(fchange, 4)}
-            if cv < floor:
-                improvements.append(fentry)      # dropped under floor
-            elif fchange > DEVFLOW_TOLERANCE:
-                regressions.append(fentry)
-            elif fchange < -DEVFLOW_TOLERANCE:
-                improvements.append(fentry)
+        if isinstance(flow_cur, dict) and isinstance(flow_prev, dict):
+            for key, unit in _DEVFLOW_GATED:
+                devflow_compared += _gate_lower_better(
+                    f"{name}.{key}", unit,
+                    float(flow_cur.get(key, 0.0) or 0.0),
+                    float(flow_prev.get(key, 0.0) or 0.0),
+                    DEVFLOW_FLOORS[key], DEVFLOW_TOLERANCE,
+                    baseline_round, regressions, improvements)
+        # ---- stage-budget gate: the workload's stage_breakdown ---------
+        sb_cur = (cur.get("stage_breakdown") or {}).get("stages")
+        sb_prev = (baseline.get("stage_breakdown") or {}).get("stages")
+        if not isinstance(sb_cur, dict) or not isinstance(sb_prev, dict):
+            continue        # pre-oplat rounds gate no stages
+        for stage in sorted(set(sb_cur) | set(sb_prev)):
+            stage_compared += _gate_lower_better(
+                f"{name}.stage.{stage}", "usec/op",
+                float((sb_cur.get(stage) or {}).get("usec_per_op",
+                                                    0.0) or 0.0),
+                float((sb_prev.get(stage) or {}).get("usec_per_op",
+                                                     0.0) or 0.0),
+                STAGE_FLOOR_USEC_PER_OP, STAGE_TOLERANCE,
+                baseline_round, regressions, improvements)
     return {"regressions": regressions, "improvements": improvements,
             "compared": compared, "devflow_compared": devflow_compared,
+            "stage_compared": stage_compared,
             "no_baseline": no_baseline,
             "tolerance": tolerance, "platform": platform}

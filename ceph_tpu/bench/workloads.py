@@ -28,6 +28,7 @@ from .schema import make_metric
 from .stats import repeat_measure
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder
 from ..trace.devprof import devflow_delta, g_devprof
+from ..trace.oplat import g_oplat
 
 K, M = 8, 4
 
@@ -146,6 +147,20 @@ def _devflow_since(before: Dict[str, int], n_ops: int) -> Dict[str, Any]:
     return devflow_delta(before, g_devprof.snapshot(), n_ops)
 
 
+def _stage_breakdown_since(before, wall_s: float,
+                           n_ops: int) -> Dict[str, Any]:
+    """The ``stage_breakdown`` block every fenced workload carries
+    (trace/oplat.py): per-stage time over the measured region —
+    share-of-stage-sum, per-op time, p50/p99 — with ``coverage``
+    (stage-sum over wall) as the reconciliation receipt: ~1.0 for a
+    serial region, ~occupancy under coalescing (per-op attribution of
+    a shared device call — the occupancy story in time units).  The
+    ``usec_per_op`` figures are gated by regress.py's stage-budget
+    gate, so the mesh/zero-copy refactors must move a stage number CI
+    watches."""
+    return g_oplat.breakdown_since(before, wall_s, n_ops)
+
+
 def _device_info() -> Tuple[str, str, int]:
     try:
         import jax
@@ -181,18 +196,24 @@ def _measure_fenced_gf(bits, batch: np.ndarray, *, metric_name: str,
     n_steps = _calibrate_steps(step, target_seconds / max(repeats, 1),
                                rtt_s)
     flow0 = g_devprof.snapshot()
+    stage0 = g_oplat.snapshot()
+    wall_t0 = time.perf_counter()
     st = repeat_measure(
         lambda: _fenced_throughput(step, n_steps, bytes_per_step, rtt_s,
                                    kernel_name)[0],
         repeats=repeats, warmup=warmup)
-    devflow = _devflow_since(flow0, n_steps * (repeats + warmup))
+    wall_s = time.perf_counter() - wall_t0
+    n_ops = n_steps * (repeats + warmup)
+    devflow = _devflow_since(flow0, n_ops)
     platform, kind, ndev = _device_info()
     rl = validate_reading(st["median"], workload, platform, kind, ndev)
     return make_metric(
         metric_name, st["median"], "GiB/s", fenced=True,
         rtt_s=rtt_s, stats=st, roofline=rl,
         extra={"n_steps": n_steps, "bytes_per_step": bytes_per_step,
-               "platform": platform, "devflow": devflow})
+               "platform": platform, "devflow": devflow,
+               "stage_breakdown": _stage_breakdown_since(
+                   stage0, wall_s, n_ops)})
 
 
 def measure_encode(matrix: np.ndarray, batch: np.ndarray, *,
@@ -254,18 +275,27 @@ def measure_host_native(matrix: np.ndarray, data2d: np.ndarray,
             n += 1
         dt = time.perf_counter() - t0
         one_sample.n_ops += n
+        # the whole region is host codec compute: one stage, so the
+        # native baseline's stage_breakdown reconciles trivially
+        g_oplat.record("bench", "host_compute", dt * 1e6)
         return n * object_size / dt / (1 << 30)
 
     one_sample.n_ops = 0
     flow0 = g_devprof.snapshot()
+    stage0 = g_oplat.snapshot()
+    wall_t0 = time.perf_counter()
     st = repeat_measure(one_sample, repeats=3, warmup=0)
+    wall_s = time.perf_counter() - wall_t0
     # the native path never crosses the device boundary — its devflow
     # block is the zero-copy baseline the device paths are judged by
     devflow = _devflow_since(flow0, max(one_sample.n_ops, 1))
     rl = validate_reading(st["median"], EC_ENCODE_K8M4, "cpu", "", 1)
     return make_metric("ec_encode_host_native", st["median"], "GiB/s",
                        fenced=True, rtt_s=0.0, stats=st, roofline=rl,
-                       extra={"platform": "cpu", "devflow": devflow})
+                       extra={"platform": "cpu", "devflow": devflow,
+                              "stage_breakdown": _stage_breakdown_since(
+                                  stage0, wall_s,
+                                  max(one_sample.n_ops, 1))})
 
 
 def measure_dispatch_coalesce(*, n_requests: int = 8,
@@ -339,6 +369,7 @@ def measure_dispatch_coalesce(*, n_requests: int = 8,
     try:
         results = {}
         flows = {}
+        breakdowns = {}
         for mode in ("serial", "coalesced"):
             coalesced = mode == "coalesced"
             # warm compiles, then calibrate rounds per sample so the
@@ -351,11 +382,16 @@ def measure_dispatch_coalesce(*, n_requests: int = 8,
                 int(max(target_seconds / max(repeats, 1),
                         4.0 * rtt_s) / per_pass), 256))
             flow0 = g_devprof.snapshot()
+            stage0 = g_oplat.snapshot()
+            wall_t0 = time.perf_counter()
             results[mode] = repeat_measure(
                 make_sampler(coalesced, rounds),
                 repeats=repeats, warmup=warmup)
-            flows[mode] = _devflow_since(
-                flow0, rounds * n_requests * (repeats + warmup))
+            wall_s = time.perf_counter() - wall_t0
+            n_ops = rounds * n_requests * (repeats + warmup)
+            flows[mode] = _devflow_since(flow0, n_ops)
+            breakdowns[mode] = _stage_breakdown_since(stage0, wall_s,
+                                                      n_ops)
     finally:
         for name, v in saved.items():
             g_conf.rm_val(name) if v is None else g_conf.set_val(name, v)
@@ -368,7 +404,8 @@ def measure_dispatch_coalesce(*, n_requests: int = 8,
         rl = validate_reading(st["median"], EC_ENCODE_K8M4, platform,
                               kind, ndev)
         extra = {"n_requests": n_requests, "object_bytes": object_bytes,
-                 "platform": platform, "devflow": flows[mode]}
+                 "platform": platform, "devflow": flows[mode],
+                 "stage_breakdown": breakdowns[mode]}
         if mode == "coalesced":
             extra["serial_gibs"] = round(results["serial"]["median"], 4)
             extra["speedup"] = round(
@@ -492,6 +529,7 @@ def measure_ec_pipeline(*, n_requests: int = 64,
             for a, b in zip(piped, serial))
         results = {}
         flows = {}
+        breakdowns = {}
         occupancy = None
         for d in (1, depth):
             make_sampler(d, 1)()        # warm compiles
@@ -504,10 +542,19 @@ def measure_ec_pipeline(*, n_requests: int = 64,
             if d == depth:
                 occ0 = (occ_hist.axis0_sum, occ_hist.total_count)
             flow0 = g_devprof.snapshot()
+            stage0 = g_oplat.snapshot()
+            wall_t0 = time.perf_counter()
             results[d] = repeat_measure(make_sampler(d, rounds),
                                         repeats=repeats, warmup=warmup)
-            flows[d] = _devflow_since(
-                flow0, rounds * n_requests * (repeats + warmup))
+            wall_s = time.perf_counter() - wall_t0
+            n_ops = rounds * n_requests * (repeats + warmup)
+            flows[d] = _devflow_since(flow0, n_ops)
+            # the stage story --smoke tells in time units: depth-1's
+            # breakdown is device_call-dominated (every op demands its
+            # own flush), depth-8 grows a real batch_window share and
+            # its coverage approaches the achieved occupancy
+            breakdowns[d] = _stage_breakdown_since(stage0, wall_s,
+                                                   n_ops)
             if d == depth:
                 ds = occ_hist.axis0_sum - occ0[0]
                 dn = occ_hist.total_count - occ0[1]
@@ -525,7 +572,8 @@ def measure_ec_pipeline(*, n_requests: int = 64,
                               kind, ndev)
         extra = {"n_requests": n_requests, "object_bytes": object_bytes,
                  "pipeline_depth": d, "platform": platform,
-                 "devflow": flows[d]}
+                 "devflow": flows[d],
+                 "stage_breakdown": breakdowns[d]}
         if d == depth:
             extra["depth1_gibs"] = round(results[1]["median"], 4)
             extra["speedup"] = round(
@@ -568,6 +616,7 @@ def measure_traffic(*, n_clients: int = 8, ops_per_client: int = 32,
     if admission_max:
         g_conf.set_val("osd_op_queue_admission_max", admission_max)
     flow0 = g_devprof.snapshot()
+    stage0 = g_oplat.snapshot()
     try:
         res = run_traffic(cluster, TrafficSpec(
             pool="load", n_clients=n_clients,
@@ -590,6 +639,12 @@ def measure_traffic(*, n_clients: int = 8, ops_per_client: int = 32,
         roofline={"verdict": "unknown", "suspect": False},
         extra={"n_clients": n_clients, "total_ops": res.total_ops,
                "devflow": _devflow_since(flow0, max(res.completed, 1)),
+               # the op-path stage decomposition (admission -> queue
+               # tiers -> service -> fan-out -> reply) over the run;
+               # queued ops wait concurrently, so coverage can exceed 1
+               "stage_breakdown": _stage_breakdown_since(
+                   stage0, max(res.elapsed_s, 1e-9),
+                   max(res.completed, 1)),
                "completed": res.completed,
                "byte_exact": bool(res.byte_exact),
                "rounds": res.rounds,
@@ -759,11 +814,20 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
     mark("resolve_device warm")
     pc = bench_perf_counters()
     flow_dev0 = g_devprof.snapshot()
+    stage_dev0 = g_oplat.snapshot()
     t0 = time.perf_counter()
     outs = [fr.resolve_device(wd) for wd in wds]
+    t_issued = time.perf_counter()
     np.asarray(outs[-1][0][0, 0])
-    total = (time.perf_counter() - t0) * 1000
+    t_end = time.perf_counter()
+    total = (t_end - t0) * 1000
     devflow_dev = _devflow_since(flow_dev0, epochs)
+    # stage split of the sustained region: back-to-back dispatch
+    # (device_call) vs the one-element drain fetch (d2h)
+    g_oplat.record("bench", "device_call", (t_issued - t0) * 1e6)
+    g_oplat.record("bench", "d2h", (t_end - t_issued) * 1e6)
+    stage_bd_dev = _stage_breakdown_since(stage_dev0, t_end - t0,
+                                          epochs)
     pc.inc(l_bench_dispatches, len(wds))
     pc.inc(l_bench_fences)
     pc.tinc(l_bench_fence_time, total / 1000.0)
@@ -792,7 +856,8 @@ def measure_crush_remap(n_osds=1000, n_pgs=100_000, epochs=10,
                    "min": dev_ms, "max": dev_ms_raw},
             extra={"pgs": n_pgs, "n_osds": n_osds,
                    "raw_ms": round(dev_ms_raw, 4),
-                   "devflow": devflow_dev}))
+                   "devflow": devflow_dev,
+                   "stage_breakdown": stage_bd_dev}))
         metrics.append(make_metric(
             f"crush_remap{name_sfx}_wall", wall_ms, "ms", fenced=True,
             rtt_s=rtt_s, stats=wall_st,

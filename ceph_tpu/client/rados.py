@@ -29,6 +29,7 @@ from ..msg.messages import (
 from ..msg.kv import pack_kv as _pack_kv, pack_keys as _pack_keys, \
     unpack_kv as _unpack_kv
 from ..osdmap import OSDMap, ceph_stable_mod, pg_t
+from ..trace.oplat import stamp_client
 
 MAX_ATTEMPTS = 8
 
@@ -300,6 +301,11 @@ class RadosClient(Dispatcher):
                              snapc_seq=sc_seq, snapc_snaps=list(sc_snaps),
                              trace_id=trace_id,
                              parent_span_id=span_id)
+                # stage-latency ledger: the submit stamp opens the
+                # op's time ledger; the OSD's intake mark turns it
+                # into the client_flight stage (trace/oplat.py).  A
+                # resend is a fresh arrival and gets a fresh ledger.
+                stamp_client(msg, self.name)
                 self.messenger.send_message(msg, f"osd.{primary}")
                 self.network.pump()
             reply = self._replies.pop(tid, None)

@@ -304,6 +304,17 @@ class MiniCluster:
             "dispatch flush",
             lambda c, a: {"flushed": g_dispatcher.flush()},
             "flush every pending EC dispatch queue now")
+        from .trace import g_oplat, oplat_perf_counters
+        self.perf_collection.add(oplat_perf_counters())
+        asok.register(
+            "latency dump",
+            lambda c, a: g_oplat.dump(a.get("daemon", "")),
+            "stage-latency ledger: per-daemon per-stage time "
+            "attribution (count/total/share/p50/p99) for every op")
+        asok.register(
+            "latency reset",
+            lambda c, a: (g_oplat.reset(), {"reset": True})[1],
+            "zero the stage-latency ledger's histograms and counters")
         self.perf_collection.add(devprof_perf_counters())
         asok.register(
             "prof dump",

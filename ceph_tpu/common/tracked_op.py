@@ -24,10 +24,14 @@ class TrackedOp:
         self.events: List[Tuple[float, str]] = []
         self.completed_at: Optional[float] = None
         # observability hooks: the daemon's span for this op (set by the
-        # dispatch path when the tracer is on) and the flight-recorder
-        # entry pinning its span tree once the op proves slow
+        # dispatch path when the tracer is on), the flight-recorder
+        # entry pinning its span tree once the op proves slow, and the
+        # always-on stage-latency ledger (trace/oplat.py) — pinned by
+        # reference like the span objects, so a slow op's per-stage
+        # breakdown survives without re-running anything
         self.span = None
         self.flight = None
+        self.oplat = None
 
     def mark_event(self, event: str) -> None:
         self.events.append((self.tracker.now(), event))
@@ -131,6 +135,10 @@ class OpTracker:
             d = o.dump()
             if o.flight is not None:
                 d["span_tree"] = o.flight.tree()
+            if o.oplat is not None:
+                # which stage ate the budget — the always-on ledger is
+                # already complete, no re-run or tracing required
+                d["stage_ledger"] = o.oplat.dump()
             out.append(d)
         return {"ops": out}
 

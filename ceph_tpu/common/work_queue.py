@@ -32,8 +32,11 @@ enforcement stays a class-tier (WallMClockQueue) property.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from ..trace.oplat import mark_item
 
 # op classes (mClockOpClassQueue's osd_op_queue_mclock_* option groups)
 CLASS_CLIENT = "client"
@@ -362,11 +365,17 @@ class MClockQueue:
             under = [c for c in candidates if not self._at_limit(c)]
             pool = under or candidates
             best = min(pool, key=finish_tag)
+        # stage ledger: the class tier picked this class NOW; the lane
+        # pop below is the client tier's own arbitration (oplat stages
+        # class_queue / client_lane — host-side stamps only)
+        t_pick = time.perf_counter()
         item = self._queues[best].pop()
         self._size -= 1
         self._r_tags[best] = self._r_tags.get(best, 0.0) + 1.0
         self._w_tags[best] = self._w_tags.get(best, 0.0) + 1.0
         _note_class_dequeue(best)
+        mark_item(item, "class_queue", t_pick)
+        mark_item(item, "client_lane")
         return item
 
     def dump(self) -> Dict:
@@ -488,9 +497,12 @@ class WallMClockQueue:
         return None, nxt
 
     def _serve(self, c: str, now: float, reserved: bool):
+        t_pick = time.perf_counter()
         item = self._queues[c].pop()
         self._size -= 1
         _note_class_dequeue(c)
+        mark_item(item, "class_queue", t_pick)
+        mark_item(item, "client_lane")
         res, weight, lim = self.tags[c]
         if res > 0:
             # served work counts toward the floor whatever phase it

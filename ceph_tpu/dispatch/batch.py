@@ -20,6 +20,7 @@ so window=0 behavior is today's behavior by construction.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -34,7 +35,8 @@ class Request:
 
     __slots__ = ("kind", "sinfo", "ec_impl", "payload", "chunks", "need",
                  "want", "future", "parent_span", "trace_id", "nbytes",
-                 "n_stripes", "chunk_size", "enq_t", "batchable", "key")
+                 "n_stripes", "chunk_size", "enq_t", "batchable", "key",
+                 "ledger")
 
     def __init__(self, kind: str, sinfo, ec_impl, *, payload=None,
                  chunks=None, need=None, want=None):
@@ -60,6 +62,7 @@ class Request:
         self.enq_t = 0.0
         self.batchable = False
         self.key = None
+        self.ledger = None       # stage-latency ledger (trace/oplat)
 
 
 def _ecutil():
@@ -67,6 +70,16 @@ def _ecutil():
     # the osd package at module-load time would cycle with ec_backend
     from ..osd import ecutil
     return ecutil
+
+
+def _mark_device_call(reqs: List["Request"]) -> None:
+    """One batched codec call just returned: stamp every batchmate's
+    stage ledger (each op waited on the SAME call — per-op attribution,
+    like the batch_window stamp in scheduler._execute)."""
+    t = time.perf_counter()
+    for r in reqs:
+        if r.ledger is not None:
+            r.ledger.mark("device_call", t)
 
 
 def run_one(req: Request):
@@ -145,6 +158,7 @@ def _run_group_encode(reqs, bucket_c, leader, use_device):
     g_devprof.account_host_copy("dispatch.stack", stacked.nbytes)
     big = _pad_stripes(stacked, use_device)
     coding = leader.encode_batch(big)          # (S_total[, pad], m, Cb)
+    _mark_device_call(reqs)
     coding = np.asarray(coding)
     out: List[Dict[int, np.ndarray]] = []
     for r, (off, stripes) in zip(reqs, offsets):
@@ -177,6 +191,7 @@ def _run_group_decode(reqs, bucket_c, leader, use_device, kind):
     else:
         want_phys = list(reqs[0].need)
     got = leader.decode_batch(stacked, want_phys)
+    _mark_device_call(reqs)
     got = {i: np.asarray(b) for i, b in got.items()}
     out: List = []
     s0 = 0

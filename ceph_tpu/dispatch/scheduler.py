@@ -40,7 +40,9 @@ import numpy as np
 from ..common.config import g_conf
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder
 from ..fault import g_faults
-from ..trace import g_devprof, g_perf_histograms, g_tracer, occupancy_axes
+from ..trace import (g_devprof, g_oplat, g_perf_histograms, g_tracer,
+                     occupancy_axes)
+from ..trace.oplat import OpLedger
 from .batch import Request, run_group, run_one
 from .future import DispatchFuture
 from .signature import (KIND_DECODE, KIND_DECODE_CONCAT, KIND_ENCODE,
@@ -195,14 +197,37 @@ class DeviceDispatcher:
         pc.inc(l_dispatch_stripes, req.n_stripes)
         return pc
 
+    @staticmethod
+    def _req_ledger(req: Request) -> OpLedger:
+        """The stage ledger this request's device stages land on: the
+        submitting op's (contextvar, like the span capture) or a fresh
+        one homed on the ``dispatch`` daemon for op-less submitters
+        (bench drivers) — device stages are accounted either way.  An
+        op ledger also gets its ``op_service`` boundary stamped here:
+        the codec submit ends the op-thread service interval."""
+        led = g_oplat.current()
+        if led is None:
+            led = OpLedger("dispatch")
+        else:
+            led.mark("op_service")
+        req.ledger = led
+        return led
+
     def _run_inline(self, req: Request):
         """Exact passthrough: today's call, inline, no extra spans, no
         future machinery; errors propagate to the caller unchanged."""
         pc = self._account(req)
         pc.inc(l_dispatch_passthrough)
         self._hist.inc(1)
+        led = self._req_ledger(req)
         try:
-            return run_one(req)
+            # no collection window on the passthrough path, so no
+            # batch_window stage; ecutil stamps device_call when the
+            # codec returns, the d2h mark below closes the fetch
+            with g_oplat.activate(led):
+                out = run_one(req)
+            led.mark("d2h")
+            return out
         except Exception:
             pc.inc(l_dispatch_errors)
             raise
@@ -215,11 +240,15 @@ class DeviceDispatcher:
         req.trace_id = g_tracer.current_trace_id() if g_tracer.enabled \
             else 0
         pc = self._account(req)
+        led = self._req_ledger(req)
         if not self._queueable(req):
             pc.inc(l_dispatch_passthrough)
             self._hist.inc(1)
             try:
-                fut.set_result(run_one(req))
+                with g_oplat.activate(led):
+                    out = run_one(req)
+                led.mark("d2h")
+                fut.set_result(out)
             except Exception as e:
                 pc.inc(l_dispatch_errors)
                 fut.set_exception(e)
@@ -335,14 +364,27 @@ class DeviceDispatcher:
                 if ch is not None:
                     ch.tags["bytes"] = r.nbytes
                 children.append(ch)
+        # stage ledger: one flush boundary ends every batched request's
+        # batch-window wait (each op in the batch accrues the full
+        # window it spent collecting — per-op attribution, docstring of
+        # oplat.breakdown_since)
+        t_launch = time.perf_counter()
+        for r in reqs:
+            if r.ledger is not None:
+                r.ledger.mark("batch_window", t_launch)
         outcomes: List = []
         with g_tracer.activate(span), g_devprof.stage("dispatch.batch"):
             try:
                 if g_faults.site_armed("dispatch.batch"):
                     g_faults.check("dispatch.batch",
                                    ctx=str(reqs[0].key or reqs[0].kind))
-                outcomes = [(True, res)
-                            for res in run_group(reqs, bucket_c)]
+                # single-request groups execute via run_one -> ecutil,
+                # which stamps device_call on the CURRENT ledger;
+                # multi-request groups are stamped inside run_group
+                with g_oplat.activate(
+                        reqs[0].ledger if len(reqs) == 1 else None):
+                    outcomes = [(True, res)
+                                for res in run_group(reqs, bucket_c)]
             except Exception as batch_err:   # noqa: BLE001 — isolated
                 # fail-fast isolation: re-run each request alone so one
                 # bad request cannot poison its batchmates
@@ -358,10 +400,17 @@ class DeviceDispatcher:
                                             kind=r.kind,
                                             error=repr(batch_err))
                     try:
-                        outcomes.append((True, run_one(r)))
+                        with g_oplat.activate(r.ledger):
+                            outcomes.append((True, run_one(r)))
                     except Exception as e:   # noqa: BLE001 — per-req
                         pc.inc(l_dispatch_errors)
                         outcomes.append((False, e))
+        t_done = time.perf_counter()
+        for r in reqs:
+            if r.ledger is not None:
+                # outputs are host-materialized by the run: the d2h
+                # stage closes each request's device round trip
+                r.ledger.mark("d2h", t_done)
         for ch in children:
             g_tracer.finish(ch)
         g_tracer.finish(span)

@@ -32,7 +32,6 @@ assertable property, not a hope.
 """
 from __future__ import annotations
 
-import math
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -50,6 +49,7 @@ from ..msg.messages import (
     new_trace_id,
 )
 from ..trace import g_perf_histograms, latency_axes
+from ..trace.oplat import stamp_client
 
 # retryable resend caps: an op survives this many peering/silent-primary
 # rounds (throttle resends are budgeted separately — backpressure is
@@ -225,13 +225,18 @@ class SyntheticClient(RadosClient):
             self.mon.send_full_map(self.name)
             self._resend.append(op)
             return
-        self.messenger.send_message(MOSDOp(
+        msg = MOSDOp(
             tid=tid, pool=pgid[0], oid=op.oid, pgid=pgid,
             op=CEPH_OSD_OP_WRITEFULL if op.kind == "write"
             else CEPH_OSD_OP_READ,
             data=op.payload if op.kind == "write" else b"",
             epoch=self.osdmap.epoch,
-            trace_id=new_trace_id()), f"osd.{primary}")
+            trace_id=new_trace_id())
+        # stage-latency ledger submit stamp (trace/oplat.py): harness
+        # traffic decomposes like any client's — the OSD-side
+        # client_flight stage shows pump-cycle transit under load
+        stamp_client(msg, self.name)
+        self.messenger.send_message(msg, f"osd.{primary}")
 
     def collect_sends(self, round_no: int) -> List[PendingOp]:
         """This round's sends, IN ORDER (resends first — throttled /
@@ -328,25 +333,14 @@ class SyntheticClient(RadosClient):
 # ---- percentiles out of the PerfHistogram machinery ------------------------
 def hist_percentiles(hist, qs=(0.5, 0.99, 0.999)) -> Dict[str, float]:
     """{"p50": usec, ...} read from a 1D latency PerfHistogram's
-    cumulative axis (the same series Prometheus exports).  Each value
-    is the EXCLUSIVE upper bucket edge the quantile falls in; the
-    overflow bucket reports the last finite edge (a lower bound)."""
+    cumulative axis (the same series Prometheus exports).  The
+    quantile rule lives in trace.histogram.percentiles_from_counts —
+    one implementation shared with `latency dump` and the bench
+    stage_breakdown deltas, so the three surfaces cannot drift."""
+    from ..trace.histogram import decumulate, percentiles_from_counts
     pts = hist.cumulative_axis0()
-    total = pts[-1][1]
-    out: Dict[str, float] = {}
-    finite = [e for e, _c in pts if e != float("inf")]
-    for q in qs:
-        key = "p" + format(q * 100, "g").replace(".", "")
-        if total == 0:
-            out[key] = 0.0
-            continue
-        target = math.ceil(q * total)
-        for edge, cum in pts:
-            if cum >= target:
-                out[key] = edge if edge != float("inf") \
-                    else (finite[-1] if finite else 0.0)
-                break
-    return out
+    return percentiles_from_counts(decumulate(pts),
+                                   [e for e, _c in pts], qs)
 
 
 @dataclass

@@ -129,6 +129,11 @@ def encode_message(msg: M.Message) -> bytes:
         # omitted-when-default contract as parent_span_id — unthrottled
         # replies and the archived corpus encode byte-identically
         fields.pop("retry_after", None)
+    # the stage-latency ledger (trace/oplat.py) rides messages as an
+    # in-process annotation only: never on the wire, so real-TCP
+    # frames and the pinned corpus stay byte-identical (a receiver
+    # opens a fresh ledger at intake instead)
+    fields.pop("_oplat", None)
     if isinstance(msg, M.MOSDMap):
         from ..osdmap.encoding import incremental_to_dict
         fields["incrementals"] = [incremental_to_dict(i)

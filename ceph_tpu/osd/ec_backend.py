@@ -67,7 +67,7 @@ from ..msg import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply,
 )
-from ..trace import (g_devprof, g_perf_histograms, g_tracer,
+from ..trace import (g_devprof, g_oplat, g_perf_histograms, g_tracer,
                      latency_in_bytes_axes, pipeline_axes)
 from ..os_store import MemStore, Transaction, hobject_t
 from ..utils.crc32c import crc32c
@@ -227,6 +227,9 @@ class InflightWrite:
     sent_msgs: Dict[int, Tuple[int, object]] = field(default_factory=dict)
     last_send: float = 0.0
     resends: int = 0
+    # the submitting op's stage ledger (trace/oplat): the last shard
+    # ack stamps its ack_gather boundary
+    ledger: object = None
 
 
 @dataclass
@@ -253,6 +256,7 @@ class InflightRead:
     saw_eio: bool = False         # any non-ENOENT shard failure (crc etc.)
     raw: bool = False             # recovery mode: deliver raw shard chunks
     user_attrs: Dict[str, bytes] = field(default_factory=dict)
+    ledger: object = None         # see InflightWrite.ledger
 
 
 @dataclass
@@ -269,6 +273,8 @@ class RMWOp:
     # span active), so reading the thread-current span at start time
     # would trace contended ops — the slow ones — as orphans
     parent_span: object = None
+    # the op's stage ledger, captured at enqueue for the same reason
+    ledger: object = None
 
 
 @dataclass
@@ -280,6 +286,7 @@ class FullWriteOp:
     xattrs: Optional[Dict[str, bytes]] = None   # full user-attr replacement
     snapset_update: Optional[Tuple[str, bytes]] = None
     parent_span: object = None    # see RMWOp.parent_span
+    ledger: object = None         # see RMWOp.ledger
 
 
 @dataclass
@@ -298,6 +305,7 @@ class VectorOp:
     run: Callable
     meta_only: bool = False   # no body op: fetch attrs from one shard
     parent_span: object = None    # see RMWOp.parent_span
+    ledger: object = None         # see RMWOp.ledger
 
 
 class ECBackend:
@@ -469,6 +477,7 @@ class ECBackend:
             rounds += 1
         gen = self._interval_gen
         nbytes = len(data)
+        led = g_oplat.current()      # the op's stage ledger, if any
         t0 = time.perf_counter()
         sp = g_tracer.begin("ec_encode") if g_tracer.enabled else None
         if sp is not None:
@@ -503,7 +512,7 @@ class ECBackend:
             err = f.exception()      # resolved — never blocks here
             if err is not None:
                 pc.inc(l_pipeline_errors)
-            with g_tracer.activate(parent_span):
+            with g_tracer.activate(parent_span), g_oplat.activate(led):
                 if err is not None:
                     then(None, err)
                 else:
@@ -560,8 +569,10 @@ class ECBackend:
     def _start_op(self, op) -> None:
         # re-enter the submitting op's span context: head-of-queue ops
         # start inline under it anyway, but a QUEUED op starts from
-        # _op_done where no (or an unrelated) span is current
-        with g_tracer.activate(op.parent_span):
+        # _op_done where no (or an unrelated) span is current — the
+        # stage ledger re-anchors the same way
+        with g_tracer.activate(op.parent_span), \
+                g_oplat.activate(op.ledger):
             if isinstance(op, FullWriteOp):
                 self._start_full_write(op)
             elif isinstance(op, VectorOp):
@@ -584,7 +595,8 @@ class ECBackend:
         self._enqueue(oid, FullWriteOp(tid=tid, oid=oid, data=bytes(data),
                                        on_commit=on_commit, xattrs=xattrs,
                                        snapset_update=snapset_update,
-                                       parent_span=g_tracer.current()))
+                                       parent_span=g_tracer.current(),
+                                       ledger=g_oplat.current()))
         return tid
 
     def submit_vector(self, oid: str, run: Callable,
@@ -594,7 +606,8 @@ class ECBackend:
         tid = self.next_tid()
         self._enqueue(oid, VectorOp(tid=tid, oid=oid, run=run,
                                     meta_only=meta_only,
-                                    parent_span=g_tracer.current()))
+                                    parent_span=g_tracer.current(),
+                                    ledger=g_oplat.current()))
         return tid
 
     def _start_vector(self, op: VectorOp) -> None:
@@ -617,12 +630,16 @@ class ECBackend:
                 self._start_full_write(FullWriteOp(
                     tid=op.tid, oid=op.oid, data=bytes(body2),
                     on_commit=on_commit, xattrs=attrs2,
-                    parent_span=op.parent_span))
+                    parent_span=op.parent_span, ledger=op.ledger))
             elif kind == "attrs":
                 _, attrs2, on_commit, _omap = spec
-                self._fan_attrs(op.tid, op.oid, attrs2,
-                                lambda r: (on_commit(r),
-                                           self._op_done(op.oid)))
+                # have_state runs from a read-reply callback: re-anchor
+                # the op's ledger so the attr fan's fan_out/ack_gather
+                # stages attribute to it
+                with g_oplat.activate(op.ledger):
+                    self._fan_attrs(op.tid, op.oid, attrs2,
+                                    lambda r: (on_commit(r),
+                                               self._op_done(op.oid)))
             else:  # ("delete", fan_fn, on_commit)
                 _, fan_fn, on_commit = spec
                 self.extent_cache.clear(op.oid)
@@ -644,7 +661,8 @@ class ECBackend:
         shard without touching the body (a versioned, logged write).
         Only called at the head of the per-oid queue."""
         wr = InflightWrite(tid=tid, oid=oid, client_reply=on_commit,
-                           on_all_commit=lambda: on_commit(0))
+                           on_all_commit=lambda: on_commit(0),
+                           ledger=g_oplat.current())
         acting = self.pg.acting_shards()
         version = self.pg.next_version()
         for shard, osd in acting.items():
@@ -655,6 +673,8 @@ class ECBackend:
             wr.pending_shards.add(shard)
             wr.sent_msgs[shard] = (osd, msg)
             self.pg.send_to_osd(osd, msg)
+        if wr.ledger is not None:
+            wr.ledger.mark("fan_out")
         wr.last_send = self.pg.osd.now
         self.inflight_writes[tid] = wr
 
@@ -664,13 +684,15 @@ class ECBackend:
         tid = self.next_tid()
         self._enqueue(oid, RMWOp(tid=tid, oid=oid, data=bytes(data),
                                  offset=offset, on_commit=on_commit,
-                                 parent_span=g_tracer.current()))
+                                 parent_span=g_tracer.current(),
+                                 ledger=g_oplat.current()))
         return tid
 
     def _start_full_write(self, op: FullWriteOp) -> None:
         # reached both from _start_op and from a VectorOp's read
-        # callback, so re-anchor the span context here
-        with g_tracer.activate(op.parent_span):
+        # callback, so re-anchor the span + ledger context here
+        with g_tracer.activate(op.parent_span), \
+                g_oplat.activate(op.ledger):
             padded = self._pad(op.data)
 
             def have_shards(shards, err) -> None:
@@ -767,8 +789,10 @@ class ECBackend:
                       old_bytes: bytes) -> None:
         """Splice + re-encode the affected range in one device call, then
         fan chunk deltas (try_reads_to_commit, ECBackend.cc:1894).
-        Runs from a read-reply callback — re-anchor the span context."""
-        with g_tracer.activate(op.parent_span):
+        Runs from a read-reply callback — re-anchor the span and
+        ledger contexts."""
+        with g_tracer.activate(op.parent_span), \
+                g_oplat.activate(op.ledger):
             buf = bytearray(a1 - a0)
             buf[:len(old_bytes)] = old_bytes
             rel = op.offset - a0
@@ -808,7 +832,8 @@ class ECBackend:
                         snapset_update: Optional[Tuple[str, bytes]]
                         = None) -> None:
         wr = InflightWrite(tid=tid, oid=oid, client_reply=client_reply,
-                           on_all_commit=on_all_commit)
+                           on_all_commit=on_all_commit,
+                           ledger=g_oplat.current())
         acting = self.pg.acting_shards()
         # propagate the op's trace so shard OSDs open child spans
         # (the Message.h:254 slot riding every sub-op)
@@ -831,6 +856,9 @@ class ECBackend:
             # last stage of the write path's copy ledger: shard chunk
             # buffers materialized into per-shard sub-op messages
             g_devprof.account_host_copy("ec.subop_messages", msg_bytes)
+        if wr.ledger is not None:
+            # time ledger's counterpart: message build + send loop done
+            wr.ledger.mark("fan_out")
         wr.last_send = self.pg.osd.now
         self.inflight_writes[tid] = wr
 
@@ -993,6 +1021,10 @@ class ECBackend:
         wr.sent_msgs.pop(msg.shard, None)
         if not wr.pending_shards:
             del self.inflight_writes[msg.tid]
+            if wr.ledger is not None:
+                # the LAST shard ack closes the gather stage; the
+                # reply mark (osd.send_op_reply) is the next boundary
+                wr.ledger.mark("ack_gather")
             if wr.on_all_commit is not None:
                 wr.on_all_commit()
             else:
@@ -1105,7 +1137,8 @@ class ECBackend:
         avail = set(acting) - self.pg.missing_shards_for(oid)
         rd = InflightRead(tid=tid, oid=oid, on_done=on_done,
                           chunk_off=chunk_off, chunk_len=chunk_len,
-                          attrs_only=attrs_only, raw=raw)
+                          attrs_only=attrs_only, raw=raw,
+                          ledger=g_oplat.current())
         cur_trace = g_tracer.current_trace_id() if g_tracer.enabled else 0
         cur_span = g_tracer.current_span_id() if g_tracer.enabled else 0
         if attrs_only:
@@ -1120,6 +1153,8 @@ class ECBackend:
                 tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
                 attrs_only=True, trace_id=cur_trace,
                 parent_span_id=cur_span))
+            if rd.ledger is not None:
+                rd.ledger.mark("fan_out")
             return tid
         # want the *physical* positions of the data chunks (chunk_mapping
         # remaps logical->physical for lrc/shec layouts)
@@ -1138,6 +1173,10 @@ class ECBackend:
                                   parent_span_id=cur_span)
             rd.pending.add(shard)
             self.pg.send_to_osd(acting[shard], msg)
+        if rd.ledger is not None:
+            # a read round is a fan-out too: the sub-read sends close
+            # the stage; the last reply closes ack_gather
+            rd.ledger.mark("fan_out")
         self.inflight_reads[tid] = rd
         return tid
 
@@ -1220,6 +1259,8 @@ class ECBackend:
         if rd.pending:
             return
         del self.inflight_reads[msg.tid]
+        if rd.ledger is not None:
+            rd.ledger.mark("ack_gather")
         if rd.attrs_only:
             if rd.size >= 0:
                 rd.on_done(0, b"", rd.size, rd.user_attrs)
@@ -1251,10 +1292,14 @@ class ECBackend:
         arrays = {i: np.frombuffer(b, dtype=np.uint8)
                   for i, b in rd.chunks.items()}
         try:
-            data = self._decode_timed(
-                sum(len(b) for b in rd.chunks.values()),
-                g_dispatcher.decode_concat, self.sinfo, self.ec_impl,
-                arrays)
+            # the decode runs from the sub-read-reply dispatch context:
+            # re-anchor the op's ledger so its device stages attribute
+            # to the read that needed them
+            with g_oplat.activate(rd.ledger):
+                data = self._decode_timed(
+                    sum(len(b) for b in rd.chunks.values()),
+                    g_dispatcher.decode_concat, self.sinfo, self.ec_impl,
+                    arrays)
         except IOError:
             rd.on_done(-5, b"", rd.size, rd.user_attrs)
             return

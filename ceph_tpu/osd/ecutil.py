@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Sequence, Set
 import numpy as np
 
 from ..trace.devprof import g_devprof
+from ..trace.oplat import g_oplat
 from ..utils.crc32c import crc32c
 
 CHUNK_ALIGNMENT = 64
@@ -95,6 +96,10 @@ def encode(sinfo: stripe_info_t, ec_impl, data,
         # physical chunk directly
         stripes = buf.reshape(S, k, C)
         allc = ec_impl.encode_batch_full(stripes)     # (S, n, C)
+        # stage ledger: the codec call returned; the submitting op's
+        # d2h stage (stamped by the dispatcher) covers the slice-out
+        # and materialization below
+        g_oplat.checkpoint("device_call")
         out = {i: np.ascontiguousarray(allc[:, i, :]).reshape(-1)
                for i in want}
         g_devprof.account_host_copy(
@@ -103,6 +108,7 @@ def encode(sinfo: stripe_info_t, ec_impl, data,
     if hasattr(ec_impl, "encode_batch") and not ec_impl.get_chunk_mapping():
         stripes = buf.reshape(S, k, C)
         coding = ec_impl.encode_batch(stripes)        # (S, m, C)
+        g_oplat.checkpoint("device_call")
         out: Dict[int, np.ndarray] = {}
         for i in want:
             if i < k:
@@ -123,6 +129,9 @@ def encode(sinfo: stripe_info_t, ec_impl, data,
         for i, chunk in encoded.items():
             assert len(chunk) == C
             out_parts[i].append(chunk)
+    # host-only codec loop: the "device_call" stage is the codec call
+    # by definition, wherever it executes
+    g_oplat.checkpoint("device_call")
     out = {i: np.concatenate(parts) for i, parts in out_parts.items()}
     g_devprof.account_host_copy(
         "ecutil.shard_slice", sum(b.nbytes for b in out.values()))
@@ -150,6 +159,7 @@ def decode_concat(sinfo: stripe_info_t, ec_impl,
         # i lives at chunk_index(i) for mapped codes (lrc)
         want_phys = [ec_impl.chunk_index(i) for i in range(k)]
         got = ec_impl.decode_batch(chunks2d, want_phys)
+        g_oplat.checkpoint("device_call")
         data = np.stack([got[want_phys[i]] for i in range(k)],
                         axis=1)  # (S, k, C)
         return data.reshape(-1)
@@ -158,6 +168,7 @@ def decode_concat(sinfo: stripe_info_t, ec_impl,
         chunks = {i: b[s] for i, b in chunks2d.items()}
         outs.append(np.frombuffer(
             ec_impl.decode_concat(chunks), dtype=np.uint8))
+    g_oplat.checkpoint("device_call")
     return np.concatenate(outs)
 
 
@@ -176,6 +187,7 @@ def decode(sinfo: stripe_info_t, ec_impl,
                 for i, b in to_decode.items()}
     if hasattr(ec_impl, "decode_batch"):
         got = ec_impl.decode_batch(chunks2d, list(need))
+        g_oplat.checkpoint("device_call")
         return {i: np.ascontiguousarray(got[i]).reshape(-1) for i in need}
     out_parts: Dict[int, List[np.ndarray]] = {i: [] for i in need}
     for s in range(S):
@@ -183,6 +195,7 @@ def decode(sinfo: stripe_info_t, ec_impl,
         decoded = ec_impl.decode(set(need), chunks)
         for i in need:
             out_parts[i].append(decoded[i])
+    g_oplat.checkpoint("device_call")
     return {i: np.concatenate(parts) for i, parts in out_parts.items()}
 
 

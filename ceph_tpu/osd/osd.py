@@ -24,8 +24,9 @@ from ..common.work_queue import (
     CLASS_CLIENT, CLASS_SCRUB, ShardedOpWQ, l_qos_admission_rejections,
     l_qos_queue_depth, l_qos_throttle_events, qos_perf_counters,
 )
-from ..trace import (g_perf_histograms, g_tracer, latency_axes,
+from ..trace import (g_oplat, g_perf_histograms, g_tracer, latency_axes,
                      latency_in_bytes_axes)
+from ..trace.oplat import intake_ledger
 from ..crush.constants import CRUSH_ITEM_NONE
 from ..msg import (
     Dispatcher, MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
@@ -598,8 +599,12 @@ class OSD(Dispatcher):
         through the mClock arbiter — under bursts, QoS decides order.
         The client-tier dmClock lane is keyed by the sending entity
         (msg.src), so one abusive client cannot starve the rest."""
+        # stage ledger: adopt the client's submit stamp (client_flight)
+        # or open one here; the admission verdict is the next boundary
+        led = intake_ledger(msg, self.name)
         if not self._admit_op(msg):
             return
+        led.mark("admission")
         is_write = msg.op in ("write", "writefull", "append", "delete") \
             or any(o.op in ("write", "writefull", "append", "delete")
                    for o in msg.ops)
@@ -618,6 +623,11 @@ class OSD(Dispatcher):
                 f"osd_op:{msg.op or 'vector'}:{msg.oid}",
                 daemon=self.name, trace_id=msg.trace_id,
                 parent_id=msg.parent_span_id)
+            if led.span is None:
+                # no client-side root (tracing enabled after submit /
+                # TCP arrival): the stage ledger rides the OSD's span
+                led.span = op.span
+        op.oplat = led
         self._tracked[(msg.src, msg.tid)] = op
         self.op_wq.enqueue(msg.pgid, CLASS_CLIENT, ("op", msg),
                            client=msg.src)
@@ -677,11 +687,18 @@ class OSD(Dispatcher):
                         "client_queue_wait_latency_histogram",
                         latency_axes).inc(
                             (time.perf_counter() - t0) * 1e6)
+            led = getattr(msg, "_oplat", None)
+            if led is not None:
+                # op-thread start: the interval since the lane pop is
+                # the dequeue handoff (thread wakeup / shard transit)
+                led.mark("dequeue_handoff")
             if tracked is not None and tracked.span is not None:
-                with g_tracer.activate(tracked.span):
+                with g_tracer.activate(tracked.span), \
+                        g_oplat.activate(led):
                     pg.do_op(msg)
             else:
-                pg.do_op(msg)
+                with g_oplat.activate(led):
+                    pg.do_op(msg)
         elif kind == "scrub":
             item[1].start_scrub(deep=item[2] if len(item) > 2 else False)
         elif kind == "pipeline":
@@ -702,6 +719,13 @@ class OSD(Dispatcher):
         op = self._tracked.pop((dst, reply.tid), None)
         if op is not None:
             op.mark_event("commit_sent" if reply.result == 0 else "error")
+            led = getattr(op, "oplat", None)
+            if led is not None:
+                # the ledger's final boundary: everything since the
+                # last mark (ack gathering's tail, reply build) is the
+                # reply stage, and the op counts as fully accounted
+                led.mark("reply")
+                g_oplat.note_op()
             if op.span is not None:
                 g_tracer.finish(op.span)
             op.finish()

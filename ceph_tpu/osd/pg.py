@@ -48,6 +48,7 @@ from ..msg.messages import (
 )
 from ..msg.kv import pack_kv, unpack_keys, unpack_kv
 from ..common.dout import dlog
+from ..trace import g_oplat
 from ..os_store import Transaction, hobject_t
 from .ec_backend import ECBackend, SIZE_ATTR
 from .pg_log import (
@@ -114,6 +115,10 @@ class ReplicatedBackend:
                                    omap=omap, attr_only=attr_only,
                                    snapset_update=snapset_update)
             self.pg.send_to_osd(osd, msg)
+        # stage ledger: replicated fans are fire-and-forget, so the
+        # fan_out boundary (covering interpret + message build here)
+        # is the last stage before the reply mark (trace/oplat.py)
+        g_oplat.checkpoint("fan_out")
 
     def apply_write(self, msg, store) -> None:
         from .ec_backend import ECBackend, USER_ATTR_PREFIX

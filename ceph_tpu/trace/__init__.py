@@ -21,6 +21,8 @@ from .flight import FlightEntry, FlightRecorder, g_flight_recorder
 from .devprof import (DevFlowProfiler, devflow_delta,
                       devprof_perf_counters, g_devprof,
                       transfer_size_axes)
+from .oplat import (OpLedger, OpLatAccumulator, STAGES, g_oplat,
+                    oplat_perf_counters)
 
 __all__ = [
     "Span", "SpanCollector", "Tracer", "build_tree", "g_tracer",
@@ -30,4 +32,6 @@ __all__ = [
     "FlightEntry", "FlightRecorder", "g_flight_recorder",
     "DevFlowProfiler", "devflow_delta", "devprof_perf_counters",
     "g_devprof", "transfer_size_axes",
+    "OpLedger", "OpLatAccumulator", "STAGES", "g_oplat",
+    "oplat_perf_counters",
 ]

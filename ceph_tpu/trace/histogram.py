@@ -18,6 +18,7 @@ so the write path keeps them hot in production.
 """
 from __future__ import annotations
 
+import math
 import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -184,6 +185,44 @@ class PerfHistogramCollection:
 
 
 g_perf_histograms = PerfHistogramCollection()
+
+
+# ---- percentile helpers (shared by load.traffic and trace.oplat) ----------
+def decumulate(pts: List[Tuple[float, int]]) -> List[int]:
+    """Cumulative (edge, count) series -> raw per-bucket counts."""
+    counts, prev = [], 0
+    for _edge, cum in pts:
+        counts.append(cum - prev)
+        prev = cum
+    return counts
+
+
+def percentiles_from_counts(counts: List[int], edges: List[float],
+                            qs=(0.5, 0.99),
+                            suffix: str = "") -> Dict[str, float]:
+    """``{"p50<suffix>": edge, ...}`` over raw per-bucket counts: each
+    value is the EXCLUSIVE upper edge of the bucket the quantile falls
+    in; the overflow bucket reports the last finite edge (a lower
+    bound).  One implementation for every percentile consumer
+    (``latency dump``, the bench stage_breakdown deltas, the traffic
+    harness's per-client series) so the quantile rule cannot drift."""
+    total = sum(counts)
+    finite = [e for e in edges if e != float("inf")]
+    out: Dict[str, float] = {}
+    for q in qs:
+        key = "p" + format(q * 100, "g").replace(".", "") + suffix
+        if total <= 0:
+            out[key] = 0.0
+            continue
+        target = math.ceil(q * total)
+        cum = 0
+        for edge, cnt in zip(edges, counts):
+            cum += cnt
+            if cum >= target:
+                out[key] = edge if edge != float("inf") \
+                    else (finite[-1] if finite else 0.0)
+                break
+    return out
 
 
 # ---- standard axis shapes (the reference's l_osd histogram configs) ------

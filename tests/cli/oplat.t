@@ -1,0 +1,39 @@
+Stage-latency ledger admin CLI (`ceph daemon <who> latency
+dump|reset`), in the style of the reference's recorded src/test/cli
+transcripts: the zeroed ledger of a freshly restored cluster — the
+stage catalog is the contract — and the reset.
+
+  $ python -c "from ceph_tpu.cluster import MiniCluster; MiniCluster(n_osds=2).checkpoint('ck')"
+
+  $ ceph --cluster ck daemon osd.0 latency dump
+  {
+    "daemons": {},
+    "ops": 0,
+    "stage_catalog": [
+      "client_flight",
+      "admission",
+      "class_queue",
+      "client_lane",
+      "dequeue_handoff",
+      "op_service",
+      "batch_window",
+      "device_call",
+      "d2h",
+      "fan_out",
+      "ack_gather",
+      "reply"
+    ],
+    "stage_samples": 0
+  }
+
+  $ ceph --cluster ck daemon osd.0 latency reset
+  {
+    "reset": true
+  }
+
+(The populated per-daemon per-stage table of a live op — admission
+wait, mClock queue tiers, codec submit, device round trip, fan-out,
+ack gathering, reply — is asserted in-process by tests/test_oplat.py;
+booting an EC cluster inside a cram subprocess would re-compile the
+encode kernel outside the shared XLA cache and burn tier-1 wall
+budget for coverage that already exists.)

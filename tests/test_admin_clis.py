@@ -48,6 +48,17 @@ def test_prof_cli_cram(tmp_path):
     assert_cram(path, str(tmp_path))
 
 
+def test_oplat_cli_cram(tmp_path):
+    """`ceph daemon <who> latency dump|reset` replayed from a recorded
+    transcript (tests/cli/oplat.t): the zeroed stage-latency ledger of
+    a restored cluster (stage catalog pinned) and the reset — through
+    the same `ceph` shim as fault.t/prof.t (the populated per-stage
+    table is covered in-process by tests/test_oplat.py)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cli", "oplat.t")
+    assert_cram(path, str(tmp_path))
+
+
 def test_rgw_admin_flow(env, capsys):
     c, cl = env
     run = lambda *a: rgw_admin.run(c, cl, list(a))

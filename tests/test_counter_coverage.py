@@ -85,11 +85,21 @@ def test_every_histogram_family_is_exported(cluster_and_text):
 
 
 def test_known_new_families_covered_by_the_lint(cluster_and_text):
-    """Canary: the lint actually sees this PR's additions (devprof) —
-    if someone unregisters the logger the lint must not silently pass
-    on an empty set."""
+    """Canary: the lint actually sees the newest counter families
+    (devprof, oplat) — if someone unregisters a logger the lint must
+    not silently pass on an empty set."""
     c, _text = cluster_and_text
     assert "devprof" in c.perf_collection.dump()
+    assert "oplat" in c.perf_collection.dump()
     from ceph_tpu.trace import g_perf_histograms
+    from ceph_tpu.trace.oplat import stage_of_hist_name
     assert any(lg == "devprof" for (lg, _n), _h
                in g_perf_histograms.items())
+    # the fixture's write/read registered per-stage oplat families on
+    # the OSD daemons — so the generic histogram lint above is really
+    # covering the stage-latency ledger's exposition
+    oplat_stages = {stage_of_hist_name(n)
+                    for (_lg, n), _h in g_perf_histograms.items()
+                    if stage_of_hist_name(n)}
+    assert {"admission", "class_queue", "device_call", "reply"} <= \
+        oplat_stages, oplat_stages

@@ -204,6 +204,25 @@ def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
     assert calls["n"] == 0, "device-flow profiling added a device sync"
     g_devprof.sample_device_mem()
     assert calls["n"] == 0, "device-mem sampling added a device sync"
+    # oplat extension: the stage-latency ledger is ALWAYS on too
+    # (timestamp stamps at every handoff boundary) — it must have
+    # accounted a full untraced AND a full traced write while this
+    # counting fence saw zero added syncs, and a `latency dump` must
+    # not sync either
+    from ceph_tpu.trace import g_oplat
+    s0 = g_oplat.snapshot()
+    ops0 = g_oplat.dump()["ops"]
+    assert cl.write_full("trace", "o_staged", b"s" * 20000) == 0
+    g_tracer.enable()
+    assert cl.write_full("trace", "o_staged_traced", b"t" * 20000) == 0
+    g_tracer.enable(False)
+    bd = g_oplat.breakdown_since(s0, wall_s=1.0, n_ops=2)
+    for stage in ("admission", "class_queue", "device_call", "d2h",
+                  "fan_out", "ack_gather", "reply"):
+        assert bd["stages"].get(stage, {}).get("count", 0) >= 2, \
+            f"stage clock missed the {stage} boundary"
+    assert g_oplat.dump()["ops"] >= ops0 + 2
+    assert calls["n"] == 0, "stage-latency ledger added a device sync"
 
 
 def test_slow_op_span_tree_and_histogram_dump(clean_tracing):

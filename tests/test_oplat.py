@@ -1,0 +1,274 @@
+"""Stage-latency ledger (ceph_tpu/trace/oplat.py): per-stage time
+attribution for every op.
+
+Tier-1 coverage for the oplat PR's acceptance criteria: one traced EC
+write shows a complete monotone stage ledger; the always-on aggregate
+reconciles per op (stage sum == ledger wall); the mClock tiers stamp
+the queue stages; slow ops carry their breakdown in
+``dump_historic_slow_ops``; and the ``latency dump`` / ``latency
+reset`` admin surface serves shares and percentiles.
+"""
+import time
+
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.trace import STAGES, g_oplat, g_tracer
+from ceph_tpu.trace.oplat import (OpLedger, item_ledger, mark_item,
+                                  stage_of_hist_name, stamp_client)
+
+# the boundaries a default-config (window=0, depth=1) full EC write
+# crosses, in order — batch_window only exists with a collection window
+WRITE_STAGES_SYNC = [
+    "client_flight", "admission", "class_queue", "client_lane",
+    "dequeue_handoff", "op_service", "device_call", "d2h", "fan_out",
+    "ack_gather", "reply",
+]
+
+
+@pytest.fixture
+def clean_tracing():
+    yield
+    g_tracer.enable(False)
+    g_tracer.collector.clear()
+    g_conf.rm_val("op_complaint_time")
+    g_conf.rm_val("ec_pipeline_depth")
+    g_conf.rm_val("ec_dispatch_batch_window_us")
+    g_conf.rm_val("ec_dispatch_batch_max")
+
+
+def _boot():
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("lat", k=3, m=2, pg_num=8)
+    return c
+
+
+# ---- ledger primitives -----------------------------------------------------
+def test_ledger_marks_record_and_reconcile():
+    led = OpLedger("unit.oplat")
+    t = led.t0
+    for stage in ("admission", "class_queue", "reply"):
+        t += 0.001
+        led.mark(stage, t)
+    d = led.dump()
+    assert [s["stage"] for s in d["stages"]] == [
+        "admission", "class_queue", "reply"]
+    # stage sum reconciles with the ledger's wall exactly
+    assert sum(s["usec"] for s in d["stages"]) == \
+        pytest.approx(d["total_usec"], rel=1e-6)
+    ats = [s["at_usec"] for s in d["stages"]]
+    assert ats == sorted(ats)
+    # out-of-order stamps clamp to zero, never negative
+    led.mark("late", t - 0.5)
+    assert led.dump()["stages"][-1]["usec"] == 0.0
+
+
+def test_hist_name_roundtrip():
+    assert stage_of_hist_name("oplat_d2h_latency_histogram") == "d2h"
+    assert stage_of_hist_name("op_w_latency_in_bytes_histogram") is None
+
+
+def test_item_ledger_finds_op_messages():
+    class FakeMsg:
+        pass
+
+    msg = FakeMsg()
+    led = stamp_client(msg, "client.unit")
+    assert item_ledger(("op", msg)) is led
+    assert item_ledger(("scrub", object(), True)) is None
+    mark_item(("op", msg), "class_queue")
+    assert [s for s, _t, _dt in led.marks] == ["class_queue"]
+
+
+def test_mclock_tiers_stamp_queue_stages():
+    """Both class-queue tiers (virtual + wall clock) stamp the
+    class_queue/client_lane boundaries on dequeue."""
+    from ceph_tpu.common.work_queue import (CLASS_CLIENT, MClockQueue,
+                                            WallMClockQueue)
+
+    class FakeMsg:
+        pass
+
+    for q, deq in ((MClockQueue(), lambda q: q.dequeue()),
+                   (WallMClockQueue(), lambda q: q.dequeue()[0])):
+        msg = FakeMsg()
+        led = stamp_client(msg, "client.unit")
+        q.enqueue(CLASS_CLIENT, ("op", msg), client="client.unit")
+        item = deq(q)
+        assert item[1] is msg
+        assert [s for s, _t, _dt in led.marks] == ["class_queue",
+                                                   "client_lane"]
+
+
+# ---- acceptance: the traced EC write's complete monotone ledger ------------
+def test_traced_ec_write_full_stage_ledger(clean_tracing):
+    g_tracer.enable()
+    c = _boot()
+    cl = c.client()
+    assert cl.write_full("lat", "obj", b"z" * 20000) == 0
+    roots = [s for ring in g_tracer.collector._rings.values()
+             for s in ring if s.name.startswith("client_op:writefull")]
+    assert roots, "no client root span"
+    ledger = roots[-1].tags.get("stage_ledger")
+    assert ledger, "traced write carried no stage_ledger tag"
+    stages = [e["stage"] for e in ledger]
+    assert stages == WRITE_STAGES_SYNC
+    # every entry is a known stage, timestamps monotone, durations sane
+    assert set(stages) <= set(STAGES)
+    ts = [e["t"] for e in ledger]
+    assert ts == sorted(ts), "stage ledger not monotone"
+    assert all(e["usec"] >= 0 for e in ledger)
+    # the same ledger rides next to the copy ledger: one traced write
+    # shows where the bytes AND the microseconds went
+    tree_spans = g_tracer.collector.spans_for_trace(roots[-1].trace_id)
+    assert any("copy_ledger" in s.tags for s in tree_spans)
+
+
+def test_pipelined_write_adds_batch_window_stage(clean_tracing):
+    """At ec_pipeline_depth > 1 with a collection window open, the
+    ledger grows the batch_window stage between the codec submit and
+    the coalesced flush."""
+    g_tracer.enable()
+    c = _boot()
+    cl = c.client()
+    cl.write_full("lat", "warm", b"w" * 20000)
+    g_conf.set_val("ec_pipeline_depth", 8)
+    g_conf.set_val("ec_dispatch_batch_window_us", 100_000)
+    assert cl.write_full("lat", "piped", b"p" * 20000) == 0
+    roots = [s for ring in g_tracer.collector._rings.values()
+             for s in ring if s.name == "client_op:writefull:piped"]
+    stages = [e["stage"] for e in roots[-1].tags["stage_ledger"]]
+    i = stages.index
+    assert i("op_service") < i("batch_window") < i("device_call") \
+        < i("d2h") < i("fan_out") < i("ack_gather") < i("reply")
+
+
+def test_rmw_write_and_read_mark_their_rounds(clean_tracing):
+    """A partial EC write's ledger shows BOTH fan-out rounds (pre-read,
+    then the write fan) and a read's ledger shows the decode's device
+    stages after its gather — the ledger records boundaries in the
+    order the op crossed them."""
+    g_tracer.enable()
+    c = _boot()
+    cl = c.client()
+    assert cl.write_full("lat", "rmw", b"a" * 20000) == 0
+    assert cl.write("lat", "rmw", b"B" * 100, offset=7) == 0
+    roots = [s for ring in g_tracer.collector._rings.values()
+             for s in ring if s.name == "client_op:write:rmw"]
+    stages = [e["stage"] for e in roots[-1].tags["stage_ledger"]]
+    assert stages.count("fan_out") == 2, stages
+    assert stages.count("ack_gather") == 2, stages
+    assert stages[-1] == "reply"
+    # read: sub-read fan + gather precede the decode's device stages
+    assert cl.read("lat", "rmw")[:8] == b"a" * 7 + b"B"
+    roots = [s for ring in g_tracer.collector._rings.values()
+             for s in ring if s.name == "client_op:read:rmw"]
+    stages = [e["stage"] for e in roots[-1].tags["stage_ledger"]]
+    i = stages.index
+    assert i("fan_out") < i("ack_gather") < i("device_call") \
+        < i("reply")
+
+
+# ---- always-on aggregate ----------------------------------------------------
+def test_untraced_write_accounts_stages(clean_tracing):
+    """Tracing OFF (the default), the aggregate still attributes every
+    op's stages — the ledger is always-on like perf counters."""
+    c = _boot()
+    cl = c.client()
+    before = g_oplat.snapshot()
+    ops_before = g_oplat.dump()["ops"]
+    assert cl.write_full("lat", "dark", b"d" * 20000) == 0
+    bd = g_oplat.breakdown_since(before, wall_s=1.0, n_ops=1)
+    assert set(WRITE_STAGES_SYNC) <= set(bd["stages"])
+    for st in bd["stages"].values():
+        assert st["count"] >= 1
+    assert g_oplat.dump()["ops"] == ops_before + 1
+
+
+def test_latency_dump_shape_and_reset(clean_tracing):
+    c = _boot()
+    cl = c.client()
+    assert cl.write_full("lat", "o", b"x" * 20000) == 0
+    d = c.admin_socket.execute("latency dump")
+    assert d["stage_catalog"] == list(STAGES)
+    assert d["ops"] >= 1 and d["stage_samples"] >= len(WRITE_STAGES_SYNC)
+    osd_daemons = {k: v for k, v in d["daemons"].items()
+                   if k.startswith("osd.")}
+    assert osd_daemons, "no OSD recorded stage latencies"
+    for dm in osd_daemons.values():
+        shares = [st["share"] for st in dm["stages"].values()]
+        assert sum(shares) == pytest.approx(1.0, abs=0.01)
+        for st in dm["stages"].values():
+            assert st["p50_usec"] <= st["p99_usec"]
+            assert st["total_usec"] >= 0
+    # daemon filter
+    one = next(iter(osd_daemons))
+    filtered = c.admin_socket.execute("latency dump", {"daemon": one})
+    assert set(filtered["daemons"]) == {one}
+    # reset zeroes the oplat families and counters, nothing else
+    out = c.admin_socket.execute("latency reset")
+    assert out == {"reset": True}
+    d2 = c.admin_socket.execute("latency dump")
+    assert d2["daemons"] == {} and d2["ops"] == 0
+    # non-oplat histograms survived the reset
+    hd = c.admin_socket.execute("perf histogram dump")
+    assert any(v.get("op_w_latency_in_bytes_histogram", {}).get("count")
+               for v in hd.values())
+
+
+def test_slow_op_carries_stage_breakdown(clean_tracing):
+    """Satellite: dump_historic_slow_ops entries show which stage ate
+    the budget WITHOUT tracing enabled and without re-running."""
+    g_conf.set_val("op_complaint_time", -1.0)     # every op is "slow"
+    c = _boot()
+    cl = c.client()
+    assert cl.write_full("lat", "slow", b"s" * 20000) == 0
+    slow = c.admin_socket.execute("dump_historic_slow_ops")
+    ledgers = [op["stage_ledger"] for d in slow.values()
+               for op in d["ops"]
+               if op["description"].startswith("osd_op(writefull")
+               and "stage_ledger" in op]
+    assert ledgers, "slow op carried no stage_ledger"
+    led = ledgers[0]
+    stages = [s["stage"] for s in led["stages"]]
+    assert stages == WRITE_STAGES_SYNC
+    assert sum(s["usec"] for s in led["stages"]) == \
+        pytest.approx(led["total_usec"], rel=0.01)
+
+
+def test_breakdown_since_percentiles_and_coverage():
+    """Unit: the bench's delta breakdown — sums, shares, percentiles
+    from bucket deltas, and the coverage receipt."""
+    base = g_oplat.snapshot()
+    for _ in range(100):
+        g_oplat.record("unit.bd", "device_call", 150.0)
+    g_oplat.record("unit.bd", "d2h", 850.0)
+    bd = g_oplat.breakdown_since(base, wall_s=(100 * 150.0 + 850.0)
+                                 / 1e6, n_ops=100)
+    assert bd["coverage"] == pytest.approx(1.0, abs=0.01)
+    dc = bd["stages"]["device_call"]
+    assert dc["count"] == 100
+    assert dc["usec_per_op"] == pytest.approx(150.0, rel=0.01)
+    # log2 usec axis: 150 usec lands in the (100, 200] bucket
+    assert dc["p50_usec"] == 200.0
+    assert dc["p99_usec"] == 200.0
+    shares = [s["share"] for s in bd["stages"].values()]
+    assert sum(shares) == pytest.approx(1.0, abs=0.01)
+
+
+def test_wall_reconciliation_end_to_end(clean_tracing):
+    """Acceptance: a serial region's stage sum reconciles with its
+    measured wall — one client, synchronous writes, coverage near 1
+    (everything the client waited on is some op's attributed stage,
+    modulo client-side bookkeeping between ops)."""
+    c = _boot()
+    cl = c.client()
+    cl.write_full("lat", "warm", b"w" * 20000)    # compile outside
+    before = g_oplat.snapshot()
+    t0 = time.perf_counter()
+    for i in range(4):
+        assert cl.write_full("lat", f"w{i}", b"x" * 20000) == 0
+    wall = time.perf_counter() - t0
+    bd = g_oplat.breakdown_since(before, wall, n_ops=4)
+    assert 0.5 <= bd["coverage"] <= 1.1, bd

@@ -244,6 +244,47 @@ def test_devprof_families_and_counters(exposition):
         assert name in vals, f"{name} missing"
 
 
+def test_oplat_families_and_agreement(exposition):
+    """Oplat-PR golden coverage: the per-stage latency families render
+    as real histogram families keyed by the daemon label (cumulative
+    monotone buckets and +Inf == _count are enforced for every family
+    by the generic test above), the usec axis exports as seconds, the
+    oplat counters render on the daemon surface, and the exposition
+    agrees with `perf histogram dump` / `latency dump` counts."""
+    from ceph_tpu.trace import g_perf_histograms
+    from ceph_tpu.trace.oplat import stage_hist_name
+    types, samples = _parse(exposition)
+    # every op the fixture issued crossed these stages (writes and the
+    # read alike; batch_window is pipelined-only so it may have fewer)
+    for stage in ("admission", "class_queue", "client_lane",
+                  "dequeue_handoff", "op_service", "device_call",
+                  "d2h", "fan_out", "ack_gather", "reply"):
+        fam = f"ceph_{stage_hist_name(stage)}"
+        assert types.get(fam) == "histogram", f"{fam} missing"
+        counts = [(labels, v) for n, labels, v in samples
+                  if n == f"{fam}_count"]
+        assert counts, f"{fam}: no _count series"
+        assert sum(v for _l, v in counts) >= 4, (fam, counts)
+        # latency axis is usec: bucket edges export scaled to seconds
+        les = sorted(_le_of(labels) for n, labels, v in samples
+                     if n == f"{fam}_bucket" and _le_of(labels)
+                     != math.inf)
+        assert les[0] == 0.0 and 0.0001 in les, (fam, les[:4])
+        # dump/exposition agreement per daemon series
+        for labels, v in counts:
+            m = re.search(r'daemon="([^"]+)"', labels)
+            hits = [h for (lg, n), h in g_perf_histograms.items()
+                    if n == stage_hist_name(stage)
+                    and re.sub(r"[^a-zA-Z0-9_:]", "_", lg)
+                    == m.group(1)]
+            assert hits and hits[0].total_count == v, \
+                f"{fam}{labels}: exposition disagrees with dump"
+    # counter families on the daemon surface
+    vals = {n: v for n, _l, v in samples}
+    assert vals.get("ceph_daemon_oplat_ops", 0) >= 4
+    assert vals.get("ceph_daemon_oplat_stage_samples", 0) >= 40
+
+
 def test_op_histograms_carry_the_writes(exposition):
     """The two writes + one read issued by the fixture are visible in
     some OSD's latency histograms (non-zero _count)."""
