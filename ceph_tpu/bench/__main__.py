@@ -126,6 +126,10 @@ def main(argv=None) -> int:
                  f"{mt['n_clients']} clients ({mt['total_ops']} ops, "
                  f"byte_exact {mt['byte_exact']}, agg p99 "
                  f"{mt['aggregate'].get('p99')}us)")
+        roll = mt["cluster_rollup"]
+        progress(f"cluster rollup: reply p99 "
+                 f"{roll['oplat_p99_usec'].get('reply')}us, "
+                 f"{roll['rates'].get('ops')} ops/s, slo {roll['slo']}")
         host = measure_host_native(matrix, batch[0],
                                    target_seconds=0.3 if args.smoke
                                    else 1.5)

@@ -613,8 +613,15 @@ def measure_traffic(*, n_clients: int = 8, ops_per_client: int = 32,
     cluster = MiniCluster(n_osds=n_osds)
     cluster.create_replicated_pool("load", size=3, pg_num=pg_num)
     saved = g_conf.values.get("osd_op_queue_admission_max")
+    saved_ret = g_conf.values.get("mgr_telemetry_retention")
     if admission_max:
         g_conf.set_val("osd_op_queue_admission_max", admission_max)
+    # the whole-run rollup below needs the mgr's boot baseline sample
+    # to SURVIVE the run's tick count — ring eviction would silently
+    # truncate the "whole-run" window to its tail and under-report the
+    # wall rates.  10k samples covers any max_rounds/tick_every shape
+    # the harness can produce (one sample per cluster tick).
+    g_conf.set_val("mgr_telemetry_retention", 10_000)
     flow0 = g_devprof.snapshot()
     stage0 = g_oplat.snapshot()
     try:
@@ -624,14 +631,44 @@ def measure_traffic(*, n_clients: int = 8, ops_per_client: int = 32,
             mode=mode, rate_multipliers=tuple(rate_multipliers),
             seed=seed, keep_completions=keep_completions),
             progress=progress)
+        # end-of-run cluster rollup (mgr/telemetry.py): the window
+        # spans the whole run — the boot-time baseline isolates this
+        # cluster's deltas from earlier workloads' process-global
+        # counts — so harness A/B comparisons (mesh dispatch,
+        # zero-copy) read ONE cluster tail number per stage instead
+        # of N per-daemon dumps
+        wall_run_s = max(res.elapsed_s, 1e-3)
+        cluster.clock += wall_run_s
+        cluster.mgr.telemetry.tick(cluster.mgr, cluster.clock)
+        roll = cluster.mgr.telemetry.rollup(
+            window_s=cluster.clock + 1.0)
     finally:
         if admission_max:
             if saved is None:
                 g_conf.rm_val("osd_op_queue_admission_max")
             else:
                 g_conf.set_val("osd_op_queue_admission_max", saved)
+        if saved_ret is None:
+            g_conf.rm_val("mgr_telemetry_retention")
+        else:
+            g_conf.set_val("mgr_telemetry_retention", saved_ret)
     pc = bench_perf_counters()
     pc.inc(l_bench_bytes, res.bytes_moved)
+    # the rollup window's dt mixes run_traffic's simulated tick
+    # seconds with the final wall bump; rescale to WALL rates so the
+    # A/B number is a real throughput figure (rate * span = the
+    # window's counter delta, so this is exact, not a guess)
+    wall_rates = {k: round(v * roll["span_s"] / wall_run_s, 4)
+                  for k, v in roll["rates"].items()}
+    cluster_rollup = {
+        "oplat_p99_usec": roll["oplat_p99_usec"],
+        "rates": wall_rates,
+        "copies_per_op": roll["copies_per_op"],
+        "slo": {check: st["state"]
+                for check, st in roll["slo"].items()},
+        "samples": roll["samples"],
+        "span_s": roll["span_s"],
+    }
     v = max(res.ops_per_sec, 1e-6)
     return make_metric(
         name, v, "ops/s", fenced=True,
@@ -645,6 +682,7 @@ def measure_traffic(*, n_clients: int = 8, ops_per_client: int = 32,
                "stage_breakdown": _stage_breakdown_since(
                    stage0, max(res.elapsed_s, 1e-9),
                    max(res.completed, 1)),
+               "cluster_rollup": cluster_rollup,
                "completed": res.completed,
                "byte_exact": bool(res.byte_exact),
                "rounds": res.rounds,

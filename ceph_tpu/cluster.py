@@ -373,6 +373,21 @@ class MiniCluster:
             lambda c, a: g_breakers.dump(),
             "per-codec-signature circuit breaker states")
         asok.register(
+            "tpu status", lambda c, a: self.tpu_status(),
+            "single-pane cluster status: health, cluster-merged "
+            "per-stage p99s, rates, open breakers, SLO state")
+        asok.register(
+            "telemetry dump",
+            lambda c, a: self.mgr.telemetry.dump(),
+            "mgr telemetry rollup: cluster-merged family percentiles, "
+            "rates and SLO burn state over the fast window")
+        asok.register(
+            "telemetry reset",
+            lambda c, a: (self.mgr.telemetry.reset(),
+                          {"reset": True})[1],
+            "drop the telemetry rings and SLO streaks (per-daemon "
+            "histograms/counters untouched)")
+        asok.register(
             "arch probe",
             lambda c, a: __import__("ceph_tpu.arch", fromlist=["probe"])
             .probe(),
@@ -437,7 +452,7 @@ class MiniCluster:
                 if osd.name not in self.network.down:
                     osd.tick(self.clock)
             self.network.pump()
-            self.mgr.tick()
+            self.mgr.tick(self.clock)
         self.run_recovery()
 
     # ---- mon thrashing ------------------------------------------------------
@@ -544,6 +559,34 @@ class MiniCluster:
                     continue
                 seen.add(pgid)
                 yield pgid, pg
+
+    def tpu_status(self) -> Dict:
+        """The ``tpu status`` single pane (admin socket / ``ceph
+        daemon``): one answer to "is the fleet inside its latency
+        budget right now" — health (TPU_SLO_* checks included), the
+        cluster-merged per-stage p99s, rates, open circuit breakers
+        and SLO burn state, all from the mgr telemetry rollup's
+        shared snapshot (telemetry.rollup) so this pane, ``telemetry
+        dump`` and the Prometheus scrape cannot disagree."""
+        from .fault import g_breakers
+        tel = self.mgr.telemetry
+        # freshen if the clock moved since the last mgr tick (a stale
+        # or equal clock is a no-op, so this never skews rate windows)
+        tel.tick(self.mgr, self.clock)
+        roll = tel.rollup()
+        return {
+            "health": self.health(),
+            "samples": roll["samples"],
+            "window_s": roll["window_s"],
+            "cluster_p99_usec": roll["oplat_p99_usec"],
+            "rates": roll["rates"],
+            "copies_per_op": roll["copies_per_op"],
+            "breakers_open": ["/".join(d["signature"][:4])
+                              for d in g_breakers.degraded()],
+            "slo": {check: st["state"]
+                    for check, st in roll["slo"].items()},
+            "objectives": roll["objectives"],
+        }
 
     def health(self) -> str:
         """HEALTH_OK / HEALTH_WARN with reasons (mon health checks):

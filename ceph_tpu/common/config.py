@@ -208,6 +208,47 @@ def build_options() -> List[Option]:
         Option("mon_osd_nearfull_ratio", OPT_FLOAT).set_default(0.85)
         .set_description("OSD fill ratio raising the NEARFULL health "
                          "warning (mon_osd_nearfull_ratio)"),
+        Option("mgr_telemetry_retention", OPT_INT).set_default(360)
+        .set_description("samples kept in the mgr telemetry rollup's "
+                         "time-series rings (one sample per mgr tick; "
+                         "keep >= mgr_slo_slow_window_s / tick dt or "
+                         "the slow burn window silently truncates to "
+                         "the ring span; docs/OBSERVABILITY.md "
+                         "cluster rollup)"),
+        Option("mgr_slo_fast_window_s", OPT_FLOAT).set_default(30.0)
+        .set_description("fast SLO burn-rate window (seconds of the "
+                         "cluster clock) — the responsive window a "
+                         "breach must sustain in before a TPU_SLO_* "
+                         "health check raises"),
+        Option("mgr_slo_slow_window_s", OPT_FLOAT).set_default(300.0)
+        .set_description("slow SLO burn-rate window (seconds) — the "
+                         "confirming window; a spike that breaches "
+                         "the fast window but dilutes below the "
+                         "objective here never raises"),
+        Option("mgr_slo_sustain_ticks", OPT_INT).set_default(2)
+        .set_description("consecutive mgr ticks the fast-window burn "
+                         "must breach before a TPU_SLO_* check "
+                         "raises (a single-tick spike never flaps it)"),
+        Option("mgr_slo_clear_ticks", OPT_INT).set_default(2)
+        .set_description("consecutive clean mgr ticks before an "
+                         "active TPU_SLO_* check clears (hysteresis)"),
+        Option("mgr_slo_oplat_p99_usec", OPT_STR).set_default("")
+        .set_description("per-stage cluster-p99 latency objectives, "
+                         "'stage:usec[,stage:usec]' over the oplat "
+                         "stage catalog (e.g. 'device_call:50000,"
+                         "class_queue:100000'); breaching raises "
+                         "TPU_SLO_OPLAT.  Empty = disabled"),
+        Option("mgr_slo_copies_per_op_max", OPT_FLOAT).set_default(0.0)
+        .set_description("cluster copies-per-op ceiling (devprof "
+                         "transfers + host copies over completed "
+                         "ops); breaching raises TPU_SLO_COPY — the "
+                         "bench copy budget as live health.  0 = "
+                         "disabled"),
+        Option("mgr_slo_admission_rate_max", OPT_FLOAT).set_default(0.0)
+        .set_description("admission-control rejection-rate ceiling "
+                         "(rejections per second of the cluster "
+                         "clock); breaching raises TPU_SLO_ADMISSION."
+                         "  0 = disabled"),
         Option("tracing_kernels", OPT_BOOL).set_default(False)
         .set_description("time every device kernel dispatch (adds a "
                          "sync per call; diagnosis only)"),

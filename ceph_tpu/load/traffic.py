@@ -331,16 +331,12 @@ class SyntheticClient(RadosClient):
 
 
 # ---- percentiles out of the PerfHistogram machinery ------------------------
-def hist_percentiles(hist, qs=(0.5, 0.99, 0.999)) -> Dict[str, float]:
-    """{"p50": usec, ...} read from a 1D latency PerfHistogram's
-    cumulative axis (the same series Prometheus exports).  The
-    quantile rule lives in trace.histogram.percentiles_from_counts —
-    one implementation shared with `latency dump` and the bench
-    stage_breakdown deltas, so the three surfaces cannot drift."""
-    from ..trace.histogram import decumulate, percentiles_from_counts
-    pts = hist.cumulative_axis0()
-    return percentiles_from_counts(decumulate(pts),
-                                   [e for e, _c in pts], qs)
+# ONE percentile implementation for every consumer: the quantile rule
+# lives in trace.histogram (hist_percentiles / merged_percentiles,
+# shared with `latency dump`, the bench stage_breakdown deltas and the
+# mgr telemetry rollup's cluster merge) and is re-exported here for the
+# harness's historical import path.
+from ..trace.histogram import hist_percentiles, merged_percentiles  # noqa: E402
 
 
 @dataclass
@@ -444,16 +440,8 @@ def run_traffic(cluster, spec: TrafficSpec,
                       and res.completed == res.total_ops
                       and res.total_ops
                       == spec.n_clients * spec.ops_per_client)
-    # aggregate percentiles over the merged per-client distributions
-    # (same machinery: sum the clients' cumulative series)
-    merged: Dict[float, int] = {}
-    for cl in clients:
-        for edge, cum in cl.hist.cumulative_axis0():
-            merged[edge] = merged.get(edge, 0) + cum
-
-    class _Agg:
-        def cumulative_axis0(self):
-            return sorted(merged.items())
-
-    res.aggregate = hist_percentiles(_Agg())
+    # aggregate percentiles over the union of the per-client
+    # distributions — the telemetry rollup's merge core (same edges,
+    # so the cluster tail is exact, not an approximation)
+    res.aggregate = merged_percentiles([cl.hist for cl in clients])
     return res

@@ -52,6 +52,12 @@ class Manager(Dispatcher):
         self.osd_stats: Dict[int, tuple] = {}
         self.autoscaler_active = False
         self.health_checks: Dict[str, str] = {}
+        # cluster telemetry rollup + SLO burn-rate engine
+        # (telemetry.py); the boot-time baseline sample makes every
+        # window "since this cluster booted" until the ring spans it
+        from .telemetry import Telemetry
+        self.telemetry = Telemetry()
+        self.telemetry.collect(0.0)
         for m in (all_mons if all_mons is not None else [self.mon]):
             m.subscribe(name)
         self.mon.send_full_map(name)
@@ -116,14 +122,21 @@ class Manager(Dispatcher):
             self.network.pump()
         return before, after
 
-    def tick(self) -> None:
-        """Periodic module work (the mgr's serve loops)."""
+    def tick(self, now: Optional[float] = None) -> None:
+        """Periodic module work (the mgr's serve loops).  *now* is the
+        cluster's deterministic clock (MiniCluster.tick passes it);
+        None self-advances the telemetry clock one second per tick."""
         if self.balancer_active:
             self.balancer_optimize()
         if self.autoscaler_active:
             self.pg_autoscale(apply=True)
         self.check_quotas_and_fullness()
         self.check_degraded_codecs()
+        # cluster rollup collection + SLO burn-rate evaluation — pure
+        # host-side histogram/counter reads, zero added device syncs
+        # (the fence-count test in tests/test_observability.py covers
+        # this tick)
+        self.telemetry.tick(self, now)
 
     # ---- codec degradation (circuit-breaker board -> health) ---------------
     def check_degraded_codecs(self) -> None:
@@ -384,6 +397,10 @@ class Manager(Dispatcher):
                     sig = self._prom_name("_".join(d["signature"][:4]))
                     lines.append(f'ceph_tpu_codec_breaker_open'
                                  f'{{signature="{sig}"}} 1')
+        # the ceph_cluster_* families render from the SAME rollup
+        # snapshot function `telemetry dump` and `tpu status` serve
+        # (telemetry.rollup), so the scrape surfaces cannot drift
+        lines.extend(self._render_cluster_rollup(self.telemetry))
         if perf_collection is not None:
             dump = perf_collection.dump()
             for logger, counters in sorted(dump.items()):
@@ -426,6 +443,45 @@ class Manager(Dispatcher):
                 lines.append(f'ceph_daemon_slow_ops'
                              f'{{daemon="{self._prom_name(daemon)}"}} {n}')
         return "\n".join(lines) + "\n"
+
+    def _render_cluster_rollup(self, telemetry) -> List[str]:
+        """The ``ceph_cluster_*`` families: per-stage cluster
+        percentiles + rates out of THE shared rollup snapshot
+        (telemetry.rollup — the same function ``telemetry dump`` and
+        ``tpu status`` render from, so the surfaces cannot drift).
+        SLO breach state itself rides ``ceph_health_check`` via
+        ``health_checks`` like every other check; the burn-rate
+        gauges here carry the continuous signal."""
+        roll = telemetry.rollup()
+        out: List[str] = []
+        for q in ("p50", "p99", "p999"):
+            fam = f"ceph_cluster_oplat_{q}_usec"
+            out.append(f"# HELP {fam} cluster-merged oplat stage "
+                       f"{q} (union of every daemon's buckets, "
+                       f"rollup window)")
+            out.append(f"# TYPE {fam} gauge")
+            for stage in sorted(roll["oplat"]):
+                out.append(f'{fam}{{stage='
+                           f'"{self._prom_name(stage)}"}} '
+                           f'{roll["oplat"][stage][q]}')
+        for key in sorted(roll["rates"]):
+            fam = f"ceph_cluster_rate_{self._prom_name(key)}"
+            out.append(f"# HELP {fam} cluster {key} per second over "
+                       f"the rollup window")
+            out.append(f"# TYPE {fam} gauge")
+            out.append(f"{fam} {roll['rates'][key]}")
+        slo = roll.get("slo", {})
+        if slo:
+            out.append("# HELP ceph_cluster_slo_burn SLO burn rate "
+                       "(observed/objective) per check and window")
+            out.append("# TYPE ceph_cluster_slo_burn gauge")
+            for check in sorted(slo):
+                c = self._prom_name(check)
+                out.append(f'ceph_cluster_slo_burn{{check="{c}",'
+                           f'window="fast"}} {slo[check]["burn_fast"]}')
+                out.append(f'ceph_cluster_slo_burn{{check="{c}",'
+                           f'window="slow"}} {slo[check]["burn_slow"]}')
+        return out
 
     def _render_histograms(self, histograms) -> List[str]:
         """One Prometheus histogram family per histogram NAME, a series

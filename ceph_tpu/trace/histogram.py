@@ -225,6 +225,51 @@ def percentiles_from_counts(counts: List[int], edges: List[float],
     return out
 
 
+def hist_percentiles(hist, qs=(0.5, 0.99, 0.999)) -> Dict[str, float]:
+    """``{"p50": value, ...}`` read from anything exposing the
+    ``cumulative_axis0()`` series shape (a PerfHistogram, or a merged
+    stand-in).  THE percentile reader every consumer shares — the
+    traffic harness's per-client tables, ``latency dump``, the bench
+    stage_breakdown deltas, and the mgr telemetry rollup — so the
+    quantile rule cannot drift between surfaces."""
+    pts = hist.cumulative_axis0()
+    return percentiles_from_counts(decumulate(pts),
+                                   [e for e, _c in pts], qs)
+
+
+def merge_axis0(hists) -> Tuple[List[float], List[int]]:
+    """The cluster-rollup merge core: per-bucket axis-0 counts summed
+    across *hists* (the union distribution).  Every histogram must
+    share the axis-0 edge layout — same-named families across daemons
+    do by construction (one axes factory per family); a mismatch is a
+    programming error and raises rather than silently mis-bucketing.
+    Returns ``(upper_edges, summed_counts)``; percentiles of the
+    merged series are EXACTLY the percentiles of the union of the
+    per-daemon samples (same edges, so no re-bucketing error)."""
+    edges: List[float] = []
+    counts: List[int] = []
+    for h in hists:
+        e = h.axes[0].upper_edges()
+        c = h.marginal_axis0()
+        if not edges:
+            edges, counts = e, list(c)
+            continue
+        if e != edges:
+            raise ValueError(
+                f"cannot merge histograms with different axis-0 edges "
+                f"({h.axes[0].dump_config()})")
+        counts = [a + b for a, b in zip(counts, c)]
+    return edges, counts
+
+
+def merged_percentiles(hists, qs=(0.5, 0.99, 0.999),
+                       suffix: str = "") -> Dict[str, float]:
+    """Percentiles of the union of same-edged histograms (cluster-level
+    tail: ONE number per quantile, not one per daemon)."""
+    edges, counts = merge_axis0(hists)
+    return percentiles_from_counts(counts, edges, qs, suffix=suffix)
+
+
 # ---- standard axis shapes (the reference's l_osd histogram configs) ------
 def latency_in_bytes_axes() -> List[PerfHistogramAxis]:
     """2D latency(usec, log2) x request-size(bytes, log2) — the

@@ -1,0 +1,66 @@
+Telemetry rollup admin CLI (`ceph daemon <who> tpu status` and
+`telemetry dump|reset`), in the style of the reference's recorded
+src/test/cli transcripts: the single-pane status and the rollup dump
+of a freshly restored cluster — the snapshot shape (rates catalog,
+objectives table, SLO/breaker panes) is the contract — and the reset.
+
+  $ python -c "from ceph_tpu.cluster import MiniCluster; MiniCluster(n_osds=2).checkpoint('ck')"
+
+  $ ceph --cluster ck daemon osd.0 telemetry dump
+  {
+    "clock": 0.0,
+    "copies_per_op": 0.0,
+    "families": {},
+    "objectives": {
+      "admission_rate_max": 0.0,
+      "copies_per_op_max": 0.0,
+      "oplat_p99_usec": {}
+    },
+    "oplat": {},
+    "oplat_p99_usec": {},
+    "rates": {
+      "admission_rejections": 0.0,
+      "d2h_bytes": 0.0,
+      "h2d_bytes": 0.0,
+      "ops": 0.0
+    },
+    "retention": 360,
+    "samples": 1,
+    "slo": {},
+    "span_s": 0.0,
+    "window_s": 30.0
+  }
+
+  $ ceph --cluster ck daemon osd.0 tpu status
+  {
+    "breakers_open": [],
+    "cluster_p99_usec": {},
+    "copies_per_op": 0.0,
+    "health": "HEALTH_OK",
+    "objectives": {
+      "admission_rate_max": 0.0,
+      "copies_per_op_max": 0.0,
+      "oplat_p99_usec": {}
+    },
+    "rates": {
+      "admission_rejections": 0.0,
+      "d2h_bytes": 0.0,
+      "h2d_bytes": 0.0,
+      "ops": 0.0
+    },
+    "samples": 1,
+    "slo": {},
+    "window_s": 30.0
+  }
+
+  $ ceph --cluster ck daemon osd.0 telemetry reset
+  {
+    "reset": true
+  }
+
+(The populated pane — cluster-merged per-stage p99s, live rates, a
+breaching TPU_SLO_* check raising and clearing through health — is
+asserted in-process by tests/test_telemetry.py; driving harness load
+inside a cram subprocess would recompile kernels outside the shared
+XLA cache and burn tier-1 wall budget for coverage that already
+exists.)

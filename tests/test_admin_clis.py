@@ -59,6 +59,18 @@ def test_oplat_cli_cram(tmp_path):
     assert_cram(path, str(tmp_path))
 
 
+def test_status_cli_cram(tmp_path):
+    """`ceph daemon <who> tpu status` + `telemetry dump|reset`
+    replayed from a recorded transcript (tests/cli/status.t): the
+    single-pane status and rollup dump of a restored cluster (rates
+    catalog, objectives table, SLO/breaker panes pinned) — through
+    the same `ceph` shim as fault.t (the populated rollup and a live
+    SLO breach are covered in-process by tests/test_telemetry.py)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cli", "status.t")
+    assert_cram(path, str(tmp_path))
+
+
 def test_rgw_admin_flow(env, capsys):
     c, cl = env
     run = lambda *a: rgw_admin.run(c, cl, list(a))

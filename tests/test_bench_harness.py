@@ -405,6 +405,14 @@ def test_smoke_mode_end_to_end():
     for cname, st in mt["per_client"].items():
         assert st["p99"] > 0.0, (cname, st)
     assert mt["aggregate"]["p99"] > 0.0
+    # telemetry acceptance: the end-of-run cluster rollup block rode
+    # along, so harness A/B comparisons read ONE cluster tail number
+    # per stage (mgr/telemetry.py) instead of per-daemon dumps
+    roll = mt["cluster_rollup"]
+    assert roll["oplat_p99_usec"].get("reply", 0) > 0, roll
+    assert roll["oplat_p99_usec"].get("class_queue", 0) > 0, roll
+    assert roll["rates"]["ops"] > 0, roll
+    assert roll["samples"] >= 2 and "slo" in roll
     # devprof acceptance: EVERY fenced workload emits a devflow block
     # with the gated per-op figures, and the dispatch/pipeline pairs
     # show coalescing as FEWER copies per op (the copy-budget story)
@@ -520,8 +528,34 @@ def test_traffic_workload_in_process():
     assert m["byte_exact"] is True
     assert m["completed"] == m["total_ops"] == 4 * 8
     assert len(m["per_client"]) == 4
+    assert m["cluster_rollup"]["samples"] >= 1
+    assert g_conf.values.get("mgr_telemetry_retention") is None, \
+        "workload leaked the telemetry retention override"
     assert g_conf.values.get("osd_op_queue_admission_max") == before, \
         "workload leaked admission config"
+
+
+def test_traffic_workload_rollup_survives_tiny_retention():
+    """The whole-run cluster_rollup must keep the boot baseline even
+    when the operator configured a ring too small for the run's tick
+    count — the workload overrides retention for its own cluster and
+    restores it after."""
+    from ceph_tpu.bench import workloads
+    from ceph_tpu.common.config import g_conf
+    g_conf.set_val("mgr_telemetry_retention", 2)
+    try:
+        m = workloads.measure_traffic(n_clients=4, ops_per_client=8,
+                                      n_osds=3, pg_num=4, seed=5,
+                                      name="traffic_tiny_ret")
+        # baseline + at least the final sample survived a ring the
+        # operator sized at 2 (which would otherwise evict the boot
+        # baseline and truncate the "whole-run" window to its tail)
+        assert m["cluster_rollup"]["samples"] >= 2
+        assert m["cluster_rollup"]["rates"]["ops"] > 0
+        assert g_conf.get_val("mgr_telemetry_retention") == 2, \
+            "workload clobbered the operator's retention value"
+    finally:
+        g_conf.rm_val("mgr_telemetry_retention")
 
 
 def test_dispatch_coalesce_workload_in_process():

@@ -23,6 +23,9 @@ def cluster_and_text():
     cl = c.client("client.lint")
     assert cl.write_full("lint", "o", b"c" * 16000) == 0
     assert cl.read("lint", "o")[:1] == b"c"
+    # one mgr tick so the telemetry ring holds a post-IO sample and
+    # the ceph_cluster_* rollup families render with real content
+    c.tick(dt=1.0)
     return c, c.admin_socket.execute("prometheus metrics")
 
 
@@ -103,3 +106,46 @@ def test_known_new_families_covered_by_the_lint(cluster_and_text):
                     if stage_of_hist_name(n)}
     assert {"admission", "class_queue", "device_call", "reply"} <= \
         oplat_stages, oplat_stages
+
+
+def test_cluster_rollup_families_exported(cluster_and_text):
+    """Telemetry-PR lint: every stage and rate in the mgr rollup
+    snapshot renders as a ``ceph_cluster_*`` gauge — a new rollup
+    series that skips the exporter fails tier-1, like a counter."""
+    c, text = cluster_and_text
+    roll = c.mgr.telemetry.rollup()
+    assert roll["oplat_p99_usec"], "rollup carries no oplat stages"
+    assert {"device_call", "class_queue", "reply"} <= \
+        set(roll["oplat_p99_usec"]), roll["oplat_p99_usec"]
+    missing = []
+    for q in ("p50", "p99", "p999"):
+        for stage in roll["oplat_p99_usec"]:
+            want = f'ceph_cluster_oplat_{q}_usec{{stage="{stage}"}}'
+            if want not in text:
+                missing.append(want)
+    assert set(roll["rates"]) == {"ops", "h2d_bytes", "d2h_bytes",
+                                  "admission_rejections"}
+    for key in roll["rates"]:
+        if f"ceph_cluster_rate_{key} " not in text:
+            missing.append(f"ceph_cluster_rate_{key}")
+    assert not missing, \
+        f"cluster rollup series missing from the exposition: {missing}"
+
+
+def test_slo_and_telemetry_options_documented():
+    """Options-coverage lint: every ``mgr_slo_*`` / ``mgr_telemetry_*``
+    option must be documented in docs/OBSERVABILITY.md's SLO option
+    table — an objective an operator cannot discover is an objective
+    nobody sets."""
+    import os
+    from ceph_tpu.common.config import g_conf
+    doc_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")
+    with open(doc_path) as f:
+        doc = f.read()
+    opts = sorted(n for n in g_conf.schema
+                  if n.startswith(("mgr_slo_", "mgr_telemetry_")))
+    assert opts, "no SLO/telemetry options registered"
+    missing = [n for n in opts if n not in doc]
+    assert not missing, \
+        f"undocumented mgr_slo_/mgr_telemetry_ options: {missing}"

@@ -24,6 +24,10 @@ def test_routes(fe):
     st, hdrs, body = f.handle("GET", "/metrics")
     assert st == 200 and b"ceph_osdmap_epoch" in body \
         and b"ceph_osd_up 4" in body
+    # the telemetry cluster-rollup families ride the HTTP scrape too
+    # (same rollup snapshot the admin-socket exposition renders)
+    assert b"ceph_cluster_rate_ops" in body
+    assert b"# TYPE ceph_cluster_oplat_p99_usec gauge" in body
 
     st, _, body = f.handle("GET", "/health")
     doc = json.loads(body)

@@ -223,6 +223,20 @@ def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
             f"stage clock missed the {stage} boundary"
     assert g_oplat.dump()["ops"] >= ops0 + 2
     assert calls["n"] == 0, "stage-latency ledger added a device sync"
+    # telemetry extension: the mgr's cluster rollup collection + SLO
+    # evaluation on tick is pure host-side histogram/counter reads —
+    # a full mgr tick, the rollup snapshot, and the single-pane
+    # status must add zero device syncs
+    samples0 = c.mgr.telemetry.rollup()["samples"]
+    c.clock += 1.0
+    c.mgr.tick(c.clock)
+    roll = c.mgr.telemetry.rollup()
+    assert roll["samples"] == samples0 + 1
+    assert roll["oplat_p99_usec"].get("device_call", 0) > 0, \
+        "telemetry tick missed the device_call stage family"
+    c.tpu_status()
+    c.mgr.telemetry.dump()
+    assert calls["n"] == 0, "telemetry collection added a device sync"
 
 
 def test_slow_op_span_tree_and_histogram_dump(clean_tracing):

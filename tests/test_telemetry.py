@@ -1,0 +1,369 @@
+"""mgr telemetry rollup: cluster-merged percentiles, time-series
+rings, and SLO burn-rate health.
+
+Covers the telemetry PR's contracts: the merged cluster p99 is EXACTLY
+the percentile of the union of the per-daemon bucket counts (same
+edges, no re-bucketing error); the SLO engine fires on sustained
+breach, clears with hysteresis, and never flaps on a single-tick
+spike; `tpu status` / `telemetry dump` / the Prometheus
+``ceph_cluster_*`` families all render from one shared rollup
+snapshot; and an SLO breach under real harness load raises the
+``TPU_SLO_*`` health checks at runtime and clears after the load
+subsides.
+"""
+import re
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.mgr.telemetry import (SLO_ADMISSION, SLO_COPY, SLO_OPLAT,
+                                    Telemetry)
+from ceph_tpu.trace import g_perf_histograms, latency_axes
+from ceph_tpu.trace.histogram import (PerfHistogram, hist_percentiles,
+                                      merge_axis0, merged_percentiles,
+                                      percentiles_from_counts)
+from ceph_tpu.trace.oplat import stage_hist_name
+
+SLO_OPTS = ("mgr_slo_oplat_p99_usec", "mgr_slo_copies_per_op_max",
+            "mgr_slo_admission_rate_max", "mgr_slo_fast_window_s",
+            "mgr_slo_slow_window_s", "mgr_slo_sustain_ticks",
+            "mgr_slo_clear_ticks", "mgr_telemetry_retention",
+            "osd_op_queue_admission_max")
+
+
+@pytest.fixture
+def clean_slo_conf():
+    yield
+    for name in SLO_OPTS:
+        g_conf.rm_val(name)
+
+
+class FakeMgr:
+    """The health surface the SLO engine drives (Manager duck-type)."""
+
+    def __init__(self):
+        self.health_checks = {}
+        self.log = []
+
+    def _cluster_log(self, level, message):
+        self.log.append((level, message))
+
+
+# ---- merge core ------------------------------------------------------------
+def test_merged_cluster_percentiles_equal_union_percentiles():
+    """Property: for random per-daemon distributions, the telemetry
+    rollup's merged cluster percentile equals the percentile computed
+    over the union of the per-daemon bucket counts — exact, because
+    same-named families share one edge layout."""
+    rng = np.random.default_rng(20260804)
+    for trial in range(20):
+        n_daemons = int(rng.integers(2, 6))
+        hists = [PerfHistogram(latency_axes()) for _ in range(n_daemons)]
+        for h in hists:
+            for _ in range(int(rng.integers(1, 200))):
+                h.inc(float(rng.lognormal(6.0, 2.0)))
+        edges, counts = merge_axis0(hists)
+        # the union, computed independently of the merge core
+        union = [0] * len(counts)
+        for h in hists:
+            for i, c in enumerate(h.marginal_axis0()):
+                union[i] += c
+        assert counts == union, trial
+        got = merged_percentiles(hists)
+        want = percentiles_from_counts(union, edges, (0.5, 0.99, 0.999))
+        assert got == want, trial
+        # every quantile answer is one of the shared edges (exactness:
+        # no daemon's sample can land between two daemons' buckets)
+        assert all(v in edges or v == 0.0 for v in got.values())
+
+
+def test_merge_refuses_mismatched_edges():
+    from ceph_tpu.trace.histogram import PerfHistogramAxis
+    a = PerfHistogram(latency_axes())
+    b = PerfHistogram([PerfHistogramAxis("latency_usec", min=0,
+                                         quant_size=7, buckets=32)])
+    with pytest.raises(ValueError):
+        merge_axis0([a, b])
+
+
+def test_hist_percentiles_is_the_shared_implementation():
+    """Satellite receipt: the load harness re-exports the ONE
+    percentile implementation from trace.histogram (no second
+    cumulative-walk copy left to drift)."""
+    from ceph_tpu.load import traffic
+    assert traffic.hist_percentiles is hist_percentiles
+    h = PerfHistogram(latency_axes())
+    for v in (50, 150, 900, 20000):
+        h.inc(v)
+    p = hist_percentiles(h)
+    assert set(p) == {"p50", "p99", "p999"}
+    assert 0 < p["p50"] <= p["p99"] <= p["p999"]
+
+
+# ---- ring + rollup ---------------------------------------------------------
+def test_ring_bounded_by_retention(clean_slo_conf):
+    g_conf.set_val("mgr_telemetry_retention", 5)
+    tel = Telemetry()
+    for t in range(20):
+        tel.collect(float(t))
+    assert len(tel._ring) == 5
+    assert tel._ring[-1]["t"] == 19.0
+    # stale/duplicate clocks are no-ops, not ring churn
+    tel.collect(3.0)
+    tel.collect(19.0)
+    assert len(tel._ring) == 5 and tel._ring[-1]["t"] == 19.0
+
+
+def test_rollup_window_isolates_run_from_process_history(clean_slo_conf):
+    """The boot baseline sample makes window deltas run-scoped: a
+    fresh Telemetry sees only samples recorded AFTER its baseline,
+    not the process-global histogram history."""
+    h = g_perf_histograms.get("osd.telrollup",
+                              stage_hist_name("device_call"),
+                              latency_axes)
+    for _ in range(50):
+        h.inc(100.0)                    # pre-history
+    tel = Telemetry()
+    tel.collect(0.0)                    # baseline
+    for _ in range(10):
+        h.inc(820000.0)                 # the "run"
+    tel.collect(10.0)
+    roll = tel.rollup(window_s=100.0)
+    st = roll["oplat"]["device_call"]
+    assert st["count"] == 10, st        # not 60
+    assert st["p99"] > 100000.0
+
+
+# ---- SLO engine ------------------------------------------------------------
+def _slo_conf(oplat="", copies=0.0, admission=0.0):
+    g_conf.set_val("mgr_slo_oplat_p99_usec", oplat)
+    g_conf.set_val("mgr_slo_copies_per_op_max", copies)
+    g_conf.set_val("mgr_slo_admission_rate_max", admission)
+    g_conf.set_val("mgr_slo_fast_window_s", 5.0)
+    g_conf.set_val("mgr_slo_slow_window_s", 20.0)
+    g_conf.set_val("mgr_slo_sustain_ticks", 2)
+    g_conf.set_val("mgr_slo_clear_ticks", 2)
+
+
+def test_slo_fires_on_sustained_breach_only(clean_slo_conf):
+    _slo_conf(oplat="device_call:1000")
+    tel, mgr = Telemetry(), FakeMgr()
+    h = g_perf_histograms.get("osd.sloA",
+                              stage_hist_name("device_call"),
+                              latency_axes)
+    tel.tick(mgr, 0.0)                  # baseline
+    for _ in range(4):
+        h.inc(50000.0)
+    tel.tick(mgr, 1.0)                  # breach tick 1: streak 1
+    assert SLO_OPLAT not in mgr.health_checks
+    for _ in range(4):
+        h.inc(50000.0)
+    tel.tick(mgr, 2.0)                  # breach tick 2: raises
+    assert SLO_OPLAT in mgr.health_checks
+    assert "device_call" in mgr.health_checks[SLO_OPLAT]
+    assert any(lv == "WRN" and SLO_OPLAT in m for lv, m in mgr.log)
+    st = tel.slo_state()[SLO_OPLAT]
+    assert st["state"] == "breach" and st["burn_fast"] >= 1.0
+
+
+def test_slo_never_flaps_on_single_tick_spike(clean_slo_conf):
+    _slo_conf(oplat="device_call:1000")
+    tel, mgr = Telemetry(), FakeMgr()
+    h = g_perf_histograms.get("osd.sloB",
+                              stage_hist_name("device_call"),
+                              latency_axes)
+    tel.tick(mgr, 0.0)
+    h.inc(800000.0)                     # one huge spike, one tick
+    tel.tick(mgr, 1.0)
+    for t in (2.0, 3.0, 4.0, 5.0, 6.0):
+        tel.tick(mgr, t)                # quiet ticks follow
+        assert SLO_OPLAT not in mgr.health_checks, t
+    assert not any(lv == "WRN" for lv, _m in mgr.log)
+
+
+def test_slo_clears_with_hysteresis(clean_slo_conf):
+    _slo_conf(oplat="device_call:1000")
+    tel, mgr = Telemetry(), FakeMgr()
+    h = g_perf_histograms.get("osd.sloC",
+                              stage_hist_name("device_call"),
+                              latency_axes)
+    tel.tick(mgr, 0.0)
+    for t in (1.0, 2.0, 3.0):
+        for _ in range(4):
+            h.inc(50000.0)
+        tel.tick(mgr, t)
+    assert SLO_OPLAT in mgr.health_checks
+    tel.tick(mgr, 4.0)                  # clean tick 1: still raised
+    assert SLO_OPLAT in mgr.health_checks, "cleared without hysteresis"
+    tel.tick(mgr, 5.0)                  # clean tick 2: clears
+    assert SLO_OPLAT not in mgr.health_checks
+    assert any(lv == "INF" and SLO_OPLAT in m for lv, m in mgr.log)
+    assert tel.slo_state()[SLO_OPLAT]["state"] == "ok"
+
+
+def test_slo_copy_and_admission_objectives(clean_slo_conf):
+    """The copy-budget and admission-rate objectives judge counter
+    deltas: copies/op from devprof+oplat, rejections/s from qos."""
+    from ceph_tpu.common.work_queue import (l_qos_admission_rejections,
+                                            qos_perf_counters)
+    from ceph_tpu.trace import g_devprof
+    from ceph_tpu.trace.oplat import g_oplat
+    _slo_conf(copies=2.0, admission=1.0)
+    tel, mgr = Telemetry(), FakeMgr()
+    tel.tick(mgr, 0.0)
+    for t in (1.0, 2.0, 3.0):
+        for _ in range(10):             # 10 ops, 50 copies: 5/op > 2
+            g_oplat.note_op()
+        for _ in range(50):
+            g_devprof.account_host_copy("telemetry.test", 64)
+        qos_perf_counters().inc(l_qos_admission_rejections, 30)
+        tel.tick(mgr, t)                # 30 rejections/s > 1/s
+    assert SLO_COPY in mgr.health_checks
+    assert SLO_ADMISSION in mgr.health_checks
+    # objective removed at runtime -> check torn down on next tick
+    g_conf.set_val("mgr_slo_copies_per_op_max", 0.0)
+    tel.tick(mgr, 4.0)
+    assert SLO_COPY not in mgr.health_checks
+    assert SLO_ADMISSION in mgr.health_checks
+
+
+def test_reset_while_breaching_cannot_strand_the_health_check(
+        clean_slo_conf):
+    """`telemetry reset` while a check is active wipes the streak
+    state; the next evaluation must reconcile — health() and
+    slo_state() may never disagree forever."""
+    _slo_conf(oplat="device_call:1000")
+    tel, mgr = Telemetry(), FakeMgr()
+    h = g_perf_histograms.get("osd.sloD",
+                              stage_hist_name("device_call"),
+                              latency_axes)
+    tel.tick(mgr, 0.0)
+    for t in (1.0, 2.0, 3.0):
+        for _ in range(4):
+            h.inc(50000.0)
+        tel.tick(mgr, t)
+    assert SLO_OPLAT in mgr.health_checks
+    tel.reset()
+    tel.tick(mgr, 4.0)                  # quiet tick post-reset
+    assert SLO_OPLAT not in mgr.health_checks, \
+        "reset stranded the raised health check"
+    assert tel.slo_state()[SLO_OPLAT]["state"] == "ok"
+    # the nastier ordering: reset AND objective disabled before the
+    # next tick — no verdict and no streak state remain, only the
+    # invariant sweep can pop the raised check
+    for t in (5.0, 6.0, 7.0):
+        for _ in range(4):
+            h.inc(50000.0)
+        tel.tick(mgr, t)
+    assert SLO_OPLAT in mgr.health_checks
+    tel.reset()
+    g_conf.set_val("mgr_slo_oplat_p99_usec", "")
+    tel.tick(mgr, 8.0)
+    assert SLO_OPLAT not in mgr.health_checks, \
+        "reset + objective removal stranded the raised health check"
+
+
+# ---- surfaces --------------------------------------------------------------
+@pytest.fixture(scope="module")
+def rollup_cluster():
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("tel", size=3, pg_num=8)
+    cl = c.client("client.tel")
+    for i in range(8):
+        assert cl.write_full("tel", f"o{i}", b"t" * 4000) == 0
+    c.tick(dt=1.0, rounds=2)
+    return c
+
+
+def test_dump_and_exposition_render_one_snapshot(rollup_cluster):
+    """Satellite: `telemetry dump` and the Prometheus scrape render
+    from ONE shared rollup function — every cluster gauge value in
+    the exposition equals the dump's figure for it."""
+    c = rollup_cluster
+    dump = c.admin_socket.execute("telemetry dump")
+    text = c.admin_socket.execute("prometheus metrics")
+    assert dump["oplat_p99_usec"], "no oplat stages in the rollup"
+    got_p99 = {}
+    for line in text.splitlines():
+        m = re.fullmatch(
+            r'ceph_cluster_oplat_p99_usec\{stage="(\w+)"\} (\S+)', line)
+        if m:
+            got_p99[m.group(1)] = float(m.group(2))
+    assert got_p99 == dump["oplat_p99_usec"]
+    got_rates = {}
+    for line in text.splitlines():
+        m = re.fullmatch(r"ceph_cluster_rate_(\w+) (\S+)", line)
+        if m:
+            got_rates[m.group(1)] = float(m.group(2))
+    assert got_rates == dump["rates"]
+    # the single-pane status draws from the same snapshot too
+    st = c.admin_socket.execute("tpu status")
+    assert st["cluster_p99_usec"] == dump["oplat_p99_usec"]
+    assert st["rates"] == dump["rates"]
+    assert st["health"].startswith("HEALTH_")
+    assert st["breakers_open"] == []
+
+
+def test_telemetry_dump_shape_and_reset(rollup_cluster):
+    c = rollup_cluster
+    d = c.admin_socket.execute("telemetry dump")
+    assert d["samples"] >= 2 and d["span_s"] > 0
+    assert d["rates"]["ops"] > 0
+    # cluster-merged family percentiles: the OSD write family merged
+    # across daemons is one number, not one per daemon
+    fam = d["families"]["op_w_latency_in_bytes_histogram"]
+    assert fam["count"] >= 8 and fam["p99"] >= fam["p50"]
+    assert set(d["objectives"]) == {"oplat_p99_usec",
+                                    "copies_per_op_max",
+                                    "admission_rate_max"}
+    out = c.admin_socket.execute("telemetry reset")
+    assert out == {"reset": True}
+    d2 = c.admin_socket.execute("telemetry dump")
+    assert d2["samples"] == 0 and d2["families"] == {}
+    # next tick repopulates (reset drops rings, not the histograms)
+    c.tick(dt=1.0)
+    assert c.admin_socket.execute("telemetry dump")["samples"] == 1
+
+
+# ---- the load-harness acceptance scenario ---------------------------------
+def test_slo_breach_under_load_raises_and_clears(clean_slo_conf):
+    """Acceptance: abusive-client saturation raises TPU_SLO_ADMISSION
+    and TPU_SLO_OPLAT at runtime (mgr ticks DURING the run), `tpu
+    status` shows the breaching stage's cluster p99, and both checks
+    clear after the load subsides."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.load import TrafficSpec, run_traffic
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("load", size=3, pg_num=8)
+    g_conf.set_val("osd_op_queue_admission_max", 8)
+    g_conf.set_val("mgr_slo_admission_rate_max", 0.1)
+    g_conf.set_val("mgr_slo_oplat_p99_usec", "class_queue:1000")
+    g_conf.set_val("mgr_slo_fast_window_s", 4.0)
+    g_conf.set_val("mgr_slo_slow_window_s", 16.0)
+    res = run_traffic(c, TrafficSpec(
+        n_clients=8, ops_per_client=16, mode="open", rate=4.0,
+        rate_multipliers=(10.0,), tick_every=4))
+    assert res.byte_exact, res.errors[:4]
+    assert res.admission_rejections > 0
+    health = c.health()
+    assert SLO_ADMISSION in health and SLO_OPLAT in health, health
+    st = c.admin_socket.execute("tpu status")
+    assert st["slo"][SLO_ADMISSION] == "breach"
+    assert st["slo"][SLO_OPLAT] == "breach"
+    # the single pane names the breaching stage's cluster p99
+    assert st["cluster_p99_usec"]["class_queue"] > 1000.0
+    assert st["rates"]["admission_rejections"] > 0.1
+    # load subsides: quiet ticks roll the windows clean and the
+    # hysteresis clears both checks
+    for _ in range(10):
+        c.tick(dt=2.0)
+        if "TPU_SLO" not in c.health():
+            break
+    health = c.health()
+    assert SLO_ADMISSION not in health and SLO_OPLAT not in health, \
+        health
+    st = c.admin_socket.execute("tpu status")
+    assert st["slo"][SLO_ADMISSION] == "ok"
+    assert st["slo"][SLO_OPLAT] == "ok"
