@@ -44,6 +44,13 @@ def main(argv=None) -> int:
     if args.smoke:
         # must land before any jax import in this process
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # the mesh workload needs a multi-chip topology: the virtual
+        # host platform provides 8 CPU devices for the smoke tier
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     t0 = time.monotonic()
     import numpy as np
@@ -54,9 +61,9 @@ def main(argv=None) -> int:
     from . import regress
     from .workloads import (bench_perf_counters, measure_decode,
                             measure_dispatch_coalesce,
-                            measure_ec_pipeline, measure_encode,
-                            measure_host_native, measure_traffic,
-                            parity_check)
+                            measure_ec_mesh, measure_ec_pipeline,
+                            measure_encode, measure_host_native,
+                            measure_traffic, parity_check)
     from ..gf.matrices import gf_gen_rs_matrix
 
     K, M = 8, 4
@@ -113,6 +120,21 @@ def main(argv=None) -> int:
                  f"{mp1['value']} depth-1 (x{mp['speedup']}, occupancy "
                  f"{mp['mean_batch_occupancy']}, identical "
                  f"{mp['identical']})")
+        # mesh runtime (ceph_tpu/mesh): the same salted k8m4 encode
+        # step across the batch-axis mesh vs one device, drained per
+        # shard, plus the dispatch-path identity/occupancy receipt
+        mm, mm1 = measure_ec_mesh(
+            matrix, mesh_chips=8 if args.smoke else -1,
+            target_seconds=0.3 if args.smoke else 2.0,
+            repeats=repeats, warmup=warmup,
+            n_steps=6 if args.smoke else None)
+        result["metrics"] += [mm, mm1]
+        occupied = sum(1 for v in mm["per_chip_stripes"].values()
+                       if v > 0)
+        progress(f"ec_mesh {mm['value']} GiB/s over {mm['mesh_chips']} "
+                 f"chips vs {mm1['value']} single (x{mm['speedup']}, "
+                 f"identical {mm['identical']}, "
+                 f"chips occupied {occupied}/{mm['mesh_chips']})")
         # traffic harness (ceph_tpu/load): ≥8 concurrent synthetic
         # clients over the real client stack; the smoke shape is <10 s
         # on CPU, the full mode drives a deeper closed loop

@@ -111,9 +111,17 @@ def measure_rtt(make_tiny: Optional[Callable[[], Any]] = None,
 
 def fenced_time(step: Callable[[int], Any], n_steps: int,
                 rtt_s: Optional[float] = None,
-                kernel_name: Optional[str] = None) -> FencedTiming:
+                kernel_name: Optional[str] = None,
+                drain_fn: Optional[Callable[[Any], Any]] = None
+                ) -> FencedTiming:
     """Dispatch ``step(i)`` for i in [0, n_steps) back-to-back, fence on
     the LAST output, and time the whole region.
+
+    ``drain_fn`` overrides the fence for outputs whose completion
+    contract needs more than the single-element drain — a mesh-sharded
+    output is only proven complete by a readback from EVERY shard's
+    device (``parallel.ec.drain_sharded``); the default ``drain`` is
+    the single-device contract.
 
     ``step`` must return the dispatch's output (device array or pytree
     leaf).  Only the LAST output is retained: a submitted PJRT dispatch
@@ -139,7 +147,7 @@ def fenced_time(step: Callable[[int], Any], n_steps: int,
             last = step(i)
         t_issued = time.perf_counter()
         drain_span = g_tracer.begin("drain") if span is not None else None
-        drain(last)
+        (drain_fn or drain)(last)
         g_tracer.finish(drain_span)
     elapsed = time.perf_counter() - t0
     g_tracer.finish(span)

@@ -106,7 +106,8 @@ def salted_matmul_step():
 
 
 def _calibrate_steps(step: Callable[[int], Any], target_s: float,
-                     rtt_s: float, lo: int = 4, hi: int = 8192) -> int:
+                     rtt_s: float, lo: int = 4, hi: int = 8192,
+                     drain_fn=None) -> int:
     """Pick how many back-to-back dispatches one fenced region needs so
     compute dominates the single drain RTT and the region lands near
     ``target_s``.
@@ -117,7 +118,7 @@ def _calibrate_steps(step: Callable[[int], Any], target_s: float,
     fenced throughput several-fold).  ``hi`` only bounds the dispatch
     queue depth — outputs are not retained (fence.fenced_time), so
     memory does not grow with n."""
-    probe = fenced_time(step, lo, rtt_s=rtt_s)
+    probe = fenced_time(step, lo, rtt_s=rtt_s, drain_fn=drain_fn)
     per_step = max((probe.elapsed_s - rtt_s) / lo, 1e-6)
     n = int(max(target_s, 10.0 * rtt_s) / per_step)
     return max(lo, min(n, hi))
@@ -125,10 +126,11 @@ def _calibrate_steps(step: Callable[[int], Any], target_s: float,
 
 def _fenced_throughput(step: Callable[[int], Any], n_steps: int,
                        bytes_per_step: int, rtt_s: float,
-                       kernel_name: str) -> Tuple[float, Dict[str, Any]]:
+                       kernel_name: str,
+                       drain_fn=None) -> Tuple[float, Dict[str, Any]]:
     """One fenced sample: GiB/s plus the raw timing dict."""
     timing = fenced_time(step, n_steps, rtt_s=rtt_s,
-                         kernel_name=kernel_name)
+                         kernel_name=kernel_name, drain_fn=drain_fn)
     pc = bench_perf_counters()
     pc.inc(l_bench_dispatches, n_steps)
     pc.inc(l_bench_bytes, n_steps * bytes_per_step)
@@ -173,18 +175,41 @@ def _device_info() -> Tuple[str, str, int]:
 def _measure_fenced_gf(bits, batch: np.ndarray, *, metric_name: str,
                        workload: Dict[str, Any], kernel_name: str,
                        target_seconds: float, repeats: int, warmup: int,
-                       rtt_s: Optional[float]) -> Dict[str, Any]:
+                       rtt_s: Optional[float],
+                       mesh=None,
+                       n_steps: Optional[int] = None) -> Dict[str, Any]:
     """Shared fenced pipeline for the GF bit-matmul workloads: warm the
     jitted step, calibrate the per-region dispatch count, take
     warmup+repeat fenced samples, and wrap the median in a schema
     metric with a roofline verdict.  Encode and decode differ only in
-    the bitmatrix and the cost model."""
+    the bitmatrix and the cost model.
+
+    With *mesh* the same step runs SPMD: the batch rows are placed
+    ``NamedSharding(mesh, PartitionSpec("batch"))``, the bit-matrix
+    replicated, the fence is ``drain_sharded`` (one readback per shard
+    — each chip's completion proven, not inferred) and the roofline
+    verdict scales the chip peak by the mesh size (``mesh_roofline``).
+    """
     import jax
     import jax.numpy as jnp
 
-    dev = jax.device_put(jnp.asarray(batch))
+    drain_fn = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..mesh.topology import BATCH_AXIS
+        from ..parallel.ec import drain_sharded
+        dev = jax.device_put(jnp.asarray(batch),
+                             NamedSharding(mesh, P(BATCH_AXIS, None,
+                                                   None)))
+        bits = jax.device_put(bits, NamedSharding(mesh, P(None, None)))
+        drain_fn = drain_sharded
+    else:
+        dev = jax.device_put(jnp.asarray(batch))
     jitted = salted_matmul_step()
-    jax.block_until_ready(jitted(dev, bits, jnp.uint32(0)))  # compile
+    warm = jitted(dev, bits, jnp.uint32(0))
+    jax.block_until_ready(warm)                              # compile
+    if drain_fn is not None:
+        drain_fn(warm)       # warm the fence's own tiny programs too
 
     def step(i: int):
         return jitted(dev, bits, jnp.uint32(_next_salt()))
@@ -193,25 +218,34 @@ def _measure_fenced_gf(bits, batch: np.ndarray, *, metric_name: str,
         rtt_s = measure_rtt()
     bytes_per_step = int(batch.shape[0]) * int(batch.shape[1]) \
         * int(batch.shape[2])
-    n_steps = _calibrate_steps(step, target_seconds / max(repeats, 1),
-                               rtt_s)
+    if n_steps is None:
+        n_steps = _calibrate_steps(step,
+                                   target_seconds / max(repeats, 1),
+                                   rtt_s, drain_fn=drain_fn)
     flow0 = g_devprof.snapshot()
     stage0 = g_oplat.snapshot()
     wall_t0 = time.perf_counter()
     st = repeat_measure(
         lambda: _fenced_throughput(step, n_steps, bytes_per_step, rtt_s,
-                                   kernel_name)[0],
+                                   kernel_name, drain_fn=drain_fn)[0],
         repeats=repeats, warmup=warmup)
     wall_s = time.perf_counter() - wall_t0
     n_ops = n_steps * (repeats + warmup)
     devflow = _devflow_since(flow0, n_ops)
     platform, kind, ndev = _device_info()
-    rl = validate_reading(st["median"], workload, platform, kind, ndev)
+    if mesh is not None:
+        from ..parallel.ec import mesh_roofline
+        rl = mesh_roofline(st["median"], workload, mesh)
+        ndev = mesh.size
+    else:
+        rl = validate_reading(st["median"], workload, platform, kind,
+                              ndev)
     return make_metric(
         metric_name, st["median"], "GiB/s", fenced=True,
         rtt_s=rtt_s, stats=st, roofline=rl,
         extra={"n_steps": n_steps, "bytes_per_step": bytes_per_step,
-               "platform": platform, "devflow": devflow,
+               "platform": platform, "n_devices": ndev,
+               "devflow": devflow,
                "stage_breakdown": _stage_breakdown_since(
                    stage0, wall_s, n_ops)})
 
@@ -584,6 +618,126 @@ def measure_ec_pipeline(*, n_requests: int = 64,
                                 fenced=True, rtt_s=rtt_s, stats=st,
                                 roofline=rl, extra=extra))
     return mets[0], mets[1]
+
+
+def _mesh_dispatch_receipt(mesh_chips: int, n_requests: int,
+                           object_bytes: int) -> Dict[str, Any]:
+    """The mesh workload's correctness + occupancy receipt, taken
+    through the REAL dispatch path: the same coalesced k8m4 encode
+    batch through the scheduler with the mesh on vs the single-device
+    twin (mesh off), outputs byte-compared shard by shard, per-chip
+    stripe deltas read back from the runtime.  Runs outside the timed
+    region — receipts must not pollute the fenced numbers."""
+    from ..common.config import g_conf
+    from ..dispatch import g_dispatcher
+    from ..ec.tpu_plugin import ErasureCodeTpu
+    from ..mesh import g_mesh
+    from ..osd.ecutil import stripe_info_t
+
+    impl = ErasureCodeTpu()
+    impl.init({"k": str(K), "m": str(M), "technique": "reed_sol_van"})
+    assert object_bytes % K == 0
+    sinfo = stripe_info_t(K, object_bytes)
+    want = set(range(K + M))
+    rng = np.random.default_rng(20260805)
+    payloads = [rng.integers(0, 256, size=object_bytes, dtype=np.uint8)
+                for _ in range(n_requests)]
+    saved = {name: g_conf.values.get(name) for name in
+             ("ec_dispatch_batch_max", "ec_dispatch_batch_window_us",
+              "ec_mesh_chips")}
+
+    def run_batch():
+        futs = [g_dispatcher.submit_encode(sinfo, impl, p, want)
+                for p in payloads]
+        g_dispatcher.flush()
+        return [f.result() for f in futs]
+
+    try:
+        g_conf.set_val("ec_dispatch_batch_max", n_requests)
+        g_conf.set_val("ec_dispatch_batch_window_us", 10**7)
+        g_conf.set_val("ec_mesh_chips", 0)
+        single = run_batch()
+        g_conf.set_val("ec_mesh_chips", mesh_chips)
+        chips0 = {i: v["stripes"] for i, v in g_mesh.per_chip().items()}
+        meshed = run_batch()
+        per_chip = {i: v["stripes"] - chips0.get(i, 0)
+                    for i, v in g_mesh.per_chip().items()}
+        identical = all(
+            sorted(a) == sorted(b)
+            and all(np.asarray(a[i]).tobytes()
+                    == np.asarray(b[i]).tobytes() for i in a)
+            for a, b in zip(meshed, single))
+        dump = g_mesh.dump()
+        return {"identical": bool(identical),
+                "per_chip_stripes": per_chip,
+                "mesh_size": dump["size"],
+                "plan_cache": len(dump["plans"]),
+                "pool": dump["pool"]}
+    finally:
+        for name, v in saved.items():
+            g_conf.rm_val(name) if v is None else g_conf.set_val(name, v)
+        g_dispatcher.flush()
+
+
+def measure_ec_mesh(matrix: np.ndarray, *, mesh_chips: int = 8,
+                    chunk: int = 8192, n_requests: int = 8,
+                    object_bytes: int = 65536,
+                    target_seconds: float = 0.3, repeats: int = 3,
+                    warmup: int = 1, rtt_s: Optional[float] = None,
+                    n_steps: Optional[int] = None
+                    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """k=8,m=4 encodes across the dispatch mesh vs a single-device
+    twin (ceph_tpu/mesh, docs/DISPATCH.md "Mesh-sharded dispatch").
+
+    Two legs of the SAME salted GF bit-matmul step: ``ec_mesh_fenced``
+    runs it SPMD over a 1-D batch-axis mesh of *mesh_chips* devices
+    (CPU smoke: the 8-device virtual host platform), completion-fenced
+    via ``drain_sharded`` — one readback from EVERY shard, because a
+    mesh output is only proven complete per device — and validated by
+    ``mesh_roofline`` (chip peaks scaled by mesh size);
+    ``ec_mesh_single_fenced`` is the identical step on one device
+    under the standard drain.  The RTT is measured and reported, never
+    subtracted; inputs are salted per dispatch.
+
+    The mesh metric also carries the dispatch-path receipt
+    (``_mesh_dispatch_receipt``): byte-identity of a coalesced batch
+    through the real scheduler with the mesh on vs off, and the
+    per-chip stripe occupancy the flush produced — every chip of the
+    smoke mesh must show work.
+    """
+    import jax.numpy as jnp
+    from ..gf.tables import expand_to_bitmatrix
+    from ..mesh.topology import batch_mesh
+
+    mesh = batch_mesh(mesh_chips)
+    batch_s = 2 * mesh.size
+    rng = np.random.default_rng(20260806)
+    batch = rng.integers(0, 256, size=(batch_s, K, chunk),
+                         dtype=np.uint8)
+    bits = jnp.asarray(expand_to_bitmatrix(matrix[K:]).astype(np.int8))
+    if rtt_s is None:
+        rtt_s = measure_rtt()
+    # a PINNED step count (smoke) keeps the twin's fence-flow per-op
+    # figures deterministic round over round; None (full mode)
+    # calibrates the region like every other fenced workload
+    m_single = _measure_fenced_gf(
+        bits, batch, metric_name="ec_mesh_single_fenced",
+        workload=EC_ENCODE_K8M4, kernel_name="bench_mesh_single_fenced",
+        target_seconds=target_seconds, repeats=repeats, warmup=warmup,
+        rtt_s=rtt_s, n_steps=n_steps)
+    m_mesh = _measure_fenced_gf(
+        bits, batch, metric_name="ec_mesh_fenced",
+        workload=EC_ENCODE_K8M4, kernel_name="bench_mesh_fenced",
+        target_seconds=target_seconds, repeats=repeats, warmup=warmup,
+        rtt_s=rtt_s, mesh=mesh, n_steps=n_steps)
+    receipt = _mesh_dispatch_receipt(mesh_chips, n_requests,
+                                     object_bytes)
+    m_mesh["mesh_chips"] = mesh.size
+    m_mesh["single_gibs"] = round(m_single["value"], 4)
+    m_mesh["speedup"] = round(
+        m_mesh["value"] / max(m_single["value"], 1e-9), 3)
+    m_mesh.update(receipt)
+    return m_mesh, m_single
 
 
 def measure_traffic(*, n_clients: int = 8, ops_per_client: int = 32,

@@ -291,6 +291,8 @@ class MiniCluster:
             "per-shard op queue sizes and mclock tags")
         from .dispatch import dispatch_perf_counters, g_dispatcher
         self.perf_collection.add(dispatch_perf_counters())
+        from .mesh import mesh_perf_counters
+        self.perf_collection.add(mesh_perf_counters())
         from .osd.ec_backend import pipeline_perf_counters
         self.perf_collection.add(pipeline_perf_counters())
         from .common.work_queue import qos_perf_counters

@@ -104,6 +104,24 @@ def build_options() -> List[Option]:
         .set_description("EC dispatch scheduler: total pending requests "
                          "across all queues before a forced "
                          "backpressure flush"),
+        Option("ec_mesh_chips", OPT_INT).set_default(0)
+        .set_description("devices in the dispatch mesh runtime "
+                         "(ceph_tpu/mesh): flushed encode batches "
+                         "shard their stripe rows across a 1-D batch-"
+                         "axis mesh of this many chips.  0 = mesh off "
+                         "(single-device dispatch, the existing path "
+                         "by construction); -1 = all addressable "
+                         "devices; N > 1 = the first N (clamped to "
+                         "what the process can see)"),
+        Option("ec_mesh_pool_buffers", OPT_INT).set_default(4)
+        .set_description("padded staging buffers the mesh runtime "
+                         "retains per batch shape for reuse across "
+                         "flushes (ceph_tpu/mesh/pool)"),
+        Option("ec_mesh_donate", OPT_BOOL).set_default(True)
+        .set_description("donate the sharded batch buffer to the mesh "
+                         "encode (donate_argnums) so the device "
+                         "recycles it into the output; ignored on "
+                         "backends without buffer aliasing (cpu)"),
         Option("ec_pipeline_depth", OPT_INT).set_default(1)
         .set_description("EC write pipeline: encodes a single PG may "
                          "keep in flight in the dispatch scheduler "

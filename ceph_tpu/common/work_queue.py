@@ -114,6 +114,118 @@ def _note_class_dequeue(op_class: str) -> None:
         qos_perf_counters().inc(idx)
 
 
+class DmClockArbiter:
+    """The dmclock-lite three-phase arbitration core, generalized over
+    an abstract ENTITY key with pluggable tag lookup — one
+    implementation shared by both virtual-clock tiers (the class tier
+    arbitrates op classes in :class:`MClockQueue`, the client tier
+    arbitrates client entities in :class:`ClientDmClock`) so the tiers
+    cannot drift (the ROADMAP residual-debt item; the self-tuning
+    control plane will turn tags through this one core).
+
+    Tags are (reservation, weight, limit) shares per 1000 dequeues of
+    the owning queue's virtual clock — one tick per pop, deterministic,
+    no wall time in the decision path (wall-rate enforcement is
+    :class:`WallMClockQueue`'s separate job).  The three phases:
+
+    - **reservation**: entities behind their guaranteed share go
+      first, most-behind-its-floor first;
+    - **weight**: among entities under their limit, the lowest
+      NORMALIZED virtual finish tag (``w_tag / weight``) wins;
+    - **limit**: ``w_tag >= now * limit / 1000`` stands an entity
+      aside in the weight phase — unless every candidate is at its
+      limit (work conservation: an idle server never refuses work).
+
+    Idle->active re-clamping (``activate``) keeps the dmclock
+    invariants: no hoarded reservation credit, and the weight tag
+    starts at the most-behind ACTIVE entity's normalized finish.  The
+    tiers differ only in the clamp's default when NOTHING else is
+    active: ``track_floor=True`` (client tier) remembers the last
+    served finish tag so a newcomer to an empty lane set cannot starve
+    entities with history; ``False`` (class tier) leaves the tag
+    untouched, the class tier's historical rule.
+    """
+
+    __slots__ = ("r_tags", "w_tags", "now", "w_floor", "track_floor")
+
+    def __init__(self, track_floor: bool = False):
+        self.r_tags: Dict[str, float] = {}
+        self.w_tags: Dict[str, float] = {}
+        self.now = 0.0
+        self.w_floor = 0.0          # last served normalized finish tag
+        self.track_floor = track_floor
+
+    def tick(self) -> None:
+        """Advance the virtual clock: one unit per dequeue attempt."""
+        self.now += 1.0
+
+    def activate(self, entity: str, res: float, weight: float,
+                 active: List[str],
+                 weight_of: Callable[[str], float]) -> None:
+        """Idle->active tag re-clamp for *entity*; *active* is the set
+        of entities with queued work (the activating entity's queue is
+        still empty when this runs)."""
+        if res > 0:
+            self.r_tags[entity] = max(self.r_tags.get(entity, 0.0),
+                                      self.now * res / 1000.0)
+        floors = [self.w_tags.get(c, 0.0) / max(weight_of(c), 1e-9)
+                  for c in active]
+        if floors:
+            floor = min(floors)
+        elif self.track_floor:
+            floor = self.w_floor
+        else:
+            return
+        self.w_tags[entity] = max(self.w_tags.get(entity, 0.0),
+                                  floor * max(weight, 1e-9))
+
+    def pick(self, candidates: List[str],
+             tags: Dict[str, Tuple[float, float, float]]) -> str:
+        """The three-phase choice among non-empty *candidates*."""
+        if len(candidates) == 1:
+            return candidates[0]
+        # phase 1: reservations — most-behind-its-floor first
+        best, best_deficit = None, 0.0
+        for c in candidates:
+            res = tags[c][0]
+            if res <= 0:
+                continue
+            deficit = self.now * res / 1000.0 - self.r_tags.get(c, 0.0)
+            if deficit > best_deficit:
+                best, best_deficit = c, deficit
+        if best is not None:
+            return best
+
+        # phase 2: weight shares — lowest normalized finish tag wins;
+        # entities at their limit stand aside unless all are (phase 3)
+        def finish(c: str) -> float:
+            return self.w_tags.get(c, 0.0) / max(tags[c][1], 1e-9)
+
+        under = [c for c in candidates
+                 if not self.at_limit(c, tags[c][2])]
+        return min(under or candidates, key=finish)
+
+    def at_limit(self, entity: str, lim: float) -> bool:
+        if lim <= 0:
+            return False
+        return self.w_tags.get(entity, 0.0) >= self.now * lim / 1000.0
+
+    def serve(self, entity: str, weight: float) -> None:
+        """Account one dequeue against *entity*'s tags."""
+        self.r_tags[entity] = self.r_tags.get(entity, 0.0) + 1.0
+        self.w_tags[entity] = self.w_tags.get(entity, 0.0) + 1.0
+        if self.track_floor:
+            self.w_floor = max(
+                self.w_floor,
+                self.w_tags[entity] / max(weight, 1e-9))
+
+    def forget(self, entity: str) -> None:
+        """Drop an evicted entity's tag state (bounded memory under
+        entity churn; a returner is re-clamped like any newcomer)."""
+        self.r_tags.pop(entity, None)
+        self.w_tags.pop(entity, None)
+
+
 class ClientDmClock:
     """The per-client dmClock lane INSIDE one op class's queue.
 
@@ -126,23 +238,23 @@ class ClientDmClock:
 
     Virtual clock: one tick per pop, so reservation/limit read as ops
     per 1000 client-tier dequeues — deterministic, like MClockQueue.
-    Per-client tags resolve override -> ``osd_mclock_client_*``
-    defaults; ``osd_mclock_client_overrides`` is parsed lazily
-    ("entity:res:weight:limit[,entity:...]") and re-parsed whenever the
-    option string changes, so injectargs takes effect immediately.
+    The arbitration itself is :class:`DmClockArbiter` — the SAME core
+    the class tier runs, parameterized only by this tier's tag lookup
+    and floor policy.  Per-client tags resolve override ->
+    ``osd_mclock_client_*`` defaults; ``osd_mclock_client_overrides``
+    is parsed lazily ("entity:res:weight:limit[,entity:...]") and
+    re-parsed whenever the option string changes, so injectargs takes
+    effect immediately.
     """
 
-    __slots__ = ("_queues", "_r_tags", "_w_tags", "_now", "_size",
-                 "_w_floor", "_dequeues", "_override_src", "_overrides",
+    __slots__ = ("_queues", "_arb", "_size",
+                 "_dequeues", "_override_src", "_overrides",
                  "_local_tags", "_defaults", "_resolved")
 
     def __init__(self):
         self._queues: Dict[str, Deque] = {}
-        self._r_tags: Dict[str, float] = {}
-        self._w_tags: Dict[str, float] = {}
-        self._now = 0.0
+        self._arb = DmClockArbiter(track_floor=True)
         self._size = 0
-        self._w_floor = 0.0          # last served normalized finish tag
         self._dequeues: Dict[str, int] = {}
         self._override_src: Optional[str] = None
         self._overrides: Dict[str, Tuple[float, float, float]] = {}
@@ -209,25 +321,15 @@ class ClientDmClock:
         if q is None:
             q = self._queues[client] = deque()
         if not q:
-            # idle -> active: clamp tags to the present (dmclock tag
-            # re-clamping) — no hoarded reservation credit, and the
-            # weight tag starts at the most-behind ACTIVE client's
-            # normalized finish (or the last served finish when alone),
-            # so neither newcomers nor returners starve anyone
+            # idle -> active re-clamp (DmClockArbiter.activate): no
+            # hoarded reservation credit, weight tag floored at the
+            # most-behind ACTIVE client's normalized finish (or the
+            # last served finish when alone — track_floor)
             self._refresh_tag_sources()
             res, weight, _lim = self._tags_for(client)
-            if res > 0:
-                self._r_tags[client] = max(
-                    self._r_tags.get(client, 0.0),
-                    self._now * res / 1000.0)
             active = [c for c, aq in self._queues.items() if aq]
-            floor = min(
-                (self._w_tags.get(c, 0.0)
-                 / max(self._tags_for(c)[1], 1e-9) for c in active),
-                default=self._w_floor)
-            self._w_tags[client] = max(
-                self._w_tags.get(client, 0.0),
-                floor * max(weight, 1e-9))
+            self._arb.activate(client, res, weight, active,
+                               lambda c: self._tags_for(c)[1])
         q.append(item)
         self._size += 1
 
@@ -236,41 +338,15 @@ class ClientDmClock:
         candidates = [c for c, q in self._queues.items() if q]
         if not candidates:
             return None
-        self._now += 1.0
+        self._arb.tick()
         # one option-change check per pop; per-candidate resolution is
         # then a cached dict lookup (nothing can change mid-decision)
         self._refresh_tag_sources()
         tags = {c: self._tags_for(c) for c in candidates}
-        if len(candidates) == 1:
-            best = candidates[0]
-        else:
-            # phase 1: reservations — most-behind-its-floor first
-            best, best_deficit = None, 0.0
-            for c in candidates:
-                res = tags[c][0]
-                if res <= 0:
-                    continue
-                deficit = self._now * res / 1000.0 \
-                    - self._r_tags.get(c, 0.0)
-                if deficit > best_deficit:
-                    best, best_deficit = c, deficit
-            if best is None:
-                # phase 2: weight shares — lowest normalized finish tag
-                # wins; clients at their limit stand aside unless all
-                # are (work-conserving)
-                def finish(c):
-                    return self._w_tags.get(c, 0.0) \
-                        / max(tags[c][1], 1e-9)
-                under = [c for c in candidates
-                         if not self._at_limit(c, tags[c][2])]
-                best = min(under or candidates, key=finish)
+        best = self._arb.pick(candidates, tags)
         item = self._queues[best].popleft()
         self._size -= 1
-        self._r_tags[best] = self._r_tags.get(best, 0.0) + 1.0
-        self._w_tags[best] = self._w_tags.get(best, 0.0) + 1.0
-        self._w_floor = max(
-            self._w_floor,
-            self._w_tags[best] / max(tags[best][1], 1e-9))
+        self._arb.serve(best, tags[best][1])
         self._dequeues[best] = self._dequeues.get(best, 0) + 1
         if not self._queues[best] and len(self._queues) > 64:
             # bound per-client memory under churn ("millions of
@@ -278,37 +354,34 @@ class ClientDmClock:
             # state — a returning client is re-clamped by push() like
             # any newcomer, so dropped history is safe by construction
             del self._queues[best]
-            self._r_tags.pop(best, None)
-            self._w_tags.pop(best, None)
+            self._arb.forget(best)
             self._dequeues.pop(best, None)
             self._resolved.pop(best, None)
         return item
-
-    def _at_limit(self, c: str, lim: float) -> bool:
-        if lim <= 0:
-            return False
-        return self._w_tags.get(c, 0.0) >= self._now * lim / 1000.0
 
     def dump(self) -> Dict:
         return {
             "queued": {c: len(q) for c, q in self._queues.items() if q},
             "dequeues": dict(self._dequeues),
-            "w_tags": {c: round(v, 3) for c, v in self._w_tags.items()},
+            "w_tags": {c: round(v, 3)
+                       for c, v in self._arb.w_tags.items()},
         }
 
 
 class MClockQueue:
     """dmclock-lite over a virtual clock that advances one unit per
-    dequeue (deterministic; no wall time in the decision path)."""
+    dequeue (deterministic; no wall time in the decision path).
+
+    The arbitration is :class:`DmClockArbiter` over op-class entity
+    keys with ``self.tags`` as the tag lookup — the SAME core the
+    per-client lanes inside each class run, so the two tiers cannot
+    drift apart."""
 
     def __init__(self, tags: Optional[Dict[str, Tuple[float, float,
                                                       float]]] = None):
         self.tags = dict(tags or DEFAULT_TAGS)
         self._queues: Dict[str, ClientDmClock] = {}
-        # per-class progress tags (dmclock's r/w tag pairs)
-        self._r_tags: Dict[str, float] = {}
-        self._w_tags: Dict[str, float] = {}
-        self._now = 0.0
+        self._arb = DmClockArbiter(track_floor=False)
         self._size = 0
 
     def enqueue(self, op_class: str, item, client: str = "") -> None:
@@ -318,21 +391,13 @@ class MClockQueue:
         if q is None:
             q = self._queues[op_class] = ClientDmClock()
         if not q:
-            # idle -> active: clamp the class's tags to the present so a
+            # idle -> active re-clamp (DmClockArbiter.activate): a
             # long-idle class cannot cash in an unbounded reservation
-            # deficit or dodge its limit (dmclock's tag re-clamping)
-            res = self.tags[op_class][0]
-            if res > 0:
-                self._r_tags[op_class] = max(
-                    self._r_tags.get(op_class, 0.0),
-                    self._now * res / 1000.0)
+            # deficit or dodge its limit
+            res, weight, _lim = self.tags[op_class]
             active = [c for c, aq in self._queues.items() if aq]
-            if active:
-                floor = min(self._w_tags.get(c, 0.0) /
-                            max(self.tags[c][1], 1e-9) for c in active)
-                self._w_tags[op_class] = max(
-                    self._w_tags.get(op_class, 0.0),
-                    floor * max(self.tags[op_class][1], 1e-9))
+            self._arb.activate(op_class, res, weight, active,
+                               lambda c: self.tags[c][1])
         q.push(client, item)
         self._size += 1
 
@@ -341,38 +406,18 @@ class MClockQueue:
 
     def dequeue(self):
         """Pop the QoS-chosen item; None when empty."""
-        self._now += 1.0
+        self._arb.tick()
         candidates = [c for c, q in self._queues.items() if q]
         if not candidates:
             return None
-        # phase 1: reservations — the class most behind its guaranteed
-        # rate goes first (dmclock's reservation tag comparison)
-        best, best_deficit = None, 0.0
-        for c in candidates:
-            res = self.tags[c][0]
-            if res <= 0:
-                continue
-            expect = self._now * res / 1000.0
-            deficit = expect - self._r_tags.get(c, 0.0)
-            if deficit > best_deficit:
-                best, best_deficit = c, deficit
-        if best is None:
-            # phase 2: weight sharing — lowest virtual finish tag wins,
-            # classes at their limit stand aside (unless all are)
-            def finish_tag(c):
-                return self._w_tags.get(c, 0.0) / max(self.tags[c][1],
-                                                      1e-9)
-            under = [c for c in candidates if not self._at_limit(c)]
-            pool = under or candidates
-            best = min(pool, key=finish_tag)
+        best = self._arb.pick(candidates, self.tags)
         # stage ledger: the class tier picked this class NOW; the lane
         # pop below is the client tier's own arbitration (oplat stages
         # class_queue / client_lane — host-side stamps only)
         t_pick = time.perf_counter()
         item = self._queues[best].pop()
         self._size -= 1
-        self._r_tags[best] = self._r_tags.get(best, 0.0) + 1.0
-        self._w_tags[best] = self._w_tags.get(best, 0.0) + 1.0
+        self._arb.serve(best, self.tags[best][1])
         _note_class_dequeue(best)
         mark_item(item, "class_queue", t_pick)
         mark_item(item, "client_lane")
@@ -381,9 +426,9 @@ class MClockQueue:
     def dump(self) -> Dict:
         return {
             "queued": {c: len(q) for c, q in self._queues.items() if q},
-            "vclock": self._now,
-            "r_tags": dict(self._r_tags),
-            "w_tags": dict(self._w_tags),
+            "vclock": self._arb.now,
+            "r_tags": dict(self._arb.r_tags),
+            "w_tags": dict(self._arb.w_tags),
             # client-tier accounting survives a drained queue: the
             # dequeue history is exactly what an operator inspects
             # AFTER a burst
@@ -391,10 +436,7 @@ class MClockQueue:
         }
 
     def _at_limit(self, c: str) -> bool:
-        lim = self.tags[c][2]
-        if lim <= 0:
-            return False
-        return self._w_tags.get(c, 0.0) >= self._now * lim / 1000.0
+        return self._arb.at_limit(c, self.tags[c][2])
 
 
 class WallMClockQueue:

@@ -130,15 +130,28 @@ def run_group(reqs: List[Request], bucket_c: int) -> List:
     """One coalesced device call for a signature/bucket group; returns
     per-request results aligned with *reqs*.  Any failure propagates to
     the caller, which re-runs each request alone so one bad request
-    cannot poison its batchmates."""
-    if len(reqs) == 1:
-        return [run_one(reqs[0])]
+    cannot poison its batchmates.
+
+    With a mesh up (ceph_tpu/mesh, ``ec_mesh_chips``) encode groups —
+    including single-request flushes, whose stripes alone can span the
+    chips — execute through the mesh runtime instead of one device;
+    mesh off (the default) or size 1 is the existing path by
+    construction."""
     leader = reqs[0].ec_impl
     kind = reqs[0].kind
     use_device = bool(getattr(leader, "_use_device", lambda: False)())
     if kind == KIND_ENCODE:
-        return _run_group_encode(reqs, bucket_c, leader, use_device)
+        if len(reqs) > 1 or (use_device and _mesh_active()):
+            return _run_group_encode(reqs, bucket_c, leader, use_device)
+        return [run_one(reqs[0])]
+    if len(reqs) == 1:
+        return [run_one(reqs[0])]
     return _run_group_decode(reqs, bucket_c, leader, use_device, kind)
+
+
+def _mesh_active() -> bool:
+    from ..mesh import g_mesh
+    return g_mesh.active()
 
 
 def _run_group_encode(reqs, bucket_c, leader, use_device):
@@ -146,18 +159,33 @@ def _run_group_encode(reqs, bucket_c, leader, use_device):
     # each is zero-padded to the bucket width and sliced back to its own
     # width (columnwise independence makes the pad invisible)
     k = leader.get_data_chunk_count()
-    stacks, offsets, s0 = [], [], 0
+    raw, offsets, s0 = [], [], 0
     for r in reqs:
         stripes = np.frombuffer(bytes(r.payload), dtype=np.uint8) \
             if not isinstance(r.payload, np.ndarray) else r.payload
         stripes = stripes.reshape(r.n_stripes, k, r.chunk_size)
-        stacks.append(_pad_cols(stripes, bucket_c))
+        raw.append(stripes)
         offsets.append((s0, stripes))
         s0 += r.n_stripes
-    stacked = np.ascontiguousarray(np.concatenate(stacks))
-    g_devprof.account_host_copy("dispatch.stack", stacked.nbytes)
-    big = _pad_stripes(stacked, use_device)
-    coding = leader.encode_batch(big)          # (S_total[, pad], m, Cb)
+    coding = None
+    if use_device:
+        # mesh path: the runtime assembles straight into its pooled
+        # padded staging buffer and shards the batch axis across the
+        # chips; None means mesh down / codec not row-shardable /
+        # guarded call exhausted — the single-device path below is the
+        # degradation, exactly as before the mesh existed
+        from ..mesh import g_mesh
+        coding = g_mesh.encode_stacked(leader, raw, bucket_c)
+    if coding is None:
+        stacks = [_pad_cols(st, bucket_c) for st in raw]
+        if len(stacks) == 1:
+            # a single-request flush only reaches here when the mesh
+            # declined it mid-flight: run the exact per-request path
+            return [run_one(reqs[0])]
+        stacked = np.ascontiguousarray(np.concatenate(stacks))
+        g_devprof.account_host_copy("dispatch.stack", stacked.nbytes)
+        big = _pad_stripes(stacked, use_device)
+        coding = leader.encode_batch(big)      # (S_total[, pad], m, Cb)
     _mark_device_call(reqs)
     coding = np.asarray(coding)
     out: List[Dict[int, np.ndarray]] = []

@@ -452,7 +452,15 @@ class DeviceDispatcher:
             "queues": queues,
             "counters": dispatch_perf_counters().dump(),
             "occupancy_histogram": self._hist.dump(),
+            "mesh": self._mesh_dump(),
         }
+
+    @staticmethod
+    def _mesh_dump() -> Dict:
+        """The mesh runtime's state rides `dispatch dump`: the mesh is
+        the flush path's device back end, so operators read one pane."""
+        from ..mesh import g_mesh
+        return g_mesh.dump()
 
 
 # process-wide scheduler: one accelerator per process, like g_tracer

@@ -193,6 +193,14 @@ class ErasureCodeJerasure(ErasureCodeMatrixRS):
         return 1
 
     @property
+    def mesh_row_shardable(self) -> bool:
+        # bitmatrix/word layouts reshape data into a virtual layout
+        # before the backend matmul; the mesh plan runs the PLAIN
+        # row-independent matmul, so only the plain-matrix techniques
+        # may shard (the mesh runtime declines the rest)
+        return not (self.is_bitmatrix or self.is_word_code)
+
+    @property
     def _device_decode_supported(self) -> bool:
         # bitmatrix/word layouts decode through the host codec (their
         # device backends consume virtual/word layouts, not whole chunks)

@@ -43,6 +43,17 @@ class ErasureCodeMatrixRS(ErasureCode):
     # None = the concrete class name
     signature_family: "str | None" = None
 
+    # the mesh runtime (ceph_tpu/mesh) may shard this codec's batched
+    # encode over the batch axis: true only when encode_batch IS the
+    # plain row-independent bit-matmul on raw (S, k, C) chunks.
+    # Codecs whose device path transforms the data layout first
+    # (jerasure bitmatrix/word codes) override this to False — the
+    # mesh plan models the plain matmul only, so sharding a
+    # transformed layout would corrupt output.
+    @property
+    def mesh_row_shardable(self) -> bool:
+        return True
+
     def codec_signature(self):
         """The dispatcher's grouping key: everything the coding matrix
         is derived from.  Two impls with equal signatures encode and

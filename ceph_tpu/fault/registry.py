@@ -76,6 +76,9 @@ SITE_CATALOG: Dict[str, str] = {
     "dispatch.batch":
         "coalesced flush execution (scheduler._execute run_group) — "
         "exercises the per-request fallback isolation",
+    "mesh.encode_batch":
+        "mesh-sharded flush execution (ceph_tpu/mesh runtime) — "
+        "exhaustion degrades the flush to the single-device path",
     "osd.shard_read_eio":
         "shard-side EC read returns EIO (bluestore_debug_inject_read_err "
         "role) — the primary must reconstruct from surviving shards",

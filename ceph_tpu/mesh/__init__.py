@@ -1,0 +1,15 @@
+"""Mesh execution runtime: one dispatch scheduler feeding N chips.
+
+See runtime.py for the design; docs/DISPATCH.md "Mesh-sharded
+dispatch" for the operator story.
+"""
+from .pool import StagingPool
+from .runtime import (MeshRuntime, ShardingPlan, chip_occupancy_axes,
+                      g_mesh, mesh_perf_counters)
+from .topology import BATCH_AXIS, addressable_devices, batch_mesh
+
+__all__ = [
+    "BATCH_AXIS", "MeshRuntime", "ShardingPlan", "StagingPool",
+    "addressable_devices", "batch_mesh", "chip_occupancy_axes",
+    "g_mesh", "mesh_perf_counters",
+]

@@ -1,0 +1,407 @@
+"""MeshRuntime — one scheduler feeding N chips.
+
+The dispatch scheduler (ceph_tpu/dispatch) coalesces concurrent EC
+requests into one padded device call, but until this subsystem that
+call landed on a single device: "more traffic" could never become
+"more chips".  The runtime threads a mesh layer between the batch
+assembler and the codec backends:
+
+- **topology**: a 1-D ``("batch",)`` mesh over the addressable devices
+  (``ec_mesh_chips``; CPU smoke via
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=8``).  Mesh size 1
+  — or ``ec_mesh_chips=0``, the default — is the existing
+  single-device path BY CONSTRUCTION: ``encode_stacked`` returns None
+  and the assembler runs today's code, so nothing changes until an
+  operator turns the knob.
+- **sharding-plan cache**: keyed by (codec signature, chunk bucket) —
+  the same key space the dispatch queues use — each plan holds the
+  ``NamedSharding(mesh, PartitionSpec("batch"))`` input placement, the
+  mesh-replicated encode bit-matrix, and the jitted sharded matmul.
+  The batch (stripe) axis pads to the next power of two rounded up to
+  a mesh-size multiple, so the jit cache stays O(log S) per plan and
+  every chip takes an equal row slice.
+- **donated staging pool**: the padded batch buffer is acquired from a
+  per-shape pool (reused across flushes instead of re-allocated;
+  pool.py) and the sharded matmul donates its input
+  (``donate_argnums=(0,)``) where the backend supports donation (not
+  cpu), so the device-side padded buffer is recycled into the output
+  instead of doubling HBM per flush.  Donation changes allocation
+  only, never the data path — the copy-budget gate holds it to zero
+  new host copies.
+- **accounting**: per-chip occupancy (stripes of real — non-pad —
+  work each chip received per flush) lands in the 2-D
+  ``dispatch_chip_occupancy_histogram`` and a per-chip totals table;
+  ``mesh`` perf counters ride perf dump / Prometheus
+  (``ceph_daemon_mesh_*``) and ``dispatch dump`` carries the whole
+  runtime state.
+
+Failure policy: the sharded call runs under the fault guard
+(``run_device_call`` — injection site ``mesh.encode_batch``, bounded
+retry, watchdog, per-signature breaker).  ``DeviceUnavailable``
+degrades to the single-device assembler path (which itself degrades to
+the host matrix twin), so a sick mesh costs throughput, never an op.
+
+Scope: the runtime shards the ENCODE kind (the write path — the
+flagship ROADMAP refactor).  Decode/reconstruct groups keep the
+single-device path; the survivor-sharded mesh decode in
+``parallel/ec.py`` (ShardedRS) is the building block for that
+follow-up (see ROADMAP).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.config import g_conf
+from ..common.perf_counters import PerfCounters, PerfCountersBuilder
+from ..trace.devprof import g_devprof
+from ..trace.histogram import (PerfHistogramAxis, SCALE_LINEAR,
+                               g_perf_histograms)
+from .pool import StagingPool
+from .topology import BATCH_AXIS, batch_mesh
+
+# ---- perf counters (perf dump / Prometheus ceph_daemon_mesh_*) -------------
+MESH_FIRST = 98000
+l_mesh_dispatches = 98001      # flushes executed through the mesh
+l_mesh_reqs = 98002            # coalesced requests through mesh flushes
+l_mesh_stripes = 98003         # real (non-pad) stripes sharded
+l_mesh_pad_stripes = 98004     # zero-pad lanes added for divisibility
+l_mesh_bytes = 98005           # payload bytes through mesh flushes
+l_mesh_plan_builds = 98006     # sharding plans compiled (cache misses)
+l_mesh_plan_hits = 98007       # sharding-plan cache hits
+l_mesh_pool_hits = 98008       # staging buffers served from the pool
+l_mesh_pool_misses = 98009     # staging buffers freshly allocated
+l_mesh_fallbacks = 98010       # flushes degraded to the single-device path
+l_mesh_chips = 98011           # gauge: current mesh size
+MESH_LAST = 98020
+
+_mesh_pc: Optional[PerfCounters] = None
+_mesh_pc_lock = threading.Lock()
+
+
+def mesh_perf_counters() -> PerfCounters:
+    """The mesh runtime's counter logger (perf dump / Prometheus)."""
+    global _mesh_pc
+    if _mesh_pc is not None:
+        return _mesh_pc
+    with _mesh_pc_lock:
+        if _mesh_pc is None:
+            b = PerfCountersBuilder("mesh", MESH_FIRST, MESH_LAST)
+            b.add_u64_counter(l_mesh_dispatches, "dispatches",
+                              "flushes executed through the mesh")
+            b.add_u64_counter(l_mesh_reqs, "reqs",
+                              "coalesced requests through mesh flushes")
+            b.add_u64_counter(l_mesh_stripes, "stripes",
+                              "real stripes sharded across the mesh")
+            b.add_u64_counter(l_mesh_pad_stripes, "pad_stripes",
+                              "zero-pad stripe lanes added for batch-"
+                              "axis divisibility")
+            b.add_u64_counter(l_mesh_bytes, "bytes",
+                              "payload bytes through mesh flushes")
+            b.add_u64_counter(l_mesh_plan_builds, "plan_builds",
+                              "sharding plans built (cache misses)")
+            b.add_u64_counter(l_mesh_plan_hits, "plan_hits",
+                              "sharding-plan cache hits")
+            b.add_u64_counter(l_mesh_pool_hits, "pool_hits",
+                              "staging buffers reused from the pool")
+            b.add_u64_counter(l_mesh_pool_misses, "pool_misses",
+                              "staging buffers freshly allocated")
+            b.add_u64_counter(l_mesh_fallbacks, "fallbacks",
+                              "mesh flushes degraded to the single-"
+                              "device path")
+            b.add_u64(l_mesh_chips, "chips",
+                      "devices in the active dispatch mesh")
+            _mesh_pc = b.create_perf_counters()
+    return _mesh_pc
+
+
+def chip_occupancy_axes() -> List[PerfHistogramAxis]:
+    """2-D per-chip occupancy: axis 0 = real stripes a chip received
+    in one mesh flush (linear unit buckets, 0..64 individually visible
+    like the batch-occupancy axis), axis 1 = the chip's index in the
+    mesh (linear, chips 0..63 individually visible — a pod-slice-sized
+    bound; larger meshes merge the tail into the overflow bucket, and
+    the exact per-chip totals stay on ``dispatch dump``'s per_chip
+    table either way).  Both axes are dimensionless, so the mgr
+    renderer exports raw edges."""
+    return [PerfHistogramAxis("chip_stripes", min=0, quant_size=1,
+                              buckets=67, scale_type=SCALE_LINEAR),
+            PerfHistogramAxis("chip_index", min=0, quant_size=1,
+                              buckets=66, scale_type=SCALE_LINEAR)]
+
+
+class ShardingPlan:
+    """One compiled placement for a (codec signature, chunk bucket):
+    input rows sharded over the batch axis, bit-matrix replicated,
+    output rows sharded in place."""
+
+    __slots__ = ("mesh", "in_sharding", "enc_bits", "fn", "donated",
+                 "hits")
+
+    def __init__(self, mesh, backend, donate: bool):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..ops.gf_matmul import gf_bit_matmul
+        self.mesh = mesh
+        self.in_sharding = NamedSharding(mesh, P(BATCH_AXIS, None, None))
+        # the bit-matrix is the contraction operand: replicate it so the
+        # forward path needs zero collectives (parallel/ec.py's layout)
+        self.enc_bits = jax.device_put(
+            backend._enc_bits, NamedSharding(mesh, P(None, None)))
+        out_sharding = NamedSharding(mesh, P(BATCH_AXIS, None, None))
+        # donation recycles the padded input rows into the output on
+        # backends that support aliasing (tpu/gpu); cpu would ignore it
+        # with a per-call warning, so the plan records what it got
+        self.donated = bool(donate)
+        donate_argnums = (0,) if self.donated else ()
+        self.fn = jax.jit(gf_bit_matmul, out_shardings=out_sharding,
+                          donate_argnums=donate_argnums)
+        self.hits = 0
+
+
+class MeshRuntime:
+    """The dispatch scheduler's device back end when a mesh is up."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._mesh = None
+        self._mesh_n = None          # ec_mesh_chips the mesh was built for
+        self._plans: Dict[Tuple, ShardingPlan] = {}
+        self._pool = StagingPool()
+        self._chips: Dict[int, Dict[str, int]] = {}
+
+    # ---- options (read live so `config set` applies without restart) ------
+    @staticmethod
+    def _opts() -> Tuple[int, int, bool]:
+        return (int(g_conf.get_val("ec_mesh_chips")),
+                int(g_conf.get_val("ec_mesh_pool_buffers")),
+                bool(g_conf.get_val("ec_mesh_donate")))
+
+    @property
+    def _hist(self):
+        return g_perf_histograms.get(
+            "dispatch", "dispatch_chip_occupancy_histogram",
+            chip_occupancy_axes)
+
+    # ---- topology ----------------------------------------------------------
+    def topology(self):
+        """The current batch mesh, rebuilt when ``ec_mesh_chips``
+        changes (plans are placement-bound, so they drop with it)."""
+        chips, pool_cap, _donate = self._opts()
+        with self._lock:
+            if self._mesh is not None and self._mesh_n == chips:
+                # ec_mesh_pool_buffers stays live even when the
+                # topology is unchanged (guarded: one unlocked read
+                # per flush, the trim only runs on an actual change)
+                if self._pool._per_shape != max(int(pool_cap), 1):
+                    self._pool.set_capacity(pool_cap)
+                return self._mesh
+            self._plans.clear()
+            self._pool.clear()
+            self._pool.set_capacity(pool_cap)
+            self._chips.clear()
+            if chips == 0:
+                self._mesh, self._mesh_n = None, 0
+            else:
+                self._mesh = batch_mesh(chips)
+                self._mesh_n = chips
+                mesh_perf_counters().set(l_mesh_chips, self._mesh.size)
+            if self._mesh is None:
+                mesh_perf_counters().set(l_mesh_chips, 0)
+            return self._mesh
+
+    def active(self) -> bool:
+        """True when flushes should shard: a mesh of >= 2 devices is
+        up.  ``ec_mesh_chips=0`` (default) or a 1-device topology keeps
+        the single-device path by construction."""
+        mesh = self.topology()
+        return mesh is not None and mesh.size > 1
+
+    # ---- the flush entry point (dispatch/batch.py assembly) ---------------
+    def encode_stacked(self, leader, stripes_list: List[np.ndarray],
+                       bucket_c: int) -> Optional[np.ndarray]:
+        """Shard one flushed encode group across the mesh.
+
+        *stripes_list* holds each request's (S_i, k, C_i) uint8 view
+        (C_i <= *bucket_c*; the assembler's column-pad contract).
+        Returns the coalesced coding rows (S_pad, m, bucket_c) — the
+        caller slices each request's rows/columns back out exactly as
+        on the single-device path — or None when the mesh is down,
+        the codec has no plain bit-matrix backend, or the guarded
+        device call exhausted its retries (the caller then runs the
+        single-device path, which itself degrades to the host twin)."""
+        if not self.active():
+            return None
+        backend = self._bit_backend(leader)
+        if backend is None:
+            return None
+        from ..dispatch.signature import codec_signature
+        from ..fault import DeviceUnavailable, run_device_call
+        sig = codec_signature(leader)
+        try:
+            return run_device_call(
+                sig, "mesh.encode_batch",
+                lambda: self._encode(sig, backend, stripes_list,
+                                     bucket_c))
+        except DeviceUnavailable:
+            mesh_perf_counters().inc(l_mesh_fallbacks)
+            return None
+
+    @staticmethod
+    def _bit_backend(leader):
+        """The leader's plain GF(2^8) bit-matmul backend, or None for
+        codecs whose device layout is not row-shardable by this plan
+        shape.  TWO gates, both required: the codec must declare
+        ``mesh_row_shardable`` (its encode_batch is the plain matmul
+        on raw chunks — jerasure's bitmatrix/word layouts transform
+        the data first and override it to False) and the backend must
+        be a plain :class:`DeviceRSBackend` (word codes ride
+        DeviceWordRSBackend)."""
+        from ..ops.gf_matmul import DeviceRSBackend
+        if not getattr(leader, "mesh_row_shardable", False):
+            return None
+        dev_fn = getattr(leader, "device", None)
+        if dev_fn is None:
+            return None
+        try:
+            backend = dev_fn()
+        except Exception:
+            return None
+        return backend if type(backend) is DeviceRSBackend else None
+
+    def _encode(self, sig: Tuple, backend, stripes_list, bucket_c: int
+                ) -> np.ndarray:
+        import jax
+        mesh = self.topology()
+        plan = self._plan(sig, bucket_c, backend, mesh)
+        k = backend.k
+        s_total = sum(int(st.shape[0]) for st in stripes_list)
+        s_pad = self._pad_rows(s_total, mesh.size)
+        pc = mesh_perf_counters()
+        buf, pooled = self._pool.acquire((s_pad, k, bucket_c))
+        pc.inc(l_mesh_pool_hits if pooled else l_mesh_pool_misses)
+        try:
+            # assembly: every request's rows land directly in the
+            # padded staging buffer — the old path's pad_cols + stack
+            # + pad_stripes chain (up to three accounted copies)
+            # collapses into ONE
+            off = 0
+            nbytes = 0
+            for st in stripes_list:
+                s_i, _k, c_i = st.shape
+                buf[off:off + s_i, :, :c_i] = st
+                off += s_i
+                nbytes += st.nbytes
+            g_devprof.account_host_copy("mesh.assemble", buf.nbytes)
+            g_devprof.install_compile_listener()
+            g_devprof.account_h2d("mesh.encode", buf.nbytes)
+            from ..common.kernel_trace import g_kernel_timer
+            with g_devprof.stage("mesh.encode"):
+                def sharded_call():
+                    dev_in = jax.device_put(buf, plan.in_sharding)
+                    # np.asarray gathers every shard to the host — the
+                    # materialization IS the completion fence (each
+                    # chip's rows cross back; the bench twin drains
+                    # per-shard via parallel.drain_sharded)
+                    return np.asarray(plan.fn(dev_in, plan.enc_bits))
+                coding = g_kernel_timer.timed("ec_encode_batch_mesh",
+                                              sharded_call)
+        finally:
+            # release on failure too: the fault-guard retry path must
+            # not turn every failed attempt into a leaked buffer
+            self._pool.release(buf)
+        g_devprof.account_d2h("mesh.encode", coding.nbytes)
+        self._account_chips(mesh, s_total, s_pad,
+                            len(stripes_list), nbytes)
+        return coding
+
+    @staticmethod
+    def _pad_rows(s: int, mesh_size: int) -> int:
+        """Batch-axis pad target: the next power of two (O(log S) jit
+        cache, like the single-device stripe pad) rounded up to a
+        mesh-size multiple (equal row slices per chip)."""
+        from ..dispatch.signature import next_pow2
+        p = max(next_pow2(max(s, 1)), mesh_size)
+        return ((p + mesh_size - 1) // mesh_size) * mesh_size
+
+    def _plan(self, sig: Tuple, bucket_c: int, backend, mesh
+              ) -> ShardingPlan:
+        _chips, _cap, donate_opt = self._opts()
+        platform = getattr(np.asarray(mesh.devices).ravel()[0],
+                           "platform", "cpu")
+        donate = donate_opt and platform != "cpu"
+        # the donate flag is part of the key, so toggling
+        # ec_mesh_donate takes effect on the next flush (a plan bakes
+        # donate_argnums into its jit) instead of waiting for a
+        # topology rebuild
+        key = (sig, bucket_c, donate)
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is not None and plan.mesh is mesh:
+                plan.hits += 1
+                mesh_perf_counters().inc(l_mesh_plan_hits)
+                return plan
+        plan = ShardingPlan(mesh, backend, donate)
+        with self._lock:
+            self._plans[key] = plan
+        mesh_perf_counters().inc(l_mesh_plan_builds)
+        return plan
+
+    def _account_chips(self, mesh, s_total: int, s_pad: int,
+                       n_reqs: int, nbytes: int) -> None:
+        pc = mesh_perf_counters()
+        pc.inc(l_mesh_dispatches)
+        pc.inc(l_mesh_reqs, n_reqs)
+        pc.inc(l_mesh_stripes, s_total)
+        pc.inc(l_mesh_pad_stripes, s_pad - s_total)
+        pc.inc(l_mesh_bytes, nbytes)
+        rows = s_pad // mesh.size
+        hist = self._hist
+        devices = np.asarray(mesh.devices).ravel()
+        with self._lock:
+            for i in range(mesh.size):
+                real = min(max(s_total - i * rows, 0), rows)
+                hist.inc(real, i)
+                c = self._chips.get(i)
+                if c is None:
+                    c = self._chips[i] = {
+                        "stripes": 0, "dispatches": 0,
+                        "device": str(devices[i])}
+                c["stripes"] += real
+                c["dispatches"] += 1
+
+    # ---- introspection -----------------------------------------------------
+    def per_chip(self) -> Dict[int, Dict[str, int]]:
+        """Per-chip totals (copy) — the occupancy receipt the bench and
+        the tier-1 mesh smoke read before/after a batched write."""
+        with self._lock:
+            return {i: dict(v) for i, v in sorted(self._chips.items())}
+
+    def dump(self) -> Dict:
+        chips, pool_cap, donate = self._opts()
+        mesh = self.topology()
+        with self._lock:
+            plans = [{"signature": list(map(str, key[0])),
+                      "bucket_chunk_size": key[1],
+                      "donated": p.donated, "hits": p.hits}
+                     for key, p in sorted(self._plans.items(),
+                                          key=lambda kv: str(kv[0]))]
+        return {
+            "options": {"ec_mesh_chips": chips,
+                        "ec_mesh_pool_buffers": pool_cap,
+                        "ec_mesh_donate": donate},
+            "active": self.active(),
+            "size": 0 if mesh is None else mesh.size,
+            "axis": BATCH_AXIS,
+            "per_chip": self.per_chip(),
+            "plans": plans,
+            "pool": self._pool.dump(),
+            "counters": mesh_perf_counters().dump(),
+        }
+
+
+# process-wide runtime, like g_dispatcher: one accelerator complex per
+# process, shared by every daemon the mini-cluster hosts
+g_mesh = MeshRuntime()

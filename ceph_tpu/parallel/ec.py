@@ -60,6 +60,13 @@ def drain_sharded(out) -> int:
             one = piece
         np.asarray(one)   # THE fence: the device->host readback
         n += 1
+    # deliberately NOT accounted on the devflow ledger: n one-element
+    # fetches are sub-byte calibration noise, and per-shard accounting
+    # would put copies_per_op = n/n_steps over the copy-budget gate's
+    # noise floor at a value that moves with step calibration — a
+    # flaky gate, not a copy chain.  The dispatch-path mesh flush
+    # accounts its REAL boundary crossings at mesh.assemble /
+    # mesh.encode (ceph_tpu/mesh/runtime.py).
     return n
 
 

@@ -12,6 +12,7 @@ site catalog, arming a trigger, the unknown-site refusal, and clearing.
       "device.encode_batch": "batched EC encode device call (matrix_plugin.encode_batch)",
       "device.encode_chunks": "per-stripe encode device call (matrix_plugin.encode_chunks)",
       "dispatch.batch": "coalesced flush execution (scheduler._execute run_group) \u2014 exercises the per-request fallback isolation",
+      "mesh.encode_batch": "mesh-sharded flush execution (ceph_tpu/mesh runtime) \u2014 exhaustion degrades the flush to the single-device path",
       "msg.drop": "drop a fabric message (ms inject socket failures role); context is '<MsgType> <src>><dst>' for match= scoping",
       "osd.shard_read_eio": "shard-side EC read returns EIO (bluestore_debug_inject_read_err role) \u2014 the primary must reconstruct from surviving shards",
       "tpu.decode_batch_device": "device-resident decode entry point (tpu_plugin, mesh/bench)",

@@ -377,6 +377,7 @@ def test_smoke_mode_end_to_end():
             "ec_dispatch_coalesce_fenced",
             "ec_dispatch_serial_fenced",
             "ec_pipeline_fenced", "ec_pipeline_depth1_fenced",
+            "ec_mesh_fenced", "ec_mesh_single_fenced",
             "traffic_harness_smoke"} <= names
     # the coalesce metric carries its serial twin and speedup
     mc = next(m for m in out["metrics"]
@@ -392,6 +393,26 @@ def test_smoke_mode_end_to_end():
     assert mp["mean_batch_occupancy"] >= 4, mp
     assert mp["identical"] is True
     assert mp["depth1_gibs"] > 0 and mp["speedup"] > 0
+    # mesh acceptance (ceph_tpu/mesh): the 8-device CPU mesh smoke is
+    # byte-identical to the single-device twin through the REAL
+    # dispatch path, and the coalesced flush put work on EVERY chip
+    mmesh = next(m for m in out["metrics"]
+                 if m["name"] == "ec_mesh_fenced")
+    assert mmesh["mesh_chips"] == 8 and mmesh["mesh_size"] == 8
+    assert mmesh["identical"] is True
+    assert mmesh["n_devices"] == 8
+    assert len(mmesh["per_chip_stripes"]) == 8
+    assert all(v > 0 for v in mmesh["per_chip_stripes"].values()), \
+        mmesh["per_chip_stripes"]
+    assert mmesh["single_gibs"] > 0 and mmesh["speedup"] > 0
+    assert mmesh["plan_cache"] >= 1
+    # the mesh leg's fence is drain_sharded + mesh_roofline: the
+    # verdict must come back scaled by the mesh (never suspect on the
+    # tiny smoke shapes) and the single twin keeps n_devices == 1
+    assert mmesh["roofline"]["verdict"] in ("ok", "unknown")
+    m1 = next(m for m in out["metrics"]
+              if m["name"] == "ec_mesh_single_fenced")
+    assert m1["n_devices"] == 1
     # traffic-harness acceptance (docs/QOS.md): >= 8 concurrent
     # synthetic clients, every op byte-exact, per-client p99 non-empty
     # in the bench JSON

@@ -17,12 +17,25 @@ import pytest
 
 @pytest.fixture(scope="module")
 def cluster_and_text():
+    from ceph_tpu.common.config import g_conf
     from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.mesh import g_mesh
     c = MiniCluster(n_osds=6)
     c.create_ec_pool("lint", k=3, m=2, pg_num=8)
     cl = c.client("client.lint")
     assert cl.write_full("lint", "o", b"c" * 16000) == 0
     assert cl.read("lint", "o")[:1] == b"c"
+    # one write through the MESH path so the per-chip occupancy
+    # histogram registers and the mesh counters move — the lint below
+    # then covers the mesh families like any other
+    g_conf.set_val("ec_mesh_chips", 8)
+    g_conf.set_val("ec_dispatch_batch_window_us", 200_000)
+    try:
+        assert cl.write_full("lint", "om", b"m" * 60000) == 0
+    finally:
+        g_conf.rm_val("ec_mesh_chips")
+        g_conf.rm_val("ec_dispatch_batch_window_us")
+        g_mesh.topology()
     # one mgr tick so the telemetry ring holds a post-IO sample and
     # the ceph_cluster_* rollup families render with real content
     c.tick(dt=1.0)
@@ -94,6 +107,11 @@ def test_known_new_families_covered_by_the_lint(cluster_and_text):
     c, _text = cluster_and_text
     assert "devprof" in c.perf_collection.dump()
     assert "oplat" in c.perf_collection.dump()
+    # mesh-PR canary: the mesh logger is registered AND the fixture's
+    # mesh write registered the per-chip occupancy family, so the
+    # generic lints above really cover the mesh surfaces
+    assert "mesh" in c.perf_collection.dump()
+    assert c.perf_collection.dump()["mesh"]["dispatches"] > 0
     from ceph_tpu.trace import g_perf_histograms
     from ceph_tpu.trace.oplat import stage_of_hist_name
     assert any(lg == "devprof" for (lg, _n), _h
@@ -106,6 +124,8 @@ def test_known_new_families_covered_by_the_lint(cluster_and_text):
                     if stage_of_hist_name(n)}
     assert {"admission", "class_queue", "device_call", "reply"} <= \
         oplat_stages, oplat_stages
+    assert any(n == "dispatch_chip_occupancy_histogram"
+               for (_lg, n), _h in g_perf_histograms.items())
 
 
 def test_cluster_rollup_families_exported(cluster_and_text):

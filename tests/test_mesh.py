@@ -1,0 +1,375 @@
+"""Mesh runtime: one scheduler feeding N chips (the mesh PR's
+acceptance gates).
+
+- ``ec_mesh_chips=0`` (the default) and a 1-device mesh are the
+  existing single-device dispatch path by construction;
+- with an 8-device mesh up, mesh-dispatched encode groups are
+  byte-identical to the single-device oracle across randomized
+  (k, m, technique, size) mixes INCLUDING batch occupancies that are
+  not a multiple of the mesh size (padding lanes never leak);
+- a mesh-dispatched cluster stores shard BODIES byte-identical to a
+  single-device twin;
+- the tier-1 mesh smoke: a batched write on the forced 8-device
+  host-platform mesh puts work on EVERY chip (per-chip occupancy > 0);
+- the sharding-plan cache and the staging pool actually reuse;
+- a DeviceUnavailable mesh call degrades to the single-device path
+  (fault site ``mesh.encode_batch``), clients never see it;
+- observability: per-chip occupancy histogram, ``ceph_daemon_mesh_*``
+  counters on Prometheus, the mesh block on ``dispatch dump``;
+- the mesh write path adds ZERO device syncs with tracing off
+  (fence-count gate extended).
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.dispatch import g_dispatcher
+from ceph_tpu.ec.isa import ErasureCodeIsa
+from ceph_tpu.ec.tpu_plugin import ErasureCodeTpu
+from ceph_tpu.mesh import g_mesh, mesh_perf_counters
+from ceph_tpu.mesh.runtime import (l_mesh_dispatches, l_mesh_fallbacks,
+                                   l_mesh_plan_builds, l_mesh_pool_hits)
+from ceph_tpu.osd.ecutil import (decode_concat as eu_decode_concat,
+                                 encode as eu_encode, stripe_info_t)
+
+
+@pytest.fixture
+def mesh_conf():
+    """Every test leaves the dispatcher drained, the options at their
+    defaults, and the mesh torn back down."""
+    yield
+    g_dispatcher.flush()
+    for name in ("ec_mesh_chips", "ec_mesh_pool_buffers",
+                 "ec_mesh_donate", "ec_dispatch_batch_max",
+                 "ec_dispatch_batch_window_us", "ec_dispatch_queue_max",
+                 "ec_pipeline_depth"):
+        g_conf.rm_val(name)
+    g_mesh.topology()      # rebuild to the default (mesh off)
+
+
+def _mesh_on(chips=8, batch_max=64, window_us=10_000_000):
+    g_conf.set_val("ec_mesh_chips", chips)
+    g_conf.set_val("ec_dispatch_batch_window_us", window_us)
+    g_conf.set_val("ec_dispatch_batch_max", batch_max)
+
+
+def _mk_impl(plugin, k, m, technique):
+    impl = plugin()
+    impl.init({"k": str(k), "m": str(m), "technique": technique})
+    return impl
+
+
+def _same_shards(a, b):
+    assert sorted(a) == sorted(b)
+    for i in a:
+        assert np.asarray(a[i]).tobytes() == np.asarray(b[i]).tobytes(), \
+            f"shard {i} differs"
+
+
+def test_mesh_off_by_default(mesh_conf):
+    assert int(g_conf.get_val("ec_mesh_chips")) == 0
+    assert g_mesh.active() is False
+    d = g_dispatcher.dump()["mesh"]
+    assert d["active"] is False and d["size"] == 0
+
+
+def test_single_chip_mesh_is_passthrough(mesh_conf):
+    """ec_mesh_chips=1: a 1-device topology never shards — the mesh
+    dispatch counter must not move and outputs are the oracle's."""
+    _mesh_on(chips=1)
+    assert g_mesh.active() is False
+    pc = mesh_perf_counters()
+    before = pc.get(l_mesh_dispatches)
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    sinfo = stripe_info_t(4, 4 * 1024)
+    d = (np.arange(3 * 4 * 1024) % 251).astype(np.uint8)
+    f = g_dispatcher.submit_encode(sinfo, impl, d, set(range(6)))
+    _same_shards(f.result(), eu_encode(sinfo, impl, d, set(range(6))))
+    assert pc.get(l_mesh_dispatches) == before
+
+
+# ---- byte identity (the property-test satellite) ---------------------------
+MIX = [
+    (ErasureCodeTpu, 4, 2, "reed_sol_van"),
+    (ErasureCodeTpu, 8, 4, "reed_sol_van"),
+    (ErasureCodeIsa, 3, 2, "cauchy"),
+    (ErasureCodeIsa, 6, 3, "reed_sol_van"),
+]
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_mesh_byte_identity_property(mesh_conf, seed):
+    """Mesh-dispatched groups vs the single-device oracle across
+    randomized (k, m, technique, chunk size, stripe count) mixes.
+    Stripe totals are deliberately NOT multiples of the mesh size —
+    the zero-pad lanes must never leak into any request's output —
+    and mixed chunk sizes share a bucket like any dispatch group."""
+    rng = np.random.default_rng(seed)
+    impls = [_mk_impl(p, k, m, t) for p, k, m, t in MIX]
+    specs = []
+    for _ in range(18):
+        impl = impls[rng.integers(0, len(impls))]
+        k, m = impl.k, impl.m
+        chunk = int(rng.choice([512, 768, 1024, 1536]))
+        stripes = int(rng.integers(1, 7))     # totals rarely % 8 == 0
+        sinfo = stripe_info_t(k, k * chunk)
+        data = rng.integers(0, 256, size=stripes * k * chunk,
+                            dtype=np.uint8)
+        specs.append((sinfo, impl, data, set(range(k + m))))
+    oracles = [eu_encode(s, i, d, w) for s, i, d, w in specs]
+    _mesh_on(chips=8)
+    futs = [g_dispatcher.submit_encode(s, i, d, w)
+            for s, i, d, w in specs]
+    g_dispatcher.flush()
+    for f, oracle in zip(futs, oracles):
+        _same_shards(f.result(), oracle)
+    # the mesh actually ran (not a silent single-device pass)
+    assert mesh_perf_counters().get(l_mesh_dispatches) > 0
+
+
+def test_mesh_declines_layout_transforming_codecs(mesh_conf):
+    """Jerasure bitmatrix techniques reshape data into a virtual
+    layout before the backend matmul — the mesh plan models the PLAIN
+    row-independent matmul only, so the runtime must DECLINE them
+    (mesh_row_shardable False) and the single-device path keeps them
+    byte-identical with the mesh up."""
+    from ceph_tpu.ec.jerasure import ErasureCodeJerasure
+    impl = ErasureCodeJerasure()
+    impl.init({"k": "4", "m": "2", "technique": "cauchy_good",
+               "packetsize": "8"})
+    assert impl.mesh_row_shardable is False
+    chunk = impl._stripe_block() * 4
+    sinfo = stripe_info_t(4, 4 * chunk)
+    rng = np.random.default_rng(31)
+    data = rng.integers(0, 256, size=3 * 4 * chunk, dtype=np.uint8)
+    oracle = eu_encode(sinfo, impl, data, set(range(6)))
+    _mesh_on(chips=8)
+    pc = mesh_perf_counters()
+    before = pc.get(l_mesh_dispatches)
+    f = g_dispatcher.submit_encode(sinfo, impl, data, set(range(6)))
+    g_dispatcher.flush()
+    _same_shards(f.result(), oracle)
+    assert pc.get(l_mesh_dispatches) == before, \
+        "the mesh must decline layout-transforming codecs"
+
+
+def test_mesh_on_leaves_decode_byte_identical(mesh_conf):
+    """Decode groups keep the single-device path with the mesh up
+    (ROADMAP follow-up) — and stay byte-identical while encode groups
+    shard around them."""
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    sinfo = stripe_info_t(4, 4 * 1024)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, size=3 * 4 * 1024, dtype=np.uint8)
+    shards = eu_encode(sinfo, impl, data, set(range(6)))
+    chunks = {i: shards[i] for i in (0, 2, 4, 5)}
+    oracle = eu_decode_concat(sinfo, impl, dict(chunks))
+    _mesh_on(chips=8)
+    f_enc = g_dispatcher.submit_encode(sinfo, impl, data, set(range(6)))
+    f_dec = g_dispatcher.submit_decode_concat(sinfo, impl, dict(chunks))
+    g_dispatcher.flush()
+    _same_shards(f_enc.result(), shards)
+    assert np.asarray(f_dec.result()).tobytes() \
+        == np.asarray(oracle).tobytes()
+
+
+def _ec_shard_bodies(c):
+    """(osd, cid, oid) -> stored shard bytes for every EC collection
+    (the test_pipeline.py receipt, applied to the mesh twin)."""
+    out = {}
+    for i, osd in c.osds.items():
+        for cid in osd.store.list_collections():
+            if "_meta" in cid or "s" not in cid.split(".")[-1]:
+                continue
+            for ho in osd.store.list_objects(cid):
+                out[(i, cid, str(ho))] = osd.store.read(cid, ho)
+    return out
+
+
+def test_cluster_twin_stored_shards_byte_identical(mesh_conf):
+    """A mesh-dispatched cluster stores shard BODIES byte-identical to
+    a single-device twin across a write/overwrite/append mix."""
+    from ceph_tpu.cluster import MiniCluster
+
+    def run(mesh: bool):
+        if mesh:
+            _mesh_on(chips=8, window_us=200_000)
+        else:
+            for name in ("ec_mesh_chips", "ec_dispatch_batch_max",
+                         "ec_dispatch_batch_window_us"):
+                g_conf.rm_val(name)
+        g_mesh.topology()
+        c = MiniCluster(n_osds=6)
+        c.create_ec_pool("mtwin", k=3, m=2, pg_num=4)
+        cl = c.client("client.mesh")
+        rng = np.random.default_rng(99)
+        expected = {}
+        for i in range(4):
+            body = bytes(rng.integers(0, 256, 9000 + 4111 * i,
+                                      dtype=np.uint8))
+            assert cl.write_full("mtwin", f"o{i}", body) == 0
+            expected[f"o{i}"] = body
+        tail = bytes(rng.integers(0, 256, 5000, dtype=np.uint8))
+        assert cl.append("mtwin", "o1", tail) == 0
+        expected["o1"] = expected["o1"] + tail
+        for oid, body in expected.items():
+            assert cl.read("mtwin", oid) == body, (mesh, oid)
+        return _ec_shard_bodies(c)
+
+    meshed = run(mesh=True)
+    assert mesh_perf_counters().get(l_mesh_dispatches) > 0
+    single = run(mesh=False)
+    assert set(meshed) == set(single)
+    diffs = [key for key in single
+             if bytes(meshed[key]) != bytes(single[key])]
+    assert not diffs, f"{len(diffs)} shard bodies differ: {diffs[:5]}"
+
+
+# ---- the tier-1 mesh smoke fixture (CI satellite) --------------------------
+def test_tier1_mesh_smoke_all_chips_occupied(mesh_conf):
+    """The conftest forces an 8-device host-platform mesh
+    (XLA_FLAGS=--xla_force_host_platform_device_count=8); a batched
+    write big enough to span >= 8 stripes must put real work on EVERY
+    chip, and read back byte-exact."""
+    from ceph_tpu.cluster import MiniCluster
+    _mesh_on(chips=8, window_us=200_000)
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("msmoke", k=3, m=2, pg_num=4)
+    cl = c.client("client.msmoke")
+    before = {i: v["stripes"] for i, v in g_mesh.per_chip().items()}
+    # exactly 16 stripes (stripe_width = k * 4096): the batch axis is
+    # BLOCK-sharded, so full occupancy needs S >= a mesh multiple —
+    # shorter writes park their zero-pad lanes on the tail chips, and
+    # the occupancy histogram is what makes that imbalance visible
+    body = bytes(np.random.default_rng(7).integers(
+        0, 256, size=16 * 3 * 4096, dtype=np.uint8))
+    assert cl.write_full("msmoke", "big", body) == 0
+    assert cl.read("msmoke", "big") == body
+    per_chip = {i: v["stripes"] - before.get(i, 0)
+                for i, v in g_mesh.per_chip().items()}
+    assert len(per_chip) == 8, per_chip
+    assert all(v > 0 for v in per_chip.values()), per_chip
+    # the occupancy surfaced on `dispatch dump` too
+    d = c.admin_socket.execute("dispatch dump")["mesh"]
+    assert d["size"] == 8 and d["active"] is True
+    assert all(d["per_chip"][i]["dispatches"] > 0 for i in d["per_chip"])
+
+
+# ---- plan cache + staging pool ---------------------------------------------
+def test_plan_cache_and_pool_reuse(mesh_conf):
+    _mesh_on(chips=8, batch_max=4)
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    sinfo = stripe_info_t(4, 4 * 1024)
+    rng = np.random.default_rng(3)
+    pc = mesh_perf_counters()
+    builds0 = pc.get(l_mesh_plan_builds)
+    hits0 = pc.get(l_mesh_pool_hits)
+
+    def flush_batch():
+        futs = [g_dispatcher.submit_encode(
+            sinfo, impl,
+            rng.integers(0, 256, size=2 * 4 * 1024, dtype=np.uint8),
+            set(range(6))) for _ in range(4)]
+        for f in futs:
+            f.result()
+
+    flush_batch()
+    flush_batch()
+    assert pc.get(l_mesh_plan_builds) == builds0 + 1, \
+        "same signature+bucket must share ONE sharding plan"
+    assert pc.get(l_mesh_pool_hits) > hits0, \
+        "the second flush must reuse the pooled staging buffer"
+    # a different chunk bucket builds a second plan
+    sinfo2 = stripe_info_t(4, 4 * 4096)
+    f = g_dispatcher.submit_encode(
+        sinfo2, impl,
+        rng.integers(0, 256, size=4 * 4096, dtype=np.uint8),
+        set(range(6)))
+    f.result()
+    assert pc.get(l_mesh_plan_builds) == builds0 + 2
+    dump = g_mesh.dump()
+    assert len(dump["plans"]) == 2
+    # on the cpu smoke platform donation is structurally off (no
+    # buffer aliasing support); the plan records what it got
+    assert all(p["donated"] is False for p in dump["plans"])
+    assert dump["pool"]["hits"] >= 1
+    # ec_mesh_pool_buffers is LIVE: a config change applies on the
+    # next flush without a topology rebuild
+    g_conf.set_val("ec_mesh_pool_buffers", 1)
+    g_mesh.topology()
+    assert g_mesh.dump()["pool"]["per_shape"] == 1
+
+
+def test_mesh_fallback_on_device_unavailable(mesh_conf):
+    """An exhausted mesh call degrades to the single-device path —
+    the op completes byte-identically, the fallback is counted."""
+    from ceph_tpu.fault import g_breakers, g_faults
+    _mesh_on(chips=8)
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    sinfo = stripe_info_t(4, 4 * 1024)
+    rng = np.random.default_rng(13)
+    payloads = [rng.integers(0, 256, size=2 * 4 * 1024, dtype=np.uint8)
+                for _ in range(3)]
+    pc = mesh_perf_counters()
+    before = pc.get(l_mesh_fallbacks)
+    g_faults.inject("mesh.encode_batch", mode="always")
+    try:
+        futs = [g_dispatcher.submit_encode(sinfo, impl, p, set(range(6)))
+                for p in payloads]
+        g_dispatcher.flush()
+        for f, p in zip(futs, payloads):
+            _same_shards(f.result(),
+                         eu_encode(sinfo, impl, p, set(range(6))))
+    finally:
+        g_faults.clear()
+        # the injected failures TRIPPED the signature's breaker (3
+        # consecutive) — reset it so this test cannot leak an open
+        # breaker (host-routed codecs + breaker dumps) into the suite
+        g_breakers.reset()
+    assert pc.get(l_mesh_fallbacks) > before
+
+
+# ---- observability ---------------------------------------------------------
+def test_chip_histogram_and_prometheus_export(mesh_conf):
+    """The per-chip occupancy histogram and the mesh counters render on
+    the mgr's Prometheus surface (golden-test satellite)."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.trace import g_perf_histograms
+    _mesh_on(chips=8, window_us=200_000)
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("mprom", k=3, m=2, pg_num=4)
+    cl = c.client("client.mprom")
+    assert cl.write_full("mprom", "o", b"p" * 60000) == 0
+    hist = g_perf_histograms.get("dispatch",
+                                 "dispatch_chip_occupancy_histogram")
+    assert hist.total_count > 0
+    assert hist.axes[0].name == "chip_stripes"
+    assert hist.axes[1].name == "chip_index"
+    prom = c.admin_socket.execute("prometheus metrics")
+    assert "ceph_daemon_mesh_dispatches" in prom
+    assert "ceph_daemon_mesh_stripes" in prom
+    assert "ceph_dispatch_chip_occupancy_histogram_bucket" in prom
+
+
+def test_zero_syncs_on_mesh_write_path(mesh_conf, monkeypatch):
+    """Fence-count gate extended to the mesh path: with tracing off a
+    mesh-dispatched write adds zero block_until_ready syncs."""
+    import jax
+    from ceph_tpu.cluster import MiniCluster
+    _mesh_on(chips=8, window_us=200_000)
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("msync", k=3, m=2, pg_num=4)
+    cl = c.client("client.msync")
+    cl.write_full("msync", "warm", b"w" * 60000)     # compile warmup
+    calls = {"n": 0}
+    real = jax.block_until_ready
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "block_until_ready", counting)
+    assert cl.write_full("msync", "obj", b"x" * 60000) == 0
+    assert cl.read("msync", "obj")[:1] == b"x"
+    assert calls["n"] == 0, "mesh path added a device sync"
+    assert mesh_perf_counters().get(l_mesh_dispatches) > 0

@@ -76,9 +76,16 @@ def exposition():
     g_conf.set_val("ec_dispatch_batch_window_us", 100_000)
     try:
         assert cl.write_full("prom", "o3", b"r" * 8000) == 0
+        # and one through the MESH path (ceph_tpu/mesh) so the per-chip
+        # occupancy family and ceph_daemon_mesh_* counters render
+        g_conf.set_val("ec_mesh_chips", 8)
+        assert cl.write_full("prom", "o4", b"s" * 60000) == 0
     finally:
+        from ceph_tpu.mesh import g_mesh
         g_conf.rm_val("ec_pipeline_depth")
         g_conf.rm_val("ec_dispatch_batch_window_us")
+        g_conf.rm_val("ec_mesh_chips")
+        g_mesh.topology()
     return c.admin_socket.execute("prometheus metrics")
 
 
@@ -157,6 +164,39 @@ def test_dispatch_occupancy_family_and_counters(exposition):
     assert sub and sub[0] > 0, "dispatch_submitted counter missing"
     assert any(n == "ceph_daemon_dispatch_passthrough"
                for n, _l, _v in samples)
+
+
+def test_mesh_family_and_counters(exposition):
+    """Mesh-PR golden coverage: the per-chip occupancy histogram
+    renders as a real histogram family (the generic cumulative test
+    above already enforces monotone buckets and +Inf == _count) with
+    RAW dimensionless stripe-count edges, and the mesh runtime's
+    counters render as ``ceph_daemon_mesh_*`` daemon series carrying
+    the fixture's mesh write."""
+    types, samples = _parse(exposition)
+    fam = "ceph_dispatch_chip_occupancy_histogram"
+    assert types.get(fam) == "histogram", \
+        "per-chip occupancy histogram family missing"
+    buckets = [(_le_of(labels), v) for n, labels, v in samples
+               if n == f"{fam}_bucket"]
+    assert buckets, "no chip-occupancy buckets rendered"
+    # axis 0 is chip_stripes: dimensionless unit-quant linear edges
+    # survive un-scaled
+    les = sorted(le for le, _v in buckets if le != math.inf)
+    assert les[0] == 0.0 and 1.0 in les and 2.0 in les, les[:4]
+    # the fixture's mesh write landed samples (one per chip per flush)
+    infs = [v for le, v in buckets if le == math.inf]
+    assert infs and infs[0] >= 8, "fewer than 8 per-chip samples"
+    for counter, expect_positive in (
+            ("ceph_daemon_mesh_dispatches", True),
+            ("ceph_daemon_mesh_stripes", True),
+            ("ceph_daemon_mesh_plan_builds", True),
+            ("ceph_daemon_mesh_chips", False),
+            ("ceph_daemon_mesh_fallbacks", False)):
+        vals = [v for n, _l, v in samples if n == counter]
+        assert vals, f"{counter} missing from the exposition"
+        if expect_positive:
+            assert vals[0] > 0, f"{counter} never moved"
 
 
 def test_pipeline_family_and_counters(exposition):
