@@ -68,6 +68,8 @@ def probe(refresh: bool = False) -> Dict[str, Any]:
         import jax.numpy as jnp
         import numpy as np
         with enable_x64():
+            # one-shot capability probe, memoized in _cache
+            # lint: allow[jit-cache-hygiene]
             v = jax.jit(lambda a: a * a)(
                 jnp.asarray(np.int64(3_000_000_019)))
             out["x64"] = int(v) == 3_000_000_019 ** 2

@@ -92,7 +92,7 @@ def salted_matmul_step():
     import jax.numpy as jnp
     from ..ops.gf_matmul import gf_bit_matmul
 
-    @jax.jit
+    @jax.jit  # lint: allow[jit-cache-hygiene] — memoized in _STEP
     def step(d, b, salt):
         s_, k_, c_ = d.shape
         d32 = jax.lax.bitcast_convert_type(

@@ -5,7 +5,8 @@ from .perf_counters import (
 )
 from .admin_socket import AdminSocket
 from .tracked_op import OpTracker, TrackedOp
-from .lockdep import DebugLock, LockOrderError, lockdep_enable, lockdep_reset
+from .lockdep import (DebugLock, DebugRLock, LockOrderError,
+                      lockdep_enable, lockdep_reset)
 from .dout import Dout, Log, dlog, get_log, register_config_observers
 from .kernel_trace import (
     KernelTimer, annotate, g_kernel_timer, start_profiler_trace,
@@ -16,7 +17,8 @@ __all__ = [
     "Option", "ConfigProxy", "OPT_INT", "OPT_STR", "OPT_FLOAT", "OPT_BOOL",
     "OPT_DOUBLE", "PerfCounters", "PerfCountersBuilder",
     "PerfCountersCollection", "AdminSocket", "OpTracker", "TrackedOp",
-    "DebugLock", "LockOrderError", "lockdep_enable", "lockdep_reset",
+    "DebugLock", "DebugRLock", "LockOrderError", "lockdep_enable",
+    "lockdep_reset",
     "Dout", "Log", "dlog", "get_log", "register_config_observers",
     "KernelTimer", "annotate", "g_kernel_timer", "start_profiler_trace",
     "stop_profiler_trace",

@@ -10,6 +10,8 @@ reference runs real thread pools over thread-safe cores).
 from __future__ import annotations
 
 import threading
+
+from .lockdep import DebugLock
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Tuple
 from urllib.parse import parse_qsl, urlparse
@@ -22,7 +24,7 @@ def serve_frontend(handle: HandleFn, port: int = 0):
     """Returns (server, port); ``server.shutdown()`` +
     ``server.server_close()`` when done (shutdown alone leaves the
     listening fd open)."""
-    lock = threading.Lock()
+    lock = DebugLock("http_frontend::serial")
 
     class Handler(BaseHTTPRequestHandler):
         def _run(self, method: str) -> None:

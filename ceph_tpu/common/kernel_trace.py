@@ -22,10 +22,23 @@ from __future__ import annotations
 
 import contextlib
 import threading
+
+from .lockdep import DebugLock
 import time
 from typing import Any, Dict, Optional
 
-from ..trace import g_tracer
+# NOTE: ..trace imports back into common (span/histogram take their
+# DebugLocks from common.lockdep), so g_tracer must resolve lazily
+# or the two package __init__s deadlock on import order
+_g_tracer = None
+
+
+def _tracer():
+    global _g_tracer
+    if _g_tracer is None:
+        from ..trace import g_tracer
+        _g_tracer = g_tracer
+    return _g_tracer
 
 
 class KernelTimer:
@@ -40,7 +53,7 @@ class KernelTimer:
     def __init__(self):
         self.enabled = False
         self.stats: Dict[str, Dict[str, float]] = {}
-        self._lock = threading.Lock()
+        self._lock = DebugLock("KernelTrace::lock")
 
     def enable(self, on: bool = True) -> None:
         self.enabled = on
@@ -67,6 +80,7 @@ class KernelTimer:
         the op's span tree.  The sync itself is still gated on
         ``self.enabled`` alone — spans never add one.
         """
+        g_tracer = _tracer()
         if not g_tracer.enabled:
             if not self.enabled:
                 return fn(*args, **kw)

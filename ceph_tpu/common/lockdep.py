@@ -65,7 +65,11 @@ def _will_lock(name: str) -> None:
     held = _held_stack()
     if not held:
         return
-    stack = "".join(traceback.format_stack(limit=8)[:-2])
+    # the stack string only matters the FIRST time an edge is
+    # recorded; format it lazily so steady-state nested acquires
+    # (every edge already known) skip the traceback walk — this runs
+    # on the per-op hot path of every witness-armed daemon
+    stack = None
     with _registry_lock:
         for h in held:
             if h == name:
@@ -78,15 +82,25 @@ def _will_lock(name: str) -> None:
                     f"lock order inversion: acquiring {name!r} while "
                     f"holding {h!r}, but an order {name!r} ->* {h!r} "
                     f"was established here:\n{prior}")
-            _orders.setdefault((h, name), stack)
+            if (h, name) not in _orders:
+                if stack is None:
+                    stack = "".join(traceback.format_stack(limit=8)[:-2])
+                _orders[(h, name)] = stack
 
 
 class DebugLock:
-    """Named lock participating in ordering checks when lockdep is on."""
+    """Named lock participating in ordering checks when lockdep is on.
+
+    Also implements the ``threading.Condition`` owner protocol
+    (``_release_save`` / ``_acquire_restore`` / ``_is_owned``) so a
+    ``Condition(DebugLock(...))`` wait/notify round keeps the held
+    stack honest instead of tripping a false recursive-acquire via
+    Condition's default ``acquire(False)`` ownership probe.
+    """
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lint: allow[no-bare-lock]
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         if _enabled:
@@ -98,10 +112,13 @@ class DebugLock:
 
     def release(self) -> None:
         self._lock.release()
-        if _enabled:
-            st = _held_stack()
-            if self.name in st:
-                st.remove(self.name)
+        # pop the held stack even when the witness is off: a lock
+        # acquired while enabled and released after lockdep_enable(False)
+        # must not strand its name (a later re-enable would see a
+        # phantom hold and report a false recursive acquire)
+        st = _held_stack()
+        if self.name in st:
+            st.remove(self.name)
 
     def __enter__(self) -> "DebugLock":
         self.acquire()
@@ -112,3 +129,73 @@ class DebugLock:
 
     def locked(self) -> bool:
         return self._lock.locked()
+
+    # ---- threading.Condition owner protocol ---------------------------
+    def _release_save(self):
+        self.release()
+
+    def _acquire_restore(self, _state) -> None:
+        self.acquire()
+
+    def _is_owned(self) -> bool:
+        if _enabled and self.name in _held_stack():
+            return True
+        # Condition's stock probe, against the RAW lock so lockdep
+        # never sees it as an ordering event
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+
+class DebugRLock:
+    """Named reentrant lock under the same witness.
+
+    Same-instance re-acquisition by the owning thread is legal RLock
+    semantics and records no ordering event; only the OUTERMOST
+    acquire/release participates in the order graph, exactly like the
+    reference's recursive ``ceph::make_recursive_mutex`` registration.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.RLock()  # lint: allow[no-bare-lock]
+        self._owner: int = 0
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        outermost = self._owner != me
+        if _enabled and outermost:
+            _will_lock(self.name)
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            # safe unlocked writes: we hold the lock
+            self._owner = me
+            self._count += 1
+            if _enabled and outermost:
+                _held_stack().append(self.name)
+        return got
+
+    def release(self) -> None:
+        outermost = self._count == 1
+        self._count -= 1
+        if self._count == 0:
+            self._owner = 0
+        self._lock.release()
+        # see DebugLock.release: unconditional so toggling the witness
+        # mid-hold can never strand a held-stack entry
+        if outermost:
+            st = _held_stack()
+            if self.name in st:
+                st.remove(self.name)
+
+    def __enter__(self) -> "DebugRLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()

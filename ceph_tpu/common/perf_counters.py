@@ -8,6 +8,8 @@ dumps every logger as JSON for the admin socket's `perf dump`.
 from __future__ import annotations
 
 import threading
+
+from .lockdep import DebugLock
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -35,7 +37,7 @@ class PerfCounters:
         self.lower = lower
         self.upper = upper
         self._by_idx: Dict[int, _Counter] = {}
-        self._lock = threading.Lock()
+        self._lock = DebugLock("PerfCounters::lock")
 
     def _add(self, idx: int, c: _Counter) -> None:
         assert self.lower < idx < self.upper, "index out of declared range"
@@ -127,7 +129,7 @@ class PerfCountersCollection:
 
     def __init__(self):
         self._loggers: Dict[str, PerfCounters] = {}
-        self._lock = threading.Lock()
+        self._lock = DebugLock("PerfCountersCollection::lock")
 
     def add(self, pc: PerfCounters) -> None:
         with self._lock:

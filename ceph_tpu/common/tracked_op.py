@@ -9,6 +9,8 @@ completed ops land in a bounded history ring dumped via the admin socket
 from __future__ import annotations
 
 import threading
+
+from .lockdep import DebugLock
 import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
@@ -69,7 +71,7 @@ class OpTracker:
         self._inflight: Dict[int, TrackedOp] = {}
         self._history: Deque[TrackedOp] = deque(maxlen=history_size)
         self._slow: Deque[TrackedOp] = deque(maxlen=history_size)
-        self._lock = threading.Lock()
+        self._lock = DebugLock("OpTracker::lock")
         self._complaint_override: Optional[float] = None
 
     @property

@@ -32,6 +32,8 @@ enforcement stays a class-tier (WallMClockQueue) property.
 from __future__ import annotations
 
 import threading
+
+from .lockdep import DebugLock
 import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
@@ -75,7 +77,7 @@ _CLASS_DEQ_IDX = {
 }
 
 _qos_pc = None
-_qos_pc_lock = threading.Lock()
+_qos_pc_lock = DebugLock("qos_pc::init")
 
 
 def qos_perf_counters():
@@ -689,7 +691,7 @@ class ShardedThreadPool:
         self.wq = wq
         self.handler = handler
         self.n_threads = max(1, n_threads)
-        self._lock = threading.Lock()
+        self._lock = DebugLock("ThreadPool::lock")
         self._cv = threading.Condition(self._lock)
         self._stopping = False
         self._active = 0

@@ -38,6 +38,8 @@ a batched call throws).
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import DebugLock
 from typing import Any, Callable, List, Optional
 
 
@@ -52,7 +54,7 @@ class DispatchFuture:
         self._value: Any = None
         self._exc: Optional[BaseException] = None
         self._callbacks: List[Callable[["DispatchFuture"], None]] = []
-        self._lock = threading.Lock()
+        self._lock = DebugLock("DispatchFuture::lock")
         # bound by the scheduler: forces the owning queue's flush so a
         # lone synchronous submitter can never deadlock on its own batch
         self._flush_fn = flush_fn

@@ -31,6 +31,8 @@ the dispatcher adds ZERO device syncs per op (fence-count enforced).
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import DebugLock, DebugRLock
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
@@ -67,7 +69,7 @@ l_dispatch_fallback_reqs = 91012  # requests re-run alone after a
 DISPATCH_LAST = 91020
 
 _dispatch_pc: Optional[PerfCounters] = None
-_dispatch_pc_lock = threading.Lock()
+_dispatch_pc_lock = DebugLock("dispatch_pc::init")
 
 
 def dispatch_perf_counters() -> PerfCounters:
@@ -127,7 +129,7 @@ class _Queue:
 
 class DeviceDispatcher:
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = DebugRLock("DeviceDispatcher::lock")
         self._queues: "OrderedDict[Tuple, _Queue]" = OrderedDict()
         self._pending = 0
 

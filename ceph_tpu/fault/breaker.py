@@ -27,6 +27,8 @@ transitions) and as a gauge on the Prometheus surface.
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import DebugLock
 import time
 from typing import Dict, List, Tuple
 
@@ -77,7 +79,7 @@ class BreakerBoard:
 
     def __init__(self):
         self._breakers: Dict[Tuple, _Breaker] = {}
-        self._lock = threading.Lock()
+        self._lock = DebugLock("CircuitBreakers::lock")
 
     # ---- options (read live so `config set` applies) ----------------------
     @staticmethod

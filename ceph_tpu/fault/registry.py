@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from ..common.lockdep import DebugLock
 import zlib
 from typing import Dict, Optional, Tuple
 
@@ -103,7 +105,7 @@ l_fault_degraded = 92011          # gauge: codec signatures currently open
 FAULT_LAST = 92020
 
 _fault_pc: Optional[PerfCounters] = None
-_fault_pc_lock = threading.Lock()
+_fault_pc_lock = DebugLock("fault_pc::init")
 
 
 def fault_perf_counters() -> PerfCounters:
@@ -216,7 +218,7 @@ class FaultRegistry:
 
     def __init__(self):
         self._armed: Dict[str, FaultSpec] = {}
-        self._lock = threading.Lock()
+        self._lock = DebugLock("FaultRegistry::lock")
 
     # ---- hot path ---------------------------------------------------------
     def site_armed(self, site: str) -> bool:

@@ -16,6 +16,8 @@ zero rows, which the slicing discards).
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import DebugLock
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -25,7 +27,7 @@ class StagingPool:
     """Per-shape free lists of C-contiguous uint8 staging buffers."""
 
     def __init__(self, per_shape: int = 4):
-        self._lock = threading.Lock()
+        self._lock = DebugLock("MeshBufferPool::lock")
         self._free: Dict[Tuple[int, ...], List[np.ndarray]] = {}
         self._per_shape = max(int(per_shape), 1)
         self.hits = 0

@@ -50,6 +50,8 @@ follow-up (see ROADMAP).
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import DebugLock, DebugRLock
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -78,7 +80,7 @@ l_mesh_chips = 98011           # gauge: current mesh size
 MESH_LAST = 98020
 
 _mesh_pc: Optional[PerfCounters] = None
-_mesh_pc_lock = threading.Lock()
+_mesh_pc_lock = DebugLock("mesh_pc::init")
 
 
 def mesh_perf_counters() -> PerfCounters:
@@ -165,7 +167,7 @@ class MeshRuntime:
     """The dispatch scheduler's device back end when a mesh is up."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = DebugRLock("MeshRuntime::lock")
         self._mesh = None
         self._mesh_n = None          # ec_mesh_chips the mesh was built for
         self._plans: Dict[Tuple, ShardingPlan] = {}

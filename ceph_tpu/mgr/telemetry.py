@@ -44,6 +44,8 @@ a running cluster raises health checks on.
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import DebugLock
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common.config import g_conf
@@ -95,7 +97,7 @@ class Telemetry:
     """The mgr's cluster telemetry module (ring + rollup + SLO)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = DebugLock("MgrTelemetry::lock")
         # ring entries: {"t", "counters": {...},
         #                "families": {name: [axis0 counts]}}
         self._ring: List[Dict[str, Any]] = []

@@ -92,6 +92,11 @@ class Monitor(Dispatcher):
         self._peer_ranks: Dict[str, int] = {}
         self._last_peer_seen: Dict[int, float] = {}
         self.now = 0.0
+        self._last_tick: Optional[float] = None
+        # consecutive compensated stalls per liveness stamp since that
+        # peer last actually spoke (bounds the compensation)
+        self._grace_credit: Dict[int, int] = {}
+        self._mds_grace_credit: Dict[str, int] = {}
         # ---- paxos state (Paxos.cc begin/accept/commit) -------------------
         # leader: the value currently awaiting an accept quorum, plus
         # proposals queued behind it (Paxos allows one in flight)
@@ -215,6 +220,7 @@ class Monitor(Dispatcher):
             self.leader_rank = msg.rank
             self.quorum = set(msg.quorum)
             self._last_peer_seen[msg.rank] = self.now
+            self._grace_credit.pop(msg.rank, None)
 
     def _declare_victory(self) -> None:
         self.election_epoch += 1          # even = decided
@@ -521,6 +527,46 @@ class Monitor(Dispatcher):
 
     # ---- liveness (elector keepalives) ------------------------------------
     def tick(self, now: float) -> None:
+        # Starvation compensation (Monitor.cc's clock-jump sanity on
+        # the same check): when OUR OWN tick cadence stalled — an
+        # oversubscribed host descheduled the process, a long pump —
+        # the silence since the last tick measures local scheduling,
+        # not peer death.  Comparing a grace window against it starts
+        # spurious elections that churn quorum exactly when the box is
+        # loaded (the two loadflaky vstart tests' election-timing
+        # sensitivity; ROADMAP residual debt 2).  Credit every
+        # liveness stamp with the stall so grace windows restart from
+        # a tick cadence we actually sustained; a genuinely dead peer
+        # still times out, one grace period of real ticks later.
+        stall = (now - self._last_tick
+                 if self._last_tick is not None else 0.0)
+        self._last_tick = now
+        if stall > MON_PING_GRACE / 2.0:
+            # BOUNDED per silent stretch: at most two consecutive
+            # stalls are compensated before the peer must actually
+            # speak (any real ping/victory resets its ledger).  A
+            # single long deschedule restarts the grace window in
+            # full — no spurious election on wake — while a HOST that
+            # stays slow against a genuinely dead peer stops earning
+            # credit after two stalls, so failover is delayed by a
+            # bounded amount, never postponed indefinitely.
+            for r in self._last_peer_seen:
+                n_stalls = self._grace_credit.get(r, 0)
+                if n_stalls < 2:
+                    self._grace_credit[r] = n_stalls + 1
+                    self._last_peer_seen[r] = min(
+                        now, self._last_peer_seen[r] + stall)
+        if stall > MDS_BEACON_GRACE / 2.0:
+            # same class of false positive, gated on ITS OWN grace
+            # (mds_grace is configured independently of mon_grace): a
+            # starved leader must not fail over a live MDS whose
+            # beacons it never drained
+            beacons = getattr(self, "_mds_last_beacon", {})
+            for n in beacons:
+                n_stalls = self._mds_grace_credit.get(n, 0)
+                if n_stalls < 2:
+                    self._mds_grace_credit[n] = n_stalls + 1
+                    beacons[n] = min(now, beacons[n] + stall)
         self.now = now
         if self.is_leader() or not self.peers:
             # down->out eviction (OSDMonitor::tick down_pending_out)
@@ -577,6 +623,7 @@ class Monitor(Dispatcher):
                 op=MMonPing.REPLY, rank=self.rank, stamp=msg.stamp),
                 msg.src)
         self._last_peer_seen[msg.rank] = self.now
+        self._grace_credit.pop(msg.rank, None)
         # a LIVE mon pinging us while outside our quorum must be
         # brought back in (its election ack straggled past the window):
         # without this it never sees another BEGIN/COMMIT and its
@@ -766,6 +813,7 @@ class Monitor(Dispatcher):
         if not hasattr(self, "_mds_last_beacon"):
             self._mds_last_beacon = {}
         self._mds_last_beacon[msg.name] = self.now
+        self._mds_grace_credit.pop(msg.name, None)
         fsmap = self._fsmap()
         cur = fsmap["mds"].get(msg.name)
         if cur is not None and cur["state"] == "standby":

@@ -36,7 +36,9 @@ class MonMap:
     def __init__(self, fsid: Optional[str] = None):
         self.epoch = 0
         self.fsid = fsid or str(_uuid.uuid4())
-        self.created = time.time()
+        # cosmetic map-birth stamp in dumps; never compared
+        # against fabric time
+        self.created = time.time()  # lint: allow[no-wall-clock]
         self.last_changed = self.created
         self.mons: Dict[str, str] = {}       # name -> "ip:port/nonce"
         self.persistent_features = 0

@@ -15,6 +15,8 @@ import ctypes
 import os
 import subprocess
 import threading
+
+from .common.lockdep import DebugLock
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -29,7 +31,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_ROOT, "native")
 _SO = os.path.join(_NATIVE_DIR, "libceph_tpu_native.so")
 
-_lock = threading.Lock()
+_lock = DebugLock("native::load")
 _lib: Optional[ctypes.CDLL] = None
 
 

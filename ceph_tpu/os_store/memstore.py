@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+from ..common.lockdep import DebugRLock
 
 
 @dataclass(frozen=True, order=True)
@@ -105,10 +106,9 @@ _VERSION = 1
 
 class MemStore:
     def __init__(self):
-        import threading
         self.colls: Dict[str, Dict[hobject_t, _Object]] = {}
         self.committed_txns = 0
-        self._write_lock = threading.RLock()
+        self._write_lock = DebugRLock("MemStore::write_lock")
 
     # ---- lifecycle / durability -------------------------------------------
     def mount(self) -> None:

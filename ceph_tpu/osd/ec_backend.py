@@ -51,6 +51,8 @@ from __future__ import annotations
 
 import struct
 import threading
+
+from ..common.lockdep import DebugLock, DebugRLock
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -95,7 +97,7 @@ l_pipeline_subwrite_resends = 93006  # unacked sub-op writes resent
 PIPELINE_LAST = 93010
 
 _pipeline_pc: Optional[PerfCounters] = None
-_pipeline_pc_lock = threading.Lock()
+_pipeline_pc_lock = DebugLock("pipeline_pc::init")
 
 
 def pipeline_perf_counters() -> PerfCounters:
@@ -331,7 +333,7 @@ class ECBackend:
         # its fan-out instead of writing into a dead acting set
         self.pipeline_inflight = 0
         self._pipeline_futs: Deque = deque()   # oldest-first pending
-        self._pipeline_lock = threading.RLock()
+        self._pipeline_lock = DebugRLock("ECBackend::pipeline_lock")
         self._interval_gen = 0
         # batched-codec latency x bytes distributions, per daemon
         # (dumped under `perf histogram dump` next to the op hists)

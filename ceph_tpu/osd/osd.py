@@ -40,6 +40,7 @@ from ..osdmap import OSDMap, pg_t
 from .ec_backend import HINFO_ATTR, SIZE_ATTR
 from .pg import PG
 from .pg_log import LogEntry, OP_DELETE
+from ..common.lockdep import DebugLock
 
 HEARTBEAT_GRACE = 20.0     # osd_heartbeat_grace default (options.cc:2461)
 HEARTBEAT_INTERVAL = 6.0   # osd_heartbeat_interval (options.cc:2456)
@@ -152,10 +153,9 @@ class OSD(Dispatcher):
         # (promote reads / flush writes): tid -> reply callback.
         # Allocated/consumed from worker threads holding only a PG
         # lock, so OSD-level state needs its own mutex
-        import threading
         self._tier_ops: Dict[int, Callable] = {}
         self._tier_tid = 1 << 40     # clear of client tid spaces
-        self._tier_lock = threading.Lock()
+        self._tier_lock = DebugLock("OSD::tier_lock")
 
     def shutdown(self) -> None:
         """Stop background machinery (the threaded op pool's workers
@@ -567,14 +567,18 @@ class OSD(Dispatcher):
             # as the per-client clear below, applied to clients that
             # never came back (their windows would otherwise pin map
             # entries forever)
-            now = time.monotonic()
+            # throttle windows are wall seconds BY CONTRACT:
+            # retry_after is handed to real clients on real
+            # sockets (QoS wall mode)
+            now = time.monotonic()  # lint: allow[no-wall-clock]
             self._throttled_clients = {
                 c: u for c, u in self._throttled_clients.items()
                 if u > now}
         until = self._throttled_clients.get(msg.src)
         shed = depth >= admission_max or (
             until is not None and
-            (depth >= low_water or time.monotonic() < until))
+            (depth >= low_water  # lint: allow[no-wall-clock]
+             or time.monotonic() < until))
         if not shed:
             if until is not None:
                 del self._throttled_clients[msg.src]
@@ -586,7 +590,7 @@ class OSD(Dispatcher):
             # could be starved forever in wall mode)
             qos.inc(l_qos_throttle_events)
             self._throttled_clients[msg.src] = \
-                time.monotonic() + window
+                time.monotonic() + window  # lint: allow[no-wall-clock]
         qos.inc(l_qos_admission_rejections)
         self.messenger.send_message(MOSDOpReply(
             tid=msg.tid, result=-11, epoch=self.osdmap.epoch,

@@ -40,6 +40,8 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import threading
+
+from ..common.lockdep import DebugLock
 from typing import Any, Dict, List, Optional
 
 from .histogram import (PerfHistogramAxis, SCALE_LOG2, g_perf_histograms)
@@ -62,7 +64,7 @@ l_devprof_device_mem_highwater = 96008  # gauge: peak device bytes seen
 DEVPROF_LAST = 96010
 
 _devprof_pc = None
-_devprof_pc_lock = threading.Lock()
+_devprof_pc_lock = DebugLock("devprof_pc::init")
 
 
 def devprof_perf_counters():
@@ -134,7 +136,7 @@ class DevFlowProfiler:
     """
 
     def __init__(self, mirror_counters: bool = False):
-        self._lock = threading.Lock()
+        self._lock = DebugLock("DeviceFlowProfiler::lock")
         # site -> {h2d_bytes, h2d_count, d2h_bytes, d2h_count,
         #          host_copy_bytes, host_copies, compiles}
         self._sites: Dict[str, Dict[str, int]] = {}

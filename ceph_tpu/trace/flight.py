@@ -10,6 +10,8 @@ after the collector's ring buffers recycled.
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import DebugLock
 from collections import deque
 from typing import Deque, List
 
@@ -39,7 +41,7 @@ class FlightEntry:
 class FlightRecorder:
     def __init__(self, size: int = 64):
         self._ring: Deque[FlightEntry] = deque(maxlen=size)
-        self._lock = threading.Lock()
+        self._lock = DebugLock("FlightRecorder::lock")
 
     def record(self, trace_id: int, description: str, duration: float,
                spans: List[Span]) -> FlightEntry:

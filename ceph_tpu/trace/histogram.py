@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import math
 import threading
+
+from ..common.lockdep import DebugLock
 from typing import Dict, Iterable, List, Optional, Tuple
 
 SCALE_LINEAR = "linear"
@@ -77,7 +79,7 @@ class PerfHistogram:
         for ax in self.axes:
             n *= ax.buckets
         self._counts = [0] * n
-        self._lock = threading.Lock()
+        self._lock = DebugLock("PerfHistogram::lock")
         # axis-0 raw-value accounting for _sum/_count exposition
         self.total_count = 0
         self.axis0_sum = 0.0
@@ -150,7 +152,7 @@ class PerfHistogramCollection:
 
     def __init__(self):
         self._hists: Dict[Tuple[str, str], PerfHistogram] = {}
-        self._lock = threading.Lock()
+        self._lock = DebugLock("PerfHistogramRegistry::lock")
 
     def get(self, logger: str, name: str,
             axes_factory=None) -> PerfHistogram:

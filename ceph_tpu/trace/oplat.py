@@ -62,6 +62,8 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import threading
+
+from ..common.lockdep import DebugLock
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -100,7 +102,7 @@ l_oplat_stage_samples = 97002  # individual stage durations recorded
 OPLAT_LAST = 97005
 
 _oplat_pc = None
-_oplat_pc_lock = threading.Lock()
+_oplat_pc_lock = DebugLock("oplat_pc::init")
 
 
 def oplat_perf_counters():
@@ -235,7 +237,7 @@ class OpLatAccumulator:
     the op they are serving."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = DebugLock("OplatRegistry::lock")
         self._hists: Dict[Tuple[str, str], PerfHistogram] = {}
 
     # ---- context ----------------------------------------------------------

@@ -21,6 +21,8 @@ import contextlib
 import contextvars
 import itertools
 import threading
+
+from ..common.lockdep import DebugLock
 import time
 from collections import deque
 from typing import Deque, Dict, List, Optional
@@ -103,7 +105,7 @@ class SpanCollector:
     def __init__(self, ring_size: int = 2048):
         self.ring_size = ring_size
         self._rings: Dict[str, Deque[Span]] = {}
-        self._lock = threading.Lock()
+        self._lock = DebugLock("Tracer::lock")
 
     def record(self, span: Span) -> None:
         with self._lock:

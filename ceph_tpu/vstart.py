@@ -32,6 +32,12 @@ def _pin_cpu() -> None:
         "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # the reference's vstart.sh runs every daemon with lockdep=1: the
+    # debug tier is exactly where the lock-order witness should be
+    # armed (CEPH_TPU_LOCKDEP=0 opts a run out)
+    if os.environ.get("CEPH_TPU_LOCKDEP", "1") != "0":
+        from .common.lockdep import lockdep_enable
+        lockdep_enable(True)
 
 
 # ---- daemon mains ----------------------------------------------------------
@@ -481,33 +487,63 @@ class ProcessCluster:
             self.keyring_path = os.path.join(self._tmpdir, "keyring")
             kr.save(self.keyring_path)
         self.client_names = client_names
-        ports = _free_ports(n_osds + n_mons + n_mds + 1)
-        self.mon_ports = ports[:n_mons]
-        self.mon_port = self.mon_ports[0]
-        self.client_port = ports[n_mons]
-        self.osd_ports = ports[n_mons + 1:n_mons + 1 + n_osds]
-        self.mds_ports = ports[n_mons + 1 + n_osds:]
-        directory: Dict[str, Tuple[str, int]] = {}
-        for r, m in enumerate(self.mon_names):
-            directory[m] = ("127.0.0.1", self.mon_ports[r])
-        for name in client_names:
-            directory[name] = ("127.0.0.1", self.client_port)
-        for i in range(n_osds):
-            directory[f"osd.{i}"] = ("127.0.0.1", self.osd_ports[i])
-        for i in range(n_mds):
-            directory[f"mds.{i}"] = ("127.0.0.1", self.mds_ports[i])
-        self.directory = directory
-        dir_json = json.dumps({k: list(v) for k, v in directory.items()})
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         self.procs: Dict[str, subprocess.Popen] = {}
         self.network = None
-        try:
-            self._spawn(n_osds, dir_json, env, pool, heartbeat_interval,
-                        heartbeat_grace, down_out_interval)
-        except Exception:
-            self.close()
-            raise
+        # the reserve-then-close port probe (_free_ports) races other
+        # processes between close() and the daemon's rebind; a loser
+        # dies instantly with EADDRINUSE, so ONE respawn with fresh
+        # ports absorbs the collision without masking slow failures
+        for attempt in (0, 1):
+            ports = _free_ports(n_osds + n_mons + n_mds + 1)
+            self.mon_ports = ports[:n_mons]
+            self.mon_port = self.mon_ports[0]
+            self.client_port = ports[n_mons]
+            self.osd_ports = ports[n_mons + 1:n_mons + 1 + n_osds]
+            self.mds_ports = ports[n_mons + 1 + n_osds:]
+            directory: Dict[str, Tuple[str, int]] = {}
+            for r, m in enumerate(self.mon_names):
+                directory[m] = ("127.0.0.1", self.mon_ports[r])
+            for name in client_names:
+                directory[name] = ("127.0.0.1", self.client_port)
+            for i in range(n_osds):
+                directory[f"osd.{i}"] = ("127.0.0.1", self.osd_ports[i])
+            for i in range(n_mds):
+                directory[f"mds.{i}"] = ("127.0.0.1", self.mds_ports[i])
+            self.directory = directory
+            dir_json = json.dumps({k: list(v)
+                                   for k, v in directory.items()})
+            try:
+                self._spawn(n_osds, dir_json, env, pool,
+                            heartbeat_interval, heartbeat_grace,
+                            down_out_interval)
+                break
+            except Exception as e:
+                # a bind-race loser DIES (its traceback is on our
+                # inherited stderr); a daemon that is alive but
+                # unready timed out instead — that is a genuine
+                # failure a respawn would only mask, so don't retry it
+                a_daemon_died = any(p.poll() is not None
+                                    for p in self.procs.values())
+                if attempt or not a_daemon_died:
+                    self.close()
+                    raise
+                print(f"ProcessCluster: spawn attempt failed with a "
+                      f"dead daemon ({e}); retrying once on fresh "
+                      f"ports (EADDRINUSE port-probe race)",
+                      file=sys.stderr, flush=True)
+                # kill whatever booted and retry on fresh ports
+                for p in self.procs.values():
+                    try:
+                        p.kill()
+                        p.wait(timeout=5)
+                    except Exception:
+                        pass
+                self.procs.clear()
+                if self.network is not None:
+                    self.network.close()
+                    self.network = None
 
     def _spawn(self, n_osds, dir_json, env, pool, heartbeat_interval,
                heartbeat_grace, down_out_interval) -> None:

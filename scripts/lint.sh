@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Repo lint entry point: the invariant analyzer's full-tree pass.
+#
+#   scripts/lint.sh            # whole ceph_tpu/ tree (~2 s)
+#   scripts/lint.sh --changed  # git-diff-scoped fast mode
+#   scripts/lint.sh --rule no-bare-lock ceph_tpu/osd
+#
+# Exit 0 = clean, 1 = violations.  The same pass gates tier-1 via
+# tests/test_static_analysis.py.  Catalog + pragma/allowlist policy:
+# docs/ANALYSIS.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m ceph_tpu.analysis "$@"

@@ -43,7 +43,15 @@ pytestmark = pytest.mark.chaos
 
 @pytest.fixture
 def clean_faults():
+    # chaos rounds run with the lock-order witness armed, like the
+    # reference qa suites run under lockdep=1: a fault path that
+    # acquires out of order fails HERE, not in a production deadlock
+    from ceph_tpu.common.lockdep import lockdep_enable, lockdep_reset
+    lockdep_reset()
+    lockdep_enable(True)
     yield
+    lockdep_enable(False)
+    lockdep_reset()
     g_faults.clear()
     g_breakers.reset()
     for name in ("ec_device_retry_max", "ec_device_retry_backoff_us",

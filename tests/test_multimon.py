@@ -436,3 +436,116 @@ def test_wire_command_peon_relay_and_dedup():
     for m in c.mons:
         pool = m.osdmap.pools[m.osdmap.lookup_pg_pool_name("p")]
         assert pool.snap_seq >= ack4.data["value"]
+
+
+# ---------------------------------------------------------------------------
+# starvation-aware liveness grace (the loadflaky root cause)
+# ---------------------------------------------------------------------------
+
+def _three_mons():
+    from ceph_tpu.msg.messenger import Network
+    from ceph_tpu.mon.monitor import Monitor
+    net = Network()
+    names = ["mon.0", "mon.1", "mon.2"]
+    mons = [Monitor(net, name=n, rank=r,
+                    peers=[p for p in names if p != n])
+            for r, n in enumerate(names)]
+    mons[0].start_election()
+    net.pump()
+    assert mons[0].is_leader() and mons[0].quorum == {0, 1, 2}
+    return net, mons
+
+
+def test_starved_tick_does_not_start_spurious_election():
+    """The loadflaky election-timing root cause (ROADMAP residual
+    debt 2): a peon whose OWN tick cadence stalled past the ping
+    grace — an oversubscribed host, not a dead leader — must NOT
+    start an election off stamps it had no chance to refresh.  The
+    stall is credited to every liveness stamp before grace runs."""
+    from ceph_tpu.mon.monitor import MON_PING_GRACE
+    net, mons = _three_mons()
+    t = 1000.0
+    for m in mons:
+        m.tick(t)
+    net.pump()
+    peon = mons[1]
+    epoch_before = peon.election_epoch
+    # the process was descheduled for 3 grace periods; it wakes and
+    # ticks BEFORE its pump drains the leader's queued keepalives —
+    # exactly the oversubscribed-box interleaving
+    peon.tick(t + 3 * MON_PING_GRACE)
+    assert peon.election_epoch == epoch_before
+    assert peon.leader_rank == 0
+    # and the cluster still converges normally afterwards
+    for m in mons:
+        m.tick(t + 3 * MON_PING_GRACE + 1.0)
+    net.pump()
+    assert mons[0].is_leader() and mons[0].quorum == {0, 1, 2}
+
+
+def test_genuinely_silent_leader_still_times_out():
+    """The compensation must not mask real failure: with a REGULAR
+    tick cadence and a leader that stopped answering, the peon
+    re-elects one grace period later, exactly as before."""
+    from ceph_tpu.mon.monitor import MON_PING_GRACE
+    net, mons = _three_mons()
+    t = 1000.0
+    for m in mons:
+        m.tick(t)
+    net.pump()
+    peon = mons[1]
+    epoch_before = peon.election_epoch
+    # mon.0 is dead: the fabric drops its traffic, only the peons
+    # tick, in small steps, and pings to the corpse go unanswered
+    net.set_down("mon.0", True)
+    step = 1.0
+    now = t
+    while now < t + MON_PING_GRACE + 2 * step:
+        now += step
+        peon.tick(now)
+        mons[2].tick(now)
+        # drain peon<->peon pings only; the dead leader neither sends
+        # nor answers
+        net.pump()
+    assert peon.election_epoch > epoch_before
+
+
+def test_sustained_slow_cadence_still_detects_dead_leader():
+    """The compensation is CAPPED: a host that stays slow (every tick
+    gap over grace/2) delays failover by at most one extra grace
+    period — it can never postpone detecting a dead leader forever."""
+    from ceph_tpu.mon.monitor import MON_PING_GRACE
+    net, mons = _three_mons()
+    t = 1000.0
+    for m in mons:
+        m.tick(t)
+    net.pump()
+    peon = mons[1]
+    epoch_before = peon.election_epoch
+    net.set_down("mon.0", True)
+    # every gap is 0.6*grace: each tick would be compensated if the
+    # credit were unbounded
+    step = MON_PING_GRACE * 0.6
+    now = t
+    for _ in range(8):           # 4.8 grace periods of slow ticks
+        now += step
+        peon.tick(now)
+        mons[2].tick(now)
+        net.pump()
+    assert peon.election_epoch > epoch_before
+
+
+def test_first_tick_at_time_zero_still_compensates():
+    """A deterministic clock starting at 0.0 must not disable the
+    compensation (falsy-zero guard): tick(0.0) then a starved jump
+    must NOT start a spurious election."""
+    from ceph_tpu.mon.monitor import MON_PING_GRACE
+    net, mons = _three_mons()
+    for m in mons:
+        m.tick(0.0)
+    net.pump()
+    peon = mons[1]
+    epoch_before = peon.election_epoch
+    peon.tick(3 * MON_PING_GRACE)      # starved jump from t=0
+    assert peon.election_epoch == epoch_before
+    assert peon.leader_rank == 0

@@ -1,0 +1,316 @@
+"""The repo-wide invariant analyzer (ceph_tpu/analysis).
+
+Two layers, mirroring how the reference treats lockdep/lints as
+first-class qa infrastructure:
+
+1. **the catalog is live** — every rule is proven by a seeded-violation
+   snippet it MUST flag next to a clean twin it MUST NOT (a lint that
+   never fires is indistinguishable from no lint);
+2. **the tree is clean** — the full ``ceph_tpu/`` pass runs here in
+   tier-1 and fails the suite on any violation, which is the
+   whole-tree static guarantee the per-PR conventions graduate into.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.analysis import run_analysis
+from ceph_tpu.analysis.core import REPO_ROOT, AnalysisContext
+from ceph_tpu.analysis.rules import (
+    ALL_RULES, OPTIONS_DOC_ALLOW, JitCacheHygieneRule, NoBareLockRule,
+    NoUntrackedSyncRule, NoWallClockRule, NoWireDriftRule,
+    OptionsDocCoverageRule, collect_wire_fields, load_wire_manifest,
+    rule_by_id,
+)
+
+
+def _check(rule, source, relpath="dispatch/snippet.py"):
+    ctx = AnalysisContext(os.path.join(REPO_ROOT, "ceph_tpu", relpath),
+                          source=source, relpath=relpath)
+    return rule.run(ctx)
+
+
+# ---------------------------------------------------------------------------
+# per-rule seeded-violation fixtures + clean twins
+# ---------------------------------------------------------------------------
+
+def test_no_bare_lock_fires_and_clean_twin_passes():
+    rule = NoBareLockRule()
+    seeded = "import threading\nlock = threading.Lock()\n"
+    assert [v.line for v in _check(rule, seeded)] == [2]
+    seeded_r = "import threading\nlock = threading.RLock()\n"
+    assert len(_check(rule, seeded_r)) == 1
+    seeded_c = "import threading\ncv = threading.Condition()\n"
+    assert len(_check(rule, seeded_c)) == 1
+    clean = ("from ceph_tpu.common.lockdep import DebugLock\n"
+             'lock = DebugLock("Snippet::lock")\n')
+    assert _check(rule, clean) == []
+    # a Condition wrapping a named lock is fine
+    clean_c = ("import threading\n"
+               "from ceph_tpu.common.lockdep import DebugLock\n"
+               'cv = threading.Condition(DebugLock("S::l"))\n')
+    assert _check(rule, clean_c) == []
+
+
+def test_no_bare_lock_allows_lockdep_internals():
+    rule = NoBareLockRule()
+    src = "import threading\nlock = threading.Lock()\n"
+    assert _check(rule, src, relpath="common/lockdep.py") == []
+
+
+def test_no_untracked_sync_fires_and_clean_twin_passes():
+    rule = NoUntrackedSyncRule()
+    seeded = ("import jax\n"
+              "def f(x):\n"
+              "    return jax.block_until_ready(x)\n")
+    assert [v.line for v in _check(rule, seeded)] == [3]
+    # method-form sync and device_get too
+    assert len(_check(rule, "def f(x):\n    x.block_until_ready()\n")) == 1
+    assert len(_check(rule, "import jax\n"
+                            "def f(x):\n"
+                            "    return jax.device_get(x)\n")) == 1
+    # np.asarray only suspect in a jax-importing (device-facing) module
+    hidden = ("import jax\nimport numpy as np\n"
+              "def fetch(dev):\n"
+              "    return np.asarray(dev)\n")
+    assert len(_check(rule, hidden)) == 1
+    host_only = ("import numpy as np\n"
+                 "def pack(xs):\n"
+                 "    return np.asarray(xs)\n")
+    assert _check(rule, host_only) == []
+    # allowlisted call-site module: same source, zero violations
+    assert _check(rule, hidden, relpath="ops/snippet.py") == []
+
+
+def test_no_wall_clock_fires_and_clean_twin_passes():
+    rule = NoWallClockRule()
+    seeded = ("import time\n"
+              "def tick_self():\n"
+              "    return time.monotonic()\n")
+    assert [v.line for v in _check(rule, seeded,
+                                   relpath="mon/snippet.py")] == [3]
+    assert len(_check(rule, "import time\nt = time.time()\n",
+                      relpath="osd/snippet.py")) == 1
+    assert len(_check(rule, "import datetime\n"
+                            "t = datetime.datetime.now()\n",
+                      relpath="msg/snippet.py")) == 1
+    # tick-parameter twin is clean
+    clean = "def tick(now):\n    return now + 1.0\n"
+    assert _check(rule, clean, relpath="mon/snippet.py") == []
+    # outside the fabric the rule does not apply at all
+    assert _check(rule, seeded, relpath="tools/snippet.py") == []
+    # the real-socket transport is module-allowlisted
+    assert _check(rule, seeded, relpath="msg/tcp.py") == []
+
+
+def test_jit_cache_hygiene_fires_and_clean_twin_passes():
+    rule = JitCacheHygieneRule()
+    seeded = ("import jax\n"
+              "def hot_path(x):\n"
+              "    return jax.jit(lambda a: a + 1)(x)\n")
+    assert [v.line for v in _check(rule, seeded)] == [3]
+    # nested decorator leaks a fresh trace per call
+    seeded_dec = ("import jax\n"
+                  "def hot(x):\n"
+                  "    @jax.jit\n"
+                  "    def k(a):\n"
+                  "        return a\n"
+                  "    return k(x)\n")
+    assert len(_check(rule, seeded_dec)) == 1
+    # clean twins: module level, __init__, recognized builder,
+    # memoized self-attribute assign
+    for clean in (
+        "import jax\nf = jax.jit(lambda a: a)\n",
+        ("import jax\n"
+         "class C:\n"
+         "    def __init__(self):\n"
+         "        self._f = jax.jit(lambda a: a)\n"),
+        ("import jax\n"
+         "class C:\n"
+         "    def _encode_jit(self):\n"
+         "        return jax.jit(lambda a: a)\n"),
+        ("import jax\n"
+         "class C:\n"
+         "    def encode(self, x):\n"
+         "        fn = self._fn = jax.jit(lambda a: a)\n"
+         "        return fn(x)\n"),
+    ):
+        assert _check(rule, clean) == [], clean
+
+
+def test_pragma_suppresses_exactly_the_named_rule():
+    rule = NoBareLockRule()
+    src = ("import threading\n"
+           "lock = threading.Lock()  # lint: allow[no-bare-lock]\n")
+    assert _check(rule, src) == []
+    # pragma on the line above works too
+    src2 = ("import threading\n"
+            "# lint: allow[no-bare-lock]\n"
+            "lock = threading.Lock()\n")
+    assert _check(rule, src2) == []
+    # a pragma for a DIFFERENT rule does not suppress
+    src3 = ("import threading\n"
+            "lock = threading.Lock()  # lint: allow[no-wall-clock]\n")
+    assert len(_check(rule, src3)) == 1
+
+
+# ---------------------------------------------------------------------------
+# no-wire-drift: manifest pinning
+# ---------------------------------------------------------------------------
+
+def _messages_source():
+    with open(os.path.join(REPO_ROOT, "ceph_tpu", "msg",
+                           "messages.py")) as f:
+        return f.read()
+
+
+def test_wire_manifest_matches_tree():
+    rule = NoWireDriftRule()
+    assert _check(rule, _messages_source(),
+                  relpath="msg/messages.py") == []
+
+
+def test_wire_drift_new_field_is_flagged():
+    rule = NoWireDriftRule()
+    src = _messages_source()
+    # seed a drift: graft one extra dataclass field onto MOSDPing
+    drifted = src.replace(
+        "class MOSDPing(Message):",
+        "class MOSDPing(Message):\n    sneaky_new_field: int = 0", 1)
+    assert drifted != src
+    viol = _check(rule, drifted, relpath="msg/messages.py")
+    assert any("MOSDPing.sneaky_new_field" in v.message for v in viol)
+
+
+def test_wire_drift_removed_class_is_flagged():
+    rule = NoWireDriftRule()
+    src = _messages_source().replace("class MOSDPing(Message):",
+                                     "class MOSDPingRenamed(Message):", 1)
+    viol = _check(rule, src, relpath="msg/messages.py")
+    msgs = "\n".join(v.message for v in viol)
+    assert "MOSDPing" in msgs and "disappeared" in msgs
+
+
+def test_wire_manifest_covers_every_message_class():
+    """The checked-in manifest and the AST collector agree on the
+    class inventory — and the collector really walks subclass chains
+    (MOSDOp etc. inherit Message transitively)."""
+    import ast
+    src = _messages_source()
+    fields = collect_wire_fields(ast.parse(src))
+    manifest = load_wire_manifest()
+    assert set(fields) == set(manifest)
+    assert "MOSDOp" in fields and "Message" in fields
+    assert "trace_id" in manifest["Message"]
+
+
+# ---------------------------------------------------------------------------
+# options-doc-coverage
+# ---------------------------------------------------------------------------
+
+def test_options_doc_rule_fires_on_undocumented_option():
+    rule = OptionsDocCoverageRule()
+    src = ('Option = object\n'
+           'opts = [Option("zz_surely_undocumented_option_xq")]\n')
+    viol = _check(rule, src, relpath="common/config.py")
+    assert len(viol) == 1
+    assert "zz_surely_undocumented_option_xq" in viol[0].message
+    # a documented one passes (mgr_slo_* live in OBSERVABILITY.md)
+    src2 = 'Option = object\nopts = [Option("mgr_slo_fast_window_s")]\n'
+    assert _check(rule, src2, relpath="common/config.py") == []
+
+
+def test_options_allowlist_is_closed():
+    """The one-time allowlist for pre-existing gaps is EMPTY: every
+    currently-registered option is documented, so any future entry
+    would be a new option dodging docs — exactly what the rule
+    forbids."""
+    assert OPTIONS_DOC_ALLOW == set()
+
+
+def test_every_runtime_option_is_seen_statically():
+    """Guard the AST enumeration: every literally-registered runtime
+    option in g_conf.schema must be found by the same string scan the
+    rule uses (the generated debug_<subsys> family excepted)."""
+    import ast
+
+    from ceph_tpu.common.config import g_conf
+    with open(os.path.join(REPO_ROOT, "ceph_tpu", "common",
+                           "config.py")) as f:
+        tree = ast.parse(f.read())
+    static = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                getattr(node.func, "id", "") == "Option" and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                static.add(a.value)
+    runtime = {n for n in g_conf.schema if not n.startswith("debug_")}
+    missing = runtime - static
+    assert not missing, f"options invisible to the lint: {missing}"
+
+
+# ---------------------------------------------------------------------------
+# the whole-tree pass (the tier-1 gate) + runner UX
+# ---------------------------------------------------------------------------
+
+def test_full_tree_is_clean():
+    """THE gate: zero violations across ceph_tpu/ — every contract in
+    the catalog holds tree-wide, not just where a runtime test
+    samples it."""
+    viol = run_analysis()
+    assert viol == [], "\n" + "\n".join(str(v) for v in viol)
+
+
+def test_rule_ids_unique_and_resolvable():
+    ids = [cls.id for cls in ALL_RULES]
+    assert len(ids) == len(set(ids))
+    for i in ids:
+        assert rule_by_id(i).id == i
+    with pytest.raises(KeyError):
+        rule_by_id("nonsense-rule")
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    """The module runner: --json on a seeded-violation file exits 1
+    with machine-readable output; --rule filters."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("import threading\nlock = threading.Lock()\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.analysis", "--json",
+         "--rule", "no-bare-lock", str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 1, proc.stderr
+    data = json.loads(proc.stdout)
+    assert len(data) == 1 and data[0]["rule"] == "no-bare-lock"
+    # clean file -> exit 0
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.analysis", str(good)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_from_import_aliases_cannot_evade_rules():
+    """`from threading import Lock` / `import threading as th` /
+    `from time import monotonic` resolve to the same canonical names
+    the rules match — the obvious evasions are closed."""
+    rule = NoBareLockRule()
+    assert len(_check(rule, "from threading import Lock\n"
+                            "x = Lock()\n")) == 1
+    assert len(_check(rule, "import threading as th\n"
+                            "x = th.RLock()\n")) == 1
+    wall = NoWallClockRule()
+    assert len(_check(wall, "from time import monotonic\n"
+                            "t = monotonic()\n",
+                      relpath="mon/snippet.py")) == 1
+    # numpy from-import in a device-facing module
+    sync = NoUntrackedSyncRule()
+    assert len(_check(sync, "import jax\n"
+                            "from numpy import asarray\n"
+                            "def f(d):\n"
+                            "    return asarray(d)\n")) == 1

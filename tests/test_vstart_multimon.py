@@ -93,7 +93,10 @@ def _wait_new_leader(c, cl, dead_rank, timeout=150.0):
     raise AssertionError(f"no post-kill leader/quorum formed: {last!r}")
 
 
-@pytest.mark.loadflaky
+# loadflaky marker DROPPED (PR 12): the election-timing
+# sensitivity was root-caused to starved-tick grace reads in
+# Monitor.tick (docs/ANALYSIS.md) and fixed; two consecutive
+# green full-suite rounds confirmed, zero auto-reruns
 def test_three_mons_leader_sigkill_recovers(cluster):
     c = cluster
     # the client is BOUND TO A PEON (mon.1): its commands cross the

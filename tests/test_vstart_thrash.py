@@ -61,7 +61,10 @@ def mon_cluster():
     c.close()
 
 
-@pytest.mark.loadflaky
+# loadflaky marker DROPPED (PR 12): the election-timing
+# sensitivity was root-caused to starved-tick grace reads in
+# Monitor.tick (docs/ANALYSIS.md) and fixed; two consecutive
+# green full-suite rounds confirmed, zero auto-reruns
 def test_mon_thrash_kill_revive_rotation(mon_cluster):
     """Three rounds: SIGKILL a different mon each time (leader
     included), writes continuing, then REVIVE it and event-wait for
