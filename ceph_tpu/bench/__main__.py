@@ -63,7 +63,8 @@ def main(argv=None) -> int:
                             measure_dispatch_coalesce,
                             measure_ec_mesh, measure_ec_pipeline,
                             measure_encode, measure_host_native,
-                            measure_traffic, parity_check)
+                            measure_recovery_storm, measure_traffic,
+                            parity_check)
     from ..gf.matrices import gf_gen_rs_matrix
 
     K, M = 8, 4
@@ -152,6 +153,18 @@ def main(argv=None) -> int:
         progress(f"cluster rollup: reply p99 "
                  f"{roll['oplat_p99_usec'].get('reply')}us, "
                  f"{roll['rates'].get('ops')} ops/s, slo {roll['slo']}")
+        # recovery storm (ceph_tpu/recovery, docs/RECOVERY.md): kill
+        # an OSD under open-loop traffic, gate bytes-moved-per-
+        # repaired-shard for the regenerating family vs RS full-stripe
+        mr = measure_recovery_storm(
+            n_objects=8 if args.smoke else 24,
+            ops_per_client=12 if args.smoke else 48)
+        result["metrics"].append(mr)
+        rec = mr["recovery"]
+        progress(f"recovery_storm {rec['bytes_per_repaired_shard_regen']}"
+                 f" B/shard regen vs {rec['bytes_per_repaired_shard_rs']}"
+                 f" RS (ratio {rec['regen_vs_rs_ratio']}, identical "
+                 f"{mr['identical']}, slo {mr['slo']})")
         host = measure_host_native(matrix, batch[0],
                                    target_seconds=0.3 if args.smoke
                                    else 1.5)

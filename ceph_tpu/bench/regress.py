@@ -63,6 +63,17 @@ DEVFLOW_FLOORS = {"copies_per_op": 0.25, "bytes_per_op": 512.0}
 STAGE_TOLERANCE = 0.50
 STAGE_FLOOR_USEC_PER_OP = 50.0
 
+# the recovery gate (recovery-storm PR): the ec_recovery_storm
+# workload's `recovery` block carries bytes-moved-per-repaired-shard
+# per codec family plus the regen/RS ratio; all three are lower-better
+# counter-delta figures (deterministic for a fixed object set, like
+# the copy budget) gated at the tight tolerance.  Floors: a run that
+# repaired nothing reports 0 — below-floor readings gate nothing.
+_RECOVERY_GATED = (("bytes_per_repaired_shard_regen", "B/shard", 64.0),
+                   ("bytes_per_repaired_shard_rs", "B/shard", 64.0),
+                   ("regen_vs_rs_ratio", "ratio", 0.01))
+RECOVERY_TOLERANCE = 0.10
+
 
 def load_trajectory(root: str) -> List[Dict[str, Any]]:
     """All parseable BENCH_r*.json records under *root*, oldest first.
@@ -159,6 +170,7 @@ def compare_against_trajectory(
     compared = 0           # metrics with a value baseline
     devflow_compared = 0   # devflow keys with a gated baseline
     stage_compared = 0     # stage usec/op figures with a gated baseline
+    recovery_compared = 0  # recovery storm figures with a baseline
     for cur in current:
         if not cur.get("fenced") or cur.get("suspect"):
             continue
@@ -203,6 +215,17 @@ def compare_against_trajectory(
                     float(flow_prev.get(key, 0.0) or 0.0),
                     DEVFLOW_FLOORS[key], DEVFLOW_TOLERANCE,
                     baseline_round, regressions, improvements)
+        # ---- recovery gate: the storm's bytes-per-repaired-shard -------
+        rec_cur = cur.get("recovery")
+        rec_prev = baseline.get("recovery")
+        if isinstance(rec_cur, dict) and isinstance(rec_prev, dict):
+            for key, unit, floor in _RECOVERY_GATED:
+                recovery_compared += _gate_lower_better(
+                    f"{name}.recovery.{key}", unit,
+                    float(rec_cur.get(key, 0.0) or 0.0),
+                    float(rec_prev.get(key, 0.0) or 0.0),
+                    floor, RECOVERY_TOLERANCE,
+                    baseline_round, regressions, improvements)
         # ---- stage-budget gate: the workload's stage_breakdown ---------
         sb_cur = (cur.get("stage_breakdown") or {}).get("stages")
         sb_prev = (baseline.get("stage_breakdown") or {}).get("stages")
@@ -220,5 +243,6 @@ def compare_against_trajectory(
     return {"regressions": regressions, "improvements": improvements,
             "compared": compared, "devflow_compared": devflow_compared,
             "stage_compared": stage_compared,
+            "recovery_compared": recovery_compared,
             "no_baseline": no_baseline,
             "tolerance": tolerance, "platform": platform}

@@ -329,6 +329,19 @@ class MiniCluster:
             lambda c, a: (g_devprof.reset(), {"reset": True})[1],
             "zero the device-flow profiler's sites, counters and "
             "transfer-size histogram")
+        from .recovery import aggregate_families, recovery_perf_counters
+        self.perf_collection.add(recovery_perf_counters())
+        asok.register(
+            "recovery dump",
+            lambda c, a: {
+                "counters": recovery_perf_counters().dump(),
+                "families": aggregate_families(self.osds.values()),
+                "per_osd": {o.name: o.recovery_sched.dump()
+                            for o in self.osds.values()},
+            },
+            "recovery scheduler state: pacing, per-codec-family "
+            "bytes-moved-per-repaired-shard, repair vs full-stripe "
+            "accounting")
         from .fault import fault_perf_counters, g_breakers, g_faults
         self.perf_collection.add(fault_perf_counters())
 
@@ -541,6 +554,11 @@ class MiniCluster:
 
     def mark_osd_out(self, osd_id: int) -> None:
         self.mon.mark_osd_out(osd_id)
+        self.network.pump()
+        self.run_recovery()
+
+    def mark_osd_in(self, osd_id: int) -> None:
+        self.mon.mark_osd_in(osd_id)
         self.network.pump()
         self.run_recovery()
 

@@ -158,6 +158,22 @@ def build_options() -> List[Option]:
         Option("ec_breaker_cooldown_s", OPT_FLOAT).set_default(30.0)
         .set_description("seconds an open breaker refuses the device "
                          "before half-open probing it to auto-restore"),
+        Option("osd_recovery_repair_reads", OPT_BOOL).set_default(True)
+        .set_description("repair a single lost shard of a "
+                         "regenerating-code pool from d sub-chunk "
+                         "helper contributions instead of k whole "
+                         "chunks (ceph_tpu/recovery; off = always "
+                         "full-stripe decode)"),
+        Option("osd_recovery_max_active", OPT_INT).set_default(8)
+        .set_description("sub-chunk repair rounds in flight per OSD; "
+                         "excess rounds park and drain as slots free "
+                         "(reference osd_recovery_max_active role)"),
+        Option("ec_regen_subchunk_unit", OPT_INT).set_default(512)
+        .set_description("default sub-chunk width (bytes) for "
+                         "regenerating-code pools whose profile omits "
+                         "subchunk=; stripe width is B x unit, stored "
+                         "chunk alpha x unit per stripe "
+                         "(docs/RECOVERY.md)"),
         Option("osd_scrub_min_interval", OPT_FLOAT).set_default(86400.0)
         .set_description("seconds between periodic background scrubs "
                          "of a PG (reference osd_scrub_min_interval)"),

@@ -159,11 +159,20 @@ def _run_group_encode(reqs, bucket_c, leader, use_device):
     # each is zero-padded to the bucket width and sliced back to its own
     # width (columnwise independence makes the pad invisible)
     k = leader.get_data_chunk_count()
+    # full-output codecs (product-matrix regenerating): the payload
+    # assembles into message matrices via the codec's own hook, and
+    # encode_batch yields EVERY shard row — the post-matmul slice takes
+    # all rows from the coalesced result, none from the input
+    prepare = getattr(leader, "regen_prepare_batch", None)
+    full_out = bool(getattr(leader, "dispatch_full_output", False))
     raw, offsets, s0 = [], [], 0
     for r in reqs:
-        stripes = np.frombuffer(bytes(r.payload), dtype=np.uint8) \
-            if not isinstance(r.payload, np.ndarray) else r.payload
-        stripes = stripes.reshape(r.n_stripes, k, r.chunk_size)
+        if prepare is not None:
+            stripes = prepare(r.payload, r.n_stripes)
+        else:
+            stripes = np.frombuffer(bytes(r.payload), dtype=np.uint8) \
+                if not isinstance(r.payload, np.ndarray) else r.payload
+            stripes = stripes.reshape(r.n_stripes, k, r.chunk_size)
         raw.append(stripes)
         offsets.append((s0, stripes))
         s0 += r.n_stripes
@@ -192,7 +201,11 @@ def _run_group_encode(reqs, bucket_c, leader, use_device):
     for r, (off, stripes) in zip(reqs, offsets):
         res: Dict[int, np.ndarray] = {}
         for i in r.want:
-            if i < k:
+            if full_out:
+                res[i] = np.ascontiguousarray(
+                    coding[off:off + r.n_stripes, i,
+                           :r.chunk_size]).reshape(-1)
+            elif i < k:
                 res[i] = np.ascontiguousarray(
                     stripes[:, i, :]).reshape(-1)
             else:

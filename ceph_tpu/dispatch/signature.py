@@ -84,6 +84,12 @@ def batchable(ec_impl, chunk_size: int, kind: str) -> bool:
             return False
     elif not hasattr(ec_impl, "decode_batch"):
         return False
+    elif getattr(ec_impl, "dispatch_full_output", False):
+        # full-output codecs' below-d decode interprets sub-chunk
+        # positions, which bucket padding would shift — decode kinds
+        # run uncoalesced (still through the dispatcher accounting,
+        # still fault-guarded inside the codec)
+        return False
     # the pad from chunk_size to its bucket must be whole blocks:
     # chunk_size % block == 0 here plus bucket_chunk_size rounding the
     # bucket up to a block multiple together guarantee it

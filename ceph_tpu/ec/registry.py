@@ -69,7 +69,8 @@ class ErasureCodePluginRegistry:
             self.get(n)
 
     def names(self):
-        for n in ("jerasure", "isa", "tpu", "lrc", "shec", "example_xor"):
+        for n in ("jerasure", "isa", "tpu", "lrc", "shec",
+                  "regenerating", "example_xor"):
             self._load_builtin(n)
         return sorted(self._plugins)
 
@@ -100,6 +101,9 @@ class ErasureCodePluginRegistry:
         elif name == "shec":
             from .shec import ErasureCodeShec
             factory = ErasureCodeShec
+        elif name == "regenerating":
+            from .regenerating import ErasureCodeRegenerating
+            factory = ErasureCodeRegenerating
         elif name == "example_xor":
             from .example_xor import ErasureCodeExampleXor
             factory = ErasureCodeExampleXor

@@ -84,6 +84,13 @@ SITE_CATALOG: Dict[str, str] = {
     "osd.shard_read_eio":
         "shard-side EC read returns EIO (bluestore_debug_inject_read_err "
         "role) — the primary must reconstruct from surviving shards",
+    "recovery.repair_read":
+        "sub-chunk repair round start (recovery scheduler) — firing "
+        "degrades the repair to the full-stripe decode path",
+    "recovery.helper_fetch":
+        "helper-side repair contribution read (handle_sub_read) — a "
+        "dropped helper fails the round and the orchestrator falls "
+        "back to full-stripe decode",
     "msg.drop":
         "drop a fabric message (ms inject socket failures role); "
         "context is '<MsgType> <src>><dst>' for match= scoping",

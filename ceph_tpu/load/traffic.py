@@ -80,6 +80,14 @@ class TrafficSpec:
     max_rounds: int = 100000
     tick_every: int = 32          # cluster.tick cadence (retry sweeps)
     keep_completions: bool = True  # False for soaks: aggregate only
+    # first-class cluster events scheduled mid-run (the recovery-storm
+    # shape, docs/RECOVERY.md): (round, action, osd_id) with action in
+    # osd_kill | osd_down | osd_out | osd_revive | osd_in — fired at
+    # the START of that round, so the remaining traffic runs against
+    # the changed topology.  "osd_kill" is the full storm trigger
+    # (network down + mon mark-down); pair it with "osd_out" to start
+    # backfill to a spare while clients keep running.
+    events: Tuple[Tuple[int, str, int], ...] = ()
 
 
 @dataclass
@@ -339,6 +347,23 @@ class SyntheticClient(RadosClient):
 from ..trace.histogram import hist_percentiles, merged_percentiles  # noqa: E402
 
 
+def _apply_event(cluster, action: str, osd_id: int) -> None:
+    """One scheduled topology event (TrafficSpec.events)."""
+    if action == "osd_kill":
+        cluster.kill_osd(osd_id)
+        cluster.mark_osd_down(osd_id)
+    elif action == "osd_down":
+        cluster.mark_osd_down(osd_id)
+    elif action == "osd_out":
+        cluster.mark_osd_out(osd_id)
+    elif action == "osd_revive":
+        cluster.revive_osd(osd_id)
+    elif action == "osd_in":
+        cluster.mark_osd_in(osd_id)
+    else:
+        raise ValueError(f"unknown traffic event action '{action}'")
+
+
 @dataclass
 class TrafficResult:
     spec: TrafficSpec
@@ -384,8 +409,16 @@ def run_traffic(cluster, spec: TrafficSpec,
                                    f"client.{spec.pool}.{i}", spec, i)
                    for i in range(spec.n_clients)]
         rnd = 0
+        fired: set = set()
         while rnd < spec.max_rounds:
-            if all(cl.done() for cl in clients):
+            for i, (r_ev, action, osd_id) in enumerate(spec.events):
+                # events fire when their round arrives (or is passed —
+                # a run can complete rounds faster than scheduled)
+                if i not in fired and rnd >= r_ev:
+                    fired.add(i)
+                    _apply_event(cluster, action, osd_id)
+            if all(cl.done() for cl in clients) and \
+                    len(fired) == len(spec.events):
                 break
             batches = [cl.collect_sends(rnd) for cl in clients]
             sent = sum(len(b) for b in batches)
@@ -406,6 +439,7 @@ def run_traffic(cluster, spec: TrafficSpec,
                 progress(rnd, sum(cl.completed for cl in clients))
             if sent == 0 and not any(cl.pending or cl._resend
                                      for cl in clients) and \
+                    len(fired) == len(spec.events) and \
                     all(cl.issued >= spec.ops_per_client
                         for cl in clients):
                 # truly drained: budgets spent AND nothing in flight.

@@ -948,6 +948,12 @@ class Monitor(Dispatcher):
             raise RuntimeError(f"create_rule failed: {rno}")
         k = ec.get_data_chunk_count()
         stripe_unit = int(profile.get("stripe_unit", DEFAULT_STRIPE_UNIT))
+        stripe_width = k * stripe_unit
+        psw = getattr(ec, "preferred_stripe_width", None)
+        if psw is not None:
+            # codec-geometry pools (regenerating codes): the plugin
+            # dictates the stripe width (one message matrix per stripe)
+            stripe_width = psw()
         from ..osdmap.types import FLAG_EC_OVERWRITES, FLAG_HASHPSPOOL
         flags = FLAG_HASHPSPOOL | (FLAG_EC_OVERWRITES if ec_overwrites
                                    else 0)
@@ -955,7 +961,7 @@ class Monitor(Dispatcher):
                          min_size=k + 1, crush_rule=rno,
                          pg_num=pg_num, pgp_num=pg_num,
                          erasure_code_profile=profile_name,
-                         stripe_width=k * stripe_unit, flags=flags)
+                         stripe_width=stripe_width, flags=flags)
         self._topology_dirty = True
         self.log_entry("mon", "INF",
                        f"pool '{name}' created (erasure "

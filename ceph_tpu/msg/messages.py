@@ -184,6 +184,12 @@ class MOSDECSubOpRead(Message):
     length: int = 0          # 0 = to end of shard
     attrs_only: bool = False  # stat/size probe: no payload wanted
     subchunks: List[Tuple[int, int]] = field(default_factory=list)
+    # >= 0: sub-chunk repair read — the helper computes and returns its
+    # β-sub-chunk contribution toward rebuilding this shard id instead
+    # of shipping the chunk (regenerating codes, docs/RECOVERY.md).
+    # Omitted from the wire when -1, so pre-repair frames and the
+    # pinned encoding corpus stay byte-identical.
+    repair_for: int = -1
 
 
 @dataclass

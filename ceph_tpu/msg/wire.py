@@ -124,6 +124,12 @@ def encode_message(msg: M.Message) -> bytes:
         # byte-identical to the pre-tracing format (decode fills the
         # dataclass default 0)
         fields.pop("parent_span_id", None)
+    if fields.get("repair_for", -1) < 0:
+        # optional repair-read selector (MOSDECSubOpRead): only on the
+        # wire for sub-chunk repair rounds — plain reads and the
+        # archived corpus encode byte-identically (decode fills the
+        # dataclass default -1)
+        fields.pop("repair_for", None)
     if not fields.get("retry_after"):
         # optional QoS throttle hint (MOSDOpReply): same
         # omitted-when-default contract as parent_span_id — unthrottled

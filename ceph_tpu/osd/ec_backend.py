@@ -257,6 +257,11 @@ class InflightRead:
     seen: int = 0                 # shards that answered at all
     saw_eio: bool = False         # any non-ENOENT shard failure (crc etc.)
     raw: bool = False             # recovery mode: deliver raw shard chunks
+    repair_for: int = -1          # >=0: sub-chunk repair round for this
+                                  # shard — replies carry computed helper
+                                  # contributions, and NO reconstruction
+                                  # retry fans out (the recovery
+                                  # orchestrator owns the fallback)
     user_attrs: Dict[str, bytes] = field(default_factory=dict)
     ledger: object = None         # see InflightWrite.ledger
 
@@ -317,7 +322,13 @@ class ECBackend:
         self.pg = pg                      # owning PG (provides osd/messenger)
         self.ec_impl = ec_impl
         k = ec_impl.get_data_chunk_count()
-        self.sinfo = stripe_info_t(k, stripe_width)
+        # codecs with their own chunk geometry (product-matrix
+        # regenerating codes: stored chunk != stripe_width/k) supply a
+        # stripe_info through the plugin hook; classic codes keep the
+        # reference shape
+        mk_sinfo = getattr(ec_impl, "make_stripe_info", None)
+        self.sinfo = mk_sinfo(stripe_width) if mk_sinfo is not None \
+            else stripe_info_t(k, stripe_width)
         self.k = k
         self.n = ec_impl.get_chunk_count()
         self.inflight_writes: Dict[int, InflightWrite] = {}
@@ -770,6 +781,11 @@ class ECBackend:
         a0 = self.sinfo.logical_to_prev_stripe_offset(offset)
         a1 = self.sinfo.logical_to_next_stripe_offset(offset + len(op.data))
         old_aligned = self.sinfo.logical_to_next_stripe_offset(old_size)
+        if getattr(self.ec_impl, "requires_whole_object_rw", False):
+            # non-systematic codecs: chunk offsets don't map to logical
+            # ranges, so an rmw reads and re-encodes the WHOLE object
+            a0 = 0
+            a1 = max(a1, old_aligned)
         read_end = min(a1, old_aligned)
         if read_end <= a0:
             self._rmw_have_old(op, a0, a1, b"")
@@ -907,6 +923,43 @@ class ECBackend:
         (no decode) — on_done(result, {shard: bytes}, logical_size,
         user_attrs)."""
         return self._start_read(oid, 0, 0, False, on_done, raw=True)
+
+    def repair_read(self, oid: str, lost: int,
+                    plan: Dict[int, List[Tuple[int, int]]],
+                    on_done: Callable[
+                        [int, Dict[int, bytes], int, Dict[str, bytes]],
+                        None]) -> int:
+        """Sub-chunk repair round (docs/RECOVERY.md): fan a
+        repair-contribution read to each helper shard in *plan* (the
+        codec's ``minimum_to_decode({lost}, avail)`` answer).  Helpers
+        reply with their computed β-sub-chunk contribution instead of
+        the whole chunk; ``on_done(result, {helper: contribution},
+        logical_size, user_attrs)``.  ANY failed helper fails the round
+        (result -5) with no reconstruction retry — the recovery
+        orchestrator then falls back to the full-stripe decode path."""
+        tid = self.next_tid()
+        acting = self.pg.acting_shards()
+        rd = InflightRead(tid=tid, oid=oid, on_done=on_done, raw=True,
+                          repair_for=lost, ledger=g_oplat.current())
+        cur_trace = g_tracer.current_trace_id() if g_tracer.enabled else 0
+        cur_span = g_tracer.current_span_id() if g_tracer.enabled else 0
+        for shard, subs in plan.items():
+            osd = acting.get(shard)
+            if osd is None:
+                on_done(-5, {}, -1, {})
+                return tid
+            msg = MOSDECSubOpRead(tid=tid, pgid=self.pg.pgid,
+                                  shard=shard, oid=oid,
+                                  subchunks=list(subs),
+                                  repair_for=lost,
+                                  trace_id=cur_trace,
+                                  parent_span_id=cur_span)
+            rd.pending.add(shard)
+            self.pg.send_to_osd(osd, msg)
+        if rd.ledger is not None:
+            rd.ledger.mark("fan_out")
+        self.inflight_reads[tid] = rd
+        return tid
 
     def handle_sub_write(self, msg: MOSDECSubOpWrite, store: MemStore,
                          pg=None) -> MOSDECSubOpWriteReply:
@@ -1084,8 +1137,12 @@ class ECBackend:
             self, oid: str, on_complete: Callable[[int, bytes], None],
             offset: int = 0, length: int = 0) -> int:
         """Client-facing (ranged) read: decode the covering chunk range,
-        slice, trim to logical size (ECBackend.cc:1580-1669)."""
-        if length == 0:
+        slice, trim to logical size (ECBackend.cc:1580-1669).  Codecs
+        without a systematic layout (regenerating codes) fetch whole
+        shards regardless of range — the decoded object is sliced
+        logically instead."""
+        whole = getattr(self.ec_impl, "requires_whole_object_rw", False)
+        if length == 0 or whole:
             c0, c1 = 0, 0
         else:
             a0 = self.sinfo.logical_to_prev_stripe_offset(offset)
@@ -1101,7 +1158,8 @@ class ECBackend:
                 body = data[:size] if size >= 0 else data
                 on_complete(0, body[offset:])
                 return
-            a0 = self.sinfo.logical_to_prev_stripe_offset(offset)
+            a0 = 0 if whole else \
+                self.sinfo.logical_to_prev_stripe_offset(offset)
             end = min(offset + length, size) if size >= 0 \
                 else offset + length
             if end <= offset:
@@ -1216,6 +1274,35 @@ class ECBackend:
                 return MOSDECSubOpReadReply(tid=msg.tid, pgid=msg.pgid,
                                             shard=msg.shard, oid=msg.oid,
                                             result=-5)
+        if msg.repair_for >= 0:
+            # sub-chunk repair helper (docs/RECOVERY.md): compute this
+            # shard's β-sub-chunk contribution toward rebuilding shard
+            # ``repair_for`` instead of shipping the whole chunk.  The
+            # chaos site drops helper fetches so the orchestrator's
+            # full-stripe fallback is a tested path, not a hope.
+            if g_faults.site_armed("recovery.helper_fetch") and \
+                    g_faults.should_fire(
+                        "recovery.helper_fetch",
+                        ctx=f"{cid}:{msg.oid}:shard{msg.shard}"):
+                fault_perf_counters().inc(l_fault_eio_injected)
+                return MOSDECSubOpReadReply(tid=msg.tid, pgid=msg.pgid,
+                                            shard=msg.shard,
+                                            oid=msg.oid, result=-5)
+            contribute = getattr(self.ec_impl, "repair_contribution",
+                                 None)
+            C = self.sinfo.get_chunk_size()
+            if contribute is None or not data or len(data) % C:
+                # codec can't help (or torn shard): the orchestrator
+                # falls back to the full-stripe decode path
+                return MOSDECSubOpReadReply(tid=msg.tid, pgid=msg.pgid,
+                                            shard=msg.shard,
+                                            oid=msg.oid, result=-5)
+            body = np.frombuffer(data, dtype=np.uint8).reshape(-1, C)
+            contrib = contribute(msg.shard, msg.repair_for, body)
+            return MOSDECSubOpReadReply(tid=msg.tid, pgid=msg.pgid,
+                                        shard=msg.shard, oid=msg.oid,
+                                        data=contrib.tobytes(),
+                                        attrs=attrs, result=0)
         if msg.attrs_only:
             data = b""
         elif msg.offset or msg.length:
@@ -1233,6 +1320,29 @@ class ECBackend:
             return
         rd.pending.discard(msg.shard)
         rd.seen += 1
+        if rd.repair_for >= 0:
+            # sub-chunk repair round: collect contributions; any
+            # failure fails the round (the orchestrator falls back to
+            # full-stripe decode — no reconstruction retry here)
+            if msg.result == 0:
+                rd.chunks[msg.shard] = msg.data
+                sz = msg.attrs.get(SIZE_ATTR)
+                if sz is not None:
+                    rd.size = struct.unpack("<Q", sz)[0]
+                if not rd.user_attrs:
+                    rd.user_attrs = user_attrs_of(msg.attrs)
+            else:
+                rd.failed.add(msg.shard)
+            if rd.pending:
+                return
+            del self.inflight_reads[msg.tid]
+            if rd.ledger is not None:
+                rd.ledger.mark("ack_gather")
+            if rd.failed:
+                rd.on_done(-5, {}, rd.size, rd.user_attrs)
+            else:
+                rd.on_done(0, dict(rd.chunks), rd.size, rd.user_attrs)
+            return
         if msg.result == 0:
             rd.chunks[msg.shard] = msg.data
             sz = msg.attrs.get(SIZE_ATTR)

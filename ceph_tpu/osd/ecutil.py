@@ -91,6 +91,19 @@ def encode(sinfo: stripe_info_t, ec_impl, data,
     n = ec_impl.get_chunk_count()
     C = sinfo.get_chunk_size()
 
+    prepare = getattr(ec_impl, "regen_prepare_batch", None)
+    if prepare is not None and hasattr(ec_impl, "encode_batch"):
+        # product-matrix regenerating codes (ec/regenerating.py): the
+        # payload assembles into batched message matrices first, and
+        # ONE Ψ projection yields every shard row (full-output codec —
+        # there is no systematic passthrough set)
+        allc = ec_impl.encode_batch(prepare(buf, S))     # (S, n, C)
+        g_oplat.checkpoint("device_call")
+        out = {i: np.ascontiguousarray(allc[:, i, :]).reshape(-1)
+               for i in want}
+        g_devprof.account_host_copy(
+            "ecutil.shard_slice", sum(b.nbytes for b in out.values()))
+        return out
     if hasattr(ec_impl, "encode_batch_full"):
         # mapped layered codes (lrc): one batched call yields every
         # physical chunk directly
@@ -154,6 +167,13 @@ def decode_concat(sinfo: stripe_info_t, ec_impl,
     k = ec_impl.get_data_chunk_count()
     chunks2d = {i: np.asarray(b, dtype=np.uint8).reshape(S, C)
                 for i, b in to_decode.items()}
+    if hasattr(ec_impl, "decode_payload_batch"):
+        # non-systematic regenerating codes: no shard holds raw data
+        # rows — the codec reconstructs the logical payload directly
+        # from any k shard chunks (structured product-matrix decode)
+        data = ec_impl.decode_payload_batch(chunks2d)    # (S, width)
+        g_oplat.checkpoint("device_call")
+        return np.ascontiguousarray(data).reshape(-1)
     if hasattr(ec_impl, "decode_batch"):
         # decode_batch is keyed by *physical* chunk ids; logical data row
         # i lives at chunk_index(i) for mapped codes (lrc)

@@ -119,6 +119,11 @@ class OSD(Dispatcher):
         self.op_tracker = OpTracker()
         self._tracked: Dict[Tuple[str, int], object] = {}
         self._recovery_queue: List[PG] = []
+        # recovery orchestration (ceph_tpu/recovery): paced sub-chunk
+        # repair rounds, QoS-classed through the recovery dmClock
+        # class, per-codec-family bytes-moved accounting
+        from ..recovery import RecoveryScheduler
+        self.recovery_sched = RecoveryScheduler(self)
         from ..common.config import g_conf
         self.op_wq = ShardedOpWQ(
             wall=bool(g_conf.get_val("osd_op_queue_mclock_wall")))
@@ -709,6 +714,11 @@ class OSD(Dispatcher):
             # deferred EC write-pipeline continuation (fan-out under
             # the PG lock — _wq_handle_locked took it via item[1])
             item[2]()
+        elif kind == "recovery":
+            # a repair round admitted by the recovery scheduler: it
+            # reached here through the CLASS_RECOVERY dmClock lane, so
+            # client vs repair ordering was the arbiter's call
+            item[2]()
 
     def _client_hist_lane(self, src: str) -> str:
         if src in self._client_hist_lanes:
@@ -898,6 +908,9 @@ class OSD(Dispatcher):
         self.maybe_schedule_scrubs()
         self._report_strays()
         self.report_pg_stats()
+        # drain repair rounds parked by pacing (slots may have freed
+        # outside the completion path, e.g. a fallback round)
+        self.recovery_sched.kick()
         # map says down but we are alive: keep asking back in every tick
         # (the reference's OSD::start_boot retries; a single send can be
         # lost while connections re-establish after a daemon reboot)
@@ -1236,6 +1249,18 @@ class OSD(Dispatcher):
     def _recover_ec_oid_push(self, pg: PG, oid: str,
                              targets: Dict[int, Tuple[int, str]],
                              needed) -> None:
+        # repair-optimal path first (ceph_tpu/recovery): a single lost
+        # shard of a regenerating-code pool rebuilds from d sub-chunk
+        # helper contributions instead of k whole chunks; the scheduler
+        # owns pacing/QoS/accounting and falls back here on any failure
+        if self.recovery_sched.try_repair(pg, oid, targets,
+                                          list(needed)):
+            return
+        self._recover_ec_oid_fullstripe(pg, oid, targets, needed)
+
+    def _recover_ec_oid_fullstripe(self, pg: PG, oid: str,
+                                   targets: Dict[int, Tuple[int, str]],
+                                   needed) -> None:
         be = pg.backend
 
         def on_chunks(result: int, chunks: Dict[int, bytes],
@@ -1245,6 +1270,9 @@ class OSD(Dispatcher):
                 pg._recovering.discard(oid)
                 self.request_recovery(pg)
                 return
+            self.recovery_sched.note_fullstripe(
+                be.ec_impl, sum(len(b) for b in chunks.values()),
+                len(needed))
             rec = be.recover_object(oid, set(needed), chunks, size)
             version = max(v for (v, _op) in targets.values())
 
@@ -1260,6 +1288,8 @@ class OSD(Dispatcher):
 
             self.dout(5, f"recovery pushing {oid} -> shards "
                       f"{sorted(needed)} acting {pg.acting}")
+            self.recovery_sched.note_push(
+                sum(len(rec[s]) for s in needed))
             be.push_chunks(oid, {s: rec[s] for s in needed}, size, pushed,
                            version=version, xattrs=attrs)
 

@@ -15,6 +15,8 @@ site catalog, arming a trigger, the unknown-site refusal, and clearing.
       "mesh.encode_batch": "mesh-sharded flush execution (ceph_tpu/mesh runtime) \u2014 exhaustion degrades the flush to the single-device path",
       "msg.drop": "drop a fabric message (ms inject socket failures role); context is '<MsgType> <src>><dst>' for match= scoping",
       "osd.shard_read_eio": "shard-side EC read returns EIO (bluestore_debug_inject_read_err role) \u2014 the primary must reconstruct from surviving shards",
+      "recovery.helper_fetch": "helper-side repair contribution read (handle_sub_read) \u2014 a dropped helper fails the round and the orchestrator falls back to full-stripe decode",
+      "recovery.repair_read": "sub-chunk repair round start (recovery scheduler) \u2014 firing degrades the repair to the full-stripe decode path",
       "tpu.decode_batch_device": "device-resident decode entry point (tpu_plugin, mesh/bench)",
       "tpu.encode_batch_device": "device-resident encode entry point (tpu_plugin, mesh/bench)"
     }

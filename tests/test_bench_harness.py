@@ -235,6 +235,37 @@ def test_gate_time_metrics_are_lower_better(tmp_path):
     assert not out["regressions"] and len(out["improvements"]) == 1
 
 
+def test_gate_recovery_block_lower_better(tmp_path):
+    """The recovery gate: the storm's bytes-per-repaired-shard and the
+    regen/RS ratio gate lower-better at the tight tolerance; a ratio
+    creeping past tolerance is a regression even when the primary
+    value held."""
+    def storm(regen, rs, ratio):
+        m = _metric("ec_recovery_storm", regen, unit="B/shard")
+        m["recovery"] = {"bytes_per_repaired_shard_regen": regen,
+                         "bytes_per_repaired_shard_rs": rs,
+                         "regen_vs_rs_ratio": ratio}
+        return m
+
+    _write_round(tmp_path, 6, "cpu", [storm(5120.0, 32768.0, 0.156)])
+    traj = regress.load_trajectory(str(tmp_path))
+    # unchanged figures: compared, no regression
+    out = regress.compare_against_trajectory(
+        [storm(5120.0, 32768.0, 0.156)], traj, "cpu")
+    assert out["recovery_compared"] == 3 and not out["regressions"]
+    # repair bandwidth doubled: the regen figure AND the ratio regress
+    out = regress.compare_against_trajectory(
+        [storm(10240.0, 32768.0, 0.3125)], traj, "cpu")
+    names = {r["name"] for r in out["regressions"]}
+    assert "ec_recovery_storm.recovery.bytes_per_repaired_shard_regen" \
+        in names
+    assert "ec_recovery_storm.recovery.regen_vs_rs_ratio" in names
+    # improvement direction classifies as improvement
+    out = regress.compare_against_trajectory(
+        [storm(2560.0, 32768.0, 0.078)], traj, "cpu")
+    assert not out["regressions"] and out["improvements"]
+
+
 def test_gate_within_tolerance_passes(tmp_path):
     _write_round(tmp_path, 6, "cpu", [_metric("enc", 10.0)])
     traj = regress.load_trajectory(str(tmp_path))
@@ -378,7 +409,7 @@ def test_smoke_mode_end_to_end():
             "ec_dispatch_serial_fenced",
             "ec_pipeline_fenced", "ec_pipeline_depth1_fenced",
             "ec_mesh_fenced", "ec_mesh_single_fenced",
-            "traffic_harness_smoke"} <= names
+            "traffic_harness_smoke", "ec_recovery_storm"} <= names
     # the coalesce metric carries its serial twin and speedup
     mc = next(m for m in out["metrics"]
               if m["name"] == "ec_dispatch_coalesce_fenced")
@@ -434,6 +465,23 @@ def test_smoke_mode_end_to_end():
     assert roll["oplat_p99_usec"].get("class_queue", 0) > 0, roll
     assert roll["rates"]["ops"] > 0, roll
     assert roll["samples"] >= 2 and "slo" in roll
+    # recovery-storm acceptance (docs/RECOVERY.md): one OSD killed
+    # under open-loop traffic at k8m4/d10 — the regenerating family's
+    # bytes-moved-per-repaired-shard beats the RS full-stripe baseline
+    # under the 0.6 gate, every object is byte-exact after backfill,
+    # and the well-behaved clients' rollup raised no TPU_SLO_OPLAT
+    mrs = next(m for m in out["metrics"]
+               if m["name"] == "ec_recovery_storm")
+    rec = mrs["recovery"]
+    assert rec["bytes_per_repaired_shard_regen"] > 0
+    assert rec["bytes_per_repaired_shard_rs"] > 0
+    assert rec["regen_vs_rs_ratio"] <= 0.6, rec
+    assert rec["families"]["pm-regen"]["repair_rounds"] > 0
+    assert rec["families"]["isa-matrix"]["fullstripe_rounds"] > 0
+    assert mrs["identical"] is True
+    assert mrs["byte_exact_traffic"] is True
+    assert mrs["slo"].get("TPU_SLO_OPLAT") == "ok", mrs["slo"]
+    assert mrs["cluster_rollup"]["oplat_p99_usec"].get("reply", 0) > 0
     # devprof acceptance: EVERY fenced workload emits a devflow block
     # with the gated per-op figures, and the dispatch/pipeline pairs
     # show coalescing as FEWER copies per op (the copy-budget story)

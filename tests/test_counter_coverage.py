@@ -36,6 +36,24 @@ def cluster_and_text():
         g_conf.rm_val("ec_mesh_chips")
         g_conf.rm_val("ec_dispatch_batch_window_us")
         g_mesh.topology()
+    # one repair round through a regenerating pool so the `recovery`
+    # counter families and the bytes-per-shard histogram register and
+    # move — the lint below then covers them like any other family
+    c.create_ec_pool("lintregen", k=3, m=2, pg_num=2,
+                     plugin="regenerating", extra_profile={"d": "4"})
+    assert cl.write_full("lintregen", "r", b"r" * 3000) == 0
+    regen_pid = c.mon.osdmap.lookup_pg_pool_name("lintregen")
+    victim = next(pg.acting[-1] for _pgid, pg in c.primary_pgs()
+                  if pg.backend is not None and _pgid[0] == regen_pid)
+    c.kill_osd(victim)
+    c.mark_osd_down(victim)
+    c.mark_osd_out(victim)
+    for _ in range(6):
+        c.tick(dt=1.0)
+    from ceph_tpu.recovery import (l_recovery_repair_rounds,
+                                   recovery_perf_counters)
+    assert recovery_perf_counters().get(l_recovery_repair_rounds) > 0
+    assert cl.read("lintregen", "r") == b"r" * 3000
     # one mgr tick so the telemetry ring holds a post-IO sample and
     # the ceph_cluster_* rollup families render with real content
     c.tick(dt=1.0)

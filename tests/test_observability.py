@@ -237,6 +237,18 @@ def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
     c.tpu_status()
     c.mgr.telemetry.dump()
     assert calls["n"] == 0, "telemetry collection added a device sync"
+    # recovery extension: an ARMED recovery scheduler (repair reads
+    # enabled, pacing configured — the default-on state every OSD
+    # boots with) must add zero syncs to the client write path; a
+    # `recovery dump` is pure counter reads and must not sync either
+    assert bool(g_conf.get_val("osd_recovery_repair_reads"))
+    for osd in c.osds.values():
+        assert osd.recovery_sched is not None
+    assert cl.write_full("trace", "o_recovery_armed",
+                         b"r" * 20000) == 0
+    c.admin_socket.execute("recovery dump")
+    assert calls["n"] == 0, "armed recovery scheduler added a " \
+        "device sync to the client write path"
 
 
 def test_slow_op_span_tree_and_histogram_dump(clean_tracing):

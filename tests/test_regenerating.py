@@ -1,0 +1,310 @@
+"""Product-matrix regenerating codec (ec/regenerating.py): property
+tests.
+
+The codec's contract, tested at every layer:
+
+- encode/decode/repair byte-identical between the device path
+  (backend=tpu — the [[I],[Ψ]] bit-matmul) and the CPU reference twin
+  (backend=host — MUL_TABLE math), across (k, m, d, technique, chunk)
+  mixes;
+- any-k reconstruction (the structured product-matrix decode) and
+  ≥d-survivor row reconstruction both recover exact bytes;
+- d-helper sub-chunk repair rebuilds a lost shard from d·β·L moved
+  bytes — the minimum_to_decode repair surface answers a single-shard
+  query with d helpers at β sub-chunks each;
+- breaker-open (CPU fallback) and mesh-on states stay byte-identical;
+- a cluster twin (dispatch window on vs off) stores byte-exact shard
+  BODIES, and the non-systematic whole-object rw guards keep ranged
+  reads and rmw byte-exact.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.registry import instance as plugin_registry
+
+
+def _mk(profile, backend="host"):
+    p = dict(profile)
+    p["backend"] = backend
+    return plugin_registry.factory("regenerating", p)
+
+
+PROFILES = [
+    {"k": "3", "m": "2", "d": "4"},
+    {"k": "4", "m": "3", "d": "5"},
+    {"k": "4", "m": "3", "d": "6"},
+    {"k": "3", "m": "2", "d": "3"},                      # d = k edge
+    {"k": "8", "m": "4", "d": "10"},                     # the storm shape
+    {"k": "3", "m": "3", "technique": "pm_msr"},         # d = 4
+    {"k": "4", "m": "3", "technique": "pm_msr"},         # d = 6
+]
+
+
+@pytest.mark.parametrize("profile", PROFILES,
+                         ids=[str(p) for p in PROFILES])
+def test_roundtrip_any_k_and_row_reconstruction(profile):
+    codec = _mk(profile)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(11)
+    for size in (100, 3000, 7777):
+        payload = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        full = codec.encode(set(range(n)), payload)
+        combos = list(itertools.combinations(range(n), codec.k))
+        # all shard chunks equal length, decode from any k recovers
+        for K in combos[::max(1, len(combos) // 12)]:
+            out = codec.decode_concat({i: full[i] for i in K})
+            assert out[:size] == payload, (profile, size, K)
+        # row reconstruction: every single lost shard, both the
+        # structured (<d survivors) and matrix (>=d survivors) branches
+        for lost in range(n):
+            ids = [i for i in range(n) if i != lost]
+            got = codec.decode_batch(
+                {i: full[i][None, :] for i in ids}, [lost])
+            assert np.array_equal(got[lost].reshape(-1), full[lost])
+            got2 = codec.decode_batch(
+                {i: full[i][None, :] for i in ids[:codec.k]}, [lost])
+            assert np.array_equal(got2[lost].reshape(-1), full[lost])
+
+
+@pytest.mark.parametrize("profile", PROFILES,
+                         ids=[str(p) for p in PROFILES])
+def test_repair_surface_and_bytes(profile):
+    """minimum_to_decode({lost}, avail) answers d helpers x β
+    sub-chunks; the contributions rebuild the exact shard at the
+    advertised byte cost."""
+    codec = _mk(profile)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(13)
+    payload = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+    full = codec.encode(set(range(n)), payload)
+    C = len(full[0])
+    for lost in range(n):
+        plan = codec.minimum_to_decode({lost}, set(range(n)) - {lost})
+        assert len(plan) == codec.d and lost not in plan
+        assert all(subs == [(0, codec.beta)] for subs in plan.values())
+        contribs = {h: codec.repair_contribution(
+            h, lost, full[h].reshape(1, C)) for h in plan}
+        moved = sum(c.nbytes for c in contribs.values())
+        assert moved == codec.repair_bytes_per_shard(C)
+        # the repair-bandwidth claim: strictly under k whole chunks
+        assert moved < codec.k * C
+        rep = codec.repair(lost, contribs)
+        assert np.array_equal(rep.reshape(-1), full[lost]), \
+            (profile, lost)
+    # a multi-shard or k-wide query keeps the base any-k semantics
+    want = {codec.chunk_index(i) for i in range(codec.k)}
+    fetch = codec.minimum_to_decode(want, set(range(n)))
+    assert set(fetch) == want
+
+
+@pytest.mark.parametrize("profile", [
+    {"k": "4", "m": "3", "d": "5"},
+    {"k": "4", "m": "3", "technique": "pm_msr"},
+], ids=["mbr", "msr"])
+def test_device_path_byte_identical_to_host_twin(profile):
+    host = _mk(profile, "host")
+    dev = _mk(profile, "tpu")
+    n = host.get_chunk_count()
+    rng = np.random.default_rng(17)
+    S = 3
+    W = host.preferred_stripe_width()
+    payload = rng.integers(0, 256, S * W, dtype=np.uint8)
+    eh = host.encode_batch(host.regen_prepare_batch(payload, S))
+    ed = dev.encode_batch(dev.regen_prepare_batch(payload, S))
+    assert np.array_equal(eh, ed)
+    chunks = {i: np.ascontiguousarray(eh[:, i, :]) for i in range(n)}
+    lost = 1
+    avail = {i: b for i, b in chunks.items() if i != lost}
+    gh = host.decode_batch(dict(avail), [lost])
+    gd = dev.decode_batch(dict(avail), [lost])
+    assert np.array_equal(np.asarray(gh[lost]), np.asarray(gd[lost]))
+    assert np.array_equal(np.asarray(gh[lost]), chunks[lost])
+    plan = host.minimum_to_decode({lost}, set(range(n)) - {lost})
+    ch = {h: host.repair_contribution(h, lost, chunks[h]) for h in plan}
+    cd = {h: dev.repair_contribution(h, lost, chunks[h]) for h in plan}
+    rh = host.repair(lost, ch)
+    rd = dev.repair(lost, cd)
+    assert np.array_equal(rh, rd) and np.array_equal(rh, chunks[lost])
+
+
+def test_breaker_open_falls_back_byte_identical():
+    """A tripped signature breaker routes the regen codec to the host
+    twin — outputs unchanged (the matrix_plugin discipline)."""
+    from ceph_tpu.fault import g_breakers
+    profile = {"k": "3", "m": "2", "d": "4"}
+    dev = _mk(profile, "tpu")
+    host = _mk(profile, "host")
+    rng = np.random.default_rng(19)
+    payload = rng.integers(0, 256, 4000, dtype=np.uint8).tobytes()
+    from ceph_tpu.common.config import g_conf
+    n = dev.get_chunk_count()
+    before = dev.encode(set(range(n)), payload)
+    sig = dev.codec_signature()
+    saved_thr = g_conf.values.get("ec_breaker_threshold")
+    saved_cd = g_conf.values.get("ec_breaker_cooldown_s")
+    g_conf.set_val("ec_breaker_threshold", 1)
+    g_conf.set_val("ec_breaker_cooldown_s", 3600.0)  # no probe mid-test
+    try:
+        assert g_breakers.record_failure(sig)        # trips open
+        assert not dev._use_device()
+        after = dev.encode(set(range(n)), payload)
+        ref = host.encode(set(range(n)), payload)
+        for i in range(n):
+            assert np.array_equal(before[i], after[i])
+            assert np.array_equal(after[i], ref[i])
+        # repair under an open breaker: host solve, same bytes
+        lost = 2
+        plan = dev.minimum_to_decode({lost}, set(range(n)) - {lost})
+        C = len(before[lost])
+        contribs = {h: dev.repair_contribution(
+            h, lost, before[h].reshape(1, C)) for h in plan}
+        rep = dev.repair(lost, contribs)
+        assert np.array_equal(rep.reshape(-1), before[lost])
+    finally:
+        for key, saved in (("ec_breaker_threshold", saved_thr),
+                           ("ec_breaker_cooldown_s", saved_cd)):
+            if saved is None:
+                g_conf.rm_val(key)
+            else:
+                g_conf.set_val(key, saved)
+        g_breakers.reset()
+
+
+def _write_objects(cluster, cl, pool, rng, count=4, base=2000):
+    bodies = {}
+    for i in range(count):
+        oid = f"o{i}"
+        body = rng.integers(0, 256, base + i * 257,
+                            dtype=np.uint8).tobytes()
+        assert cl.write_full(pool, oid, body) == 0
+        bodies[oid] = body
+    return bodies
+
+
+def _shard_bodies(cluster, pool_id):
+    out = {}
+    for osd in cluster.osds.values():
+        for pgid, pg in osd.pgs.items():
+            if pgid[0] != pool_id or pg.backend is None:
+                continue
+            s = pg.my_shard()
+            if s < 0:
+                continue
+            cid = pg.backend.shard_cid(s)
+            store = osd.store
+            if not store.collection_exists(cid):
+                continue
+            for ho in store.list_objects(cid):
+                out[(pgid, s, ho.oid)] = store.read(cid, ho)
+    return out
+
+
+def test_cluster_twin_shard_bodies_byte_exact():
+    """A regen pool written through the coalescing dispatch window
+    stores shard BODIES byte-identical to a window-off twin."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.common.config import g_conf
+
+    def build(window_us):
+        saved = g_conf.values.get("ec_dispatch_batch_window_us")
+        g_conf.set_val("ec_dispatch_batch_window_us", window_us)
+        try:
+            c = MiniCluster(n_osds=6)
+            pid = c.create_ec_pool("twin", k=3, m=2, pg_num=4,
+                                   plugin="regenerating",
+                                   extra_profile={"d": "4"})
+            cl = c.client("client.twin")
+            rng = np.random.default_rng(23)
+            bodies = _write_objects(c, cl, "twin", rng)
+            for oid, body in bodies.items():
+                assert cl.read("twin", oid) == body
+            return _shard_bodies(c, pid)
+        finally:
+            if saved is None:
+                g_conf.rm_val("ec_dispatch_batch_window_us")
+            else:
+                g_conf.set_val("ec_dispatch_batch_window_us", saved)
+
+    plain = build(0)
+    coalesced = build(50_000)
+    assert plain and set(plain) == set(coalesced)
+    for key in plain:
+        assert plain[key] == coalesced[key], key
+
+
+def test_mesh_on_stays_byte_identical():
+    """With the mesh armed the regen codec declines row-sharding
+    (mesh_row_shardable=False) and the flush degrades to the
+    single-device path — stored bytes unchanged vs mesh-off."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.common.config import g_conf
+    from ceph_tpu.mesh import g_mesh
+
+    def build(mesh_on):
+        for k, v in (("ec_dispatch_batch_window_us", 50_000),
+                     ("ec_mesh_chips", 8 if mesh_on else 0)):
+            g_conf.set_val(k, v)
+        g_mesh.topology()
+        try:
+            c = MiniCluster(n_osds=6)
+            pid = c.create_ec_pool("meshed", k=3, m=2, pg_num=4,
+                                   plugin="regenerating",
+                                   extra_profile={"d": "4"})
+            cl = c.client("client.mesh")
+            rng = np.random.default_rng(29)
+            bodies = _write_objects(c, cl, "meshed", rng)
+            for oid, body in bodies.items():
+                assert cl.read("meshed", oid) == body
+            return _shard_bodies(c, pid)
+        finally:
+            for k in ("ec_dispatch_batch_window_us", "ec_mesh_chips"):
+                g_conf.rm_val(k)
+            g_mesh.topology()
+
+    off = build(False)
+    on = build(True)
+    assert off and set(off) == set(on)
+    for key in off:
+        assert off[key] == on[key], key
+
+
+def test_whole_object_rw_guards_ranged_and_rmw():
+    """Ranged reads, appends and offset writes on the non-systematic
+    pool stay byte-exact (whole-object read/modify/write under the
+    requires_whole_object_rw guard)."""
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("rw", k=3, m=2, pg_num=4, plugin="regenerating",
+                     extra_profile={"d": "4"})
+    cl = c.client("client.rw")
+    rng = np.random.default_rng(31)
+    body = bytearray(rng.integers(0, 256, 5000, dtype=np.uint8)
+                     .tobytes())
+    assert cl.write_full("rw", "o", bytes(body)) == 0
+    # ranged reads across stripe boundaries
+    for off, ln in ((0, 100), (1000, 2500), (4990, 10), (4000, 1000)):
+        assert cl.read("rw", "o", offset=off, length=ln) == \
+            bytes(body[off:off + ln])
+    # offset write (rmw) then append
+    patch = rng.integers(0, 256, 700, dtype=np.uint8).tobytes()
+    assert cl.write("rw", "o", patch, offset=1234) == 0
+    body[1234:1234 + len(patch)] = patch
+    tail = rng.integers(0, 256, 300, dtype=np.uint8).tobytes()
+    assert cl.append("rw", "o", tail) == 0
+    body += tail
+    assert cl.read("rw", "o") == bytes(body)
+
+
+def test_profile_validation():
+    with pytest.raises(ValueError):
+        _mk({"k": "4", "m": "2", "d": "99"})            # d > n-1
+    with pytest.raises(ValueError):
+        _mk({"k": "4", "m": "2", "d": "3"})             # d < k (mbr)
+    with pytest.raises(ValueError):
+        _mk({"k": "4", "m": "3", "technique": "pm_msr", "d": "5"})
+    with pytest.raises(ValueError):
+        _mk({"k": "3", "m": "2", "d": "4", "technique": "bogus"})
+    with pytest.raises(ValueError):
+        _mk({"k": "3", "m": "2", "d": "4", "mapping": "DD_D_"})
