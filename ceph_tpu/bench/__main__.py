@@ -63,8 +63,8 @@ def main(argv=None) -> int:
                             measure_dispatch_coalesce,
                             measure_ec_mesh, measure_ec_pipeline,
                             measure_encode, measure_host_native,
-                            measure_recovery_storm, measure_traffic,
-                            parity_check)
+                            measure_mesh_skew, measure_recovery_storm,
+                            measure_traffic, parity_check)
     from ..gf.matrices import gf_gen_rs_matrix
 
     K, M = 8, 4
@@ -136,6 +136,18 @@ def main(argv=None) -> int:
                  f"chips vs {mm1['value']} single (x{mm['speedup']}, "
                  f"identical {mm['identical']}, "
                  f"chips occupied {occupied}/{mm['mesh_chips']})")
+        # the straggler ruler (ceph_tpu/mesh/chipstat): mesh twin
+        # healthy vs one-chip-slowed, scoreboard detection latency +
+        # TPU_MESH_SKEW raise/clear gated by regress.py's SKEW GATE
+        msk = measure_mesh_skew()
+        result["metrics"].append(msk)
+        sk = msk["skew"]
+        progress(f"mesh_skew chip {sk['detected_chip']} at "
+                 f"x{sk['skew_ratio_detected']} detected in "
+                 f"{sk['detection_probes']} probes (healthy false "
+                 f"suspects {sk['healthy_false_suspects']}, raised "
+                 f"{sk['raised']}, cleared {sk['cleared']}, identical "
+                 f"{msk['identical']})")
         # traffic harness (ceph_tpu/load): ≥8 concurrent synthetic
         # clients over the real client stack; the smoke shape is <10 s
         # on CPU, the full mode drives a deeper closed loop
@@ -188,6 +200,12 @@ def main(argv=None) -> int:
                        else regress.DEFAULT_TOLERANCE))
         result["gate"] = gate
         for r in gate["regressions"]:
+            if r.get("unit") == "invariant":
+                # SKEW GATE entries are absolute: `baseline` carries
+                # the violated invariant, not a prior round's value
+                progress(f"REGRESSION {r['name']}: {r['value']} — "
+                         f"{r['baseline']}")
+                continue
             pct = f"{r['change']:+.0%}" if r.get("change") is not None \
                 else "new-copies"
             progress(f"REGRESSION {r['name']}: {r['value']} vs "

@@ -74,6 +74,17 @@ _RECOVERY_GATED = (("bytes_per_repaired_shard_regen", "B/shard", 64.0),
                    ("regen_vs_rs_ratio", "ratio", 0.01))
 RECOVERY_TOLERANCE = 0.10
 
+# the SKEW GATE (per-chip timing PR): the ec_mesh_skew workload's
+# `skew` block records what the chip-health scoreboard saw with one
+# chip slowed 10x vs a healthy twin.  Unlike the other gates this one
+# is ABSOLUTE (invariants of the ruler itself, no baseline needed):
+# detection must fire within SKEW_MAX_DETECTION_PROBES probes, on
+# EXACTLY the slowed chip, the TPU_MESH_SKEW health check must raise
+# during the run and clear after the fault is removed, and the healthy
+# twin must stay quiet — a false suspect is a gate failure, because a
+# ruler that cries wolf is worse than no ruler.
+SKEW_MAX_DETECTION_PROBES = 8
+
 
 def load_trajectory(root: str) -> List[Dict[str, Any]]:
     """All parseable BENCH_r*.json records under *root*, oldest first.
@@ -171,10 +182,16 @@ def compare_against_trajectory(
     devflow_compared = 0   # devflow keys with a gated baseline
     stage_compared = 0     # stage usec/op figures with a gated baseline
     recovery_compared = 0  # recovery storm figures with a baseline
+    skew_compared = 0      # skew blocks checked (absolute gate)
     for cur in current:
         if not cur.get("fenced") or cur.get("suspect"):
             continue
         name = cur["name"]
+        # ---- SKEW GATE: absolute invariants, runs baseline or not ------
+        sk = cur.get("skew")
+        if isinstance(sk, dict):
+            skew_compared += 1
+            regressions.extend(_skew_gate(name, sk))
         baseline = None
         baseline_round = None
         for rec in reversed(trajectory):
@@ -244,5 +261,43 @@ def compare_against_trajectory(
             "compared": compared, "devflow_compared": devflow_compared,
             "stage_compared": stage_compared,
             "recovery_compared": recovery_compared,
+            "skew_compared": skew_compared,
             "no_baseline": no_baseline,
             "tolerance": tolerance, "platform": platform}
+
+
+def _skew_gate(name: str, sk: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The skew workload's absolute invariants as regression entries
+    (change=None: there is no ratio to report — the ruler either
+    works or it does not)."""
+    out: List[Dict[str, Any]] = []
+
+    def fail(key: str, value, why: str) -> None:
+        out.append({"name": f"{name}.skew.{key}", "unit": "invariant",
+                    "value": value, "baseline": why,
+                    "baseline_round": None, "change": None})
+
+    det = int(sk.get("detection_probes") or 0)
+    if det <= 0:
+        fail("detection_probes", det,
+             "scoreboard never marked the slowed chip suspect")
+    elif det > SKEW_MAX_DETECTION_PROBES:
+        fail("detection_probes", det,
+             f"detection took more than {SKEW_MAX_DETECTION_PROBES} "
+             f"probes")
+    if det > 0 and sk.get("detected_chip") != sk.get("slow_chip"):
+        fail("detected_chip", sk.get("detected_chip"),
+             f"suspect is not the slowed chip "
+             f"{sk.get('slow_chip')}")
+    if int(sk.get("healthy_false_suspects") or 0) > 0 \
+            or sk.get("healthy_raised"):
+        fail("healthy_false_suspects",
+             sk.get("healthy_false_suspects"),
+             "the healthy twin raised a suspect/health check")
+    if not sk.get("raised"):
+        fail("raised", sk.get("raised"),
+             "TPU_MESH_SKEW never raised while the mgr ticked")
+    if not sk.get("cleared"):
+        fail("cleared", sk.get("cleared"),
+             "TPU_MESH_SKEW did not clear after the fault was removed")
+    return out

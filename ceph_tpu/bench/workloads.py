@@ -740,6 +740,184 @@ def measure_ec_mesh(matrix: np.ndarray, *, mesh_chips: int = 8,
     return m_mesh, m_single
 
 
+def measure_mesh_skew(*, mesh_chips: int = 8, slow_chip: int = 5,
+                      delay_us: int = 30_000, threshold: float = 3.0,
+                      healthy_flushes: int = 4, max_probes: int = 10,
+                      n_requests: int = 3, chunk: int = 1024, k: int = 4,
+                      m: int = 2, n_stripes: int = 2,
+                      name: str = "ec_mesh_skew") -> Dict[str, Any]:
+    """The straggler ruler (docs/OBSERVABILITY.md "Per-chip timing &
+    skew health"): run the mesh twin healthy vs one-chip-slowed and
+    measure what the chip-health scoreboard SEES — skew ratio at
+    detection, per-chip p99 spread, and detection latency in probes.
+
+    Shape: a mini cluster (the mgr must tick DURING the run — the
+    TPU_MESH_SKEW raise/clear is part of the measurement) with an
+    8-chip mesh and ``ec_mesh_skew_sample_every=1``; coalesced k4m2
+    encode flushes drive the dispatch path directly.  Leg 1 (healthy):
+    N flushes, the scoreboard must stay quiet — zero false suspects is
+    a gated assertion, not a hope.  Leg 2 (slowed): the fault registry
+    arms ``mesh.chip_slowdown`` on exactly *slow_chip* with a
+    *delay_us* stall (~10x the healthy CPU probe delta) and the run
+    counts flushes until the scoreboard marks a suspect; the suspect
+    must be exactly the slowed chip, TPU_MESH_SKEW must raise while
+    the mgr ticks, and after the fault clears the check must clear
+    again.  Every flush's output is byte-compared against the
+    single-request oracle (skew sampling must never touch the data
+    path).  bench/regress.py's SKEW GATE enforces the detection
+    window, the exact-chip verdict and the quiet healthy twin.
+
+    CPU-smoke caveat: the 8 virtual devices share host cores, so the
+    HEALTHY per-chip spread here is calibration only — the real
+    healthy-spread number is a live-TPU capture (ROADMAP backlog 7).
+    """
+    from ..cluster import MiniCluster
+    from ..common.config import g_conf
+    from ..dispatch import g_dispatcher
+    from ..ec.tpu_plugin import ErasureCodeTpu
+    from ..fault import g_faults
+    from ..mesh import g_chipstat, g_mesh
+    from ..osd.ecutil import encode as eu_encode, stripe_info_t
+
+    saved = {opt: g_conf.values.get(opt) for opt in
+             ("ec_mesh_chips", "ec_dispatch_batch_max",
+              "ec_dispatch_batch_window_us",
+              "ec_mesh_skew_sample_every", "ec_mesh_skew_threshold")}
+    g_conf.set_val("ec_mesh_chips", mesh_chips)
+    g_conf.set_val("ec_dispatch_batch_max", 64)
+    g_conf.set_val("ec_dispatch_batch_window_us", 10**7)
+    g_conf.set_val("ec_mesh_skew_sample_every", 1)
+    g_conf.set_val("ec_mesh_skew_threshold", threshold)
+
+    cluster = MiniCluster(n_osds=4)
+    impl = ErasureCodeTpu()
+    impl.init({"k": str(k), "m": str(m), "technique": "reed_sol_van"})
+    sinfo = stripe_info_t(k, k * chunk)
+    want = set(range(k + m))
+    rng = np.random.default_rng(20260804)
+    flow0 = g_devprof.snapshot()
+    stage0 = g_oplat.snapshot()
+    t_wall0 = time.perf_counter()
+
+    n_flushes = [0]
+
+    def flush_once() -> bool:
+        """One coalesced mesh flush, byte-checked vs the oracle."""
+        n_flushes[0] += 1
+        payloads = [rng.integers(0, 256, size=n_stripes * k * chunk,
+                                 dtype=np.uint8)
+                    for _ in range(n_requests)]
+        oracles = [eu_encode(sinfo, impl, p, want) for p in payloads]
+        futs = [g_dispatcher.submit_encode(sinfo, impl, p, want)
+                for p in payloads]
+        g_dispatcher.flush()
+        ok = True
+        for f, oracle in zip(futs, oracles):
+            res = f.result()
+            ok = ok and sorted(res) == sorted(oracle) and all(
+                np.asarray(res[i]).tobytes()
+                == np.asarray(oracle[i]).tobytes() for i in oracle)
+        cluster.tick(dt=1.0)     # the mgr judges DURING the run
+        return ok
+
+    def spread(pcts: Dict[int, Dict[str, float]]) -> float:
+        # max p99 over the mesh-median p99, with the scoreboard's own
+        # median rule so the two surfaces cannot drift
+        from ..mesh.chipstat import ChipStat
+        p99s = [p["p99"] for p in pcts.values() if p["p99"] > 0]
+        if not p99s:
+            return 0.0
+        med = ChipStat._median(p99s)
+        return round(max(p99s) / max(med, 1e-9), 3)
+
+    identical = True
+    try:
+        identical &= flush_once()          # compile warmup
+        g_chipstat.reset()                 # drop compile-era samples
+        # ---- leg 1: healthy twin ----------------------------------------
+        for _ in range(healthy_flushes):
+            identical &= flush_once()
+        healthy_false_suspects = len(g_chipstat.suspects())
+        healthy_raised = "TPU_MESH_SKEW" in cluster.mgr.health_checks
+        healthy_spread = spread(g_chipstat.per_chip_percentiles())
+        healthy_max_ratio = max(
+            (r["skew_ratio"] for r in
+             g_chipstat.summary()["per_chip"].values()), default=0.0)
+        # ---- leg 2: one chip slowed -------------------------------------
+        g_chipstat.reset()
+        g_faults.inject("mesh.chip_slowdown", mode="always",
+                        match=f"chip={slow_chip}/", delay_us=delay_us)
+        detection_probes = 0
+        for i in range(1, max_probes + 1):
+            identical &= flush_once()
+            if g_chipstat.suspects():
+                detection_probes = i
+                break
+        suspects = g_chipstat.suspects()
+        detected_chip = suspects[0]["chip"] if suspects else -1
+        skew_ratio_detected = suspects[0]["skew_ratio"] if suspects \
+            else 0.0
+        raised = "TPU_MESH_SKEW" in cluster.mgr.health_checks
+        raised_message = cluster.mgr.health_checks.get(
+            "TPU_MESH_SKEW", "")
+        slowed_spread = spread(g_chipstat.per_chip_percentiles())
+        # ---- leg 3: fault removed, the check must clear -----------------
+        g_faults.clear("mesh.chip_slowdown")
+        cleared = False
+        for _ in range(4 * max_probes):
+            identical &= flush_once()
+            if not g_chipstat.suspects() \
+                    and "TPU_MESH_SKEW" not in \
+                    cluster.mgr.health_checks:
+                cleared = True
+                break
+        n_probes_total = g_chipstat.summary()["probes"]
+    finally:
+        g_faults.clear("mesh.chip_slowdown")
+        for opt, v in saved.items():
+            g_conf.rm_val(opt) if v is None else g_conf.set_val(opt, v)
+        g_dispatcher.flush()
+        g_mesh.topology()
+        # the scoreboard is process-global: a residual suspect (a run
+        # whose clear leg failed) must not raise TPU_MESH_SKEW in the
+        # unrelated workloads that follow this one
+        g_chipstat.reset()
+    wall_s = max(time.perf_counter() - t_wall0, 1e-3)
+    # EXACT op count for the gated per-op blocks: the clear leg's
+    # flush count varies with how fast the EWMA streaks settle, so
+    # reconstructing it would make copies_per_op wobble round-to-round
+    n_ops = n_flushes[0] * n_requests
+    v = max(skew_ratio_detected, 1e-6)
+    return make_metric(
+        name, v, "ratio", fenced=True,
+        stats={"n": 1, "median": v, "iqr": 0.0, "min": v, "max": v},
+        roofline={"verdict": "unknown", "suspect": False},
+        extra={
+            "skew": {
+                "mesh_chips": mesh_chips,
+                "slow_chip": slow_chip,
+                "delay_us": delay_us,
+                "threshold": threshold,
+                "detected_chip": detected_chip,
+                "skew_ratio_detected": skew_ratio_detected,
+                "detection_probes": detection_probes,
+                "healthy_false_suspects": healthy_false_suspects,
+                "healthy_raised": bool(healthy_raised),
+                "healthy_max_ratio": healthy_max_ratio,
+                "healthy_p99_spread": healthy_spread,
+                "slowed_p99_spread": slowed_spread,
+                "raised": bool(raised),
+                "cleared": bool(cleared),
+                "probes_total": n_probes_total,
+            },
+            "identical": bool(identical),
+            "raised_message": raised_message,
+            "devflow": _devflow_since(flow0, max(n_ops, 1)),
+            "stage_breakdown": _stage_breakdown_since(
+                stage0, wall_s, max(n_ops, 1)),
+        })
+
+
 def measure_traffic(*, n_clients: int = 8, ops_per_client: int = 32,
                     read_fraction: float = 0.5, n_osds: int = 4,
                     pg_num: int = 8, mode: str = "closed",

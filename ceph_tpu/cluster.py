@@ -291,8 +291,20 @@ class MiniCluster:
             "per-shard op queue sizes and mclock tags")
         from .dispatch import dispatch_perf_counters, g_dispatcher
         self.perf_collection.add(dispatch_perf_counters())
-        from .mesh import mesh_perf_counters
+        from .mesh import (g_chipstat, mesh_chip_perf_counters,
+                           mesh_perf_counters)
         self.perf_collection.add(mesh_perf_counters())
+        self.perf_collection.add(mesh_chip_perf_counters())
+        asok.register(
+            "mesh skew dump",
+            lambda c, a: g_chipstat.dump(),
+            "mesh chip-health scoreboard: per-chip probe EWMAs, skew "
+            "ratios, suspects, per-chip latency percentiles")
+        asok.register(
+            "mesh skew reset",
+            lambda c, a: (g_chipstat.reset(), {"reset": True})[1],
+            "zero the chip-health scoreboard, its per-chip latency "
+            "histogram and counters")
         from .osd.ec_backend import pipeline_perf_counters
         self.perf_collection.add(pipeline_perf_counters())
         from .common.work_queue import qos_perf_counters
@@ -352,14 +364,15 @@ class MiniCluster:
             # every other asok hook
             casts = (("mode", str), ("p", float), ("n", int),
                      ("seed", int), ("count", int), ("error", str),
-                     ("match", str))
+                     ("match", str), ("delay_us", int))
             unknown = set(a) - {"name"} - {k for k, _ in casts}
             if unknown:
                 # a typo'd trigger key must not silently arm a very
                 # different fault (mdoe=prob -> mode=always)
                 raise ValueError(
                     f"unknown argument(s) {sorted(unknown)}; expected "
-                    f"name, mode, p, n, seed, count, error, match")
+                    f"name, mode, p, n, seed, count, error, match, "
+                    f"delay_us")
             kw = {}
             for key, cast in casts:
                 if key in a:
@@ -374,7 +387,7 @@ class MiniCluster:
         asok.register(
             "fault inject", _fault_inject,
             "arm a fault-injection site (mode=prob|nth|once|always, "
-            "p=, n=, seed=, count=, error=, match=)")
+            "p=, n=, seed=, count=, error=, match=, delay_us=)")
         asok.register(
             "fault list",
             lambda c, a: g_faults.dump(),
@@ -589,11 +602,13 @@ class MiniCluster:
         shared snapshot (telemetry.rollup) so this pane, ``telemetry
         dump`` and the Prometheus scrape cannot disagree."""
         from .fault import g_breakers
+        from .mesh import g_chipstat
         tel = self.mgr.telemetry
         # freshen if the clock moved since the last mgr tick (a stale
         # or equal clock is a no-op, so this never skews rate windows)
         tel.tick(self.mgr, self.clock)
         roll = tel.rollup()
+        skew = g_chipstat.summary()
         return {
             "health": self.health(),
             "samples": roll["samples"],
@@ -605,6 +620,10 @@ class MiniCluster:
                               for d in g_breakers.degraded()],
             "slo": {check: st["state"]
                     for check, st in roll["slo"].items()},
+            # the chip-health scoreboard's verdict pane: suspects name
+            # the chip and its skew ratio (TPU_MESH_SKEW's figures)
+            "mesh_skew": {"probes": skew["probes"],
+                          "suspects": skew["suspects"]},
             "objectives": roll["objectives"],
         }
 
@@ -613,8 +632,11 @@ class MiniCluster:
         down osds, degraded/peering pgs, pinned pg_temp remaps,
         degraded codec signatures (TPU_CODEC_DEGRADED)."""
         # refresh breaker-derived checks so health() is current even
-        # between mgr ticks (tests and CLIs call it directly)
+        # between mgr ticks (tests and CLIs call it directly); the
+        # chip-skew check refreshes the same way (its hysteresis lives
+        # in the scoreboard, so re-reading it never flaps)
         self.mgr.check_degraded_codecs()
+        self.mgr.check_mesh_skew()
         reasons = []
         n_down = sum(1 for o in range(self.mon.osdmap.max_osd)
                      if not self.mon.osdmap.is_up(o))

@@ -122,6 +122,22 @@ def build_options() -> List[Option]:
                          "encode (donate_argnums) so the device "
                          "recycles it into the output; ignored on "
                          "backends without buffer aliasing (cpu)"),
+        Option("ec_mesh_skew_sample_every", OPT_INT).set_default(16)
+        .set_description("sampled per-chip skew probes: every Nth mesh "
+                         "flush drains one element per chip shard and "
+                         "records per-chip completion deltas on the "
+                         "chip-health scoreboard "
+                         "(ceph_tpu/mesh/chipstat).  0 = probing off; "
+                         "the OSD tick additionally guarantees the "
+                         "next flush after quiet traffic probes "
+                         "(cadence floor)"),
+        Option("ec_mesh_skew_threshold", OPT_FLOAT).set_default(3.0)
+        .set_description("per-chip probe service time over the mesh "
+                         "median at or above this ratio on 3 "
+                         "consecutive probes marks the chip SUSPECT "
+                         "(clears after 3 clean probes) and raises "
+                         "TPU_MESH_SKEW; <= 0 disables the "
+                         "scoreboard verdicts (probes still record)"),
         Option("ec_pipeline_depth", OPT_INT).set_default(1)
         .set_description("EC write pipeline: encodes a single PG may "
                          "keep in flight in the dispatch scheduler "

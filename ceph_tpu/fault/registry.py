@@ -81,6 +81,10 @@ SITE_CATALOG: Dict[str, str] = {
     "mesh.encode_batch":
         "mesh-sharded flush execution (ceph_tpu/mesh runtime) — "
         "exhaustion degrades the flush to the single-device path",
+    "mesh.chip_slowdown":
+        "per-chip straggler injection (ceph_tpu/mesh/chipstat): delays "
+        "the matching chip's probe readback by delay_us; context is "
+        "'chip=<i>/<mesh size>' so match='chip=3/' scopes one chip",
     "osd.shard_read_eio":
         "shard-side EC read returns EIO (bluestore_debug_inject_read_err "
         "role) — the primary must reconstruct from surviving shards",
@@ -165,11 +169,12 @@ class FaultSpec:
     """One armed site: trigger mode + bookkeeping."""
 
     __slots__ = ("site", "mode", "p", "n", "seed", "count", "error",
-                 "match", "fires", "checks", "_rng")
+                 "match", "delay_us", "fires", "checks", "_rng")
 
     def __init__(self, site: str, mode: str = "always", p: float = 1.0,
                  n: int = 1, seed: Optional[int] = None, count: int = 0,
-                 error: str = "device", match: str = ""):
+                 error: str = "device", match: str = "",
+                 delay_us: int = 0):
         if mode not in ("prob", "nth", "once", "always"):
             raise ValueError(f"unknown fault mode '{mode}'")
         if error not in ERROR_KINDS:
@@ -186,6 +191,10 @@ class FaultSpec:
         self.count = 1 if mode == "once" else max(int(count), 0)
         self.error = error
         self.match = match
+        # delay-shaping sites (mesh.chip_slowdown): how long the
+        # matching check stalls when the trigger fires; check-style
+        # sites ignore it
+        self.delay_us = max(int(delay_us), 0)
         self.fires = 0
         self.checks = 0
         # deterministic per-site stream, cross-process: an explicit
@@ -217,6 +226,7 @@ class FaultSpec:
         return {"mode": self.mode, "p": self.p, "n": self.n,
                 "seed": self.seed, "count": self.count,
                 "error": self.error, "match": self.match,
+                "delay_us": self.delay_us,
                 "fires": self.fires, "checks": self.checks}
 
 

@@ -3,13 +3,16 @@
 See runtime.py for the design; docs/DISPATCH.md "Mesh-sharded
 dispatch" for the operator story.
 """
+from .chipstat import (ChipStat, chip_latency_axes, g_chipstat,
+                       mesh_chip_perf_counters)
 from .pool import StagingPool
 from .runtime import (MeshRuntime, ShardingPlan, chip_occupancy_axes,
                       g_mesh, mesh_perf_counters)
 from .topology import BATCH_AXIS, addressable_devices, batch_mesh
 
 __all__ = [
-    "BATCH_AXIS", "MeshRuntime", "ShardingPlan", "StagingPool",
-    "addressable_devices", "batch_mesh", "chip_occupancy_axes",
-    "g_mesh", "mesh_perf_counters",
+    "BATCH_AXIS", "ChipStat", "MeshRuntime", "ShardingPlan",
+    "StagingPool", "addressable_devices", "batch_mesh",
+    "chip_latency_axes", "chip_occupancy_axes", "g_chipstat", "g_mesh",
+    "mesh_chip_perf_counters", "mesh_perf_counters",
 ]

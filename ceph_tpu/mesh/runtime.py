@@ -300,14 +300,24 @@ class MeshRuntime:
             g_devprof.install_compile_listener()
             g_devprof.account_h2d("mesh.encode", buf.nbytes)
             from ..common.kernel_trace import g_kernel_timer
+            from .chipstat import g_chipstat
+            # sampled fenced probe (chipstat.py): every Nth flush the
+            # coalesced output is drained one element per chip BEFORE
+            # the full materialization, so each chip's completion
+            # delta lands on the skew scoreboard; off (the default
+            # cadence counter not due) this is one int check
+            probe = g_chipstat.should_probe()
             with g_devprof.stage("mesh.encode"):
                 def sharded_call():
                     dev_in = jax.device_put(buf, plan.in_sharding)
+                    out = plan.fn(dev_in, plan.enc_bits)
+                    if probe:
+                        g_chipstat.probe(out, mesh)
                     # np.asarray gathers every shard to the host — the
                     # materialization IS the completion fence (each
                     # chip's rows cross back; the bench twin drains
                     # per-shard via parallel.drain_sharded)
-                    return np.asarray(plan.fn(dev_in, plan.enc_bits))
+                    return np.asarray(out)
                 coding = g_kernel_timer.timed("ec_encode_batch_mesh",
                                               sharded_call)
         finally:
@@ -390,6 +400,7 @@ class MeshRuntime:
                       "donated": p.donated, "hits": p.hits}
                      for key, p in sorted(self._plans.items(),
                                           key=lambda kv: str(kv[0]))]
+        from .chipstat import g_chipstat
         return {
             "options": {"ec_mesh_chips": chips,
                         "ec_mesh_pool_buffers": pool_cap,
@@ -401,6 +412,10 @@ class MeshRuntime:
             "plans": plans,
             "pool": self._pool.dump(),
             "counters": mesh_perf_counters().dump(),
+            # the chip-health scoreboard (chipstat.py): per-chip probe
+            # EWMAs, skew ratios and suspects — the full table with
+            # percentiles lives on `mesh skew dump`
+            "skew": g_chipstat.summary(),
         }
 
 

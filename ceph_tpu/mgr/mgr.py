@@ -132,6 +132,7 @@ class Manager(Dispatcher):
             self.pg_autoscale(apply=True)
         self.check_quotas_and_fullness()
         self.check_degraded_codecs()
+        self.check_mesh_skew()
         # cluster rollup collection + SLO burn-rate evaluation — pure
         # host-side histogram/counter reads, zero added device syncs
         # (the fence-count test in tests/test_observability.py covers
@@ -163,6 +164,37 @@ class Manager(Dispatcher):
             self._cluster_log("INF",
                               "Health check cleared: TPU_CODEC_DEGRADED "
                               "(device path restored)")
+
+    # ---- mesh chip skew (chip-health scoreboard -> health) ------------------
+    def check_mesh_skew(self) -> None:
+        """TPU_MESH_SKEW: raised while the mesh chip-health scoreboard
+        (ceph_tpu/mesh/chipstat) holds any SUSPECT chip — a chip whose
+        EWMA probe service time sustained ``ec_mesh_skew_threshold``
+        times the mesh median — naming the worst chip and its ratio.
+        The hysteresis lives in the scoreboard (the breaker's
+        sustain/clear discipline, counted in probes), so this check
+        raises the moment a suspect is marked and clears the moment
+        the last one sustains clean; transitions ride the same
+        health/cluster-log path as check_degraded_codecs."""
+        from ..mesh import g_chipstat
+        suspects = g_chipstat.suspects()
+        had = "TPU_MESH_SKEW" in self.health_checks
+        if suspects:
+            worst = suspects[0]
+            msg = (f"{len(suspects)} mesh chip(s) over the skew "
+                   f"threshold: worst chip {worst['chip']} at "
+                   f"{worst['skew_ratio']:.1f}x the mesh median "
+                   f"service time")
+            self.health_checks["TPU_MESH_SKEW"] = msg
+            if not had:
+                self._cluster_log(
+                    "WRN", f"Health check failed: TPU_MESH_SKEW "
+                    f"({msg})")
+        elif had:
+            self.health_checks.pop("TPU_MESH_SKEW", None)
+            self._cluster_log(
+                "INF", "Health check cleared: TPU_MESH_SKEW (chip "
+                "service times back inside the skew threshold)")
 
     def _cluster_log(self, level: str, message: str) -> None:
         """Best-effort mon cluster-log entry (clog->warn role); a

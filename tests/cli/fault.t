@@ -12,6 +12,7 @@ site catalog, arming a trigger, the unknown-site refusal, and clearing.
       "device.encode_batch": "batched EC encode device call (matrix_plugin.encode_batch)",
       "device.encode_chunks": "per-stripe encode device call (matrix_plugin.encode_chunks)",
       "dispatch.batch": "coalesced flush execution (scheduler._execute run_group) \u2014 exercises the per-request fallback isolation",
+      "mesh.chip_slowdown": "per-chip straggler injection (ceph_tpu/mesh/chipstat): delays the matching chip's probe readback by delay_us; context is 'chip=<i>/<mesh size>' so match='chip=3/' scopes one chip",
       "mesh.encode_batch": "mesh-sharded flush execution (ceph_tpu/mesh runtime) \u2014 exhaustion degrades the flush to the single-device path",
       "msg.drop": "drop a fabric message (ms inject socket failures role); context is '<MsgType> <src>><dst>' for match= scoping",
       "osd.shard_read_eio": "shard-side EC read returns EIO (bluestore_debug_inject_read_err role) \u2014 the primary must reconstruct from surviving shards",
@@ -27,6 +28,7 @@ site catalog, arming a trigger, the unknown-site refusal, and clearing.
     "armed": {
       "checks": 0,
       "count": 0,
+      "delay_us": 0,
       "error": "device",
       "fires": 0,
       "match": "",
@@ -36,6 +38,27 @@ site catalog, arming a trigger, the unknown-site refusal, and clearing.
       "seed": null
     },
     "site": "osd.shard_read_eio"
+  }
+
+The per-chip straggler site (ceph_tpu/mesh/chipstat): delay_us= stalls
+the matching chip's probe completion, match='chip=<i>/' scopes the
+injection to exactly one chip index.
+
+  $ ceph --cluster ck daemon osd.0 fault inject name=mesh.chip_slowdown mode=always match=chip=5/ delay_us=30000
+  {
+    "armed": {
+      "checks": 0,
+      "count": 0,
+      "delay_us": 30000,
+      "error": "device",
+      "fires": 0,
+      "match": "chip=5/",
+      "mode": "always",
+      "n": 1,
+      "p": 1.0,
+      "seed": null
+    },
+    "site": "mesh.chip_slowdown"
   }
 
   $ ceph --cluster ck daemon osd.0 fault inject name=bogus.site

@@ -1,0 +1,43 @@
+Chip-health scoreboard admin CLI (`ceph daemon <who> mesh skew
+dump|reset`), in the style of the reference's recorded src/test/cli
+transcripts: the zeroed scoreboard of a freshly restored cluster — the
+option defaults, hysteresis constants and counter catalog are the
+contract — and the reset.
+
+  $ python -c "from ceph_tpu.cluster import MiniCluster; MiniCluster(n_osds=2).checkpoint('ck')"
+
+  $ ceph --cluster ck daemon osd.0 mesh skew dump
+  {
+    "clear_probes": 3,
+    "counters": {
+      "max_skew_permille": 0,
+      "probes": 0,
+      "samples": 0,
+      "slowdowns_injected": 0,
+      "suspect_chips": 0,
+      "suspects_cleared": 0,
+      "suspects_marked": 0
+    },
+    "flushes": 0,
+    "options": {
+      "ec_mesh_skew_sample_every": 16,
+      "ec_mesh_skew_threshold": 3.0
+    },
+    "per_chip": {},
+    "per_chip_percentiles": {},
+    "probes": 0,
+    "suspects": [],
+    "sustain_probes": 3
+  }
+
+  $ ceph --cluster ck daemon osd.0 mesh skew reset
+  {
+    "reset": true
+  }
+
+(The populated scoreboard of a probed mesh — per-chip EWMAs, skew
+ratios, a marked suspect and the TPU_MESH_SKEW raise/clear — is
+asserted in-process by tests/test_mesh_skew.py; an 8-chip mesh
+cluster inside a cram subprocess would re-compile the sharded encode
+outside the shared XLA cache and burn tier-1 wall budget for coverage
+that already exists.)

@@ -37,6 +37,10 @@ objectives table, SLO/breaker panes) is the contract — and the reset.
     "cluster_p99_usec": {},
     "copies_per_op": 0.0,
     "health": "HEALTH_OK",
+    "mesh_skew": {
+      "probes": 0,
+      "suspects": []
+    },
     "objectives": {
       "admission_rate_max": 0.0,
       "copies_per_op_max": 0.0,

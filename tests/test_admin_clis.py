@@ -59,6 +59,19 @@ def test_oplat_cli_cram(tmp_path):
     assert_cram(path, str(tmp_path))
 
 
+def test_mesh_skew_cli_cram(tmp_path):
+    """`ceph daemon <who> mesh skew dump|reset` replayed from a
+    recorded transcript (tests/cli/mesh.t): the zeroed chip-health
+    scoreboard of a restored cluster (option defaults, hysteresis
+    constants and counter catalog pinned) and the reset — through the
+    same `ceph` shim as fault.t (the populated scoreboard and the
+    TPU_MESH_SKEW lifecycle are covered in-process by
+    tests/test_mesh_skew.py)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cli", "mesh.t")
+    assert_cram(path, str(tmp_path))
+
+
 def test_status_cli_cram(tmp_path):
     """`ceph daemon <who> tpu status` + `telemetry dump|reset`
     replayed from a recorded transcript (tests/cli/status.t): the

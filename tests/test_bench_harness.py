@@ -266,6 +266,41 @@ def test_gate_recovery_block_lower_better(tmp_path):
     assert not out["regressions"] and out["improvements"]
 
 
+def test_gate_skew_invariants(tmp_path):
+    """The SKEW GATE is absolute (no baseline needed): late or missing
+    detection, the wrong chip, a noisy healthy twin, or a health check
+    that never raised/cleared each fail the gate on their own."""
+    def skew_metric(**over):
+        m = _metric("ec_mesh_skew", 12.0, unit="ratio")
+        sk = {"mesh_chips": 8, "slow_chip": 5, "delay_us": 30000,
+              "threshold": 3.0, "detected_chip": 5,
+              "skew_ratio_detected": 12.0, "detection_probes": 3,
+              "healthy_false_suspects": 0, "healthy_raised": False,
+              "raised": True, "cleared": True}
+        sk.update(over)
+        m["skew"] = sk
+        return m
+
+    # a clean run gates clean — with or without any baseline round
+    out = regress.compare_against_trajectory([skew_metric()], [], "cpu")
+    assert out["skew_compared"] == 1 and not out["regressions"]
+    cases = (
+        ({"detection_probes": 0}, "detection_probes"),
+        ({"detection_probes":
+          regress.SKEW_MAX_DETECTION_PROBES + 1}, "detection_probes"),
+        ({"detected_chip": 2}, "detected_chip"),
+        ({"healthy_false_suspects": 1}, "healthy_false_suspects"),
+        ({"healthy_raised": True}, "healthy_false_suspects"),
+        ({"raised": False}, "raised"),
+        ({"cleared": False}, "cleared"),
+    )
+    for over, key in cases:
+        out = regress.compare_against_trajectory(
+            [skew_metric(**over)], [], "cpu")
+        names = {r["name"] for r in out["regressions"]}
+        assert f"ec_mesh_skew.skew.{key}" in names, (over, names)
+
+
 def test_gate_within_tolerance_passes(tmp_path):
     _write_round(tmp_path, 6, "cpu", [_metric("enc", 10.0)])
     traj = regress.load_trajectory(str(tmp_path))
@@ -409,7 +444,8 @@ def test_smoke_mode_end_to_end():
             "ec_dispatch_serial_fenced",
             "ec_pipeline_fenced", "ec_pipeline_depth1_fenced",
             "ec_mesh_fenced", "ec_mesh_single_fenced",
-            "traffic_harness_smoke", "ec_recovery_storm"} <= names
+            "traffic_harness_smoke", "ec_recovery_storm",
+            "ec_mesh_skew"} <= names
     # the coalesce metric carries its serial twin and speedup
     mc = next(m for m in out["metrics"]
               if m["name"] == "ec_dispatch_coalesce_fenced")
@@ -482,6 +518,22 @@ def test_smoke_mode_end_to_end():
     assert mrs["byte_exact_traffic"] is True
     assert mrs["slo"].get("TPU_SLO_OPLAT") == "ok", mrs["slo"]
     assert mrs["cluster_rollup"]["oplat_p99_usec"].get("reply", 0) > 0
+    # straggler-ruler acceptance (ceph_tpu/mesh/chipstat): with one
+    # chip slowed 10x via mesh.chip_slowdown the scoreboard marks
+    # EXACTLY that chip suspect within the gate's probe window,
+    # TPU_MESH_SKEW raises while the mgr ticks and clears after the
+    # fault is removed, the healthy twin stays quiet, and skew
+    # sampling never touched the data path (byte-identity receipt)
+    msk = next(m for m in out["metrics"] if m["name"] == "ec_mesh_skew")
+    sk = msk["skew"]
+    assert 0 < sk["detection_probes"] <= regress.SKEW_MAX_DETECTION_PROBES
+    assert sk["detected_chip"] == sk["slow_chip"]
+    assert sk["skew_ratio_detected"] >= sk["threshold"]
+    assert sk["healthy_false_suspects"] == 0
+    assert sk["healthy_raised"] is False
+    assert sk["raised"] is True and sk["cleared"] is True
+    assert msk["identical"] is True
+    assert out["gate"]["skew_compared"] >= 1
     # devprof acceptance: EVERY fenced workload emits a devflow block
     # with the gated per-op figures, and the dispatch/pipeline pairs
     # show coalescing as FEWER copies per op (the copy-budget story)

@@ -27,15 +27,22 @@ def cluster_and_text():
     assert cl.read("lint", "o")[:1] == b"c"
     # one write through the MESH path so the per-chip occupancy
     # histogram registers and the mesh counters move — the lint below
-    # then covers the mesh families like any other
+    # then covers the mesh families like any other; skew probes run on
+    # every flush so the mesh_chip scoreboard families register too
     g_conf.set_val("ec_mesh_chips", 8)
     g_conf.set_val("ec_dispatch_batch_window_us", 200_000)
+    g_conf.set_val("ec_mesh_skew_sample_every", 1)
     try:
         assert cl.write_full("lint", "om", b"m" * 60000) == 0
     finally:
         g_conf.rm_val("ec_mesh_chips")
         g_conf.rm_val("ec_dispatch_batch_window_us")
+        g_conf.rm_val("ec_mesh_skew_sample_every")
         g_mesh.topology()
+    from ceph_tpu.mesh import g_chipstat
+    assert g_chipstat.summary()["probes"] > 0, \
+        "mesh write produced no skew probe — scoreboard families " \
+        "would be lint-invisible"
     # one repair round through a regenerating pool so the `recovery`
     # counter families and the bytes-per-shard histogram register and
     # move — the lint below then covers them like any other family

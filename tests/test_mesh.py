@@ -42,9 +42,14 @@ def mesh_conf():
     for name in ("ec_mesh_chips", "ec_mesh_pool_buffers",
                  "ec_mesh_donate", "ec_dispatch_batch_max",
                  "ec_dispatch_batch_window_us", "ec_dispatch_queue_max",
-                 "ec_pipeline_depth"):
+                 "ec_pipeline_depth", "ec_mesh_skew_sample_every"):
         g_conf.rm_val(name)
     g_mesh.topology()      # rebuild to the default (mesh off)
+    # the scoreboard is process-global: drop any probe state (or a
+    # suspect marked on an oversubscribed CI host) so later tests'
+    # health() panes start clean
+    from ceph_tpu.mesh import g_chipstat
+    g_chipstat.reset()
 
 
 def _mesh_on(chips=8, batch_max=64, window_us=10_000_000):
@@ -103,7 +108,12 @@ def test_mesh_byte_identity_property(mesh_conf, seed):
     randomized (k, m, technique, chunk size, stripe count) mixes.
     Stripe totals are deliberately NOT multiples of the mesh size —
     the zero-pad lanes must never leak into any request's output —
-    and mixed chunk sizes share a bucket like any dispatch group."""
+    and mixed chunk sizes share a bucket like any dispatch group.
+    Skew sampling runs on EVERY flush here (the per-chip timing PR's
+    byte-identity extension): the probe drains the same coalesced
+    output the flush materializes anyway, so it must never touch the
+    data path."""
+    g_conf.set_val("ec_mesh_skew_sample_every", 1)
     rng = np.random.default_rng(seed)
     impls = [_mk_impl(p, k, m, t) for p, k, m, t in MIX]
     specs = []

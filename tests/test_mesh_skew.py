@@ -1,0 +1,340 @@
+"""Per-chip timing telemetry: the chip-health scoreboard, the skew
+SLO and the straggler ruler (ceph_tpu/mesh/chipstat.py).
+
+- probe cadence: every Nth flush probes (``ec_mesh_skew_sample_every``,
+  0 = off), the OSD tick arms a cadence floor, and probes land one
+  sample per chip on the 2-D ``mesh_chip_latency_histogram``;
+- the tier-1 acceptance: with one chip slowed 10x via the
+  ``mesh.chip_slowdown`` fault site the scoreboard marks EXACTLY that
+  chip suspect within K probes, ``TPU_MESH_SKEW`` raises at runtime
+  (the mgr ticking during the run) naming the chip and its ratio,
+  then clears after the fault is removed — and the healthy twin
+  raises nothing;
+- fence-count gate extended: with sampling OFF the mesh write path
+  adds ZERO ``block_until_ready``; with sampling ON, exactly the
+  probe's per-chip readbacks appear and ONLY under the dedicated
+  ``mesh.skew_probe`` devprof site — which the copy-budget snapshots
+  exclude (calibration policy);
+- surfaces: ``mesh skew dump``/``reset`` over the admin socket, the
+  skew block on ``dispatch dump``'s mesh pane, the ``tpu status``
+  pane, and dump/exposition agreement for the
+  ``ceph_daemon_mesh_chip_*`` counters.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.dispatch import g_dispatcher
+from ceph_tpu.ec.tpu_plugin import ErasureCodeTpu
+from ceph_tpu.fault import g_faults
+from ceph_tpu.mesh import g_chipstat, g_mesh, mesh_chip_perf_counters
+from ceph_tpu.mesh.chipstat import (SKEW_CLEAR_PROBES,
+                                    SKEW_SUSTAIN_PROBES, l_chip_probes,
+                                    l_chip_samples,
+                                    l_chip_suspects_cleared,
+                                    l_chip_suspects_marked)
+from ceph_tpu.osd.ecutil import encode as eu_encode, stripe_info_t
+
+
+@pytest.fixture
+def skew_conf():
+    """Every test leaves the dispatcher drained, the options at their
+    defaults, the scoreboard zeroed and the mesh torn down."""
+    yield
+    g_faults.clear()
+    g_dispatcher.flush()
+    for name in ("ec_mesh_chips", "ec_mesh_skew_sample_every",
+                 "ec_mesh_skew_threshold", "ec_dispatch_batch_max",
+                 "ec_dispatch_batch_window_us"):
+        g_conf.rm_val(name)
+    g_mesh.topology()
+    g_chipstat.reset()
+
+
+def _mesh_on(chips=8, sample_every=1, threshold=3.0):
+    g_conf.set_val("ec_mesh_chips", chips)
+    g_conf.set_val("ec_dispatch_batch_window_us", 10_000_000)
+    g_conf.set_val("ec_dispatch_batch_max", 64)
+    g_conf.set_val("ec_mesh_skew_sample_every", sample_every)
+    g_conf.set_val("ec_mesh_skew_threshold", threshold)
+
+
+def _mk_impl(k=4, m=2):
+    impl = ErasureCodeTpu()
+    impl.init({"k": str(k), "m": str(m),
+               "technique": "reed_sol_van"})
+    return impl
+
+
+_RNG = np.random.default_rng(20260804)
+
+
+def _flush_batch(impl, sinfo, want, n_requests=3, n_stripes=2,
+                 check=True):
+    """One coalesced mesh flush, byte-checked against the oracle."""
+    k = impl.k
+    chunk = sinfo.get_chunk_size()
+    payloads = [_RNG.integers(0, 256, size=n_stripes * k * chunk,
+                              dtype=np.uint8)
+                for _ in range(n_requests)]
+    oracles = [eu_encode(sinfo, impl, p, want) for p in payloads] \
+        if check else None
+    futs = [g_dispatcher.submit_encode(sinfo, impl, p, want)
+            for p in payloads]
+    g_dispatcher.flush()
+    results = [f.result() for f in futs]
+    if check:
+        for res, oracle in zip(results, oracles):
+            assert sorted(res) == sorted(oracle)
+            for i in oracle:
+                assert np.asarray(res[i]).tobytes() \
+                    == np.asarray(oracle[i]).tobytes()
+    return results
+
+
+def test_probe_cadence_every_nth_flush(skew_conf):
+    """sample_every=N probes exactly every Nth flush, each probe
+    recording one delta per chip (histogram + counters agree); 0
+    disables probing entirely."""
+    _mesh_on(chips=8, sample_every=0)
+    impl = _mk_impl()
+    sinfo = stripe_info_t(4, 4 * 1024)
+    want = set(range(6))
+    g_chipstat.reset()
+    pc = mesh_chip_perf_counters()
+    for _ in range(3):
+        _flush_batch(impl, sinfo, want)
+    assert pc.get(l_chip_probes) == 0
+    assert g_chipstat.summary()["probes"] == 0
+    g_conf.set_val("ec_mesh_skew_sample_every", 2)
+    g_chipstat.reset()
+    for _ in range(6):
+        _flush_batch(impl, sinfo, want)
+    assert pc.get(l_chip_probes) == 3          # flushes 2, 4, 6
+    assert pc.get(l_chip_samples) == 3 * 8
+    from ceph_tpu.trace import g_perf_histograms
+    hist = g_perf_histograms.get("mesh", "mesh_chip_latency_histogram")
+    assert hist.total_count == 3 * 8
+    assert hist.axes[0].name == "probe_usec"
+    assert hist.axes[1].name == "chip_index"
+    per_chip = g_chipstat.summary()["per_chip"]
+    assert len(per_chip) == 8
+    assert all(row["probes"] == 3 for row in per_chip.values())
+
+
+def test_osd_tick_arms_probe_cadence_floor(skew_conf):
+    """The OSD tick's cadence floor: traffic that flushed since the
+    last probe makes the NEXT flush probe even when the Nth-flush
+    counter is nowhere near due."""
+    from ceph_tpu.cluster import MiniCluster
+    _mesh_on(chips=8, sample_every=1000)
+    c = MiniCluster(n_osds=4)
+    impl = _mk_impl()
+    sinfo = stripe_info_t(4, 4 * 1024)
+    want = set(range(6))
+    g_chipstat.reset()
+    _flush_batch(impl, sinfo, want)           # flush 1 of 1000: no probe
+    assert g_chipstat.summary()["probes"] == 0
+    c.tick(dt=1.0)                            # OSD tick arms the floor
+    _flush_batch(impl, sinfo, want)
+    assert g_chipstat.summary()["probes"] == 1
+    # no flush since that probe: another tick must NOT arm again
+    c.tick(dt=1.0)
+    _flush_batch(impl, sinfo, want)
+    _flush_batch(impl, sinfo, want)
+    assert g_chipstat.summary()["probes"] == 1
+
+
+def test_scoreboard_marks_exactly_the_slowed_chip(skew_conf):
+    """THE tier-1 acceptance (ISSUE criteria): one chip slowed ~10x
+    via mesh.chip_slowdown -> the scoreboard suspects EXACTLY that
+    chip within the sustain window, TPU_MESH_SKEW raises while the
+    mgr ticks (naming chip + ratio), clears after the fault is
+    removed; the healthy run raises nothing; outputs stay
+    byte-identical throughout (skew sampling never touches data)."""
+    from ceph_tpu.cluster import MiniCluster
+    _mesh_on(chips=8, sample_every=1, threshold=3.0)
+    c = MiniCluster(n_osds=4)
+    impl = _mk_impl()
+    sinfo = stripe_info_t(4, 4 * 1024)
+    want = set(range(6))
+    pc = mesh_chip_perf_counters()
+    _flush_batch(impl, sinfo, want)           # compile warmup
+    g_chipstat.reset()
+    # ---- healthy leg: quiet scoreboard, no health check -------------
+    for _ in range(4):
+        _flush_batch(impl, sinfo, want)
+        c.tick(dt=1.0)
+    assert g_chipstat.suspects() == []
+    assert "TPU_MESH_SKEW" not in c.mgr.health_checks
+    # ---- slowed leg -------------------------------------------------
+    marked0 = pc.get(l_chip_suspects_marked)
+    g_faults.inject("mesh.chip_slowdown", mode="always",
+                    match="chip=5/", delay_us=30_000)
+    detection = 0
+    for i in range(1, 9):
+        _flush_batch(impl, sinfo, want)
+        c.tick(dt=1.0)
+        if g_chipstat.suspects():
+            detection = i
+            break
+    suspects = g_chipstat.suspects()
+    assert [s["chip"] for s in suspects] == [5], suspects
+    assert suspects[0]["skew_ratio"] >= 3.0
+    assert detection == SKEW_SUSTAIN_PROBES   # hysteresis, not a spike
+    assert pc.get(l_chip_suspects_marked) == marked0 + 1
+    msg = c.mgr.health_checks.get("TPU_MESH_SKEW", "")
+    assert "chip 5" in msg and "x the mesh median" in msg, msg
+    assert "TPU_MESH_SKEW" in c.health()
+    st = c.tpu_status()
+    assert st["mesh_skew"]["suspects"][0]["chip"] == 5
+    # the skew block rides dispatch dump's mesh pane too
+    d = c.admin_socket.execute("dispatch dump")["mesh"]["skew"]
+    assert d["suspects"][0]["chip"] == 5
+    # ---- fault removed: hysteretic clear ----------------------------
+    cleared0 = pc.get(l_chip_suspects_cleared)
+    g_faults.clear("mesh.chip_slowdown")
+    for _ in range(24):
+        _flush_batch(impl, sinfo, want)
+        c.tick(dt=1.0)
+        if not g_chipstat.suspects() \
+                and "TPU_MESH_SKEW" not in c.mgr.health_checks:
+            break
+    assert g_chipstat.suspects() == []
+    assert "TPU_MESH_SKEW" not in c.mgr.health_checks
+    assert pc.get(l_chip_suspects_cleared) == cleared0 + 1
+
+
+def test_single_slow_probe_never_suspects(skew_conf):
+    """Hysteresis: one slow probe (count=1 injection) breaches one
+    scoreboard pass; the streak resets on the next clean probe and no
+    suspect is ever marked — the breaker's spike discipline."""
+    _mesh_on(chips=8, sample_every=1, threshold=3.0)
+    impl = _mk_impl()
+    sinfo = stripe_info_t(4, 4 * 1024)
+    want = set(range(6))
+    _flush_batch(impl, sinfo, want)
+    g_chipstat.reset()
+    for _ in range(3):
+        _flush_batch(impl, sinfo, want)
+    g_faults.inject("mesh.chip_slowdown", mode="always",
+                    match="chip=2/", delay_us=30_000, count=1)
+    for _ in range(SKEW_SUSTAIN_PROBES + 2):
+        _flush_batch(impl, sinfo, want)
+    assert g_chipstat.suspects() == []
+
+
+def test_zero_syncs_and_probe_readbacks_only_under_skew_site(
+        skew_conf, monkeypatch):
+    """Fence-count gate extended (ISSUE satellite): sampling OFF adds
+    ZERO block_until_ready to the mesh write path and never touches
+    the mesh.skew_probe site; sampling ON still adds zero
+    block_until_ready, and exactly mesh_size readbacks per probe
+    appear — ONLY under the mesh.skew_probe devprof site, which the
+    copy-budget snapshot (devflow) excludes as calibration."""
+    import jax
+    from ceph_tpu.trace import g_devprof
+    _mesh_on(chips=8, sample_every=0)
+    impl = _mk_impl()
+    sinfo = stripe_info_t(4, 4 * 1024)
+    want = set(range(6))
+    _flush_batch(impl, sinfo, want)           # compile warmup
+    calls = {"n": 0}
+    real = jax.block_until_ready
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "block_until_ready", counting)
+
+    def site(name):
+        return dict(g_devprof.dump()["sites"].get(name, {}))
+
+    before = site("mesh.skew_probe")
+    _flush_batch(impl, sinfo, want, check=False)
+    assert calls["n"] == 0, "sampling-off mesh write path synced"
+    assert site("mesh.skew_probe") == before, \
+        "probe site moved with sampling off"
+    # sampling ON: 3 flushes -> 3 probes -> 8 readbacks each (oracle
+    # checks off so NOTHING but the mesh flush itself accounts here)
+    g_conf.set_val("ec_mesh_skew_sample_every", 1)
+    d2h0 = before.get("d2h_count", 0)
+    others0 = {name: s["d2h_count"]
+               for name, s in g_devprof.dump()["sites"].items()
+               if name != "mesh.skew_probe"}
+    for _ in range(3):
+        _flush_batch(impl, sinfo, want, check=False)
+    assert calls["n"] == 0, "skew probe added a block_until_ready"
+    probe_site = site("mesh.skew_probe")
+    assert probe_site.get("d2h_count", 0) == d2h0 + 3 * 8
+    # the probe's readbacks landed under NO other site: every other
+    # site's d2h delta is exactly what 3 mesh flushes always cost
+    # (one accounted mesh.encode materialization per flush)
+    others1 = {name: s["d2h_count"]
+               for name, s in g_devprof.dump()["sites"].items()
+               if name != "mesh.skew_probe"}
+    assert others1.get("mesh.encode", 0) \
+        == others0.get("mesh.encode", 0) + 3
+    for name, v in others1.items():
+        if name != "mesh.encode":
+            assert v == others0.get(name, v), \
+                f"probe readbacks leaked into site {name}"
+    # the copy-budget snapshot excludes the calibration site: its
+    # totals must not move when ONLY the probe site does
+    snap = g_devprof.snapshot()
+    probe_only_d2h = probe_site["d2h_count"]
+    full = g_devprof.totals()
+    assert full["d2h_count"] - snap["d2h_count"] == probe_only_d2h
+
+
+def test_mesh_skew_dump_reset_and_exposition_agreement(skew_conf):
+    """`mesh skew dump` over the admin socket carries the scoreboard,
+    per-chip percentiles and counters; the Prometheus exposition's
+    ceph_daemon_mesh_chip_* samples agree with the dump; `mesh skew
+    reset` zeroes all of it."""
+    from ceph_tpu.cluster import MiniCluster
+    _mesh_on(chips=8, sample_every=1)
+    c = MiniCluster(n_osds=4)
+    impl = _mk_impl()
+    sinfo = stripe_info_t(4, 4 * 1024)
+    want = set(range(6))
+    for _ in range(3):
+        _flush_batch(impl, sinfo, want)
+    dump = c.admin_socket.execute("mesh skew dump")
+    assert dump["probes"] == 3
+    assert len(dump["per_chip"]) == 8
+    assert len(dump["per_chip_percentiles"]) == 8
+    for pct in dump["per_chip_percentiles"].values():
+        assert pct["p99"] > 0
+    assert dump["counters"]["probes"] == 3
+    assert dump["counters"]["samples"] == 24
+    # dump/exposition agreement: the scrape shows the same figures
+    prom = c.admin_socket.execute("prometheus metrics")
+    for cname, want_v in (("probes", 3), ("samples", 24)):
+        line = next(ln for ln in prom.splitlines()
+                    if ln.startswith(f"ceph_daemon_mesh_chip_{cname} "))
+        assert float(line.split()[-1]) == want_v, line
+    out = c.admin_socket.execute("mesh skew reset")
+    assert out == {"reset": True}
+    dump = c.admin_socket.execute("mesh skew dump")
+    assert dump["probes"] == 0 and dump["per_chip"] == {}
+    assert dump["counters"]["probes"] == 0
+
+
+def test_skew_options_live_and_documented_defaults(skew_conf):
+    """The two knobs read live (config set applies on the next flush)
+    and carry the documented defaults: sampling default-on at a low
+    rate, threshold 3.0."""
+    assert int(g_conf.get_val("ec_mesh_skew_sample_every")) == 16
+    assert float(g_conf.get_val("ec_mesh_skew_threshold")) == 3.0
+    _mesh_on(chips=8, sample_every=0)
+    impl = _mk_impl()
+    sinfo = stripe_info_t(4, 4 * 1024)
+    want = set(range(6))
+    g_chipstat.reset()
+    _flush_batch(impl, sinfo, want)
+    assert g_chipstat.summary()["probes"] == 0
+    g_conf.set_val("ec_mesh_skew_sample_every", 1)   # no rebuild needed
+    _flush_batch(impl, sinfo, want)
+    assert g_chipstat.summary()["probes"] == 1

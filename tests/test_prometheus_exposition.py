@@ -77,14 +77,18 @@ def exposition():
     try:
         assert cl.write_full("prom", "o3", b"r" * 8000) == 0
         # and one through the MESH path (ceph_tpu/mesh) so the per-chip
-        # occupancy family and ceph_daemon_mesh_* counters render
+        # occupancy family and ceph_daemon_mesh_* counters render —
+        # with skew probes on EVERY flush so the per-chip latency
+        # family and the mesh_chip counters render too
         g_conf.set_val("ec_mesh_chips", 8)
+        g_conf.set_val("ec_mesh_skew_sample_every", 1)
         assert cl.write_full("prom", "o4", b"s" * 60000) == 0
     finally:
         from ceph_tpu.mesh import g_mesh
         g_conf.rm_val("ec_pipeline_depth")
         g_conf.rm_val("ec_dispatch_batch_window_us")
         g_conf.rm_val("ec_mesh_chips")
+        g_conf.rm_val("ec_mesh_skew_sample_every")
         g_mesh.topology()
     return c.admin_socket.execute("prometheus metrics")
 
@@ -193,6 +197,43 @@ def test_mesh_family_and_counters(exposition):
             ("ceph_daemon_mesh_plan_builds", True),
             ("ceph_daemon_mesh_chips", False),
             ("ceph_daemon_mesh_fallbacks", False)):
+        vals = [v for n, _l, v in samples if n == counter]
+        assert vals, f"{counter} missing from the exposition"
+        if expect_positive:
+            assert vals[0] > 0, f"{counter} never moved"
+
+
+def test_mesh_chip_family_and_counters(exposition):
+    """Per-chip-timing golden coverage (the skew PR): the 2-D
+    ``mesh_chip_latency_histogram`` renders as a real histogram family
+    whose axis-0 ``probe_usec`` edges export SCALED TO SECONDS (the
+    ``_usec`` renderer rule; the chip_index axis keeps raw edges on
+    the dump surface), and the scoreboard's counters render as
+    ``ceph_daemon_mesh_chip_*`` series carrying the fixture's probed
+    mesh write."""
+    types, samples = _parse(exposition)
+    fam = "ceph_mesh_chip_latency_histogram"
+    assert types.get(fam) == "histogram", \
+        "per-chip latency histogram family missing"
+    buckets = [(_le_of(labels), v) for n, labels, v in samples
+               if n == f"{fam}_bucket"]
+    assert buckets, "no per-chip latency buckets rendered"
+    # usec axis scaled to seconds: every finite edge must be small
+    # (the raw log2 usec edges reach 2^30; scaled they stay < 2^30/1e6)
+    les = sorted(le for le, _v in buckets if le != math.inf)
+    assert les and les[-1] < 1100.0, les[-4:]
+    assert any(0.0 < le < 1.0 for le in les), les[:6]
+    # the probed mesh flush landed one sample per chip
+    infs = [v for le, v in buckets if le == math.inf]
+    assert infs and infs[0] >= 8, "fewer than 8 per-chip probe samples"
+    for counter, expect_positive in (
+            ("ceph_daemon_mesh_chip_probes", True),
+            ("ceph_daemon_mesh_chip_samples", True),
+            ("ceph_daemon_mesh_chip_suspects_marked", False),
+            ("ceph_daemon_mesh_chip_suspects_cleared", False),
+            ("ceph_daemon_mesh_chip_suspect_chips", False),
+            ("ceph_daemon_mesh_chip_slowdowns_injected", False),
+            ("ceph_daemon_mesh_chip_max_skew_permille", True)):
         vals = [v for n, _l, v in samples if n == counter]
         assert vals, f"{counter} missing from the exposition"
         if expect_positive:
