@@ -63,8 +63,9 @@ def main(argv=None) -> int:
                             measure_dispatch_coalesce,
                             measure_ec_mesh, measure_ec_pipeline,
                             measure_encode, measure_host_native,
-                            measure_mesh_skew, measure_recovery_storm,
-                            measure_traffic, parity_check)
+                            measure_mesh_skew, measure_mesh_straggler,
+                            measure_recovery_storm, measure_traffic,
+                            parity_check)
     from ..gf.matrices import gf_gen_rs_matrix
 
     K, M = 8, 4
@@ -148,6 +149,22 @@ def main(argv=None) -> int:
                  f"suspects {sk['healthy_false_suspects']}, raised "
                  f"{sk['raised']}, cleared {sk['cleared']}, identical "
                  f"{msk['identical']})")
+        # the straggler-proof encode A/B (ceph_tpu/mesh/rateless):
+        # rateless-coded mesh healthy vs one chip slowed 10x, the
+        # protected p999 ratio + byte-identity + bandwidth overhead
+        # gated by regress.py's STRAGGLER GATE
+        mst = measure_mesh_straggler(
+            n_flushes=24 if args.smoke else 48)
+        result["metrics"].append(mst)
+        st = mst["straggler"]
+        progress(f"mesh_straggler protected p999 "
+                 f"x{st['protected_p999_ratio']} rollup / "
+                 f"x{st['protected_p999_wall_ratio']} wall of healthy "
+                 f"(unprotected x{st['unprotected_p999_wall_ratio']}, "
+                 f"detected in {st['detection_probes']} probes, "
+                 f"bw overhead x{st['bandwidth_overhead']}, "
+                 f"subset completions {st['subset_completions']}, "
+                 f"identical {mst['identical']})")
         # traffic harness (ceph_tpu/load): ≥8 concurrent synthetic
         # clients over the real client stack; the smoke shape is <10 s
         # on CPU, the full mode drives a deeper closed loop

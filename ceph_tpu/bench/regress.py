@@ -85,6 +85,31 @@ RECOVERY_TOLERANCE = 0.10
 # ruler that cries wolf is worse than no ruler.
 SKEW_MAX_DETECTION_PROBES = 8
 
+# the STRAGGLER GATE (rateless coded mesh encode PR): the
+# ec_mesh_straggler workload's `straggler` block A/Bs the rateless
+# path healthy vs one-chip-slowed-10x on one mini cluster.  Absolute
+# invariants like the SKEW GATE — the fix either holds or it does not:
+# - the scoreboard must detect the slowed chip within the probe window
+#   and report a nonzero skew ratio (the injected-degradation receipt:
+#   a quiet run proves nothing);
+# - protected cluster_rollup device_call p999 must stay within ONE
+#   log2 bucket of the healthy twin (ratio <= 2.0 on edge-quantized
+#   percentiles; measured 1.0 on CPU smoke — the unprotected twin
+#   sits ~8 buckets up) AND the exact wall-clock p999 ratio within
+#   1.5 (measured 0.9-1.0; the margin absorbs shared-core smoke
+#   wobble, the unprotected twin measures 6-7x);
+# - every op byte-identical to the unprotected oracle (subset
+#   completion + host re-solves invisible in the bytes);
+# - zero single-device fallbacks (completion must come from the
+#   surviving subset, not the degradation ladder) and at least one
+#   subset completion (the protection actually engaged);
+# - the healthy twin pays < 2x coded-bandwidth overhead and marks no
+#   false suspects.
+STRAGGLER_MAX_DETECTION_PROBES = 8
+STRAGGLER_MAX_P999_RATIO = 2.0
+STRAGGLER_MAX_WALL_P999_RATIO = 1.5
+STRAGGLER_MAX_BANDWIDTH_OVERHEAD = 2.0
+
 
 def load_trajectory(root: str) -> List[Dict[str, Any]]:
     """All parseable BENCH_r*.json records under *root*, oldest first.
@@ -183,6 +208,7 @@ def compare_against_trajectory(
     stage_compared = 0     # stage usec/op figures with a gated baseline
     recovery_compared = 0  # recovery storm figures with a baseline
     skew_compared = 0      # skew blocks checked (absolute gate)
+    straggler_compared = 0  # straggler blocks checked (absolute gate)
     for cur in current:
         if not cur.get("fenced") or cur.get("suspect"):
             continue
@@ -192,6 +218,11 @@ def compare_against_trajectory(
         if isinstance(sk, dict):
             skew_compared += 1
             regressions.extend(_skew_gate(name, sk))
+        # ---- STRAGGLER GATE: absolute invariants, baseline or not ------
+        st = cur.get("straggler")
+        if isinstance(st, dict):
+            straggler_compared += 1
+            regressions.extend(_straggler_gate(name, st))
         baseline = None
         baseline_round = None
         for rec in reversed(trajectory):
@@ -262,6 +293,7 @@ def compare_against_trajectory(
             "stage_compared": stage_compared,
             "recovery_compared": recovery_compared,
             "skew_compared": skew_compared,
+            "straggler_compared": straggler_compared,
             "no_baseline": no_baseline,
             "tolerance": tolerance, "platform": platform}
 
@@ -300,4 +332,66 @@ def _skew_gate(name: str, sk: Dict[str, Any]) -> List[Dict[str, Any]]:
     if not sk.get("cleared"):
         fail("cleared", sk.get("cleared"),
              "TPU_MESH_SKEW did not clear after the fault was removed")
+    return out
+
+
+def _straggler_gate(name: str,
+                    st: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The straggler workload's absolute invariants as regression
+    entries (change=None — the flagship robustness claim either holds
+    or it does not)."""
+    out: List[Dict[str, Any]] = []
+
+    def fail(key: str, value, why: str) -> None:
+        out.append({"name": f"{name}.straggler.{key}",
+                    "unit": "invariant", "value": value,
+                    "baseline": why, "baseline_round": None,
+                    "change": None})
+
+    det = int(st.get("detection_probes") or 0)
+    if det <= 0:
+        fail("detection_probes", det,
+             "scoreboard never marked the slowed chip suspect — no "
+             "injected-degradation receipt")
+    elif det > STRAGGLER_MAX_DETECTION_PROBES:
+        fail("detection_probes", det,
+             f"detection took more than "
+             f"{STRAGGLER_MAX_DETECTION_PROBES} probes")
+    if det > 0 and st.get("detected_chip") != st.get("slow_chip"):
+        fail("detected_chip", st.get("detected_chip"),
+             f"suspect is not the slowed chip {st.get('slow_chip')}")
+    if float(st.get("skew_ratio_detected") or 0.0) <= 0:
+        fail("skew_ratio_detected", st.get("skew_ratio_detected"),
+             "no skew ratio recorded at detection")
+    ratio = float(st.get("protected_p999_ratio") or 0.0)
+    if ratio <= 0 or ratio > STRAGGLER_MAX_P999_RATIO:
+        fail("protected_p999_ratio", ratio,
+             f"protected cluster_rollup device_call p999 beyond "
+             f"{STRAGGLER_MAX_P999_RATIO}x the healthy twin "
+             f"(log2-edge quantized: 2.0 = one bucket)")
+    wall = float(st.get("protected_p999_wall_ratio") or 0.0)
+    if wall <= 0 or wall > STRAGGLER_MAX_WALL_P999_RATIO:
+        fail("protected_p999_wall_ratio", wall,
+             f"protected wall-clock flush p999 beyond "
+             f"{STRAGGLER_MAX_WALL_P999_RATIO}x the healthy twin")
+    bw = float(st.get("bandwidth_overhead") or 0.0)
+    if bw <= 0 or bw >= STRAGGLER_MAX_BANDWIDTH_OVERHEAD:
+        fail("bandwidth_overhead", bw,
+             f"healthy twin pays >= "
+             f"{STRAGGLER_MAX_BANDWIDTH_OVERHEAD}x coded bandwidth")
+    if not st.get("byte_identical"):
+        fail("byte_identical", st.get("byte_identical"),
+             "protected outputs diverged from the unprotected oracle")
+    if int(st.get("single_device_fallbacks") or 0) > 0:
+        fail("single_device_fallbacks",
+             st.get("single_device_fallbacks"),
+             "a protected flush degraded to the single-device path")
+    if int(st.get("subset_completions") or 0) <= 0:
+        fail("subset_completions", st.get("subset_completions"),
+             "no flush completed from a strict subset — the "
+             "protection never engaged")
+    if int(st.get("healthy_false_suspects") or 0) > 0:
+        fail("healthy_false_suspects",
+             st.get("healthy_false_suspects"),
+             "the healthy twin marked a suspect")
     return out

@@ -122,6 +122,23 @@ def build_options() -> List[Option]:
                          "encode (donate_argnums) so the device "
                          "recycles it into the output; ignored on "
                          "backends without buffer aliasing (cpu)"),
+        Option("ec_mesh_rateless", OPT_BOOL).set_default(False)
+        .set_description("rateless coded mesh encode "
+                         "(ceph_tpu/mesh/rateless): over-decompose "
+                         "each flushed encode batch into more coded "
+                         "row-blocks than chips and complete the "
+                         "flush from the FIRST sufficient subset of "
+                         "chips — a slow or dead chip costs "
+                         "bandwidth, never latency.  Off (default) = "
+                         "the block-sharded SPMD mesh path"),
+        Option("ec_mesh_rateless_tasks", OPT_INT).set_default(0)
+        .set_description("total coded row-blocks per rateless mesh "
+                         "flush (systematic blocks — one per chip — "
+                         "plus GF(2^8) random-combination parity "
+                         "blocks).  0 = auto (mesh size + 2 parity "
+                         "blocks); values are clamped to at least "
+                         "mesh size + 1 so every flush carries "
+                         "redundancy"),
         Option("ec_mesh_skew_sample_every", OPT_INT).set_default(16)
         .set_description("sampled per-chip skew probes: every Nth mesh "
                          "flush drains one element per chip shard and "

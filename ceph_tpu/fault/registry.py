@@ -85,6 +85,11 @@ SITE_CATALOG: Dict[str, str] = {
         "per-chip straggler injection (ceph_tpu/mesh/chipstat): delays "
         "the matching chip's probe readback by delay_us; context is "
         "'chip=<i>/<mesh size>' so match='chip=3/' scopes one chip",
+    "mesh.chip_fail":
+        "hard per-chip failure mid-flush (ceph_tpu/mesh/rateless): the "
+        "matching chip's coded blocks become erasures the subset "
+        "completion re-solves around; context is 'chip=<i>/<mesh "
+        "size>' for match= scoping, count= bounds the failed flushes",
     "osd.shard_read_eio":
         "shard-side EC read returns EIO (bluestore_debug_inject_read_err "
         "role) — the primary must reconstruct from surviving shards",

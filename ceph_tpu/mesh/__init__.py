@@ -6,13 +6,17 @@ dispatch" for the operator story.
 from .chipstat import (ChipStat, chip_latency_axes, g_chipstat,
                        mesh_chip_perf_counters)
 from .pool import StagingPool
+from .rateless import (RatelessCoder, RatelessPlan,
+                       rateless_perf_counters)
 from .runtime import (MeshRuntime, ShardingPlan, chip_occupancy_axes,
                       g_mesh, mesh_perf_counters)
 from .topology import BATCH_AXIS, addressable_devices, batch_mesh
 
 __all__ = [
-    "BATCH_AXIS", "ChipStat", "MeshRuntime", "ShardingPlan",
-    "StagingPool", "addressable_devices", "batch_mesh",
-    "chip_latency_axes", "chip_occupancy_axes", "g_chipstat", "g_mesh",
+    "BATCH_AXIS", "ChipStat", "MeshRuntime", "RatelessCoder",
+    "RatelessPlan", "ShardingPlan", "StagingPool",
+    "addressable_devices", "batch_mesh", "chip_latency_axes",
+    "chip_occupancy_axes", "g_chipstat", "g_mesh",
     "mesh_chip_perf_counters", "mesh_perf_counters",
+    "rateless_perf_counters",
 ]

@@ -141,6 +141,27 @@ def chip_latency_axes() -> List[PerfHistogramAxis]:
                               buckets=66, scale_type=SCALE_LINEAR)]
 
 
+def slowdown_delays(n_chips: int) -> Dict[int, float]:
+    """THE ``mesh.chip_slowdown`` decision pass, shared by the SPMD
+    probe (ChipStat.probe) and the rateless drain (rateless.py) so
+    the ctx format (``chip=<i>/<n>`` — what ``match=`` scopes) and
+    the ``slowdowns_injected`` accounting cannot drift: one decision
+    per chip per probe/flush, before the clock starts, returning
+    chip index -> hold-not-complete-for microseconds."""
+    from ..fault import g_faults
+    delay_until: Dict[int, float] = {}
+    if g_faults.site_armed("mesh.chip_slowdown"):
+        spec = g_faults.armed("mesh.chip_slowdown")
+        delay_us = spec.delay_us if spec is not None else 0
+        pc = mesh_chip_perf_counters()
+        for i in range(n_chips):
+            if g_faults.should_fire("mesh.chip_slowdown",
+                                    ctx=f"chip={i}/{n_chips}"):
+                pc.inc(l_chip_slowdowns_injected)
+                delay_until[i] = delay_us
+    return delay_until
+
+
 class ChipStat:
     """Per-chip probe recorder + hysteretic skew scoreboard."""
 
@@ -228,25 +249,15 @@ class ChipStat:
         Injection is probe-observed by design: this PR builds the
         ruler, not the fix."""
         import numpy as np
-        from ..fault import g_faults
         from ..trace.devprof import g_devprof
 
         shards = getattr(out, "addressable_shards", None)
         if not shards:
             return
-        pc = mesh_chip_perf_counters()
         n_shards = len(shards)
         # one injection decision per chip per probe, before the clock
         # starts (a mid-poll re-arm must not split one probe's view)
-        delay_until: Dict[int, float] = {}
-        if g_faults.site_armed("mesh.chip_slowdown"):
-            spec = g_faults.armed("mesh.chip_slowdown")
-            delay_us = spec.delay_us if spec is not None else 0
-            for i in range(n_shards):
-                if g_faults.should_fire("mesh.chip_slowdown",
-                                        ctx=f"chip={i}/{n_shards}"):
-                    pc.inc(l_chip_slowdowns_injected)
-                    delay_until[i] = delay_us
+        delay_until = slowdown_delays(n_shards)
         pending = {i: sh.data for i, sh in enumerate(shards)}
         deltas: Dict[int, float] = {}
         t0 = time.perf_counter()
@@ -270,6 +281,23 @@ class ChipStat:
             if pending:
                 time.sleep(self.PROBE_POLL_S)
         self._record(deltas)
+
+    def record_deltas(self, deltas: Dict[int, float]) -> None:
+        """The rateless drain's probe entry (rateless.py): on probe
+        flushes the subset-completion drain measures each chip's
+        completion delta itself — same scoreboard, same hysteresis,
+        no separate element readbacks (the drain's fetches ARE the
+        data path).  Censoring policy lives in the drain: a recorded
+        delta is either exact or provably-at-least (never fabricated),
+        so the sustain/clear semantics are unchanged."""
+        self._record(deltas)
+
+    def suspect_set(self) -> set:
+        """Chip indices currently marked suspect — the placement
+        feedback the rateless coder deweights by (cheap locked read,
+        once per flush)."""
+        with self._lock:
+            return {i for i, r in self._chips.items() if r["suspect"]}
 
     def _record(self, deltas: Dict[int, float]) -> None:
         every, threshold = self._opts()

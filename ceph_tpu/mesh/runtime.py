@@ -137,10 +137,12 @@ def chip_occupancy_axes() -> List[PerfHistogramAxis]:
 class ShardingPlan:
     """One compiled placement for a (codec signature, chunk bucket):
     input rows sharded over the batch axis, bit-matrix replicated,
-    output rows sharded in place."""
+    output rows sharded in place.  ``rateless`` holds the lazily-built
+    coding geometry for the rateless path (rateless.py) — same cache
+    entry, same lifetime."""
 
     __slots__ = ("mesh", "in_sharding", "enc_bits", "fn", "donated",
-                 "hits")
+                 "hits", "rateless")
 
     def __init__(self, mesh, backend, donate: bool):
         import jax
@@ -161,18 +163,21 @@ class ShardingPlan:
         self.fn = jax.jit(gf_bit_matmul, out_shardings=out_sharding,
                           donate_argnums=donate_argnums)
         self.hits = 0
+        self.rateless = None     # (n_parity, RatelessPlan), lazy
 
 
 class MeshRuntime:
     """The dispatch scheduler's device back end when a mesh is up."""
 
     def __init__(self):
+        from .rateless import RatelessCoder
         self._lock = DebugRLock("MeshRuntime::lock")
         self._mesh = None
         self._mesh_n = None          # ec_mesh_chips the mesh was built for
         self._plans: Dict[Tuple, ShardingPlan] = {}
         self._pool = StagingPool()
         self._chips: Dict[int, Dict[str, int]] = {}
+        self._rateless = RatelessCoder()
 
     # ---- options (read live so `config set` applies without restart) ------
     @staticmethod
@@ -276,6 +281,7 @@ class MeshRuntime:
     def _encode(self, sig: Tuple, backend, stripes_list, bucket_c: int
                 ) -> np.ndarray:
         import jax
+        from .rateless import rateless_opts
         mesh = self.topology()
         plan = self._plan(sig, bucket_c, backend, mesh)
         k = backend.k
@@ -284,6 +290,7 @@ class MeshRuntime:
         pc = mesh_perf_counters()
         buf, pooled = self._pool.acquire((s_pad, k, bucket_c))
         pc.inc(l_mesh_pool_hits if pooled else l_mesh_pool_misses)
+        chip_real = None
         try:
             # assembly: every request's rows land directly in the
             # padded staging buffer — the old path's pad_cols + stack
@@ -298,7 +305,6 @@ class MeshRuntime:
                 nbytes += st.nbytes
             g_devprof.account_host_copy("mesh.assemble", buf.nbytes)
             g_devprof.install_compile_listener()
-            g_devprof.account_h2d("mesh.encode", buf.nbytes)
             from ..common.kernel_trace import g_kernel_timer
             from .chipstat import g_chipstat
             # sampled fenced probe (chipstat.py): every Nth flush the
@@ -307,27 +313,61 @@ class MeshRuntime:
             # delta lands on the skew scoreboard; off (the default
             # cadence counter not due) this is one int check
             probe = g_chipstat.should_probe()
-            with g_devprof.stage("mesh.encode"):
-                def sharded_call():
-                    dev_in = jax.device_put(buf, plan.in_sharding)
-                    out = plan.fn(dev_in, plan.enc_bits)
-                    if probe:
-                        g_chipstat.probe(out, mesh)
-                    # np.asarray gathers every shard to the host — the
-                    # materialization IS the completion fence (each
-                    # chip's rows cross back; the bench twin drains
-                    # per-shard via parallel.drain_sharded)
-                    return np.asarray(out)
-                coding = g_kernel_timer.timed("ec_encode_batch_mesh",
-                                              sharded_call)
+            if rateless_opts()[0]:
+                # rateless coded path (rateless.py): over-decomposed
+                # per-chip block calls, subset completion, h2d/d2h
+                # accounted per block inside the coder; on probe
+                # flushes the drain itself feeds the scoreboard
+                rplan = self._rateless_plan(sig, bucket_c, plan,
+                                            backend, mesh)
+                with g_devprof.stage("mesh.encode"):
+                    coding, chip_real = g_kernel_timer.timed(
+                        "ec_encode_batch_mesh_rateless",
+                        lambda: self._rateless.encode(
+                            plan, rplan, buf, mesh, probe, s_total))
+            else:
+                g_devprof.account_h2d("mesh.encode", buf.nbytes)
+                with g_devprof.stage("mesh.encode"):
+                    def sharded_call():
+                        dev_in = jax.device_put(buf, plan.in_sharding)
+                        out = plan.fn(dev_in, plan.enc_bits)
+                        if probe:
+                            g_chipstat.probe(out, mesh)
+                        # np.asarray gathers every shard to the host —
+                        # the materialization IS the completion fence
+                        # (each chip's rows cross back; the bench twin
+                        # drains per-shard via parallel.drain_sharded)
+                        return np.asarray(out)
+                    coding = g_kernel_timer.timed(
+                        "ec_encode_batch_mesh", sharded_call)
+                g_devprof.account_d2h("mesh.encode", coding.nbytes)
         finally:
             # release on failure too: the fault-guard retry path must
             # not turn every failed attempt into a leaked buffer
             self._pool.release(buf)
-        g_devprof.account_d2h("mesh.encode", coding.nbytes)
         self._account_chips(mesh, s_total, s_pad,
-                            len(stripes_list), nbytes)
+                            len(stripes_list), nbytes,
+                            chip_real=chip_real)
         return coding
+
+    def _rateless_plan(self, sig: Tuple, bucket_c: int, plan, backend,
+                       mesh):
+        """The plan-cache entry's rateless geometry, (re)built when
+        ``ec_mesh_rateless_tasks`` changes the block count — built
+        alongside the encode bit-matrix, same lifetime."""
+        from ..gf.tables import expand_to_bitmatrix
+        from .rateless import RatelessCoder, RatelessPlan
+        n_sys, n_parity = RatelessCoder.tasks_for(mesh.size)
+        with self._lock:
+            cached = plan.rateless
+            if cached is not None and cached[0] == n_parity:
+                return cached[1]
+        bits_np = expand_to_bitmatrix(
+            backend.matrix[backend.k:]).astype(np.int8)
+        rplan = RatelessPlan((sig, bucket_c), n_sys, n_parity, bits_np)
+        with self._lock:
+            plan.rateless = (n_parity, rplan)
+        return rplan
 
     @staticmethod
     def _pad_rows(s: int, mesh_size: int) -> int:
@@ -362,7 +402,12 @@ class MeshRuntime:
         return plan
 
     def _account_chips(self, mesh, s_total: int, s_pad: int,
-                       n_reqs: int, nbytes: int) -> None:
+                       n_reqs: int, nbytes: int,
+                       chip_real: Optional[Dict[int, int]] = None
+                       ) -> None:
+        """Per-chip occupancy: *chip_real* (the rateless path's
+        scoreboard-weighted placement) when given, else the SPMD
+        path's contiguous block-sharded layout."""
         pc = mesh_perf_counters()
         pc.inc(l_mesh_dispatches)
         pc.inc(l_mesh_reqs, n_reqs)
@@ -374,7 +419,10 @@ class MeshRuntime:
         devices = np.asarray(mesh.devices).ravel()
         with self._lock:
             for i in range(mesh.size):
-                real = min(max(s_total - i * rows, 0), rows)
+                if chip_real is not None:
+                    real = int(chip_real.get(i, 0))
+                else:
+                    real = min(max(s_total - i * rows, 0), rows)
                 hist.inc(real, i)
                 c = self._chips.get(i)
                 if c is None:
@@ -412,6 +460,11 @@ class MeshRuntime:
             "plans": plans,
             "pool": self._pool.dump(),
             "counters": mesh_perf_counters().dump(),
+            # the rateless coded-encode pane (rateless.py): options,
+            # coding geometry for the live mesh, and the
+            # mesh_rateless_* counter family
+            "rateless": self._rateless.dump(
+                0 if mesh is None else mesh.size),
             # the chip-health scoreboard (chipstat.py): per-chip probe
             # EWMAs, skew ratios and suspects — the full table with
             # percentiles lives on `mesh skew dump`

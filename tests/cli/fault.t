@@ -12,6 +12,7 @@ site catalog, arming a trigger, the unknown-site refusal, and clearing.
       "device.encode_batch": "batched EC encode device call (matrix_plugin.encode_batch)",
       "device.encode_chunks": "per-stripe encode device call (matrix_plugin.encode_chunks)",
       "dispatch.batch": "coalesced flush execution (scheduler._execute run_group) \u2014 exercises the per-request fallback isolation",
+      "mesh.chip_fail": "hard per-chip failure mid-flush (ceph_tpu/mesh/rateless): the matching chip's coded blocks become erasures the subset completion re-solves around; context is 'chip=<i>/<mesh size>' for match= scoping, count= bounds the failed flushes",
       "mesh.chip_slowdown": "per-chip straggler injection (ceph_tpu/mesh/chipstat): delays the matching chip's probe readback by delay_us; context is 'chip=<i>/<mesh size>' so match='chip=3/' scopes one chip",
       "mesh.encode_batch": "mesh-sharded flush execution (ceph_tpu/mesh runtime) \u2014 exhaustion degrades the flush to the single-device path",
       "msg.drop": "drop a fabric message (ms inject socket failures role); context is '<MsgType> <src>><dst>' for match= scoping",
@@ -59,6 +60,27 @@ injection to exactly one chip index.
       "seed": null
     },
     "site": "mesh.chip_slowdown"
+  }
+
+The hard per-chip failure site (ceph_tpu/mesh/rateless): the matching
+chip's coded blocks become erasures mid-flush, match='chip=<i>/' scopes
+one chip and count= bounds how many flushes lose it.
+
+  $ ceph --cluster ck daemon osd.0 fault inject name=mesh.chip_fail mode=always match=chip=3/ count=2
+  {
+    "armed": {
+      "checks": 0,
+      "count": 2,
+      "delay_us": 0,
+      "error": "device",
+      "fires": 0,
+      "match": "chip=3/",
+      "mode": "always",
+      "n": 1,
+      "p": 1.0,
+      "seed": null
+    },
+    "site": "mesh.chip_fail"
   }
 
   $ ceph --cluster ck daemon osd.0 fault inject name=bogus.site

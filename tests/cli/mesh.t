@@ -35,6 +35,30 @@ contract — and the reset.
     "reset": true
   }
 
+The rateless coded-encode pane rides `dispatch dump`'s mesh block
+(ceph_tpu/mesh/rateless): the option defaults (off; tasks 0 = auto)
+and the zeroed mesh_rateless_* counter family of a freshly restored
+cluster are the contract.
+
+  $ ceph --cluster ck daemon osd.0 dispatch dump | python -c "import json,sys; print(json.dumps(json.load(sys.stdin)['mesh']['rateless'], indent=2, sort_keys=True))"
+  {
+    "counters": {
+      "chip_failures": 0,
+      "coded_tasks": 0,
+      "flushes": 0,
+      "host_resolves": 0,
+      "insufficient": 0,
+      "parity_tasks": 0,
+      "subset_completions": 0,
+      "suspect_deweights": 0,
+      "wasted_blocks": 0
+    },
+    "options": {
+      "ec_mesh_rateless": false,
+      "ec_mesh_rateless_tasks": 0
+    }
+  }
+
 (The populated scoreboard of a probed mesh — per-chip EWMAs, skew
 ratios, a marked suspect and the TPU_MESH_SKEW raise/clear — is
 asserted in-process by tests/test_mesh_skew.py; an 8-chip mesh

@@ -301,6 +301,62 @@ def test_gate_skew_invariants(tmp_path):
         assert f"ec_mesh_skew.skew.{key}" in names, (over, names)
 
 
+def test_gate_straggler_invariants(tmp_path):
+    """The STRAGGLER GATE is absolute (no baseline needed): missing or
+    late detection, the wrong chip, a protected p999 beyond the
+    calibrated bounds, a byte divergence, a single-device fallback, a
+    never-engaged subset completion, >= 2x coded bandwidth, or a noisy
+    healthy twin each fail the gate on their own."""
+    def straggler_metric(**over):
+        m = _metric("ec_mesh_straggler", 1.0, unit="ratio")
+        st = {"mesh_chips": 8, "slow_chip": 5, "delay_us": 30000,
+              "threshold": 3.0, "detected_chip": 5,
+              "skew_ratio_detected": 3.3, "detection_probes": 3,
+              "healthy_false_suspects": 0,
+              "protected_p999_ratio": 1.0,
+              "protected_p999_wall_ratio": 0.95,
+              "bandwidth_overhead": 1.25,
+              "subset_completions": 40,
+              "single_device_fallbacks": 0,
+              "byte_identical": True}
+        st.update(over)
+        m["straggler"] = st
+        return m
+
+    # a clean run gates clean — with or without any baseline round
+    out = regress.compare_against_trajectory([straggler_metric()], [],
+                                             "cpu")
+    assert out["straggler_compared"] == 1 and not out["regressions"]
+    cases = (
+        ({"detection_probes": 0}, "detection_probes"),
+        ({"detection_probes":
+          regress.STRAGGLER_MAX_DETECTION_PROBES + 1},
+         "detection_probes"),
+        ({"detected_chip": 2}, "detected_chip"),
+        ({"skew_ratio_detected": 0.0}, "skew_ratio_detected"),
+        ({"protected_p999_ratio":
+          regress.STRAGGLER_MAX_P999_RATIO * 2},
+         "protected_p999_ratio"),
+        ({"protected_p999_ratio": 0.0}, "protected_p999_ratio"),
+        ({"protected_p999_wall_ratio":
+          regress.STRAGGLER_MAX_WALL_P999_RATIO + 0.1},
+         "protected_p999_wall_ratio"),
+        ({"bandwidth_overhead":
+          regress.STRAGGLER_MAX_BANDWIDTH_OVERHEAD},
+         "bandwidth_overhead"),
+        ({"byte_identical": False}, "byte_identical"),
+        ({"single_device_fallbacks": 1}, "single_device_fallbacks"),
+        ({"subset_completions": 0}, "subset_completions"),
+        ({"healthy_false_suspects": 1}, "healthy_false_suspects"),
+    )
+    for over, key in cases:
+        out = regress.compare_against_trajectory(
+            [straggler_metric(**over)], [], "cpu")
+        names = {r["name"] for r in out["regressions"]}
+        assert f"ec_mesh_straggler.straggler.{key}" in names, \
+            (over, names)
+
+
 def test_gate_within_tolerance_passes(tmp_path):
     _write_round(tmp_path, 6, "cpu", [_metric("enc", 10.0)])
     traj = regress.load_trajectory(str(tmp_path))
@@ -445,7 +501,7 @@ def test_smoke_mode_end_to_end():
             "ec_pipeline_fenced", "ec_pipeline_depth1_fenced",
             "ec_mesh_fenced", "ec_mesh_single_fenced",
             "traffic_harness_smoke", "ec_recovery_storm",
-            "ec_mesh_skew"} <= names
+            "ec_mesh_skew", "ec_mesh_straggler"} <= names
     # the coalesce metric carries its serial twin and speedup
     mc = next(m for m in out["metrics"]
               if m["name"] == "ec_dispatch_coalesce_fenced")
@@ -534,6 +590,32 @@ def test_smoke_mode_end_to_end():
     assert sk["raised"] is True and sk["cleared"] is True
     assert msk["identical"] is True
     assert out["gate"]["skew_compared"] >= 1
+    # straggler-proof encode acceptance (ceph_tpu/mesh/rateless): with
+    # one chip slowed 10x the rateless path keeps cluster_rollup
+    # device_call p999 next to the healthy twin (the SPMD twin pays
+    # the delay), detection receipts present, byte-identity holds,
+    # the healthy twin pays < 2x coded bandwidth, and no protected
+    # flush fell down the single-device ladder
+    mstr = next(m for m in out["metrics"]
+                if m["name"] == "ec_mesh_straggler")
+    st = mstr["straggler"]
+    assert 0 < st["detection_probes"] \
+        <= regress.STRAGGLER_MAX_DETECTION_PROBES
+    assert st["detected_chip"] == st["slow_chip"]
+    assert st["skew_ratio_detected"] > 0
+    assert 0 < st["protected_p999_ratio"] \
+        <= regress.STRAGGLER_MAX_P999_RATIO
+    assert 0 < st["protected_p999_wall_ratio"] \
+        <= regress.STRAGGLER_MAX_WALL_P999_RATIO
+    assert st["unprotected_p999_wall_ratio"] \
+        > st["protected_p999_wall_ratio"]
+    assert 1.0 < st["bandwidth_overhead"] \
+        < regress.STRAGGLER_MAX_BANDWIDTH_OVERHEAD
+    assert st["subset_completions"] > 0
+    assert st["single_device_fallbacks"] == 0
+    assert st["healthy_false_suspects"] == 0
+    assert st["byte_identical"] is True and mstr["identical"] is True
+    assert out["gate"]["straggler_compared"] >= 1
     # devprof acceptance: EVERY fenced workload emits a devflow block
     # with the gated per-op figures, and the dispatch/pipeline pairs
     # show coalescing as FEWER copies per op (the copy-budget story)

@@ -34,15 +34,24 @@ def cluster_and_text():
     g_conf.set_val("ec_mesh_skew_sample_every", 1)
     try:
         assert cl.write_full("lint", "om", b"m" * 60000) == 0
+        # and one through the RATELESS coded path so the
+        # mesh_rateless_* family registers, moves, and is lint-covered
+        g_conf.set_val("ec_mesh_rateless", True)
+        assert cl.write_full("lint", "or", b"n" * 60000) == 0
     finally:
         g_conf.rm_val("ec_mesh_chips")
         g_conf.rm_val("ec_dispatch_batch_window_us")
         g_conf.rm_val("ec_mesh_skew_sample_every")
+        g_conf.rm_val("ec_mesh_rateless")
         g_mesh.topology()
-    from ceph_tpu.mesh import g_chipstat
+    from ceph_tpu.mesh import g_chipstat, rateless_perf_counters
+    from ceph_tpu.mesh.rateless import l_rl_flushes
     assert g_chipstat.summary()["probes"] > 0, \
         "mesh write produced no skew probe — scoreboard families " \
         "would be lint-invisible"
+    assert rateless_perf_counters().get(l_rl_flushes) > 0, \
+        "mesh write never rode the rateless path — its counter " \
+        "family would be lint-invisible"
     # one repair round through a regenerating pool so the `recovery`
     # counter families and the bytes-per-shard histogram register and
     # move — the lint below then covers them like any other family

@@ -83,12 +83,18 @@ def exposition():
         g_conf.set_val("ec_mesh_chips", 8)
         g_conf.set_val("ec_mesh_skew_sample_every", 1)
         assert cl.write_full("prom", "o4", b"s" * 60000) == 0
+        # and one through the RATELESS coded path (ceph_tpu/mesh/
+        # rateless) so the mesh_rateless_* counter family renders with
+        # real content
+        g_conf.set_val("ec_mesh_rateless", True)
+        assert cl.write_full("prom", "o5", b"t" * 60000) == 0
     finally:
         from ceph_tpu.mesh import g_mesh
         g_conf.rm_val("ec_pipeline_depth")
         g_conf.rm_val("ec_dispatch_batch_window_us")
         g_conf.rm_val("ec_mesh_chips")
         g_conf.rm_val("ec_mesh_skew_sample_every")
+        g_conf.rm_val("ec_mesh_rateless")
         g_mesh.topology()
     return c.admin_socket.execute("prometheus metrics")
 
@@ -197,6 +203,28 @@ def test_mesh_family_and_counters(exposition):
             ("ceph_daemon_mesh_plan_builds", True),
             ("ceph_daemon_mesh_chips", False),
             ("ceph_daemon_mesh_fallbacks", False)):
+        vals = [v for n, _l, v in samples if n == counter]
+        assert vals, f"{counter} missing from the exposition"
+        if expect_positive:
+            assert vals[0] > 0, f"{counter} never moved"
+
+
+def test_mesh_rateless_counters(exposition):
+    """Rateless-PR golden coverage: the ``mesh_rateless_*`` counter
+    family renders as ``ceph_daemon_mesh_rateless_*`` daemon series
+    carrying the fixture's coded write — flushes and coded tasks
+    moved, the failure/fallback counters render at zero."""
+    _types, samples = _parse(exposition)
+    for counter, expect_positive in (
+            ("ceph_daemon_mesh_rateless_flushes", True),
+            ("ceph_daemon_mesh_rateless_coded_tasks", True),
+            ("ceph_daemon_mesh_rateless_parity_tasks", True),
+            ("ceph_daemon_mesh_rateless_wasted_blocks", False),
+            ("ceph_daemon_mesh_rateless_subset_completions", False),
+            ("ceph_daemon_mesh_rateless_host_resolves", False),
+            ("ceph_daemon_mesh_rateless_suspect_deweights", False),
+            ("ceph_daemon_mesh_rateless_chip_failures", False),
+            ("ceph_daemon_mesh_rateless_insufficient", False)):
         vals = [v for n, _l, v in samples if n == counter]
         assert vals, f"{counter} missing from the exposition"
         if expect_positive:
