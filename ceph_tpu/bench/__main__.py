@@ -64,7 +64,8 @@ def main(argv=None) -> int:
                             measure_ec_mesh, measure_ec_pipeline,
                             measure_encode, measure_host_native,
                             measure_mesh_skew, measure_mesh_straggler,
-                            measure_recovery_storm, measure_traffic,
+                            measure_recovery_storm,
+                            measure_slo_autotune, measure_traffic,
                             parity_check)
     from ..gf.matrices import gf_gen_rs_matrix
 
@@ -194,6 +195,22 @@ def main(argv=None) -> int:
                  f" B/shard regen vs {rec['bytes_per_repaired_shard_rs']}"
                  f" RS (ratio {rec['regen_vs_rs_ratio']}, identical "
                  f"{mr['identical']}, slo {mr['slo']})")
+        # self-tuning control plane (ceph_tpu/control, docs/CONTROL.md):
+        # the three closed-loop scenarios on real clusters, the
+        # actuation receipts gated by regress.py's CONTROL GATE
+        # self-tuning control plane (ceph_tpu/control, docs/CONTROL.md):
+        # the three closed-loop scenarios on real clusters, the
+        # actuation receipts gated by regress.py's CONTROL GATE
+        ma = measure_slo_autotune()
+        result["metrics"].append(ma)
+        ctrl = ma["control"]
+        scen = ctrl["scenarios"]
+        progress(f"slo_autotune worst converge {ma['value']} ticks "
+                 f"(admission {scen['admission']['converge_ticks']}, "
+                 f"recovery {scen['recovery']['converge_ticks']}, "
+                 f"straggler {scen['straggler']['converge_ticks']}; "
+                 f"disabled twin moves {ctrl['disabled_moves']}, "
+                 f"byte_exact {ctrl['byte_exact']})")
         host = measure_host_native(matrix, batch[0],
                                    target_seconds=0.3 if args.smoke
                                    else 1.5)

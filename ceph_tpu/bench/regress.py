@@ -110,6 +110,22 @@ STRAGGLER_MAX_P999_RATIO = 2.0
 STRAGGLER_MAX_WALL_P999_RATIO = 1.5
 STRAGGLER_MAX_BANDWIDTH_OVERHEAD = 2.0
 
+# the CONTROL GATE (self-tuning control plane PR, docs/CONTROL.md):
+# the slo_autotune workload's `control` block records the three
+# closed-loop scenarios (abusive client, recovery storm under an SLO
+# burn, straggling chip) run on real clusters with the mgr controller
+# enabled.  Absolute invariants, baseline or not:
+# - every scenario RAISED its pressure, the controller MOVED, and the
+#   episode CLEARED back to baseline within the workload's tick
+#   budget (zero operator action is the whole point);
+# - every pressure-driven move landed inside its knob's
+#   floor/ceiling corridor;
+# - the disabled-controller twin made ZERO moves (an off controller
+#   is observe-only by construction — mgr_control_enable gates every
+#   actuation);
+# - client ops stayed byte-exact throughout (the control plane never
+#   touches the data path).
+
 
 def load_trajectory(root: str) -> List[Dict[str, Any]]:
     """All parseable BENCH_r*.json records under *root*, oldest first.
@@ -209,6 +225,7 @@ def compare_against_trajectory(
     recovery_compared = 0  # recovery storm figures with a baseline
     skew_compared = 0      # skew blocks checked (absolute gate)
     straggler_compared = 0  # straggler blocks checked (absolute gate)
+    control_compared = 0   # control blocks checked (absolute gate)
     for cur in current:
         if not cur.get("fenced") or cur.get("suspect"):
             continue
@@ -223,6 +240,11 @@ def compare_against_trajectory(
         if isinstance(st, dict):
             straggler_compared += 1
             regressions.extend(_straggler_gate(name, st))
+        # ---- CONTROL GATE: absolute invariants, baseline or not --------
+        ct = cur.get("control")
+        if isinstance(ct, dict):
+            control_compared += 1
+            regressions.extend(_control_gate(name, ct))
         baseline = None
         baseline_round = None
         for rec in reversed(trajectory):
@@ -294,6 +316,7 @@ def compare_against_trajectory(
             "recovery_compared": recovery_compared,
             "skew_compared": skew_compared,
             "straggler_compared": straggler_compared,
+            "control_compared": control_compared,
             "no_baseline": no_baseline,
             "tolerance": tolerance, "platform": platform}
 
@@ -332,6 +355,59 @@ def _skew_gate(name: str, sk: Dict[str, Any]) -> List[Dict[str, Any]]:
     if not sk.get("cleared"):
         fail("cleared", sk.get("cleared"),
              "TPU_MESH_SKEW did not clear after the fault was removed")
+    return out
+
+
+def _control_gate(name: str,
+                  ct: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The control-plane workload's absolute invariants as regression
+    entries (change=None — a control plane that fails to converge, or
+    moves when disabled, either holds its contract or it does not)."""
+    out: List[Dict[str, Any]] = []
+
+    def fail(key: str, value, why: str) -> None:
+        out.append({"name": f"{name}.control.{key}",
+                    "unit": "invariant", "value": value,
+                    "baseline": why, "baseline_round": None,
+                    "change": None})
+
+    budget = int(ct.get("tick_budget") or 0)
+    if int(ct.get("disabled_moves") or 0) != 0:
+        fail("disabled_moves", ct.get("disabled_moves"),
+             "the disabled-controller twin actuated a knob — "
+             "mgr_control_enable no longer gates actuation")
+    if not ct.get("byte_exact"):
+        fail("byte_exact", ct.get("byte_exact"),
+             "client ops diverged while the controller ran — the "
+             "control plane touched the data path")
+    for scen, block in sorted((ct.get("scenarios") or {}).items()):
+        if not isinstance(block, dict):
+            fail(scen, block, "scenario block missing")
+            continue
+        if not block.get("raised"):
+            fail(f"{scen}.raised", block.get("raised"),
+                 "the scenario never raised its SLO/health pressure "
+                 "— the episode is vacuous")
+        if int(block.get("moves") or 0) <= 0:
+            fail(f"{scen}.moves", block.get("moves"),
+                 "the controller never moved a knob under sustained "
+                 "pressure")
+        conv = int(block.get("converge_ticks") or -1)
+        if not block.get("cleared") or conv <= 0 or conv > budget:
+            fail(f"{scen}.converge_ticks", conv,
+                 f"the episode did not clear back to baseline within "
+                 f"{budget} mgr ticks of the pressure ending")
+        if not block.get("in_bounds"):
+            fail(f"{scen}.in_bounds", block.get("in_bounds"),
+                 "a pressure-driven move landed outside its knob's "
+                 "floor/ceiling corridor")
+    if "admission" in (ct.get("scenarios") or {}):
+        adm = ct["scenarios"]["admission"]
+        if isinstance(adm, dict) and not adm.get("abuser_correct"):
+            fail("admission.abuser_correct",
+                 adm.get("abuser_correct"),
+                 "the controller tightened a lane other than the "
+                 "flooding client's")
     return out
 
 

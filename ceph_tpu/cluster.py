@@ -416,6 +416,40 @@ class MiniCluster:
                           {"reset": True})[1],
             "drop the telemetry rings and SLO streaks (per-daemon "
             "histograms/counters untouched)")
+        from .control import control_perf_counters
+        self.perf_collection.add(control_perf_counters())
+        asok.register(
+            "tpu control dump",
+            lambda c, a: self.mgr.control.dump(),
+            "control-plane pane: enable/bounds/cooldown state per "
+            "knob, the active abuser, and the actuation ledger")
+
+        def _control_enable(c, a, value):
+            from .common.config import g_conf
+            g_conf.set_checked("mgr_control_enable", value)
+            if not value:
+                # a disable must tear the episode down NOW, not at
+                # the next tick (no half-applied knob survives)
+                self.mgr.control.teardown(
+                    self.mgr, reason="control disable")
+            return {"enabled": value}
+
+        asok.register(
+            "control enable",
+            lambda c, a: _control_enable(c, a, True),
+            "turn the mgr feedback controller on "
+            "(mgr_control_enable = true)")
+        asok.register(
+            "control disable",
+            lambda c, a: _control_enable(c, a, False),
+            "turn the controller off and restore every engaged knob "
+            "to its episode baseline immediately")
+        asok.register(
+            "control reset",
+            lambda c, a: {"reset": True,
+                          "restored": self.mgr.control.reset(self.mgr)},
+            "tear down any episode, then drop the ledger, tick count "
+            "and sense caches")
         asok.register(
             "arch probe",
             lambda c, a: __import__("ceph_tpu.arch", fromlist=["probe"])

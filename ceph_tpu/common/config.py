@@ -243,6 +243,15 @@ def build_options() -> List[Option]:
                          "'entity:res:weight:limit[,entity:...]' — "
                          "entities not listed use the "
                          "osd_mclock_client_* defaults"),
+        Option("osd_mclock_class_overrides", OPT_STR).set_default("")
+        .set_description("class-tier dmClock tag overrides: "
+                         "'class:res:weight:limit[,class:...]' over "
+                         "the op classes (client, recovery, scrub, "
+                         "snaptrim) — layered over the constructor "
+                         "tags at every arbitration, so injectargs "
+                         "re-weights a running queue (docs/QOS.md; "
+                         "the control plane's recovery-vs-client "
+                         "actuator)"),
         Option("osd_op_queue_admission_max", OPT_INT).set_default(0)
         .set_description("op-queue depth at which client-op intake "
                          "sheds load: new client ops are answered "
@@ -316,6 +325,40 @@ def build_options() -> List[Option]:
                          "(rejections per second of the cluster "
                          "clock); breaching raises TPU_SLO_ADMISSION."
                          "  0 = disabled"),
+        Option("mgr_control_enable", OPT_BOOL).set_default(False)
+        .set_description("master enable for the mgr's damped SLO "
+                         "feedback controller (docs/CONTROL.md); off "
+                         "= today's observe-only mgr by construction "
+                         "— the controller never senses, moves, or "
+                         "logs"),
+        Option("mgr_control_bounds", OPT_STR).set_default("")
+        .set_description("operator floors/ceilings per controlled "
+                         "knob, 'knob:floor:ceiling[,knob:...]' — "
+                         "layered over the built-in bounds; the "
+                         "controller never steps a knob outside "
+                         "[floor, ceiling] (docs/CONTROL.md)"),
+        Option("mgr_control_cooldown_ticks", OPT_INT).set_default(2)
+        .set_description("mgr ticks a knob rests after an actuation "
+                         "before the controller may step it again — "
+                         "one bounded step per cooldown window makes "
+                         "oscillation structurally impossible"),
+        Option("mgr_control_damping", OPT_FLOAT).set_default(0.5)
+        .set_description("geometric step damping: each successive "
+                         "same-direction move on a knob scales its "
+                         "step by this factor (0 < d <= 1), so a "
+                         "persistent breach converges instead of "
+                         "slamming between bounds"),
+        Option("mgr_control_ledger_size", OPT_INT).set_default(128)
+        .set_description("actuation-ledger ring size ('tpu control "
+                         "dump'): every move keeps knob, from/to, "
+                         "reflex and reason until overwritten"),
+        Option("mgr_control_actuate_retries", OPT_INT).set_default(2)
+        .set_description("bounded re-attempts of one actuation within "
+                         "a tick when the config injection fails "
+                         "(fault site control.actuate); past the "
+                         "budget the move is dropped and retried "
+                         "whole next tick — the controller never "
+                         "wedges"),
         Option("tracing_kernels", OPT_BOOL).set_default(False)
         .set_description("time every device kernel dispatch (adds a "
                          "sync per call; diagnosis only)"),

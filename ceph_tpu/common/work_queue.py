@@ -370,23 +370,67 @@ class ClientDmClock:
         }
 
 
-class MClockQueue:
+class _LiveClassTags:
+    """Live ``osd_mclock_class_overrides`` overlay for the class-tier
+    queues: the constructor tags are kept as a base, and every
+    arbitration entry point re-checks the option string (cached-source
+    idiom, like ``ClientDmClock._refresh_tag_sources``) so injectargs
+    re-weights a RUNNING queue without daemon restart — the controller
+    plane's recovery-vs-client actuator depends on this.  Classes not
+    present in the base tag set are ignored (an override cannot invent
+    an op class), malformed entries fall through to the base tags."""
+
+    def _init_live_tags(self, tags: Optional[Dict[str, Tuple[
+            float, float, float]]]) -> None:
+        self._base_tags = dict(tags or DEFAULT_TAGS)
+        self.tags = dict(self._base_tags)
+        self._class_src: Optional[str] = None
+        # apply the overlay NOW so an unchanged option string never
+        # rebuilds self.tags later — direct pokes at a live queue's
+        # tags (the test idiom) survive until the option changes
+        self._refresh_class_tags()
+
+    def _refresh_class_tags(self) -> None:
+        from .config import g_conf
+        src = str(g_conf.get_val("osd_mclock_class_overrides") or "")
+        if src == self._class_src:
+            return
+        self._class_src = src
+        merged = dict(self._base_tags)
+        for part in src.replace(";", ",").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.rsplit(":", 3)
+            if len(bits) != 4 or bits[0] not in merged:
+                continue     # malformed / unknown class: base tags
+            try:
+                merged[bits[0]] = (float(bits[1]), float(bits[2]),
+                                   float(bits[3]))
+            except ValueError:
+                continue
+        self.tags = merged
+
+
+class MClockQueue(_LiveClassTags):
     """dmclock-lite over a virtual clock that advances one unit per
     dequeue (deterministic; no wall time in the decision path).
 
     The arbitration is :class:`DmClockArbiter` over op-class entity
     keys with ``self.tags`` as the tag lookup — the SAME core the
     per-client lanes inside each class run, so the two tiers cannot
-    drift apart."""
+    drift apart.  ``self.tags`` is the constructor base overlaid live
+    with ``osd_mclock_class_overrides`` (:class:`_LiveClassTags`)."""
 
     def __init__(self, tags: Optional[Dict[str, Tuple[float, float,
                                                       float]]] = None):
-        self.tags = dict(tags or DEFAULT_TAGS)
+        self._init_live_tags(tags)
         self._queues: Dict[str, ClientDmClock] = {}
         self._arb = DmClockArbiter(track_floor=False)
         self._size = 0
 
     def enqueue(self, op_class: str, item, client: str = "") -> None:
+        self._refresh_class_tags()
         if op_class not in self.tags:
             op_class = CLASS_CLIENT
         q = self._queues.get(op_class)
@@ -409,6 +453,7 @@ class MClockQueue:
     def dequeue(self):
         """Pop the QoS-chosen item; None when empty."""
         self._arb.tick()
+        self._refresh_class_tags()
         candidates = [c for c, q in self._queues.items() if q]
         if not candidates:
             return None
@@ -441,7 +486,7 @@ class MClockQueue:
         return self._arb.at_limit(c, self.tags[c][2])
 
 
-class WallMClockQueue:
+class WallMClockQueue(_LiveClassTags):
     """dmclock against WALL time — a real rate enforcer, not just an
     ordering arbiter (src/dmclock dmc::PriorityQueue semantics).
 
@@ -469,7 +514,7 @@ class WallMClockQueue:
                                                       float]]] = None,
                  clock: Optional[Callable[[], float]] = None):
         import time as _time
-        self.tags = dict(tags or DEFAULT_TAGS)
+        self._init_live_tags(tags)
         self.clock = clock or _time.monotonic
         self._queues: Dict[str, ClientDmClock] = {}
         self._r_next: Dict[str, float] = {}   # next reservation due
@@ -479,6 +524,7 @@ class WallMClockQueue:
         self._size = 0
 
     def enqueue(self, op_class: str, item, client: str = "") -> None:
+        self._refresh_class_tags()
         if op_class not in self.tags:
             op_class = CLASS_CLIENT
         q = self._queues.get(op_class)
@@ -512,6 +558,7 @@ class WallMClockQueue:
         """-> (item, 0.0) or (None, next_due_time); next_due is 0.0
         when the queue is empty."""
         now = self.clock() if now is None else now
+        self._refresh_class_tags()
         candidates = [c for c, q in self._queues.items() if q]
         if not candidates:
             return None, 0.0

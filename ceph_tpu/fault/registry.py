@@ -64,6 +64,12 @@ ERROR_KINDS = {"device": InjectedDeviceError,
 # One place so `fault list` enumerates every site the build understands
 # (docs/ROBUSTNESS.md mirrors this table).
 SITE_CATALOG: Dict[str, str] = {
+    "control.actuate":
+        "mgr control-plane config injection (ceph_tpu/control): a "
+        "firing fails ONE knob actuation; the controller retries "
+        "mgr_control_actuate_retries times within the tick, then "
+        "drops the move and re-derives it next tick — context is "
+        "'<knob>=<value> (<option>)' for match= scoping",
     "device.encode_batch":
         "batched EC encode device call (matrix_plugin.encode_batch)",
     "device.decode_batch":

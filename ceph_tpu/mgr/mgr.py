@@ -58,6 +58,11 @@ class Manager(Dispatcher):
         from .telemetry import Telemetry
         self.telemetry = Telemetry()
         self.telemetry.collect(0.0)
+        # damped SLO feedback controller (ceph_tpu/control,
+        # docs/CONTROL.md): steps after telemetry each tick; with
+        # mgr_control_enable off (default) it returns before sensing
+        from ..control import Controller
+        self.control = Controller()
         for m in (all_mons if all_mons is not None else [self.mon]):
             m.subscribe(name)
         self.mon.send_full_map(name)
@@ -138,6 +143,11 @@ class Manager(Dispatcher):
         # (the fence-count test in tests/test_observability.py covers
         # this tick)
         self.telemetry.tick(self, now)
+        # the control plane closes the loop on the streak state the
+        # telemetry tick just refreshed: at most ONE bounded knob step
+        # per tick (no-op unless mgr_control_enable)
+        self.control.step(self, now if now is not None
+                          else self.telemetry._last_eval_t)
 
     # ---- codec degradation (circuit-breaker board -> health) ---------------
     def check_degraded_codecs(self) -> None:
@@ -433,6 +443,13 @@ class Manager(Dispatcher):
         # snapshot function `telemetry dump` and `tpu status` serve
         # (telemetry.rollup), so the scrape surfaces cannot drift
         lines.extend(self._render_cluster_rollup(self.telemetry))
+        # control-plane rollup: total actuations this mgr has applied
+        # (the per-kind breakdown rides ceph_daemon_control_*)
+        lines.append("# HELP ceph_cluster_control_moves knob "
+                     "actuations applied by the mgr control plane")
+        lines.append("# TYPE ceph_cluster_control_moves gauge")
+        lines.append(f"ceph_cluster_control_moves "
+                     f"{self.control.moves_total}")
         if perf_collection is not None:
             dump = perf_collection.dump()
             for logger, counters in sorted(dump.items()):

@@ -1,0 +1,103 @@
+Control-plane admin CLI (ceph_tpu/control): the `tpu control dump`
+pane is the operator's one-stop actuation ledger — enable state, per-
+knob bounds/baseline/damping, and the move history — plus the
+enable/disable/reset verbs.  A fresh mgr is observe-only by
+construction (`mgr_control_enable` defaults off): enabled false, zero
+moves, an empty ledger.
+
+  $ python -c "from ceph_tpu.cluster import MiniCluster; MiniCluster(n_osds=2).checkpoint('ck')"
+
+  $ ceph --cluster ck daemon osd.0 tpu control dump
+  {
+    "abuser": "",
+    "enabled": false,
+    "knobs": {
+      "client_lane_limit": {
+        "baseline": null,
+        "ceiling": 500.0,
+        "cooldown": 0,
+        "floor": 20.0,
+        "moves": 0,
+        "step_scale": 1.0,
+        "value": null
+      },
+      "client_lane_weight": {
+        "baseline": null,
+        "ceiling": 100.0,
+        "cooldown": 0,
+        "floor": 0.05,
+        "moves": 0,
+        "step_scale": 1.0,
+        "value": null
+      },
+      "ec_mesh_rateless_tasks": {
+        "baseline": null,
+        "ceiling": null,
+        "cooldown": 0,
+        "floor": null,
+        "moves": 0,
+        "step_scale": 1.0,
+        "value": null
+      },
+      "osd_op_queue_admission_max": {
+        "baseline": null,
+        "ceiling": 4096,
+        "cooldown": 0,
+        "floor": 8,
+        "moves": 0,
+        "step_scale": 1.0,
+        "value": 0.0
+      },
+      "osd_recovery_max_active": {
+        "baseline": null,
+        "ceiling": 64,
+        "cooldown": 0,
+        "floor": 1,
+        "moves": 0,
+        "step_scale": 1.0,
+        "value": 8.0
+      },
+      "recovery_class_weight": {
+        "baseline": null,
+        "ceiling": 400.0,
+        "cooldown": 0,
+        "floor": 10.0,
+        "moves": 0,
+        "step_scale": 1.0,
+        "value": 100.0
+      }
+    },
+    "ledger": [],
+    "moves_total": 0,
+    "options": {
+      "actuate_retries": 2,
+      "bounds": "",
+      "cooldown_ticks": 2,
+      "damping": 0.5,
+      "ledger_size": 128
+    },
+    "tick": 0
+  }
+
+`control enable` flips the master switch live (injectargs semantics —
+the next mgr tick starts sensing); `control disable` also tears down
+any open episode, restoring every engaged knob to its recorded
+baseline before the controller goes quiet.
+
+  $ ceph --cluster ck daemon osd.0 control enable
+  {
+    "enabled": true
+  }
+  $ ceph --cluster ck daemon osd.0 control disable
+  {
+    "enabled": false
+  }
+
+`control reset` is disable plus amnesia: baselines restored, ledger
+and streak state cleared ("restored" counts the knobs walked back).
+
+  $ ceph --cluster ck daemon osd.0 control reset
+  {
+    "reset": true,
+    "restored": 0
+  }

@@ -8,6 +8,7 @@ site catalog, arming a trigger, the unknown-site refusal, and clearing.
   {
     "armed": {},
     "sites": {
+      "control.actuate": "mgr control-plane config injection (ceph_tpu/control): a firing fails ONE knob actuation; the controller retries mgr_control_actuate_retries times within the tick, then drops the move and re-derives it next tick \u2014 context is '<knob>=<value> (<option>)' for match= scoping",
       "device.decode_batch": "batched EC decode/reconstruct device call (matrix_plugin.decode_batch)",
       "device.encode_batch": "batched EC encode device call (matrix_plugin.encode_batch)",
       "device.encode_chunks": "per-stripe encode device call (matrix_plugin.encode_chunks)",
@@ -81,6 +82,28 @@ one chip and count= bounds how many flushes lose it.
       "seed": null
     },
     "site": "mesh.chip_fail"
+  }
+
+The control-plane actuation site (ceph_tpu/control): a firing fails one
+mgr knob injection; the controller's retry budget is
+mgr_control_actuate_retries per tick, then the move re-derives next
+tick (tests/test_control.py proves it never wedges).
+
+  $ ceph --cluster ck daemon osd.0 fault inject name=control.actuate mode=nth n=2
+  {
+    "armed": {
+      "checks": 0,
+      "count": 0,
+      "delay_us": 0,
+      "error": "device",
+      "fires": 0,
+      "match": "",
+      "mode": "nth",
+      "n": 2,
+      "p": 1.0,
+      "seed": null
+    },
+    "site": "control.actuate"
   }
 
   $ ceph --cluster ck daemon osd.0 fault inject name=bogus.site

@@ -72,6 +72,19 @@ def test_mesh_skew_cli_cram(tmp_path):
     assert_cram(path, str(tmp_path))
 
 
+def test_control_cli_cram(tmp_path):
+    """`ceph daemon <who> tpu control dump` and the
+    enable/disable/reset verbs replayed from a recorded transcript
+    (tests/cli/control.t): the observe-only default pane of a restored
+    cluster (knob bounds, option defaults, empty ledger pinned) —
+    through the same `ceph` shim as fault.t (the populated ledger and
+    the closed-loop episodes are covered in-process by
+    tests/test_control.py and tests/test_control_loop.py)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cli", "control.t")
+    assert_cram(path, str(tmp_path))
+
+
 def test_status_cli_cram(tmp_path):
     """`ceph daemon <who> tpu status` + `telemetry dump|reset`
     replayed from a recorded transcript (tests/cli/status.t): the

@@ -357,6 +357,69 @@ def test_gate_straggler_invariants(tmp_path):
             (over, names)
 
 
+def test_gate_control_invariants(tmp_path):
+    """The CONTROL GATE is absolute (no baseline needed): a scenario
+    that never raised, never moved, failed to converge inside the
+    tick budget, moved outside its bounds corridor, a mis-identified
+    abuser, a byte divergence, or ANY move from the disabled twin
+    each fail the gate on their own."""
+    def scenario(**over):
+        s = {"raised": True, "moves": 4, "cleared": True,
+             "converge_ticks": 6, "in_bounds": True}
+        s.update(over)
+        return s
+
+    def control_metric(scen_over=None, **over):
+        m = _metric("slo_autotune", 6.0, unit="ticks")
+        ct = {"disabled_moves": 0, "byte_exact": True,
+              "tick_budget": 80,
+              "scenarios": {
+                  "admission": scenario(abuser_correct=True),
+                  "recovery": scenario(),
+                  "straggler": scenario()}}
+        ct.update(over)
+        if scen_over:
+            which, so = scen_over
+            ct["scenarios"][which] = dict(ct["scenarios"][which],
+                                          **so)
+        m["control"] = ct
+        return m
+
+    # a clean run gates clean — with or without any baseline round
+    out = regress.compare_against_trajectory([control_metric()], [],
+                                             "cpu")
+    assert out["control_compared"] == 1 and not out["regressions"]
+    top_cases = (
+        ({"disabled_moves": 1}, "disabled_moves"),
+        ({"byte_exact": False}, "byte_exact"),
+    )
+    for over, key in top_cases:
+        out = regress.compare_against_trajectory(
+            [control_metric(**over)], [], "cpu")
+        names = {r["name"] for r in out["regressions"]}
+        assert f"slo_autotune.control.{key}" in names, (over, names)
+    scen_cases = (
+        ({"raised": False}, "raised"),
+        ({"moves": 0}, "moves"),
+        ({"cleared": False, "converge_ticks": -1}, "converge_ticks"),
+        ({"converge_ticks": 81}, "converge_ticks"),
+        ({"in_bounds": False}, "in_bounds"),
+    )
+    for over, key in scen_cases:
+        for which in ("admission", "recovery", "straggler"):
+            out = regress.compare_against_trajectory(
+                [control_metric(scen_over=(which, over))], [], "cpu")
+            names = {r["name"] for r in out["regressions"]}
+            assert f"slo_autotune.control.{which}.{key}" in names, \
+                (which, over, names)
+    out = regress.compare_against_trajectory(
+        [control_metric(scen_over=("admission",
+                                   {"abuser_correct": False}))],
+        [], "cpu")
+    names = {r["name"] for r in out["regressions"]}
+    assert "slo_autotune.control.admission.abuser_correct" in names
+
+
 def test_gate_within_tolerance_passes(tmp_path):
     _write_round(tmp_path, 6, "cpu", [_metric("enc", 10.0)])
     traj = regress.load_trajectory(str(tmp_path))

@@ -1,0 +1,342 @@
+"""Controller state machine (ceph_tpu/control, docs/CONTROL.md):
+damping, bounds, anti-windup, cooldowns, episode restore/tear-down,
+fault-bounded actuation, and the controller-off twin property.
+
+The closed-loop scenarios (abusive client / recovery storm / slowed
+chip) converging on a REAL MiniCluster are in
+tests/test_control_loop.py; these tests drive the state machine
+through a minimal fake mgr so every transition is pinned exactly.
+"""
+from typing import Dict, List, Optional, Tuple
+
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.control import Controller, control_perf_counters
+from ceph_tpu.control.controller import _parse_bounds
+
+
+class FakeTelemetry:
+    def __init__(self):
+        self.slo: Dict[str, Dict] = {}
+
+    def slo_state(self):
+        return self.slo
+
+
+class FakeMgr:
+    """The two surfaces Controller.step senses: telemetry SLO streak
+    state and the health-check map (plus the cluster log sink)."""
+
+    def __init__(self):
+        self.telemetry = FakeTelemetry()
+        self.health_checks: Dict[str, Dict] = {}
+        self.log: List[Tuple[str, str]] = []
+
+    def _cluster_log(self, lvl, msg):
+        self.log.append((lvl, msg))
+
+    def breach(self, check: str):
+        self.telemetry.slo = {check: {"state": "breach"}}
+
+    def clear(self):
+        self.telemetry.slo = {}
+
+
+CONTROL_OPTS = ("mgr_control_enable", "mgr_control_bounds",
+                "mgr_control_cooldown_ticks", "mgr_control_damping",
+                "mgr_control_actuate_retries", "mgr_control_ledger_size")
+ACTUATED_OPTS = ("osd_recovery_max_active", "osd_mclock_class_overrides",
+                 "osd_mclock_client_overrides",
+                 "osd_op_queue_admission_max", "ec_mesh_rateless_tasks")
+
+
+@pytest.fixture()
+def env():
+    """Fresh controller + fake mgr; every option either side touches
+    is restored afterwards (the options are process-global)."""
+    saved = {n: g_conf.get_val(n)
+             for n in CONTROL_OPTS + ACTUATED_OPTS}
+    from ceph_tpu.recovery import (l_recovery_active,
+                                   recovery_perf_counters)
+    try:
+        yield Controller(), FakeMgr()
+    finally:
+        for n, v in saved.items():
+            g_conf.set_val(n, v)
+        recovery_perf_counters().set(l_recovery_active, 0)
+        from ceph_tpu.fault import g_faults
+        g_faults.clear("control.actuate")
+
+
+def _storm_on():
+    from ceph_tpu.recovery import (l_recovery_active,
+                                   recovery_perf_counters)
+    recovery_perf_counters().set(l_recovery_active, 1)
+
+
+def _storm_off():
+    from ceph_tpu.recovery import (l_recovery_active,
+                                   recovery_perf_counters)
+    recovery_perf_counters().set(l_recovery_active, 0)
+
+
+def test_disabled_controller_is_inert(env):
+    """mgr_control_enable off (the default): step() returns before
+    sensing — no tick counts, no moves, no config deltas, no log."""
+    ctl, mgr = env
+    mgr.breach("TPU_SLO_OPLAT")
+    _storm_on()
+    before = dict(g_conf.values)
+    for _ in range(10):
+        ctl.step(mgr, 1.0)
+    assert ctl._tick == 0
+    assert ctl.dump()["ledger"] == []
+    assert ctl.moves_total == 0
+    assert dict(g_conf.values) == before
+    assert mgr.log == []
+
+
+def test_recovery_reflex_steps_down_damped_and_bounded(env):
+    """A sustained TPU_SLO_OPLAT breach during a storm walks
+    osd_recovery_max_active down in shrinking steps, one move per
+    cooldown window, and pins at the floor without further ledger
+    growth (anti-windup)."""
+    ctl, mgr = env
+    g_conf.set_val("mgr_control_enable", True)
+    g_conf.set_val("mgr_control_cooldown_ticks", 2)
+    g_conf.set_val("osd_recovery_max_active", 8)
+    mgr.breach("TPU_SLO_OPLAT")
+    _storm_on()
+    values = [8]
+    for _ in range(40):
+        ctl.step(mgr, 1.0)
+        values.append(int(g_conf.get_val("osd_recovery_max_active")))
+    # one move per cooldown window: at most one change per
+    # mgr_control_cooldown_ticks ticks
+    changes = [i for i in range(1, len(values))
+               if values[i] != values[i - 1]]
+    assert all(b - a >= 2 for a, b in zip(changes, changes[1:])), \
+        (changes, values)
+    # damped: 8 -> 4 (step 4), then shrinking steps, never below floor
+    steps = [values[i - 1] - values[i] for i in changes]
+    assert steps[0] == 4
+    assert all(a >= b for a, b in zip(steps, steps[1:])), steps
+    assert min(values) >= 1
+    assert values[-1] == 1            # floor reached, held
+    # anti-windup: once pinned at the floor the ledger stops growing
+    moves_at_floor = [e for e in ctl.dump()["ledger"]
+                      if e["knob"] == "osd_recovery_max_active"
+                      and e["to"] == 1]
+    assert len(moves_at_floor) == 1
+    assert control_perf_counters().get(94005) > 0   # pinned counter
+    # every ledger entry stayed inside [floor, ceiling]
+    for e in ctl.dump()["ledger"]:
+        assert 1 <= e["to"] <= 64, e
+    # second knob engaged after the first pinned: recovery weight down
+    assert ctl.dump()["knobs"]["recovery_class_weight"]["value"] < 100.0
+
+
+def test_restore_walks_back_to_baseline_and_closes_episode(env):
+    """When the breach clears, engaged knobs converge back to their
+    episode baselines and the episode state empties."""
+    ctl, mgr = env
+    g_conf.set_val("mgr_control_enable", True)
+    g_conf.set_val("mgr_control_cooldown_ticks", 0)
+    g_conf.set_val("osd_recovery_max_active", 8)
+    mgr.breach("TPU_SLO_OPLAT")
+    _storm_on()
+    for _ in range(6):
+        ctl.step(mgr, 1.0)
+    assert int(g_conf.get_val("osd_recovery_max_active")) < 8
+    mgr.clear()
+    _storm_off()
+    for _ in range(30):
+        ctl.step(mgr, 1.0)
+    assert int(g_conf.get_val("osd_recovery_max_active")) == 8
+    d = ctl.dump()
+    assert all(k["baseline"] is None for k in d["knobs"].values()), d
+    assert any(e["reflex"] == "restore" for e in d["ledger"])
+    # hysteretic: restored value holds over further clean ticks
+    for _ in range(5):
+        ctl.step(mgr, 1.0)
+    assert int(g_conf.get_val("osd_recovery_max_active")) == 8
+
+
+def test_operator_bounds_clamp_every_move(env):
+    """mgr_control_bounds floors override the built-ins and the
+    controller never steps past them."""
+    ctl, mgr = env
+    g_conf.set_val("mgr_control_enable", True)
+    g_conf.set_val("mgr_control_cooldown_ticks", 0)
+    g_conf.set_val("mgr_control_bounds",
+                   "osd_recovery_max_active:4:32")
+    g_conf.set_val("osd_recovery_max_active", 8)
+    mgr.breach("TPU_SLO_OPLAT")
+    _storm_on()
+    for _ in range(20):
+        ctl.step(mgr, 1.0)
+    assert int(g_conf.get_val("osd_recovery_max_active")) == 4
+    assert all(e["to"] >= 4 for e in ctl.dump()["ledger"]
+               if e["knob"] == "osd_recovery_max_active")
+
+
+def test_bounds_parser_tolerates_garbage():
+    assert _parse_bounds("") == {}
+    assert _parse_bounds("bogus_knob:1:2") == {}
+    assert _parse_bounds("osd_recovery_max_active:nope:2") == {}
+    assert _parse_bounds("osd_recovery_max_active:2:") == \
+        {"osd_recovery_max_active": (2.0, None)}
+    assert _parse_bounds(
+        "osd_recovery_max_active:2:32,client_lane_weight::10") == \
+        {"osd_recovery_max_active": (2.0, 32.0),
+         "client_lane_weight": (None, 10.0)}
+
+
+def test_disable_mid_episode_tears_down(env):
+    """Flipping mgr_control_enable off mid-episode restores every
+    engaged knob to its baseline on the NEXT step and leaves no
+    half-applied state."""
+    ctl, mgr = env
+    g_conf.set_val("mgr_control_enable", True)
+    g_conf.set_val("mgr_control_cooldown_ticks", 0)
+    g_conf.set_val("osd_recovery_max_active", 8)
+    mgr.breach("TPU_SLO_OPLAT")
+    _storm_on()
+    for _ in range(8):
+        ctl.step(mgr, 1.0)
+    assert int(g_conf.get_val("osd_recovery_max_active")) < 8
+    engaged = sum(1 for k in ctl.dump()["knobs"].values()
+                  if k["baseline"] is not None)
+    assert engaged >= 1
+    g_conf.set_val("mgr_control_enable", False)
+    ctl.step(mgr, 1.0)                # the disable lands here
+    assert int(g_conf.get_val("osd_recovery_max_active")) == 8
+    d = ctl.dump()
+    assert all(k["baseline"] is None for k in d["knobs"].values())
+    assert any(e["reflex"] == "teardown" for e in d["ledger"])
+    # and the controller is inert again: breach on, zero new moves
+    moves = ctl.moves_total
+    for _ in range(5):
+        ctl.step(mgr, 1.0)
+    assert ctl.moves_total == moves
+
+
+def test_faulted_actuation_bounded_retry_never_wedges(env):
+    """control.actuate armed always: every actuation fails, the
+    retry budget bounds the attempts per tick, the knob never moves,
+    and clearing the fault lets the very next move land."""
+    from ceph_tpu.fault import g_faults
+    ctl, mgr = env
+    g_conf.set_val("mgr_control_enable", True)
+    g_conf.set_val("mgr_control_cooldown_ticks", 0)
+    g_conf.set_val("mgr_control_actuate_retries", 2)
+    g_conf.set_val("osd_recovery_max_active", 8)
+    mgr.breach("TPU_SLO_OPLAT")
+    _storm_on()
+    g_faults.inject("control.actuate", mode="always")
+    pc = control_perf_counters()
+    f0, r0 = pc.get(94007), pc.get(94006)
+    for _ in range(4):
+        ctl.step(mgr, 1.0)
+    assert int(g_conf.get_val("osd_recovery_max_active")) == 8
+    assert ctl.moves_total == 0
+    assert ctl.dump()["ledger"] == []
+    # bounded: exactly retries attempts per tick, then the drop
+    assert pc.get(94007) - f0 == 4              # one drop per tick
+    assert pc.get(94006) - r0 == 4 * 2          # retries per tick
+    assert any("actuation dropped" in m for _l, m in mgr.log)
+    g_faults.clear("control.actuate")
+    ctl.step(mgr, 1.0)
+    assert int(g_conf.get_val("osd_recovery_max_active")) == 4
+    assert ctl.moves_total == 1
+
+
+def test_admission_reflex_targets_the_abuser_lane(env):
+    """TPU_SLO_ADMISSION burning: the lane whose queue-wait histogram
+    grew most is the abuser; its dmClock weight steps down first, then
+    its limit cap imposes, all through osd_mclock_client_overrides."""
+    from ceph_tpu.trace import g_perf_histograms, latency_axes
+    ctl, mgr = env
+    g_conf.set_val("mgr_control_enable", True)
+    g_conf.set_val("mgr_control_cooldown_ticks", 0)
+    h = g_perf_histograms.get("client.ctlabuse",
+                              "client_queue_wait_latency_histogram",
+                              latency_axes)
+    mgr.breach("TPU_SLO_ADMISSION")
+    for i in range(14):
+        for _ in range(50):
+            h.inc(1000.0)
+        ctl.step(mgr, 1.0)
+    ov = str(g_conf.get_val("osd_mclock_client_overrides"))
+    assert "client.ctlabuse:" in ov, ov
+    d = ctl.dump()
+    assert d["abuser"] == "client.ctlabuse"
+    assert d["knobs"]["client_lane_weight"]["value"] < 1.0
+    assert d["knobs"]["client_lane_limit"]["value"] > 0   # cap imposed
+    # clear: the lane walks back to defaults and the abuser forgets
+    mgr.clear()
+    for _ in range(40):
+        ctl.step(mgr, 1.0)
+    d = ctl.dump()
+    assert d["abuser"] == ""
+    assert all(k["baseline"] is None for k in d["knobs"].values())
+
+
+def test_twin_cluster_controller_off_is_behavior_identical():
+    """Twin-cluster property: a cluster whose mgr steps a DISABLED
+    controller ends bit-identical (config, health, controller state)
+    to one whose mgr never calls step at all — the pre-PR mgr."""
+    from ceph_tpu.cluster import MiniCluster
+
+    def drive(strip_step: bool):
+        c = MiniCluster(n_osds=3)
+        if strip_step:
+            c.mgr.control.step = lambda *_a, **_k: None
+        c.create_replicated_pool("twin", size=2, pg_num=8)
+        cl = c.client("client.twin")
+        before = dict(g_conf.values)
+        for i in range(8):
+            assert cl.write_full("twin", f"o{i}",
+                                 bytes([i]) * 2048) == 0
+            c.tick(dt=1.0)
+        return (dict(g_conf.values) == before,
+                sorted(c.mgr.health_checks),
+                c.mgr.control.moves_total,
+                c.mgr.control._tick,
+                list(c.mgr.control._ledger))
+
+    with_step = drive(strip_step=False)
+    without_step = drive(strip_step=True)
+    assert with_step == without_step
+    assert with_step[0] is True       # no config delta either way
+    assert with_step[2] == 0 and with_step[3] == 0
+
+
+def test_control_asok_panes():
+    """`tpu control dump` + `control enable|disable|reset` round-trip
+    through the admin socket; disable mid-episode restores."""
+    from ceph_tpu.cluster import MiniCluster
+    saved = {n: g_conf.get_val(n)
+             for n in CONTROL_OPTS + ACTUATED_OPTS}
+    try:
+        c = MiniCluster(n_osds=3)
+        asok = c.admin_socket
+        assert asok.execute("tpu control dump")["enabled"] is False
+        assert asok.execute("control enable") == {"enabled": True}
+        assert bool(g_conf.get_val("mgr_control_enable")) is True
+        assert asok.execute("tpu control dump")["enabled"] is True
+        # open an episode by hand, then disable through the socket:
+        # the tear-down must land immediately
+        c.mgr.control._state("osd_recovery_max_active")["baseline"] \
+            = 8.0
+        g_conf.set_val("osd_recovery_max_active", 2)
+        assert asok.execute("control disable") == {"enabled": False}
+        assert int(g_conf.get_val("osd_recovery_max_active")) == 8
+        assert bool(g_conf.get_val("mgr_control_enable")) is False
+        out = asok.execute("control reset")
+        assert out == {"reset": True, "restored": 0}
+        assert asok.execute("tpu control dump")["ledger"] == []
+    finally:
+        for n, v in saved.items():
+            g_conf.set_val(n, v)

@@ -1,0 +1,151 @@
+"""Actuator liveness (control-plane satellite): every knob the
+controller drives must take effect on live config change — injectargs
+semantics, NO daemon restart.  One test per actuator, each flipping
+the option mid-flight and asserting the consuming path re-reads it.
+
+The one gap this PR closed: the class-tier mClock tags
+(CLASS_RECOVERY's weight among them) were frozen at queue
+construction; ``osd_mclock_class_overrides`` now overlays them live
+(work_queue._LiveClassTags).
+"""
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.common.work_queue import (CLASS_CLIENT, CLASS_RECOVERY,
+                                        DEFAULT_TAGS, MClockQueue,
+                                        WallMClockQueue)
+
+
+@pytest.fixture(autouse=True)
+def _restore_options():
+    opts = ("osd_mclock_class_overrides", "osd_mclock_client_overrides",
+            "osd_op_queue_admission_max", "osd_op_queue_batch_intake",
+            "ec_dispatch_batch_window_us", "osd_recovery_max_active",
+            "ec_mesh_rateless_tasks", "ec_mesh_rateless",
+            "osd_mclock_client_weight")
+    saved = {n: g_conf.get_val(n) for n in opts}
+    yield
+    for n, v in saved.items():
+        g_conf.set_val(n, v)
+
+
+def test_mclock_class_tags_live_virtual_queue():
+    """osd_mclock_class_overrides re-weights a CONSTRUCTED
+    MClockQueue: the recovery class's tags change between two
+    dequeues of the same queue instance."""
+    q = MClockQueue()
+    q.enqueue(CLASS_CLIENT, ("op", "c1"), client="client.a")
+    q.enqueue(CLASS_RECOVERY, ("op", "r1"))
+    q.enqueue(CLASS_RECOVERY, ("op", "r2"))
+    assert q.tags[CLASS_RECOVERY] == DEFAULT_TAGS[CLASS_RECOVERY]
+    g_conf.set_checked("osd_mclock_class_overrides",
+                       "recovery:0:1:50")
+    q.dequeue()
+    assert q.tags[CLASS_RECOVERY] == (0.0, 1.0, 50.0)
+    # removal restores the constructor base on the next arbitration
+    g_conf.rm_val("osd_mclock_class_overrides")
+    q.dequeue()
+    assert q.tags[CLASS_RECOVERY] == DEFAULT_TAGS[CLASS_RECOVERY]
+    # malformed entries and unknown classes fall through to base
+    g_conf.set_val("osd_mclock_class_overrides",
+                   "recovery:nope:1:1,ghostclass:1:1:1")
+    q.dequeue()
+    assert q.tags[CLASS_RECOVERY] == DEFAULT_TAGS[CLASS_RECOVERY]
+    assert "ghostclass" not in q.tags
+
+
+def test_mclock_class_tags_live_wall_queue():
+    """The wall-clock dmClock enforcer honors the same overlay: a
+    limit injected mid-run rate-blocks the class immediately."""
+    q = WallMClockQueue(clock=lambda: 0.0)
+    for i in range(4):
+        q.enqueue(CLASS_CLIENT, ("op", i), client="client.w")
+    # client class: no reservation/limit by default -> free dequeues
+    item, _ = q.dequeue(now=1.0)
+    assert item is not None
+    g_conf.set_checked("osd_mclock_class_overrides",
+                       "client:0:500:1")   # 1 op/s hard limit
+    item, _ = q.dequeue(now=1.001)
+    assert item is not None                # first limited slot
+    item, nxt = q.dequeue(now=1.002)
+    assert item is None and nxt > 1.002    # rate-blocked LIVE
+    item, _ = q.dequeue(now=3.0)
+    assert item is not None                # credit accrued
+
+
+def test_admission_max_live(monkeypatch):
+    """osd_op_queue_admission_max is read per intake (osd._admit_op):
+    lowering it over a standing queue sheds the NEXT client op, and
+    raising it re-admits — no OSD restart."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.msg.messages import MOSDOp
+    c = MiniCluster(n_osds=1)
+    c.create_replicated_pool("adm", size=1, pg_num=1)
+    osd = c.osds[0]
+    # park items in the op queue so depth is visible to admission
+    for i in range(4):
+        osd.op_wq.enqueue((0, 0), CLASS_CLIENT, ("noop", i),
+                          client="client.adm")
+    msg = MOSDOp(src="client.adm", tid=99, pool=0, oid="o",
+                 pgid=(0, 0))
+    sent = []
+    monkeypatch.setattr(osd.messenger, "send_message",
+                        lambda m, *a, **k: sent.append(m))
+    assert osd._admit_op(msg) is True      # default 0 = disabled
+    g_conf.set_checked("osd_op_queue_admission_max", 2)
+    assert osd._admit_op(msg) is False     # depth 4 >= 2: shed, live
+    assert sent and sent[-1].result != 0
+    g_conf.set_checked("osd_op_queue_admission_max", 4096)
+    # back under the cap AND under the depth-hysteresis low water, so
+    # the throttle window clears too
+    assert osd._admit_op(msg) is True
+
+
+def test_dispatch_batch_window_live():
+    """ec_dispatch_batch_window_us reaches DeviceDispatcher._opts on
+    every call — the coalescing window follows injectargs."""
+    from ceph_tpu.dispatch.scheduler import DeviceDispatcher
+    g_conf.set_val("ec_dispatch_batch_window_us", 0)
+    assert DeviceDispatcher._opts()[1] == 0
+    g_conf.set_checked("ec_dispatch_batch_window_us", 250_000)
+    assert DeviceDispatcher._opts()[1] == 250_000
+
+
+def test_recovery_max_active_live():
+    """osd_recovery_max_active reaches RecoveryScheduler._opts on
+    every pacing decision — the controller's storm throttle is live."""
+    from ceph_tpu.recovery.scheduler import RecoveryScheduler
+    g_conf.set_checked("osd_recovery_max_active", 2)
+    assert RecoveryScheduler._opts()[1] == 2
+    g_conf.set_checked("osd_recovery_max_active", 16)
+    assert RecoveryScheduler._opts()[1] == 16
+
+
+def test_rateless_tasks_live():
+    """ec_mesh_rateless_tasks is read per flush plan (rateless_opts)
+    — widening the coded-task count needs no restart."""
+    from ceph_tpu.mesh.rateless import rateless_opts
+    g_conf.set_checked("ec_mesh_rateless", True)
+    g_conf.set_checked("ec_mesh_rateless_tasks", 11)
+    assert rateless_opts() == (True, 11)
+    g_conf.set_checked("ec_mesh_rateless_tasks", 13)
+    assert rateless_opts() == (True, 13)
+
+
+def test_mclock_client_overrides_live():
+    """osd_mclock_client_* overrides re-resolve on the next
+    arbitration of a LIVE per-client lane (the cached-source idiom:
+    a changed string drops the resolved cache)."""
+    from ceph_tpu.common.work_queue import ClientDmClock
+    lane = ClientDmClock()
+    lane.push("client.a", ("op", 1))
+    lane.push("client.b", ("op", 2))
+    assert lane._tags_for("client.a")[1] == float(
+        g_conf.get_val("osd_mclock_client_weight"))
+    g_conf.set_checked("osd_mclock_client_overrides",
+                       "client.a:0:0.125:0")
+    lane.pop()                             # one arbitration refresh
+    assert lane._tags_for("client.a")[1] == 0.125
+    g_conf.set_checked("osd_mclock_client_weight", 7.0)
+    lane.pop()
+    assert lane._tags_for("client.b")[1] == 7.0

@@ -1,0 +1,241 @@
+"""Closed-loop tier-1 smokes (ceph_tpu/control): the three policy-map
+scenarios converge on a REAL MiniCluster with ZERO operator action —
+the mgr ticks, the controller senses the SLO streaks and moves the
+responsible knob, the pressure clears, the knobs restore.
+
+The state machine itself (damping, bounds, anti-windup, tear-down,
+fault-bounded actuation) is pinned in tests/test_control.py; the
+bench-gated version of these scenarios with convergence-tick receipts
+is the `slo_autotune` workload (bench/workloads.py + the CONTROL GATE
+in bench/regress.py).
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.dispatch import g_dispatcher
+from ceph_tpu.fault import g_faults
+from ceph_tpu.mesh import g_chipstat, g_mesh
+
+TOUCHED = (
+    "mgr_control_enable", "mgr_control_cooldown_ticks",
+    "mgr_control_bounds", "mgr_slo_admission_rate_max",
+    "mgr_slo_oplat_p99_usec", "mgr_slo_fast_window_s",
+    "mgr_slo_slow_window_s", "mgr_telemetry_retention",
+    "osd_op_queue_admission_max", "osd_op_queue_batch_intake",
+    "osd_mclock_client_overrides", "osd_mclock_class_overrides",
+    "osd_recovery_max_active", "ec_mesh_chips", "ec_mesh_rateless",
+    "ec_mesh_rateless_tasks", "ec_mesh_skew_sample_every",
+    "ec_mesh_skew_threshold", "ec_dispatch_batch_max",
+    "ec_dispatch_batch_window_us",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    saved = {n: g_conf.values.get(n) for n in TOUCHED}
+    yield
+    for n, v in saved.items():
+        if v is None:
+            g_conf.rm_val(n)
+        else:
+            g_conf.set_val(n, v)
+    g_faults.clear()
+    g_dispatcher.flush()
+    g_mesh.topology()
+    g_chipstat.reset()
+
+
+def _enable_controller():
+    g_conf.set_val("mgr_control_enable", True)
+    g_conf.set_val("mgr_control_cooldown_ticks", 1)
+
+
+def test_abusive_client_scenario_converges():
+    """An abusive open-loop client burns TPU_SLO_ADMISSION; the
+    controller de-weights exactly that client's dmClock lane (and caps
+    it), the burn clears once the flood is contained, and the lane
+    restores — zero operator action, ops byte-exact."""
+    from ceph_tpu.load.traffic import TrafficSpec, run_traffic
+    c = MiniCluster(n_osds=4)
+    c.create_replicated_pool("abuse", size=2, pg_num=8)
+    _enable_controller()
+    g_conf.set_val("mgr_slo_admission_rate_max", 0.001)
+    g_conf.set_val("mgr_slo_fast_window_s", 6.0)
+    g_conf.set_val("mgr_slo_slow_window_s", 12.0)
+    g_conf.set_val("osd_op_queue_admission_max", 4)
+    spec = TrafficSpec(pool="abuse", n_clients=4, ops_per_client=160,
+                       read_fraction=0.25, mode="open", rate=10.0,
+                       rate_multipliers=(6.0, 1.0, 1.0, 1.0),
+                       tick_every=1, seed=20260807,
+                       keep_completions=False)
+    res = run_traffic(c, spec)
+    assert res.byte_exact, res.errors[:3]
+    assert res.admission_rejections > 0
+    ctl = c.mgr.control
+    led = list(ctl._ledger)
+    assert any(e["reflex"] == "admission" for e in led), led
+    # the abuser the controller picked is the flooding client
+    tightens = [e for e in led if e["reflex"] == "admission"]
+    assert all("client.abuse.0" in e["reason"] for e in tightens), led
+    ov = str(g_conf.get_val("osd_mclock_client_overrides"))
+    assert "client.abuse.0:" in ov, ov
+    # every move stayed inside its knob's bounds
+    for e in led:
+        k = ctl.dump()["knobs"][e["knob"]]
+        assert k["floor"] <= e["to"] <= k["ceiling"], e
+    # ---- traffic over: the check clears, the episode restores -------
+    cleared_at = None
+    for i in range(60):
+        c.tick(dt=1.0)
+        d = ctl.dump()
+        if "TPU_SLO_ADMISSION" not in c.mgr.health_checks and \
+                all(k["baseline"] is None for k in d["knobs"].values()):
+            cleared_at = i
+            break
+    assert cleared_at is not None, ctl.dump()
+    assert ctl.dump()["abuser"] == ""
+    assert any(e["reflex"] == "restore" for e in ctl._ledger)
+
+
+def test_recovery_storm_scenario_converges():
+    """TPU_SLO_OPLAT burning while a recovery storm is in flight: the
+    controller steps osd_recovery_max_active down (client latency
+    wins), then restores it once the storm and the burn clear."""
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("storm", k=3, m=2, pg_num=4,
+                     plugin="regenerating", extra_profile={"d": "4"})
+    cl = c.client("client.storm")
+    payloads = {f"o{i}": bytes([i % 256]) * 6000 for i in range(40)}
+    for oid, body in payloads.items():
+        assert cl.write_full("storm", oid, body) == 0
+    _enable_controller()
+    g_conf.set_val("mgr_slo_oplat_p99_usec", "reply:1")
+    g_conf.set_val("mgr_slo_fast_window_s", 6.0)
+    g_conf.set_val("mgr_slo_slow_window_s", 12.0)
+    g_conf.set_val("mgr_telemetry_retention", 10_000)
+    base_active = int(g_conf.get_val("osd_recovery_max_active"))
+    ctl = c.mgr.control
+    # ---- phase 1: the burn sustains (client IO, no storm yet) -------
+    for i in range(6):
+        assert cl.write_full("storm", f"pre{i}", b"x" * 2000) == 0
+        c.tick(dt=1.0)
+    assert "TPU_SLO_OPLAT" in c.mgr.health_checks
+    assert ctl.moves_total == 0           # burn alone: no storm, no move
+    # ---- phase 2: an OSD dies mid-burn -> recovery storm ------------
+    pid = c.mon.osdmap.lookup_pg_pool_name("storm")
+    victim = next(pg.acting[-1] for pgid, pg in c.primary_pgs()
+                  if pgid[0] == pid and pg.backend is not None)
+    c.kill_osd(victim)
+    c.mark_osd_down(victim)
+    c.mark_osd_out(victim)
+    moved_at = None
+    for i in range(20):
+        # client IO rides THROUGH the storm (the oplat samples the
+        # SLO engine judges), the mgr ticking mid-run
+        assert cl.write_full("storm", f"live{i}", b"x" * 2000) == 0
+        c.tick(dt=1.0)
+        if moved_at is None and c.mgr.control.moves_total > 0:
+            moved_at = i
+    assert moved_at is not None, \
+        (c.mgr.health_checks, ctl.dump())
+    led = list(ctl._ledger)
+    assert any(e["reflex"] == "recovery"
+               and e["knob"] == "osd_recovery_max_active"
+               for e in led), led
+    assert int(g_conf.get_val("osd_recovery_max_active")) < base_active
+    # ---- quiesce: no samples -> burn clears -> restore --------------
+    done = None
+    for i in range(80):
+        c.tick(dt=1.0)
+        if "TPU_SLO_OPLAT" not in c.mgr.health_checks and \
+                int(g_conf.get_val("osd_recovery_max_active")) \
+                == base_active:
+            done = i
+            break
+    assert done is not None, ctl.dump()
+    # data survived the storm end to end
+    for oid, body in payloads.items():
+        assert cl.read("storm", oid) == body
+
+
+def test_straggler_scenario_widens_then_narrows():
+    """A slowed chip raises TPU_MESH_SKEW; the controller widens
+    ec_mesh_rateless_tasks (straggler protection buys tail latency).
+    With the fault gone and skew quiet, the wasted-block ratio of the
+    widened plan dominates and the controller narrows back — the
+    bandwidth-vs-tail dial self-tunes both ways."""
+    from ceph_tpu.ec.tpu_plugin import ErasureCodeTpu
+    from ceph_tpu.osd.ecutil import encode as eu_encode, stripe_info_t
+    g_conf.set_val("ec_mesh_chips", 8)
+    g_conf.set_val("ec_dispatch_batch_window_us", 10_000_000)
+    g_conf.set_val("ec_dispatch_batch_max", 64)
+    g_conf.set_val("ec_mesh_skew_sample_every", 1)
+    g_conf.set_val("ec_mesh_skew_threshold", 3.0)
+    g_conf.set_val("ec_mesh_rateless", True)
+    c = MiniCluster(n_osds=4)
+    _enable_controller()
+    impl = ErasureCodeTpu()
+    impl.init({"k": "4", "m": "2", "technique": "reed_sol_van"})
+    sinfo = stripe_info_t(4, 4 * 1024)
+    want = set(range(6))
+    rng = np.random.default_rng(20260807)
+
+    def flush():
+        payloads = [rng.integers(0, 256, size=2 * 4 * 4096,
+                                 dtype=np.uint8) for _ in range(3)]
+        oracles = [eu_encode(sinfo, impl, p, want) for p in payloads]
+        futs = [g_dispatcher.submit_encode(sinfo, impl, p, want)
+                for p in payloads]
+        g_dispatcher.flush()
+        for f, oracle in zip(futs, oracles):
+            res = f.result()
+            assert sorted(res) == sorted(oracle)
+            for i in oracle:
+                assert np.asarray(res[i]).tobytes() == \
+                    np.asarray(oracle[i]).tobytes()
+
+    flush()                                    # compile warmup
+    g_chipstat.reset()
+    mesh_size = g_mesh.topology().size
+    auto_width = mesh_size + 2
+    assert int(g_conf.get_val("ec_mesh_rateless_tasks") or 0) == 0
+    g_faults.inject("mesh.chip_slowdown", mode="always",
+                    match="chip=5/", delay_us=30_000)
+    widened_at = None
+    for i in range(16):
+        flush()
+        c.tick(dt=1.0)
+        if int(g_conf.get_val("ec_mesh_rateless_tasks") or 0) \
+                > auto_width:
+            widened_at = i
+            break
+    ctl = c.mgr.control
+    assert widened_at is not None, \
+        (c.mgr.health_checks, ctl.dump())
+    peak = int(g_conf.get_val("ec_mesh_rateless_tasks"))
+    assert auto_width < peak <= 2 * mesh_size
+    assert any(e["reflex"] == "straggler" and "widen" in e["reason"]
+               for e in ctl._ledger), list(ctl._ledger)
+    # ---- fault gone: skew clears, waste economics narrow back -------
+    # (the controller may keep widening until the hysteretic clear
+    # lands, so track the true peak through the loop)
+    g_faults.clear("mesh.chip_slowdown")
+    narrowed = False
+    for _ in range(40):
+        flush()
+        c.tick(dt=1.0)
+        width = int(g_conf.get_val("ec_mesh_rateless_tasks") or 0)
+        peak = max(peak, width)
+        if "TPU_MESH_SKEW" not in c.mgr.health_checks \
+                and width < peak:
+            narrowed = True
+            break
+    assert narrowed, ctl.dump()
+    assert any(e["reflex"] == "straggler" and "narrow" in e["reason"]
+               for e in ctl._ledger), list(ctl._ledger)
+    # width never left [mesh+1, 2*mesh] at any move
+    for e in ctl._ledger:
+        if e["knob"] == "ec_mesh_rateless_tasks":
+            assert mesh_size + 1 <= e["to"] <= 2 * mesh_size, e

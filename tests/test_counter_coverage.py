@@ -146,6 +146,11 @@ def test_known_new_families_covered_by_the_lint(cluster_and_text):
     # generic lints above really cover the mesh surfaces
     assert "mesh" in c.perf_collection.dump()
     assert c.perf_collection.dump()["mesh"]["dispatches"] > 0
+    # control-plane canary (ceph_tpu/control): the controller's logger
+    # is registered on every cluster, so ceph_daemon_control_* rides
+    # the generic exposition/coverage lints above
+    assert "control" in c.perf_collection.dump()
+    assert "skipped_cooldown" in c.perf_collection.dump()["control"]
     from ceph_tpu.trace import g_perf_histograms
     from ceph_tpu.trace.oplat import stage_of_hist_name
     assert any(lg == "devprof" for (lg, _n), _h

@@ -423,3 +423,33 @@ def test_kernel_and_slow_op_series_render():
             '{kernel="unit_kernel"}', 0.5) in samples
     assert types["ceph_daemon_slow_ops"] == "gauge"
     assert ('ceph_daemon_slow_ops', '{daemon="osd_0"}', 3.0) in samples
+
+
+def test_control_counters(exposition):
+    """Control-plane golden coverage (ceph_tpu/control): every
+    ``control`` logger counter renders as a ``ceph_daemon_control_*``
+    daemon series, and the cluster-scope actuation rollup renders as
+    the ``ceph_cluster_control_moves`` gauge.  Presence is the
+    contract (the counters are process-global, so other tests may
+    have moved them); the fixture's OWN mgr is observe-only
+    (``mgr_control_enable`` defaults off), so its cluster-scope move
+    rollup must render zero."""
+    types, samples = _parse(exposition)
+    for counter in ("ceph_daemon_control_ticks",
+                    "ceph_daemon_control_moves",
+                    "ceph_daemon_control_tightens",
+                    "ceph_daemon_control_restores",
+                    "ceph_daemon_control_pinned",
+                    "ceph_daemon_control_actuate_retries",
+                    "ceph_daemon_control_actuate_failures",
+                    "ceph_daemon_control_episodes",
+                    "ceph_daemon_control_teardown_reverts",
+                    "ceph_daemon_control_skipped_cooldown",
+                    "ceph_daemon_control_engaged_knobs",
+                    "ceph_daemon_control_enabled"):
+        vals = [v for n, _l, v in samples if n == counter]
+        assert vals, f"{counter} missing from the exposition"
+    assert types["ceph_cluster_control_moves"] == "gauge"
+    moves = [v for n, _l, v in samples
+             if n == "ceph_cluster_control_moves"]
+    assert moves == [0.0], moves
