@@ -1099,6 +1099,14 @@ def measure_mesh_straggler(*, mesh_chips: int = 8, slow_chip: int = 5,
         # process-global scoreboard: a leftover suspect must not haunt
         # the workloads that follow (the skew workload's policy)
         g_chipstat.reset()
+    # incident forensics receipt: the detect/protected legs raise
+    # TPU_MESH_SKEW through the ticked mgr, which auto-captures a
+    # bundle; the operator fallback only fires if detection never did
+    inc_mgr = cluster.mgr.incident
+    if inc_mgr.captures_total == 0:
+        inc_mgr.capture("operator", "straggler forensic snapshot",
+                        reason="operator")
+    incidents = inc_mgr.receipt()
     wall_s = max(time.perf_counter() - t_wall0, 1e-3)
     n_ops = n_flushes_total[0] * n_requests
     healthy_p999 = float(healthy_dc.get("p999", 0.0) or 0.0)
@@ -1142,6 +1150,7 @@ def measure_mesh_straggler(*, mesh_chips: int = 8, slow_chip: int = 5,
                 "byte_identical": bool(identical[0]),
             },
             "identical": bool(identical[0]),
+            "incidents": incidents,
             "devflow": _devflow_since(flow0, max(n_ops, 1)),
             "stage_breakdown": _stage_breakdown_since(
                 stage0, wall_s, max(n_ops, 1)),
@@ -1366,6 +1375,15 @@ def measure_recovery_storm(*, k: int = 8, m: int = 4, d: int = 10,
             if cl.read(pool, oid) != body:
                 identical = False
     fam_after = aggregate_families(cluster.osds.values())
+    # incident forensics receipt: a plain OSD kill raises no mgr
+    # health check (health() counts down osds inline), so the storm
+    # stamps an operator capture — the bundle still carries the
+    # osd_down/osd_out journal events and the post-backfill state
+    inc_mgr = cluster.mgr.incident
+    if inc_mgr.captures_total == 0:
+        inc_mgr.capture("operator", "post-storm forensic snapshot",
+                        reason="operator")
+    incidents = inc_mgr.receipt()
 
     from ..recovery.scheduler import FAMILY_KEYS
 
@@ -1413,6 +1431,7 @@ def measure_recovery_storm(*, k: int = 8, m: int = 4, d: int = 10,
             "byte_exact_traffic": bool(res.byte_exact),
             "traffic_completed": res.completed,
             "slo": slo_seen,
+            "incidents": incidents,
             "cluster_rollup": cluster_rollup,
             "devflow": _devflow_since(
                 flow0, max(regen["repaired_shards"]
@@ -1687,6 +1706,17 @@ def measure_slo_autotune(*, mesh_chips: int = 8, slow_chip: int = 5,
     t_wall0 = time.perf_counter()
     byte_exact = True
     receipts: list = []
+    incident_blocks: Dict[str, Any] = {}
+
+    def _leg_incidents(leg: str, cluster) -> None:
+        # each leg's cluster is discarded on return, so the incident
+        # receipt is harvested here; the health raise auto-captures,
+        # and the operator fallback only fires if it never raised
+        inc_mgr = cluster.mgr.incident
+        if inc_mgr.captures_total == 0:
+            inc_mgr.capture("operator", f"{leg} leg fallback capture",
+                            reason="operator")
+        incident_blocks[leg] = inc_mgr.receipt()
 
     def _slo_windows() -> None:
         g_conf.set_val("mgr_slo_fast_window_s", 6.0)
@@ -1752,6 +1782,7 @@ def measure_slo_autotune(*, mesh_chips: int = 8, slow_chip: int = 5,
                 converge = i + 1
                 break
         receipts.extend(list(ctl._ledger)[-6:])
+        _leg_incidents("admission", cluster)
         return {"raised": bool(tightens),
                 "moves": ctl.moves_total,
                 "abuser_correct": all("client.abuse.0" in e["reason"]
@@ -1813,6 +1844,7 @@ def measure_slo_autotune(*, mesh_chips: int = 8, slow_chip: int = 5,
         for oid, body in bodies.items():
             byte_exact &= cl.read("rstorm", oid) == body
         receipts.extend(list(ctl._ledger)[-6:])
+        _leg_incidents("recovery", cluster)
         return {"raised": raised,
                 "moves": ctl.moves_total,
                 "quiet_moves_before_storm": quiet_moves,
@@ -1896,6 +1928,7 @@ def measure_slo_autotune(*, mesh_chips: int = 8, slow_chip: int = 5,
             for e in ctl._ledger
             if e["knob"] == "ec_mesh_rateless_tasks")
         receipts.extend(list(ctl._ledger)[-6:])
+        _leg_incidents("straggler", cluster)
         return {"raised": raised,
                 "moves": ctl.moves_total,
                 "widen_ticks": widened_at,
@@ -1934,6 +1967,7 @@ def measure_slo_autotune(*, mesh_chips: int = 8, slow_chip: int = 5,
                               "recovery": recovery,
                               "straggler": straggler},
             },
+            "incidents": incident_blocks,
             "receipts": receipts[-18:],
             "wall_s": wall_s,
         })

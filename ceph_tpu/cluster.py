@@ -450,6 +450,50 @@ class MiniCluster:
                           "restored": self.mgr.control.reset(self.mgr)},
             "tear down any episode, then drop the ledger, tick count "
             "and sense caches")
+        from .mgr.incident import incident_perf_counters
+        from .trace.journal import g_journal, journal_perf_counters
+        self.perf_collection.add(journal_perf_counters())
+        self.perf_collection.add(incident_perf_counters())
+        # the mgr is a map subscriber with no daemon references; the
+        # cluster wires the forensic slow-op source where the OSDs live
+        self.mgr.incident.slow_ops_source = lambda: {
+            o.name: o.op_tracker.dump_historic_slow_ops()
+            for o in self.osds.values()}
+        asok.register(
+            "journal dump",
+            lambda c, a: g_journal.dump(a.get("daemon", "")),
+            "cluster event journal: bounded per-daemon rings of typed "
+            "events on the deterministic clock (daemon= for one ring)")
+        asok.register(
+            "journal reset",
+            lambda c, a: g_journal.reset(),
+            "drop every journal ring (per-daemon sequence numbers "
+            "keep counting)")
+        asok.register(
+            "tpu incident list",
+            lambda c, a: self.mgr.incident.list(),
+            "archived incident bundles: id, clock, state, trigger, "
+            "timeline size")
+        asok.register(
+            "tpu incident dump",
+            lambda c, a: self.mgr.incident.dump(
+                int(a.get("id", 0) or 0)),
+            "one incident bundle in full (newest unless id=)")
+
+        def _incident_capture(c, a):
+            bundle = self.mgr.incident.capture(
+                "operator", "operator-requested capture",
+                reason="operator")
+            if bundle is None:
+                return {"captured": False}
+            return {"captured": True, "id": bundle["id"],
+                    "events": len(bundle["timeline"])}
+
+        asok.register(
+            "tpu incident capture", _incident_capture,
+            "snapshot an incident bundle now (same payload as an "
+            "auto-capture; drops, never fails, under an injected "
+            "mgr.incident_capture fault)")
         asok.register(
             "arch probe",
             lambda c, a: __import__("ceph_tpu.arch", fromlist=["probe"])

@@ -359,6 +359,20 @@ def build_options() -> List[Option]:
                          "budget the move is dropped and retried "
                          "whole next tick — the controller never "
                          "wedges"),
+        Option("mgr_journal_ring_size", OPT_INT).set_default(256)
+        .set_description("events kept per daemon in the cluster event "
+                         "journal's bounded rings (trace/journal.py); "
+                         "read live on every emit, so an injectargs "
+                         "shrink evicts down on the next event"),
+        Option("mgr_incident_retention", OPT_INT).set_default(16)
+        .set_description("incident bundles kept in the mgr's archive "
+                         "(mgr/incident.py); a runtime shrink prunes "
+                         "the archive immediately via the config "
+                         "observer, oldest bundles first"),
+        Option("mgr_incident_timeline_tail", OPT_INT).set_default(64)
+        .set_description("merged-timeline events snapshotted into an "
+                         "incident bundle at capture, and again per "
+                         "finalize when the triggering check clears"),
         Option("tracing_kernels", OPT_BOOL).set_default(False)
         .set_description("time every device kernel dispatch (adds a "
                          "sync per call; diagnosis only)"),

@@ -64,9 +64,11 @@ class TrackedOp:
 class OpTracker:
     def __init__(self, history_size: int = 20,
                  history_duration: float = 600.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, name: str = ""):
         self.history_size = history_size
         self.history_duration = history_duration
+        # daemon name for the event journal; empty = generic "osd"
+        self.name = name
         self.now = clock
         self._inflight: Dict[int, TrackedOp] = {}
         self._history: Deque[TrackedOp] = deque(maxlen=history_size)
@@ -115,6 +117,10 @@ class OpTracker:
                 if spans:
                     op.flight = g_flight_recorder.record(
                         op.trace_id, op.description, op.duration, spans)
+            from ..trace.journal import g_journal
+            g_journal.emit(self.name or "osd", "slow_op",
+                           description=op.description,
+                           duration=round(op.duration, 6))
 
     def dump_ops_in_flight(self) -> dict:
         with self._lock:
@@ -141,6 +147,16 @@ class OpTracker:
                 # which stage ate the budget — the always-on ledger is
                 # already complete, no re-run or tracing required
                 d["stage_ledger"] = o.oplat.dump()
+            # which COPIES ate the budget: the devprof per-transfer
+            # ledger rides the op's pinned spans as a tag, so slow-op
+            # forensics shows bytes next to time
+            copies: List[dict] = []
+            spans = o.flight.spans if o.flight is not None \
+                else ([o.span] if o.span is not None else [])
+            for s in spans:
+                copies.extend(s.tags.get("copy_ledger", ()))
+            if spans:
+                d["copy_ledger"] = copies
             out.append(d)
         return {"ops": out}
 

@@ -45,6 +45,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 from ..common.config import g_conf
 from ..common.lockdep import DebugLock
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder
+from ..trace.journal import g_journal
 
 # wasted coded blocks per launched block (per sense window) above
 # which the rateless width is judged uneconomical while skew is quiet;
@@ -577,9 +578,13 @@ class Controller:
             # it as pinned so the reflex escalates to its next knob
             # instead of micro-stepping forever
             pc.inc(l_ctl_pinned)
+            g_journal.emit("mgr", "control_pinned", knob=knob,
+                           reflex=reflex)
             return None
         if new == cur:
             pc.inc(l_ctl_pinned)
+            g_journal.emit("mgr", "control_pinned", knob=knob,
+                           reflex=reflex)
             return None           # anti-windup: pinned at a bound
         return _Move(knob, cur, new, restore, reflex, reason)
 
@@ -636,6 +641,10 @@ class Controller:
         mgr._cluster_log(
             "INF", f"control: {move.reflex}: {move.knob} "
                    f"{move.cur:g} -> {move.new:g} ({move.reason})")
+        g_journal.emit("mgr", "control_actuate", knob=move.knob,
+                       option=opt_name, reflex=move.reflex,
+                       restore=move.restore,
+                       **{"from": move.cur, "to": move.new})
         return True
 
     def _close_episode(self, knob: str) -> None:
@@ -678,6 +687,8 @@ class Controller:
                 "INF", f"control: teardown: {knob} restored to "
                        f"{base:g} ({reason})")
             pc.inc(l_ctl_reverts)
+            g_journal.emit("mgr", "control_restore", knob=knob,
+                           to=base, reason=reason)
             restored += 1
             st.update(baseline=None, dir=0, scale=1.0, cooldown=0)
         self._abuser = None

@@ -34,6 +34,7 @@ from typing import Dict, List, Tuple
 
 from ..common.config import g_conf
 from ..trace import g_tracer
+from ..trace.journal import g_journal
 from .registry import (fault_perf_counters, l_fault_breaker_restores,
                        l_fault_breaker_trips, l_fault_degraded)
 
@@ -130,6 +131,8 @@ class BreakerBoard:
             pc.inc(l_fault_breaker_restores)
             pc.set(l_fault_degraded, self._n_open())
             g_tracer.event("breaker_restore", signature=str(sig))
+            g_journal.emit("fault", "breaker_restore",
+                           signature=str(sig))
 
     def record_failure(self, sig: Tuple, error: str = "") -> bool:
         """A device attempt for *sig* failed; returns True when further
@@ -161,6 +164,11 @@ class BreakerBoard:
             pc.set(l_fault_degraded, self._n_open())
             g_tracer.event("breaker_trip", signature=str(sig),
                            error=error)
+            g_journal.emit("fault", "breaker_trip",
+                           signature=str(sig), error=error)
+        elif probe_failed:
+            g_journal.emit("fault", "breaker_half_open",
+                           signature=str(sig), error=error)
         return tripped or probe_failed
 
     def _n_open(self) -> int:

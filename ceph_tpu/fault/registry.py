@@ -34,6 +34,7 @@ import zlib
 from typing import Dict, Optional, Tuple
 
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder
+from ..trace.journal import g_journal
 
 # ---- injected error kinds --------------------------------------------------
 
@@ -96,6 +97,11 @@ SITE_CATALOG: Dict[str, str] = {
         "matching chip's coded blocks become erasures the subset "
         "completion re-solves around; context is 'chip=<i>/<mesh "
         "size>' for match= scoping, count= bounds the failed flushes",
+    "mgr.incident_capture":
+        "incident bundle snapshot on a health-check raise "
+        "(ceph_tpu/mgr/incident): a firing drops that bundle — the "
+        "raise is journaled, the tick proceeds, and the NEXT raise "
+        "captures normally; context is the triggering check name",
     "osd.shard_read_eio":
         "shard-side EC read returns EIO (bluestore_debug_inject_read_err "
         "role) — the primary must reconstruct from surviving shards",
@@ -272,6 +278,7 @@ class FaultRegistry:
                 del self._armed[site]
         if fired:
             fault_perf_counters().inc(l_fault_injected)
+            g_journal.emit("fault", "fault_fire", site=site)
         return fired, error
 
     def should_fire(self, site: str, ctx: str = "") -> bool:
@@ -300,16 +307,21 @@ class FaultRegistry:
         spec = FaultSpec(name, **kw)
         with self._lock:
             self._armed[name] = spec
+        g_journal.emit("fault", "fault_arm", site=name, mode=spec.mode)
         return spec
 
     def clear(self, name: str = "") -> int:
         with self._lock:
             if name:
-                return 1 if self._armed.pop(name, None) is not None \
+                cleared = 1 if self._armed.pop(name, None) is not None \
                     else 0
-            n = len(self._armed)
-            self._armed.clear()
-            return n
+            else:
+                cleared = len(self._armed)
+                self._armed.clear()
+        if cleared:
+            g_journal.emit("fault", "fault_clear", site=name or "*",
+                           cleared=cleared)
+        return cleared
 
     def armed(self, site: str) -> Optional[FaultSpec]:
         with self._lock:

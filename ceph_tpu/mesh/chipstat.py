@@ -66,6 +66,7 @@ from ..common.perf_counters import PerfCounters, PerfCountersBuilder
 from ..trace.histogram import (PerfHistogramAxis, SCALE_LINEAR,
                                SCALE_LOG2, g_perf_histograms,
                                percentiles_from_counts)
+from ..trace.journal import g_journal
 
 # hysteresis discipline (the breaker's sustain/clear shape): a chip
 # must breach the threshold on this many CONSECUTIVE probes to be
@@ -380,10 +381,18 @@ class ChipStat:
                 row["suspect"] = True
                 row["suspect_since_probe"] = probe_seq
                 pc.inc(l_chip_suspects_marked)
+                # journal emit takes only the journal's own lock
+                # (ChipStat::lock -> EventJournal::lock is the one
+                # nesting this module introduces)
+                g_journal.emit("mesh", "chip_suspect_mark", chip=i,
+                               probe=probe_seq,
+                               skew_ratio=row["skew_ratio"])
             elif row["suspect"] and row["clean"] >= SKEW_CLEAR_PROBES:
                 row["suspect"] = False
                 row["suspect_since_probe"] = 0
                 pc.inc(l_chip_suspects_cleared)
+                g_journal.emit("mesh", "chip_suspect_clear", chip=i,
+                               probe=probe_seq)
         pc.set(l_chip_suspect_chips,
                sum(1 for r in self._chips.values() if r["suspect"]))
         pc.set(l_chip_max_skew_permille, int(worst * 1000))

@@ -63,6 +63,15 @@ class Manager(Dispatcher):
         # mgr_control_enable off (default) it returns before sensing
         from ..control import Controller
         self.control = Controller()
+        # incident forensics (incident.py): bundles auto-captured on
+        # health raises, finalized on the matching clear.  The diff
+        # baseline below is what the tick compares health_checks
+        # against to journal raise/clear transitions — it covers every
+        # raise path (the check_* methods, the SLO engine, health()
+        # between ticks) with one mechanism
+        from .incident import IncidentManager
+        self.incident = IncidentManager(self)
+        self._journal_health: Dict[str, str] = {}
         for m in (all_mons if all_mons is not None else [self.mon]):
             m.subscribe(name)
         self.mon.send_full_map(name)
@@ -131,6 +140,11 @@ class Manager(Dispatcher):
         """Periodic module work (the mgr's serve loops).  *now* is the
         cluster's deterministic clock (MiniCluster.tick passes it);
         None self-advances the telemetry clock one second per tick."""
+        from ..trace.journal import g_journal
+        if now is not None:
+            # stamp the journal's deterministic clock before any event
+            # this tick can emit (no wall clock anywhere in the layer)
+            g_journal.set_clock(now)
         if self.balancer_active:
             self.balancer_optimize()
         if self.autoscaler_active:
@@ -143,6 +157,25 @@ class Manager(Dispatcher):
         # (the fence-count test in tests/test_observability.py covers
         # this tick)
         self.telemetry.tick(self, now)
+        # health transition journal + incident forensics: diff the
+        # check set against the last tick's baseline so every raise
+        # path lands one health_raise (+ auto-capture) and every clear
+        # one health_clear (+ finalize), in tick order.  This runs
+        # BEFORE the control step so a raise is journaled ahead of the
+        # actuation it provokes — the bundle timeline reads causally
+        # (raise -> actuate -> ... -> clear); the actuations land in
+        # the bundle when the clear finalizes it
+        prev = self._journal_health
+        cur = dict(self.health_checks)
+        for check in sorted(set(cur) - set(prev)):
+            g_journal.emit("mgr", "health_raise", check=check,
+                           message=cur[check])
+            self.incident.capture(check, cur[check],
+                                  reason="health_raise")
+        for check in sorted(set(prev) - set(cur)):
+            g_journal.emit("mgr", "health_clear", check=check)
+            self.incident.resolve(check)
+        self._journal_health = cur
         # the control plane closes the loop on the streak state the
         # telemetry tick just refreshed: at most ONE bounded knob step
         # per tick (no-op unless mgr_control_enable)
@@ -450,6 +483,11 @@ class Manager(Dispatcher):
         lines.append("# TYPE ceph_cluster_control_moves gauge")
         lines.append(f"ceph_cluster_control_moves "
                      f"{self.control.moves_total}")
+        lines.append("# HELP ceph_cluster_incidents_total incident "
+                     "bundles captured by this mgr")
+        lines.append("# TYPE ceph_cluster_incidents_total gauge")
+        lines.append(f"ceph_cluster_incidents_total "
+                     f"{self.incident.captures_total}")
         if perf_collection is not None:
             dump = perf_collection.dump()
             for logger, counters in sorted(dump.items()):

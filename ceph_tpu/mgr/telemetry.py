@@ -51,6 +51,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..common.config import g_conf
 from ..trace.histogram import (g_perf_histograms, merge_axis0,
                                percentiles_from_counts)
+from ..trace.journal import g_journal
 
 # the three SLO health checks (mon health / `ceph -s` / Prometheus
 # ceph_health_check{check=...} via Manager.health_checks)
@@ -431,9 +432,13 @@ class Telemetry:
                 if burn_now >= 1.0:
                     st["streak"] += 1
                     st["clean"] = 0
+                    streak_opened = st["streak"] == 1
+                    clean_opened = False
                 else:
                     st["streak"] = 0
                     st["clean"] += 1
+                    streak_opened = False
+                    clean_opened = st["clean"] == 1 and st["active"]
                 raise_now = (not st["active"]
                              and st["streak"] >= obj["sustain_ticks"]
                              and burn_fast >= 1.0
@@ -452,6 +457,15 @@ class Telemetry:
                     # breaches, so the health text never shows a
                     # "1.50 > 2.00" non-comparison
                     st["message"] = message
+            if streak_opened:
+                # a sustain streak opened: the first breaching tick of
+                # a possible raise — journal it so the incident bundle
+                # shows when the pressure began, not just when it won
+                g_journal.emit("mgr", "slo_streak", check=check,
+                               phase="sustain")
+            elif clean_opened:
+                g_journal.emit("mgr", "slo_streak", check=check,
+                               phase="clean")
             if raise_now:
                 mgr.health_checks[check] = message
                 mgr._cluster_log(

@@ -35,6 +35,7 @@ from ..osdmap import (
     CEPH_OSD_IN, Incremental, OSDMap, TYPE_ERASURE, TYPE_REPLICATED,
     pg_pool_t,
 )
+from ..trace.journal import g_journal
 
 DEFAULT_STRIPE_UNIT = 4096  # osd_pool_erasure_code_stripe_unit
 MON_PING_GRACE = 15.0       # leader silent this long -> re-elect
@@ -226,6 +227,9 @@ class Monitor(Dispatcher):
         self.election_epoch += 1          # even = decided
         self.leader_rank = self.rank
         self.quorum = set(self._election_acks)
+        g_journal.emit(self.name, "mon_election",
+                       leader=self.rank, epoch=self.election_epoch,
+                       quorum=sorted(self.quorum))
         for p in self.peers:
             self.messenger.send_message(MMonElection(
                 op=MMonElection.OP_VICTORY, epoch=self.election_epoch,
@@ -1372,6 +1376,7 @@ class Monitor(Dispatcher):
             reps.discard(reporter)
         self._down_stamps.setdefault(osd, self.now)
         self.log_entry("mon", "WRN", f"osd.{osd} marked down")
+        g_journal.emit(self.name, "osd_down", osd=osd)
         self.publish(inc)
 
     def mark_osd_up(self, osd: int) -> None:
@@ -1389,6 +1394,7 @@ class Monitor(Dispatcher):
         self._failure_reports.pop(osd, None)
         self._down_stamps.pop(osd, None)
         self.log_entry("mon", "INF", f"osd.{osd} boot")
+        g_journal.emit(self.name, "osd_up", osd=osd)
         self.publish(inc)
 
     def mark_osd_out(self, osd: int) -> None:
@@ -1400,6 +1406,7 @@ class Monitor(Dispatcher):
             # memo a reweight override so a later 'in' restores it
             # (osd_xinfo_t::old_weight, OSDMonitor operator out/in)
             inc.new_old_weight[osd] = cur
+        g_journal.emit(self.name, "osd_out", osd=osd)
         self.publish(inc)
 
     def handle_pg_temp(self, msg: MOSDPGTemp) -> None:
@@ -1421,6 +1428,7 @@ class Monitor(Dispatcher):
         inc.new_weight[osd] = old if old > 0 else CEPH_OSD_IN
         if old:
             inc.new_old_weight[osd] = 0      # memo consumed
+        g_journal.emit(self.name, "osd_in", osd=osd)
         self.publish(inc)
 
     # ---- durability (mon store, src/mon/MonitorDBStore.h role) -------------

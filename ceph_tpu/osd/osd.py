@@ -116,7 +116,7 @@ class OSD(Dispatcher):
             self.name, "op_r_latency_in_bytes_histogram",
             latency_in_bytes_axes)
         self.dout = Dout("osd", self.name)
-        self.op_tracker = OpTracker()
+        self.op_tracker = OpTracker(name=self.name)
         self._tracked: Dict[Tuple[str, int], object] = {}
         self._recovery_queue: List[PG] = []
         # recovery orchestration (ceph_tpu/recovery): paced sub-chunk

@@ -23,6 +23,8 @@ from .devprof import (DevFlowProfiler, devflow_delta,
                       transfer_size_axes)
 from .oplat import (OpLedger, OpLatAccumulator, STAGES, g_oplat,
                     oplat_perf_counters)
+from .journal import (EVENT_TYPES, EventJournal, g_journal,
+                      journal_perf_counters)
 
 __all__ = [
     "Span", "SpanCollector", "Tracer", "build_tree", "g_tracer",
@@ -34,4 +36,6 @@ __all__ = [
     "g_devprof", "transfer_size_axes",
     "OpLedger", "OpLatAccumulator", "STAGES", "g_oplat",
     "oplat_perf_counters",
+    "EVENT_TYPES", "EventJournal", "g_journal",
+    "journal_perf_counters",
 ]

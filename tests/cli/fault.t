@@ -16,6 +16,7 @@ site catalog, arming a trigger, the unknown-site refusal, and clearing.
       "mesh.chip_fail": "hard per-chip failure mid-flush (ceph_tpu/mesh/rateless): the matching chip's coded blocks become erasures the subset completion re-solves around; context is 'chip=<i>/<mesh size>' for match= scoping, count= bounds the failed flushes",
       "mesh.chip_slowdown": "per-chip straggler injection (ceph_tpu/mesh/chipstat): delays the matching chip's probe readback by delay_us; context is 'chip=<i>/<mesh size>' so match='chip=3/' scopes one chip",
       "mesh.encode_batch": "mesh-sharded flush execution (ceph_tpu/mesh runtime) \u2014 exhaustion degrades the flush to the single-device path",
+      "mgr.incident_capture": "incident bundle snapshot on a health-check raise (ceph_tpu/mgr/incident): a firing drops that bundle \u2014 the raise is journaled, the tick proceeds, and the NEXT raise captures normally; context is the triggering check name",
       "msg.drop": "drop a fabric message (ms inject socket failures role); context is '<MsgType> <src>><dst>' for match= scoping",
       "osd.shard_read_eio": "shard-side EC read returns EIO (bluestore_debug_inject_read_err role) \u2014 the primary must reconstruct from surviving shards",
       "recovery.helper_fetch": "helper-side repair contribution read (handle_sub_read) \u2014 a dropped helper fails the round and the orchestrator falls back to full-stripe decode",

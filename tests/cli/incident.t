@@ -1,0 +1,46 @@
+Incident forensics admin CLI (ceph_tpu/mgr/incident + the event
+journal in ceph_tpu/trace/journal): `tpu incident list|dump|capture`
+and `journal dump|reset`.  A restored cluster starts with a clean
+black box — zero archived bundles, empty per-daemon event rings, the
+deterministic clock at zero (the journal never reads the wall clock).
+
+  $ python -c "from ceph_tpu.cluster import MiniCluster; MiniCluster(n_osds=2).checkpoint('ck')"
+
+  $ ceph --cluster ck daemon osd.0 tpu incident list
+  {
+    "captures_total": 0,
+    "incidents": [],
+    "retention": 16
+  }
+
+  $ ceph --cluster ck daemon osd.0 tpu incident dump
+  {
+    "incident": null
+  }
+
+  $ ceph --cluster ck daemon osd.0 journal dump
+  {
+    "clock": 0.0,
+    "daemons": {},
+    "gseq": 0
+  }
+
+`tpu incident capture` snapshots a bundle on operator demand — the
+same payload a health-check raise captures automatically, minus the
+raise (state "manual", reason "operator").  The receipt carries the
+bundle id and the size of the timeline tail it archived.
+
+  $ ceph --cluster ck daemon osd.0 tpu incident capture
+  {
+    "captured": true,
+    "events": 0,
+    "id": 1
+  }
+
+`journal reset` drops every daemon ring (sequence numbers stay
+monotone for the process lifetime) and reports what it dropped.
+
+  $ ceph --cluster ck daemon osd.0 journal reset
+  {
+    "dropped": 0
+  }

@@ -85,6 +85,19 @@ def test_control_cli_cram(tmp_path):
     assert_cram(path, str(tmp_path))
 
 
+def test_incident_cli_cram(tmp_path):
+    """`ceph daemon <who> tpu incident list|dump|capture` and
+    `journal dump|reset` replayed from a recorded transcript
+    (tests/cli/incident.t): the clean black box of a restored cluster
+    (zero bundles, empty rings, clock at zero), an operator capture's
+    receipt, and the journal reset — through the same `ceph` shim as
+    fault.t (auto-capture on a health raise and the causal bundle
+    timeline are covered in-process by tests/test_incident.py)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cli", "incident.t")
+    assert_cram(path, str(tmp_path))
+
+
 def test_status_cli_cram(tmp_path):
     """`ceph daemon <who> tpu status` + `telemetry dump|reset`
     replayed from a recorded transcript (tests/cli/status.t): the

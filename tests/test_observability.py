@@ -249,6 +249,23 @@ def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
     c.admin_socket.execute("recovery dump")
     assert calls["n"] == 0, "armed recovery scheduler added a " \
         "device sync to the client write path"
+    # journal/incident extension: event emission is a host-side dict
+    # append, and a FULL incident capture (timeline merge + rollup +
+    # slow-op ledgers + chip scoreboard + control dump) is pure
+    # host-side snapshotting — neither may ever touch the device
+    from ceph_tpu.trace import g_journal
+    g_journal.emit("mgr", "slo_streak", check="FENCE_TEST",
+                   phase="sustain")
+    g_journal.emit("mesh", "chip_suspect_mark", chip=0, probe=1,
+                   skew_ratio=9.9)
+    bundle = c.mgr.incident.capture("FENCE_TEST", "fence-count probe",
+                                    reason="operator")
+    assert bundle is not None and bundle["timeline"]
+    c.admin_socket.execute("journal dump")
+    c.admin_socket.execute("tpu incident list")
+    c.admin_socket.execute("tpu incident dump")
+    assert calls["n"] == 0, "journal emit / incident capture added " \
+        "a device sync"
 
 
 def test_slow_op_span_tree_and_histogram_dump(clean_tracing):
@@ -306,6 +323,18 @@ def test_slow_op_span_tree_and_histogram_dump(clean_tracing):
     dt = c.admin_socket.execute("dump_tracing")
     assert dt["enabled"] and "client.0" in dt["spans"]
     assert dt["flight_recorder"]["slow_ops"]
+
+    # forensics satellite: the same historic entry carries the
+    # aggregated copy_ledger next to its stage_ledger — which host<->
+    # device boundary moved the bytes, without replaying the trace
+    ledgers = [op["copy_ledger"] for d in slow.values()
+               for op in d["ops"]
+               if op["description"].startswith("osd_op(writefull")
+               and "copy_ledger" in op]
+    assert ledgers, "slow write op carried no copy_ledger"
+    entries = ledgers[0]
+    assert all(set(e) >= {"stage", "dir", "bytes"} for e in entries)
+    assert any(e["dir"] == "h2d" and e["bytes"] > 0 for e in entries)
 
 
 def test_queued_ec_write_keeps_trace_context(clean_tracing):

@@ -453,3 +453,29 @@ def test_control_counters(exposition):
     moves = [v for n, _l, v in samples
              if n == "ceph_cluster_control_moves"]
     assert moves == [0.0], moves
+
+
+def test_journal_and_incident_counters(exposition):
+    """Forensics golden coverage (trace/journal + mgr/incident): the
+    ``journal`` and ``incident`` logger counters render as daemon
+    series, and the cluster-scope capture rollup renders as the
+    ``ceph_cluster_incidents_total`` gauge.  Presence is the contract
+    (both loggers are process-global, so other tests may have moved
+    them); the fixture's own mgr raised no health check, so its
+    cluster-scope rollup must render zero."""
+    types, samples = _parse(exposition)
+    for counter in ("ceph_daemon_journal_events",
+                    "ceph_daemon_journal_evictions",
+                    "ceph_daemon_journal_resets",
+                    "ceph_daemon_incident_captures",
+                    "ceph_daemon_incident_operator_captures",
+                    "ceph_daemon_incident_dropped",
+                    "ceph_daemon_incident_resolved",
+                    "ceph_daemon_incident_pruned",
+                    "ceph_daemon_incident_open"):
+        vals = [v for n, _l, v in samples if n == counter]
+        assert vals, f"{counter} missing from the exposition"
+    assert types["ceph_cluster_incidents_total"] == "gauge"
+    caps = [v for n, _l, v in samples
+            if n == "ceph_cluster_incidents_total"]
+    assert caps == [0.0], caps
