@@ -59,7 +59,8 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     from . import regress
-    from .workloads import (bench_perf_counters, measure_decode,
+    from .workloads import (bench_perf_counters,
+                            measure_composed_chaos, measure_decode,
                             measure_dispatch_coalesce,
                             measure_ec_mesh, measure_ec_pipeline,
                             measure_encode, measure_host_native,
@@ -211,6 +212,23 @@ def main(argv=None) -> int:
                  f"straggler {scen['straggler']['converge_ticks']}; "
                  f"disabled twin moves {ctrl['disabled_moves']}, "
                  f"byte_exact {ctrl['byte_exact']})")
+        # composed chaos (ceph_tpu/chaos, docs/CHAOS.md): pinned
+        # seeded storylines end to end, every receipt re-judged by
+        # regress.py's CHAOS GATE as absolute invariants.  Smoke runs
+        # ONE storyline (seed 24 exercises straggler + chip-fail +
+        # elastic membership) to stay inside the seconds-scale budget;
+        # both pinned seeds run in tier-1, all four in the full mode
+        mx = measure_composed_chaos(
+            seeds=(24,) if args.smoke
+            else (24, 103, 196, 20260807))
+        result["metrics"].append(mx)
+        chb = mx["chaos"]
+        progress(f"composed_chaos {mx['value']} ops/s over "
+                 f"{len(chb['receipts'])} storylines (accepted "
+                 f"{chb['accepted']}, wedges "
+                 f"{sum(1 for r in chb['receipts'] if r['wedged'])}, "
+                 f"byte_exact "
+                 f"{all(r['byte_exact'] for r in chb['receipts'])})")
         host = measure_host_native(matrix, batch[0],
                                    target_seconds=0.3 if args.smoke
                                    else 1.5)

@@ -126,6 +126,21 @@ STRAGGLER_MAX_BANDWIDTH_OVERHEAD = 2.0
 # - client ops stayed byte-exact throughout (the control plane never
 #   touches the data path).
 
+# the CHAOS GATE (composed-chaos scenario engine PR, docs/CHAOS.md):
+# the composed_chaos workload's `chaos` block carries one receipt per
+# pinned storyline seed — the engine's own universal-acceptance
+# judgment, re-pinned here as absolute invariants so a bench round can
+# never ship a storyline regression as a mere throughput wobble:
+# - every receipt ACCEPTED (the engine's conjunction of the below);
+# - every op byte-exact through the whole storyline (client replies
+#   and dispatcher oracles both);
+# - zero wedges (no storyline exhausted its settle budget);
+# - every expected health check raised AND cleared with a finalized
+#   incident bundle whose gseq timeline tells the storyline back, and
+#   every collateral raise resolved the same way;
+# - zero mesh single-device fallbacks (composed faults must be
+#   absorbed by the coded path, never the degradation ladder).
+
 
 def load_trajectory(root: str) -> List[Dict[str, Any]]:
     """All parseable BENCH_r*.json records under *root*, oldest first.
@@ -226,6 +241,7 @@ def compare_against_trajectory(
     skew_compared = 0      # skew blocks checked (absolute gate)
     straggler_compared = 0  # straggler blocks checked (absolute gate)
     control_compared = 0   # control blocks checked (absolute gate)
+    chaos_compared = 0     # chaos blocks checked (absolute gate)
     for cur in current:
         if not cur.get("fenced") or cur.get("suspect"):
             continue
@@ -245,6 +261,11 @@ def compare_against_trajectory(
         if isinstance(ct, dict):
             control_compared += 1
             regressions.extend(_control_gate(name, ct))
+        # ---- CHAOS GATE: absolute invariants, baseline or not ----------
+        ch = cur.get("chaos")
+        if isinstance(ch, dict):
+            chaos_compared += 1
+            regressions.extend(_chaos_gate(name, ch))
         baseline = None
         baseline_round = None
         for rec in reversed(trajectory):
@@ -317,6 +338,7 @@ def compare_against_trajectory(
             "skew_compared": skew_compared,
             "straggler_compared": straggler_compared,
             "control_compared": control_compared,
+            "chaos_compared": chaos_compared,
             "no_baseline": no_baseline,
             "tolerance": tolerance, "platform": platform}
 
@@ -470,4 +492,59 @@ def _straggler_gate(name: str,
         fail("healthy_false_suspects",
              st.get("healthy_false_suspects"),
              "the healthy twin marked a suspect")
+    return out
+
+
+def _chaos_gate(name: str, ch: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The composed-chaos workload's absolute invariants as regression
+    entries (change=None — a storyline either survives the universal
+    acceptance or it does not; there is no ratio to report)."""
+    out: List[Dict[str, Any]] = []
+
+    def fail(key: str, value, why: str) -> None:
+        out.append({"name": f"{name}.chaos.{key}", "unit": "invariant",
+                    "value": value, "baseline": why,
+                    "baseline_round": None, "change": None})
+
+    receipts = ch.get("receipts") or []
+    if not receipts:
+        fail("receipts", 0, "no storyline receipts — the chaos "
+             "workload executed nothing")
+        return out
+    for r in receipts:
+        if not isinstance(r, dict):
+            fail("receipt", r, "malformed storyline receipt")
+            continue
+        seed = r.get("seed")
+        if not r.get("byte_exact"):
+            fail(f"seed{seed}.byte_exact", r.get("byte_exact"),
+                 "an op or dispatcher oracle diverged from its "
+                 "expected bytes under the storyline")
+        if r.get("wedged"):
+            fail(f"seed{seed}.wedged", r.get("wedged"),
+                 "the storyline exhausted its settle budget — a "
+                 "composed fault wedged the cluster")
+        for chk, row in sorted((r.get("checks") or {}).items()):
+            if not isinstance(row, dict) or not all(row.values()):
+                fail(f"seed{seed}.{chk}", row,
+                     "an expected health check failed to raise, "
+                     "clear, or leave a finalized bundle that tells "
+                     "the storyline back")
+        if not r.get("all_raises_resolved"):
+            fail(f"seed{seed}.all_raises_resolved",
+                 r.get("all_raises_resolved"),
+                 "a collateral health raise never cleared or left "
+                 "no finalized incident bundle")
+        if not r.get("storyline_told"):
+            fail(f"seed{seed}.storyline_told", r.get("storyline_told"),
+                 "the cluster journal does not contain the injected "
+                 "storyline's promised event types")
+        if int(r.get("mesh_fallbacks") or 0) != 0:
+            fail(f"seed{seed}.mesh_fallbacks", r.get("mesh_fallbacks"),
+                 "a composed fault degraded a flush to the "
+                 "single-device fallback path")
+        if not r.get("accepted"):
+            fail(f"seed{seed}.accepted", r.get("accepted"),
+                 "the storyline failed the engine's universal "
+                 "acceptance")
     return out

@@ -1971,3 +1971,52 @@ def measure_slo_autotune(*, mesh_chips: int = 8, slow_chip: int = 5,
             "receipts": receipts[-18:],
             "wall_s": wall_s,
         })
+
+
+def measure_composed_chaos(*, seeds: Tuple[int, ...] = (24, 103),
+                           name: str = "composed_chaos"
+                           ) -> Dict[str, Any]:
+    """The composed-chaos workload (ceph_tpu/chaos, docs/CHAOS.md):
+    execute one seeded multi-fault storyline per entry in *seeds* on a
+    fresh ticking MiniCluster under open-loop harness traffic, and
+    record every receipt for bench/regress.py's CHAOS GATE, which pins
+    the universal acceptance as absolute invariants:
+
+    - every client op and every dispatcher oracle stays byte-exact
+      through the whole storyline;
+    - every health check the storyline promises — and every collateral
+      raise — both RAISES and CLEARS with zero operator action;
+    - every raise leaves a FINALIZED incident bundle whose gseq-ordered
+      timeline tells the injected storyline back (or a journaled
+      capture drop when losing the capture was itself the leg);
+    - zero wedges (no storyline exhausts its settle budget) and zero
+      mesh single-device fallbacks.
+
+    The metric value is aggregate completed client ops/s across the
+    seeds — a throughput floor for the whole chaos machinery, with the
+    invariants carried in the ``chaos`` block.
+    """
+    from ..chaos import compose_scenario, run_scenario
+
+    t0 = time.perf_counter()
+    receipts = []
+    total_ops = 0
+    for seed in seeds:
+        r = run_scenario(compose_scenario(int(seed)))
+        receipts.append(r)
+        total_ops += int(r["ops_completed"])
+    wall_s = round(max(time.perf_counter() - t0, 1e-3), 3)
+    v = round(total_ops / wall_s, 2)
+    return make_metric(
+        name, v, "ops/s", fenced=True,
+        stats={"n": len(receipts), "median": v, "iqr": 0.0,
+               "min": v, "max": v},
+        roofline={"verdict": "unknown", "suspect": False},
+        extra={
+            "chaos": {
+                "seeds": [int(s) for s in seeds],
+                "accepted": all(r["accepted"] for r in receipts),
+                "receipts": receipts,
+            },
+            "wall_s": wall_s,
+        })

@@ -291,11 +291,13 @@ class MiniCluster:
             "per-shard op queue sizes and mclock tags")
         from .dispatch import dispatch_perf_counters, g_dispatcher
         self.perf_collection.add(dispatch_perf_counters())
-        from .mesh import (g_chipstat, mesh_chip_perf_counters,
-                           mesh_perf_counters, rateless_perf_counters)
+        from .mesh import (g_chipstat, membership_perf_counters,
+                           mesh_chip_perf_counters, mesh_perf_counters,
+                           rateless_perf_counters)
         self.perf_collection.add(mesh_perf_counters())
         self.perf_collection.add(mesh_chip_perf_counters())
         self.perf_collection.add(rateless_perf_counters())
+        self.perf_collection.add(membership_perf_counters())
         asok.register(
             "mesh skew dump",
             lambda c, a: g_chipstat.dump(),
@@ -391,8 +393,10 @@ class MiniCluster:
             "p=, n=, seed=, count=, error=, match=, delay_us=)")
         asok.register(
             "fault list",
-            lambda c, a: g_faults.dump(),
-            "fault-injection site catalog + armed triggers")
+            lambda c, a: g_faults.list_sites()
+            if a.get("format") == "json" else g_faults.dump(),
+            "fault-injection site catalog + armed triggers "
+            "(format=json for the machine-readable site list)")
         asok.register(
             "fault clear",
             lambda c, a: {"cleared": g_faults.clear(a.get("name", ""))},
@@ -494,6 +498,38 @@ class MiniCluster:
             "snapshot an incident bundle now (same payload as an "
             "auto-capture; drops, never fails, under an injected "
             "mgr.incident_capture fault)")
+
+        from .chaos import chaos_perf_counters
+        self.perf_collection.add(chaos_perf_counters())
+
+        def _chaos_compose(c, a):
+            # compose-only: sample the storyline a seed deterministically
+            # maps to, without executing it (legs= narrows the catalog:
+            # comma-separated leg names)
+            from .chaos import compose_scenario
+            try:
+                seed = int(a.get("seed", ""))
+            except (TypeError, ValueError):
+                raise ValueError("chaos compose requires seed=<int>")
+            legs = None
+            if a.get("legs"):
+                legs = tuple(
+                    s for s in str(a["legs"]).split(",") if s)
+            return compose_scenario(seed, legs=legs).dump()
+
+        asok.register(
+            "chaos compose", _chaos_compose,
+            "deterministically sample the composed-chaos storyline for "
+            "seed=<int> (legs= to force the leg set) without running it")
+
+        def _chaos_dump(c, a):
+            from .chaos import engine_dump
+            return engine_dump()
+
+        asok.register(
+            "chaos dump", _chaos_dump,
+            "chaos engine pane: leg catalog, fault-site inventory, "
+            "composer options, scenario counters")
         asok.register(
             "arch probe",
             lambda c, a: __import__("ceph_tpu.arch", fromlist=["probe"])

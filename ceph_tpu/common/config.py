@@ -155,6 +155,19 @@ def build_options() -> List[Option]:
                          "(clears after 3 clean probes) and raises "
                          "TPU_MESH_SKEW; <= 0 disables the "
                          "scoreboard verdicts (probes still record)"),
+        Option("chaos_storyline_legs_max", OPT_INT).set_default(3)
+        .set_description("composed-chaos scenario engine "
+                         "(ceph_tpu/chaos): maximum primitive legs "
+                         "one seeded storyline samples on top of its "
+                         "always-on traffic phase; read at compose "
+                         "time, so runtime changes shape the NEXT "
+                         "composed scenario"),
+        Option("chaos_settle_ticks_max", OPT_INT).set_default(64)
+        .set_description("composed-chaos settle budget: mgr ticks "
+                         "(with synthetic clean flushes in between) "
+                         "the engine grants every expected health "
+                         "check to clear after its fault is disarmed "
+                         "before declaring the scenario WEDGED"),
         Option("ec_pipeline_depth", OPT_INT).set_default(1)
         .set_description("EC write pipeline: encodes a single PG may "
                          "keep in flight in the dispatch scheduler "

@@ -327,6 +327,24 @@ class FaultRegistry:
         with self._lock:
             return self._armed.get(site)
 
+    def sites(self) -> Dict[str, str]:
+        """Machine-readable site catalog (name -> description) — the
+        enumeration surface the chaos composer samples primitives from
+        (ceph_tpu/chaos/scenario.py); a copy, so callers cannot mutate
+        the build's catalog."""
+        return dict(SITE_CATALOG)
+
+    def list_sites(self) -> list:
+        """Structured per-site records, sorted by name — the ``fault
+        list format=json`` shape: one row per registered site with its
+        armed trigger (or null), so tooling iterates a stable list
+        instead of string-keyed prose."""
+        with self._lock:
+            armed = {s: spec.dump() for s, spec in self._armed.items()}
+        return [{"name": name, "description": desc,
+                 "armed": armed.get(name)}
+                for name, desc in sorted(SITE_CATALOG.items())]
+
     def dump(self) -> dict:
         with self._lock:
             armed = {s: spec.dump() for s, spec in self._armed.items()}

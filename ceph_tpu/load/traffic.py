@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -81,13 +81,21 @@ class TrafficSpec:
     tick_every: int = 32          # cluster.tick cadence (retry sweeps)
     keep_completions: bool = True  # False for soaks: aggregate only
     # first-class cluster events scheduled mid-run (the recovery-storm
-    # shape, docs/RECOVERY.md): (round, action, osd_id) with action in
-    # osd_kill | osd_down | osd_out | osd_revive | osd_in — fired at
-    # the START of that round, so the remaining traffic runs against
-    # the changed topology.  "osd_kill" is the full storm trigger
-    # (network down + mon mark-down); pair it with "osd_out" to start
-    # backfill to a spare while clients keep running.
+    # shape, docs/RECOVERY.md): (round, action, arg) with action in
+    # osd_kill | osd_down | osd_out | osd_revive | osd_in (arg = osd
+    # id) or mesh_chip_add | mesh_chip_retire (arg = CHIP COUNT delta
+    # applied to the live ec_mesh_chips target; docs/CHAOS.md) — fired
+    # at the START of that round, so the remaining traffic runs
+    # against the changed topology.  "osd_kill" is the full storm
+    # trigger (network down + mon mark-down); pair it with "osd_out"
+    # to start backfill to a spare while clients keep running.
     events: Tuple[Tuple[int, str, int], ...] = ()
+    # scheduled callables (round, fn) fired at the START of that round
+    # with the cluster as the only argument, same passed-round
+    # semantics as ``events`` — the chaos composer compiles fault
+    # arm/clear legs into these (the declarative ScenarioSpec stays
+    # the unit of determinism; ceph_tpu/chaos/engine.py compiles it)
+    hooks: Tuple[Tuple[int, Callable], ...] = ()
 
 
 @dataclass
@@ -360,6 +368,21 @@ def _apply_event(cluster, action: str, osd_id: int) -> None:
         cluster.revive_osd(osd_id)
     elif action == "osd_in":
         cluster.mark_osd_in(osd_id)
+    elif action in ("mesh_chip_add", "mesh_chip_retire"):
+        # elastic membership as a first-class storyline step: the arg
+        # is a CHIP COUNT delta (not an osd id) applied to the live
+        # ec_mesh_chips target.  set_checked fires the MeshRuntime
+        # observer, so the drain-on-old-mesh + plan-cache rebuild run
+        # right here, between rounds, under open traffic.
+        from ..mesh import g_mesh
+        cur = int(g_conf.get_val("ec_mesh_chips"))
+        if cur < 0:         # -1 = all devices: resolve to the live size
+            mesh = g_mesh.topology()
+            cur = 0 if mesh is None else mesh.size
+        delta = int(osd_id)
+        if action == "mesh_chip_retire":
+            delta = -delta
+        g_conf.set_checked("ec_mesh_chips", max(cur + delta, 1))
     else:
         raise ValueError(f"unknown traffic event action '{action}'")
 
@@ -410,6 +433,7 @@ def run_traffic(cluster, spec: TrafficSpec,
                    for i in range(spec.n_clients)]
         rnd = 0
         fired: set = set()
+        hooks_fired: set = set()
         while rnd < spec.max_rounds:
             for i, (r_ev, action, osd_id) in enumerate(spec.events):
                 # events fire when their round arrives (or is passed —
@@ -417,8 +441,13 @@ def run_traffic(cluster, spec: TrafficSpec,
                 if i not in fired and rnd >= r_ev:
                     fired.add(i)
                     _apply_event(cluster, action, osd_id)
+            for i, (r_hk, fn) in enumerate(spec.hooks):
+                if i not in hooks_fired and rnd >= r_hk:
+                    hooks_fired.add(i)
+                    fn(cluster)
             if all(cl.done() for cl in clients) and \
-                    len(fired) == len(spec.events):
+                    len(fired) == len(spec.events) and \
+                    len(hooks_fired) == len(spec.hooks):
                 break
             batches = [cl.collect_sends(rnd) for cl in clients]
             sent = sum(len(b) for b in batches)
@@ -440,6 +469,7 @@ def run_traffic(cluster, spec: TrafficSpec,
             if sent == 0 and not any(cl.pending or cl._resend
                                      for cl in clients) and \
                     len(fired) == len(spec.events) and \
+                    len(hooks_fired) == len(spec.hooks) and \
                     all(cl.issued >= spec.ops_per_client
                         for cl in clients):
                 # truly drained: budgets spent AND nothing in flight.

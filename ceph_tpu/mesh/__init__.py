@@ -9,7 +9,8 @@ from .pool import StagingPool
 from .rateless import (RatelessCoder, RatelessPlan,
                        rateless_perf_counters)
 from .runtime import (MeshRuntime, ShardingPlan, chip_occupancy_axes,
-                      g_mesh, mesh_perf_counters)
+                      g_mesh, membership_perf_counters,
+                      mesh_perf_counters)
 from .topology import BATCH_AXIS, addressable_devices, batch_mesh
 
 __all__ = [
@@ -17,6 +18,6 @@ __all__ = [
     "RatelessPlan", "ShardingPlan", "StagingPool",
     "addressable_devices", "batch_mesh", "chip_latency_axes",
     "chip_occupancy_axes", "g_chipstat", "g_mesh",
-    "mesh_chip_perf_counters", "mesh_perf_counters",
-    "rateless_perf_counters",
+    "membership_perf_counters", "mesh_chip_perf_counters",
+    "mesh_perf_counters", "rateless_perf_counters",
 ]

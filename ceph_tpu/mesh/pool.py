@@ -63,11 +63,16 @@ class StagingPool:
             for lst in self._free.values():
                 del lst[self._per_shape:]
 
-    def clear(self) -> None:
+    def clear(self) -> int:
+        """Drop every pooled buffer; returns how many were released so
+        the elastic-membership transition can account the staging
+        memory a topology change returns to the allocator."""
         with self._lock:
+            dropped = sum(len(lst) for lst in self._free.values())
             self._free.clear()
             self.hits = 0
             self.misses = 0
+        return dropped
 
     def dump(self) -> Dict:
         with self._lock:

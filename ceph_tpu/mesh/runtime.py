@@ -59,6 +59,7 @@ import numpy as np
 from ..common.config import g_conf
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder
 from ..trace.devprof import g_devprof
+from ..trace.journal import g_journal
 from ..trace.histogram import (PerfHistogramAxis, SCALE_LINEAR,
                                g_perf_histograms)
 from .pool import StagingPool
@@ -117,6 +118,61 @@ def mesh_perf_counters() -> PerfCounters:
                       "devices in the active dispatch mesh")
             _mesh_pc = b.create_perf_counters()
     return _mesh_pc
+
+
+# ---- elastic-membership counters (ceph_daemon_mesh_membership_*) ----------
+MEMBER_FIRST = 98200
+l_member_transitions = 98201     # applied ec_mesh_chips topology changes
+l_member_chip_adds = 98202       # chips added across all transitions
+l_member_chip_retires = 98203    # chips retired across all transitions
+l_member_drained_reqs = 98204    # queued requests drained on the OLD mesh
+l_member_plans_dropped = 98205   # sharding plans invalidated by transitions
+l_member_pool_dropped = 98206    # staging buffers released by transitions
+l_member_suspect_retires = 98207  # retired chips the scoreboard had SUSPECT
+l_member_target_chips = 98208    # gauge: configured ec_mesh_chips target
+MEMBER_LAST = 98220
+
+_member_pc: Optional[PerfCounters] = None
+_member_pc_lock = DebugLock("mesh_membership_pc::init")
+
+
+def membership_perf_counters() -> PerfCounters:
+    """The elastic-membership counter logger: every injectargs-driven
+    ``ec_mesh_chips`` transition (drain, invalidation, add/retire
+    accounting) lands here, so a chaos storyline's mesh_chip_add /
+    mesh_chip_retire legs are visible on perf dump and Prometheus."""
+    global _member_pc
+    if _member_pc is not None:
+        return _member_pc
+    with _member_pc_lock:
+        if _member_pc is None:
+            b = PerfCountersBuilder("mesh_membership", MEMBER_FIRST,
+                                    MEMBER_LAST)
+            b.add_u64_counter(l_member_transitions, "transitions",
+                              "applied ec_mesh_chips topology changes")
+            b.add_u64_counter(l_member_chip_adds, "chip_adds",
+                              "chips added across membership "
+                              "transitions")
+            b.add_u64_counter(l_member_chip_retires, "chip_retires",
+                              "chips retired across membership "
+                              "transitions")
+            b.add_u64_counter(l_member_drained_reqs, "drained_reqs",
+                              "queued requests drained on the old "
+                              "mesh before a rebuild")
+            b.add_u64_counter(l_member_plans_dropped, "plans_dropped",
+                              "sharding plans invalidated by "
+                              "membership transitions")
+            b.add_u64_counter(l_member_pool_dropped, "pool_dropped",
+                              "staging buffers released by "
+                              "membership transitions")
+            b.add_u64_counter(l_member_suspect_retires,
+                              "suspect_retires",
+                              "retired chips the skew scoreboard "
+                              "held SUSPECT at retire time")
+            b.add_u64(l_member_target_chips, "target_chips",
+                      "configured ec_mesh_chips target")
+            _member_pc = b.create_perf_counters()
+    return _member_pc
 
 
 def chip_occupancy_axes() -> List[PerfHistogramAxis]:
@@ -178,6 +234,15 @@ class MeshRuntime:
         self._pool = StagingPool()
         self._chips: Dict[int, Dict[str, int]] = {}
         self._rateless = RatelessCoder()
+        # while held, topology() keeps serving the CURRENT mesh even if
+        # ec_mesh_chips changed underneath — the membership transition
+        # sets this so the dispatcher drain completes every in-flight
+        # flush against the mesh it was admitted under
+        self._hold = False
+        self._transitions = 0
+        # injectargs-live membership: the observer fires synchronously
+        # from config set / injectargs, drains, and rebuilds eagerly
+        g_conf.add_observer("ec_mesh_chips", self._on_chips_changed)
 
     # ---- options (read live so `config set` applies without restart) ------
     @staticmethod
@@ -195,18 +260,29 @@ class MeshRuntime:
     # ---- topology ----------------------------------------------------------
     def topology(self):
         """The current batch mesh, rebuilt when ``ec_mesh_chips``
-        changes (plans are placement-bound, so they drop with it)."""
+        changes (plans are placement-bound, so they drop with it).
+
+        While ``_hold`` is set (a membership transition is draining the
+        dispatcher) the EXISTING mesh keeps being served, so every
+        queued flush completes against the topology it was admitted
+        under; the rebuild happens when the transition releases the
+        hold and calls back in."""
         chips, pool_cap, _donate = self._opts()
+        transition = None
         with self._lock:
-            if self._mesh is not None and self._mesh_n == chips:
+            if self._mesh is not None and (self._mesh_n == chips
+                                           or self._hold):
                 # ec_mesh_pool_buffers stays live even when the
                 # topology is unchanged (guarded: one unlocked read
                 # per flush, the trim only runs on an actual change)
                 if self._pool._per_shape != max(int(pool_cap), 1):
                     self._pool.set_capacity(pool_cap)
                 return self._mesh
+            prev_n = self._mesh_n
+            prev_size = 0 if self._mesh is None else self._mesh.size
+            plans_dropped = len(self._plans)
             self._plans.clear()
-            self._pool.clear()
+            pool_dropped = self._pool.clear()
             self._pool.set_capacity(pool_cap)
             self._chips.clear()
             if chips == 0:
@@ -214,10 +290,86 @@ class MeshRuntime:
             else:
                 self._mesh = batch_mesh(chips)
                 self._mesh_n = chips
-                mesh_perf_counters().set(l_mesh_chips, self._mesh.size)
-            if self._mesh is None:
-                mesh_perf_counters().set(l_mesh_chips, 0)
-            return self._mesh
+            new_size = 0 if self._mesh is None else self._mesh.size
+            mesh_perf_counters().set(l_mesh_chips, new_size)
+            if (prev_n is not None and prev_size > 0 and new_size > 0
+                    and prev_size != new_size):
+                # a live mesh changed size — a membership transition
+                # (mesh up 0->N and mesh down N->0 are lifecycle, not
+                # membership).  Stash the facts, account outside the
+                # lock (journal and scoreboard take their own locks).
+                self._transitions += 1
+                transition = (prev_size, new_size, plans_dropped,
+                              pool_dropped)
+            mesh = self._mesh
+        if transition is not None:
+            self._member_transition(*transition)
+        return mesh
+
+    def _on_chips_changed(self, _name: str, value) -> None:
+        """``ec_mesh_chips`` config observer (registered at
+        construction): makes membership injectargs-live.  Drain first —
+        hold the old topology so ``g_dispatcher.flush()`` completes
+        every queued request on the mesh it was admitted under (the
+        rateless path finishes from the first sufficient subset, so a
+        retiring chip that is already failing costs bandwidth, never a
+        flush) — then release and rebuild eagerly via ``topology()``,
+        which does the invalidation + add/retire accounting."""
+        try:
+            target = int(value)
+        except (TypeError, ValueError):
+            return
+        membership_perf_counters().set(l_member_target_chips,
+                                       max(target, 0))
+        with self._lock:
+            if self._mesh_n is None or self._mesh_n == target:
+                return          # never built, or an idempotent re-set
+            self._hold = True
+        try:
+            from ..dispatch import g_dispatcher
+            drained = g_dispatcher.flush()
+        finally:
+            with self._lock:
+                self._hold = False
+        if drained:
+            membership_perf_counters().inc(l_member_drained_reqs,
+                                           int(drained))
+        self.topology()
+
+    def _member_transition(self, prev_size: int, new_size: int,
+                           plans_dropped: int, pool_dropped: int
+                           ) -> None:
+        """Post-rebuild accounting for one membership transition:
+        counters, the mesh_chip_add / mesh_chip_retire journal events
+        (the composable storyline steps, docs/CHAOS.md), and the
+        scoreboard epoch roll — chip indices re-map with the topology,
+        so a retired chip's skew streak must not indict its successor.
+        Runs OUTSIDE MeshRuntime::lock."""
+        from .chipstat import g_chipstat
+        pc = membership_perf_counters()
+        pc.inc(l_member_transitions)
+        if plans_dropped:
+            pc.inc(l_member_plans_dropped, plans_dropped)
+        if pool_dropped:
+            pc.inc(l_member_pool_dropped, pool_dropped)
+        if new_size > prev_size:
+            pc.inc(l_member_chip_adds, new_size - prev_size)
+            g_journal.emit("mesh", "mesh_chip_add",
+                           chips_from=prev_size, chips_to=new_size,
+                           added=new_size - prev_size,
+                           plans_dropped=plans_dropped)
+        elif new_size < prev_size:
+            retired = list(range(new_size, prev_size))
+            suspects = sorted(g_chipstat.suspect_set()
+                              & set(retired))
+            pc.inc(l_member_chip_retires, prev_size - new_size)
+            if suspects:
+                pc.inc(l_member_suspect_retires, len(suspects))
+            g_journal.emit("mesh", "mesh_chip_retire",
+                           chips_from=prev_size, chips_to=new_size,
+                           retired=retired, suspects_retired=suspects,
+                           plans_dropped=plans_dropped)
+        g_chipstat.reset()
 
     def active(self) -> bool:
         """True when flushes should shard: a mesh of >= 2 devices is
@@ -448,6 +600,7 @@ class MeshRuntime:
                       "donated": p.donated, "hits": p.hits}
                      for key, p in sorted(self._plans.items(),
                                           key=lambda kv: str(kv[0]))]
+            transitions, hold = self._transitions, self._hold
         from .chipstat import g_chipstat
         return {
             "options": {"ec_mesh_chips": chips,
@@ -460,6 +613,12 @@ class MeshRuntime:
             "plans": plans,
             "pool": self._pool.dump(),
             "counters": mesh_perf_counters().dump(),
+            # elastic membership (injectargs-live ec_mesh_chips):
+            # transition count, the drain hold flag, and the
+            # mesh_membership counter family
+            "membership": {"transitions": transitions, "hold": hold,
+                           "counters":
+                               membership_perf_counters().dump()},
             # the rateless coded-encode pane (rateless.py): options,
             # coding geometry for the live mesh, and the
             # mesh_rateless_* counter family

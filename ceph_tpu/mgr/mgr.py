@@ -488,6 +488,21 @@ class Manager(Dispatcher):
         lines.append("# TYPE ceph_cluster_incidents_total gauge")
         lines.append(f"ceph_cluster_incidents_total "
                      f"{self.incident.captures_total}")
+        # chaos rollup: storylines executed / accepted in this process
+        # (the full per-scenario breakdown rides the chaos logger below)
+        from ..chaos.engine import (chaos_perf_counters,
+                                    l_chaos_accept_pass, l_chaos_scenarios)
+        cpc = chaos_perf_counters()
+        lines.append("# HELP ceph_cluster_chaos_scenarios composed-"
+                     "chaos storylines executed end to end")
+        lines.append("# TYPE ceph_cluster_chaos_scenarios gauge")
+        lines.append(f"ceph_cluster_chaos_scenarios "
+                     f"{cpc.get(l_chaos_scenarios)}")
+        lines.append("# HELP ceph_cluster_chaos_accepted composed-"
+                     "chaos storylines that passed universal acceptance")
+        lines.append("# TYPE ceph_cluster_chaos_accepted gauge")
+        lines.append(f"ceph_cluster_chaos_accepted "
+                     f"{cpc.get(l_chaos_accept_pass)}")
         if perf_collection is not None:
             dump = perf_collection.dump()
             for logger, counters in sorted(dump.items()):

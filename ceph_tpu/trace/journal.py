@@ -54,6 +54,11 @@ EVENT_TYPES = (
     "incident_capture",    # mgr: incident bundle captured into the archive
     "incident_drop",       # mgr: capture failed, bundle dropped
     "incident_resolve",    # mgr: open incident's triggering check cleared
+    "mesh_chip_add",       # mesh: elastic membership grew the dispatch mesh
+    "mesh_chip_retire",    # mesh: elastic membership retired mesh chip(s)
+    "chaos_scenario_start",  # chaos: a composed storyline began executing
+    "chaos_event",         # chaos: one scheduled storyline step fired
+    "chaos_scenario_end",  # chaos: storyline finished, acceptance judged
 )
 
 _EVENT_SET = frozenset(EVENT_TYPES)

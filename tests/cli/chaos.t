@@ -1,0 +1,126 @@
+Composed-chaos admin CLI (`ceph daemon <who> chaos dump|compose`),
+in the style of the reference's recorded src/test/cli transcripts: the
+engine pane of a restored cluster (leg catalog, fault-site inventory,
+zeroed counters, option defaults pinned), a deterministic storyline
+composed from seed=24, and the missing-seed refusal.  Same-seed
+equality and the full run_scenario acceptance are covered in-process
+by tests/test_chaos_composer.py.
+
+  $ python -c "from ceph_tpu.cluster import MiniCluster; MiniCluster(n_osds=2).checkpoint('ck')"
+
+  $ ceph --cluster ck daemon osd.0 chaos dump
+  {
+    "counters": {
+      "accept_fail": 0,
+      "accept_pass": 0,
+      "active": 0,
+      "checks_cleared": 0,
+      "checks_raised": 0,
+      "events": 0,
+      "faults_armed": 0,
+      "faults_cleared": 0,
+      "legs": 0,
+      "scenarios": 0,
+      "wedges": 0
+    },
+    "fault_sites": {
+      "control.actuate": "mgr control-plane config injection (ceph_tpu/control): a firing fails ONE knob actuation; the controller retries mgr_control_actuate_retries times within the tick, then drops the move and re-derives it next tick \u2014 context is '<knob>=<value> (<option>)' for match= scoping",
+      "device.decode_batch": "batched EC decode/reconstruct device call (matrix_plugin.decode_batch)",
+      "device.encode_batch": "batched EC encode device call (matrix_plugin.encode_batch)",
+      "device.encode_chunks": "per-stripe encode device call (matrix_plugin.encode_chunks)",
+      "dispatch.batch": "coalesced flush execution (scheduler._execute run_group) \u2014 exercises the per-request fallback isolation",
+      "mesh.chip_fail": "hard per-chip failure mid-flush (ceph_tpu/mesh/rateless): the matching chip's coded blocks become erasures the subset completion re-solves around; context is 'chip=<i>/<mesh size>' for match= scoping, count= bounds the failed flushes",
+      "mesh.chip_slowdown": "per-chip straggler injection (ceph_tpu/mesh/chipstat): delays the matching chip's probe readback by delay_us; context is 'chip=<i>/<mesh size>' so match='chip=3/' scopes one chip",
+      "mesh.encode_batch": "mesh-sharded flush execution (ceph_tpu/mesh runtime) \u2014 exhaustion degrades the flush to the single-device path",
+      "mgr.incident_capture": "incident bundle snapshot on a health-check raise (ceph_tpu/mgr/incident): a firing drops that bundle \u2014 the raise is journaled, the tick proceeds, and the NEXT raise captures normally; context is the triggering check name",
+      "msg.drop": "drop a fabric message (ms inject socket failures role); context is '<MsgType> <src>><dst>' for match= scoping",
+      "osd.shard_read_eio": "shard-side EC read returns EIO (bluestore_debug_inject_read_err role) \u2014 the primary must reconstruct from surviving shards",
+      "recovery.helper_fetch": "helper-side repair contribution read (handle_sub_read) \u2014 a dropped helper fails the round and the orchestrator falls back to full-stripe decode",
+      "recovery.repair_read": "sub-chunk repair round start (recovery scheduler) \u2014 firing degrades the repair to the full-stripe decode path",
+      "tpu.decode_batch_device": "device-resident decode entry point (tpu_plugin, mesh/bench)",
+      "tpu.encode_batch_device": "device-resident encode entry point (tpu_plugin, mesh/bench)"
+    },
+    "legs": [
+      "abusive_client",
+      "capture_drop",
+      "chip_fail",
+      "chip_straggler",
+      "control_flap",
+      "device_error",
+      "mesh_membership",
+      "msg_drop",
+      "recovery_storm",
+      "shard_eio"
+    ],
+    "options": {
+      "chaos_settle_ticks_max": 64,
+      "chaos_storyline_legs_max": 3
+    }
+  }
+
+The composer is a pure function of the seed: the same seed always
+yields this exact storyline (arm/clear rounds on the deterministic
+cluster clock, expected health checks, journal shape) — seed=24 is one
+of the two pinned tier-1 smoke seeds.
+
+  $ ceph --cluster ck daemon osd.0 chaos compose seed=24
+  {
+    "events": [
+      {
+        "action": "fault_arm",
+        "count": 4,
+        "match": "chip=2/",
+        "mode": "always",
+        "round": 1,
+        "site": "mesh.chip_fail"
+      },
+      {
+        "action": "fault_arm",
+        "delay_us": 30000,
+        "match": "chip=6/",
+        "mode": "always",
+        "round": 1,
+        "site": "mesh.chip_slowdown"
+      },
+      {
+        "action": "mesh_chip_retire",
+        "chips": 1,
+        "round": 4
+      },
+      {
+        "action": "fault_clear",
+        "round": 6,
+        "site": "mesh.chip_fail"
+      },
+      {
+        "action": "mesh_chip_add",
+        "chips": 1,
+        "round": 10
+      }
+    ],
+    "expected_checks": [
+      "TPU_MESH_SKEW"
+    ],
+    "journal_expect": [
+      "chip_suspect_mark",
+      "fault_arm",
+      "fault_fire",
+      "mesh_chip_add",
+      "mesh_chip_retire"
+    ],
+    "legs": [
+      "chip_fail",
+      "chip_straggler",
+      "mesh_membership"
+    ],
+    "rate_multipliers": [],
+    "seed": 24,
+    "settle_clears": [
+      "mesh.chip_slowdown"
+    ],
+    "tolerates_missing_bundle": false
+  }
+
+  $ ceph --cluster ck daemon osd.0 chaos compose
+  admin socket: chaos compose requires seed=<int>
+  [1]

@@ -115,3 +115,86 @@ tick (tests/test_control.py proves it never wedges).
   {
     "cleared": 0
   }
+
+The machine-readable site list (the composer's sites() API over
+the admin socket): one row per site, sorted by name, `armed` is
+the live trigger spec or null.
+
+  $ ceph --cluster ck daemon osd.0 fault list format=json
+  [
+    {
+      "armed": null,
+      "description": "mgr control-plane config injection (ceph_tpu/control): a firing fails ONE knob actuation; the controller retries mgr_control_actuate_retries times within the tick, then drops the move and re-derives it next tick \u2014 context is '<knob>=<value> (<option>)' for match= scoping",
+      "name": "control.actuate"
+    },
+    {
+      "armed": null,
+      "description": "batched EC decode/reconstruct device call (matrix_plugin.decode_batch)",
+      "name": "device.decode_batch"
+    },
+    {
+      "armed": null,
+      "description": "batched EC encode device call (matrix_plugin.encode_batch)",
+      "name": "device.encode_batch"
+    },
+    {
+      "armed": null,
+      "description": "per-stripe encode device call (matrix_plugin.encode_chunks)",
+      "name": "device.encode_chunks"
+    },
+    {
+      "armed": null,
+      "description": "coalesced flush execution (scheduler._execute run_group) \u2014 exercises the per-request fallback isolation",
+      "name": "dispatch.batch"
+    },
+    {
+      "armed": null,
+      "description": "hard per-chip failure mid-flush (ceph_tpu/mesh/rateless): the matching chip's coded blocks become erasures the subset completion re-solves around; context is 'chip=<i>/<mesh size>' for match= scoping, count= bounds the failed flushes",
+      "name": "mesh.chip_fail"
+    },
+    {
+      "armed": null,
+      "description": "per-chip straggler injection (ceph_tpu/mesh/chipstat): delays the matching chip's probe readback by delay_us; context is 'chip=<i>/<mesh size>' so match='chip=3/' scopes one chip",
+      "name": "mesh.chip_slowdown"
+    },
+    {
+      "armed": null,
+      "description": "mesh-sharded flush execution (ceph_tpu/mesh runtime) \u2014 exhaustion degrades the flush to the single-device path",
+      "name": "mesh.encode_batch"
+    },
+    {
+      "armed": null,
+      "description": "incident bundle snapshot on a health-check raise (ceph_tpu/mgr/incident): a firing drops that bundle \u2014 the raise is journaled, the tick proceeds, and the NEXT raise captures normally; context is the triggering check name",
+      "name": "mgr.incident_capture"
+    },
+    {
+      "armed": null,
+      "description": "drop a fabric message (ms inject socket failures role); context is '<MsgType> <src>><dst>' for match= scoping",
+      "name": "msg.drop"
+    },
+    {
+      "armed": null,
+      "description": "shard-side EC read returns EIO (bluestore_debug_inject_read_err role) \u2014 the primary must reconstruct from surviving shards",
+      "name": "osd.shard_read_eio"
+    },
+    {
+      "armed": null,
+      "description": "helper-side repair contribution read (handle_sub_read) \u2014 a dropped helper fails the round and the orchestrator falls back to full-stripe decode",
+      "name": "recovery.helper_fetch"
+    },
+    {
+      "armed": null,
+      "description": "sub-chunk repair round start (recovery scheduler) \u2014 firing degrades the repair to the full-stripe decode path",
+      "name": "recovery.repair_read"
+    },
+    {
+      "armed": null,
+      "description": "device-resident decode entry point (tpu_plugin, mesh/bench)",
+      "name": "tpu.decode_batch_device"
+    },
+    {
+      "armed": null,
+      "description": "device-resident encode entry point (tpu_plugin, mesh/bench)",
+      "name": "tpu.encode_batch_device"
+    }
+  ]

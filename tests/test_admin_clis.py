@@ -98,6 +98,20 @@ def test_incident_cli_cram(tmp_path):
     assert_cram(path, str(tmp_path))
 
 
+def test_chaos_cli_cram(tmp_path):
+    """`ceph daemon <who> chaos dump|compose` replayed from a recorded
+    transcript (tests/cli/chaos.t): the engine pane of a restored
+    cluster (leg catalog, fault-site inventory, zeroed counters,
+    option defaults pinned), the deterministic storyline composed from
+    pinned seed 24, and the missing-seed refusal — through the same
+    `ceph` shim as fault.t (same-seed schedule equality and the full
+    run_scenario universal acceptance are covered in-process by
+    tests/test_chaos_composer.py)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cli", "chaos.t")
+    assert_cram(path, str(tmp_path))
+
+
 def test_status_cli_cram(tmp_path):
     """`ceph daemon <who> tpu status` + `telemetry dump|reset`
     replayed from a recorded transcript (tests/cli/status.t): the

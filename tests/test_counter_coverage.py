@@ -151,6 +151,14 @@ def test_known_new_families_covered_by_the_lint(cluster_and_text):
     # the generic exposition/coverage lints above
     assert "control" in c.perf_collection.dump()
     assert "skipped_cooldown" in c.perf_collection.dump()["control"]
+    # chaos-PR canaries: the scenario engine's logger and the elastic
+    # mesh-membership family are registered on every cluster, so
+    # ceph_daemon_chaos_* / ceph_daemon_mesh_membership_* ride the
+    # generic exposition/coverage lints above
+    assert "chaos" in c.perf_collection.dump()
+    assert "accept_pass" in c.perf_collection.dump()["chaos"]
+    assert "mesh_membership" in c.perf_collection.dump()
+    assert "drained_reqs" in c.perf_collection.dump()["mesh_membership"]
     from ceph_tpu.trace import g_perf_histograms
     from ceph_tpu.trace.oplat import stage_of_hist_name
     assert any(lg == "devprof" for (lg, _n), _h

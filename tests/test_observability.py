@@ -266,6 +266,17 @@ def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
     c.admin_socket.execute("tpu incident dump")
     assert calls["n"] == 0, "journal emit / incident capture added " \
         "a device sync"
+    # chaos extension: the composer is pure host-side seeded sampling
+    # (no jax import at all), and a FULL storyline run — engine knobs,
+    # open-loop traffic, fault arms, settle ticks, acceptance judgment
+    # — rides the same sync-free dispatch/mesh/trace surfaces end to
+    # end: zero added fences for the whole chaos machinery
+    from ceph_tpu.chaos import compose_scenario, run_seed
+    assert compose_scenario(24) == compose_scenario(24)
+    assert calls["n"] == 0, "composing a storyline added a device sync"
+    r = run_seed(24)
+    assert r["accepted"], r
+    assert calls["n"] == 0, "a full storyline run added a device sync"
 
 
 def test_slow_op_span_tree_and_histogram_dump(clean_tracing):

@@ -479,3 +479,30 @@ def test_journal_and_incident_counters(exposition):
     caps = [v for n, _l, v in samples
             if n == "ceph_cluster_incidents_total"]
     assert caps == [0.0], caps
+
+
+def test_chaos_and_membership_counters(exposition):
+    """Chaos-PR golden coverage (ceph_tpu/chaos + elastic mesh
+    membership): the ``chaos`` and ``mesh_membership`` logger counters
+    render as daemon series, and the cluster-scope storyline rollups
+    render as the ``ceph_cluster_chaos_*`` gauges.  Presence is the
+    contract (both loggers are process-global); the fixture ran no
+    storyline, so the scenario gauge must render zero."""
+    types, samples = _parse(exposition)
+    for counter in ("ceph_daemon_chaos_scenarios",
+                    "ceph_daemon_chaos_legs",
+                    "ceph_daemon_chaos_events",
+                    "ceph_daemon_chaos_faults_armed",
+                    "ceph_daemon_chaos_accept_pass",
+                    "ceph_daemon_chaos_accept_fail",
+                    "ceph_daemon_chaos_wedges",
+                    "ceph_daemon_mesh_membership_transitions",
+                    "ceph_daemon_mesh_membership_chip_adds",
+                    "ceph_daemon_mesh_membership_chip_retires",
+                    "ceph_daemon_mesh_membership_drained_reqs",
+                    "ceph_daemon_mesh_membership_suspect_retires",
+                    "ceph_daemon_mesh_membership_target_chips"):
+        vals = [v for n, _l, v in samples if n == counter]
+        assert vals, f"{counter} missing from the exposition"
+    assert types["ceph_cluster_chaos_scenarios"] == "gauge"
+    assert types["ceph_cluster_chaos_accepted"] == "gauge"
