@@ -61,6 +61,7 @@ def main(argv=None) -> int:
     from . import regress
     from .workloads import (bench_perf_counters,
                             measure_composed_chaos, measure_decode,
+                            measure_degraded_read,
                             measure_dispatch_coalesce,
                             measure_ec_mesh, measure_ec_pipeline,
                             measure_encode, measure_host_native,
@@ -131,7 +132,7 @@ def main(argv=None) -> int:
             matrix, mesh_chips=8 if args.smoke else -1,
             target_seconds=0.3 if args.smoke else 2.0,
             repeats=repeats, warmup=warmup,
-            n_steps=6 if args.smoke else None)
+            n_steps=3 if args.smoke else None)
         result["metrics"] += [mm, mm1]
         occupied = sum(1 for v in mm["per_chip_stripes"].values()
                        if v > 0)
@@ -188,14 +189,31 @@ def main(argv=None) -> int:
         # an OSD under open-loop traffic, gate bytes-moved-per-
         # repaired-shard for the regenerating family vs RS full-stripe
         mr = measure_recovery_storm(
-            n_objects=8 if args.smoke else 24,
-            ops_per_client=12 if args.smoke else 48)
+            n_objects=6 if args.smoke else 24,
+            ops_per_client=8 if args.smoke else 48)
         result["metrics"].append(mr)
         rec = mr["recovery"]
         progress(f"recovery_storm {rec['bytes_per_repaired_shard_regen']}"
                  f" B/shard regen vs {rec['bytes_per_repaired_shard_rs']}"
                  f" RS (ratio {rec['regen_vs_rs_ratio']}, identical "
                  f"{mr['identical']}, slo {mr['slo']})")
+        # degraded-read A/B (ceph_tpu/mesh, docs/DISPATCH.md): shard
+        # kill under open-loop traffic, then meshed rateless decode
+        # healthy vs one chip slowed 10x vs the mesh-off single-device
+        # twin — the read-side STRAGGLER GATE receipt
+        md = measure_degraded_read(
+            n_batches=10 if args.smoke else 32,
+            ops_per_client=6 if args.smoke else 32)
+        result["metrics"].append(md)
+        sd = md["straggler"]
+        progress(f"degraded_read protected p999 "
+                 f"x{sd['protected_p999_ratio']} rollup / "
+                 f"x{sd['protected_p999_wall_ratio']} wall of healthy "
+                 f"(detected in {sd['detection_probes']} probes, "
+                 f"bw overhead x{sd['bandwidth_overhead']}, "
+                 f"subset completions {sd['subset_completions']}, "
+                 f"fallbacks {sd['single_device_fallbacks']}, "
+                 f"identical {md['identical']})")
         # self-tuning control plane (ceph_tpu/control, docs/CONTROL.md):
         # the three closed-loop scenarios on real clusters, the
         # actuation receipts gated by regress.py's CONTROL GATE

@@ -85,10 +85,15 @@ RECOVERY_TOLERANCE = 0.10
 # ruler that cries wolf is worse than no ruler.
 SKEW_MAX_DETECTION_PROBES = 8
 
-# the STRAGGLER GATE (rateless coded mesh encode PR): the
-# ec_mesh_straggler workload's `straggler` block A/Bs the rateless
-# path healthy vs one-chip-slowed-10x on one mini cluster.  Absolute
-# invariants like the SKEW GATE — the fix either holds or it does not:
+# the STRAGGLER GATE (rateless coded mesh encode PR; extended to the
+# READ path by the meshed-decode PR): every fenced workload carrying a
+# `straggler` block is judged by the same absolute invariants —
+# ec_mesh_straggler A/Bs the rateless ENCODE path healthy vs
+# one-chip-slowed-10x, ec_degraded_read drives the meshed rateless
+# DECODE path (shard killed under open-loop traffic, every read a
+# survivor-sharded reconstruct) through the identical twin protocol.
+# Absolute invariants like the SKEW GATE — the fix either holds or it
+# does not:
 # - the scoreboard must detect the slowed chip within the probe window
 #   and report a nonzero skew ratio (the injected-degradation receipt:
 #   a quiet run proves nothing);
@@ -101,7 +106,8 @@ SKEW_MAX_DETECTION_PROBES = 8
 # - every op byte-identical to the unprotected oracle (subset
 #   completion + host re-solves invisible in the bytes);
 # - zero single-device fallbacks (completion must come from the
-#   surviving subset, not the degradation ladder) and at least one
+#   surviving subset, not the degradation ladder — on the read side a
+#   fallback is a `mesh_decode_fallbacks` tick) and at least one
 #   subset completion (the protection actually engaged);
 # - the healthy twin pays < 2x coded-bandwidth overhead and marks no
 #   false suspects.

@@ -1444,6 +1444,246 @@ def measure_recovery_storm(*, k: int = 8, m: int = 4, d: int = 10,
         })
 
 
+def measure_degraded_read(*, mesh_chips: int = 8, slow_chip: int = 5,
+                          delay_us: int = 30_000, threshold: float = 3.0,
+                          n_batches: int = 16, detect_max: int = 10,
+                          n_objects: int = 4, object_bytes: int = 4096,
+                          k: int = 4, m: int = 2,
+                          n_clients: int = 4, ops_per_client: int = 8,
+                          meshoff_batches: int = 6,
+                          seed: int = 20260807,
+                          name: str = "ec_degraded_read"
+                          ) -> Dict[str, Any]:
+    """The straggler-proof degraded-read A/B (docs/DISPATCH.md
+    "Mesh-sharded degraded reads"): kill a data-shard OSD under
+    open-loop harness traffic, then drive every read of the pool
+    through the meshed rateless decode path with one chip slowed 10x —
+    the read-side twin of ``ec_mesh_straggler``, judged by the same
+    STRAGGLER GATE.
+
+    Shape: one pg_num=1 EC pool so a single OSD kill (acting[1] — a
+    non-primary DATA shard) degrades every object; the traffic harness
+    lands the kill mid-run (open-loop clients stay byte-exact through
+    it), after which the cluster never backfills (down, not out) and
+    each read is a fresh survivor-sharded decode.  Four legs on the
+    degraded cluster, each a cluster_rollup window like the encode
+    twin's:
+
+    1. **healthy** (mesh on, rateless on): N read batches; phase
+       rollup yields the healthy ``device_call`` p999 and the
+       DECODE_SITES h2d deltas yield the coded-bandwidth overhead
+       (parity over systematic — gated < 2x).
+    2. **detect** (``mesh.chip_slowdown`` armed on *slow_chip*): read
+       batches until the scoreboard marks the suspect from decode
+       probes alone — no write traffic to help.
+    3. **protected steady state** (fault armed, chip SUSPECT): N more
+       batches; ``device_call`` p999 over the healthy twin's is the
+       gated ``protected_p999_ratio``, wall p999 the unquantized
+       companion.
+    4. **mesh-off twin** (``ec_mesh_chips=1`` via the checked-set
+       membership transition): the single-device decode baseline the
+       tentpole replaced, reported as the stage_breakdown A/B
+       (``device_call``/``d2h`` per-op, mesh-on vs mesh-off).
+
+    Every read byte-compared against the pre-populated body; the
+    protected legs must record zero ``mesh_decode_fallbacks`` —
+    completion comes from the first spanning subset, not the
+    single-device degradation ladder.
+    """
+    from ..cluster import MiniCluster
+    from ..common.config import g_conf
+    from ..fault import g_faults
+    from ..load import TrafficSpec, run_traffic
+    from ..mesh import g_chipstat, g_mesh, rateless_perf_counters
+    from ..mesh.runtime import (l_mdec_fallbacks,
+                                mesh_decode_perf_counters)
+
+    saved = {opt: g_conf.values.get(opt) for opt in
+             ("ec_mesh_chips", "ec_mesh_skew_sample_every",
+              "ec_mesh_skew_threshold", "ec_mesh_rateless",
+              "ec_mesh_rateless_tasks")}
+    g_conf.set_val("ec_mesh_chips", mesh_chips)
+    g_conf.set_val("ec_mesh_skew_sample_every", 1)
+    g_conf.set_val("ec_mesh_skew_threshold", threshold)
+    g_conf.set_val("ec_mesh_rateless", True)
+
+    cluster = MiniCluster(n_osds=k + m + 2)
+    cluster.create_ec_pool("dread", k=k, m=m, pg_num=1, plugin="tpu")
+    cl = cluster.client("client.dread")
+    rng = np.random.default_rng(seed)
+    bodies: Dict[str, bytes] = {}
+    for i in range(n_objects):
+        body = rng.integers(0, 256, object_bytes,
+                            dtype=np.uint8).tobytes()
+        bodies[f"dread-{i}"] = body
+        assert cl.write_full("dread", f"dread-{i}", body) == 0
+    pool_id = cluster.mon.osdmap.lookup_pg_pool_name("dread")
+    acting = next(pg.acting for pgid, pg in cluster.primary_pgs()
+                  if pg.backend is not None and pgid[0] == pool_id)
+    victim = acting[1]                  # non-primary DATA shard
+    flow0 = g_devprof.snapshot()
+    stage0 = g_oplat.snapshot()
+    t_wall0 = time.perf_counter()
+    n_batches_total = [0]
+    identical = [True]
+
+    def read_batch() -> float:
+        """One batch of degraded reads (every object, byte-compared);
+        returns the wall seconds of the read section."""
+        n_batches_total[0] += 1
+        t0 = time.perf_counter()
+        got = [cl.read("dread", oid) for oid in bodies]
+        wall = time.perf_counter() - t0
+        for g, body in zip(got, bodies.values()):
+            identical[0] = identical[0] and g == body
+        cluster.tick(dt=1.0)     # the mgr rolls up DURING the run
+        return wall
+
+    def phase(n: int):
+        """Run *n* read batches as one cluster_rollup window; returns
+        (device_call percentiles from the phase rollup, wall p999) —
+        the anchored-window pattern of measure_mesh_straggler."""
+        cluster.tick(dt=1.0)
+        clock0 = cluster.clock
+        walls = [read_batch() for _ in range(n)]
+        roll = cluster.mgr.telemetry.rollup(
+            window_s=cluster.clock - clock0 - 0.5)
+        dc = roll.get("oplat", {}).get("device_call", {})
+        walls.sort()
+        p999_wall = walls[min(int(np.ceil(0.999 * len(walls))) - 1,
+                              len(walls) - 1)]
+        return dc, p999_wall * 1e6
+
+    def stage_pair(before, wall_s: float, n_ops: int) -> Dict[str, Any]:
+        sb = _stage_breakdown_since(before, max(wall_s, 1e-3),
+                                    max(n_ops, 1))
+        stages = sb.get("stages") or {}
+        return {st: stages.get(st, {}) for st in ("device_call", "d2h")}
+
+    try:
+        # ---- the storm: open-loop traffic, kill landing mid-run ------
+        spec = TrafficSpec(
+            pool="dread", n_clients=n_clients,
+            ops_per_client=ops_per_client, read_fraction=0.5,
+            mode="open", rate=4.0, seed=seed, keep_completions=False,
+            events=((1, "osd_kill", victim),))
+        res = run_traffic(cluster, spec)
+        traffic_byte_exact = bool(res.byte_exact)
+        read_batch()                    # decode compile warmup
+        g_chipstat.reset()
+        mdec0 = mesh_decode_perf_counters().get(l_mdec_fallbacks)
+        # ---- leg 1: healthy twin, meshed rateless decode -------------
+        sites0 = {s: dict(v) for s, v in
+                  g_devprof.dump()["sites"].items()}
+        on0 = g_oplat.snapshot()
+        t_on0 = time.perf_counter()
+        healthy_dc, healthy_wall_p999 = phase(n_batches)
+        sites1 = g_devprof.dump()["sites"]
+
+        def h2d_delta(site: str) -> int:
+            return (sites1.get(site, {}).get("h2d_bytes", 0)
+                    - sites0.get(site, {}).get("h2d_bytes", 0))
+
+        sys_h2d = h2d_delta("mesh.decode")
+        parity_h2d = h2d_delta("mesh.decode_parity")
+        bandwidth_overhead = round(
+            (sys_h2d + parity_h2d) / max(sys_h2d, 1), 4)
+        healthy_false_suspects = len(g_chipstat.suspects())
+        # ---- leg 2: slow one chip, detect from decode probes alone ---
+        rl0 = rateless_perf_counters().dump()
+        g_faults.inject("mesh.chip_slowdown", mode="always",
+                        match=f"chip={slow_chip}/", delay_us=delay_us)
+        detection_probes = 0
+        for i in range(1, detect_max + 1):
+            read_batch()
+            if g_chipstat.suspects():
+                detection_probes = i
+                break
+        suspects = g_chipstat.suspects()
+        detected_chip = suspects[0]["chip"] if suspects else -1
+        skew_ratio_detected = suspects[0]["skew_ratio"] if suspects \
+            else 0.0
+        # ---- leg 3: protected steady state (chip SUSPECT, slow) ------
+        slowed_dc, slowed_wall_p999 = phase(n_batches)
+        n_on_ops = n_batches_total[0] * n_objects
+        twin_on = stage_pair(on0, time.perf_counter() - t_on0,
+                             n_on_ops)
+        subset_completions = (rateless_perf_counters().dump()
+                              ["subset_completions"]
+                              - rl0["subset_completions"])
+        fallbacks = mesh_decode_perf_counters().get(l_mdec_fallbacks) \
+            - mdec0
+        # ---- leg 4: the mesh-off twin (single-device decode) ---------
+        g_conf.set_checked("ec_mesh_chips", 1)
+        read_batch()                    # single-device compile warmup
+        off0 = g_oplat.snapshot()
+        t_off0 = time.perf_counter()
+        batches_before = n_batches_total[0]
+        unprot_dc, unprot_wall_p999 = phase(meshoff_batches)
+        twin_off = stage_pair(
+            off0, time.perf_counter() - t_off0,
+            (n_batches_total[0] - batches_before) * n_objects)
+    finally:
+        g_faults.clear("mesh.chip_slowdown")
+        for opt, v in saved.items():
+            g_conf.rm_val(opt) if v is None else g_conf.set_val(opt, v)
+        g_mesh.topology()
+        g_chipstat.reset()
+    inc_mgr = cluster.mgr.incident
+    if inc_mgr.captures_total == 0:
+        inc_mgr.capture("operator", "degraded-read forensic snapshot",
+                        reason="operator")
+    incidents = inc_mgr.receipt()
+    wall_s = max(time.perf_counter() - t_wall0, 1e-3)
+    n_ops = n_batches_total[0] * n_objects
+    healthy_p999 = float(healthy_dc.get("p999", 0.0) or 0.0)
+    slowed_p999 = float(slowed_dc.get("p999", 0.0) or 0.0)
+    unprot_p999 = float(unprot_dc.get("p999", 0.0) or 0.0)
+    ratio = round(slowed_p999 / max(healthy_p999, 1e-9), 4)
+    wall_ratio = round(slowed_wall_p999 / max(healthy_wall_p999, 1e-9),
+                       4)
+    v = max(wall_ratio, 1e-6)
+    return make_metric(
+        name, v, "ratio", fenced=True,
+        stats={"n": 1, "median": v, "iqr": 0.0, "min": v, "max": v},
+        roofline={"verdict": "unknown", "suspect": False},
+        extra={
+            "straggler": {
+                "mesh_chips": mesh_chips,
+                "slow_chip": slow_chip,
+                "delay_us": delay_us,
+                "threshold": threshold,
+                "detection_probes": detection_probes,
+                "detected_chip": detected_chip,
+                "skew_ratio_detected": skew_ratio_detected,
+                "healthy_false_suspects": healthy_false_suspects,
+                "healthy_p999_usec": healthy_p999,
+                "slowed_p999_usec": slowed_p999,
+                "meshoff_p999_usec": unprot_p999,
+                "protected_p999_ratio": ratio,
+                "protected_p999_wall_ratio": wall_ratio,
+                "healthy_p999_wall_usec": round(healthy_wall_p999, 1),
+                "slowed_p999_wall_usec": round(slowed_wall_p999, 1),
+                "meshoff_p999_wall_usec": round(unprot_wall_p999, 1),
+                "bandwidth_overhead": bandwidth_overhead,
+                "subset_completions": int(subset_completions),
+                "single_device_fallbacks": int(fallbacks),
+                "byte_identical": bool(identical[0]
+                                       and traffic_byte_exact),
+            },
+            "victim_osd": victim,
+            "identical": bool(identical[0]),
+            "byte_exact_traffic": traffic_byte_exact,
+            "traffic_completed": res.completed,
+            "twin": {"mesh_on": twin_on, "mesh_off": twin_off},
+            "incidents": incidents,
+            "devflow": _devflow_since(flow0, max(n_ops, 1)),
+            "stage_breakdown": _stage_breakdown_since(
+                stage0, wall_s, max(n_ops, 1)),
+            "errors": res.errors[:8],
+        })
+
+
 def parity_check(matrix: np.ndarray) -> bool:
     """Encode REAL data on device, erase two data shards, decode on
     device, fetch, byte-compare against the original — the on-hardware
@@ -1704,6 +1944,8 @@ def measure_slo_autotune(*, mesh_chips: int = 8, slow_chip: int = 5,
               "ec_mesh_skew_threshold", "ec_dispatch_batch_max",
               "ec_dispatch_batch_window_us")}
     t_wall0 = time.perf_counter()
+    flow0 = g_devprof.snapshot()
+    stage0 = g_oplat.snapshot()
     byte_exact = True
     receipts: list = []
     incident_blocks: Dict[str, Any] = {}
@@ -1969,6 +2211,9 @@ def measure_slo_autotune(*, mesh_chips: int = 8, slow_chip: int = 5,
             },
             "incidents": incident_blocks,
             "receipts": receipts[-18:],
+            "devflow": _devflow_since(flow0, max(len(receipts), 1)),
+            "stage_breakdown": _stage_breakdown_since(
+                stage0, wall_s, max(len(receipts), 1)),
             "wall_s": wall_s,
         })
 
@@ -1999,6 +2244,8 @@ def measure_composed_chaos(*, seeds: Tuple[int, ...] = (24, 103),
     from ..chaos import compose_scenario, run_scenario
 
     t0 = time.perf_counter()
+    flow0 = g_devprof.snapshot()
+    stage0 = g_oplat.snapshot()
     receipts = []
     total_ops = 0
     for seed in seeds:
@@ -2018,5 +2265,8 @@ def measure_composed_chaos(*, seeds: Tuple[int, ...] = (24, 103),
                 "accepted": all(r["accepted"] for r in receipts),
                 "receipts": receipts,
             },
+            "devflow": _devflow_since(flow0, max(total_ops, 1)),
+            "stage_breakdown": _stage_breakdown_since(
+                stage0, wall_s, max(total_ops, 1)),
             "wall_s": wall_s,
         })

@@ -177,6 +177,38 @@ def _leg_chip_fail(rng: random.Random) -> Dict[str, Any]:
     }
 
 
+def _leg_degraded_read_straggler(rng: random.Random) -> Dict[str, Any]:
+    """A data shard dies while one mesh chip serves 10x slow: the
+    open-loop reads that land on the dead shard reconstruct through
+    the MESHED decode path (ceph_tpu/mesh decode_stacked) with the
+    straggler live — the rateless drain completes each group from the
+    first spanning subset, so every read stays byte-exact with zero
+    single-device fallbacks (the straggler-proof read PR's composed
+    shape).  TPU_MESH_SKEW must raise while the slowdown is armed and
+    clear after the settle-phase disarm, like the chip_straggler leg;
+    the OSD revives before settle so acceptance judges a whole
+    degrade/recover cycle."""
+    osd = rng.randrange(3)
+    chip = 1 + rng.randrange(BASE_MESH_CHIPS - 2)
+    r0 = 1 + rng.randrange(3)
+    dur = 5 + rng.randrange(5)
+    return {
+        "events": [
+            ScenarioEvent(r0, "osd_kill", (("osd", osd),)),
+            ScenarioEvent(r0, "fault_arm", (
+                ("delay_us", 30_000),
+                ("match", f"chip={chip}/"),
+                ("mode", "always"),
+                ("site", "mesh.chip_slowdown"))),
+            ScenarioEvent(r0 + dur, "osd_revive", (("osd", osd),)),
+        ],
+        "expected_checks": ("TPU_MESH_SKEW",),
+        "settle_clears": ("mesh.chip_slowdown",),
+        "journal_expect": ("osd_down", "fault_arm", "fault_fire",
+                           "chip_suspect_mark"),
+    }
+
+
 def _leg_msg_drop(rng: random.Random) -> Dict[str, Any]:
     """Seeded probabilistic loss of EC sub-op WRITES (``match=
     "MOSDECSubOpWrite "``): the pipeline's inflight sweep resends
@@ -297,6 +329,7 @@ LEG_BUILDERS: Dict[str, Callable[[random.Random], Dict[str, Any]]] = {
     "chip_fail": _leg_chip_fail,
     "chip_straggler": _leg_chip_straggler,
     "control_flap": _leg_control_flap,
+    "degraded_read_straggler": _leg_degraded_read_straggler,
     "device_error": _leg_device_error,
     "mesh_membership": _leg_mesh_membership,
     "msg_drop": _leg_msg_drop,

@@ -292,12 +292,14 @@ class MiniCluster:
         from .dispatch import dispatch_perf_counters, g_dispatcher
         self.perf_collection.add(dispatch_perf_counters())
         from .mesh import (g_chipstat, membership_perf_counters,
-                           mesh_chip_perf_counters, mesh_perf_counters,
+                           mesh_chip_perf_counters,
+                           mesh_decode_perf_counters, mesh_perf_counters,
                            rateless_perf_counters)
         self.perf_collection.add(mesh_perf_counters())
         self.perf_collection.add(mesh_chip_perf_counters())
         self.perf_collection.add(rateless_perf_counters())
         self.perf_collection.add(membership_perf_counters())
+        self.perf_collection.add(mesh_decode_perf_counters())
         asok.register(
             "mesh skew dump",
             lambda c, a: g_chipstat.dump(),

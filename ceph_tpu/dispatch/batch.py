@@ -136,7 +136,13 @@ def run_group(reqs: List[Request], bucket_c: int) -> List:
     including single-request flushes, whose stripes alone can span the
     chips — execute through the mesh runtime instead of one device;
     mesh off (the default) or size 1 is the existing path by
-    construction."""
+    construction.  Decode/reconstruct groups ride the mesh the same
+    way, but one level down: every path here funnels into the codec's
+    ``decode_batch``, whose mesh hook (matrix_plugin.py /
+    regenerating.py -> ``decode_stacked``) shards the survivor stack
+    across chips — so singles, coalesced groups, recovery reads and
+    repair solves all inherit the meshed decode without this module
+    dispatching them specially."""
     leader = reqs[0].ec_impl
     kind = reqs[0].kind
     use_device = bool(getattr(leader, "_use_device", lambda: False)())

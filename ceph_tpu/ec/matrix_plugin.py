@@ -54,6 +54,16 @@ class ErasureCodeMatrixRS(ErasureCode):
     def mesh_row_shardable(self) -> bool:
         return True
 
+    # the mesh runtime may also shard this codec's DECODE: true when
+    # decode_batch's device path is the plain inverted-survivor-matrix
+    # bit-matmul on raw (S, n_src, C) stacks.  Follows the encode gate
+    # for matrix-RS codes (a transformed layout corrupts either way);
+    # the regenerating family overrides — its encode is full-output
+    # but its ≥d decode and repair solve ARE plain survivor matmuls.
+    @property
+    def mesh_decode_shardable(self) -> bool:
+        return self.mesh_row_shardable and self._device_decode_supported
+
     def codec_signature(self):
         """The dispatcher's grouping key: everything the coding matrix
         is derived from.  Two impls with equal signatures encode and
@@ -184,14 +194,33 @@ class ErasureCodeMatrixRS(ErasureCode):
         srcs, want_data, want_coding, missing_data = plan_decode(
             self.k, chunks, want)
 
+        # meshed degraded read: the survivor matmul shards across the
+        # chip mesh (rateless-protected, its own mesh.decode_batch
+        # guard) BEFORE the single-device guard below — computed here,
+        # outside device_path, so the two fault guards never nest.
+        # None (mesh off, codec not shardable, or guard exhausted)
+        # keeps today's single-device path by construction.
+        mesh_rec = None
+        if missing_data and self._use_device() and \
+                self._device_decode_supported:
+            from ..mesh import g_mesh
+            survivors = np.stack([chunks[i] for i in srcs], axis=1)
+            mesh_rec = g_mesh.decode_stacked(self, survivors, srcs,
+                                             missing_data)
+
         def device_path() -> Dict[int, np.ndarray]:
             out: Dict[int, np.ndarray] = {i: chunks[i] for i in want
                                           if i in chunks}
             dev = self.device()
             by_id: Dict[int, np.ndarray] = {}
             if missing_data:
-                survivors = np.stack([chunks[i] for i in srcs], axis=1)
-                rec = dev.decode_data(survivors, srcs, missing_data)
+                if mesh_rec is not None:
+                    rec = mesh_rec
+                else:
+                    survivors = np.stack([chunks[i] for i in srcs],
+                                         axis=1)
+                    rec = dev.decode_data(survivors, srcs,
+                                          missing_data)
                 by_id = {i: rec[:, idx]
                          for idx, i in enumerate(missing_data)}
                 for i in want_data:

@@ -98,6 +98,15 @@ class ErasureCodeRegenerating(ErasureCodeMatrixRS):
         # the single-device path (still guarded, still batched)
         return False
 
+    @property
+    def mesh_decode_shardable(self) -> bool:
+        # ...but the READ side fits exactly: the ≥d decode and the d×d
+        # repair solve are plain inverted-survivor matmuls over
+        # [[I],[Ψ]] rows — the same shape the mesh decode plan models
+        # for RS-matrix codes, so they shard (and rateless-protect)
+        # across the chips despite the encode gate above
+        return self._device_decode_supported
+
     def __init__(self):
         super().__init__()
         self.technique = "pm_mbr"
@@ -478,13 +487,29 @@ class ErasureCodeRegenerating(ErasureCodeMatrixRS):
             srcs = avail[:self.d]
             row_ids = tuple(self.rows + h for h in srcs)
 
-            def device_path() -> Dict[int, np.ndarray]:
-                dev = self.device()
+            # meshed reconstruct: the Ψ-survivor solve shards across
+            # the chip mesh (rateless-protected, its own guard) before
+            # the single-device guard — outside device_path so the two
+            # fault guards never nest; None keeps today's path
+            mesh_rows = None
+            if self._use_device():
+                from ..mesh import g_mesh
                 survivors = np.stack(
                     [np.asarray(chunks[i], dtype=np.uint8)
                      for i in srcs], axis=1)
-                m_rows = dev.decode_data(survivors, row_ids,
-                                         tuple(range(self.rows)))
+                mesh_rows = g_mesh.decode_stacked(
+                    self, survivors, row_ids, tuple(range(self.rows)))
+
+            def device_path() -> Dict[int, np.ndarray]:
+                dev = self.device()
+                if mesh_rows is not None:
+                    m_rows = mesh_rows
+                else:
+                    survivors = np.stack(
+                        [np.asarray(chunks[i], dtype=np.uint8)
+                         for i in srcs], axis=1)
+                    m_rows = dev.decode_data(survivors, row_ids,
+                                             tuple(range(self.rows)))
                 allc = dev.encode(m_rows)
                 got = dict(out)
                 for i in miss:
@@ -589,6 +614,17 @@ class ErasureCodeRegenerating(ErasureCodeMatrixRS):
 
         u = None
         if self._use_device():
+            # meshed repair solve: the (1, d, S·L) stack is byte-axis-
+            # folded by the runtime so even this single "stripe"
+            # spreads across the chips (repair=True for the counters);
+            # computed before the single-device guard, never nested
+            from ..mesh import g_mesh
+            mesh_u = g_mesh.decode_stacked(
+                self, stacked[None], row_ids, tuple(range(self.rows)),
+                repair=True)
+            if mesh_u is not None:
+                u = mesh_u[0]
+        if u is None and self._use_device():
             try:
                 u = run_device_call(self.codec_signature(),
                                     "device.decode_batch", device_path)

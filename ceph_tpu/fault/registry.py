@@ -88,6 +88,11 @@ SITE_CATALOG: Dict[str, str] = {
     "mesh.encode_batch":
         "mesh-sharded flush execution (ceph_tpu/mesh runtime) — "
         "exhaustion degrades the flush to the single-device path",
+    "mesh.decode_batch":
+        "mesh-sharded decode/reconstruct/repair execution "
+        "(ceph_tpu/mesh runtime decode_stacked) — exhaustion degrades "
+        "the group to the single-device path and journals "
+        "mesh_decode_degraded",
     "mesh.chip_slowdown":
         "per-chip straggler injection (ceph_tpu/mesh/chipstat): delays "
         "the matching chip's probe readback by delay_us; context is "

@@ -8,16 +8,18 @@ from .chipstat import (ChipStat, chip_latency_axes, g_chipstat,
 from .pool import StagingPool
 from .rateless import (RatelessCoder, RatelessPlan,
                        rateless_perf_counters)
-from .runtime import (MeshRuntime, ShardingPlan, chip_occupancy_axes,
-                      g_mesh, membership_perf_counters,
-                      mesh_perf_counters)
+from .runtime import (DecodeShardingPlan, MeshRuntime, ShardingPlan,
+                      chip_occupancy_axes, g_mesh,
+                      membership_perf_counters,
+                      mesh_decode_perf_counters, mesh_perf_counters)
 from .topology import BATCH_AXIS, addressable_devices, batch_mesh
 
 __all__ = [
-    "BATCH_AXIS", "ChipStat", "MeshRuntime", "RatelessCoder",
-    "RatelessPlan", "ShardingPlan", "StagingPool",
+    "BATCH_AXIS", "ChipStat", "DecodeShardingPlan", "MeshRuntime",
+    "RatelessCoder", "RatelessPlan", "ShardingPlan", "StagingPool",
     "addressable_devices", "batch_mesh", "chip_latency_axes",
     "chip_occupancy_axes", "g_chipstat", "g_mesh",
     "membership_perf_counters", "mesh_chip_perf_counters",
-    "mesh_perf_counters", "rateless_perf_counters",
+    "mesh_decode_perf_counters", "mesh_perf_counters",
+    "rateless_perf_counters",
 ]

@@ -89,6 +89,18 @@ from .chipstat import ChipStat
 # riding its boundary); it also bounds the detection-window cap-wait
 CENSOR_MARGIN = 1.25
 
+# devprof call-sites for one rateless execution: (block h2d/d2h,
+# parity assembly+h2d, host re-solve).  The coder is GF-matmul-generic
+# — the SAME engine runs the encode bit-matrix and the inverted
+# survivor (decode) bit-matrix — so the runtime passes the site triple
+# matching the kind of work, keeping encode and decode bandwidth
+# separable on the devflow ledger (the degraded-read workload's
+# bandwidth_overhead reads the decode sites alone)
+ENCODE_SITES = ("mesh.encode", "mesh.rateless_parity",
+                "mesh.rateless_solve")
+DECODE_SITES = ("mesh.decode", "mesh.decode_parity",
+                "mesh.decode_solve")
+
 
 # ---- perf counters (perf dump / Prometheus ceph_daemon_mesh_rateless_*) ----
 RATELESS_FIRST = 98100
@@ -317,14 +329,21 @@ class RatelessCoder:
 
     # ---- the flush ---------------------------------------------------------
     def encode(self, plan, rplan: RatelessPlan, buf: np.ndarray, mesh,
-               probe: bool, s_total: int
+               probe: bool, s_total: int,
+               sites: Tuple[str, str, str] = ENCODE_SITES
                ) -> Tuple[np.ndarray, Dict[int, int]]:
         """Run one rateless-coded flush over *buf* (S_pad, k, Cb);
         returns the coalesced coding rows (S_pad, m, Cb) —
         byte-identical to the single-device call — plus each chip's
         real (non-pad) systematic stripe count for the occupancy
         surfaces.  Raises Insufficient when the surviving blocks
-        cannot span."""
+        cannot span.
+
+        The engine never reads the bit-matrix out of *plan* — only out
+        of *rplan* — so it is generic over WHICH GF matmul it protects:
+        the runtime's decode path hands it an inverted-survivor-matrix
+        RatelessPlan plus the DECODE_SITES triple and gets the same
+        subset-completion semantics on reconstruct/repair work."""
         import jax
         from ..fault import g_faults
         from ..ops.gf_matmul import gf_bit_matmul
@@ -371,12 +390,12 @@ class RatelessCoder:
             try:
                 if bl.systematic:
                     src = buf[bl.bid * rows:(bl.bid + 1) * rows]
-                    g_devprof.account_h2d("mesh.encode", src.nbytes)
+                    g_devprof.account_h2d(sites[0], src.nbytes)
                 else:
                     src = self._parity_block(buf, rplan,
-                                             bl.bid - n_sys, rows)
-                    g_devprof.account_h2d("mesh.rateless_parity",
-                                          src.nbytes)
+                                             bl.bid - n_sys, rows,
+                                             sites[1])
+                    g_devprof.account_h2d(sites[1], src.nbytes)
                 dev_in = jax.device_put(src, devices[bl.chip])
                 bl.t_launch = time.perf_counter()
                 bl.out = gf_bit_matmul(
@@ -395,7 +414,8 @@ class RatelessCoder:
                sum(1 for bl in blocks
                    if not bl.erased and not bl.systematic))
         out = self._drain(blocks, n_sys, rows, buf.shape, probe,
-                          suspects, delay_until, pc, counted_chips)
+                          suspects, delay_until, pc, counted_chips,
+                          sites)
         # occupancy: real (non-pad) stripes per chip from the
         # scoreboard-weighted placement — the deweighting is visible
         # on the same per-chip surfaces the SPMD layout fed.  Erased
@@ -410,7 +430,7 @@ class RatelessCoder:
 
     @staticmethod
     def _parity_block(buf: np.ndarray, rplan: RatelessPlan, j: int,
-                      rows: int) -> np.ndarray:
+                      rows: int, site: str) -> np.ndarray:
         """Parity input block j = Σᵢ cⱼᵢ ⊗ sys-blockᵢ on the host —
         the extra coded rows the over-decomposition pays for (h2d +
         one host pass; arXiv 2108.02692's accounting says this is the
@@ -420,7 +440,7 @@ class RatelessCoder:
             term = gf_mul_scalar(int(rplan.coeffs[j, i]),
                                  buf[i * rows:(i + 1) * rows])
             acc = term if acc is None else acc ^ term
-        g_devprof.account_host_copy("mesh.rateless_parity", acc.nbytes)
+        g_devprof.account_host_copy(site, acc.nbytes)
         return acc
 
     # ---- the readiness-polling drain ---------------------------------------
@@ -435,7 +455,9 @@ class RatelessCoder:
     def _drain(self, blocks: List[_Block], n_sys: int,
                rows: int, in_shape, probe: bool, suspects: Set[int],
                delay_until: Dict[int, float], pc,
-               counted_chips: Set[int]) -> np.ndarray:
+               counted_chips: Set[int],
+               sites: Tuple[str, str, str] = ENCODE_SITES
+               ) -> np.ndarray:
         from .chipstat import g_chipstat
 
         basis = _GFBasis(n_sys)
@@ -474,8 +496,7 @@ class RatelessCoder:
                     continue
                 try:
                     bl.out = np.asarray(bl.out)
-                    g_devprof.account_d2h("mesh.encode",
-                                          bl.out.nbytes)
+                    g_devprof.account_d2h(sites[0], bl.out.nbytes)
                     basis.add(bl.vec)
                     chosen.append(bl)
                 except RuntimeError:
@@ -507,7 +528,7 @@ class RatelessCoder:
         pc.inc(l_rl_wasted_blocks,
                sum(1 for bl in blocks if not bl.erased
                    and bl not in chosen))
-        out = self._solve(chosen, n_sys, rows, in_shape, pc)
+        out = self._solve(chosen, n_sys, rows, in_shape, pc, sites[2])
         # ---- phase 2 (probe flushes): finish the per-chip observation -----
         if probe:
             self._observe_stragglers(pending, suspects, delay_until,
@@ -563,7 +584,7 @@ class RatelessCoder:
     # ---- the host twin re-solve --------------------------------------------
     @staticmethod
     def _solve(chosen: List[_Block], n_sys: int, rows: int, in_shape,
-               pc) -> np.ndarray:
+               pc, site: str = ENCODE_SITES[2]) -> np.ndarray:
         """Reassemble the (S_pad, m, Cb) coding rows from the chosen
         spanning set: present systematic blocks land directly, missing
         ones are re-solved as E = A⁻¹ Y over GF(2^8) — exact
@@ -590,8 +611,7 @@ class RatelessCoder:
                     term = gf_mul_scalar(c, bl.out)
                     acc = term if acc is None else acc ^ term
                 out[i * rows:(i + 1) * rows] = acc
-                g_devprof.account_host_copy("mesh.rateless_solve",
-                                            acc.nbytes)
+                g_devprof.account_host_copy(site, acc.nbytes)
             pc.inc(l_rl_host_resolves, len(missing))
         return out
 

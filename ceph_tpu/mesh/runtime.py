@@ -36,16 +36,27 @@ assembler and the codec backends:
   runtime state.
 
 Failure policy: the sharded call runs under the fault guard
-(``run_device_call`` — injection site ``mesh.encode_batch``, bounded
-retry, watchdog, per-signature breaker).  ``DeviceUnavailable``
-degrades to the single-device assembler path (which itself degrades to
-the host matrix twin), so a sick mesh costs throughput, never an op.
+(``run_device_call`` — injection sites ``mesh.encode_batch`` and
+``mesh.decode_batch``, bounded retry, watchdog, per-signature
+breaker).  ``DeviceUnavailable`` degrades to the single-device path
+(which itself degrades to the host matrix twin), so a sick mesh costs
+throughput, never an op.
 
-Scope: the runtime shards the ENCODE kind (the write path — the
-flagship ROADMAP refactor).  Decode/reconstruct groups keep the
-single-device path; the survivor-sharded mesh decode in
-``parallel/ec.py`` (ShardedRS) is the building block for that
-follow-up (see ROADMAP).
+Scope: BOTH matmul kinds ride the mesh.  The write path shards
+flushed encode groups (``encode_stacked``); the READ path shards
+decode/reconstruct groups and the product-matrix repair solve
+(``decode_stacked``) — GF(2^8) decode is the same bit-matmul with the
+host-inverted survivor matrix (``parallel/ec.py``'s ShardedRS decode
+is the layout proof), so decode plans reuse the plan cache, the
+staging pool, the scoreboard probes and the rateless coder
+(DECODE_SITES) verbatim.  A repair solve's single stripe folds its
+byte axis into extra batch rows first (GF matmuls are columnwise
+independent) so even S=1 work spreads across the chips.  Decode plans
+live in the SAME ``_plans`` dict as encode plans, so an elastic-
+membership transition invalidates both; the transition additionally
+waits out IN-FLIGHT decode/repair calls (recovery's repair solves
+enter here directly, not through the dispatcher queues) before the
+rebuild.
 """
 from __future__ import annotations
 
@@ -118,6 +129,67 @@ def mesh_perf_counters() -> PerfCounters:
                       "devices in the active dispatch mesh")
             _mesh_pc = b.create_perf_counters()
     return _mesh_pc
+
+
+# ---- decode-path counters (ceph_daemon_mesh_decode_*) ----------------------
+MESH_DECODE_FIRST = 98300
+l_mdec_dispatches = 98301    # decode/reconstruct/repair groups meshed
+l_mdec_stripes = 98302       # real (non-pad) decode rows sharded
+l_mdec_pad_stripes = 98303   # zero-pad decode rows for divisibility
+l_mdec_bytes = 98304         # survivor bytes through meshed decodes
+l_mdec_plan_builds = 98305   # decode sharding plans built (cache misses)
+l_mdec_plan_hits = 98306     # decode sharding-plan cache hits
+l_mdec_fallbacks = 98307     # meshed decodes degraded to single-device
+l_mdec_repair_solves = 98308  # regenerating repair solves meshed
+l_mdec_col_folds = 98309     # byte-axis folds applied to thin batches
+l_mdec_inflight = 98310      # gauge: mesh calls executing right now
+MESH_DECODE_LAST = 98320
+
+_mdec_pc: Optional[PerfCounters] = None
+_mdec_pc_lock = DebugLock("mesh_decode_pc::init")
+
+
+def mesh_decode_perf_counters() -> PerfCounters:
+    """The meshed READ path's counter logger (perf dump / Prometheus
+    ``ceph_daemon_mesh_decode_*``): decode/reconstruct groups and
+    product-matrix repair solves sharded across the chips."""
+    global _mdec_pc
+    if _mdec_pc is not None:
+        return _mdec_pc
+    with _mdec_pc_lock:
+        if _mdec_pc is None:
+            b = PerfCountersBuilder("mesh_decode", MESH_DECODE_FIRST,
+                                    MESH_DECODE_LAST)
+            b.add_u64_counter(l_mdec_dispatches, "dispatches",
+                              "decode/reconstruct/repair groups "
+                              "executed across the mesh")
+            b.add_u64_counter(l_mdec_stripes, "stripes",
+                              "real decode rows sharded across the "
+                              "mesh")
+            b.add_u64_counter(l_mdec_pad_stripes, "pad_stripes",
+                              "zero-pad decode rows added for batch-"
+                              "axis divisibility")
+            b.add_u64_counter(l_mdec_bytes, "bytes",
+                              "survivor bytes through meshed decodes")
+            b.add_u64_counter(l_mdec_plan_builds, "plan_builds",
+                              "decode sharding plans built (cache "
+                              "misses)")
+            b.add_u64_counter(l_mdec_plan_hits, "plan_hits",
+                              "decode sharding-plan cache hits")
+            b.add_u64_counter(l_mdec_fallbacks, "fallbacks",
+                              "meshed decodes degraded to the single-"
+                              "device path")
+            b.add_u64_counter(l_mdec_repair_solves, "repair_solves",
+                              "regenerating repair solves executed "
+                              "across the mesh")
+            b.add_u64_counter(l_mdec_col_folds, "col_folds",
+                              "byte-axis folds applied so thin decode "
+                              "batches still spread across the chips")
+            b.add_u64(l_mdec_inflight, "inflight",
+                      "mesh device calls executing right now (the "
+                      "membership drain waits this to zero)")
+            _mdec_pc = b.create_perf_counters()
+    return _mdec_pc
 
 
 # ---- elastic-membership counters (ceph_daemon_mesh_membership_*) ----------
@@ -222,6 +294,41 @@ class ShardingPlan:
         self.rateless = None     # (n_parity, RatelessPlan), lazy
 
 
+class DecodeShardingPlan:
+    """One compiled placement for a decode-kind matmul: the bit-matrix
+    is the host-INVERTED survivor matrix (``DeviceRSBackend``'s
+    ``_decode_bits_for`` construction), keyed by the erasure signature
+    (srcs, want_rows) on top of the codec signature — the recovery
+    shape repeats one erasure across many stripes, so the key space
+    stays as small as the decode-bits LRU's.  Everything else mirrors
+    ShardingPlan: rows sharded over the batch axis, bit-matrix
+    replicated (zero collectives), output sharded in place, and the
+    ``rateless`` slot carries the decode-bits coding geometry for the
+    rateless path — GF-linearity makes the parity-combination trick
+    bit-matrix-agnostic."""
+
+    __slots__ = ("key", "mesh", "in_sharding", "dec_bits", "bits_np",
+                 "fn", "donated", "hits", "rateless")
+
+    def __init__(self, key, mesh, bits_np, donate: bool):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..ops.gf_matmul import gf_bit_matmul
+        self.key = key
+        self.mesh = mesh
+        self.bits_np = bits_np
+        self.in_sharding = NamedSharding(mesh, P(BATCH_AXIS, None, None))
+        self.dec_bits = jax.device_put(
+            bits_np, NamedSharding(mesh, P(None, None)))
+        out_sharding = NamedSharding(mesh, P(BATCH_AXIS, None, None))
+        self.donated = bool(donate)
+        donate_argnums = (0,) if self.donated else ()
+        self.fn = jax.jit(gf_bit_matmul, out_shardings=out_sharding,
+                          donate_argnums=donate_argnums)
+        self.hits = 0
+        self.rateless = None     # (n_parity, RatelessPlan), lazy
+
+
 class MeshRuntime:
     """The dispatch scheduler's device back end when a mesh is up."""
 
@@ -234,6 +341,11 @@ class MeshRuntime:
         self._pool = StagingPool()
         self._chips: Dict[int, Dict[str, int]] = {}
         self._rateless = RatelessCoder()
+        # mesh device calls currently executing (encode AND decode/
+        # repair): the membership drain waits this to zero after the
+        # dispatcher flush, because repair solves enter decode_stacked
+        # directly — they are never queued, so flush() cannot see them
+        self._inflight = 0
         # while held, topology() keeps serving the CURRENT mesh even if
         # ec_mesh_chips changed underneath — the membership transition
         # sets this so the dispatcher drain completes every in-flight
@@ -310,11 +422,15 @@ class MeshRuntime:
         """``ec_mesh_chips`` config observer (registered at
         construction): makes membership injectargs-live.  Drain first —
         hold the old topology so ``g_dispatcher.flush()`` completes
-        every queued request on the mesh it was admitted under (the
+        every queued request (encode AND decode groups share the
+        dispatcher queues) on the mesh it was admitted under (the
         rateless path finishes from the first sufficient subset, so a
         retiring chip that is already failing costs bandwidth, never a
-        flush) — then release and rebuild eagerly via ``topology()``,
-        which does the invalidation + add/retire accounting."""
+        flush), then wait out IN-FLIGHT mesh calls — repair solves and
+        direct decodes enter ``decode_stacked`` without queuing, so
+        the flush cannot see them — and only then release and rebuild
+        eagerly via ``topology()``, which does the invalidation (both
+        plan kinds live in ``_plans``) + add/retire accounting."""
         try:
             target = int(value)
         except (TypeError, ValueError):
@@ -328,6 +444,7 @@ class MeshRuntime:
         try:
             from ..dispatch import g_dispatcher
             drained = g_dispatcher.flush()
+            self._wait_inflight()
         finally:
             with self._lock:
                 self._hold = False
@@ -335,6 +452,31 @@ class MeshRuntime:
             membership_perf_counters().inc(l_member_drained_reqs,
                                            int(drained))
         self.topology()
+
+    # bound on the in-flight wait: generous next to any real device
+    # call, tiny next to the watchdog ladder — a wedged call is the
+    # fault guard's problem, not the membership transition's
+    INFLIGHT_DRAIN_S = 5.0
+
+    def _wait_inflight(self) -> None:
+        """Poll the in-flight gauge to zero (bounded) while ``_hold``
+        keeps the old topology alive: every admitted call completes on
+        the mesh it started on, so a membership flip mid-decode can
+        never reshard half an erasure group."""
+        import time
+        from .chipstat import ChipStat
+        deadline = time.perf_counter() + self.INFLIGHT_DRAIN_S
+        while time.perf_counter() < deadline:
+            with self._lock:
+                if self._inflight <= 0:
+                    return
+            time.sleep(ChipStat.PROBE_POLL_S)
+
+    def _inflight_add(self, delta: int) -> None:
+        with self._lock:
+            self._inflight += delta
+            n = max(self._inflight, 0)
+        mesh_decode_perf_counters().set(l_mdec_inflight, n)
 
     def _member_transition(self, prev_size: int, new_size: int,
                            plans_dropped: int, pool_dropped: int
@@ -399,6 +541,7 @@ class MeshRuntime:
         from ..dispatch.signature import codec_signature
         from ..fault import DeviceUnavailable, run_device_call
         sig = codec_signature(leader)
+        self._inflight_add(1)
         try:
             return run_device_call(
                 sig, "mesh.encode_batch",
@@ -407,6 +550,56 @@ class MeshRuntime:
         except DeviceUnavailable:
             mesh_perf_counters().inc(l_mesh_fallbacks)
             return None
+        finally:
+            self._inflight_add(-1)
+
+    # ---- the decode entry point (plugin decode_batch / repair) -------------
+    def decode_stacked(self, leader, survivors: np.ndarray,
+                       srcs, want_rows,
+                       repair: bool = False) -> Optional[np.ndarray]:
+        """Shard one decode-kind matmul across the mesh.
+
+        *survivors* is the (S, n_src, C) uint8 stack in *srcs* order —
+        exactly what ``DeviceRSBackend.decode_data`` consumes — and
+        the return is the requested rows (S, len(want_rows), C),
+        byte-identical to the single-device call.  *srcs*/*want_rows*
+        index the leader backend's full (k+m, k)-style matrix, so the
+        same entry serves plain-RS reconstruct (matrix rows), the
+        regenerating ≥d decode (Ψ rows) and the d×d repair solve
+        (*repair* marks the latter for the counters).
+
+        Returns None — the caller then runs the existing single-device
+        path — when the mesh is off/size-1 (BY CONSTRUCTION nothing
+        changes), when the codec's decode is not mesh-shardable, or
+        when the guarded call exhausted its retries: a sick mesh costs
+        throughput, never an op, and the degradation is journaled."""
+        if not self.active():
+            return None
+        backend = self._decode_backend(leader)
+        if backend is None:
+            return None
+        if survivors.size == 0 or not want_rows:
+            return None
+        from ..dispatch.signature import codec_signature
+        from ..fault import DeviceUnavailable, run_device_call
+        sig = codec_signature(leader)
+        srcs = tuple(int(i) for i in srcs)
+        want_rows = tuple(int(i) for i in want_rows)
+        self._inflight_add(1)
+        try:
+            return run_device_call(
+                sig, "mesh.decode_batch",
+                lambda: self._decode(sig, backend, survivors, srcs,
+                                     want_rows, repair))
+        except DeviceUnavailable:
+            mesh_decode_perf_counters().inc(l_mdec_fallbacks)
+            g_journal.emit("mesh", "mesh_decode_degraded",
+                           signature=list(map(str, sig)),
+                           stripes=int(survivors.shape[0]),
+                           repair=bool(repair))
+            return None
+        finally:
+            self._inflight_add(-1)
 
     @staticmethod
     def _bit_backend(leader):
@@ -429,6 +622,186 @@ class MeshRuntime:
         except Exception:
             return None
         return backend if type(backend) is DeviceRSBackend else None
+
+    @staticmethod
+    def _decode_backend(leader):
+        """The leader's backend when its DECODE is mesh-shardable.
+        Same two gates as ``_bit_backend`` but on the codec's
+        ``mesh_decode_shardable`` declaration: decode is the plain
+        inverted-matrix matmul for RS-matrix codes AND for the
+        regenerating family (whose encode is not row-shardable, but
+        whose ≥d decode and repair solve are plain survivor matmuls
+        over [[I],[Ψ]] rows)."""
+        from ..ops.gf_matmul import DeviceRSBackend
+        if not getattr(leader, "mesh_decode_shardable", False):
+            return None
+        dev_fn = getattr(leader, "device", None)
+        if dev_fn is None:
+            return None
+        try:
+            backend = dev_fn()
+        except Exception:
+            return None
+        return backend if type(backend) is DeviceRSBackend else None
+
+    def _decode(self, sig: Tuple, backend, survivors: np.ndarray,
+                srcs: Tuple[int, ...], want_rows: Tuple[int, ...],
+                repair: bool) -> np.ndarray:
+        import jax
+        from .rateless import DECODE_SITES, rateless_opts
+        mesh = self.topology()
+        s_orig, n_src, c_orig = survivors.shape
+        pc = mesh_decode_perf_counters()
+        # byte-axis folding: GF matmuls are columnwise independent, so
+        # a batch thinner than the mesh (the repair solve is S=1 by
+        # shape) folds chunk bytes into extra rows and every chip
+        # still gets real work; non-divisible widths just ride the row
+        # pad (correct, some chips idle on pad lanes)
+        fold = 1
+        if s_orig < mesh.size and c_orig % mesh.size == 0:
+            fold = mesh.size
+            survivors = np.ascontiguousarray(
+                survivors
+                .reshape(s_orig, n_src, fold, c_orig // fold)
+                .transpose(0, 2, 1, 3)
+                .reshape(s_orig * fold, n_src, c_orig // fold))
+            pc.inc(l_mdec_col_folds)
+        s_total, _n, cb = survivors.shape
+        s_pad = self._pad_rows(s_total, mesh.size)
+        plan = self._decode_plan(sig, cb, srcs, want_rows, backend,
+                                 mesh)
+        mpc = mesh_perf_counters()
+        buf, pooled = self._pool.acquire((s_pad, n_src, cb))
+        mpc.inc(l_mesh_pool_hits if pooled else l_mesh_pool_misses)
+        chip_real = None
+        try:
+            buf[:s_total] = survivors
+            g_devprof.account_host_copy("mesh.decode_assemble",
+                                        buf.nbytes)
+            g_devprof.install_compile_listener()
+            from ..common.kernel_trace import g_kernel_timer
+            from .chipstat import g_chipstat
+            probe = g_chipstat.should_probe()
+            if rateless_opts()[0]:
+                # the encode engine verbatim — it reads the bit-matrix
+                # only out of the RatelessPlan, and GF-linearity makes
+                # parity combinations valid for ANY bit-matrix; the
+                # DECODE_SITES triple keeps the bandwidth separable
+                rplan = self._decode_rateless_plan(plan, mesh)
+                with g_devprof.stage("mesh.decode"):
+                    rec, chip_real = g_kernel_timer.timed(
+                        "ec_decode_batch_mesh_rateless",
+                        lambda: self._rateless.encode(
+                            plan, rplan, buf, mesh, probe, s_total,
+                            sites=DECODE_SITES))
+            else:
+                g_devprof.account_h2d("mesh.decode", buf.nbytes)
+                with g_devprof.stage("mesh.decode"):
+                    def sharded_call():
+                        dev_in = jax.device_put(buf, plan.in_sharding)
+                        out = plan.fn(dev_in, plan.dec_bits)
+                        if probe:
+                            g_chipstat.probe(out, mesh)
+                        return np.asarray(out)
+                    rec = g_kernel_timer.timed(
+                        "ec_decode_batch_mesh", sharded_call)
+                g_devprof.account_d2h("mesh.decode", rec.nbytes)
+        finally:
+            self._pool.release(buf)
+        self._account_decode(mesh, s_total, s_pad,
+                             int(survivors.nbytes), chip_real, repair)
+        rec = rec[:s_total]
+        if fold > 1:
+            w = rec.shape[1]
+            rec = np.ascontiguousarray(
+                rec.reshape(s_orig, fold, w, cb)
+                .transpose(0, 2, 1, 3)
+                .reshape(s_orig, w, c_orig))
+        return rec
+
+    def _decode_plan(self, sig: Tuple, cb: int,
+                     srcs: Tuple[int, ...], want_rows: Tuple[int, ...],
+                     backend, mesh) -> DecodeShardingPlan:
+        _chips, _cap, donate_opt = self._opts()
+        platform = getattr(np.asarray(mesh.devices).ravel()[0],
+                           "platform", "cpu")
+        donate = donate_opt and platform != "cpu"
+        key = ("decode", sig, cb, srcs, want_rows, donate)
+        pc = mesh_decode_perf_counters()
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is not None and plan.mesh is mesh:
+                plan.hits += 1
+                pc.inc(l_mdec_plan_hits)
+                return plan
+        from ..gf.matrices import gf_invert_matrix
+        from ..gf.tables import expand_to_bitmatrix
+        inv = gf_invert_matrix(backend.matrix[list(srcs), :])
+        bits_np = expand_to_bitmatrix(
+            inv[list(want_rows), :]).astype(np.int8)
+        plan = DecodeShardingPlan(key, mesh, bits_np, donate)
+        with self._lock:
+            self._plans[key] = plan
+        pc.inc(l_mdec_plan_builds)
+        return plan
+
+    def _decode_rateless_plan(self, plan: DecodeShardingPlan, mesh):
+        """The decode plan's rateless geometry — the decode bit-matrix
+        in a RatelessPlan, cached on the plan entry like the encode
+        twin (same lifetime, same membership invalidation)."""
+        from .rateless import RatelessCoder, RatelessPlan
+        n_sys, n_parity = RatelessCoder.tasks_for(mesh.size)
+        with self._lock:
+            cached = plan.rateless
+            if cached is not None and cached[0] == n_parity:
+                return cached[1]
+        rplan = RatelessPlan(plan.key, n_sys, n_parity, plan.bits_np)
+        with self._lock:
+            plan.rateless = (n_parity, rplan)
+        return rplan
+
+    def _account_decode(self, mesh, s_total: int, s_pad: int,
+                        nbytes: int,
+                        chip_real: Optional[Dict[int, int]],
+                        repair: bool) -> None:
+        """Decode-side occupancy: the ``mesh_decode_*`` counters plus
+        the 2-D ``mesh_decode_chip_occupancy_histogram`` and the
+        per-chip table's decode columns — the same receipt surfaces
+        the encode path feeds, kept separable so a degraded-read storm
+        is visible as READ work."""
+        pc = mesh_decode_perf_counters()
+        pc.inc(l_mdec_dispatches)
+        pc.inc(l_mdec_stripes, s_total)
+        pc.inc(l_mdec_pad_stripes, s_pad - s_total)
+        pc.inc(l_mdec_bytes, nbytes)
+        if repair:
+            pc.inc(l_mdec_repair_solves)
+        rows = s_pad // mesh.size
+        hist = g_perf_histograms.get(
+            "mesh", "mesh_decode_chip_occupancy_histogram",
+            chip_occupancy_axes)
+        devices = np.asarray(mesh.devices).ravel()
+        with self._lock:
+            for i in range(mesh.size):
+                if chip_real is not None:
+                    real = int(chip_real.get(i, 0))
+                else:
+                    real = min(max(s_total - i * rows, 0), rows)
+                hist.inc(real, i)
+                c = self._chips.get(i)
+                if c is None:
+                    c = self._chips[i] = self._chip_row(devices[i])
+                c["decode_stripes"] += real
+                c["decode_dispatches"] += 1
+
+    @staticmethod
+    def _chip_row(device) -> Dict[str, int]:
+        """One per-chip totals row: encode and decode columns side by
+        side, so the occupancy receipt shows BOTH kinds of work a chip
+        carried."""
+        return {"stripes": 0, "dispatches": 0,
+                "decode_stripes": 0, "decode_dispatches": 0,
+                "device": str(device)}
 
     def _encode(self, sig: Tuple, backend, stripes_list, bucket_c: int
                 ) -> np.ndarray:
@@ -578,9 +951,7 @@ class MeshRuntime:
                 hist.inc(real, i)
                 c = self._chips.get(i)
                 if c is None:
-                    c = self._chips[i] = {
-                        "stripes": 0, "dispatches": 0,
-                        "device": str(devices[i])}
+                    c = self._chips[i] = self._chip_row(devices[i])
                 c["stripes"] += real
                 c["dispatches"] += 1
 
@@ -595,12 +966,25 @@ class MeshRuntime:
         chips, pool_cap, donate = self._opts()
         mesh = self.topology()
         with self._lock:
-            plans = [{"signature": list(map(str, key[0])),
-                      "bucket_chunk_size": key[1],
-                      "donated": p.donated, "hits": p.hits}
-                     for key, p in sorted(self._plans.items(),
-                                          key=lambda kv: str(kv[0]))]
+            plans = []
+            for key, p in sorted(self._plans.items(),
+                                 key=lambda kv: str(kv[0])):
+                if key[0] == "decode":
+                    plans.append({"kind": "decode",
+                                  "signature": list(map(str, key[1])),
+                                  "bucket_chunk_size": key[2],
+                                  "srcs": list(key[3]),
+                                  "want_rows": list(key[4]),
+                                  "donated": p.donated,
+                                  "hits": p.hits})
+                else:
+                    plans.append({"kind": "encode",
+                                  "signature": list(map(str, key[0])),
+                                  "bucket_chunk_size": key[1],
+                                  "donated": p.donated,
+                                  "hits": p.hits})
             transitions, hold = self._transitions, self._hold
+            inflight = self._inflight
         from .chipstat import g_chipstat
         return {
             "options": {"ec_mesh_chips": chips,
@@ -613,6 +997,11 @@ class MeshRuntime:
             "plans": plans,
             "pool": self._pool.dump(),
             "counters": mesh_perf_counters().dump(),
+            # the meshed READ path (decode/reconstruct/repair):
+            # in-flight gauge the membership drain waits on, plus the
+            # mesh_decode_* counter family
+            "decode": {"inflight": inflight,
+                       "counters": mesh_decode_perf_counters().dump()},
             # elastic membership (injectargs-live ec_mesh_chips):
             # transition count, the drain hold flag, and the
             # mesh_membership counter family

@@ -17,7 +17,10 @@ Mirrors the reference pipeline shapes (src/osd/ECBackend.{h,cc}):
 - reads: objects_read_and_reconstruct consults the plugin's
   minimum_to_decode, fans MOSDECSubOpRead to the cheapest shard set, and
   reconstructs via the batched decode (ECBackend.cc:1580-1669,986,1141).
-  Ranged reads fetch only the covering chunk range.
+  Ranged reads fetch only the covering chunk range.  With a mesh up the
+  reconstruct's ``decode_batch`` call shards the survivor stack across
+  the chips inside the codec (docs/DISPATCH.md "Mesh-sharded degraded
+  reads") — this backend sees the identical bytes either way.
 - recovery: RecoveryOp reads k available shards, decodes the missing
   shard's chunks, and pushes them to the replacement OSD
   (ECBackend.cc:535-743).

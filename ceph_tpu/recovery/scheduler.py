@@ -15,7 +15,12 @@ first-class workload:
   ~d·chunk/α bytes moved instead of k·chunk.  Any helper failure (or
   the armed ``recovery.repair_read`` chaos site) degrades the round to
   the existing full-stripe decode path: repair optimality costs
-  bandwidth to lose, never an object.
+  bandwidth to lose, never an object.  With a mesh up, both the
+  regenerating repair solve and the full-stripe reconstruct execute
+  as survivor-sharded meshed GF matmuls inside the codec's
+  ``repair`` / ``decode_batch`` (docs/RECOVERY.md "Mesh-sharded
+  repair solves") — a recovery storm rides all chips, and a sick
+  mesh degrades to the single-device solve, not a failed round.
 - **QoS classing**: each repair round is enqueued on the sharded op
   queue under ``CLASS_RECOVERY``, so the unified ``DmClockArbiter``
   arbitrates recovery against client work in ONE place — the

@@ -56,6 +56,8 @@ EVENT_TYPES = (
     "incident_resolve",    # mgr: open incident's triggering check cleared
     "mesh_chip_add",       # mesh: elastic membership grew the dispatch mesh
     "mesh_chip_retire",    # mesh: elastic membership retired mesh chip(s)
+    "mesh_decode_degraded",  # mesh: meshed decode/repair fell back to
+                             # the single-device path (guard exhausted)
     "chaos_scenario_start",  # chaos: a composed storyline began executing
     "chaos_event",         # chaos: one scheduled storyline step fired
     "chaos_scenario_end",  # chaos: storyline finished, acceptance judged

@@ -31,6 +31,7 @@ by tests/test_chaos_composer.py.
       "dispatch.batch": "coalesced flush execution (scheduler._execute run_group) \u2014 exercises the per-request fallback isolation",
       "mesh.chip_fail": "hard per-chip failure mid-flush (ceph_tpu/mesh/rateless): the matching chip's coded blocks become erasures the subset completion re-solves around; context is 'chip=<i>/<mesh size>' for match= scoping, count= bounds the failed flushes",
       "mesh.chip_slowdown": "per-chip straggler injection (ceph_tpu/mesh/chipstat): delays the matching chip's probe readback by delay_us; context is 'chip=<i>/<mesh size>' so match='chip=3/' scopes one chip",
+      "mesh.decode_batch": "mesh-sharded decode/reconstruct/repair execution (ceph_tpu/mesh runtime decode_stacked) \u2014 exhaustion degrades the group to the single-device path and journals mesh_decode_degraded",
       "mesh.encode_batch": "mesh-sharded flush execution (ceph_tpu/mesh runtime) \u2014 exhaustion degrades the flush to the single-device path",
       "mgr.incident_capture": "incident bundle snapshot on a health-check raise (ceph_tpu/mgr/incident): a firing drops that bundle \u2014 the raise is journaled, the tick proceeds, and the NEXT raise captures normally; context is the triggering check name",
       "msg.drop": "drop a fabric message (ms inject socket failures role); context is '<MsgType> <src>><dst>' for match= scoping",
@@ -46,6 +47,7 @@ by tests/test_chaos_composer.py.
       "chip_fail",
       "chip_straggler",
       "control_flap",
+      "degraded_read_straggler",
       "device_error",
       "mesh_membership",
       "msg_drop",
@@ -68,23 +70,27 @@ of the two pinned tier-1 smoke seeds.
     "events": [
       {
         "action": "fault_arm",
-        "count": 4,
-        "match": "chip=2/",
+        "count": 2,
+        "match": "chip=3/",
         "mode": "always",
         "round": 1,
         "site": "mesh.chip_fail"
       },
       {
         "action": "fault_arm",
-        "delay_us": 30000,
-        "match": "chip=6/",
-        "mode": "always",
-        "round": 1,
-        "site": "mesh.chip_slowdown"
+        "mode": "nth",
+        "n": 5,
+        "round": 3,
+        "site": "device.encode_batch"
       },
       {
-        "action": "mesh_chip_retire",
-        "chips": 1,
+        "action": "osd_kill",
+        "osd": 0,
+        "round": 3
+      },
+      {
+        "action": "osd_out",
+        "osd": 0,
         "round": 4
       },
       {
@@ -93,31 +99,38 @@ of the two pinned tier-1 smoke seeds.
         "site": "mesh.chip_fail"
       },
       {
-        "action": "mesh_chip_add",
-        "chips": 1,
-        "round": 10
+        "action": "fault_clear",
+        "round": 7,
+        "site": "device.encode_batch"
+      },
+      {
+        "action": "osd_revive",
+        "osd": 0,
+        "round": 11
+      },
+      {
+        "action": "osd_in",
+        "osd": 0,
+        "round": 12
       }
     ],
-    "expected_checks": [
-      "TPU_MESH_SKEW"
-    ],
+    "expected_checks": [],
     "journal_expect": [
-      "chip_suspect_mark",
       "fault_arm",
+      "fault_clear",
       "fault_fire",
-      "mesh_chip_add",
-      "mesh_chip_retire"
+      "osd_down",
+      "osd_in",
+      "osd_out"
     ],
     "legs": [
       "chip_fail",
-      "chip_straggler",
-      "mesh_membership"
+      "device_error",
+      "recovery_storm"
     ],
     "rate_multipliers": [],
     "seed": 24,
-    "settle_clears": [
-      "mesh.chip_slowdown"
-    ],
+    "settle_clears": [],
     "tolerates_missing_bundle": false
   }
 

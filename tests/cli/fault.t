@@ -15,6 +15,7 @@ site catalog, arming a trigger, the unknown-site refusal, and clearing.
       "dispatch.batch": "coalesced flush execution (scheduler._execute run_group) \u2014 exercises the per-request fallback isolation",
       "mesh.chip_fail": "hard per-chip failure mid-flush (ceph_tpu/mesh/rateless): the matching chip's coded blocks become erasures the subset completion re-solves around; context is 'chip=<i>/<mesh size>' for match= scoping, count= bounds the failed flushes",
       "mesh.chip_slowdown": "per-chip straggler injection (ceph_tpu/mesh/chipstat): delays the matching chip's probe readback by delay_us; context is 'chip=<i>/<mesh size>' so match='chip=3/' scopes one chip",
+      "mesh.decode_batch": "mesh-sharded decode/reconstruct/repair execution (ceph_tpu/mesh runtime decode_stacked) \u2014 exhaustion degrades the group to the single-device path and journals mesh_decode_degraded",
       "mesh.encode_batch": "mesh-sharded flush execution (ceph_tpu/mesh runtime) \u2014 exhaustion degrades the flush to the single-device path",
       "mgr.incident_capture": "incident bundle snapshot on a health-check raise (ceph_tpu/mgr/incident): a firing drops that bundle \u2014 the raise is journaled, the tick proceeds, and the NEXT raise captures normally; context is the triggering check name",
       "msg.drop": "drop a fabric message (ms inject socket failures role); context is '<MsgType> <src>><dst>' for match= scoping",
@@ -156,6 +157,11 @@ the live trigger spec or null.
       "armed": null,
       "description": "per-chip straggler injection (ceph_tpu/mesh/chipstat): delays the matching chip's probe readback by delay_us; context is 'chip=<i>/<mesh size>' so match='chip=3/' scopes one chip",
       "name": "mesh.chip_slowdown"
+    },
+    {
+      "armed": null,
+      "description": "mesh-sharded decode/reconstruct/repair execution (ceph_tpu/mesh runtime decode_stacked) \u2014 exhaustion degrades the group to the single-device path and journals mesh_decode_degraded",
+      "name": "mesh.decode_batch"
     },
     {
       "armed": null,

@@ -539,7 +539,10 @@ def test_load_trajectory_orders_and_survives_junk(tmp_path):
 def test_smoke_mode_end_to_end():
     """`python -m ceph_tpu.bench --smoke` is the per-PR harness check:
     exit 0 on CPU, one schema-valid JSON line, fenced metrics with
-    stats and a roofline verdict, in well under 30 s of measured time."""
+    stats and a roofline verdict, in under a minute of measured time
+    (the harness now spans 13 workloads — the budget is a
+    minutes-scale canary, not a per-workload perf gate; those live in
+    regress.py)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     p = subprocess.run(
         [sys.executable, "-m", "ceph_tpu.bench", "--smoke"],
@@ -549,7 +552,7 @@ def test_smoke_mode_end_to_end():
     line = [ln for ln in p.stdout.splitlines() if ln.strip()][-1]
     out = json.loads(line)
     assert out["mode"] == "smoke" and out["platform"] == "cpu"
-    assert out["elapsed_s"] < 30.0
+    assert out["elapsed_s"] < 60.0
     assert out["decode_parity"] is True
     names = set()
     for m in out["metrics"]:
@@ -564,7 +567,8 @@ def test_smoke_mode_end_to_end():
             "ec_pipeline_fenced", "ec_pipeline_depth1_fenced",
             "ec_mesh_fenced", "ec_mesh_single_fenced",
             "traffic_harness_smoke", "ec_recovery_storm",
-            "ec_mesh_skew", "ec_mesh_straggler"} <= names
+            "ec_mesh_skew", "ec_mesh_straggler",
+            "ec_degraded_read"} <= names
     # the coalesce metric carries its serial twin and speedup
     mc = next(m for m in out["metrics"]
               if m["name"] == "ec_dispatch_coalesce_fenced")

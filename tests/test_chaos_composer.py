@@ -34,10 +34,11 @@ from ceph_tpu.common.config import g_conf
 from ceph_tpu.fault import g_breakers, g_faults
 from ceph_tpu.trace.journal import g_journal
 
-# the two nastiest storylines the seed scan surfaced, pinned forever:
-# 24 composes a hard chip-failure burst, a 30ms straggler AND an
-# elastic-membership retire/add cycle; 103 loses incident captures
-# while sub-op writes drop probabilistically under the same straggler
+# the two nastiest storylines the seed scan surfaced, pinned forever
+# (recomposed when the leg catalog grew to 11): 24 composes a hard
+# chip-failure burst, probabilistic device errors AND a recovery storm;
+# 103 loses incident captures under a 30ms straggler while the mesh
+# retires and re-adds chips mid-flight
 PINNED_SEEDS = (24, 103)
 
 TOUCHED = (
@@ -189,6 +190,37 @@ def test_issue_storyline_storm_straggler_abusive():
     assert row == {"raised": True, "cleared": True, "bundle_ok": True}
     assert any(b["state"] == "resolved" and b["trigger"] == "TPU_MESH_SKEW"
                for b in r["incidents"]["bundles"]), r["incidents"]
+
+
+def test_issue_storyline_degraded_read_under_straggler():
+    """The degraded-read storyline: a dead OSD forces every read of its
+    objects through EC decode while one chip straggles 30ms, a second
+    chip fails hard and shard reads return EIO — the nastiest seed the
+    forced-leg scan surfaced (28: kill at round 1, four chip failures,
+    seven EIOs, straggler and failing chip distinct and overlapping).
+    Decode groups must ride the mesh throughout (no single-device
+    fallbacks), stay byte-exact, and the skew check must raise, clear
+    and finalize its bundle with zero operator action."""
+    from ceph_tpu.mesh import mesh_decode_perf_counters
+    from ceph_tpu.mesh.runtime import l_mdec_dispatches, l_mdec_fallbacks
+    legs = ("chip_fail", "degraded_read_straggler", "shard_eio")
+    spec = compose_scenario(28, legs=legs)
+    assert spec == compose_scenario(28, legs=legs)
+    assert spec.legs == legs
+    assert "TPU_MESH_SKEW" in spec.expected_checks
+    before = mesh_decode_perf_counters().get(l_mdec_dispatches)
+    fb_before = mesh_decode_perf_counters().get(l_mdec_fallbacks)
+    r = run_scenario(spec)
+    assert r["accepted"], r
+    assert r["byte_exact"], r
+    assert r["mesh_fallbacks"] == 0, r
+    row = r["checks"]["TPU_MESH_SKEW"]
+    assert row == {"raised": True, "cleared": True, "bundle_ok": True}
+    mdec = mesh_decode_perf_counters()
+    assert mdec.get(l_mdec_dispatches) > before, \
+        "degraded reads never reached the meshed decode path"
+    assert mdec.get(l_mdec_fallbacks) == fb_before, \
+        "meshed decode fell back to single-device under the storyline"
 
 
 @pytest.mark.slow

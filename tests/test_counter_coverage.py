@@ -52,6 +52,33 @@ def cluster_and_text():
     assert rateless_perf_counters().get(l_rl_flushes) > 0, \
         "mesh write never rode the rateless path — its counter " \
         "family would be lint-invisible"
+    # one DEGRADED read through the MESH path (kill a data-shard
+    # holder, reconstruct with the mesh up) so the mesh_decode_*
+    # counter family and the decode occupancy histogram register and
+    # move — the lint below then covers the meshed READ path too
+    lint_pid = c.mon.osdmap.lookup_pg_pool_name("lint")
+    victim = next(
+        o.osd_id for o in c.osds.values()
+        for cid in o.store.list_collections()
+        if cid.startswith(f"{lint_pid}.") and "s" in cid
+        and cid.rsplit("s", 1)[1] in ("1", "2")   # non-primary DATA shard
+        and any(ho.oid == "om" for ho in o.store.list_objects(cid)))
+    c.kill_osd(victim)
+    c.mark_osd_down(victim)
+    g_conf.set_val("ec_mesh_chips", 8)
+    try:
+        assert cl.read("lint", "om")[:1] == b"m"
+    finally:
+        g_conf.rm_val("ec_mesh_chips")
+        g_mesh.topology()
+    from ceph_tpu.mesh import mesh_decode_perf_counters
+    from ceph_tpu.mesh.runtime import l_mdec_dispatches
+    assert mesh_decode_perf_counters().get(l_mdec_dispatches) > 0, \
+        "degraded read never rode the meshed decode path — its " \
+        "counter family would be lint-invisible"
+    c.revive_osd(victim)
+    for _ in range(3):
+        c.tick(dt=6.0)
     # one repair round through a regenerating pool so the `recovery`
     # counter families and the bytes-per-shard histogram register and
     # move — the lint below then covers them like any other family
@@ -159,6 +186,13 @@ def test_known_new_families_covered_by_the_lint(cluster_and_text):
     assert "accept_pass" in c.perf_collection.dump()["chaos"]
     assert "mesh_membership" in c.perf_collection.dump()
     assert "drained_reqs" in c.perf_collection.dump()["mesh_membership"]
+    # meshed-READ-path canary: the mesh_decode logger is registered
+    # and the fixture's degraded read moved it AND registered the
+    # decode occupancy family, so the generic lints above really
+    # cover the straggler-proof read path's surfaces
+    assert "mesh_decode" in c.perf_collection.dump()
+    assert c.perf_collection.dump()["mesh_decode"]["dispatches"] > 0
+    assert c.perf_collection.dump()["mesh_decode"]["fallbacks"] == 0
     from ceph_tpu.trace import g_perf_histograms
     from ceph_tpu.trace.oplat import stage_of_hist_name
     assert any(lg == "devprof" for (lg, _n), _h
@@ -172,6 +206,8 @@ def test_known_new_families_covered_by_the_lint(cluster_and_text):
     assert {"admission", "class_queue", "device_call", "reply"} <= \
         oplat_stages, oplat_stages
     assert any(n == "dispatch_chip_occupancy_histogram"
+               for (_lg, n), _h in g_perf_histograms.items())
+    assert any(n == "mesh_decode_chip_occupancy_histogram"
                for (_lg, n), _h in g_perf_histograms.items())
 
 

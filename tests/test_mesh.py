@@ -163,10 +163,13 @@ def test_mesh_declines_layout_transforming_codecs(mesh_conf):
         "the mesh must decline layout-transforming codecs"
 
 
-def test_mesh_on_leaves_decode_byte_identical(mesh_conf):
-    """Decode groups keep the single-device path with the mesh up
-    (ROADMAP follow-up) — and stay byte-identical while encode groups
-    shard around them."""
+def test_mesh_on_decode_groups_ride_the_mesh(mesh_conf):
+    """Decode groups ride the mesh alongside encode groups (the
+    straggler-proof read PR; tests/test_mesh_decode.py holds the full
+    gate set) — both byte-identical to their single-device oracles in
+    one mixed flush."""
+    from ceph_tpu.mesh import mesh_decode_perf_counters
+    from ceph_tpu.mesh.runtime import l_mdec_dispatches
     impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
     sinfo = stripe_info_t(4, 4 * 1024)
     rng = np.random.default_rng(5)
@@ -175,12 +178,15 @@ def test_mesh_on_leaves_decode_byte_identical(mesh_conf):
     chunks = {i: shards[i] for i in (0, 2, 4, 5)}
     oracle = eu_decode_concat(sinfo, impl, dict(chunks))
     _mesh_on(chips=8)
+    mdec0 = mesh_decode_perf_counters().get(l_mdec_dispatches)
     f_enc = g_dispatcher.submit_encode(sinfo, impl, data, set(range(6)))
     f_dec = g_dispatcher.submit_decode_concat(sinfo, impl, dict(chunks))
     g_dispatcher.flush()
     _same_shards(f_enc.result(), shards)
     assert np.asarray(f_dec.result()).tobytes() \
         == np.asarray(oracle).tobytes()
+    assert mesh_decode_perf_counters().get(l_mdec_dispatches) > mdec0, \
+        "the reconstruct group never rode the mesh"
 
 
 def _ec_shard_bodies(c):

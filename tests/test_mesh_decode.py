@@ -1,0 +1,456 @@
+"""Straggler-proof meshed READ path (the degraded-read PR's gates).
+
+- ``ec_mesh_chips=0`` (the default), a 1-device mesh, and codecs whose
+  decode is not mesh-shardable keep the existing single-device decode
+  path by construction;
+- mesh-dispatched decode/reconstruct is byte-identical to the encoded
+  truth (== the single-device oracle) across randomized
+  (k, m, technique, stripe count, chunk size) mixes, on BOTH the SPMD
+  and the rateless branch, including batch occupancies that are not a
+  multiple of the mesh size;
+- the regenerating family rides the same entry: ≥d decode and the d×d
+  repair solve are survivor matmuls over [[I],[Ψ]] rows — byte-exact
+  for ``pm_mbr`` and ``pm_msr``, with the thin repair batch folded
+  along the byte axis (``col_folds``);
+- rateless block loss (``mesh.chip_fail``) and a hard straggler
+  (``mesh.chip_slowdown``) complete every decode from the first
+  spanning subset — byte-exact, ZERO single-device fallbacks;
+- guard exhaustion at ``mesh.decode_batch`` degrades the group to the
+  single-device path (byte-identical), counts a fallback and journals
+  ``mesh_decode_degraded``;
+- an elastic-membership transition drains in-flight decode groups and
+  invalidates their sharding-plan cache entries (the mid-decode
+  regression);
+- a mesh-up cluster under DEGRADED reads stores shard bodies
+  byte-identical to a single-device twin.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.dispatch import g_dispatcher
+from ceph_tpu.ec.isa import ErasureCodeIsa
+from ceph_tpu.ec.tpu_plugin import ErasureCodeTpu
+from ceph_tpu.fault import g_breakers, g_faults
+from ceph_tpu.mesh import (g_chipstat, g_mesh, mesh_decode_perf_counters,
+                           rateless_perf_counters)
+from ceph_tpu.mesh.rateless import (l_rl_host_resolves,
+                                    l_rl_subset_completions)
+from ceph_tpu.mesh.runtime import (l_mdec_col_folds, l_mdec_dispatches,
+                                   l_mdec_fallbacks, l_mdec_plan_builds,
+                                   l_mdec_plan_hits,
+                                   l_mdec_repair_solves)
+from ceph_tpu.osd.ecutil import encode as eu_encode, stripe_info_t
+from ceph_tpu.trace.journal import g_journal
+
+
+@pytest.fixture
+def decode_conf():
+    """Every test leaves the dispatcher drained, the options at their
+    defaults, faults/breakers cleared and the mesh torn down."""
+    yield
+    g_faults.clear()
+    g_dispatcher.flush()
+    for name in ("ec_mesh_chips", "ec_mesh_rateless",
+                 "ec_mesh_rateless_tasks", "ec_mesh_skew_sample_every",
+                 "ec_mesh_skew_threshold", "ec_dispatch_batch_max",
+                 "ec_dispatch_batch_window_us"):
+        g_conf.rm_val(name)
+    g_mesh.topology()
+    g_chipstat.reset()
+    g_breakers.reset()
+
+
+def _mesh_on(chips=8, rateless=False):
+    g_conf.set_val("ec_mesh_chips", chips)
+    if rateless:
+        g_conf.set_val("ec_mesh_rateless", True)
+
+
+def _mk_impl(plugin, k, m, technique):
+    impl = plugin()
+    # explicit backend: these tests drive the device path on the CPU
+    # host platform, where backend=auto would route to host
+    impl.init({"k": str(k), "m": str(m), "technique": technique,
+               "backend": "tpu"})
+    return impl
+
+
+def _encode_stacked(impl, rng, stripes, chunk):
+    """Encode a random payload through the HOST oracle and return
+    every shard as its (S, C) stack — the ground truth any
+    reconstruction must reproduce byte-exactly."""
+    k, m = impl.k, impl.m
+    sinfo = stripe_info_t(k, k * chunk)
+    payload = rng.integers(0, 256, size=stripes * k * chunk,
+                           dtype=np.uint8)
+    shards = eu_encode(sinfo, impl, payload, set(range(k + m)))
+    return {i: np.ascontiguousarray(
+        np.asarray(b).reshape(stripes, chunk))
+        for i, b in shards.items()}
+
+
+# ---- by-construction passthroughs ------------------------------------------
+def test_mesh_off_decode_is_passthrough(decode_conf):
+    """Mesh off (the default) and a 1-chip mesh: ``decode_stacked``
+    returns None and the decode counters never move."""
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    pc = mesh_decode_perf_counters()
+    before = pc.get(l_mdec_dispatches)
+    full = _encode_stacked(impl, np.random.default_rng(3), 4, 1024)
+    survivors = np.stack([full[i] for i in (0, 2, 3, 4)], axis=1)
+    assert g_mesh.decode_stacked(impl, survivors, (0, 2, 3, 4),
+                                 (1,)) is None
+    g_conf.set_val("ec_mesh_chips", 1)
+    assert g_mesh.active() is False
+    assert g_mesh.decode_stacked(impl, survivors, (0, 2, 3, 4),
+                                 (1,)) is None
+    got = impl.decode_batch({i: full[i] for i in (0, 2, 3, 4)}, [1])
+    assert np.array_equal(got[1], full[1])
+    assert pc.get(l_mdec_dispatches) == before
+
+
+def test_mesh_declines_non_shardable_decode(decode_conf):
+    """Jerasure bitmatrix techniques transform the data layout before
+    the backend matmul — their decode must DECLINE the mesh
+    (mesh_decode_shardable False) and stay byte-identical on the
+    single-device path with the mesh up."""
+    from ceph_tpu.ec.jerasure import ErasureCodeJerasure
+    impl = ErasureCodeJerasure()
+    impl.init({"k": "4", "m": "2", "technique": "cauchy_good",
+               "packetsize": "8", "backend": "tpu"})
+    assert impl.mesh_decode_shardable is False
+    _mesh_on(chips=8)
+    pc = mesh_decode_perf_counters()
+    before = pc.get(l_mdec_dispatches)
+    chunk = impl._stripe_block() * 2
+    full = _encode_stacked(impl, np.random.default_rng(17), 2, chunk)
+    got = impl.decode_batch({i: full[i] for i in (0, 2, 3, 4)}, [1])
+    assert np.array_equal(got[1], full[1])
+    assert pc.get(l_mdec_dispatches) == before, \
+        "the mesh must decline layout-transforming decodes"
+
+
+# ---- byte identity (the property-test satellite) ---------------------------
+MIX = [
+    (ErasureCodeTpu, 4, 2, "reed_sol_van"),
+    (ErasureCodeTpu, 8, 4, "reed_sol_van"),
+    (ErasureCodeIsa, 3, 2, "cauchy"),
+    (ErasureCodeIsa, 6, 3, "reed_sol_van"),
+]
+
+
+@pytest.mark.parametrize("seed,rateless", [(11, False), (23, True),
+                                           (47, True)])
+def test_meshed_decode_byte_identity_property(decode_conf, seed,
+                                              rateless):
+    """Meshed reconstruction vs the encoded truth across randomized
+    (k, m, technique, chunk size, stripe count, erasure set) mixes on
+    both branches.  Stripe totals are deliberately NOT multiples of
+    the mesh size (padding lanes never leak), erasures mix data and
+    parity shards up to m, and every reconstruction must be
+    byte-exact with zero single-device fallbacks."""
+    _mesh_on(chips=8, rateless=rateless)
+    pc = mesh_decode_perf_counters()
+    before = pc.get(l_mdec_dispatches)
+    fb0 = pc.get(l_mdec_fallbacks)
+    rng = np.random.default_rng(seed)
+    impls = [_mk_impl(p, k, m, t) for p, k, m, t in MIX]
+    for _ in range(10):
+        impl = impls[rng.integers(0, len(impls))]
+        k, m = impl.k, impl.m
+        chunk = int(rng.choice([512, 1024, 1536]))
+        stripes = int(rng.integers(1, 7))
+        full = _encode_stacked(impl, rng, stripes, chunk)
+        # at least one DATA erasure (else decode is a passthrough)
+        n_lost = int(rng.integers(1, m + 1))
+        lost = [int(rng.integers(0, k))]
+        lost += [int(i) for i in rng.choice(
+            [i for i in range(k + m) if i != lost[0]],
+            size=n_lost - 1, replace=False)]
+        chunks = {i: full[i] for i in range(k + m) if i not in lost}
+        got = impl.decode_batch(chunks, lost)
+        for i in lost:
+            assert np.array_equal(got[i], full[i]), \
+                (type(impl).__name__, k, m, stripes, chunk, lost, i)
+    assert pc.get(l_mdec_dispatches) > before, \
+        "no reconstruction rode the mesh"
+    assert pc.get(l_mdec_fallbacks) == fb0, \
+        "a meshed reconstruction degraded to single-device"
+
+
+def test_decode_plan_cache_reuses(decode_conf):
+    """Two signature-equal reconstructions share ONE decode sharding
+    plan (build, then hit), and the plan rows show on the dispatch
+    dump with their srcs/want fingerprint."""
+    _mesh_on(chips=8)
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    pc = mesh_decode_perf_counters()
+    b0, h0 = pc.get(l_mdec_plan_builds), pc.get(l_mdec_plan_hits)
+    rng = np.random.default_rng(29)
+    for _ in range(2):
+        full = _encode_stacked(impl, rng, 4, 1024)
+        chunks = {i: full[i] for i in (0, 2, 3, 4)}
+        got = impl.decode_batch(chunks, [1])
+        assert np.array_equal(got[1], full[1])
+    assert pc.get(l_mdec_plan_builds) == b0 + 1
+    assert pc.get(l_mdec_plan_hits) >= h0 + 1
+    rows = [p for p in g_mesh.dump()["plans"]
+            if p.get("kind") == "decode"]
+    assert rows and rows[0]["srcs"] == [0, 2, 3, 4]
+    assert rows[0]["want_rows"] == [1]
+
+
+# ---- the regenerating family ----------------------------------------------
+def test_meshed_regenerating_decode_and_repair(decode_conf):
+    """pm_mbr / pm_msr: the ≥d decode and the d×d repair solve are
+    plain survivor matmuls — both ride the mesh byte-exactly, and the
+    thin single-stripe repair batch is folded along the byte axis so
+    it actually spans the chips (col_folds)."""
+    from ceph_tpu.ec.regenerating import ErasureCodeRegenerating
+    _mesh_on(chips=8, rateless=True)
+    pc = mesh_decode_perf_counters()
+    d0 = pc.get(l_mdec_dispatches)
+    r0 = pc.get(l_mdec_repair_solves)
+    f0 = pc.get(l_mdec_col_folds)
+    fb0 = pc.get(l_mdec_fallbacks)
+    rng = np.random.default_rng(11)
+    for tech, m in (("pm_mbr", "2"), ("pm_msr", "3")):
+        r = ErasureCodeRegenerating()
+        r.init({"k": "4", "m": m, "technique": tech, "backend": "tpu"})
+        n = r.k + r.m
+        sw = r.preferred_stripe_width()
+        sinfo = r.make_stripe_info(sw)
+        payload = rng.integers(0, 256, size=2 * sw, dtype=np.uint8)
+        shards = eu_encode(sinfo, r, payload, set(range(n)))
+        stacked = {i: np.ascontiguousarray(
+            np.asarray(b).reshape(2, -1)) for i, b in shards.items()}
+        missing = 1
+        sub = {i: b for i, b in stacked.items() if i != missing}
+        dec = r.decode_batch(sub, [missing])
+        assert np.array_equal(dec[missing], stacked[missing]), \
+            f"{tech} decode mismatch"
+        helpers = [i for i in range(n) if i != missing][:r.d]
+        contribs = {h: r.repair_contribution(h, missing, stacked[h])
+                    for h in helpers}
+        rep = r.repair(missing, contribs)
+        assert np.array_equal(rep, stacked[missing]), \
+            f"{tech} repair mismatch"
+    assert pc.get(l_mdec_dispatches) >= d0 + 4
+    assert pc.get(l_mdec_repair_solves) >= r0 + 2
+    assert pc.get(l_mdec_col_folds) > f0, \
+        "the thin repair batch never folded across the byte axis"
+    assert pc.get(l_mdec_fallbacks) == fb0
+
+
+# ---- rateless protection under chip loss / straggling ----------------------
+def test_rateless_decode_block_loss_resolved_from_subset(decode_conf):
+    """A chip that dies mid-decode (mesh.chip_fail) is just an
+    erasure: the drain completes from the first spanning subset and
+    the missing systematic blocks are byte-identically re-solved on
+    host — zero single-device fallbacks."""
+    _mesh_on(chips=8, rateless=True)
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    rng = np.random.default_rng(41)
+    pc = mesh_decode_perf_counters()
+    rl = rateless_perf_counters()
+    fb0 = pc.get(l_mdec_fallbacks)
+    hr0 = rl.get(l_rl_host_resolves)
+    sc0 = rl.get(l_rl_subset_completions)
+    g_faults.inject("mesh.chip_fail", mode="always", match="chip=3/")
+    try:
+        for _ in range(2):
+            full = _encode_stacked(impl, rng, 8, 1024)
+            chunks = {i: full[i] for i in (0, 1, 3, 4)}
+            got = impl.decode_batch(chunks, [2, 5])
+            for i in (2, 5):
+                assert np.array_equal(got[i], full[i])
+    finally:
+        g_faults.clear("mesh.chip_fail")
+    assert rl.get(l_rl_host_resolves) > hr0, \
+        "the lost chip's blocks were never re-solved on host"
+    assert rl.get(l_rl_subset_completions) > sc0
+    assert pc.get(l_mdec_fallbacks) == fb0, \
+        "a spanning subset answered — the single-device fallback " \
+        "must not fire"
+
+
+def test_decode_straggler_completes_from_spanning_subset(decode_conf):
+    """A 10x-slowed chip (mesh.chip_slowdown) never blocks a rateless
+    decode: the drain routes around it via parity and completes from
+    the first spanning subset, byte-exact, zero fallbacks."""
+    _mesh_on(chips=8, rateless=True)
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    rng = np.random.default_rng(43)
+    pc = mesh_decode_perf_counters()
+    rl = rateless_perf_counters()
+    fb0 = pc.get(l_mdec_fallbacks)
+    sc0 = rl.get(l_rl_subset_completions)
+    g_faults.inject("mesh.chip_slowdown", mode="always",
+                    match="chip=5/", delay_us=20_000)
+    try:
+        full = _encode_stacked(impl, rng, 8, 1024)
+        chunks = {i: full[i] for i in (1, 2, 3, 5)}
+        got = impl.decode_batch(chunks, [0, 4])
+        for i in (0, 4):
+            assert np.array_equal(got[i], full[i])
+    finally:
+        g_faults.clear("mesh.chip_slowdown")
+    assert rl.get(l_rl_subset_completions) > sc0, \
+        "the drain waited for the straggler instead of completing " \
+        "from the spanning subset"
+    assert pc.get(l_mdec_fallbacks) == fb0
+
+
+# ---- fault-guarded degradation ---------------------------------------------
+def test_decode_guard_exhaustion_degrades_byte_identical(decode_conf):
+    """mesh.decode_batch exhaustion: the group degrades to the
+    single-device path — the client read stays byte-exact, the
+    fallback is counted and ``mesh_decode_degraded`` is journaled."""
+    _mesh_on(chips=8)
+    g_journal.reset()
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    full = _encode_stacked(impl, np.random.default_rng(53), 4, 1024)
+    pc = mesh_decode_perf_counters()
+    fb0 = pc.get(l_mdec_fallbacks)
+    g_faults.inject("mesh.decode_batch", mode="always", error="device")
+    try:
+        got = impl.decode_batch({i: full[i] for i in (0, 2, 3, 4)},
+                                [1])
+    finally:
+        g_faults.clear("mesh.decode_batch")
+        g_breakers.reset()
+    assert np.array_equal(got[1], full[1]), \
+        "the degraded decode lost byte identity"
+    assert pc.get(l_mdec_fallbacks) > fb0
+    evs = [e for e in g_journal.merged()
+           if e["type"] == "mesh_decode_degraded"]
+    assert evs and evs[0]["repair"] is False
+    assert evs[0]["stripes"] == 4
+
+
+# ---- elastic membership mid-decode (the regression satellite) ---------------
+def test_membership_mid_decode_drains_and_invalidates(decode_conf):
+    """An ec_mesh_chips transition with decode groups queued AND
+    in-flight: the old mesh drains them first (byte-exact, zero
+    fallbacks), their sharding-plan cache entries are invalidated,
+    and the next decode rebuilds its plan on the NEW mesh."""
+    from ceph_tpu.mesh.runtime import (l_member_drained_reqs,
+                                       membership_perf_counters)
+    _mesh_on(chips=8)
+    g_conf.set_val("ec_dispatch_batch_window_us", 10_000_000)
+    g_conf.set_val("ec_dispatch_batch_max", 64)
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    k, m, chunk = 4, 2, 1024
+    sinfo = stripe_info_t(k, k * chunk)
+    rng = np.random.default_rng(61)
+    pc = mesh_decode_perf_counters()
+    fb0 = pc.get(l_mdec_fallbacks)
+
+    # a first decode builds the plan on the 8-mesh
+    full = _encode_stacked(impl, rng, 4, chunk)
+    got = impl.decode_batch({i: full[i] for i in (0, 2, 3, 4)}, [1])
+    assert np.array_equal(got[1], full[1])
+    assert [p for p in g_mesh.dump()["plans"]
+            if p.get("kind") == "decode"], "no decode plan cached"
+
+    # queue degraded reads (decode_concat groups), NOT yet flushed
+    mpc = membership_perf_counters()
+    dr0 = mpc.get(l_member_drained_reqs)
+    futs, oracles = [], []
+    for _ in range(3):
+        fl = _encode_stacked(impl, rng, 2, chunk)
+        chunks = {i: np.asarray(fl[i]).reshape(-1)
+                  for i in (0, 2, 3, 4, 5)}
+        want = np.stack([fl[i] for i in range(k)], axis=1)
+        oracles.append(np.ascontiguousarray(want).reshape(-1))
+        futs.append(g_dispatcher.submit_decode_concat(
+            sinfo, impl, chunks))
+
+    b_before = pc.get(l_mdec_plan_builds)
+    g_conf.set_checked("ec_mesh_chips", 6)      # injectargs-live
+    assert g_mesh.topology().size == 6
+    for f, oracle in zip(futs, oracles):
+        assert np.asarray(f.result()).tobytes() == oracle.tobytes(), \
+            "a decode group lost bytes across the transition"
+    assert mpc.get(l_member_drained_reqs) - dr0 >= 3, \
+        "the transition did not drain the queued decode groups"
+    # the 8-mesh decode plans are gone; the next decode rebuilds
+    assert not [p for p in g_mesh.dump()["plans"]
+                if p.get("kind") == "decode"], \
+        "stale decode plans survived the membership transition"
+    full = _encode_stacked(impl, rng, 4, chunk)
+    got = impl.decode_batch({i: full[i] for i in (0, 2, 3, 4)}, [1])
+    assert np.array_equal(got[1], full[1])
+    assert pc.get(l_mdec_plan_builds) > b_before, \
+        "the post-transition decode reused a stale plan"
+    assert pc.get(l_mdec_fallbacks) == fb0
+
+
+# ---- the cluster twin (stored-bytes satellite) ------------------------------
+def _ec_shard_bodies(c):
+    out = {}
+    for i, osd in c.osds.items():
+        for cid in osd.store.list_collections():
+            if "_meta" in cid or "s" not in cid.split(".")[-1]:
+                continue
+            for ho in osd.store.list_objects(cid):
+                out[(i, cid, str(ho))] = osd.store.read(cid, ho)
+    return out
+
+
+def test_twin_cluster_degraded_reads_byte_identical(decode_conf):
+    """A mesh-up cluster under DEGRADED reads (a data-shard holder
+    killed mid-workload) returns every read byte-exact through the
+    meshed decode path and stores shard bodies byte-identical to a
+    single-device twin."""
+    from ceph_tpu.cluster import MiniCluster
+    pc = mesh_decode_perf_counters()
+
+    def run(mesh: bool):
+        if mesh:
+            _mesh_on(chips=8, rateless=True)
+            g_conf.set_val("ec_dispatch_batch_window_us", 200_000)
+        else:
+            for name in ("ec_mesh_chips", "ec_mesh_rateless",
+                         "ec_dispatch_batch_window_us"):
+                g_conf.rm_val(name)
+        g_mesh.topology()
+        c = MiniCluster(n_osds=6)
+        c.create_ec_pool("dtwin", k=3, m=2, pg_num=4)
+        cl = c.client("client.dtwin")
+        rng = np.random.default_rng(77)
+        expected = {}
+        for i in range(3):
+            body = bytes(rng.integers(0, 256, 9000 + 3001 * i,
+                                      dtype=np.uint8))
+            assert cl.write_full("dtwin", f"o{i}", body) == 0
+            expected[f"o{i}"] = body
+        # kill a non-primary DATA-shard holder of o0 — identical
+        # placement in both twins picks the same victim
+        pid = c.mon.osdmap.lookup_pg_pool_name("dtwin")
+        victim = next(
+            o.osd_id for o in c.osds.values()
+            for cid in o.store.list_collections()
+            if cid.startswith(f"{pid}.") and "s" in cid
+            and cid.rsplit("s", 1)[1] in ("1", "2")
+            and any(ho.oid == "o0"
+                    for ho in o.store.list_objects(cid)))
+        c.kill_osd(victim)
+        c.mark_osd_down(victim)
+        for oid, body in expected.items():
+            assert cl.read("dtwin", oid) == body, (mesh, oid)
+        return victim, _ec_shard_bodies(c)
+
+    d0 = pc.get(l_mdec_dispatches)
+    fb0 = pc.get(l_mdec_fallbacks)
+    victim_m, meshed = run(mesh=True)
+    assert pc.get(l_mdec_dispatches) > d0, \
+        "no degraded read rode the meshed decode path"
+    assert pc.get(l_mdec_fallbacks) == fb0
+    victim_s, single = run(mesh=False)
+    assert victim_m == victim_s
+    assert set(meshed) == set(single)
+    diffs = [key for key in single
+             if bytes(meshed[key]) != bytes(single[key])]
+    assert not diffs, f"{len(diffs)} shard bodies differ: {diffs[:5]}"

@@ -266,6 +266,35 @@ def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
     c.admin_socket.execute("tpu incident dump")
     assert calls["n"] == 0, "journal emit / incident capture added " \
         "a device sync"
+    # meshed-READ extension (the straggler-proof read PR): a DEGRADED
+    # read reconstructed through the mesh decode path — plan build,
+    # pooled staging, survivor-sharded matmul, occupancy accounting —
+    # must add zero untracked syncs, exactly like the meshed write
+    from ceph_tpu.mesh import g_mesh, mesh_decode_perf_counters
+    from ceph_tpu.mesh.runtime import l_mdec_dispatches
+    pid = c.mon.osdmap.lookup_pg_pool_name("trace")
+    victim = next(
+        o.osd_id for o in c.osds.values()
+        for cid in o.store.list_collections()
+        if cid.startswith(f"{pid}.") and "s" in cid
+        and cid.rsplit("s", 1)[1] in ("1", "2")
+        and any(ho.oid == "o_off" for ho in o.store.list_objects(cid)))
+    c.kill_osd(victim)
+    c.mark_osd_down(victim)
+    g_conf.set_val("ec_mesh_chips", 8)
+    mdec0 = mesh_decode_perf_counters().get(l_mdec_dispatches)
+    try:
+        assert cl.read("trace", "o_off") == b"x" * 20000
+    finally:
+        g_conf.rm_val("ec_mesh_chips")
+        g_mesh.topology()
+    assert mesh_decode_perf_counters().get(l_mdec_dispatches) > mdec0, \
+        "degraded read never rode the meshed decode path"
+    assert calls["n"] == 0, "meshed degraded read added a device sync"
+    c.revive_osd(victim)
+    for _ in range(3):
+        c.tick(dt=6.0)
+    assert calls["n"] == 0
     # chaos extension: the composer is pure host-side seeded sampling
     # (no jax import at all), and a FULL storyline run — engine knobs,
     # open-loop traffic, fault arms, settle ticks, acceptance judgment

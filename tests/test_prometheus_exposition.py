@@ -96,6 +96,26 @@ def exposition():
         g_conf.rm_val("ec_mesh_skew_sample_every")
         g_conf.rm_val("ec_mesh_rateless")
         g_mesh.topology()
+    # and one DEGRADED read through the MESH path (kill a data-shard
+    # holder, reconstruct with the mesh up) so the mesh_decode_*
+    # counter family and the decode occupancy histogram render with
+    # real content
+    pid = c.mon.osdmap.lookup_pg_pool_name("prom")
+    victim = next(
+        o.osd_id for o in c.osds.values()
+        for cid in o.store.list_collections()
+        if cid.startswith(f"{pid}.") and "s" in cid
+        and cid.rsplit("s", 1)[1] in ("1", "2")   # non-primary DATA shard
+        and any(ho.oid == "o4" for ho in o.store.list_objects(cid)))
+    c.kill_osd(victim)
+    c.mark_osd_down(victim)
+    g_conf.set_val("ec_mesh_chips", 8)
+    try:
+        assert cl.read("prom", "o4")[:1] == b"s"
+    finally:
+        from ceph_tpu.mesh import g_mesh
+        g_conf.rm_val("ec_mesh_chips")
+        g_mesh.topology()
     return c.admin_socket.execute("prometheus metrics")
 
 
@@ -229,6 +249,42 @@ def test_mesh_rateless_counters(exposition):
         assert vals, f"{counter} missing from the exposition"
         if expect_positive:
             assert vals[0] > 0, f"{counter} never moved"
+
+
+def test_mesh_decode_counters(exposition):
+    """Meshed-READ-path golden coverage (the straggler-proof read PR):
+    the ``mesh_decode_*`` counter family renders as
+    ``ceph_daemon_mesh_decode_*`` daemon series carrying the fixture's
+    degraded read — dispatches/stripes/plan builds moved, the
+    inflight gauge settled back to zero — and the decode occupancy
+    histogram renders as a real histogram family with per-chip
+    samples.  The counters are process-global cumulative (other tests
+    in the session may have exercised the fallback path on purpose),
+    so zero-fallback semantics live in the delta-based assertions of
+    tests/test_mesh_decode.py, not here."""
+    types, samples = _parse(exposition)
+    for counter, expect_positive in (
+            ("ceph_daemon_mesh_decode_dispatches", True),
+            ("ceph_daemon_mesh_decode_stripes", True),
+            ("ceph_daemon_mesh_decode_bytes", True),
+            ("ceph_daemon_mesh_decode_plan_builds", True),
+            ("ceph_daemon_mesh_decode_fallbacks", False),
+            ("ceph_daemon_mesh_decode_repair_solves", False),
+            ("ceph_daemon_mesh_decode_inflight", False)):
+        vals = [v for n, _l, v in samples if n == counter]
+        assert vals, f"{counter} missing from the exposition"
+        if expect_positive:
+            assert vals[0] > 0, f"{counter} never moved"
+        elif counter.endswith("inflight"):
+            assert vals[0] == 0, f"{counter} stuck: {vals[0]}"
+    fam = "ceph_mesh_decode_chip_occupancy_histogram"
+    assert types.get(fam) == "histogram", \
+        "decode occupancy histogram family missing"
+    buckets = [(_le_of(labels), v) for n, labels, v in samples
+               if n == f"{fam}_bucket"]
+    assert buckets, "no decode-occupancy buckets rendered"
+    infs = [v for le, v in buckets if le == math.inf]
+    assert infs and infs[0] >= 8, "fewer than 8 per-chip decode samples"
 
 
 def test_mesh_chip_family_and_counters(exposition):
