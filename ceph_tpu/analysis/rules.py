@@ -132,6 +132,8 @@ SYNC_ALLOWED = (
     "arch.py",                 # one-shot capability probe
     "ec/shec.py",              # SHEC device decode call site
     "osdmap/mapping.py",       # CRUSH device mapper d2h boundary
+    "os_store/device_shard.py",  # DeviceShard materialize: accounted
+                                 # d2h at memstore.fetch_shard
 )
 
 _SYNC_PRIMITIVES = ("block_until_ready", "device_get")

@@ -132,6 +132,24 @@ STRAGGLER_MAX_BANDWIDTH_OVERHEAD = 2.0
 # - client ops stayed byte-exact throughout (the control plane never
 #   touches the data path).
 
+# the ZERO-COPY gate (device-resident shard store PR,
+# docs/DISPATCH.md "Zero-copy write path"): the ec_write_zero_copy
+# workload's `zero_copy` block A/Bs the resident write path
+# (os_memstore_device_bytes_max large — fused encode+crc, shard bodies
+# stay in HBM) against the bytes twin (budget 0).  Absolute
+# invariants, baseline or not:
+# - the resident leg's write-region d2h stays under the devflow floor
+#   (the only fetch is the crc scalar — a shard body crossing back is
+#   a regression of the whole point);
+# - resident copies_per_op STRICTLY below the bytes twin's (the
+#   deleted pack/slice/message copies must show up in the ledger);
+# - residency actually engaged (DeviceShard handles live in the store
+#   when the write region closes — a 0 here means the fused path
+#   silently degraded and the A/B measured nothing);
+# - read-backs byte-exact on both legs (lazy materialization is
+#   invisible in the bytes).
+ZERO_COPY_MAX_D2H_BYTES_PER_OP = 512.0
+
 # the CHAOS GATE (composed-chaos scenario engine PR, docs/CHAOS.md):
 # the composed_chaos workload's `chaos` block carries one receipt per
 # pinned storyline seed — the engine's own universal-acceptance
@@ -248,6 +266,7 @@ def compare_against_trajectory(
     straggler_compared = 0  # straggler blocks checked (absolute gate)
     control_compared = 0   # control blocks checked (absolute gate)
     chaos_compared = 0     # chaos blocks checked (absolute gate)
+    zero_copy_compared = 0  # zero_copy blocks checked (absolute gate)
     for cur in current:
         if not cur.get("fenced") or cur.get("suspect"):
             continue
@@ -272,6 +291,11 @@ def compare_against_trajectory(
         if isinstance(ch, dict):
             chaos_compared += 1
             regressions.extend(_chaos_gate(name, ch))
+        # ---- ZERO-COPY gate: absolute invariants, baseline or not ------
+        zc = cur.get("zero_copy")
+        if isinstance(zc, dict):
+            zero_copy_compared += 1
+            regressions.extend(_zero_copy_gate(name, zc))
         baseline = None
         baseline_round = None
         for rec in reversed(trajectory):
@@ -345,6 +369,7 @@ def compare_against_trajectory(
             "straggler_compared": straggler_compared,
             "control_compared": control_compared,
             "chaos_compared": chaos_compared,
+            "zero_copy_compared": zero_copy_compared,
             "no_baseline": no_baseline,
             "tolerance": tolerance, "platform": platform}
 
@@ -498,6 +523,42 @@ def _straggler_gate(name: str,
         fail("healthy_false_suspects",
              st.get("healthy_false_suspects"),
              "the healthy twin marked a suspect")
+    return out
+
+
+def _zero_copy_gate(name: str,
+                    zc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The zero-copy workload's absolute invariants as regression
+    entries (change=None — the resident write path either deletes the
+    copies or it does not)."""
+    out: List[Dict[str, Any]] = []
+
+    def fail(key: str, value, why: str) -> None:
+        out.append({"name": f"{name}.zero_copy.{key}",
+                    "unit": "invariant", "value": value,
+                    "baseline": why, "baseline_round": None,
+                    "change": None})
+
+    d2h = float(zc.get("resident_d2h_bytes_per_op") or 0.0)
+    if d2h >= ZERO_COPY_MAX_D2H_BYTES_PER_OP:
+        fail("resident_d2h_bytes_per_op", d2h,
+             f"the resident write path fetched >= "
+             f"{ZERO_COPY_MAX_D2H_BYTES_PER_OP} B/op from device — a "
+             f"shard body is crossing back on the write path")
+    res = float(zc.get("resident_copies_per_op") or 0.0)
+    twin = float(zc.get("twin_copies_per_op") or 0.0)
+    if not res < twin:
+        fail("resident_copies_per_op", res,
+             f"resident leg not strictly below the bytes twin's "
+             f"{twin} copies/op — the fused path deleted nothing")
+    if int(zc.get("resident_shards") or 0) <= 0:
+        fail("resident_shards", zc.get("resident_shards"),
+             "no DeviceShard was resident when the write region "
+             "closed — the fused path silently degraded and the A/B "
+             "measured nothing")
+    if not zc.get("byte_exact"):
+        fail("byte_exact", zc.get("byte_exact"),
+             "a read-back diverged from the written payload")
     return out
 
 

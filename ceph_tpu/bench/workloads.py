@@ -1268,6 +1268,111 @@ def measure_traffic(*, n_clients: int = 8, ops_per_client: int = 32,
                "errors": res.errors[:8]})
 
 
+def measure_ec_write_zero_copy(*, n_osds: int = 6, k: int = 3,
+                               m: int = 2, n_objects: int = 6,
+                               stripes_per_object: int = 2,
+                               pg_num: int = 8,
+                               name: str = "ec_write_zero_copy"
+                               ) -> Dict[str, Any]:
+    """The zero-copy write path A/B (docs/DISPATCH.md "Zero-copy write
+    path"): the same EC client writes through two fresh mini-clusters —
+    device-RESIDENT (``os_memstore_device_bytes_max`` large: fused
+    encode+crc, shard bodies stay in HBM as DeviceShard handles, zero
+    body d2h) vs the BYTES twin (budget 0: today's host-bytes funnel) —
+    with each leg's devflow captured over the write region only.
+
+    The receipt is the ``zero_copy`` block, judged by regress.py's
+    ZERO-COPY gate as absolute invariants: the resident leg's write-path
+    d2h must stay under the devflow floor (512 B/op — the only fetch is
+    the crc scalar), its copies_per_op must be STRICTLY below the bytes
+    twin's (the deleted copies are the whole point), residency must have
+    actually engaged (shard handles live in the store when the region
+    closes), and read-backs — which materialize lazily, AFTER the delta
+    capture — must be byte-exact on both legs and equal across them.
+
+    Fencing: the write region's clock stops when every client ack has
+    returned on the in-process fabric; the resident leg's encode path
+    ends in the crc d2h fetch, which is itself a completion fence for
+    the fused kernel (the scalar cannot come back before the shard
+    bodies exist)."""
+    from ..cluster import MiniCluster
+    from ..common.config import g_conf
+    from ..os_store.device_shard import g_device_budget
+
+    width = k * int(g_conf.get_val("osd_pool_erasure_code_stripe_unit"))
+    object_bytes = stripes_per_object * width
+    rng = np.random.default_rng(20260807)
+    payloads = [rng.integers(0, 256, size=object_bytes,
+                             dtype=np.uint8).tobytes()
+                for _ in range(n_objects)]
+    saved = g_conf.values.get("os_memstore_device_bytes_max")
+    pc = bench_perf_counters()
+    legs: Dict[str, Dict[str, Any]] = {}
+    read_backs: Dict[str, list] = {}
+    try:
+        for leg, budget in (("resident", 1 << 30), ("bytes_twin", 0)):
+            g_conf.set_val("os_memstore_device_bytes_max", budget)
+            cluster = MiniCluster(n_osds=n_osds)
+            cluster.create_ec_pool("zc", k=k, m=m, pg_num=pg_num)
+            cl = cluster.client(f"client.zc_{leg}")
+            flow0 = g_devprof.snapshot()
+            stage0 = g_oplat.snapshot()
+            t0 = time.perf_counter()
+            for i, data in enumerate(payloads):
+                rc = cl.write_full("zc", f"obj-{i}", data)
+                assert rc == 0, f"write_full rc={rc}"
+            wall_s = max(time.perf_counter() - t0, 1e-9)
+            # the gated receipt: flow over the WRITE region only —
+            # read-backs (below) materialize resident shards, and that
+            # d2h is the read path's to pay, not the write path's
+            flow = _devflow_since(flow0, n_objects)
+            breakdown = _stage_breakdown_since(stage0, wall_s,
+                                               n_objects)
+            resident_shards = g_device_budget.resident_shards()
+            read_backs[leg] = [cl.read("zc", f"obj-{i}")
+                               for i in range(n_objects)]
+            legs[leg] = {"devflow": flow, "stage_breakdown": breakdown,
+                         "wall_s": wall_s,
+                         "resident_shards": resident_shards,
+                         "ops_per_sec": round(n_objects / wall_s, 2)}
+            pc.inc(l_bench_bytes, n_objects * object_bytes)
+    finally:
+        if saved is None:
+            g_conf.rm_val("os_memstore_device_bytes_max")
+        else:
+            g_conf.set_val("os_memstore_device_bytes_max", saved)
+    byte_exact = all(
+        bytes(read_backs["resident"][i]) == payloads[i]
+        and bytes(read_backs["bytes_twin"][i]) == payloads[i]
+        for i in range(n_objects))
+    res_flow = legs["resident"]["devflow"]
+    twin_flow = legs["bytes_twin"]["devflow"]
+    zero_copy = {
+        "resident": res_flow,
+        "bytes_twin": twin_flow,
+        "resident_d2h_bytes_per_op": round(
+            res_flow["d2h_bytes"] / max(n_objects, 1), 2),
+        "resident_copies_per_op": res_flow["copies_per_op"],
+        "twin_copies_per_op": twin_flow["copies_per_op"],
+        "resident_shards": legs["resident"]["resident_shards"],
+        "byte_exact": bool(byte_exact),
+    }
+    v = legs["resident"]["ops_per_sec"]
+    return make_metric(
+        name, v, "ops/s", fenced=True,
+        stats={"n": 1, "median": v, "iqr": 0.0, "min": v, "max": v},
+        roofline={"verdict": "unknown", "suspect": False},
+        extra={"n_objects": n_objects, "object_bytes": object_bytes,
+               "k": k, "m": m,
+               "devflow": res_flow,
+               "stage_breakdown": legs["resident"]["stage_breakdown"],
+               "twin_ops_per_sec": legs["bytes_twin"]["ops_per_sec"],
+               "twin_devflow": twin_flow,
+               "twin_stage_breakdown":
+                   legs["bytes_twin"]["stage_breakdown"],
+               "zero_copy": zero_copy})
+
+
 def measure_recovery_storm(*, k: int = 8, m: int = 4, d: int = 10,
                            n_osds: int = 0, pg_num: int = 4,
                            n_objects: int = 8,

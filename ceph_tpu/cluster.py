@@ -335,6 +335,8 @@ class MiniCluster:
             lambda c, a: (g_oplat.reset(), {"reset": True})[1],
             "zero the stage-latency ledger's histograms and counters")
         self.perf_collection.add(devprof_perf_counters())
+        from .os_store import memstore_device_perf_counters
+        self.perf_collection.add(memstore_device_perf_counters())
         asok.register(
             "prof dump",
             lambda c, a: g_devprof.dump(),

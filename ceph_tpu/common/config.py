@@ -204,6 +204,16 @@ def build_options() -> List[Option]:
         Option("ec_breaker_cooldown_s", OPT_FLOAT).set_default(30.0)
         .set_description("seconds an open breaker refuses the device "
                          "before half-open probing it to auto-restore"),
+        Option("os_memstore_device_bytes_max", OPT_INT).set_default(0)
+        .set_description("device-resident shard store byte budget "
+                         "(os_store/device_shard): > 0 lets the EC "
+                         "write path store encoded shard bodies as "
+                         "HBM handles (zero d2h on the encode->store "
+                         "path, crc fused into the encode kernel) and "
+                         "LRU-demotes the coldest resident shards to "
+                         "host bytes past the budget.  0 (default) = "
+                         "residency off, host-bytes store by "
+                         "construction"),
         Option("osd_recovery_repair_reads", OPT_BOOL).set_default(True)
         .set_description("repair a single lost shard of a "
                          "regenerating-code pool from d sub-chunk "

@@ -205,20 +205,23 @@ def _run_group_encode(reqs, bucket_c, leader, use_device):
     coding = np.asarray(coding)
     out: List[Dict[int, np.ndarray]] = []
     for r, (off, stripes) in zip(reqs, offsets):
-        res: Dict[int, np.ndarray] = {}
-        for i in r.want:
+        # one contiguous pack per request, shard outputs as row views
+        # (the fan-out sends memoryviews of these rows — same idiom as
+        # ecutil._pack_rows)
+        want_l = sorted(r.want)
+        pack = np.empty((len(want_l), r.n_stripes * r.chunk_size),
+                        dtype=np.uint8)
+        for j, i in enumerate(want_l):
+            dst = pack[j].reshape(r.n_stripes, r.chunk_size)
             if full_out:
-                res[i] = np.ascontiguousarray(
-                    coding[off:off + r.n_stripes, i,
-                           :r.chunk_size]).reshape(-1)
+                dst[:] = coding[off:off + r.n_stripes, i, :r.chunk_size]
             elif i < k:
-                res[i] = np.ascontiguousarray(
-                    stripes[:, i, :]).reshape(-1)
+                dst[:] = stripes[:, i, :]
             else:
-                res[i] = np.ascontiguousarray(
-                    coding[off:off + r.n_stripes, i - k,
-                           :r.chunk_size]).reshape(-1)
-        out.append(res)
+                dst[:] = coding[off:off + r.n_stripes, i - k,
+                                :r.chunk_size]
+        g_devprof.account_host_copy("dispatch.pack_shards", pack.nbytes)
+        out.append({i: pack[j] for j, i in enumerate(want_l)})
     return out
 
 

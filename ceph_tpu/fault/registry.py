@@ -110,6 +110,11 @@ SITE_CATALOG: Dict[str, str] = {
     "osd.shard_read_eio":
         "shard-side EC read returns EIO (bluestore_debug_inject_read_err "
         "role) — the primary must reconstruct from surviving shards",
+    "store.shard_corrupt":
+        "flip one byte of a stored shard body at read time (memstore) — "
+        "the shard-side crc32c verify must catch it and return EIO, "
+        "whether the body is host bytes or a device-resident handle; "
+        "context is '<coll>/<oid>' for match= scoping",
     "recovery.repair_read":
         "sub-chunk repair round start (recovery scheduler) — firing "
         "degrades the repair to the full-stripe decode path",

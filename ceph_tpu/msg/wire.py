@@ -140,6 +140,12 @@ def encode_message(msg: M.Message) -> bytes:
     # frames and the pinned corpus stay byte-identical (a receiver
     # opens a fresh ledger at intake instead)
     fields.pop("_oplat", None)
+    for key, v in fields.items():
+        if hasattr(v, "materialize"):
+            # device-resident payloads (os_store DeviceShard) leave
+            # the process as plain bytes: the handle is an in-process
+            # fast path only, frames stay byte-identical either way
+            fields[key] = v.materialize()
     if isinstance(msg, M.MOSDMap):
         from ..osdmap.encoding import incremental_to_dict
         fields["incrementals"] = [incremental_to_dict(i)

@@ -1,0 +1,166 @@
+"""crc32c (Castagnoli) as a jitted device kernel, fused into EC encode.
+
+Bit-identical to ``utils/crc32c.py`` (Ceph's conventions: seed -1, no
+final inversion) so a digest computed on-device can be compared against
+a stored HashInfo digest or re-checked by the host path at any time.
+
+Formulation: slicing-by-8 with eight host-precomputed 256-entry uint32
+tables (the classic Intel construction — table k advances the CRC past
+k+1 bytes).  The body consumes the buffer as 8-byte little-endian words
+in a ``fori_loop`` and finishes the non-word-aligned tail byte-at-a-time.
+The buffer LENGTH is a *traced* operand over a fixed padded shape, so
+one compiled program serves every length that fits the pad — the
+0..4097 property sweep compiles once, and the fused encode kernel can
+vmap it across all n shards of a stripe batch.
+
+Gathers from (8, 256) tables do not tile onto the MXU the way the GF
+matmul does, but the CRC runs on the VPU *after* the encode inside the
+same jit, overlapping the epilogue with the systolic work — and the
+whole point is what it deletes: the d2h of every shard body that the
+host hash used to force.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..common.lockdep import DebugLock
+from ..trace.devprof import g_devprof
+from ..utils.crc32c import _TABLE
+
+
+@functools.lru_cache(maxsize=1)
+def _slicing_tables_np() -> np.ndarray:
+    """(8, 256) uint32: row 0 is the byte table, row k advances k+1 bytes."""
+    t = np.zeros((8, 256), dtype=np.uint32)
+    t[0] = _TABLE
+    for k in range(1, 8):
+        t[k] = t[0][t[k - 1] & 0xFF] ^ (t[k - 1] >> np.uint32(8))
+    return t
+
+
+_tables_dev: Optional[jnp.ndarray] = None
+_tables_lock = DebugLock("crc32c_device::tables")
+
+
+def _tables() -> jnp.ndarray:
+    """The slicing tables as a device array (uploaded once, accounted)."""
+    global _tables_dev
+    if _tables_dev is not None:
+        return _tables_dev
+    with _tables_lock:
+        if _tables_dev is None:
+            host = _slicing_tables_np()
+            g_devprof.account_h2d("crc32c.tables", host.nbytes)
+            _tables_dev = jnp.asarray(host)
+    return _tables_dev
+
+
+def device_crc_available() -> bool:
+    """True when jax can run the kernel at all (any backend)."""
+    try:
+        return bool(jax.devices())
+    except Exception:
+        return False
+
+
+def _crc_one(padded: jnp.ndarray, length: jnp.ndarray,
+             tables: jnp.ndarray) -> jnp.ndarray:
+    """CRC of ``padded[:length]``; padded is 1-D uint8, len % 8 == 0.
+
+    ``length`` is traced: the word loop and the tail loop both carry
+    dynamic trip counts, so one compile covers every length <= the pad.
+    """
+    words = padded.reshape(-1, 8).astype(jnp.uint32)
+    length = length.astype(jnp.uint32)
+    nwords = length // 8
+
+    def word_body(i, c):
+        w = words[i]
+        lo = c ^ (w[0] | (w[1] << 8) | (w[2] << 16) | (w[3] << 24))
+        return (tables[7][lo & 0xFF]
+                ^ tables[6][(lo >> 8) & 0xFF]
+                ^ tables[5][(lo >> 16) & 0xFF]
+                ^ tables[4][(lo >> 24) & 0xFF]
+                ^ tables[3][w[4]]
+                ^ tables[2][w[5]]
+                ^ tables[1][w[6]]
+                ^ tables[0][w[7]])
+
+    c = jax.lax.fori_loop(jnp.uint32(0), nwords, word_body,
+                          jnp.uint32(0xFFFFFFFF))
+
+    flat = padded.astype(jnp.uint32)
+
+    def byte_body(i, c):
+        return tables[0][(c ^ flat[i]) & 0xFF] ^ (c >> 8)
+
+    return jax.lax.fori_loop(nwords * 8, length, byte_body, c)
+
+
+_crc_batch = jax.jit(jax.vmap(_crc_one, in_axes=(0, 0, None)))
+
+
+def crc_core(bodies: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+    """(n, L) uint8 device bodies -> (n,) uint32 CRCs; jit-composable.
+
+    Pads each row to a word multiple inside the trace (static shape
+    math) and runs the vmapped traced-length core, so fusing this after
+    an encode adds no host round-trip.
+    """
+    n, L = bodies.shape
+    pad = (-L) % 8
+    if pad:
+        bodies = jnp.pad(bodies, ((0, 0), (0, pad)))
+    lengths = jnp.full((n,), L, dtype=jnp.uint32)
+    return jax.vmap(_crc_one, in_axes=(0, 0, None))(bodies, lengths, tables)
+
+
+def crc32c_device_batch(arr2d) -> np.ndarray:
+    """Host entry: (n, L) uint8 -> (n,) python-side uint32 CRCs.
+
+    The single (n * 4)-byte fetch is the caller's to account; this is
+    the standalone verify/scrub entry, not the fused encode path.
+    """
+    a = np.ascontiguousarray(np.asarray(arr2d, dtype=np.uint8))
+    n, L = a.shape
+    pad = (-L) % 8
+    if pad:
+        a = np.pad(a, ((0, 0), (0, pad)))
+    lengths = jnp.full((n,), L, dtype=jnp.uint32)
+    out = _crc_batch(jnp.asarray(a), lengths, _tables())
+    return np.asarray(out)
+
+
+@jax.jit
+def _crc_dev_one(dev: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+    return crc_core(dev[None, :], tables)[0]
+
+
+def crc32c_of_device_array(dev) -> int:
+    """CRC of a 1-D uint8 DEVICE array without fetching the body: the
+    kernel runs where the bytes live and only the 4-byte scalar comes
+    back (accounted at ``crc32c.verify_fetch``) — the scrub/read-verify
+    path for still-resident shards."""
+    out = np.asarray(_crc_dev_one(dev, _tables()))
+    g_devprof.account_d2h("crc32c.verify_fetch", out.nbytes)
+    return int(out)
+
+
+def crc32c_device_padded(padded2d, lengths) -> np.ndarray:
+    """Property-test entry: (n, L8) uint8 + per-row traced lengths.
+
+    One compile for the whole 0..4097 sweep when every call reuses the
+    same padded shape.
+    """
+    a = np.ascontiguousarray(np.asarray(padded2d, dtype=np.uint8))
+    assert a.shape[1] % 8 == 0
+    ln = jnp.asarray(np.asarray(lengths, dtype=np.uint32))
+    out = _crc_batch(jnp.asarray(a), ln, _tables())
+    return np.asarray(out)

@@ -184,6 +184,12 @@ class DeviceRSBackend:
         """Device-resident variant; composes under jit/shard_map."""
         return gf_bit_matmul(data, self._enc_bits)
 
+    @property
+    def enc_bits(self) -> jnp.ndarray:
+        """The expanded 0/1 coding matrix on device — the operand the
+        fused encode+crc kernel (ops/resident) composes with."""
+        return self._enc_bits
+
     # -- decode -------------------------------------------------------------
     def _decode_bits_for(self, srcs: Tuple[int, ...],
                          want_rows: Tuple[int, ...]) -> jnp.ndarray:

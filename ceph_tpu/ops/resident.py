@@ -1,0 +1,81 @@
+"""Fused device-resident EC encode: GF matmul + crc32c, zero body d2h.
+
+One jitted program takes the (S, k, C) stripe batch and produces BOTH
+the per-shard concatenated bodies (still on device) and their crc32c
+digests (ops/crc32c_device, bit-identical to ``utils/crc32c.py``).  The
+only device->host traffic on the whole encode->store path is the 4*n
+bytes of CRC scalars — the fetch that used to be every shard body so
+the host could hash it.  Shard layout matches the host path exactly:
+body i is chunk i of every stripe concatenated (``allc[:, i, :]``
+flattened), so stored bytes and HashInfo digests are byte-identical to
+a residency-off twin by construction.
+
+The bodies come back as per-shard ``DeviceShard`` handles ready to be
+queued through ``Transaction.write_shard`` (os_store/device_shard).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..os_store.device_shard import DeviceShard
+from ..trace.devprof import g_devprof
+from .crc32c_device import _tables, crc_core
+from .gf_matmul import DeviceRSBackend, gf_bit_matmul
+
+
+@jax.jit
+def _fused_encode_crc(stripes: jnp.ndarray, enc_bits: jnp.ndarray,
+                      tables: jnp.ndarray):
+    """(S, k, C) uint8 -> ((n, S*C) shard bodies, (n,) uint32 crcs)."""
+    coding = gf_bit_matmul(stripes, enc_bits)            # (S, m, C)
+    allsh = jnp.concatenate([stripes, coding], axis=1)   # (S, n, C)
+    n = allsh.shape[1]
+    bodies = jnp.transpose(allsh, (1, 0, 2)).reshape(n, -1)
+    return bodies, crc_core(bodies, tables)
+
+
+def resident_capable(ec_impl) -> bool:
+    """True when *ec_impl*'s device path is the plain row-independent
+    matrix matmul on raw chunks — the only layout the fused kernel
+    models.  Word/bitmatrix/regenerating codecs (transformed layouts,
+    non-identity chunk mappings) take the classic path."""
+    if ec_impl.get_chunk_mapping():
+        return False
+    if not getattr(ec_impl, "mesh_row_shardable", False):
+        return False
+    if not hasattr(ec_impl, "device"):
+        return False
+    try:
+        return isinstance(ec_impl.device(), DeviceRSBackend)
+    except Exception:
+        return False
+
+
+def encode_resident_shards(ec_impl, stripes: np.ndarray) \
+        -> Optional[Dict[int, DeviceShard]]:
+    """Encode a (S, k, C) stripe batch into device-resident shards.
+
+    Returns shard id -> ``DeviceShard`` for ALL n shards, or None when
+    the codec's layout rules the fused kernel out.  The h2d of the
+    stripe batch and the one 4*n-byte CRC fetch are the accounted
+    entirety of this path's host<->device traffic; the CRC fetch also
+    serves as the encode's completion fence (no block_until_ready)."""
+    if not resident_capable(ec_impl):
+        return None
+    backend: DeviceRSBackend = ec_impl.device()
+    g_devprof.install_compile_listener()
+    g_devprof.account_h2d("ec.encode_resident", stripes.nbytes)
+    with g_devprof.stage("ec.encode_resident"):
+        bodies, crcs = _fused_encode_crc(
+            jnp.asarray(stripes), backend.enc_bits, _tables())
+        crcs_np = np.asarray(crcs)
+    g_devprof.account_d2h("ec.crc_fetch", crcs_np.nbytes)
+    S, _k, C = stripes.shape
+    length = S * C
+    return {i: DeviceShard(bodies[i], length, int(crcs_np[i]))
+            for i in range(bodies.shape[0])}

@@ -1,8 +1,11 @@
+from .device_shard import (DeviceShard, g_device_budget,
+                           memstore_device_perf_counters)
 from .memstore import MemStore, Transaction, hobject_t
 from .walstore import WALStore, mount_store
 
 __all__ = ["MemStore", "Transaction", "hobject_t", "WALStore",
-           "mount_store"]
+           "mount_store", "DeviceShard", "g_device_budget",
+           "memstore_device_perf_counters"]
 
 
 def parse_pg_from_cid(cid: str):

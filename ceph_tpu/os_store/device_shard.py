@@ -1,0 +1,241 @@
+"""Device-resident shard bodies for MemStore.
+
+A ``DeviceShard`` is a shard body that never made the device->host trip:
+an on-device array handle plus its length and the crc32c the fused
+encode kernel computed before any d2h (ops/crc32c_device).  MemStore
+stores the handle as the object's data; the body is lazily materialized
+to host bytes on the first host read (an *accounted* d2h at the
+``memstore.fetch_shard`` call site), so a write's encode->store path
+moves zero body bytes and a read-hot shard stays in HBM until a client
+actually fetches it.
+
+Residency is bounded: every live resident shard is registered with the
+process-wide ``g_device_budget`` LRU.  When resident bytes exceed
+``os_memstore_device_bytes_max`` the coldest shards are *demoted* —
+copied down to host bytes (accounted at ``memstore.demote_shard``) and
+dropped from HBM.  The budget holds weak references only, so a shard
+that MemStore discards (truncate, overwrite, collection teardown)
+releases its bytes without any unregister call.
+
+All state transitions (resident -> host) happen under the budget's one
+named lock; ``materialize`` is therefore safe to race from scrub, read,
+and eviction at once — exactly one d2h happens.
+"""
+from __future__ import annotations
+
+import weakref
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..common.config import g_conf
+from ..common.lockdep import DebugLock
+from ..trace.devprof import g_devprof
+
+# ---- perf counters (perf dump / Prometheus memstore_device_*) --------------
+MEMSTORE_DEVICE_FIRST = 96100
+l_msd_resident_bytes = 96101    # gauge: device-resident shard bytes
+l_msd_resident_shards = 96102   # gauge: device-resident shard count
+l_msd_materializations = 96103  # lazy first-host-read materializations
+l_msd_demotions = 96104         # budget-pressure demotions to host bytes
+l_msd_crc_device = 96105        # HashInfo digests taken from the fused
+                                # device CRC (no host hashing)
+l_msd_crc_host = 96106          # HashInfo digests hashed on host bytes
+MEMSTORE_DEVICE_LAST = 96110
+
+_msd_pc = None
+_msd_pc_lock = DebugLock("memstore_device_pc::init")
+
+
+def memstore_device_perf_counters():
+    """The device-resident shard store's counter logger (perf dump /
+    Prometheus ``ceph_daemon_memstore_device_*``)."""
+    global _msd_pc
+    if _msd_pc is not None:
+        return _msd_pc
+    with _msd_pc_lock:
+        if _msd_pc is None:
+            from ..common.perf_counters import PerfCountersBuilder
+            b = PerfCountersBuilder("memstore_device",
+                                    MEMSTORE_DEVICE_FIRST,
+                                    MEMSTORE_DEVICE_LAST)
+            b.add_u64(l_msd_resident_bytes, "resident_bytes",
+                      "device-resident shard body bytes (HBM)")
+            b.add_u64(l_msd_resident_shards, "resident_shards",
+                      "device-resident shard bodies")
+            b.add_u64_counter(l_msd_materializations, "materializations",
+                              "resident shards materialized to host "
+                              "bytes on first host read")
+            b.add_u64_counter(l_msd_demotions, "demotions",
+                              "resident shards demoted to host bytes "
+                              "by the os_memstore_device_bytes_max "
+                              "LRU budget")
+            b.add_u64_counter(l_msd_crc_device, "crc_device",
+                              "shard digests taken from the fused "
+                              "device CRC kernel")
+            b.add_u64_counter(l_msd_crc_host, "crc_host",
+                              "shard digests hashed from host bytes")
+            _msd_pc = b.create_perf_counters()
+    return _msd_pc
+
+
+class DeviceShardBudget:
+    """LRU byte budget over all live device-resident shards.
+
+    Weak entries keyed by shard identity; ``weakref.finalize`` returns
+    the bytes of shards the store simply dropped.  Eviction collects
+    victims under the lock and demotes them outside it (demotion
+    re-enters the lock to transition the shard's state).
+    """
+
+    def __init__(self):
+        self.lock = DebugLock("DeviceShardBudget::lock")
+        # id(shard) -> (weakref, nbytes); insertion order = LRU order
+        self._entries: "OrderedDict[int, Tuple[weakref.ref, int]]" = \
+            OrderedDict()
+        self._bytes = 0
+
+    # -- gauges --------------------------------------------------------------
+    def _publish_locked(self) -> None:
+        pc = memstore_device_perf_counters()
+        pc.set(l_msd_resident_bytes, self._bytes)
+        pc.set(l_msd_resident_shards, len(self._entries))
+
+    def resident_bytes(self) -> int:
+        with self.lock:
+            return self._bytes
+
+    def resident_shards(self) -> int:
+        with self.lock:
+            return len(self._entries)
+
+    # -- membership ----------------------------------------------------------
+    def admit(self, shard: "DeviceShard") -> None:
+        sid = id(shard)
+        with self.lock:
+            if sid not in self._entries:
+                self._entries[sid] = (weakref.ref(shard), shard.length)
+                self._bytes += shard.length
+                self._publish_locked()
+        weakref.finalize(shard, self._finalized, sid)
+        self._evict_over_budget()
+
+    def touch(self, shard: "DeviceShard") -> None:
+        with self.lock:
+            if id(shard) in self._entries:
+                self._entries.move_to_end(id(shard))
+
+    def _remove_locked(self, sid: int) -> None:
+        ent = self._entries.pop(sid, None)
+        if ent is not None:
+            self._bytes -= ent[1]
+            self._publish_locked()
+
+    def _finalized(self, sid: int) -> None:
+        with self.lock:
+            ent = self._entries.get(sid)
+            # the slot may have been recycled onto a live newcomer
+            if ent is not None and ent[0]() is None:
+                self._remove_locked(sid)
+
+    # -- eviction ------------------------------------------------------------
+    def _evict_over_budget(self) -> None:
+        limit = int(g_conf.get_val("os_memstore_device_bytes_max"))
+        if limit <= 0:
+            return
+        while True:
+            victim = None
+            with self.lock:
+                if self._bytes <= limit or not self._entries:
+                    return
+                sid, (ref, _nb) = next(iter(self._entries.items()))
+                victim = ref()
+                if victim is None:
+                    self._remove_locked(sid)
+                    continue
+            victim.demote()
+
+
+g_device_budget = DeviceShardBudget()
+
+
+class DeviceShard:
+    """One shard body living in HBM: array handle + length + crc.
+
+    ``bytes(shard)`` / ``len(shard)`` make it drop-in where MemStore
+    slices object data, so ``stat``/``save``/host reads work unchanged —
+    the bytes() coercion IS the accounted lazy materialization.
+    """
+
+    __slots__ = ("_dev", "_host", "length", "crc", "__weakref__")
+
+    def __init__(self, dev, length: int, crc: int):
+        self._dev = dev
+        self._host: Optional[bytes] = None
+        self.length = int(length)
+        self.crc = int(crc)
+        g_device_budget.admit(self)
+
+    @property
+    def is_resident(self) -> bool:
+        return self._host is None
+
+    def __len__(self) -> int:
+        return self.length
+
+    def device_array(self):
+        """The live device handle, or None once materialized/demoted."""
+        return self._dev
+
+    def _to_host_locked(self) -> bytes:
+        host = np.asarray(self._dev, dtype=np.uint8).tobytes()
+        assert len(host) == self.length
+        self._host = host
+        self._dev = None
+        g_device_budget._remove_locked(id(self))
+        return host
+
+    def materialize(self) -> bytes:
+        """Host bytes; the first call is THE d2h of this shard's life
+        (accounted at ``memstore.fetch_shard``), later calls are free."""
+        if self._host is not None:
+            return self._host
+        with g_device_budget.lock:
+            if self._host is not None:
+                return self._host
+            host = self._to_host_locked()
+        g_devprof.account_d2h("memstore.fetch_shard", self.length)
+        memstore_device_perf_counters().inc(l_msd_materializations)
+        return host
+
+    def __bytes__(self) -> bytes:
+        return self.materialize()
+
+    def demote(self) -> None:
+        """Budget-pressure copy-down: same transition as materialize,
+        accounted as a demotion (``memstore.demote_shard``)."""
+        if self._host is not None:
+            return
+        with g_device_budget.lock:
+            if self._host is not None:
+                return
+            self._to_host_locked()
+        g_devprof.account_d2h("memstore.demote_shard", self.length)
+        memstore_device_perf_counters().inc(l_msd_demotions)
+
+    def corrupted(self) -> "DeviceShard":
+        """Flip one body byte in place (fault injection: the stored crc
+        goes stale, exactly like bitrot under a host-bytes store)."""
+        if self.length == 0:
+            return self
+        with g_device_budget.lock:
+            if self._host is not None:
+                rot = bytearray(self._host)
+                rot[0] ^= 0x01
+                self._host = bytes(rot)
+            else:
+                import jax.numpy as jnp
+                self._dev = self._dev.at[0].set(
+                    self._dev[0] ^ jnp.uint8(1))
+        return self

@@ -8,6 +8,14 @@ block/WAL machinery is host-I/O out of scope for a TPU build (SURVEY.md
 §2.9) — this is the durability stand-in that keeps the OSD data path
 honest: every shard write and recovery push lands here through the same
 Transaction ABI the reference uses.
+
+Device-resident shard bodies: an object's ``data`` may be a
+``DeviceShard`` (os_store/device_shard.py) instead of a bytearray — a
+whole-body handle written via ``Transaction.write_shard`` that stays in
+HBM until a host read materializes it (the accounted
+``memstore.fetch_shard`` d2h).  ``stat``/``save`` work unchanged via
+``len()``/``bytes()``; any byte-granular mutation (write/zero/truncate)
+materializes first, so splicing semantics are identical either way.
 """
 from __future__ import annotations
 
@@ -17,6 +25,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 from ..common.lockdep import DebugRLock
+from .device_shard import DeviceShard, g_device_budget
 
 
 @dataclass(frozen=True, order=True)
@@ -41,6 +50,7 @@ class _Object:
 # transaction op codes (subset of ObjectStore::Transaction ops)
 OP_TOUCH = "touch"
 OP_WRITE = "write"
+OP_WRITE_SHARD = "write_shard"  # whole-body replace, handle-typed
 OP_ZERO = "zero"
 OP_TRUNCATE = "truncate"
 OP_REMOVE = "remove"
@@ -64,6 +74,13 @@ class Transaction:
 
     def write(self, cid: str, oid: hobject_t, offset: int, data):
         self.ops.append((OP_WRITE, cid, oid, offset, bytes(data)))
+
+    def write_shard(self, cid: str, oid: hobject_t, shard):
+        """Replace the whole object body with *shard* (a ``DeviceShard``
+        handle or host bytes) without coercing — the zero-copy write
+        path's store op: a resident body is queued and applied with no
+        byte movement at all."""
+        self.ops.append((OP_WRITE_SHARD, cid, oid, shard))
 
     def zero(self, cid: str, oid: hobject_t, offset: int, length: int):
         self.ops.append((OP_ZERO, cid, oid, offset, length))
@@ -227,10 +244,22 @@ class MemStore:
     @staticmethod
     def _clone(obj: _Object) -> _Object:
         c = _Object()
-        c.data = bytearray(obj.data)
+        # a DeviceShard is immutable-by-convention (mutations replace
+        # the whole body or materialize first) — clones share the
+        # handle so staging a touched collection moves no device bytes
+        c.data = obj.data if isinstance(obj.data, DeviceShard) \
+            else bytearray(obj.data)
         c.attrs = dict(obj.attrs)
         c.omap = dict(obj.omap)
         return c
+
+    @staticmethod
+    def _mutable(o: _Object) -> bytearray:
+        """The object's body as a spliceable bytearray; a resident
+        shard materializes first (byte-granular edits need bytes)."""
+        if isinstance(o.data, DeviceShard):
+            o.data = bytearray(o.data.materialize())
+        return o.data
 
     def _apply(self, colls, t: Transaction) -> None:
         def coll(cid):
@@ -257,24 +286,30 @@ class MemStore:
             elif code == OP_WRITE:
                 _, cid, oid, offset, data = op
                 o = obj(cid, oid, create=True)
+                buf = self._mutable(o)
                 end = offset + len(data)
-                if len(o.data) < end:
-                    o.data.extend(b"\0" * (end - len(o.data)))
-                o.data[offset:end] = data
+                if len(buf) < end:
+                    buf.extend(b"\0" * (end - len(buf)))
+                buf[offset:end] = data
+            elif code == OP_WRITE_SHARD:
+                _, cid, oid, shard = op
+                obj(cid, oid, create=True).data = shard
             elif code == OP_ZERO:
                 _, cid, oid, offset, length = op
                 o = obj(cid, oid, create=True)
+                buf = self._mutable(o)
                 end = offset + length
-                if len(o.data) < end:
-                    o.data.extend(b"\0" * (end - len(o.data)))
-                o.data[offset:end] = b"\0" * length
+                if len(buf) < end:
+                    buf.extend(b"\0" * (end - len(buf)))
+                buf[offset:end] = b"\0" * length
             elif code == OP_TRUNCATE:
                 _, cid, oid, size = op
                 o = obj(cid, oid, create=True)
-                if len(o.data) > size:
-                    del o.data[size:]
+                buf = self._mutable(o)
+                if len(buf) > size:
+                    del buf[size:]
                 else:
-                    o.data.extend(b"\0" * (size - len(o.data)))
+                    buf.extend(b"\0" * (size - len(buf)))
             elif code == OP_REMOVE:
                 coll(op[1]).pop(op[2], None)
             elif code == OP_SETATTR:
@@ -304,12 +339,46 @@ class MemStore:
     def exists(self, cid: str, oid: hobject_t) -> bool:
         return oid in self.colls.get(cid, {})
 
+    def _maybe_corrupt(self, cid: str, oid: hobject_t,
+                       o: _Object) -> None:
+        """Fault site ``store.shard_corrupt``: flip one stored body
+        byte (bitrot) — works on resident handles and host bytes alike
+        so the crc EIO path is testable in both representations."""
+        from ..fault import g_faults  # lazy: fault imports trace
+        if not g_faults.site_armed("store.shard_corrupt"):
+            return
+        if not g_faults.should_fire("store.shard_corrupt",
+                                    f"{cid}/{oid}"):
+            return
+        d = o.data
+        if isinstance(d, DeviceShard):
+            d.corrupted()
+        elif len(d):
+            d[0] ^= 0x01
+
     def read(self, cid: str, oid: hobject_t, offset: int = 0,
              length: int = 0) -> bytes:
         o = self.colls[cid][oid]
+        self._maybe_corrupt(cid, oid, o)
+        d = o.data
+        if isinstance(d, DeviceShard):
+            d = d.materialize()
         if length == 0:
-            length = len(o.data) - offset
-        return bytes(o.data[offset:offset + length])
+            length = len(d) - offset
+        return bytes(d[offset:offset + length])
+
+    def read_shard(self, cid: str, oid: hobject_t):
+        """The whole body WITHOUT forcing host bytes: a resident
+        ``DeviceShard`` comes back as the handle itself (LRU-touched);
+        host-bytes bodies come back as bytes.  The zero-copy read path
+        for in-process fabrics."""
+        o = self.colls[cid][oid]
+        self._maybe_corrupt(cid, oid, o)
+        d = o.data
+        if isinstance(d, DeviceShard):
+            g_device_budget.touch(d)
+            return d
+        return bytes(d)
 
     def stat(self, cid: str, oid: hobject_t) -> int:
         return len(self.colls[cid][oid].data)

@@ -75,6 +75,9 @@ from ..msg import (
 from ..trace import (g_devprof, g_oplat, g_perf_histograms, g_tracer,
                      latency_in_bytes_axes, pipeline_axes)
 from ..os_store import MemStore, Transaction, hobject_t
+from ..os_store.device_shard import (DeviceShard, l_msd_crc_device,
+                                     l_msd_crc_host,
+                                     memstore_device_perf_counters)
 from ..utils.crc32c import crc32c
 from .ecutil import HashInfo, stripe_info_t
 
@@ -429,6 +432,44 @@ class ECBackend:
         self.hist_encode.inc((time.perf_counter() - t0) * 1e6, len(data))
         return shards
 
+    def _encode_resident(self, data: bytes) \
+            -> Optional[Dict[int, DeviceShard]]:
+        """The zero-copy encode: fused GF matmul + crc32c in one jitted
+        call, shard bodies staying on device as ``DeviceShard`` handles
+        (ops/resident).  None = residency off or the codec's layout
+        rules the fused kernel out — callers fall back to the classic
+        funnel, byte-identical by construction."""
+        if int(g_conf.get_val("os_memstore_device_bytes_max")) <= 0:
+            return None
+        w = self.sinfo.get_stripe_width()
+        if not data or len(data) % w:
+            return None
+        from ..ops.resident import encode_resident_shards
+        buf = np.frombuffer(bytes(data), dtype=np.uint8) \
+            if not isinstance(data, np.ndarray) else data
+        stripes = buf.reshape(len(buf) // w, self.k,
+                              self.sinfo.get_chunk_size())
+        t0 = time.perf_counter()
+        try:
+            if g_tracer.enabled:
+                with g_tracer.span("ec_encode") as sp:
+                    if sp is not None:
+                        sp.tags["bytes"] = len(data)
+                        sp.tags["resident"] = True
+                    shards = encode_resident_shards(self.ec_impl,
+                                                    stripes)
+            else:
+                shards = encode_resident_shards(self.ec_impl, stripes)
+        except Exception:
+            # any device-side surprise degrades to the classic path —
+            # a residency failure must never cost the client op
+            return None
+        if shards is None:
+            return None
+        g_oplat.checkpoint("device_call")
+        self.hist_encode.inc((time.perf_counter() - t0) * 1e6, len(data))
+        return shards
+
     def _decode_timed(self, nbytes: int, fn, *args):
         """Shared decode instrumentation (concat + shard-recovery)."""
         t0 = time.perf_counter()
@@ -460,6 +501,13 @@ class ECBackend:
         and the batch_dispatch children stay on the op's trace."""
         depth = int(g_conf.get_val("ec_pipeline_depth"))
         if depth <= 1:
+            # device-resident first (os_memstore_device_bytes_max > 0):
+            # the fused encode+crc keeps shard bodies in HBM and the
+            # fan-out passes handles — zero body d2h on this path
+            shards = self._encode_resident(data)
+            if shards is not None:
+                then(shards, None)
+                return
             # today's synchronous path by construction: any encode
             # exception propagates to the submitter exactly as before
             then(self._encode(data), None)
@@ -862,8 +910,22 @@ class ECBackend:
         cur_span = g_tracer.current_span_id() if g_tracer.enabled else 0
         msg_bytes = 0
         for shard, osd in acting.items():
-            chunk = shards[shard].tobytes() if shard in shards else b""
-            msg_bytes += len(chunk)
+            body = shards[shard] if shard in shards else b""
+            if isinstance(body, DeviceShard):
+                # in-process fabric: the handle itself rides the
+                # message — the body never leaves the device here
+                chunk = body
+            elif isinstance(body, np.ndarray):
+                if body.flags["C_CONTIGUOUS"]:
+                    # zero-copy view over the one materialized pack
+                    # buffer (ecutil.pack_shards accounted that copy)
+                    chunk = body.data
+                else:
+                    chunk = body.tobytes()
+                    msg_bytes += len(chunk)
+            else:
+                chunk = body
+                msg_bytes += len(body)
             msg = MOSDECSubOpWrite(
                 tid=tid, pgid=self.pg.pgid, shard=shard, oid=oid,
                 chunk=chunk, offset=chunk_off, partial=partial,
@@ -1018,28 +1080,38 @@ class ECBackend:
             store.queue_transaction(t)
             return MOSDECSubOpWriteReply(tid=msg.tid, pgid=msg.pgid,
                                          shard=msg.shard, committed=True)
-        if not msg.partial:
-            t.truncate(cid, ho, 0)
-            t.write(cid, ho, 0, msg.chunk)
-            body = msg.chunk
+        if not msg.partial and isinstance(msg.chunk, DeviceShard):
+            # zero-copy store: the device handle becomes the shard
+            # body and the fused encode kernel's crc IS the HashInfo
+            # digest — no host bytes move, nothing is hashed on host
+            t.write_shard(cid, ho, msg.chunk)
+            hinfo = struct.pack("<QI", msg.chunk.length, msg.chunk.crc)
+            memstore_device_perf_counters().inc(l_msd_crc_device)
         else:
-            existing = store.read(cid, ho) \
-                if store.collection_exists(cid) and store.exists(cid, ho) \
-                else b""
-            spliced = bytearray(max(len(existing),
-                                    msg.offset + len(msg.chunk)))
-            spliced[:len(existing)] = existing
-            spliced[msg.offset:msg.offset + len(msg.chunk)] = msg.chunk
-            t.truncate(cid, ho, 0)
-            t.write(cid, ho, 0, bytes(spliced))
-            body = bytes(spliced)
+            if not msg.partial:
+                t.truncate(cid, ho, 0)
+                t.write(cid, ho, 0, msg.chunk)
+                body = msg.chunk
+            else:
+                existing = store.read(cid, ho) \
+                    if store.collection_exists(cid) and \
+                    store.exists(cid, ho) else b""
+                spliced = bytearray(max(len(existing),
+                                        msg.offset + len(msg.chunk)))
+                spliced[:len(existing)] = existing
+                spliced[msg.offset:msg.offset + len(msg.chunk)] = \
+                    msg.chunk
+                t.truncate(cid, ho, 0)
+                t.write(cid, ho, 0, bytes(spliced))
+                body = bytes(spliced)
+            hi = HashInfo(1)
+            hi.append(0, {0: np.frombuffer(body, dtype=np.uint8)})
+            hinfo = struct.pack("<QI", hi.total_chunk_size,
+                                hi.get_chunk_hash(0))
+            memstore_device_perf_counters().inc(l_msd_crc_host)
         t.setattr(cid, ho, SIZE_ATTR, struct.pack("<Q", msg.at_version))
         self._apply_user_attrs(t, store, cid, ho, msg.xattrs)
-        hi = HashInfo(1)
-        hi.append(0, {0: np.frombuffer(body, dtype=np.uint8)})
-        t.setattr(cid, ho, HINFO_ATTR,
-                  struct.pack("<QI", hi.total_chunk_size,
-                              hi.get_chunk_hash(0)))
+        t.setattr(cid, ho, HINFO_ATTR, hinfo)
         if msg.version:
             from .pg_log import VERSION_ATTR
             t.setattr(cid, ho, VERSION_ATTR,
@@ -1267,17 +1339,20 @@ class ECBackend:
             return MOSDECSubOpReadReply(tid=msg.tid, pgid=msg.pgid,
                                         shard=msg.shard, oid=msg.oid,
                                         result=-2)  # ENOENT
-        data = store.read(cid, ho)
+        data = store.read_shard(cid, ho)
         attrs = store.getattrs(cid, ho)
         hv = attrs.get(HINFO_ATTR)
         if hv is not None:
             total, expect = struct.unpack("<QI", hv)
-            if total == len(data) and crc32c(data) != expect:
+            if total == len(data) and self._shard_crc(data) != expect:
                 # bit rot: fail the shard read so the primary reconstructs
                 return MOSDECSubOpReadReply(tid=msg.tid, pgid=msg.pgid,
                                             shard=msg.shard, oid=msg.oid,
                                             result=-5)
         if msg.repair_for >= 0:
+            if isinstance(data, DeviceShard):
+                # repair math is host-side numpy: fetch the body
+                data = data.materialize()
             # sub-chunk repair helper (docs/RECOVERY.md): compute this
             # shard's β-sub-chunk contribution toward rebuilding shard
             # ``repair_for`` instead of shipping the whole chunk.  The
@@ -1309,11 +1384,32 @@ class ECBackend:
         if msg.attrs_only:
             data = b""
         elif msg.offset or msg.length:
+            if isinstance(data, DeviceShard):
+                data = data.materialize()
             end = msg.offset + msg.length if msg.length else len(data)
             data = data[msg.offset:end]
+        # a full-body read of a resident shard replies with the HANDLE:
+        # on the in-process fabric the body stays in HBM until the
+        # primary (or its client) actually touches bytes
         return MOSDECSubOpReadReply(tid=msg.tid, pgid=msg.pgid,
                                     shard=msg.shard, oid=msg.oid,
                                     data=data, attrs=attrs, result=0)
+
+    @staticmethod
+    def _shard_crc(data) -> int:
+        """crc32c of a stored body in whichever representation it has:
+        a still-resident shard verifies on DEVICE (ops/crc32c_device,
+        bit-identical kernel — the only d2h is the 4-byte scalar); host
+        bytes verify through the classic path."""
+        if isinstance(data, DeviceShard):
+            dev = data.device_array()
+            if dev is not None:
+                from ..ops.crc32c_device import crc32c_of_device_array
+                memstore_device_perf_counters().inc(l_msd_crc_device)
+                return crc32c_of_device_array(dev)
+            data = data.materialize()
+        memstore_device_perf_counters().inc(l_msd_crc_host)
+        return crc32c(data)
 
     def handle_sub_read_reply(self, msg: MOSDECSubOpReadReply) -> None:
         """Collect shard replies; reconstruct on completion
@@ -1402,9 +1498,16 @@ class ECBackend:
             # degradation contract for injected/real media errors)
             fault_perf_counters().inc(l_fault_eio_reconstructs)
         if rd.raw:
-            rd.on_done(0, dict(rd.chunks), rd.size, rd.user_attrs)
+            # raw consumers (recovery, realign) slice and splice on
+            # host — hand them bytes, not handles
+            rd.on_done(0, {i: (b.materialize()
+                               if isinstance(b, DeviceShard) else b)
+                           for i, b in rd.chunks.items()},
+                       rd.size, rd.user_attrs)
             return
-        arrays = {i: np.frombuffer(b, dtype=np.uint8)
+        arrays = {i: np.frombuffer(b.materialize()
+                                   if isinstance(b, DeviceShard) else b,
+                                   dtype=np.uint8)
                   for i, b in rd.chunks.items()}
         try:
             # the decode runs from the sub-read-reply dispatch context:
@@ -1425,7 +1528,9 @@ class ECBackend:
                        source_chunks: Dict[int, bytes],
                        logical_size: int) -> Dict[int, bytes]:
         """Decode the missing shards' chunks from k sources."""
-        arrays = {i: np.frombuffer(b, dtype=np.uint8)
+        arrays = {i: np.frombuffer(b.materialize()
+                                   if isinstance(b, DeviceShard) else b,
+                                   dtype=np.uint8)
                   for i, b in source_chunks.items()}
         rec = self._decode_timed(
             sum(len(b) for b in source_chunks.values()),

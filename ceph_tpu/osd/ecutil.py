@@ -72,6 +72,22 @@ class stripe_info_t:
         return start, end - start
 
 
+def _pack_rows(want_l, rows) -> Dict[int, np.ndarray]:
+    """ONE materialized pack: every wanted shard's body lands in a
+    single contiguous (n_want, S*C) buffer and the per-shard outputs
+    are row VIEWS of it.  Downstream fan-out sends zero-copy
+    memoryviews of these rows, replacing the old per-shard
+    ``ecutil.shard_slice`` materialization + ``ec.subop_messages``
+    re-materialization pair with one accounted copy."""
+    rows = list(rows)
+    S, C = rows[0].shape
+    pack = np.empty((len(want_l), S * C), dtype=np.uint8)
+    for j, src in enumerate(rows):
+        pack[j].reshape(S, C)[:] = src
+    g_devprof.account_host_copy("ecutil.pack_shards", pack.nbytes)
+    return {i: pack[j] for j, i in enumerate(want_l)}
+
+
 def encode(sinfo: stripe_info_t, ec_impl, data,
            want: Set[int]) -> Dict[int, np.ndarray]:
     """Erasure-code a stripe-aligned payload; returns shard id -> buffer.
@@ -99,41 +115,26 @@ def encode(sinfo: stripe_info_t, ec_impl, data,
         # there is no systematic passthrough set)
         allc = ec_impl.encode_batch(prepare(buf, S))     # (S, n, C)
         g_oplat.checkpoint("device_call")
-        out = {i: np.ascontiguousarray(allc[:, i, :]).reshape(-1)
-               for i in want}
-        g_devprof.account_host_copy(
-            "ecutil.shard_slice", sum(b.nbytes for b in out.values()))
-        return out
+        want_l = sorted(want)
+        return _pack_rows(want_l, (allc[:, i, :] for i in want_l))
     if hasattr(ec_impl, "encode_batch_full"):
         # mapped layered codes (lrc): one batched call yields every
         # physical chunk directly
         stripes = buf.reshape(S, k, C)
         allc = ec_impl.encode_batch_full(stripes)     # (S, n, C)
         # stage ledger: the codec call returned; the submitting op's
-        # d2h stage (stamped by the dispatcher) covers the slice-out
-        # and materialization below
+        # d2h stage (stamped by the dispatcher) covers the pack below
         g_oplat.checkpoint("device_call")
-        out = {i: np.ascontiguousarray(allc[:, i, :]).reshape(-1)
-               for i in want}
-        g_devprof.account_host_copy(
-            "ecutil.shard_slice", sum(b.nbytes for b in out.values()))
-        return out
+        want_l = sorted(want)
+        return _pack_rows(want_l, (allc[:, i, :] for i in want_l))
     if hasattr(ec_impl, "encode_batch") and not ec_impl.get_chunk_mapping():
         stripes = buf.reshape(S, k, C)
         coding = ec_impl.encode_batch(stripes)        # (S, m, C)
         g_oplat.checkpoint("device_call")
-        out: Dict[int, np.ndarray] = {}
-        for i in want:
-            if i < k:
-                out[i] = np.ascontiguousarray(stripes[:, i, :]).reshape(-1)
-            else:
-                out[i] = np.ascontiguousarray(
-                    coding[:, i - k, :]).reshape(-1)
-        # per-shard slice-out of the batched result: one ledger stage
-        # for the whole fan (S*C bytes per wanted shard)
-        g_devprof.account_host_copy(
-            "ecutil.shard_slice", sum(b.nbytes for b in out.values()))
-        return out
+        want_l = sorted(want)
+        return _pack_rows(want_l,
+                          (stripes[:, i, :] if i < k
+                           else coding[:, i - k, :] for i in want_l))
 
     out_parts: Dict[int, List[np.ndarray]] = {i: [] for i in want}
     w = sinfo.get_stripe_width()
@@ -145,10 +146,14 @@ def encode(sinfo: stripe_info_t, ec_impl, data,
     # host-only codec loop: the "device_call" stage is the codec call
     # by definition, wherever it executes
     g_oplat.checkpoint("device_call")
-    out = {i: np.concatenate(parts) for i, parts in out_parts.items()}
-    g_devprof.account_host_copy(
-        "ecutil.shard_slice", sum(b.nbytes for b in out.values()))
-    return out
+    want_l = sorted(want)
+    pack = np.empty((len(want_l), S * C), dtype=np.uint8)
+    for j, i in enumerate(want_l):
+        row = pack[j].reshape(S, C)
+        for s, chunk in enumerate(out_parts[i]):
+            row[s] = chunk
+    g_devprof.account_host_copy("ecutil.pack_shards", pack.nbytes)
+    return {i: pack[j] for j, i in enumerate(want_l)}
 
 
 def decode_concat(sinfo: stripe_info_t, ec_impl,

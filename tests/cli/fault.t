@@ -22,6 +22,7 @@ site catalog, arming a trigger, the unknown-site refusal, and clearing.
       "osd.shard_read_eio": "shard-side EC read returns EIO (bluestore_debug_inject_read_err role) \u2014 the primary must reconstruct from surviving shards",
       "recovery.helper_fetch": "helper-side repair contribution read (handle_sub_read) \u2014 a dropped helper fails the round and the orchestrator falls back to full-stripe decode",
       "recovery.repair_read": "sub-chunk repair round start (recovery scheduler) \u2014 firing degrades the repair to the full-stripe decode path",
+      "store.shard_corrupt": "flip one byte of a stored shard body at read time (memstore) \u2014 the shard-side crc32c verify must catch it and return EIO, whether the body is host bytes or a device-resident handle; context is '<coll>/<oid>' for match= scoping",
       "tpu.decode_batch_device": "device-resident decode entry point (tpu_plugin, mesh/bench)",
       "tpu.encode_batch_device": "device-resident encode entry point (tpu_plugin, mesh/bench)"
     }
@@ -192,6 +193,11 @@ the live trigger spec or null.
       "armed": null,
       "description": "sub-chunk repair round start (recovery scheduler) \u2014 firing degrades the repair to the full-stripe decode path",
       "name": "recovery.repair_read"
+    },
+    {
+      "armed": null,
+      "description": "flip one byte of a stored shard body at read time (memstore) \u2014 the shard-side crc32c verify must catch it and return EIO, whether the body is host bytes or a device-resident handle; context is '<coll>/<oid>' for match= scoping",
+      "name": "store.shard_corrupt"
     },
     {
       "armed": null,

@@ -357,6 +357,43 @@ def test_gate_straggler_invariants(tmp_path):
             (over, names)
 
 
+def test_gate_zero_copy_invariants(tmp_path):
+    """The ZERO-COPY GATE is absolute (no baseline needed): a resident
+    leg that fetched shard-scale bytes back from device, that did not
+    strictly beat the bytes twin's copies/op, that silently degraded
+    (nothing resident when the write region closed), or that diverged
+    on read-back each fail the gate on their own."""
+    def zc_metric(**over):
+        m = _metric("ec_write_zero_copy", 100.0, unit="ops_per_sec")
+        zc = {"resident_d2h_bytes_per_op": 20.0,
+              "resident_copies_per_op": 2.2,
+              "twin_copies_per_op": 3.0,
+              "resident_shards": 30,
+              "byte_exact": True}
+        zc.update(over)
+        m["zero_copy"] = zc
+        return m
+
+    # a clean run gates clean — with or without any baseline round
+    out = regress.compare_against_trajectory([zc_metric()], [], "cpu")
+    assert out["zero_copy_compared"] == 1 and not out["regressions"]
+    cases = (
+        ({"resident_d2h_bytes_per_op":
+          regress.ZERO_COPY_MAX_D2H_BYTES_PER_OP},
+         "resident_d2h_bytes_per_op"),
+        ({"resident_copies_per_op": 3.0}, "resident_copies_per_op"),
+        ({"resident_copies_per_op": 3.5}, "resident_copies_per_op"),
+        ({"resident_shards": 0}, "resident_shards"),
+        ({"byte_exact": False}, "byte_exact"),
+    )
+    for over, key in cases:
+        out = regress.compare_against_trajectory(
+            [zc_metric(**over)], [], "cpu")
+        names = {r["name"] for r in out["regressions"]}
+        assert f"ec_write_zero_copy.zero_copy.{key}" in names, \
+            (over, names)
+
+
 def test_gate_control_invariants(tmp_path):
     """The CONTROL GATE is absolute (no baseline needed): a scenario
     that never raised, never moved, failed to converge inside the
@@ -540,7 +577,7 @@ def test_smoke_mode_end_to_end():
     """`python -m ceph_tpu.bench --smoke` is the per-PR harness check:
     exit 0 on CPU, one schema-valid JSON line, fenced metrics with
     stats and a roofline verdict, in under a minute of measured time
-    (the harness now spans 13 workloads — the budget is a
+    (the harness now spans 14 workloads — the budget is a
     minutes-scale canary, not a per-workload perf gate; those live in
     regress.py)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -568,7 +605,7 @@ def test_smoke_mode_end_to_end():
             "ec_mesh_fenced", "ec_mesh_single_fenced",
             "traffic_harness_smoke", "ec_recovery_storm",
             "ec_mesh_skew", "ec_mesh_straggler",
-            "ec_degraded_read"} <= names
+            "ec_degraded_read", "ec_write_zero_copy"} <= names
     # the coalesce metric carries its serial twin and speedup
     mc = next(m for m in out["metrics"]
               if m["name"] == "ec_dispatch_coalesce_fenced")
@@ -683,6 +720,20 @@ def test_smoke_mode_end_to_end():
     assert st["healthy_false_suspects"] == 0
     assert st["byte_identical"] is True and mstr["identical"] is True
     assert out["gate"]["straggler_compared"] >= 1
+    # zero-copy acceptance (ISSUE 20): the resident leg of the A/B did
+    # essentially no d2h on the write path (CRC scalars only, under
+    # the 512 B/op gate), strictly beat the bytes twin on copies/op,
+    # actually kept shards resident, and read back byte-exact
+    mzc = next(m for m in out["metrics"]
+               if m["name"] == "ec_write_zero_copy")
+    zc = mzc["zero_copy"]
+    assert zc["resident_d2h_bytes_per_op"] \
+        < regress.ZERO_COPY_MAX_D2H_BYTES_PER_OP, zc
+    assert zc["resident_copies_per_op"] < zc["twin_copies_per_op"], zc
+    assert zc["resident_shards"] > 0
+    assert zc["byte_exact"] is True
+    assert mzc["twin_ops_per_sec"] > 0
+    assert out["gate"]["zero_copy_compared"] >= 1
     # devprof acceptance: EVERY fenced workload emits a devflow block
     # with the gated per-op figures, and the dispatch/pipeline pairs
     # show coalescing as FEWER copies per op (the copy-budget story)

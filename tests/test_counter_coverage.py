@@ -97,6 +97,21 @@ def cluster_and_text():
                                    recovery_perf_counters)
     assert recovery_perf_counters().get(l_recovery_repair_rounds) > 0
     assert cl.read("lintregen", "r") == b"r" * 3000
+    # one write through the DEVICE-RESIDENT path (fused encode+crc,
+    # shard bodies kept in HBM) and one materializing read-back so the
+    # memstore_device_* family registers AND moves — the lint below
+    # then covers the zero-copy write path like any other family
+    g_conf.set_val("os_memstore_device_bytes_max", 1 << 30)
+    try:
+        assert cl.write_full("lint", "od", b"z" * 16000) == 0
+        assert cl.read("lint", "od") == b"z" * 16000
+    finally:
+        g_conf.rm_val("os_memstore_device_bytes_max")
+    from ceph_tpu.os_store import memstore_device_perf_counters
+    msd = memstore_device_perf_counters().dump()
+    assert msd["crc_device"] > 0 and msd["materializations"] > 0, \
+        "write never rode the device-resident path — its counter " \
+        "family would be lint-invisible"
     # one mgr tick so the telemetry ring holds a post-IO sample and
     # the ceph_cluster_* rollup families render with real content
     c.tick(dt=1.0)
@@ -186,6 +201,14 @@ def test_known_new_families_covered_by_the_lint(cluster_and_text):
     assert "accept_pass" in c.perf_collection.dump()["chaos"]
     assert "mesh_membership" in c.perf_collection.dump()
     assert "drained_reqs" in c.perf_collection.dump()["mesh_membership"]
+    # zero-copy-PR canary: the memstore_device logger is registered on
+    # every cluster and the fixture's residency write + read moved it,
+    # so ceph_daemon_memstore_device_* rides the generic
+    # exposition/coverage lints above
+    assert "memstore_device" in c.perf_collection.dump()
+    assert c.perf_collection.dump()["memstore_device"]["crc_device"] > 0
+    assert c.perf_collection.dump()[
+        "memstore_device"]["materializations"] > 0
     # meshed-READ-path canary: the mesh_decode logger is registered
     # and the fixture's degraded read moved it AND registered the
     # decode occupancy family, so the generic lints above really

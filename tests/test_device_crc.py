@@ -1,0 +1,162 @@
+"""Device crc32c kernel — bit-identity with the host path, end to end.
+
+The fused encode+crc path (ops/crc32c_device.py, ops/resident.py) only
+holds together if the jitted CRC is byte-identical to ``utils/crc32c``
+for every length the store can produce — including the
+non-word-aligned tails the slicing-by-8 word loop hands to the
+byte-at-a-time epilogue.  The cluster-twin tests then pin the derived
+property actually relied on: HashInfo digests stored by a
+device-resident write equal the host-hashed twin's, and a corrupted
+resident shard still fails its crc verify with EIO (the
+``store.shard_corrupt`` fault site), reconstructing from survivors.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+from ceph_tpu.utils.crc32c import crc32c
+
+pytest.importorskip("jax")
+
+from ceph_tpu.ops.crc32c_device import (crc32c_device_batch,  # noqa: E402
+                                        crc32c_device_padded,
+                                        crc32c_of_device_array,
+                                        device_crc_available)
+
+
+def test_device_crc_matches_host_for_every_length_0_to_4097():
+    """The property sweep: one padded shape (ONE compile — length is a
+    traced operand), every length 0..4097 including all word-tail
+    residues, bit-compared against the host table implementation."""
+    assert device_crc_available()
+    rng = np.random.default_rng(20260807)
+    lengths = np.arange(0, 4098, dtype=np.uint32)
+    pad_w = 4104                      # 4097 rounded up to a word multiple
+    padded = np.zeros((len(lengths), pad_w), dtype=np.uint8)
+    for i, n in enumerate(lengths):
+        padded[i, :n] = rng.integers(0, 256, size=int(n), dtype=np.uint8)
+    got = crc32c_device_padded(padded, lengths)
+    for i, n in enumerate(lengths):
+        assert int(got[i]) == crc32c(padded[i, :n]), f"length {n}"
+
+
+def test_device_crc_batch_and_single_entries_agree():
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, 256, size=(5, 12289), dtype=np.uint8)
+    batch = crc32c_device_batch(rows)
+    for i in range(rows.shape[0]):
+        expect = crc32c(rows[i])
+        assert int(batch[i]) == expect
+        import jax.numpy as jnp
+        assert crc32c_of_device_array(jnp.asarray(rows[i])) == expect
+
+
+def test_device_crc_seed_convention_matches_ceph():
+    # Ceph's convention: seed -1, no final inversion — the empty buffer
+    # hashes to the seed itself
+    got = crc32c_device_padded(np.zeros((1, 8), dtype=np.uint8),
+                               np.zeros(1, dtype=np.uint32))
+    assert int(got[0]) == 0xFFFFFFFF
+    assert crc32c(b"") == 0xFFFFFFFF
+
+
+# ---- cluster twins ----------------------------------------------------------
+@pytest.fixture
+def residency():
+    from ceph_tpu.common.config import g_conf
+    saved = g_conf.values.get("os_memstore_device_bytes_max")
+    g_conf.set_val("os_memstore_device_bytes_max", 1 << 30)
+    yield
+    if saved is None:
+        g_conf.rm_val("os_memstore_device_bytes_max")
+    else:
+        g_conf.set_val("os_memstore_device_bytes_max", saved)
+
+
+def _shard_digests(c, oid):
+    """{(cid, shard): (stored hinfo digest, host crc of stored body)}
+    across every OSD holding a shard of *oid*."""
+    from ceph_tpu.osd.ec_backend import HINFO_ATTR
+    out = {}
+    for osd in c.osds.values():
+        for cid in osd.store.list_collections():
+            if "_meta" in cid:
+                continue
+            for ho in osd.store.list_objects(cid):
+                if ho.oid != oid:
+                    continue
+                total, digest = struct.unpack(
+                    "<QI", osd.store.getattr(cid, ho, HINFO_ATTR))
+                body = osd.store.read(cid, ho)
+                assert total == len(body)
+                out[(cid, ho.shard)] = (digest, crc32c(body))
+    return out
+
+
+def test_resident_write_stores_host_identical_hinfo_digests(residency):
+    """Cluster twin: a device-resident write's stored HashInfo digests
+    (computed by the fused kernel, fetched as 4-byte scalars) equal the
+    host crc32c of the materialized shard bodies — and equal the
+    digests a residency-off twin stores for the same payload."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.common.config import g_conf
+    data = np.random.default_rng(13).integers(
+        0, 256, size=36864, dtype=np.uint8).tobytes()
+
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("dc", k=3, m=2, pg_num=8)
+    assert c.client("client.dc").write_full("dc", "obj", data) == 0
+    resident = _shard_digests(c, "obj")
+    assert len(resident) == 5
+    for key, (stored, host) in resident.items():
+        assert stored == host, f"digest mismatch at {key}"
+
+    g_conf.set_val("os_memstore_device_bytes_max", 0)
+    tw = MiniCluster(n_osds=6)
+    tw.create_ec_pool("dc", k=3, m=2, pg_num=8)
+    assert tw.client("client.tw").write_full("dc", "obj", data) == 0
+    twin = _shard_digests(tw, "obj")
+    assert {k[1]: v[0] for k, v in resident.items()} \
+        == {k[1]: v[0] for k, v in twin.items()}
+
+
+def test_chaos_pinned_seed_green_with_residency_on(residency):
+    """Acceptance: a pinned composed-chaos storyline (seed 24 — the
+    tier-1 pin in tests/test_chaos_composer.py) passes the universal
+    acceptance with the device-resident shard store ENABLED, so
+    residency survives OSD kills, EIOs and stragglers like host
+    bytes do."""
+    from ceph_tpu.chaos import run_seed
+    r = run_seed(24)
+    assert r["accepted"], r
+
+
+def test_corrupted_resident_shard_fails_crc_and_reconstructs(residency):
+    """The ``store.shard_corrupt`` fault site flips one byte of a
+    still-resident shard body at read time: the shard-side device-crc
+    verify must return EIO and the primary must serve the read
+    byte-exact from the surviving shards."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.fault import g_faults
+    from ceph_tpu.os_store.device_shard import DeviceShard
+    data = np.random.default_rng(17).integers(
+        0, 256, size=24576, dtype=np.uint8).tobytes()
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("dc", k=3, m=2, pg_num=8)
+    cl = c.client("client.dc")
+    assert cl.write_full("dc", "obj", data) == 0
+    # residency engaged: at least one stored body is still a handle
+    assert any(isinstance(osd.store.colls[cid][ho].data, DeviceShard)
+               for osd in c.osds.values()
+               for cid in osd.store.list_collections()
+               if "_meta" not in cid
+               for ho in osd.store.list_objects(cid)
+               if ho.oid == "obj")
+    spec = g_faults.inject("store.shard_corrupt", mode="once",
+                           match="obj")
+    try:
+        assert cl.read("dc", "obj") == data
+        assert spec.fires == 1, "the corruption never fired"
+    finally:
+        g_faults.clear("store.shard_corrupt")

@@ -1,0 +1,162 @@
+"""DeviceShard — device-resident memstore shard bodies + LRU budget.
+
+The device-resident shard store (os_store/device_shard.py): a shard
+body written through ``Transaction.write_shard`` stays in HBM as a
+``DeviceShard`` handle until a host read lazily materializes it, and
+the process-wide ``g_device_budget`` LRU demotes cold shards to host
+bytes when resident bytes exceed ``os_memstore_device_bytes_max``.
+Byte-granular memstore splices (write/zero/truncate) materialize first,
+so storage semantics are identical to the host-bytes representation.
+"""
+import gc
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import g_conf
+from ceph_tpu.os_store import DeviceShard, g_device_budget
+from ceph_tpu.os_store.device_shard import memstore_device_perf_counters
+from ceph_tpu.os_store.memstore import MemStore, Transaction, hobject_t
+from ceph_tpu.utils.crc32c import crc32c
+
+jnp = pytest.importorskip("jax.numpy")
+
+
+@pytest.fixture(autouse=True)
+def _budget(request):
+    """A large residency budget per test (overridable via marker) and a
+    drained process-wide LRU afterwards, so tests never see each
+    other's resident bytes."""
+    saved = g_conf.values.get("os_memstore_device_bytes_max")
+    g_conf.set_val("os_memstore_device_bytes_max", 1 << 20)
+    yield
+    if saved is None:
+        g_conf.rm_val("os_memstore_device_bytes_max")
+    else:
+        g_conf.set_val("os_memstore_device_bytes_max", saved)
+    gc.collect()
+
+
+def make_shard(data: bytes) -> DeviceShard:
+    dev = jnp.asarray(np.frombuffer(data, dtype=np.uint8))
+    return DeviceShard(dev, len(data), crc32c(data))
+
+
+def payload(n: int, seed: int = 0) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+# ---- the handle itself ------------------------------------------------------
+def test_materialize_is_byte_identical_and_lazy():
+    data = payload(4096, seed=1)
+    sh = make_shard(data)
+    assert sh.is_resident and len(sh) == 4096
+    assert sh.device_array() is not None
+    before = memstore_device_perf_counters().dump()["materializations"]
+    assert sh.materialize() == data
+    assert bytes(sh) == data                # later coercions are free
+    after = memstore_device_perf_counters().dump()["materializations"]
+    assert after == before + 1              # exactly one accounted d2h
+    assert not sh.is_resident
+    assert sh.device_array() is None        # HBM handle dropped
+
+
+def test_budget_tracks_admission_and_finalize():
+    base = g_device_budget.resident_bytes()
+    sh = make_shard(payload(2048, seed=2))
+    assert g_device_budget.resident_bytes() == base + 2048
+    del sh
+    gc.collect()
+    # the weakref finalizer returned the dropped shard's bytes without
+    # any explicit unregister call (the store just forgot the object)
+    assert g_device_budget.resident_bytes() == base
+
+
+def test_lru_demotes_coldest_shard_over_budget():
+    g_conf.set_val("os_memstore_device_bytes_max", 100)
+    before = memstore_device_perf_counters().dump()["demotions"]
+    old = make_shard(payload(64, seed=3))
+    new = make_shard(payload(64, seed=4))   # 128 > 100: evict the LRU
+    assert not old.is_resident              # demoted, not lost
+    assert new.is_resident
+    assert old.materialize() == payload(64, seed=3)
+    after = memstore_device_perf_counters().dump()["demotions"]
+    assert after == before + 1
+
+
+def test_touch_refreshes_lru_order():
+    g_conf.set_val("os_memstore_device_bytes_max", 150)
+    a = make_shard(payload(64, seed=5))
+    b = make_shard(payload(64, seed=6))
+    g_device_budget.touch(a)                # a is now the hottest
+    c = make_shard(payload(64, seed=7))     # over budget: b is coldest
+    assert a.is_resident and c.is_resident
+    assert not b.is_resident
+
+
+def test_demote_preserves_bytes_and_crc():
+    data = payload(512, seed=8)
+    sh = make_shard(data)
+    sh.demote()
+    assert not sh.is_resident
+    assert bytes(sh) == data
+    assert crc32c(bytes(sh)) == sh.crc
+    sh.demote()                             # idempotent
+
+
+# ---- memstore integration ---------------------------------------------------
+def _store_with_shard(data: bytes):
+    store = MemStore()
+    ho = hobject_t("obj", 0)
+    t = Transaction()
+    t.create_collection("c")
+    t.write_shard("c", ho, make_shard(data))
+    store.queue_transaction(t)
+    return store, ho
+
+
+def test_write_shard_stores_handle_and_stat_stays_resident():
+    data = payload(4096, seed=9)
+    store, ho = _store_with_shard(data)
+    body = store.colls["c"][ho].data
+    assert isinstance(body, DeviceShard)
+    assert store.stat("c", ho) == 4096      # len() — no d2h
+    assert body.is_resident
+
+
+def test_read_shard_returns_handle_then_read_materializes():
+    data = payload(4096, seed=10)
+    store, ho = _store_with_shard(data)
+    got = store.read_shard("c", ho)
+    assert isinstance(got, DeviceShard) and got.is_resident
+    assert store.read("c", ho) == data      # the lazy materialization
+    assert not got.is_resident
+    assert store.read("c", ho, offset=100, length=200) \
+        == data[100:300]
+
+
+def test_splice_after_residency_matches_host_semantics():
+    data = payload(1024, seed=11)
+    store, ho = _store_with_shard(data)
+    twin = MemStore()
+    t = Transaction()
+    t.create_collection("c")
+    t.write("c", ho, 0, data)
+    twin.queue_transaction(t)
+    for s in (store, twin):
+        t = Transaction()
+        t.write("c", ho, 512, b"X" * 16)
+        t.zero("c", ho, 0, 8)
+        t.truncate("c", ho, 900)
+        s.queue_transaction(t)
+    assert store.read("c", ho) == twin.read("c", ho)
+    assert store.stat("c", ho) == 900
+
+
+def test_save_load_roundtrip_materializes_resident_body(tmp_path):
+    data = payload(2048, seed=12)
+    store, ho = _store_with_shard(data)
+    path = str(tmp_path / "store.bin")
+    store.save(path)
+    assert MemStore.load(path).read("c", ho) == data

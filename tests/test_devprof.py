@@ -151,8 +151,7 @@ def test_traced_ec_write_yields_complete_copy_ledger(prof_cluster,
                                                      clean_devprof):
     """Acceptance: one traced EC write shows its full copy ledger on
     the op's span tree — ≥1 h2d and ≥1 d2h stage with non-zero bytes,
-    plus the host staging stages (stripe pad, shard slice-out, sub-op
-    message build)."""
+    plus the host staging stages (stripe pad, shard pack-out)."""
     c = prof_cluster
     cl = c.client()
     g_tracer.enable()
@@ -171,10 +170,13 @@ def test_traced_ec_write_yields_complete_copy_ledger(prof_cluster,
     assert "h2d" in dirs and "d2h" in dirs, ledger
     assert all(e["bytes"] > 0 for e in ledger)
     stages = {e["stage"] for e in ledger}
-    # the write path's staging stages are all visible
+    # the write path's staging stages are all visible (the pack is the
+    # one materialized host copy; fan-out sends zero-copy memoryviews
+    # of its rows, so the old shard_slice/subop_messages pair is gone)
     assert "gf_matmul.encode" in stages
-    assert "ec.subop_messages" in stages
-    assert "ecutil.shard_slice" in stages
+    assert "ecutil.pack_shards" in stages
+    assert "ecutil.shard_slice" not in stages
+    assert "ec.subop_messages" not in stages
 
 
 def test_prof_dump_and_prometheus_agree(prof_cluster, clean_devprof):

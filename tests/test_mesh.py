@@ -316,6 +316,48 @@ def test_plan_cache_and_pool_reuse(mesh_conf):
     assert g_mesh.dump()["pool"]["per_shape"] == 1
 
 
+def test_ec_mesh_donate_receipt(mesh_conf):
+    """ec_mesh_donate=True on the CPU backend: donation must be
+    structurally OFF (the CPU runtime cannot alias XLA buffers) while
+    the plumbing stays intact — the raw option is live in dump(), the
+    per-backend resolution leaves every plan's donated flag False, and
+    because donate is part of the plan key (resolved False on cpu) the
+    toggle must NOT fork a second plan for the same signature.  The
+    staging pool keeps recycling the padded batch buffer underneath —
+    that reuse is the receipt that the zero-copy chain did not regress
+    when donation was requested but structurally unavailable."""
+    _mesh_on(chips=8, batch_max=4)
+    g_conf.set_val("ec_mesh_donate", True)
+    impl = _mk_impl(ErasureCodeTpu, 4, 2, "reed_sol_van")
+    sinfo = stripe_info_t(4, 4 * 1024)
+    rng = np.random.default_rng(29)
+    pc = mesh_perf_counters()
+    builds0 = pc.get(l_mesh_plan_builds)
+    hits0 = pc.get(l_mesh_pool_hits)
+
+    def flush_batch():
+        blobs = [rng.integers(0, 256, size=2 * 4 * 1024, dtype=np.uint8)
+                 for _ in range(4)]
+        futs = [g_dispatcher.submit_encode(sinfo, impl, d, set(range(6)))
+                for d in blobs]
+        for d, f in zip(blobs, futs):
+            _same_shards(f.result(),
+                         eu_encode(sinfo, impl, d, set(range(6))))
+
+    flush_batch()
+    flush_batch()
+    dump = g_mesh.dump()
+    assert dump["options"]["ec_mesh_donate"] is True
+    assert dump["plans"], "mesh never built a plan"
+    assert all(p["donated"] is False for p in dump["plans"]), \
+        "donation must resolve to off on the cpu backend"
+    assert pc.get(l_mesh_plan_builds) == builds0 + 1, \
+        "donate resolves into the plan key: on cpu it is False either " \
+        "way, so the toggle must not fork a second plan"
+    assert pc.get(l_mesh_pool_hits) > hits0, \
+        "staging-pool reuse must survive a donate request"
+
+
 def test_mesh_fallback_on_device_unavailable(mesh_conf):
     """An exhausted mesh call degrades to the single-device path —
     the op completes byte-identically, the fallback is counted."""

@@ -306,6 +306,28 @@ def test_write_path_zero_syncs_when_tracing_disabled(clean_tracing,
     r = run_seed(24)
     assert r["accepted"], r
     assert calls["n"] == 0, "a full storyline run added a device sync"
+    # zero-copy residency extension: the device-resident write path
+    # (fused encode+crc kernel, shard bodies kept in HBM as handles,
+    # digests fetched as tiny scalars) must add zero block_until_ready
+    # with tracing off — and so must the read that lazily materializes
+    # those handles back to host bytes
+    from ceph_tpu.os_store import g_device_budget
+    saved_budget = g_conf.values.get("os_memstore_device_bytes_max")
+    g_conf.set_val("os_memstore_device_bytes_max", 1 << 30)
+    try:
+        res0 = g_device_budget.resident_shards()
+        assert cl.write_full("trace", "o_resident", b"z" * 20000) == 0
+        assert g_device_budget.resident_shards() > res0, \
+            "the write never took the device-resident path"
+        assert calls["n"] == 0, "resident write path added a device sync"
+        assert cl.read("trace", "o_resident") == b"z" * 20000
+        assert calls["n"] == 0, \
+            "resident read materialization added a device sync"
+    finally:
+        if saved_budget is None:
+            g_conf.rm_val("os_memstore_device_bytes_max")
+        else:
+            g_conf.set_val("os_memstore_device_bytes_max", saved_budget)
 
 
 def test_slow_op_span_tree_and_histogram_dump(clean_tracing):

@@ -116,6 +116,15 @@ def exposition():
         from ceph_tpu.mesh import g_mesh
         g_conf.rm_val("ec_mesh_chips")
         g_mesh.topology()
+    # and one write through the DEVICE-RESIDENT path (fused encode+crc
+    # kernel, shard bodies held in HBM) with a materializing read-back
+    # so the memstore_device_* counter family renders with real content
+    g_conf.set_val("os_memstore_device_bytes_max", 1 << 30)
+    try:
+        assert cl.write_full("prom", "o6", b"u" * 20000) == 0
+        assert cl.read("prom", "o6") == b"u" * 20000
+    finally:
+        g_conf.rm_val("os_memstore_device_bytes_max")
     return c.admin_socket.execute("prometheus metrics")
 
 
@@ -245,6 +254,29 @@ def test_mesh_rateless_counters(exposition):
             ("ceph_daemon_mesh_rateless_suspect_deweights", False),
             ("ceph_daemon_mesh_rateless_chip_failures", False),
             ("ceph_daemon_mesh_rateless_insufficient", False)):
+        vals = [v for n, _l, v in samples if n == counter]
+        assert vals, f"{counter} missing from the exposition"
+        if expect_positive:
+            assert vals[0] > 0, f"{counter} never moved"
+
+
+def test_memstore_device_counters(exposition):
+    """Zero-copy-PR golden coverage: the ``memstore_device_*`` counter
+    family renders as ``ceph_daemon_memstore_device_*`` daemon series
+    carrying the fixture's device-resident write — device-side CRCs
+    and materializations moved (o6 was written resident then read
+    back), resident_shards/resident_bytes are gauges that render even
+    when the budget reset drained them.  Values are process-global
+    cumulative; the demotion/LRU semantics live in the delta-based
+    assertions of tests/test_device_shard.py, not here."""
+    _types, samples = _parse(exposition)
+    for counter, expect_positive in (
+            ("ceph_daemon_memstore_device_crc_device", True),
+            ("ceph_daemon_memstore_device_materializations", True),
+            ("ceph_daemon_memstore_device_resident_bytes", False),
+            ("ceph_daemon_memstore_device_resident_shards", False),
+            ("ceph_daemon_memstore_device_demotions", False),
+            ("ceph_daemon_memstore_device_crc_host", False)):
         vals = [v for n, _l, v in samples if n == counter]
         assert vals, f"{counter} missing from the exposition"
         if expect_positive:
